@@ -1,0 +1,21 @@
+"""bobrapet_tpu — a TPU-native declarative AI workflow engine.
+
+A ground-up rebuild of the capability surface of bubustack/bobrapet
+(a Kubernetes CRD operator; see /root/reference) designed TPU-first:
+
+- **Control plane** (``core``, ``controllers``, ``admission``, ``config``):
+  the same declarative resource model (Story DAGs, Engram workers,
+  StoryRun/StepRun executions, triggers, effect claims, transports) driven
+  by event-sourced reconcilers over an in-process versioned resource store
+  with watch semantics — the role kube-apiserver plays for the reference
+  (reference: cmd/main.go, internal/controller/*).
+- **Compute plane** (``models``, ``ops``, ``parallel``, ``sdk``): engram
+  workers are JAX programs. Sharding rides a ``jax.sharding.Mesh``
+  (dp/fsdp/tp/sp axes), long context uses ring attention over the mesh,
+  hot ops are Pallas TPU kernels, and the orchestrator hands engrams their
+  mesh/coordinator topology through a versioned env contract (the
+  reference's BUBU_* contract, steprun_controller.go:1692, generalized
+  with TPU topology fields).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,45 @@
+"""bobrapet_tpu API layer: typed resource kinds, enums, conditions, errors.
+
+The equivalent of the reference's five API groups
+(reference: api/v1alpha1, api/runs/v1alpha1, api/catalog/v1alpha1,
+api/transport/v1alpha1, api/policy/v1alpha1).
+"""
+
+from .enums import (
+    AcceleratorType,
+    BackoffStrategy,
+    EffectClaimPhase,
+    ExitClass,
+    OffloadedDataPolicy,
+    Phase,
+    SecretMountType,
+    StepType,
+    StopMode,
+    StoryPattern,
+    TransportMode,
+    TriggerDecision,
+    UpdateStrategyType,
+    ValidationStatus,
+    WorkloadMode,
+)
+from .errors import ErrorType, StructuredError
+
+__all__ = [
+    "AcceleratorType",
+    "BackoffStrategy",
+    "EffectClaimPhase",
+    "ExitClass",
+    "OffloadedDataPolicy",
+    "Phase",
+    "SecretMountType",
+    "StepType",
+    "StopMode",
+    "StoryPattern",
+    "TransportMode",
+    "TriggerDecision",
+    "UpdateStrategyType",
+    "ValidationStatus",
+    "WorkloadMode",
+    "ErrorType",
+    "StructuredError",
+]

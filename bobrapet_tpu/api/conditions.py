@@ -1,0 +1,199 @@
+"""Canonical condition vocabulary + condition-list management.
+
+Capability parity with the reference's condition machinery
+(reference: pkg/conditions/conditions.go:26-123): stable condition types,
+stable reason codes, and last-transition-time-preserving set semantics
+modeled on Kubernetes ``meta.SetStatusCondition``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Iterable, Optional
+
+
+# ---------------------------------------------------------------------------
+# Condition types (reference: pkg/conditions/conditions.go:26-51)
+# ---------------------------------------------------------------------------
+
+READY = "Ready"
+PROGRESSING = "Progressing"
+DEGRADED = "Degraded"
+TERMINATING = "Terminating"
+VALIDATED = "Validated"
+TEMPLATE_RESOLVED = "TemplateResolved"
+LARGE_DATA_DELEGATED = "LargeDataDelegated"
+COMPILED = "Compiled"
+SCHEDULED = "Scheduled"
+RESOLVED_INPUTS = "ResolvedInputs"
+STEPS_COMPLETED = "StepsCompleted"
+LISTENING = "Listening"
+STORY_RESOLVED = "StoryResolved"
+TRANSPORT_READY = "TransportReady"
+#: TPU addition: the slice-placement stage granted this run an
+#: ICI-contiguous sub-mesh (no reference counterpart).
+SLICE_PLACED = "SlicePlaced"
+
+
+class Reason:
+    """Stable reason codes (reference: pkg/conditions/conditions.go:57-123)."""
+
+    # success
+    VALIDATION_PASSED = "ValidationPassed"
+    TEMPLATE_RESOLVED = "TemplateResolved"
+    STORY_RESOLVED = "StoryResolved"
+    COMPILED = "Compiled"
+    SCHEDULED = "Scheduled"
+    LISTENING = "Listening"
+    COMPLETED = "Completed"
+    LARGE_DATA_DELEGATED = "LargeDataDelegated"
+
+    # errors
+    VALIDATION_FAILED = "ValidationFailed"
+    TEMPLATE_NOT_FOUND = "TemplateNotFound"
+    TEMPLATE_RESOLUTION_FAILED = "TemplateResolutionFailed"
+    OUTPUT_RESOLUTION_FAILED = "OutputResolutionFailed"
+    STORY_NOT_FOUND = "StoryNotFound"
+    STORY_REFERENCE_INVALID = "StoryReferenceInvalid"
+    ENGRAM_REFERENCE_INVALID = "EngramReferenceInvalid"
+    TRANSPORT_REFERENCE_INVALID = "TransportReferenceInvalid"
+    COMPILATION_FAILED = "CompilationFailed"
+    SCHEDULING_FAILED = "SchedulingFailed"
+    EXECUTION_FAILED = "ExecutionFailed"
+    REFERENCE_NOT_FOUND = "ReferenceNotFound"
+    INVALID_CONFIGURATION = "InvalidConfiguration"
+    DEPLOYMENT_READY = "DeploymentReady"
+
+    # progress
+    VALIDATING = "Validating"
+    RESOLVING_TEMPLATE = "ResolvingTemplate"
+    RESOLVING_STORY = "ResolvingStory"
+    COMPILING = "Compiling"
+    STARTING_EXECUTION = "StartingExecution"
+    PROCESSING_STEPS = "ProcessingSteps"
+
+    # terminating
+    DELETION_REQUESTED = "DeletionRequested"
+    CLEANING_UP = "CleaningUp"
+    INPUT_TOO_LARGE = "InputTooLarge"
+    OUTPUT_TOO_LARGE = "OutputTooLarge"
+    CANCELED = "Canceled"
+
+    # transport
+    TRANSPORT_READY = "TransportReady"
+    TRANSPORT_FAILED = "TransportFailed"
+    RECONCILING = "Reconciling"
+    AWAITING_TRANSPORT = "AwaitingTransport"
+    AWAITING_STORY_RUN = "AwaitingStoryRun"
+
+    # run lifecycle
+    PENDING = "Pending"
+    RUNNING = "Running"
+    PAUSED = "Paused"
+    BLOCKED = "Blocked"
+    TIMED_OUT = "TimedOut"
+    SKIPPED = "Skipped"
+    COMPENSATED = "Compensated"
+    COMPENSATION_FAILED = "CompensationFailed"
+    CLEANUP_FAILED = "CleanupFailed"
+    RETRY_SCHEDULED = "RetryScheduled"
+    INPUT_SCHEMA_FAILED = "InputSchemaFailed"
+    OUTPUT_SCHEMA_FAILED = "OutputSchemaFailed"
+    EXPRESSION_FAILED = "ExpressionFailed"
+    DEPENDENCY_FAILED = "DependencyFailed"
+    TOPOLOGY_TERMINATED = "TopologyTerminated"
+
+    # transport validation
+    DRIVER_MISSING = "DriverMissing"
+    CAPABILITIES_MISSING = "CapabilitiesMissing"
+    CODEC_INVALID = "CodecInvalid"
+    CODEC_DUPLICATE = "CodecDuplicate"
+    MIME_TYPE_INVALID = "MimeTypeInvalid"
+
+    # TPU additions
+    SLICE_PLACED = "SlicePlaced"
+    SLICE_UNAVAILABLE = "SliceUnavailable"
+    GANG_INCOMPLETE = "GangIncomplete"
+
+
+@dataclasses.dataclass
+class Condition:
+    """One observed condition, mirroring metav1.Condition semantics."""
+
+    type: str
+    status: bool
+    reason: str
+    message: str = ""
+    last_transition_time: float = 0.0
+    observed_generation: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "type": self.type,
+            "status": "True" if self.status else "False",
+            "reason": self.reason,
+            "message": self.message,
+            "lastTransitionTime": self.last_transition_time,
+            "observedGeneration": self.observed_generation,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Condition":
+        return cls(
+            type=d["type"],
+            status=d.get("status") in (True, "True", "true"),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+            last_transition_time=float(d.get("lastTransitionTime", 0.0)),
+            observed_generation=int(d.get("observedGeneration", 0)),
+        )
+
+
+def set_condition(
+    conditions: list[dict[str, Any]],
+    type: str,
+    status: bool,
+    reason: str,
+    message: str = "",
+    observed_generation: int = 0,
+    now: Optional[float] = None,
+) -> bool:
+    """Upsert a condition, preserving lastTransitionTime if status unchanged.
+
+    Returns True if the list changed (used for patch-if-changed semantics,
+    reference: pkg/reconcile/status.go:17).
+    """
+    now = time.time() if now is None else now
+    new = Condition(type, status, reason, message, now, observed_generation)
+    for i, raw in enumerate(conditions):
+        if raw.get("type") != type:
+            continue
+        old = Condition.from_dict(raw)
+        if old.status == new.status:
+            new.last_transition_time = old.last_transition_time
+        changed = (
+            old.status != new.status
+            or old.reason != new.reason
+            or old.message != new.message
+            or old.observed_generation != new.observed_generation
+        )
+        if changed:
+            conditions[i] = new.to_dict()
+        return changed
+    conditions.append(new.to_dict())
+    return True
+
+
+def get_condition(
+    conditions: Iterable[dict[str, Any]], type: str
+) -> Optional[Condition]:
+    for raw in conditions:
+        if raw.get("type") == type:
+            return Condition.from_dict(raw)
+    return None
+
+
+def is_condition_true(conditions: Iterable[dict[str, Any]], type: str) -> bool:
+    c = get_condition(conditions, type)
+    return bool(c and c.status)

@@ -1,0 +1,227 @@
+"""Typed string enumerations shared by every bobrapet_tpu API kind.
+
+Capability parity with the reference's enum vocabulary
+(reference: pkg/enums/enums.go:24-337) plus TPU-native additions
+(AcceleratorType, slice placement states).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class StrEnum(str, enum.Enum):
+    """String-valued enum that serializes as its value."""
+
+    def __str__(self) -> str:  # pragma: no cover - trivial
+        return str(self.value)
+
+
+class Phase(StrEnum):
+    """Execution phase of a resource (reference: pkg/enums/enums.go:24-115).
+
+    Progression: Pending -> Running -> terminal
+    (Succeeded|Failed|Finished|Canceled|Compensated|Timeout|Aborted|Skipped).
+    Paused/Blocked/Scheduling are recoverable intermediate states.
+    """
+
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    FINISHED = "Finished"
+    CANCELED = "Canceled"
+    COMPENSATED = "Compensated"
+    PAUSED = "Paused"
+    BLOCKED = "Blocked"
+    SCHEDULING = "Scheduling"
+    TIMEOUT = "Timeout"
+    ABORTED = "Aborted"
+    SKIPPED = "Skipped"
+
+    @property
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL_PHASES
+
+    @property
+    def is_failure(self) -> bool:
+        return self in (Phase.FAILED, Phase.TIMEOUT, Phase.ABORTED)
+
+
+_TERMINAL_PHASES = frozenset(
+    {
+        Phase.SUCCEEDED,
+        Phase.FAILED,
+        Phase.FINISHED,
+        Phase.CANCELED,
+        Phase.COMPENSATED,
+        Phase.TIMEOUT,
+        Phase.ABORTED,
+        Phase.SKIPPED,
+    }
+)
+
+
+class StopMode(StrEnum):
+    """Outcome requested by a `stop` primitive (reference: pkg/enums/enums.go:120-139)."""
+
+    SUCCESS = "success"
+    FAILURE = "failure"
+    CANCEL = "cancel"
+
+    @property
+    def terminal_phase(self) -> Phase:
+        return {
+            StopMode.SUCCESS: Phase.SUCCEEDED,
+            StopMode.FAILURE: Phase.FAILED,
+            StopMode.CANCEL: Phase.FINISHED,
+        }[self]
+
+
+class StepType(StrEnum):
+    """Built-in workflow primitives (reference: pkg/enums/enums.go:141-180)."""
+
+    CONDITION = "condition"
+    PARALLEL = "parallel"
+    SLEEP = "sleep"
+    STOP = "stop"
+    WAIT = "wait"
+    EXECUTE_STORY = "executeStory"
+    GATE = "gate"
+
+
+#: Primitives that only make sense in batch stories (wait/gate block on
+#: polling/approval; rejected for realtime stories by admission,
+#: reference: internal/webhook/v1alpha1/story_webhook.go).
+BATCH_ONLY_PRIMITIVES = frozenset({StepType.WAIT, StepType.GATE})
+
+
+class TransportMode(StrEnum):
+    """How a transport is used in a Story (reference: pkg/enums/enums.go:182-190)."""
+
+    HOT = "hot"
+    FALLBACK = "fallback"
+
+
+class WorkloadMode(StrEnum):
+    """Execution pattern for a workload (reference: pkg/enums/enums.go:192-209).
+
+    In bobrapet_tpu: ``job`` is a run-to-completion gang of host processes
+    (one per TPU host in the granted slice); ``deployment``/``statefulset``
+    are long-running streaming services.
+    """
+
+    JOB = "job"
+    DEPLOYMENT = "deployment"
+    STATEFULSET = "statefulset"
+
+    @property
+    def is_realtime(self) -> bool:
+        return self in (WorkloadMode.DEPLOYMENT, WorkloadMode.STATEFULSET)
+
+
+class BackoffStrategy(StrEnum):
+    """Retry delay growth (reference: pkg/enums/enums.go:211-232)."""
+
+    EXPONENTIAL = "exponential"
+    LINEAR = "linear"
+    CONSTANT = "constant"
+
+
+class UpdateStrategyType(StrEnum):
+    """Rollout behavior for realtime workloads (reference: pkg/enums/enums.go:234-251)."""
+
+    ROLLING_UPDATE = "RollingUpdate"
+    RECREATE = "Recreate"
+
+
+class ValidationStatus(StrEnum):
+    """Template validation state (reference: pkg/enums/enums.go:253-276)."""
+
+    VALID = "valid"
+    INVALID = "invalid"
+    UNKNOWN = "unknown"
+    PENDING = "pending"
+
+
+class ExitClass(StrEnum):
+    """Interpretation of a worker exit code (reference: pkg/enums/enums.go:278-307).
+
+    ``UNKNOWN`` (worker vanished / infrastructure failure) is retryable but
+    does NOT consume the retry budget.
+    """
+
+    SUCCESS = "success"
+    RETRY = "retry"
+    TERMINAL = "terminal"
+    RATE_LIMITED = "rateLimited"
+    UNKNOWN = "unknown"
+
+    @property
+    def is_retryable(self) -> bool:
+        return self in (ExitClass.RETRY, ExitClass.RATE_LIMITED, ExitClass.UNKNOWN)
+
+    @property
+    def consumes_retry_budget(self) -> bool:
+        return self is not ExitClass.UNKNOWN
+
+
+class SecretMountType(StrEnum):
+    """How secrets reach the workload (reference: pkg/enums/enums.go:309-320)."""
+
+    ENV = "env"
+    FILE = "file"
+    BOTH = "both"
+
+
+class StoryPattern(StrEnum):
+    """Story execution pattern (reference: pkg/enums/enums.go:322-337)."""
+
+    BATCH = "batch"
+    REALTIME = "realtime"
+
+    @property
+    def is_realtime(self) -> bool:
+        return self is StoryPattern.REALTIME
+
+
+class TriggerDecision(StrEnum):
+    """Durable trigger-admission outcome
+    (reference: api/runs/v1alpha1/storytrigger_types.go:51)."""
+
+    PENDING = "Pending"
+    CREATED = "Created"
+    REUSED = "Reused"
+    REJECTED = "Rejected"
+
+
+class EffectClaimPhase(StrEnum):
+    """Side-effect lease lifecycle (reference: api/runs/v1alpha1/effectclaim_types.go:35)."""
+
+    RESERVED = "Reserved"
+    COMPLETED = "Completed"
+    RELEASED = "Released"
+    ABANDONED = "Abandoned"
+
+
+class OffloadedDataPolicy(StrEnum):
+    """What to do when a template references offloaded step output
+    (reference: internal/controller/runs/templating_policy.go:12-43)."""
+
+    FAIL = "fail"
+    INJECT = "inject"
+    CONTROLLER = "controller"
+
+
+class AcceleratorType(StrEnum):
+    """TPU accelerator families this scheduler knows how to place.
+
+    TPU-native addition (no reference counterpart): names follow GKE's
+    ``cloud.google.com/gke-tpu-accelerator`` values.
+    """
+
+    CPU = "cpu"
+    TPU_V4 = "tpu-v4-podslice"
+    TPU_V5E = "tpu-v5-lite-podslice"
+    TPU_V5P = "tpu-v5p-slice"
+    TPU_V6E = "tpu-v6e-slice"

@@ -1,0 +1,113 @@
+"""Versioned machine-readable error contract stored in run status.
+
+Capability parity with the reference StructuredError v1
+(reference: api/runs/v1alpha1/structured_error_types.go:53): a stable,
+SDK<->controller shared payload describing why a step failed, with an
+error family, the classified exit class, and retryability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from .enums import ExitClass
+
+STRUCTURED_ERROR_VERSION = "v1"
+
+
+class ErrorType:
+    """Stable error families (reference: structured_error_types.go:20-47)."""
+
+    TIMEOUT = "timeout"
+    STORAGE = "storage"
+    SERIALIZATION = "serialization"
+    VALIDATION = "validation"
+    INITIALIZATION = "initialization"
+    EXECUTION = "execution"
+    UNKNOWN = "unknown"
+
+    ALL = frozenset(
+        v
+        for k, v in vars()
+        .items()  # derived, so new families can't drift out of sync
+        if not k.startswith("_") and isinstance(v, str)
+    )
+
+
+@dataclasses.dataclass
+class StructuredError:
+    """Machine-readable failure payload, persisted to StepRun/StoryRun status."""
+
+    type: str = ErrorType.UNKNOWN
+    message: str = ""
+    exit_class: Optional[ExitClass] = None
+    retryable: bool = False
+    details: dict[str, Any] = dataclasses.field(default_factory=dict)
+    version: str = STRUCTURED_ERROR_VERSION
+
+    def __post_init__(self) -> None:
+        if self.type not in ErrorType.ALL:
+            self.type = ErrorType.UNKNOWN
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "version": self.version,
+            "type": self.type,
+            "message": self.message,
+            "retryable": self.retryable,
+        }
+        if self.exit_class is not None:
+            d["exitClass"] = str(self.exit_class)
+        if self.details:
+            d["details"] = self.details
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "StructuredError":
+        # Forward-compatible parse: a payload written by a newer SDK must
+        # never crash the reconciler, so unrecognized enum values degrade
+        # to UNKNOWN exactly like unrecognized `type` does.
+        raw_exit = d.get("exitClass")
+        try:
+            exit_class = ExitClass(raw_exit) if raw_exit else None
+        except ValueError:
+            exit_class = ExitClass.UNKNOWN
+        return cls(
+            type=d.get("type", ErrorType.UNKNOWN),
+            message=d.get("message", ""),
+            exit_class=exit_class,
+            retryable=bool(d.get("retryable", False)),
+            details=dict(d.get("details") or {}),
+            version=d.get("version", STRUCTURED_ERROR_VERSION),
+        )
+
+    @classmethod
+    def from_exception(
+        cls, exc: BaseException, type: str = ErrorType.EXECUTION, retryable: bool = False
+    ) -> "StructuredError":
+        return cls(
+            type=type,
+            message=f"{exc.__class__.__name__}: {exc}",
+            retryable=retryable,
+        )
+
+
+def timeout_error(message: str, details: Optional[dict[str, Any]] = None) -> StructuredError:
+    return StructuredError(
+        type=ErrorType.TIMEOUT,
+        message=message,
+        exit_class=ExitClass.RETRY,
+        retryable=True,
+        details=details or {},
+    )
+
+
+def validation_error(message: str, details: Optional[dict[str, Any]] = None) -> StructuredError:
+    return StructuredError(
+        type=ErrorType.VALIDATION,
+        message=message,
+        exit_class=ExitClass.TERMINAL,
+        retryable=False,
+        details=details or {},
+    )

@@ -1,0 +1,30 @@
+"""Shared utilities: naming, durations, hashing."""
+
+from .duration import DurationError, format_duration, parse_duration
+from .hashing import cache_key, canonical_json, hash_inputs, sha256_hex
+from .naming import (
+    branch_steprun_name,
+    compose,
+    compose_unique,
+    sanitize,
+    short_hash,
+    steprun_name,
+    truncate_with_hash,
+)
+
+__all__ = [
+    "DurationError",
+    "format_duration",
+    "parse_duration",
+    "cache_key",
+    "canonical_json",
+    "hash_inputs",
+    "sha256_hex",
+    "branch_steprun_name",
+    "compose",
+    "compose_unique",
+    "sanitize",
+    "short_hash",
+    "steprun_name",
+    "truncate_with_hash",
+]

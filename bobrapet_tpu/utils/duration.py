@@ -1,0 +1,73 @@
+"""Duration string parsing ("300ms", "2s", "5m", "1h30m") -> seconds.
+
+Capability parity with the reference's duration handling
+(reference: pkg/kubeutil/duration parsing; CRD fields like
+RetryPolicy.delay use Go-style duration strings).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Union
+
+_UNIT_SECONDS = {
+    "ns": 1e-9,
+    "us": 1e-6,
+    "µs": 1e-6,
+    "ms": 1e-3,
+    "s": 1.0,
+    "m": 60.0,
+    "h": 3600.0,
+    "d": 86400.0,
+}
+
+_TOKEN = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h|d)")
+_BARE_NUMBER = re.compile(r"\d+(\.\d+)?")
+
+
+class DurationError(ValueError):
+    pass
+
+
+def parse_duration(value: Union[str, int, float, None], default: Optional[float] = None) -> Optional[float]:
+    """Parse a Go-style duration string to float seconds.
+
+    Accepts numbers (treated as seconds) for convenience. Returns
+    ``default`` for None/empty input. Raises DurationError on garbage.
+    """
+    if value is None or value == "":
+        return default
+    if isinstance(value, (int, float)):
+        f = float(value)
+        if f < 0 or f != f or f == float("inf"):
+            raise DurationError(f"invalid duration {value!r}")
+        return f
+    s = value.strip()
+    if not s:
+        return default
+    pos, total = 0, 0.0
+    for m in _TOKEN.finditer(s):
+        if m.start() != pos:
+            raise DurationError(f"invalid duration {value!r}")
+        total += float(m.group(1)) * _UNIT_SECONDS[m.group(2)]
+        pos = m.end()
+    if pos != len(s):
+        # allow a bare non-negative number string ("30" == 30s); reject
+        # nan/inf/sign/underscore forms that float() would accept
+        if _BARE_NUMBER.fullmatch(s):
+            return float(s)
+        raise DurationError(f"invalid duration {value!r}")
+    return total
+
+
+def format_duration(seconds: float) -> str:
+    """Render seconds as a compact duration string."""
+    if seconds < 1:
+        return f"{int(round(seconds * 1000))}ms"
+    if seconds < 60:
+        return f"{seconds:g}s"
+    m, s = divmod(seconds, 60)
+    if m < 60:
+        return f"{int(m)}m{int(s)}s" if s else f"{int(m)}m"
+    h, m = divmod(m, 60)
+    return f"{int(h)}h{int(m)}m" if m else f"{int(h)}h"

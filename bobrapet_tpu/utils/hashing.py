@@ -1,0 +1,61 @@
+"""Canonical JSON hashing for idempotency keys and cache keys.
+
+Capability parity with the reference's trigger input hashing
+(reference: pkg/runs/identity/storyrun_trigger.go:69 — sha256 over
+canonical JSON) and the step output-cache key derivation
+(reference: internal/controller/runs/steprun_controller.go:3115-3477).
+
+Stability is the contract: the same logical value must hash identically
+across processes and restarts (trigger dedupe and cache hits depend on
+it), so serialization is strict — no ``default=str`` escape hatch whose
+output can depend on hash seeds or type repr.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import json
+from typing import Any
+
+
+def _canonical_default(value: Any) -> Any:
+    # Deterministic encodings for the few non-JSON types we accept.
+    if isinstance(value, (set, frozenset)):
+        return sorted(value, key=lambda v: json.dumps(v, sort_keys=True, default=_canonical_default))
+    if isinstance(value, (datetime.datetime, datetime.date)):
+        return value.isoformat()
+    if isinstance(value, bytes):
+        return value.hex()
+    raise TypeError(f"value of type {type(value).__name__} is not canonically serializable")
+
+
+def canonical_json(value: Any) -> str:
+    """Serialize with sorted keys + minimal separators: stable across runs.
+
+    Raises TypeError for types without a deterministic encoding rather
+    than silently producing an unstable hash.
+    """
+    return json.dumps(
+        value, sort_keys=True, separators=(",", ":"), default=_canonical_default
+    )
+
+
+def sha256_hex(data: str) -> str:
+    return hashlib.sha256(data.encode()).hexdigest()
+
+
+def hash_inputs(value: Any) -> str:
+    """sha256 of canonical JSON — the dedupe identity for trigger inputs."""
+    return sha256_hex(canonical_json(value))
+
+
+def cache_key(resolved_inputs: Any, salt: str = "", mode: str = "inputs") -> str:
+    """Step output-cache key: hashed resolved inputs + salt + mode.
+
+    The components are framed as a JSON object (not ':'-joined) so
+    distinct (mode, salt) pairs can never collapse onto one key.
+    """
+    return sha256_hex(
+        canonical_json({"mode": mode, "salt": salt, "inputs": resolved_inputs})
+    )

@@ -1,0 +1,70 @@
+"""Deterministic name composition with hash-suffix truncation.
+
+Capability parity with the reference's naming helpers
+(reference: pkg/kubeutil/naming.go; pkg/runs/identity/*): child-resource
+names must be deterministic (create-or-adopt idempotency depends on it)
+and bounded in length (DNS-1123 style, 63 chars), with a stable hash
+suffix when truncated so distinct long names never collide.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+
+MAX_NAME_LEN = 63
+_HASH_LEN = 8
+_INVALID = re.compile(r"[^a-z0-9-]+")
+
+
+def sanitize(name: str) -> str:
+    """Lowercase and strip characters outside [a-z0-9-]."""
+    s = _INVALID.sub("-", name.lower()).strip("-")
+    return s or "x"
+
+
+def short_hash(s: str, n: int = _HASH_LEN) -> str:
+    return hashlib.sha256(s.encode()).hexdigest()[:n]
+
+
+def truncate_with_hash(name: str, max_len: int = MAX_NAME_LEN) -> str:
+    """Truncate to max_len, replacing the tail with a stable hash suffix."""
+    if len(name) <= max_len:
+        return name
+    keep = max_len - _HASH_LEN - 1
+    if keep <= 0:
+        return short_hash(name, n=max(1, max_len))
+    return f"{name[:keep]}-{short_hash(name)}"
+
+
+def compose(*parts: str, max_len: int = MAX_NAME_LEN) -> str:
+    """Join sanitized parts with '-' and truncate with a hash if needed.
+
+    Readable but NOT collision-free across part boundaries ('a-b','c' vs
+    'a','b-c'); identity-bearing names must use :func:`compose_unique`.
+    """
+    return truncate_with_hash("-".join(sanitize(p) for p in parts if p), max_len)
+
+
+def compose_unique(*parts: str, max_len: int = MAX_NAME_LEN) -> str:
+    """Readable name + hash of the structured identity.
+
+    The hash covers the raw parts joined with an unambiguous delimiter, so
+    distinct part tuples never collide even when sanitization or '-'
+    joining would make them ambiguous. This carries the role of the
+    reference's structured idempotency key ("ns/<run>/step/<step>",
+    pkg/runs/identity/steprun_idempotency.go:14-20) folded into the name.
+    """
+    identity = short_hash("\x00".join(parts), n=6)
+    base = "-".join(sanitize(p) for p in parts if p)
+    return truncate_with_hash(f"{base}-{identity}", max_len)
+
+
+def steprun_name(storyrun_name: str, step_name: str) -> str:
+    """Deterministic, collision-free StepRun name for (StoryRun, step)."""
+    return compose_unique(storyrun_name, step_name)
+
+
+def branch_steprun_name(storyrun_name: str, parent_step: str, branch_step: str) -> str:
+    """Deterministic name for one branch child of a `parallel` step."""
+    return compose_unique(storyrun_name, parent_step, branch_step)

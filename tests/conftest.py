@@ -1,0 +1,25 @@
+"""Test harness configuration.
+
+Compute-plane tests run on a virtual 8-device CPU mesh so multi-chip
+sharding (dp/fsdp/tp/sp, ring attention collectives) is exercised without
+TPU hardware — the moral equivalent of the reference's envtest strategy
+(real control plane, simulated kubelet; reference:
+internal/controller/runs/suite_test.go:32-54).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_store_dir(tmp_path):
+    return str(tmp_path / "store")

@@ -1,0 +1,65 @@
+"""Phase machine + enum vocabulary tests (mirrors reference pkg/enums semantics)."""
+
+from bobrapet_tpu.api.enums import (
+    BATCH_ONLY_PRIMITIVES,
+    ExitClass,
+    Phase,
+    StepType,
+    StopMode,
+    StoryPattern,
+    WorkloadMode,
+)
+
+
+def test_terminal_phases():
+    terminal = {
+        Phase.SUCCEEDED,
+        Phase.FAILED,
+        Phase.FINISHED,
+        Phase.CANCELED,
+        Phase.COMPENSATED,
+        Phase.TIMEOUT,
+        Phase.ABORTED,
+        Phase.SKIPPED,
+    }
+    for p in Phase:
+        assert p.is_terminal == (p in terminal), p
+
+
+def test_nonterminal_phases_recoverable():
+    for p in (Phase.PENDING, Phase.RUNNING, Phase.PAUSED, Phase.BLOCKED, Phase.SCHEDULING):
+        assert not p.is_terminal
+
+
+def test_stop_mode_terminal_phase():
+    assert StopMode.SUCCESS.terminal_phase is Phase.SUCCEEDED
+    assert StopMode.FAILURE.terminal_phase is Phase.FAILED
+    assert StopMode.CANCEL.terminal_phase is Phase.FINISHED
+
+
+def test_exit_class_retry_budget():
+    # Unknown exit retries without consuming the budget
+    assert ExitClass.UNKNOWN.is_retryable
+    assert not ExitClass.UNKNOWN.consumes_retry_budget
+    assert ExitClass.RETRY.is_retryable and ExitClass.RETRY.consumes_retry_budget
+    assert ExitClass.RATE_LIMITED.is_retryable
+    assert not ExitClass.TERMINAL.is_retryable
+    assert not ExitClass.SUCCESS.is_retryable
+
+
+def test_batch_only_primitives():
+    assert StepType.WAIT in BATCH_ONLY_PRIMITIVES
+    assert StepType.GATE in BATCH_ONLY_PRIMITIVES
+    assert StepType.PARALLEL not in BATCH_ONLY_PRIMITIVES
+
+
+def test_workload_realtime():
+    assert not WorkloadMode.JOB.is_realtime
+    assert WorkloadMode.DEPLOYMENT.is_realtime
+    assert WorkloadMode.STATEFULSET.is_realtime
+    assert StoryPattern.REALTIME.is_realtime
+
+
+def test_enums_serialize_as_strings():
+    assert str(Phase.RUNNING) == "Running"
+    assert Phase("Running") is Phase.RUNNING

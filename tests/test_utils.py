@@ -1,0 +1,97 @@
+"""Naming / duration / hashing utility tests."""
+
+import pytest
+
+from bobrapet_tpu.utils import (
+    DurationError,
+    cache_key,
+    canonical_json,
+    compose,
+    format_duration,
+    hash_inputs,
+    parse_duration,
+    steprun_name,
+    truncate_with_hash,
+)
+
+
+class TestNaming:
+    def test_compose_deterministic(self):
+        assert compose("Run-1", "step_a") == compose("Run-1", "step_a")
+        assert compose("run-1", "a") == "run-1-a"
+
+    def test_truncation_stable_and_distinct(self):
+        long_a = "a" * 100
+        long_b = "a" * 99 + "b"
+        ta, tb = truncate_with_hash(long_a), truncate_with_hash(long_b)
+        assert len(ta) <= 63 and len(tb) <= 63
+        assert ta != tb
+        assert ta == truncate_with_hash(long_a)
+
+    def test_steprun_name_idempotent(self):
+        assert steprun_name("run-x", "embed") == steprun_name("run-x", "embed")
+        assert steprun_name("run-x", "embed").startswith("run-x-embed-")
+
+    def test_steprun_name_no_boundary_collision(self):
+        # 'run-a'+'b-c' vs 'run-a-b'+'c' join to the same readable base;
+        # the structured-identity hash keeps them distinct
+        assert steprun_name("run-a", "b-c") != steprun_name("run-a-b", "c")
+        assert steprun_name("run-a", "step_a") != steprun_name("run-a", "step-a")
+
+
+class TestDuration:
+    @pytest.mark.parametrize(
+        "s,expected",
+        [
+            ("300ms", 0.3),
+            ("2s", 2.0),
+            ("5m", 300.0),
+            ("1h30m", 5400.0),
+            ("1.5s", 1.5),
+            ("30", 30.0),
+            (45, 45.0),
+            (None, None),
+            ("", None),
+        ],
+    )
+    def test_parse(self, s, expected):
+        assert parse_duration(s) == expected
+
+    def test_parse_default(self):
+        assert parse_duration(None, default=7.0) == 7.0
+
+    @pytest.mark.parametrize("bad", ["soon", "nan", "inf", "-5", "1_0", -3, float("nan")])
+    def test_parse_garbage(self, bad):
+        with pytest.raises(DurationError):
+            parse_duration(bad)
+
+    def test_format_roundtrip(self):
+        assert parse_duration(format_duration(90)) == 90
+
+
+class TestHashing:
+    def test_canonical_json_key_order(self):
+        assert canonical_json({"b": 1, "a": 2}) == canonical_json({"a": 2, "b": 1})
+
+    def test_hash_inputs_stable(self):
+        assert hash_inputs({"x": [1, 2]}) == hash_inputs({"x": [1, 2]})
+        assert hash_inputs({"x": 1}) != hash_inputs({"x": 2})
+
+    def test_cache_key_salt_and_mode(self):
+        base = cache_key({"a": 1})
+        assert cache_key({"a": 1}, salt="s") != base
+        assert cache_key({"a": 1}, mode="template") != base
+
+    def test_cache_key_no_delimiter_collision(self):
+        assert cache_key({"a": 1}, salt="b:c", mode="a") != cache_key(
+            {"a": 1}, salt="c", mode="a:b"
+        )
+
+    def test_sets_hash_deterministically(self):
+        assert hash_inputs({"tags": {"b", "a", "c"}}) == hash_inputs(
+            {"tags": {"c", "a", "b"}}
+        )
+
+    def test_unserializable_raises(self):
+        with pytest.raises(TypeError):
+            hash_inputs({"fn": object()})
