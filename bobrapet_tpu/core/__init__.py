@@ -1,0 +1,41 @@
+"""Control-plane substrate: resource store, object model, events.
+
+The in-process equivalent of kube-apiserver + etcd + the event API that
+the reference's controller-runtime manager talks to.
+"""
+
+from .events import NORMAL, WARNING, Event, EventRecorder
+from .object import ObjectMeta, OwnerReference, Resource, new_resource
+from .store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AdmissionDenied,
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ResourceStore,
+    StoreError,
+    WatchEvent,
+)
+
+__all__ = [
+    "NORMAL",
+    "WARNING",
+    "Event",
+    "EventRecorder",
+    "ObjectMeta",
+    "OwnerReference",
+    "Resource",
+    "new_resource",
+    "ADDED",
+    "DELETED",
+    "MODIFIED",
+    "AdmissionDenied",
+    "AlreadyExists",
+    "Conflict",
+    "NotFound",
+    "ResourceStore",
+    "StoreError",
+    "WatchEvent",
+]

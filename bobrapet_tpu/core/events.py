@@ -1,0 +1,97 @@
+"""Deduplicated event recorder.
+
+Capability parity with the reference's labeled, deduped Kubernetes events
+(reference: storyrun_controller.go:808, steprun_controller.go:4547 and
+SURVEY §5.5 "Events"): controllers record human-facing occurrences about
+a resource; repeated identical events within a window collapse into a
+count instead of flooding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+
+@dataclasses.dataclass
+class Event:
+    kind: str
+    namespace: str
+    name: str
+    type: str
+    reason: str
+    message: str
+    count: int = 1
+    first_seen: float = 0.0
+    last_seen: float = 0.0
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+class EventRecorder:
+    """Ring-buffered recorder with (object, reason, message) dedup."""
+
+    def __init__(self, capacity: int = 4096, dedup_window: float = 300.0):
+        self._lock = threading.Lock()
+        self._events: deque[Event] = deque(maxlen=capacity)
+        self._dedup_window = dedup_window
+
+    def event(
+        self,
+        obj,
+        type: str,
+        reason: str,
+        message: str,
+        labels: Optional[dict[str, str]] = None,
+    ) -> None:
+        now = time.time()
+        with self._lock:
+            for ev in reversed(self._events):
+                if (
+                    ev.kind == obj.kind
+                    and ev.namespace == obj.namespace
+                    and ev.name == obj.name
+                    and ev.type == type
+                    and ev.reason == reason
+                    and ev.message == message
+                    and now - ev.last_seen < self._dedup_window
+                ):
+                    ev.count += 1
+                    ev.last_seen = now
+                    return
+            self._events.append(
+                Event(
+                    kind=obj.kind,
+                    namespace=obj.namespace,
+                    name=obj.name,
+                    type=type,
+                    reason=reason,
+                    message=message,
+                    first_seen=now,
+                    last_seen=now,
+                    labels=dict(labels or {}),
+                )
+            )
+
+    def normal(self, obj, reason: str, message: str, **kw) -> None:
+        self.event(obj, NORMAL, reason, message, **kw)
+
+    def warning(self, obj, reason: str, message: str, **kw) -> None:
+        self.event(obj, WARNING, reason, message, **kw)
+
+    def for_object(self, kind: str, namespace: str, name: str) -> list[Event]:
+        with self._lock:
+            return [
+                ev
+                for ev in self._events
+                if ev.kind == kind and ev.namespace == namespace and ev.name == name
+            ]
+
+    def all(self) -> list[Event]:
+        with self._lock:
+            return list(self._events)

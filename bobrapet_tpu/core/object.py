@@ -1,0 +1,176 @@
+"""Resource object model: metadata + spec + status.
+
+The universal shape every bobrapet_tpu kind shares, mirroring the
+Kubernetes object model the reference builds on (metadata with
+uid/resourceVersion/generation/labels/annotations/finalizers/
+ownerReferences; spec vs status subresource split). Specs and statuses
+are plain dicts — typed wrappers in ``bobrapet_tpu.api`` interpret them —
+so the store stays schema-agnostic the way an API server is.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import time
+import uuid
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class OwnerReference:
+    """Links a child to its owning resource for cascade deletion.
+
+    (Reference relies on controller-runtime owner refs + k8s GC for child
+    cleanup, e.g. StepRuns owned by StoryRuns.)
+    """
+
+    kind: str
+    name: str
+    uid: str
+    controller: bool = True
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "OwnerReference":
+        return cls(
+            kind=d["kind"],
+            name=d["name"],
+            uid=d["uid"],
+            controller=bool(d.get("controller", True)),
+        )
+
+
+@dataclasses.dataclass
+class ObjectMeta:
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    resource_version: int = 0
+    generation: int = 0
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: dict[str, str] = dataclasses.field(default_factory=dict)
+    finalizers: list[str] = dataclasses.field(default_factory=list)
+    owner_references: list[OwnerReference] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "namespace": self.namespace,
+            "uid": self.uid,
+            "resourceVersion": self.resource_version,
+            "generation": self.generation,
+            "creationTimestamp": self.creation_timestamp,
+            "deletionTimestamp": self.deletion_timestamp,
+            "labels": dict(self.labels),
+            "annotations": dict(self.annotations),
+            "finalizers": list(self.finalizers),
+            "ownerReferences": [o.to_dict() for o in self.owner_references],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ObjectMeta":
+        return cls(
+            name=d["name"],
+            namespace=d.get("namespace", "default"),
+            uid=d.get("uid", ""),
+            resource_version=int(d.get("resourceVersion", 0)),
+            generation=int(d.get("generation", 0)),
+            creation_timestamp=float(d.get("creationTimestamp", 0.0)),
+            deletion_timestamp=d.get("deletionTimestamp"),
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+            finalizers=list(d.get("finalizers") or []),
+            owner_references=[
+                OwnerReference.from_dict(o) for o in d.get("ownerReferences") or []
+            ],
+        )
+
+
+@dataclasses.dataclass
+class Resource:
+    """One stored object: kind + metadata + spec + status."""
+
+    kind: str
+    meta: ObjectMeta
+    spec: dict[str, Any] = dataclasses.field(default_factory=dict)
+    status: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    # -- convenience -------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    @property
+    def namespace(self) -> str:
+        return self.meta.namespace
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.kind, self.meta.namespace, self.meta.name)
+
+    @property
+    def phase(self) -> Optional[str]:
+        return self.status.get("phase")
+
+    def owner_ref(self, controller: bool = True) -> OwnerReference:
+        return OwnerReference(
+            kind=self.kind, name=self.meta.name, uid=self.meta.uid, controller=controller
+        )
+
+    def has_owner(self, owner: "Resource") -> bool:
+        return any(o.uid == owner.meta.uid for o in self.meta.owner_references)
+
+    def deepcopy(self) -> "Resource":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "metadata": self.meta.to_dict(),
+            "spec": copy.deepcopy(self.spec),
+            "status": copy.deepcopy(self.status),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Resource":
+        return cls(
+            kind=d["kind"],
+            meta=ObjectMeta.from_dict(d["metadata"]),
+            spec=copy.deepcopy(d.get("spec") or {}),
+            status=copy.deepcopy(d.get("status") or {}),
+        )
+
+
+def new_resource(
+    kind: str,
+    name: str,
+    namespace: str = "default",
+    spec: Optional[dict[str, Any]] = None,
+    labels: Optional[dict[str, str]] = None,
+    annotations: Optional[dict[str, str]] = None,
+    owners: Optional[list[OwnerReference]] = None,
+) -> Resource:
+    return Resource(
+        kind=kind,
+        meta=ObjectMeta(
+            name=name,
+            namespace=namespace,
+            labels=dict(labels or {}),
+            annotations=dict(annotations or {}),
+            owner_references=list(owners or []),
+        ),
+        spec=dict(spec or {}),
+    )
+
+
+def fresh_uid() -> str:
+    return str(uuid.uuid4())
+
+
+def now() -> float:
+    return time.time()

@@ -1,0 +1,413 @@
+"""The coordination bus: a versioned, watchable, indexed resource store.
+
+This plays the role kube-apiserver + etcd play for the reference: every
+cross-component interaction is a resource write observed through watches
+(reference SURVEY §5.8: "Kubernetes API as coordination bus"). Semantics
+intentionally mirrored:
+
+- **Optimistic concurrency**: updates must carry the resourceVersion they
+  read; a stale write raises :class:`Conflict` (the reference handles
+  these with retry-on-conflict, pkg/kubeutil/retry.go).
+- **Spec/status subresources**: ``update`` bumps ``generation`` only on
+  spec change; ``update_status`` can never touch spec — the same split
+  that makes SDK-vs-controller status races tractable
+  (reference: steprun_controller.go:2031).
+- **Watches**: every committed write emits ADDED/MODIFIED/DELETED events
+  to subscribers after the store lock is released.
+- **Field indexes**: named extraction functions per kind, the equivalent
+  of the reference's 15 field-index registrations
+  (internal/setup/indexing.go:71-163).
+- **Finalizers + cascade GC**: deletion with finalizers parks the object
+  with a deletionTimestamp; actual removal cascades to owned children
+  (the k8s garbage collector's role).
+- **Admission hooks**: defaulters and validators run inside create/update,
+  exactly where the reference's webhooks sit (SURVEY §2.3).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import os
+import threading
+import urllib.parse
+from collections import deque
+from typing import Any, Callable, Iterable, Optional
+
+from .object import ObjectMeta, Resource, fresh_uid, now
+
+_log = logging.getLogger(__name__)
+
+
+class StoreError(Exception):
+    pass
+
+
+class NotFound(StoreError):
+    def __init__(self, kind: str, namespace: str, name: str):
+        super().__init__(f"{kind} {namespace}/{name} not found")
+        self.kind, self.namespace, self.name = kind, namespace, name
+
+
+class AlreadyExists(StoreError):
+    def __init__(self, kind: str, namespace: str, name: str):
+        super().__init__(f"{kind} {namespace}/{name} already exists")
+        self.kind, self.namespace, self.name = kind, namespace, name
+
+
+class Conflict(StoreError):
+    def __init__(self, kind: str, namespace: str, name: str, expected: int, actual: int):
+        super().__init__(
+            f"{kind} {namespace}/{name}: stale resourceVersion {expected} (now {actual})"
+        )
+        self.kind, self.namespace, self.name = kind, namespace, name
+        self.expected, self.actual = expected, actual
+
+
+class AdmissionDenied(StoreError):
+    """A validator rejected the write (the webhook 'denied' response)."""
+
+
+# Watch event types
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+class WatchEvent:
+    __slots__ = ("type", "resource")
+
+    def __init__(self, type: str, resource: Resource):
+        self.type = type
+        self.resource = resource
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"WatchEvent({self.type}, {self.resource.kind} {self.resource.namespace}/{self.resource.name})"
+
+
+Defaulter = Callable[[Resource], None]
+Validator = Callable[[Resource, Optional[Resource]], None]  # (new, old) -> raise AdmissionDenied
+IndexFn = Callable[[Resource], list[str]]
+WatchHandler = Callable[[WatchEvent], None]
+
+
+class ResourceStore:
+    """Thread-safe in-process resource store with watch semantics."""
+
+    def __init__(self, persist_dir: Optional[str] = None):
+        self._lock = threading.RLock()
+        self._objects: dict[tuple[str, str, str], Resource] = {}
+        self._rv_counter = 0
+        self._watchers: list[tuple[Optional[frozenset[str]], WatchHandler]] = []
+        self._indexes: dict[tuple[str, str], IndexFn] = {}
+        self._defaulters: dict[str, list[Defaulter]] = {}
+        self._validators: dict[str, list[Validator]] = {}
+        self._pending_events: deque[WatchEvent] = deque()
+        self._draining = False
+        self._persist_dir = persist_dir
+        if persist_dir:
+            os.makedirs(persist_dir, exist_ok=True)
+            self._load()
+
+    # -- admission registration -------------------------------------------
+    def register_defaulter(self, kind: str, fn: Defaulter) -> None:
+        self._defaulters.setdefault(kind, []).append(fn)
+
+    def register_validator(self, kind: str, fn: Validator) -> None:
+        self._validators.setdefault(kind, []).append(fn)
+
+    # -- index registration ------------------------------------------------
+    def add_index(self, kind: str, index_name: str, fn: IndexFn) -> None:
+        """Idempotent index registration (reference: setup/indexing.go:60)."""
+        self._indexes.setdefault((kind, index_name), fn)
+
+    # -- watch -------------------------------------------------------------
+    def watch(self, handler: WatchHandler, kinds: Optional[Iterable[str]] = None) -> Callable[[], None]:
+        """Subscribe to committed writes; returns an unsubscribe callable."""
+        entry = (frozenset(kinds) if kinds is not None else None, handler)
+        with self._lock:
+            self._watchers.append(entry)
+
+        def cancel() -> None:
+            with self._lock:
+                if entry in self._watchers:
+                    self._watchers.remove(entry)
+
+        return cancel
+
+    def _emit(self, events: list[WatchEvent]) -> None:
+        """Deliver events outside the lock, in commit order, isolating
+        handler failures (the per-object ordering + panic isolation that
+        controller-runtime informers guarantee).
+
+        A single drainer at a time pulls from a store-wide FIFO: a writer
+        that commits while another thread is draining appends and returns,
+        so delivery order always matches commit order.
+        """
+        with self._lock:
+            self._pending_events.extend(events)
+            if self._draining:
+                return
+            self._draining = True
+        while True:
+            with self._lock:
+                if not self._pending_events:
+                    self._draining = False
+                    return
+                ev = self._pending_events.popleft()
+                watchers = list(self._watchers)
+            for kinds, handler in watchers:
+                if kinds is None or ev.resource.kind in kinds:
+                    try:
+                        handler(ev)
+                    except Exception:  # noqa: BLE001 - watcher bugs must not poison the bus
+                        _log.exception(
+                            "watch handler failed for %s %s/%s",
+                            ev.resource.kind,
+                            ev.resource.namespace,
+                            ev.resource.name,
+                        )
+
+    # -- reads -------------------------------------------------------------
+    def get(self, kind: str, namespace: str, name: str) -> Resource:
+        with self._lock:
+            obj = self._objects.get((kind, namespace, name))
+            if obj is None:
+                raise NotFound(kind, namespace, name)
+        # Committed resources are never mutated in place (writes replace
+        # whole objects), so copying outside the lock is safe and keeps
+        # copy cost off the global critical section.
+        return obj.deepcopy()
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Optional[Resource]:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFound:
+            return None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        labels: Optional[dict[str, str]] = None,
+        index: Optional[tuple[str, str]] = None,
+    ) -> list[Resource]:
+        """List by kind, optionally filtered by namespace/labels/index value."""
+        with self._lock:
+            picked = []
+            index_fn = self._indexes.get((kind, index[0])) if index else None
+            if index and index_fn is None:
+                raise StoreError(f"unknown index {index[0]!r} for kind {kind}")
+            for (k, ns, _), obj in self._objects.items():
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if labels and any(obj.meta.labels.get(lk) != lv for lk, lv in labels.items()):
+                    continue
+                if index_fn is not None and index[1] not in index_fn(obj):
+                    continue
+                picked.append(obj)
+        out = [obj.deepcopy() for obj in picked]
+        out.sort(key=lambda o: (o.meta.namespace, o.meta.name))
+        return out
+
+    # -- writes ------------------------------------------------------------
+    def create(self, obj: Resource) -> Resource:
+        stored: Resource
+        with self._lock:
+            key = obj.key
+            if key in self._objects:
+                raise AlreadyExists(*key)
+            new = obj.deepcopy()
+            for fn in self._defaulters.get(new.kind, []):
+                fn(new)
+            for fn in self._validators.get(new.kind, []):
+                fn(new, None)
+            self._rv_counter += 1
+            new.meta.uid = new.meta.uid or fresh_uid()
+            new.meta.resource_version = self._rv_counter
+            new.meta.generation = 1
+            new.meta.creation_timestamp = new.meta.creation_timestamp or now()
+            self._objects[key] = new
+            self._persist(new)
+            stored = new.deepcopy()
+        self._emit([WatchEvent(ADDED, stored.deepcopy())])
+        return stored
+
+    def update(self, obj: Resource) -> Resource:
+        """Full update (spec + metadata). Requires fresh resourceVersion."""
+        return self._update(obj, status_only=False)
+
+    def update_status(self, obj: Resource) -> Resource:
+        """Status-subresource update: spec/labels/annotations are ignored."""
+        return self._update(obj, status_only=True)
+
+    def _update(self, obj: Resource, status_only: bool) -> Resource:
+        with self._lock:
+            key = obj.key
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFound(*key)
+            if obj.meta.resource_version != cur.meta.resource_version:
+                raise Conflict(*key, obj.meta.resource_version, cur.meta.resource_version)
+            new = cur.deepcopy()
+            if status_only:
+                new.status = copy.deepcopy(obj.status)
+            else:
+                new.spec = copy.deepcopy(obj.spec)
+                new.status = copy.deepcopy(obj.status)
+                new.meta.labels = dict(obj.meta.labels)
+                new.meta.annotations = dict(obj.meta.annotations)
+                new.meta.finalizers = list(obj.meta.finalizers)
+                new.meta.owner_references = list(obj.meta.owner_references)
+                for fn in self._defaulters.get(new.kind, []):
+                    fn(new)
+                for fn in self._validators.get(new.kind, []):
+                    fn(new, cur)
+                if new.spec != cur.spec:
+                    new.meta.generation = cur.meta.generation + 1
+            self._rv_counter += 1
+            new.meta.resource_version = self._rv_counter
+            self._objects[key] = new
+
+            events = [WatchEvent(MODIFIED, new.deepcopy())]
+            # Finalizer-parked object whose last finalizer was just removed
+            # completes its deletion now.
+            if new.meta.deletion_timestamp is not None and not new.meta.finalizers:
+                events = self._remove_locked(key, collect=[])
+            else:
+                self._persist(new)
+            result = new.deepcopy()
+        self._emit(events)
+        return result
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        """Delete; parks with deletionTimestamp while finalizers remain."""
+        with self._lock:
+            key = (kind, namespace, name)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFound(*key)
+            if cur.meta.finalizers:
+                if cur.meta.deletion_timestamp is None:
+                    cur = cur.deepcopy()
+                    cur.meta.deletion_timestamp = now()
+                    self._rv_counter += 1
+                    cur.meta.resource_version = self._rv_counter
+                    self._objects[key] = cur
+                    self._persist(cur)
+                    events = [WatchEvent(MODIFIED, cur.deepcopy())]
+                else:
+                    events = []
+            else:
+                events = self._remove_locked(key, collect=[])
+        self._emit(events)
+
+    def _remove_locked(self, key: tuple[str, str, str], collect: list[WatchEvent]) -> list[WatchEvent]:
+        """Remove an object and cascade to owned children (k8s GC role)."""
+        obj = self._objects.pop(key, None)
+        if obj is None:
+            return collect
+        self._unpersist(obj)
+        collect.append(WatchEvent(DELETED, obj.deepcopy()))
+        owned = [
+            child.key
+            for child in self._objects.values()
+            if any(o.uid == obj.meta.uid for o in child.meta.owner_references)
+        ]
+        for child_key in owned:
+            child = self._objects.get(child_key)
+            if child is None:
+                continue
+            if child.meta.finalizers:
+                if child.meta.deletion_timestamp is None:
+                    child = child.deepcopy()
+                    child.meta.deletion_timestamp = now()
+                    self._rv_counter += 1
+                    child.meta.resource_version = self._rv_counter
+                    self._objects[child_key] = child
+                    self._persist(child)
+                    collect.append(WatchEvent(MODIFIED, child.deepcopy()))
+            else:
+                self._remove_locked(child_key, collect)
+        return collect
+
+    # -- retry helpers -----------------------------------------------------
+    def mutate(
+        self,
+        kind: str,
+        namespace: str,
+        name: str,
+        fn: Callable[[Resource], None],
+        status_only: bool = False,
+        max_attempts: int = 10,
+    ) -> Resource:
+        """Read-modify-write with conflict retry
+        (reference: pkg/kubeutil/retry.go retry-on-conflict)."""
+        last: Optional[Conflict] = None
+        for _ in range(max_attempts):
+            cur = self.get(kind, namespace, name)
+            fn(cur)
+            try:
+                if status_only:
+                    return self.update_status(cur)
+                return self.update(cur)
+            except Conflict as e:
+                last = e
+        raise last  # type: ignore[misc]
+
+    def patch_status(
+        self, kind: str, namespace: str, name: str, fn: Callable[[dict[str, Any]], None]
+    ) -> Resource:
+        """Status-only mutate helper used by SDK and controllers."""
+        return self.mutate(kind, namespace, name, lambda r: fn(r.status), status_only=True)
+
+    # -- persistence -------------------------------------------------------
+    def _path(self, obj: Resource) -> str:
+        assert self._persist_dir
+        # Percent-encode each key component so '.'/'/' in names can neither
+        # collide two resources onto one file nor escape the persist dir.
+        q = lambda s: urllib.parse.quote(s, safe="")  # noqa: E731
+        return os.path.join(
+            self._persist_dir,
+            f"{q(obj.kind)}__{q(obj.meta.namespace)}__{q(obj.meta.name)}.json",
+        )
+
+    def _persist(self, obj: Resource) -> None:
+        if not self._persist_dir:
+            return
+        tmp = self._path(obj) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj.to_dict(), f)
+        os.replace(tmp, self._path(obj))
+
+    def _unpersist(self, obj: Resource) -> None:
+        if not self._persist_dir:
+            return
+        try:
+            os.remove(self._path(obj))
+        except FileNotFoundError:
+            pass
+
+    def _load(self) -> None:
+        assert self._persist_dir
+        max_rv = 0
+        for fname in os.listdir(self._persist_dir):
+            if not fname.endswith(".json"):
+                continue
+            with open(os.path.join(self._persist_dir, fname)) as f:
+                obj = Resource.from_dict(json.load(f))
+            self._objects[obj.key] = obj
+            max_rv = max(max_rv, obj.meta.resource_version)
+        self._rv_counter = max_rv
+
+    # -- introspection -----------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+    def kinds(self) -> set[str]:
+        with self._lock:
+            return {k for (k, _, _) in self._objects}

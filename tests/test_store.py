@@ -1,0 +1,308 @@
+"""Resource store semantics: the coordination-bus contract.
+
+These mirror the guarantees the reference gets from kube-apiserver that
+its controllers depend on (optimistic concurrency, spec/status split,
+watches, finalizers, GC, indexes).
+"""
+
+import pytest
+
+from bobrapet_tpu.core import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AdmissionDenied,
+    AlreadyExists,
+    Conflict,
+    EventRecorder,
+    NotFound,
+    ResourceStore,
+    Resource,
+    new_resource,
+)
+
+
+@pytest.fixture
+def store():
+    return ResourceStore()
+
+
+def make(name="s1", kind="Story", ns="default", spec=None):
+    return new_resource(kind, name, ns, spec or {"steps": []})
+
+
+class TestCRUD:
+    def test_create_assigns_identity(self, store):
+        obj = store.create(make())
+        assert obj.meta.uid and obj.meta.resource_version > 0
+        assert obj.meta.generation == 1
+        assert obj.meta.creation_timestamp > 0
+
+    def test_create_duplicate(self, store):
+        store.create(make())
+        with pytest.raises(AlreadyExists):
+            store.create(make())
+
+    def test_get_returns_copy(self, store):
+        store.create(make())
+        a = store.get("Story", "default", "s1")
+        a.spec["steps"].append({"name": "x"})
+        b = store.get("Story", "default", "s1")
+        assert b.spec["steps"] == []
+
+    def test_get_missing(self, store):
+        with pytest.raises(NotFound):
+            store.get("Story", "default", "nope")
+
+    def test_update_requires_fresh_rv(self, store):
+        store.create(make())
+        a = store.get("Story", "default", "s1")
+        b = store.get("Story", "default", "s1")
+        a.spec["x"] = 1
+        store.update(a)
+        b.spec["x"] = 2
+        with pytest.raises(Conflict):
+            store.update(b)
+
+    def test_generation_bumps_only_on_spec_change(self, store):
+        store.create(make())
+        obj = store.get("Story", "default", "s1")
+        obj.meta.labels["a"] = "b"
+        obj = store.update(obj)
+        assert obj.meta.generation == 1  # metadata-only change
+        obj.spec["x"] = 1
+        obj = store.update(obj)
+        assert obj.meta.generation == 2
+
+    def test_status_update_cannot_touch_spec(self, store):
+        store.create(make())
+        obj = store.get("Story", "default", "s1")
+        obj.spec["hacked"] = True
+        obj.status["phase"] = "Running"
+        store.update_status(obj)
+        cur = store.get("Story", "default", "s1")
+        assert "hacked" not in cur.spec
+        assert cur.status["phase"] == "Running"
+        assert cur.meta.generation == 1
+
+    def test_mutate_retries_conflicts(self, store):
+        store.create(make())
+        # interleave a competing write inside the mutation function once
+        calls = {"n": 0}
+
+        def bump(r):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                store.mutate("Story", "default", "s1", lambda r2: r2.spec.update(other=1))
+            r.spec["mine"] = calls["n"]
+
+        store.mutate("Story", "default", "s1", bump)
+        cur = store.get("Story", "default", "s1")
+        assert cur.spec["other"] == 1 and cur.spec["mine"] == 2
+
+
+class TestWatch:
+    def test_watch_sees_lifecycle(self, store):
+        seen = []
+        store.watch(lambda ev: seen.append((ev.type, ev.resource.name)))
+        store.create(make())
+        store.mutate("Story", "default", "s1", lambda r: r.spec.update(x=1))
+        store.delete("Story", "default", "s1")
+        assert seen == [(ADDED, "s1"), (MODIFIED, "s1"), (DELETED, "s1")]
+
+    def test_watch_kind_filter(self, store):
+        seen = []
+        store.watch(lambda ev: seen.append(ev.resource.kind), kinds=["StepRun"])
+        store.create(make())
+        store.create(make(name="r1", kind="StepRun"))
+        assert seen == ["StepRun"]
+
+    def test_watcher_can_reenter_store(self, store):
+        # watcher performing a write must not deadlock
+        def on_event(ev):
+            if ev.type == ADDED and ev.resource.kind == "Story":
+                store.create(make(name="child", kind="StepRun"))
+
+        store.watch(on_event)
+        store.create(make())
+        assert store.try_get("StepRun", "default", "child") is not None
+
+    def test_unsubscribe(self, store):
+        seen = []
+        cancel = store.watch(lambda ev: seen.append(1))
+        store.create(make())
+        cancel()
+        store.create(make(name="s2"))
+        assert len(seen) == 1
+
+
+class TestFinalizersAndGC:
+    def test_finalizer_parks_deletion(self, store):
+        obj = make()
+        obj.meta.finalizers = ["bobrapet.io/cleanup"]
+        store.create(obj)
+        store.delete("Story", "default", "s1")
+        cur = store.get("Story", "default", "s1")
+        assert cur.meta.deletion_timestamp is not None
+        # removing the finalizer completes deletion
+        cur.meta.finalizers = []
+        store.update(cur)
+        assert store.try_get("Story", "default", "s1") is None
+
+    def test_cascade_delete_owned_children(self, store):
+        parent = store.create(make(kind="StoryRun", name="run1"))
+        child = new_resource("StepRun", "run1-step-a")
+        child.meta.owner_references = [parent.owner_ref()]
+        store.create(child)
+        unowned = store.create(make(kind="StepRun", name="stray"))
+        store.delete("StoryRun", "default", "run1")
+        assert store.try_get("StepRun", "default", "run1-step-a") is None
+        assert store.try_get("StepRun", "default", "stray") is not None
+        assert unowned is not None
+
+    def test_cascade_respects_child_finalizers(self, store):
+        parent = store.create(make(kind="StoryRun", name="run1"))
+        child = new_resource("StepRun", "run1-step-a")
+        child.meta.owner_references = [parent.owner_ref()]
+        child.meta.finalizers = ["drain"]
+        store.create(child)
+        store.delete("StoryRun", "default", "run1")
+        parked = store.get("StepRun", "default", "run1-step-a")
+        assert parked.meta.deletion_timestamp is not None
+
+
+class TestIndexes:
+    def test_index_lookup(self, store):
+        store.add_index(
+            "StepRun", "storyRunRef", lambda r: [r.spec.get("storyRunRef", {}).get("name", "")]
+        )
+        store.create(
+            new_resource("StepRun", "a", spec={"storyRunRef": {"name": "run1"}})
+        )
+        store.create(
+            new_resource("StepRun", "b", spec={"storyRunRef": {"name": "run2"}})
+        )
+        got = store.list("StepRun", index=("storyRunRef", "run1"))
+        assert [r.name for r in got] == ["a"]
+
+    def test_label_and_namespace_filters(self, store):
+        store.create(new_resource("Story", "a", namespace="ns1", labels={"team": "x"}))
+        store.create(new_resource("Story", "b", namespace="ns2", labels={"team": "x"}))
+        assert len(store.list("Story", labels={"team": "x"})) == 2
+        assert [r.name for r in store.list("Story", namespace="ns1")] == ["a"]
+
+
+class TestAdmission:
+    def test_defaulter_runs_on_create_and_update(self, store):
+        def default_pattern(r: Resource):
+            r.spec.setdefault("pattern", "batch")
+
+        store.register_defaulter("Story", default_pattern)
+        obj = store.create(make())
+        assert obj.spec["pattern"] == "batch"
+
+    def test_validator_denies(self, store):
+        def deny_empty_steps(r: Resource, old):
+            if not r.spec.get("steps"):
+                raise AdmissionDenied("steps required")
+
+        store.register_validator("Story", deny_empty_steps)
+        with pytest.raises(AdmissionDenied):
+            store.create(make(spec={"steps": []}))
+
+
+class TestPersistence:
+    def test_roundtrip(self, tmp_store_dir):
+        s1 = ResourceStore(persist_dir=tmp_store_dir)
+        s1.create(make(spec={"steps": [{"name": "a"}]}))
+        s1.mutate("Story", "default", "s1", lambda r: r.status.update(phase="Running"), status_only=True)
+        s2 = ResourceStore(persist_dir=tmp_store_dir)
+        cur = s2.get("Story", "default", "s1")
+        assert cur.status["phase"] == "Running"
+        assert cur.spec["steps"] == [{"name": "a"}]
+        # resourceVersion counter resumes past loaded values
+        s2.mutate("Story", "default", "s1", lambda r: r.spec.update(x=1))
+        assert s2.get("Story", "default", "s1").meta.resource_version > cur.meta.resource_version
+
+
+class TestHardening:
+    def test_persist_filenames_cannot_collide(self, tmp_store_dir):
+        s = ResourceStore(persist_dir=tmp_store_dir)
+        s.create(new_resource("Story", "b.c", namespace="a"))
+        s.create(new_resource("Story", "c", namespace="a.b"))
+        s2 = ResourceStore(persist_dir=tmp_store_dir)
+        assert s2.try_get("Story", "a", "b.c") is not None
+        assert s2.try_get("Story", "a.b", "c") is not None
+
+    def test_persist_name_cannot_escape_dir(self, tmp_store_dir):
+        import os
+
+        s = ResourceStore(persist_dir=tmp_store_dir)
+        s.create(new_resource("Story", "../../evil"))
+        for root, _, files in os.walk(tmp_store_dir):
+            for f in files:
+                assert os.path.realpath(os.path.join(root, f)).startswith(
+                    os.path.realpath(tmp_store_dir)
+                )
+
+    def test_raising_watcher_does_not_fail_write_or_starve_others(self, store):
+        seen = []
+
+        def bad(ev):
+            raise RuntimeError("watcher bug")
+
+        store.watch(bad)
+        store.watch(lambda ev: seen.append(ev.type))
+        obj = store.create(make())  # must not raise
+        assert obj.meta.uid
+        assert seen == [ADDED]
+
+    def test_watch_events_in_commit_order_under_concurrency(self, store):
+        import threading
+
+        order = []
+        store.watch(lambda ev: order.append(ev.resource.meta.resource_version))
+
+        def writer(i):
+            store.create(make(name=f"s-{i}"))
+
+        ts = [threading.Thread(target=writer, args=(i,)) for i in range(20)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert order == sorted(order)
+
+    def test_conflict_carries_versions(self, store):
+        store.create(make())
+        a = store.get("Story", "default", "s1")
+        b = store.get("Story", "default", "s1")
+        a.spec["x"] = 1
+        store.update(a)
+        b.spec["x"] = 2
+        with pytest.raises(Conflict) as ei:
+            store.update(b)
+        assert ei.value.actual > ei.value.expected
+
+    def test_warning_not_folded_into_normal(self, store):
+        rec = EventRecorder()
+        obj = store.create(make())
+        rec.normal(obj, "Reconciling", "syncing")
+        rec.warning(obj, "Reconciling", "syncing")
+        types = [e.type for e in rec.for_object("Story", "default", "s1")]
+        assert types == ["Normal", "Warning"]
+
+
+class TestEventRecorder:
+    def test_dedup(self, store):
+        rec = EventRecorder()
+        obj = store.create(make())
+        for _ in range(5):
+            rec.warning(obj, "RetryScheduled", "retrying step")
+        evs = rec.for_object("Story", "default", "s1")
+        assert len(evs) == 1 and evs[0].count == 5
+
+    def test_distinct_messages_not_deduped(self, store):
+        rec = EventRecorder()
+        obj = store.create(make())
+        rec.normal(obj, "Scheduled", "step a")
+        rec.normal(obj, "Scheduled", "step b")
+        assert len(rec.for_object("Story", "default", "s1")) == 2
