@@ -1,0 +1,84 @@
+"""Catalog kinds: EngramTemplate / ImpulseTemplate.
+
+Capability parity with the reference catalog API group
+(reference: api/catalog/v1alpha1/ — TemplateSpec shared_types.go:34,
+TemplateExecutionPolicy:76, EngramTemplateSpec engramtemplate_types.go:63,
+ImpulseTemplate impulsetemplate_types.go): cluster-scoped reusable
+component packages.
+
+TPU-native addition: alongside the container ``image``, a template may
+declare a Python ``entrypoint`` ("pkg.module:function") that the local
+gang executor invokes directly — the in-process equivalent of launching
+the engram container, used by tests and single-machine deployments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ..core.object import Resource, new_resource
+from .enums import WorkloadMode
+from .shared import (
+    ExecutionPolicy,
+    SecretDefinition,
+    SpecBase,
+    TriggerDeliveryPolicy,
+)
+
+ENGRAM_TEMPLATE_KIND = "EngramTemplate"
+IMPULSE_TEMPLATE_KIND = "ImpulseTemplate"
+
+#: Catalog kinds are cluster-scoped: stored under this pseudo-namespace.
+CLUSTER_NAMESPACE = "_cluster"
+
+
+@dataclasses.dataclass
+class TemplateSpec(SpecBase):
+    """Fields shared by both template kinds
+    (reference: api/catalog/v1alpha1/shared_types.go:34-76)."""
+
+    image: Optional[str] = None
+    entrypoint: Optional[str] = None  # TPU-native: "module.path:callable"
+    version: Optional[str] = None
+    description: Optional[str] = None
+    config_schema: Optional[dict[str, Any]] = None
+    secret_schema: list[SecretDefinition] = dataclasses.field(default_factory=list)
+    supported_modes: list[WorkloadMode] = dataclasses.field(default_factory=list)
+    execution_policy: Optional[ExecutionPolicy] = None
+
+    def supports_mode(self, mode: WorkloadMode) -> bool:
+        return not self.supported_modes or mode in self.supported_modes
+
+
+@dataclasses.dataclass
+class EngramTemplateSpec(TemplateSpec):
+    """(reference: engramtemplate_types.go:63)"""
+
+    input_schema: Optional[dict[str, Any]] = None
+    output_schema: Optional[dict[str, Any]] = None
+    declared_output_keys: list[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ImpulseTemplateSpec(TemplateSpec):
+    """(reference: impulsetemplate_types.go; + trigger delivery defaults)"""
+
+    trigger_schema: Optional[dict[str, Any]] = None
+    delivery: Optional[TriggerDeliveryPolicy] = None
+
+
+def parse_engram_template(resource: Resource) -> EngramTemplateSpec:
+    return EngramTemplateSpec.from_dict(resource.spec)
+
+
+def parse_impulse_template(resource: Resource) -> ImpulseTemplateSpec:
+    return ImpulseTemplateSpec.from_dict(resource.spec)
+
+
+def make_engram_template(name: str, **spec_fields: Any) -> Resource:
+    return new_resource(ENGRAM_TEMPLATE_KIND, name, CLUSTER_NAMESPACE, spec_fields)
+
+
+def make_impulse_template(name: str, **spec_fields: Any) -> Resource:
+    return new_resource(IMPULSE_TEMPLATE_KIND, name, CLUSTER_NAMESPACE, spec_fields)

@@ -1,0 +1,73 @@
+"""Engram: a configured worker instance bound to an EngramTemplate.
+
+Capability parity with the reference Engram CRD
+(reference: api/v1alpha1/engram_types.go:52-159).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ..core.object import Resource, new_resource
+from .enums import WorkloadMode
+from .refs import TemplateRef
+from .shared import ExecutionOverrides, SpecBase, WorkloadSpec
+
+KIND = "Engram"
+
+
+@dataclasses.dataclass
+class EngramTLSSpec(SpecBase):
+    """(reference: engram_types.go:91-107)"""
+
+    enabled: Optional[bool] = None
+    secret_name: Optional[str] = None
+
+
+@dataclasses.dataclass
+class EngramTransportSpec(SpecBase):
+    grpc_port: Optional[int] = None
+    tls: Optional[EngramTLSSpec] = None
+
+
+@dataclasses.dataclass
+class EngramSpec(SpecBase):
+    """(reference: engram_types.go:52-89)"""
+
+    template_ref: Optional[TemplateRef] = None
+    mode: Optional[WorkloadMode] = None
+    with_config: Optional[dict[str, Any]] = None
+    secrets: dict[str, str] = dataclasses.field(default_factory=dict)
+    transport: Optional[EngramTransportSpec] = None
+    execution: Optional[ExecutionOverrides] = None
+    workload: Optional[WorkloadSpec] = None
+
+    @classmethod
+    def from_dict(cls, d):
+        if d is None:
+            return None
+        d = dict(d)
+        if "with" in d:
+            d["withConfig"] = d.pop("with")
+        return super().from_dict(d)
+
+    def to_dict(self) -> dict[str, Any]:
+        out = super().to_dict()
+        if "withConfig" in out:
+            out["with"] = out.pop("withConfig")
+        return out
+
+
+def parse_engram(resource: Resource) -> EngramSpec:
+    return EngramSpec.from_dict(resource.spec)
+
+
+def make_engram(
+    name: str,
+    template: str,
+    namespace: str = "default",
+    **spec_fields: Any,
+) -> Resource:
+    spec = {"templateRef": {"name": template}, **spec_fields}
+    return new_resource(KIND, name, namespace, spec)

@@ -1,0 +1,69 @@
+"""Impulse: an always-on event trigger that launches Stories.
+
+Capability parity with the reference Impulse CRD
+(reference: api/v1alpha1/impulse_types.go:55-156).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ..core.object import Resource, new_resource
+from .refs import StoryRef, TemplateRef
+from .shared import (
+    SpecBase,
+    TriggerDeliveryPolicy,
+    TriggerThrottlePolicy,
+    WorkloadSpec,
+)
+
+KIND = "Impulse"
+
+
+@dataclasses.dataclass
+class ImpulseSpec(SpecBase):
+    """(reference: impulse_types.go:55-102)"""
+
+    template_ref: Optional[TemplateRef] = None
+    story_ref: Optional[StoryRef] = None
+    mapping: Optional[dict[str, Any]] = None  # event -> story inputs template
+    with_config: Optional[dict[str, Any]] = None
+    delivery: Optional[TriggerDeliveryPolicy] = None
+    throttle: Optional[TriggerThrottlePolicy] = None
+    workload: Optional[WorkloadSpec] = None
+    secrets: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d):
+        if d is None:
+            return None
+        d = dict(d)
+        if "with" in d:
+            d["withConfig"] = d.pop("with")
+        return super().from_dict(d)
+
+    def to_dict(self) -> dict[str, Any]:
+        out = super().to_dict()
+        if "withConfig" in out:
+            out["with"] = out.pop("withConfig")
+        return out
+
+
+def parse_impulse(resource: Resource) -> ImpulseSpec:
+    return ImpulseSpec.from_dict(resource.spec)
+
+
+def make_impulse(
+    name: str,
+    template: str,
+    story: str,
+    namespace: str = "default",
+    **spec_fields: Any,
+) -> Resource:
+    spec = {
+        "templateRef": {"name": template},
+        "storyRef": {"name": story},
+        **spec_fields,
+    }
+    return new_resource(KIND, name, namespace, spec)

@@ -1,0 +1,89 @@
+"""ReferenceGrant: Gateway-API-style cross-namespace reference policy.
+
+Capability parity with the reference policy API group
+(reference: api/policy/v1alpha1/referencegrant_types.go:29-342): a grant
+in the TARGET namespace allows references FROM (kind, namespace) pairs TO
+(kind, optional name) targets. Evaluated by admission and controllers
+when ``referenceCrossNamespacePolicy`` is "grant"
+(reference: pkg/refs/reference_grant.go:26).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ..core.object import Resource, new_resource
+from .specbase import SpecBase
+
+KIND = "ReferenceGrant"
+
+
+@dataclasses.dataclass
+class ReferenceGrantFrom(SpecBase):
+    kind: str = ""
+    namespace: str = ""
+
+
+@dataclasses.dataclass
+class ReferenceGrantTo(SpecBase):
+    kind: str = ""
+    name: Optional[str] = None  # None = all objects of this kind
+
+
+@dataclasses.dataclass
+class ReferenceGrantSpec(SpecBase):
+    """(reference: referencegrant_types.go:29)"""
+
+    from_: list[ReferenceGrantFrom] = dataclasses.field(default_factory=list)
+    to: list[ReferenceGrantTo] = dataclasses.field(default_factory=list)
+    # (serializes as "from": snake_to_camel("from_") == "from")
+
+
+def parse_reference_grant(resource: Resource) -> ReferenceGrantSpec:
+    return ReferenceGrantSpec.from_dict(resource.spec)
+
+
+def grant_allows(
+    grant: Resource,
+    from_kind: str,
+    from_namespace: str,
+    to_kind: str,
+    to_name: str,
+) -> bool:
+    """Does this grant (living in the target namespace) permit the reference?"""
+    spec = parse_reference_grant(grant)
+    if not any(
+        f.kind == from_kind and f.namespace == from_namespace for f in spec.from_
+    ):
+        return False
+    return any(
+        t.kind == to_kind and (t.name is None or t.name == to_name) for t in spec.to
+    )
+
+
+def reference_granted(
+    store,
+    from_kind: str,
+    from_namespace: str,
+    to_kind: str,
+    to_namespace: str,
+    to_name: str,
+) -> bool:
+    """Check all ReferenceGrants in the target namespace
+    (reference: pkg/refs/reference_grant.go:26)."""
+    if from_namespace == to_namespace:
+        return True
+    for grant in store.list(KIND, namespace=to_namespace):
+        if grant_allows(grant, from_kind, from_namespace, to_kind, to_name):
+            return True
+    return False
+
+
+def make_reference_grant(
+    name: str,
+    namespace: str,
+    from_: list[dict[str, str]],
+    to: list[dict[str, Any]],
+) -> Resource:
+    return new_resource(KIND, name, namespace, {"from": from_, "to": to})

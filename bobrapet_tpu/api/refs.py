@@ -1,0 +1,66 @@
+"""Typed cross-resource references.
+
+Capability parity with the reference's reference types
+(reference: pkg/refs/refs.go:58-214): each ref names a target kind's
+object, optionally in another namespace (cross-namespace use is policed
+by ReferenceGrant policy, see admission layer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .specbase import SpecBase
+
+
+@dataclasses.dataclass
+class ObjectRef(SpecBase):
+    """Name + optional namespace reference to one resource."""
+
+    name: str = ""
+    namespace: Optional[str] = None
+
+    def resolve_namespace(self, default_namespace: str) -> str:
+        return self.namespace or default_namespace
+
+    def is_cross_namespace(self, from_namespace: str) -> bool:
+        return self.namespace is not None and self.namespace != from_namespace
+
+
+@dataclasses.dataclass
+class StoryRef(ObjectRef):
+    """Reference to a Story, optionally pinned to a spec version
+    (reference: storytrigger version pinning, storytrigger_controller.go:101-109)."""
+
+    version: Optional[str] = None
+
+
+@dataclasses.dataclass
+class EngramRef(ObjectRef):
+    pass
+
+
+@dataclasses.dataclass
+class TemplateRef(ObjectRef):
+    """Reference to a cluster-scoped EngramTemplate/ImpulseTemplate."""
+
+
+@dataclasses.dataclass
+class StoryRunRef(ObjectRef):
+    pass
+
+
+@dataclasses.dataclass
+class StepRunRef(ObjectRef):
+    pass
+
+
+@dataclasses.dataclass
+class ImpulseRef(ObjectRef):
+    pass
+
+
+@dataclasses.dataclass
+class TransportRef(ObjectRef):
+    pass

@@ -1,0 +1,253 @@
+"""Run kinds: StoryRun, StepRun, StoryTrigger, EffectClaim.
+
+Capability parity with the reference runs API group
+(reference: api/runs/v1alpha1/ — storyrun_types.go:70-299,
+steprun_types.go:77-375, storytrigger_types.go:27-155,
+effectclaim_types.go:25-155).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ..core.object import Resource, new_resource
+from .enums import Phase
+from .refs import EngramRef, ImpulseRef, StoryRef, StoryRunRef
+from .shared import ExecutionOverrides, RetryPolicy, SpecBase
+
+STORY_RUN_KIND = "StoryRun"
+STEP_RUN_KIND = "StepRun"
+STORY_TRIGGER_KIND = "StoryTrigger"
+EFFECT_CLAIM_KIND = "EffectClaim"
+
+
+# ---------------------------------------------------------------------------
+# StoryRun
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class StoryRunSpec(SpecBase):
+    """(reference: storyrun_types.go:70-104)"""
+
+    story_ref: Optional[StoryRef] = None
+    impulse_ref: Optional[ImpulseRef] = None
+    inputs: Optional[dict[str, Any]] = None
+    cancel_requested: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class StepState(SpecBase):
+    """Per-step execution state mirrored into StoryRun.status.stepStates
+    (reference: storyrun_types.go:246-272)."""
+
+    phase: Optional[Phase] = None
+    reason: Optional[str] = None
+    message: Optional[str] = None
+    started_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    retries: Optional[int] = None
+    output: Optional[Any] = None
+    output_ref: Optional[dict[str, Any]] = None
+    signals: Optional[dict[str, Any]] = None
+    exit_code: Optional[int] = None
+    exit_class: Optional[str] = None
+
+    @property
+    def effective_phase(self) -> Phase:
+        return self.phase or Phase.PENDING
+
+    @property
+    def is_terminal(self) -> bool:
+        return self.effective_phase.is_terminal
+
+
+@dataclasses.dataclass
+class GateStatus(SpecBase):
+    """Manual-approval decision recorded on StoryRun.status.gates[step]
+    via a status patch (reference: storyrun_types.go:274-297)."""
+
+    approved: Optional[bool] = None
+    approver: Optional[str] = None
+    comment: Optional[str] = None
+    decided_at: Optional[float] = None
+
+
+# Durable DAG phase annotation values (main -> compensation -> finally,
+# reference: dag.go:482-511).
+DAG_PHASE_MAIN = "main"
+DAG_PHASE_COMPENSATION = "compensation"
+DAG_PHASE_FINALLY = "finally"
+
+
+# ---------------------------------------------------------------------------
+# StepRun
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class GRPCTarget(SpecBase):
+    """(reference: steprun_types.go:139-152)"""
+
+    host: str = ""
+    port: int = 0
+    step_name: Optional[str] = None
+    tls: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class DownstreamTarget(SpecBase):
+    """Next-hop for streaming outputs, computed by the controller and
+    patched into the StepRun spec (reference: steprun_types.go:139-161,
+    steprun_controller.go:1405)."""
+
+    grpc: Optional[GRPCTarget] = None
+    terminate: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class HandoffStatus(SpecBase):
+    """Streaming cutover progress during upgrades
+    (reference: steprun_types.go:175-191)."""
+
+    strategy: Optional[str] = None  # drain | cutover
+    phase: Optional[str] = None
+    old_generation: Optional[int] = None
+    new_generation: Optional[int] = None
+    started_at: Optional[float] = None
+
+
+@dataclasses.dataclass
+class EffectRecord(SpecBase):
+    """Ledger entry for one external side effect
+    (reference: steprun_types.go:342-358)."""
+
+    effect_id: str = ""
+    claim_name: Optional[str] = None
+    state: Optional[str] = None
+    recorded_at: Optional[float] = None
+
+
+@dataclasses.dataclass
+class SignalEvent(SpecBase):
+    """(reference: steprun_types.go:360-370)"""
+
+    name: str = ""
+    value: Optional[Any] = None
+    at: Optional[float] = None
+
+
+@dataclasses.dataclass
+class StepRunSpec(SpecBase):
+    """(reference: steprun_types.go:77-137)"""
+
+    story_run_ref: Optional[StoryRunRef] = None
+    step_id: Optional[str] = None
+    idempotency_key: Optional[str] = None
+    engram_ref: Optional[EngramRef] = None
+    template_generation: Optional[int] = None
+    input: Optional[dict[str, Any]] = None
+    timeout: Optional[str] = None
+    retry: Optional[RetryPolicy] = None
+    execution_overrides: Optional[ExecutionOverrides] = None
+    downstream_targets: list[DownstreamTarget] = dataclasses.field(default_factory=list)
+    # TPU-native addition: the slice grant assigned by placement —
+    # accelerator/topology/hosts + mesh axes the engram should build.
+    slice_grant: Optional[dict[str, Any]] = None
+
+
+# ---------------------------------------------------------------------------
+# StoryTrigger
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TriggerDeliveryIdentity(SpecBase):
+    """Dedupe identity for durable trigger admission
+    (reference: storytrigger_types.go:27-49)."""
+
+    mode: Optional[str] = None  # none | key | keyAndInputHash
+    key: Optional[str] = None
+    input_hash: Optional[str] = None
+    submission_id: Optional[str] = None
+
+
+@dataclasses.dataclass
+class StoryTriggerSpec(SpecBase):
+    """(reference: storytrigger_types.go:61-81)"""
+
+    story_ref: Optional[StoryRef] = None
+    impulse_ref: Optional[ImpulseRef] = None
+    identity: Optional[TriggerDeliveryIdentity] = None
+    inputs: Optional[dict[str, Any]] = None
+
+
+# ---------------------------------------------------------------------------
+# EffectClaim
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class EffectClaimSpec(SpecBase):
+    """Durable lease for one external side effect
+    (reference: effectclaim_types.go:45-97)."""
+
+    step_run_ref: Optional[dict[str, Any]] = None
+    effect_id: Optional[str] = None
+    holder_identity: Optional[str] = None
+    lease_duration_seconds: Optional[int] = None
+    acquired_at: Optional[float] = None
+    renewed_at: Optional[float] = None
+
+
+# ---------------------------------------------------------------------------
+# Builders / parsers
+# ---------------------------------------------------------------------------
+
+
+def parse_storyrun(resource: Resource) -> StoryRunSpec:
+    return StoryRunSpec.from_dict(resource.spec)
+
+
+def parse_steprun(resource: Resource) -> StepRunSpec:
+    return StepRunSpec.from_dict(resource.spec)
+
+
+def parse_storytrigger(resource: Resource) -> StoryTriggerSpec:
+    return StoryTriggerSpec.from_dict(resource.spec)
+
+
+def parse_effectclaim(resource: Resource) -> EffectClaimSpec:
+    return EffectClaimSpec.from_dict(resource.spec)
+
+
+def make_storyrun(
+    name: str,
+    story: str,
+    inputs: Optional[dict[str, Any]] = None,
+    namespace: str = "default",
+    **spec_fields: Any,
+) -> Resource:
+    spec: dict[str, Any] = {"storyRef": {"name": story}, **spec_fields}
+    if inputs is not None:
+        spec["inputs"] = inputs
+    return new_resource(STORY_RUN_KIND, name, namespace, spec)
+
+
+def get_step_states(run: Resource) -> dict[str, StepState]:
+    return {
+        name: StepState.from_dict(raw)
+        for name, raw in (run.status.get("stepStates") or {}).items()
+    }
+
+
+def set_step_state(run: Resource, step_name: str, state: StepState) -> None:
+    run.status.setdefault("stepStates", {})[step_name] = state.to_dict()
+
+
+def get_gates(run: Resource) -> dict[str, GateStatus]:
+    return {
+        name: GateStatus.from_dict(raw)
+        for name, raw in (run.status.get("gates") or {}).items()
+    }

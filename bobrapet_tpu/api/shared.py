@@ -1,0 +1,339 @@
+"""Shared workload / policy spec types used across kinds.
+
+Capability parity with the reference's shared types
+(reference: api/v1alpha1/shared_types.go — WorkloadSpec:31,
+ExecutionOverrides:94, ExecutionPolicy:175, ResourcePolicy,
+SecurityPolicy, PlacementPolicy:355, CachePolicy:249, RetryPolicy:400,
+StoragePolicy:497-547, Trigger*Policy:281-352), plus the TPU-native
+additions the reference has no counterpart for: :class:`TPUPolicy`
+(accelerator/topology/chips/hosts + ICI-contiguity for slice placement)
+per SURVEY §7.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from .enums import (
+    AcceleratorType,
+    BackoffStrategy,
+    SecretMountType,
+    UpdateStrategyType,
+    WorkloadMode,
+)
+from .specbase import SpecBase
+
+
+# ---------------------------------------------------------------------------
+# Retry / cache
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RetryPolicy(SpecBase):
+    """Retry knobs (reference: shared_types.go:400-428).
+
+    jitter is a percentage (0-100) applied to each computed delay.
+    """
+
+    max_retries: Optional[int] = None
+    delay: Optional[str] = None
+    max_delay: Optional[str] = None
+    jitter: Optional[int] = None
+    backoff: Optional[BackoffStrategy] = None
+
+
+@dataclasses.dataclass
+class CachePolicy(SpecBase):
+    """Step output memoization (reference: shared_types.go:249-276).
+
+    mode: 'inputs' (default, key = hash of resolved inputs) or 'key'
+    (key template evaluated against the step scope).
+    """
+
+    enabled: Optional[bool] = None
+    key: Optional[str] = None
+    salt: Optional[str] = None
+    mode: Optional[str] = None
+    ttl_seconds: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# Storage
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class S3StorageProvider(SpecBase):
+    """S3/MinIO payload offload target (reference: shared_types.go:513-529)."""
+
+    bucket: str = ""
+    region: Optional[str] = None
+    endpoint: Optional[str] = None
+    use_path_style: Optional[bool] = None
+    secret_ref: Optional[str] = None
+    service_account_annotations: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class FileStorageProvider(SpecBase):
+    """Filesystem payload offload target (reference: shared_types.go:536-546)."""
+
+    path: Optional[str] = None
+    volume_claim_name: Optional[str] = None
+
+
+@dataclasses.dataclass
+class SliceLocalSSDProvider(SpecBase):
+    """TPU-native addition: slice-local SSD for hot payload offload
+    (SURVEY north star: 'large payloads offload to slice-local SSD').
+
+    Data written here is only readable by steps placed on the same slice;
+    the scheduler records slice affinity when a run uses it.
+    """
+
+    path: str = "/mnt/slice-ssd"
+    max_bytes: Optional[int] = None
+
+
+@dataclasses.dataclass
+class StoragePolicy(SpecBase):
+    """Which offload backend to use + limits (reference: shared_types.go:497-510)."""
+
+    s3: Optional[S3StorageProvider] = None
+    file: Optional[FileStorageProvider] = None
+    slice_local_ssd: Optional[SliceLocalSSDProvider] = None
+    timeout_seconds: Optional[int] = None
+    max_inline_size: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# Placement / resources / security
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TPUPolicy(SpecBase):
+    """TPU slice requirements for a step/engram (TPU-native addition).
+
+    The pod-builder equivalent turns this into ``google.com/tpu`` resource
+    limits + ``cloud.google.com/gke-tpu-topology`` node selectors, and the
+    DAG scheduler's slice-placement stage assigns an ICI-contiguous
+    sub-mesh covering ``topology`` (SURVEY §7 'TPU gang scheduling').
+    """
+
+    accelerator: Optional[AcceleratorType] = None
+    topology: Optional[str] = None  # e.g. "2x4", "4x4x4"
+    chips: Optional[int] = None  # total chips wanted (alternative to topology)
+    hosts: Optional[int] = None  # host processes in the gang (derived if unset)
+    ici_contiguous: Optional[bool] = None  # require one unfragmented sub-mesh
+    mesh_axes: dict[str, int] = dataclasses.field(default_factory=dict)
+    # logical axis name -> size, e.g. {"data": 2, "tensor": 4}; exported to
+    # the engram through the env contract so it can build jax.sharding.Mesh
+
+    def chip_count(self) -> int:
+        if self.topology:
+            n = 1
+            for part in self.topology.split("x"):
+                n *= int(part)
+            return n
+        return self.chips or 0
+
+
+@dataclasses.dataclass
+class PlacementPolicy(SpecBase):
+    """Node targeting (reference: shared_types.go:355-366) + TPU slice policy."""
+
+    node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
+    tolerations: list[dict[str, Any]] = dataclasses.field(default_factory=list)
+    affinity: Optional[dict[str, Any]] = None
+    tpu: Optional[TPUPolicy] = None
+
+
+@dataclasses.dataclass
+class ResourceRequests(SpecBase):
+    cpu: Optional[str] = None
+    memory: Optional[str] = None
+    ephemeral_storage: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ResourcePolicy(SpecBase):
+    """Compute resources (reference: shared_types.go:456-475)."""
+
+    requests: Optional[ResourceRequests] = None
+    limits: Optional[ResourceRequests] = None
+
+
+@dataclasses.dataclass
+class SecurityPolicy(SpecBase):
+    """Pod security posture (reference: shared_types.go:481-493)."""
+
+    run_as_non_root: Optional[bool] = None
+    allow_privilege_escalation: Optional[bool] = None
+    read_only_root_filesystem: Optional[bool] = None
+    run_as_user: Optional[int] = None
+    required_secrets: list[str] = dataclasses.field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Workload shape
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class JobWorkloadConfig(SpecBase):
+    """batch-Job knobs (reference: shared_types.go:67-79).
+
+    For TPU gangs: completions = hosts in the slice; the executor assigns
+    completion-index -> TPU_WORKER_ID (SURVEY §2.6 row 5).
+    """
+
+    parallelism: Optional[int] = None
+    completions: Optional[int] = None
+    backoff_limit: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    ttl_seconds_after_finished: Optional[int] = None
+
+
+@dataclasses.dataclass
+class StatefulSetWorkloadConfig(SpecBase):
+    service_name: Optional[str] = None
+    pod_management_policy: Optional[str] = None
+
+
+@dataclasses.dataclass
+class RollingUpdateConfig(SpecBase):
+    max_unavailable: Optional[str] = None
+    max_surge: Optional[str] = None
+
+
+@dataclasses.dataclass
+class UpdateStrategy(SpecBase):
+    type: Optional[UpdateStrategyType] = None
+    rolling_update: Optional[RollingUpdateConfig] = None
+
+
+@dataclasses.dataclass
+class WorkloadSpec(SpecBase):
+    """How an engram materializes (reference: shared_types.go:31-49)."""
+
+    mode: Optional[WorkloadMode] = None
+    job: Optional[JobWorkloadConfig] = None
+    stateful_set: Optional[StatefulSetWorkloadConfig] = None
+    resources: Optional[ResourcePolicy] = None
+    update_strategy: Optional[UpdateStrategy] = None
+
+
+@dataclasses.dataclass
+class ProbeOverrides(SpecBase):
+    disable_liveness: Optional[bool] = None
+    disable_readiness: Optional[bool] = None
+    disable_startup: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class ExecutionOverrides(SpecBase):
+    """Per-step execution tuning layered over resolved config
+    (reference: shared_types.go:94-147)."""
+
+    timeout: Optional[str] = None
+    retry: Optional[RetryPolicy] = None
+    debug: Optional[bool] = None
+    security: Optional[SecurityPolicy] = None
+    placement: Optional[PlacementPolicy] = None
+    image: Optional[str] = None
+    image_pull_policy: Optional[str] = None
+    max_inline_size: Optional[int] = None
+    service_account_name: Optional[str] = None
+    probes: Optional[ProbeOverrides] = None
+    storage: Optional[StoragePolicy] = None
+    cache: Optional[CachePolicy] = None
+    workload: Optional[WorkloadSpec] = None
+
+
+@dataclasses.dataclass
+class JobPolicy(SpecBase):
+    """Operator/template-level Job defaults (reference: shared_types.go:373-396)."""
+
+    ttl_seconds_after_finished: Optional[int] = None
+    backoff_limit: Optional[int] = None
+    story_run_retention_seconds: Optional[int] = None
+    restart_policy: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ExecutionPolicy(SpecBase):
+    """Recommended/default execution config carried by templates and
+    stories (reference: shared_types.go:175-217)."""
+
+    resources: Optional[ResourcePolicy] = None
+    security: Optional[SecurityPolicy] = None
+    placement: Optional[PlacementPolicy] = None
+    job: Optional[JobPolicy] = None
+    retry: Optional[RetryPolicy] = None
+    timeout: Optional[str] = None
+    max_recursion_depth: Optional[int] = None
+    service_account_name: Optional[str] = None
+    storage: Optional[StoragePolicy] = None
+    cache: Optional[CachePolicy] = None
+    probes: Optional[ProbeOverrides] = None
+
+
+# ---------------------------------------------------------------------------
+# Trigger delivery (Impulse / StoryTrigger)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TriggerDedupePolicy(SpecBase):
+    """(reference: shared_types.go:308-312)"""
+
+    mode: Optional[str] = None  # none | key | keyAndInputHash
+    key_template: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TriggerRetryPolicy(SpecBase):
+    """(reference: shared_types.go:320-332)"""
+
+    max_attempts: Optional[int] = None
+    base_delay: Optional[str] = None
+    max_delay: Optional[str] = None
+    backoff: Optional[BackoffStrategy] = None
+
+
+@dataclasses.dataclass
+class TriggerThrottlePolicy(SpecBase):
+    """(reference: shared_types.go:341-351)"""
+
+    max_in_flight: Optional[int] = None
+    rate_per_second: Optional[int] = None
+    burst: Optional[int] = None
+
+
+@dataclasses.dataclass
+class TriggerDeliveryPolicy(SpecBase):
+    """(reference: shared_types.go:284-288)"""
+
+    dedupe: Optional[TriggerDedupePolicy] = None
+    retry: Optional[TriggerRetryPolicy] = None
+    throttle: Optional[TriggerThrottlePolicy] = None
+
+
+# ---------------------------------------------------------------------------
+# Secrets
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SecretDefinition(SpecBase):
+    """How a named secret is surfaced to the workload
+    (reference: api/catalog/v1alpha1/shared_types.go:296)."""
+
+    name: str = ""
+    description: Optional[str] = None
+    required: Optional[bool] = None
+    mount_type: Optional[SecretMountType] = None
+    mount_path: Optional[str] = None

@@ -1,0 +1,113 @@
+"""Dataclass <-> resource-dict mapping for API spec types.
+
+Every API kind's spec/status travels through the resource store as plain
+dicts (camelCase keys, like CRD YAML). SpecBase gives typed dataclasses a
+generic, recursive ``from_dict``/``to_dict`` so the ~40 nested policy
+types mirrored from the reference (SURVEY §2.1) don't each hand-roll
+serialization.
+
+Conventions:
+- field ``max_retries`` <-> dict key ``maxRetries``
+- ``None`` and empty containers are omitted from dicts (sparse specs)
+- nested SpecBase / list[SpecBase] / dict[str, SpecBase] recurse
+- enum-typed fields coerce from their string values
+- unknown dict keys are ignored on parse (forward compatibility)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import typing
+from typing import Any, Optional, Type, TypeVar, get_args, get_origin, get_type_hints
+
+T = TypeVar("T", bound="SpecBase")
+
+
+def snake_to_camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(p.title() for p in rest)
+
+
+def _unwrap_optional(tp: Any) -> Any:
+    if get_origin(tp) is typing.Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def _parse_value(tp: Any, value: Any) -> Any:
+    if value is None:
+        return None
+    tp = _unwrap_optional(tp)
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        (item_tp,) = get_args(tp) or (Any,)
+        return [_parse_value(item_tp, v) for v in value]
+    if origin is dict:
+        args = get_args(tp)
+        val_tp = args[1] if len(args) == 2 else Any
+        return {k: _parse_value(val_tp, v) for k, v in value.items()}
+    if isinstance(tp, type):
+        if issubclass(tp, SpecBase):
+            return tp.from_dict(value)
+        if issubclass(tp, enum.Enum):
+            return tp(value)
+        if tp is float and isinstance(value, (int, float)):
+            return float(value)
+        if tp is int and isinstance(value, (int, float)) and not isinstance(value, bool):
+            return int(value)
+    return value
+
+
+def _dump_value(value: Any) -> Any:
+    if isinstance(value, SpecBase):
+        return value.to_dict()
+    if isinstance(value, enum.Enum):
+        return value.value
+    if isinstance(value, (list, tuple)):
+        return [_dump_value(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _dump_value(v) for k, v in value.items()}
+    return value
+
+
+@dataclasses.dataclass
+class SpecBase:
+    """Base for all spec/policy dataclasses; see module docstring."""
+
+    @classmethod
+    def from_dict(cls: Type[T], d: Optional[dict[str, Any]]) -> Optional[T]:
+        if d is None:
+            return None
+        if isinstance(d, cls):
+            return d
+        hints = get_type_hints(cls)
+        kwargs: dict[str, Any] = {}
+        for f in dataclasses.fields(cls):
+            key = snake_to_camel(f.name)
+            if key in d:
+                kwargs[f.name] = _parse_value(hints.get(f.name, Any), d[key])
+            elif f.name in d:  # tolerate snake_case input
+                kwargs[f.name] = _parse_value(hints.get(f.name, Any), d[f.name])
+        return cls(**kwargs)
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for f in dataclasses.fields(self):
+            value = getattr(self, f.name)
+            if value is None:
+                continue
+            # Sparse output: collection-typed fields (default_factory) omit
+            # their empty default. Optional fields keep empty containers —
+            # for runtime state (e.g. a step output of {}) empty-vs-absent
+            # is meaningful and must survive the round-trip.
+            if (
+                f.default is dataclasses.MISSING
+                and isinstance(value, (list, dict, tuple))
+                and not value
+            ):
+                continue
+            out[snake_to_camel(f.name)] = _dump_value(value)
+        return out

@@ -1,0 +1,178 @@
+"""Story: the workflow definition — a DAG of steps.
+
+Capability parity with the reference Story CRD
+(reference: api/v1alpha1/story_types.go:40-437): steps/compensations/
+finally DAGs, hierarchical policy, declared transports, output template,
+input/output schemas, batch vs realtime pattern.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ..core.object import Resource, new_resource
+from .enums import StepType, StoryPattern
+from .refs import EngramRef
+from .shared import (
+    ExecutionOverrides,
+    ExecutionPolicy,
+    RetryPolicy,
+    SpecBase,
+    StoragePolicy,
+    TPUPolicy,
+)
+
+KIND = "Story"
+
+
+@dataclasses.dataclass
+class PostExecutionCheck(SpecBase):
+    """Output assertion evaluated after a step succeeds
+    (reference: story_types.go:293-297)."""
+
+    condition: str = ""
+    failure_message: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Step(SpecBase):
+    """One node of the DAG (reference: story_types.go:156-283).
+
+    Exactly one of ``ref`` (engram step) or ``type`` (primitive) must be
+    set — enforced by admission. ``with_`` is the config payload
+    (primitive args or engram config; templated).
+    """
+
+    name: str = ""
+    id: Optional[str] = None
+    needs: list[str] = dataclasses.field(default_factory=list)
+    type: Optional[StepType] = None
+    if_: Optional[str] = None
+    allow_failure: Optional[bool] = None
+    side_effects: Optional[bool] = None
+    requires: list[str] = dataclasses.field(default_factory=list)
+    idempotency_key_template: Optional[str] = None
+    ref: Optional[EngramRef] = None
+    with_: Optional[dict[str, Any]] = None
+    runtime: Optional[dict[str, Any]] = None
+    transport: Optional[str] = None
+    secrets: dict[str, str] = dataclasses.field(default_factory=dict)
+    execution: Optional[ExecutionOverrides] = None
+    post_execution: Optional[PostExecutionCheck] = None
+    tpu: Optional[TPUPolicy] = None  # TPU-native addition (slice placement)
+
+    # NOTE: trailing-underscore fields (if_, with_) serialize as the bare
+    # keyword automatically: snake_to_camel("if_") == "if".
+
+    @property
+    def is_primitive(self) -> bool:
+        return self.type is not None
+
+
+@dataclasses.dataclass
+class StoryTimeouts(SpecBase):
+    """(reference: story_types.go:303-338 StoryTimeouts)"""
+
+    story: Optional[str] = None
+    step: Optional[str] = None
+    graceful_shutdown_timeout: Optional[str] = None
+
+
+@dataclasses.dataclass
+class StoryRetries(SpecBase):
+    step_retry_policy: Optional[RetryPolicy] = None
+    continue_on_step_failure: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class RealtimeConcurrency(SpecBase):
+    """(reference: story_types.go:80-84)"""
+
+    mode: Optional[str] = None
+    scope: Optional[str] = None
+
+
+@dataclasses.dataclass
+class StoryPolicy(SpecBase):
+    """Story-level policy (reference: story_types.go:301-352)."""
+
+    timeouts: Optional[StoryTimeouts] = None
+    with_defaults: Optional[dict[str, Any]] = None
+    retries: Optional[StoryRetries] = None
+    concurrency: Optional[int] = None
+    queue: Optional[str] = None
+    priority: Optional[int] = None
+    storage: Optional[StoragePolicy] = None
+    execution: Optional[ExecutionPolicy] = None
+
+    @classmethod
+    def from_dict(cls, d):
+        if d is None:
+            return None
+        d = dict(d)
+        if "with" in d:
+            d["withDefaults"] = d.pop("with")
+        return super().from_dict(d)
+
+    def to_dict(self) -> dict[str, Any]:
+        out = super().to_dict()
+        if "withDefaults" in out:
+            out["with"] = out.pop("withDefaults")
+        return out
+
+
+@dataclasses.dataclass
+class StoryTransport(SpecBase):
+    """Transport declared for use by the story's streaming steps
+    (reference: story_types.go:408-421)."""
+
+    name: str = ""
+    transport_ref: str = ""
+    description: Optional[str] = None
+    streaming: Optional[dict[str, Any]] = None
+    settings: Optional[dict[str, Any]] = None
+
+
+@dataclasses.dataclass
+class StorySpec(SpecBase):
+    """(reference: story_types.go:90-151)"""
+
+    steps: list[Step] = dataclasses.field(default_factory=list)
+    compensations: list[Step] = dataclasses.field(default_factory=list)
+    finally_: list[Step] = dataclasses.field(default_factory=list)
+    policy: Optional[StoryPolicy] = None
+    transports: list[StoryTransport] = dataclasses.field(default_factory=list)
+    pattern: Optional[StoryPattern] = None
+    version: Optional[str] = None
+    concurrency: Optional[RealtimeConcurrency] = None
+    inputs_schema: Optional[dict[str, Any]] = None
+    outputs_schema: Optional[dict[str, Any]] = None
+    output: Optional[dict[str, Any]] = None
+
+    @property
+    def effective_pattern(self) -> StoryPattern:
+        return self.pattern or StoryPattern.BATCH
+
+    def step(self, name: str) -> Optional[Step]:
+        for s in self.steps:
+            if s.name == name:
+                return s
+        return None
+
+    def all_steps(self) -> list[Step]:
+        return [*self.steps, *self.compensations, *self.finally_]
+
+
+def parse_story(resource: Resource) -> StorySpec:
+    return StorySpec.from_dict(resource.spec)
+
+
+def make_story(
+    name: str,
+    steps: list[dict[str, Any]],
+    namespace: str = "default",
+    **spec_fields: Any,
+) -> Resource:
+    spec = {"steps": steps, **spec_fields}
+    return new_resource(KIND, name, namespace, spec)

@@ -1,0 +1,285 @@
+"""Transport kinds: Transport, TransportBinding + the streaming policy language.
+
+Capability parity with the reference transport API group
+(reference: api/transport/v1alpha1/ — TransportSpec transport_types.go:11,
+TransportBindingSpec transportbinding_types.go:108, and the full
+TransportStreamingSettings policy language
+transport_settings_types.go:21-528: backpressure, buffers, flow-control
+credits, delivery semantics, replay, ordering, lanes, fan-in, routing +
+fan-out + hub/p2p modes, partitioning, lifecycle/upgrade, watermarks,
+recording, observability toggles).
+
+TPU-native addition: an ``ici`` driver kind whose negotiated "codec" is a
+device-mesh/topology descriptor — intra-slice streams ride ICI while DCN
+gRPC carries inter-slice hops (SURVEY §2.6 TransportBinding row).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ..core.object import Resource, new_resource
+from .refs import StoryRunRef
+from .specbase import SpecBase
+
+TRANSPORT_KIND = "Transport"
+TRANSPORT_BINDING_KIND = "TransportBinding"
+
+#: Driver kinds the control plane understands.
+DRIVER_GRPC = "grpc"
+DRIVER_ICI = "ici"  # TPU-native: intra-slice interconnect descriptor
+
+
+# ---------------------------------------------------------------------------
+# Streaming settings policy language
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TransportBufferSettings(SpecBase):
+    """(reference: transport_settings_types.go:207-221)"""
+
+    max_messages: Optional[int] = None
+    max_bytes: Optional[int] = None
+    max_age_seconds: Optional[int] = None
+    drop_policy: Optional[str] = None  # dropOldest | dropNewest | block
+
+
+@dataclasses.dataclass
+class TransportBackpressureSettings(SpecBase):
+    buffer: Optional[TransportBufferSettings] = None
+
+
+@dataclasses.dataclass
+class TransportFlowCredits(SpecBase):
+    messages: Optional[int] = None
+    bytes: Optional[int] = None
+
+
+@dataclasses.dataclass
+class TransportFlowAckSettings(SpecBase):
+    messages: Optional[int] = None
+    bytes: Optional[int] = None
+    max_delay: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TransportFlowThreshold(SpecBase):
+    buffer_pct: Optional[int] = None
+
+
+@dataclasses.dataclass
+class TransportFlowControlSettings(SpecBase):
+    """Credit-based flow control (reference: transport_settings_types.go:228-283)."""
+
+    mode: Optional[str] = None  # none | credits
+    initial_credits: Optional[TransportFlowCredits] = None
+    ack_every: Optional[TransportFlowAckSettings] = None
+    pause_threshold: Optional[TransportFlowThreshold] = None
+    resume_threshold: Optional[TransportFlowThreshold] = None
+
+
+@dataclasses.dataclass
+class TransportReplaySettings(SpecBase):
+    mode: Optional[str] = None  # none | fromCheckpoint | full
+    retention_seconds: Optional[int] = None
+    checkpoint_interval: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TransportDeliverySettings(SpecBase):
+    """(reference: transport_settings_types.go:290-314)"""
+
+    ordering: Optional[str] = None  # none | perKey | total
+    semantics: Optional[str] = None  # atMostOnce | atLeastOnce
+    replay: Optional[TransportReplaySettings] = None
+
+
+@dataclasses.dataclass
+class TransportRoutingRuleTarget(SpecBase):
+    steps: list[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class TransportRoutingRule(SpecBase):
+    name: Optional[str] = None
+    when: Optional[str] = None
+    action: Optional[str] = None  # route | drop | duplicate
+    target: Optional[TransportRoutingRuleTarget] = None
+
+
+@dataclasses.dataclass
+class TransportRoutingSettings(SpecBase):
+    """(reference: transport_settings_types.go:375-388)"""
+
+    mode: Optional[str] = None  # auto | hub | p2p
+    fan_out: Optional[str] = None  # all | first | roundRobin
+    max_downstreams: Optional[int] = None
+    rules: list[TransportRoutingRule] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class TransportLane(SpecBase):
+    """(reference: transport_settings_types.go:138-160)"""
+
+    name: str = ""
+    kind: Optional[str] = None  # data | control | media
+    direction: Optional[str] = None  # upstream | downstream | both
+    description: Optional[str] = None
+    max_messages: Optional[int] = None
+    max_bytes: Optional[int] = None
+
+
+@dataclasses.dataclass
+class TransportFanInSettings(SpecBase):
+    """(reference: transport_settings_types.go:177-199)"""
+
+    mode: Optional[str] = None  # merge | zip | quorum
+    quorum: Optional[int] = None
+    timeout_seconds: Optional[int] = None
+    max_entries: Optional[int] = None
+    buffer: Optional[TransportBufferSettings] = None
+
+
+@dataclasses.dataclass
+class TransportPartitioningSettings(SpecBase):
+    """(reference: transport_settings_types.go:405-418)"""
+
+    mode: Optional[str] = None  # none | keyHash | roundRobin
+    key: Optional[str] = None
+    partitions: Optional[int] = None
+    sticky: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class TransportLifecycleSettings(SpecBase):
+    """Upgrade/handoff policy (reference: transport_settings_types.go:435-445)."""
+
+    strategy: Optional[str] = None  # drain | cutover
+    drain_timeout_seconds: Optional[int] = None
+    max_in_flight: Optional[int] = None
+
+
+@dataclasses.dataclass
+class TransportMetricsSettings(SpecBase):
+    enabled: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class TransportTracingSettings(SpecBase):
+    enabled: Optional[bool] = None
+    sample_rate: Optional[int] = None
+    sample_policy: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TransportWatermarkSettings(SpecBase):
+    enabled: Optional[bool] = None
+    timestamp_source: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TransportObservabilitySettings(SpecBase):
+    metrics: Optional[TransportMetricsSettings] = None
+    tracing: Optional[TransportTracingSettings] = None
+    watermark: Optional[TransportWatermarkSettings] = None
+
+
+@dataclasses.dataclass
+class TransportRecordingSettings(SpecBase):
+    mode: Optional[str] = None  # none | sample | full
+    sample_rate: Optional[int] = None
+    retention_seconds: Optional[int] = None
+    redact_fields: list[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class TransportStreamingSettings(SpecBase):
+    """The full streaming policy language
+    (reference: transport_settings_types.go:68-107)."""
+
+    backpressure: Optional[TransportBackpressureSettings] = None
+    flow_control: Optional[TransportFlowControlSettings] = None
+    delivery: Optional[TransportDeliverySettings] = None
+    routing: Optional[TransportRoutingSettings] = None
+    lanes: list[TransportLane] = dataclasses.field(default_factory=list)
+    fan_in: Optional[TransportFanInSettings] = None
+    partitioning: Optional[TransportPartitioningSettings] = None
+    lifecycle: Optional[TransportLifecycleSettings] = None
+    observability: Optional[TransportObservabilitySettings] = None
+    recording: Optional[TransportRecordingSettings] = None
+
+
+# ---------------------------------------------------------------------------
+# Transport / TransportBinding kinds
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MediaCodec(SpecBase):
+    """(reference: transportbinding_types.go:44-64)"""
+
+    name: str = ""
+    sample_rate_hz: Optional[int] = None
+    channels: Optional[int] = None
+    profile: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TransportSpec(SpecBase):
+    """(reference: transport_types.go:11-48)"""
+
+    provider: str = ""
+    driver: str = DRIVER_GRPC
+    connector_image: Optional[str] = None
+    supported_audio: list[MediaCodec] = dataclasses.field(default_factory=list)
+    supported_video: list[MediaCodec] = dataclasses.field(default_factory=list)
+    supported_binary: list[str] = dataclasses.field(default_factory=list)
+    streaming: Optional[TransportStreamingSettings] = None
+    config_schema: Optional[dict[str, Any]] = None
+    default_settings: Optional[dict[str, Any]] = None
+    # TPU-native (driver == "ici"): mesh descriptor this transport carries.
+    mesh_topology: Optional[str] = None
+
+
+@dataclasses.dataclass
+class MediaBinding(SpecBase):
+    """Offered codecs for one media kind
+    (reference: transportbinding_types.go:71-104)."""
+
+    direction: Optional[str] = None  # send | receive | both
+    codecs: list[MediaCodec] = dataclasses.field(default_factory=list)
+    mime_types: list[str] = dataclasses.field(default_factory=list)
+    raw: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class TransportBindingSpec(SpecBase):
+    """Per-run per-step stream binding
+    (reference: transportbinding_types.go:108-151)."""
+
+    transport_ref: str = ""
+    story_run_ref: Optional[StoryRunRef] = None
+    step_name: str = ""
+    engram_name: str = ""
+    driver: str = DRIVER_GRPC
+    audio: Optional[MediaBinding] = None
+    video: Optional[MediaBinding] = None
+    binary: Optional[MediaBinding] = None
+    connector_endpoint: Optional[str] = None
+    raw_settings: Optional[dict[str, Any]] = None
+
+
+def parse_transport(resource: Resource) -> TransportSpec:
+    return TransportSpec.from_dict(resource.spec)
+
+
+def parse_transport_binding(resource: Resource) -> TransportBindingSpec:
+    return TransportBindingSpec.from_dict(resource.spec)
+
+
+def make_transport(name: str, provider: str, namespace: str = "default", **spec_fields: Any) -> Resource:
+    return new_resource(
+        TRANSPORT_KIND, name, namespace, {"provider": provider, **spec_fields}
+    )

@@ -59,6 +59,7 @@ class EventRecorder:
                     and ev.type == type
                     and ev.reason == reason
                     and ev.message == message
+                    and ev.labels == dict(labels or {})
                     and now - ev.last_seen < self._dedup_window
                 ):
                     ev.count += 1

@@ -136,38 +136,54 @@ class ResourceStore:
 
         return cancel
 
-    def _emit(self, events: list[WatchEvent]) -> None:
-        """Deliver events outside the lock, in commit order, isolating
-        handler failures (the per-object ordering + panic isolation that
-        controller-runtime informers guarantee).
+    def _enqueue_locked(self, events: list[WatchEvent]) -> None:
+        """Append committed events to the delivery FIFO.
 
-        A single drainer at a time pulls from a store-wide FIFO: a writer
-        that commits while another thread is draining appends and returns,
-        so delivery order always matches commit order.
+        MUST be called while holding the store lock, inside the same
+        critical section as the commit itself — that is what makes the
+        FIFO order identical to commit order even with many writers.
+        """
+        self._pending_events.extend(events)
+
+    def _drain(self) -> None:
+        """Deliver queued events outside the lock, in commit order,
+        isolating handler failures (the per-object ordering + panic
+        isolation that controller-runtime informers guarantee).
+
+        A single drainer at a time pulls from the store-wide FIFO: a
+        writer that commits while another thread is draining returns
+        immediately and the active drainer picks its events up.
         """
         with self._lock:
-            self._pending_events.extend(events)
             if self._draining:
                 return
             self._draining = True
-        while True:
+        try:
+            while True:
+                with self._lock:
+                    if not self._pending_events:
+                        return
+                    ev = self._pending_events.popleft()
+                    watchers = list(self._watchers)
+                # One copy per event, made outside the lock; committed
+                # objects are immutable so this is safe.
+                payload = WatchEvent(ev.type, ev.resource.deepcopy())
+                for kinds, handler in watchers:
+                    if kinds is None or ev.resource.kind in kinds:
+                        try:
+                            handler(payload)
+                        except Exception:  # noqa: BLE001 - watcher bugs must not poison the bus
+                            _log.exception(
+                                "watch handler failed for %s %s/%s",
+                                ev.resource.kind,
+                                ev.resource.namespace,
+                                ev.resource.name,
+                            )
+        finally:
+            # Even a BaseException from a handler (SystemExit, KeyboardInterrupt)
+            # must not wedge delivery forever.
             with self._lock:
-                if not self._pending_events:
-                    self._draining = False
-                    return
-                ev = self._pending_events.popleft()
-                watchers = list(self._watchers)
-            for kinds, handler in watchers:
-                if kinds is None or ev.resource.kind in kinds:
-                    try:
-                        handler(ev)
-                    except Exception:  # noqa: BLE001 - watcher bugs must not poison the bus
-                        _log.exception(
-                            "watch handler failed for %s %s/%s",
-                            ev.resource.kind,
-                            ev.resource.namespace,
-                            ev.resource.name,
-                        )
+                self._draining = False
 
     # -- reads -------------------------------------------------------------
     def get(self, kind: str, namespace: str, name: str) -> Resource:
@@ -232,9 +248,9 @@ class ResourceStore:
             new.meta.creation_timestamp = new.meta.creation_timestamp or now()
             self._objects[key] = new
             self._persist(new)
-            stored = new.deepcopy()
-        self._emit([WatchEvent(ADDED, stored.deepcopy())])
-        return stored
+            self._enqueue_locked([WatchEvent(ADDED, new)])
+        self._drain()
+        return new.deepcopy()
 
     def update(self, obj: Resource) -> Resource:
         """Full update (spec + metadata). Requires fresh resourceVersion."""
@@ -272,16 +288,16 @@ class ResourceStore:
             new.meta.resource_version = self._rv_counter
             self._objects[key] = new
 
-            events = [WatchEvent(MODIFIED, new.deepcopy())]
+            events = [WatchEvent(MODIFIED, new)]
             # Finalizer-parked object whose last finalizer was just removed
             # completes its deletion now.
             if new.meta.deletion_timestamp is not None and not new.meta.finalizers:
                 events = self._remove_locked(key, collect=[])
             else:
                 self._persist(new)
-            result = new.deepcopy()
-        self._emit(events)
-        return result
+            self._enqueue_locked(events)
+        self._drain()
+        return new.deepcopy()
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         """Delete; parks with deletionTimestamp while finalizers remain."""
@@ -298,12 +314,13 @@ class ResourceStore:
                     cur.meta.resource_version = self._rv_counter
                     self._objects[key] = cur
                     self._persist(cur)
-                    events = [WatchEvent(MODIFIED, cur.deepcopy())]
+                    events = [WatchEvent(MODIFIED, cur)]
                 else:
                     events = []
             else:
                 events = self._remove_locked(key, collect=[])
-        self._emit(events)
+            self._enqueue_locked(events)
+        self._drain()
 
     def _remove_locked(self, key: tuple[str, str, str], collect: list[WatchEvent]) -> list[WatchEvent]:
         """Remove an object and cascade to owned children (k8s GC role)."""
@@ -311,7 +328,7 @@ class ResourceStore:
         if obj is None:
             return collect
         self._unpersist(obj)
-        collect.append(WatchEvent(DELETED, obj.deepcopy()))
+        collect.append(WatchEvent(DELETED, obj))
         owned = [
             child.key
             for child in self._objects.values()
@@ -329,7 +346,7 @@ class ResourceStore:
                     child.meta.resource_version = self._rv_counter
                     self._objects[child_key] = child
                     self._persist(child)
-                    collect.append(WatchEvent(MODIFIED, child.deepcopy()))
+                    collect.append(WatchEvent(MODIFIED, child))
             else:
                 self._remove_locked(child_key, collect)
         return collect

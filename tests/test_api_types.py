@@ -1,0 +1,260 @@
+"""API type layer: dict <-> dataclass roundtrips for the 12 kinds."""
+
+from bobrapet_tpu.api.catalog import EngramTemplateSpec, make_engram_template
+from bobrapet_tpu.api.engram import EngramSpec
+from bobrapet_tpu.api.enums import (
+    AcceleratorType,
+    BackoffStrategy,
+    Phase,
+    StepType,
+    StoryPattern,
+    WorkloadMode,
+)
+from bobrapet_tpu.api.policy import grant_allows, make_reference_grant, reference_granted
+from bobrapet_tpu.api.runs import (
+    StepRunSpec,
+    StepState,
+    StoryRunSpec,
+    StoryTriggerSpec,
+    get_step_states,
+    make_storyrun,
+    set_step_state,
+)
+from bobrapet_tpu.api.shared import RetryPolicy, StoragePolicy, TPUPolicy
+from bobrapet_tpu.api.story import Step, StorySpec, make_story
+from bobrapet_tpu.api.transport import (
+    TransportBindingSpec,
+    TransportSpec,
+    TransportStreamingSettings,
+)
+from bobrapet_tpu.core import ResourceStore
+
+
+class TestStory:
+    def test_step_keyword_fields(self):
+        d = {
+            "name": "gen",
+            "needs": ["embed"],
+            "if": "{{ steps.embed.output.ok }}",
+            "with": {"prompt": "hi"},
+            "ref": {"name": "llama"},
+        }
+        step = Step.from_dict(d)
+        assert step.if_ == "{{ steps.embed.output.ok }}"
+        assert step.with_ == {"prompt": "hi"}
+        assert step.ref.name == "llama"
+        out = step.to_dict()
+        assert out["if"] == d["if"] and out["with"] == d["with"]
+        assert "if_" not in out and "with_" not in out
+
+    def test_primitive_step(self):
+        step = Step.from_dict({"name": "pause", "type": "sleep", "with": {"duration": "5s"}})
+        assert step.type is StepType.SLEEP and step.is_primitive
+
+    def test_story_spec_roundtrip(self):
+        spec = StorySpec.from_dict(
+            {
+                "pattern": "batch",
+                "steps": [{"name": "a"}, {"name": "b", "needs": ["a"]}],
+                "finally": [{"name": "cleanup", "ref": {"name": "cleaner"}}],
+                "policy": {
+                    "concurrency": 3,
+                    "queue": "tpu-v5e",
+                    "priority": 10,
+                    "timeouts": {"story": "1h", "step": "10m"},
+                    "with": {"env": "prod"},
+                },
+                "output": {"result": "{{ steps.b.output }}"},
+            }
+        )
+        assert spec.effective_pattern is StoryPattern.BATCH
+        assert [s.name for s in spec.steps] == ["a", "b"]
+        assert spec.finally_[0].name == "cleanup"
+        assert spec.policy.queue == "tpu-v5e"
+        assert spec.policy.with_defaults == {"env": "prod"}
+        out = spec.to_dict()
+        assert out["finally"][0]["name"] == "cleanup"
+        assert out["policy"]["with"] == {"env": "prod"}
+        # full roundtrip is stable
+        assert StorySpec.from_dict(out).to_dict() == out
+
+    def test_tpu_policy(self):
+        step = Step.from_dict(
+            {
+                "name": "train",
+                "ref": {"name": "trainer"},
+                "tpu": {
+                    "accelerator": "tpu-v5-lite-podslice",
+                    "topology": "4x4",
+                    "iciContiguous": True,
+                    "meshAxes": {"data": 2, "tensor": 8},
+                },
+            }
+        )
+        assert step.tpu.accelerator is AcceleratorType.TPU_V5E
+        assert step.tpu.chip_count() == 16
+        assert step.tpu.mesh_axes == {"data": 2, "tensor": 8}
+
+    def test_make_story(self):
+        r = make_story("rag", steps=[{"name": "a", "ref": {"name": "x"}}])
+        assert r.kind == "Story" and r.spec["steps"][0]["name"] == "a"
+
+
+class TestSharedPolicies:
+    def test_retry_policy_enum_coercion(self):
+        rp = RetryPolicy.from_dict({"maxRetries": 3, "delay": "2s", "backoff": "exponential", "jitter": 20})
+        assert rp.backoff is BackoffStrategy.EXPONENTIAL
+        assert rp.to_dict() == {"maxRetries": 3, "delay": "2s", "backoff": "exponential", "jitter": 20}
+
+    def test_storage_policy_providers(self):
+        sp = StoragePolicy.from_dict(
+            {
+                "s3": {"bucket": "b", "endpoint": "http://minio", "usePathStyle": True},
+                "sliceLocalSsd": {"path": "/mnt/ssd0", "maxBytes": 1 << 30},
+                "maxInlineSize": 4096,
+            }
+        )
+        assert sp.s3.bucket == "b" and sp.s3.use_path_style
+        assert sp.slice_local_ssd.path == "/mnt/ssd0"
+        assert sp.max_inline_size == 4096
+
+    def test_unknown_keys_ignored(self):
+        rp = RetryPolicy.from_dict({"maxRetries": 1, "futureKnob": "x"})
+        assert rp.max_retries == 1
+
+
+class TestRuns:
+    def test_storyrun_spec(self):
+        spec = StoryRunSpec.from_dict(
+            {"storyRef": {"name": "rag", "version": "v2"}, "inputs": {"q": "hi"}}
+        )
+        assert spec.story_ref.name == "rag" and spec.story_ref.version == "v2"
+
+    def test_steprun_spec_with_slice_grant(self):
+        spec = StepRunSpec.from_dict(
+            {
+                "storyRunRef": {"name": "run1"},
+                "stepId": "train",
+                "engramRef": {"name": "trainer"},
+                "input": {"x": 1},
+                "retry": {"maxRetries": 2},
+                "sliceGrant": {"topology": "2x4", "meshAxes": {"data": 8}},
+            }
+        )
+        assert spec.retry.max_retries == 2
+        assert spec.slice_grant["topology"] == "2x4"
+
+    def test_empty_output_survives_roundtrip(self):
+        from bobrapet_tpu.api.runs import StepState
+
+        s = StepState(phase=Phase.SUCCEEDED, output={})
+        assert StepState.from_dict(s.to_dict()).output == {}
+        s2 = StepState(phase=Phase.SUCCEEDED, output=[])
+        assert StepState.from_dict(s2.to_dict()).output == []
+
+    def test_step_state_helpers(self):
+        run = make_storyrun("r1", "rag")
+        set_step_state(run, "embed", StepState(phase=Phase.RUNNING, started_at=1.0))
+        states = get_step_states(run)
+        assert states["embed"].effective_phase is Phase.RUNNING
+        assert not states["embed"].is_terminal
+
+    def test_trigger_identity(self):
+        spec = StoryTriggerSpec.from_dict(
+            {
+                "storyRef": {"name": "rag"},
+                "identity": {"mode": "keyAndInputHash", "key": "evt-1", "inputHash": "abc"},
+            }
+        )
+        assert spec.identity.mode == "keyAndInputHash"
+
+
+class TestCatalog:
+    def test_template_mode_support(self):
+        spec = EngramTemplateSpec.from_dict(
+            {
+                "image": "gcr.io/x/llama:1",
+                "entrypoint": "my.pkg:run",
+                "supportedModes": ["job", "deployment"],
+                "declaredOutputKeys": ["text"],
+            }
+        )
+        assert spec.supports_mode(WorkloadMode.JOB)
+        assert not spec.supports_mode(WorkloadMode.STATEFULSET)
+        assert spec.entrypoint == "my.pkg:run"
+
+    def test_cluster_scoped(self):
+        r = make_engram_template("llama", image="img")
+        assert r.namespace == "_cluster"
+
+
+class TestTransport:
+    def test_streaming_settings_roundtrip(self):
+        s = TransportStreamingSettings.from_dict(
+            {
+                "backpressure": {"buffer": {"maxMessages": 100, "dropPolicy": "dropOldest"}},
+                "flowControl": {"mode": "credits", "initialCredits": {"messages": 32}},
+                "delivery": {"semantics": "atLeastOnce", "ordering": "perKey"},
+                "routing": {"mode": "auto", "maxDownstreams": 8},
+                "lanes": [{"name": "ctl", "kind": "control", "direction": "both"}],
+                "partitioning": {"mode": "keyHash", "partitions": 4},
+                "lifecycle": {"strategy": "drain", "drainTimeoutSeconds": 30},
+            }
+        )
+        assert s.flow_control.initial_credits.messages == 32
+        assert s.lanes[0].kind == "control"
+        out = s.to_dict()
+        assert TransportStreamingSettings.from_dict(out).to_dict() == out
+
+    def test_ici_transport(self):
+        t = TransportSpec.from_dict(
+            {"provider": "tpu", "driver": "ici", "meshTopology": "2x4"}
+        )
+        assert t.driver == "ici" and t.mesh_topology == "2x4"
+
+    def test_binding(self):
+        b = TransportBindingSpec.from_dict(
+            {
+                "transportRef": "bobravoz",
+                "storyRunRef": {"name": "r1"},
+                "stepName": "gen",
+                "engramName": "llama",
+                "driver": "grpc",
+                "audio": {"direction": "both", "codecs": [{"name": "opus", "sampleRateHz": 48000}]},
+            }
+        )
+        assert b.audio.codecs[0].name == "opus"
+
+
+class TestReferenceGrant:
+    def test_grant_evaluation(self):
+        g = make_reference_grant(
+            "allow-runs",
+            "prod",
+            from_=[{"kind": "StoryRun", "namespace": "dev"}],
+            to=[{"kind": "Story"}],
+        )
+        assert grant_allows(g, "StoryRun", "dev", "Story", "rag")
+        assert not grant_allows(g, "StoryRun", "other", "Story", "rag")
+        assert not grant_allows(g, "StoryRun", "dev", "Engram", "x")
+
+    def test_reference_granted_same_ns_always(self):
+        store = ResourceStore()
+        assert reference_granted(store, "StoryRun", "ns1", "Story", "ns1", "s")
+        assert not reference_granted(store, "StoryRun", "ns1", "Story", "ns2", "s")
+        store.create(
+            make_reference_grant(
+                "g", "ns2", from_=[{"kind": "StoryRun", "namespace": "ns1"}], to=[{"kind": "Story"}]
+            )
+        )
+        assert reference_granted(store, "StoryRun", "ns1", "Story", "ns2", "s")
+
+
+class TestEngramImpulse:
+    def test_engram_with_alias(self):
+        e = EngramSpec.from_dict(
+            {"templateRef": {"name": "llama"}, "mode": "job", "with": {"model": "8b"}}
+        )
+        assert e.with_config == {"model": "8b"}
+        assert e.to_dict()["with"] == {"model": "8b"}
+        assert e.mode is WorkloadMode.JOB
