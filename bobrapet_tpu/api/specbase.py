@@ -53,7 +53,13 @@ def _parse_value(tp: Any, value: Any) -> Any:
         if issubclass(tp, SpecBase):
             return tp.from_dict(value)
         if issubclass(tp, enum.Enum):
-            return tp(value)
+            # Forward-compatible: an unrecognized enum string (written by
+            # a newer vocabulary) parses to the raw string rather than
+            # crashing the reconciler reading persisted state.
+            try:
+                return tp(value)
+            except ValueError:
+                return value
         if tp is float and isinstance(value, (int, float)):
             return float(value)
         if tp is int and isinstance(value, (int, float)) and not isinstance(value, bool):

@@ -101,6 +101,9 @@ class ResourceStore:
         self._rv_counter = 0
         self._watchers: list[tuple[Optional[frozenset[str]], WatchHandler]] = []
         self._indexes: dict[tuple[str, str], IndexFn] = {}
+        # (kind, index_name) -> value -> set of object keys; maintained at
+        # commit time so index lookups are O(bucket), not O(all of kind)
+        self._index_buckets: dict[tuple[str, str], dict[str, set[tuple[str, str, str]]]] = {}
         self._defaulters: dict[str, list[Defaulter]] = {}
         self._validators: dict[str, list[Validator]] = {}
         self._pending_events: deque[WatchEvent] = deque()
@@ -119,8 +122,38 @@ class ResourceStore:
 
     # -- index registration ------------------------------------------------
     def add_index(self, kind: str, index_name: str, fn: IndexFn) -> None:
-        """Idempotent index registration (reference: setup/indexing.go:60)."""
-        self._indexes.setdefault((kind, index_name), fn)
+        """Idempotent index registration; backfills existing objects
+        (reference: setup/indexing.go:60)."""
+        with self._lock:
+            if (kind, index_name) in self._indexes:
+                return
+            self._indexes[(kind, index_name)] = fn
+            bucket = self._index_buckets.setdefault((kind, index_name), {})
+            for key, obj in self._objects.items():
+                if key[0] != kind:
+                    continue
+                for value in fn(obj):
+                    bucket.setdefault(value, set()).add(key)
+
+    def _index_add_locked(self, obj: Resource) -> None:
+        for (kind, index_name), fn in self._indexes.items():
+            if kind != obj.kind:
+                continue
+            bucket = self._index_buckets[(kind, index_name)]
+            for value in fn(obj):
+                bucket.setdefault(value, set()).add(obj.key)
+
+    def _index_remove_locked(self, obj: Resource) -> None:
+        for (kind, index_name), fn in self._indexes.items():
+            if kind != obj.kind:
+                continue
+            bucket = self._index_buckets[(kind, index_name)]
+            for value in fn(obj):
+                keys = bucket.get(value)
+                if keys is not None:
+                    keys.discard(obj.key)
+                    if not keys:
+                        bucket.pop(value, None)
 
     # -- watch -------------------------------------------------------------
     def watch(self, handler: WatchHandler, kinds: Optional[Iterable[str]] = None) -> Callable[[], None]:
@@ -162,6 +195,11 @@ class ResourceStore:
             while True:
                 with self._lock:
                     if not self._pending_events:
+                        # Clearing the flag MUST be atomic with the
+                        # empty-queue check: a writer that enqueues after
+                        # this critical section will see _draining False
+                        # and start its own drain, so no event strands.
+                        self._draining = False
                         return
                     ev = self._pending_events.popleft()
                     watchers = list(self._watchers)
@@ -179,11 +217,13 @@ class ResourceStore:
                                 ev.resource.namespace,
                                 ev.resource.name,
                             )
-        finally:
-            # Even a BaseException from a handler (SystemExit, KeyboardInterrupt)
-            # must not wedge delivery forever.
+        except BaseException:
+            # SystemExit/KeyboardInterrupt out of a handler: release the
+            # drainer role so later writes resume delivery of anything
+            # still pending, then propagate.
             with self._lock:
                 self._draining = False
+            raise
 
     # -- reads -------------------------------------------------------------
     def get(self, kind: str, namespace: str, name: str) -> Resource:
@@ -212,17 +252,19 @@ class ResourceStore:
         """List by kind, optionally filtered by namespace/labels/index value."""
         with self._lock:
             picked = []
-            index_fn = self._indexes.get((kind, index[0])) if index else None
-            if index and index_fn is None:
-                raise StoreError(f"unknown index {index[0]!r} for kind {kind}")
-            for (k, ns, _), obj in self._objects.items():
-                if k != kind:
+            if index is not None:
+                if (kind, index[0]) not in self._indexes:
+                    raise StoreError(f"unknown index {index[0]!r} for kind {kind}")
+                keys = self._index_buckets[(kind, index[0])].get(index[1], set())
+                candidates = [self._objects[k] for k in keys if k in self._objects]
+            else:
+                candidates = [o for (k, _, _), o in self._objects.items() if k == kind]
+            for obj in candidates:
+                if obj.kind != kind:
                     continue
-                if namespace is not None and ns != namespace:
+                if namespace is not None and obj.meta.namespace != namespace:
                     continue
                 if labels and any(obj.meta.labels.get(lk) != lv for lk, lv in labels.items()):
-                    continue
-                if index_fn is not None and index[1] not in index_fn(obj):
                     continue
                 picked.append(obj)
         out = [obj.deepcopy() for obj in picked]
@@ -247,6 +289,7 @@ class ResourceStore:
             new.meta.generation = 1
             new.meta.creation_timestamp = new.meta.creation_timestamp or now()
             self._objects[key] = new
+            self._index_add_locked(new)
             self._persist(new)
             self._enqueue_locked([WatchEvent(ADDED, new)])
         self._drain()
@@ -286,7 +329,9 @@ class ResourceStore:
                     new.meta.generation = cur.meta.generation + 1
             self._rv_counter += 1
             new.meta.resource_version = self._rv_counter
+            self._index_remove_locked(cur)
             self._objects[key] = new
+            self._index_add_locked(new)
 
             events = [WatchEvent(MODIFIED, new)]
             # Finalizer-parked object whose last finalizer was just removed
@@ -308,11 +353,14 @@ class ResourceStore:
                 raise NotFound(*key)
             if cur.meta.finalizers:
                 if cur.meta.deletion_timestamp is None:
+                    old = cur
                     cur = cur.deepcopy()
                     cur.meta.deletion_timestamp = now()
                     self._rv_counter += 1
                     cur.meta.resource_version = self._rv_counter
+                    self._index_remove_locked(old)
                     self._objects[key] = cur
+                    self._index_add_locked(cur)
                     self._persist(cur)
                     events = [WatchEvent(MODIFIED, cur)]
                 else:
@@ -327,6 +375,7 @@ class ResourceStore:
         obj = self._objects.pop(key, None)
         if obj is None:
             return collect
+        self._index_remove_locked(obj)
         self._unpersist(obj)
         collect.append(WatchEvent(DELETED, obj))
         owned = [
@@ -340,11 +389,14 @@ class ResourceStore:
                 continue
             if child.meta.finalizers:
                 if child.meta.deletion_timestamp is None:
+                    old_child = child
                     child = child.deepcopy()
                     child.meta.deletion_timestamp = now()
                     self._rv_counter += 1
                     child.meta.resource_version = self._rv_counter
+                    self._index_remove_locked(old_child)
                     self._objects[child_key] = child
+                    self._index_add_locked(child)
                     self._persist(child)
                     collect.append(WatchEvent(MODIFIED, child))
             else:

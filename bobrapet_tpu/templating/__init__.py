@@ -1,0 +1,39 @@
+"""Template engine: scoped expressions with the offloaded-data error channel."""
+
+from .engine import (
+    ALL_ROOTS,
+    ROOT_INPUTS,
+    ROOT_PACKET,
+    ROOT_RUN,
+    ROOT_STEPS,
+    STORAGE_REF_KEY,
+    EvaluationBlocked,
+    EvaluationError,
+    Evaluator,
+    OffloadedDataUsage,
+    TemplateConfig,
+    TemplateError,
+    TemplateSyntaxError,
+    TemplateValidationError,
+    find_storage_refs,
+    is_storage_ref,
+)
+
+__all__ = [
+    "ALL_ROOTS",
+    "ROOT_INPUTS",
+    "ROOT_PACKET",
+    "ROOT_RUN",
+    "ROOT_STEPS",
+    "STORAGE_REF_KEY",
+    "EvaluationBlocked",
+    "EvaluationError",
+    "Evaluator",
+    "OffloadedDataUsage",
+    "TemplateConfig",
+    "TemplateError",
+    "TemplateSyntaxError",
+    "TemplateValidationError",
+    "find_storage_refs",
+    "is_storage_ref",
+]

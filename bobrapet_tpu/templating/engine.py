@@ -1,0 +1,620 @@
+"""Template engine: scoped ``{{ expression }}`` evaluation.
+
+Capability parity with the reference's external templating library
+(bubustack/core ``templating``; usage at reference
+internal/controller/runs/dag.go:45,2679 and cmd/main.go:585-590):
+
+- Expression scopes ``inputs`` / ``steps`` / ``packet`` (the reference's
+  RootInputs/RootSteps/RootPacket), plus ``run`` metadata.
+- ``evaluate_condition`` for step ``if`` strings.
+- The **offloaded-data error channel**: touching a value that is a
+  ``{"storageRef": ...}`` placeholder raises :class:`OffloadedDataUsage`
+  — the DAG engine turns that into the configured offloaded-data policy
+  (fail / inject / controller-materialize; reference
+  templating_policy.go:12-43).
+- Config knobs: evaluation budget (timeout), max output bytes,
+  deterministic mode (reference templating.Config).
+- Static validation of expressions against allowed scopes for admission
+  (reference story_webhook.go:832-848), and implicit-dependency mining
+  (which ``steps.X`` a template references; reference dag.go:3223).
+
+Expressions are a small, safe subset of Python syntax evaluated over the
+scope dict: names, attribute/index access, literals, arithmetic,
+comparisons, boolean logic, conditional expressions, and a whitelist of
+pure functions. No loops, no comprehensions, no attribute access on
+Python objects — attributes are dict-key lookups only.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+import time
+from typing import Any, Iterable, Optional
+
+# ---------------------------------------------------------------------------
+# Errors
+# ---------------------------------------------------------------------------
+
+
+class TemplateError(Exception):
+    """Base class for all templating failures."""
+
+
+class TemplateSyntaxError(TemplateError):
+    pass
+
+
+class TemplateValidationError(TemplateError):
+    """Static validation failure (bad scope, forbidden construct)."""
+
+
+class EvaluationError(TemplateError):
+    """Runtime evaluation failure (missing key, type error, ...)."""
+
+
+class EvaluationBlocked(TemplateError):
+    """Evaluation exceeded its budget or output cap
+    (the reference's ErrEvaluationBlocked)."""
+
+
+class OffloadedDataUsage(TemplateError):
+    """The expression touched offloaded data
+    (the reference's ErrOffloadedDataUsage)."""
+
+    def __init__(self, message: str, refs: Optional[list[dict[str, Any]]] = None):
+        super().__init__(message)
+        self.refs = refs or []
+
+
+# ---------------------------------------------------------------------------
+# Offloaded-data placeholders
+# ---------------------------------------------------------------------------
+
+STORAGE_REF_KEY = "storageRef"
+
+
+def is_storage_ref(value: Any) -> bool:
+    """Is this value an offloaded-data placeholder?
+    (reference: pkg/storage dehydrate markers; offloaded_refs.go:23-207)"""
+    return (
+        isinstance(value, dict)
+        and STORAGE_REF_KEY in value
+        and isinstance(value[STORAGE_REF_KEY], dict)
+    )
+
+
+def find_storage_refs(value: Any) -> list[dict[str, Any]]:
+    """Collect all storageRef placeholders nested anywhere in a value."""
+    out: list[dict[str, Any]] = []
+
+    def rec(v: Any) -> None:
+        if is_storage_ref(v):
+            out.append(v[STORAGE_REF_KEY])
+            return
+        if isinstance(v, dict):
+            for x in v.values():
+                rec(x)
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                rec(x)
+
+    rec(value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Config
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TemplateConfig:
+    """(reference: templating.Config{EvaluationTimeout, MaxOutputBytes,
+    Deterministic}, cmd/main.go:585-590)"""
+
+    evaluation_timeout: float = 1.0  # wall-clock seconds per template value
+    max_output_bytes: int = 1 << 20  # 1 MiB rendered-output cap
+    deterministic: bool = True  # forbid now()/nondeterministic functions
+    max_expression_nodes: int = 500  # AST size budget per expression
+
+
+_TEMPLATE_RE = re.compile(r"\{\{(.*?)\}\}", re.DOTALL)
+
+#: Roots available in each evaluation context
+#: (reference scopes: RootInputs/RootSteps/RootPacket + run metadata).
+ROOT_INPUTS = "inputs"
+ROOT_STEPS = "steps"
+ROOT_PACKET = "packet"
+ROOT_RUN = "run"
+ALL_ROOTS = frozenset({ROOT_INPUTS, ROOT_STEPS, ROOT_PACKET, ROOT_RUN})
+
+_ALLOWED_NODES = (
+    ast.Expression,
+    ast.Name,
+    ast.Attribute,
+    ast.Subscript,
+    ast.Constant,
+    ast.BinOp,
+    ast.UnaryOp,
+    ast.BoolOp,
+    ast.Compare,
+    ast.IfExp,
+    ast.Call,
+    ast.Dict,
+    ast.List,
+    ast.Tuple,
+    ast.Slice,
+    ast.Load,
+    # operators
+    ast.Add,
+    ast.Sub,
+    ast.Mult,
+    ast.Div,
+    ast.FloorDiv,
+    ast.Mod,
+    ast.USub,
+    ast.Not,
+    ast.And,
+    ast.Or,
+    ast.Eq,
+    ast.NotEq,
+    ast.Lt,
+    ast.LtE,
+    ast.Gt,
+    ast.GtE,
+    ast.In,
+    ast.NotIn,
+    ast.keyword,
+)
+
+
+class _Missing:
+    """Sentinel for absent keys inside has()/default()."""
+
+    __slots__ = ("path",)
+
+    def __init__(self, path: str):
+        self.path = path
+
+
+def _now() -> float:
+    return time.time()
+
+
+class Evaluator:
+    """Evaluates template strings/values against a scope.
+
+    Scope layout::
+
+        {
+          "inputs": {...},           # StoryRun inputs
+          "steps": {name: {"output": ..., "signals": ...}},
+          "run":   {"name": ..., "namespace": ..., "storyName": ...},
+          "packet": {...},           # realtime message (streaming scope)
+        }
+    """
+
+    def __init__(self, config: Optional[TemplateConfig] = None):
+        self.config = config or TemplateConfig()
+
+    # -- public API --------------------------------------------------------
+
+    def evaluate_value(self, value: Any, scope: dict[str, Any]) -> Any:
+        """Recursively evaluate templates inside a JSON-like value
+        (the `with` block / output template evaluation)."""
+        deadline = _now() + self.config.evaluation_timeout
+        result = self._eval_value(value, scope, deadline)
+        self._check_output_size(result)
+        return result
+
+    def evaluate_string(self, text: str, scope: dict[str, Any]) -> Any:
+        """Evaluate one (possibly templated) string.
+
+        A string that is exactly one ``{{ expr }}`` returns the expression's
+        native value; mixed text interpolates string renderings.
+        """
+        deadline = _now() + self.config.evaluation_timeout
+        return self._eval_string(text, scope, deadline)
+
+    def evaluate_condition(self, expr: str, scope: dict[str, Any]) -> bool:
+        """Evaluate an ``if`` condition to a bool
+        (reference: templating.EvaluateCondition)."""
+        text = expr.strip()
+        if not text:
+            return True
+        # conditions may be written with or without {{ }}
+        single = self._single_expression(text)
+        if single is not None:
+            text = single
+        deadline = _now() + self.config.evaluation_timeout
+        value = self._eval_expression(text, scope, deadline)
+        if is_storage_ref(value):
+            raise OffloadedDataUsage(
+                "condition evaluates to offloaded data", [value[STORAGE_REF_KEY]]
+            )
+        return self._truthy(value)  # Missing values are falsy, not truthy objects
+
+    # -- static analysis ---------------------------------------------------
+
+    def validate(self, text: str, allowed_roots: Iterable[str] = ALL_ROOTS) -> None:
+        """Statically validate all expressions in a templated string:
+        syntax, allowed constructs, and scope roots
+        (reference: story webhook per-scope static validation)."""
+        allowed = set(allowed_roots)
+        for expr in self.extract_expressions(text):
+            tree = self._parse(expr)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Name):
+                    if node.id in _FUNCTIONS or node.id in ("true", "false", "null"):
+                        continue
+                    if node.id not in allowed:
+                        raise TemplateValidationError(
+                            f"unknown scope root {node.id!r} (allowed: {sorted(allowed)})"
+                        )
+
+    @staticmethod
+    def _single_expression(text: str) -> Optional[str]:
+        """If the whole string is exactly ONE ``{{ expr }}``, return expr.
+
+        Uses finditer (not a non-greedy fullmatch, which would swallow
+        several adjacent templates into one bogus expression).
+        """
+        stripped = text.strip()
+        matches = list(_TEMPLATE_RE.finditer(stripped))
+        if len(matches) == 1 and matches[0].span() == (0, len(stripped)):
+            return matches[0].group(1).strip()
+        return None
+
+    @staticmethod
+    def extract_expressions(text: str) -> list[str]:
+        if not isinstance(text, str):
+            return []
+        return [m.group(1).strip() for m in _TEMPLATE_RE.finditer(text)]
+
+    @classmethod
+    def find_step_references(cls, value: Any) -> set[str]:
+        """Mine implicit step dependencies from templates anywhere in a
+        value: every ``steps.<name>`` root reference
+        (reference: dag.go findAndAddDeps:3223)."""
+        found: set[str] = set()
+
+        def scan_expr(expr: str) -> None:
+            try:
+                tree = ast.parse(expr, mode="eval")
+            except SyntaxError:
+                return
+            for node in ast.walk(tree):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == ROOT_STEPS
+                ):
+                    found.add(node.attr)
+                if (
+                    isinstance(node, ast.Subscript)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == ROOT_STEPS
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                ):
+                    found.add(node.slice.value)
+
+        def rec(v: Any) -> None:
+            if isinstance(v, str):
+                for expr in cls.extract_expressions(v):
+                    scan_expr(expr)
+            elif isinstance(v, dict):
+                for x in v.values():
+                    rec(x)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    rec(x)
+
+        rec(value)
+        return found
+
+    # -- internals ---------------------------------------------------------
+
+    def _eval_value(self, value: Any, scope: dict[str, Any], deadline: float) -> Any:
+        if _now() > deadline:
+            raise EvaluationBlocked("template evaluation timed out")
+        if isinstance(value, str):
+            return self._eval_string(value, scope, deadline)
+        if isinstance(value, dict):
+            return {k: self._eval_value(v, scope, deadline) for k, v in value.items()}
+        if isinstance(value, list):
+            return [self._eval_value(v, scope, deadline) for v in value]
+        return value
+
+    def _eval_string(self, text: str, scope: dict[str, Any], deadline: float) -> Any:
+        m = self._single_expression(text)
+        if m is not None:
+            return self._eval_expression(m, scope, deadline)
+
+        def replace(match: re.Match) -> str:
+            v = self._eval_expression(match.group(1).strip(), scope, deadline)
+            if is_storage_ref(v):
+                raise OffloadedDataUsage(
+                    "offloaded data interpolated into string", [v[STORAGE_REF_KEY]]
+                )
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            if v is None:
+                return ""
+            if isinstance(v, (dict, list)):
+                import json
+
+                return json.dumps(v, separators=(",", ":"))
+            return str(v)
+
+        return _TEMPLATE_RE.sub(replace, text)
+
+    def _parse(self, expr: str) -> ast.Expression:
+        try:
+            tree = ast.parse(expr, mode="eval")
+        except SyntaxError as e:
+            raise TemplateSyntaxError(f"bad expression {expr!r}: {e}") from None
+        count = 0
+        for node in ast.walk(tree):
+            count += 1
+            if count > self.config.max_expression_nodes:
+                raise EvaluationBlocked(f"expression too large: {expr[:80]!r}")
+            if not isinstance(node, _ALLOWED_NODES):
+                raise TemplateValidationError(
+                    f"forbidden construct {type(node).__name__} in {expr[:80]!r}"
+                )
+        return tree
+
+    def _eval_expression(self, expr: str, scope: dict[str, Any], deadline: float) -> Any:
+        if _now() > deadline:
+            raise EvaluationBlocked("template evaluation timed out")
+        tree = self._parse(expr)
+        return self._eval_node(tree.body, scope, deadline)
+
+    def _eval_node(self, node: ast.AST, scope: dict[str, Any], deadline: float) -> Any:
+        if _now() > deadline:
+            raise EvaluationBlocked("template evaluation timed out")
+        if isinstance(node, ast.Constant):
+            return node.value
+        if isinstance(node, ast.Name):
+            if node.id == "true":
+                return True
+            if node.id == "false":
+                return False
+            if node.id == "null":
+                return None
+            if node.id in scope:
+                return scope[node.id]
+            if node.id in _FUNCTIONS:
+                return _FUNCTIONS[node.id]
+            return _Missing(node.id)
+        if isinstance(node, ast.Attribute):
+            base = self._eval_node(node.value, scope, deadline)
+            return self._lookup(base, node.attr, f".{node.attr}")
+        if isinstance(node, ast.Subscript):
+            base = self._eval_node(node.value, scope, deadline)
+            if isinstance(node.slice, ast.Slice):
+                lo = self._eval_node(node.slice.lower, scope, deadline) if node.slice.lower else None
+                hi = self._eval_node(node.slice.upper, scope, deadline) if node.slice.upper else None
+                if isinstance(base, _Missing):
+                    raise EvaluationError(f"unknown value {base.path!r}")
+                self._guard_offloaded(base, "[slice]")
+                return base[lo:hi]
+            key = self._eval_node(node.slice, scope, deadline)
+            return self._lookup(base, key, f"[{key!r}]")
+        if isinstance(node, ast.BinOp):
+            left = self._unwrap(self._eval_node(node.left, scope, deadline))
+            right = self._unwrap(self._eval_node(node.right, scope, deadline))
+            return self._binop(node.op, left, right)
+        if isinstance(node, ast.UnaryOp):
+            v = self._eval_node(node.operand, scope, deadline)
+            if isinstance(node.op, ast.Not):
+                return not self._truthy(v)
+            if isinstance(node.op, ast.USub):
+                return -self._unwrap(v)
+            raise TemplateValidationError("unsupported unary op")
+        if isinstance(node, ast.BoolOp):
+            if isinstance(node.op, ast.And):
+                result: Any = True
+                for v in node.values:
+                    result = self._eval_node(v, scope, deadline)
+                    if not self._truthy(result):
+                        return result if not isinstance(result, _Missing) else None
+                return result
+            result = False
+            for v in node.values:
+                result = self._eval_node(v, scope, deadline)
+                if self._truthy(result):
+                    return result
+            return result if not isinstance(result, _Missing) else None
+        if isinstance(node, ast.Compare):
+            left = self._unwrap_for_compare(self._eval_node(node.left, scope, deadline))
+            for op, comp in zip(node.ops, node.comparators):
+                right = self._unwrap_for_compare(self._eval_node(comp, scope, deadline))
+                if not self._compare(op, left, right):
+                    return False
+                left = right
+            return True
+        if isinstance(node, ast.IfExp):
+            cond = self._eval_node(node.test, scope, deadline)
+            branch = node.body if self._truthy(cond) else node.orelse
+            return self._eval_node(branch, scope, deadline)
+        if isinstance(node, ast.Call):
+            return self._call(node, scope, deadline)
+        if isinstance(node, ast.Dict):
+            return {
+                self._unwrap(self._eval_node(k, scope, deadline)): self._eval_node(v, scope, deadline)
+                for k, v in zip(node.keys, node.values)
+            }
+        if isinstance(node, (ast.List, ast.Tuple)):
+            return [self._eval_node(v, scope, deadline) for v in node.elts]
+        raise TemplateValidationError(f"unsupported node {type(node).__name__}")
+
+    # -- helpers -----------------------------------------------------------
+
+    def _lookup(self, base: Any, key: Any, where: str) -> Any:
+        if isinstance(base, _Missing):
+            return _Missing(f"{base.path}{where}")
+        self._guard_offloaded(base, where)
+        if isinstance(base, dict):
+            if key in base:
+                value = base[key]
+                return value
+            return _Missing(f"?{where}")
+        if isinstance(base, (list, tuple)) and isinstance(key, int):
+            if -len(base) <= key < len(base):
+                return base[key]
+            return _Missing(f"?{where}")
+        if isinstance(base, str) and isinstance(key, int):
+            if -len(base) <= key < len(base):
+                return base[key]
+            return _Missing(f"?{where}")
+        raise EvaluationError(f"cannot index {type(base).__name__} with {where}")
+
+    def _guard_offloaded(self, value: Any, where: str) -> None:
+        if is_storage_ref(value):
+            raise OffloadedDataUsage(
+                f"expression traverses offloaded data at {where}",
+                [value[STORAGE_REF_KEY]],
+            )
+
+    def _unwrap(self, v: Any) -> Any:
+        if isinstance(v, _Missing):
+            raise EvaluationError(f"unknown value {v.path!r}")
+        self._guard_offloaded(v, "(value)")
+        return v
+
+    def _unwrap_for_compare(self, v: Any) -> Any:
+        # comparisons tolerate missing (== null semantics)
+        if isinstance(v, _Missing):
+            return None
+        self._guard_offloaded(v, "(comparison)")
+        return v
+
+    def _truthy(self, v: Any) -> bool:
+        if isinstance(v, _Missing):
+            return False
+        self._guard_offloaded(v, "(condition)")
+        return bool(v)
+
+    @staticmethod
+    def _binop(op: ast.AST, left: Any, right: Any) -> Any:
+        try:
+            if isinstance(op, ast.Add):
+                return left + right
+            if isinstance(op, ast.Sub):
+                return left - right
+            if isinstance(op, ast.Mult):
+                return left * right
+            if isinstance(op, ast.Div):
+                return left / right
+            if isinstance(op, ast.FloorDiv):
+                return left // right
+            if isinstance(op, ast.Mod):
+                return left % right
+        except TypeError as e:
+            raise EvaluationError(str(e)) from None
+        except ZeroDivisionError:
+            raise EvaluationError("division by zero") from None
+        raise TemplateValidationError("unsupported operator")
+
+    def _compare(self, op: ast.AST, left: Any, right: Any) -> bool:
+        try:
+            if isinstance(op, ast.Eq):
+                return left == right
+            if isinstance(op, ast.NotEq):
+                return left != right
+            if isinstance(op, ast.Lt):
+                return left < right
+            if isinstance(op, ast.LtE):
+                return left <= right
+            if isinstance(op, ast.Gt):
+                return left > right
+            if isinstance(op, ast.GtE):
+                return left >= right
+            if isinstance(op, ast.In):
+                return left in right
+            if isinstance(op, ast.NotIn):
+                return left not in right
+        except TypeError as e:
+            raise EvaluationError(str(e)) from None
+        raise TemplateValidationError("unsupported comparison")
+
+    def _call(self, node: ast.Call, scope: dict[str, Any], deadline: float) -> Any:
+        if not isinstance(node.func, ast.Name):
+            raise TemplateValidationError("only whitelisted function calls allowed")
+        fname = node.func.id
+        fn = _FUNCTIONS.get(fname)
+        if fn is None:
+            raise TemplateValidationError(f"unknown function {fname!r}")
+        if self.config.deterministic and fname in _NONDETERMINISTIC:
+            raise TemplateValidationError(
+                f"function {fname!r} is forbidden in deterministic mode"
+            )
+        raw_args = [self._eval_node(a, scope, deadline) for a in node.args]
+        if fname in ("has", "default"):
+            args = raw_args  # these understand the Missing sentinel
+        else:
+            args = [self._unwrap(a) for a in raw_args]
+        try:
+            return fn(*args)
+        except TemplateError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            raise EvaluationError(f"{fname}(): {e}") from None
+
+    def _check_output_size(self, value: Any) -> None:
+        import json
+
+        try:
+            size = len(json.dumps(value, default=str))
+        except (TypeError, ValueError):
+            return
+        if size > self.config.max_output_bytes:
+            raise EvaluationBlocked(
+                f"rendered output {size}B exceeds cap {self.config.max_output_bytes}B"
+            )
+
+
+def _fn_has(v: Any) -> bool:
+    return not isinstance(v, _Missing) and v is not None
+
+
+def _fn_default(v: Any, d: Any) -> Any:
+    return d if isinstance(v, _Missing) or v is None else v
+
+
+def _fn_size(v: Any) -> int:
+    if isinstance(v, (str, list, dict, tuple)):
+        return len(v)
+    raise EvaluationError(f"size() of {type(v).__name__}")
+
+
+_FUNCTIONS: dict[str, Any] = {
+    "has": _fn_has,
+    "default": _fn_default,
+    "size": _fn_size,
+    "len": _fn_size,
+    "str": lambda v: str(v),
+    "int": lambda v: int(v),
+    "float": lambda v: float(v),
+    "min": min,
+    "max": max,
+    "sum": sum,
+    "sorted": sorted,
+    "join": lambda sep, items: sep.join(str(i) for i in items),
+    "split": lambda s, sep: s.split(sep),
+    "lower": lambda s: s.lower(),
+    "upper": lambda s: s.upper(),
+    "trim": lambda s: s.strip(),
+    "contains": lambda a, b: b in a,
+    "startsWith": lambda s, p: s.startswith(p),
+    "endsWith": lambda s, p: s.endswith(p),
+    "keys": lambda d: sorted(d.keys()),
+    "values": lambda d: [d[k] for k in sorted(d.keys())],
+    "now": _now,
+}
+
+_NONDETERMINISTIC = frozenset({"now"})

@@ -185,6 +185,26 @@ class TestIndexes:
         got = store.list("StepRun", index=("storyRunRef", "run1"))
         assert [r.name for r in got] == ["a"]
 
+    def test_index_tracks_updates_and_deletes(self, store):
+        store.add_index(
+            "StepRun", "phase", lambda r: [r.status.get("phase", "")]
+        )
+        store.create(new_resource("StepRun", "a"))
+        store.mutate("StepRun", "default", "a", lambda r: r.status.update(phase="Running"), status_only=True)
+        assert [r.name for r in store.list("StepRun", index=("phase", "Running"))] == ["a"]
+        store.mutate("StepRun", "default", "a", lambda r: r.status.update(phase="Succeeded"), status_only=True)
+        assert store.list("StepRun", index=("phase", "Running")) == []
+        assert [r.name for r in store.list("StepRun", index=("phase", "Succeeded"))] == ["a"]
+        store.delete("StepRun", "default", "a")
+        assert store.list("StepRun", index=("phase", "Succeeded")) == []
+
+    def test_index_backfills_existing_objects(self, store):
+        store.create(new_resource("StepRun", "pre", spec={"storyRunRef": {"name": "r9"}}))
+        store.add_index(
+            "StepRun", "storyRunRef", lambda r: [r.spec.get("storyRunRef", {}).get("name", "")]
+        )
+        assert [r.name for r in store.list("StepRun", index=("storyRunRef", "r9"))] == ["pre"]
+
     def test_label_and_namespace_filters(self, store):
         store.create(new_resource("Story", "a", namespace="ns1", labels={"team": "x"}))
         store.create(new_resource("Story", "b", namespace="ns2", labels={"team": "x"}))

@@ -1,0 +1,197 @@
+"""Template engine tests: scopes, conditions, offloaded-data channel,
+static validation, dependency mining."""
+
+import pytest
+
+from bobrapet_tpu.templating import (
+    EvaluationBlocked,
+    EvaluationError,
+    Evaluator,
+    OffloadedDataUsage,
+    TemplateConfig,
+    TemplateValidationError,
+    find_storage_refs,
+    is_storage_ref,
+)
+
+
+@pytest.fixture
+def ev():
+    return Evaluator()
+
+
+@pytest.fixture
+def scope():
+    return {
+        "inputs": {"q": "what is a tpu", "k": 5, "flags": {"fast": True}},
+        "steps": {
+            "embed": {"output": {"vec": [1, 2, 3], "ok": True}},
+            "retrieve": {"output": {"hits": [{"id": "a"}, {"id": "b"}], "count": 2}},
+            "offloaded": {"output": {"storageRef": {"key": "runs/r1/offloaded", "size": 10_000_000}}},
+        },
+        "run": {"name": "r1", "namespace": "default", "storyName": "rag"},
+    }
+
+
+class TestEvaluation:
+    def test_native_value_passthrough(self, ev, scope):
+        assert ev.evaluate_string("{{ steps.embed.output.vec }}", scope) == [1, 2, 3]
+        assert ev.evaluate_string("{{ inputs.k }}", scope) == 5
+
+    def test_interpolation(self, ev, scope):
+        s = ev.evaluate_string("query={{ inputs.q }} k={{ inputs.k }}", scope)
+        assert s == "query=what is a tpu k=5"
+
+    def test_recursive_with_block(self, ev, scope):
+        result = ev.evaluate_value(
+            {"prompt": "{{ inputs.q }}", "docs": "{{ steps.retrieve.output.hits }}", "n": 3},
+            scope,
+        )
+        assert result == {
+            "prompt": "what is a tpu",
+            "docs": [{"id": "a"}, {"id": "b"}],
+            "n": 3,
+        }
+
+    def test_subscript_and_arithmetic(self, ev, scope):
+        assert ev.evaluate_string("{{ steps.retrieve.output.hits[0].id }}", scope) == "a"
+        assert ev.evaluate_string("{{ inputs.k * 2 + 1 }}", scope) == 11
+        assert ev.evaluate_string("{{ steps.retrieve.output.count % 2 }}", scope) == 0
+
+    def test_functions(self, ev, scope):
+        assert ev.evaluate_string("{{ size(steps.retrieve.output.hits) }}", scope) == 2
+        assert ev.evaluate_string("{{ default(inputs.missing, 'x') }}", scope) == "x"
+        assert ev.evaluate_string("{{ has(inputs.q) }}", scope) is True
+        assert ev.evaluate_string("{{ has(inputs.nope) }}", scope) is False
+        assert ev.evaluate_string("{{ upper(inputs.q) }}", scope) == "WHAT IS A TPU"
+        assert ev.evaluate_string("{{ join(',', ['a','b']) }}", scope) == "a,b"
+
+    def test_missing_key_raises_outside_guards(self, ev, scope):
+        with pytest.raises(EvaluationError):
+            ev.evaluate_string("{{ inputs.nope + 1 }}", scope)
+
+    def test_bool_rendering(self, ev, scope):
+        assert ev.evaluate_string("ok={{ steps.embed.output.ok }}", scope) == "ok=true"
+
+    def test_dict_rendering_is_json(self, ev, scope):
+        assert ev.evaluate_string("h={{ steps.retrieve.output.hits[0] }}", scope) == 'h={"id":"a"}'
+
+
+class TestConditions:
+    def test_truthy(self, ev, scope):
+        assert ev.evaluate_condition("{{ steps.embed.output.ok }}", scope)
+        assert ev.evaluate_condition("steps.retrieve.output.count > 1", scope)
+        assert not ev.evaluate_condition("inputs.k > 100", scope)
+        assert ev.evaluate_condition("", scope)  # empty = always
+
+    def test_missing_is_falsy_in_conditions(self, ev, scope):
+        assert not ev.evaluate_condition("{{ steps.nope.output.ok }}", scope)
+        assert ev.evaluate_condition("{{ not has(steps.nope) }}", scope)
+
+    def test_comparison_with_missing_is_null(self, ev, scope):
+        assert ev.evaluate_condition("{{ inputs.missing == null }}", scope)
+
+    def test_and_or(self, ev, scope):
+        assert ev.evaluate_condition(
+            "{{ steps.embed.output.ok and inputs.k >= 5 }}", scope
+        )
+        assert ev.evaluate_condition("{{ inputs.nope or inputs.k }}", scope)
+
+
+class TestOffloadedData:
+    def test_traversal_raises(self, ev, scope):
+        with pytest.raises(OffloadedDataUsage) as ei:
+            ev.evaluate_string("{{ steps.offloaded.output.field }}", scope)
+        assert ei.value.refs[0]["key"] == "runs/r1/offloaded"
+
+    def test_condition_on_offloaded_raises(self, ev, scope):
+        with pytest.raises(OffloadedDataUsage):
+            ev.evaluate_condition("{{ steps.offloaded.output }}", scope)
+
+    def test_interpolating_offloaded_raises(self, ev, scope):
+        with pytest.raises(OffloadedDataUsage):
+            ev.evaluate_string("data={{ steps.offloaded.output }}", scope)
+
+    def test_passthrough_reference_is_allowed(self, ev, scope):
+        # passing the placeholder through untouched is fine (it rehydrates
+        # at the consumer); only *using* it is blocked
+        v = ev.evaluate_string("{{ steps.offloaded.output }}", scope)
+        assert is_storage_ref(v)
+
+    def test_find_storage_refs(self, scope):
+        refs = find_storage_refs(scope["steps"])
+        assert len(refs) == 1 and refs[0]["size"] == 10_000_000
+
+
+class TestSafety:
+    @pytest.mark.parametrize(
+        "expr",
+        [
+            "{{ __import__('os') }}",
+            "{{ ().__class__ }}",
+            "{{ [x for x in steps] }}",
+            "{{ lambda: 1 }}",
+            "{{ open('/etc/passwd') }}",
+            "{{ inputs.q.__class__ }}",
+        ],
+    )
+    def test_dangerous_constructs_rejected(self, ev, scope, expr):
+        with pytest.raises((TemplateValidationError, EvaluationError, OffloadedDataUsage)):
+            v = ev.evaluate_string(expr, scope)
+            # attribute access on str returns Missing -> unwrap check
+            if hasattr(v, "path"):
+                raise EvaluationError(v.path)
+
+    def test_expression_node_budget(self, scope):
+        ev = Evaluator(TemplateConfig(max_expression_nodes=10))
+        with pytest.raises(EvaluationBlocked):
+            ev.evaluate_string("{{ 1+1+1+1+1+1+1+1+1+1+1+1 }}", scope)
+
+    def test_output_size_cap(self, scope):
+        ev = Evaluator(TemplateConfig(max_output_bytes=64))
+        with pytest.raises(EvaluationBlocked):
+            ev.evaluate_value({"big": "{{ inputs.q }}" * 20}, scope)
+
+    def test_deterministic_mode_blocks_now(self, ev, scope):
+        with pytest.raises(TemplateValidationError):
+            ev.evaluate_string("{{ now() }}", scope)
+
+    def test_nondeterministic_allowed_when_configured(self, scope):
+        ev = Evaluator(TemplateConfig(deterministic=False))
+        assert ev.evaluate_string("{{ now() }}", scope) > 0
+
+    def test_division_by_zero(self, ev, scope):
+        with pytest.raises(EvaluationError):
+            ev.evaluate_string("{{ 1 / 0 }}", scope)
+
+
+class TestStaticValidation:
+    def test_valid_scopes(self, ev):
+        ev.validate("{{ inputs.a }} and {{ steps.b.output }}")
+
+    def test_scope_restriction(self, ev):
+        with pytest.raises(TemplateValidationError):
+            ev.validate("{{ steps.b.output }}", allowed_roots={"inputs"})
+
+    def test_unknown_root(self, ev):
+        with pytest.raises(TemplateValidationError):
+            ev.validate("{{ secrets.password }}")
+
+    def test_builtin_names_ok(self, ev):
+        ev.validate("{{ default(inputs.a, null) or true }}", allowed_roots={"inputs"})
+
+
+class TestDependencyMining:
+    def test_attribute_and_subscript_refs(self):
+        deps = Evaluator.find_step_references(
+            {
+                "a": "{{ steps.embed.output }}",
+                "b": ["{{ steps['retrieve'].output.count }}"],
+                "c": "no template",
+                "d": "{{ inputs.x }}",
+            }
+        )
+        assert deps == {"embed", "retrieve"}
+
+    def test_bad_syntax_ignored(self):
+        assert Evaluator.find_step_references("{{ steps. }}") == set()
