@@ -1,0 +1,32 @@
+"""Operator config + hierarchical execution resolution."""
+
+from .operator import (
+    CONFIG_MAP_KIND,
+    ControllerTuning,
+    EngramDefaults,
+    OperatorConfig,
+    OperatorConfigManager,
+    QueueConfig,
+    RetentionDefaults,
+    SchedulingConfig,
+    TemplatingSettings,
+    TimeoutDefaults,
+    parse_config,
+)
+from .resolver import ResolvedExecutionConfig, Resolver
+
+__all__ = [
+    "CONFIG_MAP_KIND",
+    "ControllerTuning",
+    "EngramDefaults",
+    "OperatorConfig",
+    "OperatorConfigManager",
+    "QueueConfig",
+    "RetentionDefaults",
+    "SchedulingConfig",
+    "TemplatingSettings",
+    "TimeoutDefaults",
+    "parse_config",
+    "ResolvedExecutionConfig",
+    "Resolver",
+]

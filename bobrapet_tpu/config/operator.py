@@ -1,0 +1,288 @@
+"""Operator configuration: typed knobs + live reload from a ConfigMap.
+
+Capability parity with the reference's OperatorConfigManager
+(reference: internal/config/operator.go:159,189,380 — the manager is
+itself a reconciler on the operator ConfigMap; ~60 dotted keys parsed at
+operator.go:385-1390; validation ValidateControllerConfig:256; runtime
+toggles ApplyRuntimeToggles controller_config.go:176).
+
+Here the "ConfigMap" is a resource of kind ``ConfigMap`` on the
+coordination bus whose ``spec.data`` carries the dotted keys; the manager
+watches it and atomically swaps the parsed config, notifying subscribers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Any, Callable, Optional
+
+from ..api.enums import OffloadedDataPolicy
+from ..core.object import Resource
+from ..core.store import MODIFIED, ADDED, ResourceStore, WatchEvent
+from ..utils.duration import parse_duration
+
+_log = logging.getLogger(__name__)
+
+CONFIG_MAP_KIND = "ConfigMap"
+
+
+@dataclasses.dataclass
+class QueueConfig:
+    """Named scheduling queue (reference: controller_config.go:524-547)."""
+
+    name: str = "default"
+    max_concurrent: int = 0  # 0 = unlimited
+    priority_aging_seconds: float = 300.0  # effective priority grows with age
+    # TPU-native: queues map to slice pools (SURVEY §2.6); a queue may pin
+    # an accelerator type + available chip budget for admission.
+    accelerator: Optional[str] = None
+    chip_budget: int = 0  # 0 = unlimited
+
+
+@dataclasses.dataclass
+class SchedulingConfig:
+    """(reference: controller_config.go:524-547 SchedulingConfig)"""
+
+    global_max_concurrent_steps: int = 0  # 0 = unlimited
+    queues: dict[str, QueueConfig] = dataclasses.field(default_factory=dict)
+
+    def queue(self, name: Optional[str]) -> QueueConfig:
+        if name and name in self.queues:
+            return self.queues[name]
+        return self.queues.get("default", QueueConfig())
+
+
+@dataclasses.dataclass
+class TemplatingSettings:
+    """(reference: controller_config.go:140-144 + cmd/main.go:585-590)"""
+
+    evaluation_timeout: float = 1.0
+    max_output_bytes: int = 1 << 20
+    deterministic: bool = True
+    offloaded_data_policy: OffloadedDataPolicy = OffloadedDataPolicy.FAIL
+    materialize_engram: Optional[str] = None  # engram used for controller policy
+
+
+@dataclasses.dataclass
+class ControllerTuning:
+    """Per-controller knobs (reference: operator.go:447-528)."""
+
+    max_concurrent_reconciles: int = 4
+    requeue_base_delay: float = 0.05
+    requeue_max_delay: float = 30.0
+    reconcile_timeout: float = 30.0
+
+
+@dataclasses.dataclass
+class EngramDefaults:
+    """Operator->SDK defaults (reference: operator.go engram defaults)."""
+
+    grpc_port: int = 50051
+    max_inline_size: int = 16 * 1024
+    storage_timeout_seconds: int = 30
+    max_recursion_depth: int = 10
+    debug: bool = False
+
+
+@dataclasses.dataclass
+class RetentionDefaults:
+    """Two-phase retention (reference: shared_types.go:376-397 defaults)."""
+
+    children_ttl_seconds: float = 3600.0  # children cleanup after terminal
+    storyrun_retention_seconds: float = 86400.0  # then run record itself
+
+
+@dataclasses.dataclass
+class TimeoutDefaults:
+    """Per-purpose wait timeouts (reference: controller_config.go:116-118)."""
+
+    approval_seconds: float = 86400.0  # gate default timeout
+    external_data_seconds: float = 3600.0  # wait default timeout
+    conditional_seconds: float = 60.0
+    step_seconds: float = 3600.0
+    story_seconds: float = 0.0  # 0 = none
+
+
+@dataclasses.dataclass
+class OperatorConfig:
+    """The full operator config tree
+    (reference: ControllerConfig controller_config.go:55-168)."""
+
+    controllers: ControllerTuning = dataclasses.field(default_factory=ControllerTuning)
+    scheduling: SchedulingConfig = dataclasses.field(default_factory=SchedulingConfig)
+    templating: TemplatingSettings = dataclasses.field(default_factory=TemplatingSettings)
+    engram: EngramDefaults = dataclasses.field(default_factory=EngramDefaults)
+    retention: RetentionDefaults = dataclasses.field(default_factory=RetentionDefaults)
+    timeouts: TimeoutDefaults = dataclasses.field(default_factory=TimeoutDefaults)
+    reference_cross_namespace_policy: str = "deny"  # deny | grant | allow
+    max_story_with_block_size_bytes: int = 256 * 1024
+    default_retry_max: int = 3
+    default_retry_delay: float = 5.0
+    default_retry_max_delay: float = 300.0
+    default_retry_jitter_pct: int = 10
+    telemetry_enabled: bool = False
+    step_output_logging: bool = False
+    verbosity: int = 0
+
+    def validate(self) -> list[str]:
+        """(reference: ValidateControllerConfig operator config validation)"""
+        errs = []
+        if self.reference_cross_namespace_policy not in ("deny", "grant", "allow"):
+            errs.append(
+                f"referenceCrossNamespacePolicy must be deny|grant|allow, got "
+                f"{self.reference_cross_namespace_policy!r}"
+            )
+        if self.controllers.max_concurrent_reconciles < 1:
+            errs.append("controllers.maxConcurrentReconciles must be >= 1")
+        if self.templating.evaluation_timeout <= 0:
+            errs.append("templating.evaluationTimeout must be > 0")
+        if self.engram.max_inline_size < 0:
+            errs.append("engram.maxInlineSize must be >= 0")
+        for qname, q in self.scheduling.queues.items():
+            if q.max_concurrent < 0:
+                errs.append(f"queue {qname}: maxConcurrent must be >= 0")
+        return errs
+
+
+# dotted-key -> setter table (the reference parses ~60 dotted ConfigMap
+# keys, operator.go:385-1390; same addressing style here)
+def _apply_dotted(cfg: OperatorConfig, key: str, value: str) -> bool:
+    def fset(obj: Any, attr: str, conv: Callable[[str], Any]) -> bool:
+        try:
+            setattr(obj, attr, conv(value))
+            return True
+        except (ValueError, TypeError) as e:
+            _log.warning("config key %s=%r invalid: %s", key, value, e)
+            return False
+
+    as_bool = lambda v: str(v).lower() in ("1", "true", "yes", "on")  # noqa: E731
+    as_dur = lambda v: parse_duration(v, default=0.0)  # noqa: E731
+
+    table: dict[str, Callable[[], bool]] = {
+        "controllers.max-concurrent-reconciles": lambda: fset(cfg.controllers, "max_concurrent_reconciles", int),
+        "controllers.requeue-base-delay": lambda: fset(cfg.controllers, "requeue_base_delay", as_dur),
+        "controllers.requeue-max-delay": lambda: fset(cfg.controllers, "requeue_max_delay", as_dur),
+        "controllers.reconcile-timeout": lambda: fset(cfg.controllers, "reconcile_timeout", as_dur),
+        "scheduling.global-max-concurrent-steps": lambda: fset(cfg.scheduling, "global_max_concurrent_steps", int),
+        "templating.evaluation-timeout": lambda: fset(cfg.templating, "evaluation_timeout", as_dur),
+        "templating.max-output-bytes": lambda: fset(cfg.templating, "max_output_bytes", int),
+        "templating.deterministic": lambda: fset(cfg.templating, "deterministic", as_bool),
+        "templating.offloaded-data-policy": lambda: fset(
+            cfg.templating, "offloaded_data_policy", OffloadedDataPolicy
+        ),
+        "templating.materialize-engram": lambda: fset(cfg.templating, "materialize_engram", str),
+        "engram.grpc-port": lambda: fset(cfg.engram, "grpc_port", int),
+        "engram.max-inline-size": lambda: fset(cfg.engram, "max_inline_size", int),
+        "engram.storage-timeout-seconds": lambda: fset(cfg.engram, "storage_timeout_seconds", int),
+        "engram.max-recursion-depth": lambda: fset(cfg.engram, "max_recursion_depth", int),
+        "engram.debug": lambda: fset(cfg.engram, "debug", as_bool),
+        "retention.children-ttl": lambda: fset(cfg.retention, "children_ttl_seconds", as_dur),
+        "retention.storyrun-retention": lambda: fset(cfg.retention, "storyrun_retention_seconds", as_dur),
+        "timeouts.approval": lambda: fset(cfg.timeouts, "approval_seconds", as_dur),
+        "timeouts.external-data": lambda: fset(cfg.timeouts, "external_data_seconds", as_dur),
+        "timeouts.conditional": lambda: fset(cfg.timeouts, "conditional_seconds", as_dur),
+        "timeouts.step": lambda: fset(cfg.timeouts, "step_seconds", as_dur),
+        "timeouts.story": lambda: fset(cfg.timeouts, "story_seconds", as_dur),
+        "reference-cross-namespace-policy": lambda: fset(cfg, "reference_cross_namespace_policy", str),
+        "max-story-with-block-size-bytes": lambda: fset(cfg, "max_story_with_block_size_bytes", int),
+        "retry.default-max": lambda: fset(cfg, "default_retry_max", int),
+        "retry.default-delay": lambda: fset(cfg, "default_retry_delay", as_dur),
+        "retry.default-max-delay": lambda: fset(cfg, "default_retry_max_delay", as_dur),
+        "retry.default-jitter-pct": lambda: fset(cfg, "default_retry_jitter_pct", int),
+        "telemetry.enabled": lambda: fset(cfg, "telemetry_enabled", as_bool),
+        "logging.step-output": lambda: fset(cfg, "step_output_logging", as_bool),
+        "logging.verbosity": lambda: fset(cfg, "verbosity", int),
+    }
+    fn = table.get(key)
+    if fn is not None:
+        return fn()
+    # queue keys: scheduling.queue.<name>.<field>
+    parts = key.split(".")
+    if len(parts) == 4 and parts[0] == "scheduling" and parts[1] == "queue":
+        qname, field = parts[2], parts[3]
+        q = cfg.scheduling.queues.setdefault(qname, QueueConfig(name=qname))
+        if field == "max-concurrent":
+            return fset(q, "max_concurrent", int)
+        if field == "priority-aging":
+            return fset(q, "priority_aging_seconds", as_dur)
+        if field == "accelerator":
+            return fset(q, "accelerator", str)
+        if field == "chip-budget":
+            return fset(q, "chip_budget", int)
+    _log.debug("unknown config key %s ignored", key)
+    return False
+
+
+def parse_config(data: dict[str, str]) -> OperatorConfig:
+    """Parse a flat dotted-key map into an OperatorConfig; invalid values
+    keep their defaults (reference tolerates per-key failures)."""
+    cfg = OperatorConfig()
+    for key in sorted(data):
+        _apply_dotted(cfg, key, data[key])
+    errs = cfg.validate()
+    if errs:
+        _log.warning("operator config has %d invalid fields: %s", len(errs), errs)
+    return cfg
+
+
+class OperatorConfigManager:
+    """Holds the live config; watches the ConfigMap resource for reloads
+    (reference: operator.go:356-383 — the manager is a reconciler on the
+    operator ConfigMap)."""
+
+    def __init__(
+        self,
+        store: Optional[ResourceStore] = None,
+        namespace: str = "bobrapet-system",
+        name: str = "operator-config",
+        initial: Optional[OperatorConfig] = None,
+    ):
+        self._lock = threading.Lock()
+        self._config = initial or OperatorConfig()
+        self._subscribers: list[Callable[[OperatorConfig], None]] = []
+        self._namespace = namespace
+        self._name = name
+        if store is not None:
+            existing = store.try_get(CONFIG_MAP_KIND, namespace, name)
+            if existing is not None:
+                # same last-good-config gate as reloads: an invalid initial
+                # ConfigMap leaves the defaults active
+                self._maybe_swap(existing.spec.get("data") or {})
+            store.watch(self._on_event, kinds=[CONFIG_MAP_KIND])
+
+    @property
+    def config(self) -> OperatorConfig:
+        with self._lock:
+            return self._config
+
+    def subscribe(self, fn: Callable[[OperatorConfig], None]) -> None:
+        with self._lock:
+            self._subscribers.append(fn)
+
+    def _on_event(self, ev: WatchEvent) -> None:
+        if ev.type not in (ADDED, MODIFIED):
+            return
+        r: Resource = ev.resource
+        if r.meta.namespace != self._namespace or r.meta.name != self._name:
+            return
+        self._maybe_swap(r.spec.get("data") or {})
+
+    def _maybe_swap(self, data: dict[str, str]) -> None:
+        new = parse_config(data)
+        if new.validate():
+            # invalid configs are logged but the prior good config stays
+            # active (the reference keeps serving the last valid config)
+            return
+        self._swap(new)
+
+    def _swap(self, cfg: OperatorConfig) -> None:
+        with self._lock:
+            self._config = cfg
+            subs = list(self._subscribers)
+        for fn in subs:
+            try:
+                fn(cfg)
+            except Exception:  # noqa: BLE001
+                _log.exception("config subscriber failed")

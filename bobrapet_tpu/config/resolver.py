@@ -1,0 +1,201 @@
+"""Hierarchical execution-config resolution.
+
+Capability parity with the reference's Resolver
+(reference: internal/config/resolver.go:113,257): one step's effective
+execution config is the layered merge
+
+    operator defaults
+      -> EngramTemplate.executionPolicy   (template recommendations)
+      -> Engram.execution                 (instance overrides)
+      -> Story.policy.execution + Step.execution
+      -> StepRun.executionOverrides       (runtime overrides)
+
+Later layers win field-by-field; nested policies merge recursively (a
+layer that sets only ``retry.maxRetries`` inherits the rest).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ..api.shared import (
+    CachePolicy,
+    ExecutionOverrides,
+    ExecutionPolicy,
+    JobPolicy,
+    PlacementPolicy,
+    ProbeOverrides,
+    ResourcePolicy,
+    RetryPolicy,
+    SecurityPolicy,
+    StoragePolicy,
+    TPUPolicy,
+    WorkloadSpec,
+)
+from ..utils.duration import parse_duration
+from .operator import OperatorConfig
+
+
+@dataclasses.dataclass
+class ResolvedExecutionConfig:
+    """The flattened result (reference: resolver.go:171)."""
+
+    image: Optional[str] = None
+    entrypoint: Optional[str] = None
+    image_pull_policy: Optional[str] = None
+    resources: Optional[ResourcePolicy] = None
+    security: Optional[SecurityPolicy] = None
+    placement: Optional[PlacementPolicy] = None
+    probes: Optional[ProbeOverrides] = None
+    job: Optional[JobPolicy] = None
+    workload: Optional[WorkloadSpec] = None
+    retry: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
+    timeout_seconds: Optional[float] = None
+    storage: Optional[StoragePolicy] = None
+    cache: Optional[CachePolicy] = None
+    tpu: Optional[TPUPolicy] = None
+    max_inline_size: int = 16 * 1024
+    max_recursion_depth: int = 10
+    service_account_name: Optional[str] = None
+    debug: bool = False
+
+
+def _merge_spec(base, override):
+    """Recursive field-wise merge of two SpecBase instances (same type);
+    override's non-None fields win, nested SpecBase fields merge."""
+    if base is None:
+        return override
+    if override is None:
+        return base
+    from ..api.specbase import SpecBase
+
+    kwargs = {}
+    for f in dataclasses.fields(base):
+        b, o = getattr(base, f.name), getattr(override, f.name)
+        if isinstance(b, SpecBase) and isinstance(o, SpecBase):
+            kwargs[f.name] = _merge_spec(b, o)
+        elif isinstance(o, dict) and isinstance(b, dict):
+            kwargs[f.name] = {**b, **o}
+        elif o is not None and o != [] and o != {}:
+            kwargs[f.name] = o
+        else:
+            kwargs[f.name] = b
+    return type(base)(**kwargs)
+
+
+class Resolver:
+    """(reference: internal/config/resolver.go:113)"""
+
+    def __init__(self, operator_config: OperatorConfig):
+        self.operator_config = operator_config
+
+    def resolve(
+        self,
+        template_spec=None,  # api.catalog.EngramTemplateSpec
+        engram_spec=None,  # api.engram.EngramSpec
+        story_policy=None,  # api.story.StoryPolicy
+        step=None,  # api.story.Step
+        steprun_overrides: Optional[ExecutionOverrides] = None,
+    ) -> ResolvedExecutionConfig:
+        """Merge all layers into one ResolvedExecutionConfig
+        (reference: ResolveExecutionConfig resolver.go:257)."""
+        cfg = self.operator_config
+        out = ResolvedExecutionConfig(
+            retry=RetryPolicy(
+                max_retries=cfg.default_retry_max,
+                delay=f"{cfg.default_retry_delay}s",
+                max_delay=f"{cfg.default_retry_max_delay}s",
+                jitter=cfg.default_retry_jitter_pct,
+            ),
+            timeout_seconds=cfg.timeouts.step_seconds or None,
+            max_inline_size=cfg.engram.max_inline_size,
+            max_recursion_depth=cfg.engram.max_recursion_depth,
+            debug=cfg.engram.debug,
+        )
+
+        # layer 2: template recommendations
+        if template_spec is not None:
+            out.image = template_spec.image or out.image
+            out.entrypoint = template_spec.entrypoint or out.entrypoint
+            self._apply_policy(out, template_spec.execution_policy)
+
+        # layer 3: engram instance
+        if engram_spec is not None:
+            self._apply_overrides(out, engram_spec.execution)
+            if engram_spec.workload is not None:
+                out.workload = _merge_spec(out.workload, engram_spec.workload)
+
+        # layer 4: story policy + step
+        if story_policy is not None:
+            self._apply_policy(out, story_policy.execution)
+            if story_policy.storage is not None:
+                out.storage = _merge_spec(out.storage, story_policy.storage)
+            if story_policy.timeouts is not None and story_policy.timeouts.step:
+                out.timeout_seconds = parse_duration(story_policy.timeouts.step)
+            if (
+                story_policy.retries is not None
+                and story_policy.retries.step_retry_policy is not None
+            ):
+                out.retry = _merge_spec(out.retry, story_policy.retries.step_retry_policy)
+        if step is not None:
+            self._apply_overrides(out, step.execution)
+            if step.tpu is not None:
+                out.tpu = _merge_spec(out.tpu, step.tpu)
+
+        # layer 5: steprun runtime overrides
+        self._apply_overrides(out, steprun_overrides)
+
+        if out.storage is not None and out.storage.max_inline_size is not None:
+            out.max_inline_size = out.storage.max_inline_size
+        return out
+
+    @staticmethod
+    def _apply_policy(out: ResolvedExecutionConfig, pol: Optional[ExecutionPolicy]) -> None:
+        if pol is None:
+            return
+        out.resources = _merge_spec(out.resources, pol.resources)
+        out.security = _merge_spec(out.security, pol.security)
+        out.placement = _merge_spec(out.placement, pol.placement)
+        out.probes = _merge_spec(out.probes, pol.probes)
+        out.job = _merge_spec(out.job, pol.job)
+        out.retry = _merge_spec(out.retry, pol.retry)
+        out.storage = _merge_spec(out.storage, pol.storage)
+        out.cache = _merge_spec(out.cache, pol.cache)
+        if pol.timeout:
+            out.timeout_seconds = parse_duration(pol.timeout)
+        if pol.max_recursion_depth is not None:
+            out.max_recursion_depth = pol.max_recursion_depth
+        if pol.service_account_name:
+            out.service_account_name = pol.service_account_name
+        if pol.placement is not None and pol.placement.tpu is not None:
+            out.tpu = _merge_spec(out.tpu, pol.placement.tpu)
+
+    @staticmethod
+    def _apply_overrides(
+        out: ResolvedExecutionConfig, ov: Optional[ExecutionOverrides]
+    ) -> None:
+        if ov is None:
+            return
+        if ov.image:
+            out.image = ov.image
+        if ov.image_pull_policy:
+            out.image_pull_policy = ov.image_pull_policy
+        out.security = _merge_spec(out.security, ov.security)
+        out.placement = _merge_spec(out.placement, ov.placement)
+        out.probes = _merge_spec(out.probes, ov.probes)
+        out.retry = _merge_spec(out.retry, ov.retry)
+        out.storage = _merge_spec(out.storage, ov.storage)
+        out.cache = _merge_spec(out.cache, ov.cache)
+        if ov.workload is not None:
+            out.workload = _merge_spec(out.workload, ov.workload)
+        if ov.timeout:
+            out.timeout_seconds = parse_duration(ov.timeout)
+        if ov.max_inline_size is not None:
+            out.max_inline_size = ov.max_inline_size
+        if ov.service_account_name:
+            out.service_account_name = ov.service_account_name
+        if ov.debug is not None:
+            out.debug = ov.debug
+        if ov.placement is not None and ov.placement.tpu is not None:
+            out.tpu = _merge_spec(out.tpu, ov.placement.tpu)

@@ -1,0 +1,44 @@
+"""Payload offload: blob stores + dehydrate/hydrate manager."""
+
+from .manager import (
+    DEFAULT_MAX_INLINE_SIZE,
+    StorageManager,
+    StorageRef,
+)
+from .store import (
+    BlobNotFound,
+    FileStore,
+    MemoryStore,
+    S3Store,
+    SliceLocalSSDStore,
+    StorageError,
+    Store,
+)
+
+
+def build_store(policy, base_dir: str = "/tmp/bobrapet-storage") -> Store:
+    """Construct a Store from a StoragePolicy (api.shared.StoragePolicy)."""
+    if policy is None:
+        return FileStore(base_dir)
+    if getattr(policy, "slice_local_ssd", None) is not None:
+        return SliceLocalSSDStore(policy.slice_local_ssd.path)
+    if getattr(policy, "s3", None) is not None:
+        return S3Store(bucket=policy.s3.bucket)
+    if getattr(policy, "file", None) is not None and policy.file.path:
+        return FileStore(policy.file.path)
+    return FileStore(base_dir)
+
+
+__all__ = [
+    "DEFAULT_MAX_INLINE_SIZE",
+    "StorageManager",
+    "StorageRef",
+    "BlobNotFound",
+    "FileStore",
+    "MemoryStore",
+    "S3Store",
+    "SliceLocalSSDStore",
+    "StorageError",
+    "Store",
+    "build_store",
+]

@@ -1,0 +1,246 @@
+"""StorageManager: recursive dehydrate/hydrate of oversized payloads.
+
+Capability parity with the reference's StorageManager
+(reference: pkg/storage/manager.go:177 — Dehydrate:465, Hydrate:312,
+DehydrateInputs:375, validateStorageRef:518; path tokens path.go:23-94;
+RetentionPolicy retention.go:41):
+
+- **Dehydrate**: walk a JSON-like value; any subtree whose serialized
+  size exceeds ``max_inline_size`` is written to the blob store and
+  replaced with a ``{"storageRef": {...}}`` marker. Recursion depth is
+  capped. Top-level helper ``dehydrate_inputs`` offloads per input key.
+- **Hydrate**: walk a value; every storageRef marker is resolved back to
+  the stored payload (validating the ref shape and scope prefix first, so
+  a spoofed ref cannot read another run's data — the reference's
+  storage-ref spoofing rejection, storyrun_webhook.go:389).
+- **Retention**: delete blobs under a run's prefix after the run record
+  is cleaned up (two-phase retention, SURVEY §5.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Any, Optional
+
+from ..templating.engine import STORAGE_REF_KEY, is_storage_ref
+from .store import BlobNotFound, Store, StorageError
+
+DEFAULT_MAX_INLINE_SIZE = 16 * 1024  # bytes of canonical JSON
+DEFAULT_MAX_DEPTH = 32
+
+
+@dataclasses.dataclass
+class StorageRef:
+    """The marker payload (reference: manager.go storageRef shape)."""
+
+    key: str
+    provider: str
+    size: int
+    sha256: Optional[str] = None
+    content_type: str = "application/json"
+
+    def to_marker(self) -> dict[str, Any]:
+        return {
+            STORAGE_REF_KEY: {
+                "key": self.key,
+                "provider": self.provider,
+                "size": self.size,
+                "sha256": self.sha256,
+                "contentType": self.content_type,
+            }
+        }
+
+    @classmethod
+    def from_marker(cls, marker: dict[str, Any]) -> "StorageRef":
+        d = marker[STORAGE_REF_KEY]
+        return cls(
+            key=d.get("key", ""),
+            provider=d.get("provider", ""),
+            size=int(d.get("size", 0)),
+            sha256=d.get("sha256"),
+            content_type=d.get("contentType", "application/json"),
+        )
+
+
+class StorageManager:
+    """Offload/rehydrate engine over one Store backend."""
+
+    def __init__(
+        self,
+        store: Store,
+        max_inline_size: int = DEFAULT_MAX_INLINE_SIZE,
+        max_depth: int = DEFAULT_MAX_DEPTH,
+    ):
+        self.store = store
+        self.max_inline_size = max_inline_size
+        self.max_depth = max_depth
+
+    # -- key scheme --------------------------------------------------------
+
+    @staticmethod
+    def run_prefix(namespace: str, run_name: str) -> str:
+        return f"runs/{namespace}/{run_name}"
+
+    @staticmethod
+    def step_key(namespace: str, run_name: str, step: str, field: str) -> str:
+        return f"runs/{namespace}/{run_name}/steps/{step}/{field}"
+
+    # -- dehydrate ---------------------------------------------------------
+
+    def dehydrate(
+        self,
+        value: Any,
+        key_prefix: str,
+        max_inline_size: Optional[int] = None,
+    ) -> Any:
+        """Replace oversized subtrees with storageRef markers
+        (reference: Dehydrate manager.go:465)."""
+        limit = self.max_inline_size if max_inline_size is None else max_inline_size
+        return self._dehydrate(value, key_prefix, limit, depth=0, counter=[0])
+
+    def dehydrate_inputs(
+        self,
+        inputs: dict[str, Any],
+        key_prefix: str,
+        max_inline_size: Optional[int] = None,
+    ) -> dict[str, Any]:
+        """Per-key offload of a top-level inputs map
+        (reference: DehydrateInputs manager.go:375)."""
+        limit = self.max_inline_size if max_inline_size is None else max_inline_size
+        out = {}
+        for k, v in inputs.items():
+            out[k] = self._dehydrate(v, f"{key_prefix}/{k}", limit, 0, [0])
+        return out
+
+    def _dehydrate(
+        self, value: Any, key_prefix: str, limit: int, depth: int, counter: list[int]
+    ) -> Any:
+        if depth > self.max_depth:
+            raise StorageError(f"dehydrate recursion depth {depth} exceeded")
+        if is_storage_ref(value):
+            return value  # already offloaded
+        blob = _encode(value)
+        if len(blob) <= limit:
+            return value
+        # Too big inline. Containers first try slimming children; scalars
+        # and still-oversized containers offload whole.
+        if isinstance(value, dict):
+            slim = {
+                k: self._dehydrate(v, f"{key_prefix}/{k}", limit, depth + 1, counter)
+                for k, v in value.items()
+            }
+            if len(_encode(slim)) <= limit:
+                return slim
+            value = slim
+        elif isinstance(value, list):
+            slim = [
+                self._dehydrate(v, f"{key_prefix}/{i}", limit, depth + 1, counter)
+                for i, v in enumerate(value)
+            ]
+            if len(_encode(slim)) <= limit:
+                return slim
+            value = slim
+        counter[0] += 1
+        key = f"{key_prefix}-{counter[0]}"
+        data = _encode(value)
+        self.store.put(key, data)
+        import hashlib
+
+        ref = StorageRef(
+            key=key,
+            provider=self.store.provider,
+            size=len(data),
+            sha256=hashlib.sha256(data).hexdigest(),
+        )
+        return ref.to_marker()
+
+    # -- hydrate -----------------------------------------------------------
+
+    def hydrate(
+        self,
+        value: Any,
+        allowed_prefixes: Optional[list[str]] = None,
+        depth: int = 0,
+    ) -> Any:
+        """Resolve storageRef markers back into values
+        (reference: Hydrate manager.go:312).
+
+        ``allowed_prefixes`` is the anti-spoofing scope: every ref key must
+        live under one of them (reference: validateStorageRef manager.go:518
+        + storyrun_webhook.go:389).
+        """
+        if depth > self.max_depth:
+            raise StorageError("hydrate recursion depth exceeded")
+        if is_storage_ref(value):
+            ref = StorageRef.from_marker(value)
+            self.validate_ref(ref, allowed_prefixes)
+            data = self.store.get(ref.key)
+            if ref.sha256:
+                import hashlib
+
+                actual = hashlib.sha256(data).hexdigest()
+                if actual != ref.sha256:
+                    raise StorageError(
+                        f"blob {ref.key!r} digest mismatch (corrupted or tampered)"
+                    )
+            payload = _decode(data)
+            # hydrated payload may itself contain refs (nested offload)
+            return self.hydrate(payload, allowed_prefixes, depth + 1)
+        # depth counts resolved refs only — plain container nesting must
+        # hydrate anything dehydrate passed through inline
+        if isinstance(value, dict):
+            return {k: self.hydrate(v, allowed_prefixes, depth) for k, v in value.items()}
+        if isinstance(value, list):
+            return [self.hydrate(v, allowed_prefixes, depth) for v in value]
+        return value
+
+    @staticmethod
+    def validate_ref(ref: StorageRef, allowed_prefixes: Optional[list[str]]) -> None:
+        if not ref.key or ".." in ref.key.split("/") or ref.key.startswith("/"):
+            raise StorageError(f"invalid storage ref key {ref.key!r}")
+        if allowed_prefixes is not None and not any(
+            ref.key.startswith(p.rstrip("/") + "/") or ref.key == p
+            for p in allowed_prefixes
+        ):
+            raise StorageError(
+                f"storage ref {ref.key!r} outside allowed scope {allowed_prefixes}"
+            )
+
+    # -- retention ---------------------------------------------------------
+
+    @staticmethod
+    def _bounded(prefix: str) -> str:
+        # path-segment boundary: 'runs/ns/r1' must not match 'runs/ns/r10'
+        return prefix.rstrip("/") + "/"
+
+    def delete_prefix(self, prefix: str) -> int:
+        """Remove every blob under a prefix; returns count
+        (run-record cleanup, reference: retention.go:41)."""
+        n = 0
+        for key in self.store.list(self._bounded(prefix)):
+            self.store.delete(key)
+            n += 1
+        return n
+
+    def sweep_expired(self, prefix: str, ttl_seconds: float) -> int:
+        """Delete blobs older than ttl under prefix (cache retention)."""
+        cutoff = time.time() - ttl_seconds
+        n = 0
+        for key in self.store.list(self._bounded(prefix)):
+            try:
+                if self.store.stat_mtime(key) < cutoff:
+                    self.store.delete(key)
+                    n += 1
+            except BlobNotFound:
+                continue
+        return n
+
+
+def _encode(value: Any) -> bytes:
+    return json.dumps(value, sort_keys=True, separators=(",", ":"), default=str).encode()
+
+
+def _decode(data: bytes) -> Any:
+    return json.loads(data.decode())

@@ -1,0 +1,298 @@
+"""Blob store backends for payload offload.
+
+Capability parity with the reference's Store interface + backends
+(reference: pkg/storage/store.go:26, s3_store.go:184, file_store.go:35):
+a minimal blob API (put/get/delete/list/exists) behind which S3/MinIO,
+filesystem, and — TPU-native — slice-local SSD all look identical to the
+StorageManager.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+
+class StorageError(Exception):
+    pass
+
+
+class BlobNotFound(StorageError):
+    def __init__(self, key: str):
+        super().__init__(f"blob {key!r} not found")
+        self.key = key
+
+
+class Store:
+    """Abstract blob store (reference: pkg/storage/store.go:26)."""
+
+    #: provider name recorded inside storageRef markers
+    provider = "abstract"
+
+    def put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def list(self, prefix: str = "") -> list[str]:
+        raise NotImplementedError
+
+    def stat_mtime(self, key: str) -> float:
+        """Last-modified time (for retention sweeps)."""
+        raise NotImplementedError
+
+
+def _safe_rel(key: str) -> str:
+    """Map a blob key to a safe relative path (no traversal/absolute)."""
+    parts = [p for p in key.split("/") if p not in ("", ".", "..")]
+    if not parts:
+        raise StorageError(f"invalid blob key {key!r}")
+    return os.path.join(*parts)
+
+
+class FileStore(Store):
+    """Filesystem-backed store (reference: pkg/storage/file_store.go:35).
+
+    Serves both the PVC-style shared-filesystem provider and, with a
+    slice-local mount path, the TPU slice-local SSD provider.
+    """
+
+    provider = "file"
+
+    def __init__(self, base_dir: str):
+        self.base_dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.base_dir, _safe_rel(key))
+
+    def put(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def get(self, key: str) -> bytes:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise BlobNotFound(key) from None
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def exists(self, key: str) -> bool:
+        return os.path.isfile(self._path(key))
+
+    def list(self, prefix: str = "") -> list[str]:
+        out = []
+        for root, _, files in os.walk(self.base_dir):
+            for fname in files:
+                full = os.path.join(root, fname)
+                key = os.path.relpath(full, self.base_dir).replace(os.sep, "/")
+                if key.startswith(prefix):
+                    out.append(key)
+        return sorted(out)
+
+    def stat_mtime(self, key: str) -> float:
+        try:
+            return os.stat(self._path(key)).st_mtime
+        except FileNotFoundError:
+            raise BlobNotFound(key) from None
+
+
+class SliceLocalSSDStore(FileStore):
+    """TPU-native: slice-local SSD offload (SURVEY north star).
+
+    Behaves like a FileStore rooted at the slice-local mount, but records
+    the slice identity so the scheduler can keep consumers of these blobs
+    on the same slice (slice-affinity is surfaced through ``provider`` +
+    ``slice`` fields in the storageRef marker).
+    """
+
+    provider = "slice-ssd"
+
+    def __init__(self, base_dir: str, slice_id: str = "local"):
+        super().__init__(base_dir)
+        self.slice_id = slice_id
+
+
+class MemoryStore(Store):
+    """In-memory store for tests and the envtest-style harness."""
+
+    provider = "memory"
+
+    def __init__(self):
+        self._blobs: dict[str, tuple[bytes, float]] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key: str, data: bytes) -> None:
+        with self._lock:
+            self._blobs[key] = (bytes(data), time.time())
+
+    def get(self, key: str) -> bytes:
+        with self._lock:
+            if key not in self._blobs:
+                raise BlobNotFound(key)
+            return self._blobs[key][0]
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._blobs.pop(key, None)
+
+    def exists(self, key: str) -> bool:
+        with self._lock:
+            return key in self._blobs
+
+    def list(self, prefix: str = "") -> list[str]:
+        with self._lock:
+            return sorted(k for k in self._blobs if k.startswith(prefix))
+
+    def stat_mtime(self, key: str) -> float:
+        with self._lock:
+            if key not in self._blobs:
+                raise BlobNotFound(key)
+            return self._blobs[key][1]
+
+
+class S3Store(Store):
+    """S3/MinIO-backed store (reference: pkg/storage/s3_store.go:184).
+
+    The runtime image has no AWS SDK; the client is injected — any object
+    with ``put_object/get_object/delete_object/list_objects`` (a boto3
+    client satisfies this). Constructing without a client raises a clear
+    error at first use, so specs referencing S3 stay valid everywhere.
+    """
+
+    provider = "s3"
+
+    def __init__(
+        self,
+        bucket: str,
+        client=None,
+        prefix: str = "",
+        retries: int = 3,
+        retry_delay: float = 0.2,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self._client = client
+        self._retries = retries
+        self._retry_delay = retry_delay
+        self._sleep = sleep
+
+    def _k(self, key: str) -> str:
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def _require_client(self):
+        if self._client is None:
+            raise StorageError(
+                "S3 store has no client configured (install/inject an S3 "
+                "client or switch storage.file / storage.sliceLocalSsd)"
+            )
+        return self._client
+
+    def _with_retries(self, fn: Callable[[], object]):
+        last: Optional[Exception] = None
+        for attempt in range(self._retries + 1):
+            try:
+                return fn()
+            except BlobNotFound:
+                raise
+            except Exception as e:  # noqa: BLE001 - SDK errors are opaque
+                last = e
+                if attempt < self._retries:
+                    self._sleep(self._retry_delay * (2**attempt))
+        raise StorageError(f"s3 operation failed after retries: {last}")
+
+    def put(self, key: str, data: bytes) -> None:
+        c = self._require_client()
+        self._with_retries(
+            lambda: c.put_object(Bucket=self.bucket, Key=self._k(key), Body=data)
+        )
+
+    def get(self, key: str) -> bytes:
+        c = self._require_client()
+
+        def read():
+            try:
+                resp = c.get_object(Bucket=self.bucket, Key=self._k(key))
+            except Exception as e:  # noqa: BLE001
+                if type(e).__name__ in ("NoSuchKey", "NotFound"):
+                    raise BlobNotFound(key) from None
+                raise
+            body = resp["Body"]
+            return body.read() if hasattr(body, "read") else body
+
+        return self._with_retries(read)
+
+    def delete(self, key: str) -> None:
+        c = self._require_client()
+        self._with_retries(
+            lambda: c.delete_object(Bucket=self.bucket, Key=self._k(key))
+        )
+
+    def exists(self, key: str) -> bool:
+        c = self._require_client()
+        if hasattr(c, "head_object"):
+            try:
+                self._with_retries(
+                    lambda: c.head_object(Bucket=self.bucket, Key=self._k(key))
+                )
+                return True
+            except (BlobNotFound, StorageError):
+                return False
+        try:
+            self.get(key)
+            return True
+        except BlobNotFound:
+            return False
+
+    def list(self, prefix: str = "") -> list[str]:
+        c = self._require_client()
+        keys: list[str] = []
+        marker: Optional[str] = None
+        while True:
+            kwargs = {"Bucket": self.bucket, "Prefix": self._k(prefix)}
+            if marker:
+                kwargs["Marker"] = marker
+            resp = self._with_retries(lambda: c.list_objects(**kwargs))
+            contents = resp.get("Contents", []) if isinstance(resp, dict) else []
+            for item in contents:
+                k = item.get("Key", "")
+                if self.prefix and k.startswith(self.prefix + "/"):
+                    k = k[len(self.prefix) + 1 :]
+                keys.append(k)
+            if not (isinstance(resp, dict) and resp.get("IsTruncated") and contents):
+                break
+            marker = contents[-1].get("Key")
+        return sorted(keys)
+
+    def stat_mtime(self, key: str) -> float:
+        c = self._require_client()
+        if hasattr(c, "head_object"):
+            resp = self._with_retries(
+                lambda: c.head_object(Bucket=self.bucket, Key=self._k(key))
+            )
+            lm = resp.get("LastModified") if isinstance(resp, dict) else None
+            if lm is not None:
+                return lm.timestamp() if hasattr(lm, "timestamp") else float(lm)
+        raise StorageError(
+            "s3 client cannot report LastModified; retention sweep unsupported"
+        )

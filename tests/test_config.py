@@ -1,0 +1,149 @@
+"""Operator config parsing/live-reload + hierarchical resolver tests."""
+
+from bobrapet_tpu.api.catalog import EngramTemplateSpec
+from bobrapet_tpu.api.engram import EngramSpec
+from bobrapet_tpu.api.enums import OffloadedDataPolicy
+from bobrapet_tpu.api.shared import ExecutionOverrides
+from bobrapet_tpu.api.story import Step, StoryPolicy
+from bobrapet_tpu.config import (
+    OperatorConfig,
+    OperatorConfigManager,
+    Resolver,
+    parse_config,
+)
+from bobrapet_tpu.core import ResourceStore, new_resource
+
+
+class TestParseConfig:
+    def test_dotted_keys(self):
+        cfg = parse_config(
+            {
+                "controllers.max-concurrent-reconciles": "8",
+                "templating.offloaded-data-policy": "inject",
+                "templating.deterministic": "false",
+                "engram.max-inline-size": "4096",
+                "scheduling.global-max-concurrent-steps": "50",
+                "scheduling.queue.v5e-pool.max-concurrent": "4",
+                "scheduling.queue.v5e-pool.accelerator": "tpu-v5-lite-podslice",
+                "scheduling.queue.v5e-pool.chip-budget": "16",
+                "reference-cross-namespace-policy": "grant",
+                "retention.children-ttl": "30m",
+                "timeouts.approval": "2h",
+            }
+        )
+        assert cfg.controllers.max_concurrent_reconciles == 8
+        assert cfg.templating.offloaded_data_policy is OffloadedDataPolicy.INJECT
+        assert not cfg.templating.deterministic
+        assert cfg.engram.max_inline_size == 4096
+        assert cfg.scheduling.global_max_concurrent_steps == 50
+        q = cfg.scheduling.queue("v5e-pool")
+        assert q.max_concurrent == 4 and q.chip_budget == 16
+        assert cfg.reference_cross_namespace_policy == "grant"
+        assert cfg.retention.children_ttl_seconds == 1800
+        assert cfg.timeouts.approval_seconds == 7200
+
+    def test_invalid_values_keep_defaults(self):
+        cfg = parse_config({"engram.grpc-port": "not-a-port", "unknown.key": "x"})
+        assert cfg.engram.grpc_port == 50051
+
+    def test_validation(self):
+        cfg = OperatorConfig()
+        cfg.reference_cross_namespace_policy = "maybe"
+        assert any("referenceCrossNamespacePolicy" in e for e in cfg.validate())
+
+
+class TestLiveReload:
+    def test_manager_watches_configmap(self):
+        store = ResourceStore()
+        mgr = OperatorConfigManager(store, namespace="sys", name="op")
+        assert mgr.config.engram.max_inline_size == 16 * 1024
+        seen = []
+        mgr.subscribe(lambda c: seen.append(c.engram.max_inline_size))
+        store.create(
+            new_resource("ConfigMap", "op", "sys", spec={"data": {"engram.max-inline-size": "1234"}})
+        )
+        assert mgr.config.engram.max_inline_size == 1234
+        assert seen == [1234]
+        store.mutate(
+            "ConfigMap", "sys", "op",
+            lambda r: r.spec.update(data={"engram.max-inline-size": "99"}),
+        )
+        assert mgr.config.engram.max_inline_size == 99
+
+    def test_initial_load_from_existing(self):
+        store = ResourceStore()
+        store.create(
+            new_resource("ConfigMap", "op", "sys", spec={"data": {"logging.verbosity": "3"}})
+        )
+        mgr = OperatorConfigManager(store, namespace="sys", name="op")
+        assert mgr.config.verbosity == 3
+
+    def test_invalid_reload_keeps_last_good(self):
+        store = ResourceStore()
+        mgr = OperatorConfigManager(store, namespace="sys", name="op")
+        store.create(
+            new_resource(
+                "ConfigMap", "op", "sys",
+                spec={"data": {"reference-cross-namespace-policy": "chaos"}},
+            )
+        )
+        assert mgr.config.reference_cross_namespace_policy == "deny"
+
+
+class TestResolver:
+    def test_layering_order(self):
+        cfg = OperatorConfig()
+        r = Resolver(cfg)
+        template = EngramTemplateSpec.from_dict(
+            {
+                "image": "gcr.io/x/llama:1",
+                "entrypoint": "engrams.llama:run",
+                "executionPolicy": {
+                    "timeout": "20m",
+                    "retry": {"maxRetries": 5},
+                    "resources": {"requests": {"cpu": "4"}},
+                },
+            }
+        )
+        engram = EngramSpec.from_dict(
+            {"templateRef": {"name": "t"}, "execution": {"retry": {"maxRetries": 7}}}
+        )
+        policy = StoryPolicy.from_dict(
+            {"execution": {"timeout": "10m"}, "storage": {"maxInlineSize": 2048}}
+        )
+        step = Step.from_dict(
+            {
+                "name": "gen",
+                "ref": {"name": "llama"},
+                "execution": {"timeout": "5m"},
+                "tpu": {"topology": "2x4", "accelerator": "tpu-v5-lite-podslice"},
+            }
+        )
+        overrides = ExecutionOverrides.from_dict({"retry": {"maxRetries": 1}})
+
+        out = r.resolve(template, engram, policy, step, overrides)
+        assert out.image == "gcr.io/x/llama:1"
+        assert out.entrypoint == "engrams.llama:run"
+        assert out.timeout_seconds == 300  # step wins over story over template
+        assert out.retry.max_retries == 1  # steprun override wins
+        assert out.resources.requests.cpu == "4"  # template survives
+        assert out.max_inline_size == 2048  # story storage policy
+        assert out.tpu.chip_count() == 8
+
+    def test_defaults_only(self):
+        out = Resolver(OperatorConfig()).resolve()
+        assert out.retry.max_retries == 3
+        assert out.max_inline_size == 16 * 1024
+        assert out.timeout_seconds == 3600
+
+    def test_partial_nested_merge(self):
+        r = Resolver(OperatorConfig())
+        template = EngramTemplateSpec.from_dict(
+            {"executionPolicy": {"retry": {"maxRetries": 5, "delay": "9s"}}}
+        )
+        step = Step.from_dict(
+            {"name": "s", "ref": {"name": "e"}, "execution": {"retry": {"maxRetries": 2}}}
+        )
+        out = r.resolve(template_spec=template, step=step)
+        assert out.retry.max_retries == 2
+        assert out.retry.delay == "9s"  # inherited from template layer

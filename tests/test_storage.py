@@ -1,0 +1,174 @@
+"""Storage offload: dehydrate/hydrate round-trips, spoofing guards, retention."""
+
+import json
+
+import pytest
+
+from bobrapet_tpu.storage import (
+    BlobNotFound,
+    FileStore,
+    MemoryStore,
+    S3Store,
+    StorageError,
+    StorageManager,
+    StorageRef,
+)
+from bobrapet_tpu.templating import is_storage_ref
+
+
+@pytest.fixture
+def mgr():
+    # limit must exceed one storageRef marker (~150B of JSON) or slimmed
+    # containers re-offload wholesale
+    return StorageManager(MemoryStore(), max_inline_size=256)
+
+
+BIG = "x" * 500
+SMALL = {"a": 1}
+
+
+class TestDehydrate:
+    def test_small_values_stay_inline(self, mgr):
+        v = {"a": 1, "b": "short"}
+        assert mgr.dehydrate(v, "runs/ns/r1/in") == v
+
+    def test_large_scalar_offloads(self, mgr):
+        out = mgr.dehydrate({"doc": BIG}, "runs/ns/r1/in")
+        assert is_storage_ref(out["doc"])
+        ref = StorageRef.from_marker(out["doc"])
+        assert ref.key.startswith("runs/ns/r1/in/doc")
+        assert ref.size >= 500
+
+    def test_nested_selective_offload(self, mgr):
+        v = {"meta": {"k": 1}, "body": {"text": BIG, "tag": "t"}}
+        out = mgr.dehydrate(v, "runs/ns/r1/in")
+        assert out["meta"] == {"k": 1}
+        assert is_storage_ref(out["body"]["text"]) or is_storage_ref(out["body"])
+
+    def test_dehydrate_inputs_per_key(self, mgr):
+        out = mgr.dehydrate_inputs({"q": "small", "ctx": BIG}, "runs/ns/r1/inputs")
+        assert out["q"] == "small"
+        assert is_storage_ref(out["ctx"])
+
+    def test_already_offloaded_passthrough(self, mgr):
+        marker = {"storageRef": {"key": "runs/ns/r1/x", "provider": "memory", "size": 1}}
+        assert mgr.dehydrate(marker, "runs/ns/r1/in") == marker
+
+    def test_depth_cap(self):
+        mgr = StorageManager(MemoryStore(), max_inline_size=1, max_depth=3)
+        deep = {"a": {"b": {"c": {"d": {"e": BIG}}}}}
+        with pytest.raises(StorageError):
+            mgr.dehydrate(deep, "runs/ns/r1/in")
+
+
+class TestHydrate:
+    def test_roundtrip(self, mgr):
+        original = {"doc": BIG, "n": 7, "nested": {"big": BIG + BIG, "small": True}}
+        out = mgr.dehydrate(original, "runs/ns/r1/in")
+        assert mgr.hydrate(out, allowed_prefixes=["runs/ns/r1"]) == original
+
+    def test_scope_enforcement(self, mgr):
+        out = mgr.dehydrate({"doc": BIG}, "runs/ns/r1/in")
+        with pytest.raises(StorageError):
+            mgr.hydrate(out, allowed_prefixes=["runs/ns/OTHER"])
+
+    def test_spoofed_ref_traversal_rejected(self, mgr):
+        evil = {"storageRef": {"key": "../secrets/creds", "provider": "memory", "size": 1}}
+        with pytest.raises(StorageError):
+            mgr.hydrate(evil, allowed_prefixes=["runs/ns/r1"])
+
+    def test_digest_mismatch_detected(self, mgr):
+        out = mgr.dehydrate({"doc": BIG}, "runs/ns/r1/in")
+        ref = StorageRef.from_marker(out["doc"])
+        mgr.store.put(ref.key, json.dumps("tampered").encode())
+        with pytest.raises(StorageError):
+            mgr.hydrate(out, allowed_prefixes=["runs/ns/r1"])
+
+    def test_missing_blob(self, mgr):
+        marker = {
+            "storageRef": {"key": "runs/ns/r1/gone", "provider": "memory", "size": 9}
+        }
+        with pytest.raises(BlobNotFound):
+            mgr.hydrate(marker, allowed_prefixes=["runs/ns/r1"])
+
+
+class TestRetention:
+    def test_delete_prefix(self, mgr):
+        mgr.dehydrate({"a": BIG}, "runs/ns/r1/in")
+        mgr.dehydrate({"a": BIG}, "runs/ns/r2/in")
+        n = mgr.delete_prefix(StorageManager.run_prefix("ns", "r1"))
+        assert n == 1
+        assert mgr.store.list("runs/ns/r1") == []
+        assert len(mgr.store.list("runs/ns/r2")) == 1
+
+    def test_delete_prefix_respects_segment_boundary(self, mgr):
+        mgr.dehydrate({"a": BIG}, "runs/ns/r1/in")
+        mgr.dehydrate({"a": BIG}, "runs/ns/r10/in")
+        mgr.delete_prefix(StorageManager.run_prefix("ns", "r1"))
+        # r10's blobs must survive r1's cleanup
+        assert len(mgr.store.list("runs/ns/r10")) == 1
+
+    def test_hydrate_tolerates_deep_inline_nesting(self, mgr):
+        v = {"leaf": 1}
+        for _ in range(40):
+            v = {"level": v}
+        out = mgr.dehydrate(v, "runs/ns/r1/in")
+        assert mgr.hydrate(out, allowed_prefixes=["runs/ns/r1"]) == v
+
+
+class TestFileStore:
+    def test_roundtrip_and_traversal_guard(self, tmp_path):
+        fs = FileStore(str(tmp_path))
+        fs.put("runs/a/b", b"data")
+        assert fs.get("runs/a/b") == b"data"
+        assert fs.list("runs/") == ["runs/a/b"]
+        # key traversal cannot escape the base dir
+        fs.put("../../evil", b"x")
+        assert (tmp_path.parent.parent / "evil").exists() is False
+
+    def test_missing(self, tmp_path):
+        fs = FileStore(str(tmp_path))
+        with pytest.raises(BlobNotFound):
+            fs.get("nope")
+
+
+class TestS3Store:
+    def test_requires_client(self):
+        s = S3Store(bucket="b")
+        with pytest.raises(StorageError, match="no client"):
+            s.put("k", b"v")
+
+    def test_fake_client_roundtrip_with_retries(self):
+        NoSuchKey = type("NoSuchKey", (Exception,), {})
+
+        class FlakyClient:
+            def __init__(self):
+                self.objects = {}
+                self.failures = 2
+
+            def put_object(self, Bucket, Key, Body):
+                if self.failures > 0:
+                    self.failures -= 1
+                    raise ConnectionError("flake")
+                self.objects[Key] = Body
+
+            def get_object(self, Bucket, Key):
+                if Key not in self.objects:
+                    raise NoSuchKey("missing")
+                return {"Body": self.objects[Key]}
+
+            def delete_object(self, Bucket, Key):
+                self.objects.pop(Key, None)
+
+            def list_objects(self, Bucket, Prefix):
+                return {
+                    "Contents": [
+                        {"Key": k} for k in self.objects if k.startswith(Prefix)
+                    ]
+                }
+
+        s = S3Store(bucket="b", client=FlakyClient(), prefix="base", sleep=lambda _: None)
+        s.put("runs/r1/x", b"payload")
+        assert s.get("runs/r1/x") == b"payload"
+        assert s.list("runs/") == ["runs/r1/x"]
+        assert not s.exists("runs/r1/gone")
