@@ -1,0 +1,26 @@
+"""Controllers: reconcile loops over the coordination bus."""
+
+from .dag import DAGEngine
+from .jobs import JOB_KIND, LocalGangExecutor, make_job
+from .manager import Clock, ControllerManager, ManualClock, jittered_backoff
+from .retry import classify_exit_code, compute_retry_delay, retry_budget_left
+from .step_executor import StepExecutor
+from .steprun import StepRunController
+from .storyrun import StoryRunController
+
+__all__ = [
+    "DAGEngine",
+    "JOB_KIND",
+    "LocalGangExecutor",
+    "make_job",
+    "Clock",
+    "ControllerManager",
+    "ManualClock",
+    "jittered_backoff",
+    "classify_exit_code",
+    "compute_retry_delay",
+    "retry_budget_left",
+    "StepExecutor",
+    "StepRunController",
+    "StoryRunController",
+]

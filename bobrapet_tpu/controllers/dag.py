@@ -1,0 +1,732 @@
+"""DAG engine: the workflow scheduler.
+
+Capability parity with the reference's DAG reconciler
+(reference: internal/controller/runs/dag.go — Reconcile:306,
+runDagIterations:381, findReadySteps:2631, findAndLaunchReadySteps:1697,
+buildDependencyGraphs:3024, findAndAddDeps:3223 (implicit deps mined
+from templates), enforceStoryConcurrency:1780,
+enforceSchedulingLimits:1801, checkSyncGates:1455 / SleepSteps:1217 /
+WaitSteps:1291 / ParallelSteps:1112, finalizeSuccessfulRun:2871,
+phases main->compensation->finally dag.go:482-511):
+
+- sync StepRun phases into ``status.stepStates`` (branch children roll
+  up into their `parallel` parent)
+- dependency graph = explicit ``needs`` + implicit ``steps.X``
+  references mined from ``with``/``if`` templates
+- ``if`` conditions evaluated with the offloaded-data policy
+- fail-fast skips, allowed failures, story timeout
+- primitive timers (sleep/wait/gate/parallel/sub-story) persisted in
+  ``status.stepTimers`` — restart-safe
+- story/queue/global concurrency gates; queue = TPU slice pool
+- saga phases: main -> compensation (on failure) -> finally -> finalize
+  with the story output template (1 MiB cap)
+
+The engine mutates ``run.status`` in place; the StoryRun controller
+persists it (patch-if-changed) and requeues at the returned delay.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from ..api.enums import OffloadedDataPolicy, Phase, StepType
+from ..api.errors import ErrorType, StructuredError
+from ..api.runs import (
+    DAG_PHASE_COMPENSATION,
+    DAG_PHASE_FINALLY,
+    DAG_PHASE_MAIN,
+    STEP_RUN_KIND,
+    STORY_RUN_KIND,
+    StepState,
+)
+from ..api.story import Step, StorySpec
+from ..core.object import Resource
+from ..core.store import ResourceStore
+from ..storage.manager import StorageManager
+from ..templating.engine import (
+    EvaluationBlocked,
+    Evaluator,
+    OffloadedDataUsage,
+    TemplateError,
+)
+from ..utils.duration import parse_duration
+from .manager import Clock
+from .step_executor import (
+    LABEL_QUEUE,
+    STOP_KEY,
+    TIMERS_KEY,
+    LaunchBlocked,
+    StepExecutor,
+)
+
+_log = logging.getLogger(__name__)
+
+MAX_OUTPUT_BYTES = 1 << 20  # final output template cap (reference: 1MiB)
+
+#: index names (registered by the runtime)
+INDEX_STEPRUN_STORYRUN = "storyRunRef"
+INDEX_STEPRUN_PHASE = "phase"
+
+
+class DAGEngine:
+    def __init__(
+        self,
+        store: ResourceStore,
+        evaluator: Evaluator,
+        executor: StepExecutor,
+        config_manager,
+        storage: StorageManager,
+        recorder=None,
+        clock: Optional[Clock] = None,
+    ):
+        self.store = store
+        self.evaluator = evaluator
+        self.executor = executor
+        self.config_manager = config_manager
+        self.storage = storage
+        self.recorder = recorder
+        self.clock = clock or Clock()
+
+    # ------------------------------------------------------------------
+    def run(self, run: Resource, story: StorySpec) -> Optional[float]:
+        """One DAG reconcile pass. Returns requeue delay or None."""
+        status = run.status
+        status.setdefault("phase", str(Phase.RUNNING))
+        status.setdefault("dagPhase", DAG_PHASE_MAIN)
+        status.setdefault("stepStates", {})
+        status.setdefault("startedAt", self.clock.now())
+
+        self._sync_state_from_stepruns(run)
+
+        if self._enforce_story_timeout(run, story):
+            return None
+
+        # bounded iteration (reference: <= steps+1, runDagIterations:381)
+        total_steps = len(story.all_steps()) + 1
+        for _ in range(total_steps + 1):
+            progressed = self._sync_timers(run, story)
+            if status.get(STOP_KEY):
+                self._advance_to_finally_or_finalize(run, story, stop=True)
+            phase_steps = self._current_phase_steps(run, story)
+            progressed |= self._apply_skips(run, story, phase_steps)
+            progressed |= self._launch_ready(run, story, phase_steps)
+            if self._maybe_advance_phase(run, story):
+                progressed = True
+            if Phase(status["phase"]).is_terminal:
+                return None
+            if not progressed:
+                break
+
+        return self._next_wakeup(run, story)
+
+    # ------------------------------------------------------------------
+    # state sync
+    # ------------------------------------------------------------------
+    def _sync_state_from_stepruns(self, run: Resource) -> None:
+        """(reference: syncStateFromStepRuns:965)"""
+        states = run.status["stepStates"]
+        children = self.store.list(
+            STEP_RUN_KIND,
+            namespace=run.meta.namespace,
+            index=(INDEX_STEPRUN_STORYRUN, run.meta.name),
+        )
+        by_name: dict[str, Resource] = {}
+        for sr in children:
+            step_id = sr.spec.get("stepId") or sr.meta.labels.get("bobrapet.io/step", "")
+            by_name[sr.meta.name] = sr
+            if sr.meta.labels.get("bobrapet.io/parent-step"):
+                continue  # branch child: rolled up by the parallel timer
+            if step_id:
+                states[step_id] = _merge_steprun_state(
+                    states.get(step_id) or {}, sr
+                )
+
+    # ------------------------------------------------------------------
+    # timers (reference: checkSync{Sleep,Wait,Gate,Parallel}Steps)
+    # ------------------------------------------------------------------
+    def _sync_timers(self, run: Resource, story: StorySpec) -> bool:
+        timers: dict[str, Any] = run.status.get(TIMERS_KEY) or {}
+        if not timers:
+            return False
+        states = run.status["stepStates"]
+        progressed = False
+        now = self.clock.now()
+        scope = self._scope(run)
+        for step_name in list(timers.keys()):
+            t = timers[step_name]
+            state = StepState.from_dict(states.get(step_name) or {})
+            if state.is_terminal:
+                timers.pop(step_name, None)
+                continue
+            kind = t.get("kind")
+            if kind == "sleep" and now >= t.get("due", 0):
+                states[step_name] = _finish(state, Phase.SUCCEEDED, now).to_dict()
+                timers.pop(step_name, None)
+                progressed = True
+            elif kind == "wait":
+                progressed |= self._sync_wait(run, step_name, t, state, scope, now)
+            elif kind == "gate":
+                progressed |= self._sync_gate(run, step_name, t, state, now)
+            elif kind == "parallel":
+                progressed |= self._sync_parallel(run, story, step_name, t, state, now)
+            elif kind == "subStory":
+                progressed |= self._sync_substory(run, step_name, t, state, now)
+        run.status[TIMERS_KEY] = timers
+        return progressed
+
+    def _sync_wait(self, run, step_name, t, state, scope, now) -> bool:
+        states = run.status["stepStates"]
+        if now >= t.get("deadline", float("inf")):
+            outcome = Phase.SKIPPED if t.get("onTimeout") == "skip" else Phase.TIMEOUT
+            states[step_name] = _finish(state, outcome, now, reason="WaitTimeout").to_dict()
+            run.status[TIMERS_KEY].pop(step_name, None)
+            return True
+        if now < t.get("nextPoll", 0):
+            return False
+        t["nextPoll"] = now + t.get("pollInterval", 5.0)
+        try:
+            ok = self.evaluator.evaluate_condition(t.get("until", ""), scope)
+        except OffloadedDataUsage:
+            try:
+                ok = self._condition_with_policy(run, t.get("until", ""), scope)
+            except OffloadedDataUsage as e:
+                # policy=fail: the wait step fails terminally instead of the
+                # reconcile crashing into endless backoff
+                states[step_name] = _finish(
+                    state, Phase.FAILED, now, reason="OffloadedDataPolicy"
+                ).to_dict()
+                states[step_name]["message"] = str(e)
+                run.status[TIMERS_KEY].pop(step_name, None)
+                return True
+        except TemplateError:
+            ok = False
+        if ok:
+            states[step_name] = _finish(state, Phase.SUCCEEDED, now).to_dict()
+            run.status[TIMERS_KEY].pop(step_name, None)
+            return True
+        return False
+
+    def _sync_gate(self, run, step_name, t, state, now) -> bool:
+        """Decision arrives via status.gates[step] patch
+        (reference: checkSyncGates:1455)."""
+        states = run.status["stepStates"]
+        gates = run.status.get("gates") or {}
+        decision = gates.get(step_name)
+        if decision is not None and decision.get("approved") is not None:
+            approved = bool(decision.get("approved"))
+            outcome = Phase.SUCCEEDED if approved else Phase.FAILED
+            reason = "GateApproved" if approved else "GateRejected"
+            states[step_name] = _finish(state, outcome, now, reason=reason).to_dict()
+            run.status[TIMERS_KEY].pop(step_name, None)
+            return True
+        if now >= t.get("deadline", float("inf")):
+            outcome = Phase.SKIPPED if t.get("onTimeout") == "skip" else Phase.TIMEOUT
+            states[step_name] = _finish(state, outcome, now, reason="GateTimeout").to_dict()
+            run.status[TIMERS_KEY].pop(step_name, None)
+            return True
+        return False
+
+    def _sync_parallel(self, run, story, step_name, t, state, now) -> bool:
+        """All children terminal -> parent terminal; non-allowFailure child
+        failure fails the parent (reference: dag.go:1112-1200)."""
+        states = run.status["stepStates"]
+        children = t.get("children") or []
+        child_states = []
+        for c in children:
+            sr = self.store.try_get(STEP_RUN_KIND, run.meta.namespace, c["stepRun"])
+            phase = Phase(sr.status["phase"]) if sr is not None and sr.status.get("phase") else Phase.PENDING
+            child_states.append((c, sr, phase))
+        if not all(p.is_terminal for (_, _, p) in child_states):
+            return False
+        failed = [
+            c["name"]
+            for (c, _, p) in child_states
+            if p.is_failure and not c.get("allowFailure")
+        ]
+        outputs = {
+            c["name"]: (sr.status.get("output") if sr is not None else None)
+            for (c, sr, _) in child_states
+        }
+        outcome = Phase.FAILED if failed else Phase.SUCCEEDED
+        new_state = _finish(state, outcome, now,
+                            reason=f"BranchesFailed:{','.join(failed)}" if failed else None)
+        new_state.output = outputs
+        states[step_name] = new_state.to_dict()
+        run.status[TIMERS_KEY].pop(step_name, None)
+        return True
+
+    def _sync_substory(self, run, step_name, t, state, now) -> bool:
+        """(reference: refreshAfterSubStoriesIfNeeded:652, sub-story output
+        collection)"""
+        states = run.status["stepStates"]
+        child = self.store.try_get(STORY_RUN_KIND, run.meta.namespace, t.get("storyRun", ""))
+        if child is None:
+            states[step_name] = _finish(
+                state, Phase.FAILED, now, reason="SubStoryVanished"
+            ).to_dict()
+            run.status[TIMERS_KEY].pop(step_name, None)
+            return True
+        phase = Phase(child.status["phase"]) if child.status.get("phase") else Phase.PENDING
+        if not phase.is_terminal:
+            return False
+        outcome = Phase.SUCCEEDED if phase is Phase.SUCCEEDED else Phase.FAILED
+        new_state = _finish(state, outcome, now,
+                            reason=None if outcome is Phase.SUCCEEDED else f"SubStory{phase}")
+        new_state.output = child.status.get("output")
+        states[step_name] = new_state.to_dict()
+        run.status[TIMERS_KEY].pop(step_name, None)
+        return True
+
+    # ------------------------------------------------------------------
+    # phases
+    # ------------------------------------------------------------------
+    def _current_phase_steps(self, run: Resource, story: StorySpec) -> list[Step]:
+        dag_phase = run.status.get("dagPhase", DAG_PHASE_MAIN)
+        if dag_phase == DAG_PHASE_COMPENSATION:
+            return story.compensations
+        if dag_phase == DAG_PHASE_FINALLY:
+            return story.finally_
+        return story.steps
+
+    def _maybe_advance_phase(self, run: Resource, story: StorySpec) -> bool:
+        """main -> compensation (on failure) -> finally -> finalize
+        (reference: dag.go:482-511)."""
+        status = run.status
+        dag_phase = status.get("dagPhase", DAG_PHASE_MAIN)
+        steps = self._current_phase_steps(run, story)
+        states = status["stepStates"]
+        if steps and not all(
+            StepState.from_dict(states.get(s.name) or {}).is_terminal for s in steps
+        ):
+            return False
+        if dag_phase == DAG_PHASE_MAIN:
+            failed = self._main_failed(run, story)
+            if failed and story.compensations:
+                status["dagPhase"] = DAG_PHASE_COMPENSATION
+                return True
+            if story.finally_:
+                status["dagPhase"] = DAG_PHASE_FINALLY
+                return True
+            self._finalize(run, story)
+            return True
+        if dag_phase == DAG_PHASE_COMPENSATION:
+            if story.finally_:
+                status["dagPhase"] = DAG_PHASE_FINALLY
+                return True
+            self._finalize(run, story)
+            return True
+        self._finalize(run, story)
+        return True
+
+    def _advance_to_finally_or_finalize(self, run: Resource, story: StorySpec, stop=False) -> None:
+        """Stop primitive: skip unstarted main steps, then finally/finalize
+        (reference: executeStopStep terminal semantics)."""
+        states = run.status["stepStates"]
+        now = self.clock.now()
+        for s in self._current_phase_steps(run, story):
+            st = StepState.from_dict(states.get(s.name) or {})
+            if not st.is_terminal and (states.get(s.name) is None or st.effective_phase is Phase.PENDING):
+                states[s.name] = _finish(st, Phase.SKIPPED, now, reason="StoryStopped").to_dict()
+
+    def _main_failed(self, run: Resource, story: StorySpec) -> bool:
+        states = run.status["stepStates"]
+        for s in story.steps:
+            st = StepState.from_dict(states.get(s.name) or {})
+            if st.effective_phase.is_failure and not s.allow_failure:
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    # skips + readiness
+    # ------------------------------------------------------------------
+    def _apply_skips(self, run: Resource, story: StorySpec, steps: list[Step]) -> bool:
+        """Fail-fast: when a non-allowFailure step failed, unstarted steps
+        of the phase are skipped (reference: fail-fast skips dag.go:3289).
+        Honors policy.retries.continueOnStepFailure."""
+        status = run.status
+        states = status["stepStates"]
+        continue_on_failure = bool(
+            story.policy
+            and story.policy.retries
+            and story.policy.retries.continue_on_step_failure
+        )
+        if continue_on_failure:
+            return False
+        if status.get("dagPhase", DAG_PHASE_MAIN) != DAG_PHASE_MAIN:
+            return False  # compensation/finally always run fully
+        if not self._main_failed(run, story):
+            return False
+        progressed = False
+        now = self.clock.now()
+        for s in steps:
+            if s.name not in states:
+                states[s.name] = StepState(
+                    phase=Phase.SKIPPED,
+                    reason="FailFast",
+                    started_at=now,
+                    finished_at=now,
+                ).to_dict()
+                progressed = True
+        return progressed
+
+    def _launch_ready(self, run: Resource, story: StorySpec, steps: list[Step]) -> bool:
+        """(reference: findAndLaunchReadySteps:1697 + findReadySteps:2631)"""
+        states = run.status["stepStates"]
+        progressed = False
+        queue = story.policy.queue if story.policy else None
+        by_name = {s.name: s for s in steps}
+
+        for step in steps:
+            if step.name in states:
+                continue
+            # scope is rebuilt per candidate: a step that completed earlier
+            # in this same pass (condition/stop/instant primitives) must be
+            # visible to later steps' `if`/`with` evaluation
+            scope = self._scope(run)
+            deps = set(step.needs)
+            deps |= {
+                d
+                for d in Evaluator.find_step_references(
+                    {"with": step.with_, "if": step.if_}
+                )
+                if d in by_name or story.step(d) is not None
+            }
+            unresolved = [
+                d
+                for d in deps
+                if d not in states or not StepState.from_dict(states[d]).is_terminal
+            ]
+            if unresolved:
+                continue
+
+            # dependency failure/skip propagation
+            blocked_reason = None
+            for d in deps:
+                ds = StepState.from_dict(states[d])
+                dep_def = by_name.get(d) or story.step(d)
+                if ds.effective_phase.is_failure and not (dep_def and dep_def.allow_failure):
+                    blocked_reason = "DependencyFailed"
+                elif ds.effective_phase is Phase.SKIPPED:
+                    blocked_reason = "DependencySkipped"
+            now = self.clock.now()
+            if blocked_reason:
+                states[step.name] = StepState(
+                    phase=Phase.SKIPPED, reason=blocked_reason,
+                    started_at=now, finished_at=now,
+                ).to_dict()
+                progressed = True
+                continue
+
+            # `if` condition (reference: findReadySteps:2631 + offloaded
+            # policy fail/inject/materialize)
+            if step.if_:
+                try:
+                    ok = self.evaluator.evaluate_condition(step.if_, scope)
+                except OffloadedDataUsage:
+                    try:
+                        ok = self._condition_with_policy(run, step.if_, scope)
+                    except OffloadedDataUsage as e:
+                        states[step.name] = StepState(
+                            phase=Phase.FAILED, reason="OffloadedDataPolicy",
+                            message=str(e), started_at=now, finished_at=now,
+                        ).to_dict()
+                        progressed = True
+                        continue
+                except (TemplateError, EvaluationBlocked) as e:
+                    states[step.name] = StepState(
+                        phase=Phase.FAILED, reason="ExpressionFailed",
+                        message=str(e), started_at=now, finished_at=now,
+                    ).to_dict()
+                    progressed = True
+                    continue
+                if not ok:
+                    states[step.name] = StepState(
+                        phase=Phase.SKIPPED, reason="ConditionFalse",
+                        started_at=now, finished_at=now,
+                    ).to_dict()
+                    progressed = True
+                    continue
+
+            # concurrency gates (reference: enforceStoryConcurrency:1780,
+            # enforceSchedulingLimits:1801)
+            if not self._concurrency_allows(run, story, queue):
+                run.status["queueWaiting"] = True
+                break
+            run.status.pop("queueWaiting", None)
+
+            try:
+                state = self.executor.execute(run, story, step, scope, queue=queue)
+            except LaunchBlocked as e:
+                # gang/slice capacity: stay Pending, retry soon
+                run.status["placementWaiting"] = str(e)
+                break
+            except Exception as e:  # noqa: BLE001 - launch failure fails the step
+                state = StepState(
+                    phase=Phase.FAILED, reason="LaunchFailed", message=str(e),
+                    started_at=self.clock.now(), finished_at=self.clock.now(),
+                )
+            run.status.pop("placementWaiting", None)
+            states[step.name] = state.to_dict()
+            progressed = True
+            if run.status.get(STOP_KEY):
+                break  # a stop primitive halts further launches immediately
+        return progressed
+
+    def _condition_with_policy(self, run: Resource, expr: str, scope) -> bool:
+        """Offloaded-data policy for conditions
+        (reference: templating_policy.go fail/inject/controller;
+        materialize subsystem materialize.go — controller mode hydrates
+        in-controller here, with the dedicated materialize-engram path
+        reserved for remote deployments)."""
+        policy = self.config_manager.config.templating.offloaded_data_policy
+        if policy is OffloadedDataPolicy.FAIL:
+            raise OffloadedDataUsage("offloaded data in condition under policy=fail")
+        prefix = f"runs/{run.meta.namespace}/{run.meta.name}"
+        hydrated = {
+            k: self.storage.hydrate(v, [prefix]) if k in ("inputs", "steps") else v
+            for k, v in scope.items()
+        }
+        return self.evaluator.evaluate_condition(expr, hydrated)
+
+    def _concurrency_allows(self, run: Resource, story: StorySpec, queue: Optional[str]) -> bool:
+        states = run.status["stepStates"]
+        running_here = sum(
+            1
+            for raw in states.values()
+            if not StepState.from_dict(raw).is_terminal
+        )
+        limit = story.policy.concurrency if story.policy else None
+        if limit is not None and running_here >= limit:
+            return False
+        cfg = self.config_manager.config.scheduling
+        if queue:
+            q = cfg.queue(queue)
+            if q.max_concurrent:
+                active = self._active_stepruns_in_queue(queue)
+                if active >= q.max_concurrent:
+                    return False
+        if cfg.global_max_concurrent_steps:
+            active = self._active_stepruns_in_queue(None)
+            if active >= cfg.global_max_concurrent_steps:
+                return False
+        return True
+
+    #: non-terminal phase-index buckets (the phase index is keyed by the
+    #: literal status value; "" covers not-yet-claimed StepRuns)
+    _ACTIVE_PHASES = ("", str(Phase.PENDING), str(Phase.RUNNING),
+                      str(Phase.SCHEDULING), str(Phase.PAUSED), str(Phase.BLOCKED))
+
+    def _active_stepruns_in_queue(self, queue: Optional[str]) -> int:
+        n = 0
+        for phase in self._ACTIVE_PHASES:
+            for sr in self.store.list(STEP_RUN_KIND, index=(INDEX_STEPRUN_PHASE, phase)):
+                if queue is not None and sr.meta.labels.get(LABEL_QUEUE) != queue:
+                    continue
+                n += 1
+        return n
+
+    # ------------------------------------------------------------------
+    # timeout + finalize
+    # ------------------------------------------------------------------
+    def _enforce_story_timeout(self, run: Resource, story: StorySpec) -> bool:
+        """(reference: enforceStoryTimeout:544)"""
+        timeout = None
+        if story.policy and story.policy.timeouts and story.policy.timeouts.story:
+            timeout = parse_duration(story.policy.timeouts.story)
+        if not timeout:
+            cfg = self.config_manager.config
+            timeout = cfg.timeouts.story_seconds or None
+        if not timeout:
+            return False
+        started = run.status.get("startedAt") or self.clock.now()
+        if self.clock.now() - started < timeout:
+            return False
+        run.status["phase"] = str(Phase.TIMEOUT)
+        run.status["error"] = StructuredError(
+            type=ErrorType.TIMEOUT,
+            message=f"story exceeded timeout {timeout}s",
+        ).to_dict()
+        run.status["finishedAt"] = self.clock.now()
+        self._cancel_children(run)
+        return True
+
+    def _cancel_children(self, run: Resource) -> None:
+        from .steprun import CANCEL_ANNOTATION
+
+        for sr in self.store.list(
+            STEP_RUN_KIND,
+            namespace=run.meta.namespace,
+            index=(INDEX_STEPRUN_STORYRUN, run.meta.name),
+        ):
+            phase = sr.status.get("phase")
+            if phase and Phase(phase).is_terminal:
+                continue
+
+            def annotate(r: Resource) -> None:
+                r.meta.annotations[CANCEL_ANNOTATION] = "timeout"
+
+            try:
+                self.store.mutate(STEP_RUN_KIND, sr.meta.namespace, sr.meta.name, annotate)
+            except Exception:  # noqa: BLE001
+                continue
+
+    def _finalize(self, run: Resource, story: StorySpec) -> None:
+        """(reference: finalizeStoryRun:693 / finalizeSuccessfulRun:2871)"""
+        status = run.status
+        now = self.clock.now()
+        stop = status.get(STOP_KEY)
+        if stop:
+            status["phase"] = stop.get("phase", str(Phase.SUCCEEDED))
+            if stop.get("message"):
+                status["message"] = stop["message"]
+            status["finishedAt"] = now
+            return
+        if self._main_failed(run, story):
+            failed = [
+                name
+                for name, raw in status["stepStates"].items()
+                if StepState.from_dict(raw).effective_phase.is_failure
+            ]
+            status["phase"] = str(Phase.FAILED)
+            status["error"] = StructuredError(
+                type=ErrorType.EXECUTION,
+                message=f"steps failed: {sorted(failed)}",
+                details={"failedSteps": sorted(failed)},
+            ).to_dict()
+            status["finishedAt"] = now
+            return
+        output = None
+        if story.output is not None:
+            scope = self._scope(run)
+            try:
+                output = self.evaluator.evaluate_value(story.output, scope)
+            except OffloadedDataUsage:
+                prefix = f"runs/{run.meta.namespace}/{run.meta.name}"
+                hydrated = {
+                    "inputs": self.storage.hydrate(scope["inputs"], [prefix]),
+                    "steps": self.storage.hydrate(scope["steps"], [prefix]),
+                    "run": scope["run"],
+                }
+                try:
+                    output = self.evaluator.evaluate_value(story.output, hydrated)
+                except TemplateError as e:
+                    self._finalize_output_failed(run, e)
+                    return
+            except (TemplateError, EvaluationBlocked) as e:
+                self._finalize_output_failed(run, e)
+                return
+            import json
+
+            if len(json.dumps(output, default=str)) > MAX_OUTPUT_BYTES:
+                # oversized final output offloads instead of failing
+                output = self.storage.dehydrate(
+                    output,
+                    f"runs/{run.meta.namespace}/{run.meta.name}/output",
+                    max_inline_size=MAX_OUTPUT_BYTES // 2,
+                )
+        status["phase"] = str(Phase.SUCCEEDED)
+        if output is not None:
+            status["output"] = output
+        status["finishedAt"] = now
+
+    def _finalize_output_failed(self, run: Resource, err: Exception) -> None:
+        run.status["phase"] = str(Phase.FAILED)
+        run.status["error"] = StructuredError(
+            type=ErrorType.VALIDATION,
+            message=f"output template evaluation failed: {err}",
+        ).to_dict()
+        run.status["finishedAt"] = self.clock.now()
+
+    # ------------------------------------------------------------------
+    def _scope(self, run: Resource) -> dict[str, Any]:
+        """(reference: getPriorStepOutputs:2083 — outputs + signals per
+        step; hydration is lazy via the offloaded-data policy)"""
+        steps_scope = {}
+        for name, raw in (run.status.get("stepStates") or {}).items():
+            st = StepState.from_dict(raw)
+            steps_scope[name] = {
+                "output": st.output,
+                "signals": st.signals or {},
+                "phase": str(st.effective_phase),
+            }
+        return {
+            "inputs": run.spec.get("inputs") or {},
+            "steps": steps_scope,
+            "run": {
+                "name": run.meta.name,
+                "namespace": run.meta.namespace,
+                "storyName": (run.spec.get("storyRef") or {}).get("name", ""),
+            },
+        }
+
+    def _story_timeout_seconds(self, story: StorySpec) -> Optional[float]:
+        if story.policy and story.policy.timeouts and story.policy.timeouts.story:
+            return parse_duration(story.policy.timeouts.story)
+        return self.config_manager.config.timeouts.story_seconds or None
+
+    def _next_wakeup(self, run: Resource, story: StorySpec) -> Optional[float]:
+        """Earliest timer tick; None when nothing is pending."""
+        timers = run.status.get(TIMERS_KEY) or {}
+        now = self.clock.now()
+        due = []
+        # the story-timeout boundary is itself a wakeup: a long sleep must
+        # not outlive the deadline unobserved
+        timeout = self._story_timeout_seconds(story)
+        if timeout:
+            started = run.status.get("startedAt") or now
+            due.append(started + timeout)
+        for t in timers.values():
+            kind = t.get("kind")
+            if kind == "sleep":
+                due.append(t.get("due", now))
+            elif kind == "wait":
+                due.append(min(t.get("nextPoll", now), t.get("deadline", now)))
+            elif kind == "gate":
+                due.append(min(now + t.get("pollInterval", 10.0), t.get("deadline", now)))
+        if run.status.get("placementWaiting") or run.status.get("queueWaiting"):
+            due.append(now + 1.0)
+        if not due:
+            return None
+        return max(0.0, min(due) - now)
+
+
+def _merge_steprun_state(existing: dict[str, Any], sr: Resource) -> dict[str, Any]:
+    """Merge a StepRun's status into the run's StepState entry."""
+    state = StepState.from_dict(existing)
+    phase_raw = sr.status.get("phase")
+    if phase_raw:
+        try:
+            state.phase = Phase(phase_raw)
+        except ValueError:
+            pass
+    if sr.status.get("output") is not None:
+        state.output = sr.status.get("output")
+    if sr.status.get("signals"):
+        state.signals = sr.status.get("signals")
+    if sr.status.get("retries") is not None:
+        state.retries = sr.status.get("retries")
+    if sr.status.get("exitCode") is not None:
+        state.exit_code = sr.status.get("exitCode")
+    if sr.status.get("exitClass"):
+        state.exit_class = sr.status.get("exitClass")
+    err = sr.status.get("error")
+    if err:
+        state.message = err.get("message") if isinstance(err, dict) else str(err)
+    if sr.status.get("startedAt") and not state.started_at:
+        state.started_at = sr.status.get("startedAt")
+    if sr.status.get("finishedAt"):
+        state.finished_at = sr.status.get("finishedAt")
+    return state.to_dict()
+
+
+def _finish(
+    state: StepState, phase: Phase, now: float, reason: Optional[str] = None
+) -> StepState:
+    state.phase = phase
+    state.finished_at = now
+    if state.started_at is None:
+        state.started_at = now
+    if reason:
+        state.reason = reason
+    return state

@@ -1,0 +1,248 @@
+"""Job kind + the local gang executor (this framework's "kubelet").
+
+The reference materializes batch steps as Kubernetes Jobs executed by
+kubelet (reference: steprun_controller.go buildJobSpec:1784; Job→pod→
+container). Here a **Job resource on the bus** carries the same facts
+(entrypoint/image, env contract, gang size, timeout) and the
+:class:`LocalGangExecutor` plays kubelet: it watches Jobs, runs one
+"host process" per gang member with per-host env
+(completion-index -> TPU_WORKER_ID, SURVEY §2.6), and patches Job status
+with the classified exit outcome. On GKE the same Job spec maps onto a
+JobSet-style multi-host TPU Job; the control plane above is identical.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import traceback
+from typing import Any, Optional
+
+from ..api.enums import Phase
+from ..core.object import Resource, new_resource
+from ..core.store import ADDED, MODIFIED, ResourceStore, WatchEvent
+from ..sdk import contract
+from ..sdk.context import EngramContext, EngramExit, resolve_entrypoint
+from .manager import Clock
+
+_log = logging.getLogger(__name__)
+
+JOB_KIND = "Job"
+
+
+def make_job(
+    name: str,
+    namespace: str,
+    step_run_name: str,
+    entrypoint: str,
+    env: dict[str, str],
+    hosts: int = 1,
+    timeout_seconds: Optional[float] = None,
+    image: Optional[str] = None,
+    slice_grant: Optional[dict[str, Any]] = None,
+    owners=None,
+    labels=None,
+) -> Resource:
+    spec: dict[str, Any] = {
+        "stepRunRef": {"name": step_run_name},
+        "entrypoint": entrypoint,
+        "env": env,
+        "hosts": hosts,
+    }
+    if timeout_seconds is not None:
+        spec["timeoutSeconds"] = timeout_seconds
+    if image:
+        spec["image"] = image
+    if slice_grant:
+        spec["sliceGrant"] = slice_grant
+    return new_resource(JOB_KIND, name, namespace, spec, labels=labels, owners=owners)
+
+
+class LocalGangExecutor:
+    """Runs Job resources in-process.
+
+    Modes:
+    - ``sync`` (default; deterministic tests): hosts run sequentially on
+      the watcher thread the moment the Job is committed. Timeouts are
+      cooperative (ctx.check_deadline()).
+    - ``threaded`` (live): one thread per host, join with timeout; a
+      host that outlives the deadline is canceled and recorded as
+      EXIT_TIMEOUT (kubelet's activeDeadlineSeconds role).
+    """
+
+    def __init__(
+        self,
+        store: ResourceStore,
+        storage=None,
+        clock: Optional[Clock] = None,
+        mode: str = "sync",
+    ):
+        self.store = store
+        self.storage = storage
+        self.clock = clock or Clock()
+        self.mode = mode
+        self._cancels: dict[tuple[str, str], threading.Event] = {}
+        self._lock = threading.Lock()
+        store.watch(self._on_event, kinds=[JOB_KIND])
+
+    # -- cancellation (graceful cancel path reaches running jobs) ---------
+
+    def cancel(self, namespace: str, name: str) -> None:
+        with self._lock:
+            ev = self._cancels.get((namespace, name))
+        if ev is not None:
+            ev.set()
+
+    # -- watch -------------------------------------------------------------
+
+    def _on_event(self, ev: WatchEvent) -> None:
+        if ev.type not in (ADDED, MODIFIED):
+            return
+        job = ev.resource
+        if job.status.get("phase") in (None, "", str(Phase.PENDING)):
+            if job.meta.deletion_timestamp is not None:
+                return
+            self._start(job)
+
+    def _start(self, job: Resource) -> None:
+        # claim the job (Pending -> Running); losing the claim means
+        # another executor instance took it
+        try:
+            claimed = self.store.mutate(
+                JOB_KIND,
+                job.meta.namespace,
+                job.meta.name,
+                self._claim,
+                status_only=True,
+            )
+        except Exception:  # noqa: BLE001
+            return
+        if claimed.status.get("executor") != id(self) % 100000:
+            return
+        if self.mode == "threaded":
+            t = threading.Thread(
+                target=self._run_gang, args=(claimed,), daemon=True,
+                name=f"gang-{job.meta.name}",
+            )
+            t.start()
+        else:
+            self._run_gang(claimed)
+
+    def _claim(self, r: Resource) -> None:
+        if r.status.get("phase") in (None, "", str(Phase.PENDING)):
+            r.status["phase"] = str(Phase.RUNNING)
+            r.status["startedAt"] = self.clock.now()
+            r.status["executor"] = id(self) % 100000
+
+    # -- gang execution ----------------------------------------------------
+
+    def _run_gang(self, job: Resource) -> None:
+        ns, name = job.meta.namespace, job.meta.name
+        spec = job.spec
+        hosts = int(spec.get("hosts") or 1)
+        entrypoint = spec.get("entrypoint") or ""
+        timeout = spec.get("timeoutSeconds")
+        cancel = threading.Event()
+        with self._lock:
+            self._cancels[(ns, name)] = cancel
+
+        host_results: list[dict[str, Any]] = [{} for _ in range(hosts)]
+
+        def run_host(host_id: int) -> None:
+            env = contract.host_env(dict(spec.get("env") or {}), host_id)
+            if timeout is not None:
+                env[contract.ENV_STEP_TIMEOUT_SECONDS] = str(timeout)
+            ctx = EngramContext(
+                env,
+                store=self.store,
+                storage=self.storage,
+                clock=self.clock,
+                cancel_event=cancel,
+            )
+            try:
+                fn = resolve_entrypoint(entrypoint)
+            except Exception as e:  # noqa: BLE001 - bad entrypoint = bad image
+                host_results[host_id] = {
+                    "hostId": host_id,
+                    "exitCode": contract.EXIT_CONFIG_TERMINAL_MAX,
+                    "message": f"entrypoint resolution failed: {e}",
+                }
+                return
+            try:
+                result = fn(ctx)
+                if result is not None and host_id == 0:
+                    ctx.output(result)
+                host_results[host_id] = {"hostId": host_id, "exitCode": 0}
+            except EngramExit as e:
+                host_results[host_id] = {
+                    "hostId": host_id,
+                    "exitCode": e.code,
+                    "message": str(e),
+                }
+            except Exception as e:  # noqa: BLE001 - user code failure
+                host_results[host_id] = {
+                    "hostId": host_id,
+                    "exitCode": 1,
+                    "message": f"{type(e).__name__}: {e}",
+                    "traceback": traceback.format_exc(limit=10),
+                }
+
+        try:
+            if self.mode == "threaded" and hosts > 1:
+                threads = [
+                    threading.Thread(target=run_host, args=(i,), daemon=True)
+                    for i in range(hosts)
+                ]
+                for t in threads:
+                    t.start()
+                deadline = None if timeout is None else self.clock.now() + float(timeout)
+                for i, t in enumerate(threads):
+                    remain = None if deadline is None else max(0.0, deadline - self.clock.now())
+                    t.join(remain)
+                    if t.is_alive():
+                        cancel.set()
+                        host_results[i] = {
+                            "hostId": i,
+                            "exitCode": contract.EXIT_TIMEOUT,
+                            "message": "host deadline exceeded",
+                        }
+            elif self.mode == "threaded":
+                t = threading.Thread(target=run_host, args=(0,), daemon=True)
+                t.start()
+                t.join(None if timeout is None else float(timeout))
+                if t.is_alive():
+                    cancel.set()
+                    host_results[0] = {
+                        "hostId": 0,
+                        "exitCode": contract.EXIT_TIMEOUT,
+                        "message": "host deadline exceeded",
+                    }
+            else:
+                for i in range(hosts):
+                    run_host(i)
+        finally:
+            with self._lock:
+                self._cancels.pop((ns, name), None)
+
+        # gang outcome: every host must succeed (all-or-nothing semantics)
+        exit_code = 0
+        message = ""
+        for r in host_results:
+            code = int(r.get("exitCode", -1))
+            if code != 0 and exit_code == 0:
+                exit_code = code
+                message = r.get("message", "")
+        finished = self.clock.now()
+
+        def finish(status: dict[str, Any]) -> None:
+            status["phase"] = str(Phase.SUCCEEDED if exit_code == 0 else Phase.FAILED)
+            status["exitCode"] = exit_code
+            status["hostStatuses"] = host_results
+            status["finishedAt"] = finished
+            if message:
+                status["message"] = message
+
+        try:
+            self.store.patch_status(JOB_KIND, ns, name, finish)
+        except Exception:  # noqa: BLE001 - job may have been deleted mid-run
+            _log.warning("job %s/%s vanished before completion", ns, name)

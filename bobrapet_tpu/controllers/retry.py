@@ -1,0 +1,84 @@
+"""Exit-code classification + retry delay computation.
+
+Capability parity with the reference's failure engine
+(reference: classifyExitCode steprun_controller.go:4815,
+computeRetryDelay:2251, RetryPolicy shared_types.go:400).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ..api.enums import BackoffStrategy, ExitClass
+from ..api.shared import RetryPolicy
+from ..sdk import contract
+from ..utils.duration import parse_duration
+
+
+def classify_exit_code(code: Optional[int]) -> ExitClass:
+    """Map a worker exit code to an ExitClass
+    (reference: classifyExitCode steprun_controller.go:4815):
+
+    - 0 success
+    - -1/None: pod state indeterminate -> unknown (retries without
+      consuming budget)
+    - 124: timeout -> retry
+    - 119: contract rate-limit signal -> rateLimited (the reference
+      carries 429 at the StructuredError level; one exit byte can't)
+    - 137/143 (SIGKILL/SIGTERM): evicted/preempted -> retry
+    - 125-127: container/config failure -> terminal
+    - 1-127: application error -> terminal
+    - 128-255: killed by signal -> retry
+    """
+    if code is None or code < 0:
+        return ExitClass.UNKNOWN
+    if code == 0:
+        return ExitClass.SUCCESS
+    if code == contract.EXIT_TIMEOUT:
+        return ExitClass.RETRY
+    if code == contract.EXIT_RATE_LIMITED:
+        return ExitClass.RATE_LIMITED
+    if code in (contract.EXIT_SIGKILL, contract.EXIT_SIGTERM):
+        return ExitClass.RETRY
+    if contract.EXIT_CONFIG_TERMINAL_MIN <= code <= contract.EXIT_CONFIG_TERMINAL_MAX:
+        return ExitClass.TERMINAL
+    if 1 <= code <= 127:
+        return ExitClass.TERMINAL
+    if 128 <= code <= 255:
+        return ExitClass.RETRY
+    return ExitClass.UNKNOWN
+
+
+def compute_retry_delay(
+    policy: RetryPolicy,
+    attempt: int,
+    rng: Optional[random.Random] = None,
+    rate_limited: bool = False,
+) -> float:
+    """Delay before retry ``attempt`` (1-based)
+    (reference: computeRetryDelay steprun_controller.go:2251 —
+    exponential/linear/constant + jitter pct + maxDelay; rate-limited
+    failures take at least the max delay's floor)."""
+    base = parse_duration(policy.delay, default=5.0) or 5.0
+    max_delay = parse_duration(policy.max_delay, default=300.0) or 300.0
+    strategy = policy.backoff or BackoffStrategy.EXPONENTIAL
+    if strategy is BackoffStrategy.EXPONENTIAL:
+        delay = base * (2 ** max(0, attempt - 1))
+    elif strategy is BackoffStrategy.LINEAR:
+        delay = base * attempt
+    else:
+        delay = base
+    if rate_limited:
+        delay = max(delay, min(30.0, max_delay))
+    delay = min(delay, max_delay)
+    jitter_pct = policy.jitter or 0
+    if jitter_pct:
+        r = rng or random
+        delay *= 1 + (r.random() * 2 - 1) * (jitter_pct / 100.0)
+    return max(0.0, delay)
+
+
+def retry_budget_left(policy: RetryPolicy, retries_consumed: int) -> bool:
+    max_retries = policy.max_retries if policy.max_retries is not None else 3
+    return retries_consumed < max_retries

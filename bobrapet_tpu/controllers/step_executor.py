@@ -1,0 +1,373 @@
+"""Step executor: materializes ready steps.
+
+Capability parity with the reference StepExecutor
+(reference: internal/controller/runs/step_executor.go — Execute:132
+dispatch, executeEngramStep:205, createEngramStepRun:360,
+maybeOffloadStepRunInput:662, executeParallelStep:740,
+executeStoryStep:1132, executeStopStep:1081, resolveIdempotencyKey:896;
+primitive `with` shapes documented in SURVEY §2.2):
+
+- engram steps -> StepRun CRs with deterministic names (create-or-adopt)
+  + input offload + idempotency key template + **TPU slice grant** from
+  the placement stage (TPU-native addition, SURVEY §7)
+- `condition` -> Succeeded immediately (branching happens via `if`)
+- `sleep`/`wait`/`gate` -> in-status timer state machines
+- `stop` -> story terminal request
+- `parallel` -> child StepRuns per branch (gang fan-out; branches place
+  onto disjoint ICI sub-meshes of one pool)
+- `executeStory` -> child StoryRun (sub-story)
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from ..api.enums import Phase, StepType, StopMode
+from ..api.runs import STEP_RUN_KIND, STORY_RUN_KIND, StepState
+from ..api.story import Step, StorySpec
+from ..core.object import Resource, new_resource
+from ..core.store import AlreadyExists, ResourceStore
+from ..parallel.placement import NoCapacity, SlicePlacer
+from ..storage.manager import StorageManager
+from ..templating.engine import Evaluator, TemplateError
+from ..utils.duration import parse_duration
+from ..utils.naming import branch_steprun_name, compose_unique, steprun_name
+from .manager import Clock
+
+_log = logging.getLogger(__name__)
+
+#: durable per-step timers parked in StoryRun.status
+#: (reference keeps them in the runs.bubustack.io/step-timers annotation,
+#: dag.go:64-76; status is this framework's durable home)
+TIMERS_KEY = "stepTimers"
+#: stop-primitive request recorded for the finalizer
+STOP_KEY = "stopRequest"
+
+LABEL_STORY_RUN = "bobrapet.io/story-run"
+LABEL_STEP = "bobrapet.io/step"
+LABEL_QUEUE = "bobrapet.io/queue"
+LABEL_PARENT_STEP = "bobrapet.io/parent-step"
+DEPTH_LABEL = "bobrapet.io/substory-depth"
+
+
+class LaunchBlocked(Exception):
+    """Step cannot launch yet (e.g. no slice capacity) — stay Pending."""
+
+
+class StepExecutor:
+    def __init__(
+        self,
+        store: ResourceStore,
+        evaluator: Evaluator,
+        storage: StorageManager,
+        config_manager,
+        placer: Optional[SlicePlacer] = None,
+        clock: Optional[Clock] = None,
+    ):
+        self.store = store
+        self.evaluator = evaluator
+        self.storage = storage
+        self.config_manager = config_manager
+        self.placer = placer or SlicePlacer()
+        self.clock = clock or Clock()
+
+    # ------------------------------------------------------------------
+    def execute(
+        self,
+        run: Resource,
+        story: StorySpec,
+        step: Step,
+        scope: dict[str, Any],
+        queue: Optional[str] = None,
+    ) -> StepState:
+        """Launch one ready step; returns its initial StepState.
+
+        ``run.status`` is mutated in place (timers/stop requests); the DAG
+        engine persists it after the iteration loop.
+        """
+        if step.type is None:
+            return self._execute_engram(run, story, step, scope, queue)
+        if step.type is StepType.CONDITION:
+            # branching is expressed through dependents' `if`; the node
+            # itself completes instantly (reference: step_executor.go:168)
+            return StepState(phase=Phase.SUCCEEDED, started_at=self.clock.now(),
+                             finished_at=self.clock.now())
+        if step.type is StepType.SLEEP:
+            return self._execute_sleep(run, step)
+        if step.type is StepType.WAIT:
+            return self._execute_wait(run, step)
+        if step.type is StepType.GATE:
+            return self._execute_gate(run, step)
+        if step.type is StepType.STOP:
+            return self._execute_stop(run, step, scope)
+        if step.type is StepType.PARALLEL:
+            return self._execute_parallel(run, story, step, scope, queue)
+        if step.type is StepType.EXECUTE_STORY:
+            return self._execute_story(run, step, scope)
+        raise ValueError(f"unknown step type {step.type}")
+
+    # ------------------------------------------------------------------
+    # engram steps
+    # ------------------------------------------------------------------
+    def _execute_engram(
+        self,
+        run: Resource,
+        story: StorySpec,
+        step: Step,
+        scope: dict[str, Any],
+        queue: Optional[str],
+        name_override: Optional[str] = None,
+        parent_step: Optional[str] = None,
+    ) -> StepState:
+        ns = run.meta.namespace
+        name = name_override or steprun_name(run.meta.name, step.name)
+
+        # TPU slice placement stage (gang semantics: all-or-nothing)
+        slice_grant = None
+        if step.tpu is not None:
+            try:
+                grant = self.placer.place(step.tpu, queue=queue)
+            except NoCapacity as e:
+                raise LaunchBlocked(str(e)) from None
+            slice_grant = grant.to_dict() if grant is not None else None
+
+        idempotency_key = self._resolve_idempotency_key(run, step, scope)
+
+        spec: dict[str, Any] = {
+            "storyRunRef": {"name": run.meta.name},
+            "stepId": step.name,
+            "engramRef": step.ref.to_dict() if step.ref else {},
+            "input": step.with_ or {},
+        }
+        if idempotency_key:
+            spec["idempotencyKey"] = idempotency_key
+        if step.execution is not None:
+            spec["executionOverrides"] = step.execution.to_dict()
+            if step.execution.timeout:
+                spec["timeout"] = step.execution.timeout
+            if step.execution.retry is not None:
+                spec["retry"] = step.execution.retry.to_dict()
+        if step.post_execution is not None:
+            spec["postExecution"] = step.post_execution.to_dict()
+        if slice_grant is not None:
+            spec["sliceGrant"] = slice_grant
+
+        labels = {LABEL_STORY_RUN: run.meta.name, LABEL_STEP: step.name}
+        if queue:
+            labels[LABEL_QUEUE] = queue
+        if parent_step:
+            labels[LABEL_PARENT_STEP] = parent_step
+
+        sr = new_resource(
+            STEP_RUN_KIND, name, ns, spec, labels=labels, owners=[run.owner_ref()]
+        )
+        try:
+            self.store.create(sr)
+        except AlreadyExists:
+            # deterministic name -> adopt (drift detection: if the adopted
+            # spec diverges, patch it; reference: drift detection/patch).
+            # The grant allocated above belongs to nobody (the surviving
+            # StepRun carries its own) — return it or the pool leaks.
+            if slice_grant is not None:
+                self.placer.release(slice_grant)
+            existing = self.store.get(STEP_RUN_KIND, ns, name)
+            if existing.spec.get("input") != spec["input"] and not (
+                existing.status.get("phase")
+                and Phase(existing.status["phase"]).is_terminal
+            ):
+                # keep the adopted StepRun's own (still-live) slice grant
+                drift = {k: v for k, v in spec.items() if k != "sliceGrant"}
+
+                def sync_spec(r: Resource) -> None:
+                    r.spec.update(drift)
+
+                self.store.mutate(STEP_RUN_KIND, ns, name, sync_spec)
+        return StepState(phase=Phase.PENDING, started_at=self.clock.now())
+
+    def _resolve_idempotency_key(self, run, step, scope) -> Optional[str]:
+        if not step.idempotency_key_template:
+            # default identity ns/run/step (reference:
+            # identity/steprun_idempotency.go:14)
+            return f"{run.meta.namespace}/{run.meta.name}/step/{step.name}"
+        try:
+            v = self.evaluator.evaluate_string(step.idempotency_key_template, scope)
+            return str(v)
+        except TemplateError as e:
+            _log.warning("idempotency key template for %s failed: %s", step.name, e)
+            return None
+
+    # ------------------------------------------------------------------
+    # primitives (exact `with` shapes per SURVEY §2.2)
+    # ------------------------------------------------------------------
+    def _execute_sleep(self, run: Resource, step: Step) -> StepState:
+        """sleep: {duration} (reference: dag.go:1549)"""
+        w = step.with_ or {}
+        duration = parse_duration(w.get("duration"), default=0.0) or 0.0
+        due = self.clock.now() + duration
+        run.status.setdefault(TIMERS_KEY, {})[step.name] = {
+            "kind": "sleep",
+            "due": due,
+        }
+        return StepState(phase=Phase.RUNNING, started_at=self.clock.now())
+
+    def _execute_wait(self, run: Resource, step: Step) -> StepState:
+        """wait: {until (required), timeout, pollInterval, onTimeout: fail|skip}
+        (reference: dag.go:1569, normalizeOnTimeout:1643)"""
+        w = step.with_ or {}
+        cfg = self.config_manager.config
+        timeout = parse_duration(w.get("timeout"), default=cfg.timeouts.external_data_seconds)
+        poll = parse_duration(w.get("pollInterval"), default=5.0) or 5.0
+        run.status.setdefault(TIMERS_KEY, {})[step.name] = {
+            "kind": "wait",
+            "until": w.get("until", ""),
+            "deadline": self.clock.now() + (timeout or 0.0),
+            "pollInterval": poll,
+            "nextPoll": self.clock.now(),
+            "onTimeout": _normalize_on_timeout(w.get("onTimeout")),
+        }
+        return StepState(phase=Phase.RUNNING, started_at=self.clock.now())
+
+    def _execute_gate(self, run: Resource, step: Step) -> StepState:
+        """gate: {timeout, pollInterval, onTimeout} — decision arrives via a
+        status.gates[step] patch (reference: dag.go:1608,
+        storyrun_types.go:274)"""
+        w = step.with_ or {}
+        cfg = self.config_manager.config
+        timeout = parse_duration(w.get("timeout"), default=cfg.timeouts.approval_seconds)
+        poll = parse_duration(w.get("pollInterval"), default=10.0) or 10.0
+        run.status.setdefault(TIMERS_KEY, {})[step.name] = {
+            "kind": "gate",
+            "deadline": self.clock.now() + (timeout or 0.0),
+            "pollInterval": poll,
+            "onTimeout": _normalize_on_timeout(w.get("onTimeout")),
+        }
+        return StepState(phase=Phase.PAUSED, started_at=self.clock.now(),
+                         reason="AwaitingApproval")
+
+    def _execute_stop(self, run: Resource, step: Step, scope) -> StepState:
+        """stop: {phase (default Succeeded), message}
+        (reference: step_executor.go:1084-1101)"""
+        w = step.with_ or {}
+        raw_phase = w.get("phase", "Succeeded")
+        message = w.get("message", "")
+        if isinstance(message, str) and "{{" in message:
+            try:
+                message = str(self.evaluator.evaluate_string(message, scope))
+            except TemplateError:
+                pass
+        try:
+            phase = StopMode(str(raw_phase).lower()).terminal_phase
+        except ValueError:
+            try:
+                phase = Phase(raw_phase)
+            except ValueError:
+                phase = Phase.SUCCEEDED
+        if not phase.is_terminal:
+            phase = Phase.SUCCEEDED
+        run.status[STOP_KEY] = {"phase": str(phase), "message": message, "step": step.name}
+        return StepState(
+            phase=Phase.SUCCEEDED,
+            started_at=self.clock.now(),
+            finished_at=self.clock.now(),
+            message=message or None,
+        )
+
+    def _execute_parallel(
+        self, run: Resource, story: StorySpec, step: Step, scope, queue
+    ) -> StepState:
+        """parallel: {steps: []Step} — full inline Steps per branch; parent
+        completes when ALL children are terminal, fails if any
+        non-allowFailure branch failed (no completionPolicy — SURVEY §2.2
+        documents the reference implements none despite enum comments)
+        (reference: step_executor.go:741-747, dag.go:1112-1200)"""
+        w = step.with_ or {}
+        branches = [Step.from_dict(b) for b in (w.get("steps") or [])]
+        children = []
+        for branch in branches:
+            child_name = branch_steprun_name(run.meta.name, step.name, branch.name)
+            if branch.type is not None:
+                # primitive branches run as instant/timer states inside the
+                # parent's timer store, keyed parent.branch
+                raise ValueError(
+                    f"parallel branch {branch.name!r}: primitive branches are "
+                    "not supported; use engram steps"
+                )
+            self._execute_engram(
+                run, story, branch, scope, queue,
+                name_override=child_name, parent_step=step.name,
+            )
+            children.append({"name": branch.name, "stepRun": child_name,
+                             "allowFailure": bool(branch.allow_failure)})
+        run.status.setdefault(TIMERS_KEY, {})[step.name] = {
+            "kind": "parallel",
+            "children": children,
+        }
+        return StepState(phase=Phase.RUNNING, started_at=self.clock.now())
+
+    def _execute_story(self, run: Resource, step: Step, scope) -> StepState:
+        """executeStory: {storyRef (required), waitForCompletion (default
+        true), with} (reference: step_executor.go:1188-1215,
+        ensureSubStoryRun:1407)"""
+        w = step.with_ or {}
+        story_ref = w.get("storyRef") or {}
+        story_name = story_ref.get("name", "") if isinstance(story_ref, dict) else str(story_ref)
+        sub_inputs = w.get("with") or {}
+        try:
+            sub_inputs = self.evaluator.evaluate_value(sub_inputs, scope)
+        except TemplateError as e:
+            return StepState(
+                phase=Phase.FAILED,
+                started_at=self.clock.now(),
+                finished_at=self.clock.now(),
+                message=f"executeStory input evaluation failed: {e}",
+            )
+        # recursion guard: sub-story depth is inherited through a label and
+        # capped at the resolved max recursion depth (reference:
+        # executeStory reference-cycle validation + MaxRecursionDepth)
+        depth = int(run.meta.labels.get(DEPTH_LABEL, "0")) + 1
+        max_depth = self.config_manager.config.engram.max_recursion_depth
+        if depth > max_depth:
+            return StepState(
+                phase=Phase.FAILED,
+                started_at=self.clock.now(),
+                finished_at=self.clock.now(),
+                reason="RecursionDepthExceeded",
+                message=f"executeStory nesting depth {depth} exceeds limit {max_depth}",
+            )
+        wait = w.get("waitForCompletion", True)
+        child_name = compose_unique(run.meta.name, step.name, "sub")
+        child = new_resource(
+            STORY_RUN_KIND,
+            child_name,
+            run.meta.namespace,
+            spec={"storyRef": {"name": story_name}, "inputs": sub_inputs},
+            labels={
+                LABEL_STORY_RUN: run.meta.name,
+                LABEL_PARENT_STEP: step.name,
+                DEPTH_LABEL: str(depth),
+            },
+            owners=[run.owner_ref()],
+        )
+        try:
+            self.store.create(child)
+        except AlreadyExists:
+            pass
+        if not wait:
+            return StepState(
+                phase=Phase.SUCCEEDED,
+                started_at=self.clock.now(),
+                finished_at=self.clock.now(),
+                output={"storyRun": child_name},
+            )
+        run.status.setdefault(TIMERS_KEY, {})[step.name] = {
+            "kind": "subStory",
+            "storyRun": child_name,
+        }
+        return StepState(phase=Phase.RUNNING, started_at=self.clock.now())
+
+
+def _normalize_on_timeout(value) -> str:
+    """(reference: normalizeOnTimeout dag.go:1643)"""
+    v = str(value or "fail").lower()
+    return v if v in ("fail", "skip") else "fail"

@@ -1,0 +1,630 @@
+"""StepRun controller: the workhorse of batch execution.
+
+Capability parity with the reference's StepRun reconciler batch path
+(reference: internal/controller/runs/steprun_controller.go —
+Reconcile:195, reconcileNormal:300, reconcileJobExecution:533,
+prepareExecutionContext:1265, resolveRunScopedInputs:2875,
+tryCacheHit:3346, createJobForStep:1080, buildBaseEnvVars:1692,
+handleJobStatus:1947, scheduleRetryIfNeeded:2165,
+applyFailureFallback:2345):
+
+guards -> engram/template resolution (Blocked + watch recovery when
+missing) -> input resolution (scope build, template eval with the
+offloaded-data policy, schema validation, `requires` checks,
+re-dehydration) -> cache probe -> Job creation with the env contract +
+TPU slice grant -> Job status handling (SDK-vs-controller output race,
+output schema validation, postExecution check, declaredOutputKeys
+warnings, cache write) -> exit classification -> retry scheduling.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from ..api import conditions
+from ..api.catalog import (
+    CLUSTER_NAMESPACE,
+    ENGRAM_TEMPLATE_KIND,
+    parse_engram_template,
+)
+from ..api.engram import KIND as ENGRAM_KIND, parse_engram
+from ..api.enums import ExitClass, OffloadedDataPolicy, Phase, WorkloadMode
+from ..api.errors import ErrorType, StructuredError, validation_error
+from ..api.runs import STEP_RUN_KIND, STORY_RUN_KIND, parse_steprun
+from ..api.story import KIND as STORY_KIND, parse_story
+from ..core.events import EventRecorder
+from ..core.store import AlreadyExists, NotFound, ResourceStore
+from ..sdk import contract
+from ..storage.manager import StorageManager
+from ..templating.engine import (
+    Evaluator,
+    OffloadedDataUsage,
+    TemplateError,
+)
+from ..utils.hashing import cache_key as compute_cache_key
+from .jobs import JOB_KIND, make_job
+from .manager import Clock
+from .retry import classify_exit_code, compute_retry_delay, retry_budget_left
+
+_log = logging.getLogger(__name__)
+
+CANCEL_ANNOTATION = "runs.bobrapet.io/cancel"
+
+
+class StepRunController:
+    def __init__(
+        self,
+        store: ResourceStore,
+        config_manager,
+        resolver,
+        storage: StorageManager,
+        evaluator: Evaluator,
+        recorder: Optional[EventRecorder] = None,
+        clock: Optional[Clock] = None,
+    ):
+        self.store = store
+        self.config_manager = config_manager
+        self.resolver = resolver
+        self.storage = storage
+        self.evaluator = evaluator
+        self.recorder = recorder or EventRecorder()
+        self.clock = clock or Clock()
+
+    # ------------------------------------------------------------------
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        sr = self.store.try_get(STEP_RUN_KIND, namespace, name)
+        if sr is None:
+            return None
+        phase = Phase(sr.status.get("phase")) if sr.status.get("phase") else None
+        if phase is not None and phase.is_terminal:
+            return None
+        if sr.meta.deletion_timestamp is not None:
+            return None
+        spec = parse_steprun(sr)
+
+        # graceful-cancel marker from the StoryRun controller
+        if CANCEL_ANNOTATION in sr.meta.annotations:
+            return self._finish_canceled(sr)
+
+        # --- resolve engram + template (Blocked on missing refs,
+        # reference: steprun_controller.go:320,374) ---
+        engram_name = spec.engram_ref.name if spec.engram_ref else ""
+        engram = self.store.try_get(ENGRAM_KIND, namespace, engram_name)
+        if engram is None:
+            self._set_blocked(sr, conditions.Reason.REFERENCE_NOT_FOUND,
+                              f"engram {engram_name!r} not found")
+            return None
+        engram_spec = parse_engram(engram)
+        template_name = engram_spec.template_ref.name if engram_spec.template_ref else ""
+        template = self.store.try_get(
+            ENGRAM_TEMPLATE_KIND, CLUSTER_NAMESPACE, template_name
+        )
+        if template is None:
+            self._set_blocked(sr, conditions.Reason.TEMPLATE_NOT_FOUND,
+                              f"engram template {template_name!r} not found")
+            return None
+        template_spec = parse_engram_template(template)
+
+        mode = engram_spec.mode or (
+            template_spec.supported_modes[0]
+            if template_spec.supported_modes
+            else WorkloadMode.JOB
+        )
+        if mode.is_realtime:
+            # realtime path materializes a long-running service + binding
+            # (transport milestone); until the service reports ready the
+            # StepRun stays Pending
+            return self._reconcile_realtime(sr, spec, engram_spec, template_spec)
+        return self._reconcile_job(sr, spec, engram, engram_spec, template, template_spec)
+
+    # ------------------------------------------------------------------
+    # batch path
+    # ------------------------------------------------------------------
+    def _reconcile_job(self, sr, spec, engram, engram_spec, template, template_spec):
+        namespace, name = sr.meta.namespace, sr.meta.name
+
+        # story context for scope + policies
+        run_name = spec.story_run_ref.name if spec.story_run_ref else ""
+        storyrun = self.store.try_get(STORY_RUN_KIND, namespace, run_name)
+        story_policy = None
+        story_name = ""
+        step_def = None
+        if storyrun is not None:
+            story_name = (storyrun.spec.get("storyRef") or {}).get("name", "")
+            story = self.store.try_get(STORY_KIND, namespace, story_name)
+            if story is not None:
+                story_spec = parse_story(story)
+                story_policy = story_spec.policy
+                if spec.step_id:
+                    step_def = _find_step_def(story_spec, spec.step_id)
+
+        resolved = self.resolver.resolve(
+            template_spec=template_spec,
+            engram_spec=engram_spec,
+            story_policy=story_policy,
+            step=step_def,
+            steprun_overrides=spec.execution_overrides,
+        )
+        if spec.timeout:
+            from ..utils.duration import parse_duration
+
+            resolved.timeout_seconds = parse_duration(spec.timeout, resolved.timeout_seconds)
+        if spec.retry is not None:
+            from ..config.resolver import _merge_spec
+
+            resolved.retry = _merge_spec(resolved.retry, spec.retry)
+
+        job_name = sr.status.get("jobName")
+        if job_name:
+            return self._handle_job_status(
+                sr, spec, resolved, template_spec, job_name, storyrun, story_name
+            )
+
+        # --- retry gate: a scheduled retry waits for nextRetryAt ---
+        next_retry_at = sr.status.get("nextRetryAt")
+        if next_retry_at is not None and self.clock.now() < float(next_retry_at):
+            return float(next_retry_at) - self.clock.now()
+
+        # --- resolve inputs ---
+        try:
+            resolved_inputs = self._resolve_inputs(
+                sr, spec, template_spec, storyrun, engram_spec
+            )
+        except OffloadedDataUsage as e:
+            return self._fail(
+                sr,
+                StructuredError(
+                    type=ErrorType.VALIDATION,
+                    message=f"template references offloaded data under policy=fail: {e}",
+                    exit_class=ExitClass.TERMINAL,
+                ),
+            )
+        except TemplateError as e:
+            return self._fail(
+                sr,
+                StructuredError(
+                    type=ErrorType.VALIDATION,
+                    message=f"input template evaluation failed: {e}",
+                    exit_class=ExitClass.TERMINAL,
+                ),
+            )
+        except InputValidationError as e:
+            return self._fail(sr, validation_error(str(e)))
+
+        # --- cache probe (reference: tryCacheHit:3346) ---
+        cache_cfg = resolved.cache
+        cache_enabled = bool(cache_cfg and cache_cfg.enabled)
+        ck = None
+        if cache_enabled:
+            ck = self._cache_key(cache_cfg, resolved_inputs, template, engram)
+            hit = self._cache_read(ck)
+            if hit is not None:
+                def apply_hit(status: dict[str, Any]) -> None:
+                    status["phase"] = str(Phase.SUCCEEDED)
+                    status["output"] = hit
+                    status["cacheHit"] = True
+                    status["finishedAt"] = self.clock.now()
+                self.store.patch_status(STEP_RUN_KIND, namespace, name, apply_hit)
+                self.recorder.normal(sr, "CacheHit", f"cache key {ck[:12]} hit")
+                return None
+
+        # --- create the Job (gang of hosts, env contract) ---
+        retries = int(sr.status.get("retries") or 0)
+        attempt = int(sr.status.get("attempts") or 0)
+        job_name = f"{name}-a{attempt}"
+        tpu = resolved.tpu
+        slice_grant = spec.slice_grant or {}
+        hosts = int(slice_grant.get("hosts") or (tpu.hosts if tpu and tpu.hosts else 1))
+        offloaded_inputs = self.storage.dehydrate(
+            resolved_inputs,
+            StorageManager.step_key(namespace, run_name or name, spec.step_id or name, "input"),
+            max_inline_size=resolved.max_inline_size,
+        )
+        cfg = self.config_manager.config
+        env = contract.build_env(
+            namespace=namespace,
+            story=story_name,
+            story_run=run_name,
+            step=spec.step_id or name,
+            step_run=name,
+            engram=engram.meta.name,
+            execution_mode="job",
+            inputs=offloaded_inputs,
+            config=engram_spec.with_config or {},
+            step_timeout_seconds=resolved.timeout_seconds,
+            max_inline_size=resolved.max_inline_size,
+            storage_timeout_seconds=cfg.engram.storage_timeout_seconds,
+            max_recursion_depth=resolved.max_recursion_depth,
+            grpc_port=cfg.engram.grpc_port,
+            debug=resolved.debug,
+            tpu_accelerator=str(tpu.accelerator) if tpu and tpu.accelerator else None,
+            tpu_topology=slice_grant.get("topology") or (tpu.topology if tpu else None),
+            tpu_hosts=hosts,
+            coordinator_address=slice_grant.get("coordinatorAddress"),
+            mesh_axes=slice_grant.get("meshAxes") or (tpu.mesh_axes if tpu else None),
+            slice_id=slice_grant.get("sliceId"),
+        )
+        job = make_job(
+            job_name,
+            namespace,
+            name,
+            entrypoint=resolved.entrypoint or resolved.image or "",
+            env=env,
+            hosts=hosts,
+            timeout_seconds=resolved.timeout_seconds,
+            image=resolved.image,
+            slice_grant=slice_grant or None,
+            owners=[sr.owner_ref()],
+            labels={
+                "bobrapet.io/story-run": run_name,
+                "bobrapet.io/step": spec.step_id or name,
+            },
+        )
+
+        def mark_running(status: dict[str, Any]) -> None:
+            status["phase"] = str(Phase.RUNNING)
+            status["jobName"] = job_name
+            status["attempts"] = attempt + 1
+            status["retries"] = retries
+            status.setdefault("startedAt", self.clock.now())
+            status.pop("nextRetryAt", None)
+            if ck is not None:
+                status["cacheKey"] = ck
+
+        # mark first so the job-status watch can't race an unclaimed state
+        self.store.patch_status(STEP_RUN_KIND, namespace, name, mark_running)
+        try:
+            self.store.create(job)
+        except AlreadyExists:
+            pass  # adopt: deterministic name makes the create idempotent
+        return None
+
+    # ------------------------------------------------------------------
+    def _handle_job_status(
+        self, sr, spec, resolved, template_spec, job_name, storyrun, story_name
+    ):
+        namespace, name = sr.meta.namespace, sr.meta.name
+        job = self.store.try_get(JOB_KIND, namespace, job_name)
+        if job is None:
+            # job vanished (evicted/cleaned) -> unknown exit, retry without
+            # consuming budget (reference: ExitClassUnknown semantics)
+            return self._handle_failure(sr, spec, resolved, exit_code=None, message="job vanished")
+        jphase = job.status.get("phase")
+        if jphase == str(Phase.SUCCEEDED):
+            return self._handle_success(sr, spec, resolved, template_spec, job)
+        if jphase == str(Phase.FAILED):
+            return self._handle_failure(
+                sr,
+                spec,
+                resolved,
+                exit_code=job.status.get("exitCode"),
+                message=job.status.get("message", ""),
+            )
+        return None  # still running; job watch will re-trigger us
+
+    def _handle_success(self, sr, spec, resolved, template_spec, job):
+        namespace, name = sr.meta.namespace, sr.meta.name
+        fresh = self.store.get(STEP_RUN_KIND, namespace, name)
+        # SDK-vs-controller race (reference: stepStatusPatchedBySDK:2031):
+        # the SDK writes status.output directly; the controller only reads
+        # it here — a job that succeeded without reporting yields {}
+        output = fresh.status.get("output")
+        if output is None:
+            output = {}
+
+        # output schema validation (reference: handleJobSucceeded:2050)
+        if template_spec.output_schema:
+            err = _validate_schema(
+                self._hydrated_for_validation(output, namespace, spec), template_spec.output_schema, "output"
+            )
+            if err is not None:
+                return self._fail(sr, validation_error(err))
+
+        # postExecution condition (reference: :2088)
+        post = spec_post_execution(sr)
+        if post is not None:
+            scope = {"inputs": {}, "steps": {}, "run": {}, "output": output}
+            try:
+                ok = self.evaluator.evaluate_condition(post.get("condition", ""), {**scope, "steps": {}})
+            except TemplateError as e:
+                return self._fail(sr, validation_error(f"postExecution evaluation failed: {e}"))
+            if not ok:
+                msg = post.get("failureMessage") or "postExecution condition failed"
+                return self._fail(sr, StructuredError(
+                    type=ErrorType.VALIDATION, message=msg, exit_class=ExitClass.TERMINAL))
+
+        # declaredOutputKeys warnings (reference: declared keys advisory)
+        if template_spec.declared_output_keys and isinstance(output, dict):
+            missing = [k for k in template_spec.declared_output_keys if k not in output]
+            if missing:
+                self.recorder.warning(
+                    sr, "DeclaredOutputKeysMissing",
+                    f"output missing declared keys: {missing}",
+                )
+
+        # cache write (reference: maybeWriteCache:3403)
+        ck = fresh.status.get("cacheKey")
+        if ck and resolved.cache and resolved.cache.enabled:
+            self._cache_write(ck, output, resolved.cache)
+
+        exit_code = job.status.get("exitCode", 0)
+
+        def finish(status: dict[str, Any]) -> None:
+            status["phase"] = str(Phase.SUCCEEDED)
+            status["output"] = output
+            status["exitCode"] = exit_code
+            status["exitClass"] = str(ExitClass.SUCCESS)
+            status["finishedAt"] = self.clock.now()
+            status.pop("error", None)
+
+        self.store.patch_status(STEP_RUN_KIND, namespace, name, finish)
+        return None
+
+    def _handle_failure(self, sr, spec, resolved, exit_code, message):
+        namespace, name = sr.meta.namespace, sr.meta.name
+        exit_class = classify_exit_code(exit_code)
+        retries = int(sr.status.get("retries") or 0)
+        retry_policy = resolved.retry
+
+        if exit_class.is_retryable and (
+            not exit_class.consumes_retry_budget
+            or retry_budget_left(retry_policy, retries)
+        ):
+            consumed = retries + (1 if exit_class.consumes_retry_budget else 0)
+            delay = compute_retry_delay(
+                retry_policy,
+                attempt=max(1, consumed),
+                rate_limited=exit_class is ExitClass.RATE_LIMITED,
+            )
+            due = self.clock.now() + delay
+
+            def schedule(status: dict[str, Any]) -> None:
+                status["phase"] = str(Phase.PENDING)
+                status["retries"] = consumed
+                status["nextRetryAt"] = due
+                status["exitCode"] = exit_code
+                status["exitClass"] = str(exit_class)
+                status.pop("jobName", None)
+
+            self.store.patch_status(STEP_RUN_KIND, namespace, name, schedule)
+            self.recorder.warning(
+                sr, conditions.Reason.RETRY_SCHEDULED,
+                f"exit {exit_code} ({exit_class}); retry {consumed} in {delay:.1f}s",
+            )
+            return delay
+
+        # terminal failure; keep SDK-reported structured error if present
+        fresh = self.store.get(STEP_RUN_KIND, namespace, name)
+        err_payload = fresh.status.get("error")
+        if not err_payload:
+            # applyFailureFallback (reference: :2345) — SDK died before
+            # reporting; synthesize from the exit facts
+            err_payload = StructuredError(
+                type=ErrorType.TIMEOUT if exit_code == contract.EXIT_TIMEOUT else ErrorType.EXECUTION,
+                message=message or f"step failed with exit code {exit_code}",
+                exit_class=exit_class,
+                retryable=False,
+                details={"exitCode": exit_code},
+            ).to_dict()
+
+        phase = Phase.TIMEOUT if exit_code == contract.EXIT_TIMEOUT else Phase.FAILED
+
+        def fail(status: dict[str, Any]) -> None:
+            status["phase"] = str(phase)
+            status["exitCode"] = exit_code
+            status["exitClass"] = str(exit_class)
+            status["error"] = err_payload
+            status["finishedAt"] = self.clock.now()
+
+        self.store.patch_status(STEP_RUN_KIND, namespace, name, fail)
+        return None
+
+    def _fail(self, sr, err: StructuredError):
+        def fail(status: dict[str, Any]) -> None:
+            status["phase"] = str(Phase.FAILED)
+            status["error"] = err.to_dict()
+            status["finishedAt"] = self.clock.now()
+
+        self.store.patch_status(STEP_RUN_KIND, sr.meta.namespace, sr.meta.name, fail)
+        return None
+
+    def _finish_canceled(self, sr):
+        job_name = sr.status.get("jobName")
+        if job_name:
+            try:
+                self.store.delete(JOB_KIND, sr.meta.namespace, job_name)
+            except NotFound:
+                pass
+
+        def cancel(status: dict[str, Any]) -> None:
+            status["phase"] = str(Phase.FINISHED)
+            status["finishedAt"] = self.clock.now()
+            status["reason"] = conditions.Reason.CANCELED
+
+        self.store.patch_status(STEP_RUN_KIND, sr.meta.namespace, sr.meta.name, cancel)
+        return None
+
+    def _set_blocked(self, sr, reason: str, message: str):
+        def block(status: dict[str, Any]) -> None:
+            status["phase"] = str(Phase.BLOCKED)
+            status["reason"] = reason
+            status["message"] = message
+            conds = status.setdefault("conditions", [])
+            conditions.set_condition(conds, conditions.READY, False, reason, message,
+                                     now=self.clock.now())
+
+        self.store.patch_status(STEP_RUN_KIND, sr.meta.namespace, sr.meta.name, block)
+        self.recorder.warning(sr, reason, message)
+
+    # ------------------------------------------------------------------
+    # input resolution
+    # ------------------------------------------------------------------
+    def _resolve_inputs(self, sr, spec, template_spec, storyrun, engram_spec):
+        """(reference: resolveRunScopedInputs:2875)"""
+        namespace = sr.meta.namespace
+        run_inputs: dict[str, Any] = {}
+        prior_outputs: dict[str, Any] = {}
+        run_meta: dict[str, Any] = {}
+        if storyrun is not None:
+            run_inputs = storyrun.spec.get("inputs") or {}
+            for step_name, state in (storyrun.status.get("stepStates") or {}).items():
+                prior_outputs[step_name] = {
+                    "output": state.get("output"),
+                    "signals": state.get("signals") or {},
+                    "phase": state.get("phase"),
+                }
+            run_meta = {
+                "name": storyrun.meta.name,
+                "namespace": namespace,
+                "storyName": (storyrun.spec.get("storyRef") or {}).get("name", ""),
+            }
+        scope = {"inputs": run_inputs, "steps": prior_outputs, "run": run_meta}
+
+        raw = spec.input or {}
+        policy = self.config_manager.config.templating.offloaded_data_policy
+        try:
+            resolved = self.evaluator.evaluate_value(raw, scope)
+        except OffloadedDataUsage:
+            if policy is OffloadedDataPolicy.FAIL:
+                raise
+            # inject / controller policies hydrate the offloaded values
+            # into the scope and re-evaluate (reference: in-process resolve
+            # resolve_inprocess.go; controller-materialize delegates to a
+            # dedicated engram — here hydration happens in-controller)
+            prefix = f"runs/{namespace}/{storyrun.meta.name}" if storyrun is not None else None
+            hydrated_scope = {
+                "inputs": self.storage.hydrate(run_inputs, [prefix] if prefix else None),
+                "steps": self.storage.hydrate(prior_outputs, [prefix] if prefix else None),
+                "run": run_meta,
+            }
+            resolved = self.evaluator.evaluate_value(raw, hydrated_scope)
+
+        # `requires` checks (reference: :5523)
+        story = None
+        step_def = None
+        if storyrun is not None:
+            story_name = (storyrun.spec.get("storyRef") or {}).get("name", "")
+            story = self.store.try_get(STORY_KIND, namespace, story_name)
+        if story is not None and spec.step_id:
+            step_def = parse_story(story).step(spec.step_id)
+        if step_def is not None and step_def.requires:
+            missing = [
+                k for k in step_def.requires
+                if not isinstance(resolved, dict) or k not in resolved or resolved.get(k) is None
+            ]
+            if missing:
+                raise InputValidationError(f"required inputs missing: {missing}")
+
+        # input schema validation (hydrate markers first so the schema sees
+        # real values)
+        if template_spec.input_schema:
+            err = _validate_schema(
+                self._hydrated_for_validation(resolved, namespace, spec),
+                template_spec.input_schema,
+                "input",
+            )
+            if err is not None:
+                raise InputValidationError(err)
+        return resolved
+
+    def _hydrated_for_validation(self, value, namespace, spec):
+        run_name = spec.story_run_ref.name if spec.story_run_ref else ""
+        prefix = f"runs/{namespace}/{run_name}" if run_name else None
+        try:
+            return self.storage.hydrate(value, [prefix] if prefix else None)
+        except Exception:  # noqa: BLE001 - validation best-effort on refs
+            return value
+
+    # ------------------------------------------------------------------
+    # cache
+    # ------------------------------------------------------------------
+    def _cache_key(self, cache_cfg, resolved_inputs, template, engram) -> str:
+        salt = cache_cfg.salt or ""
+        mode = cache_cfg.mode or "inputs"
+        basis = {
+            "inputs": resolved_inputs,
+            "template": template.meta.name,
+            "templateGeneration": template.meta.generation,
+            "engram": engram.meta.name,
+        }
+        if mode == "key" and cache_cfg.key:
+            basis = {"key": cache_cfg.key}
+        return compute_cache_key(basis, salt=salt, mode=mode)
+
+    def _cache_blob_key(self, ck: str) -> str:
+        return f"cache/steps/{ck}"
+
+    def _cache_read(self, ck: str):
+        import json
+
+        from ..storage.store import BlobNotFound
+
+        try:
+            data = self.storage.store.get(self._cache_blob_key(ck))
+        except BlobNotFound:
+            return None
+        try:
+            payload = json.loads(data.decode())
+        except ValueError:
+            return None
+        ttl = payload.get("ttlSeconds")
+        if ttl and self.clock.now() - payload.get("storedAt", 0) > ttl:
+            return None
+        return payload.get("output")
+
+    def _cache_write(self, ck: str, output, cache_cfg) -> None:
+        import json
+
+        payload = {
+            "output": output,
+            "storedAt": self.clock.now(),
+            "ttlSeconds": cache_cfg.ttl_seconds,
+        }
+        self.storage.store.put(
+            self._cache_blob_key(ck), json.dumps(payload, default=str).encode()
+        )
+
+    # ------------------------------------------------------------------
+    # realtime placeholder (full implementation in the transport layer)
+    # ------------------------------------------------------------------
+    def _reconcile_realtime(self, sr, spec, engram_spec, template_spec):
+        from .realtime import reconcile_realtime_step
+
+        return reconcile_realtime_step(self, sr, spec, engram_spec, template_spec)
+
+
+class InputValidationError(Exception):
+    pass
+
+
+def _find_step_def(story_spec, step_id: str):
+    """Locate a step definition by name, including `parallel` branches."""
+    from ..api.story import Step
+
+    direct = story_spec.step(step_id)
+    if direct is not None:
+        return direct
+    for s in story_spec.all_steps():
+        if s.type is not None and s.with_:
+            for raw in s.with_.get("steps") or []:
+                branch = Step.from_dict(raw)
+                if branch.name == step_id:
+                    return branch
+    return None
+
+
+def spec_post_execution(sr) -> Optional[dict[str, Any]]:
+    return (sr.spec.get("postExecution") or None) if isinstance(sr.spec, dict) else None
+
+
+def _validate_schema(value, schema: dict[str, Any], what: str) -> Optional[str]:
+    try:
+        import jsonschema
+
+        jsonschema.validate(value, schema)
+        return None
+    except ImportError:  # pragma: no cover
+        return None
+    except Exception as e:  # noqa: BLE001 - collapse validator errors
+        return f"{what} schema validation failed: {getattr(e, 'message', e)}"

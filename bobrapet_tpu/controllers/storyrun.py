@@ -1,0 +1,395 @@
+"""StoryRun controller: run lifecycle around the DAG engine.
+
+Capability parity with the reference StoryRun reconciler
+(reference: internal/controller/runs/storyrun_controller.go —
+Reconcile:216, handleRedriveFromStepIfRequested:295,
+handleGracefulCancel:1517, handleTerminalStoryRun:1811,
+ensureChildCleanup:1882, resolveRetentionSettings:1992):
+
+guards (story ref + cross-namespace policy, input schema, oversized
+inputs) -> finalizer for storage cleanup -> redrive (full +
+from-step) -> graceful cancel with drain window -> DAG reconcile ->
+two-phase retention (children TTL, then run record).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from ..api import conditions
+from ..api.enums import Phase
+from ..api.errors import ErrorType, StructuredError
+from ..api.policy import reference_granted
+from ..api.runs import STEP_RUN_KIND, STORY_RUN_KIND
+from ..api.story import KIND as STORY_KIND, parse_story
+from ..core.object import Resource
+from ..core.store import NotFound, ResourceStore
+from ..storage.manager import StorageManager
+from ..utils.duration import parse_duration
+from .dag import INDEX_STEPRUN_STORYRUN, DAGEngine
+from .manager import Clock
+from .steprun import CANCEL_ANNOTATION
+
+_log = logging.getLogger(__name__)
+
+FINALIZER = "runs.bobrapet.io/storage-cleanup"
+REDRIVE_ANNOTATION = "runs.bobrapet.io/redrive"
+
+
+class StoryRunController:
+    def __init__(
+        self,
+        store: ResourceStore,
+        dag: DAGEngine,
+        config_manager,
+        storage: StorageManager,
+        recorder=None,
+        clock: Optional[Clock] = None,
+    ):
+        self.store = store
+        self.dag = dag
+        self.config_manager = config_manager
+        self.storage = storage
+        self.recorder = recorder
+        self.clock = clock or Clock()
+
+    # ------------------------------------------------------------------
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        run = self.store.try_get(STORY_RUN_KIND, namespace, name)
+        if run is None:
+            return None
+
+        # deletion: storage cleanup behind a finalizer
+        if run.meta.deletion_timestamp is not None:
+            if FINALIZER in run.meta.finalizers:
+                self.storage.delete_prefix(StorageManager.run_prefix(namespace, name))
+
+                def strip(r: Resource) -> None:
+                    if FINALIZER in r.meta.finalizers:
+                        r.meta.finalizers.remove(FINALIZER)
+
+                self.store.mutate(STORY_RUN_KIND, namespace, name, strip)
+            return None
+
+        if FINALIZER not in run.meta.finalizers:
+            def add_fin(r: Resource) -> None:
+                if FINALIZER not in r.meta.finalizers:
+                    r.meta.finalizers.append(FINALIZER)
+
+            run = self.store.mutate(STORY_RUN_KIND, namespace, name, add_fin)
+
+        # redrive before the terminal check: redriving a terminal run
+        # resets it (reference: handleRedriveFromStepIfRequested:295)
+        if REDRIVE_ANNOTATION in run.meta.annotations:
+            return self._handle_redrive(run)
+
+        phase = Phase(run.status["phase"]) if run.status.get("phase") else None
+        if phase is not None and phase.is_terminal:
+            return self._handle_terminal(run)
+
+        # graceful cancel (reference: handleGracefulCancel:1517)
+        if run.spec.get("cancelRequested"):
+            return self._handle_cancel(run)
+
+        # --- story resolution + guards ---
+        story_ref = run.spec.get("storyRef") or {}
+        story_name = story_ref.get("name", "")
+        story_ns = story_ref.get("namespace") or namespace
+        if story_ns != namespace:
+            policy = self.config_manager.config.reference_cross_namespace_policy
+            allowed = policy == "allow" or (
+                policy == "grant"
+                and reference_granted(
+                    self.store, STORY_RUN_KIND, namespace, STORY_KIND, story_ns, story_name
+                )
+            )
+            if not allowed:
+                return self._fail(
+                    run,
+                    StructuredError(
+                        type=ErrorType.VALIDATION,
+                        message=f"cross-namespace story reference {story_ns}/{story_name} "
+                        f"denied by policy {policy!r}",
+                    ),
+                    reason=conditions.Reason.STORY_REFERENCE_INVALID,
+                )
+        story_res = self.store.try_get(STORY_KIND, story_ns, story_name)
+        if story_res is None:
+            self._set_pending(run, conditions.Reason.STORY_NOT_FOUND,
+                              f"story {story_ns}/{story_name} not found")
+            return None
+        story = parse_story(story_res)
+
+        # version pinning (reference: storytrigger_controller.go:101-109)
+        pinned = story_ref.get("version")
+        if pinned and story.version and pinned != story.version:
+            return self._fail(
+                run,
+                StructuredError(
+                    type=ErrorType.VALIDATION,
+                    message=f"story version mismatch: run pinned {pinned!r}, "
+                    f"story is {story.version!r}",
+                ),
+                reason=conditions.Reason.STORY_REFERENCE_INVALID,
+            )
+
+        # input schema validation (reference: reconcileAfterSetup:912)
+        if story.inputs_schema and not run.status.get("inputsValidated"):
+            err = _validate_inputs(run.spec.get("inputs") or {}, story.inputs_schema)
+            if err:
+                return self._fail(
+                    run,
+                    StructuredError(type=ErrorType.VALIDATION, message=err),
+                    reason=conditions.Reason.INPUT_SCHEMA_FAILED,
+                )
+
+        # oversized-inputs guard (reference: oversized-input guard —
+        # admission normally dehydrates; double-check here)
+        max_inline = self.config_manager.config.engram.max_inline_size
+        inputs = run.spec.get("inputs") or {}
+        import json
+
+        if inputs and len(json.dumps(inputs, default=str)) > max_inline * 4:
+            offloaded = self.storage.dehydrate_inputs(
+                inputs, f"runs/{namespace}/{name}/inputs", max_inline_size=max_inline
+            )
+
+            def swap_inputs(r: Resource) -> None:
+                r.spec["inputs"] = offloaded
+
+            run = self.store.mutate(STORY_RUN_KIND, namespace, name, swap_inputs)
+
+        # --- DAG reconcile (engine mutates a working copy's status) ---
+        before = json.dumps(run.status, sort_keys=True, default=str)
+        requeue = self.dag.run(run, story)
+        after = json.dumps(run.status, sort_keys=True, default=str)
+        if after != before:
+            new_status = dict(run.status)
+            new_status["inputsValidated"] = True
+            new_status["observedGeneration"] = run.meta.generation
+
+            def persist(status: dict[str, Any]) -> None:
+                # merge externally-patched channels written since our read
+                # (gate decisions arrive via concurrent status patches —
+                # clobbering them would turn approvals into GateTimeouts)
+                fresh_gates = status.get("gates") or {}
+                merged = dict(new_status)
+                merged_gates = {**(merged.get("gates") or {}), **fresh_gates}
+                if merged_gates:
+                    merged["gates"] = merged_gates
+                status.clear()
+                status.update(merged)
+
+            self.store.patch_status(STORY_RUN_KIND, namespace, name, persist)
+        return requeue
+
+    # ------------------------------------------------------------------
+    def _set_pending(self, run: Resource, reason: str, message: str) -> None:
+        def patch(status: dict[str, Any]) -> None:
+            status["phase"] = str(Phase.PENDING)
+            status["reason"] = reason
+            status["message"] = message
+            conds = status.setdefault("conditions", [])
+            conditions.set_condition(conds, conditions.READY, False, reason, message,
+                                     now=self.clock.now())
+
+        self.store.patch_status(STORY_RUN_KIND, run.meta.namespace, run.meta.name, patch)
+
+    def _fail(self, run: Resource, err: StructuredError, reason: str) -> None:
+        def patch(status: dict[str, Any]) -> None:
+            status["phase"] = str(Phase.FAILED)
+            status["error"] = err.to_dict()
+            status["reason"] = reason
+            status["finishedAt"] = self.clock.now()
+
+        self.store.patch_status(STORY_RUN_KIND, run.meta.namespace, run.meta.name, patch)
+        return None
+
+    # ------------------------------------------------------------------
+    # graceful cancel
+    # ------------------------------------------------------------------
+    def _handle_cancel(self, run: Resource) -> Optional[float]:
+        ns, name = run.meta.namespace, run.meta.name
+        now = self.clock.now()
+        started = run.status.get("cancelRequestedAt")
+        if started is None:
+            def mark(status: dict[str, Any]) -> None:
+                status["cancelRequestedAt"] = now
+                status["reason"] = conditions.Reason.CANCELED
+
+            self.store.patch_status(STORY_RUN_KIND, ns, name, mark)
+            started = now
+
+        # annotate non-terminal children (their controller tears them down)
+        children = self.store.list(
+            STEP_RUN_KIND, namespace=ns, index=(INDEX_STEPRUN_STORYRUN, name)
+        )
+        all_terminal = True
+        for sr in children:
+            phase = sr.status.get("phase")
+            if phase and Phase(phase).is_terminal:
+                continue
+            all_terminal = False
+            if CANCEL_ANNOTATION not in sr.meta.annotations:
+                def annotate(r: Resource) -> None:
+                    r.meta.annotations[CANCEL_ANNOTATION] = "storyrun-cancel"
+
+                try:
+                    self.store.mutate(STEP_RUN_KIND, ns, sr.meta.name, annotate)
+                except NotFound:
+                    pass
+
+        drain = self._drain_timeout(run)
+        if all_terminal or now - started >= drain:
+            # force-finish (reference: :1517 force after drain window)
+            def finish(status: dict[str, Any]) -> None:
+                status["phase"] = str(Phase.FINISHED)
+                status["reason"] = conditions.Reason.CANCELED
+                status["finishedAt"] = self.clock.now()
+
+            self.store.patch_status(STORY_RUN_KIND, ns, name, finish)
+            return None
+        return min(1.0, max(0.1, drain - (now - started)))
+
+    def _drain_timeout(self, run: Resource) -> float:
+        """(reference: transport drain timeout resolution :1700-1810)"""
+        story_ref = run.spec.get("storyRef") or {}
+        story = self.store.try_get(
+            STORY_KIND, story_ref.get("namespace") or run.meta.namespace,
+            story_ref.get("name", ""),
+        )
+        if story is not None:
+            spec = parse_story(story)
+            if spec.policy and spec.policy.timeouts and spec.policy.timeouts.graceful_shutdown_timeout:
+                return parse_duration(spec.policy.timeouts.graceful_shutdown_timeout, 30.0) or 30.0
+        return 30.0
+
+    # ------------------------------------------------------------------
+    # redrive (reference: :295-807)
+    # ------------------------------------------------------------------
+    def _handle_redrive(self, run: Resource) -> Optional[float]:
+        ns, name = run.meta.namespace, run.meta.name
+        target = run.meta.annotations.get(REDRIVE_ANNOTATION, "")
+        from_step = target.removeprefix("from:") if target.startswith("from:") else None
+
+        story_ref = run.spec.get("storyRef") or {}
+        story_res = self.store.try_get(
+            STORY_KIND, story_ref.get("namespace") or ns, story_ref.get("name", "")
+        )
+        affected: Optional[set[str]] = None
+        if from_step and story_res is not None:
+            affected = _transitive_dependents(parse_story(story_res), from_step)
+            affected.add(from_step)
+
+        # delete affected child StepRuns (cascade removes their Jobs)
+        for sr in self.store.list(
+            STEP_RUN_KIND, namespace=ns, index=(INDEX_STEPRUN_STORYRUN, name)
+        ):
+            step_id = sr.spec.get("stepId") or ""
+            if affected is not None and step_id not in affected:
+                continue
+            try:
+                self.store.delete(STEP_RUN_KIND, ns, sr.meta.name)
+            except NotFound:
+                pass
+
+        def reset(r: Resource) -> None:
+            r.meta.annotations.pop(REDRIVE_ANNOTATION, None)
+
+        self.store.mutate(STORY_RUN_KIND, ns, name, reset)
+
+        def reset_status(status: dict[str, Any]) -> None:
+            states = status.get("stepStates") or {}
+            if affected is None:
+                status["stepStates"] = {}
+                status.pop("stepTimers", None)
+                status.pop("stopRequest", None)
+            else:
+                for step in affected:
+                    states.pop(step, None)
+                    (status.get("stepTimers") or {}).pop(step, None)
+            status["phase"] = str(Phase.RUNNING)
+            status.pop("error", None)
+            status.pop("output", None)
+            status.pop("finishedAt", None)
+            status.pop("childrenCleanedAt", None)
+            status["dagPhase"] = "main"
+            status["redrives"] = int(status.get("redrives") or 0) + 1
+
+        self.store.patch_status(STORY_RUN_KIND, ns, name, reset_status)
+        return 0.0  # reconcile again immediately
+
+    # ------------------------------------------------------------------
+    # two-phase retention (reference: :1811-2069)
+    # ------------------------------------------------------------------
+    def _handle_terminal(self, run: Resource) -> Optional[float]:
+        ns, name = run.meta.namespace, run.meta.name
+        cfg = self.config_manager.config.retention
+        finished = run.status.get("finishedAt") or self.clock.now()
+        now = self.clock.now()
+
+        children_ttl = cfg.children_ttl_seconds
+        retention = cfg.storyrun_retention_seconds
+
+        if now - finished >= children_ttl and not run.status.get("childrenCleanedAt"):
+            for sr in self.store.list(
+                STEP_RUN_KIND, namespace=ns, index=(INDEX_STEPRUN_STORYRUN, name)
+            ):
+                try:
+                    self.store.delete(STEP_RUN_KIND, ns, sr.meta.name)
+                except NotFound:
+                    pass
+
+            def mark(status: dict[str, Any]) -> None:
+                status["childrenCleanedAt"] = now
+
+            self.store.patch_status(STORY_RUN_KIND, ns, name, mark)
+
+        if now - finished >= retention:
+            try:
+                self.store.delete(STORY_RUN_KIND, ns, name)
+            except NotFound:
+                pass
+            return None
+
+        next_boundary = min(
+            (finished + children_ttl) if not run.status.get("childrenCleanedAt") else float("inf"),
+            finished + retention,
+        )
+        return max(0.5, next_boundary - now)
+
+
+def _validate_inputs(inputs: dict[str, Any], schema: dict[str, Any]) -> Optional[str]:
+    try:
+        import jsonschema
+
+        jsonschema.validate(inputs, schema)
+        return None
+    except ImportError:  # pragma: no cover
+        return None
+    except Exception as e:  # noqa: BLE001
+        return f"inputs schema validation failed: {getattr(e, 'message', e)}"
+
+
+def _transitive_dependents(story, from_step: str) -> set[str]:
+    """Steps that (transitively) depend on from_step
+    (explicit needs + mined template refs)."""
+    from ..templating.engine import Evaluator
+
+    deps: dict[str, set[str]] = {}
+    for s in story.steps:
+        d = set(s.needs)
+        d |= Evaluator.find_step_references({"with": s.with_, "if": s.if_})
+        deps[s.name] = d
+    out: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, d in deps.items():
+            if name in out:
+                continue
+            if from_step in d or (d & out):
+                out.add(name)
+                changed = True
+    return out

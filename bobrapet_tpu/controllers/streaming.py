@@ -1,0 +1,56 @@
+"""Streaming topology materialization (control plane, minimal core).
+
+Full codec negotiation / routing / handoff arrives with the transport
+layer; this core keeps realtime StepRuns functional: per-run Service +
+worker record, phase derived from readiness
+(reference: ensureRealtimeService:2677, ensureRealtimeDeployment:2762,
+deriveRealtimePhase:2838).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..api.enums import Phase
+from ..api.runs import STEP_RUN_KIND
+from ..core.object import new_resource
+from ..core.store import AlreadyExists
+
+SERVICE_KIND = "Service"
+
+
+def ensure_realtime_topology(ctrl, sr, spec, engram_spec, template_spec):
+    """Materialize the per-run service record and mark the step Running.
+
+    The local data plane connects engram workers directly (they resolve
+    each other through these Service records); on GKE this becomes a real
+    Service + Deployment pair.
+    """
+    ns, name = sr.meta.namespace, sr.meta.name
+    engram_name = spec.engram_ref.name if spec.engram_ref else ""
+    port = ctrl.config_manager.config.engram.grpc_port
+    svc_name = f"{name}-svc"
+    svc = new_resource(
+        SERVICE_KIND,
+        svc_name,
+        ns,
+        spec={
+            "selector": {"bobrapet.io/step-run": name},
+            "port": port,
+            "engram": engram_name,
+            "stepName": spec.step_id or name,
+        },
+        owners=[sr.owner_ref()],
+    )
+    try:
+        ctrl.store.create(svc)
+    except AlreadyExists:
+        pass
+
+    def patch(status: dict[str, Any]) -> None:
+        status["phase"] = str(Phase.RUNNING)
+        status["serviceName"] = svc_name
+        status["endpoint"] = f"{svc_name}.{ns}.svc:{port}"
+
+    ctrl.store.patch_status(STEP_RUN_KIND, ns, name, patch)
+    return None

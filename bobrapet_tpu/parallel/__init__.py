@@ -1,0 +1,23 @@
+"""Parallelism: device meshes, sharding rules, slice placement, collectives."""
+
+from .mesh import build_mesh
+from .placement import (
+    NoCapacity,
+    PlacementError,
+    SliceGrant,
+    SlicePlacer,
+    SlicePool,
+    chip_count,
+    parse_topology,
+)
+
+__all__ = [
+    "build_mesh",
+    "NoCapacity",
+    "PlacementError",
+    "SliceGrant",
+    "SlicePlacer",
+    "SlicePool",
+    "chip_count",
+    "parse_topology",
+]

@@ -1,0 +1,50 @@
+"""Device mesh construction from granted logical axes.
+
+The engram-side half of slice placement: the operator grants a slice and
+logical axes through the env contract; the engram builds a
+``jax.sharding.Mesh`` over its visible devices with this helper. The
+full sharding-rule layer lives in :mod:`bobrapet_tpu.parallel.sharding`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+
+def build_mesh(axes: Optional[dict[str, int]] = None):
+    """Build a Mesh over local devices.
+
+    ``axes`` maps logical axis name -> size (e.g. {"data": 2, "model": 4});
+    sizes must multiply to a divisor of the device count. A trailing
+    implicit fill: if the product is smaller than the device count, the
+    FIRST axis is scaled up to absorb remaining devices (so {"data": 1,
+    "model": 4} on 8 devices becomes data=2).
+    None -> 1-D mesh over all devices on axis "data".
+    """
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devices = jax.devices()
+    n = len(devices)
+    if not axes:
+        return Mesh(np.array(devices), ("data",))
+    names = list(axes.keys())
+    sizes = [max(1, int(axes[a])) for a in names]
+    prod = math.prod(sizes)
+    if prod < n and n % prod == 0:
+        sizes[0] *= n // prod
+        prod = math.prod(sizes)
+    if prod != n:
+        # grant smaller than the visible device set (single-host dev run):
+        # shrink to a prefix of devices so the logical shape is honored
+        if prod < n:
+            devices = devices[:prod]
+        else:
+            raise ValueError(
+                f"mesh axes {dict(zip(names, sizes))} need {prod} devices, "
+                f"have {n}"
+            )
+    grid = np.array(devices).reshape(sizes)
+    return Mesh(grid, tuple(names))

@@ -1,0 +1,262 @@
+"""TPU slice placement: ICI-contiguous sub-mesh assignment.
+
+The gang-scheduling stage SURVEY §7 calls "new placement logic with no
+reference counterpart": ready engram steps with TPU requirements pass
+through a placer that grants an ICI-contiguous sub-mesh (slice) before
+launch; `parallel` fan-out branches land on disjoint sub-meshes of one
+pool so branch collectives ride ICI, not DCN.
+
+The model: a :class:`SlicePool` is a rectangular chip grid (topology
+"XxY" or "XxYxZ") with some chips per host. Grants carve axis-aligned
+contiguous sub-blocks — contiguity on a torus keeps every hop of a ring
+collective on neighboring ICI links. Release returns the block.
+
+Locally (one chip / CPU) everything lands on the "local" pool; on GKE
+the same grant becomes `google.com/tpu` limits + topology selectors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+from typing import Any, Optional
+
+
+def parse_topology(topology: str) -> tuple[int, ...]:
+    try:
+        dims = tuple(int(p) for p in topology.lower().split("x"))
+    except ValueError:
+        raise ValueError(f"bad topology {topology!r}") from None
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"bad topology {topology!r}")
+    return dims
+
+
+def chip_count(topology: str) -> int:
+    n = 1
+    for d in parse_topology(topology):
+        n *= d
+    return n
+
+
+@dataclasses.dataclass
+class SliceGrant:
+    """What placement hands a step; serialized into StepRun.spec.sliceGrant
+    and exported through the env contract."""
+
+    slice_id: str
+    pool: str
+    topology: str
+    hosts: int
+    origin: tuple[int, ...]  # offset of the sub-block inside the pool grid
+    mesh_axes: dict[str, int]
+    coordinator_address: Optional[str] = None
+    accelerator: Optional[str] = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "sliceId": self.slice_id,
+            "pool": self.pool,
+            "topology": self.topology,
+            "hosts": self.hosts,
+            "origin": list(self.origin),
+            "meshAxes": dict(self.mesh_axes),
+            "coordinatorAddress": self.coordinator_address,
+            "accelerator": self.accelerator,
+        }
+
+
+class PlacementError(Exception):
+    pass
+
+
+class NoCapacity(PlacementError):
+    """No contiguous block currently free (caller should queue, not fail)."""
+
+
+class SlicePool:
+    """One physical slice topology with block allocation.
+
+    Occupancy is tracked per chip cell; grants must be axis-aligned
+    contiguous blocks (ICI contiguity).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        topology: str,
+        chips_per_host: int = 4,
+        accelerator: Optional[str] = None,
+        host_addresses: Optional[list[str]] = None,
+    ):
+        self.name = name
+        self.dims = parse_topology(topology)
+        self.topology = topology
+        self.chips_per_host = max(1, chips_per_host)
+        self.accelerator = accelerator
+        self.host_addresses = host_addresses or []
+        self._occupied: set[tuple[int, ...]] = set()
+        self._grants: dict[str, tuple[tuple[int, ...], tuple[int, ...]]] = {}
+        self._lock = threading.Lock()
+        self._counter = 0
+
+    @property
+    def total_chips(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def free_chips(self) -> int:
+        with self._lock:
+            return self.total_chips - len(self._occupied)
+
+    # -- allocation --------------------------------------------------------
+
+    def allocate(self, want_topology: Optional[str] = None, chips: Optional[int] = None) -> SliceGrant:
+        """Grant an ICI-contiguous sub-block.
+
+        ``want_topology`` requests an exact block shape; ``chips`` asks
+        for any contiguous block of >= that many chips (smallest fitting
+        rectangle is chosen).
+        """
+        if want_topology:
+            shape = parse_topology(want_topology)
+        elif chips:
+            shape = self._fit_shape(chips)
+        else:
+            shape = (1,) * len(self.dims)
+        if len(shape) < len(self.dims):
+            shape = shape + (1,) * (len(self.dims) - len(shape))
+        if len(shape) > len(self.dims) or any(
+            s > d for s, d in zip(shape, self.dims)
+        ):
+            raise PlacementError(
+                f"requested block {shape} exceeds pool {self.name} topology {self.dims}"
+            )
+        with self._lock:
+            origin = self._find_block(shape)
+            if origin is None:
+                raise NoCapacity(
+                    f"pool {self.name}: no free {shape} block "
+                    f"({self.total_chips - len(self._occupied)} chips free)"
+                )
+            for cell in _cells(origin, shape):
+                self._occupied.add(cell)
+            self._counter += 1
+            slice_id = f"{self.name}-s{self._counter}"
+            self._grants[slice_id] = (origin, shape)
+        n_chips = 1
+        for s in shape:
+            n_chips *= s
+        hosts = max(1, n_chips // self.chips_per_host)
+        coord = self.host_addresses[0] if self.host_addresses else None
+        return SliceGrant(
+            slice_id=slice_id,
+            pool=self.name,
+            topology="x".join(str(s) for s in shape),
+            hosts=hosts,
+            origin=origin,
+            mesh_axes={},
+            coordinator_address=coord,
+            accelerator=self.accelerator,
+        )
+
+    def release(self, slice_id: str) -> None:
+        with self._lock:
+            grant = self._grants.pop(slice_id, None)
+            if grant is None:
+                return
+            origin, shape = grant
+            for cell in _cells(origin, shape):
+                self._occupied.discard(cell)
+
+    # -- internals ---------------------------------------------------------
+
+    def _fit_shape(self, chips: int) -> tuple[int, ...]:
+        """Smallest axis-aligned block shape with >= chips cells that fits
+        the pool dims, preferring balanced (low-diameter) shapes."""
+        best: Optional[tuple[int, ...]] = None
+        best_key: Optional[tuple[int, int]] = None
+        ranges = [range(1, d + 1) for d in self.dims]
+        for shape in itertools.product(*ranges):
+            n = 1
+            for s in shape:
+                n *= s
+            if n < chips:
+                continue
+            key = (n, max(shape))  # fewest chips, then lowest diameter
+            if best_key is None or key < best_key:
+                best, best_key = shape, key
+        if best is None:
+            raise PlacementError(f"pool {self.name} cannot fit {chips} chips")
+        return best
+
+    def _find_block(self, shape: tuple[int, ...]) -> Optional[tuple[int, ...]]:
+        ranges = [range(d - s + 1) for d, s in zip(self.dims, shape)]
+        for origin in itertools.product(*ranges):
+            if all(cell not in self._occupied for cell in _cells(origin, shape)):
+                return origin
+        return None
+
+
+def _cells(origin: tuple[int, ...], shape: tuple[int, ...]):
+    return itertools.product(*[range(o, o + s) for o, s in zip(origin, shape)])
+
+
+class SlicePlacer:
+    """Fleet of pools; the DAG scheduler's placement stage.
+
+    Queues map to pools (SURVEY §2.6 'queues become TPU-slice pools'): a
+    step scheduled on queue Q is placed on pool Q when one exists,
+    falling back to the default pool.
+    """
+
+    def __init__(self, pools: Optional[list[SlicePool]] = None):
+        self._pools: dict[str, SlicePool] = {}
+        for p in pools or []:
+            self._pools[p.name] = p
+        if "local" not in self._pools:
+            # degenerate local pool: one host, one chip — CPU/dev default
+            self._pools["local"] = SlicePool("local", "1", chips_per_host=1)
+
+    def add_pool(self, pool: SlicePool) -> None:
+        self._pools[pool.name] = pool
+
+    def pool(self, name: str) -> Optional[SlicePool]:
+        return self._pools.get(name)
+
+    def place(
+        self,
+        tpu_policy,  # api.shared.TPUPolicy | None
+        queue: Optional[str] = None,
+    ) -> Optional[SliceGrant]:
+        """Grant a slice for a step; None when the step needs no TPU.
+
+        Raises NoCapacity when the pool is full (the scheduler keeps the
+        step Pending and retries — gang semantics: never launch a partial
+        slice).
+        """
+        if tpu_policy is None or (
+            tpu_policy.topology is None and not tpu_policy.chips
+        ):
+            return None
+        pool = self._pools.get(queue or "") or self._pools["local"]
+        grant = pool.allocate(
+            want_topology=tpu_policy.topology, chips=tpu_policy.chips
+        )
+        if tpu_policy.hosts:
+            grant.hosts = tpu_policy.hosts
+        if tpu_policy.mesh_axes:
+            grant.mesh_axes = dict(tpu_policy.mesh_axes)
+        else:
+            grant.mesh_axes = {"data": 1, "model": chip_count(grant.topology)}
+        if tpu_policy.accelerator and not grant.accelerator:
+            grant.accelerator = str(tpu_policy.accelerator)
+        return grant
+
+    def release(self, grant_dict: dict[str, Any]) -> None:
+        pool = self._pools.get(grant_dict.get("pool", ""))
+        if pool is not None:
+            pool.release(grant_dict.get("sliceId", ""))

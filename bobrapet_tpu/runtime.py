@@ -1,0 +1,332 @@
+"""Runtime: assembles the full control plane in one process.
+
+The equivalent of the reference's manager binary startup
+(reference: cmd/main.go:113-360 — scheme registration, config manager,
+indexers internal/setup/indexing.go:63, controller wiring :613-790):
+store + config + storage + templating + placement + executors +
+controllers, with the field indexes and watch->controller mappings the
+reconcilers depend on.
+
+Public API::
+
+    rt = Runtime()                       # local, in-process
+    rt.apply(make_engram_template(...))
+    rt.apply(make_engram(...))
+    rt.apply(make_story(...))
+    run = rt.run_story("my-story", inputs={...})
+    rt.pump()                            # deterministic (ManualClock)
+    print(rt.store.get("StoryRun", "default", run).status)
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from .api.catalog import ENGRAM_TEMPLATE_KIND, IMPULSE_TEMPLATE_KIND
+from .api.engram import KIND as ENGRAM_KIND
+from .api.enums import Phase
+from .api.impulse import KIND as IMPULSE_KIND
+from .api.runs import (
+    EFFECT_CLAIM_KIND,
+    STEP_RUN_KIND,
+    STORY_RUN_KIND,
+    STORY_TRIGGER_KIND,
+    make_storyrun,
+)
+from .api.story import KIND as STORY_KIND
+from .api.transport import TRANSPORT_BINDING_KIND, TRANSPORT_KIND
+from .config import OperatorConfigManager, Resolver
+from .controllers.dag import DAGEngine, INDEX_STEPRUN_PHASE, INDEX_STEPRUN_STORYRUN
+from .controllers.jobs import JOB_KIND, LocalGangExecutor
+from .controllers.manager import Clock, ControllerManager, ManualClock
+from .controllers.step_executor import StepExecutor
+from .controllers.steprun import StepRunController
+from .controllers.storyrun import StoryRunController
+from .core.events import EventRecorder
+from .core.store import DELETED, ResourceStore, WatchEvent
+from .parallel.placement import SlicePlacer
+from .storage.manager import StorageManager
+from .storage.store import MemoryStore, Store
+from .templating.engine import Evaluator, TemplateConfig
+from .utils.naming import compose_unique
+
+_log = logging.getLogger(__name__)
+
+INDEX_ENGRAM_TEMPLATE = "templateRef"
+INDEX_STEPRUN_ENGRAM = "engramRef"
+INDEX_STORYRUN_STORY = "storyRef"
+
+
+class Runtime:
+    def __init__(
+        self,
+        persist_dir: Optional[str] = None,
+        clock: Optional[Clock] = None,
+        blob_store: Optional[Store] = None,
+        placer: Optional[SlicePlacer] = None,
+        executor_mode: str = "sync",
+        config_namespace: str = "bobrapet-system",
+    ):
+        self.clock = clock or ManualClock()
+        self.store = ResourceStore(persist_dir=persist_dir)
+        self.recorder = EventRecorder()
+        self.config_manager = OperatorConfigManager(self.store, namespace=config_namespace)
+        cfg = self.config_manager.config
+        self.evaluator = Evaluator(
+            TemplateConfig(
+                evaluation_timeout=cfg.templating.evaluation_timeout,
+                max_output_bytes=cfg.templating.max_output_bytes,
+                deterministic=cfg.templating.deterministic,
+            )
+        )
+        self.storage = StorageManager(
+            blob_store or MemoryStore(), max_inline_size=cfg.engram.max_inline_size
+        )
+        self.placer = placer or SlicePlacer()
+        self.resolver = Resolver(cfg)
+        self.config_manager.subscribe(self._on_config_change)
+
+        self._register_indexes()
+
+        self.step_executor = StepExecutor(
+            self.store, self.evaluator, self.storage, self.config_manager,
+            placer=self.placer, clock=self.clock,
+        )
+        self.dag = DAGEngine(
+            self.store, self.evaluator, self.step_executor, self.config_manager,
+            self.storage, recorder=self.recorder, clock=self.clock,
+        )
+        self.storyrun_controller = StoryRunController(
+            self.store, self.dag, self.config_manager, self.storage,
+            recorder=self.recorder, clock=self.clock,
+        )
+        self.steprun_controller = StepRunController(
+            self.store, self.config_manager, self.resolver, self.storage,
+            self.evaluator, recorder=self.recorder, clock=self.clock,
+        )
+        self.job_executor = LocalGangExecutor(
+            self.store, storage=self.storage, clock=self.clock, mode=executor_mode
+        )
+
+        self.manager = ControllerManager(self.store, clock=self.clock)
+        self._register_controllers()
+        self.store.watch(self._release_slices, kinds=[STEP_RUN_KIND])
+
+    # ------------------------------------------------------------------
+    def _on_config_change(self, cfg) -> None:
+        self.resolver.operator_config = cfg
+        self.evaluator.config.evaluation_timeout = cfg.templating.evaluation_timeout
+        self.evaluator.config.max_output_bytes = cfg.templating.max_output_bytes
+        self.evaluator.config.deterministic = cfg.templating.deterministic
+        self.storage.max_inline_size = cfg.engram.max_inline_size
+
+    # ------------------------------------------------------------------
+    def _register_indexes(self) -> None:
+        """The field-index registrations
+        (reference: internal/setup/indexing.go:71-163)."""
+        s = self.store
+        s.add_index(
+            STEP_RUN_KIND, INDEX_STEPRUN_STORYRUN,
+            lambda r: [(r.spec.get("storyRunRef") or {}).get("name", "")],
+        )
+        s.add_index(
+            STEP_RUN_KIND, INDEX_STEPRUN_ENGRAM,
+            lambda r: [(r.spec.get("engramRef") or {}).get("name", "")],
+        )
+        s.add_index(
+            STEP_RUN_KIND, INDEX_STEPRUN_PHASE,
+            lambda r: [r.status.get("phase") or ""],
+        )
+        s.add_index(
+            STORY_RUN_KIND, INDEX_STORYRUN_STORY,
+            lambda r: [(r.spec.get("storyRef") or {}).get("name", "")],
+        )
+        s.add_index(
+            STORY_RUN_KIND, "impulseRef",
+            lambda r: [(r.spec.get("impulseRef") or {}).get("name", "")],
+        )
+        s.add_index(
+            ENGRAM_KIND, INDEX_ENGRAM_TEMPLATE,
+            lambda r: [(r.spec.get("templateRef") or {}).get("name", "")],
+        )
+        s.add_index(
+            IMPULSE_KIND, INDEX_ENGRAM_TEMPLATE,
+            lambda r: [(r.spec.get("templateRef") or {}).get("name", "")],
+        )
+        s.add_index(
+            IMPULSE_KIND, INDEX_STORYRUN_STORY,
+            lambda r: [(r.spec.get("storyRef") or {}).get("name", "")],
+        )
+        s.add_index(
+            STORY_KIND, "stepEngramRefs",
+            lambda r: sorted(
+                {
+                    (step.get("ref") or {}).get("name", "")
+                    for step in (r.spec.get("steps") or [])
+                    if step.get("ref")
+                }
+            ),
+        )
+        s.add_index(
+            STORY_KIND, "executeStoryRefs",
+            lambda r: sorted(
+                {
+                    ((step.get("with") or {}).get("storyRef") or {}).get("name", "")
+                    for step in (r.spec.get("steps") or [])
+                    if step.get("type") == "executeStory"
+                }
+            ),
+        )
+        s.add_index(
+            STORY_KIND, "transportRefs",
+            lambda r: sorted(
+                {t.get("transportRef", "") for t in (r.spec.get("transports") or [])}
+            ),
+        )
+        s.add_index(
+            TRANSPORT_BINDING_KIND, "transportRef",
+            lambda r: [r.spec.get("transportRef", "")],
+        )
+        s.add_index(
+            JOB_KIND, "stepRunRef",
+            lambda r: [(r.spec.get("stepRunRef") or {}).get("name", "")],
+        )
+        s.add_index(
+            STORY_TRIGGER_KIND, INDEX_STORYRUN_STORY,
+            lambda r: [(r.spec.get("storyRef") or {}).get("name", "")],
+        )
+
+    # ------------------------------------------------------------------
+    def _register_controllers(self) -> None:
+        """(reference: mustSetupControllers cmd/main.go:613-790)"""
+        m = self.manager
+
+        def steprun_to_storyrun(ev: WatchEvent):
+            name = (ev.resource.spec.get("storyRunRef") or {}).get("name")
+            return [(ev.resource.meta.namespace, name)] if name else []
+
+        def substoryrun_to_parent(ev: WatchEvent):
+            parent = ev.resource.meta.labels.get("bobrapet.io/story-run")
+            out = [(ev.resource.meta.namespace, ev.resource.meta.name)]
+            if parent:
+                out.append((ev.resource.meta.namespace, parent))
+            return out
+
+        m.register(
+            "storyrun",
+            self.storyrun_controller.reconcile,
+            watches={
+                STORY_RUN_KIND: substoryrun_to_parent,
+                STEP_RUN_KIND: steprun_to_storyrun,
+            },
+        )
+
+        def job_to_steprun(ev: WatchEvent):
+            name = (ev.resource.spec.get("stepRunRef") or {}).get("name")
+            return [(ev.resource.meta.namespace, name)] if name else []
+
+        def engram_to_stepruns(ev: WatchEvent):
+            srs = self.store.list(
+                STEP_RUN_KIND,
+                index=(INDEX_STEPRUN_ENGRAM, ev.resource.meta.name),
+            )
+            return [(sr.meta.namespace, sr.meta.name) for sr in srs]
+
+        def template_to_stepruns(ev: WatchEvent):
+            engrams = self.store.list(
+                ENGRAM_KIND, index=(INDEX_ENGRAM_TEMPLATE, ev.resource.meta.name)
+            )
+            out = []
+            for e in engrams:
+                out.extend(
+                    (sr.meta.namespace, sr.meta.name)
+                    for sr in self.store.list(
+                        STEP_RUN_KIND, index=(INDEX_STEPRUN_ENGRAM, e.meta.name)
+                    )
+                )
+            return out
+
+        m.register(
+            "steprun",
+            self.steprun_controller.reconcile,
+            watches={
+                STEP_RUN_KIND: None,
+                JOB_KIND: job_to_steprun,
+                ENGRAM_KIND: engram_to_stepruns,
+                ENGRAM_TEMPLATE_KIND: template_to_stepruns,
+            },
+        )
+
+    # ------------------------------------------------------------------
+    def _release_slices(self, ev: WatchEvent) -> None:
+        """Return slice grants when their StepRun reaches a terminal phase
+        or is deleted (gang scheduling bookkeeping)."""
+        sr = ev.resource
+        grant = sr.spec.get("sliceGrant")
+        if not grant:
+            return
+        phase = sr.status.get("phase")
+        terminal = bool(phase and Phase(phase).is_terminal)
+        if ev.type == DELETED or (terminal and not sr.status.get("sliceReleased")):
+            self.placer.release(grant)
+            if ev.type != DELETED:
+                try:
+                    self.store.patch_status(
+                        STEP_RUN_KIND, sr.meta.namespace, sr.meta.name,
+                        lambda s: s.__setitem__("sliceReleased", True),
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def apply(self, resource) -> Any:
+        """Create-or-update (kubectl apply semantics)."""
+        existing = self.store.try_get(
+            resource.kind, resource.meta.namespace, resource.meta.name
+        )
+        if existing is None:
+            return self.store.create(resource)
+
+        def sync(r) -> None:
+            r.spec = dict(resource.spec)
+            r.meta.labels.update(resource.meta.labels)
+            r.meta.annotations.update(resource.meta.annotations)
+
+        return self.store.mutate(
+            resource.kind, resource.meta.namespace, resource.meta.name, sync
+        )
+
+    def run_story(
+        self,
+        story: str,
+        inputs: Optional[dict[str, Any]] = None,
+        name: Optional[str] = None,
+        namespace: str = "default",
+    ) -> str:
+        run_name = name or compose_unique(story, "run", str(self.store._rv_counter))
+        self.store.create(make_storyrun(run_name, story, inputs, namespace))
+        return run_name
+
+    def pump(self, max_virtual_seconds: float = 1800.0) -> int:
+        """Drive all controllers until quiescent (ManualClock advances
+        through timers automatically, up to the virtual horizon — the
+        default stays short of retention boundaries so finished runs
+        remain inspectable; raise it to exercise retention)."""
+        return self.manager.run_until_quiet(max_virtual_seconds=max_virtual_seconds)
+
+    def start(self) -> None:
+        self.manager.start()
+
+    def stop(self) -> None:
+        self.manager.stop()
+
+    def run_phase(self, run_name: str, namespace: str = "default") -> Optional[str]:
+        run = self.store.try_get(STORY_RUN_KIND, namespace, run_name)
+        return run.status.get("phase") if run is not None else None
+
+    def run_output(self, run_name: str, namespace: str = "default"):
+        run = self.store.try_get(STORY_RUN_KIND, namespace, run_name)
+        return run.status.get("output") if run is not None else None

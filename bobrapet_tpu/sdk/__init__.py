@@ -1,0 +1,24 @@
+"""Engram SDK: env contract, runtime context, registry."""
+
+from . import contract
+from .context import (
+    EngramContext,
+    EngramExit,
+    EngramRateLimited,
+    EngramTimeout,
+    resolve_entrypoint,
+)
+from .registry import clear_registry, get_engram, register_engram, unregister_engram
+
+__all__ = [
+    "contract",
+    "EngramContext",
+    "EngramExit",
+    "EngramRateLimited",
+    "EngramTimeout",
+    "resolve_entrypoint",
+    "clear_registry",
+    "get_engram",
+    "register_engram",
+    "unregister_engram",
+]

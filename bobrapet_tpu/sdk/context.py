@@ -1,0 +1,256 @@
+"""Engram runtime context: what user engram code sees.
+
+The in-container counterpart of the reference's out-of-repo SDK
+(SURVEY §7 'Engram runtime / SDK'): reads the env contract, exposes
+inputs/config, builds the device mesh from operator-granted topology,
+and patches results back into StepRun status (the SDK-direct status
+write the reference's controller races against,
+steprun_controller.go:2031).
+
+Engram entrypoints are callables ``run(ctx) -> output`` registered in
+:mod:`bobrapet_tpu.sdk.registry` or addressed as "module.path:attr".
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Any, Callable, Optional
+
+from ..api.errors import StructuredError
+from . import contract
+
+_log = logging.getLogger(__name__)
+
+
+class EngramExit(Exception):
+    """Terminate the engram with a specific contract exit code."""
+
+    def __init__(self, code: int, message: str = ""):
+        super().__init__(message or f"exit {code}")
+        self.code = code
+
+
+class EngramTimeout(EngramExit):
+    def __init__(self, message: str = "step deadline exceeded"):
+        super().__init__(contract.EXIT_TIMEOUT, message)
+
+
+class EngramRateLimited(EngramExit):
+    def __init__(self, message: str = "rate limited"):
+        super().__init__(contract.EXIT_RATE_LIMITED, message)
+
+
+class EngramContext:
+    """Execution context handed to engram entrypoints.
+
+    For local (in-process) execution the context holds live handles to
+    the bus and storage manager; for containerized execution the same
+    API is backed by env vars + the status-patch endpoint.
+    """
+
+    def __init__(
+        self,
+        env: dict[str, str],
+        store=None,  # ResourceStore for SDK-direct status patches
+        storage=None,  # StorageManager for offloaded IO
+        clock=None,
+        cancel_event: Optional[threading.Event] = None,
+    ):
+        self.env = env
+        self._store = store
+        self._storage = storage
+        self._clock = clock
+        self._cancel = cancel_event or threading.Event()
+        self._deadline: Optional[float] = None
+        timeout = env.get(contract.ENV_STEP_TIMEOUT_SECONDS)
+        if timeout and clock is not None:
+            self._deadline = clock.now() + float(timeout)
+        self._inputs: Optional[Any] = None
+        self._output_patched = False
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def namespace(self) -> str:
+        return self.env.get(contract.ENV_NAMESPACE, "default")
+
+    @property
+    def step_run(self) -> str:
+        return self.env.get(contract.ENV_STEP_RUN, "")
+
+    @property
+    def step(self) -> str:
+        return self.env.get(contract.ENV_STEP, "")
+
+    @property
+    def story_run(self) -> str:
+        return self.env.get(contract.ENV_STORY_RUN, "")
+
+    @property
+    def debug(self) -> bool:
+        return self.env.get(contract.ENV_DEBUG) == "1"
+
+    # -- gang/topology -----------------------------------------------------
+
+    @property
+    def host_id(self) -> int:
+        return int(self.env.get(contract.ENV_TPU_HOST_ID, "0"))
+
+    @property
+    def num_hosts(self) -> int:
+        return int(self.env.get(contract.ENV_TPU_HOSTS, "1"))
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.host_id == 0
+
+    @property
+    def coordinator_address(self) -> Optional[str]:
+        return self.env.get(contract.ENV_COORDINATOR_ADDRESS)
+
+    @property
+    def mesh_axes(self) -> dict[str, int]:
+        raw = self.env.get(contract.ENV_MESH_AXES)
+        return {k: int(v) for k, v in (json.loads(raw) if raw else {}).items()}
+
+    @property
+    def tpu_topology(self) -> Optional[str]:
+        return self.env.get(contract.ENV_TPU_TOPOLOGY)
+
+    def initialize_distributed(self) -> None:
+        """Run jax.distributed.initialize from granted coordinator env —
+        ICI replaces NCCL (SURVEY §5.8 TPU-native equivalent). No-op for
+        single-host grants."""
+        if self.num_hosts <= 1 or self.coordinator_address is None:
+            return
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator_address,
+            num_processes=self.num_hosts,
+            process_id=self.host_id,
+        )
+
+    def mesh(self, axes: Optional[dict[str, int]] = None):
+        """Build the granted jax.sharding.Mesh (local devices reshaped to
+        the granted logical axes)."""
+        from ..parallel.mesh import build_mesh
+
+        return build_mesh(axes or self.mesh_axes or None)
+
+    # -- data --------------------------------------------------------------
+
+    @property
+    def inputs(self) -> Any:
+        """Resolved step inputs; offloaded payloads hydrate lazily."""
+        if self._inputs is None:
+            raw = self.env.get(contract.ENV_INPUTS)
+            ref = self.env.get(contract.ENV_INPUTS_REF)
+            if raw is not None:
+                value = json.loads(raw)
+            elif ref is not None:
+                value = json.loads(ref)
+            else:
+                value = {}
+            if self._storage is not None:
+                prefix = f"runs/{self.namespace}/{self.story_run}"
+                value = self._storage.hydrate(value, allowed_prefixes=[prefix])
+            self._inputs = value
+        return self._inputs
+
+    @property
+    def config(self) -> dict[str, Any]:
+        raw = self.env.get(contract.ENV_CONFIG)
+        return json.loads(raw) if raw else {}
+
+    # -- deadline / cancel -------------------------------------------------
+
+    def check_deadline(self) -> None:
+        """Cooperative timeout/cancel check for long loops."""
+        if self._cancel.is_set():
+            raise EngramExit(contract.EXIT_SIGTERM, "canceled")
+        if (
+            self._deadline is not None
+            and self._clock is not None
+            and self._clock.now() > self._deadline
+        ):
+            raise EngramTimeout()
+
+    @property
+    def canceled(self) -> bool:
+        return self._cancel.is_set()
+
+    # -- results -----------------------------------------------------------
+
+    def output(self, value: Any) -> None:
+        """SDK-direct output write into StepRun.status
+        (reference: SDK patches StepRun status; controller detects via
+        stepStatusPatchedBySDK)."""
+        if self._store is None or not self.step_run:
+            return
+        if self.host_id != 0:
+            return  # gang convention: coordinator host reports the output
+        offloaded = value
+        if self._storage is not None:
+            max_inline = int(self.env.get(contract.ENV_MAX_INLINE_SIZE, "16384"))
+            key = f"runs/{self.namespace}/{self.story_run}/steps/{self.step}/output"
+            offloaded = self._storage.dehydrate(value, key, max_inline_size=max_inline)
+
+        def patch(status: dict[str, Any]) -> None:
+            status["output"] = offloaded
+            status["outputSource"] = "sdk"
+
+        self._store.patch_status("StepRun", self.namespace, self.step_run, patch)
+        self._output_patched = True
+
+    def signal(self, name: str, value: Any = True) -> None:
+        """Emit a named signal into the StepRun signals ledger
+        (reference: steprun_types.go:360 SignalEvent)."""
+        if self._store is None or not self.step_run:
+            return
+        at = self._clock.now() if self._clock is not None else 0.0
+
+        def patch(status: dict[str, Any]) -> None:
+            status.setdefault("signals", {})[name] = value
+            status.setdefault("signalEvents", []).append(
+                {"name": name, "value": value, "at": at}
+            )
+
+        self._store.patch_status("StepRun", self.namespace, self.step_run, patch)
+
+    def error(self, err: StructuredError) -> None:
+        """Report a structured error before exiting nonzero."""
+        if self._store is None or not self.step_run:
+            return
+
+        def patch(status: dict[str, Any]) -> None:
+            status["error"] = err.to_dict()
+
+        self._store.patch_status("StepRun", self.namespace, self.step_run, patch)
+
+    @property
+    def log(self) -> logging.Logger:
+        return logging.getLogger(f"engram.{self.step}")
+
+
+def resolve_entrypoint(spec: str) -> Callable[[EngramContext], Any]:
+    """Resolve "module.path:attr" or a registry name to a callable."""
+    from .registry import get_engram
+
+    registered = get_engram(spec)
+    if registered is not None:
+        return registered
+    if ":" not in spec:
+        raise ValueError(f"unknown engram entrypoint {spec!r}")
+    module_name, attr = spec.split(":", 1)
+    import importlib
+
+    module = importlib.import_module(module_name)
+    fn = module
+    for part in attr.split("."):
+        fn = getattr(fn, part)
+    if not callable(fn):
+        raise TypeError(f"entrypoint {spec!r} is not callable")
+    return fn

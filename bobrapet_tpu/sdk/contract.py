@@ -1,0 +1,149 @@
+"""The operator<->SDK env contract (the framework's real public API).
+
+Capability parity with the reference's BUBU_* env contract built in
+buildBaseEnvVars (reference: steprun_controller.go:1692; contract names
+live in the external bubustack/core ``contracts`` package), extended with
+the TPU topology fields SURVEY §7 calls for: accelerator/topology/hosts,
+per-host ids, coordinator address, and logical mesh axes so the engram
+can run ``jax.distributed.initialize`` + build its ``jax.sharding.Mesh``
+from operator-granted facts alone.
+
+Versioned: consumers check CONTRACT_VERSION before trusting fields.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+CONTRACT_VERSION = "1"
+
+# identity
+ENV_CONTRACT_VERSION = "BOBRA_CONTRACT_VERSION"
+ENV_NAMESPACE = "BOBRA_NAMESPACE"
+ENV_STORY = "BOBRA_STORY"
+ENV_STORY_RUN = "BOBRA_STORY_RUN"
+ENV_STEP = "BOBRA_STEP"
+ENV_STEP_RUN = "BOBRA_STEP_RUN"
+ENV_ENGRAM = "BOBRA_ENGRAM"
+
+# execution
+ENV_EXECUTION_MODE = "BOBRA_EXECUTION_MODE"  # job | deployment | statefulset
+ENV_INPUTS = "BOBRA_INPUTS"  # inline JSON payload
+ENV_INPUTS_REF = "BOBRA_INPUTS_REF"  # storageRef marker JSON when offloaded
+ENV_CONFIG = "BOBRA_CONFIG"  # engram `with` config JSON
+ENV_STEP_TIMEOUT_SECONDS = "BOBRA_STEP_TIMEOUT_SECONDS"
+ENV_MAX_INLINE_SIZE = "BOBRA_MAX_INLINE_SIZE"
+ENV_STORAGE_TIMEOUT_SECONDS = "BOBRA_STORAGE_TIMEOUT_SECONDS"
+ENV_MAX_RECURSION_DEPTH = "BOBRA_MAX_RECURSION_DEPTH"
+ENV_GRPC_PORT = "BOBRA_GRPC_PORT"
+ENV_DEBUG = "BOBRA_DEBUG"
+
+# streaming
+ENV_DOWNSTREAM_TARGETS = "BOBRA_DOWNSTREAM_TARGETS"  # JSON list of next hops
+ENV_BINDING_INFO = "BOBRA_BINDING_INFO"  # negotiated transport binding JSON
+
+# TPU topology (TPU-native additions; no reference counterpart)
+ENV_TPU_ACCELERATOR = "BOBRA_TPU_ACCELERATOR"
+ENV_TPU_TOPOLOGY = "BOBRA_TPU_TOPOLOGY"  # e.g. "2x4"
+ENV_TPU_HOSTS = "BOBRA_TPU_HOSTS"  # host processes in the gang
+ENV_TPU_HOST_ID = "BOBRA_TPU_HOST_ID"  # this host's index (0-based)
+ENV_COORDINATOR_ADDRESS = "BOBRA_COORDINATOR_ADDRESS"  # jax.distributed coordinator
+ENV_MESH_AXES = "BOBRA_MESH_AXES"  # JSON {axis: size}
+ENV_SLICE_ID = "BOBRA_SLICE_ID"  # granted ICI-contiguous sub-mesh id
+# GKE-standard names for compatibility with existing TPU tooling
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+
+# exit codes with contractual meaning (reference: classifyExitCode
+# steprun_controller.go:4815)
+EXIT_SUCCESS = 0
+EXIT_TIMEOUT = 124
+EXIT_CONFIG_TERMINAL_MIN = 125  # 125-127: terminal (bad config/image)
+EXIT_CONFIG_TERMINAL_MAX = 127
+EXIT_SIGKILL = 137
+EXIT_SIGTERM = 143
+EXIT_RATE_LIMITED = 119  # in-band rate-limit signal (reference uses 429
+# at the StructuredError level; one byte can't carry 429, so the contract
+# reserves 119)
+
+
+def build_env(
+    *,
+    namespace: str,
+    story: str,
+    story_run: str,
+    step: str,
+    step_run: str,
+    engram: str = "",
+    execution_mode: str = "job",
+    inputs: Optional[Any] = None,
+    inputs_ref: Optional[dict[str, Any]] = None,
+    config: Optional[dict[str, Any]] = None,
+    step_timeout_seconds: Optional[float] = None,
+    max_inline_size: int = 16 * 1024,
+    storage_timeout_seconds: int = 30,
+    max_recursion_depth: int = 10,
+    grpc_port: int = 50051,
+    debug: bool = False,
+    downstream_targets: Optional[list[dict[str, Any]]] = None,
+    tpu_accelerator: Optional[str] = None,
+    tpu_topology: Optional[str] = None,
+    tpu_hosts: int = 1,
+    coordinator_address: Optional[str] = None,
+    mesh_axes: Optional[dict[str, int]] = None,
+    slice_id: Optional[str] = None,
+) -> dict[str, str]:
+    """Render the per-step env contract (host-independent portion).
+
+    Per-host fields (HOST_ID / TPU_WORKER_ID) are layered on by
+    :func:`host_env`.
+    """
+    env = {
+        ENV_CONTRACT_VERSION: CONTRACT_VERSION,
+        ENV_NAMESPACE: namespace,
+        ENV_STORY: story,
+        ENV_STORY_RUN: story_run,
+        ENV_STEP: step,
+        ENV_STEP_RUN: step_run,
+        ENV_ENGRAM: engram,
+        ENV_EXECUTION_MODE: execution_mode,
+        ENV_MAX_INLINE_SIZE: str(max_inline_size),
+        ENV_STORAGE_TIMEOUT_SECONDS: str(storage_timeout_seconds),
+        ENV_MAX_RECURSION_DEPTH: str(max_recursion_depth),
+        ENV_GRPC_PORT: str(grpc_port),
+        ENV_DEBUG: "1" if debug else "0",
+        ENV_TPU_HOSTS: str(tpu_hosts),
+    }
+    if inputs is not None:
+        env[ENV_INPUTS] = json.dumps(inputs, separators=(",", ":"))
+    if inputs_ref is not None:
+        env[ENV_INPUTS_REF] = json.dumps(inputs_ref, separators=(",", ":"))
+    if config is not None:
+        env[ENV_CONFIG] = json.dumps(config, separators=(",", ":"))
+    if step_timeout_seconds is not None:
+        env[ENV_STEP_TIMEOUT_SECONDS] = str(step_timeout_seconds)
+    if downstream_targets:
+        env[ENV_DOWNSTREAM_TARGETS] = json.dumps(downstream_targets, separators=(",", ":"))
+    if tpu_accelerator:
+        env[ENV_TPU_ACCELERATOR] = tpu_accelerator
+    if tpu_topology:
+        env[ENV_TPU_TOPOLOGY] = tpu_topology
+    if coordinator_address:
+        env[ENV_COORDINATOR_ADDRESS] = coordinator_address
+    if mesh_axes:
+        env[ENV_MESH_AXES] = json.dumps(mesh_axes, separators=(",", ":"))
+    if slice_id:
+        env[ENV_SLICE_ID] = slice_id
+    return env
+
+
+def host_env(base: dict[str, str], host_id: int, hostnames: Optional[list[str]] = None) -> dict[str, str]:
+    """Layer per-host identity onto the base env (completion-index ->
+    TPU_WORKER_ID mapping, SURVEY §2.6 Job parallelism row)."""
+    env = dict(base)
+    env[ENV_TPU_HOST_ID] = str(host_id)
+    env[ENV_TPU_WORKER_ID] = str(host_id)
+    if hostnames:
+        env[ENV_TPU_WORKER_HOSTNAMES] = ",".join(hostnames)
+    return env

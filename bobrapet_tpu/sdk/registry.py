@@ -1,0 +1,47 @@
+"""In-process engram registry.
+
+Local/test deployments register engram callables by name instead of
+building container images — the TPU-native analogue of pointing an
+EngramTemplate at an image. Names registered here take priority over
+"module:attr" import paths in :func:`resolve_entrypoint`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+_lock = threading.Lock()
+_registry: dict[str, Callable[..., Any]] = {}
+
+
+def register_engram(name: str, fn: Optional[Callable[..., Any]] = None):
+    """Register an engram entrypoint; usable as a decorator.
+
+    @register_engram("llama-generate")
+    def run(ctx): ...
+    """
+
+    def apply(f: Callable[..., Any]):
+        with _lock:
+            _registry[name] = f
+        return f
+
+    if fn is not None:
+        return apply(fn)
+    return apply
+
+
+def get_engram(name: str) -> Optional[Callable[..., Any]]:
+    with _lock:
+        return _registry.get(name)
+
+
+def unregister_engram(name: str) -> None:
+    with _lock:
+        _registry.pop(name, None)
+
+
+def clear_registry() -> None:
+    with _lock:
+        _registry.clear()

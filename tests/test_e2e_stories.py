@@ -1,0 +1,681 @@
+"""End-to-end story execution through the full control plane.
+
+The envtest analogue (SURVEY §4): real store, real controllers, real
+local gang executor running registered engram callables — no mocks in
+the control path. ManualClock drives timers instantly.
+"""
+
+import pytest
+
+from bobrapet_tpu.api.catalog import make_engram_template
+from bobrapet_tpu.api.engram import make_engram
+from bobrapet_tpu.api.story import make_story
+from bobrapet_tpu.runtime import Runtime
+from bobrapet_tpu.sdk import EngramExit, clear_registry, register_engram
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    yield
+    clear_registry()
+
+
+@pytest.fixture
+def rt():
+    return Runtime()
+
+
+def setup_engram(rt, name="worker", entrypoint_name=None, **template_fields):
+    ep = entrypoint_name or f"{name}-impl"
+    rt.apply(make_engram_template(f"{name}-tpl", entrypoint=ep, **template_fields))
+    rt.apply(make_engram(name, f"{name}-tpl"))
+    return ep
+
+
+class TestSingleStep:
+    def test_single_step_story(self, rt):
+        """BASELINE config 1: single-step batch story."""
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            return {"echo": ctx.inputs.get("msg", ""), "host": ctx.host_id}
+
+        rt.apply(make_story("hello", steps=[
+            {"name": "only", "ref": {"name": "worker"}, "with": {"msg": "{{ inputs.msg }}"}},
+        ], output={"result": "{{ steps.only.output.echo }}"}))
+        run = rt.run_story("hello", inputs={"msg": "hi tpu"})
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert rt.run_output(run) == {"result": "hi tpu"}
+
+    def test_step_failure_fails_run(self, rt):
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            raise RuntimeError("boom")
+
+        rt.apply(make_story("failing", steps=[
+            {"name": "bad", "ref": {"name": "worker"},
+             "execution": {"retry": {"maxRetries": 0}}},
+        ]))
+        run = rt.run_story("failing")
+        rt.pump()
+        assert rt.run_phase(run) == "Failed"
+        sr = rt.store.get("StepRun", "default", [
+            r.meta.name for r in rt.store.list("StepRun")
+        ][0])
+        assert sr.status["error"]["message"].startswith("RuntimeError")
+        assert sr.status["exitClass"] == "terminal"
+
+
+class TestDag:
+    def test_three_step_dag_with_implicit_deps(self, rt):
+        """BASELINE config 2 shape: embed -> retrieve -> generate."""
+        calls = []
+        for n in ("embedder", "vectordb", "llama"):
+            ep = setup_engram(rt, n)
+
+            @register_engram(ep)
+            def impl(ctx, _n=n):
+                calls.append(_n)
+                if _n == "embedder":
+                    return {"vec": [1.0, 2.0]}
+                if _n == "vectordb":
+                    assert ctx.inputs["vec"] == [1.0, 2.0]
+                    return {"hits": ["doc1", "doc2"]}
+                return {"text": f"answer from {len(ctx.inputs['docs'])} docs"}
+
+        rt.apply(make_story("rag", steps=[
+            {"name": "embed", "ref": {"name": "embedder"}, "with": {"q": "{{ inputs.q }}"}},
+            # no explicit needs: dependency mined from the template refs
+            {"name": "retrieve", "ref": {"name": "vectordb"},
+             "with": {"vec": "{{ steps.embed.output.vec }}"}},
+            {"name": "generate", "ref": {"name": "llama"},
+             "with": {"docs": "{{ steps.retrieve.output.hits }}"}},
+        ], output={"answer": "{{ steps.generate.output.text }}"}))
+        run = rt.run_story("rag", inputs={"q": "what is a tpu"})
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert calls == ["embedder", "vectordb", "llama"]
+        assert rt.run_output(run) == {"answer": "answer from 2 docs"}
+
+    def test_if_condition_skips(self, rt):
+        ran = []
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            ran.append(ctx.step)
+            return {"ok": True}
+
+        rt.apply(make_story("branchy", steps=[
+            {"name": "a", "ref": {"name": "worker"}},
+            {"name": "yes", "needs": ["a"], "if": "{{ steps.a.output.ok }}",
+             "ref": {"name": "worker"}},
+            {"name": "no", "needs": ["a"], "if": "{{ not steps.a.output.ok }}",
+             "ref": {"name": "worker"}},
+        ]))
+        run = rt.run_story("branchy")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert ran == ["a", "yes"]
+        states = rt.store.get("StoryRun", "default", run).status["stepStates"]
+        assert states["no"]["phase"] == "Skipped"
+        assert states["no"]["reason"] == "ConditionFalse"
+
+    def test_dependency_failure_skips_dependents(self, rt):
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            if ctx.step == "bad":
+                raise EngramExit(7, "nope")
+            return {}
+
+        rt.apply(make_story("dep-fail", steps=[
+            {"name": "bad", "ref": {"name": "worker"},
+             "execution": {"retry": {"maxRetries": 0}}},
+            {"name": "after", "needs": ["bad"], "ref": {"name": "worker"}},
+        ]))
+        run = rt.run_story("dep-fail")
+        rt.pump()
+        assert rt.run_phase(run) == "Failed"
+        states = rt.store.get("StoryRun", "default", run).status["stepStates"]
+        assert states["after"]["phase"] == "Skipped"
+
+    def test_allow_failure_continues(self, rt):
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            if ctx.step == "flaky":
+                raise EngramExit(9)
+            return {"done": True}
+
+        rt.apply(make_story("tolerant", steps=[
+            {"name": "flaky", "allowFailure": True, "ref": {"name": "worker"},
+             "execution": {"retry": {"maxRetries": 0}}},
+            {"name": "after", "needs": ["flaky"], "ref": {"name": "worker"}},
+        ]))
+        run = rt.run_story("tolerant")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        states = rt.store.get("StoryRun", "default", run).status["stepStates"]
+        assert states["after"]["phase"] == "Succeeded"
+
+
+class TestRetries:
+    def test_retry_until_success(self, rt):
+        attempts = []
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise EngramExit(143, "preempted")  # retryable
+            return {"attempts": len(attempts)}
+
+        rt.apply(make_story("flaky", steps=[
+            {"name": "s", "ref": {"name": "worker"},
+             "execution": {"retry": {"maxRetries": 5, "delay": "1s"}}},
+        ], output={"n": "{{ steps.s.output.attempts }}"}))
+        run = rt.run_story("flaky")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert rt.run_output(run) == {"n": 3}
+
+    def test_retry_budget_exhaustion(self, rt):
+        attempts = []
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            attempts.append(1)
+            raise EngramExit(137)
+
+        rt.apply(make_story("doomed", steps=[
+            {"name": "s", "ref": {"name": "worker"},
+             "execution": {"retry": {"maxRetries": 2, "delay": "1s"}}},
+        ]))
+        run = rt.run_story("doomed")
+        rt.pump()
+        assert rt.run_phase(run) == "Failed"
+        assert len(attempts) == 3  # initial + 2 retries
+
+    def test_terminal_exit_no_retry(self, rt):
+        attempts = []
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            attempts.append(1)
+            raise EngramExit(2, "bad input")
+
+        rt.apply(make_story("terminal", steps=[
+            {"name": "s", "ref": {"name": "worker"},
+             "execution": {"retry": {"maxRetries": 5, "delay": "1s"}}},
+        ]))
+        run = rt.run_story("terminal")
+        rt.pump()
+        assert rt.run_phase(run) == "Failed"
+        assert len(attempts) == 1
+
+
+class TestPrimitives:
+    def test_sleep(self, rt):
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            return {}
+
+        rt.apply(make_story("sleepy", steps=[
+            {"name": "nap", "type": "sleep", "with": {"duration": "5m"}},
+            {"name": "after", "needs": ["nap"], "ref": {"name": "worker"}},
+        ]))
+        run = rt.run_story("sleepy")
+        t0 = rt.clock.now()
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert rt.clock.now() - t0 >= 300  # virtual time advanced through the sleep
+
+    def test_gate_approval(self, rt):
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            return {"released": True}
+
+        rt.apply(make_story("gated", steps=[
+            {"name": "approval", "type": "gate", "with": {"timeout": "1h"}},
+            {"name": "deploy", "needs": ["approval"], "ref": {"name": "worker"}},
+        ]))
+        run = rt.run_story("gated")
+        rt.manager.run_until_quiet(max_virtual_seconds=60)
+        assert rt.run_phase(run) == "Running"
+        states = rt.store.get("StoryRun", "default", run).status["stepStates"]
+        assert states["approval"]["phase"] == "Paused"
+        # the user approves via a status patch (kubectl patch equivalent)
+        rt.store.patch_status(
+            "StoryRun", "default", run,
+            lambda s: s.setdefault("gates", {}).update(
+                {"approval": {"approved": True, "approver": "alice"}}
+            ),
+        )
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+
+    def test_gate_rejection_fails(self, rt):
+        rt.apply(make_story("gated2", steps=[
+            {"name": "approval", "type": "gate", "with": {"timeout": "1h"}},
+        ]))
+        run = rt.run_story("gated2")
+        rt.manager.run_until_quiet(max_virtual_seconds=60)
+        rt.store.patch_status(
+            "StoryRun", "default", run,
+            lambda s: s.setdefault("gates", {}).update({"approval": {"approved": False}}),
+        )
+        rt.pump()
+        assert rt.run_phase(run) == "Failed"
+
+    def test_gate_timeout(self, rt):
+        rt.apply(make_story("gated3", steps=[
+            {"name": "approval", "type": "gate",
+             "with": {"timeout": "10m", "onTimeout": "skip"}},
+        ]))
+        run = rt.run_story("gated3")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        states = rt.store.get("StoryRun", "default", run).status["stepStates"]
+        assert states["approval"]["phase"] == "Skipped"
+
+    def test_wait_until_signal(self, rt):
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            ctx.signal("ready", True)
+            return {"ok": True}
+
+        rt.apply(make_story("waity", steps=[
+            {"name": "producer", "ref": {"name": "worker"}},
+            {"name": "waiter", "type": "wait",
+             "with": {"until": "{{ steps.producer.output.ok }}",
+                      "timeout": "1h", "pollInterval": "10s"}},
+        ]))
+        run = rt.run_story("waity")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+
+    def test_wait_timeout_fail(self, rt):
+        rt.apply(make_story("wait-to", steps=[
+            {"name": "w", "type": "wait",
+             "with": {"until": "{{ inputs.never }}", "timeout": "1m",
+                      "pollInterval": "10s", "onTimeout": "fail"}},
+        ]))
+        run = rt.run_story("wait-to")
+        rt.pump()
+        assert rt.run_phase(run) == "Failed"
+
+    def test_stop_primitive(self, rt):
+        ran = []
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            ran.append(ctx.step)
+            return {}
+
+        rt.apply(make_story("stopper", steps=[
+            {"name": "first", "ref": {"name": "worker"}},
+            {"name": "halt", "needs": ["first"], "type": "stop",
+             "with": {"phase": "success", "message": "early exit"}},
+            {"name": "never", "needs": ["halt"], "ref": {"name": "worker"}},
+        ]))
+        run = rt.run_story("stopper")
+        rt.pump()
+        r = rt.store.get("StoryRun", "default", run)
+        assert r.status["phase"] == "Succeeded"
+        assert r.status["message"] == "early exit"
+        assert ran == ["first"]
+
+    def test_condition_primitive_succeeds_instantly(self, rt):
+        rt.apply(make_story("condy", steps=[
+            {"name": "check", "type": "condition"},
+        ]))
+        run = rt.run_story("condy")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+
+    def test_parallel_fanout(self, rt):
+        """BASELINE config 3 shape: parallel fan-out branches."""
+        ran = []
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            ran.append(ctx.step)
+            return {"shard": ctx.inputs.get("shard")}
+
+        rt.apply(make_story("fan", steps=[
+            {"name": "split", "type": "parallel", "with": {"steps": [
+                {"name": "b0", "ref": {"name": "worker"}, "with": {"shard": 0}},
+                {"name": "b1", "ref": {"name": "worker"}, "with": {"shard": 1}},
+                {"name": "b2", "ref": {"name": "worker"}, "with": {"shard": 2}},
+            ]}},
+        ], output={"shards": "{{ steps.split.output }}"}))
+        run = rt.run_story("fan")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert sorted(ran) == ["b0", "b1", "b2"]
+        out = rt.run_output(run)["shards"]
+        assert out == {"b0": {"shard": 0}, "b1": {"shard": 1}, "b2": {"shard": 2}}
+
+    def test_parallel_branch_failure(self, rt):
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            if ctx.step == "bad":
+                raise EngramExit(3)
+            return {}
+
+        rt.apply(make_story("fan-fail", steps=[
+            {"name": "split", "type": "parallel", "with": {"steps": [
+                {"name": "good", "ref": {"name": "worker"}},
+                {"name": "bad", "ref": {"name": "worker"},
+                 "execution": {"retry": {"maxRetries": 0}}},
+            ]}},
+        ]))
+        run = rt.run_story("fan-fail")
+        rt.pump()
+        assert rt.run_phase(run) == "Failed"
+
+    def test_execute_story_nested(self, rt):
+        """BASELINE config 5 shape: nested executeStory."""
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            return {"double": ctx.inputs.get("x", 0) * 2}
+
+        rt.apply(make_story("inner", steps=[
+            {"name": "calc", "ref": {"name": "worker"},
+             "with": {"x": "{{ inputs.x }}"}},
+        ], output={"result": "{{ steps.calc.output.double }}"}))
+        rt.apply(make_story("outer", steps=[
+            {"name": "sub", "type": "executeStory",
+             "with": {"storyRef": {"name": "inner"}, "with": {"x": 21}}},
+        ], output={"answer": "{{ steps.sub.output.result }}"}))
+        run = rt.run_story("outer")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert rt.run_output(run) == {"answer": 42}
+
+
+class TestReviewRegressions:
+    def test_same_pass_visibility_of_instant_primitives(self, rt):
+        """A condition completing in one pass must be visible to later
+        steps' if-conditions evaluated in the same pass."""
+        ran = []
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            ran.append(ctx.step)
+            return {}
+
+        rt.apply(make_story("same-pass", steps=[
+            {"name": "check", "type": "condition"},
+            {"name": "y", "needs": ["check"],
+             "if": "{{ steps.check.phase == 'Succeeded' }}",
+             "ref": {"name": "worker"}},
+        ]))
+        run = rt.run_story("same-pass")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert ran == ["y"]
+
+    def test_recursive_execute_story_bounded(self, rt):
+        rt.apply(make_story("ouroboros", steps=[
+            {"name": "again", "type": "executeStory",
+             "with": {"storyRef": {"name": "ouroboros"}}},
+        ]))
+        run = rt.run_story("ouroboros")
+        rt.pump()
+        assert rt.run_phase(run) == "Failed"
+        runs = rt.store.list("StoryRun")
+        max_depth = rt.config_manager.config.engram.max_recursion_depth
+        assert len(runs) <= max_depth + 2
+
+    def test_wait_on_offloaded_data_policy_fail(self, rt):
+        # under policy=fail (default), a wait polling offloaded output
+        # fails the step — the run terminates rather than spinning
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            # exceeds the 16KiB env-contract inline limit -> SDK offloads
+            return {"blob": "x" * 100_000}
+
+        rt.apply(make_story("wait-offloaded", steps=[
+            {"name": "big", "ref": {"name": "worker"}},
+            {"name": "w", "type": "wait",
+             "with": {"until": "{{ steps.big.output.blob }}",
+                      "timeout": "5m", "pollInterval": "10s"}},
+        ]))
+        run = rt.run_story("wait-offloaded")
+        rt.pump()
+        r = rt.store.get("StoryRun", "default", run)
+        assert r.status["phase"] == "Failed"
+        assert r.status["stepStates"]["w"]["reason"] == "OffloadedDataPolicy"
+
+    def test_step_tpu_hosts_without_topology_reach_env(self, rt):
+        seen = {}
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            seen["hosts"] = ctx.num_hosts
+            return {}
+
+        rt.apply(make_story("hosts-only", steps=[
+            {"name": "train", "ref": {"name": "worker"}, "tpu": {"hosts": 4}},
+        ]))
+        run = rt.run_story("hosts-only")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert seen["hosts"] == 4
+
+
+class TestSagaPhases:
+    def test_compensation_runs_on_failure(self, rt):
+        ran = []
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            ran.append(ctx.step)
+            if ctx.step == "charge":
+                raise EngramExit(5)
+            return {}
+
+        story = make_story("saga", steps=[
+            {"name": "reserve", "ref": {"name": "worker"}},
+            {"name": "charge", "needs": ["reserve"], "ref": {"name": "worker"},
+             "execution": {"retry": {"maxRetries": 0}}},
+        ])
+        story.spec["compensations"] = [
+            {"name": "refund", "ref": {"name": "worker"}},
+        ]
+        story.spec["finally"] = [
+            {"name": "notify", "ref": {"name": "worker"}},
+        ]
+        rt.apply(story)
+        run = rt.run_story("saga")
+        rt.pump()
+        assert rt.run_phase(run) == "Failed"
+        assert ran == ["reserve", "charge", "refund", "notify"]
+
+    def test_finally_runs_on_success(self, rt):
+        ran = []
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            ran.append(ctx.step)
+            return {}
+
+        story = make_story("cleanup", steps=[
+            {"name": "work", "ref": {"name": "worker"}},
+        ])
+        story.spec["finally"] = [{"name": "audit", "ref": {"name": "worker"}}]
+        rt.apply(story)
+        run = rt.run_story("cleanup")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert ran == ["work", "audit"]
+
+
+class TestLifecycle:
+    def test_graceful_cancel(self, rt):
+        rt.apply(make_story("long", steps=[
+            {"name": "nap", "type": "sleep", "with": {"duration": "10h"}},
+        ]))
+        run = rt.run_story("long")
+        rt.manager.run_until_quiet(max_virtual_seconds=60)
+        assert rt.run_phase(run) == "Running"
+        rt.store.mutate(
+            "StoryRun", "default", run,
+            lambda r: r.spec.update(cancelRequested=True),
+        )
+        rt.pump()
+        assert rt.run_phase(run) == "Finished"
+
+    def test_redrive_full(self, rt):
+        count = {"n": 0}
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            count["n"] += 1
+            if count["n"] == 1:
+                raise EngramExit(4, "first time fails")
+            return {"try": count["n"]}
+
+        rt.apply(make_story("redrivable", steps=[
+            {"name": "s", "ref": {"name": "worker"},
+             "execution": {"retry": {"maxRetries": 0}}},
+        ]))
+        run = rt.run_story("redrivable")
+        rt.pump()
+        assert rt.run_phase(run) == "Failed"
+        rt.store.mutate(
+            "StoryRun", "default", run,
+            lambda r: r.meta.annotations.update({"runs.bobrapet.io/redrive": "full"}),
+        )
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+
+    def test_retention_cleans_children_then_run(self, rt):
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            return {}
+
+        rt.apply(make_story("short", steps=[{"name": "s", "ref": {"name": "worker"}}]))
+        run = rt.run_story("short")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert len(rt.store.list("StepRun")) == 1
+        # pump through the retention timers (1h children TTL, 24h record)
+        rt.manager.run_until_quiet(max_virtual_seconds=2 * 86400)
+        assert rt.store.list("StepRun") == []
+        assert rt.store.try_get("StoryRun", "default", run) is None
+
+    def test_story_timeout(self, rt):
+        rt.apply(make_story("slow", steps=[
+            {"name": "nap", "type": "sleep", "with": {"duration": "2h"}},
+        ], policy={"timeouts": {"story": "10m"}}))
+        run = rt.run_story("slow")
+        rt.pump()
+        assert rt.run_phase(run) == "Timeout"
+
+
+class TestCache:
+    def test_output_cache_hit_on_second_run(self, rt):
+        calls = []
+        ep = setup_engram(rt, template_fields=dict())
+
+        @register_engram(ep)
+        def impl(ctx):
+            calls.append(1)
+            return {"value": 42}
+
+        rt.apply(make_story("cached", steps=[
+            {"name": "s", "ref": {"name": "worker"},
+             "with": {"q": "{{ inputs.q }}"},
+             "execution": {"cache": {"enabled": True, "ttlSeconds": 86400}}},
+        ]))
+        r1 = rt.run_story("cached", inputs={"q": "x"})
+        rt.pump()
+        r2 = rt.run_story("cached", inputs={"q": "x"})
+        rt.pump()
+        r3 = rt.run_story("cached", inputs={"q": "different"})
+        rt.pump()
+        assert rt.run_phase(r1) == rt.run_phase(r2) == rt.run_phase(r3) == "Succeeded"
+        assert len(calls) == 2  # r2 was a cache hit, r3 missed
+
+
+class TestTPUPlacement:
+    def test_slice_grant_flows_to_env(self, rt):
+        from bobrapet_tpu.parallel.placement import SlicePool
+
+        rt.placer.add_pool(SlicePool("v5e-pool", "4x4", chips_per_host=4))
+        seen = {}
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            seen["topology"] = ctx.tpu_topology
+            seen["hosts"] = ctx.num_hosts
+            seen["mesh_axes"] = ctx.mesh_axes
+            return {}
+
+        rt.apply(make_story("tpu-story", steps=[
+            {"name": "train", "ref": {"name": "worker"},
+             "tpu": {"topology": "2x4", "meshAxes": {"data": 2, "model": 4}}},
+        ], policy={"queue": "v5e-pool"}))
+        run = rt.run_story("tpu-story")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert seen["topology"] == "2x4"
+        assert seen["hosts"] == 2  # 8 chips / 4 per host
+        assert seen["mesh_axes"] == {"data": 2, "model": 4}
+        # grant released after completion
+        assert rt.placer.pool("v5e-pool").free_chips() == 16
+
+    def test_gang_all_or_nothing_queueing(self, rt):
+        from bobrapet_tpu.parallel.placement import SlicePool
+
+        rt.placer.add_pool(SlicePool("tiny", "2x2", chips_per_host=4))
+        order = []
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            order.append(ctx.step)
+            return {}
+
+        rt.apply(make_story("contended", steps=[
+            {"name": "a", "ref": {"name": "worker"}, "tpu": {"topology": "2x2"}},
+            {"name": "b", "ref": {"name": "worker"}, "tpu": {"topology": "2x2"}},
+        ], policy={"queue": "tiny"}))
+        run = rt.run_story("contended")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert sorted(order) == ["a", "b"]  # both ran, serialized on the slice
