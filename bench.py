@@ -1,0 +1,153 @@
+"""Benchmark: Story wall-clock + engram decode tokens/sec/chip.
+
+Runs BASELINE config-2's shape — a 3-step DAG story (tokenize ->
+generate -> detokenize) through the FULL control plane, with the
+generate engram running Llama greedy decode on the real accelerator.
+Prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+The reference publishes no numbers (BASELINE.md), so vs_baseline
+compares against this framework's own first recorded value when present
+in BENCH_BASELINE env (else 1.0).
+
+Env knobs: BENCH_MODEL=tiny|1b|8b, BENCH_BATCH, BENCH_PROMPT_LEN,
+BENCH_NEW_TOKENS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    import jax
+
+    from bobrapet_tpu.api.catalog import make_engram_template
+    from bobrapet_tpu.api.engram import make_engram
+    from bobrapet_tpu.api.story import make_story
+    from bobrapet_tpu.models import llama
+    from bobrapet_tpu.runtime import Runtime
+    from bobrapet_tpu.sdk import register_engram
+
+    backend = jax.default_backend()
+    n_chips = jax.device_count()
+    model_name = os.environ.get("BENCH_MODEL") or ("1b" if backend == "tpu" else "tiny")
+    cfg = {
+        "tiny": llama.llama_tiny,
+        "1b": llama.llama3_1b,
+        "8b": llama.llama3_8b,
+    }[model_name]()
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64" if backend == "tpu" else "8"))
+
+    timings: dict[str, float] = {}
+
+    @register_engram("bench-tokenize")
+    def tokenize(ctx):
+        # stand-in tokenizer: deterministic ids from the prompt text
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, size=(batch, prompt_len))
+        return {"ids": ids.tolist()}
+
+    @register_engram("bench-generate")
+    def generate(ctx):
+        import jax.numpy as jnp
+
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.asarray(ctx.inputs["ids"], dtype=jnp.int32)
+
+        import functools
+
+        gen = jax.jit(
+            functools.partial(
+                llama.greedy_generate,
+                cfg=cfg,
+                max_new_tokens=new_tokens,
+                cache_capacity=prompt_len + new_tokens,
+            )
+        )
+        # warmup/compile
+        gen(params, prompt).block_until_ready()
+        t0 = time.perf_counter()
+        toks = gen(params, prompt)
+        toks.block_until_ready()
+        dt = time.perf_counter() - t0
+        timings["decode_s"] = dt
+        timings["tokens"] = batch * new_tokens
+        return {"tokens": toks.tolist(), "decode_s": dt}
+
+    @register_engram("bench-detok")
+    def detok(ctx):
+        n = sum(len(r) for r in ctx.inputs["tokens"])
+        return {"text_len": n}
+
+    rt = Runtime()
+    for name, ep in (
+        ("tokenizer", "bench-tokenize"),
+        ("generator", "bench-generate"),
+        ("detokenizer", "bench-detok"),
+    ):
+        rt.apply(make_engram_template(f"{name}-tpl", entrypoint=ep))
+        rt.apply(make_engram(name, f"{name}-tpl"))
+
+    rt.apply(
+        make_story(
+            "bench-inference",
+            steps=[
+                {"name": "tokenize", "ref": {"name": "tokenizer"},
+                 "with": {"prompt": "{{ inputs.prompt }}"}},
+                {"name": "generate", "ref": {"name": "generator"},
+                 "with": {"ids": "{{ steps.tokenize.output.ids }}"}},
+                {"name": "detokenize", "ref": {"name": "detokenizer"},
+                 "with": {"tokens": "{{ steps.generate.output.tokens }}"}},
+            ],
+            output={"textLen": "{{ steps.detokenize.output.text_len }}",
+                    "decodeSeconds": "{{ steps.generate.output.decode_s }}"},
+        )
+    )
+
+    wall0 = time.perf_counter()
+    run = rt.run_story("bench-inference", inputs={"prompt": "benchmark"})
+    rt.pump()
+    story_wall = time.perf_counter() - wall0
+
+    phase = rt.run_phase(run)
+    if phase != "Succeeded":
+        r = rt.store.get("StoryRun", "default", run)
+        print(json.dumps({
+            "metric": "llama_decode_tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "tok/s/chip",
+            "vs_baseline": 0.0,
+            "error": f"story phase {phase}: {r.status.get('error')}",
+        }))
+        raise SystemExit(1)
+
+    tps = timings["tokens"] / timings["decode_s"]
+    tps_per_chip = tps / max(1, n_chips)
+    baseline = float(os.environ.get("BENCH_BASELINE", "0") or 0)
+    result = {
+        "metric": "llama_decode_tokens_per_sec_per_chip",
+        "value": round(tps_per_chip, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tps_per_chip / baseline, 3) if baseline else 1.0,
+        "model": model_name,
+        "backend": backend,
+        "chips": n_chips,
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "decode_tokens_per_sec": round(tps, 2),
+        "story_wallclock_s": round(story_wall, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

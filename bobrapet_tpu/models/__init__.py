@@ -1,0 +1,23 @@
+"""Model families (functional JAX, sharding-rule driven)."""
+
+from .llama import (
+    LlamaConfig,
+    forward,
+    greedy_generate,
+    init_cache,
+    init_params,
+    llama3_1b,
+    llama3_8b,
+    llama_tiny,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "forward",
+    "greedy_generate",
+    "init_cache",
+    "init_params",
+    "llama3_1b",
+    "llama3_8b",
+    "llama_tiny",
+]

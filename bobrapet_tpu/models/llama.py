@@ -1,0 +1,280 @@
+"""Llama-3 model family: functional JAX implementation.
+
+The flagship engram model (BASELINE configs 2-5 run Llama-3-8B
+inference). Pure functional style — params are a pytree dict, forward is
+jit/pjit-friendly (static shapes, no Python control flow on traced
+values), sharding is applied by :mod:`bobrapet_tpu.parallel.sharding`
+rules, long context rides :mod:`bobrapet_tpu.parallel.ring_attention`.
+
+Weights use bfloat16 by default (MXU-native); accumulation in fp32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+from ..ops.rmsnorm import rmsnorm_reference
+from ..ops.rope import apply_rope, rope_frequencies
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_hidden: int = 14_336
+    max_seq_len: int = 8192
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def param_count(self) -> int:
+        emb = self.vocab_size * self.dim
+        attn = self.dim * self.dim + 2 * self.dim * (self.n_kv_heads * self.head_dim) + self.dim * self.dim
+        mlp = 3 * self.dim * self.ffn_hidden
+        norms = 2 * self.dim
+        out = 0 if self.tie_embeddings else self.vocab_size * self.dim
+        return emb + self.n_layers * (attn + mlp + norms) + self.dim + out
+
+
+def llama3_8b() -> LlamaConfig:
+    """Llama-3-8B (the BASELINE flagship)."""
+    return LlamaConfig()
+
+
+def llama3_1b() -> LlamaConfig:
+    """A ~1B config for single-chip v5e benchmarking headroom."""
+    return LlamaConfig(
+        dim=2048, n_layers=16, n_heads=16, n_kv_heads=8, ffn_hidden=5632,
+        max_seq_len=4096,
+    )
+
+
+def llama_tiny(vocab_size: int = 512, max_seq_len: int = 256) -> LlamaConfig:
+    """Tiny config for tests and the graft compile check."""
+    return LlamaConfig(
+        vocab_size=vocab_size,
+        dim=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_hidden=256,
+        max_seq_len=max_seq_len,
+        dtype=jnp.float32,
+    )
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> dict[str, Any]:
+    """Initialize a parameter pytree.
+
+    Layout (names chosen to map 1:1 onto sharding rules):
+      embed.weight [V, D]
+      layers.<i>.{attn_norm,mlp_norm}.weight [D]
+      layers.<i>.attn.{wq [D, Hq*Dh], wk [D, Hkv*Dh], wv [D, Hkv*Dh], wo [Hq*Dh, D]}
+      layers.<i>.mlp.{w_gate [D, F], w_up [D, F], w_down [F, D]}
+      final_norm.weight [D]
+      lm_head.weight [D, V] (absent when tie_embeddings)
+    """
+    n_weights = 2 + cfg.n_layers * 7
+    keys = iter(jax.random.split(key, n_weights))
+    std = 1.0 / math.sqrt(cfg.dim)
+
+    def dense(k, shape, scale=std):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    params: dict[str, Any] = {
+        "embed": {"weight": dense(next(keys), (cfg.vocab_size, cfg.dim), 1.0 / math.sqrt(cfg.dim))},
+        "layers": [],
+        "final_norm": {"weight": jnp.ones((cfg.dim,), cfg.dtype)},
+    }
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    for _ in range(cfg.n_layers):
+        layer = {
+            "attn_norm": {"weight": jnp.ones((cfg.dim,), cfg.dtype)},
+            "attn": {
+                "wq": dense(next(keys), (cfg.dim, cfg.dim)),
+                "wk": dense(next(keys), (cfg.dim, kv_dim)),
+                "wv": dense(next(keys), (cfg.dim, kv_dim)),
+                "wo": dense(next(keys), (cfg.dim, cfg.dim), std / math.sqrt(2 * cfg.n_layers)),
+            },
+            "mlp_norm": {"weight": jnp.ones((cfg.dim,), cfg.dtype)},
+            "mlp": {
+                "w_gate": dense(next(keys), (cfg.dim, cfg.ffn_hidden)),
+                "w_up": dense(next(keys), (cfg.dim, cfg.ffn_hidden)),
+                "w_down": dense(next(keys), (cfg.ffn_hidden, cfg.dim), std / math.sqrt(2 * cfg.n_layers)),
+            },
+        }
+        params["layers"].append(layer)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"weight": dense(next(keys), (cfg.dim, cfg.vocab_size))}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _attention_block(
+    layer: dict[str, Any],
+    x: jax.Array,
+    freqs: jax.Array,
+    cfg: LlamaConfig,
+    cache: Optional[dict[str, jax.Array]],
+    positions: Optional[jax.Array],
+    attn_fn,
+) -> tuple[jax.Array, Optional[dict[str, jax.Array]]]:
+    b, s, _ = x.shape
+    h = rmsnorm_reference(x, layer["attn_norm"]["weight"], cfg.norm_eps)
+    q = (h @ layer["attn"]["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = (h @ layer["attn"]["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ layer["attn"]["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = apply_rope(q, freqs, positions)
+    k = apply_rope(k, freqs, positions)
+
+    new_cache = None
+    if cache is not None:
+        # decode: write k/v at the cache cursor, attend over the prefix
+        cursor = cache["cursor"]
+        ck = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, cursor, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, cursor, 0, 0))
+        new_cache = {"k": ck, "v": cv, "cursor": cursor + s}
+        k_all, v_all = ck, cv
+        out = _cached_attention(q, k_all, v_all, cursor + s, cfg)
+    else:
+        out = attn_fn(q, k, v)
+    out = out.reshape(b, s, cfg.dim)
+    return x + out @ layer["attn"]["wo"], new_cache
+
+
+def _cached_attention(q, k_all, v_all, valid_len, cfg: LlamaConfig) -> jax.Array:
+    """Decode attention over a cache with a traced valid length."""
+    b, s, hq, d = q.shape
+    cap = k_all.shape[1]
+    group = hq // cfg.n_kv_heads
+    scale = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32) * scale
+    kf = jnp.repeat(k_all.astype(jnp.float32), group, axis=2)
+    vf = jnp.repeat(v_all.astype(jnp.float32), group, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
+    q_pos = valid_len - s + jnp.arange(s)
+    k_pos = jnp.arange(cap)
+    mask = (k_pos[None, :] <= q_pos[:, None]) & (k_pos[None, :] < valid_len)
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vf).astype(q.dtype)
+
+
+def _mlp_block(layer: dict[str, Any], x: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    h = rmsnorm_reference(x, layer["mlp_norm"]["weight"], cfg.norm_eps)
+    gate = jax.nn.silu((h @ layer["mlp"]["w_gate"]).astype(jnp.float32))
+    up = (h @ layer["mlp"]["w_up"]).astype(jnp.float32)
+    return x + ((gate * up).astype(cfg.dtype) @ layer["mlp"]["w_down"])
+
+
+def forward(
+    params: dict[str, Any],
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    cache: Optional[list[dict[str, jax.Array]]] = None,
+    positions: Optional[jax.Array] = None,
+    attn_fn=None,
+) -> tuple[jax.Array, Optional[list[dict[str, jax.Array]]]]:
+    """Token ids [B, S] -> logits [B, S, V] (+ updated cache).
+
+    ``attn_fn`` overrides the attention implementation (ring attention
+    plugs in here for sequence-parallel long context).
+    """
+    if attn_fn is None:
+        attn_fn = lambda q, k, v: attention(q, k, v, causal=True)  # noqa: E731
+    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    x = params["embed"]["weight"][tokens].astype(cfg.dtype)
+    new_caches: Optional[list[dict[str, jax.Array]]] = [] if cache is not None else None
+    for i, layer in enumerate(params["layers"]):
+        layer_cache = cache[i] if cache is not None else None
+        x, updated = _attention_block(layer, x, freqs, cfg, layer_cache, positions, attn_fn)
+        if new_caches is not None:
+            new_caches.append(updated)
+        x = _mlp_block(layer, x, cfg)
+    x = rmsnorm_reference(x, params["final_norm"]["weight"], cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"]["weight"].T.astype(cfg.dtype)
+    else:
+        logits = x @ params["lm_head"]["weight"]
+    return logits.astype(jnp.float32), new_caches
+
+
+# ---------------------------------------------------------------------------
+# KV cache + generation
+# ---------------------------------------------------------------------------
+
+
+def init_cache(cfg: LlamaConfig, batch: int, capacity: Optional[int] = None) -> list[dict[str, jax.Array]]:
+    cap = capacity or cfg.max_seq_len
+    return [
+        {
+            "k": jnp.zeros((batch, cap, cfg.n_kv_heads, cfg.head_dim), cfg.dtype),
+            "v": jnp.zeros((batch, cap, cfg.n_kv_heads, cfg.head_dim), cfg.dtype),
+            "cursor": jnp.array(0, jnp.int32),
+        }
+        for _ in range(cfg.n_layers)
+    ]
+
+
+def greedy_generate(
+    params: dict[str, Any],
+    prompt: jax.Array,
+    cfg: LlamaConfig,
+    max_new_tokens: int = 32,
+    cache_capacity: Optional[int] = None,
+) -> jax.Array:
+    """Greedy decode with a KV cache; prefill + lax.scan decode loop
+    (compiler-friendly: fixed shapes, no Python loop per token)."""
+    b, prompt_len = prompt.shape
+    cap = cache_capacity or min(cfg.max_seq_len, prompt_len + max_new_tokens)
+    if prompt_len + max_new_tokens > cap:
+        # dynamic_update_slice clamps out-of-range writes, which would
+        # silently corrupt the last cache slot instead of erroring
+        raise ValueError(
+            f"prompt_len({prompt_len}) + max_new_tokens({max_new_tokens}) "
+            f"exceeds cache capacity {cap}"
+        )
+    cache = init_cache(cfg, b, cap)
+
+    positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
+    logits, cache = forward(params, prompt, cfg, cache=cache, positions=positions)
+    next_tok = jnp.argmax(logits[:, -1:, :], axis=-1)
+
+    def step(carry, _):
+        cache, tok, pos = carry
+        logits, cache = forward(
+            params, tok, cfg, cache=cache,
+            positions=pos[:, None],
+        )
+        nxt = jnp.argmax(logits[:, -1:, :], axis=-1)
+        return (cache, nxt, pos + 1), tok[:, 0]
+
+    pos0 = jnp.full((b,), prompt_len, jnp.int32)
+    (_, _, _), toks = jax.lax.scan(
+        step, (cache, next_tok, pos0), None, length=max_new_tokens
+    )
+    return jnp.swapaxes(toks, 0, 1)  # [B, max_new_tokens]

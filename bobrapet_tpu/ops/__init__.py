@@ -1,0 +1,16 @@
+"""Hot ops: Pallas TPU kernels with XLA references."""
+
+from .attention import attention, attention_reference, flash_attention
+from .rmsnorm import rmsnorm, rmsnorm_pallas, rmsnorm_reference
+from .rope import apply_rope, rope_frequencies
+
+__all__ = [
+    "attention",
+    "attention_reference",
+    "flash_attention",
+    "rmsnorm",
+    "rmsnorm_pallas",
+    "rmsnorm_reference",
+    "apply_rope",
+    "rope_frequencies",
+]

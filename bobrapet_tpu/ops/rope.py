@@ -1,0 +1,43 @@
+"""Rotary position embeddings (RoPE) for the Llama family.
+
+Pure XLA: RoPE is elementwise and fuses into the surrounding
+projections; a hand kernel buys nothing here (the MXU work is in the
+matmuls around it).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("dim", "max_seq_len", "theta"))
+def rope_frequencies(dim: int, max_seq_len: int, theta: float = 500_000.0) -> jax.Array:
+    """Complex rotation table [max_seq_len, dim//2] as (cos, sin) stacked.
+
+    theta=500k is the Llama-3 base.
+    """
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [S, dim/2]
+    return jnp.stack([jnp.cos(freqs), jnp.sin(freqs)], axis=-1)  # [S, dim/2, 2]
+
+
+def apply_rope(x: jax.Array, freqs: jax.Array, positions: jax.Array | None = None) -> jax.Array:
+    """Rotate q/k.
+
+    x: [..., S, H, D]; freqs: [max_S, D/2, 2]; positions: [..., S] absolute
+    positions (defaults to arange — pass real positions for decode).
+    """
+    seq_len = x.shape[-3]
+    if positions is None:
+        table = freqs[:seq_len]  # [S, D/2, 2]
+    else:
+        table = freqs[positions]  # [..., S, D/2, 2]
+    cos = table[..., 0][..., :, None, :]  # [..., S, 1, D/2]
+    sin = table[..., 1][..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)

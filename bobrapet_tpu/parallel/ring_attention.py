@@ -1,0 +1,119 @@
+"""Ring attention: sequence-parallel causal attention over a mesh axis.
+
+Long-context support (SURVEY §5.7: long context is SDK/model side, over
+the ICI mesh). Each device holds one sequence shard of q/k/v; k/v blocks
+rotate around the ring with ``ppermute`` while each device folds every
+block into its local queries with an online-softmax merge — O(S/n)
+memory per device, full-sequence attention, and every hop rides a
+neighbor ICI link (the ``seq`` axis should map onto a physical ring).
+
+Pattern per the ring-attention papers (Liu et al.) rebuilt on
+``shard_map`` + XLA collectives — no reference counterpart to port.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attention(q, k, q_pos, k_pos, causal: bool):
+    """Scores for one (q shard, k block) pair in fp32 with position-aware
+    causal masking. q: [B, Sq, H, D] (kv already grouped to H)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    return s
+
+
+def _ring_shard(q, k, v, *, axis_name: str, causal: bool, sm_scale: float, n_kv_heads: int):
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, sq, hq, d = q.shape
+    chunk_k = k.shape[1]
+    group = hq // n_kv_heads
+
+    qf = q.astype(jnp.float32) * sm_scale
+    q_pos = my_idx * sq + jnp.arange(sq)
+
+    m0 = jnp.full((b, hq, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hq, sq), jnp.float32)
+    acc0 = jnp.zeros((b, sq, hq, d), jnp.float32)
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def body(i, carry):
+        k_blk, v_blk, m, l, acc = carry
+        src = (my_idx - i) % axis_size  # which shard's k/v we hold now
+        kf = jnp.repeat(k_blk.astype(jnp.float32), group, axis=2)
+        vf = jnp.repeat(v_blk.astype(jnp.float32), group, axis=2)
+        k_pos = src * chunk_k + jnp.arange(chunk_k)
+        s = _block_attention(qf, kf, q_pos, k_pos, causal)  # [B,H,Sq,Sk]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * jnp.moveaxis(alpha, 1, 2)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vf
+        )
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_nxt, v_nxt, m_new, l_new, acc_new
+
+    _, _, m, l, acc = jax.lax.fori_loop(0, axis_size, body, (k, v, m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    out = acc / jnp.moveaxis(l, 1, 2)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "seq",
+    causal: bool = True,
+    sm_scale: float | None = None,
+    batch_axes: tuple[str, ...] = (),
+) -> jax.Array:
+    """Full-sequence causal attention over sequence shards.
+
+    q: [B, S, Hq, D], k/v: [B, S, Hkv, D] — S sharded on ``axis_name``
+    (and B optionally on ``batch_axes``). Call under jit with inputs
+    sharded accordingly; shard_map makes the per-device program explicit.
+    """
+    n_kv_heads = k.shape[2]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    bspec = batch_axes if batch_axes else None
+    spec = P(bspec, axis_name, None, None)
+    fn = functools.partial(
+        _ring_shard,
+        axis_name=axis_name,
+        causal=causal,
+        sm_scale=scale,
+        n_kv_heads=n_kv_heads,
+    )
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def make_ring_attn_fn(mesh: Mesh, axis_name: str = "seq", batch_axes: tuple[str, ...] = ()):
+    """An attn_fn for models.llama.forward that runs ring attention."""
+
+    def attn_fn(q, k, v):
+        return ring_attention(q, k, v, mesh, axis_name=axis_name, batch_axes=batch_axes)
+
+    return attn_fn

@@ -1,0 +1,118 @@
+"""Sharding rules: map Llama parameters/activations onto mesh axes.
+
+The scaling-book recipe: pick a mesh (axes ``data`` for DP, ``fsdp`` for
+parameter sharding, ``model`` for TP, ``seq`` for sequence/context
+parallelism), annotate shardings with NamedSharding/PartitionSpec, and
+let XLA insert the collectives (psum/all-gather/reduce-scatter ride ICI
+when the mesh maps to one slice).
+
+Parameter layout matches :func:`bobrapet_tpu.models.llama.init_params`:
+- attention/MLP input projections: columns on ``model`` (TP
+  col-parallel), rows on ``fsdp``
+- output projections: rows on ``model`` (TP row-parallel -> psum), cols
+  on ``fsdp``
+- embeddings: vocab on ``model`` (vocab-parallel), dim on ``fsdp``
+- norms: replicated
+- activations: batch on ``data``, sequence on ``seq``
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def _axes_in(mesh: Mesh) -> set[str]:
+    return set(mesh.axis_names)
+
+
+def _p(mesh: Mesh, *axes: Optional[str]) -> P:
+    """PartitionSpec keeping only axes present in the mesh."""
+    present = _axes_in(mesh)
+    cleaned = []
+    for a in axes:
+        if a is None:
+            cleaned.append(None)
+        elif isinstance(a, tuple):
+            kept = tuple(x for x in a if x in present)
+            cleaned.append(kept if kept else None)
+        else:
+            cleaned.append(a if a in present else None)
+    while cleaned and cleaned[-1] is None:
+        cleaned.pop()
+    return P(*cleaned)
+
+
+def llama_param_specs(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
+    """Pytree of PartitionSpecs mirroring the param pytree."""
+
+    def layer_spec(_layer: dict[str, Any]) -> dict[str, Any]:
+        return {
+            "attn_norm": {"weight": _p(mesh)},
+            "attn": {
+                "wq": _p(mesh, FSDP_AXIS, MODEL_AXIS),
+                "wk": _p(mesh, FSDP_AXIS, MODEL_AXIS),
+                "wv": _p(mesh, FSDP_AXIS, MODEL_AXIS),
+                "wo": _p(mesh, MODEL_AXIS, FSDP_AXIS),
+            },
+            "mlp_norm": {"weight": _p(mesh)},
+            "mlp": {
+                "w_gate": _p(mesh, FSDP_AXIS, MODEL_AXIS),
+                "w_up": _p(mesh, FSDP_AXIS, MODEL_AXIS),
+                "w_down": _p(mesh, MODEL_AXIS, FSDP_AXIS),
+            },
+        }
+
+    specs: dict[str, Any] = {
+        "embed": {"weight": _p(mesh, MODEL_AXIS, FSDP_AXIS)},
+        "layers": [layer_spec(layer) for layer in params["layers"]],
+        "final_norm": {"weight": _p(mesh)},
+    }
+    if "lm_head" in params:
+        specs["lm_head"] = {"weight": _p(mesh, FSDP_AXIS, MODEL_AXIS)}
+    return specs
+
+
+def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
+    """device_put the param pytree with its NamedShardings."""
+    specs = llama_param_specs(params, mesh)
+    return jax.tree_util.tree_map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        params,
+        specs,
+        is_leaf=lambda x: isinstance(x, jax.Array) or hasattr(x, "shape"),
+    )
+
+
+def param_shardings(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
+    specs = llama_param_specs(params, mesh)
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def activation_spec(mesh: Mesh, sequence_sharded: bool = False) -> P:
+    """[B, S, ...] activations: batch on data(+fsdp), seq optionally on seq."""
+    return _p(
+        mesh,
+        (DATA_AXIS, FSDP_AXIS),
+        SEQ_AXIS if sequence_sharded else None,
+    )
+
+
+def token_sharding(mesh: Mesh, sequence_sharded: bool = False) -> NamedSharding:
+    return NamedSharding(mesh, activation_spec(mesh, sequence_sharded))
+
+
+def constrain(x: jax.Array, mesh: Mesh, *axes: Optional[str]) -> jax.Array:
+    """with_sharding_constraint through the cleaned spec."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, _p(mesh, *axes)))

@@ -1,0 +1,107 @@
+"""Training step factory: sharded loss/grad/update over a mesh.
+
+The full training path the driver dry-runs multi-chip: forward (ring
+attention when a ``seq`` axis exists), token cross-entropy, grads, and
+an optax update — all under one jit with NamedShardings so XLA places
+the collectives (grad psum over data/fsdp, TP psums over model) on ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding
+
+from ..models.llama import LlamaConfig, forward, init_params
+from .ring_attention import make_ring_attn_fn
+from .sharding import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    SEQ_AXIS,
+    shard_params,
+    token_sharding,
+)
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean token cross-entropy in fp32."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def make_train_step(
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    use_ring_attention: Optional[bool] = None,
+    remat: bool = False,
+) -> Callable:
+    """Build a jitted train step (params, opt_state, tokens) ->
+    (params, opt_state, loss).
+
+    tokens: [B, S+1]; loss predicts tokens[:, 1:] from tokens[:, :-1].
+    Ring attention activates when the mesh has a ``seq`` axis of size > 1
+    (sequence parallelism over ICI); rematerialization trades FLOPs for
+    HBM when ``remat`` is set.
+    """
+    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.1)
+    ring = (
+        use_ring_attention
+        if use_ring_attention is not None
+        else (SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1)
+    )
+    batch_axes = tuple(
+        a for a in (DATA_AXIS, FSDP_AXIS) if a in mesh.axis_names and mesh.shape[a] > 1
+    )
+    attn_fn = (
+        make_ring_attn_fn(mesh, SEQ_AXIS, batch_axes=batch_axes) if ring else None
+    )
+
+    # attn_fn is closed over (functions are not valid JAX types, so it
+    # must not travel through jax.checkpoint as an argument)
+    def model_fwd(params, tokens_in):
+        logits, _ = forward(params, tokens_in, cfg, attn_fn=attn_fn)
+        return logits
+
+    if remat:
+        model_fwd = jax.checkpoint(model_fwd)
+
+    def loss_fn(params, tokens):
+        logits = model_fwd(params, tokens[:, :-1])
+        return cross_entropy_loss(logits, tokens[:, 1:])
+
+    @jax.jit
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def init_sharded_train_state(
+    key: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    optimizer: Optional[optax.GradientTransformation] = None,
+) -> tuple[dict[str, Any], Any, optax.GradientTransformation]:
+    """Initialize params + optimizer state, sharded by the llama rules
+    (optimizer moments inherit each param's sharding)."""
+    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.1)
+    params = shard_params(init_params(key, cfg), mesh)
+    # initializing under jit lets XLA propagate each param's sharding onto
+    # its optimizer moments — the idiomatic way to shard optax state
+    opt_state = jax.jit(optimizer.init)(params)
+    return params, opt_state, optimizer
+
+
+def make_token_batch(
+    key: jax.Array, cfg: LlamaConfig, batch: int, seq_len: int, mesh: Mesh, sequence_sharded: bool = False
+) -> jax.Array:
+    tokens = jax.random.randint(key, (batch, seq_len + 1), 0, cfg.vocab_size)
+    return jax.device_put(tokens, token_sharding(mesh, sequence_sharded=sequence_sharded))

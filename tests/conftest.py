@@ -10,12 +10,20 @@ internal/controller/runs/suite_test.go:32-54).
 import os
 
 # Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the driver environment pins JAX_PLATFORMS to the real
+# TPU platform, but the suite runs on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# A pytest plugin may import jax before this conftest; the config update
+# still wins as long as no computation has initialized the backends.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

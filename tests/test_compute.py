@@ -1,0 +1,261 @@
+"""Compute plane tests on the virtual 8-device CPU mesh.
+
+Kernels run in interpret mode; sharding/collectives run on the forced
+8-device CPU backend (conftest sets XLA_FLAGS) — the multi-chip paths
+compile and execute exactly as they would across a slice.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bobrapet_tpu.models.llama import (
+    forward,
+    greedy_generate,
+    init_cache,
+    init_params,
+    llama_tiny,
+)
+from bobrapet_tpu.ops.attention import attention_reference, flash_attention
+from bobrapet_tpu.ops.rmsnorm import rmsnorm_pallas, rmsnorm_reference
+from bobrapet_tpu.ops.rope import apply_rope, rope_frequencies
+from bobrapet_tpu.parallel.mesh import build_mesh
+from bobrapet_tpu.parallel.ring_attention import ring_attention
+from bobrapet_tpu.parallel.sharding import llama_param_specs, shard_params
+
+
+def test_eight_cpu_devices():
+    assert len(jax.devices()) == 8
+
+
+class TestRMSNorm:
+    def test_pallas_matches_reference(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 64, 128))
+        w = jax.random.normal(jax.random.PRNGKey(1), (128,)) * 0.1 + 1.0
+        ref = rmsnorm_reference(x, w)
+        out = rmsnorm_pallas(x, w, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_uneven_rows_fall_back_to_single_block(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (3, 7, 128))
+        w = jnp.ones((128,))
+        out = rmsnorm_pallas(x, w, block_rows=256, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(rmsnorm_reference(x, w)), rtol=1e-5, atol=1e-5
+        )
+
+
+class TestRope:
+    def test_rotation_preserves_norm(self):
+        freqs = rope_frequencies(64, 128)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 4, 64))
+        y = apply_rope(x, freqs)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(x), axis=-1),
+            np.linalg.norm(np.asarray(y), axis=-1),
+            rtol=1e-4,
+        )
+
+    def test_positions_offset(self):
+        freqs = rope_frequencies(64, 128)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 4, 2, 64))
+        a = apply_rope(x, freqs)  # positions 0..3
+        pos = jnp.arange(4)[None, :]
+        b = apply_rope(x, freqs, positions=pos)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+        shifted = apply_rope(x, freqs, positions=pos + 10)
+        assert not np.allclose(np.asarray(a), np.asarray(shifted))
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("hq,hkv", [(4, 4), (8, 2)])
+    def test_matches_reference_causal(self, hq, hkv):
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(key, (2, 256, hq, 64))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 256, hkv, 64))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 256, hkv, 64))
+        ref = attention_reference(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    def test_non_causal(self):
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 128, 2, 32))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 128, 2, 32))
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 128, 2, 32))
+        ref = attention_reference(q, k, v, causal=False)
+        out = flash_attention(q, k, v, causal=False, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    def test_decode_offset_reference(self):
+        # 1 query token attending over 16-token prefix
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 4, 32))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 16, 4, 32))
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 16, 4, 32))
+        full = attention_reference(q, k, v, causal=True, q_offset=15)
+        # position 15 sees all 16 keys -> equals non-causal
+        nc = attention_reference(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(full), np.asarray(nc), rtol=1e-5)
+
+
+class TestLlama:
+    def test_forward_shapes_and_determinism(self):
+        cfg = llama_tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+        logits, _ = forward(params, tokens, cfg)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        logits2, _ = forward(params, tokens, cfg)
+        np.testing.assert_array_equal(np.asarray(logits), np.asarray(logits2))
+
+    def test_cached_decode_matches_full_forward(self):
+        cfg = llama_tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 12), 0, cfg.vocab_size)
+        full_logits, _ = forward(params, tokens, cfg)
+
+        cache = init_cache(cfg, 1, capacity=32)
+        prefill, cache = forward(
+            params, tokens[:, :8], cfg, cache=cache,
+            positions=jnp.arange(8)[None, :],
+        )
+        np.testing.assert_allclose(
+            np.asarray(prefill), np.asarray(full_logits[:, :8]), rtol=2e-3, atol=2e-3
+        )
+        # decode the remaining 4 tokens one at a time
+        outs = []
+        for i in range(8, 12):
+            step_logits, cache = forward(
+                params, tokens[:, i : i + 1], cfg, cache=cache,
+                positions=jnp.array([[i]]),
+            )
+            outs.append(step_logits)
+        decode = jnp.concatenate(outs, axis=1)
+        np.testing.assert_allclose(
+            np.asarray(decode), np.asarray(full_logits[:, 8:]), rtol=2e-3, atol=2e-3
+        )
+
+    def test_greedy_generate(self):
+        cfg = llama_tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size)
+        toks = greedy_generate(params, prompt, cfg, max_new_tokens=5)
+        assert toks.shape == (2, 5)
+        assert int(toks.min()) >= 0 and int(toks.max()) < cfg.vocab_size
+
+    def test_param_count_8b_in_range(self):
+        from bobrapet_tpu.models.llama import llama3_8b
+
+        n = llama3_8b().param_count
+        assert 7.5e9 < n < 8.5e9
+
+
+class TestSharding:
+    def test_build_mesh_axes(self):
+        mesh = build_mesh({"data": 2, "model": 4})
+        assert mesh.shape == {"data": 2, "model": 4}
+        mesh2 = build_mesh({"data": 1, "model": 4})  # first axis absorbs
+        assert mesh2.shape == {"data": 2, "model": 4}
+
+    def test_sharded_forward_matches_single_device(self):
+        cfg = llama_tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size)
+        ref, _ = forward(params, tokens, cfg)
+
+        mesh = build_mesh({"data": 2, "model": 4})
+        sharded = shard_params(params, mesh)
+        tok_sharded = jax.device_put(tokens, NamedSharding(mesh, P("data")))
+
+        @jax.jit
+        def run(p, t):
+            logits, _ = forward(p, t, cfg)
+            return logits
+
+        out = run(sharded, tok_sharded)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+    def test_param_specs_cover_tree(self):
+        cfg = llama_tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        mesh = build_mesh({"data": 2, "model": 4})
+        specs = llama_param_specs(params, mesh)
+        p_leaves = jax.tree_util.tree_leaves(params)
+        s_leaves = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        assert len(p_leaves) == len(s_leaves)
+
+
+class TestTrainStep:
+    def test_train_step_with_remat_and_ring(self):
+        import optax
+        from bobrapet_tpu.parallel.train import (
+            init_sharded_train_state,
+            make_token_batch,
+            make_train_step,
+        )
+
+        cfg = llama_tiny(vocab_size=128, max_seq_len=64)
+        devs = np.array(jax.devices()).reshape(2, 1, 2, 2)
+        mesh = Mesh(devs, ("data", "fsdp", "model", "seq"))
+        with mesh:
+            params, opt_state, opt = init_sharded_train_state(
+                jax.random.PRNGKey(0), cfg, mesh, optax.adamw(1e-3)
+            )
+            step = make_train_step(cfg, mesh, optimizer=opt, remat=True)
+            tokens = make_token_batch(jax.random.PRNGKey(1), cfg, 4, 32, mesh)
+            params, opt_state, loss = step(params, opt_state, tokens)
+        assert np.isfinite(float(loss))
+
+    def test_token_batch_sequence_sharding_flag(self):
+        from bobrapet_tpu.parallel.train import make_token_batch
+        from jax.sharding import PartitionSpec
+
+        cfg = llama_tiny()
+        devs = np.array(jax.devices()).reshape(2, 4)
+        mesh = Mesh(devs, ("data", "seq"))
+        t = make_token_batch(jax.random.PRNGKey(0), cfg, 2, 31, mesh, sequence_sharded=True)
+        assert t.sharding.spec == PartitionSpec("data", "seq")
+
+    def test_generate_capacity_guard(self):
+        cfg = llama_tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.zeros((1, 16), jnp.int32)
+        with pytest.raises(ValueError, match="exceeds cache capacity"):
+            greedy_generate(params, prompt, cfg, max_new_tokens=8, cache_capacity=16)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2)])
+    def test_matches_reference_over_8_shards(self, hq, hkv):
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("seq",))
+        S = 64  # 8 tokens per device
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, S, hq, 32))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, S, hkv, 32))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, S, hkv, 32))
+        ref = attention_reference(q, k, v, causal=True)
+        out = ring_attention(q, k, v, mesh, axis_name="seq", causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    def test_non_causal(self):
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("seq",))
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 32, 2, 16))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 32, 2, 16))
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 32, 2, 16))
+        ref = attention_reference(q, k, v, causal=False)
+        out = ring_attention(q, k, v, mesh, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    def test_ring_inside_llama_forward(self):
+        from bobrapet_tpu.parallel.ring_attention import make_ring_attn_fn
+
+        cfg = llama_tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 64), 0, cfg.vocab_size)
+        ref, _ = forward(params, tokens, cfg)
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("seq",))
+        attn = make_ring_attn_fn(mesh, "seq")
+        out, _ = forward(params, tokens, cfg, attn_fn=attn)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
