@@ -43,6 +43,7 @@ from ..api.runs import (
 from ..api.story import Step, StorySpec
 from ..core.object import Resource
 from ..core.store import ResourceStore
+from ..observability.metrics import metrics
 from ..storage.manager import StorageManager
 from ..templating.engine import (
     EvaluationBlocked,
@@ -87,10 +88,26 @@ class DAGEngine:
         self.storage = storage
         self.recorder = recorder
         self.clock = clock or Clock()
+        self._launched_this_pass = 0
 
     # ------------------------------------------------------------------
     def run(self, run: Resource, story: StorySpec) -> Optional[float]:
         """One DAG reconcile pass. Returns requeue delay or None."""
+        before = run.status.get("phase")
+        result = self._run(run, story)
+        after = run.status.get("phase")
+        if after != before and after and Phase(after).is_terminal:
+            metrics.storyrun_total.inc(after)
+            started = run.status.get("startedAt")
+            finished = run.status.get("finishedAt")
+            if started is not None and finished is not None:
+                story_name = (run.spec.get("storyRef") or {}).get("name", "")
+                metrics.storyrun_duration.observe(
+                    float(finished) - float(started), story_name
+                )
+        return result
+
+    def _run(self, run: Resource, story: StorySpec) -> Optional[float]:
         status = run.status
         status.setdefault("phase", str(Phase.RUNNING))
         status.setdefault("dagPhase", DAG_PHASE_MAIN)
@@ -104,19 +121,23 @@ class DAGEngine:
 
         # bounded iteration (reference: <= steps+1, runDagIterations:381)
         total_steps = len(story.all_steps()) + 1
-        for _ in range(total_steps + 1):
-            progressed = self._sync_timers(run, story)
-            if status.get(STOP_KEY):
-                self._advance_to_finally_or_finalize(run, story, stop=True)
-            phase_steps = self._current_phase_steps(run, story)
-            progressed |= self._apply_skips(run, story, phase_steps)
-            progressed |= self._launch_ready(run, story, phase_steps)
-            if self._maybe_advance_phase(run, story):
-                progressed = True
-            if Phase(status["phase"]).is_terminal:
-                return None
-            if not progressed:
-                break
+        self._launched_this_pass = 0
+        try:
+            for _ in range(total_steps + 1):
+                progressed = self._sync_timers(run, story)
+                if status.get(STOP_KEY):
+                    self._advance_to_finally_or_finalize(run, story, stop=True)
+                phase_steps = self._current_phase_steps(run, story)
+                progressed |= self._apply_skips(run, story, phase_steps)
+                progressed |= self._launch_ready(run, story, phase_steps)
+                if self._maybe_advance_phase(run, story):
+                    progressed = True
+                if Phase(status["phase"]).is_terminal:
+                    return None
+                if not progressed:
+                    break
+        finally:
+            metrics.dag_iterations.observe(self._launched_this_pass)
 
         return self._next_wakeup(run, story)
 
@@ -468,6 +489,7 @@ class DAGEngine:
                 )
             run.status.pop("placementWaiting", None)
             states[step.name] = state.to_dict()
+            self._launched_this_pass += 1
             progressed = True
             if run.status.get(STOP_KEY):
                 break  # a stop primitive halts further launches immediately

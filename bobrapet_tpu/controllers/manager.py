@@ -25,6 +25,7 @@ import time
 from typing import Callable, Iterable, Optional
 
 from ..core.store import ResourceStore, WatchEvent
+from ..observability.metrics import metrics
 
 _log = logging.getLogger(__name__)
 
@@ -183,12 +184,17 @@ class ControllerManager:
         fn = self._controllers.get(controller)
         if fn is None:
             return
+        started = time.monotonic()
         try:
             requeue_after = fn(ns, name)
+            metrics.reconcile_total.inc(controller, "success")
+            metrics.reconcile_duration.observe(time.monotonic() - started, controller)
             self._failures.pop(key, None)
             if requeue_after is not None and requeue_after >= 0:
                 self.enqueue(controller, ns, name, after=max(requeue_after, 1e-9))
         except Exception:  # noqa: BLE001 - reconcile errors retry with backoff
+            metrics.reconcile_total.inc(controller, "error")
+            metrics.reconcile_duration.observe(time.monotonic() - started, controller)
             n = self._failures.get(key, 0) + 1
             self._failures[key] = n
             delay = jittered_backoff(n, self._requeue_base, self._requeue_max)

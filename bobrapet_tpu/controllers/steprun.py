@@ -35,6 +35,7 @@ from ..api.runs import STEP_RUN_KIND, STORY_RUN_KIND, parse_steprun
 from ..api.story import KIND as STORY_KIND, parse_story
 from ..core.events import EventRecorder
 from ..core.store import AlreadyExists, NotFound, ResourceStore
+from ..observability.metrics import metrics
 from ..sdk import contract
 from ..storage.manager import StorageManager
 from ..templating.engine import (
@@ -199,6 +200,7 @@ class StepRunController:
         if cache_enabled:
             ck = self._cache_key(cache_cfg, resolved_inputs, template, engram)
             hit = self._cache_read(ck)
+            metrics.steprun_cache_lookups.inc("hit" if hit is not None else "miss")
             if hit is not None:
                 def apply_hit(status: dict[str, Any]) -> None:
                     status["phase"] = str(Phase.SUCCEEDED)
@@ -206,6 +208,7 @@ class StepRunController:
                     status["cacheHit"] = True
                     status["finishedAt"] = self.clock.now()
                 self.store.patch_status(STEP_RUN_KIND, namespace, name, apply_hit)
+                self._observe_terminal(sr, str(Phase.SUCCEEDED))
                 self.recorder.normal(sr, "CacheHit", f"cache key {ck[:12]} hit")
                 return None
 
@@ -359,6 +362,7 @@ class StepRunController:
             status.pop("error", None)
 
         self.store.patch_status(STEP_RUN_KIND, namespace, name, finish)
+        self._observe_terminal(fresh, str(Phase.SUCCEEDED))
         return None
 
     def _handle_failure(self, sr, spec, resolved, exit_code, message):
@@ -388,6 +392,7 @@ class StepRunController:
                 status.pop("jobName", None)
 
             self.store.patch_status(STEP_RUN_KIND, namespace, name, schedule)
+            metrics.steprun_retries.inc(str(exit_class))
             self.recorder.warning(
                 sr, conditions.Reason.RETRY_SCHEDULED,
                 f"exit {exit_code} ({exit_class}); retry {consumed} in {delay:.1f}s",
@@ -418,7 +423,15 @@ class StepRunController:
             status["finishedAt"] = self.clock.now()
 
         self.store.patch_status(STEP_RUN_KIND, namespace, name, fail)
+        self._observe_terminal(fresh, str(phase))
         return None
+
+    def _observe_terminal(self, sr, phase: str) -> None:
+        metrics.steprun_total.inc(phase)
+        started = sr.status.get("startedAt")
+        if started is not None:
+            engram = (sr.spec.get("engramRef") or {}).get("name") or ""
+            metrics.steprun_duration.observe(self.clock.now() - float(started), engram)
 
     def _fail(self, sr, err: StructuredError):
         def fail(status: dict[str, Any]) -> None:
@@ -427,6 +440,7 @@ class StepRunController:
             status["finishedAt"] = self.clock.now()
 
         self.store.patch_status(STEP_RUN_KIND, sr.meta.namespace, sr.meta.name, fail)
+        self._observe_terminal(sr, str(Phase.FAILED))
         return None
 
     def _finish_canceled(self, sr):
@@ -443,6 +457,7 @@ class StepRunController:
             status["reason"] = conditions.Reason.CANCELED
 
         self.store.patch_status(STEP_RUN_KIND, sr.meta.namespace, sr.meta.name, cancel)
+        self._observe_terminal(sr, str(Phase.FINISHED))
         return None
 
     def _set_blocked(self, sr, reason: str, message: str):

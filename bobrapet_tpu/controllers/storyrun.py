@@ -25,6 +25,7 @@ from ..api.runs import STEP_RUN_KIND, STORY_RUN_KIND
 from ..api.story import KIND as STORY_KIND, parse_story
 from ..core.object import Resource
 from ..core.store import NotFound, ResourceStore
+from ..observability.metrics import metrics
 from ..storage.manager import StorageManager
 from ..utils.duration import parse_duration
 from .dag import INDEX_STEPRUN_STORYRUN, DAGEngine
@@ -204,7 +205,19 @@ class StoryRunController:
             status["finishedAt"] = self.clock.now()
 
         self.store.patch_status(STORY_RUN_KIND, run.meta.namespace, run.meta.name, patch)
+        self._observe_terminal(run, str(Phase.FAILED))
         return None
+
+    def _observe_terminal(self, run: Resource, phase: str) -> None:
+        """Terminal transitions made outside the DAG engine (validation
+        failures, cancel force-finish) still count toward the run series."""
+        metrics.storyrun_total.inc(phase)
+        started = run.status.get("startedAt")
+        if started is not None:
+            story_name = (run.spec.get("storyRef") or {}).get("name", "")
+            metrics.storyrun_duration.observe(
+                self.clock.now() - float(started), story_name
+            )
 
     # ------------------------------------------------------------------
     # graceful cancel
@@ -249,6 +262,8 @@ class StoryRunController:
                 status["finishedAt"] = self.clock.now()
 
             self.store.patch_status(STORY_RUN_KIND, ns, name, finish)
+            metrics.storyrun_cancellations.inc()
+            self._observe_terminal(run, str(Phase.FINISHED))
             return None
         return min(1.0, max(0.1, drain - (now - started)))
 

@@ -1,0 +1,34 @@
+"""Observability: metrics, structured logging, tracing.
+
+The equivalent of the reference's layer-5 observability stack
+(reference: pkg/metrics/controller_metrics.go, pkg/logging/structured.go,
+pkg/observability/{exporter,tracing}.go). Self-contained — no Prometheus
+or OTel client dependency; exposition is text-format compatible and the
+tracer persists span context into resource status the same way the
+reference stitches controller<->SDK traces (api/runs/v1alpha1/trace_types.go:20).
+"""
+
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+    metrics,
+)
+from .structured import (  # noqa: F401
+    ControllerLogger,
+    ReconcileLogger,
+    StepLogger,
+    TemplateLogger,
+    CleanupLogger,
+    LoggingFeatures,
+    FEATURES,
+)
+from .tracing import (  # noqa: F401
+    Span,
+    Tracer,
+    TracingConfig,
+    TRACER,
+    trace_info_from_span,
+)

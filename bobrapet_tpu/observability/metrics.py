@@ -1,0 +1,359 @@
+"""Metrics registry with Prometheus text exposition.
+
+The counterpart of the reference's ~45 ``bobrapet_*`` Prometheus series
+(reference: pkg/metrics/controller_metrics.go:44-442, transport.go:11-35).
+No client library: Counter/Gauge/Histogram are small thread-safe
+implementations and :meth:`MetricsRegistry.expose` renders the standard
+text format so the output can be scraped or asserted on in tests.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Iterable, Optional, Sequence
+
+_DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0, 120.0, 300.0, 600.0,
+)
+
+
+def _label_key(
+    names: Sequence[str], values: Sequence[str]
+) -> tuple[tuple[str, str], ...]:
+    if len(names) != len(values):
+        raise ValueError(f"expected labels {list(names)}, got {len(values)} values")
+    return tuple(zip(names, (str(v) for v in values)))
+
+
+def _render_labels(pairs: Iterable[tuple[str, str]]) -> str:
+    inner = ",".join(
+        f'{k}="{v.replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
+        for k, v in pairs
+    )
+    return f"{{{inner}}}" if inner else ""
+
+
+class _Metric:
+    type: str = ""
+
+    def __init__(self, name: str, help: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+    def reset(self) -> None:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def _expose_lines(self) -> list[str]:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def expose(self) -> str:
+        return "\n".join(
+            [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.type}"]
+            + self._expose_lines()
+        )
+
+
+class Counter(_Metric):
+    type = "counter"
+
+    def __init__(self, name: str, help: str, label_names: Sequence[str] = ()):
+        super().__init__(name, help, label_names)
+        self._values: dict[tuple[tuple[str, str], ...], float] = {}
+
+    def inc(self, *label_values: str, by: float = 1.0) -> None:
+        if by < 0:
+            raise ValueError("counters only go up")
+        key = _label_key(self.label_names, label_values)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + by
+
+    def value(self, *label_values: str) -> float:
+        key = _label_key(self.label_names, label_values)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    def _expose_lines(self) -> list[str]:
+        with self._lock:
+            return [
+                f"{self.name}{_render_labels(k)} {v}"
+                for k, v in sorted(self._values.items())
+            ]
+
+
+class Gauge(_Metric):
+    type = "gauge"
+
+    def __init__(self, name: str, help: str, label_names: Sequence[str] = ()):
+        super().__init__(name, help, label_names)
+        self._values: dict[tuple[tuple[str, str], ...], float] = {}
+
+    def set(self, value: float, *label_values: str) -> None:
+        key = _label_key(self.label_names, label_values)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def add(self, delta: float, *label_values: str) -> None:
+        key = _label_key(self.label_names, label_values)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + delta
+
+    def value(self, *label_values: str) -> float:
+        key = _label_key(self.label_names, label_values)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    def _expose_lines(self) -> list[str]:
+        with self._lock:
+            return [
+                f"{self.name}{_render_labels(k)} {v}"
+                for k, v in sorted(self._values.items())
+            ]
+
+
+class Histogram(_Metric):
+    type = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        label_names: Sequence[str] = (),
+        buckets: Sequence[float] = _DEFAULT_BUCKETS,
+    ):
+        super().__init__(name, help, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple[tuple[str, str], ...], list[int]] = {}
+        self._sums: dict[tuple[tuple[str, str], ...], float] = {}
+        self._totals: dict[tuple[tuple[str, str], ...], int] = {}
+
+    def observe(self, value: float, *label_values: str) -> None:
+        key = _label_key(self.label_names, label_values)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, *label_values: str) -> int:
+        key = _label_key(self.label_names, label_values)
+        with self._lock:
+            return self._totals.get(key, 0)
+
+    def sum(self, *label_values: str) -> float:
+        key = _label_key(self.label_names, label_values)
+        with self._lock:
+            return self._sums.get(key, 0.0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._sums.clear()
+            self._totals.clear()
+
+    def _expose_lines(self) -> list[str]:
+        with self._lock:
+            lines = []
+            for key in sorted(self._counts):
+                for bound, cnt in zip(self.buckets, self._counts[key]):
+                    b = "+Inf" if math.isinf(bound) else repr(bound)
+                    lines.append(
+                        f"{self.name}_bucket{_render_labels(key + (('le', b),))} {cnt}"
+                    )
+                lines.append(
+                    f"{self.name}_bucket{_render_labels(key + (('le', '+Inf'),))} "
+                    f"{self._totals[key]}"
+                )
+                lines.append(f"{self.name}_sum{_render_labels(key)} {self._sums[key]}")
+                lines.append(f"{self.name}_count{_render_labels(key)} {self._totals[key]}")
+            return lines
+
+
+class MetricsRegistry:
+    """Holds every metric family; renders one scrape page."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name: str, help: str, labels: Sequence[str] = ()) -> Counter:
+        return self.register(Counter(name, help, labels))  # type: ignore[return-value]
+
+    def gauge(self, name: str, help: str, labels: Sequence[str] = ()) -> Gauge:
+        return self.register(Gauge(name, help, labels))  # type: ignore[return-value]
+
+    def histogram(
+        self,
+        name: str,
+        help: str,
+        labels: Sequence[str] = (),
+        buckets: Sequence[float] = _DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self.register(Histogram(name, help, labels, buckets))  # type: ignore[return-value]
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def expose(self) -> str:
+        with self._lock:
+            families = list(self._metrics.values())
+        return "\n".join(m.expose() for m in sorted(families, key=lambda m: m.name)) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            families = list(self._metrics.values())
+        for m in families:
+            m.reset()
+
+
+REGISTRY = MetricsRegistry()
+
+
+class _ControlPlaneMetrics:
+    """The named series the controllers record into — one attribute per
+    family, mirroring the reference's inventory
+    (reference: pkg/metrics/controller_metrics.go:44-442)."""
+
+    def __init__(self, reg: MetricsRegistry) -> None:
+        c, g, h = reg.counter, reg.gauge, reg.histogram
+        # StoryRun family
+        self.storyrun_total = c(
+            "bobrapet_storyrun_total", "StoryRuns by terminal phase", ["phase"]
+        )
+        self.storyrun_duration = h(
+            "bobrapet_storyrun_duration_seconds", "StoryRun wall-clock", ["story"]
+        )
+        self.storyrun_active_steps = g(
+            "bobrapet_storyrun_active_steps", "Running steps per story", ["story"]
+        )
+        self.storyrun_queue_age = h(
+            "bobrapet_storyrun_queue_age_seconds", "Time runs wait in queue", ["queue"]
+        )
+        self.storyrun_queue_depth = g(
+            "bobrapet_storyrun_queue_depth", "Runs waiting per queue", ["queue"]
+        )
+        self.storyrun_redrives = c(
+            "bobrapet_storyrun_redrives_total", "Redrive requests", ["mode"]
+        )
+        self.storyrun_cancellations = c(
+            "bobrapet_storyrun_cancellations_total", "Graceful cancels", []
+        )
+        # StepRun family
+        self.steprun_total = c(
+            "bobrapet_steprun_total", "StepRuns by terminal phase", ["phase"]
+        )
+        self.steprun_duration = h(
+            "bobrapet_steprun_duration_seconds", "StepRun wall-clock", ["engram"]
+        )
+        self.steprun_retries = c(
+            "bobrapet_steprun_retries_total", "Retry attempts", ["exit_class"]
+        )
+        self.steprun_cache_lookups = c(
+            "bobrapet_steprun_cache_lookups_total", "Cache probes", ["result"]
+        )
+        self.steprun_blocked = g(
+            "bobrapet_steprun_blocked", "StepRuns in Blocked phase", []
+        )
+        # DAG family
+        self.dag_iterations = h(
+            "bobrapet_dag_iteration_steps",
+            "Steps launched per DAG reconcile",
+            [],
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128),
+        )
+        self.dag_substory_refreshes = c(
+            "bobrapet_dag_substory_refreshes_total", "Sub-story status refreshes", []
+        )
+        # Templating family
+        self.template_evaluations = c(
+            "bobrapet_template_evaluations_total", "Template evaluations", ["outcome"]
+        )
+        self.template_eval_duration = h(
+            "bobrapet_template_evaluation_duration_seconds",
+            "Template evaluation latency",
+            [],
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
+        )
+        # Job / gang execution
+        self.job_executions = c(
+            "bobrapet_job_executions_total", "Gang job launches", ["outcome"]
+        )
+        self.gang_chips_in_use = g(
+            "bobrapet_gang_chips_in_use", "TPU chips currently granted", []
+        )
+        self.slice_placements = c(
+            "bobrapet_slice_placements_total", "Sub-mesh placement decisions", ["outcome"]
+        )
+        # Transport family (reference: pkg/metrics/transport.go:11-35)
+        self.binding_ops = c(
+            "bobrapet_transport_binding_ops_total", "Binding create/update ops", ["op"]
+        )
+        self.bindings_by_state = g(
+            "bobrapet_transport_bindings", "Bindings by state", ["state"]
+        )
+        self.stream_messages = c(
+            "bobravoz_grpc_messages_total", "Stream messages", ["direction"]
+        )
+        self.stream_dropped = c(
+            "bobravoz_grpc_messages_dropped_total", "Messages dropped", ["reason"]
+        )
+        # Storage family
+        self.storage_ops = c(
+            "bobrapet_storage_ops_total", "Blob store operations", ["op", "outcome"]
+        )
+        self.storage_offloaded_bytes = c(
+            "bobrapet_storage_offloaded_bytes_total", "Bytes dehydrated to storage", []
+        )
+        # Trigger / admission family
+        self.trigger_decisions = c(
+            "bobrapet_trigger_decisions_total", "StoryTrigger decisions", ["decision"]
+        )
+        self.trigger_backfills = c(
+            "bobrapet_trigger_backfills_total", "Token backfill passes", ["kind"]
+        )
+        # Cleanup / retention
+        self.cleanup_ops = c(
+            "bobrapet_cleanup_ops_total", "Retention cleanups", ["kind"]
+        )
+        # Config resolver stage timings (reference: internal/config/chain/chain.go)
+        self.resolver_stage_duration = h(
+            "bobrapet_resolver_stage_duration_seconds",
+            "Per-stage config resolution time",
+            ["stage"],
+            buckets=(0.00001, 0.0001, 0.001, 0.01, 0.1),
+        )
+        # Reconcile machinery
+        self.reconcile_total = c(
+            "bobrapet_reconcile_total", "Reconcile invocations", ["controller", "outcome"]
+        )
+        self.reconcile_duration = h(
+            "bobrapet_reconcile_duration_seconds", "Reconcile latency", ["controller"]
+        )
+        self.mapper_failures = c(
+            "bobrapet_mapper_failures_total", "Watch-mapper errors", ["controller"]
+        )
+
+
+metrics = _ControlPlaneMetrics(REGISTRY)

@@ -1,0 +1,110 @@
+"""Typed structured loggers per domain.
+
+The counterpart of the reference's zap wrappers
+(reference: pkg/logging/structured.go:35-305 — ControllerLogger,
+ReconcileLogger, StepLogger, CELLogger, CleanupLogger) plus the global
+feature toggles (pkg/logging/features.go:20-35 — verbosity and
+step-output logging, driven by operator config).
+
+Built on stdlib ``logging``: every wrapper binds stable key=value context
+so each line carries resource identity without the call sites repeating
+it. ``FEATURES`` holds process-wide toggles the operator config manager
+updates live.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+
+class LoggingFeatures:
+    """Process-wide toggles (reference: pkg/logging/features.go)."""
+
+    def __init__(self) -> None:
+        self.verbosity = 0
+        self.log_step_output = False
+
+    def apply(self, verbosity: int, log_step_output: bool) -> None:
+        self.verbosity = verbosity
+        self.log_step_output = log_step_output
+        root = logging.getLogger("bobrapet_tpu")
+        root.setLevel(logging.DEBUG if verbosity >= 2 else logging.INFO)
+
+
+FEATURES = LoggingFeatures()
+
+
+def _fmt(kv: dict[str, Any]) -> str:
+    return " ".join(f"{k}={v}" for k, v in kv.items())
+
+
+class _BoundLogger:
+    domain = "core"
+
+    def __init__(self, name: str, **context: Any):
+        self._log = logging.getLogger(f"bobrapet_tpu.{self.domain}.{name}")
+        self._ctx = dict(context)
+
+    def with_values(self, **context: Any) -> "_BoundLogger":
+        out = type(self)(self._log.name.rsplit(".", 1)[-1], **self._ctx)
+        out._ctx.update(context)
+        return out
+
+    def _emit(self, level: int, msg: str, kv: dict[str, Any]) -> None:
+        merged = {**self._ctx, **kv}
+        self._log.log(level, "%s %s", msg, _fmt(merged) if merged else "")
+
+    def debug(self, msg: str, **kv: Any) -> None:
+        if FEATURES.verbosity >= 1:
+            self._emit(logging.DEBUG, msg, kv)
+
+    def info(self, msg: str, **kv: Any) -> None:
+        self._emit(logging.INFO, msg, kv)
+
+    def warn(self, msg: str, **kv: Any) -> None:
+        self._emit(logging.WARNING, msg, kv)
+
+    def error(self, msg: str, **kv: Any) -> None:
+        self._emit(logging.ERROR, msg, kv)
+
+
+class ControllerLogger(_BoundLogger):
+    domain = "controller"
+
+
+class ReconcileLogger(_BoundLogger):
+    """Bound to one reconcile invocation (controller + object identity)."""
+
+    domain = "reconcile"
+
+    def __init__(
+        self,
+        name: str,
+        namespace: Optional[str] = None,
+        obj: Optional[str] = None,
+        **context: Any,
+    ):
+        if namespace is not None:
+            context.setdefault("namespace", namespace)
+        if obj is not None:
+            context.setdefault("object", obj)
+        super().__init__(name, **context)
+
+
+class StepLogger(_BoundLogger):
+    """Bound to one step of one run; honors the step-output toggle."""
+
+    domain = "step"
+
+    def step_output(self, output: Any, **kv: Any) -> None:
+        if FEATURES.log_step_output:
+            self._emit(logging.INFO, f"step output: {output!r}", kv)
+
+
+class TemplateLogger(_BoundLogger):
+    domain = "templating"
+
+
+class CleanupLogger(_BoundLogger):
+    domain = "cleanup"

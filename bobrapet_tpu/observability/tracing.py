@@ -1,0 +1,171 @@
+"""Feature-gated tracing with status-persisted span context.
+
+The counterpart of the reference's OTel wiring
+(reference: pkg/observability/exporter.go:33-89 ConfigureTracing /
+InitTracerProvider, tracing.go:65 StartSpan) and its trick of persisting
+trace context into CR status so spans stitch across the
+controller<->SDK process boundary
+(reference: api/runs/v1alpha1/trace_types.go:20, pkg/runs/status/trace.go).
+
+No OTel dependency: spans are recorded into an in-memory exporter with
+W3C-traceparent-shaped ids, which is what tests and the local runtime
+need; a real OTLP exporter would slot in behind :class:`SpanExporter`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional
+
+
+def _new_trace_id() -> str:
+    return secrets.token_hex(16)
+
+
+def _new_span_id() -> str:
+    return secrets.token_hex(8)
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_span_id: Optional[str] = None
+    start_time: float = 0.0
+    end_time: Optional[float] = None
+    attributes: dict[str, Any] = field(default_factory=dict)
+    status: str = "ok"
+    events: list[tuple[float, str]] = field(default_factory=list)
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def add_event(self, message: str) -> None:
+        self.events.append((time.time(), message))
+
+    def record_error(self, err: BaseException) -> None:
+        self.status = "error"
+        self.attributes["error.message"] = str(err)
+        self.attributes["error.type"] = type(err).__name__
+
+    @property
+    def duration(self) -> Optional[float]:
+        if self.end_time is None:
+            return None
+        return self.end_time - self.start_time
+
+
+class SpanExporter:
+    def export(self, span: Span) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def shutdown(self) -> None:  # pragma: no cover - interface
+        pass
+
+
+class InMemorySpanExporter(SpanExporter):
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    @property
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def by_trace(self, trace_id: str) -> list[Span]:
+        return [s for s in self.spans if s.trace_id == trace_id]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+@dataclass
+class TracingConfig:
+    """(reference: telemetry toggles, pkg/observability/tracing.go:41)"""
+
+    enabled: bool = False
+    propagation_enabled: bool = True
+    service_name: str = "bobrapet-tpu"
+
+
+class Tracer:
+    """Start feature-gated spans; a disabled tracer costs one branch."""
+
+    def __init__(
+        self,
+        config: Optional[TracingConfig] = None,
+        exporter: Optional[SpanExporter] = None,
+    ):
+        self.config = config or TracingConfig()
+        self.exporter = exporter or InMemorySpanExporter()
+        self._local = threading.local()
+
+    # -- context management ------------------------------------------------
+    def _current(self) -> Optional[Span]:
+        return getattr(self._local, "span", None)
+
+    @contextlib.contextmanager
+    def start_span(
+        self,
+        name: str,
+        parent: Optional[Span] = None,
+        trace_context: Optional[dict[str, Any]] = None,
+        **attributes: Any,
+    ) -> Iterator[Optional[Span]]:
+        """Open a span; a no-op (yields None) when tracing is disabled.
+
+        ``trace_context`` resumes a trace persisted in resource status
+        (the cross-process stitch); ``parent`` nests within this process.
+        """
+        if not self.config.enabled:
+            yield None
+            return
+        parent = parent or self._current()
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif trace_context and self.config.propagation_enabled and trace_context.get("traceId"):
+            trace_id = trace_context["traceId"]
+            parent_id = trace_context.get("spanId")
+        else:
+            trace_id, parent_id = _new_trace_id(), None
+        span = Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=_new_span_id(),
+            parent_span_id=parent_id,
+            start_time=time.time(),
+            attributes=dict(attributes),
+        )
+        prev = self._current()
+        self._local.span = span
+        try:
+            yield span
+        except BaseException as e:
+            span.record_error(e)
+            raise
+        finally:
+            span.end_time = time.time()
+            self._local.span = prev
+            self.exporter.export(span)
+
+
+def trace_info_from_span(span: Optional[Span]) -> Optional[dict[str, Any]]:
+    """Build the status-persisted trace context
+    (reference: TraceInfo, api/runs/v1alpha1/trace_types.go:20)."""
+    if span is None:
+        return None
+    return {"traceId": span.trace_id, "spanId": span.span_id, "sampled": True}
+
+
+TRACER = Tracer()

@@ -28,10 +28,13 @@ Python objects — attributes are dict-key lookups only.
 from __future__ import annotations
 
 import ast
+import contextlib
 import dataclasses
 import re
 import time
 from typing import Any, Iterable, Optional
+
+from ..observability.metrics import metrics
 
 # ---------------------------------------------------------------------------
 # Errors
@@ -183,6 +186,34 @@ def _now() -> float:
     return time.time()
 
 
+@contextlib.contextmanager
+def _observed():
+    """Record evaluation count + latency
+    (reference: bobrapet_cel_evaluation_* series, controller_metrics.go:246).
+
+    Offloaded-data and evaluation-blocked signals are expected control
+    flow (policies resolve them and re-evaluate), so they get their own
+    outcomes instead of inflating the error rate.
+    """
+    started = time.monotonic()
+    try:
+        yield
+    except OffloadedDataUsage:
+        metrics.template_evaluations.inc("offloaded")
+        metrics.template_eval_duration.observe(time.monotonic() - started)
+        raise
+    except EvaluationBlocked:
+        metrics.template_evaluations.inc("blocked")
+        metrics.template_eval_duration.observe(time.monotonic() - started)
+        raise
+    except Exception:
+        metrics.template_evaluations.inc("error")
+        metrics.template_eval_duration.observe(time.monotonic() - started)
+        raise
+    metrics.template_evaluations.inc("success")
+    metrics.template_eval_duration.observe(time.monotonic() - started)
+
+
 class Evaluator:
     """Evaluates template strings/values against a scope.
 
@@ -205,9 +236,10 @@ class Evaluator:
         """Recursively evaluate templates inside a JSON-like value
         (the `with` block / output template evaluation)."""
         deadline = _now() + self.config.evaluation_timeout
-        result = self._eval_value(value, scope, deadline)
-        self._check_output_size(result)
-        return result
+        with _observed():
+            result = self._eval_value(value, scope, deadline)
+            self._check_output_size(result)
+            return result
 
     def evaluate_string(self, text: str, scope: dict[str, Any]) -> Any:
         """Evaluate one (possibly templated) string.
@@ -216,7 +248,8 @@ class Evaluator:
         native value; mixed text interpolates string renderings.
         """
         deadline = _now() + self.config.evaluation_timeout
-        return self._eval_string(text, scope, deadline)
+        with _observed():
+            return self._eval_string(text, scope, deadline)
 
     def evaluate_condition(self, expr: str, scope: dict[str, Any]) -> bool:
         """Evaluate an ``if`` condition to a bool
@@ -229,11 +262,12 @@ class Evaluator:
         if single is not None:
             text = single
         deadline = _now() + self.config.evaluation_timeout
-        value = self._eval_expression(text, scope, deadline)
-        if is_storage_ref(value):
-            raise OffloadedDataUsage(
-                "condition evaluates to offloaded data", [value[STORAGE_REF_KEY]]
-            )
+        with _observed():
+            value = self._eval_expression(text, scope, deadline)
+            if is_storage_ref(value):
+                raise OffloadedDataUsage(
+                    "condition evaluates to offloaded data", [value[STORAGE_REF_KEY]]
+                )
         return self._truthy(value)  # Missing values are falsy, not truthy objects
 
     # -- static analysis ---------------------------------------------------

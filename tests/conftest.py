@@ -31,3 +31,20 @@ import pytest  # noqa: E402
 @pytest.fixture
 def tmp_store_dir(tmp_path):
     return str(tmp_path / "store")
+
+
+@pytest.fixture
+def rt():
+    from bobrapet_tpu.runtime import Runtime
+
+    return Runtime()
+
+
+@pytest.fixture(autouse=True)
+def _shared_clean_registry():
+    yield
+    from bobrapet_tpu.sdk.registry import clear_registry
+    from bobrapet_tpu.observability.metrics import REGISTRY
+
+    clear_registry()
+    REGISTRY.reset()

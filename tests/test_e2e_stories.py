@@ -11,18 +11,7 @@ from bobrapet_tpu.api.catalog import make_engram_template
 from bobrapet_tpu.api.engram import make_engram
 from bobrapet_tpu.api.story import make_story
 from bobrapet_tpu.runtime import Runtime
-from bobrapet_tpu.sdk import EngramExit, clear_registry, register_engram
-
-
-@pytest.fixture(autouse=True)
-def _clean_registry():
-    yield
-    clear_registry()
-
-
-@pytest.fixture
-def rt():
-    return Runtime()
+from bobrapet_tpu.sdk import EngramExit, register_engram
 
 
 def setup_engram(rt, name="worker", entrypoint_name=None, **template_fields):

@@ -1,0 +1,191 @@
+"""Observability layer: metrics families, exposition, tracing, logging.
+
+Mirrors the reference's observability surface (SURVEY §5.5: ~45
+bobrapet_* Prometheus series pkg/metrics/controller_metrics.go; §5.1:
+OTel spans with status-persisted TraceInfo trace_types.go:20).
+"""
+
+import pytest
+
+from bobrapet_tpu.observability import (
+    FEATURES,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    StepLogger,
+    Tracer,
+    TracingConfig,
+    metrics,
+    trace_info_from_span,
+)
+from bobrapet_tpu.observability.tracing import InMemorySpanExporter
+
+
+class TestMetricPrimitives:
+    def test_counter_labels(self):
+        c = Counter("test_total", "help", ["phase"])
+        c.inc("Succeeded")
+        c.inc("Succeeded", by=2)
+        c.inc("Failed")
+        assert c.value("Succeeded") == 3
+        assert c.value("Failed") == 1
+        assert c.value("Missing") == 0
+
+    def test_counter_rejects_negative(self):
+        c = Counter("test_total", "help")
+        with pytest.raises(ValueError):
+            c.inc(by=-1)
+
+    def test_counter_label_arity_enforced(self):
+        c = Counter("test_total", "help", ["a", "b"])
+        with pytest.raises(ValueError):
+            c.inc("only-one")
+
+    def test_gauge_set_add(self):
+        g = Gauge("test_gauge", "help", ["queue"])
+        g.set(5, "q1")
+        g.add(-2, "q1")
+        assert g.value("q1") == 3
+
+    def test_histogram_buckets_and_sum(self):
+        h = Histogram("test_seconds", "help", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        assert h.count() == 4
+        assert h.sum() == pytest.approx(55.55)
+        text = h.expose()
+        assert 'test_seconds_bucket{le="0.1"} 1' in text
+        assert 'test_seconds_bucket{le="1.0"} 2' in text
+        assert 'test_seconds_bucket{le="+Inf"} 4' in text
+
+    def test_exposition_format(self):
+        reg = MetricsRegistry()
+        c = reg.counter("x_total", "an x", ["k"])
+        c.inc("v")
+        page = reg.expose()
+        assert "# HELP x_total an x" in page
+        assert "# TYPE x_total counter" in page
+        assert 'x_total{k="v"} 1.0' in page
+
+    def test_registry_dedupes_by_name(self):
+        reg = MetricsRegistry()
+        a = reg.counter("same_total", "h")
+        b = reg.counter("same_total", "h")
+        assert a is b
+
+
+class TestControlPlaneFamilies:
+    def test_reference_series_present(self):
+        # spot-check the reference inventory (controller_metrics.go:44-442)
+        for name in [
+            "bobrapet_storyrun_duration_seconds",
+            "bobrapet_storyrun_queue_depth",
+            "bobrapet_steprun_retries_total",
+            "bobrapet_steprun_cache_lookups_total",
+            "bobrapet_dag_iteration_steps",
+            "bobrapet_template_evaluation_duration_seconds",
+            "bobravoz_grpc_messages_total",
+            "bobrapet_trigger_decisions_total",
+            "bobrapet_reconcile_duration_seconds",
+        ]:
+            assert REGISTRY.get(name) is not None, name
+
+    def test_controllers_record_metrics(self, rt):
+        REGISTRY.reset()
+        rt.apply(make_engram_template("obs-tpl", entrypoint="obs-impl"))
+        rt.apply(_mk_engram("obs-engram", "obs-tpl"))
+        register_engram("obs-impl")(lambda ctx: {"ok": True})
+        rt.apply(
+            _mk_story(
+                "obs-story",
+                steps=[{"name": "only", "ref": {"name": "obs-engram"},
+                        "with": {"v": "{{ inputs.v }}"}}],
+            )
+        )
+        run = rt.run_story("obs-story", inputs={"v": 1})
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert metrics.storyrun_total.value("Succeeded") >= 1
+        assert metrics.steprun_total.value("Succeeded") >= 1
+        assert metrics.dag_iterations.count() >= 1
+        assert metrics.template_evaluations.value("success") >= 1
+        assert metrics.reconcile_total.value("storyrun", "success") >= 1
+        page = REGISTRY.expose()
+        assert 'bobrapet_storyrun_total{phase="Succeeded"}' in page
+
+
+class TestTracing:
+    def test_disabled_tracer_yields_none(self):
+        t = Tracer(TracingConfig(enabled=False))
+        with t.start_span("x") as span:
+            assert span is None
+
+    def test_span_nesting_same_trace(self):
+        exp = InMemorySpanExporter()
+        t = Tracer(TracingConfig(enabled=True), exporter=exp)
+        with t.start_span("parent") as parent:
+            with t.start_span("child") as child:
+                assert child.trace_id == parent.trace_id
+                assert child.parent_span_id == parent.span_id
+        spans = exp.spans
+        assert [s.name for s in spans] == ["child", "parent"]
+        assert all(s.end_time is not None for s in spans)
+
+    def test_trace_context_resume_across_process_boundary(self):
+        # the reference persists TraceInfo into CR status so SDK spans
+        # stitch onto the controller trace (trace_types.go:20)
+        exp = InMemorySpanExporter()
+        t = Tracer(TracingConfig(enabled=True), exporter=exp)
+        with t.start_span("controller") as s:
+            info = trace_info_from_span(s)
+        assert info["traceId"] == s.trace_id and info["sampled"]
+        with t.start_span("sdk-side", trace_context=info) as resumed:
+            assert resumed.trace_id == info["traceId"]
+            assert resumed.parent_span_id == info["spanId"]
+
+    def test_error_recorded(self):
+        exp = InMemorySpanExporter()
+        t = Tracer(TracingConfig(enabled=True), exporter=exp)
+        with pytest.raises(RuntimeError):
+            with t.start_span("boom"):
+                raise RuntimeError("nope")
+        (span,) = exp.spans
+        assert span.status == "error"
+        assert span.attributes["error.type"] == "RuntimeError"
+
+    def test_propagation_toggle(self):
+        t = Tracer(TracingConfig(enabled=True, propagation_enabled=False))
+        ctx = {"traceId": "a" * 32, "spanId": "b" * 16}
+        with t.start_span("x", trace_context=ctx) as span:
+            assert span.trace_id != ctx["traceId"]
+
+
+class TestLoggingFeatures:
+    def test_step_output_gated(self, caplog):
+        log = StepLogger("test", step="s1")
+        FEATURES.apply(verbosity=0, log_step_output=False)
+        with caplog.at_level("INFO", logger="bobrapet_tpu"):
+            log.step_output({"big": "payload"})
+        assert not caplog.records
+        FEATURES.apply(verbosity=0, log_step_output=True)
+        with caplog.at_level("INFO", logger="bobrapet_tpu"):
+            log.step_output({"big": "payload"})
+        assert any("payload" in r.getMessage() for r in caplog.records)
+        FEATURES.apply(verbosity=0, log_step_output=False)
+
+    def test_bound_context_in_lines(self, caplog):
+        log = StepLogger("test", step="s1").with_values(run="r1")
+        with caplog.at_level("INFO", logger="bobrapet_tpu"):
+            log.info("hello", extra_key="v")
+        line = caplog.records[-1].getMessage()
+        assert "step=s1" in line and "run=r1" in line and "extra_key=v" in line
+
+
+# -- helpers -----------------------------------------------------------------
+
+from bobrapet_tpu.api.catalog import make_engram_template  # noqa: E402
+from bobrapet_tpu.api.engram import make_engram as _mk_engram  # noqa: E402
+from bobrapet_tpu.api.story import make_story as _mk_story  # noqa: E402
+from bobrapet_tpu.sdk.registry import register_engram  # noqa: E402
