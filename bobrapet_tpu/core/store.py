@@ -106,6 +106,7 @@ class ResourceStore:
         self._index_buckets: dict[tuple[str, str], dict[str, set[tuple[str, str, str]]]] = {}
         self._defaulters: dict[str, list[Defaulter]] = {}
         self._validators: dict[str, list[Validator]] = {}
+        self._status_validators: dict[str, list[Validator]] = {}
         self._pending_events: deque[WatchEvent] = deque()
         self._draining = False
         self._persist_dir = persist_dir
@@ -119,6 +120,12 @@ class ResourceStore:
 
     def register_validator(self, kind: str, fn: Validator) -> None:
         self._validators.setdefault(kind, []).append(fn)
+
+    def register_status_validator(self, kind: str, fn: Validator) -> None:
+        """Validators for the status subresource (the reference validates
+        status writes too, e.g. observedGeneration monotonicity
+        steprun_webhook.go:529)."""
+        self._status_validators.setdefault(kind, []).append(fn)
 
     # -- index registration ------------------------------------------------
     def add_index(self, kind: str, index_name: str, fn: IndexFn) -> None:
@@ -283,6 +290,11 @@ class ResourceStore:
                 fn(new)
             for fn in self._validators.get(new.kind, []):
                 fn(new, None)
+            if new.status:
+                # caller-supplied status on create must satisfy the same
+                # invariants as the status subresource
+                for fn in self._status_validators.get(new.kind, []):
+                    fn(new, None)
             self._rv_counter += 1
             new.meta.uid = new.meta.uid or fresh_uid()
             new.meta.resource_version = self._rv_counter
@@ -314,6 +326,8 @@ class ResourceStore:
             new = cur.deepcopy()
             if status_only:
                 new.status = copy.deepcopy(obj.status)
+                for fn in self._status_validators.get(new.kind, []):
+                    fn(new, cur)
             else:
                 new.spec = copy.deepcopy(obj.spec)
                 new.status = copy.deepcopy(obj.status)
@@ -325,6 +339,11 @@ class ResourceStore:
                     fn(new)
                 for fn in self._validators.get(new.kind, []):
                     fn(new, cur)
+                if new.status != cur.status:
+                    # full updates can carry status too; invariants hold
+                    # on every write path, not just update_status
+                    for fn in self._status_validators.get(new.kind, []):
+                        fn(new, cur)
                 if new.spec != cur.spec:
                     new.meta.generation = cur.meta.generation + 1
             self._rv_counter += 1

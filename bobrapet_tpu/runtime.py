@@ -50,6 +50,7 @@ from .storage.manager import StorageManager
 from .storage.store import MemoryStore, Store
 from .templating.engine import Evaluator, TemplateConfig
 from .utils.naming import compose_unique
+from .webhooks import register_webhooks
 
 _log = logging.getLogger(__name__)
 
@@ -67,6 +68,7 @@ class Runtime:
         placer: Optional[SlicePlacer] = None,
         executor_mode: str = "sync",
         config_namespace: str = "bobrapet-system",
+        enable_webhooks: bool = True,
     ):
         self.clock = clock or ManualClock()
         self.store = ResourceStore(persist_dir=persist_dir)
@@ -88,6 +90,11 @@ class Runtime:
         self.config_manager.subscribe(self._on_config_change)
 
         self._register_indexes()
+        # admission layer (reference: setupWebhooksIfEnabled, cmd/main.go:802;
+        # ENABLE_WEBHOOKS=false no-op server :364-394)
+        register_webhooks(
+            self.store, self.evaluator, self.config_manager, enabled=enable_webhooks
+        )
 
         self.step_executor = StepExecutor(
             self.store, self.evaluator, self.storage, self.config_manager,

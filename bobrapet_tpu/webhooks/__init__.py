@@ -1,0 +1,95 @@
+"""Admission layer: defaulters + validators for every resource kind.
+
+The counterpart of the reference's 9 webhooks (SURVEY §2.3; registered
+at cmd/main.go:832-911). Here they register as ResourceStore admission
+hooks — the exact seam where the reference's webhooks sit between the
+API server and storage. ``ENABLE_WEBHOOKS=false`` has the same no-op
+escape hatch (reference: cmd/main.go:364-394) via ``enabled=False``.
+"""
+
+from __future__ import annotations
+
+from ..api.catalog import ENGRAM_TEMPLATE_KIND, IMPULSE_TEMPLATE_KIND
+from ..api.engram import KIND as ENGRAM_KIND
+from ..api.impulse import KIND as IMPULSE_KIND
+from ..api.runs import (
+    EFFECT_CLAIM_KIND,
+    STEP_RUN_KIND,
+    STORY_RUN_KIND,
+    STORY_TRIGGER_KIND,
+)
+from ..api.story import KIND as STORY_KIND
+from ..api.transport import TRANSPORT_BINDING_KIND, TRANSPORT_KIND
+from ..core.store import ResourceStore
+from ..templating.engine import Evaluator
+from .engram import EngramWebhook, ImpulseWebhook
+from .runs import StepRunWebhook, StoryRunWebhook
+from .story import StoryWebhook
+from .template import EngramTemplateWebhook, ImpulseTemplateWebhook
+from .trigger import EffectClaimWebhook, StoryTriggerWebhook
+from .transport import TransportBindingWebhook, TransportWebhook
+
+__all__ = [
+    "register_webhooks",
+    "StoryWebhook",
+    "EngramWebhook",
+    "ImpulseWebhook",
+    "StoryRunWebhook",
+    "StepRunWebhook",
+    "StoryTriggerWebhook",
+    "EffectClaimWebhook",
+    "TransportWebhook",
+    "TransportBindingWebhook",
+    "EngramTemplateWebhook",
+    "ImpulseTemplateWebhook",
+]
+
+
+def register_webhooks(
+    store: ResourceStore,
+    evaluator: Evaluator,
+    config_manager=None,
+    enabled: bool = True,
+) -> None:
+    """Wire every webhook into the store's admission chain
+    (reference: setupWebhooksIfEnabled cmd/main.go:802-924; each
+    config-dependent webhook holds the live config manager :796-800)."""
+    if not enabled:
+        return
+
+    story = StoryWebhook(store, evaluator, config_manager)
+    store.register_defaulter(STORY_KIND, story.default)
+    store.register_validator(STORY_KIND, story.validate)
+
+    engram = EngramWebhook(store, config_manager)
+    store.register_defaulter(ENGRAM_KIND, engram.default)
+    store.register_validator(ENGRAM_KIND, engram.validate)
+
+    impulse = ImpulseWebhook(store, config_manager)
+    store.register_validator(IMPULSE_KIND, impulse.validate)
+
+    storyrun = StoryRunWebhook(store, config_manager)
+    store.register_validator(STORY_RUN_KIND, storyrun.validate)
+    store.register_status_validator(STORY_RUN_KIND, storyrun.validate_status)
+
+    steprun = StepRunWebhook(store, config_manager)
+    store.register_validator(STEP_RUN_KIND, steprun.validate)
+    store.register_status_validator(STEP_RUN_KIND, steprun.validate_status)
+
+    trigger = StoryTriggerWebhook(store, config_manager)
+    store.register_validator(STORY_TRIGGER_KIND, trigger.validate)
+
+    claim = EffectClaimWebhook(store, config_manager)
+    store.register_validator(EFFECT_CLAIM_KIND, claim.validate)
+
+    transport = TransportWebhook(store)
+    store.register_validator(TRANSPORT_KIND, transport.validate)
+
+    binding = TransportBindingWebhook(store)
+    store.register_validator(TRANSPORT_BINDING_KIND, binding.validate)
+
+    etpl = EngramTemplateWebhook(store)
+    store.register_validator(ENGRAM_TEMPLATE_KIND, etpl.validate)
+
+    itpl = ImpulseTemplateWebhook(store)
+    store.register_validator(IMPULSE_TEMPLATE_KIND, itpl.validate)

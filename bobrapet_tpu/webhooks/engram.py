@@ -1,0 +1,162 @@
+"""Engram + Impulse admission.
+
+The counterpart of the reference's Engram/Impulse webhooks
+(reference: internal/webhook/v1alpha1/{engram,impulse}_webhook.go —
+templateRef existence + mode support, secret-schema conformance,
+retry defaults retry_defaults.go, cross-namespace reference policy
+reference_validation.go).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.catalog import (
+    CLUSTER_NAMESPACE,
+    ENGRAM_TEMPLATE_KIND,
+    IMPULSE_TEMPLATE_KIND,
+    parse_engram_template,
+    parse_impulse_template,
+)
+from ..api.engram import KIND as ENGRAM_KIND, parse_engram
+from ..api.enums import WorkloadMode
+from ..api.impulse import KIND as IMPULSE_KIND, parse_impulse
+from ..api.story import KIND as STORY_KIND
+from ..core.object import Resource
+from ..core.store import ResourceStore
+from ..utils.duration import DurationError, parse_duration
+from .policy import check_cross_namespace
+from .validation import FieldErrors
+
+#: Retry defaults injected when an Engram declares retries without knobs
+#: (reference: retry_defaults.go).
+DEFAULT_RETRY = {"maxRetries": 3, "delay": "5s", "backoff": "exponential"}
+
+
+def _validate_secrets(errs: FieldErrors, declared: dict, schema, path: str) -> None:
+    """Secret-schema conformance: required secrets present, no unknown
+    names when a schema is declared."""
+    by_name = {s.name: s for s in schema}
+    for s in schema:
+        if s.required and s.name not in declared:
+            errs.add(f"{path}.{s.name}", "required secret is missing")
+    if by_name:
+        for name in declared:
+            if name not in by_name:
+                errs.add(f"{path}.{name}", "not declared in template secretSchema")
+
+
+def _validate_retry(errs: FieldErrors, retry, path: str) -> None:
+    if retry is None:
+        return
+    if retry.max_retries is not None and retry.max_retries < 0:
+        errs.add(path + ".maxRetries", "must be >= 0")
+    for field in ("delay", "max_delay"):
+        val = getattr(retry, field, None)
+        if val:
+            try:
+                parse_duration(val)
+            except DurationError as e:
+                errs.add(f"{path}.{field}", str(e))
+    if retry.jitter is not None and not (0 <= retry.jitter <= 100):
+        errs.add(path + ".jitter", "must be a percentage 0-100")
+
+
+class EngramWebhook:
+    def __init__(self, store: ResourceStore, config_manager=None):
+        self.store = store
+        self.config_manager = config_manager
+
+    def default(self, resource: Resource) -> None:
+        exec_ = resource.spec.get("execution")
+        if isinstance(exec_, dict) and exec_.get("retry") == {}:
+            exec_["retry"] = dict(DEFAULT_RETRY)
+
+    def validate(self, resource: Resource, old: Optional[Resource]) -> None:
+        errs = FieldErrors(ENGRAM_KIND, resource.meta.name)
+        try:
+            spec = parse_engram(resource)
+        except Exception as e:  # noqa: BLE001
+            errs.add("spec", f"malformed: {e}")
+            errs.raise_if_any()
+            return
+
+        if spec.template_ref is None or not spec.template_ref.name:
+            errs.add("spec.templateRef", "templateRef.name is required")
+            errs.raise_if_any()
+            return
+        template = self.store.try_get(
+            ENGRAM_TEMPLATE_KIND, CLUSTER_NAMESPACE, spec.template_ref.name
+        )
+        if template is None:
+            errs.add(
+                "spec.templateRef",
+                f"EngramTemplate {spec.template_ref.name!r} not found",
+            )
+            errs.raise_if_any()
+            return
+        tspec = parse_engram_template(template)
+        if spec.mode is not None and not tspec.supports_mode(spec.mode):
+            errs.add(
+                "spec.mode",
+                f"mode {spec.mode} not in template supportedModes "
+                f"{[str(m) for m in tspec.supported_modes]}",
+            )
+        _validate_secrets(errs, spec.secrets, tspec.secret_schema, "spec.secrets")
+        if spec.execution is not None:
+            _validate_retry(errs, spec.execution.retry, "spec.execution.retry")
+        errs.raise_if_any()
+
+
+class ImpulseWebhook:
+    def __init__(self, store: ResourceStore, config_manager=None):
+        self.store = store
+        self.config_manager = config_manager
+
+    def validate(self, resource: Resource, old: Optional[Resource]) -> None:
+        errs = FieldErrors(IMPULSE_KIND, resource.meta.name)
+        try:
+            spec = parse_impulse(resource)
+        except Exception as e:  # noqa: BLE001
+            errs.add("spec", f"malformed: {e}")
+            errs.raise_if_any()
+            return
+
+        if spec.template_ref is None or not spec.template_ref.name:
+            errs.add("spec.templateRef", "templateRef.name is required")
+        else:
+            template = self.store.try_get(
+                IMPULSE_TEMPLATE_KIND, CLUSTER_NAMESPACE, spec.template_ref.name
+            )
+            if template is None:
+                errs.add(
+                    "spec.templateRef",
+                    f"ImpulseTemplate {spec.template_ref.name!r} not found",
+                )
+            else:
+                tspec = parse_impulse_template(template)
+                _validate_secrets(
+                    errs, spec.secrets, tspec.secret_schema, "spec.secrets"
+                )
+
+        if spec.story_ref is None or not spec.story_ref.name:
+            errs.add("spec.storyRef", "storyRef.name is required")
+        else:
+            ns = spec.story_ref.namespace or resource.meta.namespace
+            check_cross_namespace(
+                errs, self.store, self.config_manager,
+                from_kind=IMPULSE_KIND, from_namespace=resource.meta.namespace,
+                to_kind=STORY_KIND, to_namespace=ns, to_name=spec.story_ref.name,
+                path="spec.storyRef",
+            )
+
+        if spec.throttle is not None:
+            for field, key in (
+                ("max_in_flight", "maxInFlight"),
+                ("rate_per_second", "ratePerSecond"),
+                ("burst", "burst"),
+            ):
+                val = getattr(spec.throttle, field, None)
+                if val is not None and val < 1:
+                    errs.add(f"spec.throttle.{key}", "must be >= 1")
+        errs.raise_if_any()

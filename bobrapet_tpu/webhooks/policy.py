@@ -1,0 +1,68 @@
+"""Cross-namespace reference policy enforcement.
+
+The counterpart of the reference's ValidateCrossNamespaceReference
+(reference: internal/webhook/v1alpha1/validate_helpers.go:81-126):
+``referenceCrossNamespacePolicy`` = deny (default) rejects any
+cross-namespace reference; ``grant`` consults ReferenceGrants in the
+target namespace (pkg/refs/reference_grant.go:26); ``allow`` permits
+everything. Used by webhooks and controllers alike.
+"""
+
+from __future__ import annotations
+
+from ..api.policy import reference_granted
+from ..core.store import ResourceStore
+from .validation import FieldErrors
+
+POLICY_DENY = "deny"
+POLICY_GRANT = "grant"
+POLICY_ALLOW = "allow"
+
+
+def cross_namespace_policy(config_manager) -> str:
+    cfg = config_manager.config if config_manager else None
+    return getattr(cfg, "reference_cross_namespace_policy", POLICY_DENY) or POLICY_DENY
+
+
+def cross_namespace_allowed(
+    store: ResourceStore,
+    config_manager,
+    from_kind: str,
+    from_namespace: str,
+    to_kind: str,
+    to_namespace: str,
+    to_name: str,
+) -> bool:
+    if from_namespace == to_namespace:
+        return True
+    policy = cross_namespace_policy(config_manager)
+    if policy == POLICY_ALLOW:
+        return True
+    if policy == POLICY_GRANT:
+        return reference_granted(
+            store, from_kind, from_namespace, to_kind, to_namespace, to_name
+        )
+    return False
+
+
+def check_cross_namespace(
+    errs: FieldErrors,
+    store: ResourceStore,
+    config_manager,
+    from_kind: str,
+    from_namespace: str,
+    to_kind: str,
+    to_namespace: str,
+    to_name: str,
+    path: str,
+) -> None:
+    if not cross_namespace_allowed(
+        store, config_manager, from_kind, from_namespace,
+        to_kind, to_namespace, to_name,
+    ):
+        errs.add(
+            path,
+            f"cross-namespace reference {from_namespace} -> "
+            f"{to_namespace}/{to_name} denied by policy "
+            f"{cross_namespace_policy(config_manager)!r}",
+        )

@@ -1,0 +1,276 @@
+"""StoryRun / StepRun admission.
+
+The counterpart of the reference's runs webhooks
+(reference: internal/webhook/runs/v1alpha1/storyrun_webhook.go —
+storyRef required, inputs shape/size caps, JSON-schema validation against
+Story.inputsSchema, storage-ref spoofing rejection :389, cancelRequested
+transition rules :175-191, observedGeneration monotonicity; and
+steprun_webhook.go:163-588 — field checks, size caps, downstream target
+shape, StructuredError contract, observedGeneration monotonic).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..api.enums import ExitClass
+from ..api.errors import ErrorType
+from ..api.runs import (
+    STEP_RUN_KIND,
+    STORY_RUN_KIND,
+    parse_steprun,
+    parse_storyrun,
+)
+from ..api.story import KIND as STORY_KIND, parse_story
+from ..core.object import Resource
+from ..core.store import ResourceStore
+from .policy import check_cross_namespace
+from .validation import FieldErrors, find_storage_refs, json_size, validate_name
+
+#: Size caps (reference: inputs shape/size caps; ~1MiB etcd-object
+#: headroom — oversized payloads must go through storage offload).
+DEFAULT_MAX_INPUTS_BYTES = 1 * 1024 * 1024
+DEFAULT_MAX_OUTPUT_BYTES = 1 * 1024 * 1024
+DEFAULT_MAX_OBJECT_BYTES = int(1.5 * 1024 * 1024)
+
+_VALID_ERROR_TYPES = set(ErrorType.ALL)
+_VALID_EXIT_CLASSES = {str(c) for c in ExitClass}
+
+
+def _schema_validate(value: Any, schema: dict[str, Any], path: str) -> list[str]:
+    """Minimal JSON-schema subset validation (type/required/properties/
+    enum/items) — the same subset the StepRun controller enforces."""
+    errors: list[str] = []
+    t = schema.get("type")
+    if t:
+        py = {
+            "object": dict, "array": list, "string": str,
+            "number": (int, float), "integer": int, "boolean": bool,
+        }.get(t)
+        if py is not None and value is not None and not isinstance(value, py):
+            errors.append(f"{path}: expected {t}")
+            return errors
+        # bool is an int subclass in Python; JSON schema says it is not
+        if t in ("number", "integer") and isinstance(value, bool):
+            errors.append(f"{path}: expected {t}")
+    if "enum" in schema and value not in schema["enum"]:
+        errors.append(f"{path}: not in enum {schema['enum']}")
+    if isinstance(value, dict):
+        for req in schema.get("required", []):
+            if req not in value:
+                errors.append(f"{path}.{req}: required property missing")
+        props = schema.get("properties", {})
+        for k, sub in props.items():
+            if k in value:
+                errors.extend(_schema_validate(value[k], sub, f"{path}.{k}"))
+    if isinstance(value, list) and "items" in schema:
+        for i, item in enumerate(value):
+            errors.extend(_schema_validate(item, schema["items"], f"{path}[{i}]"))
+    return errors
+
+
+def _check_storage_refs(
+    errs: FieldErrors, value: Any, namespace: str, path: str
+) -> None:
+    """Storage-ref spoofing rejection (reference: storyrun_webhook.go:389
+    + pkg/storage validateStorageRef:518): refs must stay inside the
+    resource's own namespace scope of the canonical offload key scheme
+    (``runs/<namespace>/...``, StorageManager.step_key) so a run can
+    never be pointed at another namespace's offloaded payloads."""
+    for rpath, ref in find_storage_refs(value, path):
+        key = ref.get("key") or ""
+        if not key.startswith(f"runs/{namespace}/"):
+            errs.add(
+                rpath,
+                f"storageRef key {key!r} outside namespace scope runs/{namespace}/",
+            )
+
+
+class StoryRunWebhook:
+    def __init__(self, store: ResourceStore, config_manager=None):
+        self.store = store
+        self.config_manager = config_manager
+
+    # -- spec admission ----------------------------------------------------
+    def validate(self, resource: Resource, old: Optional[Resource]) -> None:
+        errs = FieldErrors(STORY_RUN_KIND, resource.meta.name)
+        validate_name(errs, "metadata.name", resource.meta.name)
+        try:
+            spec = parse_storyrun(resource)
+        except Exception as e:  # noqa: BLE001
+            errs.add("spec", f"malformed: {e}")
+            errs.raise_if_any()
+            return
+
+        if spec.story_ref is None or not spec.story_ref.name:
+            errs.add("spec.storyRef", "storyRef.name is required")
+            errs.raise_if_any()
+            return
+
+        story_ns = spec.story_ref.namespace or resource.meta.namespace
+        check_cross_namespace(
+            errs, self.store, self.config_manager,
+            from_kind=STORY_RUN_KIND, from_namespace=resource.meta.namespace,
+            to_kind=STORY_KIND, to_namespace=story_ns, to_name=spec.story_ref.name,
+            path="spec.storyRef",
+        )
+
+        inputs = spec.inputs
+        if inputs is not None:
+            if not isinstance(inputs, dict):
+                errs.add("spec.inputs", "must be an object")
+            else:
+                size = json_size(inputs)
+                if size > DEFAULT_MAX_INPUTS_BYTES:
+                    errs.add(
+                        "spec.inputs",
+                        f"size {size} exceeds {DEFAULT_MAX_INPUTS_BYTES} "
+                        "(offload through storage instead)",
+                    )
+                _check_storage_refs(
+                    errs, inputs, resource.meta.namespace, "spec.inputs"
+                )
+                # schema validation on create only: the Story may evolve
+                # while the run exists (reference: create-time check)
+                if old is None:
+                    story = self.store.try_get(
+                        STORY_KIND, story_ns, spec.story_ref.name
+                    )
+                    if story is not None:
+                        sspec = parse_story(story)
+                        if sspec.inputs_schema:
+                            for msg in _schema_validate(
+                                inputs, sspec.inputs_schema, "spec.inputs"
+                            ):
+                                errs.add("spec.inputs", msg)
+
+        # cancelRequested transition rules (reference: :175-191) — a
+        # cancellation cannot be withdrawn
+        if old is not None:
+            was = bool(old.spec.get("cancelRequested"))
+            now = bool(spec.cancel_requested)
+            if was and not now:
+                errs.add("spec.cancelRequested", "cannot be withdrawn once set")
+
+        errs.raise_if_any()
+
+    # -- status admission --------------------------------------------------
+    def validate_status(self, resource: Resource, old: Optional[Resource]) -> None:
+        errs = FieldErrors(STORY_RUN_KIND, resource.meta.name)
+        _validate_observed_generation(errs, resource, old)
+        errs.raise_if_any()
+
+
+class StepRunWebhook:
+    def __init__(self, store: ResourceStore, config_manager=None):
+        self.store = store
+        self.config_manager = config_manager
+
+    def validate(self, resource: Resource, old: Optional[Resource]) -> None:
+        errs = FieldErrors(STEP_RUN_KIND, resource.meta.name)
+        validate_name(errs, "metadata.name", resource.meta.name)
+        try:
+            spec = parse_steprun(resource)
+        except Exception as e:  # noqa: BLE001
+            errs.add("spec", f"malformed: {e}")
+            errs.raise_if_any()
+            return
+
+        if spec.story_run_ref is None or not spec.story_run_ref.name:
+            errs.add("spec.storyRunRef", "storyRunRef.name is required")
+        if spec.engram_ref is None or not spec.engram_ref.name:
+            errs.add("spec.engramRef", "engramRef.name is required")
+
+        if spec.input is not None:
+            size = json_size(spec.input)
+            if size > DEFAULT_MAX_INPUTS_BYTES:
+                errs.add(
+                    "spec.input",
+                    f"size {size} exceeds {DEFAULT_MAX_INPUTS_BYTES}",
+                )
+            _check_storage_refs(
+                errs, spec.input, resource.meta.namespace, "spec.input"
+            )
+
+        for i, tgt in enumerate(spec.downstream_targets):
+            p = f"spec.downstreamTargets[{i}]"
+            has_grpc = tgt.grpc is not None
+            has_term = bool(tgt.terminate)
+            if has_grpc == has_term:
+                errs.add(p, "exactly one of `grpc` or `terminate` must be set")
+            elif has_grpc:
+                if not tgt.grpc.host:
+                    errs.add(p + ".grpc.host", "host is required")
+                if not (0 < tgt.grpc.port < 65536):
+                    errs.add(p + ".grpc.port", "port must be 1-65535")
+
+        total = json_size(resource.spec)
+        if total > DEFAULT_MAX_OBJECT_BYTES:
+            errs.add("spec", f"total object size {total} exceeds cap")
+
+        errs.raise_if_any()
+
+    def validate_status(self, resource: Resource, old: Optional[Resource]) -> None:
+        errs = FieldErrors(STEP_RUN_KIND, resource.meta.name)
+        _validate_observed_generation(errs, resource, old)
+
+        output = resource.status.get("output")
+        if output is not None:
+            size = json_size(output)
+            if size > DEFAULT_MAX_OUTPUT_BYTES:
+                errs.add(
+                    "status.output",
+                    f"size {size} exceeds {DEFAULT_MAX_OUTPUT_BYTES} "
+                    "(SDK must offload large outputs)",
+                )
+
+        err = resource.status.get("error")
+        if err is not None:
+            _validate_structured_error(errs, err)
+
+        errs.raise_if_any()
+
+
+def _validate_observed_generation(
+    errs: FieldErrors, resource: Resource, old: Optional[Resource]
+) -> None:
+    """(reference: steprun_webhook.go:529, storyrun observedGeneration
+    monotonicity) — status can never report a generation from the future
+    or regress one already observed."""
+    new_gen = resource.status.get("observedGeneration")
+    if new_gen is None:
+        return
+    if not isinstance(new_gen, int) or new_gen < 0:
+        errs.add("status.observedGeneration", "must be a non-negative integer")
+        return
+    if new_gen > resource.meta.generation:
+        errs.add(
+            "status.observedGeneration",
+            f"{new_gen} is ahead of metadata.generation {resource.meta.generation}",
+        )
+    if old is not None:
+        old_gen = old.status.get("observedGeneration")
+        if isinstance(old_gen, int) and new_gen < old_gen:
+            errs.add(
+                "status.observedGeneration",
+                f"cannot regress from {old_gen} to {new_gen}",
+            )
+
+
+def _validate_structured_error(errs: FieldErrors, err: Any) -> None:
+    """StructuredError v1 contract
+    (reference: api/runs/v1alpha1/structured_error_types.go:53)."""
+    if not isinstance(err, dict):
+        errs.add("status.error", "must be a StructuredError object")
+        return
+    etype = err.get("type")
+    if etype is not None and str(etype) not in _VALID_ERROR_TYPES:
+        errs.add("status.error.type", f"unknown error type {etype!r}")
+    eclass = err.get("exitClass")
+    if eclass is not None and str(eclass) not in _VALID_EXIT_CLASSES:
+        errs.add("status.error.exitClass", f"unknown exit class {eclass!r}")
+    if "message" in err and not isinstance(err["message"], str):
+        errs.add("status.error.message", "must be a string")
+    retryable = err.get("retryable")
+    if retryable is not None and not isinstance(retryable, bool):
+        errs.add("status.error.retryable", "must be a boolean")

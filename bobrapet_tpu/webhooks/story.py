@@ -1,0 +1,336 @@
+"""Story admission: defaults + the full validation battery.
+
+The counterpart of the reference's Story webhook
+(reference: internal/webhook/v1alpha1/story_webhook.go:90 Default,
+:164 ValidateCreate/Update — step shape, unique names, needs existence,
+batch-only primitives rejected in realtime, primitive `with` shapes,
+per-scope template static validation :832-848, `with` size caps,
+executeStory reference cycles, policy timeout parsing).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..api.enums import BATCH_ONLY_PRIMITIVES, StepType, StoryPattern
+from ..api.story import KIND as STORY_KIND, StorySpec, parse_story
+from ..core.object import Resource
+from ..core.store import ResourceStore
+from ..templating.engine import (
+    ROOT_INPUTS,
+    ROOT_PACKET,
+    ROOT_RUN,
+    ROOT_STEPS,
+    Evaluator,
+    TemplateError,
+)
+from ..utils.duration import DurationError, parse_duration
+from .validation import (
+    FieldErrors,
+    json_size,
+    validate_name,
+    validate_template_safety,
+    walk_strings,
+)
+
+#: Scope roots per evaluation context
+#: (reference: story_webhook.go:832-848 — batch runtime vs realtime
+#: static vs realtime runtime vs output template).
+SCOPE_BATCH_RUNTIME = frozenset({ROOT_INPUTS, ROOT_STEPS, ROOT_RUN})
+SCOPE_REALTIME_STATIC = frozenset({ROOT_INPUTS, ROOT_RUN})
+SCOPE_REALTIME_RUNTIME = frozenset({ROOT_INPUTS, ROOT_RUN, ROOT_PACKET})
+SCOPE_OUTPUT = frozenset({ROOT_INPUTS, ROOT_STEPS, ROOT_RUN})
+
+DEFAULT_MAX_WITH_BLOCK_SIZE = 256 * 1024  # reference: MaxStoryWithBlockSizeBytes
+
+_VALID_ON_TIMEOUT = {"fail", "skip"}
+# stop accepts StopMode aliases and terminal Phase names
+# (reference: step_executor.go:1084-1101 + pkg/enums StopMode)
+_VALID_STOP_PHASES = {
+    "success", "failure", "cancel",
+    "Succeeded", "Failed", "Finished", "Canceled",
+}
+
+
+class StoryWebhook:
+    def __init__(self, store: ResourceStore, evaluator: Evaluator, config_manager=None):
+        self.store = store
+        self.evaluator = evaluator
+        self.config_manager = config_manager
+
+    # -- mutating admission ------------------------------------------------
+    def default(self, resource: Resource) -> None:
+        """(reference: story_webhook.go:90 Default)"""
+        spec = resource.spec
+        spec.setdefault("pattern", str(StoryPattern.BATCH))
+        for step in spec.get("steps") or []:
+            if isinstance(step, dict) and step.get("type") == str(StepType.WAIT):
+                with_ = step.setdefault("with", {})
+                if isinstance(with_, dict):
+                    with_.setdefault("onTimeout", "fail")
+
+    # -- validating admission ----------------------------------------------
+    def validate(self, resource: Resource, old: Optional[Resource]) -> None:
+        errs = FieldErrors(STORY_KIND, resource.meta.name)
+        validate_name(errs, "metadata.name", resource.meta.name)
+        try:
+            spec = parse_story(resource)
+        except Exception as e:  # noqa: BLE001 - malformed spec is a user error
+            errs.add("spec", f"malformed: {e}")
+            errs.raise_if_any()
+            return
+
+        realtime = spec.effective_pattern.is_realtime
+        self._validate_steps(errs, resource, spec, spec.steps, "spec.steps", realtime)
+        self._validate_steps(
+            errs, resource, spec, spec.compensations, "spec.compensations", realtime
+        )
+        self._validate_steps(
+            errs, resource, spec, spec.finally_, "spec.finally", realtime
+        )
+        self._validate_output(errs, spec)
+        self._validate_policy(errs, spec)
+        errs.raise_if_any()
+
+    # -- step battery ------------------------------------------------------
+    def _validate_steps(
+        self,
+        errs: FieldErrors,
+        resource: Resource,
+        spec: StorySpec,
+        steps: list,
+        path: str,
+        realtime: bool,
+        nested: bool = False,
+    ) -> None:
+        seen: set[str] = set()
+        names = {s.name for s in steps}
+        for i, step in enumerate(steps):
+            p = f"{path}[{i}]"
+            if not step.name:
+                errs.add(p + ".name", "step name is required")
+            elif step.name in seen:
+                # (reference: CEL-validated uniqueness, story_types.go:88)
+                errs.add(p + ".name", f"duplicate step name {step.name!r}")
+            seen.add(step.name)
+
+            # exactly one of ref / type (reference: story_types.go:88 CEL)
+            if bool(step.ref) == bool(step.type):
+                errs.add(p, "exactly one of `ref` (engram) or `type` (primitive) must be set")
+
+            for dep in step.needs:
+                if dep == step.name:
+                    errs.add(p + ".needs", "step cannot depend on itself")
+                elif dep not in names:
+                    errs.add(p + ".needs", f"unknown step {dep!r}")
+
+            if realtime and step.type in BATCH_ONLY_PRIMITIVES:
+                # (reference: batch-only primitives rejected in realtime)
+                errs.add(p + ".type", f"primitive {step.type} is batch-only")
+
+            self._validate_primitive_with(errs, resource, spec, step, p, realtime, nested)
+            self._validate_step_templates(errs, step, p, realtime)
+
+            with_size = json_size(step.with_) if step.with_ else 0
+            if with_size > self._max_with_size():
+                errs.add(
+                    p + ".with",
+                    f"size {with_size} exceeds cap {self._max_with_size()}",
+                )
+
+        # needs cycle detection over this step list
+        self._detect_needs_cycle(errs, steps, path)
+
+    def _validate_primitive_with(
+        self, errs, resource, spec, step, p, realtime, nested
+    ) -> None:
+        """Primitive `with` shapes (reference SURVEY §2.2 primitive table:
+        dag.go:1549,1569,1608, step_executor.go:1084-1215,741-747)."""
+        w = step.with_ or {}
+        t = step.type
+        if t is StepType.SLEEP:
+            if not w.get("duration"):
+                errs.add(p + ".with.duration", "sleep requires `duration`")
+            else:
+                self._check_duration(errs, p + ".with.duration", w["duration"])
+        elif t is StepType.WAIT:
+            if not w.get("until"):
+                errs.add(p + ".with.until", "wait requires `until` template")
+            self._check_duration(errs, p + ".with.timeout", w.get("timeout"))
+            self._check_duration(errs, p + ".with.pollInterval", w.get("pollInterval"))
+            if w.get("onTimeout") not in (None, *_VALID_ON_TIMEOUT):
+                errs.add(p + ".with.onTimeout", "must be `fail` or `skip`")
+        elif t is StepType.GATE:
+            self._check_duration(errs, p + ".with.timeout", w.get("timeout"))
+            self._check_duration(errs, p + ".with.pollInterval", w.get("pollInterval"))
+            if w.get("onTimeout") not in (None, *_VALID_ON_TIMEOUT):
+                errs.add(p + ".with.onTimeout", "must be `fail` or `skip`")
+        elif t is StepType.STOP:
+            if w.get("phase") not in (None, *_VALID_STOP_PHASES):
+                errs.add(p + ".with.phase", f"must be one of {sorted(_VALID_STOP_PHASES)}")
+        elif t is StepType.EXECUTE_STORY:
+            ref = w.get("storyRef")
+            if not (isinstance(ref, dict) and ref.get("name")):
+                errs.add(p + ".with.storyRef", "executeStory requires `storyRef.name`")
+            else:
+                self._check_execute_story_cycle(errs, resource, ref, p)
+        elif t is StepType.PARALLEL:
+            branches = w.get("steps")
+            if not isinstance(branches, list) or not branches:
+                errs.add(p + ".with.steps", "parallel requires a non-empty `steps` list")
+            elif nested:
+                errs.add(p + ".with.steps", "parallel branches cannot nest another parallel")
+            else:
+                try:
+                    from ..api.story import Step
+
+                    parsed = [Step.from_dict(b) for b in branches]
+                except Exception as e:  # noqa: BLE001
+                    errs.add(p + ".with.steps", f"malformed branch: {e}")
+                else:
+                    self._validate_steps(
+                        errs, resource, spec, parsed, p + ".with.steps",
+                        realtime, nested=True,
+                    )
+        elif t is StepType.CONDITION:
+            # no `with` machinery (reference: step_executor.go:168-170)
+            pass
+
+    def _validate_step_templates(self, errs, step, p, realtime) -> None:
+        """Per-scope static validation
+        (reference: story_webhook.go:832-848)."""
+        if realtime:
+            config_scope = SCOPE_REALTIME_STATIC if not step.ref else SCOPE_REALTIME_RUNTIME
+        else:
+            config_scope = SCOPE_BATCH_RUNTIME
+        if step.if_:
+            self._check_template(errs, p + ".if", step.if_, config_scope)
+        for tpath, text in walk_strings(step.with_ or {}, p + ".with"):
+            self._check_template(errs, tpath, text, config_scope)
+        if step.idempotency_key_template:
+            self._check_template(
+                errs, p + ".idempotencyKeyTemplate",
+                step.idempotency_key_template, SCOPE_BATCH_RUNTIME,
+            )
+        if step.post_execution and step.post_execution.condition:
+            # postExecution sees the step's own output
+            self._check_template(
+                errs, p + ".postExecution.condition",
+                step.post_execution.condition,
+                SCOPE_BATCH_RUNTIME | {"output"},
+            )
+
+    def _validate_output(self, errs, spec: StorySpec) -> None:
+        for tpath, text in walk_strings(spec.output or {}, "spec.output"):
+            self._check_template(errs, tpath, text, SCOPE_OUTPUT)
+
+    def _validate_policy(self, errs, spec: StorySpec) -> None:
+        pol = spec.policy
+        if pol is None:
+            return
+        if pol.timeouts is not None:
+            self._check_duration(errs, "spec.policy.timeouts.story", pol.timeouts.story)
+            self._check_duration(errs, "spec.policy.timeouts.step", pol.timeouts.step)
+            self._check_duration(
+                errs, "spec.policy.timeouts.gracefulShutdownTimeout",
+                pol.timeouts.graceful_shutdown_timeout,
+            )
+        if pol.concurrency is not None and pol.concurrency < 1:
+            errs.add("spec.policy.concurrency", "must be >= 1")
+
+    # -- helpers -----------------------------------------------------------
+    def _check_template(self, errs, path, text, roots) -> None:
+        if "{{" not in text:
+            return
+        if not validate_template_safety(errs, path, text):
+            return
+        try:
+            self.evaluator.validate(text, allowed_roots=roots)
+        except TemplateError as e:
+            errs.add(path, str(e))
+
+    def _check_duration(self, errs, path, value) -> None:
+        if value in (None, ""):
+            return
+        try:
+            parse_duration(value)
+        except DurationError as e:
+            errs.add(path, str(e))
+
+    def _check_execute_story_cycle(self, errs, resource, ref: dict, p) -> None:
+        """Reject direct and transitive executeStory cycles reachable
+        through stories that already exist
+        (reference: executeStory reference cycle validation)."""
+        start = (ref.get("namespace") or resource.meta.namespace, ref.get("name"))
+        if start == (resource.meta.namespace, resource.meta.name):
+            errs.add(p + ".with.storyRef", "executeStory must not reference its own story")
+            return
+        seen = set()
+        frontier = [start]
+        while frontier:
+            ns, name = frontier.pop()
+            if (ns, name) in seen:
+                continue
+            seen.add((ns, name))
+            target = self.store.try_get(STORY_KIND, ns, name)
+            if target is None:
+                continue
+            for child in _execute_story_refs(target):
+                cns = child.get("namespace") or ns
+                cname = child.get("name")
+                if (cns, cname) == (resource.meta.namespace, resource.meta.name):
+                    errs.add(
+                        p + ".with.storyRef",
+                        f"executeStory cycle via {ns}/{name}",
+                    )
+                    return
+                frontier.append((cns, cname))
+
+    def _detect_needs_cycle(self, errs, steps, path) -> None:
+        graph = {s.name: [d for d in s.needs] for s in steps}
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in graph}
+
+        def visit(n) -> bool:
+            color[n] = GRAY
+            for d in graph.get(n, []):
+                if color.get(d, BLACK) == GRAY:
+                    return True
+                if color.get(d) == WHITE and visit(d):
+                    return True
+            color[n] = BLACK
+            return False
+
+        for n in graph:
+            if color[n] == WHITE and visit(n):
+                errs.add(path, f"dependency cycle involving step {n!r}")
+                return
+
+    def _max_with_size(self) -> int:
+        """(reference: MaxStoryWithBlockSizeBytes, controller_config.go:80)"""
+        if self.config_manager is not None:
+            return self.config_manager.config.max_story_with_block_size_bytes
+        return DEFAULT_MAX_WITH_BLOCK_SIZE
+
+
+def _execute_story_refs(story: Resource) -> list[dict[str, Any]]:
+    """Every executeStory target in the story — main/compensation/finally
+    lists AND parallel branches (a cycle through any of them recurses at
+    runtime just the same)."""
+    out: list[dict[str, Any]] = []
+
+    def walk(steps) -> None:
+        for step in steps or []:
+            if not isinstance(step, dict):
+                continue
+            if step.get("type") == str(StepType.EXECUTE_STORY):
+                ref = (step.get("with") or {}).get("storyRef")
+                if isinstance(ref, dict):
+                    out.append(ref)
+            elif step.get("type") == str(StepType.PARALLEL):
+                walk((step.get("with") or {}).get("steps"))
+
+    walk(story.spec.get("steps"))
+    walk(story.spec.get("compensations"))
+    walk(story.spec.get("finally"))
+    return out

@@ -1,0 +1,80 @@
+"""EngramTemplate / ImpulseTemplate admission.
+
+The counterpart of the reference's catalog validation (performed by the
+catalog controllers + CRD schema in the reference; here the same checks
+run at admission so bad templates never land in the catalog —
+reference: internal/controller/catalog/template_helpers.go).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.catalog import (
+    ENGRAM_TEMPLATE_KIND,
+    IMPULSE_TEMPLATE_KIND,
+    parse_engram_template,
+    parse_impulse_template,
+)
+from ..api.enums import SecretMountType
+from ..core.object import Resource
+from ..core.store import ResourceStore
+from .validation import FieldErrors
+
+_VALID_MOUNT_TYPES = {str(m) for m in SecretMountType}
+
+
+def _validate_template(errs: FieldErrors, spec) -> None:
+    if not spec.image and not spec.entrypoint:
+        errs.add("spec", "one of `image` or `entrypoint` is required")
+    seen = set()
+    for i, secret in enumerate(spec.secret_schema):
+        p = f"spec.secretSchema[{i}]"
+        if not secret.name:
+            errs.add(p + ".name", "secret name is required")
+        elif secret.name in seen:
+            errs.add(p + ".name", f"duplicate secret {secret.name!r}")
+        seen.add(secret.name)
+        if secret.mount_type is not None and str(secret.mount_type) not in _VALID_MOUNT_TYPES:
+            errs.add(p + ".mountType", f"must be one of {sorted(_VALID_MOUNT_TYPES)}")
+        if secret.mount_type is not None and str(secret.mount_type) in ("file", "both"):
+            if not secret.mount_path:
+                errs.add(p + ".mountPath", "required for file mounts")
+    if spec.config_schema is not None and not isinstance(spec.config_schema, dict):
+        errs.add("spec.configSchema", "must be a JSON schema object")
+
+
+class EngramTemplateWebhook:
+    def __init__(self, store: ResourceStore):
+        self.store = store
+
+    def validate(self, resource: Resource, old: Optional[Resource]) -> None:
+        errs = FieldErrors(ENGRAM_TEMPLATE_KIND, resource.meta.name)
+        try:
+            spec = parse_engram_template(resource)
+        except Exception as e:  # noqa: BLE001
+            errs.add("spec", f"malformed: {e}")
+            errs.raise_if_any()
+            return
+        _validate_template(errs, spec)
+        if spec.input_schema is not None and not isinstance(spec.input_schema, dict):
+            errs.add("spec.inputSchema", "must be a JSON schema object")
+        if spec.output_schema is not None and not isinstance(spec.output_schema, dict):
+            errs.add("spec.outputSchema", "must be a JSON schema object")
+        errs.raise_if_any()
+
+
+class ImpulseTemplateWebhook:
+    def __init__(self, store: ResourceStore):
+        self.store = store
+
+    def validate(self, resource: Resource, old: Optional[Resource]) -> None:
+        errs = FieldErrors(IMPULSE_TEMPLATE_KIND, resource.meta.name)
+        try:
+            spec = parse_impulse_template(resource)
+        except Exception as e:  # noqa: BLE001
+            errs.add("spec", f"malformed: {e}")
+            errs.raise_if_any()
+            return
+        _validate_template(errs, spec)
+        errs.raise_if_any()

@@ -1,0 +1,129 @@
+"""Transport / TransportBinding admission (schema-only, no config dep —
+reference: internal/webhook/transport/v1alpha1/transport_webhook.go:378,
+validation via pkg/transport/validation).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.transport import (
+    DRIVER_GRPC,
+    DRIVER_ICI,
+    TRANSPORT_BINDING_KIND,
+    TRANSPORT_KIND,
+    parse_transport,
+    parse_transport_binding,
+)
+from ..core.object import Resource
+from ..core.store import ResourceStore
+from .validation import FieldErrors
+
+_VALID_DRIVERS = {DRIVER_GRPC, DRIVER_ICI, "webrtc"}
+_VALID_DROP_POLICIES = {"dropOldest", "dropNewest", "block"}
+_VALID_DELIVERY = {"atMostOnce", "atLeastOnce"}
+_VALID_ORDERING = {"none", "perKey", "total"}
+_VALID_ROUTING_MODES = {"auto", "hub", "p2p"}
+_VALID_FAN_IN = {"merge", "zip", "quorum"}
+
+
+class TransportWebhook:
+    def __init__(self, store: ResourceStore):
+        self.store = store
+
+    def validate(self, resource: Resource, old: Optional[Resource]) -> None:
+        errs = FieldErrors(TRANSPORT_KIND, resource.meta.name)
+        try:
+            spec = parse_transport(resource)
+        except Exception as e:  # noqa: BLE001
+            errs.add("spec", f"malformed: {e}")
+            errs.raise_if_any()
+            return
+
+        if not spec.provider:
+            errs.add("spec.provider", "provider is required")
+        if spec.driver not in _VALID_DRIVERS:
+            errs.add("spec.driver", f"must be one of {sorted(_VALID_DRIVERS)}")
+        if spec.driver == DRIVER_ICI and not spec.mesh_topology:
+            errs.add("spec.meshTopology", "required for driver=ici")
+        for i, codec in enumerate(spec.supported_audio):
+            if not codec.name:
+                errs.add(f"spec.supportedAudio[{i}].name", "codec name is required")
+        for i, codec in enumerate(spec.supported_video):
+            if not codec.name:
+                errs.add(f"spec.supportedVideo[{i}].name", "codec name is required")
+
+        st = spec.streaming
+        if st is not None:
+            if st.backpressure and st.backpressure.buffer:
+                buf = st.backpressure.buffer
+                if buf.drop_policy not in (None, *_VALID_DROP_POLICIES):
+                    errs.add(
+                        "spec.streaming.backpressure.buffer.dropPolicy",
+                        f"must be one of {sorted(_VALID_DROP_POLICIES)}",
+                    )
+            if st.delivery:
+                if st.delivery.semantics not in (None, *_VALID_DELIVERY):
+                    errs.add(
+                        "spec.streaming.delivery.semantics",
+                        f"must be one of {sorted(_VALID_DELIVERY)}",
+                    )
+                if st.delivery.ordering not in (None, *_VALID_ORDERING):
+                    errs.add(
+                        "spec.streaming.delivery.ordering",
+                        f"must be one of {sorted(_VALID_ORDERING)}",
+                    )
+            if st.routing:
+                if st.routing.mode not in (None, *_VALID_ROUTING_MODES):
+                    errs.add(
+                        "spec.streaming.routing.mode",
+                        f"must be one of {sorted(_VALID_ROUTING_MODES)}",
+                    )
+                if st.routing.max_downstreams is not None and st.routing.max_downstreams < 1:
+                    errs.add("spec.streaming.routing.maxDownstreams", "must be >= 1")
+            if st.fan_in:
+                if st.fan_in.mode not in (None, *_VALID_FAN_IN):
+                    errs.add(
+                        "spec.streaming.fanIn.mode",
+                        f"must be one of {sorted(_VALID_FAN_IN)}",
+                    )
+                if st.fan_in.mode == "quorum" and not st.fan_in.quorum:
+                    errs.add("spec.streaming.fanIn.quorum", "required for mode=quorum")
+            seen_lanes = set()
+            for i, lane in enumerate(st.lanes):
+                if not lane.name:
+                    errs.add(f"spec.streaming.lanes[{i}].name", "lane name is required")
+                elif lane.name in seen_lanes:
+                    errs.add(f"spec.streaming.lanes[{i}].name", f"duplicate lane {lane.name!r}")
+                seen_lanes.add(lane.name)
+
+        errs.raise_if_any()
+
+
+class TransportBindingWebhook:
+    def __init__(self, store: ResourceStore):
+        self.store = store
+
+    def validate(self, resource: Resource, old: Optional[Resource]) -> None:
+        errs = FieldErrors(TRANSPORT_BINDING_KIND, resource.meta.name)
+        try:
+            spec = parse_transport_binding(resource)
+        except Exception as e:  # noqa: BLE001
+            errs.add("spec", f"malformed: {e}")
+            errs.raise_if_any()
+            return
+
+        if not spec.transport_ref:
+            errs.add("spec.transportRef", "transportRef is required")
+        if spec.story_run_ref is None or not spec.story_run_ref.name:
+            errs.add("spec.storyRunRef", "storyRunRef.name is required")
+        if not spec.step_name:
+            errs.add("spec.stepName", "stepName is required")
+        if spec.driver not in _VALID_DRIVERS:
+            errs.add("spec.driver", f"must be one of {sorted(_VALID_DRIVERS)}")
+        for kind in ("audio", "video", "binary"):
+            mb = getattr(spec, kind)
+            if mb is not None and mb.direction not in (None, "send", "receive", "both"):
+                errs.add(f"spec.{kind}.direction", "must be send|receive|both")
+
+        errs.raise_if_any()

@@ -1,0 +1,104 @@
+"""StoryTrigger + EffectClaim admission.
+
+The counterpart of the reference's trigger/claim webhooks
+(reference: internal/webhook/runs/v1alpha1 storytrigger/effectclaim
+validators — identity requirements, name-derivation rules, lease shape).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from ..api.runs import (
+    EFFECT_CLAIM_KIND,
+    STORY_TRIGGER_KIND,
+    parse_effectclaim,
+    parse_storytrigger,
+)
+from ..core.object import Resource
+from ..core.store import ResourceStore
+from .validation import FieldErrors
+
+_VALID_MODES = {"none", "key", "keyAndInputHash"}
+_HASH_RE = re.compile(r"^[a-f0-9]{64}$")
+
+
+class StoryTriggerWebhook:
+    def __init__(self, store: ResourceStore, config_manager=None):
+        self.store = store
+        self.config_manager = config_manager
+
+    def validate(self, resource: Resource, old: Optional[Resource]) -> None:
+        errs = FieldErrors(STORY_TRIGGER_KIND, resource.meta.name)
+        try:
+            spec = parse_storytrigger(resource)
+        except Exception as e:  # noqa: BLE001
+            errs.add("spec", f"malformed: {e}")
+            errs.raise_if_any()
+            return
+
+        if spec.story_ref is None or not spec.story_ref.name:
+            errs.add("spec.storyRef", "storyRef.name is required")
+
+        ident = spec.identity
+        if ident is None:
+            errs.add("spec.identity", "identity is required")
+        else:
+            mode = ident.mode or "none"
+            if mode not in _VALID_MODES:
+                errs.add("spec.identity.mode", f"must be one of {sorted(_VALID_MODES)}")
+            if mode in ("key", "keyAndInputHash") and not ident.key:
+                errs.add("spec.identity.key", f"required when mode={mode}")
+            if mode == "keyAndInputHash":
+                if not ident.input_hash:
+                    errs.add("spec.identity.inputHash", "required when mode=keyAndInputHash")
+                elif not _HASH_RE.match(ident.input_hash):
+                    errs.add("spec.identity.inputHash", "must be a sha256 hex digest")
+            if mode == "none" and not ident.submission_id:
+                errs.add(
+                    "spec.identity.submissionId",
+                    "required when mode=none (no other dedupe identity exists)",
+                )
+
+        # identity is immutable after creation — dedupe decisions would be
+        # unsound otherwise (reference: name-derivation rules)
+        if old is not None:
+            if (old.spec.get("identity") or {}) != (resource.spec.get("identity") or {}):
+                errs.add("spec.identity", "immutable after creation")
+            if (old.spec.get("storyRef") or {}) != (resource.spec.get("storyRef") or {}):
+                errs.add("spec.storyRef", "immutable after creation")
+
+        errs.raise_if_any()
+
+
+class EffectClaimWebhook:
+    def __init__(self, store: ResourceStore, config_manager=None):
+        self.store = store
+        self.config_manager = config_manager
+
+    def validate(self, resource: Resource, old: Optional[Resource]) -> None:
+        errs = FieldErrors(EFFECT_CLAIM_KIND, resource.meta.name)
+        try:
+            spec = parse_effectclaim(resource)
+        except Exception as e:  # noqa: BLE001
+            errs.add("spec", f"malformed: {e}")
+            errs.raise_if_any()
+            return
+
+        if not spec.effect_id:
+            errs.add("spec.effectId", "effectId is required")
+        if not (isinstance(spec.step_run_ref, dict) and spec.step_run_ref.get("name")):
+            errs.add("spec.stepRunRef", "stepRunRef.name is required")
+        if not spec.holder_identity:
+            errs.add("spec.holderIdentity", "holderIdentity is required")
+        if spec.lease_duration_seconds is not None and spec.lease_duration_seconds < 1:
+            errs.add("spec.leaseDurationSeconds", "must be >= 1")
+
+        if old is not None:
+            if old.spec.get("effectId") != resource.spec.get("effectId"):
+                errs.add("spec.effectId", "immutable after creation")
+            if (old.spec.get("stepRunRef") or {}) != (resource.spec.get("stepRunRef") or {}):
+                errs.add("spec.stepRunRef", "immutable after creation")
+
+        errs.raise_if_any()

@@ -1,0 +1,108 @@
+"""Shared admission-validation helpers.
+
+The counterpart of the reference's field-error aggregator
+(reference: pkg/validation/aggregator.go) and template safety pre-checks
+(reference: pkg/templatesafety/templatesafety.go — size/charset limits
+applied before any expression is parsed).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Optional
+
+from ..core.store import AdmissionDenied
+
+# Template-safety limits (reference: templatesafety.ValidateTemplateString)
+MAX_TEMPLATE_LENGTH = 8 * 1024
+_CONTROL_CHARS = re.compile(r"[\x00-\x08\x0b\x0c\x0e-\x1f]")
+
+# DNS-1123-subdomain-ish name shape shared by reference resource names.
+NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9.-]{0,251}[a-z0-9])?$")
+
+
+class FieldErrors:
+    """Accumulates field errors; one AdmissionDenied with all of them
+    (reference: pkg/validation aggregator — webhooks report every
+    problem in one response, not just the first)."""
+
+    def __init__(self, kind: str, name: str):
+        self.kind = kind
+        self.name = name
+        self.errors: list[str] = []
+
+    def add(self, path: str, message: str) -> None:
+        self.errors.append(f"{path}: {message}")
+
+    def require(self, condition: Any, path: str, message: str) -> None:
+        if not condition:
+            self.add(path, message)
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def raise_if_any(self) -> None:
+        if self.errors:
+            raise AdmissionDenied(
+                f"{self.kind} {self.name!r} is invalid: " + "; ".join(self.errors)
+            )
+
+
+def validate_template_safety(errs: FieldErrors, path: str, text: str) -> bool:
+    """Cheap pre-checks before expression parsing; returns False when the
+    string must not be handed to the evaluator."""
+    if len(text) > MAX_TEMPLATE_LENGTH:
+        errs.add(path, f"template exceeds {MAX_TEMPLATE_LENGTH} bytes")
+        return False
+    if _CONTROL_CHARS.search(text):
+        errs.add(path, "template contains control characters")
+        return False
+    return True
+
+
+def json_size(value: Any) -> int:
+    """Canonical serialized size used for all object-size caps."""
+    try:
+        return len(json.dumps(value, separators=(",", ":"), sort_keys=True))
+    except (TypeError, ValueError):
+        return 0
+
+
+def validate_name(errs: FieldErrors, path: str, name: Optional[str]) -> None:
+    if not name:
+        errs.add(path, "name is required")
+    elif not NAME_RE.match(name):
+        errs.add(path, f"invalid name {name!r} (must be DNS-1123 subdomain)")
+
+
+def walk_strings(value: Any, path: str = ""):
+    """Yield (path, string) pairs for every string in a JSON-like value."""
+    if isinstance(value, str):
+        yield path, value
+    elif isinstance(value, dict):
+        for k, v in value.items():
+            yield from walk_strings(v, f"{path}.{k}" if path else str(k))
+    elif isinstance(value, (list, tuple)):
+        for i, v in enumerate(value):
+            yield from walk_strings(v, f"{path}[{i}]")
+
+
+def find_storage_refs(value: Any, path: str = ""):
+    """Yield (path, refDict) for every storageRef marker in a value.
+
+    Must mirror the runtime's ``is_storage_ref`` exactly
+    (templating/engine.py:81-88 — any dict with a dict-valued
+    ``storageRef`` key counts): anything hydrate would treat as a ref,
+    admission must inspect (reference: offloaded_refs.go:23-207)."""
+    if isinstance(value, dict):
+        ref = value.get("storageRef")
+        if isinstance(ref, dict):
+            yield path, ref
+            return
+        for k, v in value.items():
+            yield from find_storage_refs(v, f"{path}.{k}" if path else str(k))
+    elif isinstance(value, (list, tuple)):
+        for i, v in enumerate(value):
+            yield from find_storage_refs(v, f"{path}[{i}]")

@@ -428,7 +428,12 @@ class TestReviewRegressions:
         assert rt.run_phase(run) == "Succeeded"
         assert ran == ["y"]
 
-    def test_recursive_execute_story_bounded(self, rt):
+    def test_recursive_execute_story_bounded(self):
+        # admission rejects executeStory self-cycles (webhook parity), so
+        # runtime depth-bounding — the defense when admission is bypassed
+        # or a cycle forms across webhook-disabled applies — needs a
+        # webhook-free runtime to be exercised
+        rt = Runtime(enable_webhooks=False)
         rt.apply(make_story("ouroboros", steps=[
             {"name": "again", "type": "executeStory",
              "with": {"storyRef": {"name": "ouroboros"}}},

@@ -1,0 +1,452 @@
+"""Admission webhook layer: the validation matrix per kind.
+
+Mirrors the reference's webhook tests (SURVEY §2.3 —
+internal/webhook/v1alpha1/story_webhook.go validations,
+internal/webhook/runs/v1alpha1/{storyrun,steprun}_webhook.go,
+transport_webhook.go). Each test drives admission through the store the
+way the reference's envtest suites drive the real API server.
+"""
+
+import pytest
+
+from bobrapet_tpu.api.catalog import make_engram_template, make_impulse_template
+from bobrapet_tpu.api.engram import make_engram
+from bobrapet_tpu.api.impulse import make_impulse
+from bobrapet_tpu.api.policy import make_reference_grant
+from bobrapet_tpu.api.runs import make_storyrun
+from bobrapet_tpu.api.story import make_story
+from bobrapet_tpu.api.transport import make_transport
+from bobrapet_tpu.core.object import new_resource
+from bobrapet_tpu.core.store import AdmissionDenied
+from bobrapet_tpu.runtime import Runtime
+
+
+def denied(fn, match=None):
+    with pytest.raises(AdmissionDenied, match=match):
+        fn()
+
+
+class TestStoryWebhook:
+    def test_step_requires_exactly_one_of_ref_or_type(self, rt):
+        denied(lambda: rt.apply(make_story("s1", steps=[{"name": "x"}])),
+               "exactly one of")
+        denied(lambda: rt.apply(make_story("s2", steps=[
+            {"name": "x", "ref": {"name": "e"}, "type": "sleep"}])),
+            "exactly one of")
+
+    def test_duplicate_step_names_rejected(self, rt):
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "a", "type": "condition"},
+            {"name": "a", "type": "condition"},
+        ])), "duplicate step name")
+
+    def test_unknown_needs_rejected(self, rt):
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "a", "type": "condition", "needs": ["ghost"]},
+        ])), "unknown step")
+
+    def test_self_dependency_rejected(self, rt):
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "a", "type": "condition", "needs": ["a"]},
+        ])), "cannot depend on itself")
+
+    def test_needs_cycle_rejected(self, rt):
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "a", "type": "condition", "needs": ["b"]},
+            {"name": "b", "type": "condition", "needs": ["a"]},
+        ])), "cycle")
+
+    def test_batch_only_primitives_rejected_in_realtime(self, rt):
+        for prim in ("wait", "gate"):
+            denied(lambda p=prim: rt.apply(make_story(
+                f"rt-{p}", pattern="realtime",
+                steps=[{"name": "x", "type": p,
+                        **({"with": {"until": "{{ inputs.go }}"}} if p == "wait" else {})}],
+            )), "batch-only")
+
+    def test_sleep_requires_duration(self, rt):
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "z", "type": "sleep"}])), "duration")
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "z", "type": "sleep", "with": {"duration": "not-a-time"}}])),
+            "invalid duration")
+
+    def test_wait_shape(self, rt):
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "w", "type": "wait"}])), "until")
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "w", "type": "wait",
+             "with": {"until": "{{ inputs.x }}", "onTimeout": "explode"}}])),
+            "fail.*or.*skip")
+
+    def test_wait_ontimeout_defaulted(self, rt):
+        rt.apply(make_story("s", steps=[
+            {"name": "w", "type": "wait", "with": {"until": "{{ inputs.x }}"}}]))
+        stored = rt.store.get("Story", "default", "s")
+        assert stored.spec["steps"][0]["with"]["onTimeout"] == "fail"
+
+    def test_execute_story_requires_ref(self, rt):
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "sub", "type": "executeStory"}])), "storyRef")
+
+    def test_execute_story_self_cycle_rejected(self, rt):
+        denied(lambda: rt.apply(make_story("loop", steps=[
+            {"name": "sub", "type": "executeStory",
+             "with": {"storyRef": {"name": "loop"}}}])), "own story")
+
+    def test_execute_story_transitive_cycle_rejected(self, rt):
+        rt.apply(make_story("a", steps=[{"name": "c", "type": "condition"}]))
+        rt.apply(make_story("b", steps=[
+            {"name": "sub", "type": "executeStory",
+             "with": {"storyRef": {"name": "a"}}}]))
+        # now updating `a` to call `b` would close the cycle b -> a -> b
+        denied(lambda: rt.apply(make_story("a", steps=[
+            {"name": "sub", "type": "executeStory",
+             "with": {"storyRef": {"name": "b"}}}])), "cycle")
+
+    def test_parallel_requires_branches(self, rt):
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "p", "type": "parallel"}])), "non-empty")
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "p", "type": "parallel",
+             "with": {"steps": [
+                 {"name": "inner", "type": "parallel",
+                  "with": {"steps": [{"name": "x", "type": "condition"}]}},
+             ]}}])), "nest")
+
+    def test_template_scope_validation(self, rt):
+        # `steps` root is not available in realtime static config scope
+        denied(lambda: rt.apply(make_story(
+            "rts", pattern="realtime",
+            steps=[{"name": "a", "type": "condition",
+                    "with": {"v": "{{ steps.other.output.x }}"}}],
+        )), "steps")
+        # packet root is invalid in batch scope
+        denied(lambda: rt.apply(make_story(
+            "bat", steps=[{"name": "a", "type": "condition",
+                           "with": {"v": "{{ packet.data }}"}}],
+        )), "packet")
+
+    def test_template_syntax_error_rejected(self, rt):
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "a", "type": "condition", "if": "{{ inputs. }}"}])))
+
+    def test_with_size_cap(self, rt):
+        big = {"blob": "x" * (300 * 1024)}  # default cap is 256KiB
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "a", "type": "condition", "with": big}])), "exceeds cap")
+        # the cap is live config (max-story-with-block-size-bytes)
+        rt.config_manager.config.max_story_with_block_size_bytes = 16
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "a", "type": "condition", "with": {"k": "0123456789abcdef"}}])),
+            "exceeds cap")
+
+    def test_execute_story_cycle_through_finally_rejected(self, rt):
+        rt.apply(make_story("fa", steps=[{"name": "c", "type": "condition"}]))
+        rt.apply(make_story("fb", steps=[{"name": "c", "type": "condition"}],
+                            **{"finally": [
+                                {"name": "sub", "type": "executeStory",
+                                 "with": {"storyRef": {"name": "fa"}}}]}))
+        denied(lambda: rt.apply(make_story("fa", steps=[
+            {"name": "sub", "type": "executeStory",
+             "with": {"storyRef": {"name": "fb"}}}])), "cycle")
+
+    def test_policy_timeouts_parsed(self, rt):
+        denied(lambda: rt.apply(make_story(
+            "s", steps=[{"name": "a", "type": "condition"}],
+            policy={"timeouts": {"story": "eleventy"}})), "invalid duration")
+        denied(lambda: rt.apply(make_story(
+            "s", steps=[{"name": "a", "type": "condition"}],
+            policy={"concurrency": 0})), "concurrency")
+
+    def test_valid_story_admitted(self, rt):
+        rt.apply(make_story("good", steps=[
+            {"name": "a", "type": "sleep", "with": {"duration": "1s"}},
+            {"name": "b", "needs": ["a"], "type": "stop",
+             "with": {"phase": "success"}},
+        ], policy={"timeouts": {"story": "5m"}}))
+        assert rt.store.get("Story", "default", "good")
+
+
+class TestEngramImpulseWebhooks:
+    def test_engram_requires_existing_template(self, rt):
+        denied(lambda: rt.apply(make_engram("e", "ghost-tpl")), "not found")
+
+    def test_engram_mode_must_be_supported(self, rt):
+        rt.apply(make_engram_template("tpl", entrypoint="x",
+                                      supportedModes=["job"]))
+        denied(lambda: rt.apply(make_engram("e", "tpl", mode="deployment")),
+               "supportedModes")
+
+    def test_engram_secret_schema_conformance(self, rt):
+        rt.apply(make_engram_template(
+            "tpl", entrypoint="x",
+            secretSchema=[{"name": "api-key", "required": True}]))
+        denied(lambda: rt.apply(make_engram("e", "tpl")), "required secret")
+        denied(lambda: rt.apply(make_engram(
+            "e", "tpl", secrets={"api-key": "s1", "rogue": "s2"})),
+            "not declared")
+        rt.apply(make_engram("e", "tpl", secrets={"api-key": "s1"}))
+
+    def test_impulse_requires_template_and_story(self, rt):
+        denied(lambda: rt.apply(make_impulse("i", "ghost", "story")), "not found")
+        rt.apply(make_impulse_template("itpl", image="img"))
+        denied(lambda: rt.apply(make_impulse("i", "itpl", "")), "storyRef")
+
+    def test_impulse_cross_namespace_denied_by_default(self, rt):
+        rt.apply(make_impulse_template("itpl", image="img"))
+        rt.apply(make_story("target", steps=[{"name": "a", "type": "condition"}],
+                            namespace="other"))
+        denied(lambda: rt.apply(make_impulse(
+            "i", "itpl", "target",
+            storyRef={"name": "target", "namespace": "other"})),
+            "denied by policy")
+
+    def test_impulse_cross_namespace_with_grant(self, rt):
+        rt.config_manager.config.reference_cross_namespace_policy = "grant"
+        rt.apply(make_impulse_template("itpl", image="img"))
+        rt.apply(make_story("target", steps=[{"name": "a", "type": "condition"}],
+                            namespace="other"))
+        rt.apply(make_reference_grant(
+            "allow-impulses", "other",
+            from_=[{"kind": "Impulse", "namespace": "default"}],
+            to=[{"kind": "Story"}],
+        ))
+        rt.apply(make_impulse("i", "itpl", "target",
+                              storyRef={"name": "target", "namespace": "other"}))
+
+
+class TestStoryRunWebhook:
+    def test_story_ref_required(self, rt):
+        denied(lambda: rt.store.create(
+            new_resource("StoryRun", "r", "default", {})), "storyRef")
+
+    def test_inputs_schema_validated(self, rt):
+        rt.apply(make_story(
+            "s", steps=[{"name": "a", "type": "condition"}],
+            inputsSchema={"type": "object", "required": ["msg"],
+                          "properties": {"msg": {"type": "string"}}}))
+        denied(lambda: rt.store.create(make_storyrun("r1", "s", inputs={})),
+               "required property")
+        denied(lambda: rt.store.create(
+            make_storyrun("r2", "s", inputs={"msg": 42})), "expected string")
+        rt.store.create(make_storyrun("r3", "s", inputs={"msg": "ok"}))
+
+    def test_inputs_schema_integer_rejects_bool(self, rt):
+        rt.apply(make_story(
+            "si", steps=[{"name": "a", "type": "condition"}],
+            inputsSchema={"type": "object",
+                          "properties": {"count": {"type": "integer"}}}))
+        denied(lambda: rt.store.create(
+            make_storyrun("rb", "si", inputs={"count": True})),
+            "expected integer")
+
+    def test_status_invariants_hold_on_create_and_full_update(self, rt):
+        rt.apply(make_story("s", steps=[{"name": "a", "type": "condition"}]))
+        # create with bogus caller-supplied status
+        bad = make_storyrun("rc", "s")
+        bad.status = {"observedGeneration": 7}
+        denied(lambda: rt.store.create(bad), "ahead of")
+        # full update carrying a status regression
+        rt.store.create(make_storyrun("ru", "s"))
+        rt.store.patch_status("StoryRun", "default", "ru",
+                              lambda s: s.__setitem__("observedGeneration", 1))
+
+        def regress(r):
+            r.status["observedGeneration"] = 0
+
+        denied(lambda: rt.store.mutate("StoryRun", "default", "ru", regress),
+               "regress")
+
+    def test_inputs_size_cap(self, rt):
+        rt.apply(make_story("s", steps=[{"name": "a", "type": "condition"}]))
+        denied(lambda: rt.store.create(
+            make_storyrun("r", "s", inputs={"blob": "x" * (1100 * 1024)})),
+            "exceeds")
+
+    def test_storage_ref_spoofing_rejected(self, rt):
+        rt.apply(make_story("s", steps=[{"name": "a", "type": "condition"}]))
+        denied(lambda: rt.store.create(make_storyrun(
+            "r", "s",
+            inputs={"stolen": {"storageRef": {"key": "runs/victim-ns/run/x",
+                                              "provider": "memory"}}})),
+            "outside namespace")
+        # a marker buried beside other keys is still a marker at runtime
+        # (is_storage_ref semantics) — admission must see it too
+        denied(lambda: rt.store.create(make_storyrun(
+            "rb", "s",
+            inputs={"d": {"storageRef": {"key": "runs/victim-ns/run/x"},
+                          "pad": 1}})),
+            "outside namespace")
+        # refs under the caller's own canonical scope are legitimate
+        rt.store.create(make_storyrun(
+            "r2", "s",
+            inputs={"mine": {"storageRef": {"key": "runs/default/run/x",
+                                            "provider": "memory"}}}))
+
+    def test_oversized_inputs_offload_then_readmit(self, rt):
+        # the controller's own dehydrated writes (runs/<ns>/... keys) must
+        # pass admission or oversized-input runs wedge in a retry loop
+        from bobrapet_tpu.api.catalog import make_engram_template as mk_tpl
+        from bobrapet_tpu.api.engram import make_engram as mk_eng
+        from bobrapet_tpu.sdk.registry import register_engram
+
+        rt.apply(mk_tpl("t", entrypoint="impl"))
+        rt.apply(mk_eng("w", "t"))
+        register_engram("impl")(lambda ctx: {"n": len(ctx.inputs.get("blob", ""))})
+        rt.apply(make_story("big", steps=[
+            {"name": "a", "ref": {"name": "w"},
+             "with": {"blob": "{{ inputs.blob }}"}}]))
+        run = rt.run_story("big", inputs={"blob": "x" * (80 * 1024)})
+        rt.pump()
+        r = rt.store.get("StoryRun", "default", run)
+        assert r.status["phase"] == "Succeeded", r.status
+
+    def test_cancel_cannot_be_withdrawn(self, rt):
+        rt.apply(make_story("s", steps=[{"name": "a", "type": "condition"}]))
+        rt.store.create(make_storyrun("r", "s"))
+        rt.store.mutate("StoryRun", "default", "r",
+                        lambda r: r.spec.__setitem__("cancelRequested", True))
+        denied(lambda: rt.store.mutate(
+            "StoryRun", "default", "r",
+            lambda r: r.spec.__setitem__("cancelRequested", False)),
+            "withdrawn")
+
+    def test_observed_generation_monotonic(self, rt):
+        rt.apply(make_story("s", steps=[{"name": "a", "type": "condition"}]))
+        rt.store.create(make_storyrun("r", "s"))
+        rt.store.patch_status("StoryRun", "default", "r",
+                              lambda s: s.__setitem__("observedGeneration", 1))
+        denied(lambda: rt.store.patch_status(
+            "StoryRun", "default", "r",
+            lambda s: s.__setitem__("observedGeneration", 0)), "regress")
+        denied(lambda: rt.store.patch_status(
+            "StoryRun", "default", "r",
+            lambda s: s.__setitem__("observedGeneration", 99)), "ahead of")
+
+
+class TestStepRunWebhook:
+    def _mk(self, rt, name="sr", **spec):
+        base = {"storyRunRef": {"name": "run"}, "engramRef": {"name": "e"},
+                "stepId": "s"}
+        base.update(spec)
+        return new_resource("StepRun", name, "default", base)
+
+    def test_required_refs(self, rt):
+        denied(lambda: rt.store.create(
+            new_resource("StepRun", "sr", "default", {})), "storyRunRef")
+
+    def test_downstream_target_shape(self, rt):
+        denied(lambda: rt.store.create(self._mk(
+            rt, downstreamTargets=[{}])), "exactly one")
+        denied(lambda: rt.store.create(self._mk(
+            rt, downstreamTargets=[{"grpc": {"host": "", "port": 9000}}])),
+            "host is required")
+        denied(lambda: rt.store.create(self._mk(
+            rt, downstreamTargets=[{"grpc": {"host": "h", "port": 99999}}])),
+            "port")
+        rt.store.create(self._mk(
+            rt, downstreamTargets=[{"grpc": {"host": "h", "port": 9000}},
+                                   {"terminate": True}]))
+
+    def test_structured_error_contract_on_status(self, rt):
+        rt.store.create(self._mk(rt))
+        denied(lambda: rt.store.patch_status(
+            "StepRun", "default", "sr",
+            lambda s: s.__setitem__("error", {"type": "martian"})),
+            "unknown error type")
+        denied(lambda: rt.store.patch_status(
+            "StepRun", "default", "sr",
+            lambda s: s.__setitem__("error", "exploded")),
+            "StructuredError")
+        rt.store.patch_status(
+            "StepRun", "default", "sr",
+            lambda s: s.__setitem__(
+                "error", {"type": "execution", "message": "boom",
+                          "exitClass": "terminal", "retryable": False}))
+
+    def test_oversized_status_output_rejected(self, rt):
+        rt.store.create(self._mk(rt))
+        denied(lambda: rt.store.patch_status(
+            "StepRun", "default", "sr",
+            lambda s: s.__setitem__("output", {"x": "y" * (1100 * 1024)})),
+            "offload")
+
+
+class TestTriggerClaimWebhooks:
+    def test_trigger_identity_requirements(self, rt):
+        rt.apply(make_story("s", steps=[{"name": "a", "type": "condition"}]))
+
+        def trig(identity):
+            return new_resource("StoryTrigger", "t", "default",
+                                {"storyRef": {"name": "s"}, "identity": identity})
+
+        denied(lambda: rt.store.create(
+            new_resource("StoryTrigger", "t", "default",
+                         {"storyRef": {"name": "s"}})), "identity is required")
+        denied(lambda: rt.store.create(trig({"mode": "key"})), "key")
+        denied(lambda: rt.store.create(trig(
+            {"mode": "keyAndInputHash", "key": "k"})), "inputHash")
+        denied(lambda: rt.store.create(trig(
+            {"mode": "keyAndInputHash", "key": "k", "inputHash": "zz"})),
+            "sha256")
+        denied(lambda: rt.store.create(trig({"mode": "none"})), "submissionId")
+        rt.store.create(trig({"mode": "key", "key": "order-123"}))
+
+    def test_trigger_identity_immutable(self, rt):
+        rt.apply(make_story("s", steps=[{"name": "a", "type": "condition"}]))
+        rt.store.create(new_resource(
+            "StoryTrigger", "t", "default",
+            {"storyRef": {"name": "s"},
+             "identity": {"mode": "key", "key": "k1"}}))
+        denied(lambda: rt.store.mutate(
+            "StoryTrigger", "default", "t",
+            lambda r: r.spec["identity"].__setitem__("key", "k2")),
+            "immutable")
+
+    def test_effect_claim_shape(self, rt):
+        denied(lambda: rt.store.create(
+            new_resource("EffectClaim", "c", "default", {})), "effectId")
+        denied(lambda: rt.store.create(new_resource(
+            "EffectClaim", "c", "default",
+            {"effectId": "charge-1", "stepRunRef": {"name": "sr"},
+             "holderIdentity": "sdk-1", "leaseDurationSeconds": 0})),
+            ">= 1")
+        rt.store.create(new_resource(
+            "EffectClaim", "c", "default",
+            {"effectId": "charge-1", "stepRunRef": {"name": "sr"},
+             "holderIdentity": "sdk-1", "leaseDurationSeconds": 30}))
+
+
+class TestTransportWebhooks:
+    def test_transport_driver_and_provider(self, rt):
+        denied(lambda: rt.store.create(
+            new_resource("Transport", "t", "default", {"driver": "carrier-pigeon"})),
+            "driver")
+        denied(lambda: rt.apply(make_transport("t", "", driver="grpc")), "provider")
+
+    def test_ici_driver_requires_topology(self, rt):
+        denied(lambda: rt.apply(make_transport("t", "tpu", driver="ici")),
+               "meshTopology")
+        rt.apply(make_transport("t", "tpu", driver="ici", meshTopology="4x4"))
+
+    def test_streaming_settings_validated(self, rt):
+        denied(lambda: rt.apply(make_transport(
+            "t", "p", streaming={"delivery": {"semantics": "exactlyOnceHonest"}})),
+            "semantics")
+        denied(lambda: rt.apply(make_transport(
+            "t", "p", streaming={"fanIn": {"mode": "quorum"}})), "quorum")
+        denied(lambda: rt.apply(make_transport(
+            "t", "p", streaming={"lanes": [{"name": "a"}, {"name": "a"}]})),
+            "duplicate lane")
+
+    def test_binding_shape(self, rt):
+        denied(lambda: rt.store.create(
+            new_resource("TransportBinding", "b", "default", {})), "transportRef")
+
+
+class TestWebhookToggle:
+    def test_disabled_webhooks_admit_anything(self):
+        rt = Runtime(enable_webhooks=False)
+        rt.apply(make_story("junk", steps=[{"name": "x"}]))  # no ref/type
+        assert rt.store.get("Story", "default", "junk")
