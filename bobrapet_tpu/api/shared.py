@@ -224,6 +224,7 @@ class WorkloadSpec(SpecBase):
     stateful_set: Optional[StatefulSetWorkloadConfig] = None
     resources: Optional[ResourcePolicy] = None
     update_strategy: Optional[UpdateStrategy] = None
+    replicas: Optional[int] = None  # long-running workloads (impulse/realtime)
 
 
 @dataclasses.dataclass
@@ -279,6 +280,10 @@ class ExecutionPolicy(SpecBase):
     storage: Optional[StoragePolicy] = None
     cache: Optional[CachePolicy] = None
     probes: Optional[ProbeOverrides] = None
+    # namespaced RBAC rules granted to the workload's runner identity
+    # (reference: TemplateExecutionPolicy rbac, catalog shared_types.go:76;
+    # sanitized against the safety allowlist before being applied)
+    rbac_rules: list[dict[str, Any]] = dataclasses.field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,288 @@
+"""Impulse controller: materialize the always-on trigger workload.
+
+Capability parity with the reference's Impulse reconciler
+(reference: internal/controller/impulse_controller.go — Reconcile:134,
+ensureImpulseWorkloads:276, buildImpulsePodTemplate:1437,
+appendTriggerDeliveryEnvVars:1477, syncImpulseTriggerStats:1151):
+
+- resolve the ImpulseTemplate (Blocked when missing; delivery defaults
+  merge template -> impulse),
+- materialize the long-running workload on the bus: a Deployment (or
+  StatefulSet) record + Service + ServiceAccount, pod env carrying the
+  trigger contract (story ref, mapping template, delivery/throttle
+  policy JSON) so the in-pod SDK can create StoryTriggers,
+- sync trigger stats from StoryTriggers/StoryRuns referencing this
+  impulse with idempotent token counting (a run/trigger counts once, an
+  annotation on the counted child records consumption).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Optional
+
+from ..api import conditions
+from ..api.catalog import (
+    CLUSTER_NAMESPACE,
+    IMPULSE_TEMPLATE_KIND,
+    parse_impulse_template,
+)
+from ..api.enums import Phase, TriggerDecision, WorkloadMode
+from ..api.impulse import KIND as IMPULSE_KIND, parse_impulse
+from ..api.runs import STORY_RUN_KIND, STORY_TRIGGER_KIND
+from ..core.events import EventRecorder
+from ..core.object import Resource, new_resource
+from ..core.store import AlreadyExists, ResourceStore
+from ..observability.metrics import metrics
+from ..sdk import contract
+from .manager import Clock
+from .resources import ANNO_COUNTED_IMPULSE, ANNO_COUNTED_IMPULSE_OUTCOME, _consume_tokens
+from .streaming import SERVICE_KIND
+
+_log = logging.getLogger(__name__)
+
+DEPLOYMENT_KIND = "Deployment"
+STATEFULSET_KIND = "StatefulSet"
+SERVICE_ACCOUNT_KIND = "ServiceAccount"
+
+INDEX_TRIGGER_IMPULSE = "impulseRef"
+
+
+class ImpulseController:
+    """(reference: impulse_controller.go Reconcile:134)"""
+
+    def __init__(
+        self,
+        store: ResourceStore,
+        config_manager,
+        recorder: Optional[EventRecorder] = None,
+        clock: Optional[Clock] = None,
+    ):
+        self.store = store
+        self.config_manager = config_manager
+        self.recorder = recorder or EventRecorder()
+        self.clock = clock or Clock()
+
+    # ------------------------------------------------------------------
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        impulse = self.store.try_get(IMPULSE_KIND, namespace, name)
+        if impulse is None or impulse.meta.deletion_timestamp is not None:
+            return None
+        spec = parse_impulse(impulse)
+        now = self.clock.now()
+
+        template_name = spec.template_ref.name if spec.template_ref else ""
+        template = self.store.try_get(
+            IMPULSE_TEMPLATE_KIND, CLUSTER_NAMESPACE, template_name
+        )
+        if template is None:
+            self._set_status(
+                impulse, Phase.BLOCKED, ready=False,
+                reason=conditions.Reason.TEMPLATE_NOT_FOUND,
+                message=f"impulse template {template_name!r} not found",
+            )
+            return None
+        tspec = parse_impulse_template(template)
+
+        story_name = spec.story_ref.name if spec.story_ref else ""
+        if story_name:
+            from ..api.story import KIND as STORY_KIND
+
+            story_ns = (spec.story_ref.namespace or namespace)
+            if self.store.try_get(STORY_KIND, story_ns, story_name) is None:
+                self._set_status(
+                    impulse, Phase.BLOCKED, ready=False,
+                    reason=conditions.Reason.STORY_NOT_FOUND,
+                    message=f"story {story_ns}/{story_name} not found",
+                )
+                return None
+
+        self._ensure_workloads(impulse, spec, tspec)
+        stats = self._sync_trigger_stats(impulse, now)
+
+        self._set_status(
+            impulse, Phase.RUNNING, ready=True,
+            reason=conditions.Reason.LISTENING,
+            message="impulse workload materialized",
+            extra=stats,
+        )
+        return None
+
+    # ------------------------------------------------------------------
+    def _ensure_workloads(self, impulse: Resource, spec, tspec) -> None:
+        """(reference: ensureImpulseWorkloads impulse_controller.go:276,
+        buildImpulsePodTemplate:1437)"""
+        ns, name = impulse.meta.namespace, impulse.meta.name
+        owner = [impulse.owner_ref()]
+        mode = (
+            (spec.workload.mode if spec.workload and spec.workload.mode else None)
+            or WorkloadMode.DEPLOYMENT
+        )
+        kind = STATEFULSET_KIND if mode == WorkloadMode.STATEFULSET else DEPLOYMENT_KIND
+        cfg = self.config_manager.config
+
+        # delivery defaults merge: template recommendation -> impulse spec
+        # (reference: appendTriggerDeliveryEnvVars:1477)
+        delivery = (
+            spec.delivery.to_dict() if spec.delivery is not None
+            else (tspec.delivery.to_dict() if tspec.delivery is not None else {})
+        )
+        env: dict[str, str] = {
+            contract.ENV_CONTRACT_VERSION: contract.CONTRACT_VERSION,
+            contract.ENV_NAMESPACE: ns,
+            contract.ENV_IMPULSE: name,
+            contract.ENV_GRPC_PORT: str(cfg.engram.grpc_port),
+            contract.ENV_MAX_INLINE_SIZE: str(cfg.engram.max_inline_size),
+            contract.ENV_TRIGGER_STORY: (
+                spec.story_ref.name if spec.story_ref else ""
+            ),
+            contract.ENV_TRIGGER_DELIVERY: json.dumps(
+                delivery, separators=(",", ":"), sort_keys=True
+            ),
+        }
+        if spec.story_ref and spec.story_ref.namespace:
+            env[contract.ENV_TRIGGER_STORY_NAMESPACE] = spec.story_ref.namespace
+        if spec.mapping:
+            env[contract.ENV_TRIGGER_MAPPING] = json.dumps(
+                spec.mapping, separators=(",", ":"), sort_keys=True
+            )
+        if spec.throttle is not None:
+            env[contract.ENV_TRIGGER_THROTTLE] = json.dumps(
+                spec.throttle.to_dict(), separators=(",", ":"), sort_keys=True
+            )
+        if spec.with_config:
+            env[contract.ENV_CONFIG] = json.dumps(
+                spec.with_config, separators=(",", ":"), sort_keys=True
+            )
+
+        sa_name = f"{name}-impulse-sa"
+        rbac_rules = (
+            list(tspec.execution_policy.rbac_rules)
+            if tspec.execution_policy and tspec.execution_policy.rbac_rules
+            else []
+        )
+        self._apply(new_resource(
+            SERVICE_ACCOUNT_KIND, sa_name, ns,
+            spec={"rbacRules": rbac_rules} if rbac_rules else {},
+            owners=owner,
+        ))
+        self._apply(new_resource(
+            kind, f"{name}-impulse", ns,
+            spec={
+                "image": tspec.image,
+                "replicas": (
+                    spec.workload.replicas
+                    if spec.workload and spec.workload.replicas is not None
+                    else 1
+                ),
+                "env": env,
+                "serviceAccountName": sa_name,
+                "selector": {"bobrapet.io/impulse": name},
+                "secrets": dict(spec.secrets or {}),
+            },
+            labels={"bobrapet.io/impulse": name},
+            owners=owner,
+        ))
+        self._apply(new_resource(
+            SERVICE_KIND, f"{name}-impulse-svc", ns,
+            spec={
+                "selector": {"bobrapet.io/impulse": name},
+                "port": cfg.engram.grpc_port,
+            },
+            owners=owner,
+        ))
+
+    def _apply(self, desired: Resource) -> None:
+        """Create-or-update keyed on spec equality
+        (reference: pkg/workload Ensure ensure.go:58 with
+        normalization-aware diffing)."""
+        try:
+            self.store.create(desired)
+        except AlreadyExists:
+            existing = self.store.try_get(
+                desired.kind, desired.meta.namespace, desired.meta.name
+            )
+            if existing is not None and existing.spec != desired.spec:
+                def sync(r: Resource) -> None:
+                    r.spec = dict(desired.spec)
+
+                self.store.mutate(
+                    desired.kind, desired.meta.namespace, desired.meta.name, sync
+                )
+
+    # ------------------------------------------------------------------
+    def _sync_trigger_stats(self, impulse: Resource, now: float) -> dict[str, int]:
+        """(reference: syncImpulseTriggerStats impulse_controller.go:1151
+        — token-based idempotent counting)"""
+        ns, name = impulse.meta.namespace, impulse.meta.name
+        triggers = self.store.list(
+            STORY_TRIGGER_KIND, namespace=ns, index=(INDEX_TRIGGER_IMPULSE, name)
+        )
+        runs = self.store.list(
+            STORY_RUN_KIND, namespace=ns, index=(INDEX_TRIGGER_IMPULSE, name)
+        )
+
+        received_inc = _consume_tokens(
+            self.store, triggers, ANNO_COUNTED_IMPULSE, now
+        ).get("", 0)
+        launched_inc = _consume_tokens(
+            self.store, runs, ANNO_COUNTED_IMPULSE, now
+        ).get("", 0)
+
+        def outcome(run: Resource) -> Optional[str]:
+            phase = run.status.get("phase")
+            if not phase or not Phase(phase).is_terminal:
+                return None  # count outcomes only when terminal
+            return "success" if phase == str(Phase.SUCCEEDED) else "failed"
+
+        outcome_inc = _consume_tokens(
+            self.store, runs, ANNO_COUNTED_IMPULSE_OUTCOME, now, value_fn=outcome
+        )
+        throttled = sum(
+            1 for t in triggers
+            if t.status.get("decision") == str(TriggerDecision.REJECTED)
+            and t.status.get("reason") == "Throttled"
+        )
+        metrics.trigger_backfills.inc(IMPULSE_KIND)
+        return {
+            "_received": received_inc,
+            "_launched": launched_inc,
+            "_succeeded": outcome_inc.get("success", 0),
+            "_failed": outcome_inc.get("failed", 0),
+            "_throttled": throttled,
+        }
+
+    # ------------------------------------------------------------------
+    def _set_status(
+        self,
+        impulse: Resource,
+        phase: Phase,
+        ready: bool,
+        reason: str,
+        message: str,
+        extra: Optional[dict[str, int]] = None,
+    ) -> None:
+        now = self.clock.now()
+        extra = extra or {}
+
+        def patch(st: dict[str, Any]) -> None:
+            st["phase"] = str(phase)
+            st["observedGeneration"] = impulse.meta.generation
+            st["triggersReceived"] = int(st.get("triggersReceived", 0)) + extra.get("_received", 0)
+            st["storiesLaunched"] = int(st.get("storiesLaunched", 0)) + extra.get("_launched", 0)
+            st["storiesSucceeded"] = int(st.get("storiesSucceeded", 0)) + extra.get("_succeeded", 0)
+            st["storiesFailed"] = int(st.get("storiesFailed", 0)) + extra.get("_failed", 0)
+            st["triggersThrottled"] = extra.get("_throttled", st.get("triggersThrottled", 0))
+            conds = st.setdefault("conditions", [])
+            conditions.set_condition(
+                conds, conditions.READY, ready, reason, message, now=now
+            )
+            conditions.set_condition(
+                conds, conditions.LISTENING, phase is Phase.RUNNING,
+                reason, message, now=now,
+            )
+
+        self.store.patch_status(IMPULSE_KIND, impulse.meta.namespace, impulse.meta.name, patch)
+        if not ready:
+            self.recorder.warning(impulse, reason, message)

@@ -1,0 +1,356 @@
+"""Definition-resource controllers: Story, Engram, catalog templates.
+
+Capability parity with the reference's definition-side reconcilers
+(reference: internal/controller/story_controller.go:247,
+internal/controller/engram_controller.go:122,
+internal/controller/catalog/{engramtemplate,impulsetemplate}_controller.go):
+
+- **StoryController** — cross-resource validation (step engram refs exist
+  + mode compatibility, executeStory targets exist, step transports are
+  declared on the story, declared transports resolve), status rollup
+  (stepsTotal, transportMode hot/fallback, validationStatus +
+  errors/warnings), and token-based idempotent run/trigger counting
+  (reference: countStoryTriggersBounded story_controller.go:1212,
+  markUsageDirty:119).
+- **EngramController** — templateRef validation + mode support, usage
+  counters (Stories referencing) and trigger counters (StepRuns), phase.
+- **EngramTemplateController / ImpulseTemplateController** — spec
+  validation + usage counts
+  (reference: internal/controller/catalog/template_helpers.go).
+
+Counting is *token-based and idempotent*: each StoryRun/StepRun counts at
+most once per counter family, recorded by an annotation on the counted
+child (reference: trigger_annotations.go:48-179); a bounded batch is
+consumed per reconcile so a large backlog cannot stall the reconciler.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from ..api import conditions
+from ..api.catalog import (
+    CLUSTER_NAMESPACE,
+    ENGRAM_TEMPLATE_KIND,
+    IMPULSE_TEMPLATE_KIND,
+    parse_engram_template,
+)
+from ..api.engram import KIND as ENGRAM_KIND, parse_engram
+from ..api.enums import Phase, StepType, ValidationStatus, WorkloadMode
+from ..api.impulse import KIND as IMPULSE_KIND
+from ..api.runs import STEP_RUN_KIND, STORY_RUN_KIND
+from ..api.story import KIND as STORY_KIND, Step, parse_story
+from ..api.transport import TRANSPORT_KIND
+from ..core.events import EventRecorder
+from ..core.store import NotFound, ResourceStore
+
+_log = logging.getLogger(__name__)
+
+# annotation families marking a child as already counted
+# (reference: trigger_annotations.go:48 — `story`, `impulse`,
+# `impulse-success`, `impulse-failed` token families)
+ANNO_COUNTED_STORY = "runs.bobrapet.io/counted-story"
+ANNO_COUNTED_ENGRAM = "runs.bobrapet.io/counted-engram"
+ANNO_COUNTED_IMPULSE = "runs.bobrapet.io/counted-impulse"
+ANNO_COUNTED_IMPULSE_OUTCOME = "runs.bobrapet.io/counted-impulse-outcome"
+
+# bounded backfill batch per reconcile
+# (reference: countStoryTriggersBounded story_controller.go:1212)
+COUNT_BATCH = 50
+
+INDEX_STORY_ENGRAM_REFS = "stepEngramRefs"
+INDEX_STORY_EXECUTE_REFS = "executeStoryRefs"
+INDEX_STORY_TRANSPORT_REFS = "transportRefs"
+INDEX_STORYRUN_STORY = "storyRef"
+INDEX_STEPRUN_ENGRAM = "engramRef"
+INDEX_ENGRAM_TEMPLATE = "templateRef"
+
+
+def _consume_tokens(
+    store: ResourceStore,
+    children,
+    annotation: str,
+    clock_now: float,
+    value_fn=None,
+) -> dict[str, int]:
+    """Idempotently count un-counted children, annotating each consumed
+    one. Returns {bucket: increment}; bucket "" is the total family.
+    ``value_fn(child) -> Optional[str]`` selects an outcome bucket (and
+    may return None to defer counting, e.g. until a run is terminal)."""
+    increments: dict[str, int] = {}
+    consumed = 0
+    for child in children:
+        if consumed >= COUNT_BATCH:
+            break
+        if annotation in child.meta.annotations:
+            continue
+        bucket = ""
+        if value_fn is not None:
+            maybe = value_fn(child)
+            if maybe is None:
+                continue  # not countable yet (e.g. still running)
+            bucket = maybe
+        try:
+            store.mutate(
+                child.kind,
+                child.meta.namespace,
+                child.meta.name,
+                lambda r: r.meta.annotations.__setitem__(annotation, str(clock_now)),
+            )
+        except NotFound:
+            continue
+        increments[bucket] = increments.get(bucket, 0) + 1
+        consumed += 1
+    return increments
+
+
+class StoryController:
+    """(reference: story_controller.go Reconcile:247)"""
+
+    def __init__(self, store: ResourceStore, recorder: Optional[EventRecorder] = None,
+                 clock=None):
+        self.store = store
+        self.recorder = recorder or EventRecorder()
+        self.clock = clock
+
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        story = self.store.try_get(STORY_KIND, namespace, name)
+        if story is None or story.meta.deletion_timestamp is not None:
+            return None
+        spec = parse_story(story)
+        errors: list[str] = []
+        warnings: list[str] = []
+
+        all_steps = spec.all_steps()
+        realtime = spec.effective_pattern.value == "realtime"
+        declared_transports = {t.name or t.transport_ref for t in (spec.transports or [])}
+
+        for step in all_steps:
+            self._validate_step(namespace, spec, step, realtime, declared_transports,
+                                errors, warnings)
+
+        for t in spec.transports or []:
+            tname = t.transport_ref or t.name
+            if tname and self.store.try_get(TRANSPORT_KIND, CLUSTER_NAMESPACE, tname) is None:
+                errors.append(f"transport {tname!r} not found")
+
+        transport_mode = self._determine_transport_mode(spec, realtime, errors)
+
+        runs = self.store.list(STORY_RUN_KIND, namespace=namespace,
+                               index=(INDEX_STORYRUN_STORY, name))
+        active = sum(
+            1 for r in runs
+            if r.status.get("phase") and not Phase(r.status["phase"]).is_terminal
+        )
+        now = self.clock.now() if self.clock else 0.0
+        inc = _consume_tokens(self.store, runs, ANNO_COUNTED_STORY, now)
+
+        status = ValidationStatus.INVALID if errors else ValidationStatus.VALID
+
+        def patch(st: dict[str, Any]) -> None:
+            st["stepsTotal"] = len(all_steps)
+            st["validationStatus"] = str(status)
+            st["validationErrors"] = errors
+            st["validationWarnings"] = warnings
+            st["transportMode"] = transport_mode
+            st["activeRuns"] = active
+            st["runsTriggered"] = int(st.get("runsTriggered", 0)) + inc.get("", 0)
+            st["observedGeneration"] = story.meta.generation
+            conds = st.setdefault("conditions", [])
+            conditions.set_condition(
+                conds, conditions.READY, not errors,
+                conditions.Reason.VALIDATION_PASSED if not errors
+                else conditions.Reason.VALIDATION_FAILED,
+                "; ".join(errors) or "story validated", now=now,
+            )
+
+        self.store.patch_status(STORY_KIND, namespace, name, patch)
+        if errors:
+            self.recorder.warning(
+                story, conditions.Reason.VALIDATION_FAILED, "; ".join(errors)
+            )
+        # more un-counted runs than one batch -> come back soon
+        uncounted = sum(
+            1 for r in runs if ANNO_COUNTED_STORY not in r.meta.annotations
+        )
+        return 1.0 if uncounted > COUNT_BATCH else None
+
+    # ------------------------------------------------------------------
+    def _validate_step(self, namespace, spec, step: Step, realtime,
+                       declared_transports, errors, warnings) -> None:
+        if step.ref is not None and step.ref.name:
+            engram = self.store.try_get(ENGRAM_KIND, namespace, step.ref.name)
+            if engram is None:
+                errors.append(f"step {step.name!r}: engram {step.ref.name!r} not found")
+            else:
+                self._check_mode_compat(step, parse_engram(engram), realtime,
+                                        errors, warnings)
+        if step.type == StepType.EXECUTE_STORY:
+            ref = (step.with_ or {}).get("storyRef") or {}
+            target = ref.get("name")
+            target_ns = ref.get("namespace") or namespace
+            if target and self.store.try_get(STORY_KIND, target_ns, target) is None:
+                errors.append(f"step {step.name!r}: executeStory target {target!r} not found")
+        if step.transport and step.transport not in declared_transports:
+            errors.append(
+                f"step {step.name!r}: transport {step.transport!r} not declared on story"
+            )
+
+    def _check_mode_compat(self, step: Step, engram_spec, realtime: bool,
+                           errors, warnings) -> None:
+        """(reference: validateStoryStep story_controller.go:734 — engram
+        mode must suit the story pattern)"""
+        template = self.store.try_get(
+            ENGRAM_TEMPLATE_KIND, CLUSTER_NAMESPACE,
+            engram_spec.template_ref.name if engram_spec.template_ref else "",
+        )
+        mode = engram_spec.mode
+        if mode is None and template is not None:
+            modes = parse_engram_template(template).supported_modes or []
+            mode = modes[0] if modes else None
+        if mode is None:
+            return
+        if realtime and mode == WorkloadMode.JOB:
+            warnings.append(
+                f"step {step.name!r}: job-mode engram in a realtime story runs batch"
+            )
+        if not realtime and mode != WorkloadMode.JOB:
+            warnings.append(
+                f"step {step.name!r}: {mode}-mode engram in a batch story"
+            )
+
+    def _determine_transport_mode(self, spec, realtime: bool, errors) -> str:
+        """hot when a realtime story has all its declared transports
+        resolvable; fallback otherwise
+        (reference: determineTransportMode story_controller.go:603)."""
+        if not realtime:
+            return ""
+        if spec.transports and not errors:
+            return "hot"
+        return "fallback"
+
+
+class EngramController:
+    """(reference: engram_controller.go Reconcile:122)"""
+
+    def __init__(self, store: ResourceStore, recorder: Optional[EventRecorder] = None,
+                 clock=None):
+        self.store = store
+        self.recorder = recorder or EventRecorder()
+        self.clock = clock
+
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        engram = self.store.try_get(ENGRAM_KIND, namespace, name)
+        if engram is None or engram.meta.deletion_timestamp is not None:
+            return None
+        spec = parse_engram(engram)
+        errors: list[str] = []
+        template_name = spec.template_ref.name if spec.template_ref else ""
+        template = self.store.try_get(ENGRAM_TEMPLATE_KIND, CLUSTER_NAMESPACE, template_name)
+        if template is None:
+            errors.append(f"engram template {template_name!r} not found")
+        elif spec.mode is not None:
+            tspec = parse_engram_template(template)
+            if tspec.supported_modes and not tspec.supports_mode(spec.mode):
+                errors.append(
+                    f"mode {spec.mode} not supported by template {template_name!r} "
+                    f"(supports {[str(m) for m in tspec.supported_modes]})"
+                )
+
+        # usage: stories whose steps reference this engram
+        # (reference: countEngramUsage engram_controller.go:323)
+        stories = self.store.list(STORY_KIND, namespace=namespace,
+                                  index=(INDEX_STORY_ENGRAM_REFS, name))
+        stepruns = self.store.list(STEP_RUN_KIND, namespace=namespace,
+                                   index=(INDEX_STEPRUN_ENGRAM, name))
+        active = sum(
+            1 for sr in stepruns
+            if sr.status.get("phase") and not Phase(sr.status["phase"]).is_terminal
+        )
+        now = self.clock.now() if self.clock else 0.0
+        inc = _consume_tokens(self.store, stepruns, ANNO_COUNTED_ENGRAM, now)
+
+        def patch(st: dict[str, Any]) -> None:
+            st["phase"] = str(Phase.FAILED if errors else Phase.RUNNING)
+            st["usedByStories"] = sorted(s.meta.name for s in stories)
+            st["usageCount"] = len(stories)
+            st["activeStepRuns"] = active
+            st["triggerCount"] = int(st.get("triggerCount", 0)) + inc.get("", 0)
+            st["observedGeneration"] = engram.meta.generation
+            conds = st.setdefault("conditions", [])
+            conditions.set_condition(
+                conds, conditions.TEMPLATE_RESOLVED, template is not None,
+                conditions.Reason.TEMPLATE_RESOLVED if template is not None
+                else conditions.Reason.TEMPLATE_NOT_FOUND,
+                errors[0] if errors else f"template {template_name!r} resolved",
+                now=now,
+            )
+            conditions.set_condition(
+                conds, conditions.READY, not errors,
+                conditions.Reason.VALIDATION_PASSED if not errors
+                else conditions.Reason.VALIDATION_FAILED,
+                "; ".join(errors) or "engram ready", now=now,
+            )
+
+        self.store.patch_status(ENGRAM_KIND, namespace, name, patch)
+        return None
+
+
+class TemplateController:
+    """Shared EngramTemplate/ImpulseTemplate reconcile
+    (reference: internal/controller/catalog/template_helpers.go)."""
+
+    def __init__(self, store: ResourceStore, kind: str, user_kind: str,
+                 recorder: Optional[EventRecorder] = None, clock=None):
+        self.store = store
+        self.kind = kind
+        self.user_kind = user_kind  # Engram or Impulse
+        self.recorder = recorder or EventRecorder()
+        self.clock = clock
+
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        template = self.store.try_get(self.kind, CLUSTER_NAMESPACE, name)
+        if template is None or template.meta.deletion_timestamp is not None:
+            return None
+        errors: list[str] = []
+        spec = template.spec
+        if not spec.get("image") and not spec.get("entrypoint"):
+            errors.append("one of spec.image or spec.entrypoint is required")
+        modes = spec.get("supportedModes") or []
+        for m in modes:
+            try:
+                WorkloadMode(m)
+            except ValueError:
+                errors.append(f"unsupported mode {m!r}")
+
+        users = self.store.list(self.user_kind, index=(INDEX_ENGRAM_TEMPLATE, name))
+        now = self.clock.now() if self.clock else 0.0
+
+        def patch(st: dict[str, Any]) -> None:
+            st["validationStatus"] = str(
+                ValidationStatus.INVALID if errors else ValidationStatus.VALID
+            )
+            st["validationErrors"] = errors
+            st["usageCount"] = len(users)
+            st["usedBy"] = sorted(
+                f"{u.meta.namespace}/{u.meta.name}" for u in users
+            )
+            st["observedGeneration"] = template.meta.generation
+            conds = st.setdefault("conditions", [])
+            conditions.set_condition(
+                conds, conditions.READY, not errors,
+                conditions.Reason.VALIDATION_PASSED if not errors
+                else conditions.Reason.VALIDATION_FAILED,
+                "; ".join(errors) or "template validated", now=now,
+            )
+
+        self.store.patch_status(self.kind, CLUSTER_NAMESPACE, name, patch)
+        return None
+
+
+def make_catalog_controllers(store: ResourceStore, recorder=None, clock=None):
+    return (
+        TemplateController(store, ENGRAM_TEMPLATE_KIND, ENGRAM_KIND, recorder, clock),
+        TemplateController(store, IMPULSE_TEMPLATE_KIND, IMPULSE_KIND, recorder, clock),
+    )

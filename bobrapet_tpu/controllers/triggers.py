@@ -1,0 +1,414 @@
+"""StoryTrigger admission + EffectClaim lease controllers.
+
+Capability parity with the reference's durable-trigger and effect-lease
+reconcilers (reference:
+internal/controller/runs/storytrigger_controller.go:70-543,
+internal/controller/runs/effectclaim_controller.go:57-187).
+
+- **StoryTriggerController** — durable trigger admission: validate the
+  dedupe identity, verify story access + version pinning
+  (storytrigger_controller.go:101-109), dehydrate oversized inputs,
+  create-or-adopt a StoryRun under a deterministic name derived from the
+  identity, and resolve the decision to Created / Reused / Rejected.
+  The trigger CR is the durable record: the impulse can crash after
+  creating it and the run is still admitted exactly once.
+- **EffectClaimController** — owns the lease lifecycle for one external
+  side effect: Reserved while the holder's lease is live, Completed /
+  Released on SDK report, Abandoned once the lease expires un-renewed
+  (stale takeover: a new holder may then acquire a fresh claim).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from ..api import conditions
+from ..api.enums import EffectClaimPhase, Phase, TriggerDecision
+from ..api.runs import (
+    EFFECT_CLAIM_KIND,
+    STEP_RUN_KIND,
+    STORY_RUN_KIND,
+    STORY_TRIGGER_KIND,
+    parse_effectclaim,
+    parse_storytrigger,
+)
+from ..api.story import KIND as STORY_KIND
+from ..core.events import EventRecorder
+from ..core.object import Resource, new_resource
+from ..core.store import AdmissionDenied, AlreadyExists, NotFound, ResourceStore
+from ..observability.metrics import metrics
+from ..utils.hashing import hash_inputs
+from ..utils.naming import compose, short_hash
+from .manager import Clock
+
+_log = logging.getLogger(__name__)
+
+# annotations stamped on the StoryRun so later triggers can be matched
+# against the run that admitted them
+# (reference: storyRunMatchesTrigger storytrigger_controller.go:331)
+ANNO_TRIGGER_UID = "runs.bobrapet.io/trigger-uid"
+ANNO_TRIGGER_INPUT_HASH = "runs.bobrapet.io/trigger-input-hash"
+ANNO_TRIGGER_KEY = "runs.bobrapet.io/trigger-key"
+
+DEFAULT_LEASE_SECONDS = 60
+
+
+def derive_storyrun_name(story: str, identity) -> str:
+    """Deterministic StoryRun name from the dedupe identity
+    (reference: identity.DeriveStoryRunName
+    pkg/runs/identity/storyrun_trigger.go:35 — key-based when available,
+    hash fallback otherwise)."""
+    mode = (identity.mode if identity else None) or "none"
+    if mode in ("key", "keyAndInputHash") and identity.key:
+        token = identity.key
+        if mode == "keyAndInputHash" and identity.input_hash:
+            token = f"{token}.{identity.input_hash[:12]}"
+    else:
+        token = identity.submission_id if identity and identity.submission_id else ""
+    return compose(story, "trig", short_hash(f"{mode}:{token}"))
+
+
+class StoryTriggerController:
+    """(reference: storytrigger_controller.go Reconcile:70)"""
+
+    def __init__(
+        self,
+        store: ResourceStore,
+        storage,
+        config_manager,
+        recorder: Optional[EventRecorder] = None,
+        clock: Optional[Clock] = None,
+    ):
+        self.store = store
+        self.storage = storage
+        self.config_manager = config_manager
+        self.recorder = recorder or EventRecorder()
+        self.clock = clock or Clock()
+
+    # ------------------------------------------------------------------
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        trigger = self.store.try_get(STORY_TRIGGER_KIND, namespace, name)
+        if trigger is None or trigger.meta.deletion_timestamp is not None:
+            return None
+        decision = trigger.status.get("decision")
+        if decision in (
+            str(TriggerDecision.CREATED),
+            str(TriggerDecision.REUSED),
+            str(TriggerDecision.REJECTED),
+        ):
+            return None
+
+        spec = parse_storytrigger(trigger)
+        story_name = spec.story_ref.name if spec.story_ref else ""
+        story_ns = (spec.story_ref.namespace if spec.story_ref else None) or namespace
+
+        # cross-namespace story access is governed by the reference policy
+        # (reference: validateStoryRefAccess storytrigger_controller.go:157)
+        if story_ns != namespace:
+            from ..webhooks.policy import cross_namespace_allowed
+
+            if not cross_namespace_allowed(
+                self.store, self.config_manager,
+                from_kind=STORY_TRIGGER_KIND, from_namespace=namespace,
+                to_kind=STORY_KIND, to_namespace=story_ns, to_name=story_name,
+            ):
+                return self._resolve(
+                    trigger, TriggerDecision.REJECTED,
+                    reason="CrossNamespaceDenied",
+                    message=f"access to story {story_ns}/{story_name} denied by policy",
+                )
+
+        story = self.store.try_get(STORY_KIND, story_ns, story_name)
+        if story is None:
+            return self._resolve(
+                trigger, TriggerDecision.REJECTED,
+                reason=conditions.Reason.STORY_NOT_FOUND,
+                message=f"story {story_ns}/{story_name} not found",
+            )
+
+        # version pinning (reference: storytrigger_controller.go:101-109)
+        pinned = spec.story_ref.version if spec.story_ref else None
+        actual = story.spec.get("version")
+        if pinned and actual and pinned != actual:
+            return self._resolve(
+                trigger, TriggerDecision.REJECTED,
+                reason="StoryVersionMismatch",
+                message=f"trigger pinned to story version {pinned!r}, found {actual!r}",
+            )
+
+        run_name = derive_storyrun_name(story_name, spec.identity)
+        input_hash = spec.identity.input_hash if spec.identity else None
+        if not input_hash:
+            input_hash = hash_inputs(spec.inputs or {})
+
+        existing = self.store.try_get(STORY_RUN_KIND, namespace, run_name)
+        if existing is not None:
+            return self._adopt(trigger, existing, input_hash)
+
+        throttle_msg = self._throttle_check(spec, namespace)
+        if throttle_msg is not None:
+            return self._resolve(
+                trigger, TriggerDecision.REJECTED,
+                reason="Throttled", message=throttle_msg,
+            )
+
+        run = self._desired_storyrun(trigger, spec, run_name, story_ns, input_hash)
+        try:
+            self.store.create(run)
+        except AlreadyExists:
+            existing = self.store.try_get(STORY_RUN_KIND, namespace, run_name)
+            if existing is None:
+                return 0.5  # race with deletion; retry
+            return self._adopt(trigger, existing, input_hash)
+        except AdmissionDenied as e:
+            # the durable-admission contract always resolves: an inadmissible
+            # run (schema violation, size cap, cross-ns policy on the run
+            # kind) is a Rejected decision, not a crash-loop
+            return self._resolve(
+                trigger, TriggerDecision.REJECTED,
+                reason="StoryRunInadmissible", message=str(e),
+            )
+        
+        return self._resolve(
+            trigger, TriggerDecision.CREATED, storyrun=run_name,
+            reason="StoryRunCreated", message=f"created StoryRun {run_name}",
+        )
+
+    # ------------------------------------------------------------------
+    def _throttle_check(self, spec, namespace: str) -> Optional[str]:
+        """Enforce the impulse's maxInFlight throttle at admission
+        (reference: TriggerThrottlePolicy shared_types.go:341; the
+        rate/burst half is paced SDK-side, in-flight is a control-plane
+        invariant). Returns a rejection message when throttled."""
+        if spec.impulse_ref is None or not spec.impulse_ref.name:
+            return None
+        from ..api.impulse import KIND as IMPULSE_KIND, parse_impulse
+
+        impulse = self.store.try_get(IMPULSE_KIND, namespace, spec.impulse_ref.name)
+        if impulse is None:
+            return None
+        ispec = parse_impulse(impulse)
+        throttle = ispec.throttle or (
+            ispec.delivery.throttle if ispec.delivery is not None else None
+        )
+        if throttle is None or not throttle.max_in_flight:
+            return None
+        runs = self.store.list(
+            STORY_RUN_KIND, namespace=namespace,
+            index=("impulseRef", spec.impulse_ref.name),
+        )
+        in_flight = sum(
+            1 for r in runs
+            if not r.status.get("phase")
+            or not Phase(r.status["phase"]).is_terminal
+        )
+        if in_flight < throttle.max_in_flight:
+            return None
+        return (
+            f"impulse {spec.impulse_ref.name!r} has {in_flight} runs "
+            f"in flight (maxInFlight={throttle.max_in_flight})"
+        )
+
+    # ------------------------------------------------------------------
+    def _desired_storyrun(
+        self, trigger: Resource, spec, run_name: str, story_ns: str, input_hash: str
+    ) -> Resource:
+        """(reference: desiredStoryRunForTrigger
+        storytrigger_controller.go:292 + oversized-input dehydration
+        prepareStoryRunForCreate:237)"""
+        inputs = spec.inputs or {}
+        # canonical offload scope "runs/<ns>/<run>/..." — the StoryRun
+        # webhook rejects storage refs outside it (spoofing guard)
+        inputs = self.storage.dehydrate_inputs(
+            inputs, key_prefix=f"runs/{trigger.meta.namespace}/{run_name}/inputs"
+        )
+        run_spec: dict[str, Any] = {
+            "storyRef": {"name": spec.story_ref.name, "namespace": story_ns},
+            "inputs": inputs,
+        }
+        if spec.impulse_ref is not None:
+            run_spec["impulseRef"] = spec.impulse_ref.to_dict()
+        return new_resource(
+            STORY_RUN_KIND,
+            run_name,
+            trigger.meta.namespace,
+            spec=run_spec,
+            labels={"bobrapet.io/story": spec.story_ref.name},
+            annotations={
+                ANNO_TRIGGER_UID: trigger.meta.uid,
+                ANNO_TRIGGER_INPUT_HASH: input_hash,
+                ANNO_TRIGGER_KEY: (spec.identity.key if spec.identity else "") or "",
+            },
+        )
+
+    # ------------------------------------------------------------------
+    def _adopt(self, trigger: Resource, run: Resource, input_hash: str):
+        """Decide recovered-Created vs Reused vs Rejected-conflict against
+        an existing run (reference: storytrigger_controller.go:120-140)."""
+        run_uid = run.meta.annotations.get(ANNO_TRIGGER_UID, "")
+        run_hash = run.meta.annotations.get(ANNO_TRIGGER_INPUT_HASH, "")
+        if run_uid == trigger.meta.uid:
+            # we created it earlier and crashed before resolving
+            return self._resolve(
+                trigger, TriggerDecision.CREATED, storyrun=run.meta.name,
+                reason="StoryRunRecovered",
+                message=f"recovered StoryRun {run.meta.name}",
+            )
+        if run_hash and run_hash == input_hash:
+            return self._resolve(
+                trigger, TriggerDecision.REUSED, storyrun=run.meta.name,
+                reason="StoryRunReused",
+                message=f"identical delivery matched StoryRun {run.meta.name}",
+            )
+        return self._resolve(
+            trigger, TriggerDecision.REJECTED,
+            reason="IdentityConflict",
+            message=(
+                f"StoryRun {run.meta.name} exists for this identity "
+                "with different inputs"
+            ),
+        )
+
+    # ------------------------------------------------------------------
+    def _resolve(
+        self,
+        trigger: Resource,
+        decision: TriggerDecision,
+        storyrun: str = "",
+        reason: str = "",
+        message: str = "",
+    ) -> None:
+        """(reference: markResolved storytrigger_controller.go:467)"""
+        now = self.clock.now()
+
+        def patch(st: dict[str, Any]) -> None:
+            st["decision"] = str(decision)
+            st["reason"] = reason
+            st["message"] = message
+            if storyrun:
+                st["storyRunName"] = storyrun
+            st["resolvedAt"] = now
+            conds = st.setdefault("conditions", [])
+            conditions.set_condition(
+                conds, conditions.READY,
+                decision is not TriggerDecision.REJECTED,
+                reason or str(decision), message, now=now,
+            )
+
+        self.store.patch_status(
+            STORY_TRIGGER_KIND, trigger.meta.namespace, trigger.meta.name, patch
+        )
+        metrics.trigger_decisions.inc(str(decision))
+        if decision is TriggerDecision.REJECTED:
+            self.recorder.warning(trigger, reason or "Rejected", message)
+        else:
+            self.recorder.normal(trigger, reason or str(decision), message)
+        return None
+
+
+class EffectClaimController:
+    """(reference: effectclaim_controller.go Reconcile:57,
+    effectClaimLifecycle:163)"""
+
+    def __init__(
+        self,
+        store: ResourceStore,
+        recorder: Optional[EventRecorder] = None,
+        clock: Optional[Clock] = None,
+    ):
+        self.store = store
+        self.recorder = recorder or EventRecorder()
+        self.clock = clock or Clock()
+
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        claim = self.store.try_get(EFFECT_CLAIM_KIND, namespace, name)
+        if claim is None or claim.meta.deletion_timestamp is not None:
+            return None
+        spec = parse_effectclaim(claim)
+        now = self.clock.now()
+
+        self._ensure_owner(claim, spec)
+
+        phase = claim.status.get("phase")
+        if phase in (
+            str(EffectClaimPhase.COMPLETED),
+            str(EffectClaimPhase.RELEASED),
+            str(EffectClaimPhase.ABANDONED),
+        ):
+            return None
+
+        # SDK-reported completion/release wins
+        # (reference: completion status completed/released/abandoned,
+        # effectclaim_types.go:25-43)
+        if claim.status.get("completed"):
+            return self._set_phase(claim, EffectClaimPhase.COMPLETED,
+                                   "EffectCompleted", "holder reported completion")
+        if claim.status.get("released"):
+            return self._set_phase(claim, EffectClaimPhase.RELEASED,
+                                   "EffectReleased", "holder released the claim")
+
+        # the controller stamps reservedAt on first sight so lease math
+        # stays in one clock domain (spec acquire/renew timestamps, when
+        # the holder supplies them, take precedence)
+        reserved_at = claim.status.get("reservedAt")
+        if reserved_at is None:
+            self.store.patch_status(
+                EFFECT_CLAIM_KIND, namespace, name,
+                lambda st: st.__setitem__("reservedAt", now),
+            )
+            reserved_at = now
+        lease = spec.lease_duration_seconds or DEFAULT_LEASE_SECONDS
+        anchor = spec.renewed_at or spec.acquired_at or float(reserved_at)
+        expires = anchor + lease
+        if now >= expires:
+            # stale takeover: the holder died mid-effect
+            # (reference: effectclaim_types.go:45-97)
+            return self._set_phase(
+                claim, EffectClaimPhase.ABANDONED, "LeaseExpired",
+                f"lease expired {now - expires:.0f}s ago without renewal",
+            )
+
+        if phase != str(EffectClaimPhase.RESERVED):
+            self._set_phase(claim, EffectClaimPhase.RESERVED, "Reserved",
+                            f"held by {spec.holder_identity}", terminal=False)
+        return max(0.1, expires - now)
+
+    # ------------------------------------------------------------------
+    def _ensure_owner(self, claim: Resource, spec) -> None:
+        """(reference: effectclaim_controller.go — owner ref to StepRun)"""
+        ref = spec.step_run_ref or {}
+        sr_name = ref.get("name")
+        if not sr_name or claim.meta.owner_references:
+            return
+        sr = self.store.try_get(STEP_RUN_KIND, claim.meta.namespace, sr_name)
+        if sr is None:
+            return
+        try:
+            self.store.mutate(
+                EFFECT_CLAIM_KIND, claim.meta.namespace, claim.meta.name,
+                lambda r: r.meta.owner_references.append(sr.owner_ref(controller=False)),
+            )
+        except NotFound:
+            pass
+
+    def _set_phase(self, claim: Resource, phase: EffectClaimPhase,
+                   reason: str, message: str, terminal: bool = True):
+        now = self.clock.now()
+
+        def patch(st: dict[str, Any]) -> None:
+            st["phase"] = str(phase)
+            if terminal:
+                st["resolvedAt"] = now
+            conds = st.setdefault("conditions", [])
+            conditions.set_condition(
+                conds, conditions.READY,
+                phase is not EffectClaimPhase.ABANDONED,
+                reason, message, now=now,
+            )
+
+        self.store.patch_status(
+            EFFECT_CLAIM_KIND, claim.meta.namespace, claim.meta.name, patch
+        )
+        metrics.effectclaim_transitions.inc(str(phase))
+        return None

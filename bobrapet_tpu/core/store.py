@@ -437,7 +437,14 @@ class ResourceStore:
         last: Optional[Conflict] = None
         for _ in range(max_attempts):
             cur = self.get(kind, namespace, name)
+            before = cur.deepcopy()
             fn(cur)
+            if cur == before:
+                # patch-if-changed: a no-op write emits no event, so
+                # status-refreshing controllers that watch their own kind
+                # converge instead of looping
+                # (reference: PatchStatusIfChanged pkg/reconcile/status.go:17)
+                return cur
             try:
                 if status_only:
                     return self.update_status(cur)

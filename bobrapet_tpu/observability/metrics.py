@@ -333,6 +333,11 @@ class _ControlPlaneMetrics:
         self.trigger_backfills = c(
             "bobrapet_trigger_backfills_total", "Token backfill passes", ["kind"]
         )
+        self.effectclaim_transitions = c(
+            "bobrapet_effectclaim_transitions_total",
+            "EffectClaim phase transitions",
+            ["phase"],
+        )
         # Cleanup / retention
         self.cleanup_ops = c(
             "bobrapet_cleanup_ops_total", "Retention cleanups", ["kind"]

@@ -23,7 +23,11 @@ from __future__ import annotations
 import logging
 from typing import Any, Optional
 
-from .api.catalog import ENGRAM_TEMPLATE_KIND, IMPULSE_TEMPLATE_KIND
+from .api.catalog import (
+    CLUSTER_NAMESPACE,
+    ENGRAM_TEMPLATE_KIND,
+    IMPULSE_TEMPLATE_KIND,
+)
 from .api.engram import KIND as ENGRAM_KIND
 from .api.enums import Phase
 from .api.impulse import KIND as IMPULSE_KIND
@@ -40,9 +44,16 @@ from .config import OperatorConfigManager, Resolver
 from .controllers.dag import DAGEngine, INDEX_STEPRUN_PHASE, INDEX_STEPRUN_STORYRUN
 from .controllers.jobs import JOB_KIND, LocalGangExecutor
 from .controllers.manager import Clock, ControllerManager, ManualClock
+from .controllers.impulse import ImpulseController
+from .controllers.resources import (
+    EngramController,
+    StoryController,
+    make_catalog_controllers,
+)
 from .controllers.step_executor import StepExecutor
 from .controllers.steprun import StepRunController
 from .controllers.storyrun import StoryRunController
+from .controllers.triggers import EffectClaimController, StoryTriggerController
 from .core.events import EventRecorder
 from .core.store import DELETED, ResourceStore, WatchEvent
 from .parallel.placement import SlicePlacer
@@ -111,6 +122,25 @@ class Runtime:
         self.steprun_controller = StepRunController(
             self.store, self.config_manager, self.resolver, self.storage,
             self.evaluator, recorder=self.recorder, clock=self.clock,
+        )
+        self.story_controller = StoryController(
+            self.store, recorder=self.recorder, clock=self.clock
+        )
+        self.engram_controller = EngramController(
+            self.store, recorder=self.recorder, clock=self.clock
+        )
+        self.engramtemplate_controller, self.impulsetemplate_controller = (
+            make_catalog_controllers(self.store, self.recorder, self.clock)
+        )
+        self.impulse_controller = ImpulseController(
+            self.store, self.config_manager, recorder=self.recorder, clock=self.clock
+        )
+        self.storytrigger_controller = StoryTriggerController(
+            self.store, self.storage, self.config_manager,
+            recorder=self.recorder, clock=self.clock,
+        )
+        self.effectclaim_controller = EffectClaimController(
+            self.store, recorder=self.recorder, clock=self.clock
         )
         self.job_executor = LocalGangExecutor(
             self.store, storage=self.storage, clock=self.clock, mode=executor_mode
@@ -203,6 +233,10 @@ class Runtime:
             STORY_TRIGGER_KIND, INDEX_STORYRUN_STORY,
             lambda r: [(r.spec.get("storyRef") or {}).get("name", "")],
         )
+        s.add_index(
+            STORY_TRIGGER_KIND, "impulseRef",
+            lambda r: [(r.spec.get("impulseRef") or {}).get("name", "")],
+        )
 
     # ------------------------------------------------------------------
     def _register_controllers(self) -> None:
@@ -263,6 +297,125 @@ class Runtime:
                 ENGRAM_KIND: engram_to_stepruns,
                 ENGRAM_TEMPLATE_KIND: template_to_stepruns,
             },
+        )
+
+        # --- definition-side controllers
+        # (reference: story/engram/catalog reconcilers, cmd/main.go:613-790)
+        def engram_to_stories(ev: WatchEvent):
+            stories = self.store.list(
+                STORY_KIND, index=("stepEngramRefs", ev.resource.meta.name)
+            )
+            return [(s.meta.namespace, s.meta.name) for s in stories]
+
+        def storyrun_to_story(ev: WatchEvent):
+            name = (ev.resource.spec.get("storyRef") or {}).get("name")
+            return [(ev.resource.meta.namespace, name)] if name else []
+
+        def transport_to_stories(ev: WatchEvent):
+            stories = self.store.list(
+                STORY_KIND, index=("transportRefs", ev.resource.meta.name)
+            )
+            return [(s.meta.namespace, s.meta.name) for s in stories]
+
+        m.register(
+            "story",
+            self.story_controller.reconcile,
+            watches={
+                STORY_KIND: None,
+                ENGRAM_KIND: engram_to_stories,
+                STORY_RUN_KIND: storyrun_to_story,
+                TRANSPORT_KIND: transport_to_stories,
+            },
+        )
+
+        def template_to_engrams(ev: WatchEvent):
+            engrams = self.store.list(
+                ENGRAM_KIND, index=(INDEX_ENGRAM_TEMPLATE, ev.resource.meta.name)
+            )
+            return [(e.meta.namespace, e.meta.name) for e in engrams]
+
+        def steprun_to_engram(ev: WatchEvent):
+            name = (ev.resource.spec.get("engramRef") or {}).get("name")
+            return [(ev.resource.meta.namespace, name)] if name else []
+
+        def story_to_engrams(ev: WatchEvent):
+            ns = ev.resource.meta.namespace
+            return [
+                (ns, (step.get("ref") or {}).get("name", ""))
+                for step in (ev.resource.spec.get("steps") or [])
+                if step.get("ref")
+            ]
+
+        m.register(
+            "engram",
+            self.engram_controller.reconcile,
+            watches={
+                ENGRAM_KIND: None,
+                ENGRAM_TEMPLATE_KIND: template_to_engrams,
+                STEP_RUN_KIND: steprun_to_engram,
+                STORY_KIND: story_to_engrams,
+            },
+        )
+
+        def engram_to_template(ev: WatchEvent):
+            name = (ev.resource.spec.get("templateRef") or {}).get("name")
+            return [(CLUSTER_NAMESPACE, name)] if name else []
+
+        m.register(
+            "engramtemplate",
+            self.engramtemplate_controller.reconcile,
+            watches={
+                ENGRAM_TEMPLATE_KIND: None,
+                ENGRAM_KIND: engram_to_template,
+            },
+        )
+        m.register(
+            "impulsetemplate",
+            self.impulsetemplate_controller.reconcile,
+            watches={
+                IMPULSE_TEMPLATE_KIND: None,
+                IMPULSE_KIND: engram_to_template,
+            },
+        )
+
+        def trigger_to_impulse(ev: WatchEvent):
+            name = (ev.resource.spec.get("impulseRef") or {}).get("name")
+            return [(ev.resource.meta.namespace, name)] if name else []
+
+        def impulsetemplate_to_impulses(ev: WatchEvent):
+            impulses = self.store.list(
+                IMPULSE_KIND, index=(INDEX_ENGRAM_TEMPLATE, ev.resource.meta.name)
+            )
+            return [(i.meta.namespace, i.meta.name) for i in impulses]
+
+        def story_to_impulses(ev: WatchEvent):
+            impulses = self.store.list(
+                IMPULSE_KIND, index=(INDEX_STORYRUN_STORY, ev.resource.meta.name)
+            )
+            return [(i.meta.namespace, i.meta.name) for i in impulses]
+
+        m.register(
+            "impulse",
+            self.impulse_controller.reconcile,
+            watches={
+                IMPULSE_KIND: None,
+                IMPULSE_TEMPLATE_KIND: impulsetemplate_to_impulses,
+                STORY_TRIGGER_KIND: trigger_to_impulse,
+                STORY_RUN_KIND: trigger_to_impulse,
+                STORY_KIND: story_to_impulses,
+            },
+        )
+
+        # --- durable admission + effect leases
+        m.register(
+            "storytrigger",
+            self.storytrigger_controller.reconcile,
+            watches={STORY_TRIGGER_KIND: None},
+        )
+        m.register(
+            "effectclaim",
+            self.effectclaim_controller.reconcile,
+            watches={EFFECT_CLAIM_KIND: None},
         )
 
     # ------------------------------------------------------------------

@@ -39,6 +39,15 @@ ENV_MAX_RECURSION_DEPTH = "BOBRA_MAX_RECURSION_DEPTH"
 ENV_GRPC_PORT = "BOBRA_GRPC_PORT"
 ENV_DEBUG = "BOBRA_DEBUG"
 
+# impulse (trigger workload) contract
+# (reference: appendTriggerDeliveryEnvVars impulse_controller.go:1477)
+ENV_IMPULSE = "BOBRA_IMPULSE"
+ENV_TRIGGER_STORY = "BOBRA_TRIGGER_STORY"
+ENV_TRIGGER_STORY_NAMESPACE = "BOBRA_TRIGGER_STORY_NAMESPACE"
+ENV_TRIGGER_MAPPING = "BOBRA_TRIGGER_MAPPING"  # event -> inputs template JSON
+ENV_TRIGGER_DELIVERY = "BOBRA_TRIGGER_DELIVERY"  # delivery policy JSON
+ENV_TRIGGER_THROTTLE = "BOBRA_TRIGGER_THROTTLE"  # throttle policy JSON
+
 # streaming
 ENV_DOWNSTREAM_TARGETS = "BOBRA_DOWNSTREAM_TARGETS"  # JSON list of next hops
 ENV_BINDING_INFO = "BOBRA_BINDING_INFO"  # negotiated transport binding JSON

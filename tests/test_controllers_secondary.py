@@ -1,0 +1,375 @@
+"""Definition controllers, durable trigger admission, effect leases,
+impulse workloads.
+
+Coverage model: the reference's envtest suites for the Story/Engram/
+catalog/StoryTrigger/EffectClaim/Impulse reconcilers (SURVEY §2.2) —
+real store, real controllers, token-counting verified idempotent.
+"""
+
+import pytest
+
+from bobrapet_tpu.api.catalog import make_engram_template, make_impulse_template
+from bobrapet_tpu.api.engram import make_engram
+from bobrapet_tpu.api.impulse import make_impulse
+from bobrapet_tpu.api.story import make_story
+from bobrapet_tpu.core.object import new_resource
+from bobrapet_tpu.sdk import register_engram
+
+
+def setup_engram(rt, name="worker", **template_fields):
+    ep = f"{name}-impl"
+    rt.apply(make_engram_template(f"{name}-tpl", entrypoint=ep,
+                                  image=f"{name}:1", **template_fields))
+    rt.apply(make_engram(name, f"{name}-tpl"))
+    return ep
+
+
+def make_trigger(name, story, key=None, inputs=None, mode=None, **extra):
+    identity = {}
+    if key is not None:
+        identity = {"mode": mode or "key", "key": key}
+    else:
+        identity = {"mode": "none", "submissionId": name}
+    spec = {"storyRef": {"name": story}, "identity": identity,
+            "inputs": inputs or {}, **extra}
+    return new_resource("StoryTrigger", name, "default", spec=spec)
+
+
+class TestStoryController:
+    def test_valid_story_status(self, rt):
+        setup_engram(rt)
+        rt.apply(make_story("s", steps=[{"name": "a", "ref": {"name": "worker"}}]))
+        rt.pump()
+        st = rt.store.get("Story", "default", "s").status
+        assert st["validationStatus"] == "valid"
+        assert st["stepsTotal"] == 1
+        assert st["validationErrors"] == []
+
+    def test_missing_engram_invalid(self, rt):
+        rt.apply(make_story("s", steps=[{"name": "a", "ref": {"name": "ghost"}}]))
+        rt.pump()
+        st = rt.store.get("Story", "default", "s").status
+        assert st["validationStatus"] == "invalid"
+        assert any("ghost" in e for e in st["validationErrors"])
+
+    def test_missing_execute_story_target(self, rt):
+        rt.apply(make_story("s", steps=[
+            {"name": "sub", "type": "executeStory",
+             "with": {"storyRef": {"name": "nonexistent"}}},
+        ]))
+        rt.pump()
+        st = rt.store.get("Story", "default", "s").status
+        assert st["validationStatus"] == "invalid"
+
+    def test_run_counting_is_idempotent(self, rt):
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            return {"ok": True}
+
+        rt.apply(make_story("s", steps=[{"name": "a", "ref": {"name": "worker"}}]))
+        r1 = rt.run_story("s")
+        rt.pump()
+        r2 = rt.run_story("s")
+        rt.pump()
+        rt.pump()  # extra pumps must not double-count
+        st = rt.store.get("Story", "default", "s").status
+        assert st["runsTriggered"] == 2
+
+    def test_revalidates_when_engram_appears(self, rt):
+        rt.apply(make_story("s", steps=[{"name": "a", "ref": {"name": "late"}}]))
+        rt.pump()
+        assert rt.store.get("Story", "default", "s").status["validationStatus"] == "invalid"
+        setup_engram(rt, name="late")
+        rt.pump()
+        assert rt.store.get("Story", "default", "s").status["validationStatus"] == "valid"
+
+
+class TestEngramAndTemplates:
+    def test_engram_usage_counters(self, rt):
+        setup_engram(rt)
+        rt.apply(make_story("s1", steps=[{"name": "a", "ref": {"name": "worker"}}]))
+        rt.apply(make_story("s2", steps=[{"name": "b", "ref": {"name": "worker"}}]))
+        rt.pump()
+        st = rt.store.get("Engram", "default", "worker").status
+        assert st["usageCount"] == 2
+        assert st["usedByStories"] == ["s1", "s2"]
+
+    def test_engram_degraded_when_template_deleted(self, rt):
+        setup_engram(rt, name="orphan")
+        rt.pump()
+        assert rt.store.get("Engram", "default", "orphan").status["phase"] == "Running"
+        rt.store.delete("EngramTemplate", "_cluster", "orphan-tpl")
+        rt.pump()
+        st = rt.store.get("Engram", "default", "orphan").status
+        assert st["phase"] == "Failed"
+
+    def test_template_usage_and_validation(self, rt):
+        setup_engram(rt)
+        rt.pump()
+        tpl = rt.store.get("EngramTemplate", "_cluster", "worker-tpl")
+        assert tpl.status["usageCount"] == 1
+        assert tpl.status["validationStatus"] == "valid"
+
+    def test_entrypoint_only_template_valid(self, rt):
+        """TPU-native templates may ship an entrypoint without an image
+        (in-process engrams); the controller must accept what admission
+        accepts."""
+        rt.apply(make_engram_template("bare-tpl", entrypoint="x"))
+        rt.pump()
+        tpl = rt.store.get("EngramTemplate", "_cluster", "bare-tpl")
+        assert tpl.status["validationStatus"] == "valid"
+
+
+class TestStoryTriggerAdmission:
+    def _story(self, rt):
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            return {"ok": True}
+
+        rt.apply(make_story("s", steps=[{"name": "a", "ref": {"name": "worker"}}]))
+
+    def test_created(self, rt):
+        self._story(rt)
+        rt.store.create(make_trigger("t1", "s", key="k1", inputs={"x": 1}))
+        rt.pump()
+        t = rt.store.get("StoryTrigger", "default", "t1")
+        assert t.status["decision"] == "Created"
+        run = rt.store.get("StoryRun", "default", t.status["storyRunName"])
+        assert run.status["phase"] == "Succeeded"
+
+    def test_duplicate_delivery_reused(self, rt):
+        self._story(rt)
+        rt.store.create(make_trigger("t1", "s", key="k1", inputs={"x": 1}))
+        rt.pump()
+        rt.store.create(make_trigger("t2", "s", key="k1", inputs={"x": 1}))
+        rt.pump()
+        t2 = rt.store.get("StoryTrigger", "default", "t2")
+        assert t2.status["decision"] == "Reused"
+        assert t2.status["storyRunName"] == (
+            rt.store.get("StoryTrigger", "default", "t1").status["storyRunName"]
+        )
+        assert len(rt.store.list("StoryRun")) == 1
+
+    def test_same_key_different_inputs_rejected(self, rt):
+        self._story(rt)
+        rt.store.create(make_trigger("t1", "s", key="k1", inputs={"x": 1}))
+        rt.pump()
+        rt.store.create(make_trigger("t2", "s", key="k1", inputs={"x": 2}))
+        rt.pump()
+        assert rt.store.get("StoryTrigger", "default", "t2").status["decision"] == "Rejected"
+
+    def test_story_not_found_rejected(self, rt):
+        rt.store.create(make_trigger("t1", "ghost", key="k1"))
+        rt.pump()
+        t = rt.store.get("StoryTrigger", "default", "t1")
+        assert t.status["decision"] == "Rejected"
+        assert "not found" in t.status["message"]
+
+    def test_version_pinning_mismatch_rejected(self, rt):
+        self._story(rt)
+        rt.store.mutate("Story", "default", "s",
+                        lambda r: r.spec.__setitem__("version", "v2"))
+        trig = make_trigger("t1", "s", key="k1")
+        trig.spec["storyRef"]["version"] = "v1"
+        rt.store.create(trig)
+        rt.pump()
+        t = rt.store.get("StoryTrigger", "default", "t1")
+        assert t.status["decision"] == "Rejected"
+        assert "version" in t.status["message"]
+
+    def test_distinct_keys_distinct_runs(self, rt):
+        self._story(rt)
+        rt.store.create(make_trigger("t1", "s", key="k1"))
+        rt.store.create(make_trigger("t2", "s", key="k2"))
+        rt.pump()
+        assert len(rt.store.list("StoryRun")) == 2
+
+    def test_oversized_inputs_offloaded_and_admitted(self, rt):
+        """Dehydrated trigger inputs must land in the canonical
+        runs/<ns>/<run>/ storage scope the StoryRun webhook accepts."""
+        self._story(rt)
+        big = "x" * (rt.storage.max_inline_size + 1)
+        rt.store.create(make_trigger("t1", "s", key="k1", inputs={"blob": big}))
+        rt.pump()
+        t = rt.store.get("StoryTrigger", "default", "t1")
+        assert t.status["decision"] == "Created", t.status
+        run = rt.store.get("StoryRun", "default", t.status["storyRunName"])
+        ref = run.spec["inputs"]["blob"]
+        assert isinstance(ref, dict) and "storageRef" in ref
+
+    def test_inadmissible_run_resolves_rejected(self, rt):
+        """An admission-rejected StoryRun resolves the trigger as
+        Rejected instead of crash-looping the reconciler."""
+        self._story(rt)
+        rt.store.mutate(
+            "Story", "default", "s",
+            lambda r: r.spec.__setitem__(
+                "inputsSchema",
+                {"type": "object", "required": ["must"],
+                 "properties": {"must": {"type": "string"}}},
+            ),
+        )
+        rt.store.create(make_trigger("t1", "s", key="k1", inputs={"wrong": 1}))
+        rt.pump()
+        t = rt.store.get("StoryTrigger", "default", "t1")
+        assert t.status["decision"] == "Rejected"
+        assert t.status["reason"] == "StoryRunInadmissible"
+
+
+class TestEffectClaims:
+    def _claim(self, rt, name="c", lease=30):
+        ec = new_resource("EffectClaim", name, "default", spec={
+            "stepRunRef": {"name": "sr-x"}, "effectId": "charge-card",
+            "holderIdentity": "sdk-1", "leaseDurationSeconds": lease,
+        })
+        rt.store.create(ec)
+        return ec
+
+    def test_reserved_then_completed(self, rt):
+        self._claim(rt)
+        rt.pump(max_virtual_seconds=5)
+        assert rt.store.get("EffectClaim", "default", "c").status["phase"] == "Reserved"
+        rt.store.patch_status("EffectClaim", "default", "c",
+                              lambda s: s.__setitem__("completed", True))
+        rt.pump(max_virtual_seconds=5)
+        assert rt.store.get("EffectClaim", "default", "c").status["phase"] == "Completed"
+
+    def test_released(self, rt):
+        self._claim(rt)
+        rt.pump(max_virtual_seconds=5)
+        rt.store.patch_status("EffectClaim", "default", "c",
+                              lambda s: s.__setitem__("released", True))
+        rt.pump(max_virtual_seconds=5)
+        assert rt.store.get("EffectClaim", "default", "c").status["phase"] == "Released"
+
+    def test_lease_expiry_abandons(self, rt):
+        self._claim(rt, lease=30)
+        rt.pump(max_virtual_seconds=5)
+        assert rt.store.get("EffectClaim", "default", "c").status["phase"] == "Reserved"
+        rt.pump(max_virtual_seconds=120)
+        assert rt.store.get("EffectClaim", "default", "c").status["phase"] == "Abandoned"
+
+    def test_renewal_extends_lease(self, rt):
+        self._claim(rt, lease=30)
+        rt.pump(max_virtual_seconds=5)
+        # holder renews: spec.renewedAt moves the anchor forward
+        far = rt.clock.now() + 100
+        rt.store.mutate("EffectClaim", "default", "c",
+                        lambda r: r.spec.__setitem__("renewedAt", far))
+        rt.pump(max_virtual_seconds=60)
+        assert rt.store.get("EffectClaim", "default", "c").status["phase"] == "Reserved"
+
+    def test_owner_ref_set_when_steprun_exists(self, rt):
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            return {}
+
+        rt.apply(make_story("s", steps=[{"name": "a", "ref": {"name": "worker"}}]))
+        rt.run_story("s")
+        rt.pump()
+        sr_name = rt.store.list("StepRun")[0].meta.name
+        ec = new_resource("EffectClaim", "c", "default", spec={
+            "stepRunRef": {"name": sr_name}, "effectId": "e",
+            "holderIdentity": "h",
+        })
+        rt.store.create(ec)
+        rt.pump(max_virtual_seconds=5)
+        claim = rt.store.get("EffectClaim", "default", "c")
+        assert claim.meta.owner_references
+        assert claim.meta.owner_references[0].name == sr_name
+
+
+class TestImpulse:
+    def _setup(self, rt):
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            return {"ok": True}
+
+        rt.apply(make_story("s", steps=[{"name": "a", "ref": {"name": "worker"}}]))
+        rt.apply(make_impulse_template("hook-tpl", image="hook:1",
+                                       supportedModes=["deployment"]))
+        rt.apply(make_impulse("imp", "hook-tpl", "s"))
+
+    def test_workloads_materialized(self, rt):
+        self._setup(rt)
+        rt.pump()
+        assert rt.store.get("Impulse", "default", "imp").status["phase"] == "Running"
+        dep = rt.store.get("Deployment", "default", "imp-impulse")
+        assert dep.spec["image"] == "hook:1"
+        assert dep.spec["env"]["BOBRA_TRIGGER_STORY"] == "s"
+        assert rt.store.try_get("Service", "default", "imp-impulse-svc") is not None
+        assert rt.store.try_get("ServiceAccount", "default", "imp-impulse-sa") is not None
+
+    def test_blocked_when_template_deleted(self, rt):
+        self._setup(rt)
+        rt.pump()
+        rt.store.delete("ImpulseTemplate", "_cluster", "hook-tpl")
+        rt.pump()
+        assert rt.store.get("Impulse", "default", "imp").status["phase"] == "Blocked"
+
+    def test_blocked_impulse_recovers_when_story_appears(self, rt):
+        rt.apply(make_impulse_template("hook-tpl", image="hook:1",
+                                       supportedModes=["deployment"]))
+        rt.apply(make_impulse("imp", "hook-tpl", "later-story"))
+        rt.pump()
+        assert rt.store.get("Impulse", "default", "imp").status["phase"] == "Blocked"
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            return {}
+
+        rt.apply(make_story("later-story",
+                            steps=[{"name": "a", "ref": {"name": "worker"}}]))
+        rt.pump()
+        assert rt.store.get("Impulse", "default", "imp").status["phase"] == "Running"
+
+    def test_max_in_flight_throttle_rejects(self, rt):
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            return {}
+
+        # a gate step keeps runs in flight until approved
+        rt.apply(make_story("s", steps=[
+            {"name": "hold", "type": "gate"},
+            {"name": "a", "ref": {"name": "worker"}, "needs": ["hold"]},
+        ]))
+        rt.apply(make_impulse_template("hook-tpl", image="hook:1",
+                                       supportedModes=["deployment"]))
+        imp = make_impulse("imp", "hook-tpl", "s")
+        imp.spec["throttle"] = {"maxInFlight": 1}
+        rt.apply(imp)
+        rt.pump()
+        rt.store.create(make_trigger("t1", "s", key="k1", impulseRef={"name": "imp"}))
+        rt.pump(max_virtual_seconds=60)
+        assert rt.store.get("StoryTrigger", "default", "t1").status["decision"] == "Created"
+        rt.store.create(make_trigger("t2", "s", key="k2", impulseRef={"name": "imp"}))
+        rt.pump(max_virtual_seconds=60)
+        t2 = rt.store.get("StoryTrigger", "default", "t2").status
+        assert t2["decision"] == "Rejected"
+        assert t2["reason"] == "Throttled"
+        assert rt.store.get("Impulse", "default", "imp").status["triggersThrottled"] == 1
+
+    def test_trigger_stats_token_counted(self, rt):
+        self._setup(rt)
+        rt.pump()
+        trig = make_trigger("t1", "s", key="k1",
+                            impulseRef={"name": "imp"})
+        rt.store.create(trig)
+        rt.pump()
+        rt.pump()  # idempotent: second pump must not double-count
+        st = rt.store.get("Impulse", "default", "imp").status
+        assert st["triggersReceived"] == 1
+        assert st["storiesLaunched"] == 1
+        assert st["storiesSucceeded"] == 1
+        assert st["storiesFailed"] == 0
