@@ -20,6 +20,7 @@ import dataclasses
 from typing import Any, Optional
 
 from ..core.object import Resource, new_resource
+from .catalog import CLUSTER_NAMESPACE
 from .refs import StoryRunRef
 from .specbase import SpecBase
 
@@ -279,7 +280,10 @@ def parse_transport_binding(resource: Resource) -> TransportBindingSpec:
     return TransportBindingSpec.from_dict(resource.spec)
 
 
-def make_transport(name: str, provider: str, namespace: str = "default", **spec_fields: Any) -> Resource:
+def make_transport(name: str, provider: str, namespace: str = CLUSTER_NAMESPACE,
+                   **spec_fields: Any) -> Resource:
+    """Transports are cluster-scoped like the reference's
+    (reference: transport_types.go Cluster scope marker)."""
     return new_resource(
         TRANSPORT_KIND, name, namespace, {"provider": provider, **spec_fields}
     )

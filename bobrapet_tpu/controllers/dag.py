@@ -413,12 +413,25 @@ class DAGEngine:
                 )
                 if d in by_name or story.step(d) is not None
             }
-            unresolved = [
-                d
-                for d in deps
-                if d not in states or not StepState.from_dict(states[d]).is_terminal
-            ]
-            if unresolved:
+            # realtime pattern: `needs` between engram steps are STREAM
+            # edges — a Running upstream topology satisfies them; only
+            # batch semantics require terminal deps
+            # (reference: realtime topology, steprun_controller.go:2527;
+            # wait/gate rejected in realtime by admission)
+            realtime = story.effective_pattern.value == "realtime"
+
+            def dep_satisfied(d: str) -> bool:
+                if d not in states:
+                    return False
+                ds = StepState.from_dict(states[d])
+                if ds.is_terminal:
+                    return True
+                if realtime and ds.effective_phase is Phase.RUNNING:
+                    dep_def = by_name.get(d) or story.step(d)
+                    return bool(dep_def is not None and dep_def.ref is not None)
+                return False
+
+            if any(not dep_satisfied(d) for d in deps):
                 continue
 
             # dependency failure/skip propagation

@@ -38,12 +38,10 @@ from ..observability.metrics import metrics
 from ..sdk import contract
 from .manager import Clock
 from .resources import ANNO_COUNTED_IMPULSE, ANNO_COUNTED_IMPULSE_OUTCOME, _consume_tokens
-from .streaming import SERVICE_KIND
+from .streaming import DEPLOYMENT_KIND, SERVICE_KIND, STATEFULSET_KIND
 
 _log = logging.getLogger(__name__)
 
-DEPLOYMENT_KIND = "Deployment"
-STATEFULSET_KIND = "StatefulSet"
 SERVICE_ACCOUNT_KIND = "ServiceAccount"
 
 INDEX_TRIGGER_IMPULSE = "impulseRef"

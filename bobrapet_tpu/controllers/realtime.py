@@ -1,45 +1,12 @@
-"""Realtime (streaming) StepRun path — control-plane side.
+"""Realtime StepRun path — delegation shim.
 
-The reference materializes realtime steps as per-run Deployment +
-Service + TransportBinding with codec negotiation and handoff
-(reference: steprun_controller.go reconcileRunScopedRealtimeStep:2527).
-The full streaming data plane lands with the transport layer; this
-module keeps the StepRun phase machine honest meanwhile: a realtime step
-materializes a Service resource on the bus and derives its phase from
-binding + service readiness.
+The full realtime control plane lives in :mod:`.streaming`
+(reference: steprun_controller.go reconcileRunScopedRealtimeStep:2527);
+this module keeps the StepRunController-facing entry point stable.
 """
 
 from __future__ import annotations
 
-from typing import Any
+from .streaming import reconcile_realtime_step
 
-from ..api import conditions
-from ..api.enums import Phase
-from ..api.runs import STEP_RUN_KIND
-
-
-def reconcile_realtime_step(ctrl, sr, spec, engram_spec, template_spec):
-    """Minimal realtime reconcile: materialize the service record and
-    report Running once it exists; the transport layer upgrades this to
-    full binding negotiation + downstream target wiring."""
-    from .streaming import ensure_realtime_topology
-
-    return ensure_realtime_topology(ctrl, sr, spec, engram_spec, template_spec)
-
-
-def set_realtime_pending(ctrl, sr, message: str):
-    def patch(status: dict[str, Any]) -> None:
-        status["phase"] = str(Phase.PENDING)
-        status["message"] = message
-        conds = status.setdefault("conditions", [])
-        conditions.set_condition(
-            conds,
-            conditions.TRANSPORT_READY,
-            False,
-            conditions.Reason.AWAITING_TRANSPORT,
-            message,
-            now=ctrl.clock.now(),
-        )
-
-    ctrl.store.patch_status(STEP_RUN_KIND, sr.meta.namespace, sr.meta.name, patch)
-    return None
+__all__ = ["reconcile_realtime_step"]

@@ -451,6 +451,22 @@ class StepRunController:
             except NotFound:
                 pass
 
+        # a realtime step also tears its stream topology down
+        # (reference: ReasonTopologyTerminated consumed at dag.go:441)
+        if sr.status.get("bindingName"):
+            from ..api.transport import TRANSPORT_BINDING_KIND
+
+            try:
+                self.store.patch_status(
+                    TRANSPORT_BINDING_KIND, sr.meta.namespace,
+                    sr.status["bindingName"],
+                    lambda st: st.update(
+                        {"phase": "Terminated", "terminatedAt": self.clock.now()}
+                    ),
+                )
+            except NotFound:
+                pass
+
         def cancel(status: dict[str, Any]) -> None:
             status["phase"] = str(Phase.FINISHED)
             status["finishedAt"] = self.clock.now()

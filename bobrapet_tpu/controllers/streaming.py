@@ -1,43 +1,286 @@
-"""Streaming topology materialization (control plane, minimal core).
+"""Realtime (streaming) StepRun materialization — the full control plane.
 
-Full codec negotiation / routing / handoff arrives with the transport
-layer; this core keeps realtime StepRuns functional: per-run Service +
-worker record, phase derived from readiness
-(reference: ensureRealtimeService:2677, ensureRealtimeDeployment:2762,
-deriveRealtimePhase:2838).
+Capability parity with the reference's run-scoped realtime path
+(reference: steprun_controller.go reconcileRunScopedRealtimeStep:2527,
+ensureRunTransportBinding:3701, ensureRealtimeService:2677,
+ensureRealtimeDeployment:2762, computeDownstreamTargets:1405,
+ensureDownstreamTargets:1548, deriveRealtimePhase:2838, handoff
+:4395-4494):
+
+1. resolve the step's declared transport (story.spec.transports entry ->
+   cluster Transport resource),
+2. ensure the per-run **TransportBinding** with negotiated codecs (or the
+   ICI mesh descriptor) + merged streaming settings in status; bump
+   ``connectorGeneration`` when the negotiated contract changes,
+3. ensure the per-run **Service** + **Deployment** records (env carries
+   the binding info + downstream targets, so the engram SDK and
+   connector sidecar need no API access),
+4. compute **downstream targets** from the stream topology (hub vs P2P)
+   and patch them into this StepRun's spec,
+5. maintain **handoff** status across connector generations
+   (drain/cutover from lifecycle settings),
+6. derive the StepRun phase from binding + deployment readiness.
+
+The data plane (engram workers streaming gRPC/ICI) never passes through
+the operator.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import json
+import logging
+from typing import Any, Optional
 
-from ..api.enums import Phase
-from ..api.runs import STEP_RUN_KIND
-from ..core.object import new_resource
-from ..core.store import AlreadyExists
+from ..api import conditions
+from ..api.catalog import CLUSTER_NAMESPACE
+from ..api.enums import Phase, WorkloadMode
+from ..api.runs import STEP_RUN_KIND, STORY_RUN_KIND
+from ..api.story import KIND as STORY_KIND, parse_story
+from ..api.transport import (
+    MediaBinding,
+    TRANSPORT_BINDING_KIND,
+    TRANSPORT_KIND,
+    parse_transport,
+)
+from ..core.object import Resource, new_resource
+from ..core.store import AlreadyExists, NotFound
+from ..observability.metrics import metrics
+from ..sdk import contract
+from ..transport import (
+    CodecError,
+    analyze_topology,
+    compute_downstream_targets,
+    merge_streaming_settings,
+    negotiate_binding,
+    step_needs_hub,
+)
+
+_log = logging.getLogger(__name__)
 
 SERVICE_KIND = "Service"
+DEPLOYMENT_KIND = "Deployment"
+STATEFULSET_KIND = "StatefulSet"
+CANCEL_ANNOTATION = "runs.bobrapet.io/cancel"
 
 
-def ensure_realtime_topology(ctrl, sr, spec, engram_spec, template_spec):
-    """Materialize the per-run service record and mark the step Running.
+# ---------------------------------------------------------------------------
+# entry point (called from StepRunController._reconcile_realtime)
+# ---------------------------------------------------------------------------
 
-    The local data plane connects engram workers directly (they resolve
-    each other through these Service records); on GKE this becomes a real
-    Service + Deployment pair.
-    """
+def reconcile_realtime_step(ctrl, sr, spec, engram_spec, template_spec):
     ns, name = sr.meta.namespace, sr.meta.name
-    engram_name = spec.engram_ref.name if spec.engram_ref else ""
-    port = ctrl.config_manager.config.engram.grpc_port
+
+    if CANCEL_ANNOTATION in sr.meta.annotations:
+        return _terminate_topology(ctrl, sr)
+
+    ctx = _build_runtime_context(ctrl, sr, spec)
+    if ctx is None:
+        return _set_pending(ctrl, sr, conditions.Reason.AWAITING_STORY_RUN,
+                            "story context unavailable")
+
+    binding = None
+    if ctx["transport"] is not None:
+        binding, err = _ensure_binding(ctrl, sr, spec, ctx)
+        if err is not None:
+            return _set_failed_transport(ctrl, sr, err)
+
+    svc_name, port = _ensure_service(ctrl, sr, spec, engram_spec)
+    targets = _ensure_downstream_targets(ctrl, sr, ctx, svc_name, port)
+    generation = (binding.status.get("connectorGeneration", 1) if binding else 1)
+    deployment = _ensure_deployment(
+        ctrl, sr, spec, engram_spec, template_spec, ctx,
+        svc_name, port, binding, targets, generation,
+    )
+
+    _sync_handoff(ctrl, sr, ctx, deployment, generation)
+    return _derive_phase(ctrl, sr, binding, deployment, svc_name, port)
+
+
+# ---------------------------------------------------------------------------
+# context
+# ---------------------------------------------------------------------------
+
+def _build_runtime_context(ctrl, sr, spec) -> Optional[dict[str, Any]]:
+    """(reference: buildRealtimeRuntimeContext steprun_controller.go:2563)"""
+    ns = sr.meta.namespace
+    run_name = (sr.spec.get("storyRunRef") or {}).get("name")
+    run = ctrl.store.try_get(STORY_RUN_KIND, ns, run_name) if run_name else None
+    if run is None:
+        return None
+    story_name = (run.spec.get("storyRef") or {}).get("name")
+    story_ns = (run.spec.get("storyRef") or {}).get("namespace") or ns
+    story = ctrl.store.try_get(STORY_KIND, story_ns, story_name) if story_name else None
+    if story is None:
+        return None
+    story_spec = parse_story(story)
+    step = story_spec.step(spec.step_id or "")
+
+    # streaming predicate: a step streams when its engram's effective mode
+    # is deployment/statefulset (reference: topology.go:46)
+    def is_streaming(s) -> bool:
+        if s.ref is None:
+            return False
+        from ..api.catalog import ENGRAM_TEMPLATE_KIND, parse_engram_template
+        from ..api.engram import KIND as ENGRAM_KIND, parse_engram
+
+        e = ctrl.store.try_get(ENGRAM_KIND, ns, s.ref.name)
+        if e is None:
+            return False
+        es = parse_engram(e)
+        mode = es.mode
+        if mode is None:
+            t = ctrl.store.try_get(
+                ENGRAM_TEMPLATE_KIND, CLUSTER_NAMESPACE,
+                es.template_ref.name if es.template_ref else "",
+            )
+            if t is not None:
+                modes = parse_engram_template(t).supported_modes
+                mode = modes[0] if modes else None
+        return bool(mode and mode.is_realtime)
+
+    topology = analyze_topology(story_spec, is_streaming)
+
+    # transport declaration: step.transport names a story transports entry
+    transport = None
+    declared = None
+    if step is not None and step.transport:
+        for t in story_spec.transports or []:
+            if (t.name or t.transport_ref) == step.transport:
+                declared = t
+                break
+        if declared is not None:
+            tname = declared.transport_ref or declared.name
+            tr = ctrl.store.try_get(TRANSPORT_KIND, CLUSTER_NAMESPACE, tname)
+            if tr is not None:
+                transport = tr
+
+    settings = None
+    if transport is not None:
+        settings = merge_streaming_settings(
+            parse_transport(transport).streaming,
+            declared.streaming or declared.settings if declared else None,
+            (step.runtime or {}).get("streaming") if step is not None else None,
+        )
+
+    return {
+        "run": run,
+        "story": story_spec,
+        "story_name": story.meta.name,
+        "step": step,
+        "topology": topology,
+        "transport": transport,
+        "declared": declared,
+        "settings": settings,
+    }
+
+
+# ---------------------------------------------------------------------------
+# binding
+# ---------------------------------------------------------------------------
+
+def binding_name(sr_name: str) -> str:
+    return f"{sr_name}-binding"
+
+
+def _offered(step, kind: str) -> Optional[MediaBinding]:
+    runtime = step.runtime if step is not None else None
+    raw = (runtime or {}).get(kind)
+    return MediaBinding.from_dict(raw) if raw else None
+
+
+def _ensure_binding(ctrl, sr, spec, ctx):
+    """(reference: ensureRunTransportBinding steprun_controller.go:3701;
+    codec negotiation via pkg/transport/codecs.go:11,58)"""
+    ns = sr.meta.namespace
+    transport = ctx["transport"]
+    tspec = parse_transport(transport)
+    step = ctx["step"]
+    now = ctrl.clock.now()
+
+    try:
+        negotiated = negotiate_binding(
+            tspec,
+            audio=_offered(step, "audio"),
+            video=_offered(step, "video"),
+            binary=_offered(step, "binary"),
+            slice_grant=sr.spec.get("sliceGrant"),
+        )
+    except CodecError as e:
+        return None, str(e)
+
+    settings_dict = ctx["settings"].to_dict() if ctx["settings"] is not None else {}
+    bname = binding_name(sr.meta.name)
+    desired_spec = {
+        "transportRef": transport.meta.name,
+        "storyRunRef": {"name": (sr.spec.get("storyRunRef") or {}).get("name", "")},
+        "stepName": spec.step_id or "",
+        "engramName": spec.engram_ref.name if spec.engram_ref else "",
+        "driver": tspec.driver,
+        "rawSettings": settings_dict,
+    }
+
+    existing = ctrl.store.try_get(TRANSPORT_BINDING_KIND, ns, bname)
+    if existing is None:
+        b = new_resource(TRANSPORT_BINDING_KIND, bname, ns, desired_spec,
+                         labels={"bobrapet.io/step-run": sr.meta.name},
+                         owners=[sr.owner_ref()])
+        try:
+            ctrl.store.create(b)
+        except AlreadyExists:
+            pass
+        metrics.binding_ops.inc("create")
+        ctrl.store.patch_status(
+            TRANSPORT_BINDING_KIND, ns, bname,
+            lambda st: st.update({
+                "phase": "Ready",
+                "negotiated": negotiated,
+                "negotiatedAt": now,
+                "connectorGeneration": 1,
+            }),
+        )
+        return ctrl.store.get(TRANSPORT_BINDING_KIND, ns, bname), None
+
+    # re-negotiate: a changed contract bumps the connector generation
+    # (reference: connector generation bumps steprun_controller.go:2711)
+    st = existing.status
+    if st.get("negotiated") != negotiated or existing.spec.get("rawSettings") != settings_dict:
+        if existing.spec.get("rawSettings") != settings_dict:
+            ctrl.store.mutate(
+                TRANSPORT_BINDING_KIND, ns, bname,
+                lambda r: r.spec.__setitem__("rawSettings", settings_dict),
+            )
+        ctrl.store.patch_status(
+            TRANSPORT_BINDING_KIND, ns, bname,
+            lambda s: s.update({
+                "phase": "Ready",
+                "negotiated": negotiated,
+                "negotiatedAt": now,
+                "connectorGeneration": int(s.get("connectorGeneration", 1)) + 1,
+            }),
+        )
+        metrics.binding_ops.inc("update")
+    return ctrl.store.get(TRANSPORT_BINDING_KIND, ns, bname), None
+
+
+# ---------------------------------------------------------------------------
+# service + deployment
+# ---------------------------------------------------------------------------
+
+def _ensure_service(ctrl, sr, spec, engram_spec):
+    """(reference: ensureRealtimeService steprun_controller.go:2677)"""
+    ns, name = sr.meta.namespace, sr.meta.name
+    port = (
+        engram_spec.transport.grpc_port
+        if engram_spec.transport and engram_spec.transport.grpc_port
+        else ctrl.config_manager.config.engram.grpc_port
+    )
     svc_name = f"{name}-svc"
     svc = new_resource(
-        SERVICE_KIND,
-        svc_name,
-        ns,
+        SERVICE_KIND, svc_name, ns,
         spec={
             "selector": {"bobrapet.io/step-run": name},
             "port": port,
-            "engram": engram_name,
+            "engram": spec.engram_ref.name if spec.engram_ref else "",
             "stepName": spec.step_id or name,
         },
         owners=[sr.owner_ref()],
@@ -46,11 +289,258 @@ def ensure_realtime_topology(ctrl, sr, spec, engram_spec, template_spec):
         ctrl.store.create(svc)
     except AlreadyExists:
         pass
+    return svc_name, port
 
-    def patch(status: dict[str, Any]) -> None:
-        status["phase"] = str(Phase.RUNNING)
-        status["serviceName"] = svc_name
-        status["endpoint"] = f"{svc_name}.{ns}.svc:{port}"
+
+def _ensure_downstream_targets(ctrl, sr, ctx, svc_name, port):
+    """(reference: computeDownstreamTargets:1405 /
+    ensureDownstreamTargets:1548 — endpoints patched into THIS step's
+    spec so its SDK knows the next hops)"""
+    ns = sr.meta.namespace
+    step = ctx["step"]
+    if step is None or step.name not in ctx["topology"].streaming_steps:
+        return []
+    run_name = (sr.spec.get("storyRunRef") or {}).get("name", "")
+
+    def endpoint_for(dep_step: str) -> Optional[tuple[str, int]]:
+        from ..utils.naming import steprun_name
+
+        dep_sr_name = steprun_name(run_name, dep_step)
+        dep_svc = ctrl.store.try_get(SERVICE_KIND, ns, f"{dep_sr_name}-svc")
+        if dep_svc is None:
+            return None
+        return (f"{dep_sr_name}-svc.{ns}.svc", int(dep_svc.spec.get("port", port)))
+
+    tls = bool(
+        ctx["transport"] is not None
+        and (sr.spec.get("tls") or (ctx["declared"].settings or {}).get("tls")
+             if ctx["declared"] else False)
+    )
+    targets = compute_downstream_targets(
+        ctx["topology"], step.name, ns, endpoint_for,
+        settings=ctx["settings"], tls=tls,
+    )
+    if targets != sr.spec.get("downstreamTargets"):
+        try:
+            ctrl.store.mutate(
+                STEP_RUN_KIND, ns, sr.meta.name,
+                lambda r: r.spec.__setitem__("downstreamTargets", targets),
+            )
+        except NotFound:
+            pass
+    return targets
+
+
+def _static_config(ctrl, ctx, sr) -> dict[str, Any]:
+    """Static `with` evaluation for realtime steps — inputs-only scope;
+    step outputs do not exist in a live topology
+    (reference: evaluateStepConfigForRealtime steprun_controller.go:4868)."""
+    raw = sr.spec.get("input") or {}
+    try:
+        scope = {"inputs": ctx["run"].spec.get("inputs") or {}, "steps": {}, "run": {
+            "name": ctx["run"].meta.name, "namespace": ctx["run"].meta.namespace,
+        }}
+        return ctrl.evaluator.evaluate_value(raw, scope)
+    except Exception:  # noqa: BLE001 - runtime templates stay verbatim
+        return raw
+
+
+def _ensure_deployment(ctrl, sr, spec, engram_spec, template_spec, ctx,
+                       svc_name, port, binding, targets, generation):
+    """(reference: ensureRealtimeDeployment steprun_controller.go:2762)"""
+    ns, name = sr.meta.namespace, sr.meta.name
+    cfg = ctrl.config_manager.config
+    run_name = (sr.spec.get("storyRunRef") or {}).get("name", "")
+    env = contract.build_env(
+        namespace=ns,
+        story=ctx["story_name"],
+        story_run=run_name,
+        step=spec.step_id or "",
+        step_run=name,
+        engram=spec.engram_ref.name if spec.engram_ref else "",
+        execution_mode="deployment",
+        max_inline_size=cfg.engram.max_inline_size,
+        storage_timeout_seconds=cfg.engram.storage_timeout_seconds,
+        max_recursion_depth=cfg.engram.max_recursion_depth,
+        grpc_port=port,
+        config=_static_config(ctrl, ctx, sr),
+        downstream_targets=targets or None,
+    )
+    if binding is not None:
+        env[contract.ENV_BINDING_INFO] = json.dumps({
+            "binding": binding.meta.name,
+            "driver": binding.spec.get("driver"),
+            "negotiated": binding.status.get("negotiated") or {},
+            "generation": generation,
+        }, separators=(",", ":"), sort_keys=True)
+
+    desired_spec = {
+        "image": template_spec.image or "",
+        "entrypoint": template_spec.entrypoint or "",
+        "replicas": 1,
+        "env": env,
+        "selector": {"bobrapet.io/step-run": name},
+        "connectorGeneration": generation,
+        "serviceName": svc_name,
+    }
+    dep_name = f"{name}-rt"
+    existing = ctrl.store.try_get(DEPLOYMENT_KIND, ns, dep_name)
+    if existing is None:
+        d = new_resource(DEPLOYMENT_KIND, dep_name, ns, desired_spec,
+                         labels={"bobrapet.io/step-run": name},
+                         owners=[sr.owner_ref()])
+        try:
+            ctrl.store.create(d)
+        except AlreadyExists:
+            pass
+        return ctrl.store.get(DEPLOYMENT_KIND, ns, dep_name)
+    if existing.spec != desired_spec:
+        def sync(r: Resource) -> None:
+            r.spec = dict(desired_spec)
+
+        ctrl.store.mutate(DEPLOYMENT_KIND, ns, dep_name, sync)
+    return ctrl.store.get(DEPLOYMENT_KIND, ns, dep_name)
+
+
+# ---------------------------------------------------------------------------
+# handoff + phase
+# ---------------------------------------------------------------------------
+
+def _sync_handoff(ctrl, sr, ctx, deployment, generation) -> None:
+    """(reference: handoff/upgrade strategy steprun_controller.go:4395-4494,
+    HandoffStatus steprun_types.go:175-191) — when the connector
+    generation moves past what the live deployment serves, record the
+    in-flight handoff; cutover completes when the deployment observes the
+    new generation."""
+    ns, name = sr.meta.namespace, sr.meta.name
+    observed = int(deployment.status.get("observedConnectorGeneration", 0))
+    current = sr.status.get("handoff") or {}
+    strategy = "drain"
+    settings = ctx.get("settings")
+    if settings is not None and settings.lifecycle is not None and settings.lifecycle.upgrade_strategy:
+        strategy = settings.lifecycle.upgrade_strategy
+
+    if observed and observed < generation:
+        if current.get("newGeneration") != generation or current.get("phase") == "Completed":
+            now = ctrl.clock.now()
+            ctrl.store.patch_status(
+                STEP_RUN_KIND, ns, name,
+                lambda st: st.__setitem__("handoff", {
+                    "strategy": strategy,
+                    "phase": "Draining" if strategy == "drain" else "CuttingOver",
+                    "oldGeneration": observed,
+                    "newGeneration": generation,
+                    "startedAt": now,
+                }),
+            )
+    elif current and current.get("phase") != "Completed" and observed >= generation:
+        ctrl.store.patch_status(
+            STEP_RUN_KIND, ns, name,
+            lambda st: st.__setitem__(
+                "handoff", {**current, "phase": "Completed"}
+            ),
+        )
+
+
+def _derive_phase(ctrl, sr, binding, deployment, svc_name, port):
+    """(reference: deriveRealtimePhase steprun_controller.go:2838)"""
+    ns, name = sr.meta.namespace, sr.meta.name
+    now = ctrl.clock.now()
+    binding_ready = binding is None or binding.status.get("phase") == "Ready"
+    ready_replicas = int(deployment.status.get("readyReplicas", 0))
+    dep_ready = ready_replicas >= int(deployment.spec.get("replicas", 1))
+
+    def patch(st: dict[str, Any]) -> None:
+        st["serviceName"] = svc_name
+        st["endpoint"] = f"{svc_name}.{ns}.svc:{port}"
+        if binding is not None:
+            st["bindingName"] = binding.meta.name
+        conds = st.setdefault("conditions", [])
+        conditions.set_condition(
+            conds, conditions.TRANSPORT_READY, binding_ready,
+            conditions.Reason.TRANSPORT_READY if binding_ready
+            else conditions.Reason.AWAITING_TRANSPORT,
+            "binding negotiated" if binding_ready else "binding not ready",
+            now=now,
+        )
+        if binding_ready and dep_ready:
+            st["phase"] = str(Phase.RUNNING)
+            st.setdefault("startedAt", now)
+        else:
+            st["phase"] = str(Phase.PENDING)
+            st["message"] = (
+                "waiting for stream workers"
+                if binding_ready else "waiting for transport binding"
+            )
 
     ctrl.store.patch_status(STEP_RUN_KIND, ns, name, patch)
     return None
+
+
+def _terminate_topology(ctrl, sr):
+    """Graceful cancel reached a streaming step: tear the topology down
+    (reference: realtime topology termination, ReasonTopologyTerminated
+    conditions.go:119 consumed at dag.go:441)."""
+    ns, name = sr.meta.namespace, sr.meta.name
+    now = ctrl.clock.now()
+    bname = binding_name(name)
+    b = ctrl.store.try_get(TRANSPORT_BINDING_KIND, ns, bname)
+    if b is not None:
+        ctrl.store.patch_status(
+            TRANSPORT_BINDING_KIND, ns, bname,
+            lambda st: st.update({"phase": "Terminated", "terminatedAt": now}),
+        )
+
+    def patch(st: dict[str, Any]) -> None:
+        st["phase"] = str(Phase.CANCELED)
+        st["finishedAt"] = now
+        conds = st.setdefault("conditions", [])
+        conditions.set_condition(
+            conds, conditions.TRANSPORT_READY, False,
+            conditions.Reason.TOPOLOGY_TERMINATED, "topology terminated",
+            now=now,
+        )
+
+    ctrl.store.patch_status(STEP_RUN_KIND, ns, name, patch)
+    return None
+
+
+def _set_pending(ctrl, sr, reason, message):
+    now = ctrl.clock.now()
+
+    def patch(st: dict[str, Any]) -> None:
+        st["phase"] = str(Phase.PENDING)
+        st["message"] = message
+        conds = st.setdefault("conditions", [])
+        conditions.set_condition(conds, conditions.TRANSPORT_READY, False,
+                                 reason, message, now=now)
+
+    ctrl.store.patch_status(STEP_RUN_KIND, sr.meta.namespace, sr.meta.name, patch)
+    return None
+
+
+def _set_failed_transport(ctrl, sr, message):
+    """Codec negotiation failure is terminal for the step
+    (reference: TransportFailed)."""
+    now = ctrl.clock.now()
+
+    def patch(st: dict[str, Any]) -> None:
+        st["phase"] = str(Phase.FAILED)
+        st["message"] = message
+        st["finishedAt"] = now
+        st["error"] = {
+            "version": "v1", "type": "initialization",
+            "message": message, "retryable": False,
+        }
+        conds = st.setdefault("conditions", [])
+        conditions.set_condition(conds, conditions.TRANSPORT_READY, False,
+                                 conditions.Reason.TRANSPORT_FAILED, message,
+                                 now=now)
+
+    ctrl.store.patch_status(STEP_RUN_KIND, sr.meta.namespace, sr.meta.name, patch)
+    return None
+
+
+# backwards-compat export (pre-transport-layer core used this name)
+def ensure_realtime_topology(ctrl, sr, spec, engram_spec, template_spec):
+    return reconcile_realtime_step(ctrl, sr, spec, engram_spec, template_spec)

@@ -1,0 +1,111 @@
+"""Transport controller: validation + capability aggregation.
+
+Capability parity with the reference's Transport reconciler
+(reference: internal/controller/transport_controller.go — Reconcile:68,
+collectAvailableCapabilities:182, heartbeatTimeout:345): validate the
+Transport spec (driver, codec lists, MIME types, ICI mesh descriptor),
+aggregate the negotiated capabilities of its live TransportBindings
+(heartbeat staleness excludes dead connectors), and maintain usage
+(stories declaring it) and binding state counters.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from ..api import conditions
+from ..api.catalog import CLUSTER_NAMESPACE
+from ..api.enums import ValidationStatus
+from ..api.story import KIND as STORY_KIND
+from ..api.transport import (
+    TRANSPORT_BINDING_KIND,
+    TRANSPORT_KIND,
+    parse_transport,
+)
+from ..core.events import EventRecorder
+from ..core.store import ResourceStore
+from ..observability.metrics import metrics
+from ..transport import aggregate_bindings, validate_transport_spec
+from ..transport.capabilities import DEFAULT_HEARTBEAT_TIMEOUT
+from .manager import Clock
+
+_log = logging.getLogger(__name__)
+
+INDEX_BINDING_TRANSPORT = "transportRef"
+INDEX_STORY_TRANSPORT_REFS = "transportRefs"
+
+
+class TransportController:
+    """(reference: transport_controller.go Reconcile:68)"""
+
+    def __init__(
+        self,
+        store: ResourceStore,
+        recorder: Optional[EventRecorder] = None,
+        clock: Optional[Clock] = None,
+        heartbeat_timeout: float = DEFAULT_HEARTBEAT_TIMEOUT,
+    ):
+        self.store = store
+        self.recorder = recorder or EventRecorder()
+        self.clock = clock or Clock()
+        self.heartbeat_timeout = heartbeat_timeout
+
+    def reconcile(self, namespace: str, name: str) -> Optional[float]:
+        transport = self.store.try_get(TRANSPORT_KIND, CLUSTER_NAMESPACE, name)
+        if transport is None or transport.meta.deletion_timestamp is not None:
+            return None
+        spec = parse_transport(transport)
+        errors = validate_transport_spec(spec)
+        now = self.clock.now()
+
+        bindings = self.store.list(
+            TRANSPORT_BINDING_KIND, index=(INDEX_BINDING_TRANSPORT, name)
+        )
+        caps = aggregate_bindings(bindings, now, self.heartbeat_timeout)
+        stories = self.store.list(
+            STORY_KIND, index=(INDEX_STORY_TRANSPORT_REFS, name)
+        )
+
+        metrics.bindings_by_state.set(caps["liveBindings"], "ready")
+        metrics.bindings_by_state.set(caps["pendingBindings"], "pending")
+        metrics.bindings_by_state.set(caps["failedBindings"], "failed")
+
+        def patch(st: dict[str, Any]) -> None:
+            st["validationStatus"] = str(
+                ValidationStatus.INVALID if errors else ValidationStatus.VALID
+            )
+            st["validationErrors"] = errors
+            st["capabilities"] = {
+                k: caps[k] for k in ("audio", "video", "binary", "meshes")
+            }
+            st["liveBindings"] = caps["liveBindings"]
+            st["staleBindings"] = caps["staleBindings"]
+            st["pendingBindings"] = caps["pendingBindings"]
+            st["failedBindings"] = caps["failedBindings"]
+            st["usedByStories"] = sorted(
+                f"{s.meta.namespace}/{s.meta.name}" for s in stories
+            )
+            st["usageCount"] = len(stories)
+            st["observedGeneration"] = transport.meta.generation
+            conds = st.setdefault("conditions", [])
+            conditions.set_condition(
+                conds, conditions.READY, not errors,
+                conditions.Reason.VALIDATION_PASSED if not errors
+                else conditions.Reason.VALIDATION_FAILED,
+                "; ".join(errors) or "transport validated", now=now,
+            )
+
+        self.store.patch_status(TRANSPORT_KIND, CLUSTER_NAMESPACE, name, patch)
+        if errors:
+            self.recorder.warning(
+                transport, conditions.Reason.VALIDATION_FAILED, "; ".join(errors)
+            )
+        # live bindings can go stale without any event: requeue while
+        # anything is live (reference: heartbeat staleness sweep);
+        # an infinite timeout (no connectors, local runtime) never sweeps
+        import math
+
+        if caps["liveBindings"] and math.isfinite(self.heartbeat_timeout):
+            return self.heartbeat_timeout
+        return None

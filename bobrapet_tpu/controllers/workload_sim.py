@@ -1,0 +1,73 @@
+"""Local workload simulator — the "kubelet" for long-running workloads.
+
+The reference relies on real kubelets to bring Deployments/StatefulSets
+up; its envtest suites simulate that by patching status
+(reference: SURVEY §4 — "tests patch Job/StepRun status to simulate SDK
+and kubelet behavior"). This simulator plays the same role for the local
+runtime: it watches Deployment/StatefulSet records and marks them ready
+(readyReplicas = replicas, observedConnectorGeneration synced), which
+drives realtime StepRuns from Pending to Running. On GKE this module is
+replaced by actual kubelets; nothing above it changes.
+
+Disable (``auto_ready=False``) to exercise Pending/handoff states in
+tests.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..core.store import ADDED, MODIFIED, ResourceStore, NotFound, WatchEvent
+from .manager import Clock
+from .streaming import DEPLOYMENT_KIND, STATEFULSET_KIND
+
+_log = logging.getLogger(__name__)
+
+
+class WorkloadSimulator:
+    def __init__(
+        self,
+        store: ResourceStore,
+        clock: Optional[Clock] = None,
+        auto_ready: bool = True,
+    ):
+        self.store = store
+        self.clock = clock or Clock()
+        self.auto_ready = auto_ready
+        store.watch(self._on_event, kinds=[DEPLOYMENT_KIND, STATEFULSET_KIND])
+
+    def _on_event(self, ev: WatchEvent) -> None:
+        if not self.auto_ready or ev.type not in (ADDED, MODIFIED):
+            return
+        r = ev.resource
+        replicas = int(r.spec.get("replicas", 1))
+        generation = int(r.spec.get("connectorGeneration", 0))
+        if (
+            int(r.status.get("readyReplicas", 0)) == replicas
+            and int(r.status.get("observedConnectorGeneration", 0)) == generation
+        ):
+            return
+
+        def patch(st) -> None:
+            st["readyReplicas"] = replicas
+            st["availableReplicas"] = replicas
+            if generation:
+                st["observedConnectorGeneration"] = generation
+            st.setdefault("startedAt", self.clock.now())
+
+        try:
+            self.store.patch_status(r.kind, r.meta.namespace, r.meta.name, patch)
+        except NotFound:
+            pass
+
+    def mark_ready(self, kind: str, namespace: str, name: str,
+                   ready: bool = True) -> None:
+        """Manual control for tests exercising readiness transitions."""
+        r = self.store.get(kind, namespace, name)
+        replicas = int(r.spec.get("replicas", 1))
+
+        def patch(st) -> None:
+            st["readyReplicas"] = replicas if ready else 0
+
+        self.store.patch_status(kind, namespace, name, patch)

@@ -53,7 +53,9 @@ from .controllers.resources import (
 from .controllers.step_executor import StepExecutor
 from .controllers.steprun import StepRunController
 from .controllers.storyrun import StoryRunController
+from .controllers.transport import TransportController
 from .controllers.triggers import EffectClaimController, StoryTriggerController
+from .controllers.workload_sim import WorkloadSimulator
 from .core.events import EventRecorder
 from .core.store import DELETED, ResourceStore, WatchEvent
 from .parallel.placement import SlicePlacer
@@ -142,9 +144,18 @@ class Runtime:
         self.effectclaim_controller = EffectClaimController(
             self.store, recorder=self.recorder, clock=self.clock
         )
+        # heartbeats come from live connectors; the local runtime has none,
+        # so staleness sweeps are disabled by default (tests pass a finite
+        # timeout to exercise them)
+        self.transport_controller = TransportController(
+            self.store, recorder=self.recorder, clock=self.clock,
+            heartbeat_timeout=float("inf"),
+        )
         self.job_executor = LocalGangExecutor(
             self.store, storage=self.storage, clock=self.clock, mode=executor_mode
         )
+        # local "kubelet" for long-running workloads (realtime + impulse)
+        self.workload_simulator = WorkloadSimulator(self.store, clock=self.clock)
 
         self.manager = ControllerManager(self.store, clock=self.clock)
         self._register_controllers()
@@ -416,6 +427,58 @@ class Runtime:
             "effectclaim",
             self.effectclaim_controller.reconcile,
             watches={EFFECT_CLAIM_KIND: None},
+        )
+
+        # --- transport (reference: transport_controller.go)
+        def binding_to_transport(ev: WatchEvent):
+            name = ev.resource.spec.get("transportRef")
+            return [(CLUSTER_NAMESPACE, name)] if name else []
+
+        m.register(
+            "transport",
+            self.transport_controller.reconcile,
+            watches={
+                TRANSPORT_KIND: None,
+                TRANSPORT_BINDING_KIND: binding_to_transport,
+            },
+        )
+
+        # binding + realtime workload events drive the owning StepRun
+        def owned_to_steprun(ev: WatchEvent):
+            name = ev.resource.meta.labels.get("bobrapet.io/step-run")
+            return [(ev.resource.meta.namespace, name)] if name else []
+
+        def service_to_run_steprens(ev: WatchEvent):
+            # a dependent's Service appearing lets UPSTREAM streaming steps
+            # resolve their P2P downstream endpoints — re-reconcile every
+            # StepRun of the same story run
+            ns = ev.resource.meta.namespace
+            owners = ev.resource.meta.owner_references
+            if not owners:
+                return []
+            owner_sr = self.store.try_get(STEP_RUN_KIND, ns, owners[0].name)
+            if owner_sr is None:
+                return []
+            run_name = (owner_sr.spec.get("storyRunRef") or {}).get("name")
+            if not run_name:
+                return []
+            return [
+                (sr.meta.namespace, sr.meta.name)
+                for sr in self.store.list(
+                    STEP_RUN_KIND, namespace=ns,
+                    index=(INDEX_STEPRUN_STORYRUN, run_name),
+                )
+            ]
+
+        m.register(
+            "steprun-realtime",
+            self.steprun_controller.reconcile,
+            watches={
+                TRANSPORT_BINDING_KIND: owned_to_steprun,
+                "Deployment": owned_to_steprun,
+                "StatefulSet": owned_to_steprun,
+                "Service": service_to_run_steprens,
+            },
         )
 
     # ------------------------------------------------------------------

@@ -1,0 +1,36 @@
+"""Streaming transport control plane.
+
+Capability parity with the reference's ``pkg/transport``
+(reference: pkg/transport/ — codec negotiation codecs.go, topology
+analysis topology.go:46, routing resolver routing_resolver.go:31,
+capability aggregation capabilities_aggregation.go:47, settings merge
+settings.go:25, BindingInfo encode transportutil.go:188).
+
+The data plane never passes through the operator: this package computes
+*who talks to whom with which codecs under which policy* and persists the
+result in TransportBinding status + StepRun downstream targets; engram
+workers and connectors do the actual streaming (gRPC over the TPU-VM
+host network between slices, ICI inside a slice).
+"""
+
+from .capabilities import aggregate_bindings
+from .codecs import (
+    CodecError,
+    negotiate_binding,
+    validate_transport_spec,
+)
+from .routing import compute_downstream_targets, step_needs_hub
+from .settings import merge_streaming_settings
+from .topology import StreamTopology, analyze_topology
+
+__all__ = [
+    "CodecError",
+    "StreamTopology",
+    "aggregate_bindings",
+    "analyze_topology",
+    "compute_downstream_targets",
+    "merge_streaming_settings",
+    "negotiate_binding",
+    "step_needs_hub",
+    "validate_transport_spec",
+]

@@ -1,0 +1,68 @@
+"""Capability aggregation across a Transport's live bindings.
+
+(reference: pkg/transport/capabilities_aggregation.go:47
+``AggregateBindings`` + heartbeat staleness heartbeatTimeout
+transport_controller.go:345 — a binding whose connector stopped
+heartbeating is excluded from the advertised capability set.)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+DEFAULT_HEARTBEAT_TIMEOUT = 60.0
+
+
+def aggregate_bindings(
+    bindings,
+    now: float,
+    heartbeat_timeout: float = DEFAULT_HEARTBEAT_TIMEOUT,
+) -> dict[str, Any]:
+    """Union the negotiated capabilities of live bindings.
+
+    ``bindings`` are TransportBinding resources; a binding is *live* when
+    Ready and its ``status.heartbeatAt`` (stamped by the connector) is
+    within the timeout. Bindings that never heartbeat yet (just created)
+    count as live until the timeout elapses from negotiation.
+    """
+    audio: dict[str, dict[str, Any]] = {}
+    video: dict[str, dict[str, Any]] = {}
+    binary: set[str] = set()
+    meshes: set[str] = set()
+    live = stale = pending = failed = 0
+
+    for b in bindings:
+        st = b.status
+        phase = st.get("phase")
+        if phase == "Failed":
+            failed += 1
+            continue
+        if phase != "Ready":
+            pending += 1
+            continue
+        beat = st.get("heartbeatAt") or st.get("negotiatedAt") or 0.0
+        if now - float(beat) > heartbeat_timeout:
+            stale += 1
+            continue
+        live += 1
+        neg = st.get("negotiated") or {}
+        for c in neg.get("audio") or []:
+            audio.setdefault(c.get("name", ""), c)
+        for c in neg.get("video") or []:
+            video.setdefault(c.get("name", ""), c)
+        for m in neg.get("binary") or []:
+            binary.add(m)
+        mesh = (neg.get("mesh") or {}).get("topology")
+        if mesh:
+            meshes.add(mesh)
+
+    return {
+        "audio": [audio[k] for k in sorted(audio)],
+        "video": [video[k] for k in sorted(video)],
+        "binary": sorted(binary),
+        "meshes": sorted(meshes),
+        "liveBindings": live,
+        "staleBindings": stale,
+        "pendingBindings": pending,
+        "failedBindings": failed,
+    }

@@ -1,0 +1,160 @@
+"""Codec negotiation + Transport spec validation.
+
+(reference: pkg/transport/codecs.go:11,58 — defaults population and
+validation against the Transport's supported codec lists;
+pkg/transport/validation used by the transport webhook.)
+
+Negotiation is an intersection: the step/engram side offers codecs (or
+none, meaning "transport defaults"), the Transport declares support, the
+controller records the agreed subset in TransportBinding status. For the
+TPU-native ``ici`` driver the negotiated artifact is not a media codec
+but the device-mesh descriptor the two sides will address
+(SURVEY §2.6 "TransportBinding negotiation" row).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional
+
+from ..api.transport import (
+    DRIVER_GRPC,
+    DRIVER_ICI,
+    MediaBinding,
+    MediaCodec,
+    TransportSpec,
+)
+
+_MIME_RE = re.compile(r"^[a-zA-Z0-9][\w.+-]*/[\w.+-]+$")
+
+KNOWN_DRIVERS = (DRIVER_GRPC, DRIVER_ICI)
+
+
+class CodecError(Exception):
+    pass
+
+
+def validate_transport_spec(spec: TransportSpec) -> list[str]:
+    """(reference: transport webhook validation — driver known, codecs
+    well-formed + unique, mime types parse)."""
+    errors: list[str] = []
+    if not spec.provider:
+        errors.append("spec.provider is required")
+    if spec.driver and spec.driver not in KNOWN_DRIVERS:
+        errors.append(
+            f"spec.driver {spec.driver!r} unknown (supported: {list(KNOWN_DRIVERS)})"
+        )
+    for field_name, codecs in (
+        ("supportedAudio", spec.supported_audio),
+        ("supportedVideo", spec.supported_video),
+    ):
+        seen: set[str] = set()
+        for c in codecs:
+            if not c.name:
+                errors.append(f"spec.{field_name}: codec name required")
+            elif c.name in seen:
+                errors.append(f"spec.{field_name}: duplicate codec {c.name!r}")
+            else:
+                seen.add(c.name)
+            if c.sample_rate_hz is not None and c.sample_rate_hz <= 0:
+                errors.append(f"spec.{field_name}.{c.name}: sampleRateHz must be > 0")
+    seen = set()
+    for m in spec.supported_binary:
+        if not _MIME_RE.match(m):
+            errors.append(f"spec.supportedBinary: invalid MIME type {m!r}")
+        elif m in seen:
+            errors.append(f"spec.supportedBinary: duplicate MIME type {m!r}")
+        else:
+            seen.add(m)
+    if spec.driver == DRIVER_ICI and not spec.mesh_topology:
+        errors.append("spec.meshTopology is required for driver 'ici'")
+    return errors
+
+
+def _intersect_codecs(
+    offered: list[MediaCodec], supported: list[MediaCodec]
+) -> list[MediaCodec]:
+    by_name = {c.name: c for c in supported}
+    out = []
+    for c in offered:
+        s = by_name.get(c.name)
+        if s is None:
+            continue
+        # the stricter (offered) parameters win within the supported shape
+        out.append(MediaCodec(
+            name=c.name,
+            sample_rate_hz=c.sample_rate_hz or s.sample_rate_hz,
+            channels=c.channels or s.channels,
+            profile=c.profile or s.profile,
+        ))
+    return out
+
+
+def negotiate_media(
+    offered: Optional[MediaBinding],
+    supported: list[MediaCodec],
+    what: str,
+) -> list[MediaCodec]:
+    """One media kind. No offer -> transport defaults (all supported);
+    an offer with an empty intersection is a negotiation failure."""
+    if offered is None or not offered.codecs:
+        return list(supported)
+    agreed = _intersect_codecs(offered.codecs, supported)
+    if not agreed:
+        raise CodecError(
+            f"{what}: no codec in common "
+            f"(offered {[c.name for c in offered.codecs]}, "
+            f"supported {[c.name for c in supported]})"
+        )
+    return agreed
+
+
+def negotiate_mime(
+    offered: Optional[MediaBinding], supported: list[str]
+) -> list[str]:
+    if offered is None or not offered.mime_types:
+        return list(supported)
+    agreed = [m for m in offered.mime_types if m in supported]
+    if not agreed:
+        raise CodecError(
+            f"binary: no MIME type in common "
+            f"(offered {offered.mime_types}, supported {supported})"
+        )
+    return agreed
+
+
+def negotiate_binding(
+    transport: TransportSpec,
+    audio: Optional[MediaBinding] = None,
+    video: Optional[MediaBinding] = None,
+    binary: Optional[MediaBinding] = None,
+    slice_grant: Optional[dict[str, Any]] = None,
+) -> dict[str, Any]:
+    """Full binding negotiation -> the dict persisted into
+    TransportBinding.status (reference: codec population/validation at
+    steprun_controller.go:3701-4061 via pkg/transport/codecs.go)."""
+    negotiated: dict[str, Any] = {"driver": transport.driver or DRIVER_GRPC}
+    if transport.driver == DRIVER_ICI:
+        # the "codec" of an ICI stream is the mesh descriptor both sides
+        # address; a slice grant narrows it to the granted sub-mesh
+        mesh = transport.mesh_topology
+        if slice_grant and slice_grant.get("topology"):
+            mesh = slice_grant["topology"]
+        negotiated["mesh"] = {
+            "topology": mesh,
+            "sliceId": (slice_grant or {}).get("sliceId"),
+        }
+        return negotiated
+    if transport.supported_audio or audio is not None:
+        agreed = negotiate_media(audio, transport.supported_audio, "audio")
+        if agreed:
+            negotiated["audio"] = [c.to_dict() for c in agreed]
+    if transport.supported_video or video is not None:
+        agreed = negotiate_media(video, transport.supported_video, "video")
+        if agreed:
+            negotiated["video"] = [c.to_dict() for c in agreed]
+    if transport.supported_binary or binary is not None:
+        agreed_mime = negotiate_mime(binary, transport.supported_binary)
+        if agreed_mime:
+            negotiated["binary"] = agreed_mime
+    return negotiated

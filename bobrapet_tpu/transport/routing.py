@@ -1,0 +1,79 @@
+"""Routing resolution: next-hop endpoints for each streaming step.
+
+(reference: pkg/transport/routing_resolver.go:31 ``RoutingResolver`` +
+computeDownstreamTargets steprun_controller.go:1405-1651 — the
+controller computes each step's dependents' gRPC endpoints and patches
+them into the StepRun spec so SDKs stream outputs P2P; terminal steps
+get a terminate target; fan-out capped by routing.maxDownstreams.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..api.transport import TransportStreamingSettings
+from .topology import StreamTopology
+
+HUB_SERVICE = "bobravoz-hub"
+DEFAULT_HUB_PORT = 50052
+
+
+def service_endpoint(service_name: str, namespace: str, port: int) -> str:
+    return f"{service_name}.{namespace}.svc:{port}"
+
+
+def hub_endpoint(namespace: str, port: int = DEFAULT_HUB_PORT) -> str:
+    return service_endpoint(HUB_SERVICE, namespace, port)
+
+
+def step_needs_hub(topology: StreamTopology, step: str) -> bool:
+    """(reference: StepNeedsHubRouting routing.go:26-43)"""
+    return topology.needs_hub(step)
+
+
+def compute_downstream_targets(
+    topology: StreamTopology,
+    step: str,
+    namespace: str,
+    endpoint_for: Callable[[str], Optional[tuple[str, int]]],
+    settings: Optional[TransportStreamingSettings] = None,
+    tls: bool = False,
+) -> list[dict[str, Any]]:
+    """Downstream targets for one streaming step's StepRun spec.
+
+    ``endpoint_for(step_name) -> (host_service, port)`` resolves a
+    dependent streaming step's service endpoint (None while its service
+    has not materialized — the caller retries on the next reconcile).
+    """
+    hub = step_needs_hub(topology, step)
+    deps = topology.downstream.get(step, [])
+    max_downstreams = None
+    if settings is not None and settings.routing is not None:
+        max_downstreams = settings.routing.max_downstreams
+        if settings.routing.mode == "hub":
+            hub = True
+    targets: list[dict[str, Any]] = []
+    if not deps:
+        # terminal streaming step: the SDK closes the stream on completion
+        # (reference: TerminateTarget steprun_types.go:157-161)
+        return [{"terminate": True}]
+    if hub:
+        target: dict[str, Any] = {
+            "host": f"{HUB_SERVICE}.{namespace}.svc",
+            "port": DEFAULT_HUB_PORT,
+        }
+        if tls:
+            target["tls"] = True
+        return [{"grpc": target}]
+    if max_downstreams is not None and len(deps) > max_downstreams:
+        deps = deps[:max_downstreams]
+    for dep in deps:
+        ep = endpoint_for(dep)
+        if ep is None:
+            continue
+        host, port = ep
+        target = {"host": host, "port": port, "stepName": dep}
+        if tls:
+            target["tls"] = True
+        targets.append({"grpc": target})
+    return targets

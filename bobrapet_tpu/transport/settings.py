@@ -1,0 +1,37 @@
+"""Streaming settings merge chain.
+
+(reference: pkg/transport/settings.go:25 ``MergeSettingsWithStreaming`` —
+transport defaults -> story transport streaming -> step streaming,
+later layer wins per field.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..api.transport import TransportStreamingSettings
+
+
+def _deep_merge(base: dict[str, Any], overlay: dict[str, Any]) -> dict[str, Any]:
+    out = dict(base)
+    for k, v in overlay.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def merge_streaming_settings(
+    transport_defaults: Optional[TransportStreamingSettings],
+    story_settings: Optional[dict[str, Any]],
+    step_settings: Optional[dict[str, Any]] = None,
+) -> TransportStreamingSettings:
+    merged: dict[str, Any] = (
+        transport_defaults.to_dict() if transport_defaults is not None else {}
+    )
+    if story_settings:
+        merged = _deep_merge(merged, story_settings)
+    if step_settings:
+        merged = _deep_merge(merged, step_settings)
+    return TransportStreamingSettings.from_dict(merged)

@@ -1,0 +1,96 @@
+"""Stream topology analysis: who streams to whom, hub vs direct P2P.
+
+(reference: pkg/transport/topology.go:46-145 ``TopologyAnalyzer`` and
+routing.go:26-43 ``StepNeedsHubRouting`` — a primitive sitting between
+two streaming steps forces hub routing because the primitive's decision
+happens in the control plane, not the stream; pure engram chains stream
+direct P2P.)
+
+On TPU the "hub" is the bobravoz-equivalent gRPC relay on the TPU-VM
+host network; direct P2P edges inside one slice can ride ICI instead
+(SURVEY §2.6 "Hub vs P2P routing decision" row).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+from ..api.story import Step, StorySpec
+
+
+@dataclasses.dataclass
+class StreamTopology:
+    """Streaming dataflow of one story."""
+
+    # streaming step -> streaming steps it feeds (transitive edges that
+    # skip over non-streaming/batch steps are NOT streaming edges)
+    downstream: dict[str, list[str]]
+    upstream: dict[str, list[str]]
+    # steps forced through the hub (primitive on some incoming/outgoing
+    # streaming path)
+    hub_steps: set[str]
+    streaming_steps: set[str]
+
+    def needs_hub(self, step: str) -> bool:
+        return step in self.hub_steps
+
+    def terminal_steps(self) -> list[str]:
+        return [s for s in sorted(self.streaming_steps) if not self.downstream.get(s)]
+
+
+def analyze_topology(
+    story: StorySpec,
+    is_streaming: Callable[[Step], bool],
+) -> StreamTopology:
+    """Build the streaming dataflow graph from the DAG's ``needs`` edges.
+
+    An edge A->B is a *streaming edge* when both endpoints stream. When B
+    streams but an intermediate hop on the dependency path is a
+    primitive, B (and the upstream streaming producer) must route via the
+    hub — the primitive re-enters the control plane.
+    """
+    steps = {s.name: s for s in story.steps or []}
+    streaming = {name for name, s in steps.items() if is_streaming(s)}
+
+    # dependency adjacency (direct needs edges)
+    dependents: dict[str, list[str]] = {n: [] for n in steps}
+    for s in steps.values():
+        for need in s.needs or []:
+            if need in dependents:
+                dependents[need].append(s.name)
+
+    downstream: dict[str, list[str]] = {n: [] for n in streaming}
+    upstream: dict[str, list[str]] = {n: [] for n in streaming}
+    hub_steps: set[str] = set()
+
+    def walk(origin: str, node: str, via_primitive: bool, seen: set[str]) -> None:
+        for dep in dependents.get(node, []):
+            if dep in seen:
+                continue
+            seen.add(dep)
+            dep_step = steps[dep]
+            if dep in streaming:
+                downstream[origin].append(dep)
+                upstream[dep].append(origin)
+                if via_primitive:
+                    hub_steps.add(origin)
+                    hub_steps.add(dep)
+                # the stream terminates here; further hops get their own
+                # edges from `dep`
+                continue
+            walk(origin, dep, via_primitive or dep_step.is_primitive, seen)
+
+    for name in streaming:
+        walk(name, name, False, {name})
+
+    for n in downstream:
+        downstream[n].sort()
+    for n in upstream:
+        upstream[n].sort()
+    return StreamTopology(
+        downstream=downstream,
+        upstream=upstream,
+        hub_steps=hub_steps,
+        streaming_steps=streaming,
+    )
