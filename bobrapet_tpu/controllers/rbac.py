@@ -1,0 +1,191 @@
+"""Per-StoryRun RBAC: runner identity + sanitized grants.
+
+Capability parity with the reference's run RBAC manager
+(reference: internal/controller/runs/rbac.go — Reconcile:95,
+collectStoryRBACRules:282, sanitizeStoryRBACRules:652,
+isSafeStoryRBACRule:714): every StoryRun gets its own ServiceAccount +
+Role + RoleBinding so engram pods act under a run-scoped identity, not a
+shared one. Rules requested by templates/story policy pass a safety
+allowlist (no wildcards, only namespaced kinds a worker legitimately
+touches); storage provider annotations (IRSA / GKE workload identity)
+land on the ServiceAccount so offload credentials follow the run.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from ..api.catalog import (
+    CLUSTER_NAMESPACE,
+    ENGRAM_TEMPLATE_KIND,
+    parse_engram_template,
+)
+from ..api.engram import KIND as ENGRAM_KIND, parse_engram
+from ..api.story import StorySpec
+from ..core.object import Resource, new_resource
+from ..core.store import AlreadyExists, ResourceStore
+
+_log = logging.getLogger(__name__)
+
+SERVICE_ACCOUNT_KIND = "ServiceAccount"
+ROLE_KIND = "Role"
+ROLE_BINDING_KIND = "RoleBinding"
+
+# resources a run-scoped worker may legitimately touch
+# (reference: isSafeStoryRBACRule rbac.go:714 — no wildcards, bounded
+# resource/verb vocabulary)
+SAFE_RESOURCES = {
+    "configmaps", "secrets", "pods", "pods/log", "services",
+    "stepruns", "storyruns", "effectclaims", "storytriggers",
+}
+SAFE_VERBS = {"get", "list", "watch", "create", "update", "patch"}
+
+
+def runner_sa_name(run_name: str) -> str:
+    """(reference: pkg/runs/identity/engram_runner.go:12)"""
+    return f"{run_name}-runner"
+
+
+def sanitize_rules(rules: list[dict[str, Any]]) -> tuple[list[dict[str, Any]], list[str]]:
+    """Drop unsafe rules; return (kept, rejection_reasons)
+    (reference: sanitizeStoryRBACRules rbac.go:652)."""
+    kept: list[dict[str, Any]] = []
+    rejected: list[str] = []
+    for rule in rules:
+        resources = [str(r).lower() for r in rule.get("resources") or []]
+        verbs = [str(v).lower() for v in rule.get("verbs") or []]
+        groups = rule.get("apiGroups")
+        if not resources or not verbs:
+            rejected.append(f"rule {rule!r}: resources and verbs required")
+            continue
+        if "*" in resources or "*" in verbs or (groups and "*" in groups):
+            rejected.append(f"rule {rule!r}: wildcards are not allowed")
+            continue
+        bad_res = [r for r in resources if r not in SAFE_RESOURCES]
+        if bad_res:
+            rejected.append(f"rule {rule!r}: resources {bad_res} outside allowlist")
+            continue
+        bad_verbs = [v for v in verbs if v not in SAFE_VERBS]
+        if bad_verbs:
+            rejected.append(f"rule {rule!r}: verbs {bad_verbs} outside allowlist")
+            continue
+        kept.append({"resources": resources, "verbs": verbs,
+                     **({"apiGroups": groups} if groups else {})})
+    return kept, rejected
+
+
+class RunRBACManager:
+    """(reference: rbac.go Reconcile:95)"""
+
+    def __init__(self, store: ResourceStore):
+        self.store = store
+
+    # ------------------------------------------------------------------
+    def ensure(self, run: Resource, story_spec: StorySpec) -> dict[str, Any]:
+        """Materialize SA + Role + RoleBinding for one run. Returns a
+        summary {serviceAccount, rules, rejectedRules}."""
+        ns = run.meta.namespace
+        sa_name = runner_sa_name(run.meta.name)
+        rules = self._collect_rules(ns, story_spec)
+        kept, rejected = sanitize_rules(rules)
+        annotations = self._storage_annotations(story_spec)
+
+        self._ensure_owned(run, new_resource(
+            SERVICE_ACCOUNT_KIND, sa_name, ns,
+            spec={"annotations": annotations} if annotations else {},
+            owners=[run.owner_ref()],
+        ), validate_owner=True)
+        self._ensure_owned(run, new_resource(
+            ROLE_KIND, sa_name, ns,
+            spec={"rules": kept},
+            owners=[run.owner_ref()],
+        ))
+        self._ensure_owned(run, new_resource(
+            ROLE_BINDING_KIND, sa_name, ns,
+            spec={
+                "roleRef": sa_name,
+                "subjects": [{"kind": SERVICE_ACCOUNT_KIND, "name": sa_name}],
+            },
+            owners=[run.owner_ref()],
+        ))
+        return {
+            "serviceAccount": sa_name,
+            "rules": kept,
+            "rejectedRules": rejected,
+        }
+
+    # ------------------------------------------------------------------
+    def _collect_rules(self, ns: str, story_spec: StorySpec) -> list[dict[str, Any]]:
+        """(reference: collectStoryRBACRules rbac.go:282 — template
+        execution-policy rules for every engram the story uses + story
+        policy rules)"""
+        rules: list[dict[str, Any]] = []
+        if story_spec.policy and story_spec.policy.execution:
+            rules.extend(story_spec.policy.execution.rbac_rules or [])
+        for step in story_spec.all_steps():
+            if step.ref is None:
+                continue
+            engram = self.store.try_get(ENGRAM_KIND, ns, step.ref.name)
+            if engram is None:
+                continue
+            es = parse_engram(engram)
+            template = self.store.try_get(
+                ENGRAM_TEMPLATE_KIND, CLUSTER_NAMESPACE,
+                es.template_ref.name if es.template_ref else "",
+            )
+            if template is None:
+                continue
+            ts = parse_engram_template(template)
+            if ts.execution_policy is not None:
+                rules.extend(ts.execution_policy.rbac_rules or [])
+        # dedup (stable order)
+        seen: set[str] = set()
+        unique = []
+        for r in rules:
+            key = repr(sorted(r.items(), key=lambda kv: kv[0]))
+            if key not in seen:
+                seen.add(key)
+                unique.append(r)
+        return unique
+
+    def _storage_annotations(self, story_spec: StorySpec) -> dict[str, str]:
+        """IRSA / workload-identity annotations follow the run's storage
+        provider (reference: storage annotations on SA, rbac.go + IRSA
+        podspec/storage.go:42)."""
+        policy = story_spec.policy.storage if story_spec.policy else None
+        if policy is None or policy.s3 is None:
+            return {}
+        return dict(policy.s3.service_account_annotations or {})
+
+    def _ensure_owned(self, run: Resource, desired: Resource,
+                      validate_owner: bool = False) -> None:
+        """Create-or-validate: an existing object not owned by this run is
+        an identity-hijack attempt and is NOT adopted
+        (reference: ownership validation against SA hijack, rbac.go)."""
+        try:
+            self.store.create(desired)
+            return
+        except AlreadyExists:
+            pass
+        existing = self.store.try_get(
+            desired.kind, desired.meta.namespace, desired.meta.name
+        )
+        if existing is None:
+            return
+        if not existing.has_owner(run):
+            raise RBACOwnershipError(
+                f"{desired.kind} {desired.meta.name!r} exists but is not "
+                f"owned by StoryRun {run.meta.name!r} — refusing to adopt"
+            )
+        if existing.spec != desired.spec:
+            def sync(r: Resource) -> None:
+                r.spec = dict(desired.spec)
+
+            self.store.mutate(
+                desired.kind, desired.meta.namespace, desired.meta.name, sync
+            )
+
+
+class RBACOwnershipError(Exception):
+    pass

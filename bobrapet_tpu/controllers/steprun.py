@@ -264,6 +264,9 @@ class StepRunController:
                 "bobrapet.io/step": spec.step_id or name,
             },
         )
+        # pods act under the run-scoped identity (reference: rbac.go)
+        if storyrun is not None and storyrun.status.get("serviceAccount"):
+            job.spec["serviceAccountName"] = storyrun.status["serviceAccount"]
 
         def mark_running(status: dict[str, Any]) -> None:
             status["phase"] = str(Phase.RUNNING)

@@ -54,6 +54,9 @@ class StoryRunController:
         self.storage = storage
         self.recorder = recorder
         self.clock = clock or Clock()
+        from .rbac import RunRBACManager
+
+        self.rbac = RunRBACManager(store)
 
     # ------------------------------------------------------------------
     def reconcile(self, namespace: str, name: str) -> Optional[float]:
@@ -160,6 +163,25 @@ class StoryRunController:
                 r.spec["inputs"] = offloaded
 
             run = self.store.mutate(STORY_RUN_KIND, namespace, name, swap_inputs)
+
+        # --- per-run RBAC identity (reference: rbac.go Reconcile:95) ---
+        if not run.status.get("serviceAccount"):
+            from .rbac import RBACOwnershipError
+
+            try:
+                rbac_summary = self.rbac.ensure(run, story)
+            except RBACOwnershipError as e:
+                return self._fail(
+                    run,
+                    StructuredError(type=ErrorType.VALIDATION, message=str(e)),
+                    reason=conditions.Reason.INVALID_CONFIGURATION,
+                )
+            def record_sa(status: dict[str, Any]) -> None:
+                status["serviceAccount"] = rbac_summary["serviceAccount"]
+                if rbac_summary["rejectedRules"]:
+                    status["rejectedRBACRules"] = rbac_summary["rejectedRules"]
+
+            run = self.store.patch_status(STORY_RUN_KIND, namespace, name, record_sa)
 
         # --- DAG reconcile (engine mutates a working copy's status) ---
         before = json.dumps(run.status, sort_keys=True, default=str)

@@ -1,0 +1,114 @@
+"""Per-run RBAC: runner identity, rule sanitization, hijack refusal.
+
+(reference: internal/controller/runs/rbac.go test coverage model)
+"""
+
+import pytest
+
+from bobrapet_tpu.api.catalog import make_engram_template
+from bobrapet_tpu.api.engram import make_engram
+from bobrapet_tpu.api.story import make_story
+from bobrapet_tpu.controllers.rbac import sanitize_rules
+from bobrapet_tpu.core.object import new_resource
+from bobrapet_tpu.sdk import register_engram
+
+
+class TestSanitize:
+    def test_wildcards_rejected(self):
+        kept, rejected = sanitize_rules([
+            {"resources": ["*"], "verbs": ["get"]},
+            {"resources": ["configmaps"], "verbs": ["*"]},
+        ])
+        assert kept == []
+        assert len(rejected) == 2
+
+    def test_allowlist_enforced(self):
+        kept, rejected = sanitize_rules([
+            {"resources": ["configmaps"], "verbs": ["get", "list"]},
+            {"resources": ["nodes"], "verbs": ["get"]},          # cluster kind
+            {"resources": ["secrets"], "verbs": ["delete"]},      # verb outside
+        ])
+        assert kept == [{"resources": ["configmaps"], "verbs": ["get", "list"]}]
+        assert len(rejected) == 2
+
+    def test_empty_rule_rejected(self):
+        kept, rejected = sanitize_rules([{"resources": [], "verbs": ["get"]}])
+        assert not kept and rejected
+
+
+class TestRunRBAC:
+    def _setup(self, rt, rbac_rules=None):
+        ep = "w-impl"
+        rt.apply(make_engram_template(
+            "w-tpl", entrypoint=ep, image="w:1", supportedModes=["job"],
+            executionPolicy={"rbacRules": rbac_rules or []},
+        ))
+        rt.apply(make_engram("worker", "w-tpl"))
+
+        @register_engram(ep)
+        def impl(ctx):
+            return {"ok": True}
+
+        rt.apply(make_story("s", steps=[{"name": "a", "ref": {"name": "worker"}}]))
+
+    def test_run_gets_scoped_identity(self, rt):
+        self._setup(rt, rbac_rules=[
+            {"resources": ["configmaps"], "verbs": ["get"]},
+        ])
+        run = rt.run_story("s")
+        rt.pump()
+        r = rt.store.get("StoryRun", "default", run)
+        assert r.status["phase"] == "Succeeded"
+        sa_name = r.status["serviceAccount"]
+        assert sa_name == f"{run}-runner"
+        sa = rt.store.get("ServiceAccount", "default", sa_name)
+        assert sa.has_owner(r)
+        role = rt.store.get("Role", "default", sa_name)
+        assert role.spec["rules"] == [{"resources": ["configmaps"], "verbs": ["get"]}]
+        binding = rt.store.get("RoleBinding", "default", sa_name)
+        assert binding.spec["subjects"][0]["name"] == sa_name
+        # jobs ran under the run identity
+        job = rt.store.list("Job")[0]
+        assert job.spec["serviceAccountName"] == sa_name
+
+    def test_unsafe_template_rules_recorded_not_granted(self, rt):
+        self._setup(rt, rbac_rules=[
+            {"resources": ["*"], "verbs": ["get"]},
+            {"resources": ["secrets"], "verbs": ["get"]},
+        ])
+        run = rt.run_story("s")
+        rt.pump()
+        r = rt.store.get("StoryRun", "default", run)
+        role = rt.store.get("Role", "default", r.status["serviceAccount"])
+        assert role.spec["rules"] == [{"resources": ["secrets"], "verbs": ["get"]}]
+        assert len(r.status["rejectedRBACRules"]) == 1
+
+    def test_sa_hijack_refused(self, rt):
+        self._setup(rt)
+        # plant a foreign SA at the name the run will claim
+        run_name = "s-run-hijack"
+        rt.store.create(new_resource("ServiceAccount", f"{run_name}-runner",
+                                     "default", spec={"annotations": {"evil": "1"}}))
+        from bobrapet_tpu.api.runs import make_storyrun
+
+        rt.store.create(make_storyrun(run_name, "s", {}, "default"))
+        rt.pump()
+        r = rt.store.get("StoryRun", "default", run_name)
+        assert r.status["phase"] == "Failed"
+        assert "refusing to adopt" in r.status["error"]["message"]
+
+    def test_storage_annotations_follow_run(self, rt):
+        self._setup(rt)
+        rt.store.mutate("Story", "default", "s", lambda r: r.spec.__setitem__(
+            "policy", {"storage": {"s3": {
+                "bucket": "b",
+                "serviceAccountAnnotations": {
+                    "iam.gke.io/gcp-service-account": "runner@proj.iam",
+                },
+            }}},
+        ))
+        run = rt.run_story("s")
+        rt.pump()
+        r = rt.store.get("StoryRun", "default", run)
+        sa = rt.store.get("ServiceAccount", "default", r.status["serviceAccount"])
+        assert sa.spec["annotations"]["iam.gke.io/gcp-service-account"] == "runner@proj.iam"
