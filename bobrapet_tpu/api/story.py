@@ -163,6 +163,22 @@ class StorySpec(SpecBase):
     def all_steps(self) -> list[Step]:
         return [*self.steps, *self.compensations, *self.finally_]
 
+    def all_steps_deep(self) -> list[Step]:
+        """All steps including `parallel`-branch sub-steps, recursively —
+        the traversal RBAC/validation must use so branch engrams are not
+        missed (reference: parallel branches are full inline Step objects,
+        step_executor.go:741-747)."""
+        out: list[Step] = []
+        frontier = self.all_steps()
+        while frontier:
+            s = frontier.pop()
+            out.append(s)
+            if s.type is not None and s.with_:
+                frontier.extend(
+                    Step.from_dict(raw) for raw in s.with_.get("steps") or []
+                )
+        return out
+
 
 def parse_story(resource: Resource) -> StorySpec:
     return StorySpec.from_dict(resource.spec)

@@ -54,6 +54,7 @@ from ..templating.engine import (
 from ..utils.duration import parse_duration
 from .manager import Clock
 from .step_executor import (
+    LABEL_PRIORITY,
     LABEL_QUEUE,
     STOP_KEY,
     TIMERS_KEY,
@@ -64,6 +65,72 @@ from .step_executor import (
 _log = logging.getLogger(__name__)
 
 MAX_OUTPUT_BYTES = 1 << 20  # final output template cap (reference: 1MiB)
+
+#: stepState reasons marking a ready step parked behind a scheduling gate
+#: rather than launched (reference: markQueuedSteps dag.go:1999 — queued
+#: steps stay Pending; their startedAt is the queue-entry time that feeds
+#: priority aging via storyRunQueuedSince:1948)
+REASON_CONCURRENCY_QUEUED = "ConcurrencyQueued"
+REASON_SCHEDULING_QUEUED = "SchedulingQueued"
+REASON_PRIORITY_QUEUED = "PriorityQueued"
+QUEUED_REASONS = frozenset(
+    {REASON_CONCURRENCY_QUEUED, REASON_SCHEDULING_QUEUED, REASON_PRIORITY_QUEUED}
+)
+
+
+def _is_queued_state(raw: dict[str, Any]) -> bool:
+    return (
+        raw.get("phase") in (None, str(Phase.PENDING))
+        and raw.get("reason") in QUEUED_REASONS
+    )
+
+
+def effective_priority(
+    base: int, queued_since: Optional[float], aging_seconds: float, now: float
+) -> int:
+    """Priority grows one step per aging interval spent queued
+    (reference: effectivePriority dag.go:1948)."""
+    if queued_since is None or aging_seconds <= 0:
+        return base
+    elapsed = now - queued_since
+    if elapsed <= 0:
+        return base
+    return base + int(elapsed // aging_seconds)
+
+
+def storyrun_queued_since(run: Resource) -> Optional[float]:
+    """Earliest queue-entry time across this run's queued steps
+    (reference: storyRunQueuedSince dag.go:1962)."""
+    earliest: Optional[float] = None
+    for raw in (run.status.get("stepStates") or {}).values():
+        if not _is_queued_state(raw):
+            continue
+        t = raw.get("startedAt")
+        if t is not None and (earliest is None or t < earliest):
+            earliest = t
+    return earliest
+
+
+def storyrun_has_demand(run: Resource) -> bool:
+    """A run competes for queue capacity while it is live or has queued
+    steps (reference: storyRunHasDemand dag.go:1981). Running runs count
+    as demand deliberately, mirroring the reference: strict priority
+    ordering reserves freed capacity for the highest-priority live run's
+    next step, at the cost of briefly idling slots (bounded by aging).
+    A run parked Pending by a guard (story missing, reference denied —
+    recorded as status.reason) cannot launch anything and must not
+    starve its queue peers."""
+    phase = run.status.get("phase")
+    states = run.status.get("stepStates") or {}
+    if phase == str(Phase.RUNNING):
+        return True
+    if phase == str(Phase.PENDING) and not run.status.get("reason"):
+        return True  # freshly admitted, about to launch
+    # guard-parked (status.reason set): only live step activity counts
+    return any(
+        raw.get("phase") == str(Phase.RUNNING) or _is_queued_state(raw)
+        for raw in states.values()
+    )
 
 #: index names (registered by the runtime)
 INDEX_STEPRUN_STORYRUN = "storyRunRef"
@@ -381,7 +448,9 @@ class DAGEngine:
         progressed = False
         now = self.clock.now()
         for s in steps:
-            if s.name not in states:
+            # queued markers are parked, not launched — fail-fast reclaims
+            # them exactly like never-started steps
+            if s.name not in states or _is_queued_state(states[s.name]):
                 states[s.name] = StepState(
                     phase=Phase.SKIPPED,
                     reason="FailFast",
@@ -397,9 +466,14 @@ class DAGEngine:
         progressed = False
         queue = story.policy.queue if story.policy else None
         by_name = {s.name: s for s in steps}
+        # gate results computed lazily, once per pass, only when a step is
+        # launchable; the concurrency verdict is invalidated after each
+        # launch (a launch is the only in-pass event that changes counts)
+        priority_block: Optional[bool] = None
+        queued_verdict: Optional[tuple[Optional[str]]] = None
 
         for step in steps:
-            if step.name in states:
+            if step.name in states and not _is_queued_state(states[step.name]):
                 continue
             # scope is rebuilt per candidate: a step that completed earlier
             # in this same pass (condition/stop/instant primitives) must be
@@ -482,11 +556,36 @@ class DAGEngine:
                     progressed = True
                     continue
 
-            # concurrency gates (reference: enforceStoryConcurrency:1780,
-            # enforceSchedulingLimits:1801)
-            if not self._concurrency_allows(run, story, queue):
+            # scheduling gates (reference: enforceStoryConcurrency:1780,
+            # enforceSchedulingLimits:1801, enforcePriorityOrdering:1910).
+            # A gated step is parked Pending with a queued reason; its
+            # startedAt is the queue-entry time that drives priority aging.
+            if priority_block is None:
+                priority_block = self._priority_blocked(run, story, queue)
+            if priority_block:
+                queued_reason: Optional[str] = REASON_PRIORITY_QUEUED
+            else:
+                if queued_verdict is None:
+                    queued_verdict = (
+                        self._concurrency_queued_reason(run, story, queue),
+                    )
+                queued_reason = queued_verdict[0]
+            if queued_reason is not None:
+                prior = states.get(step.name)
+                queued_at = (
+                    prior.get("startedAt")
+                    if prior and _is_queued_state(prior)
+                    else None
+                )
+                if queued_at is None:
+                    queued_at = self.clock.now()
+                states[step.name] = StepState(
+                    phase=Phase.PENDING, reason=queued_reason,
+                    message=f"queued behind scheduling limits ({queued_reason})",
+                    started_at=queued_at,
+                ).to_dict()
                 run.status["queueWaiting"] = True
-                break
+                continue
             run.status.pop("queueWaiting", None)
 
             try:
@@ -503,6 +602,7 @@ class DAGEngine:
             run.status.pop("placementWaiting", None)
             states[step.name] = state.to_dict()
             self._launched_this_pass += 1
+            queued_verdict = None  # counts changed; re-check the gate
             progressed = True
             if run.status.get(STOP_KEY):
                 break  # a stop primitive halts further launches immediately
@@ -524,28 +624,83 @@ class DAGEngine:
         }
         return self.evaluator.evaluate_condition(expr, hydrated)
 
-    def _concurrency_allows(self, run: Resource, story: StorySpec, queue: Optional[str]) -> bool:
+    def _concurrency_queued_reason(
+        self, run: Resource, story: StorySpec, queue: Optional[str]
+    ) -> Optional[str]:
+        """Story / queue / global concurrency gates; returns the queued
+        reason when the step must wait (reference:
+        enforceStoryConcurrency:1780 + enforceSchedulingLimits:1801).
+        Queued markers are parked, not running — they never count against
+        the limits that parked them."""
         states = run.status["stepStates"]
         running_here = sum(
             1
             for raw in states.values()
-            if not StepState.from_dict(raw).is_terminal
+            if not StepState.from_dict(raw).is_terminal and not _is_queued_state(raw)
         )
         limit = story.policy.concurrency if story.policy else None
         if limit is not None and running_here >= limit:
-            return False
+            return REASON_CONCURRENCY_QUEUED
         cfg = self.config_manager.config.scheduling
         if queue:
             q = cfg.queue(queue)
             if q.max_concurrent:
                 active = self._active_stepruns_in_queue(queue)
                 if active >= q.max_concurrent:
-                    return False
+                    return REASON_SCHEDULING_QUEUED
         if cfg.global_max_concurrent_steps:
             active = self._active_stepruns_in_queue(None)
             if active >= cfg.global_max_concurrent_steps:
-                return False
-        return True
+                return REASON_SCHEDULING_QUEUED
+        return None
+
+    def _priority_blocked(
+        self, run: Resource, story: StorySpec, queue: Optional[str]
+    ) -> bool:
+        """Defer this run's launches while another run in the same queue
+        has strictly higher effective (aged) priority and live demand
+        (reference: enforcePriorityOrdering dag.go:1910)."""
+        if not queue:
+            return False
+        qcfg = self.config_manager.config.scheduling.queue(queue)
+        aging = qcfg.priority_aging_seconds
+        now = self.clock.now()
+        base = (
+            story.policy.priority
+            if story.policy and story.policy.priority is not None
+            else 0
+        )
+        my_queued_since = storyrun_queued_since(run)
+        mine = effective_priority(base, my_queued_since, aging, now)
+        waiting = 0  # runs actually parked (queued steps), for the gauge
+        blocked = False
+        for other in self.store.list(STORY_RUN_KIND, labels={LABEL_QUEUE: queue}):
+            if (
+                other.meta.namespace == run.meta.namespace
+                and other.meta.name == run.meta.name
+            ):
+                continue
+            phase = other.status.get("phase")
+            if phase and Phase(phase).is_terminal:
+                continue
+            other_queued_since = storyrun_queued_since(other)
+            if other_queued_since is not None:
+                waiting += 1
+            if not storyrun_has_demand(other):
+                continue
+            try:
+                other_base = int(other.meta.labels.get(LABEL_PRIORITY, "0"))
+            except ValueError:
+                other_base = 0
+            other_eff = effective_priority(other_base, other_queued_since, aging, now)
+            if other_eff > mine:
+                blocked = True
+        if blocked or my_queued_since is not None:
+            waiting += 1  # this run is (or is about to be) parked
+        metrics.storyrun_queue_depth.set(waiting, queue)
+        if blocked and my_queued_since is not None:
+            metrics.storyrun_queue_age.observe(now - my_queued_since, queue)
+        return blocked
 
     #: non-terminal phase-index buckets (the phase index is keyed by the
     #: literal status value; "" covers not-yet-claimed StepRuns)

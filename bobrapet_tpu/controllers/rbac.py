@@ -95,7 +95,7 @@ class RunRBACManager:
             SERVICE_ACCOUNT_KIND, sa_name, ns,
             spec={"annotations": annotations} if annotations else {},
             owners=[run.owner_ref()],
-        ), validate_owner=True)
+        ))
         self._ensure_owned(run, new_resource(
             ROLE_KIND, sa_name, ns,
             spec={"rules": kept},
@@ -123,7 +123,7 @@ class RunRBACManager:
         rules: list[dict[str, Any]] = []
         if story_spec.policy and story_spec.policy.execution:
             rules.extend(story_spec.policy.execution.rbac_rules or [])
-        for step in story_spec.all_steps():
+        for step in story_spec.all_steps_deep():
             if step.ref is None:
                 continue
             engram = self.store.try_get(ENGRAM_KIND, ns, step.ref.name)
@@ -158,8 +158,7 @@ class RunRBACManager:
             return {}
         return dict(policy.s3.service_account_annotations or {})
 
-    def _ensure_owned(self, run: Resource, desired: Resource,
-                      validate_owner: bool = False) -> None:
+    def _ensure_owned(self, run: Resource, desired: Resource) -> None:
         """Create-or-validate: an existing object not owned by this run is
         an identity-hijack attempt and is NOT adopted
         (reference: ownership validation against SA hijack, rbac.go)."""

@@ -47,6 +47,7 @@ STOP_KEY = "stopRequest"
 LABEL_STORY_RUN = "bobrapet.io/story-run"
 LABEL_STEP = "bobrapet.io/step"
 LABEL_QUEUE = "bobrapet.io/queue"
+LABEL_PRIORITY = "bobrapet.io/priority"
 LABEL_PARENT_STEP = "bobrapet.io/parent-step"
 DEPTH_LABEL = "bobrapet.io/substory-depth"
 

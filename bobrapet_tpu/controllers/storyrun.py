@@ -30,6 +30,8 @@ from ..storage.manager import StorageManager
 from ..utils.duration import parse_duration
 from .dag import INDEX_STEPRUN_STORYRUN, DAGEngine
 from .manager import Clock
+from .rbac import RBACOwnershipError, RunRBACManager
+from .step_executor import LABEL_PRIORITY, LABEL_QUEUE
 from .steprun import CANCEL_ANNOTATION
 
 _log = logging.getLogger(__name__)
@@ -54,8 +56,6 @@ class StoryRunController:
         self.storage = storage
         self.recorder = recorder
         self.clock = clock or Clock()
-        from .rbac import RunRBACManager
-
         self.rbac = RunRBACManager(store)
 
     # ------------------------------------------------------------------
@@ -125,6 +125,34 @@ class StoryRunController:
             return None
         story = parse_story(story_res)
 
+        # scheduling labels: queue + priority stamped on the run so the
+        # DAG's priority ordering can list queue peers by label
+        # (reference: storyrun_controller.go scheduling labels;
+        # resolveSchedulingDecision + priorityFromLabels dag.go:1910-1946)
+        sched_queue = story.policy.queue if story.policy else None
+        sched_priority = (
+            story.policy.priority
+            if story.policy and story.policy.priority is not None
+            else 0
+        )
+        desired_labels = (
+            {LABEL_QUEUE: sched_queue, LABEL_PRIORITY: str(sched_priority)}
+            if sched_queue
+            else {}
+        )
+        current_labels = {
+            k: v
+            for k, v in run.meta.labels.items()
+            if k in (LABEL_QUEUE, LABEL_PRIORITY)
+        }
+        if current_labels != desired_labels:
+            def stamp(r: Resource) -> None:
+                r.meta.labels.pop(LABEL_QUEUE, None)
+                r.meta.labels.pop(LABEL_PRIORITY, None)
+                r.meta.labels.update(desired_labels)
+
+            run = self.store.mutate(STORY_RUN_KIND, namespace, name, stamp)
+
         # version pinning (reference: storytrigger_controller.go:101-109)
         pinned = story_ref.get("version")
         if pinned and story.version and pinned != story.version:
@@ -165,21 +193,26 @@ class StoryRunController:
             run = self.store.mutate(STORY_RUN_KIND, namespace, name, swap_inputs)
 
         # --- per-run RBAC identity (reference: rbac.go Reconcile:95) ---
-        if not run.status.get("serviceAccount"):
-            from .rbac import RBACOwnershipError
-
-            try:
-                rbac_summary = self.rbac.ensure(run, story)
-            except RBACOwnershipError as e:
-                return self._fail(
-                    run,
-                    StructuredError(type=ErrorType.VALIDATION, message=str(e)),
-                    reason=conditions.Reason.INVALID_CONFIGURATION,
-                )
+        # re-ensured on every pass: a deleted/drifted SA, Role, or
+        # RoleBinding is repaired create-or-update style mid-run
+        try:
+            rbac_summary = self.rbac.ensure(run, story)
+        except RBACOwnershipError as e:
+            return self._fail(
+                run,
+                StructuredError(type=ErrorType.VALIDATION, message=str(e)),
+                reason=conditions.Reason.INVALID_CONFIGURATION,
+            )
+        if (
+            run.status.get("serviceAccount") != rbac_summary["serviceAccount"]
+            or run.status.get("rejectedRBACRules", []) != rbac_summary["rejectedRules"]
+        ):
             def record_sa(status: dict[str, Any]) -> None:
                 status["serviceAccount"] = rbac_summary["serviceAccount"]
                 if rbac_summary["rejectedRules"]:
                     status["rejectedRBACRules"] = rbac_summary["rejectedRules"]
+                else:
+                    status.pop("rejectedRBACRules", None)
 
             run = self.store.patch_status(STORY_RUN_KIND, namespace, name, record_sa)
 

@@ -112,3 +112,65 @@ class TestRunRBAC:
         r = rt.store.get("StoryRun", "default", run)
         sa = rt.store.get("ServiceAccount", "default", r.status["serviceAccount"])
         assert sa.spec["annotations"]["iam.gke.io/gcp-service-account"] == "runner@proj.iam"
+
+
+class TestBranchRules:
+    def test_parallel_branch_engram_rules_granted(self, rt):
+        """Engrams referenced only inside `parallel` branches contribute
+        their template rbacRules to the run Role (regression: all_steps()
+        traversal missed branch sub-steps)."""
+        rt.apply(make_engram_template(
+            "branch-tpl", entrypoint="branch-impl", image="b:1",
+            executionPolicy={"rbacRules": [
+                {"resources": ["configmaps"], "verbs": ["get"]},
+            ]},
+        ))
+        rt.apply(make_engram("brancher", "branch-tpl"))
+
+        @register_engram("branch-impl")
+        def impl(ctx):
+            return {"ok": True}
+
+        rt.apply(make_story("fan", steps=[
+            {"name": "fanout", "type": "parallel", "with": {"steps": [
+                {"name": "b1", "ref": {"name": "brancher"}},
+                {"name": "b2", "ref": {"name": "brancher"}},
+            ]}},
+        ]))
+        run = rt.run_story("fan")
+        rt.pump()
+        r = rt.store.get("StoryRun", "default", run)
+        assert r.status["phase"] == "Succeeded"
+        role = rt.store.get("Role", "default", r.status["serviceAccount"])
+        assert {"resources": ["configmaps"], "verbs": ["get"]} in role.spec["rules"]
+
+    def test_rejected_rules_cleared_after_fix(self, rt):
+        """status.rejectedRBACRules reflects the CURRENT sanitize result —
+        fixing the template clears the stale rejection on the next pass."""
+        rt.apply(make_engram_template(
+            "w-tpl", entrypoint="w-impl", image="w:1",
+            executionPolicy={"rbacRules": [
+                {"resources": ["*"], "verbs": ["get"]},
+            ]},
+        ))
+        rt.apply(make_engram("worker", "w-tpl"))
+
+        @register_engram("w-impl")
+        def impl(ctx):
+            return {"ok": True}
+
+        rt.apply(make_story("s2", steps=[{"name": "a", "ref": {"name": "worker"}}]))
+        run = rt.run_story("s2")
+        rt.storyrun_controller.reconcile("default", run)
+        r = rt.store.get("StoryRun", "default", run)
+        assert len(r.status["rejectedRBACRules"]) == 1
+
+        rt.store.mutate(
+            "EngramTemplate", "_cluster", "w-tpl",
+            lambda t: t.spec["executionPolicy"].__setitem__(
+                "rbacRules", [{"resources": ["configmaps"], "verbs": ["get"]}]
+            ),
+        )
+        rt.storyrun_controller.reconcile("default", run)
+        r = rt.store.get("StoryRun", "default", run)
+        assert "rejectedRBACRules" not in r.status

@@ -1,0 +1,256 @@
+"""Queue scheduling: priority ordering with aging + queued-step parking.
+
+Mirrors the reference's scheduling semantics (reference:
+internal/controller/runs/dag.go — enforcePriorityOrdering:1910,
+effectivePriority:1948, storyRunQueuedSince:1962,
+storyRunHasDemand:1981, markQueuedSteps:1999): ready steps blocked by
+a scheduling gate are parked Pending with a queued reason; their
+startedAt is the queue-entry time that drives priority aging; a run is
+deferred while any same-queue peer with live demand has strictly
+higher effective priority.
+"""
+
+import pytest
+
+from bobrapet_tpu.api.catalog import make_engram_template
+from bobrapet_tpu.api.engram import make_engram
+from bobrapet_tpu.api.story import make_story
+from bobrapet_tpu.config.operator import QueueConfig
+from bobrapet_tpu.controllers.dag import (
+    REASON_PRIORITY_QUEUED,
+    REASON_SCHEDULING_QUEUED,
+    effective_priority,
+    storyrun_has_demand,
+    storyrun_queued_since,
+)
+from bobrapet_tpu.core.object import Resource, new_resource
+from bobrapet_tpu.sdk import register_engram
+
+
+class TestEffectivePriority:
+    def test_base_without_queue_time(self):
+        assert effective_priority(3, None, 300.0, 1000.0) == 3
+
+    def test_aging_adds_one_step_per_interval(self):
+        # queued 650s with a 300s aging interval -> +2
+        assert effective_priority(3, 1000.0, 300.0, 1650.0) == 5
+
+    def test_aging_disabled(self):
+        assert effective_priority(3, 1000.0, 0.0, 99999.0) == 3
+
+    def test_negative_elapsed_ignored(self):
+        assert effective_priority(3, 2000.0, 300.0, 1000.0) == 3
+
+
+class TestDemandAndQueuedSince:
+    def _run_with_states(self, states, phase="Succeeded") -> Resource:
+        r = new_resource("StoryRun", "r", "default", spec={})
+        r.status = {"phase": phase, "stepStates": states}
+        return r
+
+    def test_running_run_has_demand(self):
+        assert storyrun_has_demand(self._run_with_states({}, phase="Running"))
+
+    def test_terminal_run_without_queued_steps_has_no_demand(self):
+        assert not storyrun_has_demand(self._run_with_states({}))
+
+    def test_queued_step_is_demand(self):
+        r = self._run_with_states(
+            {"a": {"phase": "Pending", "reason": REASON_SCHEDULING_QUEUED,
+                   "startedAt": 50.0}}
+        )
+        assert storyrun_has_demand(r)
+        assert storyrun_queued_since(r) == 50.0
+
+    def test_queued_since_earliest_wins(self):
+        r = self._run_with_states({
+            "a": {"phase": "Pending", "reason": REASON_SCHEDULING_QUEUED,
+                  "startedAt": 70.0},
+            "b": {"phase": "Pending", "reason": REASON_PRIORITY_QUEUED,
+                  "startedAt": 30.0},
+            "c": {"phase": "Running", "startedAt": 10.0},  # running, not queued
+        })
+        assert storyrun_queued_since(r) == 30.0
+
+    def test_plain_pending_is_not_queued(self):
+        r = self._run_with_states(
+            {"a": {"phase": "Pending", "reason": "Launched", "startedAt": 5.0}}
+        )
+        assert storyrun_queued_since(r) is None
+
+    def test_guard_parked_pending_run_has_no_demand(self):
+        """A run parked Pending by a guard (story deleted, reference
+        denied) with no step states can never launch — it must not park
+        queue peers behind its priority."""
+        r = new_resource("StoryRun", "r", "default", spec={})
+        r.status = {"phase": "Pending", "reason": "StoryNotFound",
+                    "stepStates": {}}
+        assert not storyrun_has_demand(r)
+        # but a freshly-admitted Pending run (no guard reason) does compete
+        r.status = {"phase": "Pending", "stepStates": {}}
+        assert storyrun_has_demand(r)
+
+
+def _setup_story(rt, story_name, priority, queue="tpu"):
+    rt.apply(make_story(story_name, steps=[
+        {"name": "work", "ref": {"name": "worker"}},
+    ], policy={"queue": queue, "priority": priority}))
+
+
+@pytest.fixture
+def contended_rt(rt):
+    """Runtime with a 1-slot queue and a registered worker engram."""
+    rt.config_manager.config.scheduling.queues["tpu"] = QueueConfig(
+        name="tpu", max_concurrent=1, priority_aging_seconds=300.0
+    )
+    rt.apply(make_engram_template("worker-tpl", entrypoint="worker-impl"))
+    rt.apply(make_engram("worker", "worker-tpl"))
+
+    @register_engram("worker-impl")
+    def impl(ctx):
+        return {"ok": True}
+
+    return rt
+
+
+def _stepruns_of(rt, run_name):
+    return [
+        sr for sr in rt.store.list("StepRun")
+        if sr.meta.labels.get("bobrapet.io/story-run") == run_name
+    ]
+
+
+class TestQueueScheduling:
+    def test_scheduling_labels_stamped(self, contended_rt):
+        rt = contended_rt
+        _setup_story(rt, "lbl", priority=7)
+        run = rt.run_story("lbl")
+        rt.storyrun_controller.reconcile("default", run)
+        r = rt.store.get("StoryRun", "default", run)
+        assert r.meta.labels["bobrapet.io/queue"] == "tpu"
+        assert r.meta.labels["bobrapet.io/priority"] == "7"
+
+    def test_queue_limit_parks_step_with_queued_reason(self, contended_rt):
+        rt = contended_rt
+        _setup_story(rt, "first", priority=0)
+        _setup_story(rt, "second", priority=0)
+        r1 = rt.run_story("first")
+        rt.storyrun_controller.reconcile("default", r1)
+        assert len(_stepruns_of(rt, r1)) == 1  # occupies the only slot
+
+        r2 = rt.run_story("second")
+        rt.storyrun_controller.reconcile("default", r2)
+        assert _stepruns_of(rt, r2) == []
+        state = rt.store.get("StoryRun", "default", r2).status["stepStates"]["work"]
+        assert state["phase"] == "Pending"
+        assert state["reason"] == REASON_SCHEDULING_QUEUED
+        assert state["startedAt"] == rt.clock.now()
+
+    def test_higher_priority_peer_defers_launch(self, contended_rt):
+        rt = contended_rt
+        _setup_story(rt, "low", priority=1)
+        _setup_story(rt, "high", priority=5)
+        # low occupies the slot; high queues behind the limit
+        r_low = rt.run_story("low")
+        rt.storyrun_controller.reconcile("default", r_low)
+        r_high = rt.run_story("high")
+        rt.storyrun_controller.reconcile("default", r_high)
+        high_state = rt.store.get("StoryRun", "default", r_high).status["stepStates"]["work"]
+        assert high_state["reason"] == REASON_SCHEDULING_QUEUED
+
+        # a second low-priority run must yield to high's demand
+        r_low2 = rt.run_story("low")
+        rt.storyrun_controller.reconcile("default", r_low2)
+        low2_state = rt.store.get("StoryRun", "default", r_low2).status["stepStates"]["work"]
+        assert low2_state["reason"] == REASON_PRIORITY_QUEUED
+
+        # finish low's step -> slot frees; high launches, low2 still waits
+        sr = _stepruns_of(rt, r_low)[0]
+        for _ in range(5):
+            rt.steprun_controller.reconcile("default", sr.meta.name)
+            phase = rt.store.get("StepRun", "default", sr.meta.name).status.get("phase")
+            if phase == "Succeeded":
+                break
+        assert phase == "Succeeded"
+        rt.storyrun_controller.reconcile("default", r_low2)
+        assert _stepruns_of(rt, r_low2) == []
+        rt.storyrun_controller.reconcile("default", r_high)
+        assert len(_stepruns_of(rt, r_high)) == 1
+
+        # drain everything; all runs complete
+        rt.pump()
+        assert rt.run_phase(r_low) == "Succeeded"
+        assert rt.run_phase(r_high) == "Succeeded"
+        assert rt.run_phase(r_low2) == "Succeeded"
+
+    def test_aging_lets_starved_run_overtake(self, contended_rt):
+        rt = contended_rt
+        _setup_story(rt, "low", priority=0)
+        _setup_story(rt, "high", priority=2)
+        r_hold = rt.run_story("high")  # occupies the slot
+        rt.storyrun_controller.reconcile("default", r_hold)
+
+        r_low = rt.run_story("low")
+        rt.storyrun_controller.reconcile("default", r_low)
+        # the running high-priority run outranks low, so the priority
+        # gate (checked before the slot gate) parks it
+        assert (
+            rt.store.get("StoryRun", "default", r_low)
+            .status["stepStates"]["work"]["reason"]
+            == REASON_PRIORITY_QUEUED
+        )
+
+        # low has been queued for 3 aging intervals: effective 0+3 > 2,
+        # so a newly arriving high-priority run is the one deferred
+        rt.clock.advance(950.0)
+        r_high2 = rt.run_story("high")
+        rt.storyrun_controller.reconcile("default", r_high2)
+        state = rt.store.get("StoryRun", "default", r_high2).status["stepStates"]["work"]
+        assert state["reason"] == REASON_PRIORITY_QUEUED
+
+        rt.pump()
+        for r in (r_hold, r_low, r_high2):
+            assert rt.run_phase(r) == "Succeeded"
+
+    def test_failfast_reclaims_queued_steps(self, contended_rt):
+        """A step parked behind a scheduling gate must be skipped by
+        fail-fast like a never-started step — it must not launch once the
+        failure frees capacity (regression: queued markers escaping
+        _apply_skips)."""
+        rt = contended_rt
+        ran = []
+
+        @register_engram("bad-impl")
+        def bad(ctx):
+            raise RuntimeError("boom")
+
+        @register_engram("spy-impl")
+        def spy(ctx):
+            ran.append(ctx.step)
+            return {}
+
+        rt.apply(make_engram_template("bad-tpl", entrypoint="bad-impl"))
+        rt.apply(make_engram("bad", "bad-tpl"))
+        rt.apply(make_engram_template("spy-tpl", entrypoint="spy-impl"))
+        rt.apply(make_engram("spy", "spy-tpl"))
+        rt.apply(make_story("ff", steps=[
+            {"name": "a", "ref": {"name": "bad"},
+             "execution": {"retry": {"maxRetries": 0}}},
+            {"name": "b", "ref": {"name": "spy"}},
+        ], policy={"queue": "tpu", "priority": 0, "concurrency": 1}))
+        run = rt.run_story("ff")
+        rt.pump()
+        assert rt.run_phase(run) == "Failed"
+        states = rt.store.get("StoryRun", "default", run).status["stepStates"]
+        assert states["b"]["phase"] == "Skipped"
+        assert states["b"]["reason"] == "FailFast"
+        assert ran == []
+
+    def test_no_queue_no_priority_gate(self, contended_rt):
+        rt = contended_rt
+        rt.apply(make_story("plain", steps=[
+            {"name": "work", "ref": {"name": "worker"}},
+        ]))
+        run = rt.run_story("plain")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
