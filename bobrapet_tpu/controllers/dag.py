@@ -53,6 +53,12 @@ from ..templating.engine import (
 )
 from ..utils.duration import parse_duration
 from .manager import Clock
+from .materialize import (
+    DEFAULT_MATERIALIZE_ENGRAM,
+    MaterializeFailed,
+    MaterializeSpoofed,
+    resolve_materialize,
+)
 from .step_executor import (
     LABEL_PRIORITY,
     LABEL_QUEUE,
@@ -277,16 +283,33 @@ class DAGEngine:
             ok = self.evaluator.evaluate_condition(t.get("until", ""), scope)
         except OffloadedDataUsage:
             try:
-                ok = self._condition_with_policy(run, t.get("until", ""), scope)
-            except OffloadedDataUsage as e:
-                # policy=fail: the wait step fails terminally instead of the
-                # reconcile crashing into endless backoff
+                ok = self._condition_with_policy(
+                    run, step_name, t.get("until", ""), scope
+                )
+            except (OffloadedDataUsage, MaterializeFailed, MaterializeSpoofed) as e:
+                # policy=fail (or broken delegate): the wait step fails
+                # terminally instead of the reconcile crashing into
+                # endless backoff
                 states[step_name] = _finish(
                     state, Phase.FAILED, now, reason="OffloadedDataPolicy"
                 ).to_dict()
                 states[step_name]["message"] = str(e)
                 run.status[TIMERS_KEY].pop(step_name, None)
                 return True
+            if ok is None:
+                return False  # materialize delegate pending; poll again
+            if not ok:
+                # a wait polls a CHANGING condition: consume the completed
+                # delegate so the next poll re-materializes fresh scope
+                from .materialize import materialize_name
+
+                try:
+                    self.store.delete(
+                        STEP_RUN_KIND, run.meta.namespace,
+                        materialize_name(run.meta.name, step_name),
+                    )
+                except Exception:  # noqa: BLE001 - already gone is fine
+                    pass
         except TemplateError:
             ok = False
         if ok:
@@ -471,6 +494,11 @@ class DAGEngine:
         # launch (a launch is the only in-pass event that changes counts)
         priority_block: Optional[bool] = None
         queued_verdict: Optional[tuple[Optional[str]]] = None
+        # recomputed each pass: set again below iff some step is still
+        # waiting on a materialize delegate (a per-pass aggregate, not
+        # per-step state — clearing here avoids both clobbering between
+        # steps and leaking the 1s requeue after a delegate failure)
+        run.status.pop("materializeWaiting", None)
 
         for step in steps:
             if step.name in states and not _is_queued_state(states[step.name]):
@@ -533,13 +561,25 @@ class DAGEngine:
                     ok = self.evaluator.evaluate_condition(step.if_, scope)
                 except OffloadedDataUsage:
                     try:
-                        ok = self._condition_with_policy(run, step.if_, scope)
-                    except OffloadedDataUsage as e:
+                        ok = self._condition_with_policy(
+                            run, step.name, step.if_, scope
+                        )
+                    except (
+                        OffloadedDataUsage,
+                        MaterializeFailed,
+                        MaterializeSpoofed,
+                    ) as e:
                         states[step.name] = StepState(
                             phase=Phase.FAILED, reason="OffloadedDataPolicy",
                             message=str(e), started_at=now, finished_at=now,
                         ).to_dict()
                         progressed = True
+                        continue
+                    if ok is None:
+                        # materialize delegate still running: the step is
+                        # not ready yet (reference: resolveMaterialize
+                        # blocks readiness, materialize.go:326)
+                        run.status["materializeWaiting"] = True
                         continue
                 except (TemplateError, EvaluationBlocked) as e:
                     states[step.name] = StepState(
@@ -608,15 +648,25 @@ class DAGEngine:
                 break  # a stop primitive halts further launches immediately
         return progressed
 
-    def _condition_with_policy(self, run: Resource, expr: str, scope) -> bool:
+    def _condition_with_policy(
+        self, run: Resource, step_name: str, expr: str, scope
+    ) -> Optional[bool]:
         """Offloaded-data policy for conditions
-        (reference: templating_policy.go fail/inject/controller;
-        materialize subsystem materialize.go — controller mode hydrates
-        in-controller here, with the dedicated materialize-engram path
-        reserved for remote deployments)."""
+        (reference: templating_policy.go fail/inject/controller +
+        materialize.go). ``fail`` raises; ``inject`` hydrates in-process
+        and re-evaluates; ``controller`` delegates to a dedicated
+        materialize StepRun and returns None until it completes."""
         policy = self.config_manager.config.templating.offloaded_data_policy
         if policy is OffloadedDataPolicy.FAIL:
             raise OffloadedDataUsage("offloaded data in condition under policy=fail")
+        if policy is OffloadedDataPolicy.CONTROLLER:
+            engram = (
+                self.config_manager.config.templating.materialize_engram
+                or DEFAULT_MATERIALIZE_ENGRAM
+            )
+            return resolve_materialize(
+                self.store, run, step_name, expr, scope, engram, self.clock.now()
+            )
         prefix = f"runs/{run.meta.namespace}/{run.meta.name}"
         hydrated = {
             k: self.storage.hydrate(v, [prefix]) if k in ("inputs", "steps") else v
@@ -874,7 +924,11 @@ class DAGEngine:
                 due.append(min(t.get("nextPoll", now), t.get("deadline", now)))
             elif kind == "gate":
                 due.append(min(now + t.get("pollInterval", 10.0), t.get("deadline", now)))
-        if run.status.get("placementWaiting") or run.status.get("queueWaiting"):
+        if (
+            run.status.get("placementWaiting")
+            or run.status.get("queueWaiting")
+            or run.status.get("materializeWaiting")
+        ):
             due.append(now + 1.0)
         if not due:
             return None

@@ -1,0 +1,160 @@
+"""Materialize subsystem: controller-policy offloaded-data resolution.
+
+(reference: internal/controller/runs/materialize.go:45-326 —
+ensureMaterializeStepRun:142, resolveMaterialize:326;
+offloaded_refs.go:23-207 — detecting storage refs in expressions;
+templating_policy.go:12-43 — the fail / inject / controller policy)
+
+When a step's ``if`` condition references *offloaded* step output under
+``templating.offloaded-data-policy=controller``, the controller must not
+hydrate multi-GB payloads in-process. It instead delegates to a
+dedicated **materialize StepRun**: a managed engram whose input carries
+the raw expression plus the unhydrated scope (storage refs intact). The
+engram's SDK context hydrates the scope in-pod — on the TPU slice, next
+to the data and the slice-local SSD cache — evaluates the expression,
+and reports ``{"result": <value>}``. The DAG blocks the referencing
+step's readiness until the materialize StepRun reaches a terminal phase.
+
+Identity is validated on adoption: an existing StepRun at the
+deterministic materialize name that is not owned by this StoryRun is a
+spoof attempt and aborts resolution (reference: identity-validated,
+materialize.go:142).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from ..api.catalog import CLUSTER_NAMESPACE, ENGRAM_TEMPLATE_KIND
+from ..api.engram import KIND as ENGRAM_KIND
+from ..api.enums import Phase
+from ..api.runs import STEP_RUN_KIND
+from ..core.object import Resource, new_resource
+from ..core.store import AlreadyExists, ResourceStore
+from ..utils.naming import compose_unique
+from .step_executor import LABEL_PARENT_STEP, LABEL_STORY_RUN
+
+_log = logging.getLogger(__name__)
+
+#: default managed engram used for controller-policy materialization;
+#: overridable via operator config ``templating.materialize-engram``
+#: (reference: TemplateMaterializeEngram, controller_config.go:142-144)
+DEFAULT_MATERIALIZE_ENGRAM = "bobrapet-materialize"
+MATERIALIZE_TEMPLATE = "bobrapet-materialize-tpl"
+#: SDK entrypoint name the builtin template binds to
+#: (implemented in bobrapet_tpu/sdk/materialize.py)
+MATERIALIZE_ENTRYPOINT = "bobrapet.materialize"
+
+#: marks a StepRun as a materialize delegate: the StepRun controller
+#: passes its input through verbatim (no template eval, no controller
+#: hydration) so hydration happens in-pod
+MATERIALIZE_ANNOTATION = "runs.bobrapet.io/materialize"
+
+
+class MaterializeFailed(Exception):
+    """The materialize StepRun reached a failure phase."""
+
+
+class MaterializeSpoofed(Exception):
+    """A foreign object occupies the materialize StepRun's name."""
+
+
+def materialize_name(run_name: str, step_name: str) -> str:
+    """Deterministic, collision-free delegate name — identity-bearing
+    (an ownership mismatch at this name is treated as spoofing), so it
+    must hash the part tuple like steprun_name does."""
+    return compose_unique(run_name, step_name, "mat")
+
+
+def ensure_builtin_engram(store: ResourceStore, namespace: str) -> None:
+    """Provision the builtin materialize EngramTemplate + Engram on
+    first use (the reference expects the operator deployment to install
+    its managed materialize engram; the builtin plays that role when the
+    configured name is the default)."""
+    try:
+        store.create(new_resource(
+            ENGRAM_TEMPLATE_KIND, MATERIALIZE_TEMPLATE, CLUSTER_NAMESPACE,
+            spec={
+                "entrypoint": MATERIALIZE_ENTRYPOINT,
+                "image": "bobrapet/materialize:builtin",
+                "supportedModes": ["job"],
+                "description": "managed offloaded-data materializer",
+            },
+        ))
+    except AlreadyExists:
+        pass
+    try:
+        store.create(new_resource(
+            ENGRAM_KIND, DEFAULT_MATERIALIZE_ENGRAM, namespace,
+            spec={"templateRef": {"name": MATERIALIZE_TEMPLATE}},
+        ))
+    except AlreadyExists:
+        pass
+
+
+def resolve_materialize(
+    store: ResourceStore,
+    run: Resource,
+    step_name: str,
+    expression: str,
+    scope: dict[str, Any],
+    engram_name: str,
+    now: float,
+) -> Optional[bool]:
+    """Create-or-poll the materialize StepRun for one step's condition.
+
+    Returns None while the delegate is still running (the step is not
+    ready yet), the evaluated boolean once it succeeded. Raises
+    MaterializeFailed / MaterializeSpoofed on terminal failure
+    (reference: resolveMaterialize materialize.go:326 — blocks readiness
+    until the delegate completes)."""
+    ns = run.meta.namespace
+    name = materialize_name(run.meta.name, step_name)
+    existing = store.try_get(STEP_RUN_KIND, ns, name)
+    if existing is None:
+        if engram_name == DEFAULT_MATERIALIZE_ENGRAM and (
+            store.try_get(ENGRAM_KIND, ns, engram_name) is None
+        ):
+            ensure_builtin_engram(store, ns)
+        sr = new_resource(
+            STEP_RUN_KIND, name, ns,
+            spec={
+                "storyRunRef": {"name": run.meta.name},
+                "stepId": f"{step_name}#materialize",
+                "engramRef": {"name": engram_name},
+                "input": {"expression": expression, "scope": scope},
+            },
+            labels={
+                LABEL_STORY_RUN: run.meta.name,
+                # parent-step keyed off the synthetic id so neither the
+                # state sync nor a parallel parent's branch roll-up
+                # mistakes the delegate for a workflow step
+                LABEL_PARENT_STEP: f"{step_name}#materialize",
+            },
+            annotations={MATERIALIZE_ANNOTATION: "true"},
+            owners=[run.owner_ref()],
+        )
+        try:
+            store.create(sr)
+        except AlreadyExists:
+            return None  # concurrent creator wins; poll next pass
+        _log.debug("materialize StepRun %s created for step %s", name, step_name)
+        return None
+
+    if not existing.has_owner(run):
+        raise MaterializeSpoofed(
+            f"StepRun {name!r} exists but is not owned by StoryRun "
+            f"{run.meta.name!r} — refusing to trust its result"
+        )
+    phase_raw = existing.status.get("phase")
+    phase = Phase(phase_raw) if phase_raw else Phase.PENDING
+    if phase is Phase.SUCCEEDED:
+        output = existing.status.get("output") or {}
+        return bool(output.get("result"))
+    if phase.is_terminal:  # Failed / Canceled / Skipped
+        err = (existing.status.get("error") or {}).get("message", phase_raw)
+        raise MaterializeFailed(
+            f"materialize delegate for step {step_name!r} ended {phase_raw}: {err}"
+        )
+    return None

@@ -496,6 +496,13 @@ class StepRunController:
     # ------------------------------------------------------------------
     def _resolve_inputs(self, sr, spec, template_spec, storyrun, engram_spec):
         """(reference: resolveRunScopedInputs:2875)"""
+        from .materialize import MATERIALIZE_ANNOTATION
+
+        if sr.meta.annotations.get(MATERIALIZE_ANNOTATION):
+            # materialize delegate: input ships verbatim — storage refs
+            # intact — so hydration happens in-pod, which is the whole
+            # point of the controller policy (reference: materialize.go)
+            return spec.input or {}
         namespace = sr.meta.namespace
         run_inputs: dict[str, Any] = {}
         prior_outputs: dict[str, Any] = {}

@@ -193,22 +193,37 @@ class StoryRunController:
             run = self.store.mutate(STORY_RUN_KIND, namespace, name, swap_inputs)
 
         # --- per-run RBAC identity (reference: rbac.go Reconcile:95) ---
-        # re-ensured on every pass: a deleted/drifted SA, Role, or
-        # RoleBinding is repaired create-or-update style mid-run
-        try:
-            rbac_summary = self.rbac.ensure(run, story)
-        except RBACOwnershipError as e:
-            return self._fail(
-                run,
-                StructuredError(type=ErrorType.VALIDATION, message=str(e)),
-                reason=conditions.Reason.INVALID_CONFIGURATION,
-            )
-        if (
-            run.status.get("serviceAccount") != rbac_summary["serviceAccount"]
-            or run.status.get("rejectedRBACRules", []) != rbac_summary["rejectedRules"]
-        ):
+        # Deleted/drifted SA, Role, or RoleBinding objects are repaired
+        # mid-run, but the full rule collection (all_steps_deep + template
+        # fetch per engram) only reruns when one of the three objects is
+        # missing/unowned or the Story generation moved — parked runs
+        # requeue every second and must not pay O(steps) store reads each
+        # tick for an unchanged identity.
+        sa_name = run.status.get("serviceAccount")
+        # standing rejections disable the quick path: the fix arrives via
+        # a template edit, which does not move the Story generation
+        rbac_fresh = bool(sa_name) and not run.status.get(
+            "rejectedRBACRules"
+        ) and run.status.get(
+            "rbacStoryGeneration"
+        ) == story_res.meta.generation and all(
+            (obj := self.store.try_get(kind, namespace, sa_name)) is not None
+            and obj.has_owner(run)
+            for kind in ("ServiceAccount", "Role", "RoleBinding")
+        )
+        if not rbac_fresh:
+            try:
+                rbac_summary = self.rbac.ensure(run, story)
+            except RBACOwnershipError as e:
+                return self._fail(
+                    run,
+                    StructuredError(type=ErrorType.VALIDATION, message=str(e)),
+                    reason=conditions.Reason.INVALID_CONFIGURATION,
+                )
+
             def record_sa(status: dict[str, Any]) -> None:
                 status["serviceAccount"] = rbac_summary["serviceAccount"]
+                status["rbacStoryGeneration"] = story_res.meta.generation
                 if rbac_summary["rejectedRules"]:
                     status["rejectedRBACRules"] = rbac_summary["rejectedRules"]
                 else:

@@ -1,6 +1,7 @@
 """Engram SDK: env contract, runtime context, registry."""
 
 from . import contract
+from . import materialize as _materialize  # registers the builtin delegate
 from .context import (
     EngramContext,
     EngramExit,

@@ -1,0 +1,29 @@
+"""SDK-side materialize engram: in-pod offloaded-data evaluation.
+
+The pod half of the materialize subsystem (reference:
+internal/controller/runs/materialize.go — the dedicated managed engram
+that "hydrates data and returns the evaluated result"). The controller
+ships ``{"expression", "scope"}`` with storage refs intact; the SDK
+context hydrates them lazily when ``ctx.inputs`` is read (next to the
+data, on the slice), then the expression is evaluated against the fully
+hydrated scope and the boolean result is reported as the step output.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..templating.engine import Evaluator, TemplateConfig
+from .registry import register_engram
+
+#: must match controllers/materialize.py MATERIALIZE_ENTRYPOINT
+ENTRYPOINT = "bobrapet.materialize"
+
+
+@register_engram(ENTRYPOINT, builtin=True)
+def materialize_entrypoint(ctx) -> dict[str, Any]:
+    payload = ctx.inputs  # hydrated by the SDK context
+    expression = payload["expression"]
+    scope = payload.get("scope") or {}
+    evaluator = Evaluator(TemplateConfig())
+    return {"result": bool(evaluator.evaluate_condition(expression, scope))}

@@ -13,9 +13,14 @@ from typing import Any, Callable, Optional
 
 _lock = threading.Lock()
 _registry: dict[str, Callable[..., Any]] = {}
+#: framework-managed entrypoints (e.g. the materialize delegate) that
+#: survive clear_registry() — tests wipe user registrations, not these
+_builtins: dict[str, Callable[..., Any]] = {}
 
 
-def register_engram(name: str, fn: Optional[Callable[..., Any]] = None):
+def register_engram(
+    name: str, fn: Optional[Callable[..., Any]] = None, builtin: bool = False
+):
     """Register an engram entrypoint; usable as a decorator.
 
     @register_engram("llama-generate")
@@ -25,6 +30,8 @@ def register_engram(name: str, fn: Optional[Callable[..., Any]] = None):
     def apply(f: Callable[..., Any]):
         with _lock:
             _registry[name] = f
+            if builtin:
+                _builtins[name] = f
         return f
 
     if fn is not None:
@@ -45,3 +52,4 @@ def unregister_engram(name: str) -> None:
 def clear_registry() -> None:
     with _lock:
         _registry.clear()
+        _registry.update(_builtins)

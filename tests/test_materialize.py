@@ -1,0 +1,142 @@
+"""Materialize subsystem: offloaded-data policy fail / inject / controller.
+
+(reference: internal/controller/runs/materialize.go,
+templating_policy.go, offloaded_refs.go test coverage model)
+
+The controller policy delegates condition evaluation over offloaded
+step output to a dedicated materialize StepRun whose input ships with
+storage refs intact; the SDK hydrates in-pod and reports the result.
+"""
+
+import pytest
+
+from bobrapet_tpu.api.catalog import make_engram_template
+from bobrapet_tpu.api.engram import make_engram
+from bobrapet_tpu.api.enums import OffloadedDataPolicy
+from bobrapet_tpu.api.story import make_story
+from bobrapet_tpu.controllers.materialize import (
+    DEFAULT_MATERIALIZE_ENGRAM,
+    MATERIALIZE_ANNOTATION,
+    materialize_name,
+)
+from bobrapet_tpu.core.object import new_resource
+from bobrapet_tpu.sdk import register_engram
+
+
+BIG = "x" * 100_000  # exceeds the 16KiB inline limit -> SDK offloads
+
+
+def _setup(rt, policy):
+    rt.config_manager.config.templating.offloaded_data_policy = policy
+    ran = []
+    rt.apply(make_engram_template("prod-tpl", entrypoint="prod-impl"))
+    rt.apply(make_engram("producer", "prod-tpl"))
+    rt.apply(make_engram_template("cons-tpl", entrypoint="cons-impl"))
+    rt.apply(make_engram("consumer", "cons-tpl"))
+
+    @register_engram("prod-impl")
+    def produce(ctx):
+        return {"blob": BIG, "flag": "go"}
+
+    @register_engram("cons-impl")
+    def consume(ctx):
+        ran.append(ctx.step)
+        return {"done": True}
+
+    return ran
+
+
+def _story(condition):
+    return make_story("mat", steps=[
+        {"name": "big", "ref": {"name": "producer"}},
+        {"name": "gated", "ref": {"name": "consumer"}, "needs": ["big"],
+         "if": condition},
+    ])
+
+
+class TestPolicies:
+    def test_fail_policy_fails_step(self, rt):
+        _setup(rt, OffloadedDataPolicy.FAIL)
+        rt.apply(_story("{{ steps.big.output.blob }}"))
+        run = rt.run_story("mat")
+        rt.pump()
+        r = rt.store.get("StoryRun", "default", run)
+        assert r.status["phase"] == "Failed"
+        assert r.status["stepStates"]["gated"]["reason"] == "OffloadedDataPolicy"
+
+    def test_inject_policy_hydrates_in_controller(self, rt):
+        ran = _setup(rt, OffloadedDataPolicy.INJECT)
+        rt.apply(_story("{{ steps.big.output.blob }}"))
+        run = rt.run_story("mat")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert ran == ["gated"]
+
+    def test_controller_policy_runs_materialize_delegate(self, rt):
+        ran = _setup(rt, OffloadedDataPolicy.CONTROLLER)
+        rt.apply(_story("{{ steps.big.output.blob }}"))
+        run = rt.run_story("mat")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert ran == ["gated"]
+        # the delegate StepRun exists, annotated, owned by the run,
+        # bound to the managed engram, and its input kept storage refs
+        mat = rt.store.get("StepRun", "default", materialize_name(run, "gated"))
+        assert mat.meta.annotations[MATERIALIZE_ANNOTATION] == "true"
+        assert mat.spec["engramRef"]["name"] == DEFAULT_MATERIALIZE_ENGRAM
+        blob = mat.spec["input"]["scope"]["steps"]["big"]["output"]["blob"]
+        assert "storageRef" in blob
+        assert mat.status["output"]["result"] is True
+
+    def test_controller_policy_false_condition_skips(self, rt):
+        ran = _setup(rt, OffloadedDataPolicy.CONTROLLER)
+        rt.apply(_story("{{ steps.big.output.blob == 'nope' }}"))
+        run = rt.run_story("mat")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert ran == []
+        r = rt.store.get("StoryRun", "default", run)
+        assert r.status["stepStates"]["gated"]["phase"] == "Skipped"
+        assert r.status["stepStates"]["gated"]["reason"] == "ConditionFalse"
+
+    def test_spoofed_delegate_refused(self, rt):
+        _setup(rt, OffloadedDataPolicy.CONTROLLER)
+        rt.apply(_story("{{ steps.big.output.blob }}"))
+        # plant a foreign StepRun at the deterministic delegate name
+        from bobrapet_tpu.api.runs import make_storyrun
+
+        run_name = "mat-run-spoof"
+        rt.store.create(new_resource(
+            "StepRun", materialize_name(run_name, "gated"), "default",
+            spec={"stepId": "gated#materialize",
+                  "storyRunRef": {"name": "some-other-run"},
+                  "engramRef": {"name": "consumer"}},
+        ))
+        rt.store.create(make_storyrun(run_name, "mat", {}, "default"))
+        rt.pump()
+        r = rt.store.get("StoryRun", "default", run_name)
+        assert r.status["phase"] == "Failed"
+        assert r.status["stepStates"]["gated"]["reason"] == "OffloadedDataPolicy"
+        assert "not owned" in r.status["stepStates"]["gated"]["message"]
+
+    def test_builtin_survives_registry_clear(self):
+        from bobrapet_tpu.sdk.registry import clear_registry, get_engram
+
+        clear_registry()
+        assert get_engram("bobrapet.materialize") is not None
+
+    def test_wait_until_over_offloaded_data_controller_policy(self, rt):
+        """A wait primitive polling offloaded output under the controller
+        policy resolves through the materialize delegate."""
+        _setup(rt, OffloadedDataPolicy.CONTROLLER)
+        rt.apply(make_story("mat-wait", steps=[
+            {"name": "big", "ref": {"name": "producer"}},
+            {"name": "w", "type": "wait", "needs": ["big"],
+             "with": {"until": "{{ steps.big.output.flag == 'go' }}",
+                      "timeout": "5m", "pollInterval": "1s"}},
+        ]))
+        run = rt.run_story("mat-wait")
+        rt.pump()
+        r = rt.store.get("StoryRun", "default", run)
+        assert r.status["phase"] == "Succeeded"
+        assert r.status["stepStates"]["w"]["phase"] == "Succeeded"
