@@ -1,0 +1,24 @@
+# Build / test entry points.
+
+NATIVE_SRC := native/blobcache.cc
+NATIVE_SO  := native/libblobcache.so
+
+.PHONY: all native test bench clean
+
+all: native
+
+# The native slice-local SSD blob cache (also built on demand by
+# bobrapet_tpu/storage/ssd.py when the .so is missing or stale).
+native: $(NATIVE_SO)
+
+$(NATIVE_SO): $(NATIVE_SRC)
+	g++ -O2 -shared -fPIC -std=c++17 -o $@ $< -pthread
+
+test: native
+	python -m pytest tests/ -q
+
+bench: native
+	python bench.py
+
+clean:
+	rm -f $(NATIVE_SO)

@@ -665,7 +665,7 @@ class DAGEngine:
                 or DEFAULT_MATERIALIZE_ENGRAM
             )
             return resolve_materialize(
-                self.store, run, step_name, expr, scope, engram, self.clock.now()
+                self.store, run, step_name, expr, scope, engram
             )
         prefix = f"runs/{run.meta.namespace}/{run.meta.name}"
         hydrated = {

@@ -100,7 +100,6 @@ def resolve_materialize(
     expression: str,
     scope: dict[str, Any],
     engram_name: str,
-    now: float,
 ) -> Optional[bool]:
     """Create-or-poll the materialize StepRun for one step's condition.
 

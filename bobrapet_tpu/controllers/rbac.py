@@ -13,6 +13,8 @@ land on the ServiceAccount so offload credentials follow the run.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 from typing import Any, Optional
 
@@ -113,6 +115,7 @@ class RunRBACManager:
             "serviceAccount": sa_name,
             "rules": kept,
             "rejectedRules": rejected,
+            "rulesHash": rules_hash(kept),
         }
 
     # ------------------------------------------------------------------
@@ -184,6 +187,13 @@ class RunRBACManager:
             self.store.mutate(
                 desired.kind, desired.meta.namespace, desired.meta.name, sync
             )
+
+
+def rules_hash(rules: list[dict[str, Any]]) -> str:
+    """Stable digest of a rule list — lets the StoryRun controller's
+    quick path detect out-of-band Role drift without re-collecting."""
+    canon = json.dumps(rules, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canon.encode()).hexdigest()[:16]
 
 
 class RBACOwnershipError(Exception):

@@ -30,7 +30,7 @@ from ..storage.manager import StorageManager
 from ..utils.duration import parse_duration
 from .dag import INDEX_STEPRUN_STORYRUN, DAGEngine
 from .manager import Clock
-from .rbac import RBACOwnershipError, RunRBACManager
+from .rbac import RBACOwnershipError, RunRBACManager, rules_hash
 from .step_executor import LABEL_PRIORITY, LABEL_QUEUE
 from .steprun import CANCEL_ANNOTATION
 
@@ -209,6 +209,13 @@ class StoryRunController:
         ) == story_res.meta.generation and all(
             (obj := self.store.try_get(kind, namespace, sa_name)) is not None
             and obj.has_owner(run)
+            # out-of-band Role tampering (broadened grants) must trigger
+            # the full ensure, which rewrites the drifted spec
+            and (
+                kind != "Role"
+                or rules_hash(obj.spec.get("rules") or [])
+                == run.status.get("rbacRulesHash")
+            )
             for kind in ("ServiceAccount", "Role", "RoleBinding")
         )
         if not rbac_fresh:
@@ -224,6 +231,7 @@ class StoryRunController:
             def record_sa(status: dict[str, Any]) -> None:
                 status["serviceAccount"] = rbac_summary["serviceAccount"]
                 status["rbacStoryGeneration"] = story_res.meta.generation
+                status["rbacRulesHash"] = rbac_summary["rulesHash"]
                 if rbac_summary["rejectedRules"]:
                     status["rejectedRBACRules"] = rbac_summary["rejectedRules"]
                 else:

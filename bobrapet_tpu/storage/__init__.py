@@ -17,11 +17,22 @@ from .store import (
 
 
 def build_store(policy, base_dir: str = "/tmp/bobrapet-storage") -> Store:
-    """Construct a Store from a StoragePolicy (api.shared.StoragePolicy)."""
+    """Construct a Store from a StoragePolicy (api.shared.StoragePolicy).
+
+    The slice-local SSD provider prefers the native C++ blob cache
+    (checksummed reads, LRU byte budget — native/blobcache.cc) and falls
+    back to the Python FileStore-based implementation when no toolchain
+    is available."""
     if policy is None:
         return FileStore(base_dir)
     if getattr(policy, "slice_local_ssd", None) is not None:
-        return SliceLocalSSDStore(policy.slice_local_ssd.path)
+        from .ssd import NativeUnavailable, SSDStore
+
+        cfg = policy.slice_local_ssd
+        try:
+            return SSDStore(cfg.path, capacity_bytes=int(cfg.max_bytes or 0))
+        except NativeUnavailable:
+            return SliceLocalSSDStore(cfg.path)
     if getattr(policy, "s3", None) is not None:
         return S3Store(bucket=policy.s3.bucket)
     if getattr(policy, "file", None) is not None and policy.file.path:

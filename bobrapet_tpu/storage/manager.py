@@ -176,6 +176,15 @@ class StorageManager:
         if is_storage_ref(value):
             ref = StorageRef.from_marker(value)
             self.validate_ref(ref, allowed_prefixes)
+            if ref.provider and ref.provider != self.store.provider:
+                # mixed-provider deployments (e.g. native slice-SSD writer,
+                # plain-file reader on the same mount) must fail loudly —
+                # their on-disk layouts are not interchangeable
+                raise StorageError(
+                    f"storage ref {ref.key!r} written by provider "
+                    f"{ref.provider!r} but this store is "
+                    f"{self.store.provider!r}"
+                )
             data = self.store.get(ref.key)
             if ref.sha256:
                 import hashlib

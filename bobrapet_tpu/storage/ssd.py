@@ -1,0 +1,192 @@
+"""Slice-local SSD store: ctypes bindings over the native blob cache.
+
+The TPU-native hot-payload provider (SURVEY §5.8: "slice-local SSD
+replaces/augments S3 for hot payload offload"): a C++ content-addressed
+blob cache (native/blobcache.cc) with checksummed reads, atomic writes,
+and LRU eviction under a byte budget, mounted on the TPU-VM's local
+SSD. Plugs into the same Store interface as the S3/file/memory backends
+(reference: pkg/storage/store.go:26), so the StorageManager's
+dehydrate/hydrate machinery is provider-agnostic.
+
+The shared library builds on demand with g++ (cached next to the
+source); when no toolchain is available the loader raises and callers
+fall back to FileStore on the same mount — same semantics, slower path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+from .store import BlobNotFound, Store, StorageError
+
+_log = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SRC = os.path.abspath(os.path.join(_NATIVE_DIR, "blobcache.cc"))
+_SO = os.path.abspath(os.path.join(_NATIVE_DIR, "libblobcache.so"))
+
+_build_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+class NativeUnavailable(StorageError):
+    """The native library could not be built or loaded."""
+
+
+def _build() -> str:
+    try:
+        if os.path.exists(_SO) and (
+            not os.path.exists(_SRC)  # prebuilt .so shipped without source
+            or os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+        ):
+            return _SO
+        if not os.path.exists(_SRC):
+            raise NativeUnavailable("native source and library both missing")
+    except OSError as e:
+        raise NativeUnavailable(str(e)) from e
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        "-o", _SO, _SRC, "-pthread",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except FileNotFoundError as e:
+        raise NativeUnavailable("g++ not available") from e
+    except subprocess.CalledProcessError as e:
+        raise NativeUnavailable(f"native build failed: {e.stderr}") from e
+    return _SO
+
+
+def load_native() -> ctypes.CDLL:
+    global _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        so = _build()
+        lib = ctypes.CDLL(so)
+        lib.bc_open.restype = ctypes.c_void_p
+        lib.bc_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.bc_close.argtypes = [ctypes.c_void_p]
+        lib.bc_put.restype = ctypes.c_int
+        lib.bc_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.bc_size.restype = ctypes.c_int64
+        lib.bc_size.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.bc_get.restype = ctypes.c_int
+        lib.bc_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.bc_delete.restype = ctypes.c_int
+        lib.bc_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.bc_exists.restype = ctypes.c_int
+        lib.bc_exists.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.bc_mtime.restype = ctypes.c_double
+        lib.bc_mtime.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.bc_used_bytes.restype = ctypes.c_uint64
+        lib.bc_used_bytes.argtypes = [ctypes.c_void_p]
+        lib.bc_list.restype = ctypes.c_int64
+        lib.bc_list.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        _lib = lib
+        return lib
+
+
+_ERR = {
+    -1: "not found", -2: "io error", -3: "corrupt blob",
+    -4: "bad argument", -5: "buffer too small / over capacity",
+}
+
+
+class SSDStore(Store):
+    """Native slice-local SSD blob store."""
+
+    #: distinct from the Python fallback's "slice-ssd": the two on-disk
+    #: layouts are NOT interchangeable, and the StorageManager rejects a
+    #: ref whose provider differs from the serving store — a mixed
+    #: deployment fails loudly instead of silently missing blobs
+    provider = "slice-ssd-native"
+
+    def __init__(self, base_dir: str, capacity_bytes: int = 0):
+        self._lib = load_native()
+        self._handle = self._lib.bc_open(base_dir.encode(), capacity_bytes)
+        if not self._handle:
+            raise StorageError(f"cannot open SSD cache at {base_dir!r}")
+        self.base_dir = base_dir
+        self.capacity_bytes = capacity_bytes
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.bc_close(self._handle)
+            self._handle = None
+
+    def __del__(self):  # noqa: D105 - best-effort native cleanup
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- Store interface ---------------------------------------------------
+    def put(self, key: str, data: bytes) -> None:
+        rc = self._lib.bc_put(self._handle, key.encode(), data, len(data))
+        if rc != 0:
+            raise StorageError(
+                f"ssd put {key!r} failed: {_ERR.get(rc, rc)}"
+            )
+
+    def get(self, key: str) -> bytes:
+        size = self._lib.bc_size(self._handle, key.encode())
+        if size == -1:
+            raise BlobNotFound(key)
+        if size < 0:
+            raise StorageError(f"ssd stat {key!r} failed: {_ERR.get(size, size)}")
+        buf = ctypes.create_string_buffer(int(size))
+        rc = self._lib.bc_get(self._handle, key.encode(), buf, int(size))
+        if rc == -1:
+            raise BlobNotFound(key)
+        if rc != 0:
+            raise StorageError(f"ssd get {key!r} failed: {_ERR.get(rc, rc)}")
+        return buf.raw[:size]
+
+    def delete(self, key: str) -> None:
+        rc = self._lib.bc_delete(self._handle, key.encode())
+        if rc not in (0, -1):  # deleting a missing blob is not an error
+            raise StorageError(f"ssd delete {key!r} failed: {_ERR.get(rc, rc)}")
+
+    def exists(self, key: str) -> bool:
+        return self._lib.bc_exists(self._handle, key.encode()) == 1
+
+    def list(self, prefix: str = "") -> list[str]:
+        needed = self._lib.bc_list(self._handle, prefix.encode(), None, 0)
+        if needed <= 1:
+            return []
+        buf = ctypes.create_string_buffer(int(needed))
+        self._lib.bc_list(self._handle, prefix.encode(), buf, int(needed))
+        text = buf.value.decode()
+        return [k for k in text.split("\n") if k]
+
+    def stat_mtime(self, key: str) -> float:
+        t = self._lib.bc_mtime(self._handle, key.encode())
+        if t < 0:
+            raise BlobNotFound(key)
+        return t
+
+    def used_bytes(self) -> int:
+        return int(self._lib.bc_used_bytes(self._handle))
+
+
+def make_ssd_store(base_dir: str, capacity_bytes: int = 0) -> Store:
+    """SSDStore when the native library is available, FileStore fallback
+    on the same mount otherwise (same semantics, no native speedup)."""
+    try:
+        return SSDStore(base_dir, capacity_bytes)
+    except NativeUnavailable as e:
+        _log.warning("native SSD store unavailable (%s); using FileStore", e)
+        from .store import FileStore
+
+        return FileStore(base_dir)

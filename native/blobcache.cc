@@ -1,0 +1,387 @@
+// Slice-local SSD blob cache — the native data-plane store behind the
+// framework's Store interface (counterpart of the reference's
+// pkg/storage backends, store.go:26 / file_store.go:35; the reference is
+// a pure-Go control plane, so this component is new TPU-native work:
+// hot payload offload onto the TPU-VM's local SSD, per SURVEY §5.8).
+//
+// Design:
+//   * content-addressed shard layout: key -> FNV-1a64 -> dir fan-out
+//     (256 shards), so huge runs don't melt one directory
+//   * each blob file carries a header (magic, key, XXH-style checksum,
+//     length); reads validate the checksum — silent SSD corruption is
+//     surfaced as an error, never returned as data
+//   * writes are atomic (tmp file + rename) and update a byte budget;
+//     exceeding capacity evicts least-recently-used blobs (mtime order)
+//   * thread-safe behind a single mutex; the expensive work (IO) happens
+//     outside the store-wide critical section where possible
+//
+// Exposed as a small C ABI consumed via ctypes from
+// bobrapet_tpu/storage/ssd.py. No exceptions cross the boundary; all
+// entry points return status codes (0 ok, <0 error).
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <map>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xB0B7CA5E;
+constexpr int kOk = 0;
+constexpr int kErrNotFound = -1;
+constexpr int kErrIO = -2;
+constexpr int kErrCorrupt = -3;
+constexpr int kErrBadArg = -4;
+constexpr int kErrTooSmall = -5;
+
+uint64_t fnv1a64(const void* data, size_t len, uint64_t seed = 1469598103934665603ULL) {
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  uint64_t h = seed;
+  for (size_t i = 0; i < len; ++i) {
+    h ^= p[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+// 64-bit mix-based checksum over the payload (fast, order-sensitive).
+uint64_t checksum64(const void* data, size_t len) {
+  uint64_t h = fnv1a64(data, len, 0x9E3779B97F4A7C15ULL);
+  h ^= h >> 33;
+  h *= 0xFF51AFD7ED558CCDULL;
+  h ^= h >> 33;
+  return h;
+}
+
+#pragma pack(push, 1)
+struct BlobHeader {
+  uint32_t magic;
+  uint32_t key_len;
+  uint64_t data_len;
+  uint64_t checksum;
+};
+#pragma pack(pop)
+
+struct CacheEntry {
+  std::string path;
+  uint64_t size;   // bytes on disk (header + key + data)
+  uint64_t lru;    // monotonic access tick (higher = more recent)
+};
+
+struct Cache {
+  std::string dir;
+  uint64_t capacity;  // 0 = unlimited
+  uint64_t used = 0;
+  uint64_t tick = 0;  // LRU clock: bumped on every put/get
+  std::mutex mu;
+  std::map<std::string, CacheEntry> entries;
+};
+
+std::string shard_dir(const Cache& c, const std::string& key) {
+  char buf[8];
+  std::snprintf(buf, sizeof(buf), "%02x",
+                static_cast<unsigned>(fnv1a64(key.data(), key.size()) & 0xff));
+  return c.dir + "/" + buf;
+}
+
+std::string blob_path(const Cache& c, const std::string& key) {
+  char hex[32];
+  std::snprintf(hex, sizeof(hex), "%016llx",
+                static_cast<unsigned long long>(fnv1a64(key.data(), key.size())));
+  return shard_dir(c, key) + "/" + hex + ".blob";
+}
+
+int mkdir_p(const std::string& path) {
+  std::string acc;
+  for (size_t i = 0; i < path.size(); ++i) {
+    acc += path[i];
+    if (path[i] == '/' || i + 1 == path.size()) {
+      if (acc == "/" || acc.empty()) continue;
+      if (mkdir(acc.c_str(), 0755) != 0 && errno != EEXIST) return kErrIO;
+    }
+  }
+  return kOk;
+}
+
+double file_mtime(const std::string& p) {
+  struct stat st;
+  if (stat(p.c_str(), &st) != 0) return 0.0;
+  return static_cast<double>(st.st_mtime);
+}
+
+// Reads a blob file; returns kOk and fills key (and data when non-null;
+// header-only mode skips the payload read so index rebuilds stay
+// O(#files)). Lengths are validated against the on-disk size BEFORE any
+// allocation — a corrupted header must yield kErrCorrupt, never an
+// exception across the C boundary.
+int read_blob_file(const std::string& path, std::string* key, std::string* data) {
+  struct stat st;
+  if (stat(path.c_str(), &st) != 0) return kErrNotFound;
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return kErrNotFound;
+  BlobHeader hdr;
+  if (std::fread(&hdr, sizeof(hdr), 1, f) != 1 || hdr.magic != kMagic) {
+    std::fclose(f);
+    return kErrCorrupt;
+  }
+  uint64_t expect = sizeof(hdr) + static_cast<uint64_t>(hdr.key_len) + hdr.data_len;
+  if (hdr.key_len > 4096 || expect != static_cast<uint64_t>(st.st_size)) {
+    std::fclose(f);
+    return kErrCorrupt;
+  }
+  std::string k(hdr.key_len, '\0');
+  if (hdr.key_len && std::fread(&k[0], 1, hdr.key_len, f) != hdr.key_len) {
+    std::fclose(f);
+    return kErrCorrupt;
+  }
+  if (data) {
+    std::string d;
+    try {
+      d.resize(hdr.data_len);
+    } catch (...) {
+      std::fclose(f);
+      return kErrCorrupt;
+    }
+    if (hdr.data_len && std::fread(&d[0], 1, hdr.data_len, f) != hdr.data_len) {
+      std::fclose(f);
+      return kErrCorrupt;
+    }
+    if (checksum64(d.data(), d.size()) != hdr.checksum) {
+      std::fclose(f);
+      return kErrCorrupt;
+    }
+    *data = std::move(d);
+  }
+  std::fclose(f);
+  if (key) *key = std::move(k);
+  return kOk;
+}
+
+// Scan the shard tree on open to rebuild the index (restart-safe).
+// Header-only reads: O(#files), not O(total bytes) — payload checksums
+// are validated lazily on bc_get.
+void rescan(Cache* c) {
+  c->entries.clear();
+  c->used = 0;
+  // collected first so LRU ticks can be assigned in mtime order
+  std::vector<std::pair<double, std::pair<std::string, CacheEntry>>> found;
+  DIR* root = opendir(c->dir.c_str());
+  if (!root) return;
+  struct dirent* de;
+  while ((de = readdir(root)) != nullptr) {
+    std::string shard = c->dir + "/" + de->d_name;
+    if (de->d_name[0] == '.') continue;
+    DIR* sd = opendir(shard.c_str());
+    if (!sd) continue;
+    struct dirent* be;
+    while ((be = readdir(sd)) != nullptr) {
+      std::string name(be->d_name);
+      if (name.size() < 5 || name.substr(name.size() - 5) != ".blob") continue;
+      std::string path = shard + "/" + name;
+      std::string key;
+      if (read_blob_file(path, &key, nullptr) != kOk) continue;
+      struct stat st;
+      if (stat(path.c_str(), &st) != 0) continue;
+      CacheEntry e{path, static_cast<uint64_t>(st.st_size), 0};
+      found.emplace_back(file_mtime(path), std::make_pair(key, std::move(e)));
+    }
+    closedir(sd);
+  }
+  closedir(root);
+  std::sort(found.begin(), found.end(),
+            [](const auto& a, const auto& b) { return a.first < b.first; });
+  for (auto& item : found) {
+    item.second.second.lru = ++c->tick;
+    c->used += item.second.second.size;
+    c->entries[item.second.first] = std::move(item.second.second);
+  }
+}
+
+// Evict LRU entries until `needed` more bytes fit. Caller holds mu.
+void evict_for(Cache* c, uint64_t needed) {
+  if (c->capacity == 0) return;
+  while (c->used + needed > c->capacity && !c->entries.empty()) {
+    auto victim = c->entries.begin();
+    for (auto it = c->entries.begin(); it != c->entries.end(); ++it) {
+      if (it->second.lru < victim->second.lru) victim = it;
+    }
+    ::unlink(victim->second.path.c_str());
+    c->used -= victim->second.size;
+    c->entries.erase(victim);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* bc_open(const char* dir, uint64_t capacity_bytes) {
+  if (!dir || !*dir) return nullptr;
+  auto* c = new Cache();
+  c->dir = dir;
+  c->capacity = capacity_bytes;
+  if (mkdir_p(c->dir) != kOk) {
+    delete c;
+    return nullptr;
+  }
+  rescan(c);
+  return c;
+}
+
+void bc_close(void* handle) { delete static_cast<Cache*>(handle); }
+
+int bc_put(void* handle, const char* key, const void* data, uint64_t len) {
+  auto* c = static_cast<Cache*>(handle);
+  if (!c || !key || (!data && len)) return kErrBadArg;
+  std::string k(key);
+  std::lock_guard<std::mutex> lock(c->mu);
+
+  std::string shard = shard_dir(*c, k);
+  if (mkdir_p(shard) != kOk) return kErrIO;
+  std::string path = blob_path(*c, k);
+  std::string tmp = path + ".tmp";
+
+  BlobHeader hdr{kMagic, static_cast<uint32_t>(k.size()), len,
+                 checksum64(data, len)};
+  uint64_t total = sizeof(hdr) + k.size() + len;
+  if (c->capacity && total > c->capacity) return kErrTooSmall;
+
+  // Remove the replaced entry from the index BEFORE eviction so it can
+  // never be double-counted as an eviction victim; kept aside to restore
+  // on write failure (the old blob file is untouched until the rename).
+  CacheEntry prev_entry;
+  bool had_prev = false;
+  auto prev = c->entries.find(k);
+  if (prev != c->entries.end()) {
+    prev_entry = prev->second;
+    had_prev = true;
+    c->used -= prev_entry.size;
+    c->entries.erase(prev);
+  }
+  evict_for(c, total);
+
+  auto rollback = [&]() {
+    if (had_prev && c->entries.find(k) == c->entries.end()) {
+      c->entries[k] = prev_entry;
+      c->used += prev_entry.size;
+    }
+  };
+
+  FILE* f = std::fopen(tmp.c_str(), "wb");
+  if (!f) {
+    rollback();
+    return kErrIO;
+  }
+  bool ok = std::fwrite(&hdr, sizeof(hdr), 1, f) == 1 &&
+            (k.empty() || std::fwrite(k.data(), 1, k.size(), f) == k.size()) &&
+            (len == 0 || std::fwrite(data, 1, len, f) == len);
+  ok = std::fclose(f) == 0 && ok;
+  if (!ok || std::rename(tmp.c_str(), path.c_str()) != 0) {
+    ::unlink(tmp.c_str());
+    rollback();
+    return kErrIO;
+  }
+  c->entries[k] = CacheEntry{path, total, ++c->tick};
+  c->used += total;
+  return kOk;
+}
+
+// Two-phase read: bc_size to learn the length, bc_get to copy out.
+int64_t bc_size(void* handle, const char* key) {
+  auto* c = static_cast<Cache*>(handle);
+  if (!c || !key) return kErrBadArg;
+  std::lock_guard<std::mutex> lock(c->mu);
+  auto it = c->entries.find(key);
+  if (it == c->entries.end()) return kErrNotFound;
+  return static_cast<int64_t>(it->second.size - sizeof(BlobHeader) -
+                              std::strlen(key));
+}
+
+int bc_get(void* handle, const char* key, void* buf, uint64_t buflen) {
+  auto* c = static_cast<Cache*>(handle);
+  if (!c || !key || !buf) return kErrBadArg;
+  std::string path;
+  {
+    std::lock_guard<std::mutex> lock(c->mu);
+    auto it = c->entries.find(key);
+    if (it == c->entries.end()) return kErrNotFound;
+    path = it->second.path;
+    it->second.lru = ++c->tick;  // reads refresh recency
+  }
+  std::string k, d;
+  int rc = read_blob_file(path, &k, &d);
+  if (rc != kOk) return rc;
+  if (k != key) return kErrCorrupt;  // hash collision or tamper
+  if (d.size() > buflen) return kErrTooSmall;
+  std::memcpy(buf, d.data(), d.size());
+  return kOk;
+}
+
+int bc_delete(void* handle, const char* key) {
+  auto* c = static_cast<Cache*>(handle);
+  if (!c || !key) return kErrBadArg;
+  std::lock_guard<std::mutex> lock(c->mu);
+  auto it = c->entries.find(key);
+  if (it == c->entries.end()) return kErrNotFound;
+  ::unlink(it->second.path.c_str());
+  c->used -= it->second.size;
+  c->entries.erase(it);
+  return kOk;
+}
+
+int bc_exists(void* handle, const char* key) {
+  auto* c = static_cast<Cache*>(handle);
+  if (!c || !key) return kErrBadArg;
+  std::lock_guard<std::mutex> lock(c->mu);
+  return c->entries.count(key) ? 1 : 0;
+}
+
+double bc_mtime(void* handle, const char* key) {
+  auto* c = static_cast<Cache*>(handle);
+  if (!c || !key) return -1.0;
+  std::lock_guard<std::mutex> lock(c->mu);
+  auto it = c->entries.find(key);
+  if (it == c->entries.end()) return -1.0;
+  return file_mtime(it->second.path);
+}
+
+uint64_t bc_used_bytes(void* handle) {
+  auto* c = static_cast<Cache*>(handle);
+  if (!c) return 0;
+  std::lock_guard<std::mutex> lock(c->mu);
+  return c->used;
+}
+
+// Lists keys with the given prefix, newline-joined, into buf.
+// Returns required size (including NUL); writes only if it fits.
+int64_t bc_list(void* handle, const char* prefix, char* buf, uint64_t buflen) {
+  auto* c = static_cast<Cache*>(handle);
+  if (!c) return kErrBadArg;
+  std::string pfx = prefix ? prefix : "";
+  std::string out;
+  {
+    std::lock_guard<std::mutex> lock(c->mu);
+    for (auto& kv : c->entries) {
+      if (kv.first.compare(0, pfx.size(), pfx) == 0) {
+        out += kv.first;
+        out += '\n';
+      }
+    }
+  }
+  int64_t needed = static_cast<int64_t>(out.size() + 1);
+  if (buf && static_cast<uint64_t>(needed) <= buflen) {
+    std::memcpy(buf, out.c_str(), out.size() + 1);
+  }
+  return needed;
+}
+
+}  // extern "C"
