@@ -115,7 +115,20 @@ class RunRBACManager:
             "serviceAccount": sa_name,
             "rules": kept,
             "rejectedRules": rejected,
-            "rulesHash": rules_hash(kept),
+            # digest over all three desired specs: the quick path compares
+            # it against the live objects so ANY out-of-band drift (rules,
+            # binding subjects, SA cloud-identity annotations) forces the
+            # full repair
+            "objectsHash": objects_hash([
+                {"annotations": annotations} if annotations else {},
+                {"rules": kept},
+                {
+                    "roleRef": sa_name,
+                    "subjects": [
+                        {"kind": SERVICE_ACCOUNT_KIND, "name": sa_name}
+                    ],
+                },
+            ]),
         }
 
     # ------------------------------------------------------------------
@@ -189,10 +202,11 @@ class RunRBACManager:
             )
 
 
-def rules_hash(rules: list[dict[str, Any]]) -> str:
-    """Stable digest of a rule list — lets the StoryRun controller's
-    quick path detect out-of-band Role drift without re-collecting."""
-    canon = json.dumps(rules, sort_keys=True, separators=(",", ":"))
+def objects_hash(specs: list[dict[str, Any]]) -> str:
+    """Stable digest of the [SA, Role, RoleBinding] spec list — lets the
+    StoryRun controller's quick path detect out-of-band drift of any of
+    the three identity objects without re-collecting rules."""
+    canon = json.dumps(specs, sort_keys=True, separators=(",", ":"))
     return hashlib.sha256(canon.encode()).hexdigest()[:16]
 
 

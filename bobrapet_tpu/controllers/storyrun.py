@@ -30,7 +30,7 @@ from ..storage.manager import StorageManager
 from ..utils.duration import parse_duration
 from .dag import INDEX_STEPRUN_STORYRUN, DAGEngine
 from .manager import Clock
-from .rbac import RBACOwnershipError, RunRBACManager, rules_hash
+from .rbac import RBACOwnershipError, RunRBACManager, objects_hash
 from .step_executor import LABEL_PRIORITY, LABEL_QUEUE
 from .steprun import CANCEL_ANNOTATION
 
@@ -202,21 +202,20 @@ class StoryRunController:
         sa_name = run.status.get("serviceAccount")
         # standing rejections disable the quick path: the fix arrives via
         # a template edit, which does not move the Story generation
-        rbac_fresh = bool(sa_name) and not run.status.get(
-            "rejectedRBACRules"
-        ) and run.status.get(
-            "rbacStoryGeneration"
-        ) == story_res.meta.generation and all(
-            (obj := self.store.try_get(kind, namespace, sa_name)) is not None
-            and obj.has_owner(run)
-            # out-of-band Role tampering (broadened grants) must trigger
-            # the full ensure, which rewrites the drifted spec
-            and (
-                kind != "Role"
-                or rules_hash(obj.spec.get("rules") or [])
-                == run.status.get("rbacRulesHash")
-            )
+        live_objs = [
+            self.store.try_get(kind, namespace, sa_name) if sa_name else None
             for kind in ("ServiceAccount", "Role", "RoleBinding")
+        ]
+        rbac_fresh = (
+            bool(sa_name)
+            and not run.status.get("rejectedRBACRules")
+            and run.status.get("rbacStoryGeneration") == story_res.meta.generation
+            and all(o is not None and o.has_owner(run) for o in live_objs)
+            # any out-of-band tampering — Role rules, RoleBinding
+            # subjects, SA cloud-identity annotations — must trigger the
+            # full ensure, which rewrites the drifted specs
+            and objects_hash([o.spec for o in live_objs])
+            == run.status.get("rbacObjectsHash")
         )
         if not rbac_fresh:
             try:
@@ -231,7 +230,7 @@ class StoryRunController:
             def record_sa(status: dict[str, Any]) -> None:
                 status["serviceAccount"] = rbac_summary["serviceAccount"]
                 status["rbacStoryGeneration"] = story_res.meta.generation
-                status["rbacRulesHash"] = rbac_summary["rulesHash"]
+                status["rbacObjectsHash"] = rbac_summary["objectsHash"]
                 if rbac_summary["rejectedRules"]:
                     status["rejectedRBACRules"] = rbac_summary["rejectedRules"]
                 else:

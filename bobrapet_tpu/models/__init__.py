@@ -1,5 +1,6 @@
 """Model families (functional JAX, sharding-rule driven)."""
 
+from . import embedder, moe
 from .llama import (
     LlamaConfig,
     forward,
@@ -10,9 +11,12 @@ from .llama import (
     llama3_8b,
     llama_tiny,
 )
+from .moe import MoEConfig, mixtral_8x7b, moe_tiny
 
 __all__ = [
     "LlamaConfig",
+    "MoEConfig",
+    "embedder",
     "forward",
     "greedy_generate",
     "init_cache",
@@ -20,4 +24,7 @@ __all__ = [
     "llama3_1b",
     "llama3_8b",
     "llama_tiny",
+    "mixtral_8x7b",
+    "moe",
+    "moe_tiny",
 ]

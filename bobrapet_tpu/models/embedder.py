@@ -1,0 +1,67 @@
+"""Text embedding model: bidirectional encoder + mean pooling.
+
+The retrieval half of BASELINE config 5 (nested executeStory RAG:
+embed -> retrieve -> generate). Reuses the Llama parameter layout and
+blocks but attends bidirectionally (no causal mask) and pools the final
+hidden states into one L2-normalized vector per sequence — the standard
+dense-retrieval encoder shape, MXU-friendly end to end.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+from ..ops.rmsnorm import rmsnorm_reference
+from . import llama
+
+
+def embed_tiny(vocab_size: int = 512, max_seq_len: int = 128) -> llama.LlamaConfig:
+    """Tiny encoder config for tests/dev meshes."""
+    return llama.LlamaConfig(
+        vocab_size=vocab_size, dim=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        ffn_hidden=128, max_seq_len=max_seq_len, dtype=jnp.float32,
+        tie_embeddings=True,
+    )
+
+
+def init_params(key: jax.Array, cfg: llama.LlamaConfig) -> dict[str, Any]:
+    return llama.init_params(key, cfg)
+
+
+def encode(
+    params: dict[str, Any],
+    tokens: jax.Array,
+    cfg: llama.LlamaConfig,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Token ids [B, S] (+ optional validity mask [B, S]) -> embeddings
+    [B, D], L2-normalized."""
+    bidi = lambda q, k, v: attention(q, k, v, causal=False)  # noqa: E731
+    freqs = llama.rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    x = params["embed"]["weight"][tokens].astype(cfg.dtype)
+    for layer in params["layers"]:
+        x, _ = llama._attention_block(layer, x, freqs, cfg, None, None, bidi)
+        x = llama._mlp_block(layer, x, cfg)
+    x = rmsnorm_reference(x, params["final_norm"]["weight"], cfg.norm_eps)
+    x = x.astype(jnp.float32)
+    if mask is not None:
+        m = mask.astype(jnp.float32)[..., None]
+        pooled = (x * m).sum(1) / jnp.clip(m.sum(1), 1e-6)
+    else:
+        pooled = x.mean(1)
+    return pooled / jnp.clip(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-6
+    )
+
+
+def cosine_topk(
+    query: jax.Array, corpus: jax.Array, k: int = 4
+) -> tuple[jax.Array, jax.Array]:
+    """Dense retrieval: [Q,D] x [N,D] -> (scores [Q,k], indices [Q,k]).
+    One matmul on the MXU; both inputs are expected L2-normalized."""
+    sims = query @ corpus.T
+    return jax.lax.top_k(sims, k)

@@ -1,0 +1,252 @@
+"""Mixtral-style sparse Mixture-of-Experts family (TPU-native design).
+
+Second model family next to Llama (the reference orchestrates arbitrary
+engram containers; BASELINE's engram workloads are LLM inference — an
+MoE family exercises the expert-parallel axis the dense family cannot).
+
+TPU-first formulation: routing uses the dense one-hot dispatch/combine
+einsums (GShard/Switch style) — static shapes, no gather/scatter with
+data-dependent sizes, everything lands on the MXU and XLA inserts the
+all-to-alls when experts are sharded on the ``expert`` mesh axis:
+
+  router:   logits  [B,S,E]    = x @ w_router
+  dispatch: mask    [B,S,E,C]  (top-k one-hot with per-expert capacity)
+  expert:   inputs  [E, B*C', D] -> ffn -> outputs (batched einsum over E)
+  combine:  y       [B,S,D]    = sum_e,c weight * expert_out
+
+Expert FFN weights are stacked [E, D, F]: E shards on ``expert``
+(expert parallelism), F on ``model`` (TP inside each expert), D on
+``fsdp``. Attention blocks are the dense Llama ones — only the MLP is
+replaced per layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+from ..ops.rmsnorm import rmsnorm_reference
+from ..ops.rope import apply_rope, rope_frequencies
+from .llama import LlamaConfig, _attention_block, _cached_attention  # noqa: F401
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32_000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_hidden: int = 14_336
+    n_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25  # per-expert token budget multiplier
+    max_seq_len: int = 8192
+    rope_theta: float = 1_000_000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def capacity(self, tokens: int) -> int:
+        """Static per-expert capacity for a given token count."""
+        cap = int(math.ceil(tokens * self.experts_per_token
+                            * self.capacity_factor / self.n_experts))
+        return max(cap, 1)
+
+    def as_llama(self) -> LlamaConfig:
+        """Attention-relevant view for reusing the dense attention block."""
+        return LlamaConfig(
+            vocab_size=self.vocab_size, dim=self.dim, n_layers=self.n_layers,
+            n_heads=self.n_heads, n_kv_heads=self.n_kv_heads,
+            ffn_hidden=self.ffn_hidden, max_seq_len=self.max_seq_len,
+            rope_theta=self.rope_theta, norm_eps=self.norm_eps,
+            dtype=self.dtype,
+        )
+
+
+def mixtral_8x7b() -> MoEConfig:
+    return MoEConfig()
+
+
+def moe_tiny(vocab_size: int = 512, max_seq_len: int = 256) -> MoEConfig:
+    """Tiny config for tests and the multi-chip dryrun."""
+    return MoEConfig(
+        vocab_size=vocab_size, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_hidden=256, n_experts=4, experts_per_token=2,
+        max_seq_len=max_seq_len, dtype=jnp.float32,
+    )
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+
+def init_params(key: jax.Array, cfg: MoEConfig) -> dict[str, Any]:
+    """Parameter pytree. Expert weights are stacked on a leading E axis:
+
+      layers.<i>.moe.w_router [D, E]
+      layers.<i>.moe.{w_gate, w_up} [E, D, F]
+      layers.<i>.moe.w_down [E, F, D]
+    """
+    keys = iter(jax.random.split(key, 2 + cfg.n_layers * 8))
+    std = 1.0 / math.sqrt(cfg.dim)
+
+    def dense(k, shape, scale=std):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    params: dict[str, Any] = {
+        "embed": {"weight": dense(next(keys), (cfg.vocab_size, cfg.dim), 1.0)},
+        "layers": [],
+        "final_norm": {"weight": jnp.ones((cfg.dim,), cfg.dtype)},
+        "lm_head": {"weight": dense(next(keys), (cfg.dim, cfg.vocab_size))},
+    }
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    out_scale = std / math.sqrt(2 * cfg.n_layers)
+    for _ in range(cfg.n_layers):
+        params["layers"].append({
+            "attn_norm": {"weight": jnp.ones((cfg.dim,), cfg.dtype)},
+            "attn": {
+                "wq": dense(next(keys), (cfg.dim, cfg.dim)),
+                "wk": dense(next(keys), (cfg.dim, kv_dim)),
+                "wv": dense(next(keys), (cfg.dim, kv_dim)),
+                "wo": dense(next(keys), (cfg.dim, cfg.dim), out_scale),
+            },
+            "mlp_norm": {"weight": jnp.ones((cfg.dim,), cfg.dtype)},
+            "moe": {
+                "w_router": dense(next(keys), (cfg.dim, cfg.n_experts)),
+                "w_gate": dense(next(keys), (cfg.n_experts, cfg.dim, cfg.ffn_hidden)),
+                "w_up": dense(next(keys), (cfg.n_experts, cfg.dim, cfg.ffn_hidden)),
+                "w_down": dense(next(keys), (cfg.n_experts, cfg.ffn_hidden, cfg.dim),
+                                out_scale),
+            },
+        })
+    return params
+
+
+# ---------------------------------------------------------------------------
+# routing (dense dispatch/combine — static shapes, MXU-friendly)
+# ---------------------------------------------------------------------------
+
+
+def route_topk(
+    router_logits: jax.Array, cfg: MoEConfig
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k routing with per-expert capacity.
+
+    Returns (dispatch [T,E,C] bool, combine [T,E,C] f32, aux_loss scalar)
+    for T flattened tokens. Tokens over an expert's capacity are dropped
+    for that expert (standard Switch behavior; capacity_factor buys
+    headroom).
+    """
+    t, e = router_logits.shape
+    c = cfg.capacity(t)
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)  # [T,E]
+
+    # top-k expert ids per token -> one-hot [T,K,E]
+    _, topk_idx = jax.lax.top_k(probs, cfg.experts_per_token)
+    onehot = jax.nn.one_hot(topk_idx, e, dtype=jnp.float32)  # [T,K,E]
+    gate = jnp.einsum("tke,te->tk", onehot, probs)  # chosen probs
+    # normalize the chosen gates per token
+    gate = gate / jnp.clip(gate.sum(-1, keepdims=True), 1e-9)
+
+    # position of each (token, k) in its expert's queue, in token order
+    flat = onehot.reshape(t * cfg.experts_per_token, e)  # [T*K,E]
+    pos_in_expert = (jnp.cumsum(flat, axis=0) - flat).reshape(
+        t, cfg.experts_per_token, e
+    )  # [T,K,E]
+    within_cap = pos_in_expert < c
+    keep = onehot * within_cap  # [T,K,E]
+
+    cap_slot = jax.nn.one_hot(
+        jnp.einsum("tke->tk", pos_in_expert * onehot).astype(jnp.int32),
+        c, dtype=jnp.float32,
+    )  # [T,K,C]
+    dispatch = jnp.einsum("tke,tkc->tec", keep, cap_slot)  # [T,E,C]
+    combine = jnp.einsum("tke,tkc,tk->tec", keep, cap_slot, gate)
+
+    # load-balancing auxiliary loss (Switch eq. 4-6)
+    token_frac = jnp.mean(onehot.sum(1), axis=0)      # fraction routed per e
+    prob_frac = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(token_frac * prob_frac) / cfg.experts_per_token
+    return dispatch, combine, aux
+
+
+def moe_mlp_block(
+    layer: dict[str, Any], x: jax.Array, cfg: MoEConfig
+) -> tuple[jax.Array, jax.Array]:
+    """Sparse-MoE replacement for the dense MLP block. Returns
+    (residual output, aux loss)."""
+    b, s, d = x.shape
+    h = rmsnorm_reference(x, layer["mlp_norm"]["weight"], cfg.norm_eps)
+    flat = h.reshape(b * s, d)
+    logits = flat @ layer["moe"]["w_router"]  # [T,E]
+    dispatch, combine, aux = route_topk(logits, cfg)
+
+    # dispatch tokens into per-expert buffers: [E,C,D]
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, flat.astype(jnp.float32))
+    expert_in = expert_in.astype(cfg.dtype)
+    gate = jax.nn.silu(
+        jnp.einsum("ecd,edf->ecf", expert_in, layer["moe"]["w_gate"])
+        .astype(jnp.float32)
+    )
+    up = jnp.einsum("ecd,edf->ecf", expert_in, layer["moe"]["w_up"]).astype(
+        jnp.float32
+    )
+    expert_out = jnp.einsum(
+        "ecf,efd->ecd", (gate * up).astype(cfg.dtype), layer["moe"]["w_down"]
+    )  # [E,C,D]
+    y = jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
+    return x + y.reshape(b, s, d).astype(cfg.dtype), aux
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def forward(
+    params: dict[str, Any],
+    tokens: jax.Array,
+    cfg: MoEConfig,
+    cache: Optional[list[dict[str, jax.Array]]] = None,
+    positions: Optional[jax.Array] = None,
+    attn_fn=None,
+) -> tuple[jax.Array, Optional[list[dict[str, jax.Array]]], jax.Array]:
+    """Token ids [B,S] -> (logits [B,S,V], cache', total aux loss)."""
+    if attn_fn is None:
+        attn_fn = lambda q, k, v: attention(q, k, v, causal=True)  # noqa: E731
+    lcfg = cfg.as_llama()
+    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    x = params["embed"]["weight"][tokens].astype(cfg.dtype)
+    new_caches: Optional[list] = [] if cache is not None else None
+    aux_total = jnp.array(0.0, jnp.float32)
+    for i, layer in enumerate(params["layers"]):
+        layer_cache = cache[i] if cache is not None else None
+        x, updated = _attention_block(
+            layer, x, freqs, lcfg, layer_cache, positions, attn_fn
+        )
+        if new_caches is not None:
+            new_caches.append(updated)
+        x, aux = moe_mlp_block(layer, x, cfg)
+        aux_total = aux_total + aux
+    x = rmsnorm_reference(x, params["final_norm"]["weight"], cfg.norm_eps)
+    logits = x @ params["lm_head"]["weight"]
+    return logits.astype(jnp.float32), new_caches, aux_total
+
+
+def loss_fn(params, tokens, targets, cfg: MoEConfig,
+            aux_weight: float = 0.01) -> jax.Array:
+    logits, _, aux = forward(params, tokens, cfg)
+    ce = -jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), targets[..., None], axis=-1
+    ).mean()
+    return ce + aux_weight * aux / cfg.n_layers

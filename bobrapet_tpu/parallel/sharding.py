@@ -27,6 +27,7 @@ DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
 
 
 def _axes_in(mesh: Mesh) -> set[str]:
@@ -80,9 +81,45 @@ def llama_param_specs(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
     return specs
 
 
-def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
+def moe_param_specs(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
+    """PartitionSpecs for the MoE family: expert-stacked FFN weights
+    [E, D, F] put E on the ``expert`` axis (expert parallelism — XLA
+    inserts the dispatch/combine all-to-alls over ICI), F on ``model``
+    (TP inside each expert), D on ``fsdp``. Attention matches the dense
+    family; the router is replicated (tiny, read by every token)."""
+
+    def layer_spec(_layer: dict[str, Any]) -> dict[str, Any]:
+        return {
+            "attn_norm": {"weight": _p(mesh)},
+            "attn": {
+                "wq": _p(mesh, FSDP_AXIS, MODEL_AXIS),
+                "wk": _p(mesh, FSDP_AXIS, MODEL_AXIS),
+                "wv": _p(mesh, FSDP_AXIS, MODEL_AXIS),
+                "wo": _p(mesh, MODEL_AXIS, FSDP_AXIS),
+            },
+            "mlp_norm": {"weight": _p(mesh)},
+            "moe": {
+                "w_router": _p(mesh),
+                "w_gate": _p(mesh, EXPERT_AXIS, FSDP_AXIS, MODEL_AXIS),
+                "w_up": _p(mesh, EXPERT_AXIS, FSDP_AXIS, MODEL_AXIS),
+                "w_down": _p(mesh, EXPERT_AXIS, MODEL_AXIS, FSDP_AXIS),
+            },
+        }
+
+    return {
+        "embed": {"weight": _p(mesh, MODEL_AXIS, FSDP_AXIS)},
+        "layers": [layer_spec(layer) for layer in params["layers"]],
+        "final_norm": {"weight": _p(mesh)},
+        "lm_head": {"weight": _p(mesh, FSDP_AXIS, MODEL_AXIS)},
+    }
+
+
+def shard_params(
+    params: dict[str, Any], mesh: Mesh, specs: Optional[dict[str, Any]] = None
+) -> dict[str, Any]:
     """device_put the param pytree with its NamedShardings."""
-    specs = llama_param_specs(params, mesh)
+    if specs is None:
+        specs = llama_param_specs(params, mesh)
     return jax.tree_util.tree_map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         params,

@@ -26,13 +26,10 @@ def build_store(policy, base_dir: str = "/tmp/bobrapet-storage") -> Store:
     if policy is None:
         return FileStore(base_dir)
     if getattr(policy, "slice_local_ssd", None) is not None:
-        from .ssd import NativeUnavailable, SSDStore
+        from .ssd import make_ssd_store
 
         cfg = policy.slice_local_ssd
-        try:
-            return SSDStore(cfg.path, capacity_bytes=int(cfg.max_bytes or 0))
-        except NativeUnavailable:
-            return SliceLocalSSDStore(cfg.path)
+        return make_ssd_store(cfg.path, capacity_bytes=int(cfg.max_bytes or 0))
     if getattr(policy, "s3", None) is not None:
         return S3Store(bucket=policy.s3.bucket)
     if getattr(policy, "file", None) is not None and policy.file.path:

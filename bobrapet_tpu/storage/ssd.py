@@ -48,16 +48,25 @@ def _build() -> str:
             raise NativeUnavailable("native source and library both missing")
     except OSError as e:
         raise NativeUnavailable(str(e)) from e
+    # compile to a private temp path, then atomic-rename into place — a
+    # second process must never dlopen a half-written .so
+    tmp_so = f"{_SO}.build{os.getpid()}"
     cmd = [
         "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-        "-o", _SO, _SRC, "-pthread",
+        "-o", tmp_so, _SRC, "-pthread",
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp_so, _SO)
     except FileNotFoundError as e:
         raise NativeUnavailable("g++ not available") from e
     except subprocess.CalledProcessError as e:
         raise NativeUnavailable(f"native build failed: {e.stderr}") from e
+    except OSError as e:
+        raise NativeUnavailable(f"native build rename failed: {e}") from e
+    finally:
+        if os.path.exists(tmp_so):
+            os.unlink(tmp_so)
     return _SO
 
 
@@ -67,7 +76,10 @@ def load_native() -> ctypes.CDLL:
         if _lib is not None:
             return _lib
         so = _build()
-        lib = ctypes.CDLL(so)
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError as e:  # stale/incompatible/half-written .so
+            raise NativeUnavailable(f"cannot load native library: {e}") from e
         lib.bc_open.restype = ctypes.c_void_p
         lib.bc_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
         lib.bc_close.argtypes = [ctypes.c_void_p]
@@ -162,11 +174,17 @@ class SSDStore(Store):
         return self._lib.bc_exists(self._handle, key.encode()) == 1
 
     def list(self, prefix: str = "") -> list[str]:
+        # size-then-fill can race a concurrent put; loop until the fill
+        # call confirms the buffer was big enough
         needed = self._lib.bc_list(self._handle, prefix.encode(), None, 0)
-        if needed <= 1:
-            return []
-        buf = ctypes.create_string_buffer(int(needed))
-        self._lib.bc_list(self._handle, prefix.encode(), buf, int(needed))
+        while True:
+            if needed <= 1:
+                return []
+            buf = ctypes.create_string_buffer(int(needed))
+            got = self._lib.bc_list(self._handle, prefix.encode(), buf, int(needed))
+            if got <= needed:
+                break
+            needed = got
         text = buf.value.decode()
         return [k for k in text.split("\n") if k]
 
@@ -181,12 +199,16 @@ class SSDStore(Store):
 
 
 def make_ssd_store(base_dir: str, capacity_bytes: int = 0) -> Store:
-    """SSDStore when the native library is available, FileStore fallback
-    on the same mount otherwise (same semantics, no native speedup)."""
+    """SSDStore when the native library is available; otherwise the
+    Python slice-local fallback (same mount, same provider-tag family,
+    no native speedup). Both fallback paths — here and build_store —
+    MUST return the same store type so refs stay readable."""
     try:
         return SSDStore(base_dir, capacity_bytes)
     except NativeUnavailable as e:
-        _log.warning("native SSD store unavailable (%s); using FileStore", e)
-        from .store import FileStore
+        _log.warning(
+            "native SSD store unavailable (%s); using SliceLocalSSDStore", e
+        )
+        from .store import SliceLocalSSDStore
 
-        return FileStore(base_dir)
+        return SliceLocalSSDStore(base_dir)

@@ -20,6 +20,7 @@
 // entry points return status codes (0 ok, <0 error).
 
 #include <algorithm>
+#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
@@ -185,10 +186,18 @@ void rescan(Cache* c) {
     struct dirent* be;
     while ((be = readdir(sd)) != nullptr) {
       std::string name(be->d_name);
-      if (name.size() < 5 || name.substr(name.size() - 5) != ".blob") continue;
+      if (name[0] == '.') continue;
       std::string path = shard + "/" + name;
+      if (name.find(".tmp") != std::string::npos) {
+        ::unlink(path.c_str());  // crash leftovers must not leak disk
+        continue;
+      }
+      if (name.size() < 5 || name.substr(name.size() - 5) != ".blob") continue;
       std::string key;
-      if (read_blob_file(path, &key, nullptr) != kOk) continue;
+      if (read_blob_file(path, &key, nullptr) != kOk) {
+        ::unlink(path.c_str());  // unreadable blob: reclaim, don't leak
+        continue;
+      }
       struct stat st;
       if (stat(path.c_str(), &st) != 0) continue;
       CacheEntry e{path, static_cast<uint64_t>(st.st_size), 0};
@@ -243,21 +252,37 @@ int bc_put(void* handle, const char* key, const void* data, uint64_t len) {
   auto* c = static_cast<Cache*>(handle);
   if (!c || !key || (!data && len)) return kErrBadArg;
   std::string k(key);
-  std::lock_guard<std::mutex> lock(c->mu);
-
-  std::string shard = shard_dir(*c, k);
-  if (mkdir_p(shard) != kOk) return kErrIO;
-  std::string path = blob_path(*c, k);
-  std::string tmp = path + ".tmp";
 
   BlobHeader hdr{kMagic, static_cast<uint32_t>(k.size()), len,
                  checksum64(data, len)};
   uint64_t total = sizeof(hdr) + k.size() + len;
   if (c->capacity && total > c->capacity) return kErrTooSmall;
 
+  // Payload IO happens OUTSIDE the store-wide lock: a large put must not
+  // stall concurrent index lookups. The tmp name is unique per thread so
+  // two writers of the same key cannot clobber each other's staging file.
+  std::string shard = shard_dir(*c, k);
+  if (mkdir_p(shard) != kOk) return kErrIO;
+  std::string path = blob_path(*c, k);
+  static std::atomic<uint64_t> tmp_seq{0};
+  std::string tmp = path + ".tmp" +
+                    std::to_string(tmp_seq.fetch_add(1, std::memory_order_relaxed));
+
+  FILE* f = std::fopen(tmp.c_str(), "wb");
+  if (!f) return kErrIO;
+  bool ok = std::fwrite(&hdr, sizeof(hdr), 1, f) == 1 &&
+            (k.empty() || std::fwrite(k.data(), 1, k.size(), f) == k.size()) &&
+            (len == 0 || std::fwrite(data, 1, len, f) == len);
+  ok = std::fclose(f) == 0 && ok;
+  if (!ok) {
+    ::unlink(tmp.c_str());
+    return kErrIO;
+  }
+
+  std::lock_guard<std::mutex> lock(c->mu);
   // Remove the replaced entry from the index BEFORE eviction so it can
   // never be double-counted as an eviction victim; kept aside to restore
-  // on write failure (the old blob file is untouched until the rename).
+  // on rename failure (the old blob file is untouched until the rename).
   CacheEntry prev_entry;
   bool had_prev = false;
   auto prev = c->entries.find(k);
@@ -268,26 +293,12 @@ int bc_put(void* handle, const char* key, const void* data, uint64_t len) {
     c->entries.erase(prev);
   }
   evict_for(c, total);
-
-  auto rollback = [&]() {
+  if (std::rename(tmp.c_str(), path.c_str()) != 0) {
+    ::unlink(tmp.c_str());
     if (had_prev && c->entries.find(k) == c->entries.end()) {
       c->entries[k] = prev_entry;
       c->used += prev_entry.size;
     }
-  };
-
-  FILE* f = std::fopen(tmp.c_str(), "wb");
-  if (!f) {
-    rollback();
-    return kErrIO;
-  }
-  bool ok = std::fwrite(&hdr, sizeof(hdr), 1, f) == 1 &&
-            (k.empty() || std::fwrite(k.data(), 1, k.size(), f) == k.size()) &&
-            (len == 0 || std::fwrite(data, 1, len, f) == len);
-  ok = std::fclose(f) == 0 && ok;
-  if (!ok || std::rename(tmp.c_str(), path.c_str()) != 0) {
-    ::unlink(tmp.c_str());
-    rollback();
     return kErrIO;
   }
   c->entries[k] = CacheEntry{path, total, ++c->tick};
@@ -351,7 +362,10 @@ double bc_mtime(void* handle, const char* key) {
   std::lock_guard<std::mutex> lock(c->mu);
   auto it = c->entries.find(key);
   if (it == c->entries.end()) return -1.0;
-  return file_mtime(it->second.path);
+  double t = file_mtime(it->second.path);
+  // file vanished out-of-band under a live index entry: report missing,
+  // not epoch-0 "infinitely stale"
+  return t > 0.0 ? t : -1.0;
 }
 
 uint64_t bc_used_bytes(void* handle) {

@@ -1,0 +1,202 @@
+"""MoE family, embedding model, and the BASELINE config-5 RAG pipeline.
+
+Compute half: expert-parallel sharding on the virtual 8-device mesh
+(expert axis + model TP), routing invariants, gradient flow.
+Workflow half: nested executeStory RAG story (embed -> retrieve ->
+generate) through the full control plane with real tiny models.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bobrapet_tpu.models import embedder, llama, moe
+from bobrapet_tpu.parallel.sharding import moe_param_specs, shard_params
+
+
+class TestRouting:
+    def test_dispatch_combine_shapes_and_mass(self):
+        cfg = moe.moe_tiny()
+        t = 32
+        logits = jax.random.normal(jax.random.PRNGKey(0), (t, cfg.n_experts))
+        dispatch, combine, aux = moe.route_topk(logits, cfg)
+        c = cfg.capacity(t)
+        assert dispatch.shape == (t, cfg.n_experts, c)
+        assert combine.shape == (t, cfg.n_experts, c)
+        # every expert slot holds at most one token
+        assert float(dispatch.sum(axis=(0,))[0].max()) <= 1.0
+        # each token is dispatched at most k times, and combine mass per
+        # token is <= 1 (== 1 when nothing was capacity-dropped)
+        per_token = dispatch.sum(axis=(1, 2))
+        assert float(per_token.max()) <= cfg.experts_per_token
+        assert float(combine.sum(axis=(1, 2)).max()) <= 1.0 + 1e-5
+        assert float(aux) > 0.0
+
+    def test_capacity_drops_overflow(self):
+        cfg = moe.moe_tiny()
+        t = 16
+        # all tokens want expert 0 -> only `capacity` of them may land
+        logits = jnp.zeros((t, cfg.n_experts)).at[:, 0].set(100.0)
+        dispatch, _, _ = moe.route_topk(logits, cfg)
+        c = cfg.capacity(t)
+        assert float(dispatch[:, 0].sum()) <= c
+
+    def test_forward_and_grad(self):
+        cfg = moe.moe_tiny()
+        p = moe.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+        logits, _, aux = jax.jit(lambda p, t: moe.forward(p, t, cfg))(p, toks)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert jnp.isfinite(logits).all()
+        g = jax.grad(lambda p: moe.loss_fn(p, toks[:, :-1], toks[:, 1:], cfg))(p)
+        norms = jax.tree_util.tree_map(lambda x: float(jnp.abs(x).sum()), g)
+        router_grad = norms["layers"][0]["moe"]["w_router"]
+        assert router_grad > 0.0  # routing is differentiable via gates
+
+
+class TestExpertParallel:
+    def test_expert_sharded_forward_matches_replicated(self):
+        cfg = moe.moe_tiny()  # 4 experts
+        p = moe.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+        ref, _, _ = jax.jit(lambda p, t: moe.forward(p, t, cfg))(p, toks)
+
+        devs = np.array(jax.devices()[:8]).reshape(2, 4)
+        mesh = Mesh(devs, ("data", "expert"))
+        sharded = shard_params(p, mesh, specs=moe_param_specs(p, mesh))
+        tok_sharded = jax.device_put(toks, NamedSharding(mesh, P("data")))
+
+        @jax.jit
+        def run(params, tokens):
+            logits, _, _ = moe.forward(params, tokens, cfg)
+            return logits
+
+        out = run(sharded, tok_sharded)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+    def test_moe_specs_cover_tree(self):
+        cfg = moe.moe_tiny()
+        p = moe.init_params(jax.random.PRNGKey(0), cfg)
+        devs = np.array(jax.devices()[:4]).reshape(4)
+        mesh = Mesh(devs, ("expert",))
+        specs = moe_param_specs(p, mesh)
+        jax.tree_util.tree_map(
+            lambda x, s: None, p, specs,
+            is_leaf=lambda x: hasattr(x, "shape") and not isinstance(x, dict),
+        )  # mismatched structure would raise
+        assert specs["layers"][0]["moe"]["w_gate"] == P("expert")
+
+
+class TestEmbedder:
+    def test_encode_normalized_and_deterministic(self):
+        cfg = embedder.embed_tiny()
+        p = embedder.init_params(jax.random.PRNGKey(0), cfg)
+        docs = jax.random.randint(jax.random.PRNGKey(1), (3, 12), 0, cfg.vocab_size)
+        e1 = embedder.encode(p, docs, cfg)
+        e2 = embedder.encode(p, docs, cfg)
+        np.testing.assert_allclose(np.asarray(e1), np.asarray(e2))
+        np.testing.assert_allclose(
+            np.asarray(jnp.linalg.norm(e1, axis=-1)), np.ones(3), atol=1e-5
+        )
+
+    def test_mask_changes_pooling(self):
+        cfg = embedder.embed_tiny()
+        p = embedder.init_params(jax.random.PRNGKey(0), cfg)
+        docs = jax.random.randint(jax.random.PRNGKey(1), (1, 12), 0, cfg.vocab_size)
+        full = embedder.encode(p, docs, cfg)
+        half = embedder.encode(
+            p, docs, cfg, mask=jnp.arange(12)[None, :] < 6
+        )
+        assert float(jnp.abs(full - half).max()) > 1e-6
+
+    def test_retrieval_selfmatch(self):
+        cfg = embedder.embed_tiny()
+        p = embedder.init_params(jax.random.PRNGKey(0), cfg)
+        docs = jax.random.randint(jax.random.PRNGKey(1), (6, 12), 0, cfg.vocab_size)
+        emb = embedder.encode(p, docs, cfg)
+        _, idx = embedder.cosine_topk(emb, emb, k=1)
+        assert [int(i) for i in idx[:, 0]] == list(range(6))
+
+
+class TestRAGPipeline:
+    def test_nested_executestory_rag(self, rt):
+        """BASELINE config 5 shape: an outer story whose retrieve stage is
+        a nested executeStory (embed -> retrieve), feeding generation."""
+        from bobrapet_tpu.api.catalog import make_engram_template
+        from bobrapet_tpu.api.engram import make_engram
+        from bobrapet_tpu.api.story import make_story
+        from bobrapet_tpu.sdk import register_engram
+
+        ecfg = embedder.embed_tiny()
+        eparams = embedder.init_params(jax.random.PRNGKey(0), ecfg)
+        corpus_tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 12), 0, ecfg.vocab_size
+        )
+        corpus_emb = embedder.encode(eparams, corpus_tokens, ecfg)
+
+        gcfg = llama.llama_tiny()
+        gparams = llama.init_params(jax.random.PRNGKey(2), gcfg)
+
+        for n, ep in (("embedder", "rag-embed"), ("retriever", "rag-retrieve"),
+                      ("generator", "rag-generate")):
+            rt.apply(make_engram_template(f"{n}-tpl", entrypoint=ep))
+            rt.apply(make_engram(n, f"{n}-tpl"))
+
+        @register_engram("rag-embed")
+        def embed_impl(ctx):
+            # embed the "query" (deterministic token ids from its hash)
+            seed = abs(hash(ctx.inputs["query"])) % (2**31)
+            q = jax.random.randint(
+                jax.random.PRNGKey(seed), (1, 12), 0, ecfg.vocab_size
+            )
+            vec = embedder.encode(eparams, q, ecfg)
+            return {"vector": np.asarray(vec[0]).tolist()}
+
+        @register_engram("rag-retrieve")
+        def retrieve_impl(ctx):
+            q = jnp.asarray([ctx.inputs["vector"]], jnp.float32)
+            _, idx = embedder.cosine_topk(q, corpus_emb, k=3)
+            return {"docIds": [int(i) for i in idx[0]]}
+
+        @register_engram("rag-generate")
+        def generate_impl(ctx):
+            ids = ctx.inputs["docIds"]
+            prompt = jnp.asarray(
+                [[i % gcfg.vocab_size for i in ids] + [1, 2]], jnp.int32
+            )
+            toks = llama.greedy_generate(gparams, prompt, gcfg, max_new_tokens=4)
+            return {"tokens": np.asarray(toks[0]).tolist(), "nDocs": len(ids)}
+
+        # inner story: embed -> retrieve
+        rt.apply(make_story("retrieve-docs", steps=[
+            {"name": "embed", "ref": {"name": "embedder"},
+             "with": {"query": "{{ inputs.query }}"}},
+            {"name": "retrieve", "ref": {"name": "retriever"},
+             "with": {"vector": "{{ steps.embed.output.vector }}"}},
+        ], output={"docIds": "{{ steps.retrieve.output.docIds }}"}))
+
+        # outer story: executeStory(retrieve-docs) -> generate
+        rt.apply(make_story("rag", steps=[
+            {"name": "lookup", "type": "executeStory",
+             "with": {"storyRef": {"name": "retrieve-docs"},
+                      "with": {"query": "{{ inputs.question }}"}}},
+            {"name": "answer", "ref": {"name": "generator"},
+             "with": {"docIds": "{{ steps.lookup.output.docIds }}"}},
+        ], output={"tokens": "{{ steps.answer.output.tokens }}",
+                   "nDocs": "{{ steps.answer.output.nDocs }}"}))
+
+        run = rt.run_story("rag", inputs={"question": "what is a bobrapet?"})
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        out = rt.run_output(run)
+        assert out["nDocs"] == 3
+        assert len(out["tokens"]) == 4
+        # the nested run exists and completed
+        subruns = [
+            r for r in rt.store.list("StoryRun")
+            if (r.spec.get("storyRef") or {}).get("name") == "retrieve-docs"
+        ]
+        assert len(subruns) == 1
+        assert subruns[0].status["phase"] == "Succeeded"
