@@ -81,6 +81,9 @@ def make_train_step(
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
+    # expose the sequence-parallel decision so callers (driver dryrun)
+    # can assert the seq axis is genuinely exercised, not just declared
+    train_step.ring_active = ring
     return train_step
 
 
