@@ -225,3 +225,31 @@ class AcceleratorType(StrEnum):
     TPU_V5E = "tpu-v5-lite-podslice"
     TPU_V5P = "tpu-v5p-slice"
     TPU_V6E = "tpu-v6e-slice"
+
+
+#: Peak dense bf16 FLOP/s per chip (public spec-sheet numbers) — the
+#: denominator for MFU reporting in bench.py. CPU has no meaningful MXU
+#: peak, so it is absent (benchmarks report MFU only on TPU).
+PEAK_BF16_FLOPS: dict[AcceleratorType, float] = {
+    AcceleratorType.TPU_V4: 275e12,
+    AcceleratorType.TPU_V5E: 197e12,
+    AcceleratorType.TPU_V5P: 459e12,
+    AcceleratorType.TPU_V6E: 918e12,
+}
+
+
+def accelerator_from_device_kind(device_kind: str) -> AcceleratorType | None:
+    """Map a jax ``Device.device_kind`` string (e.g. ``"TPU v5 lite"``,
+    ``"TPU v5e"``) onto the GKE accelerator family, or None if unknown."""
+    kind = device_kind.lower().replace(" ", "")
+    if "v5lite" in kind or "v5e" in kind:
+        return AcceleratorType.TPU_V5E
+    # real v5p hardware reports device_kind "TPU v5" (v5e is "TPU v5 lite",
+    # already matched above), so bare v5 means v5p
+    if "v5p" in kind or "v5" in kind:
+        return AcceleratorType.TPU_V5P
+    if "v6" in kind:
+        return AcceleratorType.TPU_V6E
+    if "v4" in kind:
+        return AcceleratorType.TPU_V4
+    return None

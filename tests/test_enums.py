@@ -63,3 +63,23 @@ def test_workload_realtime():
 def test_enums_serialize_as_strings():
     assert str(Phase.RUNNING) == "Running"
     assert Phase("Running") is Phase.RUNNING
+
+
+def test_accelerator_from_device_kind():
+    from bobrapet_tpu.api.enums import (
+        PEAK_BF16_FLOPS,
+        AcceleratorType,
+        accelerator_from_device_kind,
+    )
+
+    assert accelerator_from_device_kind("TPU v5 lite") == AcceleratorType.TPU_V5E
+    assert accelerator_from_device_kind("TPU v5e") == AcceleratorType.TPU_V5E
+    assert accelerator_from_device_kind("TPU v5p") == AcceleratorType.TPU_V5P
+    assert accelerator_from_device_kind("TPU v5") == AcceleratorType.TPU_V5P
+    assert accelerator_from_device_kind("TPU v4") == AcceleratorType.TPU_V4
+    assert accelerator_from_device_kind("TPU v6e") == AcceleratorType.TPU_V6E
+    assert accelerator_from_device_kind("cpu") is None
+    # every TPU family has a peak-FLOPs entry for MFU
+    for accel in AcceleratorType:
+        if accel != AcceleratorType.CPU:
+            assert accel in PEAK_BF16_FLOPS
