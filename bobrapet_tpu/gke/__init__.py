@@ -1,0 +1,32 @@
+"""GKE materialization: turn bus resources + slice grants into
+`kubectl apply`-able Kubernetes manifests.
+
+The in-process control plane schedules steps as ``Job``/``Deployment``
+bus resources executed by the local gang executor; on GKE the same facts
+materialize as real workload manifests — Indexed Jobs (JobSet-style
+multi-host TPU gangs) with ``google.com/tpu`` limits,
+``cloud.google.com/gke-tpu-topology``/``gke-tpu-accelerator`` node
+selectors, headless Services for worker discovery, and the
+completion-index → ``TPU_WORKER_ID`` env contract.
+
+Reference counterpart: ``pkg/podspec/builder.go:97`` (pod template
+construction) + ``internal/controller/runs/steprun_controller.go:1784``
+(buildJobSpec); the TPU topology half is new TPU-native work.
+"""
+
+from .materialize import (
+    GKEMaterializer,
+    materialize_deployment,
+    materialize_gang_job,
+    to_yaml,
+)
+from .podspec import PodConfig, build_pod_template
+
+__all__ = [
+    "GKEMaterializer",
+    "PodConfig",
+    "build_pod_template",
+    "materialize_deployment",
+    "materialize_gang_job",
+    "to_yaml",
+]

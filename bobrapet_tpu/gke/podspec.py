@@ -1,0 +1,136 @@
+"""Pod template construction (reference: pkg/podspec/builder.go:97).
+
+Manifests are plain dicts in Kubernetes API shape — JSON/YAML-ready,
+no client library required. The builder covers the shared surface the
+reference's ``podspec.Config`` carries (container name, labels,
+annotations, env, env-from, volumes, mounts, ports, probes, security
+context, resources, restart policy, termination grace) and is the base
+both the Job and Deployment materializers layer TPU facts onto.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class PodConfig:
+    """Everything needed to render one pod template.
+
+    Mirrors the reference's podspec.Config field-for-capability; the
+    ``resources``/probe/security fields correspond to its
+    ResolvedExecutionConfig half.
+    """
+
+    container_name: str = "engram"
+    image: str = ""
+    image_pull_policy: str = "IfNotPresent"
+    command: Optional[list[str]] = None
+    args: Optional[list[str]] = None
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: dict[str, str] = dataclasses.field(default_factory=dict)
+    env: list[dict[str, Any]] = dataclasses.field(default_factory=list)
+    env_from: list[dict[str, Any]] = dataclasses.field(default_factory=list)
+    volumes: list[dict[str, Any]] = dataclasses.field(default_factory=list)
+    volume_mounts: list[dict[str, Any]] = dataclasses.field(default_factory=list)
+    ports: list[dict[str, Any]] = dataclasses.field(default_factory=list)
+    resources: dict[str, Any] = dataclasses.field(default_factory=dict)
+    node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
+    tolerations: list[dict[str, Any]] = dataclasses.field(default_factory=list)
+    liveness_probe: Optional[dict[str, Any]] = None
+    readiness_probe: Optional[dict[str, Any]] = None
+    startup_probe: Optional[dict[str, Any]] = None
+    security_context: Optional[dict[str, Any]] = None
+    pod_security_context: Optional[dict[str, Any]] = None
+    restart_policy: Optional[str] = None
+    termination_grace_period_seconds: Optional[int] = None
+    service_account_name: Optional[str] = None
+    automount_service_account_token: Optional[bool] = None
+    subdomain: Optional[str] = None
+    host_network: Optional[bool] = None
+    scheduler_name: Optional[str] = None
+    priority_class_name: Optional[str] = None
+
+
+def env_var(name: str, value: str) -> dict[str, Any]:
+    return {"name": name, "value": str(value)}
+
+
+def env_field_ref(name: str, field_path: str) -> dict[str, Any]:
+    """Downward-API env var (reference buildBaseEnvVars exposes pod
+    metadata the same way, steprun_controller.go:1725)."""
+    return {"name": name, "valueFrom": {"fieldRef": {"fieldPath": field_path}}}
+
+
+def env_from_dict(env: dict[str, str]) -> list[dict[str, Any]]:
+    """Render a flat {name: value} env mapping as k8s EnvVar list,
+    sorted for deterministic manifests."""
+    return [env_var(k, v) for k, v in sorted(env.items())]
+
+
+def build_pod_template(cfg: PodConfig) -> dict[str, Any]:
+    """PodTemplateSpec dict from PodConfig (reference Build, builder.go:97)."""
+    container: dict[str, Any] = {
+        "name": cfg.container_name,
+        "image": cfg.image,
+        "imagePullPolicy": cfg.image_pull_policy,
+    }
+    if cfg.command:
+        container["command"] = list(cfg.command)
+    if cfg.args:
+        container["args"] = list(cfg.args)
+    if cfg.env:
+        container["env"] = list(cfg.env)
+    if cfg.env_from:
+        container["envFrom"] = list(cfg.env_from)
+    if cfg.ports:
+        container["ports"] = list(cfg.ports)
+    if cfg.volume_mounts:
+        container["volumeMounts"] = list(cfg.volume_mounts)
+    if cfg.resources:
+        container["resources"] = cfg.resources
+    if cfg.liveness_probe:
+        container["livenessProbe"] = cfg.liveness_probe
+    if cfg.readiness_probe:
+        container["readinessProbe"] = cfg.readiness_probe
+    if cfg.startup_probe:
+        container["startupProbe"] = cfg.startup_probe
+    if cfg.security_context:
+        container["securityContext"] = cfg.security_context
+
+    spec: dict[str, Any] = {"containers": [container]}
+    if cfg.volumes:
+        spec["volumes"] = list(cfg.volumes)
+    if cfg.node_selector:
+        spec["nodeSelector"] = dict(cfg.node_selector)
+    if cfg.tolerations:
+        spec["tolerations"] = list(cfg.tolerations)
+    if cfg.restart_policy:
+        spec["restartPolicy"] = cfg.restart_policy
+    if cfg.termination_grace_period_seconds is not None:
+        spec["terminationGracePeriodSeconds"] = cfg.termination_grace_period_seconds
+    if cfg.service_account_name:
+        spec["serviceAccountName"] = cfg.service_account_name
+    if cfg.automount_service_account_token is not None:
+        spec["automountServiceAccountToken"] = cfg.automount_service_account_token
+    if cfg.pod_security_context:
+        spec["securityContext"] = cfg.pod_security_context
+    if cfg.subdomain:
+        spec["subdomain"] = cfg.subdomain
+    if cfg.host_network is not None:
+        spec["hostNetwork"] = cfg.host_network
+    if cfg.scheduler_name:
+        spec["schedulerName"] = cfg.scheduler_name
+    if cfg.priority_class_name:
+        spec["priorityClassName"] = cfg.priority_class_name
+
+    template: dict[str, Any] = {"spec": spec}
+    metadata: dict[str, Any] = {}
+    if cfg.labels:
+        metadata["labels"] = dict(cfg.labels)
+    if cfg.annotations:
+        metadata["annotations"] = dict(cfg.annotations)
+    if metadata:
+        template["metadata"] = metadata
+    return template

@@ -553,3 +553,23 @@ class Runtime:
     def run_output(self, run_name: str, namespace: str = "default"):
         run = self.store.try_get(STORY_RUN_KIND, namespace, run_name)
         return run.status.get("output") if run is not None else None
+
+    def export_gke_manifests(
+        self, namespace: str = "default", materializer=None
+    ) -> list[dict]:
+        """Materialize every Job/Deployment bus resource in a namespace
+        into `kubectl apply`-able manifests (the GKE half of the
+        control plane — see :mod:`bobrapet_tpu.gke`)."""
+        from .controllers.jobs import JOB_KIND
+        from .controllers.streaming import DEPLOYMENT_KIND, STATEFULSET_KIND
+        from .gke import GKEMaterializer
+
+        m = materializer or GKEMaterializer()
+        manifests: list[dict] = []
+        for job in self.store.list(JOB_KIND, namespace):
+            manifests.extend(m.materialize_job(job))
+        for dep in self.store.list(DEPLOYMENT_KIND, namespace):
+            manifests.extend(m.materialize_deployment(dep))
+        for sts in self.store.list(STATEFULSET_KIND, namespace):
+            manifests.extend(m.materialize_deployment(sts, kind="StatefulSet"))
+        return manifests
