@@ -40,6 +40,7 @@ NODE_SELECTOR_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
 NODE_SELECTOR_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
 TPU_RESOURCE = "google.com/tpu"
 COMPLETION_INDEX_ANNOTATION = "batch.kubernetes.io/job-completion-index"
+JOBSET_REPLICATED_JOB = "gang"
 
 DEFAULT_COORDINATOR_PORT = 8476  # jax.distributed default
 
@@ -74,6 +75,9 @@ def headless_service(
         "spec": {
             "clusterIP": "None",
             "selector": dict(selector),
+            # workers resolve the coordinator before it is Ready —
+            # without this, jax.distributed.initialize races pod readiness
+            "publishNotReadyAddresses": True,
             "ports": ports
             or [{"name": "coordinator", "port": DEFAULT_COORDINATOR_PORT}],
         },
@@ -132,7 +136,11 @@ def materialize_gang_job(
         pod_resources["limits"] = limits
         pod_resources["requests"] = requests
 
-        hostnames = worker_hostnames(name, svc_name, hosts)
+        # Indexed Job pods are hostnamed <job>-<index>; under a JobSet
+        # the child job is named <jobset>-<replicatedJob>-<jobIndex>, so
+        # worker DNS names must be derived from the CHILD job's name
+        pod_job_name = f"{name}-{JOBSET_REPLICATED_JOB}-0" if jobset else name
+        hostnames = worker_hostnames(pod_job_name, svc_name, hosts)
         full_env[contract.ENV_TPU_WORKER_HOSTNAMES] = ",".join(hostnames)
         full_env[contract.ENV_COORDINATOR_ADDRESS] = (
             f"{hostnames[0]}:{coordinator_port}"
@@ -232,7 +240,8 @@ def _wrap_jobset(
         "spec": {
             "failurePolicy": {"maxRestarts": 0},
             "replicatedJobs": [
-                {"name": "gang", "replicas": 1, "template": {"spec": inner}}
+                {"name": JOBSET_REPLICATED_JOB, "replicas": 1,
+                 "template": {"spec": inner}}
             ],
         },
     }

@@ -1,0 +1,314 @@
+"""Sharded model checkpointing into the blob Store (SURVEY §5.4:
+"JAX/Orbax-style model checkpointing enters on the SDK side").
+
+The workflow half of checkpoint/resume (durable StepRun state, redrive)
+lives in the controllers (reference: storyrun_controller.go:295-807);
+this module is the *model* half: save/restore of a whole train-state
+pytree — (params, opt_state, step) or anything tree-like — against the
+same storage providers run payloads use (SSD/S3/file/memory), so a
+redriven training step resumes instead of re-initializing.
+
+Layout under ``<prefix>/ckpt-<step>/``:
+
+- ``manifest.json`` — pytree paths, per-leaf dtype/shape, saved shard
+  index ranges, step number
+- ``leaf-<i>/<shard-key>`` — raw little-endian bytes, one blob per
+  *unique* shard index (replicas dedup'd; multi-controller gangs write
+  disjoint addressable shards into a shared store)
+
+Restore is resharding-aware: arrays are reassembled with
+``jax.make_array_from_callback`` under the *target* sharding, stitching
+saved shard blobs to cover whatever index ranges the new mesh asks for —
+a checkpoint saved on one mesh restores onto another (the Orbax
+restore-args pattern, without the filesystem dependency).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+import numpy as np
+
+from ..storage.store import BlobNotFound, Store
+
+MANIFEST_PREFIX = "manifest-"
+
+
+def _manifest_key(process: int) -> str:
+    return f"{MANIFEST_PREFIX}{process:05d}.json"
+
+
+def _leaf_paths(tree: Any) -> tuple[list[tuple[str, Any]], Any]:
+    """Flatten to [(path_string, leaf)] + treedef."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        out.append((jax.tree_util.keystr(path), leaf))
+    return out, treedef
+
+
+def _shard_key(index: tuple, shape: tuple[int, ...]) -> str:
+    """Canonical key for a shard's global index: 'start-stop_start-stop'."""
+    parts = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        parts.append(f"{start}-{stop}")
+    return "_".join(parts) if parts else "scalar"
+
+
+def _parse_shard_key(key: str) -> list[tuple[int, int]]:
+    if key == "scalar":
+        return []
+    return [tuple(int(x) for x in p.split("-")) for p in key.split("_")]
+
+
+def save_checkpoint(
+    store: Store,
+    prefix: str,
+    state: Any,
+    step: int,
+    keep: int = 2,
+    process: Optional[int] = None,
+) -> str:
+    """Write one checkpoint; returns its key prefix.
+
+    Each process writes only its addressable shards (deduplicated by
+    global index) plus its OWN ``manifest-<process>.json`` — restore
+    unions all processes' manifests, so gang hosts sharing a store
+    cooperatively produce one complete checkpoint without clobbering
+    each other's shard listings. Completeness across hosts is the
+    caller's barrier (the gang executor's all-or-nothing step semantics
+    provide it: a step isn't Succeeded until every host returned).
+    Old checkpoints beyond ``keep`` are pruned.
+    """
+    import jax
+
+    if process is None:
+        process = jax.process_index()
+    ckpt = f"{prefix}/ckpt-{step:012d}"
+    leaves, treedef = _leaf_paths(state)
+    manifest: dict[str, Any] = {
+        "step": int(step),
+        "treedef": str(treedef),
+        "leaves": [],
+    }
+    for i, (path, leaf) in enumerate(leaves):
+        arr_shards: list[tuple[str, np.ndarray]] = []
+        if isinstance(leaf, jax.Array):
+            shape = leaf.shape
+            dtype = str(leaf.dtype)
+            seen: set[str] = set()
+            for shard in leaf.addressable_shards:
+                key = _shard_key(shard.index, shape)
+                if key in seen:
+                    continue
+                seen.add(key)
+                arr_shards.append((key, np.asarray(shard.data)))
+        else:
+            arr = np.asarray(leaf)
+            shape = arr.shape
+            dtype = str(arr.dtype)
+            arr_shards.append((_shard_key((), shape) if arr.ndim == 0
+                               else _shard_key(tuple(slice(0, d) for d in shape), shape),
+                               arr))
+        for key, data in arr_shards:
+            # raw little-endian bytes; bfloat16 has no portable npy
+            # representation, so dtype travels in the manifest instead
+            store.put(f"{ckpt}/leaf-{i}/{key}", np.ascontiguousarray(data).tobytes())
+        manifest["leaves"].append({
+            "path": path,
+            "index": i,
+            "shape": list(shape),
+            "dtype": dtype,
+            "shards": [k for k, _ in arr_shards],
+        })
+    store.put(f"{ckpt}/{_manifest_key(process)}",
+              json.dumps(manifest, separators=(",", ":")).encode())
+
+    if keep > 0:
+        steps = sorted(checkpoint_steps(store, prefix))
+        for old in steps[:-keep]:
+            delete_checkpoint(store, prefix, old)
+    return ckpt
+
+
+def _load_merged_manifest(store: Store, ckpt: str) -> dict[str, Any]:
+    """Union all processes' manifests: same structure, shard lists merged."""
+    keys = [k for k in store.list(f"{ckpt}/{MANIFEST_PREFIX}")]
+    if not keys:
+        raise BlobNotFound(f"{ckpt}/{MANIFEST_PREFIX}*")
+    merged: Optional[dict[str, Any]] = None
+    for key in keys:
+        m = json.loads(store.get(key))
+        if merged is None:
+            merged = m
+            continue
+        if m["treedef"] != merged["treedef"] or len(m["leaves"]) != len(merged["leaves"]):
+            raise StorageMismatch(
+                f"{ckpt}: manifests disagree on checkpoint structure"
+            )
+        for ours, theirs in zip(merged["leaves"], m["leaves"]):
+            if ours["path"] != theirs["path"] or ours["shape"] != theirs["shape"]:
+                raise StorageMismatch(
+                    f"{ckpt}: manifests disagree on leaf {ours['path']!r}"
+                )
+            for shard in theirs["shards"]:
+                if shard not in ours["shards"]:
+                    ours["shards"].append(shard)
+    return merged
+
+
+def checkpoint_steps(store: Store, prefix: str) -> list[int]:
+    """Steps with a manifest-bearing checkpoint, ascending."""
+    steps = set()
+    for key in store.list(f"{prefix}/ckpt-"):
+        tail = key[len(prefix) + 1:]
+        if f"/{MANIFEST_PREFIX}" in tail:
+            steps.add(int(tail.split("/")[0].removeprefix("ckpt-")))
+    return sorted(steps)
+
+
+def latest_checkpoint_step(store: Store, prefix: str) -> Optional[int]:
+    steps = checkpoint_steps(store, prefix)
+    return steps[-1] if steps else None
+
+
+def delete_checkpoint(store: Store, prefix: str, step: int) -> None:
+    ckpt = f"{prefix}/ckpt-{step:012d}"
+    for key in store.list(ckpt):
+        store.delete(key)
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+    return np.dtype(name)
+
+
+def _stitch(
+    store: Store,
+    ckpt: str,
+    entry: dict[str, Any],
+    want: list[tuple[int, int]],
+) -> np.ndarray:
+    """Assemble the requested global index range from saved shard blobs."""
+    dtype = _np_dtype(entry["dtype"])
+    shape = tuple(entry["shape"])
+    out_shape = tuple(stop - start for start, stop in want)
+    i = entry["index"]
+
+    # fast path: exact shard match (bytearray copy keeps the result
+    # writable — frombuffer over bytes would be read-only)
+    exact = "_".join(f"{a}-{b}" for a, b in want) if want else "scalar"
+    if exact in entry["shards"]:
+        data = store.get(f"{ckpt}/leaf-{i}/{exact}")
+        return np.frombuffer(bytearray(data), dtype=dtype).reshape(out_shape)
+
+    out = np.empty(out_shape, dtype=dtype)
+    filled = 0
+    for key in entry["shards"]:
+        ranges = _parse_shard_key(key)
+        overlap = []
+        for (ws, we), (ss, se) in zip(want, ranges):
+            s, e = max(ws, ss), min(we, se)
+            if s >= e:
+                overlap = None
+                break
+            overlap.append((s, e, ss, ws))
+        if overlap is None:
+            continue
+        data = store.get(f"{ckpt}/leaf-{i}/{key}")
+        shard = np.frombuffer(data, dtype=dtype).reshape(
+            tuple(se - ss for ss, se in ranges)
+        )
+        src = tuple(slice(s - ss, e - ss) for (s, e, ss, _ws) in overlap)
+        dst = tuple(slice(s - ws, e - ws) for (s, e, _ss, ws) in overlap)
+        out[dst] = shard[src]
+        n = 1
+        for s, e, _, _ in overlap:
+            n *= e - s
+        filled += n
+    total = 1
+    for s in out_shape:
+        total *= s
+    if filled < total:
+        raise BlobNotFound(
+            f"{ckpt}/leaf-{i}: saved shards cover {filled}/{total} elements "
+            f"of requested range {want} (shape {shape})"
+        )
+    return out
+
+
+def restore_checkpoint(
+    store: Store,
+    prefix: str,
+    like: Any,
+    step: Optional[int] = None,
+) -> tuple[Any, int]:
+    """Load a checkpoint into the structure/shardings of ``like``.
+
+    ``like`` supplies the pytree structure and, for jax.Array leaves,
+    the target sharding each restored array is placed with (pass your
+    freshly-initialized train state — its values are discarded).
+    Returns (state, step). Raises BlobNotFound when no checkpoint exists.
+    """
+    import jax
+
+    if step is None:
+        step = latest_checkpoint_step(store, prefix)
+        if step is None:
+            raise BlobNotFound(f"{prefix}: no checkpoint found")
+    ckpt = f"{prefix}/ckpt-{step:012d}"
+    manifest = _load_merged_manifest(store, ckpt)
+
+    leaves, treedef = _leaf_paths(like)
+    entries = manifest["leaves"]
+    if len(entries) != len(leaves):
+        raise StorageMismatch(
+            f"{ckpt}: checkpoint has {len(entries)} leaves, "
+            f"target structure has {len(leaves)}"
+        )
+
+    restored = []
+    for (path, leaf), entry in zip(leaves, entries):
+        if entry["path"] != path:
+            raise StorageMismatch(
+                f"{ckpt}: leaf order mismatch — saved {entry['path']!r}, "
+                f"target {path!r}"
+            )
+        shape = tuple(entry["shape"])
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding") and shape:
+            sharding = leaf.sharding
+
+            def cb(index, _entry=entry, _shape=shape):
+                want = [
+                    (0 if sl.start is None else int(sl.start),
+                     dim if sl.stop is None else int(sl.stop))
+                    for sl, dim in zip(index, _shape)
+                ]
+                return _stitch(store, ckpt, _entry, want)
+
+            arr = jax.make_array_from_callback(shape, sharding, cb)
+        else:
+            full = [(0, d) for d in shape]
+            data = _stitch(store, ckpt, entry, full)
+            arr = (
+                jax.device_put(data, getattr(leaf, "sharding", None))
+                if isinstance(leaf, jax.Array)
+                else np.asarray(data).reshape(shape)
+            )
+            if not shape and not isinstance(leaf, (jax.Array, np.ndarray)):
+                # plain python scalar leaf (e.g. int step counters)
+                arr = arr.item() if hasattr(arr, "item") else arr
+        restored.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, restored), int(manifest["step"])
+
+
+class StorageMismatch(Exception):
+    """Checkpoint structure does not match the restore target."""

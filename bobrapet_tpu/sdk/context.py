@@ -230,6 +230,56 @@ class EngramContext:
 
         self._store.patch_status("StepRun", self.namespace, self.step_run, patch)
 
+    # -- model checkpointing ----------------------------------------------
+
+    @property
+    def checkpoint_prefix(self) -> str:
+        """Blob-key prefix for this step's model checkpoints — stable
+        across retries AND redrives (keyed on run + step id, not the
+        StepRun instance), so a redriven training step finds its
+        predecessor's state (SURVEY §5.4)."""
+        from ..storage.manager import StorageManager
+
+        return StorageManager.step_key(
+            self.namespace, self.story_run, self.step, "model-ckpt"
+        )
+
+    def save_model_checkpoint(self, state: Any, step: int, keep: int = 2) -> str:
+        """Sharded save of a train-state pytree (params/opt_state/...)
+        into the run's storage provider; see sdk/checkpoint.py."""
+        if self._storage is None:
+            raise RuntimeError("no storage manager configured for checkpoints")
+        from .checkpoint import save_checkpoint
+
+        return save_checkpoint(
+            self._storage.store, self.checkpoint_prefix, state, step, keep=keep
+        )
+
+    def restore_model_checkpoint(
+        self, like: Any, step: Optional[int] = None
+    ) -> Optional[tuple[Any, int]]:
+        """(state, step) from the latest (or given) checkpoint, restored
+        onto ``like``'s structure/shardings; None when no checkpoint
+        exists (fresh start)."""
+        if self._storage is None:
+            return None
+        from ..storage.store import BlobNotFound
+        from .checkpoint import restore_checkpoint
+
+        try:
+            return restore_checkpoint(
+                self._storage.store, self.checkpoint_prefix, like, step=step
+            )
+        except BlobNotFound:
+            return None
+
+    def latest_model_checkpoint_step(self) -> Optional[int]:
+        if self._storage is None:
+            return None
+        from .checkpoint import latest_checkpoint_step
+
+        return latest_checkpoint_step(self._storage.store, self.checkpoint_prefix)
+
     @property
     def log(self) -> logging.Logger:
         return logging.getLogger(f"engram.{self.step}")

@@ -1,0 +1,242 @@
+"""SDK model checkpointing: sharded save/restore into the blob Store.
+
+VERDICT r1 missing #4 / SURVEY §5.4: a redriven training step must
+resume from checkpointed state instead of re-initializing. Covers
+shard-dedup'd save, resharding restore across different meshes, pruning,
+and the e2e kill→redrive→resume story.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bobrapet_tpu.sdk.checkpoint import (
+    checkpoint_steps,
+    latest_checkpoint_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from bobrapet_tpu.storage.store import BlobNotFound, MemoryStore
+
+
+def _mesh(axes):
+    devs = jax.devices("cpu")
+    n = 1
+    for v in axes.values():
+        n *= v
+    return Mesh(
+        np.array(devs[:n]).reshape(tuple(axes.values())), tuple(axes.keys())
+    )
+
+
+def _sharded(mesh, spec, shape, seed=0):
+    arr = jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+class TestRoundTrip:
+    def test_replicated_and_sharded_leaves(self):
+        store = MemoryStore()
+        mesh = _mesh({"data": 2, "model": 4})
+        state = {
+            "w": _sharded(mesh, P("data", "model"), (8, 16), seed=1),
+            "b": _sharded(mesh, P(), (16,), seed=2),
+            "step_count": jnp.array(7, jnp.int32),
+        }
+        save_checkpoint(store, "ck", state, step=7)
+
+        like = jax.tree_util.tree_map(jnp.zeros_like, state)
+        like = {
+            "w": jax.device_put(like["w"], NamedSharding(mesh, P("data", "model"))),
+            "b": jax.device_put(like["b"], NamedSharding(mesh, P())),
+            "step_count": like["step_count"],
+        }
+        restored, step = restore_checkpoint(store, "ck", like)
+        assert step == 7
+        for k in state:
+            np.testing.assert_array_equal(
+                np.asarray(state[k]), np.asarray(restored[k]), err_msg=k
+            )
+        # sharding preserved on the restored arrays
+        assert restored["w"].sharding.spec == P("data", "model")
+
+    def test_shard_dedup_one_blob_per_unique_index(self):
+        store = MemoryStore()
+        mesh = _mesh({"data": 2, "model": 4})
+        # sharded only over model: each column block replicated over data
+        state = {"w": _sharded(mesh, P(None, "model"), (8, 16))}
+        save_checkpoint(store, "ck", state, step=0)
+        blobs = [k for k in store.list("ck/") if "leaf-0/" in k]
+        assert len(blobs) == 4  # 4 unique column blocks, not 8 device shards
+
+    def test_restore_onto_different_mesh(self):
+        """Save on a 2x4 mesh, restore onto 4x2 and single-device —
+        the stitching path."""
+        store = MemoryStore()
+        mesh_a = _mesh({"data": 2, "model": 4})
+        state = {"w": _sharded(mesh_a, P("data", "model"), (8, 16), seed=3)}
+        save_checkpoint(store, "ck", state, step=1)
+
+        mesh_b = _mesh({"data": 4, "model": 2})
+        like_b = {
+            "w": jax.device_put(
+                jnp.zeros((8, 16)), NamedSharding(mesh_b, P("data", "model"))
+            )
+        }
+        restored_b, _ = restore_checkpoint(store, "ck", like_b)
+        np.testing.assert_array_equal(
+            np.asarray(state["w"]), np.asarray(restored_b["w"])
+        )
+
+        like_c = {"w": jnp.zeros((8, 16))}
+        restored_c, _ = restore_checkpoint(store, "ck", like_c)
+        np.testing.assert_array_equal(
+            np.asarray(state["w"]), np.asarray(restored_c["w"])
+        )
+
+    def test_bfloat16_leaves(self):
+        store = MemoryStore()
+        state = {"w": jnp.arange(32, dtype=jnp.bfloat16).reshape(4, 8)}
+        save_checkpoint(store, "ck", state, step=0)
+        restored, _ = restore_checkpoint(
+            store, "ck", {"w": jnp.zeros((4, 8), jnp.bfloat16)}
+        )
+        assert restored["w"].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(state["w"], np.float32), np.asarray(restored["w"], np.float32)
+        )
+
+    def test_optax_state_round_trips(self):
+        import optax
+
+        store = MemoryStore()
+        params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+        opt = optax.adamw(1e-3)
+        opt_state = opt.init(params)
+        save_checkpoint(store, "ck", {"p": params, "o": opt_state}, step=3)
+        like = {"p": jax.tree_util.tree_map(jnp.zeros_like, params),
+                "o": opt.init(params)}
+        restored, step = restore_checkpoint(store, "ck", like)
+        assert step == 3
+        flat_a = jax.tree_util.tree_leaves(opt_state)
+        flat_b = jax.tree_util.tree_leaves(restored["o"])
+        assert len(flat_a) == len(flat_b)
+        for a, b in zip(flat_a, flat_b):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestLifecycle:
+    def test_prune_keeps_latest_k(self):
+        store = MemoryStore()
+        state = {"w": jnp.ones((2, 2))}
+        for s in (1, 2, 3, 4):
+            save_checkpoint(store, "ck", state, step=s, keep=2)
+        assert checkpoint_steps(store, "ck") == [3, 4]
+        assert latest_checkpoint_step(store, "ck") == 4
+
+    def test_restore_missing_raises(self):
+        with pytest.raises(BlobNotFound):
+            restore_checkpoint(MemoryStore(), "nope", {"w": jnp.zeros(2)})
+
+
+class TestRedriveResume:
+    def test_training_story_resumes_from_checkpoint(self, rt):
+        """Kill a training story mid-run, redrive-from-step, assert the
+        second attempt resumes from the checkpointed step (VERDICT #6)."""
+        from bobrapet_tpu.api.catalog import make_engram_template
+        from bobrapet_tpu.api.engram import make_engram
+        from bobrapet_tpu.api.story import make_story
+        from bobrapet_tpu.sdk import EngramExit, register_engram
+
+        attempts = []
+
+        @register_engram("train-impl")
+        def train(ctx):
+            params = {"w": jnp.zeros((2, 2))}
+            start = 0
+            restored = ctx.restore_model_checkpoint(params)
+            if restored is not None:
+                params, start = restored
+                start += 1
+            attempts.append(start)
+            for step in range(start, 5):
+                params = {"w": params["w"] + 1.0}
+                ctx.save_model_checkpoint(params, step)
+                if step == 2 and len(attempts) == 1:
+                    raise EngramExit(9, "simulated crash mid-training")
+            return {"final": float(params["w"][0, 0]), "resumed_at": start}
+
+        rt.apply(make_engram_template("t-tpl", entrypoint="train-impl"))
+        rt.apply(make_engram("trainer", "t-tpl"))
+        rt.apply(make_story("training", steps=[
+            {"name": "train", "ref": {"name": "trainer"},
+             "execution": {"retry": {"maxRetries": 0}}},
+        ], output={"final": "{{ steps.train.output.final }}",
+                   "resumedAt": "{{ steps.train.output.resumed_at }}"}))
+
+        run = rt.run_story("training")
+        rt.pump()
+        assert rt.run_phase(run) == "Failed"
+
+        rt.store.mutate(
+            "StoryRun", "default", run,
+            lambda r: r.meta.annotations.update(
+                {"runs.bobrapet.io/redrive": "from:train"}
+            ),
+        )
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        out = rt.run_output(run)
+        # crashed after saving step 2 (w=3.0); resume at step 3, finish 5
+        assert out["resumedAt"] == 3
+        assert out["final"] == 5.0
+        assert attempts == [0, 3]
+
+
+class TestMultiHost:
+    def test_cooperative_save_from_two_processes(self):
+        """Two gang hosts write disjoint globally-indexed shards + their
+        own manifests (never clobbering each other's); restore unions
+        them into one complete checkpoint.
+
+        Simulates what each host's save_checkpoint emits for a global
+        array sharded over the data axis across hosts: blobs keyed by
+        GLOBAL index ranges + a per-process manifest listing only the
+        locally-addressable shards."""
+        import json as _json
+
+        store = MemoryStore()
+        full = np.arange(32, dtype=np.float32).reshape(8, 4)
+        ckpt = "ck/ckpt-000000000000"
+
+        def host_write(process, shard_key, data):
+            store.put(f"{ckpt}/leaf-0/{shard_key}", data.tobytes())
+            manifest = {
+                "step": 0,
+                "treedef": "PyTreeDef({'w': *})",
+                "leaves": [{
+                    "path": "['w']", "index": 0, "shape": [8, 4],
+                    "dtype": "float32", "shards": [shard_key],
+                }],
+            }
+            store.put(f"{ckpt}/manifest-{process:05d}.json",
+                      _json.dumps(manifest).encode())
+
+        host_write(0, "0-4_0-4", full[:4])
+        host_write(1, "4-8_0-4", full[4:])
+
+        like = {"w": jnp.zeros((8, 4))}
+        restored, step = restore_checkpoint(store, "ck", like)
+        assert step == 0
+        np.testing.assert_array_equal(full, np.asarray(restored["w"]))
+
+    def test_restored_plain_numpy_leaf_is_writable(self):
+        store = MemoryStore()
+        state = {"ema": np.ones((4, 4), np.float32)}
+        save_checkpoint(store, "ck", state, step=0)
+        restored, _ = restore_checkpoint(store, "ck", {"ema": np.zeros((4, 4), np.float32)})
+        restored["ema"] += 1.0  # must not raise read-only
+        assert restored["ema"][0, 0] == 2.0

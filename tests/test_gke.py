@@ -292,3 +292,24 @@ class TestEndToEnd:
         c = pod_spec["containers"][0]
         env = {e["name"]: e.get("value") for e in c["env"]}
         assert env["BOBRA_SECRET_APIKEY_PATH"] == "/var/run/bobrapet/secrets/apikey"
+
+
+class TestJobSetNaming:
+    def test_jobset_hostnames_use_child_job_name(self):
+        from bobrapet_tpu.gke.materialize import JOBSET_REPLICATED_JOB
+
+        pool = SlicePool("p", "4x4", chips_per_host=4,
+                         accelerator="tpu-v5-lite-podslice")
+        grant = pool.allocate(want_topology="4x4").to_dict()
+        manifests = materialize_gang_job(
+            name="js", namespace="default", image="img", env={},
+            grant=grant, jobset=True,
+        )
+        js = _by_kind(manifests)["JobSet"][0]
+        pod = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]
+        env = {e["name"]: e.get("value") for e in pod["spec"]["containers"][0]["env"]}
+        child = f"js-{JOBSET_REPLICATED_JOB}-0"
+        assert env["TPU_WORKER_HOSTNAMES"].split(",")[0] == f"{child}-0.js-workers"
+        assert env["BOBRA_COORDINATOR_ADDRESS"].startswith(f"{child}-0.js-workers:")
+        svc = _by_kind(manifests)["Service"][0]
+        assert svc["spec"]["publishNotReadyAddresses"] is True
