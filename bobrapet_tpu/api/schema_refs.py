@@ -1,0 +1,48 @@
+"""SchemaReference identities (reference: api/runs/v1alpha1/schema_types.go:20,
+internal/controller/runs/schema_refs.go).
+
+``bubu://<kind>/<namespace>/<name>/<suffix>`` identifies the JSON schema
+a run's inputs/outputs were validated against; the controllers persist
+these into StoryRun/StepRun status so consumers can resolve exactly
+which contract applied, version-pinned when the Story/Engram declares a
+version.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def build_schema_ref(
+    kind: str,
+    namespace: str,
+    name: str,
+    suffix: str,
+    version: Optional[str] = None,
+) -> Optional[dict[str, Any]]:
+    """(reference: buildSchemaRef schema_refs.go:51)"""
+    kind, suffix, name = kind.strip(), suffix.strip(), name.strip()
+    if not kind or not suffix or not name:
+        return None
+    namespace = (namespace or "").strip()
+    ref = (
+        f"bubu://{kind}/{namespace}/{name}/{suffix}"
+        if namespace
+        else f"bubu://{kind}/{name}/{suffix}"
+    )
+    out: dict[str, Any] = {"ref": ref}
+    if version and version.strip():
+        out["version"] = version.strip()
+    return out
+
+
+def story_schema_ref(
+    namespace: str, name: str, suffix: str, version: Optional[str] = None
+) -> Optional[dict[str, Any]]:
+    return build_schema_ref("story", namespace, name, suffix, version)
+
+
+def engram_schema_ref(
+    namespace: str, name: str, suffix: str, version: Optional[str] = None
+) -> Optional[dict[str, Any]]:
+    return build_schema_ref("engram", namespace, name, suffix, version)

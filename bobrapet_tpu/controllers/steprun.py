@@ -63,6 +63,7 @@ class StepRunController:
         evaluator: Evaluator,
         recorder: Optional[EventRecorder] = None,
         clock: Optional[Clock] = None,
+        tracer=None,
     ):
         self.store = store
         self.config_manager = config_manager
@@ -71,6 +72,9 @@ class StepRunController:
         self.evaluator = evaluator
         self.recorder = recorder or EventRecorder()
         self.clock = clock or Clock()
+        if tracer is None:
+            from ..observability.tracing import TRACER as tracer
+        self.tracer = tracer
 
     # ------------------------------------------------------------------
     def reconcile(self, namespace: str, name: str) -> Optional[float]:
@@ -224,6 +228,7 @@ class StepRunController:
             StorageManager.step_key(namespace, run_name or name, spec.step_id or name, "input"),
             max_inline_size=resolved.max_inline_size,
         )
+        sr = self._ensure_step_contracts(sr, engram, template_spec, storyrun)
         cfg = self.config_manager.config
         env = contract.build_env(
             namespace=namespace,
@@ -247,6 +252,7 @@ class StepRunController:
             coordinator_address=slice_grant.get("coordinatorAddress"),
             mesh_axes=slice_grant.get("meshAxes") or (tpu.mesh_axes if tpu else None),
             slice_id=slice_grant.get("sliceId"),
+            trace_context=sr.status.get("trace"),
         )
         job = make_job(
             job_name,
@@ -580,6 +586,62 @@ class StepRunController:
     # ------------------------------------------------------------------
     # cache
     # ------------------------------------------------------------------
+    def _ensure_step_contracts(self, sr, engram, template_spec, storyrun):
+        """Persist TraceInfo (child of the StoryRun's trace) + engram
+        schema references into StepRun status
+        (reference: ensureStepRunSchemaRefs steprun_controller.go:2138,
+        pkg/runs/status/trace.go)."""
+        from ..api.schema_refs import engram_schema_ref
+
+        ns, name = sr.meta.namespace, sr.meta.name
+        version = getattr(template_spec, "version", None)
+        input_ref = (
+            engram_schema_ref(ns, engram.meta.name, "input", version)
+            if template_spec.input_schema
+            else None
+        )
+        output_ref = (
+            engram_schema_ref(ns, engram.meta.name, "output", version)
+            if template_spec.output_schema
+            else None
+        )
+
+        trace = sr.status.get("trace")
+        if trace is None and self.tracer.config.enabled:
+            from ..observability.tracing import trace_info_from_span
+
+            parent_ctx = storyrun.status.get("trace") if storyrun is not None else None
+            with self.tracer.start_span(
+                "steprun.launch",
+                trace_context=parent_ctx,
+                step_run=name,
+                namespace=ns,
+            ) as span:
+                trace = trace_info_from_span(span)
+
+        changed = (
+            sr.status.get("inputSchemaRef") != input_ref
+            or sr.status.get("outputSchemaRef") != output_ref
+            or (trace is not None and sr.status.get("trace") != trace)
+        )
+        if not changed:
+            return sr
+
+        def patch(status):
+            if input_ref is not None:
+                status["inputSchemaRef"] = input_ref
+            else:
+                status.pop("inputSchemaRef", None)
+            if output_ref is not None:
+                status["outputSchemaRef"] = output_ref
+            else:
+                status.pop("outputSchemaRef", None)
+            if trace is not None and not status.get("trace"):
+                status["trace"] = trace
+
+        self.store.patch_status(STEP_RUN_KIND, ns, name, patch)
+        return self.store.get(STEP_RUN_KIND, ns, name)
+
     def _cache_key(self, cache_cfg, resolved_inputs, template, engram) -> str:
         salt = cache_cfg.salt or ""
         mode = cache_cfg.mode or "inputs"

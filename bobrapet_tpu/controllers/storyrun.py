@@ -49,6 +49,7 @@ class StoryRunController:
         storage: StorageManager,
         recorder=None,
         clock: Optional[Clock] = None,
+        tracer=None,
     ):
         self.store = store
         self.dag = dag
@@ -57,6 +58,9 @@ class StoryRunController:
         self.recorder = recorder
         self.clock = clock or Clock()
         self.rbac = RunRBACManager(store)
+        if tracer is None:
+            from ..observability.tracing import TRACER as tracer
+        self.tracer = tracer
 
     # ------------------------------------------------------------------
     def reconcile(self, namespace: str, name: str) -> Optional[float]:
@@ -175,6 +179,11 @@ class StoryRunController:
                     StructuredError(type=ErrorType.VALIDATION, message=err),
                     reason=conditions.Reason.INPUT_SCHEMA_FAILED,
                 )
+
+        # trace + schema-reference contracts persisted into status
+        # (reference: ensureStoryRunSchemaRefs storyrun_controller.go:1047,
+        # TraceInfo trace_types.go:20 + pkg/runs/status/trace.go)
+        run = self._ensure_run_contracts(run, story, story_ns, story_name)
 
         # oversized-inputs guard (reference: oversized-input guard —
         # admission normally dehydrates; double-check here)
@@ -356,6 +365,59 @@ class StoryRunController:
             if spec.policy and spec.policy.timeouts and spec.policy.timeouts.graceful_shutdown_timeout:
                 return parse_duration(spec.policy.timeouts.graceful_shutdown_timeout, 30.0) or 30.0
         return 30.0
+
+    # ------------------------------------------------------------------
+    # trace + schema references
+    # ------------------------------------------------------------------
+    def _ensure_run_contracts(self, run, story, story_ns, story_name):
+        """Persist TraceInfo + input/output SchemaReferences into run
+        status (idempotent; one patch when anything changed)."""
+        from ..api.schema_refs import story_schema_ref
+
+        ns, name = run.meta.namespace, run.meta.name
+        version = (run.spec.get("storyRef") or {}).get("version") or story.version
+        input_ref = (
+            story_schema_ref(story_ns, story_name, "inputs", version)
+            if story.inputs_schema
+            else None
+        )
+        output_ref = (
+            story_schema_ref(story_ns, story_name, "output", version)
+            if story.outputs_schema
+            else None
+        )
+
+        trace = run.status.get("trace")
+        if trace is None and self.tracer.config.enabled:
+            from ..observability.tracing import trace_info_from_span
+
+            with self.tracer.start_span(
+                "storyrun.run", story=story_name, run=name, namespace=ns
+            ) as span:
+                trace = trace_info_from_span(span)
+
+        changed = (
+            run.status.get("inputSchemaRef") != input_ref
+            or run.status.get("outputSchemaRef") != output_ref
+            or (trace is not None and run.status.get("trace") != trace)
+        )
+        if not changed:
+            return run
+
+        def patch(status):
+            if input_ref is not None:
+                status["inputSchemaRef"] = input_ref
+            else:
+                status.pop("inputSchemaRef", None)
+            if output_ref is not None:
+                status["outputSchemaRef"] = output_ref
+            else:
+                status.pop("outputSchemaRef", None)
+            if trace is not None and not status.get("trace"):
+                status["trace"] = trace
+
+        self.store.patch_status(STORY_RUN_KIND, ns, name, patch)
+        return self.store.get(STORY_RUN_KIND, ns, name)
 
     # ------------------------------------------------------------------
     # redrive (reference: :295-807)

@@ -82,8 +82,12 @@ class Runtime:
         executor_mode: str = "sync",
         config_namespace: str = "bobrapet-system",
         enable_webhooks: bool = True,
+        tracer=None,
     ):
         self.clock = clock or ManualClock()
+        if tracer is None:
+            from .observability.tracing import TRACER as tracer
+        self.tracer = tracer
         self.store = ResourceStore(persist_dir=persist_dir)
         self.recorder = EventRecorder()
         self.config_manager = OperatorConfigManager(self.store, namespace=config_namespace)
@@ -119,11 +123,12 @@ class Runtime:
         )
         self.storyrun_controller = StoryRunController(
             self.store, self.dag, self.config_manager, self.storage,
-            recorder=self.recorder, clock=self.clock,
+            recorder=self.recorder, clock=self.clock, tracer=self.tracer,
         )
         self.steprun_controller = StepRunController(
             self.store, self.config_manager, self.resolver, self.storage,
             self.evaluator, recorder=self.recorder, clock=self.clock,
+            tracer=self.tracer,
         )
         self.story_controller = StoryController(
             self.store, recorder=self.recorder, clock=self.clock

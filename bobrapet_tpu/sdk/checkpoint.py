@@ -129,6 +129,20 @@ def save_checkpoint(
     store.put(f"{ckpt}/{_manifest_key(process)}",
               json.dumps(manifest, separators=(",", ":")).encode())
 
+    # a re-save at the same step after shrinking the process count must
+    # not leave the departed processes' manifests behind — their stale
+    # sharding layout would be unioned into restores. (With an unchanged
+    # process set every manifest is overwritten above, and stale blobs
+    # unreferenced by any fresh manifest are never read.)
+    try:
+        world = jax.process_count()
+    except Exception:
+        world = process + 1
+    for key in store.list(f"{ckpt}/{MANIFEST_PREFIX}"):
+        idx = int(key.rsplit(MANIFEST_PREFIX, 1)[1].removesuffix(".json"))
+        if idx >= max(world, process + 1):
+            store.delete(key)
+
     if keep > 0:
         steps = sorted(checkpoint_steps(store, prefix))
         for old in steps[:-keep]:

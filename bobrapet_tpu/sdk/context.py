@@ -230,6 +230,29 @@ class EngramContext:
 
         self._store.patch_status("StepRun", self.namespace, self.step_run, patch)
 
+    # -- tracing -----------------------------------------------------------
+
+    @property
+    def trace_context(self) -> Optional[dict[str, Any]]:
+        """Controller-persisted span context (StepRun.status.trace carried
+        through the env contract) — SDK spans parent into the
+        controller's trace across the process boundary."""
+        raw = self.env.get(contract.ENV_TRACE_CONTEXT)
+        return json.loads(raw) if raw else None
+
+    def start_span(self, name: str, **attributes: Any):
+        """Open an SDK-side span stitched into the run's trace; a no-op
+        (yields None) when tracing is disabled."""
+        from ..observability.tracing import TRACER
+
+        return TRACER.start_span(
+            name,
+            trace_context=self.trace_context,
+            step=self.step,
+            step_run=self.step_run,
+            **attributes,
+        )
+
     # -- model checkpointing ----------------------------------------------
 
     @property

@@ -52,6 +52,11 @@ ENV_TRIGGER_THROTTLE = "BOBRA_TRIGGER_THROTTLE"  # throttle policy JSON
 ENV_DOWNSTREAM_TARGETS = "BOBRA_DOWNSTREAM_TARGETS"  # JSON list of next hops
 ENV_BINDING_INFO = "BOBRA_BINDING_INFO"  # negotiated transport binding JSON
 
+# tracing: controller-persisted span context (reference: TraceInfo
+# trace_types.go:20 + pkg/runs/status/trace.go) so SDK spans parent into
+# the controller's trace across the process boundary
+ENV_TRACE_CONTEXT = "BOBRA_TRACEPARENT"  # JSON {traceId, spanId, sampled}
+
 # TPU topology (TPU-native additions; no reference counterpart)
 ENV_TPU_ACCELERATOR = "BOBRA_TPU_ACCELERATOR"
 ENV_TPU_TOPOLOGY = "BOBRA_TPU_TOPOLOGY"  # e.g. "2x4"
@@ -102,6 +107,7 @@ def build_env(
     coordinator_address: Optional[str] = None,
     mesh_axes: Optional[dict[str, int]] = None,
     slice_id: Optional[str] = None,
+    trace_context: Optional[dict[str, Any]] = None,
 ) -> dict[str, str]:
     """Render the per-step env contract (host-independent portion).
 
@@ -144,6 +150,8 @@ def build_env(
         env[ENV_MESH_AXES] = json.dumps(mesh_axes, separators=(",", ":"))
     if slice_id:
         env[ENV_SLICE_ID] = slice_id
+    if trace_context:
+        env[ENV_TRACE_CONTEXT] = json.dumps(trace_context, separators=(",", ":"))
     return env
 
 
