@@ -36,6 +36,59 @@ def build_schema_ref(
     return out
 
 
+def ensure_status_contracts(
+    store,
+    tracer,
+    kind: str,
+    obj,
+    input_ref: Optional[dict[str, Any]],
+    output_ref: Optional[dict[str, Any]],
+    span_name: str,
+    span_attrs: dict[str, Any],
+    parent_ctx: Optional[dict[str, Any]] = None,
+):
+    """Persist TraceInfo + input/output SchemaReferences into an
+    object's status (idempotent; one patch when anything changed).
+    Shared by the StoryRun and StepRun controllers
+    (reference: ensureStepRunSchemaRefs steprun_controller.go:2138,
+    pkg/runs/status/trace.go). Returns the (possibly refreshed) object.
+    """
+    ns, name = obj.meta.namespace, obj.meta.name
+    trace = obj.status.get("trace")
+    if trace is None and tracer.config.enabled:
+        from ..observability.tracing import trace_info_from_span
+
+        with tracer.start_span(
+            span_name, trace_context=parent_ctx, **span_attrs
+        ) as span:
+            trace = trace_info_from_span(span)
+
+    changed = (
+        obj.status.get("inputSchemaRef") != input_ref
+        or obj.status.get("outputSchemaRef") != output_ref
+        or (trace is not None and obj.status.get("trace") != trace)
+    )
+    if not changed:
+        return obj
+
+    def patch(status):
+        if input_ref is not None:
+            status["inputSchemaRef"] = input_ref
+        else:
+            status.pop("inputSchemaRef", None)
+        if output_ref is not None:
+            status["outputSchemaRef"] = output_ref
+        else:
+            status.pop("outputSchemaRef", None)
+        # never clobber a trace minted by a concurrent writer: first
+        # trace at this status wins
+        if trace is not None and not status.get("trace"):
+            status["trace"] = trace
+
+    store.patch_status(kind, ns, name, patch)
+    return store.get(kind, ns, name)
+
+
 def story_schema_ref(
     namespace: str, name: str, suffix: str, version: Optional[str] = None
 ) -> Optional[dict[str, Any]]:

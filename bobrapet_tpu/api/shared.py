@@ -95,6 +95,12 @@ class SliceLocalSSDProvider(SpecBase):
 
     path: str = "/mnt/slice-ssd"
     max_bytes: Optional[int] = None
+    # Pin the implementation: True = native C++ blob cache (error if the
+    # toolchain is missing), False = Python FileStore layout. The two
+    # layouts are NOT interchangeable, so a fleet must agree — leave
+    # unset only in single-process/dev deployments where autodetect
+    # cannot diverge between writer and reader.
+    native: Optional[bool] = None
 
 
 @dataclasses.dataclass

@@ -26,6 +26,7 @@ from __future__ import annotations
 import logging
 from typing import Any, Optional
 
+from ..api import conditions
 from ..api.catalog import CLUSTER_NAMESPACE, ENGRAM_TEMPLATE_KIND
 from ..api.engram import KIND as ENGRAM_KIND
 from ..api.enums import Phase
@@ -33,7 +34,12 @@ from ..api.runs import STEP_RUN_KIND
 from ..core.object import Resource, new_resource
 from ..core.store import AlreadyExists, ResourceStore
 from ..utils.naming import compose_unique
-from .step_executor import LABEL_PARENT_STEP, LABEL_STORY_RUN
+from .step_executor import (
+    LABEL_PARENT_STEP,
+    LABEL_PRIORITY,
+    LABEL_QUEUE,
+    LABEL_STORY_RUN,
+)
 
 _log = logging.getLogger(__name__)
 
@@ -112,10 +118,27 @@ def resolve_materialize(
     name = materialize_name(run.meta.name, step_name)
     existing = store.try_get(STEP_RUN_KIND, ns, name)
     if existing is None:
-        if engram_name == DEFAULT_MATERIALIZE_ENGRAM and (
-            store.try_get(ENGRAM_KIND, ns, engram_name) is None
-        ):
-            ensure_builtin_engram(store, ns)
+        if store.try_get(ENGRAM_KIND, ns, engram_name) is None:
+            if engram_name == DEFAULT_MATERIALIZE_ENGRAM:
+                ensure_builtin_engram(store, ns)
+            else:
+                # a configured-but-absent materialize engram is a config
+                # error: fail the step now instead of parking a Blocked
+                # delegate that polls forever (reference surfaces this as
+                # InvalidConfiguration)
+                raise MaterializeFailed(
+                    f"configured materialize engram {ns}/{engram_name!r} "
+                    "not found (templating.materialize-engram points at a "
+                    "nonexistent Engram)"
+                )
+        # delegate inherits the parent run's scheduling labels so it is
+        # accounted against the same queue's max_concurrent (reference:
+        # applySchedulingLabelsFromStoryRun, materialize.go)
+        sched = {
+            k: run.meta.labels[k]
+            for k in (LABEL_QUEUE, LABEL_PRIORITY)
+            if k in run.meta.labels
+        }
         sr = new_resource(
             STEP_RUN_KIND, name, ns,
             spec={
@@ -130,6 +153,7 @@ def resolve_materialize(
                 # state sync nor a parallel parent's branch roll-up
                 # mistakes the delegate for a workflow step
                 LABEL_PARENT_STEP: f"{step_name}#materialize",
+                **sched,
             },
             annotations={MATERIALIZE_ANNOTATION: "true"},
             owners=[run.owner_ref()],
@@ -156,4 +180,38 @@ def resolve_materialize(
         raise MaterializeFailed(
             f"materialize delegate for step {step_name!r} ended {phase_raw}: {err}"
         )
+    if phase is Phase.BLOCKED:
+        # the delegate's engram or template vanished after creation: a
+        # Blocked delegate never terminates on its own, so surface the
+        # config error instead of polling indefinitely. But the Blocked
+        # condition can be stale (engram deleted and recreated between
+        # reconciles) — only fail once the reference is verified still
+        # absent; otherwise keep polling and let the StepRun controller
+        # self-heal.
+        blocked_reasons = {
+            str(conditions.Reason.REFERENCE_NOT_FOUND),
+            str(conditions.Reason.TEMPLATE_NOT_FOUND),
+        }
+        for cond in existing.status.get("conditions", []):
+            if cond.get("reason") not in blocked_reasons:
+                continue
+            if _reference_still_broken(store, ns, engram_name):
+                raise MaterializeFailed(
+                    f"materialize delegate for step {step_name!r} is Blocked: "
+                    f"{cond.get('message', 'engram reference not found')}"
+                )
     return None
+
+
+def _reference_still_broken(
+    store: ResourceStore, ns: str, engram_name: str
+) -> bool:
+    """True when the delegate's engram (or its template) is genuinely
+    missing right now, not just in a stale Blocked condition."""
+    engram = store.try_get(ENGRAM_KIND, ns, engram_name)
+    if engram is None:
+        return True
+    tpl_name = (engram.spec.get("templateRef") or {}).get("name", "")
+    return bool(tpl_name) and (
+        store.try_get(ENGRAM_TEMPLATE_KIND, CLUSTER_NAMESPACE, tpl_name) is None
+    )

@@ -591,7 +591,7 @@ class StepRunController:
         schema references into StepRun status
         (reference: ensureStepRunSchemaRefs steprun_controller.go:2138,
         pkg/runs/status/trace.go)."""
-        from ..api.schema_refs import engram_schema_ref
+        from ..api.schema_refs import engram_schema_ref, ensure_status_contracts
 
         ns, name = sr.meta.namespace, sr.meta.name
         version = getattr(template_spec, "version", None)
@@ -605,42 +605,12 @@ class StepRunController:
             if template_spec.output_schema
             else None
         )
-
-        trace = sr.status.get("trace")
-        if trace is None and self.tracer.config.enabled:
-            from ..observability.tracing import trace_info_from_span
-
-            parent_ctx = storyrun.status.get("trace") if storyrun is not None else None
-            with self.tracer.start_span(
-                "steprun.launch",
-                trace_context=parent_ctx,
-                step_run=name,
-                namespace=ns,
-            ) as span:
-                trace = trace_info_from_span(span)
-
-        changed = (
-            sr.status.get("inputSchemaRef") != input_ref
-            or sr.status.get("outputSchemaRef") != output_ref
-            or (trace is not None and sr.status.get("trace") != trace)
+        return ensure_status_contracts(
+            self.store, self.tracer, STEP_RUN_KIND, sr, input_ref, output_ref,
+            span_name="steprun.launch",
+            span_attrs={"step_run": name, "namespace": ns},
+            parent_ctx=storyrun.status.get("trace") if storyrun is not None else None,
         )
-        if not changed:
-            return sr
-
-        def patch(status):
-            if input_ref is not None:
-                status["inputSchemaRef"] = input_ref
-            else:
-                status.pop("inputSchemaRef", None)
-            if output_ref is not None:
-                status["outputSchemaRef"] = output_ref
-            else:
-                status.pop("outputSchemaRef", None)
-            if trace is not None and not status.get("trace"):
-                status["trace"] = trace
-
-        self.store.patch_status(STEP_RUN_KIND, ns, name, patch)
-        return self.store.get(STEP_RUN_KIND, ns, name)
 
     def _cache_key(self, cache_cfg, resolved_inputs, template, engram) -> str:
         salt = cache_cfg.salt or ""

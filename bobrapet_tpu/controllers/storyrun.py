@@ -61,6 +61,10 @@ class StoryRunController:
         if tracer is None:
             from ..observability.tracing import TRACER as tracer
         self.tracer = tracer
+        # runs whose blob prefix is pinned against capacity eviction;
+        # in-memory is restart-safe because the store's pin table lives
+        # in the same process and resets with us
+        self._pinned: set[tuple[str, str]] = set()
 
     # ------------------------------------------------------------------
     def reconcile(self, namespace: str, name: str) -> Optional[float]:
@@ -71,6 +75,7 @@ class StoryRunController:
         # deletion: storage cleanup behind a finalizer
         if run.meta.deletion_timestamp is not None:
             if FINALIZER in run.meta.finalizers:
+                self._unpin(namespace, name)
                 self.storage.delete_prefix(StorageManager.run_prefix(namespace, name))
 
                 def strip(r: Resource) -> None:
@@ -94,7 +99,14 @@ class StoryRunController:
 
         phase = Phase(run.status["phase"]) if run.status.get("phase") else None
         if phase is not None and phase.is_terminal:
+            self._unpin(namespace, name)
             return self._handle_terminal(run)
+
+        # live run: shield its offloaded blobs from LRU eviction so a
+        # byte-budget squeeze can never break a pending hydrate
+        if (namespace, name) not in self._pinned:
+            self.storage.pin_run(namespace, name)
+            self._pinned.add((namespace, name))
 
         # graceful cancel (reference: handleGracefulCancel:1517)
         if run.spec.get("cancelRequested"):
@@ -372,7 +384,7 @@ class StoryRunController:
     def _ensure_run_contracts(self, run, story, story_ns, story_name):
         """Persist TraceInfo + input/output SchemaReferences into run
         status (idempotent; one patch when anything changed)."""
-        from ..api.schema_refs import story_schema_ref
+        from ..api.schema_refs import ensure_status_contracts, story_schema_ref
 
         ns, name = run.meta.namespace, run.meta.name
         version = (run.spec.get("storyRef") or {}).get("version") or story.version
@@ -386,38 +398,11 @@ class StoryRunController:
             if story.outputs_schema
             else None
         )
-
-        trace = run.status.get("trace")
-        if trace is None and self.tracer.config.enabled:
-            from ..observability.tracing import trace_info_from_span
-
-            with self.tracer.start_span(
-                "storyrun.run", story=story_name, run=name, namespace=ns
-            ) as span:
-                trace = trace_info_from_span(span)
-
-        changed = (
-            run.status.get("inputSchemaRef") != input_ref
-            or run.status.get("outputSchemaRef") != output_ref
-            or (trace is not None and run.status.get("trace") != trace)
+        return ensure_status_contracts(
+            self.store, self.tracer, STORY_RUN_KIND, run, input_ref, output_ref,
+            span_name="storyrun.run",
+            span_attrs={"story": story_name, "run": name, "namespace": ns},
         )
-        if not changed:
-            return run
-
-        def patch(status):
-            if input_ref is not None:
-                status["inputSchemaRef"] = input_ref
-            else:
-                status.pop("inputSchemaRef", None)
-            if output_ref is not None:
-                status["outputSchemaRef"] = output_ref
-            else:
-                status.pop("outputSchemaRef", None)
-            if trace is not None and not status.get("trace"):
-                status["trace"] = trace
-
-        self.store.patch_status(STORY_RUN_KIND, ns, name, patch)
-        return self.store.get(STORY_RUN_KIND, ns, name)
 
     # ------------------------------------------------------------------
     # redrive (reference: :295-807)
@@ -477,6 +462,11 @@ class StoryRunController:
     # ------------------------------------------------------------------
     # two-phase retention (reference: :1811-2069)
     # ------------------------------------------------------------------
+    def _unpin(self, namespace: str, name: str) -> None:
+        if (namespace, name) in self._pinned:
+            self.storage.unpin_run(namespace, name)
+            self._pinned.discard((namespace, name))
+
     def _handle_terminal(self, run: Resource) -> Optional[float]:
         ns, name = run.meta.namespace, run.meta.name
         cfg = self.config_manager.config.retention

@@ -39,8 +39,11 @@ def encode(
     mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Token ids [B, S] (+ optional validity mask [B, S]) -> embeddings
-    [B, D], L2-normalized."""
-    bidi = lambda q, k, v: attention(q, k, v, causal=False)  # noqa: E731
+    [B, D], L2-normalized. The mask is applied both inside attention
+    (padding keys get -inf bias, so pad tokens never contaminate real
+    tokens' hidden states) and at pooling — embeddings are invariant to
+    padding length."""
+    bidi = lambda q, k, v: attention(q, k, v, causal=False, kv_mask=mask)  # noqa: E731
     freqs = llama.rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     x = params["embed"]["weight"][tokens].astype(cfg.dtype)
     for layer in params["layers"]:

@@ -31,12 +31,14 @@ def attention_reference(
     causal: bool = True,
     q_offset: int = 0,
     sm_scale: float | None = None,
+    kv_mask: jax.Array | None = None,
 ) -> jax.Array:
     """Plain XLA attention with GQA.
 
     q: [B, Sq, Hq, D]; k/v: [B, Sk, Hkv, D]. q_offset shifts query
     positions for decode (q token i sits at absolute position
-    q_offset + i).
+    q_offset + i). kv_mask [B, Sk] marks valid keys (padding keys get
+    -inf bias so they cannot contaminate any query's context).
     """
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
@@ -54,6 +56,10 @@ def attention_reference(
         k_pos = jnp.arange(sk)
         mask = q_pos[:, None] >= k_pos[None, :]
         scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
+    if kv_mask is not None:
+        scores = jnp.where(
+            kv_mask.astype(bool)[:, None, None, :], scores, NEG_INF
+        )
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
     return out.astype(q.dtype)
@@ -159,16 +165,23 @@ def attention(
     causal: bool = True,
     q_offset: int = 0,
     sm_scale: float | None = None,
+    kv_mask: jax.Array | None = None,
 ) -> jax.Array:
     """Dispatch: flash kernel on TPU for aligned prefill shapes, XLA
     reference otherwise (decode with q_offset always takes the XLA path —
-    a 1-token query is bandwidth-bound, not kernel-bound)."""
+    a 1-token query is bandwidth-bound, not kernel-bound). A kv_mask
+    (padding validity) forces the XLA path; padded encoder batches are
+    short and the masked softmax fuses fine."""
     if (
-        jax.default_backend() == "tpu"
+        kv_mask is None
+        and jax.default_backend() == "tpu"
         and q_offset == 0
         and q.shape[1] >= 128
         and q.shape[1] % 128 == 0
         and k.shape[1] % 128 == 0
     ):
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
-    return attention_reference(q, k, v, causal=causal, q_offset=q_offset, sm_scale=sm_scale)
+    return attention_reference(
+        q, k, v, causal=causal, q_offset=q_offset, sm_scale=sm_scale,
+        kv_mask=kv_mask,
+    )

@@ -73,6 +73,7 @@ def save_checkpoint(
     step: int,
     keep: int = 2,
     process: Optional[int] = None,
+    world: Optional[int] = None,
 ) -> str:
     """Write one checkpoint; returns its key prefix.
 
@@ -87,6 +88,7 @@ def save_checkpoint(
     """
     import jax
 
+    process_explicit = process is not None
     if process is None:
         process = jax.process_index()
     ckpt = f"{prefix}/ckpt-{step:012d}"
@@ -133,15 +135,20 @@ def save_checkpoint(
     # not leave the departed processes' manifests behind — their stale
     # sharding layout would be unioned into restores. (With an unchanged
     # process set every manifest is overwritten above, and stale blobs
-    # unreferenced by any fresh manifest are never read.)
-    try:
-        world = jax.process_count()
-    except Exception:
-        world = process + 1
-    for key in store.list(f"{ckpt}/{MANIFEST_PREFIX}"):
-        idx = int(key.rsplit(MANIFEST_PREFIX, 1)[1].removesuffix(".json"))
-        if idx >= max(world, process + 1):
-            store.delete(key)
+    # unreferenced by any fresh manifest are never read.) Only clean when
+    # the world size is certain: an explicit `process` means a simulated
+    # gang where jax.process_count() does NOT reflect the gang size, and
+    # guessing low would delete live peers' manifests.
+    if world is None and not process_explicit:
+        try:
+            world = jax.process_count()
+        except Exception:
+            world = None
+    if world is not None:
+        for key in store.list(f"{ckpt}/{MANIFEST_PREFIX}"):
+            idx = int(key.rsplit(MANIFEST_PREFIX, 1)[1].removesuffix(".json"))
+            if idx >= max(world, process + 1):
+                store.delete(key)
 
     if keep > 0:
         steps = sorted(checkpoint_steps(store, prefix))

@@ -26,9 +26,31 @@ def build_store(policy, base_dir: str = "/tmp/bobrapet-storage") -> Store:
     if policy is None:
         return FileStore(base_dir)
     if getattr(policy, "slice_local_ssd", None) is not None:
-        from .ssd import make_ssd_store
+        from .ssd import NativeUnavailable, SSDStore, make_ssd_store
 
         cfg = policy.slice_local_ssd
+        native = getattr(cfg, "native", None)
+        if native is True:
+            # pinned native: a missing toolchain is a deployment error,
+            # not a reason to silently switch on-disk layouts
+            try:
+                return SSDStore(cfg.path, capacity_bytes=int(cfg.max_bytes or 0))
+            except NativeUnavailable as e:
+                raise StorageError(
+                    "storage policy pins slice_local_ssd.native=true but the "
+                    f"native blob cache is unavailable: {e}"
+                ) from e
+        if native is False:
+            if cfg.max_bytes:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "slice_local_ssd.max_bytes=%s is not enforced with "
+                    "native=false: the Python layout has no eviction "
+                    "budget; size the mount for the peak working set",
+                    cfg.max_bytes,
+                )
+            return SliceLocalSSDStore(cfg.path)
         return make_ssd_store(cfg.path, capacity_bytes=int(cfg.max_bytes or 0))
     if getattr(policy, "s3", None) is not None:
         return S3Store(bucket=policy.s3.bucket)

@@ -183,7 +183,9 @@ class StorageManager:
                 raise StorageError(
                     f"storage ref {ref.key!r} written by provider "
                     f"{ref.provider!r} but this store is "
-                    f"{self.store.provider!r}"
+                    f"{self.store.provider!r}; pin slice_local_ssd.native "
+                    "in the storage policy so all processes agree on one "
+                    "implementation"
                 )
             data = self.store.get(ref.key)
             if ref.sha256:
@@ -216,6 +218,18 @@ class StorageManager:
             raise StorageError(
                 f"storage ref {ref.key!r} outside allowed scope {allowed_prefixes}"
             )
+
+    # -- eviction pinning --------------------------------------------------
+
+    def pin_run(self, namespace: str, run_name: str) -> None:
+        """Shield a live run's blobs from capacity eviction (no-op on
+        stores without a byte budget). Paired with :meth:`unpin_run` at
+        terminal cleanup, so LRU pressure can never delete data a
+        StorageRef in a non-terminal run still references."""
+        self.store.pin_prefix(self._bounded(self.run_prefix(namespace, run_name)))
+
+    def unpin_run(self, namespace: str, run_name: str) -> None:
+        self.store.unpin_prefix(self._bounded(self.run_prefix(namespace, run_name)))
 
     # -- retention ---------------------------------------------------------
 

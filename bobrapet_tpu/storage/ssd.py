@@ -80,33 +80,47 @@ def load_native() -> ctypes.CDLL:
             lib = ctypes.CDLL(so)
         except OSError as e:  # stale/incompatible/half-written .so
             raise NativeUnavailable(f"cannot load native library: {e}") from e
-        lib.bc_open.restype = ctypes.c_void_p
-        lib.bc_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
-        lib.bc_close.argtypes = [ctypes.c_void_p]
-        lib.bc_put.restype = ctypes.c_int
-        lib.bc_put.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
-        ]
-        lib.bc_size.restype = ctypes.c_int64
-        lib.bc_size.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.bc_get.restype = ctypes.c_int
-        lib.bc_get.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
-        ]
-        lib.bc_delete.restype = ctypes.c_int
-        lib.bc_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.bc_exists.restype = ctypes.c_int
-        lib.bc_exists.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.bc_mtime.restype = ctypes.c_double
-        lib.bc_mtime.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.bc_used_bytes.restype = ctypes.c_uint64
-        lib.bc_used_bytes.argtypes = [ctypes.c_void_p]
-        lib.bc_list.restype = ctypes.c_int64
-        lib.bc_list.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
-        ]
+        try:
+            _bind_symbols(lib)
+        except AttributeError as e:
+            # a prebuilt .so from an older build can lack newer symbols
+            # (e.g. bc_pin); that's "native unavailable", not a crash —
+            # callers fall back to the Python store
+            raise NativeUnavailable(f"native library too old: {e}") from e
         _lib = lib
         return lib
+
+
+def _bind_symbols(lib: ctypes.CDLL) -> None:
+    lib.bc_open.restype = ctypes.c_void_p
+    lib.bc_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.bc_close.argtypes = [ctypes.c_void_p]
+    lib.bc_put.restype = ctypes.c_int
+    lib.bc_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+    ]
+    lib.bc_size.restype = ctypes.c_int64
+    lib.bc_size.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.bc_get.restype = ctypes.c_int
+    lib.bc_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+    ]
+    lib.bc_delete.restype = ctypes.c_int
+    lib.bc_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.bc_exists.restype = ctypes.c_int
+    lib.bc_exists.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.bc_mtime.restype = ctypes.c_double
+    lib.bc_mtime.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.bc_used_bytes.restype = ctypes.c_uint64
+    lib.bc_used_bytes.argtypes = [ctypes.c_void_p]
+    lib.bc_pin.restype = ctypes.c_int
+    lib.bc_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.bc_unpin.restype = ctypes.c_int
+    lib.bc_unpin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.bc_list.restype = ctypes.c_int64
+    lib.bc_list.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+    ]
 
 
 _ERR = {
@@ -196,6 +210,18 @@ class SSDStore(Store):
 
     def used_bytes(self) -> int:
         return int(self._lib.bc_used_bytes(self._handle))
+
+    def pin_prefix(self, prefix: str) -> None:
+        rc = self._lib.bc_pin(self._handle, prefix.encode())
+        if rc != 0:
+            raise StorageError(f"ssd pin {prefix!r} failed: {_ERR.get(rc, rc)}")
+
+    def unpin_prefix(self, prefix: str) -> None:
+        # unpinning a never-pinned prefix (-1) is tolerated: controllers
+        # unpin unconditionally at terminal cleanup
+        rc = self._lib.bc_unpin(self._handle, prefix.encode())
+        if rc not in (0, -1):
+            raise StorageError(f"ssd unpin {prefix!r} failed: {_ERR.get(rc, rc)}")
 
 
 def make_ssd_store(base_dir: str, capacity_bytes: int = 0) -> Store:

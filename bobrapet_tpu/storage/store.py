@@ -50,6 +50,13 @@ class Store:
         """Last-modified time (for retention sweeps)."""
         raise NotImplementedError
 
+    def pin_prefix(self, prefix: str) -> None:
+        """Exempt keys under ``prefix`` from capacity eviction while a
+        run is live. No-op for stores without an eviction budget."""
+
+    def unpin_prefix(self, prefix: str) -> None:
+        """Release a pin taken by :meth:`pin_prefix`."""
+
 
 def _safe_rel(key: str) -> str:
     """Map a blob key to a safe relative path (no traversal/absolute)."""

@@ -85,7 +85,20 @@ struct Cache {
   uint64_t tick = 0;  // LRU clock: bumped on every put/get
   std::mutex mu;
   std::map<std::string, CacheEntry> entries;
+  // Keys under a pinned prefix are exempt from LRU eviction: run
+  // controllers pin a run's blob prefix while the run is live so a
+  // byte-budget squeeze can never delete data a StorageRef still
+  // references (hydrate would raise BlobNotFound mid-run).
+  std::map<std::string, uint32_t> pinned_prefixes;  // prefix -> refcount
 };
+
+// Caller holds mu.
+bool is_pinned(const Cache& c, const std::string& key) {
+  for (const auto& kv : c.pinned_prefixes) {
+    if (key.compare(0, kv.first.size(), kv.first) == 0) return true;
+  }
+  return false;
+}
 
 std::string shard_dir(const Cache& c, const std::string& key) {
   char buf[8];
@@ -215,17 +228,25 @@ void rescan(Cache* c) {
   }
 }
 
-// Evict LRU entries until `needed` more bytes fit. Caller holds mu.
+// Evict LRU entries until `needed` more bytes fit, skipping pinned
+// keys. Best-effort: when only pinned entries remain the budget may be
+// exceeded — live run data is never sacrificed to the byte cap.
+// Caller holds mu: pinned-ness cannot change mid-call, so the prefix
+// scan runs once per entry (one O(N*R) pass + sort), not once per
+// eviction round — the mutex also gates bc_get/bc_size lookups.
 void evict_for(Cache* c, uint64_t needed) {
-  if (c->capacity == 0) return;
-  while (c->used + needed > c->capacity && !c->entries.empty()) {
-    auto victim = c->entries.begin();
-    for (auto it = c->entries.begin(); it != c->entries.end(); ++it) {
-      if (it->second.lru < victim->second.lru) victim = it;
-    }
-    ::unlink(victim->second.path.c_str());
-    c->used -= victim->second.size;
-    c->entries.erase(victim);
+  if (c->capacity == 0 || c->used + needed <= c->capacity) return;
+  std::vector<std::pair<uint64_t, std::string>> victims;  // (lru, key)
+  for (const auto& kv : c->entries) {
+    if (!is_pinned(*c, kv.first)) victims.emplace_back(kv.second.lru, kv.first);
+  }
+  std::sort(victims.begin(), victims.end());
+  for (const auto& v : victims) {
+    if (c->used + needed <= c->capacity) break;
+    auto it = c->entries.find(v.second);
+    ::unlink(it->second.path.c_str());
+    c->used -= it->second.size;
+    c->entries.erase(it);
   }
 }
 
@@ -366,6 +387,27 @@ double bc_mtime(void* handle, const char* key) {
   // file vanished out-of-band under a live index entry: report missing,
   // not epoch-0 "infinitely stale"
   return t > 0.0 ? t : -1.0;
+}
+
+// Pin/unpin an eviction-exempt key prefix (refcounted; a prefix pinned
+// twice needs two unpins). Unpinning a prefix that was never pinned
+// returns kErrNotFound.
+int bc_pin(void* handle, const char* prefix) {
+  auto* c = static_cast<Cache*>(handle);
+  if (!c || !prefix || !*prefix) return kErrBadArg;
+  std::lock_guard<std::mutex> lock(c->mu);
+  ++c->pinned_prefixes[prefix];
+  return kOk;
+}
+
+int bc_unpin(void* handle, const char* prefix) {
+  auto* c = static_cast<Cache*>(handle);
+  if (!c || !prefix || !*prefix) return kErrBadArg;
+  std::lock_guard<std::mutex> lock(c->mu);
+  auto it = c->pinned_prefixes.find(prefix);
+  if (it == c->pinned_prefixes.end()) return kErrNotFound;
+  if (--it->second == 0) c->pinned_prefixes.erase(it);
+  return kOk;
 }
 
 uint64_t bc_used_bytes(void* handle) {

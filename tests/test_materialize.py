@@ -140,3 +140,38 @@ class TestPolicies:
         r = rt.store.get("StoryRun", "default", run)
         assert r.status["phase"] == "Succeeded"
         assert r.status["stepStates"]["w"]["phase"] == "Succeeded"
+
+
+class TestAdviceRegressions:
+    def test_delegate_inherits_scheduling_labels(self, rt):
+        """The materialize delegate carries the parent run's queue and
+        priority labels so it is accounted against the same queue's
+        max_concurrent (reference: applySchedulingLabelsFromStoryRun)."""
+        from bobrapet_tpu.controllers.step_executor import (
+            LABEL_PRIORITY,
+            LABEL_QUEUE,
+        )
+
+        _setup(rt, OffloadedDataPolicy.CONTROLLER)
+        story = _story("{{ steps.big.output.blob }}")
+        story.spec["policy"] = {"queue": "tpu-pool", "priority": 7}
+        rt.apply(story)
+        run = rt.run_story("mat")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        mat = rt.store.get("StepRun", "default", materialize_name(run, "gated"))
+        assert mat.meta.labels[LABEL_QUEUE] == "tpu-pool"
+        assert mat.meta.labels[LABEL_PRIORITY] == "7"
+
+    def test_missing_configured_engram_fails_step(self, rt):
+        """A non-default materialize engram that doesn't exist is a
+        config error surfaced immediately — not an eternally-Blocked
+        delegate polled at 1s (ADVICE: materialize.py:118)."""
+        _setup(rt, OffloadedDataPolicy.CONTROLLER)
+        rt.config_manager.config.templating.materialize_engram = "no-such-engram"
+        rt.apply(_story("{{ steps.big.output.blob }}"))
+        run = rt.run_story("mat")
+        rt.pump()
+        r = rt.store.get("StoryRun", "default", run)
+        assert r.status["phase"] == "Failed"
+        assert "no-such-engram" in r.status["stepStates"]["gated"]["message"]

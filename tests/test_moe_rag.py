@@ -111,6 +111,23 @@ class TestEmbedder:
         )
         assert float(jnp.abs(full - half).max()) > 1e-6
 
+    def test_padding_length_invariance(self):
+        """Embeddings must not depend on how much padding follows the
+        real tokens: the mask gates attention keys, not just pooling
+        (ADVICE: embedder.py:51)."""
+        cfg = embedder.embed_tiny()
+        p = embedder.init_params(jax.random.PRNGKey(0), cfg)
+        real = jax.random.randint(jax.random.PRNGKey(2), (1, 6), 1, cfg.vocab_size)
+        short = jnp.concatenate([real, jnp.zeros((1, 2), real.dtype)], axis=1)
+        long = jnp.concatenate([real, jnp.full((1, 10), 7, real.dtype)], axis=1)
+        m_short = jnp.arange(8)[None, :] < 6
+        m_long = jnp.arange(16)[None, :] < 6
+        e_short = embedder.encode(p, short, cfg, mask=m_short)
+        e_long = embedder.encode(p, long, cfg, mask=m_long)
+        np.testing.assert_allclose(
+            np.asarray(e_short), np.asarray(e_long), atol=1e-5
+        )
+
     def test_retrieval_selfmatch(self):
         cfg = embedder.embed_tiny()
         p = embedder.init_params(jax.random.PRNGKey(0), cfg)

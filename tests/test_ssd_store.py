@@ -218,3 +218,104 @@ class TestReviewRegressions:
         )
         with pytest.raises(StorageError, match="provider"):
             file_mgr.hydrate(out, allowed_prefixes=["runs/default/r"])
+
+
+class TestPinning:
+    """Live-run blobs must survive LRU pressure (ADVICE: blobcache LRU
+    could evict blobs that non-terminal runs still reference)."""
+
+    def test_pinned_prefix_survives_eviction(self, tmp_path):
+        s = SSDStore(str(tmp_path / "cache"), capacity_bytes=3 * 1100)
+        s.pin_prefix("runs/default/live/")
+        s.put("runs/default/live/a", b"p" * 1024)
+        for i in range(5):
+            s.put(f"cold/{i}", bytes([i]) * 1024)
+        # the pinned blob is the LRU-oldest yet must not be a victim
+        assert s.get("runs/default/live/a") == b"p" * 1024
+        s.close()
+
+    def test_unpin_restores_evictability(self, tmp_path):
+        s = SSDStore(str(tmp_path / "cache"), capacity_bytes=2 * 1100)
+        s.pin_prefix("runs/default/done/")
+        s.put("runs/default/done/a", b"q" * 1024)
+        s.unpin_prefix("runs/default/done/")
+        for i in range(4):
+            s.put(f"cold/{i}", bytes([i]) * 1024)
+        assert not s.exists("runs/default/done/a")
+        s.close()
+
+    def test_pin_refcounted(self, tmp_path):
+        s = SSDStore(str(tmp_path / "cache"), capacity_bytes=2 * 1100)
+        s.pin_prefix("runs/r/")
+        s.pin_prefix("runs/r/")
+        s.unpin_prefix("runs/r/")  # one pin still held
+        s.put("runs/r/a", b"z" * 1024)
+        for i in range(4):
+            s.put(f"cold/{i}", bytes([i]) * 1024)
+        assert s.exists("runs/r/a")
+        s.close()
+
+    def test_budget_exceeded_rather_than_evict_pinned(self, tmp_path):
+        s = SSDStore(str(tmp_path / "cache"), capacity_bytes=3 * 1100)
+        s.pin_prefix("runs/r/")
+        for i in range(3):
+            s.put(f"runs/r/{i}", bytes([i]) * 1024)
+        s.put("runs/r/extra", b"e" * 1024)  # over budget, all pinned
+        for i in range(3):
+            assert s.exists(f"runs/r/{i}")
+        assert s.exists("runs/r/extra")
+        assert s.used_bytes() > 3 * 1100  # budget yielded to live data
+        s.close()
+
+    def test_manager_pin_run_roundtrip(self, tmp_path):
+        mgr = StorageManager(
+            SSDStore(str(tmp_path / "cache"), capacity_bytes=3 * 1100),
+            max_inline_size=64,
+        )
+        mgr.pin_run("default", "r1")
+        mgr.store.put("runs/default/r1/steps/s/output", b"live" * 256)
+        for i in range(5):
+            mgr.store.put(f"cache/{i}", bytes([i]) * 1024)
+        assert mgr.store.exists("runs/default/r1/steps/s/output")
+        mgr.unpin_run("default", "r1")
+        mgr.unpin_run("default", "r1")  # double-unpin tolerated
+        mgr.store.close()
+
+
+class TestProviderPinning:
+    """slice_local_ssd.native pins one implementation (ADVICE medium:
+    autodetect could silently diverge between writer and reader)."""
+
+    def test_native_false_forces_python_layout(self, tmp_path):
+        from bobrapet_tpu.api.shared import SliceLocalSSDProvider, StoragePolicy
+        from bobrapet_tpu.storage import SliceLocalSSDStore, build_store
+
+        policy = StoragePolicy(slice_local_ssd=SliceLocalSSDProvider(
+            path=str(tmp_path / "ssd"), native=False))
+        store = build_store(policy)
+        assert isinstance(store, SliceLocalSSDStore)
+        assert store.provider == "slice-ssd"
+
+    def test_native_true_requires_toolchain(self, tmp_path, monkeypatch):
+        from bobrapet_tpu.api.shared import SliceLocalSSDProvider, StoragePolicy
+        from bobrapet_tpu.storage import build_store
+        import bobrapet_tpu.storage.ssd as ssd_mod
+
+        def boom(*a, **k):
+            raise ssd_mod.NativeUnavailable("no g++ in this image")
+
+        monkeypatch.setattr(ssd_mod.SSDStore, "__init__", boom)
+        policy = StoragePolicy(slice_local_ssd=SliceLocalSSDProvider(
+            path=str(tmp_path / "ssd"), native=True))
+        with pytest.raises(StorageError, match="native=true"):
+            build_store(policy)
+
+    def test_native_true_builds_native(self, tmp_path):
+        from bobrapet_tpu.api.shared import SliceLocalSSDProvider, StoragePolicy
+        from bobrapet_tpu.storage import build_store
+
+        policy = StoragePolicy(slice_local_ssd=SliceLocalSSDProvider(
+            path=str(tmp_path / "ssd"), native=True))
+        store = build_store(policy)
+        assert store.provider == "slice-ssd-native"
+        store.close()
