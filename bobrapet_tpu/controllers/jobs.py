@@ -16,11 +16,12 @@ from __future__ import annotations
 import logging
 import threading
 import traceback
+import uuid
 from typing import Any, Optional
 
 from ..api.enums import Phase
 from ..core.object import Resource, new_resource
-from ..core.store import ADDED, MODIFIED, ResourceStore, WatchEvent
+from ..core.store import ADDED, DELETED, MODIFIED, ResourceStore, WatchEvent
 from ..sdk import contract
 from ..sdk.context import EngramContext, EngramExit, resolve_entrypoint
 from .manager import Clock
@@ -81,6 +82,9 @@ class LocalGangExecutor:
         self.storage = storage
         self.clock = clock or Clock()
         self.mode = mode
+        # collision-free executor identity for claim arbitration (a
+        # truncated id(self) can collide across instances/processes)
+        self.executor_id = uuid.uuid4().hex
         self._cancels: dict[tuple[str, str], threading.Event] = {}
         self._lock = threading.Lock()
         store.watch(self._on_event, kinds=[JOB_KIND])
@@ -96,12 +100,16 @@ class LocalGangExecutor:
     # -- watch -------------------------------------------------------------
 
     def _on_event(self, ev: WatchEvent) -> None:
+        job = ev.resource
+        if ev.type == DELETED or job.meta.deletion_timestamp is not None:
+            # kubelet role: a deleted Job kills its still-running gang
+            # (graceful-cancel tears the Job down; threaded hosts must
+            # observe the cancel event, not just leak as daemon threads)
+            self.cancel(job.meta.namespace, job.meta.name)
+            return
         if ev.type not in (ADDED, MODIFIED):
             return
-        job = ev.resource
         if job.status.get("phase") in (None, "", str(Phase.PENDING)):
-            if job.meta.deletion_timestamp is not None:
-                return
             self._start(job)
 
     def _start(self, job: Resource) -> None:
@@ -117,34 +125,40 @@ class LocalGangExecutor:
             )
         except Exception:  # noqa: BLE001
             return
-        if claimed.status.get("executor") != id(self) % 100000:
+        if claimed.status.get("executor") != self.executor_id:
             return
+        # register the cancel event BEFORE any thread runs: a DELETED
+        # watch event landing between spawn and the gang thread's first
+        # instruction must still find something to set
+        ns, name = job.meta.namespace, job.meta.name
+        cancel = threading.Event()
+        with self._lock:
+            self._cancels[(ns, name)] = cancel
+        if self.store.try_get(JOB_KIND, ns, name) is None:
+            cancel.set()  # deleted before we registered — don't run blind
         if self.mode == "threaded":
             t = threading.Thread(
-                target=self._run_gang, args=(claimed,), daemon=True,
+                target=self._run_gang, args=(claimed, cancel), daemon=True,
                 name=f"gang-{job.meta.name}",
             )
             t.start()
         else:
-            self._run_gang(claimed)
+            self._run_gang(claimed, cancel)
 
     def _claim(self, r: Resource) -> None:
         if r.status.get("phase") in (None, "", str(Phase.PENDING)):
             r.status["phase"] = str(Phase.RUNNING)
             r.status["startedAt"] = self.clock.now()
-            r.status["executor"] = id(self) % 100000
+            r.status["executor"] = self.executor_id
 
     # -- gang execution ----------------------------------------------------
 
-    def _run_gang(self, job: Resource) -> None:
+    def _run_gang(self, job: Resource, cancel: threading.Event) -> None:
         ns, name = job.meta.namespace, job.meta.name
         spec = job.spec
         hosts = int(spec.get("hosts") or 1)
         entrypoint = spec.get("entrypoint") or ""
         timeout = spec.get("timeoutSeconds")
-        cancel = threading.Event()
-        with self._lock:
-            self._cancels[(ns, name)] = cancel
 
         host_results: list[dict[str, Any]] = [{} for _ in range(hosts)]
 

@@ -192,10 +192,16 @@ def resolve_materialize(
             str(conditions.Reason.REFERENCE_NOT_FOUND),
             str(conditions.Reason.TEMPLATE_NOT_FOUND),
         }
+        # the delegate's OWN engram ref is the truth here, not the
+        # currently-configured name — config may have moved on while the
+        # existing delegate still points at the old engram
+        delegate_engram = (existing.spec.get("engramRef") or {}).get(
+            "name", engram_name
+        )
         for cond in existing.status.get("conditions", []):
             if cond.get("reason") not in blocked_reasons:
                 continue
-            if _reference_still_broken(store, ns, engram_name):
+            if _reference_still_broken(store, ns, delegate_engram):
                 raise MaterializeFailed(
                     f"materialize delegate for step {step_name!r} is Blocked: "
                     f"{cond.get('message', 'engram reference not found')}"

@@ -175,3 +175,71 @@ class TestAdviceRegressions:
         r = rt.store.get("StoryRun", "default", run)
         assert r.status["phase"] == "Failed"
         assert "no-such-engram" in r.status["stepStates"]["gated"]["message"]
+
+    def test_blocked_delegate_checked_against_its_own_engram(self, rt):
+        """When the configured materialize engram changes AFTER a
+        delegate was created, the Blocked check must consult the
+        delegate's own engramRef (whose engram vanished), not the new
+        config value — else the dead delegate is polled forever."""
+        from bobrapet_tpu.api.runs import make_storyrun
+        from bobrapet_tpu.controllers.materialize import (
+            MaterializeFailed,
+            resolve_materialize,
+        )
+
+        _setup(rt, OffloadedDataPolicy.CONTROLLER)
+        rt.apply(make_engram_template("mat-y-tpl", entrypoint="mat-y-impl"))
+        rt.apply(make_engram("mat-y", "mat-y-tpl"))
+        run = rt.store.create(make_storyrun("r1", "mat", {}, "default"))
+        # delegate bound to the OLD configured engram mat-x (now gone),
+        # Blocked by the StepRun controller
+        delegate = new_resource(
+            "StepRun", materialize_name("r1", "gated"), "default",
+            spec={"storyRunRef": {"name": "r1"},
+                  "stepId": "gated#materialize",
+                  "engramRef": {"name": "mat-x"},
+                  "input": {"expression": "x", "scope": {}}},
+            owners=[run.owner_ref()],
+        )
+        delegate.status.update({
+            "phase": "Blocked",
+            "conditions": [{"type": "Ready", "status": "False",
+                            "reason": "ReferenceNotFound",
+                            "message": "engram 'mat-x' not found"}],
+        })
+        rt.store.create(delegate)
+        # config has moved on to healthy mat-y; the delegate is still dead
+        with pytest.raises(MaterializeFailed, match="Blocked"):
+            resolve_materialize(
+                rt.store, run, "gated", "x", {}, engram_name="mat-y"
+            )
+
+    def test_blocked_delegate_with_live_engram_keeps_polling(self, rt):
+        """Inverse: a delegate whose OWN engram is healthy must not be
+        failed just because the configured name is currently broken —
+        the stale Blocked condition self-heals."""
+        from bobrapet_tpu.api.runs import make_storyrun
+        from bobrapet_tpu.controllers.materialize import resolve_materialize
+
+        _setup(rt, OffloadedDataPolicy.CONTROLLER)
+        rt.apply(make_engram_template("mat-y-tpl", entrypoint="mat-y-impl"))
+        rt.apply(make_engram("mat-y", "mat-y-tpl"))
+        run = rt.store.create(make_storyrun("r2", "mat", {}, "default"))
+        delegate = new_resource(
+            "StepRun", materialize_name("r2", "gated"), "default",
+            spec={"storyRunRef": {"name": "r2"},
+                  "stepId": "gated#materialize",
+                  "engramRef": {"name": "mat-y"},
+                  "input": {"expression": "x", "scope": {}}},
+            owners=[run.owner_ref()],
+        )
+        delegate.status.update({
+            "phase": "Blocked",
+            "conditions": [{"type": "Ready", "status": "False",
+                            "reason": "ReferenceNotFound",
+                            "message": "stale"}],
+        })
+        rt.store.create(delegate)
+        assert resolve_materialize(
+            rt.store, run, "gated", "x", {}, engram_name="missing-now"
+        ) is None
