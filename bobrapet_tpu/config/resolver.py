@@ -16,7 +16,9 @@ layer that sets only ``retry.maxRetries`` inherits the rest).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import time
 from typing import Any, Optional
 
 from ..api.shared import (
@@ -33,8 +35,21 @@ from ..api.shared import (
     TPUPolicy,
     WorkloadSpec,
 )
+from ..observability.metrics import metrics
 from ..utils.duration import parse_duration
 from .operator import OperatorConfig
+
+
+@contextlib.contextmanager
+def _stage(name: str):
+    """Per-stage resolution observability (reference: stage chain with
+    metrics observer, internal/config/chain/chain.go:14-60)."""
+    started = time.monotonic()
+    try:
+        yield
+    finally:
+        metrics.resolver_stages.inc(name)
+        metrics.resolver_stage_duration.observe(time.monotonic() - started, name)
 
 
 @dataclasses.dataclass
@@ -116,35 +131,41 @@ class Resolver:
 
         # layer 2: template recommendations
         if template_spec is not None:
-            out.image = template_spec.image or out.image
-            out.entrypoint = template_spec.entrypoint or out.entrypoint
-            self._apply_policy(out, template_spec.execution_policy)
+            with _stage("template"):
+                out.image = template_spec.image or out.image
+                out.entrypoint = template_spec.entrypoint or out.entrypoint
+                self._apply_policy(out, template_spec.execution_policy)
 
         # layer 3: engram instance
         if engram_spec is not None:
-            self._apply_overrides(out, engram_spec.execution)
-            if engram_spec.workload is not None:
-                out.workload = _merge_spec(out.workload, engram_spec.workload)
+            with _stage("engram"):
+                self._apply_overrides(out, engram_spec.execution)
+                if engram_spec.workload is not None:
+                    out.workload = _merge_spec(out.workload, engram_spec.workload)
 
         # layer 4: story policy + step
         if story_policy is not None:
-            self._apply_policy(out, story_policy.execution)
-            if story_policy.storage is not None:
-                out.storage = _merge_spec(out.storage, story_policy.storage)
-            if story_policy.timeouts is not None and story_policy.timeouts.step:
-                out.timeout_seconds = parse_duration(story_policy.timeouts.step)
-            if (
-                story_policy.retries is not None
-                and story_policy.retries.step_retry_policy is not None
-            ):
-                out.retry = _merge_spec(out.retry, story_policy.retries.step_retry_policy)
+            with _stage("story"):
+                self._apply_policy(out, story_policy.execution)
+                if story_policy.storage is not None:
+                    out.storage = _merge_spec(out.storage, story_policy.storage)
+                if story_policy.timeouts is not None and story_policy.timeouts.step:
+                    out.timeout_seconds = parse_duration(story_policy.timeouts.step)
+                if (
+                    story_policy.retries is not None
+                    and story_policy.retries.step_retry_policy is not None
+                ):
+                    out.retry = _merge_spec(out.retry, story_policy.retries.step_retry_policy)
         if step is not None:
-            self._apply_overrides(out, step.execution)
-            if step.tpu is not None:
-                out.tpu = _merge_spec(out.tpu, step.tpu)
+            with _stage("step"):
+                self._apply_overrides(out, step.execution)
+                if step.tpu is not None:
+                    out.tpu = _merge_spec(out.tpu, step.tpu)
 
         # layer 5: steprun runtime overrides
-        self._apply_overrides(out, steprun_overrides)
+        if steprun_overrides is not None:
+            with _stage("steprun"):
+                self._apply_overrides(out, steprun_overrides)
 
         if out.storage is not None and out.storage.max_inline_size is not None:
             out.max_inline_size = out.storage.max_inline_size

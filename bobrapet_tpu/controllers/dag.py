@@ -689,18 +689,30 @@ class DAGEngine:
             if not StepState.from_dict(raw).is_terminal and not _is_queued_state(raw)
         )
         limit = story.policy.concurrency if story.policy else None
-        if limit is not None and running_here >= limit:
-            return REASON_CONCURRENCY_QUEUED
+        if limit is not None:
+            story_name = (run.spec.get("storyRef") or {}).get("name", "")
+            scope = f"story:{run.meta.namespace}/{story_name}"
+            metrics.quota_usage.set(running_here, scope)
+            metrics.quota_limit.set(limit, scope)
+            if running_here >= limit:
+                metrics.quota_violations.inc(scope)
+                return REASON_CONCURRENCY_QUEUED
         cfg = self.config_manager.config.scheduling
         if queue:
             q = cfg.queue(queue)
             if q.max_concurrent:
                 active = self._active_stepruns_in_queue(queue)
+                metrics.quota_usage.set(active, f"queue:{queue}")
+                metrics.quota_limit.set(q.max_concurrent, f"queue:{queue}")
                 if active >= q.max_concurrent:
+                    metrics.quota_violations.inc(f"queue:{queue}")
                     return REASON_SCHEDULING_QUEUED
         if cfg.global_max_concurrent_steps:
             active = self._active_stepruns_in_queue(None)
+            metrics.quota_usage.set(active, "global")
+            metrics.quota_limit.set(cfg.global_max_concurrent_steps, "global")
             if active >= cfg.global_max_concurrent_steps:
+                metrics.quota_violations.inc("global")
                 return REASON_SCHEDULING_QUEUED
         return None
 

@@ -22,6 +22,7 @@ from typing import Any, Optional
 from ..api.enums import Phase
 from ..core.object import Resource, new_resource
 from ..core.store import ADDED, DELETED, MODIFIED, ResourceStore, WatchEvent
+from ..observability.metrics import metrics
 from ..sdk import contract
 from ..sdk.context import EngramContext, EngramExit, resolve_entrypoint
 from .manager import Clock
@@ -247,6 +248,11 @@ class LocalGangExecutor:
                 exit_code = code
                 message = r.get("message", "")
         finished = self.clock.now()
+        outcome = "success" if exit_code == 0 else "failure"
+        metrics.job_executions.inc(outcome)
+        started_at = job.status.get("startedAt")
+        if started_at is not None:
+            metrics.job_execution_duration.observe(finished - started_at, outcome)
 
         def finish(status: dict[str, Any]) -> None:
             status["phase"] = str(Phase.SUCCEEDED if exit_code == 0 else Phase.FAILED)

@@ -33,6 +33,7 @@ from ..api.enums import Phase
 from ..api.runs import STEP_RUN_KIND
 from ..core.object import Resource, new_resource
 from ..core.store import AlreadyExists, ResourceStore
+from ..observability.metrics import metrics
 from ..utils.naming import compose_unique
 from .step_executor import (
     LABEL_PARENT_STEP,
@@ -160,6 +161,7 @@ def resolve_materialize(
         )
         try:
             store.create(sr)
+            metrics.child_stepruns_created.inc("materialize")
         except AlreadyExists:
             return None  # concurrent creator wins; poll next pass
         _log.debug("materialize StepRun %s created for step %s", name, step_name)

@@ -27,6 +27,7 @@ from ..api.engram import KIND as ENGRAM_KIND, parse_engram
 from ..api.story import StorySpec
 from ..core.object import Resource, new_resource
 from ..core.store import AlreadyExists, ResourceStore
+from ..observability.metrics import metrics
 
 _log = logging.getLogger(__name__)
 
@@ -180,6 +181,7 @@ class RunRBACManager:
         (reference: ownership validation against SA hijack, rbac.go)."""
         try:
             self.store.create(desired)
+            metrics.rbac_ops.inc("create")
             return
         except AlreadyExists:
             pass
@@ -200,6 +202,7 @@ class RunRBACManager:
             self.store.mutate(
                 desired.kind, desired.meta.namespace, desired.meta.name, sync
             )
+            metrics.rbac_ops.inc("update")
 
 
 def objects_hash(specs: list[dict[str, Any]]) -> str:

@@ -44,6 +44,7 @@ from ..api.story import KIND as STORY_KIND, Step, parse_story
 from ..api.transport import TRANSPORT_KIND
 from ..core.events import EventRecorder
 from ..core.store import NotFound, ResourceStore
+from ..observability.metrics import metrics
 
 _log = logging.getLogger(__name__)
 
@@ -270,6 +271,8 @@ class EngramController:
         )
         now = self.clock.now() if self.clock else 0.0
         inc = _consume_tokens(self.store, stepruns, ANNO_COUNTED_ENGRAM, now)
+        if engram.status.get("usageCount") != len(stories):
+            metrics.story_dirty_marks.inc()
 
         def patch(st: dict[str, Any]) -> None:
             st["phase"] = str(Phase.FAILED if errors else Phase.RUNNING)

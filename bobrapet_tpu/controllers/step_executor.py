@@ -28,6 +28,7 @@ from ..api.runs import STEP_RUN_KIND, STORY_RUN_KIND, StepState
 from ..api.story import Step, StorySpec
 from ..core.object import Resource, new_resource
 from ..core.store import AlreadyExists, ResourceStore
+from ..observability.metrics import metrics
 from ..parallel.placement import NoCapacity, SlicePlacer
 from ..storage.manager import StorageManager
 from ..templating.engine import Evaluator, TemplateError
@@ -165,6 +166,9 @@ class StepExecutor:
         )
         try:
             self.store.create(sr)
+            metrics.child_stepruns_created.inc(
+                "parallel-branch" if parent_step else "engram"
+            )
         except AlreadyExists:
             # deterministic name -> adopt (drift detection: if the adopted
             # spec diverges, patch it; reference: drift detection/patch).
@@ -352,6 +356,7 @@ class StepExecutor:
         )
         try:
             self.store.create(child)
+            metrics.child_stepruns_created.inc("sub-story")
         except AlreadyExists:
             pass
         if not wait:

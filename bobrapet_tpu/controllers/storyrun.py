@@ -15,6 +15,7 @@ two-phase retention (children TTL, then run record).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Optional
 
 from ..api import conditions
@@ -430,6 +431,7 @@ class StoryRunController:
                 continue
             try:
                 self.store.delete(STEP_RUN_KIND, ns, sr.meta.name)
+                metrics.dependents_deleted.inc()
             except NotFound:
                 pass
 
@@ -477,13 +479,18 @@ class StoryRunController:
         retention = cfg.storyrun_retention_seconds
 
         if now - finished >= children_ttl and not run.status.get("childrenCleanedAt"):
+            sweep_started = time.monotonic()
             for sr in self.store.list(
                 STEP_RUN_KIND, namespace=ns, index=(INDEX_STEPRUN_STORYRUN, name)
             ):
                 try:
                     self.store.delete(STEP_RUN_KIND, ns, sr.meta.name)
+                    metrics.cleanup_ops.inc("steprun")
                 except NotFound:
                     pass
+            metrics.cleanup_duration.observe(
+                time.monotonic() - sweep_started, "children"
+            )
 
             def mark(status: dict[str, Any]) -> None:
                 status["childrenCleanedAt"] = now

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import logging
+import time
 from typing import Any, Optional
 
 from ..api import conditions
@@ -60,6 +61,11 @@ _log = logging.getLogger(__name__)
 SERVICE_KIND = "Service"
 DEPLOYMENT_KIND = "Deployment"
 STATEFULSET_KIND = "StatefulSet"
+
+#: running realtime steps re-reconcile at this cadence to refresh their
+#: binding's heartbeat; must be well below the Transport controller's
+#: staleness window so a quiet healthy topology never reads as stale
+HEARTBEAT_REFRESH = 600.0
 CANCEL_ANNOTATION = "runs.bobrapet.io/cancel"
 
 
@@ -191,6 +197,14 @@ def _offered(step, kind: str) -> Optional[MediaBinding]:
 def _ensure_binding(ctrl, sr, spec, ctx):
     """(reference: ensureRunTransportBinding steprun_controller.go:3701;
     codec negotiation via pkg/transport/codecs.go:11,58)"""
+    started = time.monotonic()
+    try:
+        return _ensure_binding_inner(ctrl, sr, spec, ctx)
+    finally:
+        metrics.binding_op_duration.observe(time.monotonic() - started, "ensure")
+
+
+def _ensure_binding_inner(ctrl, sr, spec, ctx):
     ns = sr.meta.namespace
     transport = ctx["transport"]
     tspec = parse_transport(transport)
@@ -326,6 +340,7 @@ def _ensure_downstream_targets(ctrl, sr, ctx, svc_name, port):
                 STEP_RUN_KIND, ns, sr.meta.name,
                 lambda r: r.spec.__setitem__("downstreamTargets", targets),
             )
+            metrics.downstream_target_mutations.inc()
         except NotFound:
             pass
     return targets
@@ -450,6 +465,27 @@ def _derive_phase(ctrl, sr, binding, deployment, svc_name, port):
     ready_replicas = int(deployment.status.get("readyReplicas", 0))
     dep_ready = ready_replicas >= int(deployment.spec.get("replicas", 1))
 
+    # connector-heartbeat role: a binding whose workers are up counts as
+    # heartbeating (a real connector stamps this itself; locally the
+    # controller observes workload readiness), which keeps the Transport
+    # controller's staleness sweep meaningful outside unit tests. The
+    # refresh is rate-limited: re-stamping every reconcile would emit a
+    # watch event that triggers the next reconcile (hot loop). A running
+    # step requeues itself at HEARTBEAT_REFRESH so a quiescent healthy
+    # topology keeps beating with no external events.
+    requeue = None
+    if binding is not None and binding_ready and dep_ready:
+        last_beat = binding.status.get("heartbeatAt")
+        if last_beat is None or now - last_beat >= 30.0:
+            try:
+                ctrl.store.patch_status(
+                    TRANSPORT_BINDING_KIND, ns, binding.meta.name,
+                    lambda st: st.update({"heartbeatAt": now}),
+                )
+            except NotFound:
+                pass
+        requeue = HEARTBEAT_REFRESH
+
     def patch(st: dict[str, Any]) -> None:
         st["serviceName"] = svc_name
         st["endpoint"] = f"{svc_name}.{ns}.svc:{port}"
@@ -474,7 +510,7 @@ def _derive_phase(ctrl, sr, binding, deployment, svc_name, port):
             )
 
     ctrl.store.patch_status(STEP_RUN_KIND, ns, name, patch)
-    return None
+    return requeue
 
 
 def _terminate_topology(ctrl, sr):

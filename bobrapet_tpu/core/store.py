@@ -265,6 +265,12 @@ class ResourceStore:
                 keys = self._index_buckets[(kind, index[0])].get(index[1], set())
                 candidates = [self._objects[k] for k in keys if k in self._objects]
             else:
+                if labels:
+                    # label-filtered full scan — the no-index path the
+                    # reference counts as an index fallback
+                    from ..observability.metrics import metrics
+
+                    metrics.index_fallbacks.inc(kind)
                 candidates = [o for (k, _, _), o in self._objects.items() if k == kind]
             for obj in candidates:
                 if obj.kind != kind:

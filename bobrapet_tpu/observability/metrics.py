@@ -290,6 +290,11 @@ class _ControlPlaneMetrics:
         self.template_evaluations = c(
             "bobrapet_template_evaluations_total", "Template evaluations", ["outcome"]
         )
+        self.template_cache = c(
+            "bobrapet_template_cache_lookups_total",
+            "Compiled-expression cache probes (reference: bobrapet_cel_cache_hits_total)",
+            ["result"],
+        )
         self.template_eval_duration = h(
             "bobrapet_template_evaluation_duration_seconds",
             "Template evaluation latency",
@@ -299,6 +304,11 @@ class _ControlPlaneMetrics:
         # Job / gang execution
         self.job_executions = c(
             "bobrapet_job_executions_total", "Gang job launches", ["outcome"]
+        )
+        self.job_execution_duration = h(
+            "bobrapet_job_execution_duration_seconds",
+            "Gang job wall-clock by outcome",
+            ["outcome"],
         )
         self.gang_chips_in_use = g(
             "bobrapet_gang_chips_in_use", "TPU chips currently granted", []
@@ -318,6 +328,17 @@ class _ControlPlaneMetrics:
         )
         self.stream_dropped = c(
             "bobravoz_grpc_messages_dropped_total", "Messages dropped", ["reason"]
+        )
+        self.stream_requests = c(
+            "bobravoz_stream_requests_total", "Stream open requests", ["kind"]
+        )
+        self.stream_duration = h(
+            "bobravoz_stream_duration_seconds", "Stream lifetime", ["lane"]
+        )
+        self.binding_op_duration = h(
+            "bobrapet_transport_binding_operation_duration_seconds",
+            "Binding ensure/negotiation latency",
+            ["op"],
         )
         # Storage family
         self.storage_ops = c(
@@ -342,12 +363,72 @@ class _ControlPlaneMetrics:
         self.cleanup_ops = c(
             "bobrapet_cleanup_ops_total", "Retention cleanups", ["kind"]
         )
+        self.cleanup_duration = h(
+            "bobrapet_resource_cleanup_duration_seconds",
+            "Retention cleanup latency",
+            ["kind"],
+        )
+        # Scheduling quota (reference: bobrapet_resource_quota_{usage,limit},
+        # bobrapet_quota_violation_total — scopes map to this framework's
+        # story/queue/global concurrency gates)
+        self.quota_usage = g(
+            "bobrapet_resource_quota_usage", "Active units per scheduling scope", ["scope"]
+        )
+        self.quota_limit = g(
+            "bobrapet_resource_quota_limit", "Configured cap per scheduling scope", ["scope"]
+        )
+        self.quota_violations = c(
+            "bobrapet_quota_violation_total",
+            "Step launches parked by a scheduling limit",
+            ["scope"],
+        )
+        # Run-scoped RBAC + redrive + usage-count machinery
+        self.rbac_ops = c(
+            "bobrapet_storyrun_rbac_operations_total",
+            "Run-scoped RBAC object writes",
+            ["op"],
+        )
+        self.dependents_deleted = c(
+            "bobrapet_storyrun_dependents_deleted_total",
+            "Child runs deleted by redrive-from-step",
+            [],
+        )
+        self.story_dirty_marks = c(
+            "bobrapet_story_dirty_marks_total",
+            "Usage-count dirty marks on Story/Engram",
+            [],
+        )
+        self.child_stepruns_created = c(
+            "bobrapet_child_stepruns_created_total",
+            "StepRun CRs created by the step executor",
+            ["kind"],
+        )
+        self.downstream_target_mutations = c(
+            "bobrapet_downstream_target_mutations_total",
+            "Downstream-target patches on dependent StepRuns",
+            [],
+        )
+        self.impulse_throttled = g(
+            "bobrapet_impulse_throttled_triggers",
+            "Triggers throttled per impulse (stats sync)",
+            ["impulse"],
+        )
+        self.index_fallbacks = c(
+            "bobrapet_controller_index_fallback_total",
+            "List calls that fell back to a full scan",
+            ["kind"],
+        )
         # Config resolver stage timings (reference: internal/config/chain/chain.go)
         self.resolver_stage_duration = h(
             "bobrapet_resolver_stage_duration_seconds",
             "Per-stage config resolution time",
             ["stage"],
             buckets=(0.00001, 0.0001, 0.001, 0.01, 0.1),
+        )
+        self.resolver_stages = c(
+            "bobrapet_resolver_stage_total",
+            "Config resolution stages applied",
+            ["stage"],
         )
         # Reconcile machinery
         self.reconcile_total = c(

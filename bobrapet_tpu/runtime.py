@@ -149,12 +149,13 @@ class Runtime:
         self.effectclaim_controller = EffectClaimController(
             self.store, recorder=self.recorder, clock=self.clock
         )
-        # heartbeats come from live connectors; the local runtime has none,
-        # so staleness sweeps are disabled by default (tests pass a finite
-        # timeout to exercise them)
+        # heartbeats: the streaming controller stamps bindings whose
+        # workers are up (connector role) and requeues running steps at
+        # HEARTBEAT_REFRESH, so a healthy topology keeps beating and the
+        # Transport controller's staleness sweep runs for real.
         self.transport_controller = TransportController(
             self.store, recorder=self.recorder, clock=self.clock,
-            heartbeat_timeout=float("inf"),
+            heartbeat_timeout=3600.0,
         )
         self.job_executor = LocalGangExecutor(
             self.store, storage=self.storage, clock=self.clock, mode=executor_mode

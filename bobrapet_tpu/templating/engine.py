@@ -31,6 +31,7 @@ import ast
 import contextlib
 import dataclasses
 import re
+import threading
 import time
 from typing import Any, Iterable, Optional
 
@@ -227,8 +228,17 @@ class Evaluator:
         }
     """
 
+    #: compiled-expression cache cap; template sets are small and
+    #: repetitive (the same `if`/`with` expressions re-evaluate every
+    #: reconcile), so a bounded FIFO keeps wins without unbounded growth
+    _CACHE_MAX = 1024
+
     def __init__(self, config: Optional[TemplateConfig] = None):
         self.config = config or TemplateConfig()
+        # the Evaluator is shared across webhook callers (any thread)
+        # and the dispatcher, so cache mutation needs the lock
+        self._parse_cache: dict[str, ast.Expression] = {}
+        self._cache_lock = threading.Lock()
 
     # -- public API --------------------------------------------------------
 
@@ -386,6 +396,12 @@ class Evaluator:
         return _TEMPLATE_RE.sub(replace, text)
 
     def _parse(self, expr: str) -> ast.Expression:
+        with self._cache_lock:
+            cached = self._parse_cache.get(expr)
+        if cached is not None:
+            metrics.template_cache.inc("hit")
+            return cached
+        metrics.template_cache.inc("miss")
         try:
             tree = ast.parse(expr, mode="eval")
         except SyntaxError as e:
@@ -399,6 +415,10 @@ class Evaluator:
                 raise TemplateValidationError(
                     f"forbidden construct {type(node).__name__} in {expr[:80]!r}"
                 )
+        with self._cache_lock:
+            if len(self._parse_cache) >= self._CACHE_MAX:
+                self._parse_cache.pop(next(iter(self._parse_cache)), None)
+            self._parse_cache[expr] = tree
         return tree
 
     def _eval_expression(self, expr: str, scope: dict[str, Any], deadline: float) -> Any:

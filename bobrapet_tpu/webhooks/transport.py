@@ -25,6 +25,200 @@ _VALID_DELIVERY = {"atMostOnce", "atLeastOnce"}
 _VALID_ORDERING = {"none", "perKey", "total"}
 _VALID_ROUTING_MODES = {"auto", "hub", "p2p"}
 _VALID_FAN_IN = {"merge", "zip", "quorum"}
+_VALID_FLOW_MODES = {"none", "credits"}
+_VALID_REPLAY_MODES = {"none", "fromCheckpoint", "full"}
+_VALID_PARTITION_MODES = {"none", "keyHash", "roundRobin"}
+_VALID_FAN_OUT = {"all", "first", "roundRobin"}
+_VALID_RULE_ACTIONS = {"route", "drop", "duplicate"}
+_VALID_LIFECYCLE = {"drain", "cutover"}
+_VALID_RECORDING = {"none", "sample", "full"}
+
+
+def validate_streaming_settings(st, errs: FieldErrors, path: str) -> None:
+    """Enforcement-grade coherence validation of the streaming policy
+    language: combinations the data plane cannot honor are REJECTED at
+    admission rather than silently ignored (reference semantics:
+    transport_settings_types.go:21-528 + pkg/transport/validation)."""
+    if st.backpressure and st.backpressure.buffer:
+        _validate_buffer(st.backpressure.buffer, errs, f"{path}.backpressure.buffer")
+    fc = st.flow_control
+    if fc is not None:
+        if fc.mode not in (None, *_VALID_FLOW_MODES):
+            errs.add(f"{path}.flowControl.mode",
+                     f"must be one of {sorted(_VALID_FLOW_MODES)}")
+        if fc.mode == "credits":
+            credits = fc.initial_credits
+            has_positive = credits is not None and (
+                (credits.messages or 0) > 0 or (credits.bytes or 0) > 0
+            )
+            if not has_positive:
+                errs.add(
+                    f"{path}.flowControl.initialCredits",
+                    "mode=credits requires initialCredits.messages or .bytes > 0",
+                )
+            for holder, nm in ((credits, "initialCredits"), (fc.ack_every, "ackEvery")):
+                if holder is None:
+                    continue
+                for field, camel in (("messages", "messages"), ("bytes", "bytes")):
+                    v = getattr(holder, field)
+                    if v is not None and v < 1:
+                        errs.add(f"{path}.flowControl.{nm}.{camel}", "must be >= 1")
+        elif fc.mode in (None, "none"):
+            # credit knobs without credit mode are inert — reject
+            for field, v in (
+                ("initialCredits", fc.initial_credits),
+                ("ackEvery", fc.ack_every),
+                ("pauseThreshold", fc.pause_threshold),
+                ("resumeThreshold", fc.resume_threshold),
+            ):
+                if v is not None:
+                    errs.add(f"{path}.flowControl.{field}",
+                             "only meaningful with flowControl.mode=credits")
+        pause, resume = fc.pause_threshold, fc.resume_threshold
+        for nm, th in (("pauseThreshold", pause), ("resumeThreshold", resume)):
+            if th is not None and th.buffer_pct is not None and not (
+                0 < th.buffer_pct <= 100
+            ):
+                errs.add(f"{path}.flowControl.{nm}.bufferPct", "must be in (0, 100]")
+        if (
+            pause is not None and resume is not None
+            and pause.buffer_pct is not None and resume.buffer_pct is not None
+            and resume.buffer_pct >= pause.buffer_pct
+        ):
+            errs.add(f"{path}.flowControl.resumeThreshold.bufferPct",
+                     "must be below pauseThreshold.bufferPct (hysteresis)")
+    d = st.delivery
+    if d is not None:
+        if d.semantics not in (None, *_VALID_DELIVERY):
+            errs.add(f"{path}.delivery.semantics",
+                     f"must be one of {sorted(_VALID_DELIVERY)}")
+        if d.ordering not in (None, *_VALID_ORDERING):
+            errs.add(f"{path}.delivery.ordering",
+                     f"must be one of {sorted(_VALID_ORDERING)}")
+        if d.semantics == "atLeastOnce" and (
+            fc is None or fc.mode != "credits" or fc.ack_every is None
+        ):
+            errs.add(
+                f"{path}.delivery.semantics",
+                "atLeastOnce requires flowControl.mode=credits with ackEvery "
+                "(redelivery rides the ack protocol)",
+            )
+        r = d.replay
+        if r is not None:
+            if r.mode not in (None, *_VALID_REPLAY_MODES):
+                errs.add(f"{path}.delivery.replay.mode",
+                         f"must be one of {sorted(_VALID_REPLAY_MODES)}")
+            if r.mode == "fromCheckpoint" and not r.checkpoint_interval:
+                errs.add(f"{path}.delivery.replay.checkpointInterval",
+                         "required for replay.mode=fromCheckpoint")
+            if r.mode == "full" and not r.retention_seconds:
+                errs.add(f"{path}.delivery.replay.retentionSeconds",
+                         "required for replay.mode=full")
+            if r.mode in (None, "none") and (
+                r.retention_seconds or r.checkpoint_interval
+            ):
+                errs.add(f"{path}.delivery.replay",
+                         "retention/checkpoint only meaningful with replay enabled")
+        if (
+            d.ordering == "total"
+            and st.partitioning is not None
+            and st.partitioning.mode in ("keyHash", "roundRobin")
+        ):
+            errs.add(f"{path}.delivery.ordering",
+                     "ordering=total cannot be honored across partitions")
+    p = st.partitioning
+    if p is not None:
+        if p.mode not in (None, *_VALID_PARTITION_MODES):
+            errs.add(f"{path}.partitioning.mode",
+                     f"must be one of {sorted(_VALID_PARTITION_MODES)}")
+        if p.mode == "keyHash" and not p.key:
+            errs.add(f"{path}.partitioning.key", "required for mode=keyHash")
+        if p.partitions is not None and p.partitions < 1:
+            errs.add(f"{path}.partitioning.partitions", "must be >= 1")
+        if p.mode == "roundRobin" and p.sticky:
+            errs.add(f"{path}.partitioning.sticky",
+                     "sticky assignment contradicts roundRobin")
+    ro = st.routing
+    if ro is not None:
+        if ro.mode not in (None, *_VALID_ROUTING_MODES):
+            errs.add(f"{path}.routing.mode",
+                     f"must be one of {sorted(_VALID_ROUTING_MODES)}")
+        if ro.fan_out not in (None, *_VALID_FAN_OUT):
+            errs.add(f"{path}.routing.fanOut",
+                     f"must be one of {sorted(_VALID_FAN_OUT)}")
+        if ro.max_downstreams is not None and ro.max_downstreams < 1:
+            errs.add(f"{path}.routing.maxDownstreams", "must be >= 1")
+        for i, rule in enumerate(ro.rules):
+            if rule.action not in (None, *_VALID_RULE_ACTIONS):
+                errs.add(f"{path}.routing.rules[{i}].action",
+                         f"must be one of {sorted(_VALID_RULE_ACTIONS)}")
+            if rule.action in ("route", "duplicate") and (
+                rule.target is None or not rule.target.steps
+            ):
+                errs.add(f"{path}.routing.rules[{i}].target.steps",
+                         f"required for action={rule.action}")
+            if not rule.when:
+                errs.add(f"{path}.routing.rules[{i}].when",
+                         "routing rule requires a condition")
+    fi = st.fan_in
+    if fi is not None:
+        if fi.mode not in (None, *_VALID_FAN_IN):
+            errs.add(f"{path}.fanIn.mode",
+                     f"must be one of {sorted(_VALID_FAN_IN)}")
+        if fi.mode == "quorum" and not fi.quorum:
+            errs.add(f"{path}.fanIn.quorum", "required for mode=quorum")
+        if fi.quorum is not None and fi.quorum < 1:
+            errs.add(f"{path}.fanIn.quorum", "must be >= 1")
+        if fi.mode != "quorum" and fi.quorum:
+            errs.add(f"{path}.fanIn.quorum",
+                     "only meaningful with fanIn.mode=quorum")
+        if fi.buffer is not None:
+            _validate_buffer(fi.buffer, errs, f"{path}.fanIn.buffer")
+    lc = st.lifecycle
+    if lc is not None:
+        if lc.strategy not in (None, *_VALID_LIFECYCLE):
+            errs.add(f"{path}.lifecycle.strategy",
+                     f"must be one of {sorted(_VALID_LIFECYCLE)}")
+        if lc.drain_timeout_seconds is not None and lc.drain_timeout_seconds < 0:
+            errs.add(f"{path}.lifecycle.drainTimeoutSeconds", "must be >= 0")
+        if lc.strategy == "cutover" and lc.drain_timeout_seconds:
+            errs.add(f"{path}.lifecycle.drainTimeoutSeconds",
+                     "only meaningful with strategy=drain")
+    rec = st.recording
+    if rec is not None:
+        if rec.mode not in (None, *_VALID_RECORDING):
+            errs.add(f"{path}.recording.mode",
+                     f"must be one of {sorted(_VALID_RECORDING)}")
+        if rec.mode == "sample" and not (
+            rec.sample_rate and 0 < rec.sample_rate <= 100
+        ):
+            errs.add(f"{path}.recording.sampleRate",
+                     "mode=sample requires sampleRate in (0, 100]")
+        if rec.mode in (None, "none") and (
+            rec.sample_rate or rec.retention_seconds or rec.redact_fields
+        ):
+            errs.add(f"{path}.recording",
+                     "recording knobs only meaningful with mode != none")
+    for i, lane in enumerate(st.lanes):
+        for field in ("max_messages", "max_bytes"):
+            v = getattr(lane, field)
+            if v is not None and v < 1:
+                camel = "maxMessages" if field == "max_messages" else "maxBytes"
+                errs.add(f"{path}.lanes[{i}].{camel}", "must be >= 1")
+
+
+def _validate_buffer(buf, errs: FieldErrors, path: str) -> None:
+    if buf.drop_policy not in (None, *_VALID_DROP_POLICIES):
+        errs.add(f"{path}.dropPolicy",
+                 f"must be one of {sorted(_VALID_DROP_POLICIES)}")
+    for field, camel in (
+        ("max_messages", "maxMessages"),
+        ("max_bytes", "maxBytes"),
+        ("max_age_seconds", "maxAgeSeconds"),
+    ):
+        v = getattr(buf, field)
+        if v is not None and v < 1:
+            errs.add(f"{path}.{camel}", "must be >= 1")
 
 
 class TransportWebhook:
@@ -55,40 +249,7 @@ class TransportWebhook:
 
         st = spec.streaming
         if st is not None:
-            if st.backpressure and st.backpressure.buffer:
-                buf = st.backpressure.buffer
-                if buf.drop_policy not in (None, *_VALID_DROP_POLICIES):
-                    errs.add(
-                        "spec.streaming.backpressure.buffer.dropPolicy",
-                        f"must be one of {sorted(_VALID_DROP_POLICIES)}",
-                    )
-            if st.delivery:
-                if st.delivery.semantics not in (None, *_VALID_DELIVERY):
-                    errs.add(
-                        "spec.streaming.delivery.semantics",
-                        f"must be one of {sorted(_VALID_DELIVERY)}",
-                    )
-                if st.delivery.ordering not in (None, *_VALID_ORDERING):
-                    errs.add(
-                        "spec.streaming.delivery.ordering",
-                        f"must be one of {sorted(_VALID_ORDERING)}",
-                    )
-            if st.routing:
-                if st.routing.mode not in (None, *_VALID_ROUTING_MODES):
-                    errs.add(
-                        "spec.streaming.routing.mode",
-                        f"must be one of {sorted(_VALID_ROUTING_MODES)}",
-                    )
-                if st.routing.max_downstreams is not None and st.routing.max_downstreams < 1:
-                    errs.add("spec.streaming.routing.maxDownstreams", "must be >= 1")
-            if st.fan_in:
-                if st.fan_in.mode not in (None, *_VALID_FAN_IN):
-                    errs.add(
-                        "spec.streaming.fanIn.mode",
-                        f"must be one of {sorted(_VALID_FAN_IN)}",
-                    )
-                if st.fan_in.mode == "quorum" and not st.fan_in.quorum:
-                    errs.add("spec.streaming.fanIn.quorum", "required for mode=quorum")
+            validate_streaming_settings(st, errs, "spec.streaming")
             seen_lanes = set()
             for i, lane in enumerate(st.lanes):
                 if not lane.name:
@@ -125,5 +286,11 @@ class TransportBindingWebhook:
             mb = getattr(spec, kind)
             if mb is not None and mb.direction not in (None, "send", "receive", "both"):
                 errs.add(f"spec.{kind}.direction", "must be send|receive|both")
+        # NOTE: spec.rawSettings is deliberately NOT coherence-validated
+        # here — it is controller-written merge output (transport ->
+        # story -> step), and a per-field deep merge of individually
+        # coherent layers can be locally incoherent (e.g. a step
+        # override mode=none retains upper-layer credit knobs). User
+        # input is validated at its own admission point.
 
         errs.raise_if_any()

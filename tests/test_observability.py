@@ -78,19 +78,80 @@ class TestMetricPrimitives:
 
 class TestControlPlaneFamilies:
     def test_reference_series_present(self):
-        # spot-check the reference inventory (controller_metrics.go:44-442)
+        # the reference inventory parity list (controller_metrics.go:44-442,
+        # transport.go:11-35): every capability family must have a series
         for name in [
             "bobrapet_storyrun_duration_seconds",
             "bobrapet_storyrun_queue_depth",
+            "bobrapet_storyrun_queue_age_seconds",
+            "bobrapet_storyrun_rbac_operations_total",
+            "bobrapet_storyrun_dependents_deleted_total",
             "bobrapet_steprun_retries_total",
             "bobrapet_steprun_cache_lookups_total",
+            "bobrapet_steprun_duration_seconds",
+            "bobrapet_child_stepruns_created_total",
             "bobrapet_dag_iteration_steps",
             "bobrapet_template_evaluation_duration_seconds",
+            "bobrapet_template_evaluations_total",
+            "bobrapet_template_cache_lookups_total",
+            "bobrapet_resolver_stage_duration_seconds",
+            "bobrapet_resolver_stage_total",
+            "bobrapet_resource_quota_usage",
+            "bobrapet_resource_quota_limit",
+            "bobrapet_quota_violation_total",
+            "bobrapet_resource_cleanup_duration_seconds",
+            "bobrapet_cleanup_ops_total",
+            "bobrapet_job_executions_total",
+            "bobrapet_job_execution_duration_seconds",
+            "bobrapet_story_dirty_marks_total",
+            "bobrapet_controller_index_fallback_total",
+            "bobrapet_mapper_failures_total",
+            "bobrapet_downstream_target_mutations_total",
+            "bobrapet_impulse_throttled_triggers",
+            "bobrapet_transport_binding_ops_total",
+            "bobrapet_transport_binding_operation_duration_seconds",
+            "bobrapet_transport_bindings",
             "bobravoz_grpc_messages_total",
+            "bobravoz_grpc_messages_dropped_total",
+            "bobravoz_stream_requests_total",
+            "bobravoz_stream_duration_seconds",
             "bobrapet_trigger_decisions_total",
+            "bobrapet_trigger_backfills_total",
+            "bobrapet_effectclaim_transitions_total",
             "bobrapet_reconcile_duration_seconds",
+            "bobrapet_reconcile_total",
+            "bobrapet_storage_ops_total",
+            "bobrapet_storage_offloaded_bytes_total",
+            "bobrapet_gang_chips_in_use",
+            "bobrapet_slice_placements_total",
         ]:
             assert REGISTRY.get(name) is not None, name
+
+    def test_new_families_record(self, rt):
+        """The round-2 families actually get data from the control
+        plane, not just registered names."""
+        REGISTRY.reset()
+        rt.apply(make_engram_template("nf-tpl", entrypoint="nf-impl"))
+        rt.apply(_mk_engram("nf-engram", "nf-tpl"))
+        register_engram("nf-impl")(lambda ctx: {"ok": True})
+        story = _mk_story(
+            "nf-story",
+            steps=[{"name": "a", "ref": {"name": "nf-engram"},
+                    "if": "{{ inputs.go }}"}],
+        )
+        story.spec["policy"] = {"concurrency": 4}
+        rt.apply(story)
+        run = rt.run_story("nf-story", inputs={"go": True})
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert metrics.child_stepruns_created.value("engram") >= 1
+        assert metrics.job_execution_duration.count("success") >= 1
+        hits = metrics.template_cache.value("hit")
+        misses = metrics.template_cache.value("miss")
+        assert misses >= 1 and hits + misses >= 1
+        assert metrics.rbac_ops.value("create") >= 1
+        assert metrics.resolver_stages.value("template") >= 1
+        assert metrics.quota_limit.value("story:default/nf-story") == 4
 
     def test_controllers_record_metrics(self, rt):
         REGISTRY.reset()

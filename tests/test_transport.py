@@ -279,3 +279,40 @@ class TestRealtimeStory:
         assert b.status["negotiated"]["mesh"]["topology"] == "2x4"
         t = rt.store.get("Transport", "_cluster", "ici")
         assert t.status["capabilities"]["meshes"] == ["2x4"]
+
+
+class TestHeartbeatStaleness:
+    def test_default_runtime_sweeps_stale_bindings(self, rt):
+        """The staleness sweep runs in the default runtime (finite
+        heartbeat window): bindings heartbeat while their workers are
+        up, then go stale when the clock outruns the last beat."""
+        _setup_realtime(rt)
+        rt.pump()
+        t = rt.store.get("Transport", "_cluster", "voz")
+        assert t.status["liveBindings"] == 3
+        assert t.status["staleBindings"] == 0
+        for b in rt.store.list("TransportBinding"):
+            assert b.status.get("heartbeatAt") is not None
+
+        # a healthy quiet topology keeps beating through the periodic
+        # refresh requeue — advancing past the window does NOT stale it
+        rt.clock.advance(2 * 3600.0)
+        rt.pump(max_virtual_seconds=0.0)
+        rt.manager.enqueue("transport", "_cluster", "voz")
+        rt.pump(max_virtual_seconds=0.0)
+        t = rt.store.get("Transport", "_cluster", "voz")
+        assert t.status["liveBindings"] == 3, t.status
+
+        # workers go down -> heartbeats stop -> the sweep marks stale
+        rt.workload_simulator.auto_ready = False
+        for dep in rt.store.list("Deployment"):
+            rt.workload_simulator.mark_ready("Deployment", "default", dep.meta.name,
+                                       ready=False)
+        rt.pump(max_virtual_seconds=0.0)
+        rt.clock.advance(2 * 3600.0)
+        rt.pump(max_virtual_seconds=0.0)
+        rt.manager.enqueue("transport", "_cluster", "voz")
+        rt.pump(max_virtual_seconds=0.0)
+        t = rt.store.get("Transport", "_cluster", "voz")
+        assert t.status["staleBindings"] == 3, t.status
+        assert t.status["liveBindings"] == 0
