@@ -690,12 +690,18 @@ class DAGEngine:
         )
         limit = story.policy.concurrency if story.policy else None
         if limit is not None:
+            # per-run scope for gauges (concurrent runs of one story
+            # each have their own usage; the series is deleted when the
+            # run turns terminal — see _observe_terminal); the counter
+            # stays story-scoped so its cardinality is bounded
+            scope = f"storyrun:{run.meta.namespace}/{run.meta.name}"
             story_name = (run.spec.get("storyRef") or {}).get("name", "")
-            scope = f"story:{run.meta.namespace}/{story_name}"
             metrics.quota_usage.set(running_here, scope)
             metrics.quota_limit.set(limit, scope)
             if running_here >= limit:
-                metrics.quota_violations.inc(scope)
+                metrics.quota_violations.inc(
+                    f"story:{run.meta.namespace}/{story_name}"
+                )
                 return REASON_CONCURRENCY_QUEUED
         cfg = self.config_manager.config.scheduling
         if queue:

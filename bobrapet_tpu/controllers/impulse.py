@@ -242,7 +242,7 @@ class ImpulseController:
             if t.status.get("decision") == str(TriggerDecision.REJECTED)
             and t.status.get("reason") == "Throttled"
         )
-        metrics.impulse_throttled.set(throttled, name)
+        metrics.impulse_throttled.set(throttled, f"{ns}/{name}")
         metrics.trigger_backfills.inc(IMPULSE_KIND)
         return {
             "_received": received_inc,

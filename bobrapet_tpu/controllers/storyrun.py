@@ -468,6 +468,10 @@ class StoryRunController:
         if (namespace, name) in self._pinned:
             self.storage.unpin_run(namespace, name)
             self._pinned.discard((namespace, name))
+        # per-run quota gauges die with the run (bounded cardinality)
+        scope = f"storyrun:{namespace}/{name}"
+        metrics.quota_usage.remove(scope)
+        metrics.quota_limit.remove(scope)
 
     def _handle_terminal(self, run: Resource) -> Optional[float]:
         ns, name = run.meta.namespace, run.meta.name

@@ -386,6 +386,10 @@ def _ensure_deployment(ctrl, sr, spec, engram_spec, template_spec, ctx,
             "binding": binding.meta.name,
             "driver": binding.spec.get("driver"),
             "negotiated": binding.status.get("negotiated") or {},
+            # merged settings ride to the SDK so open_output_streams /
+            # open_input_stream enforce the negotiated backpressure
+            # contract without the engram re-supplying it
+            "settings": binding.spec.get("rawSettings") or {},
             "generation": generation,
         }, separators=(",", ":"), sort_keys=True)
 

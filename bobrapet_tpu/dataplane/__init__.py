@@ -1,0 +1,26 @@
+"""Realtime streaming data plane (the in-tree bobravoz equivalent).
+
+Control plane negotiates BindingInfo + downstream targets; this package
+moves the actual bytes: a hub broker (hub-routed legs and the P2P
+embedded case) and SDK-side producer/consumer clients with credit flow
+control, drop policies, and at-least-once acks — the enforcement half
+of the streaming settings language (reference:
+transport_settings_types.go:21-528; the reference's own hub is the
+out-of-repo `bobravoz-grpc` deployable).
+"""
+
+from .client import StreamClosed, StreamConsumer, StreamProducer, StreamProtocolError
+from .frames import FrameError, encode_frame, read_frame, send_frame
+from .hub import StreamHub
+
+__all__ = [
+    "FrameError",
+    "StreamClosed",
+    "StreamConsumer",
+    "StreamHub",
+    "StreamProducer",
+    "StreamProtocolError",
+    "encode_frame",
+    "read_frame",
+    "send_frame",
+]

@@ -1,0 +1,37 @@
+"""Run a standalone stream hub: ``python -m bobrapet_tpu.dataplane``.
+
+The deployment shape of the reference's realtime add-on (its hub is a
+separate deployable installed next to the operator); on GKE this runs
+as a Service on the TPU-VM host network.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="bobrapet stream hub")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=7447)
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args()
+    logging.basicConfig(level=args.log_level)
+
+    from .hub import StreamHub
+
+    hub = StreamHub(host=args.host, port=args.port)
+    port = hub.start()
+    logging.getLogger(__name__).info("stream hub listening on %s:%s", args.host, port)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    hub.stop()
+
+
+if __name__ == "__main__":
+    main()

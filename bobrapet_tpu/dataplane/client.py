@@ -1,0 +1,220 @@
+"""SDK-side stream clients: producer (send with credit respect) and
+consumer (iterate + ack).
+
+These are what an engram uses under the hood via
+``EngramContext.open_output_stream`` / ``open_input_stream`` — the
+endpoint and settings come from the operator-negotiated BindingInfo and
+downstream targets (reference: SDKs stream outputs P2P via
+controller-computed gRPC endpoints, steprun_controller.go:1405-1651).
+
+The producer BLOCKS in :meth:`StreamProducer.send` when credit flow
+control is active and the hub has stopped granting — that is the
+backpressure contract: a full downstream buffer slows the producer
+instead of dropping data (unless the negotiated drop policy says
+otherwise, which the hub enforces).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Any, Iterator, Optional
+
+from .frames import FrameError, read_frame, send_frame
+
+
+class StreamClosed(Exception):
+    """The peer closed the stream."""
+
+
+class StreamProtocolError(Exception):
+    """The peer rejected our traffic (e.g. sending without credit)."""
+
+
+def _connect(endpoint: str, timeout: float) -> socket.socket:
+    host, _, port = endpoint.rpartition(":")
+    sock = socket.create_connection((host or "127.0.0.1", int(port)), timeout=timeout)
+    sock.settimeout(timeout)
+    return sock
+
+
+class StreamProducer:
+    """Connects to a hub (or a P2P consumer's embedded hub) and sends."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        stream: str,
+        settings: Optional[dict[str, Any]] = None,
+        lane: str = "data",
+        connect_timeout: float = 10.0,
+    ):
+        self.stream = stream
+        self._sock = _connect(endpoint, connect_timeout)
+        self._credits = 0
+        self._unlimited = False
+        self._credit_cv = threading.Condition()
+        self._closed = False
+        self._error: Optional[str] = None
+        send_frame(self._sock, {
+            "t": "hello", "role": "producer", "stream": stream,
+            "lane": lane, "settings": settings,
+        })
+        fr = read_frame(self._sock)
+        if fr is None or fr[0].get("t") != "ok":
+            raise StreamProtocolError(f"handshake failed: {fr and fr[0]}")
+        # the timeout guarded connect+handshake only: an idle stream is
+        # healthy, so reads must block indefinitely afterwards
+        self._sock.settimeout(None)
+        credits = int(fr[0].get("credits", -1))
+        if credits < 0:
+            self._unlimited = True
+        else:
+            self._credits = credits
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True, name=f"producer-{stream}"
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                fr = read_frame(self._sock)
+                if fr is None:
+                    break
+                header, _ = fr
+                t = header.get("t")
+                if t == "credit":
+                    with self._credit_cv:
+                        self._credits += int(header.get("n", 0))
+                        self._credit_cv.notify_all()
+                elif t == "err":
+                    with self._credit_cv:
+                        self._error = header.get("message", "stream error")
+                        self._credit_cv.notify_all()
+                    return
+        except (OSError, FrameError):
+            pass
+        with self._credit_cv:
+            self._closed = True
+            self._credit_cv.notify_all()
+
+    def send(
+        self,
+        payload: Any,
+        key: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> None:
+        """Send one message; blocks while the hub withholds credits
+        (backpressure). Raises TimeoutError when `timeout` elapses
+        blocked, StreamClosed/StreamProtocolError on a dead stream."""
+        data = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+        if not self._unlimited:
+            with self._credit_cv:
+                ok = self._credit_cv.wait_for(
+                    lambda: self._credits > 0 or self._closed or self._error,
+                    timeout=timeout,
+                )
+                if self._error:
+                    raise StreamProtocolError(self._error)
+                if self._closed:
+                    raise StreamClosed(self.stream)
+                if not ok:
+                    raise TimeoutError(
+                        f"backpressured: no credit on {self.stream!r} "
+                        f"after {timeout}s"
+                    )
+                self._credits -= 1
+        header: dict[str, Any] = {"t": "data"}
+        if key is not None:
+            header["key"] = key
+        send_frame(self._sock, header, data)
+
+    @property
+    def credits(self) -> int:
+        with self._credit_cv:
+            return -1 if self._unlimited else self._credits
+
+    def close(self, eos: bool = True) -> None:
+        # half-close, then wait for the hub to finish reading: closing
+        # outright while a credit frame sits unread in OUR receive
+        # buffer turns the close into a TCP RST, which discards the
+        # EOS frame still queued toward the hub
+        try:
+            if eos:
+                send_frame(self._sock, {"t": "eos"})
+            self._sock.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+        self._reader.join(timeout=5.0)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class StreamConsumer:
+    """Connects to a hub and iterates messages, acking per the
+    negotiated ``ackEvery`` cadence (cumulative acks)."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        stream: str,
+        settings: Optional[dict[str, Any]] = None,
+        lane: str = "data",
+        connect_timeout: float = 10.0,
+        decode_json: bool = False,
+    ):
+        self.stream = stream
+        self.decode_json = decode_json
+        fc = (settings or {}).get("flowControl") or {}
+        self._ack_every = int(((fc.get("ackEvery") or {}).get("messages")) or 1)
+        self._sock = _connect(endpoint, connect_timeout)
+        self._since_ack = 0
+        self._last_seq = -1
+        send_frame(self._sock, {
+            "t": "hello", "role": "consumer", "stream": stream,
+            "lane": lane, "settings": settings,
+        })
+        fr = read_frame(self._sock)
+        if fr is None or fr[0].get("t") != "ok":
+            raise StreamProtocolError(f"handshake failed: {fr and fr[0]}")
+        self._sock.settimeout(None)  # idle != dead; block between messages
+
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            try:
+                fr = read_frame(self._sock)
+            except (OSError, FrameError):
+                return
+            if fr is None:
+                return
+            header, payload = fr
+            t = header.get("t")
+            if t == "data":
+                self._last_seq = int(header.get("seq", self._last_seq))
+                self._since_ack += 1
+                if self._since_ack >= self._ack_every:
+                    self.ack()
+                yield json.loads(payload) if self.decode_json else payload
+            elif t == "eos":
+                self.ack()
+                return
+            elif t == "err":
+                raise StreamProtocolError(header.get("message", "stream error"))
+
+    def ack(self) -> None:
+        if self._last_seq >= 0:
+            try:
+                send_frame(self._sock, {"t": "ack", "seq": self._last_seq})
+            except OSError:
+                pass
+        self._since_ack = 0
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

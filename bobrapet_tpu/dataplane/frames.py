@@ -1,0 +1,83 @@
+"""Wire protocol for the streaming data plane.
+
+The reference's realtime data plane is a gRPC hub ("bobravoz") speaking
+protobuf envelopes from an external library (reference:
+pkg/transport/bindinginfo.go:5, transportutil.go:9-16 — the hub itself
+lives outside the repo). This framework ships its data plane in-tree:
+a length-prefixed binary framing that needs no codegen, carries a JSON
+control header plus a raw payload, and rides any stream transport
+(TCP on the TPU-VM host network / DCN; the in-slice tensor path is ICI
+via jax collectives and never touches this protocol).
+
+Frame layout::
+
+    4 bytes  big-endian  total frame length (header + payload)
+    2 bytes  big-endian  header length
+    N bytes  JSON        control header {"t": <type>, ...}
+    M bytes  raw         payload (DATA frames only)
+
+Header types:
+
+- ``hello``   {role: producer|consumer, stream, lane, settings, fromSeq}
+- ``ok``      {credits}              hub -> producer/consumer handshake ack
+- ``data``    {seq, key?}            + payload bytes
+- ``credit``  {n}                    hub -> producer replenishment
+- ``ack``     {seq}                  consumer -> hub cumulative ack
+- ``eos``     {}                     end of stream
+- ``err``     {message}
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Optional
+
+MAX_FRAME = 64 * 1024 * 1024  # hard sanity cap
+
+
+class FrameError(Exception):
+    """Malformed or oversized frame."""
+
+
+def encode_frame(header: dict[str, Any], payload: bytes = b"") -> bytes:
+    h = json.dumps(header, separators=(",", ":")).encode()
+    if len(h) > 0xFFFF:
+        raise FrameError("header too large")
+    total = len(h) + len(payload)
+    if total > MAX_FRAME:
+        raise FrameError(f"frame of {total} bytes exceeds cap")
+    return struct.pack(">IH", total, len(h)) + h + payload
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None  # peer closed
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def read_frame(sock: socket.socket) -> Optional[tuple[dict[str, Any], bytes]]:
+    """One frame off the socket; None on clean EOF."""
+    prefix = _recv_exact(sock, 6)
+    if prefix is None:
+        return None
+    total, hlen = struct.unpack(">IH", prefix)
+    if total > MAX_FRAME or hlen > total:
+        raise FrameError(f"bad frame lengths total={total} hlen={hlen}")
+    body = _recv_exact(sock, total)
+    if body is None:
+        raise FrameError("connection died mid-frame")
+    try:
+        header = json.loads(body[:hlen])
+    except ValueError as e:
+        raise FrameError(f"bad frame header: {e}") from e
+    return header, body[hlen:]
+
+
+def send_frame(sock: socket.socket, header: dict[str, Any], payload: bytes = b"") -> None:
+    sock.sendall(encode_frame(header, payload))

@@ -1,0 +1,431 @@
+"""Stream hub: the in-tree bobravoz equivalent.
+
+A threaded TCP broker that routes producer frames to consumers per
+stream, enforcing the *negotiated* streaming settings language — the
+same policy objects the control plane validates at admission
+(api/transport.py TransportStreamingSettings; reference semantics:
+transport_settings_types.go:207-336):
+
+- **buffer + drop policy**: per-stream bounded buffer; ``dropOldest``
+  evicts the head, ``dropNewest`` rejects the incoming message,
+  ``block`` withholds credits / stops reading so TCP backpressure
+  reaches the producer.
+- **credit flow control** (``flowControl.mode=credits``): the producer
+  starts with ``initialCredits.messages`` and must stop when they run
+  out; the hub replenishes as the buffer drains, with pause/resume
+  hysteresis on buffer occupancy (``pauseThreshold``/
+  ``resumeThreshold.bufferPct``).
+- **at-least-once** (``delivery.semantics=atLeastOnce``): messages stay
+  buffered until the consumer's cumulative ack; a reconnecting consumer
+  is re-delivered everything unacked.
+
+Topology: the controller's hub-vs-P2P analysis decides who talks to
+whom (transport/topology.py); this hub serves the hub-routed legs, and
+the same server embedded in a consumer process serves the direct-P2P
+legs (a P2P link is just a hub with one stream and one consumer).
+
+Deployment shape mirrors the reference ("Realtime add-on" hub is its
+own deployable): `python -m bobrapet_tpu.dataplane` starts a hub.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import socket
+import threading
+import time
+from typing import Any, Optional
+
+from ..observability.metrics import metrics
+from .frames import FrameError, encode_frame, read_frame, send_frame
+
+_log = logging.getLogger(__name__)
+
+UNLIMITED = -1
+
+
+def _settings_knobs(settings: Optional[dict[str, Any]]) -> dict[str, Any]:
+    """Extract the enforcement-relevant knobs from a settings dict
+    (already admission-validated; unknown fields ignored)."""
+    s = settings or {}
+    buf = ((s.get("backpressure") or {}).get("buffer")) or {}
+    fc = s.get("flowControl") or {}
+    delivery = s.get("delivery") or {}
+    credits_mode = fc.get("mode") == "credits"
+    initial = ((fc.get("initialCredits") or {}).get("messages")) if credits_mode else None
+    # (ack cadence is a CLIENT knob — StreamConsumer paces its own acks)
+    return {
+        "max_messages": buf.get("maxMessages") or 1024,
+        "drop_policy": buf.get("dropPolicy") or "dropOldest",
+        "credits": credits_mode,
+        "initial_credits": int(initial or 0),
+        "pause_pct": ((fc.get("pauseThreshold") or {}).get("bufferPct")) or 100,
+        "resume_pct": ((fc.get("resumeThreshold") or {}).get("bufferPct")) or 0,
+        "at_least_once": delivery.get("semantics") == "atLeastOnce",
+    }
+
+
+class _Stream:
+    """One logical stream (producer side state + buffer + consumers)."""
+
+    def __init__(self, name: str, knobs: dict[str, Any]):
+        self.name = name
+        self.knobs = knobs
+        self.lock = threading.Lock()
+        self.buffer: collections.deque = collections.deque()  # (seq, header, payload)
+        self.next_seq = 0
+        self.acked = -1  # cumulative: everything <= acked is done
+        self.consumers: list[_ConsumerConn] = []
+        self.producer_conns: list[_ProducerConn] = []
+        self.paused = False  # credit-grant hysteresis state
+        self.eos = False
+        self.started = time.monotonic()
+
+    # -- occupancy / credits ----------------------------------------------
+    def fill_pct(self) -> float:
+        return 100.0 * len(self.buffer) / max(1, self.knobs["max_messages"])
+
+    def grantable(self) -> int:
+        """Credits the hub is willing to hand out right now."""
+        if not self.knobs["credits"]:
+            return UNLIMITED
+        fill = self.fill_pct()
+        if self.paused:
+            if fill <= self.knobs["resume_pct"]:
+                self.paused = False
+            else:
+                return 0
+        elif fill >= self.knobs["pause_pct"]:
+            self.paused = True
+            return 0
+        return max(0, self.knobs["max_messages"] - len(self.buffer))
+
+
+class _ProducerConn:
+    def __init__(self, sock: socket.socket, stream: _Stream):
+        self.sock = sock
+        self.stream = stream
+        self.outstanding = 0  # credits handed out, not yet consumed
+
+
+class _ConsumerConn:
+    """Delivery to a consumer goes through a per-connection ordered
+    queue drained by one writer thread: producers and the attach-replay
+    path only enqueue (under the stream lock), so frames can neither
+    reorder nor block the producer's reader on a slow consumer socket."""
+
+    def __init__(self, sock: socket.socket, stream: _Stream):
+        self.sock = sock
+        self.stream = stream
+        self.delivered = -1  # highest seq enqueued to this consumer
+        self.queue: collections.deque = collections.deque()
+        self.cv = threading.Condition()
+        self.closed = False
+
+    def enqueue(self, header: dict[str, Any], payload: bytes) -> None:
+        with self.cv:
+            self.queue.append((header, payload))
+            self.cv.notify()
+
+    def writer_loop(self) -> None:
+        while True:
+            with self.cv:
+                self.cv.wait_for(lambda: self.queue or self.closed)
+                if self.closed and not self.queue:
+                    return
+                header, payload = self.queue.popleft()
+            try:
+                self.sock.sendall(encode_frame(header, payload))
+                if header.get("t") == "data":
+                    metrics.stream_messages.inc("sent")
+            except OSError:
+                return
+
+    def close(self) -> None:
+        with self.cv:
+            self.closed = True
+            self.cv.notify()
+
+
+class StreamHub:
+    """Threaded hub server. ``start()`` binds and returns the port."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._server: Optional[socket.socket] = None
+        self._streams: dict[str, _Stream] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> int:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self.host, self.port))
+        srv.listen(64)
+        self._server = srv
+        self.port = srv.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="hub-accept")
+        t.start()
+        self._threads.append(t)
+        return self.port
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        with self._lock:
+            streams = list(self._streams.values())
+        for st in streams:
+            with st.lock:
+                conns = [c.sock for c in st.consumers] + [
+                    p.sock for p in st.producer_conns
+                ]
+            for s in conns:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def stream_stats(self, name: str) -> dict[str, Any]:
+        with self._lock:
+            st = self._streams.get(name)
+        if st is None:
+            return {}
+        with st.lock:
+            return {
+                "buffered": len(st.buffer),
+                "nextSeq": st.next_seq,
+                "acked": st.acked,
+                "consumers": len(st.consumers),
+                "paused": st.paused,
+                "eos": st.eos,
+            }
+
+    # -- internals ---------------------------------------------------------
+    def _accept_loop(self) -> None:
+        assert self._server is not None
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._server.accept()
+            except OSError:
+                return
+            # daemon + self-terminating: not tracked (a long-lived hub
+            # would otherwise accumulate one dead Thread per connection)
+            threading.Thread(target=self._serve_conn, args=(sock,),
+                             daemon=True, name="hub-conn").start()
+
+    def _get_stream(self, name: str, settings: Optional[dict[str, Any]]) -> _Stream:
+        with self._lock:
+            st = self._streams.get(name)
+            if st is None:
+                st = _Stream(name, _settings_knobs(settings))
+                self._streams[name] = st
+            return st
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        try:
+            first = read_frame(sock)
+            if first is None:
+                return
+            hello, _ = first
+            if hello.get("t") != "hello":
+                send_frame(sock, {"t": "err", "message": "expected hello"})
+                return
+            role = hello.get("role")
+            stream = self._get_stream(
+                str(hello.get("stream") or ""), hello.get("settings")
+            )
+            metrics.stream_requests.inc(str(role))
+            if role == "producer":
+                self._serve_producer(sock, stream)
+            elif role == "consumer":
+                self._serve_consumer(sock, stream, hello)
+            else:
+                send_frame(sock, {"t": "err", "message": f"bad role {role!r}"})
+        except (FrameError, OSError) as e:
+            _log.debug("hub connection error: %s", e)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- producer side -----------------------------------------------------
+    def _serve_producer(self, sock: socket.socket, st: _Stream) -> None:
+        conn = _ProducerConn(sock, st)
+        with st.lock:
+            others = sum(p.outstanding for p in st.producer_conns)
+            st.producer_conns.append(conn)
+            if st.knobs["credits"]:
+                room = max(0, st.knobs["max_messages"] - len(st.buffer) - others)
+                grant = min(st.knobs["initial_credits"], room)
+                conn.outstanding = grant
+            else:
+                grant = UNLIMITED
+        send_frame(sock, {"t": "ok", "credits": grant})
+        try:
+            while True:
+                fr = read_frame(sock)
+                if fr is None:
+                    return
+                header, payload = fr
+                t = header.get("t")
+                if t == "data":
+                    self._on_data(conn, header, payload)
+                elif t == "eos":
+                    # fan-in: several producers share the consumer-named
+                    # stream — only the LAST live producer's eos ends it
+                    with st.lock:
+                        if conn in st.producer_conns:
+                            st.producer_conns.remove(conn)
+                        last = not st.producer_conns
+                        if last:
+                            st.eos = True
+                        consumers = list(st.consumers)
+                        drained = not st.buffer
+                    if last and drained:
+                        for c in consumers:
+                            c.enqueue({"t": "eos"}, b"")
+                    return
+                else:
+                    send_frame(sock, {"t": "err", "message": f"unexpected {t!r}"})
+                    return
+        finally:
+            with st.lock:
+                if conn in st.producer_conns:
+                    st.producer_conns.remove(conn)
+
+    def _on_data(self, conn: _ProducerConn, header: dict[str, Any], payload: bytes) -> None:
+        st = conn.stream
+        metrics.stream_messages.inc("received")
+        with st.lock:
+            if st.knobs["credits"]:
+                if conn.outstanding <= 0:
+                    # protocol violation: sending without credit
+                    metrics.stream_dropped.inc("no-credit")
+                    send_frame(conn.sock, {"t": "err", "message": "no credit"})
+                    return
+                conn.outstanding -= 1
+            full = len(st.buffer) >= st.knobs["max_messages"]
+            if full:
+                policy = st.knobs["drop_policy"]
+                if policy == "dropOldest":
+                    st.buffer.popleft()
+                    metrics.stream_dropped.inc("dropOldest")
+                elif policy == "dropNewest":
+                    metrics.stream_dropped.inc("dropNewest")
+                    self._maybe_replenish(st, conn)
+                    return
+                # "block": with credits the producer can't reach here
+                # (credits dried up before the buffer filled); without
+                # credits we park the message anyway and rely on the
+                # reader loop stalling (TCP backpressure) — the buffer
+                # is allowed to exceed by the in-flight window.
+            seq = st.next_seq
+            st.next_seq += 1
+            entry = (seq, {"t": "data", "seq": seq, "key": header.get("key")}, payload)
+            st.buffer.append(entry)
+            # enqueue under the lock: entries reach each consumer's
+            # ordered queue in seq order, interleaved atomically with
+            # the attach-replay path
+            for c in st.consumers:
+                c.enqueue(entry[1], entry[2])
+                c.delivered = max(c.delivered, entry[0])
+            if st.consumers and not st.knobs["at_least_once"]:
+                # at-most-once: a delivery attempt completes the message
+                if st.buffer and st.buffer[-1][0] == entry[0]:
+                    st.buffer.pop()
+            self._maybe_replenish(st, conn)
+
+    def _maybe_replenish(self, st: _Stream, conn: _ProducerConn) -> None:
+        """Grant more credits when policy allows. Caller holds st.lock.
+
+        Outstanding credits are messages that WILL land in the buffer,
+        so the window target is bounded by remaining buffer room — the
+        producer can never hold credits for slots that don't exist."""
+        if not st.knobs["credits"]:
+            return
+        room = st.grantable()
+        if room <= 0:
+            return
+        # the bound is per-STREAM: every producer's in-flight credits
+        # compete for the same buffer slots
+        others = sum(
+            p.outstanding for p in st.producer_conns if p is not conn
+        )
+        grant = min(
+            st.knobs["initial_credits"] - conn.outstanding,
+            room - others - conn.outstanding,
+        )
+        if grant > 0:
+            conn.outstanding += grant
+            try:
+                send_frame(conn.sock, {"t": "credit", "n": grant})
+            except OSError:
+                pass
+
+    # -- consumer side -----------------------------------------------------
+    def _serve_consumer(self, sock: socket.socket, st: _Stream, hello: dict[str, Any]) -> None:
+        conn = _ConsumerConn(sock, st)
+        send_frame(sock, {"t": "ok", "credits": UNLIMITED})
+        started = time.monotonic()
+        # attach atomically: backlog replay (unacked under atLeastOnce,
+        # undelivered otherwise) enters the consumer's ordered queue
+        # before any live entry can, so delivery order == seq order
+        with st.lock:
+            for seq, header, payload in list(st.buffer):
+                conn.enqueue(header, payload)
+                conn.delivered = max(conn.delivered, seq)
+            st.consumers.append(conn)
+            eos = st.eos
+            if not st.knobs["at_least_once"]:
+                # at-most-once: the replay attempt consumes the backlog
+                st.buffer.clear()
+            for pc in st.producer_conns:
+                self._maybe_replenish(st, pc)
+            if eos:
+                conn.enqueue({"t": "eos"}, b"")
+        writer = threading.Thread(target=conn.writer_loop, daemon=True,
+                                  name="hub-consumer-writer")
+        writer.start()
+        try:
+            while True:
+                fr = read_frame(sock)
+                if fr is None:
+                    return
+                header, _ = fr
+                if header.get("t") == "ack":
+                    self._on_ack(st, int(header.get("seq", -1)))
+        finally:
+            with st.lock:
+                if conn in st.consumers:
+                    st.consumers.remove(conn)
+            conn.close()
+            metrics.stream_duration.observe(
+                time.monotonic() - started, hello.get("lane") or "data"
+            )
+
+    def _on_ack(self, st: _Stream, seq: int) -> None:
+        with st.lock:
+            st.acked = max(st.acked, seq)
+            while st.buffer and st.buffer[0][0] <= st.acked:
+                st.buffer.popleft()
+            eos = st.eos and not st.buffer
+            consumers = list(st.consumers)
+            for pc in st.producer_conns:
+                self._maybe_replenish(st, pc)
+        if eos:
+            for c in consumers:
+                c.enqueue({"t": "eos"}, b"")

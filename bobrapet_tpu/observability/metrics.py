@@ -110,6 +110,13 @@ class Gauge(_Metric):
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def remove(self, *label_values: str) -> None:
+        """Drop one label series (bounded-cardinality hygiene for
+        per-run scopes: delete when the run completes)."""
+        key = _label_key(self.label_names, label_values)
+        with self._lock:
+            self._values.pop(key, None)
+
     def reset(self) -> None:
         with self._lock:
             self._values.clear()

@@ -303,6 +303,77 @@ class EngramContext:
 
         return latest_checkpoint_step(self._storage.store, self.checkpoint_prefix)
 
+    # -- realtime streaming ------------------------------------------------
+
+    @property
+    def binding_info(self) -> Optional[dict[str, Any]]:
+        """Negotiated transport binding (codecs/mesh/driver), injected
+        by the controller (reference: EncodeBindingEnv
+        transportutil.go:188)."""
+        raw = self.env.get(contract.ENV_BINDING_INFO)
+        return json.loads(raw) if raw else None
+
+    @property
+    def downstream_targets(self) -> list[dict[str, Any]]:
+        """Controller-computed next hops for this step's output stream
+        (reference: computeDownstreamTargets steprun_controller.go:1405)."""
+        raw = self.env.get(contract.ENV_DOWNSTREAM_TARGETS)
+        return json.loads(raw) if raw else []
+
+    @property
+    def negotiated_stream_settings(self) -> Optional[dict[str, Any]]:
+        """The merged streaming settings the controller negotiated into
+        the binding (transport -> story -> step layers)."""
+        info = self.binding_info
+        return (info or {}).get("settings")
+
+    def open_output_streams(self, settings: Optional[dict[str, Any]] = None,
+                            connect_timeout: float = 10.0):
+        """One StreamProducer per downstream consumer step. Backpressure
+        (credit flow control, drop policies) follows the negotiated
+        settings (default: the binding's merged settings); `send` blocks
+        when downstream is full. Streams are consumer-named
+        ``ns/run/<consumerStep>`` — a hub target fans out to every step
+        in its ``stepNames``; a P2P target names exactly one."""
+        from ..dataplane.client import StreamProducer
+
+        if settings is None:
+            settings = self.negotiated_stream_settings
+        producers = []
+        for target in self.downstream_targets:
+            if target.get("terminate"):
+                continue
+            grpc = target.get("grpc") or {}
+            host, port = grpc.get("host"), grpc.get("port")
+            if not host or not port:
+                continue
+            dests = grpc.get("stepNames") or (
+                [grpc["stepName"]] if grpc.get("stepName") else []
+            )
+            for dest in dests:
+                stream = f"{self.namespace}/{self.story_run}/{dest}"
+                producers.append(StreamProducer(
+                    f"{host}:{port}", stream, settings=settings,
+                    connect_timeout=connect_timeout,
+                ))
+        return producers
+
+    def open_input_stream(self, endpoint: str,
+                          settings: Optional[dict[str, Any]] = None,
+                          decode_json: bool = True,
+                          connect_timeout: float = 10.0):
+        """Subscribe to this step's input stream at the hub endpoint;
+        iterate to receive (acks ride the negotiated cadence; settings
+        default to the binding's merged settings)."""
+        from ..dataplane.client import StreamConsumer
+
+        if settings is None:
+            settings = self.negotiated_stream_settings
+        stream = f"{self.namespace}/{self.story_run}/{self.step}"
+        return StreamConsumer(endpoint, stream, settings=settings,
+                              decode_json=decode_json,
+                              connect_timeout=connect_timeout)
+
     @property
     def log(self) -> logging.Logger:
         return logging.getLogger(f"engram.{self.step}")

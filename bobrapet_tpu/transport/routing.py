@@ -58,9 +58,14 @@ def compute_downstream_targets(
         # (reference: TerminateTarget steprun_types.go:157-161)
         return [{"terminate": True}]
     if hub:
+        if max_downstreams is not None and len(deps) > max_downstreams:
+            deps = deps[:max_downstreams]
         target: dict[str, Any] = {
             "host": f"{HUB_SERVICE}.{namespace}.svc",
             "port": DEFAULT_HUB_PORT,
+            # streams are consumer-named (ns/run/<consumerStep>); the
+            # producer publishes one hub stream per downstream step
+            "stepNames": list(deps),
         }
         if tls:
             target["tls"] = True

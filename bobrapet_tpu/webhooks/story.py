@@ -90,7 +90,52 @@ class StoryWebhook:
         )
         self._validate_output(errs, spec)
         self._validate_policy(errs, spec)
+        self._validate_streaming_layers(errs, spec)
         errs.raise_if_any()
+
+    def _validate_streaming_layers(self, errs: FieldErrors, spec) -> None:
+        """Coherence-validate the MERGED streaming settings each
+        streaming step would bind with (transport defaults -> story
+        declaration -> step runtime). Layers are merged before checking
+        because an individually-incomplete layer (e.g. a step enabling
+        credits whose window the transport supplies) can be coherent in
+        combination — and vice versa: a step override can break an
+        admitted transport config, which must be caught HERE, the layer
+        the user is writing."""
+        from ..api.catalog import CLUSTER_NAMESPACE
+        from ..api.transport import TRANSPORT_KIND, parse_transport
+        from ..transport.settings import merge_streaming_settings
+        from .transport import validate_streaming_settings
+
+        declared = {t.name or t.transport_ref: t for t in spec.transports}
+        for i, step in enumerate(spec.steps):
+            t = declared.get(step.transport) if step.transport else None
+            step_streaming = (step.runtime or {}).get("streaming")
+            if t is None and not step_streaming:
+                continue
+            transport_defaults = None
+            if t is not None:
+                tr = self.store.try_get(
+                    TRANSPORT_KIND, CLUSTER_NAMESPACE, t.transport_ref or t.name
+                )
+                if tr is not None:
+                    try:
+                        transport_defaults = parse_transport(tr).streaming
+                    except Exception:  # noqa: BLE001 - validated at its own admission
+                        transport_defaults = None
+            try:
+                merged = merge_streaming_settings(
+                    transport_defaults,
+                    (t.streaming or t.settings) if t is not None else None,
+                    step_streaming,
+                )
+            except Exception as e:  # noqa: BLE001 - malformed override
+                errs.add(f"spec.steps[{i}].runtime.streaming", f"malformed: {e}")
+                continue
+            # errors point at the user-writable field, runtime.streaming
+            validate_streaming_settings(
+                merged, errs, f"spec.steps[{i}].runtime.streaming"
+            )
 
     # -- step battery ------------------------------------------------------
     def _validate_steps(

@@ -1,0 +1,360 @@
+"""Streaming data plane: hub, credit backpressure, drop policies, acks.
+
+The enforcement tests for the settings language the webhooks admit
+(reference semantics: transport_settings_types.go:207-336; the
+reference's own hub is out-of-repo, so this suite is the moral
+equivalent of its bobravoz integration coverage). Everything runs over
+real localhost TCP.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from bobrapet_tpu.dataplane import (
+    FrameError,
+    StreamConsumer,
+    StreamHub,
+    StreamProducer,
+    encode_frame,
+)
+from bobrapet_tpu.dataplane.frames import read_frame, send_frame
+
+
+@pytest.fixture
+def hub():
+    h = StreamHub()
+    h.start()
+    yield h
+    h.stop()
+
+
+CREDIT_SETTINGS = {
+    "flowControl": {
+        "mode": "credits",
+        "initialCredits": {"messages": 4},
+        "ackEvery": {"messages": 1},
+    },
+    "backpressure": {"buffer": {"maxMessages": 4, "dropPolicy": "block"}},
+}
+
+
+class TestFrames:
+    def test_roundtrip(self):
+        left, right = socket.socketpair()
+        send_frame(left, {"t": "data", "seq": 7}, b"payload")
+        header, payload = read_frame(right)
+        assert header == {"t": "data", "seq": 7}
+        assert payload == b"payload"
+        left.close()
+        assert read_frame(right) is None  # clean EOF
+
+    def test_oversized_rejected(self):
+        with pytest.raises(FrameError):
+            encode_frame({"t": "data"}, b"x" * (65 * 1024 * 1024))
+
+
+class TestBasicDelivery:
+    def test_produce_then_consume(self, hub):
+        p = StreamProducer(hub.endpoint, "ns/run/step")
+        for i in range(5):
+            p.send({"i": i})
+        p.close()
+        c = StreamConsumer(hub.endpoint, "ns/run/step", decode_json=True)
+        got = list(c)
+        assert got == [{"i": i} for i in range(5)]
+
+    def test_live_fanout_to_attached_consumer(self, hub):
+        c = StreamConsumer(hub.endpoint, "ns/run/live", decode_json=True)
+        received = []
+        done = threading.Event()
+
+        def drain():
+            for msg in c:
+                received.append(msg)
+            done.set()
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        p = StreamProducer(hub.endpoint, "ns/run/live")
+        for i in range(8):
+            p.send({"i": i})
+        p.close()
+        assert done.wait(10)
+        assert received == [{"i": i} for i in range(8)]
+
+    def test_binary_payload(self, hub):
+        p = StreamProducer(hub.endpoint, "ns/run/bin")
+        p.send(b"\x00\x01\xff" * 1000)
+        p.close()
+        c = StreamConsumer(hub.endpoint, "ns/run/bin")
+        assert list(c) == [b"\x00\x01\xff" * 1000]
+
+
+class TestCreditBackpressure:
+    def test_producer_blocks_on_full_buffer(self, hub):
+        """BASELINE config 4 shape: with nobody draining, the window
+        (4 credits / 4 buffer slots) exhausts and send() blocks — the
+        drops/pauses-under-full-buffer half of the backpressure
+        contract."""
+        p = StreamProducer(hub.endpoint, "ns/run/bp", settings=CREDIT_SETTINGS)
+        for i in range(4):
+            p.send({"i": i})
+        with pytest.raises(TimeoutError, match="backpressured"):
+            p.send({"i": 99}, timeout=0.3)
+        assert p.credits == 0
+
+    def test_producer_resumes_on_credit(self, hub):
+        """...and the resumes-on-credit half: a consumer draining (and
+        acking) frees buffer, the hub replenishes, the blocked send
+        completes."""
+        p = StreamProducer(hub.endpoint, "ns/run/bp2", settings=CREDIT_SETTINGS)
+        for i in range(4):
+            p.send({"i": i})
+        unblocked = threading.Event()
+        sent_late = []
+
+        def late_send():
+            p.send({"i": "late"}, timeout=15)
+            sent_late.append(True)
+            unblocked.set()
+
+        t = threading.Thread(target=late_send, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        assert not unblocked.is_set()  # still blocked, nobody drained
+        c = StreamConsumer(hub.endpoint, "ns/run/bp2",
+                           settings=CREDIT_SETTINGS, decode_json=True)
+        got = []
+        for msg in c:
+            got.append(msg)
+            if len(got) == 5:
+                break
+        assert unblocked.wait(10), "producer never resumed after drain"
+        assert {"i": "late"} in got or len(got) == 5
+
+    def test_sending_without_credit_is_rejected(self, hub):
+        """A producer that ignores the credit window is a protocol
+        violation the hub refuses (not silent data loss)."""
+        raw = socket.create_connection(("127.0.0.1", hub.port), timeout=5)
+        send_frame(raw, {"t": "hello", "role": "producer", "stream": "ns/r/x",
+                         "settings": CREDIT_SETTINGS})
+        header, _ = read_frame(raw)
+        assert header["t"] == "ok" and header["credits"] == 4
+        for _ in range(5):  # one more than granted
+            send_frame(raw, {"t": "data"}, b"{}")
+        # hub answers the over-budget frame with an error
+        deadline = time.monotonic() + 5
+        got_err = False
+        while time.monotonic() < deadline:
+            fr = read_frame(raw)
+            if fr is None:
+                break
+            if fr[0].get("t") == "err":
+                got_err = True
+                break
+        assert got_err
+        raw.close()
+
+
+class TestDropPolicies:
+    def _send_n(self, hub, stream, n, policy, buf=4):
+        settings = {"backpressure": {"buffer": {
+            "maxMessages": buf, "dropPolicy": policy}}}
+        p = StreamProducer(hub.endpoint, stream, settings=settings)
+        for i in range(n):
+            p.send({"i": i})
+        time.sleep(0.2)  # let the hub's reader drain the socket
+        p.close()
+        c = StreamConsumer(hub.endpoint, stream, decode_json=True)
+        return [m["i"] for m in c]
+
+    def test_drop_oldest_keeps_tail(self, hub):
+        assert self._send_n(hub, "ns/r/do", 10, "dropOldest") == [6, 7, 8, 9]
+
+    def test_drop_newest_keeps_head(self, hub):
+        assert self._send_n(hub, "ns/r/dn", 10, "dropNewest") == [0, 1, 2, 3]
+
+    def test_drop_metrics_recorded(self, hub):
+        from bobrapet_tpu.observability.metrics import metrics
+
+        before = metrics.stream_dropped.value("dropOldest")
+        self._send_n(hub, "ns/r/dm", 10, "dropOldest")
+        assert metrics.stream_dropped.value("dropOldest") >= before + 6
+
+
+class TestAtLeastOnce:
+    SETTINGS = {
+        "flowControl": {"mode": "credits",
+                        "initialCredits": {"messages": 64},
+                        "ackEvery": {"messages": 1}},
+        "delivery": {"semantics": "atLeastOnce"},
+        "backpressure": {"buffer": {"maxMessages": 64}},
+    }
+
+    def test_unacked_redelivered_on_reconnect(self, hub):
+        p = StreamProducer(hub.endpoint, "ns/r/alo", settings=self.SETTINGS)
+        for i in range(6):
+            p.send({"i": i})
+
+        # consumer 1 reads three, acks them, then dies
+        raw = socket.create_connection(("127.0.0.1", hub.port), timeout=5)
+        send_frame(raw, {"t": "hello", "role": "consumer", "stream": "ns/r/alo"})
+        assert read_frame(raw)[0]["t"] == "ok"
+        last = -1
+        for _ in range(3):
+            header, payload = read_frame(raw)
+            assert header["t"] == "data"
+            last = header["seq"]
+        send_frame(raw, {"t": "ack", "seq": last})
+        time.sleep(0.2)
+        raw.close()
+        p.close()
+
+        # consumer 2 sees only the unacked remainder
+        c = StreamConsumer(hub.endpoint, "ns/r/alo",
+                           settings=self.SETTINGS, decode_json=True)
+        assert [m["i"] for m in c] == [3, 4, 5]
+
+    def test_at_most_once_no_redelivery(self, hub):
+        p = StreamProducer(hub.endpoint, "ns/r/amo")
+        c1 = StreamConsumer(hub.endpoint, "ns/r/amo", decode_json=True)
+        got1 = []
+        done = threading.Event()
+
+        def drain():
+            for m in c1:
+                got1.append(m)
+            done.set()
+
+        threading.Thread(target=drain, daemon=True).start()
+        for i in range(4):
+            p.send({"i": i})
+        p.close()
+        assert done.wait(10)
+        assert got1 == [{"i": i} for i in range(4)]
+        # a second consumer gets nothing: delivery already happened
+        c2 = StreamConsumer(hub.endpoint, "ns/r/amo", decode_json=True)
+        assert list(c2) == []
+
+
+class TestHysteresis:
+    def test_pause_resume_thresholds(self, hub):
+        """Credits stop at pause%, restart only below resume% — the
+        grant decision must not flap around one boundary."""
+        settings = {
+            "flowControl": {
+                "mode": "credits",
+                "initialCredits": {"messages": 8},
+                "ackEvery": {"messages": 1},
+                "pauseThreshold": {"bufferPct": 75},
+                "resumeThreshold": {"bufferPct": 25},
+            },
+            "backpressure": {"buffer": {"maxMessages": 8}},
+        }
+        p = StreamProducer(hub.endpoint, "ns/r/hyst", settings=settings)
+        for i in range(8):
+            p.send({"i": i})
+        # buffer 100% > pause 75% -> no credit; send blocks
+        with pytest.raises(TimeoutError):
+            p.send({"i": "x"}, timeout=0.3)
+        st = hub.stream_stats("ns/r/hyst")
+        assert st["paused"] is True
+
+    def test_sdk_context_streams_over_localhost(self, hub):
+        """SDK surface end-to-end (BASELINE config 4 shape): producer
+        engram ctx streams to the hub via downstream targets, consumer
+        engram ctx subscribes, backpressure settings ride along."""
+        from bobrapet_tpu.sdk import contract
+        from bobrapet_tpu.sdk.context import EngramContext
+
+        targets = [{"grpc": {"host": "127.0.0.1", "port": hub.port,
+                             "stepName": "sink"}}]
+        prod_env = {
+            contract.ENV_NAMESPACE: "default",
+            contract.ENV_STORY_RUN: "r1",
+            contract.ENV_STEP: "source",
+            contract.ENV_DOWNSTREAM_TARGETS: json.dumps(targets),
+        }
+        cons_env = {
+            contract.ENV_NAMESPACE: "default",
+            contract.ENV_STORY_RUN: "r1",
+            contract.ENV_STEP: "sink",
+        }
+        producer_ctx = EngramContext(prod_env)
+        consumer_ctx = EngramContext(cons_env)
+
+        outs = producer_ctx.open_output_streams(settings=CREDIT_SETTINGS)
+        assert len(outs) == 1
+        received = []
+        done = threading.Event()
+
+        def consume():
+            stream = consumer_ctx.open_input_stream(
+                hub.endpoint, settings=CREDIT_SETTINGS)
+            for msg in stream:
+                received.append(msg)
+            done.set()
+
+        threading.Thread(target=consume, daemon=True).start()
+        for i in range(10):
+            outs[0].send({"frame": i}, timeout=10)
+        outs[0].close()
+        assert done.wait(10)
+        assert received == [{"frame": i} for i in range(10)]
+
+
+class TestFanIn:
+    def test_last_producer_eos_ends_stream(self, hub):
+        """Fan-in (merge): two producers share the consumer-named
+        stream; the first eos must NOT cut off the second producer."""
+        pa = StreamProducer(hub.endpoint, "ns/r/fanin")
+        pb = StreamProducer(hub.endpoint, "ns/r/fanin")
+        received = []
+        done = threading.Event()
+
+        def drain():
+            c = StreamConsumer(hub.endpoint, "ns/r/fanin", decode_json=True)
+            for m in c:
+                received.append(m)
+            done.set()
+
+        threading.Thread(target=drain, daemon=True).start()
+        pa.send({"from": "a", "i": 0})
+        pa.close()  # A finishes first
+        time.sleep(0.2)
+        assert not done.is_set(), "stream ended while producer B was live"
+        pb.send({"from": "b", "i": 1})
+        pb.close()
+        assert done.wait(10)
+        assert {"from": "a", "i": 0} in received
+        assert {"from": "b", "i": 1} in received
+
+    def test_credit_window_is_per_stream(self, hub):
+        """Multiple producers may not jointly hold more credits than
+        the buffer has slots (lossless backpressure across fan-in)."""
+        settings = {
+            "flowControl": {"mode": "credits",
+                            "initialCredits": {"messages": 8},
+                            "ackEvery": {"messages": 1}},
+            "backpressure": {"buffer": {"maxMessages": 8,
+                                        "dropPolicy": "block"}},
+        }
+        pa = StreamProducer(hub.endpoint, "ns/r/joint", settings=settings)
+        pb = StreamProducer(hub.endpoint, "ns/r/joint", settings=settings)
+        assert pa.credits + pb.credits <= 8
+        # drain each producer's window in turn: jointly they can send at
+        # most 8 (the buffer size) before both block
+        sent = 0
+        for p in (pa, pb):
+            try:
+                for _ in range(10):
+                    p.send({"i": sent}, timeout=0.3)
+                    sent += 1
+            except TimeoutError:
+                pass
+        assert sent <= 8, f"joint window leaked: {sent} sends succeeded"
+        assert sent >= 1

@@ -137,9 +137,10 @@ class TestControlPlaneFamilies:
         story = _mk_story(
             "nf-story",
             steps=[{"name": "a", "ref": {"name": "nf-engram"},
-                    "if": "{{ inputs.go }}"}],
+                    "if": "{{ inputs.go }}"},
+                   {"name": "b", "ref": {"name": "nf-engram"}}],
         )
-        story.spec["policy"] = {"concurrency": 4}
+        story.spec["policy"] = {"concurrency": 1}
         rt.apply(story)
         run = rt.run_story("nf-story", inputs={"go": True})
         rt.pump()
@@ -151,7 +152,13 @@ class TestControlPlaneFamilies:
         assert misses >= 1 and hits + misses >= 1
         assert metrics.rbac_ops.value("create") >= 1
         assert metrics.resolver_stages.value("template") >= 1
-        assert metrics.quota_limit.value("story:default/nf-story") == 4
+        # concurrency=1 with two ready steps parked one of them at least
+        # once (story-scoped counter, bounded cardinality)...
+        assert metrics.quota_violations.value("story:default/nf-story") >= 1
+        # ...and the per-run gauges were deleted when the run finished
+        run_scope = f"storyrun:default/{run}"
+        assert metrics.quota_usage.value(run_scope) == 0
+        assert metrics.quota_limit.value(run_scope) == 0
 
     def test_controllers_record_metrics(self, rt):
         REGISTRY.reset()
