@@ -3,7 +3,7 @@
 NATIVE_SRC := native/blobcache.cc
 NATIVE_SO  := native/libblobcache.so
 
-.PHONY: all native test bench clean
+.PHONY: all native test bench clean crds image
 
 all: native
 
@@ -22,3 +22,9 @@ bench: native
 
 clean:
 	rm -f $(NATIVE_SO)
+
+crds:
+	python -m bobrapet_tpu export-crds --out deploy/crds
+
+image:
+	docker build -f deploy/Dockerfile -t bobrapet-tpu/manager:dev .

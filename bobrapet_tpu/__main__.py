@@ -1,0 +1,222 @@
+"""The manager entry point: ``python -m bobrapet_tpu``.
+
+The counterpart of the reference's single ``manager`` binary
+(reference: cmd/main.go:113-151 — flags for bind addresses, webhook
+toggle, operator config coordinates; health endpoints :941; secure
+metrics serving :445-483). Subcommands:
+
+- ``manager``        run the control plane live (default)
+- ``hub``            run a standalone stream hub (also
+                     ``python -m bobrapet_tpu.dataplane``)
+- ``export-crds``    write CustomResourceDefinition YAML for all 12 kinds
+- ``export-manifests`` materialize a namespace's bus resources into
+                     kubectl-appliable GKE manifests
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.server
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+
+_log = logging.getLogger("bobrapet.manager")
+
+
+# ---------------------------------------------------------------------------
+# metrics / health serving (reference: cmd/main.go:445-483, :941)
+# ---------------------------------------------------------------------------
+
+
+def _serve_http(runtime, bind: str, token: str | None) -> http.server.ThreadingHTTPServer:
+    from .observability.metrics import REGISTRY
+
+    host, _, port = bind.rpartition(":")
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # noqa: D102 - quiet access log
+            _log.debug(fmt, *args)
+
+        def _authorized(self) -> bool:
+            if not token:
+                return True
+            header = self.headers.get("Authorization", "")
+            return header == f"Bearer {token}"
+
+        def do_GET(self):  # noqa: N802 - stdlib interface
+            if self.path == "/healthz":
+                body, code = b"ok", 200
+            elif self.path == "/readyz":
+                ready = runtime.manager.is_running()
+                body, code = (b"ok", 200) if ready else (b"not ready", 503)
+            elif self.path == "/metrics":
+                if not self._authorized():
+                    self.send_response(403)
+                    self.end_headers()
+                    return
+                body, code = REGISTRY.expose().encode(), 200
+            else:
+                body, code = b"not found", 404
+            self.send_response(code)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = http.server.ThreadingHTTPServer((host or "0.0.0.0", int(port)), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="metrics-http")
+    t.start()
+    return server
+
+
+# ---------------------------------------------------------------------------
+# subcommands
+# ---------------------------------------------------------------------------
+
+
+def _cmd_manager(args: argparse.Namespace) -> int:
+    from .controllers.manager import Clock
+    from .runtime import Runtime
+
+    token = None
+    if args.metrics_token_file:
+        with open(args.metrics_token_file) as f:
+            token = f.read().strip()
+
+    rt = Runtime(
+        persist_dir=args.persist_dir,
+        clock=Clock(),
+        executor_mode=args.executor_mode,
+        config_namespace=args.config_namespace,
+        enable_webhooks=not args.disable_webhooks,
+    )
+    rt.start()
+    server = _serve_http(rt, args.metrics_bind_address, token)
+    _log.info(
+        "manager up: metrics on %s, executor=%s, webhooks=%s, persist=%s",
+        args.metrics_bind_address, args.executor_mode,
+        not args.disable_webhooks, args.persist_dir or "<memory>",
+    )
+
+    hub = None
+    if args.with_hub:
+        from .dataplane.hub import StreamHub
+
+        hub_host, _, hub_port = args.hub_bind_address.rpartition(":")
+        hub = StreamHub(host=hub_host or "0.0.0.0", port=int(hub_port))
+        hub.start()
+        _log.info("embedded stream hub on %s", args.hub_bind_address)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    _log.info("shutting down")
+    if hub is not None:
+        hub.stop()
+    server.shutdown()
+    rt.stop()
+    return 0
+
+
+def _cmd_export_crds(args: argparse.Namespace) -> int:
+    from .api.schemas import export_crds
+
+    paths = export_crds(args.out)
+    for p in paths:
+        print(p)
+    return 0
+
+
+def _cmd_export_manifests(args: argparse.Namespace) -> int:
+    from .runtime import Runtime
+
+    rt = Runtime(persist_dir=args.persist_dir)
+    manifests = rt.export_gke_manifests(namespace=args.namespace)
+    if args.out == "-":
+        json.dump(manifests, sys.stdout, indent=2)
+        print()
+    else:
+        import yaml
+
+        with open(args.out, "w") as f:
+            yaml.safe_dump_all(manifests, f, sort_keys=False)
+        print(f"{len(manifests)} manifests -> {args.out}")
+    return 0
+
+
+def _cmd_hub(args: argparse.Namespace) -> int:
+    from .dataplane.__main__ import main as hub_main
+
+    sys.argv = ["bobrapet-hub", "--host", args.host, "--port", str(args.port)]
+    hub_main()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    # --log-level lives on a parent parser so it parses in any position,
+    # including with the implicit default subcommand
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument(
+        "--log-level", default=os.environ.get("BOBRA_LOG_LEVEL", "INFO")
+    )
+    parser = argparse.ArgumentParser(
+        prog="bobrapet_tpu", description="TPU-native workflow engine manager",
+        parents=[common],
+    )
+    sub = parser.add_subparsers(dest="command")
+
+    mgr = sub.add_parser("manager", help="run the control plane (default)",
+                         parents=[common])
+    mgr.add_argument("--persist-dir", default=os.environ.get("BOBRA_PERSIST_DIR"),
+                     help="durable resource store directory (default: in-memory)")
+    mgr.add_argument("--metrics-bind-address", default=":8080",
+                     help="host:port for /metrics, /healthz, /readyz")
+    mgr.add_argument("--metrics-token-file", default=None,
+                     help="bearer token file guarding /metrics")
+    mgr.add_argument("--executor-mode", choices=["sync", "threaded"],
+                     default="threaded")
+    mgr.add_argument("--config-namespace", default="bobrapet-system")
+    mgr.add_argument("--disable-webhooks", action="store_true",
+                     help="skip admission (reference: ENABLE_WEBHOOKS=false)")
+    mgr.add_argument("--with-hub", action="store_true",
+                     help="run an embedded stream hub")
+    mgr.add_argument("--hub-bind-address", default=":7447")
+    mgr.set_defaults(fn=_cmd_manager)
+
+    crds = sub.add_parser("export-crds", help="write CRD YAML for all kinds",
+                          parents=[common])
+    crds.add_argument("--out", default="deploy/crds")
+    crds.set_defaults(fn=_cmd_export_crds)
+
+    em = sub.add_parser("export-manifests",
+                        help="materialize bus resources into GKE manifests",
+                        parents=[common])
+    em.add_argument("--namespace", default="default")
+    em.add_argument("--persist-dir", default=os.environ.get("BOBRA_PERSIST_DIR"))
+    em.add_argument("--out", default="-")
+    em.set_defaults(fn=_cmd_export_manifests)
+
+    hub = sub.add_parser("hub", help="run a standalone stream hub",
+                         parents=[common])
+    hub.add_argument("--host", default="0.0.0.0")
+    hub.add_argument("--port", type=int, default=7447)
+    hub.set_defaults(fn=_cmd_hub)
+
+    args = parser.parse_args(argv)
+    if args.command is None:
+        args = parser.parse_args(["manager", *(argv if argv is not None else sys.argv[1:])])
+    logging.basicConfig(
+        level=args.log_level,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

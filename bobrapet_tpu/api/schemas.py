@@ -1,0 +1,188 @@
+"""CRD schema generation: SpecBase dataclasses -> openAPIV3Schema.
+
+The reference ships ~18.5k lines of generated CRD YAML
+(reference: config/crd/bases/, SURVEY §2.1 — produced by controller-gen
+from Go struct tags). Here the API types are dataclasses, so the
+generator introspects type hints directly and emits
+CustomResourceDefinition manifests for all 12 kinds — the deployable
+API surface for a GKE control plane, and the machine-readable contract
+for anything else.
+
+``python -m bobrapet_tpu export-crds --out deploy/crds`` writes them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import typing
+from typing import Any, get_args, get_origin, get_type_hints
+
+from .specbase import SpecBase, snake_to_camel
+
+GROUP = "bobrapet.io"
+RUNS_GROUP = "runs.bobrapet.io"
+CATALOG_GROUP = "catalog.bobrapet.io"
+TRANSPORT_GROUP = "transport.bobrapet.io"
+POLICY_GROUP = "policy.bobrapet.io"
+VERSION = "v1alpha1"
+
+_PRESERVE = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+
+
+def _schema_for_type(tp: Any, stack: tuple[type, ...]) -> dict[str, Any]:
+    # unwrap Optional[...]
+    if get_origin(tp) is typing.Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            inner = _schema_for_type(args[0], stack)
+            inner.setdefault("nullable", True)
+            return inner
+        return dict(_PRESERVE)
+    if tp is Any or tp is None:
+        return dict(_PRESERVE)
+    origin = get_origin(tp)
+    if origin in (list, tuple, set):
+        item_args = get_args(tp)
+        items = (
+            _schema_for_type(item_args[0], stack) if item_args else dict(_PRESERVE)
+        )
+        return {"type": "array", "items": items}
+    if origin is dict:
+        return dict(_PRESERVE)
+    if isinstance(tp, type):
+        if issubclass(tp, enum.Enum):
+            return {"type": "string", "enum": [str(v.value) for v in tp]}
+        if dataclasses.is_dataclass(tp):
+            if tp in stack:  # self-referential type: stop expanding
+                return dict(_PRESERVE)
+            return dataclass_schema(tp, stack + (tp,))
+        if tp is str:
+            return {"type": "string"}
+        if tp is bool:
+            return {"type": "boolean"}
+        if tp is int:
+            return {"type": "integer"}
+        if tp is float:
+            return {"type": "number"}
+    return dict(_PRESERVE)
+
+
+def dataclass_schema(
+    cls: type, stack: tuple[type, ...] = ()
+) -> dict[str, Any]:
+    """openAPIV3 object schema for one SpecBase dataclass."""
+    hints = get_type_hints(cls)
+    props: dict[str, Any] = {}
+    for f in dataclasses.fields(cls):
+        key = snake_to_camel(f.name)
+        props[key] = _schema_for_type(hints.get(f.name, Any), stack or (cls,))
+        if f.metadata.get("description"):
+            props[key]["description"] = f.metadata["description"]
+    out: dict[str, Any] = {"type": "object", "properties": props}
+    doc = (cls.__doc__ or "").strip().splitlines()
+    if doc:
+        out["description"] = doc[0]
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class CRDEntry:
+    kind: str
+    group: str
+    plural: str
+    spec_cls: type
+    scope: str = "Namespaced"  # or "Cluster"
+    short_names: tuple[str, ...] = ()
+
+
+def _registry() -> list[CRDEntry]:
+    from .catalog import EngramTemplateSpec, ImpulseTemplateSpec
+    from .engram import EngramSpec
+    from .impulse import ImpulseSpec
+    from .policy import ReferenceGrantSpec
+    from .runs import EffectClaimSpec, StepRunSpec, StoryRunSpec, StoryTriggerSpec
+    from .story import StorySpec
+    from .transport import TransportBindingSpec, TransportSpec
+
+    return [
+        CRDEntry("Story", GROUP, "stories", StorySpec, short_names=("st",)),
+        CRDEntry("Engram", GROUP, "engrams", EngramSpec, short_names=("eng",)),
+        CRDEntry("Impulse", GROUP, "impulses", ImpulseSpec, short_names=("imp",)),
+        CRDEntry("StoryRun", RUNS_GROUP, "storyruns", StoryRunSpec,
+                 short_names=("sr",)),
+        CRDEntry("StepRun", RUNS_GROUP, "stepruns", StepRunSpec,
+                 short_names=("str",)),
+        CRDEntry("StoryTrigger", RUNS_GROUP, "storytriggers", StoryTriggerSpec),
+        CRDEntry("EffectClaim", RUNS_GROUP, "effectclaims", EffectClaimSpec),
+        CRDEntry("EngramTemplate", CATALOG_GROUP, "engramtemplates",
+                 EngramTemplateSpec, scope="Cluster"),
+        CRDEntry("ImpulseTemplate", CATALOG_GROUP, "impulsetemplates",
+                 ImpulseTemplateSpec, scope="Cluster"),
+        CRDEntry("Transport", TRANSPORT_GROUP, "transports", TransportSpec,
+                 scope="Cluster"),
+        CRDEntry("TransportBinding", TRANSPORT_GROUP, "transportbindings",
+                 TransportBindingSpec),
+        CRDEntry("ReferenceGrant", POLICY_GROUP, "referencegrants",
+                 ReferenceGrantSpec),
+    ]
+
+
+def crd_manifest(entry: CRDEntry) -> dict[str, Any]:
+    """One apiextensions.k8s.io/v1 CustomResourceDefinition."""
+    assert issubclass(entry.spec_cls, SpecBase)
+    names: dict[str, Any] = {
+        "kind": entry.kind,
+        "listKind": f"{entry.kind}List",
+        "plural": entry.plural,
+        "singular": entry.kind.lower(),
+    }
+    if entry.short_names:
+        names["shortNames"] = list(entry.short_names)
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{entry.plural}.{entry.group}"},
+        "spec": {
+            "group": entry.group,
+            "names": names,
+            "scope": entry.scope,
+            "versions": [{
+                "name": VERSION,
+                "served": True,
+                "storage": True,
+                "subresources": {"status": {}},
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {
+                        "spec": dataclass_schema(entry.spec_cls),
+                        # status is controller-owned and evolves faster
+                        # than the schema; keep it open like the
+                        # reference's preserve-unknown status blocks
+                        "status": dict(_PRESERVE),
+                    },
+                }},
+            }],
+        },
+    }
+
+
+def all_crd_manifests() -> list[dict[str, Any]]:
+    return [crd_manifest(e) for e in _registry()]
+
+
+def export_crds(out_dir: str) -> list[str]:
+    """Write one YAML file per CRD; returns the paths."""
+    import os
+
+    import yaml
+
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    for entry in _registry():
+        manifest = crd_manifest(entry)
+        path = os.path.join(out_dir, f"{entry.group}_{entry.plural}.yaml")
+        with open(path, "w") as f:
+            yaml.safe_dump(manifest, f, sort_keys=False)
+        paths.append(path)
+    return paths

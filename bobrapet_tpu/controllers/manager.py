@@ -235,6 +235,10 @@ class ControllerManager:
 
     # -- live mode ---------------------------------------------------------
 
+    def is_running(self) -> bool:
+        """Readiness signal for /readyz (live dispatcher up)."""
+        return self._thread is not None and self._thread.is_alive()
+
     def start(self) -> None:
         if self._thread is not None:
             return

@@ -662,7 +662,7 @@ class StepRunController:
     # realtime placeholder (full implementation in the transport layer)
     # ------------------------------------------------------------------
     def _reconcile_realtime(self, sr, spec, engram_spec, template_spec):
-        from .realtime import reconcile_realtime_step
+        from .streaming import reconcile_realtime_step
 
         return reconcile_realtime_step(self, sr, spec, engram_spec, template_spec)
 

@@ -187,18 +187,25 @@ class StreamConsumer:
         while True:
             try:
                 fr = read_frame(self._sock)
-            except (OSError, FrameError):
-                return
+            except FrameError as e:
+                raise StreamProtocolError(str(e)) from e
+            except OSError as e:
+                raise StreamClosed(f"{self.stream}: {e}") from e
             if fr is None:
-                return
+                # EOF without an eos frame = the hub died mid-stream; a
+                # truncated stream must NOT read as a clean end
+                raise StreamClosed(f"{self.stream}: connection closed before eos")
             header, payload = fr
             t = header.get("t")
             if t == "data":
                 self._last_seq = int(header.get("seq", self._last_seq))
+                # yield BEFORE acking: the cumulative ack covering this
+                # message goes out only after the application consumed
+                # it (atLeastOnce survives a crash mid-processing)
+                yield json.loads(payload) if self.decode_json else payload
                 self._since_ack += 1
                 if self._since_ack >= self._ack_every:
                     self.ack()
-                yield json.loads(payload) if self.decode_json else payload
             elif t == "eos":
                 self.ack()
                 return

@@ -286,7 +286,11 @@ class StreamHub:
                     self._on_data(conn, header, payload)
                 elif t == "eos":
                     # fan-in: several producers share the consumer-named
-                    # stream — only the LAST live producer's eos ends it
+                    # stream — only the LAST live producer's eos ends it.
+                    # eos rides each consumer's ORDERED queue, so it
+                    # always arrives after every already-enqueued data
+                    # frame; under atLeastOnce the buffer keeps unacked
+                    # entries for reconnect-redelivery regardless.
                     with st.lock:
                         if conn in st.producer_conns:
                             st.producer_conns.remove(conn)
@@ -294,10 +298,10 @@ class StreamHub:
                         if last:
                             st.eos = True
                         consumers = list(st.consumers)
-                        drained = not st.buffer
-                    if last and drained:
+                    if last:
                         for c in consumers:
                             c.enqueue({"t": "eos"}, b"")
+                    self._maybe_gc(st)
                     return
                 else:
                     send_frame(sock, {"t": "err", "message": f"unexpected {t!r}"})
@@ -413,6 +417,7 @@ class StreamHub:
                 if conn in st.consumers:
                     st.consumers.remove(conn)
             conn.close()
+            self._maybe_gc(st)
             metrics.stream_duration.observe(
                 time.monotonic() - started, hello.get("lane") or "data"
             )
@@ -422,10 +427,22 @@ class StreamHub:
             st.acked = max(st.acked, seq)
             while st.buffer and st.buffer[0][0] <= st.acked:
                 st.buffer.popleft()
-            eos = st.eos and not st.buffer
-            consumers = list(st.consumers)
             for pc in st.producer_conns:
                 self._maybe_replenish(st, pc)
-        if eos:
-            for c in consumers:
-                c.enqueue({"t": "eos"}, b"")
+        self._maybe_gc(st)
+
+    def _maybe_gc(self, st: _Stream) -> None:
+        """Reclaim a finished stream: eos'd, nothing buffered, nobody
+        attached. (A stream whose data was never consumed/acked is kept
+        so a late consumer can still read it — accepted retention cost;
+        operators bound it with buffer maxMessages.)"""
+        with self._lock:
+            with st.lock:
+                if (
+                    st.eos
+                    and not st.buffer
+                    and not st.consumers
+                    and not st.producer_conns
+                    and self._streams.get(st.name) is st
+                ):
+                    del self._streams[st.name]

@@ -27,7 +27,11 @@ _log = logging.getLogger(__name__)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _SRC = os.path.abspath(os.path.join(_NATIVE_DIR, "blobcache.cc"))
-_SO = os.path.abspath(os.path.join(_NATIVE_DIR, "libblobcache.so"))
+# deployment images ship a prebuilt .so outside the source tree and
+# point at it via env (deploy/Dockerfile)
+_SO = os.environ.get("BOBRA_NATIVE_BLOBCACHE") or os.path.abspath(
+    os.path.join(_NATIVE_DIR, "libblobcache.so")
+)
 
 _build_lock = threading.Lock()
 _lib: ctypes.CDLL | None = None

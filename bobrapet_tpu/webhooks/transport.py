@@ -48,13 +48,13 @@ def validate_streaming_settings(st, errs: FieldErrors, path: str) -> None:
                      f"must be one of {sorted(_VALID_FLOW_MODES)}")
         if fc.mode == "credits":
             credits = fc.initial_credits
-            has_positive = credits is not None and (
-                (credits.messages or 0) > 0 or (credits.bytes or 0) > 0
-            )
-            if not has_positive:
+            # the data plane grants message-granularity credits; a
+            # bytes-only window would be admitted but never replenished
+            if credits is None or (credits.messages or 0) < 1:
                 errs.add(
-                    f"{path}.flowControl.initialCredits",
-                    "mode=credits requires initialCredits.messages or .bytes > 0",
+                    f"{path}.flowControl.initialCredits.messages",
+                    "mode=credits requires initialCredits.messages >= 1 "
+                    "(bytes may only supplement the message window)",
                 )
             for holder, nm in ((credits, "initialCredits"), (fc.ack_every, "ackEvery")):
                 if holder is None:

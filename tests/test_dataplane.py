@@ -358,3 +358,97 @@ class TestFanIn:
                 pass
         assert sent <= 8, f"joint window leaked: {sent} sends succeeded"
         assert sent >= 1
+
+
+class TestReviewRegressions:
+    def test_eos_not_deadlocked_by_partial_ack(self, hub):
+        """atLeastOnce with ackEvery > 1: a tail shorter than the ack
+        cadence must still see eos (the hub doesn't gate eos on a fully
+        drained buffer)."""
+        settings = {
+            "flowControl": {"mode": "credits",
+                            "initialCredits": {"messages": 16},
+                            "ackEvery": {"messages": 5}},
+            "delivery": {"semantics": "atLeastOnce"},
+            "backpressure": {"buffer": {"maxMessages": 16}},
+        }
+        p = StreamProducer(hub.endpoint, "ns/r/partial", settings=settings)
+        for i in range(7):  # 7 % 5 != 0 -> tail never hits the cadence
+            p.send({"i": i})
+        received = []
+        done = threading.Event()
+
+        def drain():
+            c = StreamConsumer(hub.endpoint, "ns/r/partial",
+                               settings=settings, decode_json=True)
+            for m in c:
+                received.append(m)
+            done.set()
+
+        threading.Thread(target=drain, daemon=True).start()
+        p.close()
+        assert done.wait(10), "consumer hung waiting for eos"
+        assert [m["i"] for m in received] == list(range(7))
+
+    def test_truncated_stream_raises_not_clean_eof(self, hub):
+        """A hub death mid-stream must surface as StreamClosed, never a
+        clean end-of-stream (silent partial data)."""
+        from bobrapet_tpu.dataplane import StreamClosed
+
+        p = StreamProducer(hub.endpoint, "ns/r/trunc")
+        p.send({"i": 0})
+        c = StreamConsumer(hub.endpoint, "ns/r/trunc", decode_json=True)
+        it = iter(c)
+        assert next(it) == {"i": 0}
+        hub.stop()  # kills the consumer's socket without an eos frame
+        with pytest.raises(StreamClosed):
+            next(it)
+
+    def test_ack_rides_behind_consumption(self, hub):
+        """atLeastOnce: the ack covering a message goes out only after
+        the application consumed it — a crash mid-processing leaves the
+        message redeliverable."""
+        settings = dict(TestAtLeastOnce.SETTINGS)
+        p = StreamProducer(hub.endpoint, "ns/r/lag", settings=settings)
+        for i in range(3):
+            p.send({"i": i})
+        time.sleep(0.2)
+        c = StreamConsumer(hub.endpoint, "ns/r/lag",
+                           settings=settings, decode_json=True)
+        it = iter(c)
+        first = next(it)  # delivered but NOT yet acked (ack on resume)
+        assert first == {"i": 0}
+        time.sleep(0.2)
+        assert hub.stream_stats("ns/r/lag")["acked"] == -1
+        c.close()  # crash before processing completes
+        p.close(eos=False)
+        c2 = StreamConsumer(hub.endpoint, "ns/r/lag",
+                            settings=settings, decode_json=True)
+        redelivered = []
+        for m in c2:
+            redelivered.append(m)
+            if len(redelivered) == 3:
+                break
+        assert redelivered[0] == {"i": 0}  # message 0 was redelivered
+
+    def test_finished_streams_reclaimed(self, hub):
+        """A fully consumed stream disappears from the hub's table
+        (long-lived hubs must not leak per-run state)."""
+        p = StreamProducer(hub.endpoint, "ns/r/gc")
+        received = []
+        done = threading.Event()
+
+        def drain():
+            c = StreamConsumer(hub.endpoint, "ns/r/gc", decode_json=True)
+            for m in c:
+                received.append(m)
+            done.set()
+
+        threading.Thread(target=drain, daemon=True).start()
+        p.send({"i": 1})
+        p.close()
+        assert done.wait(10)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and hub.stream_stats("ns/r/gc"):
+            time.sleep(0.05)
+        assert hub.stream_stats("ns/r/gc") == {}
