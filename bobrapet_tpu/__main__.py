@@ -208,9 +208,15 @@ def main(argv: list[str] | None = None) -> int:
     hub.add_argument("--port", type=int, default=7447)
     hub.set_defaults(fn=_cmd_hub)
 
-    args = parser.parse_args(argv)
-    if args.command is None:
-        args = parser.parse_args(["manager", *(argv if argv is not None else sys.argv[1:])])
+    # implicit default subcommand: flag-only invocations (the k8s
+    # container-args pattern) run the manager — argparse would otherwise
+    # reject the first flag as an invalid subcommand choice
+    raw = list(argv) if argv is not None else sys.argv[1:]
+    known = {"manager", "export-crds", "export-manifests", "hub", "-h", "--help"}
+    if not raw or (raw[0] not in known and raw[0].startswith("-")):
+        if "-h" not in raw and "--help" not in raw:
+            raw = ["manager", *raw]
+    args = parser.parse_args(raw)
     logging.basicConfig(
         level=args.log_level,
         format="%(asctime)s %(levelname)s %(name)s %(message)s",

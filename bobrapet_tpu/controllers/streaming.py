@@ -433,13 +433,18 @@ def _sync_handoff(ctrl, sr, ctx, deployment, generation) -> None:
     new generation."""
     ns, name = sr.meta.namespace, sr.meta.name
     observed = int(deployment.status.get("observedConnectorGeneration", 0))
+    # per-generation readiness: the new generation's workers passed
+    # their readiness probe (for a TPU engram: model compiled + warm).
+    # Workloads that don't report it fall back to observation — the GKE
+    # pod template carries a real readiness probe instead.
+    ready_gen = int(deployment.status.get("readyGeneration", observed))
     current = sr.status.get("handoff") or {}
     strategy = "drain"
     settings = ctx.get("settings")
     if settings is not None and settings.lifecycle is not None and settings.lifecycle.upgrade_strategy:
         strategy = settings.lifecycle.upgrade_strategy
 
-    if observed and observed < generation:
+    if observed and (observed < generation or ready_gen < generation):
         if current.get("newGeneration") != generation or current.get("phase") == "Completed":
             now = ctrl.clock.now()
             ctrl.store.patch_status(
@@ -447,12 +452,19 @@ def _sync_handoff(ctrl, sr, ctx, deployment, generation) -> None:
                 lambda st: st.__setitem__("handoff", {
                     "strategy": strategy,
                     "phase": "Draining" if strategy == "drain" else "CuttingOver",
-                    "oldGeneration": observed,
+                    "oldGeneration": min(observed, ready_gen) or observed,
                     "newGeneration": generation,
                     "startedAt": now,
                 }),
             )
-    elif current and current.get("phase") != "Completed" and observed >= generation:
+    elif (
+        current
+        and current.get("phase") != "Completed"
+        and observed >= generation
+        and ready_gen >= generation
+    ):
+        # cutover/drain completes only when the NEW generation is ready
+        # to serve — old workers keep the stream until then
         ctrl.store.patch_status(
             STEP_RUN_KIND, ns, name,
             lambda st: st.__setitem__(

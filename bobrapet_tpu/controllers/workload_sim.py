@@ -26,16 +26,54 @@ _log = logging.getLogger(__name__)
 
 
 class WorkloadSimulator:
+    """Plays kubelet + readiness probe for long-running workloads.
+
+    Readiness is modeled PER GENERATION: observing a new connector
+    generation (spec seen, new pods scheduled) is distinct from that
+    generation being READY (readiness probe passing — for a TPU engram
+    that means the model is compiled and warm). Streaming cutover gates
+    on ``readyGeneration``, not observation (SURVEY §7 hard parts:
+    "cutover must wait for compiled-model readiness").
+
+    ``warmup_seconds`` simulates compile/warmup latency: a new
+    generation is observed immediately but reports ready only after the
+    warmup elapses. ``hold_readiness`` freezes readiness entirely for
+    tests that drive it manually via :meth:`mark_generation_ready`.
+    """
+
+    CONTROLLER = "workload-sim"
+
     def __init__(
         self,
         store: ResourceStore,
         clock: Optional[Clock] = None,
         auto_ready: bool = True,
+        warmup_seconds: float = 0.0,
+        hold_readiness: bool = False,
     ):
         self.store = store
         self.clock = clock or Clock()
         self.auto_ready = auto_ready
+        self.warmup_seconds = warmup_seconds
+        self.hold_readiness = hold_readiness
+        self._manager = None
+        #: (kind, ns, name, generation) -> warmup-complete time
+        self._warm_at: dict[tuple[str, str, str, int], float] = {}
         store.watch(self._on_event, kinds=[DEPLOYMENT_KIND, STATEFULSET_KIND])
+
+    def attach(self, manager) -> None:
+        """Register with the reconcile manager so pending warmups
+        self-complete: the simulator re-probes itself at warm_at
+        instead of waiting for an unrelated watch event."""
+        self._manager = manager
+        manager.register(self.CONTROLLER, self._reprobe, watches={})
+
+    def _reprobe(self, namespace: str, name: str) -> Optional[float]:
+        for kind in (DEPLOYMENT_KIND, STATEFULSET_KIND):
+            r = self.store.try_get(kind, namespace, name)
+            if r is not None:
+                self._on_event(WatchEvent(MODIFIED, r))
+        return None
 
     def _on_event(self, ev: WatchEvent) -> None:
         if not self.auto_ready or ev.type not in (ADDED, MODIFIED):
@@ -43,9 +81,18 @@ class WorkloadSimulator:
         r = ev.resource
         replicas = int(r.spec.get("replicas", 1))
         generation = int(r.spec.get("connectorGeneration", 0))
+        ready_gen = self._ready_generation(r, generation)
+        if ready_gen < generation and self._manager is not None and not self.hold_readiness:
+            key = (r.kind, r.meta.namespace, r.meta.name, generation)
+            remaining = self._warm_at.get(key, self.clock.now()) - self.clock.now()
+            self._manager.enqueue(
+                self.CONTROLLER, r.meta.namespace, r.meta.name,
+                after=max(0.01, remaining),
+            )
         if (
             int(r.status.get("readyReplicas", 0)) == replicas
             and int(r.status.get("observedConnectorGeneration", 0)) == generation
+            and int(r.status.get("readyGeneration", 0)) == ready_gen
         ):
             return
 
@@ -54,12 +101,30 @@ class WorkloadSimulator:
             st["availableReplicas"] = replicas
             if generation:
                 st["observedConnectorGeneration"] = generation
+            if ready_gen:
+                st["readyGeneration"] = max(
+                    ready_gen, int(st.get("readyGeneration", 0))
+                )
             st.setdefault("startedAt", self.clock.now())
 
         try:
             self.store.patch_status(r.kind, r.meta.namespace, r.meta.name, patch)
         except NotFound:
             pass
+
+    def _ready_generation(self, r, generation: int) -> int:
+        """Highest generation whose simulated readiness probe passes."""
+        if self.hold_readiness:
+            return int(r.status.get("readyGeneration", 0))
+        if self.warmup_seconds <= 0:
+            return generation
+        key = (r.kind, r.meta.namespace, r.meta.name, generation)
+        warm_at = self._warm_at.setdefault(
+            key, self.clock.now() + self.warmup_seconds
+        )
+        if self.clock.now() >= warm_at:
+            return generation
+        return int(r.status.get("readyGeneration", 0))
 
     def mark_ready(self, kind: str, namespace: str, name: str,
                    ready: bool = True) -> None:
@@ -71,3 +136,14 @@ class WorkloadSimulator:
             st["readyReplicas"] = replicas if ready else 0
 
         self.store.patch_status(kind, namespace, name, patch)
+
+    def mark_generation_ready(self, kind: str, namespace: str, name: str,
+                              generation: int) -> None:
+        """Manual probe: generation finished compiling/warming."""
+        self.store.patch_status(
+            kind, namespace, name,
+            lambda st: st.__setitem__(
+                "readyGeneration",
+                max(generation, int(st.get("readyGeneration", 0))),
+            ),
+        )

@@ -151,11 +151,16 @@ class _ConsumerConn:
 class StreamHub:
     """Threaded hub server. ``start()`` binds and returns the port."""
 
+    #: bounded tombstone memory for reclaimed streams (names are
+    #: run-scoped, so collisions with future runs don't occur)
+    _ENDED_MAX = 4096
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.host = host
         self.port = port
         self._server: Optional[socket.socket] = None
         self._streams: dict[str, _Stream] = {}
+        self._ended: collections.OrderedDict[str, bool] = collections.OrderedDict()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -231,6 +236,11 @@ class StreamHub:
             st = self._streams.get(name)
             if st is None:
                 st = _Stream(name, _settings_knobs(settings))
+                if name in self._ended:
+                    # re-attach after the stream was fully consumed and
+                    # reclaimed: it IS ended — a late consumer must get
+                    # eos, not hang on a fresh empty stream
+                    st.eos = True
                 self._streams[name] = st
             return st
 
@@ -266,8 +276,13 @@ class StreamHub:
     def _serve_producer(self, sock: socket.socket, st: _Stream) -> None:
         conn = _ProducerConn(sock, st)
         with st.lock:
+            # a live producer reopens the stream (redrive/retry of the
+            # producing step after a prior eos)
+            st.eos = False
             others = sum(p.outstanding for p in st.producer_conns)
             st.producer_conns.append(conn)
+        with self._lock:
+            self._ended.pop(st.name, None)
             if st.knobs["credits"]:
                 room = max(0, st.knobs["max_messages"] - len(st.buffer) - others)
                 grant = min(st.knobs["initial_credits"], room)
@@ -435,7 +450,20 @@ class StreamHub:
         """Reclaim a finished stream: eos'd, nothing buffered, nobody
         attached. (A stream whose data was never consumed/acked is kept
         so a late consumer can still read it — accepted retention cost;
-        operators bound it with buffer maxMessages.)"""
+        operators bound it with buffer maxMessages.) The cheap predicate
+        check runs under the stream lock alone — the hub-global lock is
+        taken only for the once-per-stream-lifetime reclaim, keeping it
+        off the per-ack hot path. A tombstone remembers the ended name
+        so a late re-attach still receives a clean eos."""
+        with st.lock:
+            reclaimable = (
+                st.eos
+                and not st.buffer
+                and not st.consumers
+                and not st.producer_conns
+            )
+        if not reclaimable:
+            return
         with self._lock:
             with st.lock:
                 if (
@@ -446,3 +474,6 @@ class StreamHub:
                     and self._streams.get(st.name) is st
                 ):
                     del self._streams[st.name]
+                    self._ended[st.name] = True
+                    while len(self._ended) > self._ENDED_MAX:
+                        self._ended.popitem(last=False)

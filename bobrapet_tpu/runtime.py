@@ -164,6 +164,8 @@ class Runtime:
         self.workload_simulator = WorkloadSimulator(self.store, clock=self.clock)
 
         self.manager = ControllerManager(self.store, clock=self.clock)
+        # timed re-probes so warmup-gated readiness self-completes
+        self.workload_simulator.attach(self.manager)
         self._register_controllers()
         self.store.watch(self._release_slices, kinds=[STEP_RUN_KIND])
 

@@ -452,3 +452,44 @@ class TestReviewRegressions:
         while time.monotonic() < deadline and hub.stream_stats("ns/r/gc"):
             time.sleep(0.05)
         assert hub.stream_stats("ns/r/gc") == {}
+
+    def test_late_consumer_after_gc_gets_clean_eos(self, hub):
+        """Re-attaching to a fully-consumed, reclaimed stream must end
+        cleanly (tombstone eos), not hang on a fresh empty stream."""
+        p = StreamProducer(hub.endpoint, "ns/r/late")
+        done = threading.Event()
+
+        def drain():
+            list(StreamConsumer(hub.endpoint, "ns/r/late"))
+            done.set()
+
+        threading.Thread(target=drain, daemon=True).start()
+        p.send(b"x")
+        p.close()
+        assert done.wait(10)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and hub.stream_stats("ns/r/late"):
+            time.sleep(0.05)
+        # the stream is gone; a late consumer still terminates
+        late = list(StreamConsumer(hub.endpoint, "ns/r/late"))
+        assert late == []
+
+    def test_producer_reopens_ended_stream(self, hub):
+        """A redriven producer step reuses its stream name: attaching a
+        producer clears the ended state so new data flows."""
+        p1 = StreamProducer(hub.endpoint, "ns/r/redrive")
+        list_done = threading.Event()
+
+        def drain1():
+            list(StreamConsumer(hub.endpoint, "ns/r/redrive"))
+            list_done.set()
+
+        threading.Thread(target=drain1, daemon=True).start()
+        p1.send(b"first")
+        p1.close()
+        assert list_done.wait(10)
+        p2 = StreamProducer(hub.endpoint, "ns/r/redrive")
+        p2.send(b"second")
+        p2.close()
+        got = list(StreamConsumer(hub.endpoint, "ns/r/redrive"))
+        assert got == [b"second"]
