@@ -210,12 +210,17 @@ def main(argv: list[str] | None = None) -> int:
 
     # implicit default subcommand: flag-only invocations (the k8s
     # container-args pattern) run the manager — argparse would otherwise
-    # reject the first flag as an invalid subcommand choice
+    # reject the first flag as an invalid subcommand choice. Only
+    # applied when NO subcommand appears anywhere, so
+    # `--log-level DEBUG export-crds` still reaches export-crds.
     raw = list(argv) if argv is not None else sys.argv[1:]
-    known = {"manager", "export-crds", "export-manifests", "hub", "-h", "--help"}
-    if not raw or (raw[0] not in known and raw[0].startswith("-")):
-        if "-h" not in raw and "--help" not in raw:
-            raw = ["manager", *raw]
+    commands = {"manager", "export-crds", "export-manifests", "hub"}
+    if (
+        not any(a in commands for a in raw)
+        and "-h" not in raw
+        and "--help" not in raw
+    ):
+        raw = ["manager", *raw]
     args = parser.parse_args(raw)
     logging.basicConfig(
         level=args.log_level,

@@ -166,8 +166,18 @@ class DAGEngine:
     # ------------------------------------------------------------------
     def run(self, run: Resource, story: StorySpec) -> Optional[float]:
         """One DAG reconcile pass. Returns requeue delay or None."""
+        from ..observability.tracing import TRACER
+
         before = run.status.get("phase")
-        result = self._run(run, story)
+        # feature-gated span, parented on the run's persisted trace
+        # (reference: StartSpan in reconcilers, storyrun_controller.go:217)
+        with TRACER.start_span(
+            "dag.reconcile",
+            trace_context=run.status.get("trace"),
+            run=run.meta.name,
+            namespace=run.meta.namespace,
+        ):
+            result = self._run(run, story)
         after = run.status.get("phase")
         if after != before and after and Phase(after).is_terminal:
             metrics.storyrun_total.inc(after)

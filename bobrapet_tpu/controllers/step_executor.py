@@ -88,6 +88,25 @@ class StepExecutor:
         ``run.status`` is mutated in place (timers/stop requests); the DAG
         engine persists it after the iteration loop.
         """
+        from ..observability.tracing import TRACER
+
+        with TRACER.start_span(
+            "step.execute",
+            trace_context=run.status.get("trace"),
+            step=step.name,
+            type=str(step.type) if step.type else "engram",
+            run=run.meta.name,
+        ):
+            return self._dispatch(run, story, step, scope, queue)
+
+    def _dispatch(
+        self,
+        run: Resource,
+        story: StorySpec,
+        step: Step,
+        scope: dict[str, Any],
+        queue: Optional[str],
+    ) -> StepState:
         if step.type is None:
             return self._execute_engram(run, story, step, scope, queue)
         if step.type is StepType.CONDITION:

@@ -123,6 +123,7 @@ class WorkloadSimulator:
             key, self.clock.now() + self.warmup_seconds
         )
         if self.clock.now() >= warm_at:
+            self._warm_at.pop(key, None)  # never consulted again
             return generation
         return int(r.status.get("readyGeneration", 0))
 

@@ -275,20 +275,32 @@ class StreamHub:
     # -- producer side -----------------------------------------------------
     def _serve_producer(self, sock: socket.socket, st: _Stream) -> None:
         conn = _ProducerConn(sock, st)
-        with st.lock:
-            # a live producer reopens the stream (redrive/retry of the
-            # producing step after a prior eos)
-            st.eos = False
-            others = sum(p.outstanding for p in st.producer_conns)
-            st.producer_conns.append(conn)
+        # hub lock first (lock order: hub -> stream): clear the ended
+        # tombstone and re-register the stream in case _maybe_gc
+        # reclaimed it between _get_stream and here (redrive re-attach)
         with self._lock:
             self._ended.pop(st.name, None)
-            if st.knobs["credits"]:
-                room = max(0, st.knobs["max_messages"] - len(st.buffer) - others)
-                grant = min(st.knobs["initial_credits"], room)
-                conn.outstanding = grant
-            else:
-                grant = UNLIMITED
+            self._streams.setdefault(st.name, st)
+            st = self._streams[st.name]
+            conn.stream = st
+            with st.lock:
+                # a live producer reopens the stream (redrive/retry of
+                # the producing step after a prior eos); registration +
+                # initial grant are ATOMIC under st.lock so a concurrent
+                # ack's replenish can't race the outstanding accounting
+                st.eos = False
+                st.producer_conns.append(conn)
+                if st.knobs["credits"]:
+                    others = sum(
+                        p.outstanding for p in st.producer_conns if p is not conn
+                    )
+                    room = max(
+                        0, st.knobs["max_messages"] - len(st.buffer) - others
+                    )
+                    grant = min(st.knobs["initial_credits"], room)
+                    conn.outstanding = grant
+                else:
+                    grant = UNLIMITED
         send_frame(sock, {"t": "ok", "credits": grant})
         try:
             while True:

@@ -96,9 +96,13 @@ class StorageManager:
         max_inline_size: Optional[int] = None,
     ) -> Any:
         """Replace oversized subtrees with storageRef markers
-        (reference: Dehydrate manager.go:465)."""
+        (reference: Dehydrate manager.go:465; span per op like the
+        reference's storage tracing, manager.go:85)."""
+        from ..observability.tracing import TRACER
+
         limit = self.max_inline_size if max_inline_size is None else max_inline_size
-        return self._dehydrate(value, key_prefix, limit, depth=0, counter=[0])
+        with TRACER.start_span("storage.dehydrate", prefix=key_prefix):
+            return self._dehydrate(value, key_prefix, limit, depth=0, counter=[0])
 
     def dehydrate_inputs(
         self,
@@ -165,12 +169,24 @@ class StorageManager:
         depth: int = 0,
     ) -> Any:
         """Resolve storageRef markers back into values
-        (reference: Hydrate manager.go:312).
+        (reference: Hydrate manager.go:312; one span per top-level op
+        like the reference's storage tracing, manager.go:85).
 
         ``allowed_prefixes`` is the anti-spoofing scope: every ref key must
         live under one of them (reference: validateStorageRef manager.go:518
         + storyrun_webhook.go:389).
         """
+        from ..observability.tracing import TRACER
+
+        with TRACER.start_span("storage.hydrate"):
+            return self._hydrate(value, allowed_prefixes, depth)
+
+    def _hydrate(
+        self,
+        value: Any,
+        allowed_prefixes: Optional[list[str]],
+        depth: int,
+    ) -> Any:
         if depth > self.max_depth:
             raise StorageError("hydrate recursion depth exceeded")
         if is_storage_ref(value):
@@ -198,13 +214,13 @@ class StorageManager:
                     )
             payload = _decode(data)
             # hydrated payload may itself contain refs (nested offload)
-            return self.hydrate(payload, allowed_prefixes, depth + 1)
+            return self._hydrate(payload, allowed_prefixes, depth + 1)
         # depth counts resolved refs only — plain container nesting must
         # hydrate anything dehydrate passed through inline
         if isinstance(value, dict):
-            return {k: self.hydrate(v, allowed_prefixes, depth) for k, v in value.items()}
+            return {k: self._hydrate(v, allowed_prefixes, depth) for k, v in value.items()}
         if isinstance(value, list):
-            return [self.hydrate(v, allowed_prefixes, depth) for v in value]
+            return [self._hydrate(v, allowed_prefixes, depth) for v in value]
         return value
 
     @staticmethod

@@ -336,6 +336,13 @@ class TestTracePersistence:
         assert "storyrun.run" in names
         assert "steprun.launch" in names
         assert "engram.work" in names
+        # controllers + storage emit feature-gated spans too
+        # (reference: StartSpan in reconcilers and pkg/storage)
+        assert "dag.reconcile" in names
+        assert "step.execute" in names
+        # the dag span parents on the run's persisted trace
+        dag_span = next(s for s in exporter.spans if s.name == "dag.reconcile")
+        assert dag_span.trace_id == trace["traceId"]
 
     def test_no_schemas_no_refs_and_disabled_tracer_no_trace(self, rt):
         from bobrapet_tpu.api.catalog import make_engram_template
