@@ -1,17 +1,18 @@
 # Build / test entry points.
 
-NATIVE_SRC := native/blobcache.cc
-NATIVE_SO  := native/libblobcache.so
+NATIVE_SO  := native/libblobcache.so native/libstreamhub.so
 
 .PHONY: all native test bench clean crds image
 
 all: native
 
-# The native slice-local SSD blob cache (also built on demand by
-# bobrapet_tpu/storage/ssd.py when the .so is missing or stale).
+# The native components (also built on demand by their ctypes loaders
+# when the .so is missing or stale):
+#   libblobcache.so  - slice-local SSD blob cache (storage/ssd.py)
+#   libstreamhub.so  - data-plane stream hub engine (dataplane/native.py)
 native: $(NATIVE_SO)
 
-$(NATIVE_SO): $(NATIVE_SRC)
+native/lib%.so: native/%.cc
 	g++ -O2 -shared -fPIC -std=c++17 -o $@ $< -pthread
 
 test: native

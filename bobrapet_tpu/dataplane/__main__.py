@@ -18,14 +18,23 @@ def main() -> None:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=7447)
     parser.add_argument("--log-level", default="INFO")
+    parser.add_argument(
+        "--engine", choices=["auto", "native", "python"], default="auto",
+        help="native = C++ poll loop (native/streamhub.cc); auto prefers "
+             "native and falls back to the Python broker",
+    )
     args = parser.parse_args()
     logging.basicConfig(level=args.log_level)
 
-    from .hub import StreamHub
+    from .native import make_hub
 
-    hub = StreamHub(host=args.host, port=args.port)
+    native = {"auto": None, "native": True, "python": False}[args.engine]
+    hub = make_hub(host=args.host, port=args.port, native=native)
     port = hub.start()
-    logging.getLogger(__name__).info("stream hub listening on %s:%s", args.host, port)
+    logging.getLogger(__name__).info(
+        "stream hub (%s) listening on %s:%s",
+        type(hub).__name__, args.host, port,
+    )
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())

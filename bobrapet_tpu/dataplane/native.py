@@ -1,0 +1,117 @@
+"""Native stream hub binding: ctypes over native/streamhub.cc.
+
+Same wire protocol and semantics as the Python :class:`~.hub.StreamHub`
+(single poll(2) event loop in C++, non-blocking sockets, per-connection
+write queues), exposed with the same start/stop/endpoint/stream_stats
+surface so the two are drop-in interchangeable — the data-plane test
+suite runs against both. Build-on-demand like the blob cache
+(storage/ssd.py); when no toolchain is available callers fall back to
+the Python hub.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Any, Optional
+
+from ..utils.nativelib import build_and_load
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SRC = os.path.abspath(os.path.join(_NATIVE_DIR, "streamhub.cc"))
+_SO = os.environ.get("BOBRA_NATIVE_STREAMHUB") or os.path.abspath(
+    os.path.join(_NATIVE_DIR, "libstreamhub.so")
+)
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+class NativeUnavailable(RuntimeError):
+    """The native hub library could not be built or loaded."""
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.shub_start.restype = ctypes.c_void_p
+    lib.shub_start.argtypes = [ctypes.c_char_p, ctypes.c_uint16]
+    lib.shub_port.restype = ctypes.c_uint16
+    lib.shub_port.argtypes = [ctypes.c_void_p]
+    lib.shub_stop.argtypes = [ctypes.c_void_p]
+    lib.shub_stream_stats.restype = ctypes.c_int
+    lib.shub_stream_stats.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+    ]
+
+
+def load_native() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            _lib = build_and_load(_SRC, _SO, _bind, NativeUnavailable)
+        return _lib
+
+
+class NativeStreamHub:
+    """Drop-in for :class:`bobrapet_tpu.dataplane.hub.StreamHub` backed
+    by the C++ event loop."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._lib = load_native()
+        self._handle: Optional[int] = None
+
+    def start(self) -> int:
+        handle = self._lib.shub_start(self.host.encode(), self.port)
+        if not handle:
+            raise RuntimeError(f"cannot start native hub on {self.host}:{self.port}")
+        self._handle = handle
+        self.port = int(self._lib.shub_port(handle))
+        return self.port
+
+    def stop(self) -> None:
+        if self._handle:
+            self._lib.shub_stop(self._handle)
+            self._handle = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def stream_stats(self, name: str) -> dict[str, Any]:
+        if not self._handle:
+            return {}
+        buf = ctypes.create_string_buffer(256)
+        rc = self._lib.shub_stream_stats(self._handle, name.encode(), buf, 256)
+        if rc != 0:
+            return {}
+        buffered, next_seq, acked, consumers, eos, paused, dropped = (
+            buf.value.decode().split(",")
+        )
+        return {
+            "buffered": int(buffered),
+            "nextSeq": int(next_seq),
+            "acked": int(acked),
+            "consumers": int(consumers),
+            "paused": paused == "1",
+            "eos": eos == "1",
+            "dropped": int(dropped),
+        }
+
+
+def make_hub(host: str = "127.0.0.1", port: int = 0, native: Optional[bool] = None):
+    """Hub factory: native C++ engine when available (or pinned with
+    ``native=True``), the Python hub otherwise."""
+    if native is False:
+        from .hub import StreamHub
+
+        return StreamHub(host=host, port=port)
+    try:
+        return NativeStreamHub(host=host, port=port)
+    except NativeUnavailable:
+        if native is True:
+            raise
+        from .hub import StreamHub
+
+        return StreamHub(host=host, port=port)

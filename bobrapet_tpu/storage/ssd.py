@@ -18,7 +18,6 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
-import subprocess
 import threading
 
 from .store import BlobNotFound, Store, StorageError
@@ -41,58 +40,14 @@ class NativeUnavailable(StorageError):
     """The native library could not be built or loaded."""
 
 
-def _build() -> str:
-    try:
-        if os.path.exists(_SO) and (
-            not os.path.exists(_SRC)  # prebuilt .so shipped without source
-            or os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
-        ):
-            return _SO
-        if not os.path.exists(_SRC):
-            raise NativeUnavailable("native source and library both missing")
-    except OSError as e:
-        raise NativeUnavailable(str(e)) from e
-    # compile to a private temp path, then atomic-rename into place — a
-    # second process must never dlopen a half-written .so
-    tmp_so = f"{_SO}.build{os.getpid()}"
-    cmd = [
-        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-        "-o", tmp_so, _SRC, "-pthread",
-    ]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
-        os.replace(tmp_so, _SO)
-    except FileNotFoundError as e:
-        raise NativeUnavailable("g++ not available") from e
-    except subprocess.CalledProcessError as e:
-        raise NativeUnavailable(f"native build failed: {e.stderr}") from e
-    except OSError as e:
-        raise NativeUnavailable(f"native build rename failed: {e}") from e
-    finally:
-        if os.path.exists(tmp_so):
-            os.unlink(tmp_so)
-    return _SO
-
-
 def load_native() -> ctypes.CDLL:
     global _lib
     with _build_lock:
-        if _lib is not None:
-            return _lib
-        so = _build()
-        try:
-            lib = ctypes.CDLL(so)
-        except OSError as e:  # stale/incompatible/half-written .so
-            raise NativeUnavailable(f"cannot load native library: {e}") from e
-        try:
-            _bind_symbols(lib)
-        except AttributeError as e:
-            # a prebuilt .so from an older build can lack newer symbols
-            # (e.g. bc_pin); that's "native unavailable", not a crash —
-            # callers fall back to the Python store
-            raise NativeUnavailable(f"native library too old: {e}") from e
-        _lib = lib
-        return lib
+        if _lib is None:
+            from ..utils.nativelib import build_and_load
+
+            _lib = build_and_load(_SRC, _SO, _bind_symbols, NativeUnavailable)
+        return _lib
 
 
 def _bind_symbols(lib: ctypes.CDLL) -> None:

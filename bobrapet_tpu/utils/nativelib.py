@@ -1,0 +1,75 @@
+"""Shared build-on-demand ctypes loader for the native components.
+
+One implementation of the mtime-checked g++ build, the tmp +
+atomic-replace dance, and the symbol binding — used by the slice-local
+SSD blob cache (storage/ssd.py) and the stream-hub engine
+(dataplane/native.py). Every failure mode maps to the caller-supplied
+``unavailable`` exception type so "no native" always degrades to the
+Python fallback instead of crashing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Type
+
+_lock = threading.Lock()
+
+
+def build_and_load(
+    src: str,
+    so: str,
+    bind: Callable[[ctypes.CDLL], None],
+    unavailable: Type[Exception],
+) -> ctypes.CDLL:
+    """Build (if stale) and dlopen one native library; bind its symbols.
+
+    Raises ``unavailable`` on ANY failure: missing toolchain, compile
+    error, rename failure, un-loadable or too-old .so.
+    """
+    with _lock:
+        try:
+            fresh = os.path.exists(so) and (
+                not os.path.exists(src)  # prebuilt .so shipped without source
+                or os.path.getmtime(so) >= os.path.getmtime(src)
+            )
+            if not fresh:
+                if not os.path.exists(src):
+                    raise unavailable("native source and library both missing")
+                _compile(src, so, unavailable)
+        except OSError as e:
+            raise unavailable(str(e)) from e
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError as e:  # stale/incompatible/half-written .so
+            raise unavailable(f"cannot load native library: {e}") from e
+        try:
+            bind(lib)
+        except AttributeError as e:
+            # a prebuilt .so from an older build can lack newer symbols;
+            # that's "native unavailable", not a crash
+            raise unavailable(f"native library too old: {e}") from e
+        return lib
+
+
+def _compile(src: str, so: str, unavailable: Type[Exception]) -> None:
+    # compile to a private temp path, then atomic-rename into place — a
+    # second process must never dlopen a half-written .so
+    tmp = f"{so}.build{os.getpid()}"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, src,
+           "-pthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, so)
+    except FileNotFoundError as e:
+        raise unavailable("g++ not available") from e
+    except subprocess.CalledProcessError as e:
+        raise unavailable(f"native build failed: {e.stderr}") from e
+    except OSError as e:
+        raise unavailable(f"native build rename failed: {e}") from e
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)

@@ -1,0 +1,749 @@
+// Native stream hub — the C++ engine for the realtime data plane.
+//
+// Same wire protocol and semantics as the Python hub
+// (bobrapet_tpu/dataplane/hub.py; reference counterpart: the bobravoz
+// gRPC hub, a separate Go deployable — here the hot IO path is native):
+//   * length-prefixed frames: 4B BE total len | 2B BE header len |
+//     JSON header | payload
+//   * per-stream bounded buffer with dropOldest/dropNewest/block
+//   * credit flow control with per-stream window accounting and
+//     pause/resume hysteresis
+//   * at-most-once (delivery attempt completes) vs atLeastOnce
+//     (cumulative ack, redelivery to reconnecting consumers)
+//   * fan-in: last live producer's eos ends the stream; tombstones so
+//     late consumers get a clean eos; producers reopen ended streams
+//
+// Single poll(2) event loop on a dedicated thread; all sockets
+// non-blocking with per-connection read accumulators and write queues
+// (a slow consumer can never stall the loop). Exposed through a small
+// C ABI consumed via ctypes (bobrapet_tpu/dataplane/native.py).
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <set>
+#include <string>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// minimal JSON (headers are small: objects/strings/numbers/bools/null)
+// ---------------------------------------------------------------------------
+
+struct JValue {
+  enum Kind { Null, Bool, Num, Str, Obj, Arr } kind = Null;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::map<std::string, JValue> obj;
+  std::vector<JValue> arr;
+
+  const JValue* get(const std::string& k) const {
+    auto it = obj.find(k);
+    return it == obj.end() ? nullptr : &it->second;
+  }
+  std::string get_str(const std::string& k, const std::string& dflt = "") const {
+    const JValue* v = get(k);
+    return (v && v->kind == Str) ? v->str : dflt;
+  }
+  long get_int(const std::string& k, long dflt = 0) const {
+    const JValue* v = get(k);
+    return (v && v->kind == Num) ? static_cast<long>(v->num) : dflt;
+  }
+};
+
+struct JParser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit JParser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void ws() { while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p; }
+  bool lit(const char* s) {
+    size_t n = std::strlen(s);
+    if (static_cast<size_t>(end - p) < n || std::memcmp(p, s, n) != 0) return false;
+    p += n;
+    return true;
+  }
+
+  JValue parse() {
+    JValue v = value();
+    ws();
+    if (p != end) ok = false;
+    return v;
+  }
+
+  JValue value() {
+    ws();
+    if (p >= end) { ok = false; return {}; }
+    switch (*p) {
+      case '{': return object();
+      case '[': return array();
+      case '"': { JValue v; v.kind = JValue::Str; v.str = string(); return v; }
+      case 't': { JValue v; v.kind = JValue::Bool; v.b = true; ok &= lit("true"); return v; }
+      case 'f': { JValue v; v.kind = JValue::Bool; v.b = false; ok &= lit("false"); return v; }
+      case 'n': { ok &= lit("null"); return {}; }
+      default: return number();
+    }
+  }
+
+  JValue object() {
+    JValue v; v.kind = JValue::Obj;
+    ++p;  // {
+    ws();
+    if (p < end && *p == '}') { ++p; return v; }
+    while (p < end) {
+      ws();
+      if (p >= end || *p != '"') { ok = false; return v; }
+      std::string key = string();
+      ws();
+      if (p >= end || *p != ':') { ok = false; return v; }
+      ++p;
+      v.obj[key] = value();
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == '}') { ++p; return v; }
+      ok = false;
+      return v;
+    }
+    ok = false;
+    return v;
+  }
+
+  JValue array() {
+    JValue v; v.kind = JValue::Arr;
+    ++p;  // [
+    ws();
+    if (p < end && *p == ']') { ++p; return v; }
+    while (p < end) {
+      v.arr.push_back(value());
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == ']') { ++p; return v; }
+      ok = false;
+      return v;
+    }
+    ok = false;
+    return v;
+  }
+
+  std::string string() {
+    std::string out;
+    ++p;  // opening quote
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'u': {
+            if (end - p >= 5) {
+              unsigned code = std::strtoul(std::string(p + 1, p + 5).c_str(), nullptr, 16);
+              p += 4;
+              // UTF-16 surrogate pair (json.dumps ensure_ascii emits
+              // non-BMP chars as \uD8xx\uDCxx) -> one code point
+              if (code >= 0xD800 && code <= 0xDBFF && end - p >= 7 &&
+                  p[1] == '\\' && p[2] == 'u') {
+                unsigned lo = std::strtoul(std::string(p + 3, p + 7).c_str(), nullptr, 16);
+                if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                  code = 0x10000 + ((code - 0xD800) << 10) + (lo - 0xDC00);
+                  p += 6;
+                }
+              }
+              if (code < 0x80) {
+                out += static_cast<char>(code);
+              } else if (code < 0x800) {
+                out += static_cast<char>(0xC0 | (code >> 6));
+                out += static_cast<char>(0x80 | (code & 0x3F));
+              } else if (code < 0x10000) {
+                out += static_cast<char>(0xE0 | (code >> 12));
+                out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+                out += static_cast<char>(0x80 | (code & 0x3F));
+              } else {
+                out += static_cast<char>(0xF0 | (code >> 18));
+                out += static_cast<char>(0x80 | ((code >> 12) & 0x3F));
+                out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+                out += static_cast<char>(0x80 | (code & 0x3F));
+              }
+            }
+            break;
+          }
+          default: out += *p;
+        }
+        ++p;
+      } else {
+        out += *p++;
+      }
+    }
+    if (p < end) ++p;  // closing quote
+    else ok = false;
+    return out;
+  }
+
+  JValue number() {
+    char* np = nullptr;
+    double d = std::strtod(p, &np);
+    if (np == p) { ok = false; return {}; }
+    p = np;
+    JValue v; v.kind = JValue::Num; v.num = d;
+    return v;
+  }
+};
+
+std::string jescape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// framing
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kMaxFrame = 64u * 1024u * 1024u;
+
+std::string frame(const std::string& header, const std::string& payload = "") {
+  uint32_t total = header.size() + payload.size();
+  std::string out;
+  out.reserve(6 + total);
+  out.push_back(static_cast<char>(total >> 24));
+  out.push_back(static_cast<char>(total >> 16));
+  out.push_back(static_cast<char>(total >> 8));
+  out.push_back(static_cast<char>(total));
+  out.push_back(static_cast<char>(header.size() >> 8));
+  out.push_back(static_cast<char>(header.size()));
+  out += header;
+  out += payload;
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// hub state
+// ---------------------------------------------------------------------------
+
+struct Knobs {
+  long max_messages = 1024;
+  std::string drop_policy = "dropOldest";  // dropOldest | dropNewest | block
+  bool credits = false;
+  long initial_credits = 0;
+  long pause_pct = 100;
+  long resume_pct = 0;
+  bool at_least_once = false;
+};
+
+Knobs knobs_from(const JValue& settings) {
+  Knobs k;
+  if (settings.kind != JValue::Obj) return k;
+  if (const JValue* bp = settings.get("backpressure")) {
+    if (const JValue* buf = bp->get("buffer")) {
+      long mm = buf->get_int("maxMessages", 0);
+      if (mm > 0) k.max_messages = mm;
+      std::string dp = buf->get_str("dropPolicy");
+      if (!dp.empty()) k.drop_policy = dp;
+    }
+  }
+  if (const JValue* fc = settings.get("flowControl")) {
+    k.credits = fc->get_str("mode") == "credits";
+    if (k.credits) {
+      if (const JValue* ic = fc->get("initialCredits"))
+        k.initial_credits = ic->get_int("messages", 0);
+    }
+    if (const JValue* pt = fc->get("pauseThreshold")) {
+      long v = pt->get_int("bufferPct", 0);
+      if (v > 0) k.pause_pct = v;
+    }
+    if (const JValue* rt = fc->get("resumeThreshold")) {
+      long v = rt->get_int("bufferPct", 0);
+      if (v > 0) k.resume_pct = v;
+    }
+  }
+  if (const JValue* d = settings.get("delivery")) {
+    k.at_least_once = d->get_str("semantics") == "atLeastOnce";
+  }
+  return k;
+}
+
+struct Entry {
+  long seq;
+  std::string header;
+  std::string payload;
+};
+
+struct Conn;
+
+struct Stream {
+  std::string name;
+  Knobs knobs;
+  std::deque<Entry> buffer;
+  long next_seq = 0;
+  long acked = -1;
+  long dropped = 0;  // by buffer drop policy
+  bool eos = false;
+  bool paused = false;
+  std::set<Conn*> producers;
+  std::set<Conn*> consumers;
+
+  double fill_pct() const {
+    return 100.0 * buffer.size() / (knobs.max_messages > 0 ? knobs.max_messages : 1);
+  }
+  long grantable() {
+    if (!knobs.credits) return -1;
+    double fill = fill_pct();
+    if (paused) {
+      if (fill <= knobs.resume_pct) paused = false;
+      else return 0;
+    } else if (fill >= knobs.pause_pct) {
+      paused = true;
+      return 0;
+    }
+    long room = knobs.max_messages - static_cast<long>(buffer.size());
+    return room > 0 ? room : 0;
+  }
+};
+
+struct Conn {
+  int fd = -1;
+  std::string rbuf;
+  std::string wbuf;
+  bool closing = false;     // protocol abort: flush wbuf then close
+  bool peer_eof = false;    // peer half-closed: PARSE buffered frames,
+                            // then close — eos often rides right behind
+                            // the last data frame before the FIN
+  bool handshaken = false;
+  bool is_producer = false;
+  Stream* stream = nullptr;
+  long outstanding = 0;     // producer credits handed out
+};
+
+struct Hub {
+  int listen_fd = -1;
+  uint16_t port = 0;
+  int wake_r = -1, wake_w = -1;  // self-pipe for shutdown
+  std::thread loop;
+  // ONE lock covers all hub/stream state: the event loop takes it for
+  // each post-poll handling burst (released while blocked in poll), and
+  // the external stats/stop API takes it for reads — so cross-thread
+  // access to stream internals is always serialized.
+  std::mutex mu;
+  std::map<std::string, std::unique_ptr<Stream>> streams;
+  std::set<std::string> ended;            // tombstone membership
+  std::deque<std::string> ended_fifo;     // eviction order (oldest first)
+  std::map<int, std::unique_ptr<Conn>> conns;
+  bool stopping = false;
+
+  // All helpers below assume mu is HELD by the caller (the event loop).
+  Stream* get_stream(const std::string& name, const JValue& settings) {
+    auto it = streams.find(name);
+    if (it != streams.end()) return it->second.get();
+    auto st = std::make_unique<Stream>();
+    st->name = name;
+    st->knobs = knobs_from(settings);
+    if (ended.count(name)) st->eos = true;
+    Stream* raw = st.get();
+    streams[name] = std::move(st);
+    return raw;
+  }
+
+  void maybe_gc(Stream* st) {
+    if (!(st->eos && st->buffer.empty() && st->consumers.empty() &&
+          st->producers.empty()))
+      return;
+    auto it = streams.find(st->name);
+    if (it != streams.end() && it->second.get() == st) {
+      if (!ended.count(st->name)) {
+        ended.insert(st->name);
+        ended_fifo.push_back(st->name);
+        while (ended_fifo.size() > 4096) {  // FIFO: oldest tombstone first
+          ended.erase(ended_fifo.front());
+          ended_fifo.pop_front();
+        }
+      }
+      streams.erase(it);
+    }
+  }
+
+  void send(Conn* c, const std::string& header, const std::string& payload = "") {
+    c->wbuf += frame(header, payload);
+  }
+
+  void replenish(Stream* st, Conn* producer) {
+    if (!st->knobs.credits) return;
+    long room = st->grantable();
+    if (room <= 0) return;
+    long others = 0;
+    for (Conn* p : st->producers)
+      if (p != producer) others += p->outstanding;
+    long grant = std::min(st->knobs.initial_credits - producer->outstanding,
+                          room - others - producer->outstanding);
+    if (grant > 0) {
+      producer->outstanding += grant;
+      send(producer, "{\"t\":\"credit\",\"n\":" + std::to_string(grant) + "}");
+    }
+  }
+
+  void deliver(Stream* st, const Entry& e) {
+    for (Conn* c : st->consumers) send(c, e.header, e.payload);
+  }
+
+  void on_hello(Conn* c, const JValue& h) {
+    std::string role = h.get_str("role");
+    const JValue* settings = h.get("settings");
+    Stream* st = get_stream(h.get_str("stream"), settings ? *settings : JValue{});
+    c->stream = st;
+    c->handshaken = true;
+    if (role == "producer") {
+      c->is_producer = true;
+      st->eos = false;  // a live producer reopens an ended stream
+      ended.erase(st->name);
+      long grant = -1;
+      if (st->knobs.credits) {
+        long others = 0;
+        for (Conn* p : st->producers) others += p->outstanding;
+        long room = st->knobs.max_messages -
+                    static_cast<long>(st->buffer.size()) - others;
+        grant = std::max(0L, std::min(st->knobs.initial_credits, room));
+        c->outstanding = grant;
+      }
+      st->producers.insert(c);
+      send(c, "{\"t\":\"ok\",\"credits\":" + std::to_string(grant) + "}");
+    } else if (role == "consumer") {
+      send(c, "{\"t\":\"ok\",\"credits\":-1}");
+      // ordered replay straight into the write queue, then live entries
+      for (const Entry& e : st->buffer) send(c, e.header, e.payload);
+      st->consumers.insert(c);
+      if (!st->knobs.at_least_once) st->buffer.clear();
+      for (Conn* p : st->producers) replenish(st, p);
+      if (st->eos) send(c, "{\"t\":\"eos\"}");
+    } else {
+      send(c, "{\"t\":\"err\",\"message\":\"bad role\"}");
+      c->closing = true;
+    }
+  }
+
+  void on_data(Conn* c, const JValue& h, const std::string& payload) {
+    Stream* st = c->stream;
+    if (st->knobs.credits) {
+      if (c->outstanding <= 0) {
+        send(c, "{\"t\":\"err\",\"message\":\"no credit\"}");
+        c->closing = true;
+        return;
+      }
+      --c->outstanding;
+    }
+    bool full = static_cast<long>(st->buffer.size()) >= st->knobs.max_messages;
+    if (full) {
+      if (st->knobs.drop_policy == "dropOldest") {
+        st->buffer.pop_front();
+        ++st->dropped;
+      } else if (st->knobs.drop_policy == "dropNewest") {
+        ++st->dropped;
+        replenish(st, c);
+        return;
+      }
+      // "block": without credits we park anyway; the in-flight window
+      // may exceed the cap (matches the Python hub)
+    }
+    Entry e;
+    e.seq = st->next_seq++;
+    std::string key = h.get_str("key");
+    e.header = "{\"t\":\"data\",\"seq\":" + std::to_string(e.seq) +
+               (key.empty() ? std::string(",\"key\":null}")
+                            : ",\"key\":\"" + jescape(key) + "\"}");
+    e.payload = payload;
+    st->buffer.push_back(e);
+    deliver(st, st->buffer.back());
+    if (!st->consumers.empty() && !st->knobs.at_least_once) st->buffer.pop_back();
+    replenish(st, c);
+  }
+
+  void on_eos(Conn* c) {
+    Stream* st = c->stream;
+    st->producers.erase(c);
+    if (st->producers.empty()) {
+      st->eos = true;
+      for (Conn* cons : st->consumers) send(cons, "{\"t\":\"eos\"}");
+    }
+    c->closing = true;
+    maybe_gc(st);
+  }
+
+  void on_ack(Conn* c, long seq) {
+    Stream* st = c->stream;
+    if (seq > st->acked) st->acked = seq;
+    while (!st->buffer.empty() && st->buffer.front().seq <= st->acked)
+      st->buffer.pop_front();
+    for (Conn* p : st->producers) replenish(st, p);
+    maybe_gc(st);
+  }
+
+  void on_frame(Conn* c, const std::string& header_raw, const std::string& payload) {
+    JParser parser(header_raw);
+    JValue h = parser.parse();
+    if (!parser.ok || h.kind != JValue::Obj) {
+      c->closing = true;
+      return;
+    }
+    std::string t = h.get_str("t");
+    if (!c->handshaken) {
+      if (t == "hello") on_hello(c, h);
+      else {
+        send(c, "{\"t\":\"err\",\"message\":\"expected hello\"}");
+        c->closing = true;
+      }
+      return;
+    }
+    if (c->is_producer) {
+      if (t == "data") on_data(c, h, payload);
+      else if (t == "eos") on_eos(c);
+      else {
+        send(c, "{\"t\":\"err\",\"message\":\"unexpected frame\"}");
+        c->closing = true;
+      }
+    } else {
+      if (t == "ack") on_ack(c, h.get_int("seq", -1));
+    }
+  }
+
+  void drop_conn(int fd) {
+    auto it = conns.find(fd);
+    if (it == conns.end()) return;
+    Conn* c = it->second.get();
+    if (c->stream != nullptr) {
+      c->stream->producers.erase(c);
+      c->stream->consumers.erase(c);
+      for (Conn* p : c->stream->producers) replenish(c->stream, p);
+      maybe_gc(c->stream);
+    }
+    ::close(fd);
+    conns.erase(it);
+  }
+
+  void pump_read(Conn* c) {
+    char buf[65536];
+    for (;;) {
+      ssize_t n = ::recv(c->fd, buf, sizeof(buf), 0);
+      if (n > 0) {
+        c->rbuf.append(buf, static_cast<size_t>(n));
+        // bound the per-burst accumulation (pipelined valid frames are
+        // parsed below and the poll loop re-triggers for the rest); the
+        // per-FRAME cap is enforced by the parser, not here
+        if (c->rbuf.size() >= 2ull * kMaxFrame) break;
+        continue;
+      }
+      if (n == 0) { c->peer_eof = true; break; }
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      c->peer_eof = true;
+      break;
+    }
+    // parse complete frames
+    for (;;) {
+      if (c->rbuf.size() < 6) break;
+      const unsigned char* b = reinterpret_cast<const unsigned char*>(c->rbuf.data());
+      uint32_t total = (uint32_t(b[0]) << 24) | (uint32_t(b[1]) << 16) |
+                       (uint32_t(b[2]) << 8) | uint32_t(b[3]);
+      uint16_t hlen = (uint16_t(b[4]) << 8) | uint16_t(b[5]);
+      if (total > kMaxFrame || hlen > total) { c->closing = true; break; }
+      if (c->rbuf.size() < 6 + total) break;
+      std::string header = c->rbuf.substr(6, hlen);
+      std::string payload = c->rbuf.substr(6 + hlen, total - hlen);
+      c->rbuf.erase(0, 6 + total);
+      on_frame(c, header, payload);
+      if (c->closing) break;  // protocol abort only — EOF keeps parsing
+    }
+    // after EOF nothing more arrives: any residue is a truncated frame
+    if (c->peer_eof) c->closing = true;
+  }
+
+  void pump_write(Conn* c) {
+    while (!c->wbuf.empty()) {
+      ssize_t n = ::send(c->fd, c->wbuf.data(), c->wbuf.size(), MSG_NOSIGNAL);
+      if (n > 0) {
+        c->wbuf.erase(0, static_cast<size_t>(n));
+        continue;
+      }
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+      c->closing = true;
+      c->wbuf.clear();
+      return;
+    }
+  }
+
+  void run() {
+    for (;;) {
+      std::vector<pollfd> fds;
+      std::vector<int> order;
+      {
+        std::lock_guard<std::mutex> lock(mu);
+        fds.push_back({listen_fd, POLLIN, 0});
+        fds.push_back({wake_r, POLLIN, 0});
+        for (auto& kv : conns) {
+          short events = POLLIN;
+          if (!kv.second->wbuf.empty()) events |= POLLOUT;
+          fds.push_back({kv.first, events, 0});
+          order.push_back(kv.first);
+        }
+      }
+      int rc = ::poll(fds.data(), fds.size(), 1000);
+      std::lock_guard<std::mutex> lock(mu);  // handling burst
+      if (stopping) break;
+      if (rc <= 0) continue;
+      if (fds[0].revents & POLLIN) {
+        for (;;) {
+          int fd = ::accept(listen_fd, nullptr, nullptr);
+          if (fd < 0) break;
+          int fl = fcntl(fd, F_GETFL, 0);
+          fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+          int one = 1;
+          setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          auto c = std::make_unique<Conn>();
+          c->fd = fd;
+          conns[fd] = std::move(c);
+        }
+      }
+      if (fds[1].revents & POLLIN) {
+        char sink[64];
+        while (::read(wake_r, sink, sizeof(sink)) > 0) {}
+      }
+      for (size_t i = 0; i < order.size(); ++i) {
+        int fd = order[i];
+        auto it = conns.find(fd);
+        if (it == conns.end()) continue;
+        Conn* c = it->second.get();
+        short rev = fds[i + 2].revents;
+        if (rev & (POLLERR | POLLHUP)) {
+          // flush what we can, then close (half-closed peers still read)
+          pump_read(c);
+          pump_write(c);
+          if (c->wbuf.empty()) { drop_conn(fd); continue; }
+        }
+        if (rev & POLLIN) pump_read(c);
+        if ((rev & POLLOUT) || !c->wbuf.empty()) pump_write(c);
+        if (c->closing && c->wbuf.empty()) drop_conn(fd);
+      }
+    }
+    // teardown (the burst lock was released when break left its scope)
+    std::lock_guard<std::mutex> lock(mu);
+    for (auto& kv : conns) ::close(kv.first);
+    conns.clear();
+    ::close(listen_fd);
+    ::close(wake_r);
+    ::close(wake_w);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* shub_start(const char* host, uint16_t port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (::inet_pton(AF_INET, host && *host ? host : "127.0.0.1", &addr.sin_addr) != 1) {
+    ::close(fd);
+    return nullptr;
+  }
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+
+  int pipefd[2];
+  if (::pipe(pipefd) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  fcntl(pipefd[0], F_SETFL, O_NONBLOCK);
+  fcntl(pipefd[1], F_SETFL, O_NONBLOCK);
+
+  auto* hub = new Hub();
+  hub->listen_fd = fd;
+  hub->port = ntohs(addr.sin_port);
+  hub->wake_r = pipefd[0];
+  hub->wake_w = pipefd[1];
+  hub->loop = std::thread([hub] { hub->run(); });
+  return hub;
+}
+
+uint16_t shub_port(void* h) {
+  return h ? static_cast<Hub*>(h)->port : 0;
+}
+
+void shub_stop(void* h) {
+  if (!h) return;
+  auto* hub = static_cast<Hub*>(h);
+  {
+    std::lock_guard<std::mutex> lock(hub->mu);
+    hub->stopping = true;
+  }
+  char x = 1;
+  ssize_t ignored = ::write(hub->wake_w, &x, 1);
+  (void)ignored;
+  if (hub->loop.joinable()) hub->loop.join();
+  delete hub;
+}
+
+// Stats for tests/ops: fills "buffered,nextSeq,acked,consumers,eos" as
+// a tiny CSV; returns 0 when the stream exists, -1 otherwise.
+int shub_stream_stats(void* h, const char* name, char* out, uint64_t outlen) {
+  if (!h || !name || !out) return -1;
+  auto* hub = static_cast<Hub*>(h);
+  std::lock_guard<std::mutex> lock(hub->mu);
+  auto it = hub->streams.find(name);
+  if (it == hub->streams.end()) return -1;
+  Stream* st = it->second.get();
+  std::string s = std::to_string(st->buffer.size()) + "," +
+                  std::to_string(st->next_seq) + "," +
+                  std::to_string(st->acked) + "," +
+                  std::to_string(st->consumers.size()) + "," +
+                  (st->eos ? "1" : "0") + "," +
+                  (st->paused ? "1" : "0") + "," +
+                  std::to_string(st->dropped);
+  if (s.size() + 1 > outlen) return -1;
+  std::memcpy(out, s.c_str(), s.size() + 1);
+  return 0;
+}
+
+}  // extern "C"
