@@ -1,4 +1,9 @@
-"""Parallelism: device meshes, sharding rules, slice placement, collectives."""
+"""Parallelism: device meshes, sharding rules, slice placement, collectives.
+
+Long-context strategies (SURVEY §5.7): :func:`ring_attention` (k/v ring
+over ppermute, O(S/P) memory) and :func:`ulysses_attention` (head
+scatter over all-to-all, two collectives total) — pick per workload.
+"""
 
 from .mesh import build_mesh
 from .placement import (
@@ -10,6 +15,8 @@ from .placement import (
     chip_count,
     parse_topology,
 )
+from .ring_attention import make_ring_attn_fn, ring_attention
+from .ulysses import make_ulysses_attn_fn, ulysses_attention
 
 __all__ = [
     "build_mesh",
@@ -20,4 +27,8 @@ __all__ = [
     "SlicePool",
     "chip_count",
     "parse_topology",
+    "make_ring_attn_fn",
+    "make_ulysses_attn_fn",
+    "ring_attention",
+    "ulysses_attention",
 ]

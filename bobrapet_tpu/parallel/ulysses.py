@@ -1,0 +1,116 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second long-context strategy next to :mod:`.ring_attention`
+(SURVEY §5.7; the DeepSpeed-Ulysses pattern rebuilt on ``shard_map`` +
+``lax.all_to_all`` — no reference counterpart to port): instead of
+rotating k/v blocks around a ring, ONE all-to-all re-shards activations
+from sequence-sharded to head-sharded, each device runs dense attention
+over the FULL sequence for its head group, and a second all-to-all
+restores sequence sharding.
+
+Trade-off vs ring attention: two collectives total instead of P-1
+ppermute hops (better when the sequence axis spans few, well-connected
+devices and H >= P), but each device materializes full-sequence k/v for
+its heads — memory O(S * H/P) vs ring's O(S/P * H). Pick per workload;
+both ride ICI when the ``seq`` axis maps onto the physical mesh.
+
+Requires n_heads % axis_size == 0 (kv heads too — GQA kv heads are
+grouped up to q heads first when needed).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import attention_reference
+
+
+def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool,
+                   sm_scale: float, n_kv_heads: int):
+    axis_size = jax.lax.psum(1, axis_name)
+    hq = q.shape[2]
+    group = hq // n_kv_heads
+    if group > 1 and n_kv_heads % axis_size != 0:
+        # GQA with fewer kv heads than the axis can split: replicate kv
+        # up to the q-head count BEFORE the collective. When kv heads DO
+        # divide the axis they scatter at native width — group-factor
+        # less kv traffic over ICI — and attention_reference grows them
+        # locally.
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+
+    # [B, S/P, H, D] -> all-to-all -> [B, S, H/P, D]:
+    # scatter the head axis, gather the sequence axis
+    def scatter_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    ql, kl, vl = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    # full sequence locally for this head group: plain dense attention
+    # (handles the local GQA ratio hq/P : hkv/P itself)
+    out = attention_reference(
+        ql, kl, vl, causal=causal, sm_scale=sm_scale
+    )
+    # [B, S, H/P, D] -> all-to-all -> [B, S/P, H, D]
+    return jax.lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "seq",
+    causal: bool = True,
+    sm_scale: float | None = None,
+    batch_axes: tuple[str, ...] = (),
+) -> jax.Array:
+    """Full-sequence attention over sequence shards via head scatter.
+
+    Same contract as :func:`~.ring_attention.ring_attention`:
+    q [B, S, Hq, D], k/v [B, S, Hkv, D], S sharded on ``axis_name``.
+    """
+    axis_size = mesh.shape[axis_name]
+    hq = q.shape[2]
+    if hq % axis_size != 0:
+        raise ValueError(
+            f"ulysses needs n_heads ({hq}) divisible by the {axis_name!r} "
+            f"axis size ({axis_size}); use ring_attention otherwise"
+        )
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    bspec = batch_axes if batch_axes else None
+    spec = P(bspec, axis_name, None, None)
+    fn = functools.partial(
+        _ulysses_shard,
+        axis_name=axis_name,
+        causal=causal,
+        sm_scale=scale,
+        n_kv_heads=k.shape[2],
+    )
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def make_ulysses_attn_fn(mesh: Mesh, axis_name: str = "seq",
+                         batch_axes: tuple[str, ...] = ()):
+    """An attn_fn for models.llama.forward that runs Ulysses attention."""
+
+    def attn_fn(q, k, v):
+        return ulysses_attention(q, k, v, mesh, axis_name=axis_name,
+                                 batch_axes=batch_axes)
+
+    return attn_fn

@@ -29,6 +29,7 @@
 #include <mutex>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <netdb.h>
 #include <poll.h>
 #include <set>
 #include <string>
@@ -427,7 +428,13 @@ struct Hub {
     if (role == "producer") {
       c->is_producer = true;
       st->eos = false;  // a live producer reopens an ended stream
-      ended.erase(st->name);
+      if (ended.erase(st->name)) {
+        // keep fifo in sync or a later re-end would duplicate the
+        // entry and evict the live tombstone early
+        for (auto it = ended_fifo.begin(); it != ended_fifo.end(); ++it) {
+          if (*it == st->name) { ended_fifo.erase(it); break; }
+        }
+      }
       long grant = -1;
       if (st->knobs.credits) {
         long others = 0;
@@ -497,6 +504,9 @@ struct Hub {
       for (Conn* cons : st->consumers) send(cons, "{\"t\":\"eos\"}");
     }
     c->closing = true;
+    // detach BEFORE gc: maybe_gc may destroy the Stream, and drop_conn
+    // would otherwise dereference the freed pointer
+    c->stream = nullptr;
     maybe_gc(st);
   }
 
@@ -676,9 +686,19 @@ void* shub_start(const char* host, uint16_t port) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(port);
-  if (::inet_pton(AF_INET, host && *host ? host : "127.0.0.1", &addr.sin_addr) != 1) {
-    ::close(fd);
-    return nullptr;
+  const char* want = host && *host ? host : "127.0.0.1";
+  if (::inet_pton(AF_INET, want, &addr.sin_addr) != 1) {
+    // hostname bind (e.g. "localhost"): resolve like the Python hub
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(want, nullptr, &hints, &res) != 0 || res == nullptr) {
+      ::close(fd);
+      return nullptr;
+    }
+    addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    ::freeaddrinfo(res);
   }
   if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
       ::listen(fd, 128) != 0) {
@@ -725,8 +745,10 @@ void shub_stop(void* h) {
   delete hub;
 }
 
-// Stats for tests/ops: fills "buffered,nextSeq,acked,consumers,eos" as
-// a tiny CSV; returns 0 when the stream exists, -1 otherwise.
+// Stats for tests/ops: fills a tiny CSV with
+// "buffered,nextSeq,acked,consumers,eos,paused,dropped" (the ctypes
+// binding unpacks exactly these 7 fields); returns 0 when the stream
+// exists, -1 otherwise.
 int shub_stream_stats(void* h, const char* name, char* out, uint64_t outlen) {
   if (!h || !name || !out) return -1;
   auto* hub = static_cast<Hub*>(h);

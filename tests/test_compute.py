@@ -259,3 +259,71 @@ class TestRingAttention:
         attn = make_ring_attn_fn(mesh, "seq")
         out, _ = forward(params, tokens, cfg, attn_fn=attn)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+class TestUlyssesAttention:
+    """The all-to-all sequence-parallel strategy (DeepSpeed-Ulysses
+    pattern): one head-scatter all-to-all, dense local attention over
+    the full sequence, one gather back. Complement to ring attention
+    for meshes where n_heads >= axis size."""
+
+    @pytest.mark.parametrize("hq,hkv", [(8, 8), (8, 4), (8, 2), (16, 8)])
+    def test_matches_reference_over_8_shards(self, hq, hkv):
+        from bobrapet_tpu.parallel.ulysses import ulysses_attention
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("seq",))
+        S = 64
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, S, hq, 32))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, S, hkv, 32))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, S, hkv, 32))
+        ref = attention_reference(q, k, v, causal=True)
+        out = ulysses_attention(q, k, v, mesh, axis_name="seq", causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_non_causal(self):
+        from bobrapet_tpu.parallel.ulysses import ulysses_attention
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("seq",))
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 32, 8, 16))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 32, 8, 16))
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 32, 8, 16))
+        ref = attention_reference(q, k, v, causal=False)
+        out = ulysses_attention(q, k, v, mesh, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_head_divisibility_guard(self):
+        from bobrapet_tpu.parallel.ulysses import ulysses_attention
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("seq",))
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 32, 4, 16))
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_attention(q, q, q, mesh)
+
+    def test_matches_ring_attention(self):
+        """The two long-context strategies agree on the same shards."""
+        from bobrapet_tpu.parallel.ulysses import ulysses_attention
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("seq",))
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 8, 32))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 4, 32))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 64, 4, 32))
+        ring = ring_attention(q, k, v, mesh, axis_name="seq", causal=True)
+        uly = ulysses_attention(q, k, v, mesh, axis_name="seq", causal=True)
+        np.testing.assert_allclose(np.asarray(uly), np.asarray(ring),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_ulysses_inside_llama_forward(self):
+        from bobrapet_tpu.parallel.ulysses import make_ulysses_attn_fn
+
+        cfg = llama_tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 64), 0,
+                                    cfg.vocab_size)
+        ref, _ = forward(params, tokens, cfg)
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("seq",))
+        attn = make_ulysses_attn_fn(mesh, "seq")
+        out, _ = forward(params, tokens, cfg, attn_fn=attn)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
