@@ -32,7 +32,11 @@ _log = logging.getLogger("bobrapet.manager")
 # ---------------------------------------------------------------------------
 
 
-def _serve_http(runtime, bind: str, token: str | None) -> http.server.ThreadingHTTPServer:
+def _serve_http(state: dict, bind: str, token: str | None) -> http.server.ThreadingHTTPServer:
+    """``state['rt']`` is None while this replica waits on leader
+    election — /healthz stays green (the standby is alive and warm, the
+    kubelet must not kill it; reference: controller-runtime serves
+    health during election) while /readyz reports not-ready."""
     from .observability.metrics import REGISTRY
 
     host, _, port = bind.rpartition(":")
@@ -51,7 +55,8 @@ def _serve_http(runtime, bind: str, token: str | None) -> http.server.ThreadingH
             if self.path == "/healthz":
                 body, code = b"ok", 200
             elif self.path == "/readyz":
-                ready = runtime.manager.is_running()
+                rt = state.get("rt")
+                ready = rt is not None and rt.manager.is_running()
                 body, code = (b"ok", 200) if ready else (b"not ready", 503)
             elif self.path == "/metrics":
                 if not self._authorized():
@@ -88,6 +93,22 @@ def _cmd_manager(args: argparse.Namespace) -> int:
         with open(args.metrics_token_file) as f:
             token = f.read().strip()
 
+    # health/metrics serve from the start: a standby waiting on the
+    # lease must stay alive under liveness probes
+    state: dict = {"rt": None}
+    server = _serve_http(state, args.metrics_bind_address, token)
+
+    elector = None
+    if args.leader_elect:
+        from .utils.leader import FileLeaderElector
+
+        lease = args.leader_lease_file or os.path.join(
+            args.persist_dir or "/var/run/bobrapet", "leader.lock"
+        )
+        elector = FileLeaderElector(lease)
+        _log.info("leader election on %s (serving /healthz while waiting)", lease)
+        elector.acquire()
+
     rt = Runtime(
         persist_dir=args.persist_dir,
         clock=Clock(),
@@ -96,7 +117,7 @@ def _cmd_manager(args: argparse.Namespace) -> int:
         enable_webhooks=not args.disable_webhooks,
     )
     rt.start()
-    server = _serve_http(rt, args.metrics_bind_address, token)
+    state["rt"] = rt
     _log.info(
         "manager up: metrics on %s, executor=%s, webhooks=%s, persist=%s",
         args.metrics_bind_address, args.executor_mode,
@@ -121,6 +142,8 @@ def _cmd_manager(args: argparse.Namespace) -> int:
         hub.stop()
     server.shutdown()
     rt.stop()
+    if elector is not None:
+        elector.release()
     return 0
 
 
@@ -187,6 +210,11 @@ def main(argv: list[str] | None = None) -> int:
     mgr.add_argument("--with-hub", action="store_true",
                      help="run an embedded stream hub")
     mgr.add_argument("--hub-bind-address", default=":7447")
+    mgr.add_argument("--leader-elect", action="store_true",
+                     help="block until the lease flock is held "
+                          "(reference: cmd/main.go --leader-elect)")
+    mgr.add_argument("--leader-lease-file", default=None,
+                     help="lease path (default: <persist-dir>/leader.lock)")
     mgr.set_defaults(fn=_cmd_manager)
 
     crds = sub.add_parser("export-crds", help="write CRD YAML for all kinds",

@@ -1,0 +1,94 @@
+"""File-lock leader election for the manager.
+
+The reference elects a leader through a Kubernetes Lease
+(reference: cmd/main.go --leader-elect flag wiring controller-runtime's
+LeaderElection). This control plane owns its own resource bus, so the
+election primitive is an advisory ``flock`` on a lease file on shared
+storage: exactly one manager replica holds the exclusive lock; the
+others block until the holder dies (the kernel releases the flock on
+process exit — crash-safe, no TTL bookkeeping).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import logging
+import os
+import socket
+import threading
+from typing import Optional
+
+_log = logging.getLogger(__name__)
+
+
+class FileLeaderElector:
+    """Exclusive-flock lease; ``acquire`` blocks until leadership."""
+
+    def __init__(self, lease_path: str):
+        self.lease_path = lease_path
+        self._fh = None
+
+    @property
+    def identity(self) -> str:
+        return f"{socket.gethostname()}/{os.getpid()}"
+
+    def try_acquire(self) -> bool:
+        """One non-blocking attempt; True when this process is leader."""
+        if self._fh is not None:
+            return True
+        os.makedirs(os.path.dirname(self.lease_path) or ".", exist_ok=True)
+        fh = open(self.lease_path, "a+")
+        try:
+            fcntl.flock(fh.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            fh.close()
+            return False
+        fh.seek(0)
+        fh.truncate()
+        fh.write(self.identity)
+        fh.flush()
+        self._fh = fh
+        return True
+
+    def acquire(
+        self,
+        poll_interval: float = 2.0,
+        stop: Optional[threading.Event] = None,
+    ) -> bool:
+        """Block until leadership (or ``stop`` is set -> False)."""
+        waited = False
+        while True:
+            if self.try_acquire():
+                if waited:
+                    _log.info("leader election won by %s", self.identity)
+                return True
+            if not waited:
+                _log.info(
+                    "leader election: %s waiting on %s",
+                    self.identity, self.lease_path,
+                )
+                waited = True
+            if stop is not None and stop.wait(poll_interval):
+                return False
+            if stop is None:
+                threading.Event().wait(poll_interval)
+
+    def holder(self) -> Optional[str]:
+        """Best-effort identity of the current lease holder."""
+        try:
+            with open(self.lease_path) as fh:
+                return fh.read().strip() or None
+        except OSError:
+            return None
+
+    def release(self) -> None:
+        if self._fh is not None:
+            try:
+                fcntl.flock(self._fh.fileno(), fcntl.LOCK_UN)
+            finally:
+                self._fh.close()
+                self._fh = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._fh is not None

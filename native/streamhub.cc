@@ -421,6 +421,13 @@ struct Hub {
 
   void on_hello(Conn* c, const JValue& h) {
     std::string role = h.get_str("role");
+    if (role != "producer" && role != "consumer") {
+      // reject BEFORE creating stream state: a bad-role hello with a
+      // unique stream name must not leak an uncollectable Stream
+      send(c, "{\"t\":\"err\",\"message\":\"bad role\"}");
+      c->closing = true;
+      return;
+    }
     const JValue* settings = h.get("settings");
     Stream* st = get_stream(h.get_str("stream"), settings ? *settings : JValue{});
     c->stream = st;
@@ -454,9 +461,6 @@ struct Hub {
       if (!st->knobs.at_least_once) st->buffer.clear();
       for (Conn* p : st->producers) replenish(st, p);
       if (st->eos) send(c, "{\"t\":\"eos\"}");
-    } else {
-      send(c, "{\"t\":\"err\",\"message\":\"bad role\"}");
-      c->closing = true;
     }
   }
 
@@ -535,6 +539,11 @@ struct Hub {
       }
       return;
     }
+    if (c->stream == nullptr) {
+      // detached by a prior eos: further frames are a protocol error
+      c->closing = true;
+      return;
+    }
     if (c->is_producer) {
       if (t == "data") on_data(c, h, payload);
       else if (t == "eos") on_eos(c);
@@ -580,7 +589,7 @@ struct Hub {
     }
     // parse complete frames
     for (;;) {
-      if (c->rbuf.size() < 6) break;
+      if (c->closing || c->rbuf.size() < 6) break;
       const unsigned char* b = reinterpret_cast<const unsigned char*>(c->rbuf.data());
       uint32_t total = (uint32_t(b[0]) << 24) | (uint32_t(b[1]) << 16) |
                        (uint32_t(b[2]) << 8) | uint32_t(b[3]);
