@@ -155,16 +155,42 @@ def main() -> None:
     # sharded tensor-parallel over every available chip ----
     state["stage"] = "param-init"
     mesh = None
-    params = llama.init_params(jax.random.PRNGKey(0), cfg)
-    if n_chips > 1:
-        from jax.sharding import Mesh
+    # BENCH_QUANT=int8: weight-only quantization — halves HBM weight
+    # bytes (the decode roofline) and fits 8B on one 16 GB chip; the
+    # forward consumes the int8 tree natively (scales applied after each
+    # matmul, models/quant.py), so nothing bf16-sized ever materializes
+    quant_mode = os.environ.get("BENCH_QUANT", "")
+    if quant_mode not in ("", "int8"):
+        _fail(f"unknown BENCH_QUANT={quant_mode!r} (supported: int8)",
+              backend=backend)
+    quant_note = None
+    if not quant_mode and model_name == "8b" and n_chips == 1:
+        quant_mode = "int8"
+        quant_note = "auto: 8b bf16 exceeds one chip's HBM"
+    if quant_mode and n_chips > 1:
+        quant_mode = ""
+        quant_note = "int8 disabled: multi-chip shards the bf16 tree"
+    if quant_mode == "int8":
+        from bobrapet_tpu.models import quant
 
-        from bobrapet_tpu.parallel.sharding import shard_params
-
-        mesh = Mesh(np.array(jax.devices()).reshape(n_chips), ("model",))
-        params = shard_params(params, mesh)
+        # init + quantize on HOST memory: a big bf16 tree must never
+        # touch the accelerator (8b would OOM before quantization)
+        with jax.default_device(jax.devices("cpu")[0]):
+            params = quant.quantize_params(
+                llama.init_params(jax.random.PRNGKey(0), cfg)
+            )
+        params = jax.device_put(params, jax.devices()[0])
     else:
-        params = jax.device_put(params)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        if n_chips > 1:
+            from jax.sharding import Mesh
+
+            from bobrapet_tpu.parallel.sharding import shard_params
+
+            mesh = Mesh(np.array(jax.devices()).reshape(n_chips), ("model",))
+            params = shard_params(params, mesh)
+        else:
+            params = jax.device_put(params)
     jax.block_until_ready(params)
 
     import functools
@@ -273,6 +299,8 @@ def main() -> None:
         "new_tokens": new_tokens,
         "reps": reps,
         "decode_tokens_per_sec": round(tps, 2),
+        "quant": quant_mode or None,
+        "quant_note": quant_note,
         # includes compile warmup + `reps` decode passes inside the
         # generate engram; param init is hoisted out of the story
         "story_wallclock_s": round(story_wall, 3),

@@ -102,8 +102,16 @@ def _cmd_manager(args: argparse.Namespace) -> int:
     if args.leader_elect:
         from .utils.leader import FileLeaderElector
 
+        if not args.leader_lease_file and not args.persist_dir:
+            # a node-local default would let every node elect its own
+            # leader (split-brain) — demand a path on SHARED storage
+            _log.error(
+                "--leader-elect needs --leader-lease-file or --persist-dir "
+                "on storage shared by all replicas"
+            )
+            return 2
         lease = args.leader_lease_file or os.path.join(
-            args.persist_dir or "/var/run/bobrapet", "leader.lock"
+            args.persist_dir, "leader.lock"
         )
         elector = FileLeaderElector(lease)
         _log.info("leader election on %s (serving /healthz while waiting)", lease)

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import attention
 from ..ops.rmsnorm import rmsnorm_reference
+from .quant import matmul as _mm
 from ..ops.rope import apply_rope, rope_frequencies
 
 
@@ -145,9 +146,9 @@ def _attention_block(
 ) -> tuple[jax.Array, Optional[dict[str, jax.Array]]]:
     b, s, _ = x.shape
     h = rmsnorm_reference(x, layer["attn_norm"]["weight"], cfg.norm_eps)
-    q = (h @ layer["attn"]["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-    k = (h @ layer["attn"]["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-    v = (h @ layer["attn"]["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = _mm(h, layer["attn"]["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = _mm(h, layer["attn"]["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = _mm(h, layer["attn"]["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
     q = apply_rope(q, freqs, positions)
     k = apply_rope(k, freqs, positions)
 
@@ -163,7 +164,7 @@ def _attention_block(
     else:
         out = attn_fn(q, k, v)
     out = out.reshape(b, s, cfg.dim)
-    return x + out @ layer["attn"]["wo"], new_cache
+    return x + _mm(out, layer["attn"]["wo"]), new_cache
 
 
 def _cached_attention(q, k_all, v_all, valid_len, cfg: LlamaConfig) -> jax.Array:
@@ -186,9 +187,9 @@ def _cached_attention(q, k_all, v_all, valid_len, cfg: LlamaConfig) -> jax.Array
 
 def _mlp_block(layer: dict[str, Any], x: jax.Array, cfg: LlamaConfig) -> jax.Array:
     h = rmsnorm_reference(x, layer["mlp_norm"]["weight"], cfg.norm_eps)
-    gate = jax.nn.silu((h @ layer["mlp"]["w_gate"]).astype(jnp.float32))
-    up = (h @ layer["mlp"]["w_up"]).astype(jnp.float32)
-    return x + ((gate * up).astype(cfg.dtype) @ layer["mlp"]["w_down"])
+    gate = jax.nn.silu(_mm(h, layer["mlp"]["w_gate"]).astype(jnp.float32))
+    up = _mm(h, layer["mlp"]["w_up"]).astype(jnp.float32)
+    return x + _mm((gate * up).astype(cfg.dtype), layer["mlp"]["w_down"])
 
 
 def forward(
@@ -219,7 +220,7 @@ def forward(
     if cfg.tie_embeddings:
         logits = x @ params["embed"]["weight"].T.astype(cfg.dtype)
     else:
-        logits = x @ params["lm_head"]["weight"]
+        logits = _mm(x, params["lm_head"]["weight"])
     return logits.astype(jnp.float32), new_caches
 
 

@@ -1,0 +1,122 @@
+"""Int8 weight-only quantization for decode.
+
+Greedy decode is HBM-bandwidth-bound: every generated token re-reads
+every weight byte (BASELINE.md roofline). Storing matmul weights as
+int8 with per-output-channel scales halves the bytes vs bf16 — Llama-8B
+(~16 GB bf16) fits one 16 GB v5e chip — and raises the bandwidth
+roofline ~2x. Under jit the int8 tree is the carried state: XLA fuses
+the dequantize (convert + scale multiply) into each matmul's operand
+read, so the bf16 view is transient, never resident.
+
+Weight-only symmetric scheme (the standard inference recipe; no
+reference counterpart — the reference orchestrates containers and owns
+no model code):
+
+- every 2-D float matmul weight -> ``{"q": int8, "scale": f32[out]}``
+  (per-output-channel absmax scaling, error independent per column)
+- 1-D norm gains stay exact; the embedding table stays bf16 (it is a
+  gather, not a matmul, and shares storage with the tied lm head)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+#: param-tree paths never quantized (gather tables + tied heads)
+_SKIP_NAMES = {"embed"}
+
+
+def _is_quantized(leaf: Any) -> bool:
+    # structural marker (jit-friendly: arrays only, no static leaves):
+    # exactly {"q": int8, "scale": <original dtype>}
+    return (
+        isinstance(leaf, dict)
+        and set(leaf) == {"q", "scale"}
+        and getattr(leaf["q"], "dtype", None) == jnp.int8
+    )
+
+
+def quantize_array(w: jax.Array) -> dict[str, Any]:
+    """One matmul weight [in, out] -> int8 + per-out-column scale.
+    The scale carries the original dtype so the dequantized view is a
+    drop-in for the source weight."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return {"q": q.astype(jnp.int8), "scale": scale.astype(w.dtype)}
+
+
+def dequantize_array(leaf: dict[str, Any]) -> jax.Array:
+    scale = leaf["scale"]
+    return (leaf["q"].astype(jnp.float32) * scale.astype(jnp.float32)).astype(
+        scale.dtype
+    )
+
+
+def matmul(x: jax.Array, w: Any) -> jax.Array:
+    """``x @ w`` where ``w`` may be a plain array OR an int8 leaf.
+
+    For the quantized case the per-output-column scales factor out of
+    the contraction: ``x @ (q * s_col) == (x @ q) * s_col`` — the bf16
+    weight is NEVER materialized, not even transiently, so a decode
+    loop (lax.scan) carries only int8 weight bytes in HBM. This is the
+    hook the model forward uses at every weight site; it makes a
+    quantized tree a drop-in for the bf16 one."""
+    if _is_quantized(w):
+        out = x @ w["q"].astype(x.dtype)
+        return out * w["scale"].astype(x.dtype)
+    return x @ w
+
+
+def quantize_params(params: Any) -> Any:
+    """Walk a param tree; every 2-D float weight outside the skip list
+    becomes an int8 leaf. Structure is otherwise preserved, so
+    :func:`dequantize_params` yields a drop-in tree for ``forward``."""
+
+    def walk(node: Any, name: str) -> Any:
+        if isinstance(node, dict):
+            return {k: walk(v, k) for k, v in node.items()}
+        if isinstance(node, list):
+            return [walk(v, name) for v in node]
+        if (
+            isinstance(node, jax.Array)
+            and node.ndim == 2
+            and jnp.issubdtype(node.dtype, jnp.floating)
+            and name not in _SKIP_NAMES
+        ):
+            return quantize_array(node)
+        return node
+
+    out = {}
+    for key, value in params.items():
+        out[key] = value if key in _SKIP_NAMES else walk(value, key)
+    return out
+
+
+def dequantize_params(qparams: Any) -> Any:
+    """The bf16 view of an int8 tree — call INSIDE jit so XLA fuses the
+    dequantize into each weight's consumer and the view stays
+    transient."""
+
+    def walk(node: Any) -> Any:
+        if _is_quantized(node):
+            return dequantize_array(node)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        return node
+
+    return walk(qparams)
+
+
+def tree_bytes(params: Any) -> int:
+    """Total array storage of a (possibly quantized) param tree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        if hasattr(leaf, "nbytes"):
+            total += leaf.nbytes
+    return total

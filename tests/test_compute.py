@@ -327,3 +327,71 @@ class TestUlyssesAttention:
         out, _ = forward(params, tokens, cfg, attn_fn=attn)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-3, atol=2e-3)
+
+
+class TestInt8Quantization:
+    """Weight-only int8 decode (BASELINE: the 8B single-chip path needs
+    int8; per-output-channel absmax keeps column error independent)."""
+
+    def test_roundtrip_error_bounded(self):
+        from bobrapet_tpu.models import quant
+
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * 0.1
+        q = quant.quantize_array(w)
+        assert q["q"].dtype == jnp.int8
+        back = quant.dequantize_array(q)
+        # absmax/127 per column bounds the element error at scale/2
+        col_scale = np.asarray(q["scale"])
+        err = np.abs(np.asarray(back) - np.asarray(w))
+        assert (err <= col_scale[None, :] * 0.51).all()
+
+    def test_tree_halves_and_preserves_structure(self):
+        from bobrapet_tpu.models import quant
+
+        cfg = llama_tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        qp = quant.quantize_params(params)
+        # embed stays exact; matmul weights are int8
+        assert qp["embed"]["weight"].dtype == params["embed"]["weight"].dtype
+        assert qp["layers"][0]["attn"]["wq"]["q"].dtype == jnp.int8
+        assert qp["layers"][0]["attn_norm"]["weight"].ndim == 1  # untouched
+        # ~4x smaller matmul weights dominate the fp32 tiny tree
+        assert quant.tree_bytes(qp) < 0.5 * quant.tree_bytes(params)
+        deq = quant.dequantize_params(qp)
+        ref_tree = jax.tree_util.tree_structure(params)
+        assert jax.tree_util.tree_structure(deq) == ref_tree
+
+    def test_quantized_forward_close_and_decode_agrees(self):
+        from bobrapet_tpu.models import quant
+
+        cfg = llama_tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        qp = quant.quantize_params(params)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    cfg.vocab_size)
+        ref, _ = forward(params, tokens, cfg)
+
+        # the forward consumes the int8 tree NATIVELY (scales applied
+        # after each matmul) — no dequantized weight ever materializes
+        out = jax.jit(lambda qp, t: forward(qp, t, cfg)[0])(qp, tokens)
+        # logits track closely relative to their spread
+        spread = float(jnp.std(ref))
+        assert float(jnp.max(jnp.abs(out - ref))) < 0.12 * spread * 10
+        # greedy argmax agrees on the vast majority of positions
+        agree = jnp.mean(
+            (jnp.argmax(out, -1) == jnp.argmax(ref, -1)).astype(jnp.float32)
+        )
+        assert float(agree) >= 0.9, float(agree)
+
+    def test_quantized_greedy_generate(self):
+        from bobrapet_tpu.models import quant
+
+        cfg = llama_tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        qp = quant.quantize_params(params)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                                    cfg.vocab_size)
+
+        toks = jax.jit(lambda qp, p: greedy_generate(
+            qp, p, cfg=cfg, max_new_tokens=4, cache_capacity=16))(qp, prompt)
+        assert toks.shape == (1, 4)
