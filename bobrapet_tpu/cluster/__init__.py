@@ -1,0 +1,30 @@
+"""Cluster execution backend: apply GKE manifests and reconcile observed
+status back into the bus (see client/fake/kubeclient/executor modules)."""
+
+from .client import (
+    ClusterClient,
+    ClusterConflict,
+    ClusterError,
+    ClusterNotFound,
+    apply_manifest,
+    extract_failed_exit_code,
+    subset_differs,
+)
+from .executor import ClusterExecutor, ClusterWorkloadReconciler
+from .fake import FakeCluster, FakeKubelet
+from .kubeclient import KubeHttpClient
+
+__all__ = [
+    "ClusterClient",
+    "ClusterConflict",
+    "ClusterError",
+    "ClusterNotFound",
+    "ClusterExecutor",
+    "ClusterWorkloadReconciler",
+    "FakeCluster",
+    "FakeKubelet",
+    "KubeHttpClient",
+    "apply_manifest",
+    "extract_failed_exit_code",
+    "subset_differs",
+]

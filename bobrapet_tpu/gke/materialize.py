@@ -100,6 +100,7 @@ def materialize_gang_job(
     coordinator_port: int = DEFAULT_COORDINATOR_PORT,
     resources: Optional[dict[str, Any]] = None,
     jobset: bool = False,
+    hosts: Optional[int] = None,
 ) -> list[dict[str, Any]]:
     """One batch gang → [headless Service, Indexed Job] (or [JobSet]).
 
@@ -109,7 +110,9 @@ def materialize_gang_job(
     the env contract the gang executor applies locally
     (completion-index → TPU_WORKER_ID, worker hostnames, coordinator).
     """
-    hosts = max(1, int((grant or {}).get("hosts") or 1))
+    # gang width: the grant's host count when placed, else the caller's
+    # declared hosts (a multi-host gang can exist before placement)
+    hosts = max(1, int((grant or {}).get("hosts") or hosts or 1))
     labels = {
         "app.kubernetes.io/name": "bobrapet",
         "app.kubernetes.io/component": "engram",
@@ -158,19 +161,19 @@ def materialize_gang_job(
 
     env_list = env_from_dict(full_env)
     # per-host identity: the Indexed Job's completion index IS the worker
-    # id (SURVEY §2.6; locally contract.host_env plays this role)
-    env_list.append(
-        env_field_ref(
-            contract.ENV_TPU_WORKER_ID,
-            f"metadata.annotations['{COMPLETION_INDEX_ANNOTATION}']",
+    # id (SURVEY §2.6; locally contract.host_env plays this role). A
+    # plain (non-Indexed) single-pod Job has no completion-index
+    # annotation to dereference — host 0 is literal.
+    indexed = hosts > 1 or grant is not None
+    for env_name in (contract.ENV_TPU_WORKER_ID, contract.ENV_TPU_HOST_ID):
+        env_list.append(
+            env_field_ref(
+                env_name,
+                f"metadata.annotations['{COMPLETION_INDEX_ANNOTATION}']",
+            )
+            if indexed
+            else env_var(env_name, "0")
         )
-    )
-    env_list.append(
-        env_field_ref(
-            contract.ENV_TPU_HOST_ID,
-            f"metadata.annotations['{COMPLETION_INDEX_ANNOTATION}']",
-        )
-    )
 
     pod = build_pod_template(
         PodConfig(
@@ -194,7 +197,7 @@ def materialize_gang_job(
         "ttlSecondsAfterFinished": ttl_seconds_after_finished,
         "template": pod,
     }
-    if hosts > 1 or grant is not None:
+    if indexed:
         job_spec["completions"] = hosts
         job_spec["parallelism"] = hosts
         job_spec["completionMode"] = "Indexed"
@@ -381,6 +384,7 @@ class GKEMaterializer:
             timeout_seconds=spec.get("timeoutSeconds"),
             service_account=self.service_account,
             jobset=self.jobset,
+            hosts=spec.get("hosts"),
         )
 
     def materialize_deployment(self, dep, kind: str = "Deployment") -> list[dict[str, Any]]:

@@ -80,6 +80,8 @@ class Runtime:
         blob_store: Optional[Store] = None,
         placer: Optional[SlicePlacer] = None,
         executor_mode: str = "sync",
+        executor_backend: str = "local",
+        cluster_client=None,
         config_namespace: str = "bobrapet-system",
         enable_webhooks: bool = True,
         tracer=None,
@@ -157,15 +159,47 @@ class Runtime:
             self.store, recorder=self.recorder, clock=self.clock,
             heartbeat_timeout=3600.0,
         )
-        self.job_executor = LocalGangExecutor(
-            self.store, storage=self.storage, clock=self.clock, mode=executor_mode
-        )
-        # local "kubelet" for long-running workloads (realtime + impulse)
-        self.workload_simulator = WorkloadSimulator(self.store, clock=self.clock)
+        self.executor_backend = executor_backend
+        self.cluster = None
+        self.workload_simulator = None
+        if executor_backend == "cluster":
+            # cluster backend: bus Jobs/Deployments are materialized into
+            # GKE manifests, applied through a ClusterClient, and their
+            # observed status reconciled back (VERDICT r2 #1). Default
+            # client is the FakeCluster envtest analog with an in-process
+            # kubelet; pass a KubeHttpClient for a real cluster.
+            from .cluster import (
+                ClusterExecutor,
+                ClusterWorkloadReconciler,
+                FakeCluster,
+                FakeKubelet,
+            )
+
+            self.cluster = cluster_client or FakeCluster(clock=self.clock)
+            if isinstance(self.cluster, FakeCluster) and self.cluster._kubelet is None:
+                FakeKubelet(
+                    self.cluster, store=self.store, storage=self.storage,
+                    clock=self.clock, mode=executor_mode,
+                )
+            self.job_executor = ClusterExecutor(
+                self.store, self.cluster, clock=self.clock
+            )
+            self.workload_reconciler = ClusterWorkloadReconciler(
+                self.store, self.cluster, clock=self.clock
+            )
+        else:
+            self.job_executor = LocalGangExecutor(
+                self.store, storage=self.storage, clock=self.clock, mode=executor_mode
+            )
+            # local "kubelet" for long-running workloads (realtime + impulse)
+            self.workload_simulator = WorkloadSimulator(self.store, clock=self.clock)
 
         self.manager = ControllerManager(self.store, clock=self.clock)
         # timed re-probes so warmup-gated readiness self-completes
-        self.workload_simulator.attach(self.manager)
+        if self.workload_simulator is not None:
+            self.workload_simulator.attach(self.manager)
+        if executor_backend == "cluster":
+            self.workload_reconciler.attach(self.manager)
         self._register_controllers()
         self.store.watch(self._release_slices, kinds=[STEP_RUN_KIND])
 

@@ -1,0 +1,421 @@
+"""Cluster execution backend: apply/watch semantics, the FakeCluster
+envtest analog, exit-code extraction, rollout readiness reflection, and
+the stdlib Kubernetes REST client against a stub API server.
+
+Reference behaviors under test: workload ensure create-or-update
+(pkg/workload/ensure.go:58), handleJobStatus
+(steprun_controller.go:1947), extractPodExitCode (:2389).
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from bobrapet_tpu.api.catalog import make_engram_template
+from bobrapet_tpu.api.engram import make_engram
+from bobrapet_tpu.api.story import make_story
+from bobrapet_tpu.cluster import (
+    ClusterConflict,
+    FakeCluster,
+    KubeHttpClient,
+    apply_manifest,
+    extract_failed_exit_code,
+    subset_differs,
+)
+from bobrapet_tpu.runtime import Runtime
+from bobrapet_tpu.sdk import register_engram
+
+
+def job_manifest(name="j1", ns="default", image="img:1", labels=None):
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": {
+            "backoffLimit": 0,
+            "template": {
+                "metadata": {"labels": {"x": "y"}},
+                "spec": {"containers": [{"name": "engram", "image": image}]},
+            },
+        },
+    }
+
+
+class TestApplySemantics:
+    def test_create_then_unchanged(self):
+        c = FakeCluster()
+        m = {
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "s", "namespace": "default"},
+            "spec": {"ports": [{"port": 80}]},
+        }
+        _, outcome = apply_manifest(c, m)
+        assert outcome == "created"
+        _, outcome = apply_manifest(c, m)
+        assert outcome == "unchanged"
+
+    def test_drift_is_patched_but_server_defaults_are_not_drift(self):
+        c = FakeCluster()
+        m = {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "d", "namespace": "default"},
+            "spec": {"replicas": 1},
+        }
+        apply_manifest(c, m)
+        # server-side defaulting: extra live fields are not drift
+        c.patch("apps/v1", "Deployment", "default", "d",
+                {"spec": {"revisionHistoryLimit": 10}})
+        _, outcome = apply_manifest(c, m)
+        assert outcome == "unchanged"
+        # real drift on a controlled field is patched
+        m2 = dict(m, spec={"replicas": 3})
+        _, outcome = apply_manifest(c, m2)
+        assert outcome == "updated"
+        live = c.get("apps/v1", "Deployment", "default", "d")
+        assert live["spec"]["replicas"] == 3
+        assert live["spec"]["revisionHistoryLimit"] == 10  # merge, not replace
+
+    def test_job_spec_is_immutable_adopt_on_exists(self):
+        c = FakeCluster()
+        apply_manifest(c, job_manifest(image="img:1"))
+        live, outcome = apply_manifest(c, job_manifest(image="img:2"))
+        assert outcome == "unchanged"
+        assert (
+            live["spec"]["template"]["spec"]["containers"][0]["image"] == "img:1"
+        )
+
+    def test_create_conflict_raises(self):
+        c = FakeCluster()
+        c.create(job_manifest())
+        with pytest.raises(ClusterConflict):
+            c.create(job_manifest())
+
+    def test_subset_differs_lists_and_scalars(self):
+        assert not subset_differs({"a": [1, 2]}, {"a": [1, 2], "b": 3})
+        assert subset_differs({"a": [1, 2]}, {"a": [1, 2, 3]})
+        assert subset_differs({"a": {"b": 1}}, {"a": {}})
+        assert not subset_differs({}, {"anything": True})
+
+
+class TestExitCodeExtraction:
+    def test_most_recent_failed_pod_nonzero_code(self):
+        pods = [
+            {"status": {"phase": "Failed", "containerStatuses": [
+                {"state": {"terminated": {"exitCode": 2}}}]}},
+            {"status": {"phase": "Succeeded", "containerStatuses": [
+                {"state": {"terminated": {"exitCode": 0}}}]}},
+            {"status": {"phase": "Failed", "containerStatuses": [
+                {"state": {"terminated": {"exitCode": 99}}}]}},
+        ]
+        assert extract_failed_exit_code(pods) == 99
+
+    def test_unknown_when_no_terminated_state(self):
+        # evicted pod: Failed phase but no container terminated record
+        pods = [{"status": {"phase": "Failed"}}]
+        assert extract_failed_exit_code(pods) == -1
+        assert extract_failed_exit_code([]) == -1
+
+
+class TestFakeClusterControllers:
+    def test_indexed_job_creates_pods_with_completion_index(self):
+        c = FakeCluster()  # no kubelet: pods stay Pending
+        m = job_manifest(name="gang")
+        m["spec"].update(completions=4, parallelism=4, completionMode="Indexed")
+        c.create(m)
+        pods = c.list("v1", "Pod", "default", labels={"job-name": "gang"})
+        assert len(pods) == 4
+        indexes = sorted(
+            p["metadata"]["annotations"]["batch.kubernetes.io/job-completion-index"]
+            for p in pods
+        )
+        assert indexes == ["0", "1", "2", "3"]
+        assert all(p["status"]["phase"] == "Pending" for p in pods)
+
+    def test_job_fails_past_backoff_limit_and_succeeds_on_completion(self):
+        c = FakeCluster()
+        c.create(job_manifest(name="ok"))
+        c.patch_status("v1", "Pod", "default", "ok-0", {"status": {
+            "phase": "Succeeded",
+            "containerStatuses": [{"state": {"terminated": {"exitCode": 0}}}],
+        }})
+        job = c.get("batch/v1", "Job", "default", "ok")
+        assert {c_["type"] for c_ in job["status"]["conditions"]} == {"Complete"}
+
+        c.create(job_manifest(name="bad"))
+        c.patch_status("v1", "Pod", "default", "bad-0", {"status": {
+            "phase": "Failed",
+            "containerStatuses": [{"state": {"terminated": {"exitCode": 7}}}],
+        }})
+        job = c.get("batch/v1", "Job", "default", "bad")
+        assert {c_["type"] for c_ in job["status"]["conditions"]} == {"Failed"}
+
+    def test_deleting_job_cascades_pods(self):
+        c = FakeCluster()
+        m = job_manifest(name="gone")
+        m["spec"].update(completions=2, parallelism=2, completionMode="Indexed")
+        c.create(m)
+        c.delete("batch/v1", "Job", "default", "gone")
+        assert c.list("v1", "Pod", "default", labels={"job-name": "gone"}) == []
+
+
+class TestClusterBackendEndToEnd:
+    def test_unknown_exit_does_not_consume_retry_budget(self):
+        """An evicted pod (Failed, no terminated record) classifies as
+        unknown (-1) and retries without consuming budget
+        (reference: ExitClassUnknown semantics)."""
+        rt = Runtime(executor_backend="cluster")
+        rt.apply(make_engram_template("w-tpl", entrypoint="w-impl"))
+        rt.apply(make_engram("w", "w-tpl"))
+        evicted = {"done": False}
+
+        @register_engram("w-impl")
+        def impl(ctx):
+            return {"ok": True}
+
+        # evict the first pod before the kubelet runs it: hold the
+        # kubelet, fail the pod via status patch (the envtest move)
+        kubelet = rt.cluster._kubelet
+        orig = kubelet.pod_added
+
+        def evict_first(pod):
+            if not evicted["done"]:
+                evicted["done"] = True
+                meta = pod["metadata"]
+                rt.cluster.patch_status("v1", "Pod", meta["namespace"], meta["name"], {
+                    "status": {"phase": "Failed", "message": "evicted"},
+                })
+                return
+            orig(pod)
+
+        kubelet.pod_added = evict_first
+        rt.apply(make_story("s", steps=[
+            {"name": "a", "ref": {"name": "w"},
+             "execution": {"retry": {"maxRetries": 0}}},
+        ]))
+        run = rt.run_story("s")
+        rt.pump()
+        # maxRetries=0 yet the run succeeds: the unknown-class failure
+        # was retried for free, the second pod ran normally
+        assert rt.run_phase(run) == "Succeeded"
+        sr = next(iter(rt.store.list("StepRun")))
+        assert sr.status["retries"] == 0
+        assert sr.status["attempts"] == 2
+
+    def test_terminal_exit_code_flows_from_watched_pod_status(self):
+        rt = Runtime(executor_backend="cluster")
+        rt.apply(make_engram_template("f-tpl", entrypoint="f-impl"))
+        rt.apply(make_engram("f", "f-tpl"))
+
+        @register_engram("f-impl")
+        def impl(ctx):
+            from bobrapet_tpu.sdk import EngramExit
+
+            raise EngramExit(126, "bad config")
+
+        rt.apply(make_story("s", steps=[{"name": "a", "ref": {"name": "f"}}]))
+        run = rt.run_story("s")
+        rt.pump()
+        assert rt.run_phase(run) == "Failed"
+        sr = next(iter(rt.store.list("StepRun")))
+        assert sr.status["exitCode"] == 126
+        assert sr.status["exitClass"] == "terminal"
+        # the exit code came through the cluster: pod -> job -> bus
+        pods = rt.cluster.list("v1", "Pod", "default")
+        terms = [
+            cs["state"]["terminated"]["exitCode"]
+            for p in pods for cs in p["status"].get("containerStatuses", [])
+        ]
+        assert 126 in terms
+
+    def test_gang_pods_get_distinct_worker_ids(self):
+        from bobrapet_tpu.parallel.placement import SlicePool
+
+        rt = Runtime(executor_backend="cluster")
+        rt.placer.add_pool(SlicePool("v5e-pool", "2x4", chips_per_host=4))
+        rt.apply(make_engram_template("g-tpl", entrypoint="g-impl"))
+        rt.apply(make_engram("g", "g-tpl"))
+        seen = []
+
+        @register_engram("g-impl")
+        def impl(ctx):
+            seen.append(ctx.host_id)
+            return {"host": ctx.host_id}
+
+        rt.apply(make_story("s", steps=[
+            {"name": "a", "ref": {"name": "g"}, "tpu": {"topology": "2x4"}},
+        ], policy={"queue": "v5e-pool"}))
+        run = rt.run_story("s")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        # 8 chips / 4 per host = a 2-pod Indexed gang; worker identity
+        # flowed from the completion-index annotation (downward API)
+        assert sorted(seen) == [0, 1]
+        pods = rt.cluster.list("v1", "Pod", "default")
+        assert len(pods) == 2
+        job = rt.cluster.list("batch/v1", "Job", "default")[0]
+        assert job["spec"]["completionMode"] == "Indexed"
+        # TPU placement facts are on the pod spec
+        tspec = job["spec"]["template"]["spec"]
+        assert tspec["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+        limits = tspec["containers"][0]["resources"]["limits"]
+        assert limits["google.com/tpu"] == "4"
+
+
+class TestWorkloadReadinessReflection:
+    def _mk_bus_deployment(self, rt, generation=1):
+        from bobrapet_tpu.core.object import new_resource
+
+        d = new_resource("Deployment", "rt-step", "default", {
+            "replicas": 1,
+            "env": {"BOBRA_GRPC_PORT": "50051"},
+            "selector": {"bobrapet.io/step-run": "rt-step"},
+            "connectorGeneration": generation,
+            "serviceName": "rt-step-svc",
+        }, labels={"bobrapet.io/step-run": "rt-step"})
+        return rt.store.create(d)
+
+    def test_ready_generation_reflects_rollout(self):
+        rt = Runtime(executor_backend="cluster")
+        self._mk_bus_deployment(rt)
+        d = rt.store.get("Deployment", "default", "rt-step")
+        assert d.status["readyGeneration"] == 1
+        assert d.status["observedConnectorGeneration"] == 1
+
+        # bump the connector generation with readiness held: observed
+        # advances, ready does NOT (cutover must keep waiting)
+        rt.cluster.hold_readiness = True
+        rt.store.mutate("Deployment", "default", "rt-step",
+                        lambda r: r.spec.__setitem__("connectorGeneration", 2))
+        d = rt.store.get("Deployment", "default", "rt-step")
+        assert d.status["observedConnectorGeneration"] == 2
+        assert d.status["readyGeneration"] == 1
+
+        # probe passes (model compiled + warm) -> ready advances
+        rt.cluster.hold_readiness = False
+        rt.cluster.mark_ready("Deployment", "default", "rt-step")
+        d = rt.store.get("Deployment", "default", "rt-step")
+        assert d.status["readyGeneration"] == 2
+
+    def test_warmup_self_completes_via_timed_reprobe(self):
+        """Simulated compile/warmup latency resolves without any manual
+        poke: the reconciler's timed re-probe re-derives cluster status
+        once the clock passes warm_at."""
+        rt = Runtime(executor_backend="cluster")
+        rt.cluster.warmup_seconds = 30.0
+        self._mk_bus_deployment(rt)
+        rt.pump()
+        d = rt.store.get("Deployment", "default", "rt-step")
+        assert d.status["readyGeneration"] == 1
+        assert d.status["readyReplicas"] == 1
+
+
+# ---------------------------------------------------------------------------
+# stub API server for the stdlib REST client
+# ---------------------------------------------------------------------------
+
+
+class _StubAPIHandler(BaseHTTPRequestHandler):
+    server_version = "kube-stub"
+    store: dict = {}
+    requests: list = []
+
+    def log_message(self, *a):  # noqa: D102 - quiet
+        pass
+
+    def _reply(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        type(self).requests.append(("GET", self.path, None))
+        if "watch=true" in self.path:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            for ev in [
+                {"type": "ADDED", "object": {
+                    "metadata": {"name": "j1", "resourceVersion": "5"}}},
+                {"type": "MODIFIED", "object": {
+                    "metadata": {"name": "j1", "resourceVersion": "6"},
+                    "status": {"succeeded": 1}}},
+            ]:
+                self.wfile.write((json.dumps(ev) + "\n").encode())
+                self.wfile.flush()
+            return
+        if self.path.endswith("/jobs"):
+            self._reply(200, {"items": [{"metadata": {"name": "j1"}}]})
+        elif self.path.endswith("/jobs/missing"):
+            self._reply(404, {"kind": "Status", "code": 404})
+        else:
+            self._reply(200, {"metadata": {"name": "j1"}})
+
+    def do_POST(self):  # noqa: N802
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        type(self).requests.append(("POST", self.path, json.loads(body)))
+        self._reply(201, json.loads(body))
+
+    def do_PATCH(self):  # noqa: N802
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        type(self).requests.append(
+            ("PATCH", self.path, self.headers.get("Content-Type")))
+        self._reply(200, json.loads(body))
+
+    def do_DELETE(self):  # noqa: N802
+        type(self).requests.append(("DELETE", self.path, None))
+        self._reply(200, {"kind": "Status", "status": "Success"})
+
+
+@pytest.fixture
+def stub_api():
+    _StubAPIHandler.requests = []
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _StubAPIHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+class TestKubeHttpClient:
+    def test_paths_and_methods(self, stub_api):
+        c = KubeHttpClient(base_url=stub_api, token="tok")
+        assert c.get("batch/v1", "Job", "ns1", "missing") is None
+        c.create(job_manifest(ns="ns1"))
+        c.patch("batch/v1", "Job", "ns1", "j1", {"metadata": {"labels": {"a": "b"}}})
+        c.patch_status("batch/v1", "Job", "ns1", "j1", {"status": {"succeeded": 1}})
+        c.delete("batch/v1", "Job", "ns1", "j1")
+        assert c.list("batch/v1", "Job", "ns1")[0]["kind"] == "Job"
+        # core-group path has no group segment
+        assert c.get("v1", "Pod", "ns1", "p") is not None
+
+        paths = [(m, p) for m, p, _ in _StubAPIHandler.requests]
+        assert ("GET", "/apis/batch/v1/namespaces/ns1/jobs/missing") in paths
+        assert ("POST", "/apis/batch/v1/namespaces/ns1/jobs") in paths
+        assert ("GET", "/api/v1/namespaces/ns1/pods/p") in paths
+        patch_types = [x for m, p, x in _StubAPIHandler.requests if m == "PATCH"]
+        assert patch_types == ["application/merge-patch+json"] * 2
+        status_paths = [p for m, p, _ in _StubAPIHandler.requests
+                        if m == "PATCH" and p.endswith("/status")]
+        assert status_paths == ["/apis/batch/v1/namespaces/ns1/jobs/j1/status"]
+
+    def test_watch_streams_events(self, stub_api):
+        c = KubeHttpClient(base_url=stub_api, token="tok")
+        got = []
+        done = threading.Event()
+
+        def cb(ev_type, obj):
+            got.append((ev_type, obj.get("status", {})))
+            if len(got) >= 2:
+                done.set()
+                c.close()
+
+        c.watch(cb)
+        c.start_watch("batch/v1", "Job", "ns1")
+        assert done.wait(5.0)
+        assert got[0][0] == "ADDED"
+        assert got[1] == ("MODIFIED", {"succeeded": 1})

