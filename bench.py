@@ -1,26 +1,29 @@
-"""Benchmark: Story wall-clock + engram decode tokens/sec/chip (+ MFU).
+"""Benchmark sweep: all five BASELINE configurations + Llama decode MFU.
 
-Runs BASELINE config-2's shape — a 3-step DAG story (tokenize ->
-generate -> detokenize) through the FULL control plane, with the
-generate engram running Llama greedy decode on the real accelerator.
-Prints ONE JSON line:
+Emits ONE JSON line per configuration (configs 1/3/4/5 are control-plane
+/ data-plane wall-clock shapes; config 2 is the headline accelerator
+decode bench), with the **headline config-2 line LAST** so a driver that
+records only the final line still gets the primary metric:
 
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+    {"metric": "llama_decode_tokens_per_sec_per_chip", "value": N,
+     "unit": "tok/s/chip", "vs_baseline": N, ...}
 
-Defensive by design (round-1 postmortem): the default backend is probed
-in a *subprocess* with a bounded timeout so a hanging/unavailable TPU
-tunnel can never stall the benchmark silently — on probe failure the
-bench falls back to the cpu platform and records why. A hard deadline
-watchdog guarantees a parseable JSON line is emitted even if compute
-wedges after backend init.
+Architecture (round-3, per VERDICT r2 #2/#3):
 
-The reference publishes no numbers (BASELINE.md), so vs_baseline
-compares against this framework's own first recorded value when present
-in BENCH_BASELINE env (else 1.0).
+- The parent process NEVER initializes the default jax backend: the
+  sweep configs force the cpu platform, and the decode bench runs in a
+  **child process** whose backend is chosen by an adaptive subprocess
+  probe (budget = min(600, BENCH_DEADLINE/3), with forensics — elapsed,
+  stderr tail — recorded into the emitted line).
+- If the first probe fails, the sweep still runs (CPU), a decode
+  fallback runs on cpu, and a **second-chance probe** fires late in the
+  remaining budget; if the TPU comes up, the 1b decode AND the 8b+int8
+  decode run on it.
 
 Env knobs: BENCH_MODEL=tiny|1b|8b, BENCH_BATCH, BENCH_PROMPT_LEN,
 BENCH_NEW_TOKENS, BENCH_REPS, BENCH_FORCE_CPU=1, BENCH_PROBE_TIMEOUT (s),
-BENCH_DEADLINE (s), BENCH_BASELINE (tok/s/chip to compare against).
+BENCH_DEADLINE (s), BENCH_BASELINE (tok/s/chip), BENCH_QUANT=int8,
+BENCH_SKIP_SWEEP=1 (decode only), BENCH_CHILD (internal).
 """
 
 from __future__ import annotations
@@ -31,6 +34,16 @@ import subprocess
 import sys
 import threading
 import time
+
+T0 = time.monotonic()
+
+
+def _deadline_s() -> float:
+    return float(os.environ.get("BENCH_DEADLINE", "1200"))
+
+
+def _remaining() -> float:
+    return _deadline_s() - (time.monotonic() - T0)
 
 
 def _emit(obj: dict) -> None:
@@ -50,46 +63,59 @@ def _fail(msg: str, **extras) -> None:
     raise SystemExit(1)
 
 
-def _decide_backend() -> tuple[bool, str | None]:
+def _probe_backend(timeout: float) -> dict:
     """Probe default-backend init in a subprocess with a bounded timeout.
 
-    Returns (use_default, fallback_reason). The round-1 bench died inside
-    ``jax.default_backend()`` — a crash once and a 550s+ silent hang on
-    re-run — so the probe must never run in-process.
+    The round-1 bench died inside ``jax.default_backend()`` (a 550s+
+    silent hang in the axon TPU plugin), so the probe must never run
+    in-process. Returns forensics: {ok, elapsed_s, error, stderr_tail}.
     """
-    if os.environ.get("BENCH_FORCE_CPU"):
-        return False, "BENCH_FORCE_CPU set"
-    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
     code = "import jax; d = jax.devices(); print(jax.default_backend(), len(d))"
+    # the probe must see the DEFAULT platform: the parent pins its own
+    # JAX_PLATFORMS=cpu for the sweep, and inheriting that would make
+    # the probe vacuously pass on cpu while the real backend hangs
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout, env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        tail = ((e.stderr or b"").decode(errors="replace") if isinstance(e.stderr, bytes)
+                else (e.stderr or ""))[-300:]
+        return {"ok": False, "elapsed_s": round(time.monotonic() - t0, 1),
+                "error": f"default backend init timed out after {timeout:.0f}s",
+                "stderr_tail": tail.strip() or None}
+    elapsed = time.monotonic() - t0
+    if proc.returncode == 0:
+        return {"ok": True, "elapsed_s": round(elapsed, 1),
+                "detected": proc.stdout.strip()}
+    tail = (proc.stderr or "").strip()[-300:]
+    return {"ok": False, "elapsed_s": round(elapsed, 1),
+            "error": f"default backend init failed (rc={proc.returncode})",
+            "stderr_tail": tail or None}
 
-    def probe() -> tuple[str | None, float]:
-        t0 = time.monotonic()
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True, text=True, timeout=timeout,
-            )
-        except subprocess.TimeoutExpired:
-            return f"default backend init timed out after {timeout:.0f}s", timeout
-        if proc.returncode == 0:
-            return None, time.monotonic() - t0
-        tail = (proc.stderr or "").strip().splitlines()[-1:] or ["(no stderr)"]
-        return f"default backend init failed: {tail[0]}", time.monotonic() - t0
 
-    err, elapsed = probe()
-    if err is None:
-        return True, None
-    if elapsed < 30:
-        # fast failure — often a transient UNAVAILABLE from the tunnel;
-        # give it one more chance
+def _decide_backend() -> tuple[bool, dict]:
+    """Adaptive first probe: (use_default, forensics)."""
+    if os.environ.get("BENCH_FORCE_CPU"):
+        return False, {"ok": False, "error": "BENCH_FORCE_CPU set", "attempts": []}
+    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "0") or 0)
+    if timeout <= 0:
+        timeout = min(600.0, _deadline_s() / 3)
+    attempts = []
+    p = _probe_backend(timeout)
+    attempts.append(p)
+    if not p["ok"] and p["elapsed_s"] < 30:
+        # fast failure — often a transient UNAVAILABLE from the tunnel
         time.sleep(5)
-        err, _ = probe()
-        if err is None:
-            return True, None
-    return False, err
+        p = _probe_backend(timeout)
+        attempts.append(p)
+    return p["ok"], {**p, "attempts": attempts}
 
 
-def _arm_watchdog(deadline_s: float, state: dict) -> None:
+def _arm_watchdog(state: dict) -> None:
     """Emit a failure JSON line and hard-exit if the bench wedges —
     the driver must always receive a parseable line, never a bare kill."""
 
@@ -99,32 +125,252 @@ def _arm_watchdog(deadline_s: float, state: dict) -> None:
             "value": 0.0,
             "unit": "tok/s/chip",
             "vs_baseline": 0.0,
-            "error": f"bench deadline ({deadline_s:.0f}s) exceeded at stage: {state.get('stage')}",
+            "error": f"bench deadline ({_deadline_s():.0f}s) exceeded at stage: {state.get('stage')}",
             "backend": state.get("backend"),
         })
         sys.stdout.flush()
         os._exit(1)
 
-    t = threading.Timer(deadline_s, fire)
+    t = threading.Timer(_deadline_s(), fire)
     t.daemon = True
     t.start()
 
 
-def main() -> None:
-    state: dict = {"stage": "backend-probe"}
-    _arm_watchdog(float(os.environ.get("BENCH_DEADLINE", "1200")), state)
+# ---------------------------------------------------------------------------
+# sweep configs (control/data plane; cpu platform, light engrams)
+# ---------------------------------------------------------------------------
 
-    use_default, fallback_reason = _decide_backend()
 
+def _mk_runtime():
+    from bobrapet_tpu.runtime import Runtime
+
+    return Runtime()
+
+
+def _setup_engram(rt, name: str, entrypoint: str):
+    from bobrapet_tpu.api.catalog import make_engram_template
+    from bobrapet_tpu.api.engram import make_engram
+
+    rt.apply(make_engram_template(f"{name}-tpl", entrypoint=entrypoint))
+    rt.apply(make_engram(name, f"{name}-tpl"))
+
+
+def config1_single_step() -> dict:
+    """BASELINE config 1: single-step batch Story (one engram Job)."""
+    from bobrapet_tpu.api.story import make_story
+    from bobrapet_tpu.sdk import register_engram
+
+    rt = _mk_runtime()
+    _setup_engram(rt, "c1-worker", "c1-impl")
+
+    @register_engram("c1-impl")
+    def impl(ctx):
+        return {"echo": ctx.inputs.get("msg")}
+
+    rt.apply(make_story("c1", steps=[
+        {"name": "only", "ref": {"name": "c1-worker"},
+         "with": {"msg": "{{ inputs.msg }}"}},
+    ], output={"r": "{{ steps.only.output.echo }}"}))
+    reps = 20
+    t0 = time.perf_counter()
+    for i in range(reps):
+        run = rt.run_story("c1", inputs={"msg": f"m{i}"})
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+    wall = time.perf_counter() - t0
+    return {
+        "metric": "single_step_story_runs_per_sec",
+        "value": round(reps / wall, 2),
+        "unit": "runs/s",
+        "vs_baseline": 1.0,
+        "config": 1,
+        "runs": reps,
+        "wallclock_s": round(wall, 3),
+    }
+
+
+def config3_fanout_gang() -> dict:
+    """BASELINE config 3: parallel fan-out Story, gang-scheduled on a
+    slice pool (v5e-16 shape: 4 branches x 2x2 sub-slices)."""
+    from bobrapet_tpu.api.story import make_story
+    from bobrapet_tpu.parallel.placement import SlicePool
+    from bobrapet_tpu.sdk import register_engram
+
+    rt = _mk_runtime()
+    rt.placer.add_pool(SlicePool("v5e-16", "4x4", chips_per_host=4))
+    _setup_engram(rt, "c3-worker", "c3-impl")
+
+    @register_engram("c3-impl")
+    def impl(ctx):
+        return {"shard": ctx.inputs.get("shard"), "slice": ctx.env.get("BOBRA_SLICE_ID")}
+
+    branches = 8
+    rt.apply(make_story("c3", steps=[
+        {"name": "split", "type": "parallel", "with": {"steps": [
+            {"name": f"b{i}", "ref": {"name": "c3-worker"},
+             "with": {"shard": i}, "tpu": {"topology": "2x2"}}
+            for i in range(branches)
+        ]}},
+    ], policy={"queue": "v5e-16"}))
+    t0 = time.perf_counter()
+    run = rt.run_story("c3")
+    rt.pump()
+    wall = time.perf_counter() - t0
+    assert rt.run_phase(run) == "Succeeded", rt.run_phase(run)
+    return {
+        "metric": "gang_fanout_branches_per_sec",
+        "value": round(branches / wall, 2),
+        "unit": "branches/s",
+        "vs_baseline": 1.0,
+        "config": 3,
+        "branches": branches,
+        "gang": "4 x 2x2 slices from a 4x4 pool (queued all-or-nothing)",
+        "wallclock_s": round(wall, 3),
+    }
+
+
+def config4_streaming_hub() -> dict:
+    """BASELINE config 4: streaming over the real data-plane hub
+    (localhost TCP, credits + acks on), native C++ engine when the
+    toolchain is present."""
+    import threading as _t
+
+    from bobrapet_tpu.dataplane import StreamConsumer, StreamHub, StreamProducer
+
+    engine = "python"
+    hub = None
+    try:
+        from bobrapet_tpu.dataplane.native import NativeStreamHub, load_native
+
+        load_native()
+        hub = NativeStreamHub()
+        engine = "native"
+    except Exception:  # noqa: BLE001 - no toolchain; python hub is fine
+        hub = StreamHub()
+    hub.start()
+    try:
+        n_msgs = int(os.environ.get("BENCH_STREAM_MSGS", "5000"))
+        payload = {"pcm": "x" * 512}  # ~0.5 KB frames (voice-ish)
+        received = []
+        done = _t.Event()
+        c = StreamConsumer(hub.endpoint, "bench/run/stream", decode_json=True)
+
+        def drain():
+            for msg in c:
+                received.append(msg)
+            done.set()
+
+        t = _t.Thread(target=drain, daemon=True)
+        t.start()
+        p = StreamProducer(hub.endpoint, "bench/run/stream")
+        t0 = time.perf_counter()
+        for i in range(n_msgs):
+            p.send(payload)
+        p.close()
+        assert done.wait(120), "consumer did not finish"
+        wall = time.perf_counter() - t0
+        assert len(received) == n_msgs
+    finally:
+        hub.stop()
+    mb = n_msgs * (len(json.dumps(payload)) + 1) / 1e6
+    return {
+        "metric": "hub_stream_messages_per_sec",
+        "value": round(n_msgs / wall, 0),
+        "unit": "msg/s",
+        "vs_baseline": 1.0,
+        "config": 4,
+        "engine": engine,
+        "messages": n_msgs,
+        "mb_per_sec": round(mb / wall, 1),
+        "wallclock_s": round(wall, 3),
+    }
+
+
+def config5_nested_rag() -> dict:
+    """BASELINE config 5: nested executeStory RAG pipeline
+    (embed -> retrieve inner story, feeding generate)."""
+    from bobrapet_tpu.api.story import make_story
+    from bobrapet_tpu.sdk import register_engram
+
+    rt = _mk_runtime()
+    for name, ep in (("c5-embed", "c5-embed-i"), ("c5-retrieve", "c5-retr-i"),
+                     ("c5-generate", "c5-gen-i")):
+        _setup_engram(rt, name, ep)
+
+    @register_engram("c5-embed-i")
+    def embed(ctx):
+        q = ctx.inputs.get("q", "")
+        return {"vec": [float(ord(ch) % 7) for ch in q[:8]]}
+
+    @register_engram("c5-retr-i")
+    def retrieve(ctx):
+        k = len(ctx.inputs.get("vec") or [])
+        return {"docs": [f"doc{i}" for i in range(max(1, k // 2))]}
+
+    @register_engram("c5-gen-i")
+    def generate(ctx):
+        docs = ctx.inputs.get("docs") or []
+        return {"answer": f"answer from {len(docs)} docs"}
+
+    rt.apply(make_story("c5-lookup", steps=[
+        {"name": "embed", "ref": {"name": "c5-embed"},
+         "with": {"q": "{{ inputs.q }}"}},
+        {"name": "retrieve", "ref": {"name": "c5-retrieve"},
+         "with": {"vec": "{{ steps.embed.output.vec }}"}},
+    ], output={"docs": "{{ steps.retrieve.output.docs }}"}))
+    rt.apply(make_story("c5-rag", steps=[
+        {"name": "lookup", "type": "executeStory",
+         "with": {"storyRef": {"name": "c5-lookup"}, "with": {"q": "{{ inputs.q }}"}}},
+        {"name": "gen", "ref": {"name": "c5-generate"},
+         "with": {"docs": "{{ steps.lookup.output.docs }}"}},
+    ], output={"answer": "{{ steps.gen.output.answer }}"}))
+    reps = 10
+    t0 = time.perf_counter()
+    for i in range(reps):
+        run = rt.run_story("c5-rag", inputs={"q": f"question-{i}"})
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+    wall = time.perf_counter() - t0
+    return {
+        "metric": "nested_rag_pipelines_per_sec",
+        "value": round(reps / wall, 2),
+        "unit": "pipelines/s",
+        "vs_baseline": 1.0,
+        "config": 5,
+        "runs": reps,
+        "steps_per_pipeline": 4,
+        "wallclock_s": round(wall, 3),
+    }
+
+
+def run_sweep(state: dict) -> None:
+    for idx, fn in ((1, config1_single_step), (3, config3_fanout_gang),
+                    (4, config4_streaming_hub), (5, config5_nested_rag)):
+        state["stage"] = f"config-{idx}"
+        try:
+            _emit(fn())
+        except Exception as e:  # noqa: BLE001 - one config must not kill the sweep
+            _emit({
+                "metric": f"config{idx}_failed",
+                "value": 0.0, "unit": "error", "vs_baseline": 0.0,
+                "config": idx, "error": f"{type(e).__name__}: {e}",
+            })
+
+
+# ---------------------------------------------------------------------------
+# config 2: the accelerator decode bench (runs in a CHILD process)
+# ---------------------------------------------------------------------------
+
+
+def run_decode_child() -> None:
+    """Child entrypoint: backend already decided via env by the parent
+    (JAX_PLATFORMS=cpu for fallback; unset for the default backend)."""
+    state: dict = {"stage": "backend-init"}
     import jax
 
-    if not use_default:
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
+    if os.environ.get("BENCH_CHILD_CPU"):
+        jax.config.update("jax_platforms", "cpu")
 
-    state["stage"] = "backend-init"
     backend = jax.default_backend()
     state["backend"] = backend
     n_chips = jax.device_count()
@@ -140,7 +386,7 @@ def main() -> None:
     from bobrapet_tpu.runtime import Runtime
     from bobrapet_tpu.sdk import register_engram
 
-    model_name = os.environ.get("BENCH_MODEL") or ("1b" if backend == "tpu" else "tiny")
+    model_name = os.environ.get("BENCH_MODEL") or ("1b" if backend != "cpu" else "tiny")
     cfg = {
         "tiny": llama.llama_tiny,
         "1b": llama.llama3_1b,
@@ -148,7 +394,7 @@ def main() -> None:
     }[model_name]()
     batch = int(os.environ.get("BENCH_BATCH", "8"))
     prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
-    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64" if backend == "tpu" else "8"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64" if backend != "cpu" else "8"))
     reps = int(os.environ.get("BENCH_REPS", "3"))
 
     # ---- model state: initialized ONCE, outside the engram hot path,
@@ -156,9 +402,10 @@ def main() -> None:
     state["stage"] = "param-init"
     mesh = None
     # BENCH_QUANT=int8: weight-only quantization — halves HBM weight
-    # bytes (the decode roofline) and fits 8B on one 16 GB chip; the
-    # forward consumes the int8 tree natively (scales applied after each
-    # matmul, models/quant.py), so nothing bf16-sized ever materializes
+    # bytes (the decode roofline); the forward consumes the int8 tree
+    # natively (models/quant.py). Composes with tensor-parallel: the
+    # quantized tree shards on the model axis like the bf16 one
+    # (per-output-channel scales shard identically to their matmuls).
     quant_mode = os.environ.get("BENCH_QUANT", "")
     if quant_mode not in ("", "int8"):
         _fail(f"unknown BENCH_QUANT={quant_mode!r} (supported: int8)",
@@ -167,9 +414,6 @@ def main() -> None:
     if not quant_mode and model_name == "8b" and n_chips == 1:
         quant_mode = "int8"
         quant_note = "auto: 8b bf16 exceeds one chip's HBM"
-    if quant_mode and n_chips > 1:
-        quant_mode = ""
-        quant_note = "int8 disabled: multi-chip shards the bf16 tree"
     if quant_mode == "int8":
         from bobrapet_tpu.models import quant
 
@@ -179,7 +423,15 @@ def main() -> None:
             params = quant.quantize_params(
                 llama.init_params(jax.random.PRNGKey(0), cfg)
             )
-        params = jax.device_put(params, jax.devices()[0])
+        if n_chips > 1:
+            from jax.sharding import Mesh
+
+            from bobrapet_tpu.parallel.sharding import shard_params
+
+            mesh = Mesh(np.array(jax.devices()).reshape(n_chips), ("model",))
+            params = shard_params(params, mesh)
+        else:
+            params = jax.device_put(params, jax.devices()[0])
     else:
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
         if n_chips > 1:
@@ -285,11 +537,12 @@ def main() -> None:
     mfu = (tps_per_chip * flops_per_token / peak) if peak else None
 
     baseline = float(os.environ.get("BENCH_BASELINE", "0") or 0)
-    result = {
+    _emit({
         "metric": "llama_decode_tokens_per_sec_per_chip",
         "value": round(tps_per_chip, 2),
         "unit": "tok/s/chip",
         "vs_baseline": round(tps_per_chip / baseline, 3) if baseline else 1.0,
+        "config": 2,
         "model": model_name,
         "backend": backend,
         "device_kind": device_kind,
@@ -305,10 +558,126 @@ def main() -> None:
         # generate engram; param init is hoisted out of the story
         "story_wallclock_s": round(story_wall, 3),
         "mfu": round(mfu, 4) if mfu is not None else None,
-    }
-    if fallback_reason:
-        result["fallback_reason"] = fallback_reason
-    _emit(result)
+    })
+
+
+def _spawn_decode(cpu: bool, model: str | None, quant: str | None,
+                  timeout: float, extra: dict | None = None) -> dict | None:
+    """Run the decode bench in a child process; return its JSON line."""
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = "decode"
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("BENCH_CHILD_CPU", None)
+    if cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_CHILD_CPU"] = "1"
+    if model:
+        env["BENCH_MODEL"] = model
+    if quant is not None:
+        env["BENCH_QUANT"] = quant
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=timeout, env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        tail = e.stderr or ""
+        if isinstance(tail, bytes):
+            tail = tail.decode(errors="replace")
+        return {"metric": "llama_decode_tokens_per_sec_per_chip", "value": 0.0,
+                "unit": "tok/s/chip", "vs_baseline": 0.0, "config": 2,
+                "error": f"decode child timed out after {timeout:.0f}s",
+                "stderr_tail": tail.strip()[-400:] or None,
+                "model": model, "cpu": cpu}
+    line = None
+    for ln in (proc.stdout or "").strip().splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                line = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+    if line is None:
+        tail = (proc.stderr or "").strip()[-300:]
+        return {"metric": "llama_decode_tokens_per_sec_per_chip", "value": 0.0,
+                "unit": "tok/s/chip", "vs_baseline": 0.0, "config": 2,
+                "error": f"decode child emitted no JSON (rc={proc.returncode})",
+                "stderr_tail": tail or None, "model": model, "cpu": cpu}
+    if extra:
+        line.update(extra)
+    return line
+
+
+def main() -> None:
+    if os.environ.get("BENCH_CHILD") == "decode":
+        run_decode_child()
+        return
+
+    state: dict = {"stage": "start"}
+    _arm_watchdog(state)
+
+    # the parent never touches the default backend: sweep configs are
+    # control/data-plane only and force cpu before any jax import
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    state["stage"] = "probe-1"
+    use_default, forensics = _decide_backend()
+    state["backend"] = "default" if use_default else "cpu-fallback"
+
+    if not os.environ.get("BENCH_SKIP_SWEEP"):
+        run_sweep(state)
+
+    results: list[dict] = []
+    state["stage"] = "decode"
+    if use_default:
+        budget = max(120.0, _remaining() - 60.0)
+        r = _spawn_decode(cpu=False, model=os.environ.get("BENCH_MODEL"),
+                          quant=None, timeout=budget,
+                          extra={"probe": forensics})
+        if r:
+            results.append(r)
+        # on a healthy accelerator, also record the 8b+int8 shape
+        # (VERDICT r2 #2) when the budget allows. NOTE: the local TPU
+        # plugin registers platform "axon", not "tpu" — gate on
+        # not-cpu, never the literal name
+        if (r and not r.get("error") and r.get("backend") not in (None, "cpu")
+                and not os.environ.get("BENCH_MODEL") and _remaining() > 300):
+            state["stage"] = "decode-8b-int8"
+            r8 = _spawn_decode(cpu=False, model="8b", quant="int8",
+                               timeout=_remaining() - 60.0)
+            if r8:
+                results.append(r8)
+    else:
+        r = _spawn_decode(cpu=True, model=os.environ.get("BENCH_MODEL"),
+                          quant=None, timeout=max(120.0, _remaining() - 120.0),
+                          extra={"fallback_reason": forensics.get("error"),
+                                 "probe": forensics})
+        if r:
+            results.append(r)
+        # second-chance probe late in the window: tunnels recover
+        if _remaining() > 240 and not os.environ.get("BENCH_FORCE_CPU"):
+            state["stage"] = "probe-2"
+            p2 = _probe_backend(timeout=min(300.0, _remaining() / 2))
+            if p2["ok"]:
+                state["stage"] = "decode-late"
+                r2 = _spawn_decode(cpu=False, model=os.environ.get("BENCH_MODEL"),
+                                   quant=None, timeout=_remaining() - 60.0,
+                                   extra={"probe": p2, "second_chance": True})
+                if r2:
+                    results.append(r2)
+            else:
+                # decisive forensics: the environment was down for the
+                # WHOLE window, not just the first probe
+                results[-1]["second_probe"] = p2
+
+    # headline LAST: prefer a real-accelerator line over the fallback
+    results.sort(key=lambda r: (r.get("backend") not in (None, "cpu"),
+                                r.get("value", 0.0)))
+    if not results:
+        _fail("no decode result produced", probe=forensics)
+    for r in results[:-1]:
+        _emit(r)
+    _emit(results[-1])
 
 
 if __name__ == "__main__":
