@@ -29,7 +29,7 @@ import jax.numpy as jnp
 _SKIP_NAMES = {"embed"}
 
 
-def _is_quantized(leaf: Any) -> bool:
+def is_quantized(leaf: Any) -> bool:
     # structural marker (jit-friendly: arrays only, no static leaves):
     # exactly {"q": int8, "scale": <original dtype>}
     return (
@@ -39,14 +39,25 @@ def _is_quantized(leaf: Any) -> bool:
     )
 
 
+_is_quantized = is_quantized
+
+
 def quantize_array(w: jax.Array) -> dict[str, Any]:
     """One matmul weight [in, out] -> int8 + per-out-column scale.
     The scale carries the original dtype so the dequantized view is a
-    drop-in for the source weight."""
+    drop-in for the source weight.
+
+    The scale is cast to the STORAGE dtype first and that rounded scale
+    is what divides ``w`` — quantize and dequantize then agree exactly,
+    instead of rounding with an f32 scale the stored bf16 scale can't
+    represent (~3 decimal digits)."""
     absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
-    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
-    return {"q": q.astype(jnp.int8), "scale": scale.astype(w.dtype)}
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0).astype(w.dtype)
+    # guard: a tiny absmax can underflow to 0 in bf16 — quantizing with
+    # it would divide by zero; scale 1 maps such columns to q=0 exactly
+    scale = jnp.where(scale > 0, scale, jnp.ones_like(scale))
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale.astype(jnp.float32)), -127, 127)
+    return {"q": q.astype(jnp.int8), "scale": scale}
 
 
 def dequantize_array(leaf: dict[str, Any]) -> jax.Array:

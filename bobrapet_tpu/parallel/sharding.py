@@ -114,17 +114,46 @@ def moe_param_specs(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
     }
 
 
+def _scale_spec(weight_spec: P) -> P:
+    """Per-output-channel quant scales [out] shard exactly like their
+    weight's LAST axis: a column-parallel weight P(fsdp, model) carries
+    scales P(model), so the post-matmul scale multiply is local — no
+    collective is introduced by quantization."""
+    parts = tuple(weight_spec)
+    return P(parts[-1]) if parts else P()
+
+
 def shard_params(
     params: dict[str, Any], mesh: Mesh, specs: Optional[dict[str, Any]] = None
 ) -> dict[str, Any]:
-    """device_put the param pytree with its NamedShardings."""
+    """device_put the param pytree with its NamedShardings.
+
+    Int8-quantized leaves ({"q", "scale"}, models/quant.py) compose with
+    tensor parallelism: the int8 ``q`` takes the bf16 weight's spec and
+    the scale shards on the weight's output axis — int8+TP halves
+    per-chip weight bytes *again* on top of the TP split (the 8B
+    multi-chip serving shape)."""
     if specs is None:
         specs = llama_param_specs(params, mesh)
+    from ..models.quant import is_quantized
+
+    def place(x: Any, spec: P) -> Any:
+        if is_quantized(x):
+            return {
+                "q": jax.device_put(x["q"], NamedSharding(mesh, spec)),
+                "scale": jax.device_put(
+                    x["scale"], NamedSharding(mesh, _scale_spec(spec))
+                ),
+            }
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
     return jax.tree_util.tree_map(
-        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        place,
         params,
         specs,
-        is_leaf=lambda x: isinstance(x, jax.Array) or hasattr(x, "shape"),
+        is_leaf=lambda x: is_quantized(x)
+        or isinstance(x, jax.Array)
+        or hasattr(x, "shape"),
     )
 
 

@@ -395,3 +395,80 @@ class TestInt8Quantization:
         toks = jax.jit(lambda qp, p: greedy_generate(
             qp, p, cfg=cfg, max_new_tokens=4, cache_capacity=16))(qp, prompt)
         assert toks.shape == (1, 4)
+
+    def test_quantize_dequantize_requantize_fixpoint(self):
+        """The stored scale is what divided the weight (ADVICE r2):
+        quantizing the dequantized view with the same scale reproduces
+        q exactly — no drift from an f32-vs-stored-dtype mismatch."""
+        from bobrapet_tpu.models import quant
+
+        w = (jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * 0.1
+             ).astype(jnp.bfloat16)
+        q1 = quant.quantize_array(w)
+        back = quant.dequantize_array(q1)
+        q2 = quant.quantize_array(back)
+        np.testing.assert_array_equal(np.asarray(q1["q"]), np.asarray(q2["q"]))
+        np.testing.assert_array_equal(
+            np.asarray(q1["scale"], dtype=np.float32),
+            np.asarray(q2["scale"], dtype=np.float32),
+        )
+
+    def test_int8_composes_with_tensor_parallel(self):
+        """VERDICT r2 #5: int8 x TP — the quantized tree shards over the
+        model axis (scales on the weight's output axis), and the sharded
+        quantized forward matches the single-device quantized forward."""
+        from bobrapet_tpu.models import quant
+        from bobrapet_tpu.parallel.sharding import llama_param_specs, shard_params
+
+        cfg = llama_tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        qp = quant.quantize_params(params)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    cfg.vocab_size)
+        ref = jax.jit(lambda qp, t: forward(qp, t, cfg)[0])(qp, tokens)
+
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("fsdp", "model"))
+        sharded = shard_params(qp, mesh)
+        # int8 payload carries the weight's spec; the scale shards on
+        # the OUTPUT axis (column-parallel wq -> scale on model)
+        wq = sharded["layers"][0]["attn"]["wq"]
+        assert wq["q"].dtype == jnp.int8
+        assert wq["q"].sharding.spec == llama_param_specs(params, mesh)[
+            "layers"][0]["attn"]["wq"]
+        assert tuple(wq["scale"].sharding.spec) == ("model",)
+        # row-parallel wo: scale on fsdp (the output axis)
+        wo = sharded["layers"][0]["attn"]["wo"]
+        assert tuple(wo["scale"].sharding.spec) == ("fsdp",)
+        # per-chip int8 bytes: |W|/(fsdp*model) — TP and int8 compose
+        local_q = wq["q"].addressable_shards[0].data
+        assert local_q.size == wq["q"].size // 8
+
+        with mesh:
+            out = jax.jit(lambda qp, t: forward(qp, t, cfg)[0])(sharded, tokens)
+        np.testing.assert_allclose(
+            np.asarray(out, dtype=np.float32),
+            np.asarray(ref, dtype=np.float32),
+            rtol=2e-3, atol=2e-3,
+        )
+
+    def test_int8_tp_greedy_generate(self):
+        """The 8B serving shape end-to-end: quantized + model-sharded
+        greedy decode produces identical tokens to unsharded decode."""
+        from bobrapet_tpu.models import quant
+        from bobrapet_tpu.parallel.sharding import shard_params
+
+        cfg = llama_tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        qp = quant.quantize_params(params)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                    cfg.vocab_size)
+        ref = jax.jit(lambda qp, p: greedy_generate(
+            qp, p, cfg=cfg, max_new_tokens=4, cache_capacity=16))(qp, prompt)
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("model",))
+        sharded = shard_params(qp, mesh)
+        with mesh:
+            toks = jax.jit(lambda qp, p: greedy_generate(
+                qp, p, cfg=cfg, max_new_tokens=4, cache_capacity=16))(
+                sharded, prompt)
+        np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
