@@ -99,23 +99,73 @@ def _cmd_manager(args: argparse.Namespace) -> int:
     server = _serve_http(state, args.metrics_bind_address, token)
 
     elector = None
+    heartbeat_stop = None
     if args.leader_elect:
-        from .utils.leader import FileLeaderElector
+        mode = args.leader_elect_mode
+        if mode == "auto":
+            # in-cluster: the reference's mechanism (API-server Lease);
+            # outside: flock on shared storage
+            mode = "kube" if os.environ.get("KUBERNETES_SERVICE_HOST") else "flock"
+        if mode == "kube":
+            from .cluster import KubeHttpClient
+            from .utils.leader import KubeLeaseElector
 
-        if not args.leader_lease_file and not args.persist_dir:
-            # a node-local default would let every node elect its own
-            # leader (split-brain) — demand a path on SHARED storage
-            _log.error(
-                "--leader-elect needs --leader-lease-file or --persist-dir "
-                "on storage shared by all replicas"
+            elector = KubeLeaseElector(
+                KubeHttpClient(), namespace=args.config_namespace,
+                lease_duration=args.lease_duration,
             )
-            return 2
-        lease = args.leader_lease_file or os.path.join(
-            args.persist_dir, "leader.lock"
-        )
-        elector = FileLeaderElector(lease)
-        _log.info("leader election on %s (serving /healthz while waiting)", lease)
+            _log.info(
+                "kube Lease election (%s/bobrapet-manager) as %s",
+                args.config_namespace, elector.identity,
+            )
+        else:
+            from .utils.leader import FileLeaderElector
+
+            if not args.leader_lease_file and not args.persist_dir:
+                # a node-local default would let every node elect its own
+                # leader (split-brain) — demand a path on SHARED storage
+                _log.error(
+                    "--leader-elect needs --leader-lease-file or --persist-dir "
+                    "on storage shared by all replicas"
+                )
+                return 2
+            lease = args.leader_lease_file or os.path.join(
+                args.persist_dir, "leader.lock"
+            )
+            elector = FileLeaderElector(lease)
+            _log.info("flock election on %s (serving /healthz while waiting)", lease)
         elector.acquire()
+        if hasattr(elector, "heartbeat"):
+            # TTL leases need renewal at well under lease_duration; a
+            # leader that loses the lease must stand down hard (the
+            # reference exits on lost leadership too)
+            heartbeat_stop = threading.Event()
+
+            def _renew_loop():
+                import time as _time
+
+                last_renewed = _time.monotonic()
+                while not heartbeat_stop.wait(max(1.0, args.lease_duration / 3)):
+                    try:
+                        if elector.heartbeat():
+                            last_renewed = _time.monotonic()
+                            continue
+                        # positively lost (another holder) — stand down NOW
+                        _log.error("lost leadership; exiting for restart")
+                        os._exit(3)
+                    except Exception:  # noqa: BLE001 - apiserver blip:
+                        # retry until the TTL would have lapsed anyway;
+                        # a silently-dead thread would leave this
+                        # replica leading unrenewed (worse)
+                        _log.exception("lease heartbeat failed; retrying")
+                    if _time.monotonic() - last_renewed > args.lease_duration:
+                        _log.error(
+                            "lease unrenewed past TTL; standing down hard"
+                        )
+                        os._exit(3)
+
+            threading.Thread(target=_renew_loop, daemon=True,
+                             name="lease-heartbeat").start()
 
     rt = Runtime(
         persist_dir=args.persist_dir,
@@ -146,6 +196,8 @@ def _cmd_manager(args: argparse.Namespace) -> int:
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
     _log.info("shutting down")
+    if heartbeat_stop is not None:
+        heartbeat_stop.set()
     if hub is not None:
         hub.stop()
     server.shutdown()
@@ -223,6 +275,11 @@ def main(argv: list[str] | None = None) -> int:
                           "(reference: cmd/main.go --leader-elect)")
     mgr.add_argument("--leader-lease-file", default=None,
                      help="lease path (default: <persist-dir>/leader.lock)")
+    mgr.add_argument("--leader-elect-mode", default="auto",
+                     choices=["auto", "kube", "flock"],
+                     help="auto = API-server Lease in-cluster, flock outside")
+    mgr.add_argument("--lease-duration", type=float, default=15.0,
+                     help="TTL for lease-based election (seconds)")
     mgr.set_defaults(fn=_cmd_manager)
 
     crds = sub.add_parser("export-crds", help="write CRD YAML for all kinds",

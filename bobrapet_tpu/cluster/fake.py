@@ -135,6 +135,15 @@ class FakeCluster:
             obj = self._objects.get((api_version, kind, namespace, name))
             if obj is None:
                 raise ClusterNotFound(f"{kind} {namespace}/{name} not found")
+            # optimistic concurrency: a patch carrying resourceVersion
+            # must match the live object (the API server's 409 contract
+            # the lease election CAS depends on)
+            expected = (patch.get("metadata") or {}).get("resourceVersion")
+            if expected is not None and str(expected) != obj["metadata"]["resourceVersion"]:
+                raise ClusterConflict(
+                    f"{kind} {namespace}/{name}: resourceVersion {expected} "
+                    f"is stale (live {obj['metadata']['resourceVersion']})"
+                )
             import json
 
             spec_before = json.dumps(obj.get("spec"), sort_keys=True, default=str)

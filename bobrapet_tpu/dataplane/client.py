@@ -166,6 +166,7 @@ class StreamConsumer:
         lane: str = "data",
         connect_timeout: float = 10.0,
         decode_json: bool = False,
+        from_seq: Optional[int] = None,
     ):
         self.stream = stream
         self.decode_json = decode_json
@@ -174,10 +175,15 @@ class StreamConsumer:
         self._sock = _connect(endpoint, connect_timeout)
         self._since_ack = 0
         self._last_seq = -1
-        send_frame(self._sock, {
+        hello: dict[str, Any] = {
             "t": "hello", "role": "consumer", "stream": stream,
             "lane": lane, "settings": settings,
-        })
+        }
+        if from_seq is not None:
+            # replay.mode=full: rejoin the stream at a seq in retained
+            # history (re-delivers already-acked entries)
+            hello["fromSeq"] = int(from_seq)
+        send_frame(self._sock, hello)
         fr = read_frame(self._sock)
         if fr is None or fr[0].get("t") != "ok":
             raise StreamProtocolError(f"handshake failed: {fr and fr[0]}")

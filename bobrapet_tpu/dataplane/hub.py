@@ -55,6 +55,7 @@ def _settings_knobs(settings: Optional[dict[str, Any]]) -> dict[str, Any]:
     credits_mode = fc.get("mode") == "credits"
     initial = ((fc.get("initialCredits") or {}).get("messages")) if credits_mode else None
     # (ack cadence is a CLIENT knob — StreamConsumer paces its own acks)
+    replay = delivery.get("replay") or {}
     return {
         "max_messages": buf.get("maxMessages") or 1024,
         "drop_policy": buf.get("dropPolicy") or "dropOldest",
@@ -63,6 +64,12 @@ def _settings_knobs(settings: Optional[dict[str, Any]]) -> dict[str, Any]:
         "pause_pct": ((fc.get("pauseThreshold") or {}).get("bufferPct")) or 100,
         "resume_pct": ((fc.get("resumeThreshold") or {}).get("bufferPct")) or 0,
         "at_least_once": delivery.get("semantics") == "atLeastOnce",
+        # replay.mode=full: every data frame is retained (bounded by
+        # retentionSeconds) and a consumer hello may carry ``fromSeq``
+        # to re-read history — the admission layer requires
+        # retentionSeconds so the bound is always explicit
+        "replay_full": replay.get("mode") == "full",
+        "replay_retention": float(replay.get("retentionSeconds") or 3600),
     }
 
 
@@ -81,6 +88,23 @@ class _Stream:
         self.paused = False  # credit-grant hysteresis state
         self.eos = False
         self.started = time.monotonic()
+        #: replay.mode=full history: (seq, header, payload, wall_ts) —
+        #: a SUPERSET of buffer (acked entries stay until retention).
+        #: Count-capped besides the time bound: retention alone would
+        #: let a fast producer grow history without limit (a maxlen
+        #: deque evicts oldest-first, preserving replay's tail)
+        self.retained: collections.deque = collections.deque(
+            maxlen=int(knobs.get("replay_max_entries") or 65536)
+        )
+
+    def retain(self, entry: tuple) -> None:
+        if not self.knobs["replay_full"]:
+            return
+        now = time.monotonic()
+        self.retained.append((*entry, now))
+        horizon = now - self.knobs["replay_retention"]
+        while self.retained and self.retained[0][3] < horizon:
+            self.retained.popleft()
 
     # -- occupancy / credits ----------------------------------------------
     def fill_pct(self) -> float:
@@ -103,10 +127,41 @@ class _Stream:
 
 
 class _ProducerConn:
+    """Control frames back to a producer (credits, errors) go through a
+    per-connection queue drained by one writer thread — callers holding
+    ``st.lock`` only enqueue, so a producer whose TCP send buffer is
+    full can never stall the stream lock for everyone else (the native
+    hub's per-connection write-queue pattern; ADVICE r2)."""
+
     def __init__(self, sock: socket.socket, stream: _Stream):
         self.sock = sock
         self.stream = stream
         self.outstanding = 0  # credits handed out, not yet consumed
+        self.queue: collections.deque = collections.deque()
+        self.cv = threading.Condition()
+        self.closed = False
+
+    def enqueue(self, header: dict[str, Any]) -> None:
+        with self.cv:
+            self.queue.append(header)
+            self.cv.notify()
+
+    def writer_loop(self) -> None:
+        while True:
+            with self.cv:
+                self.cv.wait_for(lambda: self.queue or self.closed)
+                if self.closed and not self.queue:
+                    return
+                header = self.queue.popleft()
+            try:
+                self.sock.sendall(encode_frame(header, b""))
+            except OSError:
+                return
+
+    def close(self) -> None:
+        with self.cv:
+            self.closed = True
+            self.cv.notify()
 
 
 class _ConsumerConn:
@@ -275,6 +330,8 @@ class StreamHub:
     # -- producer side -----------------------------------------------------
     def _serve_producer(self, sock: socket.socket, st: _Stream) -> None:
         conn = _ProducerConn(sock, st)
+        threading.Thread(target=conn.writer_loop, daemon=True,
+                         name="hub-producer-writer").start()
         # hub lock first (lock order: hub -> stream): clear the ended
         # tombstone and re-register the stream in case _maybe_gc
         # reclaimed it between _get_stream and here (redrive re-attach)
@@ -334,6 +391,7 @@ class StreamHub:
                     send_frame(sock, {"t": "err", "message": f"unexpected {t!r}"})
                     return
         finally:
+            conn.close()
             with st.lock:
                 if conn in st.producer_conns:
                     st.producer_conns.remove(conn)
@@ -346,7 +404,7 @@ class StreamHub:
                 if conn.outstanding <= 0:
                     # protocol violation: sending without credit
                     metrics.stream_dropped.inc("no-credit")
-                    send_frame(conn.sock, {"t": "err", "message": "no credit"})
+                    conn.enqueue({"t": "err", "message": "no credit"})
                     return
                 conn.outstanding -= 1
             full = len(st.buffer) >= st.knobs["max_messages"]
@@ -368,6 +426,7 @@ class StreamHub:
             st.next_seq += 1
             entry = (seq, {"t": "data", "seq": seq, "key": header.get("key")}, payload)
             st.buffer.append(entry)
+            st.retain(entry)
             # enqueue under the lock: entries reach each consumer's
             # ordered queue in seq order, interleaved atomically with
             # the attach-replay path
@@ -402,10 +461,7 @@ class StreamHub:
         )
         if grant > 0:
             conn.outstanding += grant
-            try:
-                send_frame(conn.sock, {"t": "credit", "n": grant})
-            except OSError:
-                pass
+            conn.enqueue({"t": "credit", "n": grant})
 
     # -- consumer side -----------------------------------------------------
     def _serve_consumer(self, sock: socket.socket, st: _Stream, hello: dict[str, Any]) -> None:
@@ -415,10 +471,21 @@ class StreamHub:
         # attach atomically: backlog replay (unacked under atLeastOnce,
         # undelivered otherwise) enters the consumer's ordered queue
         # before any live entry can, so delivery order == seq order
+        from_seq = hello.get("fromSeq")
         with st.lock:
-            for seq, header, payload in list(st.buffer):
-                conn.enqueue(header, payload)
-                conn.delivered = max(conn.delivered, seq)
+            if from_seq is not None and st.knobs["replay_full"]:
+                # replay attach: history from fromSeq rides the ordered
+                # queue first; ``retained`` is a superset of the unacked
+                # buffer, so the regular backlog replay is skipped — no
+                # double delivery
+                for seq, header, payload, _ts in list(st.retained):
+                    if seq >= int(from_seq):
+                        conn.enqueue(header, payload)
+                        conn.delivered = max(conn.delivered, seq)
+            else:
+                for seq, header, payload in list(st.buffer):
+                    conn.enqueue(header, payload)
+                    conn.delivered = max(conn.delivered, seq)
             st.consumers.append(conn)
             eos = st.eos
             if not st.knobs["at_least_once"]:

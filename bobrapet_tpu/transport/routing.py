@@ -15,6 +15,9 @@ from ..api.transport import TransportStreamingSettings
 from .topology import StreamTopology
 
 HUB_SERVICE = "bobravoz-hub"
+#: the hub Service lives in the operator's namespace (deploy/hub.yaml),
+#: NOT per run namespace — hub targets must resolve there (ADVICE r2)
+HUB_NAMESPACE = "bobrapet-system"
 DEFAULT_HUB_PORT = 50052
 
 
@@ -22,7 +25,7 @@ def service_endpoint(service_name: str, namespace: str, port: int) -> str:
     return f"{service_name}.{namespace}.svc:{port}"
 
 
-def hub_endpoint(namespace: str, port: int = DEFAULT_HUB_PORT) -> str:
+def hub_endpoint(namespace: str = HUB_NAMESPACE, port: int = DEFAULT_HUB_PORT) -> str:
     return service_endpoint(HUB_SERVICE, namespace, port)
 
 
@@ -38,6 +41,7 @@ def compute_downstream_targets(
     endpoint_for: Callable[[str], Optional[tuple[str, int]]],
     settings: Optional[TransportStreamingSettings] = None,
     tls: bool = False,
+    hub_namespace: str = HUB_NAMESPACE,
 ) -> list[dict[str, Any]]:
     """Downstream targets for one streaming step's StepRun spec.
 
@@ -61,7 +65,10 @@ def compute_downstream_targets(
         if max_downstreams is not None and len(deps) > max_downstreams:
             deps = deps[:max_downstreams]
         target: dict[str, Any] = {
-            "host": f"{HUB_SERVICE}.{namespace}.svc",
+            # the hub's OWN namespace: runs in other namespaces would
+            # otherwise resolve a Service that only exists in
+            # bobrapet-system (ADVICE r2, routing.py finding)
+            "host": f"{HUB_SERVICE}.{hub_namespace}.svc",
             "port": DEFAULT_HUB_PORT,
             # streams are consumer-named (ns/run/<consumerStep>); the
             # producer publishes one hub stream per downstream step

@@ -111,6 +111,13 @@ def validate_streaming_settings(st, errs: FieldErrors, path: str) -> None:
             if r.mode == "fromCheckpoint" and not r.checkpoint_interval:
                 errs.add(f"{path}.delivery.replay.checkpointInterval",
                          "required for replay.mode=fromCheckpoint")
+            if r.mode == "fromCheckpoint":
+                # only mode=full is enforced (hub retained history +
+                # fromSeq rejoin); checkpointed replay has no enforcer
+                errs.add(f"{path}.delivery.replay.mode",
+                         "fromCheckpoint replay is not enforced by the "
+                         "data plane; use mode=full with "
+                         "retentionSeconds")
             if r.mode == "full" and not r.retention_seconds:
                 errs.add(f"{path}.delivery.replay.retentionSeconds",
                          "required for replay.mode=full")
@@ -138,6 +145,16 @@ def validate_streaming_settings(st, errs: FieldErrors, path: str) -> None:
         if p.mode == "roundRobin" and p.sticky:
             errs.add(f"{path}.partitioning.sticky",
                      "sticky assignment contradicts roundRobin")
+        if p.mode in ("keyHash", "roundRobin") or (
+            p.partitions is not None and p.partitions > 1
+        ):
+            # reject-what-you-don't-enforce (round-1 rule): the data
+            # plane delivers one ordered stream per edge — admitting a
+            # partitioned config would silently not partition
+            errs.add(f"{path}.partitioning",
+                     "partitioned delivery is not enforced by the data "
+                     "plane (single ordered stream per edge); remove it "
+                     "or set mode=none")
     ro = st.routing
     if ro is not None:
         if ro.mode not in (None, *_VALID_ROUTING_MODES):
@@ -199,6 +216,19 @@ def validate_streaming_settings(st, errs: FieldErrors, path: str) -> None:
         ):
             errs.add(f"{path}.recording",
                      "recording knobs only meaningful with mode != none")
+        if rec.mode in ("sample", "full"):
+            # reject-what-you-don't-enforce: no recorder exists in the
+            # data plane — an admitted recording config would record
+            # nothing and read as compliance
+            errs.add(f"{path}.recording.mode",
+                     "stream recording is not enforced by the data "
+                     "plane; remove it or set mode=none")
+    ob = st.observability
+    if ob is not None and ob.watermark is not None and ob.watermark.enabled:
+        # reject-what-you-don't-enforce: no watermark propagation exists
+        errs.add(f"{path}.observability.watermark.enabled",
+                 "event-time watermarks are not enforced by the data "
+                 "plane; remove the watermark block")
     for i, lane in enumerate(st.lanes):
         for field in ("max_messages", "max_bytes"):
             v = getattr(lane, field)

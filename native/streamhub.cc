@@ -20,6 +20,7 @@
 
 #include <arpa/inet.h>
 #include <cerrno>
+#include <chrono>
 #include <cstdint>
 #include <cstring>
 #include <deque>
@@ -263,6 +264,8 @@ struct Knobs {
   long pause_pct = 100;
   long resume_pct = 0;
   bool at_least_once = false;
+  bool replay_full = false;       // delivery.replay.mode == "full"
+  double replay_retention = 3600; // delivery.replay.retentionSeconds
 };
 
 Knobs knobs_from(const JValue& settings) {
@@ -293,6 +296,11 @@ Knobs knobs_from(const JValue& settings) {
   }
   if (const JValue* d = settings.get("delivery")) {
     k.at_least_once = d->get_str("semantics") == "atLeastOnce";
+    if (const JValue* r = d->get("replay")) {
+      k.replay_full = r->get_str("mode") == "full";
+      long ret = r->get_int("retentionSeconds", 0);
+      if (ret > 0) k.replay_retention = static_cast<double>(ret);
+    }
   }
   return k;
 }
@@ -301,14 +309,22 @@ struct Entry {
   long seq;
   std::string header;
   std::string payload;
+  double ts = 0;  // retention clock (replay history only)
 };
 
 struct Conn;
+
+double mono_seconds() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 struct Stream {
   std::string name;
   Knobs knobs;
   std::deque<Entry> buffer;
+  std::deque<Entry> retained;  // replay.mode=full history (superset of buffer)
   long next_seq = 0;
   long acked = -1;
   long dropped = 0;  // by buffer drop policy
@@ -316,6 +332,20 @@ struct Stream {
   bool paused = false;
   std::set<Conn*> producers;
   std::set<Conn*> consumers;
+
+  void retain(const Entry& e) {
+    if (!knobs.replay_full) return;
+    Entry copy = e;
+    copy.ts = mono_seconds();
+    retained.push_back(std::move(copy));
+    double horizon = mono_seconds() - knobs.replay_retention;
+    while (!retained.empty() && retained.front().ts < horizon)
+      retained.pop_front();
+    // count cap besides the time bound: retention alone would let a
+    // fast producer grow history without limit (matches the Python
+    // hub's 65536-entry deque maxlen; oldest evicted first)
+    while (retained.size() > 65536) retained.pop_front();
+  }
 
   double fill_pct() const {
     return 100.0 * buffer.size() / (knobs.max_messages > 0 ? knobs.max_messages : 1);
@@ -455,8 +485,16 @@ struct Hub {
       send(c, "{\"t\":\"ok\",\"credits\":" + std::to_string(grant) + "}");
     } else if (role == "consumer") {
       send(c, "{\"t\":\"ok\",\"credits\":-1}");
-      // ordered replay straight into the write queue, then live entries
-      for (const Entry& e : st->buffer) send(c, e.header, e.payload);
+      long from_seq = h.get_int("fromSeq", -1);
+      if (from_seq >= 0 && st->knobs.replay_full) {
+        // replay attach: retained history from fromSeq (a superset of
+        // the unacked buffer — the regular backlog replay is skipped)
+        for (const Entry& e : st->retained)
+          if (e.seq >= from_seq) send(c, e.header, e.payload);
+      } else {
+        // ordered replay straight into the write queue, then live entries
+        for (const Entry& e : st->buffer) send(c, e.header, e.payload);
+      }
       st->consumers.insert(c);
       if (!st->knobs.at_least_once) st->buffer.clear();
       for (Conn* p : st->producers) replenish(st, p);
@@ -495,6 +533,7 @@ struct Hub {
                             : ",\"key\":\"" + jescape(key) + "\"}");
     e.payload = payload;
     st->buffer.push_back(e);
+    st->retain(st->buffer.back());
     deliver(st, st->buffer.back());
     if (!st->consumers.empty() && !st->knobs.at_least_once) st->buffer.pop_back();
     replenish(st, c);

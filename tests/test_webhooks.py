@@ -487,7 +487,27 @@ class TestTransportWebhooks:
         denied(lambda: rt.apply(make_transport(
             "t", "p", streaming={"recording": {"mode": "sample"}})),
             "sampleRate")
-        # a coherent credit + ack + replay config is admitted
+        # unenforced families are rejected outright (VERDICT r2 #7:
+        # reject-what-you-don't-enforce)
+        denied(lambda: rt.apply(make_transport(
+            "t", "p", streaming={
+                "partitioning": {"mode": "keyHash", "key": "{{ packet.id }}"}})),
+            "not enforced")
+        denied(lambda: rt.apply(make_transport(
+            "t", "p", streaming={
+                "recording": {"mode": "sample", "sampleRate": 10}})),
+            "not enforced")
+        denied(lambda: rt.apply(make_transport(
+            "t", "p", streaming={
+                "observability": {"watermark": {"enabled": True}}})),
+            "not enforced")
+        denied(lambda: rt.apply(make_transport(
+            "t", "p", streaming={"delivery": {
+                "replay": {"mode": "fromCheckpoint",
+                           "checkpointInterval": "30s"}}})),
+            "not enforced")
+        # a coherent credit + ack + replay config is admitted — with the
+        # ENFORCED replay mode (hub retained history + fromSeq rejoin)
         rt.apply(make_transport("t-ok", "p", streaming={
             "backpressure": {"buffer": {"maxMessages": 64,
                                         "dropPolicy": "dropOldest"}},
@@ -497,8 +517,8 @@ class TestTransportWebhooks:
                             "pauseThreshold": {"bufferPct": 80},
                             "resumeThreshold": {"bufferPct": 40}},
             "delivery": {"semantics": "atLeastOnce", "ordering": "perKey",
-                         "replay": {"mode": "fromCheckpoint",
-                                    "checkpointInterval": "30s"}},
+                         "replay": {"mode": "full",
+                                    "retentionSeconds": 3600}},
         }))
 
 
