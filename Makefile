@@ -2,7 +2,7 @@
 
 NATIVE_SO  := native/libblobcache.so native/libstreamhub.so
 
-.PHONY: all native test bench clean crds image
+.PHONY: all native test test-e2e bench clean crds chart image
 
 all: native
 
@@ -27,5 +27,26 @@ clean:
 crds:
 	python -m bobrapet_tpu export-crds --out deploy/crds
 
+chart:
+	python -m bobrapet_tpu export-chart
+
 image:
 	docker build -f deploy/Dockerfile -t bobrapet-tpu/manager:dev .
+
+# Deployed-image e2e (reference: Kind-based test-e2e, Makefile:79-97).
+# Gated on a container runtime: without docker it degrades to the
+# no-container smoke (CLI --help, CRD export, chart render) so bit-rot
+# in the packaging surface is still caught.
+test-e2e:
+	@if command -v docker >/dev/null 2>&1; then \
+		docker build -q -f deploy/Dockerfile -t bobrapet-tpu/manager:e2e . && \
+		docker run --rm bobrapet-tpu/manager:e2e --help >/dev/null && \
+		docker run --rm bobrapet-tpu/manager:e2e export-crds --out /tmp/crds && \
+		echo "docker e2e smoke: OK"; \
+	else \
+		echo "docker not found; running no-container packaging smoke"; \
+		python -m bobrapet_tpu --help >/dev/null && \
+		python -m bobrapet_tpu export-crds --out /tmp/bobrapet-crds-smoke >/dev/null && \
+		python -m bobrapet_tpu export-chart >/dev/null && \
+		echo "packaging smoke: OK"; \
+	fi

@@ -236,8 +236,36 @@ def _cmd_export_manifests(args: argparse.Namespace) -> int:
 def _cmd_hub(args: argparse.Namespace) -> int:
     from .dataplane.__main__ import main as hub_main
 
+    if args.bind_address:
+        host, _, port = args.bind_address.rpartition(":")
+        args.host, args.port = host or "0.0.0.0", int(port)
     sys.argv = ["bobrapet-hub", "--host", args.host, "--port", str(args.port)]
+    if args.tls_dir:
+        sys.argv += ["--tls-dir", args.tls_dir]
     hub_main()
+    return 0
+
+
+def _cmd_export_chart(args: argparse.Namespace) -> int:
+    """Render the Helm chart without helm (gke/chart.py subset)."""
+    from .gke.chart import render_chart
+
+    chart_dir = args.chart or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "deploy", "chart", "bobrapet-tpu",
+    )
+    rendered = render_chart(
+        chart_dir, release_name=args.release, namespace=args.namespace
+    )
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+        for fname, text in rendered.items():
+            path = os.path.join(args.out, fname)
+            with open(path, "w") as f:
+                f.write(text)
+            print(path)
+    else:
+        print("---\n".join(rendered.values()))
     return 0
 
 
@@ -299,7 +327,21 @@ def main(argv: list[str] | None = None) -> int:
                          parents=[common])
     hub.add_argument("--host", default="0.0.0.0")
     hub.add_argument("--port", type=int, default=7447)
+    hub.add_argument("--bind-address", default=None,
+                     help="host:port shorthand (container-args pattern)")
+    hub.add_argument("--tls-dir", default=None,
+                     help="shared-CA mTLS dir (forces the Python engine)")
     hub.set_defaults(fn=_cmd_hub)
+
+    chart = sub.add_parser(
+        "export-chart", parents=[common],
+        help="render the Helm chart without helm (deploy/chart)",
+    )
+    chart.add_argument("--chart", default=None, help="chart directory")
+    chart.add_argument("--release", default="bobrapet")
+    chart.add_argument("--namespace", default="bobrapet-system")
+    chart.add_argument("--out", default=None, help="write one file per template")
+    chart.set_defaults(fn=_cmd_export_chart)
 
     # implicit default subcommand: flag-only invocations (the k8s
     # container-args pattern) run the manager — argparse would otherwise
@@ -307,7 +349,8 @@ def main(argv: list[str] | None = None) -> int:
     # applied when NO subcommand appears anywhere, so
     # `--log-level DEBUG export-crds` still reaches export-crds.
     raw = list(argv) if argv is not None else sys.argv[1:]
-    commands = {"manager", "export-crds", "export-manifests", "hub"}
+    commands = {"manager", "export-crds", "export-manifests", "hub",
+                "export-chart"}
     if (
         not any(a in commands for a in raw)
         and "-h" not in raw

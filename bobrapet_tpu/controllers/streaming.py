@@ -393,6 +393,18 @@ def _ensure_deployment(ctrl, sr, spec, engram_spec, template_spec, ctx,
             "generation": generation,
         }, separators=(",", ":"), sort_keys=True)
 
+    # EngramTLSSpec -> data-plane mTLS: advertise the shared-CA mount
+    # to the SDK and carry the secret name for the GKE materializer
+    # (reference: engram_types.go:91-107 + pkg/transport/security.go:11)
+    tls_secret = None
+    if (engram_spec.transport is not None
+            and engram_spec.transport.tls is not None
+            and engram_spec.transport.tls.enabled):
+        from ..dataplane.tls import DEFAULT_TLS_MOUNT
+
+        env[contract.ENV_TLS_DIR] = DEFAULT_TLS_MOUNT
+        tls_secret = engram_spec.transport.tls.secret_name or f"{name}-tls"
+
     desired_spec = {
         "image": template_spec.image or "",
         "entrypoint": template_spec.entrypoint or "",
@@ -402,6 +414,8 @@ def _ensure_deployment(ctrl, sr, spec, engram_spec, template_spec, ctx,
         "connectorGeneration": generation,
         "serviceName": svc_name,
     }
+    if tls_secret:
+        desired_spec["tlsSecret"] = tls_secret
     dep_name = f"{name}-rt"
     existing = ctrl.store.try_get(DEPLOYMENT_KIND, ns, dep_name)
     if existing is None:

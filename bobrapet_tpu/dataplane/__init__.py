@@ -12,6 +12,7 @@ out-of-repo `bobravoz-grpc` deployable).
 from .client import StreamClosed, StreamConsumer, StreamProducer, StreamProtocolError
 from .frames import FrameError, encode_frame, read_frame, send_frame
 from .hub import StreamHub
+from .tls import TLSPaths, make_hub
 
 __all__ = [
     "FrameError",
@@ -20,7 +21,9 @@ __all__ = [
     "StreamHub",
     "StreamProducer",
     "StreamProtocolError",
+    "TLSPaths",
     "encode_frame",
+    "make_hub",
     "read_frame",
     "send_frame",
 ]

@@ -23,13 +23,19 @@ def main() -> None:
         help="native = C++ poll loop (native/streamhub.cc); auto prefers "
              "native and falls back to the Python broker",
     )
+    parser.add_argument(
+        "--tls-dir", default=None,
+        help="shared-CA mTLS material (ca.crt/tls.crt/tls.key); forces "
+             "the Python engine",
+    )
     args = parser.parse_args()
     logging.basicConfig(level=args.log_level)
 
     from .native import make_hub
 
     native = {"auto": None, "native": True, "python": False}[args.engine]
-    hub = make_hub(host=args.host, port=args.port, native=native)
+    hub = make_hub(host=args.host, port=args.port, native=native,
+                   tls=args.tls_dir)
     port = hub.start()
     logging.getLogger(__name__).info(
         "stream hub (%s) listening on %s:%s",

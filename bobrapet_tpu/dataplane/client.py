@@ -32,10 +32,18 @@ class StreamProtocolError(Exception):
     """The peer rejected our traffic (e.g. sending without credit)."""
 
 
-def _connect(endpoint: str, timeout: float) -> socket.socket:
+def _connect(endpoint: str, timeout: float, tls=None) -> socket.socket:
     host, _, port = endpoint.rpartition(":")
     sock = socket.create_connection((host or "127.0.0.1", int(port)), timeout=timeout)
     sock.settimeout(timeout)
+    if tls is not None:
+        # shared-CA mutual TLS (dataplane/tls.py): the server must
+        # present a CA-chained cert; we present ours
+        from .tls import client_context
+
+        sock = client_context(tls).wrap_socket(
+            sock, server_hostname=host or "127.0.0.1"
+        )
     return sock
 
 
@@ -49,9 +57,10 @@ class StreamProducer:
         settings: Optional[dict[str, Any]] = None,
         lane: str = "data",
         connect_timeout: float = 10.0,
+        tls=None,
     ):
         self.stream = stream
-        self._sock = _connect(endpoint, connect_timeout)
+        self._sock = _connect(endpoint, connect_timeout, tls=tls)
         self._credits = 0
         self._unlimited = False
         self._credit_cv = threading.Condition()
@@ -167,12 +176,13 @@ class StreamConsumer:
         connect_timeout: float = 10.0,
         decode_json: bool = False,
         from_seq: Optional[int] = None,
+        tls=None,
     ):
         self.stream = stream
         self.decode_json = decode_json
         fc = (settings or {}).get("flowControl") or {}
         self._ack_every = int(((fc.get("ackEvery") or {}).get("messages")) or 1)
-        self._sock = _connect(endpoint, connect_timeout)
+        self._sock = _connect(endpoint, connect_timeout, tls=tls)
         self._since_ack = 0
         self._last_seq = -1
         hello: dict[str, Any] = {

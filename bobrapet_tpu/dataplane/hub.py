@@ -33,6 +33,7 @@ from __future__ import annotations
 import collections
 import logging
 import socket
+import ssl
 import threading
 import time
 from typing import Any, Optional
@@ -43,6 +44,11 @@ from .frames import FrameError, encode_frame, read_frame, send_frame
 _log = logging.getLogger(__name__)
 
 UNLIMITED = -1
+
+#: hard cap on replay.mode=full history per stream (mirrored by the
+#: native hub); no settings field configures it — an unbounded knob
+#: would hand producers an OOM lever
+REPLAY_MAX_ENTRIES = 65536
 
 
 def _settings_knobs(settings: Optional[dict[str, Any]]) -> dict[str, Any]:
@@ -88,13 +94,14 @@ class _Stream:
         self.paused = False  # credit-grant hysteresis state
         self.eos = False
         self.started = time.monotonic()
-        #: replay.mode=full history: (seq, header, payload, wall_ts) —
-        #: a SUPERSET of buffer (acked entries stay until retention).
-        #: Count-capped besides the time bound: retention alone would
-        #: let a fast producer grow history without limit (a maxlen
-        #: deque evicts oldest-first, preserving replay's tail)
+        #: replay.mode=full history: (seq, header, payload, wall_ts).
+        #: Bounded by retentionSeconds AND a hard entry cap (a maxlen
+        #: deque evicts oldest-first): retention alone would let a fast
+        #: producer grow history without limit. NOT guaranteed to be a
+        #: superset of the unacked buffer — eviction ignores ack state,
+        #: so the replay attach path unions retained with buffer.
         self.retained: collections.deque = collections.deque(
-            maxlen=int(knobs.get("replay_max_entries") or 65536)
+            maxlen=REPLAY_MAX_ENTRIES
         )
 
     def retain(self, entry: tuple) -> None:
@@ -210,7 +217,7 @@ class StreamHub:
     #: run-scoped, so collisions with future runs don't occur)
     _ENDED_MAX = 4096
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, tls=None):
         self.host = host
         self.port = port
         self._server: Optional[socket.socket] = None
@@ -219,6 +226,13 @@ class StreamHub:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # shared-CA mutual TLS (dataplane/tls.py): wrap-on-accept; a
+        # peer without a CA-chained cert never reaches the protocol
+        self._tls_ctx = None
+        if tls is not None:
+            from .tls import server_context
+
+            self._tls_ctx = server_context(tls)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> int:
@@ -300,6 +314,18 @@ class StreamHub:
             return st
 
     def _serve_conn(self, sock: socket.socket) -> None:
+        if self._tls_ctx is not None:
+            # handshake on the per-connection thread (a slow or
+            # malicious peer must not stall the accept loop)
+            try:
+                sock = self._tls_ctx.wrap_socket(sock, server_side=True)
+            except (OSError, ssl.SSLError) as e:
+                _log.debug("hub TLS handshake failed: %s", e)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
         try:
             first = read_frame(sock)
             if first is None:
@@ -358,7 +384,12 @@ class StreamHub:
                     conn.outstanding = grant
                 else:
                     grant = UNLIMITED
-        send_frame(sock, {"t": "ok", "credits": grant})
+                # the handshake 'ok' rides the SAME writer queue as
+                # credit frames, enqueued under st.lock before any
+                # concurrent replenish can queue a credit — direct
+                # socket writes here could reorder past the writer
+                # thread and fail the client handshake
+                conn.enqueue({"t": "ok", "credits": grant})
         try:
             while True:
                 fr = read_frame(sock)
@@ -388,7 +419,7 @@ class StreamHub:
                     self._maybe_gc(st)
                     return
                 else:
-                    send_frame(sock, {"t": "err", "message": f"unexpected {t!r}"})
+                    conn.enqueue({"t": "err", "message": f"unexpected {t!r}"})
                     return
         finally:
             conn.close()
@@ -474,14 +505,22 @@ class StreamHub:
         from_seq = hello.get("fromSeq")
         with st.lock:
             if from_seq is not None and st.knobs["replay_full"]:
-                # replay attach: history from fromSeq rides the ordered
-                # queue first; ``retained`` is a superset of the unacked
-                # buffer, so the regular backlog replay is skipped — no
-                # double delivery
-                for seq, header, payload, _ts in list(st.retained):
+                # replay attach: UNION of retained history and the
+                # unacked buffer from fromSeq, in seq order — retention
+                # eviction ignores ack state, so an unacked entry may
+                # live only in the buffer; dropping it here would break
+                # at-least-once through the replay feature itself
+                merged: dict[int, tuple] = {}
+                for seq, header, payload, _ts in st.retained:
                     if seq >= int(from_seq):
-                        conn.enqueue(header, payload)
-                        conn.delivered = max(conn.delivered, seq)
+                        merged[seq] = (header, payload)
+                for seq, header, payload in st.buffer:
+                    if seq >= int(from_seq):
+                        merged.setdefault(seq, (header, payload))
+                for seq in sorted(merged):
+                    header, payload = merged[seq]
+                    conn.enqueue(header, payload)
+                    conn.delivered = max(conn.delivered, seq)
             else:
                 for seq, header, payload in list(st.buffer):
                     conn.enqueue(header, payload)

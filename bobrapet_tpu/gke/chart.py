@@ -1,0 +1,139 @@
+"""Helm-chart renderer for the subset of template syntax the in-tree
+chart uses — a no-helm fallback and the render-check the packaging
+tests run (reference chart parity: hack/charts/bobrapet).
+
+Supported directives (all the chart needs; anything else is an error so
+the chart can't silently drift past what this renderer understands):
+
+- ``{{ .Values.a.b }}`` / ``{{ .Release.Name }}`` /
+  ``{{ .Release.Namespace }}`` / ``{{ .Chart.Name }}`` /
+  ``{{ .Chart.AppVersion }}`` — value substitution
+- ``{{- if .Values.flag }} ... {{- end }}`` — nestable conditionals on
+  truthiness
+- ``"{{ .Values.image.repository }}:{{ .Values.image.tag }}"`` — inline
+  (multi-token) substitution
+
+Rendering with real helm produces identical output for this subset;
+the chart remains a normal helm chart.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+_DIRECTIVE = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+
+
+class ChartError(Exception):
+    pass
+
+
+def _resolve(path: str, scope: dict[str, Any]) -> Any:
+    if not path.startswith("."):
+        raise ChartError(f"unsupported expression: {path!r}")
+    node: Any = scope
+    for part in path[1:].split("."):
+        if not isinstance(node, dict) or part not in node:
+            raise ChartError(f"value {path} not found (missing {part!r})")
+        node = node[part]
+    return node
+
+
+def _render_text(text: str, scope: dict[str, Any]) -> str:
+    out_lines: list[str] = []
+    # stack of booleans: is the current conditional branch active?
+    stack: list[bool] = []
+
+    for line in text.splitlines():
+        directives = _DIRECTIVE.findall(line)
+        control = [d for d in directives if d.startswith(("if ", "end"))]
+        if control:
+            stripped = _DIRECTIVE.sub("", line).strip()
+            if stripped:
+                raise ChartError(
+                    f"control directive must be alone on its line: {line!r}"
+                )
+            for d in control:
+                if d.startswith("if "):
+                    cond = bool(_resolve(d[3:].strip(), scope)) and all(stack)
+                    stack.append(cond)
+                else:  # end
+                    if not stack:
+                        raise ChartError("unbalanced {{ end }}")
+                    stack.pop()
+            continue
+        if not all(stack):
+            continue
+
+        def sub(m: re.Match) -> str:
+            return str(_resolve(m.group(1), scope))
+
+        out_lines.append(_DIRECTIVE.sub(sub, line))
+    if stack:
+        raise ChartError("unterminated {{ if }}")
+    return "\n".join(out_lines) + "\n"
+
+
+def _load_values(chart_dir: str) -> dict[str, Any]:
+    import yaml
+
+    with open(os.path.join(chart_dir, "values.yaml")) as f:
+        return yaml.safe_load(f) or {}
+
+
+def render_chart(
+    chart_dir: str,
+    release_name: str = "bobrapet",
+    namespace: str = "bobrapet-system",
+    values: Optional[dict[str, Any]] = None,
+) -> dict[str, str]:
+    """Render every template; returns {template_filename: rendered_yaml}.
+    ``values`` overlays values.yaml (deep merge)."""
+    import yaml
+
+    base = _load_values(chart_dir)
+    if values:
+        def merge(dst: dict, src: dict) -> None:
+            for k, v in src.items():
+                if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                    merge(dst[k], v)
+                else:
+                    dst[k] = v
+        merge(base, values)
+    with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+        chart_meta = yaml.safe_load(f)
+    scope = {
+        "Values": base,
+        "Release": {"Name": release_name, "Namespace": namespace},
+        "Chart": {"Name": chart_meta.get("name", ""),
+                  "AppVersion": chart_meta.get("appVersion", "")},
+    }
+    out: dict[str, str] = {}
+    tdir = os.path.join(chart_dir, "templates")
+    for fname in sorted(os.listdir(tdir)):
+        if not fname.endswith((".yaml", ".yml")):
+            continue
+        with open(os.path.join(tdir, fname)) as f:
+            rendered = _render_text(f.read(), scope)
+        if rendered.strip():
+            out[fname] = rendered
+    return out
+
+
+def render_chart_manifests(
+    chart_dir: str,
+    release_name: str = "bobrapet",
+    namespace: str = "bobrapet-system",
+    values: Optional[dict[str, Any]] = None,
+) -> list[dict[str, Any]]:
+    """Rendered chart as parsed manifest dicts (multi-doc aware)."""
+    import yaml
+
+    manifests: list[dict[str, Any]] = []
+    for rendered in render_chart(chart_dir, release_name, namespace, values).values():
+        for doc in yaml.safe_load_all(rendered):
+            if doc:
+                manifests.append(doc)
+    return manifests

@@ -285,6 +285,7 @@ def materialize_deployment(
     readiness_path: Optional[str] = None,
     service_account: Optional[str] = None,
     secrets: Optional[dict[str, str]] = None,
+    tls_secret: Optional[str] = None,
     kind: str = "Deployment",
 ) -> list[dict[str, Any]]:
     """One long-running workload → [Service, Deployment|StatefulSet]
@@ -312,6 +313,13 @@ def materialize_deployment(
     env_list = env_from_dict(full_env)
     volumes, mounts, secret_env = _secret_artifacts(secrets or {})
     env_list.extend(secret_env)
+    if tls_secret:
+        # shared-CA mTLS material (cert-manager secret layout:
+        # ca.crt/tls.crt/tls.key) at the contract mount the SDK reads
+        # via BOBRA_TLS_DIR (dataplane/tls.py)
+        volumes.append({"name": "tls", "secret": {"secretName": tls_secret}})
+        mounts.append({"name": "tls", "mountPath": "/var/run/bobrapet/tls",
+                       "readOnly": True})
     svc_name = service_name or f"{name}-svc"
     pod = build_pod_template(
         PodConfig(
@@ -410,6 +418,7 @@ class GKEMaterializer:
             entrypoint=spec.get("entrypoint") or "",
             service_account=spec.get("serviceAccountName"),
             secrets=dict(spec.get("secrets") or {}),
+            tls_secret=spec.get("tlsSecret"),
             kind=kind,
         )
 

@@ -336,9 +336,14 @@ class EngramContext:
         ``ns/run/<consumerStep>`` — a hub target fans out to every step
         in its ``stepNames``; a P2P target names exactly one."""
         from ..dataplane.client import StreamProducer
+        from ..dataplane.tls import TLSPaths
 
         if settings is None:
             settings = self.negotiated_stream_settings
+        # EngramTLSSpec contract: the controller advertises the mounted
+        # shared-CA material via BOBRA_TLS_DIR; every streaming edge
+        # this SDK opens then speaks mTLS (plaintext otherwise)
+        tls = TLSPaths.from_env(self.env)
         producers = []
         for target in self.downstream_targets:
             if target.get("terminate"):
@@ -354,7 +359,7 @@ class EngramContext:
                 stream = f"{self.namespace}/{self.story_run}/{dest}"
                 producers.append(StreamProducer(
                     f"{host}:{port}", stream, settings=settings,
-                    connect_timeout=connect_timeout,
+                    connect_timeout=connect_timeout, tls=tls,
                 ))
         return producers
 
@@ -366,13 +371,15 @@ class EngramContext:
         iterate to receive (acks ride the negotiated cadence; settings
         default to the binding's merged settings)."""
         from ..dataplane.client import StreamConsumer
+        from ..dataplane.tls import TLSPaths
 
         if settings is None:
             settings = self.negotiated_stream_settings
         stream = f"{self.namespace}/{self.story_run}/{self.step}"
         return StreamConsumer(endpoint, stream, settings=settings,
                               decode_json=decode_json,
-                              connect_timeout=connect_timeout)
+                              connect_timeout=connect_timeout,
+                              tls=TLSPaths.from_env(self.env))
 
     @property
     def log(self) -> logging.Logger:

@@ -51,6 +51,9 @@ ENV_TRIGGER_THROTTLE = "BOBRA_TRIGGER_THROTTLE"  # throttle policy JSON
 # streaming
 ENV_DOWNSTREAM_TARGETS = "BOBRA_DOWNSTREAM_TARGETS"  # JSON list of next hops
 ENV_BINDING_INFO = "BOBRA_BINDING_INFO"  # negotiated transport binding JSON
+# shared-CA mTLS material directory (ca.crt/tls.crt/tls.key — the
+# cert-manager secret layout; reference: pkg/transport/security.go:11)
+ENV_TLS_DIR = "BOBRA_TLS_DIR"
 
 # tracing: controller-persisted span context (reference: TraceInfo
 # trace_types.go:20 + pkg/runs/status/trace.go) so SDK spans parent into

@@ -187,12 +187,39 @@ class LeaseLeaderElector:
             pass
 
 
+def _to_microtime(epoch: float) -> str:
+    """Epoch seconds -> RFC3339 metav1.MicroTime (the wire format the
+    API server REQUIRES for Lease acquireTime/renewTime — a bare number
+    is a 400)."""
+    import datetime
+
+    return datetime.datetime.fromtimestamp(
+        epoch, datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%S.%f") + "Z"
+
+
+def _from_microtime(value) -> float:
+    """RFC3339 MicroTime -> epoch seconds (tolerates epoch numbers from
+    fakes and missing values)."""
+    import datetime
+
+    if value in (None, ""):
+        return 0.0
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = str(value).replace("Z", "+00:00")
+    try:
+        return datetime.datetime.fromisoformat(s).timestamp()
+    except ValueError:
+        return 0.0
+
+
 class KubeLeaseElector:
     """The reference's exact mechanism: a ``coordination.k8s.io/v1``
     Lease through the API server (stdlib client), same TTL protocol as
-    :class:`LeaseLeaderElector`. Times are written as epoch-seconds in
-    an annotation-free spec (microTime formatting is presentation; the
-    CAS and TTL math are what elect)."""
+    :class:`LeaseLeaderElector`. Times go over the wire as RFC3339
+    metav1.MicroTime strings (the schema the API server enforces); the
+    resourceVersion carried in each merge patch is the CAS."""
 
     API_VERSION = "coordination.k8s.io/v1"
 
@@ -234,8 +261,8 @@ class KubeLeaseElector:
                 "spec": {
                     "holderIdentity": self._identity,
                     "leaseDurationSeconds": int(self.lease_duration),
-                    "acquireTime": now,
-                    "renewTime": now,
+                    "acquireTime": _to_microtime(now),
+                    "renewTime": _to_microtime(now),
                     "leaseTransitions": 0,
                 },
             }
@@ -247,17 +274,17 @@ class KubeLeaseElector:
             return True
         spec = live.get("spec") or {}
         holder = spec.get("holderIdentity") or ""
-        renew = float(spec.get("renewTime") or 0.0)
+        renew = _from_microtime(spec.get("renewTime"))
         duration = float(spec.get("leaseDurationSeconds") or self.lease_duration)
         patch: Optional[dict] = None
         if holder == self._identity:
-            patch = {"spec": {"renewTime": now}}
+            patch = {"spec": {"renewTime": _to_microtime(now)}}
         elif not holder or now > renew + duration:
             patch = {"spec": {
                 "holderIdentity": self._identity,
                 "leaseDurationSeconds": int(self.lease_duration),
-                "acquireTime": now,
-                "renewTime": now,
+                "acquireTime": _to_microtime(now),
+                "renewTime": _to_microtime(now),
                 "leaseTransitions": int(spec.get("leaseTransitions") or 0) + 1,
             }}
         if patch is None:
@@ -304,9 +331,20 @@ class KubeLeaseElector:
             return
         self._leading = False
         try:
-            if self.holder() == self._identity:
-                self.client.patch(self.API_VERSION, LEASE_KIND, self.namespace,
-                                  self.name, {"spec": {"holderIdentity": ""}})
+            live = self.client.get(self.API_VERSION, LEASE_KIND,
+                                   self.namespace, self.name)
+            if live is None:
+                return
+            if (live.get("spec") or {}).get("holderIdentity") != self._identity:
+                return
+            # CAS like _attempt: a release racing a steal must lose,
+            # not wipe the new holder's fresh lease
+            patch: dict = {"spec": {"holderIdentity": ""}}
+            rv = (live.get("metadata") or {}).get("resourceVersion")
+            if rv is not None:
+                patch["metadata"] = {"resourceVersion": rv}
+            self.client.patch(self.API_VERSION, LEASE_KIND, self.namespace,
+                              self.name, patch)
         except ClusterError:
             pass
 

@@ -108,12 +108,11 @@ def validate_streaming_settings(st, errs: FieldErrors, path: str) -> None:
             if r.mode not in (None, *_VALID_REPLAY_MODES):
                 errs.add(f"{path}.delivery.replay.mode",
                          f"must be one of {sorted(_VALID_REPLAY_MODES)}")
-            if r.mode == "fromCheckpoint" and not r.checkpoint_interval:
-                errs.add(f"{path}.delivery.replay.checkpointInterval",
-                         "required for replay.mode=fromCheckpoint")
             if r.mode == "fromCheckpoint":
                 # only mode=full is enforced (hub retained history +
-                # fromSeq rejoin); checkpointed replay has no enforcer
+                # fromSeq rejoin); checkpointed replay has no enforcer —
+                # one decisive rejection, no contradictory guidance
+                # about its sub-fields
                 errs.add(f"{path}.delivery.replay.mode",
                          "fromCheckpoint replay is not enforced by the "
                          "data plane; use mode=full with "

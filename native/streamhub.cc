@@ -487,10 +487,16 @@ struct Hub {
       send(c, "{\"t\":\"ok\",\"credits\":-1}");
       long from_seq = h.get_int("fromSeq", -1);
       if (from_seq >= 0 && st->knobs.replay_full) {
-        // replay attach: retained history from fromSeq (a superset of
-        // the unacked buffer — the regular backlog replay is skipped)
+        // replay attach: UNION of retained history and the unacked
+        // buffer from fromSeq, in seq order — retention eviction
+        // ignores ack state, so an unacked entry may live only in the
+        // buffer (matches the Python hub)
+        std::map<long, const Entry*> merged;
         for (const Entry& e : st->retained)
-          if (e.seq >= from_seq) send(c, e.header, e.payload);
+          if (e.seq >= from_seq) merged[e.seq] = &e;
+        for (const Entry& e : st->buffer)
+          if (e.seq >= from_seq) merged.emplace(e.seq, &e);
+        for (const auto& kv : merged) send(c, kv.second->header, kv.second->payload);
       } else {
         // ordered replay straight into the write queue, then live entries
         for (const Entry& e : st->buffer) send(c, e.header, e.payload);

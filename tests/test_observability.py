@@ -366,3 +366,103 @@ class TestTracePersistence:
         srun = rt.store.get("StoryRun", "default", run)
         assert "trace" not in srun.status
         assert "inputSchemaRef" not in srun.status
+
+
+class TestOTLPExport:
+    """VERDICT r2 #8: wire-level OTLP/HTTP export behind SpanExporter —
+    bounded queue, batch flush, shutdown-with-deadline; spans from a
+    story run arrive at a collector stub with parent/child links
+    intact across controller -> SDK."""
+
+    @staticmethod
+    def _collector():
+        import json as _json
+        import threading as _t
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        received: list[dict] = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):  # noqa: N802
+                assert self.path == "/v1/traces"
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                received.append(_json.loads(body))
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        _t.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, f"http://127.0.0.1:{srv.server_address[1]}", received
+
+    @staticmethod
+    def _flatten(received):
+        out = []
+        for post in received:
+            for rs in post.get("resourceSpans", []):
+                for ss in rs.get("scopeSpans", []):
+                    out.extend(ss.get("spans", []))
+        return out
+
+    def test_story_spans_reach_collector_with_links(self, monkeypatch):
+        from bobrapet_tpu.api.catalog import make_engram_template
+        from bobrapet_tpu.api.engram import make_engram
+        from bobrapet_tpu.api.story import make_story
+        from bobrapet_tpu.observability import tracing as tracing_mod
+        from bobrapet_tpu.observability.tracing import OTLPSpanExporter
+        from bobrapet_tpu.runtime import Runtime
+
+        srv, endpoint, received = self._collector()
+        exporter = OTLPSpanExporter(endpoint, flush_interval=0.1)
+        tracer = Tracer(TracingConfig(enabled=True), exporter=exporter)
+        monkeypatch.setattr(tracing_mod, "TRACER", tracer)
+        rt = Runtime(tracer=tracer)
+
+        @register_engram("otlp-impl")
+        def impl(ctx):
+            with ctx.start_span("engram.work"):
+                pass
+            return {"ok": True}
+
+        rt.apply(make_engram_template("otlp-tpl", entrypoint="otlp-impl"))
+        rt.apply(make_engram("otlp-worker", "otlp-tpl"))
+        rt.apply(make_story("otlp-story", steps=[
+            {"name": "s", "ref": {"name": "otlp-worker"}},
+        ]))
+        run = rt.run_story("otlp-story")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        exporter.shutdown()
+        srv.shutdown()
+
+        spans = self._flatten(received)
+        assert spans, "no spans reached the collector"
+        by_id = {s["spanId"]: s for s in spans}
+        # the SDK-side span parents into a controller-side span IN THE
+        # SAME TRACE — the cross-process stitch survived the wire
+        work = [s for s in spans if s["name"] == "engram.work"]
+        assert work, [s["name"] for s in spans]
+        parent = by_id.get(work[0].get("parentSpanId"))
+        assert parent is not None, "engram span's parent was not exported"
+        assert parent["traceId"] == work[0]["traceId"]
+        # OTLP shape: service.name resource attribute present
+        res_attrs = received[0]["resourceSpans"][0]["resource"]["attributes"]
+        assert {"key": "service.name",
+                "value": {"stringValue": "bobrapet-tpu"}} in res_attrs
+
+    def test_bounded_queue_drops_instead_of_blocking(self):
+        from bobrapet_tpu.observability.tracing import OTLPSpanExporter, Span
+
+        # endpoint that will never answer: export must stay non-blocking
+        exp = OTLPSpanExporter("http://127.0.0.1:1", max_queue=8,
+                               flush_interval=30.0, timeout=0.2)
+        for i in range(50):
+            exp.export(Span(name=f"s{i}", trace_id="t", span_id=str(i),
+                            start_time=0.0, end_time=1.0))
+        assert exp.dropped > 0
+        exp.shutdown(deadline=0.5)
+        assert exp.export_errors >= 1

@@ -203,3 +203,99 @@ class TestLeaderElection:
         )
         assert probe.stdout.strip() == "False", probe.stderr
         a.release()
+
+
+class TestHelmChart:
+    """Chart parity (VERDICT r2 #9): templates render cleanly through
+    the no-helm subset renderer; ServiceMonitor/NetworkPolicy/shared-CA
+    gate on values; rendered docs are valid Kubernetes-shaped YAML."""
+
+    CHART = os.path.join(os.path.dirname(__file__), "..", "deploy", "chart",
+                         "bobrapet-tpu")
+
+    def _render(self, **values):
+        from bobrapet_tpu.gke.chart import render_chart_manifests
+
+        return render_chart_manifests(self.CHART, values=values or None)
+
+    def test_default_render_is_valid_and_complete(self):
+        docs = self._render()
+        kinds = [(d["kind"], d["metadata"]["name"]) for d in docs]
+        assert ("Deployment", "bobrapet-manager") in kinds
+        assert ("Deployment", "bobravoz-hub") in kinds
+        assert ("Service", "bobravoz-hub") in kinds
+        assert ("ServiceAccount", "bobrapet-manager") in kinds
+        assert ("Role", "bobrapet-leader-election") in kinds
+        assert ("PersistentVolumeClaim", "bobrapet-store") in kinds
+        for d in docs:
+            assert d.get("apiVersion") and d.get("kind")
+            assert d["metadata"].get("name")
+        # defaults exclude the gated extras
+        assert not [k for k, _ in kinds if k in
+                    ("ServiceMonitor", "NetworkPolicy", "Certificate")]
+        # manager args wired from values
+        mgr = next(d for d in docs
+                   if (d["kind"], d["metadata"]["name"]) == ("Deployment", "bobrapet-manager"))
+        args = mgr["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--leader-elect" in args
+        assert "--persist-dir=/var/lib/bobrapet/store" in args
+        # stock-cluster default: one replica over RWO (HA is opt-in:
+        # replicas 2 + accessMode ReadWriteMany on an RWX class)
+        assert mgr["spec"]["replicas"] == 1
+        pvc = next(d for d in docs if d["kind"] == "PersistentVolumeClaim")
+        assert pvc["spec"]["accessModes"] == ["ReadWriteOnce"]
+        ha = self._render(replicas=2,
+                          persistence={"accessMode": "ReadWriteMany"})
+        pvc = next(d for d in ha if d["kind"] == "PersistentVolumeClaim")
+        assert pvc["spec"]["accessModes"] == ["ReadWriteMany"]
+
+    def test_gated_monitoring_and_security_render(self):
+        docs = self._render(
+            metrics={"serviceMonitor": True, "networkPolicy": True},
+            certManager={"enabled": True},
+            hub={"tls": True},
+        )
+        kinds = {d["kind"] for d in docs}
+        assert {"ServiceMonitor", "NetworkPolicy", "Certificate",
+                "ClusterIssuer", "Issuer"} <= kinds
+        # TLS hub mounts the cert-manager secret and passes --tls-dir
+        hub = next(d for d in docs
+                   if (d["kind"], d["metadata"]["name"]) == ("Deployment", "bobravoz-hub"))
+        c = hub["spec"]["template"]["spec"]["containers"][0]
+        assert "--tls-dir=/var/run/bobrapet/tls" in c["args"]
+        assert hub["spec"]["template"]["spec"]["volumes"][0]["secret"][
+            "secretName"] == "bobrapet-hub-tls"
+
+    def test_disabled_persistence_drops_pvc_and_flag(self):
+        docs = self._render(persistence={"enabled": False})
+        assert not [d for d in docs if d["kind"] == "PersistentVolumeClaim"]
+        mgr = next(d for d in docs
+                   if (d["kind"], d["metadata"]["name"]) == ("Deployment", "bobrapet-manager"))
+        args = mgr["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert not [a for a in args if a.startswith("--persist-dir")]
+
+    def test_export_chart_cli(self, tmp_path):
+        import subprocess
+        import sys
+
+        out = subprocess.run(
+            [sys.executable, "-m", "bobrapet_tpu", "export-chart",
+             "--out", str(tmp_path / "rendered")],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        files = os.listdir(tmp_path / "rendered")
+        assert "deployment.yaml" in files
+
+    def test_make_test_e2e_smoke(self):
+        """The gated e2e target runs green in this environment (falls
+        back to the no-container packaging smoke without docker)."""
+        import subprocess
+
+        out = subprocess.run(
+            ["make", "test-e2e"],
+            capture_output=True, text=True, timeout=300,
+            cwd=os.path.join(os.path.dirname(__file__), ".."),
+        )
+        assert out.returncode == 0, out.stderr + out.stdout
+        assert "OK" in out.stdout

@@ -473,11 +473,12 @@ class TestTransportWebhooks:
                 "pauseThreshold": {"bufferPct": 50},
                 "resumeThreshold": {"bufferPct": 80}}})),
             "hysteresis")
-        # replay checkpoints without checkpoint interval
+        # fromCheckpoint replay (with or without interval) is rejected
+        # outright as unenforced — no contradictory field guidance
         denied(lambda: rt.apply(make_transport(
             "t", "p", streaming={"delivery": {
                 "replay": {"mode": "fromCheckpoint"}}})),
-            "checkpointInterval")
+            "not enforced")
         # cutover with a drain timeout
         denied(lambda: rt.apply(make_transport(
             "t", "p", streaming={"lifecycle": {
