@@ -343,9 +343,60 @@ def config5_nested_rag() -> dict:
     }
 
 
+def config6_serving() -> dict:
+    """Continuous-batching serving engine throughput (paged KV cache):
+    requests stream through a small slot pool; measures aggregate
+    decoded tok/s incl. admission/prefill overlap. CPU tiny-model
+    numbers gauge engine overhead, not chip speed."""
+    import numpy as np
+
+    from bobrapet_tpu.models import llama
+    from bobrapet_tpu.serving import PagedConfig, ServingEngine
+
+    cfg = llama.llama_tiny()
+    params = llama.init_params(__import__("jax").random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, PagedConfig(
+        max_slots=4, block_size=16, num_blocks=128, max_blocks_per_seq=8))
+    rng = np.random.default_rng(0)
+    n_requests, new_tokens = 12, 16
+    for i in range(n_requests):
+        eng.submit(rng.integers(0, cfg.vocab_size, 8 + (i % 5) * 7).tolist(),
+                   max_new_tokens=new_tokens)
+    # warm the compiled paths (prefill buckets + decode step); tokens
+    # produced by the warm-up step are EXCLUDED from the timed count
+    eng.step()
+    warm_tokens = sum(
+        len(s.request.output) for s in eng.slots if s is not None
+    ) + sum(len(r.output) for r in eng.finished)
+    t0 = time.perf_counter()
+    done = eng.run()
+    wall = time.perf_counter() - t0
+    total_tokens = sum(len(r.output) for r in done) - warm_tokens
+    return {
+        "metric": "serving_decode_tokens_per_sec",
+        "value": round(total_tokens / wall, 1),
+        "unit": "tok/s",
+        "vs_baseline": 1.0,
+        "config": "serving",
+        "requests": n_requests,
+        "slots": 4,
+        "tokens": total_tokens,
+        "wallclock_s": round(wall, 3),
+    }
+
+
 def run_sweep(state: dict) -> None:
+    # the parent NEVER touches the accelerator — but the env var alone
+    # is not enough: a site hook can rewrite platform priority
+    # ('cpu' -> 'axon,cpu'), and the first jax-touching config (serving)
+    # would then initialize the possibly-wedged TPU plugin. The config
+    # update after import is authoritative.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
     for idx, fn in ((1, config1_single_step), (3, config3_fanout_gang),
-                    (4, config4_streaming_hub), (5, config5_nested_rag)):
+                    (4, config4_streaming_hub), (5, config5_nested_rag),
+                    ("serving", config6_serving)):
         state["stage"] = f"config-{idx}"
         try:
             _emit(fn())

@@ -38,12 +38,14 @@ def _connect(endpoint: str, timeout: float, tls=None) -> socket.socket:
     sock.settimeout(timeout)
     if tls is not None:
         # shared-CA mutual TLS (dataplane/tls.py): the server must
-        # present a CA-chained cert; we present ours
-        from .tls import client_context
+        # present a CA-chained cert; we present ours. The wrapper
+        # serializes SSL_read/SSL_write — these sockets are shared by a
+        # reader thread and a sending thread
+        from .tls import client_context, wrap_tls
 
-        sock = client_context(tls).wrap_socket(
-            sock, server_hostname=host or "127.0.0.1"
-        )
+        sock = wrap_tls(sock, client_context(tls),
+                        server_hostname=host or "127.0.0.1")
+        sock.settimeout(timeout)
     return sock
 
 

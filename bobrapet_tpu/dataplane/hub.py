@@ -147,6 +147,7 @@ class _ProducerConn:
         self.queue: collections.deque = collections.deque()
         self.cv = threading.Condition()
         self.closed = False
+        self.writer: Optional[threading.Thread] = None
 
     def enqueue(self, header: dict[str, Any]) -> None:
         with self.cv:
@@ -316,9 +317,13 @@ class StreamHub:
     def _serve_conn(self, sock: socket.socket) -> None:
         if self._tls_ctx is not None:
             # handshake on the per-connection thread (a slow or
-            # malicious peer must not stall the accept loop)
+            # malicious peer must not stall the accept loop); the
+            # wrapper serializes SSL ops — each connection is shared by
+            # this reader thread and a writer-queue thread
+            from .tls import wrap_tls
+
             try:
-                sock = self._tls_ctx.wrap_socket(sock, server_side=True)
+                sock = wrap_tls(sock, self._tls_ctx, server_side=True)
             except (OSError, ssl.SSLError) as e:
                 _log.debug("hub TLS handshake failed: %s", e)
                 try:
@@ -356,8 +361,9 @@ class StreamHub:
     # -- producer side -----------------------------------------------------
     def _serve_producer(self, sock: socket.socket, st: _Stream) -> None:
         conn = _ProducerConn(sock, st)
-        threading.Thread(target=conn.writer_loop, daemon=True,
-                         name="hub-producer-writer").start()
+        conn.writer = threading.Thread(target=conn.writer_loop, daemon=True,
+                                       name="hub-producer-writer")
+        conn.writer.start()
         # hub lock first (lock order: hub -> stream): clear the ended
         # tombstone and re-register the stream in case _maybe_gc
         # reclaimed it between _get_stream and here (redrive re-attach)
@@ -423,6 +429,11 @@ class StreamHub:
                     return
         finally:
             conn.close()
+            # drain before _serve_conn's finally closes the socket: a
+            # queued err/credit frame must reach the kernel buffer, not
+            # race the close into a bare RST
+            if conn.writer is not None:
+                conn.writer.join(timeout=2.0)
             with st.lock:
                 if conn in st.producer_conns:
                     st.producer_conns.remove(conn)

@@ -102,6 +102,77 @@ def client_context(tls) -> ssl.SSLContext:
     return ctx
 
 
+class SerializedTLSSocket:
+    """Full-duplex-safe wrapper for an ``ssl.SSLSocket`` shared by a
+    reader and a writer thread.
+
+    One OpenSSL ``SSL*`` must never run SSL_read and SSL_write
+    concurrently (CPython releases the GIL around both). The data plane
+    is full duplex — a producer blocks in send while its credit-reader
+    thread blocks in recv — so every SSL operation is serialized behind
+    one lock, with reads degraded to a poll loop (short socket timeout,
+    lock released between attempts) so a blocked reader can't starve
+    the writer. Plaintext sockets don't take this detour: kernel-level
+    send/recv on a plain fd are independently safe.
+    """
+
+    POLL_S = 0.05
+
+    def __init__(self, sock, poll_s: Optional[float] = None):
+        import threading
+
+        self._sock = sock
+        self._lock = threading.Lock()
+        self._deadline: Optional[float] = None  # caller-set read deadline
+        self._poll = poll_s or self.POLL_S
+
+    def settimeout(self, value) -> None:
+        import time
+
+        self._deadline = None if value is None else time.monotonic() + value
+
+    def recv(self, n: int) -> bytes:
+        import socket as _socket
+        import time
+
+        while True:
+            with self._lock:
+                self._sock.settimeout(self._poll)
+                try:
+                    return self._sock.recv(n)
+                except (_socket.timeout, ssl.SSLWantReadError):
+                    pass
+            if self._deadline is not None and time.monotonic() > self._deadline:
+                raise TimeoutError("read deadline exceeded")
+
+    def sendall(self, data: bytes) -> None:
+        with self._lock:
+            self._sock.settimeout(None)
+            self._sock.sendall(data)
+
+    def shutdown(self, how) -> None:
+        with self._lock:
+            self._sock.shutdown(how)
+
+    def close(self) -> None:
+        # no lock: close must be able to interrupt a poll-looping reader
+        self._sock.close()
+
+    def fileno(self) -> int:
+        return self._sock.fileno()
+
+
+def wrap_tls(sock, ctx: ssl.SSLContext, server_side: bool = False,
+             server_hostname: Optional[str] = None) -> SerializedTLSSocket:
+    """Handshake + full-duplex-safe wrapper (see SerializedTLSSocket)."""
+    wrapped = (
+        ctx.wrap_socket(sock, server_side=True)
+        if server_side
+        else ctx.wrap_socket(sock, server_hostname=server_hostname)
+    )
+    return SerializedTLSSocket(wrapped)
+
+
 def make_hub(tls=None, prefer_native: bool = True, host: str = "127.0.0.1",
              port: int = 0):
     """Hub engine selection with the TLS rule applied: the native C++

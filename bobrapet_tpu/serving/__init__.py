@@ -1,0 +1,8 @@
+"""Serving: continuous batching over a paged KV cache (engine.py,
+paged_cache.py) — the TPU-native decode server the inference engrams
+run."""
+
+from .engine import Request, ServingEngine
+from .paged_cache import BlockAllocator, PagedConfig
+
+__all__ = ["BlockAllocator", "PagedConfig", "Request", "ServingEngine"]

@@ -1,0 +1,463 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+The TPU-native decode server the BASELINE inference configs point at:
+instead of one `greedy_generate` per request (whole-batch lockstep,
+padded to the slowest prompt), requests stream through a fixed pool of
+**slots** — a request is admitted the moment a slot and enough KV
+blocks are free, decodes one token per engine step fused with every
+other live request, and leaves the instant it finishes. Throughput
+stays at the batch roofline regardless of arrival times or length
+spread.
+
+XLA-first design decisions:
+
+- ONE compiled decode step, ever: slots are a static batch; liveness is
+  a mask, never a shape. Inactive slots compute garbage that lands in
+  the reserved scratch block (paged_cache.py).
+- Prefill compiles per LENGTH BUCKET (next power of two), so arbitrary
+  prompt lengths cost at most log2(max_len) compilations.
+- Host-side scheduler (admit/finish/preempt, block accounting) touches
+  only tiny int arrays; all tensor work is jitted with donated pools so
+  XLA updates the cache in place.
+- Preemption = recompute: when the pool can't grow a sequence, the
+  youngest victim's blocks are freed and it re-queues with its prompt +
+  already-generated tokens (the classic recompute strategy — cheap on
+  TPU where prefill rides the MXU).
+
+Sampling: greedy when ``temperature == 0``, else
+``jax.random.categorical`` with a per-request key folded per step —
+deterministic replay for a fixed submit order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from collections import deque
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models import quant
+from ..models.llama import LlamaConfig, forward, init_cache
+from ..ops.rmsnorm import rmsnorm_reference
+from ..ops.rope import apply_rope, rope_frequencies
+from .paged_cache import (
+    SCRATCH_BLOCK,
+    BlockAllocator,
+    PagedConfig,
+    gather_kv,
+    init_pools,
+    write_prefill,
+)
+
+_mm = quant.matmul
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: list[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    eos_token: Optional[int] = None
+    #: filled by the engine
+    output: list[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+    preemptions: int = 0
+
+
+@dataclasses.dataclass
+class _SlotState:
+    request: Request
+    blocks: list[int]
+    seq_len: int  # tokens currently in the cache (prompt + generated)
+
+
+def _bucket(n: int, minimum: int = 16) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class ServingEngine:
+    """See module docstring. Single-host; the params tree may be int8
+    (models/quant.py) and/or sharded (parallel/sharding.py) — the fused
+    step consumes it through the same quant-aware matmul hook as
+    ``forward``."""
+
+    def __init__(self, params: Any, cfg: LlamaConfig,
+                 pcfg: Optional[PagedConfig] = None):
+        self.params = params
+        self.cfg = cfg
+        self.pcfg = pcfg or PagedConfig()
+        self.pools = init_pools(cfg, self.pcfg)
+        self.allocator = BlockAllocator(self.pcfg.num_blocks)
+        self.pending: deque[Request] = deque()
+        self.slots: list[Optional[_SlotState]] = [None] * self.pcfg.max_slots
+        self.finished: list[Request] = []
+        self._next_rid = 0
+        self._last_tokens = [0] * self.pcfg.max_slots
+        self._keys = jax.random.split(
+            jax.random.PRNGKey(0), self.pcfg.max_slots
+        )
+        self._steps = 0
+        self._decode_fn = jax.jit(
+            functools.partial(_decode_step, cfg=cfg, pcfg=self.pcfg),
+            donate_argnums=(1,),
+        )
+        self._prefill_fns: dict[int, Any] = {}
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, prompt: list[int], max_new_tokens: int,
+               temperature: float = 0.0,
+               eos_token: Optional[int] = None) -> int:
+        if len(prompt) + max_new_tokens > self.pcfg.capacity:
+            raise ValueError(
+                f"prompt+new ({len(prompt)}+{max_new_tokens}) exceeds slot "
+                f"capacity {self.pcfg.capacity}"
+            )
+        req = Request(self._next_rid, list(prompt), max_new_tokens,
+                      temperature, eos_token)
+        self._next_rid += 1
+        self.pending.append(req)
+        return req.rid
+
+    def run(self, max_steps: int = 100_000) -> list[Request]:
+        """Drive until every submitted request finishes; returns them in
+        completion order."""
+        steps = 0
+        while (self.pending or any(self.slots)) and steps < max_steps:
+            self.step()
+            steps += 1
+        return self.finished
+
+    @property
+    def active_slots(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    # -- scheduler ---------------------------------------------------------
+
+    def step(self) -> list[int]:
+        """One engine tick: admit -> grow/preempt -> fused decode ->
+        retire. Returns rids that finished this tick."""
+        self._admit()
+        self._ensure_growth()
+        if not any(self.slots):
+            return []
+        done = self._decode_once()
+        return done
+
+    def _admit(self) -> None:
+        for i, slot in enumerate(self.slots):
+            if not self.pending:
+                return
+            if slot is not None:
+                continue
+            req = self.pending[0]
+            need = self.pcfg.blocks_for(len(req.prompt) + len(req.output) + 1)
+            if need > self.pcfg.max_blocks_per_seq:
+                req.done = True
+                self.pending.popleft()
+                self.finished.append(req)
+                continue
+            blocks = self.allocator.alloc(need)
+            if blocks is None:
+                return  # head-of-line waits for memory
+            self.pending.popleft()
+            self._prefill(i, req, blocks)
+
+    def _ensure_growth(self) -> None:
+        """Allocate the next block for any slot whose next token would
+        cross a block boundary; preempt the youngest slot when the pool
+        is exhausted."""
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            if slot.seq_len % self.pcfg.block_size == 0:
+                needed_idx = slot.seq_len // self.pcfg.block_size
+                if needed_idx < len(slot.blocks):
+                    continue
+                if needed_idx >= self.pcfg.max_blocks_per_seq:
+                    self._retire(i)  # capacity cap reached
+                    continue
+                got = self.allocator.alloc(1)
+                while got is None:
+                    victim = self._youngest(exclude=i)
+                    if victim is None:
+                        # nothing to steal from; retire this request
+                        # with what it has rather than deadlock
+                        self._retire(i)
+                        break
+                    self._preempt(victim)
+                    got = self.allocator.alloc(1)
+                if self.slots[i] is not None and got:
+                    slot.blocks.extend(got)
+
+    def _youngest(self, exclude: int) -> Optional[int]:
+        cands = [
+            (self.slots[i].request.rid, i)
+            for i in range(len(self.slots))
+            if i != exclude and self.slots[i] is not None
+        ]
+        return max(cands)[1] if cands else None
+
+    def _preempt(self, slot_idx: int) -> None:
+        slot = self.slots[slot_idx]
+        assert slot is not None
+        req = slot.request
+        req.preemptions += 1
+        # recompute strategy: blocks are freed NOW; on readmission the
+        # prefill recomputes over prompt + already-generated output (the
+        # request keeps its history — only the cache is sacrificed)
+        self.allocator.free(slot.blocks)
+        self.slots[slot_idx] = None
+        self.pending.appendleft(req)
+
+    def _retire(self, slot_idx: int) -> None:
+        slot = self.slots[slot_idx]
+        assert slot is not None
+        slot.request.done = True
+        self.allocator.free(slot.blocks)
+        self.finished.append(slot.request)
+        self.slots[slot_idx] = None
+
+    # -- compute -----------------------------------------------------------
+
+    def _prefill(self, slot_idx: int, req: Request, blocks: list[int]) -> None:
+        # a preempted request resumes by prefilling prompt + its own
+        # prior output (recompute strategy)
+        effective = req.prompt + req.output
+        p = len(effective)
+        bucket = min(_bucket(p), self.pcfg.capacity)
+        n_blocks = bucket // self.pcfg.block_size
+        while len(blocks) < n_blocks:
+            more = self.allocator.alloc(1)
+            if more is None:
+                # not enough for the padded bucket: give the blocks back
+                # and let the request wait at the head of the queue
+                self.allocator.free(blocks)
+                self.pending.appendleft(req)
+                return
+            blocks.extend(more)
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(_prefill_bucket, cfg=self.cfg,
+                                  bucket=bucket),
+                donate_argnums=(1,),
+            )
+            self._prefill_fns[bucket] = fn
+        prompt = jnp.asarray(
+            effective + [0] * (bucket - p), jnp.int32
+        )[None, :]
+        self.pools, logits = fn(
+            self.params, self.pools, prompt,
+            jnp.asarray(blocks[:n_blocks], jnp.int32),
+        )
+        tok = self._sample_host(logits[0, p - 1], req, slot_idx)
+        self.slots[slot_idx] = _SlotState(req, blocks, p + 1)
+        self._record(slot_idx, req, tok)
+
+    def _decode_once(self) -> list[int]:
+        S = self.pcfg.max_slots
+        active = jnp.asarray(
+            [s is not None for s in self.slots], jnp.bool_
+        )
+        seq_lens = jnp.asarray(
+            [s.seq_len if s else 1 for s in self.slots], jnp.int32
+        )
+        tokens = jnp.asarray(self._last_tokens, jnp.int32)
+        tables = self._block_tables()
+        temps = jnp.asarray(
+            [s.request.temperature if s else 0.0 for s in self.slots],
+            jnp.float32,
+        )
+        self._steps += 1
+        keys = jax.vmap(jax.random.fold_in, (0, None))(
+            self._keys, self._steps
+        )
+        self.pools, next_tokens = self._decode_fn(
+            self.params, self.pools, tokens, seq_lens, active, tables,
+            temps, keys,
+        )
+        next_host = jax.device_get(next_tokens).tolist()
+
+        done: list[int] = []
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            slot.seq_len += 1
+            req = slot.request
+            self._record(i, req, int(next_host[i]))
+            if req.done:  # _record observed eos/budget
+                done.append(req.rid)
+                self._retire(i)
+        return done
+
+    def _record(self, slot_idx: int, req: Request, tok: int) -> None:
+        """Account one generated token (host side)."""
+        self._last_tokens[slot_idx] = tok
+        req.output.append(tok)
+        if (req.eos_token is not None and tok == req.eos_token) or (
+            len(req.output) >= req.max_new_tokens
+        ):
+            req.done = True
+
+    def _sample_host(self, logits: jax.Array, req: Request, slot_idx: int) -> int:
+        if req.temperature > 0:
+            key = jax.random.fold_in(self._keys[slot_idx], self._steps)
+            return int(jax.random.categorical(key, logits / req.temperature))
+        return int(jnp.argmax(logits))
+
+    def _block_tables(self) -> jax.Array:
+        import numpy as np
+
+        t = np.full((self.pcfg.max_slots, self.pcfg.max_blocks_per_seq),
+                    SCRATCH_BLOCK, np.int32)
+        for i, slot in enumerate(self.slots):
+            if slot is not None:
+                t[i, :len(slot.blocks)] = slot.blocks
+        return jnp.asarray(t)
+
+
+# ---------------------------------------------------------------------------
+# jitted kernels
+# ---------------------------------------------------------------------------
+
+
+def _prefill_bucket(params, pools, prompt, block_ids, *, cfg: LlamaConfig,
+                    bucket: int):
+    """Full forward over the padded prompt; contiguous K/V lands in the
+    sequence's blocks. Reuses the model's contiguous-cache forward (the
+    single compiled graph per bucket)."""
+    cache = init_cache(cfg, 1, bucket)
+    positions = jnp.arange(bucket)[None, :]
+    logits, cache = forward(params, prompt, cfg, cache=cache,
+                            positions=positions)
+    k = jnp.stack([c["k"][0] for c in cache])  # [L, bucket, Hkv, Dh]
+    v = jnp.stack([c["v"][0] for c in cache])
+    pools = write_prefill(pools, k, v, block_ids)
+    return pools, logits
+
+
+def _decode_step(params, pools, tokens, seq_lens, active, block_tables,
+                 temps, keys, *, cfg: LlamaConfig, pcfg: PagedConfig):
+    """One fused token step for every slot (see module doc)."""
+    S = pcfg.max_slots
+    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    positions = seq_lens - 1  # the incoming token's position
+    x = params["embed"]["weight"][tokens].astype(cfg.dtype)[:, None, :]
+
+    # masked write target: inactive slots scribble on the scratch block
+    block_idx = positions // pcfg.block_size
+    row = jnp.take_along_axis(block_tables, block_idx[:, None], axis=1)[:, 0]
+    write_block = jnp.where(active, row, SCRATCH_BLOCK)
+    write_off = jnp.where(active, positions % pcfg.block_size, 0)
+
+    for layer_i, layer in enumerate(params["layers"]):
+        h = rmsnorm_reference(x, layer["attn_norm"]["weight"], cfg.norm_eps)
+        q = _mm(h, layer["attn"]["wq"]).reshape(S, 1, cfg.n_heads, cfg.head_dim)
+        k = _mm(h, layer["attn"]["wk"]).reshape(S, 1, cfg.n_kv_heads, cfg.head_dim)
+        v = _mm(h, layer["attn"]["wv"]).reshape(S, 1, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, freqs, positions[:, None])
+        k = apply_rope(k, freqs, positions[:, None])
+
+        pools = _write_layer(pools, layer_i, k, v, write_block, write_off)
+
+        out = _paged_attention(q, pools, block_tables, seq_lens, layer_i, cfg)
+        x = x + _mm(out.reshape(S, 1, cfg.dim), layer["attn"]["wo"])
+        h2 = rmsnorm_reference(x, layer["mlp_norm"]["weight"], cfg.norm_eps)
+        gate = jax.nn.silu(_mm(h2, layer["mlp"]["w_gate"]).astype(jnp.float32))
+        up = _mm(h2, layer["mlp"]["w_up"]).astype(jnp.float32)
+        x = x + _mm((gate * up).astype(cfg.dtype), layer["mlp"]["w_down"])
+
+    x = rmsnorm_reference(x, params["final_norm"]["weight"], cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"]["weight"].T.astype(cfg.dtype)
+    else:
+        logits = _mm(x, params["lm_head"]["weight"])
+    logits = logits[:, 0].astype(jnp.float32)  # [S, V]
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    sampled = jax.vmap(
+        lambda key, lg, t: jax.random.categorical(key, lg / jnp.maximum(t, 1e-6))
+    )(keys, logits, temps).astype(jnp.int32)
+    return pools, jnp.where(temps > 0, sampled, greedy)
+
+
+def _write_layer(pools, layer_i, k, v, write_block, write_off):
+    """Write one layer's new token K/V: [S,1,H,D] -> pool[layer]."""
+    return {
+        "k": pools["k"].at[layer_i, write_block, write_off].set(
+            k[:, 0].astype(pools["k"].dtype)),
+        "v": pools["v"].at[layer_i, write_block, write_off].set(
+            v[:, 0].astype(pools["v"].dtype)),
+    }
+
+
+def _use_pallas() -> bool:
+    """Pallas paged-attention fast path: TPU only, explicit opt-in
+    (BOBRA_PALLAS_PAGED=1) until validated on a healthy chip — the
+    reference einsum path is the always-correct default."""
+    import os
+
+    if os.environ.get("BOBRA_PALLAS_PAGED") != "1":
+        return False
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:  # noqa: BLE001 - backend init failure = no fast path
+        return False
+
+
+def _paged_attention_pallas(q, pools, block_tables, seq_lens, layer_i,
+                            cfg: LlamaConfig) -> jax.Array:
+    """jax.experimental paged_attention kernel: reads KV pages in place
+    (no per-step cache materialization — the HBM win paging exists
+    for). Pool layout [N, B, H, D] transposes to the kernel's
+    [H, N, B, D] page layout; XLA keeps the transpose out of the hot
+    loop by caching the constant-folded view when pools are donated."""
+    from jax.experimental.pallas.ops.tpu.paged_attention import (
+        paged_attention as _pallas_paged,
+    )
+
+    k_pages = jnp.transpose(pools["k"][layer_i], (2, 0, 1, 3))
+    v_pages = jnp.transpose(pools["v"][layer_i], (2, 0, 1, 3))
+    out = _pallas_paged(
+        q[:, 0],  # [S, Hq, D]
+        k_pages, v_pages,
+        seq_lens.astype(jnp.int32),
+        block_tables.astype(jnp.int32),
+        pages_per_compute_block=min(4, block_tables.shape[1]),
+    )
+    return out[:, None]  # [S, 1, Hq, D]
+
+
+def _paged_attention(q, pools, block_tables, seq_lens, layer_i,
+                     cfg: LlamaConfig) -> jax.Array:
+    """Decode attention over the paged cache (reference einsum path;
+    the Pallas kernel slots in behind the same signature on TPU)."""
+    import math as _math
+
+    if _use_pallas():
+        return _paged_attention_pallas(
+            q, pools, block_tables, seq_lens, layer_i, cfg
+        )
+
+    k_all, v_all = gather_kv(pools, block_tables, layer_i)  # [S, cap, H, D]
+    s, one, hq, d = q.shape
+    cap = k_all.shape[1]
+    group = hq // cfg.n_kv_heads
+    scale = 1.0 / _math.sqrt(d)
+    qf = q[:, 0].astype(jnp.float32) * scale            # [S, Hq, D]
+    kf = jnp.repeat(k_all.astype(jnp.float32), group, axis=2)
+    vf = jnp.repeat(v_all.astype(jnp.float32), group, axis=2)
+    scores = jnp.einsum("shd,skhd->shk", qf, kf)        # [S, Hq, cap]
+    mask = jnp.arange(cap)[None, :] < seq_lens[:, None]  # [S, cap]
+    scores = jnp.where(mask[:, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("shk,skhd->shd", probs, vf)
+    return out[:, None].astype(q.dtype)  # [S, 1, Hq, D]

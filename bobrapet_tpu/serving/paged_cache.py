@@ -1,0 +1,141 @@
+"""Paged KV cache: fixed block pools + per-sequence block tables.
+
+The serving-engine memory layout (no reference counterpart — the
+reference orchestrates containers and owns no model code; this is the
+TPU-native serving capability its inference engrams need). Design:
+
+- One pool per K and V, shaped ``[layers, num_blocks, block_size,
+  kv_heads, head_dim]``: a block id addresses the SAME slab across all
+  layers, so one allocation covers the whole model and every write is a
+  single vectorized scatter over the layer axis.
+- **Block 0 is reserved scratch**: inactive slots in the fused decode
+  step still execute their (masked) writes — they land in block 0,
+  which is never allocated, so garbage can't corrupt live sequences.
+  This keeps the step free of data-dependent control flow (XLA traces
+  one graph regardless of which slots are live).
+- Block tables are tiny ``[max_slots, max_blocks_per_seq]`` int32
+  arrays maintained host-side by the engine's allocator and shipped
+  with each step call.
+
+Static shapes everywhere: capacity = ``max_blocks_per_seq *
+block_size`` bounds attention; XLA compiles the step exactly once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.llama import LlamaConfig
+
+#: block id 0 is never allocated (masked writes land there)
+SCRATCH_BLOCK = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedConfig:
+    max_slots: int = 8          # concurrent sequences in the decode batch
+    block_size: int = 16        # tokens per KV block
+    num_blocks: int = 256       # pool size (incl. the scratch block)
+    max_blocks_per_seq: int = 32
+
+    @property
+    def capacity(self) -> int:
+        return self.max_blocks_per_seq * self.block_size
+
+    def blocks_for(self, tokens: int) -> int:
+        return max(1, math.ceil(tokens / self.block_size))
+
+
+def init_pools(cfg: LlamaConfig, pcfg: PagedConfig) -> dict[str, jax.Array]:
+    shape = (cfg.n_layers, pcfg.num_blocks, pcfg.block_size,
+             cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def write_token(
+    pools: dict[str, jax.Array],
+    k: jax.Array,  # [L, S, Hkv, Dh] — one new token per slot, all layers
+    v: jax.Array,
+    block_ids: jax.Array,  # [S] physical block per slot (0 when masked)
+    offsets: jax.Array,    # [S] offset within the block
+) -> dict[str, jax.Array]:
+    """Scatter one decoded token's K/V for every slot into the pools.
+
+    ``pool[:, block_ids, offsets]`` (adjacent advanced indices) selects
+    ``[L, S, Hkv, Dh]`` — one scatter covers every layer and slot."""
+    return {
+        "k": pools["k"].at[:, block_ids, offsets].set(k),
+        "v": pools["v"].at[:, block_ids, offsets].set(v),
+    }
+
+
+def write_prefill(
+    pools: dict[str, jax.Array],
+    k: jax.Array,  # [L, P, Hkv, Dh] contiguous prompt K (P = padded bucket)
+    v: jax.Array,
+    block_ids: jax.Array,  # [n_blocks] physical blocks receiving the prompt
+) -> dict[str, jax.Array]:
+    """Scatter a contiguous prefill K/V run into this sequence's blocks.
+
+    P must equal ``len(block_ids) * block_size`` (the engine pads the
+    bucket); positions beyond the true prompt length hold garbage that
+    the attention mask never reads.
+    """
+    n_blocks = block_ids.shape[0]
+    L, P, H, D = k.shape
+    B = P // n_blocks
+    kb = k.reshape(L, n_blocks, B, H, D)
+    vb = v.reshape(L, n_blocks, B, H, D)
+    return {
+        "k": pools["k"].at[:, block_ids].set(kb),
+        "v": pools["v"].at[:, block_ids].set(vb),
+    }
+
+
+def gather_kv(
+    pools: dict[str, jax.Array],
+    block_tables: jax.Array,  # [S, max_blocks_per_seq]
+    layer: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Reference (non-Pallas) path: materialize each slot's cache view
+    ``[S, capacity, Hkv, Dh]`` for one layer. The Pallas fast path
+    (ops/paged_attention) reads the pool in place instead."""
+    k = pools["k"][layer][block_tables]  # [S, MB, B, H, D]
+    v = pools["v"][layer][block_tables]
+    s, mb, b, h, d = k.shape
+    return k.reshape(s, mb * b, h, d), v.reshape(s, mb * b, h, d)
+
+
+class BlockAllocator:
+    """Host-side free-list allocator over the pool's block ids.
+
+    Block 0 (scratch) is never handed out. The engine calls
+    :meth:`alloc` as sequences grow and :meth:`free` on finish/preempt;
+    fragmentation is impossible by construction (all blocks equal)."""
+
+    def __init__(self, num_blocks: int):
+        self._free = list(range(num_blocks - 1, SCRATCH_BLOCK, -1))
+        self.num_blocks = num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[list[int]]:
+        """n blocks or None (caller decides to wait/preempt) — never a
+        partial allocation."""
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if b == SCRATCH_BLOCK:
+                raise ValueError("scratch block cannot be freed")
+            self._free.append(b)

@@ -732,3 +732,44 @@ class TestTLS:
         assert paths.cert_file == "/var/run/bobrapet/tls/tls.crt"
         assert paths.key_file == "/var/run/bobrapet/tls/tls.key"
         assert TLSPaths.from_env({}) is None
+
+    def test_full_duplex_under_credits_over_tls(self, tmp_path):
+        """Concurrent SSL read (credit frames) + write (data frames) on
+        one connection: the serialized TLS socket must survive a
+        credit-paced burst without record corruption."""
+        import threading as _t
+
+        from bobrapet_tpu.dataplane import StreamHub
+
+        tls_dir = _make_ca(tmp_path, "duplex")
+        hub = StreamHub(tls=tls_dir)
+        hub.start()
+        try:
+            settings = {
+                "flowControl": {"mode": "credits",
+                                "initialCredits": {"messages": 4},
+                                "ackEvery": {"messages": 1}},
+                "backpressure": {"buffer": {"maxMessages": 8}},
+            }
+            received = []
+            done = _t.Event()
+            c = StreamConsumer(hub.endpoint, "ns/r/duplex",
+                               settings=settings, decode_json=True,
+                               tls=tls_dir)
+
+            def drain():
+                for m in c:
+                    received.append(m)
+                done.set()
+
+            _t.Thread(target=drain, daemon=True).start()
+            p = StreamProducer(hub.endpoint, "ns/r/duplex",
+                               settings=settings, tls=tls_dir)
+            n = 200
+            for i in range(n):
+                p.send({"i": i}, timeout=10.0)
+            p.close()
+            assert done.wait(30)
+            assert [m["i"] for m in received] == list(range(n))
+        finally:
+            hub.stop()
