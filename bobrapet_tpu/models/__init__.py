@@ -12,8 +12,11 @@ from .llama import (
     llama_tiny,
 )
 from .moe import MoEConfig, mixtral_8x7b, moe_tiny
+from .speculative import SpecResult, speculative_generate
 
 __all__ = [
+    "SpecResult",
+    "speculative_generate",
     "LlamaConfig",
     "MoEConfig",
     "embedder",

@@ -1,0 +1,179 @@
+"""Speculative decoding: draft-model proposals, target-model verify.
+
+Greedy decode is HBM-bandwidth-bound — each token re-reads every target
+weight byte. A small draft model proposes ``k`` tokens per round and
+the target verifies all of them in ONE forward pass, so accepted
+proposals amortize the target's weight traffic over multiple tokens.
+With greedy acceptance the output is **token-identical** to running the
+target alone (the property the tests pin): a proposal is accepted only
+when it equals the target's own argmax at that position, and the first
+mismatch is replaced by the target's choice — so every committed token
+is exactly what target-only greedy would have produced.
+
+XLA-first structure: one ``lax.while_loop`` whose carry holds both
+models' caches, the committed-token buffer, and cursors; every round
+runs a fixed-shape draft scan (k steps) and a fixed-shape target verify
+forward (k+1 tokens). The variable acceptance count only moves cursors
+(dynamic slices), never shapes. Rewind is free: caches are rewound by
+moving the cursor back — stale entries beyond it are masked out by the
+valid-length attention mask.
+
+Single-sequence (batch 1): the serving engine batches across requests;
+speculation accelerates within a sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .llama import LlamaConfig, forward, init_cache
+
+
+class SpecResult(NamedTuple):
+    tokens: jax.Array        # [max_new_tokens] committed tokens
+    rounds: jax.Array        # verify rounds executed
+    drafted: jax.Array       # proposals made
+    accepted: jax.Array      # proposals accepted
+
+
+def _set_cursor(cache, value):
+    return [
+        {"k": c["k"], "v": c["v"], "cursor": jnp.asarray(value, jnp.int32)}
+        for c in cache
+    ]
+
+
+def speculative_generate(
+    target_params: dict[str, Any],
+    draft_params: dict[str, Any],
+    prompt: jax.Array,  # [1, P]
+    cfg: LlamaConfig,
+    draft_cfg: LlamaConfig,
+    max_new_tokens: int = 32,
+    k: int = 4,
+    cache_capacity: int | None = None,
+) -> SpecResult:
+    """Greedy speculative decode (see module docstring)."""
+    b, prompt_len = prompt.shape
+    if b != 1:
+        raise ValueError("speculative_generate is single-sequence (batch 1)")
+    # like greedy_generate: never exceed the RoPE table — out-of-range
+    # positions would CLAMP in the freqs gather under jit, silently
+    # breaking the token-identity guarantee instead of erroring
+    cap = cache_capacity or min(
+        min(cfg.max_seq_len, draft_cfg.max_seq_len),
+        prompt_len + max_new_tokens + k + 1,
+    )
+    if prompt_len + max_new_tokens + k + 1 > cap:
+        raise ValueError(
+            f"prompt({prompt_len}) + new({max_new_tokens}) + k+1({k + 1}) "
+            f"exceeds capacity {cap} (bounded by max_seq_len)"
+        )
+
+    # --- prefill both models; commit the target's first token ---------
+    positions = jnp.arange(prompt_len)[None, :]
+    t_cache = init_cache(cfg, 1, cap)
+    t_logits, t_cache = forward(target_params, prompt, cfg, cache=t_cache,
+                                positions=positions)
+    first = jnp.argmax(t_logits[0, -1]).astype(jnp.int32)
+
+    d_cache = init_cache(draft_cfg, 1, cap)
+    _, d_cache = forward(draft_params, prompt, draft_cfg, cache=d_cache,
+                         positions=positions)
+
+    out = jnp.zeros((max_new_tokens,), jnp.int32)
+    out = out.at[0].set(first)
+
+    # carry: (t_cache, d_cache, out, n_out, n_ctx, rounds, drafted, accepted)
+    # n_ctx = committed tokens IN the target cache (prompt + accepted);
+    # the last committed token is NOT yet in either cache — it is fed
+    # at the start of the next round (the standard lag-one invariant)
+    init = (t_cache, d_cache, out, jnp.asarray(1, jnp.int32),
+            jnp.asarray(prompt_len, jnp.int32),
+            jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+            jnp.asarray(0, jnp.int32))
+
+    def cond(carry):
+        return carry[3] < max_new_tokens
+
+    def body(carry):
+        t_cache, d_cache, out, n_out, n_ctx, rounds, drafted, accepted = carry
+        last = jax.lax.dynamic_index_in_dim(out, n_out - 1, keepdims=False)
+
+        # --- draft: ingest `last`, then propose k greedy tokens -------
+        d_cache = _set_cursor(d_cache, n_ctx)
+
+        def d_step(c, _x):
+            cache, tok = c  # the carry threads the real token chain
+            lg, cache = forward(
+                draft_params, tok[None, None], draft_cfg, cache=cache,
+                positions=cache[0]["cursor"][None, None],
+            )
+            nxt = jnp.argmax(lg[0, -1]).astype(jnp.int32)
+            return (cache, nxt), nxt
+
+        (d_cache, _), proposals = jax.lax.scan(
+            d_step, (d_cache, last), jnp.zeros((k,), jnp.int32),
+        )
+        # scan fed `last` then each proposal: proposals[i] is the draft's
+        # token after last + proposals[:i]
+        # (the scan xs are dummies; the carry threads the real token).
+        # Ingest the final proposal too: when all k are accepted the
+        # next round rewinds PAST it, and a stale cache entry there
+        # would degrade the next round's proposals (never correctness —
+        # the target verifies everything)
+        _, d_cache = forward(
+            draft_params, proposals[-1][None, None], draft_cfg,
+            cache=d_cache, positions=d_cache[0]["cursor"][None, None],
+        )
+
+        # --- target: verify last + ALL k proposals in one pass ---------
+        # (the logits at proposals[-1] supply the bonus token when
+        # every proposal is accepted)
+        t_cache = _set_cursor(t_cache, n_ctx)
+        verify_tokens = jnp.concatenate([last[None], proposals])[None, :]  # [1, k+1]
+        v_positions = n_ctx + jnp.arange(k + 1)[None, :]
+        v_logits, t_cache = forward(target_params, verify_tokens, cfg,
+                                    cache=t_cache, positions=v_positions)
+        target_next = jnp.argmax(v_logits[0], axis=-1).astype(jnp.int32)  # [k+1]
+        # target_next[i] = target's token after last+proposals[:i]
+
+        # longest prefix where proposal matches the target's own choice
+        matches = proposals == target_next[:k]
+        m = jnp.sum(jnp.cumprod(matches.astype(jnp.int32))).astype(jnp.int32)
+        # committed this round: proposals[:m] + the target's correction
+        budget = max_new_tokens - n_out
+        commit = jnp.minimum(m + 1, budget)
+        round_tokens = jnp.concatenate([
+            proposals, target_next[k][None],
+        ])  # [k+1]; positions < m hold accepted proposals, m holds y_{m+1}
+        round_tokens = jnp.where(
+            jnp.arange(k + 1) == m, target_next[m], round_tokens
+        )
+
+        def write(i, o):
+            return jax.lax.cond(
+                i < commit,
+                lambda oo: jax.lax.dynamic_update_index_in_dim(
+                    oo, round_tokens[i], n_out + i, axis=0),
+                lambda oo: oo,
+                o,
+            )
+
+        out = jax.lax.fori_loop(0, k + 1, write, out)
+
+        # caches advance by the verified run (last + proposals), but the
+        # committed CONTEXT grows by the clamped commit (the extra
+        # verified tokens are rewound by cursor on the next round),
+        # preserving n_ctx == prompt + committed - 1
+        n_ctx = n_ctx + commit
+        return (t_cache, d_cache, out, n_out + commit, n_ctx,
+                rounds + 1, drafted + k, accepted + jnp.minimum(m, budget))
+
+    _, _, out, _, _, rounds, drafted, accepted = jax.lax.while_loop(
+        cond, body, init
+    )
+    return SpecResult(out, rounds, drafted, accepted)

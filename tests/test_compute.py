@@ -8,6 +8,7 @@ compile and execute exactly as they would across a slice.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import dataclasses
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -472,3 +473,69 @@ class TestInt8Quantization:
                 qp, p, cfg=cfg, max_new_tokens=4, cache_capacity=16))(
                 sharded, prompt)
         np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+
+
+class TestSpeculativeDecoding:
+    """Draft-propose + target-verify decode: greedy speculative output
+    must be TOKEN-IDENTICAL to target-only greedy — acceptance rate
+    only moves speed, never content."""
+
+    def _spec(self, target, draft, cfg, dcfg, prompt, n, k):
+        from bobrapet_tpu.models.speculative import speculative_generate
+
+        return jax.jit(
+            lambda tp, dp, p: speculative_generate(
+                tp, dp, p, cfg, dcfg, max_new_tokens=n, k=k)
+        )(target, draft, prompt)
+
+    def test_identical_to_target_greedy_with_weak_draft(self):
+        cfg = llama_tiny()
+        dcfg = llama_tiny()
+        target = init_params(jax.random.PRNGKey(0), cfg)
+        draft = init_params(jax.random.PRNGKey(7), dcfg)  # unrelated model
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 12), 0,
+                                    cfg.vocab_size)
+        want = jax.jit(lambda p, t: greedy_generate(
+            p, t, cfg=cfg, max_new_tokens=10, cache_capacity=64))(
+            target, prompt)
+
+        res = self._spec(target, draft, cfg, dcfg, prompt, 10, 4)
+        np.testing.assert_array_equal(np.asarray(res.tokens),
+                                      np.asarray(want)[0])
+        assert int(res.rounds) >= 1
+        assert int(res.drafted) == int(res.rounds) * 4
+
+    def test_perfect_draft_accepts_everything(self):
+        """Draft == target: every proposal matches, so the loop commits
+        k+1 tokens per round (the ideal acceptance ceiling)."""
+        cfg = llama_tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 8), 0,
+                                    cfg.vocab_size)
+        n, k = 12, 3
+        want = jax.jit(lambda p, t: greedy_generate(
+            p, t, cfg=cfg, max_new_tokens=n, cache_capacity=64))(
+            params, prompt)
+        res = self._spec(params, params, cfg, cfg, prompt, n, k)
+        np.testing.assert_array_equal(np.asarray(res.tokens),
+                                      np.asarray(want)[0])
+        assert int(res.accepted) == int(res.drafted)
+        # ceil((n-1)/(k+1)) rounds after the prefill-committed token
+        assert int(res.rounds) == -(-(n - 1) // (k + 1))
+
+    def test_smaller_draft_architecture(self):
+        """The draft may be a genuinely smaller model (fewer layers) —
+        outputs still match the target exactly."""
+        cfg = llama_tiny()
+        dcfg = llama_tiny()
+        dcfg = dataclasses.replace(dcfg, n_layers=1, ffn_hidden=128)
+        target = init_params(jax.random.PRNGKey(0), cfg)
+        draft = init_params(jax.random.PRNGKey(3), dcfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 9), 0,
+                                    cfg.vocab_size)
+        want = jax.jit(lambda p, t: greedy_generate(
+            p, t, cfg=cfg, max_new_tokens=7, cache_capacity=64))(
+            target, prompt)
+        res = self._spec(target, draft, cfg, dcfg, prompt, 7, 2)
+        np.testing.assert_array_equal(np.asarray(res.tokens),
+                                      np.asarray(want)[0])
