@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models import quant
-from ..models.llama import LlamaConfig, forward, init_cache
+from ..models.llama import LlamaConfig, forward
 from ..ops.rmsnorm import rmsnorm_reference
 from ..ops.rope import apply_rope, rope_frequencies
 from .paged_cache import (
@@ -48,9 +48,11 @@ from .paged_cache import (
     BlockAllocator,
     PagedConfig,
     gather_kv,
+    init_cache_seed,
     init_pools,
     write_prefill,
 )
+from .prefix_cache import PrefixCache
 
 _mm = quant.matmul
 
@@ -95,6 +97,9 @@ class ServingEngine:
         self.pcfg = pcfg or PagedConfig()
         self.pools = init_pools(cfg, self.pcfg)
         self.allocator = BlockAllocator(self.pcfg.num_blocks)
+        # all block traffic flows through the prefix cache so freed-
+        # but-still-registered blocks are lazily invalidated on reuse
+        self.blocks = PrefixCache(self.allocator, self.pcfg.block_size)
         self.pending: deque[Request] = deque()
         self.slots: list[Optional[_SlotState]] = [None] * self.pcfg.max_slots
         self.finished: list[Request] = []
@@ -109,6 +114,7 @@ class ServingEngine:
             donate_argnums=(1,),
         )
         self._prefill_fns: dict[int, Any] = {}
+        self._prefill_seed_fns: dict[int, Any] = {}
 
     # -- public API --------------------------------------------------------
 
@@ -158,17 +164,23 @@ class ServingEngine:
             if slot is not None:
                 continue
             req = self.pending[0]
-            need = self.pcfg.blocks_for(len(req.prompt) + len(req.output) + 1)
-            if need > self.pcfg.max_blocks_per_seq:
+            effective = req.prompt + req.output
+            need_total = self.pcfg.blocks_for(len(effective) + 1)
+            if need_total > self.pcfg.max_blocks_per_seq:
                 req.done = True
                 self.pending.popleft()
                 self.finished.append(req)
                 continue
-            blocks = self.allocator.alloc(need)
-            if blocks is None:
+            shared: list[int] = []
+            shared_tokens = 0
+            if self.pcfg.prefix_caching:
+                shared, shared_tokens = self.blocks.match_prefix(effective)
+            fresh = self.blocks.alloc(need_total - len(shared))
+            if fresh is None:
+                self.blocks.free(shared)
                 return  # head-of-line waits for memory
             self.pending.popleft()
-            self._prefill(i, req, blocks)
+            self._prefill(i, req, shared, shared_tokens, fresh)
 
     def _ensure_growth(self) -> None:
         """Allocate the next block for any slot whose next token would
@@ -184,7 +196,7 @@ class ServingEngine:
                 if needed_idx >= self.pcfg.max_blocks_per_seq:
                     self._retire(i)  # capacity cap reached
                     continue
-                got = self.allocator.alloc(1)
+                got = self.blocks.alloc(1)
                 while got is None:
                     victim = self._youngest(exclude=i)
                     if victim is None:
@@ -193,7 +205,7 @@ class ServingEngine:
                         self._retire(i)
                         break
                     self._preempt(victim)
-                    got = self.allocator.alloc(1)
+                    got = self.blocks.alloc(1)
                 if self.slots[i] is not None and got:
                     slot.blocks.extend(got)
 
@@ -212,8 +224,10 @@ class ServingEngine:
         req.preemptions += 1
         # recompute strategy: blocks are freed NOW; on readmission the
         # prefill recomputes over prompt + already-generated output (the
-        # request keeps its history — only the cache is sacrificed)
-        self.allocator.free(slot.blocks)
+        # request keeps its history — only the cache is sacrificed).
+        # Shared prefix blocks survive in the cache registry, so the
+        # recompute usually re-matches them for free.
+        self.blocks.free(slot.blocks)
         self.slots[slot_idx] = None
         self.pending.appendleft(req)
 
@@ -221,45 +235,76 @@ class ServingEngine:
         slot = self.slots[slot_idx]
         assert slot is not None
         slot.request.done = True
-        self.allocator.free(slot.blocks)
+        self.blocks.free(slot.blocks)
         self.finished.append(slot.request)
         self.slots[slot_idx] = None
 
     # -- compute -----------------------------------------------------------
 
-    def _prefill(self, slot_idx: int, req: Request, blocks: list[int]) -> None:
+    def _prefill(self, slot_idx: int, req: Request, shared: list[int],
+                 shared_tokens: int, fresh: list[int]) -> None:
         # a preempted request resumes by prefilling prompt + its own
-        # prior output (recompute strategy)
+        # prior output (recompute strategy); a matched prefix skips
+        # straight to the uncached suffix
         effective = req.prompt + req.output
         p = len(effective)
-        bucket = min(_bucket(p), self.pcfg.capacity)
-        n_blocks = bucket // self.pcfg.block_size
-        while len(blocks) < n_blocks:
-            more = self.allocator.alloc(1)
+        suffix = effective[shared_tokens:]
+        sp = len(suffix)
+        bucket = min(_bucket(sp), self.pcfg.capacity)
+        n_sfx_blocks = bucket // self.pcfg.block_size
+        while len(fresh) < n_sfx_blocks:
+            more = self.blocks.alloc(1)
             if more is None:
-                # not enough for the padded bucket: give the blocks back
+                # not enough for the padded bucket: give everything back
                 # and let the request wait at the head of the queue
-                self.allocator.free(blocks)
+                self.blocks.free(shared + fresh)
                 self.pending.appendleft(req)
                 return
-            blocks.extend(more)
-        fn = self._prefill_fns.get(bucket)
-        if fn is None:
-            fn = jax.jit(
-                functools.partial(_prefill_bucket, cfg=self.cfg,
-                                  bucket=bucket),
-                donate_argnums=(1,),
-            )
-            self._prefill_fns[bucket] = fn
-        prompt = jnp.asarray(
-            effective + [0] * (bucket - p), jnp.int32
+            fresh.extend(more)
+        suffix_tokens = jnp.asarray(
+            suffix + [0] * (bucket - sp), jnp.int32
         )[None, :]
-        self.pools, logits = fn(
-            self.params, self.pools, prompt,
-            jnp.asarray(blocks[:n_blocks], jnp.int32),
-        )
-        tok = self._sample_host(logits[0, p - 1], req, slot_idx)
-        self.slots[slot_idx] = _SlotState(req, blocks, p + 1)
+        if shared:
+            fn = self._prefill_seed_fns.get(bucket)
+            if fn is None:
+                fn = jax.jit(
+                    functools.partial(_prefill_bucket, cfg=self.cfg,
+                                      pcfg=self.pcfg, bucket=bucket),
+                    donate_argnums=(1,),
+                )
+                self._prefill_seed_fns[bucket] = fn
+            import numpy as np
+
+            prefix_table = np.full((self.pcfg.max_blocks_per_seq,),
+                                   SCRATCH_BLOCK, np.int32)
+            prefix_table[:len(shared)] = shared
+            self.pools, logits = fn(
+                self.params, self.pools, suffix_tokens,
+                jnp.asarray(prefix_table),
+                jnp.asarray(shared_tokens, jnp.int32),
+                jnp.asarray(fresh[:n_sfx_blocks], jnp.int32),
+            )
+        else:
+            # hot path without a cache hit: the plain bucket-sized
+            # graph — no prefix-capacity gather/attention overhead
+            fn = self._prefill_fns.get(bucket)
+            if fn is None:
+                fn = jax.jit(
+                    functools.partial(_prefill_plain, cfg=self.cfg,
+                                      bucket=bucket),
+                    donate_argnums=(1,),
+                )
+                self._prefill_fns[bucket] = fn
+            self.pools, logits = fn(
+                self.params, self.pools, suffix_tokens,
+                jnp.asarray(fresh[:n_sfx_blocks], jnp.int32),
+            )
+        tok = self._sample_host(logits[0, sp - 1], req, slot_idx)
+        table = shared + fresh
+        if self.pcfg.prefix_caching:
+            self.blocks.register(effective, table)
+            self.blocks.record_stats(p, shared_tokens)
+        self.slots[slot_idx] = _SlotState(req, table, p + 1)
         self._record(slot_idx, req, tok)
 
     def _decode_once(self) -> list[int]:
@@ -329,18 +374,45 @@ class ServingEngine:
 # ---------------------------------------------------------------------------
 
 
-def _prefill_bucket(params, pools, prompt, block_ids, *, cfg: LlamaConfig,
-                    bucket: int):
-    """Full forward over the padded prompt; contiguous K/V lands in the
-    sequence's blocks. Reuses the model's contiguous-cache forward (the
-    single compiled graph per bucket)."""
+def _prefill_plain(params, pools, tokens, block_ids, *, cfg: LlamaConfig,
+                   bucket: int):
+    """Full-prompt prefill without a shared prefix: contiguous cache of
+    exactly bucket capacity (the pre-prefix-caching hot path)."""
+    from ..models.llama import init_cache
+
     cache = init_cache(cfg, 1, bucket)
     positions = jnp.arange(bucket)[None, :]
-    logits, cache = forward(params, prompt, cfg, cache=cache,
+    logits, cache = forward(params, tokens, cfg, cache=cache,
                             positions=positions)
-    k = jnp.stack([c["k"][0] for c in cache])  # [L, bucket, Hkv, Dh]
+    k = jnp.stack([c["k"][0] for c in cache])
     v = jnp.stack([c["v"][0] for c in cache])
     pools = write_prefill(pools, k, v, block_ids)
+    return pools, logits
+
+
+def _prefill_bucket(params, pools, suffix_tokens, prefix_table, prefix_len,
+                    suffix_blocks, *, cfg: LlamaConfig, pcfg: PagedConfig,
+                    bucket: int):
+    """Suffix forward against a prefix-seeded contiguous cache; the
+    suffix's K/V lands in the sequence's fresh blocks. With an empty
+    prefix (prefix_len 0, scratch-padded table) this degenerates to the
+    plain full-prompt prefill — one compiled graph per suffix bucket
+    either way."""
+    cache = init_cache_seed(pools, prefix_table, prefix_len, extra=bucket)
+    positions = prefix_len + jnp.arange(bucket)[None, :]
+    logits, cache = forward(params, suffix_tokens, cfg, cache=cache,
+                            positions=positions)
+    # suffix K/V occupies [prefix_len, prefix_len + bucket) in the
+    # contiguous cache (block-aligned: shared prefixes are whole blocks)
+    k = jnp.stack([
+        jax.lax.dynamic_slice_in_dim(c["k"][0], prefix_len, bucket, axis=0)
+        for c in cache
+    ])  # [L, bucket, Hkv, Dh]
+    v = jnp.stack([
+        jax.lax.dynamic_slice_in_dim(c["v"][0], prefix_len, bucket, axis=0)
+        for c in cache
+    ])
+    pools = write_prefill(pools, k, v, suffix_blocks)
     return pools, logits
 
 

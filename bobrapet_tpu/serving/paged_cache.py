@@ -42,6 +42,8 @@ class PagedConfig:
     block_size: int = 16        # tokens per KV block
     num_blocks: int = 256       # pool size (incl. the scratch block)
     max_blocks_per_seq: int = 32
+    #: content-addressed reuse of full prompt blocks (prefix_cache.py)
+    prefix_caching: bool = True
 
     @property
     def capacity(self) -> int:
@@ -97,6 +99,36 @@ def write_prefill(
     }
 
 
+def init_cache_seed(
+    pools: dict[str, jax.Array],
+    prefix_table: jax.Array,  # [MB] block ids (scratch-padded)
+    prefix_len,               # traced token count actually valid
+    extra: int,               # contiguous room after the prefix (static)
+) -> list[dict[str, jax.Array]]:
+    """Contiguous model cache pre-seeded with a shared prefix's KV.
+
+    The suffix prefill runs the normal model forward against this
+    cache: gathered prefix blocks occupy positions [0, MB*block) with
+    only [0, prefix_len) valid (cursor + attention masking hide the
+    scratch-padded rest), and the forward writes the suffix starting at
+    ``cursor == prefix_len``.
+    """
+    L, _, B, H, D = pools["k"].shape
+    mb = prefix_table.shape[0]
+    cap = mb * B + extra
+    kpre = pools["k"][:, prefix_table].reshape(L, mb * B, H, D)
+    vpre = pools["v"][:, prefix_table].reshape(L, mb * B, H, D)
+    cursor = jnp.asarray(prefix_len, jnp.int32)
+    return [
+        {
+            "k": jnp.zeros((1, cap, H, D), pools["k"].dtype).at[0, :mb * B].set(kpre[layer]),
+            "v": jnp.zeros((1, cap, H, D), pools["v"].dtype).at[0, :mb * B].set(vpre[layer]),
+            "cursor": cursor,
+        }
+        for layer in range(L)
+    ]
+
+
 def gather_kv(
     pools: dict[str, jax.Array],
     block_tables: jax.Array,  # [S, max_blocks_per_seq]
@@ -139,3 +171,12 @@ class BlockAllocator:
             if b == SCRATCH_BLOCK:
                 raise ValueError("scratch block cannot be freed")
             self._free.append(b)
+
+    def reserve(self, block: int) -> bool:
+        """Pull a SPECIFIC block out of the free list (prefix-cache
+        reuse of a still-registered freed block)."""
+        try:
+            self._free.remove(block)
+        except ValueError:
+            return False
+        return True

@@ -1,0 +1,133 @@
+"""Prefix caching: content-addressed sharing of full KV blocks.
+
+Requests that share a prompt prefix (system prompts, few-shot headers,
+conversation history) should not recompute or re-store its KV. Blocks
+are content-addressed by a **chain hash** — ``H(parent_chain, block
+tokens)`` — so a block's identity pins its entire left context, and two
+requests match exactly when their token prefixes match block-for-block.
+
+Sharing is **zero-copy**: a matched block's id goes straight into the
+new request's block table. Prefix blocks are read-only by construction
+(decode writes only at positions >= the request's own prompt length,
+which land in the request's fresh suffix blocks), so no copy-on-write
+machinery is needed.
+
+Lifetime: a refcount per shared block counts live users. At zero the
+block returns to the underlying allocator's free list **with its hash
+registration retained** — it stays matchable until the allocator hands
+it out again for new content (lazy invalidation). This keeps the
+allocator's free-block accounting exact while giving an LRU-ish reuse
+window for free.
+
+Only FULL blocks are ever shared, and a matching request always keeps
+at least its final token out of the match (the sampler needs logits
+for it), so a non-empty suffix prefill is guaranteed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+from .paged_cache import BlockAllocator
+
+
+def _chain_hash(parent: bytes, tokens: list[int]) -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(parent)
+    h.update(b",".join(str(t).encode() for t in tokens))
+    return h.digest()
+
+
+ROOT = b"root"
+
+
+class PrefixCache:
+    """Wraps a :class:`BlockAllocator` with content-addressed reuse.
+
+    All allocation/free traffic must flow through this wrapper so lazy
+    invalidation sees every reallocation.
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        self.allocator = allocator
+        self.block_size = block_size
+        self._by_hash: dict[bytes, int] = {}
+        self._hash_of: dict[int, bytes] = {}
+        self._refs: dict[int, int] = {}
+        self.hit_tokens = 0
+        self.miss_tokens = 0
+
+    # -- allocation (invalidating) ----------------------------------------
+
+    def alloc(self, n: int) -> Optional[list[int]]:
+        blocks = self.allocator.alloc(n)
+        if blocks is None:
+            return None
+        for b in blocks:
+            self._invalidate(b)
+            self._refs[b] = 1
+        return blocks
+
+    def free(self, blocks: list[int]) -> None:
+        """Release one user's claim; blocks at refcount 0 return to the
+        free list (hash registration retained — lazy invalidation)."""
+        for b in blocks:
+            refs = self._refs.get(b, 1) - 1
+            if refs > 0:
+                self._refs[b] = refs
+                continue
+            self._refs.pop(b, None)
+            self.allocator.free([b])
+
+    def _invalidate(self, block: int) -> None:
+        h = self._hash_of.pop(block, None)
+        if h is not None and self._by_hash.get(h) == block:
+            del self._by_hash[h]
+
+    # -- content addressing ------------------------------------------------
+
+    def register(self, tokens: list[int], blocks: list[int]) -> None:
+        """Record the chain hashes of every FULL block of ``tokens``
+        stored in ``blocks`` (block i holds tokens[i*B:(i+1)*B])."""
+        b = self.block_size
+        parent = ROOT
+        for i in range(len(tokens) // b):
+            if i >= len(blocks):
+                break
+            parent = _chain_hash(parent, tokens[i * b:(i + 1) * b])
+            blk = blocks[i]
+            self._invalidate(blk)  # re-registration moves the hash
+            self._by_hash[parent] = blk
+            self._hash_of[blk] = parent
+
+    def match_prefix(self, tokens: list[int]) -> tuple[list[int], int]:
+        """Longest reusable block chain for ``tokens``; claims a
+        reference on every matched block. Returns (block_ids,
+        matched_token_count); the final token is never matched."""
+        b = self.block_size
+        limit = (len(tokens) - 1) // b  # keep >= 1 token for the suffix
+        parent = ROOT
+        matched: list[int] = []
+        for i in range(limit):
+            parent = _chain_hash(parent, tokens[i * b:(i + 1) * b])
+            blk = self._by_hash.get(parent)
+            if blk is None:
+                break
+            if blk in self._refs:
+                self._refs[blk] += 1
+            else:
+                # free-listed but still registered: reserve it back
+                if not self.allocator.reserve(blk):
+                    self._invalidate(blk)
+                    break
+                self._refs[blk] = 1
+            matched.append(blk)
+        # stats are recorded by the caller AFTER admission commits — a
+        # refunded match (allocation failure, retry next tick) must not
+        # inflate the hit rate
+        return matched, len(matched) * b
+
+    def record_stats(self, total_tokens: int, hit: int) -> None:
+        self.hit_tokens += hit
+        self.miss_tokens += total_tokens - hit

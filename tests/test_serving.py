@@ -162,3 +162,102 @@ class TestEngineCorrectness:
         a, b = run_once(), run_once()
         assert a == b  # per-request keys + per-step fold = replayable
         assert len(a) == 6
+
+
+class TestPrefixCaching:
+    """Content-addressed prompt-prefix sharing: matched full blocks go
+    straight into the new request's block table (zero copy), prefill
+    computes only the uncached suffix, and outputs stay exact."""
+
+    def test_shared_prefix_is_reused_and_exact(self, model):
+        cfg, params = model
+        rng = np.random.default_rng(10)
+        system = rng.integers(0, cfg.vocab_size, 16).tolist()  # 2 full blocks
+        a = system + rng.integers(0, cfg.vocab_size, 5).tolist()
+        b = system + rng.integers(0, cfg.vocab_size, 9).tolist()
+        want_a = _reference_tokens(params, cfg, a, 6)
+        want_b = _reference_tokens(params, cfg, b, 6)
+
+        eng = ServingEngine(params, cfg, PagedConfig(
+            max_slots=2, block_size=8, num_blocks=32, max_blocks_per_seq=6))
+        ra = eng.submit(a, max_new_tokens=6)
+        done = {r.rid: r for r in eng.run()}
+        assert done[ra].output == want_a
+        hits_before = eng.blocks.hit_tokens
+
+        rb = eng.submit(b, max_new_tokens=6)
+        done = {r.rid: r for r in eng.run()}
+        assert done[rb].output == want_b
+        # the 16-token system prompt was served from cache
+        assert eng.blocks.hit_tokens - hits_before == 16
+
+    def test_concurrent_sharers_protect_blocks(self, model):
+        """Two live requests share prefix blocks; the first finishing
+        must not free them out from under the second."""
+        cfg, params = model
+        rng = np.random.default_rng(11)
+        system = rng.integers(0, cfg.vocab_size, 16).tolist()
+        a = system + rng.integers(0, cfg.vocab_size, 3).tolist()
+        b = system + rng.integers(0, cfg.vocab_size, 4).tolist()
+        want_a = _reference_tokens(params, cfg, a, 3)
+        want_b = _reference_tokens(params, cfg, b, 12)
+
+        eng = ServingEngine(params, cfg, PagedConfig(
+            max_slots=2, block_size=8, num_blocks=32, max_blocks_per_seq=6))
+        ra = eng.submit(a, max_new_tokens=3)   # finishes early
+        rb = eng.submit(b, max_new_tokens=12)  # keeps using the prefix
+        done = {r.rid: r for r in eng.run()}
+        assert done[ra].output == want_a
+        assert done[rb].output == want_b
+        assert eng.allocator.free_blocks == 31  # everything reclaimed
+
+    def test_freed_prefix_survives_until_reallocated(self, model):
+        """Lazy invalidation: after ALL users finish, the registered
+        blocks sit in the free list and are still matchable — until the
+        allocator hands them out for new content."""
+        cfg, params = model
+        rng = np.random.default_rng(12)
+        system = rng.integers(0, cfg.vocab_size, 16).tolist()
+        eng = ServingEngine(params, cfg, PagedConfig(
+            max_slots=2, block_size=8, num_blocks=32, max_blocks_per_seq=6))
+        eng.submit(system + [1, 2, 3], max_new_tokens=2)
+        eng.run()
+        assert eng.allocator.free_blocks == 31
+
+        hits_before = eng.blocks.hit_tokens
+        eng.submit(system + [4, 5], max_new_tokens=2)
+        eng.run()
+        assert eng.blocks.hit_tokens - hits_before == 16
+        assert eng.allocator.free_blocks == 31
+
+    def test_disabled_prefix_caching_never_matches(self, model):
+        cfg, params = model
+        rng = np.random.default_rng(13)
+        system = rng.integers(0, cfg.vocab_size, 16).tolist()
+        eng = ServingEngine(params, cfg, PagedConfig(
+            max_slots=2, block_size=8, num_blocks=32, max_blocks_per_seq=6,
+            prefix_caching=False))
+        want = _reference_tokens(params, cfg, system + [7], 4)
+        eng.submit(system + [7], max_new_tokens=4)
+        eng.run()
+        eng.submit(system + [7], max_new_tokens=4)
+        done = eng.run()
+        assert done[-1].output == want
+        assert eng.blocks.hit_tokens == 0
+
+    def test_mismatched_prefix_does_not_match(self, model):
+        cfg, params = model
+        rng = np.random.default_rng(14)
+        a = rng.integers(0, cfg.vocab_size, 20).tolist()
+        b = list(a)
+        b[3] = (b[3] + 1) % cfg.vocab_size  # diverges inside block 0
+        eng = ServingEngine(params, cfg, PagedConfig(
+            max_slots=2, block_size=8, num_blocks=32, max_blocks_per_seq=6))
+        want_b = _reference_tokens(params, cfg, b, 4)
+        eng.submit(a, max_new_tokens=4)
+        eng.run()
+        hits = eng.blocks.hit_tokens
+        rb = eng.submit(b, max_new_tokens=4)
+        done = {r.rid: r for r in eng.run()}
+        assert done[rb].output == want_b
+        assert eng.blocks.hit_tokens == hits  # no false sharing
