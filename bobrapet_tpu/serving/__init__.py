@@ -4,5 +4,8 @@ run."""
 
 from .engine import Request, ServingEngine
 from .paged_cache import BlockAllocator, PagedConfig
+from .prefix_cache import PrefixCache
+from .service import StreamServer
 
-__all__ = ["BlockAllocator", "PagedConfig", "Request", "ServingEngine"]
+__all__ = ["BlockAllocator", "PagedConfig", "PrefixCache", "Request",
+           "ServingEngine", "StreamServer"]

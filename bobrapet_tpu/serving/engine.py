@@ -121,6 +121,9 @@ class ServingEngine:
     def submit(self, prompt: list[int], max_new_tokens: int,
                temperature: float = 0.0,
                eos_token: Optional[int] = None) -> int:
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1 (the prefill "
+                             "always samples one token)")
         if len(prompt) + max_new_tokens > self.pcfg.capacity:
             raise ValueError(
                 f"prompt+new ({len(prompt)}+{max_new_tokens}) exceeds slot "
@@ -148,13 +151,21 @@ class ServingEngine:
     # -- scheduler ---------------------------------------------------------
 
     def step(self) -> list[int]:
-        """One engine tick: admit -> grow/preempt -> fused decode ->
-        retire. Returns rids that finished this tick."""
+        """One engine tick: admit -> retire-finished -> grow/preempt ->
+        fused decode -> retire. Returns rids that finished this tick."""
         self._admit()
+        # a request can finish ON its prefill token (max_new_tokens=1,
+        # or eos as the first sample) — decoding it once more would
+        # leak a token past its budget
+        done: list[int] = []
+        for i, slot in enumerate(self.slots):
+            if slot is not None and slot.request.done:
+                done.append(slot.request.rid)
+                self._retire(i)
         self._ensure_growth()
         if not any(self.slots):
-            return []
-        done = self._decode_once()
+            return done
+        done.extend(self._decode_once())
         return done
 
     def _admit(self) -> None:
@@ -250,7 +261,10 @@ class ServingEngine:
         p = len(effective)
         suffix = effective[shared_tokens:]
         sp = len(suffix)
-        bucket = min(_bucket(sp), self.pcfg.capacity)
+        # bucket within what the block table can still hold: capacity
+        # minus the matched prefix (shared + fresh must fit
+        # max_blocks_per_seq)
+        bucket = min(_bucket(sp), self.pcfg.capacity - shared_tokens)
         n_sfx_blocks = bucket // self.pcfg.block_size
         while len(fresh) < n_sfx_blocks:
             more = self.blocks.alloc(1)
@@ -265,18 +279,27 @@ class ServingEngine:
             suffix + [0] * (bucket - sp), jnp.int32
         )[None, :]
         if shared:
-            fn = self._prefill_seed_fns.get(bucket)
+            # the seed graph's attention cost scales with its prefix
+            # region, so size that region to a power-of-two BLOCK
+            # bucket of the actual match (compilations bounded by
+            # log2(max_blocks) x log2(capacity); a 1-block hit no
+            # longer pays full-capacity attention)
+            prefix_bucket = 1
+            while prefix_bucket < len(shared):
+                prefix_bucket *= 2
+            prefix_bucket = min(prefix_bucket, self.pcfg.max_blocks_per_seq)
+            key = (bucket, prefix_bucket)
+            fn = self._prefill_seed_fns.get(key)
             if fn is None:
                 fn = jax.jit(
                     functools.partial(_prefill_bucket, cfg=self.cfg,
                                       pcfg=self.pcfg, bucket=bucket),
                     donate_argnums=(1,),
                 )
-                self._prefill_seed_fns[bucket] = fn
+                self._prefill_seed_fns[key] = fn
             import numpy as np
 
-            prefix_table = np.full((self.pcfg.max_blocks_per_seq,),
-                                   SCRATCH_BLOCK, np.int32)
+            prefix_table = np.full((prefix_bucket,), SCRATCH_BLOCK, np.int32)
             prefix_table[:len(shared)] = shared
             self.pools, logits = fn(
                 self.params, self.pools, suffix_tokens,

@@ -1,0 +1,121 @@
+"""StreamServer: the serving engine behind the realtime data plane.
+
+The flagship end-to-end path: a streaming Story's generate step runs
+this loop — prompts arrive on the step's input stream (hub or P2P,
+negotiated settings enforced by the data plane), flow through the
+continuous-batching engine, and completions leave on the downstream
+stream. Requests batch across *stream messages*: a prompt that arrives
+mid-decode joins the next engine tick without waiting for the batch to
+drain (the whole point of continuous batching).
+
+Threading: the engine is single-threaded by design; the consumer thread
+only parks raw messages on a queue, and the serve loop alone touches
+the engine. EOS on the input stream drains in-flight requests, emits
+their completions, then closes downstream.
+
+Wire shapes (JSON over the stream frames):
+
+    in:  {"id": <any>, "prompt": [int], "maxNewTokens": int,
+          "temperature"?: float, "eos"?: int}
+    out: {"id": <any>, "tokens": [int], "preemptions": int}
+    err: {"id": <any>, "error": str}
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Any, Optional
+
+from .engine import ServingEngine
+
+_log = logging.getLogger(__name__)
+
+
+class StreamServer:
+    def __init__(self, engine: ServingEngine, consumer, producer,
+                 idle_wait_s: float = 0.01):
+        self.engine = engine
+        self.consumer = consumer
+        self.producer = producer
+        self.idle_wait_s = idle_wait_s
+        self._inbox: "queue.Queue[Optional[dict[str, Any]]]" = queue.Queue()
+        self._rid_to_id: dict[int, Any] = {}
+        self.served = 0
+
+    # -- consumption (thread) ---------------------------------------------
+
+    def _consume(self) -> None:
+        try:
+            for msg in self.consumer:
+                self._inbox.put(msg)
+        except Exception as e:  # noqa: BLE001 - stream died; drain + stop
+            _log.warning("serving input stream failed: %s", e)
+        finally:
+            self._inbox.put(None)  # input EOS sentinel
+
+    def _admit_from_inbox(self, block: bool) -> bool:
+        """Move queued messages into the engine; returns False once the
+        input stream has ended."""
+        while True:
+            try:
+                msg = self._inbox.get(
+                    timeout=self.idle_wait_s if block else 0.0
+                )
+            except queue.Empty:
+                return True
+            if msg is None:
+                return False
+            block = False  # only ever block for the first message
+            try:
+                raw_max = msg.get("maxNewTokens")
+                rid = self.engine.submit(
+                    [int(t) for t in msg["prompt"]],
+                    # sentinel, not `or`: an explicit 0 must reach the
+                    # engine's validation, not silently become 32
+                    max_new_tokens=32 if raw_max is None else int(raw_max),
+                    temperature=float(msg.get("temperature") or 0.0),
+                    eos_token=(int(msg["eos"]) if msg.get("eos") is not None
+                               else None),
+                )
+                self._rid_to_id[rid] = msg.get("id")
+            except (KeyError, TypeError, ValueError) as e:
+                # a malformed request answers in-band; the batch lives on
+                self.producer.send({"id": msg.get("id"), "error": str(e)})
+
+    # -- serve loop --------------------------------------------------------
+
+    def run(self) -> int:
+        """Serve until input EOS and every in-flight request finishes;
+        returns the number of completions emitted."""
+        t = threading.Thread(target=self._consume, daemon=True,
+                             name="serving-consume")
+        t.start()
+        emitted = 0  # finished[] index already sent downstream
+        open_input = True
+        while True:
+            if open_input:
+                # block briefly only when the engine would otherwise
+                # spin empty — a busy engine polls without waiting
+                idle = self.engine.active_slots == 0 and not self.engine.pending
+                open_input = self._admit_from_inbox(block=idle)
+            # busy is judged AFTER admission: a request admitted in the
+            # same tick that closed the input must still be served
+            busy = self.engine.active_slots > 0 or bool(self.engine.pending)
+            if (not open_input and not busy
+                    and emitted == len(self.engine.finished)):
+                break
+            self.engine.step()
+            # emit every newly finished request, in completion order
+            while emitted < len(self.engine.finished):
+                req = self.engine.finished[emitted]
+                emitted += 1
+                self.producer.send({
+                    "id": self._rid_to_id.pop(req.rid, None),
+                    "tokens": list(req.output),
+                    "preemptions": req.preemptions,
+                })
+                self.served += 1
+        self.producer.close()
+        return self.served

@@ -261,3 +261,164 @@ class TestPrefixCaching:
         done = {r.rid: r for r in eng.run()}
         assert done[rb].output == want_b
         assert eng.blocks.hit_tokens == hits  # no false sharing
+
+
+class TestStreamServer:
+    """The serving engine behind the real data plane: prompts in on a
+    hub stream, completions out downstream, batched continuously."""
+
+    def test_prompts_over_hub_served_exactly(self, model):
+        import threading
+
+        from bobrapet_tpu.dataplane import (
+            StreamConsumer,
+            StreamHub,
+            StreamProducer,
+        )
+        from bobrapet_tpu.serving import StreamServer
+
+        cfg, params = model
+        rng = np.random.default_rng(20)
+        prompts = [rng.integers(0, cfg.vocab_size, 6 + 3 * i).tolist()
+                   for i in range(5)]
+        wants = {i: _reference_tokens(params, cfg, p, 5)
+                 for i, p in enumerate(prompts)}
+
+        hub = StreamHub()
+        hub.start()
+        try:
+            eng = ServingEngine(params, cfg, PagedConfig(
+                max_slots=2, block_size=8, num_blocks=32,
+                max_blocks_per_seq=6))
+            server = StreamServer(
+                eng,
+                consumer=StreamConsumer(hub.endpoint, "ns/r/gen",
+                                        decode_json=True),
+                producer=StreamProducer(hub.endpoint, "ns/r/out"),
+            )
+            results = []
+            out_done = threading.Event()
+
+            def drain():
+                c = StreamConsumer(hub.endpoint, "ns/r/out",
+                                   decode_json=True)
+                for msg in c:
+                    results.append(msg)
+                out_done.set()
+
+            threading.Thread(target=drain, daemon=True).start()
+            serve_thread = threading.Thread(target=server.run, daemon=True)
+            serve_thread.start()
+
+            p = StreamProducer(hub.endpoint, "ns/r/gen")
+            for i, prompt in enumerate(prompts):
+                p.send({"id": i, "prompt": prompt, "maxNewTokens": 5})
+            p.close()
+            serve_thread.join(120)
+            assert not serve_thread.is_alive()
+            assert out_done.wait(30)
+        finally:
+            hub.stop()
+
+        assert server.served == 5
+        got = {m["id"]: m["tokens"] for m in results}
+        assert got == wants
+
+    def test_malformed_request_answers_in_band(self, model):
+        import threading
+
+        from bobrapet_tpu.dataplane import (
+            StreamConsumer,
+            StreamHub,
+            StreamProducer,
+        )
+        from bobrapet_tpu.serving import StreamServer
+
+        cfg, params = model
+        hub = StreamHub()
+        hub.start()
+        try:
+            eng = ServingEngine(params, cfg, PagedConfig(
+                max_slots=2, block_size=8, num_blocks=16,
+                max_blocks_per_seq=4))
+            server = StreamServer(
+                eng,
+                consumer=StreamConsumer(hub.endpoint, "ns/r/gen2",
+                                        decode_json=True),
+                producer=StreamProducer(hub.endpoint, "ns/r/out2"),
+            )
+            results = []
+            done = threading.Event()
+
+            def drain():
+                c = StreamConsumer(hub.endpoint, "ns/r/out2",
+                                   decode_json=True)
+                for msg in c:
+                    results.append(msg)
+                done.set()
+
+            threading.Thread(target=drain, daemon=True).start()
+            st = threading.Thread(target=server.run, daemon=True)
+            st.start()
+            p = StreamProducer(hub.endpoint, "ns/r/gen2")
+            p.send({"id": "bad"})  # no prompt
+            p.send({"id": "ok", "prompt": [1, 2, 3], "maxNewTokens": 2})
+            p.close()
+            st.join(60)
+            assert done.wait(30)
+        finally:
+            hub.stop()
+        by_id = {m["id"]: m for m in results}
+        assert "error" in by_id["bad"]
+        assert len(by_id["ok"]["tokens"]) == 2
+
+
+class TestReviewRegressions:
+    def test_budget_one_yields_exactly_one_token(self, model):
+        cfg, params = model
+        rng = np.random.default_rng(30)
+        prompt = rng.integers(0, cfg.vocab_size, 9).tolist()
+        want = _reference_tokens(params, cfg, prompt, 1)
+        eng = ServingEngine(params, cfg, PagedConfig(
+            max_slots=2, block_size=8, num_blocks=16, max_blocks_per_seq=4))
+        eng.submit(prompt, max_new_tokens=1)
+        done = eng.run()
+        assert done[0].output == want  # not one token past the budget
+
+    def test_eos_on_prefill_token_stops_immediately(self, model):
+        cfg, params = model
+        rng = np.random.default_rng(31)
+        prompt = rng.integers(0, cfg.vocab_size, 9).tolist()
+        first = _reference_tokens(params, cfg, prompt, 1)[0]
+        eng = ServingEngine(params, cfg, PagedConfig(
+            max_slots=2, block_size=8, num_blocks=16, max_blocks_per_seq=4))
+        eng.submit(prompt, max_new_tokens=8, eos_token=first)
+        done = eng.run()
+        assert done[0].output == [first]
+
+    def test_zero_budget_rejected(self, model):
+        cfg, params = model
+        eng = ServingEngine(params, cfg, PagedConfig(
+            max_slots=2, block_size=8, num_blocks=16, max_blocks_per_seq=4))
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.submit([1, 2, 3], max_new_tokens=0)
+
+    def test_long_shared_prefix_respects_block_table_width(self, model):
+        """Shared blocks + bucketed suffix must fit max_blocks_per_seq
+        (the suffix bucket is clamped by the remaining capacity)."""
+        cfg, params = model
+        rng = np.random.default_rng(32)
+        base = rng.integers(0, cfg.vocab_size, 47).tolist()  # 5 full blocks
+        want_a = _reference_tokens(params, cfg, base, 1)
+        b = base[:40] + rng.integers(0, cfg.vocab_size, 7).tolist()
+        want_b = _reference_tokens(params, cfg, b, 1)
+
+        eng = ServingEngine(params, cfg, PagedConfig(
+            max_slots=2, block_size=8, num_blocks=32, max_blocks_per_seq=6))
+        ra = eng.submit(base, max_new_tokens=1)
+        done = {r.rid: r for r in eng.run()}
+        assert done[ra].output == want_a
+        rb = eng.submit(b, max_new_tokens=1)  # shares 5 blocks (40 tokens)
+        done = {r.rid: r for r in eng.run()}
+        assert done[rb].output == want_b
+        assert eng.allocator.free_blocks == 31
