@@ -342,6 +342,25 @@ class _ControlPlaneMetrics:
         self.stream_duration = h(
             "bobravoz_stream_duration_seconds", "Stream lifetime", ["lane"]
         )
+        # Serving family (continuous-batching engine; TPU-native
+        # addition — the reference orchestrates containers and has no
+        # model serving of its own)
+        self.serving_requests = c(
+            "bobrapet_serving_requests_total", "Serving requests", ["outcome"]
+        )
+        self.serving_tokens = c(
+            "bobrapet_serving_tokens_total", "Decoded tokens", []
+        )
+        self.serving_preemptions = c(
+            "bobrapet_serving_preemptions_total", "Recompute preemptions", []
+        )
+        self.serving_active_slots = g(
+            "bobrapet_serving_active_slots", "Slots decoding right now", []
+        )
+        self.serving_prefix_tokens = c(
+            "bobrapet_serving_prefix_tokens_total",
+            "Prompt tokens by prefix-cache outcome", ["result"]
+        )
         self.binding_op_duration = h(
             "bobrapet_transport_binding_operation_duration_seconds",
             "Binding ensure/negotiation latency",

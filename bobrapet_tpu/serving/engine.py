@@ -41,6 +41,7 @@ import jax.numpy as jnp
 
 from ..models import quant
 from ..models.llama import LlamaConfig, forward
+from ..observability.metrics import metrics
 from ..ops.rmsnorm import rmsnorm_reference
 from ..ops.rope import apply_rope, rope_frequencies
 from .paged_cache import (
@@ -233,6 +234,8 @@ class ServingEngine:
         assert slot is not None
         req = slot.request
         req.preemptions += 1
+        metrics.serving_preemptions.inc()
+        metrics.serving_active_slots.set(self.active_slots - 1)
         # recompute strategy: blocks are freed NOW; on readmission the
         # prefill recomputes over prompt + already-generated output (the
         # request keeps its history — only the cache is sacrificed).
@@ -249,6 +252,9 @@ class ServingEngine:
         self.blocks.free(slot.blocks)
         self.finished.append(slot.request)
         self.slots[slot_idx] = None
+        metrics.serving_requests.inc("completed")
+        metrics.serving_tokens.inc(by=len(slot.request.output))
+        metrics.serving_active_slots.set(self.active_slots)
 
     # -- compute -----------------------------------------------------------
 
@@ -284,10 +290,8 @@ class ServingEngine:
             # bucket of the actual match (compilations bounded by
             # log2(max_blocks) x log2(capacity); a 1-block hit no
             # longer pays full-capacity attention)
-            prefix_bucket = 1
-            while prefix_bucket < len(shared):
-                prefix_bucket *= 2
-            prefix_bucket = min(prefix_bucket, self.pcfg.max_blocks_per_seq)
+            prefix_bucket = min(_bucket(len(shared), minimum=1),
+                                self.pcfg.max_blocks_per_seq)
             key = (bucket, prefix_bucket)
             fn = self._prefill_seed_fns.get(key)
             if fn is None:
@@ -327,8 +331,11 @@ class ServingEngine:
         if self.pcfg.prefix_caching:
             self.blocks.register(effective, table)
             self.blocks.record_stats(p, shared_tokens)
+            metrics.serving_prefix_tokens.inc("hit", by=shared_tokens)
+            metrics.serving_prefix_tokens.inc("miss", by=p - shared_tokens)
         self.slots[slot_idx] = _SlotState(req, table, p + 1)
         self._record(slot_idx, req, tok)
+        metrics.serving_active_slots.set(self.active_slots)
 
     def _decode_once(self) -> list[int]:
         S = self.pcfg.max_slots

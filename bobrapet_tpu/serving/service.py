@@ -33,6 +33,11 @@ from .engine import ServingEngine
 _log = logging.getLogger(__name__)
 
 
+#: input-EOS marker — a dedicated object so a client sending JSON
+#: ``null`` cannot forge end-of-stream
+_EOS = object()
+
+
 class StreamServer:
     def __init__(self, engine: ServingEngine, consumer, producer,
                  idle_wait_s: float = 0.01):
@@ -40,7 +45,7 @@ class StreamServer:
         self.consumer = consumer
         self.producer = producer
         self.idle_wait_s = idle_wait_s
-        self._inbox: "queue.Queue[Optional[dict[str, Any]]]" = queue.Queue()
+        self._inbox: "queue.Queue[Any]" = queue.Queue()
         self._rid_to_id: dict[int, Any] = {}
         self.served = 0
 
@@ -53,7 +58,7 @@ class StreamServer:
         except Exception as e:  # noqa: BLE001 - stream died; drain + stop
             _log.warning("serving input stream failed: %s", e)
         finally:
-            self._inbox.put(None)  # input EOS sentinel
+            self._inbox.put(_EOS)
 
     def _admit_from_inbox(self, block: bool) -> bool:
         """Move queued messages into the engine; returns False once the
@@ -65,9 +70,16 @@ class StreamServer:
                 )
             except queue.Empty:
                 return True
-            if msg is None:
+            if msg is _EOS:
                 return False
             block = False  # only ever block for the first message
+            if not isinstance(msg, dict):
+                # any JSON value decodes (list/str/null) — answer
+                # in-band, never crash the batch
+                self.producer.send({"id": None,
+                                    "error": f"request must be an object, "
+                                             f"got {type(msg).__name__}"})
+                continue
             try:
                 raw_max = msg.get("maxNewTokens")
                 rid = self.engine.submit(
@@ -94,6 +106,18 @@ class StreamServer:
         t.start()
         emitted = 0  # finished[] index already sent downstream
         open_input = True
+        try:
+            emitted = self._serve_loop(open_input, emitted)
+        finally:
+            # downstream consumers must see EOS even when the loop dies
+            # (a hung consumer is worse than a truncated stream error)
+            try:
+                self.producer.close()
+            except Exception:  # noqa: BLE001 - socket already gone
+                pass
+        return self.served
+
+    def _serve_loop(self, open_input: bool, emitted: int) -> int:
         while True:
             if open_input:
                 # block briefly only when the engine would otherwise
@@ -117,5 +141,4 @@ class StreamServer:
                     "preemptions": req.preemptions,
                 })
                 self.served += 1
-        self.producer.close()
-        return self.served
+        return emitted

@@ -422,3 +422,123 @@ class TestReviewRegressions:
         done = {r.rid: r for r in eng.run()}
         assert done[rb].output == want_b
         assert eng.allocator.free_blocks == 31
+
+
+class TestServingIntegration:
+    def test_tensor_parallel_int8_tree_serves(self, model):
+        """The v5e-4 8B serving shape in miniature: an int8 weight-only
+        tree sharded over the model axis drives the engine; outputs
+        match the unsharded engine exactly."""
+        from jax.sharding import Mesh
+
+        from bobrapet_tpu.parallel.sharding import shard_params
+
+        cfg, params = model
+        qp = quant.quantize_params(params)
+        rng = np.random.default_rng(40)
+        prompts = [rng.integers(0, cfg.vocab_size, 7 + i).tolist()
+                   for i in range(3)]
+        pcfg = PagedConfig(max_slots=2, block_size=8, num_blocks=32,
+                           max_blocks_per_seq=6)
+
+        ref_eng = ServingEngine(qp, cfg, pcfg)
+        ref_ids = [ref_eng.submit(p, max_new_tokens=4) for p in prompts]
+        ref = {r.rid: r.output for r in ref_eng.run()}
+
+        mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("fsdp", "model"))
+        sharded = shard_params(qp, mesh)
+        eng = ServingEngine(sharded, cfg, pcfg)
+        ids = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        got = {r.rid: r.output for r in eng.run()}
+        for a, b in zip(ref_ids, ids):
+            assert got[b] == ref[a]
+
+    def test_restore_checkpoint_then_serve(self, model):
+        """train -> sharded checkpoint -> serve: params restored through
+        the SDK checkpoint path drive the engine bit-identically."""
+        from bobrapet_tpu.sdk.checkpoint import restore_checkpoint, save_checkpoint
+        from bobrapet_tpu.storage.store import MemoryStore
+
+        cfg, params = model
+        store = MemoryStore()
+        save_checkpoint(store, "serve-ckpt", {"params": params}, step=7)
+        restored, step = restore_checkpoint(store, "serve-ckpt",
+                                            {"params": params})
+        assert step == 7
+
+        rng = np.random.default_rng(41)
+        prompt = rng.integers(0, cfg.vocab_size, 10).tolist()
+        want = _reference_tokens(params, cfg, prompt, 5)
+        eng = ServingEngine(restored["params"], cfg, PagedConfig(
+            max_slots=2, block_size=8, num_blocks=16, max_blocks_per_seq=4))
+        eng.submit(prompt, max_new_tokens=5)
+        assert eng.run()[0].output == want
+
+
+class TestServingMetrics:
+    def test_engine_emits_serving_series(self, model):
+        from bobrapet_tpu.observability.metrics import metrics
+
+        cfg, params = model
+        rng = np.random.default_rng(50)
+        system = rng.integers(0, cfg.vocab_size, 16).tolist()
+        eng = ServingEngine(params, cfg, PagedConfig(
+            max_slots=2, block_size=8, num_blocks=32, max_blocks_per_seq=6))
+        eng.submit(system + [1], max_new_tokens=3)
+        eng.submit(system + [2], max_new_tokens=3)
+        eng.run()
+        assert metrics.serving_requests.value("completed") == 2
+        assert metrics.serving_tokens.value() == 6
+        assert metrics.serving_prefix_tokens.value("hit") == 16
+        assert metrics.serving_active_slots.value() == 0
+
+    def test_null_and_nonobject_messages_dont_kill_the_server(self, model):
+        import threading
+
+        from bobrapet_tpu.dataplane import (
+            StreamConsumer,
+            StreamHub,
+            StreamProducer,
+        )
+        from bobrapet_tpu.serving import StreamServer
+
+        cfg, params = model
+        hub = StreamHub()
+        hub.start()
+        try:
+            eng = ServingEngine(params, cfg, PagedConfig(
+                max_slots=2, block_size=8, num_blocks=16,
+                max_blocks_per_seq=4))
+            server = StreamServer(
+                eng,
+                consumer=StreamConsumer(hub.endpoint, "ns/r/gen3",
+                                        decode_json=True),
+                producer=StreamProducer(hub.endpoint, "ns/r/out3"),
+            )
+            results = []
+            done = threading.Event()
+
+            def drain():
+                c = StreamConsumer(hub.endpoint, "ns/r/out3",
+                                   decode_json=True)
+                for msg in c:
+                    results.append(msg)
+                done.set()
+
+            threading.Thread(target=drain, daemon=True).start()
+            st = threading.Thread(target=server.run, daemon=True)
+            st.start()
+            p = StreamProducer(hub.endpoint, "ns/r/gen3")
+            p.send(None)          # JSON null must NOT read as input EOS
+            p.send([1, 2, 3])     # non-object answers in-band
+            p.send({"id": "ok", "prompt": [5, 6], "maxNewTokens": 2})
+            p.close()
+            st.join(60)
+            assert not st.is_alive()
+            assert done.wait(30)  # downstream ALWAYS sees a clean EOS
+        finally:
+            hub.stop()
+        errors = [m for m in results if "error" in m]
+        assert len(errors) == 2
+        ok = [m for m in results if m.get("id") == "ok"]
+        assert len(ok) == 1 and len(ok[0]["tokens"]) == 2
