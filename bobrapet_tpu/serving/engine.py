@@ -76,6 +76,11 @@ class _SlotState:
     request: Request
     blocks: list[int]
     seq_len: int  # tokens currently in the cache (prompt + generated)
+    #: chunked prefill in progress: tokens of the effective prompt
+    #: already ingested (block-aligned); None once decoding
+    ingest_pos: Optional[int] = None
+    #: prefix-cache hit size at admission (stats recorded on completion)
+    shared_tokens: int = 0
 
 
 def _bucket(n: int, minimum: int = 16) -> int:
@@ -152,9 +157,15 @@ class ServingEngine:
     # -- scheduler ---------------------------------------------------------
 
     def step(self) -> list[int]:
-        """One engine tick: admit -> retire-finished -> grow/preempt ->
-        fused decode -> retire. Returns rids that finished this tick."""
+        """One engine tick: admit -> ingest one chunk per prefilling
+        slot -> retire-finished -> grow/preempt -> fused decode ->
+        retire. Returns rids that finished this tick."""
         self._admit()
+        # chunked prefill: each ingesting slot advances ONE chunk per
+        # tick, so a long prompt never blocks the live batch's decode
+        for i, slot in enumerate(self.slots):
+            if slot is not None and slot.ingest_pos is not None:
+                self._ingest_chunk(i)
         # a request can finish ON its prefill token (max_new_tokens=1,
         # or eos as the first sample) — decoding it once more would
         # leak a token past its budget
@@ -164,7 +175,7 @@ class ServingEngine:
                 done.append(slot.request.rid)
                 self._retire(i)
         self._ensure_growth()
-        if not any(self.slots):
+        if not any(s is not None and s.ingest_pos is None for s in self.slots):
             return done
         done.extend(self._decode_once())
         return done
@@ -182,6 +193,7 @@ class ServingEngine:
                 req.done = True
                 self.pending.popleft()
                 self.finished.append(req)
+                metrics.serving_requests.inc("rejected")
                 continue
             shared: list[int] = []
             shared_tokens = 0
@@ -199,8 +211,8 @@ class ServingEngine:
         cross a block boundary; preempt the youngest slot when the pool
         is exhausted."""
         for i, slot in enumerate(self.slots):
-            if slot is None:
-                continue
+            if slot is None or slot.ingest_pos is not None:
+                continue  # ingesting slots pre-allocated their blocks
             if slot.seq_len % self.pcfg.block_size == 0:
                 needed_idx = slot.seq_len // self.pcfg.block_size
                 if needed_idx < len(slot.blocks):
@@ -258,6 +270,26 @@ class ServingEngine:
 
     # -- compute -----------------------------------------------------------
 
+    def _whole_block_bucket(self, sp: int, room: int) -> int:
+        """Static prefill width: power-of-two-ish bucket of ``sp``
+        rounded UP to whole blocks (write_prefill scatters whole
+        blocks), clamped to ``room`` (itself always block-aligned)."""
+        B = self.pcfg.block_size
+        bucket = min(_bucket(sp), room)
+        bucket = min(-(-bucket // B) * B, room)
+        return bucket
+
+    def _chunk_size(self) -> Optional[int]:
+        """Chunked-prefill unit: block-aligned AND equal to the compiled
+        bucket width (floor = the smallest block multiple >= _bucket's
+        16-token minimum), so every middle chunk advances exactly one
+        graph width — no padded re-writes, no wasted FLOPs."""
+        if self.pcfg.prefill_chunk is None:
+            return None
+        B = self.pcfg.block_size
+        floor = -(-16 // B) * B  # smallest multiple of B >= 16
+        return _bucket(self.pcfg.prefill_chunk, minimum=floor)
+
     def _prefill(self, slot_idx: int, req: Request, shared: list[int],
                  shared_tokens: int, fresh: list[int]) -> None:
         # a preempted request resumes by prefilling prompt + its own
@@ -265,12 +297,108 @@ class ServingEngine:
         # straight to the uncached suffix
         effective = req.prompt + req.output
         p = len(effective)
-        suffix = effective[shared_tokens:]
-        sp = len(suffix)
+        sp = p - shared_tokens
+        chunk = self._chunk_size()
+        if chunk is not None and sp > chunk:
+            # chunked path: secure the WHOLE table now (incl. the final
+            # chunk's bucket padding), then ingest across ticks
+            B = self.pcfg.block_size
+            n_chunks = -(-sp // chunk)
+            final_start = shared_tokens + (n_chunks - 1) * chunk
+            final_bucket = self._whole_block_bucket(
+                p - final_start, self.pcfg.capacity - final_start
+            )
+            # every chunk's (padded) writes plus the first decode token
+            # must fit the table secured up front
+            total_blocks = max(final_start // B + final_bucket // B,
+                               self.pcfg.blocks_for(p + 1))
+            while len(shared) + len(fresh) < total_blocks:
+                more = self.blocks.alloc(1)
+                if more is None:
+                    self.blocks.free(shared + fresh)
+                    self.pending.appendleft(req)
+                    return
+                fresh.extend(more)
+            self.slots[slot_idx] = _SlotState(
+                req, shared + fresh, 0, ingest_pos=shared_tokens,
+                shared_tokens=shared_tokens,
+            )
+            metrics.serving_active_slots.set(self.active_slots)
+            return
+        if not self._run_prefill_graph(slot_idx, req, effective,
+                                       shared, shared_tokens, fresh,
+                                       start=shared_tokens, end=p):
+            return
+        table = shared + fresh
+        if self.pcfg.prefix_caching:
+            self.blocks.register(effective, table)
+            self.blocks.record_stats(p, shared_tokens)
+            metrics.serving_prefix_tokens.inc("hit", by=shared_tokens)
+            metrics.serving_prefix_tokens.inc("miss", by=p - shared_tokens)
+        metrics.serving_active_slots.set(self.active_slots)
+
+    def _ingest_chunk(self, slot_idx: int) -> None:
+        """Advance one ingesting slot by one chunk; the final chunk
+        samples the first token and flips the slot to decoding."""
+        slot = self.slots[slot_idx]
+        assert slot is not None and slot.ingest_pos is not None
+        req = slot.request
+        effective = req.prompt + req.output
+        p = len(effective)
+        chunk = self._chunk_size()
+        assert chunk is not None  # ingest_pos only set on the chunked path
+        start = slot.ingest_pos
+        B = self.pcfg.block_size
+        prefix_blocks = slot.blocks[:start // B]
+        if p - start > chunk:
+            # middle chunk: bucket-exact, no sampling
+            self._run_chunk_graph(effective, prefix_blocks, start,
+                                  start + chunk, slot.blocks)
+            slot.ingest_pos = start + chunk
+            return
+        # final chunk
+        logits_idx = self._run_chunk_graph(effective, prefix_blocks, start,
+                                           p, slot.blocks)
+        tok = self._sample_host(logits_idx, req, slot_idx)
+        slot.ingest_pos = None
+        slot.seq_len = p + 1
+        shared_tokens = slot.shared_tokens
+        if self.pcfg.prefix_caching:
+            self.blocks.register(effective, slot.blocks)
+            self.blocks.record_stats(p, shared_tokens)
+            metrics.serving_prefix_tokens.inc("hit", by=shared_tokens)
+            metrics.serving_prefix_tokens.inc(
+                "miss", by=p - shared_tokens)
+        self._record(slot_idx, req, tok)
+
+    def _run_chunk_graph(self, effective, prefix_blocks, start, end,
+                         table):
+        """Ingest effective[start:end] against the already-ingested
+        prefix blocks; returns last real token's logits."""
+        B = self.pcfg.block_size
+        sp = end - start
+        bucket = self._whole_block_bucket(sp, self.pcfg.capacity - start)
+        n_sfx = bucket // B
+        target = table[start // B: start // B + n_sfx]
+        suffix_tokens = jnp.asarray(
+            effective[start:end] + [0] * (bucket - sp), jnp.int32
+        )[None, :]
+        logits = self._dispatch_prefill(
+            suffix_tokens, prefix_blocks, start, target, bucket)
+        return logits[0, sp - 1]
+
+    def _run_prefill_graph(self, slot_idx, req, effective, shared,
+                           shared_tokens, fresh, start, end):
+        """One-shot prefill (the non-chunked path); returns False when
+        the padded bucket cannot be funded (request re-queued)."""
+        p = len(effective)
+        sp = end - start
         # bucket within what the block table can still hold: capacity
         # minus the matched prefix (shared + fresh must fit
         # max_blocks_per_seq)
-        bucket = min(_bucket(sp), self.pcfg.capacity - shared_tokens)
+        bucket = self._whole_block_bucket(
+            sp, self.pcfg.capacity - shared_tokens
+        )
         n_sfx_blocks = bucket // self.pcfg.block_size
         while len(fresh) < n_sfx_blocks:
             more = self.blocks.alloc(1)
@@ -279,18 +407,30 @@ class ServingEngine:
                 # and let the request wait at the head of the queue
                 self.blocks.free(shared + fresh)
                 self.pending.appendleft(req)
-                return
+                return False
             fresh.extend(more)
         suffix_tokens = jnp.asarray(
-            suffix + [0] * (bucket - sp), jnp.int32
+            effective[start:end] + [0] * (bucket - sp), jnp.int32
         )[None, :]
-        if shared:
+        logits = self._dispatch_prefill(
+            suffix_tokens, shared, shared_tokens,
+            fresh[:n_sfx_blocks], bucket)
+        tok = self._sample_host(logits[0, sp - 1], req, slot_idx)
+        self.slots[slot_idx] = _SlotState(req, shared + fresh, p + 1)
+        self._record(slot_idx, req, tok)
+        return True
+
+    def _dispatch_prefill(self, suffix_tokens, prefix_blocks, prefix_len,
+                          target_blocks, bucket):
+        """Run the right compiled prefill graph (plain vs prefix-seeded)
+        over donated pools; returns the suffix logits [1, bucket, V]."""
+        if prefix_blocks:
             # the seed graph's attention cost scales with its prefix
             # region, so size that region to a power-of-two BLOCK
-            # bucket of the actual match (compilations bounded by
-            # log2(max_blocks) x log2(capacity); a 1-block hit no
+            # bucket of the actual prefix (compilations bounded by
+            # log2(max_blocks) x log2(capacity); a 1-block prefix no
             # longer pays full-capacity attention)
-            prefix_bucket = min(_bucket(len(shared), minimum=1),
+            prefix_bucket = min(_bucket(len(prefix_blocks), minimum=1),
                                 self.pcfg.max_blocks_per_seq)
             key = (bucket, prefix_bucket)
             fn = self._prefill_seed_fns.get(key)
@@ -304,16 +444,16 @@ class ServingEngine:
             import numpy as np
 
             prefix_table = np.full((prefix_bucket,), SCRATCH_BLOCK, np.int32)
-            prefix_table[:len(shared)] = shared
+            prefix_table[:len(prefix_blocks)] = prefix_blocks
             self.pools, logits = fn(
                 self.params, self.pools, suffix_tokens,
                 jnp.asarray(prefix_table),
-                jnp.asarray(shared_tokens, jnp.int32),
-                jnp.asarray(fresh[:n_sfx_blocks], jnp.int32),
+                jnp.asarray(prefix_len, jnp.int32),
+                jnp.asarray(target_blocks, jnp.int32),
             )
         else:
-            # hot path without a cache hit: the plain bucket-sized
-            # graph — no prefix-capacity gather/attention overhead
+            # hot path without a prefix: the plain bucket-sized graph —
+            # no prefix-capacity gather/attention overhead
             fn = self._prefill_fns.get(bucket)
             if fn is None:
                 fn = jax.jit(
@@ -324,26 +464,22 @@ class ServingEngine:
                 self._prefill_fns[bucket] = fn
             self.pools, logits = fn(
                 self.params, self.pools, suffix_tokens,
-                jnp.asarray(fresh[:n_sfx_blocks], jnp.int32),
+                jnp.asarray(target_blocks, jnp.int32),
             )
-        tok = self._sample_host(logits[0, sp - 1], req, slot_idx)
-        table = shared + fresh
-        if self.pcfg.prefix_caching:
-            self.blocks.register(effective, table)
-            self.blocks.record_stats(p, shared_tokens)
-            metrics.serving_prefix_tokens.inc("hit", by=shared_tokens)
-            metrics.serving_prefix_tokens.inc("miss", by=p - shared_tokens)
-        self.slots[slot_idx] = _SlotState(req, table, p + 1)
-        self._record(slot_idx, req, tok)
-        metrics.serving_active_slots.set(self.active_slots)
+        return logits
 
     def _decode_once(self) -> list[int]:
         S = self.pcfg.max_slots
+        # ingesting slots are NOT in the decode batch: their seq_len is
+        # not final and their cache is mid-prefill
         active = jnp.asarray(
-            [s is not None for s in self.slots], jnp.bool_
+            [s is not None and s.ingest_pos is None for s in self.slots],
+            jnp.bool_,
         )
         seq_lens = jnp.asarray(
-            [s.seq_len if s else 1 for s in self.slots], jnp.int32
+            [s.seq_len if (s and s.ingest_pos is None) else 1
+             for s in self.slots],
+            jnp.int32,
         )
         tokens = jnp.asarray(self._last_tokens, jnp.int32)
         tables = self._block_tables()
@@ -363,8 +499,8 @@ class ServingEngine:
 
         done: list[int] = []
         for i, slot in enumerate(self.slots):
-            if slot is None:
-                continue
+            if slot is None or slot.ingest_pos is not None:
+                continue  # ingesting slots were masked out of the step
             slot.seq_len += 1
             req = slot.request
             self._record(i, req, int(next_host[i]))

@@ -44,6 +44,10 @@ class PagedConfig:
     max_blocks_per_seq: int = 32
     #: content-addressed reuse of full prompt blocks (prefix_cache.py)
     prefix_caching: bool = True
+    #: when set, prompts longer than this many tokens ingest in
+    #: block-aligned chunks interleaved with decode ticks, so one long
+    #: prompt can't stall every live request's next token
+    prefill_chunk: Optional[int] = None
 
     @property
     def capacity(self) -> int:
