@@ -402,3 +402,69 @@ class TestLeaseLeaderElection:
         assert lease["spec"]["holderIdentity"] == "pod-b"
         assert lease["spec"]["leaseTransitions"] == 1
         assert not a.heartbeat()
+
+
+class TestThreadedClusterBackend:
+    """Live-mode cluster backend: real clock, dispatcher thread, and the
+    FakeKubelet running pods on their own threads — the flat cluster
+    event dispatch and bus reflection must hold under real concurrency
+    (the race the serialized _dispatching flag fix targets)."""
+
+    @pytest.fixture
+    def live_cluster_rt(self):
+        rt = Runtime(clock=Clock(), executor_mode="threaded",
+                     executor_backend="cluster")
+        rt.start()
+        yield rt
+        rt.stop()
+
+    def test_threaded_cluster_story_end_to_end(self, live_cluster_rt):
+        rt = live_cluster_rt
+        done = []
+        lock = threading.Lock()
+
+        @register_engram("live.cluster.step")
+        def step(ctx):
+            with lock:
+                done.append(ctx.step)
+            return {"at": ctx.step}
+
+        rt.apply(make_engram_template("cw-tpl", entrypoint="live.cluster.step"))
+        rt.apply(make_engram("cw", "cw-tpl"))
+        rt.apply(make_story("live-cluster", steps=[
+            {"name": "a", "ref": {"name": "cw"}},
+            {"name": "b", "ref": {"name": "cw"}, "needs": ["a"]},
+            {"name": "c", "ref": {"name": "cw"}, "needs": ["a"]},
+        ]))
+        run = rt.run_story("live-cluster")
+        assert wait_for(lambda: rt.run_phase(run) == "Succeeded",
+                        timeout=30.0), (rt.run_phase(run), done)
+        assert sorted(done) == ["a", "b", "c"]
+        # the work demonstrably ran as cluster pods
+        pods = rt.cluster.list("v1", "Pod", "default")
+        assert len(pods) == 3
+        assert all(p["status"]["phase"] == "Succeeded" for p in pods)
+
+    def test_threaded_cluster_parallel_fanout(self, live_cluster_rt):
+        rt = live_cluster_rt
+        seen = []
+        lock = threading.Lock()
+
+        @register_engram("live.cluster.fan")
+        def fan(ctx):
+            with lock:
+                seen.append(ctx.inputs.get("shard"))
+            return {"shard": ctx.inputs.get("shard")}
+
+        rt.apply(make_engram_template("cf-tpl", entrypoint="live.cluster.fan"))
+        rt.apply(make_engram("cf", "cf-tpl"))
+        rt.apply(make_story("fan-cluster", steps=[
+            {"name": "split", "type": "parallel", "with": {"steps": [
+                {"name": f"b{i}", "ref": {"name": "cf"}, "with": {"shard": i}}
+                for i in range(6)
+            ]}},
+        ]))
+        run = rt.run_story("fan-cluster")
+        assert wait_for(lambda: rt.run_phase(run) == "Succeeded",
+                        timeout=30.0), rt.run_phase(run)
+        assert sorted(seen) == list(range(6))
