@@ -246,6 +246,14 @@ def _cmd_hub(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_export_samples(args: argparse.Namespace) -> int:
+    from .api.samples import export_samples
+
+    for p in export_samples(args.out):
+        print(p)
+    return 0
+
+
 def _cmd_export_chart(args: argparse.Namespace) -> int:
     """Render the Helm chart without helm (gke/chart.py subset)."""
     from .gke.chart import render_chart
@@ -333,6 +341,13 @@ def main(argv: list[str] | None = None) -> int:
                      help="shared-CA mTLS dir (forces the Python engine)")
     hub.set_defaults(fn=_cmd_hub)
 
+    samples = sub.add_parser(
+        "export-samples", parents=[common],
+        help="write admission-valid sample CRs for every kind",
+    )
+    samples.add_argument("--out", default="deploy/samples")
+    samples.set_defaults(fn=_cmd_export_samples)
+
     chart = sub.add_parser(
         "export-chart", parents=[common],
         help="render the Helm chart without helm (deploy/chart)",
@@ -350,7 +365,7 @@ def main(argv: list[str] | None = None) -> int:
     # `--log-level DEBUG export-crds` still reaches export-crds.
     raw = list(argv) if argv is not None else sys.argv[1:]
     commands = {"manager", "export-crds", "export-manifests", "hub",
-                "export-chart"}
+                "export-chart", "export-samples"}
     if (
         not any(a in commands for a in raw)
         and "-h" not in raw

@@ -1,0 +1,230 @@
+"""Sample custom resources for every kind — REAL, admission-valid ones.
+
+The reference ships `config/samples/` with empty spec templates
+("Populate this spec before applying"). These samples go further: the
+definition kinds are hand-authored as a coherent RAG scenario, and the
+run-side kinds (StepRun, StoryTrigger, EffectClaim, TransportBinding)
+are HARVESTED from an actual in-memory run of that scenario — every
+sample has passed this framework's own admission webhooks and, for the
+run kinds, been produced by the real controllers. A packaging test
+re-applies the definition set through a webhook-enabled Runtime on
+every suite run, so the samples can never rot.
+
+Export: ``python -m bobrapet_tpu export-samples --out deploy/samples``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from .catalog import make_engram_template, make_impulse_template
+from .engram import make_engram
+from .impulse import make_impulse
+from .policy import make_reference_grant
+from .runs import make_storyrun
+from .story import make_story
+from .transport import make_transport
+
+
+def definition_samples() -> list:
+    """The hand-authored kinds, in admission order (refs before
+    referents): a 3-step RAG story with a streaming transport."""
+    return [
+        make_engram_template(
+            "embedder-tpl",
+            entrypoint="examples.rag:embed",
+            image="ghcr.io/example/embedder:1",
+            inputSchema={"type": "object",
+                         "properties": {"q": {"type": "string"}}},
+            outputSchema={"type": "object"},
+        ),
+        make_engram_template(
+            "retriever-tpl",
+            entrypoint="examples.rag:retrieve",
+            image="ghcr.io/example/retriever:1",
+        ),
+        make_engram_template(
+            "generator-tpl",
+            entrypoint="examples.rag:generate",
+            image="ghcr.io/example/generator:1",
+            supportedModes=["job", "deployment"],
+        ),
+        make_impulse_template(
+            "webhook-tpl",
+            entrypoint="examples.rag:webhook_listener",
+            image="ghcr.io/example/webhook:1",
+        ),
+        make_engram("embedder", "embedder-tpl"),
+        make_engram("retriever", "retriever-tpl"),
+        make_engram("generator", "generator-tpl"),
+        make_transport(
+            "voz", "bobravoz", driver="grpc",
+            supportedBinary=["application/json"],
+        ),
+        make_story(
+            "rag",
+            steps=[
+                {"name": "embed", "ref": {"name": "embedder"},
+                 "with": {"q": "{{ inputs.question }}"}},
+                {"name": "retrieve", "ref": {"name": "retriever"},
+                 "with": {"vec": "{{ steps.embed.output.vec }}"}},
+                {"name": "generate", "ref": {"name": "generator"},
+                 "with": {"docs": "{{ steps.retrieve.output.docs }}"},
+                 "tpu": {"topology": "2x2",
+                         "meshAxes": {"data": 1, "model": 4}}},
+            ],
+            output={"answer": "{{ steps.generate.output.text }}"},
+            policy={"queue": "v5e-pool"},
+        ),
+        make_impulse("webhook-in", "webhook-tpl", "rag"),
+        make_reference_grant(
+            "allow-rag-from-apps", "default",
+            from_=[{"group": "bobrapet.io", "kind": "Story",
+                    "namespace": "apps"}],
+            to=[{"group": "bobrapet.io", "kind": "Engram",
+                 "names": ["generator"]}],
+        ),
+        make_storyrun("rag-run-sample", "rag",
+                      {"question": "what is a TPU slice?"}),
+    ]
+
+
+def harvest_run_samples() -> list:
+    """Run the scenario in-memory and harvest controller-created run
+    kinds — guaranteed-real StepRun/StoryTrigger/EffectClaim shapes."""
+    from ..parallel.placement import SlicePool
+    from ..runtime import Runtime
+    from ..sdk import register_engram
+    from ..sdk.registry import unregister_engram
+
+    rt = Runtime()
+    # the story's generate step asks for a 2x2 sub-slice from this pool
+    rt.placer.add_pool(SlicePool("v5e-pool", "4x4", chips_per_host=4))
+
+    # lightweight local stand-ins so the run completes — unregistered in
+    # the finally below (the registry is process-global, and registered
+    # names shadow real module:attr entrypoints)
+    stubs = {
+        "examples.rag:embed": lambda ctx: {"vec": [0.1, 0.2]},
+        "examples.rag:retrieve": lambda ctx: {"docs": ["d1"]},
+        "examples.rag:generate": lambda ctx: {"text": "a TPU slice is ..."},
+        "examples.rag:stream": lambda ctx: {"ok": True},
+    }
+    for name, fn in stubs.items():
+        register_engram(name, fn)
+    try:
+        return _harvest(rt)
+    finally:
+        for name in stubs:
+            unregister_engram(name)
+
+
+def _harvest(rt) -> list:
+    from ..utils.naming import steprun_name
+
+    for r in definition_samples():
+        if r.kind != "StoryRun":
+            rt.apply(r)
+    run = rt.run_story("rag", inputs={"question": "what is a TPU slice?"},
+                       name="rag-run-sample")
+    rt.pump()
+    assert rt.run_phase(run) == "Succeeded", rt.run_phase(run)
+
+    # a durable trigger delivery (webhook-style) admits one more run
+    from ..core.object import new_resource
+
+    rt.store.create(new_resource(
+        "StoryTrigger", "webhook-delivery-sample", "default", spec={
+            "storyRef": {"name": "rag"},
+            "identity": {"mode": "key", "key": "evt-2026-07-30-0001"},
+            "inputs": {"question": "what is a TPU slice?"},
+        },
+    ))
+    # an at-most-once side-effect lease held by an SDK worker —
+    # referencing the REAL StepRun the rag run produced (names carry a
+    # uniquifying hash; a bare "<run>-<step>" would dangle)
+    gen_sr = steprun_name("rag-run-sample", "generate")
+    assert rt.store.try_get("StepRun", "default", gen_sr) is not None
+    rt.store.create(new_resource(
+        "EffectClaim", "charge-card-sample", "default", spec={
+            "stepRunRef": {"name": gen_sr},
+            "effectId": "charge-card",
+            "holderIdentity": "engram-sdk-0",
+            "leaseDurationSeconds": 60,
+        },
+    ))
+    rt.pump()
+    assert rt.store.get("StoryTrigger", "default",
+                        "webhook-delivery-sample").status.get("decision")
+
+    # a realtime mini-story negotiates a TransportBinding over "voz"
+    # (deployment-only engrams: batch mode must not be selectable)
+    rt.apply(make_engram_template(
+        "streamer-tpl", entrypoint="examples.rag:stream",
+        image="ghcr.io/example/streamer:1", supportedModes=["deployment"],
+    ))
+    rt.apply(make_engram("streamer", "streamer-tpl"))
+    rt.apply(make_story("live-sample", steps=[
+        {"name": "ingest", "ref": {"name": "streamer"}, "transport": "voz"},
+        {"name": "emit", "ref": {"name": "streamer"},
+         "needs": ["ingest"], "transport": "voz"},
+    ], transports=[{"name": "voz", "transportRef": "voz"}],
+        pattern="realtime"))
+    # deterministic run name -> stable harvested filenames across exports
+    rt.run_story("live-sample", inputs={}, name="live-sample-run")
+    rt.pump()
+
+    harvested = []
+    sr = sorted(rt.store.list("StepRun"), key=lambda r: r.meta.name)[0]
+    harvested.append(sr)
+    harvested.append(rt.store.get("StoryTrigger", "default",
+                                  "webhook-delivery-sample"))
+    harvested.append(rt.store.get("EffectClaim", "default",
+                                  "charge-card-sample"))
+    bindings = sorted(rt.store.list("TransportBinding"),
+                      key=lambda r: r.meta.name)
+    assert bindings, "realtime sample produced no TransportBinding"
+    harvested.append(bindings[0])
+    return harvested
+
+
+def _manifest(resource, group: str) -> dict[str, Any]:
+    out: dict[str, Any] = {
+        "apiVersion": f"{group}/v1alpha1",
+        "kind": resource.kind,
+        "metadata": {"name": resource.meta.name},
+        "spec": resource.spec,
+    }
+    if resource.meta.namespace not in ("_cluster",):
+        out["metadata"]["namespace"] = resource.meta.namespace
+    if resource.meta.labels:
+        out["metadata"]["labels"] = dict(resource.meta.labels)
+    return out
+
+
+def export_samples(out_dir: str, include_run_kinds: bool = True) -> list[str]:
+    import yaml
+
+    from .schemas import _registry
+
+    os.makedirs(out_dir, exist_ok=True)
+    # remove stale exports first: a renamed sample would otherwise leave
+    # an orphaned-but-tracked YAML no staleness check can see
+    for old in os.listdir(out_dir):
+        if old.endswith(".yaml"):
+            os.unlink(os.path.join(out_dir, old))
+    plurals = {e.kind: (e.group, e.plural) for e in _registry()}
+    resources = list(definition_samples())
+    if include_run_kinds:
+        resources += harvest_run_samples()
+    paths = []
+    for r in resources:
+        group, plural = plurals[r.kind]
+        path = os.path.join(
+            out_dir, f"{group.split('.')[0]}_{plural}_{r.meta.name}.yaml"
+        )
+        with open(path, "w") as f:
+            yaml.safe_dump(_manifest(r, group), f, sort_keys=False)
+        paths.append(path)
+    return paths
