@@ -608,3 +608,42 @@ class TestChunkedPrefill:
         done = {r.rid: r for r in eng.run()}
         assert done[rid].output == _reference_tokens(params, cfg, a, 4)
         assert eng.blocks.hit_tokens - hits == 24
+
+
+class TestServingFuzz:
+    """Property check: under random slot/pool/chunk configs and request
+    mixes (lengths, budgets, eos, memory pressure forcing preemption),
+    every greedy output must equal the reference decode exactly."""
+
+    # bounded shape sets keep the jit cache warm across seeds
+    LENS = (5, 12, 23, 40)
+    NEWS = (1, 3, 6)
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_mix_is_reference_exact(self, model, seed):
+        cfg, params = model
+        rng = np.random.default_rng(100 + seed)
+        pcfg = PagedConfig(
+            max_slots=int(rng.integers(1, 4)),
+            block_size=int(rng.choice([4, 8])),
+            num_blocks=int(rng.integers(12, 40)),
+            max_blocks_per_seq=16,
+            prefix_caching=bool(rng.integers(0, 2)),
+            prefill_chunk=int(rng.choice([8, 16])) if rng.integers(0, 2) else None,
+        )
+        eng = ServingEngine(params, cfg, pcfg)
+        reqs = []
+        for _ in range(int(rng.integers(2, 6))):
+            prompt = rng.integers(0, cfg.vocab_size,
+                                  int(rng.choice(self.LENS))).tolist()
+            n = int(rng.choice(self.NEWS))
+            if prompt and n and len(prompt) + n <= pcfg.capacity:
+                want = _reference_tokens(params, cfg, prompt, n)
+                eos = want[0] if rng.integers(0, 4) == 0 else None
+                rid = eng.submit(prompt, max_new_tokens=n, eos_token=eos)
+                reqs.append((rid, want[:1] if eos is not None else want))
+        done = {r.rid: r for r in eng.run(max_steps=5000)}
+        assert len(done) == len(reqs), (len(done), len(reqs))
+        for rid, want in reqs:
+            assert done[rid].output == want, (seed, rid)
+        assert eng.allocator.free_blocks == pcfg.num_blocks - 1
