@@ -18,7 +18,7 @@ import enum
 import typing
 from typing import Any, get_args, get_origin, get_type_hints
 
-from .specbase import SpecBase, snake_to_camel
+from .specbase import _hints_for, SpecBase, snake_to_camel
 
 GROUP = "bobrapet.io"
 RUNS_GROUP = "runs.bobrapet.io"
@@ -72,7 +72,7 @@ def dataclass_schema(
     cls: type, stack: tuple[type, ...] = ()
 ) -> dict[str, Any]:
     """openAPIV3 object schema for one SpecBase dataclass."""
-    hints = get_type_hints(cls)
+    hints = _hints_for(cls)
     props: dict[str, Any] = {}
     for f in dataclasses.fields(cls):
         key = snake_to_camel(f.name)

@@ -79,6 +79,20 @@ def _dump_value(value: Any) -> Any:
     return value
 
 
+#: get_type_hints() walks the MRO and eval's forward refs on EVERY call
+#: — ~35% of a single-step run's control-plane time went to re-resolving
+#: identical hints. Spec classes are static; memoize per class.
+_HINTS_CACHE: dict[type, dict[str, Any]] = {}
+
+
+def _hints_for(cls: type) -> dict[str, Any]:
+    hints = _HINTS_CACHE.get(cls)
+    if hints is None:
+        hints = get_type_hints(cls)
+        _HINTS_CACHE[cls] = hints
+    return hints
+
+
 @dataclasses.dataclass
 class SpecBase:
     """Base for all spec/policy dataclasses; see module docstring."""
@@ -89,7 +103,7 @@ class SpecBase:
             return None
         if isinstance(d, cls):
             return d
-        hints = get_type_hints(cls)
+        hints = _hints_for(cls)
         kwargs: dict[str, Any] = {}
         for f in dataclasses.fields(cls):
             key = snake_to_camel(f.name)

@@ -26,7 +26,6 @@ intentionally mirrored:
 
 from __future__ import annotations
 
-import copy
 import json
 import logging
 import os
@@ -35,7 +34,7 @@ import urllib.parse
 from collections import deque
 from typing import Any, Callable, Iterable, Optional
 
-from .object import ObjectMeta, Resource, fresh_uid, now
+from .object import ObjectMeta, Resource, _fast_copy, fresh_uid, now
 
 _log = logging.getLogger(__name__)
 
@@ -331,12 +330,12 @@ class ResourceStore:
                 raise Conflict(*key, obj.meta.resource_version, cur.meta.resource_version)
             new = cur.deepcopy()
             if status_only:
-                new.status = copy.deepcopy(obj.status)
+                new.status = _fast_copy(obj.status)
                 for fn in self._status_validators.get(new.kind, []):
                     fn(new, cur)
             else:
-                new.spec = copy.deepcopy(obj.spec)
-                new.status = copy.deepcopy(obj.status)
+                new.spec = _fast_copy(obj.spec)
+                new.status = _fast_copy(obj.status)
                 new.meta.labels = dict(obj.meta.labels)
                 new.meta.annotations = dict(obj.meta.annotations)
                 new.meta.finalizers = list(obj.meta.finalizers)

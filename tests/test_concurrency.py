@@ -505,6 +505,8 @@ class TestSoak:
             ), [rt.run_phase(r) for r in runs]
             for i, r in enumerate(runs):
                 assert rt.run_output(r) == {"i": i}  # no cross-talk
+            # the engram-side record agrees: each run saw only its input
+            assert {results[r] for r in runs if r in results} == set(range(20))
             # every pod retired cleanly on the fake cluster
             pods = rt.cluster.list("v1", "Pod", "default")
             assert len(pods) == 40
