@@ -18,12 +18,14 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import functools
 import typing
 from typing import Any, Optional, Type, TypeVar, get_args, get_origin, get_type_hints
 
 T = TypeVar("T", bound="SpecBase")
 
 
+@functools.lru_cache(maxsize=4096)
 def snake_to_camel(name: str) -> str:
     head, *rest = name.split("_")
     return head + "".join(p.title() for p in rest)
