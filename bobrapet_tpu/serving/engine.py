@@ -488,12 +488,12 @@ class ServingEngine:
             jnp.float32,
         )
         self._steps += 1
-        keys = jax.vmap(jax.random.fold_in, (0, None))(
-            self._keys, self._steps
-        )
+        # the per-step key fold happens INSIDE the compiled step (same
+        # fold_in values) — a separate vmapped dispatch per tick was
+        # pure host overhead
         self.pools, next_tokens = self._decode_fn(
             self.params, self.pools, tokens, seq_lens, active, tables,
-            temps, keys,
+            temps, self._keys, jnp.asarray(self._steps, jnp.int32),
         )
         next_host = jax.device_get(next_tokens).tolist()
 
@@ -583,9 +583,11 @@ def _prefill_bucket(params, pools, suffix_tokens, prefix_table, prefix_len,
 
 
 def _decode_step(params, pools, tokens, seq_lens, active, block_tables,
-                 temps, keys, *, cfg: LlamaConfig, pcfg: PagedConfig):
+                 temps, base_keys, step, *, cfg: LlamaConfig,
+                 pcfg: PagedConfig):
     """One fused token step for every slot (see module doc)."""
     S = pcfg.max_slots
+    keys = jax.vmap(jax.random.fold_in, (0, None))(base_keys, step)
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     positions = seq_lens - 1  # the incoming token's position
     x = params["embed"]["weight"][tokens].astype(cfg.dtype)[:, None, :]
