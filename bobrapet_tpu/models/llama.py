@@ -135,6 +135,17 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict[str, Any]:
 # ---------------------------------------------------------------------------
 
 
+def _lora_mm(h: jax.Array, w: Any, lora_layer, site: str,
+             lora_scale: float) -> jax.Array:
+    """Base matmul (+ optional LoRA delta: scale * (h @ A) @ B)."""
+    out = _mm(h, w)
+    if lora_layer is not None and site in lora_layer:
+        from .lora import lora_delta
+
+        out = out + lora_delta(h, lora_layer[site], lora_scale)
+    return out
+
+
 def _attention_block(
     layer: dict[str, Any],
     x: jax.Array,
@@ -143,12 +154,17 @@ def _attention_block(
     cache: Optional[dict[str, jax.Array]],
     positions: Optional[jax.Array],
     attn_fn,
+    lora_layer=None,
+    lora_scale: float = 1.0,
 ) -> tuple[jax.Array, Optional[dict[str, jax.Array]]]:
     b, s, _ = x.shape
     h = rmsnorm_reference(x, layer["attn_norm"]["weight"], cfg.norm_eps)
-    q = _mm(h, layer["attn"]["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-    k = _mm(h, layer["attn"]["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-    v = _mm(h, layer["attn"]["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = _lora_mm(h, layer["attn"]["wq"], lora_layer, "wq", lora_scale).reshape(
+        b, s, cfg.n_heads, cfg.head_dim)
+    k = _lora_mm(h, layer["attn"]["wk"], lora_layer, "wk", lora_scale).reshape(
+        b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = _lora_mm(h, layer["attn"]["wv"], lora_layer, "wv", lora_scale).reshape(
+        b, s, cfg.n_kv_heads, cfg.head_dim)
     q = apply_rope(q, freqs, positions)
     k = apply_rope(k, freqs, positions)
 
@@ -164,7 +180,8 @@ def _attention_block(
     else:
         out = attn_fn(q, k, v)
     out = out.reshape(b, s, cfg.dim)
-    return x + _mm(out, layer["attn"]["wo"]), new_cache
+    return x + _lora_mm(out, layer["attn"]["wo"], lora_layer, "wo",
+                        lora_scale), new_cache
 
 
 def _cached_attention(q, k_all, v_all, valid_len, cfg: LlamaConfig) -> jax.Array:
@@ -185,11 +202,17 @@ def _cached_attention(q, k_all, v_all, valid_len, cfg: LlamaConfig) -> jax.Array
     return jnp.einsum("bhqk,bkhd->bqhd", probs, vf).astype(q.dtype)
 
 
-def _mlp_block(layer: dict[str, Any], x: jax.Array, cfg: LlamaConfig) -> jax.Array:
+def _mlp_block(layer: dict[str, Any], x: jax.Array, cfg: LlamaConfig,
+               lora_layer=None, lora_scale: float = 1.0) -> jax.Array:
     h = rmsnorm_reference(x, layer["mlp_norm"]["weight"], cfg.norm_eps)
-    gate = jax.nn.silu(_mm(h, layer["mlp"]["w_gate"]).astype(jnp.float32))
-    up = _mm(h, layer["mlp"]["w_up"]).astype(jnp.float32)
-    return x + _mm((gate * up).astype(cfg.dtype), layer["mlp"]["w_down"])
+    gate = jax.nn.silu(
+        _lora_mm(h, layer["mlp"]["w_gate"], lora_layer, "w_gate",
+                 lora_scale).astype(jnp.float32))
+    up = _lora_mm(h, layer["mlp"]["w_up"], lora_layer, "w_up",
+                  lora_scale).astype(jnp.float32)
+    return x + _lora_mm((gate * up).astype(cfg.dtype),
+                        layer["mlp"]["w_down"], lora_layer, "w_down",
+                        lora_scale)
 
 
 def forward(
@@ -199,11 +222,15 @@ def forward(
     cache: Optional[list[dict[str, jax.Array]]] = None,
     positions: Optional[jax.Array] = None,
     attn_fn=None,
+    lora: Optional[dict[str, Any]] = None,
+    lora_scale: float = 1.0,
 ) -> tuple[jax.Array, Optional[list[dict[str, jax.Array]]]]:
     """Token ids [B, S] -> logits [B, S, V] (+ updated cache).
 
     ``attn_fn`` overrides the attention implementation (ring attention
-    plugs in here for sequence-parallel long context).
+    plugs in here for sequence-parallel long context). ``lora`` is ONE
+    adapter's tree (models/lora.py); its rank-r deltas ride every site
+    it carries.
     """
     if attn_fn is None:
         attn_fn = lambda q, k, v: attention(q, k, v, causal=True)  # noqa: E731
@@ -212,10 +239,13 @@ def forward(
     new_caches: Optional[list[dict[str, jax.Array]]] = [] if cache is not None else None
     for i, layer in enumerate(params["layers"]):
         layer_cache = cache[i] if cache is not None else None
-        x, updated = _attention_block(layer, x, freqs, cfg, layer_cache, positions, attn_fn)
+        lora_layer = lora["layers"][i] if lora is not None else None
+        x, updated = _attention_block(layer, x, freqs, cfg, layer_cache,
+                                      positions, attn_fn, lora_layer,
+                                      lora_scale)
         if new_caches is not None:
             new_caches.append(updated)
-        x = _mlp_block(layer, x, cfg)
+        x = _mlp_block(layer, x, cfg, lora_layer, lora_scale)
     x = rmsnorm_reference(x, params["final_norm"]["weight"], cfg.norm_eps)
     if cfg.tie_embeddings:
         logits = x @ params["embed"]["weight"].T.astype(cfg.dtype)

@@ -1,0 +1,129 @@
+"""LoRA adapters: low-rank deltas over the Llama weight sites.
+
+Multi-tenant serving wants many fine-tunes over ONE resident base
+model: adapters are rank-r factors (A [in, r], B [r, out]) whose delta
+``scale * (x @ A) @ B`` adds to each target matmul — the base weights
+(bf16 or int8) are never touched, so hundreds of adapters cost
+megabytes while the base costs gigabytes.
+
+Layout mirrors the param tree: ``{"layers": [{site: {"a", "b"}}]}``
+with sites among wq/wk/wv/wo/w_gate/w_up/w_down. A STACKED tree adds a
+leading adapter axis to every leaf — the serving engine gathers each
+slot's adapter inside the fused decode step, so one compiled graph
+serves any adapter mix. Index 0 is the reserved BASE adapter (zeros):
+requests without an adapter select it and get exactly the base model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+ATTN_SITES = ("wq", "wk", "wv", "wo")
+MLP_SITES = ("w_gate", "w_up", "w_down")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    #: which matmul sites carry adapters (attention-only is the usual
+    #: quality/size sweet spot)
+    sites: tuple[str, ...] = ("wq", "wv")
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def _site_dims(cfg, site: str) -> tuple[int, int]:
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    return {
+        "wq": (cfg.dim, cfg.dim),
+        "wk": (cfg.dim, kv_dim),
+        "wv": (cfg.dim, kv_dim),
+        "wo": (cfg.dim, cfg.dim),
+        "w_gate": (cfg.dim, cfg.ffn_hidden),
+        "w_up": (cfg.dim, cfg.ffn_hidden),
+        "w_down": (cfg.ffn_hidden, cfg.dim),
+    }[site]
+
+
+def init_lora(key: jax.Array, cfg, lcfg: LoRAConfig) -> dict[str, Any]:
+    """One adapter: A ~ N(0, 1/r), B = 0 (standard init: the delta
+    starts at zero, training moves it)."""
+    layers = []
+    keys = iter(jax.random.split(key, cfg.n_layers * len(lcfg.sites)))
+    for _ in range(cfg.n_layers):
+        layer: dict[str, Any] = {}
+        for site in lcfg.sites:
+            d_in, d_out = _site_dims(cfg, site)
+            layer[site] = {
+                "a": (jax.random.normal(next(keys), (d_in, lcfg.rank),
+                                        jnp.float32)
+                      / math.sqrt(lcfg.rank)).astype(cfg.dtype),
+                "b": jnp.zeros((lcfg.rank, d_out), cfg.dtype),
+            }
+        layers.append(layer)
+    return {"layers": layers}
+
+
+def zero_lora(cfg, lcfg: LoRAConfig) -> dict[str, Any]:
+    """The identity adapter (all-zero delta) — stack index 0."""
+    layers = []
+    for _ in range(cfg.n_layers):
+        layer: dict[str, Any] = {}
+        for site in lcfg.sites:
+            d_in, d_out = _site_dims(cfg, site)
+            layer[site] = {
+                "a": jnp.zeros((d_in, lcfg.rank), cfg.dtype),
+                "b": jnp.zeros((lcfg.rank, d_out), cfg.dtype),
+            }
+        layers.append(layer)
+    return {"layers": layers}
+
+
+def stack_adapters(adapters: Sequence[dict[str, Any]]) -> dict[str, Any]:
+    """[adapter trees] -> one tree with a leading adapter axis per leaf
+    (adapter 0 should be :func:`zero_lora` — the engine maps "no
+    adapter" there)."""
+    return jax.tree_util.tree_map(lambda *leaves: jnp.stack(leaves), *adapters)
+
+
+def select_adapter(stacked: dict[str, Any], index) -> dict[str, Any]:
+    """One adapter's tree out of a stack (gather on the leading axis —
+    jit-safe with a traced index)."""
+    return jax.tree_util.tree_map(lambda leaf: leaf[index], stacked)
+
+
+def lora_delta(x: jax.Array, site_lora: Optional[dict[str, Any]],
+               scale: float) -> jax.Array:
+    """``scale * (x @ A) @ B`` — rank-r bottleneck, fused by XLA into
+    two skinny matmuls; returns 0.0 when the site has no adapter."""
+    if site_lora is None:
+        return jnp.zeros((), x.dtype)
+    a = site_lora["a"].astype(x.dtype)
+    b = site_lora["b"].astype(x.dtype)
+    return ((x @ a) @ b) * jnp.asarray(scale, x.dtype)
+
+
+def merge_lora(params: dict[str, Any], adapter: dict[str, Any],
+               scale: float) -> dict[str, Any]:
+    """Materialize base + delta into plain weights (reference baseline
+    for tests; production serving never does this — the whole point is
+    NOT materializing per-tenant weight copies)."""
+    # tree_map identity rebuilds the container dicts; leaves (immutable
+    # arrays) are shared — all the site reassignment below needs
+    out = jax.tree_util.tree_map(lambda x: x, params)
+    for layer, lora_layer in zip(out["layers"], adapter["layers"]):
+        for site, ab in lora_layer.items():
+            tgt = layer["attn"] if site in ATTN_SITES else layer["mlp"]
+            w = tgt[site]
+            delta = (ab["a"].astype(jnp.float32)
+                     @ ab["b"].astype(jnp.float32)) * scale
+            tgt[site] = (w.astype(jnp.float32) + delta).astype(w.dtype)
+    return out

@@ -65,6 +65,8 @@ class Request:
     max_new_tokens: int
     temperature: float = 0.0
     eos_token: Optional[int] = None
+    #: multi-LoRA: index into the engine's adapter stack (0 = base)
+    adapter: int = 0
     #: filled by the engine
     output: list[int] = dataclasses.field(default_factory=list)
     done: bool = False
@@ -97,10 +99,30 @@ class ServingEngine:
     ``forward``."""
 
     def __init__(self, params: Any, cfg: LlamaConfig,
-                 pcfg: Optional[PagedConfig] = None):
+                 pcfg: Optional[PagedConfig] = None,
+                 loras: Optional[Any] = None, lora_scale: float = 1.0):
         self.params = params
         self.cfg = cfg
         self.pcfg = pcfg or PagedConfig()
+        #: multi-LoRA: a STACKED adapter tree (models/lora.py
+        #: stack_adapters; index 0 must be the zero adapter) — one
+        #: compiled step serves any per-slot adapter mix
+        self.loras = loras
+        self.lora_scale = lora_scale
+        if loras is not None:
+            leaves = jax.tree_util.tree_leaves(loras)
+            counts = {leaf.shape[0] for leaf in leaves}
+            if any(leaf.ndim != 3 for leaf in leaves) or len(counts) != 1:
+                raise ValueError(
+                    "loras must be a STACKED adapter tree "
+                    "(models.lora.stack_adapters: every leaf "
+                    "[n_adapters, in, r] / [n_adapters, r, out]); got "
+                    f"leaf shapes {[leaf.shape for leaf in leaves[:3]]}"
+                )
+            self.n_adapters = counts.pop()
+        else:
+            self.n_adapters = 1
+        self._adapter_cache: dict[int, Any] = {}
         self.pools = init_pools(cfg, self.pcfg)
         self.allocator = BlockAllocator(self.pcfg.num_blocks)
         # all block traffic flows through the prefix cache so freed-
@@ -116,7 +138,8 @@ class ServingEngine:
         )
         self._steps = 0
         self._decode_fn = jax.jit(
-            functools.partial(_decode_step, cfg=cfg, pcfg=self.pcfg),
+            functools.partial(_decode_step, cfg=cfg, pcfg=self.pcfg,
+                              lora_scale=lora_scale),
             donate_argnums=(1,),
         )
         self._prefill_fns: dict[int, Any] = {}
@@ -126,7 +149,8 @@ class ServingEngine:
 
     def submit(self, prompt: list[int], max_new_tokens: int,
                temperature: float = 0.0,
-               eos_token: Optional[int] = None) -> int:
+               eos_token: Optional[int] = None,
+               adapter: Optional[int] = None) -> int:
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill "
                              "always samples one token)")
@@ -135,8 +159,13 @@ class ServingEngine:
                 f"prompt+new ({len(prompt)}+{max_new_tokens}) exceeds slot "
                 f"capacity {self.pcfg.capacity}"
             )
+        if adapter is not None and not (0 <= adapter < self.n_adapters):
+            raise ValueError(
+                f"adapter {adapter} out of range (engine has "
+                f"{self.n_adapters} incl. the base at 0)"
+            )
         req = Request(self._next_rid, list(prompt), max_new_tokens,
-                      temperature, eos_token)
+                      temperature, eos_token, adapter=adapter or 0)
         self._next_rid += 1
         self.pending.append(req)
         return req.rid
@@ -198,7 +227,9 @@ class ServingEngine:
             shared: list[int] = []
             shared_tokens = 0
             if self.pcfg.prefix_caching:
-                shared, shared_tokens = self.blocks.match_prefix(effective)
+                shared, shared_tokens = self.blocks.match_prefix(
+                    effective, salt=req.adapter
+                )
             fresh = self.blocks.alloc(need_total - len(shared))
             if fresh is None:
                 self.blocks.free(shared)
@@ -331,7 +362,7 @@ class ServingEngine:
             return
         table = shared + fresh
         if self.pcfg.prefix_caching:
-            self.blocks.register(effective, table)
+            self.blocks.register(effective, table, salt=req.adapter)
             self.blocks.record_stats(p, shared_tokens)
             metrics.serving_prefix_tokens.inc("hit", by=shared_tokens)
             metrics.serving_prefix_tokens.inc("miss", by=p - shared_tokens)
@@ -353,18 +384,19 @@ class ServingEngine:
         if p - start > chunk:
             # middle chunk: bucket-exact, no sampling
             self._run_chunk_graph(effective, prefix_blocks, start,
-                                  start + chunk, slot.blocks)
+                                  start + chunk, slot.blocks, req.adapter)
             slot.ingest_pos = start + chunk
             return
         # final chunk
         logits_idx = self._run_chunk_graph(effective, prefix_blocks, start,
-                                           p, slot.blocks)
+                                           p, slot.blocks, req.adapter)
         tok = self._sample_host(logits_idx, req, slot_idx)
         slot.ingest_pos = None
         slot.seq_len = p + 1
         shared_tokens = slot.shared_tokens
         if self.pcfg.prefix_caching:
-            self.blocks.register(effective, slot.blocks)
+            self.blocks.register(effective, slot.blocks,
+                                 salt=req.adapter)
             self.blocks.record_stats(p, shared_tokens)
             metrics.serving_prefix_tokens.inc("hit", by=shared_tokens)
             metrics.serving_prefix_tokens.inc(
@@ -372,7 +404,7 @@ class ServingEngine:
         self._record(slot_idx, req, tok)
 
     def _run_chunk_graph(self, effective, prefix_blocks, start, end,
-                         table):
+                         table, adapter: int):
         """Ingest effective[start:end] against the already-ingested
         prefix blocks; returns last real token's logits."""
         B = self.pcfg.block_size
@@ -384,7 +416,7 @@ class ServingEngine:
             effective[start:end] + [0] * (bucket - sp), jnp.int32
         )[None, :]
         logits = self._dispatch_prefill(
-            suffix_tokens, prefix_blocks, start, target, bucket)
+            suffix_tokens, prefix_blocks, start, target, bucket, adapter)
         return logits[0, sp - 1]
 
     def _run_prefill_graph(self, slot_idx, req, effective, shared,
@@ -414,16 +446,33 @@ class ServingEngine:
         )[None, :]
         logits = self._dispatch_prefill(
             suffix_tokens, shared, shared_tokens,
-            fresh[:n_sfx_blocks], bucket)
+            fresh[:n_sfx_blocks], bucket, req.adapter)
         tok = self._sample_host(logits[0, sp - 1], req, slot_idx)
         self.slots[slot_idx] = _SlotState(req, shared + fresh, p + 1)
         self._record(slot_idx, req, tok)
         return True
 
     def _dispatch_prefill(self, suffix_tokens, prefix_blocks, prefix_len,
-                          target_blocks, bucket):
+                          target_blocks, bucket, adapter: int = 0):
         """Run the right compiled prefill graph (plain vs prefix-seeded)
-        over donated pools; returns the suffix logits [1, bucket, V]."""
+        over donated pools; returns the suffix logits [1, bucket, V].
+
+        Prefill is single-sequence, so the request's ONE adapter is
+        selected from the stack OUTSIDE the graph (a tiny gather) and
+        passed as a normal pytree arg — shapes are adapter-invariant,
+        so no recompilation per adapter."""
+        lora = None
+        if self.loras is not None and adapter != 0:
+            # adapter 0 is the zero adapter by contract — base traffic
+            # takes the (cached) lora=None prefill graph at zero cost.
+            # Selections memoize per index: adapters are engine-static,
+            # so the per-layer gathers run once, not per chunk.
+            lora = self._adapter_cache.get(adapter)
+            if lora is None:
+                from ..models.lora import select_adapter
+
+                lora = select_adapter(self.loras, adapter)
+                self._adapter_cache[adapter] = lora
         if prefix_blocks:
             # the seed graph's attention cost scales with its prefix
             # region, so size that region to a power-of-two BLOCK
@@ -437,7 +486,8 @@ class ServingEngine:
             if fn is None:
                 fn = jax.jit(
                     functools.partial(_prefill_bucket, cfg=self.cfg,
-                                      pcfg=self.pcfg, bucket=bucket),
+                                      pcfg=self.pcfg, bucket=bucket,
+                                      lora_scale=self.lora_scale),
                     donate_argnums=(1,),
                 )
                 self._prefill_seed_fns[key] = fn
@@ -450,6 +500,7 @@ class ServingEngine:
                 jnp.asarray(prefix_table),
                 jnp.asarray(prefix_len, jnp.int32),
                 jnp.asarray(target_blocks, jnp.int32),
+                lora,
             )
         else:
             # hot path without a prefix: the plain bucket-sized graph —
@@ -458,13 +509,15 @@ class ServingEngine:
             if fn is None:
                 fn = jax.jit(
                     functools.partial(_prefill_plain, cfg=self.cfg,
-                                      bucket=bucket),
+                                      bucket=bucket,
+                                      lora_scale=self.lora_scale),
                     donate_argnums=(1,),
                 )
                 self._prefill_fns[bucket] = fn
             self.pools, logits = fn(
                 self.params, self.pools, suffix_tokens,
                 jnp.asarray(target_blocks, jnp.int32),
+                lora,
             )
         return logits
 
@@ -491,9 +544,13 @@ class ServingEngine:
         # the per-step key fold happens INSIDE the compiled step (same
         # fold_in values) — a separate vmapped dispatch per tick was
         # pure host overhead
+        adapters = jnp.asarray(
+            [s.request.adapter if s else 0 for s in self.slots], jnp.int32
+        )
         self.pools, next_tokens = self._decode_fn(
             self.params, self.pools, tokens, seq_lens, active, tables,
             temps, self._keys, jnp.asarray(self._steps, jnp.int32),
+            self.loras, adapters,
         )
         next_host = jax.device_get(next_tokens).tolist()
 
@@ -540,8 +597,8 @@ class ServingEngine:
 # ---------------------------------------------------------------------------
 
 
-def _prefill_plain(params, pools, tokens, block_ids, *, cfg: LlamaConfig,
-                   bucket: int):
+def _prefill_plain(params, pools, tokens, block_ids, lora=None, *,
+                   cfg: LlamaConfig, bucket: int, lora_scale: float = 1.0):
     """Full-prompt prefill without a shared prefix: contiguous cache of
     exactly bucket capacity (the pre-prefix-caching hot path)."""
     from ..models.llama import init_cache
@@ -549,7 +606,8 @@ def _prefill_plain(params, pools, tokens, block_ids, *, cfg: LlamaConfig,
     cache = init_cache(cfg, 1, bucket)
     positions = jnp.arange(bucket)[None, :]
     logits, cache = forward(params, tokens, cfg, cache=cache,
-                            positions=positions)
+                            positions=positions, lora=lora,
+                            lora_scale=lora_scale)
     k = jnp.stack([c["k"][0] for c in cache])
     v = jnp.stack([c["v"][0] for c in cache])
     pools = write_prefill(pools, k, v, block_ids)
@@ -557,8 +615,8 @@ def _prefill_plain(params, pools, tokens, block_ids, *, cfg: LlamaConfig,
 
 
 def _prefill_bucket(params, pools, suffix_tokens, prefix_table, prefix_len,
-                    suffix_blocks, *, cfg: LlamaConfig, pcfg: PagedConfig,
-                    bucket: int):
+                    suffix_blocks, lora=None, *, cfg: LlamaConfig,
+                    pcfg: PagedConfig, bucket: int, lora_scale: float = 1.0):
     """Suffix forward against a prefix-seeded contiguous cache; the
     suffix's K/V lands in the sequence's fresh blocks. With an empty
     prefix (prefix_len 0, scratch-padded table) this degenerates to the
@@ -567,7 +625,8 @@ def _prefill_bucket(params, pools, suffix_tokens, prefix_table, prefix_len,
     cache = init_cache_seed(pools, prefix_table, prefix_len, extra=bucket)
     positions = prefix_len + jnp.arange(bucket)[None, :]
     logits, cache = forward(params, suffix_tokens, cfg, cache=cache,
-                            positions=positions)
+                            positions=positions, lora=lora,
+                            lora_scale=lora_scale)
     # suffix K/V occupies [prefix_len, prefix_len + bucket) in the
     # contiguous cache (block-aligned: shared prefixes are whole blocks)
     k = jnp.stack([
@@ -582,12 +641,32 @@ def _prefill_bucket(params, pools, suffix_tokens, prefix_table, prefix_len,
     return pools, logits
 
 
+def _lora_delta_slots(h, site_stack, adapter_idx, scale):
+    """Per-slot LoRA delta inside the fused step: each slot gathers
+    ITS adapter's factors from the stack (XLA turns the gather + two
+    skinny batched matmuls into a few fused ops — no per-adapter
+    graphs, no weight materialization)."""
+    a = site_stack["a"][adapter_idx].astype(h.dtype)  # [S, in, r]
+    b = site_stack["b"][adapter_idx].astype(h.dtype)  # [S, r, out]
+    xa = jnp.einsum("sqi,sir->sqr", h, a)
+    return jnp.einsum("sqr,sro->sqo", xa, b) * jnp.asarray(scale, h.dtype)
+
+
 def _decode_step(params, pools, tokens, seq_lens, active, block_tables,
-                 temps, base_keys, step, *, cfg: LlamaConfig,
-                 pcfg: PagedConfig):
+                 temps, base_keys, step, loras, adapter_idx, *,
+                 cfg: LlamaConfig, pcfg: PagedConfig,
+                 lora_scale: float = 1.0):
     """One fused token step for every slot (see module doc)."""
     S = pcfg.max_slots
     keys = jax.vmap(jax.random.fold_in, (0, None))(base_keys, step)
+
+    def with_lora(out, h, layer_i, site):
+        if loras is None:
+            return out
+        site_stack = loras["layers"][layer_i].get(site)
+        if site_stack is None:
+            return out
+        return out + _lora_delta_slots(h, site_stack, adapter_idx, lora_scale)
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     positions = seq_lens - 1  # the incoming token's position
     x = params["embed"]["weight"][tokens].astype(cfg.dtype)[:, None, :]
@@ -600,20 +679,29 @@ def _decode_step(params, pools, tokens, seq_lens, active, block_tables,
 
     for layer_i, layer in enumerate(params["layers"]):
         h = rmsnorm_reference(x, layer["attn_norm"]["weight"], cfg.norm_eps)
-        q = _mm(h, layer["attn"]["wq"]).reshape(S, 1, cfg.n_heads, cfg.head_dim)
-        k = _mm(h, layer["attn"]["wk"]).reshape(S, 1, cfg.n_kv_heads, cfg.head_dim)
-        v = _mm(h, layer["attn"]["wv"]).reshape(S, 1, cfg.n_kv_heads, cfg.head_dim)
+        q = with_lora(_mm(h, layer["attn"]["wq"]), h, layer_i, "wq").reshape(
+            S, 1, cfg.n_heads, cfg.head_dim)
+        k = with_lora(_mm(h, layer["attn"]["wk"]), h, layer_i, "wk").reshape(
+            S, 1, cfg.n_kv_heads, cfg.head_dim)
+        v = with_lora(_mm(h, layer["attn"]["wv"]), h, layer_i, "wv").reshape(
+            S, 1, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, freqs, positions[:, None])
         k = apply_rope(k, freqs, positions[:, None])
 
         pools = _write_layer(pools, layer_i, k, v, write_block, write_off)
 
         out = _paged_attention(q, pools, block_tables, seq_lens, layer_i, cfg)
-        x = x + _mm(out.reshape(S, 1, cfg.dim), layer["attn"]["wo"])
+        o2 = out.reshape(S, 1, cfg.dim)
+        x = x + with_lora(_mm(o2, layer["attn"]["wo"]), o2, layer_i, "wo")
         h2 = rmsnorm_reference(x, layer["mlp_norm"]["weight"], cfg.norm_eps)
-        gate = jax.nn.silu(_mm(h2, layer["mlp"]["w_gate"]).astype(jnp.float32))
-        up = _mm(h2, layer["mlp"]["w_up"]).astype(jnp.float32)
-        x = x + _mm((gate * up).astype(cfg.dtype), layer["mlp"]["w_down"])
+        gate = jax.nn.silu(
+            with_lora(_mm(h2, layer["mlp"]["w_gate"]), h2, layer_i,
+                      "w_gate").astype(jnp.float32))
+        up = with_lora(_mm(h2, layer["mlp"]["w_up"]), h2, layer_i,
+                       "w_up").astype(jnp.float32)
+        gu = (gate * up).astype(cfg.dtype)
+        x = x + with_lora(_mm(gu, layer["mlp"]["w_down"]), gu, layer_i,
+                          "w_down")
 
     x = rmsnorm_reference(x, params["final_norm"]["weight"], cfg.norm_eps)
     if cfg.tie_embeddings:

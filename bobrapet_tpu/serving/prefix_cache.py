@@ -87,11 +87,17 @@ class PrefixCache:
 
     # -- content addressing ------------------------------------------------
 
-    def register(self, tokens: list[int], blocks: list[int]) -> None:
+    def register(self, tokens: list[int], blocks: list[int],
+                 salt: int = 0) -> None:
         """Record the chain hashes of every FULL block of ``tokens``
-        stored in ``blocks`` (block i holds tokens[i*B:(i+1)*B])."""
+        stored in ``blocks`` (block i holds tokens[i*B:(i+1)*B]).
+
+        ``salt`` scopes the chain (the engine passes the LoRA adapter
+        id): adapters with k/v deltas produce DIFFERENT cache content
+        for identical tokens, so cross-adapter sharing would serve the
+        wrong model."""
         b = self.block_size
-        parent = ROOT
+        parent = _chain_hash(ROOT, [salt])
         for i in range(len(tokens) // b):
             if i >= len(blocks):
                 break
@@ -101,13 +107,15 @@ class PrefixCache:
             self._by_hash[parent] = blk
             self._hash_of[blk] = parent
 
-    def match_prefix(self, tokens: list[int]) -> tuple[list[int], int]:
-        """Longest reusable block chain for ``tokens``; claims a
-        reference on every matched block. Returns (block_ids,
-        matched_token_count); the final token is never matched."""
+    def match_prefix(self, tokens: list[int],
+                     salt: int = 0) -> tuple[list[int], int]:
+        """Longest reusable block chain for ``tokens`` under ``salt``
+        (see :meth:`register`); claims a reference on every matched
+        block. Returns (block_ids, matched_token_count); the final
+        token is never matched."""
         b = self.block_size
         limit = (len(tokens) - 1) // b  # keep >= 1 token for the suffix
-        parent = ROOT
+        parent = _chain_hash(ROOT, [salt])
         matched: list[int] = []
         for i in range(limit):
             parent = _chain_hash(parent, tokens[i * b:(i + 1) * b])

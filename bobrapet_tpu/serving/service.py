@@ -90,6 +90,8 @@ class StreamServer:
                     temperature=float(msg.get("temperature") or 0.0),
                     eos_token=(int(msg["eos"]) if msg.get("eos") is not None
                                else None),
+                    adapter=(int(msg["adapter"])
+                             if msg.get("adapter") is not None else None),
                 )
                 self._rid_to_id[rid] = msg.get("id")
             except (KeyError, TypeError, ValueError) as e:

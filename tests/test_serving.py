@@ -647,3 +647,97 @@ class TestServingFuzz:
         for rid, want in reqs:
             assert done[rid].output == want, (seed, rid)
         assert eng.allocator.free_blocks == pcfg.num_blocks - 1
+
+
+class TestMultiLoRA:
+    """Many adapters over one resident base model: per-slot LoRA in the
+    fused step; every output matches a merged-weights reference."""
+
+    @pytest.fixture(scope="class")
+    def lora_setup(self, model):
+        from bobrapet_tpu.models import lora as lora_mod
+
+        cfg, params = model
+        lcfg = lora_mod.LoRAConfig(rank=4, alpha=8.0, sites=("wq", "wv"))
+        adapters = [lora_mod.zero_lora(cfg, lcfg)]
+        for seed in (1, 2):
+            a = lora_mod.init_lora(jax.random.PRNGKey(seed), cfg, lcfg)
+            # give B real content (init is zero so deltas start null)
+            a = jax.tree_util.tree_map(
+                lambda leaf: leaf + 0.05 * jax.random.normal(
+                    jax.random.PRNGKey(seed + 10), leaf.shape, leaf.dtype),
+                a,
+            )
+            adapters.append(a)
+        stacked = lora_mod.stack_adapters(adapters)
+        merged = [params] + [
+            lora_mod.merge_lora(params, a, lcfg.scale) for a in adapters[1:]
+        ]
+        return cfg, params, lcfg, stacked, merged
+
+    def _engine(self, cfg, params, stacked, lcfg, **pc):
+        base = dict(max_slots=3, block_size=8, num_blocks=64,
+                    max_blocks_per_seq=6)
+        base.update(pc)
+        return ServingEngine(params, cfg, PagedConfig(**base),
+                             loras=stacked, lora_scale=lcfg.scale)
+
+    def test_each_adapter_matches_merged_reference(self, lora_setup):
+        cfg, params, lcfg, stacked, merged = lora_setup
+        rng = np.random.default_rng(70)
+        prompt = rng.integers(0, cfg.vocab_size, 11).tolist()
+        eng = self._engine(cfg, params, stacked, lcfg)
+        rids = [eng.submit(prompt, max_new_tokens=5, adapter=i)
+                for i in range(3)]
+        done = {r.rid: r for r in eng.run()}
+        for i, rid in enumerate(rids):
+            want = _reference_tokens(merged[i], cfg, prompt, 5)
+            assert done[rid].output == want, f"adapter {i}"
+        # sanity: the adapters actually change the output
+        assert done[rids[1]].output != done[rids[0]].output
+
+    def test_mixed_adapters_decode_fused(self, lora_setup):
+        """Different adapters in the SAME decode batch stay independent
+        (per-slot gather, no cross-contamination)."""
+        cfg, params, lcfg, stacked, merged = lora_setup
+        rng = np.random.default_rng(71)
+        prompts = [rng.integers(0, cfg.vocab_size, 7 + 3 * i).tolist()
+                   for i in range(3)]
+        eng = self._engine(cfg, params, stacked, lcfg)
+        rids = [eng.submit(p, max_new_tokens=4, adapter=i)
+                for i, p in enumerate(prompts)]
+        done = {r.rid: r for r in eng.run()}
+        for i, (rid, p) in enumerate(zip(rids, prompts)):
+            assert done[rid].output == _reference_tokens(
+                merged[i], cfg, p, 4), f"adapter {i}"
+
+    def test_prefix_cache_is_adapter_scoped(self, lora_setup):
+        """Identical prompts under different adapters must NOT share KV
+        blocks (k/v deltas make the cache adapter-specific); the same
+        adapter still shares."""
+        cfg, params, lcfg, stacked, merged = lora_setup
+        rng = np.random.default_rng(72)
+        system = rng.integers(0, cfg.vocab_size, 16).tolist()
+        eng = self._engine(cfg, params, stacked, lcfg, num_blocks=64)
+        # all three admitted together so the first request's registered
+        # prefix blocks are still LIVE when the same-adapter request
+        # arrives (freed blocks may be lazily recycled by the
+        # intervening allocation — by design)
+        r1 = eng.submit(system + [1], max_new_tokens=2, adapter=1)
+        r2 = eng.submit(system + [2], max_new_tokens=2, adapter=2)
+        r3 = eng.submit(system + [3], max_new_tokens=2, adapter=1)
+        done = {r.rid: r for r in eng.run()}
+        # only the same-adapter pair shared the 16-token system prompt
+        assert eng.blocks.hit_tokens == 16
+        assert done[r1].output == _reference_tokens(
+            merged[1], cfg, system + [1], 2)
+        assert done[r2].output == _reference_tokens(
+            merged[2], cfg, system + [2], 2)
+        assert done[r3].output == _reference_tokens(
+            merged[1], cfg, system + [3], 2)
+
+    def test_out_of_range_adapter_rejected(self, lora_setup):
+        cfg, params, lcfg, stacked, _ = lora_setup
+        eng = self._engine(cfg, params, stacked, lcfg)
+        with pytest.raises(ValueError, match="adapter"):
+            eng.submit([1, 2, 3], max_new_tokens=2, adapter=7)
