@@ -741,3 +741,23 @@ class TestMultiLoRA:
         eng = self._engine(cfg, params, stacked, lcfg)
         with pytest.raises(ValueError, match="adapter"):
             eng.submit([1, 2, 3], max_new_tokens=2, adapter=7)
+
+
+class TestLongContextServing:
+    def test_near_max_seq_prompt_chunks_through(self, model):
+        """A prompt near the model's max_seq_len ingests in chunks and
+        decodes exactly (long-context serving path end to end)."""
+        cfg, params = model  # llama_tiny: max_seq_len 256
+        rng = np.random.default_rng(80)
+        prompt = rng.integers(0, cfg.vocab_size, 230).tolist()
+        want = _reference_tokens(params, cfg, prompt, 6)
+        eng = ServingEngine(params, cfg, PagedConfig(
+            max_slots=2, block_size=16, num_blocks=64,
+            max_blocks_per_seq=16, prefill_chunk=64))
+        rid = eng.submit(prompt, max_new_tokens=6)
+        # a short request rides along while the giant ingests
+        short = rng.integers(0, cfg.vocab_size, 5).tolist()
+        rs = eng.submit(short, max_new_tokens=6)
+        done = {r.rid: r for r in eng.run()}
+        assert done[rid].output == want
+        assert done[rs].output == _reference_tokens(params, cfg, short, 6)
