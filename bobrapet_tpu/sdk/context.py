@@ -133,6 +133,12 @@ class EngramContext:
             process_id=self.host_id,
         )
 
+    @property
+    def storage(self):
+        """The run's storage manager (None when storage is not wired) —
+        the public accessor extension code must use."""
+        return self._storage
+
     def mesh(self, axes: Optional[dict[str, int]] = None):
         """Build the granted jax.sharding.Mesh (local devices reshaped to
         the granted logical axes)."""

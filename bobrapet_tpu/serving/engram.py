@@ -1,0 +1,163 @@
+"""The serving engram: reference a model server from a Story step.
+
+The one-liner deployment path for inference: a streaming step whose
+template entrypoint is ``bobrapet_tpu.serving.engram:serve`` becomes a
+continuous-batching model server — prompts arrive on the step's input
+stream, completions leave on its downstream targets, and everything
+else (model config, checkpoint, quantization, paging, LoRA stack) comes
+from the step's ``with`` config through the env contract:
+
+```yaml
+steps:
+  - name: generate
+    ref: {name: llama-server}     # template entrypoint: ...engram:serve
+    transport: voz
+    with:
+      model: 1b                   # tiny | 1b | 8b
+      quant: int8                 # optional weight-only quantization
+      checkpoint: runs/prod/llama # optional blob-store prefix
+      lora:                       # optional multi-LoRA stack
+        rank: 8
+        alpha: 16
+        sites: [wq, wv]
+        checkpoints: [runs/prod/lora-support, runs/prod/lora-code]
+      paging: {maxSlots: 8, blockSize: 16, numBlocks: 512,
+               maxBlocksPerSeq: 64, prefillChunk: 256}
+      hub: bobravoz-hub.bobrapet-system.svc:50052
+```
+
+Requests select adapters by stack index over the wire (``"adapter": 1``
+= the first configured LoRA; 0 = base). Without a checkpoint the engram
+initializes from ``initSeed`` (dev / bench mode; ``lora.initSeeds``
+does the same for adapters). The server drains on input EOS and returns
+its completion count as the step output.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..models import llama, quant
+from ..models.lora import LoRAConfig, init_lora, stack_adapters, zero_lora
+from .engine import ServingEngine
+from .paged_cache import PagedConfig
+from .service import StreamServer
+
+_MODELS = {
+    "tiny": llama.llama_tiny,
+    "1b": llama.llama3_1b,
+    "8b": llama.llama3_8b,
+}
+
+
+def _paged_config(raw: dict[str, Any]) -> PagedConfig:
+    # None-sentinel defaults: an explicit 0 must reach PagedConfig /
+    # allocator validation, not silently become the default
+    return PagedConfig(
+        max_slots=int(raw.get("maxSlots", 8)),
+        block_size=int(raw.get("blockSize", 16)),
+        num_blocks=int(raw.get("numBlocks", 256)),
+        max_blocks_per_seq=int(raw.get("maxBlocksPerSeq", 32)),
+        prefix_caching=bool(raw.get("prefixCaching", True)),
+        prefill_chunk=(int(raw["prefillChunk"])
+                       if raw.get("prefillChunk") is not None else None),
+    )
+
+
+def _restore(ctx, prefix: str, like: Any) -> Any:
+    from ..sdk.checkpoint import restore_checkpoint
+
+    if ctx.storage is None:
+        raise ValueError(
+            f"config references checkpoint {prefix!r} but the context "
+            "has no storage manager — serving random weights instead "
+            "would be a silent correctness failure"
+        )
+    restored, _ = restore_checkpoint(ctx.storage.store, prefix, like)
+    return restored
+
+
+def _build_loras(ctx, cfg, raw: dict[str, Any]):
+    """Stacked adapter tree from config: blob-store checkpoints
+    (production) or initSeeds (dev) — index 0 is always the zero/base
+    adapter."""
+    lcfg = LoRAConfig(
+        rank=int(raw.get("rank", 8)),
+        alpha=float(raw.get("alpha", 16.0)),
+        sites=tuple(raw.get("sites") or ("wq", "wv")),
+    )
+    adapters = [zero_lora(cfg, lcfg)]
+    import jax
+
+    for prefix in raw.get("checkpoints") or []:
+        like = init_lora(jax.random.PRNGKey(0), cfg, lcfg)
+        adapters.append(_restore(ctx, str(prefix), {"lora": like})["lora"])
+    for seed in raw.get("initSeeds") or []:
+        adapters.append(init_lora(jax.random.PRNGKey(int(seed)), cfg, lcfg))
+    if len(adapters) == 1:
+        raise ValueError("config.lora needs checkpoints or initSeeds "
+                         "(an empty stack serves only the base model)")
+    return stack_adapters(adapters), lcfg.scale
+
+
+def build_engine(ctx) -> ServingEngine:
+    """ServingEngine from the step's config + the run's blob store."""
+    import jax
+
+    config = ctx.config
+    cfg = _MODELS[str(config.get("model", "tiny"))]()
+    ckpt = config.get("checkpoint")
+    if ckpt:
+        like = llama.init_params(jax.random.PRNGKey(0), cfg)
+        params = _restore(ctx, str(ckpt), {"params": like})["params"]
+    else:
+        params = llama.init_params(
+            jax.random.PRNGKey(int(config.get("initSeed") or 0)), cfg
+        )
+    if config.get("quant") == "int8":
+        params = quant.quantize_params(params)
+    loras, lora_scale = (None, 1.0)
+    if config.get("lora"):
+        loras, lora_scale = _build_loras(ctx, cfg, config["lora"])
+    return ServingEngine(params, cfg, _paged_config(config.get("paging") or {}),
+                         loras=loras, lora_scale=lora_scale)
+
+
+class _Broadcast:
+    """Fan a server's completion stream out to EVERY downstream target
+    (and close them all), so no consumer step ever hangs waiting for an
+    EOS that went to a sibling."""
+
+    def __init__(self, producers):
+        self.producers = producers
+
+    def send(self, payload, **kw) -> None:
+        for p in self.producers:
+            p.send(payload, **kw)
+
+    def close(self) -> None:
+        for p in self.producers:
+            try:
+                p.close()
+            except Exception:  # noqa: BLE001 - close the rest regardless
+                pass
+
+
+def serve(ctx) -> dict[str, Any]:
+    """Engram entrypoint: serve the step's input stream until EOS."""
+    config = ctx.config
+    hub = config.get("hub")
+    if not hub:
+        raise ValueError("serving engram needs config.hub (host:port of "
+                         "the stream hub carrying this step's input)")
+    # cheap topology checks BEFORE the expensive model build: a
+    # misconfigured step must not pay a full checkpoint restore first
+    producers = ctx.open_output_streams()
+    if not producers:
+        raise ValueError("serving engram has no downstream target to "
+                         "emit completions to")
+    engine = build_engine(ctx)
+    consumer = ctx.open_input_stream(str(hub))
+    server = StreamServer(engine, consumer, _Broadcast(producers))
+    served = server.run()
+    return {"served": served}

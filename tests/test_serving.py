@@ -761,3 +761,135 @@ class TestLongContextServing:
         done = {r.rid: r for r in eng.run()}
         assert done[rid].output == want
         assert done[rs].output == _reference_tokens(params, cfg, short, 6)
+
+
+class TestServingEngram:
+    """The packaged serving entrypoint: an EngramContext wired to the
+    hub serves prompts end to end (the deployable inference story)."""
+
+    def test_serve_entrypoint_over_hub(self, model):
+        import json as _json
+        import threading
+
+        from bobrapet_tpu.dataplane import (
+            StreamConsumer,
+            StreamHub,
+            StreamProducer,
+        )
+        from bobrapet_tpu.sdk import contract
+        from bobrapet_tpu.sdk.context import EngramContext
+        from bobrapet_tpu.serving.engram import serve
+
+        cfg, params = model
+        hub = StreamHub()
+        hub.start()
+        try:
+            targets = [{"grpc": {"host": "127.0.0.1", "port": hub.port,
+                                 "stepName": "sink"}}]
+            env = {
+                contract.ENV_NAMESPACE: "default",
+                contract.ENV_STORY_RUN: "r1",
+                contract.ENV_STEP: "generate",
+                contract.ENV_DOWNSTREAM_TARGETS: _json.dumps(targets),
+                contract.ENV_CONFIG: _json.dumps({
+                    "model": "tiny", "initSeed": 0,
+                    "hub": hub.endpoint,
+                    "paging": {"maxSlots": 2, "blockSize": 8,
+                               "numBlocks": 32, "maxBlocksPerSeq": 6},
+                }),
+            }
+            ctx = EngramContext(env)
+            results = []
+            done = threading.Event()
+
+            def drain():
+                c = StreamConsumer(hub.endpoint, "default/r1/sink",
+                                   decode_json=True)
+                for m in c:
+                    results.append(m)
+                done.set()
+
+            threading.Thread(target=drain, daemon=True).start()
+            out = {}
+            server_thread = threading.Thread(
+                target=lambda: out.update(serve(ctx)), daemon=True)
+            server_thread.start()
+
+            rng = np.random.default_rng(90)
+            prompts = {i: rng.integers(0, cfg.vocab_size, 6 + i).tolist()
+                       for i in range(3)}
+            p = StreamProducer(hub.endpoint, "default/r1/generate")
+            for i, prompt in prompts.items():
+                p.send({"id": i, "prompt": prompt, "maxNewTokens": 4})
+            p.close()
+            server_thread.join(120)
+            assert not server_thread.is_alive()
+            assert done.wait(30)
+        finally:
+            hub.stop()
+        assert out == {"served": 3}
+        got = {m["id"]: m["tokens"] for m in results}
+        # the engram's seed-0 init equals the test fixture's params
+        for i, prompt in prompts.items():
+            assert got[i] == _reference_tokens(params, cfg, prompt, 4)
+
+    def test_build_engine_restores_checkpoint(self, model):
+        """checkpoint config -> params restored from the run's blob
+        store drive the engine (train -> checkpoint -> serve via the
+        engram path)."""
+        import json as _json
+
+        from bobrapet_tpu.sdk import contract
+        from bobrapet_tpu.sdk.checkpoint import save_checkpoint
+        from bobrapet_tpu.sdk.context import EngramContext
+        from bobrapet_tpu.serving.engram import build_engine
+        from bobrapet_tpu.storage import MemoryStore, StorageManager
+
+        cfg, params = model
+        storage = StorageManager(MemoryStore())
+        save_checkpoint(storage.store, "runs/d/r1/model", {"params": params},
+                        step=3)
+        env = {contract.ENV_CONFIG: _json.dumps({
+            "model": "tiny", "checkpoint": "runs/d/r1/model",
+            "paging": {"maxSlots": 2, "blockSize": 8, "numBlocks": 16,
+                       "maxBlocksPerSeq": 4},
+        })}
+        ctx = EngramContext(env, storage=storage)
+        eng = build_engine(ctx)
+        rng = np.random.default_rng(91)
+        prompt = rng.integers(0, cfg.vocab_size, 8).tolist()
+        eng.submit(prompt, max_new_tokens=3)
+        assert eng.run()[0].output == _reference_tokens(params, cfg, prompt, 3)
+
+    def test_checkpoint_without_storage_raises(self, model):
+        import json as _json
+
+        from bobrapet_tpu.sdk import contract
+        from bobrapet_tpu.sdk.context import EngramContext
+        from bobrapet_tpu.serving.engram import build_engine
+
+        env = {contract.ENV_CONFIG: _json.dumps({
+            "model": "tiny", "checkpoint": "runs/prod/llama"})}
+        with pytest.raises(ValueError, match="storage"):
+            build_engine(EngramContext(env))  # never serve random weights
+
+    def test_lora_config_builds_adapter_stack(self, model):
+        import json as _json
+
+        from bobrapet_tpu.sdk import contract
+        from bobrapet_tpu.sdk.context import EngramContext
+        from bobrapet_tpu.serving.engram import build_engine
+
+        env = {contract.ENV_CONFIG: _json.dumps({
+            "model": "tiny", "initSeed": 0,
+            "lora": {"rank": 4, "alpha": 8, "sites": ["wq", "wv"],
+                     "initSeeds": [1, 2]},
+            "paging": {"maxSlots": 2, "blockSize": 8, "numBlocks": 16,
+                       "maxBlocksPerSeq": 4},
+        })}
+        eng = build_engine(EngramContext(env))
+        assert eng.n_adapters == 3  # zero/base + two configured
+        # adapter requests admit (freshly-initialized adapters have
+        # B = 0, so outputs equal base — the plumbing is what's tested)
+        eng.submit([1, 2, 3], max_new_tokens=2, adapter=2)
+        assert len(eng.run()) == 1
