@@ -89,8 +89,10 @@ def _build_loras(ctx, cfg, raw: dict[str, Any]):
     adapters = [zero_lora(cfg, lcfg)]
     import jax
 
+    # one zero tree supplies the restore structure for every adapter
+    # (restore_checkpoint discards template values)
+    like = zero_lora(cfg, lcfg)
     for prefix in raw.get("checkpoints") or []:
-        like = init_lora(jax.random.PRNGKey(0), cfg, lcfg)
         adapters.append(_restore(ctx, str(prefix), {"lora": like})["lora"])
     for seed in raw.get("initSeeds") or []:
         adapters.append(init_lora(jax.random.PRNGKey(int(seed)), cfg, lcfg))
@@ -105,7 +107,13 @@ def build_engine(ctx) -> ServingEngine:
     import jax
 
     config = ctx.config
-    cfg = _MODELS[str(config.get("model", "tiny"))]()
+    model_name = str(config.get("model", "tiny"))
+    if model_name not in _MODELS:
+        raise ValueError(
+            f"config.model {model_name!r} unknown; choose one of "
+            f"{sorted(_MODELS)}"
+        )
+    cfg = _MODELS[model_name]()
     ckpt = config.get("checkpoint")
     if ckpt:
         like = llama.init_params(jax.random.PRNGKey(0), cfg)
@@ -114,8 +122,14 @@ def build_engine(ctx) -> ServingEngine:
         params = llama.init_params(
             jax.random.PRNGKey(int(config.get("initSeed") or 0)), cfg
         )
-    if config.get("quant") == "int8":
+    quant_mode = config.get("quant")
+    if quant_mode == "int8":
         params = quant.quantize_params(params)
+    elif quant_mode not in (None, ""):
+        # silently serving full precision would hide the misconfig (and
+        # OOM the 8b single-chip shape the int8 path exists for)
+        raise ValueError(f"config.quant {quant_mode!r} unsupported "
+                         "(supported: int8)")
     loras, lora_scale = (None, 1.0)
     if config.get("lora"):
         loras, lora_scale = _build_loras(ctx, cfg, config["lora"])
@@ -156,8 +170,15 @@ def serve(ctx) -> dict[str, Any]:
     if not producers:
         raise ValueError("serving engram has no downstream target to "
                          "emit completions to")
-    engine = build_engine(ctx)
-    consumer = ctx.open_input_stream(str(hub))
-    server = StreamServer(engine, consumer, _Broadcast(producers))
+    broadcast = _Broadcast(producers)
+    try:
+        engine = build_engine(ctx)
+        consumer = ctx.open_input_stream(str(hub))
+    except BaseException:
+        # downstream consumers must see EOS even when the model build
+        # fails — leaked producers leave them blocked forever
+        broadcast.close()
+        raise
+    server = StreamServer(engine, consumer, broadcast)
     served = server.run()
     return {"served": served}

@@ -1,0 +1,100 @@
+"""HF Llama checkpoint conversion: our model math pinned to the
+canonical transformers implementation at the LOGIT level."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from bobrapet_tpu.models import llama  # noqa: E402
+from bobrapet_tpu.models.convert import (  # noqa: E402
+    config_from_hf,
+    load_hf,
+    params_from_hf_state_dict,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=160,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rope_theta=10_000.0,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+        attention_bias=False,
+        mlp_bias=False,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+class TestHFConversion:
+    def test_config_mapping(self, hf_model):
+        cfg = config_from_hf(hf_model.config)
+        assert (cfg.vocab_size, cfg.dim, cfg.n_layers) == (160, 64, 2)
+        assert (cfg.n_heads, cfg.n_kv_heads, cfg.ffn_hidden) == (4, 2, 128)
+        assert cfg.rope_theta == 10_000.0
+
+    def test_logits_match_transformers(self, hf_model):
+        """The whole model — embeddings, RMSNorm, GQA attention, RoPE
+        convention, SwiGLU, head — agrees with transformers' forward."""
+        params, cfg = load_hf(hf_model, dtype=jnp.float32)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (2, 24))
+        with torch.no_grad():
+            want = hf_model(torch.tensor(ids)).logits.numpy()
+        got, _ = llama.forward(params, jnp.asarray(ids, jnp.int32), cfg)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3, atol=2e-3)
+
+    def test_greedy_continuations_agree(self, hf_model):
+        params, cfg = load_hf(hf_model, dtype=jnp.float32)
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, cfg.vocab_size, (1, 10))
+        with torch.no_grad():
+            want = hf_model.generate(
+                torch.tensor(ids), max_new_tokens=6, do_sample=False,
+                pad_token_id=0,
+            ).numpy()[0, 10:]
+        got = llama.greedy_generate(
+            params, jnp.asarray(ids, jnp.int32), cfg,
+            max_new_tokens=6, cache_capacity=32,
+        )
+        np.testing.assert_array_equal(np.asarray(got)[0], want)
+
+    def test_converted_tree_serves_and_quantizes(self, hf_model):
+        """Converted weights drop into the serving engine and int8
+        path unchanged."""
+        from bobrapet_tpu.models import quant
+        from bobrapet_tpu.serving import PagedConfig, ServingEngine
+
+        params, cfg = load_hf(hf_model, dtype=jnp.float32)
+        rng = np.random.default_rng(2)
+        prompt = rng.integers(0, cfg.vocab_size, 9).tolist()
+        want = np.asarray(llama.greedy_generate(
+            params, jnp.asarray(prompt, jnp.int32)[None], cfg,
+            max_new_tokens=4, cache_capacity=32))[0].tolist()
+        eng = ServingEngine(params, cfg, PagedConfig(
+            max_slots=2, block_size=8, num_blocks=16, max_blocks_per_seq=4))
+        eng.submit(prompt, max_new_tokens=4)
+        assert eng.run()[0].output == want
+        qp = quant.quantize_params(params)  # int8 path accepts the tree
+        assert qp["layers"][0]["attn"]["wq"]["q"].dtype == jnp.int8
+
+    def test_missing_weight_named(self, hf_model):
+        cfg = config_from_hf(hf_model.config)
+        sd = dict(hf_model.state_dict())
+        sd.pop("model.layers.1.mlp.up_proj.weight")
+        with pytest.raises(KeyError, match="up_proj"):
+            params_from_hf_state_dict(sd, cfg)

@@ -893,3 +893,44 @@ class TestServingEngram:
         # B = 0, so outputs equal base — the plumbing is what's tested)
         eng.submit([1, 2, 3], max_new_tokens=2, adapter=2)
         assert len(eng.run()) == 1
+
+    def test_lora_checkpoint_contract(self, model):
+        """Adapters trained elsewhere restore through the engram's
+        {'lora': tree} checkpoint contract and actually change output."""
+        import json as _json
+
+        from bobrapet_tpu.models import lora as lora_mod
+        from bobrapet_tpu.sdk import contract
+        from bobrapet_tpu.sdk.checkpoint import save_checkpoint
+        from bobrapet_tpu.sdk.context import EngramContext
+        from bobrapet_tpu.serving.engram import build_engine
+        from bobrapet_tpu.storage import MemoryStore, StorageManager
+
+        cfg, params = model
+        lcfg = lora_mod.LoRAConfig(rank=4, alpha=8.0, sites=("wq", "wv"))
+        trained = jax.tree_util.tree_map(
+            lambda leaf: leaf + 0.05 * jax.random.normal(
+                jax.random.PRNGKey(5), leaf.shape, leaf.dtype),
+            lora_mod.init_lora(jax.random.PRNGKey(4), cfg, lcfg),
+        )
+        storage = StorageManager(MemoryStore())
+        save_checkpoint(storage.store, "runs/d/r2/adapter-a",
+                        {"lora": trained}, step=1)
+        env = {contract.ENV_CONFIG: _json.dumps({
+            "model": "tiny", "initSeed": 0,
+            "lora": {"rank": 4, "alpha": 8, "sites": ["wq", "wv"],
+                     "checkpoints": ["runs/d/r2/adapter-a"]},
+            "paging": {"maxSlots": 2, "blockSize": 8, "numBlocks": 32,
+                       "maxBlocksPerSeq": 6},
+        })}
+        eng = build_engine(EngramContext(env, storage=storage))
+        assert eng.n_adapters == 2
+        rng = np.random.default_rng(92)
+        prompt = rng.integers(0, cfg.vocab_size, 9).tolist()
+        r0 = eng.submit(prompt, max_new_tokens=4, adapter=0)
+        r1 = eng.submit(prompt, max_new_tokens=4, adapter=1)
+        done = {r.rid: r for r in eng.run()}
+        merged = lora_mod.merge_lora(params, trained, lcfg.scale)
+        assert done[r1].output == _reference_tokens(merged, cfg, prompt, 4)
+        assert done[r0].output == _reference_tokens(params, cfg, prompt, 4)
+        assert done[r0].output != done[r1].output
