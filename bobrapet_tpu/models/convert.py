@@ -31,14 +31,22 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
     get = (hf_config.get if isinstance(hf_config, dict)
            else lambda k, d=None: getattr(hf_config, k, d))
     scaling = get("rope_scaling")
+    rope_scaling = None
     if scaling:
-        # llama.rope_frequencies implements the unscaled schedule only;
-        # converting a rope-scaled checkpoint (Llama-3.1's
-        # {rope_type: llama3, factor: 8} etc.) would SILENTLY break the
-        # logit-level agreement this module promises
-        raise ValueError(
-            f"rope_scaling {scaling!r} is not supported; only unscaled "
-            "RoPE checkpoints convert faithfully"
+        rope_type = scaling.get("rope_type") or scaling.get("type")
+        if rope_type != "llama3":
+            # only the published llama3 remap is implemented; converting
+            # linear/dynamic/yarn checkpoints would SILENTLY break the
+            # logit-level agreement this module promises
+            raise ValueError(
+                f"rope_scaling type {rope_type!r} is not supported "
+                "(supported: llama3)"
+            )
+        rope_scaling = (
+            float(scaling["factor"]),
+            float(scaling.get("low_freq_factor", 1.0)),
+            float(scaling.get("high_freq_factor", 4.0)),
+            int(scaling.get("original_max_position_embeddings", 8192)),
         )
     if get("attention_bias") or get("mlp_bias"):
         raise ValueError(
@@ -54,6 +62,7 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
         ffn_hidden=int(get("intermediate_size")),
         max_seq_len=int(get("max_position_embeddings")),
         rope_theta=float(get("rope_theta") or 10_000.0),
+        rope_scaling=rope_scaling,
         norm_eps=float(get("rms_norm_eps") or 1e-5),
         tie_embeddings=bool(get("tie_word_embeddings") or False),
     )

@@ -34,6 +34,9 @@ class LlamaConfig:
     ffn_hidden: int = 14_336
     max_seq_len: int = 8192
     rope_theta: float = 500_000.0
+    #: Llama-3.1 long-context RoPE remap: (factor, low_freq_factor,
+    #: high_freq_factor, original_max_position_embeddings) or None
+    rope_scaling: Optional[tuple] = None
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
@@ -234,7 +237,8 @@ def forward(
     """
     if attn_fn is None:
         attn_fn = lambda q, k, v: attention(q, k, v, causal=True)  # noqa: E731
-    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                             cfg.rope_theta, cfg.rope_scaling)
     x = params["embed"]["weight"][tokens].astype(cfg.dtype)
     new_caches: Optional[list[dict[str, jax.Array]]] = [] if cache is not None else None
     for i, layer in enumerate(params["layers"]):

@@ -13,13 +13,37 @@ import jax
 import jax.numpy as jnp
 
 
-@functools.partial(jax.jit, static_argnames=("dim", "max_seq_len", "theta"))
-def rope_frequencies(dim: int, max_seq_len: int, theta: float = 500_000.0) -> jax.Array:
+@functools.partial(
+    jax.jit, static_argnames=("dim", "max_seq_len", "theta", "scaling")
+)
+def rope_frequencies(
+    dim: int,
+    max_seq_len: int,
+    theta: float = 500_000.0,
+    scaling: tuple[float, float, float, int] | None = None,
+) -> jax.Array:
     """Complex rotation table [max_seq_len, dim//2] as (cos, sin) stacked.
 
-    theta=500k is the Llama-3 base.
+    theta=500k is the Llama-3 base. ``scaling`` is the Llama-3.1
+    long-context frequency remap ``(factor, low_freq_factor,
+    high_freq_factor, original_max_position_embeddings)``: wavelengths
+    beyond the original context divide by ``factor``, short wavelengths
+    stay, the band between interpolates smoothly (the published llama3
+    rope_type; matches transformers' implementation).
     """
     inv_freq = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    if scaling is not None:
+        factor, low_f, high_f, orig_len = scaling
+        wavelen = 2.0 * jnp.pi / inv_freq
+        low_wavelen = orig_len / low_f
+        high_wavelen = orig_len / high_f
+        smooth = (orig_len / wavelen - low_f) / (high_f - low_f)
+        interpolated = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        inv_freq = jnp.where(
+            wavelen < high_wavelen,
+            inv_freq,
+            jnp.where(wavelen > low_wavelen, inv_freq / factor, interpolated),
+        )
     t = jnp.arange(max_seq_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)  # [S, dim/2]
     return jnp.stack([jnp.cos(freqs), jnp.sin(freqs)], axis=-1)  # [S, dim/2, 2]

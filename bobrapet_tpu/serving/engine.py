@@ -667,7 +667,8 @@ def _decode_step(params, pools, tokens, seq_lens, active, block_tables,
         if site_stack is None:
             return out
         return out + _lora_delta_slots(h, site_stack, adapter_idx, lora_scale)
-    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                             cfg.rope_theta, cfg.rope_scaling)
     positions = seq_lens - 1  # the incoming token's position
     x = params["embed"]["weight"][tokens].astype(cfg.dtype)[:, None, :]
 

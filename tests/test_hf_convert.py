@@ -98,3 +98,53 @@ class TestHFConversion:
         sd.pop("model.layers.1.mlp.up_proj.weight")
         with pytest.raises(KeyError, match="up_proj"):
             params_from_hf_state_dict(sd, cfg)
+
+
+class TestRopeScaling:
+    def test_llama3_rope_scaling_matches_transformers(self):
+        """Llama-3.1-style long-context checkpoints (rope_type=llama3)
+        convert AND agree with transformers' scaled-RoPE forward."""
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=128,
+            hidden_size=64,
+            intermediate_size=96,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            max_position_embeddings=256,
+            rope_theta=10_000.0,
+            rope_scaling={
+                "rope_type": "llama3",
+                "factor": 8.0,
+                "low_freq_factor": 1.0,
+                "high_freq_factor": 4.0,
+                "original_max_position_embeddings": 64,
+            },
+            tie_word_embeddings=False,
+            attention_bias=False,
+            mlp_bias=False,
+        )
+        torch.manual_seed(2)
+        model = transformers.LlamaForCausalLM(hf_cfg).eval()
+        params, cfg = load_hf(model, dtype=jnp.float32)
+        assert cfg.rope_scaling == (8.0, 1.0, 4.0, 64)
+        rng = np.random.default_rng(3)
+        # positions BEYOND the original 64-token context exercise the
+        # scaled band for real
+        ids = rng.integers(0, cfg.vocab_size, (1, 150))
+        with torch.no_grad():
+            want = model(torch.tensor(ids)).logits.numpy()
+        got, _ = llama.forward(params, jnp.asarray(ids, jnp.int32), cfg)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3, atol=2e-3)
+
+    def test_unsupported_scaling_types_rejected(self, hf_model):
+        from bobrapet_tpu.models.convert import config_from_hf
+
+        cfg_dict = {
+            "vocab_size": 128, "hidden_size": 64, "intermediate_size": 96,
+            "num_hidden_layers": 1, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "max_position_embeddings": 64,
+            "rope_scaling": {"rope_type": "yarn", "factor": 4.0},
+        }
+        with pytest.raises(ValueError, match="yarn"):
+            config_from_hf(cfg_dict)
