@@ -612,11 +612,87 @@ def run_decode_child() -> None:
     })
 
 
+def run_serving_child() -> None:
+    """Serving-engine + speculative-decoding throughput on the default
+    backend (runs only after the headline decode line is secured)."""
+    import jax
+
+    if os.environ.get("BENCH_CHILD_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    backend = jax.default_backend()
+
+    import numpy as np
+
+    from bobrapet_tpu.models import llama
+    from bobrapet_tpu.models.speculative import speculative_generate
+    from bobrapet_tpu.serving import PagedConfig, ServingEngine
+
+    model_name = os.environ.get("BENCH_MODEL") or ("1b" if backend != "cpu" else "tiny")
+    cfg = {"tiny": llama.llama_tiny, "1b": llama.llama3_1b,
+           "8b": llama.llama3_8b}[model_name]()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+
+    # --- continuous batching: 16 requests over 8 slots -----------------
+    eng = ServingEngine(params, cfg, PagedConfig(
+        max_slots=8, block_size=16, num_blocks=256, max_blocks_per_seq=16))
+    n_req, n_new = 16, 32
+    for i in range(n_req):
+        eng.submit(rng.integers(0, cfg.vocab_size, 32 + (i % 4) * 32).tolist(),
+                   max_new_tokens=n_new)
+    eng.step()  # compile warm-up (tokens excluded below)
+    warm = sum(len(s_.request.output) for s_ in eng.slots if s_) + sum(
+        len(r.output) for r in eng.finished)
+    t0 = time.perf_counter()
+    done = eng.run()
+    serving_wall = time.perf_counter() - t0
+    serving_tokens = sum(len(r.output) for r in done) - warm
+    _emit({
+        "metric": "serving_decode_tokens_per_sec",
+        "value": round(serving_tokens / serving_wall, 1),
+        "unit": "tok/s",
+        "vs_baseline": 1.0,
+        "config": "serving",
+        "backend": backend,
+        "model": model_name,
+        "requests": n_req,
+        "slots": 8,
+        "wallclock_s": round(serving_wall, 3),
+    })
+
+    # --- speculative decoding: tiny draft over the target --------------
+    dcfg = llama.llama_tiny(vocab_size=cfg.vocab_size)
+    draft = llama.init_params(jax.random.PRNGKey(7), dcfg)
+    prompt = rng.integers(0, cfg.vocab_size, (1, 64)).astype("int32")
+    spec = jax.jit(lambda t, d, p: speculative_generate(
+        t, d, p, cfg, dcfg, max_new_tokens=64, k=4))
+    res = spec(params, draft, prompt)
+    jax.block_until_ready(res.tokens)  # compile
+    t0 = time.perf_counter()
+    res = spec(params, draft, prompt)
+    jax.block_until_ready(res.tokens)
+    spec_wall = time.perf_counter() - t0
+    _emit({
+        "metric": "speculative_decode_tokens_per_sec",
+        "value": round(64 / spec_wall, 1),
+        "unit": "tok/s",
+        "vs_baseline": 1.0,
+        "config": "speculative",
+        "backend": backend,
+        "model": model_name,
+        "k": 4,
+        "rounds": int(res.rounds),
+        "accept_rate": round(float(res.accepted) / max(1, float(res.drafted)), 3),
+        "wallclock_s": round(spec_wall, 3),
+    })
+
+
 def _spawn_decode(cpu: bool, model: str | None, quant: str | None,
-                  timeout: float, extra: dict | None = None) -> dict | None:
-    """Run the decode bench in a child process; return its JSON line."""
+                  timeout: float, extra: dict | None = None,
+                  child: str = "decode") -> dict | None:
+    """Run a bench child process; return its LAST JSON line."""
     env = dict(os.environ)
-    env["BENCH_CHILD"] = "decode"
+    env["BENCH_CHILD"] = child
     env.pop("JAX_PLATFORMS", None)
     env.pop("BENCH_CHILD_CPU", None)
     if cpu:
@@ -659,9 +735,41 @@ def _spawn_decode(cpu: bool, model: str | None, quant: str | None,
     return line
 
 
+def _spawn_passthrough(child: str, model: str | None, timeout: float,
+                       cpu: bool = False) -> None:
+    """Run a multi-line bench child and pass its JSON lines through."""
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = child
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("BENCH_CHILD_CPU", None)
+    if cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_CHILD_CPU"] = "1"
+    if model:
+        env["BENCH_MODEL"] = model
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=timeout, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        _emit({"metric": f"{child}_child_timeout", "value": 0.0,
+               "unit": "error", "vs_baseline": 0.0,
+               "error": f"{child} child timed out after {timeout:.0f}s"})
+        return
+    for ln in (proc.stdout or "").strip().splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            print(ln)
+            sys.stdout.flush()
+
+
 def main() -> None:
     if os.environ.get("BENCH_CHILD") == "decode":
         run_decode_child()
+        return
+    if os.environ.get("BENCH_CHILD") == "serving":
+        run_serving_child()
         return
 
     state: dict = {"stage": "start"}
@@ -695,9 +803,15 @@ def main() -> None:
                 and not os.environ.get("BENCH_MODEL") and _remaining() > 300):
             state["stage"] = "decode-8b-int8"
             r8 = _spawn_decode(cpu=False, model="8b", quant="int8",
-                               timeout=_remaining() - 60.0)
+                               timeout=max(120.0, _remaining() - 240.0))
             if r8:
                 results.append(r8)
+            if _remaining() > 240:
+                # serving-engine + speculative throughput on the real
+                # chip (extra lines; headline decode already secured)
+                state["stage"] = "serving-extras"
+                _spawn_passthrough("serving", None,
+                                   timeout=_remaining() - 60.0)
     else:
         r = _spawn_decode(cpu=True, model=os.environ.get("BENCH_MODEL"),
                           quant=None, timeout=max(120.0, _remaining() - 120.0),
