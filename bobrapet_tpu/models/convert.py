@@ -131,3 +131,109 @@ def load_hf(model_or_path: Any, dtype: Optional[Any] = None
     cfg = config_from_hf(model.config)
     params = params_from_hf_state_dict(model.state_dict(), cfg, dtype)
     return params, cfg
+
+
+# ---------------------------------------------------------------------------
+# Mixtral (sparse MoE)
+# ---------------------------------------------------------------------------
+
+
+def moe_config_from_hf(hf_config: Any,
+                       capacity_factor: Optional[float] = None):
+    """transformers ``MixtralConfig`` -> MoEConfig.
+
+    HF Mixtral routes every token to its top-k experts with NO capacity
+    limit; this implementation uses static per-expert capacity (tokens
+    over budget drop). For faithful conversion the default capacity
+    factor is ``n_experts`` — enough for the worst case (every token
+    picking the same expert), so nothing ever drops and logits agree
+    with transformers exactly. Serving deployments can pass a tighter
+    ``capacity_factor`` to trade exactness at the margin for memory.
+    """
+    from .moe import MoEConfig
+
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    n_experts = int(get("num_local_experts"))
+    return MoEConfig(
+        vocab_size=int(get("vocab_size")),
+        dim=int(get("hidden_size")),
+        n_layers=int(get("num_hidden_layers")),
+        n_heads=int(get("num_attention_heads")),
+        n_kv_heads=int(get("num_key_value_heads") or get("num_attention_heads")),
+        ffn_hidden=int(get("intermediate_size")),
+        n_experts=n_experts,
+        experts_per_token=int(get("num_experts_per_tok") or 2),
+        capacity_factor=(float(capacity_factor)
+                         if capacity_factor is not None else float(n_experts)),
+        max_seq_len=int(get("max_position_embeddings")),
+        rope_theta=float(get("rope_theta") or 1_000_000.0),
+        norm_eps=float(get("rms_norm_eps") or 1e-5),
+    )
+
+
+def moe_params_from_hf_state_dict(
+    state_dict: Mapping[str, Any],
+    cfg: Any,
+    dtype: Optional[Any] = None,
+) -> dict[str, Any]:
+    """HF ``MixtralForCausalLM`` state dict -> moe.py param tree
+    (expert weights stacked on a leading E axis; HF w1 = gate,
+    w3 = up, w2 = down)."""
+    dtype = dtype or cfg.dtype
+    sd = state_dict
+
+    def w(name: str, transpose: bool = False) -> jnp.ndarray:
+        if name not in sd:
+            raise KeyError(f"HF state dict missing {name!r}")
+        arr = _to_np(sd[name])
+        if transpose:
+            arr = arr.T
+        return jnp.asarray(arr, dtype)
+
+    def experts(layer: int, which: str, transpose: bool) -> jnp.ndarray:
+        return jnp.stack([
+            w(f"model.layers.{layer}.block_sparse_moe.experts.{j}."
+              f"{which}.weight", transpose=transpose)
+            for j in range(cfg.n_experts)
+        ])
+
+    params: dict[str, Any] = {
+        "embed": {"weight": w("model.embed_tokens.weight")},
+        "layers": [],
+        "final_norm": {"weight": w("model.norm.weight")},
+        "lm_head": {"weight": w("lm_head.weight", transpose=True)},
+    }
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        params["layers"].append({
+            "attn_norm": {"weight": w(p + "input_layernorm.weight")},
+            "attn": {
+                "wq": w(p + "self_attn.q_proj.weight", transpose=True),
+                "wk": w(p + "self_attn.k_proj.weight", transpose=True),
+                "wv": w(p + "self_attn.v_proj.weight", transpose=True),
+                "wo": w(p + "self_attn.o_proj.weight", transpose=True),
+            },
+            "mlp_norm": {"weight": w(p + "post_attention_layernorm.weight")},
+            "moe": {
+                "w_router": w(p + "block_sparse_moe.gate.weight",
+                              transpose=True),
+                "w_gate": experts(i, "w1", transpose=True),
+                "w_up": experts(i, "w3", transpose=True),
+                "w_down": experts(i, "w2", transpose=True),
+            },
+        })
+    return params
+
+
+def load_hf_mixtral(model_or_path: Any, dtype: Optional[Any] = None,
+                    capacity_factor: Optional[float] = None):
+    """Convenience: transformers Mixtral model or path -> (params, cfg)."""
+    model = model_or_path
+    if isinstance(model_or_path, str):
+        from transformers import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_pretrained(model_or_path)
+    cfg = moe_config_from_hf(model.config, capacity_factor)
+    params = moe_params_from_hf_state_dict(model.state_dict(), cfg, dtype)
+    return params, cfg

@@ -148,3 +148,61 @@ class TestRopeScaling:
         }
         with pytest.raises(ValueError, match="yarn"):
             config_from_hf(cfg_dict)
+
+
+class TestMixtralConversion:
+    """The MoE family pinned to transformers' MixtralForCausalLM."""
+
+    @pytest.fixture(scope="class")
+    def hf_mixtral(self):
+        hf_cfg = transformers.MixtralConfig(
+            vocab_size=128,
+            hidden_size=64,
+            intermediate_size=96,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            num_local_experts=4,
+            num_experts_per_tok=2,
+            max_position_embeddings=128,
+            rope_theta=10_000.0,
+            sliding_window=None,
+            tie_word_embeddings=False,
+            attention_bias=False,
+        )
+        torch.manual_seed(3)
+        model = transformers.MixtralForCausalLM(hf_cfg)
+        model.eval()
+        return model
+
+    def test_logits_match_transformers(self, hf_mixtral):
+        """Routing (softmax -> top-2 -> renormalize), expert SwiGLU,
+        dispatch/combine, and attention all agree with the canonical
+        implementation (no-drop capacity)."""
+        from bobrapet_tpu.models import moe
+        from bobrapet_tpu.models.convert import load_hf_mixtral
+
+        params, cfg = load_hf_mixtral(hf_mixtral, dtype=jnp.float32)
+        assert cfg.n_experts == 4 and cfg.experts_per_token == 2
+        rng = np.random.default_rng(4)
+        ids = rng.integers(0, cfg.vocab_size, (2, 20))
+        with torch.no_grad():
+            want = hf_mixtral(torch.tensor(ids)).logits.numpy()
+        got, _, _ = moe.forward(params, jnp.asarray(ids, jnp.int32), cfg)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3, atol=2e-3)
+
+    def test_expert_weight_mapping(self, hf_mixtral):
+        from bobrapet_tpu.models.convert import load_hf_mixtral
+
+        params, cfg = load_hf_mixtral(hf_mixtral, dtype=jnp.float32)
+        moe_layer = params["layers"][0]["moe"]
+        assert moe_layer["w_gate"].shape == (4, 64, 96)   # [E, D, F]
+        assert moe_layer["w_down"].shape == (4, 96, 64)   # [E, F, D]
+        assert moe_layer["w_router"].shape == (64, 4)     # [D, E]
+        sd = hf_mixtral.state_dict()
+        np.testing.assert_allclose(
+            np.asarray(moe_layer["w_gate"][1]),
+            sd["model.layers.0.block_sparse_moe.experts.1.w1.weight"
+               ].numpy().T,
+            rtol=1e-6,
+        )
