@@ -281,9 +281,17 @@ def greedy_generate(
     cfg: LlamaConfig,
     max_new_tokens: int = 32,
     cache_capacity: Optional[int] = None,
+    forward_fn=None,
 ) -> jax.Array:
     """Greedy decode with a KV cache; prefill + lax.scan decode loop
-    (compiler-friendly: fixed shapes, no Python loop per token)."""
+    (compiler-friendly: fixed shapes, no Python loop per token).
+
+    ``forward_fn(params, tokens, cfg, cache, positions) -> (logits,
+    cache)`` swaps the model family (the MoE family reuses this exact
+    loop rather than copying it)."""
+    if forward_fn is None:
+        forward_fn = lambda p, t, c, cache, pos: forward(  # noqa: E731
+            p, t, c, cache=cache, positions=pos)
     b, prompt_len = prompt.shape
     cap = cache_capacity or min(cfg.max_seq_len, prompt_len + max_new_tokens)
     if prompt_len + max_new_tokens > cap:
@@ -296,15 +304,12 @@ def greedy_generate(
     cache = init_cache(cfg, b, cap)
 
     positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
-    logits, cache = forward(params, prompt, cfg, cache=cache, positions=positions)
+    logits, cache = forward_fn(params, prompt, cfg, cache, positions)
     next_tok = jnp.argmax(logits[:, -1:, :], axis=-1)
 
     def step(carry, _):
         cache, tok, pos = carry
-        logits, cache = forward(
-            params, tok, cfg, cache=cache,
-            positions=pos[:, None],
-        )
+        logits, cache = forward_fn(params, tok, cfg, cache, pos[:, None])
         nxt = jnp.argmax(logits[:, -1:, :], axis=-1)
         return (cache, nxt, pos + 1), tok[:, 0]
 

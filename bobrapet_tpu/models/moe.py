@@ -48,6 +48,8 @@ class MoEConfig:
     capacity_factor: float = 1.25  # per-expert token budget multiplier
     max_seq_len: int = 8192
     rope_theta: float = 1_000_000.0
+    #: Llama-3.1-style long-context RoPE remap (see llama.LlamaConfig)
+    rope_scaling: Optional[tuple] = None
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
 
@@ -67,8 +69,8 @@ class MoEConfig:
             vocab_size=self.vocab_size, dim=self.dim, n_layers=self.n_layers,
             n_heads=self.n_heads, n_kv_heads=self.n_kv_heads,
             ffn_hidden=self.ffn_hidden, max_seq_len=self.max_seq_len,
-            rope_theta=self.rope_theta, norm_eps=self.norm_eps,
-            dtype=self.dtype,
+            rope_theta=self.rope_theta, rope_scaling=self.rope_scaling,
+            norm_eps=self.norm_eps, dtype=self.dtype,
         )
 
 
@@ -225,7 +227,8 @@ def forward(
     if attn_fn is None:
         attn_fn = lambda q, k, v: attention(q, k, v, causal=True)  # noqa: E731
     lcfg = cfg.as_llama()
-    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                             cfg.rope_theta, cfg.rope_scaling)
     x = params["embed"]["weight"][tokens].astype(cfg.dtype)
     new_caches: Optional[list] = [] if cache is not None else None
     aux_total = jnp.array(0.0, jnp.float32)
@@ -250,3 +253,35 @@ def loss_fn(params, tokens, targets, cfg: MoEConfig,
         jax.nn.log_softmax(logits, axis=-1), targets[..., None], axis=-1
     ).mean()
     return ce + aux_weight * aux / cfg.n_layers
+
+
+# ---------------------------------------------------------------------------
+# KV cache + generation (reference decode for the serving engine)
+# ---------------------------------------------------------------------------
+
+
+def init_cache(cfg: MoEConfig, batch: int, capacity: Optional[int] = None):
+    """Same attention-cache shape as the dense family (MoE replaces
+    only the MLP)."""
+    from .llama import init_cache as _llama_init_cache
+
+    return _llama_init_cache(cfg.as_llama(), batch, capacity)
+
+
+def greedy_generate(
+    params: dict[str, Any],
+    prompt: jax.Array,
+    cfg: MoEConfig,
+    max_new_tokens: int = 32,
+    cache_capacity: Optional[int] = None,
+) -> jax.Array:
+    """Greedy decode for the MoE family — llama's prefill+scan loop
+    with the routed forward plugged in (no copied loop)."""
+    from .llama import greedy_generate as _greedy
+
+    def fwd(p, t, c, cache, pos):
+        logits, cache, _aux = forward(p, t, c, cache=cache, positions=pos)
+        return logits, cache
+
+    return _greedy(params, prompt, cfg, max_new_tokens=max_new_tokens,
+                   cache_capacity=cache_capacity, forward_fn=fwd)

@@ -104,6 +104,34 @@ class ServingEngine:
         self.params = params
         self.cfg = cfg
         self.pcfg = pcfg or PagedConfig()
+        #: sparse-MoE family (MoEConfig): the fused step swaps the
+        #: dense MLP for the routed dispatch/combine block; attention,
+        #: paging, scheduling are identical
+        from ..models.moe import MoEConfig as _MoEConfig
+
+        self.is_moe = isinstance(cfg, _MoEConfig)
+        if self.is_moe:
+            if loras is not None:
+                raise ValueError("multi-LoRA serving is dense-family only")
+            if cfg.capacity_factor < cfg.n_experts:
+                # the fused step routes every SLOT as one token batch:
+                # under a droppy capacity, co-scheduled requests (and
+                # inactive-slot garbage) would displace each other's
+                # expert assignments — outputs would vary with
+                # co-tenancy. Serving demands no-drop routing.
+                raise ValueError(
+                    f"MoE serving requires a no-drop capacity_factor "
+                    f">= n_experts ({cfg.n_experts}); got "
+                    f"{cfg.capacity_factor}. Use dataclasses.replace "
+                    f"(moe_config_from_hf defaults to no-drop)."
+                )
+            router = params["layers"][0]["moe"]["w_router"]
+            if quant.is_quantized(router) or isinstance(router, dict):
+                raise ValueError(
+                    "int8 weight-only quantization is dense-family "
+                    "only (the MoE dispatch einsums do not consume "
+                    "quantized leaves)"
+                )
         #: multi-LoRA: a STACKED adapter tree (models/lora.py
         #: stack_adapters; index 0 must be the zero adapter) — one
         #: compiled step serves any per-slot adapter mix
@@ -139,7 +167,7 @@ class ServingEngine:
         self._steps = 0
         self._decode_fn = jax.jit(
             functools.partial(_decode_step, cfg=cfg, pcfg=self.pcfg,
-                              lora_scale=lora_scale),
+                              lora_scale=lora_scale, is_moe=self.is_moe),
             donate_argnums=(1,),
         )
         self._prefill_fns: dict[int, Any] = {}
@@ -487,7 +515,8 @@ class ServingEngine:
                 fn = jax.jit(
                     functools.partial(_prefill_bucket, cfg=self.cfg,
                                       pcfg=self.pcfg, bucket=bucket,
-                                      lora_scale=self.lora_scale),
+                                      lora_scale=self.lora_scale,
+                                      is_moe=self.is_moe),
                     donate_argnums=(1,),
                 )
                 self._prefill_seed_fns[key] = fn
@@ -510,7 +539,8 @@ class ServingEngine:
                 fn = jax.jit(
                     functools.partial(_prefill_plain, cfg=self.cfg,
                                       bucket=bucket,
-                                      lora_scale=self.lora_scale),
+                                      lora_scale=self.lora_scale,
+                                      is_moe=self.is_moe),
                     donate_argnums=(1,),
                 )
                 self._prefill_fns[bucket] = fn
@@ -597,17 +627,31 @@ class ServingEngine:
 # ---------------------------------------------------------------------------
 
 
+def _family_forward(params, tokens, cfg, cache, positions, lora,
+                    lora_scale, is_moe):
+    """Dense vs MoE forward behind one (logits, cache) signature."""
+    if is_moe:
+        from ..models import moe as moe_mod
+
+        logits, cache, _aux = moe_mod.forward(
+            params, tokens, cfg, cache=cache, positions=positions
+        )
+        return logits, cache
+    return forward(params, tokens, cfg, cache=cache, positions=positions,
+                   lora=lora, lora_scale=lora_scale)
+
+
 def _prefill_plain(params, pools, tokens, block_ids, lora=None, *,
-                   cfg: LlamaConfig, bucket: int, lora_scale: float = 1.0):
+                   cfg: LlamaConfig, bucket: int, lora_scale: float = 1.0,
+                   is_moe: bool = False):
     """Full-prompt prefill without a shared prefix: contiguous cache of
     exactly bucket capacity (the pre-prefix-caching hot path)."""
     from ..models.llama import init_cache
 
-    cache = init_cache(cfg, 1, bucket)
+    cache = init_cache(cfg if not is_moe else cfg.as_llama(), 1, bucket)
     positions = jnp.arange(bucket)[None, :]
-    logits, cache = forward(params, tokens, cfg, cache=cache,
-                            positions=positions, lora=lora,
-                            lora_scale=lora_scale)
+    logits, cache = _family_forward(params, tokens, cfg, cache, positions,
+                                    lora, lora_scale, is_moe)
     k = jnp.stack([c["k"][0] for c in cache])
     v = jnp.stack([c["v"][0] for c in cache])
     pools = write_prefill(pools, k, v, block_ids)
@@ -616,7 +660,8 @@ def _prefill_plain(params, pools, tokens, block_ids, lora=None, *,
 
 def _prefill_bucket(params, pools, suffix_tokens, prefix_table, prefix_len,
                     suffix_blocks, lora=None, *, cfg: LlamaConfig,
-                    pcfg: PagedConfig, bucket: int, lora_scale: float = 1.0):
+                    pcfg: PagedConfig, bucket: int, lora_scale: float = 1.0,
+                    is_moe: bool = False):
     """Suffix forward against a prefix-seeded contiguous cache; the
     suffix's K/V lands in the sequence's fresh blocks. With an empty
     prefix (prefix_len 0, scratch-padded table) this degenerates to the
@@ -624,9 +669,8 @@ def _prefill_bucket(params, pools, suffix_tokens, prefix_table, prefix_len,
     either way."""
     cache = init_cache_seed(pools, prefix_table, prefix_len, extra=bucket)
     positions = prefix_len + jnp.arange(bucket)[None, :]
-    logits, cache = forward(params, suffix_tokens, cfg, cache=cache,
-                            positions=positions, lora=lora,
-                            lora_scale=lora_scale)
+    logits, cache = _family_forward(params, suffix_tokens, cfg, cache,
+                                    positions, lora, lora_scale, is_moe)
     # suffix K/V occupies [prefix_len, prefix_len + bucket) in the
     # contiguous cache (block-aligned: shared prefixes are whole blocks)
     k = jnp.stack([
@@ -655,7 +699,7 @@ def _lora_delta_slots(h, site_stack, adapter_idx, scale):
 def _decode_step(params, pools, tokens, seq_lens, active, block_tables,
                  temps, base_keys, step, loras, adapter_idx, *,
                  cfg: LlamaConfig, pcfg: PagedConfig,
-                 lora_scale: float = 1.0):
+                 lora_scale: float = 1.0, is_moe: bool = False):
     """One fused token step for every slot (see module doc)."""
     S = pcfg.max_slots
     keys = jax.vmap(jax.random.fold_in, (0, None))(base_keys, step)
@@ -694,18 +738,26 @@ def _decode_step(params, pools, tokens, seq_lens, active, block_tables,
         out = _paged_attention(q, pools, block_tables, seq_lens, layer_i, cfg)
         o2 = out.reshape(S, 1, cfg.dim)
         x = x + with_lora(_mm(o2, layer["attn"]["wo"]), o2, layer_i, "wo")
-        h2 = rmsnorm_reference(x, layer["mlp_norm"]["weight"], cfg.norm_eps)
-        gate = jax.nn.silu(
-            with_lora(_mm(h2, layer["mlp"]["w_gate"]), h2, layer_i,
-                      "w_gate").astype(jnp.float32))
-        up = with_lora(_mm(h2, layer["mlp"]["w_up"]), h2, layer_i,
-                       "w_up").astype(jnp.float32)
-        gu = (gate * up).astype(cfg.dtype)
-        x = x + with_lora(_mm(gu, layer["mlp"]["w_down"]), gu, layer_i,
-                          "w_down")
+        if is_moe:
+            # routed MLP: slots are the token batch; with a no-drop
+            # capacity factor, cross-slot routing equals per-sequence
+            # routing exactly (moe.py dispatch/combine)
+            from ..models.moe import moe_mlp_block
+
+            x, _aux = moe_mlp_block(layer, x, cfg)
+        else:
+            h2 = rmsnorm_reference(x, layer["mlp_norm"]["weight"], cfg.norm_eps)
+            gate = jax.nn.silu(
+                with_lora(_mm(h2, layer["mlp"]["w_gate"]), h2, layer_i,
+                          "w_gate").astype(jnp.float32))
+            up = with_lora(_mm(h2, layer["mlp"]["w_up"]), h2, layer_i,
+                           "w_up").astype(jnp.float32)
+            gu = (gate * up).astype(cfg.dtype)
+            x = x + with_lora(_mm(gu, layer["mlp"]["w_down"]), gu, layer_i,
+                              "w_down")
 
     x = rmsnorm_reference(x, params["final_norm"]["weight"], cfg.norm_eps)
-    if cfg.tie_embeddings:
+    if getattr(cfg, "tie_embeddings", False):
         logits = x @ params["embed"]["weight"].T.astype(cfg.dtype)
     else:
         logits = _mm(x, params["lm_head"]["weight"])

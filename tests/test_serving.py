@@ -934,3 +934,84 @@ class TestServingEngram:
         assert done[r1].output == _reference_tokens(merged, cfg, prompt, 4)
         assert done[r0].output == _reference_tokens(params, cfg, prompt, 4)
         assert done[r0].output != done[r1].output
+
+
+class TestMoEServing:
+    """The engine serves the sparse-MoE family: routed MLP inside the
+    fused step, token-exact vs moe.greedy_generate (no-drop capacity)."""
+
+    @pytest.fixture(scope="class")
+    def moe_model(self):
+        import dataclasses
+
+        from bobrapet_tpu.models import moe
+
+        cfg = dataclasses.replace(
+            moe.moe_tiny(), capacity_factor=float(moe.moe_tiny().n_experts)
+        )
+        params = moe.init_params(jax.random.PRNGKey(0), cfg)
+        return cfg, params
+
+    def _ref(self, params, cfg, prompt, n):
+        from bobrapet_tpu.models import moe
+
+        toks = jax.jit(lambda p, t: moe.greedy_generate(
+            p, t, cfg=cfg, max_new_tokens=n,
+            cache_capacity=len(prompt) + n))(
+            params, jnp.asarray(prompt, jnp.int32)[None, :])
+        return np.asarray(toks)[0].tolist()
+
+    def test_moe_requests_match_reference(self, moe_model):
+        cfg, params = moe_model
+        rng = np.random.default_rng(100)
+        prompts = [rng.integers(0, cfg.vocab_size, n).tolist()
+                   for n in (7, 15, 22)]
+        wants = [self._ref(params, cfg, p, 5) for p in prompts]
+        eng = ServingEngine(params, cfg, PagedConfig(
+            max_slots=3, block_size=8, num_blocks=64, max_blocks_per_seq=8))
+        assert eng.is_moe
+        rids = [eng.submit(p, max_new_tokens=5) for p in prompts]
+        done = {r.rid: r for r in eng.run()}
+        for rid, want in zip(rids, wants):
+            assert done[rid].output == want
+        assert eng.allocator.free_blocks == 63
+
+    def test_moe_with_prefix_cache_and_chunks(self, moe_model):
+        cfg, params = moe_model
+        rng = np.random.default_rng(101)
+        system = rng.integers(0, cfg.vocab_size, 16).tolist()
+        a = system + rng.integers(0, cfg.vocab_size, 20).tolist()
+        b = system + rng.integers(0, cfg.vocab_size, 3).tolist()
+        eng = ServingEngine(params, cfg, PagedConfig(
+            max_slots=2, block_size=8, num_blocks=64, max_blocks_per_seq=8,
+            prefill_chunk=16))
+        ra = eng.submit(a, max_new_tokens=4)
+        rb = eng.submit(b, max_new_tokens=4)
+        done = {r.rid: r for r in eng.run()}
+        assert done[ra].output == self._ref(params, cfg, a, 4)
+        assert done[rb].output == self._ref(params, cfg, b, 4)
+
+    def test_lora_rejected_for_moe(self, moe_model):
+        from bobrapet_tpu.models import lora as lora_mod
+        from bobrapet_tpu.models.llama import llama_tiny
+
+        cfg, params = moe_model
+        lcfg = lora_mod.LoRAConfig(rank=2)
+        stacked = lora_mod.stack_adapters(
+            [lora_mod.zero_lora(llama_tiny(), lcfg)] * 2)
+        with pytest.raises(ValueError, match="dense-family"):
+            ServingEngine(params, cfg, PagedConfig(), loras=stacked)
+
+    def test_droppy_capacity_rejected(self, moe_model):
+        from bobrapet_tpu.models import moe
+
+        params = moe.init_params(jax.random.PRNGKey(0), moe.moe_tiny())
+        with pytest.raises(ValueError, match="no-drop"):
+            ServingEngine(params, moe.moe_tiny(), PagedConfig())
+
+    def test_int8_moe_rejected(self, moe_model):
+        from bobrapet_tpu.models import quant
+
+        cfg, params = moe_model
+        with pytest.raises(ValueError, match="dense-family"):
+            ServingEngine(quant.quantize_params(params), cfg, PagedConfig())
