@@ -37,16 +37,30 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
-from ..models import llama, quant
+from ..models import llama, moe, quant
 from ..models.lora import LoRAConfig, init_lora, stack_adapters, zero_lora
 from .engine import ServingEngine
 from .paged_cache import PagedConfig
 from .service import StreamServer
 
+
+def _moe_cfg(factory):
+    """Serving-safe MoE config: no-drop capacity (see engine guard)."""
+    import dataclasses
+
+    def make():
+        cfg = factory()
+        return dataclasses.replace(cfg,
+                                   capacity_factor=float(cfg.n_experts))
+    return make
+
+
 _MODELS = {
     "tiny": llama.llama_tiny,
     "1b": llama.llama3_1b,
     "8b": llama.llama3_8b,
+    "moe-tiny": _moe_cfg(moe.moe_tiny),
+    "mixtral-8x7b": _moe_cfg(moe.mixtral_8x7b),
 }
 
 
@@ -114,12 +128,13 @@ def build_engine(ctx) -> ServingEngine:
             f"{sorted(_MODELS)}"
         )
     cfg = _MODELS[model_name]()
+    family = moe if hasattr(cfg, "n_experts") else llama
     ckpt = config.get("checkpoint")
     if ckpt:
-        like = llama.init_params(jax.random.PRNGKey(0), cfg)
+        like = family.init_params(jax.random.PRNGKey(0), cfg)
         params = _restore(ctx, str(ckpt), {"params": like})["params"]
     else:
-        params = llama.init_params(
+        params = family.init_params(
             jax.random.PRNGKey(int(config.get("initSeed") or 0)), cfg
         )
     quant_mode = config.get("quant")

@@ -1015,3 +1015,21 @@ class TestMoEServing:
         cfg, params = moe_model
         with pytest.raises(ValueError, match="dense-family"):
             ServingEngine(quant.quantize_params(params), cfg, PagedConfig())
+
+    def test_engram_builds_moe_engine(self, moe_model):
+        import json as _json
+
+        from bobrapet_tpu.sdk import contract
+        from bobrapet_tpu.sdk.context import EngramContext
+        from bobrapet_tpu.serving.engram import build_engine
+
+        env = {contract.ENV_CONFIG: _json.dumps({
+            "model": "moe-tiny", "initSeed": 0,
+            "paging": {"maxSlots": 2, "blockSize": 8, "numBlocks": 32,
+                       "maxBlocksPerSeq": 6},
+        })}
+        eng = build_engine(EngramContext(env))
+        assert eng.is_moe
+        eng.submit([1, 2, 3, 4], max_new_tokens=3)
+        out = eng.run()
+        assert len(out) == 1 and len(out[0].output) == 3
