@@ -13,7 +13,7 @@ steps:
     ref: {name: llama-server}     # template entrypoint: ...engram:serve
     transport: voz
     with:
-      model: 1b                   # tiny | 1b | 8b
+      model: 1b                   # tiny | 1b | 8b | moe-tiny | mixtral-8x7b
       quant: int8                 # optional weight-only quantization
       checkpoint: runs/prod/llama # optional blob-store prefix
       lora:                       # optional multi-LoRA stack
@@ -129,6 +129,12 @@ def build_engine(ctx) -> ServingEngine:
         )
     cfg = _MODELS[model_name]()
     family = moe if hasattr(cfg, "n_experts") else llama
+    if family is moe and (config.get("quant") or config.get("lora")):
+        # cheap check BEFORE any restore: the engine would reject these
+        # anyway, but only after the multi-GB tree came out of the blob
+        # store
+        raise ValueError("quant/lora are dense-family only; remove them "
+                         f"for model {model_name!r}")
     ckpt = config.get("checkpoint")
     if ckpt:
         like = family.init_params(jax.random.PRNGKey(0), cfg)

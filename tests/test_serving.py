@@ -1033,3 +1033,18 @@ class TestMoEServing:
         eng.submit([1, 2, 3, 4], max_new_tokens=3)
         out = eng.run()
         assert len(out) == 1 and len(out[0].output) == 3
+
+    def test_engram_rejects_moe_quant_before_restore(self, moe_model):
+        import json as _json
+
+        from bobrapet_tpu.sdk import contract
+        from bobrapet_tpu.sdk.context import EngramContext
+        from bobrapet_tpu.serving.engram import build_engine
+
+        env = {contract.ENV_CONFIG: _json.dumps({
+            "model": "moe-tiny", "quant": "int8",
+            "checkpoint": "runs/never/restored"})}
+        # storage is absent, but the family check must fire FIRST —
+        # before any restore attempt (cheap-checks-first)
+        with pytest.raises(ValueError, match="dense-family"):
+            build_engine(EngramContext(env))
