@@ -385,6 +385,46 @@ def config6_serving() -> dict:
     }
 
 
+def config7_serving_moe() -> dict:
+    """MoE-family serving throughput: routed dispatch/combine inside
+    the fused step (no-drop capacity), CPU tiny gauge of engine
+    overhead for the second model family."""
+    import dataclasses
+
+    import numpy as np
+
+    from bobrapet_tpu.models import moe
+    from bobrapet_tpu.serving import PagedConfig, ServingEngine
+
+    cfg = dataclasses.replace(moe.moe_tiny(),
+                              capacity_factor=float(moe.moe_tiny().n_experts))
+    params = moe.init_params(__import__("jax").random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, PagedConfig(
+        max_slots=4, block_size=16, num_blocks=128, max_blocks_per_seq=8))
+    rng = np.random.default_rng(0)
+    n_requests, new_tokens = 8, 12
+    for i in range(n_requests):
+        eng.submit(rng.integers(0, cfg.vocab_size, 8 + (i % 4) * 8).tolist(),
+                   max_new_tokens=new_tokens)
+    eng.step()
+    warm = sum(len(s_.request.output) for s_ in eng.slots if s_) + sum(
+        len(r.output) for r in eng.finished)
+    t0 = time.perf_counter()
+    done = eng.run()
+    wall = time.perf_counter() - t0
+    total = sum(len(r.output) for r in done) - warm
+    return {
+        "metric": "serving_moe_decode_tokens_per_sec",
+        "value": round(total / wall, 1),
+        "unit": "tok/s",
+        "vs_baseline": 1.0,
+        "config": "serving-moe",
+        "requests": n_requests,
+        "experts": cfg.n_experts,
+        "wallclock_s": round(wall, 3),
+    }
+
+
 def run_sweep(state: dict) -> None:
     # the parent NEVER touches the accelerator — but the env var alone
     # is not enough: a site hook can rewrite platform priority
@@ -396,7 +436,8 @@ def run_sweep(state: dict) -> None:
     jax.config.update("jax_platforms", "cpu")
     for idx, fn in ((1, config1_single_step), (3, config3_fanout_gang),
                     (4, config4_streaming_hub), (5, config5_nested_rag),
-                    ("serving", config6_serving)):
+                    ("serving", config6_serving),
+                    ("serving-moe", config7_serving_moe)):
         state["stage"] = f"config-{idx}"
         try:
             _emit(fn())
