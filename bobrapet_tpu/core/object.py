@@ -181,8 +181,10 @@ class Resource:
                 dataclasses.replace(o) for o in meta.owner_references
             ],
         )
-        return Resource(
-            kind=self.kind,
+        # replace() keeps this class- and field-agnostic: subclasses and
+        # future Resource-level fields survive the store boundary
+        return dataclasses.replace(
+            self,
             meta=new_meta,
             spec=_fast_copy(self.spec),
             status=_fast_copy(self.status),

@@ -123,18 +123,22 @@ class SerializedTLSSocket:
 
         self._sock = sock
         self._lock = threading.Lock()
-        self._deadline: Optional[float] = None  # caller-set read deadline
+        self._timeout: Optional[float] = None  # per-op idle timeout
         self._poll = poll_s or self.POLL_S
 
     def settimeout(self, value) -> None:
-        import time
-
-        self._deadline = None if value is None else time.monotonic() + value
+        self._timeout = value
 
     def recv(self, n: int) -> bytes:
         import socket as _socket
         import time
 
+        # per-operation semantics, like a real socket: the deadline is
+        # measured from the start of THIS recv, not from settimeout()
+        deadline = (
+            None if self._timeout is None
+            else time.monotonic() + self._timeout
+        )
         while True:
             with self._lock:
                 self._sock.settimeout(self._poll)
@@ -142,7 +146,7 @@ class SerializedTLSSocket:
                     return self._sock.recv(n)
                 except (_socket.timeout, ssl.SSLWantReadError):
                     pass
-            if self._deadline is not None and time.monotonic() > self._deadline:
+            if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError("read deadline exceeded")
 
     def sendall(self, data: bytes) -> None:

@@ -577,9 +577,12 @@ class ServingEngine:
         adapters = jnp.asarray(
             [s.request.adapter if s else 0 for s in self.slots], jnp.int32
         )
+        rids = jnp.asarray(
+            [s.request.rid if s else 0 for s in self.slots], jnp.int32
+        )
         self.pools, next_tokens = self._decode_fn(
             self.params, self.pools, tokens, seq_lens, active, tables,
-            temps, self._keys, jnp.asarray(self._steps, jnp.int32),
+            temps, self._keys, jnp.asarray(self._steps, jnp.int32), rids,
             self.loras, adapters,
         )
         next_host = jax.device_get(next_tokens).tolist()
@@ -607,7 +610,11 @@ class ServingEngine:
 
     def _sample_host(self, logits: jax.Array, req: Request, slot_idx: int) -> int:
         if req.temperature > 0:
-            key = jax.random.fold_in(self._keys[slot_idx], self._steps)
+            # rid is folded in so slot reuse with no intervening decode
+            # tick still gives each request a distinct stream
+            key = jax.random.fold_in(
+                jax.random.fold_in(self._keys[slot_idx], req.rid), self._steps
+            )
             return int(jax.random.categorical(key, logits / req.temperature))
         return int(jnp.argmax(logits))
 
@@ -697,12 +704,15 @@ def _lora_delta_slots(h, site_stack, adapter_idx, scale):
 
 
 def _decode_step(params, pools, tokens, seq_lens, active, block_tables,
-                 temps, base_keys, step, loras, adapter_idx, *,
+                 temps, base_keys, step, rids, loras, adapter_idx, *,
                  cfg: LlamaConfig, pcfg: PagedConfig,
                  lora_scale: float = 1.0, is_moe: bool = False):
     """One fused token step for every slot (see module doc)."""
     S = pcfg.max_slots
-    keys = jax.vmap(jax.random.fold_in, (0, None))(base_keys, step)
+    # rid fold keeps streams distinct across slot reuse even when no
+    # decode tick separates two occupants of the same slot
+    keys = jax.vmap(jax.random.fold_in)(base_keys, rids)
+    keys = jax.vmap(jax.random.fold_in, (0, None))(keys, step)
 
     def with_lora(out, h, layer_i, site):
         if loras is None:

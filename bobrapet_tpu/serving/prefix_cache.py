@@ -73,11 +73,15 @@ class PrefixCache:
         """Release one user's claim; blocks at refcount 0 return to the
         free list (hash registration retained — lazy invalidation)."""
         for b in blocks:
-            refs = self._refs.get(b, 1) - 1
-            if refs > 0:
-                self._refs[b] = refs
+            refs = self._refs.get(b)
+            if refs is None:
+                # double-free (or free of a never-allocated block) would
+                # hand one block to two sequences — refuse loudly
+                raise ValueError(f"free of block {b} with no refcount entry")
+            if refs > 1:
+                self._refs[b] = refs - 1
                 continue
-            self._refs.pop(b, None)
+            del self._refs[b]
             self.allocator.free([b])
 
     def _invalidate(self, block: int) -> None:
