@@ -93,6 +93,45 @@ def _cmd_manager(args: argparse.Namespace) -> int:
         with open(args.metrics_token_file) as f:
             token = f.read().strip()
 
+    # config validation BEFORE leader election: a misconfigured
+    # replica must fail fast instead of winning the Lease and then
+    # exiting (crash-looping while starving a healthy standby)
+    if args.executor_backend not in ("local", "cluster"):
+        # argparse only checks choices for CLI-given values, not the
+        # BOBRA_EXECUTOR_BACKEND env default — a typo must not silently
+        # run the local backend
+        _log.error("invalid executor backend %r (local|cluster)",
+                   args.executor_backend)
+        return 2
+
+    cluster_client = None
+    if args.executor_backend == "cluster":
+        # a production "cluster" backend must never silently fall back
+        # to the in-memory FakeCluster: demand a reachable API server
+        from .cluster import KubeHttpClient
+
+        if args.cluster_url or os.environ.get("KUBERNETES_SERVICE_HOST"):
+            cluster_token = None
+            if args.cluster_token_file:
+                with open(args.cluster_token_file) as f:
+                    cluster_token = f.read().strip()
+            # explicit credential/TLS flags apply in-cluster too (no
+            # base_url -> KubeHttpClient derives it from the service
+            # env; token/ca fall back to the service account only when
+            # not given here)
+            cluster_client = KubeHttpClient(
+                base_url=args.cluster_url,
+                token=cluster_token,
+                ca_file=args.cluster_ca_file,
+                insecure_skip_verify=args.cluster_insecure,
+            )
+        else:
+            _log.error(
+                "--executor-backend cluster needs --cluster-url or an "
+                "in-cluster environment (KUBERNETES_SERVICE_HOST)"
+            )
+            return 2
+
     # health/metrics serve from the start: a standby waiting on the
     # lease must stay alive under liveness probes
     state: dict = {"rt": None}
@@ -107,11 +146,20 @@ def _cmd_manager(args: argparse.Namespace) -> int:
             # outside: flock on shared storage
             mode = "kube" if os.environ.get("KUBERNETES_SERVICE_HOST") else "flock"
         if mode == "kube":
-            from .cluster import KubeHttpClient
+            from .cluster import ClusterError, KubeHttpClient
             from .utils.leader import KubeLeaseElector
 
+            # election talks to the same API server (and with the same
+            # credentials) as the cluster executor when one is configured
+            lease_client = cluster_client
+            if lease_client is None:
+                try:
+                    lease_client = KubeHttpClient()
+                except ClusterError as e:
+                    _log.error("kube Lease election unavailable: %s", e)
+                    return 2
             elector = KubeLeaseElector(
-                KubeHttpClient(), namespace=args.config_namespace,
+                lease_client, namespace=args.config_namespace,
                 lease_duration=args.lease_duration,
             )
             _log.info(
@@ -171,14 +219,17 @@ def _cmd_manager(args: argparse.Namespace) -> int:
         persist_dir=args.persist_dir,
         clock=Clock(),
         executor_mode=args.executor_mode,
+        executor_backend=args.executor_backend,
+        cluster_client=cluster_client,
+        cr_sync=not args.disable_cr_sync,
         config_namespace=args.config_namespace,
         enable_webhooks=not args.disable_webhooks,
     )
     rt.start()
     state["rt"] = rt
     _log.info(
-        "manager up: metrics on %s, executor=%s, webhooks=%s, persist=%s",
-        args.metrics_bind_address, args.executor_mode,
+        "manager up: metrics on %s, executor=%s/%s, webhooks=%s, persist=%s",
+        args.metrics_bind_address, args.executor_backend, args.executor_mode,
         not args.disable_webhooks, args.persist_dir or "<memory>",
     )
 
@@ -300,6 +351,22 @@ def main(argv: list[str] | None = None) -> int:
                      help="bearer token file guarding /metrics")
     mgr.add_argument("--executor-mode", choices=["sync", "threaded"],
                      default="threaded")
+    mgr.add_argument("--executor-backend", choices=["local", "cluster"],
+                     default=os.environ.get("BOBRA_EXECUTOR_BACKEND", "local"),
+                     help="cluster = apply workloads through the Kubernetes "
+                          "API and sync the 12 CRD kinds (kubectl front door)")
+    mgr.add_argument("--cluster-url", default=os.environ.get("BOBRA_CLUSTER_URL"),
+                     help="API server base URL (default: in-cluster service "
+                          "account when KUBERNETES_SERVICE_HOST is set)")
+    mgr.add_argument("--cluster-token-file", default=None,
+                     help="bearer token file for --cluster-url")
+    mgr.add_argument("--cluster-ca-file", default=None,
+                     help="CA bundle for --cluster-url")
+    mgr.add_argument("--cluster-insecure", action="store_true",
+                     help="skip TLS verification toward --cluster-url")
+    mgr.add_argument("--disable-cr-sync", action="store_true",
+                     help="cluster backend without CRD mirroring "
+                          "(workload apply/watch only)")
     mgr.add_argument("--config-namespace", default="bobrapet-system")
     mgr.add_argument("--disable-webhooks", action="store_true",
                      help="skip admission (reference: ENABLE_WEBHOOKS=false)")

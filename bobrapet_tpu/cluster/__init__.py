@@ -10,11 +10,13 @@ from .client import (
     extract_failed_exit_code,
     subset_differs,
 )
+from .crsync import CRSyncer
 from .executor import ClusterExecutor, ClusterWorkloadReconciler
 from .fake import FakeCluster, FakeKubelet
 from .kubeclient import KubeHttpClient
 
 __all__ = [
+    "CRSyncer",
     "ClusterClient",
     "ClusterConflict",
     "ClusterError",

@@ -1,0 +1,730 @@
+"""CR sync: kubectl is the front door.
+
+The reference's entire user interface is CRDs + kubectl — users
+``kubectl apply`` Stories/Engrams, controllers watch them through the
+API server, and gate approval is a ``kubectl patch storyrun ...
+--subresource status`` (reference: cmd/main.go:81-90 scheme
+registration, :613-790 controller watches, README.md §Workflow
+Primitives). In this framework the runtime source of truth is the
+in-process :class:`~bobrapet_tpu.core.store.ResourceStore` (the bus);
+this module makes the cluster API server an equally first-class front
+door by mirroring the 12 ``bobrapet.io`` CRD kinds both ways:
+
+- **spec in** (cluster -> bus): every watched CR's spec/labels/
+  annotations sync into the bus through the SAME in-process admission
+  chain local writes use. A rejected object never reaches the bus;
+  the denial surfaces on the cluster object as an ``Admitted=False``
+  status condition with the field errors, visible to kubectl.
+- **status out** (bus -> cluster): controller-owned status flows back
+  to the cluster via the status subresource, and bus-originated
+  resources (StepRuns fanned out by the DAG, trigger-created
+  StoryRuns) are mirrored onto the cluster so ``kubectl get stepruns``
+  shows the real run state.
+- **user-writable status in**: gate decisions patched cluster-side
+  (``status.gates``) merge into the bus — exactly the reference's
+  approval flow — passing through the bus status validators.
+
+Sync is content-driven: each direction writes only when the owned
+subtree actually differs, so echoes (our own writes re-delivered by
+the watch) converge to no-ops instead of looping.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import threading
+from typing import Any, Callable, Optional
+
+from ..api.catalog import CLUSTER_NAMESPACE
+from ..api.schemas import VERSION, _registry
+from ..core.object import ObjectMeta, Resource
+from ..core.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AdmissionDenied,
+    AlreadyExists,
+    NotFound,
+    ResourceStore,
+    WatchEvent,
+)
+from .client import ClusterClient, ClusterConflict, ClusterNotFound
+
+_log = logging.getLogger(__name__)
+
+ADMITTED_CONDITION = "Admitted"
+
+#: stamped on BUS objects once they have been mirrored to the cluster;
+#: lets resync() distinguish "deleted cluster-side while the manager
+#: was down" (prune from the bus) from "never mirrored yet" (push out).
+#: Never part of the mirrored manifest or the drift comparison.
+MIRRORED_ANNOTATION = "bobrapet.io/mirrored"
+
+#: dependency rank for the initial resync (definitions before the runs
+#: that reference them); kinds added to the registry later default to
+#: last rather than breaking the import
+_SYNC_RANK = {
+    k: i for i, k in enumerate([
+        "EngramTemplate", "ImpulseTemplate", "Transport", "Engram",
+        "Impulse", "ReferenceGrant", "Story", "TransportBinding",
+        "StoryTrigger", "StoryRun", "StepRun", "EffectClaim",
+    ])
+}
+
+#: kind -> (apiVersion, cluster-scoped?) for the CRD kinds, in
+#: dependency order so the initial resync admits cleanly without retries.
+CR_KINDS: dict[str, tuple[str, bool]] = {
+    e.kind: (f"{e.group}/{VERSION}", e.scope == "Cluster")
+    for e in sorted(
+        _registry(), key=lambda e: _SYNC_RANK.get(e.kind, len(_SYNC_RANK))
+    )
+}
+
+#: status fields users may write cluster-side; everything else in
+#: status is controller-owned and flows bus -> cluster only.
+#: gates: the reference's manual-approval channel (README.md §gate).
+USER_STATUS_FIELDS: dict[str, tuple[str, ...]] = {
+    "StoryRun": ("gates",),
+}
+
+
+def bus_namespace(kind: str, cluster_ns: str) -> str:
+    """Cluster-scoped kinds live in the bus pseudo-namespace."""
+    return CLUSTER_NAMESPACE if CR_KINDS[kind][1] else (cluster_ns or "default")
+
+
+def cluster_namespace(kind: str, bus_ns: str) -> str:
+    """'' means no namespace path segment (cluster-scoped)."""
+    return "" if CR_KINDS[kind][1] else bus_ns
+
+
+def manifest_to_resource(obj: dict, with_status: bool = False) -> Resource:
+    """Cluster manifest -> bus resource. Server-managed metadata (uid,
+    resourceVersion, k8s timestamps) is NOT carried — the bus assigns
+    its own; ownerReferences stay bus-managed for the same reason.
+
+    ``with_status`` imports the cluster-side status too (minus the
+    Admitted condition, which is cluster-side admission bookkeeping):
+    used when the bus first learns of an object, so a manager restarted
+    with a fresh in-memory bus adopts the cluster's persisted run state
+    instead of null-deleting it back to empty."""
+    kind = obj["kind"]
+    meta = obj.get("metadata") or {}
+    annotations = {
+        k: v for k, v in (meta.get("annotations") or {}).items()
+        if k != MIRRORED_ANNOTATION
+    }
+    status: dict[str, Any] = {}
+    if with_status:
+        status = json.loads(json.dumps(obj.get("status") or {}))
+        # generation-coupled bookkeeping can't survive adoption (the
+        # fresh bus object restarts at generation 1); the controller
+        # re-stamps it on its next reconcile
+        status.pop("observedGeneration", None)
+        if "conditions" in status:
+            conditions = [
+                c for c in status["conditions"]
+                if not (isinstance(c, dict) and c.get("type") == ADMITTED_CONDITION)
+            ]
+            if conditions:
+                status["conditions"] = conditions
+            else:
+                del status["conditions"]
+    return Resource(
+        kind=kind,
+        meta=ObjectMeta(
+            name=meta.get("name", ""),
+            namespace=bus_namespace(kind, meta.get("namespace", "")),
+            labels=dict(meta.get("labels") or {}),
+            annotations=annotations,
+        ),
+        spec=json.loads(json.dumps(obj.get("spec") or {})),
+        status=status,
+    )
+
+
+def resource_to_manifest(r: Resource) -> dict:
+    """Bus resource -> cluster manifest. ownerReferences are omitted:
+    bus uids never match cluster uids, and a real API server's GC
+    would collect mirrored children whose owner uid is unknown;
+    parent linkage stays visible through the bobrapet.io labels."""
+    api_version, cluster_scoped = CR_KINDS[r.kind]
+    meta: dict[str, Any] = {"name": r.meta.name}
+    if not cluster_scoped:
+        meta["namespace"] = r.meta.namespace
+    else:
+        meta["namespace"] = ""
+    if r.meta.labels:
+        meta["labels"] = dict(r.meta.labels)
+    annotations = {
+        k: v for k, v in r.meta.annotations.items()
+        if k != MIRRORED_ANNOTATION
+    }
+    if annotations:
+        meta["annotations"] = annotations
+    return {
+        "apiVersion": api_version,
+        "kind": r.kind,
+        "metadata": meta,
+        "spec": json.loads(json.dumps(r.spec)),
+        "status": json.loads(json.dumps(r.status)),
+    }
+
+
+class _NoChange:
+    def __repr__(self) -> str:  # pragma: no cover
+        return "<no-change>"
+
+
+#: sentinel distinguishing "nothing differs" from a literal {} value
+NO_CHANGE = _NoChange()
+
+
+def merge_patch_diff(desired: Any, live: Any) -> Any:
+    """Minimal RFC 7386 merge patch turning ``live`` into ``desired``.
+
+    Keys absent from desired become explicit ``null`` deletions — a
+    bus-side annotation removal (e.g. the consumed redrive annotation)
+    must propagate, or the stale cluster copy would sync straight back
+    in and re-trigger the action forever. Returns :data:`NO_CHANGE`
+    when nothing differs (a plain ``{}`` would be ambiguous with a
+    literal empty-dict replacement). Lists replace wholesale (k8s
+    merge-patch semantics)."""
+    if isinstance(desired, dict) and isinstance(live, dict):
+        patch: dict[str, Any] = {}
+        for k, v in desired.items():
+            if k not in live:
+                patch[k] = v
+            else:
+                sub = merge_patch_diff(v, live[k])
+                if sub is not NO_CHANGE:
+                    patch[k] = sub
+        for k in live:
+            if k not in desired:
+                patch[k] = None
+        return patch if patch else NO_CHANGE
+    return desired if desired != live else NO_CHANGE
+
+
+def _strip_nulls(patch: Any) -> Any:
+    """Remove merge-patch deletions (nulls) at every depth; returns
+    ``None`` when nothing but deletions remains."""
+    if not isinstance(patch, dict):
+        return patch
+    out = {}
+    for k, v in patch.items():
+        if v is None:
+            continue
+        sv = _strip_nulls(v)
+        if sv is None:
+            continue
+        out[k] = sv
+    return out or None
+
+
+def _spec_hash(obj: dict) -> str:
+    payload = {
+        "spec": obj.get("spec") or {},
+        "labels": (obj.get("metadata") or {}).get("labels") or {},
+        "annotations": (obj.get("metadata") or {}).get("annotations") or {},
+    }
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True, default=str).encode()
+    ).hexdigest()
+
+
+class CRSyncer:
+    """Bidirectional mirror between a ClusterClient and the bus for the
+    12 CRD kinds (see module doc).
+
+    Ordering/threading: handlers run on whatever thread delivers the
+    event (store drain thread, FakeCluster dispatch, KubeHttpClient
+    watch threads); both stores are internally locked and every write
+    here is conditional on real content drift, so concurrent delivery
+    converges.
+    """
+
+    def __init__(
+        self,
+        store: ResourceStore,
+        cluster: ClusterClient,
+        clock=None,
+        kinds: Optional[dict[str, tuple[str, bool]]] = None,
+    ):
+        from ..controllers.manager import Clock
+
+        self.store = store
+        self.cluster = cluster
+        self.clock = clock or Clock()
+        self.kinds = dict(kinds or CR_KINDS)
+        # cluster objects whose admission was denied, keyed by
+        # (kind, ns, name) -> spec hash; retried only when the spec
+        # changes or a dependency lands (missing-ref rejections heal
+        # once the referenced object syncs)
+        self._rejected: dict[tuple[str, str, str], str] = {}
+        self._rejected_manifests: dict[tuple[str, str, str], dict] = {}
+        # last bus-side controlled-fields hash pushed per object: spec
+        # patches go out ONLY when the bus spec actually changed, so a
+        # status-triggered push can never revert a newer (or parked-
+        # invalid) cluster-side edit back to the bus copy
+        self._pushed_spec: dict[tuple[str, str, str], str] = {}
+        self._lock = threading.Lock()
+
+        self._closed = False
+        self._cancel_bus_watch = store.watch(
+            self._on_bus_event, kinds=list(self.kinds)
+        )
+        cluster.watch(self._on_cluster_event)
+        # watch streams start in resync(), AFTER the controllers have
+        # registered their bus watches — an object synced in before
+        # that would be created unobserved and never reconciled
+
+    def close(self) -> None:
+        """Stop mirroring (Runtime.stop): cancel the bus watch and
+        no-op any cluster events still draining. The cluster client's
+        own watch threads are closed by its ``close()``."""
+        self._closed = True
+        self._cancel_bus_watch()
+
+    # -- initial state -----------------------------------------------------
+
+    def resync(self) -> None:
+        """List-based catch-up: cluster objects that predate this
+        manager sync in (dependency order), then bus objects missing
+        cluster-side mirror out. Watch streams start here too (k8s
+        list-then-watch), so nothing syncs in before the controllers
+        are listening."""
+        if hasattr(self.cluster, "start_watch"):
+            for kind, (api_version, _) in self.kinds.items():
+                self.cluster.start_watch(api_version, kind)
+        listed_ok: set[str] = set()
+        for kind, (api_version, _) in self.kinds.items():
+            try:
+                objs = self.cluster.list(api_version, kind)
+            except Exception as e:  # noqa: BLE001 - CRDs not installed yet
+                _log.warning("resync list of %s failed: %s", kind, e)
+                continue
+            listed_ok.add(kind)
+            for obj in objs:
+                self._sync_in(obj)
+        for kind, (api_version, _) in self.kinds.items():
+            if kind not in listed_ok:
+                # a failed list means we cannot distinguish "deleted
+                # while down" from "never mirrored" — pushing blindly
+                # would resurrect kubectl-deleted objects, so park this
+                # kind until the next resync/watch delivers truth
+                _log.warning("skipping push-out of %s (list failed)", kind)
+                continue
+            for r in self.store.list(kind):
+                if MIRRORED_ANNOTATION in r.meta.annotations:
+                    try:
+                        live = self.cluster.get(
+                            api_version, kind,
+                            cluster_namespace(kind, r.meta.namespace),
+                            r.meta.name,
+                        )
+                    except Exception as e:  # noqa: BLE001 - transient
+                        # can't tell "deleted while down" from "blip":
+                        # skip the object this cycle rather than crash
+                        # startup or resurrect a deletion
+                        _log.warning(
+                            "resync get of %s %s/%s failed: %s; skipping",
+                            kind, r.meta.namespace, r.meta.name, e,
+                        )
+                        continue
+                else:
+                    live = True  # never mirrored: bootstrap push below
+                if live is None:
+                    # was mirrored, now gone cluster-side: the user
+                    # kubectl-deleted it while the manager was down —
+                    # honor the deletion instead of resurrecting it
+                    _log.info(
+                        "pruning %s %s/%s: deleted cluster-side while "
+                        "the manager was down",
+                        kind, r.meta.namespace, r.meta.name,
+                    )
+                    try:
+                        self.store.delete(kind, r.meta.namespace, r.meta.name)
+                    except NotFound:
+                        pass
+                    continue
+                self._push_out(r)
+
+    # -- cluster -> bus ----------------------------------------------------
+
+    def _on_cluster_event(self, ev_type: str, obj: dict) -> None:
+        kind = obj.get("kind")
+        if kind not in self.kinds or self._closed:
+            return
+        meta = obj.get("metadata") or {}
+        ns = bus_namespace(kind, meta.get("namespace", ""))
+        name = meta.get("name", "")
+        if ev_type in (DELETED, "DELETED"):
+            with self._lock:
+                self._rejected.pop((kind, ns, name), None)
+                self._rejected_manifests.pop((kind, ns, name), None)
+                self._pushed_spec.pop((kind, ns, name), None)
+            try:
+                self.store.delete(kind, ns, name)
+            except NotFound:
+                pass
+            return
+        if ev_type in (ADDED, MODIFIED, "ADDED", "MODIFIED"):
+            # level-based: the event is only a trigger — sync from the
+            # LIVE object, not the snapshot. Comparing a stale snapshot
+            # against newer bus state would manufacture phantom drift,
+            # and two queued snapshots can oscillate the sync forever
+            # (each re-"correcting" the other side).
+            api_version, _ = self.kinds[kind]
+            live = self.cluster.get(
+                api_version, kind, meta.get("namespace", ""), name
+            )
+            if live is not None:
+                self._sync_in(live)
+
+    def _sync_in(self, obj: dict) -> None:
+        kind = obj["kind"]
+        desired = manifest_to_resource(obj)
+        ns, name = desired.meta.namespace, desired.meta.name
+        key = (kind, ns, name)
+        with self._lock:
+            parked = self._rejected.get(key) == _spec_hash(obj)
+        if parked:
+            # unchanged since denial; wait for a spec edit — but user-
+            # writable status (gate decisions) must still flow while
+            # the spec sits parked
+            self._merge_user_status(kind, ns, name, obj)
+            return
+        bus = self.store.try_get(kind, ns, name)
+        try:
+            if bus is None:
+                # adopt the cluster's persisted status (fresh-bus
+                # restart): without it, push-out would null-delete a
+                # Succeeded run back to empty and re-execute it
+                desired = manifest_to_resource(obj, with_status=True)
+                self.store.create(desired)
+                self._admitted(key, obj)
+                self._retry_rejected()
+                # gate decisions patched cluster-side while the manager
+                # was down arrive with the first sync — merge them now,
+                # not only on later MODIFIED events
+                self._merge_user_status(kind, ns, name, obj)
+            else:
+                bus_annotations = {
+                    k: v for k, v in bus.meta.annotations.items()
+                    if k != MIRRORED_ANNOTATION
+                }
+                if (
+                    bus.spec != desired.spec
+                    or bus.meta.labels != desired.meta.labels
+                    or bus_annotations != desired.meta.annotations
+                ):
+                    def sync(r: Resource) -> None:
+                        r.spec = json.loads(json.dumps(desired.spec))
+                        r.meta.labels = dict(desired.meta.labels)
+                        marker = r.meta.annotations.get(MIRRORED_ANNOTATION)
+                        r.meta.annotations = dict(desired.meta.annotations)
+                        if marker is not None:
+                            r.meta.annotations[MIRRORED_ANNOTATION] = marker
+
+                    self.store.mutate(kind, ns, name, sync)
+                    self._admitted(key, obj)
+                    # an admitted spec EDIT can be the missing
+                    # dependency of a parked rejection too (e.g. a
+                    # cycle broken by editing the other story)
+                    self._retry_rejected()
+                self._merge_user_status(kind, ns, name, obj)
+        except AlreadyExists:
+            pass  # create race with a local apply; next event converges
+        except AdmissionDenied as e:
+            with self._lock:
+                self._rejected[key] = _spec_hash(obj)
+                self._rejected_manifests[key] = obj
+            self._set_condition(
+                obj, "False", reason="AdmissionDenied", message=str(e)
+            )
+            _log.info("cluster %s %s/%s rejected: %s", kind, ns, name, e)
+        except Exception:  # noqa: BLE001 - reflected on the next event
+            _log.exception("sync-in of %s %s/%s failed", kind, ns, name)
+
+    def _merge_user_status(self, kind: str, ns: str, name: str, obj: dict) -> None:
+        """Cluster-side writes to user-writable status fields (gate
+        decisions) merge into the bus; a decision already recorded on
+        the bus wins (no flip-flop after the controller acted)."""
+        fields = USER_STATUS_FIELDS.get(kind)
+        if not fields:
+            return
+        cluster_status = obj.get("status") or {}
+        bus = self.store.try_get(kind, ns, name)
+        if bus is None:
+            return
+        pending: dict[str, dict[str, Any]] = {}
+        for field in fields:
+            theirs = cluster_status.get(field)
+            if not isinstance(theirs, dict):
+                continue
+            ours = bus.status.get(field) or {}
+            fresh: dict[str, Any] = {}
+            for k, v in theirs.items():
+                if k not in ours:
+                    fresh[k] = v
+                elif isinstance(v, dict) and isinstance(ours.get(k), dict):
+                    # second-level additions too: a later kubectl patch
+                    # adding e.g. gates.approval.comment must merge even
+                    # though 'approval' already exists on the bus (bus
+                    # wins per-subkey; recorded decisions never flip)
+                    sub_fresh = {
+                        sk: sv for sk, sv in v.items() if sk not in ours[k]
+                    }
+                    if sub_fresh:
+                        fresh[k] = sub_fresh
+            if fresh:
+                pending[field] = fresh
+        if not pending:
+            return
+
+        def patch(status: dict[str, Any]) -> None:
+            for field, fresh in pending.items():
+                merged = dict(status.get(field) or {})
+                for k, v in fresh.items():
+                    if k not in merged:
+                        merged[k] = v
+                    elif isinstance(v, dict) and isinstance(merged[k], dict):
+                        sub = dict(merged[k])
+                        for sk, sv in v.items():
+                            sub.setdefault(sk, sv)
+                        merged[k] = sub
+                status[field] = merged
+
+        try:
+            self.store.patch_status(kind, ns, name, patch)
+        except AdmissionDenied as e:
+            self._set_condition(
+                obj, "False", reason="StatusRejected", message=str(e)
+            )
+        except NotFound:
+            pass
+
+    def _retry_rejected(self) -> None:
+        """A successful admit may have been the missing dependency of an
+        earlier rejection (Story before its Engram synced); re-attempt
+        every parked manifest once."""
+        with self._lock:
+            retries = list(self._rejected_manifests.items())
+            self._rejected.clear()
+            self._rejected_manifests.clear()
+        for _, manifest in retries:
+            self._sync_in(manifest)
+
+    def _admitted(self, key: tuple[str, str, str], obj: dict) -> None:
+        with self._lock:
+            self._rejected.pop(key, None)
+            self._rejected_manifests.pop(key, None)
+        # surface acceptance only if a prior denial is on record —
+        # unconditional Admitted=True writes would race the status
+        # pushes that soon replace conditions wholesale. The denial
+        # lives on the LIVE object (obj can be a pre-denial snapshot,
+        # e.g. a parked manifest re-admitted via _retry_rejected).
+        kind = obj["kind"]
+        meta = obj.get("metadata") or {}
+        api_version, _ = self.kinds[kind]
+        live = self.cluster.get(
+            api_version, kind, meta.get("namespace", ""), meta.get("name", "")
+        )
+        conditions = ((live or {}).get("status") or {}).get("conditions") or []
+        if any(
+            c.get("type") == ADMITTED_CONDITION and c.get("status") == "False"
+            for c in conditions
+        ):
+            self._set_condition(obj, "True", reason="Admitted", message="")
+
+    def _set_condition(self, obj: dict, status: str, reason: str, message: str) -> None:
+        kind = obj["kind"]
+        meta = obj.get("metadata") or {}
+        api_version, _ = self.kinds[kind]
+        cluster_ns = meta.get("namespace", "")
+        name = meta.get("name", "")
+        live = self.cluster.get(api_version, kind, cluster_ns, name)
+        if live is None:
+            return
+        conditions = list((live.get("status") or {}).get("conditions") or [])
+        current = next(
+            (c for c in conditions if c.get("type") == ADMITTED_CONDITION), None
+        )
+        if (
+            current is not None
+            and current.get("status") == status
+            and current.get("reason") == reason
+            and current.get("message") == message
+        ):
+            return  # no-op; unconditional patches would loop the watch
+        cond = {
+            "type": ADMITTED_CONDITION,
+            "status": status,
+            "reason": reason,
+            "message": message,
+            "lastTransitionTime": self.clock.now(),
+        }
+        conditions = [
+            c for c in conditions if c.get("type") != ADMITTED_CONDITION
+        ] + [cond]
+        try:
+            self.cluster.patch_status(
+                api_version, kind, cluster_ns, name,
+                {"status": {"conditions": conditions}},
+            )
+        except (ClusterNotFound, ClusterConflict):
+            pass
+        except Exception:  # noqa: BLE001 - best-effort surfacing
+            _log.exception("condition patch on %s %s/%s failed", kind, cluster_ns, name)
+
+    # -- bus -> cluster ----------------------------------------------------
+
+    def _on_bus_event(self, ev: WatchEvent) -> None:
+        r = ev.resource
+        if r.kind not in self.kinds:
+            return
+        api_version, _ = self.kinds[r.kind]
+        cluster_ns = cluster_namespace(r.kind, r.meta.namespace)
+        if ev.type == DELETED:
+            with self._lock:
+                self._pushed_spec.pop(
+                    (r.kind, r.meta.namespace, r.meta.name), None
+                )
+            try:
+                self.cluster.delete(api_version, r.kind, cluster_ns, r.meta.name)
+            except ClusterNotFound:
+                pass  # cluster-side deletion was the origin
+            except Exception:  # noqa: BLE001 - best-effort
+                _log.exception(
+                    "mirror delete of %s %s/%s failed",
+                    r.kind, cluster_ns, r.meta.name,
+                )
+            return
+        if ev.type in (ADDED, MODIFIED):
+            # level-based (see _on_cluster_event): push the live bus
+            # state, not the event snapshot
+            cur = self.store.try_get(r.kind, r.meta.namespace, r.meta.name)
+            if cur is not None:
+                self._push_out(cur)
+
+    def _push_out(self, r: Resource) -> None:
+        api_version, _ = self.kinds[r.kind]
+        cluster_ns = cluster_namespace(r.kind, r.meta.namespace)
+        manifest = resource_to_manifest(r)
+        key = (r.kind, r.meta.namespace, r.meta.name)
+        bus_hash = _spec_hash(manifest)
+        try:
+            live = self.cluster.get(api_version, r.kind, cluster_ns, r.meta.name)
+            if live is None:
+                try:
+                    # a real API server's status subresource strips
+                    # .status from the POST — keep the create result as
+                    # `live` so the status patch below still runs
+                    live = self.cluster.create(manifest)
+                    with self._lock:
+                        self._pushed_spec[key] = bus_hash
+                except ClusterConflict:
+                    live = self.cluster.get(
+                        api_version, r.kind, cluster_ns, r.meta.name
+                    )
+            if live is not None:
+                # spec goes out ONLY when the bus-side controlled
+                # fields changed since the last push — a push triggered
+                # by a mere status event must never revert a newer (or
+                # parked-invalid) cluster-side edit to the bus copy.
+                # An object whose cluster copy is currently REJECTED is
+                # never spec-patched at all: the parked user edit is
+                # the pending source of truth (covers restarts, where
+                # _pushed_spec starts empty).
+                with self._lock:
+                    push_spec = (
+                        self._pushed_spec.get(key) != bus_hash
+                        and key not in self._rejected
+                    )
+                if push_spec:
+                    live_meta = live.get("metadata") or {}
+                    patch: dict[str, Any] = {}
+                    spec_patch = merge_patch_diff(
+                        manifest["spec"], live.get("spec") or {}
+                    )
+                    if spec_patch is not NO_CHANGE:
+                        patch["spec"] = spec_patch
+                    meta_patch: dict[str, Any] = {}
+                    for field in ("labels", "annotations"):
+                        diff = merge_patch_diff(
+                            (manifest["metadata"].get(field) or {}),
+                            live_meta.get(field) or {},
+                        )
+                        if diff is not NO_CHANGE:
+                            meta_patch[field] = diff
+                    if meta_patch:
+                        patch["metadata"] = meta_patch
+                    if patch:
+                        self.cluster.patch(
+                            api_version, r.kind, cluster_ns, r.meta.name, patch
+                        )
+                    with self._lock:
+                        self._pushed_spec[key] = bus_hash
+                # no emptiness guard: an emptied bus status must still
+                # push (its keys become null deletions in the diff)
+                self._push_status(
+                    api_version, r.kind, cluster_ns, r.meta.name,
+                    manifest["status"], live,
+                )
+                if MIRRORED_ANNOTATION not in r.meta.annotations:
+                    # durable mirror record for resync's prune logic
+                    try:
+                        self.store.mutate(
+                            r.kind, r.meta.namespace, r.meta.name,
+                            lambda b: b.meta.annotations.__setitem__(
+                                MIRRORED_ANNOTATION, "true"
+                            ),
+                        )
+                    except (NotFound, AdmissionDenied):
+                        pass
+        except Exception:  # noqa: BLE001 - next bus event retries
+            _log.exception(
+                "mirror push of %s %s/%s failed", r.kind, cluster_ns, r.meta.name
+            )
+
+    def _push_status(self, api_version: str, kind: str, cluster_ns: str,
+                     name: str, out_status: dict, live: dict) -> None:
+        live_status = live.get("status") or {}
+        # the live Admitted condition (a parked denial, or the
+        # acceptance that cleared one) is cluster-side admission
+        # bookkeeping the bus knows nothing about — it must survive
+        # condition-list replacement/deletion by controller pushes
+        live_admitted = next(
+            (c for c in live_status.get("conditions") or []
+             if c.get("type") == ADMITTED_CONDITION),
+            None,
+        )
+        if live_admitted is not None:
+            out_status["conditions"] = [
+                c for c in out_status.get("conditions") or []
+                if c.get("type") != ADMITTED_CONDITION
+            ] + [live_admitted]
+        status_patch = merge_patch_diff(out_status, live_status)
+        if status_patch is NO_CHANGE:
+            return
+        # never DELETE user-writable fields at ANY depth (a cluster-side
+        # gate decision — or a later sub-field like gates.x.comment —
+        # not yet merged into the bus must survive concurrent controller
+        # pushes); additions/changes still flow
+        for field in USER_STATUS_FIELDS.get(kind, ()):
+            sub = status_patch.get(field, NO_CHANGE)
+            if sub is NO_CHANGE:
+                continue
+            scrubbed = _strip_nulls(sub)
+            if scrubbed is None:
+                del status_patch[field]
+            else:
+                status_patch[field] = scrubbed
+        if not status_patch:
+            return
+        self.cluster.patch_status(
+            api_version, kind, cluster_ns, name, {"status": status_patch}
+        )

@@ -55,9 +55,21 @@ _PLURALS = {
     "Lease": "leases",
 }
 
+_CR_PLURALS: Optional[dict[str, str]] = None
+
 
 def plural_for(kind: str) -> str:
-    return _PLURALS.get(kind, kind.lower() + "s")
+    """Built-in kinds from the table; CRD kinds from the schema
+    registry (the authoritative plural — Story pluralizes irregularly
+    to 'stories'); anything else lowercased + 's'."""
+    if kind in _PLURALS:
+        return _PLURALS[kind]
+    global _CR_PLURALS
+    if _CR_PLURALS is None:
+        from ..api.schemas import _registry
+
+        _CR_PLURALS = {e.kind: e.plural for e in _registry()}
+    return _CR_PLURALS.get(kind) or kind.lower() + "s"
 
 
 class KubeHttpClient:
@@ -161,7 +173,9 @@ class KubeHttpClient:
 
     def create(self, manifest: dict) -> dict:
         meta = manifest.get("metadata") or {}
-        ns = meta.get("namespace") or self.namespace_default
+        # an explicit empty namespace means cluster-scoped (no namespace
+        # path segment); only an ABSENT namespace falls back to default
+        ns = meta["namespace"] if "namespace" in meta else self.namespace_default
         return self._json(self._request(
             "POST", self._path(manifest["apiVersion"], manifest["kind"], ns),
             body=manifest))

@@ -82,6 +82,7 @@ class Runtime:
         executor_mode: str = "sync",
         executor_backend: str = "local",
         cluster_client=None,
+        cr_sync: bool = True,
         config_namespace: str = "bobrapet-system",
         enable_webhooks: bool = True,
         tracer=None,
@@ -162,6 +163,7 @@ class Runtime:
         self.executor_backend = executor_backend
         self.cluster = None
         self.workload_simulator = None
+        self.cr_syncer = None
         if executor_backend == "cluster":
             # cluster backend: bus Jobs/Deployments are materialized into
             # GKE manifests, applied through a ClusterClient, and their
@@ -187,6 +189,16 @@ class Runtime:
             self.workload_reconciler = ClusterWorkloadReconciler(
                 self.store, self.cluster, clock=self.clock
             )
+            if cr_sync:
+                # kubectl front door: the 12 CRD kinds mirror between
+                # the cluster API and the bus (spec in through
+                # admission, status out, gate decisions in) — see
+                # cluster/crsync.py; reference cmd/main.go:613-790
+                from .cluster import CRSyncer
+
+                self.cr_syncer = CRSyncer(
+                    self.store, self.cluster, clock=self.clock
+                )
         else:
             self.job_executor = LocalGangExecutor(
                 self.store, storage=self.storage, clock=self.clock, mode=executor_mode
@@ -202,6 +214,11 @@ class Runtime:
             self.workload_reconciler.attach(self.manager)
         self._register_controllers()
         self.store.watch(self._release_slices, kinds=[STEP_RUN_KIND])
+        if self.cr_syncer is not None:
+            # list-based catch-up AFTER controller registration so
+            # cluster objects that predate this manager fire watch
+            # events the reconcilers actually receive
+            self.cr_syncer.resync()
 
     # ------------------------------------------------------------------
     def _on_config_change(self, cfg) -> None:
@@ -587,6 +604,12 @@ class Runtime:
 
     def stop(self) -> None:
         self.manager.stop()
+        if self.cr_syncer is not None:
+            self.cr_syncer.close()
+        if self.cluster is not None and hasattr(self.cluster, "close"):
+            # stop KubeHttpClient watch threads; FakeCluster has no
+            # connections to close
+            self.cluster.close()
 
     def run_phase(self, run_name: str, namespace: str = "default") -> Optional[str]:
         run = self.store.try_get(STORY_RUN_KIND, namespace, run_name)

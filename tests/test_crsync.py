@@ -1,0 +1,639 @@
+"""kubectl is the front door: CR sync between the cluster API and the
+bus (cluster/crsync.py).
+
+Reference behaviors under test: CRD kinds served by the API server are
+the user interface (cmd/main.go:81-90, :613-790); gate approval is a
+``kubectl patch storyrun ... --subresource status`` (README.md
+§Workflow Primitives); admission rejection is visible to kubectl.
+
+Every resource in these tests is created ONLY through the cluster API
+(the FakeCluster envtest analog) — nothing touches rt.apply().
+"""
+
+import pytest
+
+from bobrapet_tpu.api.catalog import CLUSTER_NAMESPACE, make_engram_template
+from bobrapet_tpu.api.engram import make_engram
+from bobrapet_tpu.api.story import make_story
+from bobrapet_tpu.api.runs import make_storyrun
+from bobrapet_tpu.cluster import FakeCluster
+from bobrapet_tpu.cluster.crsync import (
+    CR_KINDS,
+    manifest_to_resource,
+    resource_to_manifest,
+)
+from bobrapet_tpu.runtime import Runtime
+from bobrapet_tpu.sdk import register_engram
+
+RUNS_API = "runs.bobrapet.io/v1alpha1"
+CORE_API = "bobrapet.io/v1alpha1"
+CATALOG_API = "catalog.bobrapet.io/v1alpha1"
+
+
+def kubectl_apply(cluster, resource):
+    """Create a bus-typed resource through the cluster API only."""
+    return cluster.create(resource_to_manifest(resource))
+
+
+@pytest.fixture
+def rt():
+    return Runtime(executor_backend="cluster")
+
+
+def admitted_condition(obj):
+    for c in (obj.get("status") or {}).get("conditions") or []:
+        if c.get("type") == "Admitted":
+            return c
+    return None
+
+
+class TestManifestRoundTrip:
+    def test_all_12_kinds_have_api_versions(self):
+        assert len(CR_KINDS) == 12
+        assert CR_KINDS["Story"] == (CORE_API, False)
+        assert CR_KINDS["EngramTemplate"] == (CATALOG_API, True)
+        assert CR_KINDS["Transport"][1] is True  # cluster-scoped
+
+    def test_round_trip_preserves_spec_and_meta(self):
+        story = make_story("s", steps=[{"name": "a", "type": "sleep",
+                                        "with": {"duration": "1s"}}])
+        story.meta.labels["team"] = "ml"
+        m = resource_to_manifest(story)
+        assert m["apiVersion"] == CORE_API
+        back = manifest_to_resource(m)
+        assert back.spec == story.spec
+        assert back.meta.labels == {"team": "ml"}
+        assert back.meta.namespace == "default"
+
+    def test_cluster_scoped_maps_to_bus_pseudo_namespace(self):
+        tpl = make_engram_template("t", entrypoint="x")
+        m = resource_to_manifest(tpl)
+        assert m["metadata"]["namespace"] == ""
+        back = manifest_to_resource(m)
+        assert back.meta.namespace == CLUSTER_NAMESPACE
+
+
+class TestKubectlFrontDoor:
+    def test_story_applied_via_cluster_runs_to_completion(self, rt):
+        @register_engram("front-impl")
+        def impl(ctx):
+            return {"ok": True}
+
+        kubectl_apply(rt.cluster, make_engram_template("front-tpl",
+                                                       entrypoint="front-impl"))
+        kubectl_apply(rt.cluster, make_engram("front", "front-tpl"))
+        kubectl_apply(rt.cluster, make_story("front-story", steps=[
+            {"name": "a", "ref": {"name": "front"}},
+        ]))
+        kubectl_apply(rt.cluster, make_storyrun("front-run", "front-story"))
+        rt.pump()
+
+        # bus saw it and ran it through the cluster backend
+        assert rt.run_phase("front-run") == "Succeeded"
+        # ...and kubectl sees the result: status flowed back out
+        live = rt.cluster.get(RUNS_API, "StoryRun", "default", "front-run")
+        assert live["status"]["phase"] == "Succeeded"
+        # bus-originated StepRuns are mirrored for kubectl get stepruns
+        steprun_objs = rt.cluster.list(RUNS_API, "StepRun", "default")
+        assert len(steprun_objs) == 1
+        assert steprun_objs[0]["status"]["phase"] == "Succeeded"
+
+    def test_gate_approved_by_cluster_side_status_patch(self, rt):
+        kubectl_apply(rt.cluster, make_story("gated", steps=[
+            {"name": "approval", "type": "gate", "with": {"timeout": "1h"}},
+        ]))
+        kubectl_apply(rt.cluster, make_storyrun("gated-run", "gated"))
+        rt.pump()
+        assert rt.run_phase("gated-run") == "Running"
+
+        # kubectl patch storyrun gated-run --subresource status ...
+        rt.cluster.patch_status(
+            RUNS_API, "StoryRun", "default", "gated-run",
+            {"status": {"gates": {"approval": {"approved": True,
+                                               "approver": "alice"}}}},
+        )
+        rt.pump()
+        assert rt.run_phase("gated-run") == "Succeeded"
+        live = rt.cluster.get(RUNS_API, "StoryRun", "default", "gated-run")
+        assert live["status"]["phase"] == "Succeeded"
+        assert live["status"]["gates"]["approval"]["approver"] == "alice"
+
+    def test_cancel_requested_via_cluster_spec_patch(self, rt):
+        kubectl_apply(rt.cluster, make_story("slow", steps=[
+            {"name": "z", "type": "gate", "with": {"timeout": "10h"}},
+        ]))
+        kubectl_apply(rt.cluster, make_storyrun("slow-run", "slow"))
+        rt.pump()
+        assert rt.run_phase("slow-run") == "Running"
+        rt.cluster.patch(RUNS_API, "StoryRun", "default", "slow-run",
+                         {"spec": {"cancelRequested": True}})
+        rt.pump()
+        # graceful cancel drains to Finished (e2e suite parity)
+        assert rt.run_phase("slow-run") == "Finished"
+        live = rt.cluster.get(RUNS_API, "StoryRun", "default", "slow-run")
+        assert live["status"]["phase"] == "Finished"
+
+    def test_spec_edit_flows_in(self, rt):
+        kubectl_apply(rt.cluster, make_story("editable", steps=[
+            {"name": "a", "type": "sleep", "with": {"duration": "1s"}},
+        ]))
+        rt.pump()
+        story = rt.store.get("Story", "default", "editable")
+        gen0 = story.meta.generation
+        rt.cluster.patch(CORE_API, "Story", "default", "editable", {
+            "spec": {"steps": [{"name": "a", "type": "sleep",
+                                "with": {"duration": "2s"}}]},
+        })
+        story = rt.store.get("Story", "default", "editable")
+        assert story.spec["steps"][0]["with"]["duration"] == "2s"
+        assert story.meta.generation > gen0
+
+    def test_cluster_delete_removes_bus_object(self, rt):
+        kubectl_apply(rt.cluster, make_story("doomed", steps=[
+            {"name": "a", "type": "sleep", "with": {"duration": "1s"}},
+        ]))
+        assert rt.store.try_get("Story", "default", "doomed") is not None
+        rt.cluster.delete(CORE_API, "Story", "default", "doomed")
+        assert rt.store.try_get("Story", "default", "doomed") is None
+
+    def test_bus_delete_mirrors_out(self, rt):
+        kubectl_apply(rt.cluster, make_story("mirrored", steps=[
+            {"name": "a", "type": "sleep", "with": {"duration": "1s"}},
+        ]))
+        assert rt.cluster.get(CORE_API, "Story", "default", "mirrored")
+        rt.store.delete("Story", "default", "mirrored")
+        assert rt.cluster.get(CORE_API, "Story", "default", "mirrored") is None
+
+
+class TestClusterAdmission:
+    def test_invalid_story_rejected_with_field_errors(self, rt):
+        bad = make_story("bad", steps=[
+            {"name": "dup", "type": "sleep", "with": {"duration": "1s"}},
+            {"name": "dup", "type": "sleep", "with": {"duration": "1s"}},
+        ])
+        kubectl_apply(rt.cluster, bad)
+        # never reached the bus
+        assert rt.store.try_get("Story", "default", "bad") is None
+        # kubectl-visible denial with the field path
+        live = rt.cluster.get(CORE_API, "Story", "default", "bad")
+        cond = admitted_condition(live)
+        assert cond is not None and cond["status"] == "False"
+        assert cond["reason"] == "AdmissionDenied"
+        assert "duplicate step name" in cond["message"]
+        assert "spec.steps[1].name" in cond["message"]
+
+    def test_fixing_the_spec_admits_and_clears_condition(self, rt):
+        bad = make_story("fixable", steps=[
+            {"name": "dup", "type": "sleep", "with": {"duration": "1s"}},
+            {"name": "dup", "type": "sleep", "with": {"duration": "1s"}},
+        ])
+        kubectl_apply(rt.cluster, bad)
+        assert rt.store.try_get("Story", "default", "fixable") is None
+        rt.cluster.patch(CORE_API, "Story", "default", "fixable", {
+            "spec": {"steps": [
+                {"name": "dup", "type": "sleep", "with": {"duration": "1s"}},
+                {"name": "dup2", "type": "sleep", "with": {"duration": "1s"}},
+            ]},
+        })
+        assert rt.store.try_get("Story", "default", "fixable") is not None
+        live = rt.cluster.get(CORE_API, "Story", "default", "fixable")
+        cond = admitted_condition(live)
+        assert cond is not None and cond["status"] == "True"
+
+    def test_unchanged_invalid_spec_is_not_rehammered(self, rt):
+        """Identical denied spec re-delivered by the watch must not
+        re-run admission forever (the rejected-hash guard)."""
+        bad = make_story("parked", steps=[
+            {"name": "dup", "type": "sleep", "with": {"duration": "1s"}},
+            {"name": "dup", "type": "sleep", "with": {"duration": "1s"}},
+        ])
+        kubectl_apply(rt.cluster, bad)
+        live = rt.cluster.get(CORE_API, "Story", "default", "parked")
+        t0 = admitted_condition(live)["lastTransitionTime"]
+        # a no-spec-change touch (labels on status patch path) re-fires
+        # the watch; condition must not churn
+        rt.cluster.patch_status(CORE_API, "Story", "default", "parked",
+                                {"status": {"noise": 1}})
+        live = rt.cluster.get(CORE_API, "Story", "default", "parked")
+        assert admitted_condition(live)["lastTransitionTime"] == t0
+
+    def test_invalid_spec_update_leaves_bus_at_last_good(self, rt):
+        kubectl_apply(rt.cluster, make_story("held", steps=[
+            {"name": "a", "type": "sleep", "with": {"duration": "1s"}},
+        ]))
+        assert rt.store.try_get("Story", "default", "held") is not None
+        rt.cluster.patch(CORE_API, "Story", "default", "held", {
+            "spec": {"steps": [
+                {"name": "dup", "type": "sleep", "with": {"duration": "1s"}},
+                {"name": "dup", "type": "sleep", "with": {"duration": "1s"}},
+            ]},
+        })
+        # bus keeps the last admitted spec
+        story = rt.store.get("Story", "default", "held")
+        assert len(story.spec["steps"]) == 1
+        live = rt.cluster.get(CORE_API, "Story", "default", "held")
+        cond = admitted_condition(live)
+        assert cond is not None and cond["status"] == "False"
+
+
+class TestResyncAndOrdering:
+    def test_objects_created_before_manager_sync_on_start(self):
+        """Cluster state that predates the manager (apply while the
+        operator was down) is picked up by the list-based resync, in
+        dependency order, and runs normally."""
+        cluster = FakeCluster()
+        kubectl_apply(cluster, make_story("early", steps=[
+            {"name": "a", "ref": {"name": "w-early"}},
+        ]))  # story BEFORE its engram: resync order must still admit
+        kubectl_apply(cluster, make_engram_template("tpl-early",
+                                                    entrypoint="early-impl"))
+        kubectl_apply(cluster, make_engram("w-early", "tpl-early"))
+        kubectl_apply(cluster, make_storyrun("early-run", "early"))
+
+        @register_engram("early-impl")
+        def impl(ctx):
+            return {"ok": 1}
+
+        rt = Runtime(executor_backend="cluster", cluster_client=cluster)
+        from bobrapet_tpu.cluster import FakeKubelet
+        FakeKubelet(cluster, store=rt.store, storage=rt.storage,
+                    clock=rt.clock, mode="sync")
+        rt.pump()
+        assert rt.run_phase("early-run") == "Succeeded"
+
+    def test_cluster_scoped_template_lands_in_pseudo_namespace(self, rt):
+        kubectl_apply(rt.cluster, make_engram_template("scoped-tpl",
+                                                       entrypoint="x"))
+        tpl = rt.store.try_get("EngramTemplate", CLUSTER_NAMESPACE, "scoped-tpl")
+        assert tpl is not None
+        # and mirrors back out under the empty cluster namespace
+        live = rt.cluster.get(CATALOG_API, "EngramTemplate", "", "scoped-tpl")
+        assert live is not None
+
+    def test_local_apply_still_mirrors_out(self, rt):
+        """The bus-side API keeps working under the cluster backend; a
+        locally applied Story is visible to kubectl."""
+        rt.apply(make_story("local", steps=[
+            {"name": "a", "type": "sleep", "with": {"duration": "1s"}},
+        ]))
+        live = rt.cluster.get(CORE_API, "Story", "default", "local")
+        assert live is not None
+        assert live["spec"]["steps"][0]["name"] == "a"
+
+
+class TestOwnershipAndHealing:
+    def test_status_push_does_not_revert_parked_cluster_edit(self, rt):
+        """A controller status write must not push the bus spec back
+        over a newer (parked-invalid) cluster-side edit."""
+        kubectl_apply(rt.cluster, make_story("ownr", steps=[
+            {"name": "a", "type": "sleep", "with": {"duration": "1s"}},
+        ]))
+        bad_steps = [
+            {"name": "dup", "type": "sleep", "with": {"duration": "1s"}},
+            {"name": "dup", "type": "sleep", "with": {"duration": "1s"}},
+        ]
+        rt.cluster.patch(CORE_API, "Story", "default", "ownr",
+                         {"spec": {"steps": bad_steps}})
+        live = rt.cluster.get(CORE_API, "Story", "default", "ownr")
+        assert admitted_condition(live)["status"] == "False"
+        # a bus status write (controller activity) fires a push
+        rt.store.patch_status("Story", "default", "ownr",
+                              lambda s: s.update(observed=1))
+        live = rt.cluster.get(CORE_API, "Story", "default", "ownr")
+        # the parked edit survived — no silent revert to the bus spec
+        assert [s["name"] for s in live["spec"]["steps"]] == ["dup", "dup"]
+        # and the kubectl-visible denial survived the status push too
+        assert admitted_condition(live)["status"] == "False"
+
+    def test_denial_condition_survives_status_push_without_conditions(self, rt):
+        kubectl_apply(rt.cluster, make_story("denied", steps=[
+            {"name": "a", "type": "sleep", "with": {"duration": "1s"}},
+        ]))
+        rt.cluster.patch(CORE_API, "Story", "default", "denied", {
+            "spec": {"steps": [
+                {"name": "d", "type": "sleep", "with": {"duration": "1s"}},
+                {"name": "d", "type": "sleep", "with": {"duration": "1s"}},
+            ]},
+        })
+        assert admitted_condition(
+            rt.cluster.get(CORE_API, "Story", "default", "denied"))["status"] == "False"
+        # bus status has no 'conditions' key at all
+        rt.store.patch_status("Story", "default", "denied",
+                              lambda s: s.update(phase="Ready"))
+        live = rt.cluster.get(CORE_API, "Story", "default", "denied")
+        cond = admitted_condition(live)
+        assert cond is not None and cond["status"] == "False"
+
+    def test_parked_rejection_heals_via_dependency_update(self, rt):
+        """A cycle rejection heals when the OTHER story is edited to
+        break the cycle (retry fires on the update-admit path)."""
+        kubectl_apply(rt.cluster, make_story("y-story", steps=[
+            {"name": "call", "type": "executeStory",
+             "with": {"storyRef": {"name": "x-story"}}},
+        ]))
+        # x -> y while y -> x: rejected as a cycle
+        kubectl_apply(rt.cluster, make_story("x-story", steps=[
+            {"name": "call", "type": "executeStory",
+             "with": {"storyRef": {"name": "y-story"}}},
+        ]))
+        assert rt.store.try_get("Story", "default", "x-story") is None
+        # break the cycle by editing Y cluster-side
+        rt.cluster.patch(CORE_API, "Story", "default", "y-story", {
+            "spec": {"steps": [
+                {"name": "call", "type": "sleep", "with": {"duration": "1s"}},
+            ]},
+        })
+        assert rt.store.try_get("Story", "default", "x-story") is not None
+        cond = admitted_condition(
+            rt.cluster.get(CORE_API, "Story", "default", "x-story"))
+        assert cond is not None and cond["status"] == "True"
+
+
+class TestManagerDowntime:
+    def test_kubectl_delete_while_down_is_honored_not_resurrected(self, tmp_path):
+        """A mirrored object deleted cluster-side while the manager is
+        down is pruned from the persisted bus on restart, not pushed
+        back to the cluster."""
+        persist = str(tmp_path / "bus")
+        cluster = FakeCluster()
+        rt1 = Runtime(persist_dir=persist, executor_backend="cluster",
+                      cluster_client=cluster)
+        kubectl_apply(cluster, make_story("ephemeral", steps=[
+            {"name": "a", "type": "sleep", "with": {"duration": "1s"}},
+        ]))
+        assert rt1.store.try_get("Story", "default", "ephemeral") is not None
+        rt1.stop()
+        # manager down; user deletes via kubectl
+        cluster.delete(CORE_API, "Story", "default", "ephemeral")
+
+        rt2 = Runtime(persist_dir=persist, executor_backend="cluster",
+                      cluster_client=cluster)
+        assert rt2.store.try_get("Story", "default", "ephemeral") is None
+        assert cluster.get(CORE_API, "Story", "default", "ephemeral") is None
+        rt2.stop()
+
+    def test_bus_object_never_mirrored_is_pushed_not_pruned(self, tmp_path):
+        persist = str(tmp_path / "bus")
+        rt1 = Runtime(persist_dir=persist)  # LOCAL backend: no mirroring
+        rt1.apply(make_story("fresh", steps=[
+            {"name": "a", "type": "sleep", "with": {"duration": "1s"}},
+        ]))
+        rt1.stop()
+        cluster = FakeCluster()
+        rt2 = Runtime(persist_dir=persist, executor_backend="cluster",
+                      cluster_client=cluster)
+        # first cluster-backed start: the un-mirrored object bootstraps out
+        assert cluster.get(CORE_API, "Story", "default", "fresh") is not None
+        rt2.stop()
+
+    def test_gate_approved_while_down_is_merged_on_first_sync(self):
+        """kubectl gate approval landed while the manager was down; the
+        restart's resync must deliver it (create path merges user
+        status)."""
+        cluster = FakeCluster()
+        kubectl_apply(cluster, make_story("gated-dt", steps=[
+            {"name": "approval", "type": "gate", "with": {"timeout": "1h"}},
+        ]))
+        run_manifest = resource_to_manifest(make_storyrun("dt-run", "gated-dt"))
+        run_manifest["status"] = {
+            "gates": {"approval": {"approved": True, "approver": "bob"}}
+        }
+        cluster.create(run_manifest)
+
+        rt = Runtime(executor_backend="cluster", cluster_client=cluster)
+        rt.pump()
+        assert rt.run_phase("dt-run") == "Succeeded"
+        rt.stop()
+
+    def test_stop_detaches_the_mirror(self, rt):
+        rt.stop()
+        rt.apply(make_story("post-stop", steps=[
+            {"name": "a", "type": "sleep", "with": {"duration": "1s"}},
+        ]))
+        assert rt.cluster.get(CORE_API, "Story", "default", "post-stop") is None
+
+
+class TestDowntimeEdits:
+    def test_parked_edit_survives_manager_restart(self, tmp_path):
+        """An invalid cluster-side edit made while the manager is down
+        must stay parked (Admitted=False) after restart — not be
+        silently reverted by the resync push-out."""
+        persist = str(tmp_path / "bus")
+        cluster = FakeCluster()
+        rt1 = Runtime(persist_dir=persist, executor_backend="cluster",
+                      cluster_client=cluster)
+        kubectl_apply(cluster, make_story("edit-dt", steps=[
+            {"name": "a", "type": "sleep", "with": {"duration": "1s"}},
+        ]))
+        rt1.stop()
+        cluster.patch(CORE_API, "Story", "default", "edit-dt", {
+            "spec": {"steps": [
+                {"name": "dup", "type": "sleep", "with": {"duration": "1s"}},
+                {"name": "dup", "type": "sleep", "with": {"duration": "1s"}},
+            ]},
+        })
+        rt2 = Runtime(persist_dir=persist, executor_backend="cluster",
+                      cluster_client=cluster)
+        live = cluster.get(CORE_API, "Story", "default", "edit-dt")
+        # the user's pending edit is intact, visibly denied
+        assert [s["name"] for s in live["spec"]["steps"]] == ["dup", "dup"]
+        assert admitted_condition(live)["status"] == "False"
+        # bus keeps last-good
+        assert len(rt2.store.get("Story", "default", "edit-dt").spec["steps"]) == 1
+        rt2.stop()
+
+    def test_failed_list_parks_pushout_for_that_kind(self, tmp_path):
+        """When a kind's resync list fails, push-out must not run for
+        it — blind pushes would resurrect kubectl-deleted objects."""
+        persist = str(tmp_path / "bus")
+        cluster = FakeCluster()
+        rt1 = Runtime(persist_dir=persist, executor_backend="cluster",
+                      cluster_client=cluster)
+        kubectl_apply(cluster, make_story("blip", steps=[
+            {"name": "a", "type": "sleep", "with": {"duration": "1s"}},
+        ]))
+        rt1.stop()
+        cluster.delete(CORE_API, "Story", "default", "blip")
+
+        orig_list = cluster.list
+
+        def flaky_list(api_version, kind, namespace=None, labels=None):
+            if kind == "Story":
+                raise RuntimeError("transient apiserver blip")
+            return orig_list(api_version, kind, namespace, labels)
+
+        cluster.list = flaky_list
+        rt2 = Runtime(persist_dir=persist, executor_backend="cluster",
+                      cluster_client=cluster)
+        cluster.list = orig_list
+        # not resurrected cluster-side despite the failed list
+        assert cluster.get(CORE_API, "Story", "default", "blip") is None
+        rt2.stop()
+
+    def test_second_gate_patch_merges_nested_fields(self, rt):
+        kubectl_apply(rt.cluster, make_story("g2", steps=[
+            {"name": "approval", "type": "gate", "with": {"timeout": "1h"}},
+        ]))
+        kubectl_apply(rt.cluster, make_storyrun("g2-run", "g2"))
+        rt.pump()
+        rt.cluster.patch_status(
+            RUNS_API, "StoryRun", "default", "g2-run",
+            {"status": {"gates": {"approval": {"approved": True}}}},
+        )
+        # a second kubectl patch ADDING a sub-field to the existing gate
+        rt.cluster.patch_status(
+            RUNS_API, "StoryRun", "default", "g2-run",
+            {"status": {"gates": {"approval": {"comment": "lgtm"}}}},
+        )
+        rt.pump()
+        run = rt.store.get("StoryRun", "default", "g2-run")
+        assert run.status["gates"]["approval"]["comment"] == "lgtm"
+        live = rt.cluster.get(RUNS_API, "StoryRun", "default", "g2-run")
+        assert live["status"]["gates"]["approval"]["comment"] == "lgtm"
+        assert live["status"]["phase"] == "Succeeded"
+
+
+class TestFreshBusRestart:
+    def test_completed_run_is_adopted_not_reexecuted(self):
+        """Restarting with a fresh in-memory bus adopts the cluster's
+        persisted run state; it must not wipe status and re-fire side
+        effects."""
+        calls = []
+
+        @register_engram("fresh.impl")
+        def impl(ctx):
+            calls.append(1)
+            return {"ok": True}
+
+        cluster = FakeCluster()
+        rt1 = Runtime(executor_backend="cluster", cluster_client=cluster)
+        kubectl_apply(cluster, make_engram_template("fr-tpl",
+                                                    entrypoint="fresh.impl"))
+        kubectl_apply(cluster, make_engram("fr", "fr-tpl"))
+        kubectl_apply(cluster, make_story("fr-story", steps=[
+            {"name": "a", "ref": {"name": "fr"}},
+        ]))
+        kubectl_apply(cluster, make_storyrun("fr-run", "fr-story"))
+        rt1.pump()
+        assert rt1.run_phase("fr-run") == "Succeeded"
+        assert calls == [1]
+        rt1.stop()
+
+        # fresh bus, same cluster
+        from bobrapet_tpu.cluster import FakeKubelet
+        rt2 = Runtime(executor_backend="cluster", cluster_client=cluster)
+        FakeKubelet(cluster, store=rt2.store, storage=rt2.storage,
+                    clock=rt2.clock, mode="sync")
+        rt2.pump()
+        # adopted, still Succeeded, NOT re-executed
+        assert rt2.run_phase("fr-run") == "Succeeded"
+        live = cluster.get(RUNS_API, "StoryRun", "default", "fr-run")
+        assert live["status"]["phase"] == "Succeeded"
+        assert calls == [1]
+        rt2.stop()
+
+    def test_gate_approval_flows_while_spec_is_parked(self, rt):
+        """A parked-invalid spec edit must not block gate decisions."""
+        kubectl_apply(rt.cluster, make_story("pk", steps=[
+            {"name": "approval", "type": "gate", "with": {"timeout": "1h"}},
+        ]))
+        kubectl_apply(rt.cluster, make_storyrun("pk-run", "pk"))
+        rt.pump()
+        assert rt.run_phase("pk-run") == "Running"
+        # park an invalid spec edit on the RUN object
+        rt.cluster.patch(RUNS_API, "StoryRun", "default", "pk-run",
+                         {"spec": {"storyRef": {}}})
+        # approval patched while parked still reaches the controller
+        rt.cluster.patch_status(
+            RUNS_API, "StoryRun", "default", "pk-run",
+            {"status": {"gates": {"approval": {"approved": True}}}},
+        )
+        rt.pump()
+        assert rt.run_phase("pk-run") == "Succeeded"
+
+    def test_transient_get_error_does_not_crash_startup(self, tmp_path):
+        persist = str(tmp_path / "bus")
+        cluster = FakeCluster()
+        rt1 = Runtime(persist_dir=persist, executor_backend="cluster",
+                      cluster_client=cluster)
+        kubectl_apply(cluster, make_story("geterr", steps=[
+            {"name": "a", "type": "sleep", "with": {"duration": "1s"}},
+        ]))
+        rt1.stop()
+        orig_get = cluster.get
+
+        def flaky_get(api_version, kind, namespace, name):
+            if kind == "Story" and name == "geterr":
+                raise RuntimeError("connection reset")
+            return orig_get(api_version, kind, namespace, name)
+
+        cluster.get = flaky_get
+        # startup survives the blip (the object is skipped this cycle)
+        rt2 = Runtime(persist_dir=persist, executor_backend="cluster",
+                      cluster_client=cluster)
+        cluster.get = orig_get
+        assert rt2.store.try_get("Story", "default", "geterr") is not None
+        rt2.stop()
+
+
+class TestMergePatchDiff:
+    def test_no_change_sentinel_vs_literal_empty_dict(self):
+        from bobrapet_tpu.cluster.crsync import NO_CHANGE, merge_patch_diff
+
+        assert merge_patch_diff({"a": 1}, {"a": 1}) is NO_CHANGE
+        # scalar -> literal {} must produce a replacement, not no-op
+        assert merge_patch_diff({"a": {}}, {"a": "x"}) == {"a": {}}
+        assert merge_patch_diff({}, {}) is NO_CHANGE
+
+    def test_deletions_become_explicit_nulls(self):
+        from bobrapet_tpu.cluster.crsync import merge_patch_diff
+
+        assert merge_patch_diff({"keep": 1}, {"keep": 1, "gone": 2}) == {
+            "gone": None
+        }
+        assert merge_patch_diff(
+            {"nested": {"a": 1}}, {"nested": {"a": 1, "b": 2}}
+        ) == {"nested": {"b": None}}
+
+    def test_status_key_removal_propagates_out(self, rt):
+        """A controller-removed bus status key must vanish cluster-side
+        (the push is a real diff with null deletions, not accumulate)."""
+        kubectl_apply(rt.cluster, make_story("skey", steps=[
+            {"name": "a", "type": "sleep", "with": {"duration": "1s"}},
+        ]))
+        rt.store.patch_status("Story", "default", "skey",
+                              lambda s: s.update(transient="x"))
+        live = rt.cluster.get(CORE_API, "Story", "default", "skey")
+        assert live["status"]["transient"] == "x"
+        rt.store.patch_status("Story", "default", "skey",
+                              lambda s: s.pop("transient"))
+        live = rt.cluster.get(CORE_API, "Story", "default", "skey")
+        assert "transient" not in live["status"]
+
+
+class TestManagerFlag:
+    def test_cluster_backend_without_api_server_exits_2(self, monkeypatch):
+        from bobrapet_tpu.__main__ import main
+
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+        rc = main(["manager", "--executor-backend", "cluster",
+                   "--metrics-bind-address", "127.0.0.1:0"])
+        assert rc == 2
+
+    def test_env_backend_typo_is_rejected(self, monkeypatch):
+        """argparse skips choices-validation for env-derived defaults;
+        the manager must still refuse a typo'd backend instead of
+        silently running local."""
+        from bobrapet_tpu.__main__ import main
+
+        monkeypatch.setenv("BOBRA_EXECUTOR_BACKEND", "Cluster")
+        rc = main(["manager", "--metrics-bind-address", "127.0.0.1:0"])
+        assert rc == 2
+
+    def test_kube_lease_mode_outside_cluster_exits_2(self, monkeypatch):
+        from bobrapet_tpu.__main__ import main
+
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+        rc = main(["manager", "--leader-elect", "--leader-elect-mode", "kube",
+                   "--metrics-bind-address", "127.0.0.1:0"])
+        assert rc == 2
