@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import functools
 import typing
 from typing import Any, get_args, get_origin, get_type_hints
 
@@ -28,6 +29,215 @@ POLICY_GROUP = "policy.bobrapet.io"
 VERSION = "v1alpha1"
 
 _PRESERVE = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+
+#: Go-style duration grammar (utils/duration.py): one or more
+#: value+unit tokens, or a bare number of seconds
+DURATION_PATTERN = (
+    r"^(\d+(\.\d+)?(ns|us|µs|ms|s|m|h|d))+$|^\d+(\.\d+)?$"
+)
+_DURATION = {"type": "string", "pattern": DURATION_PATTERN}
+
+#: DNS-1123 subdomain (k8s object-name references)
+NAME_PATTERN = r"^[a-z0-9]([-a-z0-9.]*[a-z0-9])?$"
+
+
+def _field_constraints() -> dict[type, dict[str, dict[str, Any]]]:
+    """Per-(dataclass, field) schema constraints, mirroring exactly the
+    rules the admission webhooks enforce (webhooks/*.py) so a
+    kubectl-applied CR fails API-server validation with the same bounds
+    the manager would reject — the reference encodes these via
+    controller-gen markers into its ~18.5k-line CRD YAML."""
+    from .engram import EngramTransportSpec
+    from .runs import GRPCTarget, StoryRunSpec
+    from .shared import (
+        JobWorkloadConfig,
+        RetryPolicy,
+        SecurityPolicy,
+        SliceLocalSSDProvider,
+        StoragePolicy,
+        TPUPolicy,
+    )
+    from .story import Step, StoryPolicy, StoryTimeouts
+    from .transport import (
+        TransportBufferSettings,
+        TransportFanInSettings,
+        TransportFlowAckSettings,
+        TransportFlowControlSettings,
+        TransportFlowCredits,
+        TransportFlowThreshold,
+        TransportLane,
+        TransportPartitioningSettings,
+        TransportReplaySettings,
+        TransportRoutingSettings,
+        TransportDeliverySettings,
+        TransportLifecycleSettings,
+    )
+
+    from .refs import ObjectRef
+
+    positive = {"minimum": 1}
+    non_negative = {"minimum": 0}
+    name_ref = {"pattern": NAME_PATTERN, "maxLength": 253}
+    out: dict[type, dict[str, dict[str, Any]]] = {
+        # every ObjectRef subclass (StoryRef/EngramRef/...) inherits
+        # DNS-1123 name/namespace shape from the base entry below
+        ObjectRef: {
+            "name": dict(name_ref, minLength=1),
+            "namespace": name_ref,
+        },
+    }
+    out.update({
+        Step: {
+            "name": {"minLength": 1, "required": True},
+            # exactly one of ref|type: webhooks/story.py:164; needs
+            # self-dependency: :168
+            "__cel__": [
+                {
+                    "rule": "has(self.ref) != has(self.type)",
+                    "message": "exactly one of `ref` (engram) or `type`"
+                               " (primitive) must be set",
+                },
+                {
+                    "rule": "!has(self.needs) || !(self.name in self.needs)",
+                    "message": "step cannot depend on itself",
+                },
+            ],
+        },
+        StoryPolicy: {
+            "concurrency": positive,  # webhooks/story.py:284
+        },
+        StoryTimeouts: {
+            "story": _DURATION,
+            "step": _DURATION,
+            "gracefulShutdownTimeout": _DURATION,
+        },
+        RetryPolicy: {
+            "maxRetries": non_negative,  # webhooks/engram.py:53
+            "jitter": {"minimum": 0, "maximum": 100},  # :62
+            "delay": _DURATION,
+            "maxDelay": _DURATION,
+        },
+        StoryRunSpec: {
+            "storyRef": {"required": True},
+        },
+        GRPCTarget: {
+            "port": {"minimum": 1, "maximum": 65535},  # webhooks/runs.py:205
+        },
+        EngramTransportSpec: {
+            "grpcPort": {"minimum": 1, "maximum": 65535},
+        },
+        TPUPolicy: {
+            "chips": positive,
+            "hosts": positive,
+            "topology": {"pattern": r"^\d+x\d+(x\d+)?$"},
+        },
+        SliceLocalSSDProvider: {
+            "maxBytes": positive,
+        },
+        StoragePolicy: {
+            "timeoutSeconds": positive,
+            "maxInlineSize": non_negative,
+        },
+        SecurityPolicy: {
+            "runAsUser": non_negative,
+        },
+        JobWorkloadConfig: {
+            "parallelism": positive,
+            "completions": positive,
+            "backoffLimit": non_negative,
+            "activeDeadlineSeconds": positive,
+            "ttlSecondsAfterFinished": non_negative,
+        },
+        # streaming policy language bounds (webhooks/transport.py:47-95)
+        TransportFlowControlSettings: {
+            "mode": {"enum": ["none", "credits"]},
+        },
+        TransportFlowCredits: {
+            "messages": positive,
+            "bytes": positive,
+        },
+        TransportFlowAckSettings: {
+            "messages": positive,
+            "bytes": positive,
+            "maxDelay": _DURATION,
+        },
+        TransportFlowThreshold: {
+            "bufferPct": {"minimum": 1, "maximum": 100},
+        },
+        TransportBufferSettings: {
+            "maxMessages": positive,
+            "maxBytes": positive,
+            "maxAgeSeconds": positive,
+            "dropPolicy": {"enum": ["dropOldest", "dropNewest", "block"]},
+        },
+        TransportDeliverySettings: {
+            "ordering": {"enum": ["none", "perKey", "total"]},
+            "semantics": {"enum": ["atMostOnce", "atLeastOnce"]},
+        },
+        TransportReplaySettings: {
+            "mode": {"enum": ["none", "fromCheckpoint", "full"]},
+            "retentionSeconds": positive,
+            "checkpointInterval": _DURATION,
+        },
+        TransportRoutingSettings: {
+            "mode": {"enum": ["auto", "hub", "p2p"]},
+            "fanOut": {"enum": ["all", "first", "roundRobin"]},
+            "maxDownstreams": positive,
+        },
+        TransportLane: {
+            "kind": {"enum": ["data", "control", "media"]},
+            "direction": {"enum": ["upstream", "downstream", "both"]},
+            "maxMessages": positive,
+            "maxBytes": positive,
+        },
+        TransportFanInSettings: {
+            "mode": {"enum": ["merge", "zip", "quorum"]},
+            "quorum": positive,
+            "timeoutSeconds": positive,
+            "maxEntries": positive,
+        },
+        TransportPartitioningSettings: {
+            "mode": {"enum": ["none", "keyHash", "roundRobin"]},
+            "partitions": positive,
+        },
+        TransportLifecycleSettings: {
+            "strategy": {"enum": ["drain", "cutover"]},
+        },
+    })
+    return out
+
+
+#: steps/compensations/finally are k8s list-maps keyed by name — the
+#: API server enforces name uniqueness exactly like the reference's
+#: CEL-validated uniqueness (story_types.go:88)
+def _list_map_fields() -> dict[type, dict[str, str]]:
+    from .story import StorySpec
+
+    return {
+        StorySpec: {
+            "steps": "name",
+            "compensations": "name",
+            "finally": "name",
+        },
+    }
+
+
+def _constraints_for(cls: type) -> dict[str, dict[str, Any]]:
+    """MRO-merged constraints: a subclass (StoryRef under ObjectRef)
+    inherits the base entry's field rules and may override per field."""
+    table = _cached_field_constraints()
+    merged: dict[str, dict[str, Any]] = {}
+    for ancestor in reversed(cls.__mro__):
+        merged.update(table.get(ancestor, {}))
+    return merged
+
+
+def _list_maps_for(cls: type) -> dict[str, str]:
+    return _cached_list_map_fields().get(cls, {})
+
+
+_cached_field_constraints = functools.cache(_field_constraints)
+_cached_list_map_fields = functools.cache(_list_map_fields)
 
 
 def _schema_for_type(tp: Any, stack: tuple[type, ...]) -> dict[str, Any]:
@@ -71,15 +281,39 @@ def _schema_for_type(tp: Any, stack: tuple[type, ...]) -> dict[str, Any]:
 def dataclass_schema(
     cls: type, stack: tuple[type, ...] = ()
 ) -> dict[str, Any]:
-    """openAPIV3 object schema for one SpecBase dataclass."""
+    """openAPIV3 object schema for one SpecBase dataclass, enriched
+    with the constraint registry (bounds/enums/patterns/CEL mirroring
+    the admission webhooks) so the API server rejects what the manager
+    would reject."""
     hints = _hints_for(cls)
+    constraints = _constraints_for(cls)
+    list_maps = _list_maps_for(cls)
     props: dict[str, Any] = {}
+    required: list[str] = []
     for f in dataclasses.fields(cls):
         key = snake_to_camel(f.name)
-        props[key] = _schema_for_type(hints.get(f.name, Any), stack or (cls,))
+        schema = _schema_for_type(hints.get(f.name, Any), stack or (cls,))
+        extra = constraints.get(key)
+        if extra:
+            extra = dict(extra)
+            if extra.pop("required", False):
+                required.append(key)
+                # k8s `required` only checks key presence; nullable
+                # would still admit an explicit null
+                schema.pop("nullable", None)
+            schema.update(extra)
+        if key in list_maps and schema.get("type") == "array":
+            schema["x-kubernetes-list-type"] = "map"
+            schema["x-kubernetes-list-map-keys"] = [list_maps[key]]
         if f.metadata.get("description"):
-            props[key]["description"] = f.metadata["description"]
+            schema["description"] = f.metadata["description"]
+        props[key] = schema
     out: dict[str, Any] = {"type": "object", "properties": props}
+    if required:
+        out["required"] = sorted(required)
+    cel = constraints.get("__cel__")
+    if cel:
+        out["x-kubernetes-validations"] = [dict(r) for r in cel]
     doc = (cls.__doc__ or "").strip().splitlines()
     if doc:
         out["description"] = doc[0]

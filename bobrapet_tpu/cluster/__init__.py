@@ -5,6 +5,7 @@ from .client import (
     ClusterClient,
     ClusterConflict,
     ClusterError,
+    ClusterInvalid,
     ClusterNotFound,
     apply_manifest,
     extract_failed_exit_code,
@@ -20,6 +21,7 @@ __all__ = [
     "ClusterClient",
     "ClusterConflict",
     "ClusterError",
+    "ClusterInvalid",
     "ClusterNotFound",
     "ClusterExecutor",
     "ClusterWorkloadReconciler",

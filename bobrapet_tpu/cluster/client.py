@@ -44,6 +44,15 @@ class ClusterNotFound(ClusterError):
     """Get/patch/delete of an object that does not exist."""
 
 
+class ClusterInvalid(ClusterError):
+    """Schema validation rejected the object (HTTP 422 Invalid)."""
+
+    def __init__(self, kind: str, name: str, errors: list[str]):
+        self.errors = list(errors)
+        subject = f"{kind} {name!r} is " if kind else ""
+        super().__init__(subject + "invalid: " + "; ".join(errors))
+
+
 #: kinds whose spec is immutable once created (the API server rejects
 #: pod-template mutations); apply never patches these, mirroring the
 #: reference's create-once + adopt-on-AlreadyExists Job handling

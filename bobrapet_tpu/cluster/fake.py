@@ -95,6 +95,34 @@ class FakeCluster:
         self.hold_readiness = False
         self.warmup_seconds = 0.0
         self._warm_at: dict[tuple[str, str, int], float] = {}
+        # structural CRD validation (install_crds): None = permissive,
+        # like a cluster without the CRDs' schemas applied
+        self._crd_registry = None
+
+    def install_crds(self, manifests: Optional[list[dict]] = None) -> None:
+        """Install CRD schemas and enforce them on create/patch — the
+        API-server half of admission (envtest parity). With no
+        argument, installs the framework's 12 exported CRDs."""
+        from ..api.schemas import all_crd_manifests
+        from .schema_validate import CRDRegistry
+
+        if self._crd_registry is None:
+            self._crd_registry = CRDRegistry()
+        for m in manifests if manifests is not None else all_crd_manifests():
+            self._crd_registry.install(m)
+
+    def _validate_crd(self, manifest: dict) -> None:
+        if self._crd_registry is None:
+            return
+        errors = self._crd_registry.validate(manifest)
+        if errors:
+            from .client import ClusterInvalid
+
+            raise ClusterInvalid(
+                manifest.get("kind", ""),
+                (manifest.get("metadata") or {}).get("name", ""),
+                errors,
+            )
 
     # -- client surface ----------------------------------------------------
 
@@ -110,6 +138,7 @@ class FakeCluster:
         meta = m.setdefault("metadata", {})
         meta.setdefault("namespace", "default")
         key = (m.get("apiVersion", ""), m.get("kind", ""), meta["namespace"], meta.get("name", ""))
+        self._validate_crd(m)
         with self._lock:
             if key in self._objects:
                 raise ClusterConflict(f"{key[1]} {key[2]}/{key[3]} already exists")
@@ -147,7 +176,13 @@ class FakeCluster:
             import json
 
             spec_before = json.dumps(obj.get("spec"), sort_keys=True, default=str)
-            _deep_merge(obj, _copy(patch))
+            # merge into a candidate first: schema rejection (422) must
+            # leave the live object untouched
+            candidate = _copy(obj)
+            _deep_merge(candidate, _copy(patch))
+            self._validate_crd(candidate)
+            self._objects[(api_version, kind, namespace, name)] = candidate
+            obj = candidate
             meta = obj["metadata"]
             self._order += 1
             meta["resourceVersion"] = str(self._order)

@@ -154,6 +154,10 @@ class KubeHttpClient:
                 raise ClusterNotFound(f"{method} {path}: {detail}") from e
             if e.code == 409:
                 raise ClusterConflict(f"{method} {path}: {detail}") from e
+            if e.code == 422:
+                from .client import ClusterInvalid
+
+                raise ClusterInvalid("", "", [f"{method} {path}: {detail}"]) from e
             raise ClusterError(f"{method} {path}: HTTP {e.code}: {detail}") from e
         except urllib.error.URLError as e:
             raise ClusterError(f"{method} {path}: {e.reason}") from e

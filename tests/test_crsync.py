@@ -611,6 +611,119 @@ class TestMergePatchDiff:
         assert "transient" not in live["status"]
 
 
+class TestServerSideSchema:
+    """CRD schemas enforce webhook-parity bounds at the API server
+    (FakeCluster.install_crds = envtest with schemas applied)."""
+
+    @pytest.fixture
+    def vc(self):
+        c = FakeCluster()
+        c.install_crds()
+        return c
+
+    def test_duplicate_step_names_rejected_by_list_map(self, vc):
+        from bobrapet_tpu.cluster import ClusterInvalid
+
+        bad = make_story("dup", steps=[
+            {"name": "x", "type": "sleep", "with": {"duration": "1s"}},
+            {"name": "x", "type": "sleep", "with": {"duration": "1s"}},
+        ])
+        with pytest.raises(ClusterInvalid, match="duplicate list-map key"):
+            kubectl_apply(vc, bad)
+
+    def test_port_and_enum_bounds(self, vc):
+        from bobrapet_tpu.cluster import ClusterInvalid
+
+        manifest = {
+            "apiVersion": "transport.bobrapet.io/v1alpha1",
+            "kind": "Transport",
+            "metadata": {"name": "t1", "namespace": ""},
+            "spec": {"settings": {}},
+        }
+        vc.create(manifest)  # valid baseline
+        bad = {
+            "apiVersion": "bobrapet.io/v1alpha1",
+            "kind": "Engram",
+            "metadata": {"name": "e1", "namespace": "default"},
+            "spec": {"transport": {"grpcPort": 99999}},
+        }
+        with pytest.raises(ClusterInvalid, match="above maximum 65535"):
+            vc.create(bad)
+
+    def test_missing_story_ref_rejected(self, vc):
+        from bobrapet_tpu.cluster import ClusterInvalid
+
+        with pytest.raises(ClusterInvalid, match="storyRef.*required"):
+            vc.create({
+                "apiVersion": "runs.bobrapet.io/v1alpha1",
+                "kind": "StoryRun",
+                "metadata": {"name": "r1", "namespace": "default"},
+                "spec": {},
+            })
+
+    def test_invalid_patch_leaves_live_object_untouched(self, vc):
+        from bobrapet_tpu.cluster import ClusterInvalid
+
+        kubectl_apply(vc, make_story("pat", steps=[
+            {"name": "a", "type": "sleep", "with": {"duration": "1s"}},
+        ]))
+        with pytest.raises(ClusterInvalid):
+            vc.patch(CORE_API, "Story", "default", "pat", {
+                "spec": {"steps": [
+                    {"name": "z", "type": "sleep", "with": {"duration": "1s"}},
+                    {"name": "z", "type": "sleep", "with": {"duration": "1s"}},
+                ]},
+            })
+        live = vc.get(CORE_API, "Story", "default", "pat")
+        assert [s["name"] for s in live["spec"]["steps"]] == ["a"]
+
+    def test_full_run_passes_schema_validation(self, vc):
+        """The mirror's own pushes (defaulted specs, status subtrees)
+        must satisfy the exported schemas end to end."""
+        @register_engram("schema.impl")
+        def impl(ctx):
+            return {"ok": True}
+
+        rt = Runtime(executor_backend="cluster", cluster_client=vc)
+        from bobrapet_tpu.cluster import FakeKubelet
+        FakeKubelet(vc, store=rt.store, storage=rt.storage,
+                    clock=rt.clock, mode="sync")
+        kubectl_apply(vc, make_engram_template("sc-tpl",
+                                               entrypoint="schema.impl"))
+        kubectl_apply(vc, make_engram("sc", "sc-tpl"))
+        kubectl_apply(vc, make_story("sc-story", steps=[
+            {"name": "a", "ref": {"name": "sc"}},
+        ]))
+        kubectl_apply(vc, make_storyrun("sc-run", "sc-story"))
+        rt.pump()
+        assert rt.run_phase("sc-run") == "Succeeded"
+        live = vc.get(RUNS_API, "StoryRun", "default", "sc-run")
+        assert live["status"]["phase"] == "Succeeded"
+        rt.stop()
+
+    def test_exported_schemas_carry_cel_and_patterns(self):
+        from bobrapet_tpu.api.schemas import DURATION_PATTERN, all_crd_manifests
+
+        by_kind = {
+            m["spec"]["names"]["kind"]: m for m in all_crd_manifests()
+        }
+        story_schema = (by_kind["Story"]["spec"]["versions"][0]["schema"]
+                        ["openAPIV3Schema"]["properties"]["spec"])
+        steps = story_schema["properties"]["steps"]
+        assert steps["x-kubernetes-list-type"] == "map"
+        assert steps["x-kubernetes-list-map-keys"] == ["name"]
+        item = steps["items"]
+        assert item["required"] == ["name"]
+        rules = {r["rule"] for r in item["x-kubernetes-validations"]}
+        assert "has(self.ref) != has(self.type)" in rules
+        # duration pattern accepts the grammar, rejects garbage
+        import re
+        for ok in ("30s", "1.5h", "2m30s", "100ms", "42"):
+            assert re.search(DURATION_PATTERN, ok), ok
+        for bad in ("fast", "1 hour", "-3s", "3ss"):
+            assert not re.search(DURATION_PATTERN, bad), bad
+
+
 class TestManagerFlag:
     def test_cluster_backend_without_api_server_exits_2(self, monkeypatch):
         from bobrapet_tpu.__main__ import main
