@@ -2,7 +2,7 @@
 
 NATIVE_SO  := native/libblobcache.so native/libstreamhub.so
 
-.PHONY: all native test test-e2e bench clean crds chart image
+.PHONY: all native test test-e2e test-e2e-apiserver bench clean crds chart image
 
 all: native
 
@@ -50,3 +50,11 @@ test-e2e:
 		python -m bobrapet_tpu export-chart >/dev/null && \
 		echo "packaging smoke: OK"; \
 	fi
+
+# Real-apiserver e2e (reference: envtest suites + Kind e2e). Boots
+# kube-apiserver + etcd (KUBEBUILDER_ASSETS or PATH), installs the
+# exported CRDs, runs the manager against it, and classifies exit
+# codes from real Pod status. SKIPS (visibly, via pytest -rs) when the
+# binaries are absent — it never silently passes.
+test-e2e-apiserver:
+	python -m pytest tests/test_e2e_apiserver.py -v -rs
