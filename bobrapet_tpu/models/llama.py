@@ -15,6 +15,11 @@ import dataclasses
 import math
 from typing import Any, Optional
 
+#: one-hot embedding lookups on the sharded training path are capped by
+#: vocab size — the [B, S, V] one-hot beats the gather's reshard only
+#: while it stays small relative to activations (V ~ tens of dims)
+ONEHOT_EMBED_MAX_VOCAB = 16384
+
 import jax
 import jax.numpy as jnp
 
@@ -227,19 +232,45 @@ def forward(
     attn_fn=None,
     lora: Optional[dict[str, Any]] = None,
     lora_scale: float = 1.0,
+    act_sharding=None,
 ) -> tuple[jax.Array, Optional[list[dict[str, jax.Array]]]]:
     """Token ids [B, S] -> logits [B, S, V] (+ updated cache).
 
     ``attn_fn`` overrides the attention implementation (ring attention
     plugs in here for sequence-parallel long context). ``lora`` is ONE
     adapter's tree (models/lora.py); its rank-r deltas ride every site
-    it carries.
+    it carries. ``act_sharding`` (a NamedSharding for [B, S, D]
+    activations) pins the residual stream at every layer boundary —
+    without the pin, SPMD propagation on the BACKWARD pass is free to
+    invent layouts for the residual cotangents (observed: batch sharded
+    over model x seq), whose reconciliation at the attention shard_map
+    boundary forces XLA involuntary full rematerialization.
     """
     if attn_fn is None:
         attn_fn = lambda q, k, v: attention(q, k, v, causal=True)  # noqa: E731
+    constrain = (
+        (lambda t: jax.lax.with_sharding_constraint(t, act_sharding))
+        if act_sharding is not None
+        else (lambda t: t)
+    )
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                              cfg.rope_theta, cfg.rope_scaling)
-    x = params["embed"]["weight"][tokens].astype(cfg.dtype)
+    if act_sharding is not None and cfg.vocab_size <= ONEHOT_EMBED_MAX_VOCAB:
+        # sharded training path: one-hot matmul instead of gather — a
+        # gather from the (vocab=model, dim=fsdp)-sharded table
+        # partitions into a layout whose transition to the pinned
+        # activation sharding forces an involuntary full remat; the
+        # matmul contracts over the sharded vocab dim cleanly (psum
+        # over model) and rides the MXU. Capped by vocab size: the
+        # [B, S, V] one-hot is only cheap for small vocabularies —
+        # above the cap the gather (and its possible reshard) costs
+        # less than materializing the one-hot.
+        onehot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=cfg.dtype)
+        x = constrain(onehot @ params["embed"]["weight"].astype(cfg.dtype))
+    elif act_sharding is not None:
+        x = constrain(params["embed"]["weight"][tokens].astype(cfg.dtype))
+    else:
+        x = params["embed"]["weight"][tokens].astype(cfg.dtype)
     new_caches: Optional[list[dict[str, jax.Array]]] = [] if cache is not None else None
     for i, layer in enumerate(params["layers"]):
         layer_cache = cache[i] if cache is not None else None
@@ -247,9 +278,10 @@ def forward(
         x, updated = _attention_block(layer, x, freqs, cfg, layer_cache,
                                       positions, attn_fn, lora_layer,
                                       lora_scale)
+        x = constrain(x)
         if new_caches is not None:
             new_caches.append(updated)
-        x = _mlp_block(layer, x, cfg, lora_layer, lora_scale)
+        x = constrain(_mlp_block(layer, x, cfg, lora_layer, lora_scale))
     x = rmsnorm_reference(x, params["final_norm"]["weight"], cfg.norm_eps)
     if cfg.tie_embeddings:
         logits = x @ params["embed"]["weight"].T.astype(cfg.dtype)

@@ -24,6 +24,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
+def _to_varying(x, axes):
+    """Mark an unvarying value as device-varying over ``axes``
+    (jax>=0.9 pcast; pvary on older versions)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to="varying")
+    return jax.lax.pvary(x, axes)
+
+
 def _block_attention(q, k, q_pos, k_pos, causal: bool):
     """Scores for one (q shard, k block) pair in fp32 with position-aware
     causal masking. q: [B, Sq, H, D] (kv already grouped to H)."""
@@ -34,7 +42,8 @@ def _block_attention(q, k, q_pos, k_pos, causal: bool):
     return s
 
 
-def _ring_shard(q, k, v, *, axis_name: str, causal: bool, sm_scale: float, n_kv_heads: int):
+def _ring_shard(q, k, v, *, axis_name: str, causal: bool, sm_scale: float,
+                n_kv_heads: int, vary_axes: tuple[str, ...] = ()):
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, sq, hq, d = q.shape
@@ -47,6 +56,14 @@ def _ring_shard(q, k, v, *, axis_name: str, causal: bool, sm_scale: float, n_kv_
     m0 = jnp.full((b, hq, sq), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, hq, sq), jnp.float32)
     acc0 = jnp.zeros((b, sq, hq, d), jnp.float32)
+    if vary_axes:
+        # the loop produces device-varying carries from unvarying inits;
+        # mark them varying up front so the carry types are stable under
+        # vma checking (which in turn lets shard_map's backward avoid
+        # conservative full reshards at the region boundary)
+        m0 = _to_varying(m0, vary_axes)
+        l0 = _to_varying(l0, vary_axes)
+        acc0 = _to_varying(acc0, vary_axes)
 
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
@@ -100,13 +117,18 @@ def ring_attention(
         causal=causal,
         sm_scale=scale,
         n_kv_heads=n_kv_heads,
+        vary_axes=tuple(batch_axes) + (axis_name,),
     )
+    # vma checking ON: with replication tracked, shard_map's transpose
+    # keeps the cotangent shardings expressible — with it off, the
+    # backward boundary produced XLA "involuntary full rematerialization"
+    # (replicate-then-repartition) on every training step
     return shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
+        check_vma=True,
     )(q, k, v)
 
 

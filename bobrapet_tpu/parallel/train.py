@@ -61,10 +61,21 @@ def make_train_step(
         make_ring_attn_fn(mesh, SEQ_AXIS, batch_axes=batch_axes) if ring else None
     )
 
+    # pin the residual stream: batch over (data, fsdp), sequence over
+    # seq when ring attention shards it — leaving this to propagation
+    # let the backward invent batch-over-(model x seq) cotangent
+    # layouts that forced involuntary full remats at the ring boundary
+    from .sharding import activation_spec
+
+    act_sharding = NamedSharding(mesh, activation_spec(mesh, sequence_sharded=ring))
+
     # attn_fn is closed over (functions are not valid JAX types, so it
     # must not travel through jax.checkpoint as an argument)
     def model_fwd(params, tokens_in):
-        logits, _ = forward(params, tokens_in, cfg, attn_fn=attn_fn)
+        logits, _ = forward(
+            params, tokens_in, cfg, attn_fn=attn_fn,
+            act_sharding=act_sharding,
+        )
         return logits
 
     if remat:

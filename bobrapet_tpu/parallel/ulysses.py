@@ -96,12 +96,15 @@ def ulysses_attention(
         sm_scale=scale,
         n_kv_heads=k.shape[2],
     )
+    # vma checking ON for the same reason as ring_attention: with it
+    # off, shard_map's transpose reshards cotangents inexpressibly at
+    # the region boundary (XLA involuntary full rematerialization)
     return shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
+        check_vma=True,
     )(q, k, v)
 
 
