@@ -425,6 +425,62 @@ def config7_serving_moe() -> dict:
     }
 
 
+def config8_serving_spec() -> dict:
+    """Speculative decoding INSIDE the paged engine (spec_decode.py):
+    the same greedy workload with and without a draft model, reporting
+    tok/s both ways plus the accept rate. On CPU tiny models the draft
+    overhead can exceed the amortization; on a real chip the verify
+    amortizes the target's HBM weight traffic over accepted tokens."""
+    import numpy as np
+
+    from bobrapet_tpu.models import llama
+    from bobrapet_tpu.serving import PagedConfig, ServingEngine
+
+    from bobrapet_tpu.models import quant
+
+    cfg = llama.llama_tiny()
+    params = llama.init_params(__import__("jax").random.PRNGKey(0), cfg)
+    # draft = int8-quantized target: a realistic high-accept draft
+    # (untrained random small models agree on ~nothing), and it
+    # exercises the int8 draft path
+    dcfg = cfg
+    dparams = quant.quantize_params(params)
+    pc = PagedConfig(max_slots=4, block_size=16, num_blocks=128,
+                     max_blocks_per_seq=8)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, 8 + (i % 5) * 7).tolist()
+               for i in range(12)]
+
+    def timed(engine):
+        for pr in prompts:
+            engine.submit(list(pr), max_new_tokens=16)
+        engine.step()  # warm the compiled paths
+        warm = sum(len(s.request.output) for s in engine.slots if s) + sum(
+            len(r.output) for r in engine.finished)
+        t0 = time.perf_counter()
+        done = engine.run()
+        wall = time.perf_counter() - t0
+        return (sum(len(r.output) for r in done) - warm) / wall
+
+    off = timed(ServingEngine(params, cfg, pc))
+    spec_eng = ServingEngine(params, cfg, pc, draft_params=dparams,
+                             draft_cfg=dcfg, spec_k=4)
+    on = timed(spec_eng)
+    accept = (spec_eng.spec_accepted / spec_eng.spec_drafted
+              if spec_eng.spec_drafted else 0.0)
+    return {
+        "metric": "serving_spec_decode_tokens_per_sec",
+        "value": round(on, 1),
+        "unit": "tok/s",
+        "vs_baseline": 1.0,
+        "config": "serving-spec",
+        "spec_off_tok_s": round(off, 1),
+        "speedup_vs_off": round(on / off, 2) if off else None,
+        "accept_rate": round(accept, 3),
+        "spec_k": 4,
+    }
+
+
 def run_sweep(state: dict) -> None:
     # the parent NEVER touches the accelerator — but the env var alone
     # is not enough: a site hook can rewrite platform priority
@@ -437,7 +493,8 @@ def run_sweep(state: dict) -> None:
     for idx, fn in ((1, config1_single_step), (3, config3_fanout_gang),
                     (4, config4_streaming_hub), (5, config5_nested_rag),
                     ("serving", config6_serving),
-                    ("serving-moe", config7_serving_moe)):
+                    ("serving-moe", config7_serving_moe),
+                    ("serving-spec", config8_serving_spec)):
         state["stage"] = f"config-{idx}"
         try:
             _emit(fn())

@@ -361,6 +361,10 @@ class _ControlPlaneMetrics:
             "bobrapet_serving_prefix_tokens_total",
             "Prompt tokens by prefix-cache outcome", ["result"]
         )
+        self.serving_spec_tokens = c(
+            "bobrapet_serving_spec_tokens_total",
+            "Speculative decoding proposals by outcome", ["result"]
+        )
         self.binding_op_duration = h(
             "bobrapet_transport_binding_operation_duration_seconds",
             "Binding ensure/negotiation latency",

@@ -100,7 +100,10 @@ class ServingEngine:
 
     def __init__(self, params: Any, cfg: LlamaConfig,
                  pcfg: Optional[PagedConfig] = None,
-                 loras: Optional[Any] = None, lora_scale: float = 1.0):
+                 loras: Optional[Any] = None, lora_scale: float = 1.0,
+                 draft_params: Optional[Any] = None,
+                 draft_cfg: Optional[LlamaConfig] = None,
+                 spec_k: int = 4):
         self.params = params
         self.cfg = cfg
         self.pcfg = pcfg or PagedConfig()
@@ -172,6 +175,51 @@ class ServingEngine:
         )
         self._prefill_fns: dict[int, Any] = {}
         self._prefill_seed_fns: dict[int, Any] = {}
+        # speculative decoding (spec_decode.py): a dense draft model
+        # proposes spec_k tokens per tick over its own pools; one fused
+        # verify commits the greedy-exact accept prefix
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.spec_k = spec_k
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        if draft_params is not None:
+            if draft_cfg is None:
+                raise ValueError("draft_params requires draft_cfg")
+            if self.is_moe:
+                raise ValueError(
+                    "speculative serving is dense-target only (the MoE "
+                    "fused step routes slots, not slot x position grids)"
+                )
+            from ..models.moe import MoEConfig as _MoEConfig2
+
+            if isinstance(draft_cfg, _MoEConfig2):
+                raise ValueError("the draft model must be dense")
+            if spec_k < 1:
+                raise ValueError("spec_k must be >= 1")
+            if draft_cfg.max_seq_len < cfg.max_seq_len:
+                raise ValueError(
+                    f"draft max_seq_len {draft_cfg.max_seq_len} < target "
+                    f"{cfg.max_seq_len}: the draft must cover every "
+                    f"position the target can reach"
+                )
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                # a smaller draft vocab would CLAMP target token ids in
+                # the embed gather — accept rate collapses to ~0 while
+                # still paying full speculative overhead
+                raise ValueError(
+                    f"draft vocab_size {draft_cfg.vocab_size} != target "
+                    f"{cfg.vocab_size}: draft and target must share the "
+                    f"tokenizer"
+                )
+            from .spec_decode import make_spec_step
+
+            self.dpools = init_pools(draft_cfg, self.pcfg)
+            self._spec_fn = make_spec_step(
+                cfg, draft_cfg, self.pcfg, spec_k, lora_scale=lora_scale
+            )
+            self._draft_prefill_fns: dict[int, Any] = {}
+            self._draft_prefill_seed_fns: dict[Any, Any] = {}
 
     # -- public API --------------------------------------------------------
 
@@ -266,19 +314,24 @@ class ServingEngine:
             self._prefill(i, req, shared, shared_tokens, fresh)
 
     def _ensure_growth(self) -> None:
-        """Allocate the next block for any slot whose next token would
-        cross a block boundary; preempt the youngest slot when the pool
-        is exhausted."""
+        """Ensure every decoding slot's table covers its next write
+        (position seq_len-1, i.e. blocks_for(seq_len) blocks); preempt
+        the youngest slot when the pool is exhausted.
+
+        Need-based rather than boundary-triggered: speculative commits
+        advance seq_len by jumps that can SKIP a block boundary, so a
+        modulo trigger would miss the allocation and the next write
+        would land in the scratch block (silent output corruption)."""
         for i, slot in enumerate(self.slots):
             if slot is None or slot.ingest_pos is not None:
                 continue  # ingesting slots pre-allocated their blocks
-            if slot.seq_len % self.pcfg.block_size == 0:
-                needed_idx = slot.seq_len // self.pcfg.block_size
-                if needed_idx < len(slot.blocks):
-                    continue
-                if needed_idx >= self.pcfg.max_blocks_per_seq:
-                    self._retire(i)  # capacity cap reached
-                    continue
+            needed = self.pcfg.blocks_for(slot.seq_len)
+            if needed <= len(slot.blocks):
+                continue
+            if needed > self.pcfg.max_blocks_per_seq:
+                self._retire(i)  # capacity cap reached
+                continue
+            while self.slots[i] is not None and len(slot.blocks) < needed:
                 got = self.blocks.alloc(1)
                 while got is None:
                     victim = self._youngest(exclude=i)
@@ -501,6 +554,32 @@ class ServingEngine:
 
                 lora = select_adapter(self.loras, adapter)
                 self._adapter_cache[adapter] = lora
+        self.pools, logits = self._run_prefill_graphs(
+            self.params, self.pools, self.cfg,
+            self._prefill_fns, self._prefill_seed_fns,
+            suffix_tokens, prefix_blocks, prefix_len, target_blocks,
+            bucket, lora, self.lora_scale, self.is_moe,
+        )
+        if self.draft_params is not None:
+            # mirror every prefill into the draft pools: the draft's
+            # cache must cover the prompt before the first spec tick,
+            # and registered prefix blocks stay draft-valid on reuse
+            # (content-addressed: same tokens -> same draft K/V)
+            self.dpools, _ = self._run_prefill_graphs(
+                self.draft_params, self.dpools, self.draft_cfg,
+                self._draft_prefill_fns, self._draft_prefill_seed_fns,
+                suffix_tokens, prefix_blocks, prefix_len, target_blocks,
+                bucket, None, 1.0, False,
+            )
+        return logits
+
+    def _run_prefill_graphs(self, params, pools, cfg, fns, seed_fns,
+                            suffix_tokens, prefix_blocks, prefix_len,
+                            target_blocks, bucket, lora, lora_scale,
+                            is_moe):
+        """One prefill dispatch over an explicit (params, pools, cfg,
+        graph-cache) tuple — shared by the target and the draft mirror
+        so their bucketing/prefix-table logic cannot drift apart."""
         if prefix_blocks:
             # the seed graph's attention cost scales with its prefix
             # region, so size that region to a power-of-two BLOCK
@@ -510,48 +589,148 @@ class ServingEngine:
             prefix_bucket = min(_bucket(len(prefix_blocks), minimum=1),
                                 self.pcfg.max_blocks_per_seq)
             key = (bucket, prefix_bucket)
-            fn = self._prefill_seed_fns.get(key)
+            fn = seed_fns.get(key)
             if fn is None:
                 fn = jax.jit(
-                    functools.partial(_prefill_bucket, cfg=self.cfg,
+                    functools.partial(_prefill_bucket, cfg=cfg,
                                       pcfg=self.pcfg, bucket=bucket,
-                                      lora_scale=self.lora_scale,
-                                      is_moe=self.is_moe),
+                                      lora_scale=lora_scale,
+                                      is_moe=is_moe),
                     donate_argnums=(1,),
                 )
-                self._prefill_seed_fns[key] = fn
+                seed_fns[key] = fn
             import numpy as np
 
             prefix_table = np.full((prefix_bucket,), SCRATCH_BLOCK, np.int32)
             prefix_table[:len(prefix_blocks)] = prefix_blocks
-            self.pools, logits = fn(
-                self.params, self.pools, suffix_tokens,
+            return fn(
+                params, pools, suffix_tokens,
                 jnp.asarray(prefix_table),
                 jnp.asarray(prefix_len, jnp.int32),
                 jnp.asarray(target_blocks, jnp.int32),
                 lora,
             )
-        else:
-            # hot path without a prefix: the plain bucket-sized graph —
-            # no prefix-capacity gather/attention overhead
-            fn = self._prefill_fns.get(bucket)
-            if fn is None:
-                fn = jax.jit(
-                    functools.partial(_prefill_plain, cfg=self.cfg,
-                                      bucket=bucket,
-                                      lora_scale=self.lora_scale,
-                                      is_moe=self.is_moe),
-                    donate_argnums=(1,),
-                )
-                self._prefill_fns[bucket] = fn
-            self.pools, logits = fn(
-                self.params, self.pools, suffix_tokens,
-                jnp.asarray(target_blocks, jnp.int32),
-                lora,
+        # hot path without a prefix: the plain bucket-sized graph —
+        # no prefix-capacity gather/attention overhead
+        fn = fns.get(bucket)
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(_prefill_plain, cfg=cfg, bucket=bucket,
+                                  lora_scale=lora_scale, is_moe=is_moe),
+                donate_argnums=(1,),
             )
-        return logits
+            fns[bucket] = fn
+        return fn(
+            params, pools, suffix_tokens,
+            jnp.asarray(target_blocks, jnp.int32),
+            lora,
+        )
 
     def _decode_once(self) -> list[int]:
+        if self.draft_params is not None:
+            return self._spec_decode_once()
+        return self._plain_decode_once()
+
+    def _spec_coverage(self, slot: "_SlotState") -> bool:
+        """Ensure the slot's table covers verify writes through
+        seq_len + spec_k - 1; no preemption for speculative extras —
+        failure just degrades this slot to plain decode this tick."""
+        need = self.pcfg.blocks_for(slot.seq_len + self.spec_k)
+        if need <= len(slot.blocks):
+            return True
+        if (need > self.pcfg.max_blocks_per_seq
+                or slot.seq_len + self.spec_k > self.pcfg.capacity):
+            return False
+        got = self.blocks.alloc(need - len(slot.blocks))
+        if got is None:
+            return False
+        slot.blocks.extend(got)
+        return True
+
+    def _spec_decode_once(self) -> list[int]:
+        """Speculative tick: draft spec_k proposals per greedy slot,
+        verify in one fused target step, commit the accept prefix
+        (+ correction/bonus). Mixed batches supported: temperature>0
+        slots sample one token from the position-0 logits; slots
+        without block coverage commit the position-0 argmax — both
+        identical to a plain decode step."""
+        active_l = [
+            s is not None and s.ingest_pos is None for s in self.slots
+        ]
+        spec_ok_l = []
+        for i, slot in enumerate(self.slots):
+            ok = (
+                active_l[i]
+                and slot.request.temperature == 0
+                and slot.request.max_new_tokens - len(slot.request.output) >= 2
+                and self._spec_coverage(slot)
+            )
+            spec_ok_l.append(ok)
+        if not any(spec_ok_l):
+            # nothing to speculate this tick (all-sampled batch, last-
+            # token budgets, no coverage): the plain step commits the
+            # same tokens at 1/(spec_k+1) the target compute
+            return self._plain_decode_once()
+        active = jnp.asarray(active_l, jnp.bool_)
+        spec_ok = jnp.asarray(spec_ok_l, jnp.bool_)
+        seq_lens = jnp.asarray(
+            [s.seq_len if (s and s.ingest_pos is None) else 1
+             for s in self.slots],
+            jnp.int32,
+        )
+        tokens = jnp.asarray(self._last_tokens, jnp.int32)
+        tables = self._block_tables()
+        temps = jnp.asarray(
+            [s.request.temperature if s else 0.0 for s in self.slots],
+            jnp.float32,
+        )
+        adapters = jnp.asarray(
+            [s.request.adapter if s else 0 for s in self.slots], jnp.int32
+        )
+        rids = jnp.asarray(
+            [s.request.rid if s else 0 for s in self.slots], jnp.int32
+        )
+        self._steps += 1
+        self.pools, self.dpools, props, choice, sampled = self._spec_fn(
+            self.params, self.draft_params, self.pools, self.dpools,
+            tokens, seq_lens, active, spec_ok, tables, temps,
+            self._keys, jnp.asarray(self._steps, jnp.int32), rids,
+            self.loras, adapters,
+        )
+        props_h = jax.device_get(props).tolist()
+        choice_h = jax.device_get(choice).tolist()
+        sampled_h = jax.device_get(sampled).tolist()
+
+        done: list[int] = []
+        for i, slot in enumerate(self.slots):
+            if slot is None or slot.ingest_pos is not None:
+                continue
+            req = slot.request
+            if req.temperature > 0:
+                commits = [int(sampled_h[i])]
+            elif not spec_ok_l[i]:
+                commits = [int(choice_h[i][0])]
+            else:
+                m = 0
+                while m < self.spec_k and props_h[i][m] == choice_h[i][m]:
+                    m += 1
+                commits = [int(t) for t in props_h[i][:m]]
+                commits.append(int(choice_h[i][m]))
+                self.spec_drafted += self.spec_k
+                self.spec_accepted += m
+                metrics.serving_spec_tokens.inc("proposed", by=self.spec_k)
+                metrics.serving_spec_tokens.inc("accepted", by=m)
+            for tok in commits:
+                slot.seq_len += 1
+                self._record(i, req, tok)
+                if req.done:
+                    break
+            if req.done:
+                done.append(req.rid)
+                self._retire(i)
+        return done
+
+    def _plain_decode_once(self) -> list[int]:
         S = self.pcfg.max_slots
         # ingesting slots are NOT in the decode batch: their seq_len is
         # not final and their cache is mid-prefill

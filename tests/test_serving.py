@@ -1048,3 +1048,198 @@ class TestMoEServing:
         # before any restore attempt (cheap-checks-first)
         with pytest.raises(ValueError, match="dense-family"):
             build_engine(EngramContext(env))
+
+
+class TestSpeculativeServing:
+    """Speculative decoding inside the paged engine (spec_decode.py):
+    greedy outputs must be token-identical to the non-speculative
+    engine, with accept-rate > 0 doing the amortization work."""
+
+    @pytest.fixture(scope="class")
+    def spec_models(self):
+        cfg = llama.llama_tiny()
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        dcfg = llama.LlamaConfig(
+            vocab_size=cfg.vocab_size, dim=64, n_layers=1, n_heads=2,
+            n_kv_heads=2, ffn_hidden=128, max_seq_len=cfg.max_seq_len,
+            dtype=jnp.float32,
+        )
+        dparams = llama.init_params(jax.random.PRNGKey(7), dcfg)
+        return cfg, params, dcfg, dparams
+
+    def _run_pair(self, spec_models, prompts, n=12, pcfg=None, **spec_kw):
+        cfg, params, dcfg, dparams = spec_models
+        pc = pcfg or PagedConfig(max_slots=4, block_size=8, num_blocks=64,
+                                 max_blocks_per_seq=8)
+        plain = ServingEngine(params, cfg, pc)
+        spec = ServingEngine(params, cfg, pc, draft_params=dparams,
+                             draft_cfg=dcfg, **spec_kw)
+        for pr in prompts:
+            plain.submit(list(pr), n)
+            spec.submit(list(pr), n)
+        plain_out = {r.rid: r.output for r in plain.run()}
+        spec_out = {r.rid: r.output for r in spec.run()}
+        return plain_out, spec_out, spec
+
+    def test_token_identical_to_plain_engine(self, spec_models):
+        prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [11, 12, 13, 14, 15, 16, 17]]
+        plain_out, spec_out, eng = self._run_pair(spec_models, prompts)
+        assert spec_out == plain_out
+        assert eng.spec_drafted > 0  # speculation actually ran
+
+    def test_matches_contiguous_reference(self, spec_models):
+        cfg, params, dcfg, dparams = spec_models
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        ref = _reference_tokens(params, cfg, prompt, 10)
+        eng = ServingEngine(params, cfg,
+                            PagedConfig(max_slots=2, block_size=8,
+                                        num_blocks=32, max_blocks_per_seq=8),
+                            draft_params=dparams, draft_cfg=dcfg)
+        eng.submit(prompt, 10)
+        (r,) = eng.run()
+        assert r.output == ref
+
+    def test_perfect_draft_accepts_everything(self, spec_models):
+        """Draft == target: every proposal matches, so each spec tick
+        commits spec_k+1 tokens and accept rate is 100%."""
+        cfg, params, _, _ = spec_models
+        pc = PagedConfig(max_slots=2, block_size=8, num_blocks=64,
+                         max_blocks_per_seq=8)
+        eng = ServingEngine(params, cfg, pc, draft_params=params,
+                            draft_cfg=cfg, spec_k=3)
+        eng.submit([1, 2, 3, 4], 13)
+        (r,) = eng.run()
+        ref = ServingEngine(params, cfg, pc)
+        ref.submit([1, 2, 3, 4], 13)
+        (rr,) = ref.run()
+        assert r.output == rr.output
+        assert eng.spec_accepted == eng.spec_drafted > 0
+
+    def test_eos_mid_accept_window_truncates(self, spec_models):
+        cfg, params, dcfg, dparams = spec_models
+        pc = PagedConfig(max_slots=2, block_size=8, num_blocks=64,
+                         max_blocks_per_seq=8)
+        ref = ServingEngine(params, cfg, pc)
+        ref.submit([5, 6, 7], 16)
+        (rr,) = ref.run()
+        eos = rr.output[4]  # a token the sequence actually produces
+        plain = ServingEngine(params, cfg, pc)
+        plain.submit([5, 6, 7], 16, eos_token=eos)
+        (p,) = plain.run()
+        spec = ServingEngine(params, cfg, pc, draft_params=dparams,
+                             draft_cfg=dcfg, spec_k=4)
+        spec.submit([5, 6, 7], 16, eos_token=eos)
+        (s,) = spec.run()
+        assert s.output == p.output
+
+    def test_mixed_batch_with_temperature_slots(self, spec_models):
+        """Greedy slots speculate; temp>0 slots advance one sampled
+        token per tick — greedy outputs stay exact."""
+        cfg, params, dcfg, dparams = spec_models
+        pc = PagedConfig(max_slots=4, block_size=8, num_blocks=64,
+                         max_blocks_per_seq=8)
+        plain = ServingEngine(params, cfg, pc)
+        spec = ServingEngine(params, cfg, pc, draft_params=dparams,
+                             draft_cfg=dcfg)
+        for eng in (plain, spec):
+            eng.submit([1, 2, 3], 10)                      # greedy
+            eng.submit([4, 5, 6], 6, temperature=0.8)      # sampled
+            eng.submit([7, 8, 9, 10], 10)                  # greedy
+        plain_out = {r.rid: r.output for r in plain.run()}
+        spec_out = {r.rid: r.output for r in spec.run()}
+        assert spec_out[0] == plain_out[0]
+        assert spec_out[2] == plain_out[2]
+        assert len(spec_out[1]) == 6  # sampled slot completed its budget
+
+    def test_chunked_prefill_and_prefix_cache_with_draft(self, spec_models):
+        cfg, params, dcfg, dparams = spec_models
+        pc = PagedConfig(max_slots=2, block_size=8, num_blocks=64,
+                         max_blocks_per_seq=8, prefill_chunk=16)
+        long_prompt = list(range(1, 41))
+        plain = ServingEngine(params, cfg, pc)
+        spec = ServingEngine(params, cfg, pc, draft_params=dparams,
+                             draft_cfg=dcfg)
+        for eng in (plain, spec):
+            eng.submit(list(long_prompt), 6)
+            eng.submit(list(long_prompt[:24]) + [49, 50], 6)  # prefix reuse
+        plain_out = {r.rid: r.output for r in plain.run()}
+        spec_out = {r.rid: r.output for r in spec.run()}
+        assert spec_out == plain_out
+
+    def test_block_exhaustion_degrades_to_plain_not_wrong(self, spec_models):
+        """Too few free blocks for speculative coverage: slots fall
+        back to single-token commits, outputs stay exact."""
+        cfg, params, dcfg, dparams = spec_models
+        pc = PagedConfig(max_slots=2, block_size=8, num_blocks=8,
+                         max_blocks_per_seq=4)
+        plain = ServingEngine(params, cfg, pc)
+        spec = ServingEngine(params, cfg, pc, draft_params=dparams,
+                             draft_cfg=dcfg, spec_k=4)
+        for eng in (plain, spec):
+            eng.submit([1, 2, 3, 4, 5, 6], 8)
+            eng.submit([9, 8, 7, 6, 5], 8)
+        plain_out = {r.rid: r.output for r in plain.run()}
+        spec_out = {r.rid: r.output for r in spec.run()}
+        assert spec_out == plain_out
+
+    def test_spec_commit_jump_over_block_boundary_stays_exact(self, spec_models):
+        """Multi-token commits can SKIP the block-boundary trigger;
+        the next (degraded, last-budget-token) tick must still have a
+        real block for its write — not the scratch block."""
+        cfg, params, _, _ = spec_models
+        pc = PagedConfig(max_slots=2, block_size=8, num_blocks=64,
+                         max_blocks_per_seq=8)
+        prompt = list(range(1, 12))  # len 11 -> seq_len 12 after prefill
+        plain = ServingEngine(params, cfg, pc)
+        plain.submit(list(prompt), 7)
+        (p,) = plain.run()
+        # perfect draft: tick 1 commits 5 (12 -> 17, skipping the
+        # 16-boundary), tick 2 has remaining budget 1 -> spec degraded
+        spec = ServingEngine(params, cfg, pc, draft_params=params,
+                             draft_cfg=cfg, spec_k=4)
+        spec.submit(list(prompt), 7)
+        (s,) = spec.run()
+        assert s.output == p.output
+
+    def test_all_sampled_batch_takes_plain_step(self, spec_models):
+        """A spec engine with nothing to speculate must not pay the
+        k+1-wide step (falls back to the plain decode graph)."""
+        cfg, params, dcfg, dparams = spec_models
+        pc = PagedConfig(max_slots=2, block_size=8, num_blocks=64,
+                         max_blocks_per_seq=8)
+        eng = ServingEngine(params, cfg, pc, draft_params=dparams,
+                            draft_cfg=dcfg)
+        eng.submit([1, 2, 3], 5, temperature=0.7)
+        (r,) = eng.run()
+        assert len(r.output) == 5
+        assert eng.spec_drafted == 0  # never speculated
+
+    def test_vocab_mismatch_rejected(self, spec_models):
+        cfg, params, _, _ = spec_models
+        dcfg = llama.llama_tiny(vocab_size=cfg.vocab_size // 2)
+        dparams = llama.init_params(jax.random.PRNGKey(3), dcfg)
+        with pytest.raises(ValueError, match="share the tokenizer"):
+            ServingEngine(params, cfg, draft_params=dparams,
+                          draft_cfg=dcfg)
+
+    def test_moe_target_rejected(self):
+        import dataclasses
+
+        from bobrapet_tpu.models import moe
+
+        mcfg = moe.moe_tiny()
+        mcfg = dataclasses.replace(mcfg, capacity_factor=float(mcfg.n_experts))
+        mparams = moe.init_params(jax.random.PRNGKey(0), mcfg)
+        dcfg = llama.llama_tiny(vocab_size=mcfg.vocab_size)
+        dparams = llama.init_params(jax.random.PRNGKey(1), dcfg)
+        with pytest.raises(ValueError, match="dense-target only"):
+            ServingEngine(mparams, mcfg, draft_params=dparams,
+                          draft_cfg=dcfg)
+
+    def test_short_draft_context_rejected(self, spec_models):
+        cfg, params, _, _ = spec_models
+        short = llama.llama_tiny(max_seq_len=cfg.max_seq_len // 2)
+        dparams = llama.init_params(jax.random.PRNGKey(2), short)
+        with pytest.raises(ValueError, match="draft must cover"):
+            ServingEngine(params, cfg, draft_params=dparams,
+                          draft_cfg=short)
