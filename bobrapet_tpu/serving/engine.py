@@ -103,7 +103,8 @@ class ServingEngine:
                  loras: Optional[Any] = None, lora_scale: float = 1.0,
                  draft_params: Optional[Any] = None,
                  draft_cfg: Optional[LlamaConfig] = None,
-                 spec_k: int = 4):
+                 spec_k: int = 4,
+                 pipeline_decode: bool = True):
         self.params = params
         self.cfg = cfg
         self.pcfg = pcfg or PagedConfig()
@@ -154,6 +155,18 @@ class ServingEngine:
         else:
             self.n_adapters = 1
         self._adapter_cache: dict[int, Any] = {}
+        self._tables_cache: Optional[jax.Array] = None
+        self._tables_key: Optional[tuple] = None
+        self._lane_cache: Optional[tuple] = None
+        self._lane_key: Optional[tuple] = None
+        #: decode pipelining: in the steady decode state, tick N+1 is
+        #: dispatched BEFORE tick N's tokens are read back, hiding the
+        #: host round-trip; eos detection lags one step (wasted lanes
+        #: are discarded, their stale writes land at uncommitted
+        #: offsets). Structural ticks (admission, chunked ingest,
+        #: growth, speculation) always run settled.
+        self.pipeline_decode = pipeline_decode
+        self._pending_tick: Optional[dict] = None
         self.pools = init_pools(cfg, self.pcfg)
         self.allocator = BlockAllocator(self.pcfg.num_blocks)
         # all block traffic flows through the prefix cache so freed-
@@ -253,6 +266,9 @@ class ServingEngine:
         while (self.pending or any(self.slots)) and steps < max_steps:
             self.step()
             steps += 1
+        # a pipelined tick may still be in flight at loop exit
+        self._commit_tick(self._pending_tick)
+        self._pending_tick = None
         return self.finished
 
     @property
@@ -262,9 +278,61 @@ class ServingEngine:
     # -- scheduler ---------------------------------------------------------
 
     def step(self) -> list[int]:
-        """One engine tick: admit -> ingest one chunk per prefilling
-        slot -> retire-finished -> grow/preempt -> fused decode ->
-        retire. Returns rids that finished this tick."""
+        """One engine tick. Steady decode state: dispatch tick N+1,
+        THEN read back tick N (host/device overlap; see
+        ``pipeline_decode``). Otherwise: flush any in-flight tick and
+        run the classic settled sequence (admit -> ingest one chunk
+        per prefilling slot -> retire-finished -> grow/preempt ->
+        fused decode -> retire). Returns rids that finished."""
+        if (
+            self.pipeline_decode
+            and self.draft_params is None
+            and self._steady_state()
+        ):
+            prev = self._pending_tick
+            self._pending_tick = None
+            new_tick = self._dispatch_plain(prev)
+            done = self._commit_tick(prev)
+            self._pending_tick = new_tick
+            return done
+        done = self._commit_tick(self._pending_tick)
+        self._pending_tick = None
+        done.extend(self._settled_step())
+        return done
+
+    @staticmethod
+    def _pending_indices(tick: Optional[dict]) -> set:
+        """Slot indexes with an uncommitted token in the in-flight
+        tick; their effective seq_len is one ahead of the committed
+        value (single source for _steady_state and _dispatch_plain)."""
+        return {i for i, _rid in tick["snapshot"]} if tick else set()
+
+    def _steady_state(self) -> bool:
+        """True when the next tick is pure decode: no admissions, no
+        ingesting slots, every active slot's next write position is
+        already block-covered, and at least one slot is decoding."""
+        if self.pending:
+            return False
+        pend_idx = self._pending_indices(self._pending_tick)
+        any_active = False
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            if s.ingest_pos is not None:
+                return False
+            any_active = True
+            predicted = s.seq_len + (1 if i in pend_idx else 0)
+            # the next dispatch passes seq_lens == predicted and writes
+            # at position predicted - 1, so bound coverage/capacity on
+            # `predicted` exactly — an extra +1 would force a settled
+            # stall at every block boundary
+            if self.pcfg.blocks_for(predicted) > len(s.blocks):
+                return False
+            if predicted > self.pcfg.capacity:
+                return False
+        return any_active
+
+    def _settled_step(self) -> list[int]:
         self._admit()
         # chunked prefill: each ingesting slot advances ONE chunk per
         # tick, so a long prompt never blocks the live batch's decode
@@ -731,45 +799,93 @@ class ServingEngine:
         return done
 
     def _plain_decode_once(self) -> list[int]:
-        S = self.pcfg.max_slots
-        # ingesting slots are NOT in the decode batch: their seq_len is
-        # not final and their cache is mid-prefill
-        active = jnp.asarray(
-            [s is not None and s.ingest_pos is None for s in self.slots],
-            jnp.bool_,
-        )
+        # synchronous tick: dispatch then harvest immediately
+        return self._commit_tick(self._dispatch_plain(None))
+
+    def _dispatch_plain(self, prev: Optional[dict]) -> dict:
+        """Dispatch one fused decode step. With ``prev`` (the still-
+        in-flight previous tick) the input tokens are its device-
+        resident outputs — no host round-trip on the hot path — and
+        seq_lens are advanced by the commit the harvest will apply."""
+        pend_idx = self._pending_indices(prev)
+        active_l, active, temps, adapters, rids = self._lane_arrays()
         seq_lens = jnp.asarray(
-            [s.seq_len if (s and s.ingest_pos is None) else 1
-             for s in self.slots],
+            [
+                (s.seq_len + (1 if i in pend_idx else 0))
+                if (s and s.ingest_pos is None) else 1
+                for i, s in enumerate(self.slots)
+            ],
             jnp.int32,
         )
-        tokens = jnp.asarray(self._last_tokens, jnp.int32)
+        if prev is None:
+            tokens = jnp.asarray(self._last_tokens, jnp.int32)
+        else:
+            # every active slot was in prev's snapshot (steady state
+            # admits nothing); lanes of slots retired at harvest are
+            # masked inactive and write only uncommitted offsets
+            tokens = prev["next"]
         tables = self._block_tables()
-        temps = jnp.asarray(
-            [s.request.temperature if s else 0.0 for s in self.slots],
-            jnp.float32,
-        )
         self._steps += 1
         # the per-step key fold happens INSIDE the compiled step (same
         # fold_in values) — a separate vmapped dispatch per tick was
         # pure host overhead
-        adapters = jnp.asarray(
-            [s.request.adapter if s else 0 for s in self.slots], jnp.int32
-        )
-        rids = jnp.asarray(
-            [s.request.rid if s else 0 for s in self.slots], jnp.int32
-        )
         self.pools, next_tokens = self._decode_fn(
             self.params, self.pools, tokens, seq_lens, active, tables,
             temps, self._keys, jnp.asarray(self._steps, jnp.int32), rids,
             self.loras, adapters,
         )
-        next_host = jax.device_get(next_tokens).tolist()
+        snapshot = [
+            (i, self.slots[i].request.rid)
+            for i in range(self.pcfg.max_slots) if active_l[i]
+        ]
+        return {"next": next_tokens, "snapshot": snapshot}
 
+    def _lane_arrays(self):
+        """Per-slot [S] lane arrays (active/temps/adapters/rids),
+        device-cached between occupancy changes: in the steady decode
+        loop these are invariant, and re-transferring four small host
+        arrays per tick was the same overhead class as rebuilding the
+        block table."""
+        key = tuple(
+            (s.request.rid, s.ingest_pos is None) if s is not None else None
+            for s in self.slots
+        )
+        if self._lane_key == key:
+            return self._lane_cache
+        # ingesting slots are NOT in the decode batch: their seq_len is
+        # not final and their cache is mid-prefill
+        active_l = [
+            s is not None and s.ingest_pos is None for s in self.slots
+        ]
+        self._lane_cache = (
+            active_l,
+            jnp.asarray(active_l, jnp.bool_),
+            jnp.asarray(
+                [s.request.temperature if s else 0.0 for s in self.slots],
+                jnp.float32,
+            ),
+            jnp.asarray(
+                [s.request.adapter if s else 0 for s in self.slots],
+                jnp.int32,
+            ),
+            jnp.asarray(
+                [s.request.rid if s else 0 for s in self.slots], jnp.int32
+            ),
+        )
+        self._lane_key = key
+        return self._lane_cache
+
+    def _commit_tick(self, tick: Optional[dict]) -> list[int]:
+        """Read one tick's tokens back and commit them; lanes whose
+        slot churned since dispatch (retired/replaced) are discarded."""
+        if tick is None:
+            return []
+        next_host = jax.device_get(tick["next"]).tolist()
         done: list[int] = []
-        for i, slot in enumerate(self.slots):
-            if slot is None or slot.ingest_pos is not None:
-                continue  # ingesting slots were masked out of the step
+        for i, rid in tick["snapshot"]:
+            slot = self.slots[i]
+            if slot is None or slot.request.rid != rid:
+                continue
             slot.seq_len += 1
             req = slot.request
             self._record(i, req, int(next_host[i]))
@@ -798,6 +914,15 @@ class ServingEngine:
         return int(jnp.argmax(logits))
 
     def _block_tables(self) -> jax.Array:
+        # device-resident between structural changes: rebuilding +
+        # transferring the [S, max_blocks] table every tick was pure
+        # host overhead in the steady decode loop; the content key
+        # detects admission/growth/retire without invalidation hooks
+        key = tuple(
+            tuple(s.blocks) if s is not None else None for s in self.slots
+        )
+        if self._tables_cache is not None and self._tables_key == key:
+            return self._tables_cache
         import numpy as np
 
         t = np.full((self.pcfg.max_slots, self.pcfg.max_blocks_per_seq),
@@ -805,7 +930,9 @@ class ServingEngine:
         for i, slot in enumerate(self.slots):
             if slot is not None:
                 t[i, :len(slot.blocks)] = slot.blocks
-        return jnp.asarray(t)
+        self._tables_key = key
+        self._tables_cache = jnp.asarray(t)
+        return self._tables_cache
 
 
 # ---------------------------------------------------------------------------

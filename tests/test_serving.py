@@ -1243,3 +1243,67 @@ class TestSpeculativeServing:
         with pytest.raises(ValueError, match="draft must cover"):
             ServingEngine(params, cfg, draft_params=dparams,
                           draft_cfg=short)
+
+
+class TestPipelinedDecode:
+    """Steady-state decode pipelining: tick N+1 dispatched before tick
+    N's read-back. Must be invisible to the math."""
+
+    def test_pipelined_equals_synchronous(self, model):
+        cfg, params = model
+        pc = PagedConfig(max_slots=4, block_size=8, num_blocks=64,
+                         max_blocks_per_seq=8)
+        sync = ServingEngine(params, cfg, pc, pipeline_decode=False)
+        pipe = ServingEngine(params, cfg, pc, pipeline_decode=True)
+        prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [11, 12, 13], [4, 4, 4, 4]]
+        for eng in (sync, pipe):
+            for i, pr in enumerate(prompts):
+                # mixed greedy + sampled, mixed budgets
+                eng.submit(list(pr), 8 + i,
+                           temperature=0.0 if i % 2 == 0 else 0.6)
+        sync_out = {r.rid: r.output for r in sync.run()}
+        pipe_out = {r.rid: r.output for r in pipe.run()}
+        assert pipe_out == sync_out
+
+    def test_eos_lag_does_not_leak_tokens(self, model):
+        cfg, params = model
+        pc = PagedConfig(max_slots=2, block_size=8, num_blocks=32,
+                         max_blocks_per_seq=8)
+        probe = ServingEngine(params, cfg, pc, pipeline_decode=False)
+        probe.submit([5, 6, 7], 16)
+        (p,) = probe.run()
+        eos = p.output[5]
+        for pipeline in (False, True):
+            eng = ServingEngine(params, cfg, pc, pipeline_decode=pipeline)
+            eng.submit([5, 6, 7], 16, eos_token=eos)
+            (r,) = eng.run()
+            assert r.output == p.output[:p.output.index(eos) + 1], pipeline
+
+    def test_late_admission_flushes_cleanly(self, model):
+        """A request submitted mid-run forces settled ticks; outputs
+        stay exact for both the old and new occupants."""
+        cfg, params = model
+        pc = PagedConfig(max_slots=2, block_size=8, num_blocks=64,
+                         max_blocks_per_seq=8)
+        ref = ServingEngine(params, cfg, pc, pipeline_decode=False)
+        pipe = ServingEngine(params, cfg, pc, pipeline_decode=True)
+        outs = {}
+        for name, eng in (("ref", ref), ("pipe", pipe)):
+            eng.submit([1, 2, 3], 10)
+            for _ in range(4):
+                eng.step()
+            eng.submit([7, 8, 9, 10], 10)  # arrives mid-decode
+            eng.run()
+            outs[name] = {r.rid: r.output for r in eng.finished}
+        assert outs["pipe"] == outs["ref"]
+
+    def test_block_tables_cached_between_structural_changes(self, model):
+        cfg, params = model
+        eng = ServingEngine(params, cfg,
+                            PagedConfig(max_slots=2, block_size=8,
+                                        num_blocks=32, max_blocks_per_seq=8))
+        eng.submit(list(range(1, 6)), 6)
+        eng.step()
+        t1 = eng._block_tables()
+        t2 = eng._block_tables()
+        assert t1 is t2  # same device array, no rebuild
