@@ -9,21 +9,35 @@ transport_settings_types.go:21-528; the reference's own hub is the
 out-of-repo `bobravoz-grpc` deployable).
 """
 
-from .client import StreamClosed, StreamConsumer, StreamProducer, StreamProtocolError
+from .client import (
+    StreamClosed,
+    StreamConsumer,
+    StreamProducer,
+    StreamProtocolError,
+    open_consumer,
+    open_producer,
+)
 from .frames import FrameError, encode_frame, read_frame, send_frame
 from .hub import StreamHub
+from .partition import PartitionedConsumer, PartitionedProducer
+from .recording import StreamRecorder
 from .tls import TLSPaths, make_hub
 
 __all__ = [
     "FrameError",
+    "PartitionedConsumer",
+    "PartitionedProducer",
     "StreamClosed",
     "StreamConsumer",
     "StreamHub",
     "StreamProducer",
     "StreamProtocolError",
+    "StreamRecorder",
     "TLSPaths",
     "encode_frame",
     "make_hub",
+    "open_consumer",
+    "open_producer",
     "read_frame",
     "send_frame",
 ]

@@ -28,14 +28,25 @@ def main() -> None:
         help="shared-CA mTLS material (ca.crt/tls.crt/tls.key); forces "
              "the Python engine",
     )
+    parser.add_argument(
+        "--record-dir", default=None,
+        help="record streams whose settings enable recording into this "
+             "directory (FileStore); forces the Python engine",
+    )
     args = parser.parse_args()
     logging.basicConfig(level=args.log_level)
 
     from .native import make_hub
 
+    recorder = None
+    if args.record_dir:
+        from ..storage.store import FileStore
+        from .recording import StreamRecorder
+
+        recorder = StreamRecorder(FileStore(args.record_dir))
     native = {"auto": None, "native": True, "python": False}[args.engine]
     hub = make_hub(host=args.host, port=args.port, native=native,
-                   tls=args.tls_dir)
+                   tls=args.tls_dir, recorder=recorder)
     port = hub.start()
     logging.getLogger(__name__).info(
         "stream hub (%s) listening on %s:%s",

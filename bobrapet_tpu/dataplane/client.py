@@ -243,3 +243,30 @@ class StreamConsumer:
             self._sock.close()
         except OSError:
             pass
+
+
+def open_producer(endpoint: str, stream: str,
+                  settings: Optional[dict[str, Any]] = None,
+                  **kw: Any):
+    """Settings-aware producer factory: partitioned settings return a
+    router over N hub streams (dataplane/partition.py), plain settings
+    a direct :class:`StreamProducer` — call sites stay agnostic."""
+    from .partition import PartitionedProducer, partitioning_of
+
+    part = partitioning_of(settings)
+    if part is not None:
+        return PartitionedProducer(endpoint, stream, settings, part, **kw)
+    return StreamProducer(endpoint, stream, settings=settings, **kw)
+
+
+def open_consumer(endpoint: str, stream: str,
+                  settings: Optional[dict[str, Any]] = None,
+                  **kw: Any):
+    """Settings-aware consumer factory: the partitioned variant fan-in
+    merges every partition into one iterator."""
+    from .partition import PartitionedConsumer, partitioning_of
+
+    part = partitioning_of(settings)
+    if part is not None:
+        return PartitionedConsumer(endpoint, stream, settings, part, **kw)
+    return StreamConsumer(endpoint, stream, settings=settings, **kw)

@@ -40,6 +40,7 @@ from typing import Any, Optional
 
 from ..observability.metrics import metrics
 from .frames import FrameError, encode_frame, read_frame, send_frame
+from .recording import recording_knobs
 
 _log = logging.getLogger(__name__)
 
@@ -76,6 +77,9 @@ def _settings_knobs(settings: Optional[dict[str, Any]]) -> dict[str, Any]:
         # retentionSeconds so the bound is always explicit
         "replay_full": replay.get("mode") == "full",
         "replay_retention": float(replay.get("retentionSeconds") or 3600),
+        # recording.mode=full/sample: data frames tee into the blob
+        # store when the hub carries a recorder (dataplane/recording.py)
+        "recording": recording_knobs(s),
     }
 
 
@@ -218,9 +222,13 @@ class StreamHub:
     #: run-scoped, so collisions with future runs don't occur)
     _ENDED_MAX = 4096
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, tls=None):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, tls=None,
+                 recorder=None):
         self.host = host
         self.port = port
+        #: optional StreamRecorder (dataplane/recording.py): streams
+        #: whose settings enable recording tee their data frames here
+        self._recorder = recorder
         self._server: Optional[socket.socket] = None
         self._streams: dict[str, _Stream] = {}
         self._ended: collections.OrderedDict[str, bool] = collections.OrderedDict()
@@ -360,6 +368,17 @@ class StreamHub:
 
     # -- producer side -----------------------------------------------------
     def _serve_producer(self, sock: socket.socket, st: _Stream) -> None:
+        if st.knobs["recording"] and self._recorder is None:
+            # fail LOUD: admission accepted a recording contract; a hub
+            # deployed without a recorder must refuse the stream rather
+            # than silently record nothing (the compliance trap)
+            send_frame(sock, {
+                "t": "err",
+                "message": "stream requires recording but this hub has "
+                           "no recorder (deploy the hub with a record "
+                           "store, e.g. --record-dir)",
+            })
+            return
         conn = _ProducerConn(sock, st)
         conn.writer = threading.Thread(target=conn.writer_loop, daemon=True,
                                        name="hub-producer-writer")
@@ -422,6 +441,8 @@ class StreamHub:
                     if last:
                         for c in consumers:
                             c.enqueue({"t": "eos"}, b"")
+                        if self._recorder is not None and st.knobs["recording"]:
+                            self._recorder.flush(st.name)
                     self._maybe_gc(st)
                     return
                 else:
@@ -469,6 +490,10 @@ class StreamHub:
             entry = (seq, {"t": "data", "seq": seq, "key": header.get("key")}, payload)
             st.buffer.append(entry)
             st.retain(entry)
+            if self._recorder is not None and st.knobs["recording"]:
+                # under st.lock: recorded order == seq order
+                self._recorder.record(st.name, seq, header.get("key"),
+                                      payload, st.knobs["recording"])
             # enqueue under the lock: entries reach each consumer's
             # ordered queue in seq order, interleaved atomically with
             # the attach-replay path

@@ -101,21 +101,23 @@ class NativeStreamHub:
 
 
 def make_hub(host: str = "127.0.0.1", port: int = 0,
-             native: Optional[bool] = None, tls=None):
+             native: Optional[bool] = None, tls=None, recorder=None):
     """Hub factory: native C++ engine when available (or pinned with
     ``native=True``), the Python hub otherwise. TLS forces the Python
     engine — the native event loop does not terminate TLS (VERDICT r2
-    #4 fallback rule); pinning ``native=True`` with TLS is an error,
+    #4 fallback rule) — and so does a recorder (the native engine has
+    no storage tee); pinning ``native=True`` with either is an error,
     not a silent downgrade."""
-    if tls is not None:
+    if tls is not None or recorder is not None:
         if native is True:
+            feature = "terminate TLS" if tls is not None else "record streams"
             raise NativeUnavailable(
-                "the native hub engine does not terminate TLS; "
-                "use engine=python (or auto) with --tls-dir"
+                f"the native hub engine does not {feature}; "
+                f"use engine=python (or auto)"
             )
         from .hub import StreamHub
 
-        return StreamHub(host=host, port=port, tls=tls)
+        return StreamHub(host=host, port=port, tls=tls, recorder=recorder)
     if native is False:
         from .hub import StreamHub
 

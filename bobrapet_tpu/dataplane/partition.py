@@ -1,0 +1,171 @@
+"""Partitioned delivery: one logical stream over N hub streams.
+
+The streaming policy language's ``partitioning`` block
+(api/transport.py TransportPartitioningSettings; reference:
+transport_settings_types.go:393-421) splits a logical stream into N
+partitions with per-partition ordering:
+
+- ``keyHash``: a message's key picks its partition by stable hash, so
+  all messages of one key ride one ordered partition (key stickiness —
+  this is what makes ``delivery.ordering=perKey`` enforceable under
+  parallel consumption);
+- ``roundRobin``: messages rotate over partitions for load spreading
+  (no per-key guarantee, which is why admission rejects ``sticky``
+  with it).
+
+The hub needs no partition awareness: partition ``p`` of stream ``S``
+is simply the hub stream ``S#p`` with the same negotiated settings —
+every buffer/credit/replay/at-least-once behavior applies per
+partition. The producer side routes; the consumer side opens all N
+partitions and FAN-IN MERGES them into one iterator (per-partition
+order preserved; cross-partition interleaving unspecified, exactly the
+contract partitioning trades for parallelism).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import queue as queue_mod
+import threading
+from typing import Any, Iterator, Optional
+
+PARTITION_SEP = "#"
+DEFAULT_PARTITIONS = 2
+
+
+def partitioning_of(settings: Optional[dict[str, Any]]) -> Optional[dict[str, Any]]:
+    """The enforcement knobs when ``settings`` declares partitioned
+    delivery; None for unpartitioned streams."""
+    p = (settings or {}).get("partitioning") or {}
+    mode = p.get("mode")
+    if mode not in ("keyHash", "roundRobin"):
+        return None
+    return {
+        "mode": mode,
+        "partitions": int(p.get("partitions") or DEFAULT_PARTITIONS),
+    }
+
+
+def partition_stream(stream: str, p: int) -> str:
+    return f"{stream}{PARTITION_SEP}{p}"
+
+
+def key_partition(key: str, n: int) -> int:
+    """Stable cross-process key hash (NOT Python's randomized hash())."""
+    digest = hashlib.sha256(key.encode()).digest()
+    return int.from_bytes(digest[:8], "big") % n
+
+
+class PartitionedProducer:
+    """Routes ``send`` calls onto the right partition's producer."""
+
+    def __init__(self, endpoint: str, stream: str,
+                 settings: Optional[dict[str, Any]],
+                 part: dict[str, Any], **kw: Any):
+        from .client import StreamProducer
+
+        self.stream = stream
+        self.mode = part["mode"]
+        self.partitions = part["partitions"]
+        self._rr = 0
+        self._subs = [
+            StreamProducer(endpoint, partition_stream(stream, p),
+                           settings=settings, **kw)
+            for p in range(self.partitions)
+        ]
+
+    def partition_for(self, key: Optional[str]) -> int:
+        if self.mode == "keyHash":
+            if key is None:
+                raise ValueError(
+                    f"stream {self.stream!r} uses keyHash partitioning; "
+                    f"every message needs a key"
+                )
+            return key_partition(key, self.partitions)
+        p = self._rr % self.partitions
+        self._rr += 1
+        return p
+
+    def send(self, payload: Any, key: Optional[str] = None,
+             timeout: Optional[float] = None) -> None:
+        self._subs[self.partition_for(key)].send(payload, key=key,
+                                                 timeout=timeout)
+
+    @property
+    def credits(self) -> int:
+        vals = [s.credits for s in self._subs]
+        return -1 if all(v == -1 for v in vals) else sum(max(0, v) for v in vals)
+
+    def close(self, eos: bool = True) -> None:
+        for s in self._subs:
+            s.close(eos=eos)
+
+
+class PartitionedConsumer:
+    """Fan-in merge over all partitions of one logical stream.
+
+    One reader thread per partition feeds a shared queue; iteration
+    ends when EVERY partition delivered eos.
+
+    Ack/backpressure discipline matches the plain consumer: a pump
+    thread only ADVANCES its sub-consumer's iterator — which is what
+    sends the cumulative ack for the previous item — after the
+    application consumed that item (a per-item handshake). So acks
+    never cover unprocessed messages (atLeastOnce redelivery is
+    preserved across a crash), a stalled application stops the socket
+    reads (credit flow control keeps pacing the producer), and the
+    merge holds at most one in-flight item per partition."""
+
+    def __init__(self, endpoint: str, stream: str,
+                 settings: Optional[dict[str, Any]],
+                 part: dict[str, Any], **kw: Any):
+        from .client import StreamConsumer
+
+        self.stream = stream
+        self.partitions = part["partitions"]
+        self._subs = [
+            StreamConsumer(endpoint, partition_stream(stream, p),
+                           settings=settings, **kw)
+            for p in range(self.partitions)
+        ]
+        self._q: queue_mod.Queue = queue_mod.Queue()
+        self._started = False
+        self._closed = threading.Event()
+
+    def _pump(self, sub) -> None:
+        it = iter(sub)
+        try:
+            while True:
+                item = next(it)  # advancing acks the PREVIOUS item
+                consumed = threading.Event()
+                self._q.put(("data", item, consumed))
+                while not consumed.wait(0.1):
+                    if self._closed.is_set():
+                        return
+        except StopIteration:
+            self._q.put(("end", None, None))
+        except Exception as e:  # noqa: BLE001 - surfaced to the iterator
+            self._q.put(("error", e, None))
+
+    def __iter__(self) -> Iterator[Any]:
+        if not self._started:
+            self._started = True
+            for sub in self._subs:
+                threading.Thread(target=self._pump, args=(sub,),
+                                 daemon=True,
+                                 name=f"fanin-{sub.stream}").start()
+        ended = 0
+        while ended < self.partitions:
+            kind, val, consumed = self._q.get()
+            if kind == "data":
+                yield val
+                consumed.set()  # now the pump may advance (and ack)
+            elif kind == "end":
+                ended += 1
+            else:
+                raise val
+
+    def close(self) -> None:
+        self._closed.set()
+        for sub in self._subs:
+            sub.close()

@@ -1,0 +1,168 @@
+"""Stream recording: tee data frames into the blob store.
+
+The streaming policy language's ``recording`` block (reference:
+transport_settings_types.go:469-487): ``mode=full`` records every data
+frame, ``mode=sample`` a deterministic sampleRate% subset;
+``redactFields`` scrubs named top-level JSON payload fields before
+anything touches storage; ``retentionSeconds`` bounds how long
+segments live (the storage retention sweep pattern).
+
+Segments are JSONL blobs under ``{prefix}/{stream}/{first_seq}.jsonl``
+in any :class:`~bobrapet_tpu.storage.store.Store` (Memory/File/S3/SSD),
+so a recorded stream replays from durable storage long after the hub
+forgot it — unlike ``replay.mode=full``, which is hub-memory-bounded.
+
+Flush model: the hub records under its stream lock so per-stream entry
+order is exactly seq order; appends are cheap, and the occasional
+segment write at a boundary is one ``store.put`` (Memory/File stores —
+wrap a slow remote store in an async adapter before handing it to a
+hot hub). A final flush lands the tail at eos, and ``replay`` merges
+flushed segments with the unflushed tail, so readers never wait for a
+boundary.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+from typing import Any, Iterator, Optional
+
+from ..storage.store import Store
+
+DEFAULT_SEGMENT_ENTRIES = 256
+
+#: deterministic per-seq sampling hash (Knuth multiplicative); NOT
+#: random so a replayed producer records the same subset
+_SAMPLE_MIX = 2654435761
+
+
+def _sampled(seq: int, rate: float) -> bool:
+    return (seq * _SAMPLE_MIX) % 10_000 < rate * 100
+
+
+def recording_knobs(settings: Optional[dict[str, Any]]) -> Optional[dict[str, Any]]:
+    rec = (settings or {}).get("recording") or {}
+    mode = rec.get("mode")
+    if mode not in ("full", "sample"):
+        return None
+    return {
+        "mode": mode,
+        "sample_rate": float(rec.get("sampleRate") or 100.0),
+        "retention": float(rec.get("retentionSeconds") or 0) or None,
+        "redact": list(rec.get("redactFields") or []),
+    }
+
+
+def _redact(payload: bytes, fields: list[str]) -> bytes:
+    if not fields:
+        return payload
+    try:
+        obj = json.loads(payload)
+    except (ValueError, UnicodeDecodeError):
+        return payload  # opaque payloads cannot be field-redacted
+    if isinstance(obj, dict):
+        for f in fields:
+            if f in obj:
+                obj[f] = "[REDACTED]"
+    return json.dumps(obj).encode()
+
+
+class StreamRecorder:
+    """Records streams into a Store (see module doc)."""
+
+    def __init__(self, store: Store, prefix: str = "recordings",
+                 segment_entries: int = DEFAULT_SEGMENT_ENTRIES):
+        self.store = store
+        self.prefix = prefix
+        self.segment_entries = segment_entries
+        self._lock = threading.Lock()
+        #: stream -> list of pending (seq, key, payload) entries
+        self._pending: dict[str, list[tuple[int, Optional[str], bytes]]] = {}
+        #: stream -> retention seconds (for the sweep)
+        self._retention: dict[str, Optional[float]] = {}
+
+    # -- write path --------------------------------------------------------
+
+    def record(self, stream: str, seq: int, key: Optional[str],
+               payload: bytes, knobs: Optional[dict[str, Any]]) -> None:
+        """Tee one data frame; cheap unless a segment boundary is
+        crossed (then the full segment is written to the store)."""
+        if knobs is None:
+            return
+        if knobs["mode"] == "sample" and not _sampled(seq, knobs["sample_rate"]):
+            return
+        payload = _redact(payload, knobs["redact"])
+        with self._lock:
+            pend = self._pending.setdefault(stream, [])
+            pend.append((seq, key, payload))
+            self._retention[stream] = knobs["retention"]
+            if len(pend) >= self.segment_entries:
+                # write INSIDE the lock: popping first and writing
+                # outside would open a window where a concurrent
+                # replay() sees the entries in neither the store nor
+                # the tail (a silent mid-stream gap)
+                self._write_segment(stream, pend)
+                self._pending[stream] = []
+
+    def flush(self, stream: str) -> None:
+        """Persist the unflushed tail (the hub calls this at eos)."""
+        with self._lock:
+            pend = self._pending.pop(stream, None)
+            if pend:
+                self._write_segment(stream, pend)
+
+    def _write_segment(self, stream: str, entries: list) -> None:
+        first = entries[0][0]
+        lines = [
+            json.dumps({
+                "seq": seq,
+                "key": key,
+                "payload": base64.b64encode(payload).decode(),
+            })
+            for seq, key, payload in entries
+        ]
+        self.store.put(
+            f"{self.prefix}/{stream}/{first:012d}.jsonl",
+            ("\n".join(lines) + "\n").encode(),
+        )
+
+    # -- read / retention --------------------------------------------------
+
+    def replay(self, stream: str, from_seq: int = 0) -> Iterator[dict[str, Any]]:
+        """Entries of a recorded stream in seq order: flushed segments
+        from the store plus the unflushed tail."""
+        keys = sorted(self.store.list(f"{self.prefix}/{stream}/"))
+        for blob_key in keys:
+            for line in self.store.get(blob_key).splitlines():
+                if not line.strip():
+                    continue
+                entry = json.loads(line)
+                if entry["seq"] >= from_seq:
+                    entry["payload"] = base64.b64decode(entry["payload"])
+                    yield entry
+        with self._lock:
+            tail = list(self._pending.get(stream, []))
+        for seq, key, payload in tail:
+            if seq >= from_seq:
+                yield {"seq": seq, "key": key, "payload": payload}
+
+    def sweep(self, now: Optional[float] = None) -> int:
+        """Delete segments past their stream's retention; returns the
+        number removed (the storage-retention sweep pattern)."""
+        now = now if now is not None else time.time()
+        removed = 0
+        with self._lock:
+            retentions = dict(self._retention)
+        for stream, retention in retentions.items():
+            if not retention:
+                continue
+            for blob_key in self.store.list(f"{self.prefix}/{stream}/"):
+                try:
+                    if now - self.store.stat_mtime(blob_key) > retention:
+                        self.store.delete(blob_key)
+                        removed += 1
+                except Exception:  # noqa: BLE001 - raced deletion
+                    pass
+        return removed

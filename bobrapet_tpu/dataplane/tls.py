@@ -178,12 +178,13 @@ def wrap_tls(sock, ctx: ssl.SSLContext, server_side: bool = False,
 
 
 def make_hub(tls=None, prefer_native: bool = True, host: str = "127.0.0.1",
-             port: int = 0):
-    """Hub engine selection with the TLS rule applied: the native C++
-    engine does not terminate TLS, so requesting TLS forces the Python
-    hub regardless of preference (delegates to
-    :func:`bobrapet_tpu.dataplane.native.make_hub`)."""
+             port: int = 0, recorder=None):
+    """Hub engine selection with the TLS/recording rules applied: the
+    native C++ engine terminates neither TLS nor the recording tee, so
+    requesting either forces the Python hub regardless of preference
+    (delegates to :func:`bobrapet_tpu.dataplane.native.make_hub`)."""
     from .native import make_hub as _make
 
     return _make(host=host, port=port,
-                 native=None if prefer_native else False, tls=tls)
+                 native=None if prefer_native else False, tls=tls,
+                 recorder=recorder)

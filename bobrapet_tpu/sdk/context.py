@@ -341,7 +341,7 @@ class EngramContext:
         when downstream is full. Streams are consumer-named
         ``ns/run/<consumerStep>`` — a hub target fans out to every step
         in its ``stepNames``; a P2P target names exactly one."""
-        from ..dataplane.client import StreamProducer
+        from ..dataplane.client import open_producer
         from ..dataplane.tls import TLSPaths
 
         if settings is None:
@@ -363,7 +363,9 @@ class EngramContext:
             )
             for dest in dests:
                 stream = f"{self.namespace}/{self.story_run}/{dest}"
-                producers.append(StreamProducer(
+                # settings-aware: partitioned settings route over N
+                # hub streams transparently (dataplane/partition.py)
+                producers.append(open_producer(
                     f"{host}:{port}", stream, settings=settings,
                     connect_timeout=connect_timeout, tls=tls,
                 ))
@@ -376,16 +378,16 @@ class EngramContext:
         """Subscribe to this step's input stream at the hub endpoint;
         iterate to receive (acks ride the negotiated cadence; settings
         default to the binding's merged settings)."""
-        from ..dataplane.client import StreamConsumer
+        from ..dataplane.client import open_consumer
         from ..dataplane.tls import TLSPaths
 
         if settings is None:
             settings = self.negotiated_stream_settings
         stream = f"{self.namespace}/{self.story_run}/{self.step}"
-        return StreamConsumer(endpoint, stream, settings=settings,
-                              decode_json=decode_json,
-                              connect_timeout=connect_timeout,
-                              tls=TLSPaths.from_env(self.env))
+        return open_consumer(endpoint, stream, settings=settings,
+                             decode_json=decode_json,
+                             connect_timeout=connect_timeout,
+                             tls=TLSPaths.from_env(self.env))
 
     @property
     def log(self) -> logging.Logger:

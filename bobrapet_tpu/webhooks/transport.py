@@ -144,16 +144,15 @@ def validate_streaming_settings(st, errs: FieldErrors, path: str) -> None:
         if p.mode == "roundRobin" and p.sticky:
             errs.add(f"{path}.partitioning.sticky",
                      "sticky assignment contradicts roundRobin")
-        if p.mode in ("keyHash", "roundRobin") or (
-            p.partitions is not None and p.partitions > 1
-        ):
-            # reject-what-you-don't-enforce (round-1 rule): the data
-            # plane delivers one ordered stream per edge — admitting a
-            # partitioned config would silently not partition
-            errs.add(f"{path}.partitioning",
-                     "partitioned delivery is not enforced by the data "
-                     "plane (single ordered stream per edge); remove it "
-                     "or set mode=none")
+        if p.mode in (None, "none") and p.partitions is not None and p.partitions > 1:
+            # partitions without a routing mode (absent OR an explicit
+            # "none") would silently deliver on one stream
+            errs.add(f"{path}.partitioning.mode",
+                     "partitions > 1 requires mode=keyHash or roundRobin")
+        # keyHash/roundRobin are ENFORCED since round 4: the client
+        # splits the logical stream into N hub streams with a consumer-
+        # side fan-in merge (dataplane/partition.py) — per-partition
+        # ordering and key stickiness hold end to end
     ro = st.routing
     if ro is not None:
         if ro.mode not in (None, *_VALID_ROUTING_MODES):
@@ -215,13 +214,10 @@ def validate_streaming_settings(st, errs: FieldErrors, path: str) -> None:
         ):
             errs.add(f"{path}.recording",
                      "recording knobs only meaningful with mode != none")
-        if rec.mode in ("sample", "full"):
-            # reject-what-you-don't-enforce: no recorder exists in the
-            # data plane — an admitted recording config would record
-            # nothing and read as compliance
-            errs.add(f"{path}.recording.mode",
-                     "stream recording is not enforced by the data "
-                     "plane; remove it or set mode=none")
+        # full/sample recording is ENFORCED since round 4: hubs carry a
+        # StreamRecorder that tees (optionally sampled/redacted) data
+        # frames into the blob store with retention
+        # (dataplane/recording.py)
     ob = st.observability
     if ob is not None and ob.watermark is not None and ob.watermark.enabled:
         # reject-what-you-don't-enforce: no watermark propagation exists

@@ -773,3 +773,228 @@ class TestTLS:
             assert [m["i"] for m in received] == list(range(n))
         finally:
             hub.stop()
+
+
+class TestPartitionedDelivery:
+    """partitioning.mode=keyHash/roundRobin: N hub streams per logical
+    stream, per-partition ordering, key stickiness, consumer fan-in
+    (dataplane/partition.py). Runs against BOTH engines — the hub needs
+    no partition awareness."""
+
+    KH = {"partitioning": {"mode": "keyHash", "key": "{{ packet.k }}",
+                           "partitions": 3}}
+    RR = {"partitioning": {"mode": "roundRobin", "partitions": 3}}
+
+    def test_keyhash_per_key_order_and_stickiness(self, hub):
+        from bobrapet_tpu.dataplane import open_consumer, open_producer
+        from bobrapet_tpu.dataplane.partition import key_partition
+
+        p = open_producer(hub.endpoint, "ns/run/part", settings=self.KH)
+        sent: dict[str, list[int]] = {}
+        for i in range(30):
+            key = f"k{i % 5}"
+            p.send({"key": key, "i": i}, key=key)
+            sent.setdefault(key, []).append(i)
+        p.close()
+
+        c = open_consumer(hub.endpoint, "ns/run/part", settings=self.KH,
+                          decode_json=True)
+        got: dict[str, list[int]] = {}
+        for msg in c:
+            got.setdefault(msg["key"], []).append(msg["i"])
+        # per-key order survives the parallel partitions
+        assert got == sent
+        # stickiness: each key landed on exactly its hash partition
+        for key in sent:
+            assert 0 <= key_partition(key, 3) < 3
+        # the hub really carries 3 sub-streams
+        seqs = [hub.stream_stats(f"ns/run/part#{i}").get("nextSeq", 0)
+                for i in range(3)]
+        assert sum(seqs) == 30 and all(s > 0 for s in seqs)
+
+    def test_roundrobin_spreads_messages(self, hub):
+        from bobrapet_tpu.dataplane import open_consumer, open_producer
+
+        p = open_producer(hub.endpoint, "ns/run/rr", settings=self.RR)
+        for i in range(12):
+            p.send({"i": i})
+        p.close()
+        c = open_consumer(hub.endpoint, "ns/run/rr", settings=self.RR,
+                          decode_json=True)
+        got = sorted(m["i"] for m in c)
+        assert got == list(range(12))
+        # exact rotation: every partition carries 4 of the 12
+        for i in range(3):
+            assert hub.stream_stats(f"ns/run/rr#{i}")["nextSeq"] == 4
+
+    def test_keyhash_requires_key(self, hub):
+        from bobrapet_tpu.dataplane import open_producer
+
+        p = open_producer(hub.endpoint, "ns/run/nk", settings=self.KH)
+        with pytest.raises(ValueError, match="needs a key"):
+            p.send({"x": 1})
+        p.close()
+
+    def test_unpartitioned_settings_take_the_plain_path(self, hub):
+        from bobrapet_tpu.dataplane import (
+            StreamConsumer as SC,
+            StreamProducer as SP,
+            open_consumer,
+            open_producer,
+        )
+
+        p = open_producer(hub.endpoint, "ns/run/plain", settings={})
+        c = open_consumer(hub.endpoint, "ns/run/plain", settings={})
+        assert isinstance(p, SP) and isinstance(c, SC)
+        p.send(b"x")
+        p.close()
+        assert list(c) == [b"x"]
+
+
+class TestPartitionedAckDiscipline:
+    AL = {
+        "partitioning": {"mode": "roundRobin", "partitions": 2},
+        "flowControl": {"mode": "credits",
+                        "initialCredits": {"messages": 32},
+                        "ackEvery": {"messages": 1}},
+        "delivery": {"semantics": "atLeastOnce"},
+    }
+
+    def test_fan_in_does_not_ack_ahead_of_consumption(self, hub):
+        """The merge must not ack (nor release producer credit for)
+        messages the application has not consumed — atLeastOnce
+        through the fan-in."""
+        from bobrapet_tpu.dataplane import open_consumer, open_producer
+
+        p = open_producer(hub.endpoint, "ns/run/ackd", settings=self.AL)
+        for i in range(10):
+            p.send({"i": i})
+        p.close()
+        c = open_consumer(hub.endpoint, "ns/run/ackd", settings=self.AL,
+                          decode_json=True)
+        it = iter(c)
+        got = [next(it) for _ in range(4)]
+        assert len(got) == 4
+        time.sleep(0.3)  # let any (wrong) eager acks land
+        acked = sum(
+            hub.stream_stats(f"ns/run/ackd#{i}").get("acked", -1) + 1
+            for i in range(2)
+        )
+        # consumed 4; each partition may have ONE in-flight handed item
+        assert acked <= 4 + 2, acked
+        c.close()
+
+
+class TestRecording:
+    """recording.mode=full/sample: data frames tee into the blob store
+    with retention + redaction; a recorded stream replays from storage
+    (dataplane/recording.py)."""
+
+    def _hub_with_recorder(self, **kw):
+        from bobrapet_tpu.dataplane import StreamHub, StreamRecorder
+        from bobrapet_tpu.storage.store import MemoryStore
+
+        store = MemoryStore()
+        rec = StreamRecorder(store, **kw)
+        hub = StreamHub()
+        hub._recorder = rec
+        hub.start()
+        return hub, rec, store
+
+    def test_recorded_stream_replays_from_storage(self):
+        hub, rec, store = self._hub_with_recorder(segment_entries=4)
+        try:
+            settings = {"recording": {"mode": "full"}}
+            p = StreamProducer(hub.endpoint, "ns/run/rec", settings=settings)
+            for i in range(10):
+                p.send({"i": i}, key=f"k{i}")
+            p.close()  # eos flushes the tail segment
+            # drain so the recording is complete
+            list(StreamConsumer(hub.endpoint, "ns/run/rec"))
+            entries = list(rec.replay("ns/run/rec"))
+            assert [e["seq"] for e in entries] == list(range(10))
+            assert [json.loads(e["payload"])["i"] for e in entries] == list(range(10))
+            assert entries[3]["key"] == "k3"
+            # segments actually persisted (10 entries / 4 per segment)
+            assert len(store.list("recordings/ns/run/rec/")) == 3
+            # replay from mid-stream
+            assert [e["seq"] for e in rec.replay("ns/run/rec", from_seq=7)] == [7, 8, 9]
+        finally:
+            hub.stop()
+
+    def test_sampled_recording_records_subset_deterministically(self):
+        hub, rec, _ = self._hub_with_recorder()
+        try:
+            settings = {"recording": {"mode": "sample", "sampleRate": 30}}
+            p = StreamProducer(hub.endpoint, "ns/run/smp", settings=settings)
+            for i in range(50):
+                p.send({"i": i})
+            p.close()
+            got = [e["seq"] for e in rec.replay("ns/run/smp")]
+            assert 0 < len(got) < 50
+            from bobrapet_tpu.dataplane.recording import _sampled
+
+            assert got == [s for s in range(50) if _sampled(s, 30.0)]
+        finally:
+            hub.stop()
+
+    def test_redact_fields_scrub_before_storage(self):
+        hub, rec, store = self._hub_with_recorder()
+        try:
+            settings = {"recording": {"mode": "full",
+                                      "redactFields": ["secret"]}}
+            p = StreamProducer(hub.endpoint, "ns/run/red", settings=settings)
+            p.send({"secret": "hunter2", "ok": 1})
+            p.close()
+            (entry,) = rec.replay("ns/run/red")
+            obj = json.loads(entry["payload"])
+            assert obj == {"secret": "[REDACTED]", "ok": 1}
+            # nothing in the store carries the plaintext
+            for key in store.list(""):
+                assert b"hunter2" not in store.get(key)
+        finally:
+            hub.stop()
+
+    def test_retention_sweep_removes_old_segments(self):
+        hub, rec, store = self._hub_with_recorder(segment_entries=2)
+        try:
+            settings = {"recording": {"mode": "full",
+                                      "retentionSeconds": 60}}
+            p = StreamProducer(hub.endpoint, "ns/run/ret", settings=settings)
+            for i in range(4):
+                p.send({"i": i})
+            p.close()
+            assert len(store.list("recordings/ns/run/ret/")) == 2
+            assert rec.sweep() == 0  # nothing old yet
+            removed = rec.sweep(now=time.time() + 3600)
+            assert removed == 2
+            assert store.list("recordings/ns/run/ret/") == []
+        finally:
+            hub.stop()
+
+    def test_recorderless_hub_refuses_recording_stream(self):
+        """Admission accepted a recording contract; a hub with no
+        recorder must refuse the producer, not silently record
+        nothing."""
+        from bobrapet_tpu.dataplane import StreamHub
+        from bobrapet_tpu.dataplane.client import StreamProtocolError
+
+        hub = StreamHub()
+        hub.start()
+        try:
+            with pytest.raises(StreamProtocolError, match="no recorder"):
+                StreamProducer(hub.endpoint, "ns/run/norec",
+                               settings={"recording": {"mode": "full"}})
+        finally:
+            hub.stop()
+
+    def test_native_pin_with_recorder_refuses(self):
+        from bobrapet_tpu.dataplane import StreamRecorder, make_hub
+        from bobrapet_tpu.dataplane.native import NativeUnavailable
+        from bobrapet_tpu.storage.store import MemoryStore
+
+        rec = StreamRecorder(MemoryStore())
+        with pytest.raises(NativeUnavailable, match="record"):
+            from bobrapet_tpu.dataplane.native import make_hub as native_make
+
+            native_make(native=True, recorder=rec)

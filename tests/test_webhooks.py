@@ -488,16 +488,20 @@ class TestTransportWebhooks:
         denied(lambda: rt.apply(make_transport(
             "t", "p", streaming={"recording": {"mode": "sample"}})),
             "sampleRate")
-        # unenforced families are rejected outright (VERDICT r2 #7:
-        # reject-what-you-don't-enforce)
+        # partitioning and recording became ENFORCED in round 4
+        # (dataplane/partition.py, dataplane/recording.py) — valid
+        # configs are now admitted
+        rt.apply(make_transport(
+            "t-part", "p", streaming={
+                "partitioning": {"mode": "keyHash", "key": "{{ packet.id }}",
+                                 "partitions": 4}}))
+        rt.apply(make_transport(
+            "t-rec", "p", streaming={
+                "recording": {"mode": "sample", "sampleRate": 10}}))
+        # ...but partitions without a mode still make no sense
         denied(lambda: rt.apply(make_transport(
-            "t", "p", streaming={
-                "partitioning": {"mode": "keyHash", "key": "{{ packet.id }}"}})),
-            "not enforced")
-        denied(lambda: rt.apply(make_transport(
-            "t", "p", streaming={
-                "recording": {"mode": "sample", "sampleRate": 10}})),
-            "not enforced")
+            "t", "p", streaming={"partitioning": {"partitions": 4}})),
+            "requires mode")
         denied(lambda: rt.apply(make_transport(
             "t", "p", streaming={
                 "observability": {"watermark": {"enabled": True}}})),
