@@ -247,31 +247,59 @@ def config4_streaming_hub() -> dict:
         engine = "native"
     except Exception:  # noqa: BLE001 - no toolchain; python hub is fine
         hub = StreamHub()
-    hub.start()
+    n_msgs = int(os.environ.get("BENCH_STREAM_MSGS", "5000"))
+    payload = {"pcm": "x" * 512}  # ~0.5 KB frames (voice-ish)
+
+    def burst(h, tls=None) -> float:
+        h.start()
+        try:
+            received = []
+            done = _t.Event()
+            c = StreamConsumer(h.endpoint, "bench/run/stream",
+                               decode_json=True, tls=tls)
+
+            def drain():
+                for msg in c:
+                    received.append(msg)
+                done.set()
+
+            t = _t.Thread(target=drain, daemon=True)
+            t.start()
+            p = StreamProducer(h.endpoint, "bench/run/stream", tls=tls)
+            t0 = time.perf_counter()
+            for _i in range(n_msgs):
+                p.send(payload)
+            p.close()
+            assert done.wait(120), "consumer did not finish"
+            wall = time.perf_counter() - t0
+            assert len(received) == n_msgs
+            return wall
+        finally:
+            h.stop()
+
+    wall = burst(hub)
+
+    # the SAME engine with mTLS on (native rides the TLS frontend) —
+    # the production-security configuration's throughput is part of the
+    # hub's story, not a footnote
+    tls_msg_s = None
     try:
-        n_msgs = int(os.environ.get("BENCH_STREAM_MSGS", "5000"))
-        payload = {"pcm": "x" * 512}  # ~0.5 KB frames (voice-ish)
-        received = []
-        done = _t.Event()
-        c = StreamConsumer(hub.endpoint, "bench/run/stream", decode_json=True)
+        import tempfile
 
-        def drain():
-            for msg in c:
-                received.append(msg)
-            done.set()
+        from bobrapet_tpu.dataplane.native import make_hub as _mk
+        from bobrapet_tpu.dataplane.tls import generate_dev_ca
 
-        t = _t.Thread(target=drain, daemon=True)
-        t.start()
-        p = StreamProducer(hub.endpoint, "bench/run/stream")
-        t0 = time.perf_counter()
-        for i in range(n_msgs):
-            p.send(payload)
-        p.close()
-        assert done.wait(120), "consumer did not finish"
-        wall = time.perf_counter() - t0
-        assert len(received) == n_msgs
-    finally:
-        hub.stop()
+        with tempfile.TemporaryDirectory() as td:
+            tls_dir = generate_dev_ca(td)
+            hub2 = _mk(native=None if engine == "native" else False,
+                       tls=tls_dir)
+            tls_msg_s = round(n_msgs / burst(hub2, tls=tls_dir), 0)
+    except ImportError:
+        pass  # cryptography not installed: the TLS leg is optional
+    # anything else (splice drops frames, handshake breaks) must FAIL
+    # the config — a TLS-path regression must not read as a missing
+    # optional dependency
+
     mb = n_msgs * (len(json.dumps(payload)) + 1) / 1e6
     return {
         "metric": "hub_stream_messages_per_sec",
@@ -279,6 +307,7 @@ def config4_streaming_hub() -> dict:
         "unit": "msg/s",
         "vs_baseline": 1.0,
         "config": 4,
+        "tls_msg_s": tls_msg_s,
         "engine": engine,
         "messages": n_msgs,
         "mb_per_sec": round(mb / wall, 1),

@@ -25,8 +25,8 @@ def main() -> None:
     )
     parser.add_argument(
         "--tls-dir", default=None,
-        help="shared-CA mTLS material (ca.crt/tls.crt/tls.key); forces "
-             "the Python engine",
+        help="shared-CA mTLS material (ca.crt/tls.crt/tls.key); the "
+             "native engine runs behind a TLS-terminating frontend",
     )
     parser.add_argument(
         "--record-dir", default=None,

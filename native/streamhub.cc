@@ -266,6 +266,10 @@ struct Knobs {
   bool at_least_once = false;
   bool replay_full = false;       // delivery.replay.mode == "full"
   double replay_retention = 3600; // delivery.replay.retentionSeconds
+  // recording.mode == full|sample: this engine has no storage tee, so
+  // producers demanding recording are refused (fail-loud, mirroring
+  // the Python hub's recorder-less refusal)
+  bool requires_recording = false;
 };
 
 Knobs knobs_from(const JValue& settings) {
@@ -301,6 +305,10 @@ Knobs knobs_from(const JValue& settings) {
       long ret = r->get_int("retentionSeconds", 0);
       if (ret > 0) k.replay_retention = static_cast<double>(ret);
     }
+  }
+  if (const JValue* rec = settings.get("recording")) {
+    std::string mode = rec->get_str("mode");
+    k.requires_recording = (mode == "full" || mode == "sample");
   }
   return k;
 }
@@ -459,6 +467,20 @@ struct Hub {
       return;
     }
     const JValue* settings = h.get("settings");
+    if (role == "producer" && settings) {
+      // refuse BEFORE creating stream state (like the bad-role path
+      // above): a refused producer must not leak an uncollectable
+      // Stream — maybe_gc only reclaims eos'd streams, and a stream
+      // whose every producer is refused can never reach eos
+      Knobs probe = knobs_from(*settings);
+      if (probe.requires_recording) {
+        send(c, "{\"t\":\"err\",\"message\":\"stream requires recording "
+                "but this hub has no recorder (use the Python hub with "
+                "a record store)\"}");
+        c->closing = true;
+        return;
+      }
+    }
     Stream* st = get_stream(h.get_str("stream"), settings ? *settings : JValue{});
     c->stream = st;
     c->handshaken = true;

@@ -610,70 +610,33 @@ class TestReplay:
 
 
 def _make_ca(tmp_path, name: str):
-    """Self-signed CA + one leaf cert, written in the cert-manager
-    secret layout (ca.crt/tls.crt/tls.key)."""
-    import datetime
+    """Shared-CA material via the in-tree dev generator (one layout
+    for tests, bench, and docs)."""
+    from bobrapet_tpu.dataplane.tls import generate_dev_ca
 
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.x509.oid import NameOID
-
-    def _key():
-        return ec.generate_private_key(ec.SECP256R1())
-
-    now = datetime.datetime.now(datetime.timezone.utc)
-    ca_key = _key()
-    ca_name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, f"{name}-ca")])
-    ca_cert = (
-        x509.CertificateBuilder()
-        .subject_name(ca_name).issuer_name(ca_name)
-        .public_key(ca_key.public_key())
-        .serial_number(x509.random_serial_number())
-        .not_valid_before(now).not_valid_after(now + datetime.timedelta(days=1))
-        .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
-        .sign(ca_key, hashes.SHA256())
-    )
-    leaf_key = _key()
-    leaf = (
-        x509.CertificateBuilder()
-        .subject_name(x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, name)]))
-        .issuer_name(ca_name)
-        .public_key(leaf_key.public_key())
-        .serial_number(x509.random_serial_number())
-        .not_valid_before(now).not_valid_after(now + datetime.timedelta(days=1))
-        .add_extension(x509.SubjectAlternativeName(
-            [x509.DNSName("localhost"),
-             x509.IPAddress(__import__("ipaddress").ip_address("127.0.0.1"))]),
-            critical=False)
-        .sign(ca_key, hashes.SHA256())
-    )
-    d = tmp_path / name
-    d.mkdir()
-    (d / "ca.crt").write_bytes(ca_cert.public_bytes(serialization.Encoding.PEM))
-    (d / "tls.crt").write_bytes(leaf.public_bytes(serialization.Encoding.PEM))
-    (d / "tls.key").write_bytes(leaf_key.private_bytes(
-        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
-        serialization.NoEncryption()))
-    return str(d)
+    return generate_dev_ca(str(tmp_path), name)
 
 
-@pytest.fixture(params=["off", "on"])
+@pytest.fixture(params=["python-off", "python-on", "native-off", "native-on"])
 def tls_hub(request, tmp_path):
-    """The hub under both security modes; yields (hub, client_tls)."""
+    """Every (engine x TLS) combination; yields (hub, client_tls).
+    Native+TLS runs the C++ engine behind the TLS frontend
+    (dataplane/tlsfront.py) — mTLS no longer forfeits the native data
+    path."""
     from bobrapet_tpu.dataplane import StreamHub
+    from bobrapet_tpu.dataplane.native import NativeStreamHub
 
-    if request.param == "off":
-        hub = StreamHub()
-        hub.start()
-        yield hub, None
-        hub.stop()
+    engine, mode = request.param.split("-")
+    if engine == "native" and not _native_hub_available():
+        pytest.skip("native hub unavailable (no toolchain)")
+    tls_dir = _make_ca(tmp_path, "shared") if mode == "on" else None
+    if engine == "native":
+        hub = NativeStreamHub(tls=tls_dir)
     else:
-        tls_dir = _make_ca(tmp_path, "shared")
         hub = StreamHub(tls=tls_dir)
-        hub.start()
-        yield hub, tls_dir
-        hub.stop()
+    hub.start()
+    yield hub, tls_dir
+    hub.stop()
 
 
 class TestTLS:
@@ -716,12 +679,54 @@ class TestTLS:
         finally:
             hub.stop()
 
-    def test_make_hub_forces_python_under_tls(self, tmp_path):
+    def test_make_hub_keeps_native_under_tls(self, tmp_path):
+        """mTLS no longer forfeits the native engine: the factory
+        returns the C++ hub behind a TLS frontend (falling back to the
+        Python hub only when the toolchain is absent)."""
         from bobrapet_tpu.dataplane import StreamHub, make_hub
+        from bobrapet_tpu.dataplane.native import NativeStreamHub
 
         tls_dir = _make_ca(tmp_path, "shared3")
         h = make_hub(tls=tls_dir, prefer_native=True)
-        assert isinstance(h, StreamHub)  # native engine cannot terminate TLS
+        if _native_hub_available():
+            assert isinstance(h, NativeStreamHub)
+            # round-trip through the frontend proves the splice
+            h.start()
+            try:
+                p = StreamProducer(h.endpoint, "ns/r/nt", tls=tls_dir)
+                p.send({"i": 1})
+                p.close()
+                c = StreamConsumer(h.endpoint, "ns/r/nt", decode_json=True,
+                                   tls=tls_dir)
+                assert [m["i"] for m in c] == [1]
+            finally:
+                h.stop()
+        else:
+            assert isinstance(h, StreamHub)
+
+    def test_native_tls_rejects_wrong_ca_and_plaintext(self, tmp_path):
+        import ssl as _ssl
+
+        from bobrapet_tpu.dataplane import StreamProtocolError
+        from bobrapet_tpu.dataplane.client import StreamClosed
+        from bobrapet_tpu.dataplane.native import NativeStreamHub
+
+        if not _native_hub_available():
+            pytest.skip("native hub unavailable")
+        right = _make_ca(tmp_path, "right-n")
+        wrong = _make_ca(tmp_path, "wrong-n")
+        hub = NativeStreamHub(tls=right)
+        hub.start()
+        try:
+            with pytest.raises((_ssl.SSLError, OSError, StreamProtocolError)):
+                StreamProducer(hub.endpoint, "ns/r/nbad", tls=wrong,
+                               connect_timeout=3.0)
+            with pytest.raises((StreamProtocolError, StreamClosed, OSError,
+                                FrameError)):
+                StreamProducer(hub.endpoint, "ns/r/nplain",
+                               connect_timeout=3.0)
+        finally:
+            hub.stop()
 
     def test_tls_paths_from_env_contract(self, tmp_path):
         from bobrapet_tpu.dataplane import TLSPaths
@@ -984,6 +989,24 @@ class TestRecording:
         try:
             with pytest.raises(StreamProtocolError, match="no recorder"):
                 StreamProducer(hub.endpoint, "ns/run/norec",
+                               settings={"recording": {"mode": "full"}})
+        finally:
+            hub.stop()
+
+    def test_native_engine_refuses_recording_stream(self):
+        """The C++ engine has no storage tee: a producer demanding
+        recording gets a protocol error, mirroring the recorder-less
+        Python hub (fail-loud, not silently unrecorded)."""
+        from bobrapet_tpu.dataplane.client import StreamProtocolError
+        from bobrapet_tpu.dataplane.native import NativeStreamHub
+
+        if not _native_hub_available():
+            pytest.skip("native hub unavailable")
+        hub = NativeStreamHub()
+        hub.start()
+        try:
+            with pytest.raises(StreamProtocolError, match="no recorder"):
+                StreamProducer(hub.endpoint, "ns/run/nrec",
                                settings={"recording": {"mode": "full"}})
         finally:
             hub.stop()
