@@ -62,6 +62,16 @@ class StreamProducer:
         tls=None,
     ):
         self.stream = stream
+        # observability.watermark.timestampSource: a dotted path into
+        # JSON payloads (e.g. "metadata.event_time_ms"); when set, send
+        # extracts the event time and stamps the header "et" the hubs'
+        # watermark tracking consumes — extraction lives CLIENT-side so
+        # both hub engines stay payload-agnostic
+        wm = ((settings or {}).get("observability") or {}).get("watermark") or {}
+        self._et_source = (
+            (wm.get("timestampSource") or "").split(".")
+            if wm.get("enabled") and wm.get("timestampSource") else None
+        )
         self._sock = _connect(endpoint, connect_timeout, tls=tls)
         self._credits = 0
         self._unlimited = False
@@ -116,10 +126,20 @@ class StreamProducer:
         payload: Any,
         key: Optional[str] = None,
         timeout: Optional[float] = None,
+        event_time_ms: Optional[int] = None,
     ) -> None:
         """Send one message; blocks while the hub withholds credits
         (backpressure). Raises TimeoutError when `timeout` elapses
-        blocked, StreamClosed/StreamProtocolError on a dead stream."""
+        blocked, StreamClosed/StreamProtocolError on a dead stream.
+        ``event_time_ms`` stamps the event-time header for watermark
+        tracking (auto-extracted from JSON payloads when the settings
+        declare a timestampSource)."""
+        if event_time_ms is None and self._et_source and not isinstance(payload, bytes):
+            node: Any = payload
+            for part in self._et_source:
+                node = node.get(part) if isinstance(node, dict) else None
+            if isinstance(node, (int, float)):
+                event_time_ms = int(node)
         data = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
         if not self._unlimited:
             with self._credit_cv:
@@ -140,6 +160,8 @@ class StreamProducer:
         header: dict[str, Any] = {"t": "data"}
         if key is not None:
             header["key"] = key
+        if event_time_ms is not None:
+            header["et"] = int(event_time_ms)
         send_frame(self._sock, header, data)
 
     @property
@@ -182,6 +204,9 @@ class StreamConsumer:
     ):
         self.stream = stream
         self.decode_json = decode_json
+        #: latest event-time watermark (ms) pushed by the hub; None
+        #: until the first watermark frame arrives
+        self.watermark_ms: Optional[int] = None
         fc = (settings or {}).get("flowControl") or {}
         self._ack_every = int(((fc.get("ackEvery") or {}).get("messages")) or 1)
         self._sock = _connect(endpoint, connect_timeout, tls=tls)
@@ -224,6 +249,14 @@ class StreamConsumer:
                 self._since_ack += 1
                 if self._since_ack >= self._ack_every:
                     self.ack()
+            elif t == "watermark":
+                # event-time frontier update; not part of the data
+                # iteration. max-guarded: reconnects/races must never
+                # rewind the locally observed frontier
+                ms = header.get("ms")
+                if ms is not None and (self.watermark_ms is None
+                                       or int(ms) > self.watermark_ms):
+                    self.watermark_ms = int(ms)
             elif t == "eos":
                 self.ack()
                 return

@@ -80,6 +80,14 @@ def _settings_knobs(settings: Optional[dict[str, Any]]) -> dict[str, Any]:
         # recording.mode=full/sample: data frames tee into the blob
         # store when the hub carries a recorder (dataplane/recording.py)
         "recording": recording_knobs(s),
+        # observability.watermark.enabled: event-time watermark/lag
+        # tracking — producers stamp header "et" (ms; the client
+        # extracts from the payload per timestampSource), the hub
+        # tracks min-over-live-producers of per-producer maxima and
+        # pushes "watermark" frames to consumers on advance
+        "watermark": bool(
+            (((s.get("observability") or {}).get("watermark")) or {}).get("enabled")
+        ),
     }
 
 
@@ -107,6 +115,34 @@ class _Stream:
         self.retained: collections.deque = collections.deque(
             maxlen=REPLAY_MAX_ENTRIES
         )
+        #: event-time watermark (ms) delivered to consumers; advances
+        #: monotonically as min-over-live-producers moves
+        self.watermark_ms: Optional[int] = None
+
+    def compute_watermark(self) -> Optional[int]:
+        """min over live producers' per-connection event-time maxima.
+        A live producer that has not stamped any event time yet HOLDS
+        the frontier at unknown — advancing past a source that has
+        made no claims would break the watermark promise the moment
+        its (arbitrarily old) events arrive. Caller holds the lock."""
+        if not self.knobs["watermark"] or not self.producer_conns:
+            return None
+        maxima = []
+        for p in self.producer_conns:
+            if p.event_time_max is None:
+                return None
+            maxima.append(p.event_time_max)
+        return min(maxima)
+
+    def advance_watermark(self) -> Optional[int]:
+        """Recompute; returns the new watermark when it ADVANCED (the
+        monotone contract: a late-joining producer can hold the
+        watermark back but never rewind it). Caller holds the lock."""
+        wm = self.compute_watermark()
+        if wm is not None and (self.watermark_ms is None or wm > self.watermark_ms):
+            self.watermark_ms = wm
+            return wm
+        return None
 
     def retain(self, entry: tuple) -> None:
         if not self.knobs["replay_full"]:
@@ -148,6 +184,7 @@ class _ProducerConn:
         self.sock = sock
         self.stream = stream
         self.outstanding = 0  # credits handed out, not yet consumed
+        self.event_time_max: Optional[int] = None  # watermark input
         self.queue: collections.deque = collections.deque()
         self.cv = threading.Condition()
         self.closed = False
@@ -287,7 +324,7 @@ class StreamHub:
         if st is None:
             return {}
         with st.lock:
-            return {
+            out = {
                 "buffered": len(st.buffer),
                 "nextSeq": st.next_seq,
                 "acked": st.acked,
@@ -295,6 +332,13 @@ class StreamHub:
                 "paused": st.paused,
                 "eos": st.eos,
             }
+            if st.knobs["watermark"]:
+                out["watermarkMs"] = st.watermark_ms
+                out["lagMs"] = (
+                    max(0, int(time.time() * 1000) - st.watermark_ms)
+                    if st.watermark_ms is not None else None
+                )
+            return out
 
     # -- internals ---------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -438,6 +482,7 @@ class StreamHub:
                         if last:
                             st.eos = True
                         consumers = list(st.consumers)
+                        self._notify_watermark(st)
                     if last:
                         for c in consumers:
                             c.enqueue({"t": "eos"}, b"")
@@ -458,6 +503,8 @@ class StreamHub:
             with st.lock:
                 if conn in st.producer_conns:
                     st.producer_conns.remove(conn)
+                    # a departing producer can only RAISE the min
+                    self._notify_watermark(st)
 
     def _on_data(self, conn: _ProducerConn, header: dict[str, Any], payload: bytes) -> None:
         st = conn.stream
@@ -504,7 +551,27 @@ class StreamHub:
                 # at-most-once: a delivery attempt completes the message
                 if st.buffer and st.buffer[-1][0] == entry[0]:
                     st.buffer.pop()
+            if st.knobs["watermark"] and header.get("et") is not None:
+                # AFTER the data enqueue: the watermark frame must ride
+                # behind the event that moved it, or consumers could
+                # close an event-time window before that event arrives
+                # (the C++ engine orders deliver-then-notify too)
+                et = int(header["et"])
+                if conn.event_time_max is None or et > conn.event_time_max:
+                    conn.event_time_max = et
+                self._notify_watermark(st)
             self._maybe_replenish(st, conn)
+
+    @staticmethod
+    def _notify_watermark(st: _Stream) -> None:
+        """Advance + fan out a watermark frame on every consumer's
+        ordered queue. MUST be called under st.lock — enqueueing
+        outside it can interleave a stale advance behind a newer one
+        (the consumer's monotone contract would break)."""
+        advanced = st.advance_watermark()
+        if advanced is not None:
+            for c in st.consumers:
+                c.enqueue({"t": "watermark", "ms": advanced}, b"")
 
     def _maybe_replenish(self, st: _Stream, conn: _ProducerConn) -> None:
         """Grant more credits when policy allows. Caller holds st.lock.
@@ -562,6 +629,9 @@ class StreamHub:
                     conn.enqueue(header, payload)
                     conn.delivered = max(conn.delivered, seq)
             st.consumers.append(conn)
+            if st.watermark_ms is not None:
+                # a late consumer learns the current event-time frontier
+                conn.enqueue({"t": "watermark", "ms": st.watermark_ms}, b"")
             eos = st.eos
             if not st.knobs["at_least_once"]:
                 # at-most-once: the replay attempt consumes the backlog

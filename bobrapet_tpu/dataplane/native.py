@@ -14,6 +14,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+import time
 from typing import Any, Optional
 
 from ..utils.nativelib import build_and_load
@@ -114,10 +115,9 @@ class NativeStreamHub:
         rc = self._lib.shub_stream_stats(self._handle, name.encode(), buf, 256)
         if rc != 0:
             return {}
-        buffered, next_seq, acked, consumers, eos, paused, dropped = (
-            buf.value.decode().split(",")
-        )
-        return {
+        fields = buf.value.decode().split(",")
+        buffered, next_seq, acked, consumers, eos, paused, dropped = fields[:7]
+        out = {
             "buffered": int(buffered),
             "nextSeq": int(next_seq),
             "acked": int(acked),
@@ -126,6 +126,18 @@ class NativeStreamHub:
             "eos": eos == "1",
             "dropped": int(dropped),
         }
+        # tri-state 8th field: "" = watermarks disabled (keys absent),
+        # "-1" = enabled but frontier unknown (None, matching the
+        # Python hub), else the frontier — lag derived from it
+        if len(fields) > 7 and fields[7] != "":
+            wm = int(fields[7])
+            if wm < 0:
+                out["watermarkMs"] = None
+                out["lagMs"] = None
+            else:
+                out["watermarkMs"] = wm
+                out["lagMs"] = max(0, int(time.time() * 1000) - wm)
+        return out
 
 
 def make_hub(host: str = "127.0.0.1", port: int = 0,

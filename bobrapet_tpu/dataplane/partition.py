@@ -87,9 +87,10 @@ class PartitionedProducer:
         return p
 
     def send(self, payload: Any, key: Optional[str] = None,
-             timeout: Optional[float] = None) -> None:
-        self._subs[self.partition_for(key)].send(payload, key=key,
-                                                 timeout=timeout)
+             timeout: Optional[float] = None,
+             event_time_ms: Optional[int] = None) -> None:
+        self._subs[self.partition_for(key)].send(
+            payload, key=key, timeout=timeout, event_time_ms=event_time_ms)
 
     @property
     def credits(self) -> int:
@@ -164,6 +165,16 @@ class PartitionedConsumer:
                 ended += 1
             else:
                 raise val
+
+    @property
+    def watermark_ms(self):
+        """Fan-in event-time frontier: the MIN over partitions (a
+        partition without a watermark yet makes no claim, so the merged
+        frontier is unknown until every partition reported)."""
+        vals = [s.watermark_ms for s in self._subs]
+        if any(v is None for v in vals):
+            return None
+        return min(vals)
 
     def close(self) -> None:
         self._closed.set()

@@ -5,6 +5,7 @@ validation via pkg/transport/validation).
 
 from __future__ import annotations
 
+import re
 from typing import Optional
 
 from ..api.transport import (
@@ -219,11 +220,21 @@ def validate_streaming_settings(st, errs: FieldErrors, path: str) -> None:
         # frames into the blob store with retention
         # (dataplane/recording.py)
     ob = st.observability
-    if ob is not None and ob.watermark is not None and ob.watermark.enabled:
-        # reject-what-you-don't-enforce: no watermark propagation exists
-        errs.add(f"{path}.observability.watermark.enabled",
-                 "event-time watermarks are not enforced by the data "
-                 "plane; remove the watermark block")
+    if ob is not None and ob.watermark is not None:
+        # watermarks are ENFORCED since round 4: producers stamp event
+        # time (client-side extraction per timestampSource), both hub
+        # engines track min-over-producers and push watermark frames
+        wm = ob.watermark
+        if wm.timestamp_source is not None and not re.fullmatch(
+            r"[A-Za-z_][A-Za-z0-9_]*(\.[A-Za-z_][A-Za-z0-9_]*)*",
+            wm.timestamp_source,
+        ):
+            errs.add(f"{path}.observability.watermark.timestampSource",
+                     "must be a dotted field path into the JSON payload "
+                     "(e.g. metadata.event_time_ms)")
+        if wm.timestamp_source and not wm.enabled:
+            errs.add(f"{path}.observability.watermark.timestampSource",
+                     "only meaningful with watermark.enabled")
     for i, lane in enumerate(st.lanes):
         for field in ("max_messages", "max_bytes"):
             v = getattr(lane, field)

@@ -270,6 +270,10 @@ struct Knobs {
   // producers demanding recording are refused (fail-loud, mirroring
   // the Python hub's recorder-less refusal)
   bool requires_recording = false;
+  // observability.watermark.enabled: track the event-time frontier
+  // (min over live producers of per-connection "et" header maxima) and
+  // push watermark frames to consumers on advance
+  bool watermark = false;
 };
 
 Knobs knobs_from(const JValue& settings) {
@@ -310,6 +314,12 @@ Knobs knobs_from(const JValue& settings) {
     std::string mode = rec->get_str("mode");
     k.requires_recording = (mode == "full" || mode == "sample");
   }
+  if (const JValue* ob = settings.get("observability")) {
+    if (const JValue* wm = ob->get("watermark")) {
+      const JValue* en = wm->get("enabled");
+      k.watermark = en && en->kind == JValue::Bool && en->b;
+    }
+  }
   return k;
 }
 
@@ -336,6 +346,8 @@ struct Stream {
   long next_seq = 0;
   long acked = -1;
   long dropped = 0;  // by buffer drop policy
+  bool has_watermark = false;
+  long watermark_ms = 0;  // monotone event-time frontier
   bool eos = false;
   bool paused = false;
   std::set<Conn*> producers;
@@ -385,6 +397,8 @@ struct Conn {
   bool is_producer = false;
   Stream* stream = nullptr;
   long outstanding = 0;     // producer credits handed out
+  bool has_et = false;      // watermark: producer stamped event time
+  long et_max = 0;          // per-connection event-time maximum (ms)
 };
 
 struct Hub {
@@ -524,6 +538,9 @@ struct Hub {
         for (const Entry& e : st->buffer) send(c, e.header, e.payload);
       }
       st->consumers.insert(c);
+      if (st->has_watermark)
+        send(c, "{\"t\":\"watermark\",\"ms\":" +
+                    std::to_string(st->watermark_ms) + "}");
       if (!st->knobs.at_least_once) st->buffer.clear();
       for (Conn* p : st->producers) replenish(st, p);
       if (st->eos) send(c, "{\"t\":\"eos\"}");
@@ -564,12 +581,53 @@ struct Hub {
     st->retain(st->buffer.back());
     deliver(st, st->buffer.back());
     if (!st->consumers.empty() && !st->knobs.at_least_once) st->buffer.pop_back();
+    if (st->knobs.watermark) {
+      long et = h.get_int("et", -1);
+      if (et >= 0) {
+        if (!c->has_et || et > c->et_max) {
+          c->et_max = et;
+          c->has_et = true;
+        }
+        if (advance_watermark(st)) notify_watermark(st);
+      }
+    }
     replenish(st, c);
+  }
+
+  // min over live producers' event-time maxima; true when the stream
+  // watermark ADVANCED (monotone: producers can hold it back, never
+  // rewind it). Caller holds hub->mu.
+  bool advance_watermark(Stream* st) {
+    if (!st->knobs.watermark || st->producers.empty()) return false;
+    bool any = false;
+    long m = 0;
+    for (Conn* p : st->producers) {
+      // a live producer with no claims HOLDS the frontier: advancing
+      // past it would break the watermark promise when its
+      // (arbitrarily old) events arrive (matches the Python hub)
+      if (!p->has_et) return false;
+      if (!any || p->et_max < m) m = p->et_max;
+      any = true;
+    }
+    if (!any) return false;
+    if (!st->has_watermark || m > st->watermark_ms) {
+      st->watermark_ms = m;
+      st->has_watermark = true;
+      return true;
+    }
+    return false;
+  }
+
+  void notify_watermark(Stream* st) {
+    for (Conn* cons : st->consumers)
+      send(cons, "{\"t\":\"watermark\",\"ms\":" +
+                     std::to_string(st->watermark_ms) + "}");
   }
 
   void on_eos(Conn* c) {
     Stream* st = c->stream;
     st->producers.erase(c);
+    if (advance_watermark(st)) notify_watermark(st);
     if (st->producers.empty()) {
       st->eos = true;
       for (Conn* cons : st->consumers) send(cons, "{\"t\":\"eos\"}");
@@ -628,8 +686,10 @@ struct Hub {
     if (it == conns.end()) return;
     Conn* c = it->second.get();
     if (c->stream != nullptr) {
-      c->stream->producers.erase(c);
+      bool was_producer = c->stream->producers.erase(c) > 0;
       c->stream->consumers.erase(c);
+      if (was_producer && advance_watermark(c->stream))
+        notify_watermark(c->stream);
       for (Conn* p : c->stream->producers) replenish(c->stream, p);
       maybe_gc(c->stream);
     }
@@ -838,7 +898,14 @@ int shub_stream_stats(void* h, const char* name, char* out, uint64_t outlen) {
                   std::to_string(st->consumers.size()) + "," +
                   (st->eos ? "1" : "0") + "," +
                   (st->paused ? "1" : "0") + "," +
-                  std::to_string(st->dropped);
+                  std::to_string(st->dropped) + "," +
+                  // tri-state: "" = watermarks disabled, "-1" =
+                  // enabled but frontier unknown, else the frontier ms
+                  (st->knobs.watermark
+                       ? (st->has_watermark
+                              ? std::to_string(st->watermark_ms)
+                              : std::string("-1"))
+                       : std::string(""));
   if (s.size() + 1 > outlen) return -1;
   std::memcpy(out, s.c_str(), s.size() + 1);
   return 0;

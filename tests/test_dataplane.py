@@ -1021,3 +1021,104 @@ class TestRecording:
             from bobrapet_tpu.dataplane.native import make_hub as native_make
 
             native_make(native=True, recorder=rec)
+
+
+class TestWatermarks:
+    """observability.watermark: event-time frontier tracking — both
+    engines track min-over-live-producers of header-stamped event
+    times and push watermark frames; the client extracts event times
+    from JSON payloads per timestampSource."""
+
+    WM = {"observability": {"watermark": {
+        "enabled": True, "timestampSource": "meta.event_time_ms"}}}
+
+    def test_watermark_advances_and_reaches_consumer(self, hub):
+        c = StreamConsumer(hub.endpoint, "ns/r/wm", settings=self.WM,
+                           decode_json=True)
+        got = []
+        done = threading.Event()
+
+        def drain():
+            for m in c:
+                got.append((m["i"], c.watermark_ms))
+            done.set()
+
+        threading.Thread(target=drain, daemon=True).start()
+        p = StreamProducer(hub.endpoint, "ns/r/wm", settings=self.WM)
+        for i, et in enumerate([1000, 3000, 2000, 5000]):
+            p.send({"i": i, "meta": {"event_time_ms": et}})
+        p.close()
+        assert done.wait(10)
+        assert [i for i, _ in got] == [0, 1, 2, 3]
+        # frontier is monotone: 1000, 3000, 3000 (2000 can't rewind), 5000
+        assert c.watermark_ms == 5000
+        stats = hub.stream_stats("ns/r/wm")
+        assert stats.get("watermarkMs") == 5000
+        assert stats.get("lagMs") is not None and stats["lagMs"] >= 0
+
+    def test_multi_producer_min_over_maxima(self, hub):
+        """The stream frontier is the MIN over live producers — a
+        laggard holds it back; its departure releases it."""
+        settings = {"observability": {"watermark": {"enabled": True}}}
+        fast = StreamProducer(hub.endpoint, "ns/r/wm2", settings=settings)
+        slow = StreamProducer(hub.endpoint, "ns/r/wm2", settings=settings)
+        fast.send({"i": 0}, event_time_ms=9000)
+        slow.send({"i": 1}, event_time_ms=2000)
+        time.sleep(0.3)
+        assert hub.stream_stats("ns/r/wm2")["watermarkMs"] == 2000
+        slow.close()  # the laggard leaves; frontier releases to 9000
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if hub.stream_stats("ns/r/wm2").get("watermarkMs") == 9000:
+                break
+            time.sleep(0.05)
+        assert hub.stream_stats("ns/r/wm2")["watermarkMs"] == 9000
+        fast.close()
+
+    def test_late_consumer_learns_current_frontier(self, hub):
+        settings = {"observability": {"watermark": {"enabled": True}}}
+        p = StreamProducer(hub.endpoint, "ns/r/wm3", settings=settings)
+        p.send({"i": 0}, event_time_ms=4200)
+        time.sleep(0.2)
+        c = StreamConsumer(hub.endpoint, "ns/r/wm3", decode_json=True)
+        got = []
+        done = threading.Event()
+
+        def drain():
+            for m in c:
+                got.append(m)
+            done.set()
+
+        threading.Thread(target=drain, daemon=True).start()
+        p.close()
+        assert done.wait(10)
+        assert c.watermark_ms == 4200
+
+    def test_partitioned_fan_in_watermark_is_min(self, hub):
+        from bobrapet_tpu.dataplane import open_consumer, open_producer
+
+        settings = {
+            "partitioning": {"mode": "keyHash", "key": "{{ packet.k }}",
+                             "partitions": 2},
+            "observability": {"watermark": {"enabled": True}},
+        }
+        c = open_consumer(hub.endpoint, "ns/r/wmp", settings=settings,
+                          decode_json=True)
+        got = []
+        done = threading.Event()
+
+        def drain():
+            for m in c:
+                got.append(m)
+            done.set()
+
+        threading.Thread(target=drain, daemon=True).start()
+        p = open_producer(hub.endpoint, "ns/r/wmp", settings=settings)
+        # spread keys so both partitions carry messages
+        keys = [f"k{i}" for i in range(6)]
+        for i, key in enumerate(keys):
+            p.send({"i": i}, key=key, event_time_ms=1000 * (i + 1))
+        p.close()
+        assert done.wait(10)
+        # merged frontier = min over partitions, both > 0
+        assert c.watermark_ms is not None and c.watermark_ms >= 1000

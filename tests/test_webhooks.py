@@ -502,10 +502,19 @@ class TestTransportWebhooks:
         denied(lambda: rt.apply(make_transport(
             "t", "p", streaming={"partitioning": {"partitions": 4}})),
             "requires mode")
+        # watermarks became ENFORCED in round 4 (hub event-time
+        # frontier tracking); valid configs are admitted
+        rt.apply(make_transport(
+            "t-wm", "p", streaming={
+                "observability": {"watermark": {
+                    "enabled": True,
+                    "timestampSource": "metadata.event_time_ms"}}}))
         denied(lambda: rt.apply(make_transport(
             "t", "p", streaming={
-                "observability": {"watermark": {"enabled": True}}})),
-            "not enforced")
+                "observability": {"watermark": {
+                    "enabled": True,
+                    "timestampSource": "not a path!"}}})),
+            "dotted field path")
         denied(lambda: rt.apply(make_transport(
             "t", "p", streaming={"delivery": {
                 "replay": {"mode": "fromCheckpoint",
