@@ -37,6 +37,19 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 import pytest  # noqa: E402
 
 
+def wait_for(cond, timeout=30.0, interval=0.02):
+    """Poll ``cond`` until truthy or timeout; shared by threaded
+    tests (one definition — per-file copies drift)."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
 @pytest.fixture
 def tmp_store_dir(tmp_path):
     return str(tmp_path / "store")
