@@ -40,6 +40,7 @@ from typing import Any, Callable, Optional
 from ..api.catalog import CLUSTER_NAMESPACE
 from ..api.schemas import VERSION, _registry
 from ..core.object import ObjectMeta, Resource
+from ..observability.metrics import metrics
 from ..core.store import (
     ADDED,
     DELETED,
@@ -347,6 +348,7 @@ class CRSyncer:
                     )
                     try:
                         self.store.delete(kind, r.meta.namespace, r.meta.name)
+                        metrics.cr_sync_ops.inc("in", "pruned")
                     except NotFound:
                         pass
                     continue
@@ -368,6 +370,7 @@ class CRSyncer:
                 self._pushed_spec.pop((kind, ns, name), None)
             try:
                 self.store.delete(kind, ns, name)
+                metrics.cr_sync_ops.inc("in", "deleted")
             except NotFound:
                 pass
             return
@@ -405,6 +408,7 @@ class CRSyncer:
                 # Succeeded run back to empty and re-execute it
                 desired = manifest_to_resource(obj, with_status=True)
                 self.store.create(desired)
+                metrics.cr_sync_ops.inc("in", "created")
                 self._admitted(key, obj)
                 self._retry_rejected()
                 # gate decisions patched cluster-side while the manager
@@ -430,6 +434,7 @@ class CRSyncer:
                             r.meta.annotations[MIRRORED_ANNOTATION] = marker
 
                     self.store.mutate(kind, ns, name, sync)
+                    metrics.cr_sync_ops.inc("in", "updated")
                     self._admitted(key, obj)
                     # an admitted spec EDIT can be the missing
                     # dependency of a parked rejection too (e.g. a
@@ -442,6 +447,7 @@ class CRSyncer:
             with self._lock:
                 self._rejected[key] = _spec_hash(obj)
                 self._rejected_manifests[key] = obj
+            metrics.cr_sync_ops.inc("in", "rejected")
             self._set_condition(
                 obj, "False", reason="AdmissionDenied", message=str(e)
             )
@@ -595,6 +601,7 @@ class CRSyncer:
                 )
             try:
                 self.cluster.delete(api_version, r.kind, cluster_ns, r.meta.name)
+                metrics.cr_sync_ops.inc("out", "deleted")
             except ClusterNotFound:
                 pass  # cluster-side deletion was the origin
             except Exception:  # noqa: BLE001 - best-effort
@@ -624,6 +631,7 @@ class CRSyncer:
                     # .status from the POST — keep the create result as
                     # `live` so the status patch below still runs
                     live = self.cluster.create(manifest)
+                    metrics.cr_sync_ops.inc("out", "created")
                     with self._lock:
                         self._pushed_spec[key] = bus_hash
                 except ClusterConflict:
@@ -666,6 +674,7 @@ class CRSyncer:
                         self.cluster.patch(
                             api_version, r.kind, cluster_ns, r.meta.name, patch
                         )
+                        metrics.cr_sync_ops.inc("out", "updated")
                     with self._lock:
                         self._pushed_spec[key] = bus_hash
                 # no emptiness guard: an emptied bus status must still
@@ -728,3 +737,4 @@ class CRSyncer:
         self.cluster.patch_status(
             api_version, kind, cluster_ns, name, {"status": status_patch}
         )
+        metrics.cr_sync_ops.inc("out", "status")

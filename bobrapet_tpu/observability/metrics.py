@@ -365,6 +365,11 @@ class _ControlPlaneMetrics:
             "bobrapet_serving_spec_tokens_total",
             "Speculative decoding proposals by outcome", ["result"]
         )
+        self.cr_sync_ops = c(
+            "bobrapet_cr_sync_operations_total",
+            "CR mirror operations between the cluster API and the bus",
+            ["direction", "outcome"]
+        )
         self.binding_op_duration = h(
             "bobrapet_transport_binding_operation_duration_seconds",
             "Binding ensure/negotiation latency",
