@@ -17,6 +17,7 @@ from jax.sharding import Mesh, NamedSharding
 
 from ..models.llama import LlamaConfig, forward, init_params
 from .ring_attention import make_ring_attn_fn
+from .ulysses import make_ulysses_attn_fn
 from .sharding import (
     DATA_AXIS,
     FSDP_AXIS,
@@ -39,15 +40,32 @@ def make_train_step(
     optimizer: Optional[optax.GradientTransformation] = None,
     use_ring_attention: Optional[bool] = None,
     remat: bool = False,
+    seq_parallel: Optional[str] = None,
 ) -> Callable:
     """Build a jitted train step (params, opt_state, tokens) ->
     (params, opt_state, loss).
 
     tokens: [B, S+1]; loss predicts tokens[:, 1:] from tokens[:, :-1].
-    Ring attention activates when the mesh has a ``seq`` axis of size > 1
-    (sequence parallelism over ICI); rematerialization trades FLOPs for
-    HBM when ``remat`` is set.
+    Sequence/context parallelism activates when the mesh has a ``seq``
+    axis of size > 1, with the strategy chosen by ``seq_parallel``:
+
+    - ``"ring"`` — k/v blocks rotate on ``ppermute`` hops (neighbor ICI
+      links; any head count);
+    - ``"ulysses"`` — head-scatter ``all_to_all`` (two collectives per
+      attention instead of seq-axis-size hops; needs n_heads divisible
+      by the seq axis).
+
+    Both compute identical full-sequence attention — the choice is a
+    bandwidth/topology tradeoff, not a semantics one.
+    Rematerialization trades FLOPs for HBM when ``remat`` is set.
     """
+    if seq_parallel not in (None, "ring", "ulysses"):
+        raise ValueError(f"seq_parallel must be ring|ulysses, got {seq_parallel!r}")
+    if use_ring_attention is False and seq_parallel is not None:
+        raise ValueError(
+            "use_ring_attention=False disables sequence parallelism — it "
+            f"contradicts the explicit seq_parallel={seq_parallel!r}"
+        )
     optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.1)
     ring = (
         use_ring_attention
@@ -57,9 +75,20 @@ def make_train_step(
     batch_axes = tuple(
         a for a in (DATA_AXIS, FSDP_AXIS) if a in mesh.axis_names and mesh.shape[a] > 1
     )
-    attn_fn = (
-        make_ring_attn_fn(mesh, SEQ_AXIS, batch_axes=batch_axes) if ring else None
-    )
+    if not ring:
+        attn_fn = None
+    elif seq_parallel == "ulysses":
+        seq_size = mesh.shape[SEQ_AXIS]
+        if cfg.n_heads % seq_size != 0:
+            # fail BEFORE the caller builds (expensive) sharded state —
+            # tracing would only raise on the first step
+            raise ValueError(
+                f"ulysses needs n_heads ({cfg.n_heads}) divisible by the "
+                f"seq axis size ({seq_size}); use seq_parallel='ring'"
+            )
+        attn_fn = make_ulysses_attn_fn(mesh, SEQ_AXIS, batch_axes=batch_axes)
+    else:
+        attn_fn = make_ring_attn_fn(mesh, SEQ_AXIS, batch_axes=batch_axes)
 
     # pin the residual stream: batch over (data, fsdp), sequence over
     # seq when ring attention shards it — leaving this to propagation

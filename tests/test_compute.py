@@ -210,6 +210,56 @@ class TestTrainStep:
             params, opt_state, loss = step(params, opt_state, tokens)
         assert np.isfinite(float(loss))
 
+    def test_ulysses_train_step_matches_ring(self):
+        """Both context-parallel strategies compute identical attention,
+        so one train step from the same state must produce the same
+        loss — and ulysses' backward must compile under the full
+        sharded step (this is its only full-train coverage)."""
+        import optax
+        from bobrapet_tpu.parallel.train import (
+            init_sharded_train_state,
+            make_token_batch,
+            make_train_step,
+        )
+
+        cfg = llama_tiny(vocab_size=128, max_seq_len=64)
+        devs = np.array(jax.devices()).reshape(1, 2, 2, 2)
+        mesh = Mesh(devs, ("data", "fsdp", "model", "seq"))
+        losses = {}
+        for strategy in ("ring", "ulysses"):
+            with mesh:
+                params, opt_state, opt = init_sharded_train_state(
+                    jax.random.PRNGKey(0), cfg, mesh, optax.adamw(1e-3)
+                )
+                step = make_train_step(cfg, mesh, optimizer=opt,
+                                       seq_parallel=strategy)
+                tokens = make_token_batch(jax.random.PRNGKey(1), cfg, 4, 32, mesh)
+                _, _, loss = step(params, opt_state, tokens)
+                losses[strategy] = float(loss)
+        assert np.isfinite(losses["ring"])
+        assert losses["ulysses"] == pytest.approx(losses["ring"], rel=1e-5)
+
+    def test_ulysses_strategy_requires_divisible_heads(self):
+        """The misconfiguration fails at BUILD time, before a caller
+        initializes expensive sharded state."""
+        from bobrapet_tpu.parallel.train import make_train_step
+
+        cfg = llama_tiny()  # n_heads=4, not divisible by seq=8
+        devs = np.array(jax.devices()).reshape(1, 1, 1, 8)
+        mesh = Mesh(devs, ("data", "fsdp", "model", "seq"))
+        with pytest.raises(ValueError, match="divisible"):
+            make_train_step(cfg, mesh, seq_parallel="ulysses")
+
+    def test_seq_parallel_contradiction_rejected(self):
+        from bobrapet_tpu.parallel.train import make_train_step
+
+        cfg = llama_tiny()
+        devs = np.array(jax.devices()).reshape(1, 1, 1, 8)
+        mesh = Mesh(devs, ("data", "fsdp", "model", "seq"))
+        with pytest.raises(ValueError, match="contradicts"):
+            make_train_step(cfg, mesh, use_ring_attention=False,
+                            seq_parallel="ulysses")
+
     def test_token_batch_sequence_sharding_flag(self):
         from bobrapet_tpu.parallel.train import make_token_batch
         from jax.sharding import PartitionSpec
