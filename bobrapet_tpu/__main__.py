@@ -235,12 +235,17 @@ def _cmd_manager(args: argparse.Namespace) -> int:
 
     hub = None
     if args.with_hub:
-        from .dataplane.hub import StreamHub
+        # same engine selection + feature rules as the standalone hub
+        # CLI (python -m bobrapet_tpu.dataplane), via the shared factory
+        from .dataplane.native import build_hub
 
         hub_host, _, hub_port = args.hub_bind_address.rpartition(":")
-        hub = StreamHub(host=hub_host or "0.0.0.0", port=int(hub_port))
+        hub = build_hub(host=hub_host or "0.0.0.0", port=int(hub_port),
+                        tls_dir=args.hub_tls_dir,
+                        record_dir=args.hub_record_dir)
         hub.start()
-        _log.info("embedded stream hub on %s", args.hub_bind_address)
+        _log.info("embedded stream hub (%s) on %s",
+                  type(hub).__name__, args.hub_bind_address)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -373,6 +378,11 @@ def main(argv: list[str] | None = None) -> int:
     mgr.add_argument("--with-hub", action="store_true",
                      help="run an embedded stream hub")
     mgr.add_argument("--hub-bind-address", default=":7447")
+    mgr.add_argument("--hub-tls-dir", default=None,
+                     help="shared-CA mTLS material for the embedded hub")
+    mgr.add_argument("--hub-record-dir", default=None,
+                     help="record streams (recording-enabled settings) "
+                          "into this directory")
     mgr.add_argument("--leader-elect", action="store_true",
                      help="block until the lease flock is held "
                           "(reference: cmd/main.go --leader-elect)")

@@ -36,17 +36,11 @@ def main() -> None:
     args = parser.parse_args()
     logging.basicConfig(level=args.log_level)
 
-    from .native import make_hub
+    from .native import build_hub
 
-    recorder = None
-    if args.record_dir:
-        from ..storage.store import FileStore
-        from .recording import StreamRecorder
-
-        recorder = StreamRecorder(FileStore(args.record_dir))
     native = {"auto": None, "native": True, "python": False}[args.engine]
-    hub = make_hub(host=args.host, port=args.port, native=native,
-                   tls=args.tls_dir, recorder=recorder)
+    hub = build_hub(host=args.host, port=args.port, native=native,
+                    tls_dir=args.tls_dir, record_dir=args.record_dir)
     port = hub.start()
     logging.getLogger(__name__).info(
         "stream hub (%s) listening on %s:%s",

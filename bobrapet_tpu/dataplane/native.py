@@ -140,6 +140,24 @@ class NativeStreamHub:
         return out
 
 
+def build_hub(host: str = "0.0.0.0", port: int = 0,
+              native: Optional[bool] = None,
+              tls_dir: Optional[str] = None,
+              record_dir: Optional[str] = None):
+    """CLI-facing hub assembly shared by the standalone hub command and
+    the manager's embedded hub: recorder from a directory + the
+    make_hub engine/feature rules — ONE place, so the two entry points
+    cannot drift."""
+    recorder = None
+    if record_dir:
+        from ..storage.store import FileStore
+        from .recording import StreamRecorder
+
+        recorder = StreamRecorder(FileStore(record_dir))
+    return make_hub(host=host, port=port, native=native, tls=tls_dir,
+                    recorder=recorder)
+
+
 def make_hub(host: str = "127.0.0.1", port: int = 0,
              native: Optional[bool] = None, tls=None, recorder=None):
     """Hub factory: native C++ engine when available (or pinned with
