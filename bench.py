@@ -846,19 +846,29 @@ def run_serving_child() -> None:
     rng = np.random.default_rng(0)
 
     # --- continuous batching: 16 requests over 8 slots -----------------
-    eng = ServingEngine(params, cfg, PagedConfig(
-        max_slots=8, block_size=16, num_blocks=256, max_blocks_per_seq=16))
     n_req, n_new = 16, 32
-    for i in range(n_req):
-        eng.submit(rng.integers(0, cfg.vocab_size, 32 + (i % 4) * 32).tolist(),
-                   max_new_tokens=n_new)
-    eng.step()  # compile warm-up (tokens excluded below)
-    warm = sum(len(s_.request.output) for s_ in eng.slots if s_) + sum(
-        len(r.output) for r in eng.finished)
-    t0 = time.perf_counter()
-    done = eng.run()
-    serving_wall = time.perf_counter() - t0
-    serving_tokens = sum(len(r.output) for r in done) - warm
+    pcfg_kw = dict(max_slots=8, block_size=16, num_blocks=256,
+                   max_blocks_per_seq=16)
+    prompts = [rng.integers(0, cfg.vocab_size, 32 + (i % 4) * 32).tolist()
+               for i in range(n_req)]
+
+    def timed_tokens(engine, warm_steps: int = 1) -> tuple[int, float]:
+        """Submit the workload, run warm_steps unmeasured ticks (each
+        compiled graph the run will touch must be warm), then time the
+        drain; returns (tokens, wall)."""
+        for pr in prompts:
+            engine.submit(list(pr), max_new_tokens=n_new)
+        for _ in range(warm_steps):
+            engine.step()
+        warm = sum(len(s_.request.output) for s_ in engine.slots if s_) + sum(
+            len(r.output) for r in engine.finished)
+        t0 = time.perf_counter()
+        done = engine.run()
+        wall = time.perf_counter() - t0
+        return sum(len(r.output) for r in done) - warm, wall
+
+    eng = ServingEngine(params, cfg, PagedConfig(**pcfg_kw))
+    serving_tokens, serving_wall = timed_tokens(eng)
     _emit({
         "metric": "serving_decode_tokens_per_sec",
         "value": round(serving_tokens / serving_wall, 1),
@@ -872,7 +882,37 @@ def run_serving_child() -> None:
         "wallclock_s": round(serving_wall, 3),
     })
 
-    # --- speculative decoding: tiny draft over the target --------------
+    # --- engine-integrated speculation: int8 draft of the target -------
+    # (the continuous-batching spec path; accept rate is meaningful
+    # because the draft is a quantization of the same weights)
+    from bobrapet_tpu.models import quant as _quant
+
+    spec_eng = ServingEngine(
+        params, cfg, PagedConfig(**pcfg_kw),
+        draft_params=_quant.quantize_params(params), draft_cfg=cfg, spec_k=4)
+    # warm the PLAIN fallback graph too: every slot's last budget token
+    # takes it, and a first compile inside the timed drain would
+    # deflate the number. A 2-token throwaway request reaches it
+    # naturally (remaining budget 1 -> no slot speculates)
+    spec_eng.submit([1, 2, 3, 4], max_new_tokens=2)
+    spec_eng.run()
+    spec_eng_tokens, spec_eng_wall = timed_tokens(spec_eng)
+    _emit({
+        "metric": "serving_spec_decode_tokens_per_sec",
+        "value": round(spec_eng_tokens / spec_eng_wall, 1),
+        "unit": "tok/s",
+        "vs_baseline": 1.0,
+        "config": "serving-spec",
+        "backend": backend,
+        "model": model_name,
+        "spec_k": 4,
+        "accept_rate": round(
+            spec_eng.spec_accepted / max(1, spec_eng.spec_drafted), 3),
+        "spec_off_tok_s": round(serving_tokens / serving_wall, 1),
+        "wallclock_s": round(spec_eng_wall, 3),
+    })
+
+    # --- standalone speculative decoding: tiny draft over the target ---
     dcfg = llama.llama_tiny(vocab_size=cfg.vocab_size)
     draft = llama.init_params(jax.random.PRNGKey(7), dcfg)
     prompt = rng.integers(0, cfg.vocab_size, (1, 64)).astype("int32")
@@ -964,12 +1004,17 @@ def _spawn_passthrough(child: str, model: str | None, timeout: float,
             [sys.executable, os.path.abspath(__file__)],
             capture_output=True, text=True, timeout=timeout, env=env,
         )
-    except subprocess.TimeoutExpired:
+        stdout = proc.stdout or ""
+    except subprocess.TimeoutExpired as e:
+        # salvage the lines the child DID mint before the deadline —
+        # a later block overrunning must not discard earlier metrics
+        stdout = e.stdout or ""
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
         _emit({"metric": f"{child}_child_timeout", "value": 0.0,
                "unit": "error", "vs_baseline": 0.0,
                "error": f"{child} child timed out after {timeout:.0f}s"})
-        return
-    for ln in (proc.stdout or "").strip().splitlines():
+    for ln in stdout.strip().splitlines():
         ln = ln.strip()
         if ln.startswith("{"):
             print(ln)
