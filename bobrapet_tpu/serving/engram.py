@@ -23,8 +23,14 @@ steps:
         checkpoints: [runs/prod/lora-support, runs/prod/lora-code]
       paging: {maxSlots: 8, blockSize: 16, numBlocks: 512,
                maxBlocksPerSeq: 64, prefillChunk: 256}
+      draft: {selfInt8: true, specK: 4}   # optional speculative decoding
       hub: bobravoz-hub.bobrapet-system.svc:50052
 ```
+
+``draft`` turns on engine-integrated speculative decoding:
+``selfInt8`` drafts with an int8 quantization of the target (no extra
+checkpoint), or name a small dense ``model`` with its own
+``checkpoint``/``initSeed``. Greedy outputs stay token-identical.
 
 Requests select adapters by stack index over the wire (``"adapter": 1``
 = the first configured LoRA; 0 = base). Without a checkpoint the engram
@@ -129,20 +135,15 @@ def build_engine(ctx) -> ServingEngine:
         )
     cfg = _MODELS[model_name]()
     family = moe if hasattr(cfg, "n_experts") else llama
-    if family is moe and (config.get("quant") or config.get("lora")):
+    if family is moe and (config.get("quant") or config.get("lora")
+                          or config.get("draft")):
         # cheap check BEFORE any restore: the engine would reject these
         # anyway, but only after the multi-GB tree came out of the blob
         # store
-        raise ValueError("quant/lora are dense-family only; remove them "
-                         f"for model {model_name!r}")
-    ckpt = config.get("checkpoint")
-    if ckpt:
-        like = family.init_params(jax.random.PRNGKey(0), cfg)
-        params = _restore(ctx, str(ckpt), {"params": like})["params"]
-    else:
-        params = family.init_params(
-            jax.random.PRNGKey(int(config.get("initSeed") or 0)), cfg
-        )
+        raise ValueError("quant/lora/draft are dense-family only; remove "
+                         f"them for model {model_name!r}")
+    params = _load_params(ctx, family, cfg, config.get("checkpoint"),
+                          config.get("initSeed"))
     quant_mode = config.get("quant")
     if quant_mode == "int8":
         params = quant.quantize_params(params)
@@ -154,8 +155,62 @@ def build_engine(ctx) -> ServingEngine:
     loras, lora_scale = (None, 1.0)
     if config.get("lora"):
         loras, lora_scale = _build_loras(ctx, cfg, config["lora"])
+    draft_params, draft_cfg, spec_k = _build_draft(ctx, config, cfg, params)
     return ServingEngine(params, cfg, _paged_config(config.get("paging") or {}),
-                         loras=loras, lora_scale=lora_scale)
+                         loras=loras, lora_scale=lora_scale,
+                         draft_params=draft_params, draft_cfg=draft_cfg,
+                         spec_k=spec_k)
+
+
+def _load_params(ctx, family, cfg, ckpt, seed):
+    """Checkpoint restore (against an init template) or seeded init —
+    one loader for the target and the draft."""
+    import jax
+
+    if ckpt:
+        like = family.init_params(jax.random.PRNGKey(0), cfg)
+        return _restore(ctx, str(ckpt), {"params": like})["params"]
+    return family.init_params(jax.random.PRNGKey(int(seed or 0)), cfg)
+
+
+def _build_draft(ctx, config, cfg, params):
+    """Speculative-decoding draft from ``config.draft``:
+
+    - ``{selfInt8: true, specK: N}`` — the draft is an int8
+      quantization of the target itself (no extra checkpoint; high
+      accept rates because it IS the target);
+    - ``{model: tiny, checkpoint|initSeed: ..., specK: N}`` — a
+      separate small dense model sharing the tokenizer.
+    """
+    raw = config.get("draft")
+    if not raw:
+        return None, None, 4
+    spec_k = int(raw.get("specK", 4))
+    if raw.get("selfInt8"):
+        if raw.get("model") or raw.get("checkpoint") or raw.get("initSeed"):
+            raise ValueError("config.draft: selfInt8 takes no model/"
+                             "checkpoint/initSeed — it quantizes the "
+                             "target")
+        if config.get("quant") == "int8":
+            # the "draft" would BE the target: a full-size extra
+            # forward per token for zero speedup
+            raise ValueError("config.draft.selfInt8 with quant=int8 "
+                             "drafts with the target itself; use a "
+                             "named small draft model instead")
+        return quant.quantize_params(params), cfg, spec_k
+    dname = str(raw.get("model") or "")
+    if dname not in _MODELS:
+        raise ValueError(
+            f"config.draft.model {dname!r} unknown; choose one of "
+            f"{sorted(_MODELS)} or use selfInt8"
+        )
+    dcfg = _MODELS[dname]()
+    if hasattr(dcfg, "n_experts"):
+        raise ValueError("config.draft.model must be a dense family "
+                         "(the engine drafts dense only)")
+    return (_load_params(ctx, llama, dcfg, raw.get("checkpoint"),
+                         raw.get("initSeed")),
+            dcfg, spec_k)
 
 
 class _Broadcast:

@@ -1307,3 +1307,72 @@ class TestPipelinedDecode:
         t1 = eng._block_tables()
         t2 = eng._block_tables()
         assert t1 is t2  # same device array, no rebuild
+
+
+class TestServingEngramDraft:
+    """config.draft turns on engine-integrated speculation from the
+    Story step's with-config."""
+
+    def _ctx(self, config):
+        import json as _json
+
+        from bobrapet_tpu.sdk import contract
+        from bobrapet_tpu.sdk.context import EngramContext
+
+        return EngramContext({contract.ENV_CONFIG: _json.dumps(config)})
+
+    def test_self_int8_draft_is_exact_and_speculates(self, model):
+        from bobrapet_tpu.serving.engram import build_engine
+
+        cfg, params = model
+        paging = {"maxSlots": 2, "blockSize": 8, "numBlocks": 32,
+                  "maxBlocksPerSeq": 8}
+        plain = build_engine(self._ctx({
+            "model": "tiny", "initSeed": 0, "paging": paging}))
+        spec = build_engine(self._ctx({
+            "model": "tiny", "initSeed": 0, "paging": paging,
+            "draft": {"selfInt8": True, "specK": 3}}))
+        assert spec.draft_params is not None and spec.spec_k == 3
+        prompt = [5, 4, 3, 2, 1]
+        for eng in (plain, spec):
+            eng.submit(list(prompt), 8)
+        assert spec.run()[0].output == plain.run()[0].output
+        assert spec.spec_drafted > 0
+
+    def test_named_draft_model(self, model):
+        from bobrapet_tpu.serving.engram import build_engine
+
+        eng = build_engine(self._ctx({
+            "model": "tiny", "initSeed": 0,
+            "paging": {"maxSlots": 2, "blockSize": 8, "numBlocks": 16,
+                       "maxBlocksPerSeq": 4},
+            "draft": {"model": "tiny", "initSeed": 7, "specK": 2}}))
+        assert eng.draft_params is not None and eng.spec_k == 2
+
+    def test_draft_misconfig_fails_fast(self, model):
+        from bobrapet_tpu.serving.engram import build_engine
+
+        with pytest.raises(ValueError, match="selfInt8 takes no model"):
+            build_engine(self._ctx({
+                "model": "tiny",
+                "draft": {"selfInt8": True, "model": "tiny"}}))
+        with pytest.raises(ValueError, match="unknown"):
+            build_engine(self._ctx({
+                "model": "tiny", "draft": {"model": "nope"}}))
+        with pytest.raises(ValueError, match="dense"):
+            build_engine(self._ctx({
+                "model": "tiny", "draft": {"model": "moe-tiny"}}))
+        # int8 target + selfInt8 draft: the "draft" would BE the target
+        with pytest.raises(ValueError, match="target itself"):
+            build_engine(self._ctx({
+                "model": "tiny", "quant": "int8",
+                "draft": {"selfInt8": True}}))
+        # MoE target + draft refused BEFORE any checkpoint restore
+        with pytest.raises(ValueError, match="dense-family only"):
+            build_engine(self._ctx({
+                "model": "moe-tiny", "draft": {"selfInt8": True}}))
+        # stray initSeed under selfInt8 is a misconfig, not ignored
+        with pytest.raises(ValueError, match="initSeed"):
+            build_engine(self._ctx({
+                "model": "tiny",
+                "draft": {"selfInt8": True, "initSeed": 7}}))
