@@ -17,7 +17,7 @@ import dataclasses
 import enum
 import functools
 import typing
-from typing import Any, get_args, get_origin, get_type_hints
+from typing import Any, get_args, get_origin
 
 from .specbase import _hints_for, SpecBase, snake_to_camel
 

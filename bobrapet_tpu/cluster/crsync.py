@@ -35,7 +35,7 @@ import hashlib
 import json
 import logging
 import threading
-from typing import Any, Callable, Optional
+from typing import Any, Optional
 
 from ..api.catalog import CLUSTER_NAMESPACE
 from ..api.schemas import VERSION, _registry

@@ -19,7 +19,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import time
-from typing import Any, Optional
+from typing import Optional
 
 from ..api.shared import (
     CachePolicy,

@@ -30,7 +30,7 @@ from __future__ import annotations
 import logging
 from typing import Any, Optional
 
-from ..api.enums import OffloadedDataPolicy, Phase, StepType
+from ..api.enums import OffloadedDataPolicy, Phase
 from ..api.errors import ErrorType, StructuredError
 from ..api.runs import (
     DAG_PHASE_COMPENSATION,

@@ -34,7 +34,7 @@ from typing import Any, Optional
 
 from ..api import conditions
 from ..api.catalog import CLUSTER_NAMESPACE
-from ..api.enums import Phase, WorkloadMode
+from ..api.enums import Phase
 from ..api.runs import STEP_RUN_KIND, STORY_RUN_KIND
 from ..api.story import KIND as STORY_KIND, parse_story
 from ..api.transport import (

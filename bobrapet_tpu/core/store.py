@@ -34,7 +34,7 @@ import urllib.parse
 from collections import deque
 from typing import Any, Callable, Iterable, Optional
 
-from .object import ObjectMeta, Resource, _fast_copy, fresh_uid, now
+from .object import Resource, _fast_copy, fresh_uid, now
 
 _log = logging.getLogger(__name__)
 

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import attention
 from ..ops.rmsnorm import rmsnorm_reference
-from ..ops.rope import apply_rope, rope_frequencies
+from ..ops.rope import rope_frequencies
 from .llama import LlamaConfig, _attention_block, _cached_attention  # noqa: F401
 
 

@@ -41,7 +41,7 @@ its completion count as the step output.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 from ..models import llama, moe, quant
 from ..models.lora import LoRAConfig, init_lora, stack_adapters, zero_lora

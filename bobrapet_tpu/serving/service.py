@@ -26,7 +26,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-from typing import Any, Optional
+from typing import Any
 
 from .engine import ServingEngine
 

@@ -33,7 +33,7 @@ exactly like a contiguous-cache cursor rewind.
 from __future__ import annotations
 
 import functools
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -12,7 +12,7 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Callable, Iterable, Optional
+from typing import Callable, Optional
 
 
 class StorageError(Exception):

@@ -14,7 +14,7 @@ host network; direct P2P edges inside one slice can ride ICI instead
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Optional
+from typing import Callable
 
 from ..api.story import Step, StorySpec
 

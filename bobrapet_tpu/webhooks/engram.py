@@ -19,7 +19,6 @@ from ..api.catalog import (
     parse_impulse_template,
 )
 from ..api.engram import KIND as ENGRAM_KIND, parse_engram
-from ..api.enums import WorkloadMode
 from ..api.impulse import KIND as IMPULSE_KIND, parse_impulse
 from ..api.story import KIND as STORY_KIND
 from ..core.object import Resource
