@@ -201,6 +201,7 @@ class StreamConsumer:
         decode_json: bool = False,
         from_seq: Optional[int] = None,
         tls=None,
+        consumer_id: Optional[str] = None,
     ):
         self.stream = stream
         self.decode_json = decode_json
@@ -220,6 +221,11 @@ class StreamConsumer:
             # replay.mode=full: rejoin the stream at a seq in retained
             # history (re-delivers already-acked entries)
             hello["fromSeq"] = int(from_seq)
+        if consumer_id is not None:
+            # replay.mode=fromCheckpoint: the durable checkpoint
+            # identity — the hub resumes this consumer after its last
+            # persisted cumulative ack automatically
+            hello["consumerId"] = str(consumer_id)
         send_frame(self._sock, hello)
         fr = read_frame(self._sock)
         if fr is None or fr[0].get("t") != "ok":

@@ -52,6 +52,14 @@ UNLIMITED = -1
 REPLAY_MAX_ENTRIES = 65536
 
 
+def _parse_interval(value) -> Optional[float]:
+    if value in (None, ""):
+        return None
+    from ..utils.duration import parse_duration
+
+    return parse_duration(value)
+
+
 def _settings_knobs(settings: Optional[dict[str, Any]]) -> dict[str, Any]:
     """Extract the enforcement-relevant knobs from a settings dict
     (already admission-validated; unknown fields ignored)."""
@@ -75,8 +83,19 @@ def _settings_knobs(settings: Optional[dict[str, Any]]) -> dict[str, Any]:
         # retentionSeconds) and a consumer hello may carry ``fromSeq``
         # to re-read history — the admission layer requires
         # retentionSeconds so the bound is always explicit
-        "replay_full": replay.get("mode") == "full",
+        "replay_full": replay.get("mode") in ("full", "fromCheckpoint"),
         "replay_retention": float(replay.get("retentionSeconds") or 3600),
+        # replay.mode=fromCheckpoint: consumers carry a consumerId; the
+        # hub persists their cumulative-ack position in the record
+        # store every checkpointInterval and reattaches resume from it
+        # automatically (no explicit fromSeq needed)
+        "replay_checkpoint": replay.get("mode") == "fromCheckpoint",
+        # absent interval -> 30s: per-ack durable IO would put a
+        # store.put on the hot path; the detach save guarantees tail
+        # durability regardless
+        "checkpoint_interval": float(
+            _parse_interval(replay.get("checkpointInterval")) or 30.0
+        ),
         # recording.mode=full/sample: data frames tee into the blob
         # store when the hub carries a recorder (dataplane/recording.py)
         "recording": recording_knobs(s),
@@ -106,6 +125,15 @@ class _Stream:
         self.paused = False  # credit-grant hysteresis state
         self.eos = False
         self.started = time.monotonic()
+        #: checkpoint epoch: seqs restart at 0 whenever a _Stream is
+        #: (re)created (hub restart, GC + redrive re-attach) — a
+        #: durable checkpoint from a previous epoch must NOT skip the
+        #: new epoch's data, so checkpoints bind to this token and an
+        #: epoch mismatch degrades to redelivery-from-0 (atLeastOnce
+        #: permits duplicates; it never permits loss)
+        import uuid as _uuid
+
+        self.epoch = _uuid.uuid4().hex
         #: replay.mode=full history: (seq, header, payload, wall_ts).
         #: Bounded by retentionSeconds AND a hard entry cap (a maxlen
         #: deque evicts oldest-first): retention alone would let a fast
@@ -223,6 +251,11 @@ class _ConsumerConn:
         self.sock = sock
         self.stream = stream
         self.delivered = -1  # highest seq enqueued to this consumer
+        # replay.mode=fromCheckpoint bookkeeping
+        self.consumer_id: Optional[str] = None
+        self.checkpointed_seq = -1
+        self.checkpointed_at = 0.0  # monotonic; 0 => first ack persists
+        self.last_ack_seq = -1
         self.queue: collections.deque = collections.deque()
         self.cv = threading.Condition()
         self.closed = False
@@ -392,6 +425,14 @@ class StreamHub:
                 send_frame(sock, {"t": "err", "message": "expected hello"})
                 return
             role = hello.get("role")
+            refusal = self._refuse_hello(role, hello)
+            if refusal is not None:
+                # refuse BEFORE creating stream state: a refused hello
+                # must not leak an uncollectable _Stream (maybe_gc only
+                # reclaims eos'd streams — same invariant as the native
+                # engine's pre-get_stream checks)
+                send_frame(sock, {"t": "err", "message": refusal})
+                return
             stream = self._get_stream(
                 str(hello.get("stream") or ""), hello.get("settings")
             )
@@ -410,19 +451,28 @@ class StreamHub:
             except OSError:
                 pass
 
+    def _refuse_hello(self, role, hello: dict[str, Any]) -> Optional[str]:
+        """Fail-loud checks that must run BEFORE stream-state creation:
+        admission accepted these contracts, so a hub that cannot honor
+        them refuses the connection rather than silently degrading."""
+        probe = _settings_knobs(hello.get("settings"))
+        if (role == "producer" and probe["recording"]
+                and self._recorder is None):
+            return ("stream requires recording but this hub has no "
+                    "recorder (deploy the hub with a record store, "
+                    "e.g. --record-dir)")
+        if role == "consumer" and probe["replay_checkpoint"]:
+            if self._recorder is None:
+                return ("stream uses replay.mode=fromCheckpoint but "
+                        "this hub has no record store (deploy with "
+                        "--record-dir)")
+            if not hello.get("consumerId"):
+                return ("replay.mode=fromCheckpoint needs a consumerId "
+                        "in the hello (the checkpoint identity)")
+        return None
+
     # -- producer side -----------------------------------------------------
     def _serve_producer(self, sock: socket.socket, st: _Stream) -> None:
-        if st.knobs["recording"] and self._recorder is None:
-            # fail LOUD: admission accepted a recording contract; a hub
-            # deployed without a recorder must refuse the stream rather
-            # than silently record nothing (the compliance trap)
-            send_frame(sock, {
-                "t": "err",
-                "message": "stream requires recording but this hub has "
-                           "no recorder (deploy the hub with a record "
-                           "store, e.g. --record-dir)",
-            })
-            return
         conn = _ProducerConn(sock, st)
         conn.writer = threading.Thread(target=conn.writer_loop, daemon=True,
                                        name="hub-producer-writer")
@@ -597,15 +647,79 @@ class StreamHub:
             conn.outstanding += grant
             conn.enqueue({"t": "credit", "n": grant})
 
+    # -- consumer checkpoints (replay.mode=fromCheckpoint) -----------------
+
+    def _checkpoint_key(self, stream: str, consumer_id: str) -> str:
+        return f"checkpoints/{stream}/{consumer_id}"
+
+    def _load_checkpoint(self, st: _Stream, consumer_id: str) -> int:
+        """Durable position for this consumer in the CURRENT stream
+        epoch; -1 when none. A missing blob is 'no checkpoint yet'; any
+        OTHER store failure raises — silently resetting a consumer to 0
+        on a store blip would mass-redeliver, and skipping ahead would
+        lose data (the caller refuses the attach loudly instead)."""
+        import json as _json
+
+        from ..storage.store import BlobNotFound
+
+        try:
+            raw = self._recorder.store.get(
+                self._checkpoint_key(st.name, consumer_id))
+        except BlobNotFound:
+            return -1
+        entry = _json.loads(raw)  # corrupt blob -> loud attach failure
+        if entry.get("epoch") != st.epoch:
+            # previous stream epoch: its seq namespace is gone; start
+            # over (duplicates allowed, loss is not)
+            return -1
+        return int(entry["seq"])
+
+    def _save_checkpoint(self, st: _Stream, consumer_id: str,
+                         seq: int) -> bool:
+        import json as _json
+
+        try:
+            self._recorder.store.put(
+                self._checkpoint_key(st.name, consumer_id),
+                _json.dumps({"seq": seq, "epoch": st.epoch,
+                             "at": time.time()}).encode(),
+            )
+            return True
+        except Exception:  # noqa: BLE001 - retried on the next ack /
+            # detach (the caller only advances its marker on success)
+            _log.exception("checkpoint save failed for %s/%s",
+                           st.name, consumer_id)
+            return False
+
     # -- consumer side -----------------------------------------------------
     def _serve_consumer(self, sock: socket.socket, st: _Stream, hello: dict[str, Any]) -> None:
+        # machinery/identity refusals already ran pre-stream-creation
+        # (_refuse_hello)
+        consumer_id = hello.get("consumerId")
+        from_seq = hello.get("fromSeq")
+        if (from_seq is None and st.knobs["replay_checkpoint"]
+                and consumer_id):
+            try:
+                # resume AFTER the durably-acknowledged position
+                from_seq = self._load_checkpoint(st, consumer_id) + 1
+            except Exception as e:  # noqa: BLE001 - store blip/corrupt
+                # fail LOUD: resetting to 0 would mass-redeliver and
+                # skipping ahead would lose data — neither silently
+                _log.exception("checkpoint load failed for %s/%s",
+                               st.name, consumer_id)
+                send_frame(sock, {
+                    "t": "err",
+                    "message": f"checkpoint unavailable for "
+                               f"{consumer_id!r}: {e} (retry the attach)",
+                })
+                return
         conn = _ConsumerConn(sock, st)
+        conn.consumer_id = consumer_id
         send_frame(sock, {"t": "ok", "credits": UNLIMITED})
         started = time.monotonic()
         # attach atomically: backlog replay (unacked under atLeastOnce,
         # undelivered otherwise) enters the consumer's ordered queue
         # before any live entry can, so delivery order == seq order
-        from_seq = hello.get("fromSeq")
         with st.lock:
             if from_seq is not None and st.knobs["replay_full"]:
                 # replay attach: UNION of retained history and the
@@ -650,11 +764,28 @@ class StreamHub:
                     return
                 header, _ = fr
                 if header.get("t") == "ack":
-                    self._on_ack(st, int(header.get("seq", -1)))
+                    seq = int(header.get("seq", -1))
+                    conn.last_ack_seq = max(conn.last_ack_seq, seq)
+                    self._on_ack(st, seq)
+                    if (st.knobs["replay_checkpoint"] and conn.consumer_id
+                            and seq > conn.checkpointed_seq):
+                        now = time.monotonic()
+                        interval = st.knobs["checkpoint_interval"]
+                        if (now - conn.checkpointed_at >= interval
+                                and self._save_checkpoint(
+                                    st, conn.consumer_id, seq)):
+                            conn.checkpointed_seq = seq
+                            conn.checkpointed_at = now
         finally:
             with st.lock:
                 if conn in st.consumers:
                     st.consumers.remove(conn)
+            if (st.knobs["replay_checkpoint"] and conn.consumer_id
+                    and conn.last_ack_seq > conn.checkpointed_seq):
+                # persist the tail position at detach (interval pacing
+                # only bounds WRITE traffic, not durability at close)
+                self._save_checkpoint(st, conn.consumer_id,
+                                      conn.last_ack_seq)
             conn.close()
             self._maybe_gc(st)
             metrics.stream_duration.observe(

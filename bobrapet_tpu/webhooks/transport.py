@@ -109,23 +109,30 @@ def validate_streaming_settings(st, errs: FieldErrors, path: str) -> None:
             if r.mode not in (None, *_VALID_REPLAY_MODES):
                 errs.add(f"{path}.delivery.replay.mode",
                          f"must be one of {sorted(_VALID_REPLAY_MODES)}")
-            if r.mode == "fromCheckpoint":
-                # only mode=full is enforced (hub retained history +
-                # fromSeq rejoin); checkpointed replay has no enforcer —
-                # one decisive rejection, no contradictory guidance
-                # about its sub-fields
+            # fromCheckpoint is ENFORCED since round 4: the hub
+            # persists per-consumerId cumulative-ack positions in its
+            # record store (every checkpointInterval + at detach) and
+            # reattaching consumers resume after them automatically
+            if r.mode == "fromCheckpoint" and (
+                fc is None or fc.mode != "credits" or fc.ack_every is None
+            ):
                 errs.add(f"{path}.delivery.replay.mode",
-                         "fromCheckpoint replay is not enforced by the "
-                         "data plane; use mode=full with "
-                         "retentionSeconds")
-            if r.mode == "full" and not r.retention_seconds:
+                         "fromCheckpoint needs flowControl.mode=credits "
+                         "with ackEvery (checkpoint positions come from "
+                         "the ack protocol)")
+            if r.mode in ("full", "fromCheckpoint") and not r.retention_seconds:
                 errs.add(f"{path}.delivery.replay.retentionSeconds",
-                         "required for replay.mode=full")
+                         f"required for replay.mode={r.mode}")
             if r.mode in (None, "none") and (
                 r.retention_seconds or r.checkpoint_interval
             ):
                 errs.add(f"{path}.delivery.replay",
                          "retention/checkpoint only meaningful with replay enabled")
+            if r.mode == "full" and r.checkpoint_interval:
+                # inert knob: intervals pace CHECKPOINT persistence,
+                # which only mode=fromCheckpoint performs
+                errs.add(f"{path}.delivery.replay.checkpointInterval",
+                         "only meaningful with replay.mode=fromCheckpoint")
         if (
             d.ordering == "total"
             and st.partitioning is not None

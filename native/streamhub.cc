@@ -270,6 +270,9 @@ struct Knobs {
   // producers demanding recording are refused (fail-loud, mirroring
   // the Python hub's recorder-less refusal)
   bool requires_recording = false;
+  // replay.mode == fromCheckpoint: durable consumer checkpoints need
+  // the Python hub's record store; refused here for both roles
+  bool requires_checkpoint = false;
   // observability.watermark.enabled: track the event-time frontier
   // (min over live producers of per-connection "et" header maxima) and
   // push watermark frames to consumers on advance
@@ -306,6 +309,7 @@ Knobs knobs_from(const JValue& settings) {
     k.at_least_once = d->get_str("semantics") == "atLeastOnce";
     if (const JValue* r = d->get("replay")) {
       k.replay_full = r->get_str("mode") == "full";
+      k.requires_checkpoint = r->get_str("mode") == "fromCheckpoint";
       long ret = r->get_int("retentionSeconds", 0);
       if (ret > 0) k.replay_retention = static_cast<double>(ret);
     }
@@ -481,16 +485,21 @@ struct Hub {
       return;
     }
     const JValue* settings = h.get("settings");
-    if (role == "producer" && settings) {
+    if (settings) {
       // refuse BEFORE creating stream state (like the bad-role path
-      // above): a refused producer must not leak an uncollectable
-      // Stream — maybe_gc only reclaims eos'd streams, and a stream
-      // whose every producer is refused can never reach eos
+      // above): a refused connection must not leak an uncollectable
+      // Stream — maybe_gc only reclaims eos'd streams
       Knobs probe = knobs_from(*settings);
-      if (probe.requires_recording) {
+      if (probe.requires_recording && role == "producer") {
         send(c, "{\"t\":\"err\",\"message\":\"stream requires recording "
                 "but this hub has no recorder (use the Python hub with "
                 "a record store)\"}");
+        c->closing = true;
+        return;
+      }
+      if (probe.requires_checkpoint) {
+        send(c, "{\"t\":\"err\",\"message\":\"replay.mode=fromCheckpoint "
+                "needs the Python hub with a record store\"}");
         c->closing = true;
         return;
       }

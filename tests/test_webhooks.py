@@ -473,12 +473,22 @@ class TestTransportWebhooks:
                 "pauseThreshold": {"bufferPct": 50},
                 "resumeThreshold": {"bufferPct": 80}}})),
             "hysteresis")
-        # fromCheckpoint replay (with or without interval) is rejected
-        # outright as unenforced — no contradictory field guidance
+        # fromCheckpoint replay became ENFORCED in round 4 (durable
+        # consumer checkpoints in the hub's record store); it now needs
+        # the ack protocol + a retention bound
         denied(lambda: rt.apply(make_transport(
             "t", "p", streaming={"delivery": {
                 "replay": {"mode": "fromCheckpoint"}}})),
-            "not enforced")
+            "ack")
+        rt.apply(make_transport(
+            "t-ckpt", "p", streaming={
+                "flowControl": {"mode": "credits",
+                                "initialCredits": {"messages": 8},
+                                "ackEvery": {"messages": 1}},
+                "delivery": {"semantics": "atLeastOnce",
+                             "replay": {"mode": "fromCheckpoint",
+                                        "retentionSeconds": 3600,
+                                        "checkpointInterval": "5s"}}}))
         # cutover with a drain timeout
         denied(lambda: rt.apply(make_transport(
             "t", "p", streaming={"lifecycle": {
@@ -519,7 +529,7 @@ class TestTransportWebhooks:
             "t", "p", streaming={"delivery": {
                 "replay": {"mode": "fromCheckpoint",
                            "checkpointInterval": "30s"}}})),
-            "not enforced")
+            "ack protocol")
         # a coherent credit + ack + replay config is admitted — with the
         # ENFORCED replay mode (hub retained history + fromSeq rejoin)
         rt.apply(make_transport("t-ok", "p", streaming={
