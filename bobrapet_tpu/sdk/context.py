@@ -384,10 +384,16 @@ class EngramContext:
         if settings is None:
             settings = self.negotiated_stream_settings
         stream = f"{self.namespace}/{self.story_run}/{self.step}"
+        # step identity + gang host = the durable checkpoint identity
+        # (replay.mode=fromCheckpoint): a redriven/restarted replica
+        # resumes exactly after what IT acknowledged — without the
+        # host suffix, gang replicas would share one checkpoint and a
+        # lagging host could silently skip past its unprocessed range
         return open_consumer(endpoint, stream, settings=settings,
                              decode_json=decode_json,
                              connect_timeout=connect_timeout,
-                             tls=TLSPaths.from_env(self.env))
+                             tls=TLSPaths.from_env(self.env),
+                             consumer_id=f"{stream}@{self.host_id}")
 
     @property
     def log(self) -> logging.Logger:

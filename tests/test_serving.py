@@ -1376,3 +1376,131 @@ class TestServingEngramDraft:
             build_engine(self._ctx({
                 "model": "tiny",
                 "draft": {"selfInt8": True, "initSeed": 7}}))
+
+
+class TestRound4Capstone:
+    """Everything composes: a serving engram with a speculative draft
+    consumes a PARTITIONED + RECORDED + WATERMARKED + fromCheckpoint
+    stream through the SDK surface, over a recording hub, and its
+    greedy completions are token-identical to the plain engine."""
+
+    def test_full_streaming_stack_into_spec_serving(self, model):
+        import json as _json
+        import threading
+
+        from bobrapet_tpu.dataplane import StreamHub, StreamRecorder
+        from bobrapet_tpu.sdk import contract
+        from bobrapet_tpu.sdk.context import EngramContext
+        from bobrapet_tpu.serving.engram import serve
+        from bobrapet_tpu.storage.store import MemoryStore
+
+        cfg, params = model
+        store = MemoryStore()
+        rec = StreamRecorder(store)
+        hub = StreamHub(recorder=rec)
+        hub.start()
+        try:
+            settings = {
+                "flowControl": {"mode": "credits",
+                                "initialCredits": {"messages": 16},
+                                "ackEvery": {"messages": 1}},
+                "delivery": {"semantics": "atLeastOnce",
+                             "replay": {"mode": "fromCheckpoint",
+                                        "retentionSeconds": 3600,
+                                        "checkpointInterval": "5s"}},
+                # roundRobin: ONE settings object governs both the
+                # prompt edge and the completion edge (the broadcast
+                # sends unkeyed completions)
+                "partitioning": {"mode": "roundRobin", "partitions": 2},
+                "recording": {"mode": "full", "redactFields": ["secret"]},
+                "observability": {"watermark": {
+                    "enabled": True, "timestampSource": "ts"}},
+            }
+            serve_config = {
+                "model": "tiny", "initSeed": 0,
+                "paging": {"maxSlots": 2, "blockSize": 8, "numBlocks": 64,
+                           "maxBlocksPerSeq": 8},
+                "draft": {"selfInt8": True, "specK": 3},
+                "hub": hub.endpoint,
+            }
+            serve_env = {
+                contract.ENV_NAMESPACE: "default",
+                contract.ENV_STORY_RUN: "r9",
+                contract.ENV_STEP: "generate",
+                contract.ENV_CONFIG: _json.dumps(serve_config),
+                contract.ENV_BINDING_INFO: _json.dumps(
+                    {"settings": settings}),
+                contract.ENV_DOWNSTREAM_TARGETS: _json.dumps([{
+                    "grpc": {"host": "127.0.0.1", "port": hub.port,
+                             "stepName": "sink"}}]),
+            }
+            result = {}
+
+            def run_server():
+                result["served"] = serve(EngramContext(serve_env))["served"]
+
+            server_thread = threading.Thread(target=run_server, daemon=True)
+            server_thread.start()
+
+            # downstream consumer of completions
+            sink_env = {
+                contract.ENV_NAMESPACE: "default",
+                contract.ENV_STORY_RUN: "r9",
+                contract.ENV_STEP: "sink",
+            }
+            completions = []
+            sink_done = threading.Event()
+
+            def drain():
+                for m in EngramContext(sink_env).open_input_stream(
+                        hub.endpoint, settings=settings):
+                    completions.append(m)
+                sink_done.set()
+
+            threading.Thread(target=drain, daemon=True).start()
+
+            # the upstream step streams keyed, watermarked prompts
+            prod_env = {
+                contract.ENV_NAMESPACE: "default",
+                contract.ENV_STORY_RUN: "r9",
+                contract.ENV_STEP: "client",
+                contract.ENV_DOWNSTREAM_TARGETS: _json.dumps([{
+                    "grpc": {"host": "127.0.0.1", "port": hub.port,
+                             "stepName": "generate"}}]),
+            }
+            (out,) = EngramContext(prod_env).open_output_streams(
+                settings=settings)
+            prompts = {f"u{i}": [1 + i, 2, 3, 4] for i in range(4)}
+            for i, (user, prompt) in enumerate(prompts.items()):
+                out.send({"id": user, "user": user, "prompt": prompt,
+                          "maxNewTokens": 6, "secret": "hunter2",
+                          "ts": 1000 * (i + 1)},
+                         key=user)
+            out.close()
+
+            server_thread.join(timeout=60)
+            assert not server_thread.is_alive(), "server never drained"
+            assert sink_done.wait(20)
+            assert result["served"] == 4
+
+            # token-identical to the plain engine, per prompt
+            pc = PagedConfig(max_slots=2, block_size=8, num_blocks=64,
+                             max_blocks_per_seq=8)
+            ref_eng = ServingEngine(params, cfg, pc)
+            rids = {ref_eng.submit(list(p), 6): u
+                    for u, p in prompts.items()}
+            ref = {rids[r.rid]: r.output for r in ref_eng.run()}
+            got = {c["id"]: c["tokens"] for c in completions}
+            assert got == ref
+
+            # the recording captured every partitioned prompt, redacted
+            recorded = [e for p in range(2)
+                        for e in rec.replay(f"default/r9/generate#{p}")]
+            assert len(recorded) == 4
+            for e in recorded:
+                obj = _json.loads(e["payload"])
+                assert obj["secret"] == "[REDACTED]"
+            # durable checkpoints exist for the serving step's fan-in
+            assert len(store.list("checkpoints/default/r9/generate")) == 2
+        finally:
+            hub.stop()
