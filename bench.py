@@ -1102,9 +1102,15 @@ def main() -> None:
             if r2:
                 results.append(r2)
 
-        wait = bool(os.environ.get("BENCH_WAIT_FOR_TPU")) or (
-            "--wait-for-tpu" in sys.argv
-        )
+        # ON by default: after three rounds of chip downtime, the
+        # driver's window should be spent hunting for recovery — the
+        # cpu fallback line is already secured above, so waiting risks
+        # nothing and a healthy minute mints the first real MFU number.
+        # Opt out with any falsy spelling (0/false/no/off); the env var
+        # is the sole control now that waiting is the default.
+        wait = os.environ.get(
+            "BENCH_WAIT_FOR_TPU", "1"
+        ).strip().lower() not in ("0", "false", "no", "off", "")
         if wait and not os.environ.get("BENCH_FORCE_CPU"):
             # poll the probe for the WHOLE remaining window: the moment
             # the chip comes up, mint the MFU microbench + real decode.
@@ -1133,18 +1139,27 @@ def main() -> None:
                                      "wait_for_tpu_probes": len(probe_log)})
                     break
                 time.sleep(min(30.0, max(5.0, _remaining() * 0.02)))
-            if not recovered and results:
-                results[-1]["wait_for_tpu_probe_log"] = probe_log[-20:]
+            if not recovered:
+                if results:
+                    results[-1]["wait_for_tpu_probe_log"] = probe_log[-20:]
+                else:
+                    # the cpu fallback itself failed: the forensics are
+                    # the only evidence the window had — never drop them
+                    _fail("no decode result produced", probe=forensics,
+                          wait_for_tpu_probe_log=probe_log[-20:])
         # second-chance probe late in the window: tunnels recover
         elif _remaining() > 240 and not os.environ.get("BENCH_FORCE_CPU"):
             state["stage"] = "probe-2"
             p2 = _probe_backend(timeout=min(300.0, _remaining() / 2))
             if p2["ok"]:
                 recover_on_chip({"probe": p2, "second_chance": True})
-            else:
+            elif results:
                 # decisive forensics: the environment was down for the
                 # WHOLE window, not just the first probe
                 results[-1]["second_probe"] = p2
+            else:
+                _fail("no decode result produced", probe=forensics,
+                      second_probe=p2)
 
     # headline LAST: prefer a real-accelerator line over the fallback
     results.sort(key=lambda r: (r.get("backend") not in (None, "cpu"),
