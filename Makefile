@@ -18,6 +18,12 @@ native/lib%.so: native/%.cc
 test: native
 	python -m pytest tests/ -q
 
+# opt-in parallel run (pytest-xdist): fastest wall-clock, but the
+# threaded soak tests see heavier CPU contention — the serial target
+# above is the canonical gate
+test-fast: native
+	python -m pytest tests/ -q -n auto
+
 bench: native
 	python bench.py
 
