@@ -96,7 +96,8 @@ def _settings_knobs(settings: Optional[dict[str, Any]]) -> dict[str, Any]:
         "checkpoint_interval": float(
             _parse_interval(replay.get("checkpointInterval")) or 30.0
         ),
-        # recording.mode=full/sample: data frames tee into the blob
+        # recording (off|metadata|payload / none|sample|full):
+        # data frames tee into the blob
         # store when the hub carries a recorder (dataplane/recording.py)
         "recording": recording_knobs(s),
         # observability.watermark.enabled: event-time watermark/lag

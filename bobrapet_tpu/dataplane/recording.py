@@ -1,11 +1,14 @@
 """Stream recording: tee data frames into the blob store.
 
 The streaming policy language's ``recording`` block (reference:
-transport_settings_types.go:469-487): ``mode=full`` records every data
-frame, ``mode=sample`` a deterministic sampleRate% subset;
-``redactFields`` scrubs named top-level JSON payload fields before
-anything touches storage; ``retentionSeconds`` bounds how long
-segments live (the storage retention sweep pattern).
+transport_settings_types.go:498-528). Two vocabularies are accepted:
+the reference's ``off | metadata | payload`` — ``metadata`` records
+seq/key/size with no payload bytes, and ``sampleRate`` samples a
+deterministic percentage orthogonally — and the in-tree shorthand
+``none | sample | full`` (``full`` always records 100%; ``sample``
+needs a rate). ``redactFields`` scrubs named top-level JSON payload
+fields before anything touches storage; ``retentionSeconds`` bounds
+how long segments live (the storage retention sweep pattern).
 
 Segments are JSONL blobs under ``{prefix}/{stream}/{first_seq}.jsonl``
 in any :class:`~bobrapet_tpu.storage.store.Store` (Memory/File/S3/SSD),
@@ -43,13 +46,27 @@ def _sampled(seq: int, rate: float) -> bool:
 
 
 def recording_knobs(settings: Optional[dict[str, Any]]) -> Optional[dict[str, Any]]:
+    """Normalized recording knobs, accepting BOTH vocabularies:
+
+    - the reference's (transport_settings_types.go:498-505):
+      ``off | metadata | payload`` with an orthogonal ``sampleRate``
+      (metadata records seq/key/size without the payload bytes);
+    - the in-tree shorthand: ``none | sample | full`` (full==payload;
+      sample==payload at sampleRate%).
+    """
     rec = (settings or {}).get("recording") or {}
     mode = rec.get("mode")
-    if mode not in ("full", "sample"):
+    if mode in (None, "none", "off"):
         return None
+    if mode not in ("full", "sample", "payload", "metadata"):
+        return None  # admission already rejected unknown modes
     return {
-        "mode": mode,
-        "sample_rate": float(rec.get("sampleRate") or 100.0),
+        "payload": mode != "metadata",
+        # legacy "full" means 100% by definition (admission also
+        # rejects a stray sampleRate there); reference modes take the
+        # orthogonal rate
+        "sample_rate": (100.0 if mode == "full"
+                        else float(rec.get("sampleRate") or 100.0)),
         "retention": float(rec.get("retentionSeconds") or 0) or None,
         "redact": list(rec.get("redactFields") or []),
     }
@@ -78,8 +95,11 @@ class StreamRecorder:
         self.prefix = prefix
         self.segment_entries = segment_entries
         self._lock = threading.Lock()
-        #: stream -> list of pending (seq, key, payload) entries
-        self._pending: dict[str, list[tuple[int, Optional[str], bytes]]] = {}
+        #: stream -> pending (seq, key, payload-or-None, size) entries
+        #: (payload None = metadata-mode entry)
+        self._pending: dict[
+            str, list[tuple[int, Optional[str], Optional[bytes], int]]
+        ] = {}
         #: stream -> retention seconds (for the sweep)
         self._retention: dict[str, Optional[float]] = {}
 
@@ -91,12 +111,18 @@ class StreamRecorder:
         crossed (then the full segment is written to the store)."""
         if knobs is None:
             return
-        if knobs["mode"] == "sample" and not _sampled(seq, knobs["sample_rate"]):
+        if knobs["sample_rate"] < 100.0 and not _sampled(seq, knobs["sample_rate"]):
             return
-        payload = _redact(payload, knobs["redact"])
+        size = len(payload)
+        if knobs["payload"]:
+            payload = _redact(payload, knobs["redact"])
+        else:
+            # metadata mode: seq/key/size only — the bytes never touch
+            # storage (the reference's TransportRecordingMetadata)
+            payload = None
         with self._lock:
             pend = self._pending.setdefault(stream, [])
-            pend.append((seq, key, payload))
+            pend.append((seq, key, payload, size))
             self._retention[stream] = knobs["retention"]
             if len(pend) >= self.segment_entries:
                 # write INSIDE the lock: popping first and writing
@@ -119,9 +145,12 @@ class StreamRecorder:
             json.dumps({
                 "seq": seq,
                 "key": key,
-                "payload": base64.b64encode(payload).decode(),
+                # null payload = metadata-mode entry (size retained)
+                "payload": (base64.b64encode(payload).decode()
+                            if payload is not None else None),
+                "bytes": size,
             })
-            for seq, key, payload in entries
+            for seq, key, payload, size in entries
         ]
         self.store.put(
             f"{self.prefix}/{stream}/{first:012d}.jsonl",
@@ -140,13 +169,24 @@ class StreamRecorder:
                     continue
                 entry = json.loads(line)
                 if entry["seq"] >= from_seq:
-                    entry["payload"] = base64.b64decode(entry["payload"])
+                    entry["payload"] = (
+                        base64.b64decode(entry["payload"])
+                        if entry.get("payload") is not None else None
+                    )
+                    # segments written before the metadata-mode change
+                    # carry no "bytes" field — derive it so every
+                    # replayed entry has one shape
+                    entry.setdefault(
+                        "bytes",
+                        len(entry["payload"]) if entry["payload"] else 0,
+                    )
                     yield entry
         with self._lock:
             tail = list(self._pending.get(stream, []))
-        for seq, key, payload in tail:
+        for seq, key, payload, size in tail:
             if seq >= from_seq:
-                yield {"seq": seq, "key": key, "payload": payload}
+                yield {"seq": seq, "key": key, "payload": payload,
+                       "bytes": size}
 
     def sweep(self, now: Optional[float] = None) -> int:
         """Delete segments past their stream's retention; returns the

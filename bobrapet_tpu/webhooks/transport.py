@@ -32,7 +32,10 @@ _VALID_PARTITION_MODES = {"none", "keyHash", "roundRobin"}
 _VALID_FAN_OUT = {"all", "first", "roundRobin"}
 _VALID_RULE_ACTIONS = {"route", "drop", "duplicate"}
 _VALID_LIFECYCLE = {"drain", "cutover"}
-_VALID_RECORDING = {"none", "sample", "full"}
+# both vocabularies: the reference's off|metadata|payload
+# (sampleRate orthogonal) and the in-tree none|sample|full
+_VALID_RECORDING = {"none", "off", "metadata", "payload",
+                    "sample", "full"}
 
 
 def validate_streaming_settings(st, errs: FieldErrors, path: str) -> None:
@@ -212,12 +215,22 @@ def validate_streaming_settings(st, errs: FieldErrors, path: str) -> None:
         if rec.mode not in (None, *_VALID_RECORDING):
             errs.add(f"{path}.recording.mode",
                      f"must be one of {sorted(_VALID_RECORDING)}")
-        if rec.mode == "sample" and not (
-            rec.sample_rate and 0 < rec.sample_rate <= 100
-        ):
+        if rec.mode == "sample" and rec.sample_rate is None:
             errs.add(f"{path}.recording.sampleRate",
-                     "mode=sample requires sampleRate in (0, 100]")
-        if rec.mode in (None, "none") and (
+                     "mode=sample requires a sampleRate")
+        elif rec.sample_rate is not None and not (0 < rec.sample_rate <= 100):
+            errs.add(f"{path}.recording.sampleRate",
+                     "must be in (0, 100]")
+        if rec.mode == "full" and rec.sample_rate is not None:
+            # legacy full means 100% by definition; a stray rate would
+            # silently change a durable audit artifact's coverage
+            errs.add(f"{path}.recording.sampleRate",
+                     "mode=full records everything; use mode=payload "
+                     "for orthogonal sampling")
+        if rec.mode == "metadata" and rec.redact_fields:
+            errs.add(f"{path}.recording.redactFields",
+                     "metadata mode records no payload to redact")
+        if rec.mode in (None, "none", "off") and (
             rec.sample_rate or rec.retention_seconds or rec.redact_fields
         ):
             errs.add(f"{path}.recording",

@@ -316,7 +316,8 @@ Knobs knobs_from(const JValue& settings) {
   }
   if (const JValue* rec = settings.get("recording")) {
     std::string mode = rec->get_str("mode");
-    k.requires_recording = (mode == "full" || mode == "sample");
+    k.requires_recording = (mode == "full" || mode == "sample" ||
+                            mode == "payload" || mode == "metadata");
   }
   if (const JValue* ob = settings.get("observability")) {
     if (const JValue* wm = ob->get("watermark")) {

@@ -977,6 +977,37 @@ class TestRecording:
         finally:
             hub.stop()
 
+    def test_reference_vocabulary_payload_and_metadata(self):
+        """The reference's off|metadata|payload modes (sampleRate
+        orthogonal): payload==full; metadata records seq/key/size with
+        NO payload bytes in storage."""
+        hub, rec, store = self._hub_with_recorder()
+        try:
+            p = StreamProducer(hub.endpoint, "ns/run/md",
+                               settings={"recording": {"mode": "metadata"}})
+            p.send({"token": "hunter2", "i": 0}, key="k0")
+            p.send({"token": "hunter2", "i": 1}, key="k1")
+            p.close()
+            entries = list(rec.replay("ns/run/md"))
+            assert [e["seq"] for e in entries] == [0, 1]
+            assert all(e["payload"] is None for e in entries)
+            assert all(e["bytes"] > 0 for e in entries)
+            assert entries[1]["key"] == "k1"
+            # the payload bytes never touched storage
+            for key in store.list(""):
+                assert b"hunter2" not in store.get(key)
+
+            p2 = StreamProducer(hub.endpoint, "ns/run/pl",
+                                settings={"recording": {"mode": "payload",
+                                                        "sampleRate": 50}})
+            for i in range(40):
+                p2.send({"i": i})
+            p2.close()
+            got = [e["seq"] for e in rec.replay("ns/run/pl")]
+            assert 0 < len(got) < 40  # orthogonal sampling applied
+        finally:
+            hub.stop()
+
     def test_recorderless_hub_refuses_recording_stream(self):
         """Admission accepted a recording contract; a hub with no
         recorder must refuse the producer, not silently record
