@@ -607,10 +607,14 @@ class StreamHub:
                 # behind the event that moved it, or consumers could
                 # close an event-time window before that event arrives
                 # (the C++ engine orders deliver-then-notify too)
+                # et >= 0 only, matching the native engine's guard in
+                # streamhub.cc — both engines must compute identical
+                # frontiers for the same producer input
                 et = int(header["et"])
-                if conn.event_time_max is None or et > conn.event_time_max:
-                    conn.event_time_max = et
-                self._notify_watermark(st)
+                if et >= 0:
+                    if conn.event_time_max is None or et > conn.event_time_max:
+                        conn.event_time_max = et
+                    self._notify_watermark(st)
             self._maybe_replenish(st, conn)
 
     @staticmethod

@@ -161,13 +161,26 @@ class StreamRecorder:
 
     def replay(self, stream: str, from_seq: int = 0) -> Iterator[dict[str, Any]]:
         """Entries of a recorded stream in seq order: flushed segments
-        from the store plus the unflushed tail."""
+        from the store plus the unflushed tail.
+
+        The tail is snapshotted BEFORE the segment listing: a segment
+        flush racing the other way (list first, then snapshot) would
+        hide entries that moved from pending into a segment between the
+        two reads — a silent mid-stream gap. Snapshotting first means
+        an entry can instead appear in BOTH the snapshot and a freshly
+        flushed segment, so tail entries at or below the highest
+        segment seq are deduped away.
+        """
+        with self._lock:
+            tail = list(self._pending.get(stream, []))
+        last_segment_seq = -1
         keys = sorted(self.store.list(f"{self.prefix}/{stream}/"))
         for blob_key in keys:
             for line in self.store.get(blob_key).splitlines():
                 if not line.strip():
                     continue
                 entry = json.loads(line)
+                last_segment_seq = max(last_segment_seq, entry["seq"])
                 if entry["seq"] >= from_seq:
                     entry["payload"] = (
                         base64.b64decode(entry["payload"])
@@ -181,10 +194,8 @@ class StreamRecorder:
                         len(entry["payload"]) if entry["payload"] else 0,
                     )
                     yield entry
-        with self._lock:
-            tail = list(self._pending.get(stream, []))
         for seq, key, payload, size in tail:
-            if seq >= from_seq:
+            if seq >= from_seq and seq > last_segment_seq:
                 yield {"seq": seq, "key": key, "payload": payload,
                        "bytes": size}
 
@@ -196,13 +207,30 @@ class StreamRecorder:
         with self._lock:
             retentions = dict(self._retention)
         for stream, retention in retentions.items():
-            if not retention:
-                continue
-            for blob_key in self.store.list(f"{self.prefix}/{stream}/"):
-                try:
-                    if now - self.store.stat_mtime(blob_key) > retention:
-                        self.store.delete(blob_key)
-                        removed += 1
-                except Exception:  # noqa: BLE001 - raced deletion
-                    pass
+            remaining = 0
+            if retention:
+                for blob_key in self.store.list(f"{self.prefix}/{stream}/"):
+                    try:
+                        if now - self.store.stat_mtime(blob_key) > retention:
+                            self.store.delete(blob_key)
+                            removed += 1
+                        else:
+                            remaining += 1
+                    except Exception:  # noqa: BLE001 - raced deletion
+                        remaining += 1
+            if remaining == 0:
+                # fully swept (or never-segmented) stream: drop its
+                # bookkeeping so run-scoped stream names don't grow the
+                # maps — and sweep() cost — monotonically across runs.
+                # Re-check BOTH pending and the store listing under the
+                # lock: a flush between the sweep's listing and here
+                # would otherwise orphan its fresh segment from
+                # retention forever (record()/flush() hold this lock
+                # while writing segments, so the re-list is race-free).
+                with self._lock:
+                    if (not self._pending.get(stream)
+                            and not (retention and self.store.list(
+                                f"{self.prefix}/{stream}/"))):
+                        self._pending.pop(stream, None)
+                        self._retention.pop(stream, None)
         return removed

@@ -225,12 +225,13 @@ class ServingEngine:
                     f"{cfg.vocab_size}: draft and target must share the "
                     f"tokenizer"
                 )
-            from .spec_decode import make_spec_step
+            from .spec_decode import make_draft_append, make_spec_step
 
             self.dpools = init_pools(draft_cfg, self.pcfg)
             self._spec_fn = make_spec_step(
                 cfg, draft_cfg, self.pcfg, spec_k, lora_scale=lora_scale
             )
+            self._draft_append_fn = make_draft_append(draft_cfg, self.pcfg)
             self._draft_prefill_fns: dict[int, Any] = {}
             self._draft_prefill_seed_fns: dict[Any, Any] = {}
 
@@ -737,7 +738,29 @@ class ServingEngine:
         if not any(spec_ok_l):
             # nothing to speculate this tick (all-sampled batch, last-
             # token budgets, no coverage): the plain step commits the
-            # same tokens at 1/(spec_k+1) the target compute
+            # same tokens at 1/(spec_k+1) the target compute. The draft
+            # pools still need this tick's input token (the i==0 write
+            # of the spec scan) for slots that may resume speculating
+            # later, or they attend a permanent hole at this position.
+            # Only greedy slots qualify — temperature is fixed per
+            # request, so sampled slots never speculate and an
+            # all-sampled batch skips the draft pass entirely.
+            greedy_l = [
+                active_l[i] and s.request.temperature == 0
+                for i, s in enumerate(self.slots)
+            ]
+            if any(greedy_l):
+                self.dpools = self._draft_append_fn(
+                    self.draft_params, self.dpools,
+                    jnp.asarray(self._last_tokens, jnp.int32),
+                    jnp.asarray(
+                        [s.seq_len if (s and s.ingest_pos is None) else 1
+                         for s in self.slots],
+                        jnp.int32,
+                    ),
+                    jnp.asarray(greedy_l, jnp.bool_),
+                    self._block_tables(),
+                )
             return self._plain_decode_once()
         active = jnp.asarray(active_l, jnp.bool_)
         spec_ok = jnp.asarray(spec_ok_l, jnp.bool_)
@@ -774,6 +797,7 @@ class ServingEngine:
             if slot is None or slot.ingest_pos is not None:
                 continue
             req = slot.request
+            m = None
             if req.temperature > 0:
                 commits = [int(sampled_h[i])]
             elif not spec_ok_l[i]:
@@ -784,15 +808,22 @@ class ServingEngine:
                     m += 1
                 commits = [int(t) for t in props_h[i][:m]]
                 commits.append(int(choice_h[i][m]))
-                self.spec_drafted += self.spec_k
-                self.spec_accepted += m
-                metrics.serving_spec_tokens.inc("proposed", by=self.spec_k)
-                metrics.serving_spec_tokens.inc("accepted", by=m)
+            emitted = 0
             for tok in commits:
                 slot.seq_len += 1
                 self._record(i, req, tok)
+                emitted += 1
                 if req.done:
                     break
+            if m is not None:
+                # count AFTER the commit loop: eos/budget can truncate
+                # the commits, and accepted-but-never-emitted tokens
+                # would inflate the reported accept rate
+                accepted = min(m, emitted)
+                self.spec_drafted += self.spec_k
+                self.spec_accepted += accepted
+                metrics.serving_spec_tokens.inc("proposed", by=self.spec_k)
+                metrics.serving_spec_tokens.inc("accepted", by=accepted)
             if req.done:
                 done.append(req.rid)
                 self._retire(i)

@@ -205,3 +205,26 @@ def make_spec_step(cfg: LlamaConfig, dcfg: LlamaConfig, pcfg: PagedConfig,
                           lora_scale=lora_scale),
         donate_argnums=(2, 3),
     )
+
+
+def _draft_append(draft_params, dpools, last_tokens, seq_lens, active,
+                  block_tables, *, dcfg: LlamaConfig, pcfg: PagedConfig):
+    """T=1 draft-pool append of the tick's input token — the ``i == 0``
+    write of the draft scan WITHOUT proposing anything. Used on ticks
+    where the whole engine degrades to plain decode: the target step
+    writes ``last`` into its pools, and this keeps the draft cache
+    lag-one-current too, so a slot that resumes speculating later does
+    not attend a hole at the position of a plainly-committed token."""
+    pos0 = seq_lens - 1
+    dpools, _ = _model_append(
+        draft_params, dpools, last_tokens[:, None], pos0,
+        active[:, None], block_tables, cfg=dcfg, pcfg=pcfg, T=1,
+    )
+    return dpools
+
+
+def make_draft_append(dcfg: LlamaConfig, pcfg: PagedConfig):
+    return jax.jit(
+        functools.partial(_draft_append, dcfg=dcfg, pcfg=pcfg),
+        donate_argnums=(1,),
+    )
