@@ -227,6 +227,70 @@ def _cmd_manager(args: argparse.Namespace) -> int:
     )
     rt.start()
     state["rt"] = rt
+
+    # synchronous admission serving (reference: cmd/main.go:802-924 —
+    # the webhook server + its cert dir; here certs are self-minted
+    # when no cert-manager-mounted dir is given)
+    admission_server = None
+    serve_webhooks = args.serve_webhooks or (
+        args.executor_backend == "cluster" and not args.disable_webhooks
+    )
+    if serve_webhooks and not args.disable_webhooks:
+        import tempfile
+
+        from .cluster.admission import (
+            AdmissionServer,
+            register_webhook_configurations,
+        )
+        from .cluster.certs import ensure_webhook_certs
+
+        cert_dir = args.webhook_certs_dir or (
+            os.path.join(args.persist_dir, "webhook-certs")
+            if args.persist_dir
+            else os.path.join(tempfile.gettempdir(), "bobrapet-webhook-certs")
+        )
+        # the advertised host must be a SAN on the self-minted leaf or
+        # the apiserver's TLS handshake to the webhook fails
+        extra_hosts = []
+        if args.webhook_url:
+            from urllib.parse import urlparse
+
+            advertised_host = urlparse(args.webhook_url).hostname
+            if advertised_host:
+                extra_hosts.append(advertised_host)
+        wh_host, _, wh_port = args.webhook_bind_address.rpartition(":")
+        certs = ensure_webhook_certs(
+            cert_dir,
+            hosts=[
+                "127.0.0.1", "localhost",
+                "bobrapet-webhook-service.bobrapet-system.svc",
+                "bobrapet-webhook-service.bobrapet-system.svc.cluster.local",
+                *extra_hosts,
+            ],
+        )
+        admission_server = AdmissionServer(
+            rt.store, certs["cert"], certs["key"],
+            host=wh_host or "0.0.0.0", port=int(wh_port),
+        ).start()
+        _log.info("admission webhooks serving on %s", admission_server.base_url)
+        if cluster_client is not None and not args.skip_webhook_registration:
+            if args.webhook_url:
+                # URL-mode registration needs an explicit, apiserver-
+                # reachable URL: auto-advertising 127.0.0.1 with
+                # failurePolicy=Fail would block every CR write on a
+                # real cluster (the apiserver resolves localhost in its
+                # OWN netns)
+                names = register_webhook_configurations(
+                    cluster_client, rt.store, args.webhook_url,
+                    certs["ca_pem"],
+                )
+                _log.info("registered webhook configurations: %s", names)
+            else:
+                _log.warning(
+                    "webhook serving is up but NOT registered: pass "
+                    "--webhook-url (apiserver-reachable) or install the "
+                    "chart's Service-based WebhookConfigurations"
+                )
     _log.info(
         "manager up: metrics on %s, executor=%s/%s, webhooks=%s, persist=%s",
         args.metrics_bind_address, args.executor_backend, args.executor_mode,
@@ -256,6 +320,8 @@ def _cmd_manager(args: argparse.Namespace) -> int:
         heartbeat_stop.set()
     if hub is not None:
         hub.stop()
+    if admission_server is not None:
+        admission_server.stop()
     server.shutdown()
     rt.stop()
     if elector is not None:
@@ -375,6 +441,22 @@ def main(argv: list[str] | None = None) -> int:
     mgr.add_argument("--config-namespace", default="bobrapet-system")
     mgr.add_argument("--disable-webhooks", action="store_true",
                      help="skip admission (reference: ENABLE_WEBHOOKS=false)")
+    mgr.add_argument("--serve-webhooks", action="store_true",
+                     help="serve the admission chain over HTTPS even "
+                          "without the cluster backend (auto-on with it)")
+    mgr.add_argument("--webhook-bind-address", default=":9443",
+                     help="host:port for the HTTPS admission server "
+                          "(reference: controller-runtime's default 9443)")
+    mgr.add_argument("--webhook-certs-dir", default=None,
+                     help="dir with tls.crt/tls.key/ca.crt (e.g. a "
+                          "cert-manager mount); self-minted when absent")
+    mgr.add_argument("--webhook-url", default=None,
+                     help="external base URL the API server should call "
+                          "(URL-mode registration; the chart uses a "
+                          "Service reference instead)")
+    mgr.add_argument("--skip-webhook-registration", action="store_true",
+                     help="serve webhooks without writing "
+                          "WebhookConfiguration objects to the cluster")
     mgr.add_argument("--with-hub", action="store_true",
                      help="run an embedded stream hub")
     mgr.add_argument("--hub-bind-address", default=":7447")

@@ -1,0 +1,156 @@
+"""Self-signed serving-certificate material for webhook serving.
+
+The reference gets its webhook serving certs from cert-manager
+(reference: hack/charts/bobrapet/templates/serving-cert.yaml issues a
+Certificate off the chart's self-signed Issuer; cmd/main.go wires the
+mounted cert dir into the webhook server). Outside a cluster with
+cert-manager — envtest runs, the local e2e, dev loops — somebody still
+has to mint a CA plus a leaf the API server will trust, which is what
+this module does with the `openssl` CLI (already a hard dependency of
+the envtest launcher for service-account keys).
+
+Layout written by :func:`ensure_webhook_certs` (controller-runtime's
+expected file names)::
+
+    <dir>/ca.crt        # the CA certificate (caBundle for the
+                        # webhook client config)
+    <dir>/tls.crt       # leaf serving certificate
+    <dir>/tls.key       # leaf private key
+
+Existing material is reused when present and still valid for every
+requested SAN, so repeated manager starts don't churn certs.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import os
+import subprocess
+from typing import Iterable, Optional
+
+
+class CertError(Exception):
+    pass
+
+
+def _run(cmd: list[str]) -> None:
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise CertError(
+            f"{cmd[0]} failed (rc={proc.returncode}): {proc.stderr.strip()[-500:]}"
+        )
+
+
+def _san_config(hosts: Iterable[str]) -> str:
+    entries = []
+    for i, host in enumerate(hosts, start=1):
+        try:
+            ipaddress.ip_address(host)
+            entries.append(f"IP.{i} = {host}")
+        except ValueError:
+            entries.append(f"DNS.{i} = {host}")
+    return "\n".join(entries)
+
+
+def _cert_covers(cert_path: str, hosts: Iterable[str]) -> bool:
+    """True when an existing cert is valid (+1h) and carries every
+    requested SAN — the reuse check."""
+    if not os.path.exists(cert_path):
+        return False
+    proc = subprocess.run(
+        ["openssl", "x509", "-in", cert_path, "-noout", "-text",
+         "-checkend", "3600"],
+        capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        return False
+    # parse the SAN entries exactly — a substring test would treat a
+    # requested 10.0.0.1 as covered by an existing 10.0.0.10 SAN and
+    # reuse a cert the apiserver will refuse
+    import re
+
+    sans = {
+        m.group(1) or m.group(2)
+        for m in re.finditer(
+            r"DNS:([^,\s]+)|IP Address:([^,\s]+)", proc.stdout
+        )
+    }
+    return all(host in sans for host in hosts)
+
+
+def ensure_webhook_certs(
+    cert_dir: str,
+    hosts: Optional[Iterable[str]] = None,
+    days: int = 3650,
+) -> dict[str, str]:
+    """Mint (or reuse) a CA + leaf serving cert for ``hosts``.
+
+    Returns ``{"ca": ..., "cert": ..., "key": ..., "ca_pem": ...}``
+    with file paths plus the CA PEM text (the ``caBundle`` payload).
+    Default hosts cover local serving and the in-cluster webhook
+    Service DNS names the chart would create.
+    """
+    hosts = list(hosts or [
+        "127.0.0.1",
+        "localhost",
+        "bobrapet-webhook-service.bobrapet-system.svc",
+        "bobrapet-webhook-service.bobrapet-system.svc.cluster.local",
+    ])
+    os.makedirs(cert_dir, exist_ok=True)
+    ca_crt = os.path.join(cert_dir, "ca.crt")
+    ca_key = os.path.join(cert_dir, "ca.key")
+    tls_crt = os.path.join(cert_dir, "tls.crt")
+    tls_key = os.path.join(cert_dir, "tls.key")
+
+    if (os.path.exists(tls_crt) and os.path.exists(tls_key)
+            and not os.path.exists(ca_key)):
+        # externally managed material (a cert-manager mount: tls.crt/
+        # tls.key/ca.crt, never ca.key) — serve it verbatim; minting
+        # here would overwrite (or crash on a read-only mount) the
+        # operator's issued certs
+        bundle = ca_crt if os.path.exists(ca_crt) else tls_crt
+        with open(bundle) as f:
+            ca_pem = f.read()
+        return {"ca": bundle, "cert": tls_crt, "key": tls_key,
+                "ca_pem": ca_pem}
+
+    have_ca = _cert_covers(ca_crt, []) and os.path.exists(ca_key)
+    if not (have_ca and _cert_covers(tls_crt, hosts)
+            and os.path.exists(tls_key)):
+        if not have_ca:
+            _run([
+                "openssl", "req", "-x509", "-newkey", "rsa:2048",
+                "-sha256", "-nodes", "-days", str(days),
+                "-keyout", ca_key, "-out", ca_crt,
+                "-subj", "/CN=bobrapet-webhook-ca",
+                "-addext", "basicConstraints=critical,CA:TRUE",
+                "-addext", "keyUsage=critical,keyCertSign,cRLSign",
+            ])
+        csr = os.path.join(cert_dir, "tls.csr")
+        ext = os.path.join(cert_dir, "san.cnf")
+        with open(ext, "w") as f:
+            f.write(
+                "[v3_ext]\n"
+                "basicConstraints = CA:FALSE\n"
+                "keyUsage = digitalSignature,keyEncipherment\n"
+                "extendedKeyUsage = serverAuth\n"
+                "subjectAltName = @alt_names\n"
+                "[alt_names]\n" + _san_config(hosts) + "\n"
+            )
+        _run([
+            "openssl", "req", "-newkey", "rsa:2048", "-sha256", "-nodes",
+            "-keyout", tls_key, "-out", csr,
+            "-subj", "/CN=bobrapet-webhook",
+        ])
+        _run([
+            "openssl", "x509", "-req", "-sha256", "-days", str(days),
+            "-in", csr, "-CA", ca_crt, "-CAkey", ca_key,
+            "-CAcreateserial", "-out", tls_crt,
+            "-extfile", ext, "-extensions", "v3_ext",
+        ])
+        os.unlink(csr)
+    os.chmod(tls_key, 0o600)
+    os.chmod(ca_key, 0o600)
+    with open(ca_crt) as f:
+        ca_pem = f.read()
+    return {"ca": ca_crt, "cert": tls_crt, "key": tls_key, "ca_pem": ca_pem}

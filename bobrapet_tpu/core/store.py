@@ -126,6 +126,18 @@ class ResourceStore:
         steprun_webhook.go:529)."""
         self._status_validators.setdefault(kind, []).append(fn)
 
+    def admission_chain(
+        self, kind: str
+    ) -> tuple[list[Defaulter], list[Validator], list[Validator]]:
+        """The registered (defaulters, validators, status validators)
+        for a kind — the HTTPS admission server serves the exact same
+        chain the bus runs, so the two fronts cannot drift."""
+        return (
+            list(self._defaulters.get(kind, [])),
+            list(self._validators.get(kind, [])),
+            list(self._status_validators.get(kind, [])),
+        )
+
     # -- index registration ------------------------------------------------
     def add_index(self, kind: str, index_name: str, fn: IndexFn) -> None:
         """Idempotent index registration; backfills existing objects
