@@ -131,3 +131,67 @@ def tree_bytes(params: Any) -> int:
         if hasattr(leaf, "nbytes"):
             total += leaf.nbytes
     return total
+
+
+def init_quantized_params(key: jax.Array, cfg: Any) -> Any:
+    """Initialize an int8 tree DIRECTLY — same structure
+    ``quantize_params(llama.init_params(...))`` yields, without ever
+    materializing the bf16 tree.
+
+    Motivation: the 8b bench leg timed out in round 5 — host-initializing
+    16 GB of bf16 then quantizing it took longer than the whole window.
+    For throughput benchmarking the weight *values* are irrelevant (the
+    decode loop reads every byte either way), so int8 leaves are drawn
+    uniformly and scales set to a plausible absmax/127. Shapes and
+    skip-list behavior follow llama.init_params exactly
+    (models/llama.py:95).
+    """
+    import math
+
+    counter = [0]
+
+    def q(shape) -> dict[str, Any]:
+        counter[0] += 1
+        k = jax.random.fold_in(key, counter[0])
+        return {
+            "q": jax.random.randint(k, shape, -127, 128, jnp.int8),
+            "scale": jnp.full(
+                (shape[-1],), 1.0 / (127.0 * math.sqrt(shape[0])), cfg.dtype
+            ),
+        }
+
+    def dense_bf16(shape, scale=1.0) -> jax.Array:
+        counter[0] += 1
+        k = jax.random.fold_in(key, counter[0])
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(
+            cfg.dtype
+        )
+
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    params: dict[str, Any] = {
+        # embed stays bf16 (gather table, _SKIP_NAMES)
+        "embed": {"weight": dense_bf16(
+            (cfg.vocab_size, cfg.dim), 1.0 / math.sqrt(cfg.dim)
+        )},
+        "layers": [],
+        "final_norm": {"weight": jnp.ones((cfg.dim,), cfg.dtype)},
+    }
+    for _ in range(cfg.n_layers):
+        params["layers"].append({
+            "attn_norm": {"weight": jnp.ones((cfg.dim,), cfg.dtype)},
+            "attn": {
+                "wq": q((cfg.dim, cfg.dim)),
+                "wk": q((cfg.dim, kv_dim)),
+                "wv": q((cfg.dim, kv_dim)),
+                "wo": q((cfg.dim, cfg.dim)),
+            },
+            "mlp_norm": {"weight": jnp.ones((cfg.dim,), cfg.dtype)},
+            "mlp": {
+                "w_gate": q((cfg.dim, cfg.ffn_hidden)),
+                "w_up": q((cfg.dim, cfg.ffn_hidden)),
+                "w_down": q((cfg.ffn_hidden, cfg.dim)),
+            },
+        })
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"weight": q((cfg.dim, cfg.vocab_size))}
+    return params

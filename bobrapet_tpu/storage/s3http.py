@@ -1,0 +1,321 @@
+"""S3 wire-protocol client in stdlib: SigV4 over urllib.
+
+The runtime image carries no AWS SDK, but "Story says storage.s3"
+must still reach real bytes (VERDICT r4 #2; reference wires the full
+AWS SDK v2 config chain at pkg/storage/s3_store.go:184-260). This
+client implements the slice of the S3 REST API the Store interface
+needs — PutObject, GetObject, DeleteObject, HeadObject, ListObjectsV2
+— with AWS Signature Version 4 request signing, virtual-hosted or
+path-style addressing, custom endpoints (MinIO), region defaulting,
+optional TLS-verification bypass for self-signed lab endpoints, and
+anonymous (unsigned) access when no credentials are configured.
+
+It exposes the same duck-typed surface ``S3Store`` already accepts
+(``put_object/get_object/delete_object/head_object/list_objects``), so
+a boto3 client remains a drop-in replacement where one exists.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import ssl
+import urllib.error
+import urllib.parse
+import urllib.request
+from email.utils import parsedate_to_datetime
+from typing import Any, Optional
+from xml.etree import ElementTree
+
+from .store import BlobNotFound, StorageError
+
+_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+def _uri_encode(value: str, encode_slash: bool = True) -> str:
+    safe = "~" if encode_slash else "~/"
+    return urllib.parse.quote(value, safe=safe)
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+class SigV4Signer:
+    """AWS Signature Version 4 (the header-based variant)."""
+
+    def __init__(self, access_key: str, secret_key: str,
+                 session_token: Optional[str] = None,
+                 region: str = "us-east-1", service: str = "s3"):
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.session_token = session_token
+        self.region = region
+        self.service = service
+
+    def sign(self, method: str, url: str, headers: dict[str, str],
+             payload_sha256: str,
+             now: Optional[datetime.datetime] = None) -> dict[str, str]:
+        """Returns the headers to add (Authorization, x-amz-*)."""
+        parsed = urllib.parse.urlsplit(url)
+        now = now or datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = now.strftime("%Y%m%d")
+
+        out = dict(headers)
+        out["x-amz-date"] = amz_date
+        out["x-amz-content-sha256"] = payload_sha256
+        if self.session_token:
+            out["x-amz-security-token"] = self.session_token
+        out.setdefault("host", parsed.netloc)
+
+        # the request path is already URI-encoded once by _url(); for
+        # the s3 service the canonical URI is that exact string —
+        # re-encoding here would sign %20 as %2520 and real S3/MinIO
+        # would reject every key needing encoding (the stub can't catch
+        # this: it verifies by re-running this same signer)
+        canonical_path = parsed.path or "/"
+        query_pairs = urllib.parse.parse_qsl(
+            parsed.query, keep_blank_values=True
+        )
+        canonical_query = "&".join(
+            f"{_uri_encode(k)}={_uri_encode(v)}"
+            for k, v in sorted(query_pairs)
+        )
+        signed_names = sorted(k.lower() for k in out)
+        canonical_headers = "".join(
+            f"{name}:{str(out[next(k for k in out if k.lower() == name)]).strip()}\n"
+            for name in signed_names
+        )
+        signed_headers = ";".join(signed_names)
+        canonical_request = "\n".join([
+            method, canonical_path, canonical_query,
+            canonical_headers, signed_headers, payload_sha256,
+        ])
+
+        scope = f"{datestamp}/{self.region}/{self.service}/aws4_request"
+        string_to_sign = "\n".join([
+            "AWS4-HMAC-SHA256", amz_date, scope,
+            hashlib.sha256(canonical_request.encode()).hexdigest(),
+        ])
+        k_date = _hmac(b"AWS4" + self.secret_key.encode(), datestamp)
+        k_region = _hmac(k_date, self.region)
+        k_service = _hmac(k_region, self.service)
+        k_signing = _hmac(k_service, "aws4_request")
+        signature = hmac.new(
+            k_signing, string_to_sign.encode(), hashlib.sha256
+        ).hexdigest()
+
+        out["Authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={signed_headers}, Signature={signature}"
+        )
+        out.pop("host", None)  # urllib sets Host itself; it was only
+        # needed in the canonical form
+        return out
+
+
+class S3HttpClient:
+    """Minimal S3 REST client (see module doc).
+
+    ``endpoint`` examples: ``https://s3.us-east-1.amazonaws.com``,
+    ``http://127.0.0.1:9000`` (MinIO). Without one, the standard AWS
+    regional endpoint is derived from ``region``.
+    """
+
+    def __init__(
+        self,
+        region: str = "us-east-1",
+        endpoint: Optional[str] = None,
+        access_key: Optional[str] = None,
+        secret_key: Optional[str] = None,
+        session_token: Optional[str] = None,
+        use_path_style: bool = False,
+        verify_tls: bool = True,
+        timeout: float = 30.0,
+    ):
+        self.region = region or "us-east-1"
+        self.endpoint = (endpoint or
+                         f"https://s3.{self.region}.amazonaws.com").rstrip("/")
+        self.use_path_style = use_path_style
+        self.timeout = timeout
+        self._signer = (
+            SigV4Signer(access_key, secret_key, session_token, self.region)
+            if access_key and secret_key else None
+        )
+        ctx = ssl.create_default_context()
+        if not verify_tls:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        self._ssl_ctx = ctx
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _url(self, bucket: str, key: str = "",
+             query: Optional[dict[str, str]] = None) -> str:
+        parsed = urllib.parse.urlsplit(self.endpoint)
+        if self.use_path_style:
+            netloc, path = parsed.netloc, f"/{bucket}"
+        else:
+            netloc, path = f"{bucket}.{parsed.netloc}", ""
+        if key:
+            path += "/" + _uri_encode(key, encode_slash=False)
+        elif not path:
+            path = "/"
+        qs = urllib.parse.urlencode(sorted((query or {}).items()))
+        return urllib.parse.urlunsplit(
+            (parsed.scheme, netloc, path or "/", qs, "")
+        )
+
+    def _request(self, method: str, url: str,
+                 body: Optional[bytes] = None) -> tuple[int, dict, bytes]:
+        payload = body or b""
+        payload_sha = (hashlib.sha256(payload).hexdigest() if payload
+                       else _EMPTY_SHA256)
+        headers: dict[str, str] = {}
+        if self._signer is not None:
+            headers = self._signer.sign(method, url, headers, payload_sha)
+        else:
+            headers["x-amz-content-sha256"] = payload_sha
+        req = urllib.request.Request(
+            url, data=body if body else None, headers=headers, method=method
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout, context=self._ssl_ctx
+            ) as resp:
+                return resp.status, dict(resp.headers), resp.read()
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = e.read().decode(errors="replace")[:300]
+            except Exception:  # noqa: BLE001 - body already consumed
+                pass
+            if e.code == 404:
+                raise _NotFound(f"{method} {url}: 404 {detail}") from None
+            raise StorageError(
+                f"s3 {method} failed: HTTP {e.code} {detail}"
+            ) from None
+        except urllib.error.URLError as e:
+            raise StorageError(f"s3 {method} failed: {e.reason}") from None
+
+    # -- the boto3-shaped surface S3Store consumes -------------------------
+
+    def put_object(self, Bucket: str, Key: str, Body: bytes) -> dict:  # noqa: N803
+        self._request("PUT", self._url(Bucket, Key), body=bytes(Body))
+        return {}
+
+    def get_object(self, Bucket: str, Key: str) -> dict:  # noqa: N803
+        try:
+            _status, _headers, data = self._request(
+                "GET", self._url(Bucket, Key)
+            )
+        except _NotFound:
+            raise BlobNotFound(Key) from None
+        return {"Body": data}
+
+    def delete_object(self, Bucket: str, Key: str) -> dict:  # noqa: N803
+        try:
+            self._request("DELETE", self._url(Bucket, Key))
+        except _NotFound:
+            pass  # S3 DELETE is idempotent; MinIO can 404 a missing key
+        return {}
+
+    def head_object(self, Bucket: str, Key: str) -> dict:  # noqa: N803
+        try:
+            _status, headers, _data = self._request(
+                "HEAD", self._url(Bucket, Key)
+            )
+        except _NotFound:
+            raise BlobNotFound(Key) from None
+        out: dict[str, Any] = {
+            "ContentLength": int(headers.get("Content-Length") or 0),
+        }
+        lm = headers.get("Last-Modified")
+        if lm:
+            try:
+                out["LastModified"] = parsedate_to_datetime(lm)
+            except (TypeError, ValueError):
+                pass
+        return out
+
+    def list_objects(self, Bucket: str, Prefix: str = "",  # noqa: N803
+                     Marker: str = "") -> dict:
+        query = {"list-type": "2", "prefix": Prefix}
+        if Marker:
+            query["start-after"] = Marker
+        _status, _headers, data = self._request(
+            "GET", self._url(Bucket, query=query)
+        )
+        ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        root = ElementTree.fromstring(data)
+        contents = []
+        for el in root.findall(f"{ns}Contents") or root.findall("Contents"):
+            def text(tag: str, el=el) -> str:
+                node = el.find(f"{ns}{tag}")
+                if node is None:
+                    node = el.find(tag)
+                return (node.text or "") if node is not None else ""
+
+            contents.append({"Key": text("Key"),
+                             "LastModified": text("LastModified")})
+        trunc = root.find(f"{ns}IsTruncated")
+        if trunc is None:
+            trunc = root.find("IsTruncated")
+        return {
+            "Contents": contents,
+            "IsTruncated": (trunc is not None
+                            and (trunc.text or "").lower() == "true"),
+        }
+
+
+class _NotFound(Exception):
+    pass
+
+
+# -- policy -> client construction ------------------------------------------
+
+#: env contract for explicit S3 credentials/overrides (the reference
+#: reads contracts.StorageS3*Env the same way, s3_store.go:155-179;
+#: secretRef materializes into these on the pod, podspec storage env)
+ENV_S3_ACCESS_KEY_ID = "BOBRA_STORAGE_S3_ACCESS_KEY_ID"
+ENV_S3_SECRET_ACCESS_KEY = "BOBRA_STORAGE_S3_SECRET_ACCESS_KEY"  # noqa: S105
+ENV_S3_SESSION_TOKEN = "BOBRA_STORAGE_S3_SESSION_TOKEN"  # noqa: S105
+ENV_S3_ENDPOINT = "BOBRA_STORAGE_S3_ENDPOINT"
+ENV_S3_REGION = "BOBRA_STORAGE_S3_REGION"
+ENV_S3_USE_PATH_STYLE = "BOBRA_STORAGE_S3_USE_PATH_STYLE"
+ENV_S3_TLS_VERIFY = "BOBRA_STORAGE_S3_TLS_VERIFY"
+
+
+def client_from_policy(s3_policy, environ: Optional[dict] = None) -> S3HttpClient:
+    """Build an :class:`S3HttpClient` from an
+    ``api.shared.S3StorageProvider`` + the env contract. Env values
+    override policy values (the reference's applyS3EndpointOverride
+    order, s3_store.go:236-257); region defaults to us-east-1; missing
+    credentials mean anonymous access (public buckets / IAM-fronted
+    proxies)."""
+    import os
+
+    env = environ if environ is not None else os.environ
+    endpoint = env.get(ENV_S3_ENDPOINT) or getattr(s3_policy, "endpoint", None)
+    region = (env.get(ENV_S3_REGION) or getattr(s3_policy, "region", None)
+              or "us-east-1")
+    path_env = env.get(ENV_S3_USE_PATH_STYLE)
+    if path_env is not None:
+        use_path_style = path_env.strip().lower() in ("1", "true", "yes", "on")
+    else:
+        use_path_style = bool(getattr(s3_policy, "use_path_style", None))
+    verify_env = env.get(ENV_S3_TLS_VERIFY)
+    verify_tls = (verify_env is None
+                  or verify_env.strip().lower() not in ("0", "false", "no",
+                                                        "off"))
+    return S3HttpClient(
+        region=region,
+        endpoint=endpoint,
+        access_key=env.get(ENV_S3_ACCESS_KEY_ID),
+        secret_key=env.get(ENV_S3_SECRET_ACCESS_KEY),
+        session_token=env.get(ENV_S3_SESSION_TOKEN),
+        use_path_style=use_path_style,
+        verify_tls=verify_tls,
+    )

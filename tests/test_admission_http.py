@@ -159,7 +159,7 @@ class TestValidatePath:
         # the template lands on the bus the same review passes — the
         # HTTPS front shares the store the bus chain reads
         engram = {
-            "apiVersion": "bubustack.io/v1alpha1", "kind": "Engram",
+            "apiVersion": "bobrapet.io/v1alpha1", "kind": "Engram",
             "metadata": {"name": "worker", "namespace": "default"},
             "spec": {"templateRef": {"name": "tool-tpl"}},
         }

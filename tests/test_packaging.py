@@ -266,6 +266,86 @@ class TestHelmChart:
         assert hub["spec"]["template"]["spec"]["volumes"][0]["secret"][
             "secretName"] == "bobrapet-hub-tls"
 
+    def test_webhooks_without_certmanager_render_nothing(self):
+        """webhooks.enabled without certManager.enabled must not render
+        ANY webhook artifact: a failurePolicy=Fail configuration whose
+        serving cert can never be issued would block every CR write in
+        the cluster (the chart subset renderer has no `fail`, so the
+        guard is render-to-nothing + this test)."""
+        docs = self._render(webhooks={"enabled": True})
+        kinds = {d["kind"] for d in docs}
+        assert "ValidatingWebhookConfiguration" not in kinds
+        assert "MutatingWebhookConfiguration" not in kinds
+        mgr = next(d for d in docs
+                   if (d["kind"], d["metadata"]["name"]) ==
+                   ("Deployment", "bobrapet-manager"))
+        args = mgr["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--serve-webhooks" not in args
+        assert not any(
+            v.get("secret") for v in
+            mgr["spec"]["template"]["spec"]["volumes"] or []
+        )
+
+    def test_webhook_serving_render_matches_registered_chain(self):
+        """The chart's static webhook list cannot drift from what the
+        manager actually registers: rendered resources == the
+        programmatic webhook_configurations() coverage."""
+        docs = self._render(
+            certManager={"enabled": True}, webhooks={"enabled": True},
+        )
+        by_kind = {d["kind"]: d for d in docs}
+        assert "MutatingWebhookConfiguration" in by_kind
+        assert "ValidatingWebhookConfiguration" in by_kind
+        svc = next(d for d in docs
+                   if (d["kind"], d["metadata"]["name"]) ==
+                   ("Service", "bobrapet-webhook-service"))
+        assert svc["spec"]["ports"][0]["targetPort"] == 9443
+
+        from bobrapet_tpu.cluster.admission import webhook_configurations
+        from bobrapet_tpu.runtime import Runtime
+
+        rt = Runtime()
+        programmatic = webhook_configurations(
+            rt.store, "https://x:9443", "CA"
+        )
+        for cfg_kind in ("MutatingWebhookConfiguration",
+                        "ValidatingWebhookConfiguration"):
+            want = {
+                r
+                for c in programmatic if c["kind"] == cfg_kind
+                for w in c["webhooks"] for rule in w["rules"]
+                for r in rule["resources"]
+            }
+            got = {
+                r
+                for w in by_kind[cfg_kind]["webhooks"]
+                for rule in w["rules"] for r in rule["resources"]
+            }
+            assert got == want, (cfg_kind, got ^ want)
+            # every chart hook routes to a path the server actually
+            # serves, through the in-cluster Service
+            from bobrapet_tpu.cluster.admission import _PATH_TO_KIND
+
+            for w in by_kind[cfg_kind]["webhooks"]:
+                path = w["clientConfig"]["service"]["path"]
+                assert path in _PATH_TO_KIND, path
+                assert w["clientConfig"]["service"]["name"] == (
+                    "bobrapet-webhook-service")
+
+        # manager args + cert mount wired
+        mgr = next(d for d in docs
+                   if (d["kind"], d["metadata"]["name"]) ==
+                   ("Deployment", "bobrapet-manager"))
+        args = mgr["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--serve-webhooks" in args
+        assert "--webhook-certs-dir=/var/run/webhook-certs" in args
+        assert "--skip-webhook-registration" in args
+        vols = mgr["spec"]["template"]["spec"]["volumes"]
+        assert any(
+            v.get("secret", {}).get("secretName") ==
+            "bobrapet-webhook-server-cert" for v in vols
+        )
+
     def test_disabled_persistence_drops_pvc_and_flag(self):
         docs = self._render(persistence={"enabled": False})
         assert not [d for d in docs if d["kind"] == "PersistentVolumeClaim"]
