@@ -361,6 +361,11 @@ class _ControlPlaneMetrics:
             "bobrapet_serving_prefix_tokens_total",
             "Prompt tokens by prefix-cache outcome", ["result"]
         )
+        self.serving_spec_active = g(
+            "bobrapet_serving_spec_active",
+            "1 when the spec-decode payoff guard kept speculation on, "
+            "0 when it disabled it", []
+        )
         self.serving_spec_tokens = c(
             "bobrapet_serving_spec_tokens_total",
             "Speculative decoding proposals by outcome", ["result"]

@@ -155,11 +155,12 @@ def build_engine(ctx) -> ServingEngine:
     loras, lora_scale = (None, 1.0)
     if config.get("lora"):
         loras, lora_scale = _build_loras(ctx, cfg, config["lora"])
-    draft_params, draft_cfg, spec_k = _build_draft(ctx, config, cfg, params)
+    draft_params, draft_cfg, spec_k, spec_guard = _build_draft(
+        ctx, config, cfg, params)
     return ServingEngine(params, cfg, _paged_config(config.get("paging") or {}),
                          loras=loras, lora_scale=lora_scale,
                          draft_params=draft_params, draft_cfg=draft_cfg,
-                         spec_k=spec_k)
+                         spec_k=spec_k, spec_guard=spec_guard)
 
 
 def _load_params(ctx, family, cfg, ckpt, seed):
@@ -181,11 +182,16 @@ def _build_draft(ctx, config, cfg, params):
       accept rates because it IS the target);
     - ``{model: tiny, checkpoint|initSeed: ..., specK: N}`` — a
       separate small dense model sharing the tokenizer.
+
+    ``guard`` (default true) keeps the engine's payoff guard: the first
+    ticks A/B-measure spec vs plain tok/s and speculation stays on only
+    when it wins (VERDICT r4 #4). ``guard: false`` pins speculation on.
     """
     raw = config.get("draft")
     if not raw:
-        return None, None, 4
+        return None, None, 4, True
     spec_k = int(raw.get("specK", 4))
+    spec_guard = bool(raw.get("guard", True))
     if raw.get("selfInt8"):
         if raw.get("model") or raw.get("checkpoint") or raw.get("initSeed"):
             raise ValueError("config.draft: selfInt8 takes no model/"
@@ -197,7 +203,7 @@ def _build_draft(ctx, config, cfg, params):
             raise ValueError("config.draft.selfInt8 with quant=int8 "
                              "drafts with the target itself; use a "
                              "named small draft model instead")
-        return quant.quantize_params(params), cfg, spec_k
+        return quant.quantize_params(params), cfg, spec_k, spec_guard
     dname = str(raw.get("model") or "")
     if dname not in _MODELS:
         raise ValueError(
@@ -210,7 +216,7 @@ def _build_draft(ctx, config, cfg, params):
                          "(the engine drafts dense only)")
     return (_load_params(ctx, llama, dcfg, raw.get("checkpoint"),
                          raw.get("initSeed")),
-            dcfg, spec_k)
+            dcfg, spec_k, spec_guard)
 
 
 class _Broadcast:
