@@ -111,10 +111,13 @@ class SerializedTLSSocket:
     concurrently (CPython releases the GIL around both). The data plane
     is full duplex — a producer blocks in send while its credit-reader
     thread blocks in recv — so every SSL operation is serialized behind
-    one lock, with reads degraded to a poll loop (short socket timeout,
-    lock released between attempts) so a blocked reader can't starve
-    the writer. Plaintext sockets don't take this detour: kernel-level
-    send/recv on a plain fd are independently safe.
+    one lock. The underlying socket is NON-BLOCKING and all waiting
+    happens in ``select`` OUTSIDE the lock: the earlier design blocked
+    inside SSL_read for up to 50 ms with the lock held, gating every
+    concurrent send behind the reader's poll slice (the r4 mTLS
+    throughput collapse lived here, not in the hub engine). Plaintext
+    sockets don't take this detour: kernel-level send/recv on a plain
+    fd are independently safe.
     """
 
     POLL_S = 0.05
@@ -123,6 +126,7 @@ class SerializedTLSSocket:
         import threading
 
         self._sock = sock
+        self._sock.setblocking(False)
         self._lock = threading.Lock()
         self._timeout: Optional[float] = None  # per-op idle timeout
         self._poll = poll_s or self.POLL_S
@@ -130,8 +134,30 @@ class SerializedTLSSocket:
     def settimeout(self, value) -> None:
         self._timeout = value
 
+    def _wait(self, readable: bool, deadline: Optional[float]) -> None:
+        import select
+        import time
+
+        slice_s = self._poll
+        if deadline is not None:
+            slice_s = min(slice_s, max(0.0, deadline - time.monotonic()))
+        fd = self._sock.fileno()
+        if fd < 0:
+            raise TimeoutError("socket closed")
+        # select.poll, not select.select: fds above FD_SETSIZE (a hub
+        # terminating TLS for ~1000 connections) would raise ValueError
+        # in select — and swallowing that turned this wait into a
+        # busy spin
+        p = select.poll()
+        p.register(fd, select.POLLIN if readable else select.POLLOUT)
+        try:
+            p.poll(slice_s * 1000.0)
+        except OSError:
+            # closed out from under us mid-poll: the caller's next SSL
+            # op raises the real error
+            pass
+
     def recv(self, n: int) -> bytes:
-        import socket as _socket
         import time
 
         # per-operation semantics, like a real socket: the deadline is
@@ -142,18 +168,40 @@ class SerializedTLSSocket:
         )
         while True:
             with self._lock:
-                self._sock.settimeout(self._poll)
                 try:
                     return self._sock.recv(n)
-                except (_socket.timeout, ssl.SSLWantReadError):
-                    pass
+                except (ssl.SSLWantReadError, BlockingIOError):
+                    want_read = True
+                except ssl.SSLWantWriteError:  # renegotiation
+                    want_read = False
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError("read deadline exceeded")
+            self._wait(readable=want_read, deadline=deadline)
 
     def sendall(self, data: bytes) -> None:
-        with self._lock:
-            self._sock.settimeout(None)
-            self._sock.sendall(data)
+        import time
+
+        deadline = (
+            None if self._timeout is None
+            else time.monotonic() + self._timeout
+        )
+        view = memoryview(bytes(data))
+        while view.nbytes:
+            with self._lock:
+                try:
+                    # CPython's ssl enables ENABLE_PARTIAL_WRITE +
+                    # ACCEPT_MOVING_WRITE_BUFFER, so retrying from a
+                    # shifted view is safe
+                    sent = self._sock.send(view)
+                    view = view[sent:]
+                    continue
+                except (ssl.SSLWantWriteError, BlockingIOError):
+                    want_read = False
+                except ssl.SSLWantReadError:  # renegotiation
+                    want_read = True
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("write deadline exceeded")
+            self._wait(readable=want_read, deadline=deadline)
 
     def shutdown(self, how) -> None:
         with self._lock:
