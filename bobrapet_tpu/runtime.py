@@ -193,11 +193,15 @@ class Runtime:
                 # kubectl front door: the 12 CRD kinds mirror between
                 # the cluster API and the bus (spec in through
                 # admission, status out, gate decisions in) — see
-                # cluster/crsync.py; reference cmd/main.go:613-790
+                # cluster/crsync.py; reference cmd/main.go:613-790.
+                # The operator ConfigMap mirrors cluster -> bus too, so
+                # `kubectl edit configmap` live-reloads the manager
+                # (reference: internal/config/operator.go:356-383)
                 from .cluster import CRSyncer
 
                 self.cr_syncer = CRSyncer(
-                    self.store, self.cluster, clock=self.clock
+                    self.store, self.cluster, clock=self.clock,
+                    config_map=(config_namespace, "operator-config"),
                 )
         else:
             self.job_executor = LocalGangExecutor(

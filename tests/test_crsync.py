@@ -825,3 +825,102 @@ class TestCRSyncSoak:
             ))
         finally:
             rt.stop()
+
+
+class TestConfigMapBridge:
+    """VERDICT r4 #6: `kubectl edit configmap` live-reloads the manager
+    — crsync mirrors the operator ConfigMap cluster -> bus (read-only,
+    one object) and the bus-side OperatorConfigManager reload fires
+    (reference: internal/config/operator.go:356-383, the config manager
+    is a reconciler on the real ConfigMap)."""
+
+    @staticmethod
+    def _wait(cond):
+        from conftest import wait_for
+
+        return wait_for(cond)
+
+    CM = {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "operator-config",
+                     "namespace": "bobrapet-system"},
+        "data": {"templating.offloaded-data-policy": "inject"},
+    }
+
+    def test_cluster_side_edit_reloads_config(self):
+        import json as _json
+
+        cluster = FakeCluster()
+        rt = Runtime(executor_backend="cluster", cluster_client=cluster)
+        rt.start()
+        try:
+            assert (rt.config_manager.config.templating
+                    .offloaded_data_policy.value) == "fail"
+            cluster.create(_json.loads(_json.dumps(self.CM)))
+            assert self._wait(lambda: (
+                rt.config_manager.config.templating
+                .offloaded_data_policy.value) == "inject")
+            # an EDIT (kubectl edit configmap) flips it again, live
+            cluster.patch("v1", "ConfigMap", "bobrapet-system",
+                          "operator-config",
+                          {"data": {"templating.offloaded-data-policy":
+                                    "controller"}})
+            assert self._wait(lambda: (
+                rt.config_manager.config.templating
+                .offloaded_data_policy.value) == "controller")
+        finally:
+            rt.stop()
+
+    def test_configmap_predating_manager_loads_at_resync(self):
+        import json as _json
+
+        cluster = FakeCluster()
+        cluster.create(_json.loads(_json.dumps(self.CM)))
+        rt = Runtime(executor_backend="cluster", cluster_client=cluster)
+        rt.start()
+        try:
+            assert self._wait(lambda: (
+                rt.config_manager.config.templating
+                .offloaded_data_policy.value) == "inject")
+        finally:
+            rt.stop()
+
+    def test_delete_keeps_last_good_config(self):
+        import json as _json
+
+        cluster = FakeCluster()
+        rt = Runtime(executor_backend="cluster", cluster_client=cluster)
+        rt.start()
+        try:
+            cluster.create(_json.loads(_json.dumps(self.CM)))
+            assert self._wait(lambda: (
+                rt.config_manager.config.templating
+                .offloaded_data_policy.value) == "inject")
+            cluster.delete("v1", "ConfigMap", "bobrapet-system",
+                           "operator-config")
+            assert self._wait(lambda: rt.store.try_get(
+                "ConfigMap", "bobrapet-system", "operator-config") is None)
+            # reference behavior: the last good config stays active
+            assert (rt.config_manager.config.templating
+                    .offloaded_data_policy.value) == "inject"
+        finally:
+            rt.stop()
+
+    def test_other_configmaps_ignored(self):
+        cluster = FakeCluster()
+        rt = Runtime(executor_backend="cluster", cluster_client=cluster)
+        rt.start()
+        try:
+            cluster.create({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "unrelated",
+                             "namespace": "bobrapet-system"},
+                "data": {"templating.offloaded-data-policy": "inject"},
+            })
+            rt.pump()
+            assert rt.store.try_get(
+                "ConfigMap", "bobrapet-system", "unrelated") is None
+            assert (rt.config_manager.config.templating
+                    .offloaded_data_policy.value) == "fail"
+        finally:
+            rt.stop()

@@ -175,6 +175,34 @@ class TestFrontDoorOnRealApiserver:
                     pass
             server.stop()
 
+    def test_configmap_edit_reloads_manager_live(self, env, manager):
+        """VERDICT r4 #6: a cluster-side ConfigMap edit (kubectl edit
+        configmap) reaches the live config manager without restart."""
+        kubectl = env.client()
+        assert (manager.config_manager.config.templating
+                .offloaded_data_policy.value) == "fail"
+        cm = {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "operator-config",
+                         "namespace": "bobrapet-system"},
+            "data": {"templating.offloaded-data-policy": "inject"},
+        }
+        ns = {"apiVersion": "v1", "kind": "Namespace",
+              "metadata": {"name": "bobrapet-system", "namespace": ""}}
+        if kubectl.get("v1", "Namespace", "", "bobrapet-system") is None:
+            kubectl.create(ns)
+        if kubectl.get("v1", "ConfigMap", "bobrapet-system",
+                       "operator-config") is None:
+            kubectl.create(cm)
+        else:
+            kubectl.patch("v1", "ConfigMap", "bobrapet-system",
+                          "operator-config", {"data": cm["data"]})
+        assert wait_for(lambda: (
+            manager.config_manager.config.templating
+            .offloaded_data_policy.value) == "inject"), (
+            "cluster ConfigMap edit never reached the live manager"
+        )
+
     def test_batch_story_exit_code_from_real_pod_status(self, env, manager):
         from bobrapet_tpu.api.catalog import make_engram_template
         from bobrapet_tpu.api.engram import make_engram
