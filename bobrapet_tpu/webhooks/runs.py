@@ -178,6 +178,14 @@ class StepRunWebhook:
 
         if spec.story_run_ref is None or not spec.story_run_ref.name:
             errs.add("spec.storyRunRef", "storyRunRef.name is required")
+        else:
+            # DNS-1123 shape, mirroring the schema's ObjectRef pattern
+            # (parity suite): a ref that can never name a real object
+            # must fail at admission, not at reconcile
+            validate_name(errs, "spec.storyRunRef.name",
+                          spec.story_run_ref.name)
+        if spec.engram_ref is not None and spec.engram_ref.name:
+            validate_name(errs, "spec.engramRef.name", spec.engram_ref.name)
         if spec.engram_ref is None or not spec.engram_ref.name:
             errs.add("spec.engramRef", "engramRef.name is required")
 

@@ -162,6 +162,15 @@ class StoryWebhook:
             # exactly one of ref / type (reference: story_types.go:88 CEL)
             if bool(step.ref) == bool(step.type):
                 errs.add(p, "exactly one of `ref` (engram) or `type` (primitive) must be set")
+            elif step.type is not None and not isinstance(step.type, StepType):
+                # forward-compat parsing keeps unknown enum strings
+                # verbatim (specbase.py) — admission must still reject
+                # them, mirroring the schema's enum (parity suite)
+                errs.add(
+                    p + ".type",
+                    f"unknown step type {step.type!r} (one of "
+                    f"{sorted(t.value for t in StepType)})",
+                )
 
             for dep in step.needs:
                 if dep == step.name:
@@ -175,6 +184,15 @@ class StoryWebhook:
 
             self._validate_primitive_with(errs, resource, spec, step, p, realtime, nested)
             self._validate_step_templates(errs, step, p, realtime)
+
+            if step.execution is not None and step.execution.retry is not None:
+                # same bounds the Engram webhook applies (and the
+                # schema mirrors on RetryPolicy): a step-level override
+                # must not smuggle invalid retry math past admission
+                from .engram import _validate_retry
+
+                _validate_retry(errs, step.execution.retry,
+                                p + ".execution.retry")
 
             with_size = json_size(step.with_) if step.with_ else 0
             if with_size > self._max_with_size():
