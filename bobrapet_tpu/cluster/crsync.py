@@ -459,6 +459,14 @@ class CRSyncer:
                 return
             except (AlreadyExists, Conflict):
                 continue  # refetch and re-apply
+        # exhausting the retries must be LOUD: no periodic re-get
+        # exists, so a dropped edit would leave stale config until the
+        # next cluster-side ConfigMap event
+        _log.warning(
+            "operator ConfigMap mirror lost a conflict race %s times; "
+            "config edit NOT applied until the next event", 3,
+        )
+        metrics.cr_sync_ops.inc("in", "config-map-conflict")
 
     def _sync_in(self, obj: dict) -> None:
         kind = obj["kind"]

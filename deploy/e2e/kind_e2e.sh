@@ -1,0 +1,112 @@
+#!/usr/bin/env bash
+# Deployed-image e2e (reference: Makefile:79-97 + test/e2e — the real
+# operator IMAGE in a Kind cluster, validated through kubectl only).
+#
+#   deploy/e2e/kind_e2e.sh [IMAGE]
+#
+# Needs: kubectl pointed at a cluster (Kind in CI) with IMAGE loaded,
+# and python (to render the chart without helm). Proves:
+#   1. the image starts as a Deployment and turns Ready
+#   2. CRDs serve; kubectl-applied CRs run (a primitive-only story)
+#   3. gate approval via `kubectl patch --subresource status` completes
+#      the run — the reference's manual-approval flow, end to end
+set -euo pipefail
+
+IMAGE="${1:-bobrapet-tpu/manager:e2e}"
+NS="bobrapet-system"
+cd "$(dirname "$0")/../.."
+
+echo "==> installing CRDs"
+kubectl apply -f deploy/crds/
+
+echo "==> rendering + applying the chart (image=$IMAGE)"
+kubectl get ns "$NS" >/dev/null 2>&1 || kubectl create ns "$NS"
+RENDER_DIR=$(mktemp -d)
+python - "$IMAGE" "$RENDER_DIR" <<'EOF'
+import sys
+
+from bobrapet_tpu.gke.chart import render_chart
+
+image, out = sys.argv[1], sys.argv[2]
+repo, _, tag = image.rpartition(":")
+rendered = render_chart(
+    "deploy/chart/bobrapet-tpu",
+    release_name="bobrapet", namespace="bobrapet-system",
+    values={
+        "image": {"repository": repo, "tag": tag,
+                  "pullPolicy": "IfNotPresent"},
+        # the PVC needs a provisioner; the e2e exercises the manager,
+        # not the storage class
+        "persistence": {"enabled": False},
+        "leaderElect": False,
+        "hub": {"enabled": False},
+    },
+)
+import os
+
+for name, text in rendered.items():
+    with open(os.path.join(out, name), "w") as f:
+        f.write(text)
+    print(" rendered", name)
+EOF
+kubectl apply -n "$NS" -f "$RENDER_DIR"
+
+echo "==> waiting for the manager to be Ready"
+kubectl -n "$NS" rollout status deployment/bobrapet-manager --timeout=180s
+
+echo "==> applying a primitive story + run through kubectl"
+kubectl apply -f - <<'EOF'
+apiVersion: bobrapet.io/v1alpha1
+kind: Story
+metadata:
+  name: e2e-gated
+  namespace: default
+spec:
+  steps:
+    - name: nap
+      type: sleep
+      with: {duration: "1s"}
+    - name: approval
+      type: gate
+      needs: [nap]
+      with: {timeout: "10m"}
+EOF
+kubectl apply -f - <<'EOF'
+apiVersion: runs.bobrapet.io/v1alpha1
+kind: StoryRun
+metadata:
+  name: e2e-gated-run
+  namespace: default
+spec:
+  storyRef: {name: e2e-gated}
+EOF
+
+wait_phase() {
+  local want="$1" deadline=$((SECONDS + 120))
+  while ((SECONDS < deadline)); do
+    phase=$(kubectl get storyrun e2e-gated-run -o jsonpath='{.status.phase}' 2>/dev/null || true)
+    [[ "$phase" == "$want" ]] && return 0
+    sleep 2
+  done
+  echo "timed out waiting for phase=$want (last: ${phase:-<none>})"
+  kubectl get storyrun e2e-gated-run -o yaml || true
+  kubectl -n "$NS" logs deployment/bobrapet-manager --tail=100 || true
+  return 1
+}
+
+echo "==> run should reach Running (sleep done, gate open)"
+wait_phase Running
+
+echo "==> approving the gate via the status subresource"
+kubectl patch storyrun e2e-gated-run --subresource status --type merge \
+  -p '{"status":{"gates":{"approval":{"approved":true,"approver":"kind-e2e"}}}}'
+
+echo "==> run should Succeed"
+wait_phase Succeeded
+
+echo "==> metrics endpoint serves"
+kubectl -n "$NS" run curl-probe --rm -i --restart=Never \
+  --image=curlimages/curl:8.7.1 -- \
+  -sf "http://bobrapet-manager-metrics.$NS.svc:8080/healthz"
+
+echo "kind e2e: OK"

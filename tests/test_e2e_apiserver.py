@@ -191,17 +191,27 @@ class TestFrontDoorOnRealApiserver:
               "metadata": {"name": "bobrapet-system", "namespace": ""}}
         if kubectl.get("v1", "Namespace", "", "bobrapet-system") is None:
             kubectl.create(ns)
-        if kubectl.get("v1", "ConfigMap", "bobrapet-system",
-                       "operator-config") is None:
-            kubectl.create(cm)
-        else:
-            kubectl.patch("v1", "ConfigMap", "bobrapet-system",
-                          "operator-config", {"data": cm["data"]})
-        assert wait_for(lambda: (
-            manager.config_manager.config.templating
-            .offloaded_data_policy.value) == "inject"), (
-            "cluster ConfigMap edit never reached the live manager"
-        )
+        try:
+            if kubectl.get("v1", "ConfigMap", "bobrapet-system",
+                           "operator-config") is None:
+                kubectl.create(cm)
+            else:
+                kubectl.patch("v1", "ConfigMap", "bobrapet-system",
+                              "operator-config", {"data": cm["data"]})
+            assert wait_for(lambda: (
+                manager.config_manager.config.templating
+                .offloaded_data_policy.value) == "inject"), (
+                "cluster ConfigMap edit never reached the live manager"
+            )
+        finally:
+            # the apiserver outlives this test (module-scoped env):
+            # a leftover ConfigMap would leak non-default config into
+            # every later Runtime's resync
+            try:
+                kubectl.delete("v1", "ConfigMap", "bobrapet-system",
+                               "operator-config")
+            except Exception:  # noqa: BLE001 - never created
+                pass
 
     def test_batch_story_exit_code_from_real_pod_status(self, env, manager):
         from bobrapet_tpu.api.catalog import make_engram_template
