@@ -333,10 +333,12 @@ def config4_streaming_hub() -> dict:
 
     wall = burst(hub)
 
-    # the SAME engine with mTLS on (native rides the TLS frontend) —
+    # the SAME engine with mTLS on (terminated inside the native poll
+    # loop when OpenSSL loads; the Python frontend is the fallback) —
     # the production-security configuration's throughput is part of the
     # hub's story, not a footnote
     tls_msg_s = None
+    tls_mode = None
     try:
         import tempfile
 
@@ -348,6 +350,7 @@ def config4_streaming_hub() -> dict:
             hub2 = _mk(native=None if engine == "native" else False,
                        tls=tls_dir)
             tls_msg_s = round(n_msgs / burst(hub2, tls=tls_dir), 0)
+            tls_mode = getattr(hub2, "tls_mode", "python")
     except ImportError:
         pass  # cryptography not installed: the TLS leg is optional
     # anything else (splice drops frames, handshake breaks) must FAIL
@@ -362,6 +365,7 @@ def config4_streaming_hub() -> dict:
         "vs_baseline": 1.0,
         "config": 4,
         "tls_msg_s": tls_msg_s,
+        "tls_mode": tls_mode,
         "engine": engine,
         "messages": n_msgs,
         "mb_per_sec": round(mb / wall, 1),
@@ -548,6 +552,14 @@ def config8_serving_spec() -> dict:
     off = timed(ServingEngine(params, cfg, pc))
     spec_eng = ServingEngine(params, cfg, pc, draft_params=dparams,
                              draft_cfg=dcfg, spec_k=4)
+    # drive the payoff guard (VERDICT r4 #4) to its decision before
+    # timing — on the SAME batch shape the timed drain uses: the
+    # payoff flips with slot occupancy (spec wins 1-slot on CPU where
+    # per-tick host overhead dominates, loses at 4 busy slots), so a
+    # single-request warmup would decide on an unrepresentative shape
+    for pr in prompts:
+        spec_eng.submit(list(pr), max_new_tokens=16)
+    spec_eng.run()
     on = timed(spec_eng)
     accept = (spec_eng.spec_accepted / spec_eng.spec_drafted
               if spec_eng.spec_drafted else 0.0)
@@ -560,6 +572,7 @@ def config8_serving_spec() -> dict:
         "spec_off_tok_s": round(off, 1),
         "speedup_vs_off": round(on / off, 2) if off else None,
         "accept_rate": round(accept, 3),
+        "guard": spec_eng.spec_guard_decision,
         "spec_k": 4,
     }
 
@@ -961,11 +974,13 @@ def run_serving_child() -> None:
     spec_eng = ServingEngine(
         params, cfg, PagedConfig(**pcfg_kw),
         draft_params=_quant.quantize_params(params), draft_cfg=cfg, spec_k=4)
-    # warm the PLAIN fallback graph too: every slot's last budget token
-    # takes it, and a first compile inside the timed drain would
-    # deflate the number. A 2-token throwaway request reaches it
-    # naturally (remaining budget 1 -> no slot speculates)
-    spec_eng.submit([1, 2, 3, 4], max_new_tokens=2)
+    # the warmup workload (a) compiles BOTH tick graphs and (b) drives
+    # the payoff guard to its decision (VERDICT r4 #4) on the SAME
+    # batch shape the timed drain uses (payoff flips with slot
+    # occupancy) — so the timed drain runs in whichever mode the guard
+    # picked for this shape.
+    for pr in prompts:
+        spec_eng.submit(list(pr), max_new_tokens=8)
     spec_eng.run()
     spec_eng_tokens, spec_eng_wall = timed_tokens(spec_eng)
     _emit({
@@ -980,6 +995,7 @@ def run_serving_child() -> None:
         "accept_rate": round(
             spec_eng.spec_accepted / max(1, spec_eng.spec_drafted), 3),
         "spec_off_tok_s": round(serving_tokens / serving_wall, 1),
+        "guard": spec_eng.spec_guard_decision,
         "wallclock_s": round(spec_eng_wall, 3),
     })
 

@@ -205,7 +205,10 @@ class ServingEngine:
         # token-exact in both modes, so alternating is free); then
         # speculation stays on only if it actually pays. The decision
         # lands in spec_guard_decision and the serving_spec_active
-        # gauge.
+        # gauge. It is ONE-SHOT and shaped by the warmup workload:
+        # payoff flips with slot occupancy (amortized host overhead
+        # favors spec at low occupancy), so warm the engine on a
+        # representative batch shape (the bench does).
         self.spec_guard = spec_guard
         self.spec_guard_ticks = spec_guard_ticks
         self.spec_active = draft_params is not None
