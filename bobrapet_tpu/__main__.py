@@ -85,8 +85,16 @@ def _serve_http(state: dict, bind: str, token: str | None) -> http.server.Thread
 
 
 def _cmd_manager(args: argparse.Namespace) -> int:
+    import gc
+
     from .controllers.manager import Clock
     from .runtime import Runtime
+
+    # long-lived-server GC posture: with five-digit resident object
+    # populations, default gen0 thresholds spent ~25% of the r5 scale
+    # soak in collections (46 -> 57-63 steps/s tuned/off). Cycles are
+    # still collected — just far less often.
+    gc.set_threshold(100_000, 50, 50)
 
     token = None
     if args.metrics_token_file:

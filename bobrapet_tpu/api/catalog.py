@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Optional
 
+from .specbase import cached_parse
 from ..core.object import Resource, new_resource
 from .enums import WorkloadMode
 from .shared import (
@@ -69,11 +70,12 @@ class ImpulseTemplateSpec(TemplateSpec):
 
 
 def parse_engram_template(resource: Resource) -> EngramTemplateSpec:
-    return EngramTemplateSpec.from_dict(resource.spec)
+    # cached: a handful of templates parsed on every step launch
+    return cached_parse(EngramTemplateSpec, resource.spec)
 
 
 def parse_impulse_template(resource: Resource) -> ImpulseTemplateSpec:
-    return ImpulseTemplateSpec.from_dict(resource.spec)
+    return cached_parse(ImpulseTemplateSpec, resource.spec)
 
 
 def make_engram_template(name: str, **spec_fields: Any) -> Resource:

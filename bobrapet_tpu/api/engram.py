@@ -9,6 +9,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Optional
 
+from .specbase import cached_parse
 from ..core.object import Resource, new_resource
 from .enums import WorkloadMode
 from .refs import TemplateRef
@@ -60,7 +61,8 @@ class EngramSpec(SpecBase):
 
 
 def parse_engram(resource: Resource) -> EngramSpec:
-    return EngramSpec.from_dict(resource.spec)
+    # cached: one spec parsed once per referencing reconcile
+    return cached_parse(EngramSpec, resource.spec)
 
 
 def make_engram(

@@ -11,6 +11,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Optional
 
+from .specbase import cached_parse
 from ..core.object import Resource, new_resource
 from .enums import Phase
 from .refs import EngramRef, ImpulseRef, StoryRef, StoryRunRef
@@ -207,11 +208,13 @@ class EffectClaimSpec(SpecBase):
 
 
 def parse_storyrun(resource: Resource) -> StoryRunSpec:
-    return StoryRunSpec.from_dict(resource.spec)
+    # cached: reconciled many times per lifecycle (treat as immutable)
+    return cached_parse(StoryRunSpec, resource.spec)
 
 
 def parse_steprun(resource: Resource) -> StepRunSpec:
-    return StepRunSpec.from_dict(resource.spec)
+    # cached: reconciled ~6x per lifecycle (treat as immutable)
+    return cached_parse(StepRunSpec, resource.spec)
 
 
 def parse_storytrigger(resource: Resource) -> StoryTriggerSpec:

@@ -16,9 +16,12 @@ Conventions:
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import enum
 import functools
+import json
+import threading
 import typing
 from typing import Any, Optional, Type, TypeVar, get_args, get_origin, get_type_hints
 
@@ -133,3 +136,61 @@ class SpecBase:
                 continue
             out[snake_to_camel(f.name)] = _dump_value(value)
         return out
+
+
+# ---------------------------------------------------------------------------
+# content-keyed parse cache
+# ---------------------------------------------------------------------------
+
+#: Controllers re-parse the same specs on every reconcile (the DAG
+#: parses its Story tens of times per run; a StepRun is reconciled ~6
+#: times over its lifecycle) and ``from_dict`` dominated the r5
+#: scale-soak profile. The cache key is (class, canonical spec JSON) —
+#: never (name, generation), which collides across the multiple stores
+#: one process can host (the test suite, embedded runtimes). Parsed
+#: specs are treated as immutable by every consumer; callers must not
+#: mutate what ``cached_parse`` returns.
+_PARSE_CACHE: "collections.OrderedDict[tuple, Any]" = collections.OrderedDict()
+_PARSE_CACHE_LOCK = threading.Lock()
+_PARSE_CACHE_MAX = 8192
+_PARSE_KEY_MAX = 64 * 1024  # don't serialize giant specs just to key them
+
+
+def _cache_safe(value: Any) -> bool:
+    """Only JSON-native trees with str dict keys get cache keys: an
+    int-keyed dict serializes identically to its str-keyed twin
+    ({1: 'x'} vs {'1': 'x'}), which would alias two distinct specs to
+    one cached parse."""
+    t = type(value)
+    if t in (str, int, float, bool, type(None)):
+        return True
+    if t is dict:
+        return all(
+            type(k) is str and _cache_safe(v) for k, v in value.items()
+        )
+    if t is list:
+        return all(_cache_safe(v) for v in value)
+    return False
+
+
+def cached_parse(cls: Type[T], spec: Optional[dict]) -> T:
+    if not _cache_safe(spec):
+        return cls.from_dict(spec)
+    try:
+        body = json.dumps(spec, sort_keys=True, separators=(",", ":"))
+    except (TypeError, ValueError):
+        return cls.from_dict(spec)
+    if len(body) > _PARSE_KEY_MAX:
+        return cls.from_dict(spec)
+    key = (cls, body)
+    with _PARSE_CACHE_LOCK:
+        hit = _PARSE_CACHE.get(key)
+        if hit is not None:
+            _PARSE_CACHE.move_to_end(key)
+            return hit
+    parsed = cls.from_dict(spec)
+    with _PARSE_CACHE_LOCK:
+        _PARSE_CACHE[key] = parsed
+        while len(_PARSE_CACHE) > _PARSE_CACHE_MAX:
+            _PARSE_CACHE.popitem(last=False)
+    return parsed

@@ -22,6 +22,7 @@ from .shared import (
     StoragePolicy,
     TPUPolicy,
 )
+from .specbase import cached_parse
 
 KIND = "Story"
 
@@ -181,7 +182,9 @@ class StorySpec(SpecBase):
 
 
 def parse_story(resource: Resource) -> StorySpec:
-    return StorySpec.from_dict(resource.spec)
+    # content-keyed cache (specbase.cached_parse): the DAG re-parses
+    # its Story on every reconcile. Treat the result as immutable.
+    return cached_parse(StorySpec, resource.spec)
 
 
 def make_story(

@@ -61,11 +61,37 @@ ANNO_COUNTED_IMPULSE_OUTCOME = "runs.bobrapet.io/counted-impulse-outcome"
 COUNT_BATCH = 50
 
 INDEX_STORY_ENGRAM_REFS = "stepEngramRefs"
+
+#: status/annotation-derived indexes (recomputed on every commit) that
+#: keep the usage-counter controllers O(interesting children) instead
+#: of O(all children): the r5 scale soak measured the old full-list
+#: path at 37 steps/s on a 10k-StepRun population — the N^2 term was
+#: deep-copying every child per usage reconcile.
+INDEX_STORYRUN_STORY_ACTIVE = "storyRefActive"
+INDEX_STORYRUN_UNCOUNTED = "storyRefUncounted"
+INDEX_STEPRUN_ENGRAM_ACTIVE = "engramRefActive"
+INDEX_STEPRUN_UNCOUNTED = "engramRefUncounted"
 INDEX_STORY_EXECUTE_REFS = "executeStoryRefs"
 INDEX_STORY_TRANSPORT_REFS = "transportRefs"
 INDEX_STORYRUN_STORY = "storyRef"
 INDEX_STEPRUN_ENGRAM = "engramRef"
 INDEX_ENGRAM_TEMPLATE = "templateRef"
+
+
+def _bounded_fetch(store: ResourceStore, kind: str, namespace: str,
+                   index: tuple[str, str], limit: int) -> list:
+    """At most ``limit`` deep-copied objects from an index bucket —
+    _consume_tokens consumes COUNT_BATCH per pass, so under a burst of
+    10k uncounted children a full list() would deep-copy the whole
+    bucket every pass (O(U^2/batch) total)."""
+    out = []
+    for ns, nm in store.list_keys(kind, namespace=namespace, index=index):
+        r = store.try_get(kind, ns, nm)
+        if r is not None:
+            out.append(r)
+            if len(out) >= limit:
+                break
+    return out
 
 
 def _consume_tokens(
@@ -138,14 +164,19 @@ class StoryController:
 
         transport_mode = self._determine_transport_mode(spec, realtime, errors)
 
-        runs = self.store.list(STORY_RUN_KIND, namespace=namespace,
-                               index=(INDEX_STORYRUN_STORY, name))
-        active = sum(
-            1 for r in runs
-            if r.status.get("phase") and not Phase(r.status["phase"]).is_terminal
+        # O(interesting) index reads, not an O(all-runs) deep-copying
+        # list: `active` from the status-derived index, token
+        # consumption over only the still-uncounted runs
+        active = self.store.count(
+            STORY_RUN_KIND, namespace=namespace,
+            index=(INDEX_STORYRUN_STORY_ACTIVE, name),
+        )
+        uncounted_runs = _bounded_fetch(
+            self.store, STORY_RUN_KIND, namespace,
+            (INDEX_STORYRUN_UNCOUNTED, name), COUNT_BATCH,
         )
         now = self.clock.now() if self.clock else 0.0
-        inc = _consume_tokens(self.store, runs, ANNO_COUNTED_STORY, now)
+        inc = _consume_tokens(self.store, uncounted_runs, ANNO_COUNTED_STORY, now)
 
         status = ValidationStatus.INVALID if errors else ValidationStatus.VALID
 
@@ -172,8 +203,9 @@ class StoryController:
                 story, conditions.Reason.VALIDATION_FAILED, "; ".join(errors)
             )
         # more un-counted runs than one batch -> come back soon
-        uncounted = sum(
-            1 for r in runs if ANNO_COUNTED_STORY not in r.meta.annotations
+        uncounted = self.store.count(
+            STORY_RUN_KIND, namespace=namespace,
+            index=(INDEX_STORYRUN_UNCOUNTED, name),
         )
         return 1.0 if uncounted > COUNT_BATCH else None
 
@@ -260,24 +292,32 @@ class EngramController:
                 )
 
         # usage: stories whose steps reference this engram
-        # (reference: countEngramUsage engram_controller.go:323)
-        stories = self.store.list(STORY_KIND, namespace=namespace,
-                                  index=(INDEX_STORY_ENGRAM_REFS, name))
-        stepruns = self.store.list(STEP_RUN_KIND, namespace=namespace,
-                                   index=(INDEX_STEPRUN_ENGRAM, name))
-        active = sum(
-            1 for sr in stepruns
-            if sr.status.get("phase") and not Phase(sr.status["phase"]).is_terminal
+        # (reference: countEngramUsage engram_controller.go:323) —
+        # names/counts from index keys, token consumption over only
+        # the uncounted StepRuns (O(interesting), not O(all children))
+        story_names = sorted(
+            n for _ns, n in self.store.list_keys(
+                STORY_KIND, namespace=namespace,
+                index=(INDEX_STORY_ENGRAM_REFS, name),
+            )
+        )
+        active = self.store.count(
+            STEP_RUN_KIND, namespace=namespace,
+            index=(INDEX_STEPRUN_ENGRAM_ACTIVE, name),
+        )
+        uncounted_srs = _bounded_fetch(
+            self.store, STEP_RUN_KIND, namespace,
+            (INDEX_STEPRUN_UNCOUNTED, name), COUNT_BATCH,
         )
         now = self.clock.now() if self.clock else 0.0
-        inc = _consume_tokens(self.store, stepruns, ANNO_COUNTED_ENGRAM, now)
-        if engram.status.get("usageCount") != len(stories):
+        inc = _consume_tokens(self.store, uncounted_srs, ANNO_COUNTED_ENGRAM, now)
+        if engram.status.get("usageCount") != len(story_names):
             metrics.story_dirty_marks.inc()
 
         def patch(st: dict[str, Any]) -> None:
             st["phase"] = str(Phase.FAILED if errors else Phase.RUNNING)
-            st["usedByStories"] = sorted(s.meta.name for s in stories)
-            st["usageCount"] = len(stories)
+            st["usedByStories"] = story_names
+            st["usageCount"] = len(story_names)
             st["activeStepRuns"] = active
             st["triggerCount"] = int(st.get("triggerCount", 0)) + inc.get("", 0)
             st["observedGeneration"] = engram.meta.generation

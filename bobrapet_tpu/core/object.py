@@ -169,26 +169,23 @@ class Resource:
         non-JSON leaves (rare: tuples, arrays) fall back to deepcopy.
         """
         meta = self.meta
-        # dataclasses.replace stays field-agnostic: a field added to
-        # ObjectMeta later is carried automatically instead of being
-        # silently reset at every store boundary
-        new_meta = dataclasses.replace(
-            meta,
-            labels=dict(meta.labels),
-            annotations=dict(meta.annotations),
-            finalizers=list(meta.finalizers),
-            owner_references=[
-                dataclasses.replace(o) for o in meta.owner_references
-            ],
-        )
-        # replace() keeps this class- and field-agnostic: subclasses and
-        # future Resource-level fields survive the store boundary
-        return dataclasses.replace(
-            self,
-            meta=new_meta,
-            spec=_fast_copy(self.spec),
-            status=_fast_copy(self.status),
-        )
+        # copy.copy stays field-agnostic like dataclasses.replace (the
+        # whole __dict__ carries over, so fields added later survive
+        # the store boundary) but skips replace()'s __init__ re-run and
+        # fields() introspection — at r5-soak scale those were ~12% of
+        # the whole control plane (3.8M replace calls)
+        new_meta = copy.copy(meta)
+        new_meta.labels = dict(meta.labels)
+        new_meta.annotations = dict(meta.annotations)
+        new_meta.finalizers = list(meta.finalizers)
+        new_meta.owner_references = [
+            copy.copy(o) for o in meta.owner_references
+        ]
+        new = copy.copy(self)
+        new.meta = new_meta
+        new.spec = _fast_copy(self.spec)
+        new.status = _fast_copy(self.status)
+        return new
 
     def to_dict(self) -> dict[str, Any]:
         return {

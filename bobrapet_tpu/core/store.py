@@ -260,6 +260,22 @@ class ResourceStore:
         except NotFound:
             return None
 
+    def _index_keys_locked(
+        self, kind: str, index: Optional[tuple[str, str]]
+    ) -> list[tuple[str, str, str]]:
+        """Candidate object keys for one kind (optionally one index
+        bucket) — the shared selection for list/count/list_keys. MUST
+        be called with the lock held."""
+        if index is not None:
+            if (kind, index[0]) not in self._indexes:
+                raise StoreError(f"unknown index {index[0]!r} for kind {kind}")
+            return [
+                k for k in self._index_buckets[(kind, index[0])].get(
+                    index[1], set())
+                if k in self._objects
+            ]
+        return [k for k in self._objects if k[0] == kind]
+
     def list(
         self,
         kind: str,
@@ -271,10 +287,10 @@ class ResourceStore:
         with self._lock:
             picked = []
             if index is not None:
-                if (kind, index[0]) not in self._indexes:
-                    raise StoreError(f"unknown index {index[0]!r} for kind {kind}")
-                keys = self._index_buckets[(kind, index[0])].get(index[1], set())
-                candidates = [self._objects[k] for k in keys if k in self._objects]
+                candidates = [
+                    self._objects[k]
+                    for k in self._index_keys_locked(kind, index)
+                ]
             else:
                 if labels:
                     # label-filtered full scan — the no-index path the
@@ -293,6 +309,38 @@ class ResourceStore:
                 picked.append(obj)
         out = [obj.deepcopy() for obj in picked]
         out.sort(key=lambda o: (o.meta.namespace, o.meta.name))
+        return out
+
+    def count(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        index: Optional[tuple[str, str]] = None,
+    ) -> int:
+        """O(bucket) count without materializing (or deep-copying) any
+        object — the usage-counter controllers scan five-digit child
+        populations and list() was the control plane's N^2 term."""
+        with self._lock:
+            keys = self._index_keys_locked(kind, index)
+            if namespace is None:
+                return len(keys)
+            return sum(1 for k in keys if k[1] == namespace)
+
+    def list_keys(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        index: Optional[tuple[str, str]] = None,
+    ) -> list[tuple[str, str]]:
+        """(namespace, name) pairs, sorted — a copy-free list() for
+        callers that only need identities (usedByStories etc.)."""
+        with self._lock:
+            out = [
+                (k[1], k[2])
+                for k in self._index_keys_locked(kind, index)
+                if namespace is None or k[1] == namespace
+            ]
+        out.sort()
         return out
 
     # -- writes ------------------------------------------------------------

@@ -253,6 +253,50 @@ class Runtime:
             STORY_RUN_KIND, INDEX_STORYRUN_STORY,
             lambda r: [(r.spec.get("storyRef") or {}).get("name", "")],
         )
+        # status/annotation-derived usage-counter indexes (see
+        # controllers/resources.py): recomputed on every commit, they
+        # keep the Story/Engram usage reconciles O(interesting
+        # children) on five-digit populations
+        from .controllers.resources import (
+            ANNO_COUNTED_ENGRAM,
+            ANNO_COUNTED_STORY,
+            INDEX_STEPRUN_ENGRAM_ACTIVE,
+            INDEX_STEPRUN_UNCOUNTED,
+            INDEX_STORYRUN_STORY_ACTIVE,
+            INDEX_STORYRUN_UNCOUNTED,
+        )
+        from .api.enums import Phase as _Phase
+
+        def _active(ref_field):
+            def fn(r):
+                phase = r.status.get("phase")
+                if not phase:
+                    return []
+                try:
+                    if _Phase(phase).is_terminal:
+                        return []
+                except ValueError:  # unknown phase string: count active
+                    pass
+                return [(r.spec.get(ref_field) or {}).get("name", "")]
+
+            return fn
+
+        def _uncounted(ref_field, annotation):
+            def fn(r):
+                if annotation in r.meta.annotations:
+                    return []
+                return [(r.spec.get(ref_field) or {}).get("name", "")]
+
+            return fn
+
+        s.add_index(STORY_RUN_KIND, INDEX_STORYRUN_STORY_ACTIVE,
+                    _active("storyRef"))
+        s.add_index(STORY_RUN_KIND, INDEX_STORYRUN_UNCOUNTED,
+                    _uncounted("storyRef", ANNO_COUNTED_STORY))
+        s.add_index(STEP_RUN_KIND, INDEX_STEPRUN_ENGRAM_ACTIVE,
+                    _active("engramRef"))
+        s.add_index(STEP_RUN_KIND, INDEX_STEPRUN_UNCOUNTED,
+                    _uncounted("engramRef", ANNO_COUNTED_ENGRAM))
         s.add_index(
             STORY_RUN_KIND, "impulseRef",
             lambda r: [(r.spec.get("impulseRef") or {}).get("name", "")],
