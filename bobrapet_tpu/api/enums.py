@@ -253,3 +253,17 @@ def accelerator_from_device_kind(device_kind: str) -> AcceleratorType | None:
     if "v4" in kind:
         return AcceleratorType.TPU_V4
     return None
+
+
+def is_nonterminal_phase(phase, *, empty_is_active: bool) -> bool:
+    """Shared active-phase predicate for status-derived indexes (usage
+    counters, queue caps): unknown phase strings count as ACTIVE — a
+    mixed-version rollout must throttle conservatively, not leak
+    capacity. ``empty_is_active`` decides the not-yet-claimed case
+    (no phase at all)."""
+    if not phase:
+        return empty_is_active
+    try:
+        return not Phase(phase).is_terminal
+    except ValueError:
+        return True

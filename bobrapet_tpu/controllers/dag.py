@@ -141,6 +141,25 @@ def storyrun_has_demand(run: Resource) -> bool:
 #: index names (registered by the runtime)
 INDEX_STEPRUN_STORYRUN = "storyRunRef"
 INDEX_STEPRUN_PHASE = "phase"
+#: queue-cap gate index: non-terminal StepRuns keyed by their queue
+#: label, plus one all-queues bucket for the global cap. Registered by
+#: the engine itself (add_index is idempotent + backfills), so the
+#: O(1) gate can never silently degrade to a scan.
+INDEX_STEPRUN_QUEUE_ACTIVE = "queueActive"
+ACTIVE_ALL_BUCKET = "\x00all"  # cannot collide with a label value
+
+
+def _queue_active_index(r: Resource) -> list[str]:
+    from ..api.enums import is_nonterminal_phase
+
+    # empty phase = not-yet-claimed StepRun: it competes for capacity
+    if not is_nonterminal_phase(r.status.get("phase"), empty_is_active=True):
+        return []
+    out = [ACTIVE_ALL_BUCKET]
+    q = r.meta.labels.get(LABEL_QUEUE)
+    if q:
+        out.append(q)
+    return out
 
 
 class DAGEngine:
@@ -162,6 +181,8 @@ class DAGEngine:
         self.recorder = recorder
         self.clock = clock or Clock()
         self._launched_this_pass = 0
+        store.add_index(STEP_RUN_KIND, INDEX_STEPRUN_QUEUE_ACTIVE,
+                        _queue_active_index)
 
     # ------------------------------------------------------------------
     def run(self, run: Resource, story: StorySpec) -> Optional[float]:
@@ -786,13 +807,15 @@ class DAGEngine:
                       str(Phase.SCHEDULING), str(Phase.PAUSED), str(Phase.BLOCKED))
 
     def _active_stepruns_in_queue(self, queue: Optional[str]) -> int:
-        n = 0
-        for phase in self._ACTIVE_PHASES:
-            for sr in self.store.list(STEP_RUN_KIND, index=(INDEX_STEPRUN_PHASE, phase)):
-                if queue is not None and sr.meta.labels.get(LABEL_QUEUE) != queue:
-                    continue
-                n += 1
-        return n
+        # copy-free count over the self-registered queue-active index:
+        # this gate runs per launch attempt, and deep-copy-listing
+        # whole phase buckets made every launch O(all active StepRuns)
+        # once a queue or global cap was configured
+        return self.store.count(
+            STEP_RUN_KIND,
+            index=(INDEX_STEPRUN_QUEUE_ACTIVE,
+                   queue if queue is not None else ACTIVE_ALL_BUCKET),
+        )
 
     # ------------------------------------------------------------------
     # timeout + finalize

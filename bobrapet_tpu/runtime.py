@@ -265,18 +265,16 @@ class Runtime:
             INDEX_STORYRUN_STORY_ACTIVE,
             INDEX_STORYRUN_UNCOUNTED,
         )
-        from .api.enums import Phase as _Phase
+
+        from .api.enums import is_nonterminal_phase
 
         def _active(ref_field):
             def fn(r):
-                phase = r.status.get("phase")
-                if not phase:
+                # phase-less children are not yet live work here (the
+                # queue-cap index decides the opposite — see dag.py)
+                if not is_nonterminal_phase(r.status.get("phase"),
+                                            empty_is_active=False):
                     return []
-                try:
-                    if _Phase(phase).is_terminal:
-                        return []
-                except ValueError:  # unknown phase string: count active
-                    pass
                 return [(r.spec.get(ref_field) or {}).get("name", "")]
 
             return fn
