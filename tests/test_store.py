@@ -326,3 +326,119 @@ class TestEventRecorder:
         rec.normal(obj, "Scheduled", "step a")
         rec.normal(obj, "Scheduled", "step b")
         assert len(rec.for_object("Story", "default", "s1")) == 2
+
+
+class TestCheapReads:
+    """store.count / store.list_keys: the copy-free reads the r5
+    usage-counter and queue-cap indexes depend on — they must agree
+    with list() and track status/annotation-derived index functions
+    through every write path."""
+
+    def _indexed(self):
+        store = ResourceStore()
+        store.add_index(
+            "StepRun", "engramRef",
+            lambda r: [(r.spec.get("engramRef") or {}).get("name", "")],
+        )
+        store.add_index(
+            "StepRun", "activeByEngram",
+            lambda r: (
+                [] if r.status.get("phase") == "Succeeded"
+                else [(r.spec.get("engramRef") or {}).get("name", "")]
+            ),
+        )
+        for i in range(5):
+            store.create(new_resource(
+                "StepRun", f"sr{i}", "default",
+                {"engramRef": {"name": "w" if i < 3 else "x"}},
+            ))
+        return store
+
+    def test_count_matches_list_everywhere(self):
+        store = self._indexed()
+        for kwargs in (
+            {"kind": "StepRun"},
+            {"kind": "StepRun", "namespace": "default"},
+            {"kind": "StepRun", "namespace": "other"},
+            {"kind": "StepRun", "index": ("engramRef", "w")},
+            {"kind": "StepRun", "index": ("engramRef", "missing")},
+        ):
+            assert store.count(**kwargs) == len(store.list(**kwargs)), kwargs
+
+    def test_list_keys_matches_list_identities(self):
+        store = self._indexed()
+        keys = store.list_keys("StepRun", index=("engramRef", "w"))
+        objs = store.list("StepRun", index=("engramRef", "w"))
+        assert keys == [(o.meta.namespace, o.meta.name) for o in objs]
+        assert keys == sorted(keys)
+
+    def test_status_derived_index_tracks_updates(self):
+        store = self._indexed()
+        assert store.count("StepRun", index=("activeByEngram", "w")) == 3
+
+        def done(r):
+            r.status["phase"] = "Succeeded"
+
+        store.mutate("StepRun", "default", "sr0", done)
+        assert store.count("StepRun", index=("activeByEngram", "w")) == 2
+        store.delete("StepRun", "default", "sr1")
+        assert store.count("StepRun", index=("activeByEngram", "w")) == 1
+
+    def test_unknown_index_raises_like_list(self):
+        store = self._indexed()
+        from bobrapet_tpu.core.store import StoreError
+
+        with pytest.raises(StoreError):
+            store.count("StepRun", index=("nope", "v"))
+        with pytest.raises(StoreError):
+            store.list_keys("StepRun", index=("nope", "v"))
+
+
+class TestRuntimeScaleIndexes:
+    """The r5 scale indexes through the real Runtime: active counts and
+    uncounted-token buckets stay exact across phase flips and token
+    consumption (drift here silently corrupts usage counters)."""
+
+    def test_queue_active_and_usage_indexes(self):
+        from bobrapet_tpu.api.catalog import make_engram_template
+        from bobrapet_tpu.api.engram import make_engram
+        from bobrapet_tpu.api.story import make_story
+        from bobrapet_tpu.controllers.dag import (
+            ACTIVE_ALL_BUCKET,
+            INDEX_STEPRUN_QUEUE_ACTIVE,
+        )
+        from bobrapet_tpu.controllers.resources import (
+            INDEX_STORYRUN_STORY_ACTIVE,
+            INDEX_STORYRUN_UNCOUNTED,
+        )
+        from bobrapet_tpu.runtime import Runtime
+        from bobrapet_tpu.sdk import register_engram
+
+        rt = Runtime()
+
+        @register_engram("idx-impl")
+        def impl(ctx):
+            return {"ok": 1}
+
+        rt.apply(make_engram_template("idx-tpl", entrypoint="idx-impl"))
+        rt.apply(make_engram("idx-worker", "idx-tpl"))
+        rt.apply(make_story("idx-story", steps=[
+            {"name": "a", "ref": {"name": "idx-worker"}},
+        ]))
+        runs = [rt.run_story("idx-story") for _ in range(4)]
+        rt.pump()
+        assert all(rt.run_phase(r) == "Succeeded" for r in runs)
+        # everything terminal: active buckets empty, queue-cap bucket too
+        assert rt.store.count(
+            "StoryRun", index=(INDEX_STORYRUN_STORY_ACTIVE, "idx-story")
+        ) == 0
+        assert rt.store.count(
+            "StepRun", index=(INDEX_STEPRUN_QUEUE_ACTIVE, ACTIVE_ALL_BUCKET)
+        ) == 0
+        # token consumption drained the uncounted bucket and the Story
+        # status carries the exact run count
+        assert rt.store.count(
+            "StoryRun", index=(INDEX_STORYRUN_UNCOUNTED, "idx-story")
+        ) == 0
+        story = rt.store.get("Story", "default", "idx-story")
+        assert story.status.get("runsTriggered") == 4
