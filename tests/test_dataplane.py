@@ -742,6 +742,51 @@ class TestTLS:
         finally:
             hub.stop()
 
+    def test_tls_client_works_beyond_fd_setsize(self, tmp_path):
+        """The client's TLS wait uses select.poll, not select.select:
+        with >1024 fds open, select raises ValueError — and swallowing
+        it turned the wait loop into a busy spin (r5 review finding)."""
+        import os as _os
+
+        from bobrapet_tpu.dataplane.native import NativeStreamHub
+
+        if not _native_hub_available():
+            pytest.skip("native hub unavailable")
+        tls_dir = _make_ca(tmp_path, "bigfd")
+        hub = NativeStreamHub(tls=tls_dir)
+        hub.start()
+        pipes = []
+        try:
+            # push the next fd numbers past FD_SETSIZE
+            while True:
+                r, w = _os.pipe()
+                pipes.append((r, w))
+                if w > 1100:
+                    break
+            p = StreamProducer(hub.endpoint, "ns/r/bigfd", tls=tls_dir)
+            assert p._sock.fileno() > 1024
+            got = []
+            done = threading.Event()
+            c = StreamConsumer(hub.endpoint, "ns/r/bigfd", tls=tls_dir)
+            assert c._sock.fileno() > 1024
+
+            def drain():
+                for m in c:
+                    got.append(m)
+                done.set()
+
+            threading.Thread(target=drain, daemon=True).start()
+            for i in range(50):
+                p.send(b"fd-%d" % i)
+            p.close()
+            assert done.wait(30)
+            assert len(got) == 50
+        finally:
+            for r, w in pipes:
+                _os.close(r)
+                _os.close(w)
+            hub.stop()
+
     def test_native_tls_rejects_wrong_ca_and_plaintext(self, tmp_path):
         import ssl as _ssl
 
