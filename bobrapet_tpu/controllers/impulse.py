@@ -28,7 +28,7 @@ from ..api.catalog import (
     IMPULSE_TEMPLATE_KIND,
     parse_impulse_template,
 )
-from ..api.enums import Phase, TriggerDecision, WorkloadMode
+from ..api.enums import Phase, WorkloadMode
 from ..api.impulse import KIND as IMPULSE_KIND, parse_impulse
 from ..api.runs import STORY_RUN_KIND, STORY_TRIGGER_KIND
 from ..core.events import EventRecorder
@@ -45,6 +45,14 @@ _log = logging.getLogger(__name__)
 SERVICE_ACCOUNT_KIND = "ServiceAccount"
 
 INDEX_TRIGGER_IMPULSE = "impulseRef"
+#: status/annotation-derived counter indexes (registered by the
+#: runtime; same O(interesting-children) pattern as
+#: controllers/resources.py — the full-bucket lists were the N^2 term
+#: the r5 scale soak exposed)
+INDEX_TRIGGER_UNCOUNTED = "impulseRefUncounted"
+INDEX_TRIGGER_THROTTLED = "impulseRefThrottled"
+INDEX_STORYRUN_IMPULSE_UNCOUNTED = "impulseRefUncounted"
+INDEX_STORYRUN_IMPULSE_OUTCOME = "impulseRefOutcomeUncounted"
 
 
 class ImpulseController:
@@ -213,19 +221,33 @@ class ImpulseController:
     def _sync_trigger_stats(self, impulse: Resource, now: float) -> dict[str, int]:
         """(reference: syncImpulseTriggerStats impulse_controller.go:1151
         — token-based idempotent counting)"""
+        from .resources import COUNT_BATCH, _bounded_fetch
+
         ns, name = impulse.meta.namespace, impulse.meta.name
-        triggers = self.store.list(
-            STORY_TRIGGER_KIND, namespace=ns, index=(INDEX_TRIGGER_IMPULSE, name)
+        # O(interesting) index reads (see resources.py): only the
+        # still-uncounted children are fetched, throttle counts come
+        # from a status-derived bucket
+        uncounted_triggers = _bounded_fetch(
+            self.store, STORY_TRIGGER_KIND, ns,
+            (INDEX_TRIGGER_UNCOUNTED, name), COUNT_BATCH,
         )
-        runs = self.store.list(
-            STORY_RUN_KIND, namespace=ns, index=(INDEX_TRIGGER_IMPULSE, name)
+        uncounted_runs = _bounded_fetch(
+            self.store, STORY_RUN_KIND, ns,
+            (INDEX_STORYRUN_IMPULSE_UNCOUNTED, name), COUNT_BATCH,
+        )
+        # the outcome index already excludes non-terminal runs, so the
+        # value_fn's "defer until terminal" None-return never consumes
+        # batch budget scanning still-running children
+        uncounted_outcomes = _bounded_fetch(
+            self.store, STORY_RUN_KIND, ns,
+            (INDEX_STORYRUN_IMPULSE_OUTCOME, name), COUNT_BATCH,
         )
 
         received_inc = _consume_tokens(
-            self.store, triggers, ANNO_COUNTED_IMPULSE, now
+            self.store, uncounted_triggers, ANNO_COUNTED_IMPULSE, now
         ).get("", 0)
         launched_inc = _consume_tokens(
-            self.store, runs, ANNO_COUNTED_IMPULSE, now
+            self.store, uncounted_runs, ANNO_COUNTED_IMPULSE, now
         ).get("", 0)
 
         def outcome(run: Resource) -> Optional[str]:
@@ -235,12 +257,12 @@ class ImpulseController:
             return "success" if phase == str(Phase.SUCCEEDED) else "failed"
 
         outcome_inc = _consume_tokens(
-            self.store, runs, ANNO_COUNTED_IMPULSE_OUTCOME, now, value_fn=outcome
+            self.store, uncounted_outcomes, ANNO_COUNTED_IMPULSE_OUTCOME, now,
+            value_fn=outcome,
         )
-        throttled = sum(
-            1 for t in triggers
-            if t.status.get("decision") == str(TriggerDecision.REJECTED)
-            and t.status.get("reason") == "Throttled"
+        throttled = self.store.count(
+            STORY_TRIGGER_KIND, namespace=ns,
+            index=(INDEX_TRIGGER_THROTTLED, name),
         )
         metrics.impulse_throttled.set(throttled, f"{ns}/{name}")
         metrics.trigger_backfills.inc(IMPULSE_KIND)

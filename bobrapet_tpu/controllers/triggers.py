@@ -35,6 +35,7 @@ from ..api.runs import (
 )
 from ..api.story import KIND as STORY_KIND
 from ..core.events import EventRecorder
+from .impulse import INDEX_TRIGGER_IMPULSE
 from ..core.object import Resource, new_resource
 from ..core.store import AdmissionDenied, AlreadyExists, NotFound, ResourceStore
 from ..observability.metrics import metrics
@@ -196,7 +197,7 @@ class StoryTriggerController:
             return None
         runs = self.store.list(
             STORY_RUN_KIND, namespace=namespace,
-            index=("impulseRef", spec.impulse_ref.name),
+            index=(INDEX_TRIGGER_IMPULSE, spec.impulse_ref.name),
         )
         in_flight = sum(
             1 for r in runs

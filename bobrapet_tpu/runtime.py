@@ -295,8 +295,51 @@ class Runtime:
                     _active("engramRef"))
         s.add_index(STEP_RUN_KIND, INDEX_STEPRUN_UNCOUNTED,
                     _uncounted("engramRef", ANNO_COUNTED_ENGRAM))
+        # impulse counter indexes (controllers/impulse.py), same pattern
+        from .controllers.impulse import (
+            INDEX_STORYRUN_IMPULSE_OUTCOME,
+            INDEX_STORYRUN_IMPULSE_UNCOUNTED,
+            INDEX_TRIGGER_THROTTLED,
+            INDEX_TRIGGER_UNCOUNTED,
+        )
+        from .controllers.resources import (
+            ANNO_COUNTED_IMPULSE,
+            ANNO_COUNTED_IMPULSE_OUTCOME,
+        )
+        from .api.enums import TriggerDecision as _TD
+
+        s.add_index(STORY_TRIGGER_KIND, INDEX_TRIGGER_UNCOUNTED,
+                    _uncounted("impulseRef", ANNO_COUNTED_IMPULSE))
+        s.add_index(STORY_RUN_KIND, INDEX_STORYRUN_IMPULSE_UNCOUNTED,
+                    _uncounted("impulseRef", ANNO_COUNTED_IMPULSE))
+
+        def _outcome_uncounted(r):
+            # terminal AND not yet outcome-counted: the consumer's
+            # value_fn defers non-terminal runs, so the index excludes
+            # them up front
+            if ANNO_COUNTED_IMPULSE_OUTCOME in r.meta.annotations:
+                return []
+            if is_nonterminal_phase(r.status.get("phase"),
+                                    empty_is_active=True):
+                return []
+            return [(r.spec.get("impulseRef") or {}).get("name", "")]
+
+        s.add_index(STORY_RUN_KIND, INDEX_STORYRUN_IMPULSE_OUTCOME,
+                    _outcome_uncounted)
+
+        def _throttled(r):
+            if (
+                r.status.get("decision") == str(_TD.REJECTED)
+                and r.status.get("reason") == "Throttled"
+            ):
+                return [(r.spec.get("impulseRef") or {}).get("name", "")]
+            return []
+
+        s.add_index(STORY_TRIGGER_KIND, INDEX_TRIGGER_THROTTLED, _throttled)
+        from .controllers.impulse import INDEX_TRIGGER_IMPULSE
+
         s.add_index(
-            STORY_RUN_KIND, "impulseRef",
+            STORY_RUN_KIND, INDEX_TRIGGER_IMPULSE,
             lambda r: [(r.spec.get("impulseRef") or {}).get("name", "")],
         )
         s.add_index(
@@ -350,7 +393,7 @@ class Runtime:
             lambda r: [(r.spec.get("storyRef") or {}).get("name", "")],
         )
         s.add_index(
-            STORY_TRIGGER_KIND, "impulseRef",
+            STORY_TRIGGER_KIND, INDEX_TRIGGER_IMPULSE,
             lambda r: [(r.spec.get("impulseRef") or {}).get("name", "")],
         )
 

@@ -747,11 +747,25 @@ class TestTLS:
         with >1024 fds open, select raises ValueError — and swallowing
         it turned the wait loop into a busy spin (r5 review finding)."""
         import os as _os
+        import resource
 
         from bobrapet_tpu.dataplane.native import NativeStreamHub
 
         if not _native_hub_available():
             pytest.skip("native hub unavailable")
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < 1400:
+            # try raising toward the hard limit; skip (not error) on
+            # boxes that cap below what the scenario needs
+            try:
+                resource.setrlimit(resource.RLIMIT_NOFILE,
+                                   (min(4096, hard), hard))
+            except (ValueError, OSError):
+                pass
+            soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+            if soft < 1400:
+                pytest.skip(f"RLIMIT_NOFILE soft={soft} too low for the "
+                            "beyond-FD_SETSIZE scenario")
         tls_dir = _make_ca(tmp_path, "bigfd")
         hub = NativeStreamHub(tls=tls_dir)
         hub.start()
