@@ -213,6 +213,37 @@ class TestMutatePath:
         assert patched["metadata"]["annotations"]["bobrapet.io/mirrored"] == "true"
 
 
+class TestUpdatePath:
+    def test_cancel_withdrawal_rejected_with_old_object(self, server, certs):
+        """UPDATE reviews carry oldObject; validators that compare
+        (new, old) must see it — cancelRequested cannot be withdrawn
+        once set (reference: storyrun_webhook.go:175-191)."""
+        old = {
+            "apiVersion": "runs.bobrapet.io/v1alpha1", "kind": "StoryRun",
+            "metadata": {"name": "cr", "namespace": "default"},
+            "spec": {"storyRef": {"name": "s"}, "cancelRequested": True},
+        }
+        new = json.loads(json.dumps(old))
+        new["spec"]["cancelRequested"] = False
+        out = post(server, certs, KIND_PATHS["StoryRun"]["validate"],
+                   review_for(new, operation="UPDATE", old=old))
+        resp = out["response"]
+        assert resp["allowed"] is False
+        assert "cannot be withdrawn" in resp["status"]["message"]
+
+    def test_cancel_set_is_allowed(self, server, certs):
+        old = {
+            "apiVersion": "runs.bobrapet.io/v1alpha1", "kind": "StoryRun",
+            "metadata": {"name": "cr2", "namespace": "default"},
+            "spec": {"storyRef": {"name": "s"}},
+        }
+        new = json.loads(json.dumps(old))
+        new["spec"]["cancelRequested"] = True
+        out = post(server, certs, KIND_PATHS["StoryRun"]["validate"],
+                   review_for(new, operation="UPDATE", old=old))
+        assert out["response"]["allowed"] is True, out["response"]
+
+
 class TestStatusSubresource:
     def test_observed_generation_must_not_regress(self, server, certs):
         new = {
