@@ -152,7 +152,7 @@ class SpecBase:
 #: mutate what ``cached_parse`` returns.
 _PARSE_CACHE: "collections.OrderedDict[tuple, Any]" = collections.OrderedDict()
 _PARSE_CACHE_LOCK = threading.Lock()
-_PARSE_CACHE_MAX = 8192
+_PARSE_CACHE_MAX = 32768
 _PARSE_KEY_MAX = 64 * 1024  # don't serialize giant specs just to key them
 
 
