@@ -426,25 +426,47 @@ class Runtime:
             name = (ev.resource.spec.get("stepRunRef") or {}).get("name")
             return [(ev.resource.meta.namespace, name)] if name else []
 
+        def _generation_gated(fn):
+            """Fan out only on ADDED/DELETED or a SPEC change (the
+            generation bump). Definition objects' STATUS updates are
+            bookkeeping the children themselves caused — r5 soak
+            forensics: every engram usage-counter patch re-enqueued
+            EVERY StepRun of that engram (250 -> 950 reconciles per run
+            as the population grew), a pure feedback loop. The children
+            never read definition status (steprun.py resolves specs),
+            so a status-only MODIFIED cannot change their outcome."""
+            seen: dict[tuple, int] = {}
+
+            def wrapper(ev: WatchEvent):
+                key = (ev.resource.kind, ev.resource.meta.namespace,
+                       ev.resource.meta.name)
+                if ev.type == DELETED:
+                    seen.pop(key, None)
+                    return fn(ev)
+                gen = ev.resource.meta.generation
+                if seen.get(key) == gen:
+                    return []
+                seen[key] = gen
+                return fn(ev)
+
+            return wrapper
+
+        @_generation_gated
         def engram_to_stepruns(ev: WatchEvent):
-            srs = self.store.list(
+            return self.store.list_keys(
                 STEP_RUN_KIND,
                 index=(INDEX_STEPRUN_ENGRAM, ev.resource.meta.name),
             )
-            return [(sr.meta.namespace, sr.meta.name) for sr in srs]
 
+        @_generation_gated
         def template_to_stepruns(ev: WatchEvent):
-            engrams = self.store.list(
-                ENGRAM_KIND, index=(INDEX_ENGRAM_TEMPLATE, ev.resource.meta.name)
-            )
             out = []
-            for e in engrams:
-                out.extend(
-                    (sr.meta.namespace, sr.meta.name)
-                    for sr in self.store.list(
-                        STEP_RUN_KIND, index=(INDEX_STEPRUN_ENGRAM, e.meta.name)
-                    )
-                )
+            for _ns, engram_name in self.store.list_keys(
+                ENGRAM_KIND, index=(INDEX_ENGRAM_TEMPLATE, ev.resource.meta.name)
+            ):
+                out.extend(self.store.list_keys(
+                    STEP_RUN_KIND, index=(INDEX_STEPRUN_ENGRAM, engram_name)
+                ))
             return out
 
         m.register(
