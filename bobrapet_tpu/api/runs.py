@@ -63,6 +63,67 @@ class StepState(SpecBase):
     def is_terminal(self) -> bool:
         return self.effective_phase.is_terminal
 
+    # The DAG engine parses/serializes a StepState for nearly every
+    # step it looks at, every pass — the generic SpecBase walk
+    # (type-hint resolution + per-field dispatch) dominated the scale
+    # soak. The fields are flat scalars, so both directions are
+    # hand-rolled; behavior matches SpecBase exactly (camelCase keys,
+    # snake tolerance, unknown-enum passthrough, sparse None omission).
+
+    @classmethod
+    def from_dict(cls, d):  # type: ignore[override]
+        if d is None:
+            return None
+        if isinstance(d, cls):
+            return d
+        phase = d.get("phase")
+        if phase is not None and not isinstance(phase, Phase):
+            try:
+                phase = Phase(phase)
+            except ValueError:
+                pass  # forward-compatible raw string
+        return cls(
+            phase=phase,
+            reason=d.get("reason"),
+            message=d.get("message"),
+            started_at=d.get("startedAt", d.get("started_at")),
+            finished_at=d.get("finishedAt", d.get("finished_at")),
+            retries=d.get("retries"),
+            output=d.get("output"),
+            output_ref=d.get("outputRef", d.get("output_ref")),
+            signals=d.get("signals"),
+            exit_code=d.get("exitCode", d.get("exit_code")),
+            exit_class=d.get("exitClass", d.get("exit_class")),
+        )
+
+    def to_dict(self) -> dict:  # type: ignore[override]
+        out: dict = {}
+        if self.phase is not None:
+            out["phase"] = (
+                self.phase.value if isinstance(self.phase, Phase) else self.phase
+            )
+        if self.reason is not None:
+            out["reason"] = self.reason
+        if self.message is not None:
+            out["message"] = self.message
+        if self.started_at is not None:
+            out["startedAt"] = self.started_at
+        if self.finished_at is not None:
+            out["finishedAt"] = self.finished_at
+        if self.retries is not None:
+            out["retries"] = self.retries
+        if self.output is not None:
+            out["output"] = self.output
+        if self.output_ref is not None:
+            out["outputRef"] = self.output_ref
+        if self.signals is not None:
+            out["signals"] = self.signals
+        if self.exit_code is not None:
+            out["exitCode"] = self.exit_code
+        if self.exit_class is not None:
+            out["exitClass"] = self.exit_class
+        return out
+
 
 @dataclasses.dataclass
 class GateStatus(SpecBase):

@@ -21,6 +21,7 @@ import dataclasses
 import enum
 import functools
 import json
+import os
 import threading
 import typing
 from typing import Any, Optional, Type, TypeVar, get_args, get_origin, get_type_hints
@@ -155,6 +156,28 @@ _PARSE_CACHE_LOCK = threading.Lock()
 _PARSE_CACHE_MAX = 32768
 _PARSE_KEY_MAX = 64 * 1024  # don't serialize giant specs just to key them
 
+#: Debug mode (BOBRA_PARSE_CACHE_DEBUG=1): every content-cache hit
+#: re-serializes the cached parse and compares against the dump hash
+#: recorded at insert — a consumer that mutated the shared object in
+#: place (poisoning every other holder) fails loudly at the next hit
+#: instead of corrupting unrelated reconciles silently.
+PARSE_CACHE_DEBUG = os.environ.get(
+    "BOBRA_PARSE_CACHE_DEBUG", ""
+) not in ("", "0", "false")
+_PARSE_DUMPS: dict[tuple, int] = {}
+
+
+class SharedParseMutated(AssertionError):
+    """A cached_parse object was mutated in place by a consumer."""
+
+
+def _dump_hash(parsed: Any) -> int:
+    try:
+        payload = parsed.to_dict() if isinstance(parsed, SpecBase) else parsed
+        return hash(json.dumps(payload, sort_keys=True, default=str))
+    except (TypeError, ValueError):  # pragma: no cover - defensive
+        return 0
+
 
 def _cache_safe(value: Any) -> bool:
     """Only JSON-native trees with str dict keys get cache keys: an
@@ -173,7 +196,44 @@ def _cache_safe(value: Any) -> bool:
     return False
 
 
+#: identity-keyed fast path over the content cache: with copy-on-write
+#: store views, controllers hand the SAME committed spec dict to
+#: cached_parse on every reconcile until the object is rewritten — an
+#: id() hit skips both the safety walk and the canonical-JSON dump.
+#: Entries hold a strong ref to the keyed dict so its id cannot be
+#: recycled while the entry lives; bounded LRU like the content cache.
+#: Two deliberate properties: (1) entries are earned through a
+#: probation tier — a dict is promoted only on its second CONTENT-cache
+#: hit — so one-shot dicts (fresh write-boundary copies parsed once by
+#: admission) neither churn the stable view entries out nor pin dead
+#: spec trees beyond the small probation FIFO; (2) the id path
+#: extends the immutability contract to INPUTS: a dict passed to
+#: cached_parse is frozen from that point on (true everywhere in-tree:
+#: committed specs are never edited in place, and admission defaulters
+#: mutate before the first parse). BOBRA_PARSE_CACHE_DEBUG bypasses
+#: the id path, restoring pure content keying.
+_PARSE_ID_CACHE: "collections.OrderedDict[tuple[type, int], tuple[dict, Any]]" = (
+    collections.OrderedDict()
+)
+_PARSE_ID_CACHE_MAX = 8192
+#: probation tier: a dict earns a real id-cache entry only on its
+#: SECOND content-hit — one-shot dicts (fresh write-boundary copies of
+#: already-seen content) cycle through this small FIFO and never touch
+#: the stable view entries, bounding pinned garbage to 1024 slots
+_PARSE_ID_PROBATION: "collections.OrderedDict[tuple[type, int], tuple[dict, Any]]" = (
+    collections.OrderedDict()
+)
+_PARSE_ID_PROBATION_MAX = 1024
+
+
 def cached_parse(cls: Type[T], spec: Optional[dict]) -> T:
+    id_key = (cls, id(spec))
+    if not PARSE_CACHE_DEBUG:  # debug mode routes every hit via the hash check
+        with _PARSE_CACHE_LOCK:
+            id_hit = _PARSE_ID_CACHE.get(id_key)
+            if id_hit is not None and id_hit[0] is spec:
+                _PARSE_ID_CACHE.move_to_end(id_key)
+                return id_hit[1]
     if not _cache_safe(spec):
         return cls.from_dict(spec)
     try:
@@ -187,10 +247,38 @@ def cached_parse(cls: Type[T], spec: Optional[dict]) -> T:
         hit = _PARSE_CACHE.get(key)
         if hit is not None:
             _PARSE_CACHE.move_to_end(key)
-            return hit
+            prob = _PARSE_ID_PROBATION.get(id_key)
+            if prob is not None and prob[0] is spec:
+                # second content-hit for this exact dict: long-lived
+                # (a committed view) — promote to the id fast path
+                del _PARSE_ID_PROBATION[id_key]
+                _remember_id_locked(id_key, spec, hit)
+            else:
+                _PARSE_ID_PROBATION[id_key] = (spec, hit)
+                while len(_PARSE_ID_PROBATION) > _PARSE_ID_PROBATION_MAX:
+                    _PARSE_ID_PROBATION.popitem(last=False)
+    if hit is not None:
+        if PARSE_CACHE_DEBUG and _dump_hash(hit) != _PARSE_DUMPS.get(key):
+            raise SharedParseMutated(
+                f"cached {cls.__name__} parse was mutated in place by a "
+                f"consumer — cached_parse objects are shared process-wide "
+                f"and must be treated as immutable (spec: {body[:200]})"
+            )
+        return hit
     parsed = cls.from_dict(spec)
     with _PARSE_CACHE_LOCK:
         _PARSE_CACHE[key] = parsed
         while len(_PARSE_CACHE) > _PARSE_CACHE_MAX:
-            _PARSE_CACHE.popitem(last=False)
+            evicted, _ = _PARSE_CACHE.popitem(last=False)
+            _PARSE_DUMPS.pop(evicted, None)
+        # no id-cache insert on a first-ever parse: only dicts seen
+        # twice (content hits) earn an identity entry
+        if PARSE_CACHE_DEBUG:
+            _PARSE_DUMPS[key] = _dump_hash(parsed)
     return parsed
+
+
+def _remember_id_locked(id_key: tuple, spec: dict, parsed: Any) -> None:
+    _PARSE_ID_CACHE[id_key] = (spec, parsed)
+    while len(_PARSE_ID_CACHE) > _PARSE_ID_CACHE_MAX:
+        _PARSE_ID_CACHE.popitem(last=False)

@@ -70,6 +70,22 @@ class Step(SpecBase):
     def is_primitive(self) -> bool:
         return self.type is not None
 
+    def template_step_refs(self) -> frozenset[str]:
+        """Implicit ``steps.<name>`` references mined from this step's
+        templates (reference: findAndAddDeps dag.go:3223) — memoized on
+        the instance: the DAG re-derives the dependency graph every
+        pass, and parsed steps are shared, immutable cached_parse
+        objects, so the ast walk needs to run once per distinct step."""
+        refs = self.__dict__.get("_template_refs")
+        if refs is None:
+            from ..templating.engine import Evaluator
+
+            refs = frozenset(
+                Evaluator.find_step_references({"with": self.with_, "if": self.if_})
+            )
+            self.__dict__["_template_refs"] = refs
+        return refs
+
 
 @dataclasses.dataclass
 class StoryTimeouts(SpecBase):

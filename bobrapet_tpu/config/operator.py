@@ -73,6 +73,11 @@ class ControllerTuning:
     requeue_base_delay: float = 0.05
     requeue_max_delay: float = 30.0
     reconcile_timeout: float = 30.0
+    #: per-controller pool-width overrides, keyed by controller name
+    #: (reference: the five per-controller ``*.max-concurrent-reconciles``
+    #: families, operator.go:447-528); dotted key
+    #: ``controllers.<name>.max-concurrent-reconciles``
+    per_controller: dict[str, int] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -136,6 +141,12 @@ class OperatorConfig:
             )
         if self.controllers.max_concurrent_reconciles < 1:
             errs.append("controllers.maxConcurrentReconciles must be >= 1")
+        for cname, width in self.controllers.per_controller.items():
+            if width < 1:
+                errs.append(
+                    f"controllers.{cname}.max-concurrent-reconciles "
+                    f"must be >= 1, got {width}"
+                )
         if self.templating.evaluation_timeout <= 0:
             errs.append("templating.evaluationTimeout must be > 0")
         if self.engram.max_inline_size < 0:
@@ -198,8 +209,22 @@ def _apply_dotted(cfg: OperatorConfig, key: str, value: str) -> bool:
     fn = table.get(key)
     if fn is not None:
         return fn()
-    # queue keys: scheduling.queue.<name>.<field>
     parts = key.split(".")
+    # per-controller pool width: controllers.<name>.max-concurrent-reconciles
+    # (reference: the per-controller MaxConcurrentReconciles families,
+    # operator.go:447-528); consumed live by ControllerManager.apply_config
+    if (
+        len(parts) == 3
+        and parts[0] == "controllers"
+        and parts[2] == "max-concurrent-reconciles"
+    ):
+        try:
+            cfg.controllers.per_controller[parts[1]] = int(value)
+            return True
+        except (ValueError, TypeError) as e:
+            _log.warning("config key %s=%r invalid: %s", key, value, e)
+            return False
+    # queue keys: scheduling.queue.<name>.<field>
     if len(parts) == 4 and parts[0] == "scheduling" and parts[1] == "queue":
         qname, field = parts[2], parts[3]
         q = cfg.scheduling.queues.setdefault(qname, QueueConfig(name=qname))

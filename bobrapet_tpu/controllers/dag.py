@@ -27,7 +27,9 @@ persists it (patch-if-changed) and requeues at the returned delay.
 
 from __future__ import annotations
 
+import contextlib
 import logging
+import threading
 from typing import Any, Optional
 
 from ..api.enums import OffloadedDataPolicy, Phase
@@ -89,6 +91,21 @@ def _is_queued_state(raw: dict[str, Any]) -> bool:
         raw.get("phase") in (None, str(Phase.PENDING))
         and raw.get("reason") in QUEUED_REASONS
     )
+
+
+#: raw-dict phase tests for the per-pass loops: constructing a StepState
+#: per step per pass was a top soak profile term, and these checks only
+#: need the phase string (StrEnum values ARE their strings)
+_TERMINAL_RAW = frozenset(str(p) for p in Phase if p.is_terminal)
+_FAILURE_RAW = frozenset(str(p) for p in Phase if p.is_failure)
+
+
+def _raw_terminal(raw: Optional[dict[str, Any]]) -> bool:
+    return bool(raw) and raw.get("phase") in _TERMINAL_RAW
+
+
+def _raw_failure(raw: Optional[dict[str, Any]]) -> bool:
+    return bool(raw) and raw.get("phase") in _FAILURE_RAW
 
 
 def effective_priority(
@@ -180,7 +197,22 @@ class DAGEngine:
         self.storage = storage
         self.recorder = recorder
         self.clock = clock or Clock()
-        self._launched_this_pass = 0
+        #: per-pass launch counter; thread-local because the StoryRun
+        #: controller's pool runs several DAG passes concurrently
+        self._pass = threading.local()
+        #: serializes the check-then-reserve window of the CROSS-RUN
+        #: scheduling gates (queue caps, global cap, priority ordering)
+        #: across concurrent StoryRun reconciles — without it two runs
+        #: could both read "capacity free" and both launch past a
+        #: queue's max-concurrent. Taken only when such a gate applies;
+        #: uncapped stories launch lock-free. The expensive launch
+        #: itself (template eval, storage offload, StepRun commit) runs
+        #: OUTSIDE the lock against an in-memory reservation, so a slow
+        #: materialization cannot head-of-line-block other runs' gates.
+        self._sched_lock = threading.Lock()
+        #: queue-name (and the all-queues bucket) -> launches reserved
+        #: but not yet visible in the store index; counted by the gate
+        self._sched_reserved: dict[Optional[str], int] = {}
         store.add_index(STEP_RUN_KIND, INDEX_STEPRUN_QUEUE_ACTIVE,
                         _queue_active_index)
 
@@ -225,7 +257,7 @@ class DAGEngine:
 
         # bounded iteration (reference: <= steps+1, runDagIterations:381)
         total_steps = len(story.all_steps()) + 1
-        self._launched_this_pass = 0
+        self._pass.launched = 0
         try:
             for _ in range(total_steps + 1):
                 progressed = self._sync_timers(run, story)
@@ -241,7 +273,7 @@ class DAGEngine:
                 if not progressed:
                     break
         finally:
-            metrics.dag_iterations.observe(self._launched_this_pass)
+            metrics.dag_iterations.observe(self._pass.launched)
 
         return self._next_wakeup(run, story)
 
@@ -251,7 +283,11 @@ class DAGEngine:
     def _sync_state_from_stepruns(self, run: Resource) -> None:
         """(reference: syncStateFromStepRuns:965)"""
         states = run.status["stepStates"]
-        children = self.store.list(
+        # read-only views: this sync runs on EVERY StoryRun reconcile
+        # and deep-copying the whole child population was the dominant
+        # per-reconcile linear cost (merged values aliasing child
+        # status are isolated by the write-boundary copy on persist)
+        children = self.store.list_views(
             STEP_RUN_KIND,
             namespace=run.meta.namespace,
             index=(INDEX_STEPRUN_STORYRUN, run.meta.name),
@@ -280,10 +316,11 @@ class DAGEngine:
         scope = self._scope(run)
         for step_name in list(timers.keys()):
             t = timers[step_name]
-            state = StepState.from_dict(states.get(step_name) or {})
-            if state.is_terminal:
+            raw_state = states.get(step_name) or {}
+            if _raw_terminal(raw_state):
                 timers.pop(step_name, None)
                 continue
+            state = StepState.from_dict(raw_state)
             kind = t.get("kind")
             if kind == "sleep" and now >= t.get("due", 0):
                 states[step_name] = _finish(state, Phase.SUCCEEDED, now).to_dict()
@@ -376,7 +413,7 @@ class DAGEngine:
         children = t.get("children") or []
         child_states = []
         for c in children:
-            sr = self.store.try_get(STEP_RUN_KIND, run.meta.namespace, c["stepRun"])
+            sr = self.store.try_get_view(STEP_RUN_KIND, run.meta.namespace, c["stepRun"])
             phase = Phase(sr.status["phase"]) if sr is not None and sr.status.get("phase") else Phase.PENDING
             child_states.append((c, sr, phase))
         if not all(p.is_terminal for (_, _, p) in child_states):
@@ -402,7 +439,7 @@ class DAGEngine:
         """(reference: refreshAfterSubStoriesIfNeeded:652, sub-story output
         collection)"""
         states = run.status["stepStates"]
-        child = self.store.try_get(STORY_RUN_KIND, run.meta.namespace, t.get("storyRun", ""))
+        child = self.store.try_get_view(STORY_RUN_KIND, run.meta.namespace, t.get("storyRun", ""))
         if child is None:
             states[step_name] = _finish(
                 state, Phase.FAILED, now, reason="SubStoryVanished"
@@ -438,9 +475,7 @@ class DAGEngine:
         dag_phase = status.get("dagPhase", DAG_PHASE_MAIN)
         steps = self._current_phase_steps(run, story)
         states = status["stepStates"]
-        if steps and not all(
-            StepState.from_dict(states.get(s.name) or {}).is_terminal for s in steps
-        ):
+        if steps and not all(_raw_terminal(states.get(s.name)) for s in steps):
             return False
         if dag_phase == DAG_PHASE_MAIN:
             failed = self._main_failed(run, story)
@@ -474,8 +509,7 @@ class DAGEngine:
     def _main_failed(self, run: Resource, story: StorySpec) -> bool:
         states = run.status["stepStates"]
         for s in story.steps:
-            st = StepState.from_dict(states.get(s.name) or {})
-            if st.effective_phase.is_failure and not s.allow_failure:
+            if _raw_failure(states.get(s.name)) and not s.allow_failure:
                 return True
         return False
 
@@ -531,19 +565,23 @@ class DAGEngine:
         # steps and leaking the 1s requeue after a delegate failure)
         run.status.pop("materializeWaiting", None)
 
+        # one scope per pass, patched incrementally: a step that
+        # completes earlier in this same pass (condition/stop/instant
+        # primitives) must be visible to later steps' `if`/`with`
+        # evaluation, but rebuilding the whole scope per candidate made
+        # every pass O(steps^2) in StepState parses
+        scope = self._scope(run)
+
+        def touch(name: str) -> None:
+            scope["steps"][name] = _scope_entry(states[name])
+
         for step in steps:
             if step.name in states and not _is_queued_state(states[step.name]):
                 continue
-            # scope is rebuilt per candidate: a step that completed earlier
-            # in this same pass (condition/stop/instant primitives) must be
-            # visible to later steps' `if`/`with` evaluation
-            scope = self._scope(run)
             deps = set(step.needs)
             deps |= {
                 d
-                for d in Evaluator.find_step_references(
-                    {"with": step.with_, "if": step.if_}
-                )
+                for d in step.template_step_refs()
                 if d in by_name or story.step(d) is not None
             }
             # realtime pattern: `needs` between engram steps are STREAM
@@ -554,12 +592,12 @@ class DAGEngine:
             realtime = story.effective_pattern.value == "realtime"
 
             def dep_satisfied(d: str) -> bool:
-                if d not in states:
+                raw = states.get(d)
+                if raw is None:
                     return False
-                ds = StepState.from_dict(states[d])
-                if ds.is_terminal:
+                if _raw_terminal(raw):
                     return True
-                if realtime and ds.effective_phase is Phase.RUNNING:
+                if realtime and raw.get("phase") == str(Phase.RUNNING):
                     dep_def = by_name.get(d) or story.step(d)
                     return bool(dep_def is not None and dep_def.ref is not None)
                 return False
@@ -570,11 +608,11 @@ class DAGEngine:
             # dependency failure/skip propagation
             blocked_reason = None
             for d in deps:
-                ds = StepState.from_dict(states[d])
+                raw = states[d]
                 dep_def = by_name.get(d) or story.step(d)
-                if ds.effective_phase.is_failure and not (dep_def and dep_def.allow_failure):
+                if _raw_failure(raw) and not (dep_def and dep_def.allow_failure):
                     blocked_reason = "DependencyFailed"
-                elif ds.effective_phase is Phase.SKIPPED:
+                elif raw.get("phase") == str(Phase.SKIPPED):
                     blocked_reason = "DependencySkipped"
             now = self.clock.now()
             if blocked_reason:
@@ -582,6 +620,7 @@ class DAGEngine:
                     phase=Phase.SKIPPED, reason=blocked_reason,
                     started_at=now, finished_at=now,
                 ).to_dict()
+                touch(step.name)
                 progressed = True
                 continue
 
@@ -604,6 +643,7 @@ class DAGEngine:
                             phase=Phase.FAILED, reason="OffloadedDataPolicy",
                             message=str(e), started_at=now, finished_at=now,
                         ).to_dict()
+                        touch(step.name)
                         progressed = True
                         continue
                     if ok is None:
@@ -617,6 +657,7 @@ class DAGEngine:
                         phase=Phase.FAILED, reason="ExpressionFailed",
                         message=str(e), started_at=now, finished_at=now,
                     ).to_dict()
+                    touch(step.name)
                     progressed = True
                     continue
                 if not ok:
@@ -624,6 +665,7 @@ class DAGEngine:
                         phase=Phase.SKIPPED, reason="ConditionFalse",
                         started_at=now, finished_at=now,
                     ).to_dict()
+                    touch(step.name)
                     progressed = True
                     continue
 
@@ -631,48 +673,76 @@ class DAGEngine:
             # enforceSchedulingLimits:1801, enforcePriorityOrdering:1910).
             # A gated step is parked Pending with a queued reason; its
             # startedAt is the queue-entry time that drives priority aging.
-            if priority_block is None:
-                priority_block = self._priority_blocked(run, story, queue)
-            if priority_block:
-                queued_reason: Optional[str] = REASON_PRIORITY_QUEUED
-            else:
-                if queued_verdict is None:
-                    queued_verdict = (
-                        self._concurrency_queued_reason(run, story, queue),
+            # Cross-run caps (queue/global) are check-then-launch: when one
+            # applies, the check-then-RESERVE window is serialized under
+            # _sched_lock across concurrent StoryRun workers and the
+            # verdict is recomputed per candidate — the lazy per-pass
+            # cache is only sound when no other worker can launch between
+            # candidates. The launch itself runs OUTSIDE the lock against
+            # the reservation; between the StepRun commit and _unreserve
+            # the launch is briefly counted twice (index + reservation),
+            # which can only park a peer BELOW the cap for that window —
+            # conservative, never a breach, healed by the 1s queueWaiting
+            # requeue.
+            gated = bool(queue) or bool(
+                self.config_manager.config.scheduling.global_max_concurrent_steps
+            )
+            with self._sched_lock if gated else contextlib.nullcontext():
+                if gated or priority_block is None:
+                    priority_block = self._priority_blocked(run, story, queue)
+                if priority_block:
+                    queued_reason: Optional[str] = REASON_PRIORITY_QUEUED
+                else:
+                    if gated or queued_verdict is None:
+                        queued_verdict = (
+                            self._concurrency_queued_reason(run, story, queue),
+                        )
+                    queued_reason = queued_verdict[0]
+                if queued_reason is not None:
+                    prior = states.get(step.name)
+                    queued_at = (
+                        prior.get("startedAt")
+                        if prior and _is_queued_state(prior)
+                        else None
                     )
-                queued_reason = queued_verdict[0]
-            if queued_reason is not None:
-                prior = states.get(step.name)
-                queued_at = (
-                    prior.get("startedAt")
-                    if prior and _is_queued_state(prior)
-                    else None
-                )
-                if queued_at is None:
-                    queued_at = self.clock.now()
-                states[step.name] = StepState(
-                    phase=Phase.PENDING, reason=queued_reason,
-                    message=f"queued behind scheduling limits ({queued_reason})",
-                    started_at=queued_at,
-                ).to_dict()
-                run.status["queueWaiting"] = True
-                continue
+                    if queued_at is None:
+                        queued_at = self.clock.now()
+                    states[step.name] = StepState(
+                        phase=Phase.PENDING, reason=queued_reason,
+                        message=f"queued behind scheduling limits ({queued_reason})",
+                        started_at=queued_at,
+                    ).to_dict()
+                    touch(step.name)
+                    run.status["queueWaiting"] = True
+                    continue
+                if gated:
+                    # capacity reserved under the lock; the launch runs
+                    # OUTSIDE it so slow materialization cannot stall
+                    # every other run's gate
+                    self._reserve_locked(queue)
             run.status.pop("queueWaiting", None)
 
             try:
-                state = self.executor.execute(run, story, step, scope, queue=queue)
-            except LaunchBlocked as e:
-                # gang/slice capacity: stay Pending, retry soon
-                run.status["placementWaiting"] = str(e)
-                break
-            except Exception as e:  # noqa: BLE001 - launch failure fails the step
-                state = StepState(
-                    phase=Phase.FAILED, reason="LaunchFailed", message=str(e),
-                    started_at=self.clock.now(), finished_at=self.clock.now(),
-                )
+                try:
+                    state = self.executor.execute(run, story, step, scope, queue=queue)
+                except LaunchBlocked as e:
+                    # gang/slice capacity: stay Pending, retry soon
+                    run.status["placementWaiting"] = str(e)
+                    break
+                except Exception as e:  # noqa: BLE001 - launch failure fails the step
+                    state = StepState(
+                        phase=Phase.FAILED, reason="LaunchFailed", message=str(e),
+                        started_at=self.clock.now(), finished_at=self.clock.now(),
+                    )
+            finally:
+                if gated:
+                    # the committed StepRun (if any) is in the index now;
+                    # drop the reservation either way
+                    self._unreserve(queue)
             run.status.pop("placementWaiting", None)
             states[step.name] = state.to_dict()
-            self._launched_this_pass += 1
+            touch(step.name)
+            self._pass.launched += 1
             queued_verdict = None  # counts changed; re-check the gate
             progressed = True
             if run.status.get(STOP_KEY):
@@ -717,7 +787,7 @@ class DAGEngine:
         running_here = sum(
             1
             for raw in states.values()
-            if not StepState.from_dict(raw).is_terminal and not _is_queued_state(raw)
+            if not _raw_terminal(raw) and not _is_queued_state(raw)
         )
         limit = story.policy.concurrency if story.policy else None
         if limit is not None:
@@ -773,7 +843,7 @@ class DAGEngine:
         mine = effective_priority(base, my_queued_since, aging, now)
         waiting = 0  # runs actually parked (queued steps), for the gauge
         blocked = False
-        for other in self.store.list(STORY_RUN_KIND, labels={LABEL_QUEUE: queue}):
+        for other in self.store.list_views(STORY_RUN_KIND, labels={LABEL_QUEUE: queue}):
             if (
                 other.meta.namespace == run.meta.namespace
                 and other.meta.name == run.meta.name
@@ -810,12 +880,28 @@ class DAGEngine:
         # copy-free count over the self-registered queue-active index:
         # this gate runs per launch attempt, and deep-copy-listing
         # whole phase buckets made every launch O(all active StepRuns)
-        # once a queue or global cap was configured
+        # once a queue or global cap was configured. Reservations cover
+        # launches another worker has committed to but not yet written.
         return self.store.count(
             STEP_RUN_KIND,
             index=(INDEX_STEPRUN_QUEUE_ACTIVE,
                    queue if queue is not None else ACTIVE_ALL_BUCKET),
-        )
+        ) + self._sched_reserved.get(queue, 0)
+
+    def _reserve_locked(self, queue: Optional[str]) -> None:
+        """Account one imminent launch; MUST hold _sched_lock."""
+        self._sched_reserved[None] = self._sched_reserved.get(None, 0) + 1
+        if queue is not None:
+            self._sched_reserved[queue] = self._sched_reserved.get(queue, 0) + 1
+
+    def _unreserve(self, queue: Optional[str]) -> None:
+        with self._sched_lock:
+            for k in {None, queue}:
+                n = self._sched_reserved.get(k, 0) - 1
+                if n > 0:
+                    self._sched_reserved[k] = n
+                else:
+                    self._sched_reserved.pop(k, None)
 
     # ------------------------------------------------------------------
     # timeout + finalize
@@ -845,7 +931,7 @@ class DAGEngine:
     def _cancel_children(self, run: Resource) -> None:
         from .steprun import CANCEL_ANNOTATION
 
-        for sr in self.store.list(
+        for sr in self.store.list_views(
             STEP_RUN_KIND,
             namespace=run.meta.namespace,
             index=(INDEX_STEPRUN_STORYRUN, run.meta.name),
@@ -877,7 +963,7 @@ class DAGEngine:
             failed = [
                 name
                 for name, raw in status["stepStates"].items()
-                if StepState.from_dict(raw).effective_phase.is_failure
+                if _raw_failure(raw)
             ]
             status["phase"] = str(Phase.FAILED)
             status["error"] = StructuredError(
@@ -933,14 +1019,10 @@ class DAGEngine:
     def _scope(self, run: Resource) -> dict[str, Any]:
         """(reference: getPriorStepOutputs:2083 — outputs + signals per
         step; hydration is lazy via the offloaded-data policy)"""
-        steps_scope = {}
-        for name, raw in (run.status.get("stepStates") or {}).items():
-            st = StepState.from_dict(raw)
-            steps_scope[name] = {
-                "output": st.output,
-                "signals": st.signals or {},
-                "phase": str(st.effective_phase),
-            }
+        steps_scope = {
+            name: _scope_entry(raw)
+            for name, raw in (run.status.get("stepStates") or {}).items()
+        }
         return {
             "inputs": run.spec.get("inputs") or {},
             "steps": steps_scope,
@@ -984,6 +1066,15 @@ class DAGEngine:
         if not due:
             return None
         return max(0.0, min(due) - now)
+
+
+def _scope_entry(raw: dict[str, Any]) -> dict[str, Any]:
+    """One step's template-scope projection (output/signals/phase)."""
+    return {
+        "output": raw.get("output"),
+        "signals": raw.get("signals") or {},
+        "phase": raw.get("phase") or str(Phase.PENDING),
+    }
 
 
 def _merge_steprun_state(existing: dict[str, Any], sr: Resource) -> dict[str, Any]:

@@ -135,7 +135,7 @@ class LocalGangExecutor:
         cancel = threading.Event()
         with self._lock:
             self._cancels[(ns, name)] = cancel
-        if self.store.try_get(JOB_KIND, ns, name) is None:
+        if self.store.try_get_view(JOB_KIND, ns, name) is None:
             cancel.set()  # deleted before we registered — don't run blind
         if self.mode == "threaded":
             t = threading.Thread(

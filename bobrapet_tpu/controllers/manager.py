@@ -8,10 +8,28 @@ reconcile function keyed by (namespace, name); events map to keys, keys
 dedupe in a work queue, failures requeue with exponential backoff +
 jitter, and ``requeue_after`` timers park keys until due.
 
+Dispatch is per-controller (reference: ``controller.Options.
+MaxConcurrentReconciles``, cmd/main.go:650-769): every controller owns a
+worker pool sized by ``controllers.max-concurrent-reconciles`` (plus
+``controllers.<name>.max-concurrent-reconciles`` overrides), so one slow
+StepRun reconcile can no longer head-of-line-block every other
+controller. Workqueue semantics are preserved exactly:
+
+- a key is never reconciled concurrently with itself — an event
+  arriving mid-reconcile marks the key *dirty* and it re-dispatches
+  once the in-flight run completes (controller-runtime's
+  processing-set behavior);
+- queued keys dedupe; failures back off with jitter; ``requeue_after``
+  timers park keys until due, popped under the shared lock and routed
+  only to the pools that received work (idle pools stay asleep).
+
 Determinism for tests comes from an injectable clock: with a
-:class:`ManualClock`, :meth:`run_until_quiet` advances virtual time to
-the next due timer whenever the queue is idle, so sleep/gate/retry logic
-runs instantly — the envtest analogue (SURVEY §4).
+:class:`ManualClock`, :meth:`run_until_quiet` pumps every controller
+serially on the calling thread — advancing virtual time to the next due
+timer whenever the queue is idle — so sleep/gate/retry logic runs
+instantly; the envtest analogue (SURVEY §4). The pump uses the same
+active/dirty bookkeeping as the pools, so both modes share one
+correctness story.
 """
 
 from __future__ import annotations
@@ -22,6 +40,7 @@ import logging
 import random
 import threading
 import time
+from collections import deque
 from typing import Callable, Iterable, Optional
 
 from ..core.store import ResourceStore, WatchEvent
@@ -88,14 +107,33 @@ class _Timer:
     key: tuple[str, str, str] = dataclasses.field(compare=False)  # (controller, ns, name)
 
 
+class _Pool:
+    """One controller's work queue + worker bookkeeping. All fields are
+    guarded by the manager's shared lock; ``cond`` shares that lock so
+    waking this pool cannot wake any other."""
+
+    __slots__ = ("name", "queue", "queued", "cond", "target", "spawned",
+                 "idle", "busy")
+
+    def __init__(self, name: str, lock: threading.Lock, target: int):
+        self.name = name
+        #: FIFO of (global seq, enqueue monotonic time, (ns, name))
+        self.queue: deque[tuple[int, float, tuple[str, str]]] = deque()
+        self.queued: set[tuple[str, str]] = set()
+        self.cond = threading.Condition(lock)
+        self.target = target  # desired worker count
+        self.spawned = 0  # live worker threads
+        self.idle = 0  # workers waiting on cond
+        self.busy = 0  # reconciles in flight
+
+
 class ControllerManager:
-    """Single-dispatcher reconcile engine.
+    """Per-controller-pool reconcile engine (see module docstring).
 
     Keys are processed on the calling thread of :meth:`run_until_quiet`
-    (tests) or a dispatcher thread (:meth:`start`). Reconcilers therefore
-    never race each other — matching the reference's default
-    MaxConcurrentReconciles=1 per controller semantics, with cross-
-    controller ordering serialized for determinism.
+    (tests; strictly serial, global-FIFO across controllers) or on the
+    per-controller worker pools (:meth:`start`). In both modes the
+    active/dirty sets guarantee a key never overlaps itself.
     """
 
     def __init__(
@@ -105,22 +143,31 @@ class ControllerManager:
         requeue_base_delay: float = 0.05,
         requeue_max_delay: float = 30.0,
         max_failures_logged: int = 10,
+        default_max_concurrent: int = 1,
     ):
         self.store = store
         self.clock = clock or Clock()
         self._controllers: dict[str, ReconcileFn] = {}
-        self._queue: list[tuple[str, str, str]] = []
-        self._queued: set[tuple[str, str, str]] = set()
+        self._pools: dict[str, _Pool] = {}
         self._timers: list[_Timer] = []
         self._timer_seq = 0
+        self._queue_seq = 0
+        self._active: set[tuple[str, str, str]] = set()
+        self._dirty: set[tuple[str, str, str]] = set()
         self._failures: dict[tuple[str, str, str], int] = {}
         self._lock = threading.Lock()
-        self._wakeup = threading.Event()
+        self._timer_cond = threading.Condition(self._lock)
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._started = False
+        self._timer_thread: Optional[threading.Thread] = None
         self._requeue_base = requeue_base_delay
         self._requeue_max = requeue_max_delay
         self._max_failures_logged = max_failures_logged
+        self._default_max_concurrent = max(1, int(default_max_concurrent))
+        self._per_controller_max: dict[str, int] = {}
+        #: widths pinned by register(max_concurrent=...) — these outrank
+        #: config and survive apply_config reloads
+        self._registered_max: dict[str, int] = {}
 
     # -- registration ------------------------------------------------------
 
@@ -129,13 +176,28 @@ class ControllerManager:
         name: str,
         reconcile: ReconcileFn,
         watches: dict[str, Optional[MapperFn]],
+        max_concurrent: Optional[int] = None,
     ) -> None:
         """Register a controller.
 
         watches: kind -> mapper (None = identity mapping). Every matching
         committed event enqueues the mapped keys for this controller.
+        ``max_concurrent`` pins this controller's pool width; without it
+        the config default / per-controller override applies.
         """
         self._controllers[name] = reconcile
+        if max_concurrent is not None:
+            self._registered_max[name] = max(1, int(max_concurrent))
+        with self._lock:
+            if name not in self._pools:
+                self._pools[name] = _Pool(
+                    name, self._lock, self._target_width(name)
+                )
+            else:
+                # pool may pre-exist (auto-created by an early enqueue,
+                # or a second registration sharing the name): a pinned
+                # width must take effect now, not at the next reload
+                self._pools[name].target = self._target_width(name)
 
         def on_event(ev: WatchEvent, _name=name, _watches=dict(watches)) -> None:
             mapper = _watches.get(ev.resource.kind)
@@ -143,7 +205,39 @@ class ControllerManager:
             for ns, obj_name in fn(ev):
                 self.enqueue(_name, ns, obj_name)
 
-        self.store.watch(on_event, kinds=list(watches.keys()))
+        if watches:
+            self.store.watch(on_event, kinds=list(watches.keys()))
+
+    def _target_width(self, name: str) -> int:
+        pinned = self._registered_max.get(name)
+        if pinned is not None:
+            return pinned
+        return self._per_controller_max.get(name, self._default_max_concurrent)
+
+    # -- config ------------------------------------------------------------
+
+    def apply_config(self, cfg) -> None:
+        """Adopt the live ``controllers.*`` tuning (called at startup and
+        on every ConfigMap reload — reference: ApplyRuntimeToggles,
+        controller_config.go:176). Growing a pool spawns workers on
+        demand; shrinking lets excess workers retire as they go idle."""
+        tuning = cfg.controllers
+        with self._lock:
+            self._requeue_base = tuning.requeue_base_delay
+            self._requeue_max = tuning.requeue_max_delay
+            self._default_max_concurrent = max(
+                1, int(tuning.max_concurrent_reconciles)
+            )
+            self._per_controller_max = {
+                name: max(1, int(width))
+                for name, width in (tuning.per_controller or {}).items()
+            }
+            for pool in self._pools.values():
+                pool.target = self._target_width(pool.name)
+                if self._started and pool.queue:
+                    self._spawn_workers_locked(pool)
+                # shrink: idle workers re-check target when notified
+                pool.cond.notify_all()
 
     # -- queue -------------------------------------------------------------
 
@@ -155,27 +249,66 @@ class ControllerManager:
                 heapq.heappush(
                     self._timers, _Timer(self.clock.now() + after, self._timer_seq, key)
                 )
-            elif key not in self._queued:
-                self._queued.add(key)
-                self._queue.append(key)
-        self._wakeup.set()
+                # only the timer waiter needs to recompute its sleep;
+                # no worker pool has runnable work yet
+                self._timer_cond.notify()
+            else:
+                self._enqueue_ready_locked(key)
+
+    def _enqueue_ready_locked(self, key: tuple[str, str, str]) -> None:
+        """Queue a key for immediate dispatch. MUST hold the lock.
+
+        A key currently reconciling is marked dirty instead of queued:
+        it re-dispatches exactly once after the in-flight run completes
+        (controller-runtime's processing-set semantics), so the
+        reconcile that follows observes the event's state."""
+        if key in self._active:
+            self._dirty.add(key)
+            return
+        controller, ns, name = key
+        pool = self._pools.get(controller)
+        if pool is None:
+            pool = self._pools[controller] = _Pool(
+                controller, self._lock, self._target_width(controller)
+            )
+        if (ns, name) in pool.queued:
+            return
+        pool.queued.add((ns, name))
+        self._queue_seq += 1
+        pool.queue.append((self._queue_seq, time.monotonic(), (ns, name)))
+        if self._started:
+            metrics.reconcile_queue_depth.set(len(pool.queue), controller)
+            # one notify per enqueued key: notifies sent under the lock
+            # wake DISTINCT waiters, so k keys wake k idle workers. When
+            # queued work exceeds idle waiters the surplus gets real
+            # threads — relying on notify alone can strand a key when
+            # consecutive enqueues outnumber the waiters (each extra
+            # notify is lost, and no one spawns).
+            pool.cond.notify()
+            if pool.idle < len(pool.queue):
+                self._spawn_workers_locked(pool)
 
     def _pop_due_timers_locked(self) -> None:
         now = self.clock.now()
         while self._timers and self._timers[0].due <= now:
             t = heapq.heappop(self._timers)
-            if t.key not in self._queued:
-                self._queued.add(t.key)
-                self._queue.append(t.key)
+            self._enqueue_ready_locked(t.key)
 
-    def _next(self) -> Optional[tuple[str, str, str]]:
-        with self._lock:
-            self._pop_due_timers_locked()
-            if not self._queue:
-                return None
-            key = self._queue.pop(0)
-            self._queued.discard(key)
-            return key
+    def _pump_next_locked(self) -> Optional[tuple[str, str, str]]:
+        """Serial-pump pop: the oldest queued key across all pools
+        (global FIFO order, as if there were one queue)."""
+        self._pop_due_timers_locked()
+        best: Optional[_Pool] = None
+        for pool in self._pools.values():
+            if pool.queue and (best is None or pool.queue[0][0] < best.queue[0][0]):
+                best = pool
+        if best is None:
+            return None
+        _seq, _enq_t, (ns, name) = best.queue.popleft()
+        best.queued.discard((ns, name))
+        # no gauge/latency samples here: the serial pump runs in virtual
+        # time at soak rates — dispatcher metrics are live-mode signals
+        return (best.name, ns, name)
 
     # -- dispatch ----------------------------------------------------------
 
@@ -195,6 +328,8 @@ class ControllerManager:
         except Exception:  # noqa: BLE001 - reconcile errors retry with backoff
             metrics.reconcile_total.inc(controller, "error")
             metrics.reconcile_duration.observe(time.monotonic() - started, controller)
+            # per-key counters race-free: keyed serialization means no
+            # two workers ever touch the same key's entry concurrently
             n = self._failures.get(key, 0) + 1
             self._failures[key] = n
             delay = jittered_backoff(n, self._requeue_base, self._requeue_max)
@@ -205,19 +340,33 @@ class ControllerManager:
                 )
             self.enqueue(controller, ns, name, after=delay)
 
+    def _finish_locked(self, key: tuple[str, str, str]) -> None:
+        """Retire an in-flight key; a dirty mark re-queues it once."""
+        self._active.discard(key)
+        if key in self._dirty:
+            self._dirty.discard(key)
+            self._enqueue_ready_locked(key)
+
     # -- test-mode pump ----------------------------------------------------
 
     def run_until_quiet(self, max_iterations: int = 100_000, max_virtual_seconds: float = 7 * 86400) -> int:
         """Process work until queue AND timers are exhausted.
 
-        With a ManualClock, virtual time jumps to the next timer when the
-        queue idles; with a real clock, pending timers end the pump (use
-        ``start()`` for live operation). Returns iterations processed.
+        Strictly serial on the calling thread, oldest key first across
+        every controller — identical scheduling to the pre-pool
+        dispatcher, so deterministic tests stay deterministic. With a
+        ManualClock, virtual time jumps to the next timer when the
+        queue idles; with a real clock, pending timers end the pump
+        (use ``start()`` for live operation). Returns iterations
+        processed.
         """
         processed = 0
         horizon = self.clock.now() + max_virtual_seconds
         for _ in range(max_iterations):
-            key = self._next()
+            with self._lock:
+                key = self._pump_next_locked()
+                if key is not None:
+                    self._active.add(key)
             if key is None:
                 with self._lock:
                     next_due = self._timers[0].due if self._timers else None
@@ -229,7 +378,11 @@ class ControllerManager:
                     break
                 self.clock.advance_to(next_due)
                 continue
-            self._process(key)
+            try:
+                self._process(key)
+            finally:
+                with self._lock:
+                    self._finish_locked(key)
             processed += 1
         return processed
 
@@ -237,33 +390,115 @@ class ControllerManager:
 
     def is_running(self) -> bool:
         """Readiness signal for /readyz (live dispatcher up)."""
-        return self._thread is not None and self._thread.is_alive()
+        return bool(
+            self._started
+            and self._timer_thread is not None
+            and self._timer_thread.is_alive()
+        )
 
     def start(self) -> None:
-        if self._thread is not None:
+        if self._started:
             return
         self._stop.clear()
-        self._thread = threading.Thread(target=self._loop, daemon=True, name="reconcile-dispatcher")
-        self._thread.start()
+        self._started = True
+        self._timer_thread = threading.Thread(
+            target=self._timer_loop, daemon=True, name="reconcile-timers"
+        )
+        self._timer_thread.start()
+        with self._lock:
+            for pool in self._pools.values():
+                if pool.queue:
+                    self._spawn_workers_locked(pool)
 
     def stop(self, timeout: float = 5.0) -> None:
+        if not self._started:
+            return
         self._stop.set()
-        self._wakeup.set()
-        if self._thread is not None:
-            self._thread.join(timeout)
-            self._thread = None
-
-    def _loop(self) -> None:
-        while not self._stop.is_set():
-            key = self._next()
-            if key is not None:
-                self._process(key)
-                continue
+        self._started = False
+        with self._lock:
+            self._timer_cond.notify_all()
+            for pool in self._pools.values():
+                pool.cond.notify_all()
+        if self._timer_thread is not None:
+            self._timer_thread.join(timeout)
+            self._timer_thread = None
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             with self._lock:
+                if all(p.spawned == 0 for p in self._pools.values()):
+                    return
+            time.sleep(0.005)
+
+    def _spawn_workers_locked(self, pool: _Pool) -> None:
+        """Grow a pool toward its target, one worker per queued key at
+        most (lazy: an idle controller holds no threads)."""
+        want = min(pool.target, pool.spawned + len(pool.queue))
+        while pool.spawned < want:
+            pool.spawned += 1
+            threading.Thread(
+                target=self._worker_loop, args=(pool,), daemon=True,
+                name=f"reconcile-{pool.name}-{pool.spawned}",
+            ).start()
+
+    def _worker_loop(self, pool: _Pool) -> None:
+        while True:
+            with self._lock:
+                item = None
+                while item is None:
+                    if self._stop.is_set() or pool.spawned > pool.target:
+                        pool.spawned -= 1
+                        if not self._stop.is_set() and pool.queue:
+                            # don't swallow a notify meant for work: hand
+                            # the queued key to a surviving worker (or
+                            # respawn if this was the last one)
+                            if pool.idle > 0:
+                                pool.cond.notify()
+                            else:
+                                self._spawn_workers_locked(pool)
+                        return
+                    if pool.queue:
+                        item = pool.queue.popleft()
+                        break
+                    pool.idle += 1
+                    try:
+                        notified = pool.cond.wait(timeout=5.0)
+                    finally:
+                        pool.idle -= 1
+                    if not notified and not pool.queue and not self._stop.is_set():
+                        # idle past the grace window: retire so a quiet
+                        # controller holds no threads (spawn is lazy)
+                        pool.spawned -= 1
+                        return
+                _seq, enq_t, (ns, name) = item
+                key = (pool.name, ns, name)
+                pool.queued.discard((ns, name))
+                self._active.add(key)
+                pool.busy += 1
+                metrics.reconcile_queue_depth.set(len(pool.queue), pool.name)
+                metrics.reconcile_busy_workers.set(pool.busy, pool.name)
+            metrics.reconcile_queue_latency.observe(
+                time.monotonic() - enq_t, pool.name
+            )
+            try:
+                self._process(key)
+            finally:
+                with self._lock:
+                    pool.busy -= 1
+                    metrics.reconcile_busy_workers.set(pool.busy, pool.name)
+                    self._finish_locked(key)
+
+    def _timer_loop(self) -> None:
+        """Pop due timers under the shared lock and route their keys to
+        the owning pools — enqueue notifies exactly the pools that
+        received work, so idle pools never wake on a foreign timer."""
+        while not self._stop.is_set():
+            with self._lock:
+                self._pop_due_timers_locked()
                 next_due = self._timers[0].due if self._timers else None
-            wait = 0.2 if next_due is None else max(0.0, min(next_due - self.clock.now(), 0.2))
-            self._wakeup.wait(wait if wait > 0 else 0.001)
-            self._wakeup.clear()
+                wait = 0.2 if next_due is None else max(
+                    0.001, min(next_due - self.clock.now(), 0.2)
+                )
+                self._timer_cond.wait(wait)
 
 
 def jittered_backoff(attempt: int, base: float, max_delay: float, jitter: float = 0.2) -> float:

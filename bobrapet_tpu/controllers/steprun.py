@@ -78,7 +78,9 @@ class StepRunController:
 
     # ------------------------------------------------------------------
     def reconcile(self, namespace: str, name: str) -> Optional[float]:
-        sr = self.store.try_get(STEP_RUN_KIND, namespace, name)
+        # a view: this controller never edits sr in place — every write
+        # goes through patch_status/mutate, which re-read-and-copy
+        sr = self.store.try_get_view(STEP_RUN_KIND, namespace, name)
         if sr is None:
             return None
         phase = Phase(sr.status.get("phase")) if sr.status.get("phase") else None
@@ -94,15 +96,18 @@ class StepRunController:
 
         # --- resolve engram + template (Blocked on missing refs,
         # reference: steprun_controller.go:320,374) ---
+        # read-only views: the engram/template/story chain is resolved on
+        # every reconcile and never mutated here — spec parses go through
+        # the shared cached_parse objects anyway
         engram_name = spec.engram_ref.name if spec.engram_ref else ""
-        engram = self.store.try_get(ENGRAM_KIND, namespace, engram_name)
+        engram = self.store.try_get_view(ENGRAM_KIND, namespace, engram_name)
         if engram is None:
             self._set_blocked(sr, conditions.Reason.REFERENCE_NOT_FOUND,
                               f"engram {engram_name!r} not found")
             return None
         engram_spec = parse_engram(engram)
         template_name = engram_spec.template_ref.name if engram_spec.template_ref else ""
-        template = self.store.try_get(
+        template = self.store.try_get_view(
             ENGRAM_TEMPLATE_KIND, CLUSTER_NAMESPACE, template_name
         )
         if template is None:
@@ -131,13 +136,13 @@ class StepRunController:
 
         # story context for scope + policies
         run_name = spec.story_run_ref.name if spec.story_run_ref else ""
-        storyrun = self.store.try_get(STORY_RUN_KIND, namespace, run_name)
+        storyrun = self.store.try_get_view(STORY_RUN_KIND, namespace, run_name)
         story_policy = None
         story_name = ""
         step_def = None
         if storyrun is not None:
             story_name = (storyrun.spec.get("storyRef") or {}).get("name", "")
-            story = self.store.try_get(STORY_KIND, namespace, story_name)
+            story = self.store.try_get_view(STORY_KIND, namespace, story_name)
             if story is not None:
                 story_spec = parse_story(story)
                 story_policy = story_spec.policy
@@ -297,7 +302,7 @@ class StepRunController:
         self, sr, spec, resolved, template_spec, job_name, storyrun, story_name
     ):
         namespace, name = sr.meta.namespace, sr.meta.name
-        job = self.store.try_get(JOB_KIND, namespace, job_name)
+        job = self.store.try_get_view(JOB_KIND, namespace, job_name)
         if job is None:
             # job vanished (evicted/cleaned) -> unknown exit, retry without
             # consuming budget (reference: ExitClassUnknown semantics)
@@ -317,7 +322,7 @@ class StepRunController:
 
     def _handle_success(self, sr, spec, resolved, template_spec, job):
         namespace, name = sr.meta.namespace, sr.meta.name
-        fresh = self.store.get(STEP_RUN_KIND, namespace, name)
+        fresh = self.store.get_view(STEP_RUN_KIND, namespace, name)
         # SDK-vs-controller race (reference: stepStatusPatchedBySDK:2031):
         # the SDK writes status.output directly; the controller only reads
         # it here — a job that succeeded without reporting yields {}
@@ -409,7 +414,7 @@ class StepRunController:
             return delay
 
         # terminal failure; keep SDK-reported structured error if present
-        fresh = self.store.get(STEP_RUN_KIND, namespace, name)
+        fresh = self.store.get_view(STEP_RUN_KIND, namespace, name)
         err_payload = fresh.status.get("error")
         if not err_payload:
             # applyFailureFallback (reference: :2345) — SDK died before
@@ -552,7 +557,7 @@ class StepRunController:
         step_def = None
         if storyrun is not None:
             story_name = (storyrun.spec.get("storyRef") or {}).get("name", "")
-            story = self.store.try_get(STORY_KIND, namespace, story_name)
+            story = self.store.try_get_view(STORY_KIND, namespace, story_name)
         if story is not None and spec.step_id:
             step_def = parse_story(story).step(spec.step_id)
         if step_def is not None and step_def.requires:

@@ -66,6 +66,16 @@ class StoryRunController:
         # in-memory is restart-safe because the store's pin table lives
         # in the same process and resets with us
         self._pinned: set[tuple[str, str]] = set()
+        #: (ns, name) -> (uid, generation) whose inputs passed the
+        #: oversized-inputs probe (in-memory; a restart just re-probes).
+        #: Invalidated on config reload: a lowered engram.max-inline-size
+        #: must re-probe live runs, and run specs never regenerate on
+        #: their own.
+        self._oversize_checked: dict[tuple[str, str], tuple[str, int]] = {}
+        if hasattr(config_manager, "subscribe"):
+            config_manager.subscribe(
+                lambda _cfg: self._oversize_checked.clear()
+            )
 
     # ------------------------------------------------------------------
     def reconcile(self, namespace: str, name: str) -> Optional[float]:
@@ -135,7 +145,8 @@ class StoryRunController:
                     ),
                     reason=conditions.Reason.STORY_REFERENCE_INVALID,
                 )
-        story_res = self.store.try_get(STORY_KIND, story_ns, story_name)
+        # a view: the Story is only parsed (cached) and generation-read
+        story_res = self.store.try_get_view(STORY_KIND, story_ns, story_name)
         if story_res is None:
             self._set_pending(run, conditions.Reason.STORY_NOT_FOUND,
                               f"story {story_ns}/{story_name} not found")
@@ -199,20 +210,29 @@ class StoryRunController:
         run = self._ensure_run_contracts(run, story, story_ns, story_name)
 
         # oversized-inputs guard (reference: oversized-input guard —
-        # admission normally dehydrates; double-check here)
-        max_inline = self.config_manager.config.engram.max_inline_size
-        inputs = run.spec.get("inputs") or {}
-        import json
+        # admission normally dehydrates; double-check here). Inputs live
+        # in spec, which only changes with a generation bump — the JSON
+        # size probe runs once per observed generation, not on the ~7
+        # reconciles every step of the run triggers.
+        if self._oversize_checked.get((namespace, name)) != (run.meta.uid, run.meta.generation):
+            max_inline = self.config_manager.config.engram.max_inline_size
+            inputs = run.spec.get("inputs") or {}
+            import json
 
-        if inputs and len(json.dumps(inputs, default=str)) > max_inline * 4:
-            offloaded = self.storage.dehydrate_inputs(
-                inputs, f"runs/{namespace}/{name}/inputs", max_inline_size=max_inline
-            )
+            if inputs and len(json.dumps(inputs, default=str)) > max_inline * 4:
+                offloaded = self.storage.dehydrate_inputs(
+                    inputs, f"runs/{namespace}/{name}/inputs", max_inline_size=max_inline
+                )
 
-            def swap_inputs(r: Resource) -> None:
-                r.spec["inputs"] = offloaded
+                def swap_inputs(r: Resource) -> None:
+                    r.spec["inputs"] = offloaded
 
-            run = self.store.mutate(STORY_RUN_KIND, namespace, name, swap_inputs)
+                run = self.store.mutate(STORY_RUN_KIND, namespace, name, swap_inputs)
+            if len(self._oversize_checked) > 65536:
+                self._oversize_checked.clear()  # cheap bound; re-checks are one dump
+            # uid in the key: a deleted-and-recreated run (same name,
+            # generation restarts at 1) must be re-probed
+            self._oversize_checked[(namespace, name)] = (run.meta.uid, run.meta.generation)
 
         # --- per-run RBAC identity (reference: rbac.go Reconcile:95) ---
         # Deleted/drifted SA, Role, or RoleBinding objects are repaired
@@ -225,7 +245,7 @@ class StoryRunController:
         # standing rejections disable the quick path: the fix arrives via
         # a template edit, which does not move the Story generation
         live_objs = [
-            self.store.try_get(kind, namespace, sa_name) if sa_name else None
+            self.store.try_get_view(kind, namespace, sa_name) if sa_name else None
             for kind in ("ServiceAccount", "Role", "RoleBinding")
         ]
         rbac_fresh = (
@@ -261,10 +281,13 @@ class StoryRunController:
             run = self.store.patch_status(STORY_RUN_KIND, namespace, name, record_sa)
 
         # --- DAG reconcile (engine mutates a working copy's status) ---
-        before = json.dumps(run.status, sort_keys=True, default=str)
+        # change detection against the COMMITTED status (a view): no
+        # pre-image copy, no JSON dumps — dict == short-circuits, and a
+        # mismatch with a concurrent writer just means one extra
+        # patch-if-changed round through mutate's conflict retry
+        committed = self.store.try_get_view(STORY_RUN_KIND, namespace, name)
         requeue = self.dag.run(run, story)
-        after = json.dumps(run.status, sort_keys=True, default=str)
-        if after != before:
+        if committed is not None and run.status != committed.status:
             new_status = dict(run.status)
             new_status["inputsValidated"] = True
             new_status["observedGeneration"] = run.meta.generation
@@ -334,7 +357,7 @@ class StoryRunController:
             started = now
 
         # annotate non-terminal children (their controller tears them down)
-        children = self.store.list(
+        children = self.store.list_views(
             STEP_RUN_KIND, namespace=ns, index=(INDEX_STEPRUN_STORYRUN, name)
         )
         all_terminal = True
@@ -369,7 +392,7 @@ class StoryRunController:
     def _drain_timeout(self, run: Resource) -> float:
         """(reference: transport drain timeout resolution :1700-1810)"""
         story_ref = run.spec.get("storyRef") or {}
-        story = self.store.try_get(
+        story = self.store.try_get_view(
             STORY_KIND, story_ref.get("namespace") or run.meta.namespace,
             story_ref.get("name", ""),
         )
@@ -414,7 +437,7 @@ class StoryRunController:
         from_step = target.removeprefix("from:") if target.startswith("from:") else None
 
         story_ref = run.spec.get("storyRef") or {}
-        story_res = self.store.try_get(
+        story_res = self.store.try_get_view(
             STORY_KIND, story_ref.get("namespace") or ns, story_ref.get("name", "")
         )
         affected: Optional[set[str]] = None
@@ -423,7 +446,7 @@ class StoryRunController:
             affected.add(from_step)
 
         # delete affected child StepRuns (cascade removes their Jobs)
-        for sr in self.store.list(
+        for sr in self.store.list_views(
             STEP_RUN_KIND, namespace=ns, index=(INDEX_STEPRUN_STORYRUN, name)
         ):
             step_id = sr.spec.get("stepId") or ""
@@ -484,11 +507,11 @@ class StoryRunController:
 
         if now - finished >= children_ttl and not run.status.get("childrenCleanedAt"):
             sweep_started = time.monotonic()
-            for sr in self.store.list(
+            for _sr_ns, sr_name in self.store.list_keys(
                 STEP_RUN_KIND, namespace=ns, index=(INDEX_STEPRUN_STORYRUN, name)
             ):
                 try:
-                    self.store.delete(STEP_RUN_KIND, ns, sr.meta.name)
+                    self.store.delete(STEP_RUN_KIND, ns, sr_name)
                     metrics.cleanup_ops.inc("steprun")
                 except NotFound:
                     pass
@@ -530,12 +553,10 @@ def _validate_inputs(inputs: dict[str, Any], schema: dict[str, Any]) -> Optional
 def _transitive_dependents(story, from_step: str) -> set[str]:
     """Steps that (transitively) depend on from_step
     (explicit needs + mined template refs)."""
-    from ..templating.engine import Evaluator
-
     deps: dict[str, set[str]] = {}
     for s in story.steps:
         d = set(s.needs)
-        d |= Evaluator.find_step_references({"with": s.with_, "if": s.if_})
+        d |= s.template_step_refs()
         deps[s.name] = d
     out: set[str] = set()
     changed = True

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import copy
 import dataclasses
+import itertools
 import time
 import uuid
 from typing import Any, Optional
@@ -159,15 +160,13 @@ class Resource:
     def has_owner(self, owner: "Resource") -> bool:
         return any(o.uid == owner.meta.uid for o in self.meta.owner_references)
 
-    def deepcopy(self) -> "Resource":
-        """Isolation copy for every store read/write boundary.
-
-        The hottest call in the control plane (hundreds per run):
-        generic ``copy.deepcopy`` spends most of its time in memo
-        bookkeeping and type dispatch, so spec/status — JSON-ish trees
-        by construction — take a specialized walk instead (~6x faster);
-        non-JSON leaves (rare: tuples, arrays) fall back to deepcopy.
-        """
+    def copy_shell(self) -> "Resource":
+        """Copy of the resource with OWN metadata but spec/status still
+        aliasing this object's. The store's write paths build successor
+        versions from the committed object this way: whichever of
+        spec/status the write replaces gets a fresh _fast_copy, and the
+        other is SHARED between the two committed versions — safe
+        because committed objects are never edited in place."""
         meta = self.meta
         # copy.copy stays field-agnostic like dataclasses.replace (the
         # whole __dict__ carries over, so fields added later survive
@@ -179,10 +178,23 @@ class Resource:
         new_meta.annotations = dict(meta.annotations)
         new_meta.finalizers = list(meta.finalizers)
         new_meta.owner_references = [
-            copy.copy(o) for o in meta.owner_references
+            OwnerReference(o.kind, o.name, o.uid, o.controller)
+            for o in meta.owner_references
         ]
         new = copy.copy(self)
         new.meta = new_meta
+        return new
+
+    def deepcopy(self) -> "Resource":
+        """Isolation copy for every store read/write boundary.
+
+        The hottest call in the control plane (hundreds per run):
+        generic ``copy.deepcopy`` spends most of its time in memo
+        bookkeeping and type dispatch, so spec/status — JSON-ish trees
+        by construction — take a specialized walk instead (~6x faster);
+        non-JSON leaves (rare: tuples, arrays) fall back to deepcopy.
+        """
+        new = self.copy_shell()
         new.spec = _fast_copy(self.spec)
         new.status = _fast_copy(self.status)
         return new
@@ -227,8 +239,16 @@ def new_resource(
     )
 
 
+#: per-process random prefix + counter: uid allocation sits on the
+#: object-create hot path, and a urandom syscall per uuid4 was visible
+#: at soak scale; the prefix keeps uids unique across processes and
+#: restarts, the counter within one
+_UID_PREFIX = uuid.uuid4().hex[:12]
+_UID_COUNTER = itertools.count(1)
+
+
 def fresh_uid() -> str:
-    return str(uuid.uuid4())
+    return f"{_UID_PREFIX}-{next(_UID_COUNTER):012x}"
 
 
 def now() -> float:

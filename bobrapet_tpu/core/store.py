@@ -221,9 +221,12 @@ class ResourceStore:
                         return
                     ev = self._pending_events.popleft()
                     watchers = list(self._watchers)
-                # One copy per event, made outside the lock; committed
-                # objects are immutable so this is safe.
-                payload = WatchEvent(ev.type, ev.resource.deepcopy())
+                # Handlers share the committed object (a view): committed
+                # resources are never edited in place, and every handler
+                # treats events as read-only — mutations go back through
+                # store APIs, which copy at the write boundary. The old
+                # one-deepcopy-per-event was the bus's largest fixed cost.
+                payload = ev
                 for kinds, handler in watchers:
                     if kinds is None or ev.resource.kind in kinds:
                         try:
@@ -245,20 +248,76 @@ class ResourceStore:
 
     # -- reads -------------------------------------------------------------
     def get(self, kind: str, namespace: str, name: str) -> Resource:
-        with self._lock:
-            obj = self._objects.get((kind, namespace, name))
-            if obj is None:
-                raise NotFound(kind, namespace, name)
         # Committed resources are never mutated in place (writes replace
         # whole objects), so copying outside the lock is safe and keeps
         # copy cost off the global critical section.
-        return obj.deepcopy()
+        return self.get_view(kind, namespace, name).deepcopy()
 
     def try_get(self, kind: str, namespace: str, name: str) -> Optional[Resource]:
         try:
             return self.get(kind, namespace, name)
         except NotFound:
             return None
+
+    # -- snapshot views (copy-on-write reads) ------------------------------
+    #
+    # Committed objects are immutable by construction: every write path
+    # builds a NEW Resource and swaps it in, never editing in place. A
+    # *view* hands the committed object out directly — no deepcopy — for
+    # the read-only hot paths (child syncs, spec resolution, priority
+    # scans) where per-reconcile isolation copies were the control
+    # plane's dominant linear cost (BASELINE.md). Contract: a view MUST
+    # NOT be mutated; writers keep using get()/mutate(), whose
+    # write-boundary _fast_copy makes any aliased subtree independent
+    # the moment it is committed.
+
+    def get_view(self, kind: str, namespace: str, name: str) -> Resource:
+        """The committed object itself, no isolation copy. READ-ONLY."""
+        with self._lock:
+            obj = self._objects.get((kind, namespace, name))
+            if obj is None:
+                raise NotFound(kind, namespace, name)
+            return obj
+
+    def try_get_view(self, kind: str, namespace: str, name: str) -> Optional[Resource]:
+        with self._lock:
+            return self._objects.get((kind, namespace, name))
+
+    def list_views(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        labels: Optional[dict[str, str]] = None,
+        index: Optional[tuple[str, str]] = None,
+    ) -> list[Resource]:
+        """list() without the per-object deepcopy. READ-ONLY results."""
+        with self._lock:
+            if index is not None:
+                candidates = [
+                    self._objects[k]
+                    for k in self._index_keys_locked(kind, index)
+                ]
+            else:
+                if labels:
+                    from ..observability.metrics import metrics
+
+                    metrics.index_fallbacks.inc(kind)
+                candidates = [o for (k, _, _), o in self._objects.items() if k == kind]
+            picked = [
+                obj
+                for obj in candidates
+                if obj.kind == kind
+                and (namespace is None or obj.meta.namespace == namespace)
+                and not (
+                    labels
+                    and any(
+                        obj.meta.labels.get(lk) != lv
+                        for lk, lv in labels.items()
+                    )
+                )
+            ]
+        picked.sort(key=lambda o: (o.meta.namespace, o.meta.name))
+        return picked
 
     def _index_keys_locked(
         self, kind: str, index: Optional[tuple[str, str]]
@@ -283,33 +342,11 @@ class ResourceStore:
         labels: Optional[dict[str, str]] = None,
         index: Optional[tuple[str, str]] = None,
     ) -> list[Resource]:
-        """List by kind, optionally filtered by namespace/labels/index value."""
-        with self._lock:
-            picked = []
-            if index is not None:
-                candidates = [
-                    self._objects[k]
-                    for k in self._index_keys_locked(kind, index)
-                ]
-            else:
-                if labels:
-                    # label-filtered full scan — the no-index path the
-                    # reference counts as an index fallback
-                    from ..observability.metrics import metrics
-
-                    metrics.index_fallbacks.inc(kind)
-                candidates = [o for (k, _, _), o in self._objects.items() if k == kind]
-            for obj in candidates:
-                if obj.kind != kind:
-                    continue
-                if namespace is not None and obj.meta.namespace != namespace:
-                    continue
-                if labels and any(obj.meta.labels.get(lk) != lv for lk, lv in labels.items()):
-                    continue
-                picked.append(obj)
-        out = [obj.deepcopy() for obj in picked]
-        out.sort(key=lambda o: (o.meta.namespace, o.meta.name))
-        return out
+        """List by kind, optionally filtered by namespace/labels/index
+        value. Same selection as :meth:`list_views` (ONE filter
+        implementation), plus per-object isolation copies made outside
+        the lock."""
+        return [obj.deepcopy() for obj in self.list_views(kind, namespace, labels, index)]
 
     def count(
         self,
@@ -388,7 +425,11 @@ class ResourceStore:
                 raise NotFound(*key)
             if obj.meta.resource_version != cur.meta.resource_version:
                 raise Conflict(*key, obj.meta.resource_version, cur.meta.resource_version)
-            new = cur.deepcopy()
+            # shell copy: only the subresource being written is copied;
+            # a status-only update SHARES the committed spec with its
+            # predecessor (copy-on-write — committed objects are never
+            # edited in place, so aliasing across versions is safe)
+            new = cur.copy_shell()
             if status_only:
                 new.status = _fast_copy(obj.status)
                 for fn in self._status_validators.get(new.kind, []):
@@ -438,7 +479,7 @@ class ResourceStore:
             if cur.meta.finalizers:
                 if cur.meta.deletion_timestamp is None:
                     old = cur
-                    cur = cur.deepcopy()
+                    cur = cur.copy_shell()  # meta-only change; spec/status shared
                     cur.meta.deletion_timestamp = now()
                     self._rv_counter += 1
                     cur.meta.resource_version = self._rv_counter
@@ -474,7 +515,7 @@ class ResourceStore:
             if child.meta.finalizers:
                 if child.meta.deletion_timestamp is None:
                     old_child = child
-                    child = child.deepcopy()
+                    child = child.copy_shell()  # meta-only change
                     child.meta.deletion_timestamp = now()
                     self._rv_counter += 1
                     child.meta.resource_version = self._rv_counter
@@ -501,13 +542,14 @@ class ResourceStore:
         (reference: pkg/kubeutil/retry.go retry-on-conflict)."""
         last: Optional[Conflict] = None
         for _ in range(max_attempts):
-            cur = self.get(kind, namespace, name)
-            before = cur.deepcopy()
+            committed = self.get_view(kind, namespace, name)
+            cur = committed.deepcopy()
             fn(cur)
-            if cur == before:
+            if cur == committed:
                 # patch-if-changed: a no-op write emits no event, so
                 # status-refreshing controllers that watch their own kind
-                # converge instead of looping
+                # converge instead of looping — detected against the
+                # committed object itself, no pre-image copy needed
                 # (reference: PatchStatusIfChanged pkg/reconcile/status.go:17)
                 return cur
             try:

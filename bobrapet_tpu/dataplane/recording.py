@@ -102,6 +102,10 @@ class StreamRecorder:
         ] = {}
         #: stream -> retention seconds (for the sweep)
         self._retention: dict[str, Optional[float]] = {}
+        #: stream -> count of segments ever written (under the lock);
+        #: lets the sweep prove "no segment landed since my listing"
+        #: without holding the lock across a store round trip
+        self._segment_epoch: dict[str, int] = {}
 
     # -- write path --------------------------------------------------------
 
@@ -140,6 +144,7 @@ class StreamRecorder:
                 self._write_segment(stream, pend)
 
     def _write_segment(self, stream: str, entries: list) -> None:
+        self._segment_epoch[stream] = self._segment_epoch.get(stream, 0) + 1
         first = entries[0][0]
         lines = [
             json.dumps({
@@ -206,6 +211,7 @@ class StreamRecorder:
         removed = 0
         with self._lock:
             retentions = dict(self._retention)
+            epochs = dict(self._segment_epoch)
         for stream, retention in retentions.items():
             remaining = 0
             if retention:
@@ -222,15 +228,19 @@ class StreamRecorder:
                 # fully swept (or never-segmented) stream: drop its
                 # bookkeeping so run-scoped stream names don't grow the
                 # maps — and sweep() cost — monotonically across runs.
-                # Re-check BOTH pending and the store listing under the
-                # lock: a flush between the sweep's listing and here
-                # would otherwise orphan its fresh segment from
-                # retention forever (record()/flush() hold this lock
-                # while writing segments, so the re-list is race-free).
+                # The re-check holds the lock only for in-memory state
+                # (the old under-lock store.list() blocked record()/
+                # flush() for a full S3 round trip): pending must be
+                # empty AND the segment epoch unchanged since before
+                # this stream's listing — segments are only written
+                # under the lock, so an unchanged epoch proves the
+                # (lock-free) listing is still authoritative and no
+                # fresh segment can be orphaned from retention.
                 with self._lock:
                     if (not self._pending.get(stream)
-                            and not (retention and self.store.list(
-                                f"{self.prefix}/{stream}/"))):
+                            and self._segment_epoch.get(stream, 0)
+                            == epochs.get(stream, 0)):
                         self._pending.pop(stream, None)
                         self._retention.pop(stream, None)
+                        self._segment_epoch.pop(stream, None)
         return removed

@@ -480,6 +480,25 @@ class _ControlPlaneMetrics:
         self.mapper_failures = c(
             "bobrapet_mapper_failures_total", "Watch-mapper errors", ["controller"]
         )
+        # Per-controller dispatcher (reference: workqueue_depth /
+        # workqueue_queue_duration_seconds / active_workers, the
+        # controller-runtime workqueue families)
+        self.reconcile_queue_depth = g(
+            "bobrapet_reconcile_queue_depth",
+            "Keys waiting in a controller's work queue",
+            ["controller"],
+        )
+        self.reconcile_busy_workers = g(
+            "bobrapet_reconcile_busy_workers",
+            "Reconciles in flight per controller pool",
+            ["controller"],
+        )
+        self.reconcile_queue_latency = h(
+            "bobrapet_reconcile_queue_latency_seconds",
+            "Enqueue-to-dequeue wait per controller",
+            ["controller"],
+            buckets=(0.0001, 0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+        )
 
 
 metrics = _ControlPlaneMetrics(REGISTRY)

@@ -1,0 +1,36 @@
+"""jax version compatibility for the parallel layer.
+
+One shim, shared by ring_attention and ulysses, so jax-compat fixes
+cannot drift between the two attention implementations.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    from jax import shard_map
+except ImportError:  # jax<0.6: experimental namespace + check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):  # type: ignore[no-redef]
+        # check_vma does NOT translate to check_rep on old jax: the
+        # callers mark their carries varying via pcast/pvary, which
+        # don't exist pre-0.5 (to_varying no-ops), so rep-checking
+        # there rejects the loop carries ("mismatched replication
+        # types"). Old jax gets check_rep=False — numerics are
+        # unaffected; only the newer-jax transpose-resharding guard is
+        # lost, which pre-vma jax didn't implement anyway.
+        return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False, **kw)
+
+
+def to_varying(x, axes):
+    """Mark an unvarying value as device-varying over ``axes``
+    (jax>=0.9 pcast; pvary on 0.5-0.8; a no-op before vma tracking
+    existed — there check_rep owns replication bookkeeping)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axes)
+    return x

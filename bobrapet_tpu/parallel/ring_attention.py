@@ -18,18 +18,11 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .compat import shard_map, to_varying as _to_varying
+
 NEG_INF = -1e30
-
-
-def _to_varying(x, axes):
-    """Mark an unvarying value as device-varying over ``axes``
-    (jax>=0.9 pcast; pvary on older versions)."""
-    if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, axes, to="varying")
-    return jax.lax.pvary(x, axes)
 
 
 def _block_attention(q, k, q_pos, k_pos, causal: bool):

@@ -25,8 +25,9 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .compat import shard_map
 
 from ..ops.attention import attention_reference
 

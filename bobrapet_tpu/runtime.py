@@ -211,6 +211,12 @@ class Runtime:
             self.workload_simulator = WorkloadSimulator(self.store, clock=self.clock)
 
         self.manager = ControllerManager(self.store, clock=self.clock)
+        # per-controller pool widths (controllers.max-concurrent-reconciles
+        # + controllers.<name>.max-concurrent-reconciles) follow the live
+        # config, including ConfigMap reloads (reference: controller
+        # Options wiring, cmd/main.go:650-769)
+        self.manager.apply_config(self.config_manager.config)
+        self.config_manager.subscribe(self.manager.apply_config)
         # timed re-probes so warmup-gated readiness self-completes
         if self.workload_simulator is not None:
             self.workload_simulator.attach(self.manager)
@@ -640,8 +646,12 @@ class Runtime:
                 )
             ]
 
+        # SAME controller name as the batch registration: realtime watch
+        # sources must map into the one "steprun" pool — a second name
+        # would give the same StepRun two dispatch keys and let two
+        # workers reconcile it concurrently, breaking keyed serialization
         m.register(
-            "steprun-realtime",
+            "steprun",
             self.steprun_controller.reconcile,
             watches={
                 TRANSPORT_BINDING_KIND: owned_to_steprun,

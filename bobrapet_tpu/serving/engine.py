@@ -106,6 +106,7 @@ class ServingEngine:
                  spec_k: int = 4,
                  spec_guard: bool = True,
                  spec_guard_ticks: int = 6,
+                 spec_guard_margin: float = 0.05,
                  pipeline_decode: bool = True):
         self.params = params
         self.cfg = cfg
@@ -211,6 +212,14 @@ class ServingEngine:
         # representative batch shape (the bench does).
         self.spec_guard = spec_guard
         self.spec_guard_ticks = spec_guard_ticks
+        # The guard's "plain" arm runs through _plain_with_draft_sync
+        # (it must keep the draft pools mirrored), which is
+        # systematically SLOWER than the real pipelined plain path the
+        # engine uses once speculation is off — so the raw comparison
+        # is biased toward keeping speculation on. The margin makes
+        # spec beat plain by a factor before it survives, and the
+        # decision record carries the bias so near-ties read correctly.
+        self.spec_guard_margin = spec_guard_margin
         self.spec_active = draft_params is not None
         self.spec_guard_decision: Optional[dict] = None
         self._guard_samples: dict[str, list[float]] = {"spec": [], "plain": []}
@@ -770,7 +779,7 @@ class ServingEngine:
         plain_rate = median(
             [s for s in self._guard_samples["plain"] if s > 0]
         )
-        keep = spec_rate >= plain_rate
+        keep = spec_rate >= plain_rate * (1.0 + self.spec_guard_margin)
         self.spec_active = keep
         self.spec_guard_decision = {
             "active": keep,
@@ -780,6 +789,11 @@ class ServingEngine:
                 self.spec_accepted / max(1, self.spec_drafted), 3
             ),
             "spec_k": self.spec_k,
+            # measurement bias disclosure: "plain" here is the
+            # draft-synced plain tick, which understates the real
+            # (pipelined, draft-free) plain path — margin compensates
+            "margin": self.spec_guard_margin,
+            "plain_measured_via": "plain_with_draft_sync",
         }
         metrics.serving_spec_active.set(1.0 if keep else 0.0)
 

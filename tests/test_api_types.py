@@ -258,3 +258,84 @@ class TestEngramImpulse:
         assert e.with_config == {"model": "8b"}
         assert e.to_dict()["with"] == {"model": "8b"}
         assert e.mode is WorkloadMode.JOB
+
+
+class TestParseCacheDebug:
+    """BOBRA_PARSE_CACHE_DEBUG: a consumer that mutates a shared
+    cached_parse object in place is caught at the next cache hit."""
+
+    def test_mutation_caught_on_hit(self, monkeypatch):
+        from bobrapet_tpu.api import specbase
+        from bobrapet_tpu.api.story import Step
+
+        monkeypatch.setattr(specbase, "PARSE_CACHE_DEBUG", True)
+        spec = {"name": "dbg-step", "type": "condition",
+                "with": {"marker": "parse-cache-debug-test"}}
+        parsed = specbase.cached_parse(Step, dict(spec))
+        # clean hit passes
+        assert specbase.cached_parse(Step, dict(spec)) is parsed
+        parsed.with_["marker"] = "poisoned"  # the bug class under test
+        import pytest as _pytest
+        with _pytest.raises(specbase.SharedParseMutated):
+            specbase.cached_parse(Step, dict(spec))
+        parsed.with_["marker"] = "parse-cache-debug-test"  # restore
+
+    def test_identity_hit_returns_same_object(self):
+        from bobrapet_tpu.api import specbase
+        from bobrapet_tpu.api.story import Step
+
+        spec = {"name": "id-step", "type": "condition"}
+        a = specbase.cached_parse(Step, spec)
+        assert specbase.cached_parse(Step, spec) is a  # id fast path
+        assert specbase.cached_parse(Step, dict(spec)) is a  # content path
+
+
+class TestStepStateFastPathParity:
+    """StepState.from_dict/to_dict are hand-rolled for the DAG hot
+    path; they must stay field-for-field equivalent to the generic
+    SpecBase walk, or a future StepState field silently vanishes."""
+
+    SAMPLE = {
+        "phase": "Running", "reason": "r", "message": "m",
+        "startedAt": 1.5, "finishedAt": 2.5, "retries": 2,
+        "output": {"a": [1, {"b": 2}]}, "outputRef": {"key": "k"},
+        "signals": {"s": 1}, "exitCode": 3, "exitClass": "retry",
+    }
+
+    def test_roundtrip_matches_generic_walk(self):
+        import dataclasses
+
+        from bobrapet_tpu.api.runs import StepState
+        from bobrapet_tpu.api.specbase import SpecBase
+
+        fast = StepState.from_dict(dict(self.SAMPLE))
+        generic = SpecBase.from_dict.__func__(StepState, dict(self.SAMPLE))
+        assert fast == generic
+        assert fast.to_dict() == SpecBase.to_dict(fast)
+        # every dataclass field is covered by the hand-rolled pair: a
+        # new field must appear in the round-trip or this fails
+        full = StepState(**{
+            f.name: getattr(fast, f.name) for f in dataclasses.fields(StepState)
+        })
+        assert set(full.to_dict()) >= {
+            "phase", "reason", "message", "startedAt", "finishedAt",
+            "retries", "output", "outputRef", "signals", "exitCode",
+            "exitClass",
+        }
+
+    def test_every_field_survives_roundtrip(self):
+        import dataclasses
+
+        from bobrapet_tpu.api.runs import StepState
+
+        parsed = StepState.from_dict(dict(self.SAMPLE))
+        back = StepState.from_dict(parsed.to_dict())
+        assert parsed == back
+        # the hand-rolled serializers must know every declared field
+        untouched = [
+            f.name for f in dataclasses.fields(StepState)
+            if getattr(parsed, f.name) is None
+        ]
+        assert untouched == [], (
+            f"fields not exercised by SAMPLE (add them): {untouched}"
+        )

@@ -1,0 +1,115 @@
+"""Chart <-> code webhook drift (ADVICE r5).
+
+`cluster/admission.webhook_configurations()` builds the Mutating/
+Validating WebhookConfiguration manifests from what is actually
+registered on the store — the chart's `webhooks.yaml` is the
+hand-maintained Service-based mirror of the same list. Like the
+schema<->webhook parity suite (test_admission_parity.py), this renders
+the chart template and diffs webhook names, paths, and rules against
+the code-built configurations, so adding a webhook chain without
+updating the chart (or vice versa) fails here instead of shipping a
+cluster that silently skips admission for a kind.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+import yaml
+
+from bobrapet_tpu.cluster.admission import webhook_configurations
+from bobrapet_tpu.runtime import Runtime
+
+CHART = os.path.join(
+    os.path.dirname(__file__), "..",
+    "deploy", "chart", "bobrapet-tpu", "templates", "webhooks.yaml",
+)
+PORT = "9443"
+
+
+def render_chart() -> dict[str, dict]:
+    """Poor-man's helm template: drop control directives, substitute the
+    few values the webhook template consumes, parse the YAML stream."""
+    with open(CHART) as f:
+        text = f.read()
+    text = "\n".join(
+        line for line in text.splitlines()
+        if not line.strip().startswith("{{-")
+    )
+    text = (
+        text.replace("{{ .Release.Name }}", "rel")
+        .replace("{{ .Release.Namespace }}", "ns")
+        .replace("{{ .Values.webhooks.port }}", PORT)
+    )
+    docs = [d for d in yaml.safe_load_all(text) if d]
+    return {
+        d["kind"]: d
+        for d in docs
+        if d["kind"].endswith("WebhookConfiguration")
+    }
+
+
+@pytest.fixture(scope="module")
+def chart_configs():
+    return render_chart()
+
+
+@pytest.fixture(scope="module")
+def code_configs():
+    rt = Runtime()
+    return {
+        c["kind"]: c
+        for c in webhook_configurations(
+            rt.store, f"https://host:{PORT}", "test-ca"
+        )
+    }
+
+
+CONFIG_KINDS = ["MutatingWebhookConfiguration", "ValidatingWebhookConfiguration"]
+
+
+class TestChartWebhookDrift:
+    def test_both_configuration_kinds_exist_in_both(self, chart_configs, code_configs):
+        assert set(chart_configs) == set(CONFIG_KINDS)
+        assert set(code_configs) == set(CONFIG_KINDS)
+
+    @pytest.mark.parametrize("kind", CONFIG_KINDS)
+    def test_webhook_names_match(self, chart_configs, code_configs, kind):
+        chart = {w["name"] for w in chart_configs[kind]["webhooks"]}
+        code = {w["name"] for w in code_configs[kind]["webhooks"]}
+        assert chart == code, (
+            f"{kind} drifted: chart-only={sorted(chart - code)}, "
+            f"code-only={sorted(code - chart)} — update "
+            f"deploy/chart/bobrapet-tpu/templates/webhooks.yaml or the "
+            f"registered admission chain"
+        )
+
+    @pytest.mark.parametrize("kind", CONFIG_KINDS)
+    def test_paths_and_rules_match(self, chart_configs, code_configs, kind):
+        chart = {w["name"]: w for w in chart_configs[kind]["webhooks"]}
+        code = {w["name"]: w for w in code_configs[kind]["webhooks"]}
+        for name in sorted(set(chart) & set(code)):
+            # chart uses Service client config, code uses URL mode: the
+            # request path must be identical either way
+            chart_path = chart[name]["clientConfig"]["service"]["path"]
+            code_path = code[name]["clientConfig"]["url"].split(PORT, 1)[1]
+            assert chart_path == code_path, (
+                f"{name}: chart serves {chart_path}, code expects {code_path}"
+            )
+            chart_rule = chart[name]["rules"][0]
+            code_rule = code[name]["rules"][0]
+            for field in ("apiGroups", "apiVersions", "operations", "resources"):
+                assert sorted(chart_rule[field]) == sorted(code_rule[field]), (
+                    f"{name}: rule field {field} drifted "
+                    f"({chart_rule[field]} vs {code_rule[field]})"
+                )
+
+    @pytest.mark.parametrize("kind", CONFIG_KINDS)
+    def test_chart_webhooks_fail_closed(self, chart_configs, kind):
+        """Every chart webhook keeps failurePolicy: Fail and sideEffects:
+        None — the posture the code-built configurations pin."""
+        for w in chart_configs[kind]["webhooks"]:
+            assert w["failurePolicy"] == "Fail", w["name"]
+            assert w["sideEffects"] == "None", w["name"]
+            assert w["admissionReviewVersions"] == ["v1"], w["name"]

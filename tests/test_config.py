@@ -46,10 +46,33 @@ class TestParseConfig:
         cfg = parse_config({"engram.grpc-port": "not-a-port", "unknown.key": "x"})
         assert cfg.engram.grpc_port == 50051
 
+    def test_per_controller_max_concurrent_reconciles(self):
+        cfg = parse_config({
+            "controllers.max-concurrent-reconciles": "2",
+            "controllers.steprun.max-concurrent-reconciles": "16",
+            "controllers.storyrun.max-concurrent-reconciles": "8",
+        })
+        assert cfg.controllers.max_concurrent_reconciles == 2
+        assert cfg.controllers.per_controller == {"steprun": 16, "storyrun": 8}
+
+    def test_per_controller_invalid_value_ignored(self):
+        cfg = parse_config({
+            "controllers.steprun.max-concurrent-reconciles": "lots",
+        })
+        assert cfg.controllers.per_controller == {}
+
     def test_validation(self):
         cfg = OperatorConfig()
         cfg.reference_cross_namespace_policy = "maybe"
         assert any("referenceCrossNamespacePolicy" in e for e in cfg.validate())
+
+    def test_validation_rejects_nonpositive_pool_width(self):
+        cfg = OperatorConfig()
+        cfg.controllers.per_controller = {"steprun": 0}
+        assert any(
+            "controllers.steprun.max-concurrent-reconciles" in e
+            for e in cfg.validate()
+        )
 
 
 class TestLiveReload:

@@ -611,7 +611,9 @@ class TestReplay:
 
 def _make_ca(tmp_path, name: str):
     """Shared-CA material via the in-tree dev generator (one layout
-    for tests, bench, and docs)."""
+    for tests, bench, and docs). Needs the cryptography package; on
+    images without it the TLS capability cannot run — skip, not fail."""
+    pytest.importorskip("cryptography")
     from bobrapet_tpu.dataplane.tls import generate_dev_ca
 
     return generate_dev_ca(str(tmp_path), name)

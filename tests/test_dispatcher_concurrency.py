@@ -1,0 +1,384 @@
+"""Per-controller dispatch: pool isolation, keyed serialization, and
+pump parity (ISSUE 1 tentpole).
+
+The reference gives every controller its own worker pool sized by
+``controller.Options.MaxConcurrentReconciles`` (cmd/main.go:650-769);
+these tests pin the properties that replacement must preserve:
+
+- a blocked controller cannot head-of-line-block its peers;
+- ``controllers.max-concurrent-reconciles`` (and the per-controller
+  ``controllers.<name>.max-concurrent-reconciles`` override) is
+  actually consumed: N distinct keys reconcile concurrently;
+- one KEY never overlaps itself, and an event arriving mid-reconcile
+  triggers exactly one follow-up run (workqueue dirty semantics);
+- the ManualClock test pump is unchanged: serial, deterministic,
+  virtual-time-advancing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from bobrapet_tpu.config.operator import OperatorConfig, parse_config
+from bobrapet_tpu.controllers.manager import Clock, ControllerManager, ManualClock
+from bobrapet_tpu.core.store import ResourceStore
+
+
+def wait_for(cond, timeout=10.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_manager(**per_controller) -> ControllerManager:
+    m = ControllerManager(ResourceStore(), clock=Clock())
+    cfg = OperatorConfig()
+    cfg.controllers.max_concurrent_reconciles = 1
+    cfg.controllers.per_controller = dict(per_controller)
+    m.apply_config(cfg)
+    return m
+
+
+class TestPoolIsolation:
+    def test_blocked_controller_does_not_starve_peers(self):
+        """Controller 'slow' parks on an event while 'fast' must keep
+        draining its own queue — the exact head-of-line-blocking the
+        single-dispatcher design suffered."""
+        release = threading.Event()
+        slow_started = threading.Event()
+        fast_done: list[str] = []
+
+        def slow(ns, name):
+            slow_started.set()
+            assert release.wait(10.0)
+            return None
+
+        def fast(ns, name):
+            fast_done.append(name)
+            return None
+
+        m = make_manager()
+        m.register("slow", slow, watches={})
+        m.register("fast", fast, watches={})
+        m.start()
+        try:
+            m.enqueue("slow", "default", "blocker")
+            assert wait_for(slow_started.is_set)
+            for i in range(10):
+                m.enqueue("fast", "default", f"k{i}")
+            assert wait_for(lambda: len(fast_done) == 10), fast_done
+            assert not release.is_set()  # slow is STILL parked
+        finally:
+            release.set()
+            m.stop()
+
+    def test_config_width_runs_n_distinct_keys_concurrently(self):
+        """With controllers.max-concurrent-reconciles=N, N reconciles of
+        distinct keys overlap (a barrier only opens once N arrive)."""
+        n = 4
+        barrier = threading.Barrier(n, timeout=10.0)
+        peak = []
+
+        def fanout(ns, name):
+            barrier.wait()  # deadlocks unless n run CONCURRENTLY
+            peak.append(name)
+            return None
+
+        m = ControllerManager(ResourceStore(), clock=Clock())
+        cfg = parse_config({"controllers.max-concurrent-reconciles": str(n)})
+        m.apply_config(cfg)
+        m.register("fanout", fanout, watches={})
+        m.start()
+        try:
+            for i in range(n):
+                m.enqueue("fanout", "default", f"k{i}")
+            assert wait_for(lambda: len(peak) == n)
+        finally:
+            m.stop()
+
+    def test_per_controller_override_key_wins(self):
+        """controllers.<name>.max-concurrent-reconciles overrides the
+        global default for that controller only."""
+        cfg = parse_config({
+            "controllers.max-concurrent-reconciles": "1",
+            "controllers.wide.max-concurrent-reconciles": "3",
+        })
+        assert cfg.controllers.per_controller == {"wide": 3}
+
+        barrier = threading.Barrier(3, timeout=10.0)
+        wide_done: list[str] = []
+        narrow_overlap = []
+        narrow_in_flight = threading.Semaphore(0)
+        narrow_running = []
+
+        def wide(ns, name):
+            barrier.wait()
+            wide_done.append(name)
+            return None
+
+        def narrow(ns, name):
+            narrow_running.append(name)
+            if len(narrow_running) > 1:
+                narrow_overlap.append(name)
+            time.sleep(0.02)
+            narrow_running.remove(name)
+            narrow_in_flight.release()
+            return None
+
+        m = ControllerManager(ResourceStore(), clock=Clock())
+        m.apply_config(cfg)
+        m.register("wide", wide, watches={})
+        m.register("narrow", narrow, watches={})
+        m.start()
+        try:
+            for i in range(3):
+                m.enqueue("wide", "default", f"w{i}")
+                m.enqueue("narrow", "default", f"n{i}")
+            assert wait_for(lambda: len(wide_done) == 3)
+            for _ in range(3):
+                assert narrow_in_flight.acquire(timeout=10.0)
+            # the width-1 pool never ran two keys at once
+            assert narrow_overlap == []
+        finally:
+            m.stop()
+
+    def test_live_reload_grows_pool(self):
+        """apply_config mid-flight widens a pool: a second batch that
+        needs 3-way concurrency passes after the reload."""
+        m = make_manager()
+        barrier = threading.Barrier(3, timeout=10.0)
+        done = []
+
+        def fn(ns, name):
+            barrier.wait()
+            done.append(name)
+            return None
+
+        m.register("growme", fn, watches={})
+        cfg = OperatorConfig()
+        cfg.controllers.per_controller = {"growme": 3}
+        m.apply_config(cfg)
+        m.start()
+        try:
+            for i in range(3):
+                m.enqueue("growme", "default", f"g{i}")
+            assert wait_for(lambda: len(done) == 3)
+        finally:
+            m.stop()
+
+
+class TestKeyedSerialization:
+    def test_same_key_never_overlaps_and_dirty_runs_once(self):
+        """An event for a key that is mid-reconcile must not start a
+        second reconcile of that key; it must schedule EXACTLY one
+        follow-up run after the in-flight one completes."""
+        in_flight = []
+        overlaps = []
+        runs = []
+        first_entered = threading.Event()
+        release_first = threading.Event()
+        lock = threading.Lock()
+
+        def fn(ns, name):
+            with lock:
+                if in_flight:
+                    overlaps.append(name)
+                in_flight.append(name)
+                runs.append(time.monotonic())
+            if len(runs) == 1:
+                first_entered.set()
+                assert release_first.wait(10.0)
+            with lock:
+                in_flight.remove(name)
+            return None
+
+        m = make_manager(serial=4)  # width > 1: serialization must be keyed
+        m.register("serial", fn, watches={})
+        m.start()
+        try:
+            m.enqueue("serial", "default", "hot")
+            assert wait_for(first_entered.is_set)
+            # three events land mid-reconcile: dedupe to ONE follow-up
+            m.enqueue("serial", "default", "hot")
+            m.enqueue("serial", "default", "hot")
+            m.enqueue("serial", "default", "hot")
+            time.sleep(0.05)
+            assert len(runs) == 1  # nothing overlapped the in-flight run
+            release_first.set()
+            assert wait_for(lambda: len(runs) == 2)
+            time.sleep(0.2)  # settle: no third run may appear
+            assert len(runs) == 2, runs
+            assert overlaps == []
+        finally:
+            release_first.set()
+            m.stop()
+
+    def test_distinct_keys_of_one_controller_do_overlap(self):
+        """Sanity inverse: the serialization is per-KEY, not per-pool."""
+        barrier = threading.Barrier(2, timeout=10.0)
+        done = []
+
+        def fn(ns, name):
+            barrier.wait()
+            done.append(name)
+            return None
+
+        m = make_manager(pair=2)
+        m.register("pair", fn, watches={})
+        m.start()
+        try:
+            m.enqueue("pair", "default", "a")
+            m.enqueue("pair", "default", "b")
+            assert wait_for(lambda: sorted(done) == ["a", "b"])
+        finally:
+            m.stop()
+
+
+class TestPumpParity:
+    """run_until_quiet / ManualClock behavior is unchanged: serial,
+    deterministic, virtual-time-advancing (the envtest analogue)."""
+
+    def test_pump_is_serial_and_fifo(self):
+        order = []
+        active = []
+
+        def a(ns, name):
+            assert not active, "pump must be strictly serial"
+            active.append(1)
+            order.append(("a", name))
+            active.pop()
+            return None
+
+        def b(ns, name):
+            assert not active
+            active.append(1)
+            order.append(("b", name))
+            active.pop()
+            return None
+
+        m = ControllerManager(ResourceStore(), clock=ManualClock())
+        # wide pools configured — the PUMP must stay serial regardless
+        cfg = OperatorConfig()
+        cfg.controllers.max_concurrent_reconciles = 8
+        m.apply_config(cfg)
+        m.register("a", a, watches={})
+        m.register("b", b, watches={})
+        m.enqueue("a", "default", "1")
+        m.enqueue("b", "default", "2")
+        m.enqueue("a", "default", "3")
+        assert m.run_until_quiet() == 3
+        # global FIFO across controllers, exactly as the old dispatcher
+        assert order == [("a", "1"), ("b", "2"), ("a", "3")]
+
+    def test_pump_advances_virtual_time_through_timers(self):
+        clock = ManualClock(start=1000.0)
+        m = ControllerManager(ResourceStore(), clock=clock)
+        ticks = []
+
+        def fn(ns, name):
+            ticks.append(clock.now())
+            return 60.0 if len(ticks) < 3 else None  # requeue twice
+
+        m.register("timer", fn, watches={})
+        m.enqueue("timer", "default", "t")
+        assert m.run_until_quiet() == 3
+        assert ticks == [1000.0, 1060.0, 1120.0]
+
+    def test_pump_backoff_on_failure_requeues(self):
+        m = ControllerManager(ResourceStore(), clock=ManualClock())
+        attempts = []
+
+        def flaky(ns, name):
+            attempts.append(name)
+            if len(attempts) < 3:
+                raise RuntimeError("transient")
+            return None
+
+        m.register("flaky", flaky, watches={})
+        m.enqueue("flaky", "default", "x")
+        assert m.run_until_quiet() == 3
+        assert len(attempts) == 3
+
+    def test_pump_dedupes_queued_keys(self):
+        m = ControllerManager(ResourceStore(), clock=ManualClock())
+        runs = []
+        m.register("dedupe", lambda ns, name: runs.append(name), watches={})
+        for _ in range(5):
+            m.enqueue("dedupe", "default", "same")
+        assert m.run_until_quiet() == 1
+        assert runs == ["same"]
+
+
+class TestRuntimeWiring:
+    def test_runtime_manager_follows_configmap_reload(self):
+        """The per-controller key flows ConfigMap -> OperatorConfigManager
+        -> ControllerManager.apply_config live."""
+        from bobrapet_tpu.core.object import new_resource
+        from bobrapet_tpu.runtime import Runtime
+
+        rt = Runtime()
+        assert rt.manager._default_max_concurrent == 4  # ControllerTuning default
+        rt.store.create(new_resource(
+            "ConfigMap", "operator-config", "bobrapet-system",
+            spec={"data": {
+                "controllers.max-concurrent-reconciles": "2",
+                "controllers.steprun.max-concurrent-reconciles": "8",
+            }},
+        ))
+        assert rt.manager._default_max_concurrent == 2
+        assert rt.manager._per_controller_max == {"steprun": 8}
+        assert rt.manager._pools["steprun"].target == 8
+        assert rt.manager._pools["storyrun"].target == 2
+
+
+class TestSchedulingGateUnderConcurrency:
+    def test_queue_cap_holds_with_concurrent_storyrun_workers(self):
+        """Cross-run queue caps are check-then-launch: with several
+        StoryRun workers live, the cap must never be breached (the
+        DAG serializes the gate+launch window under _sched_lock)."""
+        import threading as _threading
+
+        from bobrapet_tpu.api.catalog import make_engram_template
+        from bobrapet_tpu.api.engram import make_engram
+        from bobrapet_tpu.api.story import make_story
+        from bobrapet_tpu.config.operator import QueueConfig
+        from bobrapet_tpu.runtime import Runtime
+        from bobrapet_tpu.sdk import register_engram
+
+        rt = Runtime(clock=Clock(), executor_mode="threaded")
+        rt.config_manager.config.scheduling.queues["capq"] = QueueConfig(
+            name="capq", max_concurrent=2
+        )
+        peak = [0]
+        active = [0]
+        lock = _threading.Lock()
+
+        @register_engram("gate.work")
+        def work(ctx):
+            with lock:
+                active[0] += 1
+                peak[0] = max(peak[0], active[0])
+            time.sleep(0.02)
+            with lock:
+                active[0] -= 1
+            return {"ok": 1}
+
+        rt.apply(make_engram_template("gate-tpl", entrypoint="gate.work"))
+        rt.apply(make_engram("gate-worker", "gate-tpl"))
+        rt.apply(make_story("capped", steps=[
+            {"name": "w", "ref": {"name": "gate-worker"}},
+        ], policy={"queue": "capq"}))
+        rt.start()
+        try:
+            runs = [rt.run_story("capped") for _ in range(10)]
+            assert wait_for(
+                lambda: all(rt.run_phase(r) == "Succeeded" for r in runs),
+                timeout=60.0,
+            ), [rt.run_phase(r) for r in runs]
+        finally:
+            rt.stop()
+        assert peak[0] <= 2, f"queue cap breached: peak concurrency {peak[0]}"

@@ -442,3 +442,66 @@ class TestRuntimeScaleIndexes:
         ) == 0
         story = rt.store.get("Story", "default", "idx-story")
         assert story.status.get("runsTriggered") == 4
+
+
+class TestSnapshotViews:
+    """Copy-on-write reads: views share the committed object; writes
+    still isolate at the store boundary."""
+
+    def _store(self):
+        store = ResourceStore()
+        store.create(new_resource("Job", "v1", "default",
+                                  spec={"cfg": {"deep": [1, 2]}}))
+        return store
+
+    def test_view_is_the_committed_object(self):
+        store = self._store()
+        a = store.get_view("Job", "default", "v1")
+        b = store.try_get_view("Job", "default", "v1")
+        assert a is b  # no per-read copies
+        assert store.get("Job", "default", "v1") is not a  # get() still isolates
+
+    def test_views_survive_writes_unchanged(self):
+        """An update replaces the committed object; a previously handed
+        out view keeps its (old) content — never mutated in place."""
+        store = self._store()
+        old = store.get_view("Job", "default", "v1")
+        old_rv = old.meta.resource_version
+        store.mutate("Job", "default", "v1",
+                     lambda r: r.spec.__setitem__("cfg", {"deep": [3]}))
+        assert old.spec == {"cfg": {"deep": [1, 2]}}
+        assert old.meta.resource_version == old_rv
+        fresh = store.get_view("Job", "default", "v1")
+        assert fresh is not old
+        assert fresh.spec == {"cfg": {"deep": [3]}}
+
+    def test_status_only_update_shares_spec_between_versions(self):
+        """The copy-on-write core: a status write reuses the committed
+        spec subtree instead of deep-copying it."""
+        store = self._store()
+        before = store.get_view("Job", "default", "v1")
+        store.patch_status("Job", "default", "v1",
+                           lambda s: s.__setitem__("phase", "Running"))
+        after = store.get_view("Job", "default", "v1")
+        assert after is not before
+        assert after.spec is before.spec  # shared, not copied
+        assert after.status.get("phase") == "Running"
+        assert before.status.get("phase") is None
+
+    def test_list_views_filters_like_list(self):
+        store = self._store()
+        store.create(new_resource("Job", "v2", "other", spec={},
+                                  labels={"pick": "me"}))
+        assert [r.meta.name for r in store.list_views("Job")] == ["v1", "v2"]
+        assert [r.meta.name
+                for r in store.list_views("Job", namespace="other")] == ["v2"]
+        assert [r.meta.name
+                for r in store.list_views("Job", labels={"pick": "me"})] == ["v2"]
+
+    def test_watch_event_shares_committed_object(self):
+        store = self._store()
+        seen = []
+        store.watch(lambda ev: seen.append(ev.resource), kinds=["Job"])
+        store.patch_status("Job", "default", "v1",
+                           lambda s: s.__setitem__("phase", "Running"))
+        assert seen and seen[-1] is store.get_view("Job", "default", "v1")
