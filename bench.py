@@ -595,6 +595,108 @@ def config8_serving_spec() -> dict:
     }
 
 
+#: PR-2 seed numbers for the data-plane/payload fast-path configs,
+#: measured on this box against the pre-fast-path code (single-encode
+#: fan-out, batched writers, hydrate LRU absent). vs_baseline on the
+#: two configs below is computed against THESE, so future BENCH_r*.json
+#: capture the trajectory.
+DATAPLANE_SEED_FPS = 1573.0
+HYDRATE_SEED_MBPS = 295.7
+
+
+def config9_dataplane_fanout() -> dict:
+    """Multi-consumer hub fan-out: 1 producer, 4 consumers, every frame
+    delivered to every consumer (the single-encode + batched-writer
+    fast path's headline shape). Python hub on purpose: the fast path
+    under test lives in the Python broker + SDK clients."""
+    import threading as _t
+
+    from bobrapet_tpu.dataplane import StreamConsumer, StreamHub, StreamProducer
+
+    n_msgs = int(os.environ.get("BENCH_FANOUT_MSGS", "4000"))
+    n_consumers = int(os.environ.get("BENCH_FANOUT_CONSUMERS", "4"))
+    payload = {"pcm": "x" * 512}
+    hub = StreamHub()
+    hub.start()
+    try:
+        counts = [0] * n_consumers
+        done = [_t.Event() for _ in range(n_consumers)]
+
+        def drain(idx):
+            c = StreamConsumer(hub.endpoint, "bench/fan/stream",
+                               decode_json=True)
+            for _msg in c:
+                counts[idx] += 1
+            done[idx].set()
+
+        for i in range(n_consumers):
+            _t.Thread(target=drain, args=(i,), daemon=True).start()
+        time.sleep(0.3)  # all consumers attached before the burst
+        p = StreamProducer(hub.endpoint, "bench/fan/stream")
+        t0 = time.perf_counter()
+        for _i in range(n_msgs):
+            p.send(payload)
+        p.close()
+        for d in done:
+            assert d.wait(120), "fan-out consumer did not finish"
+        wall = time.perf_counter() - t0
+        total = sum(counts)
+        assert total == n_msgs * n_consumers, (total, counts)
+        fps = total / wall
+        return {
+            "metric": "dataplane_frames_per_sec",
+            "value": round(fps, 0),
+            "unit": "frames/s",
+            "vs_baseline": round(fps / DATAPLANE_SEED_FPS, 2),
+            "config": "dataplane-fanout",
+            "consumers": n_consumers,
+            "messages": n_msgs,
+            "frames_delivered": total,
+            "wallclock_s": round(wall, 3),
+        }
+    finally:
+        hub.stop()
+
+
+def config10_payload_hydrate() -> dict:
+    """Payload pipeline: hydrate a 100-ref scope 10x (the per-step
+    pattern — every StepRun reconcile re-reads the run scope). Exercises
+    parallel ref fetch on the cold pass and the hydrate LRU on the
+    warm ones."""
+    from bobrapet_tpu.storage.manager import StorageManager
+    from bobrapet_tpu.storage.store import MemoryStore
+
+    n_refs = int(os.environ.get("BENCH_HYDRATE_REFS", "100"))
+    ref_kb = int(os.environ.get("BENCH_HYDRATE_REF_KB", "64"))
+    passes = int(os.environ.get("BENCH_HYDRATE_PASSES", "10"))
+    mgr = StorageManager(MemoryStore(), max_inline_size=1024)
+    big = "y" * (ref_kb * 1024)
+    scope = {}
+    total_bytes = 0
+    for i in range(n_refs):
+        v = {"doc": big + str(i)}
+        out = mgr.dehydrate(v, f"runs/ns/bench/steps/s{i}/output")
+        scope[f"s{i}"] = out
+        total_bytes += len(json.dumps(v))
+    t0 = time.perf_counter()
+    for _ in range(passes):
+        h = mgr.hydrate(scope, allowed_prefixes=["runs/ns/bench"])
+    wall = time.perf_counter() - t0
+    assert h["s0"]["doc"].startswith("y")
+    mbps = (total_bytes * passes) / 1e6 / wall
+    return {
+        "metric": "payload_hydrate_mb_per_sec",
+        "value": round(mbps, 1),
+        "unit": "MB/s",
+        "vs_baseline": round(mbps / HYDRATE_SEED_MBPS, 2),
+        "config": "payload-hydrate",
+        "refs": n_refs,
+        "ref_kb": ref_kb,
+        "passes": passes,
+        "wallclock_s": round(wall, 3),
+    }
+
+
 def run_sweep(state: dict) -> None:
     # the parent NEVER touches the accelerator — but the env var alone
     # is not enough: a site hook can rewrite platform priority
@@ -606,6 +708,8 @@ def run_sweep(state: dict) -> None:
     jax.config.update("jax_platforms", "cpu")
     for idx, fn in ((1, config1_single_step), (3, config3_fanout_gang),
                     (4, config4_streaming_hub), (5, config5_nested_rag),
+                    ("dataplane-fanout", config9_dataplane_fanout),
+                    ("payload-hydrate", config10_payload_hydrate),
                     ("serving", config6_serving),
                     ("serving-moe", config7_serving_moe),
                     ("serving-spec", config8_serving_spec)):

@@ -81,6 +81,20 @@ class ControllerTuning:
 
 
 @dataclasses.dataclass
+class DataplaneConfig:
+    """Data-plane hub hot-path knobs (consumed live by
+    ``dataplane.hub.apply_tuning`` — the writer threads read them at
+    drain time, so a reload affects running streams)."""
+
+    #: frames a hub writer thread drains per wakeup and flushes as one
+    #: vectored/joined write
+    writer_max_batch: int = 64
+    #: collapse buffered cumulative-ack runs / merge adjacent credit
+    #: grants into single frames
+    coalesce_acks: bool = True
+
+
+@dataclasses.dataclass
 class EngramDefaults:
     """Operator->SDK defaults (reference: operator.go engram defaults)."""
 
@@ -118,6 +132,7 @@ class OperatorConfig:
     controllers: ControllerTuning = dataclasses.field(default_factory=ControllerTuning)
     scheduling: SchedulingConfig = dataclasses.field(default_factory=SchedulingConfig)
     templating: TemplatingSettings = dataclasses.field(default_factory=TemplatingSettings)
+    dataplane: DataplaneConfig = dataclasses.field(default_factory=DataplaneConfig)
     engram: EngramDefaults = dataclasses.field(default_factory=EngramDefaults)
     retention: RetentionDefaults = dataclasses.field(default_factory=RetentionDefaults)
     timeouts: TimeoutDefaults = dataclasses.field(default_factory=TimeoutDefaults)
@@ -149,6 +164,8 @@ class OperatorConfig:
                 )
         if self.templating.evaluation_timeout <= 0:
             errs.append("templating.evaluationTimeout must be > 0")
+        if self.dataplane.writer_max_batch < 1:
+            errs.append("dataplane.writer-max-batch must be >= 1")
         if self.engram.max_inline_size < 0:
             errs.append("engram.maxInlineSize must be >= 0")
         for qname, q in self.scheduling.queues.items():
@@ -184,6 +201,8 @@ def _apply_dotted(cfg: OperatorConfig, key: str, value: str) -> bool:
             cfg.templating, "offloaded_data_policy", OffloadedDataPolicy
         ),
         "templating.materialize-engram": lambda: fset(cfg.templating, "materialize_engram", str),
+        "dataplane.writer-max-batch": lambda: fset(cfg.dataplane, "writer_max_batch", int),
+        "dataplane.coalesce-acks": lambda: fset(cfg.dataplane, "coalesce_acks", as_bool),
         "engram.grpc-port": lambda: fset(cfg.engram, "grpc_port", int),
         "engram.max-inline-size": lambda: fset(cfg.engram, "max_inline_size", int),
         "engram.storage-timeout-seconds": lambda: fset(cfg.engram, "storage_timeout_seconds", int),

@@ -183,6 +183,13 @@ class StepExecutor:
         sr = new_resource(
             STEP_RUN_KIND, name, ns, spec, labels=labels, owners=[run.owner_ref()]
         )
+        # the StepRun controller will hydrate this scope's refs while
+        # resolving inputs — start pulling them into the hydrate LRU
+        # now, overlapped with the create + watch dispatch (fire and
+        # forget; resolution hits cache instead of the blob store)
+        self.storage.prefetch(
+            scope, [StorageManager.run_prefix(ns, run.meta.name)]
+        )
         try:
             self.store.create(sr)
             metrics.child_stepruns_created.inc(

@@ -295,6 +295,19 @@ class StepRunController:
             self.store.create(job)
         except AlreadyExists:
             pass  # adopt: deterministic name makes the create idempotent
+        # while this step's Job dispatches, warm the hydrate LRU with
+        # the run scope's refs (run inputs + prior step outputs): the
+        # NEXT steps' input resolution and this step's output
+        # validation read the same refs and will hit cache instead of
+        # the store (fire-and-forget; never blocks the reconcile)
+        if storyrun is not None:
+            self.storage.prefetch(
+                {
+                    "inputs": storyrun.spec.get("inputs"),
+                    "steps": storyrun.status.get("stepStates"),
+                },
+                [StorageManager.run_prefix(namespace, run_name)],
+            )
         return None
 
     # ------------------------------------------------------------------
@@ -535,6 +548,10 @@ class StepRunController:
 
         raw = spec.input or {}
         policy = self.config_manager.config.templating.offloaded_data_policy
+        # when the scope had to be hydrated for evaluation, the SAME
+        # hydrated values feed schema validation below — the ref fetches
+        # happen once per reconcile, not once per consumer
+        evaluated_hydrated = False
         try:
             resolved = self.evaluator.evaluate_value(raw, scope)
         except OffloadedDataUsage:
@@ -551,6 +568,7 @@ class StepRunController:
                 "run": run_meta,
             }
             resolved = self.evaluator.evaluate_value(raw, hydrated_scope)
+            evaluated_hydrated = True
 
         # `requires` checks (reference: :5523)
         story = None
@@ -569,13 +587,19 @@ class StepRunController:
                 raise InputValidationError(f"required inputs missing: {missing}")
 
         # input schema validation (hydrate markers first so the schema sees
-        # real values)
+        # real values). A scope hydrated for evaluation is SHARED with
+        # validation: scope-derived values are already real, so unless
+        # the raw input carried a verbatim marker there is nothing left
+        # to fetch — and what is left hits the hydrate LRU warmed by the
+        # scope pass, not the store.
         if template_spec.input_schema:
-            err = _validate_schema(
-                self._hydrated_for_validation(resolved, namespace, spec),
-                template_spec.input_schema,
-                "input",
-            )
+            if evaluated_hydrated and not _contains_marker(resolved):
+                to_validate = resolved
+            else:
+                to_validate = self._hydrated_for_validation(
+                    resolved, namespace, spec
+                )
+            err = _validate_schema(to_validate, template_spec.input_schema, "input")
             if err is not None:
                 raise InputValidationError(err)
         return resolved
@@ -674,6 +698,19 @@ class StepRunController:
 
 class InputValidationError(Exception):
     pass
+
+
+def _contains_marker(value) -> bool:
+    """True when any storageRef marker survives in a value tree."""
+    from ..templating.engine import is_storage_ref
+
+    if is_storage_ref(value):
+        return True
+    if isinstance(value, dict):
+        return any(_contains_marker(v) for v in value.values())
+    if isinstance(value, list):
+        return any(_contains_marker(v) for v in value)
+    return False
 
 
 def _find_step_def(story_spec, step_id: str):

@@ -110,12 +110,14 @@ def _build_runtime_context(ctrl, sr, spec) -> Optional[dict[str, Any]]:
     """(reference: buildRealtimeRuntimeContext steprun_controller.go:2563)"""
     ns = sr.meta.namespace
     run_name = (sr.spec.get("storyRunRef") or {}).get("name")
-    run = ctrl.store.try_get(STORY_RUN_KIND, ns, run_name) if run_name else None
+    # read-only views (PR 1 copy-on-write idiom): the context chain is
+    # resolved every reconcile and never mutated here
+    run = ctrl.store.try_get_view(STORY_RUN_KIND, ns, run_name) if run_name else None
     if run is None:
         return None
     story_name = (run.spec.get("storyRef") or {}).get("name")
     story_ns = (run.spec.get("storyRef") or {}).get("namespace") or ns
-    story = ctrl.store.try_get(STORY_KIND, story_ns, story_name) if story_name else None
+    story = ctrl.store.try_get_view(STORY_KIND, story_ns, story_name) if story_name else None
     if story is None:
         return None
     story_spec = parse_story(story)
@@ -129,13 +131,13 @@ def _build_runtime_context(ctrl, sr, spec) -> Optional[dict[str, Any]]:
         from ..api.catalog import ENGRAM_TEMPLATE_KIND, parse_engram_template
         from ..api.engram import KIND as ENGRAM_KIND, parse_engram
 
-        e = ctrl.store.try_get(ENGRAM_KIND, ns, s.ref.name)
+        e = ctrl.store.try_get_view(ENGRAM_KIND, ns, s.ref.name)
         if e is None:
             return False
         es = parse_engram(e)
         mode = es.mode
         if mode is None:
-            t = ctrl.store.try_get(
+            t = ctrl.store.try_get_view(
                 ENGRAM_TEMPLATE_KIND, CLUSTER_NAMESPACE,
                 es.template_ref.name if es.template_ref else "",
             )
@@ -156,7 +158,7 @@ def _build_runtime_context(ctrl, sr, spec) -> Optional[dict[str, Any]]:
                 break
         if declared is not None:
             tname = declared.transport_ref or declared.name
-            tr = ctrl.store.try_get(TRANSPORT_KIND, CLUSTER_NAMESPACE, tname)
+            tr = ctrl.store.try_get_view(TRANSPORT_KIND, CLUSTER_NAMESPACE, tname)
             if tr is not None:
                 transport = tr
 
@@ -233,7 +235,7 @@ def _ensure_binding_inner(ctrl, sr, spec, ctx):
         "rawSettings": settings_dict,
     }
 
-    existing = ctrl.store.try_get(TRANSPORT_BINDING_KIND, ns, bname)
+    existing = ctrl.store.try_get_view(TRANSPORT_BINDING_KIND, ns, bname)
     if existing is None:
         b = new_resource(TRANSPORT_BINDING_KIND, bname, ns, desired_spec,
                          labels={"bobrapet.io/step-run": sr.meta.name},
@@ -252,7 +254,7 @@ def _ensure_binding_inner(ctrl, sr, spec, ctx):
                 "connectorGeneration": 1,
             }),
         )
-        return ctrl.store.get(TRANSPORT_BINDING_KIND, ns, bname), None
+        return ctrl.store.get_view(TRANSPORT_BINDING_KIND, ns, bname), None
 
     # re-negotiate: a changed contract bumps the connector generation
     # (reference: connector generation bumps steprun_controller.go:2711)
@@ -273,7 +275,7 @@ def _ensure_binding_inner(ctrl, sr, spec, ctx):
             }),
         )
         metrics.binding_ops.inc("update")
-    return ctrl.store.get(TRANSPORT_BINDING_KIND, ns, bname), None
+    return ctrl.store.get_view(TRANSPORT_BINDING_KIND, ns, bname), None
 
 
 # ---------------------------------------------------------------------------
@@ -320,7 +322,7 @@ def _ensure_downstream_targets(ctrl, sr, ctx, svc_name, port):
         from ..utils.naming import steprun_name
 
         dep_sr_name = steprun_name(run_name, dep_step)
-        dep_svc = ctrl.store.try_get(SERVICE_KIND, ns, f"{dep_sr_name}-svc")
+        dep_svc = ctrl.store.try_get_view(SERVICE_KIND, ns, f"{dep_sr_name}-svc")
         if dep_svc is None:
             return None
         return (f"{dep_sr_name}-svc.{ns}.svc", int(dep_svc.spec.get("port", port)))
@@ -417,7 +419,7 @@ def _ensure_deployment(ctrl, sr, spec, engram_spec, template_spec, ctx,
     if tls_secret:
         desired_spec["tlsSecret"] = tls_secret
     dep_name = f"{name}-rt"
-    existing = ctrl.store.try_get(DEPLOYMENT_KIND, ns, dep_name)
+    existing = ctrl.store.try_get_view(DEPLOYMENT_KIND, ns, dep_name)
     if existing is None:
         d = new_resource(DEPLOYMENT_KIND, dep_name, ns, desired_spec,
                          labels={"bobrapet.io/step-run": name},
@@ -426,13 +428,13 @@ def _ensure_deployment(ctrl, sr, spec, engram_spec, template_spec, ctx,
             ctrl.store.create(d)
         except AlreadyExists:
             pass
-        return ctrl.store.get(DEPLOYMENT_KIND, ns, dep_name)
+        return ctrl.store.get_view(DEPLOYMENT_KIND, ns, dep_name)
     if existing.spec != desired_spec:
         def sync(r: Resource) -> None:
             r.spec = dict(desired_spec)
 
         ctrl.store.mutate(DEPLOYMENT_KIND, ns, dep_name, sync)
-    return ctrl.store.get(DEPLOYMENT_KIND, ns, dep_name)
+    return ctrl.store.get_view(DEPLOYMENT_KIND, ns, dep_name)
 
 
 # ---------------------------------------------------------------------------
@@ -550,7 +552,7 @@ def _terminate_topology(ctrl, sr):
     ns, name = sr.meta.namespace, sr.meta.name
     now = ctrl.clock.now()
     bname = binding_name(name)
-    b = ctrl.store.try_get(TRANSPORT_BINDING_KIND, ns, bname)
+    b = ctrl.store.try_get_view(TRANSPORT_BINDING_KIND, ns, bname)
     if b is not None:
         ctrl.store.patch_status(
             TRANSPORT_BINDING_KIND, ns, bname,

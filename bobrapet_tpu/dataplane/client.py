@@ -16,12 +16,13 @@ otherwise, which the hub enforces).
 
 from __future__ import annotations
 
+import collections
 import json
 import socket
 import threading
 from typing import Any, Iterator, Optional
 
-from .frames import FrameError, read_frame, send_frame
+from .frames import FrameError, FrameReader, encode_frame, send_frame, send_frames
 
 
 class StreamClosed(Exception):
@@ -32,10 +33,21 @@ class StreamProtocolError(Exception):
     """The peer rejected our traffic (e.g. sending without credit)."""
 
 
-def _connect(endpoint: str, timeout: float, tls=None) -> socket.socket:
+def _connect(endpoint: str, timeout: float, tls=None,
+             nodelay: bool = False) -> socket.socket:
     host, _, port = endpoint.rpartition(":")
     sock = socket.create_connection((host or "127.0.0.1", int(port)), timeout=timeout)
     sock.settimeout(timeout)
+    if nodelay:
+        # consumers ack on this socket and producers wait on the credit
+        # replenish those acks trigger — Nagle would hold each tiny ack
+        # for a delayed-ACK window. Producer data sockets keep Nagle:
+        # back-to-back sends coalesce into fewer segments, and the
+        # producer never waits on its own socket's round trip.
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:  # pragma: no cover - non-TCP transports
+            pass
     if tls is not None:
         # shared-CA mutual TLS (dataplane/tls.py): the server must
         # present a CA-chained cert; we present ours. The wrapper
@@ -50,7 +62,15 @@ def _connect(endpoint: str, timeout: float, tls=None) -> socket.socket:
 
 
 class StreamProducer:
-    """Connects to a hub (or a P2P consumer's embedded hub) and sends."""
+    """Connects to a hub (or a P2P consumer's embedded hub) and sends.
+
+    Sends go through a per-producer write queue drained by one writer
+    thread: a burst of :meth:`send` calls coalesces into one large
+    write (one TCP segment train) instead of one small segment per
+    frame — small-frame streams are otherwise throttled by the
+    Nagle/delayed-ACK round trip, not by bandwidth. An idle writer
+    flushes a lone frame immediately (one thread wakeup of latency);
+    :meth:`close` drains the queue before the eos leaves."""
 
     def __init__(
         self,
@@ -73,6 +93,7 @@ class StreamProducer:
             if wm.get("enabled") and wm.get("timestampSource") else None
         )
         self._sock = _connect(endpoint, connect_timeout, tls=tls)
+        self._reader = FrameReader(self._sock)
         self._credits = 0
         self._unlimited = False
         self._credit_cv = threading.Condition()
@@ -82,7 +103,7 @@ class StreamProducer:
             "t": "hello", "role": "producer", "stream": stream,
             "lane": lane, "settings": settings,
         })
-        fr = read_frame(self._sock)
+        fr = self._reader.read()
         if fr is None or fr[0].get("t") != "ok":
             raise StreamProtocolError(f"handshake failed: {fr and fr[0]}")
         # the timeout guarded connect+handshake only: an idle stream is
@@ -93,15 +114,87 @@ class StreamProducer:
             self._unlimited = True
         else:
             self._credits = credits
-        self._reader = threading.Thread(
+        self._reader_thread = threading.Thread(
             target=self._read_loop, daemon=True, name=f"producer-{stream}"
         )
-        self._reader.start()
+        self._reader_thread.start()
+        # batched writer: send() only enqueues encoded frames. The
+        # queue is BYTE-bounded: a producer outrunning a backpressured
+        # peer blocks in send() (the same TCP backpressure contract as
+        # the old synchronous sendall, one buffer earlier).
+        self._wq: collections.deque = collections.deque()
+        self._wq_bytes = 0
+        self._wq_max_bytes = 8 * 1024 * 1024
+        self._wcv = threading.Condition()
+        self._wclosed = False
+        self._winflight = False
+        self._writer_thread = threading.Thread(
+            target=self._write_loop, daemon=True,
+            name=f"producer-writer-{stream}",
+        )
+        self._writer_thread.start()
+
+    def _write_loop(self) -> None:
+        while True:
+            with self._wcv:
+                self._wcv.wait_for(lambda: self._wq or self._wclosed)
+                if not self._wq:
+                    if self._wclosed:
+                        return  # drained: everything enqueued was sent
+                    continue
+                bufs = []
+                while self._wq and len(bufs) < 256:
+                    w = self._wq.popleft()
+                    self._wq_bytes -= len(w)
+                    bufs.append(w)
+                self._winflight = True
+                self._wcv.notify_all()  # wake senders blocked on the bound
+            try:
+                send_frames(self._sock, bufs)
+            except OSError as e:
+                with self._credit_cv:
+                    if self._error is None:
+                        self._error = f"send failed: {e}"
+                    self._credit_cv.notify_all()
+                with self._wcv:
+                    self._wclosed = True
+                    self._wq.clear()
+                    self._wq_bytes = 0
+                    self._winflight = False
+                    self._wcv.notify_all()
+                return
+            with self._wcv:
+                self._winflight = False
+                self._wcv.notify_all()  # wake flush()/close() waiters
+
+    def _enqueue_wire(self, wire: bytes) -> None:
+        with self._wcv:
+            # backpressure: block while the queue is at its byte bound
+            # (the writer drains it; a dead writer raises below)
+            self._wcv.wait_for(
+                lambda: self._wclosed
+                or self._wq_bytes + len(wire) <= self._wq_max_bytes
+                or not self._wq
+            )
+            if self._wclosed:
+                raise StreamClosed(self.stream)
+            self._wq.append(wire)
+            self._wq_bytes += len(wire)
+            self._wcv.notify_all()
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until every enqueued frame reached the socket."""
+        with self._wcv:
+            self._wcv.wait_for(
+                lambda: (not self._wq and not self._winflight)
+                or self._wclosed,
+                timeout=timeout,
+            )
 
     def _read_loop(self) -> None:
         try:
             while True:
-                fr = read_frame(self._sock)
+                fr = self._reader.read()
                 if fr is None:
                     break
                 header, _ = fr
@@ -162,7 +255,7 @@ class StreamProducer:
             header["key"] = key
         if event_time_ms is not None:
             header["et"] = int(event_time_ms)
-        send_frame(self._sock, header, data)
+        self._enqueue_wire(encode_frame(header, data))
 
     @property
     def credits(self) -> int:
@@ -174,13 +267,39 @@ class StreamProducer:
         # outright while a credit frame sits unread in OUR receive
         # buffer turns the close into a TCP RST, which discards the
         # EOS frame still queued toward the hub
-        try:
-            if eos:
-                send_frame(self._sock, {"t": "eos"})
-            self._sock.shutdown(socket.SHUT_WR)
-        except OSError:
-            pass
-        self._reader.join(timeout=5.0)
+        if eos:
+            try:
+                self._enqueue_wire(encode_frame({"t": "eos"}, b""))
+            except StreamClosed:
+                pass  # writer already dead; nothing more can be sent
+            # drain-then-exit: the writer flushes everything queued
+            # (the eos included) before the half-close below. No join
+            # timeout — the old synchronous send blocked exactly the
+            # same way on a stalled peer, and a DEAD peer breaks the
+            # writer's sendall with an error that ends the drain.
+            with self._wcv:
+                self._wclosed = True
+                self._wcv.notify_all()
+            self._writer_thread.join()
+            try:
+                self._sock.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+        else:
+            # abort-close (crash semantics): drop what was queued,
+            # break any in-flight sendall with the shutdown, and give
+            # the writer a BOUNDED exit window — never hang an abort
+            with self._wcv:
+                self._wq.clear()
+                self._wq_bytes = 0
+                self._wclosed = True
+                self._wcv.notify_all()
+            try:
+                self._sock.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+            self._writer_thread.join(timeout=5.0)
+        self._reader_thread.join(timeout=5.0)
         try:
             self._sock.close()
         except OSError:
@@ -210,7 +329,10 @@ class StreamConsumer:
         self.watermark_ms: Optional[int] = None
         fc = (settings or {}).get("flowControl") or {}
         self._ack_every = int(((fc.get("ackEvery") or {}).get("messages")) or 1)
-        self._sock = _connect(endpoint, connect_timeout, tls=tls)
+        #: deferral bound: at most this many consumed-but-unacked
+        #: messages before an ack is forced mid-burst
+        self._ack_defer_cap = max(64, 8 * self._ack_every)
+        self._sock = _connect(endpoint, connect_timeout, tls=tls, nodelay=True)
         self._since_ack = 0
         self._last_seq = -1
         hello: dict[str, Any] = {
@@ -227,7 +349,8 @@ class StreamConsumer:
             # persisted cumulative ack automatically
             hello["consumerId"] = str(consumer_id)
         send_frame(self._sock, hello)
-        fr = read_frame(self._sock)
+        self._reader = FrameReader(self._sock)
+        fr = self._reader.read()
         if fr is None or fr[0].get("t") != "ok":
             raise StreamProtocolError(f"handshake failed: {fr and fr[0]}")
         self._sock.settimeout(None)  # idle != dead; block between messages
@@ -235,7 +358,7 @@ class StreamConsumer:
     def __iter__(self) -> Iterator[Any]:
         while True:
             try:
-                fr = read_frame(self._sock)
+                fr = self._reader.read()
             except FrameError as e:
                 raise StreamProtocolError(str(e)) from e
             except OSError as e:
@@ -253,8 +376,6 @@ class StreamConsumer:
                 # it (atLeastOnce survives a crash mid-processing)
                 yield json.loads(payload) if self.decode_json else payload
                 self._since_ack += 1
-                if self._since_ack >= self._ack_every:
-                    self.ack()
             elif t == "watermark":
                 # event-time frontier update; not part of the data
                 # iteration. max-guarded: reconnects/races must never
@@ -268,6 +389,19 @@ class StreamConsumer:
                 return
             elif t == "err":
                 raise StreamProtocolError(header.get("message", "stream error"))
+            # deferred cumulative-ack flush, checked after EVERY frame
+            # type: acks are cumulative, so while a drain burst is
+            # still buffered locally one later ack covers the whole
+            # run. Capped so a long burst can't starve the producer's
+            # credit replenish (which rides on acks) — and flushed when
+            # the buffer runs dry even if the LAST buffered frame was a
+            # control frame (a watermark behind the final data frame
+            # must not leave the ack deferred forever: the producer
+            # would wait on credits that only an ack can release).
+            if self._since_ack >= self._ack_every:
+                if (self._since_ack >= self._ack_defer_cap
+                        or not self._reader.has_buffered_frame()):
+                    self.ack()
 
     def ack(self) -> None:
         if self._last_seq >= 0:

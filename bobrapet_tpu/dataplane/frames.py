@@ -79,5 +79,100 @@ def read_frame(sock: socket.socket) -> Optional[tuple[dict[str, Any], bytes]]:
     return header, body[hlen:]
 
 
+class FrameReader:
+    """Buffered frame reader: recv() in large chunks instead of two
+    exact reads per frame, so a burst of small frames (data under load,
+    ack trains) costs ~one syscall per buffer-full rather than two per
+    frame. Wire format and error behavior match :func:`read_frame`.
+
+    ``try_read`` parses ONLY what is already buffered (never touches
+    the socket) — the hub uses it to coalesce runs of cumulative-ack
+    frames that arrived in one recv.
+    """
+
+    __slots__ = ("_sock", "_buf", "_eof")
+
+    CHUNK = 256 * 1024
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._buf = bytearray()
+        self._eof = False
+
+    def _parse_buffered(self) -> Optional[tuple[dict[str, Any], bytes]]:
+        buf = self._buf
+        if len(buf) < 6:
+            return None
+        total, hlen = struct.unpack_from(">IH", buf)
+        if total > MAX_FRAME or hlen > total:
+            raise FrameError(f"bad frame lengths total={total} hlen={hlen}")
+        if len(buf) < 6 + total:
+            return None
+        try:
+            header = json.loads(bytes(buf[6:6 + hlen]))
+        except ValueError as e:
+            raise FrameError(f"bad frame header: {e}") from e
+        payload = bytes(buf[6 + hlen:6 + total])
+        del buf[:6 + total]
+        return header, payload
+
+    def read(self) -> Optional[tuple[dict[str, Any], bytes]]:
+        """One frame, blocking; None on clean EOF at a frame boundary."""
+        while True:
+            fr = self._parse_buffered()
+            if fr is not None:
+                return fr
+            if self._eof:
+                if self._buf:
+                    raise FrameError("connection died mid-frame")
+                return None
+            chunk = self._sock.recv(self.CHUNK)
+            if not chunk:
+                self._eof = True
+                continue
+            self._buf.extend(chunk)
+
+    def try_read(self) -> Optional[tuple[dict[str, Any], bytes]]:
+        """A frame IF one is fully buffered already; never blocks."""
+        return self._parse_buffered()
+
+    def has_buffered_frame(self) -> bool:
+        """True when a complete frame is already buffered (no parse,
+        no socket touch) — consumers use it to defer cumulative acks
+        while a drain burst is still in flight."""
+        buf = self._buf
+        if len(buf) < 6:
+            return False
+        total, _hlen = struct.unpack_from(">IH", buf)
+        return len(buf) >= 6 + total
+
+
 def send_frame(sock: socket.socket, header: dict[str, Any], payload: bytes = b"") -> None:
     sock.sendall(encode_frame(header, payload))
+
+
+def send_frames(sock: socket.socket, wires: list[bytes]) -> None:
+    """Flush a batch of pre-encoded frames in one write: vectored
+    ``sendmsg`` on plain sockets (no copy), joined-buffer ``sendall``
+    where the transport lacks it (TLS wrapper). A partial sendmsg is
+    completed with sendall on the remainder."""
+    if len(wires) == 1:
+        sock.sendall(wires[0])
+        return
+    sendmsg = getattr(sock, "sendmsg", None)
+    if sendmsg is None or len(wires) > 1024:
+        # no vectored path (TLS wrapper), or batch above IOV_MAX —
+        # sendmsg would fail with EMSGSIZE
+        sock.sendall(b"".join(wires))
+        return
+    total = 0
+    for w in wires:
+        total += len(w)
+    try:
+        sent = sendmsg(wires)
+    except (AttributeError, NotImplementedError):  # pragma: no cover
+        sock.sendall(b"".join(wires))
+        return
+    if sent < total:
+        rest = memoryview(b"".join(wires))[sent:]
+        sock.sendall(rest)

@@ -31,6 +31,7 @@ own deployable): `python -m bobrapet_tpu.dataplane` starts a hub.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import logging
 import socket
 import ssl
@@ -39,12 +40,49 @@ import time
 from typing import Any, Optional
 
 from ..observability.metrics import metrics
-from .frames import FrameError, encode_frame, read_frame, send_frame
+from .frames import (
+    FrameError,
+    FrameReader,
+    encode_frame,
+    send_frame,
+    send_frames,
+)
 from .recording import recording_knobs
 
 _log = logging.getLogger(__name__)
 
 UNLIMITED = -1
+
+
+@dataclasses.dataclass
+class HubTuning:
+    """Data-plane hot-path knobs, live-reloaded from the operator
+    ConfigMap like the ``controllers.*`` keys (dotted keys
+    ``dataplane.writer-max-batch`` / ``dataplane.coalesce-acks``).
+
+    ``writer_max_batch``: frames a writer thread drains per wakeup and
+    flushes as ONE vectored/joined write. ``coalesce_acks``: collapse a
+    buffered run of cumulative-ack frames into the final position, and
+    merge adjacent queued credit grants into one frame."""
+
+    writer_max_batch: int = 64
+    coalesce_acks: bool = True
+
+
+#: process-wide live tuning; every hub reads it at drain time, so a
+#: ConfigMap reload takes effect without restarting streams
+HUB_TUNING = HubTuning()
+
+
+def apply_tuning(dataplane_cfg) -> None:
+    """Adopt ``cfg.dataplane`` (called from the runtime's config
+    subscription on every reload). The batch width is clamped to
+    IOV_MAX (1024): a larger vectored write would fail with EMSGSIZE
+    (send_frames guards this too)."""
+    HUB_TUNING.writer_max_batch = min(
+        1024, max(1, int(dataplane_cfg.writer_max_batch))
+    )
+    HUB_TUNING.coalesce_acks = bool(dataplane_cfg.coalesce_acks)
 
 #: hard cap on replay.mode=full history per stream (mirrored by the
 #: native hub); no settings field configures it — an unbounded knob
@@ -118,8 +156,15 @@ class _Stream:
         self.name = name
         self.knobs = knobs
         self.lock = threading.Lock()
-        self.buffer: collections.deque = collections.deque()  # (seq, header, payload)
+        #: (seq, wire) — wire is the FULL pre-encoded data frame, built
+        #: exactly once in _on_data and shared (immutable bytes) by
+        #: every consumer queue, the replay attach, and retained history
+        self.buffer: collections.deque = collections.deque()
         self.next_seq = 0
+        #: cumulative delivery counters folded in from detached
+        #: consumers (live consumers' counters are read directly)
+        self.delivered_frames = 0
+        self.delivered_bytes = 0
         self.acked = -1  # cumulative: everything <= acked is done
         self.consumers: list[_ConsumerConn] = []
         self.producer_conns: list[_ProducerConn] = []
@@ -135,7 +180,7 @@ class _Stream:
         import uuid as _uuid
 
         self.epoch = _uuid.uuid4().hex
-        #: replay.mode=full history: (seq, header, payload, wall_ts).
+        #: replay.mode=full history: (seq, wire, wall_ts).
         #: Bounded by retentionSeconds AND a hard entry cap (a maxlen
         #: deque evicts oldest-first): retention alone would let a fast
         #: producer grow history without limit. NOT guaranteed to be a
@@ -179,7 +224,7 @@ class _Stream:
         now = time.monotonic()
         self.retained.append((*entry, now))
         horizon = now - self.knobs["replay_retention"]
-        while self.retained and self.retained[0][3] < horizon:
+        while self.retained and self.retained[0][2] < horizon:
             self.retained.popleft()
 
     # -- occupancy / credits ----------------------------------------------
@@ -207,7 +252,11 @@ class _ProducerConn:
     per-connection queue drained by one writer thread — callers holding
     ``st.lock`` only enqueue, so a producer whose TCP send buffer is
     full can never stall the stream lock for everyone else (the native
-    hub's per-connection write-queue pattern; ADVICE r2)."""
+    hub's per-connection write-queue pattern; ADVICE r2).
+
+    The writer drains the WHOLE queue per wakeup and flushes it as one
+    batched write; adjacent credit grants coalesce into a single frame
+    (credits are additive) when ``dataplane.coalesce-acks`` is on."""
 
     def __init__(self, sock: socket.socket, stream: _Stream):
         self.sock = sock
@@ -221,32 +270,65 @@ class _ProducerConn:
 
     def enqueue(self, header: dict[str, Any]) -> None:
         with self.cv:
+            if self.closed and not self.queue:
+                # the writer may already be past its final drain; a
+                # frame enqueued now could sit forever — drop LOUDLY
+                _log.debug("producer conn closed; dropping %s frame",
+                           header.get("t"))
+                return
             self.queue.append(header)
-            self.cv.notify()
+            self.cv.notify_all()
 
     def writer_loop(self) -> None:
         while True:
             with self.cv:
                 self.cv.wait_for(lambda: self.queue or self.closed)
-                if self.closed and not self.queue:
-                    return
-                header = self.queue.popleft()
+                if not self.queue:
+                    if self.closed:
+                        return  # drained: every enqueued frame was sent
+                    continue
+                batch_n = max(1, HUB_TUNING.writer_max_batch)
+                headers = []
+                while self.queue and len(headers) < batch_n:
+                    headers.append(self.queue.popleft())
+            if HUB_TUNING.coalesce_acks and len(headers) > 1:
+                merged: list[dict[str, Any]] = []
+                for h in headers:
+                    if (h.get("t") == "credit" and merged
+                            and merged[-1].get("t") == "credit"):
+                        merged[-1] = {
+                            "t": "credit",
+                            "n": int(merged[-1]["n"]) + int(h["n"]),
+                        }
+                    else:
+                        merged.append(h)
+                headers = merged
+            wires = [encode_frame(h, b"") for h in headers]
             try:
-                self.sock.sendall(encode_frame(header, b""))
+                send_frames(self.sock, wires)
             except OSError:
                 return
+            metrics.stream_writer_batch.observe(len(wires), "producer")
 
     def close(self) -> None:
+        """Mark no-more-frames; the writer drains what is queued, then
+        exits. notify_all: close must wake the writer even if a stray
+        waiter consumed a single notify."""
         with self.cv:
             self.closed = True
-            self.cv.notify()
+            self.cv.notify_all()
 
 
 class _ConsumerConn:
     """Delivery to a consumer goes through a per-connection ordered
     queue drained by one writer thread: producers and the attach-replay
     path only enqueue (under the stream lock), so frames can neither
-    reorder nor block the producer's reader on a slow consumer socket."""
+    reorder nor block the producer's reader on a slow consumer socket.
+
+    Queue entries are PRE-ENCODED wire bytes (encoded once per frame in
+    _on_data, shared across all consumers); the writer drains up to
+    ``dataplane.writer-max-batch`` entries per wakeup and flushes them
+    as one vectored/joined write."""
 
     def __init__(self, sock: socket.socket, stream: _Stream):
         self.sock = sock
@@ -257,33 +339,51 @@ class _ConsumerConn:
         self.checkpointed_seq = -1
         self.checkpointed_at = 0.0  # monotonic; 0 => first ack persists
         self.last_ack_seq = -1
-        self.queue: collections.deque = collections.deque()
+        self.queue: collections.deque = collections.deque()  # (wire, is_data)
         self.cv = threading.Condition()
         self.closed = False
+        # written by the single writer thread, read by stream_stats
+        self.sent_frames = 0
+        self.sent_bytes = 0
 
-    def enqueue(self, header: dict[str, Any], payload: bytes) -> None:
+    def enqueue(self, wire: bytes, is_data: bool = False) -> None:
         with self.cv:
-            self.queue.append((header, payload))
-            self.cv.notify()
+            if self.closed and not self.queue:
+                _log.debug("consumer conn closed; dropping a frame")
+                return
+            self.queue.append((wire, is_data))
+            self.cv.notify_all()
 
     def writer_loop(self) -> None:
         while True:
             with self.cv:
                 self.cv.wait_for(lambda: self.queue or self.closed)
-                if self.closed and not self.queue:
-                    return
-                header, payload = self.queue.popleft()
+                if not self.queue:
+                    if self.closed:
+                        return  # drained: every enqueued frame was sent
+                    continue
+                batch_n = max(1, HUB_TUNING.writer_max_batch)
+                batch = []
+                while self.queue and len(batch) < batch_n:
+                    batch.append(self.queue.popleft())
+            wires = [w for w, _ in batch]
+            n_data = sum(1 for _, d in batch if d)
+            n_bytes = sum(len(w) for w in wires)
             try:
-                self.sock.sendall(encode_frame(header, payload))
-                if header.get("t") == "data":
-                    metrics.stream_messages.inc("sent")
+                send_frames(self.sock, wires)
             except OSError:
                 return
+            self.sent_frames += n_data
+            self.sent_bytes += n_bytes
+            if n_data:
+                metrics.stream_messages.inc("sent", by=float(n_data))
+            metrics.stream_bytes.inc("out", by=float(n_bytes))
+            metrics.stream_writer_batch.observe(len(wires), "consumer")
 
     def close(self) -> None:
         with self.cv:
             self.closed = True
-            self.cv.notify()
+            self.cv.notify_all()
 
 
 class StreamHub:
@@ -358,6 +458,13 @@ class StreamHub:
         if st is None:
             return {}
         with st.lock:
+            elapsed = max(1e-9, time.monotonic() - st.started)
+            frames = st.delivered_frames + sum(
+                c.sent_frames for c in st.consumers
+            )
+            nbytes = st.delivered_bytes + sum(
+                c.sent_bytes for c in st.consumers
+            )
             out = {
                 "buffered": len(st.buffer),
                 "nextSeq": st.next_seq,
@@ -365,6 +472,10 @@ class StreamHub:
                 "consumers": len(st.consumers),
                 "paused": st.paused,
                 "eos": st.eos,
+                # per-stream delivery throughput (all consumers)
+                "deliveredFrames": frames,
+                "deliveredBytes": nbytes,
+                "framesPerSec": round(frames / elapsed, 1),
             }
             if st.knobs["watermark"]:
                 out["watermarkMs"] = st.watermark_ms
@@ -418,7 +529,15 @@ class StreamHub:
                     pass
                 return
         try:
-            first = read_frame(sock)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:  # pragma: no cover - transports without TCP
+                pass
+            # one buffered reader for the connection's whole life — its
+            # buffer may already hold bytes past the hello, so every
+            # later read must go through it
+            reader = FrameReader(sock)
+            first = reader.read()
             if first is None:
                 return
             hello, _ = first
@@ -439,9 +558,9 @@ class StreamHub:
             )
             metrics.stream_requests.inc(str(role))
             if role == "producer":
-                self._serve_producer(sock, stream)
+                self._serve_producer(sock, stream, reader)
             elif role == "consumer":
-                self._serve_consumer(sock, stream, hello)
+                self._serve_consumer(sock, stream, hello, reader)
             else:
                 send_frame(sock, {"t": "err", "message": f"bad role {role!r}"})
         except (FrameError, OSError) as e:
@@ -473,7 +592,8 @@ class StreamHub:
         return None
 
     # -- producer side -----------------------------------------------------
-    def _serve_producer(self, sock: socket.socket, st: _Stream) -> None:
+    def _serve_producer(self, sock: socket.socket, st: _Stream,
+                        reader: FrameReader) -> None:
         conn = _ProducerConn(sock, st)
         conn.writer = threading.Thread(target=conn.writer_loop, daemon=True,
                                        name="hub-producer-writer")
@@ -512,7 +632,7 @@ class StreamHub:
                 conn.enqueue({"t": "ok", "credits": grant})
         try:
             while True:
-                fr = read_frame(sock)
+                fr = reader.read()
                 if fr is None:
                     return
                 header, payload = fr
@@ -535,8 +655,9 @@ class StreamHub:
                         consumers = list(st.consumers)
                         self._notify_watermark(st)
                     if last:
+                        eos_wire = encode_frame({"t": "eos"}, b"")
                         for c in consumers:
-                            c.enqueue({"t": "eos"}, b"")
+                            c.enqueue(eos_wire)
                         if self._recorder is not None and st.knobs["recording"]:
                             self._recorder.flush(st.name)
                     self._maybe_gc(st)
@@ -585,9 +706,16 @@ class StreamHub:
                 # is allowed to exceed by the in-flight window.
             seq = st.next_seq
             st.next_seq += 1
-            entry = (seq, {"t": "data", "seq": seq, "key": header.get("key")}, payload)
+            # encode ONCE; the immutable wire bytes are shared by every
+            # consumer queue, retained history, and the replay attach —
+            # fan-out to N consumers costs zero further encodes/copies
+            wire = encode_frame(
+                {"t": "data", "seq": seq, "key": header.get("key")}, payload
+            )
+            entry = (seq, wire)
             st.buffer.append(entry)
             st.retain(entry)
+            metrics.stream_bytes.inc("in", by=float(len(wire)))
             if self._recorder is not None and st.knobs["recording"]:
                 # under st.lock: recorded order == seq order
                 self._recorder.record(st.name, seq, header.get("key"),
@@ -596,11 +724,11 @@ class StreamHub:
             # ordered queue in seq order, interleaved atomically with
             # the attach-replay path
             for c in st.consumers:
-                c.enqueue(entry[1], entry[2])
-                c.delivered = max(c.delivered, entry[0])
+                c.enqueue(wire, is_data=True)
+                c.delivered = max(c.delivered, seq)
             if st.consumers and not st.knobs["at_least_once"]:
                 # at-most-once: a delivery attempt completes the message
-                if st.buffer and st.buffer[-1][0] == entry[0]:
+                if st.buffer and st.buffer[-1][0] == seq:
                     st.buffer.pop()
             if st.knobs["watermark"] and header.get("et") is not None:
                 # AFTER the data enqueue: the watermark frame must ride
@@ -625,8 +753,9 @@ class StreamHub:
         (the consumer's monotone contract would break)."""
         advanced = st.advance_watermark()
         if advanced is not None:
+            wire = encode_frame({"t": "watermark", "ms": advanced}, b"")
             for c in st.consumers:
-                c.enqueue({"t": "watermark", "ms": advanced}, b"")
+                c.enqueue(wire)
 
     def _maybe_replenish(self, st: _Stream, conn: _ProducerConn) -> None:
         """Grant more credits when policy allows. Caller holds st.lock.
@@ -697,7 +826,9 @@ class StreamHub:
             return False
 
     # -- consumer side -----------------------------------------------------
-    def _serve_consumer(self, sock: socket.socket, st: _Stream, hello: dict[str, Any]) -> None:
+    def _serve_consumer(self, sock: socket.socket, st: _Stream,
+                        hello: dict[str, Any],
+                        reader: FrameReader) -> None:
         # machinery/identity refusals already ran pre-stream-creation
         # (_refuse_hello)
         consumer_id = hello.get("consumerId")
@@ -732,25 +863,26 @@ class StreamHub:
                 # eviction ignores ack state, so an unacked entry may
                 # live only in the buffer; dropping it here would break
                 # at-least-once through the replay feature itself
-                merged: dict[int, tuple] = {}
-                for seq, header, payload, _ts in st.retained:
+                merged: dict[int, bytes] = {}
+                for seq, wire, _ts in st.retained:
                     if seq >= int(from_seq):
-                        merged[seq] = (header, payload)
-                for seq, header, payload in st.buffer:
+                        merged[seq] = wire
+                for seq, wire in st.buffer:
                     if seq >= int(from_seq):
-                        merged.setdefault(seq, (header, payload))
+                        merged.setdefault(seq, wire)
                 for seq in sorted(merged):
-                    header, payload = merged[seq]
-                    conn.enqueue(header, payload)
+                    conn.enqueue(merged[seq], is_data=True)
                     conn.delivered = max(conn.delivered, seq)
             else:
-                for seq, header, payload in list(st.buffer):
-                    conn.enqueue(header, payload)
+                for seq, wire in list(st.buffer):
+                    conn.enqueue(wire, is_data=True)
                     conn.delivered = max(conn.delivered, seq)
             st.consumers.append(conn)
             if st.watermark_ms is not None:
                 # a late consumer learns the current event-time frontier
-                conn.enqueue({"t": "watermark", "ms": st.watermark_ms}, b"")
+                conn.enqueue(
+                    encode_frame({"t": "watermark", "ms": st.watermark_ms}, b"")
+                )
             eos = st.eos
             if not st.knobs["at_least_once"]:
                 # at-most-once: the replay attempt consumes the backlog
@@ -758,18 +890,31 @@ class StreamHub:
             for pc in st.producer_conns:
                 self._maybe_replenish(st, pc)
             if eos:
-                conn.enqueue({"t": "eos"}, b"")
+                conn.enqueue(encode_frame({"t": "eos"}, b""))
         writer = threading.Thread(target=conn.writer_loop, daemon=True,
                                   name="hub-consumer-writer")
         writer.start()
         try:
             while True:
-                fr = read_frame(sock)
+                fr = reader.read()
                 if fr is None:
                     return
                 header, _ = fr
                 if header.get("t") == "ack":
                     seq = int(header.get("seq", -1))
+                    if HUB_TUNING.coalesce_acks:
+                        # acks are CUMULATIVE: a run of ack frames that
+                        # arrived in one recv collapses to its final
+                        # position — buffer trim, credit replenish, and
+                        # checkpoint pacing run once per burst instead
+                        # of once per frame (non-ack frames are ignored
+                        # here exactly as the per-frame loop does)
+                        while True:
+                            nxt = reader.try_read()
+                            if nxt is None:
+                                break
+                            if nxt[0].get("t") == "ack":
+                                seq = max(seq, int(nxt[0].get("seq", -1)))
                     conn.last_ack_seq = max(conn.last_ack_seq, seq)
                     self._on_ack(st, seq)
                     if (st.knobs["replay_checkpoint"] and conn.consumer_id
@@ -785,6 +930,10 @@ class StreamHub:
             with st.lock:
                 if conn in st.consumers:
                     st.consumers.remove(conn)
+                # fold this consumer's delivery counters into the
+                # stream's cumulative totals (stream_stats reads them)
+                st.delivered_frames += conn.sent_frames
+                st.delivered_bytes += conn.sent_bytes
             if (st.knobs["replay_checkpoint"] and conn.consumer_id
                     and conn.last_ack_seq > conn.checkpointed_seq):
                 # persist the tail position at detach (interval pacing

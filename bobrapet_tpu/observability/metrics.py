@@ -342,6 +342,19 @@ class _ControlPlaneMetrics:
         self.stream_duration = h(
             "bobravoz_stream_duration_seconds", "Stream lifetime", ["lane"]
         )
+        self.stream_bytes = c(
+            "bobravoz_stream_bytes_total",
+            "Wire bytes through the hub (in = produced frames, "
+            "out = delivered frames across all consumers)",
+            ["direction"],
+        )
+        self.stream_writer_batch = h(
+            "bobravoz_writer_batch_frames",
+            "Frames flushed per writer-thread wakeup (batched "
+            "vectored/joined writes; capped by dataplane.writer-max-batch)",
+            ["role"],
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+        )
         # Serving family (continuous-batching engine; TPU-native
         # addition — the reference orchestrates containers and has no
         # model serving of its own)
@@ -386,6 +399,17 @@ class _ControlPlaneMetrics:
         )
         self.storage_offloaded_bytes = c(
             "bobrapet_storage_offloaded_bytes_total", "Bytes dehydrated to storage", []
+        )
+        self.storage_dedup_hits = c(
+            "bobrapet_storage_dedup_hits_total",
+            "Dehydrate writes skipped because an identical payload "
+            "(same sha256, same run scope) was already stored",
+            [],
+        )
+        self.storage_hydrate_cache = c(
+            "bobrapet_storage_hydrate_cache_total",
+            "Hydrate LRU probes by result",
+            ["result"],
         )
         # Trigger / admission family
         self.trigger_decisions = c(

@@ -237,6 +237,11 @@ class Runtime:
         self.evaluator.config.max_output_bytes = cfg.templating.max_output_bytes
         self.evaluator.config.deterministic = cfg.templating.deterministic
         self.storage.max_inline_size = cfg.engram.max_inline_size
+        # live data-plane tuning: hub writer threads read these at
+        # drain time, so a reload affects already-open streams
+        from .dataplane.hub import apply_tuning
+
+        apply_tuning(cfg.dataplane)
 
     # ------------------------------------------------------------------
     def _register_indexes(self) -> None:

@@ -15,20 +15,59 @@ RetentionPolicy retention.go:41):
   storage-ref spoofing rejection, storyrun_webhook.go:389).
 - **Retention**: delete blobs under a run's prefix after the run record
   is cleaned up (two-phase retention, SURVEY §5.4).
+
+Fast path (PR 2): dehydrate encodes each node ONCE and reuses the bytes
+for the size check, the sha256, and the ``put`` (slimmed containers are
+re-encoded by splicing the already-encoded children, not by re-walking
+the tree); identical payloads (same sha256, same run scope) write once
+(content-addressed dedup); hydrate keeps a bounded in-process LRU keyed
+``(provider, key, sha256)`` and fetches all refs of a value tree
+concurrently before substitution.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import hashlib
 import json
+import logging
+import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
+from ..observability.metrics import metrics
 from ..templating.engine import STORAGE_REF_KEY, is_storage_ref
 from .store import BlobNotFound, Store, StorageError
 
+_log = logging.getLogger(__name__)
+
 DEFAULT_MAX_INLINE_SIZE = 16 * 1024  # bytes of canonical JSON
 DEFAULT_MAX_DEPTH = 32
+
+#: bounded in-process hydrate cache (entries / approximate payload bytes)
+DEFAULT_HYDRATE_CACHE_ENTRIES = 512
+DEFAULT_HYDRATE_CACHE_BYTES = 128 * 1024 * 1024
+#: bounded (scope, sha256) -> key map for content-addressed dedup
+DEFAULT_DEDUP_ENTRIES = 4096
+
+#: shared fetch pool for parallel hydrate/prefetch — one per process,
+#: sized for blob-store round trips (IO-bound; hashing releases the GIL)
+_FETCH_WORKERS = 8
+_fetch_executor: Optional[ThreadPoolExecutor] = None
+_fetch_lock = threading.Lock()
+
+
+def _executor() -> ThreadPoolExecutor:
+    global _fetch_executor
+    with _fetch_lock:
+        if _fetch_executor is None:
+            _fetch_executor = ThreadPoolExecutor(
+                max_workers=_FETCH_WORKERS,
+                thread_name_prefix="hydrate-fetch",
+            )
+        return _fetch_executor
 
 
 @dataclasses.dataclass
@@ -64,6 +103,50 @@ class StorageRef:
         )
 
 
+class _HydrateCache:
+    """Thread-safe LRU of DECODED blob payloads keyed
+    ``(provider, key, sha256)``.
+
+    A hit skips the store round trip, the digest verification, AND the
+    JSON decode. Only sha-carrying refs are cached (without the digest
+    two generations of one key would collide), so a hit always returns
+    content that matched the digest the marker claims. Cached values
+    are SHARED between callers — the same copy-on-write contract as the
+    store's views (PR 1): hydrated scopes are read, never mutated.
+    """
+
+    def __init__(self, max_entries: int, max_bytes: int):
+        self.max_entries = max_entries
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries: collections.OrderedDict[tuple, tuple[Any, int]] = (
+            collections.OrderedDict()
+        )
+        self._bytes = 0
+
+    def get(self, key: tuple):
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is None:
+                return None
+            self._entries.move_to_end(key)
+            return hit  # (value, size)
+
+    def put(self, key: tuple, value: Any, size: int) -> None:
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (value, size)
+            self._bytes += size
+            while self._entries and (
+                len(self._entries) > self.max_entries
+                or self._bytes > self.max_bytes
+            ):
+                _, (_v, sz) = self._entries.popitem(last=False)
+                self._bytes -= sz
+
+
 class StorageManager:
     """Offload/rehydrate engine over one Store backend."""
 
@@ -72,10 +155,26 @@ class StorageManager:
         store: Store,
         max_inline_size: int = DEFAULT_MAX_INLINE_SIZE,
         max_depth: int = DEFAULT_MAX_DEPTH,
+        hydrate_cache_entries: int = DEFAULT_HYDRATE_CACHE_ENTRIES,
+        hydrate_cache_bytes: int = DEFAULT_HYDRATE_CACHE_BYTES,
+        dedup_entries: int = DEFAULT_DEDUP_ENTRIES,
     ):
         self.store = store
         self.max_inline_size = max_inline_size
         self.max_depth = max_depth
+        self._hydrate_cache = _HydrateCache(
+            hydrate_cache_entries, hydrate_cache_bytes
+        )
+        # (scope, sha256) -> key of the blob already holding that
+        # content, plus the reverse map so an overwrite of a key with
+        # DIFFERENT content invalidates the stale forward entry (the
+        # deterministic key scheme reuses paths across retries)
+        self._dedup_lock = threading.Lock()
+        self._dedup: collections.OrderedDict[tuple[str, str], str] = (
+            collections.OrderedDict()
+        )
+        self._dedup_by_key: dict[str, tuple[str, str]] = {}
+        self._dedup_entries = dedup_entries
 
     # -- key scheme --------------------------------------------------------
 
@@ -121,44 +220,116 @@ class StorageManager:
     def _dehydrate(
         self, value: Any, key_prefix: str, limit: int, depth: int, counter: list[int]
     ) -> Any:
+        return self._dehydrate_node(value, key_prefix, limit, depth, counter)[0]
+
+    def _dehydrate_node(
+        self, value: Any, key_prefix: str, limit: int, depth: int, counter: list[int]
+    ) -> tuple[Any, bytes]:
+        """Single-pass offload: returns ``(result, canonical_encoding)``
+        — the SAME bytes serve the size check, the sha256, and the
+        ``put``. A container slimmed by child offloads is re-encoded by
+        splicing the children's already-produced encodings (no second
+        tree walk); a container whose children all stayed inline reuses
+        its original encoding outright."""
         if depth > self.max_depth:
             raise StorageError(f"dehydrate recursion depth {depth} exceeded")
         if is_storage_ref(value):
-            return value  # already offloaded
-        blob = _encode(value)
-        if len(blob) <= limit:
-            return value
+            return value, _encode(value)
+        enc = _encode(value)
+        if len(enc) <= limit:
+            return value, enc
         # Too big inline. Containers first try slimming children; scalars
         # and still-oversized containers offload whole.
         if isinstance(value, dict):
-            slim = {
-                k: self._dehydrate(v, f"{key_prefix}/{k}", limit, depth + 1, counter)
-                for k, v in value.items()
-            }
-            if len(_encode(slim)) <= limit:
-                return slim
-            value = slim
+            items = []
+            changed = False
+            for k, v in value.items():
+                nv, nenc = self._dehydrate_node(
+                    v, f"{key_prefix}/{k}", limit, depth + 1, counter
+                )
+                changed = changed or nv is not v
+                items.append((k, nv, nenc))
+            if changed:
+                slim = {k: nv for k, nv, _ in items}
+                enc = _splice_dict(items, slim)
+                if len(enc) <= limit:
+                    return slim, enc
+                value = slim
         elif isinstance(value, list):
-            slim = [
-                self._dehydrate(v, f"{key_prefix}/{i}", limit, depth + 1, counter)
-                for i, v in enumerate(value)
-            ]
-            if len(_encode(slim)) <= limit:
-                return slim
-            value = slim
+            parts = []
+            changed = False
+            for i, v in enumerate(value):
+                nv, nenc = self._dehydrate_node(
+                    v, f"{key_prefix}/{i}", limit, depth + 1, counter
+                )
+                changed = changed or nv is not v
+                parts.append((nv, nenc))
+            if changed:
+                slim_list = [nv for nv, _ in parts]
+                enc = b"[" + b",".join(nenc for _, nenc in parts) + b"]"
+                if len(enc) <= limit:
+                    return slim_list, enc
+                value = slim_list
         counter[0] += 1
         key = f"{key_prefix}-{counter[0]}"
-        data = _encode(value)
-        self.store.put(key, data)
-        import hashlib
-
+        digest = hashlib.sha256(enc).hexdigest()
+        key = self._dedup_put(key, enc, digest)
         ref = StorageRef(
             key=key,
             provider=self.store.provider,
-            size=len(data),
-            sha256=hashlib.sha256(data).hexdigest(),
+            size=len(enc),
+            sha256=digest,
         )
-        return ref.to_marker()
+        marker = ref.to_marker()
+        return marker, _encode(marker)
+
+    # -- content-addressed dedup ------------------------------------------
+
+    @staticmethod
+    def _dedup_scope(key: str) -> Optional[str]:
+        """Dedup is scoped to one run's prefix (``runs/<ns>/<run>``):
+        hydration validates ref keys against exactly that scope, and
+        run-prefix retention deletes under it — a blob shared ACROSS
+        runs would be readable by neither and deletable by either."""
+        parts = key.split("/")
+        if parts[0] == "runs" and len(parts) >= 4:
+            return "/".join(parts[:3])
+        return None
+
+    def _dedup_put(self, key: str, data: bytes, digest: str) -> str:
+        scope = self._dedup_scope(key)
+        if scope is None:
+            self.store.put(key, data)
+            metrics.storage_offloaded_bytes.inc(by=float(len(data)))
+            return key
+        cache_key = (scope, digest)
+        with self._dedup_lock:
+            prior = self._dedup.get(cache_key)
+        if prior is not None and prior != key:
+            try:
+                if self.store.exists(prior):
+                    # no bytes hit storage — counted only as a dedup hit
+                    metrics.storage_dedup_hits.inc()
+                    return prior
+            except StorageError:  # pragma: no cover - backend hiccup
+                pass  # fall through to a fresh write
+        self.store.put(key, data)
+        metrics.storage_offloaded_bytes.inc(by=float(len(data)))
+        with self._dedup_lock:
+            stale = self._dedup_by_key.pop(key, None)
+            if stale is not None and stale != cache_key:
+                # this key now holds different content; the old
+                # (scope, sha) -> key mapping would hand out markers
+                # whose sha no longer matches the stored bytes
+                self._dedup.pop(stale, None)
+            self._dedup[cache_key] = key
+            self._dedup_by_key[key] = cache_key
+            self._dedup.move_to_end(cache_key)
+            while len(self._dedup) > self._dedup_entries:
+                _old_ck, old_key = self._dedup.popitem(last=False)
+                if self._dedup_by_key.get(old_key) == _old_ck:
+                    del self._dedup_by_key[old_key]
+        return key
 
     # -- hydrate -----------------------------------------------------------
 
@@ -175,10 +346,16 @@ class StorageManager:
         ``allowed_prefixes`` is the anti-spoofing scope: every ref key must
         live under one of them (reference: validateStorageRef manager.go:518
         + storyrun_webhook.go:389).
+
+        Refs are fetched CONCURRENTLY (wave by wave for nested
+        offloads) into the hydrate LRU before the substitution walk —
+        the walk itself is the serial reference implementation, so
+        results and error behavior are identical to a serial hydrate.
         """
         from ..observability.tracing import TRACER
 
         with TRACER.start_span("storage.hydrate"):
+            self._prefetch_waves(value, allowed_prefixes, depth)
             return self._hydrate(value, allowed_prefixes, depth)
 
     def _hydrate(
@@ -191,28 +368,7 @@ class StorageManager:
             raise StorageError("hydrate recursion depth exceeded")
         if is_storage_ref(value):
             ref = StorageRef.from_marker(value)
-            self.validate_ref(ref, allowed_prefixes)
-            if ref.provider and ref.provider != self.store.provider:
-                # mixed-provider deployments (e.g. native slice-SSD writer,
-                # plain-file reader on the same mount) must fail loudly —
-                # their on-disk layouts are not interchangeable
-                raise StorageError(
-                    f"storage ref {ref.key!r} written by provider "
-                    f"{ref.provider!r} but this store is "
-                    f"{self.store.provider!r}; pin slice_local_ssd.native "
-                    "in the storage policy so all processes agree on one "
-                    "implementation"
-                )
-            data = self.store.get(ref.key)
-            if ref.sha256:
-                import hashlib
-
-                actual = hashlib.sha256(data).hexdigest()
-                if actual != ref.sha256:
-                    raise StorageError(
-                        f"blob {ref.key!r} digest mismatch (corrupted or tampered)"
-                    )
-            payload = _decode(data)
+            payload = self._fetch_ref(ref, allowed_prefixes)
             # hydrated payload may itself contain refs (nested offload)
             return self._hydrate(payload, allowed_prefixes, depth + 1)
         # depth counts resolved refs only — plain container nesting must
@@ -222,6 +378,151 @@ class StorageManager:
         if isinstance(value, list):
             return [self._hydrate(v, allowed_prefixes, depth) for v in value]
         return value
+
+    def _fetch_ref(
+        self, ref: StorageRef, allowed_prefixes: Optional[list[str]]
+    ) -> Any:
+        """Validate + fetch + verify + decode ONE ref, through the LRU.
+        Cached payloads are shared (read-only by contract)."""
+        self.validate_ref(ref, allowed_prefixes)
+        if ref.provider and ref.provider != self.store.provider:
+            # mixed-provider deployments (e.g. native slice-SSD writer,
+            # plain-file reader on the same mount) must fail loudly —
+            # their on-disk layouts are not interchangeable
+            raise StorageError(
+                f"storage ref {ref.key!r} written by provider "
+                f"{ref.provider!r} but this store is "
+                f"{self.store.provider!r}; pin slice_local_ssd.native "
+                "in the storage policy so all processes agree on one "
+                "implementation"
+            )
+        cache_key = None
+        if ref.sha256:
+            cache_key = (ref.provider, ref.key, ref.sha256)
+            hit = self._hydrate_cache.get(cache_key)
+            if hit is not None:
+                metrics.storage_hydrate_cache.inc("hit")
+                return hit[0]
+            metrics.storage_hydrate_cache.inc("miss")
+        data = self.store.get(ref.key)
+        if ref.sha256:
+            actual = hashlib.sha256(data).hexdigest()
+            if actual != ref.sha256:
+                raise StorageError(
+                    f"blob {ref.key!r} digest mismatch (corrupted or tampered)"
+                )
+        payload = _decode(data)
+        if cache_key is not None:
+            self._hydrate_cache.put(cache_key, payload, len(data))
+        return payload
+
+    # -- parallel fetch / prefetch ----------------------------------------
+
+    @staticmethod
+    def _collect_markers(value: Any, out: list[dict[str, Any]]) -> None:
+        if is_storage_ref(value):
+            out.append(value)
+            return
+        if isinstance(value, dict):
+            for v in value.values():
+                StorageManager._collect_markers(v, out)
+        elif isinstance(value, list):
+            for v in value:
+                StorageManager._collect_markers(v, out)
+
+    def _prefetch_waves(
+        self,
+        value: Any,
+        allowed_prefixes: Optional[list[str]],
+        depth: int,
+    ) -> None:
+        """Fetch every ref in the tree concurrently, wave by wave
+        (payloads of one wave may carry the next wave's refs). Already
+        cached refs are only probed (a warm scope costs one cache probe
+        per ref, no executor round trip); misses are fetched in
+        worker-count chunks, not one task per ref — blob-store round
+        trips parallelize, task churn does not. Failures are swallowed
+        here: the serial walk re-raises them at its deterministic
+        position (only successes enter the cache)."""
+        markers: list[dict[str, Any]] = []
+        self._collect_markers(value, markers)
+        while markers and depth <= self.max_depth:
+            seen: set[tuple] = set()
+            payloads: list[Any] = []
+            misses: list[StorageRef] = []
+            for m in markers:
+                ref = StorageRef.from_marker(m)
+                if not ref.sha256:
+                    # uncacheable (no digest): prefetching it would
+                    # only double the store round trips — the serial
+                    # walk fetches it exactly once
+                    continue
+                ident = (ref.provider, ref.key, ref.sha256)
+                if ident in seen:
+                    continue
+                seen.add(ident)
+                hit = self._hydrate_cache.get(ident)
+                if hit is not None:
+                    payloads.append(hit[0])
+                else:
+                    misses.append(ref)
+            if len(misses) == 1:
+                payloads.append(self._try_fetch(misses[0], allowed_prefixes))
+            elif misses:
+                nchunks = min(_FETCH_WORKERS, len(misses))
+                chunks = [misses[i::nchunks] for i in range(nchunks)]
+
+                def fetch_chunk(chunk: list[StorageRef]) -> list[Any]:
+                    return [
+                        self._try_fetch(r, allowed_prefixes) for r in chunk
+                    ]
+
+                for result in _executor().map(fetch_chunk, chunks):
+                    payloads.extend(result)
+            markers = []
+            for p in payloads:
+                if p is not None:
+                    self._collect_markers(p, markers)
+            depth += 1
+
+    def _try_fetch(
+        self, ref: StorageRef, allowed_prefixes: Optional[list[str]]
+    ) -> Any:
+        try:
+            return self._fetch_ref(ref, allowed_prefixes)
+        except Exception:  # noqa: BLE001 - the serial walk re-raises
+            return None
+
+    def prefetch(
+        self,
+        value: Any,
+        allowed_prefixes: Optional[list[str]] = None,
+    ) -> None:
+        """Fire-and-forget cache warm-up: fetch the refs reachable from
+        ``value`` on the shared pool so an upcoming ``hydrate`` (this
+        step's validation, the next step's scope) hits the LRU instead
+        of the store. Never raises; refs without a sha256 are skipped
+        (they cannot be cached)."""
+        markers: list[dict[str, Any]] = []
+        try:
+            self._collect_markers(value, markers)
+        except RecursionError:  # pragma: no cover - hostile nesting
+            return
+        for m in markers:
+            try:
+                ref = StorageRef.from_marker(m)
+            except Exception:  # noqa: BLE001 - malformed marker
+                continue
+            if not ref.sha256:
+                continue
+            # probe the LRU before spending an executor slot: warm
+            # scopes re-prefetch on every reconcile and must not crowd
+            # genuinely cold fetches out of the shared pool
+            if self._hydrate_cache.get(
+                (ref.provider, ref.key, ref.sha256)
+            ) is not None:
+                continue
+            _executor().submit(self._try_fetch, ref, allowed_prefixes)
 
     @staticmethod
     def validate_ref(ref: StorageRef, allowed_prefixes: Optional[list[str]]) -> None:
@@ -279,6 +580,25 @@ class StorageManager:
 
 def _encode(value: Any) -> bytes:
     return json.dumps(value, sort_keys=True, separators=(",", ":"), default=str).encode()
+
+
+def _splice_dict(items: list[tuple], slim: dict[str, Any]) -> bytes:
+    """Canonical encoding of a slimmed dict from its children's already
+    canonical encodings — byte-identical to ``_encode(slim)``.
+
+    json.dumps(sort_keys=True) sorts the ORIGINAL keys; with mixed key
+    types that ordering (and key coercion) is not reproducible from
+    strings alone, so non-str keys fall back to a real encode."""
+    if not all(isinstance(k, str) for k, _nv, _nenc in items):
+        return _encode(slim)
+    return (
+        b"{"
+        + b",".join(
+            json.dumps(k).encode() + b":" + nenc
+            for k, _nv, nenc in sorted(items, key=lambda t: t[0])
+        )
+        + b"}"
+    )
 
 
 def _decode(data: bytes) -> Any:
