@@ -244,18 +244,19 @@ def _cmd_manager(args: argparse.Namespace) -> int:
         args.executor_backend == "cluster" and not args.disable_webhooks
     )
     if serve_webhooks and not args.disable_webhooks:
-        import tempfile
-
         from .cluster.admission import (
             AdmissionServer,
             register_webhook_configurations,
         )
-        from .cluster.certs import ensure_webhook_certs
+        from .cluster.certs import ensure_webhook_certs, secure_fallback_cert_dir
 
+        # fallback dir is per-user 0700 with ownership/symlink checks —
+        # a predictable world-accessible temp path would let any local
+        # user pre-plant or read the self-minted webhook keys
         cert_dir = args.webhook_certs_dir or (
             os.path.join(args.persist_dir, "webhook-certs")
             if args.persist_dir
-            else os.path.join(tempfile.gettempdir(), "bobrapet-webhook-certs")
+            else secure_fallback_cert_dir()
         )
         # the advertised host must be a SAN on the self-minted leaf or
         # the apiserver's TLS handshake to the webhook fails

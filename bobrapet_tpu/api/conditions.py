@@ -34,6 +34,9 @@ TRANSPORT_READY = "TransportReady"
 #: TPU addition: the slice-placement stage granted this run an
 #: ICI-contiguous sub-mesh (no reference counterpart).
 SLICE_PLACED = "SlicePlaced"
+#: TPU addition: the fleet subsystem recovered this run/step from one or
+#: more slice preemptions (checkpoint-resuming gang redrive).
+PREEMPTION_RECOVERED = "PreemptionRecovered"
 
 
 class Reason:
@@ -115,6 +118,10 @@ class Reason:
     SLICE_PLACED = "SlicePlaced"
     SLICE_UNAVAILABLE = "SliceUnavailable"
     GANG_INCOMPLETE = "GangIncomplete"
+    PREEMPTED = "Preempted"
+    PREEMPTION_REDRIVE = "PreemptionRedrive"
+    PREEMPTION_BUDGET_EXHAUSTED = "PreemptionBudgetExhausted"
+    AWAITING_HEALTHY_SLICE = "AwaitingHealthySlice"
 
 
 @dataclasses.dataclass

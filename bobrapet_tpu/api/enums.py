@@ -149,6 +149,12 @@ class ExitClass(StrEnum):
 
     ``UNKNOWN`` (worker vanished / infrastructure failure) is retryable but
     does NOT consume the retry budget.
+
+    ``PREEMPTED`` (TPU-native addition, no reference counterpart): the
+    node/slice was reclaimed under the gang (GKE spot preemption ≈
+    SIGTERM + node condition). Retryable against the fleet subsystem's
+    own ``fleet.preemption-retry-cap`` — a reclaimed slice is an
+    infrastructure event, so it never consumes the user's retry budget.
     """
 
     SUCCESS = "success"
@@ -156,14 +162,20 @@ class ExitClass(StrEnum):
     TERMINAL = "terminal"
     RATE_LIMITED = "rateLimited"
     UNKNOWN = "unknown"
+    PREEMPTED = "preempted"
 
     @property
     def is_retryable(self) -> bool:
-        return self in (ExitClass.RETRY, ExitClass.RATE_LIMITED, ExitClass.UNKNOWN)
+        return self in (
+            ExitClass.RETRY,
+            ExitClass.RATE_LIMITED,
+            ExitClass.UNKNOWN,
+            ExitClass.PREEMPTED,
+        )
 
     @property
     def consumes_retry_budget(self) -> bool:
-        return self is not ExitClass.UNKNOWN
+        return self not in (ExitClass.UNKNOWN, ExitClass.PREEMPTED)
 
 
 class SecretMountType(StrEnum):

@@ -54,6 +54,8 @@ class StepState(SpecBase):
     signals: Optional[dict[str, Any]] = None
     exit_code: Optional[int] = None
     exit_class: Optional[str] = None
+    #: fleet preemption redrives this step survived (TPU-native)
+    preemptions: Optional[int] = None
 
     @property
     def effective_phase(self) -> Phase:
@@ -94,6 +96,7 @@ class StepState(SpecBase):
             signals=d.get("signals"),
             exit_code=d.get("exitCode", d.get("exit_code")),
             exit_class=d.get("exitClass", d.get("exit_class")),
+            preemptions=d.get("preemptions"),
         )
 
     def to_dict(self) -> dict:  # type: ignore[override]
@@ -122,6 +125,8 @@ class StepState(SpecBase):
             out["exitCode"] = self.exit_code
         if self.exit_class is not None:
             out["exitClass"] = self.exit_class
+        if self.preemptions is not None:
+            out["preemptions"] = self.preemptions
         return out
 
 

@@ -25,12 +25,61 @@ from __future__ import annotations
 
 import ipaddress
 import os
+import stat
 import subprocess
+import tempfile
 from typing import Iterable, Optional
 
 
 class CertError(Exception):
     pass
+
+
+#: files under a cert dir that hold private key material
+_KEY_FILES = ("tls.key", "ca.key")
+
+
+def secure_fallback_cert_dir(
+    base: Optional[str] = None, name: str = "bobrapet-webhook-certs"
+) -> str:
+    """A per-user 0700 directory for self-minted webhook key material.
+
+    The old fallback (``$TMPDIR/bobrapet-webhook-certs``) was a
+    predictable world-accessible path: any local user could pre-create
+    it (or pre-plant a CA) and the manager would happily mint/serve keys
+    out of it. This helper appends the uid, creates the directory 0700,
+    and refuses to proceed when the path is a symlink or owned by
+    someone else. Key material found in a group/other-writable
+    directory is discarded — never reused — and the mode is tightened
+    before minting fresh certs.
+    """
+    base = base or tempfile.gettempdir()
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    path = os.path.join(base, f"{name}-{uid}")
+    try:
+        os.makedirs(path, mode=0o700)
+    except FileExistsError:
+        pass
+    st = os.lstat(path)
+    if stat.S_ISLNK(st.st_mode) or not stat.S_ISDIR(st.st_mode):
+        raise CertError(
+            f"webhook cert fallback {path!r} is not a real directory "
+            "(symlink attack?) — pass --webhook-certs-dir explicitly"
+        )
+    if st.st_uid != uid:
+        raise CertError(
+            f"webhook cert fallback {path!r} is owned by uid {st.st_uid}, "
+            f"not {uid} — pass --webhook-certs-dir explicitly"
+        )
+    if st.st_mode & 0o077:
+        # a previous (or hostile) loose-mode dir: existing key material
+        # is untrustworthy — drop it and tighten before minting anew
+        for fname in _KEY_FILES:
+            fpath = os.path.join(path, fname)
+            if os.path.lexists(fpath):
+                os.unlink(fpath)
+        os.chmod(path, 0o700)
+    return path
 
 
 def _run(cmd: list[str]) -> None:

@@ -4,6 +4,7 @@ from .operator import (
     CONFIG_MAP_KIND,
     ControllerTuning,
     EngramDefaults,
+    FleetConfig,
     OperatorConfig,
     OperatorConfigManager,
     QueueConfig,
@@ -19,6 +20,7 @@ __all__ = [
     "CONFIG_MAP_KIND",
     "ControllerTuning",
     "EngramDefaults",
+    "FleetConfig",
     "OperatorConfig",
     "OperatorConfigManager",
     "QueueConfig",

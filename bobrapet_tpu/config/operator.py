@@ -95,6 +95,45 @@ class DataplaneConfig:
 
 
 @dataclasses.dataclass
+class FleetConfig:
+    """Slice-fleet health & preemption recovery knobs (``fleet.*``;
+    TPU-native addition, consumed live by :mod:`bobrapet_tpu.fleet`).
+
+    A preemption redrive has its OWN retry cap — reclaimed slices are
+    infrastructure events and must not consume the user-facing retry
+    budget (shared_types.go:400 RetryPolicy stays untouched)."""
+
+    #: checkpoint-resuming redrives allowed per StepRun before the
+    #: preemption turns terminal (dotted: fleet.preemption-retry-cap)
+    preemption_retry_cap: int = 5
+    #: delay before relaunching a preempted gang (fleet.redrive-delay)
+    redrive_delay_seconds: float = 1.0
+    #: base quarantine for a cell whose host was reclaimed
+    #: (fleet.quarantine); repeated strikes escalate up to
+    #: fleet.max-quarantine-multiplier x this base, then decay out
+    quarantine_seconds: float = 300.0
+    max_quarantine_multiplier: float = 8.0
+    #: suspicion score at which a cell is quarantined
+    #: (fleet.suspicion-threshold); scores decay exponentially with
+    #: fleet.suspicion-half-life
+    suspicion_threshold: float = 2.0
+    suspicion_half_life_seconds: float = 600.0
+    #: a gang host silent for this long is reported suspect
+    #: (fleet.heartbeat-timeout)
+    heartbeat_timeout_seconds: float = 60.0
+    #: kill the whole gang the moment one host dies of preemption
+    #: instead of waiting for the step timeout (fleet.fail-fast)
+    fail_fast: bool = True
+    #: GKE materialization: target spot (preemptible) TPU slices —
+    #: gke-spot nodeSelector + toleration on gang pods (fleet.gke-spot)
+    gke_spot: bool = False
+    #: SIGTERM->SIGKILL window on gang pods so a reclaimed worker can
+    #: cut a final checkpoint (fleet.termination-grace; 0 = leave the
+    #: cluster default). 30s matches the k8s default explicitly.
+    termination_grace_seconds: float = 30.0
+
+
+@dataclasses.dataclass
 class EngramDefaults:
     """Operator->SDK defaults (reference: operator.go engram defaults)."""
 
@@ -133,6 +172,7 @@ class OperatorConfig:
     scheduling: SchedulingConfig = dataclasses.field(default_factory=SchedulingConfig)
     templating: TemplatingSettings = dataclasses.field(default_factory=TemplatingSettings)
     dataplane: DataplaneConfig = dataclasses.field(default_factory=DataplaneConfig)
+    fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     engram: EngramDefaults = dataclasses.field(default_factory=EngramDefaults)
     retention: RetentionDefaults = dataclasses.field(default_factory=RetentionDefaults)
     timeouts: TimeoutDefaults = dataclasses.field(default_factory=TimeoutDefaults)
@@ -166,6 +206,16 @@ class OperatorConfig:
             errs.append("templating.evaluationTimeout must be > 0")
         if self.dataplane.writer_max_batch < 1:
             errs.append("dataplane.writer-max-batch must be >= 1")
+        if self.fleet.preemption_retry_cap < 0:
+            errs.append("fleet.preemption-retry-cap must be >= 0")
+        if self.fleet.quarantine_seconds < 0:
+            errs.append("fleet.quarantine must be >= 0")
+        if self.fleet.suspicion_threshold <= 0:
+            errs.append("fleet.suspicion-threshold must be > 0")
+        if self.fleet.suspicion_half_life_seconds <= 0:
+            errs.append("fleet.suspicion-half-life must be > 0")
+        if self.fleet.redrive_delay_seconds < 0:
+            errs.append("fleet.redrive-delay must be >= 0")
         if self.engram.max_inline_size < 0:
             errs.append("engram.maxInlineSize must be >= 0")
         for qname, q in self.scheduling.queues.items():
@@ -203,6 +253,16 @@ def _apply_dotted(cfg: OperatorConfig, key: str, value: str) -> bool:
         "templating.materialize-engram": lambda: fset(cfg.templating, "materialize_engram", str),
         "dataplane.writer-max-batch": lambda: fset(cfg.dataplane, "writer_max_batch", int),
         "dataplane.coalesce-acks": lambda: fset(cfg.dataplane, "coalesce_acks", as_bool),
+        "fleet.preemption-retry-cap": lambda: fset(cfg.fleet, "preemption_retry_cap", int),
+        "fleet.redrive-delay": lambda: fset(cfg.fleet, "redrive_delay_seconds", as_dur),
+        "fleet.quarantine": lambda: fset(cfg.fleet, "quarantine_seconds", as_dur),
+        "fleet.max-quarantine-multiplier": lambda: fset(cfg.fleet, "max_quarantine_multiplier", float),
+        "fleet.suspicion-threshold": lambda: fset(cfg.fleet, "suspicion_threshold", float),
+        "fleet.suspicion-half-life": lambda: fset(cfg.fleet, "suspicion_half_life_seconds", as_dur),
+        "fleet.heartbeat-timeout": lambda: fset(cfg.fleet, "heartbeat_timeout_seconds", as_dur),
+        "fleet.fail-fast": lambda: fset(cfg.fleet, "fail_fast", as_bool),
+        "fleet.gke-spot": lambda: fset(cfg.fleet, "gke_spot", as_bool),
+        "fleet.termination-grace": lambda: fset(cfg.fleet, "termination_grace_seconds", as_dur),
         "engram.grpc-port": lambda: fset(cfg.engram, "grpc_port", int),
         "engram.max-inline-size": lambda: fset(cfg.engram, "max_inline_size", int),
         "engram.storage-timeout-seconds": lambda: fset(cfg.engram, "storage_timeout_seconds", int),

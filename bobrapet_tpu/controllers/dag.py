@@ -293,14 +293,38 @@ class DAGEngine:
             index=(INDEX_STEPRUN_STORYRUN, run.meta.name),
         )
         by_name: dict[str, Resource] = {}
+        #: per-child max-merge ledger: entries survive child retention, so
+        #: the run-level tally keeps counting after early children reap
+        #: (a plain sum over live children would freeze at the old total)
+        ledger = run.status.get("preemptionsByStep")
         for sr in children:
             step_id = sr.spec.get("stepId") or sr.meta.labels.get("bobrapet.io/step", "")
             by_name[sr.meta.name] = sr
+            p = int(sr.status.get("preemptions") or 0)
+            if p:
+                if ledger is None:
+                    ledger = run.status.setdefault("preemptionsByStep", {})
+                ledger[sr.meta.name] = max(int(ledger.get(sr.meta.name) or 0), p)
             if sr.meta.labels.get("bobrapet.io/parent-step"):
                 continue  # branch child: rolled up by the parallel timer
             if step_id:
                 states[step_id] = _merge_steprun_state(
                     states.get(step_id) or {}, sr
+                )
+        # fleet recovery surfaces on the run: total redrives + condition
+        # (child StepRuns are retention-reaped; the run keeps the record)
+        if ledger:
+            preemptions = sum(int(v) for v in ledger.values())
+            if preemptions > int(run.status.get("preemptions") or 0):
+                from ..api import conditions as api_conditions
+
+                run.status["preemptions"] = preemptions
+                api_conditions.set_condition(
+                    run.status.setdefault("conditions", []),
+                    api_conditions.PREEMPTION_RECOVERED, True,
+                    api_conditions.Reason.PREEMPTION_REDRIVE,
+                    f"{preemptions} slice preemption(s) recovered by redrive",
+                    now=self.clock.now(),
                 )
 
     # ------------------------------------------------------------------
@@ -1092,6 +1116,8 @@ def _merge_steprun_state(existing: dict[str, Any], sr: Resource) -> dict[str, An
         state.signals = sr.status.get("signals")
     if sr.status.get("retries") is not None:
         state.retries = sr.status.get("retries")
+    if sr.status.get("preemptions") is not None:
+        state.preemptions = sr.status.get("preemptions")
     if sr.status.get("exitCode") is not None:
         state.exit_code = sr.status.get("exitCode")
     if sr.status.get("exitClass"):

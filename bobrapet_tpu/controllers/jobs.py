@@ -78,11 +78,18 @@ class LocalGangExecutor:
         storage=None,
         clock: Optional[Clock] = None,
         mode: str = "sync",
+        injector=None,
+        config_manager=None,
     ):
         self.store = store
         self.storage = storage
         self.clock = clock or Clock()
         self.mode = mode
+        #: fault injection (controllers/workload_sim.PreemptionInjector):
+        #: plays the GKE spot reclaimer for chaos testing — picks gang
+        #: hosts to kill mid-step and stamps the preemption notice
+        self.injector = injector
+        self.config_manager = config_manager
         # collision-free executor identity for claim arbitration (a
         # truncated id(self) can collide across instances/processes)
         self.executor_id = uuid.uuid4().hex
@@ -162,6 +169,16 @@ class LocalGangExecutor:
         timeout = spec.get("timeoutSeconds")
 
         host_results: list[dict[str, Any]] = [{} for _ in range(hosts)]
+        # chaos: the injector may pick one host of this gang to preempt
+        # (cooperative SIGTERM after N deadline polls — the local analog
+        # of a GKE spot reclaim landing mid-step)
+        plan = self.injector.plan(job) if self.injector is not None else None
+        fuse = _PreemptionFuse(cancel, plan["afterPolls"]) if plan else None
+        fail_fast = (
+            self.config_manager.config.fleet.fail_fast
+            if self.config_manager is not None
+            else True
+        )
 
         def run_host(host_id: int) -> None:
             env = contract.host_env(dict(spec.get("env") or {}), host_id)
@@ -172,7 +189,7 @@ class LocalGangExecutor:
                 store=self.store,
                 storage=self.storage,
                 clock=self.clock,
-                cancel_event=cancel,
+                cancel_event=fuse if plan and host_id == plan["host"] else cancel,
             )
             try:
                 fn = resolve_entrypoint(entrypoint)
@@ -194,6 +211,13 @@ class LocalGangExecutor:
                     "exitCode": e.code,
                     "message": str(e),
                 }
+                # gang fail-fast: one host dying of a signal kills the
+                # whole gang now instead of the survivors burning the
+                # step timeout on dead collectives
+                if fail_fast and e.code in (
+                    contract.EXIT_SIGKILL, contract.EXIT_SIGTERM
+                ):
+                    cancel.set()
             except Exception as e:  # noqa: BLE001 - user code failure
                 host_results[host_id] = {
                     "hostId": host_id,
@@ -239,12 +263,23 @@ class LocalGangExecutor:
             with self._lock:
                 self._cancels.pop((ns, name), None)
 
-        # gang outcome: every host must succeed (all-or-nothing semantics)
+        # gang outcome: every host must succeed (all-or-nothing
+        # semantics). A non-signal failure outranks signal deaths in the
+        # aggregate: fail-fast SIGTERMs the survivors of any host crash,
+        # and a genuine application error must keep its terminal
+        # classification whatever the host ordering (and whether or not
+        # a preemption was injected in the same attempt).
         exit_code = 0
         message = ""
         for r in host_results:
             code = int(r.get("exitCode", -1))
-            if code != 0 and exit_code == 0:
+            if code == 0:
+                continue
+            signal_death = code in (contract.EXIT_SIGKILL, contract.EXIT_SIGTERM)
+            if exit_code == 0 or (
+                not signal_death
+                and exit_code in (contract.EXIT_SIGKILL, contract.EXIT_SIGTERM)
+            ):
                 exit_code = code
                 message = r.get("message", "")
         finished = self.clock.now()
@@ -254,6 +289,16 @@ class LocalGangExecutor:
         if started_at is not None:
             metrics.job_execution_duration.observe(finished - started_at, outcome)
 
+        # the notice requires the gang's outcome to BE the victim's
+        # signal death: a genuine application error on another host must
+        # keep its terminal classification even when an injection fired
+        # in the same attempt
+        preempted = bool(
+            fuse is not None
+            and fuse.fired
+            and exit_code in (contract.EXIT_SIGKILL, contract.EXIT_SIGTERM)
+        )
+
         def finish(status: dict[str, Any]) -> None:
             status["phase"] = str(Phase.SUCCEEDED if exit_code == 0 else Phase.FAILED)
             status["exitCode"] = exit_code
@@ -261,8 +306,43 @@ class LocalGangExecutor:
             status["finishedAt"] = finished
             if message:
                 status["message"] = message
+            if preempted:
+                # the node-condition half of the preemption notice: the
+                # fleet watcher + exit classifier key off this marker
+                status["preempted"] = True
+                status["preemptedHost"] = plan["host"]
 
         try:
             self.store.patch_status(JOB_KIND, ns, name, finish)
         except Exception:  # noqa: BLE001 - job may have been deleted mid-run
             _log.warning("job %s/%s vanished before completion", ns, name)
+
+
+class _PreemptionFuse:
+    """Event-shaped trigger: reads as set after N ``is_set`` polls.
+
+    Handed to the victim host's EngramContext as its cancel event, it
+    turns the next cooperative deadline check after the fuse burns down
+    into a SIGTERM — preemption lands *between* instructions, exactly
+    like a real reclaim, without a second thread."""
+
+    def __init__(self, inner: threading.Event, after_polls: int):
+        self._inner = inner
+        self._after = max(1, int(after_polls))
+        self._polls = 0
+        self.fired = False
+
+    def is_set(self) -> bool:
+        if self._inner.is_set():
+            return True
+        self._polls += 1
+        if self._polls > self._after:
+            self.fired = True
+            return True
+        return False
+
+    def set(self) -> None:
+        self._inner.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._inner.wait(timeout)

@@ -16,7 +16,7 @@ from ..sdk import contract
 from ..utils.duration import parse_duration
 
 
-def classify_exit_code(code: Optional[int]) -> ExitClass:
+def classify_exit_code(code: Optional[int], preempted: bool = False) -> ExitClass:
     """Map a worker exit code to an ExitClass
     (reference: classifyExitCode steprun_controller.go:4815):
 
@@ -30,11 +30,20 @@ def classify_exit_code(code: Optional[int]) -> ExitClass:
     - 125-127: container/config failure -> terminal
     - 1-127: application error -> terminal
     - 128-255: killed by signal -> retry
+
+    ``preempted`` is the node-condition half of a GKE preemption notice
+    (SIGTERM alone is ambiguous — a timeout kill and a slice reclaim
+    both deliver 143). When the infrastructure attests the node was
+    reclaimed, ANY nonzero death classifies as PREEMPTED, which routes
+    through the fleet subsystem's checkpoint-resuming redrive instead
+    of the user retry budget.
     """
     if code is None or code < 0:
         return ExitClass.UNKNOWN
     if code == 0:
         return ExitClass.SUCCESS
+    if preempted:
+        return ExitClass.PREEMPTED
     if code == contract.EXIT_TIMEOUT:
         return ExitClass.RETRY
     if code == contract.EXIT_RATE_LIMITED:

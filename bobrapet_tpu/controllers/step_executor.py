@@ -203,6 +203,9 @@ class StepExecutor:
             if slice_grant is not None:
                 self.placer.release(slice_grant)
             existing = self.store.get(STEP_RUN_KIND, ns, name)
+            # the surviving StepRun's grant is the live one — anything
+            # reported below must name it, not the released allocation
+            slice_grant = existing.spec.get("sliceGrant")
             if existing.spec.get("input") != spec["input"] and not (
                 existing.status.get("phase")
                 and Phase(existing.status["phase"]).is_terminal
@@ -214,6 +217,23 @@ class StepExecutor:
                     r.spec.update(drift)
 
                 self.store.mutate(STEP_RUN_KIND, ns, name, sync_spec)
+        if slice_grant is not None:
+            # surfaced into stepStates so `kubectl get storyrun -o yaml`
+            # answers "which sub-mesh is this step on" without chasing
+            # the StepRun; the fleet redrive path replaces the grant and
+            # the merge keeps this reason until the step turns terminal
+            from ..api.conditions import Reason
+
+            return StepState(
+                phase=Phase.PENDING,
+                started_at=self.clock.now(),
+                reason=Reason.SLICE_PLACED,
+                message=(
+                    f"slice {slice_grant.get('sliceId')} "
+                    f"({slice_grant.get('topology')}) on pool "
+                    f"{slice_grant.get('pool')}"
+                ),
+            )
         return StepState(phase=Phase.PENDING, started_at=self.clock.now())
 
     def _resolve_idempotency_key(self, run, step, scope) -> Optional[str]:

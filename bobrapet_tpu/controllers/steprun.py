@@ -64,6 +64,7 @@ class StepRunController:
         recorder: Optional[EventRecorder] = None,
         clock: Optional[Clock] = None,
         tracer=None,
+        fleet=None,
     ):
         self.store = store
         self.config_manager = config_manager
@@ -72,6 +73,10 @@ class StepRunController:
         self.evaluator = evaluator
         self.recorder = recorder or EventRecorder()
         self.clock = clock or Clock()
+        #: fleet.FleetManager — preemption quarantine + cordon-aware
+        #: grant replacement (None disables the recovery subsystem;
+        #: preemption-class exits then retry like plain signal deaths)
+        self.fleet = fleet
         if tracer is None:
             from ..observability.tracing import TRACER as tracer
         self.tracer = tracer
@@ -176,6 +181,35 @@ class StepRunController:
         if next_retry_at is not None and self.clock.now() < float(next_retry_at):
             return float(next_retry_at) - self.clock.now()
 
+        # --- deferred preemption re-placement: the dead gang's grant was
+        # released at redrive time but no cordon-free block fit; keep
+        # retrying — quarantine decay reopens capacity on its own ---
+        if sr.status.get("awaitingSlice") and spec.slice_grant:
+            if self.fleet is None:
+                self.store.patch_status(
+                    STEP_RUN_KIND, namespace, name,
+                    lambda st: st.pop("awaitingSlice", None),
+                )
+            else:
+                new_grant = self.fleet.place_pending(spec.slice_grant)
+                if new_grant is None:
+                    return max(
+                        0.5, self.config_manager.config.fleet.redrive_delay_seconds
+                    )
+                if not self._install_replacement_grant(namespace, name, new_grant):
+                    return None
+                # re-read instead of patching the parsed spec: parse
+                # objects are shared via cached_parse and immutable
+                sr = self.store.try_get_view(STEP_RUN_KIND, namespace, name)
+                if sr is None:
+                    return None
+                spec = parse_steprun(sr)
+                self.recorder.normal(
+                    sr, conditions.Reason.SLICE_PLACED,
+                    f"replacement slice {new_grant.get('sliceId')} granted "
+                    "after preemption",
+                )
+
         # --- resolve inputs ---
         try:
             resolved_inputs = self._resolve_inputs(
@@ -235,6 +269,13 @@ class StepRunController:
         )
         sr = self._ensure_step_contracts(sr, engram, template_spec, storyrun)
         cfg = self.config_manager.config
+        # checkpoint-resume contract: the canonical prefix always ships;
+        # after a preemption redrive the recorded latest-checkpoint step
+        # rides along so training resumes instead of restarting at zero
+        ckpt_prefix = self._checkpoint_prefix(namespace, name, spec)
+        resume = sr.status.get("resumeFrom") or {}
+        resume_step = resume.get("step")
+        preemption_attempt = int(sr.status.get("preemptions") or 0)
         env = contract.build_env(
             namespace=namespace,
             story=story_name,
@@ -258,6 +299,9 @@ class StepRunController:
             mesh_axes=slice_grant.get("meshAxes") or (tpu.mesh_axes if tpu else None),
             slice_id=slice_grant.get("sliceId"),
             trace_context=sr.status.get("trace"),
+            checkpoint_prefix=ckpt_prefix,
+            resume_step=resume_step,
+            preemption_attempt=preemption_attempt,
         )
         job = make_job(
             job_name,
@@ -286,11 +330,22 @@ class StepRunController:
             status["retries"] = retries
             status.setdefault("startedAt", self.clock.now())
             status.pop("nextRetryAt", None)
+            # consumed into this attempt's env; a later preemption
+            # recomputes it from the then-latest checkpoint
+            status.pop("resumeFrom", None)
             if ck is not None:
                 status["cacheKey"] = ck
 
         # mark first so the job-status watch can't race an unclaimed state
         self.store.patch_status(STEP_RUN_KIND, namespace, name, mark_running)
+        if resume_step is not None:
+            metrics.fleet_resumed_steps.inc()
+        if preemption_attempt and self.fleet is not None:
+            # the recovered gang is relaunching now — close the
+            # preemption-to-relaunch latency window
+            self.fleet.observe_recovery(
+                namespace, name, slice_grant.get("pool", "")
+            )
         try:
             self.store.create(job)
         except AlreadyExists:
@@ -330,6 +385,9 @@ class StepRunController:
                 resolved,
                 exit_code=job.status.get("exitCode"),
                 message=job.status.get("message", ""),
+                preempted=bool(job.status.get("preempted")),
+                preempted_host=job.status.get("preemptedHost"),
+                job_name=job_name,
             )
         return None  # still running; job watch will re-trigger us
 
@@ -392,9 +450,21 @@ class StepRunController:
         self._observe_terminal(fresh, str(Phase.SUCCEEDED))
         return None
 
-    def _handle_failure(self, sr, spec, resolved, exit_code, message):
+    def _handle_failure(
+        self, sr, spec, resolved, exit_code, message,
+        preempted=False, preempted_host=None, job_name=None,
+    ):
         namespace, name = sr.meta.namespace, sr.meta.name
-        exit_class = classify_exit_code(exit_code)
+        # without a FleetManager the preemption marker is ignored and the
+        # death classifies like any signal (retry on the user budget) —
+        # the recovery subsystem must be all-on or all-off
+        exit_class = classify_exit_code(
+            exit_code, preempted=preempted and self.fleet is not None
+        )
+        if exit_class is ExitClass.PREEMPTED:
+            return self._handle_preemption(
+                sr, spec, exit_code, message, preempted_host, job_name
+            )
         retries = int(sr.status.get("retries") or 0)
         retry_policy = resolved.retry
 
@@ -417,6 +487,8 @@ class StepRunController:
                 status["exitCode"] = exit_code
                 status["exitClass"] = str(exit_class)
                 status.pop("jobName", None)
+                # dead attempt's liveness stamps must not outlive it
+                status.pop("hostHeartbeats", None)
 
             self.store.patch_status(STEP_RUN_KIND, namespace, name, schedule)
             metrics.steprun_retries.inc(str(exit_class))
@@ -452,6 +524,176 @@ class StepRunController:
         self.store.patch_status(STEP_RUN_KIND, namespace, name, fail)
         self._observe_terminal(fresh, str(phase))
         return None
+
+    # ------------------------------------------------------------------
+    # fleet preemption recovery (TPU-native; no reference counterpart —
+    # the reference retries 137/143 from scratch on the user budget)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _checkpoint_prefix(namespace: str, name: str, spec) -> str:
+        """The one canonical checkpoint prefix — exported to the worker
+        as BOBRA_CHECKPOINT_PREFIX at launch AND probed for the resume
+        step at redrive; a single derivation so the two can't diverge."""
+        from ..sdk.checkpoint import STEP_CHECKPOINT_FIELD
+
+        run_name = spec.story_run_ref.name if spec.story_run_ref else ""
+        return StorageManager.step_key(
+            namespace, run_name or name, spec.step_id or name,
+            STEP_CHECKPOINT_FIELD,
+        )
+
+    def _install_replacement_grant(
+        self, namespace: str, name: str, new_grant: dict[str, Any]
+    ) -> bool:
+        """Commit a freshly-allocated replacement grant into the StepRun
+        spec (one atomic mutate: grant in, awaitingSlice flag out).
+        False = the StepRun vanished mid-recovery; the grant is released
+        and recovery tracking abandoned — nothing references the block,
+        so the terminal-phase release watch could never reclaim it."""
+
+        def swap(r):
+            r.spec["sliceGrant"] = new_grant
+            r.status.pop("awaitingSlice", None)
+
+        try:
+            self.store.mutate(STEP_RUN_KIND, namespace, name, swap)
+            return True
+        except NotFound:
+            self.fleet.placer.release(new_grant)
+            self.fleet.abandon_recovery(namespace, name)
+            return False
+
+    def _handle_preemption(
+        self, sr, spec, exit_code, message, preempted_host, job_name
+    ):
+        """Checkpoint-resuming gang redrive: quarantine the reclaimed
+        host's cells, re-place the gang onto a healthy sub-mesh, and
+        inject resume env — all against ``fleet.preemption-retry-cap``,
+        leaving the user policy's ``retries`` untouched."""
+        namespace, name = sr.meta.namespace, sr.meta.name
+        fleet_cfg = self.config_manager.config.fleet
+        preemptions = int(sr.status.get("preemptions") or 0)
+        grant = spec.slice_grant
+        try:
+            # external writers may stamp a node NAME here; an unknown
+            # host quarantines the whole grant block instead of wedging
+            # the reconcile
+            host = int(preempted_host) if preempted_host is not None else None
+        except (TypeError, ValueError):
+            host = None
+
+        if self.fleet is not None and grant:
+            # one event key shared with the fleet watcher (both observe
+            # the same dead Job; the registry books it once)
+            self.fleet.on_preemption(
+                grant, host=host,
+                key=f"{namespace}/{job_name}" if job_name else None,
+            )
+
+        if preemptions >= fleet_cfg.preemption_retry_cap:
+            err = StructuredError(
+                type=ErrorType.EXECUTION,
+                message=(
+                    f"preempted {preemptions + 1}x; "
+                    f"fleet.preemption-retry-cap={fleet_cfg.preemption_retry_cap} "
+                    "exhausted"
+                ),
+                exit_class=ExitClass.PREEMPTED,
+                retryable=False,
+                details={"exitCode": exit_code, "preemptions": preemptions + 1},
+            ).to_dict()
+
+            def exhaust(status: dict[str, Any]) -> None:
+                status["phase"] = str(Phase.FAILED)
+                status["exitCode"] = exit_code
+                status["exitClass"] = str(ExitClass.PREEMPTED)
+                status["preemptions"] = preemptions + 1
+                status["error"] = err
+                status["finishedAt"] = self.clock.now()
+                conds = status.setdefault("conditions", [])
+                conditions.set_condition(
+                    conds, conditions.PREEMPTION_RECOVERED, False,
+                    conditions.Reason.PREEMPTION_BUDGET_EXHAUSTED, message or "",
+                    now=self.clock.now(),
+                )
+
+            self.store.patch_status(STEP_RUN_KIND, namespace, name, exhaust)
+            self._observe_terminal(sr, str(Phase.FAILED))
+            if self.fleet is not None:
+                self.fleet.abandon_recovery(namespace, name)
+            self.recorder.warning(
+                sr, conditions.Reason.PREEMPTION_BUDGET_EXHAUSTED,
+                f"preemption retry cap {fleet_cfg.preemption_retry_cap} exhausted",
+            )
+            return None
+
+        # re-place onto a healthy sub-mesh; the dead grant is released
+        # either way (fail fast — never hold a reclaimed slice)
+        new_grant = None
+        awaiting = False
+        if grant:
+            if self.fleet is not None:
+                self.fleet.begin_recovery(namespace, name)
+                new_grant = self.fleet.replace_grant(grant)
+                awaiting = new_grant is None
+            if new_grant is not None and not self._install_replacement_grant(
+                namespace, name, new_grant
+            ):
+                return None
+
+        # resume facts for the relaunch env: the latest checkpoint this
+        # step completed before the reclaim (None -> fresh start)
+        prefix = self._checkpoint_prefix(namespace, name, spec)
+        resume_step = None
+        try:
+            # restorable, not merely newest: a reclaim mid-save leaves a
+            # partial checkpoint whose manifests can't cover the shapes
+            from ..sdk.checkpoint import latest_restorable_checkpoint_step
+
+            resume_step = latest_restorable_checkpoint_step(
+                self.storage.store, prefix
+            )
+        except Exception:  # noqa: BLE001 - storage probe is best-effort
+            pass
+
+        delay = max(0.0, fleet_cfg.redrive_delay_seconds)
+        due = self.clock.now() + delay
+
+        def redrive(status: dict[str, Any]) -> None:
+            status["phase"] = str(Phase.PENDING)
+            status["preemptions"] = preemptions + 1
+            status["nextRetryAt"] = due
+            status["exitCode"] = exit_code
+            status["exitClass"] = str(ExitClass.PREEMPTED)
+            status.pop("jobName", None)
+            # beats belong to the dead attempt; judging them stale later
+            # would book false suspicion against the REPLACEMENT grant
+            status.pop("hostHeartbeats", None)
+            if resume_step is not None:
+                status["resumeFrom"] = {"prefix": prefix, "step": resume_step}
+            if awaiting:
+                status["awaitingSlice"] = True
+            conds = status.setdefault("conditions", [])
+            conditions.set_condition(
+                conds, conditions.PREEMPTION_RECOVERED, True,
+                conditions.Reason.AWAITING_HEALTHY_SLICE if awaiting
+                else conditions.Reason.PREEMPTION_REDRIVE,
+                f"preemption {preemptions + 1}: "
+                + (f"resuming from checkpoint step {resume_step}"
+                   if resume_step is not None else "restarting from step zero"),
+                now=self.clock.now(),
+            )
+
+        self.store.patch_status(STEP_RUN_KIND, namespace, name, redrive)
+        metrics.steprun_retries.inc(str(ExitClass.PREEMPTED))
+        self.recorder.warning(
+            sr, conditions.Reason.PREEMPTION_REDRIVE,
+            f"host {preempted_host} preempted (exit {exit_code}); "
+            f"redrive {preemptions + 1}/{fleet_cfg.preemption_retry_cap}"
+            + (f", resume from step {resume_step}" if resume_step is not None
+               else ""),
+        )
+        return delay
 
     def _observe_terminal(self, sr, phase: str) -> None:
         metrics.steprun_total.inc(phase)

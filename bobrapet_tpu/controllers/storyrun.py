@@ -469,6 +469,11 @@ class StoryRunController:
                 status["stepStates"] = {}
                 status.pop("stepTimers", None)
                 status.pop("stopRequest", None)
+                # a full redrive is a fresh run-through: the fleet
+                # recovery tally restarts with it (the quarantine ledger
+                # itself lives in the health registry, not run status)
+                status.pop("preemptions", None)
+                status.pop("preemptionsByStep", None)
             else:
                 for step in affected:
                     states.pop(step, None)

@@ -1,4 +1,5 @@
-"""Local workload simulator — the "kubelet" for long-running workloads.
+"""Local workload simulator — the "kubelet" for long-running workloads,
+plus the fleet chaos half: spot-preemption fault injection.
 
 The reference relies on real kubelets to bring Deployments/StatefulSets
 up; its envtest suites simulate that by patching status
@@ -9,6 +10,12 @@ runtime: it watches Deployment/StatefulSet records and marks them ready
 drives realtime StepRuns from Pending to Running. On GKE this module is
 replaced by actual kubelets; nothing above it changes.
 
+:class:`PreemptionInjector` is the GKE spot reclaimer's stand-in: wired
+into the gang executor, it picks gang hosts to kill mid-step
+(cooperative SIGTERM + preemption notice), which drives the fleet
+subsystem's quarantine / cordon-aware re-place / checkpoint-resume
+machinery end to end in tests (the ``chaos`` pytest suite).
+
 Disable (``auto_ready=False``) to exercise Pending/handoff states in
 tests.
 """
@@ -16,13 +23,51 @@ tests.
 from __future__ import annotations
 
 import logging
-from typing import Optional
+import random
+from typing import Any, Optional
 
 from ..core.store import ADDED, MODIFIED, ResourceStore, NotFound, WatchEvent
 from .manager import Clock
 from .streaming import DEPLOYMENT_KIND, STATEFULSET_KIND
 
 _log = logging.getLogger(__name__)
+
+
+class PreemptionInjector:
+    """Seeded fault plan: preempt a fraction of slice-granted gangs.
+
+    ``plan(job)`` is consulted once per gang launch (so a redriven
+    attempt re-rolls — repeated preemptions of the same step are
+    possible, exactly like real spot capacity). A plan names one victim
+    host and a fuse length in cooperative deadline polls; hosts that
+    never poll ride out the plan unharmed, matching a workload that
+    ignores SIGTERM until the hard kill.
+    """
+
+    def __init__(
+        self,
+        rate: float = 0.1,
+        seed: int = 0,
+        min_hosts: int = 2,
+        max_polls: int = 3,
+    ):
+        self.rate = rate
+        self.min_hosts = min_hosts
+        self.max_polls = max(1, max_polls)
+        self.rng = random.Random(seed)
+        self.planned = 0
+
+    def plan(self, job) -> Optional[dict[str, Any]]:
+        hosts = int(job.spec.get("hosts") or 1)
+        if hosts < self.min_hosts or not job.spec.get("sliceGrant"):
+            return None
+        if self.rng.random() >= self.rate:
+            return None
+        self.planned += 1
+        return {
+            "host": self.rng.randrange(hosts),
+            "afterPolls": self.rng.randint(1, self.max_polls),
+        }
 
 
 class WorkloadSimulator:

@@ -1,0 +1,32 @@
+"""Slice-fleet health & preemption-recovery subsystem (TPU-native
+addition; no reference counterpart — the reference classifies 137/143
+as a plain retry and restarts steps from scratch).
+
+Three cooperating pieces:
+
+- :class:`FleetHealthRegistry` (health.py) — per-cell suspicion scoring
+  with decaying quarantine, fed by heartbeats and preemption notices;
+- :class:`FleetManager` (manager.py) — cordon-aware grant replacement
+  plus recovery-latency bookkeeping, wired into the slice placer;
+- :class:`PreemptionWatcher` (watcher.py) — cluster-event intake (Job
+  preemption notices, SDK heartbeats) feeding the registry.
+
+The checkpoint-resuming redrive itself lives in the StepRun controller
+(controllers/steprun.py ``_handle_preemption``): preemption-class exits
+re-place the gang on healthy cells and inject the resume env
+(``BOBRA_CHECKPOINT_PREFIX`` / ``BOBRA_RESUME_STEP``) without touching
+the user retry budget. See docs/FLEET.md.
+"""
+
+from .health import CellHealth, FleetHealthRegistry
+from .manager import FleetManager, grant_cells, host_cells
+from .watcher import PreemptionWatcher
+
+__all__ = [
+    "CellHealth",
+    "FleetHealthRegistry",
+    "FleetManager",
+    "PreemptionWatcher",
+    "grant_cells",
+    "host_cells",
+]

@@ -1,0 +1,204 @@
+"""Per-cell fleet health: suspicion scoring + decaying quarantine.
+
+The registry is the subsystem's single source of truth for which chip
+cells are trustworthy. Inputs are worker heartbeats (liveness) and
+cluster events (preemption notices — on GKE a spot reclaim delivers
+SIGTERM plus a node condition; locally the workload simulator's fault
+injection plays that role). Outputs are cordon sets the slice placer
+excludes from new grants.
+
+Model:
+
+- every report **decays** the cell's prior suspicion exponentially
+  (half-life ``fleet.suspicion-half-life``) before adding its weight —
+  a cell that misbehaved an hour ago is nearly clean again;
+- crossing ``fleet.suspicion-threshold`` quarantines the cell for
+  ``fleet.quarantine`` seconds, escalating 2x per strike up to
+  ``fleet.max-quarantine-multiplier`` — flaky cells sit out longer each
+  time, but always come back (spot capacity returns);
+- a preemption notice carries threshold weight by default: the cell is
+  quarantined immediately (the node is *gone*, not merely suspicious).
+
+All knobs are read live from the operator config on every report, so a
+ConfigMap reload retunes the registry like the ``controllers.*`` /
+``dataplane.*`` families.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+from ..config.operator import FleetConfig
+from ..observability.metrics import metrics
+
+Cell = tuple[int, ...]
+
+
+class CellHealth:
+    __slots__ = (
+        "suspicion", "updated_at", "quarantined_until", "strikes",
+        "last_strike_at",
+    )
+
+    def __init__(self) -> None:
+        self.suspicion = 0.0
+        self.updated_at = 0.0
+        self.quarantined_until = 0.0
+        self.strikes = 0.0  # fractional: decays between incidents
+        self.last_strike_at = 0.0
+
+
+class FleetHealthRegistry:
+    """Thread-safe per-(pool, cell) health ledger."""
+
+    def __init__(
+        self,
+        config: Optional[Callable[[], FleetConfig]] = None,
+        clock=None,
+    ):
+        self._cfg = config or FleetConfig
+        self._now = clock.now if clock is not None else time.time
+        self._lock = threading.Lock()
+        self._pools: dict[str, dict[Cell, CellHealth]] = {}
+        #: event keys already accounted (a preemption surfaces through
+        #: both the watcher and the StepRun controller — count once)
+        self._seen_events: set[str] = set()
+
+    # -- reports -----------------------------------------------------------
+
+    def report_preemption(
+        self,
+        pool: str,
+        cells: Iterable[Cell],
+        key: Optional[str] = None,
+        weight: Optional[float] = None,
+    ) -> bool:
+        """A host under ``cells`` was reclaimed. Returns False when
+        ``key`` was already accounted (idempotent across reporters)."""
+        with self._lock:
+            if key is not None:
+                if key in self._seen_events:
+                    return False
+                self._seen_events.add(key)
+                if len(self._seen_events) > 65536:
+                    self._seen_events.clear()  # cheap bound; worst case
+                    self._seen_events.add(key)  # is one double count
+            cfg = self._cfg()
+            now = self._now()
+            w = weight if weight is not None else max(cfg.suspicion_threshold, 1.0)
+            for cell in cells:
+                self._bump(pool, tuple(cell), w, now, cfg)
+            self._update_gauge_locked(pool, now)
+        metrics.fleet_preemptions.inc(pool)
+        metrics.fleet_suspect_reports.inc("preemption")
+        return True
+
+    def report_suspect(
+        self, pool: str, cells: Iterable[Cell], weight: float = 1.0,
+        source: str = "heartbeat",
+    ) -> None:
+        """Soft evidence (stale heartbeat, slow collective): adds
+        ``weight`` suspicion; quarantine only once the threshold trips."""
+        with self._lock:
+            cfg = self._cfg()
+            now = self._now()
+            for cell in cells:
+                self._bump(pool, tuple(cell), weight, now, cfg)
+            self._update_gauge_locked(pool, now)
+        metrics.fleet_suspect_reports.inc(source)
+
+    def report_healthy(self, pool: str, cells: Iterable[Cell]) -> None:
+        """A live heartbeat: decay suspicion forward (liveness is not
+        innocence — an active quarantine is never shortened)."""
+        with self._lock:
+            cfg = self._cfg()
+            now = self._now()
+            cell_map = self._pools.get(pool)
+            if not cell_map:
+                return
+            for cell in cells:
+                h = cell_map.get(tuple(cell))
+                if h is not None:
+                    self._decay(h, now, cfg)
+
+    # -- queries -----------------------------------------------------------
+
+    def quarantined_cells(self, pool: str) -> set[Cell]:
+        with self._lock:
+            now = self._now()
+            out = self._quarantined_locked(pool, now)
+            self._update_gauge_locked(pool, now)
+            return out
+
+    def is_quarantined(self, pool: str, cell: Cell) -> bool:
+        with self._lock:
+            h = self._pools.get(pool, {}).get(tuple(cell))
+            return bool(h and h.quarantined_until > self._now())
+
+    def suspicion(self, pool: str, cell: Cell) -> float:
+        with self._lock:
+            h = self._pools.get(pool, {}).get(tuple(cell))
+            if h is None:
+                return 0.0
+            cfg = self._cfg()
+            dt = max(0.0, self._now() - h.updated_at)
+            return h.suspicion * 0.5 ** (dt / cfg.suspicion_half_life_seconds)
+
+    # -- internals ---------------------------------------------------------
+
+    def _cell(self, pool: str, cell: Cell) -> CellHealth:
+        cell_map = self._pools.setdefault(pool, {})
+        h = cell_map.get(cell)
+        if h is None:
+            h = cell_map[cell] = CellHealth()
+            h.updated_at = self._now()
+        return h
+
+    @staticmethod
+    def _decay(h: CellHealth, now: float, cfg: FleetConfig) -> None:
+        dt = max(0.0, now - h.updated_at)
+        if dt:
+            h.suspicion *= 0.5 ** (dt / cfg.suspicion_half_life_seconds)
+            h.updated_at = now
+
+    def _bump(
+        self, pool: str, cell: Cell, weight: float, now: float, cfg: FleetConfig
+    ) -> None:
+        h = self._cell(pool, cell)
+        self._decay(h, now, cfg)
+        h.suspicion += weight
+        if h.suspicion >= cfg.suspicion_threshold:
+            # strikes decay too (halving per max-quarantine span spent
+            # clean): a cell that behaved for weeks must not quarantine
+            # at the escalation ceiling over one routine reclaim —
+            # escalation is for cells failing FASTER than they heal
+            if h.strikes and h.last_strike_at:
+                span = max(
+                    cfg.quarantine_seconds
+                    * max(1.0, cfg.max_quarantine_multiplier),
+                    1.0,
+                )
+                h.strikes *= 0.5 ** ((now - h.last_strike_at) / span)
+            h.strikes += 1
+            h.last_strike_at = now
+            mult = min(2.0 ** (h.strikes - 1), max(1.0, cfg.max_quarantine_multiplier))
+            h.quarantined_until = max(
+                h.quarantined_until, now + cfg.quarantine_seconds * mult
+            )
+            # the score spent itself on the quarantine; a fresh incident
+            # after release re-earns it (and lands a longer strike)
+            h.suspicion = 0.0
+
+    def _quarantined_locked(self, pool: str, now: float) -> set[Cell]:
+        return {
+            cell
+            for cell, h in self._pools.get(pool, {}).items()
+            if h.quarantined_until > now
+        }
+
+    def _update_gauge_locked(self, pool: str, now: float) -> None:
+        metrics.fleet_quarantined_cells.set(
+            len(self._quarantined_locked(pool, now)), pool
+        )

@@ -1,0 +1,180 @@
+"""FleetManager: glue between health registry, slice placer and the
+recovery paths in the StepRun controller.
+
+Owns the three recovery moves the subsystem composes:
+
+- **quarantine** — a preemption notice maps the dead host back to its
+  chip cells (grant origin + topology + chips-per-host) and books them
+  into the health registry; the placer's cordon source keeps those
+  cells out of every subsequent grant until the quarantine decays;
+- **replace** — the dead gang's grant is released immediately (fail
+  fast: never wait for the step timeout to reclaim a reclaimed slice)
+  and an equivalently-shaped block is allocated around the cordons;
+- **recovery bookkeeping** — preemption-to-relaunch latency feeds
+  ``bobrapet_fleet_recovery_seconds``.
+
+Config is read live from the operator config manager on every call, so
+``fleet.*`` ConfigMap edits apply to in-flight recoveries.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Optional
+
+from ..parallel.placement import (
+    NoCapacity,
+    PlacementError,
+    SlicePlacer,
+    SlicePool,
+    _cells,
+    parse_topology,
+)
+from ..observability.metrics import metrics
+from .health import Cell, FleetHealthRegistry
+
+_log = logging.getLogger(__name__)
+
+
+def grant_cells(grant: dict[str, Any]) -> list[Cell]:
+    """All chip cells a serialized grant covers, in the pool's canonical
+    cell order (placement._cells — host_cells' chunking depends on the
+    two never diverging)."""
+    origin = tuple(int(o) for o in (grant.get("origin") or []))
+    shape = parse_topology(grant["topology"])
+    if len(origin) != len(shape):
+        origin = origin + (0,) * (len(shape) - len(origin))
+    return list(_cells(origin, shape))
+
+
+def host_cells(grant: dict[str, Any], host: Optional[int]) -> list[Cell]:
+    """The cells host ``host`` of the gang owns (contiguous chunk of the
+    canonical cell order); the whole block when the host is unknown.
+    The LAST host absorbs any remainder of a non-dividing host count —
+    dropping those cells would leave reclaimed hardware unquarantined."""
+    cells = grant_cells(grant)
+    hosts = max(1, int(grant.get("hosts") or 1))
+    if host is None or hosts <= 1:
+        return cells
+    per = max(1, len(cells) // hosts)
+    h = min(int(host), hosts - 1)
+    start = h * per
+    chunk = cells[start:] if h == hosts - 1 else cells[start:start + per]
+    return chunk or cells
+
+
+class FleetManager:
+    def __init__(self, placer: SlicePlacer, config_manager, clock=None):
+        self.placer = placer
+        self.config_manager = config_manager
+        self.registry = FleetHealthRegistry(
+            config=lambda: config_manager.config.fleet, clock=clock
+        )
+        self._now = clock.now if clock is not None else time.time
+        #: (namespace, steprun) -> preemption detection time, pending a
+        #: successful relaunch (recovery-latency numerator)
+        self._recovering: dict[tuple[str, str], float] = {}
+        # every grant routes through the placer: keep its cordons synced
+        # with the registry so quarantine decay reopens capacity lazily
+        placer.cordon_source = self.registry.quarantined_cells
+
+    @property
+    def cfg(self):
+        return self.config_manager.config.fleet
+
+    # -- preemption intake -------------------------------------------------
+
+    def on_preemption(
+        self,
+        grant: Optional[dict[str, Any]],
+        host: Optional[int] = None,
+        key: Optional[str] = None,
+    ) -> bool:
+        """Book a preemption notice: quarantine the dead host's cells and
+        cordon them out of the pool. Idempotent per ``key``."""
+        if not grant or not grant.get("topology"):
+            return False
+        pool_name = grant.get("pool", "")
+        try:
+            cells = host_cells(grant, host)
+        except (ValueError, KeyError):
+            return False
+        fresh = self.registry.report_preemption(pool_name, cells, key=key)
+        pool = self.placer.pool(pool_name)
+        if pool is not None:
+            pool.set_cordoned(self.registry.quarantined_cells(pool_name))
+        return fresh
+
+    def report_heartbeat(self, grant: dict[str, Any], host: int) -> None:
+        try:
+            self.registry.report_healthy(grant.get("pool", ""), host_cells(grant, host))
+        except (ValueError, KeyError):
+            pass
+
+    def report_stale_host(self, grant: dict[str, Any], host: int) -> None:
+        """A gang host missed its heartbeat window: soft suspicion."""
+        try:
+            self.registry.report_suspect(
+                grant.get("pool", ""), host_cells(grant, host), source="heartbeat"
+            )
+        except (ValueError, KeyError):
+            pass
+
+    # -- grant replacement -------------------------------------------------
+
+    def replace_grant(self, grant: dict[str, Any]) -> Optional[dict[str, Any]]:
+        """Release a preempted gang's grant and allocate an equal block
+        on healthy cells. None when no cordon-free block fits right now
+        (caller parks the step; quarantine decay frees capacity)."""
+        pool = self.placer.pool(grant.get("pool", ""))
+        if pool is None:
+            return None
+        pool.release(grant.get("sliceId", ""))
+        return self._allocate_like(pool, grant)
+
+    def place_pending(self, grant: dict[str, Any]) -> Optional[dict[str, Any]]:
+        """Retry a deferred replacement (the old grant is already
+        released)."""
+        pool = self.placer.pool(grant.get("pool", ""))
+        if pool is None:
+            return None
+        return self._allocate_like(pool, grant)
+
+    def _allocate_like(
+        self, pool: SlicePool, grant: dict[str, Any]
+    ) -> Optional[dict[str, Any]]:
+        pool.set_cordoned(self.registry.quarantined_cells(pool.name))
+        try:
+            new = pool.allocate(want_topology=grant.get("topology"))
+        except (NoCapacity, PlacementError):
+            return None
+        if grant.get("hosts"):
+            new.hosts = int(grant["hosts"])
+        if grant.get("meshAxes"):
+            new.mesh_axes = dict(grant["meshAxes"])
+        if grant.get("accelerator") and not new.accelerator:
+            new.accelerator = grant["accelerator"]
+        # pool.allocate already counted this placement under "granted" —
+        # a second outcome label would double-count the decision
+        return new.to_dict()
+
+    # -- recovery latency --------------------------------------------------
+
+    def begin_recovery(self, namespace: str, steprun: str) -> None:
+        if len(self._recovering) > 4096:
+            # steps that died before relaunching (deleted, cancelled)
+            # never observe; bound the ledger — losing a latency sample
+            # beats growing forever on a spot-heavy fleet
+            self._recovering.clear()
+        self._recovering.setdefault((namespace, steprun), self._now())
+
+    def observe_recovery(self, namespace: str, steprun: str, pool: str) -> None:
+        t0 = self._recovering.pop((namespace, steprun), None)
+        if t0 is not None:
+            metrics.fleet_recovery_seconds.observe(self._now() - t0, pool)
+
+    def abandon_recovery(self, namespace: str, steprun: str) -> None:
+        """The step turned terminal without relaunching (preemption cap
+        exhausted): no latency sample, drop the pending window."""
+        self._recovering.pop((namespace, steprun), None)

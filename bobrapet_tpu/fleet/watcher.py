@@ -1,0 +1,198 @@
+"""PreemptionWatcher: cluster-event intake for the fleet subsystem.
+
+Two sources feed the health registry independently of the StepRun
+controller's own redrive path (the registry dedupes by event key):
+
+- **Job preemption notices** — a gang Job whose status carries
+  ``preempted: true`` (set by the kubelet analog: locally the gang
+  executor's fault injection, on GKE the node-condition observer)
+  quarantines the dead host's cells the moment the status lands, even
+  if the owning StepRun's reconcile is queued behind other work;
+- **worker heartbeats** — SDK ``ctx.heartbeat()`` stamps
+  ``StepRun.status.hostHeartbeats``; each beat schedules a staleness
+  probe one ``fleet.heartbeat-timeout`` later, and a host that went
+  silent while its step still runs is reported suspect (soft evidence,
+  quarantine only after repeated strikes).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..api.enums import Phase
+from ..core.store import ADDED, MODIFIED, ResourceStore, WatchEvent
+from .manager import FleetManager
+
+_log = logging.getLogger(__name__)
+
+JOB_KIND = "Job"
+STEP_RUN_KIND = "StepRun"
+
+
+class PreemptionWatcher:
+    CONTROLLER = "fleet-watcher"
+
+    def __init__(self, store: ResourceStore, fleet: FleetManager, clock=None):
+        self.store = store
+        self.fleet = fleet
+        self.clock = clock
+        self._manager = None
+        #: (ns, steprun) -> {host: last observed beat} — keyed per step
+        #: so a staleness probe touches only that step's hosts, and ONE
+        #: self-rescheduling probe per step replaces a timer per beat
+        self._beats: dict[tuple[str, str], dict[str, float]] = {}
+        self._probe_armed: set[tuple[str, str]] = set()
+        #: hosts already reported suspect for their CURRENT silence —
+        #: re-reported only after a fresh beat arrives and goes stale
+        #: again (one report per silence, never per probe)
+        self._reported: set[tuple[str, str, str]] = set()
+        #: watch callbacks arrive on writer threads (gang hosts patching
+        #: status) while probes run on reconcile workers — every access
+        #: to _beats/_reported/_probe_armed goes through this lock
+        self._lock = threading.Lock()
+        store.watch(self._on_job, kinds=[JOB_KIND])
+        store.watch(self._on_steprun, kinds=[STEP_RUN_KIND])
+
+    def attach(self, manager) -> None:
+        """Register with the reconcile manager so heartbeat staleness
+        probes self-schedule instead of waiting for unrelated events."""
+        self._manager = manager
+        manager.register(self.CONTROLLER, self._probe_stale, watches={})
+
+    # -- job preemption notices --------------------------------------------
+
+    def _on_job(self, ev: WatchEvent) -> None:
+        if ev.type not in (ADDED, MODIFIED):
+            return
+        job = ev.resource
+        if not job.status.get("preempted"):
+            return
+        grant = job.spec.get("sliceGrant")
+        if not grant:
+            return
+        host = job.status.get("preemptedHost")
+        try:
+            host = int(host) if host is not None else None
+        except (TypeError, ValueError):
+            host = None  # node-name stamp: quarantine the whole block
+        self.fleet.on_preemption(
+            grant,
+            host=host,
+            key=f"{job.meta.namespace}/{job.meta.name}",
+        )
+
+    # -- heartbeats --------------------------------------------------------
+
+    def _on_steprun(self, ev: WatchEvent) -> None:
+        if ev.type not in (ADDED, MODIFIED):
+            return
+        sr = ev.resource
+        beats = sr.status.get("hostHeartbeats")
+        grant = sr.spec.get("sliceGrant")
+        if not beats or not grant:
+            return
+        ns, name = sr.meta.namespace, sr.meta.name
+        timeout = self.fleet.cfg.heartbeat_timeout_seconds
+        fresh_hosts: list[str] = []
+        arm = False
+        with self._lock:
+            step_beats = self._beats.setdefault((ns, name), {})
+            for host, at in beats.items():
+                host = str(host)
+                if step_beats.get(host) == at:
+                    continue
+                step_beats[host] = at
+                fresh_hosts.append(host)
+                self._reported.discard((ns, name, host))
+            if (
+                fresh_hosts
+                and self._manager is not None
+                and timeout > 0
+                and (ns, name) not in self._probe_armed
+            ):
+                # one probe chain per step: _probe_stale re-arms while
+                # beats remain, so a beat storm costs zero extra timers
+                self._probe_armed.add((ns, name))
+                arm = True
+            if len(self._beats) > 8192:
+                self._beats.clear()  # bounded; next beats repopulate
+                self._probe_armed.clear()
+                self._reported.clear()
+        for host in fresh_hosts:
+            try:
+                self.fleet.report_heartbeat(grant, int(host))
+            except (TypeError, ValueError):
+                pass  # non-numeric host key from an external writer
+        if arm:
+            self._manager.enqueue(self.CONTROLLER, ns, name,
+                                  after=timeout + 0.01)
+
+    def _probe_stale(self, namespace: str, name: str) -> Optional[float]:
+        with self._lock:
+            self._probe_armed.discard((namespace, name))
+        self.sweep(namespace, name)
+        # re-arm while live beats remain — the chain dies with them
+        timeout = self.fleet.cfg.heartbeat_timeout_seconds
+        with self._lock:
+            if self._beats.get((namespace, name)) and timeout > 0:
+                self._probe_armed.add((namespace, name))
+                return timeout + 0.01
+        return None
+
+    def sweep(self, namespace: str, name: str) -> None:
+        """Report gang hosts whose beat went stale while the step still
+        runs; consumed entries re-arm on the next beat."""
+        import time
+
+        sr = self.store.try_get_view(STEP_RUN_KIND, namespace, name)
+        now = self.clock.now() if self.clock is not None else time.time()
+        if sr is None or (
+            sr.status.get("phase")
+            and Phase(sr.status["phase"]).is_terminal
+        ):
+            self._drop_step(namespace, name)
+            return
+        grant = sr.spec.get("sliceGrant") or {}
+        timeout = self.fleet.cfg.heartbeat_timeout_seconds
+        if not grant or timeout <= 0:
+            return
+        # only hosts still stamped in status count: a redrive clears
+        # hostHeartbeats, and judging the dead attempt's beats stale
+        # would book suspicion against the REPLACEMENT grant's cells
+        live = sr.status.get("hostHeartbeats") or {}
+        stale_hosts: list[str] = []
+        with self._lock:
+            step_beats = self._beats.get((namespace, name))
+            if not step_beats:
+                return
+            for host in list(step_beats):
+                key = (namespace, name, host)
+                if host not in live:
+                    step_beats.pop(host, None)
+                    self._reported.discard(key)
+                    continue
+                # the stale entry stays (a pop would resurrect it from
+                # the old status stamp on the next peer beat); _reported
+                # keeps one silence from re-reporting per probe
+                if (
+                    now - step_beats[host] > timeout
+                    and key not in self._reported
+                ):
+                    self._reported.add(key)
+                    stale_hosts.append(host)
+            if not step_beats:
+                self._beats.pop((namespace, name), None)
+        for host in stale_hosts:
+            try:
+                self.fleet.report_stale_host(grant, int(host))
+            except (TypeError, ValueError):
+                pass  # non-numeric host key from an external writer
+
+    def _drop_step(self, namespace: str, name: str) -> None:
+        with self._lock:
+            self._beats.pop((namespace, name), None)
+            self._reported = {
+                k for k in self._reported if k[:2] != (namespace, name)
+            }

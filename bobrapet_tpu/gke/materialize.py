@@ -38,6 +38,7 @@ from .podspec import (
 # GKE node labels (public contract; see parse in api/enums.AcceleratorType)
 NODE_SELECTOR_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
 NODE_SELECTOR_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+NODE_SELECTOR_SPOT = "cloud.google.com/gke-spot"
 TPU_RESOURCE = "google.com/tpu"
 COMPLETION_INDEX_ANNOTATION = "batch.kubernetes.io/job-completion-index"
 JOBSET_REPLICATED_JOB = "gang"
@@ -101,6 +102,8 @@ def materialize_gang_job(
     resources: Optional[dict[str, Any]] = None,
     jobset: bool = False,
     hosts: Optional[int] = None,
+    termination_grace_seconds: Optional[int] = None,
+    spot: bool = False,
 ) -> list[dict[str, Any]]:
     """One batch gang → [headless Service, Indexed Job] (or [JobSet]).
 
@@ -109,6 +112,14 @@ def materialize_gang_job(
     materialized: chip limits, topology/accelerator node selectors, and
     the env contract the gang executor applies locally
     (completion-index → TPU_WORKER_ID, worker hostnames, coordinator).
+
+    Preemption support (fleet subsystem): ``termination_grace_seconds``
+    sets the pod's SIGTERM→SIGKILL window so a reclaimed worker can cut
+    a final checkpoint before the node goes away, and ``spot`` adds the
+    GKE spot-VM nodeSelector + toleration so gangs land on preemptible
+    slices deliberately. Resume facts (``BOBRA_CHECKPOINT_PREFIX`` /
+    ``BOBRA_RESUME_STEP``) arrive through ``env`` like every other
+    contract field — a redriven Job's manifest carries them verbatim.
     """
     # gang width: the grant's host count when placed, else the caller's
     # declared hosts (a multi-host gang can exist before placement)
@@ -122,10 +133,17 @@ def materialize_gang_job(
     svc_name = f"{name}-workers"
 
     node_selector: dict[str, str] = {}
+    tolerations: list[dict[str, Any]] = []
     pod_resources: dict[str, Any] = dict(resources or {})
     full_env = dict(env)
     if entrypoint:
         full_env.setdefault("BOBRA_ENTRYPOINT", entrypoint)
+    if spot:
+        node_selector[NODE_SELECTOR_SPOT] = "true"
+        tolerations.append({
+            "key": NODE_SELECTOR_SPOT, "operator": "Equal",
+            "value": "true", "effect": "NoSchedule",
+        })
 
     if grant is not None:
         chips = _tpu_chips_per_host(grant)
@@ -182,10 +200,12 @@ def materialize_gang_job(
             env=env_list,
             resources=pod_resources,
             node_selector=node_selector,
+            tolerations=tolerations,
             restart_policy="Never",
             subdomain=svc_name if grant is not None else None,
             service_account_name=service_account,
             automount_service_account_token=True,
+            termination_grace_period_seconds=termination_grace_seconds,
             ports=[{"name": "coordinator", "containerPort": coordinator_port}]
             if grant is not None
             else [],
@@ -373,10 +393,27 @@ class GKEMaterializer:
         default_image: str = "bobrapet/engram-runner:latest",
         service_account: Optional[str] = None,
         jobset: bool = False,
+        spot: bool = False,
+        termination_grace_seconds: Optional[int] = None,
     ):
         self.default_image = default_image
         self.service_account = service_account
         self.jobset = jobset
+        #: target preemptible slices (spot VMs) + the graceful-termination
+        #: window a reclaimed worker gets to cut a final checkpoint
+        self.spot = spot
+        self.termination_grace_seconds = termination_grace_seconds
+
+    @classmethod
+    def from_fleet_config(cls, fleet_cfg, **kwargs) -> "GKEMaterializer":
+        """Materializer honoring the ``fleet.gke-spot`` /
+        ``fleet.termination-grace`` operator knobs (docs/FLEET.md)."""
+        grace = int(fleet_cfg.termination_grace_seconds)
+        return cls(
+            spot=fleet_cfg.gke_spot,
+            termination_grace_seconds=grace if grace > 0 else None,
+            **kwargs,
+        )
 
     def materialize_job(self, job) -> list[dict[str, Any]]:
         """Bus Job resource (controllers/jobs.py:make_job) → manifests."""
@@ -393,6 +430,8 @@ class GKEMaterializer:
             service_account=self.service_account,
             jobset=self.jobset,
             hosts=spec.get("hosts"),
+            spot=self.spot,
+            termination_grace_seconds=self.termination_grace_seconds,
         )
 
     def materialize_deployment(self, dep, kind: str = "Deployment") -> list[dict[str, Any]]:

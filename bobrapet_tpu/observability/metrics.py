@@ -323,6 +323,35 @@ class _ControlPlaneMetrics:
         self.slice_placements = c(
             "bobrapet_slice_placements_total", "Sub-mesh placement decisions", ["outcome"]
         )
+        # Fleet health & preemption recovery (bobrapet_tpu/fleet; TPU-native
+        # addition — the reference retries whole steps and knows nothing of
+        # slice reclamation)
+        self.fleet_preemptions = c(
+            "bobrapet_fleet_preemptions_total",
+            "Slice preemptions detected (gang host reclaimed mid-step)",
+            ["pool"],
+        )
+        self.fleet_quarantined_cells = g(
+            "bobrapet_fleet_quarantined_cells",
+            "Chip cells currently quarantined by the health registry",
+            ["pool"],
+        )
+        self.fleet_recovery_seconds = h(
+            "bobrapet_fleet_recovery_seconds",
+            "Preemption detection to resumed-gang relaunch latency",
+            ["pool"],
+        )
+        self.fleet_resumed_steps = c(
+            "bobrapet_fleet_resumed_steps_total",
+            "Gang relaunches that resumed from a step checkpoint "
+            "(vs restarting from step zero)",
+            [],
+        )
+        self.fleet_suspect_reports = c(
+            "bobrapet_fleet_suspect_reports_total",
+            "Cell suspicion reports by source",
+            ["source"],
+        )
         # Transport family (reference: pkg/metrics/transport.go:11-35)
         self.binding_ops = c(
             "bobrapet_transport_binding_ops_total", "Binding create/update ops", ["op"]

@@ -20,7 +20,9 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import threading
-from typing import Any, Optional
+from typing import Any, Callable, Iterable, Optional
+
+from ..observability.metrics import metrics
 
 
 def parse_topology(topology: str) -> tuple[int, ...]:
@@ -97,6 +99,9 @@ class SlicePool:
         self.accelerator = accelerator
         self.host_addresses = host_addresses or []
         self._occupied: set[tuple[int, ...]] = set()
+        #: cells cordoned by fleet health (quarantined hardware): excluded
+        #: from new grants but still released normally by in-flight ones
+        self._cordoned: set[tuple[int, ...]] = set()
         self._grants: dict[str, tuple[tuple[int, ...], tuple[int, ...]]] = {}
         self._lock = threading.Lock()
         self._counter = 0
@@ -111,6 +116,26 @@ class SlicePool:
     def free_chips(self) -> int:
         with self._lock:
             return self.total_chips - len(self._occupied)
+
+    # -- cordons (fleet health) --------------------------------------------
+
+    def set_cordoned(self, cells: Iterable[tuple[int, ...]]) -> None:
+        """Replace the cordon set (cells the health registry currently
+        quarantines). Idempotent full-sync: decayed quarantines drop out
+        by simply not being in the next sync."""
+        cordoned = {tuple(c) for c in cells}
+        with self._lock:
+            self._cordoned = cordoned
+
+    def cordoned_chips(self) -> int:
+        with self._lock:
+            return len(self._cordoned)
+
+    def schedulable_chips(self) -> int:
+        """Chips neither granted nor cordoned (an upper bound on what a
+        new grant could cover; contiguity may admit less)."""
+        with self._lock:
+            return self.total_chips - len(self._occupied | self._cordoned)
 
     # -- allocation --------------------------------------------------------
 
@@ -138,9 +163,11 @@ class SlicePool:
         with self._lock:
             origin = self._find_block(shape)
             if origin is None:
+                metrics.slice_placements.inc("no-capacity")
                 raise NoCapacity(
                     f"pool {self.name}: no free {shape} block "
-                    f"({self.total_chips - len(self._occupied)} chips free)"
+                    f"({self.total_chips - len(self._occupied)} chips free, "
+                    f"{len(self._cordoned)} cordoned)"
                 )
             for cell in _cells(origin, shape):
                 self._occupied.add(cell)
@@ -150,6 +177,8 @@ class SlicePool:
         n_chips = 1
         for s in shape:
             n_chips *= s
+        metrics.slice_placements.inc("granted")
+        metrics.gang_chips_in_use.add(n_chips)
         hosts = max(1, n_chips // self.chips_per_host)
         coord = self.host_addresses[0] if self.host_addresses else None
         return SliceGrant(
@@ -169,8 +198,11 @@ class SlicePool:
             if grant is None:
                 return
             origin, shape = grant
+            n = 0
             for cell in _cells(origin, shape):
                 self._occupied.discard(cell)
+                n += 1
+        metrics.gang_chips_in_use.add(-n)
 
     # -- internals ---------------------------------------------------------
 
@@ -194,9 +226,13 @@ class SlicePool:
         return best
 
     def _find_block(self, shape: tuple[int, ...]) -> Optional[tuple[int, ...]]:
+        blocked = (
+            self._occupied if not self._cordoned
+            else self._occupied | self._cordoned
+        )
         ranges = [range(d - s + 1) for d, s in zip(self.dims, shape)]
         for origin in itertools.product(*ranges):
-            if all(cell not in self._occupied for cell in _cells(origin, shape)):
+            if all(cell not in blocked for cell in _cells(origin, shape)):
                 return origin
         return None
 
@@ -220,6 +256,13 @@ class SlicePlacer:
         if "local" not in self._pools:
             # degenerate local pool: one host, one chip — CPU/dev default
             self._pools["local"] = SlicePool("local", "1", chips_per_host=1)
+        #: fleet-health hook: pool name -> currently quarantined cells.
+        #: Synced into the pool's cordon set before every grant so a
+        #: decayed quarantine reopens capacity without an explicit event
+        #: (set by the runtime to FleetHealthRegistry.quarantined_cells).
+        self.cordon_source: Optional[
+            Callable[[str], Iterable[tuple[int, ...]]]
+        ] = None
 
     def add_pool(self, pool: SlicePool) -> None:
         self._pools[pool.name] = pool
@@ -243,6 +286,8 @@ class SlicePlacer:
         ):
             return None
         pool = self._pools.get(queue or "") or self._pools["local"]
+        if self.cordon_source is not None:
+            pool.set_cordoned(self.cordon_source(pool.name))
         grant = pool.allocate(
             want_topology=tpu_policy.topology, chips=tpu_policy.chips
         )

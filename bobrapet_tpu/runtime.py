@@ -58,6 +58,7 @@ from .controllers.triggers import EffectClaimController, StoryTriggerController
 from .controllers.workload_sim import WorkloadSimulator
 from .core.events import EventRecorder
 from .core.store import DELETED, ResourceStore, WatchEvent
+from .fleet import FleetManager, PreemptionWatcher
 from .parallel.placement import SlicePlacer
 from .storage.manager import StorageManager
 from .storage.store import MemoryStore, Store
@@ -86,6 +87,7 @@ class Runtime:
         config_namespace: str = "bobrapet-system",
         enable_webhooks: bool = True,
         tracer=None,
+        preemption_injector=None,
     ):
         self.clock = clock or ManualClock()
         if tracer is None:
@@ -106,6 +108,11 @@ class Runtime:
             blob_store or MemoryStore(), max_inline_size=cfg.engram.max_inline_size
         )
         self.placer = placer or SlicePlacer()
+        # fleet health & preemption recovery: quarantine ledger + cordon
+        # hook on the placer + grant replacement (reads fleet.* live)
+        self.fleet = FleetManager(
+            self.placer, self.config_manager, clock=self.clock
+        )
         self.resolver = Resolver(cfg)
         self.config_manager.subscribe(self._on_config_change)
 
@@ -131,7 +138,11 @@ class Runtime:
         self.steprun_controller = StepRunController(
             self.store, self.config_manager, self.resolver, self.storage,
             self.evaluator, recorder=self.recorder, clock=self.clock,
-            tracer=self.tracer,
+            tracer=self.tracer, fleet=self.fleet,
+        )
+        # cluster-event intake: Job preemption notices + SDK heartbeats
+        self.preemption_watcher = PreemptionWatcher(
+            self.store, self.fleet, clock=self.clock
         )
         self.story_controller = StoryController(
             self.store, recorder=self.recorder, clock=self.clock
@@ -183,11 +194,20 @@ class Runtime:
                     self.cluster, store=self.store, storage=self.storage,
                     clock=self.clock, mode=executor_mode,
                 )
+            # gang manifests honor the fleet.gke-spot / termination-grace
+            # knobs (spot slice targeting + final-checkpoint window)
+            from .gke import GKEMaterializer
+
+            fleet_materializer = GKEMaterializer.from_fleet_config(
+                self.config_manager.config.fleet
+            )
             self.job_executor = ClusterExecutor(
-                self.store, self.cluster, clock=self.clock
+                self.store, self.cluster, clock=self.clock,
+                materializer=fleet_materializer,
             )
             self.workload_reconciler = ClusterWorkloadReconciler(
-                self.store, self.cluster, clock=self.clock
+                self.store, self.cluster, clock=self.clock,
+                materializer=fleet_materializer,
             )
             if cr_sync:
                 # kubectl front door: the 12 CRD kinds mirror between
@@ -205,7 +225,9 @@ class Runtime:
                 )
         else:
             self.job_executor = LocalGangExecutor(
-                self.store, storage=self.storage, clock=self.clock, mode=executor_mode
+                self.store, storage=self.storage, clock=self.clock,
+                mode=executor_mode, injector=preemption_injector,
+                config_manager=self.config_manager,
             )
             # local "kubelet" for long-running workloads (realtime + impulse)
             self.workload_simulator = WorkloadSimulator(self.store, clock=self.clock)
@@ -220,6 +242,8 @@ class Runtime:
         # timed re-probes so warmup-gated readiness self-completes
         if self.workload_simulator is not None:
             self.workload_simulator.attach(self.manager)
+        # heartbeat-staleness probes self-schedule through the manager
+        self.preemption_watcher.attach(self.manager)
         if executor_backend == "cluster":
             self.workload_reconciler.attach(self.manager)
         self._register_controllers()
@@ -242,6 +266,23 @@ class Runtime:
         from .dataplane.hub import apply_tuning
 
         apply_tuning(cfg.dataplane)
+        # fleet.gke-spot / fleet.termination-grace are live like every
+        # other fleet.* knob: retune the cluster materializer IN PLACE
+        # (replacing it would discard operator customization such as
+        # default_image/service_account/jobset) so the NEXT gang pods
+        # carry the new spot/grace facts
+        if getattr(self, "job_executor", None) is not None and hasattr(
+            self.job_executor, "materializer"
+        ):
+            grace = int(cfg.fleet.termination_grace_seconds)
+            for holder in (self.job_executor,
+                           getattr(self, "workload_reconciler", None)):
+                if holder is None:
+                    continue
+                holder.materializer.spot = cfg.fleet.gke_spot
+                holder.materializer.termination_grace_seconds = (
+                    grace if grace > 0 else None
+                )
 
     # ------------------------------------------------------------------
     def _register_indexes(self) -> None:
@@ -755,7 +796,9 @@ class Runtime:
         from .controllers.streaming import DEPLOYMENT_KIND, STATEFULSET_KIND
         from .gke import GKEMaterializer
 
-        m = materializer or GKEMaterializer()
+        m = materializer or GKEMaterializer.from_fleet_config(
+            self.config_manager.config.fleet
+        )
         manifests: list[dict] = []
         for job in self.store.list(JOB_KIND, namespace):
             manifests.extend(m.materialize_job(job))

@@ -34,6 +34,12 @@ from ..storage.store import BlobNotFound, Store
 
 MANIFEST_PREFIX = "manifest-"
 
+#: final path segment of a step's canonical checkpoint prefix
+#: (``runs/<ns>/<run>/steps/<step>/model-ckpt``) — shared between the
+#: SDK (EngramContext.checkpoint_prefix) and the StepRun controller's
+#: preemption-redrive resume probe so the two can never diverge
+STEP_CHECKPOINT_FIELD = "model-ckpt"
+
 
 def _manifest_key(process: int) -> str:
     return f"{MANIFEST_PREFIX}{process:05d}.json"
@@ -198,6 +204,43 @@ def latest_checkpoint_step(store: Store, prefix: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+def _manifest_covers(manifest: dict[str, Any]) -> bool:
+    """True when every leaf's shard set covers its full shape (shards
+    are disjoint by construction, so coverage == volume sum)."""
+    for entry in manifest["leaves"]:
+        total = 1
+        for d in entry["shape"]:
+            total *= d
+        covered = 0
+        for key in entry["shards"]:
+            vol = 1
+            for start, stop in _parse_shard_key(key):
+                vol *= stop - start
+            covered += vol
+        if covered < total:
+            return False
+    return True
+
+
+def latest_restorable_checkpoint_step(
+    store: Store, prefix: str
+) -> Optional[int]:
+    """Newest step whose merged manifests cover every leaf completely.
+
+    A preemption can land MID-SAVE: the newest step then has some
+    hosts' manifests/shards missing, and advertising it (e.g. as
+    ``BOBRA_RESUME_STEP``) would point resume at state that cannot
+    stitch. Manifest-only check — no shard blobs are read."""
+    for step in reversed(checkpoint_steps(store, prefix)):
+        ckpt = f"{prefix}/ckpt-{step:012d}"
+        try:
+            if _manifest_covers(_load_merged_manifest(store, ckpt)):
+                return step
+        except (BlobNotFound, StorageMismatch, ValueError, KeyError):
+            continue
+    return None
+
+
 def delete_checkpoint(store: Store, prefix: str, step: int) -> None:
     ckpt = f"{prefix}/ckpt-{step:012d}"
     for key in store.list(ckpt):
@@ -278,13 +321,37 @@ def restore_checkpoint(
     the target sharding each restored array is placed with (pass your
     freshly-initialized train state — its values are discarded).
     Returns (state, step). Raises BlobNotFound when no checkpoint exists.
+
+    Without an explicit ``step``, candidates are tried newest-first: a
+    preemption can land MID-SAVE, leaving the newest step with some
+    hosts' manifests/shards missing — such a partial checkpoint fails
+    to stitch and restore falls back to the previous complete one
+    instead of surfacing the failure (which callers would turn into a
+    from-scratch restart, the exact loss checkpointing exists to
+    prevent).
     """
+    if step is not None:
+        return _restore_one(store, prefix, like, step)
+    steps = checkpoint_steps(store, prefix)
+    if not steps:
+        raise BlobNotFound(f"{prefix}: no checkpoint found")
+    last_err: Exception = BlobNotFound(f"{prefix}: no checkpoint found")
+    for candidate in reversed(steps):
+        try:
+            return _restore_one(store, prefix, like, candidate)
+        # ValueError/KeyError cover truncated/corrupt manifests (a
+        # SIGKILL mid-save can leave half-written JSON) — same clause
+        # as latest_restorable_checkpoint_step, so the probes agree
+        except (BlobNotFound, StorageMismatch, ValueError, KeyError) as e:
+            last_err = e
+    raise last_err
+
+
+def _restore_one(
+    store: Store, prefix: str, like: Any, step: int
+) -> tuple[Any, int]:
     import jax
 
-    if step is None:
-        step = latest_checkpoint_step(store, prefix)
-        if step is None:
-            raise BlobNotFound(f"{prefix}: no checkpoint found")
     ckpt = f"{prefix}/ckpt-{step:012d}"
     manifest = _load_merged_manifest(store, ckpt)
 

@@ -171,6 +171,40 @@ class EngramContext:
         raw = self.env.get(contract.ENV_CONFIG)
         return json.loads(raw) if raw else {}
 
+    # -- preemption recovery (fleet subsystem) -----------------------------
+
+    @property
+    def resume_step(self) -> Optional[int]:
+        """Latest complete checkpoint step the operator observed when
+        redriving this gang after a preemption; None on a fresh launch.
+        Resume-aware engrams skip to this step instead of restoring
+        blind (``restore_model_checkpoint`` finds it either way)."""
+        raw = self.env.get(contract.ENV_RESUME_STEP)
+        return int(raw) if raw is not None else None
+
+    @property
+    def preemption_attempt(self) -> int:
+        """How many times this step has been preemption-redriven."""
+        return int(self.env.get(contract.ENV_PREEMPTION_ATTEMPT, "0"))
+
+    def heartbeat(self) -> None:
+        """Stamp this host's liveness into StepRun.status.hostHeartbeats.
+        The fleet preemption watcher treats a stale beat as a suspect
+        cell (cluster-event analog of a GKE node condition)."""
+        if self._store is None or not self.step_run:
+            return
+        import time
+
+        # wall clock, never 0.0: a zero stamp reads as infinitely stale
+        # and would earn a live host endless suspicion
+        at = self._clock.now() if self._clock is not None else time.time()
+        host = str(self.host_id)
+
+        def patch(status: dict[str, Any]) -> None:
+            status.setdefault("hostHeartbeats", {})[host] = at
+
+        self._store.patch_status("StepRun", self.namespace, self.step_run, patch)
+
     # -- deadline / cancel -------------------------------------------------
 
     def check_deadline(self) -> None:
@@ -266,22 +300,32 @@ class EngramContext:
         """Blob-key prefix for this step's model checkpoints — stable
         across retries AND redrives (keyed on run + step id, not the
         StepRun instance), so a redriven training step finds its
-        predecessor's state (SURVEY §5.4)."""
+        predecessor's state (SURVEY §5.4). The operator exports the same
+        canonical prefix through the env contract
+        (``BOBRA_CHECKPOINT_PREFIX``) — the env wins when present so the
+        two sides can never disagree about where resume state lives."""
+        explicit = self.env.get(contract.ENV_CHECKPOINT_PREFIX)
+        if explicit:
+            return explicit
         from ..storage.manager import StorageManager
+        from .checkpoint import STEP_CHECKPOINT_FIELD
 
         return StorageManager.step_key(
-            self.namespace, self.story_run, self.step, "model-ckpt"
+            self.namespace, self.story_run, self.step, STEP_CHECKPOINT_FIELD
         )
 
     def save_model_checkpoint(self, state: Any, step: int, keep: int = 2) -> str:
         """Sharded save of a train-state pytree (params/opt_state/...)
-        into the run's storage provider; see sdk/checkpoint.py."""
+        into the run's storage provider; see sdk/checkpoint.py. Each
+        gang host writes its own shards + manifest (host id = process),
+        so multi-host gangs cooperatively produce one checkpoint."""
         if self._storage is None:
             raise RuntimeError("no storage manager configured for checkpoints")
         from .checkpoint import save_checkpoint
 
         return save_checkpoint(
-            self._storage.store, self.checkpoint_prefix, state, step, keep=keep
+            self._storage.store, self.checkpoint_prefix, state, step, keep=keep,
+            process=self.host_id, world=self.num_hosts,
         )
 
     def restore_model_checkpoint(

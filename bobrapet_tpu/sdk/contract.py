@@ -72,6 +72,15 @@ ENV_SLICE_ID = "BOBRA_SLICE_ID"  # granted ICI-contiguous sub-mesh id
 ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
 ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
 
+# checkpoint-resume contract (fleet preemption recovery; TPU-native
+# addition). The operator always exports the step's canonical checkpoint
+# prefix; after a preemption redrive it also exports the latest complete
+# checkpoint step so training resumes instead of restarting at step zero
+# (docs/TRAINING.md "Checkpoint-resume env contract").
+ENV_CHECKPOINT_PREFIX = "BOBRA_CHECKPOINT_PREFIX"
+ENV_RESUME_STEP = "BOBRA_RESUME_STEP"
+ENV_PREEMPTION_ATTEMPT = "BOBRA_PREEMPTION_ATTEMPT"  # redrives so far
+
 # exit codes with contractual meaning (reference: classifyExitCode
 # steprun_controller.go:4815)
 EXIT_SUCCESS = 0
@@ -111,6 +120,9 @@ def build_env(
     mesh_axes: Optional[dict[str, int]] = None,
     slice_id: Optional[str] = None,
     trace_context: Optional[dict[str, Any]] = None,
+    checkpoint_prefix: Optional[str] = None,
+    resume_step: Optional[int] = None,
+    preemption_attempt: int = 0,
 ) -> dict[str, str]:
     """Render the per-step env contract (host-independent portion).
 
@@ -155,6 +167,12 @@ def build_env(
         env[ENV_SLICE_ID] = slice_id
     if trace_context:
         env[ENV_TRACE_CONTEXT] = json.dumps(trace_context, separators=(",", ":"))
+    if checkpoint_prefix:
+        env[ENV_CHECKPOINT_PREFIX] = checkpoint_prefix
+    if resume_step is not None:
+        env[ENV_RESUME_STEP] = str(int(resume_step))
+    if preemption_attempt:
+        env[ENV_PREEMPTION_ATTEMPT] = str(int(preemption_attempt))
     return env
 
 

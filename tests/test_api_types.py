@@ -300,6 +300,7 @@ class TestStepStateFastPathParity:
         "startedAt": 1.5, "finishedAt": 2.5, "retries": 2,
         "output": {"a": [1, {"b": 2}]}, "outputRef": {"key": "k"},
         "signals": {"s": 1}, "exitCode": 3, "exitClass": "retry",
+        "preemptions": 1,
     }
 
     def test_roundtrip_matches_generic_walk(self):

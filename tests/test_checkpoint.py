@@ -233,6 +233,52 @@ class TestMultiHost:
         assert step == 0
         np.testing.assert_array_equal(full, np.asarray(restored["w"]))
 
+    def test_partial_newest_checkpoint_falls_back_to_complete_one(self):
+        """A preemption can land MID-SAVE: the newest step has host 0's
+        manifest but not host 1's shards. Step-unset restore must fall
+        back to the previous complete checkpoint instead of raising (a
+        raise turns into a from-scratch restart upstream)."""
+        import json as _json
+
+        store = MemoryStore()
+        full = np.arange(32, dtype=np.float32).reshape(8, 4)
+
+        def host_write(ckpt, process, shard_key, data, step):
+            store.put(f"{ckpt}/leaf-0/{shard_key}", data.tobytes())
+            manifest = {
+                "step": step,
+                "treedef": "PyTreeDef({'w': *})",
+                "leaves": [{
+                    "path": "['w']", "index": 0, "shape": [8, 4],
+                    "dtype": "float32", "shards": [shard_key],
+                }],
+            }
+            store.put(f"{ckpt}/manifest-{process:05d}.json",
+                      _json.dumps(manifest).encode())
+
+        # complete checkpoint at step 3
+        host_write("ck/ckpt-000000000003", 0, "0-4_0-4", full[:4], 3)
+        host_write("ck/ckpt-000000000003", 1, "4-8_0-4", full[4:], 3)
+        # partial checkpoint at step 4: host 1 never wrote
+        host_write("ck/ckpt-000000000004", 0, "0-4_0-4", full[:4] + 1, 4)
+
+        like = {"w": jnp.zeros((8, 4))}
+        restored, step = restore_checkpoint(store, "ck", like)
+        assert step == 3
+        np.testing.assert_array_equal(full, np.asarray(restored["w"]))
+        # explicit step still surfaces the partial failure
+        with pytest.raises(Exception):
+            restore_checkpoint(store, "ck", like, step=4)
+        # the controller's resume probe skips the partial step too, so
+        # BOBRA_RESUME_STEP never advertises unrestorable state
+        from bobrapet_tpu.sdk.checkpoint import (
+            latest_checkpoint_step,
+            latest_restorable_checkpoint_step,
+        )
+
+        assert latest_checkpoint_step(store, "ck") == 4
+        assert latest_restorable_checkpoint_step(store, "ck") == 3
+
     def test_restored_plain_numpy_leaf_is_writable(self):
         store = MemoryStore()
         state = {"ema": np.ones((4, 4), np.float32)}

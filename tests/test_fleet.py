@@ -1,0 +1,438 @@
+"""Fleet health & preemption-recovery subsystem: unit layer.
+
+Covers the health registry (suspicion scoring, decay, quarantine
+escalation), cordon-aware SlicePool allocation (exclusion +
+fragmentation + NoCapacity-not-misshape), PREEMPTED exit
+classification, the fleet.* config family, the checkpoint-resume env
+contract, retry-delay determinism satellites, and the webhook cert
+fallback-dir hardening.
+"""
+
+from __future__ import annotations
+
+import os
+import stat
+
+import pytest
+
+from bobrapet_tpu.api.enums import BackoffStrategy, ExitClass
+from bobrapet_tpu.api.shared import RetryPolicy
+from bobrapet_tpu.config.operator import FleetConfig, parse_config
+from bobrapet_tpu.controllers.manager import ManualClock
+from bobrapet_tpu.controllers.retry import classify_exit_code, compute_retry_delay
+from bobrapet_tpu.fleet import FleetHealthRegistry, grant_cells, host_cells
+from bobrapet_tpu.observability.metrics import metrics
+from bobrapet_tpu.parallel.placement import NoCapacity, SlicePool, parse_topology
+
+
+def _registry(clock, **overrides):
+    cfg = FleetConfig(**overrides)
+    return FleetHealthRegistry(config=lambda: cfg, clock=clock)
+
+
+class TestHealthRegistry:
+    def test_preemption_quarantines_immediately(self):
+        clock = ManualClock()
+        reg = _registry(clock, quarantine_seconds=100.0)
+        reg.report_preemption("p", [(0, 0), (0, 1)], key="e1")
+        assert reg.is_quarantined("p", (0, 0))
+        assert reg.quarantined_cells("p") == {(0, 0), (0, 1)}
+        assert metrics.fleet_quarantined_cells.value("p") == 2
+
+    def test_event_key_dedupes_across_reporters(self):
+        clock = ManualClock()
+        reg = _registry(clock)
+        assert reg.report_preemption("p", [(0, 0)], key="job-1")
+        assert not reg.report_preemption("p", [(0, 0)], key="job-1")
+        assert metrics.fleet_preemptions.value("p") == 1
+
+    def test_quarantine_decays_out(self):
+        clock = ManualClock()
+        reg = _registry(clock, quarantine_seconds=50.0)
+        reg.report_preemption("p", [(1, 1)], key="e")
+        clock.advance(51.0)
+        assert not reg.is_quarantined("p", (1, 1))
+        assert reg.quarantined_cells("p") == set()
+        assert metrics.fleet_quarantined_cells.value("p") == 0
+
+    def test_repeat_offender_quarantine_escalates(self):
+        clock = ManualClock()
+        reg = _registry(clock, quarantine_seconds=50.0,
+                        max_quarantine_multiplier=8.0)
+        reg.report_preemption("p", [(2, 2)], key="a")  # strike 1: 50s
+        clock.advance(51.0)
+        assert not reg.is_quarantined("p", (2, 2))
+        reg.report_preemption("p", [(2, 2)], key="b")  # strike 2: 100s
+        clock.advance(51.0)
+        assert reg.is_quarantined("p", (2, 2))
+        clock.advance(50.0)
+        assert not reg.is_quarantined("p", (2, 2))
+
+    def test_suspicion_accumulates_to_threshold(self):
+        clock = ManualClock()
+        reg = _registry(clock, suspicion_threshold=2.0,
+                        suspicion_half_life_seconds=1000.0)
+        reg.report_suspect("p", [(3, 3)], weight=1.0)
+        assert not reg.is_quarantined("p", (3, 3))
+        reg.report_suspect("p", [(3, 3)], weight=1.0)
+        assert reg.is_quarantined("p", (3, 3))
+
+    def test_suspicion_decays_below_threshold(self):
+        clock = ManualClock()
+        reg = _registry(clock, suspicion_threshold=2.0,
+                        suspicion_half_life_seconds=10.0)
+        reg.report_suspect("p", [(4, 4)], weight=1.5)
+        clock.advance(20.0)  # two half-lives: 1.5 -> 0.375
+        assert reg.suspicion("p", (4, 4)) == pytest.approx(0.375)
+        reg.report_suspect("p", [(4, 4)], weight=1.0)
+        assert not reg.is_quarantined("p", (4, 4))
+
+    def test_healthy_report_never_shortens_quarantine(self):
+        clock = ManualClock()
+        reg = _registry(clock, quarantine_seconds=100.0)
+        reg.report_preemption("p", [(5, 5)], key="e")
+        reg.report_healthy("p", [(5, 5)])
+        assert reg.is_quarantined("p", (5, 5))
+
+
+class TestGrantCellMapping:
+    GRANT = {"topology": "2x4", "origin": [1, 0], "hosts": 2, "pool": "p"}
+
+    def test_grant_cells_cover_block(self):
+        cells = grant_cells(self.GRANT)
+        assert len(cells) == 8
+        assert cells[0] == (1, 0) and cells[-1] == (2, 3)
+
+    def test_host_cells_partition_block(self):
+        h0 = host_cells(self.GRANT, 0)
+        h1 = host_cells(self.GRANT, 1)
+        assert len(h0) == len(h1) == 4
+        assert not set(h0) & set(h1)
+        assert set(h0) | set(h1) == set(grant_cells(self.GRANT))
+
+    def test_unknown_host_means_whole_block(self):
+        assert host_cells(self.GRANT, None) == grant_cells(self.GRANT)
+
+
+class TestCordonAwarePool:
+    def test_cordoned_cells_excluded_from_grants(self):
+        pool = SlicePool("p", "2x2")
+        pool.set_cordoned({(0, 0)})
+        with pytest.raises(NoCapacity):
+            pool.allocate(want_topology="2x2")
+        # a block that avoids the cordon still fits
+        g = pool.allocate(want_topology="1x2")
+        assert tuple(g.origin) == (1, 0)
+
+    def test_grant_around_quarantine_stays_contiguous_and_shaped(self):
+        """Exclusion must never produce a mis-shaped or fragmented
+        grant: what comes back is exactly the requested block, placed
+        on non-cordoned cells."""
+        pool = SlicePool("p", "4x4", chips_per_host=2)
+        pool.set_cordoned({(1, 1), (1, 2)})  # hole in the middle
+        g = pool.allocate(want_topology="2x4")
+        assert parse_topology(g.topology) == (2, 4)
+        cells = {
+            (g.origin[0] + i, g.origin[1] + j)
+            for i in range(2) for j in range(4)
+        }
+        assert not cells & {(1, 1), (1, 2)}
+        assert len(cells) == 8
+
+    def test_fragmented_free_capacity_raises_no_capacity(self):
+        """Free chips exist but no contiguous block: NoCapacity, never
+        a smaller/mis-shaped grant."""
+        pool = SlicePool("p", "4x1")
+        pool.set_cordoned({(1, 0), (3, 0)})  # free cells 0 and 2, split
+        assert pool.schedulable_chips() == 2
+        with pytest.raises(NoCapacity):
+            pool.allocate(want_topology="2x1")
+        g = pool.allocate(want_topology="1x1")  # single cells still fit
+        assert parse_topology(g.topology) == (1, 1)
+
+    def test_cordon_release_and_resync(self):
+        pool = SlicePool("p", "2x2")
+        pool.set_cordoned({(0, 0), (0, 1), (1, 0), (1, 1)})
+        with pytest.raises(NoCapacity):
+            pool.allocate(want_topology="1x1")
+        pool.set_cordoned(set())  # quarantine decayed -> full sync drops it
+        g = pool.allocate(want_topology="2x2")
+        assert g.hosts >= 1
+
+    def test_release_still_works_for_cordoned_grant_cells(self):
+        pool = SlicePool("p", "2x2")
+        g = pool.allocate(want_topology="2x2")
+        pool.set_cordoned({(0, 0)})  # cordon lands under a live grant
+        pool.release(g.slice_id)
+        assert pool.free_chips() == 4
+        assert pool.schedulable_chips() == 3
+
+
+class TestPreemptedClassification:
+    def test_sigterm_with_node_condition_is_preempted(self):
+        assert classify_exit_code(143, preempted=True) is ExitClass.PREEMPTED
+        assert classify_exit_code(137, preempted=True) is ExitClass.PREEMPTED
+
+    def test_any_nonzero_death_on_reclaimed_node_is_preempted(self):
+        assert classify_exit_code(1, preempted=True) is ExitClass.PREEMPTED
+        assert classify_exit_code(124, preempted=True) is ExitClass.PREEMPTED
+
+    def test_success_and_unknown_win_over_the_flag(self):
+        assert classify_exit_code(0, preempted=True) is ExitClass.SUCCESS
+        assert classify_exit_code(None, preempted=True) is ExitClass.UNKNOWN
+
+    def test_without_flag_sigterm_stays_plain_retry(self):
+        assert classify_exit_code(143) is ExitClass.RETRY
+
+    def test_preempted_class_budget_semantics(self):
+        assert ExitClass.PREEMPTED.is_retryable
+        assert not ExitClass.PREEMPTED.consumes_retry_budget
+
+
+class TestFleetConfig:
+    def test_dotted_keys_parse(self):
+        cfg = parse_config({
+            "fleet.preemption-retry-cap": "7",
+            "fleet.redrive-delay": "2s",
+            "fleet.quarantine": "10m",
+            "fleet.suspicion-threshold": "3.5",
+            "fleet.suspicion-half-life": "5m",
+            "fleet.heartbeat-timeout": "90s",
+            "fleet.fail-fast": "false",
+            "fleet.max-quarantine-multiplier": "4",
+        })
+        f = cfg.fleet
+        assert f.preemption_retry_cap == 7
+        assert f.redrive_delay_seconds == 2.0
+        assert f.quarantine_seconds == 600.0
+        assert f.suspicion_threshold == 3.5
+        assert f.suspicion_half_life_seconds == 300.0
+        assert f.heartbeat_timeout_seconds == 90.0
+        assert f.fail_fast is False
+        assert f.max_quarantine_multiplier == 4.0
+
+    def test_invalid_values_keep_defaults(self):
+        cfg = parse_config({"fleet.preemption-retry-cap": "banana"})
+        assert cfg.fleet.preemption_retry_cap == FleetConfig().preemption_retry_cap
+
+    def test_validation_rejects_bad_tree(self):
+        cfg = FleetConfig(preemption_retry_cap=-1)
+        from bobrapet_tpu.config.operator import OperatorConfig
+
+        errs = OperatorConfig(fleet=cfg).validate()
+        assert any("fleet.preemption-retry-cap" in e for e in errs)
+
+    def test_live_reload_through_configmap(self, rt):
+        """fleet.* keys reload like controllers.*/dataplane.* — via the
+        operator ConfigMap resource, no restart."""
+        from bobrapet_tpu.core.object import new_resource
+
+        assert rt.config_manager.config.fleet.preemption_retry_cap == 5
+        rt.store.create(new_resource(
+            "ConfigMap", "operator-config", "bobrapet-system",
+            {"data": {"fleet.preemption-retry-cap": "2",
+                      "fleet.quarantine": "42s"}},
+        ))
+        assert rt.config_manager.config.fleet.preemption_retry_cap == 2
+        assert rt.config_manager.config.fleet.quarantine_seconds == 42.0
+        # the fleet manager reads the same live tree
+        assert rt.fleet.cfg.preemption_retry_cap == 2
+
+
+class TestGKEFleetWiring:
+    def test_materializer_honors_fleet_knobs(self):
+        from bobrapet_tpu.gke import GKEMaterializer
+
+        cfg = FleetConfig(gke_spot=True, termination_grace_seconds=45.0)
+        m = GKEMaterializer.from_fleet_config(cfg)
+        assert m.spot is True
+        assert m.termination_grace_seconds == 45
+        off = GKEMaterializer.from_fleet_config(
+            FleetConfig(termination_grace_seconds=0.0)
+        )
+        assert off.termination_grace_seconds is None
+
+    def test_spot_and_grace_keys_parse(self):
+        cfg = parse_config({"fleet.gke-spot": "true",
+                            "fleet.termination-grace": "90s"})
+        assert cfg.fleet.gke_spot is True
+        assert cfg.fleet.termination_grace_seconds == 90.0
+
+    def test_gang_manifest_carries_spot_and_grace(self):
+        from bobrapet_tpu.gke import GKEMaterializer
+        from bobrapet_tpu.controllers.jobs import make_job
+
+        job = make_job(
+            "j1", "default", "sr1", entrypoint="e", env={}, hosts=2,
+            slice_grant={"sliceId": "p-s1", "pool": "p", "topology": "2x2",
+                         "hosts": 2, "origin": [0, 0], "meshAxes": {}},
+        )
+        m = GKEMaterializer.from_fleet_config(
+            FleetConfig(gke_spot=True, termination_grace_seconds=45.0)
+        )
+        k8s_job = [x for x in m.materialize_job(job) if x["kind"] == "Job"][0]
+        pod = k8s_job["spec"]["template"]["spec"]
+        assert pod["terminationGracePeriodSeconds"] == 45
+        assert pod["nodeSelector"]["cloud.google.com/gke-spot"] == "true"
+        assert any(t["key"] == "cloud.google.com/gke-spot"
+                   for t in pod["tolerations"])
+
+
+class TestResumeEnvContract:
+    def test_resume_fields_render(self):
+        from bobrapet_tpu.sdk import contract
+
+        env = contract.build_env(
+            namespace="ns", story="s", story_run="r", step="fit",
+            step_run="sr", checkpoint_prefix="runs/ns/r/steps/fit/model-ckpt",
+            resume_step=12, preemption_attempt=2,
+        )
+        assert env[contract.ENV_CHECKPOINT_PREFIX] == "runs/ns/r/steps/fit/model-ckpt"
+        assert env[contract.ENV_RESUME_STEP] == "12"
+        assert env[contract.ENV_PREEMPTION_ATTEMPT] == "2"
+
+    def test_fresh_launch_omits_resume(self):
+        from bobrapet_tpu.sdk import contract
+
+        env = contract.build_env(
+            namespace="ns", story="s", story_run="r", step="fit",
+            step_run="sr", checkpoint_prefix="p",
+        )
+        assert contract.ENV_RESUME_STEP not in env
+        assert contract.ENV_PREEMPTION_ATTEMPT not in env
+
+    def test_context_reads_resume_fields(self):
+        from bobrapet_tpu.sdk import contract
+        from bobrapet_tpu.sdk.context import EngramContext
+
+        ctx = EngramContext({
+            contract.ENV_CHECKPOINT_PREFIX: "explicit/prefix",
+            contract.ENV_RESUME_STEP: "7",
+            contract.ENV_PREEMPTION_ATTEMPT: "1",
+        })
+        assert ctx.checkpoint_prefix == "explicit/prefix"
+        assert ctx.resume_step == 7
+        assert ctx.preemption_attempt == 1
+
+    def test_context_prefix_defaults_to_canonical(self):
+        from bobrapet_tpu.sdk import contract
+        from bobrapet_tpu.sdk.context import EngramContext
+
+        ctx = EngramContext({
+            contract.ENV_NAMESPACE: "ns",
+            contract.ENV_STORY_RUN: "r",
+            contract.ENV_STEP: "fit",
+        })
+        assert ctx.checkpoint_prefix == "runs/ns/r/steps/fit/model-ckpt"
+        assert ctx.resume_step is None
+
+
+class TestRetryDelaySatellites:
+    """ISSUE 3 satellite: compute_retry_delay was only exercised
+    indirectly — pin down seeded-jitter determinism and the backoff-cap
+    boundary."""
+
+    def test_seeded_jitter_is_deterministic(self):
+        import random
+
+        policy = RetryPolicy(delay="10s", max_delay="300s", jitter=20,
+                             backoff=BackoffStrategy.EXPONENTIAL)
+        a = compute_retry_delay(policy, attempt=3, rng=random.Random(42))
+        b = compute_retry_delay(policy, attempt=3, rng=random.Random(42))
+        c = compute_retry_delay(policy, attempt=3, rng=random.Random(43))
+        assert a == b
+        assert a != c  # different seed actually moves the draw
+
+    def test_jitter_stays_within_pct_band(self):
+        import random
+
+        policy = RetryPolicy(delay="10s", max_delay="1000s", jitter=25)
+        base = 10.0 * 2 ** 2  # attempt 3 exponential
+        for seed in range(50):
+            d = compute_retry_delay(policy, attempt=3, rng=random.Random(seed))
+            assert base * 0.75 <= d <= base * 1.25
+
+    def test_cap_boundary_exact_hit(self):
+        # exponential 5 * 2^5 = 160 == max_delay: no clamping distortion
+        policy = RetryPolicy(delay="5s", max_delay="160s", jitter=0)
+        assert compute_retry_delay(policy, attempt=6) == 160.0
+        # one attempt later the cap clamps
+        assert compute_retry_delay(policy, attempt=7) == 160.0
+
+    def test_cap_applies_before_jitter(self):
+        """Jitter is applied to the capped delay, so a +pct draw can
+        exceed max_delay by at most the jitter band — never by the
+        uncapped exponential."""
+        import random
+
+        policy = RetryPolicy(delay="100s", max_delay="100s", jitter=10)
+        for seed in range(20):
+            d = compute_retry_delay(policy, attempt=10, rng=random.Random(seed))
+            assert 90.0 <= d <= 110.0
+
+    def test_linear_and_constant_strategies(self):
+        lin = RetryPolicy(delay="7s", max_delay="300s", jitter=0,
+                          backoff=BackoffStrategy.LINEAR)
+        assert compute_retry_delay(lin, attempt=4) == 28.0
+        const = RetryPolicy(delay="7s", max_delay="300s", jitter=0,
+                            backoff=BackoffStrategy.CONSTANT)
+        assert compute_retry_delay(const, attempt=4) == 7.0
+
+    def test_rate_limited_floor(self):
+        policy = RetryPolicy(delay="1s", max_delay="300s", jitter=0)
+        assert compute_retry_delay(policy, attempt=1, rate_limited=True) == 30.0
+
+    def test_zero_jitter_no_rng_needed(self):
+        policy = RetryPolicy(delay="5s", max_delay="300s", jitter=0)
+        assert compute_retry_delay(policy, attempt=1) == 5.0
+
+
+class TestSecureCertFallbackDir:
+    """ISSUE 3 satellite (advisor r5): the webhook cert fallback dir
+    must be per-user 0700, never a predictable world-accessible path."""
+
+    def test_creates_per_user_0700_dir(self, tmp_path):
+        from bobrapet_tpu.cluster.certs import secure_fallback_cert_dir
+
+        path = secure_fallback_cert_dir(base=str(tmp_path))
+        assert os.path.isdir(path)
+        assert str(os.getuid()) in os.path.basename(path)
+        assert stat.S_IMODE(os.lstat(path).st_mode) == 0o700
+
+    def test_world_writable_dir_drops_key_material(self, tmp_path):
+        from bobrapet_tpu.cluster.certs import secure_fallback_cert_dir
+
+        uid = os.getuid()
+        loose = tmp_path / f"bobrapet-webhook-certs-{uid}"
+        loose.mkdir(mode=0o777)
+        os.chmod(loose, 0o777)  # mkdir is umask-filtered; force it
+        (loose / "tls.key").write_text("PLANTED")
+        (loose / "ca.key").write_text("PLANTED")
+        (loose / "tls.crt").write_text("cert stays")
+        path = secure_fallback_cert_dir(base=str(tmp_path))
+        assert path == str(loose)
+        assert not os.path.exists(loose / "tls.key")
+        assert not os.path.exists(loose / "ca.key")
+        assert os.path.exists(loose / "tls.crt")
+        assert stat.S_IMODE(os.lstat(path).st_mode) == 0o700
+
+    def test_symlink_fallback_refused(self, tmp_path):
+        from bobrapet_tpu.cluster.certs import CertError, secure_fallback_cert_dir
+
+        uid = os.getuid()
+        real = tmp_path / "elsewhere"
+        real.mkdir()
+        os.symlink(real, tmp_path / f"bobrapet-webhook-certs-{uid}")
+        with pytest.raises(CertError):
+            secure_fallback_cert_dir(base=str(tmp_path))
+
+    def test_private_dir_reused_untouched(self, tmp_path):
+        from bobrapet_tpu.cluster.certs import secure_fallback_cert_dir
+
+        first = secure_fallback_cert_dir(base=str(tmp_path))
+        with open(os.path.join(first, "tls.key"), "w") as f:
+            f.write("mine")
+        second = secure_fallback_cert_dir(base=str(tmp_path))
+        assert first == second
+        with open(os.path.join(first, "tls.key")) as f:
+            assert f.read() == "mine"

@@ -1,0 +1,362 @@
+"""Fleet chaos suite: fault-injected preemption recovery end to end.
+
+Runs in tier-1 (not marked slow); select explicitly with ``-m chaos``.
+The injector (controllers/workload_sim.PreemptionInjector) plays the GKE
+spot reclaimer: it kills gang hosts mid-step with SIGTERM + a preemption
+notice, which must drive quarantine, cordon-aware re-placement, and
+checkpoint-resuming redrive — with zero lost runs and zero user retry
+budget consumed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from bobrapet_tpu.api.catalog import make_engram_template
+from bobrapet_tpu.api.engram import make_engram
+from bobrapet_tpu.api.story import make_story
+from bobrapet_tpu.controllers.workload_sim import PreemptionInjector
+from bobrapet_tpu.fleet import grant_cells
+from bobrapet_tpu.observability.metrics import metrics
+from bobrapet_tpu.parallel.placement import SlicePool
+from bobrapet_tpu.runtime import Runtime
+from bobrapet_tpu.sdk import register_engram
+
+pytestmark = pytest.mark.chaos
+
+TRAIN_STEPS = 6
+#: params after an uninterrupted run: zeros + sum(1..TRAIN_STEPS)
+REFERENCE_PARAMS = [float(sum(range(1, TRAIN_STEPS + 1)))] * 4
+
+
+class ScriptedInjector(PreemptionInjector):
+    """Deterministic plan list instead of a seeded rate."""
+
+    def __init__(self, plans):
+        super().__init__(rate=0.0)
+        self._plans = list(plans)
+
+    def plan(self, job):
+        if not self._plans:
+            return None
+        if int(job.spec.get("hosts") or 1) < self.min_hosts:
+            return None
+        if not job.spec.get("sliceGrant"):
+            return None
+        self.planned += 1
+        return self._plans.pop(0)
+
+
+def _training_rt(injector, pool_topology="4x4", chips_per_host=2):
+    rt = Runtime(preemption_injector=injector)
+    # assertions read StepRuns after the drain: park retention far past
+    # the virtual-time horizon (same pattern as test_scale_soak)
+    rt.config_manager.config.retention.children_ttl_seconds = 7 * 86400.0
+    rt.config_manager.config.retention.storyrun_retention_seconds = 14 * 86400.0
+    rt.placer.add_pool(
+        SlicePool("v5e", pool_topology, chips_per_host=chips_per_host)
+    )
+
+    @register_engram("chaos-train")
+    def train(ctx):
+        steps_total = int(ctx.inputs.get("steps", TRAIN_STEPS))
+        if ctx.host_id != 0:
+            # worker hosts: cooperative SIGTERM points once per step
+            for _ in range(steps_total):
+                ctx.check_deadline()
+            return None
+        state = {"params": np.zeros(4), "step": 0}
+        restored = ctx.restore_model_checkpoint(state)
+        start = 0
+        if restored is not None:
+            state, start = restored
+            start = int(start)
+        params = np.asarray(state["params"]).copy()
+        for s in range(start, steps_total):
+            ctx.check_deadline()  # preemption lands between checkpoints
+            params = params + (s + 1)  # deterministic update rule
+            ctx.save_model_checkpoint(
+                {"params": params, "step": s + 1}, step=s + 1
+            )
+        return {"params": params.tolist(), "resumedFrom": start}
+
+    rt.apply(make_engram_template("chaos-tpl", entrypoint="chaos-train"))
+    rt.apply(make_engram("chaos-trainer", "chaos-tpl"))
+    rt.apply(make_story("chaos-train", steps=[
+        {"name": "fit", "ref": {"name": "chaos-trainer"},
+         "with": {"steps": TRAIN_STEPS},
+         "tpu": {"topology": "2x2", "meshAxes": {"data": 2, "model": 2}}},
+    ], policy={"queue": "v5e"}))
+    return rt
+
+
+def drain(rt, max_virtual_seconds=43_200.0):
+    while rt.pump(max_virtual_seconds=max_virtual_seconds) > 0:
+        pass
+
+
+def _steprun(rt, run_name):
+    srs = [
+        sr for sr in rt.store.list("StepRun")
+        if (sr.spec.get("storyRunRef") or {}).get("name") == run_name
+    ]
+    assert len(srs) == 1
+    return srs[0]
+
+
+def _condition(obj, ctype):
+    for c in obj.status.get("conditions") or []:
+        if c.get("type") == ctype:
+            return c
+    return None
+
+
+class TestSinglePreemptionRecovery:
+    def test_redrive_resumes_from_checkpoint(self, rt):
+        del rt  # fixture unused; chaos runtimes carry injectors
+        inj = ScriptedInjector([{"host": 0, "afterPolls": 3}])
+        rt = _training_rt(inj)
+        run = rt.run_story("chaos-train")
+        drain(rt)
+
+        assert rt.run_phase(run) == "Succeeded"
+        sr = _steprun(rt, run)
+        # param delta vs the uninterrupted run is exactly 0.0
+        assert sr.status["output"]["params"] == REFERENCE_PARAMS
+        # ...and it actually resumed mid-stream, not from step zero
+        assert sr.status["output"]["resumedFrom"] > 0
+        assert sr.status.get("preemptions") == 1
+        # the user retry budget was NOT consumed
+        assert int(sr.status.get("retries") or 0) == 0
+
+        cond = _condition(sr, "PreemptionRecovered")
+        assert cond and cond["status"] == "True"
+        srun = rt.store.get("StoryRun", "default", run)
+        assert srun.status.get("preemptions") == 1
+        rcond = _condition(srun, "PreemptionRecovered")
+        assert rcond and rcond["status"] == "True"
+
+        assert metrics.fleet_preemptions.value("v5e") == 1
+        assert metrics.fleet_resumed_steps.value() == 1
+        assert metrics.fleet_recovery_seconds.count("v5e") == 1
+        assert metrics.fleet_quarantined_cells.value("v5e") == 2
+
+    def test_replacement_grant_avoids_quarantined_cells(self, rt):
+        del rt
+        inj = ScriptedInjector([{"host": 1, "afterPolls": 2}])
+        rt = _training_rt(inj)
+        run = rt.run_story("chaos-train")
+        drain(rt)
+
+        assert rt.run_phase(run) == "Succeeded"
+        sr = _steprun(rt, run)
+        new_grant = sr.spec["sliceGrant"]
+        quarantined = rt.fleet.registry.quarantined_cells("v5e")
+        assert quarantined  # the dead host's cells are booked
+        assert not set(grant_cells(new_grant)) & quarantined
+
+    def test_worker_host_preemption_also_recovers(self, rt):
+        """Victim host 1 (not the trainer): the gang fail-fast kills
+        host 0 too; redrive resumes whatever host 0 checkpointed."""
+        del rt
+        inj = ScriptedInjector([{"host": 1, "afterPolls": 1}])
+        rt = _training_rt(inj)
+        run = rt.run_story("chaos-train")
+        drain(rt)
+        assert rt.run_phase(run) == "Succeeded"
+        sr = _steprun(rt, run)
+        assert sr.status["output"]["params"] == REFERENCE_PARAMS
+        assert sr.status.get("preemptions") == 1
+
+
+class TestPreemptionBudget:
+    def test_cap_exhaustion_turns_terminal(self, rt):
+        del rt
+        # every attempt dies after one training step
+        inj = ScriptedInjector([{"host": 0, "afterPolls": 1}] * 10)
+        rt = _training_rt(inj)
+        rt.config_manager.config.fleet.preemption_retry_cap = 2
+        run = rt.run_story("chaos-train")
+        drain(rt)
+
+        assert rt.run_phase(run) == "Failed"
+        sr = _steprun(rt, run)
+        assert sr.status["phase"] == "Failed"
+        assert sr.status["exitClass"] == "preempted"
+        assert sr.status["preemptions"] == 3  # cap 2 + the terminal one
+        assert "preemption-retry-cap" in sr.status["error"]["message"]
+        # even a terminal preemption never touched the user budget
+        assert int(sr.status.get("retries") or 0) == 0
+        cond = _condition(sr, "PreemptionRecovered")
+        assert cond and cond["status"] == "False"
+        assert cond["reason"] == "PreemptionBudgetExhausted"
+
+    def test_user_retry_budget_still_independent(self, rt):
+        """An application failure AFTER a preemption recovery consumes
+        the user budget; the preemption tally stays separate."""
+        del rt
+        inj = ScriptedInjector([{"host": 0, "afterPolls": 2}])
+        rt = Runtime(preemption_injector=inj)
+        rt.config_manager.config.retention.children_ttl_seconds = 7 * 86400.0
+        rt.config_manager.config.retention.storyrun_retention_seconds = 14 * 86400.0
+        rt.placer.add_pool(SlicePool("v5e", "4x4", chips_per_host=2))
+        calls = {"n": 0}
+
+        @register_engram("flaky-train")
+        def train(ctx):
+            if ctx.host_id != 0:
+                for _ in range(4):
+                    ctx.check_deadline()
+                return None
+            for _ in range(4):
+                ctx.check_deadline()
+            calls["n"] += 1
+            if calls["n"] == 2:  # first post-preemption attempt fails
+                raise RuntimeError("app bug")
+            return {"ok": calls["n"]}
+
+        rt.apply(make_engram_template("flaky-tpl", entrypoint="flaky-train"))
+        rt.apply(make_engram("flaky", "flaky-tpl"))
+        rt.apply(make_story("flaky-story", steps=[
+            {"name": "fit", "ref": {"name": "flaky"},
+             "tpu": {"topology": "2x2"},
+             "execution": {"retry": {"maxRetries": 2, "delay": "1s"}}},
+        ], policy={"queue": "v5e"}))
+        run = rt.run_story("flaky-story")
+        drain(rt)
+
+        sr = _steprun(rt, run)
+        # exit 1 is TERMINAL class (application error): the run fails,
+        # but the two ledgers stayed independent
+        assert sr.status.get("preemptions") == 1
+        assert int(sr.status.get("retries") or 0) == 0
+
+
+class TestHeartbeatStaleness:
+    def test_stale_gang_host_reported_suspect(self, rt):
+        from bobrapet_tpu.core.object import new_resource
+
+        grant = {"sliceId": "v5e-s1", "pool": "v5e", "topology": "2x2",
+                 "hosts": 2, "origin": [0, 0], "meshAxes": {}}
+        rt.placer.add_pool(SlicePool("v5e", "4x4", chips_per_host=2))
+        rt.store.create(new_resource(
+            "StepRun", "hb-test", "default",
+            {"stepId": "fit", "sliceGrant": grant,
+             "storyRunRef": {"name": "hb-run"},
+             "engramRef": {"name": "hb-engram"}},
+        ))
+        rt.store.patch_status(
+            "StepRun", "default", "hb-test",
+            lambda st: st.update(
+                {"phase": "Running",
+                 "hostHeartbeats": {"0": rt.clock.now(), "1": rt.clock.now()}}
+            ),
+        )
+        # host 1 goes silent past fleet.heartbeat-timeout (60s default)
+        rt.clock.advance(45.0)
+        rt.store.patch_status(
+            "StepRun", "default", "hb-test",
+            lambda st: st["hostHeartbeats"].__setitem__("0", rt.clock.now()),
+        )
+        rt.clock.advance(45.0)
+        rt.preemption_watcher.sweep("default", "hb-test")
+        reg = rt.fleet.registry
+        assert reg.suspicion("v5e", (1, 0)) > 0  # host 1's cells
+        assert reg.suspicion("v5e", (0, 0)) == 0  # host 0 kept beating
+
+    def test_redrive_cleared_beats_are_not_judged_stale(self, rt):
+        """A preemption redrive pops status.hostHeartbeats; the dead
+        attempt's beats must not book suspicion against the REPLACEMENT
+        grant's cells."""
+        from bobrapet_tpu.core.object import new_resource
+
+        grant = {"sliceId": "v5e-s1", "pool": "v5e", "topology": "2x2",
+                 "hosts": 2, "origin": [0, 0], "meshAxes": {}}
+        rt.placer.add_pool(SlicePool("v5e", "4x4", chips_per_host=2))
+        rt.store.create(new_resource(
+            "StepRun", "hb-redrive", "default",
+            {"stepId": "fit", "sliceGrant": grant,
+             "storyRunRef": {"name": "hb-run"},
+             "engramRef": {"name": "hb-engram"}},
+        ))
+        rt.store.patch_status(
+            "StepRun", "default", "hb-redrive",
+            lambda st: st.update(
+                {"phase": "Running",
+                 "hostHeartbeats": {"0": rt.clock.now(), "1": rt.clock.now()}}
+            ),
+        )
+        # the redrive patch clears the dead attempt's beats
+        rt.store.patch_status(
+            "StepRun", "default", "hb-redrive",
+            lambda st: (st.pop("hostHeartbeats", None),
+                        st.__setitem__("phase", "Pending")),
+        )
+        rt.clock.advance(120.0)
+        rt.preemption_watcher.sweep("default", "hb-redrive")
+        reg = rt.fleet.registry
+        assert reg.suspicion("v5e", (0, 0)) == 0
+        assert reg.suspicion("v5e", (1, 0)) == 0
+
+
+class TestChaosSoak:
+    def test_200_run_soak_zero_lost_runs(self, rt):
+        """Acceptance: >=10% of multi-host steps killed mid-run across a
+        200-run soak; every StoryRun completes, preempted steps resume
+        from the latest checkpoint with zero parameter delta, user retry
+        budgets stay untouched, and the fleet metrics are populated."""
+        del rt
+        inj = PreemptionInjector(rate=0.2, seed=1234, min_hosts=2)
+        rt = _training_rt(inj)
+        # short quarantine so the 16-chip pool never starves the soak
+        rt.config_manager.config.fleet.quarantine_seconds = 60.0
+
+        # 200 runs in waves of 25: the priority gate is O(queue peers)
+        # per launch attempt, so a single 200-run dump measures the
+        # scheduler's worst case instead of the fleet machinery
+        n, wave = 200, 25
+        runs = []
+        for i in range(0, n, wave):
+            runs.extend(rt.run_story("chaos-train") for _ in range(wave))
+            drain(rt)
+
+        phases = [rt.run_phase(r) for r in runs]
+        assert phases.count("Succeeded") == n, (
+            f"lost {n - phases.count('Succeeded')} runs: "
+            f"{[p for p in phases if p != 'Succeeded'][:5]}"
+        )
+
+        preempted_runs = 0
+        resumed_runs = 0
+        for r in runs:
+            sr = _steprun(rt, r)
+            out = sr.status["output"]
+            # post-resume parameter delta vs uninterrupted run == 0.0
+            assert out["params"] == REFERENCE_PARAMS, (r, out)
+            p = int(sr.status.get("preemptions") or 0)
+            if p:
+                preempted_runs += 1
+                # preemption redrives never consume the user budget
+                assert int(sr.status.get("retries") or 0) == 0
+            if out["resumedFrom"] > 0:
+                resumed_runs += 1
+                assert p > 0  # only recovered gangs resume mid-stream
+
+        # injection level: >=10% of the multi-host steps were killed
+        assert preempted_runs >= n // 10, (
+            f"only {preempted_runs}/{n} runs preempted — injector too quiet"
+        )
+        assert resumed_runs > 0
+
+        total_preemptions = metrics.fleet_preemptions.value("v5e")
+        assert total_preemptions >= preempted_runs
+        # a run preempted k times relaunches k times, each resuming from
+        # its newest checkpoint (first-attempt-before-any-checkpoint
+        # kills redrive without resume env, hence <= total)
+        assert resumed_runs <= metrics.fleet_resumed_steps.value() <= total_preemptions
+        # every preemption's recovery latency was observed
+        assert metrics.fleet_recovery_seconds.count("v5e") == total_preemptions
+        # the quarantine gauge series exists on the scrape page
+        page = metrics.fleet_quarantined_cells.expose()
+        assert 'bobrapet_fleet_quarantined_cells{pool="v5e"}' in page
