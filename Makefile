@@ -2,7 +2,7 @@
 
 NATIVE_SO  := native/libblobcache.so native/libstreamhub.so
 
-.PHONY: all native test test-e2e test-e2e-apiserver test-e2e-kind lint bench clean crds chart image
+.PHONY: all native test test-e2e test-e2e-apiserver test-e2e-kind lint analyze bench clean crds chart image
 
 all: native
 
@@ -34,6 +34,12 @@ lint:
 		echo "ruff not found; running compileall sweep"; \
 		python -m compileall -q bobrapet_tpu tests bench.py __graft_entry__.py; \
 	fi
+
+# bobralint: repo-native invariant analyzer (docs/ANALYSIS.md). Fails
+# on any finding not suppressed (with justification) in
+# bobralint-baseline.json. Stdlib-only — runs in the lint CI job.
+analyze:
+	python -m bobrapet_tpu.analysis
 
 bench: native
 	python bench.py

@@ -1,0 +1,50 @@
+"""bobralint: repo-native static analysis + runtime concurrency sanitizer.
+
+The reference operator leans on ``go vet``, controller-runtime's linters
+and the race detector to keep its concurrency invariants honest; this
+package is the Python port's equivalent, specialized to the invariants
+THIS codebase already relies on (rather than generic style rules):
+
+- **lock-blocking-io** — no store traffic / sleeps / filesystem /
+  network calls inside ``with <lock>:`` blocks (the advisor's recorder
+  finding, generalized across every lock-held region);
+- **cow-discipline** — objects obtained from ``get_view`` /
+  ``list_views`` / ``cached_parse`` / watch events are shared
+  copy-on-write views and must never be mutated in place (the PR 1
+  contract);
+- **config-key-drift** — dotted config-key literals must be registered
+  in ``config/operator.py``, registered keys must set real dataclass
+  fields and be consumed somewhere, and keys documented in docs/ must
+  exist;
+- **metrics-drift** — emitted metric families must be registered in
+  ``observability/metrics.py`` and carry the ``bobrapet_*`` /
+  ``bobravoz_*`` prefix;
+- **enum-literal-drift** — bare string literals that shadow
+  phase/exit-class/decision vocabulary must come from ``api/enums.py``.
+
+Static findings are gated by a checked-in baseline
+(``bobralint-baseline.json``) whose every entry carries a mandatory
+justification — CI fails on any NEW violation, never on the audited
+backlog. Run ``python -m bobrapet_tpu.analysis`` or ``make analyze``.
+
+The runtime prong (:mod:`.lockorder`) instruments ``threading.Lock`` /
+``RLock`` during the concurrency/chaos suites, records the
+lock-acquisition-order graph, and fails the suite on acquisition-order
+cycles (potential deadlocks) — ThreadSanitizer's lock-order checking,
+scoped to this process model.
+
+Everything here is stdlib-only so the analyzer runs in the lint CI job
+without the compute-plane dependencies installed.
+"""
+
+from .baseline import Baseline, BaselineError
+from .core import Finding, ProjectFile, load_project, run_checkers
+
+__all__ = [
+    "Baseline",
+    "BaselineError",
+    "Finding",
+    "ProjectFile",
+    "load_project",
+    "run_checkers",
+]

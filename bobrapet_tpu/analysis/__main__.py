@@ -1,0 +1,135 @@
+"""CLI: ``python -m bobrapet_tpu.analysis`` (Makefile: ``make analyze``).
+
+Exit codes: 0 = clean modulo baseline, 1 = new findings (or baseline
+errors), 2 = usage/internal error. ``--write-baseline`` regenerates the
+baseline from the current findings with placeholder justifications the
+loader deliberately REJECTS — each entry must be hand-audited (replace
+the placeholder with a real why) before CI goes green again.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .baseline import BASELINE_NAME, Baseline, BaselineError
+from .checkers import ALL_CHECKERS
+from .core import DEFAULT_ROOTS, load_project, run_checkers
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m bobrapet_tpu.analysis",
+        description="bobralint: repo-native invariant analyzer",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=None,
+        help=f"analysis roots relative to --root (default: {', '.join(DEFAULT_ROOTS)})",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root (default: auto-detect from this package's location)",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: <root>/{BASELINE_NAME})",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="write current findings to the baseline file with placeholder "
+             "justifications (hand-audit required before it loads)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
+    parser.add_argument(
+        "--checker", action="append", default=None,
+        help="run only the named checker(s)",
+    )
+    parser.add_argument(
+        "--strict-stale", action="store_true",
+        help="fail when baseline entries no longer match any finding",
+    )
+    args = parser.parse_args(argv)
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+
+    checkers = ALL_CHECKERS
+    if args.checker:
+        wanted = set(args.checker)
+        known = {c.name for c in ALL_CHECKERS}
+        unknown = wanted - known
+        if unknown:
+            print(f"unknown checker(s): {', '.join(sorted(unknown))}; "
+                  f"available: {', '.join(sorted(known))}", file=sys.stderr)
+            return 2
+        checkers = tuple(c for c in ALL_CHECKERS if c.name in wanted)
+
+    roots = tuple(args.paths) if args.paths else DEFAULT_ROOTS
+    ctx, parse_errors = load_project(root, roots)
+    findings = run_checkers(ctx, checkers)
+
+    if args.write_baseline:
+        doc = Baseline.render(
+            findings,
+            justification="PLACEHOLDER — audit this finding and explain why "
+                          "it is intentional, or fix it",
+        )
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            f.write(doc)
+        print(f"wrote {len(findings)} suppression(s) to {baseline_path}; "
+              f"hand-audit every justification before CI will pass")
+        return 0
+
+    if args.no_baseline:
+        new, suppressed, stale = list(findings), [], []
+    else:
+        try:
+            baseline = Baseline.load(baseline_path)
+        except BaselineError as e:
+            print(f"baseline error: {e}", file=sys.stderr)
+            return 1
+        new, suppressed, stale = baseline.partition(findings)
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [vars(f) | {"fingerprint": f.fingerprint} for f in new],
+            "suppressed": [f.fingerprint for f in suppressed],
+            "stale": [s.fingerprint for s in stale],
+            "parse_errors": parse_errors,
+        }, indent=2))
+    else:
+        for err in parse_errors:
+            print(f"PARSE ERROR: {err}")
+        for f in new:
+            print(f.render())
+        if stale:
+            print(f"-- {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (fixed or moved; "
+                  f"prune from {os.path.basename(baseline_path)}):")
+            for s in stale:
+                print(f"   {s.fingerprint} {s.checker} {s.path} [{s.scope}]")
+        print(
+            f"bobralint: {len(new)} new finding(s), "
+            f"{len(suppressed)} suppressed, {len(stale)} stale, "
+            f"{len(ctx.files)} file(s) analyzed"
+        )
+
+    if parse_errors or new:
+        return 1
+    if stale and args.strict_stale:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

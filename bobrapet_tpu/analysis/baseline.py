@@ -1,0 +1,138 @@
+"""Baseline / suppression file for bobralint findings.
+
+The first full-repo run of a new checker surfaces a backlog; the
+baseline freezes the AUDITED part of that backlog so CI fails on any
+*new* violation while the frozen entries are paid down over time. Every
+entry carries a mandatory, human-written justification — an empty or
+placeholder justification fails the load, so "suppress and forget"
+cannot merge.
+
+Format (checked in at the repo root as ``bobralint-baseline.json``)::
+
+    {
+      "version": 1,
+      "suppressions": [
+        {
+          "fingerprint": "0f3a9c21be77",
+          "checker": "lock-blocking-io",
+          "path": "bobrapet_tpu/core/store.py",
+          "scope": "ResourceStore._update",
+          "message": "...as reported...",
+          "justification": "why this one is intentional"
+        }
+      ]
+    }
+
+Fingerprints are line-number-free (see core.Finding), so entries
+survive unrelated edits; an entry whose code is actually fixed becomes
+*stale* and is reported so the file shrinks instead of rotting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Iterable, Sequence
+
+from .core import Finding
+
+BASELINE_NAME = "bobralint-baseline.json"
+
+#: justifications that mean "nobody looked" — rejected at load time
+_PLACEHOLDERS = {"", "todo", "tbd", "fixme", "temporary", "suppress"}
+
+
+class BaselineError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class Suppression:
+    fingerprint: str
+    checker: str
+    path: str
+    scope: str
+    message: str
+    justification: str
+
+
+@dataclasses.dataclass
+class Baseline:
+    suppressions: list[Suppression] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            raise BaselineError(f"cannot read baseline {path}: {e}") from e
+        if data.get("version") != 1:
+            raise BaselineError(f"{path}: unsupported baseline version {data.get('version')!r}")
+        out = cls()
+        seen: set[str] = set()
+        for i, raw in enumerate(data.get("suppressions") or []):
+            fp = str(raw.get("fingerprint") or "")
+            just = str(raw.get("justification") or "").strip()
+            if not fp:
+                raise BaselineError(f"{path}: suppression #{i} missing fingerprint")
+            if just.lower() in _PLACEHOLDERS or len(just) < 10:
+                raise BaselineError(
+                    f"{path}: suppression {fp} ({raw.get('checker')}, "
+                    f"{raw.get('path')}) needs a real justification — got "
+                    f"{just!r}. Explain WHY the finding is intentional."
+                )
+            if fp in seen:
+                raise BaselineError(f"{path}: duplicate suppression {fp}")
+            seen.add(fp)
+            out.suppressions.append(
+                Suppression(
+                    fingerprint=fp,
+                    checker=str(raw.get("checker") or ""),
+                    path=str(raw.get("path") or ""),
+                    scope=str(raw.get("scope") or ""),
+                    message=str(raw.get("message") or ""),
+                    justification=just,
+                )
+            )
+        return out
+
+    def fingerprints(self) -> set[str]:
+        return {s.fingerprint for s in self.suppressions}
+
+    def partition(
+        self, findings: Sequence[Finding]
+    ) -> tuple[list[Finding], list[Finding], list[Suppression]]:
+        """-> (new findings, suppressed findings, stale suppressions)."""
+        known = self.fingerprints()
+        new = [f for f in findings if f.fingerprint not in known]
+        suppressed = [f for f in findings if f.fingerprint in known]
+        live = {f.fingerprint for f in findings}
+        stale = [s for s in self.suppressions if s.fingerprint not in live]
+        return new, suppressed, stale
+
+    @staticmethod
+    def render(findings: Iterable[Finding], justification: str) -> str:
+        """Serialize findings as a baseline document (--write-baseline).
+        The justification is deliberately a placeholder the LOADER
+        rejects: each entry must be hand-audited before CI passes.
+        Findings sharing a fingerprint (same invariant broken the same
+        way in one scope) collapse to one entry."""
+        entries: dict[str, dict] = {}
+        for f in findings:
+            entries.setdefault(
+                f.fingerprint,
+                {
+                    "fingerprint": f.fingerprint,
+                    "checker": f.checker,
+                    "path": f.path,
+                    "scope": f.scope,
+                    "message": f.message,
+                    "justification": justification,
+                },
+            )
+        doc = {"version": 1, "suppressions": list(entries.values())}
+        return json.dumps(doc, indent=2, sort_keys=False) + "\n"

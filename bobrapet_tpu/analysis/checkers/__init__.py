@@ -1,0 +1,17 @@
+"""Checker registry: one module per repo-native invariant."""
+
+from .config_key_drift import ConfigKeyDriftChecker
+from .cow_discipline import CowDisciplineChecker
+from .enum_literal_drift import EnumLiteralDriftChecker
+from .lock_blocking_io import LockBlockingIOChecker
+from .metrics_drift import MetricsDriftChecker
+
+ALL_CHECKERS = (
+    LockBlockingIOChecker(),
+    CowDisciplineChecker(),
+    ConfigKeyDriftChecker(),
+    MetricsDriftChecker(),
+    EnumLiteralDriftChecker(),
+)
+
+__all__ = ["ALL_CHECKERS"]

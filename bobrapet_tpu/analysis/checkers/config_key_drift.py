@@ -1,0 +1,200 @@
+"""config-key-drift: dotted config keys must exist, work, and be used.
+
+The operator config travels as flat dotted keys through a ConfigMap
+(``config/operator.py:_apply_dotted``); nothing but convention ties a
+literal like ``"fleet.preemption-retry-cap"`` in a test, a doc table or
+a chart default to the setter table. Four drift modes, all mechanical:
+
+1. **unknown literal** — a dotted-key string literal used as a dict
+   key or as the first argument of a ``.get(...)`` call, whose first
+   segment is a known config group but which is neither in the table
+   nor a dynamic family (``controllers.<name>.max-concurrent-
+   reconciles``, ``scheduling.queue.<name>.*``): it would be silently
+   ignored at parse time. Only those two positions are scanned — a
+   dotted string elsewhere (a span name, an id) is not a config key;
+2. **broken setter** — a table entry whose ``fset`` writes an attribute
+   that does not exist on the target dataclass (a field rename that
+   missed the table: the key parses, sets a ghost attribute, and the
+   consumer keeps reading the stale default);
+3. **dead key** — a registered key whose dataclass attribute is never
+   read anywhere outside ``config/``: registered but not consumed, so a
+   reload can never take effect;
+4. **doc drift** — a backticked dotted key in ``docs/*.md`` / README
+   with a known group prefix that is not registered.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Sequence
+
+from ..context import config_registry
+from ..core import AnalysisContext, Finding, ProjectFile
+
+_KEY_RE = re.compile(r"^[a-z][a-z0-9-]*(\.[a-z0-9-]+)+$")
+_DOC_KEY_RE = re.compile(r"`([a-z][a-z0-9-]*(?:\.[a-z0-9-]+)+)`")
+_DOC_FILES = ("README.md", "docs/SCALING.md", "docs/FLEET.md", "docs/TRAINING.md",
+              "docs/STREAMING.md", "docs/SERVING.md", "docs/KUBECTL.md",
+              "docs/ANALYSIS.md")
+
+
+class ConfigKeyDriftChecker:
+    name = "config-key-drift"
+    description = "dotted config-key literals vs the registered setter table"
+
+    def run(
+        self, files: Sequence[ProjectFile], ctx: AnalysisContext
+    ) -> Iterable[Finding]:
+        reg = config_registry(ctx)
+        if reg is None:
+            return []
+        out: list[Finding] = []
+        groups = reg.known_groups()
+
+        # (1) unknown dotted literals in code
+        for pf in files:
+            if pf.rel == "bobrapet_tpu/config/operator.py":
+                continue
+            scope_stack: list[str] = []
+            self._scan_literals(pf, pf.tree, scope_stack, groups, reg, out)
+
+        # (2) broken setters + collect attr reads for (3)
+        attr_reads: set[str] = set()
+        for pf in files:
+            # the registry itself doesn't count as a consumer, but the
+            # resolver chain (config/resolver.py) does
+            if pf.rel == "bobrapet_tpu/config/operator.py":
+                continue
+            for node in ast.walk(pf.tree):
+                if isinstance(node, ast.Attribute):
+                    attr_reads.add(node.attr)
+        for key in sorted(reg.keys):
+            ck = reg.keys[key]
+            if ck.group == "?":
+                continue
+            cls = (
+                "OperatorConfig"
+                if ck.group == ""
+                else reg.group_classes.get(ck.group, "")
+            )
+            fields = reg.dataclass_fields.get(cls)
+            if fields is not None and ck.attr not in fields:
+                out.append(
+                    Finding(
+                        checker=self.name,
+                        path="bobrapet_tpu/config/operator.py",
+                        line=ck.line,
+                        col=0,
+                        scope="_apply_dotted",
+                        message=(
+                            f"config key {key!r} sets attribute "
+                            f"{ck.attr!r} which does not exist on {cls} — "
+                            f"the key parses but writes a ghost attribute"
+                        ),
+                        kernel=f"ghost attribute {cls}.{ck.attr} for {key}",
+                    )
+                )
+            elif ck.attr not in attr_reads:
+                # (3) dead key: attribute never read outside config/
+                out.append(
+                    Finding(
+                        checker=self.name,
+                        path="bobrapet_tpu/config/operator.py",
+                        line=ck.line,
+                        col=0,
+                        scope="_apply_dotted",
+                        message=(
+                            f"config key {key!r} is registered but its "
+                            f"attribute {ck.attr!r} is never read outside "
+                            f"the registry — a reload can never take effect"
+                        ),
+                        kernel=f"dead config key {key}",
+                    )
+                )
+
+        # (4) documented keys must be registered
+        for rel in _DOC_FILES:
+            path = os.path.join(ctx.root, rel)
+            if not os.path.exists(path):
+                continue
+            try:
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            for lineno, line in enumerate(text.splitlines(), 1):
+                for m in _DOC_KEY_RE.finditer(line):
+                    key = m.group(1)
+                    if key.split(".")[0] not in groups:
+                        continue
+                    if not reg.is_registered(key):
+                        out.append(
+                            Finding(
+                                checker=self.name,
+                                path=rel,
+                                line=lineno,
+                                col=m.start(1),
+                                scope="",
+                                message=(
+                                    f"documented config key {key!r} is not "
+                                    f"registered in config/operator.py"
+                                ),
+                                kernel=f"documented-but-unregistered {key}",
+                            )
+                        )
+        return out
+
+    def _scan_literals(
+        self,
+        pf: ProjectFile,
+        node: ast.AST,
+        scope_stack: list[str],
+        groups: set[str],
+        reg,
+        out: list[Finding],
+    ) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                scope_stack.append(child.name)
+                self._scan_literals(pf, child, scope_stack, groups, reg, out)
+                scope_stack.pop()
+                continue
+            candidates: list[ast.Constant] = []
+            if isinstance(child, ast.Dict):
+                candidates = [
+                    k for k in child.keys
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str)
+                ]
+            elif (
+                isinstance(child, ast.Call)
+                and isinstance(child.func, ast.Attribute)
+                and child.func.attr == "get"
+                and child.args
+                and isinstance(child.args[0], ast.Constant)
+                and isinstance(child.args[0].value, str)
+            ):
+                candidates = [child.args[0]]
+            for lit in candidates:
+                if (
+                    _KEY_RE.match(lit.value)
+                    and lit.value.split(".")[0] in groups
+                    and not reg.is_registered(lit.value)
+                ):
+                    out.append(
+                        Finding(
+                            checker=self.name,
+                            path=pf.rel,
+                            line=lit.lineno,
+                            col=lit.col_offset,
+                            scope=".".join(scope_stack),
+                            message=(
+                                f"config key literal {lit.value!r} is not "
+                                f"registered in config/operator.py — it "
+                                f"would be silently ignored at parse time"
+                            ),
+                            kernel=f"unregistered key literal {lit.value}",
+                        )
+                    )
+            self._scan_literals(pf, child, scope_stack, groups, reg, out)

@@ -1,0 +1,209 @@
+"""cow-discipline: never mutate a shared copy-on-write view in place.
+
+PR 1's copy-on-write reads hand the COMMITTED object out directly:
+``store.get_view`` / ``try_get_view`` / ``list_views`` results, watch
+event ``.resource`` payloads, and ``cached_parse`` returns are all
+shared process-wide. One in-place mutation poisons every other holder —
+the exact bug class the ``BOBRA_PARSE_CACHE_DEBUG`` trap catches at
+runtime; this checker catches it at review time.
+
+Intraprocedural taint per function:
+
+- ``x = store.get_view(...)`` / ``try_get_view`` / ``cached_parse``
+  taints ``x``;
+- ``for v in store.list_views(...)`` (or iterating a name assigned from
+  it) taints ``v``;
+- ``sr = ev.resource`` in a watch handler taints ``sr`` (drain shares
+  the committed object with every handler);
+
+then any store into an attribute/subscript chain rooted at a tainted
+name (``x.spec["k"] = ...``, ``x.status.update(...)``, ``del x.meta...``)
+or a mutating method call on such a chain is flagged. Rebinding the
+name clears the taint; chains broken by an intermediate call (e.g.
+``x.deepcopy().spec[...] = ...``) are NOT flagged — a call result is a
+fresh object.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Sequence
+
+from ..core import AnalysisContext, Finding, ProjectFile, attr_chain
+
+#: callables whose result is a shared view
+_VIEW_SOURCES = {"get_view", "try_get_view", "cached_parse"}
+_LIST_VIEW_SOURCES = {"list_views"}
+
+#: methods that mutate their receiver in place
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "setdefault", "sort", "reverse", "add", "discard",
+}
+
+def _call_terminal(call: ast.Call) -> Optional[str]:
+    chain = attr_chain(call.func)
+    return chain[-1] if chain else None
+
+
+class _FunctionScanner(ast.NodeVisitor):
+    """One instance per function body; nested defs get their own."""
+
+    def __init__(self, pf: ProjectFile, scope: str):
+        self.pf = pf
+        self.scope = scope
+        self.findings: list[Finding] = []
+        self.tainted: dict[str, str] = {}  # name -> origin description
+        self.list_names: dict[str, str] = {}  # names holding list_views results
+
+    # -- taint sources -----------------------------------------------------
+
+    def _origin_of_call(self, value: ast.AST) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        term = _call_terminal(value)
+        if term in _VIEW_SOURCES:
+            return term
+        return None
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        if len(node.targets) != 1 or not isinstance(node.targets[0], ast.Name):
+            self._check_store(node.targets[0], node)
+            return
+        name = node.targets[0].id
+        origin = self._origin_of_call(node.value)
+        if origin is not None:
+            self.tainted[name] = origin
+            self.list_names.pop(name, None)
+            return
+        if (
+            isinstance(node.value, ast.Call)
+            and _call_terminal(node.value) in _LIST_VIEW_SOURCES
+        ):
+            self.list_names[name] = "list_views"
+            self.tainted.pop(name, None)
+            return
+        # ``sr = ev.resource``: watch handlers share the committed object
+        chain = attr_chain(node.value)
+        if chain and len(chain) == 2 and chain[1] == "resource" and chain[0] in ("ev", "event"):
+            self.tainted[name] = "watch event .resource"
+            return
+        # ``alias = tainted`` propagates; anything else clears
+        if isinstance(node.value, ast.Name) and node.value.id in self.tainted:
+            self.tainted[name] = self.tainted[node.value.id]
+        else:
+            self.tainted.pop(name, None)
+            self.list_names.pop(name, None)
+
+    def visit_For(self, node: ast.For) -> None:
+        if isinstance(node.target, ast.Name):
+            it = node.iter
+            if (
+                isinstance(it, ast.Call)
+                and _call_terminal(it) in _LIST_VIEW_SOURCES
+            ) or (isinstance(it, ast.Name) and it.id in self.list_names):
+                self.tainted[node.target.id] = "list_views"
+        self.generic_visit(node)
+
+    # -- nested scopes -----------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        sub = _FunctionScanner(self.pf, f"{self.scope}.{node.name}" if self.scope else node.name)
+        for stmt in node.body:
+            sub.visit(stmt)
+        self.findings.extend(sub.findings)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        sub = _FunctionScanner(self.pf, f"{self.scope}.{node.name}" if self.scope else node.name)
+        for stmt in node.body:
+            sub.visit(stmt)
+        self.findings.extend(sub.findings)
+
+    # -- mutation sinks ----------------------------------------------------
+
+    def _tainted_root(self, node: ast.AST) -> Optional[tuple[str, str]]:
+        """If node is an Attribute/Subscript chain rooted at a tainted
+        name (and deeper than the bare name), -> (name, origin)."""
+        if not isinstance(node, (ast.Attribute, ast.Subscript)):
+            return None
+        chain = attr_chain(node)
+        if chain is None or len(chain) < 1:
+            return None
+        root = chain[0]
+        if root in self.tainted:
+            return root, self.tainted[root]
+        return None
+
+    def _check_store(self, target: ast.AST, node: ast.AST) -> None:
+        for t in ast.walk(target) if isinstance(target, (ast.Tuple, ast.List)) else [target]:
+            hit = self._tainted_root(t)
+            if hit is not None:
+                name, origin = hit
+                self._flag(node, name, origin, "assignment into")
+
+    def _flag(self, node: ast.AST, name: str, origin: str, what: str) -> None:
+        self.findings.append(
+            Finding(
+                checker="cow-discipline",
+                path=self.pf.rel,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                scope=self.scope,
+                message=(
+                    f"{what} {name!r}, a shared view from {origin} — views "
+                    f"are committed objects shared process-wide; deepcopy "
+                    f"first or write through store.mutate()/update()"
+                ),
+                kernel=f"{what} view {name} from {origin}",
+            )
+        )
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.generic_visit(node)
+        hit = self._tainted_root(node.target)
+        if hit is not None:
+            self._flag(node, hit[0], hit[1], "augmented assignment into")
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        self.generic_visit(node)
+        for t in node.targets:
+            hit = self._tainted_root(t)
+            if hit is not None:
+                self._flag(node, hit[0], hit[1], "del on")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        func = node.func
+        if not isinstance(func, ast.Attribute) or func.attr not in _MUTATORS:
+            return
+        # receiver chain must be Attribute/Subscript only down to a
+        # tainted name: x.spec.update(...) flags, x.to_dict().update(...)
+        # does not (attr_chain returns None through a Call)
+        receiver = func.value
+        chain = attr_chain(receiver)
+        if chain is None:
+            return
+        root = chain[0]
+        if root in self.tainted:
+            # bare ``x.update(...)`` counts too: a parsed spec object
+            # mutated directly is still a shared-parse mutation
+            self._flag(node, root, self.tainted[root], f".{func.attr}() on")
+
+
+class CowDisciplineChecker:
+    name = "cow-discipline"
+    description = "in-place mutation of shared copy-on-write views / cached parses"
+
+    def run(
+        self, files: Sequence[ProjectFile], ctx: AnalysisContext
+    ) -> Iterable[Finding]:
+        out: list[Finding] = []
+        for pf in files:
+            scanner = _FunctionScanner(pf, "")
+            for stmt in pf.tree.body:
+                scanner.visit(stmt)
+            out.extend(scanner.findings)
+        return out

@@ -1,0 +1,207 @@
+"""enum-literal-drift: bare literals shadowing the typed vocabulary.
+
+``api/enums.py`` / ``api/conditions.py`` are the single vocabulary for
+phases, exit classes, trigger decisions and condition reasons — the
+reference's pkg/enums. A bare ``"Running"`` compared against a phase
+field keeps working until someone renames/retires the member, then
+fails open (comparison silently False). Flagged contexts, chosen for
+precision over recall:
+
+- comparisons (``==``, ``!=``, ``in``/``not in`` over a literal tuple)
+  where one side is a string matching an enum family's value and the
+  OTHER side's identifiers mention that family's hint token
+  (``phase``, ``exit``, ``decision``, …);
+- subscript stores / dict literals pairing a vocabulary KEY
+  (``"phase"``, ``"exitClass"``, ``"decision"``, …) with a bare value
+  literal from the matching family.
+
+The fix is ``Phase.RUNNING`` / ``Phase.RUNNING.value`` — admission and
+the store serialize enums transparently (SpecBase dumps ``.value``).
+
+Scope: package code only (``bobrapet_tpu/``). Tests and the bench
+harness deliberately assert on RAW wire strings — a test pinning
+``status["phase"] == "Succeeded"`` verifies the serialized contract
+independently of the enum, which is exactly what you want when the
+enum itself is what might drift.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Sequence
+
+from ..context import CONDITIONS_MODULE, ENUMS_MODULE, enum_vocabulary
+from ..core import AnalysisContext, Finding, ProjectFile, hint_text
+
+#: enum family -> identifier tokens that mark a context as being
+#: "about" that family. Tokens are matched against the non-literal
+#: side of a comparison (or the subscript key), lowercased.
+_FAMILY_HINTS = {
+    "Phase": ("phase",),
+    "ExitClass": ("exitclass", "exit_class", "exit"),
+    "TriggerDecision": ("decision",),
+    "EffectClaimPhase": ("phase",),
+    "StopMode": ("stopmode", "stop_mode",),
+    "StoryPattern": ("pattern",),
+    "WorkloadMode": ("workloadmode", "workload_mode",),
+}
+
+#: dict/subscript keys -> families whose values they carry
+_KEY_FAMILIES = {
+    "phase": ("Phase", "EffectClaimPhase"),
+    "exitClass": ("ExitClass",),
+    "exit_class": ("ExitClass",),
+    "decision": ("TriggerDecision",),
+    "pattern": ("StoryPattern",),
+}
+
+#: modules that DEFINE the vocabulary (never flagged)
+_DEFINITION_MODULES = {ENUMS_MODULE, CONDITIONS_MODULE}
+
+
+class EnumLiteralDriftChecker:
+    name = "enum-literal-drift"
+    description = "bare string literals shadowing Phase/ExitClass/... enum values"
+
+    def run(
+        self, files: Sequence[ProjectFile], ctx: AnalysisContext
+    ) -> Iterable[Finding]:
+        vocab = enum_vocabulary(ctx)
+        if vocab is None:
+            return []
+        #: value -> [(family, member)], for families we police
+        value_map: dict[str, list[tuple[str, str]]] = {}
+        for family, hints in _FAMILY_HINTS.items():
+            for value, member in vocab.families.get(family, {}).items():
+                value_map.setdefault(value, []).append((family, member))
+        out: list[Finding] = []
+        for pf in files:
+            if pf.rel in _DEFINITION_MODULES:
+                continue
+            if not pf.rel.startswith("bobrapet_tpu/"):
+                continue  # tests/bench pin raw wire strings on purpose
+            scope: list[str] = []
+            self._scan(pf, pf.tree, scope, value_map, out)
+        return out
+
+    # ------------------------------------------------------------------
+    def _families_for(
+        self, literal: str, hint: str, value_map
+    ) -> Optional[list[tuple[str, str]]]:
+        matches = value_map.get(literal)
+        if not matches:
+            return None
+        picked = [
+            (family, member)
+            for family, member in matches
+            if any(tok in hint for tok in _FAMILY_HINTS[family])
+        ]
+        return picked or None
+
+    def _flag(
+        self,
+        pf: ProjectFile,
+        node: ast.AST,
+        scope: list[str],
+        literal: str,
+        picked: list[tuple[str, str]],
+        context: str,
+        out: list[Finding],
+    ) -> None:
+        suggestions = ", ".join(f"{fam}.{mem}" for fam, mem in picked)
+        out.append(
+            Finding(
+                checker=self.name,
+                path=pf.rel,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                scope=".".join(scope),
+                message=(
+                    f"bare literal {literal!r} in {context} shadows "
+                    f"{suggestions} — use the enum member (renames/retires "
+                    f"fail open on raw strings)"
+                ),
+                kernel=f"bare {literal} in {context} ({suggestions})",
+            )
+        )
+
+    def _scan(
+        self,
+        pf: ProjectFile,
+        node: ast.AST,
+        scope: list[str],
+        value_map,
+        out: list[Finding],
+    ) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                scope.append(child.name)
+                self._scan(pf, child, scope, value_map, out)
+                scope.pop()
+                continue
+            if isinstance(child, ast.Compare):
+                self._check_compare(pf, child, scope, value_map, out)
+            elif isinstance(child, ast.Assign):
+                for tgt in child.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        self._check_keyed(
+                            pf, tgt.slice, child.value, child, scope, value_map, out
+                        )
+            elif isinstance(child, ast.Dict):
+                for k, v in zip(child.keys, child.values):
+                    if k is not None:
+                        self._check_keyed(pf, k, v, v, scope, value_map, out)
+            self._scan(pf, child, scope, value_map, out)
+
+    def _check_compare(
+        self, pf: ProjectFile, node: ast.Compare, scope, value_map, out
+    ) -> None:
+        operands = [node.left, *node.comparators]
+        ops = node.ops
+        for i, op in enumerate(ops):
+            if not isinstance(op, (ast.Eq, ast.NotEq, ast.In, ast.NotIn)):
+                continue
+            left, right = operands[i], operands[i + 1]
+            literals: list[tuple[ast.Constant, ast.AST]] = []
+            if isinstance(left, ast.Constant) and isinstance(left.value, str):
+                literals.append((left, right))
+            if isinstance(right, ast.Constant) and isinstance(right.value, str):
+                literals.append((right, left))
+            # ``phase in ("Failed", "Timeout")``
+            if isinstance(op, (ast.In, ast.NotIn)) and isinstance(
+                right, (ast.Tuple, ast.List, ast.Set)
+            ):
+                for elt in right.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        literals.append((elt, left))
+            for lit_node, other in literals:
+                hint = hint_text(other)
+                picked = self._families_for(lit_node.value, hint, value_map)
+                if picked:
+                    self._flag(
+                        pf, lit_node, scope, lit_node.value, picked,
+                        "comparison", out,
+                    )
+
+    def _check_keyed(
+        self, pf: ProjectFile, key: ast.AST, value: ast.AST, at: ast.AST,
+        scope, value_map, out,
+    ) -> None:
+        if not (isinstance(key, ast.Constant) and isinstance(key.value, str)):
+            return
+        families = _KEY_FAMILIES.get(key.value)
+        if not families:
+            return
+        if not (isinstance(value, ast.Constant) and isinstance(value.value, str)):
+            return
+        picked = [
+            (fam, value_map[value.value][j][1])
+            for fam in families
+            for j, (f2, _) in enumerate(value_map.get(value.value, []))
+            if f2 == fam
+        ]
+        if picked:
+            self._flag(
+                pf, at, scope, value.value, picked,
+                f"{key.value!r}-keyed store", out,
+            )

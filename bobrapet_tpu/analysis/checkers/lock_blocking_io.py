@@ -1,0 +1,248 @@
+"""lock-blocking-io: no blocking calls inside ``with <lock>:`` blocks.
+
+The control plane's scalability story depends on every lock being a
+short critical section around in-memory state — PR 1's advisor round
+found the recorder holding its lock across store listings, and with
+~120 lock-held regions in the tree that bug class WILL recur. This
+checker flags, lexically inside any ``with <something named *lock*>:``
+body:
+
+- sleeps (``time.sleep`` / bare ``sleep``);
+- store traffic — any method call on a receiver named ``store`` (the
+  coordination bus takes its own global lock and fans out to watchers:
+  calling it under a private lock couples unrelated subsystems'
+  latencies and invites lock-order cycles);
+- filesystem calls (``open``, ``os.replace/remove/listdir/...``,
+  ``shutil.*``);
+- socket traffic (``recv/sendall/sendmsg/accept/connect/...``);
+- subprocess / urllib calls;
+- ``.wait(...)`` on anything that does not look like a Condition
+  (``Condition.wait`` atomically releases the lock — ``Event.wait``
+  under someone else's lock just blocks it).
+
+Nested ``def``/``lambda`` bodies are skipped (defining a function under
+a lock does not run it); comprehensions are scanned (they do run).
+
+One level of interprocedural reasoning, same file only: a helper that
+itself performs blocking calls (directly or via other same-file
+helpers) marks every call site of that helper inside a lock-held
+region — ``self._persist(obj)`` under the store lock is flagged
+because ``_persist`` opens and replaces files, even though the
+``open()`` is lexically elsewhere.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Sequence
+
+from ..core import (
+    AnalysisContext,
+    Finding,
+    ProjectFile,
+    attr_chain,
+    terminal_name,
+)
+
+#: method names that block on sockets/pipes regardless of receiver
+_SOCKET_METHODS = {
+    "recv", "recv_into", "recvfrom", "sendall", "sendmsg", "accept",
+    "connect", "makefile", "do_handshake", "unwrap",
+}
+
+#: os/shutil functions that hit the filesystem
+_OS_BLOCKING = {
+    "replace", "remove", "rename", "listdir", "makedirs", "mkdir",
+    "rmdir", "unlink", "fsync", "stat", "scandir", "walk",
+}
+
+#: store methods — the full bus API; even the "cheap" view reads take
+#: the store's global lock, so calling them under a private lock
+#: creates a cross-subsystem lock edge
+_STORE_METHODS = {
+    "get", "try_get", "get_view", "try_get_view", "list", "list_views",
+    "list_keys", "count", "create", "update", "update_status", "delete",
+    "mutate", "patch_status", "watch",
+}
+
+#: receiver names treated as condition variables (``.wait`` releases)
+_CONDVAR_HINTS = ("cond", "cv", "not_empty", "not_full", "_wakeup", "waiter")
+
+
+def _lock_like(expr: ast.AST) -> Optional[str]:
+    """Name the lock if this with-item looks like one (terminal
+    identifier contains 'lock' and is not a condition variable)."""
+    name = terminal_name(expr)
+    if name is None:
+        return None
+    low = name.lower()
+    if "lock" in low and not any(h in low for h in _CONDVAR_HINTS):
+        chain = attr_chain(expr)
+        return ".".join(chain) if chain else name
+    return None
+
+
+def _classify_call(call: ast.Call) -> Optional[str]:
+    """-> stable kernel string describing the blocking call, or None."""
+    func = call.func
+    chain = attr_chain(func)
+    if chain is None:
+        return None
+    dotted = ".".join(chain)
+    last = chain[-1]
+    if dotted in ("time.sleep",) or (len(chain) == 1 and last == "sleep"):
+        return f"sleep call {dotted}"
+    if len(chain) == 1 and last == "open":
+        return "filesystem call open()"
+    if len(chain) >= 2 and chain[-2] == "os" and last in _OS_BLOCKING:
+        return f"filesystem call {dotted}"
+    if len(chain) >= 2 and chain[-2] == "shutil":
+        return f"filesystem call {dotted}"
+    if len(chain) >= 2 and chain[-2] == "subprocess":
+        return f"subprocess call {dotted}"
+    if "urlopen" in last or (len(chain) >= 2 and "urllib" in chain[0]):
+        return f"network call {dotted}"
+    if len(chain) >= 2 and last in _SOCKET_METHODS:
+        return f"socket call .{last}()"
+    if len(chain) >= 2 and last in _STORE_METHODS and chain[-2] == "store":
+        return f"store call {dotted}"
+    if (
+        len(chain) >= 2
+        and last == "wait"
+        and not any(h in chain[-2].lower() for h in _CONDVAR_HINTS)
+    ):
+        return f"blocking wait {dotted}"
+    return None
+
+
+def _blocking_functions(tree: ast.Module) -> dict[str, str]:
+    """Map bare function/method name -> kernel of a blocking call it
+    performs, propagated through same-file call edges to a fixed point
+    (names collide across classes in one file; the union is a cheap,
+    sound-enough over-approximation for a lint)."""
+    direct: dict[str, str] = {}
+    edges: dict[str, set[str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        callees: set[str] = set()
+        for call in _walk_skipping_defs_multi(node.body):
+            if not isinstance(call, ast.Call):
+                continue
+            kernel = _classify_call(call)
+            if kernel is not None:
+                direct.setdefault(node.name, kernel)
+            else:
+                t = terminal_name(call.func)
+                if t is not None:
+                    callees.add(t)
+        edges[node.name] = callees
+    # propagate: fn with no kernel inherits from a blocking callee —
+    # callees in sorted order so the chosen kernel (and therefore the
+    # finding fingerprint) is stable across runs
+    blocking = dict(direct)
+    changed = True
+    while changed:
+        changed = False
+        for fn in sorted(edges):
+            if fn in blocking:
+                continue
+            for c in sorted(edges[fn]):
+                if c in blocking and c != fn:
+                    blocking[fn] = f"{blocking[c]} (via {c}())"
+                    changed = True
+                    break
+    return blocking
+
+
+def _walk_skipping_defs_multi(stmts):
+    for stmt in stmts:
+        yield from _walk_skipping_defs(stmt)
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, pf: ProjectFile, blocking_fns: dict[str, str]):
+        self.pf = pf
+        self.blocking_fns = blocking_fns
+        self.findings: list[Finding] = []
+        self._scope: list[str] = []
+
+    def _in_scope(self, name: str, node: ast.AST) -> None:
+        self._scope.append(name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._in_scope(node.name, node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._in_scope(node.name, node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._in_scope(node.name, node)
+
+    def visit_With(self, node: ast.With) -> None:
+        locks = [_lock_like(item.context_expr) for item in node.items]
+        lock_name = next((name for name in locks if name), None)
+        if lock_name is not None:
+            for stmt in node.body:
+                self._scan_locked(stmt, lock_name)
+        self.generic_visit(node)
+
+    def _scan_locked(self, node: ast.AST, lock_name: str) -> None:
+        for child in _walk_skipping_defs(node):
+            if isinstance(child, ast.Call):
+                kernel = _classify_call(child)
+                if kernel is None:
+                    t = terminal_name(child.func)
+                    if t in self.blocking_fns:
+                        kernel = f"{t}(): {self.blocking_fns[t]}"
+                if kernel is not None:
+                    self.findings.append(
+                        Finding(
+                            checker="lock-blocking-io",
+                            path=self.pf.rel,
+                            line=child.lineno,
+                            col=child.col_offset,
+                            scope=".".join(self._scope),
+                            message=(
+                                f"{kernel} while holding {lock_name} — move "
+                                f"the blocking work outside the critical "
+                                f"section (snapshot under the lock, act after "
+                                f"release)"
+                            ),
+                            kernel=f"{kernel} under {lock_name}",
+                        )
+                    )
+
+
+def _walk_skipping_defs(root: ast.AST):
+    """ast.walk, but do not descend into nested function/lambda bodies
+    (code defined under a lock is not code RUN under it). Applies to
+    the root too: a bare ``def`` statement inside a with-block
+    contributes nothing."""
+    if isinstance(root, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+class LockBlockingIOChecker:
+    name = "lock-blocking-io"
+    description = "blocking I/O, sleeps or store traffic inside a lock-held region"
+
+    def run(
+        self, files: Sequence[ProjectFile], ctx: AnalysisContext
+    ) -> Iterable[Finding]:
+        out: list[Finding] = []
+        for pf in files:
+            v = _Visitor(pf, _blocking_functions(pf.tree))
+            v.visit(pf.tree)
+            out.extend(v.findings)
+        return out

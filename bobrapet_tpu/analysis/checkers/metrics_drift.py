@@ -1,0 +1,159 @@
+"""metrics-drift: emitted metric families vs the registered inventory.
+
+``observability/metrics.py:_ControlPlaneMetrics`` is the single
+inventory of Prometheus families (the reference keeps the same shape in
+pkg/metrics). Drift modes:
+
+1. **unknown attribute** — ``metrics.<attr>...`` emission for an attr
+   not defined in ``_ControlPlaneMetrics`` (raises ``AttributeError``
+   only when that code path actually runs — typically in production,
+   not in tests);
+2. **bad prefix** — a registered family whose name does not carry the
+   ``bobrapet_`` / ``bobravoz_`` namespace;
+3. **duplicate family** — two registrations with the same name (the
+   registry silently returns the first, so the second's help/labels
+   are dead);
+4. **rogue registration** — a ``REGISTRY.counter/gauge/histogram`` call
+   outside ``observability/metrics.py`` with an unprefixed name
+   literal (ad-hoc families bypass the inventory; they may, but must
+   stay in the namespace).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Sequence
+
+from ..context import METRICS_MODULE, metrics_registry
+from ..core import AnalysisContext, Finding, ProjectFile, attr_chain
+
+_PREFIXES = ("bobrapet_", "bobravoz_")
+_FACTORY_METHODS = {"counter", "gauge", "histogram"}
+
+
+class MetricsDriftChecker:
+    name = "metrics-drift"
+    description = "emitted metric families vs observability/metrics.py registry"
+
+    def run(
+        self, files: Sequence[ProjectFile], ctx: AnalysisContext
+    ) -> Iterable[Finding]:
+        facts = metrics_registry(ctx)
+        if facts is None:
+            return []
+        out: list[Finding] = []
+
+        # (2) + (3): registry hygiene
+        for attr, mname in sorted(facts.attr_names.items()):
+            if not mname.startswith(_PREFIXES):
+                out.append(
+                    Finding(
+                        checker=self.name,
+                        path=METRICS_MODULE,
+                        line=facts.name_lines.get(mname, 0),
+                        col=0,
+                        scope="_ControlPlaneMetrics",
+                        message=(
+                            f"metric family {mname!r} (attr {attr!r}) lacks "
+                            f"the bobrapet_/bobravoz_ namespace prefix"
+                        ),
+                        kernel=f"unprefixed family {mname}",
+                    )
+                )
+        for mname, line in facts.duplicates:
+            out.append(
+                Finding(
+                    checker=self.name,
+                    path=METRICS_MODULE,
+                    line=line,
+                    col=0,
+                    scope="_ControlPlaneMetrics",
+                    message=(
+                        f"metric family {mname!r} registered twice — the "
+                        f"registry keeps the first, the second is dead"
+                    ),
+                    kernel=f"duplicate family {mname}",
+                )
+            )
+
+        known_attrs = set(facts.attr_names)
+        for pf in files:
+            if pf.rel == METRICS_MODULE:
+                continue
+            scope: list[str] = []
+            self._scan(pf, pf.tree, scope, known_attrs, out)
+        return out
+
+    def _scan(
+        self,
+        pf: ProjectFile,
+        node: ast.AST,
+        scope: list[str],
+        known_attrs: set[str],
+        out: list[Finding],
+    ) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                scope.append(child.name)
+                self._scan(pf, child, scope, known_attrs, out)
+                scope.pop()
+                continue
+            # (1) metrics.<attr>.<method>(...) emissions
+            if isinstance(child, ast.Attribute):
+                chain = attr_chain(child)
+                if (
+                    chain
+                    and len(chain) >= 2
+                    and chain[0] == "metrics"
+                    and chain[1] not in known_attrs
+                    # plain module access like metrics.REGISTRY is fine
+                    and not chain[1].isupper()
+                    and chain[1] != "metrics"  # observability.metrics.metrics
+                ):
+                    out.append(
+                        Finding(
+                            checker=self.name,
+                            path=pf.rel,
+                            line=child.lineno,
+                            col=child.col_offset,
+                            scope=".".join(scope),
+                            message=(
+                                f"metrics.{chain[1]} is not a family "
+                                f"registered in _ControlPlaneMetrics — "
+                                f"emission would raise AttributeError at "
+                                f"runtime"
+                            ),
+                            kernel=f"unregistered emission {chain[1]}",
+                        )
+                    )
+                    continue
+            # (4) rogue REGISTRY.counter("name"...) outside metrics.py
+            if isinstance(child, ast.Call):
+                chain = attr_chain(child.func)
+                if (
+                    chain
+                    and len(chain) >= 2
+                    and chain[-2] == "REGISTRY"
+                    and chain[-1] in _FACTORY_METHODS
+                    and child.args
+                    and isinstance(child.args[0], ast.Constant)
+                    and isinstance(child.args[0].value, str)
+                    and not child.args[0].value.startswith(_PREFIXES)
+                ):
+                    out.append(
+                        Finding(
+                            checker=self.name,
+                            path=pf.rel,
+                            line=child.lineno,
+                            col=child.col_offset,
+                            scope=".".join(scope),
+                            message=(
+                                f"ad-hoc metric {child.args[0].value!r} "
+                                f"registered outside the inventory without "
+                                f"the bobrapet_/bobravoz_ prefix"
+                            ),
+                            kernel=f"rogue unprefixed {child.args[0].value}",
+                        )
+                    )
+            self._scan(pf, child, scope, known_attrs, out)
+        return

@@ -1,0 +1,264 @@
+"""Project-fact extraction for the repo-native checkers.
+
+Everything here reads the AUTHORITATIVE in-repo registries by AST — the
+dotted-key table in ``config/operator.py``, the metric families in
+``observability/metrics.py``, the enum vocabulary in ``api/enums.py`` /
+``api/conditions.py`` — so the checkers compare code against what the
+code actually registers, never against a second hand-maintained list
+that could itself drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Optional
+
+from .core import AnalysisContext, attr_chain
+
+CONFIG_MODULE = "bobrapet_tpu/config/operator.py"
+METRICS_MODULE = "bobrapet_tpu/observability/metrics.py"
+ENUMS_MODULE = "bobrapet_tpu/api/enums.py"
+CONDITIONS_MODULE = "bobrapet_tpu/api/conditions.py"
+
+#: dynamic dotted-key families parsed structurally (not via the table)
+#: in config/operator.py:_apply_dotted — kept in sync by
+#: test_analysis.py::test_dynamic_config_families_still_parsed
+DYNAMIC_CONFIG_FAMILIES = (
+    re.compile(r"^controllers\.[a-z0-9-]+\.max-concurrent-reconciles$"),
+    re.compile(
+        r"^scheduling\.queue\.[a-z0-9-]+\."
+        r"(max-concurrent|priority-aging|accelerator|chip-budget)$"
+    ),
+)
+
+
+@dataclasses.dataclass
+class ConfigKey:
+    key: str  #: dotted key, e.g. "fleet.preemption-retry-cap"
+    group: str  #: "fleet" for grouped keys, "" for top-level OperatorConfig
+    attr: str  #: dataclass attribute the setter writes
+    line: int
+
+
+@dataclasses.dataclass
+class ConfigRegistry:
+    keys: dict[str, ConfigKey]
+    #: dataclass name -> set of field names (from operator.py)
+    dataclass_fields: dict[str, set[str]]
+    #: OperatorConfig group field name -> dataclass name
+    group_classes: dict[str, str]
+
+    def known_groups(self) -> set[str]:
+        return {k.split(".")[0] for k in self.keys if "." in k}
+
+    def is_registered(self, key: str) -> bool:
+        if key in self.keys:
+            return True
+        return any(f.match(key) for f in DYNAMIC_CONFIG_FAMILIES)
+
+
+def _lambda_fset_target(lam: ast.Lambda) -> Optional[tuple[str, str]]:
+    """A table entry ``lambda: fset(cfg.fleet, "attr", conv)`` ->
+    ("fleet", "attr"); ``lambda: fset(cfg, "attr", conv)`` -> ("", attr)."""
+    body = lam.body
+    if not (isinstance(body, ast.Call) and isinstance(body.func, ast.Name)):
+        return None
+    if body.func.id != "fset" or len(body.args) < 2:
+        return None
+    obj, attr_node = body.args[0], body.args[1]
+    if not (isinstance(attr_node, ast.Constant) and isinstance(attr_node.value, str)):
+        return None
+    chain = attr_chain(obj)
+    if chain == ["cfg"]:
+        return "", attr_node.value
+    if chain and len(chain) == 2 and chain[0] == "cfg":
+        return chain[1], attr_node.value
+    return None
+
+
+def config_registry(ctx: AnalysisContext) -> Optional[ConfigRegistry]:
+    def build() -> Optional[ConfigRegistry]:
+        pf = ctx.file(CONFIG_MODULE)
+        if pf is None:
+            return None
+        keys: dict[str, ConfigKey] = {}
+        dataclass_fields: dict[str, set[str]] = {}
+        group_classes: dict[str, str] = {}
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.ClassDef):
+                is_dc = any(
+                    (isinstance(d, ast.Attribute) and d.attr == "dataclass")
+                    or (isinstance(d, ast.Name) and d.id == "dataclass")
+                    or (
+                        isinstance(d, ast.Call)
+                        and (attr_chain(d.func) or [""])[-1] == "dataclass"
+                    )
+                    for d in node.decorator_list
+                )
+                if not is_dc:
+                    continue
+                fields = {
+                    s.target.id
+                    for s in node.body
+                    if isinstance(s, ast.AnnAssign) and isinstance(s.target, ast.Name)
+                }
+                dataclass_fields[node.name] = fields
+                if node.name == "OperatorConfig":
+                    for s in node.body:
+                        if isinstance(s, ast.AnnAssign) and isinstance(s.target, ast.Name):
+                            ann = s.annotation
+                            if isinstance(ann, ast.Name):
+                                group_classes[s.target.id] = ann.id
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, ast.AnnAssign):
+                target = node.target
+            if (
+                target is not None
+                and isinstance(target, ast.Name)
+                and target.id == "table"
+                and isinstance(node.value, ast.Dict)
+            ):
+                for k, v in zip(node.value.keys, node.value.values):
+                    if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                        continue
+                    target = (
+                        _lambda_fset_target(v) if isinstance(v, ast.Lambda) else None
+                    )
+                    group, attr = target if target else ("?", "?")
+                    keys[k.value] = ConfigKey(
+                        key=k.value, group=group, attr=attr, line=k.lineno
+                    )
+        if not keys:
+            return None
+        return ConfigRegistry(
+            keys=keys,
+            dataclass_fields=dataclass_fields,
+            group_classes=group_classes,
+        )
+
+    return ctx.memo("config_registry", build)
+
+
+@dataclasses.dataclass
+class MetricsRegistryFacts:
+    #: _ControlPlaneMetrics attribute -> registered family name
+    attr_names: dict[str, str]
+    #: family name -> registration line in metrics.py
+    name_lines: dict[str, int]
+    #: duplicate registrations: (name, line)
+    duplicates: list[tuple[str, int]]
+
+
+def metrics_registry(ctx: AnalysisContext) -> Optional[MetricsRegistryFacts]:
+    def build() -> Optional[MetricsRegistryFacts]:
+        pf = ctx.file(METRICS_MODULE)
+        if pf is None:
+            return None
+        attr_names: dict[str, str] = {}
+        name_lines: dict[str, int] = {}
+        duplicates: list[tuple[str, int]] = []
+        cpm = next(
+            (
+                n
+                for n in ast.walk(pf.tree)
+                if isinstance(n, ast.ClassDef) and n.name == "_ControlPlaneMetrics"
+            ),
+            None,
+        )
+        if cpm is None:
+            return None
+        for node in ast.walk(cpm):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt = node.targets[0]
+            chain = attr_chain(tgt)
+            if not (chain and chain[0] == "self" and len(chain) == 2):
+                continue
+            call = node.value
+            if not (isinstance(call, ast.Call) and call.args):
+                continue
+            first = call.args[0]
+            if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+                continue
+            name = first.value
+            attr_names[chain[1]] = name
+            if name in name_lines:
+                duplicates.append((name, node.lineno))
+            else:
+                name_lines[name] = node.lineno
+        return MetricsRegistryFacts(
+            attr_names=attr_names, name_lines=name_lines, duplicates=duplicates
+        )
+
+    return ctx.memo("metrics_registry", build)
+
+
+@dataclasses.dataclass
+class EnumVocabulary:
+    #: enum class name -> {string value -> member name}
+    families: dict[str, dict[str, str]]
+    #: condition type constants (READY = "Ready" ...)
+    condition_types: dict[str, str]  # value -> constant name
+    #: Reason codes (Reason.X values)
+    reasons: dict[str, str]
+
+
+def enum_vocabulary(ctx: AnalysisContext) -> Optional[EnumVocabulary]:
+    def build() -> Optional[EnumVocabulary]:
+        pf = ctx.file(ENUMS_MODULE)
+        if pf is None:
+            return None
+        families: dict[str, dict[str, str]] = {}
+        for node in pf.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = {b.id for b in node.bases if isinstance(b, ast.Name)}
+            if "StrEnum" not in bases:
+                continue
+            values: dict[str, str] = {}
+            for s in node.body:
+                if (
+                    isinstance(s, ast.Assign)
+                    and len(s.targets) == 1
+                    and isinstance(s.targets[0], ast.Name)
+                    and isinstance(s.value, ast.Constant)
+                    and isinstance(s.value.value, str)
+                ):
+                    values[s.value.value] = s.targets[0].id
+            if values:
+                families[node.name] = values
+        condition_types: dict[str, str] = {}
+        reasons: dict[str, str] = {}
+        pc = ctx.file(CONDITIONS_MODULE)
+        if pc is not None:
+            for node in pc.tree.body:
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id.isupper()
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                ):
+                    condition_types[node.value.value] = node.targets[0].id
+                if isinstance(node, ast.ClassDef) and node.name == "Reason":
+                    for s in node.body:
+                        if (
+                            isinstance(s, ast.Assign)
+                            and len(s.targets) == 1
+                            and isinstance(s.targets[0], ast.Name)
+                            and isinstance(s.value, ast.Constant)
+                            and isinstance(s.value.value, str)
+                        ):
+                            reasons[s.value.value] = s.targets[0].id
+        if not families:
+            return None
+        return EnumVocabulary(
+            families=families, condition_types=condition_types, reasons=reasons
+        )
+
+    return ctx.memo("enum_vocabulary", build)

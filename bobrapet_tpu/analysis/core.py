@@ -1,0 +1,186 @@
+"""Analyzer framework: findings, file loading, checker driver.
+
+A checker is an object with a ``name``, a one-line ``description`` and a
+``run(files, ctx)`` returning :class:`Finding`s. Checkers get the WHOLE
+parsed project at once (not one file at a time) because most of the
+repo-native checks are cross-file by nature: a metric emitted in
+``controllers/`` is validated against the registry in
+``observability/metrics.py``, a config literal in ``tests/`` against
+the dotted-key table in ``config/operator.py``.
+
+Finding fingerprints deliberately exclude line numbers: a baseline entry
+must survive unrelated edits above it. The identity is
+``(checker, path, enclosing scope, message kernel)`` — lockdep-style
+class suppression, so two identical violations in one function share a
+fingerprint and one justification covers both.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import os
+from typing import Iterable, Optional, Protocol, Sequence
+
+#: directories never analyzed (generated output, caches, VCS, and the
+#: checker test corpus — its *_bad.py files violate invariants on
+#: purpose; test_analysis.py feeds them to the checkers directly)
+_SKIP_DIRS = {
+    "__pycache__", ".git", ".jax_cache", "node_modules", ".venv",
+    "analysis_corpus",
+}
+
+#: default analysis roots, relative to the repo root. Tests are
+#: included: the invariants (no bare enum literals, registered config
+#: keys) bind test code too — tests are where drift usually starts.
+DEFAULT_ROOTS = ("bobrapet_tpu", "tests", "bench.py")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    checker: str  #: checker name, e.g. "lock-blocking-io"
+    path: str  #: repo-relative posix path
+    line: int
+    col: int
+    scope: str  #: dotted enclosing class/function chain ("" at module level)
+    message: str  #: full human-readable description
+    kernel: str  #: stable short core of the message (fingerprint input)
+
+    @property
+    def fingerprint(self) -> str:
+        raw = f"{self.checker}|{self.path}|{self.scope}|{self.kernel}"
+        return hashlib.sha256(raw.encode()).hexdigest()[:12]
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}:{self.col}"
+        scope = f" [{self.scope}]" if self.scope else ""
+        return f"{where}: {self.checker}: {self.message}{scope} ({self.fingerprint})"
+
+
+@dataclasses.dataclass
+class ProjectFile:
+    path: str  #: absolute
+    rel: str  #: repo-relative posix
+    source: str
+    tree: ast.Module
+
+
+class Checker(Protocol):  # pragma: no cover - typing only
+    name: str
+    description: str
+
+    def run(self, files: Sequence[ProjectFile], ctx: "AnalysisContext") -> Iterable[Finding]: ...
+
+
+@dataclasses.dataclass
+class AnalysisContext:
+    """Shared project facts, computed once per run (see context.py)."""
+
+    root: str
+    files: list[ProjectFile] = dataclasses.field(default_factory=list)
+    _cache: dict = dataclasses.field(default_factory=dict)
+
+    def file(self, rel: str) -> Optional[ProjectFile]:
+        for f in self.files:
+            if f.rel == rel:
+                return f
+        return None
+
+    def memo(self, key: str, build):
+        if key not in self._cache:
+            self._cache[key] = build()
+        return self._cache[key]
+
+
+def _iter_py_files(root: str, roots: Sequence[str]) -> Iterable[str]:
+    for entry in roots:
+        top = os.path.join(root, entry)
+        if os.path.isfile(top):
+            if top.endswith(".py"):
+                yield top
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def load_project(
+    root: str, roots: Sequence[str] = DEFAULT_ROOTS
+) -> tuple[AnalysisContext, list[str]]:
+    """Parse every analyzable file once; syntax errors are reported,
+    not fatal (one broken file must not hide findings elsewhere)."""
+    ctx = AnalysisContext(root=os.path.abspath(root))
+    errors: list[str] = []
+    for path in _iter_py_files(ctx.root, roots):
+        rel = os.path.relpath(path, ctx.root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=rel)
+        except (OSError, SyntaxError) as e:
+            errors.append(f"{rel}: {e}")
+            continue
+        ctx.files.append(ProjectFile(path=path, rel=rel, source=source, tree=tree))
+    return ctx, errors
+
+
+def run_checkers(
+    ctx: AnalysisContext, checkers: Sequence[Checker]
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for checker in checkers:
+        findings.extend(checker.run(ctx.files, ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.checker))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def attr_chain(node: ast.AST) -> Optional[list[str]]:
+    """``a.b.c`` -> ["a","b","c"]; ``a["k"].b`` -> ["a","b"] (subscripts
+    are transparent). Returns None if the chain passes through a call or
+    any non-name root — a call result is a NEW object, which breaks
+    taint/receiver reasoning."""
+    parts: list[str] = []
+    cur = node
+    while True:
+        if isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        elif isinstance(cur, ast.Subscript):
+            cur = cur.value
+        elif isinstance(cur, ast.Name):
+            parts.append(cur.id)
+            parts.reverse()
+            return parts
+        else:
+            return None
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """The last identifier of a Name/Attribute expression, else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def hint_text(node: ast.AST) -> str:
+    """Lowercased bag of identifiers + string constants under a node —
+    used to decide whether e.g. a comparison is 'about' a phase."""
+    out: list[str] = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.append(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.append(sub.attr)
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            out.append(sub.value)
+    return " ".join(out).lower()

@@ -1,0 +1,337 @@
+"""Runtime lock-order sanitizer: lockdep for the concurrency suites.
+
+The static checkers see lock-held *regions*; this module watches lock
+*interleavings* while the threaded suites actually run. Inside a
+:func:`sanitize_locks` session, every ``threading.Lock()`` /
+``threading.RLock()`` created by repo code is wrapped so acquisitions
+record, per thread, the stack of locks currently held. Two facts are
+collected:
+
+- the **acquisition-order graph**: an edge A→B whenever a thread
+  acquires a lock of class B while holding one of class A. A cycle in
+  that graph (including a self-edge over two *distinct instances* of
+  one class) is a potential deadlock — two threads can interleave the
+  two orders and wait on each other forever. This is ThreadSanitizer's
+  lock-order inversion detection / the kernel's lockdep, scoped to this
+  process model.
+- **hold times**: wall-clock per acquisition, with Condition waits
+  excluded (``wait()`` releases the lock; the hold naturally splits).
+  Holds beyond the budget (``BOBRA_LOCK_HOLD_BUDGET``, default 0.5 s)
+  are reported as warnings — wall-clock under CI contention is too
+  noisy to gate on by default; set ``BOBRA_LOCK_HOLD_STRICT=1`` to
+  fail on them.
+
+Lock *classes* are keyed by allocation site (``module:lineno``), like
+lockdep: all instances born on one line share a class, so an ordering
+inversion between two ``SlicePool``\\ s is caught even though the
+specific instances differ, while a class's two different lock
+attributes (born on different lines) stay distinct.
+
+Locks created by stdlib code (logging, queue, thread startup) are left
+untouched — zero overhead, zero duck-typing risk; edges through them
+are invisible, which is fine: the invariants under test are about repo
+locks.
+
+Usage (the three threaded suites wire this as an autouse fixture)::
+
+    with sanitize_locks() as monitor:
+        ... run threaded workload ...
+    monitor.assert_clean()   # raises LockOrderViolation on cycles
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+from typing import Iterator, Optional
+
+_THIS_FILE = os.path.abspath(__file__)
+#: repo source prefixes whose lock allocations are tracked
+_TRACKED_PARTS = (f"{os.sep}bobrapet_tpu{os.sep}", f"{os.sep}tests{os.sep}")
+
+
+class LockOrderViolation(AssertionError):
+    """The acquisition-order graph has a cycle (potential deadlock)."""
+
+
+class LockMonitor:
+    """Collects acquisition edges + hold times for one session."""
+
+    def __init__(self, hold_budget: Optional[float] = None):
+        if hold_budget is None:
+            hold_budget = float(os.environ.get("BOBRA_LOCK_HOLD_BUDGET", "0.5"))
+        self.hold_budget = hold_budget
+        self.enabled = True
+        self._tls = threading.local()
+        #: (from_label, to_label) -> acquisition count. Plain dict ops
+        #: under the GIL; per-edge counts may undercount under heavy
+        #: races but edge EXISTENCE (what cycles are built from) cannot
+        #: be lost.
+        self.edges: dict[tuple[str, str], int] = {}
+        #: label -> max observed hold seconds
+        self.max_hold: dict[str, float] = {}
+        #: (label, seconds) for holds beyond budget
+        self.hold_violations: list[tuple[str, float]] = []
+
+    # -- per-thread stack --------------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def on_acquired(self, lock: "_SanitizedLockBase", count: int = 1) -> None:
+        if not self.enabled:
+            return
+        stack = self._stack()
+        for entry in stack:
+            if entry[0] is lock:  # re-entrant RLock acquire
+                entry[3] += count
+                return
+        if stack:
+            top = stack[-1]
+            if top[0] is not lock:
+                key = (top[1], lock.label)
+                self.edges[key] = self.edges.get(key, 0) + 1
+        stack.append([lock, lock.label, time.monotonic(), count])
+
+    def on_released(self, lock: "_SanitizedLockBase") -> None:
+        if not self.enabled:
+            return
+        stack = self._stack()
+        # search from the top: locks may legally release out of order
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] is lock:
+                if stack[i][3] > 1:
+                    stack[i][3] -= 1
+                    return
+                held = time.monotonic() - stack[i][2]
+                del stack[i]
+                self._note_hold(lock.label, held)
+                return
+
+    def on_wait_release(self, lock: "_SanitizedLockBase") -> None:
+        """Condition.wait released the lock entirely (_release_save)."""
+        if not self.enabled:
+            return
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] is lock:
+                held = time.monotonic() - stack[i][2]
+                del stack[i]
+                self._note_hold(lock.label, held)
+                return
+
+    def _note_hold(self, label: str, held: float) -> None:
+        if held > self.max_hold.get(label, 0.0):
+            self.max_hold[label] = held
+        if held > self.hold_budget > 0:
+            self.hold_violations.append((label, held))
+
+    # -- analysis ----------------------------------------------------------
+
+    def cycles(self) -> list[list[str]]:
+        """Strongly connected components of the edge graph with more
+        than one node, plus self-edges — each is a potential deadlock."""
+        graph: dict[str, set[str]] = {}
+        for a, b in self.edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on_stack: set[str] = set()
+        stack: list[str] = []
+        out: list[list[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            # iterative Tarjan (suites can build deep graphs)
+            work = [(v, iter(sorted(graph[v])))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(graph[w]))))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) > 1 or (node, node) in self.edges:
+                        out.append(sorted(comp))
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+        return out
+
+    def report(self) -> str:
+        lines = [
+            f"lock-order sanitizer: {len(self.edges)} edge(s), "
+            f"{len(self.max_hold)} lock class(es)"
+        ]
+        for cyc in self.cycles():
+            involved = [
+                f"{a} -> {b} ({n}x)"
+                for (a, b), n in sorted(self.edges.items())
+                if a in cyc and b in cyc
+            ]
+            lines.append("CYCLE: " + " | ".join(involved))
+        for label, held in self.hold_violations:
+            lines.append(
+                f"HOLD: {label} held {held:.3f}s "
+                f"(budget {self.hold_budget:.3f}s)"
+            )
+        return "\n".join(lines)
+
+    def assert_clean(self, strict_hold: Optional[bool] = None) -> None:
+        """Raise on acquisition-order cycles; hold-budget violations
+        raise only in strict mode (default: BOBRA_LOCK_HOLD_STRICT)."""
+        if strict_hold is None:
+            strict_hold = os.environ.get("BOBRA_LOCK_HOLD_STRICT", "") not in (
+                "", "0", "false",
+            )
+        cycles = self.cycles()
+        if cycles or (strict_hold and self.hold_violations):
+            raise LockOrderViolation(self.report())
+        if self.hold_violations:
+            print(f"[lockorder warning]\n{self.report()}", file=sys.stderr)
+
+
+# ---------------------------------------------------------------------------
+# instrumented lock wrappers
+# ---------------------------------------------------------------------------
+
+
+class _SanitizedLockBase:
+    __slots__ = ("_inner", "label", "_mon")
+
+    def __init__(self, inner, label: str, mon: LockMonitor):
+        self._inner = inner
+        self.label = label
+        self._mon = mon
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._mon.on_acquired(self)
+        return got
+
+    def release(self) -> None:
+        self._mon.on_released(self)
+        self._inner.release()
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<sanitized {self.label} wrapping {self._inner!r}>"
+
+
+class _SanitizedLock(_SanitizedLockBase):
+    __slots__ = ()
+
+    # Condition duck-typing for plain Locks uses acquire/release only —
+    # already instrumented above.
+
+
+class _SanitizedRLock(_SanitizedLockBase):
+    __slots__ = ()
+
+    # Condition(RLock) protocol: wait() saves/releases the whole
+    # recursion and restores it on wakeup; mirror that in the stack so
+    # the wait time never counts as hold time.
+    def _release_save(self):
+        self._mon.on_wait_release(self)
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state) -> None:
+        self._inner._acquire_restore(state)
+        # CPython's RLock state is (count, owner): restore the SAME
+        # recursion depth in the monitor, or the first post-wait
+        # release() of a recursively-held lock would drop the entry
+        # while the lock is still held (missed ordering edges)
+        count = state[0] if isinstance(state, tuple) and state else 1
+        self._mon.on_acquired(self, count=max(1, int(count)))
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+def _creation_label() -> Optional[str]:
+    """Allocation site of the lock being created, as ``module:lineno``;
+    None -> do not track. Only the IMMEDIATE caller frame counts: a
+    ``threading.Lock()`` written in repo source is a repo lock, but a
+    lock born inside a stdlib constructor the repo merely invoked
+    (ThreadPoolExecutor, Thread, Event, Condition) is stdlib machinery —
+    attributing those to the repo call site would fuse many unrelated
+    stdlib locks into one fake lock class and manufacture cycles."""
+    frame = sys._getframe(2)
+    fn = frame.f_code.co_filename
+    if fn != _THIS_FILE and any(p in fn for p in _TRACKED_PARTS):
+        mod = frame.f_globals.get("__name__", "?")
+        return f"{mod}:{frame.f_lineno}"
+    return None
+
+
+@contextlib.contextmanager
+def sanitize_locks(
+    hold_budget: Optional[float] = None,
+) -> Iterator[LockMonitor]:
+    """Patch ``threading.Lock``/``RLock`` for the duration; locks repo
+    code creates inside the session are instrumented and keep working
+    (recording stops) after the session ends."""
+    mon = LockMonitor(hold_budget=hold_budget)
+    real_lock = threading.Lock
+    real_rlock = threading.RLock
+
+    def make_lock():
+        label = _creation_label()
+        inner = real_lock()
+        return inner if label is None else _SanitizedLock(inner, label, mon)
+
+    def make_rlock():
+        label = _creation_label()
+        inner = real_rlock()
+        return inner if label is None else _SanitizedRLock(inner, label, mon)
+
+    threading.Lock = make_lock  # type: ignore[assignment]
+    threading.RLock = make_rlock  # type: ignore[assignment]
+    try:
+        yield mon
+    finally:
+        threading.Lock = real_lock  # type: ignore[assignment]
+        threading.RLock = real_rlock  # type: ignore[assignment]
+        mon.enabled = False

@@ -216,6 +216,21 @@ class EffectClaimPhase(StrEnum):
     ABANDONED = "Abandoned"
 
 
+class HandoffPhase(StrEnum):
+    """Realtime rollout handoff state machine
+    (``StepRun.status.handoff.phase``; reference: deriveRealtimePhase
+    steprun_controller.go:2838 drives the same drain/cutover flow).
+
+    ``COMPLETED`` deliberately does NOT reuse EffectClaimPhase: a
+    handoff finishing and an effect lease completing are unrelated
+    state machines that merely share a word.
+    """
+
+    DRAINING = "Draining"
+    CUTTING_OVER = "CuttingOver"
+    COMPLETED = "Completed"
+
+
 class OffloadedDataPolicy(StrEnum):
     """What to do when a template references offloaded step output
     (reference: internal/controller/runs/templating_policy.go:12-43)."""

@@ -19,6 +19,7 @@ import os
 from typing import Any
 
 from .catalog import make_engram_template, make_impulse_template
+from .enums import Phase
 from .engram import make_engram
 from .impulse import make_impulse
 from .policy import make_reference_grant
@@ -129,7 +130,7 @@ def _harvest(rt) -> list:
     run = rt.run_story("rag", inputs={"question": "what is a TPU slice?"},
                        name="rag-run-sample")
     rt.pump()
-    assert rt.run_phase(run) == "Succeeded", rt.run_phase(run)
+    assert rt.run_phase(run) == Phase.SUCCEEDED, rt.run_phase(run)
 
     # a durable trigger delivery (webhook-style) admits one more run
     from ..core.object import new_resource

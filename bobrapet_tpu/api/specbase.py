@@ -22,6 +22,7 @@ import enum
 import functools
 import json
 import os
+import sys
 import threading
 import typing
 from typing import Any, Optional, Type, TypeVar, get_args, get_origin, get_type_hints
@@ -160,10 +161,19 @@ _PARSE_KEY_MAX = 64 * 1024  # don't serialize giant specs just to key them
 #: re-serializes the cached parse and compares against the dump hash
 #: recorded at insert — a consumer that mutated the shared object in
 #: place (poisoning every other holder) fails loudly at the next hit
-#: instead of corrupting unrelated reconciles silently.
-PARSE_CACHE_DEBUG = os.environ.get(
-    "BOBRA_PARSE_CACHE_DEBUG", ""
-) not in ("", "0", "false")
+#: instead of corrupting unrelated reconciles silently. Debug mode also
+#: disables the identity fast path (pure content keying).
+_ENV_DEBUG = os.environ.get("BOBRA_PARSE_CACHE_DEBUG", "")
+PARSE_CACHE_DEBUG = _ENV_DEBUG not in ("", "0", "false")
+#: The CHEAP tier of the same trap, always on under pytest: digests are
+#: rechecked on content-cache hits only, while the id fast path stays
+#: enabled (an id hit proves the caller got the same dict back — the
+#: mutation still surfaces at the next content hit from a fresh copy).
+#: Hot paths keep their O(1) reads; the whole suite doubles as a
+#: mutation canary. Opt out with BOBRA_PARSE_CACHE_DEBUG=0.
+PARSE_CACHE_CHECK = PARSE_CACHE_DEBUG or (
+    _ENV_DEBUG == "" and "pytest" in sys.modules
+)
 _PARSE_DUMPS: dict[tuple, int] = {}
 
 
@@ -258,12 +268,14 @@ def cached_parse(cls: Type[T], spec: Optional[dict]) -> T:
                 while len(_PARSE_ID_PROBATION) > _PARSE_ID_PROBATION_MAX:
                     _PARSE_ID_PROBATION.popitem(last=False)
     if hit is not None:
-        if PARSE_CACHE_DEBUG and _dump_hash(hit) != _PARSE_DUMPS.get(key):
-            raise SharedParseMutated(
-                f"cached {cls.__name__} parse was mutated in place by a "
-                f"consumer — cached_parse objects are shared process-wide "
-                f"and must be treated as immutable (spec: {body[:200]})"
-            )
+        if PARSE_CACHE_CHECK or PARSE_CACHE_DEBUG:
+            recorded = _PARSE_DUMPS.get(key)
+            if recorded is not None and _dump_hash(hit) != recorded:
+                raise SharedParseMutated(
+                    f"cached {cls.__name__} parse was mutated in place by a "
+                    f"consumer — cached_parse objects are shared process-wide "
+                    f"and must be treated as immutable (spec: {body[:200]})"
+                )
         return hit
     parsed = cls.from_dict(spec)
     with _PARSE_CACHE_LOCK:
@@ -273,7 +285,7 @@ def cached_parse(cls: Type[T], spec: Optional[dict]) -> T:
             _PARSE_DUMPS.pop(evicted, None)
         # no id-cache insert on a first-ever parse: only dicts seen
         # twice (content hits) earn an identity entry
-        if PARSE_CACHE_DEBUG:
+        if PARSE_CACHE_CHECK or PARSE_CACHE_DEBUG:
             _PARSE_DUMPS[key] = _dump_hash(parsed)
     return parsed
 

@@ -613,7 +613,7 @@ class DAGEngine:
             # batch semantics require terminal deps
             # (reference: realtime topology, steprun_controller.go:2527;
             # wait/gate rejected in realtime by admission)
-            realtime = story.effective_pattern.value == "realtime"
+            realtime = story.effective_pattern.is_realtime
 
             def dep_satisfied(d: str) -> bool:
                 raw = states.get(d)

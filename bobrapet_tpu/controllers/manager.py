@@ -164,6 +164,11 @@ class ControllerManager:
         self._requeue_max = requeue_max_delay
         self._max_failures_logged = max_failures_logged
         self._default_max_concurrent = max(1, int(default_max_concurrent))
+        #: soft reconcile budget (controllers.reconcile-timeout): threads
+        #: cannot be killed, so an overrun is detected after the fact —
+        #: logged + counted so a wedged reconciler is visible in metrics
+        #: before it exhausts its pool
+        self._reconcile_timeout = 30.0
         self._per_controller_max: dict[str, int] = {}
         #: widths pinned by register(max_concurrent=...) — these outrank
         #: config and survive apply_config reloads
@@ -225,6 +230,7 @@ class ControllerManager:
         with self._lock:
             self._requeue_base = tuning.requeue_base_delay
             self._requeue_max = tuning.requeue_max_delay
+            self._reconcile_timeout = max(0.0, float(tuning.reconcile_timeout))
             self._default_max_concurrent = max(
                 1, int(tuning.max_concurrent_reconciles)
             )
@@ -321,13 +327,13 @@ class ControllerManager:
         try:
             requeue_after = fn(ns, name)
             metrics.reconcile_total.inc(controller, "success")
-            metrics.reconcile_duration.observe(time.monotonic() - started, controller)
+            self._observe_duration(controller, ns, name, started)
             self._failures.pop(key, None)
             if requeue_after is not None and requeue_after >= 0:
                 self.enqueue(controller, ns, name, after=max(requeue_after, 1e-9))
         except Exception:  # noqa: BLE001 - reconcile errors retry with backoff
             metrics.reconcile_total.inc(controller, "error")
-            metrics.reconcile_duration.observe(time.monotonic() - started, controller)
+            self._observe_duration(controller, ns, name, started)
             # per-key counters race-free: keyed serialization means no
             # two workers ever touch the same key's entry concurrently
             n = self._failures.get(key, 0) + 1
@@ -339,6 +345,19 @@ class ControllerManager:
                     controller, ns, name, n, delay,
                 )
             self.enqueue(controller, ns, name, after=delay)
+
+    def _observe_duration(
+        self, controller: str, ns: str, name: str, started: float
+    ) -> None:
+        dur = time.monotonic() - started
+        metrics.reconcile_duration.observe(dur, controller)
+        if 0 < self._reconcile_timeout < dur:
+            metrics.reconcile_overruns.inc(controller)
+            _log.warning(
+                "reconcile %s %s/%s took %.2fs (budget %.2fs, "
+                "controllers.reconcile-timeout)",
+                controller, ns, name, dur, self._reconcile_timeout,
+            )
 
     def _finish_locked(self, key: tuple[str, str, str]) -> None:
         """Retire an in-flight key; a dirty mark re-queues it once."""

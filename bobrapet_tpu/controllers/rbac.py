@@ -205,6 +205,54 @@ class RunRBACManager:
             metrics.rbac_ops.inc("update")
 
 
+#: verbs a controller needs on kinds it fully manages
+_MANAGE_VERBS = ["get", "list", "watch", "create", "update", "patch", "delete"]
+
+
+def manager_cluster_rules() -> list[dict[str, Any]]:
+    """The ClusterRole rules the MANAGER deployment needs against a real
+    cluster, derived from code-level registrations — the schema registry
+    (CRD groups), the workload kinds the materializer emits and the
+    executors watch, and the election Lease — so the chart's
+    hand-maintained ``serviceaccount.yaml`` can be diffed against what
+    the code actually touches (test_chart_rbac_drift.py), the same
+    chart<->code contract as ``webhook_configurations()``.
+
+    Shape notes: CRD kinds get wildcard resources per group (the
+    manager owns every kind it registers, including future ones in the
+    same groups) plus the status subresource; Pods are read-only (exit
+    code extraction only — the Job controller owns their lifecycle).
+    """
+    from ..api.schemas import _registry
+    from ..cluster.kubeclient import plural_for
+    from ..gke.materialize import JOBSET_API_VERSION
+    from ..utils.leader import KubeLeaseElector
+    from .streaming import DEPLOYMENT_KIND, SERVICE_KIND, STATEFULSET_KIND
+
+    crd_groups = sorted({e.group for e in _registry()})
+    jobset_group = JOBSET_API_VERSION.split("/", 1)[0]
+    lease_group = KubeLeaseElector.API_VERSION.split("/", 1)[0]
+    return [
+        {"apiGroups": crd_groups, "resources": ["*"], "verbs": _MANAGE_VERBS},
+        {"apiGroups": crd_groups, "resources": ["*/status"],
+         "verbs": ["get", "update", "patch"]},
+        {"apiGroups": ["batch"], "resources": [plural_for("Job")],
+         "verbs": _MANAGE_VERBS},
+        {"apiGroups": [jobset_group], "resources": [plural_for("JobSet")],
+         "verbs": _MANAGE_VERBS},
+        {"apiGroups": ["apps"],
+         "resources": sorted(
+             [plural_for(DEPLOYMENT_KIND), plural_for(STATEFULSET_KIND)]),
+         "verbs": _MANAGE_VERBS},
+        {"apiGroups": [""], "resources": [plural_for("Pod")],
+         "verbs": ["get", "list", "watch"]},
+        {"apiGroups": [""], "resources": [plural_for(SERVICE_KIND)],
+         "verbs": _MANAGE_VERBS},
+        {"apiGroups": [lease_group], "resources": [plural_for("Lease")],
+         "verbs": _MANAGE_VERBS},
+    ]
+
+
 def objects_hash(specs: list[dict[str, Any]]) -> str:
     """Stable digest of the [SA, Role, RoleBinding] spec list — lets the
     StoryRun controller's quick path detect out-of-band drift of any of

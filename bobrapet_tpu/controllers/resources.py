@@ -150,7 +150,7 @@ class StoryController:
         warnings: list[str] = []
 
         all_steps = spec.all_steps()
-        realtime = spec.effective_pattern.value == "realtime"
+        realtime = spec.effective_pattern.is_realtime
         declared_transports = {t.name or t.transport_ref for t in (spec.transports or [])}
 
         for step in all_steps:

@@ -36,6 +36,7 @@ from ..api.story import KIND as STORY_KIND, parse_story
 from ..core.events import EventRecorder
 from ..core.store import AlreadyExists, NotFound, ResourceStore
 from ..observability.metrics import metrics
+from ..observability.structured import StepLogger
 from ..sdk import contract
 from ..storage.manager import StorageManager
 from ..templating.engine import (
@@ -447,6 +448,8 @@ class StepRunController:
             status.pop("error", None)
 
         self.store.patch_status(STEP_RUN_KIND, namespace, name, finish)
+        # logging.step-output toggle (reference: pkg/logging/features.go)
+        StepLogger("steprun", namespace=namespace, object=name).step_output(output)
         self._observe_terminal(fresh, str(Phase.SUCCEEDED))
         return None
 

@@ -34,7 +34,7 @@ from typing import Any, Optional
 
 from ..api import conditions
 from ..api.catalog import CLUSTER_NAMESPACE
-from ..api.enums import Phase
+from ..api.enums import HandoffPhase, Phase
 from ..api.runs import STEP_RUN_KIND, STORY_RUN_KIND
 from ..api.story import KIND as STORY_KIND, parse_story
 from ..api.transport import (
@@ -461,13 +461,16 @@ def _sync_handoff(ctrl, sr, ctx, deployment, generation) -> None:
         strategy = settings.lifecycle.upgrade_strategy
 
     if observed and (observed < generation or ready_gen < generation):
-        if current.get("newGeneration") != generation or current.get("phase") == "Completed":
+        if current.get("newGeneration") != generation or current.get("phase") == HandoffPhase.COMPLETED:
             now = ctrl.clock.now()
             ctrl.store.patch_status(
                 STEP_RUN_KIND, ns, name,
                 lambda st: st.__setitem__("handoff", {
                     "strategy": strategy,
-                    "phase": "Draining" if strategy == "drain" else "CuttingOver",
+                    "phase": str(
+                        HandoffPhase.DRAINING if strategy == "drain"
+                        else HandoffPhase.CUTTING_OVER
+                    ),
                     "oldGeneration": min(observed, ready_gen) or observed,
                     "newGeneration": generation,
                     "startedAt": now,
@@ -475,7 +478,7 @@ def _sync_handoff(ctrl, sr, ctx, deployment, generation) -> None:
             )
     elif (
         current
-        and current.get("phase") != "Completed"
+        and current.get("phase") != HandoffPhase.COMPLETED
         and observed >= generation
         and ready_gen >= generation
     ):
@@ -484,7 +487,7 @@ def _sync_handoff(ctrl, sr, ctx, deployment, generation) -> None:
         ctrl.store.patch_status(
             STEP_RUN_KIND, ns, name,
             lambda st: st.__setitem__(
-                "handoff", {**current, "phase": "Completed"}
+                "handoff", {**current, "phase": str(HandoffPhase.COMPLETED)}
             ),
         )
 

@@ -42,6 +42,7 @@ NODE_SELECTOR_SPOT = "cloud.google.com/gke-spot"
 TPU_RESOURCE = "google.com/tpu"
 COMPLETION_INDEX_ANNOTATION = "batch.kubernetes.io/job-completion-index"
 JOBSET_REPLICATED_JOB = "gang"
+JOBSET_API_VERSION = "jobset.x-k8s.io/v1alpha2"
 
 DEFAULT_COORDINATOR_PORT = 8476  # jax.distributed default
 
@@ -257,7 +258,7 @@ def _wrap_jobset(
     also enforces)."""
     inner = {k: v for k, v in job_spec.items() if k != "ttlSecondsAfterFinished"}
     return {
-        "apiVersion": "jobset.x-k8s.io/v1alpha2",
+        "apiVersion": JOBSET_API_VERSION,
         "kind": "JobSet",
         "metadata": {"name": name, "namespace": namespace, "labels": labels},
         "spec": {

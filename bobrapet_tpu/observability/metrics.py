@@ -533,6 +533,12 @@ class _ControlPlaneMetrics:
         self.mapper_failures = c(
             "bobrapet_mapper_failures_total", "Watch-mapper errors", ["controller"]
         )
+        self.reconcile_overruns = c(
+            "bobrapet_reconcile_overruns_total",
+            "Reconciles that exceeded the controllers.reconcile-timeout "
+            "budget (detected post-hoc; workers cannot be killed)",
+            ["controller"],
+        )
         # Per-controller dispatcher (reference: workqueue_depth /
         # workqueue_queue_duration_seconds / active_workers, the
         # controller-runtime workqueue families)

@@ -90,6 +90,9 @@ class Runtime:
         preemption_injector=None,
     ):
         self.clock = clock or ManualClock()
+        # an explicitly injected tracer keeps its own enabled flag; only
+        # the module-default tracer follows the telemetry.enabled key
+        self._tracer_follows_config = tracer is None
         if tracer is None:
             from .observability.tracing import TRACER as tracer
         self.tracer = tracer
@@ -115,6 +118,10 @@ class Runtime:
         )
         self.resolver = Resolver(cfg)
         self.config_manager.subscribe(self._on_config_change)
+        # subscribers only fire on RELOADS; a pre-existing ConfigMap's
+        # observability toggles must apply at startup too (same
+        # construct-then-apply pattern as manager.apply_config below)
+        self._apply_observability_toggles(cfg)
 
         self._register_indexes()
         # admission layer (reference: setupWebhooksIfEnabled, cmd/main.go:802;
@@ -255,8 +262,19 @@ class Runtime:
             self.cr_syncer.resync()
 
     # ------------------------------------------------------------------
+    def _apply_observability_toggles(self, cfg) -> None:
+        """Process-wide observability toggles (reference:
+        ApplyRuntimeToggles controller_config.go:176 — telemetry.enabled
+        flips tracing, logging.* drives the zap feature gates)."""
+        if self._tracer_follows_config:
+            self.tracer.config.enabled = cfg.telemetry_enabled
+        from .observability.structured import FEATURES
+
+        FEATURES.apply(cfg.verbosity, cfg.step_output_logging)
+
     def _on_config_change(self, cfg) -> None:
         self.resolver.operator_config = cfg
+        self._apply_observability_toggles(cfg)
         self.evaluator.config.evaluation_timeout = cfg.templating.evaluation_timeout
         self.evaluator.config.max_output_bytes = cfg.templating.max_output_bytes
         self.evaluator.config.deterministic = cfg.templating.deterministic

@@ -1,0 +1,13 @@
+"""BAD corpus for config-key-drift (checked against the REAL registry
+in config/operator.py): unregistered dotted keys in key positions."""
+
+CONFIG_MAP_DATA = {
+    "data": {
+        "fleet.bogus-knob": "1",  # BAD: no such key in the table
+        "dataplane.writer-max-batch-size": "64",  # BAD: near-miss of a real key
+    }
+}
+
+
+def read_unknown(config):
+    return config.get("controllers.max-reconcile-width")  # BAD: unregistered

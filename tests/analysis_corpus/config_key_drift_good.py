@@ -1,0 +1,20 @@
+"""GOOD corpus for config-key-drift: registered keys + dynamic families
++ dotted strings in non-key positions."""
+
+CONFIG_MAP_DATA = {
+    "data": {
+        "fleet.preemption-retry-cap": "5",  # OK: registered
+        "dataplane.writer-max-batch": "64",  # OK: registered
+        "controllers.steprun.max-concurrent-reconciles": "8",  # OK: dynamic family
+        "scheduling.queue.gpu.max-concurrent": "2",  # OK: dynamic family
+    }
+}
+
+
+def read_known(config):
+    return config.get("templating.evaluation-timeout")  # OK: registered
+
+
+def span_name(tracer):
+    # OK: dotted string as a call argument is NOT a config key position
+    return tracer.start_span("engram.work")

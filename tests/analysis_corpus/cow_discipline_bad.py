@@ -1,0 +1,33 @@
+"""BAD corpus for cow-discipline: every mutation here must be flagged."""
+
+
+def mutate_view(store):
+    sr = store.get_view("StepRun", "ns", "a")
+    sr.status["phase"] = "Poisoned"  # BAD: assignment into a view
+
+
+def mutate_try_view(store):
+    sr = store.try_get_view("StepRun", "ns", "a")
+    if sr is not None:
+        sr.spec.update({"k": "v"})  # BAD: mutating method on a view
+
+
+def mutate_list_views(store):
+    for obj in store.list_views("StepRun"):
+        obj.meta.labels["touched"] = "yes"  # BAD: loop var from list_views
+
+
+def mutate_parsed(cached_parse, Step, spec):
+    parsed = cached_parse(Step, spec)
+    parsed.with_["k"] = "v"  # BAD: shared parse mutated
+
+
+def mutate_event(ev, store):
+    sr = ev.resource
+    del sr.status["phase"]  # BAD: watch payloads are shared
+
+
+def mutate_alias(store):
+    view = store.get_view("StepRun", "ns", "a")
+    alias = view
+    alias.status["x"] = 1  # BAD: taint propagates through alias

@@ -1,0 +1,33 @@
+"""GOOD corpus for cow-discipline: nothing here may be flagged."""
+
+
+def read_view(store):
+    sr = store.get_view("StepRun", "ns", "a")
+    return sr.status.get("phase")
+
+
+def copy_then_mutate(store):
+    sr = store.get_view("StepRun", "ns", "a").deepcopy()
+    sr.status["phase"] = "Running"  # OK: chain broken by deepcopy()
+    return sr
+
+
+def rebind_clears_taint(store):
+    sr = store.get_view("StepRun", "ns", "a")
+    sr = {"status": {}}
+    sr["status"]["phase"] = "Running"  # OK: rebound to a fresh dict
+    return sr
+
+
+def write_through_store(store):
+    def patch(r):
+        r.status["phase"] = "Running"  # OK: mutate() hands out a copy
+
+    store.mutate("StepRun", "ns", "a", patch)
+
+
+def dump_is_fresh(cached_parse, Step, spec):
+    parsed = cached_parse(Step, spec)
+    d = parsed.to_dict()
+    d["name"] = "local-copy"  # OK: to_dict() is a new tree
+    return d

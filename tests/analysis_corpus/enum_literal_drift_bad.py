@@ -1,0 +1,20 @@
+"""BAD corpus for enum-literal-drift (fed to the checker under a
+bobrapet_tpu/ pseudo-path; as a real tests/ file it would be exempt)."""
+
+
+def compare_phase(sr):
+    return sr.status.get("phase") == "Running"  # BAD: Phase.RUNNING
+
+
+def compare_exit(state):
+    if state.exit_class in ("retry", "rateLimited"):  # BAD: ExitClass members
+        return True
+    return False
+
+
+def stamp_phase(status):
+    status["phase"] = "Succeeded"  # BAD: keyed store of Phase value
+
+
+def build_status():
+    return {"phase": "Failed", "exitClass": "terminal"}  # BAD: both keys

@@ -1,0 +1,26 @@
+"""GOOD corpus for enum-literal-drift."""
+
+from bobrapet_tpu.api.enums import ExitClass, Phase
+
+
+def compare_phase(sr):
+    return sr.status.get("phase") == Phase.RUNNING  # OK: enum member
+
+
+def stamp_phase(status):
+    status["phase"] = str(Phase.SUCCEEDED)  # OK: serialized enum
+
+
+def build_status():
+    return {"phase": Phase.FAILED.value, "exitClass": ExitClass.TERMINAL.value}
+
+
+def unrelated_literals(doc):
+    # OK: 'Running' compared against something with no phase hint
+    return doc.title == "Running"
+
+
+def kube_vocabulary(pod):
+    # would be BAD in repo code (and is, in cluster/: suppressed with a
+    # justification) — here the hint word is absent so it's not flagged
+    return pod.state == "Whatever"

@@ -1,0 +1,46 @@
+"""BAD corpus for lock-blocking-io: every pattern here must be flagged."""
+
+import os
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+class Recorder:
+    def __init__(self, store):
+        self._lock = threading.Lock()
+        self.store = store
+
+    def sweep_sleep(self):
+        with self._lock:
+            time.sleep(0.5)  # BAD: sleep under lock
+
+    def sweep_store(self):
+        with self._lock:
+            return self.store.list("StepRun")  # BAD: store traffic under lock
+
+    def sweep_view(self):
+        with self._lock:
+            return self.store.list_views("StepRun")  # BAD: store lock edge
+
+    def _journal(self, payload):
+        with open("/tmp/journal", "w") as f:  # blocking helper
+            f.write(payload)
+
+    def sweep_indirect(self):
+        with self._lock:
+            self._journal("x")  # BAD: same-file helper does file I/O
+
+    def sweep_socket(self, sock):
+        with self._lock:
+            return sock.recv(4096)  # BAD: socket under lock
+
+    def sweep_event(self, ev):
+        with self._lock:
+            ev.wait(1.0)  # BAD: Event.wait blocks the lock (no release)
+
+
+def module_level(payload):
+    with _lock:
+        os.replace("/tmp/a", "/tmp/b")  # BAD: filesystem under module lock

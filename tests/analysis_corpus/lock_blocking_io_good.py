@@ -1,0 +1,33 @@
+"""GOOD corpus for lock-blocking-io: nothing here may be flagged."""
+
+import threading
+import time
+
+
+class Recorder:
+    def __init__(self, store):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.store = store
+        self._pending = []
+
+    def sweep(self):
+        # snapshot under the lock, act after release — the fixed
+        # recorder pattern
+        with self._lock:
+            pending = list(self._pending)
+            self._pending.clear()
+        for key in pending:
+            self.store.list("StepRun", namespace=key)
+        time.sleep(0.01)
+
+    def wait_for_work(self):
+        with self._lock:
+            self._cond.wait(timeout=1.0)  # OK: Condition.wait releases
+
+    def deferred_def(self):
+        with self._lock:
+            def flush():
+                time.sleep(1.0)  # OK: defined under lock, not run
+
+            self._pending.append(flush)

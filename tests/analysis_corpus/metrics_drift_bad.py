@@ -1,0 +1,12 @@
+"""BAD corpus for metrics-drift (checked against the REAL inventory in
+observability/metrics.py)."""
+
+from bobrapet_tpu.observability.metrics import REGISTRY, metrics
+
+
+def emit_unknown():
+    metrics.totally_unregistered_family.inc("x")  # BAD: not in inventory
+
+
+def rogue_unprefixed():
+    return REGISTRY.counter("my_adhoc_total", "no namespace")  # BAD: prefix

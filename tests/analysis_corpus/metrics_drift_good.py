@@ -1,0 +1,18 @@
+"""GOOD corpus for metrics-drift."""
+
+from bobrapet_tpu.observability.metrics import REGISTRY, metrics
+
+
+def emit_known():
+    metrics.steprun_total.inc("Succeeded")  # OK: registered family
+    metrics.reconcile_queue_depth.set(3, "steprun")  # OK
+
+
+def adhoc_prefixed():
+    # OK: ad-hoc registration is allowed when it stays in the namespace
+    return REGISTRY.counter("bobrapet_corpus_demo_total", "demo")
+
+
+def registry_admin():
+    REGISTRY.reset()  # OK: registry management, not an emission
+    return metrics.REGISTRY if hasattr(metrics, "REGISTRY") else None

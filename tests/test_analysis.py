@@ -1,0 +1,187 @@
+"""bobralint analyzer tests (ISSUE 4).
+
+Three layers:
+
+1. **fixture corpus** — ``tests/analysis_corpus/`` holds a good/bad
+   pair per checker. Every line tagged ``# BAD`` in a bad fixture must
+   be flagged by its checker; the good twin must produce zero findings.
+   Corpus files are fed to the checkers under a ``bobrapet_tpu/``
+   pseudo-path (so path-scoped checkers engage) against the REAL repo
+   context, so the drift checkers validate against the live registries.
+2. **framework** — fingerprint stability under line shifts, baseline
+   loader rejections (placeholder justifications, duplicates), stale
+   detection.
+3. **self-run** — the repo itself is clean modulo the checked-in
+   baseline, and the baseline carries no stale entries; this is the
+   same gate ``make analyze`` / CI runs.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+
+import pytest
+
+from bobrapet_tpu.analysis import Baseline, BaselineError, load_project, run_checkers
+from bobrapet_tpu.analysis.checkers import ALL_CHECKERS
+from bobrapet_tpu.analysis.context import DYNAMIC_CONFIG_FAMILIES
+from bobrapet_tpu.analysis.core import ProjectFile
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+CORPUS = os.path.join(os.path.dirname(__file__), "analysis_corpus")
+CHECKERS = {c.name: c for c in ALL_CHECKERS}
+
+
+@pytest.fixture(scope="module")
+def repo_ctx():
+    ctx, errors = load_project(REPO_ROOT)
+    assert not errors, errors
+    return ctx
+
+
+def corpus_findings(ctx, checker_name: str, fname: str):
+    path = os.path.join(CORPUS, fname)
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    rel = f"bobrapet_tpu/_corpus/{fname}"
+    pf = ProjectFile(path=path, rel=rel, source=source, tree=ast.parse(source))
+    found = CHECKERS[checker_name].run([pf], ctx)
+    return [f for f in found if f.path == rel], source
+
+
+def bad_lines(source: str) -> set[int]:
+    return {
+        i for i, line in enumerate(source.splitlines(), 1) if "# BAD" in line
+    }
+
+
+class TestCheckerCorpus:
+    @pytest.mark.parametrize("name", sorted(CHECKERS))
+    def test_bad_fixture_fully_flagged(self, repo_ctx, name):
+        fname = name.replace("-", "_") + "_bad.py"
+        findings, source = corpus_findings(repo_ctx, name, fname)
+        assert findings, f"{name} found nothing in its bad fixture"
+        assert {f.checker for f in findings} == {name}
+        flagged = {f.line for f in findings}
+        missed = bad_lines(source) - flagged
+        assert not missed, (
+            f"{name} missed tagged lines {sorted(missed)} in {fname} "
+            f"(flagged: {sorted(flagged)})"
+        )
+
+    @pytest.mark.parametrize("name", sorted(CHECKERS))
+    def test_good_fixture_clean(self, repo_ctx, name):
+        fname = name.replace("-", "_") + "_good.py"
+        findings, _ = corpus_findings(repo_ctx, name, fname)
+        assert not findings, (
+            f"{name} false positives in its good fixture:\n"
+            + "\n".join(f.render() for f in findings)
+        )
+
+
+class TestFramework:
+    def test_fingerprint_survives_line_shift(self, repo_ctx):
+        fname = "cow_discipline_bad.py"
+        a, src = corpus_findings(repo_ctx, "cow-discipline", fname)
+        # same code, pushed 3 lines down: fingerprints must not move
+        shifted_src = "\n\n\n" + src
+        rel = f"bobrapet_tpu/_corpus/{fname}"
+        pf = ProjectFile(
+            path="x", rel=rel, source=shifted_src, tree=ast.parse(shifted_src)
+        )
+        b = [
+            f
+            for f in CHECKERS["cow-discipline"].run([pf], repo_ctx)
+            if f.path == rel
+        ]
+        assert {f.fingerprint for f in a} == {f.fingerprint for f in b}
+        assert {f.line for f in a} != {f.line for f in b}
+
+    def test_baseline_rejects_placeholder_justification(self, tmp_path):
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps({
+            "version": 1,
+            "suppressions": [{
+                "fingerprint": "abc123def456", "checker": "x", "path": "y",
+                "scope": "", "message": "m", "justification": "TODO",
+            }],
+        }))
+        with pytest.raises(BaselineError, match="real justification"):
+            Baseline.load(str(p))
+
+    def test_baseline_rejects_duplicates(self, tmp_path):
+        entry = {
+            "fingerprint": "abc123def456", "checker": "x", "path": "y",
+            "scope": "", "message": "m",
+            "justification": "a perfectly valid reason for keeping this",
+        }
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps({"version": 1, "suppressions": [entry, entry]}))
+        with pytest.raises(BaselineError, match="duplicate"):
+            Baseline.load(str(p))
+
+    def test_partition_new_suppressed_stale(self, repo_ctx, tmp_path):
+        findings, _ = corpus_findings(
+            repo_ctx, "cow-discipline", "cow_discipline_bad.py"
+        )
+        keep = findings[0]
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps({
+            "version": 1,
+            "suppressions": [
+                {"fingerprint": keep.fingerprint, "checker": keep.checker,
+                 "path": keep.path, "scope": keep.scope, "message": keep.message,
+                 "justification": "corpus fixture entry used by the test"},
+                {"fingerprint": "dead00000000", "checker": "x", "path": "y",
+                 "scope": "", "message": "m",
+                 "justification": "entry for code that no longer exists"},
+            ],
+        }))
+        new, suppressed, stale = Baseline.load(str(p)).partition(findings)
+        assert keep.fingerprint not in {f.fingerprint for f in new}
+        assert keep.fingerprint in {f.fingerprint for f in suppressed}
+        assert [s.fingerprint for s in stale] == ["dead00000000"]
+
+    def test_dynamic_config_families_still_parsed(self):
+        """The checker's hardcoded dynamic-family regexes must keep
+        matching keys _apply_dotted actually parses structurally."""
+        from bobrapet_tpu.config.operator import parse_config
+
+        keys = {
+            "controllers.steprun.max-concurrent-reconciles": "8",
+            "scheduling.queue.gpu.max-concurrent": "2",
+            "scheduling.queue.gpu.priority-aging": "60s",
+            "scheduling.queue.gpu.accelerator": "tpu-v5p-slice",
+            "scheduling.queue.gpu.chip-budget": "16",
+        }
+        cfg = parse_config(keys)
+        assert cfg.controllers.per_controller["steprun"] == 8
+        q = cfg.scheduling.queues["gpu"]
+        assert (q.max_concurrent, q.chip_budget) == (2, 16)
+        for key in keys:
+            assert any(f.match(key) for f in DYNAMIC_CONFIG_FAMILIES), key
+
+
+class TestSelfRun:
+    """The merged tree must be clean modulo the checked-in baseline —
+    the exact gate `make analyze` enforces in CI."""
+
+    def test_repo_clean_modulo_baseline(self, repo_ctx):
+        findings = run_checkers(repo_ctx, ALL_CHECKERS)
+        baseline = Baseline.load(os.path.join(REPO_ROOT, "bobralint-baseline.json"))
+        new, _suppressed, stale = baseline.partition(findings)
+        assert not new, "NEW findings:\n" + "\n".join(f.render() for f in new)
+        assert not stale, (
+            "stale baseline entries (prune them): "
+            + ", ".join(s.fingerprint for s in stale)
+        )
+
+    def test_every_suppression_is_justified_and_reachable(self):
+        baseline = Baseline.load(os.path.join(REPO_ROOT, "bobralint-baseline.json"))
+        assert baseline.suppressions, "baseline unexpectedly empty"
+        for s in baseline.suppressions:
+            # loader already enforces this; pin it against loader edits
+            assert len(s.justification) >= 10
+            assert s.checker in CHECKERS

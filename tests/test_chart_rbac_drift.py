@@ -1,0 +1,130 @@
+"""Chart <-> code RBAC drift (ISSUE 4 satellite).
+
+`controllers/rbac.manager_cluster_rules()` derives the ClusterRole the
+manager needs from code-level registrations: CRD groups from the schema
+registry, workload kinds from what the materializer emits / executors
+watch, the election Lease from the elector. The chart's
+`serviceaccount.yaml` is the hand-maintained mirror. Like the
+webhook-drift suite (test_chart_webhook_drift.py), this renders the
+chart template and diffs the grants both ways, so registering a new CRD
+group or teaching the executor a new workload kind without widening the
+chart (or widening the chart beyond what code uses — a least-privilege
+regression) fails here instead of shipping a manager that cannot (or
+can over-) reach the cluster.
+
+The run-scoped identity allowlist is asserted separately: the verbs the
+runner sanitizer may ever grant (`SAFE_VERBS`) must not exceed what the
+manager itself holds on the namespaced kinds it creates Role objects
+for — a run could otherwise be granted more than its creator has.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+import yaml
+
+from bobrapet_tpu.controllers.rbac import (
+    SAFE_VERBS,
+    manager_cluster_rules,
+)
+
+CHART = os.path.join(
+    os.path.dirname(__file__), "..",
+    "deploy", "chart", "bobrapet-tpu", "templates", "serviceaccount.yaml",
+)
+
+
+def render_chart() -> list[dict]:
+    """Poor-man's helm template, same approach as the webhook suite."""
+    with open(CHART) as f:
+        text = f.read()
+    text = "\n".join(
+        line for line in text.splitlines()
+        if not line.strip().startswith("{{-")
+    )
+    text = (
+        text.replace("{{ .Release.Name }}", "rel")
+        .replace("{{ .Release.Namespace }}", "ns")
+    )
+    return [d for d in yaml.safe_load_all(text) if d]
+
+
+def normalize(rules: list[dict]) -> set[tuple]:
+    """(group, resource, verb) triples — the flat grant set, immune to
+    how rules happen to be batched into list entries."""
+    out = set()
+    for rule in rules:
+        for g in rule.get("apiGroups") or [""]:
+            for r in rule.get("resources") or []:
+                for v in rule.get("verbs") or []:
+                    out.add((g, r, v))
+    return out
+
+
+@pytest.fixture(scope="module")
+def chart_docs():
+    return render_chart()
+
+
+@pytest.fixture(scope="module")
+def chart_cluster_role(chart_docs):
+    roles = [d for d in chart_docs if d["kind"] == "ClusterRole"]
+    assert len(roles) == 1, "expected exactly one manager ClusterRole"
+    return roles[0]
+
+
+class TestChartRBACDrift:
+    def test_identity_object_kinds_present(self, chart_docs):
+        kinds = {d["kind"] for d in chart_docs}
+        assert kinds == {
+            "ServiceAccount", "Role", "RoleBinding",
+            "ClusterRole", "ClusterRoleBinding",
+        }
+
+    def test_cluster_role_matches_code_derived_rules(self, chart_cluster_role):
+        chart = normalize(chart_cluster_role["rules"])
+        code = normalize(manager_cluster_rules())
+        assert chart == code, (
+            f"manager ClusterRole drifted:\n"
+            f"  chart-only (over-grant / stale): {sorted(chart - code)}\n"
+            f"  code-only (manager will get Forbidden): {sorted(code - chart)}\n"
+            f"update deploy/chart/bobrapet-tpu/templates/serviceaccount.yaml "
+            f"or controllers/rbac.manager_cluster_rules()"
+        )
+
+    def test_pods_stay_read_only(self, chart_cluster_role):
+        """Least-privilege pin: exit-code extraction reads pods; nothing
+        may ever write them through the manager identity."""
+        grants = normalize(chart_cluster_role["rules"])
+        pod_verbs = {v for (g, r, v) in grants if r == "pods"}
+        assert pod_verbs == {"get", "list", "watch"}
+
+    def test_no_wildcard_outside_crd_groups(self, chart_cluster_role):
+        crd_groups = {
+            g for rule in manager_cluster_rules()
+            for g in rule["apiGroups"]
+            if "*" in rule["resources"]
+        }
+        for rule in chart_cluster_role["rules"]:
+            if any("*" in r for r in rule.get("resources") or []):
+                assert set(rule["apiGroups"]) <= crd_groups, (
+                    f"wildcard resources outside the CRD groups: {rule}"
+                )
+
+    def test_leader_election_role_scoped_to_leases(self, chart_docs):
+        role = next(d for d in chart_docs if d["kind"] == "Role")
+        grants = normalize(role["rules"])
+        assert {r for (_, r, _) in grants} == {"leases"}
+
+    def test_runner_allowlist_within_manager_grants(self, chart_cluster_role):
+        """sanitize_rules() can never mint a run-scoped Role whose verbs
+        exceed the manager's own CRD-group grants (the objects the
+        runner touches are CRD kinds + core kinds the manager manages)."""
+        grants = normalize(chart_cluster_role["rules"])
+        manager_verbs = {v for (g, r, v) in grants if r == "*"}
+        assert SAFE_VERBS <= manager_verbs, (
+            f"runner allowlist verbs {sorted(SAFE_VERBS - manager_verbs)} "
+            f"exceed the manager's own grants"
+        )

@@ -34,6 +34,18 @@ def wait_for(cond, timeout=30.0, interval=0.02):
     return False
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _lock_order_sanitizer():
+    """Lockdep for the whole module: every repo lock created while these
+    threaded tests run is instrumented; an acquisition-order cycle
+    (potential deadlock) fails the suite at module teardown."""
+    from bobrapet_tpu.analysis.lockorder import sanitize_locks
+
+    with sanitize_locks() as monitor:
+        yield monitor
+    monitor.assert_clean()
+
+
 @pytest.fixture
 def live_rt():
     """Runtime in live mode: real clock, dispatcher thread, threaded

@@ -20,6 +20,8 @@ from __future__ import annotations
 import threading
 import time
 
+import pytest
+
 from bobrapet_tpu.config.operator import OperatorConfig, parse_config
 from bobrapet_tpu.controllers.manager import Clock, ControllerManager, ManualClock
 from bobrapet_tpu.core.store import ResourceStore
@@ -32,6 +34,16 @@ def wait_for(cond, timeout=10.0, interval=0.005):
             return True
         time.sleep(interval)
     return False
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _lock_order_sanitizer():
+    """Lockdep for the dispatcher suite (see test_concurrency.py)."""
+    from bobrapet_tpu.analysis.lockorder import sanitize_locks
+
+    with sanitize_locks() as monitor:
+        yield monitor
+    monitor.assert_clean()
 
 
 def make_manager(**per_controller) -> ControllerManager:

@@ -30,6 +30,18 @@ TRAIN_STEPS = 6
 REFERENCE_PARAMS = [float(sum(range(1, TRAIN_STEPS + 1)))] * 4
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _lock_order_sanitizer():
+    """Lockdep for the chaos suite (see test_concurrency.py): the
+    preemption storm is the highest-entropy lock interleaving in
+    tier-1, exactly where an ordering inversion would surface."""
+    from bobrapet_tpu.analysis.lockorder import sanitize_locks
+
+    with sanitize_locks() as monitor:
+        yield monitor
+    monitor.assert_clean()
+
+
 class ScriptedInjector(PreemptionInjector):
     """Deterministic plan list instead of a seeded rate."""
 

@@ -1,0 +1,205 @@
+"""Runtime lock-order sanitizer tests (ISSUE 4).
+
+The sanitizer itself must be trustworthy before the threaded suites
+lean on it: wrappers must be transparent (Condition protocol included),
+ordering edges must be recorded per allocation-site lock class,
+lockdep-style cycles must be detected WITHOUT needing an actual
+deadlock to strike, and Condition waits must not count as hold time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from bobrapet_tpu.analysis.lockorder import (
+    LockOrderViolation,
+    sanitize_locks,
+)
+
+
+class TestTransparency:
+    def test_lock_and_rlock_still_work(self):
+        with sanitize_locks():
+            lock = threading.Lock()
+            rlock = threading.RLock()
+            with lock:
+                assert lock.locked()
+            with rlock:
+                with rlock:  # re-entrant
+                    pass
+            assert lock.acquire(blocking=False)
+            lock.release()
+
+    def test_condition_wait_notify_roundtrip(self):
+        with sanitize_locks():
+            lock = threading.Lock()
+            cond = threading.Condition(lock)
+            hits = []
+
+            def waiter():
+                with cond:
+                    hits.append("waiting")
+                    cond.wait(timeout=5.0)
+                    hits.append("woke")
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            for _ in range(500):
+                if hits:
+                    break
+                time.sleep(0.005)
+            with cond:
+                cond.notify_all()
+            t.join(timeout=5.0)
+            assert hits == ["waiting", "woke"]
+
+    def test_locks_keep_working_after_session(self):
+        with sanitize_locks():
+            lock = threading.Lock()
+        with lock:  # session over: recording off, lock still functional
+            pass
+        assert not lock.locked()
+
+
+class TestOrdering:
+    def test_consistent_order_is_clean(self):
+        with sanitize_locks() as mon:
+            a = threading.Lock()
+            b = threading.Lock()
+            for _ in range(3):
+                with a:
+                    with b:
+                        pass
+        assert len(mon.edges) == 1
+        assert mon.cycles() == []
+        mon.assert_clean()
+
+    def test_inverted_order_is_a_cycle_without_deadlocking(self):
+        with sanitize_locks() as mon:
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:  # inversion: never deadlocks single-threaded,
+                    pass  # but two threads interleaving it would
+        cycles = mon.cycles()
+        assert len(cycles) == 1 and len(cycles[0]) == 2
+        with pytest.raises(LockOrderViolation, match="CYCLE"):
+            mon.assert_clean()
+
+    def test_distinct_instances_of_one_class_self_edge(self):
+        def make():
+            return threading.Lock()  # one allocation site = one class
+
+        with sanitize_locks() as mon:
+            a, b = make(), make()
+            with a:
+                with b:
+                    pass
+        assert [c for c in mon.cycles()], "self-edge over distinct instances"
+        with pytest.raises(LockOrderViolation):
+            mon.assert_clean()
+
+    def test_reentrant_rlock_is_not_a_self_edge(self):
+        with sanitize_locks() as mon:
+            r = threading.RLock()
+            with r:
+                with r:
+                    pass
+        assert mon.edges == {}
+        mon.assert_clean()
+
+    def test_out_of_order_release_is_legal(self):
+        with sanitize_locks() as mon:
+            a = threading.Lock()
+            b = threading.Lock()
+            a.acquire()
+            b.acquire()
+            a.release()  # hand-over-hand: release a before b
+            b.release()
+        assert mon.cycles() == []
+        mon.assert_clean()
+
+
+class TestHoldBudget:
+    def test_overlong_hold_is_a_warning_not_a_failure(self, capsys):
+        with sanitize_locks(hold_budget=0.01) as mon:
+            lock = threading.Lock()
+            with lock:
+                time.sleep(0.05)
+        assert mon.hold_violations
+        mon.assert_clean(strict_hold=False)  # warns, does not raise
+        assert "HOLD" in capsys.readouterr().err
+
+    def test_strict_mode_fails_on_hold_violation(self):
+        with sanitize_locks(hold_budget=0.01) as mon:
+            lock = threading.Lock()
+            with lock:
+                time.sleep(0.05)
+        with pytest.raises(LockOrderViolation, match="HOLD"):
+            mon.assert_clean(strict_hold=True)
+
+    def test_recursive_hold_survives_condition_wait(self):
+        """A doubly-acquired RLock that waits on its Condition must come
+        back with recursion depth 2 in the monitor: after wake, the
+        FIRST release still leaves the lock held, so ordering edges to
+        later acquisitions must still be recorded."""
+        with sanitize_locks() as mon:
+            r = threading.RLock()
+            cond = threading.Condition(r)
+            b = threading.Lock()
+            with r:  # depth 1
+                with cond:  # depth 2 (same lock)
+                    cond.wait(timeout=0.01)
+                # back to depth 1 — the lock is STILL held here
+                with b:
+                    pass
+        r_label = next(lbl for lbl in mon.max_hold if "test_lockorder" in lbl)
+        assert any(
+            a == r_label for (a, bl) in mon.edges if bl != r_label
+        ), f"missing edge from still-held RLock: {mon.edges}"
+
+    def test_condition_wait_does_not_count_as_hold(self):
+        with sanitize_locks(hold_budget=0.02) as mon:
+            lock = threading.RLock()
+            cond = threading.Condition(lock)
+            with cond:
+                cond.wait(timeout=0.1)  # releases the lock while waiting
+        assert mon.hold_violations == []
+        mon.assert_clean(strict_hold=True)
+
+
+class TestCrossThread:
+    def test_edges_merge_across_threads(self):
+        """Each thread contributes its own acquisition order; the graph
+        (and the cycle) only exists in the union — exactly the deadlock
+        that never fires in either thread alone."""
+        with sanitize_locks() as mon:
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def ab():
+                with a:
+                    with b:
+                        pass
+
+            def ba():
+                with b:
+                    with a:
+                        pass
+
+            # STRICTLY sequential on purpose: overlapping them could
+            # strike the very deadlock under discussion. The sanitizer
+            # must see the hazard from the per-thread orders alone.
+            t1 = threading.Thread(target=ab)
+            t1.start()
+            t1.join(timeout=10.0)
+            t2 = threading.Thread(target=ba)
+            t2.start()
+            t2.join(timeout=10.0)
+        assert mon.cycles(), "cross-thread inversion must form a cycle"
