@@ -697,6 +697,90 @@ def config10_payload_hydrate() -> dict:
     }
 
 
+#: PR-5 seed number for the placement churn config, measured on this box
+#: against the pre-indexed brute-force allocator (per-cell set probes,
+#: unmemoized _fit_shape, no batched gang API) running the identical op
+#: mix. vs_baseline below is computed against THIS, so future
+#: BENCH_r*.json capture the trajectory.
+PLACEMENT_SEED_GPS = 178.2
+
+
+def config11_placement_churn() -> dict:
+    """Sub-mesh placement under preemption-style churn: random
+    allocate/release on a 16x16x16 pool with rolling cordon syncs and
+    periodic 4-branch gang fan-outs — the fleet-manager re-placement
+    shape that made the seed's O(origins x cells) scan the control-plane
+    hot path. Runs in a CHILD with the standard timeout guard (an
+    allocator bug must not wedge the whole bench)."""
+    import random
+
+    from bobrapet_tpu.parallel.placement import (
+        NoCapacity,
+        SlicePool,
+        parse_topology,
+    )
+
+    topology = os.environ.get("BENCH_PLACEMENT_TOPOLOGY", "16x16x16")
+    n_ops = int(os.environ.get("BENCH_PLACEMENT_OPS", "3000"))
+    rng = random.Random(0xB0B8A)
+    pool = SlicePool("bench", topology, chips_per_host=4)
+    dims = parse_topology(topology)
+    all_cells = [()]
+    for d in dims:
+        all_cells = [c + (i,) for c in all_cells for i in range(d)]
+    chip_choices = [1, 2, 4, 8, 8, 16, 16, 32, 64, 128]
+    live = []
+    granted = attempts = nocap = 0
+    t0 = time.perf_counter()
+    for i in range(n_ops):
+        if i % 37 == 0:
+            # cordon churn: the fleet quarantines / decays random cells
+            pool.set_cordoned(rng.sample(all_cells, rng.randrange(0, 48)))
+        if i % 11 == 0:
+            # parallel fan-out: a 4-branch gang of equal sibling blocks
+            # (2 per axis where the pool has room; 16x16x16 -> 2x2x2)
+            gang_shape = "x".join("2" if d >= 2 else "1" for d in dims)
+            reqs = [(gang_shape, None)] * 4
+            attempts += 4
+            try:
+                gs = pool.allocate_many(reqs)
+                live.extend(gs)
+                granted += len(gs)
+            except NoCapacity:
+                nocap += 4
+        elif rng.random() < 0.55 or not live:
+            attempts += 1
+            try:
+                g = pool.allocate(chips=rng.choice(chip_choices))
+                live.append(g)
+                granted += 1
+            except NoCapacity:
+                nocap += 1
+        else:
+            g = live.pop(rng.randrange(len(live)))
+            pool.release(g.slice_id)
+    wall = time.perf_counter() - t0
+    gps = granted / wall
+    return {
+        "metric": "placement_grants_per_sec",
+        "value": round(gps, 1),
+        "unit": "grants/s",
+        "vs_baseline": round(gps / PLACEMENT_SEED_GPS, 2),
+        "config": "placement-churn",
+        "topology": topology,
+        "ops": n_ops,
+        "granted": granted,
+        "no_capacity": nocap,
+        "fragmentation": round(pool.fragmentation(), 3),
+        "wallclock_s": round(wall, 3),
+    }
+
+
+def run_placement_child() -> None:
+    """Child entrypoint: pure control-plane (no accelerator, no jax)."""
+    _emit(config11_placement_churn())
+
+
 def run_sweep(state: dict) -> None:
     # the parent NEVER touches the accelerator — but the env var alone
     # is not enough: a site hook can rewrite platform priority
@@ -1249,6 +1333,9 @@ def main() -> None:
     if os.environ.get("BENCH_CHILD") == "micro":
         run_micro_child()
         return
+    if os.environ.get("BENCH_CHILD") == "placement":
+        run_placement_child()
+        return
 
     state: dict = {"stage": "start"}
     _arm_watchdog(state)
@@ -1267,6 +1354,14 @@ def main() -> None:
 
     if not os.environ.get("BENCH_SKIP_SWEEP"):
         run_sweep(state)
+        # placement churn runs as a CHILD under the standard timeout
+        # guard: a wedged allocator (the one config with a brand-new
+        # search core) must not take the rest of the bench down with it
+        state["stage"] = "placement-churn"
+        _spawn_passthrough(
+            "placement", None,
+            timeout=min(240.0, max(60.0, _remaining() - 60.0)), cpu=True,
+        )
 
     # give the FIRST probe a chance to conclude before deciding: a
     # short sweep must not misread a merely-cold tunnel. first_done

@@ -81,8 +81,14 @@ MAX_OUTPUT_BYTES = 1 << 20  # final output template cap (reference: 1MiB)
 REASON_CONCURRENCY_QUEUED = "ConcurrencyQueued"
 REASON_SCHEDULING_QUEUED = "SchedulingQueued"
 REASON_PRIORITY_QUEUED = "PriorityQueued"
+REASON_PLACEMENT_QUEUED = "PlacementQueued"
 QUEUED_REASONS = frozenset(
-    {REASON_CONCURRENCY_QUEUED, REASON_SCHEDULING_QUEUED, REASON_PRIORITY_QUEUED}
+    {
+        REASON_CONCURRENCY_QUEUED,
+        REASON_SCHEDULING_QUEUED,
+        REASON_PRIORITY_QUEUED,
+        REASON_PLACEMENT_QUEUED,
+    }
 )
 
 
@@ -599,6 +605,7 @@ class DAGEngine:
         def touch(name: str) -> None:
             scope["steps"][name] = _scope_entry(states[name])
 
+        placement_parks = 0
         for step in steps:
             if step.name in states and not _is_queued_state(states[step.name]):
                 continue
@@ -750,9 +757,26 @@ class DAGEngine:
                 try:
                     state = self.executor.execute(run, story, step, scope, queue=queue)
                 except LaunchBlocked as e:
-                    # gang/slice capacity: stay Pending, retry soon
+                    # gang/slice capacity: park THIS step Pending and keep
+                    # launching siblings — the allocator's fast-negative
+                    # NoCapacity makes the re-probe O(1), and a full pool
+                    # must not stall ready steps that need no TPU (or a
+                    # different pool). The seed aborted the whole pass here.
                     run.status["placementWaiting"] = str(e)
-                    break
+                    placement_parks += 1
+                    prior = states.get(step.name)
+                    parked_at = (
+                        prior.get("startedAt")
+                        if prior and _is_queued_state(prior)
+                        else None
+                    )
+                    states[step.name] = StepState(
+                        phase=Phase.PENDING, reason=REASON_PLACEMENT_QUEUED,
+                        message=str(e),
+                        started_at=parked_at or self.clock.now(),
+                    ).to_dict()
+                    touch(step.name)
+                    continue
                 except Exception as e:  # noqa: BLE001 - launch failure fails the step
                     state = StepState(
                         phase=Phase.FAILED, reason="LaunchFailed", message=str(e),
@@ -763,7 +787,6 @@ class DAGEngine:
                     # the committed StepRun (if any) is in the index now;
                     # drop the reservation either way
                     self._unreserve(queue)
-            run.status.pop("placementWaiting", None)
             states[step.name] = state.to_dict()
             touch(step.name)
             self._pass.launched += 1
@@ -771,6 +794,11 @@ class DAGEngine:
             progressed = True
             if run.status.get(STOP_KEY):
                 break  # a stop primitive halts further launches immediately
+        if not placement_parks:
+            # no step parked on capacity THIS pass: clear the 1s
+            # placement requeue (clearing per-launch instead would let a
+            # later sibling's success erase an earlier park's wakeup)
+            run.status.pop("placementWaiting", None)
         return progressed
 
     def _condition_with_policy(

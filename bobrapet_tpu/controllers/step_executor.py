@@ -140,13 +140,17 @@ class StepExecutor:
         queue: Optional[str],
         name_override: Optional[str] = None,
         parent_step: Optional[str] = None,
+        preplaced_grant: Optional[dict[str, Any]] = None,
+        preplaced: bool = False,
     ) -> StepState:
         ns = run.meta.namespace
         name = name_override or steprun_name(run.meta.name, step.name)
 
-        # TPU slice placement stage (gang semantics: all-or-nothing)
-        slice_grant = None
-        if step.tpu is not None:
+        # TPU slice placement stage (gang semantics: all-or-nothing).
+        # ``preplaced`` means the parent fan-out already ran the batched
+        # gang pass and this branch's grant (possibly None) is final.
+        slice_grant = preplaced_grant
+        if not preplaced and step.tpu is not None:
             try:
                 grant = self.placer.place(step.tpu, queue=queue)
             except NoCapacity as e:
@@ -334,9 +338,7 @@ class StepExecutor:
         (reference: step_executor.go:741-747, dag.go:1112-1200)"""
         w = step.with_ or {}
         branches = [Step.from_dict(b) for b in (w.get("steps") or [])]
-        children = []
         for branch in branches:
-            child_name = branch_steprun_name(run.meta.name, step.name, branch.name)
             if branch.type is not None:
                 # primitive branches run as instant/timer states inside the
                 # parent's timer store, keyed parent.branch
@@ -344,12 +346,46 @@ class StepExecutor:
                     f"parallel branch {branch.name!r}: primitive branches are "
                     "not supported; use engram steps"
                 )
-            self._execute_engram(
-                run, story, branch, scope, queue,
-                name_override=child_name, parent_step=step.name,
+        # batched gang placement: every TPU branch gets its slice in ONE
+        # pool pass (siblings packed ICI-adjacent when a super-block
+        # fits), and capacity shortfall surfaces BEFORE any branch
+        # StepRun exists — the per-branch path could strand a partial
+        # gang when a later sibling hit NoCapacity
+        try:
+            grants = self.placer.place_group(
+                [(b.name, b.tpu) for b in branches], queue=queue
             )
-            children.append({"name": branch.name, "stepRun": child_name,
-                             "allowFailure": bool(branch.allow_failure)})
+        except NoCapacity as e:
+            raise LaunchBlocked(str(e)) from None
+        children = []
+        try:
+            for branch in branches:
+                child_name = branch_steprun_name(
+                    run.meta.name, step.name, branch.name
+                )
+                grant = grants.pop(branch.name, None)
+                try:
+                    self._execute_engram(
+                        run, story, branch, scope, queue,
+                        name_override=child_name, parent_step=step.name,
+                        preplaced_grant=(
+                            grant.to_dict() if grant is not None else None
+                        ),
+                        preplaced=True,
+                    )
+                except BaseException:
+                    if grant is not None:
+                        self.placer.release(grant.to_dict())
+                    raise
+                children.append({"name": branch.name, "stepRun": child_name,
+                                 "allowFailure": bool(branch.allow_failure)})
+        except BaseException:
+            # a failed branch launch must hand the still-unconsumed
+            # sibling grants back or the gang leaks its blocks
+            for grant in grants.values():
+                if grant is not None:
+                    self.placer.release(grant.to_dict())
+            raise
         run.status.setdefault(TIMERS_KEY, {})[step.name] = {
             "kind": "parallel",
             "children": children,

@@ -634,11 +634,17 @@ class StepRunController:
         # either way (fail fast — never hold a reclaimed slice)
         new_grant = None
         awaiting = False
+        awaiting_hint = ""
         if grant:
             if self.fleet is not None:
                 self.fleet.begin_recovery(namespace, name)
                 new_grant = self.fleet.replace_grant(grant)
                 awaiting = new_grant is None
+                if awaiting:
+                    # what the pool could still place — the figure the
+                    # operator needs to judge whether the park will clear
+                    # on quarantine decay or needs a capacity fix
+                    awaiting_hint = self.fleet.capacity_hint(grant)
             if new_grant is not None and not self._install_replacement_grant(
                 namespace, name, new_grant
             ):
@@ -683,7 +689,9 @@ class StepRunController:
                 else conditions.Reason.PREEMPTION_REDRIVE,
                 f"preemption {preemptions + 1}: "
                 + (f"resuming from checkpoint step {resume_step}"
-                   if resume_step is not None else "restarting from step zero"),
+                   if resume_step is not None else "restarting from step zero")
+                + (f"; no healthy block fits ({awaiting_hint})"
+                   if awaiting_hint else ""),
                 now=self.clock.now(),
             )
 

@@ -127,11 +127,29 @@ class FleetManager:
         """Release a preempted gang's grant and allocate an equal block
         on healthy cells. None when no cordon-free block fits right now
         (caller parks the step; quarantine decay frees capacity)."""
-        pool = self.placer.pool(grant.get("pool", ""))
+        out = self.replace_grants([grant])
+        return out[0] if out is not None else None
+
+    def replace_grants(
+        self, grants: list[dict[str, Any]]
+    ) -> Optional[list[dict[str, Any]]]:
+        """Batched gang re-placement: release every dead sibling grant,
+        then re-place all of them in ONE pool pass (all-or-nothing, via
+        the allocator's batched gang API — siblings of one fan-out land
+        ICI-adjacent again when a super-block fits). The dead grants are
+        released even when nothing fits (fail fast: never hold a
+        reclaimed slice); None means the callers park and retry."""
+        if not grants:
+            return []
+        pools = {g.get("pool", "") for g in grants}
+        if len(pools) != 1:
+            raise ValueError(f"sibling grants span pools {sorted(pools)}")
+        pool = self.placer.pool(pools.pop())
         if pool is None:
             return None
-        pool.release(grant.get("sliceId", ""))
-        return self._allocate_like(pool, grant)
+        for g in grants:
+            pool.release(g.get("sliceId", ""))
+        return self._allocate_like(pool, grants)
 
     def place_pending(self, grant: dict[str, Any]) -> Optional[dict[str, Any]]:
         """Retry a deferred replacement (the old grant is already
@@ -139,25 +157,46 @@ class FleetManager:
         pool = self.placer.pool(grant.get("pool", ""))
         if pool is None:
             return None
-        return self._allocate_like(pool, grant)
+        out = self._allocate_like(pool, [grant])
+        return out[0] if out is not None else None
 
     def _allocate_like(
-        self, pool: SlicePool, grant: dict[str, Any]
-    ) -> Optional[dict[str, Any]]:
+        self, pool: SlicePool, grants: list[dict[str, Any]]
+    ) -> Optional[list[dict[str, Any]]]:
         pool.set_cordoned(self.registry.quarantined_cells(pool.name))
         try:
-            new = pool.allocate(want_topology=grant.get("topology"))
+            # op="replace": the latency histogram sample for this span
+            # lands in the replace series only (not the fan-out "gang"
+            # series), observed once inside allocate_many
+            news = pool.allocate_many(
+                [(g.get("topology"), None) for g in grants], op="replace"
+            )
         except (NoCapacity, PlacementError):
             return None
-        if grant.get("hosts"):
-            new.hosts = int(grant["hosts"])
-        if grant.get("meshAxes"):
-            new.mesh_axes = dict(grant["meshAxes"])
-        if grant.get("accelerator") and not new.accelerator:
-            new.accelerator = grant["accelerator"]
-        # pool.allocate already counted this placement under "granted" —
-        # a second outcome label would double-count the decision
-        return new.to_dict()
+        for grant, new in zip(grants, news):
+            if grant.get("hosts"):
+                new.hosts = int(grant["hosts"])
+            if grant.get("meshAxes"):
+                new.mesh_axes = dict(grant["meshAxes"])
+            if grant.get("accelerator") and not new.accelerator:
+                new.accelerator = grant["accelerator"]
+        # pool.allocate_many already counted these placements under
+        # "granted" — a second outcome label would double-count them
+        return [new.to_dict() for new in news]
+
+    def capacity_hint(self, grant: dict[str, Any]) -> str:
+        """One truthful line for awaitingSlice park logs: what the
+        grant's pool could still place right now (schedulable excludes
+        cordons; the largest-block figure is exact, served from the
+        allocator's cache between capacity changes)."""
+        pool = self.placer.pool(grant.get("pool", ""))
+        if pool is None:
+            return ""
+        return (
+            f"pool {pool.name}: {pool.schedulable_chips()} schedulable "
+            f"chips, {pool.cordoned_chips()} cordoned, largest free "
+            f"block {pool.largest_free_block()} chips"
+        )
 
     # -- recovery latency --------------------------------------------------
 

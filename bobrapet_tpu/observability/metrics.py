@@ -323,6 +323,28 @@ class _ControlPlaneMetrics:
         self.slice_placements = c(
             "bobrapet_slice_placements_total", "Sub-mesh placement decisions", ["outcome"]
         )
+        self.slice_placement_seconds = h(
+            "bobrapet_slice_placement_seconds",
+            "Sub-mesh placement latency by operation (place=single grant, "
+            "gang=batched fan-out, replace=fleet re-placement)",
+            ["op"],
+            buckets=(0.00001, 0.00005, 0.0001, 0.0005, 0.001, 0.005,
+                     0.01, 0.05, 0.1, 0.5),
+        )
+        self.slice_fragmentation = g(
+            "bobrapet_slice_fragmentation",
+            "Pool fragmentation: largest placeable free block / schedulable "
+            "chips (1.0 = all free capacity is one contiguous block; "
+            "refreshed at placement pressure points)",
+            ["pool"],
+        )
+        self.slice_scan_probes = c(
+            "bobrapet_slice_scan_probes_total",
+            "Occupancy-word probes during free-block search (one word "
+            "covers a full last-axis row of cells; the seed allocator "
+            "probed every cell of every candidate block)",
+            ["pool"],
+        )
         # Fleet health & preemption recovery (bobrapet_tpu/fleet; TPU-native
         # addition — the reference retries whole steps and knows nothing of
         # slice reclamation)

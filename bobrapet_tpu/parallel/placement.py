@@ -11,6 +11,28 @@ The model: a :class:`SlicePool` is a rectangular chip grid (topology
 contiguous sub-blocks — contiguity on a torus keeps every hop of a ring
 collective on neighboring ICI links. Release returns the block.
 
+Allocation is **indexed**, not scanned. Occupancy packs into one
+bitboard integer — one ``Z+1``-bit field per last-axis row of cells
+(the extra guard bit stops free-runs bleeding across row boundaries) —
+so a run of free cells, a windowed AND along a leading axis, and a
+whole-grid candidate-origin set each cost a few shift-AND operations on
+the packed word instead of per-cell set probes. On top of the index:
+
+- ``_fit_shape`` is memoized by ``(dims, chips)`` — the cartesian
+  shape enumeration runs once per distinct request size, not per call;
+- failed shapes are remembered until capacity grows again (release or
+  cordon change), so ``awaitingSlice`` parks re-probing a full pool
+  fast-negative in O(1) instead of rescanning the grid;
+- a cached largest-free-block figure (recomputed lazily, only when
+  capacity changed since last computed) bounds requests and feeds the
+  fragmentation gauge and truthful ``NoCapacity`` messages;
+- grants prefer **corner-contact** origins (faces flush against pool
+  walls or existing grants) over first-fit, which keeps the free space
+  in fewer, larger blocks under churn;
+- :meth:`SlicePool.allocate_many` places a whole gang of sibling
+  blocks in one lock pass, all-or-nothing, preferring one contiguous
+  super-block so `parallel` branches land ICI-adjacent.
+
 Locally (one chip / CPU) everything lands on the "local" pool; on GKE
 the same grant becomes `google.com/tpu` limits + topology selectors.
 """
@@ -20,7 +42,8 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import threading
-from typing import Any, Callable, Iterable, Optional
+import time
+from typing import Any, Callable, Iterable, Optional, Sequence
 
 from ..observability.metrics import metrics
 
@@ -39,6 +62,13 @@ def chip_count(topology: str) -> int:
     n = 1
     for d in parse_topology(topology):
         n *= d
+    return n
+
+
+def _volume(shape: tuple[int, ...]) -> int:
+    n = 1
+    for s in shape:
+        n *= s
     return n
 
 
@@ -77,11 +107,37 @@ class NoCapacity(PlacementError):
     """No contiguous block currently free (caller should queue, not fail)."""
 
 
-class SlicePool:
-    """One physical slice topology with block allocation.
+#: memoized smallest-fitting-shape results, shared across pools with the
+#: same grid (keyed (dims, chips)); the cartesian enumeration behind one
+#: entry was the seed allocator's whole per-call cost
+_FIT_SHAPE_CACHE: dict[tuple[tuple[int, ...], int], tuple[int, ...]] = {}
+_FIT_CACHE_MAX = 8192
 
-    Occupancy is tracked per chip cell; grants must be axis-aligned
-    contiguous blocks (ICI contiguity).
+#: best-fit scoring budget: candidate origins examined before settling
+#: for the best corner-contact score seen so far (keeps allocate latency
+#: bounded on near-empty grids where almost every origin is valid)
+_BEST_FIT_CANDIDATES = 24
+
+
+def _run_starts(bits: int, length: int, step: int = 1) -> int:
+    """Positions where ``length`` consecutive set entries begin, for
+    entries ``step`` bit-positions apart (doubling fold: O(log length)
+    shift-ANDs on the packed word)."""
+    runs = bits
+    have = 1
+    while runs and have < length:
+        d = min(have, length - have)
+        runs &= runs >> (d * step)
+        have += d
+    return runs
+
+
+class SlicePool:
+    """One physical slice topology with indexed block allocation.
+
+    Occupancy is one packed bitboard (a ``Z+1``-bit field per last-axis
+    row of cells); grants must be axis-aligned contiguous blocks (ICI
+    contiguity).
     """
 
     def __init__(
@@ -98,13 +154,49 @@ class SlicePool:
         self.chips_per_host = max(1, chips_per_host)
         self.accelerator = accelerator
         self.host_addresses = host_addresses or []
-        self._occupied: set[tuple[int, ...]] = set()
+        self._z = self.dims[-1]
+        #: bits per row field: Z data bits + 1 guard bit (always clear)
+        #: so free-run folds can never bleed across row boundaries
+        self._rowbits = self._z + 1
+        self._full_row = (1 << self._z) - 1
+        self._lead_dims = self.dims[:-1]
+        strides: list[int] = []
+        acc = 1
+        for d in reversed(self._lead_dims):
+            strides.append(acc)
+            acc *= d
+        #: per-leading-axis stride, in rows
+        self._lead_strides = tuple(reversed(strides))
+        self._n_rows = acc
+        row = self._full_row
+        board = 0
+        for r in range(self._n_rows):
+            board |= row << (r * self._rowbits)
+        #: every data bit set, every guard bit clear
+        self._full_board = board
+        self._occ_bits = 0
+        self._cord_bits = 0
+        #: occupied | cordoned — the board every block probe tests against
+        self._blk_bits = 0
+        self._occupied_count = 0
+        self._schedulable = self.total_chips
         #: cells cordoned by fleet health (quarantined hardware): excluded
         #: from new grants but still released normally by in-flight ones
         self._cordoned: set[tuple[int, ...]] = set()
         self._grants: dict[str, tuple[tuple[int, ...], tuple[int, ...]]] = {}
         self._lock = threading.Lock()
         self._counter = 0
+        #: shapes proven blockless since the last capacity-increasing
+        #: event — repeat requests short-circuit to NoCapacity without a
+        #: scan (sound because committed grants only shrink free space)
+        self._failed_shapes: set[tuple[int, ...]] = set()
+        #: largest placeable block (chips); exact when clean, a stale
+        #: upper bound when dirty (capacity only shrank since computed)
+        self._largest_free = self.total_chips
+        self._largest_dirty = False
+        #: origin-validity masks per (leading axis, window): full row
+        #: fields where coord_axis <= dim - window (built lazily)
+        self._vmasks: dict[tuple[int, int], int] = {}
 
     @property
     def total_chips(self) -> int:
@@ -115,17 +207,34 @@ class SlicePool:
 
     def free_chips(self) -> int:
         with self._lock:
-            return self.total_chips - len(self._occupied)
+            return self.total_chips - self._occupied_count
 
     # -- cordons (fleet health) --------------------------------------------
 
     def set_cordoned(self, cells: Iterable[tuple[int, ...]]) -> None:
         """Replace the cordon set (cells the health registry currently
         quarantines). Idempotent full-sync: decayed quarantines drop out
-        by simply not being in the next sync."""
+        by simply not being in the next sync. An unchanged sync (the
+        placer re-syncs before every grant) costs one set compare and
+        invalidates nothing."""
         cordoned = {tuple(c) for c in cells}
         with self._lock:
+            if cordoned == self._cordoned:
+                return
             self._cordoned = cordoned
+            ndims = len(self.dims)
+            bits = 0
+            for cell in cordoned:
+                if len(cell) == ndims and all(
+                    0 <= c < d for c, d in zip(cell, self.dims)
+                ):
+                    bits |= 1 << (
+                        self._row_index(cell) * self._rowbits + cell[-1]
+                    )
+            self._cord_bits = bits
+            self._blk_bits = self._occ_bits | bits
+            self._schedulable = self.total_chips - self._blk_bits.bit_count()
+            self._capacity_changed_locked()
 
     def cordoned_chips(self) -> int:
         with self._lock:
@@ -135,17 +244,120 @@ class SlicePool:
         """Chips neither granted nor cordoned (an upper bound on what a
         new grant could cover; contiguity may admit less)."""
         with self._lock:
-            return self.total_chips - len(self._occupied | self._cordoned)
+            return self._schedulable
+
+    def largest_free_block(self) -> int:
+        """Chips in the largest axis-aligned block a grant could take
+        right now (exact; recomputed only when capacity changed since
+        the last figure)."""
+        with self._lock:
+            return self._largest_free_locked()
+
+    def fragmentation(self) -> float:
+        """largest free block / schedulable chips — 1.0 means all free
+        capacity is one placeable block, lower means churn has shredded
+        it. Refreshes the pool's fragmentation gauge as a side effect."""
+        with self._lock:
+            self._largest_free_locked()
+            return self._fragmentation_value_locked()
 
     # -- allocation --------------------------------------------------------
 
-    def allocate(self, want_topology: Optional[str] = None, chips: Optional[int] = None) -> SliceGrant:
+    def allocate(
+        self, want_topology: Optional[str] = None, chips: Optional[int] = None
+    ) -> SliceGrant:
         """Grant an ICI-contiguous sub-block.
 
         ``want_topology`` requests an exact block shape; ``chips`` asks
         for any contiguous block of >= that many chips (smallest fitting
         rectangle is chosen).
         """
+        t0 = time.perf_counter()
+        shape = self._resolve_shape(want_topology, chips)
+        with self._lock:
+            origin = self._acquire_block_locked(shape)
+            self._counter += 1
+            slice_id = f"{self.name}-s{self._counter}"
+            self._grants[slice_id] = (origin, shape)
+        grant = self._grant_for(slice_id, origin, shape)
+        metrics.slice_placements.inc("granted")
+        metrics.gang_chips_in_use.add(_volume(shape))
+        metrics.slice_placement_seconds.observe(time.perf_counter() - t0, "place")
+        return grant
+
+    def allocate_many(
+        self,
+        requests: Sequence[tuple[Optional[str], Optional[int]]],
+        op: str = "gang",
+    ) -> list[SliceGrant]:
+        """Place a gang of sibling blocks in ONE lock pass, all-or-nothing.
+
+        ``requests`` is a sequence of ``(want_topology, chips)`` pairs —
+        one per gang member. Either every member gets a grant or
+        :class:`NoCapacity` is raised and the pool is untouched (gang
+        semantics: never launch a partial fan-out). ``op`` labels the
+        placement-latency histogram sample (fleet re-placement passes
+        "replace" so each span lands in exactly one series).
+
+        Identical sibling shapes are first tried as one contiguous
+        **super-block** (siblings stacked along one axis) so the whole
+        gang shares ICI adjacency — branch collectives and slice-local
+        SSD payload reuse stay on neighboring links. When no super-block
+        fits, members are placed individually (still atomically).
+        """
+        t0 = time.perf_counter()
+        shapes = [self._resolve_shape(t, c) for t, c in requests]
+        if not shapes:
+            return []
+        with self._lock:
+            placed = self._acquire_gang_locked(shapes)
+            grants: list[tuple[str, tuple[int, ...], tuple[int, ...]]] = []
+            for origin, shape in placed:
+                self._counter += 1
+                slice_id = f"{self.name}-s{self._counter}"
+                self._grants[slice_id] = (origin, shape)
+                grants.append((slice_id, origin, shape))
+        out = [self._grant_for(sid, o, s) for sid, o, s in grants]
+        for _sid, _o, s in grants:
+            metrics.slice_placements.inc("granted")
+            metrics.gang_chips_in_use.add(_volume(s))
+        metrics.slice_placement_seconds.observe(time.perf_counter() - t0, op)
+        return out
+
+    def release(self, slice_id: str) -> None:
+        with self._lock:
+            grant = self._grants.pop(slice_id, None)
+            if grant is None:
+                return
+            origin, shape = grant
+            self._uncommit_block_locked(origin, shape)
+            n = _volume(shape)
+        metrics.gang_chips_in_use.add(-n)
+
+    # -- internals ---------------------------------------------------------
+
+    def _grant_for(
+        self, slice_id: str, origin: tuple[int, ...], shape: tuple[int, ...]
+    ) -> SliceGrant:
+        n_chips = _volume(shape)
+        # ceil-div: 6 chips at 4/host is 2 hosts, not 1 — flooring would
+        # under-provision the gang Job's completions
+        hosts = max(1, -(-n_chips // self.chips_per_host))
+        coord = self.host_addresses[0] if self.host_addresses else None
+        return SliceGrant(
+            slice_id=slice_id,
+            pool=self.name,
+            topology="x".join(str(s) for s in shape),
+            hosts=hosts,
+            origin=origin,
+            mesh_axes={},
+            coordinator_address=coord,
+            accelerator=self.accelerator,
+        )
+
+    def _resolve_shape(
+        self, want_topology: Optional[str], chips: Optional[int]
+    ) -> tuple[int, ...]:
         if want_topology:
             shape = parse_topology(want_topology)
         elif chips:
@@ -160,75 +372,362 @@ class SlicePool:
             raise PlacementError(
                 f"requested block {shape} exceeds pool {self.name} topology {self.dims}"
             )
-        with self._lock:
-            origin = self._find_block(shape)
-            if origin is None:
-                metrics.slice_placements.inc("no-capacity")
-                raise NoCapacity(
-                    f"pool {self.name}: no free {shape} block "
-                    f"({self.total_chips - len(self._occupied)} chips free, "
-                    f"{len(self._cordoned)} cordoned)"
-                )
-            for cell in _cells(origin, shape):
-                self._occupied.add(cell)
-            self._counter += 1
-            slice_id = f"{self.name}-s{self._counter}"
-            self._grants[slice_id] = (origin, shape)
-        n_chips = 1
-        for s in shape:
-            n_chips *= s
-        metrics.slice_placements.inc("granted")
-        metrics.gang_chips_in_use.add(n_chips)
-        hosts = max(1, n_chips // self.chips_per_host)
-        coord = self.host_addresses[0] if self.host_addresses else None
-        return SliceGrant(
-            slice_id=slice_id,
-            pool=self.name,
-            topology="x".join(str(s) for s in shape),
-            hosts=hosts,
-            origin=origin,
-            mesh_axes={},
-            coordinator_address=coord,
-            accelerator=self.accelerator,
-        )
-
-    def release(self, slice_id: str) -> None:
-        with self._lock:
-            grant = self._grants.pop(slice_id, None)
-            if grant is None:
-                return
-            origin, shape = grant
-            n = 0
-            for cell in _cells(origin, shape):
-                self._occupied.discard(cell)
-                n += 1
-        metrics.gang_chips_in_use.add(-n)
-
-    # -- internals ---------------------------------------------------------
+        return shape
 
     def _fit_shape(self, chips: int) -> tuple[int, ...]:
         """Smallest axis-aligned block shape with >= chips cells that fits
-        the pool dims, preferring balanced (low-diameter) shapes."""
+        the pool dims, preferring balanced (low-diameter) shapes.
+        Memoized by (dims, chips) — identical semantics to the seed's
+        full cartesian enumeration, paid once per distinct request."""
+        key = (self.dims, chips)
+        hit = _FIT_SHAPE_CACHE.get(key)
+        if hit is not None:
+            return hit
         best: Optional[tuple[int, ...]] = None
         best_key: Optional[tuple[int, int]] = None
         ranges = [range(1, d + 1) for d in self.dims]
         for shape in itertools.product(*ranges):
-            n = 1
-            for s in shape:
-                n *= s
+            n = _volume(shape)
             if n < chips:
                 continue
-            key = (n, max(shape))  # fewest chips, then lowest diameter
-            if best_key is None or key < best_key:
-                best, best_key = shape, key
+            key2 = (n, max(shape))  # fewest chips, then lowest diameter
+            if best_key is None or key2 < best_key:
+                best, best_key = shape, key2
         if best is None:
             raise PlacementError(f"pool {self.name} cannot fit {chips} chips")
+        if len(_FIT_SHAPE_CACHE) >= _FIT_CACHE_MAX:
+            _FIT_SHAPE_CACHE.clear()
+        _FIT_SHAPE_CACHE[key] = best
         return best
 
-    def _find_block(self, shape: tuple[int, ...]) -> Optional[tuple[int, ...]]:
+    def _row_index(self, cell: tuple[int, ...]) -> int:
+        idx = 0
+        for c, s in zip(cell, self._lead_strides):
+            idx += c * s
+        return idx
+
+    def _vmask(self, axis: int, window: int) -> int:
+        """Full row fields at leading origins whose ``axis`` coordinate
+        leaves room for ``window`` — masks off the wrap garbage a
+        windowed fold shifts in at the high edge."""
+        key = (axis, window)
+        mask = self._vmasks.get(key)
+        if mask is None:
+            limit = self._lead_dims[axis] - window
+            row = self._full_row
+            mask = 0
+            for lead in itertools.product(
+                *[range(d) for d in self._lead_dims]
+            ):
+                if lead[axis] <= limit:
+                    mask |= row << (self._row_index(lead) * self._rowbits)
+            self._vmasks[key] = mask
+        return mask
+
+    def _block_mask(
+        self, origin: tuple[int, ...], shape: tuple[int, ...]
+    ) -> int:
+        """Packed mask of every cell the block covers (OR-doubling per
+        axis: O(log extent) shift-ORs)."""
+        # _row_index zips against the leading strides, so passing the
+        # full origin simply ignores the trailing z coordinate
+        mask = (((1 << shape[-1]) - 1) << origin[-1]) << (
+            self._row_index(origin) * self._rowbits
+        )
+        for axis, extent in enumerate(shape[:-1]):
+            step = self._lead_strides[axis] * self._rowbits
+            have = 1
+            while have < extent:
+                d = min(have, extent - have)
+                mask |= mask << (d * step)
+                have += d
+        return mask
+
+    def _capacity_changed_locked(self) -> None:
+        """Free space GREW (release / cordon change): every cached
+        negative is stale."""
+        self._failed_shapes.clear()
+        self._largest_dirty = True
+
+    def _commit_block_locked(
+        self, origin: tuple[int, ...], shape: tuple[int, ...]
+    ) -> None:
+        mask = self._block_mask(origin, shape)
+        if mask & self._blk_bits:
+            raise PlacementError(
+                f"pool {self.name}: internal overlap committing "
+                f"{shape} at {origin}"
+            )
+        self._occ_bits |= mask
+        self._blk_bits |= mask
+        vol = _volume(shape)
+        self._occupied_count += vol
+        self._schedulable -= vol
+        # free space only SHRANK: failed shapes stay failed, the cached
+        # largest figure degrades to an upper bound
+        self._largest_dirty = True
+
+    def _uncommit_block_locked(
+        self, origin: tuple[int, ...], shape: tuple[int, ...]
+    ) -> None:
+        mask = self._block_mask(origin, shape)
+        self._occ_bits &= ~mask
+        self._blk_bits = self._occ_bits | self._cord_bits
+        self._occupied_count -= _volume(shape)
+        self._schedulable += (mask & ~self._cord_bits).bit_count()
+        self._capacity_changed_locked()
+
+    def _acquire_block_locked(self, shape: tuple[int, ...]) -> tuple[int, ...]:
+        vol = _volume(shape)
+        if (
+            shape in self._failed_shapes
+            or vol > self._schedulable
+            or (not self._largest_dirty and vol > self._largest_free)
+        ):
+            self._failed_shapes.add(shape)
+            self._raise_no_capacity_locked(shape)
+        origin, probes = self._find_block(shape, best_fit=True)
+        metrics.slice_scan_probes.inc(self.name, by=probes)
+        if origin is None:
+            self._failed_shapes.add(shape)
+            self._raise_no_capacity_locked(shape)
+        self._commit_block_locked(origin, shape)
+        return origin
+
+    def _acquire_gang_locked(
+        self, shapes: list[tuple[int, ...]]
+    ) -> list[tuple[tuple[int, ...], tuple[int, ...]]]:
+        total_vol = sum(_volume(s) for s in shapes)
+        if total_vol > self._schedulable:
+            metrics.slice_placements.inc("no-capacity")
+            raise NoCapacity(
+                f"pool {self.name}: gang of {len(shapes)} blocks wants "
+                f"{total_vol} chips, only {self._schedulable} schedulable "
+                f"({len(self._cordoned)} cordoned)"
+            )
+        # identical siblings: try one contiguous super-block first so the
+        # whole gang lands ICI-adjacent
+        if len(shapes) > 1 and len(set(shapes)) == 1:
+            placed = self._acquire_superblock_locked(shapes[0], len(shapes))
+            if placed is not None:
+                return placed
+        placed = []
+        try:
+            for shape in shapes:
+                placed.append((self._acquire_block_locked(shape), shape))
+        except NoCapacity as e:
+            # all-or-nothing: siblings placed so far roll back (which
+            # also clears the failed-shape marker booked against the
+            # temporarily fuller grid)
+            for origin, shape in placed:
+                self._uncommit_block_locked(origin, shape)
+            raise NoCapacity(
+                f"pool {self.name}: gang of {len(shapes)} blocks does not "
+                f"fit together ({e})"
+            ) from None
+        return placed
+
+    def _acquire_superblock_locked(
+        self, shape: tuple[int, ...], k: int
+    ) -> Optional[list[tuple[tuple[int, ...], tuple[int, ...]]]]:
+        candidates = []
+        for axis in range(len(self.dims)):
+            stacked = list(shape)
+            stacked[axis] *= k
+            if stacked[axis] <= self.dims[axis]:
+                # prefer the stacking that keeps the super-block squat
+                # (low diameter, like _fit_shape's tie-break)
+                candidates.append((max(stacked), axis, tuple(stacked)))
+        candidates.sort()
+        for _diam, axis, super_shape in candidates:
+            if super_shape in self._failed_shapes:
+                continue
+            origin, probes = self._find_block(super_shape, best_fit=True)
+            metrics.slice_scan_probes.inc(self.name, by=probes)
+            if origin is None:
+                self._failed_shapes.add(super_shape)
+                continue
+            placed = []
+            for i in range(k):
+                o = list(origin)
+                o[axis] += i * shape[axis]
+                placed.append((tuple(o), shape))
+                self._commit_block_locked(tuple(o), shape)
+            return placed
+        return None
+
+    def _raise_no_capacity_locked(self, shape: tuple[int, ...]) -> None:
+        metrics.slice_placements.inc("no-capacity")
+        if self._largest_dirty:
+            # refresh so the park log is exact — cheap now (a handful of
+            # packed-word folds), and the figure stays clean for every
+            # repeat park until capacity actually changes
+            self._largest_free_locked()
+        raise NoCapacity(
+            f"pool {self.name}: no free {shape} block "
+            f"({self._schedulable} schedulable chips, "
+            f"{len(self._cordoned)} cordoned, "
+            f"largest free block {self._largest_free} chips)"
+        )
+
+    def _find_block(
+        self, shape: tuple[int, ...], best_fit: bool
+    ) -> tuple[Optional[tuple[int, ...]], int]:
+        """All-origins search on the packed board. Returns (origin, probe
+        ops). ``best_fit`` picks the highest corner-contact origin
+        instead of the first valid one."""
+        avail = ~self._blk_bits & self._full_board
+        cand = _run_starts(avail, shape[-1])
+        probes = 1
+        for axis, extent in enumerate(shape[:-1]):
+            if not cand:
+                break
+            if extent > 1:
+                cand = _run_starts(
+                    cand, extent, self._lead_strides[axis] * self._rowbits
+                )
+                cand &= self._vmask(axis, extent)
+                probes += 2
+        if not cand:
+            return None, probes
+        if not best_fit:
+            return self._origin_of_bit(cand & -cand), probes
+        best: Optional[tuple[int, ...]] = None
+        best_score = -1
+        perfect = 2 * len(self.dims)
+        examined = 0
+        while cand and examined < _BEST_FIT_CANDIDATES:
+            bit = cand & -cand
+            cand ^= bit
+            origin = self._origin_of_bit(bit)
+            score, ops = self._contact_score(origin, shape)
+            probes += ops
+            examined += 1
+            if score > best_score:
+                best_score, best = score, origin
+                if best_score >= perfect:
+                    break
+        return best, probes
+
+    def _origin_of_bit(self, bit: int) -> tuple[int, ...]:
+        pos = bit.bit_length() - 1
+        row, z = divmod(pos, self._rowbits)
+        coords = []
+        for s in self._lead_strides:
+            c, row = divmod(row, s)
+            coords.append(c)
+        return tuple(coords) + (z,)
+
+    def _contact_score(
+        self, origin: tuple[int, ...], shape: tuple[int, ...]
+    ) -> tuple[int, int]:
+        """Corner-contact heuristic: +1 per block face flush against a
+        pool wall or a blocked cell. Packing grants into contact keeps
+        the remaining free space in fewer, larger blocks."""
+        mask = self._block_mask(origin, shape)
+        blk = self._blk_bits
+        score = 0
+        ops = 2
+        # last axis: guard bits make the +-1 shifts row-safe
+        if origin[-1] == 0 or blk & ((mask >> 1) & ~mask):
+            score += 1
+        if origin[-1] + shape[-1] == self._z or blk & ((mask << 1) & ~mask):
+            score += 1
+        for axis, (o_a, s_a, d_a) in enumerate(
+            zip(origin, shape, self._lead_dims)
+        ):
+            step = self._lead_strides[axis] * self._rowbits
+            ops += 2
+            if o_a == 0 or blk & ((mask >> step) & ~mask):
+                score += 1
+            if o_a + s_a == d_a or blk & ((mask << step) & ~mask):
+                score += 1
+        return score, ops
+
+    def _largest_free_locked(self) -> int:
+        if not self._largest_dirty:
+            return self._largest_free
+        avail = ~self._blk_bits & self._full_board
+        best = 0
+        z = self._z
+        lead = self._lead_dims
+
+        def descend(mask: int, axis: int, vol: int) -> None:
+            nonlocal best
+            if not mask:
+                return
+            if axis == len(lead):
+                # count the longest free z-run surviving the lead folds
+                run = 0
+                m = mask
+                while m:
+                    run += 1
+                    m &= m >> 1
+                if vol * run > best:
+                    best = vol * run
+                return
+            d_a = lead[axis]
+            step = self._lead_strides[axis] * self._rowbits
+            cur = mask
+            for extent in range(1, d_a + 1):
+                if extent > 1:
+                    cur &= mask >> ((extent - 1) * step)
+                gated = cur & self._vmask(axis, extent)
+                if not gated:
+                    break
+                # remaining axes can contribute at most their full extent
+                cap = vol * extent * z
+                for rest in lead[axis + 1:]:
+                    cap *= rest
+                if cap > best:
+                    descend(gated, axis + 1, vol * extent)
+
+        descend(avail, 0, 1)
+        self._largest_free = best
+        self._largest_dirty = False
+        metrics.slice_fragmentation.set(
+            self._fragmentation_value_locked(), self.name
+        )
+        return best
+
+    def _fragmentation_value_locked(self) -> float:
+        if self._schedulable <= 0:
+            return 1.0
+        return self._largest_free / self._schedulable
+
+
+def _cells(origin: tuple[int, ...], shape: tuple[int, ...]):
+    return itertools.product(*[range(o, o + s) for o, s in zip(origin, shape)])
+
+
+class BruteForceReference:
+    """The seed allocator's scan semantics, retained verbatim as the
+    equivalence oracle: per-cell set probes over every candidate origin.
+    The property-based churn suite replays every indexed-allocator
+    decision against this and demands identical grant/no-capacity
+    verdicts. Never used on the grant path."""
+
+    def __init__(self, dims: tuple[int, ...]):
+        self.dims = dims
+        self.occupied: set[tuple[int, ...]] = set()
+        self.cordoned: set[tuple[int, ...]] = set()
+
+    def fit_shape(self, chips: int) -> Optional[tuple[int, ...]]:
+        best: Optional[tuple[int, ...]] = None
+        best_key: Optional[tuple[int, int]] = None
+        for shape in itertools.product(*[range(1, d + 1) for d in self.dims]):
+            n = _volume(shape)
+            if n < chips:
+                continue
+            key = (n, max(shape))
+            if best_key is None or key < best_key:
+                best, best_key = shape, key
+        return best
+
+    def find_block(self, shape: tuple[int, ...]) -> Optional[tuple[int, ...]]:
         blocked = (
-            self._occupied if not self._cordoned
-            else self._occupied | self._cordoned
+            self.occupied if not self.cordoned
+            else self.occupied | self.cordoned
         )
         ranges = [range(d - s + 1) for d, s in zip(self.dims, shape)]
         for origin in itertools.product(*ranges):
@@ -236,9 +735,23 @@ class SlicePool:
                 return origin
         return None
 
+    def largest_free_block(self) -> int:
+        best = 0
+        for shape in itertools.product(*[range(1, d + 1) for d in self.dims]):
+            vol = _volume(shape)
+            if vol > best and self.find_block(shape) is not None:
+                best = vol
+        return best
 
-def _cells(origin: tuple[int, ...], shape: tuple[int, ...]):
-    return itertools.product(*[range(o, o + s) for o, s in zip(origin, shape)])
+    def occupy(self, origin: tuple[int, ...], shape: tuple[int, ...]) -> None:
+        for cell in _cells(origin, shape):
+            if cell in self.occupied:
+                raise AssertionError(f"overlapping grant at {cell}")
+            self.occupied.add(cell)
+
+    def release(self, origin: tuple[int, ...], shape: tuple[int, ...]) -> None:
+        for cell in _cells(origin, shape):
+            self.occupied.discard(cell)
 
 
 class SlicePlacer:
@@ -270,6 +783,24 @@ class SlicePlacer:
     def pool(self, name: str) -> Optional[SlicePool]:
         return self._pools.get(name)
 
+    def _pool_for(self, queue: Optional[str]) -> SlicePool:
+        pool = self._pools.get(queue or "") or self._pools["local"]
+        if self.cordon_source is not None:
+            pool.set_cordoned(self.cordon_source(pool.name))
+        return pool
+
+    @staticmethod
+    def _apply_policy(grant: SliceGrant, tpu_policy) -> SliceGrant:
+        if tpu_policy.hosts:
+            grant.hosts = tpu_policy.hosts
+        if tpu_policy.mesh_axes:
+            grant.mesh_axes = dict(tpu_policy.mesh_axes)
+        else:
+            grant.mesh_axes = {"data": 1, "model": chip_count(grant.topology)}
+        if tpu_policy.accelerator and not grant.accelerator:
+            grant.accelerator = str(tpu_policy.accelerator)
+        return grant
+
     def place(
         self,
         tpu_policy,  # api.shared.TPUPolicy | None
@@ -285,21 +816,44 @@ class SlicePlacer:
             tpu_policy.topology is None and not tpu_policy.chips
         ):
             return None
-        pool = self._pools.get(queue or "") or self._pools["local"]
-        if self.cordon_source is not None:
-            pool.set_cordoned(self.cordon_source(pool.name))
+        pool = self._pool_for(queue)
         grant = pool.allocate(
             want_topology=tpu_policy.topology, chips=tpu_policy.chips
         )
-        if tpu_policy.hosts:
-            grant.hosts = tpu_policy.hosts
-        if tpu_policy.mesh_axes:
-            grant.mesh_axes = dict(tpu_policy.mesh_axes)
-        else:
-            grant.mesh_axes = {"data": 1, "model": chip_count(grant.topology)}
-        if tpu_policy.accelerator and not grant.accelerator:
-            grant.accelerator = str(tpu_policy.accelerator)
-        return grant
+        return self._apply_policy(grant, tpu_policy)
+
+    def place_group(
+        self,
+        requests: Sequence[tuple[str, Any]],  # (name, TPUPolicy | None)
+        queue: Optional[str] = None,
+    ) -> dict[str, Optional[SliceGrant]]:
+        """Place a `parallel` fan-out's branches in one batched gang
+        pass: every TPU branch gets a grant or NoCapacity is raised and
+        the pool is untouched (all-or-nothing — the seed placed branches
+        one by one and could strand a partial gang when a later sibling
+        hit capacity). Branches without TPU needs map to None."""
+        names = [name for name, _ in requests]
+        if len(set(names)) != len(names):
+            # results key by name: a duplicate would silently shadow its
+            # sibling's grant and leak the block (nothing would ever
+            # release it)
+            dupes = sorted({n for n in names if names.count(n) > 1})
+            raise ValueError(f"duplicate branch names in gang: {dupes}")
+        out: dict[str, Optional[SliceGrant]] = {name: None for name in names}
+        placeable = [
+            (name, pol)
+            for name, pol in requests
+            if pol is not None and (pol.topology is not None or pol.chips)
+        ]
+        if not placeable:
+            return out
+        pool = self._pool_for(queue)
+        grants = pool.allocate_many(
+            [(pol.topology, pol.chips) for _name, pol in placeable]
+        )
+        for (name, pol), grant in zip(placeable, grants):
+            out[name] = self._apply_policy(grant, pol)
+        return out
 
     def release(self, grant_dict: dict[str, Any]) -> None:
         pool = self._pools.get(grant_dict.get("pool", ""))

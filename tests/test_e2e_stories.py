@@ -682,3 +682,51 @@ class TestTPUPlacement:
         rt.pump()
         assert rt.run_phase(run) == "Succeeded"
         assert sorted(order) == ["a", "b"]  # both ran, serialized on the slice
+
+    def test_parallel_fanout_places_gang_ici_adjacent(self, rt):
+        """A `parallel` fan-out's branches place through the batched
+        gang API in ONE pool pass: every sibling gets a disjoint
+        sub-mesh, and equal siblings pack into a contiguous super-block
+        (union of cells == its bounding box)."""
+        import itertools
+
+        from bobrapet_tpu.parallel.placement import SlicePool, parse_topology
+
+        rt.placer.add_pool(SlicePool("v5e", "4x4", chips_per_host=4))
+        ep = setup_engram(rt)
+
+        @register_engram(ep)
+        def impl(ctx):
+            return {}
+
+        branches = [
+            {"name": f"b{i}", "ref": {"name": "worker"},
+             "tpu": {"topology": "1x4"}}
+            for i in range(4)
+        ]
+        rt.apply(make_story("fanout", steps=[
+            {"name": "fan", "type": "parallel", "with": {"steps": branches}},
+        ], policy={"queue": "v5e"}))
+        run = rt.run_story("fanout")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        cells = set()
+        grants = []
+        for sr in rt.store.list("StepRun"):
+            grant = sr.spec.get("sliceGrant")
+            assert grant, f"branch {sr.meta.name} launched without a slice"
+            grants.append(grant)
+            shape = parse_topology(grant["topology"])
+            origin = tuple(grant["origin"])
+            block = set(itertools.product(
+                *[range(o, o + s) for o, s in zip(origin, shape)]
+            ))
+            assert not block & cells, "sibling grants overlap"
+            cells |= block
+        assert len(grants) == 4
+        assert len(cells) == 16
+        xs = [c[0] for c in cells]
+        ys = [c[1] for c in cells]
+        assert (max(xs) - min(xs) + 1) * (max(ys) - min(ys) + 1) == 16
+        # all four released on completion
+        assert rt.placer.pool("v5e").free_chips() == 16

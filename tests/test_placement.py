@@ -1,0 +1,450 @@
+"""Indexed sub-mesh allocator (bobrapet_tpu/parallel/placement.py).
+
+Property-based churn equivalence against the retained brute-force
+reference (identical grant/no-capacity decisions for single grants),
+batched gang semantics (all-or-nothing, ICI-adjacent super-blocks),
+fast-negative NoCapacity for parked steps, truthful capacity messages,
+ceil-div host counts, fragmentation accounting, and a threaded churn
+leg under the runtime lock-order sanitizer.
+"""
+
+import random
+import threading
+
+import pytest
+
+from bobrapet_tpu.api.shared import TPUPolicy
+from bobrapet_tpu.observability.metrics import metrics
+from bobrapet_tpu.parallel.placement import (
+    BruteForceReference,
+    NoCapacity,
+    PlacementError,
+    SlicePlacer,
+    SlicePool,
+    _cells,
+    parse_topology,
+)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _lock_order_sanitizer():
+    """Lockdep for the whole module: the new allocator core must hold
+    its pool lock in a cycle-free order against the metrics locks it
+    records into (same harness as the other threaded suites)."""
+    from bobrapet_tpu.analysis.lockorder import sanitize_locks
+
+    with sanitize_locks() as monitor:
+        yield monitor
+    monitor.assert_clean()
+
+
+def _grant_cells(grant):
+    return set(_cells(tuple(grant.origin), parse_topology(grant.topology)))
+
+
+class TestHostRounding:
+    def test_non_divisible_chip_count_rounds_up(self):
+        """Regression: 6 chips at 4 chips/host is 2 hosts — the seed's
+        floor-div handed the gang Job a 1-host completions count and
+        dropped half the block's workers."""
+        pool = SlicePool("p", "2x3", chips_per_host=4)
+        g = pool.allocate(want_topology="2x3")
+        assert g.hosts == 2
+
+    @pytest.mark.parametrize(
+        "topology,cph,want,expected",
+        [
+            ("4x4", 4, "2x4", 2),   # divisible: unchanged from seed
+            ("4x4", 4, "2x2", 1),
+            ("8", 4, "6", 2),       # 6/4 -> 2
+            ("2x2", 8, "2x2", 1),   # fewer chips than a host
+            ("2x4x4", 4, "1x3x3", 3),  # 9/4 -> 3
+        ],
+    )
+    def test_host_counts(self, topology, cph, want, expected):
+        pool = SlicePool("p", topology, chips_per_host=cph)
+        assert pool.allocate(want_topology=want).hosts == expected
+
+
+class TestNoCapacityMessage:
+    def test_reports_schedulable_not_raw_free(self):
+        """The seed reported total-minus-occupied as 'chips free' while
+        ignoring cordons — awaitingSlice park logs claimed capacity that
+        was quarantined. The message must carry schedulable chips and
+        the largest placeable block."""
+        pool = SlicePool("p", "4x1")
+        pool.set_cordoned({(1, 0), (3, 0)})
+        with pytest.raises(NoCapacity) as ei:
+            pool.allocate(want_topology="2x1")
+        msg = str(ei.value)
+        assert "2 schedulable chips" in msg
+        assert "2 cordoned" in msg
+        assert "largest free block 1 chips" in msg
+
+    def test_full_pool_message(self):
+        pool = SlicePool("p", "2x2")
+        pool.allocate(want_topology="2x2")
+        with pytest.raises(NoCapacity) as ei:
+            pool.allocate(want_topology="1x1")
+        assert "0 schedulable chips" in str(ei.value)
+        assert "largest free block 0 chips" in str(ei.value)
+
+
+class TestFastNegative:
+    def test_repeat_park_probe_skips_the_scan(self):
+        pool = SlicePool("fastneg", "4x4")
+        pool.allocate(want_topology="4x4")
+        with pytest.raises(NoCapacity):
+            pool.allocate(want_topology="1x1")
+        probes_after_first = metrics.slice_scan_probes.value("fastneg")
+        for _ in range(5):  # the awaitingSlice retry loop
+            with pytest.raises(NoCapacity):
+                pool.allocate(want_topology="1x1")
+        assert metrics.slice_scan_probes.value("fastneg") == probes_after_first
+
+    def test_release_reopens_capacity(self):
+        pool = SlicePool("p", "2x2")
+        g = pool.allocate(want_topology="2x2")
+        with pytest.raises(NoCapacity):
+            pool.allocate(want_topology="1x1")
+        pool.release(g.slice_id)
+        assert pool.allocate(want_topology="1x1") is not None
+
+    def test_cordon_change_reopens_capacity(self):
+        pool = SlicePool("p", "2x2")
+        pool.set_cordoned({(0, 0), (0, 1), (1, 0), (1, 1)})
+        for _ in range(2):
+            with pytest.raises(NoCapacity):
+                pool.allocate(want_topology="1x1")
+        pool.set_cordoned(set())
+        assert pool.allocate(want_topology="2x2") is not None
+
+
+class TestAllocateMany:
+    def test_gang_grants_are_disjoint(self):
+        pool = SlicePool("p", "2x2")
+        gs = pool.allocate_many([("1x2", None), ("1x2", None)])
+        assert len(gs) == 2
+        assert not (_grant_cells(gs[0]) & _grant_cells(gs[1]))
+        assert pool.free_chips() == 0
+
+    def test_all_or_nothing_rollback(self):
+        pool = SlicePool("p", "2x2")
+        with pytest.raises(NoCapacity):
+            pool.allocate_many([("1x2", None)] * 3)
+        assert pool.free_chips() == 4
+        assert pool.schedulable_chips() == 4
+        # the rolled-back pool must still serve a fitting gang
+        assert len(pool.allocate_many([("1x2", None)] * 2)) == 2
+
+    def test_siblings_pack_into_a_contiguous_superblock(self):
+        """4 x (1x4) siblings on an empty 4x4 pool should land as one
+        4x4 super-block: the union of their cells is a contiguous
+        bounding box, so branch collectives stay on neighboring ICI."""
+        pool = SlicePool("p", "4x4")
+        gs = pool.allocate_many([("1x4", None)] * 4)
+        cells = set()
+        for g in gs:
+            cells |= _grant_cells(g)
+        assert len(cells) == 16
+        xs = [c[0] for c in cells]
+        ys = [c[1] for c in cells]
+        bbox = (max(xs) - min(xs) + 1) * (max(ys) - min(ys) + 1)
+        assert bbox == 16  # contiguous: bounding box == cell count
+
+    def test_mixed_shapes_fall_back_to_individual_blocks(self):
+        pool = SlicePool("p", "4x4")
+        gs = pool.allocate_many([("2x2", None), (None, 2)])
+        assert parse_topology(gs[0].topology) == (2, 2)
+        assert len(_grant_cells(gs[1])) == 2
+        assert not (_grant_cells(gs[0]) & _grant_cells(gs[1]))
+
+    def test_empty_request_list(self):
+        assert SlicePool("p", "2x2").allocate_many([]) == []
+
+
+class TestPlaceGroup:
+    def test_mixed_tpu_and_plain_branches(self):
+        placer = SlicePlacer([SlicePool("v5e", "4x4", chips_per_host=4)])
+        out = placer.place_group(
+            [
+                ("train", TPUPolicy(topology="2x2")),
+                ("log", None),
+                ("eval", TPUPolicy(chips=2)),
+            ],
+            queue="v5e",
+        )
+        assert out["log"] is None
+        assert parse_topology(out["train"].topology) == (2, 2)
+        assert out["train"].mesh_axes == {"data": 1, "model": 4}
+        assert len(_grant_cells(out["eval"])) == 2
+
+    def test_group_no_capacity_is_atomic(self):
+        pool = SlicePool("tiny", "2x2")
+        placer = SlicePlacer([pool])
+        with pytest.raises(NoCapacity):
+            placer.place_group(
+                [("a", TPUPolicy(topology="2x2")),
+                 ("b", TPUPolicy(topology="2x2"))],
+                queue="tiny",
+            )
+        assert pool.free_chips() == 4
+
+    def test_group_without_tpu_branches_places_nothing(self):
+        placer = SlicePlacer()
+        out = placer.place_group([("a", None), ("b", TPUPolicy())])
+        assert out == {"a": None, "b": None}
+
+    def test_duplicate_branch_names_rejected_before_placing(self):
+        """Results key by branch name — a duplicate would shadow its
+        sibling's grant and leak the block. Must fail fast with the
+        pool untouched."""
+        pool = SlicePool("v5e", "4x4")
+        placer = SlicePlacer([pool])
+        with pytest.raises(ValueError, match="duplicate branch"):
+            placer.place_group(
+                [("b", TPUPolicy(topology="1x2")),
+                 ("b", TPUPolicy(topology="1x2"))],
+                queue="v5e",
+            )
+        assert pool.free_chips() == 16
+
+
+def _dict_grant_cells(grant):
+    return set(_cells(tuple(grant["origin"]), parse_topology(grant["topology"])))
+
+
+class TestFleetBatchedReplacement:
+    def _runtime_with_pool(self):
+        from bobrapet_tpu.runtime import Runtime
+
+        rt = Runtime()
+        rt.placer.add_pool(SlicePool("v5e", "4x4", chips_per_host=4))
+        return rt, rt.placer.pool("v5e")
+
+    def test_replace_grants_re_places_siblings_around_quarantine(self):
+        rt, pool = self._runtime_with_pool()
+        sib = [g.to_dict() for g in pool.allocate_many([("1x4", None)] * 2)]
+        rt.fleet.on_preemption(sib[0], host=0, key="ns/j1")
+        news = rt.fleet.replace_grants(sib)
+        assert news is not None and len(news) == 2
+        quarantined = set(map(tuple, rt.fleet.registry.quarantined_cells("v5e")))
+        assert quarantined
+        c0, c1 = _dict_grant_cells(news[0]), _dict_grant_cells(news[1])
+        assert not c0 & c1
+        assert not (c0 | c1) & quarantined
+
+    def test_replace_grants_rejects_cross_pool_siblings(self):
+        rt, pool = self._runtime_with_pool()
+        rt.placer.add_pool(SlicePool("other", "2x2"))
+        a = pool.allocate(want_topology="1x2").to_dict()
+        b = rt.placer.pool("other").allocate(want_topology="1x2").to_dict()
+        with pytest.raises(ValueError, match="span pools"):
+            rt.fleet.replace_grants([a, b])
+
+    def test_replace_grants_releases_dead_blocks_even_when_parking(self):
+        """Fail fast: the dead gang's chips return to the pool even
+        when no replacement fits (callers park on awaitingSlice)."""
+        rt, pool = self._runtime_with_pool()
+        sib = [g.to_dict() for g in pool.allocate_many([("2x4", None)] * 2)]
+        # quarantine everything so nothing can re-place
+        rt.fleet.registry.report_preemption(
+            "v5e", [(x, y) for x in range(4) for y in range(4)], key="k"
+        )
+        assert rt.fleet.replace_grants(sib) is None
+        assert pool.free_chips() == 16  # released, not leaked
+        assert pool.schedulable_chips() == 0  # but all cordoned
+
+
+class TestLargestFreeAndFragmentation:
+    def test_split_free_space(self):
+        pool = SlicePool("frag", "4x1")
+        pool.set_cordoned({(1, 0), (3, 0)})
+        assert pool.schedulable_chips() == 2
+        assert pool.largest_free_block() == 1
+        assert pool.fragmentation() == pytest.approx(0.5)
+        assert metrics.slice_fragmentation.value("frag") == pytest.approx(0.5)
+
+    def test_empty_and_full(self):
+        pool = SlicePool("p", "4x4")
+        assert pool.largest_free_block() == 16
+        assert pool.fragmentation() == pytest.approx(1.0)
+        pool.allocate(want_topology="4x4")
+        assert pool.largest_free_block() == 0
+
+
+class TestPropertyChurnEquivalence:
+    """Random allocate/release/cordon sequences replayed against the
+    retained brute-force reference: the indexed allocator must never
+    overlap grants, must restore free counts on release, and must agree
+    with the brute-force scan on every single-grant grant/no-capacity
+    decision."""
+
+    @pytest.mark.parametrize(
+        "topology,seed",
+        [
+            ("8x8", 1), ("8x8", 2), ("8x8", 3),
+            ("4x4x4", 4), ("4x4x4", 5),
+            ("16", 6),
+            ("2x3", 7),
+            ("3x5x2", 8),
+        ],
+    )
+    def test_churn_matches_brute_force(self, topology, seed):
+        dims = parse_topology(topology)
+        pool = SlicePool(f"pb-{topology}-{seed}", topology, chips_per_host=4)
+        ref = BruteForceReference(dims)
+        rng = random.Random(seed)
+        total = pool.total_chips
+        all_cells = [()]
+        for d in dims:
+            all_cells = [c + (i,) for c in all_cells for i in range(d)]
+        live = []  # (slice_id, origin, shape)
+
+        def check_counts():
+            assert pool.free_chips() == total - len(ref.occupied)
+            assert pool.schedulable_chips() == total - len(
+                ref.occupied | ref.cordoned
+            )
+
+        for i in range(250):
+            op = rng.random()
+            if op < 0.08:
+                cord = set(
+                    rng.sample(all_cells, rng.randrange(0, max(2, total // 6)))
+                )
+                pool.set_cordoned(cord)
+                ref.cordoned = set(cord)
+            elif op < 0.62 or not live:
+                if rng.random() < 0.5:
+                    shape = tuple(rng.randint(1, d) for d in dims)
+                    kwargs = {"want_topology": "x".join(map(str, shape))}
+                else:
+                    chips = rng.randint(1, total)
+                    shape = ref.fit_shape(chips)
+                    kwargs = {"chips": chips}
+                try:
+                    g = pool.allocate(**kwargs)
+                except NoCapacity:
+                    # decision agreement: brute force finds nothing either
+                    assert ref.find_block(shape) is None, (
+                        f"op {i}: indexed said NoCapacity for {shape} but "
+                        f"brute force finds {ref.find_block(shape)}"
+                    )
+                else:
+                    origin = tuple(g.origin)
+                    granted = parse_topology(g.topology)
+                    assert granted == shape
+                    # decision agreement: brute force also finds a block
+                    assert ref.find_block(shape) is not None
+                    cells = set(_cells(origin, granted))
+                    assert all(
+                        all(0 <= c < d for c, d in zip(cell, dims))
+                        for cell in cells
+                    )
+                    assert not cells & ref.cordoned, "grant on cordoned cells"
+                    ref.occupy(origin, granted)  # raises on overlap
+                    live.append((g.slice_id, origin, granted))
+            else:
+                sid, origin, shape = live.pop(rng.randrange(len(live)))
+                pool.release(sid)
+                ref.release(origin, shape)
+            check_counts()
+            if i % 50 == 25 and total <= 64:
+                assert pool.largest_free_block() == ref.largest_free_block()
+
+        while live:
+            sid, origin, shape = live.pop()
+            pool.release(sid)
+            ref.release(origin, shape)
+        check_counts()
+        pool.set_cordoned(set())
+        assert pool.free_chips() == total
+        assert pool.largest_free_block() == total
+
+    def test_gang_churn_invariants(self):
+        """allocate_many under churn: grants stay disjoint (the
+        reference's occupy() raises on overlap) and rollback restores
+        counts exactly."""
+        dims = (4, 4)
+        pool = SlicePool("gang-churn", "4x4")
+        ref = BruteForceReference(dims)
+        rng = random.Random(99)
+        live = []
+        for _i in range(200):
+            if rng.random() < 0.55 or not live:
+                k = rng.randint(2, 4)
+                shape = (1, rng.randint(1, 4))
+                topo = "x".join(map(str, shape))
+                try:
+                    gs = pool.allocate_many([(topo, None)] * k)
+                except NoCapacity:
+                    pass
+                else:
+                    for g in gs:
+                        origin = tuple(g.origin)
+                        ref.occupy(origin, parse_topology(g.topology))
+                        live.append((g.slice_id, origin,
+                                     parse_topology(g.topology)))
+            else:
+                sid, origin, shape = live.pop(rng.randrange(len(live)))
+                pool.release(sid)
+                ref.release(origin, shape)
+            assert pool.free_chips() == 16 - len(ref.occupied)
+        while live:
+            sid, origin, shape = live.pop()
+            pool.release(sid)
+        assert pool.free_chips() == 16
+
+
+class TestOversizeRequests:
+    def test_oversize_topology_is_placement_error_not_no_capacity(self):
+        pool = SlicePool("p", "2x2")
+        with pytest.raises(PlacementError) as ei:
+            pool.allocate(want_topology="4x4")
+        assert not isinstance(ei.value, NoCapacity)
+
+    def test_oversize_chips(self):
+        with pytest.raises(PlacementError):
+            SlicePool("p", "2x2").allocate(chips=32)
+
+
+class TestThreadedChurn:
+    def test_concurrent_allocate_release(self):
+        """4 workers churning one pool: no overlap (the allocator's
+        internal commit guard raises PlacementError on any), no lost
+        cells, and the module-level lockdep sees a cycle-free order."""
+        pool = SlicePool("threaded", "8x8")
+        errors = []
+        barrier = threading.Barrier(4)
+
+        def worker(worker_seed):
+            rng = random.Random(worker_seed)
+            mine = []
+            barrier.wait()
+            try:
+                for _ in range(150):
+                    if rng.random() < 0.6 or not mine:
+                        try:
+                            mine.append(pool.allocate(
+                                chips=rng.choice([1, 2, 4, 8, 16])
+                            ))
+                        except NoCapacity:
+                            pass
+                    else:
+                        pool.release(mine.pop(rng.randrange(len(mine))).slice_id)
+                for g in mine:
+                    pool.release(g.slice_id)
+            except Exception as e:  # noqa: BLE001 - collected for the assert
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(s,)) for s in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors
+        assert pool.free_chips() == 64
+        assert pool.largest_free_block() == 64
