@@ -23,7 +23,14 @@ Architecture (round-3, per VERDICT r2 #2/#3):
 Env knobs: BENCH_MODEL=tiny|1b|8b, BENCH_BATCH, BENCH_PROMPT_LEN,
 BENCH_NEW_TOKENS, BENCH_REPS, BENCH_FORCE_CPU=1, BENCH_PROBE_TIMEOUT (s),
 BENCH_DEADLINE (s), BENCH_BASELINE (tok/s/chip), BENCH_QUANT=int8,
-BENCH_SKIP_SWEEP=1 (decode only), BENCH_CHILD (internal).
+BENCH_SKIP_SWEEP=1 (decode only), BENCH_CHILD (internal),
+BENCH_SHARDED_{SHARDS,CAP,SLEEP_S,MEASURE_S} (sharded soak),
+BENCH_GATE_TOLERANCE (fraction, default 0.10) and
+BENCH_ALLOW_REGRESSION=1 for the end-of-run regression gate (every
+metric vs its best prior BENCH_r*.json value, same-backend only; an
+unexplained drop exits rc=3). Runs that fall back to cpu because the
+TPU probe failed record `backend_fallback_reason` on the decode line
+and the gate line.
 """
 
 from __future__ import annotations
@@ -37,6 +44,10 @@ import time
 
 T0 = time.monotonic()
 
+#: every JSON line this (parent) process prints, for the end-of-run
+#: regression gate (children's lines are folded in by the spawn helpers)
+_EMITTED: list[dict] = []
+
 
 def _deadline_s() -> float:
     return float(os.environ.get("BENCH_DEADLINE", "1200"))
@@ -47,6 +58,7 @@ def _remaining() -> float:
 
 
 def _emit(obj: dict) -> None:
+    _EMITTED.append(obj)
     print(json.dumps(obj))
     sys.stdout.flush()
 
@@ -781,6 +793,88 @@ def run_placement_child() -> None:
     _emit(config11_placement_churn())
 
 
+#: PR-6 seed number for the sharded control-plane soak: steady-state
+#: steps/s of ONE single-active manager on the calibrated
+#: latency-bound workload (sleep 0.6s, global cap 2) — the pre-sharding
+#: control-plane shape docs/SCALING.md records as the hard ceiling.
+#: vs_baseline below is the N-shard value over THIS, so future
+#: BENCH_r*.json capture the scale-out trajectory.
+SHARDED_SEED_SPS = 3.0
+
+
+def config12_sharded_soak() -> dict:
+    """Sharded control plane: N in-process managers over one bus
+    (hash-ring run ownership, leader-published shard map, partitioned
+    watch fan-out) vs one manager on the identical workload. The
+    workload is latency-dominated (sleeping engrams under a per-manager
+    concurrency budget) because in-process shards share the GIL —
+    production runs one process per shard; this measures coordination
+    scaling, not compute parallelism (see docs/SCALING.md). The
+    double-reconcile detector arms on every shard: a nonzero violation
+    count fails the config outright."""
+    from bobrapet_tpu.api.catalog import make_engram_template
+    from bobrapet_tpu.api.engram import make_engram
+    from bobrapet_tpu.api.story import make_story
+    from bobrapet_tpu.sdk import register_engram
+    from bobrapet_tpu.shard import ShardedControlPlane
+
+    sleep_s = float(os.environ.get("BENCH_SHARDED_SLEEP_S", "0.6"))
+    cap = int(os.environ.get("BENCH_SHARDED_CAP", "2"))
+    shards = int(os.environ.get("BENCH_SHARDED_SHARDS", "4"))
+    measure_s = float(os.environ.get("BENCH_SHARDED_MEASURE_S", "5"))
+
+    def leg(n_shards: int) -> float:
+        def configure(cfg):
+            cfg.scheduling.global_max_concurrent_steps = cap
+            cfg.scheduling.queue_probe_interval = 1.0  # event-driven refill
+
+        cp = ShardedControlPlane(
+            shards=n_shards, heartbeat_interval=0.25, member_ttl=3.0,
+            lease_duration=4.0, configure=configure,
+        )
+        with cp:
+            cp.wait_members({str(i) for i in range(n_shards)})
+            entry = f"bench-shard-{n_shards}"
+
+            @register_engram(entry)
+            def impl(ctx):
+                time.sleep(sleep_s)
+                return {"i": ctx.inputs.get("i", 0)}
+
+            cp.apply(make_engram_template(f"{entry}-tpl", entrypoint=entry))
+            cp.apply(make_engram(f"{entry}-worker", f"{entry}-tpl"))
+            cp.apply(make_story(f"{entry}-story", steps=[
+                {"name": "s0", "ref": {"name": f"{entry}-worker"},
+                 "with": {"i": "{{ inputs.i }}"}}]))
+            sps = cp.steady_state_steps_per_sec(
+                f"{entry}-story", window=6 * n_shards,
+                measure_s=measure_s, warmup_s=2.0,
+            )
+        cp.detector.assert_clean()
+        return sps
+
+    single = leg(1)
+    multi = leg(shards)
+    return {
+        "metric": "sharded_steps_per_sec",
+        "value": round(multi, 2),
+        "unit": "steps/s",
+        "vs_baseline": round(multi / SHARDED_SEED_SPS, 2),
+        "config": "sharded-soak",
+        "shards": shards,
+        "single_shard_steps_per_sec": round(single, 2),
+        "scaling_x": round(multi / single, 2) if single else None,
+        "cap_per_shard": cap,
+        "step_latency_s": sleep_s,
+        "double_reconcile_violations": 0,
+    }
+
+
+def run_sharded_child() -> None:
+    """Child entrypoint: pure control-plane (no accelerator, no jax)."""
+    _emit(config12_sharded_soak())
+
+
 def run_sweep(state: dict) -> None:
     # the parent NEVER touches the accelerator — but the env var alone
     # is not enough: a site hook can rewrite platform priority
@@ -1319,8 +1413,103 @@ def _spawn_passthrough(child: str, model: str | None, timeout: float,
     for ln in stdout.strip().splitlines():
         ln = ln.strip()
         if ln.startswith("{"):
+            try:
+                _EMITTED.append(json.loads(ln))
+            except json.JSONDecodeError:
+                pass
             print(ln)
             sys.stdout.flush()
+
+
+# ---------------------------------------------------------------------------
+# regression gate: every metric vs the best prior BENCH_r*.json value
+# ---------------------------------------------------------------------------
+
+#: metrics where a LOWER value is the improvement
+GATE_LOWER_IS_BETTER = frozenset({"entry_forward_step_ms"})
+
+
+def _gate_key(d: dict) -> tuple:
+    """Comparison identity for a metric line. Backend AND run shape are
+    part of the key: an 8b int8 leg must never be judged against a
+    tiny-model best, nor a 2-shard soak against a 4-shard one, nor a
+    BENCH_PROMPT_LEN=2048 decode against the default-128 prior — a
+    shape with no prior simply isn't gated. Every env-overridable knob
+    that moves the number must appear here (lines record them; absent
+    fields key as None, so old priors without a field still match runs
+    that also lack it)."""
+    return (d.get("metric"), d.get("backend"), d.get("model"),
+            d.get("quant"), d.get("batch"), d.get("shards"),
+            d.get("prompt_len"), d.get("new_tokens"),
+            d.get("step_latency_s"), d.get("cap_per_shard"))
+
+
+def _best_prior() -> dict:
+    """(metric, backend) -> best value across every BENCH_r*.json
+    recorded next to this script. Error lines and non-numeric values
+    are skipped; backend is part of the key so a cpu-fallback run is
+    never judged against a real-chip best (and vice versa)."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    best: dict = {}
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
+        try:
+            with open(path) as fh:
+                obj = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        for ln in (obj.get("tail") or "").splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                d = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            value = d.get("value")
+            if (d.get("unit") == "error" or d.get("error")
+                    or not isinstance(value, (int, float)) or value <= 0):
+                continue
+            key = _gate_key(d)
+            prior = best.get(key)
+            if d.get("metric") in GATE_LOWER_IS_BETTER:
+                best[key] = value if prior is None else min(prior, value)
+            else:
+                best[key] = value if prior is None else max(prior, value)
+    return best
+
+
+def _regression_gate() -> list[dict]:
+    """Compare every metric line this run minted against the best prior
+    recorded value (the `llama_decode_tokens_per_sec_per_chip`
+    2819 -> 2499 drift across r02->r05 sailed through unnoticed; this
+    makes such drops loud). Returns the failure records; the caller
+    emits them and decides the exit code."""
+    tol = float(os.environ.get("BENCH_GATE_TOLERANCE", "0.10"))
+    best = _best_prior()
+    failures: list[dict] = []
+    for d in list(_EMITTED):
+        value = d.get("value")
+        if (d.get("unit") == "error" or d.get("error")
+                or not isinstance(value, (int, float)) or value <= 0):
+            continue
+        prior = best.get(_gate_key(d))
+        if not prior:
+            continue
+        if d.get("metric") in GATE_LOWER_IS_BETTER:
+            ratio = prior / value
+        else:
+            ratio = value / prior
+        if ratio < 1.0 - tol:
+            failures.append({
+                "metric": d.get("metric"),
+                "backend": d.get("backend"),
+                "value": value,
+                "best_prior": prior,
+                "drop_pct": round(100.0 * (1.0 - ratio), 1),
+            })
+    return failures
 
 
 def main() -> None:
@@ -1335,6 +1524,9 @@ def main() -> None:
         return
     if os.environ.get("BENCH_CHILD") == "placement":
         run_placement_child()
+        return
+    if os.environ.get("BENCH_CHILD") == "sharded":
+        run_sharded_child()
         return
 
     state: dict = {"stage": "start"}
@@ -1361,6 +1553,13 @@ def main() -> None:
         _spawn_passthrough(
             "placement", None,
             timeout=min(240.0, max(60.0, _remaining() - 60.0)), cpu=True,
+        )
+        # sharded control-plane soak: same child-isolation rule (N live
+        # runtimes with real threads must not wedge the sweep)
+        state["stage"] = "sharded-soak"
+        _spawn_passthrough(
+            "sharded", None,
+            timeout=min(240.0, max(90.0, _remaining() - 60.0)), cpu=True,
         )
 
     # give the FIRST probe a chance to conclude before deciding: a
@@ -1420,6 +1619,10 @@ def main() -> None:
         r = _spawn_decode(cpu=True, model=os.environ.get("BENCH_MODEL"),
                           quant=None, timeout=max(120.0, _remaining() - 120.0),
                           extra={"fallback_reason": forensics.get("error"),
+                                 # the canonical record of WHY this run
+                                 # is on cpu (probe timeout / init
+                                 # failure), for trend tooling
+                                 "backend_fallback_reason": forensics.get("error"),
                                  "probe": forensics})
         if r:
             results.append(r)
@@ -1480,7 +1683,40 @@ def main() -> None:
         _fail("no decode result produced", probe=forensics)
     for r in results[:-1]:
         _emit(r)
-    _emit(results[-1])
+
+    # regression gate over everything minted so far + the headline
+    # (appended before the gate runs so it is judged too, but still
+    # PRINTED last for drivers that record only the final line)
+    headline = results[-1]
+    _EMITTED.append(headline)
+    failures = _regression_gate()
+    allow = os.environ.get(
+        "BENCH_ALLOW_REGRESSION", ""
+    ).strip().lower() not in ("", "0", "false", "no", "off")
+    gate_line = {
+        "metric": "bench_regression_gate",
+        "value": float(len(failures)),
+        "unit": "regressions",
+        "vs_baseline": 1.0 if not failures else 0.0,
+        "tolerance_pct": round(
+            100 * float(os.environ.get("BENCH_GATE_TOLERANCE", "0.10")), 1),
+        "failures": failures,
+        "allowed": allow if failures else None,
+        "backend_fallback_reason": (None if use_default
+                                    else forensics.get("error")),
+    }
+    # gate line before the headline; emit via print only (the gate must
+    # not judge itself)
+    print(json.dumps(gate_line))
+    sys.stdout.flush()
+    print(json.dumps(headline))
+    sys.stdout.flush()
+    if failures and not allow:
+        # unexplained drop vs the best prior round: fail the bench so
+        # the driver's record carries rc != 0 (set
+        # BENCH_ALLOW_REGRESSION=1 to downgrade to a warning once the
+        # drop is understood and accepted)
+        raise SystemExit(3)
 
 
 if __name__ == "__main__":

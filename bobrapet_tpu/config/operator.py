@@ -46,6 +46,12 @@ class SchedulingConfig:
     """(reference: controller_config.go:524-547 SchedulingConfig)"""
 
     global_max_concurrent_steps: int = 0  # 0 = unlimited
+    #: how often a capacity-parked run re-probes the scheduling gates
+    #: (queueWaiting/placementWaiting requeue). The default matches the
+    #: historical hardcoded 1s; latency-sensitive deployments (and the
+    #: sharded soak) tighten it so a freed slot refills promptly
+    #: (dotted: scheduling.queue-probe-interval)
+    queue_probe_interval: float = 1.0
     queues: dict[str, QueueConfig] = dataclasses.field(default_factory=dict)
 
     def queue(self, name: Optional[str]) -> QueueConfig:
@@ -73,6 +79,17 @@ class ControllerTuning:
     requeue_base_delay: float = 0.05
     requeue_max_delay: float = 30.0
     reconcile_timeout: float = 30.0
+    #: horizontal sharding (bobrapet_tpu/shard): number of cooperating
+    #: managers owning disjoint hash-ring ranges of run keys. 1 = the
+    #: classic single-active manager. Live-reloaded: the elected shard
+    #: leader republishes the map and a barrier rebalance follows
+    #: (dotted: controllers.shard-count)
+    shard_count: int = 1
+    #: this replica's shard identity in [0, shard-count). Normally set
+    #: per-process (BOBRA_SHARD_ID / Runtime(shard_id=...)) because the
+    #: ConfigMap is shared by every replica; the dotted key exists for
+    #: single-replica pinning and tooling (controllers.shard-id)
+    shard_id: int = 0
     #: per-controller pool-width overrides, keyed by controller name
     #: (reference: the five per-controller ``*.max-concurrent-reconciles``
     #: families, operator.go:447-528); dotted key
@@ -196,6 +213,18 @@ class OperatorConfig:
             )
         if self.controllers.max_concurrent_reconciles < 1:
             errs.append("controllers.maxConcurrentReconciles must be >= 1")
+        if self.scheduling.queue_probe_interval <= 0:
+            # 0 would turn every capacity-parked run into an immediate
+            # hot requeue loop — the exact timer churn the event-driven
+            # refill exists to avoid
+            errs.append("scheduling.queue-probe-interval must be > 0")
+        if self.controllers.shard_count < 1:
+            errs.append("controllers.shard-count must be >= 1")
+        if not (0 <= self.controllers.shard_id < max(1, self.controllers.shard_count)):
+            errs.append(
+                f"controllers.shard-id must be in [0, shard-count), got "
+                f"{self.controllers.shard_id} of {self.controllers.shard_count}"
+            )
         for cname, width in self.controllers.per_controller.items():
             if width < 1:
                 errs.append(
@@ -243,7 +272,10 @@ def _apply_dotted(cfg: OperatorConfig, key: str, value: str) -> bool:
         "controllers.requeue-base-delay": lambda: fset(cfg.controllers, "requeue_base_delay", as_dur),
         "controllers.requeue-max-delay": lambda: fset(cfg.controllers, "requeue_max_delay", as_dur),
         "controllers.reconcile-timeout": lambda: fset(cfg.controllers, "reconcile_timeout", as_dur),
+        "controllers.shard-count": lambda: fset(cfg.controllers, "shard_count", int),
+        "controllers.shard-id": lambda: fset(cfg.controllers, "shard_id", int),
         "scheduling.global-max-concurrent-steps": lambda: fset(cfg.scheduling, "global_max_concurrent_steps", int),
+        "scheduling.queue-probe-interval": lambda: fset(cfg.scheduling, "queue_probe_interval", as_dur),
         "templating.evaluation-timeout": lambda: fset(cfg.templating, "evaluation_timeout", as_dur),
         "templating.max-output-bytes": lambda: fset(cfg.templating, "max_output_bytes", int),
         "templating.deterministic": lambda: fset(cfg.templating, "deterministic", as_bool),

@@ -203,6 +203,13 @@ class DAGEngine:
         self.storage = storage
         self.recorder = recorder
         self.clock = clock or Clock()
+        #: sharded control plane (bobrapet_tpu/shard): when set, the
+        #: GLOBAL concurrency cap counts only StepRuns whose run family
+        #: this manager owns — `scheduling.global-max-concurrent-steps`
+        #: is per-manager dispatch capacity, so N shards each get their
+        #: own budget. Named queue caps stay bus-global (user-facing
+        #: admission invariants, counted over the shared store).
+        self.owned_filter = None
         #: per-pass launch counter; thread-local because the StoryRun
         #: controller's pool runs several DAG passes concurrently
         self._pass = threading.local()
@@ -215,10 +222,28 @@ class DAGEngine:
         #: itself (template eval, storage offload, StepRun commit) runs
         #: OUTSIDE the lock against an in-memory reservation, so a slow
         #: materialization cannot head-of-line-block other runs' gates.
-        self._sched_lock = threading.Lock()
-        #: queue-name (and the all-queues bucket) -> launches reserved
-        #: but not yet visible in the store index; counted by the gate
-        self._sched_reserved: dict[Optional[str], int] = {}
+        #: The (lock, reservations) pair is BUS-WIDE (store.
+        #: scheduling_gate()): named-queue caps are user-facing
+        #: admission invariants counted over the shared store, so N
+        #: sharded managers gating under process-local locks could each
+        #: admit one step over a cap in the same instant.
+        self._sched_lock, self._sched_reserved = store.scheduling_gate()
+        #: the GLOBAL cap's reservation bucket is per-ENGINE: that cap
+        #: is shard-local dispatch capacity (see owned_filter above),
+        #: so one shard's in-flight reservations must not shrink
+        #: another's budget. Named queues share their string keys.
+        self._global_bucket = ("global", id(self))
+        #: runs parked behind a capacity gate (queueWaiting /
+        #: placementWaiting) as of their last reconcile. A terminal
+        #: StepRun frees capacity, so the runtime wakes entries from
+        #: here event-driven (wake_capacity_parked) instead of leaning
+        #: on the scheduling.queue-probe-interval timer alone — at N
+        #: shards the timer-poll churn of a parked population was the
+        #: dominant control-plane CPU cost (GIL-bound), while the event
+        #: wake costs one enqueue per freed slot. Entries are popped at
+        #: wake time; a still-gated run re-parks itself on its own
+        #: reconcile, so stale keys self-heal.
+        self.capacity_parked: set[tuple[str, str]] = set()
         store.add_index(STEP_RUN_KIND, INDEX_STEPRUN_QUEUE_ACTIVE,
                         _queue_active_index)
 
@@ -237,6 +262,11 @@ class DAGEngine:
             namespace=run.meta.namespace,
         ):
             result = self._run(run, story)
+        key = (run.meta.namespace, run.meta.name)
+        if run.status.get("queueWaiting") or run.status.get("placementWaiting"):
+            self.capacity_parked.add(key)
+        else:
+            self.capacity_parked.discard(key)
         after = run.status.get("phase")
         if after != before and after and Phase(after).is_terminal:
             metrics.storyrun_total.inc(after)
@@ -282,6 +312,18 @@ class DAGEngine:
             metrics.dag_iterations.observe(self._pass.launched)
 
         return self._next_wakeup(run, story)
+
+    def wake_capacity_parked(self, limit: int = 4) -> list[tuple[str, str]]:
+        """Pop up to ``limit`` capacity-parked run keys for an
+        event-driven requeue (one freed slot rarely admits more than a
+        few runs; the popped run re-parks itself if still gated)."""
+        out: list[tuple[str, str]] = []
+        while len(out) < limit:
+            try:
+                out.append(self.capacity_parked.pop())
+            except KeyError:
+                break
+        return out
 
     # ------------------------------------------------------------------
     # state sync
@@ -934,21 +976,36 @@ class DAGEngine:
         # whole phase buckets made every launch O(all active StepRuns)
         # once a queue or global cap was configured. Reservations cover
         # launches another worker has committed to but not yet written.
+        if queue is None and self.owned_filter is not None:
+            # shard-local global cap: the bucket holds every shard's
+            # active steps (bounded by the sum of per-shard caps), so
+            # the ownership probe over views stays cheap
+            return sum(
+                1
+                for sr in self.store.list_views(
+                    STEP_RUN_KIND,
+                    index=(INDEX_STEPRUN_QUEUE_ACTIVE, ACTIVE_ALL_BUCKET),
+                )
+                if self.owned_filter(sr)
+            ) + self._sched_reserved.get(self._global_bucket, 0)
+        key = queue if queue is not None else self._global_bucket
         return self.store.count(
             STEP_RUN_KIND,
             index=(INDEX_STEPRUN_QUEUE_ACTIVE,
                    queue if queue is not None else ACTIVE_ALL_BUCKET),
-        ) + self._sched_reserved.get(queue, 0)
+        ) + self._sched_reserved.get(key, 0)
 
     def _reserve_locked(self, queue: Optional[str]) -> None:
         """Account one imminent launch; MUST hold _sched_lock."""
-        self._sched_reserved[None] = self._sched_reserved.get(None, 0) + 1
+        g = self._global_bucket
+        self._sched_reserved[g] = self._sched_reserved.get(g, 0) + 1
         if queue is not None:
             self._sched_reserved[queue] = self._sched_reserved.get(queue, 0) + 1
 
     def _unreserve(self, queue: Optional[str]) -> None:
+        keys = {self._global_bucket} | ({queue} if queue is not None else set())
         with self._sched_lock:
-            for k in {None, queue}:
+            for k in keys:
                 n = self._sched_reserved.get(k, 0) - 1
                 if n > 0:
                     self._sched_reserved[k] = n
@@ -1114,7 +1171,10 @@ class DAGEngine:
             or run.status.get("queueWaiting")
             or run.status.get("materializeWaiting")
         ):
-            due.append(now + 1.0)
+            due.append(
+                now
+                + self.config_manager.config.scheduling.queue_probe_interval
+            )
         if not due:
             return None
         return max(0.0, min(due) - now)

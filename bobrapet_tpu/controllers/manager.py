@@ -173,6 +173,16 @@ class ControllerManager:
         #: widths pinned by register(max_concurrent=...) — these outrank
         #: config and survive apply_config reloads
         self._registered_max: dict[str, int] = {}
+        #: sharded dispatch gate (bobrapet_tpu/shard): consulted before
+        #: each reconcile with (controller, ns, name); None admits,
+        #: >= 0 parks the key (requeue after that delay, e.g. awaiting a
+        #: rebalance barrier), < 0 drops it (another shard's work).
+        #: Runs OUTSIDE the manager lock and must be cheap.
+        self.reconcile_gate: Optional[Callable[[str, str, str], Optional[float]]] = None
+        #: reconcile start/finish hook (duck-typed: reconcile_started /
+        #: reconcile_finished, both (controller, ns, name)) — the shard
+        #: double-reconcile detector rides here in tests
+        self.reconcile_observer = None
 
     # -- registration ------------------------------------------------------
 
@@ -323,6 +333,44 @@ class ControllerManager:
         fn = self._controllers.get(controller)
         if fn is None:
             return
+        gate = self.reconcile_gate
+        if gate is not None:
+            try:
+                verdict = gate(controller, ns, name)
+            except Exception:  # noqa: BLE001 - a broken gate must not kill the worker thread
+                # fail CLOSED (ownership unknown -> don't reconcile;
+                # running anyway could double-own the key on another
+                # shard) but stay live: requeue and retry shortly
+                _log.exception(
+                    "reconcile gate failed for %s %s/%s; parking key",
+                    controller, ns, name,
+                )
+                self.enqueue(controller, ns, name, after=0.1)
+                return
+            if verdict is not None:
+                if verdict >= 0:
+                    self.enqueue(controller, ns, name,
+                                 after=max(verdict, 1e-9))
+                return
+        observer = self.reconcile_observer
+        if observer is not None:
+            try:
+                observer.reconcile_started(controller, ns, name)
+            except Exception:  # noqa: BLE001 - diagnostics must not affect dispatch
+                _log.exception("reconcile observer failed (start)")
+                observer = None  # keep start/finish balanced
+        try:
+            self._process_inner(key)
+        finally:
+            if observer is not None:
+                try:
+                    observer.reconcile_finished(controller, ns, name)
+                except Exception:  # noqa: BLE001 - diagnostics must not affect dispatch
+                    _log.exception("reconcile observer failed (finish)")
+
+    def _process_inner(self, key: tuple[str, str, str]) -> None:
+        controller, ns, name = key
+        fn = self._controllers[controller]
         started = time.monotonic()
         try:
             requeue_after = fn(ns, name)
@@ -358,6 +406,13 @@ class ControllerManager:
                 "controllers.reconcile-timeout)",
                 controller, ns, name, dur, self._reconcile_timeout,
             )
+
+    def active_keys(self) -> list[tuple[str, str, str]]:
+        """Snapshot of in-flight reconcile keys (controller, ns, name)
+        — the shard coordinator's drain check reads this to decide when
+        every reconcile for families it is losing has completed."""
+        with self._lock:
+            return list(self._active)
 
     def _finish_locked(self, key: tuple[str, str, str]) -> None:
         """Retire an in-flight key; a dirty mark re-queues it once."""

@@ -85,6 +85,13 @@ class EventRecorder:
     def warning(self, obj, reason: str, message: str, **kw) -> None:
         self.event(obj, WARNING, reason, message, **kw)
 
+    def scoped(self, **labels: str) -> "ScopedRecorder":
+        """A view of this recorder that stamps fixed labels on every
+        event — the sharded control plane records rebalance/handoff
+        occurrences as ``shard=<id>`` so N managers sharing one bus
+        stay attributable in a single event stream."""
+        return ScopedRecorder(self, {k: str(v) for k, v in labels.items()})
+
     def for_object(self, kind: str, namespace: str, name: str) -> list[Event]:
         with self._lock:
             return [
@@ -96,3 +103,31 @@ class EventRecorder:
     def all(self) -> list[Event]:
         with self._lock:
             return list(self._events)
+
+
+class ScopedRecorder:
+    """Label-stamping facade over an :class:`EventRecorder` (same
+    interface, shared ring buffer + dedup window). Scoped labels merge
+    under any per-call labels, so a caller can still add specifics."""
+
+    def __init__(self, recorder: EventRecorder, labels: dict[str, str]):
+        self._recorder = recorder
+        self._labels = dict(labels)
+
+    def event(
+        self,
+        obj,
+        type: str,
+        reason: str,
+        message: str,
+        labels: Optional[dict[str, str]] = None,
+    ) -> None:
+        merged = dict(self._labels)
+        merged.update(labels or {})
+        self._recorder.event(obj, type, reason, message, labels=merged)
+
+    def normal(self, obj, reason: str, message: str, **kw) -> None:
+        self.event(obj, NORMAL, reason, message, **kw)
+
+    def warning(self, obj, reason: str, message: str, **kw) -> None:
+        self.event(obj, WARNING, reason, message, **kw)

@@ -89,6 +89,11 @@ Defaulter = Callable[[Resource], None]
 Validator = Callable[[Resource, Optional[Resource]], None]  # (new, old) -> raise AdmissionDenied
 IndexFn = Callable[[Resource], list[str]]
 WatchHandler = Callable[[WatchEvent], None]
+#: per-watcher delivery predicate (sharded watch fan-out): evaluated at
+#: drain time against the committed resource; False suppresses delivery
+#: to that watcher only. MUST be cheap and read-only (it runs once per
+#: (event, watcher) on the drainer thread).
+WatchFilter = Callable[[Resource], bool]
 
 
 class ResourceStore:
@@ -98,7 +103,9 @@ class ResourceStore:
         self._lock = threading.RLock()
         self._objects: dict[tuple[str, str, str], Resource] = {}
         self._rv_counter = 0
-        self._watchers: list[tuple[Optional[frozenset[str]], WatchHandler]] = []
+        self._watchers: list[
+            tuple[Optional[frozenset[str]], Optional[WatchFilter], WatchHandler]
+        ] = []
         self._indexes: dict[tuple[str, str], IndexFn] = {}
         # (kind, index_name) -> value -> set of object keys; maintained at
         # commit time so index lookups are O(bucket), not O(all of kind)
@@ -108,6 +115,11 @@ class ResourceStore:
         self._status_validators: dict[str, list[Validator]] = {}
         self._pending_events: deque[WatchEvent] = deque()
         self._draining = False
+        #: default delivery predicate baked into subscriptions made
+        #: while it is set (see set_watch_filter) — the seam that lets a
+        #: sharded Runtime partition EVERY watch its components register
+        #: without threading a filter through each call site
+        self._default_watch_filter: Optional[WatchFilter] = None
         self._persist_dir = persist_dir
         if persist_dir:
             os.makedirs(persist_dir, exist_ok=True)
@@ -174,9 +186,22 @@ class ResourceStore:
                         bucket.pop(value, None)
 
     # -- watch -------------------------------------------------------------
-    def watch(self, handler: WatchHandler, kinds: Optional[Iterable[str]] = None) -> Callable[[], None]:
-        """Subscribe to committed writes; returns an unsubscribe callable."""
-        entry = (frozenset(kinds) if kinds is not None else None, handler)
+    def watch(
+        self,
+        handler: WatchHandler,
+        kinds: Optional[Iterable[str]] = None,
+        filter: Optional[WatchFilter] = None,
+    ) -> Callable[[], None]:
+        """Subscribe to committed writes; returns an unsubscribe callable.
+
+        ``filter`` partitions the fan-out per watcher (the sharded
+        control plane's delivery seam): a manager passes its shard
+        router's ownership predicate so its dispatchers only ever see
+        events for run families it owns — the other N-1 shards' run
+        churn never reaches this subscriber's mappers at all."""
+        if filter is None:
+            filter = self._default_watch_filter
+        entry = (frozenset(kinds) if kinds is not None else None, filter, handler)
         with self._lock:
             self._watchers.append(entry)
 
@@ -186,6 +211,31 @@ class ResourceStore:
                     self._watchers.remove(entry)
 
         return cancel
+
+    def scheduling_gate(self) -> tuple[threading.Lock, dict]:
+        """The bus-wide check-then-reserve state for cross-run
+        scheduling caps (named-queue / global concurrency): ONE
+        (lock, reservations) pair per store, handed to every DAG engine
+        on this bus. Queue caps are user-facing admission invariants
+        counted over the shared store, so the check-then-reserve window
+        must serialize across ALL managers sharing the bus — N sharded
+        managers each gating under a process-local lock could admit up
+        to N-1 steps over a cap in the same instant."""
+        with self._lock:
+            if not hasattr(self, "_sched_gate"):
+                self._sched_gate = (threading.Lock(), {})
+            return self._sched_gate
+
+    def set_watch_filter(self, filter: Optional[WatchFilter]) -> None:
+        """Install (or clear, with None) the default delivery predicate
+        for subscriptions registered from now on. The binding is
+        registration-time, per watcher — a sharded Runtime brackets its
+        construction with its router's ownership predicate so all of
+        its components' watches partition, while another shard's
+        Runtime on the same store binds its own. The predicate itself
+        is evaluated per event at drain time, so ring changes apply to
+        already-bound subscriptions immediately."""
+        self._default_watch_filter = filter
 
     def _enqueue_locked(self, events: list[WatchEvent]) -> None:
         """Append committed events to the delivery FIFO.
@@ -227,17 +277,23 @@ class ResourceStore:
                 # store APIs, which copy at the write boundary. The old
                 # one-deepcopy-per-event was the bus's largest fixed cost.
                 payload = ev
-                for kinds, handler in watchers:
-                    if kinds is None or ev.resource.kind in kinds:
-                        try:
-                            handler(payload)
-                        except Exception:  # noqa: BLE001 - watcher bugs must not poison the bus
-                            _log.exception(
-                                "watch handler failed for %s %s/%s",
-                                ev.resource.kind,
-                                ev.resource.namespace,
-                                ev.resource.name,
-                            )
+                for kinds, flt, handler in watchers:
+                    if kinds is not None and ev.resource.kind not in kinds:
+                        continue
+                    try:
+                        # the filter shares the handler's failure
+                        # isolation: a broken shard predicate must not
+                        # poison delivery to the other watchers
+                        if flt is not None and not flt(ev.resource):
+                            continue
+                        handler(payload)
+                    except Exception:  # noqa: BLE001 - watcher bugs must not poison the bus
+                        _log.exception(
+                            "watch handler failed for %s %s/%s",
+                            ev.resource.kind,
+                            ev.resource.namespace,
+                            ev.resource.name,
+                        )
         except BaseException:
             # SystemExit/KeyboardInterrupt out of a handler: release the
             # drainer role so later writes resume delivery of anything

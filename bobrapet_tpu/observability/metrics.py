@@ -374,6 +374,50 @@ class _ControlPlaneMetrics:
             "Cell suspicion reports by source",
             ["source"],
         )
+        # Sharded control plane (bobrapet_tpu/shard; TPU-native addition —
+        # the reference is deliberately single-active-manager, see
+        # internal/config/operator.go; this is the scale-out past it)
+        self.shard_owned_runs = g(
+            "bobrapet_shard_owned_runs",
+            "Resident StoryRuns this shard owns under the active map "
+            "(refreshed at rebalance barriers)",
+            ["shard"],
+        )
+        self.shard_map_epoch = g(
+            "bobrapet_shard_map_epoch",
+            "Shard-map epoch each manager has promoted to active "
+            "(divergence across shards = a rebalance in flight)",
+            ["shard"],
+        )
+        self.shard_rebalances = c(
+            "bobrapet_shard_rebalances_total",
+            "Rebalance barriers completed, by membership delta",
+            ["shard", "delta"],
+        )
+        self.shard_rebalance_seconds = h(
+            "bobrapet_shard_rebalance_seconds",
+            "Map observed to barrier cleared (drain + all-member acks)",
+            ["shard"],
+        )
+        self.shard_handoffs = c(
+            "bobrapet_shard_handoffs_total",
+            "Cross-shard handoffs accepted by this shard (child "
+            "StoryRuns created by a parent another shard owns)",
+            ["shard"],
+        )
+        self.shard_parked_keys = g(
+            "bobrapet_shard_parked_keys",
+            "Reconcile keys parked awaiting a rebalance barrier "
+            "(gained families stay untouched until the old owner drains)",
+            ["controller"],
+        )
+        self.shard_self_fenced = c(
+            "bobrapet_shard_self_fenced_total",
+            "Keys parked by the self-fence: this member's renewal went "
+            "stale past member-ttl/2, so it stopped family work rather "
+            "than risk overlapping a survivor's takeover",
+            ["shard"],
+        )
         # Transport family (reference: pkg/metrics/transport.go:11-35)
         self.binding_ops = c(
             "bobrapet_transport_binding_ops_total", "Binding create/update ops", ["op"]

@@ -21,6 +21,7 @@ Public API::
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Optional
 
 from .api.catalog import (
@@ -88,6 +89,11 @@ class Runtime:
         enable_webhooks: bool = True,
         tracer=None,
         preemption_injector=None,
+        store: Optional[ResourceStore] = None,
+        shard_id: Optional[str] = None,
+        shard_count: Optional[int] = None,
+        recorder: Optional[EventRecorder] = None,
+        shard_options: Optional[dict] = None,
     ):
         self.clock = clock or ManualClock()
         # an explicitly injected tracer keeps its own enabled flag; only
@@ -96,10 +102,49 @@ class Runtime:
         if tracer is None:
             from .observability.tracing import TRACER as tracer
         self.tracer = tracer
-        self.store = ResourceStore(persist_dir=persist_dir)
-        self.recorder = EventRecorder()
+        # a shared store = N managers on one coordination bus (the
+        # sharded control plane, bobrapet_tpu/shard); admission/index
+        # registration on it is idempotent, webhooks are per-store
+        # (enable them on the first Runtime only)
+        self.store = store if store is not None else ResourceStore(persist_dir=persist_dir)
+        self.recorder = recorder if recorder is not None else EventRecorder()
         self.config_manager = OperatorConfigManager(self.store, namespace=config_namespace)
         cfg = self.config_manager.config
+
+        # -- sharding identity (bobrapet_tpu/shard) -----------------------
+        # enabled by an explicit shard_id (harness / BOBRA_SHARD_ID) or a
+        # configured controllers.shard-count > 1; shard-id is normally
+        # per-process (the ConfigMap is shared by every replica)
+        env_sid = os.environ.get("BOBRA_SHARD_ID")
+        count = int(shard_count if shard_count is not None
+                    else cfg.controllers.shard_count)
+        self.shard_router = None
+        self.shard_coordinator = None
+        if shard_id is not None or env_sid is not None or count > 1:
+            from .shard import ShardRouter
+            from .shard.ring import DEFAULT_VNODES
+
+            sid = (shard_id if shard_id is not None
+                   else env_sid if env_sid is not None
+                   else cfg.controllers.shard_id)
+            opts = dict(shard_options or {})
+            # reject typos BEFORE the watch-filter bracket opens: a
+            # TypeError out of ShardCoordinator(**opts) further down
+            # would leave this shard's predicate installed as the
+            # store's default and poison the next Runtime's watchers
+            unknown = set(opts) - {"heartbeat_interval", "member_ttl",
+                                   "lease_duration", "vnodes",
+                                   "resync_every", "namespace"}
+            if unknown:
+                raise TypeError(f"unknown shard_options: {sorted(unknown)}")
+            self.shard_router = ShardRouter(
+                self.store, str(sid), shard_count=max(1, count),
+                vnodes=opts.get("vnodes", DEFAULT_VNODES),
+            )
+            # every subscription registered below (controller watches,
+            # executors, fleet, slice release) binds this shard's
+            # ownership predicate; non-family kinds broadcast through it
+            self.store.set_watch_filter(self.shard_router.wants)
         self.evaluator = Evaluator(
             TemplateConfig(
                 evaluation_timeout=cfg.templating.evaluation_timeout,
@@ -255,6 +300,27 @@ class Runtime:
             self.workload_reconciler.attach(self.manager)
         self._register_controllers()
         self.store.watch(self._release_slices, kinds=[STEP_RUN_KIND])
+        self.store.watch(self._wake_capacity_parked, kinds=[STEP_RUN_KIND])
+        if self.shard_router is not None:
+            from .shard import ShardCoordinator
+
+            # shard-local global concurrency cap: this manager's
+            # scheduling budget counts only families it owns
+            self.dag.owned_filter = self.shard_router.owns_resource
+            try:
+                self.shard_coordinator = ShardCoordinator(
+                    self.store, self.shard_router, self.manager,
+                    recorder=self.recorder.scoped(shard=self.shard_router.me),
+                    clock=self.clock,
+                    **{k: v for k, v in (shard_options or {}).items()},
+                )
+                self.manager.reconcile_gate = self.shard_coordinator.gate
+                self.shard_coordinator.register()
+            finally:
+                # construction bracket closes even on failure: later
+                # Runtimes on this store bind their OWN router as the
+                # default filter, never a dead shard's predicate
+                self.store.set_watch_filter(None)
         if self.cr_syncer is not None:
             # list-based catch-up AFTER controller registration so
             # cluster objects that predate this manager fire watch
@@ -275,6 +341,18 @@ class Runtime:
     def _on_config_change(self, cfg) -> None:
         self.resolver.operator_config = cfg
         self._apply_observability_toggles(cfg)
+        # controllers.shard-count live-reload: only effective while the
+        # fleet is still on the epoch-0 bootstrap ring — once a leader
+        # has published a ShardMap, dynamic membership (heartbeats +
+        # fenced publishes) is authoritative and the static count is
+        # just the expected fleet size
+        if self.shard_router is not None:
+            if self.shard_router.set_bootstrap_count(cfg.controllers.shard_count):
+                _log.info(
+                    "shard %s: bootstrap ring resized to %d members "
+                    "(controllers.shard-count reload)",
+                    self.shard_router.me, cfg.controllers.shard_count,
+                )
         self.evaluator.config.evaluation_timeout = cfg.templating.evaluation_timeout
         self.evaluator.config.max_output_bytes = cfg.templating.max_output_bytes
         self.evaluator.config.deterministic = cfg.templating.deterministic
@@ -746,6 +824,20 @@ class Runtime:
                 except Exception:  # noqa: BLE001
                     pass
 
+    def _wake_capacity_parked(self, ev: WatchEvent) -> None:
+        """Event-driven slot refill: a StepRun leaving the active set
+        (terminal or deleted) frees queue/global-cap/slice capacity, so
+        runs parked behind those gates are requeued NOW instead of
+        waiting out scheduling.queue-probe-interval. Under the sharded
+        watch filter each manager only sees its own families' StepRun
+        events, so every shard refills exactly its own parked runs."""
+        if ev.type != DELETED:
+            phase = ev.resource.status.get("phase")
+            if not (phase and Phase(phase).is_terminal):
+                return
+        for ns, name in self.dag.wake_capacity_parked():
+            self.manager.enqueue("storyrun", ns, name)
+
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
@@ -789,6 +881,10 @@ class Runtime:
 
     def stop(self) -> None:
         self.manager.stop()
+        if self.shard_coordinator is not None:
+            # releases the shard-leader lease so a surviving replica
+            # takes over without waiting out the TTL
+            self.shard_coordinator.stop()
         if self.cr_syncer is not None:
             self.cr_syncer.close()
         if self.cluster is not None and hasattr(self.cluster, "close"):

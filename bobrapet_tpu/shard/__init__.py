@@ -1,0 +1,56 @@
+"""Sharded control plane: hash-ring run ownership across N managers.
+
+The deliberate step PAST the reference's single-active-manager shape
+(reference: internal/config/operator.go — one controller-runtime
+process, leader-elected active/standby): N cooperating managers share
+one coordination bus and own **disjoint hash-ring ranges of run keys**,
+so watch fan-out, dispatcher queues, and reconcile work all partition.
+
+Pieces (each its own module, composable without the others):
+
+- :mod:`ring` — consistent hashing with stable virtual nodes
+  (``utils/hashing.stable_uint64``; minimal key movement on membership
+  change).
+- :mod:`map` — the ShardMap bus resource: leader-published membership +
+  epoch, admission-fenced against stale leaders
+  (``utils/leader.py`` fencing tokens).
+- :mod:`router` — per-manager ownership decisions: run-family root
+  resolution for watch delivery, reconcile-key classification
+  (own/park/drop) for the dispatcher gate, rebalance state.
+- :mod:`coordinator` — the "shard" controller each manager runs:
+  leader election + map publish, drain-and-ack barrier on membership
+  change, parked-key release, handoff accounting.
+- :mod:`detector` — test-support double-reconcile detector (no run may
+  be processed by two shards).
+- :mod:`harness` — N in-process Runtimes over one bus for tests/bench.
+"""
+
+from .coordinator import SHARD_CONTROLLER, ShardCoordinator
+from .detector import DoubleReconcileDetector
+from .harness import ShardedControlPlane
+from .map import (
+    SHARD_MAP_KIND,
+    SHARD_MAP_NAME,
+    SHARD_NAMESPACE,
+    ShardMapPublisher,
+    register_shard_admission,
+)
+from .ring import HashRing
+from .router import ADMIT_DROP, ADMIT_OWN, ADMIT_PARK, ShardRouter
+
+__all__ = [
+    "ADMIT_DROP",
+    "ADMIT_OWN",
+    "ADMIT_PARK",
+    "DoubleReconcileDetector",
+    "HashRing",
+    "SHARD_CONTROLLER",
+    "SHARD_MAP_KIND",
+    "SHARD_MAP_NAME",
+    "SHARD_NAMESPACE",
+    "ShardCoordinator",
+    "ShardMapPublisher",
+    "ShardRouter",
+    "ShardedControlPlane",
+    "register_shard_admission",
+]

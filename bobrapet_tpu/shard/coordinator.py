@@ -1,0 +1,615 @@
+"""The "shard" controller each manager runs: membership + rebalance.
+
+One reconcile key (``bobrapet-system/shard-map``) drives a small state
+machine on every manager, at ``heartbeat_interval`` cadence and on any
+ShardMap/ShardMember event:
+
+1. **membership heartbeat** — renew this shard's ShardMember resource
+   (leaving members keep renewing, flagged ``leaving``, until retired —
+   they must stay ack-capable through the barrier). Renewals run on a
+   **dedicated thread**, not just the dispatcher: a flooded queue would
+   starve the beat past ``member_ttl`` and the leader would declare a
+   live member dead (measured as real double-reconciles in the churn
+   soak). The member-side half of that contract is the **self-fence**:
+   when this member's own renewal goes stale past ``member_ttl / 2``
+   the gate parks all family work until a renewal lands — so by the
+   time survivors may promote past a presumed-dead member (one full
+   TTL), it has refused new work for at least half of it. Non-overlap
+   is therefore guaranteed for reconciles shorter than
+   ``member_ttl / 2``; size the TTL accordingly;
+2. **leader election** — a fenced ``shard-leader`` lease
+   (``utils/leader.py``); the holder publishes a new ShardMap whenever
+   the alive-member set differs from the published one (join, leave,
+   heartbeat expiry — crash detection is just lease-style TTL on the
+   member resources);
+3. **rebalance barrier** — on observing a newer map epoch every member
+   installs it as the router's pending ring, finishes in-flight
+   reconciles for families it is losing (the dispatcher gate already
+   refuses NEW work for them), then acks ``status.acks[shard] = epoch``.
+   When every required member (new members + old members still alive)
+   has acked, each member independently promotes pending -> active,
+   releases parked keys, and resyncs the families it gained — so a run
+   that went quiet mid-handoff is picked up without an event. No run is
+   ever reconciled by two shards: the loser drains before the ack, the
+   gainer parks until the promote (tests assert this with
+   :class:`~bobrapet_tpu.shard.detector.DoubleReconcileDetector`).
+
+The reference has nothing to compare against here — its operator shape
+is deliberately single-active (internal/config/operator.go); this is
+the scale-out past it (ROADMAP item 1).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..api.enums import is_nonterminal_phase
+from ..api.runs import STEP_RUN_KIND, STORY_RUN_KIND
+from ..core.store import AlreadyExists, Conflict, NotFound, ResourceStore
+from ..observability.metrics import metrics
+from ..utils.leader import LeaseLeaderElector
+from .map import (
+    SHARD_LEASE_NAME,
+    SHARD_MAP_KIND,
+    SHARD_MAP_NAME,
+    SHARD_MEMBER_KIND,
+    SHARD_NAMESPACE,
+    ShardMapPublisher,
+    make_member,
+    map_epoch,
+    map_members,
+    register_shard_admission,
+)
+from .ring import DEFAULT_VNODES
+from .router import (
+    ADMIT_OWN,
+    ADMIT_PARK,
+    LABEL_STORY_RUN,
+    _AUX_CONTROLLER_KIND,
+    _DEF_CONTROLLER_KIND,
+    ShardRouter,
+)
+
+_log = logging.getLogger(__name__)
+
+SHARD_CONTROLLER = "shard"
+
+
+class ShardCoordinator:
+    """Runs inside one manager process; see module docstring."""
+
+    def __init__(
+        self,
+        store: ResourceStore,
+        router: ShardRouter,
+        manager,
+        recorder=None,
+        clock=None,
+        namespace: str = SHARD_NAMESPACE,
+        heartbeat_interval: float = 2.0,
+        member_ttl: float = 6.0,
+        lease_duration: float = 10.0,
+        resync_every: int = 10,
+        vnodes: int = DEFAULT_VNODES,
+    ):
+        self.store = store
+        self.router = router
+        self.manager = manager
+        self.recorder = recorder
+        self.clock = clock or manager.clock
+        self.namespace = namespace
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.member_ttl = float(member_ttl)
+        self.resync_every = max(1, int(resync_every))
+        #: parked keys re-probe the gate at this cadence while a
+        #: barrier is in flight
+        self.park_delay = min(0.1, self.heartbeat_interval / 2)
+        self.elector = LeaseLeaderElector(
+            store,
+            name=SHARD_LEASE_NAME,
+            namespace=namespace,
+            lease_duration=lease_duration,
+            identity=f"shard-{router.me}",
+            clock=self.clock,
+        )
+        self.publisher = ShardMapPublisher(
+            store, self.elector, namespace=namespace, vnodes=vnodes
+        )
+        register_shard_admission(store, namespace=namespace)
+        self._leaving = False
+        self._retired = False
+        self._acked_epoch = 0
+        self._tick = 0
+        #: gauge labels set by the last _update_parked_gauge pass
+        self._parked_labels: set[str] = set()
+        #: last wall-clock write of the member/lease heartbeats. Event-
+        #: triggered reconciles (map changes, member joins) run the
+        #: read-only state machine at full cadence but must NOT write a
+        #: heartbeat each time: a renewal is itself a bus event that
+        #: wakes every other coordinator, and unthrottled that feedback
+        #: loop saturates the store with renewals (measured: it starved
+        #: coordinator ticks past member_ttl and caused false deaths)
+        self._last_beat = float("-inf")
+        #: membership heartbeats CANNOT ride the dispatcher alone: the
+        #: shard controller's reconcile competes with run work, and a
+        #: flooded queue starves the renewal past member_ttl — the
+        #: leader then declares a live member dead, promotes without
+        #: its ack, and two shards reconcile one family (measured: 116
+        #: double-reconciles in the churn soak). A dedicated renewal
+        #: thread (started in register, kube leader-election's own
+        #: shape) keeps liveness independent of dispatch latency; the
+        #: reconcile's opportunistic beat stays as a cheap backstop.
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        #: last renewal that REACHED the bus; the self-fence reads this
+        self._last_renew_ok = self.clock.now()
+        #: self-fence margin: past this renewal staleness the leader
+        #: may declare us dead at any moment, so the gate parks all
+        #: family work (we cannot assume we still own anything). Half
+        #: the TTL leaves the other half for in-flight reconciles to
+        #: finish before survivors can promote past us — non-overlap is
+        #: guaranteed for reconciles shorter than member_ttl/2.
+        self._fence_after = self.member_ttl / 2
+
+    # -- wiring ------------------------------------------------------------
+    def register(self) -> None:
+        """Register the shard controller + the handoff observer on the
+        manager this coordinator serves."""
+
+        def to_map_key(ev):
+            return [(self.namespace, SHARD_MAP_NAME)]
+
+        def member_to_map_key(ev):
+            # membership CHANGES matter immediately (join/crash cleanup);
+            # renew-only MODIFIED events are other coordinators'
+            # heartbeats — reacting to each would couple every
+            # coordinator to every other's cadence (liveness expiry is
+            # caught by this controller's own timed requeue)
+            if ev.type == "MODIFIED":
+                return []
+            return [(self.namespace, SHARD_MAP_NAME)]
+
+        self.manager.register(
+            SHARD_CONTROLLER,
+            self.reconcile,
+            watches={SHARD_MAP_KIND: to_map_key,
+                     SHARD_MEMBER_KIND: member_to_map_key},
+            max_concurrent=1,
+        )
+        self.store.watch(self._on_storyrun_added, kinds=[STORY_RUN_KIND])
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop,
+            name=f"shard-{self.router.me}-heartbeat",
+            daemon=True,
+        )
+        self._hb_thread.start()
+        self.kick()
+
+    def kick(self) -> None:
+        self.manager.enqueue(SHARD_CONTROLLER, self.namespace, SHARD_MAP_NAME)
+
+    # -- the dispatcher gate ----------------------------------------------
+    def gate(self, controller: str, ns: str, name: str) -> Optional[float]:
+        """controllers/manager.py reconcile_gate: None = run it,
+        >= 0 = park (requeue after that delay), < 0 = drop."""
+        verdict, root = self.router.classify(controller, ns, name)
+        key = (controller, ns, name)
+        if root is not None and self._self_fenced():
+            # renewal stale past the safety margin: the leader may have
+            # already declared us dead and handed our families to
+            # survivors — starting work now risks the double-reconcile
+            # the barrier exists to prevent. Park until a renewal lands.
+            if key not in self.router.parked:
+                self.router.parked.add(key)
+                self._update_parked_gauge()
+                metrics.shard_self_fenced.inc(self.router.me)
+            return self.park_delay
+        if verdict == ADMIT_OWN:
+            if key in self.router.parked:
+                # released from a self-fence (barrier parks are cleared
+                # wholesale at promote) — drop the gauge entry
+                self.router.parked.discard(key)
+                self._update_parked_gauge()
+            return None
+        if verdict == ADMIT_PARK:
+            if key not in self.router.parked:
+                self.router.parked.add(key)
+                self._update_parked_gauge()
+            return self.park_delay
+        if key in self.router.parked:
+            self.router.parked.discard(key)
+            self._update_parked_gauge()
+        return -1.0
+
+    def _update_parked_gauge(self) -> None:
+        counts: dict[str, int] = {}
+        for controller, _ns, _name in tuple(self.router.parked):
+            counts[controller] = counts.get(controller, 0) + 1
+        # zero labels that emptied, or the gauge would read "parked"
+        # forever after the barrier clears
+        for stale in self._parked_labels - counts.keys():
+            metrics.shard_parked_keys.set(0, stale)
+        for controller, n in counts.items():
+            metrics.shard_parked_keys.set(n, controller)
+        self._parked_labels = set(counts)
+
+    # -- cross-shard handoff accounting -----------------------------------
+    def _on_storyrun_added(self, ev) -> None:
+        # the store's default filter already scopes this to families we
+        # have an interest in; count the ones we OWN whose parent lives
+        # on another shard — an accepted executeStory handoff
+        if ev.type != "ADDED":
+            return
+        r = ev.resource
+        parent = r.meta.labels.get(LABEL_STORY_RUN)
+        if not parent:
+            return
+        ns = r.meta.namespace
+        if not self.router.owns_run(ns, r.meta.name):
+            return
+        if self.router.owner_of(f"{ns}/{parent}") == self.router.me:
+            return
+        metrics.shard_handoffs.inc(self.router.me)
+        if self.recorder is not None:
+            self.recorder.normal(
+                r, "CrossShardHandoff",
+                f"child of {parent} (shard "
+                f"{self.router.owner_of(f'{ns}/{parent}')}) accepted",
+            )
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def retired(self) -> bool:
+        return self._retired
+
+    def request_leave(self) -> None:
+        """Graceful leave: flag the member resource so the leader
+        republishes without us; this coordinator keeps heartbeating and
+        acking until the barrier that removes it clears."""
+        self._leaving = True
+        self.kick()
+
+    def crash(self) -> None:
+        """Test support: die WITHOUT releasing the lease or the member
+        — the abrupt death the TTL-expiry and stale-leader fencing
+        paths exist for. A subsequent stop() releases nothing (a
+        crashed process cannot run cleanup)."""
+        self._crashed = True
+        self._hb_stop.set()
+        if (self._hb_thread is not None
+                and self._hb_thread is not threading.current_thread()):
+            self._hb_thread.join(timeout=5.0)
+
+    def stop(self) -> None:
+        if getattr(self, "_crashed", False):
+            return
+        # the renewal thread must die with the runtime, or a "crashed"
+        # shard would keep its member resource fresh forever and the
+        # leader could never detect the death. JOIN it before releasing
+        # the lease: an in-flight _beat -> elector.heartbeat() landing
+        # after the release would steal the lease straight back and
+        # leave this dead process as leaseholder for a full TTL.
+        self._hb_stop.set()
+        if (self._hb_thread is not None
+                and self._hb_thread is not threading.current_thread()):
+            self._hb_thread.join(timeout=5.0)
+        self.elector.release()
+
+    # -- the reconcile -----------------------------------------------------
+    def reconcile(self, ns: str, name: str) -> Optional[float]:
+        now = self.clock.now()
+        self._tick += 1
+        # write-side heartbeats at their own cadence only (see
+        # _last_beat); event-triggered runs are read-mostly. The
+        # dedicated renewal thread is the primary beat — this is the
+        # backstop for clock shapes with no live thread (ManualClock
+        # pumps drive time through reconciles alone).
+        if now - self._last_beat >= self.heartbeat_interval * 0.5:
+            self._beat(now)
+        if self.elector.is_leader:
+            self._leader_duties(now)
+        self._observe_map(now)
+        if self.router.rebalancing:
+            self._advance_barrier(now)
+        else:
+            self._confirm_promoted()
+            if self._tick % self.resync_every == 0:
+                self._resync_definitions()
+                self._refresh_owned_gauge()
+        if self._retired:
+            return None  # nothing left to coordinate; stop requeueing
+        return self.heartbeat_interval
+
+    # -- membership --------------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        """Dedicated renewal thread (see __init__): member + lease
+        heartbeats at half cadence, never queued behind run work."""
+        while not self._hb_stop.wait(self.heartbeat_interval * 0.5):
+            if self._retired:
+                return
+            try:
+                self._beat(self.clock.now())
+            except Exception:  # noqa: BLE001 - liveness must survive transient bus errors
+                _log.exception("shard %s heartbeat failed", self.router.me)
+
+    def _beat(self, now: float) -> None:
+        """One member-renew + lease-heartbeat pass. Deliberately
+        lock-free: the renewal thread and the reconcile backstop may
+        overlap, but each store write is individually atomic and both
+        writers renew the SAME member with near-identical timestamps —
+        the retire race is handled by joining the thread instead
+        (:meth:`_retire`), so no lock spans a bus call."""
+        if self._retired:
+            return
+        self._last_beat = now
+        self._heartbeat_member(now)
+        self.elector.heartbeat()
+
+    def _self_fenced(self) -> bool:
+        """True when this member's last SUCCESSFUL renewal is stale
+        past the safety margin — the member-side half of the fencing
+        contract (a paused-then-resumed manager must not touch family
+        work until it has proven it is still in the map)."""
+        if self._retired:
+            return False
+        return self.clock.now() - self._last_renew_ok > self._fence_after
+
+    def _heartbeat_member(self, now: float) -> None:
+        me = self.router.me
+
+        def renew(r) -> None:
+            r.spec["renewTime"] = now
+            if self._leaving:
+                r.spec["leaving"] = True
+
+        try:
+            self.store.mutate(SHARD_MEMBER_KIND, self.namespace, me, renew)
+        except NotFound:
+            member = make_member(me, now, self.namespace)
+            if self._leaving:
+                member.spec["leaving"] = True
+            try:
+                self.store.create(member)
+            except AlreadyExists:
+                return  # another writer holds our name; retry next beat
+        except Conflict:
+            return  # next beat renews
+        self._last_renew_ok = now
+
+    def _alive_members(self, now: float) -> dict[str, dict]:
+        """shard id -> member spec for members with a fresh heartbeat
+        (self is always alive)."""
+        out: dict[str, dict] = {}
+        for m in self.store.list_views(SHARD_MEMBER_KIND, self.namespace):
+            renew = float(m.spec.get("renewTime") or 0.0)
+            if m.meta.name == self.router.me or renew + self.member_ttl >= now:
+                out[m.meta.name] = m.spec
+        return out
+
+    def _leader_duties(self, now: float) -> None:
+        alive = self._alive_members(now)
+        desired = sorted(
+            mid for mid, spec in alive.items() if not spec.get("leaving")
+        )
+        if not desired:
+            return
+        current = self.store.try_get_view(
+            SHARD_MAP_KIND, self.namespace, SHARD_MAP_NAME
+        )
+        if current is not None and map_members(current) == desired:
+            return
+        if current is None and self._tick < 2:
+            # first-publish grace: peers started in the same instant may
+            # not have heartbeated yet — publishing a solo map now would
+            # churn a shrink+grow rebalance pair for nothing
+            return
+        if current is not None:
+            # serialize rebalances: publishing epoch N+1 while N's
+            # barrier is still in flight lets members straddle THREE
+            # rings (a laggard's active N-1 + pending N+1 vs a fast
+            # peer's active N), and the two-ring own/park/drop gate is
+            # only sound pairwise — measured as real double-reconciles
+            # when a join+leave pair made ring N+1 == ring N-1. Wait
+            # until every ALIVE member has promoted the current epoch
+            # (crashed members are exempt, or a death would wedge the
+            # map forever).
+            epoch = map_epoch(current)
+            promoted = current.status.get("promoted") or {}
+            if any(int(promoted.get(mid) or 0) < epoch for mid in alive):
+                return
+        published = self.publisher.publish(desired)
+        if published is not None:
+            _log.info(
+                "shard leader %s published map epoch %s members %s",
+                self.router.me, map_epoch(published), desired,
+            )
+            if self.recorder is not None:
+                self.recorder.normal(
+                    published, "ShardMapPublished",
+                    f"epoch {map_epoch(published)}: {','.join(desired)}",
+                )
+
+    # -- rebalance ---------------------------------------------------------
+    def _observe_map(self, now: float) -> None:
+        m = self.store.try_get_view(SHARD_MAP_KIND, self.namespace, SHARD_MAP_NAME)
+        if m is None:
+            return
+        epoch = map_epoch(m)
+        if epoch > max(self.router.active_epoch, self.router.pending_epoch):
+            self.router.begin_rebalance(
+                map_members(m), epoch, now,
+                vnodes=int(m.spec.get("vnodes") or 0) or None,
+            )
+
+    def _advance_barrier(self, now: float) -> None:
+        epoch = self.router.pending_epoch
+        if self._acked_epoch < epoch:
+            if self._draining():
+                return  # in-flight losing reconciles; re-check next tick
+            try:
+                self.store.patch_status(
+                    SHARD_MAP_KIND, self.namespace, SHARD_MAP_NAME,
+                    lambda s: s.setdefault("acks", {}).__setitem__(
+                        self.router.me, epoch
+                    ),
+                )
+            except (Conflict, NotFound):
+                return
+            self._acked_epoch = epoch
+        m = self.store.try_get_view(SHARD_MAP_KIND, self.namespace, SHARD_MAP_NAME)
+        if m is None or map_epoch(m) != epoch:
+            return
+        acks = m.status.get("acks") or {}
+        alive = self._alive_members(now)
+        active, pending = self.router.rings()
+        # only ALIVE members owe an ack: a member that crashes mid-
+        # barrier (even a joiner named in the pending map) must not
+        # wedge the promote — the leader's next map removes it
+        required = {
+            mid
+            for mid in set(pending.members) | set(active.members)
+            if mid in alive
+        }
+        if any(int(acks.get(mid) or 0) < epoch for mid in required):
+            return
+        old_n, new_n, started = self.router.promote()
+        # the gauge means "epoch this manager has PROMOTED to active"
+        # (divergence across shards = a barrier in flight) — setting it
+        # at observe time would hide exactly the stall it exists to show
+        metrics.shard_map_epoch.set(epoch, self.router.me)
+        try:
+            # publish the promote so the leader can serialize barriers
+            # (no new epoch until every alive member runs ring `epoch`)
+            self.store.patch_status(
+                SHARD_MAP_KIND, self.namespace, SHARD_MAP_NAME,
+                lambda s: s.setdefault("promoted", {}).__setitem__(
+                    self.router.me, epoch
+                ),
+            )
+        except (Conflict, NotFound):
+            pass  # the heartbeat-cadence requeue retries via _observe_map
+        delta = new_n - old_n
+        metrics.shard_rebalances.inc(self.router.me, f"{delta:+d}")
+        if started is not None:
+            metrics.shard_rebalance_seconds.observe(
+                max(0.0, now - started), self.router.me
+            )
+        self._update_parked_gauge()
+        _log.info(
+            "shard %s promoted map epoch %s (%d -> %d members)",
+            self.router.me, epoch, old_n, new_n,
+        )
+        if self.recorder is not None:
+            self.recorder.normal(
+                m, "ShardRebalanced",
+                f"epoch {epoch} active ({old_n} -> {new_n} members)",
+            )
+        if self.router.me not in self.router.members():
+            if self._leaving:
+                self._retire()
+            # else: excluded without asking (a heartbeat raced the
+            # leader's publish, or a partition healed) — keep
+            # heartbeating; the leader re-adds us next duty cycle
+        else:
+            self._resync_owned()
+            self._refresh_owned_gauge()
+
+    def _confirm_promoted(self) -> None:
+        """Idempotent catch-up for the post-promote ``status.promoted``
+        write (a Conflict there must not wedge the leader's barrier
+        serialization): re-patch whenever the bus record lags this
+        member's active epoch."""
+        if self._retired:
+            return
+        m = self.store.try_get_view(
+            SHARD_MAP_KIND, self.namespace, SHARD_MAP_NAME
+        )
+        if m is None or map_epoch(m) != self.router.active_epoch:
+            return
+        promoted = m.status.get("promoted") or {}
+        epoch = self.router.active_epoch
+        if int(promoted.get(self.router.me) or 0) >= epoch:
+            return
+        try:
+            self.store.patch_status(
+                SHARD_MAP_KIND, self.namespace, SHARD_MAP_NAME,
+                lambda s: s.setdefault("promoted", {}).__setitem__(
+                    self.router.me, epoch
+                ),
+            )
+        except (Conflict, NotFound):
+            pass  # next tick retries
+
+    def _draining(self) -> bool:
+        """Any in-flight reconcile for a family this shard is losing?"""
+        active, pending = self.router.rings()
+        if pending is None:
+            return False
+        for controller, ns, name in self.manager.active_keys():
+            if controller == SHARD_CONTROLLER:
+                continue
+            root = self.router.root_for(controller, ns, name)
+            if root is None:
+                continue
+            if (active.owner(root) == self.router.me
+                    and pending.owner(root) != self.router.me):
+                return True
+        return False
+
+    def _retire(self) -> None:
+        # stop and JOIN the renewal thread before the member delete —
+        # a beat landing after it would resurrect the member as a
+        # zombie until TTL expiry
+        self._retired = True
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5.0)
+        try:
+            self.store.delete(SHARD_MEMBER_KIND, self.namespace, self.router.me)
+        except NotFound:
+            pass
+        self.stop()
+        _log.info("shard %s retired (left the ring)", self.router.me)
+
+    # -- resync ------------------------------------------------------------
+    def _resync_owned(self) -> None:
+        """Post-promote: enqueue every non-terminal run family this
+        shard now owns — a run handed over mid-flight may produce no
+        further events on its own."""
+        for run in self.store.list_views(STORY_RUN_KIND):
+            ns, rn = run.meta.namespace, run.meta.name
+            if not self.router.owns_run(ns, rn):
+                continue
+            if is_nonterminal_phase(run.status.get("phase"), empty_is_active=True):
+                self.manager.enqueue("storyrun", ns, rn)
+        for sr in self.store.list_views(STEP_RUN_KIND):
+            run = (sr.spec.get("storyRunRef") or {}).get("name")
+            if not run or not self.router.owns_run(sr.meta.namespace, run):
+                continue
+            if is_nonterminal_phase(sr.status.get("phase"), empty_is_active=True):
+                self.manager.enqueue("steprun", sr.meta.namespace, sr.meta.name)
+        self._resync_definitions()
+        for controller, kind in _AUX_CONTROLLER_KIND.items():
+            for ns, name in self.store.list_keys(kind):
+                if self.router.owns_root(f"{kind}:{ns}/{name}"):
+                    self.manager.enqueue(controller, ns, name)
+
+    def _resync_definitions(self) -> None:
+        """Definition owners no longer receive other shards' run events
+        (the mappers that would re-reconcile them fan out on run-owner
+        shards and gate-drop there), so usage counters converge by
+        periodic resync instead of per-event nudges."""
+        for controller, kind in _DEF_CONTROLLER_KIND.items():
+            for ns, name in self.store.list_keys(kind):
+                if self.router.owns_root(f"{kind}:{ns}/{name}"):
+                    self.manager.enqueue(controller, ns, name)
+
+    def _refresh_owned_gauge(self) -> None:
+        owned = sum(
+            1
+            for ns, name in self.store.list_keys(STORY_RUN_KIND)
+            if self.router.owns_run(ns, name)
+        )
+        metrics.shard_owned_runs.set(owned, self.router.me)

@@ -1,0 +1,115 @@
+"""Double-reconcile detector: no run family on two shards at once.
+
+Test/bench support (wired by the harness, importable anywhere): every
+manager's dispatcher reports reconcile start/finish through the
+``reconcile_observer`` hook (controllers/manager.py), the detector
+resolves each key to its ownership root through that shard's router,
+and a root in flight on two DIFFERENT shards simultaneously is recorded
+as a violation. Same-shard overlap (the storyrun and steprun pools both
+touching one family) is legal — keyed serialization is per controller —
+so the ledger is a per-root multiset of shards, not a single slot.
+
+This is the executable form of the rebalance contract: the loser
+drains before acking, the gainer parks until the promote, therefore the
+in-flight shard-sets never overlap across a membership change.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class Violation:
+    root: str
+    shards: tuple[str, ...]
+    controller: str
+    key: tuple[str, str]
+
+
+@dataclass
+class _InFlight:
+    #: shard id -> count of reconciles currently processing this root
+    by_shard: dict[str, int] = field(default_factory=dict)
+
+
+class DoubleReconcileDetector:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._inflight: dict[str, _InFlight] = {}
+        self.violations: list[Violation] = []
+        #: reconciles observed per shard (proof both shards did work)
+        self.processed: dict[str, int] = {}
+
+    def install(self, runtime) -> None:
+        """Attach to one manager; requires the runtime to be sharded
+        (the router resolves ownership roots)."""
+        router = runtime.shard_router
+        if router is None:
+            raise ValueError("detector requires a sharded Runtime")
+        runtime.manager.reconcile_observer = _Observer(self, router)
+
+    def assert_clean(self) -> None:
+        assert not self.violations, (
+            f"{len(self.violations)} double-reconcile violations; first: "
+            f"{self.violations[0]}"
+        )
+
+    # -- observer callbacks ------------------------------------------------
+    def _started(self, shard: str, root: Optional[str],
+                 controller: str, ns: str, name: str) -> None:
+        with self._lock:
+            self.processed[shard] = self.processed.get(shard, 0) + 1
+            if root is None:
+                return
+            entry = self._inflight.setdefault(root, _InFlight())
+            entry.by_shard[shard] = entry.by_shard.get(shard, 0) + 1
+            live = tuple(s for s, n in entry.by_shard.items() if n > 0)
+            if len(live) > 1:
+                self.violations.append(
+                    Violation(root=root, shards=live,
+                              controller=controller, key=(ns, name))
+                )
+
+    def _finished(self, shard: str, root: Optional[str]) -> None:
+        if root is None:
+            return
+        with self._lock:
+            entry = self._inflight.get(root)
+            if entry is None:
+                return
+            n = entry.by_shard.get(shard, 0) - 1
+            if n <= 0:
+                entry.by_shard.pop(shard, None)
+                if not entry.by_shard:
+                    self._inflight.pop(root, None)
+            else:
+                entry.by_shard[shard] = n
+
+
+class _Observer:
+    """Per-manager adapter: resolves roots with THAT shard's router."""
+
+    __slots__ = ("detector", "router", "_roots")
+
+    def __init__(self, detector: DoubleReconcileDetector, router):
+        self.detector = detector
+        self.router = router
+        #: root resolved at start, replayed at finish — the resource
+        #: may be deleted mid-reconcile and the finish must balance
+        self._roots: dict[tuple[str, str, str], Optional[str]] = {}
+
+    def reconcile_started(self, controller: str, ns: str, name: str) -> None:
+        # only run families carry the no-two-shards invariant; the
+        # definition/aux controllers are single-owner by the gate alone
+        root = None
+        if controller in ("storyrun", "steprun"):
+            root = self.router.root_for(controller, ns, name)
+        self._roots[(controller, ns, name)] = root
+        self.detector._started(self.router.me, root, controller, ns, name)
+
+    def reconcile_finished(self, controller: str, ns: str, name: str) -> None:
+        root = self._roots.pop((controller, ns, name), None)
+        self.detector._finished(self.router.me, root)

@@ -1,0 +1,236 @@
+"""N in-process managers over one coordination bus, for tests + bench.
+
+``ShardedControlPlane`` assembles N full Runtimes that share ONE
+ResourceStore (the bus), each with its own shard identity, router,
+coordinator, dispatcher pools, placer and executor — the in-process
+model of N manager replicas against a shared API server. Everything
+the real deployment would exercise runs for real here: fenced map
+publish, watch partitioning, the drain/ack/promote barrier, cross-shard
+``executeStory`` handoff, graceful leave and crash detection. What it
+deliberately does NOT model is GIL-free CPU parallelism — production
+runs one process per shard; this harness measures coordination
+correctness and latency-bound throughput (see docs/SCALING.md).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..api.enums import Phase
+from ..core.events import EventRecorder
+from ..core.store import ResourceStore
+from ..controllers.manager import Clock
+from .detector import DoubleReconcileDetector
+from .ring import DEFAULT_VNODES
+
+
+class ShardedControlPlane:
+    def __init__(
+        self,
+        shards: int = 2,
+        executor_mode: str = "threaded",
+        heartbeat_interval: float = 0.25,
+        member_ttl: float = 3.0,
+        lease_duration: float = 4.0,
+        vnodes: int = DEFAULT_VNODES,
+        configure: Optional[Callable] = None,
+    ):
+        from ..runtime import Runtime  # late: runtime imports this package
+
+        self._runtime_cls = Runtime
+        self.store = ResourceStore()
+        self.clock = Clock()  # real clock: shards run live, threaded
+        self.recorder = EventRecorder()
+        self.detector = DoubleReconcileDetector()
+        self.executor_mode = executor_mode
+        self._configure = configure
+        self._bootstrap_count = max(1, int(shards))
+        self._shard_options = {
+            "heartbeat_interval": heartbeat_interval,
+            "member_ttl": member_ttl,
+            "lease_duration": lease_duration,
+            "vnodes": vnodes,
+        }
+        self.runtimes: dict[str, "Runtime"] = {}
+        self._next_id = 0
+        self._started = False
+        for _ in range(self._bootstrap_count):
+            self.add_shard()
+
+    # -- membership --------------------------------------------------------
+    def add_shard(self) -> str:
+        """Create a shard runtime. Before ``start()`` this builds the
+        initial fleet; after, it is a live JOIN — the new member owns
+        nothing until the leader publishes a map including it and the
+        rebalance barrier clears."""
+        sid = str(self._next_id)
+        self._next_id += 1
+        rt = self._runtime_cls(
+            store=self.store,
+            clock=self.clock,
+            shard_id=sid,
+            # every member bootstraps the SAME epoch-0 ring (the initial
+            # fleet size): a joiner owns nothing under it, so rings
+            # agree everywhere until a published map supersedes them
+            shard_count=self._bootstrap_count,
+            recorder=self.recorder,
+            executor_mode=self.executor_mode,
+            enable_webhooks=not self.runtimes,  # admission is per-store
+            shard_options=dict(self._shard_options),
+        )
+        if self._configure is not None:
+            self._configure(rt.config_manager.config)
+        self.detector.install(rt)
+        self.runtimes[sid] = rt
+        if self._started:
+            rt.start()
+        return sid
+
+    def leave_shard(self, sid: str, timeout: float = 60.0) -> None:
+        """Graceful leave: drain, ack the removal barrier, retire."""
+        rt = self.runtimes[sid]
+        rt.shard_coordinator.request_leave()
+        self.wait_until(
+            lambda: rt.shard_coordinator.retired, timeout,
+            f"shard {sid} did not retire",
+        )
+        rt.stop()
+        del self.runtimes[sid]
+
+    def kill_shard(self, sid: str) -> None:
+        """Crash: no drain, no ack, NO graceful lease release — the
+        leader detects the stale member heartbeat and republishes
+        without it, and a crashed leader's lease must be outlived
+        (TTL expiry + fencing), never handed over."""
+        rt = self.runtimes.pop(sid)
+        rt.shard_coordinator.crash()
+        rt.stop()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._started = True
+        for rt in self.runtimes.values():
+            rt.start()
+
+    def stop(self) -> None:
+        self._started = False
+        for rt in self.runtimes.values():
+            rt.stop()
+
+    def __enter__(self) -> "ShardedControlPlane":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- convenience -------------------------------------------------------
+    @property
+    def any(self):
+        """Any live runtime (definitions/stories apply through the
+        shared bus, so the entry shard does not matter)."""
+        return next(iter(self.runtimes.values()))
+
+    def apply(self, resource):
+        return self.any.apply(resource)
+
+    def run_story(self, story: str, inputs=None, name=None,
+                  namespace: str = "default") -> str:
+        return self.any.run_story(story, inputs=inputs, name=name,
+                                  namespace=namespace)
+
+    def run_phase(self, run_name: str, namespace: str = "default"):
+        return self.any.run_phase(run_name, namespace)
+
+    def members_settled(self, expected: set[str]) -> bool:
+        """Every live router's ACTIVE ring matches ``expected`` and no
+        rebalance is in flight."""
+        for sid, rt in self.runtimes.items():
+            router = rt.shard_router
+            if set(router.members()) != expected or router.rebalancing:
+                return False
+        return True
+
+    def wait_members(self, expected: set[str], timeout: float = 30.0) -> None:
+        self.wait_until(
+            lambda: self.members_settled(expected), timeout,
+            f"rings never settled on {sorted(expected)}: "
+            f"{ {sid: rt.shard_router.members() for sid, rt in self.runtimes.items()} }",
+        )
+
+    def steady_state_steps_per_sec(
+        self,
+        story: str,
+        window: int,
+        measure_s: float = 6.0,
+        warmup_s: float = 2.5,
+        namespace: str = "default",
+        drain_timeout: float = 60.0,
+    ) -> float:
+        """Closed-loop steady-state throughput: keep ``window`` runs of
+        ``story`` outstanding, count completions inside the timed
+        window only (warmup fills the pipeline, the drain tail is
+        excluded — fixed-N soaks under-read multi-shard scaling by the
+        tail, where emptying shards idle). Drains every outstanding run
+        before returning, so the detector ledger is settled."""
+        outstanding: list[str] = []
+        submitted = done_meas = 0
+        warm_end = time.perf_counter() + warmup_s
+        t_meas0 = None
+        while True:
+            now = time.perf_counter()
+            if t_meas0 is None and now >= warm_end:
+                t_meas0 = now
+            if t_meas0 is not None and now - t_meas0 >= measure_s:
+                break
+            while len(outstanding) < window:
+                outstanding.append(self.run_story(
+                    story, inputs={"i": submitted}, namespace=namespace))
+                submitted += 1
+            still = []
+            for r in outstanding:
+                if self.run_phase(r, namespace) in (Phase.SUCCEEDED,
+                                                    Phase.FAILED):
+                    done_meas += t_meas0 is not None
+                else:
+                    still.append(r)
+            outstanding = still
+            time.sleep(0.02)
+        wall = time.perf_counter() - t_meas0
+        self.wait_runs(outstanding, timeout=drain_timeout,
+                       namespace=namespace)
+        return done_meas / wall
+
+    def wait_runs(self, runs, timeout: float = 60.0,
+                  namespace: str = "default") -> None:
+        """Wait for every run to turn terminal. Polls INCREMENTALLY at
+        a coarse interval — a tight loop re-reading the whole
+        population from the main thread convoys the store lock against
+        all N shards' workers (measured: it halves soak throughput)."""
+        remaining = set(runs)
+        deadline = time.monotonic() + timeout
+        while remaining:
+            for r in list(remaining):
+                if self.run_phase(r, namespace) in (Phase.SUCCEEDED, Phase.FAILED):
+                    remaining.discard(r)
+            if not remaining:
+                return
+            if time.monotonic() > deadline:
+                sample = [(r, self.run_phase(r, namespace))
+                          for r in list(remaining)[:5]]
+                raise AssertionError(
+                    f"{len(remaining)} runs not terminal after {timeout}s; "
+                    f"sample: {sample}"
+                )
+            time.sleep(0.1)
+
+    @staticmethod
+    def wait_until(cond: Callable[[], bool], timeout: float,
+                   message="condition not met", interval: float = 0.02) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return
+            time.sleep(interval)
+        raise AssertionError(message() if callable(message) else message)

@@ -1,0 +1,161 @@
+"""ShardMap: the leader-published membership resource on the bus.
+
+One resource (``bobrapet-system/shard-map``) carries the facts every
+manager needs to agree on ownership: the member list, a monotonically
+increasing **epoch** (one per membership change), the publisher's
+**fence token** (``utils/leader.py`` — the lease epoch minted at the
+leader's last acquisition), and the vnode count the rings are built
+with. Status carries the rebalance barrier: ``acks[shard] = epoch``
+written by each member once it has drained everything it is losing.
+
+Fencing is enforced at ADMISSION, not by publisher discipline: a
+paused-and-resumed stale leader that still believes it leads carries a
+fence token older than the lease's current epoch, and the validator
+rejects the write — the stale map loses at the bus, deterministically
+(``register_shard_admission``). ``ShardMapPublisher.publish`` also
+pre-checks ``validate_fence()`` (a fresh lease read), but that check is
+advisory; the validator is the guarantee.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable, Optional, Sequence
+
+from ..core.object import Resource, new_resource
+from ..core.store import AdmissionDenied, Conflict, NotFound, ResourceStore
+from ..utils.leader import LEASE_KIND
+from .ring import DEFAULT_VNODES
+
+_log = logging.getLogger(__name__)
+
+SHARD_NAMESPACE = "bobrapet-system"
+SHARD_MAP_KIND = "ShardMap"
+SHARD_MAP_NAME = "shard-map"
+SHARD_MEMBER_KIND = "ShardMember"
+SHARD_LEASE_NAME = "shard-leader"
+
+
+def register_shard_admission(
+    store: ResourceStore,
+    namespace: str = SHARD_NAMESPACE,
+    lease_name: str = SHARD_LEASE_NAME,
+) -> None:
+    """Install the ShardMap spec validator (idempotent per store).
+
+    Rules:
+    - ``spec.fence`` must be >= the shard-leader lease's current epoch
+      (a stale leader's token is strictly older — rejected);
+    - ``spec.epoch`` must strictly increase on any spec change;
+    - ``spec.members`` must be a non-empty list.
+    """
+    if getattr(store, "_shard_admission_registered", False):
+        return
+    store._shard_admission_registered = True  # noqa: SLF001 - own marker
+
+    def validate(new: Resource, old: Optional[Resource]) -> None:
+        spec = new.spec
+        members = spec.get("members")
+        if not members or not isinstance(members, list):
+            raise AdmissionDenied("ShardMap spec.members must be a non-empty list")
+        lease = store.try_get_view(LEASE_KIND, namespace, lease_name)
+        if lease is not None:
+            current = int(lease.spec.get("epoch") or 0)
+            fence = int(spec.get("fence") or 0)
+            if fence < current:
+                raise AdmissionDenied(
+                    f"ShardMap publish fenced out: token {fence} is older "
+                    f"than the shard-leader lease epoch {current} (stale "
+                    f"leader)"
+                )
+        if old is not None and spec != old.spec:
+            if int(spec.get("epoch") or 0) <= int(old.spec.get("epoch") or 0):
+                raise AdmissionDenied(
+                    f"ShardMap epoch must increase on membership change "
+                    f"(got {spec.get('epoch')} after {old.spec.get('epoch')})"
+                )
+
+    store.register_validator(SHARD_MAP_KIND, validate)
+
+
+class ShardMapPublisher:
+    """Leader-side publish of membership changes (fenced; see module
+    docstring). One instance per coordinator; only the elected leader's
+    calls survive admission."""
+
+    def __init__(
+        self,
+        store: ResourceStore,
+        elector,
+        namespace: str = SHARD_NAMESPACE,
+        name: str = SHARD_MAP_NAME,
+        vnodes: int = DEFAULT_VNODES,
+    ):
+        self.store = store
+        self.elector = elector
+        self.namespace = namespace
+        self.name = name
+        self.vnodes = int(vnodes)
+
+    def publish(self, members: Iterable[str]) -> Optional[Resource]:
+        """Publish ``members`` as the new map (epoch+1). Returns the
+        committed resource, or None when this publisher lost the fence
+        race (stale leader) — never raises for staleness."""
+        desired = sorted({str(m) for m in members})
+        if not desired:
+            return None
+        # advisory pre-check: a fresh lease read, not the cached
+        # is_leader flag — skips the doomed write in the common case
+        if not self.elector.validate_fence():
+            return None
+        fence = int(self.elector.fence_token)
+
+        def fill(spec: dict) -> None:
+            spec["members"] = desired
+            spec["epoch"] = int(spec.get("epoch") or 0) + 1
+            spec["fence"] = fence
+            spec["vnodes"] = self.vnodes
+            spec["publisher"] = self.elector.identity
+
+        existing = self.store.try_get(SHARD_MAP_KIND, self.namespace, self.name)
+        try:
+            if existing is None:
+                spec: dict = {}
+                fill(spec)
+                return self.store.create(
+                    new_resource(SHARD_MAP_KIND, self.name, self.namespace, spec)
+                )
+
+            def mut(r: Resource) -> None:
+                if list(r.spec.get("members") or []) == desired:
+                    return  # no-op write: mutate's patch-if-changed elides it
+                fill(r.spec)
+
+            return self.store.mutate(
+                SHARD_MAP_KIND, self.namespace, self.name, mut
+            )
+        except AdmissionDenied as e:
+            _log.warning("shard map publish fenced out: %s", e)
+            return None
+        except (Conflict, NotFound):
+            return None
+
+
+def map_members(resource: Optional[Resource]) -> list[str]:
+    if resource is None:
+        return []
+    return [str(m) for m in (resource.spec.get("members") or [])]
+
+
+def map_epoch(resource: Optional[Resource]) -> int:
+    if resource is None:
+        return 0
+    return int(resource.spec.get("epoch") or 0)
+
+
+def make_member(shard_id: str, renew_time: float,
+                namespace: str = SHARD_NAMESPACE) -> Resource:
+    return new_resource(
+        SHARD_MEMBER_KIND, str(shard_id), namespace,
+        {"renewTime": float(renew_time)},
+    )

@@ -1,0 +1,76 @@
+"""Consistent hash ring over run keys.
+
+Stable virtual-node hashing (``utils/hashing.stable_uint64`` — sha256,
+never the process-seeded ``hash()``): every manager computes the exact
+same ring from the same member list, across processes and restarts.
+Virtual nodes smooth the per-member share (64 vnodes keeps the largest/
+smallest member spread under ~1.4x at 4 members); consistent hashing
+bounds movement on membership change to ~1/N of the keyspace, which is
+what keeps a rebalance barrier short.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Iterable, Sequence
+
+from ..utils.hashing import stable_uint64
+
+DEFAULT_VNODES = 64
+
+
+class HashRing:
+    """Immutable once built; membership change = build a new ring."""
+
+    __slots__ = ("_members", "_vnodes", "_points", "_owners")
+
+    def __init__(self, members: Iterable[str], vnodes: int = DEFAULT_VNODES):
+        self._members: tuple[str, ...] = tuple(sorted({str(m) for m in members}))
+        if not self._members:
+            raise ValueError("HashRing needs at least one member")
+        self._vnodes = max(1, int(vnodes))
+        points: list[tuple[int, str]] = []
+        for member in self._members:
+            for v in range(self._vnodes):
+                points.append((stable_uint64(f"vnode:{member}:{v}"), member))
+        points.sort()
+        self._points = [p for p, _ in points]
+        self._owners = [m for _, m in points]
+
+    @property
+    def members(self) -> tuple[str, ...]:
+        return self._members
+
+    @property
+    def vnodes(self) -> int:
+        return self._vnodes
+
+    def owner(self, key: str) -> str:
+        """The member owning ``key`` (first vnode clockwise)."""
+        if len(self._members) == 1:
+            return self._members[0]
+        i = bisect.bisect_right(self._points, stable_uint64(key))
+        if i == len(self._points):
+            i = 0
+        return self._owners[i]
+
+    def owns(self, member: str, key: str) -> bool:
+        return self.owner(key) == str(member)
+
+    def moved_keys(self, other: "HashRing", keys: Sequence[str]) -> list[str]:
+        """Keys whose owner differs between this ring and ``other`` —
+        the drain set of a rebalance."""
+        return [k for k in keys if self.owner(k) != other.owner(k)]
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, HashRing)
+            and self._members == other._members
+            and self._vnodes == other._vnodes
+        )
+
+    def __hash__(self) -> int:  # pragma: no cover - set membership only
+        return hash((self._members, self._vnodes))
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"HashRing(members={list(self._members)}, vnodes={self._vnodes})"

@@ -1,0 +1,314 @@
+"""Per-manager ownership decisions over the hash ring.
+
+Three seams, one source of truth (the active/pending ring pair):
+
+- **delivery filter** (:meth:`ShardRouter.wants`) — installed as the
+  store's default watch filter, so every watch this manager's
+  components register only sees events for run families it owns (plus
+  the parent-interest edge for cross-shard ``executeStory`` children,
+  and every non-family kind: definitions, config, leases, shard
+  coordination — those broadcast).
+- **reconcile gate** (:meth:`classify`) — consulted by the dispatcher
+  before each reconcile: OWN (proceed), PARK (gaining this family in a
+  pending map; requeue until the barrier clears), DROP (another
+  shard's work — a mapper fan-out or a family this shard is losing).
+- **rebalance state** — ``begin_rebalance`` installs a pending ring
+  (keys deliver to BOTH old and new owner: the loser stops starting
+  work, the gainer parks it), ``promote`` swaps it in once the barrier
+  clears.
+
+Ownership roots:
+
+- run family — a StoryRun and every resource under it (StepRuns, Jobs,
+  realtime workloads, bindings) root at ``namespace/run-name``; a
+  sub-StoryRun roots at its OWN name (per-run sharding — that's what
+  makes cross-shard ``executeStory`` handoff exist) while its events
+  also deliver to the parent's shard so the waiting parent step
+  observes completion.
+- aux family — StoryTriggers and EffectClaims root at themselves
+  (their created runs hash independently; creation through the shared
+  store IS the handoff).
+- definitions (Story/Engram/templates/Impulse/Transport) broadcast on
+  the watch but reconcile on exactly one shard (hash of kind+key), so
+  usage counters are written by one manager — the counter+annotation
+  pair cannot be raced by two shards. Run events no longer reach the
+  definition owner's mappers from other shards, so the coordinator
+  resyncs owned definitions periodically.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..api.catalog import ENGRAM_TEMPLATE_KIND, IMPULSE_TEMPLATE_KIND
+from ..api.engram import KIND as ENGRAM_KIND
+from ..api.impulse import KIND as IMPULSE_KIND
+from ..api.runs import (
+    EFFECT_CLAIM_KIND,
+    STEP_RUN_KIND,
+    STORY_RUN_KIND,
+    STORY_TRIGGER_KIND,
+)
+from ..api.story import KIND as STORY_KIND
+from ..api.transport import TRANSPORT_BINDING_KIND, TRANSPORT_KIND
+from ..core.object import Resource
+from .ring import DEFAULT_VNODES, HashRing
+
+#: gate verdicts
+ADMIT_OWN = "own"
+ADMIT_PARK = "park"
+ADMIT_DROP = "drop"
+
+#: run-family labels (controllers/step_executor.py stamps them)
+LABEL_STORY_RUN = "bobrapet.io/story-run"
+LABEL_STEP_RUN = "bobrapet.io/step-run"
+
+#: child-workload kinds that carry the step-run label
+_STEP_OWNED_KINDS = frozenset(
+    {TRANSPORT_BINDING_KIND, "Deployment", "StatefulSet", "Service"}
+)
+
+#: controller registration name -> the definition kind it reconciles
+#: (controllers/manager.py registration names; runtime.py wiring)
+_DEF_CONTROLLER_KIND = {
+    "story": STORY_KIND,
+    "engram": ENGRAM_KIND,
+    "engramtemplate": ENGRAM_TEMPLATE_KIND,
+    "impulsetemplate": IMPULSE_TEMPLATE_KIND,
+    "impulse": IMPULSE_KIND,
+    "transport": TRANSPORT_KIND,
+}
+
+_AUX_CONTROLLER_KIND = {
+    "storytrigger": STORY_TRIGGER_KIND,
+    "effectclaim": EFFECT_CLAIM_KIND,
+}
+
+DEFINITION_KINDS = frozenset(_DEF_CONTROLLER_KIND.values())
+
+
+class ShardRouter:
+    """One per manager process; thread-safe (ring swaps under a lock,
+    reads take an immutable snapshot)."""
+
+    def __init__(
+        self,
+        store,
+        shard_id: str,
+        shard_count: int = 1,
+        vnodes: int = DEFAULT_VNODES,
+    ):
+        self.store = store
+        self.me = str(shard_id)
+        self.vnodes = int(vnodes)
+        self._lock = threading.Lock()
+        #: the (active, pending) pair lives in ONE tuple attribute so
+        #: readers (wants/classify, which run unlocked on gate and
+        #: drainer threads) snapshot both rings in a single atomic load
+        #: — two separate attribute reads could tear against a
+        #: concurrent promote() into (old active, pending=None), which
+        #: classifies a family this shard just lost as OWN.
+        #: Epoch 0 = the config-derived bootstrap ring (controllers.
+        #: shard-count); published maps supersede it from epoch 1 on.
+        self._rings: tuple[HashRing, Optional[HashRing]] = (
+            HashRing(
+                [str(i) for i in range(max(1, int(shard_count)))],
+                vnodes=vnodes,
+            ),
+            None,
+        )
+        self._active_epoch = 0
+        self._pending_epoch = 0
+        self._rebalance_started: Optional[float] = None
+        #: keys currently parked by the gate, for the gauge + tests
+        self.parked: set[tuple[str, str, str]] = set()
+
+    # -- ring state --------------------------------------------------------
+    @property
+    def active_epoch(self) -> int:
+        return self._active_epoch
+
+    @property
+    def pending_epoch(self) -> int:
+        return self._pending_epoch
+
+    @property
+    def rebalancing(self) -> bool:
+        return self._rings[1] is not None
+
+    def rings(self) -> tuple[HashRing, Optional[HashRing]]:
+        return self._rings
+
+    def members(self) -> tuple[str, ...]:
+        return self._rings[0].members
+
+    def set_bootstrap_count(self, count: int) -> bool:
+        """Adopt a live-reloaded ``controllers.shard-count`` — only
+        while still on the bootstrap ring (epoch 0). Once a leader has
+        published a map, dynamic membership is authoritative and the
+        static count is just the expected fleet size."""
+        with self._lock:
+            active, pending = self._rings
+            if self._active_epoch != 0 or pending is not None:
+                return False
+            members = [str(i) for i in range(max(1, int(count)))]
+            if list(active.members) == members:
+                return False
+            self._rings = (HashRing(members, vnodes=self.vnodes), None)
+            return True
+
+    def begin_rebalance(self, members, epoch: int, started_at: float,
+                        vnodes: Optional[int] = None) -> None:
+        with self._lock:
+            if epoch <= max(self._active_epoch, self._pending_epoch):
+                return
+            self._rings = (
+                self._rings[0],
+                HashRing(members, vnodes=vnodes or self.vnodes),
+            )
+            self._pending_epoch = int(epoch)
+            if self._rebalance_started is None:
+                self._rebalance_started = float(started_at)
+
+    def promote(self) -> tuple[int, int, Optional[float]]:
+        """Swap pending -> active at the barrier; returns
+        (old member count, new member count, rebalance start time)."""
+        with self._lock:
+            active, pending = self._rings
+            assert pending is not None
+            old_n = len(active.members)
+            self._rings = (pending, None)
+            self._active_epoch = self._pending_epoch
+            started = self._rebalance_started
+            self._rebalance_started = None
+            self.parked.clear()
+            return old_n, len(pending.members), started
+
+    # -- ownership ---------------------------------------------------------
+    def owner_of(self, root: str) -> str:
+        return self._rings[0].owner(root)
+
+    def owns_root(self, root: str) -> bool:
+        return self._rings[0].owner(root) == self.me
+
+    def owns_run(self, namespace: str, run_name: str) -> bool:
+        return self.owns_root(f"{namespace}/{run_name}")
+
+    def owns_resource(self, resource: Resource) -> bool:
+        """Does this shard own the run family ``resource`` belongs to?
+        (Used by the DAG engine's shard-local global concurrency cap.)
+        Non-family resources are 'owned' everywhere. Only the FIRST
+        interest root is ownership — later entries are delivery edges
+        (a sub-StoryRun's parent shard observes, it does not own)."""
+        roots = self._interest_roots(resource)
+        if not roots:
+            return True
+        return self._rings[0].owner(roots[0]) == self.me
+
+    # -- delivery filter ---------------------------------------------------
+    def wants(self, resource: Resource) -> bool:
+        """The store's default watch filter for this manager: deliver
+        run-family events only to shards with an ownership interest
+        (owner under the active ring, owner under a pending ring, or —
+        for sub-StoryRuns — the parent run's owner). Everything else
+        broadcasts."""
+        roots = self._interest_roots(resource)
+        if not roots:
+            return True
+        active, pending = self._rings  # one atomic load (see __init__)
+        for root in roots:
+            if active.owner(root) == self.me:
+                return True
+            if pending is not None and pending.owner(root) == self.me:
+                return True
+        return False
+
+    def _interest_roots(self, resource: Resource) -> list[str]:
+        """Run-family roots this resource's events concern; [] means
+        non-family (broadcast)."""
+        kind = resource.kind
+        ns = resource.meta.namespace
+        if kind == STORY_RUN_KIND:
+            roots = [f"{ns}/{resource.meta.name}"]
+            parent = resource.meta.labels.get(LABEL_STORY_RUN)
+            if parent:
+                # cross-shard executeStory: the parent's shard must see
+                # the child's phase changes to progress the waiting step
+                roots.append(f"{ns}/{parent}")
+            return roots
+        if kind == STEP_RUN_KIND:
+            run = (resource.spec.get("storyRunRef") or {}).get(
+                "name"
+            ) or resource.meta.labels.get(LABEL_STORY_RUN)
+            return [f"{ns}/{run}"] if run else []
+        if kind == "Job":
+            run = resource.meta.labels.get(LABEL_STORY_RUN)
+            if run:
+                return [f"{ns}/{run}"]
+            sr_name = (resource.spec.get("stepRunRef") or {}).get("name")
+            return self._steprun_root(ns, sr_name)
+        if kind in _STEP_OWNED_KINDS:
+            run = resource.meta.labels.get(LABEL_STORY_RUN)
+            if run:
+                return [f"{ns}/{run}"]
+            sr_name = resource.meta.labels.get(LABEL_STEP_RUN)
+            return self._steprun_root(ns, sr_name)
+        if kind == STORY_TRIGGER_KIND or kind == EFFECT_CLAIM_KIND:
+            return [f"{kind}:{ns}/{resource.meta.name}"]
+        return []
+
+    def _steprun_root(self, ns: str, sr_name: Optional[str]) -> list[str]:
+        if not sr_name:
+            return []
+        sr = self.store.try_get_view(STEP_RUN_KIND, ns, sr_name)
+        if sr is None:
+            return []  # parent gone: broadcast, gates still apply
+        run = (sr.spec.get("storyRunRef") or {}).get("name")
+        return [f"{ns}/{run}"] if run else []
+
+    # -- reconcile gate ----------------------------------------------------
+    def classify(self, controller: str, ns: str, name: str
+                 ) -> tuple[str, Optional[str]]:
+        """Gate verdict for a dispatched key: (OWN|PARK|DROP, root).
+
+        Controllers outside the known families (the shard coordinator
+        itself, cluster reconcilers, simulators) always run."""
+        root = self.root_for(controller, ns, name)
+        if root is None:
+            return ADMIT_OWN, None
+        active, pending = self._rings  # one atomic load (see __init__)
+        own_now = active.owner(root) == self.me
+        if pending is None:
+            return (ADMIT_OWN if own_now else ADMIT_DROP), root
+        own_next = pending.owner(root) == self.me
+        if own_now and own_next:
+            return ADMIT_OWN, root
+        if own_next:
+            # gaining: untouched until the old owner drains and the
+            # barrier clears — the no-two-shards invariant lives here
+            return ADMIT_PARK, root
+        # losing (or never ours): the pending owner parks it
+        return ADMIT_DROP, root
+
+    def root_for(self, controller: str, ns: str, name: str) -> Optional[str]:
+        """Ownership root for a (controller, key) dispatch; None for
+        unsharded controllers."""
+        if controller == "storyrun":
+            return f"{ns}/{name}"
+        if controller == "steprun":
+            sr = self.store.try_get_view(STEP_RUN_KIND, ns, name)
+            if sr is not None:
+                run = (sr.spec.get("storyRunRef") or {}).get(
+                    "name"
+                ) or sr.meta.labels.get(LABEL_STORY_RUN)
+                if run:
+                    return f"{ns}/{run}"
+            return f"{ns}/{name}"  # orphan StepRun: hash on itself
+        kind = _AUX_CONTROLLER_KIND.get(controller)
+        if kind is not None:
+            return f"{kind}:{ns}/{name}"
+        kind = _DEF_CONTROLLER_KIND.get(controller)
+        if kind is not None:
+            return f"{kind}:{ns}/{name}"
+        return None

@@ -45,6 +45,15 @@ def sha256_hex(data: str) -> str:
     return hashlib.sha256(data.encode()).hexdigest()
 
 
+def stable_uint64(data: str) -> int:
+    """First 8 bytes of sha256 as an unsigned int — the ring-position
+    hash for consistent hashing (``shard/ring.py``). Stability across
+    processes and restarts is the contract (Python's ``hash()`` is
+    seed-randomized per process, so two managers would disagree on
+    every ring position)."""
+    return int.from_bytes(hashlib.sha256(data.encode()).digest()[:8], "big")
+
+
 def hash_inputs(value: Any) -> str:
     """sha256 of canonical JSON — the dedupe identity for trigger inputs."""
     return sha256_hex(canonical_json(value))

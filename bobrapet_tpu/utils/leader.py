@@ -18,6 +18,19 @@ LeaderElection). Three primitives, one interface
 - :class:`FileLeaderElector` — advisory ``flock`` kept as the
   single-node fast path (kernel releases on process exit; crash-safe
   with zero TTL bookkeeping, but node-local by nature).
+
+**Fencing (round 8).** Leadership alone is not enough once a leader
+PUBLISHES state other processes act on (the shard map): a leader paused
+mid-write and resumed after its lease expired still believes it leads
+and would publish a stale map. Every acquisition therefore mints a
+monotonically increasing **epoch** (the fencing token, persisted in the
+lease spec): renewals keep it, steals and fresh takes bump it. Writers
+carry their ``fence_token`` into the published resource and the
+consumer side (``shard/map.py`` admission) rejects any write whose
+token is older than the lease's current epoch — so a stale leader's
+write loses at the bus, not by luck of timing. ``validate_fence()`` is
+the belt-and-braces pre-write check (a fresh read, not the cached
+``is_leader`` flag).
 """
 
 from __future__ import annotations
@@ -78,6 +91,9 @@ class LeaseLeaderElector:
         self._identity = identity or _default_identity()
         self.clock = clock or _WallClock()
         self._leading = False
+        #: fencing token minted at the last successful ACQUISITION (not
+        #: renewal); 0 = never led. See module docstring.
+        self._fence = 0
 
     @property
     def identity(self) -> str:
@@ -87,18 +103,43 @@ class LeaseLeaderElector:
     def is_leader(self) -> bool:
         return self._leading
 
+    @property
+    def fence_token(self) -> int:
+        """Epoch of this elector's last acquisition. Carry it into any
+        state published while leading; consumers must reject tokens
+        older than the lease's current epoch."""
+        return self._fence
+
+    def validate_fence(self) -> bool:
+        """Fresh-read check that this elector STILL holds the lease at
+        the epoch it acquired: False the moment another identity has
+        acquired (even if our TTL math thinks we lead). The pre-write
+        gate for fenced publishes."""
+        r = self.store.try_get_view(LEASE_KIND, self.namespace, self.name)
+        if r is None or not self._leading:
+            return False
+        spec = r.spec
+        return (
+            spec.get("holderIdentity") == self._identity
+            and int(spec.get("epoch") or 0) == self._fence
+        )
+
     def _attempt(self) -> bool:
         from ..core.object import new_resource
         from ..core.store import AlreadyExists, Conflict, NotFound
 
         now = self.clock.now()
-        won = {"v": False}
+        won = {"v": False, "fence": self._fence}
 
         def take(spec: dict) -> None:
             spec["holderIdentity"] = self._identity
             spec["leaseDurationSeconds"] = self.lease_duration
             spec["renewTime"] = now
+            # every acquisition mints a new fencing epoch; renewals
+            # (handled in judge) deliberately do not pass through here
+            spec["epoch"] = int(spec.get("epoch") or 0) + 1
             won["v"] = True
+            won["fence"] = spec["epoch"]
 
         existing = self.store.try_get(LEASE_KIND, self.namespace, self.name)
         if existing is None:
@@ -112,6 +153,7 @@ class LeaseLeaderElector:
                 won["v"] = False
                 return self._attempt()  # lost the create race; re-judge
             self._leading = True
+            self._fence = won["fence"]
             return True
 
         def judge(r) -> None:
@@ -120,14 +162,18 @@ class LeaseLeaderElector:
             holder = spec.get("holderIdentity") or ""
             renew = float(spec.get("renewTime") or 0.0)
             duration = float(spec.get("leaseDurationSeconds") or self.lease_duration)
-            if holder == self._identity:
+            if holder == self._identity and int(spec.get("epoch") or 0) == self._fence:
                 spec["renewTime"] = now
                 won["v"] = True
+                won["fence"] = self._fence
             elif not holder or now > renew + duration:
                 # expired (or released): steal
                 spec["leaseTransitions"] = int(spec.get("leaseTransitions") or 0) + 1
                 spec["acquireTime"] = now
                 take(spec)
+            # holder == us but epoch moved on: someone stole AND we
+            # re-acquired is impossible without take(); treat as lost —
+            # a resumed stale leader must not renew its way back in
 
         try:
             self.store.mutate(LEASE_KIND, self.namespace, self.name, judge)
@@ -135,6 +181,8 @@ class LeaseLeaderElector:
             self._leading = False
             return False
         self._leading = won["v"]
+        if won["v"]:
+            self._fence = won["fence"]
         return won["v"]
 
     def try_acquire(self) -> bool:
@@ -239,6 +287,7 @@ class KubeLeaseElector:
         self._identity = identity or _default_identity()
         self.clock = clock or _WallClock()
         self._leading = False
+        self._fence = 0
 
     @property
     def identity(self) -> str:
@@ -247,6 +296,24 @@ class KubeLeaseElector:
     @property
     def is_leader(self) -> bool:
         return self._leading
+
+    @property
+    def fence_token(self) -> int:
+        """Fencing epoch for the kube Lease: ``leaseTransitions + 1``
+        at acquisition time (coordination/v1 has no free-form fields, and
+        transitions bump exactly once per holder change — the same
+        monotonicity the bus elector's ``epoch`` field provides)."""
+        return self._fence
+
+    def validate_fence(self) -> bool:
+        live = self.client.get(self.API_VERSION, LEASE_KIND, self.namespace, self.name)
+        if live is None or not self._leading:
+            return False
+        spec = live.get("spec") or {}
+        return (
+            spec.get("holderIdentity") == self._identity
+            and int(spec.get("leaseTransitions") or 0) + 1 == self._fence
+        )
 
     def _attempt(self) -> bool:
         from ..cluster.client import ClusterConflict, ClusterNotFound
@@ -271,21 +338,26 @@ class KubeLeaseElector:
             except ClusterConflict:
                 return self._attempt()
             self._leading = True
+            self._fence = 1  # transitions 0 + 1 (see fence_token)
             return True
         spec = live.get("spec") or {}
         holder = spec.get("holderIdentity") or ""
         renew = _from_microtime(spec.get("renewTime"))
         duration = float(spec.get("leaseDurationSeconds") or self.lease_duration)
         patch: Optional[dict] = None
-        if holder == self._identity:
+        fence_after = self._fence
+        if (holder == self._identity
+                and int(spec.get("leaseTransitions") or 0) + 1 == self._fence):
             patch = {"spec": {"renewTime": _to_microtime(now)}}
         elif not holder or now > renew + duration:
+            transitions = int(spec.get("leaseTransitions") or 0) + 1
+            fence_after = transitions + 1
             patch = {"spec": {
                 "holderIdentity": self._identity,
                 "leaseDurationSeconds": int(self.lease_duration),
                 "acquireTime": _to_microtime(now),
                 "renewTime": _to_microtime(now),
-                "leaseTransitions": int(spec.get("leaseTransitions") or 0) + 1,
+                "leaseTransitions": transitions,
             }}
         if patch is None:
             self._leading = False
@@ -303,6 +375,7 @@ class KubeLeaseElector:
             self._leading = False
             return False
         self._leading = True
+        self._fence = fence_after
         return True
 
     try_acquire = _attempt
@@ -420,3 +493,14 @@ class FileLeaderElector:
     @property
     def is_leader(self) -> bool:
         return self._fh is not None
+
+    @property
+    def fence_token(self) -> int:
+        """flock has no epoch: the kernel revokes the lock with the
+        process, so a paused holder still HOLDS (there is no stale-lease
+        window to fence). 0 marks the token as absent; fenced publishers
+        (shard map) require a TTL elector instead."""
+        return 0
+
+    def validate_fence(self) -> bool:
+        return self.is_leader

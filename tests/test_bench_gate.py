@@ -1,0 +1,143 @@
+"""bench.py regression gate: every metric vs its best prior round.
+
+The gate exists because `llama_decode_tokens_per_sec_per_chip` drifted
+2819 -> 2499 (-11%) across BENCH_r02 -> r05 with nobody noticing: any
+current metric more than BENCH_GATE_TOLERANCE below the best prior
+BENCH_r*.json value (same backend AND run shape — model/quant/batch/
+shards) must fail the bench run.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the run shape the shipped BENCH_r01..r05 decode lines carry
+_DECODE_SHAPE = {"model": "tiny", "quant": None, "batch": 8,
+                 "prompt_len": 128, "new_tokens": 8}
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(_REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _prior_file(tmp_path, lines):
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "n": 1, "rc": 0,
+        "tail": "\n".join(json.dumps(ln) for ln in lines),
+    }))
+
+
+def _key(bench, **fields):
+    return bench._gate_key(fields)
+
+
+def test_best_prior_parses_real_rounds(bench):
+    best = bench._best_prior()
+    # the repo ships BENCH_r01..r05; the drifted headline metric must be
+    # keyed by backend + run shape and carry the best (r02) value, not
+    # the latest
+    key = _key(bench, metric="llama_decode_tokens_per_sec_per_chip",
+               backend="cpu", **_DECODE_SHAPE)
+    assert best[key] >= 2819
+
+
+def test_gate_catches_the_historical_drift(bench):
+    # the motivating case: 2819 -> 2499 is an 11.4% drop, over the 10%
+    # default tolerance (same backend, same tiny/batch-8 shape)
+    bench._EMITTED[:] = [{
+        "metric": "llama_decode_tokens_per_sec_per_chip",
+        "value": 2499.17, "unit": "tok/s/chip", "backend": "cpu",
+        **_DECODE_SHAPE,
+    }]
+    failures = bench._regression_gate()
+    assert [f["metric"] for f in failures] == [
+        "llama_decode_tokens_per_sec_per_chip"]
+    assert failures[0]["drop_pct"] > 10
+
+
+def test_gate_passes_healthy_new_and_error_lines(bench):
+    bench._EMITTED[:] = [
+        # within tolerance of the best prior
+        {"metric": "llama_decode_tokens_per_sec_per_chip",
+         "value": 2700.0, "unit": "tok/s/chip", "backend": "cpu",
+         **_DECODE_SHAPE},
+        # brand-new metric: nothing to compare against
+        {"metric": "sharded_steps_per_sec", "value": 11.0,
+         "unit": "steps/s"},
+        # error lines never count as a measured zero
+        {"metric": "config4_failed", "value": 0.0, "unit": "error",
+         "error": "boom"},
+    ]
+    assert bench._regression_gate() == []
+
+
+def test_gate_never_crosses_backends(bench, monkeypatch):
+    # a cpu-fallback run must not be judged against a real-chip best
+    monkeypatch.setattr(bench, "_best_prior", lambda: {
+        _key(bench, metric="llama_decode_tokens_per_sec_per_chip",
+             backend="axon", **_DECODE_SHAPE): 50000.0,
+    })
+    bench._EMITTED[:] = [{
+        "metric": "llama_decode_tokens_per_sec_per_chip",
+        "value": 2700.0, "unit": "tok/s/chip", "backend": "cpu",
+        **_DECODE_SHAPE,
+    }]
+    assert bench._regression_gate() == []
+
+
+def test_gate_never_crosses_run_shapes(bench, monkeypatch):
+    # an 8b leg (or a 2-shard soak after a 4-shard round) must not be
+    # judged against a different shape's best — a shape with no prior
+    # simply isn't gated
+    monkeypatch.setattr(bench, "_best_prior", lambda: {
+        _key(bench, metric="llama_decode_tokens_per_sec_per_chip",
+             backend="cpu", **_DECODE_SHAPE): 2819.0,
+        _key(bench, metric="sharded_steps_per_sec", shards=4): 12.0,
+    })
+    bench._EMITTED[:] = [
+        {"metric": "llama_decode_tokens_per_sec_per_chip", "value": 150.0,
+         "unit": "tok/s/chip", "backend": "cpu", "model": "8b",
+         "quant": "int8", "batch": 8},
+        {"metric": "sharded_steps_per_sec", "value": 6.4,
+         "unit": "steps/s", "shards": 2},
+    ]
+    assert bench._regression_gate() == []
+    # while the SAME shape still gates
+    bench._EMITTED[:] = [{"metric": "sharded_steps_per_sec", "value": 6.4,
+                          "unit": "steps/s", "shards": 4}]
+    assert bench._regression_gate()
+
+
+def test_gate_lower_is_better_metrics(bench, monkeypatch):
+    monkeypatch.setattr(bench, "_best_prior", lambda: {
+        _key(bench, metric="entry_forward_step_ms", backend="cpu"): 10.0,
+    })
+    bench._EMITTED[:] = [{"metric": "entry_forward_step_ms",
+                          "value": 12.0, "unit": "ms", "backend": "cpu"}]
+    failures = bench._regression_gate()
+    assert failures and failures[0]["metric"] == "entry_forward_step_ms"
+    bench._EMITTED[:] = [{"metric": "entry_forward_step_ms",
+                          "value": 10.5, "unit": "ms", "backend": "cpu"}]
+    assert bench._regression_gate() == []
+
+
+def test_gate_tolerance_env_override(bench, monkeypatch):
+    monkeypatch.setattr(bench, "_best_prior", lambda: {
+        _key(bench, metric="m"): 100.0,
+    })
+    bench._EMITTED[:] = [{"metric": "m", "value": 80.0, "unit": "x"}]
+    assert bench._regression_gate()
+    monkeypatch.setenv("BENCH_GATE_TOLERANCE", "0.30")
+    assert bench._regression_gate() == []
