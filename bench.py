@@ -442,10 +442,32 @@ def config5_nested_rag() -> dict:
     }
 
 
+def _phase_fields(engine) -> dict:
+    """Flatten the engine's per-phase wall-clock counters into the
+    metric line (`prefill_s`/`decode_device_s`/`host_sync_s`/`draft_s`
+    /`verify_s` + sync/horizon counts) — the ISSUE-7 instrumentation
+    that shows WHERE decode wall-clock goes. Call reset_phase_stats()
+    after warm so compile time never pollutes the breakdown."""
+    p = engine.phase_seconds
+    return {
+        "prefill_s": round(p["prefill"], 4),
+        "decode_device_s": round(p["decode_device"], 4),
+        "host_sync_s": round(p["host_sync"], 4),
+        "draft_s": round(p["draft"], 4),
+        "verify_s": round(p["verify"], 4),
+        "host_syncs": engine.phase_counts["host_syncs"],
+        "horizons": engine.phase_counts["horizons"],
+        "decode_horizon": engine.decode_horizon,
+    }
+
+
 def config6_serving() -> dict:
     """Continuous-batching serving engine throughput (paged KV cache):
     requests stream through a small slot pool; measures aggregate
-    decoded tok/s incl. admission/prefill overlap. CPU tiny-model
+    decoded tok/s incl. admission/prefill overlap on a WARM engine
+    (a shape-identical different-bytes pass compiles every graph the
+    drain touches first — the seed measurement was ~90% jit compile
+    time, which buried the engine's actual speed). CPU tiny-model
     numbers gauge engine overhead, not chip speed."""
     import numpy as np
 
@@ -457,30 +479,38 @@ def config6_serving() -> dict:
     eng = ServingEngine(params, cfg, PagedConfig(
         max_slots=4, block_size=16, num_blocks=128, max_blocks_per_seq=8))
     rng = np.random.default_rng(0)
-    n_requests, new_tokens = 12, 16
-    for i in range(n_requests):
-        eng.submit(rng.integers(0, cfg.vocab_size, 8 + (i % 5) * 7).tolist(),
-                   max_new_tokens=new_tokens)
-    # warm the compiled paths (prefill buckets + decode step); tokens
-    # produced by the warm-up step are EXCLUDED from the timed count
-    eng.step()
-    warm_tokens = sum(
-        len(s.request.output) for s in eng.slots if s is not None
-    ) + sum(len(r.output) for r in eng.finished)
-    t0 = time.perf_counter()
-    done = eng.run()
-    wall = time.perf_counter() - t0
-    total_tokens = sum(len(r.output) for r in done) - warm_tokens
+    # 48-token budgets: the seed's 16-token drain finished in <100ms on
+    # the horizon engine — pure scheduler-noise territory for the
+    # regression gate. new_tokens is recorded on the line, so this is
+    # a FRESH gate lineage (the old shapeless prior keys as None).
+    n_requests, new_tokens = 12, 48
+    prompts = [rng.integers(0, cfg.vocab_size, 8 + (i % 5) * 7).tolist()
+               for i in range(n_requests)]
+
+    def one_drain(seed=None):
+        r2 = np.random.default_rng(seed) if seed is not None else None
+        for pr in prompts:
+            toks = (r2.integers(0, cfg.vocab_size, len(pr)).tolist()
+                    if r2 is not None else list(pr))
+            eng.submit(toks, max_new_tokens=new_tokens)
+        t0 = time.perf_counter()
+        eng.run()
+        return (n_requests * new_tokens) / (time.perf_counter() - t0)
+
+    one_drain(seed=99)  # compile every graph the drain touches
+    eng.reset_phase_stats()
+    best = max(one_drain(), one_drain(seed=98))
     return {
         "metric": "serving_decode_tokens_per_sec",
-        "value": round(total_tokens / wall, 1),
+        "value": round(best, 1),
         "unit": "tok/s",
         "vs_baseline": 1.0,
         "config": "serving",
         "requests": n_requests,
+        "new_tokens": new_tokens,
         "slots": 4,
-        "tokens": total_tokens,
-        "wallclock_s": round(wall, 3),
+        "tokens": n_requests * new_tokens,
+        **_phase_fields(eng),
     }
 
 
@@ -501,26 +531,36 @@ def config7_serving_moe() -> dict:
     eng = ServingEngine(params, cfg, PagedConfig(
         max_slots=4, block_size=16, num_blocks=128, max_blocks_per_seq=8))
     rng = np.random.default_rng(0)
-    n_requests, new_tokens = 8, 12
-    for i in range(n_requests):
-        eng.submit(rng.integers(0, cfg.vocab_size, 8 + (i % 4) * 8).tolist(),
-                   max_new_tokens=new_tokens)
-    eng.step()
-    warm = sum(len(s_.request.output) for s_ in eng.slots if s_) + sum(
-        len(r.output) for r in eng.finished)
-    t0 = time.perf_counter()
-    done = eng.run()
-    wall = time.perf_counter() - t0
-    total = sum(len(r.output) for r in done) - warm
+    # warm + longer drains + best-of-2, same treatment as config6 (the
+    # seed's compile-polluted sub-40ms timing bounced 331-2297 across
+    # rounds on the same code); new_tokens recorded = fresh gate lineage
+    n_requests, new_tokens = 8, 32
+    prompts = [rng.integers(0, cfg.vocab_size, 8 + (i % 4) * 8).tolist()
+               for i in range(n_requests)]
+
+    def one_drain(seed=None):
+        r2 = np.random.default_rng(seed) if seed is not None else None
+        for pr in prompts:
+            toks = (r2.integers(0, cfg.vocab_size, len(pr)).tolist()
+                    if r2 is not None else list(pr))
+            eng.submit(toks, max_new_tokens=new_tokens)
+        t0 = time.perf_counter()
+        eng.run()
+        return (n_requests * new_tokens) / (time.perf_counter() - t0)
+
+    one_drain(seed=99)
+    eng.reset_phase_stats()
+    best = max(one_drain(), one_drain(seed=98))
     return {
         "metric": "serving_moe_decode_tokens_per_sec",
-        "value": round(total / wall, 1),
+        "value": round(best, 1),
         "unit": "tok/s",
         "vs_baseline": 1.0,
         "config": "serving-moe",
         "requests": n_requests,
+        "new_tokens": new_tokens,
         "experts": cfg.n_experts,
-        "wallclock_s": round(wall, 3),
+        **_phase_fields(eng),
     }
 
 
@@ -547,52 +587,65 @@ def config8_serving_spec() -> dict:
     pc = PagedConfig(max_slots=4, block_size=16, num_blocks=128,
                      max_blocks_per_seq=8)
     rng = np.random.default_rng(0)
+    n_new = 48  # long drains: sub-100ms measurements were gate noise
     prompts = [rng.integers(0, cfg.vocab_size, 8 + (i % 5) * 7).tolist()
                for i in range(12)]
 
-    def warm(engine, seed: int = 99) -> None:
-        # a full shape-identical pass with DIFFERENT prompt bytes:
-        # compiles every graph the timed drain touches (prefill
-        # buckets, both tick paths, the guard's A/B window) WITHOUT
-        # registering the drain's prompts in the prefix cache — same
-        # bytes would make the drain compile the prefix-seeded prefill
-        # graphs inside the timed region (observed: a 4x phantom
-        # slowdown that was 100% compile time)
-        warm_rng = np.random.default_rng(seed)
+    def drain(engine, seed: int) -> float:
+        # every pass uses DIFFERENT prompt bytes, so every pass pays
+        # prefill honestly and the drain's prompts are never pre-
+        # registered in the prefix cache (same bytes would make a
+        # later drain compile the prefix-seeded prefill graphs inside
+        # the timed region — observed: a 4x phantom slowdown that was
+        # 100% compile time)
+        drain_rng = np.random.default_rng(seed)
         for pr in prompts:
             engine.submit(
-                warm_rng.integers(0, cfg.vocab_size, len(pr)).tolist(),
-                max_new_tokens=16,
+                drain_rng.integers(0, cfg.vocab_size, len(pr)).tolist(),
+                max_new_tokens=n_new,
             )
-        engine.run()
-
-    def timed(engine):
-        for pr in prompts:
-            engine.submit(list(pr), max_new_tokens=16)
-        engine.step()
-        warm_toks = sum(
-            len(s.request.output) for s in engine.slots if s) + sum(
-            len(r.output) for r in engine.finished)
         t0 = time.perf_counter()
-        done = engine.run()
-        wall = time.perf_counter() - t0
-        return (sum(len(r.output) for r in done) - warm_toks) / wall
+        engine.run()
+        return (len(prompts) * n_new) / (time.perf_counter() - t0)
 
     off_eng = ServingEngine(params, cfg, pc)
-    warm(off_eng)
-    off = timed(off_eng)
     spec_eng = ServingEngine(params, cfg, pc, draft_params=dparams,
                              draft_cfg=dcfg, spec_k=4)
-    # the warm pass also drives the payoff guard (VERDICT r4 #4) to
+    # the warm passes also drive the payoff guard (VERDICT r4 #4) to
     # its decision on the SAME batch shape the drain uses (payoff
-    # flips with slot occupancy). Residual CPU gap vs off (~0.9x): a
-    # spec engine prefills the DRAFT pools per admission too — a real
-    # cost the decode-tick guard cannot see; it shrinks as budgets
-    # grow and flips positive where weight reads dominate (real chip)
-    warm(spec_eng)
-    on = timed(spec_eng)
+    # flips with slot occupancy): warm until it lands so the timed
+    # drains measure the engine's SETTLED mode, whichever way the
+    # guard went on this hardware.
+    drain(off_eng, 99)
+    drain(spec_eng, 99)
+    for extra in range(3):
+        if spec_eng.spec_guard_decision is not None:
+            break
+        drain(spec_eng, 77 + extra)
+    off_eng.reset_phase_stats()
+    spec_eng.reset_phase_stats()
+    # INTERLEAVED best-of-2: the speedup is a ratio of two wall-clock
+    # measurements, and a box-load shift between legs prints phantom
+    # (un)profitability — alternate the engines so drift taxes both
+    off1 = drain(off_eng, 1)
+    on1 = drain(spec_eng, 2)
+    off = max(off1, drain(off_eng, 3))
+    on = max(on1, drain(spec_eng, 4))
     accept = (spec_eng.spec_accepted / spec_eng.spec_drafted
               if spec_eng.spec_drafted else 0.0)
+    if off:
+        # speedup as its OWN gated metric line: the regression gate
+        # compares every metric against its best prior BENCH_r*.json
+        # value, so spec-decode profitability can never silently
+        # regress again (BENCH_r05 shipped 0.68x unnoticed)
+        _emit({
+            "metric": "serving_spec_speedup_vs_off",
+            "value": round(on / off, 3),
+            "unit": "x",
+            "vs_baseline": 1.0,
+            "config": "serving-spec",
+            "accept_rate": round(accept, 3),
+        })
     return {
         "metric": "serving_spec_decode_tokens_per_sec",
         "value": round(on, 1),
@@ -604,6 +657,8 @@ def config8_serving_spec() -> dict:
         "accept_rate": round(accept, 3),
         "guard": spec_eng.spec_guard_decision,
         "spec_k": 4,
+        "new_tokens": n_new,
+        **_phase_fields(spec_eng),
     }
 
 
@@ -1236,36 +1291,69 @@ def run_serving_child() -> None:
     prompts = [rng.integers(0, cfg.vocab_size, 32 + (i % 4) * 32).tolist()
                for i in range(n_req)]
 
-    def timed_tokens(engine, warm_steps: int = 1) -> tuple[int, float]:
-        """Submit the workload, run warm_steps unmeasured ticks (each
-        compiled graph the run will touch must be warm), then time the
-        drain; returns (tokens, wall)."""
+    def timed_tokens(engine, seed=None) -> tuple[int, float]:
+        """Submit the workload (fresh prompt bytes when seeded — a
+        reused prompt set would skip prefill through the prefix cache
+        and flatter the second pass) and time the drain; returns
+        (tokens, wall)."""
+        sub_rng = np.random.default_rng(seed) if seed is not None else None
         for pr in prompts:
-            engine.submit(list(pr), max_new_tokens=n_new)
-        for _ in range(warm_steps):
-            engine.step()
-        warm = sum(len(s_.request.output) for s_ in engine.slots if s_) + sum(
-            len(r.output) for r in engine.finished)
+            toks = (sub_rng.integers(0, cfg.vocab_size, len(pr)).tolist()
+                    if sub_rng is not None else list(pr))
+            engine.submit(toks, max_new_tokens=n_new)
         t0 = time.perf_counter()
-        done = engine.run()
+        engine.run()
         wall = time.perf_counter() - t0
-        return sum(len(r.output) for r in done) - warm, wall
+        return len(prompts) * n_new, wall
 
     def full_warm(engine, seed: int = 99) -> None:
         # shape-identical different-bytes pass: compiles every graph
         # the timed drain touches without registering the drain's
-        # prompts in the prefix cache (see config8_serving_spec)
-        warm_rng = np.random.default_rng(seed)
-        for pr in prompts:
-            engine.submit(
-                warm_rng.integers(0, cfg.vocab_size, len(pr)).tolist(),
-                max_new_tokens=n_new,
-            )
-        engine.run()
+        # prompts in the prefix cache (see config8_serving_spec); on a
+        # draft engine, repeated until the payoff guard decides so the
+        # timed drain measures the SETTLED mode
+        for attempt in range(4):
+            warm_rng = np.random.default_rng(seed + attempt)
+            for pr in prompts:
+                engine.submit(
+                    warm_rng.integers(0, cfg.vocab_size, len(pr)).tolist(),
+                    max_new_tokens=n_new,
+                )
+            engine.run()
+            if (engine.draft_params is None
+                    or engine.spec_guard_decision is not None
+                    or not engine.spec_guard):
+                break
+
+    # the spec draft is an int8 quantization of the target (the
+    # continuous-batching spec path; accept rate is meaningful because
+    # the draft IS the target's weights)
+    from bobrapet_tpu.models import quant as _quant
 
     eng = ServingEngine(params, cfg, PagedConfig(**pcfg_kw))
+    spec_eng = ServingEngine(
+        params, cfg, PagedConfig(**pcfg_kw),
+        draft_params=_quant.quantize_params(params), draft_cfg=cfg,
+        spec_k=4)
     full_warm(eng)
-    serving_tokens, serving_wall = timed_tokens(eng)
+    # the spec warm passes also drive the payoff guard (VERDICT r4 #4)
+    # to its decision on this batch shape (full_warm loops until it
+    # lands), so the timed drains measure the engine's SETTLED mode
+    full_warm(spec_eng)
+    # INTERLEAVED best-of-2 drains: speedup_vs_off is a ratio of two
+    # wall-clocks; alternating the engines taxes box-load drift evenly.
+    # Phase stats reset ONCE and accumulate across both legs, so the
+    # emitted breakdown describes the same measurement window the
+    # best-of value came from (per-leg reset left the fields showing
+    # only the LAST leg — possibly the load-spiked one).
+    eng.reset_phase_stats()
+    spec_eng.reset_phase_stats()
+    walls = {id(eng): [], id(spec_eng): []}
+    for leg_seed, target in ((11, eng), (12, spec_eng),
+                             (13, eng), (14, spec_eng)):
+        walls[id(target)].append(timed_tokens(target, seed=leg_seed))
+    serving_tokens, serving_wall = min(
+        walls[id(eng)], key=lambda p: p[1] / p[0])
     _emit({
         "metric": "serving_decode_tokens_per_sec",
         "value": round(serving_tokens / serving_wall, 1),
@@ -1275,38 +1363,45 @@ def run_serving_child() -> None:
         "backend": backend,
         "model": model_name,
         "requests": n_req,
+        "new_tokens": n_new,
         "slots": 8,
         "wallclock_s": round(serving_wall, 3),
+        **_phase_fields(eng),
     })
 
-    # --- engine-integrated speculation: int8 draft of the target -------
-    # (the continuous-batching spec path; accept rate is meaningful
-    # because the draft is a quantization of the same weights)
-    from bobrapet_tpu.models import quant as _quant
-
-    spec_eng = ServingEngine(
-        params, cfg, PagedConfig(**pcfg_kw),
-        draft_params=_quant.quantize_params(params), draft_cfg=cfg, spec_k=4)
-    # the warm pass compiles BOTH tick graphs and drives the payoff
-    # guard to its decision (VERDICT r4 #4) on the SAME batch shape
-    # the timed drain uses (payoff flips with slot occupancy)
-    full_warm(spec_eng)
-    spec_eng_tokens, spec_eng_wall = timed_tokens(spec_eng)
+    spec_eng_tokens, spec_eng_wall = min(
+        walls[id(spec_eng)], key=lambda p: p[1] / p[0])
+    spec_rate = spec_eng_tokens / spec_eng_wall
+    off_rate = serving_tokens / serving_wall
     _emit({
         "metric": "serving_spec_decode_tokens_per_sec",
-        "value": round(spec_eng_tokens / spec_eng_wall, 1),
+        "value": round(spec_rate, 1),
         "unit": "tok/s",
         "vs_baseline": 1.0,
         "config": "serving-spec",
         "backend": backend,
         "model": model_name,
         "spec_k": 4,
+        "new_tokens": n_new,
         "accept_rate": round(
             spec_eng.spec_accepted / max(1, spec_eng.spec_drafted), 3),
-        "spec_off_tok_s": round(serving_tokens / serving_wall, 1),
+        "spec_off_tok_s": round(off_rate, 1),
+        "speedup_vs_off": round(spec_rate / off_rate, 2) if off_rate else None,
         "guard": spec_eng.spec_guard_decision,
         "wallclock_s": round(spec_eng_wall, 3),
+        **_phase_fields(spec_eng),
     })
+    if off_rate:
+        # gated profitability line (see config8_serving_spec)
+        _emit({
+            "metric": "serving_spec_speedup_vs_off",
+            "value": round(spec_rate / off_rate, 3),
+            "unit": "x",
+            "vs_baseline": 1.0,
+            "config": "serving-spec",
+            "backend": backend,
+            "model": model_name,
+        })
 
     # --- standalone speculative decoding: tiny draft over the target ---
     dcfg = llama.llama_tiny(vocab_size=cfg.vocab_size)

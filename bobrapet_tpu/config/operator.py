@@ -151,6 +151,35 @@ class FleetConfig:
 
 
 @dataclasses.dataclass
+class ServingConfig:
+    """Serving-engine knobs (``serving.*``; TPU-native addition,
+    consumed live by :func:`bobrapet_tpu.serving.engram.apply_tuning`
+    on every engine the process is serving — compiled horizon graphs
+    are cached per length, so flipping these costs one compile on
+    first use, nothing after)."""
+
+    #: fused decode steps per host sync (the device-resident decode
+    #: horizon; 1 = the classic single-step reference engine)
+    #: (dotted: serving.decode-horizon)
+    decode_horizon: int = 8
+    #: draft proposals per speculative round on draft-capable engines
+    #: (dotted: serving.spec-k)
+    spec_k: int = 4
+    #: share prefix-cache blocks ACROSS engine instances by content
+    #: hash (weights-fingerprint scoped; see prefix_cache.py)
+    #: (dotted: serving.prefix-cache-shared)
+    prefix_cache_shared: bool = False
+
+
+#: last serving config a Runtime applied in this process. The serving
+#: engram module is jax-heavy and typically imported AFTER the control
+#: plane boots, so Runtime cannot push startup knobs into it directly
+#: — it parks them here (a no-jax module both sides can import) and
+#: ``serving/engram.build_engine`` reads them as build-time defaults.
+LAST_SERVING_TUNING: Optional[ServingConfig] = None
+
+
+@dataclasses.dataclass
 class EngramDefaults:
     """Operator->SDK defaults (reference: operator.go engram defaults)."""
 
@@ -190,6 +219,7 @@ class OperatorConfig:
     templating: TemplatingSettings = dataclasses.field(default_factory=TemplatingSettings)
     dataplane: DataplaneConfig = dataclasses.field(default_factory=DataplaneConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+    serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     engram: EngramDefaults = dataclasses.field(default_factory=EngramDefaults)
     retention: RetentionDefaults = dataclasses.field(default_factory=RetentionDefaults)
     timeouts: TimeoutDefaults = dataclasses.field(default_factory=TimeoutDefaults)
@@ -245,6 +275,10 @@ class OperatorConfig:
             errs.append("fleet.suspicion-half-life must be > 0")
         if self.fleet.redrive_delay_seconds < 0:
             errs.append("fleet.redrive-delay must be >= 0")
+        if self.serving.decode_horizon < 1:
+            errs.append("serving.decode-horizon must be >= 1")
+        if self.serving.spec_k < 1:
+            errs.append("serving.spec-k must be >= 1")
         if self.engram.max_inline_size < 0:
             errs.append("engram.maxInlineSize must be >= 0")
         for qname, q in self.scheduling.queues.items():
@@ -295,6 +329,9 @@ def _apply_dotted(cfg: OperatorConfig, key: str, value: str) -> bool:
         "fleet.fail-fast": lambda: fset(cfg.fleet, "fail_fast", as_bool),
         "fleet.gke-spot": lambda: fset(cfg.fleet, "gke_spot", as_bool),
         "fleet.termination-grace": lambda: fset(cfg.fleet, "termination_grace_seconds", as_dur),
+        "serving.decode-horizon": lambda: fset(cfg.serving, "decode_horizon", int),
+        "serving.spec-k": lambda: fset(cfg.serving, "spec_k", int),
+        "serving.prefix-cache-shared": lambda: fset(cfg.serving, "prefix_cache_shared", as_bool),
         "engram.grpc-port": lambda: fset(cfg.engram, "grpc_port", int),
         "engram.max-inline-size": lambda: fset(cfg.engram, "max_inline_size", int),
         "engram.storage-timeout-seconds": lambda: fset(cfg.engram, "storage_timeout_seconds", int),

@@ -478,6 +478,38 @@ class _ControlPlaneMetrics:
             "bobrapet_serving_spec_tokens_total",
             "Speculative decoding proposals by outcome", ["result"]
         )
+        self.serving_horizon = g(
+            "bobrapet_serving_decode_horizon",
+            "Fused decode steps dispatched per host sync (the "
+            "device-resident horizon width in effect; 1 = the classic "
+            "single-step reference engine)", []
+        )
+        self.serving_host_syncs = c(
+            "bobrapet_serving_host_syncs_total",
+            "Horizon-boundary device_get round-trips by tick kind "
+            "(the engine's whole point is that this counts horizons, "
+            "not tokens)", ["kind"]
+        )
+        self.serving_device_step = h(
+            "bobrapet_serving_device_step_seconds",
+            "On-device fused dispatch latency by phase (decode = the "
+            "H-step scan, draft = the k-proposal scan, verify = the "
+            "k+1-token target step)", ["phase"],
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                     0.5, 1.0, 5.0),
+        )
+        self.serving_spec_rounds = c(
+            "bobrapet_serving_spec_rounds_total",
+            "Fused draft+verify+accept rounds dispatched inside "
+            "decode horizons", []
+        )
+        self.serving_prefix_shared = c(
+            "bobrapet_serving_prefix_shared_total",
+            "Cross-engine shared-prefix registry probes (hit = block "
+            "adopted from another engine's export, miss = no scoped "
+            "entry, import-failed = payload refused by this engine)",
+            ["outcome"]
+        )
         self.cr_sync_ops = c(
             "bobrapet_cr_sync_operations_total",
             "CR mirror operations between the cluster API and the bus",

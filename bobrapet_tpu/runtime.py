@@ -167,6 +167,13 @@ class Runtime:
         # observability toggles must apply at startup too (same
         # construct-then-apply pattern as manager.apply_config below)
         self._apply_observability_toggles(cfg)
+        # likewise seed the serving.* tuning defaults at startup —
+        # engines built later in this process (engram.build_engine)
+        # read the last-applied tuning; without this a pre-existing
+        # ConfigMap's serving knobs were silently ignored until the
+        # first reload. Lazy: never imports jax into a pure
+        # control-plane process.
+        self._apply_serving_tuning(cfg)
 
         self._register_indexes()
         # admission layer (reference: setupWebhooksIfEnabled, cmd/main.go:802;
@@ -338,6 +345,23 @@ class Runtime:
 
         FEATURES.apply(cfg.verbosity, cfg.step_output_logging)
 
+    @staticmethod
+    def _apply_serving_tuning(cfg) -> None:
+        """Publish serving.* knobs for the engram layer: park them in
+        the no-jax handoff slot (config/operator.py) so engines built
+        LATER in this process see a startup ConfigMap's values, and
+        push them onto already-live engines when the engram module is
+        loaded (it pulls in jax; a pure control-plane process must not
+        import it just to retune zero engines)."""
+        import sys as _sys
+
+        from .config import operator as _opcfg
+
+        _opcfg.LAST_SERVING_TUNING = cfg.serving
+        _serving = _sys.modules.get("bobrapet_tpu.serving.engram")
+        if _serving is not None:
+            _serving.apply_tuning(cfg.serving)
+
     def _on_config_change(self, cfg) -> None:
         self.resolver.operator_config = cfg
         self._apply_observability_toggles(cfg)
@@ -362,6 +386,7 @@ class Runtime:
         from .dataplane.hub import apply_tuning
 
         apply_tuning(cfg.dataplane)
+        self._apply_serving_tuning(cfg)
         # fleet.gke-spot / fleet.termination-grace are live like every
         # other fleet.* knob: retune the cluster materializer IN PLACE
         # (replacing it would discard operator customization such as

@@ -4,8 +4,8 @@ run."""
 
 from .engine import Request, ServingEngine
 from .paged_cache import BlockAllocator, PagedConfig
-from .prefix_cache import PrefixCache
+from .prefix_cache import PrefixCache, SharedPrefixRegistry
 from .service import StreamServer
 
 __all__ = ["BlockAllocator", "PagedConfig", "PrefixCache", "Request",
-           "ServingEngine", "StreamServer"]
+           "ServingEngine", "SharedPrefixRegistry", "StreamServer"]

@@ -23,10 +23,23 @@ XLA-first design decisions:
   youngest victim's blocks are freed and it re-queues with its prompt +
   already-generated tokens (the classic recompute strategy — cheap on
   TPU where prefill rides the MXU).
+- **Device-resident decode horizon** (``decode_horizon``, default 8):
+  slot state (last tokens, seq_lens, liveness, budgets, PRNG-relevant
+  identity, block tables) lives ON DEVICE between scheduler decisions;
+  a fused ``lax.scan`` decodes up to ``decode_horizon`` tokens per slot
+  with on-device eos/budget deactivation, and the host reads back ONE
+  committed token block + liveness per horizon instead of syncing every
+  token. Admission/preemption stays host-side but patches only the
+  device lanes that changed. ``decode_horizon=1`` retains the classic
+  single-step engine — the byte-identical reference path the parity
+  suite pins the horizon loop against.
 
 Sampling: greedy when ``temperature == 0``, else
-``jax.random.categorical`` with a per-request key folded per step —
-deterministic replay for a fixed submit order.
+``jax.random.categorical`` with a key folded from the REQUEST identity
+and the request's own token index — a request's sampled stream is a
+pure function of (engine seed, rid, token position), byte-identical
+across slot assignment, co-tenancy, preemption/recompute, and the
+single-step vs horizon engines.
 """
 
 from __future__ import annotations
@@ -107,7 +120,11 @@ class ServingEngine:
                  spec_guard: bool = True,
                  spec_guard_ticks: int = 6,
                  spec_guard_margin: float = 0.05,
-                 pipeline_decode: bool = True):
+                 pipeline_decode: bool = True,
+                 decode_horizon: int = 8,
+                 prefix_shared: Any = False):
+        if decode_horizon < 1:
+            raise ValueError("decode_horizon must be >= 1")
         self.params = params
         self.cfg = cfg
         self.pcfg = pcfg or PagedConfig()
@@ -170,6 +187,9 @@ class ServingEngine:
         #: growth, speculation) always run settled.
         self.pipeline_decode = pipeline_decode
         self._pending_tick: Optional[dict] = None
+        #: fused multi-step decode (device-resident horizon); 1 = the
+        #: retained classic single-step engine (the parity reference)
+        self.decode_horizon = decode_horizon
         self.pools = init_pools(cfg, self.pcfg)
         self.allocator = BlockAllocator(self.pcfg.num_blocks)
         # all block traffic flows through the prefix cache so freed-
@@ -180,10 +200,27 @@ class ServingEngine:
         self.finished: list[Request] = []
         self._next_rid = 0
         self._last_tokens = [0] * self.pcfg.max_slots
-        self._keys = jax.random.split(
-            jax.random.PRNGKey(0), self.pcfg.max_slots
-        )
+        self._base_key = jax.random.PRNGKey(0)
         self._steps = 0
+        # device-resident slot state (horizon path): lane arrays +
+        # block tables stay on device between horizons; the host keeps
+        # a value mirror and patches only the lanes that changed
+        # (admission/retire/preempt/growth), never rebuilding the set
+        self._dev: Optional[dict] = None
+        self._dev_mirror: list = [None] * self.pcfg.max_slots
+        self._hz_fns: dict[int, Any] = {}
+        self._hz_sync_fns: dict[int, Any] = {}
+        #: (k, (gather, draft, verify)) — see _spec_horizon_fns
+        self._hz_spec_fns: Optional[tuple] = None
+        self._hz_scatter_fns: dict[int, Any] = {}
+        self._import_fn: Optional[Any] = None
+        self._sharing_scope_cache: Optional[str] = None
+        #: per-phase wall-clock breakdown of where engine time goes
+        #: (bench surfaces these; reset_phase_stats() zeroes after warm)
+        self.phase_seconds = {"prefill": 0.0, "decode_device": 0.0,
+                              "host_sync": 0.0, "draft": 0.0, "verify": 0.0}
+        self.phase_counts = {"host_syncs": 0, "horizons": 0,
+                             "device_steps": 0, "spec_rounds": 0}
         self._decode_fn = jax.jit(
             functools.partial(_decode_step, cfg=cfg, pcfg=self.pcfg,
                               lora_scale=lora_scale, is_moe=self.is_moe),
@@ -224,6 +261,9 @@ class ServingEngine:
         self.spec_guard_decision: Optional[dict] = None
         self._guard_samples: dict[str, list[float]] = {"spec": [], "plain": []}
         self._tokens_emitted = 0
+        #: post-guard watchdog window: [tokens, seconds] of realized
+        #: spec-horizon throughput (see _watched_spec_horizon)
+        self._spec_watch: list = [0, 0.0]
         if draft_params is not None:
             if draft_cfg is None:
                 raise ValueError("draft_params requires draft_cfg")
@@ -256,12 +296,22 @@ class ServingEngine:
             from .spec_decode import make_draft_append, make_spec_step
 
             self.dpools = init_pools(draft_cfg, self.pcfg)
-            self._spec_fn = make_spec_step(
+            # (k, compiled step) published as ONE tuple: a live
+            # serving.spec-k reload lands on the config-watch thread,
+            # and a tick must never pair the new k with the old graph
+            # (torn read = IndexError in the accept loop or a
+            # mis-sized scatter window) — consumers read the bundle
+            # once per tick
+            self._spec_shape = (spec_k, make_spec_step(
                 cfg, draft_cfg, self.pcfg, spec_k, lora_scale=lora_scale
-            )
+            ))
             self._draft_append_fn = make_draft_append(draft_cfg, self.pcfg)
             self._draft_prefill_fns: dict[int, Any] = {}
             self._draft_prefill_seed_fns: dict[Any, Any] = {}
+        # identity check, not truthiness: an EMPTY SharedPrefixRegistry
+        # is falsy (len 0) but very much a request to share through it
+        if prefix_shared is not False and prefix_shared is not None:
+            self.set_prefix_sharing(prefix_shared)
 
     # -- public API --------------------------------------------------------
 
@@ -304,6 +354,165 @@ class ServingEngine:
     def active_slots(self) -> int:
         return sum(1 for s in self.slots if s is not None)
 
+    def set_decode_horizon(self, horizon: int) -> None:
+        """Live-reloadable (`serving.decode-horizon`): takes effect at
+        the next tick — compiled horizon graphs are cached per length,
+        so flipping back and forth costs nothing after the first use."""
+        if horizon < 1:
+            raise ValueError("decode_horizon must be >= 1")
+        changed = int(horizon) != self.decode_horizon
+        self.decode_horizon = int(horizon)
+        if changed:
+            self._rearm_spec_guard()
+
+    def set_spec_k(self, k: int) -> None:
+        """Live-reloadable (`serving.spec-k`) on draft-capable engines:
+        rebuilds the k-shaped compiled entries (spec step, horizon
+        round fns) lazily; a no-op on engines without a draft."""
+        if k < 1:
+            raise ValueError("spec_k must be >= 1")
+        if self.draft_params is None or k == self.spec_k:
+            self.spec_k = int(k)
+            return
+        from .spec_decode import make_spec_step
+
+        self.spec_k = int(k)
+        # atomic single-attribute publishes (GIL): an in-flight tick
+        # keeps its already-read (k, fn) pair; the next tick gets the
+        # new pair — never a mix
+        self._spec_shape = (self.spec_k, make_spec_step(
+            self.cfg, self.draft_cfg, self.pcfg, self.spec_k,
+            lora_scale=self.lora_scale
+        ))
+        self._hz_spec_fns = None  # re-made at next spec horizon
+        self._rearm_spec_guard()
+
+    def _rearm_spec_guard(self) -> None:
+        """The horizon and spec_k ARE the payoff guard's measurement
+        shape: after either changes, an existing decision (and the
+        watchdog's plain-rate floor) says nothing about the new sync
+        cadence, and half-collected A/B samples from the old shape
+        must not be medianed with new-shape ones ('could flip the
+        one-shot decision'). Re-arm from scratch; the draft gets a
+        fresh shot even if it was retired — its pools may have gone
+        stale while off, which depresses accept for one window, but
+        commits stay token-exact and the guard re-decides."""
+        if self.draft_params is None or not self.spec_guard:
+            return
+        self.spec_guard_decision = None
+        self._guard_samples = {"spec": [], "plain": []}
+        self._spec_watch = [0, 0.0]
+        self.spec_active = True
+        if self.blocks._shared is not None:
+            self._sharing_scope_cache = None
+            self.blocks.rescope(self._sharing_scope())
+
+    def set_prefix_sharing(self, enabled: Any) -> None:
+        """Live toggle (`serving.prefix-cache-shared`) for cross-engine
+        prefix sharing: pass True (process-global registry), a specific
+        :class:`~.prefix_cache.SharedPrefixRegistry`, or False. Only
+        engines with an IDENTICAL weights fingerprint (params + LoRA
+        stack + draft) ever cross-hit; adapter scoping stays per-chain
+        exactly as in the local cache."""
+        from .prefix_cache import GLOBAL_SHARED_PREFIXES, SharedPrefixRegistry
+
+        if enabled is False or enabled is None:
+            self.blocks.disable_sharing()
+            return
+        if not self.pcfg.prefix_caching:
+            raise ValueError("prefix sharing requires prefix_caching=True")
+        reg = (enabled if isinstance(enabled, SharedPrefixRegistry)
+               else GLOBAL_SHARED_PREFIXES)
+        self.blocks.enable_sharing(reg, self._sharing_scope(),
+                                   self._export_block, self._import_block)
+
+    def reset_phase_stats(self) -> None:
+        """Zero the per-phase counters (benches call this after warm so
+        compile time never pollutes the reported breakdown)."""
+        for k in self.phase_seconds:
+            self.phase_seconds[k] = 0.0
+        for k in self.phase_counts:
+            self.phase_counts[k] = 0
+
+    def _sharing_scope(self) -> str:
+        """Content fingerprint isolating shared-prefix namespaces:
+        engines cross-hit only when target weights, LoRA stack, and
+        EFFECTIVE draft identity all match (different weights would
+        serve another model's KV; a draft-less engine's export lacks
+        draft KV). A guard-retired draft is excluded — the engine then
+        exports and imports exactly like the plain engine it now is;
+        _guard_decide rescopes (without it, a retired engine's
+        draft-less exports would squat the draft scope's publish-once
+        keys and every live spec engine's import would fail forever)."""
+        if self._sharing_scope_cache is None:
+            import hashlib
+
+            import numpy as _np
+
+            h = hashlib.blake2b(digest_size=16)
+
+            def feed(tag: bytes, tree: Any) -> None:
+                h.update(tag)
+                for leaf in jax.tree_util.tree_leaves(tree):
+                    h.update(str(leaf.shape).encode())
+                    h.update(str(leaf.dtype).encode())
+                    # STRIDED sample + whole-leaf checksum: a head-only
+                    # sample misses content that differs deeper in the
+                    # leaf (a stacked LoRA tree's leading adapter is
+                    # the shared zero adapter — two different stacks
+                    # fingerprinted identically and cross-hit)
+                    flat = jnp.ravel(leaf)
+                    stride = max(1, flat.shape[0] // 16)
+                    sample = _np.asarray(jax.device_get(
+                        flat[::stride][:16].astype(jnp.float32)))
+                    h.update(sample.tobytes())
+                    total = _np.asarray(jax.device_get(
+                        jnp.sum(flat.astype(jnp.float32))))
+                    h.update(total.tobytes())
+
+            h.update(repr(self.cfg).encode())
+            feed(b"params", self.params)
+            if self.loras is not None:
+                feed(b"loras", self.loras)
+            if self.draft_params is not None and self.spec_active:
+                h.update(repr(self.draft_cfg).encode())
+                feed(b"draft", self.draft_params)
+            self._sharing_scope_cache = h.hexdigest()
+        return self._sharing_scope_cache
+
+    def _export_block(self, blk: int) -> dict[str, jax.Array]:
+        """Shared-registry payload for one full prompt block: the K/V
+        slabs across all layers (device arrays — the slice is its own
+        buffer, so later donated pool updates can't corrupt it)."""
+        payload = {"k": self.pools["k"][:, blk], "v": self.pools["v"][:, blk]}
+        if self.draft_params is not None and self.spec_active:
+            payload["dk"] = self.dpools["k"][:, blk]
+            payload["dv"] = self.dpools["v"][:, blk]
+        return payload
+
+    def _import_block(self, blk: int, payload: dict) -> bool:
+        """Adopt another engine's exported block content into this
+        engine's pools (a scatter instead of a prefill forward). A spec
+        engine refuses payloads without draft KV — importing a hole
+        would silently collapse the accept rate."""
+        needs_draft = self.draft_params is not None and self.spec_active
+        if needs_draft and "dk" not in payload:
+            return False
+        if self._import_fn is None:
+            self._import_fn = jax.jit(
+                lambda pools, b, k, v: {
+                    "k": pools["k"].at[:, b].set(k),
+                    "v": pools["v"].at[:, b].set(v),
+                },
+                donate_argnums=(0,),
+            )
+        self.pools = self._import_fn(self.pools, blk, payload["k"],
+                                     payload["v"])
+        if needs_draft:
+            self.dpools = self._import_fn(self.dpools, blk, payload["dk"],
+                                          payload["dv"])
+        return True
+
     # -- scheduler ---------------------------------------------------------
 
     def step(self) -> list[int]:
@@ -314,7 +523,11 @@ class ServingEngine:
         per prefilling slot -> retire-finished -> grow/preempt ->
         fused decode -> retire). Returns rids that finished."""
         if (
-            self.pipeline_decode
+            # the device-resident horizon subsumes single-step
+            # pipelining: with decode_horizon > 1 every steady tick goes
+            # through the fused multi-step path instead
+            self.decode_horizon <= 1
+            and self.pipeline_decode
             # pipelining composes with a draft-capable engine only
             # AFTER the payoff guard turned speculation off for good:
             # from then on no tick drafts or syncs draft pools, so the
@@ -382,6 +595,17 @@ class ServingEngine:
             if slot is not None and slot.request.done:
                 done.append(slot.request.rid)
                 self._retire(i)
+        if not any(s is not None and s.ingest_pos is None for s in self.slots):
+            return done
+        if (self.decode_horizon > 1
+                and not any(s is not None and s.ingest_pos is not None
+                            for s in self.slots)):
+            hz = self._horizon_decode()
+            if hz is not None:
+                done.extend(hz)
+                return done
+            # horizon coverage unfundable without preemption: fall
+            # through to the classic tick, which preempts/retires
         self._ensure_growth()
         if not any(s is not None and s.ingest_pos is None for s in self.slots):
             return done
@@ -574,7 +798,7 @@ class ServingEngine:
         # final chunk
         logits_idx = self._run_chunk_graph(effective, prefix_blocks, start,
                                            p, slot.blocks, req.adapter)
-        tok = self._sample_host(logits_idx, req, slot_idx)
+        tok = self._sample_host(logits_idx, req)
         slot.ingest_pos = None
         slot.seq_len = p + 1
         shared_tokens = slot.shared_tokens
@@ -631,7 +855,7 @@ class ServingEngine:
         logits = self._dispatch_prefill(
             suffix_tokens, shared, shared_tokens,
             fresh[:n_sfx_blocks], bucket, req.adapter)
-        tok = self._sample_host(logits[0, sp - 1], req, slot_idx)
+        tok = self._sample_host(logits[0, sp - 1], req)
         self.slots[slot_idx] = _SlotState(req, shared + fresh, p + 1)
         self._record(slot_idx, req, tok)
         return True
@@ -657,6 +881,9 @@ class ServingEngine:
 
                 lora = select_adapter(self.loras, adapter)
                 self._adapter_cache[adapter] = lora
+        import time as _time
+
+        t0 = _time.perf_counter()
         self.pools, logits = self._run_prefill_graphs(
             self.params, self.pools, self.cfg,
             self._prefill_fns, self._prefill_seed_fns,
@@ -676,6 +903,7 @@ class ServingEngine:
                 suffix_tokens, prefix_blocks, prefix_len, target_blocks,
                 bucket, None, 1.0, False,
             )
+        self.phase_seconds["prefill"] += _time.perf_counter() - t0
         return logits
 
     def _run_prefill_graphs(self, params, pools, cfg, fns, seed_fns,
@@ -738,6 +966,436 @@ class ServingEngine:
             return self._guarded_tick()
         return self._spec_decode_once()
 
+    # -- device-resident horizon -------------------------------------------
+
+    def _horizon_decode(self) -> Optional[list[int]]:
+        """One fused multi-step decode horizon; None when per-slot
+        block coverage cannot be funded without preemption (the caller
+        falls back to the classic tick, which may preempt)."""
+        if self.draft_params is not None and self.spec_active:
+            if self.spec_guard and self.spec_guard_decision is None:
+                return self._guarded_horizon()
+            if self.spec_guard:
+                return self._watched_spec_horizon()
+            return self._spec_horizon_decode(self._spec_rounds())
+        return self._plain_horizon_decode(self.decode_horizon,
+                                          draft_sync=False)
+
+    def _watched_spec_horizon(self) -> Optional[list[int]]:
+        """Post-guard watchdog on a kept draft: the one-shot A/B window
+        is a few hundred tokens on a shared box — one noisy patch can
+        flip a LOSING draft on, and one-shot means production then pays
+        ~2x forever. Accumulate the realized spec rate over rolling
+        512-token windows and DEMOTE (one-way, no flapping back) the
+        moment a full window underperforms the guard's own recorded
+        plain rate. A wrong OFF loses a maybe-win; a wrong ON halves
+        throughput — only the harmful direction gets the watchdog."""
+        import time as _time
+
+        before = self._tokens_emitted
+        t0 = _time.perf_counter()
+        done = self._spec_horizon_decode(self._spec_rounds())
+        if done is None:
+            return None
+        w = self._spec_watch
+        w[0] += self._tokens_emitted - before
+        w[1] += _time.perf_counter() - t0
+        if w[0] >= 512 and w[1] > 0:
+            realized = w[0] / w[1]
+            floor = float(self.spec_guard_decision.get("plain_tok_s", 0.0))
+            if realized < floor:
+                self.spec_active = False
+                self._retire_draft_scope()
+                self.spec_guard_decision["demoted"] = {
+                    "realized_spec_tok_s": round(realized, 1),
+                    "plain_floor_tok_s": round(floor, 1),
+                    "window_tokens": int(w[0]),
+                }
+                metrics.serving_spec_active.set(0.0)
+            self._spec_watch = [0, 0.0]
+        return done
+
+    def _spec_rounds(self) -> int:
+        """Draft+verify rounds per horizon, sized so a well-accepting
+        draft commits about one horizon's worth of tokens per sync."""
+        return max(1, -(-self.decode_horizon // (self.spec_k + 1)))
+
+    def _decoding_slots(self) -> list[tuple[int, _SlotState]]:
+        return [(i, s) for i, s in enumerate(self.slots)
+                if s is not None and s.ingest_pos is None]
+
+    def _fund_lookahead(self, slot: _SlotState, tokens_ahead: int) -> bool:
+        """Grow the slot's table to cover ``tokens_ahead`` more commits
+        WITHOUT preemption (speculative lookahead must never evict a
+        live request); partial growth is kept — the blocks belong to
+        the slot either way.
+
+        With ``tokens_ahead <= rems`` the per-seq cap below is
+        unreachable (``submit`` bounds prompt+budget by capacity), so a
+        False here means POOL exhaustion — the caller drops to the
+        classic tick, whose preemption logic is the one place eviction
+        decisions live. Spec over-lookahead (rounds*(k+1) > rems) is
+        the only caller that can hit the cap, and it degrades that lane
+        to plain commits instead."""
+        need = self.pcfg.blocks_for(slot.seq_len + tokens_ahead)
+        if need > self.pcfg.max_blocks_per_seq:
+            return False
+        while len(slot.blocks) < need:
+            got = self.blocks.alloc(1)
+            if got is None:
+                return False
+            slot.blocks.extend(got)
+        return True
+
+    def _sync_device_state(self) -> None:
+        """Reconcile the on-device lane arrays with the host scheduler
+        state: diff each lane against the mirror of what the device
+        holds and patch ONLY the changed lanes (one tiny fused scatter
+        per changed lane). Catches every mutation path — admission,
+        retire, preempt, growth, and classic-tick interleaving —
+        without invalidation hooks."""
+        import numpy as np
+
+        MB = self.pcfg.max_blocks_per_seq
+        desired: list[dict] = []
+        for i, s in enumerate(self.slots):
+            if s is not None and s.ingest_pos is None:
+                req = s.request
+                desired.append({
+                    "last": int(self._last_tokens[i]),
+                    "seq": int(s.seq_len), "act": True,
+                    "emitted": len(req.output),
+                    "budget": int(req.max_new_tokens),
+                    "eos": -1 if req.eos_token is None else int(req.eos_token),
+                    "temp": float(req.temperature),
+                    "adapter": int(req.adapter), "rid": int(req.rid),
+                    "table": tuple(s.blocks),
+                })
+            else:
+                prev = self._dev_mirror[i]
+                lane = dict(prev) if prev is not None else {
+                    "last": 0, "seq": 1, "act": False, "emitted": 0,
+                    "budget": 0, "eos": -1, "temp": 0.0, "adapter": 0,
+                    "rid": 0, "table": (),
+                }
+                lane["act"] = False
+                desired.append(lane)
+        if self._dev is None:
+            tables = np.full((self.pcfg.max_slots, MB), SCRATCH_BLOCK,
+                             np.int32)
+            for i, lane in enumerate(desired):
+                tables[i, :len(lane["table"])] = lane["table"]
+            self._dev = {
+                "last": jnp.asarray([d["last"] for d in desired], jnp.int32),
+                "seq": jnp.asarray([d["seq"] for d in desired], jnp.int32),
+                "act": jnp.asarray([d["act"] for d in desired], jnp.bool_),
+                "emitted": jnp.asarray([d["emitted"] for d in desired],
+                                       jnp.int32),
+                "budget": jnp.asarray([d["budget"] for d in desired],
+                                      jnp.int32),
+                "eos": jnp.asarray([d["eos"] for d in desired], jnp.int32),
+                "temps": jnp.asarray([d["temp"] for d in desired],
+                                     jnp.float32),
+                "adapters": jnp.asarray([d["adapter"] for d in desired],
+                                        jnp.int32),
+                "rids": jnp.asarray([d["rid"] for d in desired], jnp.int32),
+                "tables": jnp.asarray(tables),
+            }
+            self._dev_mirror = desired
+            return
+        for i, (want, have) in enumerate(zip(desired, self._dev_mirror)):
+            if want == have:
+                continue
+            trow = np.full((MB,), SCRATCH_BLOCK, np.int32)
+            trow[:len(want["table"])] = want["table"]
+            self._dev = _patch_lane(
+                self._dev, i, want["last"], want["seq"], want["act"],
+                want["emitted"], want["budget"], want["eos"], want["temp"],
+                want["adapter"], want["rid"], jnp.asarray(trow))
+            self._dev_mirror[i] = want
+
+    def _plain_horizon_decode(self, horizon: int,
+                              draft_sync: bool) -> Optional[list[int]]:
+        """Dispatch one fused H-step decode scan and commit its token
+        block. With ``draft_sync`` (spec engine whose guard is still
+        measuring, or a spec tick with nothing to speculate) the
+        horizon's committed tokens are appended to the draft pools in
+        ONE fused T=H pass, keeping the draft cache lag-one current."""
+        import time as _time
+
+        acts = self._decoding_slots()
+        rems = {i: s.request.max_new_tokens - len(s.request.output)
+                for i, s in acts}
+        # ALWAYS the full horizon: on-device budget deactivation makes
+        # trailing no-op steps correct, and one compiled graph per
+        # horizon length beats a family of shrunken H variants whose
+        # compiles land mid-drain (measured: a 1.2s jit stall inside
+        # the timed bench region when a tail-shaped H first appeared)
+        H_eff = horizon
+        for i, s in acts:
+            if not self._fund_lookahead(s, min(H_eff, rems[i])):
+                return None
+        self._sync_device_state()
+        fn = self._hz_fns.get(H_eff)
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(_horizon_plain, cfg=self.cfg,
+                                  pcfg=self.pcfg, H=H_eff,
+                                  lora_scale=self.lora_scale,
+                                  is_moe=self.is_moe),
+                donate_argnums=(1,),
+            )
+            self._hz_fns[H_eff] = fn
+        d = self._dev
+        t0 = _time.perf_counter()
+        pools, (last, seq, act, emitted), toks = fn(
+            self.params, self.pools, d["last"], d["seq"], d["act"],
+            d["emitted"], d["budget"], d["eos"], d["temps"], d["adapters"],
+            d["rids"], d["tables"], self._base_key, self.loras)
+        jax.block_until_ready(toks)
+        dt = _time.perf_counter() - t0
+        self.phase_seconds["decode_device"] += dt
+        self.phase_counts["horizons"] += 1
+        self.phase_counts["device_steps"] += H_eff
+        metrics.serving_device_step.observe(dt, "decode")
+        metrics.serving_horizon.set(float(H_eff))
+        self.pools = pools
+        if draft_sync and any(s.request.temperature == 0 for _, s in acts):
+            t0 = _time.perf_counter()
+            self.dpools = self._hz_draft_sync_fn(H_eff)(
+                self.draft_params, self.dpools, toks, d["last"], d["seq"],
+                d["emitted"], emitted, d["tables"])
+            jax.block_until_ready(jax.tree_util.tree_leaves(self.dpools)[0])
+            self.phase_seconds["draft"] += _time.perf_counter() - t0
+        self._dev = {**d, "last": last, "seq": seq, "act": act,
+                     "emitted": emitted}
+        self._steps += H_eff
+        t0 = _time.perf_counter()
+        toks_h, last_h, seq_h, act_h, em_h = jax.device_get(
+            (toks, last, seq, act, emitted))
+        self.phase_seconds["host_sync"] += _time.perf_counter() - t0
+        self.phase_counts["host_syncs"] += 1
+        metrics.serving_host_syncs.inc("decode")
+        done: list[int] = []
+        for i, s in acts:
+            e = int(em_h[i]) - self._dev_mirror[i]["emitted"]
+            req = s.request
+            for t in range(e):
+                slot_tok = int(toks_h[t][i])
+                s.seq_len += 1
+                self._record(i, req, slot_tok)
+            if req.done:
+                done.append(req.rid)
+                self._retire(i)
+        self._mirror_from_device(last_h, seq_h, act_h, em_h)
+        return done
+
+    def _hz_draft_sync_fn(self, H_eff: int):
+        fn = self._hz_sync_fns.get(H_eff)
+        if fn is None:
+            from .spec_decode import make_draft_sync_block
+
+            fn = make_draft_sync_block(self.draft_cfg, self.pcfg, H_eff)
+            self._hz_sync_fns[H_eff] = fn
+        return fn
+
+    def _mirror_from_device(self, last_h, seq_h, act_h, em_h) -> None:
+        """After a horizon commit the device lane values are
+        authoritative — copy them into the mirror so the next sync
+        patches nothing unless the host scheduler really changed a
+        lane (retire already shows up as a plain ``act`` diff)."""
+        for i in range(self.pcfg.max_slots):
+            m = self._dev_mirror[i]
+            m["last"] = int(last_h[i])
+            m["seq"] = int(seq_h[i])
+            m["act"] = bool(act_h[i])
+            m["emitted"] = int(em_h[i])
+
+    def _spec_horizon_decode(self, rounds: int) -> Optional[list[int]]:
+        """R fused draft+verify+accept rounds with state device-resident
+        throughout; the host learns committed tokens and counts once at
+        the horizon boundary. Draft and verify stay separate dispatches
+        (still sync-free) so their cost split is measurable."""
+        import time as _time
+
+        acts = self._decoding_slots()
+        # ONE bundle read per horizon: k, the round fns, and the
+        # scatter width must all come from the same shape (live
+        # serving.spec-k reload safety)
+        k, (gather_fn, draft_fn, verify_fn) = self._spec_horizon_fns()
+        rems = {i: s.request.max_new_tokens - len(s.request.output)
+                for i, s in acts}
+        # lanes that cannot speculate (sampled, last-token budget, no
+        # coverage) ride the SAME rounds committing their one plain
+        # token through the verify step — no separate fallback graph,
+        # so a rare all-sampled horizon can never jit-compile a new
+        # shape mid-drain (observed: a 1.9s stall inside the timed
+        # bench region). Persistently all-sampled engines should not
+        # configure a draft; the payoff guard retires it anyway.
+        cov = [False] * self.pcfg.max_slots
+        for i, s in acts:
+            spec_capable = (s.request.temperature == 0 and rems[i] >= 2)
+            ahead = (min(rounds * (k + 1), rems[i]) if spec_capable
+                     else min(rounds, rems[i]))
+            ok = self._fund_lookahead(s, ahead)
+            if not ok and spec_capable:
+                # degrade THIS slot to plain commits rather than give
+                # up the horizon (mirrors _spec_coverage)
+                spec_capable = False
+                ok = self._fund_lookahead(s, min(rounds, rems[i]))
+            if not ok:
+                return None
+            cov[i] = spec_capable
+        self._sync_device_state()
+        d = self._dev
+        vk, vv = gather_fn(self.pools, d["tables"])
+        dvk, dvv = gather_fn(self.dpools, d["tables"])
+        cov_dev = jnp.asarray(cov, jnp.bool_)
+        last, seq, act, emitted = d["last"], d["seq"], d["act"], d["emitted"]
+        outs = []
+        for _r in range(rounds):
+            # NO sync between rounds: draft/verify dispatches chain on
+            # device, the host only enqueues. Phase seconds therefore
+            # attribute ENQUEUE wall here; the one real wait at the
+            # horizon boundary lands in host_sync (the honest place —
+            # it is where the host actually stalls).
+            t0 = _time.perf_counter()
+            dvk, dvv, props, spec_ok = draft_fn(
+                self.draft_params, dvk, dvv, last, seq, act, emitted,
+                d["budget"], d["temps"], cov_dev)
+            dt = _time.perf_counter() - t0
+            self.phase_seconds["draft"] += dt
+            metrics.serving_device_step.observe(dt, "draft")
+            t0 = _time.perf_counter()
+            (vk, vv, last, seq, act, emitted, c_out, ncommit,
+             stats) = verify_fn(
+                self.params, vk, vv, props, spec_ok, last, seq, act,
+                emitted, d["budget"], d["eos"], d["temps"], d["adapters"],
+                d["rids"], self._base_key, self.loras)
+            dt = _time.perf_counter() - t0
+            self.phase_seconds["verify"] += dt
+            metrics.serving_device_step.observe(dt, "verify")
+            outs.append((c_out, ncommit, stats))
+        self.phase_counts["spec_rounds"] += rounds
+        metrics.serving_spec_rounds.inc(by=rounds)
+        width = rounds * (k + 1)
+        scatter_fn = self._scatter_fn(width)
+        t0 = _time.perf_counter()
+        self.pools = scatter_fn(self.pools, vk, vv, d["tables"],
+                                d["seq"] - 1, d["act"])
+        self.dpools = scatter_fn(self.dpools, dvk, dvv, d["tables"],
+                                 d["seq"] - 1, d["act"])
+        self.phase_seconds["decode_device"] += _time.perf_counter() - t0
+        self._dev = {**d, "last": last, "seq": seq, "act": act,
+                     "emitted": emitted}
+        self._steps += rounds
+        self.phase_counts["horizons"] += 1
+        t0 = _time.perf_counter()
+        res = jax.device_get((outs, last, seq, act, emitted))
+        self.phase_seconds["host_sync"] += _time.perf_counter() - t0
+        self.phase_counts["host_syncs"] += 1
+        metrics.serving_host_syncs.inc("spec")
+        outs_h, last_h, seq_h, act_h, em_h = res
+        done: list[int] = []
+        drafted = accepted = 0
+        for c_out, ncommit, stats in outs_h:
+            drafted += int(stats[0])
+            accepted += int(stats[1])
+            for i, s in acts:
+                req = s.request
+                if req.done:
+                    continue
+                for t in range(int(ncommit[i])):
+                    s.seq_len += 1
+                    self._record(i, req, int(c_out[i][t]))
+        for i, s in acts:
+            if s.request.done:
+                done.append(s.request.rid)
+                self._retire(i)
+        if drafted:
+            self.spec_drafted += drafted
+            self.spec_accepted += accepted
+            metrics.serving_spec_tokens.inc("proposed", by=drafted)
+            metrics.serving_spec_tokens.inc("accepted", by=accepted)
+        self._mirror_from_device(last_h, seq_h, act_h, em_h)
+        return done
+
+    def _spec_horizon_fns(self):
+        """(k, (gather, draft, verify)) cached per spec_k — the tuple
+        keeps a horizon's k and its compiled round fns inseparable
+        across live spec-k reloads."""
+        cached = self._hz_spec_fns
+        if cached is None or cached[0] != self.spec_k:
+            from .spec_decode import make_spec_horizon_fns
+
+            k = self.spec_k
+            cached = (k, make_spec_horizon_fns(
+                self.cfg, self.draft_cfg, self.pcfg, k,
+                lora_scale=self.lora_scale))
+            self._hz_spec_fns = cached
+        return cached
+
+    def _scatter_fn(self, width: int):
+        fn = self._hz_scatter_fns.get(width)
+        if fn is None:
+            from .paged_cache import scatter_window
+
+            fn = jax.jit(
+                lambda pools, vk, vv, tables, start, ok: scatter_window(
+                    pools, vk, vv, tables, start, width, ok),
+                donate_argnums=(0,),
+            )
+            self._hz_scatter_fns[width] = fn
+        return fn
+
+    def _guarded_horizon(self) -> Optional[list[int]]:
+        """The payoff guard at horizon granularity: alternate one spec
+        round against one comparably-sized plain horizon (k+1 steps),
+        sampling realized tok/s each way; same decision logic and
+        one-shot semantics as the single-step guard."""
+        import time as _time
+
+        spec_n = len(self._guard_samples["spec"])
+        plain_n = len(self._guard_samples["plain"])
+        mode = "spec" if spec_n <= plain_n else "plain"
+        before = self._tokens_emitted
+        draft_before = self.phase_seconds["draft"]
+        t0 = _time.perf_counter()
+        if mode == "spec":
+            done = self._spec_horizon_decode(self._spec_rounds())
+        else:
+            # the FULL horizon, exactly the graph the post-guard plain
+            # path reuses (a shrunken guard-only H would add a compile
+            # and measure a graph production never runs)
+            done = self._plain_horizon_decode(self.decode_horizon,
+                                              draft_sync=True)
+        if done is None:
+            return None  # unfundable: no sample, classic tick decides
+        dt = _time.perf_counter() - t0
+        if mode == "plain":
+            # the draft-sync block keeps the draft cache current DURING
+            # measurement, but a guard-off engine never pays it — at
+            # horizon width its wall (a fused T=H draft forward) taxed
+            # the plain arm ~40% and flipped a losing draft ON
+            # (measured: plain_tok_s 438 vs a true 1895). Subtract the
+            # sync's own timed wall from the sample; the sync still ran.
+            dt = max(dt - (self.phase_seconds["draft"] - draft_before),
+                     1e-9)
+        emitted = self._tokens_emitted - before
+        samples = self._guard_samples[mode]
+        samples.append(emitted / dt if (samples and emitted and dt > 0)
+                       else -1.0)
+        # horizon samples aggregate a whole multi-step dispatch, so
+        # they are far less noisy than single-tick samples — half the
+        # tick budget (floor 2) decides without eating the warm pass
+        need = max(2, -(-self.spec_guard_ticks // 2))
+        if all(
+            len([x for x in self._guard_samples[m] if x > 0]) >= need
+            for m in ("spec", "plain")
+        ):
+            self._guard_decide()
+        return done
+
     # -- payoff guard ------------------------------------------------------
 
     def _guarded_tick(self) -> list[int]:
@@ -758,6 +1416,14 @@ class ServingEngine:
         # (observed r5: 0.98 -> 0.36 before this went through the sync)
         done = (self._spec_decode_once() if mode == "spec"
                 else self._plain_with_draft_sync())
+        if self.decode_horizon > 1:
+            # a horizon engine only lands here when a horizon was
+            # unfundable (memory pressure): the tick still commits
+            # correct tokens, but its per-token-sync rate is not
+            # comparable to the horizon samples the guard is
+            # collecting — recording it would mix granularities and
+            # could flip the one-shot decision
+            return done
         dt = _time.perf_counter() - t0
         emitted = self._tokens_emitted - before
         samples = self._guard_samples[mode]
@@ -781,6 +1447,8 @@ class ServingEngine:
         )
         keep = spec_rate >= plain_rate * (1.0 + self.spec_guard_margin)
         self.spec_active = keep
+        if not keep:
+            self._retire_draft_scope()
         self.spec_guard_decision = {
             "active": keep,
             "spec_tok_s": round(spec_rate, 1),
@@ -797,15 +1465,25 @@ class ServingEngine:
         }
         metrics.serving_spec_active.set(1.0 if keep else 0.0)
 
-    def _spec_coverage(self, slot: "_SlotState") -> bool:
+    def _retire_draft_scope(self) -> None:
+        """After the draft is retired (guard or watchdog) the engine
+        serves exactly like a draft-less engine: rescope prefix
+        sharing so its exports land in (and imports come from) the
+        plain-engine namespace instead of poisoning the draft scope
+        with dk-less payloads."""
+        if self.blocks._shared is not None:
+            self._sharing_scope_cache = None
+            self.blocks.rescope(self._sharing_scope())
+
+    def _spec_coverage(self, slot: "_SlotState", k: int) -> bool:
         """Ensure the slot's table covers verify writes through
-        seq_len + spec_k - 1; no preemption for speculative extras —
+        seq_len + k - 1; no preemption for speculative extras —
         failure just degrades this slot to plain decode this tick."""
-        need = self.pcfg.blocks_for(slot.seq_len + self.spec_k)
+        need = self.pcfg.blocks_for(slot.seq_len + k)
         if need <= len(slot.blocks):
             return True
         if (need > self.pcfg.max_blocks_per_seq
-                or slot.seq_len + self.spec_k > self.pcfg.capacity):
+                or slot.seq_len + k > self.pcfg.capacity):
             return False
         got = self.blocks.alloc(need - len(slot.blocks))
         if got is None:
@@ -820,6 +1498,9 @@ class ServingEngine:
         slots sample one token from the position-0 logits; slots
         without block coverage commit the position-0 argmax — both
         identical to a plain decode step."""
+        # ONE read of the (k, fn) bundle for the whole tick (live
+        # spec-k reload safety; see the ctor comment)
+        k, spec_fn = self._spec_shape
         active_l = [
             s is not None and s.ingest_pos is None for s in self.slots
         ]
@@ -829,7 +1510,7 @@ class ServingEngine:
                 active_l[i]
                 and slot.request.temperature == 0
                 and slot.request.max_new_tokens - len(slot.request.output) >= 2
-                and self._spec_coverage(slot)
+                and self._spec_coverage(slot, k)
             )
             spec_ok_l.append(ok)
         if not any(spec_ok_l):
@@ -856,11 +1537,16 @@ class ServingEngine:
         rids = jnp.asarray(
             [s.request.rid if s else 0 for s in self.slots], jnp.int32
         )
+        emitted = jnp.asarray(
+            [len(s.request.output) if (s and s.ingest_pos is None) else 0
+             for s in self.slots],
+            jnp.int32,
+        )
         self._steps += 1
-        self.pools, self.dpools, props, choice, sampled = self._spec_fn(
+        self.pools, self.dpools, props, choice, sampled = spec_fn(
             self.params, self.draft_params, self.pools, self.dpools,
             tokens, seq_lens, active, spec_ok, tables, temps,
-            self._keys, jnp.asarray(self._steps, jnp.int32), rids,
+            self._base_key, emitted, rids,
             self.loras, adapters,
         )
         props_h = jax.device_get(props).tolist()
@@ -879,7 +1565,7 @@ class ServingEngine:
                 commits = [int(choice_h[i][0])]
             else:
                 m = 0
-                while m < self.spec_k and props_h[i][m] == choice_h[i][m]:
+                while m < k and props_h[i][m] == choice_h[i][m]:
                     m += 1
                 commits = [int(t) for t in props_h[i][:m]]
                 commits.append(int(choice_h[i][m]))
@@ -895,9 +1581,9 @@ class ServingEngine:
                 # the commits, and accepted-but-never-emitted tokens
                 # would inflate the reported accept rate
                 accepted = min(m, emitted)
-                self.spec_drafted += self.spec_k
+                self.spec_drafted += k
                 self.spec_accepted += accepted
-                metrics.serving_spec_tokens.inc("proposed", by=self.spec_k)
+                metrics.serving_spec_tokens.inc("proposed", by=k)
                 metrics.serving_spec_tokens.inc("accepted", by=accepted)
             if req.done:
                 done.append(req.rid)
@@ -959,12 +1645,21 @@ class ServingEngine:
             tokens = prev["next"]
         tables = self._block_tables()
         self._steps += 1
-        # the per-step key fold happens INSIDE the compiled step (same
-        # fold_in values) — a separate vmapped dispatch per tick was
-        # pure host overhead
+        # the key fold happens INSIDE the compiled step (same fold_in
+        # values) — a separate vmapped dispatch per tick was pure host
+        # overhead. `emitted` counts the tokens already committed per
+        # request (+1 for a still-in-flight pipelined commit).
+        emitted = jnp.asarray(
+            [
+                (len(s.request.output) + (1 if i in pend_idx else 0))
+                if (s and s.ingest_pos is None) else 0
+                for i, s in enumerate(self.slots)
+            ],
+            jnp.int32,
+        )
         self.pools, next_tokens = self._decode_fn(
             self.params, self.pools, tokens, seq_lens, active, tables,
-            temps, self._keys, jnp.asarray(self._steps, jnp.int32), rids,
+            temps, self._base_key, emitted, rids,
             self.loras, adapters,
         )
         snapshot = [
@@ -1013,7 +1708,12 @@ class ServingEngine:
         slot churned since dispatch (retired/replaced) are discarded."""
         if tick is None:
             return []
+        import time as _time
+
+        t0 = _time.perf_counter()
         next_host = jax.device_get(tick["next"]).tolist()
+        self.phase_seconds["host_sync"] += _time.perf_counter() - t0
+        self.phase_counts["host_syncs"] += 1
         done: list[int] = []
         for i, rid in tick["snapshot"]:
             slot = self.slots[i]
@@ -1037,12 +1737,13 @@ class ServingEngine:
         ):
             req.done = True
 
-    def _sample_host(self, logits: jax.Array, req: Request, slot_idx: int) -> int:
+    def _sample_host(self, logits: jax.Array, req: Request) -> int:
+        """Sample the request's next token on the host (prefill's first
+        token) with the SAME (engine seed, rid, token index) key fold
+        as every fused kernel — scheduling-invariant by construction."""
         if req.temperature > 0:
-            # rid is folded in so slot reuse with no intervening decode
-            # tick still gives each request a distinct stream
             key = jax.random.fold_in(
-                jax.random.fold_in(self._keys[slot_idx], req.rid), self._steps
+                jax.random.fold_in(self._base_key, req.rid), len(req.output)
             )
             return int(jax.random.categorical(key, logits / req.temperature))
         return int(jnp.argmax(logits))
@@ -1072,6 +1773,172 @@ class ServingEngine:
 # ---------------------------------------------------------------------------
 # jitted kernels
 # ---------------------------------------------------------------------------
+
+
+def _fold_keys(base_key, rids, emitted):
+    """Per-slot sampling keys: ``fold_in(fold_in(base, rid), index)``.
+
+    Keyed by REQUEST identity and the request's own generated-token
+    index — never by slot or global step — so a sampled stream is a
+    pure function of (engine seed, rid, position): identical across
+    slot assignment, co-tenancy, preemption/recompute, and the
+    single-step vs horizon engines."""
+    def one(r, e):
+        return jax.random.fold_in(jax.random.fold_in(base_key, r), e)
+
+    return jax.vmap(one)(rids, emitted)
+
+
+@jax.jit
+def _patch_lane(dev, i, last, seq, act, emitted, budget, eos, temp,
+                adapter, rid, trow):
+    """Point-update ONE device lane (admission/retire/preempt/growth
+    delta) — the alternative is re-uploading every lane array per tick,
+    the exact host tax the horizon loop exists to kill.
+
+    Deliberately NOT donated: the previous horizon's windowed scatter
+    may still be in flight reading these exact buffers (tables/seq/act
+    are shared into it), and the lane arrays are kilobytes — donation
+    buys nothing and gambles on the runtime's donate-while-pending
+    copy semantics."""
+    return {
+        "last": dev["last"].at[i].set(last),
+        "seq": dev["seq"].at[i].set(seq),
+        "act": dev["act"].at[i].set(act),
+        "emitted": dev["emitted"].at[i].set(emitted),
+        "budget": dev["budget"].at[i].set(budget),
+        "eos": dev["eos"].at[i].set(eos),
+        "temps": dev["temps"].at[i].set(temp),
+        "adapters": dev["adapters"].at[i].set(adapter),
+        "rids": dev["rids"].at[i].set(rid),
+        "tables": dev["tables"].at[i].set(trow),
+    }
+
+
+def _forward_views(params, view_k, view_v, tokens, positions, write_ok, *,
+                   cfg: LlamaConfig, loras=None, adapter_idx=None,
+                   lora_scale: float = 1.0, is_moe: bool = False):
+    """Transformer forward for T tokens per slot over the PADDED
+    contiguous views (:func:`~.paged_cache.gather_views`): each token's
+    K/V is written into the views first (masked writes land in the
+    per-slot scratch column, so they can never corrupt a live
+    position), then position-masked attention reads the view directly —
+    no per-step pool gather. Returns ``((view_k, view_v), logits
+    [S, T, V] fp32)``. T=1 is the classic decode step minus sampling;
+    T=k+1 is the spec verify; T=H is the draft catch-up append."""
+    import math as _math
+
+    S, T = tokens.shape
+    cap1 = view_k.shape[2]
+    cap = cap1 - 1
+
+    def with_lora(out, h, layer_i, site):
+        if loras is None:
+            return out
+        site_stack = loras["layers"][layer_i].get(site)
+        if site_stack is None:
+            return out
+        return out + _lora_delta_slots(h, site_stack, adapter_idx, lora_scale)
+
+    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                             cfg.rope_theta, cfg.rope_scaling)
+    x = params["embed"]["weight"][tokens].astype(cfg.dtype)  # [S, T, D]
+    wpos = jnp.where(write_ok, jnp.clip(positions, 0, cap - 1), cap)
+    sl = jnp.arange(S)[:, None]
+    group = cfg.n_heads // cfg.n_kv_heads
+    scale = 1.0 / _math.sqrt(cfg.head_dim)
+    mask = jnp.arange(cap1)[None, None, :] <= positions[:, :, None]
+
+    for layer_i, layer in enumerate(params["layers"]):
+        h = rmsnorm_reference(x, layer["attn_norm"]["weight"], cfg.norm_eps)
+        q = with_lora(_mm(h, layer["attn"]["wq"]), h, layer_i, "wq").reshape(
+            S, T, cfg.n_heads, cfg.head_dim)
+        k = with_lora(_mm(h, layer["attn"]["wk"]), h, layer_i, "wk").reshape(
+            S, T, cfg.n_kv_heads, cfg.head_dim)
+        v = with_lora(_mm(h, layer["attn"]["wv"]), h, layer_i, "wv").reshape(
+            S, T, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, freqs, positions)
+        k = apply_rope(k, freqs, positions)
+
+        view_k = view_k.at[layer_i, sl, wpos].set(k.astype(view_k.dtype))
+        view_v = view_v.at[layer_i, sl, wpos].set(v.astype(view_v.dtype))
+
+        qf = q.astype(jnp.float32) * scale
+        kf = jnp.repeat(view_k[layer_i].astype(jnp.float32), group, axis=2)
+        vf = jnp.repeat(view_v[layer_i].astype(jnp.float32), group, axis=2)
+        scores = jnp.einsum("sthd,skhd->sthk", qf, kf)
+        scores = jnp.where(mask[:, :, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("sthk,skhd->sthd", probs, vf).astype(q.dtype)
+        o2 = out.reshape(S, T, cfg.dim)
+        x = x + with_lora(_mm(o2, layer["attn"]["wo"]), o2, layer_i, "wo")
+        if is_moe:
+            from ..models.moe import moe_mlp_block
+
+            x, _aux = moe_mlp_block(layer, x, cfg)
+        else:
+            h2 = rmsnorm_reference(x, layer["mlp_norm"]["weight"],
+                                   cfg.norm_eps)
+            gate = jax.nn.silu(
+                with_lora(_mm(h2, layer["mlp"]["w_gate"]), h2, layer_i,
+                          "w_gate").astype(jnp.float32))
+            up = with_lora(_mm(h2, layer["mlp"]["w_up"]), h2, layer_i,
+                           "w_up").astype(jnp.float32)
+            gu = (gate * up).astype(cfg.dtype)
+            x = x + with_lora(_mm(gu, layer["mlp"]["w_down"]), gu, layer_i,
+                              "w_down")
+
+    x = rmsnorm_reference(x, params["final_norm"]["weight"], cfg.norm_eps)
+    if getattr(cfg, "tie_embeddings", False):
+        logits = x @ params["embed"]["weight"].T.astype(cfg.dtype)
+    else:
+        logits = _mm(x, params["lm_head"]["weight"])
+    return (view_k, view_v), logits.astype(jnp.float32)
+
+
+def _horizon_plain(params, pools, last, seq, act, emitted, budget, eos,
+                   temps, adapters, rids, tables, base_key, loras, *,
+                   cfg: LlamaConfig, pcfg: PagedConfig, H: int,
+                   lora_scale: float = 1.0, is_moe: bool = False):
+    """H fused decode steps with ZERO host round-trips: the contiguous
+    KV views are gathered once, maintained in-scan, and persisted back
+    to the pools with one windowed scatter; liveness (eos / budget)
+    deactivates lanes on device. Returns
+    ``(pools, (last, seq, act, emitted), toks [H, S])`` where dead
+    lanes' token slots read -1."""
+    from .paged_cache import gather_views, scatter_window
+
+    vk, vv = gather_views(pools, tables)
+    start = seq - 1
+    act0 = act
+
+    def body(carry, _):
+        vk, vv, last, seq, act, emitted = carry
+        pos = (seq - 1)[:, None]
+        (vk, vv), logits = _forward_views(
+            params, vk, vv, last[:, None], pos, act[:, None], cfg=cfg,
+            loras=loras, adapter_idx=adapters, lora_scale=lora_scale,
+            is_moe=is_moe)
+        lg = logits[:, 0]
+        greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        keys = _fold_keys(base_key, rids, emitted)
+        sampled = jax.vmap(
+            lambda key, l, t: jax.random.categorical(
+                key, l / jnp.maximum(t, 1e-6))
+        )(keys, lg, temps).astype(jnp.int32)
+        tok = jnp.where(temps > 0, sampled, greedy)
+        emitted2 = emitted + act
+        seq2 = seq + act
+        done = ((eos >= 0) & (tok == eos)) | (emitted2 >= budget)
+        act2 = act & ~done
+        last2 = jnp.where(act, tok, last)
+        return ((vk, vv, last2, seq2, act2, emitted2),
+                jnp.where(act, tok, -1))
+
+    (vk, vv, last, seq, act, emitted), toks = jax.lax.scan(
+        body, (vk, vv, last, seq, act, emitted), None, length=H)
+    pools = scatter_window(pools, vk, vv, tables, start, H, act0)
+    return pools, (last, seq, act, emitted), toks
 
 
 def _family_forward(params, tokens, cfg, cache, positions, lora,
@@ -1144,15 +2011,14 @@ def _lora_delta_slots(h, site_stack, adapter_idx, scale):
 
 
 def _decode_step(params, pools, tokens, seq_lens, active, block_tables,
-                 temps, base_keys, step, rids, loras, adapter_idx, *,
+                 temps, base_key, emitted, rids, loras, adapter_idx, *,
                  cfg: LlamaConfig, pcfg: PagedConfig,
                  lora_scale: float = 1.0, is_moe: bool = False):
     """One fused token step for every slot (see module doc)."""
     S = pcfg.max_slots
-    # rid fold keeps streams distinct across slot reuse even when no
-    # decode tick separates two occupants of the same slot
-    keys = jax.vmap(jax.random.fold_in)(base_keys, rids)
-    keys = jax.vmap(jax.random.fold_in, (0, None))(keys, step)
+    # request-identity keys (rid + own token index): streams stay
+    # distinct across slot reuse AND identical across scheduling
+    keys = _fold_keys(base_key, rids, emitted)
 
     def with_lora(out, h, layer_i, site):
         if loras is None:

@@ -24,8 +24,16 @@ steps:
       paging: {maxSlots: 8, blockSize: 16, numBlocks: 512,
                maxBlocksPerSeq: 64, prefillChunk: 256}
       draft: {selfInt8: true, specK: 4}   # optional speculative decoding
+      decodeHorizon: 8                    # fused steps per host sync
+      prefixShared: true                  # cross-engine prefix sharing
       hub: bobravoz-hub.bobrapet-system.svc:50052
 ```
+
+``decodeHorizon``/``prefixShared`` default to the operator's live
+`serving.decode-horizon` / `serving.prefix-cache-shared` knobs (see
+:func:`apply_tuning`); pinning them in the step config opts the engine
+out of live reloads of that knob's build-time default (reloads still
+retune running engines).
 
 ``draft`` turns on engine-integrated speculative decoding:
 ``selfInt8`` drafts with an int8 quantization of the target (no extra
@@ -41,13 +49,75 @@ its completion count as the step output.
 
 from __future__ import annotations
 
-from typing import Any
+import logging
+import weakref
+from typing import Any, Optional
 
 from ..models import llama, moe, quant
 from ..models.lora import LoRAConfig, init_lora, stack_adapters, zero_lora
 from .engine import ServingEngine
 from .paged_cache import PagedConfig
 from .service import StreamServer
+
+_log = logging.getLogger(__name__)
+
+#: engines this process is currently serving — live-reload targets for
+#: the ``serving.*`` operator knobs (same pattern as
+#: ``dataplane.hub.apply_tuning``; weak so a drained server's engine
+#: does not outlive its step)
+_LIVE_ENGINES: "weakref.WeakSet[ServingEngine]" = weakref.WeakSet()
+#: last operator ServingConfig applied — build-time defaults for
+#: engines whose step config does not pin its own values
+_TUNING: Optional[Any] = None
+
+
+def _tuning() -> Optional[Any]:
+    """The operative serving.* defaults: the last apply_tuning push,
+    else whatever a Runtime parked in the no-jax handoff slot at
+    startup (this module is usually imported AFTER the control plane
+    boots, so a pre-existing ConfigMap's knobs arrive that way)."""
+    if _TUNING is not None:
+        return _TUNING
+    from ..config import operator as _opcfg
+
+    return _opcfg.LAST_SERVING_TUNING
+
+
+def apply_tuning(scfg: Any) -> None:
+    """Apply the operator's ``serving.*`` knobs to every live engine
+    (called from ``Runtime._on_config_change`` whenever this module is
+    loaded).
+
+    Step-PINNED values survive reloads: an engine built from a step
+    config that explicitly set ``decodeHorizon``/``specK``/
+    ``prefixShared`` keeps that knob (``_engram_pinned``) — otherwise
+    a reload of an UNRELATED key would clobber a deliberate per-step
+    choice (e.g. the ``decodeHorizon: 1`` parity reference). Engines
+    sharing through a custom registry (tenant isolation) are likewise
+    never swapped onto the global one nor silently detached. Per-engine
+    failures (e.g. `serving.prefix-cache-shared` on an engine built
+    with ``prefixCaching: false``) are logged and skipped — one misfit
+    engine must not block the fleet's reload."""
+    from .prefix_cache import GLOBAL_SHARED_PREFIXES
+
+    global _TUNING
+    _TUNING = scfg
+    for eng in list(_LIVE_ENGINES):
+        pinned = getattr(eng, "_engram_pinned", frozenset())
+        try:
+            if "decode_horizon" not in pinned:
+                eng.set_decode_horizon(scfg.decode_horizon)
+            if "spec_k" not in pinned:
+                eng.set_spec_k(scfg.spec_k)
+            if "prefix_shared" not in pinned:
+                current = eng.blocks._shared
+                if scfg.prefix_cache_shared:
+                    if current is None:
+                        eng.set_prefix_sharing(True)
+                elif current is None or current is GLOBAL_SHARED_PREFIXES:
+                    eng.set_prefix_sharing(False)
+        except ValueError as e:
+            _log.warning("serving.* reload skipped an engine: %s", e)
 
 
 def _moe_cfg(factory):
@@ -157,10 +227,44 @@ def build_engine(ctx) -> ServingEngine:
         loras, lora_scale = _build_loras(ctx, cfg, config["lora"])
     draft_params, draft_cfg, spec_k, spec_guard = _build_draft(
         ctx, config, cfg, params)
-    return ServingEngine(params, cfg, _paged_config(config.get("paging") or {}),
-                         loras=loras, lora_scale=lora_scale,
-                         draft_params=draft_params, draft_cfg=draft_cfg,
-                         spec_k=spec_k, spec_guard=spec_guard)
+    # step config pins build-time values; otherwise the operator's live
+    # serving.* knobs (last applied tuning / startup handoff) are the
+    # defaults
+    pcfg = _paged_config(config.get("paging") or {})
+    tuning = _tuning()
+    horizon = int(config.get(
+        "decodeHorizon", tuning.decode_horizon if tuning else 8))
+    shared = bool(config.get(
+        "prefixShared", tuning.prefix_cache_shared if tuning else False))
+    if (draft_params is not None and tuning is not None
+            and "specK" not in (config.get("draft") or {})):
+        # serving.spec-k is a build-time default exactly like the other
+        # two knobs (the step's own specK pins it)
+        spec_k = int(tuning.spec_k)
+    if shared and not pcfg.prefix_caching:
+        if "prefixShared" in config:
+            # explicitly asked for both: contradictory, fail loudly
+            raise ValueError("config.prefixShared requires "
+                             "paging.prefixCaching: true")
+        # the GLOBAL knob must not brick prefix-caching-disabled steps
+        # fleet-wide — this engine just cannot participate
+        _log.warning("serving.prefix-cache-shared skipped: step disables "
+                     "prefix caching")
+        shared = False
+    engine = ServingEngine(params, cfg, pcfg,
+                           loras=loras, lora_scale=lora_scale,
+                           draft_params=draft_params, draft_cfg=draft_cfg,
+                           spec_k=spec_k, spec_guard=spec_guard,
+                           decode_horizon=horizon, prefix_shared=shared)
+    # knobs the STEP pinned survive serving.* reloads (apply_tuning)
+    engine._engram_pinned = frozenset(
+        name for key, name in (("decodeHorizon", "decode_horizon"),
+                               ("prefixShared", "prefix_shared"))
+        if key in config
+    ) | (frozenset(["spec_k"])
+         if "specK" in (config.get("draft") or {}) else frozenset())
+    _LIVE_ENGINES.add(engine)
+    return engine
 
 
 def _load_params(ctx, family, cfg, ckpt, seed):

@@ -147,6 +147,67 @@ def gather_kv(
     return k.reshape(s, mb * b, h, d), v.reshape(s, mb * b, h, d)
 
 
+def gather_views(
+    pools: dict[str, jax.Array],
+    block_tables: jax.Array,  # [S, max_blocks_per_seq]
+) -> tuple[jax.Array, jax.Array]:
+    """Materialize every slot's contiguous cache view for ALL layers at
+    once: ``[L, S, capacity + 1, Hkv, Dh]`` each for K and V.
+
+    This is the device-resident horizon loop's amortization: the view
+    is gathered ONCE per horizon and maintained incrementally inside
+    the fused multi-step scan, instead of re-gathered from the pools on
+    every token (the reference einsum path's per-step cost driver).
+
+    The final column (index ``capacity``) is a per-slot scratch column:
+    masked in-scan writes land there so they can never corrupt a live
+    position of the slot's own view. It is never attended (positions
+    are always ``< capacity``) and never scattered back.
+    """
+    k = pools["k"][:, block_tables]  # [L, S, MB, B, H, D]
+    v = pools["v"][:, block_tables]
+    L, s, mb, b, h, d = k.shape
+    pad = [(0, 0), (0, 0), (0, 1), (0, 0), (0, 0)]
+    return (jnp.pad(k.reshape(L, s, mb * b, h, d), pad),
+            jnp.pad(v.reshape(L, s, mb * b, h, d), pad))
+
+
+def scatter_window(
+    pools: dict[str, jax.Array],
+    view_k: jax.Array,  # [L, S, capacity + 1, Hkv, Dh] (scratch-padded)
+    view_v: jax.Array,
+    block_tables: jax.Array,  # [S, max_blocks_per_seq]
+    start_pos: jax.Array,     # [S] first view position to persist
+    width: int,               # static window length
+    write_ok: jax.Array,      # [S] lanes that were live at dispatch
+) -> dict[str, jax.Array]:
+    """Persist a per-slot window of contiguous view positions back into
+    the block pools: positions ``[start_pos[s], start_pos[s] + width)``
+    of slot ``s``, mapped through its block table.
+
+    One scatter per horizon replaces a scatter per decoded token.
+    Positions past ``capacity``, past the funded table (scratch-padded
+    rows), or on dead lanes are redirected to the pool scratch block —
+    stale-but-masked by the engine's lag-one invariant."""
+    B = pools["k"].shape[2]
+    cap = block_tables.shape[1] * B
+    t = jnp.arange(width)[None, :]
+    pos = start_pos[:, None] + t                          # [S, W]
+    pos_c = jnp.clip(pos, 0, cap - 1)
+    row = jnp.take_along_axis(block_tables, pos_c // B, axis=1)
+    ok = write_ok[:, None] & (pos >= 0) & (pos < cap)
+    wb = jnp.where(ok, row, SCRATCH_BLOCK)
+    wo = jnp.where(ok, pos_c % B, 0)
+    S = pos.shape[0]
+    sl = jnp.arange(S)[:, None]
+    kvals = view_k[:, sl, pos_c]                          # [L, S, W, H, D]
+    vvals = view_v[:, sl, pos_c]
+    return {
+        "k": pools["k"].at[:, wb, wo].set(kvals.astype(pools["k"].dtype)),
+        "v": pools["v"].at[:, wb, wo].set(vvals.astype(pools["v"].dtype)),
+    }
+
+
 class BlockAllocator:
     """Host-side free-list allocator over the pool's block ids.
 

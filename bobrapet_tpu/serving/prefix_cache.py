@@ -22,13 +22,29 @@ window for free.
 Only FULL blocks are ever shared, and a matching request always keeps
 at least its final token out of the match (the sampler needs logits
 for it), so a non-empty suffix prefill is guaranteed.
+
+**Cross-engine sharing** (`serving.prefix-cache-shared`): a
+:class:`SharedPrefixRegistry` keeps exported block payloads (the K/V
+slabs across layers) keyed by ``(scope, chain hash)``, where ``scope``
+is the engine's weights fingerprint (target params + LoRA stack +
+draft identity — see ``ServingEngine._sharing_scope``). An engine that
+misses locally but hits the registry allocates a fresh block and
+ADOPTS the exported content with a scatter instead of re-running the
+prefill forward — repeated system prompts skip prefill regardless of
+which tenant's engine computed them first. Different weights hash to
+different scopes and can never cross-hit; the per-adapter ``salt``
+stays folded into the chain hash so adapter isolation carries over
+unchanged.
 """
 
 from __future__ import annotations
 
 import hashlib
-from typing import Optional
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Optional
 
+from ..observability.metrics import metrics
 from .paged_cache import BlockAllocator
 
 
@@ -40,6 +56,50 @@ def _chain_hash(parent: bytes, tokens: list[int]) -> bytes:
 
 
 ROOT = b"root"
+
+
+class SharedPrefixRegistry:
+    """Process-wide content-hash -> exported-block-payload map shared
+    by engine instances (bounded LRU; thread-safe — engines may serve
+    from different engram threads).
+
+    Payloads are DEVICE arrays: exporting a block slices its K/V out of
+    the donated pools into a standalone buffer, so the registry entry
+    stays valid however the exporting engine's pools evolve — at the
+    cost of holding that HBM until eviction. Size ``max_entries``
+    accordingly (one entry = one block's K/V across all layers,
+    target + draft for spec engines)."""
+
+    def __init__(self, max_entries: int = 512):
+        if max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple[str, bytes], dict] = OrderedDict()
+
+    def put(self, scope: str, h: bytes, payload: dict) -> None:
+        with self._lock:
+            key = (scope, h)
+            self._entries.pop(key, None)
+            self._entries[key] = payload
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def get(self, scope: str, h: bytes) -> Optional[dict]:
+        with self._lock:
+            payload = self._entries.get((scope, h))
+            if payload is not None:
+                self._entries.move_to_end((scope, h))
+            return payload
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+#: default registry for `serving.prefix-cache-shared: true` — every
+#: engine in the process that opts in shares through this instance
+GLOBAL_SHARED_PREFIXES = SharedPrefixRegistry()
 
 
 class PrefixCache:
@@ -57,6 +117,39 @@ class PrefixCache:
         self._refs: dict[int, int] = {}
         self.hit_tokens = 0
         self.miss_tokens = 0
+        # cross-engine sharing (disabled until enable_sharing): the
+        # registry plus the owning engine's export/import callbacks
+        self._shared: Optional[SharedPrefixRegistry] = None
+        self._scope: str = ""
+        self._export: Optional[Callable[[int], dict]] = None
+        self._import: Optional[Callable[[int, dict], bool]] = None
+        self.shared_hits = 0
+
+    # -- cross-engine sharing ----------------------------------------------
+
+    def enable_sharing(self, registry: SharedPrefixRegistry, scope: str,
+                       export_cb: Callable[[int], dict],
+                       import_cb: Callable[[int, dict], bool]) -> None:
+        """Join a shared registry under ``scope``: registered full
+        blocks are exported, and local match misses consult the
+        registry before giving up (adopting a hit via ``import_cb``).
+        Already-registered local blocks are NOT retro-exported — enable
+        sharing before serving traffic."""
+        self._shared = registry
+        self._scope = scope
+        self._export = export_cb
+        self._import = import_cb
+
+    def disable_sharing(self) -> None:
+        self._shared = None
+        self._export = None
+        self._import = None
+
+    def rescope(self, scope: str) -> None:
+        """Move future exports/imports to a new namespace (the engine's
+        effective identity changed, e.g. a payoff guard retired its
+        draft). Existing registry entries stay under the old scope."""
+        self._scope = scope
 
     # -- allocation (invalidating) ----------------------------------------
 
@@ -110,6 +203,15 @@ class PrefixCache:
             self._invalidate(blk)  # re-registration moves the hash
             self._by_hash[parent] = blk
             self._hash_of[blk] = parent
+            # capture locals: a live-reload can disable_sharing() from
+            # the config-watch thread between the check and the use
+            shared, export = self._shared, self._export
+            if shared is not None and export is not None:
+                # publish-once: the first engine to compute a chain
+                # block exports it; re-exports of identical content
+                # would only churn registry device memory
+                if shared.get(self._scope, parent) is None:
+                    shared.put(self._scope, parent, export(blk))
 
     def match_prefix(self, tokens: list[int],
                      salt: int = 0) -> tuple[list[int], int]:
@@ -125,6 +227,10 @@ class PrefixCache:
             parent = _chain_hash(parent, tokens[i * b:(i + 1) * b])
             blk = self._by_hash.get(parent)
             if blk is None:
+                blk = self._adopt_shared(parent)
+                if blk is not None:
+                    matched.append(blk)
+                    continue
                 break
             if blk in self._refs:
                 self._refs[blk] += 1
@@ -139,6 +245,33 @@ class PrefixCache:
         # refunded match (allocation failure, retry next tick) must not
         # inflate the hit rate
         return matched, len(matched) * b
+
+    def _adopt_shared(self, chain_hash: bytes) -> Optional[int]:
+        """Local miss: consult the shared registry and, on a scoped
+        hit, adopt the exported content into a freshly allocated local
+        block (a scatter instead of a prefill forward). Returns the
+        block id, or None (no entry / no memory / payload refused)."""
+        # locals against a concurrent disable_sharing() (see register)
+        shared, importer = self._shared, self._import
+        if shared is None or importer is None:
+            return None
+        payload = shared.get(self._scope, chain_hash)
+        if payload is None:
+            metrics.serving_prefix_shared.inc("miss")
+            return None
+        got = self.alloc(1)
+        if got is None:
+            return None  # memory pressure: admission will retry
+        blk = got[0]
+        if not importer(blk, payload):
+            metrics.serving_prefix_shared.inc("import-failed")
+            self.free(got)
+            return None
+        self._by_hash[chain_hash] = blk
+        self._hash_of[blk] = chain_hash
+        self.shared_hits += 1
+        metrics.serving_prefix_shared.inc("hit")
+        return blk
 
     def record_stats(self, total_tokens: int, hit: int) -> None:
         self.hit_tokens += hit

@@ -141,7 +141,7 @@ def _model_append(params, pools, tokens, pos0, write_ok, block_tables, *,
 
 
 def _spec_step(params, draft_params, pools, dpools, last_tokens, seq_lens,
-               active, spec_ok, block_tables, temps, base_keys, step, rids,
+               active, spec_ok, block_tables, temps, base_key, emitted, rids,
                loras, adapter_idx, *, cfg: LlamaConfig, dcfg: LlamaConfig,
                pcfg: PagedConfig, k: int, lora_scale: float = 1.0):
     """One fused speculative tick (see module doc).
@@ -189,9 +189,11 @@ def _spec_step(params, draft_params, pools, dpools, last_tokens, seq_lens,
     choice = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k+1]
 
     # -- temperature sampling from the position-0 logits (plain-decode
-    # equivalent; same rid+step key fold as _decode_step) ----------------
-    keys = jax.vmap(jax.random.fold_in)(base_keys, rids)
-    keys = jax.vmap(jax.random.fold_in, (0, None))(keys, step)
+    # equivalent; same request-identity (rid, token-index) key fold as
+    # _decode_step, so spec on/off cannot change a sampled stream) -------
+    from .engine import _fold_keys
+
+    keys = _fold_keys(base_key, rids, emitted)
     sampled = jax.vmap(
         lambda key, lg, t: jax.random.categorical(key, lg / jnp.maximum(t, 1e-6))
     )(keys, logits[:, 0], temps).astype(jnp.int32)
@@ -228,3 +230,147 @@ def make_draft_append(dcfg: LlamaConfig, pcfg: PagedConfig):
         functools.partial(_draft_append, dcfg=dcfg, pcfg=pcfg),
         donate_argnums=(1,),
     )
+
+
+# ---------------------------------------------------------------------------
+# device-resident horizon kernels (see engine.py "device-resident decode
+# horizon"): draft + verify + accept computed over the engine's gathered
+# contiguous KV views, the host learning only commit counts per horizon
+# ---------------------------------------------------------------------------
+
+
+def _draft_sync_block(draft_params, dpools, toks, last0, seq0, em0, em1,
+                      block_tables, *, dcfg: LlamaConfig, pcfg: PagedConfig,
+                      H: int):
+    """Catch the draft pools up on one PLAIN horizon's commits in a
+    single fused T=H pass: step ``t``'s input token (``last0`` at t=0,
+    the step t-1 commit after) is appended at position ``seq0-1+t`` for
+    every lane that actually took step t (``t < em1-em0``). Without
+    this, a spec-capable engine that decoded a horizon plainly (guard
+    measuring / nothing to speculate) would leave an H-token hole in
+    the draft cache and the accept rate would silently collapse — the
+    horizon-sized version of :func:`_draft_append`."""
+    toks_t = jnp.transpose(toks)                      # [S, H] commit order
+    ins = jnp.concatenate([last0[:, None], toks_t[:, :H - 1]], axis=1)
+    steps_taken = em1 - em0                           # [S]
+    wok = jnp.arange(H)[None, :] < steps_taken[:, None]
+    dpools, _ = _model_append(
+        draft_params, dpools, ins, seq0 - 1, wok, block_tables,
+        cfg=dcfg, pcfg=pcfg, T=H,
+    )
+    return dpools
+
+
+def make_draft_sync_block(dcfg: LlamaConfig, pcfg: PagedConfig, H: int):
+    return jax.jit(
+        functools.partial(_draft_sync_block, dcfg=dcfg, pcfg=pcfg, H=H),
+        donate_argnums=(1,),
+    )
+
+
+def make_spec_horizon_fns(cfg: LlamaConfig, dcfg: LlamaConfig,
+                          pcfg: PagedConfig, k: int,
+                          lora_scale: float = 1.0):
+    """The three compiled pieces of one device-resident speculative
+    round, all operating on the engine's gathered contiguous views so
+    no pool gather or host sync happens between rounds:
+
+    - ``gather_fn(pools, tables)`` — the once-per-horizon view gather;
+    - ``draft_fn(...)`` — ``k+1`` chained draft steps over the draft
+      views (the final step writes ``p_k``'s K/V, see :func:`_spec_step`),
+      returning ``(dvk, dvv, proposals [S, k], spec_ok [S])``;
+    - ``verify_fn(...)`` — ONE fused ``k+1``-token target step plus the
+      prefix-accept, eos/budget truncation, and lane-state advance
+      computed on device, returning the updated views and lane arrays,
+      the committed token block ``c_out [S, k+1]`` (-1 past the commit
+      count), per-lane commit counts, and (drafted, accepted) totals.
+
+    Draft and verify stay SEPARATE dispatches — still sync-free — so
+    the engine can attribute wall-clock to each phase (the ISSUE's
+    profitability instrumentation).
+    """
+    from .engine import _fold_keys, _forward_views
+    from .paged_cache import gather_views
+
+    gather_fn = jax.jit(gather_views)
+
+    def _draft(draft_params, dvk, dvv, last, seq, act, emitted, budget,
+               temps, cov):
+        # a lane speculates this round when the host funded lookahead
+        # coverage (cov), it is greedy, and at least 2 tokens of budget
+        # remain (a 1-token budget commits exactly the plain token)
+        spec_ok = act & cov & (temps == 0) & (budget - emitted >= 2)
+
+        def dstep(carry, i):
+            dvk_c, dvv_c, tok, pos = carry
+            wok = (act & (spec_ok | (i == 0)))[:, None]
+            (dvk_c, dvv_c), lg = _forward_views(
+                draft_params, dvk_c, dvv_c, tok[:, None], pos[:, None],
+                wok, cfg=dcfg)
+            nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
+            return (dvk_c, dvv_c, nxt, pos + 1), nxt
+
+        (dvk, dvv, _, _), props = jax.lax.scan(
+            dstep, (dvk, dvv, last, seq - 1), jnp.arange(k + 1))
+        return dvk, dvv, jnp.transpose(props)[:, :k], spec_ok
+
+    def _verify(params, vk, vv, props, spec_ok, last, seq, act, emitted,
+                budget, eos, temps, adapters, rids, base_key, loras):
+        ar = jnp.arange(k + 1)[None, :]
+        pos0 = seq - 1
+        verify_tokens = jnp.concatenate([last[:, None], props], axis=1)
+        wok = act[:, None] & (spec_ok[:, None] | (ar == 0))
+        (vk, vv), logits = _forward_views(
+            params, vk, vv, verify_tokens, pos0[:, None] + ar, wok,
+            cfg=cfg, loras=loras, adapter_idx=adapters,
+            lora_scale=lora_scale)
+        choice = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k+1]
+        keys = _fold_keys(base_key, rids, emitted)
+        sampled = jax.vmap(
+            lambda key, lg, t: jax.random.categorical(
+                key, lg / jnp.maximum(t, 1e-6))
+        )(keys, logits[:, 0], temps).astype(jnp.int32)
+
+        # prefix accept (the host loop of _spec_decode_once, vectorized):
+        # m = longest prefix of proposals matching the target's argmax
+        match = (props == choice[:, :k]).astype(jnp.int32)
+        m = jnp.cumprod(match, axis=1).sum(axis=1)              # [S]
+        # candidate commit block: spec lanes emit props[:m] + choice[m];
+        # non-spec active lanes emit exactly the plain-step token
+        cand_spec = jnp.where(
+            ar < m[:, None],
+            jnp.pad(props, ((0, 0), (0, 1))),
+            jnp.take_along_axis(choice, jnp.minimum(m, k)[:, None], axis=1),
+        )
+        one_tok = jnp.where(temps > 0, sampled, choice[:, 0])
+        cand = jnp.where(spec_ok[:, None], cand_spec,
+                         jnp.where(ar == 0, one_tok[:, None], 0))
+        n_raw = jnp.where(spec_ok, m + 1, 1) * act              # [S]
+
+        # eos/budget truncation, exactly the host commit loop: token j
+        # is emitted iff j < n_raw and no earlier token stopped the
+        # request; the stopping token itself IS emitted
+        valid = ar < n_raw[:, None]
+        stop = valid & (((eos[:, None] >= 0) & (cand == eos[:, None]))
+                        | (emitted[:, None] + ar + 1 >= budget[:, None]))
+        stop_before = jnp.cumsum(stop.astype(jnp.int32), axis=1) - stop
+        emit = valid & (stop_before == 0)
+        ncommit = emit.astype(jnp.int32).sum(axis=1)            # [S]
+        c_out = jnp.where(emit, cand, -1)
+        # accept-rate accounting AFTER truncation (engine counts the
+        # same way host-side: accepted-but-never-emitted would inflate)
+        drafted = jnp.where(spec_ok, k, 0).sum()
+        accepted = jnp.where(spec_ok, jnp.minimum(m, ncommit), 0).sum()
+
+        new_emitted = emitted + ncommit
+        done = (stop & emit).any(axis=1)
+        last_tok = jnp.take_along_axis(
+            cand, jnp.maximum(ncommit - 1, 0)[:, None], axis=1)[:, 0]
+        return (vk, vv,
+                jnp.where(act & (ncommit > 0), last_tok, last),
+                seq + ncommit, act & ~done, new_emitted,
+                c_out, ncommit, jnp.stack([drafted, accepted]))
+
+    return (gather_fn,
+            jax.jit(_draft, donate_argnums=(1, 2)),
+            jax.jit(_verify, donate_argnums=(1, 2)))

@@ -1,0 +1,516 @@
+"""Device-resident decode horizon: parity + cross-engine prefix sharing.
+
+The horizon engine (`decode_horizon > 1`) must emit BYTE-IDENTICAL
+output streams to the retained single-step reference engine
+(`decode_horizon=1`) for every scheduling shape: greedy, sampled with
+fixed seeds, mixed-temperature batches, speculation on/off, an EOS
+firing inside a horizon, and a preemption landing mid-drain. That is
+the contract that lets the fused multi-step scan replace the per-token
+host round-trip without a correctness asterisk.
+
+Sampling parity is not luck: sampled streams are a pure function of
+(engine seed, rid, token index) — `engine._fold_keys` — so slot
+assignment, co-tenancy, recompute, and horizon size cannot move them.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from bobrapet_tpu.models import llama, quant
+from bobrapet_tpu.serving import PagedConfig, ServingEngine
+from bobrapet_tpu.serving.prefix_cache import SharedPrefixRegistry
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def draft(model):
+    _cfg, params = model
+    return quant.quantize_params(params)
+
+
+def _pcfg(**over):
+    kw = dict(max_slots=4, block_size=16, num_blocks=128,
+              max_blocks_per_seq=8)
+    kw.update(over)
+    return PagedConfig(**kw)
+
+
+def _prompts(cfg, n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, cfg.vocab_size, 8 + (i % 5) * 7).tolist()
+            for i in range(n)]
+
+
+def _drain(engine, prompts, *, max_new=12, temps=None, eos=None):
+    for i, p in enumerate(prompts):
+        engine.submit(list(p), max_new_tokens=max_new,
+                      temperature=(temps[i] if temps else 0.0),
+                      eos_token=eos)
+    done = engine.run()
+    return {r.rid: r.output for r in done}
+
+
+class TestHorizonParity:
+    """Every case: horizon engine vs the decode_horizon=1 reference."""
+
+    def _pair(self, model, horizon=8, pc=None, **kw):
+        cfg, params = model
+        ref = ServingEngine(params, cfg, pc or _pcfg(),
+                            decode_horizon=1, **kw)
+        hz = ServingEngine(params, cfg, pc or _pcfg(),
+                           decode_horizon=horizon, **kw)
+        return ref, hz
+
+    def test_greedy_byte_identical(self, model):
+        cfg, _ = model
+        prompts = _prompts(cfg)
+        ref, hz = self._pair(model)
+        assert _drain(ref, prompts) == _drain(hz, prompts)
+        assert hz.phase_counts["horizons"] > 0
+        # the whole point: horizon syncs ~1/H as often as the
+        # reference commits tokens
+        assert hz.phase_counts["host_syncs"] < 8 * 12
+
+    def test_sampled_fixed_seed_byte_identical(self, model):
+        cfg, _ = model
+        prompts = _prompts(cfg, seed=3)
+        temps = [0.7, 1.1, 0.9, 1.3, 0.8, 1.0, 0.6, 1.2]
+        ref, hz = self._pair(model)
+        a = _drain(ref, prompts, temps=temps)
+        b = _drain(hz, prompts, temps=temps)
+        assert a == b
+
+    def test_mixed_temperature_batch_byte_identical(self, model):
+        cfg, _ = model
+        prompts = _prompts(cfg, seed=4)
+        temps = [0.0, 0.8, 0.0, 1.2, 0.0, 0.0, 0.9, 0.0]
+        ref, hz = self._pair(model)
+        assert _drain(ref, prompts, temps=temps) == _drain(
+            hz, prompts, temps=temps)
+
+    def test_eos_fires_inside_horizon(self, model):
+        cfg, _ = model
+        prompts = _prompts(cfg, seed=5)
+        ref, hz = self._pair(model)
+        base = _drain(ref, prompts, max_new=16)
+        # an eos token observed MID-stream: the horizon loop must stop
+        # the request on device at the same position the single-step
+        # reference stops it on host
+        eos = next(t for out in base.values() for t in out[3:10])
+        ref2, hz2 = self._pair(model)
+        a = _drain(ref2, prompts, max_new=16, eos=eos)
+        b = _drain(hz2, prompts, max_new=16, eos=eos)
+        assert a == b
+        assert any(len(v) < 16 for v in a.values())
+
+    def test_spec_on_off_byte_identical(self, model, draft):
+        cfg, _ = model
+        prompts = _prompts(cfg, seed=6)
+        ref, _unused = self._pair(model)
+        base = _drain(ref, prompts, max_new=14)
+        for horizon in (1, 8):
+            spec = ServingEngine(
+                model[1], cfg, _pcfg(), decode_horizon=horizon,
+                draft_params=draft, draft_cfg=cfg, spec_k=4,
+                spec_guard=False)
+            assert _drain(spec, prompts, max_new=14) == base
+            assert spec.spec_drafted > 0
+
+    def test_spec_horizon_mixed_temps_byte_identical(self, model, draft):
+        cfg, _ = model
+        prompts = _prompts(cfg, seed=7)
+        temps = [0.0, 0.9, 0.0, 1.1, 0.0, 0.7, 0.0, 0.0]
+        ref, _unused = self._pair(model)
+        base = _drain(ref, prompts, temps=temps)
+        spec = ServingEngine(model[1], cfg, _pcfg(), decode_horizon=8,
+                             draft_params=draft, draft_cfg=cfg, spec_k=4,
+                             spec_guard=False)
+        assert _drain(spec, prompts, temps=temps) == base
+
+    def test_preemption_mid_drain_byte_identical(self, model, draft):
+        """Tight block pool: growth preempts the youngest slot while
+        horizons are in flight; recompute + the request-identity key
+        scheme keep every stream byte-identical anyway."""
+        cfg, params = model
+        rng = np.random.default_rng(8)
+        prompts = [rng.integers(0, cfg.vocab_size, 10 + (i % 3) * 9).tolist()
+                   for i in range(6)]
+        pc = dict(max_slots=4, block_size=8, num_blocks=18,
+                  max_blocks_per_seq=8, prefix_caching=False)
+
+        def run(horizon, spec=False):
+            kw = dict(draft_params=draft, draft_cfg=cfg, spec_k=4,
+                      spec_guard=False) if spec else {}
+            eng = ServingEngine(params, cfg, PagedConfig(**pc),
+                                decode_horizon=horizon, **kw)
+            for p in prompts:
+                eng.submit(list(p), max_new_tokens=24)
+            done = eng.run()
+            return ({r.rid: r.output for r in done},
+                    sum(r.preemptions for r in done))
+
+        base, pre_ref = run(1)
+        hz, pre_hz = run(8)
+        spec_hz, _pre_spec = run(8, spec=True)
+        assert pre_ref > 0 and pre_hz > 0
+        assert base == hz == spec_hz
+
+    def test_horizon_live_reload_mid_stream(self, model):
+        """set_decode_horizon between ticks (the serving.decode-horizon
+        reload path) must not change a single output byte."""
+        cfg, params = model
+        prompts = _prompts(cfg, seed=9)
+        ref, _unused = self._pair(model)
+        base = _drain(ref, prompts, max_new=16)
+        eng = ServingEngine(params, cfg, _pcfg(), decode_horizon=4)
+        for p in prompts:
+            eng.submit(list(p), max_new_tokens=16)
+        for hz in (4, 1, 8, 2):
+            eng.set_decode_horizon(hz)
+            eng.step()
+        done = eng.run()
+        assert {r.rid: r.output for r in done} == base
+
+    def test_invalid_horizon_rejected(self, model):
+        cfg, params = model
+        with pytest.raises(ValueError):
+            ServingEngine(params, cfg, _pcfg(), decode_horizon=0)
+        eng = ServingEngine(params, cfg, _pcfg())
+        with pytest.raises(ValueError):
+            eng.set_decode_horizon(0)
+        with pytest.raises(ValueError):
+            eng.set_spec_k(0)
+
+
+class TestHorizonMetrics:
+    def test_horizon_series_emitted(self, model):
+        from bobrapet_tpu.observability.metrics import metrics
+
+        cfg, params = model
+        before = metrics.serving_host_syncs.value("decode")
+        eng = ServingEngine(params, cfg, _pcfg(), decode_horizon=8)
+        for p in _prompts(cfg, n=4, seed=10):
+            eng.submit(list(p), max_new_tokens=10)
+        eng.run()
+        assert metrics.serving_host_syncs.value("decode") > before
+        assert metrics.serving_horizon.value() == 8.0
+        assert eng.phase_counts["device_steps"] >= 10
+        # breakdown populated where the work happened
+        assert eng.phase_seconds["decode_device"] > 0
+        assert eng.phase_seconds["host_sync"] > 0
+        eng.reset_phase_stats()
+        assert eng.phase_seconds["decode_device"] == 0.0
+        assert eng.phase_counts["horizons"] == 0
+
+    def test_spec_round_series_emitted(self, model, draft):
+        from bobrapet_tpu.observability.metrics import metrics
+
+        cfg, params = model
+        before = metrics.serving_spec_rounds.value()
+        eng = ServingEngine(params, cfg, _pcfg(), decode_horizon=8,
+                            draft_params=draft, draft_cfg=cfg, spec_k=4,
+                            spec_guard=False)
+        for p in _prompts(cfg, n=4, seed=11):
+            eng.submit(list(p), max_new_tokens=10)
+        eng.run()
+        assert metrics.serving_spec_rounds.value() > before
+        assert eng.phase_seconds["draft"] > 0
+        assert eng.phase_seconds["verify"] > 0
+
+
+class TestPrefixSharing:
+    """Two engines with identical weights share prefix KV by content
+    hash through a SharedPrefixRegistry; different weights, draft
+    identity, or adapter stacks must never cross-hit."""
+
+    def _workload(self, cfg, seed=20):
+        rng = np.random.default_rng(seed)
+        system = rng.integers(0, cfg.vocab_size, 48).tolist()  # 3 blocks
+        tail = rng.integers(0, cfg.vocab_size, 9).tolist()
+        return system + tail
+
+    def test_same_weights_cross_hit_and_exact(self, model):
+        from bobrapet_tpu.observability.metrics import metrics
+
+        cfg, params = model
+        reg = SharedPrefixRegistry()
+        prompt = self._workload(cfg)
+        hits0 = metrics.serving_prefix_shared.value("hit")
+
+        a = ServingEngine(params, cfg, _pcfg(), prefix_shared=reg)
+        a.submit(list(prompt), max_new_tokens=8)
+        out_a = a.run()[0].output
+        assert len(reg) >= 3  # full prompt blocks exported
+
+        b = ServingEngine(params, cfg, _pcfg(), prefix_shared=reg)
+        b.submit(list(prompt), max_new_tokens=8)
+        out_b = b.run()[0].output
+        assert b.blocks.shared_hits >= 3
+        assert metrics.serving_prefix_shared.value("hit") >= hits0 + 3
+        assert out_b == out_a
+
+        # adopted KV must be EXACT: a share-less engine agrees
+        plain = ServingEngine(params, cfg, _pcfg())
+        plain.submit(list(prompt), max_new_tokens=8)
+        assert plain.run()[0].output == out_b
+
+    def test_different_weights_isolated(self, model):
+        from bobrapet_tpu.observability.metrics import metrics
+
+        cfg, params = model
+        other = llama.init_params(jax.random.PRNGKey(7), cfg)
+        reg = SharedPrefixRegistry()
+        prompt = self._workload(cfg, seed=21)
+        a = ServingEngine(params, cfg, _pcfg(), prefix_shared=reg)
+        a.submit(list(prompt), max_new_tokens=6)
+        a.run()
+        miss0 = metrics.serving_prefix_shared.value("miss")
+        c = ServingEngine(other, cfg, _pcfg(), prefix_shared=reg)
+        c.submit(list(prompt), max_new_tokens=6)
+        c.run()
+        assert c.blocks.shared_hits == 0
+        assert metrics.serving_prefix_shared.value("miss") > miss0
+
+    def test_draft_identity_isolated(self, model, draft):
+        """A spec engine's scope includes its draft: it must not adopt
+        a draft-less export (the hole would collapse the accept rate),
+        and vice versa."""
+        cfg, params = model
+        reg = SharedPrefixRegistry()
+        prompt = self._workload(cfg, seed=22)
+        a = ServingEngine(params, cfg, _pcfg(), prefix_shared=reg)
+        a.submit(list(prompt), max_new_tokens=6)
+        a.run()
+        s = ServingEngine(params, cfg, _pcfg(), draft_params=draft,
+                          draft_cfg=cfg, spec_k=4, spec_guard=False,
+                          prefix_shared=reg)
+        s.submit(list(prompt), max_new_tokens=6)
+        s.run()
+        assert s.blocks.shared_hits == 0
+
+    def test_adapter_stacks_isolated(self, model):
+        """Engines whose LoRA stacks differ hash to different scopes;
+        within one engine the per-adapter salt still separates chains
+        exactly as the local cache always did."""
+        from bobrapet_tpu.models.lora import (
+            LoRAConfig, init_lora, stack_adapters, zero_lora,
+        )
+
+        cfg, params = model
+        lcfg = LoRAConfig(rank=4, alpha=8.0, sites=("wq", "wv"))
+        stack1 = stack_adapters([
+            zero_lora(cfg, lcfg),
+            init_lora(jax.random.PRNGKey(1), cfg, lcfg),
+        ])
+        stack2 = stack_adapters([
+            zero_lora(cfg, lcfg),
+            init_lora(jax.random.PRNGKey(2), cfg, lcfg),
+        ])
+        reg = SharedPrefixRegistry()
+        prompt = self._workload(cfg, seed=23)
+        a = ServingEngine(params, cfg, _pcfg(), loras=stack1,
+                          prefix_shared=reg)
+        a.submit(list(prompt), max_new_tokens=6)
+        a.run()
+        b = ServingEngine(params, cfg, _pcfg(), loras=stack2,
+                          prefix_shared=reg)
+        b.submit(list(prompt), max_new_tokens=6)
+        b.run()
+        assert b.blocks.shared_hits == 0
+
+    def test_registry_lru_bound(self):
+        reg = SharedPrefixRegistry(max_entries=2)
+        reg.put("s", b"a", {"k": 1})
+        reg.put("s", b"b", {"k": 2})
+        reg.put("s", b"c", {"k": 3})
+        assert len(reg) == 2
+        assert reg.get("s", b"a") is None
+        assert reg.get("s", b"c") == {"k": 3}
+
+    def test_sharing_requires_prefix_caching(self, model):
+        cfg, params = model
+        with pytest.raises(ValueError):
+            ServingEngine(params, cfg, _pcfg(prefix_caching=False),
+                          prefix_shared=SharedPrefixRegistry())
+
+
+class TestServingConfigKnobs:
+    """`serving.*` operator keys: registration, validation, and the
+    live-reload path through serving/engram.apply_tuning."""
+
+    def test_keys_parse_and_validate(self):
+        from bobrapet_tpu.config.operator import parse_config
+
+        cfg = parse_config({
+            "serving.decode-horizon": "16",
+            "serving.spec-k": "6",
+            "serving.prefix-cache-shared": "true",
+        })
+        assert cfg.serving.decode_horizon == 16
+        assert cfg.serving.spec_k == 6
+        assert cfg.serving.prefix_cache_shared is True
+        assert cfg.validate() == []
+
+    def test_horizon_validation_floor(self):
+        from bobrapet_tpu.config.operator import OperatorConfig
+
+        cfg = OperatorConfig()
+        cfg.serving.decode_horizon = 0
+        assert any("serving.decode-horizon" in e for e in cfg.validate())
+        cfg.serving.decode_horizon = 8
+        cfg.serving.spec_k = 0
+        assert any("serving.spec-k" in e for e in cfg.validate())
+
+    def test_apply_tuning_retunes_live_engine(self, model):
+        from bobrapet_tpu.config.operator import ServingConfig
+        from bobrapet_tpu.serving import engram
+
+        cfg, params = model
+        eng = ServingEngine(params, cfg, _pcfg(), decode_horizon=4)
+        engram._LIVE_ENGINES.add(eng)
+        try:
+            engram.apply_tuning(ServingConfig(
+                decode_horizon=16, spec_k=5, prefix_cache_shared=False))
+            assert eng.decode_horizon == 16
+            assert eng.spec_k == 5
+            # prefix sharing toggles on live through the global registry
+            engram.apply_tuning(ServingConfig(prefix_cache_shared=True))
+            assert eng.blocks._shared is not None
+            engram.apply_tuning(ServingConfig(prefix_cache_shared=False))
+            assert eng.blocks._shared is None
+        finally:
+            engram._LIVE_ENGINES.discard(eng)
+            engram._TUNING = None
+
+    def test_apply_tuning_respects_step_pinned_knobs(self, model):
+        """A reload of UNRELATED keys must not clobber step-pinned
+        values or swap a custom tenant registry for the global one."""
+        from bobrapet_tpu.config.operator import ServingConfig
+        from bobrapet_tpu.serving import engram
+
+        cfg, params = model
+        reg = SharedPrefixRegistry()
+        eng = ServingEngine(params, cfg, _pcfg(), decode_horizon=1,
+                            prefix_shared=reg)
+        eng._engram_pinned = frozenset({"decode_horizon", "prefix_shared"})
+        engram._LIVE_ENGINES.add(eng)
+        try:
+            engram.apply_tuning(ServingConfig(
+                decode_horizon=8, prefix_cache_shared=False))
+            assert eng.decode_horizon == 1  # pinned parity reference
+            assert eng.blocks._shared is reg  # custom registry kept
+            # unpinned engine with a CUSTOM registry: never detached by
+            # the operator default nor swapped onto the global registry
+            eng._engram_pinned = frozenset()
+            engram.apply_tuning(ServingConfig(prefix_cache_shared=False))
+            assert eng.blocks._shared is reg
+            engram.apply_tuning(ServingConfig(prefix_cache_shared=True))
+            assert eng.blocks._shared is reg
+        finally:
+            engram._LIVE_ENGINES.discard(eng)
+            engram._TUNING = None
+
+    def test_guard_retired_draft_rescopes_to_plain(self, model, draft):
+        """A spec engine whose payoff guard retires the draft must
+        export/import in the PLAIN engine's namespace — its dk-less
+        exports would otherwise squat the draft scope's publish-once
+        keys and poison every live spec engine's imports."""
+        cfg, params = model
+        reg = SharedPrefixRegistry()
+        rng = np.random.default_rng(30)
+        system = rng.integers(0, cfg.vocab_size, 48).tolist()
+        spec = ServingEngine(params, cfg, _pcfg(), draft_params=draft,
+                             draft_cfg=cfg, spec_k=4, spec_guard=True,
+                             spec_guard_ticks=2, decode_horizon=8,
+                             prefix_shared=reg)
+        for i in range(8):
+            spec.submit(system + [i], max_new_tokens=24)
+        spec.run()
+        assert spec.spec_guard_decision is not None
+        if spec.spec_active:
+            pytest.skip("guard kept speculation on this box")
+        # pre-decision registrations exported under the draft scope; a
+        # POST-retirement prefill re-registers the chain and publishes
+        # it under the engine's new (plain) scope
+        spec.submit(system + [50], max_new_tokens=4)
+        spec.run()
+        # after retirement the scope equals a plain engine's: a plain
+        # engine adopts this engine's exports
+        plain = ServingEngine(params, cfg, _pcfg(), prefix_shared=reg)
+        plain.submit(system + [99], max_new_tokens=8)
+        out_p = plain.run()[0].output
+        assert plain.blocks.shared_hits >= 3
+        ref = ServingEngine(params, cfg, _pcfg())
+        ref.submit(system + [99], max_new_tokens=8)
+        assert ref.run()[0].output == out_p
+
+    def test_horizon_reload_rearms_spec_guard(self, model, draft):
+        """serving.decode-horizon reload changes the guard's
+        measurement shape: a kept/retired decision (and the watchdog's
+        plain-rate floor) from the old horizon must be re-measured, not
+        compared across cadences (a stale floor spuriously demotes a
+        profitable draft one-way)."""
+        cfg, params = model
+        rng = np.random.default_rng(31)
+        eng = ServingEngine(params, cfg, _pcfg(), draft_params=draft,
+                            draft_cfg=cfg, spec_k=4, spec_guard=True,
+                            spec_guard_ticks=2, decode_horizon=8)
+        for i in range(8):
+            eng.submit(rng.integers(0, cfg.vocab_size, 12).tolist(),
+                       max_new_tokens=32)
+        eng.run()
+        assert eng.spec_guard_decision is not None
+        eng.set_decode_horizon(2)
+        assert eng.spec_guard_decision is None
+        assert eng.spec_active  # the draft gets a fresh A/B at H=2
+        # same horizon again: no spurious re-arm
+        eng.submit(rng.integers(0, cfg.vocab_size, 12).tolist(),
+                   max_new_tokens=32)
+        eng.run()
+        decided = eng.spec_guard_decision
+        eng.set_decode_horizon(2)
+        assert eng.spec_guard_decision is decided
+
+    def test_startup_configmap_seeds_serving_tuning(self, model):
+        """A ConfigMap that EXISTS at manager startup must reach
+        engines built later in the process — subscribers only fire on
+        reloads, so Runtime seeds the engram tuning at construction."""
+        from bobrapet_tpu.core.object import new_resource
+        from bobrapet_tpu.core.store import ResourceStore
+        from bobrapet_tpu.runtime import Runtime
+        from bobrapet_tpu.serving import engram
+
+        store = ResourceStore()
+        store.create(new_resource(
+            "ConfigMap", "operator-config", "bobrapet-system",
+            spec={"data": {"serving.decode-horizon": "16"}}))
+        prev = engram._TUNING
+        try:
+            Runtime(store=store)
+            assert engram._TUNING is not None
+            assert engram._TUNING.decode_horizon == 16
+        finally:
+            engram._TUNING = prev
+
+    def test_apply_tuning_survives_misfit_engine(self, model):
+        """prefix-cache-shared on an engine built without prefix
+        caching is a per-engine skip, not a fleet-wide reload crash."""
+        from bobrapet_tpu.config.operator import ServingConfig
+        from bobrapet_tpu.serving import engram
+
+        cfg, params = model
+        eng = ServingEngine(params, cfg, _pcfg(prefix_caching=False))
+        engram._LIVE_ENGINES.add(eng)
+        try:
+            engram.apply_tuning(ServingConfig(prefix_cache_shared=True))
+            assert eng.decode_horizon == 8  # the rest still applied
+        finally:
+            engram._LIVE_ENGINES.discard(eng)
+            engram._TUNING = None
