@@ -442,6 +442,40 @@ def config5_nested_rag() -> dict:
     }
 
 
+def _slo_lines(reqs, config_name: str, new_tokens: int, **key_fields) -> list:
+    """TTFT/TPOT p50/p95/p99 metric lines from a measured drain's
+    finished requests (ROADMAP 4a: request-level latency joins the
+    regression gate so it can never silently regress the way
+    `llama_decode_tokens_per_sec_per_chip` did). One gated line per
+    percentile; the names live in GATE_LOWER_IS_BETTER."""
+
+    def pctl(vals, q):
+        return vals[min(len(vals) - 1, round(q * (len(vals) - 1)))]
+
+    lines = []
+    samples = {
+        "ttft": sorted(r.ttft_seconds for r in reqs
+                       if r.ttft_seconds is not None),
+        "tpot": sorted(r.tpot_seconds for r in reqs
+                       if r.tpot_seconds is not None),
+    }
+    for name, vals in samples.items():
+        if not vals:
+            continue
+        for q, tag in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            lines.append({
+                "metric": f"serving_{name}_ms_{tag}",
+                "value": round(pctl(vals, q) * 1000.0, 3),
+                "unit": "ms",
+                "vs_baseline": 1.0,
+                "config": config_name,
+                "new_tokens": new_tokens,
+                "samples": len(vals),
+                **key_fields,
+            })
+    return lines
+
+
 def _phase_fields(engine) -> dict:
     """Flatten the engine's per-phase wall-clock counters into the
     metric line (`prefill_s`/`decode_device_s`/`host_sync_s`/`draft_s`
@@ -499,7 +533,11 @@ def config6_serving() -> dict:
 
     one_drain(seed=99)  # compile every graph the drain touches
     eng.reset_phase_stats()
+    measured_from = len(eng.finished)  # warm drain's TTFT is compile-polluted
     best = max(one_drain(), one_drain(seed=98))
+    for line in _slo_lines(eng.finished[measured_from:], "serving",
+                           new_tokens, requests=n_requests):
+        _emit(line)
     return {
         "metric": "serving_decode_tokens_per_sec",
         "value": round(best, 1),
@@ -1348,10 +1386,15 @@ def run_serving_child() -> None:
     # only the LAST leg — possibly the load-spiked one).
     eng.reset_phase_stats()
     spec_eng.reset_phase_stats()
+    measured_from = len(eng.finished)
     walls = {id(eng): [], id(spec_eng): []}
     for leg_seed, target in ((11, eng), (12, spec_eng),
                              (13, eng), (14, spec_eng)):
         walls[id(target)].append(timed_tokens(target, seed=leg_seed))
+    for line in _slo_lines(eng.finished[measured_from:], "serving",
+                           n_new, requests=n_req, backend=backend,
+                           model=model_name):
+        _emit(line)
     serving_tokens, serving_wall = min(
         walls[id(eng)], key=lambda p: p[1] / p[0])
     _emit({
@@ -1521,7 +1564,14 @@ def _spawn_passthrough(child: str, model: str | None, timeout: float,
 # ---------------------------------------------------------------------------
 
 #: metrics where a LOWER value is the improvement
-GATE_LOWER_IS_BETTER = frozenset({"entry_forward_step_ms"})
+GATE_LOWER_IS_BETTER = frozenset({
+    "entry_forward_step_ms",
+    # request-level serving SLO percentiles (ROADMAP 4a: latency is
+    # gated exactly like throughput — an unexplained p95 TTFT rise
+    # fails the bench)
+    "serving_ttft_ms_p50", "serving_ttft_ms_p95", "serving_ttft_ms_p99",
+    "serving_tpot_ms_p50", "serving_tpot_ms_p95", "serving_tpot_ms_p99",
+})
 
 
 def _gate_key(d: dict) -> tuple:

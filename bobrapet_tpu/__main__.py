@@ -32,6 +32,78 @@ _log = logging.getLogger("bobrapet.manager")
 # ---------------------------------------------------------------------------
 
 
+def _span_dict(span) -> dict:
+    return {
+        "name": span.name,
+        "traceId": span.trace_id,
+        "spanId": span.span_id,
+        "parentSpanId": span.parent_span_id,
+        "startTime": span.start_time,
+        "endTime": span.end_time,
+        "status": span.status,
+        "attributes": {k: str(v) for k, v in span.attributes.items()},
+        "events": [{"at": ts, "name": msg} for ts, msg in span.events],
+    }
+
+
+def _debug_response(state: dict, path: str) -> tuple[bytes, int, str]:
+    """``/debug/runs/<ns>/<name>`` (or ``/debug/runs/<name>`` in the
+    default namespace) -> the run's flight-recorder timeline + status
+    summary; ``/debug/traces/<traceId>`` -> the trace's spans (when the
+    tracer keeps an in-memory exporter) + every linked run's timeline.
+    Gated by `telemetry.debug-endpoints` (live) and the same bearer
+    token as /metrics (checked by the caller)."""
+    from .observability.timeline import FLIGHT
+
+    rt = state.get("rt")
+    if rt is None:
+        return b"not ready", 503, "text/plain"
+    if not rt.config_manager.config.telemetry.debug_endpoints:
+        return b"not found", 404, "text/plain"
+    parts = [p for p in path.split("/") if p]
+    if len(parts) in (3, 4) and parts[1] == "runs":
+        ns, name = (("default", parts[2]) if len(parts) == 3
+                    else (parts[2], parts[3]))
+        run = rt.store.try_get("StoryRun", ns, name)
+        timeline = FLIGHT.timeline(ns, name)
+        if run is None and not timeline:
+            return b"unknown run", 404, "text/plain"
+        payload = {
+            "namespace": ns,
+            "run": name,
+            "live": run is not None,
+            "phase": run.status.get("phase") if run is not None else None,
+            "reason": run.status.get("reason") if run is not None else None,
+            "trace": run.status.get("trace") if run is not None else None,
+            "error": run.status.get("error") if run is not None else None,
+            "timeline": timeline,
+        }
+        return (json.dumps(payload, default=str).encode(), 200,
+                "application/json")
+    if len(parts) == 3 and parts[1] == "traces":
+        trace_id = parts[2]
+        exporter = rt.tracer.exporter
+        spans = (
+            [_span_dict(s) for s in exporter.by_trace(trace_id)]
+            if hasattr(exporter, "by_trace") else []
+        )
+        runs = FLIGHT.runs_for_trace(trace_id)
+        if not spans and not runs:
+            return b"unknown trace", 404, "text/plain"
+        payload = {
+            "traceId": trace_id,
+            "spans": spans,
+            "runs": [
+                {"namespace": ns, "run": name,
+                 "timeline": FLIGHT.timeline(ns, name)}
+                for ns, name in runs
+            ],
+        }
+        return (json.dumps(payload, default=str).encode(), 200,
+                "application/json")
+    return b"not found", 404, "text/plain"
+
+
 def _serve_http(state: dict, bind: str, token: str | None) -> http.server.ThreadingHTTPServer:
     """``state['rt']`` is None while this replica waits on leader
     election — /healthz stays green (the standby is alive and warm, the
@@ -52,6 +124,7 @@ def _serve_http(state: dict, bind: str, token: str | None) -> http.server.Thread
             return header == f"Bearer {token}"
 
         def do_GET(self):  # noqa: N802 - stdlib interface
+            ctype = "text/plain; version=0.0.4"
             if self.path == "/healthz":
                 body, code = b"ok", 200
             elif self.path == "/readyz":
@@ -64,10 +137,18 @@ def _serve_http(state: dict, bind: str, token: str | None) -> http.server.Thread
                     self.end_headers()
                     return
                 body, code = REGISTRY.expose().encode(), 200
+            elif self.path.startswith("/debug/"):
+                # token-gated exactly like /metrics: timelines carry
+                # run identities and error messages
+                if not self._authorized():
+                    self.send_response(403)
+                    self.end_headers()
+                    return
+                body, code, ctype = _debug_response(state, self.path)
             else:
                 body, code = b"not found", 404
             self.send_response(code)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

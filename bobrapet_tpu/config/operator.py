@@ -180,6 +180,28 @@ LAST_SERVING_TUNING: Optional[ServingConfig] = None
 
 
 @dataclasses.dataclass
+class TelemetryConfig:
+    """Observability-plane knobs (``telemetry.*``; consumed live by
+    :meth:`bobrapet_tpu.runtime.Runtime._apply_observability_toggles` —
+    the flight recorder re-bounds its rings, the serving SLO judges
+    read the new thresholds on the very next request, and the debug
+    endpoints consult the live flag per request)."""
+
+    #: per-run flight-recorder ring depth
+    #: (dotted: telemetry.flight-recorder-depth)
+    flight_recorder_depth: int = 256
+    #: TTFT within-threshold budget for the serving SLO counters
+    #: (dotted: telemetry.slo.ttft-threshold)
+    slo_ttft_threshold_seconds: float = 2.0
+    #: TPOT within-threshold budget (telemetry.slo.tpot-threshold)
+    slo_tpot_threshold_seconds: float = 0.1
+    #: serve /debug/runs/<id> + /debug/traces/<traceId> on the manager
+    #: HTTP server (token-gated like /metrics)
+    #: (dotted: telemetry.debug-endpoints)
+    debug_endpoints: bool = True
+
+
+@dataclasses.dataclass
 class EngramDefaults:
     """Operator->SDK defaults (reference: operator.go engram defaults)."""
 
@@ -220,6 +242,7 @@ class OperatorConfig:
     dataplane: DataplaneConfig = dataclasses.field(default_factory=DataplaneConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
     engram: EngramDefaults = dataclasses.field(default_factory=EngramDefaults)
     retention: RetentionDefaults = dataclasses.field(default_factory=RetentionDefaults)
     timeouts: TimeoutDefaults = dataclasses.field(default_factory=TimeoutDefaults)
@@ -279,6 +302,14 @@ class OperatorConfig:
             errs.append("serving.decode-horizon must be >= 1")
         if self.serving.spec_k < 1:
             errs.append("serving.spec-k must be >= 1")
+        if self.telemetry.flight_recorder_depth < 8:
+            # below ~8 records a ring cannot even hold one launch's
+            # causal chain — the recorder would be on but useless
+            errs.append("telemetry.flight-recorder-depth must be >= 8")
+        if self.telemetry.slo_ttft_threshold_seconds <= 0:
+            errs.append("telemetry.slo.ttft-threshold must be > 0")
+        if self.telemetry.slo_tpot_threshold_seconds <= 0:
+            errs.append("telemetry.slo.tpot-threshold must be > 0")
         if self.engram.max_inline_size < 0:
             errs.append("engram.maxInlineSize must be >= 0")
         for qname, q in self.scheduling.queues.items():
@@ -351,6 +382,10 @@ def _apply_dotted(cfg: OperatorConfig, key: str, value: str) -> bool:
         "retry.default-max-delay": lambda: fset(cfg, "default_retry_max_delay", as_dur),
         "retry.default-jitter-pct": lambda: fset(cfg, "default_retry_jitter_pct", int),
         "telemetry.enabled": lambda: fset(cfg, "telemetry_enabled", as_bool),
+        "telemetry.flight-recorder-depth": lambda: fset(cfg.telemetry, "flight_recorder_depth", int),
+        "telemetry.slo.ttft-threshold": lambda: fset(cfg.telemetry, "slo_ttft_threshold_seconds", as_dur),
+        "telemetry.slo.tpot-threshold": lambda: fset(cfg.telemetry, "slo_tpot_threshold_seconds", as_dur),
+        "telemetry.debug-endpoints": lambda: fset(cfg.telemetry, "debug_endpoints", as_bool),
         "logging.step-output": lambda: fset(cfg, "step_output_logging", as_bool),
         "logging.verbosity": lambda: fset(cfg, "verbosity", int),
     }

@@ -46,6 +46,7 @@ from ..api.story import Step, StorySpec
 from ..core.object import Resource
 from ..core.store import ResourceStore
 from ..observability.metrics import metrics
+from ..observability.timeline import FLIGHT
 from ..storage.manager import StorageManager
 from ..templating.engine import (
     EvaluationBlocked,
@@ -253,6 +254,13 @@ class DAGEngine:
         from ..observability.tracing import TRACER
 
         before = run.status.get("phase")
+        # prior park state from the COMMITTED status, not capacity_parked
+        # membership: the event-driven wake pops keys from that set, so a
+        # still-gated run would look "newly parked" on every wake and
+        # flood its ring with identical queued records
+        was_parked = bool(
+            run.status.get("queueWaiting") or run.status.get("placementWaiting")
+        )
         # feature-gated span, parented on the run's persisted trace
         # (reference: StartSpan in reconcilers, storyrun_controller.go:217)
         with TRACER.start_span(
@@ -264,19 +272,44 @@ class DAGEngine:
             result = self._run(run, story)
         key = (run.meta.namespace, run.meta.name)
         if run.status.get("queueWaiting") or run.status.get("placementWaiting"):
+            if not was_parked:
+                # transition INTO the park (not every re-probe or wake):
+                # the queued-reason is the forensic fact a dead run's
+                # timeline needs — "it waited here, on this"
+                FLIGHT.record(
+                    key[0], key[1], "queued",
+                    message=str(
+                        run.status.get("placementWaiting")
+                        or "queued behind scheduling limits"
+                    ),
+                )
             self.capacity_parked.add(key)
         else:
             self.capacity_parked.discard(key)
         after = run.status.get("phase")
-        if after != before and after and Phase(after).is_terminal:
-            metrics.storyrun_total.inc(after)
-            started = run.status.get("startedAt")
-            finished = run.status.get("finishedAt")
-            if started is not None and finished is not None:
-                story_name = (run.spec.get("storyRef") or {}).get("name", "")
-                metrics.storyrun_duration.observe(
-                    float(finished) - float(started), story_name
-                )
+        if after != before and after:
+            FLIGHT.record(key[0], key[1], "phase",
+                          message=f"{before or 'created'} -> {after}")
+            if Phase(after).is_terminal:
+                metrics.storyrun_total.inc(after)
+                started = run.status.get("startedAt")
+                finished = run.status.get("finishedAt")
+                if started is not None and finished is not None:
+                    story_name = (run.spec.get("storyRef") or {}).get("name", "")
+                    metrics.storyrun_duration.observe(
+                        float(finished) - float(started), story_name
+                    )
+                if Phase(after).is_failure:
+                    # a dead run explains itself: the causal tail rides
+                    # the terminal status (the ring itself is reaped
+                    # with the run; status survives until retention)
+                    err = run.status.get("error") or {}
+                    if err:
+                        FLIGHT.record(
+                            key[0], key[1], "error",
+                            message=str(err.get("message") or "")[:512],
+                        )
+                    run.status["forensics"] = FLIGHT.tail(key[0], key[1], 20)
         return result
 
     def _run(self, run: Resource, story: StorySpec) -> Optional[float]:
@@ -807,6 +840,13 @@ class DAGEngine:
                     run.status["placementWaiting"] = str(e)
                     placement_parks += 1
                     prior = states.get(step.name)
+                    if not (prior and _is_queued_state(prior)):
+                        # first park only — the 1s re-probe while parked
+                        # must not flood the ring with identical records
+                        FLIGHT.record(
+                            run.meta.namespace, run.meta.name,
+                            "no-capacity", message=str(e), step=step.name,
+                        )
                     parked_at = (
                         prior.get("startedAt")
                         if prior and _is_queued_state(prior)

@@ -201,7 +201,12 @@ class LocalGangExecutor:
                 }
                 return
             try:
-                result = fn(ctx)
+                # the SDK hop of the run trace: parented on the env
+                # contract's BOBRA_TRACEPARENT (the StepRun's persisted
+                # context), stitching controller -> worker across what
+                # is a process boundary in production
+                with ctx.start_span("sdk.step", host=host_id):
+                    result = fn(ctx)
                 if result is not None and host_id == 0:
                     ctx.output(result)
                 host_results[host_id] = {"hostId": host_id, "exitCode": 0}

@@ -28,7 +28,9 @@ from ..api.runs import STEP_RUN_KIND, STORY_RUN_KIND, StepState
 from ..api.story import Step, StorySpec
 from ..core.object import Resource, new_resource
 from ..core.store import AlreadyExists, ResourceStore
+from ..observability import tracing
 from ..observability.metrics import metrics
+from ..observability.timeline import FLIGHT
 from ..parallel.placement import NoCapacity, SlicePlacer
 from ..storage.manager import StorageManager
 from ..templating.engine import Evaluator, TemplateError
@@ -51,6 +53,27 @@ LABEL_QUEUE = "bobrapet.io/queue"
 LABEL_PRIORITY = "bobrapet.io/priority"
 LABEL_PARENT_STEP = "bobrapet.io/parent-step"
 DEPTH_LABEL = "bobrapet.io/substory-depth"
+#: parent trace context carried on the executeStory handoff edge: the
+#: child StoryRun (possibly owned by ANOTHER shard) resumes the parent's
+#: trace from this annotation, so one story + its sub-stories yield ONE
+#: queryable trace across the cross-shard handoff
+TRACE_ANNOTATION = "runs.bobrapet.io/traceparent"
+
+
+def parse_trace_annotation(meta) -> Optional[dict[str, Any]]:
+    """The one decoder for :data:`TRACE_ANNOTATION` (the StoryRun
+    controller and the shard coordinator both consume it — a format
+    change must not be able to diverge the two stitches)."""
+    raw = meta.annotations.get(TRACE_ANNOTATION)
+    if not raw:
+        return None
+    import json
+
+    try:
+        parsed = json.loads(raw)
+    except ValueError:
+        return None
+    return parsed if isinstance(parsed, dict) else None
 
 
 class LaunchBlocked(Exception):
@@ -88,16 +111,23 @@ class StepExecutor:
         ``run.status`` is mutated in place (timers/stop requests); the DAG
         engine persists it after the iteration loop.
         """
-        from ..observability.tracing import TRACER
-
-        with TRACER.start_span(
+        with tracing.TRACER.start_span(
             "step.execute",
             trace_context=run.status.get("trace"),
             step=step.name,
             type=str(step.type) if step.type else "engram",
             run=run.meta.name,
+            namespace=run.meta.namespace,
         ):
-            return self._dispatch(run, story, step, scope, queue)
+            state = self._dispatch(run, story, step, scope, queue)
+        FLIGHT.record(
+            run.meta.namespace, run.meta.name, "launch",
+            message=f"step {step.name} "
+                    f"({str(step.type) if step.type else 'engram'}) -> "
+                    f"{state.phase}",
+            step=step.name,
+        )
+        return state
 
     def _dispatch(
         self,
@@ -151,10 +181,19 @@ class StepExecutor:
         # gang pass and this branch's grant (possibly None) is final.
         slice_grant = preplaced_grant
         if not preplaced and step.tpu is not None:
-            try:
-                grant = self.placer.place(step.tpu, queue=queue)
-            except NoCapacity as e:
-                raise LaunchBlocked(str(e)) from None
+            # placement decision span: nests under step.execute on this
+            # thread, so the trace reads admission -> scheduling ->
+            # placement without explicit context plumbing
+            with tracing.TRACER.start_span(
+                "slice.place", step=step.name, run=run.meta.name,
+                namespace=ns,
+            ) as sp:
+                try:
+                    grant = self.placer.place(step.tpu, queue=queue)
+                except NoCapacity as e:
+                    raise LaunchBlocked(str(e)) from None
+                if sp is not None and grant is not None:
+                    sp.set_attribute("sliceId", grant.to_dict().get("sliceId"))
             slice_grant = grant.to_dict() if grant is not None else None
 
         idempotency_key = self._resolve_idempotency_key(run, step, scope)
@@ -228,6 +267,14 @@ class StepExecutor:
             # the merge keeps this reason until the step turns terminal
             from ..api.conditions import Reason
 
+            FLIGHT.record(
+                ns, run.meta.name, "placement",
+                message=f"step {step.name}: slice "
+                        f"{slice_grant.get('sliceId')} on pool "
+                        f"{slice_grant.get('pool')}",
+                step=step.name, sliceId=slice_grant.get("sliceId"),
+                pool=slice_grant.get("pool"),
+            )
             return StepState(
                 phase=Phase.PENDING,
                 started_at=self.clock.now(),
@@ -351,12 +398,24 @@ class StepExecutor:
         # fits), and capacity shortfall surfaces BEFORE any branch
         # StepRun exists — the per-branch path could strand a partial
         # gang when a later sibling hit NoCapacity
-        try:
-            grants = self.placer.place_group(
-                [(b.name, b.tpu) for b in branches], queue=queue
+        with tracing.TRACER.start_span(
+            "slice.place_group", step=step.name, run=run.meta.name,
+            namespace=run.meta.namespace, branches=len(branches),
+        ):
+            try:
+                grants = self.placer.place_group(
+                    [(b.name, b.tpu) for b in branches], queue=queue
+                )
+            except NoCapacity as e:
+                raise LaunchBlocked(str(e)) from None
+        if any(g is not None for g in grants.values()):
+            FLIGHT.record(
+                run.meta.namespace, run.meta.name, "placement",
+                message=f"gang {step.name}: "
+                        f"{sum(1 for g in grants.values() if g is not None)} "
+                        f"branch slice(s) granted in one pass",
+                step=step.name,
             )
-        except NoCapacity as e:
-            raise LaunchBlocked(str(e)) from None
         children = []
         try:
             for branch in branches:
@@ -424,6 +483,16 @@ class StepExecutor:
             )
         wait = w.get("waitForCompletion", True)
         child_name = compose_unique(run.meta.name, step.name, "sub")
+        # the handoff edge carries the parent's trace context: the child
+        # run (which may hash to ANOTHER shard) resumes the same traceId
+        # instead of minting a fresh one, so the executeStory hop — and
+        # the cross-shard handoff it may become — stays one trace
+        annotations = {}
+        parent_trace = run.status.get("trace")
+        if parent_trace:
+            import json as _json
+
+            annotations[TRACE_ANNOTATION] = _json.dumps(parent_trace)
         child = new_resource(
             STORY_RUN_KIND,
             child_name,
@@ -434,11 +503,19 @@ class StepExecutor:
                 LABEL_PARENT_STEP: step.name,
                 DEPTH_LABEL: str(depth),
             },
+            annotations=annotations,
             owners=[run.owner_ref()],
         )
         try:
             self.store.create(child)
             metrics.child_stepruns_created.inc("sub-story")
+            FLIGHT.record(
+                run.meta.namespace, child_name, "handoff",
+                message=f"sub-story of {run.meta.name} (step {step.name})",
+                trace_id=(parent_trace or {}).get("traceId"),
+                span_id=(parent_trace or {}).get("spanId"),
+                parent=run.meta.name, step=step.name,
+            )
         except AlreadyExists:
             pass
         if not wait:

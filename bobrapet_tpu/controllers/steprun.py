@@ -37,6 +37,7 @@ from ..core.events import EventRecorder
 from ..core.store import AlreadyExists, NotFound, ResourceStore
 from ..observability.metrics import metrics
 from ..observability.structured import StepLogger
+from ..observability.timeline import FLIGHT
 from ..sdk import contract
 from ..storage.manager import StorageManager
 from ..templating.engine import (
@@ -337,20 +338,38 @@ class StepRunController:
             if ck is not None:
                 status["cacheKey"] = ck
 
-        # mark first so the job-status watch can't race an unclaimed state
-        self.store.patch_status(STEP_RUN_KIND, namespace, name, mark_running)
-        if resume_step is not None:
-            metrics.fleet_resumed_steps.inc()
-        if preemption_attempt and self.fleet is not None:
-            # the recovered gang is relaunching now — close the
-            # preemption-to-relaunch latency window
-            self.fleet.observe_recovery(
-                namespace, name, slice_grant.get("pool", "")
+        # the gang-dispatch hop of the run trace: parented on the
+        # StepRun's persisted context (a child of the StoryRun trace via
+        # _ensure_step_contracts), so admission -> scheduling ->
+        # placement -> dispatch -> SDK reads as one chain
+        with self.tracer.start_span(
+            "steprun.dispatch",
+            trace_context=sr.status.get("trace"),
+            step_run=name, job=job_name, hosts=hosts,
+            run=run_name, namespace=namespace,
+        ):
+            # mark first so the job-status watch can't race an
+            # unclaimed state
+            self.store.patch_status(STEP_RUN_KIND, namespace, name, mark_running)
+            if resume_step is not None:
+                metrics.fleet_resumed_steps.inc()
+            if preemption_attempt and self.fleet is not None:
+                # the recovered gang is relaunching now — close the
+                # preemption-to-relaunch latency window
+                self.fleet.observe_recovery(
+                    namespace, name, slice_grant.get("pool", "")
+                )
+            try:
+                self.store.create(job)
+            except AlreadyExists:
+                pass  # adopt: deterministic name makes the create idempotent
+        if run_name:
+            FLIGHT.record(
+                namespace, run_name, "dispatch",
+                message=f"step {spec.step_id or name}: job {job_name} "
+                        f"({hosts} host(s))",
+                step=spec.step_id or name,
             )
-        try:
-            self.store.create(job)
-        except AlreadyExists:
-            pass  # adopt: deterministic name makes the create idempotent
         # while this step's Job dispatches, warm the hydrate LRU with
         # the run scope's refs (run inputs + prior step outputs): the
         # NEXT steps' input resolution and this step's output
@@ -697,6 +716,15 @@ class StepRunController:
 
         self.store.patch_status(STEP_RUN_KIND, namespace, name, redrive)
         metrics.steprun_retries.inc(str(ExitClass.PREEMPTED))
+        run_name = spec.story_run_ref.name if spec.story_run_ref else name
+        FLIGHT.record(
+            namespace, run_name, "preemption",
+            message=f"step {spec.step_id or name}: host {preempted_host} "
+                    f"preempted (exit {exit_code}); redrive "
+                    f"{preemptions + 1}/{fleet_cfg.preemption_retry_cap}"
+                    + (", awaiting healthy slice" if awaiting else ""),
+            step=spec.step_id or name,
+        )
         self.recorder.warning(
             sr, conditions.Reason.PREEMPTION_REDRIVE,
             f"host {preempted_host} preempted (exit {exit_code}); "
@@ -712,6 +740,17 @@ class StepRunController:
         if started is not None:
             engram = (sr.spec.get("engramRef") or {}).get("name") or ""
             metrics.steprun_duration.observe(self.clock.now() - float(started), engram)
+        if phase in (str(Phase.FAILED), str(Phase.TIMEOUT)):
+            run_name = (sr.spec.get("storyRunRef") or {}).get("name")
+            if run_name:
+                err = sr.status.get("error") or {}
+                FLIGHT.record(
+                    sr.meta.namespace, run_name, "error",
+                    message=f"step {sr.spec.get('stepId') or sr.meta.name} "
+                            f"{phase}: "
+                            f"{str(err.get('message') or '')[:256]}",
+                    step=sr.spec.get("stepId") or sr.meta.name,
+                )
 
     def _fail(self, sr, err: StructuredError):
         def fail(status: dict[str, Any]) -> None:
@@ -946,7 +985,32 @@ class StepRunController:
     def _reconcile_realtime(self, sr, spec, engram_spec, template_spec):
         from .streaming import reconcile_realtime_step
 
+        sr = self._ensure_realtime_trace(sr, spec)
         return reconcile_realtime_step(self, sr, spec, engram_spec, template_spec)
+
+    def _ensure_realtime_trace(self, sr, spec):
+        """Persist a TraceInfo child of the StoryRun trace into a
+        realtime StepRun's status (the batch path does this in
+        _ensure_step_contracts; realtime must too, or the serving
+        engram's env contract carries no context and the request
+        lifecycle falls out of the run trace)."""
+        if sr.status.get("trace") is not None or not self.tracer.config.enabled:
+            return sr
+        ns, name = sr.meta.namespace, sr.meta.name
+        run_name = spec.story_run_ref.name if spec.story_run_ref else ""
+        storyrun = (
+            self.store.try_get_view(STORY_RUN_KIND, ns, run_name)
+            if run_name else None
+        )
+        from ..api.schema_refs import ensure_status_contracts
+
+        return ensure_status_contracts(
+            self.store, self.tracer, STEP_RUN_KIND, sr, None, None,
+            span_name="steprun.realtime",
+            span_attrs={"step_run": name, "namespace": ns, "run": run_name},
+            parent_ctx=(storyrun.status.get("trace")
+                        if storyrun is not None else None),
+        )
 
 
 class InputValidationError(Exception):

@@ -27,12 +27,13 @@ from ..api.story import KIND as STORY_KIND, parse_story
 from ..core.object import Resource
 from ..core.store import NotFound, ResourceStore
 from ..observability.metrics import metrics
+from ..observability.timeline import FLIGHT
 from ..storage.manager import StorageManager
 from ..utils.duration import parse_duration
 from .dag import INDEX_STEPRUN_STORYRUN, DAGEngine
 from .manager import Clock
 from .rbac import RBACOwnershipError, RunRBACManager, objects_hash
-from .step_executor import LABEL_PRIORITY, LABEL_QUEUE
+from .step_executor import LABEL_PRIORITY, LABEL_QUEUE, parse_trace_annotation
 from .steprun import CANCEL_ANNOTATION
 
 _log = logging.getLogger(__name__)
@@ -320,13 +321,22 @@ class StoryRunController:
         self.store.patch_status(STORY_RUN_KIND, run.meta.namespace, run.meta.name, patch)
 
     def _fail(self, run: Resource, err: StructuredError, reason: str) -> None:
+        ns, name = run.meta.namespace, run.meta.name
+        FLIGHT.record(ns, name, "error",
+                      message=f"{reason}: {err.message}"[:512])
+        forensics = FLIGHT.tail(ns, name, 20)
+
         def patch(status: dict[str, Any]) -> None:
             status["phase"] = str(Phase.FAILED)
             status["error"] = err.to_dict()
             status["reason"] = reason
             status["finishedAt"] = self.clock.now()
+            # terminal-failure forensics: the causal tail (admission
+            # guards fail runs the DAG never touched — they must explain
+            # themselves too)
+            status["forensics"] = forensics
 
-        self.store.patch_status(STORY_RUN_KIND, run.meta.namespace, run.meta.name, patch)
+        self.store.patch_status(STORY_RUN_KIND, ns, name, patch)
         self._observe_terminal(run, str(Phase.FAILED))
         return None
 
@@ -411,6 +421,11 @@ class StoryRunController:
         from ..api.schema_refs import ensure_status_contracts, story_schema_ref
 
         ns, name = run.meta.namespace, run.meta.name
+        # executeStory handoff edge: a child run carries its parent's
+        # trace context as an annotation (step_executor.TRACE_ANNOTATION)
+        # so the sub-story — possibly owned by another shard — RESUMES
+        # the parent trace instead of minting a fresh traceId
+        parent_ctx = parse_trace_annotation(run.meta)
         version = (run.spec.get("storyRef") or {}).get("version") or story.version
         input_ref = (
             story_schema_ref(story_ns, story_name, "inputs", version)
@@ -426,6 +441,7 @@ class StoryRunController:
             self.store, self.tracer, STORY_RUN_KIND, run, input_ref, output_ref,
             span_name="storyrun.run",
             span_attrs={"story": story_name, "run": name, "namespace": ns},
+            parent_ctx=parent_ctx,
         )
 
     # ------------------------------------------------------------------
@@ -534,6 +550,9 @@ class StoryRunController:
                 self.store.delete(STORY_RUN_KIND, ns, name)
             except NotFound:
                 pass
+            # the flight ring dies with the run record (its tail already
+            # rode terminal status while that existed)
+            FLIGHT.forget(ns, name)
             return None
 
         next_boundary = min(

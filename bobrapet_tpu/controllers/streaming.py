@@ -382,6 +382,11 @@ def _ensure_deployment(ctrl, sr, spec, engram_spec, template_spec, ctx,
         grpc_port=port,
         config=_static_config(ctrl, ctx, sr),
         downstream_targets=targets or None,
+        # the status-persisted trace rides the env contract into the
+        # serving workers (BOBRA_TRACEPARENT), exactly like the batch
+        # path — the serving request lifecycle then stitches into the
+        # run trace instead of starting its own
+        trace_context=sr.status.get("trace"),
     )
     if binding is not None:
         env[contract.ENV_BINDING_INFO] = json.dumps({

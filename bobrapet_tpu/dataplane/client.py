@@ -80,8 +80,13 @@ class StreamProducer:
         lane: str = "data",
         connect_timeout: float = 10.0,
         tls=None,
+        trace_context: Optional[dict[str, Any]] = None,
     ):
         self.stream = stream
+        #: run trace context advertised in the hello — the hub stamps it
+        #: onto the stream record, so a stream is queryable by traceId
+        #: (observability plane; ignored by hubs that predate it)
+        self.trace_context = trace_context
         # observability.watermark.timestampSource: a dotted path into
         # JSON payloads (e.g. "metadata.event_time_ms"); when set, send
         # extracts the event time and stamps the header "et" the hubs'
@@ -99,10 +104,16 @@ class StreamProducer:
         self._credit_cv = threading.Condition()
         self._closed = False
         self._error: Optional[str] = None
-        send_frame(self._sock, {
+        hello: dict[str, Any] = {
             "t": "hello", "role": "producer", "stream": stream,
             "lane": lane, "settings": settings,
-        })
+        }
+        if trace_context and trace_context.get("traceId"):
+            hello["trace"] = {
+                "traceId": trace_context.get("traceId"),
+                "spanId": trace_context.get("spanId"),
+            }
+        send_frame(self._sock, hello)
         fr = self._reader.read()
         if fr is None or fr[0].get("t") != "ok":
             raise StreamProtocolError(f"handshake failed: {fr and fr[0]}")

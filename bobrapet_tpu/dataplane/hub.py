@@ -192,6 +192,10 @@ class _Stream:
         #: event-time watermark (ms) delivered to consumers; advances
         #: monotonically as min-over-live-producers moves
         self.watermark_ms: Optional[int] = None
+        #: run trace context a producer/consumer hello advertised
+        #: ({traceId, spanId}); observability only, never consulted by
+        #: the delivery path
+        self.trace: Optional[dict[str, Any]] = None
 
     def compute_watermark(self) -> Optional[int]:
         """min over live producers' per-connection event-time maxima.
@@ -483,6 +487,8 @@ class StreamHub:
                     max(0, int(time.time() * 1000) - st.watermark_ms)
                     if st.watermark_ms is not None else None
                 )
+            if st.trace is not None:
+                out["trace"] = dict(st.trace)
             return out
 
     # -- internals ---------------------------------------------------------
@@ -557,6 +563,14 @@ class StreamHub:
                 str(hello.get("stream") or ""), hello.get("settings")
             )
             metrics.stream_requests.inc(str(role))
+            tc = hello.get("trace")
+            if isinstance(tc, dict) and tc.get("traceId"):
+                # producers advertise the run trace they serve under —
+                # the stream record carries it so stream_stats (and
+                # whoever scrapes them) can join streams to traces
+                with stream.lock:
+                    stream.trace = {"traceId": tc.get("traceId"),
+                                    "spanId": tc.get("spanId")}
             if role == "producer":
                 self._serve_producer(sock, stream, reader)
             elif role == "consumer":

@@ -32,3 +32,9 @@ from .tracing import (  # noqa: F401
     TRACER,
     trace_info_from_span,
 )
+from .timeline import (  # noqa: F401
+    FLIGHT,
+    FlightRecorder,
+    SLO_THRESHOLDS,
+    set_slo_thresholds,
+)

@@ -510,6 +510,79 @@ class _ControlPlaneMetrics:
             "entry, import-failed = payload refused by this engine)",
             ["outcome"]
         )
+        # Serving SLO latency plane (request-level; measured at horizon
+        # granularity from the engine's existing once-per-horizon host
+        # sync — instrumenting these adds ZERO device round-trips)
+        self.serving_ttft = h(
+            "bobrapet_serving_ttft_seconds",
+            "Time to first token: request submission to the host "
+            "learning of the first sampled token (prefill + queue)",
+            ["step", "tenant"],
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+        )
+        self.serving_tpot = h(
+            "bobrapet_serving_tpot_seconds",
+            "Time per output token after the first (decode cadence; "
+            "horizon-granular — the host observes tokens in "
+            "decode-horizon-sized bursts)",
+            ["step", "tenant"],
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0),
+        )
+        self.serving_queue_wait = h(
+            "bobrapet_serving_queue_wait_seconds",
+            "Submission to slot admission (head-of-line + memory waits)",
+            ["step", "tenant"],
+            buckets=(0.0001, 0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                     30.0, 120.0),
+        )
+        self.serving_e2e_latency = h(
+            "bobrapet_serving_e2e_latency_seconds",
+            "Submission to final token (whole request lifecycle)",
+            ["step", "tenant"],
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                     30.0, 60.0, 300.0),
+        )
+        self.serving_slo = c(
+            "bobrapet_serving_slo_total",
+            "Requests judged against the live telemetry.slo.* "
+            "thresholds (slo = ttft|tpot, outcome = ok|breach) — burn "
+            "rates are ratios of breach over the summed pair",
+            ["slo", "outcome", "step"],
+        )
+        # Tracing exporter self-reporting (OTLPSpanExporter): its
+        # dropped/export_errors/queue-depth were plain attributes,
+        # invisible in production
+        self.tracing_dropped = c(
+            "bobrapet_tracing_dropped_total",
+            "Spans shed by the OTLP exporter's bounded queue "
+            "(overflow drops oldest; telemetry never blocks the "
+            "control plane)",
+            [],
+        )
+        self.tracing_export_errors = c(
+            "bobrapet_tracing_export_errors_total",
+            "OTLP batch posts that failed (batch stays queued for the "
+            "next flush interval)",
+            [],
+        )
+        self.tracing_queue_depth = g(
+            "bobrapet_tracing_queue_depth",
+            "Spans waiting in the OTLP exporter queue",
+            [],
+        )
+        # Flight recorder (observability/timeline.py)
+        self.timeline_records = c(
+            "bobrapet_timeline_records_total",
+            "Flight-recorder timeline records appended, by kind",
+            ["kind"],
+        )
+        self.timeline_runs = g(
+            "bobrapet_timeline_runs",
+            "Runs currently holding a flight-recorder ring (LRU-bounded)",
+            [],
+        )
         self.cr_sync_ops = c(
             "bobrapet_cr_sync_operations_total",
             "CR mirror operations between the cluster API and the bus",

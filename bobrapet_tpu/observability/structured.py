@@ -17,6 +17,8 @@ from __future__ import annotations
 import logging
 from typing import Any, Optional
 
+from .tracing import TRACER
+
 
 class LoggingFeatures:
     """Process-wide toggles (reference: pkg/logging/features.go)."""
@@ -53,6 +55,17 @@ class _BoundLogger:
 
     def _emit(self, level: int, msg: str, kv: dict[str, Any]) -> None:
         merged = {**self._ctx, **kv}
+        # log<->trace correlation: when a span is current on this thread,
+        # every structured line carries its ids so logs join traces
+        # without grep archaeology. One attribute probe when tracing is
+        # off (current_span() is a thread-local read returning None).
+        span = TRACER.current_span()
+        if span is not None:
+            merged.setdefault("trace_id", span.trace_id)
+            merged.setdefault("span_id", span.span_id)
+            run = span.attributes.get("run")
+            if run is not None:
+                merged.setdefault("run_id", run)
         self._log.log(level, "%s %s", msg, _fmt(merged) if merged else "")
 
     def debug(self, msg: str, **kv: Any) -> None:

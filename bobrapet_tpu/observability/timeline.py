@@ -1,0 +1,203 @@
+"""Per-run flight recorder: an always-on bounded causal timeline.
+
+When a run dies, the deduplicated event ring (core/events.py) holds a
+few human-facing occurrences and the metrics hold aggregates — neither
+answers "what happened to THIS run, in order". The flight recorder
+keeps a small ring of structured timeline records per run (phase
+transitions, queued-reasons, placement grants and NoCapacity hints,
+preemptions, cross-shard handoffs, span summaries) so
+``/debug/runs/<ns>/<name>`` can replay the causal story of a live OR
+dead run, and terminal failures attach their tail as forensics.
+
+Design constraints (the 1k-run soak must not notice it exists):
+
+- recording is a dict append onto a ``deque(maxlen=depth)`` under one
+  lock — no store reads, no serialization, no I/O;
+- the per-run ring bounds record count, an LRU over runs bounds run
+  count, and trace links are evicted with their runs — memory is
+  O(runs_cap * depth) worst case regardless of uptime;
+- everything is best-effort telemetry: a recorder failure must never
+  surface into a reconcile, so ``record`` swallows nothing because it
+  can raise nothing (plain dict/deque ops).
+
+The module also owns the live SLO thresholds (``telemetry.slo.*``)
+because the serving engine needs them without importing the config
+manager: Runtime pushes reloads here, engines read module state.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Optional
+
+from .metrics import metrics
+
+#: default ring depth per run (telemetry.flight-recorder-depth)
+DEFAULT_DEPTH = 256
+#: LRU cap on distinct runs held at once — at 4096 runs x 256 records
+#: the recorder tops out around tens of MB, far below the store's own
+#: footprint for the same population
+MAX_RUNS = 4096
+
+#: live serving SLO thresholds (seconds), pushed by Runtime on config
+#: reload (`telemetry.slo.ttft-threshold` / `telemetry.slo.tpot-threshold`);
+#: the serving engine reads them at observe time, so a reload applies to
+#: the very next request without touching engine state
+SLO_THRESHOLDS = {"ttft": 2.0, "tpot": 0.1}
+
+
+def set_slo_thresholds(ttft_seconds: float, tpot_seconds: float) -> None:
+    if ttft_seconds > 0:
+        SLO_THRESHOLDS["ttft"] = float(ttft_seconds)
+    if tpot_seconds > 0:
+        SLO_THRESHOLDS["tpot"] = float(tpot_seconds)
+
+
+class FlightRecorder:
+    """Bounded per-run ring of structured timeline records."""
+
+    def __init__(self, depth: int = DEFAULT_DEPTH, max_runs: int = MAX_RUNS):
+        self._lock = threading.Lock()
+        self._depth = max(8, int(depth))
+        self._max_runs = max(16, int(max_runs))
+        #: (ns, run) -> deque of records, LRU-ordered (oldest first)
+        self._runs: "OrderedDict[tuple[str, str], deque]" = OrderedDict()
+        #: trace_id -> set of run keys that recorded under it, plus the
+        #: reverse index so LRU eviction drops a run's links in
+        #: O(traces-for-that-run) instead of scanning every live trace
+        #: under the lock
+        self._by_trace: dict[str, set[tuple[str, str]]] = {}
+        self._traces_of: dict[tuple[str, str], set[str]] = {}
+
+    # -- write path --------------------------------------------------------
+    def record(
+        self,
+        namespace: str,
+        run: str,
+        kind: str,
+        message: str = "",
+        trace_id: Optional[str] = None,
+        span_id: Optional[str] = None,
+        **attrs: Any,
+    ) -> None:
+        rec: dict[str, Any] = {"at": time.time(), "kind": kind}
+        if message:
+            rec["message"] = message
+        if trace_id:
+            rec["traceId"] = trace_id
+        if span_id:
+            rec["spanId"] = span_id
+        if attrs:
+            rec.update(attrs)
+        key = (namespace, run)
+        with self._lock:
+            ring = self._runs.get(key)
+            if ring is None:
+                ring = deque(maxlen=self._depth)
+                self._runs[key] = ring
+                while len(self._runs) > self._max_runs:
+                    old_key, _ = self._runs.popitem(last=False)
+                    self._drop_trace_links(old_key)
+            else:
+                self._runs.move_to_end(key)
+            ring.append(rec)
+            if trace_id:
+                self._by_trace.setdefault(trace_id, set()).add(key)
+                self._traces_of.setdefault(key, set()).add(trace_id)
+        metrics.timeline_records.inc(kind)
+        metrics.timeline_runs.set(len(self._runs))
+
+    def record_span(self, span) -> None:
+        """Span sink (tracing.set_span_sink): summarize completed spans
+        that carry run identity into that run's timeline. Spans without
+        a ``run`` attribute (storage, hub internals) are not run-scoped
+        and are skipped."""
+        run = span.attributes.get("run")
+        if not run:
+            return
+        namespace = span.attributes.get("namespace") or "default"
+        self.record(
+            str(namespace), str(run), "span",
+            message=span.name,
+            trace_id=span.trace_id, span_id=span.span_id,
+            durationMs=round((span.duration or 0.0) * 1000.0, 3),
+            status=span.status,
+        )
+
+    # -- read path ---------------------------------------------------------
+    def timeline(self, namespace: str, run: str) -> list[dict[str, Any]]:
+        with self._lock:
+            ring = self._runs.get((namespace, run))
+            return list(ring) if ring is not None else []
+
+    def tail(self, namespace: str, run: str, limit: int = 20) -> list[dict[str, Any]]:
+        with self._lock:
+            ring = self._runs.get((namespace, run))
+            if ring is None:
+                return []
+            return list(ring)[-max(1, int(limit)):]
+
+    def runs_for_trace(self, trace_id: str) -> list[tuple[str, str]]:
+        with self._lock:
+            return sorted(self._by_trace.get(trace_id, ()))
+
+    def known(self, namespace: str, run: str) -> bool:
+        with self._lock:
+            return (namespace, run) in self._runs
+
+    # -- lifecycle ---------------------------------------------------------
+    def forget(self, namespace: str, run: str) -> None:
+        """Drop a run's ring (retention deleted the run record)."""
+        key = (namespace, run)
+        with self._lock:
+            self._runs.pop(key, None)
+            self._drop_trace_links(key)
+        metrics.timeline_runs.set(len(self._runs))
+
+    def set_depth(self, depth: int) -> None:
+        """Live reload (`telemetry.flight-recorder-depth`): new rings use
+        the new depth immediately; existing rings are re-bounded lazily
+        on their next record (re-allocating every ring under the lock
+        would be the one thing this module must never do)."""
+        depth = max(8, int(depth))
+        with self._lock:
+            if depth == self._depth:
+                return
+            self._depth = depth
+            # rebound in place: deque(maxlen) is immutable, so swap the
+            # rings — bounded by MAX_RUNS * depth, still cheap, and only
+            # on an operator-initiated reload (never the hot path)
+            for key, ring in self._runs.items():
+                self._runs[key] = deque(ring, maxlen=depth)
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    def _drop_trace_links(self, key: tuple[str, str]) -> None:
+        """Caller holds the lock."""
+        for t in self._traces_of.pop(key, ()):
+            keys = self._by_trace.get(t)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    self._by_trace.pop(t, None)
+
+
+#: the process-wide recorder (always on; controllers and the serving
+#: plane record into it unconditionally — it is bounded and lock-cheap)
+FLIGHT = FlightRecorder()
+
+
+def _wire_span_sink() -> None:
+    """Completed run-scoped spans summarize into the flight recorder;
+    the sink only runs when tracing is enabled (the disabled path in
+    Tracer.start_span never reaches export)."""
+    from . import tracing
+
+    tracing.set_span_sink(FLIGHT.record_span)
+
+
+_wire_span_sink()

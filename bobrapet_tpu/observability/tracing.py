@@ -19,7 +19,18 @@ import secrets
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Iterator, Optional
+from typing import Any, Callable, Iterator, Optional
+
+#: optional sink invoked with every COMPLETED span (after export) —
+#: the flight recorder (observability/timeline.py) registers itself so
+#: run-scoped spans summarize into the per-run causal timeline. Only
+#: reached when tracing is enabled; the disabled path stays one branch.
+_SPAN_SINK: Optional[Callable[["Span"], None]] = None
+
+
+def set_span_sink(sink: Optional[Callable[["Span"], None]]) -> None:
+    global _SPAN_SINK
+    _SPAN_SINK = sink
 
 
 def _new_trace_id() -> str:
@@ -115,23 +126,34 @@ class Tracer:
     def _current(self) -> Optional[Span]:
         return getattr(self._local, "span", None)
 
+    def current_span(self) -> Optional[Span]:
+        """The span active on THIS thread, or None — the log<->trace
+        correlation hook (structured.py stamps trace_id/span_id from it)."""
+        return self._current()
+
     @contextlib.contextmanager
     def start_span(
         self,
         name: str,
         parent: Optional[Span] = None,
         trace_context: Optional[dict[str, Any]] = None,
+        detached: bool = False,
         **attributes: Any,
     ) -> Iterator[Optional[Span]]:
         """Open a span; a no-op (yields None) when tracing is disabled.
 
         ``trace_context`` resumes a trace persisted in resource status
         (the cross-process stitch); ``parent`` nests within this process.
+        ``detached`` ignores the thread-current span so an explicit
+        ``trace_context`` always wins — the serving engine's per-request
+        spans must honor a caller-supplied trace even when the serve
+        loop runs inside an ambient ``sdk.step`` span.
         """
         if not self.config.enabled:
             yield None
             return
-        parent = parent or self._current()
+        if parent is None and not detached:
+            parent = self._current()
         if parent is not None:
             trace_id, parent_id = parent.trace_id, parent.span_id
         elif trace_context and self.config.propagation_enabled and trace_context.get("traceId"):
@@ -158,6 +180,11 @@ class Tracer:
             span.end_time = time.time()
             self._local.span = prev
             self.exporter.export(span)
+            if _SPAN_SINK is not None:
+                try:
+                    _SPAN_SINK(span)
+                except Exception:  # noqa: BLE001 - telemetry must not crash
+                    pass
 
 
 def trace_info_from_span(span: Optional[Span]) -> Optional[dict[str, Any]]:
@@ -212,11 +239,19 @@ class OTLPSpanExporter(SpanExporter):
 
     # -- SpanExporter ------------------------------------------------------
     def export(self, span: Span) -> None:
+        from .metrics import metrics
+
         with self._lock:
             if len(self._queue) == self._queue.maxlen:
                 self.dropped += 1
+                metrics.tracing_dropped.inc()
             self._queue.append(span)
-        if len(self._queue) >= self.batch_size:
+            depth = len(self._queue)
+        # self-reporting (`bobrapet_tracing_*`): dropped/export_errors/
+        # queue-depth were plain attributes, invisible in production —
+        # a backed-up OTLP endpoint silently shed spans with no signal
+        metrics.tracing_queue_depth.set(depth)
+        if depth >= self.batch_size:
             self._wake.set()
 
     def shutdown(self, deadline: float = 5.0) -> None:
@@ -246,14 +281,18 @@ class OTLPSpanExporter(SpanExporter):
             return batch
 
     def _flush(self) -> None:
+        from .metrics import metrics
+
         while True:
             batch = self._drain_batch()
+            metrics.tracing_queue_depth.set(len(self._queue))
             if not batch:
                 return
             try:
                 self._post(batch)
             except Exception:  # noqa: BLE001 - telemetry must not crash
                 self.export_errors += 1
+                metrics.tracing_export_errors.inc()
                 return  # keep the rest queued for the next interval
 
     def _post(self, batch: list[Span]) -> None:

@@ -344,6 +344,15 @@ class Runtime:
         from .observability.structured import FEATURES
 
         FEATURES.apply(cfg.verbosity, cfg.step_output_logging)
+        # flight recorder + serving SLO plane (telemetry.*): the
+        # recorder re-bounds its rings; the SLO thresholds land in the
+        # module slot the serving engine reads at observe time (no jax
+        # import, no engine retune needed)
+        from .observability.timeline import FLIGHT, set_slo_thresholds
+
+        FLIGHT.set_depth(cfg.telemetry.flight_recorder_depth)
+        set_slo_thresholds(cfg.telemetry.slo_ttft_threshold_seconds,
+                           cfg.telemetry.slo_tpot_threshold_seconds)
 
     @staticmethod
     def _apply_serving_tuning(cfg) -> None:

@@ -290,6 +290,10 @@ class EngramContext:
             trace_context=self.trace_context,
             step=self.step,
             step_run=self.step_run,
+            # run identity on every SDK span: the flight recorder's span
+            # sink and /debug/traces join spans to runs through these
+            run=self.story_run,
+            namespace=self.namespace,
             **attributes,
         )
 
@@ -412,6 +416,9 @@ class EngramContext:
                 producers.append(open_producer(
                     f"{host}:{port}", stream, settings=settings,
                     connect_timeout=connect_timeout, tls=tls,
+                    # the run trace rides onto the stream's hello frame
+                    # so the hub can attribute the stream to the trace
+                    trace_context=self.trace_context,
                 ))
         return producers
 

@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time as _walltime
 from collections import deque
 from typing import Any, Optional
 
@@ -54,7 +55,9 @@ import jax.numpy as jnp
 
 from ..models import quant
 from ..models.llama import LlamaConfig, forward
+from ..observability import tracing
 from ..observability.metrics import metrics
+from ..observability.timeline import SLO_THRESHOLDS
 from ..ops.rmsnorm import rmsnorm_reference
 from ..ops.rope import apply_rope, rope_frequencies
 from .paged_cache import (
@@ -80,10 +83,40 @@ class Request:
     eos_token: Optional[int] = None
     #: multi-LoRA: index into the engine's adapter stack (0 = base)
     adapter: int = 0
+    #: SLO attribution label (wire field "tenant"; "" = unattributed)
+    tenant: str = ""
+    #: per-request trace context override ({traceId, spanId}); falls
+    #: back to the engine-level context (the step's BOBRA_TRACEPARENT)
+    trace: Optional[dict] = None
     #: filled by the engine
     output: list[int] = dataclasses.field(default_factory=list)
     done: bool = False
     preemptions: int = 0
+    #: SLO latency plane timestamps — monotonic (perf_counter) for
+    #: deltas plus one wall anchor for span backdating. Stamped at
+    #: host-side scheduling points the engine already visits; first
+    #: token lands at horizon granularity (the existing per-horizon
+    #: device_get), never via an extra sync.
+    submitted_at: float = 0.0
+    submitted_wall: float = 0.0
+    admitted_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+
+    @property
+    def ttft_seconds(self) -> Optional[float]:
+        if self.first_token_at is None or not self.submitted_at:
+            return None
+        return self.first_token_at - self.submitted_at
+
+    @property
+    def tpot_seconds(self) -> Optional[float]:
+        """Mean time per output token AFTER the first (None until the
+        request finishes with >= 2 tokens)."""
+        if (self.finished_at is None or self.first_token_at is None
+                or len(self.output) < 2):
+            return None
+        return (self.finished_at - self.first_token_at) / (len(self.output) - 1)
 
 
 @dataclasses.dataclass
@@ -215,6 +248,18 @@ class ServingEngine:
         self._hz_scatter_fns: dict[int, Any] = {}
         self._import_fn: Optional[Any] = None
         self._sharing_scope_cache: Optional[str] = None
+        #: SLO attribution: the step this engine serves (label on the
+        #: request-level latency histograms; engram.build_engine stamps
+        #: it from the env contract) and the run trace the engine's
+        #: request spans stitch into (BOBRA_TRACEPARENT; per-request
+        #: ``trace`` overrides it)
+        self.slo_step = ""
+        self.trace_context: Optional[dict] = None
+        #: tenants already admitted as metric labels — the tenant field
+        #: arrives from UNTRUSTED stream clients, and unbounded label
+        #: values would mint unbounded series across four histograms;
+        #: past the cap every new tenant collapses into "other"
+        self._tenant_labels: set[str] = set()
         #: per-phase wall-clock breakdown of where engine time goes
         #: (bench surfaces these; reset_phase_stats() zeroes after warm)
         self.phase_seconds = {"prefill": 0.0, "decode_device": 0.0,
@@ -318,7 +363,9 @@ class ServingEngine:
     def submit(self, prompt: list[int], max_new_tokens: int,
                temperature: float = 0.0,
                eos_token: Optional[int] = None,
-               adapter: Optional[int] = None) -> int:
+               adapter: Optional[int] = None,
+               tenant: str = "",
+               trace: Optional[dict] = None) -> int:
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill "
                              "always samples one token)")
@@ -333,10 +380,28 @@ class ServingEngine:
                 f"{self.n_adapters} incl. the base at 0)"
             )
         req = Request(self._next_rid, list(prompt), max_new_tokens,
-                      temperature, eos_token, adapter=adapter or 0)
+                      temperature, eos_token, adapter=adapter or 0,
+                      tenant=self._bound_tenant(tenant), trace=trace,
+                      submitted_at=_walltime.perf_counter(),
+                      submitted_wall=_walltime.time())
         self._next_rid += 1
         self.pending.append(req)
         return req.rid
+
+    #: distinct tenant label values one engine will ever mint
+    MAX_TENANT_LABELS = 64
+
+    def _bound_tenant(self, tenant) -> str:
+        """Normalize the wire tenant into a bounded label vocabulary
+        (a client sending a fresh UUID per request must not grow the
+        metric registry without bound)."""
+        t = str(tenant or "")[:64]
+        if t in self._tenant_labels:
+            return t
+        if len(self._tenant_labels) < self.MAX_TENANT_LABELS:
+            self._tenant_labels.add(t)
+            return t
+        return "other"
 
     def run(self, max_steps: int = 100_000) -> list[Request]:
         """Drive until every submitted request finishes; returns them in
@@ -706,6 +771,48 @@ class ServingEngine:
         metrics.serving_requests.inc("completed")
         metrics.serving_tokens.inc(by=len(slot.request.output))
         metrics.serving_active_slots.set(self.active_slots)
+        self._observe_request(slot.request)
+
+    def _observe_request(self, req: Request) -> None:
+        """Close out the request's SLO plane: e2e + TPOT histograms,
+        within-threshold counters, and (when a trace context is wired)
+        the ``serving.request`` span backdated over the whole lifecycle
+        so the run trace reaches from admission to first token."""
+        req.finished_at = _walltime.perf_counter()
+        step, tenant = self.slo_step, req.tenant
+        metrics.serving_e2e_latency.observe(
+            req.finished_at - req.submitted_at, step, tenant
+        )
+        tpot = req.tpot_seconds
+        if tpot is not None:
+            metrics.serving_tpot.observe(tpot, step, tenant)
+            metrics.serving_slo.inc(
+                "tpot",
+                "ok" if tpot <= SLO_THRESHOLDS["tpot"] else "breach",
+                step,
+            )
+        tc = req.trace or self.trace_context
+        if tc and tracing.TRACER.config.enabled:
+            # detached: the serve loop usually runs INSIDE an ambient
+            # sdk.step span; thread-local parenting would silently
+            # override a caller-supplied per-request trace
+            with tracing.TRACER.start_span(
+                "serving.request", trace_context=tc, detached=True,
+                rid=req.rid, step=step, tenant=tenant,
+                tokens=len(req.output), preemptions=req.preemptions,
+            ) as sp:
+                if sp is not None:
+                    # backdate over the real lifecycle; the first-token
+                    # event carries the TTFT moment inside the span
+                    sp.start_time = req.submitted_wall
+                    ttft = req.ttft_seconds
+                    if ttft is not None:
+                        sp.set_attribute("ttftSeconds", round(ttft, 6))
+                        sp.events.append(
+                            (req.submitted_wall + ttft, "first_token")
+                        )
+                    if tpot is not None:
+                        sp.set_attribute("tpotSeconds", round(tpot, 6))
 
     # -- compute -----------------------------------------------------------
 
@@ -731,6 +838,14 @@ class ServingEngine:
 
     def _prefill(self, slot_idx: int, req: Request, shared: list[int],
                  shared_tokens: int, fresh: list[int]) -> None:
+        if req.admitted_at is None:
+            # first admission only — a preemption recompute re-enters
+            # here but the request already left the queue once
+            req.admitted_at = _walltime.perf_counter()
+            metrics.serving_queue_wait.observe(
+                req.admitted_at - req.submitted_at,
+                self.slo_step, req.tenant,
+            )
         # a preempted request resumes by prefilling prompt + its own
         # prior output (recompute strategy); a matched prefix skips
         # straight to the uncached suffix
@@ -1732,6 +1847,19 @@ class ServingEngine:
         self._last_tokens[slot_idx] = tok
         self._tokens_emitted += 1
         req.output.append(tok)
+        if req.first_token_at is None:
+            # TTFT at the moment the HOST learns of the token — on the
+            # horizon engine that is the once-per-horizon device_get,
+            # so the measurement is horizon-granular by construction
+            # and costs zero extra syncs
+            req.first_token_at = _walltime.perf_counter()
+            ttft = req.first_token_at - req.submitted_at
+            metrics.serving_ttft.observe(ttft, self.slo_step, req.tenant)
+            metrics.serving_slo.inc(
+                "ttft",
+                "ok" if ttft <= SLO_THRESHOLDS["ttft"] else "breach",
+                self.slo_step,
+            )
         if (req.eos_token is not None and tok == req.eos_token) or (
             len(req.output) >= req.max_new_tokens
         ):

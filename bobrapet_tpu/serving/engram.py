@@ -263,6 +263,11 @@ def build_engine(ctx) -> ServingEngine:
         if key in config
     ) | (frozenset(["spec_k"])
          if "specK" in (config.get("draft") or {}) else frozenset())
+    # SLO attribution + trace stitching from the env contract: the
+    # request histograms label by this step, and request lifecycle
+    # spans join the run trace the controller persisted
+    engine.slo_step = getattr(ctx, "step", "") or ""
+    engine.trace_context = getattr(ctx, "trace_context", None)
     _LIVE_ENGINES.add(engine)
     return engine
 

@@ -16,10 +16,14 @@ their completions, then closes downstream.
 Wire shapes (JSON over the stream frames):
 
     in:  {"id": <any>, "prompt": [int], "maxNewTokens": int,
-          "temperature"?: float, "eos"?: int}
+          "temperature"?: float, "eos"?: int, "tenant"?: str,
+          "trace"?: {"traceId": str, "spanId"?: str}}
     out: {"id": <any>, "tokens": [int], "preemptions": int}
     err: {"id": <any>, "error": str}
-"""
+
+``tenant`` labels the engine's TTFT/TPOT/queue-wait SLO histograms;
+``trace`` stitches the request's lifecycle span into the caller's
+trace (defaulting to the serving step's own run trace)."""
 
 from __future__ import annotations
 
@@ -40,11 +44,17 @@ _EOS = object()
 
 class StreamServer:
     def __init__(self, engine: ServingEngine, consumer, producer,
-                 idle_wait_s: float = 0.01):
+                 idle_wait_s: float = 0.01,
+                 trace_context=None):
         self.engine = engine
         self.consumer = consumer
         self.producer = producer
         self.idle_wait_s = idle_wait_s
+        if trace_context is not None:
+            # the serving step's run trace (env contract) — every
+            # request lifecycle span stitches into it unless the
+            # request carries its own context
+            self.engine.trace_context = trace_context
         self._inbox: "queue.Queue[Any]" = queue.Queue()
         self._rid_to_id: dict[int, Any] = {}
         self.served = 0
@@ -92,6 +102,9 @@ class StreamServer:
                                else None),
                     adapter=(int(msg["adapter"])
                              if msg.get("adapter") is not None else None),
+                    tenant=str(msg.get("tenant") or ""),
+                    trace=(msg["trace"]
+                           if isinstance(msg.get("trace"), dict) else None),
                 )
                 self._rid_to_id[rid] = msg.get("id")
             except (KeyError, TypeError, ValueError) as e:

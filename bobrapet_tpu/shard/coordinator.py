@@ -47,8 +47,10 @@ from typing import Optional
 
 from ..api.enums import is_nonterminal_phase
 from ..api.runs import STEP_RUN_KIND, STORY_RUN_KIND
+from ..controllers.step_executor import parse_trace_annotation
 from ..core.store import AlreadyExists, Conflict, NotFound, ResourceStore
 from ..observability.metrics import metrics
+from ..observability.timeline import FLIGHT
 from ..utils.leader import LeaseLeaderElector
 from .map import (
     SHARD_LEASE_NAME,
@@ -252,11 +254,28 @@ class ShardCoordinator:
         if self.router.owner_of(f"{ns}/{parent}") == self.router.me:
             return
         metrics.shard_handoffs.inc(self.router.me)
+        # trace context rides the handoff edge (the parent's trace is
+        # annotated onto the child by the step executor) — the event AND
+        # the flight-recorder record carry the ids, so the cross-shard
+        # hop is queryable inside the ONE run trace
+        trace = parse_trace_annotation(r.meta) or {}
+        trace_note = (
+            f" trace {trace.get('traceId')}/{trace.get('spanId')}"
+            if trace.get("traceId") else ""
+        )
+        FLIGHT.record(
+            ns, r.meta.name, "handoff",
+            message=f"accepted by shard {self.router.me} (parent {parent} "
+                    f"on shard {self.router.owner_of(f'{ns}/{parent}')})",
+            trace_id=trace.get("traceId"), span_id=trace.get("spanId"),
+            shard=self.router.me,
+        )
         if self.recorder is not None:
             self.recorder.normal(
                 r, "CrossShardHandoff",
                 f"child of {parent} (shard "
-                f"{self.router.owner_of(f'{ns}/{parent}')}) accepted",
+                f"{self.router.owner_of(f'{ns}/{parent}')}) accepted"
+                + trace_note,
             )
 
     # -- lifecycle ---------------------------------------------------------

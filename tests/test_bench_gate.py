@@ -133,6 +133,46 @@ def test_gate_lower_is_better_metrics(bench, monkeypatch):
     assert bench._regression_gate() == []
 
 
+def test_serving_slo_percentiles_are_gated_lower_is_better(bench, monkeypatch):
+    """ISSUE 8 satellite: the request-level TTFT/TPOT percentile lines
+    join the regression gate with latency semantics — a p95 TTFT RISE
+    over a prior round fails the bench; a drop passes."""
+    for name in ("serving_ttft_ms_p50", "serving_ttft_ms_p95",
+                 "serving_ttft_ms_p99", "serving_tpot_ms_p50",
+                 "serving_tpot_ms_p95", "serving_tpot_ms_p99"):
+        assert name in bench.GATE_LOWER_IS_BETTER
+    monkeypatch.setattr(bench, "_best_prior", lambda: {
+        _key(bench, metric="serving_ttft_ms_p95", new_tokens=48): 100.0,
+    })
+    bench._EMITTED[:] = [{"metric": "serving_ttft_ms_p95", "value": 130.0,
+                          "unit": "ms", "new_tokens": 48}]
+    failures = bench._regression_gate()
+    assert failures and failures[0]["metric"] == "serving_ttft_ms_p95"
+    bench._EMITTED[:] = [{"metric": "serving_ttft_ms_p95", "value": 90.0,
+                          "unit": "ms", "new_tokens": 48}]
+    assert bench._regression_gate() == []
+
+
+def test_slo_lines_from_requests(bench):
+    """_slo_lines computes ms percentiles from request timestamps."""
+
+    class R:
+        def __init__(self, ttft, tpot):
+            self.ttft_seconds = ttft
+            self.tpot_seconds = tpot
+
+    reqs = [R(0.010 * (i + 1), 0.001 * (i + 1)) for i in range(10)]
+    lines = bench._slo_lines(reqs, "serving", 48, requests=10)
+    by_metric = {ln["metric"]: ln for ln in lines}
+    assert set(by_metric) == {
+        "serving_ttft_ms_p50", "serving_ttft_ms_p95", "serving_ttft_ms_p99",
+        "serving_tpot_ms_p50", "serving_tpot_ms_p95", "serving_tpot_ms_p99",
+    }
+    assert by_metric["serving_ttft_ms_p50"]["value"] == pytest.approx(50.0)
+    assert by_metric["serving_ttft_ms_p99"]["value"] == pytest.approx(100.0)
+    assert all(ln["unit"] == "ms" and ln["new_tokens"] == 48 for ln in lines)
+
+
 def test_gate_tolerance_env_override(bench, monkeypatch):
     monkeypatch.setattr(bench, "_best_prior", lambda: {
         _key(bench, metric="m"): 100.0,

@@ -1,0 +1,182 @@
+"""Manager HTTP plane: health/readiness, token-gated /metrics, and the
+observability debug endpoints (/debug/runs/<id>, /debug/traces/<id>).
+
+These routes had no coverage at all (ISSUE 8 satellite): token auth
+accept/reject, /healthz green-while-standby vs /readyz not-ready, the
+exposition content, and the flight-recorder dumps for live and failed
+runs.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from bobrapet_tpu.__main__ import _serve_http
+from bobrapet_tpu.api.catalog import make_engram_template
+from bobrapet_tpu.api.engram import make_engram
+from bobrapet_tpu.api.story import make_story
+from bobrapet_tpu.core.object import new_resource
+from bobrapet_tpu.runtime import Runtime
+from bobrapet_tpu.sdk import register_engram
+
+
+def _get(port: int, path: str, token: str | None = None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    try:
+        conn.request("GET", path, headers=headers)
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+@pytest.fixture
+def server_factory():
+    servers = []
+
+    def make(state, token=None):
+        server = _serve_http(state, "127.0.0.1:0", token)
+        servers.append(server)
+        return server.server_address[1]
+
+    yield make
+    for s in servers:
+        s.shutdown()
+        s.server_close()
+
+
+class TestHealthAndAuth:
+    def test_standby_replica_health_vs_ready(self, server_factory):
+        # rt=None = waiting on leader election: alive but not ready
+        port = server_factory({"rt": None})
+        assert _get(port, "/healthz")[0] == 200
+        assert _get(port, "/readyz")[0] == 503
+
+    def test_ready_when_manager_running(self, server_factory):
+        stub = SimpleNamespace(manager=SimpleNamespace(is_running=lambda: True))
+        port = server_factory({"rt": stub})
+        status, body = _get(port, "/readyz")
+        assert (status, body) == (200, b"ok")
+
+    def test_metrics_token_gate(self, server_factory):
+        port = server_factory({"rt": None}, token="sekrit")
+        assert _get(port, "/metrics")[0] == 403
+        assert _get(port, "/metrics", token="wrong")[0] == 403
+        status, body = _get(port, "/metrics", token="sekrit")
+        assert status == 200
+        # exposition content: HELP/TYPE headers + namespaced families
+        assert b"# HELP bobrapet_storyrun_total" in body
+        assert b"# TYPE bobrapet_storyrun_total counter" in body
+        assert b"bobrapet_tracing_dropped_total" in body
+
+    def test_metrics_open_without_token(self, server_factory):
+        port = server_factory({"rt": None})
+        assert _get(port, "/metrics")[0] == 200
+
+    def test_debug_routes_share_the_token_gate(self, server_factory):
+        port = server_factory({"rt": None}, token="sekrit")
+        assert _get(port, "/debug/runs/x")[0] == 403
+        # authorized but no runtime yet -> not ready, not a 404
+        assert _get(port, "/debug/runs/x", token="sekrit")[0] == 503
+
+    def test_unknown_path_404(self, server_factory):
+        port = server_factory({"rt": None})
+        assert _get(port, "/nope")[0] == 404
+
+
+class TestDebugEndpoints:
+    @pytest.fixture
+    def traced_rt(self):
+        rt = Runtime()
+        rt.tracer.config.enabled = True
+        from bobrapet_tpu.observability.tracing import InMemorySpanExporter
+
+        rt.tracer.exporter = InMemorySpanExporter()
+        yield rt
+        rt.tracer.config.enabled = False
+
+    def _run_story(self, rt, impl_name, fails=False):
+        @register_engram(impl_name)
+        def impl(ctx):  # noqa: ARG001
+            if fails:
+                raise RuntimeError("engram exploded")
+            return {"ok": True}
+
+        rt.apply(make_engram_template(f"{impl_name}-tpl", entrypoint=impl_name))
+        rt.apply(make_engram(f"{impl_name}-worker", f"{impl_name}-tpl"))
+        rt.apply(make_story(f"{impl_name}-story", steps=[
+            {"name": "s", "ref": {"name": f"{impl_name}-worker"},
+             "execution": {"retry": {"maxRetries": 0}}},
+        ]))
+        run = rt.run_story(f"{impl_name}-story", inputs={})
+        rt.pump()
+        return run
+
+    def test_live_run_timeline(self, traced_rt, server_factory):
+        run = self._run_story(traced_rt, "dbg-live")
+        port = server_factory({"rt": traced_rt})
+        status, body = _get(port, f"/debug/runs/default/{run}")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["phase"] == "Succeeded"
+        kinds = {r["kind"] for r in payload["timeline"]}
+        # the causal story: phase transitions, launches, dispatch, spans
+        assert "phase" in kinds
+        assert "launch" in kinds
+        assert "dispatch" in kinds
+        assert "span" in kinds
+        # default-namespace shorthand resolves the same run
+        assert _get(port, f"/debug/runs/{run}")[0] == 200
+
+    def test_failed_run_explains_itself(self, traced_rt, server_factory):
+        run = self._run_story(traced_rt, "dbg-dead", fails=True)
+        srun = traced_rt.store.get("StoryRun", "default", run)
+        assert srun.status["phase"] == "Failed"
+        # terminal-failure forensics attached to status
+        forensics = srun.status.get("forensics")
+        assert forensics and any(r["kind"] == "error" for r in forensics)
+        port = server_factory({"rt": traced_rt})
+        status, body = _get(port, f"/debug/runs/default/{run}")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["phase"] == "Failed"
+        assert any(r["kind"] == "error" for r in payload["timeline"])
+
+    def test_trace_route_joins_spans_and_runs(self, traced_rt, server_factory):
+        run = self._run_story(traced_rt, "dbg-trace")
+        srun = traced_rt.store.get("StoryRun", "default", run)
+        tid = srun.status["trace"]["traceId"]
+        port = server_factory({"rt": traced_rt})
+        status, body = _get(port, f"/debug/traces/{tid}")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["traceId"] == tid
+        names = {s["name"] for s in payload["spans"]}
+        assert {"storyrun.run", "dag.reconcile", "step.execute"} <= names
+        assert any(r["run"] == run for r in payload["runs"])
+
+    def test_unknown_run_and_trace_404(self, traced_rt, server_factory):
+        port = server_factory({"rt": traced_rt})
+        assert _get(port, "/debug/runs/default/no-such-run")[0] == 404
+        assert _get(port, "/debug/traces/ffffffffffffffff")[0] == 404
+        assert _get(port, "/debug/bogus")[0] == 404
+
+    def test_debug_endpoints_config_gate(self, traced_rt, server_factory):
+        run = self._run_story(traced_rt, "dbg-gated")
+        port = server_factory({"rt": traced_rt})
+        assert _get(port, f"/debug/runs/default/{run}")[0] == 200
+        # live reload: telemetry.debug-endpoints=false turns them off
+        traced_rt.store.create(new_resource(
+            "ConfigMap", "operator-config", "bobrapet-system",
+            spec={"data": {"telemetry.debug-endpoints": "false"}},
+        ))
+        assert not traced_rt.config_manager.config.telemetry.debug_endpoints
+        assert _get(port, f"/debug/runs/default/{run}")[0] == 404
+        # /metrics and health stay up regardless
+        assert _get(port, "/metrics")[0] == 200
+        assert _get(port, "/healthz")[0] == 200

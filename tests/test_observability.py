@@ -330,12 +330,26 @@ class TestTracePersistence:
             "bubu://engram/default/worker/input"
         )
         assert engram_trace["trace_id"] == trace["traceId"]
-        assert engram_trace["parent"] == step_trace["spanId"]
+        # full dispatch chain, still ONE trace: the controller's
+        # steprun.dispatch span parents on the StepRun's persisted
+        # context; the gang host wraps user code in sdk.step (in sync
+        # executor mode the gang runs inside the dispatch span on the
+        # same thread); the engram's own span nests inside that
+        dispatch_span = next(
+            s for s in exporter.spans if s.name == "steprun.dispatch"
+        )
+        sdk_span = next(s for s in exporter.spans if s.name == "sdk.step")
+        assert dispatch_span.trace_id == trace["traceId"]
+        assert dispatch_span.parent_span_id == step_trace["spanId"]
+        assert sdk_span.trace_id == trace["traceId"]
+        assert sdk_span.parent_span_id == dispatch_span.span_id
+        assert engram_trace["parent"] == sdk_span.span_id
 
         names = [s.name for s in exporter.spans]
         assert "storyrun.run" in names
         assert "steprun.launch" in names
         assert "engram.work" in names
+        assert "steprun.dispatch" in names
         # controllers + storage emit feature-gated spans too
         # (reference: StartSpan in reconcilers and pkg/storage)
         assert "dag.reconcile" in names
@@ -466,3 +480,128 @@ class TestOTLPExport:
         assert exp.dropped > 0
         exp.shutdown(deadline=0.5)
         assert exp.export_errors >= 1
+
+    def test_self_reporting_metrics(self):
+        """ISSUE 8 satellite: dropped/export_errors/queue-depth register
+        as bobrapet_tracing_* series instead of staying invisible
+        attributes."""
+        from bobrapet_tpu.observability.tracing import OTLPSpanExporter, Span
+
+        dropped0 = metrics.tracing_dropped.value()
+        errors0 = metrics.tracing_export_errors.value()
+        exp = OTLPSpanExporter("http://127.0.0.1:1", max_queue=4,
+                               flush_interval=30.0, timeout=0.2)
+        for i in range(12):
+            exp.export(Span(name=f"s{i}", trace_id="t", span_id=str(i),
+                            start_time=0.0, end_time=1.0))
+        assert metrics.tracing_dropped.value() - dropped0 == exp.dropped > 0
+        assert metrics.tracing_queue_depth.value() > 0
+        exp.shutdown(deadline=0.5)
+        assert metrics.tracing_export_errors.value() - errors0 >= 1
+        page = REGISTRY.expose()
+        assert "bobrapet_tracing_dropped_total" in page
+        assert "bobrapet_tracing_queue_depth" in page
+
+
+class TestFlightRecorder:
+    def _fresh(self, **kw):
+        from bobrapet_tpu.observability.timeline import FlightRecorder
+
+        return FlightRecorder(**kw)
+
+    def test_ring_bounded_per_run(self):
+        fr = self._fresh(depth=8)
+        for i in range(50):
+            fr.record("ns", "r", "phase", message=f"m{i}")
+        tl = fr.timeline("ns", "r")
+        assert len(tl) == 8
+        assert tl[-1]["message"] == "m49"  # newest kept, oldest dropped
+        assert fr.tail("ns", "r", 3) == tl[-3:]
+
+    def test_run_population_lru_bounded(self):
+        fr = self._fresh(depth=8, max_runs=16)
+        for i in range(40):
+            fr.record("ns", f"r{i}", "phase", trace_id=f"t{i}")
+        assert not fr.known("ns", "r0")  # evicted
+        assert fr.known("ns", "r39")
+        # trace links evicted with their runs
+        assert fr.runs_for_trace("t0") == []
+        assert fr.runs_for_trace("t39") == [("ns", "r39")]
+
+    def test_forget_drops_ring_and_links(self):
+        fr = self._fresh()
+        fr.record("ns", "r", "phase", trace_id="tt")
+        fr.forget("ns", "r")
+        assert fr.timeline("ns", "r") == []
+        assert fr.runs_for_trace("tt") == []
+
+    def test_set_depth_live_rebound(self):
+        fr = self._fresh(depth=16)
+        for i in range(16):
+            fr.record("ns", "r", "phase", message=f"m{i}")
+        fr.set_depth(8)
+        assert fr.depth == 8
+        tl = fr.timeline("ns", "r")
+        assert len(tl) == 8 and tl[-1]["message"] == "m15"
+        fr.record("ns", "r", "phase", message="m16")
+        assert len(fr.timeline("ns", "r")) == 8
+
+    def test_span_sink_records_run_scoped_spans_only(self):
+        from bobrapet_tpu.observability.tracing import (
+            InMemorySpanExporter,
+            Tracer,
+            TracingConfig,
+        )
+
+        tracer = Tracer(TracingConfig(enabled=True), InMemorySpanExporter())
+        from bobrapet_tpu.observability.timeline import FLIGHT
+
+        with tracer.start_span("dag.reconcile", run="fr-span-run",
+                               namespace="fr-ns"):
+            pass
+        with tracer.start_span("storage.dehydrate"):
+            pass  # no run attr: not run-scoped, not recorded
+        tl = FLIGHT.timeline("fr-ns", "fr-span-run")
+        assert [r["message"] for r in tl if r["kind"] == "span"] == ["dag.reconcile"]
+        FLIGHT.forget("fr-ns", "fr-span-run")
+
+    def test_slo_threshold_live_reload(self):
+        from bobrapet_tpu.observability.timeline import (
+            SLO_THRESHOLDS,
+            set_slo_thresholds,
+        )
+
+        before = dict(SLO_THRESHOLDS)
+        try:
+            set_slo_thresholds(7.5, 0.25)
+            assert SLO_THRESHOLDS == {"ttft": 7.5, "tpot": 0.25}
+            # invalid values keep the prior thresholds
+            set_slo_thresholds(0, -1)
+            assert SLO_THRESHOLDS == {"ttft": 7.5, "tpot": 0.25}
+        finally:
+            set_slo_thresholds(before["ttft"], before["tpot"])
+
+
+class TestLogTraceCorrelation:
+    def test_records_carry_trace_ids_when_span_current(self, caplog, monkeypatch):
+        import logging
+
+        from bobrapet_tpu.observability import structured as structured_mod
+        from bobrapet_tpu.observability.tracing import (
+            InMemorySpanExporter,
+            Tracer,
+            TracingConfig,
+        )
+
+        tracer = Tracer(TracingConfig(enabled=True), InMemorySpanExporter())
+        monkeypatch.setattr(structured_mod, "TRACER", tracer)
+        logger = StepLogger("corr", namespace="ns", object="x")
+        with caplog.at_level(logging.INFO, logger="bobrapet_tpu"):
+            with tracer.start_span("steprun.launch", run="corr-run") as span:
+                logger.info("inside")
+            logger.info("outside")
+        inside, outside = caplog.messages[0], caplog.messages[1]
+        assert f"trace_id={span.trace_id}" in inside
+        assert f"span_id={span.span_id}" in inside
+        assert "run_id=corr-run" in inside
+        assert "trace_id" not in outside

@@ -1,0 +1,207 @@
+"""ISSUE 8 acceptance: ONE stitched trace across the whole run.
+
+A story with a parallel TPU fan-out, an executeStory handoff, and a
+realtime serving step must yield a single queryable trace — admission
+-> DAG scheduling -> gang placement -> Job dispatch -> SDK execution
+-> serving first token — with every span sharing the StoryRun's
+traceId across the process-boundary stitch (status-persisted context
+riding the env contract) and the executeStory handoff edge, plus
+TTFT/TPOT histograms populated and visible in ``REGISTRY.expose()``.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from bobrapet_tpu.api.catalog import make_engram_template
+from bobrapet_tpu.api.engram import make_engram
+from bobrapet_tpu.api.story import make_story
+from bobrapet_tpu.observability import REGISTRY
+from bobrapet_tpu.observability.tracing import (
+    InMemorySpanExporter,
+    Tracer,
+    TracingConfig,
+)
+from bobrapet_tpu.parallel.placement import SlicePool
+from bobrapet_tpu.runtime import Runtime
+from bobrapet_tpu.sdk import register_engram
+
+
+class TestStitchedTrace:
+    def test_one_trace_admission_to_first_token(self, monkeypatch):
+        from bobrapet_tpu.observability import tracing as tracing_mod
+
+        exporter = InMemorySpanExporter()
+        tracer = Tracer(TracingConfig(enabled=True), exporter=exporter)
+        # controllers/SDK/engine resolve the module TRACER at call time
+        monkeypatch.setattr(tracing_mod, "TRACER", tracer)
+        rt = Runtime(tracer=tracer)
+        rt.placer.add_pool(SlicePool("trace-pool", "4x4", chips_per_host=4))
+
+        @register_engram("trace-e2e-worker")
+        def impl(ctx):  # noqa: ARG001
+            return {"ok": True}
+
+        rt.apply(make_engram_template("te-w-tpl", entrypoint="trace-e2e-worker"))
+        rt.apply(make_engram("te-worker", "te-w-tpl"))
+        # realtime serving step: deployment-mode engram (the WorkloadSim
+        # plays kubelet; the model server itself is driven below through
+        # the same env contract the deployment would receive)
+        rt.apply(make_engram_template(
+            "te-s-tpl", image="serve:1",
+            entrypoint="bobrapet_tpu.serving.engram:serve",
+            supportedModes=["deployment"],
+        ))
+        rt.apply(make_engram("te-server", "te-s-tpl"))
+        rt.apply(make_story("te-sub", steps=[
+            {"name": "inner", "ref": {"name": "te-worker"}},
+        ]))
+        rt.apply(make_story("te-main", steps=[
+            {"name": "fan", "type": "parallel", "with": {"steps": [
+                {"name": "b1", "ref": {"name": "te-worker"},
+                 "tpu": {"topology": "2x2"}},
+                {"name": "b2", "ref": {"name": "te-worker"},
+                 "tpu": {"topology": "2x2"}},
+            ]}},
+            {"name": "sub", "type": "executeStory", "needs": ["fan"],
+             "with": {"storyRef": {"name": "te-sub"}}},
+            {"name": "generate", "ref": {"name": "te-server"},
+             "needs": ["fan", "sub"]},
+        ], policy={"queue": "trace-pool"}))
+
+        run = rt.run_story("te-main", inputs={})
+        rt.pump()
+
+        srun = rt.store.get("StoryRun", "default", run)
+        # the serving topology stays live; everything batch is done
+        assert srun.status["phase"] == "Running"
+        trace = srun.status["trace"]
+        tid = trace["traceId"]
+
+        # --- executeStory handoff: the child run RESUMES the trace ----
+        children = [
+            r for r in rt.store.list("StoryRun", "default")
+            if r.meta.labels.get("bobrapet.io/story-run") == run
+        ]
+        assert children, "sub-story child run missing"
+        assert children[0].status["trace"]["traceId"] == tid
+
+        # --- realtime step: trace persisted + carried on the env ------
+        gen_sr = next(
+            sr for sr in rt.store.list("StepRun", "default")
+            if sr.spec.get("stepId") == "generate"
+        )
+        assert gen_sr.status["phase"] == "Running"
+        assert gen_sr.status["trace"]["traceId"] == tid
+        dep = next(
+            d for d in rt.store.list("Deployment", "default")
+            if d.meta.labels.get("bobrapet.io/step-run") == gen_sr.meta.name
+        )
+        tc = json.loads(dep.spec["env"]["BOBRA_TRACEPARENT"])
+        assert tc["traceId"] == tid
+
+        # --- serving side: drive the engine exactly as the deployment's
+        # worker would (env-contract trace context), to first token ----
+        import jax
+
+        from bobrapet_tpu.models import llama
+        from bobrapet_tpu.serving import PagedConfig, ServingEngine
+
+        cfg = llama.llama_tiny()
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        eng = ServingEngine(params, cfg, PagedConfig(
+            max_slots=2, block_size=8, num_blocks=32, max_blocks_per_seq=6))
+        eng.slo_step = "generate"
+        eng.trace_context = tc
+        eng.submit(list(range(1, 9)), max_new_tokens=4, tenant="acme")
+        eng.run()
+
+        # --- ONE trace, every hop ------------------------------------
+        stitched = [s for s in exporter.spans if s.trace_id == tid]
+        names = {s.name for s in stitched}
+        for expected in (
+            "storyrun.run",        # admission
+            "dag.reconcile",       # scheduling decision
+            "step.execute",        # launch
+            "slice.place_group",   # batched gang placement
+            "steprun.dispatch",    # Job/gang dispatch
+            "sdk.step",            # worker-side execution
+            "steprun.realtime",    # dataplane/serving step stitch point
+            "serving.request",     # request lifecycle to first token
+        ):
+            assert expected in names, f"missing {expected} in {sorted(names)}"
+
+        req_span = next(s for s in stitched if s.name == "serving.request")
+        assert any(name == "first_token" for _, name in req_span.events)
+        assert "ttftSeconds" in req_span.attributes
+        assert req_span.attributes["tenant"] == "acme"
+
+        # --- SLO histograms populated and exposed --------------------
+        page = REGISTRY.expose()
+        assert 'bobrapet_serving_ttft_seconds_count{step="generate",tenant="acme"}' in page
+        assert 'bobrapet_serving_queue_wait_seconds_count{step="generate",tenant="acme"}' in page
+        assert 'bobrapet_serving_tpot_seconds_count{step="generate",tenant="acme"}' in page
+        assert 'bobrapet_serving_e2e_latency_seconds_count{step="generate",tenant="acme"}' in page
+        # within-threshold counters make burn rates computable
+        assert 'bobrapet_serving_slo_total{slo="ttft"' in page
+        assert 'bobrapet_serving_slo_total{slo="tpot"' in page
+
+    def test_per_request_trace_wins_under_ambient_span(self, monkeypatch):
+        """The serve loop runs inside the gang host's sdk.step span in
+        production — a caller-supplied per-request trace must still win
+        (the request span is detached from the thread-local parent)."""
+        from bobrapet_tpu.observability import tracing as tracing_mod
+
+        exporter = InMemorySpanExporter()
+        tracer = Tracer(TracingConfig(enabled=True), exporter=exporter)
+        monkeypatch.setattr(tracing_mod, "TRACER", tracer)
+
+        import jax
+
+        from bobrapet_tpu.models import llama
+        from bobrapet_tpu.serving import PagedConfig, ServingEngine
+
+        cfg = llama.llama_tiny()
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        eng = ServingEngine(params, cfg, PagedConfig(
+            max_slots=2, block_size=8, num_blocks=32, max_blocks_per_seq=6))
+        eng.trace_context = {"traceId": "99" * 16, "spanId": "88" * 8}
+        caller_tid = "ab" * 16
+        with tracer.start_span("sdk.step", run="amb-run", namespace="ns"):
+            eng.submit(list(range(1, 9)), max_new_tokens=2,
+                       trace={"traceId": caller_tid, "spanId": "cd" * 8})
+            eng.submit(list(range(1, 9)), max_new_tokens=2)
+            eng.run()
+        req_spans = [s for s in exporter.spans if s.name == "serving.request"]
+        tids = {s.trace_id for s in req_spans}
+        # per-request override wins; the engine-level context covers the
+        # rest — neither is swallowed by the ambient sdk.step span
+        assert caller_tid in tids
+        assert "99" * 16 in tids
+
+        # untrusted tenant labels are cardinality-capped
+        labels = {eng._bound_tenant(f"uuid-{i}") for i in range(200)}
+        assert "other" in labels
+        assert len(labels) <= ServingEngine.MAX_TENANT_LABELS + 1
+
+    def test_trace_disabled_costs_nothing_and_stitches_nothing(self):
+        rt = Runtime()  # default tracer follows telemetry.enabled=False
+        assert not rt.tracer.config.enabled
+
+        @register_engram("trace-e2e-dark")
+        def impl(ctx):  # noqa: ARG001
+            return {}
+
+        rt.apply(make_engram_template("td-tpl", entrypoint="trace-e2e-dark"))
+        rt.apply(make_engram("td-worker", "td-tpl"))
+        rt.apply(make_story("td-story", steps=[
+            {"name": "s", "ref": {"name": "td-worker"}},
+        ]))
+        run = rt.run_story("td-story", inputs={})
+        rt.pump()
+        srun = rt.store.get("StoryRun", "default", run)
+        assert srun.status["phase"] == "Succeeded"
+        # span-dark: no trace minted anywhere
+        assert "trace" not in srun.status
