@@ -802,6 +802,111 @@ def config10_payload_hydrate() -> dict:
     }
 
 
+def config13_payload_hydrate_tiered() -> dict:
+    """Tiered payload storage: warm-disk hydrate vs the provider-only
+    (cold) path on the SAME scope. The backing provider simulates a
+    remote blob store with BENCH_TIER_RTT_MS of latency per get — the
+    round trip the slice-local disk tier exists to delete; the disk
+    tier is the real SSD store (native blob cache, or the bounded
+    Python layout when no toolchain). Each pass uses a FRESH hydrate
+    LRU so the comparison is provider vs disk, not RAM. Emits NEW
+    gated keys (fresh lineage — tier numbers must not be judged
+    against flat-store priors) plus the per-tier hit/miss counters."""
+    import shutil
+    import tempfile
+
+    from bobrapet_tpu.observability.metrics import metrics as _m
+    from bobrapet_tpu.storage.manager import StorageManager
+    from bobrapet_tpu.storage.ssd import make_ssd_store
+    from bobrapet_tpu.storage.store import MemoryStore
+
+    n_refs = int(os.environ.get("BENCH_TIER_REFS", "64"))
+    ref_kb = int(os.environ.get("BENCH_TIER_REF_KB", "32"))
+    passes = int(os.environ.get("BENCH_TIER_PASSES", "3"))
+    # 25ms ~ a same-region S3 GET; the cold leg's floor is
+    # refs/8-workers x RTT of UNAVOIDABLE wire time per pass
+    rtt_s = float(os.environ.get("BENCH_TIER_RTT_MS", "25")) / 1000.0
+
+    class SimulatedRemoteStore(MemoryStore):
+        """In-memory blobs + a fixed per-get round trip."""
+
+        def get(self, key):
+            time.sleep(rtt_s)
+            return super().get(key)
+
+    backing = SimulatedRemoteStore()
+    build = StorageManager(backing, max_inline_size=1024)
+    big = "y" * (ref_kb * 1024)
+    scope, total_bytes = {}, 0
+    for i in range(n_refs):
+        v = {"doc": big + str(i)}
+        scope[f"s{i}"] = build.dehydrate(
+            v, f"runs/ns/bench-tier/steps/s{i}/output"
+        )
+        total_bytes += len(json.dumps(v))
+    prefixes = ["runs/ns/bench-tier"]
+
+    def leg(tier) -> float:
+        t0 = time.perf_counter()
+        for _ in range(passes):
+            # fresh manager per pass = fresh L1; the disk tier (when
+            # given) carries all the warmth between passes
+            mgr = StorageManager(backing, max_inline_size=1024,
+                                 disk_tier=tier)
+            h = mgr.hydrate(scope, allowed_prefixes=prefixes)
+        wall = time.perf_counter() - t0
+        assert h["s0"]["doc"].startswith("y")
+        return (total_bytes * passes) / 1e6 / wall
+
+    cold = leg(None)
+
+    tier_dir = tempfile.mkdtemp(prefix="bobra-bench-tier-")
+    tier = None
+    try:
+        tier = make_ssd_store(tier_dir)
+        h0 = _m.storage_tier.value("disk", "hit")
+        m0 = _m.storage_tier.value("disk", "miss")
+        p0 = _m.storage_tier.value("provider", "fetch")
+        # one read-through pass promotes every ref into the disk tier
+        StorageManager(backing, max_inline_size=1024, disk_tier=tier).hydrate(
+            scope, allowed_prefixes=prefixes
+        )
+        warm = leg(tier)
+        disk_hits = _m.storage_tier.value("disk", "hit") - h0
+        disk_misses = _m.storage_tier.value("disk", "miss") - m0
+        provider_fetches = _m.storage_tier.value("provider", "fetch") - p0
+        native = type(tier).__name__ == "SSDStore"
+    finally:
+        # detach the process-wide handoff slot BEFORE deleting the dir:
+        # the serving configs run later in this sweep and their prefix
+        # registry must not adopt (and spill through) a dead tier
+        from bobrapet_tpu.storage import manager as _manager_mod
+
+        if _manager_mod.ACTIVE_DISK_TIER is not None:
+            _manager_mod.ACTIVE_DISK_TIER = None
+        if tier is not None and hasattr(tier, "close"):
+            tier.close()
+        shutil.rmtree(tier_dir, ignore_errors=True)
+
+    return {
+        "metric": "payload_hydrate_warm_disk_mb_per_sec",
+        "value": round(warm, 1),
+        "unit": "MB/s",
+        "vs_baseline": 1.0,
+        "config": "payload-hydrate-tiered",
+        "cold_provider_mb_per_sec": round(cold, 1),
+        "speedup_vs_cold": round(warm / cold, 2) if cold else None,
+        "provider_rtt_ms": rtt_s * 1000.0,
+        "refs": n_refs,
+        "ref_kb": ref_kb,
+        "passes": passes,
+        "native_tier": native,
+        "tier_disk_hits": int(disk_hits),
+        "tier_disk_misses": int(disk_misses),
+        "tier_provider_fetches": int(provider_fetches),
+    }
+
+
 #: PR-5 seed number for the placement churn config, measured on this box
 #: against the pre-indexed brute-force allocator (per-cell set probes,
 #: unmemoized _fit_shape, no batched gang API) running the identical op
@@ -981,6 +1086,7 @@ def run_sweep(state: dict) -> None:
                     (4, config4_streaming_hub), (5, config5_nested_rag),
                     ("dataplane-fanout", config9_dataplane_fanout),
                     ("payload-hydrate", config10_payload_hydrate),
+                    ("payload-hydrate-tiered", config13_payload_hydrate_tiered),
                     ("serving", config6_serving),
                     ("serving-moe", config7_serving_moe),
                     ("serving-spec", config8_serving_spec)):

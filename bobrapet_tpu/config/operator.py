@@ -180,6 +180,28 @@ LAST_SERVING_TUNING: Optional[ServingConfig] = None
 
 
 @dataclasses.dataclass
+class StorageConfig:
+    """Tiered payload/KV storage knobs (``storage.*``; TPU-native
+    addition, consumed live by
+    :meth:`bobrapet_tpu.runtime.Runtime._apply_storage_tier` — a reload
+    attaches/detaches/resizes the slice-local disk tier on the running
+    StorageManager; in-flight run pins are replayed onto a tier
+    attached mid-run)."""
+
+    #: interpose a slice-local disk tier (L2) between the in-memory
+    #: hydrate LRU and the backing provider
+    #: (dotted: storage.disk-cache-enabled)
+    disk_cache_enabled: bool = False
+    #: slice-local mount the disk tier lives on; the native C++ blob
+    #: cache is preferred, the Python layout is the fallback
+    #: (dotted: storage.disk-cache-dir)
+    disk_cache_dir: str = ""
+    #: LRU eviction byte budget for the disk tier; 0 = unbounded
+    #: (dotted: storage.disk-cache-bytes)
+    disk_cache_bytes: int = 0
+
+
+@dataclasses.dataclass
 class TelemetryConfig:
     """Observability-plane knobs (``telemetry.*``; consumed live by
     :meth:`bobrapet_tpu.runtime.Runtime._apply_observability_toggles` —
@@ -242,6 +264,7 @@ class OperatorConfig:
     dataplane: DataplaneConfig = dataclasses.field(default_factory=DataplaneConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
     telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
     engram: EngramDefaults = dataclasses.field(default_factory=EngramDefaults)
     retention: RetentionDefaults = dataclasses.field(default_factory=RetentionDefaults)
@@ -302,6 +325,14 @@ class OperatorConfig:
             errs.append("serving.decode-horizon must be >= 1")
         if self.serving.spec_k < 1:
             errs.append("serving.spec-k must be >= 1")
+        if self.storage.disk_cache_bytes < 0:
+            errs.append("storage.disk-cache-bytes must be >= 0")
+        if self.storage.disk_cache_enabled and not self.storage.disk_cache_dir:
+            # enabling a tier with no mount would silently stay flat —
+            # the operator asked for a capability the config can't build
+            errs.append(
+                "storage.disk-cache-enabled requires storage.disk-cache-dir"
+            )
         if self.telemetry.flight_recorder_depth < 8:
             # below ~8 records a ring cannot even hold one launch's
             # causal chain — the recorder would be on but useless
@@ -363,6 +394,9 @@ def _apply_dotted(cfg: OperatorConfig, key: str, value: str) -> bool:
         "serving.decode-horizon": lambda: fset(cfg.serving, "decode_horizon", int),
         "serving.spec-k": lambda: fset(cfg.serving, "spec_k", int),
         "serving.prefix-cache-shared": lambda: fset(cfg.serving, "prefix_cache_shared", as_bool),
+        "storage.disk-cache-enabled": lambda: fset(cfg.storage, "disk_cache_enabled", as_bool),
+        "storage.disk-cache-dir": lambda: fset(cfg.storage, "disk_cache_dir", str),
+        "storage.disk-cache-bytes": lambda: fset(cfg.storage, "disk_cache_bytes", int),
         "engram.grpc-port": lambda: fset(cfg.engram, "grpc_port", int),
         "engram.max-inline-size": lambda: fset(cfg.engram, "max_inline_size", int),
         "engram.storage-timeout-seconds": lambda: fset(cfg.engram, "storage_timeout_seconds", int),

@@ -227,9 +227,10 @@ class StepExecutor:
             STEP_RUN_KIND, name, ns, spec, labels=labels, owners=[run.owner_ref()]
         )
         # the StepRun controller will hydrate this scope's refs while
-        # resolving inputs — start pulling them into the hydrate LRU
-        # now, overlapped with the create + watch dispatch (fire and
-        # forget; resolution hits cache instead of the blob store)
+        # resolving inputs — start pulling them through the payload
+        # tiers now, overlapped with the create + watch dispatch (fire
+        # and forget; resolution hits the hydrate LRU, and the fetch
+        # leaves the slice-local disk tier warm for later processes)
         self.storage.prefetch(
             scope, [StorageManager.run_prefix(ns, run.meta.name)]
         )

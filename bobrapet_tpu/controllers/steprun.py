@@ -370,11 +370,13 @@ class StepRunController:
                         f"({hosts} host(s))",
                 step=spec.step_id or name,
             )
-        # while this step's Job dispatches, warm the hydrate LRU with
+        # while this step's Job dispatches, warm the payload tiers with
         # the run scope's refs (run inputs + prior step outputs): the
         # NEXT steps' input resolution and this step's output
-        # validation read the same refs and will hit cache instead of
-        # the store (fire-and-forget; never blocks the reconcile)
+        # validation read the same refs and will hit the hydrate LRU —
+        # and, once fetched, the slice-local disk tier holds them for
+        # every later process on this slice (fire-and-forget; never
+        # blocks the reconcile)
         if storyrun is not None:
             self.storage.prefetch(
                 {

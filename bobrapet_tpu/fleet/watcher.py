@@ -34,11 +34,22 @@ STEP_RUN_KIND = "StepRun"
 class PreemptionWatcher:
     CONTROLLER = "fleet-watcher"
 
-    def __init__(self, store: ResourceStore, fleet: FleetManager, clock=None):
+    def __init__(
+        self,
+        store: ResourceStore,
+        fleet: FleetManager,
+        clock=None,
+        storage=None,
+    ):
         self.store = store
         self.fleet = fleet
         self.clock = clock
+        self.storage = storage
         self._manager = None
+        #: jobs whose run scope was already warmed for this preemption
+        #: (a preempted Job's status keeps getting MODIFIED events;
+        #: warm once per notice, bounded like _beats)
+        self._warmed: set[tuple[str, str]] = set()
         #: (ns, steprun) -> {host: last observed beat} — keyed per step
         #: so a staleness probe touches only that step's hosts, and ONE
         #: self-rescheduling probe per step replaces a timer per beat
@@ -81,6 +92,46 @@ class PreemptionWatcher:
             grant,
             host=host,
             key=f"{job.meta.namespace}/{job.meta.name}",
+        )
+        self._warm_run_scope(job)
+
+    def _warm_run_scope(self, job) -> None:
+        """The redriven gang will re-hydrate the run scope (inputs +
+        prior step outputs) the moment it relaunches — start pulling
+        those refs into the payload tiers NOW, overlapped with
+        quarantine and re-placement, so the resume's hydrate hits the
+        slice-local disk tier instead of the backing provider
+        (fire-and-forget; once per preemption notice)."""
+        if self.storage is None:
+            return
+        ns = job.meta.namespace
+        key = (ns, job.meta.name)
+        with self._lock:
+            if key in self._warmed:
+                return
+            self._warmed.add(key)
+            if len(self._warmed) > 8192:
+                self._warmed.clear()  # bounded; re-warming is cheap
+        sr_name = (job.spec.get("stepRunRef") or {}).get("name")
+        if not sr_name:
+            return
+        sr = self.store.try_get_view(STEP_RUN_KIND, ns, sr_name)
+        if sr is None:
+            return
+        run_name = (sr.spec.get("storyRunRef") or {}).get("name")
+        if not run_name:
+            return
+        run = self.store.try_get_view("StoryRun", ns, run_name)
+        if run is None:
+            return
+        from ..storage.manager import StorageManager
+
+        self.storage.prefetch(
+            {
+                "inputs": run.spec.get("inputs"),
+                "steps": run.status.get("stepStates"),
+            },
+            [StorageManager.run_prefix(ns, run_name)],
         )
 
     # -- heartbeats --------------------------------------------------------

@@ -611,6 +611,35 @@ class _ControlPlaneMetrics:
             "Hydrate LRU probes by result",
             ["result"],
         )
+        # Tiered payload/KV storage (L1 hydrate LRU -> L2 slice-local
+        # disk -> L3 backing provider; see docs/STORAGE.md)
+        self.storage_tier = c(
+            "bobrapet_storage_tier_total",
+            "Tier decisions: tier=disk result=hit|miss|stale|write|"
+            "promote|evict, tier=provider result=fetch, tier=kv "
+            "result=hit|miss|write for the serving prefix-KV spill",
+            ["tier", "result"],
+        )
+        self.storage_singleflight = c(
+            "bobrapet_storage_singleflight_total",
+            "Concurrent hydrate misses collapsed onto an already "
+            "in-flight fetch of the same (provider, key, sha256) "
+            "identity (each tick = one provider round trip saved)",
+            [],
+        )
+        self.storage_disk_used_bytes = g(
+            "bobrapet_storage_disk_used_bytes",
+            "Bytes resident in the slice-local disk tier (refreshed at "
+            "tier writes, hits and evictions)",
+            [],
+        )
+        self.storage_disk_hit_rate = g(
+            "bobrapet_storage_disk_hit_rate",
+            "Disk-tier hit fraction over this process's lifetime "
+            "(hits / (hits + misses+stales); the eviction budget is "
+            "tuned against this)",
+            [],
+        )
         # Trigger / admission family
         self.trigger_decisions = c(
             "bobrapet_trigger_decisions_total", "StoryTrigger decisions", ["decision"]

@@ -155,6 +155,11 @@ class Runtime:
         self.storage = StorageManager(
             blob_store or MemoryStore(), max_inline_size=cfg.engram.max_inline_size
         )
+        # slice-local disk tier (L2) between the hydrate LRU and the
+        # backing provider (storage.disk-cache-*): built at startup
+        # from a pre-existing ConfigMap, retuned live on reloads
+        self._disk_tier_key: Optional[tuple] = None
+        self._apply_storage_tier(cfg)
         self.placer = placer or SlicePlacer()
         # fleet health & preemption recovery: quarantine ledger + cordon
         # hook on the placer + grant replacement (reads fleet.* live)
@@ -200,8 +205,10 @@ class Runtime:
             tracer=self.tracer, fleet=self.fleet,
         )
         # cluster-event intake: Job preemption notices + SDK heartbeats
+        # (storage ref: a preemption notice warms the payload tiers for
+        # the redrive, overlapped with quarantine + re-placement)
         self.preemption_watcher = PreemptionWatcher(
-            self.store, self.fleet, clock=self.clock
+            self.store, self.fleet, clock=self.clock, storage=self.storage
         )
         self.story_controller = StoryController(
             self.store, recorder=self.recorder, clock=self.clock
@@ -371,9 +378,63 @@ class Runtime:
         if _serving is not None:
             _serving.apply_tuning(cfg.serving)
 
+    def _apply_storage_tier(self, cfg) -> None:
+        """Attach/detach/resize the slice-local disk tier from the live
+        ``storage.disk-cache-*`` keys. The tier store rebuilds only when
+        (dir, bytes) actually changed — unrelated reloads must not blow
+        a warm cache away — and the serving plane's prefix-KV spill is
+        re-synced afterwards (lazy: never imports jax into a pure
+        control-plane process)."""
+        st = cfg.storage
+        want = (
+            (st.disk_cache_dir, int(st.disk_cache_bytes))
+            if st.disk_cache_enabled and st.disk_cache_dir
+            else None
+        )
+        if want != self._disk_tier_key:
+            had = self.storage.disk_tier is not None
+            tier = None
+            if want is not None:
+                from .storage.ssd import make_ssd_store
+
+                try:
+                    tier = make_ssd_store(want[0], capacity_bytes=want[1])
+                except Exception as e:  # noqa: BLE001 - bad mount/path
+                    _log.warning(
+                        "storage.disk-cache-dir %r unusable (%s); "
+                        "staying on the flat store", want[0], e,
+                    )
+            # record the key only when the build succeeded (or the tier
+            # was deliberately disabled): a mount that was missing at
+            # startup must retry on the NEXT reload even if the config
+            # values themselves did not change
+            self._disk_tier_key = want if (tier is not None or want is None) else None
+            self.storage.set_disk_tier(tier)
+            if tier is not None or had:
+                self._sync_kv_spill()
+        elif self.storage.disk_tier is not None:
+            # tier unchanged, but the serving module may have loaded
+            # since the last sync — keep its spill pointed at the tier.
+            # A TIER-LESS runtime stays hands-off here: in a
+            # multi-runtime process (shard harness) it must not clobber
+            # a sibling's spill attachment with None.
+            self._sync_kv_spill()
+
+    def _sync_kv_spill(self) -> None:
+        """Point the serving plane's shared-prefix registry at the disk
+        tier so exported paged-KV blocks survive an engram preemption
+        (only when the serving module is already loaded — importing it
+        here would pull jax into the control plane)."""
+        import sys as _sys
+
+        mod = _sys.modules.get("bobrapet_tpu.serving.prefix_cache")
+        if mod is not None:
+            mod.GLOBAL_SHARED_PREFIXES.attach_spill(self.storage.disk_tier)
+
     def _on_config_change(self, cfg) -> None:
         self.resolver.operator_config = cfg
         self._apply_observability_toggles(cfg)
+        self._apply_storage_tier(cfg)
         # controllers.shard-count live-reload: only effective while the
         # fleet is still on the epoch-0 bootstrap ring — once a leader
         # has published a ShardMap, dynamic membership (heartbeats +

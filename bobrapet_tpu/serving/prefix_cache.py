@@ -40,12 +40,15 @@ unchanged.
 from __future__ import annotations
 
 import hashlib
+import logging
 import threading
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
 from ..observability.metrics import metrics
 from .paged_cache import BlockAllocator
+
+_log = logging.getLogger(__name__)
 
 
 def _chain_hash(parent: bytes, tokens: list[int]) -> bytes:
@@ -58,6 +61,28 @@ def _chain_hash(parent: bytes, tokens: list[int]) -> bytes:
 ROOT = b"root"
 
 
+def _encode_kv_payload(payload: dict) -> bytes:
+    """Serialize an exported block payload (K/V device arrays across
+    layers, plus draft K/V for spec engines) for the disk tier. Plain
+    ``np.savez`` — shapes and dtypes round-trip, nothing is pickled."""
+    import io
+
+    import numpy as np
+
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in payload.items()})
+    return buf.getvalue()
+
+
+def _decode_kv_payload(data: bytes) -> dict:
+    import io
+
+    import numpy as np
+
+    with np.load(io.BytesIO(data)) as z:
+        return {k: z[k] for k in z.files}
+
+
 class SharedPrefixRegistry:
     """Process-wide content-hash -> exported-block-payload map shared
     by engine instances (bounded LRU; thread-safe — engines may serve
@@ -68,29 +93,85 @@ class SharedPrefixRegistry:
     stays valid however the exporting engine's pools evolve — at the
     cost of holding that HBM until eviction. Size ``max_entries``
     accordingly (one entry = one block's K/V across all layers,
-    target + draft for spec engines)."""
+    target + draft for spec engines).
 
-    def __init__(self, max_entries: int = 512):
+    **Disk-tier spill** (:meth:`attach_spill`): exported payloads
+    write through to the slice-local disk tier keyed
+    ``kv/<scope>/<chain-hash>``, and in-memory misses read back from
+    it — so a preempted or restarted serving engram re-adopts its
+    prefix state through a scatter instead of re-running prefill, even
+    after every in-memory registry died with the old process. Scope
+    isolation carries over unchanged: the scope (weights fingerprint)
+    is part of the disk key, so different weights can never cross-hit.
+    Entries the memory LRU evicted remain adoptable from disk until
+    the tier's own byte budget evicts them."""
+
+    def __init__(self, max_entries: int = 512, spill=None,
+                 spill_prefix: str = "kv"):
         if max_entries < 1:
             raise ValueError("max_entries must be >= 1")
         self.max_entries = max_entries
         self._lock = threading.Lock()
         self._entries: OrderedDict[tuple[str, bytes], dict] = OrderedDict()
+        self._spill = spill
+        self._spill_prefix = spill_prefix.strip("/")
+
+    def attach_spill(self, store, prefix: str = "kv") -> None:
+        """Write-through/read-through persistence via a blob store
+        (normally the StorageManager's disk tier); ``None`` detaches."""
+        with self._lock:
+            self._spill = store
+            self._spill_prefix = prefix.strip("/")
+
+    def _spill_key(self, scope: str, h: bytes) -> str:
+        return f"{self._spill_prefix}/{scope}/{h.hex()}"
+
+    def _insert_locked(self, key: tuple[str, bytes], payload: dict) -> None:
+        """Caller holds ``_lock``: MRU insert + LRU trim."""
+        self._entries.pop(key, None)
+        self._entries[key] = payload
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
 
     def put(self, scope: str, h: bytes, payload: dict) -> None:
         with self._lock:
-            key = (scope, h)
-            self._entries.pop(key, None)
-            self._entries[key] = payload
-            while len(self._entries) > self.max_entries:
-                self._entries.popitem(last=False)
+            self._insert_locked((scope, h), payload)
+            spill = self._spill
+        if spill is not None:
+            # serialization (device_get) stays OUTSIDE the lock; the
+            # spill is best-effort — a full tier degrades to memory-only
+            try:
+                spill.put(self._spill_key(scope, h),
+                          _encode_kv_payload(payload))
+                metrics.storage_tier.inc("kv", "write")
+            except Exception as e:  # noqa: BLE001 - tier hiccup
+                _log.debug("prefix-KV spill write failed: %s", e)
 
     def get(self, scope: str, h: bytes) -> Optional[dict]:
         with self._lock:
             payload = self._entries.get((scope, h))
             if payload is not None:
                 self._entries.move_to_end((scope, h))
-            return payload
+                return payload
+            spill = self._spill
+        if spill is None:
+            return None
+        try:
+            data = spill.get(self._spill_key(scope, h))
+        except Exception:  # noqa: BLE001 - BlobNotFound / tier hiccup
+            metrics.storage_tier.inc("kv", "miss")
+            return None
+        try:
+            payload = _decode_kv_payload(data)
+        except Exception as e:  # noqa: BLE001 - torn/stale spill entry
+            _log.debug("prefix-KV spill entry undecodable: %s", e)
+            metrics.storage_tier.inc("kv", "miss")
+            return None
+        metrics.storage_tier.inc("kv", "hit")
+        with self._lock:
+            # repopulate the memory LRU so repeat adoptions stay cheap
+            self._insert_locked((scope, h), payload)
+        return payload
 
     def __len__(self) -> int:
         with self._lock:
@@ -100,6 +181,22 @@ class SharedPrefixRegistry:
 #: default registry for `serving.prefix-cache-shared: true` — every
 #: engine in the process that opts in shares through this instance
 GLOBAL_SHARED_PREFIXES = SharedPrefixRegistry()
+
+
+def _adopt_active_disk_tier() -> None:
+    """This module is jax-heavy and loads AFTER the control plane boots;
+    if a Runtime already attached a slice-local disk tier, point the
+    global registry's spill at it now (reloads re-sync through
+    ``Runtime._sync_kv_spill``). Custom per-tenant registries opt in
+    explicitly via :meth:`SharedPrefixRegistry.attach_spill`."""
+    from ..storage import manager as _sm
+
+    tier = getattr(_sm, "ACTIVE_DISK_TIER", None)
+    if tier is not None:
+        GLOBAL_SHARED_PREFIXES.attach_spill(tier)
+
+
+_adopt_active_disk_tier()
 
 
 class PrefixCache:

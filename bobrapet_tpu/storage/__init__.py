@@ -41,16 +41,11 @@ def build_store(policy, base_dir: str = "/tmp/bobrapet-storage") -> Store:
                     f"native blob cache is unavailable: {e}"
                 ) from e
         if native is False:
-            if cfg.max_bytes:
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "slice_local_ssd.max_bytes=%s is not enforced with "
-                    "native=false: the Python layout has no eviction "
-                    "budget; size the mount for the peak working set",
-                    cfg.max_bytes,
-                )
-            return SliceLocalSSDStore(cfg.path)
+            # the Python layout enforces the same byte budget / LRU
+            # eviction / pinning contract as the native cache
+            return SliceLocalSSDStore(
+                cfg.path, capacity_bytes=int(cfg.max_bytes or 0)
+            )
         return make_ssd_store(cfg.path, capacity_bytes=int(cfg.max_bytes or 0))
     if getattr(policy, "s3", None) is not None:
         # a REAL client from the full policy + env contract (endpoint,

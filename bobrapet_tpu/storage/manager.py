@@ -23,6 +23,20 @@ the tree); identical payloads (same sha256, same run scope) write once
 (content-addressed dedup); hydrate keeps a bounded in-process LRU keyed
 ``(provider, key, sha256)`` and fetches all refs of a value tree
 concurrently before substitution.
+
+Tiered storage (PR 10): between the in-memory hydrate LRU (L1) and the
+backing provider (L3) sits an optional slice-local disk tier (L2, a
+capacity-bounded SSD store — ``storage.disk-cache-*``). Reads go
+L1 -> L2 -> L3 with every L3 fetch promoted into L2; dehydrate writes
+through to L2; the ``(provider, key, sha256)`` identity the dedup path
+already computes makes stale L2 entries (a backing key overwritten with
+new content) self-invalidating — a digest mismatch on an L2 hit is
+treated as a miss and the entry dropped. Concurrent misses on one
+identity collapse onto a single in-flight fetch (single-flight), and
+``pin_run``/``unpin_run`` pin both the backing store AND the disk tier
+(pins are replayed onto a tier attached mid-run). Tier decisions emit
+flight-recorder records and annotate the ambient trace span, so a slow
+``steprun.dispatch`` is attributable to cold storage.
 """
 
 from __future__ import annotations
@@ -34,7 +48,7 @@ import json
 import logging
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Optional
 
 from ..observability.metrics import metrics
@@ -68,6 +82,36 @@ def _executor() -> ThreadPoolExecutor:
                 thread_name_prefix="hydrate-fetch",
             )
         return _fetch_executor
+
+
+#: the process's active slice-local disk tier (L2), published by
+#: ``StorageManager.set_disk_tier`` — a no-jax handoff slot the serving
+#: plane reads so prefix-KV exports can spill through the same tier
+#: without the control plane importing jax (see serving/prefix_cache.py)
+ACTIVE_DISK_TIER: Optional[Store] = None
+
+
+@dataclasses.dataclass
+class TierStats:
+    """Per-hydrate tier accounting (annotated onto the trace chain;
+    counts are telemetry — executor threads update them without a lock)."""
+
+    l1_hits: int = 0
+    disk_hits: int = 0
+    provider_fetches: int = 0
+    singleflight_joins: int = 0
+
+    def annotate(self, span) -> None:
+        if span is None:
+            return
+        attrs = span.attributes
+        for name, n in (
+            ("storage.l1_hits", self.l1_hits),
+            ("storage.disk_hits", self.disk_hits),
+            ("storage.provider_fetches", self.provider_fetches),
+            ("storage.singleflight_joins", self.singleflight_joins),
+        ):
+            attrs[name] = attrs.get(name, 0) + n
 
 
 @dataclasses.dataclass
@@ -158,6 +202,7 @@ class StorageManager:
         hydrate_cache_entries: int = DEFAULT_HYDRATE_CACHE_ENTRIES,
         hydrate_cache_bytes: int = DEFAULT_HYDRATE_CACHE_BYTES,
         dedup_entries: int = DEFAULT_DEDUP_ENTRIES,
+        disk_tier: Optional[Store] = None,
     ):
         self.store = store
         self.max_inline_size = max_inline_size
@@ -165,6 +210,19 @@ class StorageManager:
         self._hydrate_cache = _HydrateCache(
             hydrate_cache_entries, hydrate_cache_bytes
         )
+        # L2 slice-local disk tier + the bookkeeping the tiers need:
+        # live pin refcounts (replayed onto a tier attached mid-run),
+        # the single-flight in-flight map, and hit/miss tallies feeding
+        # the hit-rate gauge
+        self._tier_lock = threading.Lock()
+        self._disk_tier: Optional[Store] = None
+        self._pinned_prefixes: collections.Counter = collections.Counter()
+        self._inflight_lock = threading.Lock()
+        self._inflight: dict[tuple, Future] = {}
+        self._tier_hits = 0
+        self._tier_misses = 0
+        if disk_tier is not None:
+            self.set_disk_tier(disk_tier)
         # (scope, sha256) -> key of the blob already holding that
         # content, plus the reverse map so an overwrite of a key with
         # DIFFERENT content invalidates the stale forward entry (the
@@ -185,6 +243,91 @@ class StorageManager:
     @staticmethod
     def step_key(namespace: str, run_name: str, step: str, field: str) -> str:
         return f"runs/{namespace}/{run_name}/steps/{step}/{field}"
+
+    # -- disk tier (L2) ----------------------------------------------------
+
+    @property
+    def disk_tier(self) -> Optional[Store]:
+        return self._disk_tier
+
+    def set_disk_tier(self, tier: Optional[Store]) -> None:
+        """Attach (or detach, with None) the slice-local disk tier.
+        Live-reload safe: pins taken while the tier was absent are
+        replayed so eviction cannot strip a running run's blobs, and
+        the tier is published to the process-wide handoff slot the
+        serving plane's prefix-KV spill reads."""
+        global ACTIVE_DISK_TIER
+        with self._tier_lock:
+            old = self._disk_tier
+            self._disk_tier = tier
+            pins = list(self._pinned_prefixes.elements())
+        # the REPLACED tier is deliberately not close()d here: in-flight
+        # fetches on other threads may still hold it, and closing a
+        # native handle under them is a use-after-free. Dropping the
+        # references (here, ACTIVE_DISK_TIER, the KV spill resync) lets
+        # refcounting fire its __del__ close exactly when the last
+        # in-flight user drains.
+        if tier is not None:
+            if hasattr(tier, "on_evict"):
+                tier.on_evict = self._on_tier_evict
+            for prefix in pins:
+                try:
+                    tier.pin_prefix(prefix)
+                except (StorageError, OSError):  # pragma: no cover - tier hiccup
+                    pass
+            ACTIVE_DISK_TIER = tier
+            self._refresh_tier_gauges(tier)
+        elif old is not None:
+            # a detached tier must not leave its last readings frozen
+            # on /metrics — dashboards would keep seeing a cache that
+            # no longer exists
+            metrics.storage_disk_used_bytes.set(0.0)
+            metrics.storage_disk_hit_rate.set(0.0)
+            if ACTIVE_DISK_TIER is old:
+                ACTIVE_DISK_TIER = None
+
+    def _on_tier_evict(self, key: str) -> None:
+        """Eviction callback from the disk tier (Python path; the native
+        cache evicts inside C and reports only through used_bytes)."""
+        metrics.storage_tier.inc("disk", "evict")
+        self._flight(key, "evict")
+        tier = self._disk_tier
+        if tier is not None:
+            self._refresh_tier_gauges(tier)
+
+    def _refresh_tier_gauges(self, tier: Store) -> None:
+        used = getattr(tier, "used_bytes", None)
+        if callable(used):
+            try:
+                metrics.storage_disk_used_bytes.set(float(used()))
+            except (StorageError, OSError):  # pragma: no cover - tier hiccup
+                pass
+        total = self._tier_hits + self._tier_misses
+        if total:
+            metrics.storage_disk_hit_rate.set(self._tier_hits / total)
+
+    @staticmethod
+    def _run_identity(key: str) -> Optional[tuple[str, str]]:
+        """(namespace, run) parsed from a run-scoped blob key, or None
+        for keys outside the ``runs/<ns>/<run>/...`` scheme."""
+        parts = key.split("/")
+        if parts[0] == "runs" and len(parts) >= 4:
+            return parts[1], parts[2]
+        return None
+
+    def _flight(self, key: str, decision: str) -> None:
+        """Tier decisions land in the owning run's flight recorder so
+        ``/debug/runs/<id>`` shows whether a slow dispatch paid for
+        cold storage (best-effort telemetry)."""
+        ident = self._run_identity(key)
+        if ident is None:
+            return
+        from ..observability.timeline import FLIGHT
+
+        FLIGHT.record(
+            ident[0], ident[1], "storage",
+            message=f"{decision} {key}", tier="disk", decision=decision,
+        )
 
     # -- dehydrate ---------------------------------------------------------
 
@@ -296,10 +439,31 @@ class StorageManager:
             return "/".join(parts[:3])
         return None
 
+    def _tier_write(self, key: str, data: bytes, promote: bool = False) -> None:
+        """Best-effort L2 write (write-through on dehydrate, promote on
+        an L3 fetch). Over-capacity / IO failures degrade to a flat
+        store — the disk tier is a cache, never the source of truth."""
+        tier = self._disk_tier
+        if tier is None:
+            return
+        try:
+            tier.put(key, data)
+        except (StorageError, OSError) as e:
+            # raw OSError covers the Python FileStore layout (full or
+            # read-only mount) — L2 failures degrade to a flat store,
+            # they never fail an offload the backing store accepted
+            _log.debug("disk tier put %r skipped: %s", key, e)
+            return
+        metrics.storage_tier.inc("disk", "promote" if promote else "write")
+        if promote:
+            self._flight(key, "promote")
+        self._refresh_tier_gauges(tier)
+
     def _dedup_put(self, key: str, data: bytes, digest: str) -> str:
         scope = self._dedup_scope(key)
         if scope is None:
             self.store.put(key, data)
+            self._tier_write(key, data)
             metrics.storage_offloaded_bytes.inc(by=float(len(data)))
             return key
         cache_key = (scope, digest)
@@ -314,6 +478,7 @@ class StorageManager:
             except StorageError:  # pragma: no cover - backend hiccup
                 pass  # fall through to a fresh write
         self.store.put(key, data)
+        self._tier_write(key, data)
         metrics.storage_offloaded_bytes.inc(by=float(len(data)))
         with self._dedup_lock:
             stale = self._dedup_by_key.pop(key, None)
@@ -351,39 +516,58 @@ class StorageManager:
         offloads) into the hydrate LRU before the substitution walk —
         the walk itself is the serial reference implementation, so
         results and error behavior are identical to a serial hydrate.
+
+        Tier accounting for the whole operation is annotated onto the
+        ``storage.hydrate`` span AND its ambient parent (the reconcile /
+        ``steprun.dispatch`` span), so a slow dispatch chain shows
+        whether it paid for cold storage.
         """
         from ..observability.tracing import TRACER
 
-        with TRACER.start_span("storage.hydrate"):
-            self._prefetch_waves(value, allowed_prefixes, depth)
-            return self._hydrate(value, allowed_prefixes, depth)
+        stats = TierStats()
+        parent = TRACER.current_span()
+        with TRACER.start_span("storage.hydrate") as span:
+            self._prefetch_waves(value, allowed_prefixes, depth, stats)
+            try:
+                return self._hydrate(value, allowed_prefixes, depth, stats)
+            finally:
+                stats.annotate(span)
+                stats.annotate(parent)
 
     def _hydrate(
         self,
         value: Any,
         allowed_prefixes: Optional[list[str]],
         depth: int,
+        stats: Optional[TierStats] = None,
     ) -> Any:
         if depth > self.max_depth:
             raise StorageError("hydrate recursion depth exceeded")
         if is_storage_ref(value):
             ref = StorageRef.from_marker(value)
-            payload = self._fetch_ref(ref, allowed_prefixes)
+            payload = self._fetch_ref(ref, allowed_prefixes, stats)
             # hydrated payload may itself contain refs (nested offload)
-            return self._hydrate(payload, allowed_prefixes, depth + 1)
+            return self._hydrate(payload, allowed_prefixes, depth + 1, stats)
         # depth counts resolved refs only — plain container nesting must
         # hydrate anything dehydrate passed through inline
         if isinstance(value, dict):
-            return {k: self._hydrate(v, allowed_prefixes, depth) for k, v in value.items()}
+            return {
+                k: self._hydrate(v, allowed_prefixes, depth, stats)
+                for k, v in value.items()
+            }
         if isinstance(value, list):
-            return [self._hydrate(v, allowed_prefixes, depth) for v in value]
+            return [self._hydrate(v, allowed_prefixes, depth, stats) for v in value]
         return value
 
     def _fetch_ref(
-        self, ref: StorageRef, allowed_prefixes: Optional[list[str]]
+        self,
+        ref: StorageRef,
+        allowed_prefixes: Optional[list[str]],
+        stats: Optional[TierStats] = None,
     ) -> Any:
-        """Validate + fetch + verify + decode ONE ref, through the LRU.
-        Cached payloads are shared (read-only by contract)."""
+        """Validate + fetch + verify + decode ONE ref, through the
+        tiers (L1 hydrate LRU -> L2 disk -> L3 provider). Cached
+        payloads are shared (read-only by contract)."""
         self.validate_ref(ref, allowed_prefixes)
         if ref.provider and ref.provider != self.store.provider:
             # mixed-provider deployments (e.g. native slice-SSD writer,
@@ -396,25 +580,119 @@ class StorageManager:
                 "in the storage policy so all processes agree on one "
                 "implementation"
             )
-        cache_key = None
-        if ref.sha256:
-            cache_key = (ref.provider, ref.key, ref.sha256)
-            hit = self._hydrate_cache.get(cache_key)
-            if hit is not None:
-                metrics.storage_hydrate_cache.inc("hit")
-                return hit[0]
-            metrics.storage_hydrate_cache.inc("miss")
-        data = self.store.get(ref.key)
-        if ref.sha256:
-            actual = hashlib.sha256(data).hexdigest()
-            if actual != ref.sha256:
-                raise StorageError(
-                    f"blob {ref.key!r} digest mismatch (corrupted or tampered)"
-                )
-        payload = _decode(data)
-        if cache_key is not None:
-            self._hydrate_cache.put(cache_key, payload, len(data))
+        if not ref.sha256:
+            # uncacheable (no digest): neither the LRU nor the disk
+            # tier can vouch for it — straight to the provider
+            if stats is not None:
+                stats.provider_fetches += 1
+            return _decode(self.store.get(ref.key))
+        cache_key = (ref.provider, ref.key, ref.sha256)
+        hit = self._hydrate_cache.get(cache_key)
+        if hit is not None:
+            metrics.storage_hydrate_cache.inc("hit")
+            if stats is not None:
+                stats.l1_hits += 1
+            return hit[0]
+        metrics.storage_hydrate_cache.inc("miss")
+        return self._fetch_singleflight(cache_key, ref, stats)
+
+    def _fetch_singleflight(
+        self,
+        cache_key: tuple,
+        ref: StorageRef,
+        stats: Optional[TierStats],
+    ) -> Any:
+        """Collapse concurrent misses on one ``(provider, key, sha256)``
+        identity onto a single tier fetch: the first caller (leader)
+        fetches, everyone else joins its future — N concurrent hydrates
+        of one ref cost ONE provider round trip (real money under
+        ``parallel`` fan-outs). A leader failure propagates to its
+        joiners; the serial hydrate walk re-raises it at its
+        deterministic position exactly as before."""
+        with self._inflight_lock:
+            fut = self._inflight.get(cache_key)
+            if fut is None:
+                fut = Future()
+                self._inflight[cache_key] = fut
+                leader = True
+            else:
+                leader = False
+        if not leader:
+            metrics.storage_singleflight.inc()
+            if stats is not None:
+                stats.singleflight_joins += 1
+            self._flight(ref.key, "singleflight join")
+            return fut.result()
+        # double-checked leadership: a prior leader populates L1 BEFORE
+        # retiring its in-flight entry, so re-probing here (after our
+        # insert, which happens-after that pop) makes "miss the entry,
+        # refetch anyway" impossible — late arrivals are served from L1
+        hit = self._hydrate_cache.get(cache_key)
+        if hit is not None:
+            with self._inflight_lock:
+                self._inflight.pop(cache_key, None)
+            fut.set_result(hit[0])
+            return hit[0]
+        try:
+            payload, nbytes = self._fetch_tiers(ref, stats)
+        except BaseException as e:
+            with self._inflight_lock:
+                self._inflight.pop(cache_key, None)
+            fut.set_exception(e)
+            raise
+        # populate L1 BEFORE retiring the in-flight entry: a caller that
+        # misses the entry must then hit the LRU, never double-fetch
+        self._hydrate_cache.put(cache_key, payload, nbytes)
+        with self._inflight_lock:
+            self._inflight.pop(cache_key, None)
+        fut.set_result(payload)
         return payload
+
+    def _fetch_tiers(
+        self, ref: StorageRef, stats: Optional[TierStats]
+    ) -> tuple[Any, int]:
+        """L2 -> L3 for one digest-carrying ref (leader side of the
+        single flight). A disk-tier payload whose digest does not match
+        the marker is STALE (the backing key was overwritten with new
+        content) — dropped and refetched, never served."""
+        key, want = ref.key, ref.sha256
+        tier = self._disk_tier
+        if tier is not None:
+            data = None
+            try:
+                data = tier.get(key)
+            except BlobNotFound:
+                pass
+            except (StorageError, OSError) as e:  # pragma: no cover - tier hiccup
+                _log.debug("disk tier get %r failed: %s", key, e)
+            if data is not None:
+                if hashlib.sha256(data).hexdigest() == want:
+                    self._tier_hits += 1
+                    metrics.storage_tier.inc("disk", "hit")
+                    self._refresh_tier_gauges(tier)
+                    if stats is not None:
+                        stats.disk_hits += 1
+                    self._flight(key, "disk hit")
+                    return _decode(data), len(data)
+                metrics.storage_tier.inc("disk", "stale")
+                try:
+                    tier.delete(key)
+                except (StorageError, OSError):  # pragma: no cover - tier hiccup
+                    pass
+            else:
+                metrics.storage_tier.inc("disk", "miss")
+            self._tier_misses += 1
+        data = self.store.get(key)
+        metrics.storage_tier.inc("provider", "fetch")
+        if stats is not None:
+            stats.provider_fetches += 1
+        actual = hashlib.sha256(data).hexdigest()
+        if actual != want:
+            raise StorageError(
+                f"blob {key!r} digest mismatch (corrupted or tampered)"
+            )
+        self._tier_write(key, data, promote=True)
+        return _decode(data), len(data)
 
     # -- parallel fetch / prefetch ----------------------------------------
 
@@ -435,6 +713,7 @@ class StorageManager:
         value: Any,
         allowed_prefixes: Optional[list[str]],
         depth: int,
+        stats: Optional[TierStats] = None,
     ) -> None:
         """Fetch every ref in the tree concurrently, wave by wave
         (payloads of one wave may carry the next wave's refs). Already
@@ -467,14 +746,17 @@ class StorageManager:
                 else:
                     misses.append(ref)
             if len(misses) == 1:
-                payloads.append(self._try_fetch(misses[0], allowed_prefixes))
+                payloads.append(
+                    self._try_fetch(misses[0], allowed_prefixes, stats)
+                )
             elif misses:
                 nchunks = min(_FETCH_WORKERS, len(misses))
                 chunks = [misses[i::nchunks] for i in range(nchunks)]
 
                 def fetch_chunk(chunk: list[StorageRef]) -> list[Any]:
                     return [
-                        self._try_fetch(r, allowed_prefixes) for r in chunk
+                        self._try_fetch(r, allowed_prefixes, stats)
+                        for r in chunk
                     ]
 
                 for result in _executor().map(fetch_chunk, chunks):
@@ -486,10 +768,13 @@ class StorageManager:
             depth += 1
 
     def _try_fetch(
-        self, ref: StorageRef, allowed_prefixes: Optional[list[str]]
+        self,
+        ref: StorageRef,
+        allowed_prefixes: Optional[list[str]],
+        stats: Optional[TierStats] = None,
     ) -> Any:
         try:
-            return self._fetch_ref(ref, allowed_prefixes)
+            return self._fetch_ref(ref, allowed_prefixes, stats)
         except Exception:  # noqa: BLE001 - the serial walk re-raises
             return None
 
@@ -542,11 +827,35 @@ class StorageManager:
         """Shield a live run's blobs from capacity eviction (no-op on
         stores without a byte budget). Paired with :meth:`unpin_run` at
         terminal cleanup, so LRU pressure can never delete data a
-        StorageRef in a non-terminal run still references."""
-        self.store.pin_prefix(self._bounded(self.run_prefix(namespace, run_name)))
+        StorageRef in a non-terminal run still references. Pins cover
+        the backing store AND the disk tier; the refcount ledger lets
+        :meth:`set_disk_tier` replay live pins onto a tier attached
+        mid-run (config reload)."""
+        prefix = self._bounded(self.run_prefix(namespace, run_name))
+        with self._tier_lock:
+            self._pinned_prefixes[prefix] += 1
+            tier = self._disk_tier
+        self.store.pin_prefix(prefix)
+        if tier is not None:
+            try:
+                tier.pin_prefix(prefix)
+            except (StorageError, OSError):  # pragma: no cover - tier hiccup
+                pass
 
     def unpin_run(self, namespace: str, run_name: str) -> None:
-        self.store.unpin_prefix(self._bounded(self.run_prefix(namespace, run_name)))
+        prefix = self._bounded(self.run_prefix(namespace, run_name))
+        with self._tier_lock:
+            if self._pinned_prefixes[prefix] > 1:
+                self._pinned_prefixes[prefix] -= 1
+            else:
+                self._pinned_prefixes.pop(prefix, None)
+            tier = self._disk_tier
+        self.store.unpin_prefix(prefix)
+        if tier is not None:
+            try:
+                tier.unpin_prefix(prefix)
+            except (StorageError, OSError):  # pragma: no cover - tier hiccup
+                pass
 
     # -- retention ---------------------------------------------------------
 
@@ -557,21 +866,42 @@ class StorageManager:
 
     def delete_prefix(self, prefix: str) -> int:
         """Remove every blob under a prefix; returns count
-        (run-record cleanup, reference: retention.go:41)."""
+        (run-record cleanup, reference: retention.go:41). The disk
+        tier is swept too: after retention a ref must not resolve, and
+        a surviving L2 copy would keep serving deleted data."""
         n = 0
-        for key in self.store.list(self._bounded(prefix)):
+        bounded = self._bounded(prefix)
+        for key in self.store.list(bounded):
             self.store.delete(key)
             n += 1
+        self._tier_delete_prefix(bounded)
         return n
+
+    def _tier_delete_prefix(self, bounded_prefix: str) -> None:
+        tier = self._disk_tier
+        if tier is None:
+            return
+        try:
+            for key in tier.list(bounded_prefix):
+                tier.delete(key)
+            self._refresh_tier_gauges(tier)
+        except (StorageError, OSError):  # pragma: no cover - tier hiccup
+            pass
 
     def sweep_expired(self, prefix: str, ttl_seconds: float) -> int:
         """Delete blobs older than ttl under prefix (cache retention)."""
         cutoff = time.time() - ttl_seconds
         n = 0
+        tier = self._disk_tier
         for key in self.store.list(self._bounded(prefix)):
             try:
                 if self.store.stat_mtime(key) < cutoff:
                     self.store.delete(key)
+                    if tier is not None:
+                        try:
+                            tier.delete(key)
+                        except (StorageError, OSError):  # pragma: no cover
+                            pass
                     n += 1
             except BlobNotFound:
                 continue

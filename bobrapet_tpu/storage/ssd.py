@@ -186,7 +186,8 @@ class SSDStore(Store):
 def make_ssd_store(base_dir: str, capacity_bytes: int = 0) -> Store:
     """SSDStore when the native library is available; otherwise the
     Python slice-local fallback (same mount, same provider-tag family,
-    no native speedup). Both fallback paths — here and build_store —
+    no native speedup — but the SAME capacity budget, eviction order
+    and pinning contract). Both fallback paths — here and build_store —
     MUST return the same store type so refs stay readable."""
     try:
         return SSDStore(base_dir, capacity_bytes)
@@ -196,4 +197,4 @@ def make_ssd_store(base_dir: str, capacity_bytes: int = 0) -> Store:
         )
         from .store import SliceLocalSSDStore
 
-        return SliceLocalSSDStore(base_dir)
+        return SliceLocalSSDStore(base_dir, capacity_bytes=capacity_bytes)

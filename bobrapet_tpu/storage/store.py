@@ -12,6 +12,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import OrderedDict
 from typing import Callable, Optional
 
 
@@ -130,13 +131,128 @@ class SliceLocalSSDStore(FileStore):
     the slice identity so the scheduler can keep consumers of these blobs
     on the same slice (slice-affinity is surfaced through ``provider`` +
     ``slice`` fields in the storageRef marker).
+
+    With ``capacity_bytes > 0`` the store enforces the same eviction
+    contract as the native blob cache (native/blobcache.cc): access-order
+    LRU under a byte budget (ticks rebuilt in ``stat_mtime`` order on
+    reopen, refreshed by put/get), pinned prefixes exempt (the budget
+    yields to live-run data rather than evict it), and a single blob
+    larger than the whole budget is rejected outright. Eviction victims
+    are reported through the optional ``on_evict`` callback (the
+    StorageManager turns those into flight-recorder records and metric
+    ticks). All file IO happens OUTSIDE the accounting lock.
     """
 
     provider = "slice-ssd"
 
-    def __init__(self, base_dir: str, slice_id: str = "local"):
+    def __init__(
+        self,
+        base_dir: str,
+        slice_id: str = "local",
+        capacity_bytes: int = 0,
+        on_evict: Optional[Callable[[str], None]] = None,
+    ):
         super().__init__(base_dir)
         self.slice_id = slice_id
+        self.capacity_bytes = int(capacity_bytes or 0)
+        self.on_evict = on_evict
+        self._acct_lock = threading.Lock()
+        #: key -> size, ordered least- to most-recently used; rebuilt
+        #: from on-disk mtimes so a reopened cache evicts oldest first
+        self._sizes: "OrderedDict[str, int]" = OrderedDict()
+        self._used = 0
+        #: pinned prefix -> refcount (pin_prefix/unpin_prefix)
+        self._pins: dict[str, int] = {}
+        self._rescan()
+
+    def _rescan(self) -> None:
+        entries: list[tuple[float, str, int]] = []
+        for root, _, files in os.walk(self.base_dir):
+            for fname in files:
+                full = os.path.join(root, fname)
+                if ".tmp." in fname:
+                    continue  # torn write leftover; not a live blob
+                try:
+                    st = os.stat(full)
+                except FileNotFoundError:  # pragma: no cover - race
+                    continue
+                key = os.path.relpath(full, self.base_dir).replace(os.sep, "/")
+                entries.append((st.st_mtime, key, st.st_size))
+        entries.sort()
+        with self._acct_lock:
+            self._sizes = OrderedDict((k, sz) for _, k, sz in entries)
+            self._used = sum(sz for _, _, sz in entries)
+
+    def _pinned(self, key: str) -> bool:
+        """Caller holds ``_acct_lock``."""
+        return any(n > 0 and key.startswith(p) for p, n in self._pins.items())
+
+    def put(self, key: str, data: bytes) -> None:
+        size = len(data)
+        if self.capacity_bytes and size > self.capacity_bytes:
+            raise StorageError(
+                f"blob {key!r} ({size}B) exceeds slice-SSD capacity "
+                f"{self.capacity_bytes}B"
+            )
+        super().put(key, data)
+        victims: list[str] = []
+        with self._acct_lock:
+            old = self._sizes.pop(key, None)
+            if old is not None:
+                self._used -= old
+            self._sizes[key] = size
+            self._used += size
+            if self.capacity_bytes and self._used > self.capacity_bytes:
+                # LRU order, skipping pinned keys and the fresh write;
+                # when only pinned entries remain the budget yields
+                # (live run data is never sacrificed to the byte cap)
+                for k in [k for k in self._sizes]:
+                    if self._used <= self.capacity_bytes:
+                        break
+                    if k == key or self._pinned(k):
+                        continue
+                    self._used -= self._sizes.pop(k)
+                    victims.append(k)
+        for k in victims:
+            try:
+                os.remove(self._path(k))
+            except FileNotFoundError:
+                pass
+            if self.on_evict is not None:
+                try:
+                    self.on_evict(k)
+                except Exception:  # noqa: BLE001 - telemetry hook
+                    pass
+
+    def get(self, key: str) -> bytes:
+        data = super().get(key)
+        with self._acct_lock:
+            if key in self._sizes:
+                self._sizes.move_to_end(key)  # reads refresh recency
+        return data
+
+    def delete(self, key: str) -> None:
+        with self._acct_lock:
+            size = self._sizes.pop(key, None)
+            if size is not None:
+                self._used -= size
+        super().delete(key)
+
+    def used_bytes(self) -> int:
+        with self._acct_lock:
+            return self._used
+
+    def pin_prefix(self, prefix: str) -> None:
+        with self._acct_lock:
+            self._pins[prefix] = self._pins.pop(prefix, 0) + 1
+
+    def unpin_prefix(self, prefix: str) -> None:
+        # unpinning a never-pinned prefix is tolerated: controllers
+        # unpin unconditionally at terminal cleanup
+        with self._acct_lock:
+            n = self._pins.pop(prefix, 0)
+            if n > 1:
+                self._pins[prefix] = n - 1
 
 
 class MemoryStore(Store):

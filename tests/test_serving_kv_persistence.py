@@ -1,0 +1,147 @@
+"""Prefix-KV persistence through the slice-local disk tier.
+
+A preempted or restarted serving engram loses every in-memory registry
+with its process; what survives is the slice-local disk tier. These
+tests pin the resume contract: exported prefix blocks spill through the
+tier (``kv/<scope>/<chain-hash>``), a FRESH registry in the relaunched
+process reads them back, and the new engine adopts its prefix state via
+scatter instead of re-running prefill — with BYTE-IDENTICAL decode
+output (the same parity bar as the horizon engine, test_serving_horizon).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from bobrapet_tpu.models import llama
+from bobrapet_tpu.observability.metrics import metrics
+from bobrapet_tpu.serving import PagedConfig, ServingEngine
+from bobrapet_tpu.serving.prefix_cache import (
+    SharedPrefixRegistry,
+    _decode_kv_payload,
+    _encode_kv_payload,
+)
+from bobrapet_tpu.storage.store import SliceLocalSSDStore
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _pcfg(**over):
+    kw = dict(max_slots=4, block_size=16, num_blocks=128,
+              max_blocks_per_seq=8)
+    kw.update(over)
+    return PagedConfig(**kw)
+
+
+def _prompt(cfg, seed=40):
+    rng = np.random.default_rng(seed)
+    system = rng.integers(0, cfg.vocab_size, 48).tolist()  # 3 full blocks
+    tail = rng.integers(0, cfg.vocab_size, 9).tolist()
+    return system + tail
+
+
+def _serve_once(params, cfg, reg, prompt, max_new=8):
+    eng = ServingEngine(params, cfg, _pcfg(), prefix_shared=reg)
+    eng.submit(list(prompt), max_new_tokens=max_new)
+    out = eng.run()[0].output
+    return eng, out
+
+
+class TestPayloadCodec:
+    def test_kv_payload_roundtrip_exact(self):
+        payload = {
+            "k": np.arange(2 * 3 * 4, dtype=np.float32).reshape(2, 3, 4),
+            "v": np.linspace(-1, 1, 24, dtype=np.float32).reshape(2, 3, 4),
+        }
+        back = _decode_kv_payload(_encode_kv_payload(payload))
+        assert set(back) == {"k", "v"}
+        for name in ("k", "v"):
+            assert back[name].dtype == payload[name].dtype
+            assert back[name].shape == payload[name].shape
+            np.testing.assert_array_equal(back[name], payload[name])
+
+    def test_jax_arrays_encode_like_numpy(self):
+        import jax.numpy as jnp
+
+        arr = jnp.ones((2, 4), dtype=jnp.float32) * 0.5
+        back = _decode_kv_payload(_encode_kv_payload({"k": arr}))
+        np.testing.assert_array_equal(back["k"], np.asarray(arr))
+
+
+class TestPreemptionResume:
+    def test_restarted_engram_readopts_prefix_state_from_disk(
+        self, model, tmp_path
+    ):
+        """Simulated preemption: engine + registry die; only the disk
+        tier survives. The relaunched engine must adopt the persisted
+        blocks (scatter, no prefill) and decode byte-identically."""
+        cfg, params = model
+        tier = SliceLocalSSDStore(str(tmp_path / "tier"))
+        prompt = _prompt(cfg)
+
+        reg1 = SharedPrefixRegistry()
+        reg1.attach_spill(tier)
+        eng1, out_before = _serve_once(params, cfg, reg1, prompt)
+        assert len(reg1) >= 3
+        assert len(tier.list("kv/")) >= 3  # spilled through the tier
+        del eng1, reg1  # the preemption: in-memory state is GONE
+
+        kv_hits0 = metrics.storage_tier.value("kv", "hit")
+        reg2 = SharedPrefixRegistry()
+        reg2.attach_spill(tier)
+        assert len(reg2) == 0  # nothing in memory — disk is the source
+        eng2, out_after = _serve_once(params, cfg, reg2, prompt)
+        assert eng2.blocks.shared_hits >= 3  # adopted, not re-prefilled
+        assert metrics.storage_tier.value("kv", "hit") >= kv_hits0 + 3
+        assert out_after == out_before  # byte-identical decode
+
+        # adopted KV must be EXACT: a cold share-less engine agrees
+        plain = ServingEngine(params, cfg, _pcfg())
+        plain.submit(list(prompt), max_new_tokens=8)
+        assert plain.run()[0].output == out_after
+
+    def test_scope_isolation_survives_the_disk_hop(self, model, tmp_path):
+        """Different weights fingerprint to a different scope; the scope
+        is part of the disk key, so a restarted engine with OTHER
+        weights can never adopt the persisted blocks."""
+        cfg, params = model
+        tier = SliceLocalSSDStore(str(tmp_path / "tier"))
+        prompt = _prompt(cfg, seed=41)
+        reg1 = SharedPrefixRegistry()
+        reg1.attach_spill(tier)
+        _eng, _ = _serve_once(params, cfg, reg1, prompt, max_new=6)
+        del reg1
+
+        other = llama.init_params(jax.random.PRNGKey(7), cfg)
+        reg2 = SharedPrefixRegistry()
+        reg2.attach_spill(tier)
+        eng2, _ = _serve_once(other, cfg, reg2, prompt, max_new=6)
+        assert eng2.blocks.shared_hits == 0
+
+    def test_memory_lru_eviction_recovers_from_disk(self, model, tmp_path):
+        """An entry the bounded in-memory LRU evicted stays adoptable:
+        the spill read-through repopulates it on demand."""
+        cfg, params = model
+        tier = SliceLocalSSDStore(str(tmp_path / "tier"))
+        prompt = _prompt(cfg, seed=42)
+        reg = SharedPrefixRegistry(max_entries=1)  # evicts almost all
+        reg.attach_spill(tier)
+        _eng, out_a = _serve_once(params, cfg, reg, prompt)
+        assert len(reg) == 1
+        eng2, out_b = _serve_once(params, cfg, reg, prompt)
+        assert eng2.blocks.shared_hits >= 3
+        assert out_b == out_a
+
+    def test_detached_spill_is_memory_only(self, model, tmp_path):
+        cfg, params = model
+        tier = SliceLocalSSDStore(str(tmp_path / "tier"))
+        reg = SharedPrefixRegistry()
+        reg.attach_spill(tier)
+        reg.attach_spill(None)
+        _eng, _ = _serve_once(params, cfg, reg, _prompt(cfg, seed=43))
+        assert tier.list("kv/") == []  # nothing persisted after detach

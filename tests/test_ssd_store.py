@@ -8,12 +8,17 @@ same Store contract as the S3/file/memory providers
 
 import os
 import struct
+import time
 
 import pytest
 
 from bobrapet_tpu.storage.manager import StorageManager
 from bobrapet_tpu.storage.ssd import SSDStore, make_ssd_store
-from bobrapet_tpu.storage.store import BlobNotFound, StorageError
+from bobrapet_tpu.storage.store import (
+    BlobNotFound,
+    SliceLocalSSDStore,
+    StorageError,
+)
 
 
 @pytest.fixture
@@ -21,6 +26,27 @@ def ssd(tmp_path):
     store = SSDStore(str(tmp_path / "cache"))
     yield store
     store.close()
+
+
+def _close(store):
+    if hasattr(store, "close"):
+        store.close()
+
+
+@pytest.fixture(params=["native", "python"])
+def bounded_factory(request, tmp_path):
+    """Both slice-SSD implementations under one eviction contract:
+    the native C++ blob cache and the capacity-bounded Python layout
+    must agree on LRU order, pinning, capacity accounting, and
+    oversized-put rejection."""
+
+    def make(capacity_bytes, subdir="cache"):
+        path = str(tmp_path / subdir)
+        if request.param == "native":
+            return SSDStore(path, capacity_bytes=capacity_bytes)
+        return SliceLocalSSDStore(path, capacity_bytes=capacity_bytes)
+
+    return make
 
 
 class TestRoundtrip:
@@ -280,6 +306,132 @@ class TestPinning:
         mgr.unpin_run("default", "r1")
         mgr.unpin_run("default", "r1")  # double-unpin tolerated
         mgr.store.close()
+
+
+def _blob_paths(base_dir):
+    out = set()
+    for root, _, files in os.walk(base_dir):
+        out |= {os.path.join(root, f) for f in files}
+    return out
+
+
+class TestEvictionContract:
+    """One eviction contract, two implementations: the native blob
+    cache and the capacity-bounded Python layout must agree on
+    pin-exemption, capacity accounting across delete/re-put, and
+    ``stat_mtime``-ordered eviction after a reopen."""
+
+    def test_eviction_under_pin_prefix(self, bounded_factory):
+        s = bounded_factory(3 * 1100)
+        s.pin_prefix("runs/default/live/")
+        s.put("runs/default/live/a", b"p" * 1024)
+        for i in range(5):
+            s.put(f"cold/{i}", bytes([i]) * 1024)
+        # the pinned blob is the LRU-oldest yet must not be a victim;
+        # the pressure lands on the unpinned cold blobs instead
+        assert s.get("runs/default/live/a") == b"p" * 1024
+        assert sum(s.exists(f"cold/{i}") for i in range(5)) < 5
+        s.unpin_prefix("runs/default/live/")
+        for i in range(5, 9):
+            s.put(f"cold/{i}", bytes([i]) * 1024)
+        assert not s.exists("runs/default/live/a")
+        _close(s)
+
+    def test_budget_yields_to_pinned_data(self, bounded_factory):
+        s = bounded_factory(2 * 1100)
+        s.pin_prefix("runs/r/")
+        for i in range(3):
+            s.put(f"runs/r/{i}", bytes([i]) * 1024)
+        for i in range(3):
+            assert s.exists(f"runs/r/{i}")
+        assert s.used_bytes() > 2 * 1100  # budget yielded, data kept
+        _close(s)
+
+    def test_capacity_accounting_across_delete_and_reput(
+        self, bounded_factory
+    ):
+        s = bounded_factory(64 * 1024)
+        s.put("a", b"A" * 1000)
+        ua = s.used_bytes()
+        s.put("b", b"B" * 1000)
+        uab = s.used_bytes()
+        # same payload size + same key length = same on-disk cost
+        assert uab == 2 * ua
+        s.delete("a")
+        assert s.used_bytes() == uab - ua
+        s.delete("a")  # idempotent: no double subtraction
+        assert s.used_bytes() == uab - ua
+        s.put("a", b"A" * 1000)
+        assert s.used_bytes() == uab
+        s.put("a", b"A" * 2000)  # overwrite grows by exactly the delta
+        assert s.used_bytes() == uab + 1000
+        s.put("a", b"A" * 500)  # overwrite shrinks likewise
+        assert s.used_bytes() == uab - 500
+        _close(s)
+
+    def test_stat_mtime_ordered_eviction_after_reopen(self, bounded_factory):
+        s = bounded_factory(3 * 1100)
+        paths, before = {}, set()
+        for k in ("k0", "k1", "k2"):
+            s.put(k, b"z" * 1024)
+            now = _blob_paths(s.base_dir)
+            paths[k] = (now - before).pop()
+            before = now
+        _close(s)
+        # rewrite history on disk: k1 is oldest, k0 middle, k2 newest
+        # (deliberately NOT the insertion order — a rebuilt index must
+        # trust stat_mtime, the only recency fact that survives)
+        t = time.time()
+        for key, age in (("k1", 300), ("k0", 200), ("k2", 100)):
+            os.utime(paths[key], (t - age, t - age))
+        s2 = bounded_factory(3 * 1100)
+        s2.put("k3", b"z" * 1024)  # over budget: evicts exactly one
+        assert not s2.exists("k1")
+        for k in ("k0", "k2", "k3"):
+            assert s2.exists(k)
+        _close(s2)
+
+    def test_oversized_put_rejected_without_side_effects(
+        self, bounded_factory
+    ):
+        s = bounded_factory(512)
+        with pytest.raises(StorageError):
+            s.put("huge", b"x" * 4096)
+        assert not s.exists("huge")
+        assert s.used_bytes() == 0
+        _close(s)
+
+
+class TestPythonFallbackBudget:
+    """make_ssd_store / build_store now hand the byte budget to the
+    Python fallback too (it used to be silently unenforced)."""
+
+    def test_make_ssd_store_fallback_keeps_budget(self, tmp_path, monkeypatch):
+        import bobrapet_tpu.storage.ssd as ssd_mod
+
+        def boom(*a, **k):
+            raise ssd_mod.NativeUnavailable("no toolchain")
+
+        monkeypatch.setattr(ssd_mod, "load_native", boom)
+        s = make_ssd_store(str(tmp_path / "c"), capacity_bytes=2 * 1100)
+        assert isinstance(s, SliceLocalSSDStore)
+        assert s.capacity_bytes == 2 * 1100
+        for i in range(4):
+            s.put(f"b/{i}", bytes([i]) * 1024)
+        assert s.used_bytes() <= 2 * 1100
+
+    def test_build_store_native_false_enforces_budget(self, tmp_path):
+        from bobrapet_tpu.api.shared import SliceLocalSSDProvider, StoragePolicy
+        from bobrapet_tpu.storage import build_store
+
+        policy = StoragePolicy(slice_local_ssd=SliceLocalSSDProvider(
+            path=str(tmp_path / "ssd"), max_bytes=2 * 1100, native=False))
+        s = build_store(policy)
+        for i in range(4):
+            s.put(f"b/{i}", bytes([i]) * 1024)
+        assert s.used_bytes() <= 2 * 1100
+        assert not s.exists("b/0")
+        assert s.exists("b/3")
 
 
 class TestProviderPinning:

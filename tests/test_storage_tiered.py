@@ -1,0 +1,421 @@
+"""Tiered payload storage: L1 hydrate LRU -> L2 slice-local disk -> L3
+backing provider.
+
+Pins the tentpole contracts of the tiered StorageManager: read-through
+promotion, dehydrate write-through, sha-verified staleness handling,
+single-flight fetch collapsing, pin propagation (including replay onto
+a tier attached mid-run), retention sweeping both layers, the
+``storage.*`` operator keys and their live Runtime wiring, the
+flight-recorder / trace-span breadcrumbs, and the headline economics:
+a warm disk tier beats the provider-only path.
+"""
+
+import threading
+import time
+
+import pytest
+
+from bobrapet_tpu.config.operator import OperatorConfig, parse_config
+from bobrapet_tpu.observability.metrics import metrics
+from bobrapet_tpu.storage.manager import StorageManager
+from bobrapet_tpu.storage.store import (
+    MemoryStore,
+    SliceLocalSSDStore,
+    StorageError,
+)
+
+
+class CountingStore(MemoryStore):
+    """Backing provider that counts (and optionally delays) gets."""
+
+    def __init__(self, delay: float = 0.0):
+        super().__init__()
+        self.gets = 0
+        self.delay = delay
+        self._gate = threading.Event()
+        self._gate.set()
+
+    def get(self, key):
+        self.gets += 1
+        if self.delay:
+            time.sleep(self.delay)
+        self._gate.wait(5.0)
+        return super().get(key)
+
+
+@pytest.fixture
+def tier(tmp_path):
+    return SliceLocalSSDStore(str(tmp_path / "tier"))
+
+
+def _offload(mgr, n=4, prefix="runs/ns/r1"):
+    scope = {}
+    for i in range(n):
+        scope[f"s{i}"] = mgr.dehydrate(
+            {"doc": "z" * 4096 + str(i)}, f"{prefix}/steps/s{i}/output"
+        )
+    return scope
+
+
+class TestReadThroughWriteThrough:
+    def test_dehydrate_writes_through_to_disk_tier(self, tier):
+        backing = CountingStore()
+        mgr = StorageManager(backing, max_inline_size=64, disk_tier=tier)
+        _offload(mgr)
+        assert backing.list("runs/ns/r1/")  # L3 is the source of truth
+        assert tier.list("runs/ns/r1/")  # L2 warmed at write time
+
+    def test_provider_fetch_promotes_into_disk_tier(self, tier):
+        backing = CountingStore()
+        flat = StorageManager(backing, max_inline_size=64)
+        scope = _offload(flat)  # backing only — tier is cold
+        assert tier.list("runs/ns/r1/") == []
+        mgr = StorageManager(backing, max_inline_size=64, disk_tier=tier)
+        h0 = metrics.storage_tier.value("disk", "hit")
+        out = mgr.hydrate(scope, allowed_prefixes=["runs/ns/r1"])
+        assert out["s0"]["doc"].startswith("z")
+        assert tier.list("runs/ns/r1/")  # promoted on the L3 fetch
+        gets_after_cold = backing.gets
+        # a FRESH manager (fresh L1) must now be served from disk
+        mgr2 = StorageManager(backing, max_inline_size=64, disk_tier=tier)
+        out2 = mgr2.hydrate(scope, allowed_prefixes=["runs/ns/r1"])
+        assert out2 == out
+        assert backing.gets == gets_after_cold  # zero provider round trips
+        assert metrics.storage_tier.value("disk", "hit") >= h0 + 4
+
+    def test_stale_disk_entry_refetched_not_served(self, tier):
+        backing = CountingStore()
+        mgr = StorageManager(backing, max_inline_size=64, disk_tier=tier)
+        scope = _offload(mgr, n=1)
+        key = tier.list("runs/ns/r1/")[0]
+        # the backing key is overwritten with NEW content (retry with a
+        # different payload reusing the deterministic key scheme); the
+        # disk tier still holds the old bytes
+        new_payload = b'{"doc":"fresh"}'
+        backing.put(key, new_payload)
+        import hashlib
+        import json
+
+        marker = scope["s0"]
+        marker["storageRef"]["sha256"] = hashlib.sha256(new_payload).hexdigest()
+        marker["storageRef"]["size"] = len(new_payload)
+        s0 = metrics.storage_tier.value("disk", "stale")
+        mgr2 = StorageManager(backing, max_inline_size=64, disk_tier=tier)
+        out = mgr2.hydrate(scope, allowed_prefixes=["runs/ns/r1"])
+        assert out["s0"] == json.loads(new_payload)
+        assert metrics.storage_tier.value("disk", "stale") == s0 + 1
+        # the stale entry was replaced by the fresh promote
+        assert tier.get(key) == new_payload
+
+    def test_gauges_track_tier_state(self, tier):
+        backing = CountingStore()
+        mgr = StorageManager(backing, max_inline_size=64, disk_tier=tier)
+        scope = _offload(mgr, n=2)
+        assert metrics.storage_disk_used_bytes.value() == tier.used_bytes()
+        assert tier.used_bytes() > 0
+        StorageManager(backing, max_inline_size=64, disk_tier=tier).hydrate(
+            scope, allowed_prefixes=["runs/ns/r1"]
+        )
+        assert metrics.storage_disk_hit_rate.value() > 0
+
+
+class TestSingleFlight:
+    def test_concurrent_misses_collapse_to_one_fetch(self):
+        backing = CountingStore(delay=0.05)
+        mgr = StorageManager(backing, max_inline_size=64)
+        # a big SCALAR offloads as exactly one blob (a container would
+        # nest-offload into several refs and muddy the fetch count)
+        scope = {"s0": mgr.dehydrate("z" * 4096, "runs/ns/r1/steps/s0/o")}
+        joins0 = metrics.storage_singleflight.value()
+        results, errors = [], []
+
+        def worker():
+            try:
+                results.append(
+                    mgr.hydrate(scope, allowed_prefixes=["runs/ns/r1"])
+                )
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert len({str(r) for r in results}) == 1
+        # one leader fetched; everyone else joined (hydrate spawns at
+        # most one provider round trip for the single shared ref)
+        assert backing.gets == 1
+        assert metrics.storage_singleflight.value() >= joins0 + 1
+
+    def test_leader_failure_propagates_to_joiners(self):
+        class FailingStore(CountingStore):
+            def get(self, key):
+                self.gets += 1
+                time.sleep(0.05)
+                raise StorageError("backend down")
+
+        backing = FailingStore()
+        mgr = StorageManager(backing, max_inline_size=64)
+        from bobrapet_tpu.storage.manager import StorageRef
+
+        ref = StorageRef(key="runs/ns/r1/steps/a/output", provider="memory",
+                         size=10, sha256="ab" * 32)
+        errors = []
+
+        def worker():
+            try:
+                mgr._fetch_ref(ref, ["runs/ns/r1"])
+            except StorageError as e:
+                errors.append(str(e))
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(errors) == 4  # every caller saw the failure
+        assert backing.gets <= 2  # but the backend was not stampeded
+
+
+class TestPinsAndRetention:
+    def test_pin_run_pins_both_layers_and_replays_on_attach(self, tmp_path):
+        tier = SliceLocalSSDStore(str(tmp_path / "t"), capacity_bytes=3 * 1100)
+        backing = MemoryStore()
+        mgr = StorageManager(backing, max_inline_size=64)
+        mgr.pin_run("ns", "r1")  # pinned BEFORE the tier exists
+        mgr.set_disk_tier(tier)  # attach mid-run: pin must be replayed
+        tier.put("runs/ns/r1/steps/a/output", b"p" * 1024)
+        for i in range(5):
+            tier.put(f"cold/{i}", bytes([i]) * 1024)
+        assert tier.exists("runs/ns/r1/steps/a/output")
+        mgr.unpin_run("ns", "r1")
+        for i in range(5, 9):
+            tier.put(f"cold/{i}", bytes([i]) * 1024)
+        assert not tier.exists("runs/ns/r1/steps/a/output")
+
+    def test_delete_prefix_sweeps_disk_tier(self, tier):
+        backing = MemoryStore()
+        mgr = StorageManager(backing, max_inline_size=64, disk_tier=tier)
+        _offload(mgr)
+        assert tier.list("runs/ns/r1/")
+        n_backing = len(backing.list("runs/ns/r1/"))
+        n = mgr.delete_prefix("runs/ns/r1")
+        assert n == n_backing
+        assert backing.list("runs/ns/r1/") == []
+        assert tier.list("runs/ns/r1/") == []
+
+
+class TestObservability:
+    def test_tier_decisions_reach_flight_recorder(self, tier):
+        from bobrapet_tpu.observability.timeline import FLIGHT
+
+        backing = MemoryStore()
+        flat = StorageManager(backing, max_inline_size=64)
+        scope = _offload(flat, prefix="runs/flightns/flightrun")
+        mgr = StorageManager(backing, max_inline_size=64, disk_tier=tier)
+        mgr.hydrate(scope, allowed_prefixes=["runs/flightns/flightrun"])
+        StorageManager(backing, max_inline_size=64, disk_tier=tier).hydrate(
+            scope, allowed_prefixes=["runs/flightns/flightrun"]
+        )
+        records = FLIGHT.timeline("flightns", "flightrun")
+        decisions = {r.get("decision") for r in records
+                     if r.get("kind") == "storage"}
+        assert "promote" in decisions  # cold pass promoted into L2
+        assert "disk hit" in decisions  # warm pass served from L2
+        FLIGHT.forget("flightns", "flightrun")
+
+    def test_hydrate_annotates_ambient_span_chain(self, tier):
+        from bobrapet_tpu.observability.tracing import (
+            InMemorySpanExporter,
+            Tracer,
+            TracingConfig,
+        )
+        from bobrapet_tpu.observability import tracing as tracing_mod
+
+        exporter = InMemorySpanExporter()
+        tracer = Tracer(TracingConfig(enabled=True), exporter)
+        backing = MemoryStore()
+        mgr = StorageManager(backing, max_inline_size=64, disk_tier=tier)
+        scope = _offload(mgr)
+        prev = tracing_mod.TRACER
+        tracing_mod.TRACER = tracer
+        try:
+            with tracer.start_span("steprun.dispatch") as parent:
+                StorageManager(
+                    backing, max_inline_size=64, disk_tier=tier
+                ).hydrate(scope, allowed_prefixes=["runs/ns/r1"])
+        finally:
+            tracing_mod.TRACER = prev
+        hydrate_spans = [s for s in exporter.spans
+                         if s.name == "storage.hydrate"]
+        assert hydrate_spans
+        attrs = hydrate_spans[0].attributes
+        assert attrs.get("storage.disk_hits", 0) >= 4
+        # ...and the ambient dispatch span carries the same accounting,
+        # so a slow dispatch is attributable to cold storage
+        assert parent.attributes.get("storage.disk_hits", 0) >= 4
+        assert "storage.provider_fetches" in parent.attributes
+
+
+class TestOperatorKeys:
+    def test_storage_keys_parse_and_validate(self):
+        cfg = parse_config({
+            "storage.disk-cache-enabled": "true",
+            "storage.disk-cache-dir": "/mnt/slice-ssd/cache",
+            "storage.disk-cache-bytes": "1073741824",
+        })
+        assert cfg.storage.disk_cache_enabled is True
+        assert cfg.storage.disk_cache_dir == "/mnt/slice-ssd/cache"
+        assert cfg.storage.disk_cache_bytes == 1 << 30
+        assert cfg.validate() == []
+
+    def test_validation_rejects_enabled_without_dir(self):
+        cfg = OperatorConfig()
+        cfg.storage.disk_cache_enabled = True
+        assert any("storage.disk-cache-dir" in e for e in cfg.validate())
+        cfg.storage.disk_cache_dir = "/mnt/x"
+        cfg.storage.disk_cache_bytes = -1
+        assert any("storage.disk-cache-bytes" in e for e in cfg.validate())
+
+    def test_runtime_live_reload_attaches_and_detaches_tier(self, tmp_path):
+        from bobrapet_tpu.core.object import new_resource
+        from bobrapet_tpu.runtime import Runtime
+
+        rt = Runtime(blob_store=MemoryStore())
+        assert rt.storage.disk_tier is None
+        rt.store.create(new_resource(
+            "ConfigMap", "operator-config", "bobrapet-system",
+            spec={"data": {
+                "storage.disk-cache-enabled": "true",
+                "storage.disk-cache-dir": str(tmp_path / "tier"),
+                "storage.disk-cache-bytes": "1048576",
+            }},
+        ))
+        tier = rt.storage.disk_tier
+        assert tier is not None
+        tier.put("probe", b"x")
+        assert tier.get("probe") == b"x"
+        # unrelated reload keeps the SAME warm tier object
+        rt.store.mutate(
+            "ConfigMap", "bobrapet-system", "operator-config",
+            lambda r: r.spec["data"].update({"logging.verbosity": "2"}),
+        )
+        assert rt.storage.disk_tier is tier
+        # disabling detaches
+        rt.store.mutate(
+            "ConfigMap", "bobrapet-system", "operator-config",
+            lambda r: r.spec["data"].update(
+                {"storage.disk-cache-enabled": "false"}
+            ),
+        )
+        assert rt.storage.disk_tier is None
+
+    def test_runtime_startup_reads_preexisting_configmap(self, tmp_path):
+        from bobrapet_tpu.core.object import new_resource
+        from bobrapet_tpu.core.store import ResourceStore
+        from bobrapet_tpu.runtime import Runtime
+
+        store = ResourceStore()
+        store.create(new_resource(
+            "ConfigMap", "operator-config", "bobrapet-system",
+            spec={"data": {
+                "storage.disk-cache-enabled": "true",
+                "storage.disk-cache-dir": str(tmp_path / "tier"),
+            }},
+        ))
+        rt = Runtime(store=store, blob_store=MemoryStore())
+        assert rt.storage.disk_tier is not None
+        # detach so the process-wide ACTIVE_DISK_TIER handoff slot does
+        # not outlive this test's tmp_path
+        rt.storage.set_disk_tier(None)
+
+
+class TestPreemptionWarmsTiers:
+    def test_preemption_notice_prefetches_run_scope(self, tmp_path):
+        """The moment a Job preemption notice lands, the fleet watcher
+        fires a fire-and-forget prefetch of the owning run's scope —
+        overlapped with quarantine + re-placement — so the redriven
+        gang's hydrate hits warm tiers instead of the provider."""
+        from bobrapet_tpu.config import OperatorConfigManager
+        from bobrapet_tpu.core.object import new_resource
+        from bobrapet_tpu.core.store import ResourceStore
+        from bobrapet_tpu.fleet import FleetManager, PreemptionWatcher
+        from bobrapet_tpu.parallel.placement import SlicePlacer
+
+        backing = CountingStore()
+        flat = StorageManager(backing, max_inline_size=64)
+        inputs = {
+            "doc": flat.dehydrate("q" * 4096, "runs/ns/prun/inputs/doc")
+        }
+        tier = SliceLocalSSDStore(str(tmp_path / "t"))
+        storage = StorageManager(backing, max_inline_size=64, disk_tier=tier)
+        store = ResourceStore()
+        fleet = FleetManager(SlicePlacer(), OperatorConfigManager())
+        watcher = PreemptionWatcher(store, fleet, storage=storage)
+        store.create(new_resource(
+            "StoryRun", "prun", "ns", spec={"inputs": inputs}
+        ))
+        store.create(new_resource(
+            "StepRun", "prun-s0", "ns",
+            spec={"storyRunRef": {"name": "prun"}},
+        ))
+        grant = {"pool": "p", "topology": "1x1", "origin": [0, 0],
+                 "hosts": 1}
+        store.create(new_resource(
+            "Job", "prun-s0-job", "ns",
+            spec={"stepRunRef": {"name": "prun-s0"}, "sliceGrant": grant},
+        ))
+        store.patch_status(
+            "Job", "ns", "prun-s0-job",
+            lambda s: s.update(preempted=True, preemptedHost=0),
+        )
+        # the prefetch is fire-and-forget on the shared pool — wait for
+        # the provider fetch + disk-tier promote to land
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not tier.list("runs/ns/prun/"):
+            time.sleep(0.01)
+        assert backing.gets >= 1  # scope actually pulled
+        assert tier.list("runs/ns/prun/")  # ...and the disk tier is warm
+        # repeat notices don't re-walk the scope (warm-once per job)
+        gets = backing.gets
+        store.patch_status(
+            "Job", "ns", "prun-s0-job",
+            lambda s: s.update(preempted=True, preemptedHost=1),
+        )
+        time.sleep(0.1)
+        assert backing.gets == gets
+        assert watcher is not None
+
+
+class TestWarmDiskEconomics:
+    def test_warm_disk_beats_cold_provider_3x(self, tmp_path):
+        """The acceptance shape of the tier: with a realistic provider
+        round trip, hydrating a scope from the warm disk tier is >= 3x
+        the provider-only path. The cold leg's floor is hard (injected
+        sleep per get); the warm leg does no provider IO at all."""
+        backing = CountingStore(delay=0.010)
+        flat = StorageManager(backing, max_inline_size=64)
+        scope = {}
+        for i in range(32):
+            scope[f"s{i}"] = flat.dehydrate(
+                {"doc": "w" * 8192 + str(i)}, f"runs/ns/econ/steps/s{i}/o"
+            )
+        t0 = time.perf_counter()
+        StorageManager(backing, max_inline_size=64).hydrate(
+            scope, allowed_prefixes=["runs/ns/econ"]
+        )
+        cold = time.perf_counter() - t0
+        tier = SliceLocalSSDStore(str(tmp_path / "tier"))
+        StorageManager(backing, max_inline_size=64, disk_tier=tier).hydrate(
+            scope, allowed_prefixes=["runs/ns/econ"]
+        )  # promote pass
+        gets0 = backing.gets
+        t0 = time.perf_counter()
+        StorageManager(backing, max_inline_size=64, disk_tier=tier).hydrate(
+            scope, allowed_prefixes=["runs/ns/econ"]
+        )
+        warm = time.perf_counter() - t0
+        assert backing.gets == gets0  # warm leg: zero provider IO
+        assert cold / warm >= 3.0, (cold, warm)
