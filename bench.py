@@ -442,30 +442,36 @@ def config5_nested_rag() -> dict:
     }
 
 
+def _pctl(vals, q):
+    """Nearest-rank percentile over possibly-unsorted/None-holed
+    samples — the ONE definition every gated latency line uses (two
+    drifting private copies would silently change what the regression
+    gate compares)."""
+    vals = sorted(v for v in vals if v is not None)
+    if not vals:
+        return None
+    return vals[min(len(vals) - 1, round(q * (len(vals) - 1)))]
+
+
 def _slo_lines(reqs, config_name: str, new_tokens: int, **key_fields) -> list:
     """TTFT/TPOT p50/p95/p99 metric lines from a measured drain's
     finished requests (ROADMAP 4a: request-level latency joins the
     regression gate so it can never silently regress the way
     `llama_decode_tokens_per_sec_per_chip` did). One gated line per
     percentile; the names live in GATE_LOWER_IS_BETTER."""
-
-    def pctl(vals, q):
-        return vals[min(len(vals) - 1, round(q * (len(vals) - 1)))]
-
     lines = []
     samples = {
-        "ttft": sorted(r.ttft_seconds for r in reqs
-                       if r.ttft_seconds is not None),
-        "tpot": sorted(r.tpot_seconds for r in reqs
-                       if r.tpot_seconds is not None),
+        "ttft": [r.ttft_seconds for r in reqs],
+        "tpot": [r.tpot_seconds for r in reqs],
     }
     for name, vals in samples.items():
+        vals = [v for v in vals if v is not None]
         if not vals:
             continue
         for q, tag in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
             lines.append({
                 "metric": f"serving_{name}_ms_{tag}",
-                "value": round(pctl(vals, q) * 1000.0, 3),
+                "value": round(_pctl(vals, q) * 1000.0, 3),
                 "unit": "ms",
                 "vs_baseline": 1.0,
                 "config": config_name,
@@ -907,6 +913,205 @@ def config13_payload_hydrate_tiered() -> dict:
     }
 
 
+def config14_serving_disagg() -> dict:
+    """Disaggregated prefill/decode serving with prefix-aware routing
+    (serving/router.py) vs a RESOURCE-MATCHED unified deployment on a
+    mixed long-prompt/short-prompt workload.
+
+    Both legs run TWO engines behind the same ServingRouter on one
+    serialized CPU (the same GIL-honesty framing as the shard soak:
+    what transfers to real hardware is the equal-replica comparison,
+    not absolute tok/s):
+
+    - **unified leg**: 2 unified engines, least-loaded routing
+      (prefix_affinity=False — affinity IS part of this change, the
+      baseline is the status-quo replica deployment), chunked prefill
+      (prefillChunk=128, the strongest pair config measured on this
+      box: bigger chunks beat smaller ones on BOTH axes here because
+      per-tick overhead, not stall size, dominates at tiny-model CPU
+      scale; one-shot prefill is reported unfit separately — its tpot
+      p95 measured ~2x worse). Cross-engine prefix sharing stays ON
+      (PR-7 capability, not this change).
+    - **disagg leg**: 1 prefill-role engine (one-shot prefill — a
+      prefill pool has no decode horizons to protect, so chunking
+      would be pure dispatch tax) + 1 decode-role engine, prefix-aware
+      routing, KV handoff through the shared registry.
+
+    Workload: 12 prefill-heavy requests (128-token shared system
+    prompt + 512-token unique tail, 8 new tokens) interleaved 2:1 with
+    8 decode-heavy requests (8-11 token prompts, 64 new tokens),
+    submitted closed-loop (window 14) so long arrivals keep landing
+    mid-decode — the interference shape disaggregation exists for.
+    Timed as interleaved best-of-N drains (fresh prompt bytes per rep;
+    prefill is paid honestly every drain). The KV-handoff cost is
+    charged per request (prefill-pool retirement -> first decode-side
+    token) and reported; decode output must be byte-identical to the
+    unified leg for every request, every rep."""
+    import numpy as np
+
+    from bobrapet_tpu.models import llama
+    from bobrapet_tpu.serving import PagedConfig, ServingEngine, ServingRouter
+    from bobrapet_tpu.serving.prefix_cache import SharedPrefixRegistry
+
+    cfg = llama.llama_tiny()
+    params = llama.init_params(__import__("jax").random.PRNGKey(0), cfg)
+    n_long, n_short = 12, 8
+    long_new, short_new = 8, 64
+    reps = int(os.environ.get("BENCH_DISAGG_REPS", "4"))
+    window = 14
+    mix = f"{n_long}Lx{long_new}+{n_short}Sx{short_new}"
+
+    def mk_workload(seed):
+        r = np.random.default_rng(seed)
+        # 128-token system prompt + 512-token tail: the post-match
+        # suffix is exactly the 512 bucket, so the prefill pool pays
+        # zero padding FLOPs (an unaligned tail taxed it up to 23%)
+        system = r.integers(0, cfg.vocab_size, 128).tolist()
+        longs = [(system + r.integers(0, cfg.vocab_size, 512).tolist(),
+                  long_new) for _ in range(n_long)]
+        shorts = [(r.integers(0, cfg.vocab_size, 8 + (i % 4)).tolist(),
+                   short_new) for i in range(n_short)]
+        out, li, si = [], 0, 0
+        while li < n_long or si < n_short:
+            if li < n_long:
+                out.append(longs[li]); li += 1
+            if li < n_long:
+                out.append(longs[li]); li += 1
+            if si < n_short:
+                out.append(shorts[si]); si += 1
+        return out
+
+    def closed_drain(target, wl):
+        base = len(target.finished)
+        it = iter(wl)
+        submitted = 0
+        t0 = time.perf_counter()
+        for _ in range(min(window, len(wl))):
+            p, n = next(it)
+            target.submit(list(p), max_new_tokens=n)
+            submitted += 1
+        while len(target.finished) - base < len(wl):
+            target.step()
+            while (submitted < len(wl)
+                   and submitted - (len(target.finished) - base) < window):
+                p, n = next(it)
+                target.submit(list(p), max_new_tokens=n)
+                submitted += 1
+        return target.finished[base:], time.perf_counter() - t0
+
+    pctl = _pctl  # the shared gate-wide percentile definition
+
+    pc = dict(block_size=16, num_blocks=512, max_blocks_per_seq=41)
+    total_new = n_long * long_new + n_short * short_new
+
+    reg_u = SharedPrefixRegistry(max_entries=4096)
+    upair = ServingRouter({
+        "u0": ServingEngine(params, cfg, PagedConfig(
+            max_slots=8, prefill_chunk=128, **pc), prefix_shared=reg_u),
+        "u1": ServingEngine(params, cfg, PagedConfig(
+            max_slots=8, prefill_chunk=128, **pc), prefix_shared=reg_u),
+    }, registry=reg_u, prefix_affinity=False)
+    reg_d = SharedPrefixRegistry(max_entries=4096)
+    pf = ServingEngine(params, cfg, PagedConfig(max_slots=8, **pc),
+                       prefix_shared=reg_d, role="prefill")
+    dec = ServingEngine(params, cfg, PagedConfig(max_slots=8, **pc),
+                        prefix_shared=reg_d, role="decode")
+    disagg = ServingRouter({"prefill": pf, "decode": dec}, registry=reg_d,
+                           prefill_threshold=64)
+
+    # shape-identical different-bytes warm pass compiles every graph
+    # both legs touch (and the fresh bytes per timed rep below keep
+    # every drain paying prefill honestly — see config8)
+    closed_drain(upair, mk_workload(99))
+    closed_drain(disagg, mk_workload(99))
+    for eng in (pf, dec):
+        eng.reset_phase_stats()
+
+    best_u = best_d = 0.0
+    tpot_u = tpot_d = None
+    fin_d_best = []
+    identical = True
+    for rep in range(reps):
+        wl = mk_workload(1 + rep)
+        fin_u, wall_u = closed_drain(upair, wl)
+        fin_d, wall_d = closed_drain(disagg, wl)
+        identical = identical and (
+            sorted(tuple(r.output) for r in fin_u)
+            == sorted(tuple(r.output) for r in fin_d)
+        )
+        ru, rd = total_new / wall_u, total_new / wall_d
+        if ru > best_u:
+            best_u = ru
+            tpot_u = pctl([r.tpot_seconds for r in fin_u], 0.95)
+        if rd > best_d:
+            best_d = rd
+            tpot_d = pctl([r.tpot_seconds for r in fin_d], 0.95)
+            fin_d_best = fin_d
+    # router hit rate over the prefix-heavy leg = the handoff
+    # population of the best rep (every rep's system prompt is fresh
+    # bytes, so each rep re-earns its hits through the chain the
+    # prefill pool exported — nothing is inherited across reps)
+    all_hits = sum(1 for o in disagg.outcomes.values() if o == "prefix-hit")
+    long_hits = sum(
+        1 for r in fin_d_best
+        if r.kv_handoff_s is not None
+        and disagg.outcomes.get(r.rid) == "prefix-hit"
+    )
+    long_out = [disagg.outcomes.get(r.rid) for r in fin_d_best]
+    n_handoffs = sum(1 for r in fin_d_best if r.kv_handoff_s is not None)
+    kh = sorted(r.kv_handoff_s for r in fin_d_best
+                if r.kv_handoff_s is not None)
+    _emit({
+        "metric": "serving_disagg_tpot_ms_p95",
+        "value": round(tpot_d * 1000.0, 3),
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "config": "serving-disagg",
+        "mix": mix,
+        "unified_tpot_ms_p95": round(tpot_u * 1000.0, 3),
+    })
+    _emit({
+        "metric": "serving_disagg_speedup_vs_unified",
+        "value": round(best_d / best_u, 3) if best_u else 0.0,
+        "unit": "x",
+        "vs_baseline": 1.0,
+        "config": "serving-disagg",
+        "mix": mix,
+    })
+    _emit({
+        "metric": "serving_disagg_router_hit_rate",
+        "value": round(long_hits / n_handoffs, 3) if n_handoffs else 0.0,
+        "unit": "fraction",
+        "vs_baseline": 1.0,
+        "config": "serving-disagg",
+        "mix": mix,
+        "prefix_leg_requests": n_handoffs,
+        "overall_prefix_hits": all_hits,
+        "decode_routings": len(disagg.outcomes),
+    })
+    return {
+        "metric": "serving_disagg_tokens_per_sec",
+        "value": round(best_d, 1),
+        "unit": "tok/s",
+        "vs_baseline": 1.0,
+        "config": "serving-disagg",
+        "mix": mix,
+        "reps": reps,
+        "window": window,
+        "unified_tok_s": round(best_u, 1),
+        "speedup_vs_unified": round(best_d / best_u, 2) if best_u else None,
+        "tpot_ms_p95": round(tpot_d * 1000.0, 3),
+        "unified_tpot_ms_p95": round(tpot_u * 1000.0, 3),
+        "byte_identical": identical,
+        "kv_handoff_ms_p50": round(1000.0 * pctl(kh, 0.5), 1) if kh else None,
+        "kv_handoff_ms_p95": round(1000.0 * pctl(kh, 0.95), 1) if kh else None,
+        "router_outcomes_sample": long_out[:8],
+        "unified_leg": "2x unified (chunk=128, least-loaded, shared "
+                       "registry); disagg: prefill(one-shot)+decode, "
+                       "prefix-aware",
+    }
+
+
 #: PR-5 seed number for the placement churn config, measured on this box
 #: against the pre-indexed brute-force allocator (per-cell set probes,
 #: unmemoized _fit_shape, no batched gang API) running the identical op
@@ -1089,7 +1294,8 @@ def run_sweep(state: dict) -> None:
                     ("payload-hydrate-tiered", config13_payload_hydrate_tiered),
                     ("serving", config6_serving),
                     ("serving-moe", config7_serving_moe),
-                    ("serving-spec", config8_serving_spec)):
+                    ("serving-spec", config8_serving_spec),
+                    ("serving-disagg", config14_serving_disagg)):
         state["stage"] = f"config-{idx}"
         try:
             _emit(fn())
@@ -1677,6 +1883,8 @@ GATE_LOWER_IS_BETTER = frozenset({
     # fails the bench)
     "serving_ttft_ms_p50", "serving_ttft_ms_p95", "serving_ttft_ms_p99",
     "serving_tpot_ms_p50", "serving_tpot_ms_p95", "serving_tpot_ms_p99",
+    # disaggregated serving latency plane (config14)
+    "serving_disagg_tpot_ms_p95",
 })
 
 
@@ -1692,7 +1900,11 @@ def _gate_key(d: dict) -> tuple:
     return (d.get("metric"), d.get("backend"), d.get("model"),
             d.get("quant"), d.get("batch"), d.get("shards"),
             d.get("prompt_len"), d.get("new_tokens"),
-            d.get("step_latency_s"), d.get("cap_per_shard"))
+            d.get("step_latency_s"), d.get("cap_per_shard"),
+            # disaggregated-serving lineage: the workload mix is part
+            # of the identity, so a reshaped mix starts a fresh gate
+            # history instead of being judged against the old one
+            d.get("mix"))
 
 
 def _best_prior() -> dict:

@@ -169,6 +169,19 @@ class ServingConfig:
     #: hash (weights-fingerprint scoped; see prefix_cache.py)
     #: (dotted: serving.prefix-cache-shared)
     prefix_cache_shared: bool = False
+    #: disaggregated serving role for engines this process serves:
+    #: unified (classic), prefill (retire at first token + KV export),
+    #: decode (adopt + decode-only traffic); step `role` keys pin
+    #: per-engine values (dotted: serving.role)
+    role: str = "unified"
+    #: minimum prompt tokens for the router to send a request through
+    #: the prefill pool; 0 = every request while a prefill engine
+    #: exists (dotted: serving.router-prefill-threshold)
+    router_prefill_threshold: int = 0
+    #: route decode admissions to the engine holding the longest
+    #: matching prefix chain (False = pure least-loaded)
+    #: (dotted: serving.router-prefix-affinity)
+    router_prefix_affinity: bool = True
 
 
 #: last serving config a Runtime applied in this process. The serving
@@ -325,6 +338,13 @@ class OperatorConfig:
             errs.append("serving.decode-horizon must be >= 1")
         if self.serving.spec_k < 1:
             errs.append("serving.spec-k must be >= 1")
+        if self.serving.role not in ("unified", "prefill", "decode"):
+            errs.append(
+                f"serving.role must be unified|prefill|decode, got "
+                f"{self.serving.role!r}"
+            )
+        if self.serving.router_prefill_threshold < 0:
+            errs.append("serving.router-prefill-threshold must be >= 0")
         if self.storage.disk_cache_bytes < 0:
             errs.append("storage.disk-cache-bytes must be >= 0")
         if self.storage.disk_cache_enabled and not self.storage.disk_cache_dir:
@@ -394,6 +414,9 @@ def _apply_dotted(cfg: OperatorConfig, key: str, value: str) -> bool:
         "serving.decode-horizon": lambda: fset(cfg.serving, "decode_horizon", int),
         "serving.spec-k": lambda: fset(cfg.serving, "spec_k", int),
         "serving.prefix-cache-shared": lambda: fset(cfg.serving, "prefix_cache_shared", as_bool),
+        "serving.role": lambda: fset(cfg.serving, "role", str),
+        "serving.router-prefill-threshold": lambda: fset(cfg.serving, "router_prefill_threshold", int),
+        "serving.router-prefix-affinity": lambda: fset(cfg.serving, "router_prefix_affinity", as_bool),
         "storage.disk-cache-enabled": lambda: fset(cfg.storage, "disk_cache_enabled", as_bool),
         "storage.disk-cache-dir": lambda: fset(cfg.storage, "disk_cache_dir", str),
         "storage.disk-cache-bytes": lambda: fset(cfg.storage, "disk_cache_bytes", int),

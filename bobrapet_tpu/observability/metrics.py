@@ -551,6 +551,50 @@ class _ControlPlaneMetrics:
             "rates are ratios of breach over the summed pair",
             ["slo", "outcome", "step"],
         )
+        # Disaggregated prefill/decode serving (serving/router.py):
+        # routing decisions, per-pool backlogs, and the KV-handoff cost
+        # the disaggregation bench charges against itself
+        self.serving_router = c(
+            "bobrapet_serving_router_total",
+            "Router admissions by outcome (prefix-hit = sent to the "
+            "engine holding the longest matching prefix chain, miss = "
+            "least-loaded fallback, prefill = sent to the prefill "
+            "pool, handoff = prefill->decode KV transfer, completed = "
+            "request finished through the router)",
+            ["outcome"],
+        )
+        self.serving_kv_handoff = h(
+            "bobrapet_serving_kv_handoff_seconds",
+            "Prefill-pool completion to the decode engine's first NEW "
+            "token (queue + registry adoption scatter + the <= "
+            "one-block suffix prefill — the full per-request cost of "
+            "disaggregation, charged honestly)",
+            [],
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0),
+        )
+        self.serving_pool_depth = g(
+            "bobrapet_serving_pool_queue_depth",
+            "Requests queued in the router ahead of engine admission, "
+            "per pool — prefill and decode backlogs are independently "
+            "visible (the autoscaler signal split)",
+            ["pool"],
+        )
+        self.serving_pool_wait = h(
+            "bobrapet_serving_pool_queue_wait_seconds",
+            "Router submission to engine admission, per pool",
+            ["pool"],
+            buckets=(0.0001, 0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                     30.0),
+        )
+        self.serving_prefix_match_depth = h(
+            "bobrapet_serving_prefix_match_depth_blocks",
+            "Chain blocks matched per SharedPrefixRegistry."
+            "longest_match probe (0 = registry knows nothing of this "
+            "prompt; partial depths show where chains break)",
+            [],
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64),
+        )
         # Tracing exporter self-reporting (OTLPSpanExporter): its
         # dropped/export_errors/queue-depth were plain attributes,
         # invisible in production

@@ -1,11 +1,14 @@
 """Serving: continuous batching over a paged KV cache (engine.py,
 paged_cache.py) — the TPU-native decode server the inference engrams
-run."""
+run. router.py disaggregates it into prefill/decode pools with
+prefix-aware routing."""
 
 from .engine import Request, ServingEngine
 from .paged_cache import BlockAllocator, PagedConfig
 from .prefix_cache import PrefixCache, SharedPrefixRegistry
+from .router import ServingRouter
 from .service import StreamServer
 
 __all__ = ["BlockAllocator", "PagedConfig", "PrefixCache", "Request",
-           "ServingEngine", "SharedPrefixRegistry", "StreamServer"]
+           "ServingEngine", "ServingRouter", "SharedPrefixRegistry",
+           "StreamServer"]

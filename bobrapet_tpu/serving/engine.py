@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 import time as _walltime
 from collections import deque
 from typing import Any, Optional
@@ -73,6 +74,8 @@ from .prefix_cache import PrefixCache
 
 _mm = quant.matmul
 
+_log = logging.getLogger(__name__)
+
 
 @dataclasses.dataclass
 class Request:
@@ -92,6 +95,22 @@ class Request:
     output: list[int] = dataclasses.field(default_factory=list)
     done: bool = False
     preemptions: int = 0
+    #: retired by a PREFILL-role engine: the request's KV is exported
+    #: and its first token(s) sampled; a decode engine continues it
+    #: (router.py hands it over). Never set on eos/budget completion.
+    prefilled: bool = False
+    #: tokens already in ``output`` at submit time (a KV-handoff
+    #: continuation); TTFT/TPOT count only tokens THIS engine decoded
+    preseeded: int = 0
+    #: stamped by the router on handoff completions: prefill-pool
+    #: retirement to this engine's first NEW token — the per-request
+    #: disaggregation cost the bench charges against the win
+    kv_handoff_s: Optional[float] = None
+    #: the USER-visible TTFT carried across a handoff (the prefill
+    #: leg's first token against the original submit clock);
+    #: ``first_token_at`` on the decode leg anchors decode CADENCE
+    #: (tpot), which must exclude the one-time handoff gap
+    ttft_carried_s: Optional[float] = None
     #: SLO latency plane timestamps — monotonic (perf_counter) for
     #: deltas plus one wall anchor for span backdating. Stamped at
     #: host-side scheduling points the engine already visits; first
@@ -105,6 +124,13 @@ class Request:
 
     @property
     def ttft_seconds(self) -> Optional[float]:
+        if self.ttft_carried_s is not None:
+            # a handoff continuation: the user saw their first token on
+            # the PREFILL leg — first_token_at here is the first DECODE
+            # token, and computing from it would inflate TTFT by the
+            # queue + handoff gap (traces would disagree with the
+            # histogram, which the prefill leg already fed)
+            return self.ttft_carried_s
         if self.first_token_at is None or not self.submitted_at:
             return None
         return self.first_token_at - self.submitted_at
@@ -112,11 +138,14 @@ class Request:
     @property
     def tpot_seconds(self) -> Optional[float]:
         """Mean time per output token AFTER the first (None until the
-        request finishes with >= 2 tokens)."""
+        request finishes with >= 2 tokens). Preseeded handoff tokens
+        were decoded by ANOTHER engine before submit — only tokens this
+        engine emitted between its first token and finish count."""
+        emitted = len(self.output) - self.preseeded
         if (self.finished_at is None or self.first_token_at is None
-                or len(self.output) < 2):
+                or emitted < 2):
             return None
-        return (self.finished_at - self.first_token_at) / (len(self.output) - 1)
+        return (self.finished_at - self.first_token_at) / (emitted - 1)
 
 
 @dataclasses.dataclass
@@ -144,6 +173,9 @@ class ServingEngine:
     step consumes it through the same quant-aware matmul hook as
     ``forward``."""
 
+    #: disaggregated serving roles (see ``role`` in the ctor)
+    ROLES = frozenset({"unified", "prefill", "decode"})
+
     def __init__(self, params: Any, cfg: LlamaConfig,
                  pcfg: Optional[PagedConfig] = None,
                  loras: Optional[Any] = None, lora_scale: float = 1.0,
@@ -155,9 +187,22 @@ class ServingEngine:
                  spec_guard_margin: float = 0.05,
                  pipeline_decode: bool = True,
                  decode_horizon: int = 8,
-                 prefix_shared: Any = False):
+                 prefix_shared: Any = False,
+                 role: str = "unified"):
         if decode_horizon < 1:
             raise ValueError("decode_horizon must be >= 1")
+        if role not in self.ROLES:
+            raise ValueError(
+                f"role must be one of {sorted(self.ROLES)}, got {role!r}"
+            )
+        #: disaggregated serving role (serving.role / step `role` key):
+        #: "prefill" retires every request after its first sampled
+        #: token (the KV export + first token ARE the product; a paired
+        #: decode engine adopts the blocks and continues), "decode"
+        #: and "unified" decode to completion — "decode" is a routing
+        #: statement (the router only sends it handoff/short traffic),
+        #: not an engine-loop change
+        self.role = role
         self.params = params
         self.cfg = cfg
         self.pcfg = pcfg or PagedConfig()
@@ -223,6 +268,12 @@ class ServingEngine:
         #: fused multi-step decode (device-resident horizon); 1 = the
         #: retained classic single-step engine (the parity reference)
         self.decode_horizon = decode_horizon
+        if role == "prefill" and not self.pcfg.prefix_caching:
+            raise ValueError(
+                "prefill role requires prefix_caching=True — the KV "
+                "handoff to the decode pool rides the prefix cache's "
+                "block registration/export"
+            )
         self.pools = init_pools(cfg, self.pcfg)
         self.allocator = BlockAllocator(self.pcfg.num_blocks)
         # all block traffic flows through the prefix cache so freed-
@@ -247,6 +298,8 @@ class ServingEngine:
         self._hz_spec_fns: Optional[tuple] = None
         self._hz_scatter_fns: dict[int, Any] = {}
         self._import_fn: Optional[Any] = None
+        #: batched adoption scatters, compiled per run length
+        self._import_many_fns: dict[int, Any] = {}
         self._sharing_scope_cache: Optional[str] = None
         #: SLO attribution: the step this engine serves (label on the
         #: request-level latency histograms; engram.build_engine stamps
@@ -357,6 +410,15 @@ class ServingEngine:
         # is falsy (len 0) but very much a request to share through it
         if prefix_shared is not False and prefix_shared is not None:
             self.set_prefix_sharing(prefix_shared)
+        if role == "prefill" and self.blocks._shared is None:
+            # legal (set_prefix_sharing may follow) but loud: without a
+            # shared registry the engine's product — exported prompt
+            # blocks — goes nowhere, and every handoff re-prefills the
+            # whole prompt on the decode side
+            _log.warning(
+                "prefill-role engine has NO shared prefix registry: "
+                "nothing will be exported for the decode pool to adopt"
+            )
 
     # -- public API --------------------------------------------------------
 
@@ -365,10 +427,27 @@ class ServingEngine:
                eos_token: Optional[int] = None,
                adapter: Optional[int] = None,
                tenant: str = "",
-               trace: Optional[dict] = None) -> int:
+               trace: Optional[dict] = None,
+               rid: Optional[int] = None,
+               output: Optional[list[int]] = None) -> int:
+        """Queue a request. ``rid``/``output`` are the KV-handoff
+        continuation contract (router.py): a pinned ``rid`` keeps
+        sampled streams byte-identical across engines (keys fold from
+        request identity, never slot/engine state), and ``output``
+        preseeds already-generated tokens so admission prefills only
+        the uncached suffix — the adopted prefix blocks arrive through
+        the shared registry, not a recompute. ``max_new_tokens``
+        remains the TOTAL new-token budget including the preseed."""
+        preseed = list(output or [])
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill "
                              "always samples one token)")
+        if preseed and max_new_tokens <= len(preseed):
+            raise ValueError(
+                f"max_new_tokens ({max_new_tokens}) must exceed the "
+                f"preseeded output ({len(preseed)} tokens) — nothing "
+                f"would be left to decode"
+            )
         if len(prompt) + max_new_tokens > self.pcfg.capacity:
             raise ValueError(
                 f"prompt+new ({len(prompt)}+{max_new_tokens}) exceeds slot "
@@ -379,12 +458,17 @@ class ServingEngine:
                 f"adapter {adapter} out of range (engine has "
                 f"{self.n_adapters} incl. the base at 0)"
             )
-        req = Request(self._next_rid, list(prompt), max_new_tokens,
+        if rid is None:
+            rid = self._next_rid
+        elif rid < 0:
+            raise ValueError("rid must be >= 0")
+        self._next_rid = max(self._next_rid, rid + 1)
+        req = Request(rid, list(prompt), max_new_tokens,
                       temperature, eos_token, adapter=adapter or 0,
                       tenant=self._bound_tenant(tenant), trace=trace,
+                      output=preseed, preseeded=len(preseed),
                       submitted_at=_walltime.perf_counter(),
                       submitted_wall=_walltime.time())
-        self._next_rid += 1
         self.pending.append(req)
         return req.rid
 
@@ -429,6 +513,33 @@ class ServingEngine:
         self.decode_horizon = int(horizon)
         if changed:
             self._rearm_spec_guard()
+
+    def set_role(self, role: str) -> None:
+        """Live-reloadable (`serving.role` / step ``role`` key): takes
+        effect at the next sampled token. Demotion (prefill ->
+        unified/decode) drains cleanly by construction — requests whose
+        first token lands AFTER the flip simply keep decoding on this
+        engine to their own eos/budget instead of retiring as
+        ``prefilled``; nothing in flight is dropped or re-queued.
+        Promotion to prefill retires each decoding request at its next
+        committed token with whatever output it has (a handoff
+        continuation preseeds it downstream)."""
+        if role not in self.ROLES:
+            raise ValueError(
+                f"role must be one of {sorted(self.ROLES)}, got {role!r}"
+            )
+        if role == "prefill" and not self.pcfg.prefix_caching:
+            raise ValueError(
+                "prefill role requires prefix_caching=True — the KV "
+                "handoff to the decode pool rides the prefix cache's "
+                "block registration/export"
+            )
+        if role == "prefill" and self.blocks._shared is None:
+            _log.warning(
+                "prefill-role engine has NO shared prefix registry: "
+                "nothing will be exported for the decode pool to adopt"
+            )
+        self.role = role
 
     def set_spec_k(self, k: int) -> None:
         """Live-reloadable (`serving.spec-k`) on draft-capable engines:
@@ -489,7 +600,8 @@ class ServingEngine:
         reg = (enabled if isinstance(enabled, SharedPrefixRegistry)
                else GLOBAL_SHARED_PREFIXES)
         self.blocks.enable_sharing(reg, self._sharing_scope(),
-                                   self._export_block, self._import_block)
+                                   self._export_block, self._import_block,
+                                   import_many_cb=self._import_blocks)
 
     def reset_phase_stats(self) -> None:
         """Zero the per-phase counters (benches call this after warm so
@@ -576,6 +688,39 @@ class ServingEngine:
         if needs_draft:
             self.dpools = self._import_fn(self.dpools, blk, payload["dk"],
                                           payload["dv"])
+        return True
+
+    def _import_blocks(self, blks: list[int], payloads: list[dict]) -> bool:
+        """Batched adoption: scatter a whole RUN of exported blocks
+        (a KV handoff's entire prompt chain) into the pools with one
+        compiled dispatch per pool instead of one per block — the
+        per-block dispatch train was most of the prefill->decode
+        handoff's latency. Same draft-hole refusal as the single-block
+        path; compiled per run length (bounded by max_blocks_per_seq)."""
+        needs_draft = self.draft_params is not None and self.spec_active
+        if needs_draft and any("dk" not in p for p in payloads):
+            return False
+        n = len(blks)
+        fn = self._import_many_fns.get(n)
+        if fn is None:
+            fn = jax.jit(
+                lambda pools, b, k, v: {
+                    # k/v arrive [n, L, B, H, D] (stacked payloads);
+                    # pool indexing wants [L, n, B, H, D]
+                    "k": pools["k"].at[:, b].set(jnp.swapaxes(k, 0, 1)),
+                    "v": pools["v"].at[:, b].set(jnp.swapaxes(v, 0, 1)),
+                },
+                donate_argnums=(0,),
+            )
+            self._import_many_fns[n] = fn
+        ids = jnp.asarray(blks, jnp.int32)
+        k = jnp.stack([jnp.asarray(p["k"]) for p in payloads])
+        v = jnp.stack([jnp.asarray(p["v"]) for p in payloads])
+        self.pools = fn(self.pools, ids, k, v)
+        if needs_draft:
+            dk = jnp.stack([jnp.asarray(p["dk"]) for p in payloads])
+            dv = jnp.stack([jnp.asarray(p["dv"]) for p in payloads])
+            self.dpools = fn(self.dpools, ids, dk, dv)
         return True
 
     # -- scheduler ---------------------------------------------------------
@@ -768,6 +913,14 @@ class ServingEngine:
         self.blocks.free(slot.blocks)
         self.finished.append(slot.request)
         self.slots[slot_idx] = None
+        if slot.request.prefilled:
+            # a prefill-pool retirement is a CONTINUATION, not a
+            # completion: the decode engine finishes the request and
+            # owns its completed-count/token-count/e2e observation —
+            # observing both legs double-counted every routed request
+            # on the PR-8 SLO plane
+            metrics.serving_active_slots.set(self.active_slots)
+            return
         metrics.serving_requests.inc("completed")
         metrics.serving_tokens.inc(by=len(slot.request.output))
         metrics.serving_active_slots.set(self.active_slots)
@@ -852,6 +1005,25 @@ class ServingEngine:
         effective = req.prompt + req.output
         p = len(effective)
         sp = p - shared_tokens
+        if sp == 1 and req.output:
+            # KV-handoff fast path: every cached position [0, p-1) was
+            # adopted/shared, and the one uncovered token is ALREADY
+            # SAMPLED (the prefill pool's last token, or a recompute
+            # whose whole tail matched) — it is simply the next decode
+            # INPUT, whose KV the fused step writes in place at
+            # position p-1. No suffix forward, no sampling, zero
+            # compiled dispatches on this admission.
+            self.slots[slot_idx] = _SlotState(
+                req, shared + fresh, p, shared_tokens=shared_tokens)
+            self._last_tokens[slot_idx] = req.output[-1]
+            if self.pcfg.prefix_caching:
+                self.blocks.register(effective, shared + fresh,
+                                     salt=req.adapter)
+                self.blocks.record_stats(p, shared_tokens)
+                metrics.serving_prefix_tokens.inc("hit", by=shared_tokens)
+                metrics.serving_prefix_tokens.inc("miss", by=1)
+            metrics.serving_active_slots.set(self.active_slots)
+            return
         chunk = self._chunk_size()
         if chunk is not None and sp > chunk:
             # chunked path: secure the WHOLE table now (incl. the final
@@ -1299,6 +1471,13 @@ class ServingEngine:
                 slot_tok = int(toks_h[t][i])
                 s.seq_len += 1
                 self._record(i, req, slot_tok)
+                if req.done:
+                    # normally the device already deactivated the lane
+                    # at eos/budget, but a live promotion to the
+                    # prefill role retires HOST-side mid-commit — the
+                    # rest of the horizon's tokens must not leak into
+                    # a request the router is about to hand off
+                    break
             if req.done:
                 done.append(req.rid)
                 self._retire(i)
@@ -1423,6 +1602,14 @@ class ServingEngine:
                 for t in range(int(ncommit[i])):
                     s.seq_len += 1
                     self._record(i, req, int(c_out[i][t]))
+                    if req.done:
+                        # same guard as the plain horizon commit loop:
+                        # a live promotion to the prefill role retires
+                        # the request host-side mid-round, and the
+                        # round's remaining accepted tokens must not
+                        # leak past the retirement (a budget-filling
+                        # leak made the handoff continuation invalid)
+                        break
         for i, s in acts:
             if s.request.done:
                 done.append(s.request.rid)
@@ -1853,17 +2040,34 @@ class ServingEngine:
             # so the measurement is horizon-granular by construction
             # and costs zero extra syncs
             req.first_token_at = _walltime.perf_counter()
-            ttft = req.first_token_at - req.submitted_at
-            metrics.serving_ttft.observe(ttft, self.slo_step, req.tenant)
-            metrics.serving_slo.inc(
-                "ttft",
-                "ok" if ttft <= SLO_THRESHOLDS["ttft"] else "breach",
-                self.slo_step,
-            )
+            if not req.preseeded:
+                # a handoff continuation's USER-visible first token was
+                # the prefill pool's — that engine observed the true
+                # TTFT against the original submit clock; re-observing
+                # here would record the handoff gap as a fresh (tiny)
+                # TTFT sample. first_token_at still anchors this
+                # engine's decode cadence (tpot).
+                ttft = req.first_token_at - req.submitted_at
+                metrics.serving_ttft.observe(ttft, self.slo_step,
+                                             req.tenant)
+                metrics.serving_slo.inc(
+                    "ttft",
+                    "ok" if ttft <= SLO_THRESHOLDS["ttft"] else "breach",
+                    self.slo_step,
+                )
         if (req.eos_token is not None and tok == req.eos_token) or (
             len(req.output) >= req.max_new_tokens
         ):
             req.done = True
+        elif self.role == "prefill":
+            # prefill pool contract: the KV export (register() already
+            # published the full prompt blocks) plus the first token IS
+            # this engine's product — retire now, the router hands the
+            # request to a decode engine that adopts the blocks via
+            # scatter and continues the stream. eos/budget completions
+            # above stay ordinary completions (nothing left to decode).
+            req.done = True
+            req.prefilled = True
 
     def _sample_host(self, logits: jax.Array, req: Request) -> int:
         """Sample the request's next token on the host (prefill's first

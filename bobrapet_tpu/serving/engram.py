@@ -26,6 +26,7 @@ steps:
       draft: {selfInt8: true, specK: 4}   # optional speculative decoding
       decodeHorizon: 8                    # fused steps per host sync
       prefixShared: true                  # cross-engine prefix sharing
+      role: prefill                       # disaggregated pool role
       hub: bobravoz-hub.bobrapet-system.svc:50052
 ```
 
@@ -98,6 +99,8 @@ def apply_tuning(scfg: Any) -> None:
     failures (e.g. `serving.prefix-cache-shared` on an engine built
     with ``prefixCaching: false``) are logged and skipped — one misfit
     engine must not block the fleet's reload."""
+    import sys as _sys
+
     from .prefix_cache import GLOBAL_SHARED_PREFIXES
 
     global _TUNING
@@ -109,6 +112,8 @@ def apply_tuning(scfg: Any) -> None:
                 eng.set_decode_horizon(scfg.decode_horizon)
             if "spec_k" not in pinned:
                 eng.set_spec_k(scfg.spec_k)
+            if "role" not in pinned:
+                eng.set_role(scfg.role)
             if "prefix_shared" not in pinned:
                 current = eng.blocks._shared
                 if scfg.prefix_cache_shared:
@@ -118,6 +123,25 @@ def apply_tuning(scfg: Any) -> None:
                     eng.set_prefix_sharing(False)
         except ValueError as e:
             _log.warning("serving.* reload skipped an engine: %s", e)
+    # serving.router-* knobs retune live ServingRouters the same way
+    # (lazy: the router module imports the jax-heavy engine, so a
+    # process serving zero routers never loads it here)
+    _router_mod = _sys.modules.get("bobrapet_tpu.serving.router")
+    if _router_mod is not None:
+        _router_mod.apply_tuning(scfg)
+    if scfg.role == "prefill" and not (
+        _router_mod is not None and len(_router_mod._LIVE_ROUTERS)
+    ):
+        # the global knob just turned every unpinned engine into a
+        # prefill worker, but nothing in THIS process will continue
+        # the handoffs — every request retires after one token and
+        # streams out as a (flagged) prefilled completion. Legitimate
+        # for a dedicated prefill-pool process; loud for a misstep.
+        _log.warning(
+            "serving.role=prefill applied with no live ServingRouter "
+            "in this process: requests will retire after their first "
+            "token (wire completions carry \"prefilled\": true)"
+        )
 
 
 def _moe_cfg(factory):
@@ -251,15 +275,42 @@ def build_engine(ctx) -> ServingEngine:
         _log.warning("serving.prefix-cache-shared skipped: step disables "
                      "prefix caching")
         shared = False
+    # disaggregated serving role: a step key (`role: prefill`) pins it;
+    # otherwise the live serving.role knob is the build-time default
+    role = str(config.get("role", tuning.role if tuning else "unified"))
+    if role == "prefill" and not pcfg.prefix_caching:
+        # a prefill engine's entire product is the registered/exported
+        # prompt blocks — without prefix caching it would burn prefill
+        # FLOPs and hand off nothing adoptable
+        if "role" in config:
+            raise ValueError("role: prefill requires paging.prefixCaching"
+                             ": true (the KV handoff rides the prefix "
+                             "cache)")
+        # the GLOBAL knob must not brick prefix-caching-disabled steps
+        # fleet-wide — this engine just serves unified
+        _log.warning("serving.role=prefill skipped: step disables "
+                     "prefix caching")
+        role = "unified"
+    if role == "prefill" and not shared and "role" in config:
+        # an explicitly prefill step whose sharing is OFF is a config
+        # contradiction: its entire product (exported prompt blocks)
+        # would go nowhere and every handoff re-prefills downstream
+        raise ValueError("role: prefill requires prefix sharing "
+                         "(prefixShared: true or the "
+                         "serving.prefix-cache-shared knob) — the KV "
+                         "handoff is exported through the shared "
+                         "registry")
     engine = ServingEngine(params, cfg, pcfg,
                            loras=loras, lora_scale=lora_scale,
                            draft_params=draft_params, draft_cfg=draft_cfg,
                            spec_k=spec_k, spec_guard=spec_guard,
-                           decode_horizon=horizon, prefix_shared=shared)
+                           decode_horizon=horizon, prefix_shared=shared,
+                           role=role)
     # knobs the STEP pinned survive serving.* reloads (apply_tuning)
     engine._engram_pinned = frozenset(
         name for key, name in (("decodeHorizon", "decode_horizon"),
-                               ("prefixShared", "prefix_shared"))
+                               ("prefixShared", "prefix_shared"),
+                               ("role", "role"))
         if key in config
     ) | (frozenset(["spec_k"])
          if "specK" in (config.get("draft") or {}) else frozenset())

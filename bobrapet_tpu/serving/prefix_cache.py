@@ -61,6 +61,23 @@ def _chain_hash(parent: bytes, tokens: list[int]) -> bytes:
 ROOT = b"root"
 
 
+def chain_hashes(tokens: list[int], block_size: int,
+                 salt: int = 0) -> list[bytes]:
+    """The chain hash of every FULL block of ``tokens`` under ``salt``,
+    in order — the ONE construction `register`, `match_prefix`, and the
+    registry's `longest_match` all walk, so a router probe can never
+    disagree with the adoption path about what a prompt's chain is.
+    The final token is excluded exactly like `match_prefix` (the
+    sampler needs its logits, so it is never matchable)."""
+    limit = (len(tokens) - 1) // block_size
+    parent = _chain_hash(ROOT, [salt])
+    out: list[bytes] = []
+    for i in range(limit):
+        parent = _chain_hash(parent, tokens[i * block_size:(i + 1) * block_size])
+        out.append(parent)
+    return out
+
+
 def _encode_kv_payload(payload: dict) -> bytes:
     """Serialize an exported block payload (K/V device arrays across
     layers, plus draft K/V for spec engines) for the disk tier. Plain
@@ -173,6 +190,39 @@ class SharedPrefixRegistry:
             self._insert_locked((scope, h), payload)
         return payload
 
+    def longest_match(self, scope: str, tokens: list[int],
+                      block_size: int, salt: int = 0) -> int:
+        """How many leading FULL blocks of ``tokens`` this registry
+        holds under ``scope`` — the router's prefix-affinity probe
+        (``today only exact chain-hash adoption exists``: this is the
+        explicit lookup API on top of the same chain construction).
+
+        Memory-resident entries only: a per-block disk probe on the
+        admission path would put the SSD tier's latency in front of
+        every routing decision; spilled entries still adopt through the
+        read-through at prefill time. Every hit is LRU-TOUCHED — a
+        prompt the router keeps routing by is a prompt worth keeping
+        exported. Records the partial-match depth metric."""
+        return self.longest_match_hashes(
+            scope, chain_hashes(tokens, block_size, salt))
+
+    def longest_match_hashes(self, scope: str,
+                             hashes: list[bytes]) -> int:
+        """:meth:`longest_match` over a precomputed chain (the router
+        hashes each queued prompt ONCE and probes with the digests —
+        re-hashing a 500-token prompt on every scheduling retry was
+        measurable wall on the admission path)."""
+        depth = 0
+        with self._lock:
+            for h in hashes:
+                key = (scope, h)
+                if key not in self._entries:
+                    break
+                self._entries.move_to_end(key)
+                depth += 1
+        metrics.serving_prefix_match_depth.observe(float(depth))
+        return depth
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)
@@ -220,27 +270,50 @@ class PrefixCache:
         self._scope: str = ""
         self._export: Optional[Callable[[int], dict]] = None
         self._import: Optional[Callable[[int, dict], bool]] = None
+        self._import_many: Optional[
+            Callable[[list[int], list[dict]], bool]] = None
         self.shared_hits = 0
 
     # -- cross-engine sharing ----------------------------------------------
 
+    @property
+    def shared(self) -> Optional[SharedPrefixRegistry]:
+        """The registry this cache shares through (None = local-only);
+        the router reads it to probe chain depth without reaching into
+        private state."""
+        return self._shared
+
+    @property
+    def scope(self) -> str:
+        """The sharing namespace (engine weights fingerprint) exports
+        land under — the registry key half a router probe needs."""
+        return self._scope
+
     def enable_sharing(self, registry: SharedPrefixRegistry, scope: str,
                        export_cb: Callable[[int], dict],
-                       import_cb: Callable[[int, dict], bool]) -> None:
+                       import_cb: Callable[[int, dict], bool],
+                       import_many_cb: Optional[
+                           Callable[[list[int], list[dict]], bool]] = None,
+                       ) -> None:
         """Join a shared registry under ``scope``: registered full
         blocks are exported, and local match misses consult the
-        registry before giving up (adopting a hit via ``import_cb``).
+        registry before giving up (adopting a hit via ``import_cb``, or
+        ``import_many_cb`` batching a whole run of blocks into ONE
+        scatter — a KV handoff adopts 6-12 blocks at once, and paying a
+        compiled dispatch per block was most of the handoff's cost).
         Already-registered local blocks are NOT retro-exported — enable
         sharing before serving traffic."""
         self._shared = registry
         self._scope = scope
         self._export = export_cb
         self._import = import_cb
+        self._import_many = import_many_cb
 
     def disable_sharing(self) -> None:
         self._shared = None
         self._export = None
         self._import = None
+        self._import_many = None
 
     def rescope(self, scope: str) -> None:
         """Move future exports/imports to a new namespace (the engine's
@@ -310,6 +383,25 @@ class PrefixCache:
                 if shared.get(self._scope, parent) is None:
                     shared.put(self._scope, parent, export(blk))
 
+    def longest_local_match(self, tokens: list[int], salt: int = 0) -> int:
+        """Read-only probe: how many leading full blocks of ``tokens``
+        this engine's LOCAL cache currently addresses (registered, and
+        either live or still reservable off the free list). No
+        references are claimed and nothing is adopted — the router uses
+        this to rank engines by chain depth without mutating state."""
+        return self.longest_local_match_hashes(
+            chain_hashes(tokens, self.block_size, salt))
+
+    def longest_local_match_hashes(self, hashes: list[bytes]) -> int:
+        """:meth:`longest_local_match` over a precomputed chain (see
+        ``SharedPrefixRegistry.longest_match_hashes``)."""
+        depth = 0
+        for h in hashes:
+            if h not in self._by_hash:
+                break
+            depth += 1
+        return depth
+
     def match_prefix(self, tokens: list[int],
                      salt: int = 0) -> tuple[list[int], int]:
         """Longest reusable block chain for ``tokens`` under ``salt``
@@ -319,16 +411,35 @@ class PrefixCache:
         b = self.block_size
         limit = (len(tokens) - 1) // b  # keep >= 1 token for the suffix
         parent = _chain_hash(ROOT, [salt])
+        hashes: list[bytes] = []
+
+        def hash_through(n: int) -> None:
+            # chain digests computed LAZILY: a local-only engine whose
+            # chain misses at block 0 must not pay a full-prompt hash
+            # walk per admission retry (the run-adoption probe is the
+            # only consumer of the tail, and only sharing engines run
+            # it)
+            nonlocal parent
+            while len(hashes) < n:
+                j = len(hashes)
+                parent = _chain_hash(parent, tokens[j * b:(j + 1) * b])
+                hashes.append(parent)
+
         matched: list[int] = []
-        for i in range(limit):
-            parent = _chain_hash(parent, tokens[i * b:(i + 1) * b])
-            blk = self._by_hash.get(parent)
+        i = 0
+        while i < limit:
+            hash_through(i + 1)
+            blk = self._by_hash.get(hashes[i])
             if blk is None:
-                blk = self._adopt_shared(parent)
-                if blk is not None:
-                    matched.append(blk)
-                    continue
-                break
+                if self._shared is None or self._import is None:
+                    break
+                hash_through(limit)
+                got = self._adopt_shared_run(hashes[i:])
+                if not got:
+                    break
+                matched.extend(got)
+                i += len(got)
+                continue
             if blk in self._refs:
                 self._refs[blk] += 1
             else:
@@ -338,37 +449,66 @@ class PrefixCache:
                     break
                 self._refs[blk] = 1
             matched.append(blk)
+            i += 1
         # stats are recorded by the caller AFTER admission commits — a
         # refunded match (allocation failure, retry next tick) must not
         # inflate the hit rate
-        return matched, len(matched) * b
+        return matched, len(matched) * self.block_size
 
-    def _adopt_shared(self, chain_hash: bytes) -> Optional[int]:
-        """Local miss: consult the shared registry and, on a scoped
-        hit, adopt the exported content into a freshly allocated local
-        block (a scatter instead of a prefill forward). Returns the
-        block id, or None (no entry / no memory / payload refused)."""
+    def _adopt_shared_run(self, hashes: list[bytes]) -> list[int]:
+        """Local miss: consult the shared registry for the LONGEST run
+        of consecutive chain blocks it holds from ``hashes[0]`` on, and
+        adopt the whole run into freshly allocated local blocks — ONE
+        batched scatter when the engine provides ``import_many_cb``
+        (a per-block compiled dispatch was most of a KV handoff's
+        cost), else block-at-a-time. Returns the adopted block ids
+        ([] = no entry / no memory / payload refused)."""
         # locals against a concurrent disable_sharing() (see register)
         shared, importer = self._shared, self._import
+        importer_many = self._import_many
         if shared is None or importer is None:
-            return None
-        payload = shared.get(self._scope, chain_hash)
-        if payload is None:
+            return []
+        payloads: list[dict] = []
+        for h in hashes:
+            if h in self._by_hash:
+                # the chain resumes LOCALLY here: stop the run so the
+                # caller's next iteration reuses the resident block —
+                # adopting it again would burn a fresh block and
+                # re-point the hash at the duplicate
+                break
+            payload = shared.get(self._scope, h)
+            if payload is None:
+                break
+            payloads.append(payload)
+        if not payloads:
             metrics.serving_prefix_shared.inc("miss")
-            return None
-        got = self.alloc(1)
-        if got is None:
-            return None  # memory pressure: admission will retry
-        blk = got[0]
-        if not importer(blk, payload):
+            return []
+        blks = self.alloc(len(payloads))
+        while blks is None and payloads:
+            # memory pressure: a shorter run still skips that much
+            # prefill; admission retries the rest next tick
+            payloads.pop()
+            blks = self.alloc(len(payloads)) if payloads else None
+        if blks is None:
+            return []
+        if importer_many is not None and len(payloads) > 1:
+            ok = importer_many(blks, payloads)
+        else:
+            ok = True
+            for blk, payload in zip(blks, payloads):
+                if not importer(blk, payload):
+                    ok = False
+                    break
+        if not ok:
             metrics.serving_prefix_shared.inc("import-failed")
-            self.free(got)
-            return None
-        self._by_hash[chain_hash] = blk
-        self._hash_of[blk] = chain_hash
-        self.shared_hits += 1
-        metrics.serving_prefix_shared.inc("hit")
-        return blk
+            self.free(blks)
+            return []
+        for blk, h in zip(blks, hashes):
+            self._by_hash[h] = blk
+            self._hash_of[blk] = h
+        self.shared_hits += len(blks)
+        metrics.serving_prefix_shared.inc("hit", by=len(blks))
+        return blks
 
     def record_stats(self, total_tokens: int, hit: int) -> None:
         self.hit_tokens += hit

@@ -1,0 +1,498 @@
+"""ServingRouter: disaggregated prefill/decode pools with prefix-aware
+routing.
+
+The production shape for mixed prompt lengths (DistServe / Splitwise):
+prompt-heavy requests stall decode horizons when one engine does both —
+any ingesting slot forces the engine off the fused multi-step scan and
+back to one host sync per token for EVERY live request. Splitting the
+work fixes the interference structurally:
+
+- a **prefill pool** (engines with ``role="prefill"``) runs chunked
+  prefill only: each request retires the moment its first token
+  samples, with its full prompt blocks already exported through the
+  :class:`~.prefix_cache.SharedPrefixRegistry` (memory, spilling to the
+  slice-local SSD tier exactly as preemption resume does);
+- a **decode pool** (``role="decode"`` / ``"unified"``) adopts those
+  blocks via the existing scatter path at admission — the continuation
+  prefills only the final partial block (< ``block_size`` tokens; the
+  sampler needs its logits either way) and then rides uninterrupted
+  fused decode horizons. No request ever re-prefills its prompt bulk on
+  the decode side.
+
+**Prefix-aware routing**: each decode admission probes every candidate
+engine's LOCAL chain (``PrefixCache.longest_local_match``) and the
+shared registry (``SharedPrefixRegistry.longest_match``) and lands on
+the engine already holding the longest matching prefix chain — repeated
+system prompts keep hitting the engine whose cache is warm — falling
+back to least-loaded on a miss. Decisions ride
+``bobrapet_serving_router_total{outcome}`` and (when a run identity is
+wired) the per-run flight recorder; per-pool backlogs ride
+``bobrapet_serving_pool_queue_depth{pool}`` / ``_pool_queue_wait``
+so prefill and decode pressure are independently visible — the two
+autoscaler signals (queue wait vs tpot burn) ROADMAP item 3 needs.
+
+**Correctness bar**: decode output is byte-identical to a unified
+engine serving the same requests. Sampling keys fold from (engine seed,
+rid, token index) and the router pins ONE rid across the handoff, so
+even sampled streams survive the engine switch; the adopted KV blocks
+are byte-identical by the PR-10 persistence contract.
+
+The router is single-threaded by the same contract as the engine: one
+serve loop drives ``submit``/``step``; it duck-types the engine surface
+:class:`~.service.StreamServer` consumes (``submit``/``step``/
+``finished``/``active_slots``/``pending``/``trace_context``), so a
+streaming step serves a disaggregated pool unchanged.
+
+Live tuning: ``serving.router-prefill-threshold`` /
+``serving.router-prefix-affinity`` retune live routers through
+:func:`apply_tuning` (forwarded from ``engram.apply_tuning``), and
+``serving.role`` re-pools engines on their very next admission — pools
+are derived from each engine's CURRENT role, never cached.
+"""
+
+from __future__ import annotations
+
+import logging
+import time as _walltime
+import weakref
+from collections import deque
+from typing import Any, Optional
+
+from ..observability.metrics import metrics
+from ..observability.timeline import FLIGHT
+from .engine import Request, ServingEngine
+
+_log = logging.getLogger(__name__)
+
+#: routers this process is currently serving — live-reload targets for
+#: the ``serving.router-*`` operator knobs (same pattern as the engine
+#: weakset in engram.py)
+_LIVE_ROUTERS: "weakref.WeakSet[ServingRouter]" = weakref.WeakSet()
+
+
+def apply_tuning(scfg: Any) -> None:
+    """Apply the operator's ``serving.router-*`` knobs to every live
+    router (forwarded from ``engram.apply_tuning`` whenever this module
+    is loaded)."""
+    for router in list(_LIVE_ROUTERS):
+        try:
+            router.set_prefill_threshold(scfg.router_prefill_threshold)
+            router.set_prefix_affinity(scfg.router_prefix_affinity)
+        except ValueError as e:
+            _log.warning("serving.router-* reload skipped a router: %s", e)
+
+
+class _Queued:
+    """One router-queued request (not yet admitted to an engine)."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "temperature",
+                 "eos_token", "adapter", "tenant", "trace", "output",
+                 "enqueued_at", "enqueued_wall", "handoff_from", "carry",
+                 "_hashes")
+
+    def __init__(self, rid, prompt, max_new_tokens, temperature,
+                 eos_token, adapter, tenant, trace, output=None,
+                 handoff_from: Optional[float] = None,
+                 carry: Optional[dict] = None):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.eos_token = eos_token
+        self.adapter = adapter
+        self.tenant = tenant
+        self.trace = trace
+        self.output = output
+        self.enqueued_at = _walltime.perf_counter()
+        self.enqueued_wall = _walltime.time()
+        #: perf_counter of the prefill-pool retirement (handoffs only)
+        self.handoff_from = handoff_from
+        #: request-lifecycle clocks carried onto the engine Request
+        #: after submit. First legs carry the ROUTER enqueue clocks
+        #: (engine.submit stamps arrival at admission, which would
+        #: exclude the router queue wait from ttft/e2e/SLO); handoff
+        #: legs carry the prefill leg's full set so the decode-side
+        #: e2e observation and trace span cover the whole request.
+        self.carry = carry or {"submitted_at": self.enqueued_at,
+                               "submitted_wall": self.enqueued_wall}
+        #: chain digests, hashed ONCE per queued request — affinity
+        #: probes retry every scheduling pass, and re-hashing a long
+        #: prompt each time was measurable admission wall
+        self._hashes: Optional[list[bytes]] = None
+
+    def hashes(self, block_size: int) -> list[bytes]:
+        if self._hashes is None:
+            from .prefix_cache import chain_hashes
+
+            self._hashes = chain_hashes(
+                self.prompt + (self.output or []), block_size,
+                self.adapter or 0)
+        return self._hashes
+
+
+class ServingRouter:
+    """See module docstring.
+
+    ``engines`` is ``{name: ServingEngine}``; pools are derived from
+    each engine's live ``role``. ``registry`` overrides the shared
+    registry probed for prefix affinity (defaults to whatever the
+    engines share through). ``flight`` is an optional ``(namespace,
+    run)`` identity routing decisions are flight-recorded under."""
+
+    def __init__(self, engines: dict[str, ServingEngine],
+                 registry: Any = None,
+                 prefill_threshold: int = 0,
+                 prefix_affinity: bool = True,
+                 flight: Optional[tuple[str, str]] = None):
+        if not engines:
+            raise ValueError("ServingRouter needs at least one engine")
+        if prefill_threshold < 0:
+            raise ValueError("prefill_threshold must be >= 0")
+        self.engines = dict(engines)
+        self.registry = registry
+        self.prefill_threshold = int(prefill_threshold)
+        self.prefix_affinity = bool(prefix_affinity)
+        self.flight = flight
+        self._queues: dict[str, deque[_Queued]] = {
+            "prefill": deque(), "decode": deque(),
+        }
+        # start ABOVE every engine's own counter: router rids are
+        # pinned onto engines, and a collision with a directly-
+        # submitted request's rid would alias their sampled streams
+        # AND make _harvest claim the foreign request as owned
+        self._next_rid = max(eng._next_rid for eng in self.engines.values())
+        #: engine.finished index already harvested, per engine (engines
+        #: may carry history from direct use before the router attached)
+        self._consumed = {name: len(eng.finished)
+                          for name, eng in self.engines.items()}
+        #: rid -> final decode-pool routing outcome ("prefix-hit"|"miss")
+        self.outcomes: dict[int, str] = {}
+        #: rids the router owns (a finished request with a foreign rid —
+        #: direct engine use — is left alone, never harvested)
+        self._owned: set[int] = set()
+        #: rid -> perf_counter of the prefill-pool retirement, pending
+        #: resolution into kv_handoff_s at completion
+        self._handoff_clock: dict[int, float] = {}
+        self.finished: list[Request] = []
+        self._trace_context: Optional[dict] = None
+        _LIVE_ROUTERS.add(self)
+
+    # -- live tuning -------------------------------------------------------
+
+    def set_prefill_threshold(self, tokens: int) -> None:
+        """Live-reloadable (`serving.router-prefill-threshold`): prompts
+        shorter than this skip the prefill pool (their prefill is too
+        small to be worth a handoff); 0 routes every request through it
+        while one exists."""
+        if tokens < 0:
+            raise ValueError("router-prefill-threshold must be >= 0")
+        self.prefill_threshold = int(tokens)
+
+    def set_prefix_affinity(self, enabled: bool) -> None:
+        """Live-reloadable (`serving.router-prefix-affinity`): False
+        degrades routing to pure least-loaded (every decode admission
+        counts as a miss) — the A/B lever the bench uses to price the
+        affinity itself."""
+        self.prefix_affinity = bool(enabled)
+
+    # -- StreamServer surface ----------------------------------------------
+
+    @property
+    def trace_context(self) -> Optional[dict]:
+        return self._trace_context
+
+    @trace_context.setter
+    def trace_context(self, tc: Optional[dict]) -> None:
+        # the serving step's run trace fans out to every pool member so
+        # request lifecycle spans stitch regardless of placement
+        self._trace_context = tc
+        for eng in self.engines.values():
+            eng.trace_context = tc
+
+    @property
+    def active_slots(self) -> int:
+        return sum(eng.active_slots for eng in self.engines.values())
+
+    @property
+    def pending(self) -> tuple:
+        """Everything admitted but unfinished ANYWHERE (router queues +
+        engine queues) — truthy exactly while a drain must keep
+        stepping, which is all StreamServer consumes."""
+        out: list = []
+        for q in self._queues.values():
+            out.extend(q)
+        for eng in self.engines.values():
+            out.extend(eng.pending)
+        return tuple(out)
+
+    def submit(self, prompt: list[int], max_new_tokens: int,
+               temperature: float = 0.0,
+               eos_token: Optional[int] = None,
+               adapter: Optional[int] = None,
+               tenant: str = "",
+               trace: Optional[dict] = None) -> int:
+        """Queue one request; returns its router-wide rid (the SAME rid
+        every engine that touches the request decodes under)."""
+        # re-sync against the engine counters each submit: traffic
+        # submitted DIRECTLY to a pool engine since the last call must
+        # never share a rid with a routed request (see ctor comment)
+        self._next_rid = max(
+            self._next_rid,
+            max(eng._next_rid for eng in self.engines.values()),
+        )
+        rid = self._next_rid
+        self._next_rid += 1
+        # ...and advance every engine's counter PAST the rid now: the
+        # routed request only reaches an engine at _admit, and a direct
+        # submit landing in that window would otherwise mint the same
+        # rid (aliased sampling streams + a foreign harvest)
+        for eng in self.engines.values():
+            eng._next_rid = max(eng._next_rid, rid + 1)
+        self._owned.add(rid)
+        q = _Queued(rid, list(prompt), max_new_tokens, temperature,
+                    eos_token, adapter, tenant, trace)
+        pool = self._submit_pool(q)
+        self._queues[pool].append(q)
+        if pool == "prefill":
+            metrics.serving_router.inc("prefill")
+        self._set_depth_gauges()
+        return rid
+
+    def _submit_pool(self, q: _Queued) -> str:
+        if (len(q.prompt) >= self.prefill_threshold
+                and any(e.role == "prefill" for e in self.engines.values())):
+            return "prefill"
+        return "decode"
+
+    def step(self) -> list[int]:
+        """One router tick: admit queued work onto engines, step every
+        engine with work, harvest finishes (handoffs re-queue onto the
+        decode pool). Returns rids that COMPLETED this tick."""
+        self._admit("prefill")
+        self._admit("decode")
+        for eng in self.engines.values():
+            if eng.pending or eng.active_slots:
+                eng.step()
+        done = self._harvest()
+        self._set_depth_gauges()
+        return done
+
+    def run(self, max_steps: int = 1_000_000) -> list[Request]:
+        """Drive until every submitted request completes; returns them
+        in completion order."""
+        steps = 0
+        while self.busy and steps < max_steps:
+            self.step()
+            steps += 1
+        return self.finished
+
+    @property
+    def busy(self) -> bool:
+        # cheap form of bool(self.pending) — the drain loop checks this
+        # every step, and materializing the combined tuple each time
+        # was pure allocation churn
+        return (any(len(q) for q in self._queues.values())
+                or any(eng.pending or eng.active_slots
+                       for eng in self.engines.values()))
+
+    # -- routing -----------------------------------------------------------
+
+    @property
+    def hit_rate(self) -> float:
+        """Fraction of decode-pool admissions routed by prefix chain
+        (the bench's pinned floor); 1.0 before any decode admission."""
+        hits = sum(1 for o in self.outcomes.values() if o == "prefix-hit")
+        total = len(self.outcomes)
+        return hits / total if total else 1.0
+
+    def _pool(self, *roles: str) -> list[tuple[str, ServingEngine]]:
+        return [(n, e) for n, e in self.engines.items() if e.role in roles]
+
+    @staticmethod
+    def _load(eng: ServingEngine) -> int:
+        return eng.active_slots + len(eng.pending)
+
+    def _has_room(self, eng: ServingEngine) -> bool:
+        return self._load(eng) < eng.pcfg.max_slots
+
+    def _admit(self, pool: str) -> None:
+        queue = self._queues[pool]
+        if pool == "prefill" and queue and not self._pool("prefill"):
+            # live demotion emptied the pool: everything queued drains
+            # through the decode pool instead of deadlocking
+            self._queues["decode"].extend(queue)
+            queue.clear()
+            return
+        while queue:
+            q = queue[0]
+            if pool == "prefill":
+                target = self._pick_prefill(q)
+            else:
+                target = self._pick_decode(q)
+            if target is None:
+                return  # no engine can take the head; FIFO holds
+            name, eng = target
+            queue.popleft()
+            metrics.serving_pool_wait.observe(
+                _walltime.perf_counter() - q.enqueued_at, pool)
+            eng.submit(q.prompt, q.max_new_tokens,
+                       temperature=q.temperature, eos_token=q.eos_token,
+                       adapter=q.adapter, tenant=q.tenant, trace=q.trace,
+                       rid=q.rid, output=q.output)
+            # restore the request's TRUE clocks onto the engine Request
+            # (the freshly queued tail of pending): engine.submit
+            # stamps arrival at ADMISSION, which would exclude the
+            # router queue wait from ttft/e2e/SLO; handoff legs carry
+            # the whole prefill-leg set (incl. the observed TTFT and a
+            # preset admitted_at, so queue-wait and TTFT stay observed
+            # exactly once per user request)
+            req = eng.pending[-1]
+            for field, value in q.carry.items():
+                setattr(req, field, value)
+
+    def _pick_prefill(self, q: _Queued) -> Optional[tuple[str, ServingEngine]]:
+        pool = self._pool("prefill")
+        cands = [(self._load(e), n, e) for n, e in pool if self._has_room(e)]
+        if not cands:
+            return None
+        _, name, eng = min(cands)
+        self._record_decision(q.rid, "prefill-pool", name, pool="prefill")
+        return name, eng
+
+    def _pick_decode(self, q: _Queued) -> Optional[tuple[str, ServingEngine]]:
+        pool = self._pool("decode", "unified")
+        if not pool:
+            # every engine is prefill-role (operator misstep mid-reload):
+            # decoding SOMEWHERE beats deadlock — a prefill engine still
+            # decodes correctly, it just retires at the first token and
+            # the request comes back around as another handoff
+            pool = list(self.engines.items())
+        outcome, depth, choice = "miss", 0, None
+        has_room = any(self._has_room(e) for _n, e in pool)
+        if self.prefix_affinity:
+            # local probes are cheap dict lookups over the queued
+            # request's cached digests — safe to repeat while the head
+            # stalls on a full pool
+            hashes = q.hashes(pool[0][1].pcfg.block_size)
+            ranked = sorted(
+                ((e.blocks.longest_local_match_hashes(hashes), n, e)
+                 for n, e in pool),
+                key=lambda t: (-t[0], t[1]),
+            )
+            best_depth, best_name, best_eng = ranked[0]
+            if best_depth > 0:
+                # the KV already resident on one engine beats both load
+                # balance and a registry adoption — route to it even
+                # when it is the busier engine
+                outcome, depth = "prefix-hit", best_depth
+                choice = (best_name, best_eng)
+            elif has_room:
+                # the registry probe LRU-touches entries and records
+                # the depth histogram, so it runs only when a placement
+                # can actually happen — a stalled head re-proved every
+                # tick would spam both
+                reg_depth = self._registry_depth(pool[0][1], hashes)
+                if reg_depth > 0:
+                    # any engine adopts registry blocks at equal cost:
+                    # prefix-routed, placed least-loaded
+                    outcome, depth = "prefix-hit", reg_depth
+        if choice is None:
+            if not has_room:
+                return None
+            cands = [(self._load(e), n, e) for n, e in pool
+                     if self._has_room(e)]
+            _, name, eng = min(cands)
+            choice = (name, eng)
+        name, eng = choice
+        kind = "handoff" if q.handoff_from is not None else "route"
+        if kind == "handoff":
+            metrics.serving_router.inc("handoff")
+        self.outcomes[q.rid] = outcome
+        metrics.serving_router.inc(outcome)
+        self._record_decision(q.rid, outcome, name, pool="decode",
+                              depth=depth, kind=kind)
+        return choice
+
+    def _registry_depth(self, eng: ServingEngine,
+                        hashes: list[bytes]) -> int:
+        """Shared-registry chain depth for a queued prompt under the
+        pool's scope (engines in one pool share weights, hence scope)."""
+        reg = self.registry if self.registry is not None else eng.blocks.shared
+        if reg is None:
+            return 0
+        scope = eng.blocks.scope
+        if not scope:
+            return 0
+        return reg.longest_match_hashes(scope, hashes)
+
+    def _record_decision(self, rid: int, outcome: str, engine: str,
+                         **attrs: Any) -> None:
+        if self.flight is None:
+            return
+        ns, run = self.flight
+        FLIGHT.record(ns, run, "router",
+                      message=f"rid {rid} -> {engine} ({outcome})",
+                      rid=rid, outcome=outcome, engine=engine, **attrs)
+
+    # -- harvest -----------------------------------------------------------
+
+    def _harvest(self) -> list[int]:
+        done: list[int] = []
+        for name, eng in self.engines.items():
+            idx = self._consumed[name]
+            while idx < len(eng.finished):
+                req = eng.finished[idx]
+                idx += 1
+                if req.rid not in self._owned:
+                    continue  # direct engine traffic, not ours
+                if req.prefilled and len(req.output) < req.max_new_tokens:
+                    self._handoff(req, name)
+                else:
+                    # not prefilled, OR a prefilled retirement whose
+                    # output already fills the budget (a role flip
+                    # landing on the final token): nothing left to
+                    # decode — complete it rather than hand off a
+                    # continuation with no remaining budget
+                    self._complete(req, name)
+                    done.append(req.rid)
+            self._consumed[name] = idx
+        return done
+
+    def _handoff(self, req: Request, from_engine: str) -> None:
+        """A prefill-pool retirement: re-queue the request onto the
+        decode pool with its output preseeded. The KV needs no copy
+        here — register() exported the prompt blocks at prefill time,
+        and the decode engine's admission adopts them by chain hash."""
+        now = _walltime.perf_counter()
+        self._handoff_clock[req.rid] = now
+        q = _Queued(req.rid, req.prompt, req.max_new_tokens,
+                    req.temperature, req.eos_token,
+                    req.adapter, req.tenant, req.trace,
+                    output=list(req.output), handoff_from=now,
+                    carry={"submitted_at": req.submitted_at,
+                           "submitted_wall": req.submitted_wall,
+                           "admitted_at": req.admitted_at,
+                           # the TRUE user TTFT: prefill-leg first
+                           # token against the original submit clock
+                           "ttft_carried_s": req.ttft_seconds})
+        self._queues["decode"].append(q)
+        self._record_decision(req.rid, "prefilled", from_engine,
+                              tokens=len(req.output))
+
+    def _complete(self, req: Request, engine: str) -> None:
+        t0 = self._handoff_clock.pop(req.rid, None)
+        if t0 is not None and req.first_token_at is not None:
+            # the full prefill-retire -> first-NEW-token latency
+            # (decode-pool queue + registry adoption scatter + the
+            # suffix prefill) — disaggregation's per-request cost
+            req.kv_handoff_s = max(0.0, req.first_token_at - t0)
+            metrics.serving_kv_handoff.observe(req.kv_handoff_s)
+        metrics.serving_router.inc("completed")
+        self.finished.append(req)
+        self._record_decision(req.rid, "completed", engine,
+                              tokens=len(req.output),
+                              handoffS=req.kv_handoff_s)
+
+    def _set_depth_gauges(self) -> None:
+        for pool, q in self._queues.items():
+            metrics.serving_pool_depth.set(float(len(q)), pool)

@@ -13,12 +13,19 @@ only parks raw messages on a queue, and the serve loop alone touches
 the engine. EOS on the input stream drains in-flight requests, emits
 their completions, then closes downstream.
 
+The ``engine`` may equally be a :class:`~.router.ServingRouter` — it
+duck-types the surface this loop consumes (``submit``/``step``/
+``finished``/``active_slots``/``pending``/``trace_context``), so one
+streaming step can front a disaggregated prefill/decode pool with no
+wire or loop changes.
+
 Wire shapes (JSON over the stream frames):
 
     in:  {"id": <any>, "prompt": [int], "maxNewTokens": int,
           "temperature"?: float, "eos"?: int, "tenant"?: str,
           "trace"?: {"traceId": str, "spanId"?: str}}
-    out: {"id": <any>, "tokens": [int], "preemptions": int}
+    out: {"id": <any>, "tokens": [int], "preemptions": int,
+          "prefilled"?: true}   # prefill-role engine with no router
     err: {"id": <any>, "error": str}
 
 ``tenant`` labels the engine's TTFT/TPOT/queue-wait SLO histograms;
@@ -43,7 +50,7 @@ _EOS = object()
 
 
 class StreamServer:
-    def __init__(self, engine: ServingEngine, consumer, producer,
+    def __init__(self, engine: "ServingEngine | Any", consumer, producer,
                  idle_wait_s: float = 0.01,
                  trace_context=None):
         self.engine = engine
@@ -132,17 +139,25 @@ class StreamServer:
                 pass
         return self.served
 
+    def _busy(self) -> bool:
+        """Prefer the engine's own ``busy`` when it has one (the router
+        exposes it precisely because materializing its combined pending
+        tuple per poll is allocation churn); fall back to the classic
+        slots+pending check for the bare engine."""
+        busy = getattr(self.engine, "busy", None)
+        if busy is not None:
+            return bool(busy)
+        return self.engine.active_slots > 0 or bool(self.engine.pending)
+
     def _serve_loop(self, open_input: bool, emitted: int) -> int:
         while True:
             if open_input:
                 # block briefly only when the engine would otherwise
                 # spin empty — a busy engine polls without waiting
-                idle = self.engine.active_slots == 0 and not self.engine.pending
-                open_input = self._admit_from_inbox(block=idle)
+                open_input = self._admit_from_inbox(block=not self._busy())
             # busy is judged AFTER admission: a request admitted in the
             # same tick that closed the input must still be served
-            busy = self.engine.active_slots > 0 or bool(self.engine.pending)
-            if (not open_input and not busy
+            if (not open_input and not self._busy()
                     and emitted == len(self.engine.finished)):
                 break
             self.engine.step()
@@ -150,10 +165,17 @@ class StreamServer:
             while emitted < len(self.engine.finished):
                 req = self.engine.finished[emitted]
                 emitted += 1
-                self.producer.send({
+                out = {
                     "id": self._rid_to_id.pop(req.rid, None),
                     "tokens": list(req.output),
                     "preemptions": req.preemptions,
-                })
+                }
+                if req.prefilled:
+                    # a prefill-role engine served WITHOUT a router in
+                    # front: the output is the prefill product (first
+                    # token only), not a full completion — flag it so
+                    # downstream can tell truncation from completion
+                    out["prefilled"] = True
+                self.producer.send(out)
                 self.served += 1
         return emitted

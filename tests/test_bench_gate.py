@@ -173,6 +173,42 @@ def test_slo_lines_from_requests(bench):
     assert all(ln["unit"] == "ms" and ln["new_tokens"] == 48 for ln in lines)
 
 
+def test_disagg_lineage_keys_on_workload_mix(bench, monkeypatch):
+    """ISSUE 11 satellite: the disaggregated-serving lines gate with
+    FRESH lineage — the workload mix is part of the comparison key, so
+    a reshaped mix is never judged against the old mix's best, while
+    the same mix still gates (including the lower-is-better tpot
+    line and the router-hit-rate floor)."""
+    assert "serving_disagg_tpot_ms_p95" in bench.GATE_LOWER_IS_BETTER
+    monkeypatch.setattr(bench, "_best_prior", lambda: {
+        _key(bench, metric="serving_disagg_tokens_per_sec",
+             mix="12Lx8+8Sx64"): 800.0,
+        _key(bench, metric="serving_disagg_tpot_ms_p95",
+             mix="12Lx8+8Sx64"): 6.0,
+        _key(bench, metric="serving_disagg_router_hit_rate",
+             mix="12Lx8+8Sx64"): 1.0,
+    })
+    # a different mix: no prior, not gated
+    bench._EMITTED[:] = [{"metric": "serving_disagg_tokens_per_sec",
+                          "value": 100.0, "unit": "tok/s",
+                          "mix": "24Lx8+4Sx16"}]
+    assert bench._regression_gate() == []
+    # same mix: a throughput drop, a tpot RISE, and a hit-rate drop
+    # past tolerance all fail
+    bench._EMITTED[:] = [
+        {"metric": "serving_disagg_tokens_per_sec", "value": 600.0,
+         "unit": "tok/s", "mix": "12Lx8+8Sx64"},
+        {"metric": "serving_disagg_tpot_ms_p95", "value": 9.0,
+         "unit": "ms", "mix": "12Lx8+8Sx64"},
+        {"metric": "serving_disagg_router_hit_rate", "value": 0.5,
+         "unit": "fraction", "mix": "12Lx8+8Sx64"},
+    ]
+    assert {f["metric"] for f in bench._regression_gate()} == {
+        "serving_disagg_tokens_per_sec", "serving_disagg_tpot_ms_p95",
+        "serving_disagg_router_hit_rate",
+    }
+
+
 def test_gate_tolerance_env_override(bench, monkeypatch):
     monkeypatch.setattr(bench, "_best_prior", lambda: {
         _key(bench, metric="m"): 100.0,

@@ -71,6 +71,22 @@ def _install_workload(cp: ShardedControlPlane, entry: str,
     cp.apply(make_story(f"{entry}-story", steps=defs))
 
 
+def _wait_for_leader(cp: ShardedControlPlane, timeout: float = 15.0) -> str:
+    """Condition-wait until SOME shard holds the leader lease and
+    return its sid, captured inside the predicate — leadership can
+    lapse between lease renewals, so a separate probe after the wait
+    reintroduces the StopIteration flake this exists to kill."""
+    found: list[str] = []
+
+    def probe() -> bool:
+        found[:] = [sid for sid, rt in cp.runtimes.items()
+                    if rt.shard_coordinator.elector.is_leader]
+        return bool(found)
+
+    cp.wait_until(probe, timeout, "no shard ever took the leader lease")
+    return found[0]
+
+
 def _assert_all_succeeded(cp: ShardedControlPlane, runs) -> None:
     """Terminal + succeeded + nothing orphaned (every run accounted).
     On failure, dump the family's StepRuns and recorded events — churn
@@ -189,11 +205,12 @@ class TestRebalance:
                     for i in range(16)]
             # kill the NON-leader so map publication survives the crash
             # (leader crash also recovers, but through lease expiry —
-            # that path is the slow churn leg's job)
-            victim = next(
-                sid for sid, rt in cp.runtimes.items()
-                if not rt.shard_coordinator.elector.is_leader
-            )
+            # that path is the slow churn leg's job). The leader is
+            # captured INSIDE the wait predicate: leadership is an
+            # event, not an invariant of any instant, and a second
+            # probe after the wait could land in a between-renewals gap
+            leader = _wait_for_leader(cp)
+            victim = next(sid for sid in cp.runtimes if sid != leader)
             cp.kill_shard(victim)
             survivor = next(iter(cp.runtimes))
             cp.wait_members({survivor}, timeout=30.0)
@@ -215,10 +232,12 @@ class TestRebalance:
             _install_workload(cp, "shard-leadercrash", sleep_s=0.02)
             runs = [cp.run_story("shard-leadercrash-story",
                                  inputs={"i": i}) for i in range(12)]
-            victim = next(
-                sid for sid, rt in cp.runtimes.items()
-                if rt.shard_coordinator.elector.is_leader
-            )
+            # leadership is an EVENT, not an invariant of any instant:
+            # between lease renewals on a loaded box an instantaneous
+            # probe can see nobody leading (observed StopIteration ~1
+            # in 10 tier-1 runs) — the wait predicate CAPTURES the
+            # leader in the same observation that proves one exists
+            victim = _wait_for_leader(cp)
             old_fence = cp.runtimes[victim].shard_coordinator.elector.fence_token
             cp.kill_shard(victim)
             survivor = next(iter(cp.runtimes))
@@ -286,14 +305,60 @@ class TestShardedSoak:
             )
         return sps, cp
 
+    def test_four_shards_share_steady_state_work(self):
+        """The tier-1 leg of the old 3x acceptance test, made
+        DETERMINISTIC: the wall-clock throughput ratio flaked ~5/10 on
+        a loaded 1-core CI box (steps/s is a property of the box, not
+        the architecture), so tier-1 now pins only event/condition
+        facts — a closed-loop 4-shard soak completes every run (the
+        wait_runs condition wait replaces the timed window), EVERY
+        shard processed work, ownership was disjoint (detector), and
+        nothing was lost or double-finished. The throughput claim
+        itself lives where wall-clock belongs: the slow-marked ratio
+        leg below and the bench's gated `sharded_steps_per_sec`
+        lineage (scaling_x recorded per run)."""
+        def configure(cfg):
+            cfg.scheduling.global_max_concurrent_steps = self.CAP_PER_SHARD
+            cfg.scheduling.queue_probe_interval = 1.0
+
+        cp = ShardedControlPlane(
+            shards=4, heartbeat_interval=0.25, member_ttl=3.0,
+            lease_duration=4.0, configure=configure,
+        )
+        n_runs = 32
+        with cp:
+            cp.wait_members({str(i) for i in range(4)})
+            _install_workload(cp, "shard-soak-fast", sleep_s=0.05)
+            runs, done = [], 0
+            # closed loop: keep a bounded window outstanding so all
+            # four shards stay busy without depending on timing
+            while done < n_runs:
+                while (len(runs) < n_runs
+                       and len(runs) - done < 4 * self.CAP_PER_SHARD):
+                    runs.append(cp.run_story("shard-soak-fast-story",
+                                             inputs={"i": len(runs)}))
+                done = sum(
+                    cp.run_phase(r) in (Phase.SUCCEEDED, Phase.FAILED)
+                    for r in runs)
+                time.sleep(0.02)
+            cp.wait_runs(runs, timeout=60.0)
+
+        _assert_all_succeeded(cp, runs)
+        cp.detector.assert_clean()
+        # all four shards genuinely shared the work (hash-ring spread
+        # over 32 run families makes an idle shard an ownership bug,
+        # not a scheduling accident)
+        assert len(cp.detector.processed) == 4, cp.detector.processed
+
+    @pytest.mark.slow
     def test_four_shards_sustain_3x_single_shard(self):
-        """The acceptance criterion: same workload, same per-manager
-        budget — 4 cooperating managers >= 3x one manager's steps/s,
-        detector clean on both legs. Calibrated headroom: this shape
-        measures 4.1-4.4x on an otherwise idle box; one re-measure of
-        the 4-shard leg absorbs a scheduler hiccup (the ratio is a
-        property of the architecture, the noise is a property of the
-        2-core CI box)."""
+        """The wall-clock acceptance measurement (4 cooperating
+        managers >= 3x one manager's steps/s on the same per-manager
+        budget), slow-marked out of tier-1: the ratio is real on an
+        idle box (4.1-4.4x measured) but a loaded single-core CI
+        runner fails it ~5/10 through scheduler noise alone. The bench
+        regression gate (`sharded_steps_per_sec` + recorded scaling_x)
+        guards the trend on every bench run."""
         single_sps, cp1 = self._steady_state_soak(shards=1)
         cp1.detector.assert_clean()
         ratio = 0.0
