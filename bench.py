@@ -1278,6 +1278,103 @@ def run_sharded_child() -> None:
     _emit(config12_sharded_soak())
 
 
+def config15_multislice_train() -> dict:
+    """Multi-slice hierarchical parallelism: DCN-data-parallel x
+    ICI-model-parallel train step on a two-level (dcn x ICI) mesh vs
+    the single-mesh baseline — resource-matched (same 8 virtual
+    devices, same global batch, same model; the ONLY difference is
+    which axis carries the gradient psum). On this CPU image both legs
+    run the identical arithmetic, so the ratio is the overhead of the
+    two-level collective schedule (~1.0 when healthy); on real
+    multi-slice hardware the dcn leg is the shape that scales past one
+    slice. Runs in a CHILD with the virtual-device env (the parent
+    never re-initializes its jax backend)."""
+    import jax
+    import numpy as np
+    import optax
+
+    from bobrapet_tpu.models.llama import llama_tiny
+    from bobrapet_tpu.parallel.mesh import build_mesh
+    from bobrapet_tpu.parallel.train import (
+        init_sharded_train_state,
+        make_multislice_train_step,
+        make_token_batch,
+        make_train_step,
+    )
+
+    batch = int(os.environ.get("BENCH_MULTISLICE_BATCH", "8"))
+    seq_len = int(os.environ.get("BENCH_MULTISLICE_SEQ", "32"))
+    steps = int(os.environ.get("BENCH_MULTISLICE_STEPS", "20"))
+    cfg = llama_tiny()
+    opt = optax.adamw(1e-3, weight_decay=0.1)
+
+    def leg(mesh, step_fn) -> float:
+        params, opt_state, _ = init_sharded_train_state(
+            jax.random.PRNGKey(0), cfg, mesh, optimizer=opt
+        )
+        tokens = make_token_batch(
+            jax.random.PRNGKey(1), cfg, batch=batch, seq_len=seq_len,
+            mesh=mesh,
+        )
+        # warmup: compile + first-touch
+        for _ in range(2):
+            params, opt_state, loss = step_fn(params, opt_state, tokens)
+        loss.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step_fn(params, opt_state, tokens)
+        loss.block_until_ready()
+        return steps / (time.perf_counter() - t0), float(loss)
+
+    ici = {"data": 1, "model": 4}
+    two_mesh, two_step = make_multislice_train_step(
+        cfg, replicas=2, ici_axes=ici, optimizer=opt
+    )
+    single_mesh = build_mesh({"data": 2, "model": 4})
+    single_step = make_train_step(cfg, single_mesh, optimizer=opt)
+
+    # interleaved best-of-2: box noise must tax both legs alike
+    two = single = 0.0
+    loss_two = loss_single = 0.0
+    for _ in range(2):
+        sps, loss_two = leg(two_mesh, two_step)
+        two = max(two, sps)
+        sps, loss_single = leg(single_mesh, single_step)
+        single = max(single, sps)
+    # honesty check: the two schedules compute the same math
+    parity = bool(np.isclose(loss_two, loss_single, rtol=2e-4))
+    return {
+        "metric": "multislice_train_step_per_sec",
+        "value": round(two, 2),
+        "unit": "steps/s",
+        "vs_baseline": round(two / single, 2) if single else 0.0,
+        "config": "multislice-train",
+        # fresh _gate_key lineage: the mesh shape is part of the
+        # comparison identity (a dcn2 leg must never be judged against
+        # a future dcn4 prior)
+        "mesh": "dcn2x" + "x".join(f"{k}{v}" for k, v in ici.items()),
+        "model": "tiny",
+        "batch": batch,
+        "single_mesh_steps_per_sec": round(single, 2),
+        "numeric_parity": parity,
+        "devices": jax.device_count(),
+    }
+
+
+def run_multislice_child() -> None:
+    """Child entrypoint: needs the virtual 8-device CPU backend (the
+    flag must land before jax initializes in THIS process)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    _emit(config15_multislice_train())
+
+
 def run_sweep(state: dict) -> None:
     # the parent NEVER touches the accelerator — but the env var alone
     # is not enough: a site hook can rewrite platform priority
@@ -1904,7 +2001,11 @@ def _gate_key(d: dict) -> tuple:
             # disaggregated-serving lineage: the workload mix is part
             # of the identity, so a reshaped mix starts a fresh gate
             # history instead of being judged against the old one
-            d.get("mix"))
+            d.get("mix"),
+            # multi-slice lineage: the two-level mesh shape is part of
+            # the identity (a dcn2 leg vs a future dcn4 prior would be
+            # a shape change, not a regression)
+            d.get("mesh"))
 
 
 def _best_prior() -> dict:
@@ -1991,6 +2092,9 @@ def main() -> None:
     if os.environ.get("BENCH_CHILD") == "sharded":
         run_sharded_child()
         return
+    if os.environ.get("BENCH_CHILD") == "multislice":
+        run_multislice_child()
+        return
 
     state: dict = {"stage": "start"}
     _arm_watchdog(state)
@@ -2023,6 +2127,13 @@ def main() -> None:
         _spawn_passthrough(
             "sharded", None,
             timeout=min(240.0, max(90.0, _remaining() - 60.0)), cpu=True,
+        )
+        # multi-slice two-level-mesh train step: child because it needs
+        # the virtual 8-device backend the parent must not initialize
+        state["stage"] = "multislice-train"
+        _spawn_passthrough(
+            "multislice", None,
+            timeout=min(300.0, max(90.0, _remaining() - 60.0)), cpu=True,
         )
 
     # give the FIRST probe a chance to conclude before deciding: a

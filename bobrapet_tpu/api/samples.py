@@ -56,9 +56,15 @@ def definition_samples() -> list:
             entrypoint="examples.rag:webhook_listener",
             image="ghcr.io/example/webhook:1",
         ),
+        make_engram_template(
+            "trainer-tpl",
+            entrypoint="examples.train:train_step",
+            image="ghcr.io/example/trainer:1",
+        ),
         make_engram("embedder", "embedder-tpl"),
         make_engram("retriever", "retriever-tpl"),
         make_engram("generator", "generator-tpl"),
+        make_engram("trainer", "trainer-tpl"),
         make_transport(
             "voz", "bobravoz", driver="grpc",
             supportedBinary=["application/json"],
@@ -77,6 +83,27 @@ def definition_samples() -> list:
             ],
             output={"answer": "{{ steps.generate.output.text }}"},
             policy={"queue": "v5e-pool"},
+        ),
+        make_story(
+            "multislice-train",
+            steps=[
+                # one logical trainer fanned out as a SPANNING grant:
+                # a per-pool ICI-contiguous block per replica, DCN
+                # data-parallel between them (docs/TRAINING.md
+                # "Multi-slice training"). Omitting `pools` falls back
+                # to the scheduling.span-pools operator key.
+                {"name": "train", "type": "parallel", "with": {
+                    "replicas": 2,
+                    "pools": ["v5e-pool-a", "v5e-pool-b"],
+                    "step": {
+                        "name": "rep",
+                        "ref": {"name": "trainer"},
+                        "with": {"steps": "{{ inputs.steps }}"},
+                        "tpu": {"topology": "4x4",
+                                "meshAxes": {"data": 1, "model": 16}},
+                    },
+                }},
+            ],
         ),
         make_impulse("webhook-in", "webhook-tpl", "rag"),
         make_reference_grant(

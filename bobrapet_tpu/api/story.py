@@ -87,6 +87,45 @@ class Step(SpecBase):
         return refs
 
 
+def expand_parallel_branches(step: Step) -> list[Step]:
+    """Branch Steps of a ``parallel`` step — ONE decoder for both
+    fan-out spellings (the executor, validators, and the deep-traversal
+    must never diverge on what the branches are):
+
+    - explicit ``with.steps``: full inline Step objects, verbatim;
+    - ``with.replicas`` + ``with.step``: one logical step template
+      fanned out N times (the multi-slice spelling — each replica
+      becomes a gang member of one spanning grant, DCN data-parallel
+      across per-pool ICI sub-meshes). Replica branches are named
+      ``<template-name>-r<i>``.
+    """
+    w = step.with_ or {}
+    if w.get("steps"):
+        return [Step.from_dict(raw) for raw in w["steps"]]
+    replicas = w.get("replicas")
+    tmpl = w.get("step")
+    if replicas and isinstance(tmpl, dict):
+        try:
+            n = int(replicas)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"parallel step {step.name!r}: replicas must be an "
+                f"integer, got {replicas!r}"
+            ) from None
+        if n < 1:
+            raise ValueError(
+                f"parallel step {step.name!r}: replicas must be >= 1, got {n}"
+            )
+        base = tmpl.get("name") or "replica"
+        out = []
+        for i in range(n):
+            d = dict(tmpl)
+            d["name"] = f"{base}-r{i}"
+            out.append(Step.from_dict(d))
+        return out
+    return []
+
+
 @dataclasses.dataclass
 class StoryTimeouts(SpecBase):
     """(reference: story_types.go:303-338 StoryTimeouts)"""
@@ -191,9 +230,7 @@ class StorySpec(SpecBase):
             s = frontier.pop()
             out.append(s)
             if s.type is not None and s.with_:
-                frontier.extend(
-                    Step.from_dict(raw) for raw in s.with_.get("steps") or []
-                )
+                frontier.extend(expand_parallel_branches(s))
         return out
 
 

@@ -52,6 +52,18 @@ class SchedulingConfig:
     #: sharded soak) tighten it so a freed slot refills promptly
     #: (dotted: scheduling.queue-probe-interval)
     queue_probe_interval: float = 1.0
+    #: default pool set for SPANNING gangs (multi-slice DCN
+    #: data-parallel): a `parallel` step with a replicas/step fan-out
+    #: that names no `pools` of its own spans these. Empty = replicated
+    #: fan-outs stay single-pool on their queue's pool
+    #: (dotted: scheduling.span-pools, comma-separated pool names)
+    span_pools: list[str] = dataclasses.field(default_factory=list)
+    #: when the balanced round-robin distribution of a spanning gang
+    #: does not fit, allow the greedy first-fit fallback that may pack
+    #: replicas unevenly across pools (off = balanced-or-park; uneven
+    #: replica counts skew DCN gradient-sync stragglers)
+    #: (dotted: scheduling.span-spill)
+    span_spill: bool = True
     queues: dict[str, QueueConfig] = dataclasses.field(default_factory=dict)
 
     def queue(self, name: Optional[str]) -> QueueConfig:
@@ -307,6 +319,10 @@ class OperatorConfig:
             # hot requeue loop — the exact timer churn the event-driven
             # refill exists to avoid
             errs.append("scheduling.queue-probe-interval must be > 0")
+        if len(set(self.scheduling.span_pools)) != len(self.scheduling.span_pools):
+            # a duplicated pool would double its round-robin share and
+            # silently skew the balanced replica distribution
+            errs.append("scheduling.span-pools must not repeat a pool")
         if self.controllers.shard_count < 1:
             errs.append("controllers.shard-count must be >= 1")
         if not (0 <= self.controllers.shard_id < max(1, self.controllers.shard_count)):
@@ -392,6 +408,11 @@ def _apply_dotted(cfg: OperatorConfig, key: str, value: str) -> bool:
         "controllers.shard-id": lambda: fset(cfg.controllers, "shard_id", int),
         "scheduling.global-max-concurrent-steps": lambda: fset(cfg.scheduling, "global_max_concurrent_steps", int),
         "scheduling.queue-probe-interval": lambda: fset(cfg.scheduling, "queue_probe_interval", as_dur),
+        "scheduling.span-pools": lambda: fset(
+            cfg.scheduling, "span_pools",
+            lambda v: [p.strip() for p in str(v).split(",") if p.strip()],
+        ),
+        "scheduling.span-spill": lambda: fset(cfg.scheduling, "span_spill", as_bool),
         "templating.evaluation-timeout": lambda: fset(cfg.templating, "evaluation_timeout", as_dur),
         "templating.max-output-bytes": lambda: fset(cfg.templating, "max_output_bytes", int),
         "templating.deterministic": lambda: fset(cfg.templating, "deterministic", as_bool),

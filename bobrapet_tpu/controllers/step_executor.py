@@ -383,9 +383,21 @@ class StepExecutor:
         completes when ALL children are terminal, fails if any
         non-allowFailure branch failed (no completionPolicy — SURVEY §2.2
         documents the reference implements none despite enum comments)
-        (reference: step_executor.go:741-747, dag.go:1112-1200)"""
+        (reference: step_executor.go:741-747, dag.go:1112-1200).
+
+        The ``replicas``/``step`` spelling ({replicas: N, step: {...},
+        pools: [...]}) fans ONE logical step out as N gang members and
+        places them as one SPANNING grant across the named pools (or
+        ``scheduling.span-pools``): per-pool ICI-contiguous
+        super-blocks, all-or-nothing across pools, every member's env
+        carrying replica index + span process layout so the engrams
+        initialize jax.distributed as one job over a dcn x ICI mesh —
+        the multi-slice DCN-data-parallel shape."""
+        from ..api.story import expand_parallel_branches
+
         w = step.with_ or {}
-        branches = [Step.from_dict(b) for b in (w.get("steps") or [])]
+        branches = expand_parallel_branches(step)
+        replicated = bool(w.get("replicas")) and not w.get("steps")
         for branch in branches:
             if branch.type is not None:
                 # primitive branches run as instant/timer states inside the
@@ -394,27 +406,49 @@ class StepExecutor:
                     f"parallel branch {branch.name!r}: primitive branches are "
                     "not supported; use engram steps"
                 )
+        span_pools: Optional[list[str]] = None
+        spill = True
+        if replicated:
+            sched = self.config_manager.config.scheduling
+            pools = w.get("pools") or sched.span_pools
+            if not pools:
+                # no pools named anywhere: span over the queue's own
+                # pool. The replicas spelling ALWAYS means one
+                # data-parallel job — silently launching N independent
+                # full-workload copies (no span env, N flat meshes)
+                # would burn N slices for zero extra throughput
+                pools = [
+                    queue if queue and self.placer.pool(queue) else "local"
+                ]
+            span_pools = [str(p) for p in pools]
+            spill = bool(w.get("spill", sched.span_spill))
         # batched gang placement: every TPU branch gets its slice in ONE
-        # pool pass (siblings packed ICI-adjacent when a super-block
+        # pass per pool (siblings packed ICI-adjacent when a super-block
         # fits), and capacity shortfall surfaces BEFORE any branch
         # StepRun exists — the per-branch path could strand a partial
         # gang when a later sibling hit NoCapacity
         with tracing.TRACER.start_span(
             "slice.place_group", step=step.name, run=run.meta.name,
             namespace=run.meta.namespace, branches=len(branches),
+            span_pools=",".join(span_pools) if span_pools else None,
         ):
             try:
                 grants = self.placer.place_group(
-                    [(b.name, b.tpu) for b in branches], queue=queue
+                    [(b.name, b.tpu) for b in branches], queue=queue,
+                    pools=span_pools, spill=spill,
                 )
             except NoCapacity as e:
                 raise LaunchBlocked(str(e)) from None
         if any(g is not None for g in grants.values()):
+            placed = [g for g in grants.values() if g is not None]
+            span = placed[0].span if placed else None
             FLIGHT.record(
                 run.meta.namespace, run.meta.name, "placement",
-                message=f"gang {step.name}: "
-                        f"{sum(1 for g in grants.values() if g is not None)} "
-                        f"branch slice(s) granted in one pass",
+                message=f"gang {step.name}: {len(placed)} "
+                        f"branch slice(s) granted in one pass"
+                        + (f" spanning pools "
+                           f"{sorted({g.pool for g in placed})} "
+                           f"({span['id']})" if span else ""),
                 step=step.name,
             )
         children = []

@@ -54,6 +54,20 @@ _log = logging.getLogger(__name__)
 
 CANCEL_ANNOTATION = "runs.bobrapet.io/cancel"
 
+#: bounded stale-scope requeues before the run fails for real (at the
+#: 0.5s requeue that is ~60s — a rebalance drain clears in seconds; a
+#: scope still stale after this is a genuine lost output, and failing
+#: loudly keeps the churn-soak assert as the detector it is)
+STALE_SCOPE_RETRY_CAP = 120
+
+
+class StaleRunScope(Exception):
+    """Input templates referenced a sibling step whose output the
+    StoryRun status view does not carry YET: the view lags the
+    sibling's output patch (observed during cross-shard rebalance
+    drains — PR-6 vintage). The scope is stale, not wrong — the caller
+    requeues instead of failing the run."""
+
 
 class StepRunController:
     def __init__(
@@ -226,6 +240,39 @@ class StepRunController:
                     exit_class=ExitClass.TERMINAL,
                 ),
             )
+        except StaleRunScope as e:
+            # cross-shard lost-work guard: the sibling's output exists
+            # (its StepRun succeeded) but this reconcile's StoryRun view
+            # lags the patch. Requeue — never turn a replication lag
+            # into a terminal run failure — with a hard cap so a
+            # genuinely lost output still fails loudly.
+            retries = int(sr.status.get("staleScopeRetries") or 0)
+            if retries >= STALE_SCOPE_RETRY_CAP:
+                metrics.steprun_stale_scope.inc("exhausted")
+                return self._fail(
+                    sr,
+                    StructuredError(
+                        type=ErrorType.VALIDATION,
+                        message=(
+                            f"input scope still stale after {retries} "
+                            f"requeues (sibling output never surfaced): {e}"
+                        ),
+                        exit_class=ExitClass.TERMINAL,
+                    ),
+                )
+            metrics.steprun_stale_scope.inc("requeued")
+            self.store.patch_status(
+                STEP_RUN_KIND, namespace, name,
+                lambda st: st.update({"staleScopeRetries": retries + 1}),
+            )
+            if retries == 0 and run_name:
+                FLIGHT.record(
+                    namespace, run_name, "stale-scope",
+                    message=f"step {spec.step_id or name}: sibling output "
+                            f"missing from run view, requeueing ({e})",
+                    step=spec.step_id or name,
+                )
+            return 0.5
         except TemplateError as e:
             return self._fail(
                 sr,
@@ -304,6 +351,10 @@ class StepRunController:
             checkpoint_prefix=ckpt_prefix,
             resume_step=resume_step,
             preemption_attempt=preemption_attempt,
+            # spanning-gang membership: replica identity + global
+            # process layout + the ONE span coordinator (build_env
+            # overrides the per-pool coordinator with it)
+            span=slice_grant.get("span"),
         )
         job = make_job(
             job_name,
@@ -332,6 +383,7 @@ class StepRunController:
             status["retries"] = retries
             status.setdefault("startedAt", self.clock.now())
             status.pop("nextRetryAt", None)
+            status.pop("staleScopeRetries", None)
             # consumed into this attempt's env; a later preemption
             # recomputes it from the then-latest checkpoint
             status.pop("resumeFrom", None)
@@ -847,7 +899,43 @@ class StepRunController:
         # happen once per reconcile, not once per consumer
         evaluated_hydrated = False
         try:
-            resolved = self.evaluator.evaluate_value(raw, scope)
+            try:
+                resolved = self.evaluator.evaluate_value(raw, scope)
+            except OffloadedDataUsage:
+                raise  # policy hydration below, not a stale-scope case
+            except TemplateError:
+                # the StoryRun status view can LAG a sibling's output
+                # patch (cross-shard rebalance drain): resolve the
+                # missing outputs from the authoritative StepRun state
+                # and retry once before judging the template
+                if not self._authoritative_steps_overlay(
+                    namespace, storyrun, scope
+                ):
+                    stale = self._stale_output_refs(raw, scope)
+                    if stale:
+                        # the reference IS a succeeded sibling whose
+                        # output no view carries yet (patch in flight):
+                        # stale, not wrong — requeue
+                        raise StaleRunScope(
+                            f"succeeded sibling(s) {stale} have no "
+                            f"output in the run view yet"
+                        ) from None
+                    raise
+                metrics.steprun_stale_scope.inc("healed")
+                try:
+                    resolved = self.evaluator.evaluate_value(raw, scope)
+                except OffloadedDataUsage:
+                    raise
+                except TemplateError:
+                    # overlay healed some refs but not all — if what
+                    # remains is still a stale sibling, requeue
+                    stale = self._stale_output_refs(raw, scope)
+                    if stale:
+                        raise StaleRunScope(
+                            f"succeeded sibling(s) {stale} have no "
+                            f"output in the run view yet"
+                        ) from None
+                    raise
         except OffloadedDataUsage:
             if policy is OffloadedDataPolicy.FAIL:
                 raise
@@ -905,6 +993,66 @@ class StepRunController:
             return self.storage.hydrate(value, [prefix] if prefix else None)
         except Exception:  # noqa: BLE001 - validation best-effort on refs
             return value
+
+    # ------------------------------------------------------------------
+    # stale-scope recovery (cross-shard lost-work guard)
+    # ------------------------------------------------------------------
+    def _authoritative_steps_overlay(
+        self, namespace: str, storyrun, scope: dict[str, Any]
+    ) -> bool:
+        """Fill scope["steps"] entries whose output is missing from the
+        (possibly lagging) StoryRun status view with the AUTHORITATIVE
+        StepRun status — the output patch lands on the sibling StepRun
+        strictly before the DAG merges it into stepStates, so the
+        StepRun is the source of truth whenever the two disagree.
+        Returns True when anything was filled. Deterministic top-level
+        StepRun names only; `parallel` branch outputs roll up through
+        the parent timer and never resolve here."""
+        if storyrun is None:
+            return False
+        from ..utils.naming import steprun_name
+
+        changed = False
+        for step_name, entry in list((scope.get("steps") or {}).items()):
+            if not isinstance(entry, dict) or entry.get("output") is not None:
+                continue
+            sib = self.store.try_get_view(
+                STEP_RUN_KIND, namespace,
+                steprun_name(storyrun.meta.name, step_name),
+            )
+            if sib is None:
+                continue
+            out = sib.status.get("output")
+            if out is None:
+                continue
+            healed = dict(entry)
+            healed["output"] = out
+            healed["phase"] = sib.status.get("phase") or entry.get("phase")
+            scope["steps"][step_name] = healed
+            changed = True
+        return changed
+
+    def _stale_output_refs(
+        self, raw: Any, scope: dict[str, Any]
+    ) -> list[str]:
+        """Referenced sibling steps whose run-view state says SUCCEEDED
+        yet carries no output — the exact signature of an output patch
+        the view has not absorbed yet (anything else failing template
+        evaluation is a genuine authoring error and must stay one)."""
+        try:
+            refs = Evaluator.find_step_references({"with": raw})
+        except Exception:  # noqa: BLE001 - detector must never mask the error
+            return []
+        stale = []
+        for name in refs:
+            entry = (scope.get("steps") or {}).get(name)
+            if (
+                isinstance(entry, dict)
+                and entry.get("phase") == str(Phase.SUCCEEDED)
+                and entry.get("output") is None
+            ):
+                stale.append(name)
+        return sorted(stale)
 
     # ------------------------------------------------------------------
     # cache
@@ -1033,16 +1181,16 @@ def _contains_marker(value) -> bool:
 
 
 def _find_step_def(story_spec, step_id: str):
-    """Locate a step definition by name, including `parallel` branches."""
-    from ..api.story import Step
+    """Locate a step definition by name, including `parallel` branches
+    (both spellings: explicit `steps` and the replicas/step fan-out)."""
+    from ..api.story import expand_parallel_branches
 
     direct = story_spec.step(step_id)
     if direct is not None:
         return direct
     for s in story_spec.all_steps():
         if s.type is not None and s.with_:
-            for raw in s.with_.get("steps") or []:
-                branch = Step.from_dict(raw)
+            for branch in expand_parallel_branches(s):
                 if branch.name == step_id:
                     return branch
     return None

@@ -134,22 +134,45 @@ class FleetManager:
         self, grants: list[dict[str, Any]]
     ) -> Optional[list[dict[str, Any]]]:
         """Batched gang re-placement: release every dead sibling grant,
-        then re-place all of them in ONE pool pass (all-or-nothing, via
-        the allocator's batched gang API — siblings of one fan-out land
-        ICI-adjacent again when a super-block fits). The dead grants are
-        released even when nothing fits (fail fast: never hold a
-        reclaimed slice); None means the callers park and retry."""
+        then re-place all of them in ONE pass per pool (all-or-nothing,
+        via the allocator's batched gang API — siblings of one fan-out
+        land ICI-adjacent again when a super-block fits). Siblings that
+        span pools (SPANNING grants — the multi-slice DCN shape) are
+        grouped by pool, released everywhere, and re-placed pool by
+        pool; a pool that cannot re-place its members rolls back every
+        OTHER pool's fresh allocations and returns None (the dead
+        grants stay released either way — fail fast: never hold a
+        reclaimed slice; callers park and retry). Non-span siblings on
+        different pools are a caller bug and still rejected."""
         if not grants:
             return []
         pools = {g.get("pool", "") for g in grants}
-        if len(pools) != 1:
+        if len(pools) != 1 and not all(g.get("span") for g in grants):
             raise ValueError(f"sibling grants span pools {sorted(pools)}")
-        pool = self.placer.pool(pools.pop())
-        if pool is None:
-            return None
-        for g in grants:
-            pool.release(g.get("sliceId", ""))
-        return self._allocate_like(pool, grants)
+        by_pool: dict[str, list[tuple[int, dict[str, Any]]]] = {}
+        for idx, g in enumerate(grants):
+            by_pool.setdefault(g.get("pool", ""), []).append((idx, g))
+        for name, members in by_pool.items():
+            pool = self.placer.pool(name)
+            if pool is None:
+                return None
+            for _idx, g in members:
+                pool.release(g.get("sliceId", ""))
+        news: list[Optional[dict[str, Any]]] = [None] * len(grants)
+        for name, members in by_pool.items():
+            out = self._allocate_like(
+                self.placer.pool(name), [g for _idx, g in members]
+            )
+            if out is None:
+                # atomic across pools: hand back what the OTHER pools
+                # just granted; the dead grants stay released
+                for new in news:
+                    if new is not None:
+                        self.placer.release(new)
+                return None
+            for (idx, _g), new in zip(members, out):
+                news[idx] = new
+        return news  # type: ignore[return-value]
 
     def place_pending(self, grant: dict[str, Any]) -> Optional[dict[str, Any]]:
         """Retry a deferred replacement (the old grant is already
@@ -180,6 +203,11 @@ class FleetManager:
                 new.mesh_axes = dict(grant["meshAxes"])
             if grant.get("accelerator") and not new.accelerator:
                 new.accelerator = grant["accelerator"]
+            if grant.get("span"):
+                # spanning membership survives re-placement: replica
+                # index, process base and coordinator are LOGICAL
+                # identity — the replacement block carries them verbatim
+                new.span = dict(grant["span"])
         # pool.allocate_many already counted these placements under
         # "granted" — a second outcome label would double-count them
         return [new.to_dict() for new in news]
@@ -188,15 +216,22 @@ class FleetManager:
         """One truthful line for awaitingSlice park logs: what the
         grant's pool could still place right now (schedulable excludes
         cordons; the largest-block figure is exact, served from the
-        allocator's cache between capacity changes)."""
-        pool = self.placer.pool(grant.get("pool", ""))
-        if pool is None:
-            return ""
-        return (
-            f"pool {pool.name}: {pool.schedulable_chips()} schedulable "
-            f"chips, {pool.cordoned_chips()} cordoned, largest free "
-            f"block {pool.largest_free_block()} chips"
-        )
+        allocator's cache between capacity changes). A SPANNING grant
+        reports every pool its gang covers — a park that will only
+        clear when capacity frees on a sibling's slice must say so."""
+        span_pools = (grant.get("span") or {}).get("pools") or []
+        names = list(dict.fromkeys([grant.get("pool", ""), *span_pools]))
+        hints = []
+        for name in names:
+            pool = self.placer.pool(name)
+            if pool is None:
+                continue
+            hints.append(
+                f"pool {pool.name}: {pool.schedulable_chips()} schedulable "
+                f"chips, {pool.cordoned_chips()} cordoned, largest free "
+                f"block {pool.largest_free_block()} chips"
+            )
+        return "; ".join(hints)
 
     # -- recovery latency --------------------------------------------------
 

@@ -136,6 +136,9 @@ def materialize_gang_job(
     node_selector: dict[str, str] = {}
     tolerations: list[dict[str, Any]] = []
     pod_resources: dict[str, Any] = dict(resources or {})
+    #: extra Service minted for span member 0 when the span has no
+    #: recorded coordinator (see the span block below)
+    span_coord_manifest: Optional[dict[str, Any]] = None
     full_env = dict(env)
     if entrypoint:
         full_env.setdefault("BOBRA_ENTRYPOINT", entrypoint)
@@ -177,6 +180,45 @@ def materialize_gang_job(
             full_env[contract.ENV_MESH_AXES] = json.dumps(
                 grant["meshAxes"], separators=(",", ":"), sort_keys=True
             )
+        span = grant.get("span")
+        if span:
+            # spanning gang member: replica identity + the span-global
+            # process layout (one renderer — contract.span_env), and
+            # ONE coordinator for the whole span. Workers of every
+            # member job dial the SAME address, which is what makes N
+            # per-pool Indexed Jobs one jax.distributed job.
+            full_env.update(contract.span_env(span))
+            coord = span.get("coordinator")
+            replicas = int(span.get("replicas") or 1)
+            if coord:
+                full_env[contract.ENV_COORDINATOR_ADDRESS] = (
+                    str(coord) if ":" in str(coord)
+                    else f"{coord}:{coordinator_port}"
+                )
+            elif replicas > 1 and span.get("id"):
+                # placement recorded no coordinator (pools declare no
+                # host addresses on GKE — DNS is minted by k8s, not the
+                # operator). Every member's own worker-0 would split the
+                # span into N disjoint coordinator groups that all hang,
+                # so derive ONE span-scoped address from the span id:
+                # member 0's manifest ships a headless Service selecting
+                # exactly its worker-0 pod (the completion-index pod
+                # label), and every member dials that Service name.
+                span_coord_svc = f"{span['id']}-coord"
+                full_env[contract.ENV_COORDINATOR_ADDRESS] = (
+                    f"{span_coord_svc}:{coordinator_port}"
+                )
+                if int(span.get("replica") or 0) == 0:
+                    span_coord_manifest = headless_service(
+                        span_coord_svc,
+                        namespace,
+                        {
+                            "bobrapet.io/job": name,
+                            COMPLETION_INDEX_ANNOTATION: "0",
+                        },
+                        ports=[{"name": "coordinator",
+                                "port": coordinator_port}],
+                    )
 
     env_list = env_from_dict(full_env)
     # per-host identity: the Indexed Job's completion index IS the worker
@@ -242,6 +284,8 @@ def materialize_gang_job(
                 ports=[{"name": "coordinator", "port": coordinator_port}],
             )
         )
+    if span_coord_manifest is not None:
+        manifests.append(span_coord_manifest)
     if jobset:
         manifests.append(_wrap_jobset(name, namespace, labels, job_spec))
     else:

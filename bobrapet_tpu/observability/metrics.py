@@ -280,6 +280,14 @@ class _ControlPlaneMetrics:
         self.steprun_cache_lookups = c(
             "bobrapet_steprun_cache_lookups_total", "Cache probes", ["result"]
         )
+        self.steprun_stale_scope = c(
+            "bobrapet_steprun_stale_scope_total",
+            "Input scopes that lagged a sibling's output patch "
+            "(cross-shard drain): healed = resolved from authoritative "
+            "StepRun state, requeued = retried on view lag, exhausted = "
+            "output never surfaced within the retry cap",
+            ["outcome"],
+        )
         self.steprun_blocked = g(
             "bobrapet_steprun_blocked", "StepRuns in Blocked phase", []
         )

@@ -3,9 +3,21 @@
 Long-context strategies (SURVEY §5.7): :func:`ring_attention` (k/v ring
 over ppermute, O(S/P) memory) and :func:`ulysses_attention` (head
 scatter over all-to-all, two collectives total) — pick per workload.
+
+Multi-slice (SURVEY §7 / ROADMAP 1): :func:`build_two_level_mesh` puts
+a ``dcn`` outer axis over per-slice ICI axes; spanning grants from
+:meth:`SlicePlacer.place_group` carry the multi-grant env contract the
+mesh constructors consume (:func:`build_mesh_from_env`).
 """
 
-from .mesh import build_mesh
+from .mesh import (
+    DCN_AXIS,
+    build_mesh,
+    build_mesh_from_env,
+    build_two_level_mesh,
+    distributed_init_args,
+    span_facts,
+)
 from .placement import (
     NoCapacity,
     PlacementError,
@@ -19,7 +31,12 @@ from .ring_attention import make_ring_attn_fn, ring_attention
 from .ulysses import make_ulysses_attn_fn, ulysses_attention
 
 __all__ = [
+    "DCN_AXIS",
     "build_mesh",
+    "build_mesh_from_env",
+    "build_two_level_mesh",
+    "distributed_init_args",
+    "span_facts",
     "NoCapacity",
     "PlacementError",
     "SliceGrant",

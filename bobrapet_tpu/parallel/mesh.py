@@ -4,47 +4,233 @@ The engram-side half of slice placement: the operator grants a slice and
 logical axes through the env contract; the engram builds a
 ``jax.sharding.Mesh`` over its visible devices with this helper. The
 full sharding-rule layer lives in :mod:`bobrapet_tpu.parallel.sharding`.
+
+Two-level meshes (multi-slice): when a step runs as a SPANNING gang
+(one grant per pool, DCN between slices — the multi-grant env contract,
+``BOBRA_DCN_REPLICAS``/``BOBRA_DCN_REPLICA_INDEX``/``BOBRA_SPAN_*``),
+:func:`build_two_level_mesh` puts a ``dcn`` outer axis over the
+per-replica ICI axes: batch shards over ``dcn`` (gradient psum rides the
+data-center network once per step), parameters shard over the inner ICI
+axes only (every collective that runs per-layer stays on ICI). Device
+order groups each replica's devices contiguously (slice index, then
+process, then local id), so the ``dcn`` axis boundary IS the slice
+boundary. :func:`build_mesh_from_env` picks the right constructor from
+the env contract alone.
 """
 
 from __future__ import annotations
 
+import json
 import math
-from typing import Optional
+import os
+from typing import Any, Mapping, Optional
+
+#: the outer (slower, data-center network) mesh axis of a two-level mesh
+DCN_AXIS = "dcn"
 
 
-def build_mesh(axes: Optional[dict[str, int]] = None):
+def _device_order_key(d: Any) -> tuple[int, int, int]:
+    """Canonical device order: slice, then process, then local id —
+    reshaping this order into (dcn, *ici) makes each dcn row exactly one
+    slice's devices. CPU-faked devices (no slice_index) all land in
+    slice 0 and split by position, which is what the numeric-parity
+    tests emulate."""
+    return (
+        int(getattr(d, "slice_index", 0) or 0),
+        int(getattr(d, "process_index", 0) or 0),
+        int(getattr(d, "id", 0) or 0),
+    )
+
+
+def _resolve_axes(
+    axes: Mapping[str, int], n: int
+) -> tuple[list[str], list[int]]:
+    """Validate explicit axes against ``n`` devices.
+
+    Single-axis grants keep the convenience fill (axis scales up to
+    absorb all devices). Multi-axis grants are EXPLICIT: sizes are
+    honored verbatim — a product that exceeds ``n`` or does not divide
+    it fails loudly instead of silently resizing the first axis (the
+    implicit fill turned {"data": 1, "model": 4} on 8 devices into
+    data=2, doubling the batch shards a replica thought it had).
+    """
+    names = list(axes.keys())
+    sizes = [max(1, int(axes[a])) for a in names]
+    prod = math.prod(sizes)
+    if len(sizes) == 1 and prod < n and n % prod == 0:
+        sizes[0] = n  # convenience fill: one axis over everything
+        prod = n
+    if prod > n:
+        raise ValueError(
+            f"mesh axes {dict(zip(names, sizes))} need {prod} devices, "
+            f"have {n}"
+        )
+    if prod < n and n % prod != 0:
+        raise ValueError(
+            f"mesh axes {dict(zip(names, sizes))} cover {prod} devices "
+            f"which does not divide the {n} available — explicit "
+            f"multi-axis grants must divide the device count (pass "
+            f"axes=None or a single axis for the implicit fill)"
+        )
+    return names, sizes
+
+
+def build_mesh(axes: Optional[dict[str, int]] = None, devices=None):
     """Build a Mesh over local devices.
 
-    ``axes`` maps logical axis name -> size (e.g. {"data": 2, "model": 4});
-    sizes must multiply to a divisor of the device count. A trailing
-    implicit fill: if the product is smaller than the device count, the
-    FIRST axis is scaled up to absorb remaining devices (so {"data": 1,
-    "model": 4} on 8 devices becomes data=2).
-    None -> 1-D mesh over all devices on axis "data".
+    ``axes`` maps logical axis name -> size (e.g. {"data": 2, "model":
+    4}). ``None`` -> 1-D mesh over all devices on axis "data"; a single
+    axis scales up to absorb all devices (convenience fill). Explicit
+    multi-axis grants are honored verbatim: when their product is
+    smaller than (but divides) the device count, the mesh shrinks to a
+    prefix of devices (single-host dev run of a smaller grant); a
+    non-dividing product fails loudly — the seed's silent first-axis
+    fill mis-sized such grants.
     """
     import jax
     from jax.sharding import Mesh
     import numpy as np
 
-    devices = jax.devices()
+    if devices is None:
+        devices = list(jax.devices())
     n = len(devices)
     if not axes:
         return Mesh(np.array(devices), ("data",))
-    names = list(axes.keys())
-    sizes = [max(1, int(axes[a])) for a in names]
+    names, sizes = _resolve_axes(axes, n)
     prod = math.prod(sizes)
-    if prod < n and n % prod == 0:
-        sizes[0] *= n // prod
-        prod = math.prod(sizes)
-    if prod != n:
-        # grant smaller than the visible device set (single-host dev run):
-        # shrink to a prefix of devices so the logical shape is honored
-        if prod < n:
-            devices = devices[:prod]
-        else:
-            raise ValueError(
-                f"mesh axes {dict(zip(names, sizes))} need {prod} devices, "
-                f"have {n}"
-            )
+    if prod < n:
+        # grant smaller than the visible device set (single-host dev
+        # run): shrink to a prefix of devices, honor the logical shape
+        devices = devices[:prod]
     grid = np.array(devices).reshape(sizes)
     return Mesh(grid, tuple(names))
+
+
+def build_two_level_mesh(
+    replicas: int,
+    ici_axes: Optional[dict[str, int]] = None,
+    devices=None,
+):
+    """Two-level ``dcn`` x ICI mesh for a spanning gang.
+
+    ``replicas`` is the DCN axis size (one per member grant / pool);
+    ``ici_axes`` are the per-replica inner axes (e.g. {"data": 1,
+    "model": 4}; ``None`` -> one "data" axis over each replica's full
+    device share). Devices are ordered slice-major so each ``dcn`` row
+    is one slice's devices — the inner collectives never cross a slice
+    boundary, the outer psum crosses exactly once.
+    """
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    replicas = int(replicas)
+    if replicas < 1:
+        raise ValueError(f"replicas must be >= 1, got {replicas}")
+    if devices is None:
+        devices = sorted(jax.devices(), key=_device_order_key)
+    n = len(devices)
+    if n % replicas != 0:
+        raise ValueError(
+            f"{n} devices do not divide over {replicas} DCN replicas"
+        )
+    per = n // replicas
+    if not ici_axes:
+        names, sizes = ["data"], [per]
+    else:
+        names, sizes = _resolve_axes(ici_axes, per)
+        if DCN_AXIS in names:
+            raise ValueError(
+                f"ici_axes must not contain the reserved {DCN_AXIS!r} axis"
+            )
+    prod = math.prod(sizes)
+    if prod < per:
+        # grant smaller than each replica's visible share: take a prefix
+        # of every replica's chunk so the logical shape is honored
+        devices = [
+            d
+            for r in range(replicas)
+            for d in devices[r * per : r * per + prod]
+        ]
+    grid = np.array(devices).reshape([replicas, *sizes])
+    return Mesh(grid, (DCN_AXIS, *names))
+
+
+def span_facts(environ: Optional[Mapping[str, str]] = None) -> dict[str, Any]:
+    """Decode the multi-grant half of the env contract (one shape for
+    every consumer — the engram SDK, build_mesh_from_env, and tests
+    must not re-parse these fields independently)."""
+    from ..sdk import contract
+
+    env = os.environ if environ is None else environ
+    raw_axes = env.get(contract.ENV_MESH_AXES)
+    axes = None
+    if raw_axes:
+        try:
+            parsed = json.loads(raw_axes)
+            if isinstance(parsed, dict):
+                axes = {str(k): int(v) for k, v in parsed.items()}
+        except (ValueError, TypeError):
+            axes = None
+
+    def _int(key: str, default: int) -> int:
+        try:
+            return int(env.get(key, "") or default)
+        except ValueError:
+            return default
+
+    return {
+        "replicas": max(1, _int(contract.ENV_DCN_REPLICAS, 1)),
+        "replica": _int(contract.ENV_DCN_REPLICA_INDEX, 0),
+        "span_id": env.get(contract.ENV_SPAN_ID) or None,
+        "processes": _int(contract.ENV_SPAN_PROCESSES, 0),
+        "process_base": _int(contract.ENV_SPAN_PROCESS_BASE, 0),
+        "coordinator": env.get(contract.ENV_COORDINATOR_ADDRESS) or None,
+        "mesh_axes": axes,
+    }
+
+
+def build_mesh_from_env(environ: Optional[Mapping[str, str]] = None):
+    """The engram-side mesh constructor driven purely by the env
+    contract: a spanning gang (``BOBRA_DCN_REPLICAS`` > 1) yields the
+    two-level ``dcn`` x ICI mesh; a classic grant yields the flat mesh
+    from ``BOBRA_MESH_AXES``. Engrams that call this never hardcode a
+    topology — the operator's grant IS the mesh."""
+    facts = span_facts(environ)
+    if facts["replicas"] > 1:
+        return build_two_level_mesh(facts["replicas"], facts["mesh_axes"])
+    return build_mesh(facts["mesh_axes"])
+
+
+def distributed_init_args(
+    environ: Optional[Mapping[str, str]] = None,
+    host_id: Optional[int] = None,
+) -> Optional[dict[str, Any]]:
+    """kwargs for ``jax.distributed.initialize`` on one span member
+    host, derived from the multi-grant env contract; None when the step
+    is not a multi-process gang (single host, no span). The global
+    process id is the member's process base plus the local host id —
+    every host of every replica agrees on ONE coordinator and ONE
+    process count, which is exactly what makes N per-pool gangs one
+    jax job."""
+    from ..sdk import contract
+
+    env = os.environ if environ is None else environ
+    facts = span_facts(environ)
+    if host_id is None:
+        try:
+            host_id = int(env.get(contract.ENV_TPU_HOST_ID, "0") or 0)
+        except ValueError:
+            host_id = 0
+    try:
+        hosts = int(env.get(contract.ENV_TPU_HOSTS, "1") or 1)
+    except ValueError:
+        hosts = 1
+    processes = facts["processes"] or hosts
+    if processes <= 1 or not facts["coordinator"]:
+        return None
+    return {
+        "coordinator_address": facts["coordinator"],
+        "num_processes": processes,
+        "process_id": facts["process_base"] + host_id,
+    }

@@ -43,6 +43,7 @@ import dataclasses
 import itertools
 import threading
 import time
+import uuid
 from typing import Any, Callable, Iterable, Optional, Sequence
 
 from ..observability.metrics import metrics
@@ -85,9 +86,16 @@ class SliceGrant:
     mesh_axes: dict[str, int]
     coordinator_address: Optional[str] = None
     accelerator: Optional[str] = None
+    #: spanning-gang membership (multi-slice DCN data-parallel; None for
+    #: classic single-pool grants): {"id", "replicas", "replica",
+    #: "pools", "coordinator", "processes", "processBase"} — the
+    #: multi-grant half of the env contract, enough for every member to
+    #: run jax.distributed.initialize over ONE process set and build the
+    #: dcn x ICI two-level mesh (parallel/mesh.build_mesh_from_env)
+    span: Optional[dict[str, Any]] = None
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        out = {
             "sliceId": self.slice_id,
             "pool": self.pool,
             "topology": self.topology,
@@ -97,6 +105,9 @@ class SliceGrant:
             "coordinatorAddress": self.coordinator_address,
             "accelerator": self.accelerator,
         }
+        if self.span:
+            out["span"] = dict(self.span)
+        return out
 
 
 class PlacementError(Exception):
@@ -700,6 +711,47 @@ def _cells(origin: tuple[int, ...], shape: tuple[int, ...]):
     return itertools.product(*[range(o, o + s) for o, s in zip(origin, shape)])
 
 
+def _shape_fits(
+    pool: "SlicePool", topology: Optional[str], chips: Optional[int]
+) -> bool:
+    """Whether the request could EVER fit the pool's dims (ignores
+    occupancy — this separates permanent spec errors from NoCapacity)."""
+    try:
+        pool._resolve_shape(topology, chips)
+        return True
+    except PlacementError:
+        return False
+
+
+def _stamp_span(grants: Sequence["SliceGrant"], pools: list[str]) -> None:
+    """Attach spanning-gang metadata to every member grant (in member
+    order). The process layout is derived from final host counts:
+    member i's worker h is global process ``processBase_i + h`` of
+    ``processes`` total — exactly what jax.distributed.initialize needs
+    on every host of the span. The coordinator is MEMBER 0's pool
+    coordinator and nothing else — global process 0 (the process that
+    binds the jax coordinator service) lives on member 0, so
+    substituting another member's address would point every host at a
+    machine where no coordinator ever listens. None when member 0's
+    pool declares no host addresses (the GKE materializer then derives
+    a span-scoped coordinator Service from the span id instead)."""
+    span_id = f"span-{uuid.uuid4().hex[:10]}"
+    total = sum(g.hosts for g in grants)
+    coordinator = grants[0].coordinator_address
+    base = 0
+    for i, g in enumerate(grants):
+        g.span = {
+            "id": span_id,
+            "replicas": len(grants),
+            "replica": i,
+            "pools": list(pools),
+            "coordinator": coordinator,
+            "processes": total,
+            "processBase": base,
+        }
+        base += g.hosts
+
+
 class BruteForceReference:
     """The seed allocator's scan semantics, retained verbatim as the
     equivalence oracle: per-cell set probes over every candidate origin.
@@ -759,7 +811,10 @@ class SlicePlacer:
 
     Queues map to pools (SURVEY §2.6 'queues become TPU-slice pools'): a
     step scheduled on queue Q is placed on pool Q when one exists,
-    falling back to the default pool.
+    falling back to the default pool. A gang may also SPAN pools
+    (:meth:`place_group` with ``pools=``): one grant group across
+    multiple slices, per-pool ICI-contiguous super-blocks, DCN between
+    them — the standard multi-slice TPU shape.
     """
 
     def __init__(self, pools: Optional[list[SlicePool]] = None):
@@ -826,12 +881,27 @@ class SlicePlacer:
         self,
         requests: Sequence[tuple[str, Any]],  # (name, TPUPolicy | None)
         queue: Optional[str] = None,
+        pools: Optional[Sequence[str]] = None,
+        spill: bool = True,
     ) -> dict[str, Optional[SliceGrant]]:
         """Place a `parallel` fan-out's branches in one batched gang
         pass: every TPU branch gets a grant or NoCapacity is raised and
-        the pool is untouched (all-or-nothing — the seed placed branches
-        one by one and could strand a partial gang when a later sibling
-        hit capacity). Branches without TPU needs map to None."""
+        every pool is untouched (all-or-nothing — the seed placed
+        branches one by one and could strand a partial gang when a later
+        sibling hit capacity). Branches without TPU needs map to None.
+
+        ``pools`` turns the gang into a SPANNING grant: members are
+        distributed round-robin across the named pools (balanced — the
+        DCN data-parallel shape wants equal replicas per slice), each
+        pool's members placed as one ICI-contiguous super-block via
+        :meth:`SlicePool.allocate_many`, and a :class:`NoCapacity` from
+        ANY pool releases every sibling already placed (atomic across
+        pools). When the balanced distribution does not fit and
+        ``spill`` is true, a greedy first-fit pass may pack members
+        unevenly before giving up. Every member's grant carries ``span``
+        metadata (group id, replica index/count, global process layout,
+        one coordinator) — the multi-grant env contract.
+        """
         names = [name for name, _ in requests]
         if len(set(names)) != len(names):
             # results key by name: a duplicate would silently shadow its
@@ -847,6 +917,18 @@ class SlicePlacer:
         ]
         if not placeable:
             return out
+        if pools:
+            grants = self._place_spanning(placeable, list(pools), spill)
+            applied = [
+                self._apply_policy(grant, pol)
+                for (_name, pol), grant in zip(placeable, grants)
+            ]
+            # span process layout AFTER policy application: hosts may be
+            # pinned by the policy, and process ids derive from hosts
+            _stamp_span(applied, [str(p) for p in pools])
+            for (name, _pol), grant in zip(placeable, applied):
+                out[name] = grant
+            return out
         pool = self._pool_for(queue)
         grants = pool.allocate_many(
             [(pol.topology, pol.chips) for _name, pol in placeable]
@@ -854,6 +936,137 @@ class SlicePlacer:
         for (name, pol), grant in zip(placeable, grants):
             out[name] = self._apply_policy(grant, pol)
         return out
+
+    def _span_pool(self, name: str) -> SlicePool:
+        pool = self._pools.get(name)
+        if pool is None:
+            raise PlacementError(f"unknown span pool {name!r}")
+        if self.cordon_source is not None:
+            pool.set_cordoned(self.cordon_source(pool.name))
+        return pool
+
+    def _place_spanning(
+        self,
+        placeable: Sequence[tuple[str, Any]],
+        pool_names: list[str],
+        spill: bool,
+    ) -> list[SliceGrant]:
+        """One gang across multiple pools, all-or-nothing. Pool locks
+        are only ever taken one at a time (allocate_many per pool), so
+        the cross-pool pass cannot deadlock; atomicity is rollback, not
+        a global lock."""
+        t0 = time.perf_counter()
+        resolved = [self._span_pool(n) for n in pool_names]
+        reqs = [(pol.topology, pol.chips) for _n, pol in placeable]
+        for t, c in reqs:
+            # a request no pool's topology can EVER hold is a permanent
+            # spec error, not a transient NoCapacity park
+            if not any(_shape_fits(p, t, c) for p in resolved):
+                raise PlacementError(
+                    f"request (topology={t}, chips={c}) exceeds every span "
+                    f"pool topology {[p.topology for p in resolved]}"
+                )
+        # balanced round-robin first: member i -> pool i % P (equal
+        # replicas per slice is the shape DCN data-parallel wants)
+        assignment = [i % len(resolved) for i in range(len(reqs))]
+        grants, misfit = self._try_span_assignment(reqs, resolved, assignment)
+        if grants is None and misfit and not (spill and len(resolved) > 1):
+            # the round-robin routed a shape to a pool that can NEVER
+            # hold it and spill is off: no release/decay will ever
+            # clear this — a permanent spec error, not a capacity park
+            raise PlacementError(
+                f"balanced distribution routes a request to a span pool "
+                f"too small for it and scheduling.span-spill is off "
+                f"(pools {[p.topology for p in resolved]})"
+            )
+        if grants is None and spill and len(resolved) > 1:
+            # greedy spill: pack members first-fit, possibly unevenly —
+            # admissibility on a fragmented fleet beats balance
+            grants = self._greedy_span(reqs, resolved)
+        if grants is None:
+            metrics.slice_placements.inc("no-capacity")
+            hints = "; ".join(
+                f"pool {p.name}: {p.schedulable_chips()} schedulable, "
+                f"largest free block {p.largest_free_block()} chips"
+                for p in resolved
+            )
+            raise NoCapacity(
+                f"spanning gang of {len(reqs)} blocks does not fit across "
+                f"pools {[p.name for p in resolved]} ({hints})"
+            )
+        metrics.slice_placement_seconds.observe(time.perf_counter() - t0, "span")
+        return grants
+
+    def _try_span_assignment(
+        self,
+        reqs: list[tuple[Optional[str], Optional[int]]],
+        pools: list[SlicePool],
+        assignment: list[int],
+    ) -> tuple[Optional[list[SliceGrant]], bool]:
+        """Place members under a fixed member->pool assignment; one
+        allocate_many per pool (same-pool siblings super-block). Any
+        pool's NoCapacity rolls every already-placed pool back.
+        Returns (grants, misfit): ``misfit`` marks a PERMANENT failure
+        (a shape routed to a pool too small for it — spill may still
+        fit it; pre-validation guarantees SOME pool can) as opposed to
+        a transient capacity shortfall."""
+        placed: list[Optional[SliceGrant]] = [None] * len(reqs)
+        done: list[SliceGrant] = []
+        misfit = False
+        try:
+            for pi, pool in enumerate(pools):
+                members = [i for i, a in enumerate(assignment) if a == pi]
+                if not members:
+                    continue
+                gs = pool.allocate_many(
+                    [reqs[i] for i in members], op="span-pool"
+                )
+                done.extend(gs)
+                for i, g in zip(members, gs):
+                    placed[i] = g
+        except NoCapacity:
+            for g in done:
+                self._pools[g.pool].release(g.slice_id)
+            return None, False
+        except PlacementError:
+            misfit = True
+            for g in done:
+                self._pools[g.pool].release(g.slice_id)
+            return None, True
+        return placed, misfit  # type: ignore[return-value]
+
+    def _greedy_span(
+        self,
+        reqs: list[tuple[Optional[str], Optional[int]]],
+        pools: list[SlicePool],
+    ) -> Optional[list[SliceGrant]]:
+        """First-fit-decreasing fallback: members are packed largest
+        first (a big block placed late is the classic first-fit
+        failure), each taking the first pool (in declaration order)
+        with a free block. All-or-nothing: a member no pool can hold
+        releases everything."""
+
+        def _vol(req: tuple[Optional[str], Optional[int]]) -> int:
+            topology, chips = req
+            if topology:
+                return _volume(parse_topology(topology))
+            return int(chips or 1)
+
+        order = sorted(range(len(reqs)), key=lambda i: -_vol(reqs[i]))
+        placed: list[Optional[SliceGrant]] = [None] * len(reqs)
+        for i in order:
+            for pool in pools:
+                try:
+                    placed[i] = pool.allocate_many([reqs[i]], op="span-pool")[0]
+                    break
+                except (NoCapacity, PlacementError):
+                    continue
+            if placed[i] is None:
+                for g in placed:
+                    if g is not None:
+                        self._pools[g.pool].release(g.slice_id)
+                return None
+        return placed  # type: ignore[return-value]
 
     def release(self, grant_dict: dict[str, Any]) -> None:
         pool = self._pools.get(grant_dict.get("pool", ""))

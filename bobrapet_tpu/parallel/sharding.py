@@ -28,6 +28,16 @@ FSDP_AXIS = "fsdp"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
+#: outer axis of a two-level (multi-slice) mesh: pure data parallelism
+#: over the data-center network. Parameters never name it (replicated
+#: per slice), activations put batch on it — so the ONLY collective
+#: that crosses the slice boundary is the once-per-step gradient psum,
+#: while every per-layer TP/FSDP collective stays on ICI. This is the
+#: standard multi-slice TPU sharding shape (mesh.build_two_level_mesh).
+DCN_AXIS = "dcn"
+
+#: every axis activations may shard batch over, in mesh-major order
+BATCH_AXES = (DCN_AXIS, DATA_AXIS, FSDP_AXIS)
 
 
 def _axes_in(mesh: Mesh) -> set[str]:
@@ -167,10 +177,13 @@ def param_shardings(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
 
 
 def activation_spec(mesh: Mesh, sequence_sharded: bool = False) -> P:
-    """[B, S, ...] activations: batch on data(+fsdp), seq optionally on seq."""
+    """[B, S, ...] activations: batch on dcn+data(+fsdp), seq optionally
+    on seq. On a two-level mesh the ``dcn`` component makes the batch
+    split across slices; meshes without the axis are unaffected
+    (``_p`` drops absent axes)."""
     return _p(
         mesh,
-        (DATA_AXIS, FSDP_AXIS),
+        BATCH_AXES,
         SEQ_AXIS if sequence_sharded else None,
     )
 

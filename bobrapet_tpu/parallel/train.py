@@ -19,8 +19,7 @@ from ..models.llama import LlamaConfig, forward, init_params
 from .ring_attention import make_ring_attn_fn
 from .ulysses import make_ulysses_attn_fn
 from .sharding import (
-    DATA_AXIS,
-    FSDP_AXIS,
+    BATCH_AXES,
     SEQ_AXIS,
     shard_params,
     token_sharding,
@@ -72,8 +71,12 @@ def make_train_step(
         if use_ring_attention is not None
         else (SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1)
     )
+    # dcn included: on a two-level (multi-slice) mesh the batch is
+    # data-parallel across slices — the gradient psum over dcn is the
+    # one collective that rides the data-center network; params never
+    # shard on dcn, so per-layer collectives stay on ICI
     batch_axes = tuple(
-        a for a in (DATA_AXIS, FSDP_AXIS) if a in mesh.axis_names and mesh.shape[a] > 1
+        a for a in BATCH_AXES if a in mesh.axis_names and mesh.shape[a] > 1
     )
     if not ring:
         attn_fn = None
@@ -125,6 +128,28 @@ def make_train_step(
     # can assert the seq axis is genuinely exercised, not just declared
     train_step.ring_active = ring
     return train_step
+
+
+def make_multislice_train_step(
+    cfg: LlamaConfig,
+    replicas: int,
+    ici_axes: Optional[dict[str, int]] = None,
+    devices=None,
+    **kwargs,
+) -> tuple[Mesh, Callable]:
+    """The multi-slice training config: batch data-parallel over the
+    ``dcn`` outer axis, model over the granted ICI axes. Builds the
+    two-level mesh (one ``dcn`` row per span replica) and the train
+    step over it; everything else — sharded init, token batches —
+    takes the returned mesh through the standard helpers, so the
+    single-slice and multi-slice paths share every line of math (the
+    numeric-parity suite pins them equal). CPU-fakeable: with
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` the same
+    code runs the full two-level collective schedule on one host."""
+    from .mesh import build_two_level_mesh
+
+    mesh = build_two_level_mesh(replicas, ici_axes, devices=devices)
+    return mesh, make_train_step(cfg, mesh, **kwargs)
 
 
 def init_sharded_train_state(

@@ -119,19 +119,35 @@ class EngramContext:
     def tpu_topology(self) -> Optional[str]:
         return self.env.get(contract.ENV_TPU_TOPOLOGY)
 
+    @property
+    def dcn_replicas(self) -> int:
+        """DCN replica count of the spanning gang this step is one
+        member of (1 = classic single-slice grant)."""
+        from ..parallel.mesh import span_facts
+
+        return span_facts(self.env)["replicas"]
+
+    @property
+    def dcn_replica_index(self) -> int:
+        from ..parallel.mesh import span_facts
+
+        return span_facts(self.env)["replica"]
+
     def initialize_distributed(self) -> None:
         """Run jax.distributed.initialize from granted coordinator env —
         ICI replaces NCCL (SURVEY §5.8 TPU-native equivalent). No-op for
-        single-host grants."""
-        if self.num_hosts <= 1 or self.coordinator_address is None:
+        single-host grants. A SPANNING gang member initializes over the
+        span's GLOBAL process set (every host of every per-pool member,
+        one coordinator) so N per-pool gangs form ONE jax job — the
+        two-level-mesh contract (parallel/mesh.distributed_init_args)."""
+        from ..parallel.mesh import distributed_init_args
+
+        args = distributed_init_args(self.env, host_id=self.host_id)
+        if args is None:
             return
         import jax
 
-        jax.distributed.initialize(
-            coordinator_address=self.coordinator_address,
-            num_processes=self.num_hosts,
-            process_id=self.host_id,
-        )
+        jax.distributed.initialize(**args)
 
     @property
     def storage(self):
@@ -141,10 +157,16 @@ class EngramContext:
 
     def mesh(self, axes: Optional[dict[str, int]] = None):
         """Build the granted jax.sharding.Mesh (local devices reshaped to
-        the granted logical axes)."""
-        from ..parallel.mesh import build_mesh
+        the granted logical axes). A spanning-gang member builds the
+        two-level ``dcn`` x ICI mesh — the granted ICI axes are the
+        inner level, the span's replica count the outer."""
+        from ..parallel.mesh import build_mesh, build_two_level_mesh
 
-        return build_mesh(axes or self.mesh_axes or None)
+        ici = axes or self.mesh_axes or None
+        replicas = self.dcn_replicas
+        if replicas > 1:
+            return build_two_level_mesh(replicas, ici)
+        return build_mesh(ici)
 
     # -- data --------------------------------------------------------------
 

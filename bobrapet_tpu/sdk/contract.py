@@ -72,6 +72,19 @@ ENV_SLICE_ID = "BOBRA_SLICE_ID"  # granted ICI-contiguous sub-mesh id
 ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
 ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
 
+# multi-grant (spanning gang) contract: a `parallel` step with a
+# replicas/span policy fans one logical step out as N per-pool gang
+# members; each member's env carries its replica identity plus the
+# span-global process layout so every host of every member initializes
+# jax.distributed over ONE process set and builds the two-level
+# dcn x ICI mesh (parallel/mesh.build_mesh_from_env). TPU-native
+# addition; no reference counterpart.
+ENV_DCN_REPLICAS = "BOBRA_DCN_REPLICAS"  # DCN axis size (span member count)
+ENV_DCN_REPLICA_INDEX = "BOBRA_DCN_REPLICA_INDEX"  # this member's index
+ENV_SPAN_ID = "BOBRA_SPAN_ID"  # spanning-grant group id
+ENV_SPAN_PROCESSES = "BOBRA_SPAN_PROCESSES"  # global process count
+ENV_SPAN_PROCESS_BASE = "BOBRA_SPAN_PROCESS_BASE"  # first global pid here
+
 # checkpoint-resume contract (fleet preemption recovery; TPU-native
 # addition). The operator always exports the step's canonical checkpoint
 # prefix; after a preemption redrive it also exports the latest complete
@@ -123,6 +136,7 @@ def build_env(
     checkpoint_prefix: Optional[str] = None,
     resume_step: Optional[int] = None,
     preemption_attempt: int = 0,
+    span: Optional[dict[str, Any]] = None,
 ) -> dict[str, str]:
     """Render the per-step env contract (host-independent portion).
 
@@ -173,6 +187,34 @@ def build_env(
         env[ENV_RESUME_STEP] = str(int(resume_step))
     if preemption_attempt:
         env[ENV_PREEMPTION_ATTEMPT] = str(int(preemption_attempt))
+    if span:
+        # spanning-gang membership (SliceGrant.span): replica identity +
+        # the global process layout. The span coordinator (member 0's
+        # pool) overrides any per-pool coordinator already set — every
+        # member of the span must dial ONE address
+        env.update(span_env(span))
+        if span.get("coordinator"):
+            env[ENV_COORDINATOR_ADDRESS] = str(span["coordinator"])
+    return env
+
+
+def span_env(span: dict[str, Any]) -> dict[str, str]:
+    """Render the spanning-gang membership fields (replica identity +
+    global process layout) — the ONE renderer both :func:`build_env`
+    and the GKE materializer use, so the two emission paths cannot
+    drift. Coordinator handling stays with the caller: the runtime
+    path trusts the span's recorded address verbatim, the GKE path
+    normalizes ports and can derive a span-scoped coordinator Service
+    when placement recorded none."""
+    env = {
+        ENV_DCN_REPLICAS: str(int(span.get("replicas") or 1)),
+        ENV_DCN_REPLICA_INDEX: str(int(span.get("replica") or 0)),
+        ENV_SPAN_PROCESS_BASE: str(int(span.get("processBase") or 0)),
+    }
+    if span.get("id"):
+        env[ENV_SPAN_ID] = str(span["id"])
+    if span.get("processes"):
+        env[ENV_SPAN_PROCESSES] = str(int(span["processes"]))
     return env
 
 

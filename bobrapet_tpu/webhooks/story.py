@@ -239,8 +239,58 @@ class StoryWebhook:
                 self._check_execute_story_cycle(errs, resource, ref, p)
         elif t is StepType.PARALLEL:
             branches = w.get("steps")
-            if not isinstance(branches, list) or not branches:
-                errs.add(p + ".with.steps", "parallel requires a non-empty `steps` list")
+            replicated = w.get("replicas") is not None or isinstance(
+                w.get("step"), dict
+            )
+            if replicated and isinstance(branches, list) and branches:
+                errs.add(
+                    p + ".with",
+                    "parallel takes either `steps` or `replicas`+`step`, "
+                    "not both",
+                )
+            elif replicated and nested:
+                # same rule as the explicit spelling — a replicated
+                # fan-out nested inside another parallel would only
+                # fail at execution time otherwise
+                errs.add(p + ".with",
+                         "parallel branches cannot nest another parallel")
+            elif replicated:
+                try:
+                    n = int(w.get("replicas") or 0)
+                except (TypeError, ValueError):
+                    n = 0
+                if n < 1:
+                    errs.add(p + ".with.replicas",
+                             "replicas must be an integer >= 1")
+                if not isinstance(w.get("step"), dict):
+                    errs.add(p + ".with.step",
+                             "replicas fan-out requires a `step` template")
+                pools = w.get("pools")
+                if pools is not None and not (
+                    isinstance(pools, list)
+                    and pools
+                    and all(isinstance(x, str) and x for x in pools)
+                ):
+                    errs.add(p + ".with.pools",
+                             "must be a non-empty list of pool names")
+                if n >= 1 and isinstance(w.get("step"), dict):
+                    try:
+                        from ..api.story import expand_parallel_branches
+
+                        parsed = expand_parallel_branches(step)
+                    except Exception as e:  # noqa: BLE001
+                        errs.add(p + ".with.step", f"malformed template: {e}")
+                    else:
+                        self._validate_steps(
+                            errs, resource, spec, parsed[:1], p + ".with.step",
+                            realtime, nested=True,
+                        )
+            elif not isinstance(branches, list) or not branches:
+                errs.add(
+                    p + ".with.steps",
+                    "parallel requires a non-empty `steps` list (or "
+                    "`replicas`+`step` for a spanning fan-out)",
+                )
             elif nested:
                 errs.add(p + ".with.steps", "parallel branches cannot nest another parallel")
             else:

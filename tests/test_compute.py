@@ -156,8 +156,15 @@ class TestSharding:
     def test_build_mesh_axes(self):
         mesh = build_mesh({"data": 2, "model": 4})
         assert mesh.shape == {"data": 2, "model": 4}
-        mesh2 = build_mesh({"data": 1, "model": 4})  # first axis absorbs
-        assert mesh2.shape == {"data": 2, "model": 4}
+        # explicit multi-axis grants are honored verbatim now (the old
+        # implicit first-axis fill silently doubled the data axis — the
+        # mis-sizing the build_mesh hardening removed); the smaller
+        # grant shrinks to a device prefix instead
+        mesh2 = build_mesh({"data": 1, "model": 4})
+        assert mesh2.shape == {"data": 1, "model": 4}
+        # the single-axis convenience fill is kept
+        mesh3 = build_mesh({"data": 1})
+        assert mesh3.shape == {"data": 8}
 
     def test_sharded_forward_matches_single_device(self):
         cfg = llama_tiny()

@@ -730,3 +730,102 @@ class TestTPUPlacement:
         assert (max(xs) - min(xs) + 1) * (max(ys) - min(ys) + 1) == 16
         # all four released on completion
         assert rt.placer.pool("v5e").free_chips() == 16
+
+    def test_replicas_fanout_spans_pools(self, rt):
+        """The multi-slice shape end to end: a `parallel` step with a
+        replicas/step policy fans one logical step out as one SPANNING
+        grant across two pools — each replica on its own pool's
+        ICI-contiguous block, every member env carrying its DCN replica
+        identity plus ONE span-global coordinator/process layout (what
+        jax.distributed needs to fuse the gangs into one job)."""
+        from bobrapet_tpu.parallel.placement import SlicePool
+
+        rt.placer.add_pool(SlicePool(
+            "pool-a", "4x4", chips_per_host=4,
+            host_addresses=["a-h0:8476", "a-h1:8476"],
+        ))
+        rt.placer.add_pool(SlicePool(
+            "pool-b", "4x4", chips_per_host=4,
+            host_addresses=["b-h0:8476", "b-h1:8476"],
+        ))
+        ep = setup_engram(rt)
+        seen = {}
+
+        @register_engram(ep)
+        def impl(ctx):
+            from bobrapet_tpu.parallel.mesh import distributed_init_args
+
+            if not ctx.is_coordinator:
+                return {}
+            seen[ctx.step] = {
+                "replicas": ctx.dcn_replicas,
+                "replica": ctx.dcn_replica_index,
+                "coordinator": ctx.coordinator_address,
+                "init": distributed_init_args(ctx.env, host_id=ctx.host_id),
+            }
+            return {}
+
+        rt.apply(make_story("multislice", steps=[
+            {"name": "train", "type": "parallel", "with": {
+                "replicas": 2,
+                "pools": ["pool-a", "pool-b"],
+                "step": {"name": "rep", "ref": {"name": "worker"},
+                         "tpu": {"topology": "2x4",
+                                 "meshAxes": {"data": 1, "model": 8}}},
+            }},
+        ]))
+        run = rt.run_story("multislice")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert set(seen) == {"rep-r0", "rep-r1"}
+        # both members agree on the span: 2 replicas, distinct indices,
+        # ONE coordinator, and a global process set of 4 (2 hosts each)
+        assert {v["replica"] for v in seen.values()} == {0, 1}
+        assert all(v["replicas"] == 2 for v in seen.values())
+        coords = {v["coordinator"] for v in seen.values()}
+        assert coords == {"a-h0:8476"}
+        inits = sorted(
+            (v["init"]["process_id"], v["init"]["num_processes"])
+            for v in seen.values()
+        )
+        # host 0 of each member: process ids 0 and 2 of 4
+        assert inits == [(0, 4), (2, 4)]
+        # one replica per pool, both released on completion
+        srs = [sr for sr in rt.store.list("StepRun")
+               if sr.spec.get("sliceGrant")]
+        pools = sorted(sr.spec["sliceGrant"]["pool"] for sr in srs)
+        assert pools == ["pool-a", "pool-b"]
+        spans = {sr.spec["sliceGrant"]["span"]["id"] for sr in srs}
+        assert len(spans) == 1
+        assert rt.placer.pool("pool-a").free_chips() == 16
+        assert rt.placer.pool("pool-b").free_chips() == 16
+
+    def test_replicas_fanout_without_pools_spans_queue_pool(self, rt):
+        """No `pools` and no scheduling.span-pools: the replicas
+        spelling still means ONE data-parallel job — both members land
+        on the queue's pool WITH span metadata (N independent
+        full-workload copies would be a silent 2x waste)."""
+        from bobrapet_tpu.parallel.placement import SlicePool
+
+        rt.placer.add_pool(SlicePool("v5e", "4x4", chips_per_host=4))
+        ep = setup_engram(rt)
+        seen = {}
+
+        @register_engram(ep)
+        def impl(ctx):
+            if ctx.is_coordinator:
+                seen[ctx.step] = (ctx.dcn_replicas, ctx.dcn_replica_index)
+            return {}
+
+        rt.apply(make_story("ms-onepool", steps=[
+            {"name": "train", "type": "parallel", "with": {
+                "replicas": 2,
+                "step": {"name": "rep", "ref": {"name": "worker"},
+                         "tpu": {"topology": "2x2"}},
+            }},
+        ], policy={"queue": "v5e"}))
+        run = rt.run_story("ms-onepool")
+        rt.pump()
+        assert rt.run_phase(run) == "Succeeded"
+        assert seen == {"rep-r0": (2, 0), "rep-r1": (2, 1)}
+        assert rt.placer.pool("v5e").free_chips() == 16

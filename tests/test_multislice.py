@@ -1,0 +1,339 @@
+"""Multi-slice hierarchical parallelism (two-level dcn x ICI mesh).
+
+Covers the multi-grant env contract decoders, the hardened build_mesh
+axis rules, two-level mesh construction on the CPU-faked 8-device
+backend, and the numeric-parity pin: a DCN-data-parallel x
+ICI-model-parallel train step must be byte-for-step equivalent (to fp
+tolerance) to the single-mesh reference over the same devices.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from bobrapet_tpu.parallel.mesh import (
+    DCN_AXIS,
+    build_mesh,
+    build_mesh_from_env,
+    build_two_level_mesh,
+    distributed_init_args,
+    span_facts,
+)
+from bobrapet_tpu.sdk import contract
+
+
+class TestBuildMeshHardening:
+    def test_explicit_multi_axis_honored_verbatim(self):
+        mesh = build_mesh({"data": 1, "model": 4})
+        assert mesh.shape == {"data": 1, "model": 4}
+
+    def test_non_dividing_multi_axis_fails_loudly(self):
+        # 3*2=6 neither equals nor divides 8 — the seed silently scaled
+        # the first axis; now the grant mis-size is an error
+        with pytest.raises(ValueError, match="does not divide"):
+            build_mesh({"data": 3, "model": 2})
+
+    def test_oversized_axes_fail(self):
+        with pytest.raises(ValueError, match="need"):
+            build_mesh({"data": 4, "model": 4})
+
+    def test_single_axis_fill_kept(self):
+        assert build_mesh({"data": 2}).shape == {"data": 8}
+        assert build_mesh({"model": 1}).shape == {"model": 8}
+
+    def test_none_axes_one_dim_data(self):
+        mesh = build_mesh(None)
+        assert mesh.axis_names == ("data",)
+        assert mesh.shape["data"] == 8
+
+
+class TestTwoLevelMesh:
+    def test_shape_and_axis_order(self):
+        mesh = build_two_level_mesh(2, {"data": 1, "model": 4})
+        assert mesh.axis_names == (DCN_AXIS, "data", "model")
+        assert mesh.shape == {"dcn": 2, "data": 1, "model": 4}
+
+    def test_each_dcn_row_is_one_contiguous_device_chunk(self):
+        devices = list(jax.devices())
+        mesh = build_two_level_mesh(2, {"model": 4})
+        got = [list(np.asarray(mesh.devices[r]).ravel()) for r in range(2)]
+        assert got[0] == devices[:4]
+        assert got[1] == devices[4:]
+
+    def test_non_dividing_replicas_fail(self):
+        with pytest.raises(ValueError, match="do not divide"):
+            build_two_level_mesh(3, None)
+
+    def test_reserved_dcn_axis_rejected(self):
+        with pytest.raises(ValueError, match="reserved"):
+            build_two_level_mesh(2, {"dcn": 2, "model": 2})
+
+    def test_default_ici_axes(self):
+        mesh = build_two_level_mesh(4, None)
+        assert mesh.shape == {"dcn": 4, "data": 2}
+
+    def test_single_axis_fill_applies_per_replica(self):
+        # {"model": 2} is the single-axis convenience grant: it scales
+        # to each replica's full device share, exactly like build_mesh
+        mesh = build_two_level_mesh(2, {"model": 2})
+        assert mesh.shape == {"dcn": 2, "model": 4}
+
+    def test_smaller_grant_takes_a_prefix_of_each_replica_chunk(self):
+        devices = list(jax.devices())
+        # explicit multi-axis grant smaller than the per-replica share:
+        # honored verbatim over a prefix of each replica's chunk
+        mesh = build_two_level_mesh(2, {"data": 1, "model": 2})
+        assert mesh.shape == {"dcn": 2, "data": 1, "model": 2}
+        got = [list(np.asarray(mesh.devices[r]).ravel()) for r in range(2)]
+        assert got[0] == devices[:2]
+        assert got[1] == devices[4:6]
+
+
+class TestEnvContract:
+    def _span_env(self):
+        return {
+            contract.ENV_DCN_REPLICAS: "2",
+            contract.ENV_DCN_REPLICA_INDEX: "1",
+            contract.ENV_SPAN_ID: "span-7",
+            contract.ENV_SPAN_PROCESSES: "4",
+            contract.ENV_SPAN_PROCESS_BASE: "2",
+            contract.ENV_COORDINATOR_ADDRESS: "pool-a-h0:8476",
+            contract.ENV_TPU_HOSTS: "2",
+            contract.ENV_MESH_AXES: json.dumps({"data": 1, "model": 4}),
+        }
+
+    def test_span_facts_roundtrip(self):
+        facts = span_facts(self._span_env())
+        assert facts["replicas"] == 2
+        assert facts["replica"] == 1
+        assert facts["span_id"] == "span-7"
+        assert facts["processes"] == 4
+        assert facts["process_base"] == 2
+        assert facts["coordinator"] == "pool-a-h0:8476"
+        assert facts["mesh_axes"] == {"data": 1, "model": 4}
+
+    def test_build_mesh_from_env_two_level(self):
+        mesh = build_mesh_from_env(self._span_env())
+        assert mesh.shape == {"dcn": 2, "data": 1, "model": 4}
+
+    def test_build_mesh_from_env_flat(self):
+        env = {contract.ENV_MESH_AXES: json.dumps({"data": 2, "model": 4})}
+        assert build_mesh_from_env(env).shape == {"data": 2, "model": 4}
+
+    def test_distributed_init_args_span_member(self):
+        args = distributed_init_args(self._span_env(), host_id=1)
+        assert args == {
+            "coordinator_address": "pool-a-h0:8476",
+            "num_processes": 4,
+            "process_id": 3,  # base 2 + host 1
+        }
+
+    def test_distributed_init_args_single_host_none(self):
+        assert distributed_init_args({}, host_id=0) is None
+
+    def test_distributed_init_args_classic_gang(self):
+        # no span: a plain multi-host gang keeps the old semantics
+        env = {
+            contract.ENV_TPU_HOSTS: "2",
+            contract.ENV_COORDINATOR_ADDRESS: "h0:8476",
+        }
+        args = distributed_init_args(env, host_id=1)
+        assert args == {
+            "coordinator_address": "h0:8476",
+            "num_processes": 2,
+            "process_id": 1,
+        }
+
+    def test_build_env_emits_span_fields(self):
+        env = contract.build_env(
+            namespace="ns", story="s", story_run="r", step="t",
+            step_run="sr",
+            coordinator_address="local-pool-h0:8476",
+            span={
+                "id": "span-3", "replicas": 2, "replica": 1,
+                "processes": 4, "processBase": 2,
+                "coordinator": "pool-a-h0:8476",
+            },
+        )
+        assert env[contract.ENV_DCN_REPLICAS] == "2"
+        assert env[contract.ENV_DCN_REPLICA_INDEX] == "1"
+        assert env[contract.ENV_SPAN_ID] == "span-3"
+        assert env[contract.ENV_SPAN_PROCESSES] == "4"
+        assert env[contract.ENV_SPAN_PROCESS_BASE] == "2"
+        # the span coordinator overrides the per-pool address: every
+        # member of the span must dial ONE coordinator
+        assert env[contract.ENV_COORDINATOR_ADDRESS] == "pool-a-h0:8476"
+
+    def test_build_env_without_span_unchanged(self):
+        env = contract.build_env(
+            namespace="ns", story="s", story_run="r", step="t",
+            step_run="sr", coordinator_address="h0:8476",
+        )
+        assert contract.ENV_DCN_REPLICAS not in env
+        assert env[contract.ENV_COORDINATOR_ADDRESS] == "h0:8476"
+
+
+class TestGKESpanEnv:
+    def test_gang_job_carries_span_env(self):
+        from bobrapet_tpu.gke.materialize import materialize_gang_job
+
+        grant = {
+            "sliceId": "pa-s1", "pool": "pa", "topology": "2x4",
+            "hosts": 2, "origin": [0, 0],
+            "meshAxes": {"data": 1, "model": 8},
+            "span": {"id": "span-9", "replicas": 2, "replica": 1,
+                     "processes": 4, "processBase": 2,
+                     "coordinator": "gang-a-0.gang-a-workers:8476",
+                     "pools": ["pa", "pb"]},
+        }
+        manifests = materialize_gang_job(
+            name="gang-b", namespace="ns", image="img", env={},
+            grant=grant,
+        )
+        job = manifests[-1]
+        env_list = job["spec"]["template"]["spec"]["containers"][0]["env"]
+        env = {e["name"]: e.get("value") for e in env_list}
+        assert env[contract.ENV_DCN_REPLICAS] == "2"
+        assert env[contract.ENV_DCN_REPLICA_INDEX] == "1"
+        assert env[contract.ENV_SPAN_PROCESSES] == "4"
+        assert env[contract.ENV_SPAN_PROCESS_BASE] == "2"
+        # member 0's address wins over this member's own worker-0
+        assert env[contract.ENV_COORDINATOR_ADDRESS] == (
+            "gang-a-0.gang-a-workers:8476"
+        )
+
+    def _span(self, replica):
+        return {"id": "span-abc123", "replicas": 2, "replica": replica,
+                "processes": 4, "processBase": 2 * replica,
+                "coordinator": None, "pools": ["pa", "pb"]}
+
+    def _grant(self, pool, replica):
+        return {
+            "sliceId": f"{pool}-s1", "pool": pool, "topology": "2x4",
+            "hosts": 2, "origin": [0, 0],
+            "meshAxes": {"data": 1, "model": 8},
+            "span": self._span(replica),
+        }
+
+    def test_coordinatorless_span_derives_one_service(self):
+        """Placement on GKE records no coordinator (pool DNS is minted
+        by k8s): every member must dial ONE span-scoped Service name —
+        each member's own worker-0 would split the span into N
+        coordinator groups that all hang — and member 0's manifest
+        ships that Service, selecting exactly its worker-0 pod."""
+        from bobrapet_tpu.gke.materialize import materialize_gang_job
+
+        def env_of(manifests):
+            job = manifests[-1]
+            env_list = job["spec"]["template"]["spec"]["containers"][0]["env"]
+            return {e["name"]: e.get("value") for e in env_list}
+
+        m0 = materialize_gang_job(
+            name="gang-a", namespace="ns", image="img", env={},
+            grant=self._grant("pa", 0),
+        )
+        m1 = materialize_gang_job(
+            name="gang-b", namespace="ns", image="img", env={},
+            grant=self._grant("pb", 1),
+        )
+        want = "span-abc123-coord:8476"
+        assert env_of(m0)[contract.ENV_COORDINATOR_ADDRESS] == want
+        assert env_of(m1)[contract.ENV_COORDINATOR_ADDRESS] == want
+        # exactly member 0 ships the coordinator Service, worker-0 only
+        svcs0 = [m for m in m0 if m["kind"] == "Service"
+                 and m["metadata"]["name"] == "span-abc123-coord"]
+        assert len(svcs0) == 1
+        sel = svcs0[0]["spec"]["selector"]
+        assert sel["bobrapet.io/job"] == "gang-a"
+        assert sel["batch.kubernetes.io/job-completion-index"] == "0"
+        assert not [m for m in m1 if m["kind"] == "Service"
+                    and m["metadata"]["name"] == "span-abc123-coord"]
+
+
+class TestMultisliceNumericParity:
+    """The acceptance pin: DCN-data-parallel x ICI-model-parallel on a
+    CPU-faked two-level mesh is numerically parity-locked against the
+    single-mesh reference — same init, same tokens, same losses and
+    same updated params over several steps. The two meshes partition
+    the batch identically (2-way) and the model identically (4-way);
+    only WHICH axis carries the gradient psum differs (dcn vs data), so
+    any divergence is a sharding bug, not arithmetic noise."""
+
+    def _run(self, mesh, steps=3, same_tokens=False):
+        import optax
+
+        from bobrapet_tpu.models.llama import llama_tiny
+        from bobrapet_tpu.parallel.train import (
+            init_sharded_train_state,
+            make_token_batch,
+            make_train_step,
+        )
+
+        cfg = llama_tiny()
+        # deterministic optimizer (no per-run state beyond moments)
+        opt = optax.adamw(1e-3, weight_decay=0.1)
+        params, opt_state, _ = init_sharded_train_state(
+            jax.random.PRNGKey(0), cfg, mesh, optimizer=opt
+        )
+        step = make_train_step(cfg, mesh, optimizer=opt)
+        losses = []
+        for i in range(steps):
+            tokens = make_token_batch(
+                jax.random.PRNGKey(100 if same_tokens else 100 + i),
+                cfg, batch=4, seq_len=16, mesh=mesh,
+            )
+            params, opt_state, loss = step(params, opt_state, tokens)
+            losses.append(float(loss))
+        return losses, params
+
+    def test_two_level_matches_single_mesh(self):
+        from bobrapet_tpu.models.llama import llama_tiny
+        from bobrapet_tpu.parallel.train import make_multislice_train_step
+
+        two_level, _ = make_multislice_train_step(
+            llama_tiny(), replicas=2, ici_axes={"model": 4}
+        )
+        assert two_level.shape == {"dcn": 2, "model": 4}
+        reference = build_mesh({"data": 2, "model": 4})
+
+        losses_a, params_a = self._run(two_level)
+        losses_b, params_b = self._run(reference)
+        np.testing.assert_allclose(losses_a, losses_b, rtol=2e-4)
+        flat_a = jax.tree_util.tree_leaves(params_a)
+        flat_b = jax.tree_util.tree_leaves(params_b)
+        assert len(flat_a) == len(flat_b)
+        for a, b in zip(flat_a, flat_b):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
+            )
+
+    def test_loss_decreases_on_two_level_mesh(self):
+        mesh = build_two_level_mesh(2, {"model": 2})
+        losses, _ = self._run(mesh, steps=4, same_tokens=True)
+        assert losses[-1] < losses[0]
+
+    def test_activation_spec_puts_batch_on_dcn(self):
+        from jax.sharding import PartitionSpec as P
+
+        from bobrapet_tpu.parallel.sharding import activation_spec
+
+        mesh = build_two_level_mesh(2, {"data": 2, "model": 2})
+        spec = activation_spec(mesh)
+        assert spec == P(("dcn", "data"))
+        # params never shard on dcn: replicated per slice
+        from bobrapet_tpu.models.llama import llama_tiny
+        from bobrapet_tpu.models.llama import init_params
+        from bobrapet_tpu.parallel.sharding import llama_param_specs
+
+        params = init_params(jax.random.PRNGKey(0), llama_tiny())
+        specs = llama_param_specs(params, mesh)
+        flat = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        for s in flat:
+            for part in s:
+                parts = part if isinstance(part, tuple) else (part,)
+                assert DCN_AXIS not in parts

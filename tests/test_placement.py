@@ -214,6 +214,248 @@ def _dict_grant_cells(grant):
     return set(_cells(tuple(grant["origin"]), parse_topology(grant["topology"])))
 
 
+class TestSpanningGrants:
+    """One gang across multiple pools (the multi-slice DCN shape):
+    balanced round-robin distribution, per-pool ICI-contiguous
+    super-blocks, all-or-nothing atomicity across pools, greedy spill,
+    and span metadata (replica identity + global process layout)."""
+
+    def _placer(self, *topos, hosts=True):
+        pools = []
+        for i, topo in enumerate(topos):
+            name = f"p{i}"
+            pools.append(SlicePool(
+                name, topo, chips_per_host=4,
+                host_addresses=[f"{name}-h0:8476"] if hosts else None,
+            ))
+        return SlicePlacer(pools), pools
+
+    def test_balanced_round_robin_with_per_pool_superblocks(self):
+        placer, pools = self._placer("4x4", "4x4")
+        out = placer.place_group(
+            [(f"r{i}", TPUPolicy(topology="2x2")) for i in range(4)],
+            pools=["p0", "p1"],
+        )
+        assert len(out) == 4
+        by_pool = {}
+        for name, g in out.items():
+            by_pool.setdefault(g.pool, []).append(g)
+        # balanced: two members per pool
+        assert {p: len(gs) for p, gs in by_pool.items()} == {"p0": 2, "p1": 2}
+        for gs in by_pool.values():
+            cells = set()
+            for g in gs:
+                c = _grant_cells(g)
+                assert not c & cells
+                cells |= c
+            # same-pool siblings land as one contiguous super-block
+            xs = [c[0] for c in cells]
+            ys = [c[1] for c in cells]
+            assert (max(xs) - min(xs) + 1) * (max(ys) - min(ys) + 1) == len(cells)
+
+    def test_span_metadata_layout(self):
+        placer, _ = self._placer("4x4", "4x4")
+        out = placer.place_group(
+            [(f"r{i}", TPUPolicy(topology="2x4")) for i in range(2)],
+            pools=["p0", "p1"],
+        )
+        g0, g1 = out["r0"], out["r1"]
+        assert g0.span["id"] == g1.span["id"]
+        assert (g0.span["replica"], g1.span["replica"]) == (0, 1)
+        assert g0.span["replicas"] == g1.span["replicas"] == 2
+        assert g0.span["pools"] == ["p0", "p1"]
+        # 8 chips @ 4/host = 2 hosts each: global process set of 4,
+        # member bases 0 and 2, ONE coordinator (member 0's pool)
+        assert g0.span["processes"] == g1.span["processes"] == 4
+        assert (g0.span["processBase"], g1.span["processBase"]) == (0, 2)
+        assert g0.span["coordinator"] == g1.span["coordinator"] == "p0-h0:8476"
+        # serialized form carries the span verbatim
+        assert g0.to_dict()["span"]["replicas"] == 2
+
+    def test_all_or_nothing_rolls_back_every_pool(self):
+        placer, pools = self._placer("4x4", "4x4")
+        pools[1].allocate(want_topology="4x4")  # p1 full (transient)
+        with pytest.raises(NoCapacity) as ei:
+            placer.place_group(
+                [(f"r{i}", TPUPolicy(topology="2x2")) for i in range(4)],
+                pools=["p0", "p1"], spill=False,
+            )
+        # truthful per-pool hints in the park message
+        assert "p0" in str(ei.value) and "p1" in str(ei.value)
+        assert "largest free block" in str(ei.value)
+        assert pools[0].free_chips() == 16  # p0 fully rolled back
+        assert pools[1].free_chips() == 0
+
+    def test_greedy_spill_packs_unevenly(self):
+        placer, pools = self._placer("4x4", "2x2")
+        pools[1].allocate(want_topology="2x2")  # p1 full
+        out = placer.place_group(
+            [("r0", TPUPolicy(topology="2x2")),
+             ("r1", TPUPolicy(topology="2x2"))],
+            pools=["p0", "p1"], spill=True,
+        )
+        assert {g.pool for g in out.values()} == {"p0"}
+        # span metadata still stamped on the spilled layout
+        assert out["r0"].span["replicas"] == 2
+
+    def test_spill_disabled_parks_instead(self):
+        placer, pools = self._placer("4x4", "2x2")
+        pools[1].allocate(want_topology="2x2")
+        with pytest.raises(NoCapacity):
+            placer.place_group(
+                [("r0", TPUPolicy(topology="2x2")),
+                 ("r1", TPUPolicy(topology="2x2"))],
+                pools=["p0", "p1"], spill=False,
+            )
+        assert pools[0].free_chips() == 16
+
+    def test_shape_too_big_for_every_pool_is_permanent(self):
+        placer, pools = self._placer("2x2", "2x2")
+        with pytest.raises(PlacementError) as ei:
+            placer.place_group(
+                [("r0", TPUPolicy(topology="4x4"))], pools=["p0", "p1"]
+            )
+        assert not isinstance(ei.value, NoCapacity)
+
+    def test_oversized_member_spills_to_the_pool_that_fits(self):
+        # balanced routing would send member 1 (4x4) to the too-small
+        # p1; spill must land it on p0 (largest member packs first) and
+        # route the small member to p1
+        placer, pools = self._placer("4x4", "2x2")
+        out = placer.place_group(
+            [("r0", TPUPolicy(topology="2x2")),
+             ("r1", TPUPolicy(topology="4x4"))],
+            pools=["p0", "p1"],
+        )
+        assert out["r1"].pool == "p0"
+        assert out["r0"].pool == "p1"
+
+    def test_balanced_misfit_with_spill_off_is_permanent(self):
+        """Round-robin routes a shape to a pool that can NEVER hold it
+        and spill is off: that must be a permanent PlacementError, not
+        a NoCapacity park that re-probes forever."""
+        placer, pools = self._placer("4x4", "2x2")
+        with pytest.raises(PlacementError) as ei:
+            placer.place_group(
+                [("r0", TPUPolicy(topology="2x4")),
+                 ("r1", TPUPolicy(topology="2x4"))],
+                pools=["p0", "p1"], spill=False,
+            )
+        assert not isinstance(ei.value, NoCapacity)
+        assert "span-spill" in str(ei.value)
+        assert pools[0].free_chips() == 16
+
+    def test_span_coordinator_is_member_zero_only(self):
+        """Global process 0 lives on member 0 — when member 0's pool
+        declares no addresses, the span coordinator must be None (the
+        GKE layer derives one), NEVER another member's address (every
+        host would dial a machine where no coordinator listens)."""
+        p0 = SlicePool("p0", "4x4", chips_per_host=4)  # no addresses
+        p1 = SlicePool("p1", "4x4", chips_per_host=4,
+                       host_addresses=["p1-h0:8476"])
+        placer = SlicePlacer([p0, p1])
+        out = placer.place_group(
+            [("r0", TPUPolicy(topology="2x2")),
+             ("r1", TPUPolicy(topology="2x2"))],
+            pools=["p0", "p1"],
+        )
+        assert out["r0"].span["coordinator"] is None
+        assert out["r1"].span["coordinator"] is None
+
+    def test_unknown_span_pool_fails_loudly(self):
+        placer, _ = self._placer("4x4")
+        with pytest.raises(PlacementError, match="unknown span pool"):
+            placer.place_group(
+                [("r0", TPUPolicy(topology="2x2"))], pools=["p0", "ghost"]
+            )
+
+    def test_single_pool_span_still_stamps_metadata(self):
+        placer, _ = self._placer("4x4")
+        out = placer.place_group(
+            [("r0", TPUPolicy(topology="2x2")),
+             ("r1", TPUPolicy(topology="2x2"))],
+            pools=["p0"],
+        )
+        assert out["r0"].span["replicas"] == 2
+        assert out["r0"].pool == out["r1"].pool == "p0"
+
+
+class TestSpanningChurnOracle:
+    """Seeded churn over multiple pools with per-pool brute-force
+    mirrors: spanning placement must keep every pool's occupancy exact,
+    never overlap grants, and roll back atomically across pools on any
+    NoCapacity."""
+
+    @pytest.mark.parametrize("seed", [11, 12, 13])
+    def test_spanning_churn_invariants(self, seed):
+        import random
+
+        topos = {"p0": "4x4", "p1": "4x4", "p2": "2x4"}
+        pools = {
+            n: SlicePool(n, t, chips_per_host=4) for n, t in topos.items()
+        }
+        placer = SlicePlacer(list(pools.values()))
+        refs = {n: BruteForceReference(parse_topology(t))
+                for n, t in topos.items()}
+        rng = random.Random(seed)
+        live = []  # list of grant lists (span gangs)
+
+        def check_counts():
+            for n, p in pools.items():
+                assert p.free_chips() == (
+                    p.total_chips - len(refs[n].occupied)
+                ), f"pool {n} drifted"
+
+        for _i in range(150):
+            if rng.random() < 0.55 or not live:
+                k = rng.randint(2, 4)
+                shape = (rng.randint(1, 2), rng.randint(1, 4))
+                topo = "x".join(map(str, shape))
+                names = [f"r{j}" for j in range(k)]
+                before = {n: p.free_chips() for n, p in pools.items()}
+                try:
+                    out = placer.place_group(
+                        [(nm, TPUPolicy(topology=topo)) for nm in names],
+                        pools=list(pools),
+                        spill=rng.random() < 0.5,
+                    )
+                except NoCapacity:
+                    # atomic: NO pool's occupancy moved
+                    after = {n: p.free_chips() for n, p in pools.items()}
+                    assert after == before
+                else:
+                    gang = []
+                    for nm in names:
+                        g = out[nm]
+                        refs[g.pool].occupy(
+                            tuple(g.origin), parse_topology(g.topology)
+                        )  # raises on any overlap
+                        gang.append(g)
+                    span_ids = {g.span["id"] for g in gang}
+                    assert len(span_ids) == 1
+                    assert sorted(g.span["replica"] for g in gang) == list(
+                        range(k)
+                    )
+                    live.append(gang)
+            else:
+                gang = live.pop(rng.randrange(len(live)))
+                for g in gang:
+                    pools[g.pool].release(g.slice_id)
+                    refs[g.pool].release(
+                        tuple(g.origin), parse_topology(g.topology)
+                    )
+            check_counts()
+
+        while live:
+            for g in live.pop():
+                pools[g.pool].release(g.slice_id)
+                refs[g.pool].release(
+                    tuple(g.origin), parse_topology(g.topology)
+                )
+        for p in pools.values():
+            assert p.free_chips() == p.total_chips
+
+
 class TestFleetBatchedReplacement:
     def _runtime_with_pool(self):
         from bobrapet_tpu.runtime import Runtime
@@ -235,12 +477,70 @@ class TestFleetBatchedReplacement:
         assert not (c0 | c1) & quarantined
 
     def test_replace_grants_rejects_cross_pool_siblings(self):
+        """Non-SPAN siblings on different pools are a caller bug — only
+        grants carrying span metadata may legitimately cross pools."""
         rt, pool = self._runtime_with_pool()
         rt.placer.add_pool(SlicePool("other", "2x2"))
         a = pool.allocate(want_topology="1x2").to_dict()
         b = rt.placer.pool("other").allocate(want_topology="1x2").to_dict()
         with pytest.raises(ValueError, match="span pools"):
             rt.fleet.replace_grants([a, b])
+
+    def _runtime_with_span(self):
+        from bobrapet_tpu.runtime import Runtime
+
+        rt = Runtime()
+        rt.placer.add_pool(SlicePool("pa", "4x4", chips_per_host=4))
+        rt.placer.add_pool(SlicePool("pb", "4x4", chips_per_host=4))
+        out = rt.placer.place_group(
+            [("r0", TPUPolicy(topology="2x4")),
+             ("r1", TPUPolicy(topology="2x4"))],
+            pools=["pa", "pb"],
+        )
+        return rt, [out["r0"].to_dict(), out["r1"].to_dict()]
+
+    def test_replace_grants_spanning_re_places_per_pool(self):
+        rt, grants = self._runtime_with_span()
+        rt.fleet.on_preemption(grants[0], host=0, key="ns/span-j1")
+        news = rt.fleet.replace_grants(grants)
+        assert news is not None and len(news) == 2
+        # each replacement stays on its member's pool and keeps its
+        # logical span identity (replica index, process base, id)
+        for old, new in zip(grants, news):
+            assert new["pool"] == old["pool"]
+            assert new["span"] == old["span"]
+        quarantined = set(map(tuple, rt.fleet.registry.quarantined_cells("pa")))
+        assert quarantined
+        assert not _dict_grant_cells(news[0]) & quarantined
+
+    def test_replace_grants_spanning_rolls_back_on_partial_fit(self):
+        """One pool cannot re-place its member: the OTHER pool's fresh
+        allocation is handed back and the dead grants stay released —
+        no chips leak in either pool, callers park."""
+        rt, grants = self._runtime_with_span()
+        # quarantine all of pb so its member can never re-place
+        rt.fleet.registry.report_preemption(
+            "pb", [(x, y) for x in range(4) for y in range(4)], key="k"
+        )
+        assert rt.fleet.replace_grants(grants) is None
+        assert rt.placer.pool("pa").free_chips() == 16  # rolled back
+        assert rt.placer.pool("pb").free_chips() == 16  # dead grant freed
+        assert rt.placer.pool("pb").schedulable_chips() == 0
+
+    def test_capacity_hint_covers_every_span_pool(self):
+        rt, grants = self._runtime_with_span()
+        hint = rt.fleet.capacity_hint(grants[0])
+        assert "pool pa" in hint and "pool pb" in hint
+        # per-pool figures are the exact brute-force largest blocks
+        for name in ("pa", "pb"):
+            ref = BruteForceReference(parse_topology("4x4"))
+            for g in grants:
+                if g["pool"] == name:
+                    ref.occupy(tuple(g["origin"]), parse_topology(g["topology"]))
+            assert (
+                f"largest free block {ref.largest_free_block()} chips"
+                in hint.split(f"pool {name}:")[1].split(";")[0]
+            )
 
     def test_replace_grants_releases_dead_blocks_even_when_parking(self):
         """Fail fast: the dead gang's chips return to the pool even

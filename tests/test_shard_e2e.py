@@ -161,7 +161,17 @@ class TestRebalance:
     def test_join_and_leave_churn_mid_soak(self):
         """Shard join + graceful leave while runs are in flight: the
         drain/ack/promote barrier must hand families over with zero
-        double-owned and zero orphaned runs."""
+        double-owned and zero orphaned runs.
+
+        The historical "cannot index NoneType with .i" flake (a
+        dependent StepRun resolving ``steps.<sib>.output`` from a
+        StoryRun view lagging the sibling's output patch during a
+        drain) is FIXED: the StepRun controller now heals the scope
+        from authoritative StepRun state and requeues on view lag
+        (steprun.StaleRunScope; pinned by tests/test_stale_scope.py).
+        The all-succeeded assert below stays armed as the detector —
+        if it ever fires again, a NEW lost-work path exists; do not
+        de-assert it."""
         cp = ShardedControlPlane(shards=2, heartbeat_interval=0.25,
                                  member_ttl=3.0, lease_duration=4.0)
         with cp:

@@ -1,0 +1,155 @@
+"""Cross-shard stale-scope recovery (the PR-6-vintage lost-work race).
+
+During a rebalance drain a dependent StepRun could resolve
+``steps.<sib>.output`` from a StoryRun status view that lagged the
+sibling's output patch and fail the run terminally ("cannot index
+NoneType with .i" in the churn soak). The fix resolves missing outputs
+from the AUTHORITATIVE StepRun state, and requeues (bounded) when even
+that lags — these tests pin all three legs: heal, requeue, exhaust.
+The churn-soak assert in test_shard_e2e stays the live detector.
+"""
+
+import pytest
+
+from bobrapet_tpu.api.catalog import make_engram_template
+from bobrapet_tpu.api.engram import make_engram
+from bobrapet_tpu.api.story import make_story
+from bobrapet_tpu.controllers.steprun import STALE_SCOPE_RETRY_CAP
+from bobrapet_tpu.observability.metrics import metrics
+from bobrapet_tpu.sdk import register_engram
+
+
+def _setup(rt):
+    rt.apply(make_engram_template("w-tpl", entrypoint="stale-impl"))
+    rt.apply(make_engram("worker", "w-tpl"))
+
+    @register_engram("stale-impl")
+    def impl(ctx):
+        return {"i": ctx.inputs.get("v", 5)}
+
+    rt.apply(make_story("dep-story", steps=[
+        {"name": "s1", "ref": {"name": "worker"}, "with": {"v": 5}},
+        {"name": "s2", "ref": {"name": "worker"},
+         "with": {"v": "{{ steps.s1.output.i }}"}},
+    ]))
+
+
+def _steprun_of(rt, run, step_id):
+    for sr in rt.store.list("StepRun"):
+        if (
+            (sr.spec.get("storyRunRef") or {}).get("name") == run
+            and sr.spec.get("stepId") == step_id
+        ):
+            return sr
+    return None
+
+
+def _drive_to_s2(rt):
+    """Run s1 to completion, launch s2, and return its StepRun name."""
+    run = rt.run_story("dep-story")
+    for _ in range(8):
+        rt.storyrun_controller.reconcile("default", run)
+        s1 = _steprun_of(rt, run, "s1")
+        if s1 is not None:
+            rt.steprun_controller.reconcile("default", s1.meta.name)
+            if rt.store.get(
+                "StepRun", "default", s1.meta.name
+            ).status.get("phase") == "Succeeded":
+                break
+    rt.storyrun_controller.reconcile("default", run)
+    s2 = _steprun_of(rt, run, "s2")
+    assert s2 is not None, "s2 never launched"
+    return run, _steprun_of(rt, run, "s1").meta.name, s2.meta.name
+
+
+def _blank_view_output(rt, run):
+    """Simulate the lagging replica view: the StoryRun's stepStates say
+    s1 Succeeded but carry no output (the output patch 'in flight')."""
+    def lag(r):
+        r.status["stepStates"]["s1"]["output"] = None
+
+    rt.store.mutate("StoryRun", "default", run, lag)
+
+
+class TestStaleScopeRecovery:
+    def test_heals_from_authoritative_steprun(self, rt):
+        _setup(rt)
+        run, _s1, s2 = _drive_to_s2(rt)
+        _blank_view_output(rt, run)
+        before = metrics.steprun_stale_scope.value("healed")
+        # the dependent's reconcile must resolve s1's output from the
+        # authoritative StepRun and dispatch — not fail the run
+        for _ in range(4):
+            rt.steprun_controller.reconcile("default", s2)
+        status = rt.store.get("StepRun", "default", s2).status
+        assert status.get("phase") == "Succeeded", status
+        assert status.get("output") == {"i": 5}
+        assert metrics.steprun_stale_scope.value("healed") == before + 1
+
+    def test_requeues_when_even_the_steprun_lags(self, rt):
+        _setup(rt)
+        run, s1, s2 = _drive_to_s2(rt)
+        _blank_view_output(rt, run)
+        # blank the authoritative output too: nothing to heal from yet
+        rt.store.patch_status(
+            "StepRun", "default", s1, lambda st: st.update({"output": None})
+        )
+        delay = rt.steprun_controller.reconcile("default", s2)
+        assert delay is not None and delay > 0  # requeued, not failed
+        status = rt.store.get("StepRun", "default", s2).status
+        assert status.get("phase") != "Failed"
+        assert status.get("staleScopeRetries") == 1
+        # the output surfaces -> next reconcile launches and clears the
+        # retry ledger
+        rt.store.patch_status(
+            "StepRun", "default", s1,
+            lambda st: st.update({"output": {"i": 5}}),
+        )
+        for _ in range(4):
+            rt.steprun_controller.reconcile("default", s2)
+        status = rt.store.get("StepRun", "default", s2).status
+        assert status.get("phase") == "Succeeded"
+        assert "staleScopeRetries" not in status
+
+    def test_exhaustion_fails_loudly(self, rt):
+        """A scope still stale past the cap is a genuinely lost output:
+        the run must fail with a message naming the starved sibling —
+        the requeue must not paper over real lost work forever."""
+        _setup(rt)
+        run, s1, s2 = _drive_to_s2(rt)
+        _blank_view_output(rt, run)
+        rt.store.patch_status(
+            "StepRun", "default", s1, lambda st: st.update({"output": None})
+        )
+        rt.store.patch_status(
+            "StepRun", "default", s2,
+            lambda st: st.update(
+                {"staleScopeRetries": STALE_SCOPE_RETRY_CAP}
+            ),
+        )
+        rt.steprun_controller.reconcile("default", s2)
+        status = rt.store.get("StepRun", "default", s2).status
+        assert status.get("phase") == "Failed"
+        assert "stale" in (status.get("error") or {}).get("message", "")
+
+    def test_genuine_template_errors_stay_terminal(self, rt):
+        """An outputless sibling that did NOT succeed is not a lagging
+        view — indexing its None output is a genuine evaluation error
+        and must stay terminal, not loop on the requeue."""
+        _setup(rt)
+        run, s1, s2 = _drive_to_s2(rt)
+        _blank_view_output(rt, run)
+        rt.store.patch_status(
+            "StepRun", "default", s1, lambda st: st.update({"output": None})
+        )
+
+        def fail_sib(r):
+            r.status["stepStates"]["s1"]["phase"] = "Failed"
+
+        rt.store.mutate("StoryRun", "default", run, fail_sib)
+        rt.steprun_controller.reconcile("default", s2)
+        status = rt.store.get("StepRun", "default", s2).status
+        assert status.get("phase") == "Failed"
+        assert "input template evaluation failed" in (
+            (status.get("error") or {}).get("message", "")
+        )

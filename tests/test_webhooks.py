@@ -114,6 +114,35 @@ class TestStoryWebhook:
                   "with": {"steps": [{"name": "x", "type": "condition"}]}},
              ]}}])), "nest")
 
+    def test_parallel_replicas_spelling_validated(self, rt):
+        # replicas + steps together is ambiguous
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "p", "type": "parallel",
+             "with": {"replicas": 2,
+                      "step": {"name": "r", "ref": {"name": "w"}},
+                      "steps": [{"name": "b", "ref": {"name": "w"}}]}}])),
+               "not both")
+        # replicas must be a positive integer with a step template
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "p", "type": "parallel",
+             "with": {"replicas": 0,
+                      "step": {"name": "r", "ref": {"name": "w"}}}}])),
+               "replicas")
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "p", "type": "parallel",
+             "with": {"replicas": 2, "step": {"name": "r",
+                                              "ref": {"name": "w"}},
+                      "pools": []}}])), "pools")
+        # a replicated fan-out nested inside another parallel is
+        # rejected at admission like the explicit spelling
+        denied(lambda: rt.apply(make_story("s", steps=[
+            {"name": "p", "type": "parallel",
+             "with": {"steps": [
+                 {"name": "inner", "type": "parallel",
+                  "with": {"replicas": 2,
+                           "step": {"name": "r", "ref": {"name": "w"}}}},
+             ]}}])), "nest")
+
     def test_template_scope_validation(self, rt):
         # `steps` root is not available in realtime static config scope
         denied(lambda: rt.apply(make_story(
