@@ -283,6 +283,14 @@ def config3_fanout_gang() -> dict:
     rt.pump()
     wall = time.perf_counter() - t0
     assert rt.run_phase(run) == "Succeeded", rt.run_phase(run)
+    # fleet-efficiency lineage (ISSUE 13 satellite): chip-second ledger
+    # + occupancy percentiles ride the bench JSON so future BENCH_r*
+    # files carry utilization next to throughput
+    from bobrapet_tpu.observability.analytics import LEDGER, UTILIZATION
+
+    summary = LEDGER.summary()
+    pool_totals = summary["pools"].get("v5e-16", {})
+    occ = UTILIZATION.occupancy_percentiles("v5e-16")
     return {
         "metric": "gang_fanout_branches_per_sec",
         "value": round(branches / wall, 2),
@@ -292,6 +300,17 @@ def config3_fanout_gang() -> dict:
         "branches": branches,
         "gang": "4 x 2x2 slices from a 4x4 pool (queued all-or-nothing)",
         "wallclock_s": round(wall, 3),
+        "fleet": {
+            "chip_seconds": pool_totals.get("chipSeconds", {}),
+            "granted_chip_seconds": round(
+                pool_totals.get("grantedChipSeconds", 0.0), 6),
+            "waste_fraction": round(
+                pool_totals.get("wasteFraction", 0.0), 4),
+            "goodput_chip_seconds": summary["goodputChipSeconds"],
+            "occupancy_p50": round(occ["p50"], 4),
+            "occupancy_p95": round(occ["p95"], 4),
+            "ledger_balanced": LEDGER.unbalanced() == [],
+        },
     }
 
 
@@ -1135,10 +1154,21 @@ def config11_placement_churn() -> dict:
         parse_topology,
     )
 
+    from bobrapet_tpu.observability.analytics import LEDGER, UTILIZATION
+
     topology = os.environ.get("BENCH_PLACEMENT_TOPOLOGY", "16x16x16")
     n_ops = int(os.environ.get("BENCH_PLACEMENT_OPS", "3000"))
     rng = random.Random(0xB0B8A)
     pool = SlicePool("bench", topology, chips_per_host=4)
+
+    class _Placer:
+        """Duck-typed placer for the utilization tracker's pool walk."""
+
+        def pools(self):
+            return [pool]
+
+    placer = _Placer()
+    outcomes = ("productive", "productive", "retry", "preempted")
     dims = parse_topology(topology)
     all_cells = [()]
     for d in dims:
@@ -1159,6 +1189,8 @@ def config11_placement_churn() -> dict:
             attempts += 4
             try:
                 gs = pool.allocate_many(reqs)
+                for g in gs:
+                    LEDGER.open_grant(g.to_dict(), time.time())
                 live.extend(gs)
                 granted += len(gs)
             except NoCapacity:
@@ -1167,6 +1199,7 @@ def config11_placement_churn() -> dict:
             attempts += 1
             try:
                 g = pool.allocate(chips=rng.choice(chip_choices))
+                LEDGER.open_grant(g.to_dict(), time.time())
                 live.append(g)
                 granted += 1
             except NoCapacity:
@@ -1174,8 +1207,18 @@ def config11_placement_churn() -> dict:
         else:
             g = live.pop(rng.randrange(len(live)))
             pool.release(g.slice_id)
+            LEDGER.account(g.slice_id, rng.choice(outcomes), time.time())
+            LEDGER.close_grant(g.slice_id, "drain", time.time())
+        if i % 97 == 0:
+            UTILIZATION.sample(placer, time.time(), force=True)
     wall = time.perf_counter() - t0
+    for g in live:
+        pool.release(g.slice_id)
+        LEDGER.close_grant(g.slice_id, "drain", time.time())
     gps = granted / wall
+    summary = LEDGER.summary()
+    totals = summary["pools"].get("bench", {})
+    occ = UTILIZATION.occupancy_percentiles("bench")
     return {
         "metric": "placement_grants_per_sec",
         "value": round(gps, 1),
@@ -1188,6 +1231,16 @@ def config11_placement_churn() -> dict:
         "no_capacity": nocap,
         "fragmentation": round(pool.fragmentation(), 3),
         "wallclock_s": round(wall, 3),
+        # fleet-efficiency lineage (ISSUE 13): the churn's own chip-time
+        # ledger, balanced-by-construction, + occupancy percentiles
+        "fleet": {
+            "granted_chip_seconds": round(
+                totals.get("grantedChipSeconds", 0.0), 3),
+            "waste_fraction": round(totals.get("wasteFraction", 0.0), 4),
+            "occupancy_p50": round(occ["p50"], 4),
+            "occupancy_p95": round(occ["p95"], 4),
+            "ledger_balanced": LEDGER.unbalanced() == [],
+        },
     }
 
 
@@ -2147,6 +2200,17 @@ def main() -> None:
     use_default = watcher.ok.is_set()
     forensics = watcher.forensics()
     state["backend"] = "default" if use_default else "cpu-fallback"
+    if not use_default:
+        # satellite: the fallback is a RUNTIME fact, not just a bench
+        # JSON field — count it into the live metrics plane and log the
+        # startup line every BENCH_r0x run has been missing
+        from bobrapet_tpu.observability.analytics import record_backend_fallback
+
+        record_backend_fallback(
+            "probe-timeout" if "timeout" in str(forensics.get("error") or "")
+            else "probe-error",
+            detail=str(forensics.get("error") or "TPU probe failed"),
+        )
 
     results: list[dict] = []
     state["stage"] = "decode"

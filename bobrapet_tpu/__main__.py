@@ -46,11 +46,58 @@ def _span_dict(span) -> dict:
     }
 
 
+def _runs_list_response(rt) -> tuple[bytes, int, str]:
+    """``/debug/runs`` — most-recent runs with phase + duration + trace
+    id, so an operator can find a run WITHOUT knowing its name in
+    advance (the per-id endpoints assumed you did). Store-resident runs
+    are listed newest-first; runs retention already reaped but still in
+    the flight recorder ring follow, marked ``live: false``."""
+    from .observability.timeline import FLIGHT
+
+    rows = []
+    seen = set()
+    for run in rt.store.list_views("StoryRun"):
+        ns, name = run.meta.namespace, run.meta.name
+        seen.add((ns, name))
+        started = run.status.get("startedAt")
+        finished = run.status.get("finishedAt")
+        rows.append({
+            "namespace": ns,
+            "run": name,
+            "live": True,
+            "phase": run.status.get("phase"),
+            "startedAt": started,
+            "finishedAt": finished,
+            "durationSeconds": (
+                float(finished) - float(started)
+                if started is not None and finished is not None else None
+            ),
+            "traceId": (run.status.get("trace") or {}).get("traceId"),
+            "steps": len(run.status.get("stepStates") or {}),
+        })
+    rows.sort(key=lambda r: r["startedAt"] or 0.0, reverse=True)
+    rows = rows[:50]
+    for ns, name in FLIGHT.recent_runs(50):
+        if (ns, name) in seen or len(rows) >= 100:
+            continue
+        rows.append({
+            "namespace": ns, "run": name, "live": False, "phase": None,
+            "startedAt": None, "finishedAt": None, "durationSeconds": None,
+            "traceId": None, "steps": None,
+        })
+    return (json.dumps({"runs": rows}, default=str).encode(), 200,
+            "application/json")
+
+
 def _debug_response(state: dict, path: str) -> tuple[bytes, int, str]:
-    """``/debug/runs/<ns>/<name>`` (or ``/debug/runs/<name>`` in the
-    default namespace) -> the run's flight-recorder timeline + status
-    summary; ``/debug/traces/<traceId>`` -> the trace's spans (when the
-    tracer keeps an in-memory exporter) + every linked run's timeline.
+    """``/debug/runs`` (most-recent list), ``/debug/runs/<ns>/<name>``
+    (or ``/debug/runs/<name>`` in the default namespace) -> the run's
+    flight-recorder timeline + status summary, with a
+    ``/critical-path`` suffix for the full wall-clock attribution;
+    ``/debug/traces/<traceId>`` -> the trace's spans (when the tracer
+    keeps an in-memory exporter) + every linked run's timeline;
+    ``/debug/fleet/utilization`` -> occupancy snapshots + the chip-time
+    ledger; ``/debug/profile`` -> the control-plane profiler snapshot.
     Gated by `telemetry.debug-endpoints` (live) and the same bearer
     token as /metrics (checked by the caller)."""
     from .observability.timeline import FLIGHT
@@ -61,6 +108,28 @@ def _debug_response(state: dict, path: str) -> tuple[bytes, int, str]:
     if not rt.config_manager.config.telemetry.debug_endpoints:
         return b"not found", 404, "text/plain"
     parts = [p for p in path.split("/") if p]
+    if len(parts) == 2 and parts[1] == "runs":
+        return _runs_list_response(rt)
+    if len(parts) == 3 and parts[1] == "fleet" and parts[2] == "utilization":
+        from .observability.analytics import utilization_payload
+
+        return (json.dumps(utilization_payload(rt.placer),
+                           default=str).encode(), 200, "application/json")
+    if len(parts) == 2 and parts[1] == "profile":
+        from .observability.profiler import PROFILER
+
+        return (json.dumps(PROFILER.snapshot(), default=str).encode(),
+                200, "application/json")
+    # the /critical-path suffix belongs to the runs routes ONLY — a
+    # length-only strip would misroute /debug/traces/<id>/critical-path
+    # into the plain trace handler
+    critical = (
+        len(parts) in (4, 5)
+        and parts[1] == "runs"
+        and parts[-1] == "critical-path"
+    )
+    if critical:
+        parts = parts[:-1]
     if len(parts) in (3, 4) and parts[1] == "runs":
         ns, name = (("default", parts[2]) if len(parts) == 3
                     else (parts[2], parts[3]))
@@ -68,6 +137,24 @@ def _debug_response(state: dict, path: str) -> tuple[bytes, int, str]:
         timeline = FLIGHT.timeline(ns, name)
         if run is None and not timeline:
             return b"unknown run", 404, "text/plain"
+        if critical:
+            from .observability.analytics import analyze_run
+
+            analysis = (
+                analyze_run(run.status, timeline)
+                if run is not None else None
+            )
+            if analysis is None:
+                return (b"run has no terminal clock bounds yet", 404,
+                        "text/plain")
+            payload = {
+                "namespace": ns,
+                "run": name,
+                "phase": run.status.get("phase"),
+                **analysis,
+            }
+            return (json.dumps(payload, default=str).encode(), 200,
+                    "application/json")
         payload = {
             "namespace": ns,
             "run": name,
@@ -76,6 +163,7 @@ def _debug_response(state: dict, path: str) -> tuple[bytes, int, str]:
             "reason": run.status.get("reason") if run is not None else None,
             "trace": run.status.get("trace") if run is not None else None,
             "error": run.status.get("error") if run is not None else None,
+            "analysis": run.status.get("analysis") if run is not None else None,
             "timeline": timeline,
         }
         return (json.dumps(payload, default=str).encode(), 200,

@@ -246,6 +246,18 @@ class TelemetryConfig:
     #: HTTP server (token-gated like /metrics)
     #: (dotted: telemetry.debug-endpoints)
     debug_endpoints: bool = True
+    #: continuous control-plane profiler (observability/profiler.py):
+    #: a sampling wall-clock profiler thread over this manager's own
+    #: threads, served at /debug/profile with lock-wait attribution
+    #: (dotted: telemetry.profiler-enabled; live — flipping it starts/
+    #: stops the sampler thread)
+    profiler_enabled: bool = False
+    #: seconds between stack samples (telemetry.profiler-interval);
+    #: the soak smoke bounds the default's cost at <2% steps/s
+    profiler_interval_seconds: float = 0.02
+    #: innermost frames kept per sampled stack
+    #: (telemetry.profiler-depth)
+    profiler_depth: int = 12
 
 
 @dataclasses.dataclass
@@ -377,6 +389,12 @@ class OperatorConfig:
             errs.append("telemetry.slo.ttft-threshold must be > 0")
         if self.telemetry.slo_tpot_threshold_seconds <= 0:
             errs.append("telemetry.slo.tpot-threshold must be > 0")
+        if self.telemetry.profiler_interval_seconds <= 0:
+            # 0 would turn the sampler into a busy loop — the exact
+            # overhead the interval exists to bound
+            errs.append("telemetry.profiler-interval must be > 0")
+        if self.telemetry.profiler_depth < 1:
+            errs.append("telemetry.profiler-depth must be >= 1")
         if self.engram.max_inline_size < 0:
             errs.append("engram.maxInlineSize must be >= 0")
         for qname, q in self.scheduling.queues.items():
@@ -464,6 +482,9 @@ def _apply_dotted(cfg: OperatorConfig, key: str, value: str) -> bool:
         "telemetry.slo.ttft-threshold": lambda: fset(cfg.telemetry, "slo_ttft_threshold_seconds", as_dur),
         "telemetry.slo.tpot-threshold": lambda: fset(cfg.telemetry, "slo_tpot_threshold_seconds", as_dur),
         "telemetry.debug-endpoints": lambda: fset(cfg.telemetry, "debug_endpoints", as_bool),
+        "telemetry.profiler-enabled": lambda: fset(cfg.telemetry, "profiler_enabled", as_bool),
+        "telemetry.profiler-interval": lambda: fset(cfg.telemetry, "profiler_interval_seconds", as_dur),
+        "telemetry.profiler-depth": lambda: fset(cfg.telemetry, "profiler_depth", int),
         "logging.step-output": lambda: fset(cfg, "step_output_logging", as_bool),
         "logging.verbosity": lambda: fset(cfg, "verbosity", int),
     }

@@ -282,6 +282,7 @@ class DAGEngine:
                         run.status.get("placementWaiting")
                         or "queued behind scheduling limits"
                     ),
+                    at=self.clock.now(),
                 )
             self.capacity_parked.add(key)
         else:
@@ -289,7 +290,8 @@ class DAGEngine:
         after = run.status.get("phase")
         if after != before and after:
             FLIGHT.record(key[0], key[1], "phase",
-                          message=f"{before or 'created'} -> {after}")
+                          message=f"{before or 'created'} -> {after}",
+                          at=self.clock.now())
             if Phase(after).is_terminal:
                 metrics.storyrun_total.inc(after)
                 started = run.status.get("startedAt")
@@ -308,8 +310,23 @@ class DAGEngine:
                         FLIGHT.record(
                             key[0], key[1], "error",
                             message=str(err.get("message") or "")[:512],
+                            at=self.clock.now(),
                         )
                     run.status["forensics"] = FLIGHT.tail(key[0], key[1], 20)
+                # critical-path analysis on EVERY terminal run: a
+                # compact where-did-the-wall-clock-go rides the status;
+                # the full breakdown recomputes behind
+                # /debug/runs/<id>/critical-path from the same ring
+                from ..observability.analytics import (
+                    analyze_run,
+                    compact_analysis,
+                )
+
+                analysis = analyze_run(
+                    run.status, FLIGHT.timeline(key[0], key[1])
+                )
+                if analysis is not None:
+                    run.status["analysis"] = compact_analysis(analysis)
         return result
 
     def _run(self, run: Resource, story: StorySpec) -> Optional[float]:
@@ -846,6 +863,7 @@ class DAGEngine:
                         FLIGHT.record(
                             run.meta.namespace, run.meta.name,
                             "no-capacity", message=str(e), step=step.name,
+                            at=self.clock.now(),
                         )
                     parked_at = (
                         prior.get("startedAt")

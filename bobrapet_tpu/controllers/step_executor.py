@@ -29,6 +29,7 @@ from ..api.story import Step, StorySpec
 from ..core.object import Resource, new_resource
 from ..core.store import AlreadyExists, ResourceStore
 from ..observability import tracing
+from ..observability.analytics import LEDGER, UTILIZATION
 from ..observability.metrics import metrics
 from ..observability.timeline import FLIGHT
 from ..parallel.placement import NoCapacity, SlicePlacer
@@ -125,7 +126,7 @@ class StepExecutor:
             message=f"step {step.name} "
                     f"({str(step.type) if step.type else 'engram'}) -> "
                     f"{state.phase}",
-            step=step.name,
+            step=step.name, at=self.clock.now(),
         )
         return state
 
@@ -268,13 +269,23 @@ class StepExecutor:
             # the merge keeps this reason until the step turns terminal
             from ..api.conditions import Reason
 
+            # chip-time ledger: the clock starts the moment the grant is
+            # committed to a StepRun (idempotent for the adopt path —
+            # the surviving grant keeps its original open time); tenant
+            # = the run's tenant label or its namespace
+            now = self.clock.now()
+            LEDGER.open_grant(
+                slice_grant, now,
+                tenant=run.meta.labels.get("bobrapet.io/tenant") or ns,
+            )
+            UTILIZATION.sample(self.placer, now)
             FLIGHT.record(
                 ns, run.meta.name, "placement",
                 message=f"step {step.name}: slice "
                         f"{slice_grant.get('sliceId')} on pool "
                         f"{slice_grant.get('pool')}",
                 step=step.name, sliceId=slice_grant.get("sliceId"),
-                pool=slice_grant.get("pool"),
+                pool=slice_grant.get("pool"), at=now,
             )
             return StepState(
                 phase=Phase.PENDING,
@@ -449,7 +460,7 @@ class StepExecutor:
                         + (f" spanning pools "
                            f"{sorted({g.pool for g in placed})} "
                            f"({span['id']})" if span else ""),
-                step=step.name,
+                step=step.name, at=self.clock.now(),
             )
         children = []
         try:
@@ -550,6 +561,7 @@ class StepExecutor:
                 trace_id=(parent_trace or {}).get("traceId"),
                 span_id=(parent_trace or {}).get("spanId"),
                 parent=run.meta.name, step=step.name,
+                at=self.clock.now(),
             )
         except AlreadyExists:
             pass

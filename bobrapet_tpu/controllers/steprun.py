@@ -35,6 +35,7 @@ from ..api.runs import STEP_RUN_KIND, STORY_RUN_KIND, parse_steprun
 from ..api.story import KIND as STORY_KIND, parse_story
 from ..core.events import EventRecorder
 from ..core.store import AlreadyExists, NotFound, ResourceStore
+from ..observability.analytics import LEDGER
 from ..observability.metrics import metrics
 from ..observability.structured import StepLogger
 from ..observability.timeline import FLIGHT
@@ -270,7 +271,7 @@ class StepRunController:
                     namespace, run_name, "stale-scope",
                     message=f"step {spec.step_id or name}: sibling output "
                             f"missing from run view, requeueing ({e})",
-                    step=spec.step_id or name,
+                    step=spec.step_id or name, at=self.clock.now(),
                 )
             return 0.5
         except TemplateError as e:
@@ -394,6 +395,30 @@ class StepRunController:
         # StepRun's persisted context (a child of the StoryRun trace via
         # _ensure_step_contracts), so admission -> scheduling ->
         # placement -> dispatch -> SDK reads as one chain
+        # the dispatch INSTANT, captured before the Job create: the
+        # sync local executor runs the gang inside create(), so a
+        # clock read after it would fold the attempt's time into the
+        # pre-dispatch segment
+        dispatch_at = self.clock.now()
+        # chip-time ledger: the segment from grant-open (or the prior
+        # attempt's end) to this dispatch was held-idle — placement
+        # park/input resolution on a first attempt, redrive wait on a
+        # relaunch. The attempt's own chip time is labeled when the Job
+        # reports back.
+        if slice_grant.get("sliceId"):
+            LEDGER.account(
+                slice_grant["sliceId"],
+                "retry" if attempt > 0 else "park",
+                dispatch_at,
+                tenant=self._tenant(storyrun, namespace),
+            )
+        if run_name:
+            FLIGHT.record(
+                namespace, run_name, "dispatch",
+                message=f"step {spec.step_id or name}: job {job_name} "
+                        f"({hosts} host(s))",
+                step=spec.step_id or name, at=dispatch_at,
+            )
         with self.tracer.start_span(
             "steprun.dispatch",
             trace_context=sr.status.get("trace"),
@@ -415,13 +440,6 @@ class StepRunController:
                 self.store.create(job)
             except AlreadyExists:
                 pass  # adopt: deterministic name makes the create idempotent
-        if run_name:
-            FLIGHT.record(
-                namespace, run_name, "dispatch",
-                message=f"step {spec.step_id or name}: job {job_name} "
-                        f"({hosts} host(s))",
-                step=spec.step_id or name,
-            )
         # while this step's Job dispatches, warm the payload tiers with
         # the run scope's refs (run inputs + prior step outputs): the
         # NEXT steps' input resolution and this step's output
@@ -438,6 +456,16 @@ class StepRunController:
                 [StorageManager.run_prefix(namespace, run_name)],
             )
         return None
+
+    @staticmethod
+    def _tenant(storyrun, namespace: str) -> str:
+        """Goodput attribution identity: the run's tenant label, else
+        its namespace (bounded cardinality either way)."""
+        if storyrun is not None:
+            label = storyrun.meta.labels.get("bobrapet.io/tenant")
+            if label:
+                return str(label)
+        return namespace
 
     # ------------------------------------------------------------------
     def _handle_job_status(
@@ -520,6 +548,14 @@ class StepRunController:
             status["finishedAt"] = self.clock.now()
             status.pop("error", None)
 
+        # the attempt's chip time was goodput — the one bucket the
+        # per-tenant counters scale on. Accounted BEFORE the terminal
+        # patch: the release watch fires synchronously on it and closes
+        # the ledger entry (the tail after this mark is drain).
+        LEDGER.account(
+            (spec.slice_grant or {}).get("sliceId"), "productive",
+            self.clock.now(),
+        )
         self.store.patch_status(STEP_RUN_KIND, namespace, name, finish)
         # logging.step-output toggle (reference: pkg/logging/features.go)
         StepLogger("steprun", namespace=namespace, object=name).step_output(output)
@@ -567,6 +603,13 @@ class StepRunController:
                 status.pop("hostHeartbeats", None)
 
             self.store.patch_status(STEP_RUN_KIND, namespace, name, schedule)
+            # the failed attempt's chip time is retry waste (the grant
+            # stays held across the backoff; the relaunch dispatch
+            # labels the wait itself)
+            LEDGER.account(
+                (spec.slice_grant or {}).get("sliceId"), "retry",
+                self.clock.now(),
+            )
             metrics.steprun_retries.inc(str(exit_class))
             self.recorder.warning(
                 sr, conditions.Reason.RETRY_SCHEDULED,
@@ -597,6 +640,11 @@ class StepRunController:
             status["error"] = err_payload
             status["finishedAt"] = self.clock.now()
 
+        # before the terminal patch: its release watch closes the entry
+        LEDGER.account(
+            (spec.slice_grant or {}).get("sliceId"), "failed",
+            self.clock.now(),
+        )
         self.store.patch_status(STEP_RUN_KIND, namespace, name, fail)
         self._observe_terminal(fresh, str(phase))
         return None
@@ -633,6 +681,9 @@ class StepRunController:
 
         try:
             self.store.mutate(STEP_RUN_KIND, namespace, name, swap)
+            # the replacement block's clock starts now; the relaunch
+            # dispatch labels the redrive wait
+            LEDGER.open_grant(new_grant, self.clock.now())
             return True
         except NotFound:
             self.fleet.placer.release(new_grant)
@@ -693,6 +744,11 @@ class StepRunController:
                     now=self.clock.now(),
                 )
 
+            # before the terminal patch (its release watch closes the
+            # entry): the dead attempt's time is preempted waste
+            LEDGER.account(
+                (grant or {}).get("sliceId"), "preempted", self.clock.now()
+            )
             self.store.patch_status(STEP_RUN_KIND, namespace, name, exhaust)
             self._observe_terminal(sr, str(Phase.FAILED))
             if self.fleet is not None:
@@ -709,6 +765,12 @@ class StepRunController:
         awaiting = False
         awaiting_hint = ""
         if grant:
+            # the dead attempt's chip time since the last mark was lost
+            # to the reclaim, and replace_grant releases the block below
+            # — close its ledger entry under the preempted bucket
+            LEDGER.close_grant(
+                grant.get("sliceId"), "preempted", self.clock.now()
+            )
             if self.fleet is not None:
                 self.fleet.begin_recovery(namespace, name)
                 new_grant = self.fleet.replace_grant(grant)
@@ -777,7 +839,7 @@ class StepRunController:
                     f"preempted (exit {exit_code}); redrive "
                     f"{preemptions + 1}/{fleet_cfg.preemption_retry_cap}"
                     + (", awaiting healthy slice" if awaiting else ""),
-            step=spec.step_id or name,
+            step=spec.step_id or name, at=self.clock.now(),
         )
         self.recorder.warning(
             sr, conditions.Reason.PREEMPTION_REDRIVE,
@@ -804,6 +866,7 @@ class StepRunController:
                             f"{phase}: "
                             f"{str(err.get('message') or '')[:256]}",
                     step=sr.spec.get("stepId") or sr.meta.name,
+                    at=self.clock.now(),
                 )
 
     def _fail(self, sr, err: StructuredError):
@@ -812,6 +875,14 @@ class StepRunController:
             status["error"] = err.to_dict()
             status["finishedAt"] = self.clock.now()
 
+        # validation/postExecution/template failures are attempt waste
+        # like any other terminal failure — account BEFORE the terminal
+        # patch whose release watch closes the grant (else the whole
+        # attempt misattributes to drain)
+        LEDGER.account(
+            (sr.spec.get("sliceGrant") or {}).get("sliceId"), "failed",
+            self.clock.now(),
+        )
         self.store.patch_status(STEP_RUN_KIND, sr.meta.namespace, sr.meta.name, fail)
         self._observe_terminal(sr, str(Phase.FAILED))
         return None

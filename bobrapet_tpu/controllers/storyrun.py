@@ -323,7 +323,8 @@ class StoryRunController:
     def _fail(self, run: Resource, err: StructuredError, reason: str) -> None:
         ns, name = run.meta.namespace, run.meta.name
         FLIGHT.record(ns, name, "error",
-                      message=f"{reason}: {err.message}"[:512])
+                      message=f"{reason}: {err.message}"[:512],
+                      at=self.clock.now())
         forensics = FLIGHT.tail(ns, name, 20)
 
         def patch(status: dict[str, Any]) -> None:

@@ -1,0 +1,651 @@
+"""Fleet analytics: the aggregation layer over the per-run sensors.
+
+PRs 5/8/10/11/12 built primitives that *emit* — placement grants,
+stitched traces, flight-recorder rings, SLO histograms — but nothing
+*aggregates* them: there was no chip-time ledger, no answer to "where
+did this run's wall-clock go", and no fleet-efficiency figure the
+autoscaler (ROADMAP 3) or the defrag planner (ROADMAP 5) could burn
+on. Three legs live here:
+
+- :class:`ChipLedger` — per-grant chip-second accounting. A grant's
+  lifetime is partitioned into labeled segments (park, productive,
+  retry, preempted, failed, drain); timestamps are kept as integer
+  nanoseconds so ``granted == sum(buckets)`` holds EXACTLY for every
+  closed grant (telescoping integer sums cannot lose a remainder the
+  way float accumulation can). Controllers label transitions; the
+  ledger never guesses.
+- :class:`UtilizationTracker` — ring-buffered per-pool occupancy /
+  fragmentation snapshots taken at placement pressure points, the
+  time-series behind ``/debug/fleet/utilization`` and the bench
+  occupancy percentiles.
+- :func:`analyze_run` — the critical-path analyzer: consumes a
+  terminal run's flight-recorder ring (PR 8) and attributes the run's
+  wall-clock to phases (scheduling, queue-wait, placement,
+  dispatch-wait, execution, retry, preempted-retry, sub-story,
+  finalize). The attribution is a total state machine over the
+  timeline — every moment lands in exactly one phase, so the phase
+  sums cover the terminal wall-clock by construction.
+
+Everything here is best-effort telemetry fed from code that holds
+clocks (controllers pass ``now=``); a ledger mistake must never
+surface into a reconcile, so unknown grant ids are ignored and
+re-opens of a colliding slice id retire the stale entry instead of
+raising.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import Any, Iterable, Optional
+
+from .metrics import metrics
+
+_log = logging.getLogger(__name__)
+
+#: segment outcomes a grant's lifetime partitions into. "productive"
+#: is the goodput bucket; everything else is waste the fleet paid for:
+#: park        granted but not yet dispatched (placement-park, input
+#:             resolution, scheduling-gate holds)
+#: retry       a failed attempt's chip time + the wait to its redrive
+#: preempted   chip time lost to a reclaimed slice (since the last
+#:             accounted mark)
+#: failed      a terminally-failed attempt's chip time
+#: drain       terminal/rollback hold until the grant was released
+OUTCOMES = ("productive", "park", "retry", "preempted", "failed", "drain")
+
+#: closed-entry history cap (the per-grant detail behind balance
+#: asserts and the bench summary; totals are unbounded counters)
+_CLOSED_CAP = 4096
+
+
+def _ns(now: float) -> int:
+    return int(round(float(now) * 1e9))
+
+
+class _Entry:
+    __slots__ = ("slice_id", "pool", "chips", "tenant", "span_id",
+                 "opened_ns", "last_ns", "closed_ns", "buckets")
+
+    def __init__(self, slice_id: str, pool: str, chips: int,
+                 tenant: Optional[str], span_id: Optional[str],
+                 opened_ns: int):
+        self.slice_id = slice_id
+        self.pool = pool
+        self.chips = max(1, int(chips))
+        self.tenant = tenant
+        self.span_id = span_id
+        self.opened_ns = opened_ns
+        self.last_ns = opened_ns
+        self.closed_ns: Optional[int] = None
+        self.buckets: dict[str, int] = {}
+
+    def account(self, outcome: str, at_ns: int) -> int:
+        """Attribute the time since the last mark to ``outcome``;
+        returns the segment's nanoseconds. A clock that stepped
+        backwards yields a zero-length segment, never a negative one."""
+        at_ns = max(at_ns, self.last_ns)
+        dt = at_ns - self.last_ns
+        self.last_ns = at_ns
+        if dt:
+            self.buckets[outcome] = self.buckets.get(outcome, 0) + dt
+        return dt
+
+    @property
+    def granted_ns(self) -> int:
+        end = self.closed_ns if self.closed_ns is not None else self.last_ns
+        return end - self.opened_ns
+
+    def balanced(self) -> bool:
+        """granted == sum of buckets, exactly (integer nanoseconds)."""
+        return self.granted_ns == sum(self.buckets.values())
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "sliceId": self.slice_id,
+            "pool": self.pool,
+            "chips": self.chips,
+            "tenant": self.tenant,
+            "span": self.span_id,
+            "grantedSeconds": self.granted_ns / 1e9,
+            "buckets": {k: v / 1e9 for k, v in sorted(self.buckets.items())},
+            "closed": self.closed_ns is not None,
+        }
+
+
+class ChipLedger:
+    """Per-grant chip-second accounting with an exact balance invariant.
+
+    Controllers feed the three moves:
+
+    - :meth:`open_grant` when a slice grant is committed to a step;
+    - :meth:`account` at every labeled transition (dispatch, attempt
+      end, preemption) — attributes the time SINCE THE LAST MARK;
+    - :meth:`close_grant` when the grant is released (the remaining
+      tail gets the closing outcome, "drain" on the normal path).
+
+    Chip-seconds (segment seconds x chips) pour into
+    ``bobrapet_fleet_chip_seconds_total{pool,outcome}``, and productive
+    segments additionally into the per-tenant goodput counter the
+    ROADMAP-3 autoscaler scales on.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._open: dict[str, _Entry] = {}
+        self._closed: deque[_Entry] = deque(maxlen=_CLOSED_CAP)
+        #: pool -> outcome -> chip-nanoseconds (process-lifetime totals,
+        #: exact integers — the /debug and bench summaries read these)
+        self._totals: dict[str, dict[str, int]] = {}
+        #: tenant -> productive chip-nanoseconds
+        self._goodput: dict[str, int] = {}
+
+    # -- write path --------------------------------------------------------
+    def open_grant(
+        self,
+        grant: Optional[dict[str, Any]],
+        now: float,
+        tenant: Optional[str] = None,
+    ) -> None:
+        """Start the clock on a committed grant. Idempotent per slice
+        id: a re-announce of an already-open grant (the adopt path —
+        a step launch re-runs against a surviving StepRun) keeps the
+        ORIGINAL entry, open time and tenant; retiring-and-reopening
+        would mislabel the live grant's park/execution time as drain."""
+        if not grant or not grant.get("sliceId"):
+            return
+        sid = str(grant["sliceId"])
+        try:
+            from ..parallel.placement import chip_count
+
+            chips = chip_count(grant.get("topology") or "1")
+        except Exception:  # noqa: BLE001 - telemetry never raises
+            chips = 1
+        span_id = (grant.get("span") or {}).get("id")
+        at_ns = _ns(now)
+        with self._lock:
+            if sid in self._open:
+                return
+            self._open[sid] = _Entry(
+                sid, str(grant.get("pool") or ""), chips, tenant,
+                span_id, at_ns,
+            )
+            open_count = len(self._open)
+        metrics.fleet_open_grants.set(open_count)
+
+    def account(
+        self,
+        slice_id: Optional[str],
+        outcome: str,
+        now: float,
+        tenant: Optional[str] = None,
+    ) -> None:
+        """Label the segment since the last mark on this grant."""
+        if not slice_id:
+            return
+        with self._lock:
+            entry = self._open.get(str(slice_id))
+            if entry is None:
+                return
+            if tenant and entry.tenant is None:
+                entry.tenant = tenant
+            dt = entry.account(outcome, _ns(now))
+            if dt:
+                self._tally_locked(entry, outcome, dt)
+        if dt:
+            self._observe(entry, outcome, dt)
+
+    def close_grant(
+        self, slice_id: Optional[str], outcome: str, now: float
+    ) -> None:
+        """Release: the tail since the last mark gets ``outcome`` and
+        the entry is finalized (unknown ids are a no-op — grants placed
+        before this ledger existed, or already closed)."""
+        if not slice_id:
+            return
+        with self._lock:
+            entry = self._open.pop(str(slice_id), None)
+            if entry is None:
+                return
+            dt = self._close_locked(entry, outcome, _ns(now))
+            open_count = len(self._open)
+        if dt:
+            self._observe(entry, outcome, dt)
+        metrics.fleet_open_grants.set(open_count)
+
+    def _close_locked(self, entry: _Entry, outcome: str, at_ns: int) -> int:
+        dt = entry.account(outcome, at_ns)
+        entry.closed_ns = entry.last_ns
+        if dt:
+            self._tally_locked(entry, outcome, dt)
+        self._open.pop(entry.slice_id, None)
+        self._closed.append(entry)
+        return dt
+
+    def _tally_locked(self, entry: _Entry, outcome: str, dt_ns: int) -> None:
+        chip_ns = dt_ns * entry.chips
+        pool = self._totals.setdefault(entry.pool, {})
+        pool[outcome] = pool.get(outcome, 0) + chip_ns
+        if outcome == "productive":
+            tenant = entry.tenant or "default"
+            self._goodput[tenant] = self._goodput.get(tenant, 0) + chip_ns
+
+    def _observe(self, entry: _Entry, outcome: str, dt_ns: int) -> None:
+        chip_seconds = dt_ns * entry.chips / 1e9
+        metrics.fleet_chip_seconds.inc(entry.pool, outcome, by=chip_seconds)
+        if outcome == "productive":
+            metrics.fleet_goodput_chip_seconds.inc(
+                entry.tenant or "default", by=chip_seconds
+            )
+
+    # -- read path ---------------------------------------------------------
+    def entries(self, include_open: bool = True) -> list[dict[str, Any]]:
+        with self._lock:
+            out = [e.to_dict() for e in self._closed]
+            if include_open:
+                out.extend(e.to_dict() for e in self._open.values())
+        return out
+
+    def unbalanced(self) -> list[str]:
+        """Slice ids of CLOSED entries whose buckets do not sum to the
+        granted time — by construction this must stay empty; the churn
+        suite asserts on it."""
+        with self._lock:
+            return [e.slice_id for e in self._closed if not e.balanced()]
+
+    def summary(self) -> dict[str, Any]:
+        """Per-pool chip-second totals + waste fraction + per-tenant
+        goodput + span-level utilization (PR-12 multi-pool grants)."""
+        with self._lock:
+            pools: dict[str, Any] = {}
+            for pool, buckets in sorted(self._totals.items()):
+                granted = sum(buckets.values())
+                productive = buckets.get("productive", 0)
+                pools[pool] = {
+                    "chipSeconds": {
+                        k: v / 1e9 for k, v in sorted(buckets.items())
+                    },
+                    "grantedChipSeconds": granted / 1e9,
+                    "wasteFraction": (
+                        (granted - productive) / granted if granted else 0.0
+                    ),
+                }
+            spans: dict[str, Any] = {}
+            for e in list(self._closed) + list(self._open.values()):
+                if not e.span_id:
+                    continue
+                s = spans.setdefault(e.span_id, {
+                    "grants": 0, "pools": set(), "chips": 0,
+                    "grantedChipSeconds": 0.0, "productiveChipSeconds": 0.0,
+                })
+                s["grants"] += 1
+                s["pools"].add(e.pool)
+                s["chips"] += e.chips
+                s["grantedChipSeconds"] += e.granted_ns * e.chips / 1e9
+                s["productiveChipSeconds"] += (
+                    e.buckets.get("productive", 0) * e.chips / 1e9
+                )
+            for s in spans.values():
+                s["pools"] = sorted(s["pools"])
+                g = s["grantedChipSeconds"]
+                s["utilization"] = s["productiveChipSeconds"] / g if g else 0.0
+            return {
+                "pools": pools,
+                "goodputChipSeconds": {
+                    t: v / 1e9 for t, v in sorted(self._goodput.items())
+                },
+                "openGrants": len(self._open),
+                "closedGrants": len(self._closed),
+                "spans": spans,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._open.clear()
+            self._closed.clear()
+            self._totals.clear()
+            self._goodput.clear()
+
+
+#: the process-wide ledger (always on, like the flight recorder: a dict
+#: update under one lock per labeled transition — the soak cannot
+#: notice it)
+LEDGER = ChipLedger()
+
+
+# ---------------------------------------------------------------------------
+# pool occupancy / fragmentation time series
+# ---------------------------------------------------------------------------
+
+
+class UtilizationTracker:
+    """Ring-buffered per-pool occupancy snapshots.
+
+    ``sample`` is called at placement pressure points (grant open /
+    release); a real-time rate limit keeps the ring from being flooded
+    by a placement storm while ``force=True`` (tests, the debug
+    endpoint) always records. The ring bounds memory regardless of
+    uptime; the gauges carry the latest figure to /metrics.
+    """
+
+    def __init__(self, depth: int = 512, min_interval: float = 0.25):
+        self._lock = threading.Lock()
+        self._ring: deque[dict[str, Any]] = deque(maxlen=depth)
+        self._min_interval = min_interval
+        self._last_mono = 0.0
+
+    def sample(self, placer, now: float, force: bool = False) -> bool:
+        import time as _time
+
+        if placer is None:
+            return False
+        mono = _time.monotonic()
+        with self._lock:
+            if not force and mono - self._last_mono < self._min_interval:
+                return False
+            self._last_mono = mono
+        snaps = []
+        try:
+            for pool in placer.pools():
+                total = pool.total_chips
+                free = pool.free_chips()
+                occupied = total - free
+                largest = pool.largest_free_block()
+                schedulable = pool.schedulable_chips()
+                snap = {
+                    "at": float(now),
+                    "pool": pool.name,
+                    "totalChips": total,
+                    "occupiedChips": occupied,
+                    "schedulableChips": schedulable,
+                    "cordonedChips": pool.cordoned_chips(),
+                    "largestFreeBlock": largest,
+                    "occupancy": occupied / total if total else 0.0,
+                    "fragmentation": (
+                        largest / schedulable if schedulable else 1.0
+                    ),
+                }
+                snaps.append(snap)
+                metrics.fleet_pool_occupancy.set(snap["occupancy"], pool.name)
+        except Exception:  # noqa: BLE001 - telemetry never raises
+            return False
+        with self._lock:
+            self._ring.extend(snaps)
+        return True
+
+    def snapshots(self, pool: Optional[str] = None) -> list[dict[str, Any]]:
+        with self._lock:
+            snaps = list(self._ring)
+        if pool is not None:
+            snaps = [s for s in snaps if s["pool"] == pool]
+        return snaps
+
+    def occupancy_percentiles(
+        self, pool: Optional[str] = None
+    ) -> dict[str, float]:
+        vals = sorted(s["occupancy"] for s in self.snapshots(pool))
+        if not vals:
+            return {"p50": 0.0, "p95": 0.0, "samples": 0}
+
+        def pick(q: float) -> float:
+            return vals[min(len(vals) - 1, int(q * (len(vals) - 1) + 0.5))]
+
+        return {"p50": pick(0.5), "p95": pick(0.95), "samples": len(vals)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._last_mono = 0.0
+
+
+UTILIZATION = UtilizationTracker()
+
+
+def utilization_payload(placer) -> dict[str, Any]:
+    """The /debug/fleet/utilization document: current per-pool facts,
+    the snapshot ring, and the chip-time ledger summary."""
+    pools = []
+    if placer is not None:
+        for pool in placer.pools():
+            total = pool.total_chips
+            free = pool.free_chips()
+            pools.append({
+                "pool": pool.name,
+                "topology": pool.topology,
+                "totalChips": total,
+                "occupiedChips": total - free,
+                "schedulableChips": pool.schedulable_chips(),
+                "cordonedChips": pool.cordoned_chips(),
+                "largestFreeBlock": pool.largest_free_block(),
+                "fragmentation": pool.fragmentation(),
+            })
+    return {
+        "pools": pools,
+        "occupancy": {
+            p["pool"]: UTILIZATION.occupancy_percentiles(p["pool"])
+            for p in pools
+        },
+        "snapshots": UTILIZATION.snapshots(),
+        "ledger": LEDGER.summary(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# critical-path analyzer
+# ---------------------------------------------------------------------------
+
+#: flight-record kind -> the phase the RUN enters at that record. The
+#: state machine is total: every moment of [startedAt, finishedAt] is
+#: in exactly one phase, so the attribution sums to the terminal
+#: wall-clock by construction (the >=95% acceptance bound holds with
+#: float rounding as the only loss).
+_KIND_TO_PHASE = {
+    "queued": "queue-wait",
+    "no-capacity": "placement-park",
+    "launch": "dispatch-wait",
+    "placement": "dispatch-wait",
+    "dispatch": "execution",
+    "preemption": "preempted-retry",
+    "stale-scope": "retry",
+    "handoff": "sub-story",
+}
+
+#: span names summarized into the span breakdown (durations are
+#: time-base-free, so they compose with virtual-clock positions).
+#: Built from pairs: these are SPAN names, not dotted config keys.
+_SPAN_PHASES = dict([
+    ("steprun.dispatch", "dispatch"),
+    ("sdk.step", "sdk-execution"),
+    ("slice.place", "placement-decision"),
+    ("slice.place_group", "placement-decision"),
+    ("serving.request", "serving"),
+])
+
+
+def analyze_run(
+    status: dict[str, Any],
+    timeline: Iterable[dict[str, Any]],
+) -> Optional[dict[str, Any]]:
+    """Attribute a terminal run's wall-clock to phases and compute its
+    critical path from per-step timings.
+
+    ``status`` is the StoryRun's terminal status (startedAt/finishedAt/
+    stepStates); ``timeline`` is its flight-recorder ring. Returns None
+    when the run carries no usable clock bounds.
+    """
+    try:
+        started = float(status.get("startedAt"))
+        finished = float(status.get("finishedAt"))
+    except (TypeError, ValueError):
+        return None
+    wall = finished - started
+    if wall < 0:
+        return None
+
+    # --- exclusive phase attribution (total state machine) ---
+    events = []
+    for rec in timeline:
+        phase = _KIND_TO_PHASE.get(rec.get("kind", ""))
+        if phase is None:
+            continue
+        at = rec.get("at")
+        if at is None:
+            continue
+        at = float(at)
+        if at < started or at > finished:
+            # a record from another time base (wall-clock span sinks in
+            # a virtual-clock run) must not fold the state machine
+            continue
+        events.append((at, phase))
+    events.sort(key=lambda e: e[0])
+
+    phases: dict[str, float] = {}
+    segments: list[dict[str, Any]] = []
+    cursor, state = started, "scheduling"
+    for at, phase in events + [(finished, "finalize")]:
+        if at > cursor:
+            phases[state] = phases.get(state, 0.0) + (at - cursor)
+            segments.append({
+                "phase": state,
+                "from": cursor,
+                "to": at,
+                "seconds": at - cursor,
+            })
+            cursor = at
+        state = phase
+
+    covered = sum(phases.values())
+
+    # --- critical path through step completion times ---
+    steps = []
+    for name, raw in (status.get("stepStates") or {}).items():
+        if not isinstance(raw, dict):
+            continue
+        s0, s1 = raw.get("startedAt"), raw.get("finishedAt")
+        if s0 is None:
+            continue
+        steps.append({
+            "step": name,
+            "startedAt": float(s0),
+            "finishedAt": float(s1) if s1 is not None else finished,
+            "phase": raw.get("phase"),
+        })
+    critical: list[dict[str, Any]] = []
+    if steps:
+        node = max(steps, key=lambda s: s["finishedAt"])
+        seen = set()
+        while node is not None and node["step"] not in seen:
+            seen.add(node["step"])
+            critical.append({
+                "step": node["step"],
+                "startedAt": node["startedAt"],
+                "finishedAt": node["finishedAt"],
+                "seconds": node["finishedAt"] - node["startedAt"],
+            })
+            # predecessor: the latest-finishing step that completed at
+            # or before this one started (the one it plausibly waited on)
+            preds = [
+                s for s in steps
+                if s["step"] not in seen
+                and s["finishedAt"] <= node["startedAt"] + 1e-9
+            ]
+            node = max(preds, key=lambda s: s["finishedAt"]) if preds else None
+        critical.reverse()
+
+    # --- span breakdown (durations only; base-free) ---
+    span_breakdown: dict[str, float] = {}
+    for rec in timeline:
+        if rec.get("kind") != "span":
+            continue
+        name = _SPAN_PHASES.get(str(rec.get("message") or ""))
+        if name is None:
+            continue
+        dur = rec.get("durationMs")
+        if dur is None:
+            continue
+        span_breakdown[name] = span_breakdown.get(name, 0.0) + float(dur) / 1e3
+
+    return {
+        "wallClockSeconds": wall,
+        "phases": {k: v for k, v in sorted(phases.items())},
+        "coverage": covered / wall if wall else 1.0,
+        "criticalPath": critical,
+        "spanBreakdown": {
+            k: v for k, v in sorted(span_breakdown.items())
+        },
+        "segments": segments,
+    }
+
+
+def compact_analysis(analysis: dict[str, Any]) -> dict[str, Any]:
+    """The status-stamped form: small enough to ride every terminal
+    StoryRun (the full breakdown stays behind the debug endpoint)."""
+    return {
+        "wallClockSeconds": round(analysis["wallClockSeconds"], 6),
+        "phases": {
+            k: round(v, 6) for k, v in analysis["phases"].items()
+        },
+        "coverage": round(analysis["coverage"], 4),
+        "criticalPath": [c["step"] for c in analysis["criticalPath"]],
+    }
+
+
+# ---------------------------------------------------------------------------
+# backend fallback (runtime surface of the bench-only probe facts)
+# ---------------------------------------------------------------------------
+
+#: reasons already logged once (the metric counts every occurrence;
+#: the log line is a startup fact, not a per-step nag)
+_FALLBACK_LOGGED: set[str] = set()
+_FALLBACK_LOCK = threading.Lock()
+
+
+def record_backend_fallback(reason: str, detail: str = "") -> None:
+    """Count (and log, once per reason) a run proceeding on a fallback
+    backend — e.g. a TPU grant whose worker found only CPU devices.
+    Every BENCH_r0x run has silently done this; the live metrics plane
+    now says so: ``bobrapet_backend_fallback_total{reason}``."""
+    reason = reason or "unknown"
+    metrics.backend_fallback.inc(reason)
+    with _FALLBACK_LOCK:
+        fresh = reason not in _FALLBACK_LOGGED
+        if fresh:
+            _FALLBACK_LOGGED.add(reason)
+    if fresh:
+        _log.warning(
+            "backend fallback (%s): proceeding on a non-granted backend%s",
+            reason, f" — {detail}" if detail else "",
+        )
+
+
+def check_backend_expectation(accelerator: Optional[str]) -> None:
+    """Worker-side probe: the env contract granted a TPU accelerator
+    but jax initialized on CPU (probe timeout / missing plugin) — make
+    the silent fallback visible in the live metrics plane. Never
+    imports jax when it is not already loaded (a pure control-plane
+    process must not pay backend init for telemetry)."""
+    if not accelerator:
+        return
+    import sys as _sys
+
+    jax = _sys.modules.get("jax")
+    if jax is None:
+        return
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 - backend init failure
+        record_backend_fallback(
+            "backend-init-failed", f"granted {accelerator}"
+        )
+        return
+    if backend == "cpu" and "cpu" not in str(accelerator).lower():
+        record_backend_fallback(
+            "accelerator-grant-on-cpu",
+            f"granted {accelerator}, jax backend is cpu",
+        )
+
+
+def reset_backend_fallback_log() -> None:
+    with _FALLBACK_LOCK:
+        _FALLBACK_LOGGED.clear()

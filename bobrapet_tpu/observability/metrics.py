@@ -382,6 +382,58 @@ class _ControlPlaneMetrics:
             "Cell suspicion reports by source",
             ["source"],
         )
+        # Fleet utilization accounting (observability/analytics.py):
+        # every grant's lifetime partitions into labeled chip-second
+        # buckets — granted == productive + each waste bucket, exactly
+        self.fleet_chip_seconds = c(
+            "bobrapet_fleet_chip_seconds_total",
+            "Chip-seconds by outcome (productive = goodput; park/retry/"
+            "preempted/failed/drain = what the fleet paid for nothing)",
+            ["pool", "outcome"],
+        )
+        self.fleet_goodput_chip_seconds = c(
+            "bobrapet_fleet_goodput_chip_seconds_total",
+            "Productive chip-seconds per tenant (the autoscaler's "
+            "scale-on signal; tenant = bobrapet.io/tenant label or the "
+            "run namespace)",
+            ["tenant"],
+        )
+        self.fleet_open_grants = g(
+            "bobrapet_fleet_open_grants",
+            "Grants currently open in the chip-time ledger",
+            [],
+        )
+        self.fleet_pool_occupancy = g(
+            "bobrapet_fleet_pool_occupancy",
+            "Occupied / total chips per pool (latest utilization "
+            "snapshot; the time series rings at /debug/fleet/"
+            "utilization)",
+            ["pool"],
+        )
+        # Backend fallback surfaced at runtime (was bench-file-only):
+        # a TPU-granted worker that initialized on CPU now counts here
+        self.backend_fallback = c(
+            "bobrapet_backend_fallback_total",
+            "Runs/workers that proceeded on a fallback backend (reason "
+            "= accelerator-grant-on-cpu | backend-init-failed | "
+            "probe-timeout | probe-error)",
+            ["reason"],
+        )
+        # Continuous control-plane profiler (observability/profiler.py)
+        self.profiler_samples = c(
+            "bobrapet_profiler_samples_total",
+            "Thread-stack samples by classification (busy = CPU, idle "
+            "= blocked in a wait primitive, lock-wait = blocked on an "
+            "instrumented repo lock)",
+            ["kind"],
+        )
+        self.profiler_overhead = g(
+            "bobrapet_profiler_overhead_ratio",
+            "Profiler self-cost: sampling seconds per wall second "
+            "(measured, not assumed; the soak smoke bounds the "
+            "end-to-end cost)",
+            [],
+        )
         # Sharded control plane (bobrapet_tpu/shard; TPU-native addition —
         # the reference is deliberately single-active-manager, see
         # internal/config/operator.go; this is the scale-out past it)

@@ -80,9 +80,15 @@ class FlightRecorder:
         message: str = "",
         trace_id: Optional[str] = None,
         span_id: Optional[str] = None,
+        at: Optional[float] = None,
         **attrs: Any,
     ) -> None:
-        rec: dict[str, Any] = {"at": time.time(), "kind": kind}
+        # controllers pass ``at=clock.now()`` so record positions share
+        # the run's status time base (virtual under ManualClock) — the
+        # critical-path analyzer attributes wall-clock from them
+        rec: dict[str, Any] = {
+            "at": time.time() if at is None else float(at), "kind": kind,
+        }
         if message:
             rec["message"] = message
         if trace_id:
@@ -146,6 +152,15 @@ class FlightRecorder:
     def known(self, namespace: str, run: str) -> bool:
         with self._lock:
             return (namespace, run) in self._runs
+
+    def recent_runs(self, limit: int = 50) -> list[tuple[str, str]]:
+        """Run keys in most-recently-recorded order (the LRU order) —
+        the /debug/runs list endpoint's recency source, which covers
+        dead runs the store has already reaped."""
+        with self._lock:
+            keys = list(self._runs.keys())
+        keys.reverse()
+        return keys[: max(1, int(limit))]
 
     # -- lifecycle ---------------------------------------------------------
     def forget(self, namespace: str, run: str) -> None:

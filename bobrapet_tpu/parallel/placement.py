@@ -838,6 +838,10 @@ class SlicePlacer:
     def pool(self, name: str) -> Optional[SlicePool]:
         return self._pools.get(name)
 
+    def pools(self) -> list[SlicePool]:
+        """Every pool, name-ordered (the utilization tracker's walk)."""
+        return [self._pools[n] for n in sorted(self._pools)]
+
     def _pool_for(self, queue: Optional[str]) -> SlicePool:
         pool = self._pools.get(queue or "") or self._pools["local"]
         if self.cordon_source is not None:

@@ -360,6 +360,16 @@ class Runtime:
         FLIGHT.set_depth(cfg.telemetry.flight_recorder_depth)
         set_slo_thresholds(cfg.telemetry.slo_ttft_threshold_seconds,
                            cfg.telemetry.slo_tpot_threshold_seconds)
+        # continuous control-plane profiler (telemetry.profiler-*):
+        # flipping the key starts/stops the sampler thread; interval and
+        # depth retune a running sampler from the very next sample
+        from .observability.profiler import PROFILER
+
+        PROFILER.configure(
+            cfg.telemetry.profiler_enabled,
+            interval=cfg.telemetry.profiler_interval_seconds,
+            depth=cfg.telemetry.profiler_depth,
+        )
 
     @staticmethod
     def _apply_serving_tuning(cfg) -> None:
@@ -910,6 +920,14 @@ class Runtime:
         terminal = bool(phase and Phase(phase).is_terminal)
         if ev.type == DELETED or (terminal and not sr.status.get("sliceReleased")):
             self.placer.release(grant)
+            # chip-time ledger: the tail from the step's terminal mark
+            # to this release is drain; the release is also a capacity
+            # change worth a utilization snapshot
+            from .observability.analytics import LEDGER, UTILIZATION
+
+            now = self.clock.now()
+            LEDGER.close_grant(grant.get("sliceId"), "drain", now)
+            UTILIZATION.sample(self.placer, now)
             if ev.type != DELETED:
                 try:
                     self.store.patch_status(

@@ -165,8 +165,19 @@ class EngramContext:
         ici = axes or self.mesh_axes or None
         replicas = self.dcn_replicas
         if replicas > 1:
-            return build_two_level_mesh(replicas, ici)
-        return build_mesh(ici)
+            mesh = build_two_level_mesh(replicas, ici)
+        else:
+            mesh = build_mesh(ici)
+        # the grant promised an accelerator; jax just initialized its
+        # backend to build the mesh — if that landed on CPU, surface the
+        # fallback in the live metrics plane instead of only in bench
+        # forensics (bobrapet_backend_fallback_total{reason} + one log)
+        from ..observability.analytics import check_backend_expectation
+
+        check_backend_expectation(
+            self.env.get(contract.ENV_TPU_ACCELERATOR)
+        )
+        return mesh
 
     # -- data --------------------------------------------------------------
 

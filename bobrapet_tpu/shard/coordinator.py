@@ -268,7 +268,7 @@ class ShardCoordinator:
             message=f"accepted by shard {self.router.me} (parent {parent} "
                     f"on shard {self.router.owner_of(f'{ns}/{parent}')})",
             trace_id=trace.get("traceId"), span_id=trace.get("spanId"),
-            shard=self.router.me,
+            shard=self.router.me, at=self.clock.now(),
         )
         if self.recorder is not None:
             self.recorder.normal(

@@ -66,7 +66,15 @@ def rt():
 def _shared_clean_registry():
     yield
     from bobrapet_tpu.sdk.registry import clear_registry
+    from bobrapet_tpu.observability.analytics import LEDGER, UTILIZATION
     from bobrapet_tpu.observability.metrics import REGISTRY
+    from bobrapet_tpu.observability.profiler import PROFILER
 
     clear_registry()
     REGISTRY.reset()
+    # fleet analytics are process-global like the metrics registry:
+    # reset between tests so balance asserts see only their own grants
+    # and a profiler a test enabled never samples into the next one
+    PROFILER.configure(False)
+    LEDGER.reset()
+    UTILIZATION.reset()

@@ -1,0 +1,512 @@
+"""Fleet analytics suite (ISSUE 13): chip-time ledger balance, the
+critical-path analyzer's phase attribution, the utilization tracker,
+the backend-fallback surface, and the continuous control-plane
+profiler — plus the acceptance e2e: a story through one preemption AND
+one user-budget retry whose phase attributions cover >= 95% of the
+terminal wall-clock while every grant's ledger balances exactly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from bobrapet_tpu.api.catalog import make_engram_template
+from bobrapet_tpu.api.engram import make_engram
+from bobrapet_tpu.api.story import make_story
+from bobrapet_tpu.observability.analytics import (
+    LEDGER,
+    UTILIZATION,
+    ChipLedger,
+    UtilizationTracker,
+    analyze_run,
+    compact_analysis,
+    record_backend_fallback,
+    reset_backend_fallback_log,
+)
+from bobrapet_tpu.observability.metrics import metrics
+from bobrapet_tpu.observability.profiler import PROFILER, SamplingProfiler
+from bobrapet_tpu.parallel.placement import SlicePlacer, SlicePool
+from bobrapet_tpu.runtime import Runtime
+from bobrapet_tpu.sdk import register_engram
+
+
+def _grant(sid="p-s1", pool="p", topology="2x2", span=None):
+    g = {"sliceId": sid, "pool": pool, "topology": topology}
+    if span:
+        g["span"] = span
+    return g
+
+
+class TestChipLedger:
+    def test_granted_equals_sum_of_buckets_exactly(self):
+        led = ChipLedger()
+        led.open_grant(_grant(), 10.0)
+        led.account("p-s1", "park", 10.3)
+        led.account("p-s1", "productive", 17.9)
+        led.close_grant("p-s1", "drain", 18.0001)
+        (entry,) = led.entries()
+        assert entry["closed"]
+        assert led.unbalanced() == []
+        # 4 chips x 8.0001s granted; the bucket split is exact
+        assert entry["grantedSeconds"] == pytest.approx(8.0001)
+        assert set(entry["buckets"]) == {"park", "productive", "drain"}
+
+    def test_chip_seconds_metrics_scale_by_chips(self):
+        led = ChipLedger()
+        led.open_grant(_grant(topology="2x2"), 0.0)  # 4 chips
+        led.account("p-s1", "productive", 10.0)
+        led.close_grant("p-s1", "drain", 10.0)
+        assert metrics.fleet_chip_seconds.value("p", "productive") == (
+            pytest.approx(40.0)
+        )
+
+    def test_goodput_counts_per_tenant(self):
+        led = ChipLedger()
+        led.open_grant(_grant(), 0.0, tenant="acme")
+        led.account("p-s1", "productive", 2.0)
+        led.close_grant("p-s1", "drain", 2.0)
+        assert led.summary()["goodputChipSeconds"]["acme"] == (
+            pytest.approx(8.0)
+        )
+        assert metrics.fleet_goodput_chip_seconds.value("acme") == (
+            pytest.approx(8.0)
+        )
+
+    def test_waste_fraction(self):
+        led = ChipLedger()
+        led.open_grant(_grant(topology="1"), 0.0)
+        led.account("p-s1", "productive", 6.0)
+        led.account("p-s1", "retry", 8.0)
+        led.close_grant("p-s1", "drain", 10.0)
+        pool = led.summary()["pools"]["p"]
+        assert pool["wasteFraction"] == pytest.approx(0.4)
+
+    def test_backwards_clock_never_goes_negative(self):
+        led = ChipLedger()
+        led.open_grant(_grant(), 100.0)
+        led.account("p-s1", "park", 99.0)  # clock stepped back
+        led.close_grant("p-s1", "drain", 101.0)
+        assert led.unbalanced() == []
+        (entry,) = led.entries()
+        assert all(v >= 0 for v in entry["buckets"].values())
+
+    def test_unknown_and_double_close_are_noops(self):
+        led = ChipLedger()
+        led.account("ghost", "productive", 1.0)
+        led.close_grant("ghost", "drain", 1.0)
+        led.open_grant(_grant(), 0.0)
+        led.close_grant("p-s1", "drain", 1.0)
+        led.close_grant("p-s1", "drain", 2.0)
+        assert len(led.entries()) == 1
+
+    def test_reopen_of_live_grant_keeps_original_entry(self):
+        # the adopt path re-announces a surviving grant: the ORIGINAL
+        # open time and tenant must win, or the live grant's park time
+        # would misattribute to drain on every adopt
+        led = ChipLedger()
+        led.open_grant(_grant(sid="local-s1"), 0.0, tenant="acme")
+        led.open_grant(_grant(sid="local-s1"), 5.0, tenant="other")
+        led.account("local-s1", "productive", 10.0)
+        led.close_grant("local-s1", "drain", 10.0)
+        (entry,) = led.entries()
+        assert entry["grantedSeconds"] == pytest.approx(10.0)
+        assert entry["tenant"] == "acme"
+        assert led.unbalanced() == []
+
+    def test_failed_validation_attempt_counts_as_failed_waste(self):
+        # steprun._fail (schema/postExecution failures) accounts the
+        # attempt under "failed" before release closes the grant
+        rt = Runtime()
+        rt.placer.add_pool(SlicePool("v5e", "4x4", chips_per_host=2))
+
+        @register_engram("an-badout")
+        def badout(ctx):
+            ctx._clock.sleep(1.0)
+            return {"wrong": "shape"}
+
+        rt.apply(make_engram_template(
+            "an-bad-tpl", entrypoint="an-badout",
+            outputSchema={"type": "object", "required": ["ok"],
+                          "properties": {"ok": {"type": "boolean"}}},
+        ))
+        rt.apply(make_engram("an-bad-worker", "an-bad-tpl"))
+        rt.apply(make_story("an-bad-story", steps=[
+            {"name": "fit", "ref": {"name": "an-bad-worker"},
+             "tpu": {"topology": "2x2"},
+             "execution": {"retry": {"maxRetries": 0}}},
+        ], policy={"queue": "v5e"}))
+        LEDGER.reset()
+        run = rt.run_story("an-bad-story")
+        while rt.pump(max_virtual_seconds=43_200.0) > 0:
+            pass
+        assert rt.run_phase(run) == "Failed"
+        (entry,) = LEDGER.entries()
+        assert entry["closed"]
+        assert entry["buckets"].get("failed", 0) > 0
+        assert LEDGER.unbalanced() == []
+
+    def test_span_level_utilization_aggregates_pools(self):
+        led = ChipLedger()
+        span = {"id": "span-1", "pools": ["a", "b"]}
+        led.open_grant(_grant(sid="a-s1", pool="a", span=span), 0.0)
+        led.open_grant(_grant(sid="b-s1", pool="b", span=span), 0.0)
+        led.account("a-s1", "productive", 10.0)
+        led.account("b-s1", "productive", 10.0)
+        led.close_grant("a-s1", "drain", 10.0)
+        led.close_grant("b-s1", "drain", 10.0)
+        spans = led.summary()["spans"]
+        assert spans["span-1"]["grants"] == 2
+        assert spans["span-1"]["pools"] == ["a", "b"]
+        assert spans["span-1"]["utilization"] == pytest.approx(1.0)
+
+
+class TestUtilizationTracker:
+    def test_snapshots_and_percentiles(self):
+        placer = SlicePlacer([SlicePool("v5e", "4x4")])
+        tracker = UtilizationTracker()
+        tracker.sample(placer, 1.0, force=True)
+        g = placer.pool("v5e").allocate(want_topology="4x4")
+        tracker.sample(placer, 2.0, force=True)
+        placer.pool("v5e").release(g.slice_id)
+        tracker.sample(placer, 3.0, force=True)
+        snaps = tracker.snapshots("v5e")
+        assert [s["occupancy"] for s in snaps] == [0.0, 1.0, 0.0]
+        pct = tracker.occupancy_percentiles("v5e")
+        assert pct["samples"] == 3
+        assert pct["p50"] == 0.0 and pct["p95"] == 1.0
+
+    def test_rate_limit_skips_unforced_samples(self):
+        placer = SlicePlacer()
+        tracker = UtilizationTracker(min_interval=60.0)
+        assert tracker.sample(placer, 1.0)
+        assert not tracker.sample(placer, 2.0)
+        assert tracker.sample(placer, 3.0, force=True)
+
+
+class TestAnalyzer:
+    def _status(self, steps=None):
+        return {
+            "startedAt": 0.0,
+            "finishedAt": 100.0,
+            "stepStates": steps or {},
+        }
+
+    def test_phase_attribution_on_known_durations(self):
+        timeline = [
+            {"at": 10.0, "kind": "launch"},       # 0-10 scheduling
+            {"at": 20.0, "kind": "dispatch"},     # 10-20 dispatch-wait
+            {"at": 50.0, "kind": "preemption"},   # 20-50 execution
+            {"at": 60.0, "kind": "dispatch"},     # 50-60 preempted-retry
+        ]                                          # 60-100 execution
+        a = analyze_run(self._status(), timeline)
+        assert a["wallClockSeconds"] == pytest.approx(100.0)
+        assert a["phases"]["scheduling"] == pytest.approx(10.0)
+        assert a["phases"]["dispatch-wait"] == pytest.approx(10.0)
+        assert a["phases"]["preempted-retry"] == pytest.approx(10.0)
+        assert a["phases"]["execution"] == pytest.approx(70.0)
+        # the state machine is total: attribution covers the wall-clock
+        assert sum(a["phases"].values()) == pytest.approx(100.0)
+        assert a["coverage"] == pytest.approx(1.0)
+
+    def test_queue_and_park_phases(self):
+        timeline = [
+            {"at": 5.0, "kind": "queued"},
+            {"at": 30.0, "kind": "no-capacity"},
+            {"at": 70.0, "kind": "launch"},
+            {"at": 75.0, "kind": "dispatch"},
+        ]
+        a = analyze_run(self._status(), timeline)
+        assert a["phases"]["queue-wait"] == pytest.approx(25.0)
+        assert a["phases"]["placement-park"] == pytest.approx(40.0)
+        assert a["phases"]["execution"] == pytest.approx(25.0)
+
+    def test_records_from_another_time_base_are_ignored(self):
+        # span-sink records carry wall-clock stamps in virtual-clock
+        # runs; they must not fold the state machine
+        timeline = [
+            {"at": 10.0, "kind": "dispatch"},
+            {"at": 1.7e9, "kind": "dispatch"},
+        ]
+        a = analyze_run(self._status(), timeline)
+        assert sum(a["phases"].values()) == pytest.approx(100.0)
+
+    def test_critical_path_walks_predecessors(self):
+        steps = {
+            "a": {"startedAt": 0.0, "finishedAt": 40.0, "phase": "Succeeded"},
+            "side": {"startedAt": 0.0, "finishedAt": 10.0,
+                     "phase": "Succeeded"},
+            "b": {"startedAt": 40.0, "finishedAt": 100.0,
+                  "phase": "Succeeded"},
+        }
+        a = analyze_run(self._status(steps), [])
+        assert [c["step"] for c in a["criticalPath"]] == ["a", "b"]
+        assert a["criticalPath"][-1]["seconds"] == pytest.approx(60.0)
+
+    def test_span_breakdown_sums_durations(self):
+        timeline = [
+            {"at": 1.0, "kind": "span", "message": "sdk.step",
+             "durationMs": 1500.0},
+            {"at": 2.0, "kind": "span", "message": "sdk.step",
+             "durationMs": 500.0},
+            {"at": 3.0, "kind": "span", "message": "steprun.dispatch",
+             "durationMs": 10.0},
+        ]
+        a = analyze_run(self._status(), timeline)
+        assert a["spanBreakdown"]["sdk-execution"] == pytest.approx(2.0)
+        assert a["spanBreakdown"]["dispatch"] == pytest.approx(0.01)
+
+    def test_no_clock_bounds_returns_none(self):
+        assert analyze_run({"startedAt": 5.0}, []) is None
+        assert analyze_run({}, []) is None
+
+    def test_compact_form_is_small(self):
+        a = analyze_run(self._status(), [{"at": 10.0, "kind": "dispatch"}])
+        c = compact_analysis(a)
+        assert set(c) == {"wallClockSeconds", "phases", "coverage",
+                          "criticalPath"}
+
+
+class TestBackendFallback:
+    def test_counts_and_logs_once_per_reason(self, caplog):
+        reset_backend_fallback_log()
+        with caplog.at_level("WARNING"):
+            record_backend_fallback("probe-timeout", "tunnel cold")
+            record_backend_fallback("probe-timeout", "still cold")
+        assert metrics.backend_fallback.value("probe-timeout") == 2
+        assert sum(
+            "backend fallback" in r.message for r in caplog.records
+        ) == 1
+
+
+class TestProfiler:
+    def test_samples_busy_and_idle_threads(self):
+        prof = SamplingProfiler(interval=0.005, depth=8)
+        stop = threading.Event()
+
+        def busy():
+            x = 0
+            while not stop.is_set():
+                x += 1  # pure CPU
+
+        def idle():
+            stop.wait(5.0)
+
+        threads = [threading.Thread(target=busy, daemon=True),
+                   threading.Thread(target=idle, daemon=True)]
+        for t in threads:
+            t.start()
+        prof.start()
+        try:
+            time.sleep(0.4)
+        finally:
+            prof.stop()
+            stop.set()
+            for t in threads:
+                t.join(timeout=2.0)
+        snap = prof.snapshot()
+        assert snap["samples"] > 10
+        kinds = {s["kind"] for s in snap["topStacks"]}
+        assert "busy" in kinds and "idle" in kinds
+        # the self-overhead is measured and plausibly nonzero
+        assert 0.0 < snap["overheadRatio"] < 0.5
+        assert metrics.profiler_overhead.value() > 0.0
+
+    def test_lock_wait_attribution_via_sanitizer_classes(self):
+        from bobrapet_tpu.analysis.lockorder import sanitize_locks
+
+        with sanitize_locks():
+            lock = threading.Lock()  # repo-tracked allocation site
+
+            def holder():
+                # deliberately HOLDS the lock across a sleep — the
+                # condition under test, so no with-block sugar here
+                lock.acquire()
+                try:
+                    time.sleep(0.5)
+                finally:
+                    lock.release()
+
+            def blocker():
+                with lock:
+                    pass
+
+            prof = SamplingProfiler(interval=0.005, depth=8)
+            h = threading.Thread(target=holder, daemon=True)
+            h.start()
+            time.sleep(0.05)  # holder owns the lock
+            b = threading.Thread(target=blocker, daemon=True)
+            b.start()
+            prof.start()
+            try:
+                time.sleep(0.3)
+            finally:
+                prof.stop()
+                h.join(timeout=2.0)
+                b.join(timeout=2.0)
+        snap = prof.snapshot()
+        # the blocked thread attributes to the lock's ALLOCATION-SITE
+        # class (module:lineno), the lockdep naming
+        assert snap["lockWaits"], snap["topStacks"]
+        assert any("test_analytics" in k for k in snap["lockWaits"])
+
+    def test_configure_is_live(self):
+        prof = SamplingProfiler(interval=0.5)
+        prof.configure(True, interval=0.005, depth=4)
+        try:
+            assert prof.running
+            assert prof.interval == 0.005 and prof.depth == 4
+            time.sleep(0.05)
+        finally:
+            prof.configure(False)
+        assert not prof.running
+
+    def test_runtime_toggles_profiler_from_config(self):
+        from bobrapet_tpu.core.object import new_resource
+
+        rt = Runtime()
+        assert not PROFILER.running
+        rt.store.create(new_resource(
+            "ConfigMap", "operator-config", "bobrapet-system",
+            spec={"data": {"telemetry.profiler-enabled": "true",
+                           "telemetry.profiler-interval": "5ms"}},
+        ))
+        try:
+            assert PROFILER.running
+            assert PROFILER.interval == pytest.approx(0.005)
+        finally:
+            PROFILER.configure(False)
+
+
+class TestConfigKeys:
+    def test_profiler_keys_parse_and_validate(self):
+        from bobrapet_tpu.config.operator import OperatorConfig, parse_config
+
+        cfg = parse_config({
+            "telemetry.profiler-enabled": "true",
+            "telemetry.profiler-interval": "50ms",
+            "telemetry.profiler-depth": "6",
+        })
+        assert cfg.telemetry.profiler_enabled
+        assert cfg.telemetry.profiler_interval_seconds == pytest.approx(0.05)
+        assert cfg.telemetry.profiler_depth == 6
+        bad = OperatorConfig()
+        bad.telemetry.profiler_interval_seconds = 0.0
+        assert any("profiler-interval" in e for e in bad.validate())
+        bad = OperatorConfig()
+        bad.telemetry.profiler_depth = 0
+        assert any("profiler-depth" in e for e in bad.validate())
+
+
+# ---------------------------------------------------------------------------
+# acceptance e2e: preemption + retry, >=95% attribution, exact balance
+# ---------------------------------------------------------------------------
+
+
+class _OnePreemption:
+    """Minimal injector: preempt host 0 of the first eligible gang Job
+    once (duck-types PreemptionInjector's plan())."""
+
+    min_hosts = 2
+
+    def __init__(self):
+        self.fired = False
+        self.planned = 0
+
+    def plan(self, job):
+        if self.fired:
+            return None
+        if int(job.spec.get("hosts") or 1) < self.min_hosts:
+            return None
+        if not job.spec.get("sliceGrant"):
+            return None
+        self.fired = True
+        self.planned += 1
+        return {"host": 0, "afterPolls": 2}
+
+
+class TestE2ECriticalPathAndLedger:
+    def test_preemption_plus_retry_run_attributes_and_balances(self):
+        LEDGER.reset()
+        UTILIZATION.reset()
+        rt = Runtime(preemption_injector=_OnePreemption())
+        rt.config_manager.config.retention.children_ttl_seconds = 7 * 86400.0
+        rt.config_manager.config.retention.storyrun_retention_seconds = (
+            14 * 86400.0
+        )
+        rt.placer.add_pool(SlicePool("v5e", "4x4", chips_per_host=2))
+        calls = {"n": 0}
+
+        @register_engram("an-train")
+        def train(ctx):
+            # each training step burns VIRTUAL time (the sync executor
+            # is otherwise instantaneous under ManualClock), so the
+            # attempt segments — preempted, retry, productive — have
+            # nonzero chip-seconds to account
+            if ctx.host_id != 0:
+                for _ in range(4):
+                    ctx.check_deadline()
+                return None
+            calls["n"] += 1
+            for _ in range(4):
+                ctx._clock.sleep(0.5)
+                ctx.check_deadline()
+            if calls["n"] == 2:
+                # the attempt after the preemption redrive dies once of
+                # a retryable signal (SIGTERM-class, USER budget) — the
+                # run sees both waste shapes
+                from bobrapet_tpu.sdk.context import EngramExit
+
+                raise EngramExit(143, "transient wobble")
+            return {"ok": calls["n"]}
+
+        rt.apply(make_engram_template("an-tpl", entrypoint="an-train"))
+        rt.apply(make_engram("an-worker", "an-tpl"))
+        rt.apply(make_story("an-story", steps=[
+            {"name": "fit", "ref": {"name": "an-worker"},
+             "tpu": {"topology": "2x2"},
+             "execution": {"retry": {"maxRetries": 2}}},
+        ], policy={"queue": "v5e"}))
+        run = rt.run_story("an-story")
+        while rt.pump(max_virtual_seconds=43_200.0) > 0:
+            pass
+
+        srun = rt.store.get("StoryRun", "default", run)
+        assert srun.status["phase"] == "Succeeded", srun.status
+        (sr,) = [
+            s for s in rt.store.list("StepRun")
+            if (s.spec.get("storyRunRef") or {}).get("name") == run
+        ]
+        assert sr.status.get("preemptions") == 1
+        assert int(sr.status.get("retries") or 0) >= 1
+
+        # --- acceptance: phase attributions cover >= 95% wall-clock ---
+        analysis = srun.status.get("analysis")
+        assert analysis is not None
+        wall = analysis["wallClockSeconds"]
+        assert wall > 0.0  # redrive + retry delays advanced the clock
+        assert sum(analysis["phases"].values()) >= 0.95 * wall
+        assert analysis["coverage"] >= 0.95
+        assert analysis["criticalPath"] == ["fit"]
+        # both waste shapes are visible in the attribution
+        assert "preempted-retry" in analysis["phases"]
+
+        # --- acceptance: ledger balances exactly for every grant ---
+        assert LEDGER.unbalanced() == []
+        entries = LEDGER.entries()
+        assert len(entries) == 2  # the preempted grant + its replacement
+        assert all(e["closed"] for e in entries)
+        buckets = set()
+        for e in entries:
+            buckets |= set(e["buckets"])
+        assert "preempted" in buckets
+        assert "productive" in buckets
+        assert "retry" in buckets
+        summary = LEDGER.summary()
+        pool = summary["pools"]["v5e"]
+        assert pool["grantedChipSeconds"] > 0
+        assert 0.0 < pool["wasteFraction"] < 1.0
+        # goodput landed on the run's namespace tenant
+        assert summary["goodputChipSeconds"]["default"] > 0

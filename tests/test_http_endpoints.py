@@ -177,6 +177,104 @@ class TestDebugEndpoints:
         ))
         assert not traced_rt.config_manager.config.telemetry.debug_endpoints
         assert _get(port, f"/debug/runs/default/{run}")[0] == 404
+        # the ISSUE-13 endpoints ride the same gate
+        assert _get(port, "/debug/runs")[0] == 404
+        assert _get(port, f"/debug/runs/default/{run}/critical-path")[0] == 404
+        assert _get(port, "/debug/fleet/utilization")[0] == 404
+        assert _get(port, "/debug/profile")[0] == 404
         # /metrics and health stay up regardless
         assert _get(port, "/metrics")[0] == 200
         assert _get(port, "/healthz")[0] == 200
+
+
+class TestAnalyticsEndpoints:
+    """ISSUE 13: the runs list, critical-path, fleet utilization and
+    profiler routes — auth, gate, and payload shape."""
+
+    @pytest.fixture
+    def rt_with_run(self):
+        rt = Runtime()
+
+        @register_engram("an-ep-impl")
+        def impl(ctx):  # noqa: ARG001
+            return {"ok": True}
+
+        rt.apply(make_engram_template("an-ep-tpl", entrypoint="an-ep-impl"))
+        rt.apply(make_engram("an-ep-worker", "an-ep-tpl"))
+        rt.apply(make_story("an-ep-story", steps=[
+            {"name": "a", "ref": {"name": "an-ep-worker"}},
+            {"name": "b", "ref": {"name": "an-ep-worker"}, "needs": ["a"]},
+        ]))
+        run = rt.run_story("an-ep-story", inputs={})
+        rt.pump()
+        return rt, run
+
+    def test_new_routes_share_the_token_gate(self, server_factory):
+        port = server_factory({"rt": None}, token="sekrit")
+        for path in ("/debug/runs", "/debug/fleet/utilization",
+                     "/debug/profile"):
+            assert _get(port, path)[0] == 403
+            assert _get(port, path, token="wrong")[0] == 403
+
+    def test_runs_list(self, rt_with_run, server_factory):
+        rt, run = rt_with_run
+        port = server_factory({"rt": rt})
+        status, body = _get(port, "/debug/runs")
+        assert status == 200
+        rows = json.loads(body)["runs"]
+        row = next(r for r in rows if r["run"] == run)
+        assert row["phase"] == "Succeeded"
+        assert row["live"] is True
+        assert row["durationSeconds"] is not None
+        assert row["steps"] == 2
+
+    def test_critical_path_on_completed_run(self, rt_with_run,
+                                            server_factory):
+        rt, run = rt_with_run
+        port = server_factory({"rt": rt})
+        status, body = _get(port, f"/debug/runs/default/{run}/critical-path")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["phase"] == "Succeeded"
+        assert set(payload) >= {"wallClockSeconds", "phases", "coverage",
+                                "criticalPath", "segments", "spanBreakdown"}
+        # the total state machine covers the terminal wall-clock
+        assert payload["coverage"] >= 0.95
+        assert {c["step"] for c in payload["criticalPath"]} <= {"a", "b"}
+        # default-namespace shorthand + unknown run
+        assert _get(port, f"/debug/runs/{run}/critical-path")[0] == 200
+        assert _get(port, "/debug/runs/default/nope/critical-path")[0] == 404
+        # the suffix belongs to the runs routes only — not traces
+        assert _get(port, "/debug/traces/abc/critical-path")[0] == 404
+        # the compact analysis also rides the run status + debug payload
+        full = json.loads(_get(port, f"/debug/runs/default/{run}")[1])
+        assert full["analysis"]["criticalPath"]
+
+    def test_utilization_snapshot_shape(self, rt_with_run, server_factory):
+        rt, run = rt_with_run
+        del run
+        port = server_factory({"rt": rt})
+        status, body = _get(port, "/debug/fleet/utilization")
+        assert status == 200
+        payload = json.loads(body)
+        assert set(payload) == {"pools", "occupancy", "snapshots", "ledger"}
+        pools = {p["pool"] for p in payload["pools"]}
+        assert "local" in pools
+        for p in payload["pools"]:
+            assert set(p) >= {"totalChips", "occupiedChips",
+                              "schedulableChips", "cordonedChips",
+                              "largestFreeBlock", "fragmentation"}
+        assert set(payload["ledger"]) == {"pools", "goodputChipSeconds",
+                                          "openGrants", "closedGrants",
+                                          "spans"}
+
+    def test_profile_snapshot(self, rt_with_run, server_factory):
+        rt, run = rt_with_run
+        del run
+        port = server_factory({"rt": rt})
+        status, body = _get(port, "/debug/profile")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["running"] is False  # profiler off by default
+        assert set(payload) >= {"intervalSeconds", "samples", "topStacks",
+                                "threads", "lockWaits", "overheadRatio"}

@@ -456,6 +456,61 @@ class TestSpanningChurnOracle:
             assert p.free_chips() == p.total_chips
 
 
+class TestChipLedgerChurnBalance:
+    """ISSUE 13 acceptance: the chip-seconds ledger balances —
+    granted = productive + each waste bucket, EXACTLY, for every grant
+    the churn produces. Seeded allocate/release churn with random
+    labeled marks drives the ledger the way the controllers do; the
+    integer-nanosecond invariant must survive any interleaving of
+    marks, zero-length segments, and backwards clock jitter."""
+
+    @pytest.mark.parametrize("seed", [7, 21])
+    def test_every_churned_grant_balances_exactly(self, seed):
+        from bobrapet_tpu.observability.analytics import ChipLedger
+
+        rng = random.Random(seed)
+        pool = SlicePool("churn", "8x8", chips_per_host=4)
+        led = ChipLedger()
+        outcomes = ["park", "productive", "retry", "preempted", "failed"]
+        now = 1000.0
+        live = []
+        opened = 0
+        for _i in range(600):
+            # clock advances by messy fractional steps, occasionally
+            # stepping BACKWARDS (NTP jitter; the ledger must clamp)
+            now += rng.uniform(-0.01, 0.5)
+            if rng.random() < 0.55 or not live:
+                try:
+                    g = pool.allocate(chips=rng.choice([1, 2, 4, 8, 16]))
+                except NoCapacity:
+                    continue
+                led.open_grant(g.to_dict(), now)
+                live.append(g)
+                opened += 1
+            elif rng.random() < 0.5 and live:
+                g = rng.choice(live)
+                led.account(g.slice_id, rng.choice(outcomes), now)
+            else:
+                g = live.pop(rng.randrange(len(live)))
+                pool.release(g.slice_id)
+                led.account(g.slice_id, rng.choice(outcomes), now)
+                led.close_grant(g.slice_id, "drain", now)
+        for g in live:
+            pool.release(g.slice_id)
+            led.close_grant(g.slice_id, "drain", now + 1.0)
+        assert pool.free_chips() == pool.total_chips
+
+        entries = led.entries()
+        assert len(entries) >= opened  # closed-entry ring kept them all
+        assert all(e["closed"] for e in entries)
+        # THE invariant: zero unbalanced grants, exactly
+        assert led.unbalanced() == []
+        # and the per-pool totals reconcile with the per-grant sums
+        summary = led.summary()["pools"]["churn"]
+        total = sum(summary["chipSeconds"].values())
+        assert total == pytest.approx(summary["grantedChipSeconds"])
+
+
 class TestFleetBatchedReplacement:
     def _runtime_with_pool(self):
         from bobrapet_tpu.runtime import Runtime
