@@ -97,7 +97,9 @@ def _debug_response(state: dict, path: str) -> tuple[bytes, int, str]:
     ``/debug/traces/<traceId>`` -> the trace's spans (when the tracer
     keeps an in-memory exporter) + every linked run's timeline;
     ``/debug/fleet/utilization`` -> occupancy snapshots + the chip-time
-    ledger; ``/debug/profile`` -> the control-plane profiler snapshot.
+    ledger; ``/debug/profile`` -> the control-plane profiler snapshot;
+    ``/debug/traffic`` -> every live traffic autoscaler's replica state
+    + recent decision ring.
     Gated by `telemetry.debug-endpoints` (live) and the same bearer
     token as /metrics (checked by the caller)."""
     from .observability.timeline import FLIGHT
@@ -119,6 +121,11 @@ def _debug_response(state: dict, path: str) -> tuple[bytes, int, str]:
         from .observability.profiler import PROFILER
 
         return (json.dumps(PROFILER.snapshot(), default=str).encode(),
+                200, "application/json")
+    if len(parts) == 2 and parts[1] == "traffic":
+        from .traffic.autoscaler import traffic_debug_payload
+
+        return (json.dumps(traffic_debug_payload(), default=str).encode(),
                 200, "application/json")
     # the /critical-path suffix belongs to the runs routes ONLY — a
     # length-only strip would misroute /debug/traces/<id>/critical-path

@@ -37,7 +37,8 @@ _KEY_RE = re.compile(r"^[a-z][a-z0-9-]*(\.[a-z0-9-]+)+$")
 _DOC_KEY_RE = re.compile(r"`([a-z][a-z0-9-]*(?:\.[a-z0-9-]+)+)`")
 _DOC_FILES = ("README.md", "docs/SCALING.md", "docs/FLEET.md", "docs/TRAINING.md",
               "docs/STREAMING.md", "docs/SERVING.md", "docs/KUBECTL.md",
-              "docs/ANALYSIS.md", "docs/OBSERVABILITY.md", "docs/STORAGE.md")
+              "docs/ANALYSIS.md", "docs/OBSERVABILITY.md", "docs/STORAGE.md",
+              "docs/TRAFFIC.md")
 
 
 class ConfigKeyDriftChecker:

@@ -194,6 +194,13 @@ class ServingConfig:
     #: matching prefix chain (False = pure least-loaded)
     #: (dotted: serving.router-prefix-affinity)
     router_prefix_affinity: bool = True
+    #: weighted-fair tenant admission: "tenantA:4,tenantB:1" swaps the
+    #: engine/router pending queues for a weighted deficit scheduler
+    #: (traffic/fairness.py) so one tenant's burst cannot starve
+    #: another's TTFT; empty = plain FIFO. Unlisted tenants weigh 1,
+    #: the "*" key overrides that default
+    #: (dotted: serving.tenant-weights)
+    tenant_weights: str = ""
 
 
 #: last serving config a Runtime applied in this process. The serving
@@ -202,6 +209,51 @@ class ServingConfig:
 #: — it parks them here (a no-jax module both sides can import) and
 #: ``serving/engram.build_engine`` reads them as build-time defaults.
 LAST_SERVING_TUNING: Optional[ServingConfig] = None
+
+
+@dataclasses.dataclass
+class TrafficConfig:
+    """Traffic-harness autoscaler knobs (``traffic.*``; TPU-native
+    addition, consumed live by
+    :func:`bobrapet_tpu.traffic.autoscaler.apply_tuning` — a reload
+    swaps every live autoscaler's policy/interval/enable flag; an
+    invalid combination keeps the prior policy)."""
+
+    #: run the SLO-driven replica autoscaler loop
+    #: (dotted: traffic.autoscale-enabled)
+    autoscale_enabled: bool = False
+    #: seconds between decision passes (the burn/queue-wait windows ARE
+    #: this interval) (dotted: traffic.autoscale-interval)
+    autoscale_interval_seconds: float = 1.0
+    #: replica clamps per pool (dotted: traffic.min-replicas /
+    #: traffic.max-replicas); max counts draining replicas — their
+    #: chips are still held
+    min_replicas: int = 1
+    max_replicas: int = 4
+    #: decode pools scale UP past this SLO (tpot) burn fraction and
+    #: DOWN only below the lower bound — the gap is the hysteresis
+    #: (dotted: traffic.scale-up-burn / traffic.scale-down-burn)
+    scale_up_burn: float = 0.30
+    scale_down_burn: float = 0.05
+    #: prefill pools scale on p95 router-queue wait instead (their
+    #: pressure is arrival-shaped, not cadence-shaped)
+    #: (dotted: traffic.scale-up-queue-wait / -down-queue-wait)
+    scale_up_queue_wait_seconds: float = 0.50
+    scale_down_queue_wait_seconds: float = 0.05
+    #: either pool scales up when router backlog exceeds this many
+    #: queued requests per routable replica
+    #: (dotted: traffic.queue-depth-per-replica)
+    queue_depth_per_replica: int = 8
+    #: per-direction cooldowns (dotted: traffic.scale-up-cooldown /
+    #: traffic.scale-down-cooldown)
+    scale_up_cooldown_seconds: float = 5.0
+    scale_down_cooldown_seconds: float = 30.0
+
+
+#: last traffic config a Runtime applied in this process (same handoff
+#: contract as LAST_SERVING_TUNING: autoscalers built after the control
+#: plane booted read a pre-existing ConfigMap's knobs from here).
+LAST_TRAFFIC_TUNING: Optional[TrafficConfig] = None
 
 
 @dataclasses.dataclass
@@ -301,6 +353,7 @@ class OperatorConfig:
     dataplane: DataplaneConfig = dataclasses.field(default_factory=DataplaneConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    traffic: TrafficConfig = dataclasses.field(default_factory=TrafficConfig)
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
     telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
     engram: EngramDefaults = dataclasses.field(default_factory=EngramDefaults)
@@ -373,6 +426,21 @@ class OperatorConfig:
             )
         if self.serving.router_prefill_threshold < 0:
             errs.append("serving.router-prefill-threshold must be >= 0")
+        try:
+            # one validator, shared with the live queue swap: a weights
+            # string the scheduler could not consume never validates
+            from ..traffic.fairness import parse_tenant_weights
+
+            parse_tenant_weights(self.serving.tenant_weights)
+        except ValueError as e:
+            errs.append(f"serving.tenant-weights invalid: {e}")
+        if self.traffic.autoscale_interval_seconds <= 0:
+            errs.append("traffic.autoscale-interval must be > 0")
+        # the threshold/clamp relationships live in AutoscalePolicy so
+        # the pure decision tests and the config plane agree exactly
+        from ..traffic.autoscaler import AutoscalePolicy
+
+        errs.extend(AutoscalePolicy.from_config(self.traffic).validate())
         if self.storage.disk_cache_bytes < 0:
             errs.append("storage.disk-cache-bytes must be >= 0")
         if self.storage.disk_cache_enabled and not self.storage.disk_cache_dir:
@@ -456,6 +524,18 @@ def _apply_dotted(cfg: OperatorConfig, key: str, value: str) -> bool:
         "serving.role": lambda: fset(cfg.serving, "role", str),
         "serving.router-prefill-threshold": lambda: fset(cfg.serving, "router_prefill_threshold", int),
         "serving.router-prefix-affinity": lambda: fset(cfg.serving, "router_prefix_affinity", as_bool),
+        "serving.tenant-weights": lambda: fset(cfg.serving, "tenant_weights", str),
+        "traffic.autoscale-enabled": lambda: fset(cfg.traffic, "autoscale_enabled", as_bool),
+        "traffic.autoscale-interval": lambda: fset(cfg.traffic, "autoscale_interval_seconds", as_dur),
+        "traffic.min-replicas": lambda: fset(cfg.traffic, "min_replicas", int),
+        "traffic.max-replicas": lambda: fset(cfg.traffic, "max_replicas", int),
+        "traffic.scale-up-burn": lambda: fset(cfg.traffic, "scale_up_burn", float),
+        "traffic.scale-down-burn": lambda: fset(cfg.traffic, "scale_down_burn", float),
+        "traffic.scale-up-queue-wait": lambda: fset(cfg.traffic, "scale_up_queue_wait_seconds", as_dur),
+        "traffic.scale-down-queue-wait": lambda: fset(cfg.traffic, "scale_down_queue_wait_seconds", as_dur),
+        "traffic.queue-depth-per-replica": lambda: fset(cfg.traffic, "queue_depth_per_replica", int),
+        "traffic.scale-up-cooldown": lambda: fset(cfg.traffic, "scale_up_cooldown_seconds", as_dur),
+        "traffic.scale-down-cooldown": lambda: fset(cfg.traffic, "scale_down_cooldown_seconds", as_dur),
         "storage.disk-cache-enabled": lambda: fset(cfg.storage, "disk_cache_enabled", as_bool),
         "storage.disk-cache-dir": lambda: fset(cfg.storage, "disk_cache_dir", str),
         "storage.disk-cache-bytes": lambda: fset(cfg.storage, "disk_cache_bytes", int),

@@ -76,6 +76,13 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def snapshot(self) -> dict[tuple[tuple[str, str], ...], float]:
+        """Every labeled series' current value (the traffic
+        autoscaler's windowed burn-rate deltas read this — per-series
+        ``value()`` would need the caller to know every label value)."""
+        with self._lock:
+            return dict(self._values)
+
     def reset(self) -> None:
         with self._lock:
             self._values.clear()
@@ -164,6 +171,18 @@ class Histogram(_Metric):
         key = _label_key(self.label_names, label_values)
         with self._lock:
             return self._sums.get(key, 0.0)
+
+    def bucket_snapshot(
+        self, *label_values: str
+    ) -> tuple[tuple[float, ...], list[int], int]:
+        """(bounds, cumulative bucket counts, total) for one series —
+        windowed percentile estimates (the autoscaler's queue-wait p95)
+        diff two of these."""
+        key = _label_key(self.label_names, label_values)
+        with self._lock:
+            counts = list(self._counts.get(key, [0] * len(self.buckets)))
+            total = self._totals.get(key, 0)
+        return self.buckets, counts, total
 
     def reset(self) -> None:
         with self._lock:
@@ -646,6 +665,43 @@ class _ControlPlaneMetrics:
             ["pool"],
             buckets=(0.0001, 0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
                      30.0),
+        )
+        # Production traffic harness (bobrapet_tpu/traffic): the
+        # SLO-driven autoscaler's decisions/replica state and the
+        # closed-loop load generator's offered traffic
+        self.traffic_autoscale = c(
+            "bobrapet_traffic_autoscale_total",
+            "Autoscaler actions taken (direction = up|down; reason = "
+            "tpot-burn|queue-wait|queue-depth|calm — the signal that "
+            "triggered the decision)",
+            ["pool", "direction", "reason"],
+        )
+        self.traffic_replicas = g(
+            "bobrapet_traffic_replicas",
+            "Serving replicas per pool (kind = desired|actual|draining;"
+            " desired is the last decision's target, actual counts "
+            "routable engines, draining ones are retiring in-flight "
+            "work with their chips still held)",
+            ["pool", "kind"],
+        )
+        self.traffic_drain_seconds = h(
+            "bobrapet_traffic_drain_seconds",
+            "Scale-down drain latency: stop-routing to in-flight-empty "
+            "(the grant releases at the end of this window)",
+            ["pool"],
+            buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                     60.0, 300.0),
+        )
+        self.traffic_evictions = c(
+            "bobrapet_traffic_evictions_total",
+            "Replicas evicted (slice preempted mid-serve): unfinished "
+            "requests requeued onto the router with clocks carried",
+            ["pool"],
+        )
+        self.traffic_loadgen_requests = c(
+            "bobrapet_traffic_loadgen_requests_total",
+            "Closed-loop load-generator submissions per tenant",
+            ["tenant"],
         )
         self.serving_prefix_match_depth = h(
             "bobrapet_serving_prefix_match_depth_blocks",

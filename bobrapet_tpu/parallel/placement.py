@@ -320,6 +320,19 @@ class SlicePool:
         shapes = [self._resolve_shape(t, c) for t, c in requests]
         if not shapes:
             return []
+        demand = sum(_volume(s) for s in shapes)
+        if demand > self.total_chips:
+            # a gang bigger than the WHOLE pool is a permanent spec
+            # error, not a transient capacity shortfall: no release or
+            # quarantine decay can ever clear it, so a NoCapacity park
+            # here would wait forever (bench config3 did exactly that
+            # for three releases — 8 x 2x2 against a 4x4 pool)
+            metrics.slice_placements.inc("impossible")
+            raise PlacementError(
+                f"gang of {len(shapes)} blocks wants {demand} chips but "
+                f"pool {self.name} ({self.topology}) has only "
+                f"{self.total_chips} total — unplaceable at any occupancy"
+            )
         with self._lock:
             placed = self._acquire_gang_locked(shapes)
             grants: list[tuple[str, tuple[int, ...], tuple[int, ...]]] = []

@@ -179,6 +179,7 @@ class Runtime:
         # first reload. Lazy: never imports jax into a pure
         # control-plane process.
         self._apply_serving_tuning(cfg)
+        self._apply_traffic_tuning(cfg)
 
         self._register_indexes()
         # admission layer (reference: setupWebhooksIfEnabled, cmd/main.go:802;
@@ -388,6 +389,23 @@ class Runtime:
         if _serving is not None:
             _serving.apply_tuning(cfg.serving)
 
+    @staticmethod
+    def _apply_traffic_tuning(cfg) -> None:
+        """Publish traffic.* knobs the same way: park them in the
+        config-module handoff slot for autoscalers built later, and
+        retune every live autoscaler when the traffic module is
+        loaded (lazy by symmetry with the serving push — the traffic
+        package is jax-free, but a process running zero autoscalers
+        still should not import it on every reload)."""
+        import sys as _sys
+
+        from .config import operator as _opcfg
+
+        _opcfg.LAST_TRAFFIC_TUNING = cfg.traffic
+        _traffic = _sys.modules.get("bobrapet_tpu.traffic.autoscaler")
+        if _traffic is not None:
+            _traffic.apply_tuning(cfg.traffic)
+
     def _apply_storage_tier(self, cfg) -> None:
         """Attach/detach/resize the slice-local disk tier from the live
         ``storage.disk-cache-*`` keys. The tier store rebuilds only when
@@ -467,6 +485,7 @@ class Runtime:
 
         apply_tuning(cfg.dataplane)
         self._apply_serving_tuning(cfg)
+        self._apply_traffic_tuning(cfg)
         # fleet.gke-spot / fleet.termination-grace are live like every
         # other fleet.* knob: retune the cluster materializer IN PLACE
         # (replacing it would discard operator customization such as

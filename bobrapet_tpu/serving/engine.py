@@ -279,7 +279,14 @@ class ServingEngine:
         # all block traffic flows through the prefix cache so freed-
         # but-still-registered blocks are lazily invalidated on reuse
         self.blocks = PrefixCache(self.allocator, self.pcfg.block_size)
-        self.pending: deque[Request] = deque()
+        #: drain contract (traffic autoscaler / live role demotion):
+        #: a draining engine refuses NEW submissions but keeps
+        #: admitting its own queue and decoding to retirement
+        self.draining = False
+        #: pending is a FIFO deque until ``set_tenant_weights``
+        #: installs the weighted-fair scheduler (serving.tenant-weights)
+        self.pending: "deque[Request] | Any" = deque()
+        self._tenant_weights: Optional[dict[str, float]] = None
         self.slots: list[Optional[_SlotState]] = [None] * self.pcfg.max_slots
         self.finished: list[Request] = []
         self._next_rid = 0
@@ -439,6 +446,11 @@ class ServingEngine:
         the shared registry, not a recompute. ``max_new_tokens``
         remains the TOTAL new-token budget including the preseed."""
         preseed = list(output or [])
+        if self.draining:
+            raise ValueError(
+                "engine is draining (scale-down or role change in "
+                "progress): submit to another replica"
+            )
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill "
                              "always samples one token)")
@@ -502,6 +514,46 @@ class ServingEngine:
     @property
     def active_slots(self) -> int:
         return sum(1 for s in self.slots if s is not None)
+
+    # -- drain contract (see ServingRouter.drain / traffic/autoscaler) -----
+
+    def drain(self) -> None:
+        """Stop admitting NEW submissions; everything already accepted
+        (queued or slotted) keeps stepping to retirement. Idempotent."""
+        self.draining = True
+
+    def undrain(self) -> None:
+        self.draining = False
+
+    @property
+    def in_flight(self) -> int:
+        """Requests accepted but not yet finished (queue + slots)."""
+        return len(self.pending) + self.active_slots
+
+    @property
+    def drained(self) -> bool:
+        """True exactly when a requested drain has fully retired."""
+        return self.draining and self.in_flight == 0
+
+    def set_tenant_weights(
+        self, weights: Optional[dict[str, float]]
+    ) -> None:
+        """Live-reloadable (`serving.tenant-weights`): swap the pending
+        queue between FIFO and the weighted start-time fair scheduler
+        (traffic/fairness.py). Queued requests transfer in arrival
+        order — a reload reorders future SERVICE, never loses work."""
+        weights = dict(weights) if weights else None
+        if weights == self._tenant_weights:
+            return
+        self._tenant_weights = weights
+        if weights:
+            from ..traffic.fairness import WeightedFairQueue
+
+            fresh: Any = WeightedFairQueue(weights)
+        else:
+            fresh = deque()
+        fresh.extend(self.pending)
+        self.pending = fresh
 
     def set_decode_horizon(self, horizon: int) -> None:
         """Live-reloadable (`serving.decode-horizon`): takes effect at

@@ -101,10 +101,19 @@ def apply_tuning(scfg: Any) -> None:
     engine must not block the fleet's reload."""
     import sys as _sys
 
+    from ..traffic.fairness import parse_tenant_weights
     from .prefix_cache import GLOBAL_SHARED_PREFIXES
 
     global _TUNING
     _TUNING = scfg
+    try:
+        weights: Optional[dict] = parse_tenant_weights(scfg.tenant_weights)
+    except ValueError as e:
+        # config validation rejects malformed weights before a reload
+        # lands here; belt-and-braces for directly-constructed configs
+        _log.warning("serving.tenant-weights unparseable, keeping prior "
+                     "weights: %s", e)
+        weights = None
     for eng in list(_LIVE_ENGINES):
         pinned = getattr(eng, "_engram_pinned", frozenset())
         try:
@@ -114,6 +123,8 @@ def apply_tuning(scfg: Any) -> None:
                 eng.set_spec_k(scfg.spec_k)
             if "role" not in pinned:
                 eng.set_role(scfg.role)
+            if weights is not None and "tenant_weights" not in pinned:
+                eng.set_tenant_weights(weights)
             if "prefix_shared" not in pinned:
                 current = eng.blocks._shared
                 if scfg.prefix_cache_shared:
@@ -306,11 +317,29 @@ def build_engine(ctx) -> ServingEngine:
                            spec_k=spec_k, spec_guard=spec_guard,
                            decode_horizon=horizon, prefix_shared=shared,
                            role=role)
+    # weighted-fair tenant admission: the step's own tenantWeights
+    # mapping pins it; otherwise the live serving.tenant-weights knob
+    # is the build-time default (same contract as the other knobs)
+    tw = config.get("tenantWeights")
+    if tw is not None:
+        if not isinstance(tw, dict) or not tw:
+            raise ValueError("config.tenantWeights must be a non-empty "
+                             "mapping of tenant -> weight")
+        weights = {str(k): float(v) for k, v in tw.items()}
+        if any(w <= 0 for w in weights.values()):
+            raise ValueError("config.tenantWeights weights must be > 0")
+        engine.set_tenant_weights(weights)
+    elif tuning is not None and getattr(tuning, "tenant_weights", ""):
+        from ..traffic.fairness import parse_tenant_weights
+
+        engine.set_tenant_weights(
+            parse_tenant_weights(tuning.tenant_weights))
     # knobs the STEP pinned survive serving.* reloads (apply_tuning)
     engine._engram_pinned = frozenset(
         name for key, name in (("decodeHorizon", "decode_horizon"),
                                ("prefixShared", "prefix_shared"),
-                               ("role", "role"))
+                               ("role", "role"),
+                               ("tenantWeights", "tenant_weights"))
         if key in config
     ) | (frozenset(["spec_k"])
          if "specK" in (config.get("draft") or {}) else frozenset())

@@ -52,6 +52,7 @@ are derived from each engine's CURRENT role, never cached.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import time as _walltime
 import weakref
@@ -64,6 +65,23 @@ from .engine import Request, ServingEngine
 
 _log = logging.getLogger(__name__)
 
+
+@dataclasses.dataclass(frozen=True)
+class DrainStatus:
+    """One engine's drain progress (the explicit contract scale-down
+    and live role demotion consume instead of poking router/engine
+    internals): ``in_flight`` counts everything not yet delivered —
+    engine queue + active slots + finishes the router has not harvested
+    — and ``empty`` signals the engine is safe to remove/retune."""
+
+    engine: str
+    draining: bool
+    in_flight: int
+
+    @property
+    def empty(self) -> bool:
+        return self.draining and self.in_flight == 0
+
 #: routers this process is currently serving — live-reload targets for
 #: the ``serving.router-*`` operator knobs (same pattern as the engine
 #: weakset in engram.py)
@@ -71,13 +89,23 @@ _LIVE_ROUTERS: "weakref.WeakSet[ServingRouter]" = weakref.WeakSet()
 
 
 def apply_tuning(scfg: Any) -> None:
-    """Apply the operator's ``serving.router-*`` knobs to every live
-    router (forwarded from ``engram.apply_tuning`` whenever this module
-    is loaded)."""
+    """Apply the operator's ``serving.router-*`` (and tenant-weight)
+    knobs to every live router (forwarded from ``engram.apply_tuning``
+    whenever this module is loaded)."""
+    from ..traffic.fairness import parse_tenant_weights
+
+    try:
+        weights: Optional[dict] = parse_tenant_weights(scfg.tenant_weights)
+    except ValueError as e:
+        _log.warning("serving.tenant-weights unparseable, keeping prior "
+                     "weights: %s", e)
+        weights = None
     for router in list(_LIVE_ROUTERS):
         try:
             router.set_prefill_threshold(scfg.router_prefill_threshold)
             router.set_prefix_affinity(scfg.router_prefix_affinity)
+            if weights is not None:
+                router.set_tenant_weights(weights)
         except ValueError as e:
             _log.warning("serving.router-* reload skipped a router: %s", e)
 
@@ -143,7 +171,8 @@ class ServingRouter:
                  registry: Any = None,
                  prefill_threshold: int = 0,
                  prefix_affinity: bool = True,
-                 flight: Optional[tuple[str, str]] = None):
+                 flight: Optional[tuple[str, str]] = None,
+                 tenant_weights: Optional[dict[str, float]] = None):
         if not engines:
             raise ValueError("ServingRouter needs at least one engine")
         if prefill_threshold < 0:
@@ -153,9 +182,19 @@ class ServingRouter:
         self.prefill_threshold = int(prefill_threshold)
         self.prefix_affinity = bool(prefix_affinity)
         self.flight = flight
-        self._queues: dict[str, deque[_Queued]] = {
+        self._tenant_weights: Optional[dict[str, float]] = None
+        self._queues: dict[str, Any] = {
             "prefill": deque(), "decode": deque(),
         }
+        if tenant_weights:
+            self.set_tenant_weights(tenant_weights)
+        #: engines the autoscaler (or a role change) is draining: still
+        #: stepped and harvested, never routed new work
+        self._draining: set[str] = set()
+        #: engine -> target role applied once its drain reaches empty
+        #: (live role demotion through the drain contract: the flip
+        #: never truncates in-flight work)
+        self._pending_roles: dict[str, str] = {}
         # start ABOVE every engine's own counter: router rids are
         # pinned onto engines, and a collision with a directly-
         # submitted request's rid would alias their sampled streams
@@ -194,6 +233,180 @@ class ServingRouter:
         counts as a miss) — the A/B lever the bench uses to price the
         affinity itself."""
         self.prefix_affinity = bool(enabled)
+
+    def set_tenant_weights(self, weights: Optional[dict[str, float]]) -> None:
+        """Live-reloadable (`serving.tenant-weights`): swap the per-pool
+        queues between plain FIFO (no weights) and the weighted
+        start-time fair scheduler. Queued work transfers in arrival order, so a
+        mid-traffic reload reorders SERVICE, never loses or duplicates
+        a request."""
+        weights = dict(weights) if weights else None
+        if weights == self._tenant_weights:
+            return
+        self._tenant_weights = weights
+        for pool, q in self._queues.items():
+            if weights:
+                from ..traffic.fairness import WeightedFairQueue
+
+                fresh: Any = WeightedFairQueue(weights)
+            else:
+                fresh = deque()
+            fresh.extend(q)  # arrival order either way
+            self._queues[pool] = fresh
+
+    # -- replica lifecycle (the drain contract) ----------------------------
+
+    def add_engine(self, name: str, engine: ServingEngine) -> None:
+        """Register a replica (the autoscaler's scale-up actuator).
+        The rid counters sync both ways so the newcomer's history can
+        never alias a routed rid, and the step's run trace fans out to
+        it like every pool member."""
+        if name in self.engines:
+            raise ValueError(f"engine {name!r} already registered")
+        self._next_rid = max(self._next_rid, engine._next_rid)
+        engine._next_rid = max(engine._next_rid, self._next_rid)
+        self.engines[name] = engine
+        self._consumed[name] = len(engine.finished)
+        engine.trace_context = self._trace_context
+        engine.undrain()
+
+    def drain(self, name: str) -> DrainStatus:
+        """Stop routing new work to ``name`` (and block direct submits
+        on the engine itself); everything already accepted keeps
+        stepping to retirement. Idempotent."""
+        eng = self._engine(name)
+        self._draining.add(name)
+        eng.drain()
+        return self.drain_status(name)  # type: ignore[return-value]
+
+    def undrain(self, name: str) -> None:
+        """Cancel a drain: the engine is routable again."""
+        eng = self._engine(name)
+        self._draining.discard(name)
+        self._pending_roles.pop(name, None)
+        eng.undrain()
+
+    def drain_status(self, name: str) -> Optional[DrainStatus]:
+        """None for an unknown engine (a preempted replica already
+        evicted — the autoscaler treats that as drain complete)."""
+        eng = self.engines.get(name)
+        if eng is None:
+            return None
+        unharvested = len(eng.finished) - self._consumed[name]
+        return DrainStatus(
+            engine=name,
+            draining=name in self._draining,
+            in_flight=eng.in_flight + unharvested,
+        )
+
+    def remove_engine(self, name: str) -> ServingEngine:
+        """Unregister a DRAINED replica (scale-down's final step). The
+        engine must be empty — removing live work would strand it; use
+        :meth:`evict_engine` for a dead (preempted) replica."""
+        self._harvest()  # deliver any finishes still on the engine
+        status = self.drain_status(name)
+        if status is None:
+            raise ValueError(f"unknown engine {name!r}")
+        if not status.empty:
+            raise ValueError(
+                f"engine {name!r} still has {status.in_flight} request(s) "
+                f"in flight (draining={status.draining}) — drain it first"
+            )
+        self._draining.discard(name)
+        self._pending_roles.pop(name, None)
+        self._consumed.pop(name, None)
+        return self.engines.pop(name)
+
+    def evict_engine(self, name: str) -> int:
+        """A replica died under us (slice preempted): requeue every
+        unfinished owned request onto the router — output so far rides
+        along as a preseed, lifecycle clocks carry, sampled streams
+        stay byte-identical (keys fold from the pinned rid) — then
+        unregister the engine. Returns the number requeued. Completed
+        work still on the engine is harvested first, so every rid
+        retires exactly once no matter when the preemption lands."""
+        eng = self.engines.get(name)
+        if eng is None:
+            raise ValueError(f"unknown engine {name!r}")
+        self._harvest()
+        stranded: list[Request] = []
+        for slot in eng.slots:
+            if slot is not None and slot.request.rid in self._owned:
+                stranded.append(slot.request)
+        for req in eng.pending:
+            if req.rid in self._owned:
+                stranded.append(req)
+        for req in stranded:
+            self._requeue_evicted(req, name)
+        self._draining.discard(name)
+        self._pending_roles.pop(name, None)
+        self._consumed.pop(name, None)
+        self.engines.pop(name)
+        self._set_depth_gauges()
+        return len(stranded)
+
+    def set_role(self, name: str, role: str) -> None:
+        """Live role change through the drain contract: the engine
+        stops receiving new work, finishes what it holds under its OLD
+        role, then flips and rejoins its new pool — a demotion can
+        never truncate in-flight requests, a promotion can never leak
+        a full-budget continuation. No-op when already in role."""
+        eng = self._engine(name)
+        if eng.role == role and name not in self._pending_roles:
+            return
+        if role not in ServingEngine.ROLES:
+            raise ValueError(
+                f"role must be one of {sorted(ServingEngine.ROLES)}, "
+                f"got {role!r}"
+            )
+        self._pending_roles[name] = role
+        self.drain(name)
+        self._apply_pending_roles()
+
+    def _apply_pending_roles(self) -> None:
+        for name in list(self._pending_roles):
+            status = self.drain_status(name)
+            if status is not None and status.empty:
+                role = self._pending_roles.pop(name)
+                eng = self.engines[name]
+                eng.set_role(role)
+                self._draining.discard(name)
+                eng.undrain()
+                self._record_decision(-1, "role-change", name, role=role)
+
+    def _engine(self, name: str) -> ServingEngine:
+        eng = self.engines.get(name)
+        if eng is None:
+            raise ValueError(f"unknown engine {name!r}")
+        return eng
+
+    def _requeue_evicted(self, req: Request, from_engine: str) -> None:
+        carry: dict[str, Any] = {
+            "submitted_at": req.submitted_at,
+            "submitted_wall": req.submitted_wall,
+            # queue wait was observed at the FIRST admission; carrying
+            # the clock keeps the re-admission from minting a second
+            # sample (engine._prefill guards on admitted_at)
+            "admitted_at": req.admitted_at,
+        }
+        ttft = req.ttft_seconds
+        if ttft is not None:
+            # the user already saw their first token before the
+            # preemption — re-deriving TTFT on the new engine would
+            # count the eviction gap as fresh first-token latency
+            carry["ttft_carried_s"] = ttft
+        q = _Queued(req.rid, req.prompt, req.max_new_tokens,
+                    req.temperature, req.eos_token,
+                    req.adapter, req.tenant, req.trace,
+                    output=list(req.output), carry=carry)
+        pool = "decode" if req.output else self._submit_pool(q)
+        self._queues[pool].append(q)
+        self._record_decision(req.rid, "evicted", from_engine,
+                              requeuedTo=pool, tokens=len(req.output))
+
+    def queue_depths(self) -> dict[str, int]:
+        """Router backlog per pool (the autoscaler's depth signal)."""
+        return {pool: len(q) for pool, q in self._queues.items()}
 
     # -- StreamServer surface ----------------------------------------------
 
@@ -259,8 +472,7 @@ class ServingRouter:
         return rid
 
     def _submit_pool(self, q: _Queued) -> str:
-        if (len(q.prompt) >= self.prefill_threshold
-                and any(e.role == "prefill" for e in self.engines.values())):
+        if len(q.prompt) >= self.prefill_threshold and self._pool("prefill"):
             return "prefill"
         return "decode"
 
@@ -274,6 +486,8 @@ class ServingRouter:
             if eng.pending or eng.active_slots:
                 eng.step()
         done = self._harvest()
+        # deferred role changes apply the moment their drain is empty
+        self._apply_pending_roles()
         self._set_depth_gauges()
         return done
 
@@ -306,7 +520,8 @@ class ServingRouter:
         return hits / total if total else 1.0
 
     def _pool(self, *roles: str) -> list[tuple[str, ServingEngine]]:
-        return [(n, e) for n, e in self.engines.items() if e.role in roles]
+        return [(n, e) for n, e in self.engines.items()
+                if e.role in roles and n not in self._draining]
 
     @staticmethod
     def _load(eng: ServingEngine) -> int:
@@ -366,7 +581,12 @@ class ServingRouter:
             # decoding SOMEWHERE beats deadlock — a prefill engine still
             # decodes correctly, it just retires at the first token and
             # the request comes back around as another handoff
-            pool = list(self.engines.items())
+            pool = self._pool("prefill")
+        if not pool:
+            # everything is draining: the queue holds until a drain
+            # finishes (undrain/role flip) or a replica joins — a
+            # draining engine must never be handed NEW work
+            return None
         outcome, depth, choice = "miss", 0, None
         has_room = any(self._has_room(e) for _n, e in pool)
         if self.prefix_affinity:

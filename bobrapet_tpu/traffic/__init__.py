@@ -1,0 +1,44 @@
+"""Production traffic harness (ROADMAP 3): closed-loop load generation,
+SLO-driven autoscaling, weighted-fair tenant admission.
+
+Three cooperating pieces, wired through the existing config / metrics /
+flight-recorder planes:
+
+- :mod:`.fairness` — a weighted deficit round-robin queue the serving
+  engine and router swap in for their FIFO pending queues when the
+  operator configures ``serving.tenant-weights``: one tenant's burst
+  can no longer starve another tenant's TTFT (starvation is impossible
+  by construction — every backlogged tenant accrues deficit every
+  round).
+- :mod:`.autoscaler` — a control loop scaling serving replicas per pool
+  off SLO burn rate + router queue signals (prefill pools scale on
+  queue wait, decode pools on TPOT burn — the PR-11 signal split),
+  with hysteresis and per-direction cooldowns; scale-up goes through
+  the placement fast path, scale-down through the router's explicit
+  ``drain()`` contract.
+- :mod:`.loadgen` — a deterministic seeded closed-loop load generator
+  replaying bursty/diurnal multi-tenant mixes against engines/routers,
+  recording per-tenant achieved TTFT/TPOT/goodput.
+
+The package is deliberately jax-free at import time: the autoscaler and
+load generator drive whatever engine/router objects the caller built,
+so a pure control-plane process can import (and live-retune) them
+without pulling in the serving stack.
+"""
+
+from .autoscaler import (  # noqa: F401
+    Autoscaler,
+    AutoscalePolicy,
+    Decision,
+    EngineReplicaSet,
+    PoolSignals,
+    decide,
+    traffic_debug_payload,
+)
+from .fairness import WeightedFairQueue, parse_tenant_weights  # noqa: F401
+from .loadgen import (  # noqa: F401
+    ClosedLoopLoadGen,
+    TenantProfile,
+    TrafficPhase,
+    TrafficReport,
+)

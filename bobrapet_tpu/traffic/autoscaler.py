@@ -1,0 +1,659 @@
+"""SLO-driven serving autoscaler: close the loop the sensors built.
+
+PR 8 built the sensor plane (per-tenant TTFT/TPOT/queue-wait histograms
+and SLO burn counters vs live ``telemetry.slo.*`` thresholds) and PR 11
+exposed per-pool router queue depth/wait as "the independent autoscaler
+signals" — this module is the loop that was missing:
+
+- **decide** (pure function): per-pool scale decision from
+  :class:`PoolSignals` under an :class:`AutoscalePolicy` — prefill
+  pools scale UP on queue-wait (their backlog is ingest-bound), decode
+  pools on TPOT burn rate (their pain is cadence), both on raw queue
+  depth per replica; scale DOWN only when the pool is calm below the
+  *lower* hysteresis thresholds with an empty queue. Per-direction
+  cooldowns and min/max replica clamps. One replica per decision —
+  drains are slow, and a measured step beats an oscillating jump.
+- :class:`EngineReplicaSet`: the actuator. Scale-up places a slice
+  grant through the PR-5 placement fast path (``SlicePlacer.place``)
+  and registers a factory-built engine with the router; scale-down
+  picks the newest autoscaler-added replica and retires it through the
+  router's explicit drain contract (stop routing -> in-flight
+  retirement -> remove + release the grant — prefix/KV state re-adopts
+  from the PR-10 SSD tier exactly as preemption resume does). A
+  preempted replica is *evicted* (its unfinished requests requeue onto
+  the router with their clocks carried) and its grant released — a
+  drain in progress on that replica is cleared, never stranded.
+- :class:`Autoscaler`: the loop. Gathers signals (router queues +
+  windowed deltas of the live SLO burn counters), decides, acts,
+  flight-records every decision and counts it into
+  ``bobrapet_traffic_autoscale_total{pool,direction,reason}`` plus the
+  desired/actual/draining replica gauges. ``/debug/traffic`` serves
+  :func:`traffic_debug_payload` — every live autoscaler's status and
+  recent decision ring.
+
+Live tuning: the ``traffic.*`` operator keys retune live autoscalers
+through :func:`apply_tuning` (wired from
+``Runtime._apply_traffic_tuning`` on every config reload).
+
+Threading: an autoscaler is single-threaded by the same contract as
+the router it steers — the serve/bench loop calls ``tick()``; nothing
+here spawns threads or takes locks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time as _walltime
+import weakref
+from collections import deque
+from typing import Any, Callable, Optional
+
+from ..observability.metrics import metrics
+from ..observability.timeline import FLIGHT
+
+_log = logging.getLogger(__name__)
+
+#: flight-recorder identity autoscaler decisions land under when the
+#: caller wires no run of its own (kept stable so /debug/runs/
+#: bobrapet-system/traffic-autoscaler always shows the decision ring)
+DEFAULT_FLIGHT = ("bobrapet-system", "traffic-autoscaler")
+
+#: autoscalers this process is currently running — live-reload targets
+#: for the ``traffic.*`` operator knobs (same pattern as the engine
+#: weakset in serving/engram.py)
+_LIVE_AUTOSCALERS: "weakref.WeakSet[Autoscaler]" = weakref.WeakSet()
+
+
+def apply_tuning(tcfg: Any) -> None:
+    """Apply the operator's ``traffic.*`` knobs to every live
+    autoscaler (forwarded from ``Runtime._apply_traffic_tuning``)."""
+    for scaler in list(_LIVE_AUTOSCALERS):
+        try:
+            scaler.apply_tuning(tcfg)
+        except ValueError as e:
+            _log.warning("traffic.* reload skipped an autoscaler: %s", e)
+
+
+def traffic_debug_payload() -> dict[str, Any]:
+    """The /debug/traffic response body: every live autoscaler's
+    status + recent decisions."""
+    return {"autoscalers": [s.status() for s in list(_LIVE_AUTOSCALERS)]}
+
+
+# ---------------------------------------------------------------------------
+# pure decision core
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolSignals:
+    """One pool's observed state at decision time."""
+
+    #: requests queued in the router ahead of engine admission
+    queue_depth: int = 0
+    #: p95 router-queue wait over the last window (seconds)
+    queue_wait_p95_s: float = 0.0
+    #: fraction of requests breaching the pool's SLO over the last
+    #: window (prefill pools judge ttft, decode pools tpot); 0 when the
+    #: window saw no completed observations
+    burn_rate: float = 0.0
+    #: serving replicas currently routable (draining excluded)
+    replicas: int = 1
+    #: replicas mid-drain (shrinking but still retiring work)
+    draining: int = 0
+
+
+@dataclasses.dataclass
+class AutoscalePolicy:
+    """Scale thresholds + clamps (the ``traffic.*`` operator keys).
+
+    Hysteresis is the up/down threshold GAP: a pool between
+    ``scale_down_burn`` and ``scale_up_burn`` (or between the two
+    queue-wait bounds) holds — without the gap a pool hovering at one
+    threshold would flap a replica up and down every window."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    #: decode pools scale up past this SLO burn fraction
+    scale_up_burn: float = 0.30
+    #: ...and down only below this one (must be < scale_up_burn)
+    scale_down_burn: float = 0.05
+    #: prefill pools scale up past this p95 router-queue wait
+    scale_up_queue_wait_s: float = 0.50
+    #: ...and down only below this one (must be < the up bound)
+    scale_down_queue_wait_s: float = 0.05
+    #: either pool scales up when its backlog exceeds this many queued
+    #: requests per routable replica (depth is the leading indicator —
+    #: burn only moves after requests already suffered)
+    queue_depth_per_replica: int = 8
+    scale_up_cooldown_s: float = 5.0
+    scale_down_cooldown_s: float = 30.0
+
+    def validate(self) -> list[str]:
+        errs = []
+        if self.min_replicas < 1:
+            errs.append("traffic.min-replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            errs.append("traffic.max-replicas must be >= traffic.min-replicas")
+        for name, v in (("traffic.scale-up-burn", self.scale_up_burn),
+                        ("traffic.scale-down-burn", self.scale_down_burn)):
+            if not (0.0 <= v <= 1.0):
+                errs.append(f"{name} must be in [0, 1]")
+        if self.scale_down_burn >= self.scale_up_burn:
+            errs.append(
+                "traffic.scale-down-burn must be < traffic.scale-up-burn "
+                "(the gap IS the hysteresis)"
+            )
+        if self.scale_up_queue_wait_s <= 0:
+            errs.append("traffic.scale-up-queue-wait must be > 0")
+        if not (0 <= self.scale_down_queue_wait_s < self.scale_up_queue_wait_s):
+            errs.append(
+                "traffic.scale-down-queue-wait must be in "
+                "[0, traffic.scale-up-queue-wait)"
+            )
+        if self.queue_depth_per_replica < 1:
+            errs.append("traffic.queue-depth-per-replica must be >= 1")
+        if self.scale_up_cooldown_s < 0 or self.scale_down_cooldown_s < 0:
+            errs.append("traffic.*-cooldown must be >= 0")
+        return errs
+
+    @classmethod
+    def from_config(cls, tcfg: Any) -> "AutoscalePolicy":
+        """Policy from the operator's TrafficConfig dataclass."""
+        return cls(
+            min_replicas=int(tcfg.min_replicas),
+            max_replicas=int(tcfg.max_replicas),
+            scale_up_burn=float(tcfg.scale_up_burn),
+            scale_down_burn=float(tcfg.scale_down_burn),
+            scale_up_queue_wait_s=float(tcfg.scale_up_queue_wait_seconds),
+            scale_down_queue_wait_s=float(tcfg.scale_down_queue_wait_seconds),
+            queue_depth_per_replica=int(tcfg.queue_depth_per_replica),
+            scale_up_cooldown_s=float(tcfg.scale_up_cooldown_seconds),
+            scale_down_cooldown_s=float(tcfg.scale_down_cooldown_seconds),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    pool: str
+    direction: str  # "up" | "down" | "hold"
+    reason: str
+    #: replica target the decision implies (= replicas for "hold")
+    desired: int
+    signals: PoolSignals
+
+    @property
+    def scaled(self) -> bool:
+        return self.direction != "hold"
+
+
+def decide(
+    pool: str,
+    sig: PoolSignals,
+    policy: AutoscalePolicy,
+    now: float,
+    last_up_at: Optional[float] = None,
+    last_down_at: Optional[float] = None,
+) -> Decision:
+    """The pure scale decision — no engines, no clocks of its own.
+
+    ``pool`` picks the signal family ("prefill" scales on queue wait,
+    anything else on burn rate — the PR-11 split: prefill pressure is
+    arrival-shaped and shows up as queue wait long before burn, decode
+    pressure is cadence-shaped and queue wait stays flat while TPOT
+    burns). Queue depth per replica is a shared leading indicator.
+    Cooldown windows apply per direction; a scale-up landing inside the
+    *down* cooldown is allowed (load spikes must not wait out a
+    scale-down's settle window), and vice versa."""
+
+    def hold(reason: str) -> Decision:
+        return Decision(pool, "hold", reason, sig.replicas, sig)
+
+    prefill = pool == "prefill"
+    hot_signal = (
+        sig.queue_wait_p95_s > policy.scale_up_queue_wait_s
+        if prefill
+        else sig.burn_rate > policy.scale_up_burn
+    )
+    hot_reason = "queue-wait" if prefill else "tpot-burn"
+    depth_hot = (
+        sig.queue_depth > policy.queue_depth_per_replica * max(1, sig.replicas)
+    )
+    calm = (
+        sig.queue_depth == 0
+        and (
+            sig.queue_wait_p95_s <= policy.scale_down_queue_wait_s
+            if prefill
+            else sig.burn_rate <= policy.scale_down_burn
+        )
+    )
+    # total footprint includes draining replicas: their chips are still
+    # held, so "room to grow" must count them or a slow drain plus a
+    # burst double-books the max (the chaos soak's double-count trap)
+    footprint = sig.replicas + sig.draining
+    if hot_signal or depth_hot:
+        reason = hot_reason if hot_signal else "queue-depth"
+        if footprint >= policy.max_replicas:
+            return hold(f"{reason} hot but at max-replicas")
+        if last_up_at is not None and now - last_up_at < policy.scale_up_cooldown_s:
+            return hold(f"{reason} hot but in scale-up cooldown")
+        return Decision(pool, "up", reason, sig.replicas + 1, sig)
+    if calm and sig.replicas > policy.min_replicas:
+        if sig.draining > 0:
+            # one drain at a time: a second victim before the first
+            # finishes retiring turns "calm" into a self-inflicted
+            # backlog (and makes capacity accounting ambiguous)
+            return hold("calm but a drain is already in flight")
+        if (
+            last_down_at is not None
+            and now - last_down_at < policy.scale_down_cooldown_s
+        ):
+            return hold("calm but in scale-down cooldown")
+        if last_up_at is not None and now - last_up_at < policy.scale_down_cooldown_s:
+            # a replica we JUST added must prove itself across a full
+            # settle window before it can be judged idle
+            return hold("calm but settling after a scale-up")
+        return Decision(pool, "down", "calm", sig.replicas - 1, sig)
+    return hold("within hysteresis band")
+
+
+# ---------------------------------------------------------------------------
+# signal gathering (windowed deltas over the live metrics plane)
+# ---------------------------------------------------------------------------
+
+
+class MetricsSignalReader:
+    """Per-pool :class:`PoolSignals` from the router + windowed deltas
+    of the PR-8/PR-11 sensor metrics.
+
+    Burn rate = breach / (ok + breach) of ``bobrapet_serving_slo_total``
+    (ttft for prefill pools, tpot for decode) since the previous read;
+    queue-wait p95 comes from the bucket deltas of
+    ``bobrapet_serving_pool_queue_wait_seconds``. Both windows are
+    "since last tick" — the autoscaler's interval IS the window."""
+
+    def __init__(self, router: Any):
+        self.router = router
+        self._last_slo: dict[tuple, float] = {}
+        self._last_wait: dict[str, tuple] = {}
+        # prime the baselines NOW: the first window must cover "since
+        # the autoscaler started", not the process's whole metric
+        # history (a long-lived engine's past breaches are not load)
+        for slo in ("ttft", "tpot"):
+            self._burn(slo)
+        for pool in ("prefill", "decode"):
+            self._wait_p95(pool)
+
+    def read(self, pool: str, replicas: int, draining: int) -> PoolSignals:
+        return PoolSignals(
+            queue_depth=int(self.router.queue_depths().get(pool, 0)),
+            queue_wait_p95_s=self._wait_p95(pool),
+            burn_rate=self._burn("ttft" if pool == "prefill" else "tpot"),
+            replicas=replicas,
+            draining=draining,
+        )
+
+    def _burn(self, slo: str) -> float:
+        ok = breach = 0.0
+        for labels, value in metrics.serving_slo.snapshot().items():
+            ld = dict(labels)
+            if ld.get("slo") != slo:
+                continue
+            key = labels
+            delta = value - self._last_slo.get(key, 0.0)
+            self._last_slo[key] = value
+            if ld.get("outcome") == "breach":
+                breach += delta
+            else:
+                ok += delta
+        total = ok + breach
+        return (breach / total) if total > 0 else 0.0
+
+    def _wait_p95(self, pool: str) -> float:
+        bounds, counts, total = metrics.serving_pool_wait.bucket_snapshot(pool)
+        prev_counts, prev_total = self._last_wait.get(
+            pool, ([0] * len(counts), 0)
+        )
+        self._last_wait[pool] = (counts, total)
+        window_total = total - prev_total
+        if window_total <= 0:
+            return 0.0
+        target = 0.95 * window_total
+        cum = 0
+        for bound, c, pc in zip(bounds, counts, prev_counts):
+            cum += c - pc
+            if cum >= target:
+                return float(bound)
+        return float(bounds[-1]) if bounds else 0.0
+
+
+# ---------------------------------------------------------------------------
+# the actuator: replicas behind a router
+# ---------------------------------------------------------------------------
+
+
+class EngineReplicaSet:
+    """Replica lifecycle for ONE pool behind a :class:`ServingRouter`.
+
+    ``factory()`` builds a ready engine in the pool's role (the caller
+    owns model/params/paging choices); ``placer``/``queue``/``tpu``
+    optionally charge each replica a slice grant through the placement
+    fast path — scale-up that loses the NoCapacity race simply reports
+    failure and the autoscaler re-tries next window. Only replicas this
+    set added are eligible drain victims (the operator's static engines
+    are not the autoscaler's to retire)."""
+
+    def __init__(
+        self,
+        pool: str,
+        router: Any,
+        factory: Callable[[], Any],
+        placer: Any = None,
+        queue: Optional[str] = None,
+        tpu: Any = None,
+        flight: tuple[str, str] = DEFAULT_FLIGHT,
+    ):
+        if pool not in ("prefill", "decode"):
+            raise ValueError(f"pool must be prefill|decode, got {pool!r}")
+        self.pool = pool
+        self.router = router
+        self.factory = factory
+        self.placer = placer
+        self.queue = queue
+        self.tpu = tpu
+        self.flight = flight
+        self._counter = 0
+        #: engine name -> slice grant dict (None when unplaced)
+        self.grants: dict[str, Optional[dict]] = {}
+        #: engine name -> drain start (monotonic)
+        self._draining: dict[str, float] = {}
+        #: most recent drained-out engines (newest last, bounded) — a
+        #: factory may hand them back out as WARM spares instead of
+        #: paying a fresh compile on the next scale-up
+        self.retired: deque = deque(maxlen=4)
+
+    # -- observation --------------------------------------------------------
+
+    def _members(self) -> list[str]:
+        roles = ("prefill",) if self.pool == "prefill" else ("decode", "unified")
+        return [
+            name
+            for name, eng in self.router.engines.items()
+            if eng.role in roles
+        ]
+
+    def actual(self) -> int:
+        return sum(
+            1 for n in self._members() if n not in self._draining
+        )
+
+    def draining(self) -> int:
+        return len(self._draining)
+
+    # -- scale-up (placement fast path) -------------------------------------
+
+    def scale_up(self, now: float, reason: str) -> Optional[str]:
+        """Place + build + register one replica; returns its name, or
+        None when placement lost the capacity race."""
+        grant = None
+        if self.placer is not None and self.tpu is not None:
+            from ..parallel.placement import NoCapacity
+
+            try:
+                placed = self.placer.place(self.tpu, queue=self.queue)
+            except NoCapacity as e:
+                self._record("scale-up blocked: no capacity",
+                             outcome="no-capacity", reason=reason)
+                _log.info("autoscale %s: placement blocked: %s", self.pool, e)
+                return None
+            grant = placed.to_dict() if placed is not None else None
+        self._counter += 1
+        name = f"{self.pool}-as{self._counter}"
+        try:
+            engine = self.factory()
+        except BaseException:
+            # the grant belongs to nobody — hand it back or the pool
+            # leaks chips on every failed engine build
+            if grant is not None and self.placer is not None:
+                self.placer.release(grant)
+            raise
+        self.router.add_engine(name, engine)
+        self.grants[name] = grant
+        self._record(
+            f"replica {name} up"
+            + (f" on slice {grant.get('sliceId')}" if grant else ""),
+            outcome="up", engine=name, reason=reason,
+        )
+        return name
+
+    # -- scale-down (drain contract) ----------------------------------------
+
+    def begin_drain(self, now: float, reason: str) -> Optional[str]:
+        """Pick the newest autoscaler-added routable replica and stop
+        routing to it; returns its name (None when no eligible
+        victim)."""
+        eligible = [
+            n for n in self._members()
+            if n in self.grants and n not in self._draining
+        ]
+        if not eligible:
+            return None
+        victim = eligible[-1]  # newest first: oldest replicas are the
+        # warmed baseline the operator sized deliberately
+        self.router.drain(victim)
+        self._draining[victim] = now
+        self._record(f"replica {victim} draining", outcome="drain-begin",
+                     engine=victim, reason=reason)
+        return victim
+
+    def poll_drains(self, now: float) -> list[str]:
+        """Retire every drain that reached empty: remove from the
+        router, release the grant. Returns the names retired."""
+        done = []
+        for name in list(self._draining):
+            status = self.router.drain_status(name)
+            if status is None or status.empty:
+                started = self._draining.pop(name)
+                if status is not None:
+                    self.retired.append(self.router.remove_engine(name))
+                self._release(name)
+                metrics.traffic_drain_seconds.observe(
+                    max(0.0, now - started), self.pool
+                )
+                self._record(f"replica {name} drained + released",
+                             outcome="down", engine=name)
+                done.append(name)
+        return done
+
+    # -- preemption (chaos) -------------------------------------------------
+
+    def preempt(self, name: str) -> int:
+        """A replica's slice was reclaimed: evict it (unfinished
+        requests requeue onto the router, clocks carried), release the
+        grant, and clear any drain in progress on it — the drain is
+        finished by force, never stranded. Returns requeued count."""
+        requeued = self.router.evict_engine(name)
+        self._draining.pop(name, None)
+        self._release(name)
+        metrics.traffic_evictions.inc(self.pool)
+        self._record(
+            f"replica {name} preempted: {requeued} request(s) requeued",
+            outcome="preempted", engine=name, requeued=requeued,
+        )
+        return requeued
+
+    # -- internals ----------------------------------------------------------
+
+    def _release(self, name: str) -> None:
+        grant = self.grants.pop(name, None)
+        if grant is not None and self.placer is not None:
+            self.placer.release(grant)
+
+    def _record(self, message: str, **attrs: Any) -> None:
+        ns, run = self.flight
+        FLIGHT.record(ns, run, "autoscale", message=message,
+                      pool=self.pool, **attrs)
+
+
+# ---------------------------------------------------------------------------
+# the loop
+# ---------------------------------------------------------------------------
+
+
+class Autoscaler:
+    """Tick-driven control loop over one router's replica sets.
+
+    ``pools`` maps pool name -> :class:`EngineReplicaSet`. ``tick()``
+    is cheap enough to call from the serve loop every iteration — it
+    self-gates on ``interval`` seconds between decision passes (drains
+    in flight are polled every call so retirement is prompt)."""
+
+    def __init__(
+        self,
+        pools: dict[str, EngineReplicaSet],
+        policy: Optional[AutoscalePolicy] = None,
+        signals: Optional[Any] = None,
+        interval_s: float = 1.0,
+        enabled: bool = True,
+        flight: tuple[str, str] = DEFAULT_FLIGHT,
+    ):
+        if not pools:
+            raise ValueError("Autoscaler needs at least one replica set")
+        self.pools = dict(pools)
+        self.policy = policy or AutoscalePolicy()
+        errs = self.policy.validate()
+        if errs:
+            raise ValueError("; ".join(errs))
+        if signals is None:
+            routers = {id(rs.router): rs.router for rs in self.pools.values()}
+            if len(routers) > 1:
+                # the default reader polls ONE router's queue depths —
+                # silently reading router A for a pool behind router B
+                # would hold that pool forever; multi-router setups
+                # must bring their own signal source
+                raise ValueError(
+                    "replica sets span multiple routers: pass an "
+                    "explicit `signals` reader (the default "
+                    "MetricsSignalReader reads one router's queues)"
+                )
+            signals = MetricsSignalReader(next(iter(routers.values())))
+        self.signals = signals
+        self.interval_s = float(interval_s)
+        self.enabled = bool(enabled)
+        self.flight = flight
+        self._last_pass: Optional[float] = None
+        self._last_up: dict[str, float] = {}
+        self._last_down: dict[str, float] = {}
+        self.decisions: deque = deque(maxlen=64)
+        _LIVE_AUTOSCALERS.add(self)
+
+    # -- live tuning --------------------------------------------------------
+
+    def apply_tuning(self, tcfg: Any) -> None:
+        """Live ``traffic.*`` reload: swap the policy (validated — an
+        invalid combination keeps the prior policy), interval and the
+        enabled flag."""
+        policy = AutoscalePolicy.from_config(tcfg)
+        errs = policy.validate()
+        if errs:
+            raise ValueError("; ".join(errs))
+        self.policy = policy
+        self.interval_s = float(tcfg.autoscale_interval_seconds)
+        self.enabled = bool(tcfg.autoscale_enabled)
+
+    # -- the loop body ------------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> list[Decision]:
+        now = _walltime.monotonic() if now is None else now
+        for rs in self.pools.values():
+            rs.poll_drains(now)
+        self._set_gauges(desired=None)
+        if not self.enabled:
+            return []
+        if self._last_pass is not None and now - self._last_pass < self.interval_s:
+            return []
+        self._last_pass = now
+        out: list[Decision] = []
+        for pool, rs in self.pools.items():
+            sig = self.signals.read(pool, rs.actual(), rs.draining())
+            d = decide(pool, sig, self.policy, now,
+                       self._last_up.get(pool), self._last_down.get(pool))
+            acted = False
+            if d.direction == "up":
+                acted = rs.scale_up(now, d.reason) is not None
+                if acted:
+                    self._last_up[pool] = now
+                else:
+                    d = Decision(pool, "hold",
+                                 f"{d.reason} hot but placement blocked",
+                                 sig.replicas, sig)
+            elif d.direction == "down":
+                acted = rs.begin_drain(now, d.reason) is not None
+                if acted:
+                    self._last_down[pool] = now
+                else:
+                    d = Decision(pool, "hold",
+                                 f"{d.reason} but no drainable replica",
+                                 sig.replicas, sig)
+            if d.scaled and acted:
+                metrics.traffic_autoscale.inc(pool, d.direction, d.reason)
+                ns, run = self.flight
+                FLIGHT.record(
+                    ns, run, "autoscale",
+                    message=f"{pool}: scale {d.direction} ({d.reason}) "
+                            f"-> {d.desired} replicas",
+                    pool=pool, direction=d.direction, reason=d.reason,
+                    desired=d.desired, queueDepth=sig.queue_depth,
+                    burnRate=round(sig.burn_rate, 4),
+                    queueWaitP95=round(sig.queue_wait_p95_s, 4),
+                )
+            # consecutive identical holds collapse into one ring entry
+            # (a long idle window must not wash the actual scale
+            # decisions out of the bounded ring)
+            last = next(
+                (e for e in reversed(self.decisions) if e["pool"] == pool),
+                None,
+            )
+            if (d.direction != "hold" or last is None
+                    or (last["direction"], last["reason"])
+                    != (d.direction, d.reason)):
+                self.decisions.append({
+                    "at": now, "pool": pool, "direction": d.direction,
+                    "reason": d.reason, "desired": d.desired,
+                    "queueDepth": sig.queue_depth,
+                    "burnRate": round(sig.burn_rate, 4),
+                    "queueWaitP95": round(sig.queue_wait_p95_s, 4),
+                })
+            self._set_gauges(desired=(pool, d.desired))
+            out.append(d)
+        return out
+
+    def _set_gauges(self, desired: Optional[tuple[str, int]]) -> None:
+        for pool, rs in self.pools.items():
+            metrics.traffic_replicas.set(float(rs.actual()), pool, "actual")
+            metrics.traffic_replicas.set(float(rs.draining()), pool, "draining")
+            if desired is not None and desired[0] == pool:
+                metrics.traffic_replicas.set(float(desired[1]), pool, "desired")
+
+    # -- introspection ------------------------------------------------------
+
+    def status(self) -> dict[str, Any]:
+        return {
+            "enabled": self.enabled,
+            "intervalSeconds": self.interval_s,
+            "policy": dataclasses.asdict(self.policy),
+            "pools": {
+                pool: {
+                    "actual": rs.actual(),
+                    "draining": rs.draining(),
+                    "members": sorted(rs._members()),
+                    "grants": {
+                        n: (g or {}).get("sliceId")
+                        for n, g in rs.grants.items()
+                    },
+                }
+                for pool, rs in self.pools.items()
+            },
+            "decisions": list(self.decisions),
+        }

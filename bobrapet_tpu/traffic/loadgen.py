@@ -1,0 +1,309 @@
+"""Closed-loop multi-tenant load generation with seeded arrivals.
+
+"Millions of users" becomes a claim the repo simulates and measures:
+each :class:`TenantProfile` models a population of synthetic users in
+**closed loop** — a user submits, waits for its completion, thinks
+(exponential think time = Poisson arrivals per user at steady state),
+then submits again, so in-flight work per user is bounded by
+construction and offered load backs off when the system saturates,
+exactly like real interactive traffic (an open-loop generator would
+just grow an unbounded queue and measure nothing but itself).
+
+Arrivals are **deterministic given the seed**: every user owns a
+``random.Random`` seeded from (seed, tenant, user index), so the
+sequence of prompt lengths/contents, output budgets and think times
+replays identically run to run. What the target does with them (the
+interleaving) is the system under test.
+
+:class:`TrafficPhase` shapes the mix over time: a ``rate`` multiplier
+scales every user's arrival rate for the phase's duration (burst = big
+multiplier, diurnal trough = fractional), and ``rate_end`` turns the
+phase into a linear ramp. Phases advance on wall-clock; when the last
+phase ends the generator stops submitting and drains.
+
+The ``target`` is anything with the engine/router serve surface
+(``submit``/``step``/``finished``) — a bare :class:`ServingEngine`, a
+:class:`ServingRouter` fronting a pool, it does not matter.
+``tick_hooks`` run once per drive-loop iteration (the autoscaler's
+``tick`` rides here in the harness). The report carries per-tenant
+achieved TTFT/TPOT percentiles, goodput and SLO breach counts — the
+numbers the bench gates and the fairness test asserts on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time as _walltime
+from typing import Any, Callable, Optional, Sequence
+
+from ..observability.metrics import metrics
+
+
+def percentile(values: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (None on empty input) — one definition
+    shared by the report, the bench and the tests."""
+    if not values:
+        return None
+    vs = sorted(values)
+    idx = min(len(vs) - 1, max(0, int(round(q * (len(vs) - 1)))))
+    return vs[idx]
+
+
+@dataclasses.dataclass
+class TenantProfile:
+    """One tenant's synthetic population + request-shape distributions."""
+
+    tenant: str
+    #: closed-loop concurrency: simultaneous in-flight requests <= users
+    users: int = 1
+    #: mean think time between a user's completion and its next submit
+    #: (exponential draw; 0 = back-to-back)
+    think_time_s: float = 0.0
+    #: uniform [lo, hi] prompt length draw
+    prompt_len: tuple[int, int] = (8, 24)
+    #: uniform [lo, hi] new-token budget draw
+    new_tokens: tuple[int, int] = (8, 16)
+    temperature: float = 0.0
+    #: token id universe for generated prompts
+    vocab: int = 256
+    #: tokens of tenant-shared system prompt prepended to every request
+    #: (drawn once per tenant from the seed; exercises prefix caching)
+    shared_prefix_len: int = 0
+    #: total requests this tenant may submit (0 = unbounded; phases or
+    #: the wall deadline terminate instead)
+    max_requests: int = 0
+
+
+@dataclasses.dataclass
+class TrafficPhase:
+    """A named window of arrival-rate modulation."""
+
+    name: str
+    duration_s: float
+    #: arrival-rate multiplier (divides think time): 10 = burst, 0.1 =
+    #: trough, 1 = the profile's base rate
+    rate: float = 1.0
+    #: when set, the multiplier ramps linearly rate -> rate_end across
+    #: the phase (diurnal shoulders)
+    rate_end: Optional[float] = None
+
+    def multiplier(self, into_phase_s: float) -> float:
+        if self.rate_end is None or self.duration_s <= 0:
+            return self.rate
+        frac = min(1.0, max(0.0, into_phase_s / self.duration_s))
+        return self.rate + (self.rate_end - self.rate) * frac
+
+
+@dataclasses.dataclass
+class TrafficReport:
+    """What the run achieved, per tenant and overall."""
+
+    wall_s: float
+    submitted: int
+    completed: int
+    #: rids submitted but never retired (MUST be 0 — the e2e test and
+    #: the chaos soak assert on it)
+    lost: int
+    per_tenant: dict[str, dict[str, Any]]
+    phase_log: list[dict[str, Any]]
+
+    def tenant(self, name: str) -> dict[str, Any]:
+        return self.per_tenant[name]
+
+
+class _User:
+    __slots__ = ("profile", "rng", "prefix", "inflight_rid", "next_at",
+                 "submitted")
+
+    def __init__(self, profile: TenantProfile, seed: int, idx: int,
+                 prefix: list[int]):
+        self.profile = profile
+        self.rng = random.Random(f"{seed}:{profile.tenant}:{idx}")
+        self.prefix = prefix
+        self.inflight_rid: Optional[int] = None
+        self.next_at = 0.0
+        self.submitted = 0
+
+
+class ClosedLoopLoadGen:
+    """See module docstring."""
+
+    def __init__(
+        self,
+        target: Any,
+        profiles: Sequence[TenantProfile],
+        phases: Optional[Sequence[TrafficPhase]] = None,
+        seed: int = 0,
+        tick_hooks: Sequence[Callable[[float], Any]] = (),
+    ):
+        if not profiles:
+            raise ValueError("loadgen needs at least one TenantProfile")
+        names = [p.tenant for p in profiles]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate tenant profiles: {sorted(names)}")
+        self.target = target
+        self.profiles = list(profiles)
+        self.phases = list(phases or [])
+        self.seed = int(seed)
+        self.tick_hooks = list(tick_hooks)
+        self._users: list[_User] = []
+        for p in self.profiles:
+            prefix_rng = random.Random(f"{seed}:{p.tenant}:prefix")
+            prefix = [prefix_rng.randrange(p.vocab)
+                      for _ in range(p.shared_prefix_len)]
+            for i in range(p.users):
+                self._users.append(_User(p, self.seed, i, prefix))
+        #: rid -> submitting user, for completion attribution
+        self._inflight: dict[int, _User] = {}
+
+    # -- drive --------------------------------------------------------------
+
+    def run(self, max_duration_s: float = 60.0,
+            max_steps: int = 1_000_000) -> TrafficReport:
+        """Drive the target until every phase has elapsed (or every
+        bounded tenant exhausted its budget) and in-flight work
+        drained; hard stops at ``max_duration_s`` wall seconds either
+        way (the closed loop cannot hang on a wedged target — lost
+        rids then show up in the report, loudly)."""
+        t0 = _walltime.perf_counter()
+        deadline = t0 + max_duration_s
+        phase_total = sum(ph.duration_s for ph in self.phases)
+        harvested = len(self.target.finished)
+        results: dict[str, list[Any]] = {p.tenant: [] for p in self.profiles}
+        phase_log: list[dict[str, Any]] = []
+        last_phase = None
+        submitted = 0
+        steps = 0
+        while steps < max_steps:
+            now = _walltime.perf_counter()
+            elapsed = now - t0
+            if now >= deadline:
+                break
+            phase = self._phase_at(elapsed)
+            if phase is not last_phase and phase is not None:
+                phase_log.append({"phase": phase.name,
+                                  "at_s": round(elapsed, 3)})
+                last_phase = phase
+            submitting = (
+                phase is not None
+                or (not self.phases and self._budget_left())
+            )
+            mult = phase.multiplier(
+                elapsed - self._phase_start(phase)) if phase else 1.0
+            if submitting:
+                submitted += self._submit_ready(now)
+            self.target.step()
+            steps += 1
+            harvested = self._harvest(harvested, results, now, mult)
+            for hook in self.tick_hooks:
+                hook(now)
+            if not submitting and not self._inflight:
+                break
+            if (not self.phases and not self._budget_left()
+                    and not self._inflight):
+                break
+            if self.phases and elapsed > phase_total and not self._inflight:
+                break
+        wall = _walltime.perf_counter() - t0
+        completed = sum(len(v) for v in results.values())
+        return TrafficReport(
+            wall_s=wall,
+            submitted=submitted,
+            completed=completed,
+            lost=len(self._inflight),
+            per_tenant={
+                t: self._stats(rs, wall) for t, rs in results.items()
+            },
+            phase_log=phase_log,
+        )
+
+    # -- internals ----------------------------------------------------------
+
+    def _phase_at(self, elapsed: float) -> Optional[TrafficPhase]:
+        acc = 0.0
+        for ph in self.phases:
+            if elapsed < acc + ph.duration_s:
+                return ph
+            acc += ph.duration_s
+        return None
+
+    def _phase_start(self, phase: TrafficPhase) -> float:
+        acc = 0.0
+        for ph in self.phases:
+            if ph is phase:
+                return acc
+            acc += ph.duration_s
+        return acc
+
+    def _budget_left(self) -> bool:
+        return any(
+            u.profile.max_requests == 0
+            or u.submitted < -(-u.profile.max_requests // u.profile.users)
+            for u in self._users
+        )
+
+    def _submit_ready(self, now: float) -> int:
+        n = 0
+        for u in self._users:
+            if u.inflight_rid is not None or now < u.next_at:
+                continue
+            p = u.profile
+            if p.max_requests and u.submitted >= -(-p.max_requests // p.users):
+                continue
+            prompt = u.prefix + [
+                u.rng.randrange(p.vocab)
+                for _ in range(u.rng.randint(*p.prompt_len))
+            ]
+            rid = self.target.submit(
+                prompt,
+                max_new_tokens=u.rng.randint(*p.new_tokens),
+                temperature=p.temperature,
+                tenant=p.tenant,
+            )
+            u.inflight_rid = rid
+            u.submitted += 1
+            self._inflight[rid] = u
+            metrics.traffic_loadgen_requests.inc(p.tenant)
+            n += 1
+        return n
+
+    def _harvest(self, harvested: int, results: dict[str, list],
+                 now: float, mult: float = 1.0) -> int:
+        fin = self.target.finished
+        while harvested < len(fin):
+            req = fin[harvested]
+            harvested += 1
+            u = self._inflight.pop(req.rid, None)
+            if u is None:
+                continue  # not ours (shared target)
+            results[u.profile.tenant].append(req)
+            p = u.profile
+            # the ACTIVE phase's rate multiplier scales this user's
+            # arrival rate by dividing its think time: burst = near
+            # back-to-back, trough = long idle gaps. Applied at draw
+            # time, so a phase change reshapes arrivals within one
+            # request of taking effect.
+            think = (
+                u.rng.expovariate(1.0 / p.think_time_s) / max(1e-9, mult)
+                if p.think_time_s > 0 else 0.0
+            )
+            u.next_at = now + think
+            u.inflight_rid = None
+        return harvested
+
+    @staticmethod
+    def _stats(reqs: list[Any], wall: float) -> dict[str, Any]:
+        ttfts = [r.ttft_seconds for r in reqs if r.ttft_seconds is not None]
+        tpots = [r.tpot_seconds for r in reqs if r.tpot_seconds is not None]
+        tokens = sum(len(r.output) for r in reqs)
+        return {
+            "completed": len(reqs),
+            "tokens": tokens,
+            "goodput_tok_s": round(tokens / wall, 2) if wall > 0 else 0.0,
+            "ttft_p50_s": percentile(ttfts, 0.50),
+            "ttft_p95_s": percentile(ttfts, 0.95),
+            "tpot_p50_s": percentile(tpots, 0.50),
+            "tpot_p95_s": percentile(tpots, 0.95),
+            "preemptions": sum(r.preemptions for r in reqs),
+        }

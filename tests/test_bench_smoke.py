@@ -1,0 +1,86 @@
+"""Standalone-invocation bench smokes (ISSUE 14 satellite).
+
+``config3_fanout_gang`` shipped asking for an 8 x 2x2 = 32-chip gang
+from a 16-chip pool; the pre-PR-5 per-branch scheduler served it in
+two waves, all-or-nothing gang placement made it permanently
+unplaceable, and the run parked in ``Running`` forever — the bench
+assert failed on every standalone invocation (and inside the sweep,
+recorded as ``config3_failed`` in BENCH_r06) for three releases
+without anything in CI noticing. These tests run the config in-process
+so it can never silently regress again, and pin the allocator change
+that made the failure loud: a gang bigger than a pool's TOTAL capacity
+is a permanent ``PlacementError`` (step fails with LaunchFailed), not
+an un-clearable ``NoCapacity`` park.
+"""
+
+import pytest
+
+from bobrapet_tpu.api.story import make_story
+from bobrapet_tpu.parallel.placement import (
+    NoCapacity,
+    PlacementError,
+    SlicePool,
+)
+from bobrapet_tpu.sdk import register_engram
+
+
+class TestConfig3Standalone:
+    def test_config3_fanout_gang_runs_clean(self):
+        import bench
+
+        r = bench.config3_fanout_gang()
+        assert r["metric"] == "gang_fanout_branches_per_sec"
+        assert r["value"] > 0
+        assert r["branches"] == 4  # the docstring's feasible shape
+        assert r["fleet"]["ledger_balanced"] is True
+
+
+class TestImpossibleGangIsPermanent:
+    def test_pool_raises_placement_error_not_nocapacity(self):
+        pool = SlicePool("p", "4x4", chips_per_host=4)
+        with pytest.raises(PlacementError, match="unplaceable") as ei:
+            pool.allocate_many([("2x2", None)] * 8)  # 32 > 16 total
+        assert not isinstance(ei.value, NoCapacity)
+        # the pool is untouched — nothing was partially committed
+        assert pool.free_chips() == 16
+        # a feasible gang on a BUSY pool still parks as NoCapacity
+        # (transient: releases can clear it)
+        blocker = pool.allocate(want_topology="4x4")
+        with pytest.raises(NoCapacity):
+            pool.allocate_many([("2x2", None)] * 4)
+        pool.release(blocker.slice_id)
+        assert len(pool.allocate_many([("2x2", None)] * 4)) == 4
+
+    def test_run_fails_loudly_instead_of_parking_forever(self):
+        """The old config3 shape through the full control plane: the
+        run must turn terminal Failed (LaunchFailed), never sit in
+        Running with an eternal PlacementQueued park."""
+        from bobrapet_tpu.runtime import Runtime
+
+        rt = Runtime()
+        rt.placer.add_pool(SlicePool("v5e-16", "4x4", chips_per_host=4))
+
+        @register_engram("smoke-c3-impl")
+        def impl(ctx):  # noqa: ARG001
+            return {}
+
+        from bobrapet_tpu.api.catalog import make_engram_template
+        from bobrapet_tpu.api.engram import make_engram
+
+        rt.apply(make_engram_template("smoke-c3-tpl",
+                                      entrypoint="smoke-c3-impl"))
+        rt.apply(make_engram("smoke-c3-worker", "smoke-c3-tpl"))
+        rt.apply(make_story("smoke-c3", steps=[
+            {"name": "split", "type": "parallel", "with": {"steps": [
+                {"name": f"b{i}", "ref": {"name": "smoke-c3-worker"},
+                 "tpu": {"topology": "2x2"}}
+                for i in range(8)  # 32 chips vs the 16-chip pool
+            ]}},
+        ], policy={"queue": "v5e-16"}))
+        run = rt.run_story("smoke-c3")
+        rt.pump()
+        assert rt.run_phase(run) == "Failed"
+        status = rt.store.get("StoryRun", "default", run).status
+        split = status["stepStates"]["split"]
+        assert split["reason"] == "LaunchFailed"
+        assert "unplaceable" in split["message"]

@@ -278,3 +278,57 @@ class TestAnalyticsEndpoints:
         assert payload["running"] is False  # profiler off by default
         assert set(payload) >= {"intervalSeconds", "samples", "topStacks",
                                 "threads", "lockWaits", "overheadRatio"}
+
+
+class TestTrafficEndpoint:
+    """ISSUE 14: /debug/traffic — auth, gate, and payload shape."""
+
+    def test_token_gate(self, server_factory):
+        port = server_factory({"rt": None}, token="sekrit")
+        assert _get(port, "/debug/traffic")[0] == 403
+        assert _get(port, "/debug/traffic", token="wrong")[0] == 403
+
+    def test_payload_shape(self, server_factory):
+        from bobrapet_tpu.traffic import Autoscaler, EngineReplicaSet
+        from bobrapet_tpu.traffic.autoscaler import PoolSignals
+
+        class _FakeRouter:
+            """Engine-free router double: the autoscaler only reads
+            engines/queue_depths from it here."""
+
+            def __init__(self):
+                self.engines = {}
+
+            def queue_depths(self):
+                return {"prefill": 0, "decode": 0}
+
+        class _Signals:
+            def read(self, pool, replicas, draining):
+                return PoolSignals(replicas=replicas, draining=draining)
+
+        router = _FakeRouter()
+        rs = EngineReplicaSet("decode", router, lambda: None)
+        scaler = Autoscaler({"decode": rs}, signals=_Signals(),
+                            interval_s=0.0)
+        scaler.tick(now=1.0)
+        rt = Runtime()
+        port = server_factory({"rt": rt})
+        status, body = _get(port, "/debug/traffic")
+        assert status == 200
+        payload = json.loads(body)
+        ours = [s for s in payload["autoscalers"] if "decode" in s["pools"]]
+        assert ours
+        s = ours[-1]
+        assert set(s) >= {"enabled", "intervalSeconds", "policy", "pools",
+                          "decisions"}
+        assert set(s["pools"]["decode"]) >= {"actual", "draining",
+                                             "members", "grants"}
+        # keep the weakset from dropping them before the request landed
+        del scaler, rs
+
+    def test_config_gate(self, server_factory):
+        rt = Runtime()
+        port = server_factory({"rt": rt})
+        assert _get(port, "/debug/traffic")[0] == 200
+        rt.config_manager.config.telemetry.debug_endpoints = False
+        assert _get(port, "/debug/traffic")[0] == 404

@@ -130,12 +130,27 @@ class TestAllocateMany:
 
     def test_all_or_nothing_rollback(self):
         pool = SlicePool("p", "2x2")
+        # 2 x 1x2 = 4 chips fits the pool's TOTAL but not its current
+        # free space: a TRANSIENT NoCapacity that rolls back cleanly
+        blocker = pool.allocate(want_topology="1x2")
         with pytest.raises(NoCapacity):
-            pool.allocate_many([("1x2", None)] * 3)
-        assert pool.free_chips() == 4
-        assert pool.schedulable_chips() == 4
-        # the rolled-back pool must still serve a fitting gang
+            pool.allocate_many([("1x2", None)] * 2)
+        assert pool.free_chips() == 2
+        assert pool.schedulable_chips() == 2
+        # ...and a release clears it — the rolled-back pool serves the
+        # same gang
+        pool.release(blocker.slice_id)
         assert len(pool.allocate_many([("1x2", None)] * 2)) == 2
+
+    def test_gang_over_total_capacity_is_permanent(self):
+        """A gang bigger than the WHOLE pool can never be cleared by a
+        release: permanent PlacementError, never an eternal NoCapacity
+        park (the bench-config3 hang, ISSUE 14)."""
+        pool = SlicePool("p", "2x2")
+        with pytest.raises(PlacementError, match="unplaceable") as ei:
+            pool.allocate_many([("1x2", None)] * 3)  # 6 > 4 total
+        assert not isinstance(ei.value, NoCapacity)
+        assert pool.free_chips() == 4
 
     def test_siblings_pack_into_a_contiguous_superblock(self):
         """4 x (1x4) siblings on an empty 4x4 pool should land as one
@@ -180,9 +195,24 @@ class TestPlaceGroup:
         assert len(_grant_cells(out["eval"])) == 2
 
     def test_group_no_capacity_is_atomic(self):
+        pool = SlicePool("tiny", "2x4")
+        placer = SlicePlacer([pool])
+        blocker = pool.allocate(want_topology="2x2")
+        # gang fits the TOTAL pool but not current free space —
+        # transient, atomic, pool untouched
+        with pytest.raises(NoCapacity):
+            placer.place_group(
+                [("a", TPUPolicy(topology="2x2")),
+                 ("b", TPUPolicy(topology="2x2"))],
+                queue="tiny",
+            )
+        assert pool.free_chips() == 4
+        del blocker
+
+    def test_group_over_total_capacity_is_permanent(self):
         pool = SlicePool("tiny", "2x2")
         placer = SlicePlacer([pool])
-        with pytest.raises(NoCapacity):
+        with pytest.raises(PlacementError, match="unplaceable"):
             placer.place_group(
                 [("a", TPUPolicy(topology="2x2")),
                  ("b", TPUPolicy(topology="2x2"))],

@@ -1,0 +1,573 @@
+"""Traffic harness units: weighted-fair queue, pure autoscaler
+decisions, closed-loop load-generator mechanics, traffic.* config keys.
+
+Everything here is engine-free (no jax) — the pure halves of the
+subsystem. The closed-loop fairness pin, the drain contract and the
+autoscaler e2e live in test_traffic_e2e.py; the preemption chaos soak
+in test_traffic_chaos.py.
+"""
+
+import random
+from collections import deque
+from dataclasses import replace
+
+import pytest
+
+from bobrapet_tpu.config.operator import (
+    OperatorConfig,
+    TrafficConfig,
+    parse_config,
+)
+from bobrapet_tpu.traffic import (
+    Autoscaler,
+    AutoscalePolicy,
+    ClosedLoopLoadGen,
+    Decision,
+    PoolSignals,
+    TenantProfile,
+    TrafficPhase,
+    WeightedFairQueue,
+    decide,
+    parse_tenant_weights,
+)
+
+
+class _Req:
+    """Duck-typed queue item (matches engine Request / router _Queued)."""
+
+    def __init__(self, tenant, prompt_len=10, max_new=4):
+        self.tenant = tenant
+        self.prompt = [0] * prompt_len
+        self.max_new_tokens = max_new
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"_Req({self.tenant})"
+
+
+# ---------------------------------------------------------------------------
+# parse_tenant_weights
+# ---------------------------------------------------------------------------
+
+
+class TestParseTenantWeights:
+    def test_basic(self):
+        assert parse_tenant_weights("a:4,b:1") == {"a": 4.0, "b": 1.0}
+
+    def test_empty_is_fifo(self):
+        assert parse_tenant_weights("") == {}
+        assert parse_tenant_weights("   ") == {}
+
+    def test_default_star(self):
+        assert parse_tenant_weights("*:2,a:8") == {"*": 2.0, "a": 8.0}
+
+    def test_colon_in_tenant_name(self):
+        # rpartition: the LAST colon splits, so namespaced tenants work
+        assert parse_tenant_weights("org:team:3") == {"org:team": 3.0}
+
+    @pytest.mark.parametrize("bad", ["a", "a:", ":3", "a:zero", "a:-1",
+                                     "a:0"])
+    def test_rejects(self, bad):
+        with pytest.raises(ValueError):
+            parse_tenant_weights(bad)
+
+
+# ---------------------------------------------------------------------------
+# WeightedFairQueue
+# ---------------------------------------------------------------------------
+
+
+class TestWeightedFairQueue:
+    def test_fifo_parity_without_weights(self):
+        """No weights = byte-compatible with the deque it replaces."""
+        q, d = WeightedFairQueue(), deque()
+        rng = random.Random(0)
+        for _ in range(300):
+            if rng.random() < 0.6 or not d:
+                r = _Req(rng.choice("abc"))
+                q.append(r)
+                d.append(r)
+            else:
+                assert q.popleft() is d.popleft()
+                assert len(q) == len(d)
+        while d:
+            assert q.popleft() is d.popleft()
+
+    def test_victim_not_starved_by_flood(self):
+        """The construction the whole subsystem exists for: a victim
+        arriving behind a 100-deep flood is served within ONE flood
+        request, not after the backlog."""
+        q = WeightedFairQueue({"victim": 1.0, "flood": 1.0})
+        for _ in range(100):
+            q.append(_Req("flood"))
+        q.append(_Req("victim"))
+        first_two = [q.popleft().tenant, q.popleft().tenant]
+        assert "victim" in first_two
+
+    def test_weight_proportional_share(self):
+        q = WeightedFairQueue({"a": 3.0, "b": 1.0})
+        for _ in range(60):
+            q.append(_Req("a"))
+            q.append(_Req("b"))
+        served = [q.popleft().tenant for _ in range(40)]
+        # 3:1 within one request of exact
+        assert 28 <= served.count("a") <= 31
+
+    def test_cost_weighted_not_count_weighted(self):
+        """A tenant sending requests 10x the size cannot buy 10x the
+        tokens: share is cost-proportional."""
+        q = WeightedFairQueue({"big": 1.0, "small": 1.0})
+        for _ in range(40):
+            q.append(_Req("big", prompt_len=100, max_new=0))
+            q.append(_Req("small", prompt_len=10, max_new=0))
+        cost = {"big": 0.0, "small": 0.0}
+        for _ in range(44):
+            r = q.popleft()
+            cost[r.tenant] += len(r.prompt)
+        ratio = cost["big"] / max(1.0, cost["small"])
+        assert 0.7 <= ratio <= 1.4, cost
+
+    def test_head_stability_and_appendleft(self):
+        q = WeightedFairQueue({"a": 1.0})
+        r1, r2 = _Req("a"), _Req("b")
+        q.append(r1)
+        q.append(r2)
+        head = q[0]
+        assert q[0] is head  # repeated peeks stable
+        assert q.popleft() is head
+        q.appendleft(head)  # engine preemption requeue
+        assert q[0] is head and q.popleft() is head
+
+    def test_idle_banks_no_credit(self):
+        """A tenant idle while others were served cannot burst through
+        banked virtual time on return."""
+        q = WeightedFairQueue({"a": 1.0, "b": 1.0})
+        for _ in range(20):
+            q.append(_Req("a"))
+        for _ in range(10):
+            q.popleft()  # only a served; clock advanced
+        for _ in range(20):
+            q.append(_Req("b"))
+        served = [q.popleft().tenant for _ in range(10)]
+        # b re-enters AT the clock: interleaves, does not monopolize
+        assert 3 <= served.count("b") <= 7, served
+
+    def test_iteration_is_arrival_order(self):
+        q = WeightedFairQueue({"a": 1.0})
+        reqs = [_Req("a"), _Req("b"), _Req("a"), _Req("c")]
+        for r in reqs:
+            q.append(r)
+        assert list(q) == reqs
+        assert q[2] is reqs[2]
+
+    def test_len_bool_clear(self):
+        q = WeightedFairQueue()
+        assert not q and len(q) == 0
+        with pytest.raises(IndexError):
+            q.popleft()
+        q.extend([_Req("a"), _Req("b")])
+        assert q and len(q) == 2
+        q.clear()
+        assert not q
+
+    def test_transfer_preserves_order(self):
+        """The live-reload swap path: deque -> fair -> deque keeps
+        arrival order exactly."""
+        reqs = [_Req(t) for t in "abcabc"]
+        d = deque(reqs)
+        q = WeightedFairQueue({"a": 2.0})
+        q.extend(d)
+        back: deque = deque()
+        back.extend(q)
+        assert list(back) == reqs
+
+
+# ---------------------------------------------------------------------------
+# pure autoscaler decisions (satellite: no engines needed)
+# ---------------------------------------------------------------------------
+
+
+_POL = AutoscalePolicy(
+    min_replicas=1, max_replicas=4,
+    scale_up_burn=0.30, scale_down_burn=0.05,
+    scale_up_queue_wait_s=0.50, scale_down_queue_wait_s=0.05,
+    queue_depth_per_replica=8,
+    scale_up_cooldown_s=5.0, scale_down_cooldown_s=30.0,
+)
+
+
+class TestDecide:
+    def test_decode_scales_up_on_tpot_burn(self):
+        d = decide("decode", PoolSignals(burn_rate=0.5, replicas=1),
+                   _POL, now=100.0)
+        assert (d.direction, d.reason, d.desired) == ("up", "tpot-burn", 2)
+
+    def test_prefill_scales_up_on_queue_wait(self):
+        d = decide("prefill", PoolSignals(queue_wait_p95_s=1.0, replicas=1),
+                   _POL, now=100.0)
+        assert (d.direction, d.reason) == ("up", "queue-wait")
+
+    def test_signal_split_is_strict(self):
+        """The PR-11 split: a prefill pool does NOT scale on burn, a
+        decode pool does NOT scale on queue wait."""
+        d = decide("prefill", PoolSignals(burn_rate=1.0, replicas=1),
+                   _POL, now=100.0)
+        assert d.direction == "hold"
+        d = decide("decode", PoolSignals(queue_wait_p95_s=10.0, replicas=1),
+                   _POL, now=100.0)
+        assert d.direction == "hold"
+
+    def test_depth_is_shared_leading_indicator(self):
+        for pool in ("prefill", "decode"):
+            d = decide(pool, PoolSignals(queue_depth=20, replicas=2),
+                       _POL, now=100.0)
+            assert (d.direction, d.reason) == ("up", "queue-depth"), pool
+        # 16 queued on 2 replicas = at the 8/replica bound, not past it
+        d = decide("decode", PoolSignals(queue_depth=16, replicas=2),
+                   _POL, now=100.0)
+        assert d.direction == "hold"
+
+    def test_hysteresis_band_holds(self):
+        """Between the down and up thresholds NOTHING happens, in
+        either direction — the gap is the anti-flap guarantee."""
+        for burn in (0.06, 0.15, 0.29):
+            d = decide("decode", PoolSignals(burn_rate=burn, replicas=2),
+                       _POL, now=100.0)
+            assert d.direction == "hold", burn
+        for wait in (0.06, 0.3, 0.49):
+            d = decide("prefill",
+                       PoolSignals(queue_wait_p95_s=wait, replicas=2),
+                       _POL, now=100.0)
+            assert d.direction == "hold", wait
+
+    def test_scale_up_cooldown(self):
+        sig = PoolSignals(burn_rate=0.9, replicas=2)
+        d = decide("decode", sig, _POL, now=103.0, last_up_at=100.0)
+        assert d.direction == "hold" and "cooldown" in d.reason
+        d = decide("decode", sig, _POL, now=105.1, last_up_at=100.0)
+        assert d.direction == "up"
+
+    def test_scale_down_requires_calm_and_cooldown(self):
+        calm = PoolSignals(burn_rate=0.0, queue_depth=0, replicas=3)
+        d = decide("decode", calm, _POL, now=100.0)
+        assert (d.direction, d.desired) == ("down", 2)
+        # queued work blocks a scale-down no matter how low the burn
+        d = decide("decode", replace(calm, queue_depth=1), _POL, now=100.0)
+        assert d.direction == "hold"
+        d = decide("decode", calm, _POL, now=110.0, last_down_at=100.0)
+        assert d.direction == "hold" and "cooldown" in d.reason
+        # a replica added seconds ago must settle before being judged
+        d = decide("decode", calm, _POL, now=110.0, last_up_at=100.0)
+        assert d.direction == "hold" and "settling" in d.reason
+
+    def test_clamps(self):
+        d = decide("decode", PoolSignals(burn_rate=0.9, replicas=4),
+                   _POL, now=100.0)
+        assert d.direction == "hold" and "max-replicas" in d.reason
+        d = decide("decode",
+                   PoolSignals(burn_rate=0.0, queue_depth=0, replicas=1),
+                   _POL, now=100.0)
+        assert d.direction == "hold"  # at min
+
+    def test_draining_counts_against_max(self):
+        """A slow drain's chips are still held: 3 routable + 1 draining
+        at max 4 means NO room to grow (the double-count trap)."""
+        d = decide("decode",
+                   PoolSignals(burn_rate=0.9, replicas=3, draining=1),
+                   _POL, now=100.0)
+        assert d.direction == "hold" and "max-replicas" in d.reason
+
+    def test_one_drain_at_a_time(self):
+        d = decide("decode",
+                   PoolSignals(burn_rate=0.0, queue_depth=0, replicas=3,
+                               draining=1),
+                   _POL, now=100.0)
+        assert d.direction == "hold" and "drain" in d.reason
+
+    def test_decision_is_pure(self):
+        sig = PoolSignals(burn_rate=0.5, replicas=1)
+        a = decide("decode", sig, _POL, now=100.0)
+        b = decide("decode", sig, _POL, now=100.0)
+        assert a == b and isinstance(a, Decision)
+
+    def test_policy_validation(self):
+        assert AutoscalePolicy().validate() == []
+        assert AutoscalePolicy(min_replicas=0).validate()
+        assert AutoscalePolicy(max_replicas=0).validate()
+        assert AutoscalePolicy(scale_down_burn=0.5,
+                               scale_up_burn=0.3).validate()
+        assert AutoscalePolicy(scale_down_queue_wait_s=2.0).validate()
+        assert AutoscalePolicy(scale_up_cooldown_s=-1).validate()
+
+
+# ---------------------------------------------------------------------------
+# closed-loop load generator (against an instant fake target)
+# ---------------------------------------------------------------------------
+
+
+class _FakeTarget:
+    """Instant-completion serving target: every step finishes every
+    pending request (Request-shaped results)."""
+
+    class _Fin:
+        def __init__(self, rid, prompt, n):
+            self.rid = rid
+            self.output = list(range(n))
+            self.preemptions = 0
+            self.ttft_seconds = 0.01
+            self.tpot_seconds = 0.001
+
+    def __init__(self):
+        self.finished = []
+        self._queue = []
+        self._next = 0
+        self.submissions = []
+
+    def submit(self, prompt, max_new_tokens, temperature=0.0, tenant="",
+               **kw):
+        rid = self._next
+        self._next += 1
+        self.submissions.append((tenant, list(prompt), max_new_tokens))
+        self._queue.append((rid, prompt, max_new_tokens))
+        return rid
+
+    def step(self):
+        for rid, prompt, n in self._queue:
+            self.finished.append(self._Fin(rid, prompt, n))
+        self._queue.clear()
+
+
+class TestLoadGen:
+    def _profiles(self):
+        return [
+            TenantProfile("a", users=2, prompt_len=(4, 8),
+                          new_tokens=(2, 4), max_requests=10),
+            TenantProfile("b", users=1, prompt_len=(16, 16),
+                          new_tokens=(8, 8), max_requests=5,
+                          shared_prefix_len=8),
+        ]
+
+    def test_deterministic_schedule(self):
+        """Same seed = identical per-tenant request sequences."""
+        subs = []
+        for _ in range(2):
+            t = _FakeTarget()
+            ClosedLoopLoadGen(t, self._profiles(), seed=7).run(
+                max_duration_s=10.0)
+            subs.append(sorted(t.submissions))
+        assert subs[0] == subs[1]
+        t = _FakeTarget()
+        ClosedLoopLoadGen(t, self._profiles(), seed=8).run(
+            max_duration_s=10.0)
+        assert sorted(t.submissions) != subs[0]
+
+    def test_budgets_and_report(self):
+        t = _FakeTarget()
+        rep = ClosedLoopLoadGen(t, self._profiles(), seed=1).run(
+            max_duration_s=10.0)
+        assert rep.submitted == rep.completed == 15
+        assert rep.lost == 0
+        assert rep.tenant("a")["completed"] == 10
+        assert rep.tenant("b")["completed"] == 5
+        assert rep.tenant("b")["ttft_p95_s"] == pytest.approx(0.01)
+        assert rep.tenant("b")["tokens"] == 5 * 8
+
+    def test_shared_prefix_rides_every_request(self):
+        t = _FakeTarget()
+        ClosedLoopLoadGen(t, self._profiles(), seed=1).run(
+            max_duration_s=10.0)
+        b_prompts = [p for ten, p, _n in t.submissions if ten == "b"]
+        prefixes = {tuple(p[:8]) for p in b_prompts}
+        assert len(prefixes) == 1
+        assert all(len(p) == 24 for p in b_prompts)
+
+    def test_closed_loop_bounds_in_flight(self):
+        """In-flight per tenant never exceeds its user count."""
+        class SlowTarget(_FakeTarget):
+            def __init__(self):
+                super().__init__()
+                self.max_seen = 0
+
+            def step(self):
+                per = {}
+                for rid, p, n in self._queue:
+                    per.setdefault(len(p) >= 0 and "x", 0)
+                self.max_seen = max(self.max_seen, len(self._queue))
+                # finish ONE request per step — backlog builds if the
+                # generator were open-loop
+                if self._queue:
+                    rid, prompt, n = self._queue.pop(0)
+                    self.finished.append(self._Fin(rid, prompt, n))
+
+        t = SlowTarget()
+        ClosedLoopLoadGen(
+            t, [TenantProfile("a", users=3, max_requests=30)], seed=2,
+        ).run(max_duration_s=20.0)
+        assert t.max_seen <= 3
+
+    def test_phases_modulate_rate_and_terminate(self):
+        t = _FakeTarget()
+        rep = ClosedLoopLoadGen(
+            t,
+            [TenantProfile("a", users=2, think_time_s=0.002)],
+            phases=[TrafficPhase("warm", 0.05, rate=1.0),
+                    TrafficPhase("burst", 0.05, rate=50.0),
+                    TrafficPhase("ramp-down", 0.05, rate=50.0,
+                                 rate_end=0.1)],
+            seed=3,
+        ).run(max_duration_s=5.0)
+        assert [p["phase"] for p in rep.phase_log] == [
+            "warm", "burst", "ramp-down"]
+        assert rep.lost == 0 and rep.completed == rep.submitted > 0
+
+    def test_phase_rate_shapes_arrivals(self):
+        """The multiplier must actually modulate the arrival process —
+        not just exist (it was once computed and dropped): the same
+        profile through a high-rate phase completes far more requests
+        than through a low-rate phase in the same wall budget."""
+        def completed(rate):
+            t = _FakeTarget()
+            rep = ClosedLoopLoadGen(
+                t,
+                [TenantProfile("a", users=2, think_time_s=0.05)],
+                phases=[TrafficPhase("p", 0.4, rate=rate)],
+                seed=5,
+            ).run(max_duration_s=2.0)
+            assert rep.lost == 0
+            return rep.completed
+
+        slow, fast = completed(0.1), completed(50.0)
+        assert fast > 4 * slow, (slow, fast)
+
+    def test_phase_multiplier_ramp(self):
+        ph = TrafficPhase("r", 10.0, rate=1.0, rate_end=11.0)
+        assert ph.multiplier(0.0) == pytest.approx(1.0)
+        assert ph.multiplier(5.0) == pytest.approx(6.0)
+        assert ph.multiplier(10.0) == pytest.approx(11.0)
+        assert TrafficPhase("flat", 10.0, rate=2.0).multiplier(7.0) == 2.0
+
+    def test_duplicate_tenants_rejected(self):
+        with pytest.raises(ValueError):
+            ClosedLoopLoadGen(_FakeTarget(),
+                              [TenantProfile("a"), TenantProfile("a")])
+
+
+# ---------------------------------------------------------------------------
+# traffic.* / serving.tenant-weights config plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestTrafficConfigKeys:
+    def test_keys_parse(self):
+        cfg = parse_config({
+            "traffic.autoscale-enabled": "true",
+            "traffic.autoscale-interval": "2s",
+            "traffic.min-replicas": "2",
+            "traffic.max-replicas": "6",
+            "traffic.scale-up-burn": "0.4",
+            "traffic.scale-down-burn": "0.1",
+            "traffic.scale-up-queue-wait": "750ms",
+            "traffic.scale-down-queue-wait": "100ms",
+            "traffic.queue-depth-per-replica": "16",
+            "traffic.scale-up-cooldown": "3s",
+            "traffic.scale-down-cooldown": "45s",
+            "serving.tenant-weights": "gold:4,free:1",
+        })
+        t = cfg.traffic
+        assert t.autoscale_enabled is True
+        assert t.autoscale_interval_seconds == 2.0
+        assert (t.min_replicas, t.max_replicas) == (2, 6)
+        assert (t.scale_up_burn, t.scale_down_burn) == (0.4, 0.1)
+        assert t.scale_up_queue_wait_seconds == pytest.approx(0.75)
+        assert t.scale_down_queue_wait_seconds == pytest.approx(0.10)
+        assert t.queue_depth_per_replica == 16
+        assert t.scale_up_cooldown_seconds == 3.0
+        assert t.scale_down_cooldown_seconds == 45.0
+        assert cfg.serving.tenant_weights == "gold:4,free:1"
+        assert cfg.validate() == []
+
+    def test_validation_rejects(self):
+        bad = OperatorConfig()
+        bad.serving.tenant_weights = "a:-1"
+        assert any("tenant-weights" in e for e in bad.validate())
+        bad = OperatorConfig()
+        bad.traffic.scale_down_burn = 0.9
+        assert any("hysteresis" in e for e in bad.validate())
+        bad = OperatorConfig()
+        bad.traffic.autoscale_interval_seconds = 0.0
+        assert any("autoscale-interval" in e for e in bad.validate())
+        bad = OperatorConfig()
+        bad.traffic.max_replicas = 0
+        assert any("max-replicas" in e for e in bad.validate())
+
+    def test_policy_from_config(self):
+        pol = AutoscalePolicy.from_config(TrafficConfig(
+            min_replicas=2, max_replicas=8, scale_up_burn=0.5,
+        ))
+        assert pol.min_replicas == 2 and pol.max_replicas == 8
+        assert pol.scale_up_burn == 0.5
+        assert pol.validate() == []
+
+
+class _FakeRouter:
+    """Engine-free router double for reload tests."""
+
+    def __init__(self):
+        self.engines = {}
+
+    def queue_depths(self):
+        return {"prefill": 0, "decode": 0}
+
+
+class _ZeroSignals:
+    def read(self, pool, replicas, draining):
+        return PoolSignals(replicas=replicas, draining=draining)
+
+
+class TestLiveReload:
+    def test_runtime_reload_reaches_live_autoscalers(self):
+        from bobrapet_tpu.runtime import Runtime
+        from bobrapet_tpu.traffic.autoscaler import EngineReplicaSet
+
+        rs = EngineReplicaSet("decode", _FakeRouter(), lambda: None)
+        scaler = Autoscaler({"decode": rs}, signals=_ZeroSignals(),
+                            interval_s=5.0, enabled=False)
+        cfg = parse_config({
+            "traffic.autoscale-enabled": "true",
+            "traffic.autoscale-interval": "250ms",
+            "traffic.max-replicas": "7",
+            "traffic.scale-up-burn": "0.6",
+        })
+        Runtime._apply_traffic_tuning(cfg)
+        assert scaler.enabled is True
+        assert scaler.interval_s == pytest.approx(0.25)
+        assert scaler.policy.max_replicas == 7
+        assert scaler.policy.scale_up_burn == 0.6
+        # the handoff slot is parked for autoscalers built later
+        from bobrapet_tpu.config import operator as opcfg
+
+        assert opcfg.LAST_TRAFFIC_TUNING is cfg.traffic
+
+    def test_multi_router_needs_explicit_signals(self):
+        """The default MetricsSignalReader polls ONE router's queues;
+        replica sets spanning routers must bring their own reader or
+        one pool's depth signal would silently read the wrong router."""
+        from bobrapet_tpu.traffic.autoscaler import EngineReplicaSet
+
+        rs_a = EngineReplicaSet("prefill", _FakeRouter(), lambda: None)
+        rs_b = EngineReplicaSet("decode", _FakeRouter(), lambda: None)
+        with pytest.raises(ValueError, match="multiple routers"):
+            Autoscaler({"prefill": rs_a, "decode": rs_b})
+        # an explicit reader makes the same shape legal
+        Autoscaler({"prefill": rs_a, "decode": rs_b},
+                   signals=_ZeroSignals())
+
+    def test_invalid_reload_keeps_prior_policy(self):
+        from bobrapet_tpu.traffic import autoscaler as mod
+
+        rs = mod.EngineReplicaSet("decode", _FakeRouter(), lambda: None)
+        scaler = Autoscaler({"decode": rs}, signals=_ZeroSignals(),
+                            interval_s=1.0)
+        prior = scaler.policy
+        bad = TrafficConfig(scale_up_burn=0.1, scale_down_burn=0.5)
+        mod.apply_tuning(bad)  # logs + skips, never half-applies
+        assert scaler.policy is prior

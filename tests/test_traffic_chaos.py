@@ -1,0 +1,206 @@
+"""Chaos soak: the traffic autoscaler under PR-3 preemption injection.
+
+The trap this pins (ISSUE 14): a replica's slice reclaimed WHILE the
+autoscaler is mid-decision must neither double-count capacity (the
+dead replica's chips released once, never twice; max-replicas honored
+against the true footprint) nor strand a drain (a drain in progress on
+the preempted replica is finished by force, not left dangling).
+
+Deterministic: the PreemptionInjector's seeded plan() decides which
+autoscaler-added replica dies and after how many loadgen ticks —
+exactly the contract the gang executor consults — and every loadgen
+arrival is seed-replayed. Condition-wait based: loops wait on state,
+never on wall-clock guesses.
+"""
+
+import types
+
+import jax
+import pytest
+
+from bobrapet_tpu.api.shared import TPUPolicy
+from bobrapet_tpu.controllers.workload_sim import PreemptionInjector
+from bobrapet_tpu.models import llama
+from bobrapet_tpu.parallel.placement import SlicePlacer, SlicePool
+from bobrapet_tpu.serving import PagedConfig, ServingEngine, ServingRouter
+from bobrapet_tpu.traffic import (
+    Autoscaler,
+    AutoscalePolicy,
+    ClosedLoopLoadGen,
+    EngineReplicaSet,
+    TenantProfile,
+    TrafficPhase,
+)
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _engine(model):
+    cfg, params = model
+    return ServingEngine(params, cfg, PagedConfig(
+        max_slots=4, block_size=16, num_blocks=128, max_blocks_per_seq=8))
+
+
+def _grant_job(grant: dict) -> types.SimpleNamespace:
+    """Duck-typed gang Job over a replica's slice grant — the exact
+    surface PreemptionInjector.plan consults."""
+    return types.SimpleNamespace(
+        spec={"hosts": grant.get("hosts", 1), "sliceGrant": grant}
+    )
+
+
+class _ChaosReplicaSet(EngineReplicaSet):
+    """Replica set that rolls the injector's plan on every scale-up —
+    a planned replica is preempted after the plan's poll count of
+    loadgen ticks (the injector's cooperative-deadline fuse, with
+    loadgen ticks standing in for deadline polls)."""
+
+    def __init__(self, *a, injector: PreemptionInjector, **kw):
+        super().__init__(*a, **kw)
+        self.injector = injector
+        #: name -> remaining ticks until the planned preemption fires
+        self.fuses: dict[str, int] = {}
+        self.preempted: list[str] = []
+
+    def scale_up(self, now, reason):
+        name = super().scale_up(now, reason)
+        if name is not None:
+            grant = self.grants.get(name)
+            if grant is not None:
+                plan = self.injector.plan(_grant_job(grant))
+                if plan is not None:
+                    # one loadgen tick stands in for a (much longer)
+                    # cooperative deadline poll: a short fuse fires
+                    # while the replica still holds live work
+                    self.fuses[name] = plan["afterPolls"] * 5
+        return name
+
+    def chaos_tick(self) -> None:
+        for name in list(self.fuses):
+            if name not in self.grants:
+                self.fuses.pop(name)  # already drained/removed
+                continue
+            self.fuses[name] -= 1
+            if self.fuses[name] <= 0:
+                self.fuses.pop(name)
+                self.preempted.append(name)
+                self.preempt(name)
+
+
+class TestTrafficChaosSoak:
+    def test_preemption_during_autoscale_exactly_once(self, model):
+        placer = SlicePlacer([SlicePool("serve", "4x4", chips_per_host=4)])
+        pool = placer.pool("serve")
+        router = ServingRouter({"d0": _engine(model)})
+        injector = PreemptionInjector(rate=1.0, seed=1234, min_hosts=1)
+        rs = _ChaosReplicaSet(
+            "decode", router, lambda: _engine(model),
+            placer=placer, queue="serve", tpu=TPUPolicy(topology="2x2"),
+            injector=injector,
+        )
+        scaler = Autoscaler(
+            {"decode": rs},
+            AutoscalePolicy(
+                min_replicas=1, max_replicas=3,
+                scale_up_burn=0.5, scale_down_burn=0.05,
+                queue_depth_per_replica=2,
+                scale_up_cooldown_s=0.0, scale_down_cooldown_s=0.02,
+            ),
+            interval_s=0.0,
+        )
+        free0 = pool.free_chips()
+        min_free_seen = [free0]
+
+        def hook(_now):
+            scaler.tick()
+            rs.chaos_tick()
+            min_free_seen[0] = min(min_free_seen[0], pool.free_chips())
+
+        profiles = [
+            TenantProfile("alpha", users=8, prompt_len=(10, 20),
+                          new_tokens=(12, 24), max_requests=80),
+            TenantProfile("beta", users=8, prompt_len=(10, 20),
+                          new_tokens=(12, 24), max_requests=80),
+        ]
+        phases = [TrafficPhase("burst", 3.0, rate=20.0),
+                  TrafficPhase("trough", 2.0, rate=0.2)]
+        rep = ClosedLoopLoadGen(
+            router, profiles, phases=phases, seed=42, tick_hooks=[hook],
+        ).run(max_duration_s=90.0)
+
+        # the soak actually exercised the chaos path
+        assert injector.planned >= 1 and rs.preempted, (
+            "seeded plan never fired — chaos leg inert"
+        )
+        # zero lost work: every submitted rid retired exactly ONCE even
+        # through evictions (requeued continuations keep their rid)
+        assert rep.lost == 0
+        rids = [r.rid for r in router.finished]
+        assert len(rids) == len(set(rids)) == rep.completed == rep.submitted
+        # capacity never double-counted: the replica cap bounds grants
+        # at every instant (3 x 2x2 = 12 chips over the 16-chip pool)
+        assert min_free_seen[0] >= free0 - 12
+
+        # condition-wait the system back to quiescence: drains finish,
+        # grants release, nothing stranded
+        import time as _t
+
+        deadline = _t.monotonic() + 30.0
+        while _t.monotonic() < deadline:
+            router.step()
+            scaler.tick()
+            rs.chaos_tick()
+            if (rs.draining() == 0 and rs.actual() == 1
+                    and pool.free_chips() == free0):
+                break
+            _t.sleep(0.002)
+        assert rs.draining() == 0, "stranded drain"
+        assert rs.actual() == 1
+        assert pool.free_chips() == free0, (
+            "grant leaked or double-released"
+        )
+        assert rs.grants == {}
+
+    def test_preempt_mid_drain_is_not_stranded(self, model):
+        """The sharpest corner: the victim of a scale-down drain is
+        preempted BEFORE its drain empties. The drain must resolve (by
+        force), its grant release exactly once, and its in-flight work
+        requeue and finish."""
+        placer = SlicePlacer([SlicePool("serve", "4x4", chips_per_host=4)])
+        pool = placer.pool("serve")
+        router = ServingRouter({"d0": _engine(model)})
+        rs = EngineReplicaSet(
+            "decode", router, lambda: _engine(model),
+            placer=placer, queue="serve", tpu=TPUPolicy(topology="2x2"),
+        )
+        free0 = pool.free_chips()
+        name = rs.scale_up(now=0.0, reason="test")
+        assert name is not None and pool.free_chips() == free0 - 4
+
+        rids = [router.submit(list(range(5, 5 + 10)), max_new_tokens=48)
+                for _ in range(8)]
+        for _ in range(2):
+            router.step()  # work lands on both replicas
+        assert router.engines[name].in_flight > 0
+        rs.begin_drain(now=1.0, reason="test")
+        assert rs.draining() == 1
+        # the draining replica's slice is reclaimed mid-retirement
+        requeued = rs.preempt(name)
+        assert requeued > 0
+        assert rs.draining() == 0, "drain stranded by the preemption"
+        assert pool.free_chips() == free0, "grant not released exactly once"
+        # a concurrent scale decision sees truthful capacity: the dead
+        # replica is gone from actual AND draining
+        assert rs.actual() == 1
+        fin = router.run()
+        assert sorted(r.rid for r in fin) == sorted(rids)
+        assert len({r.rid for r in fin}) == len(rids)
+        # poll_drains on the evicted name is a no-op, not an error
+        assert rs.poll_drains(now=2.0) == []

@@ -1,0 +1,493 @@
+"""Traffic harness against real engines: the drain contract (both
+roles), the closed-loop fairness pin (ISSUE 14 acceptance — both
+directions), and the autoscaler e2e (scale up via placement, down via
+drain, zero lost/mis-routed rids, decisions in flight records +
+metrics)."""
+
+import jax
+import pytest
+
+from bobrapet_tpu.api.shared import TPUPolicy
+from bobrapet_tpu.models import llama
+from bobrapet_tpu.observability.metrics import metrics
+from bobrapet_tpu.observability.timeline import (
+    FLIGHT,
+    SLO_THRESHOLDS,
+    set_slo_thresholds,
+)
+from bobrapet_tpu.parallel.placement import SlicePlacer, SlicePool
+from bobrapet_tpu.serving import (
+    PagedConfig,
+    ServingEngine,
+    ServingRouter,
+    SharedPrefixRegistry,
+)
+from bobrapet_tpu.traffic import (
+    Autoscaler,
+    AutoscalePolicy,
+    ClosedLoopLoadGen,
+    EngineReplicaSet,
+    TenantProfile,
+    traffic_debug_payload,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _pcfg(**over):
+    kw = dict(max_slots=4, block_size=16, num_blocks=128,
+              max_blocks_per_seq=8)
+    kw.update(over)
+    return PagedConfig(**kw)
+
+
+def _engine(model, role="unified", reg=None, **pc_over):
+    cfg, params = model
+    return ServingEngine(params, cfg, _pcfg(**pc_over),
+                         prefix_shared=reg if reg is not None else False,
+                         role=role)
+
+
+def _prompt(seed, n=12, vocab=256):
+    import random
+
+    rng = random.Random(seed)
+    return [rng.randrange(vocab) for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# satellite: the explicit drain contract
+# ---------------------------------------------------------------------------
+
+
+class TestEngineDrain:
+    def test_decode_role(self, model):
+        eng = _engine(model, role="unified")
+        eng.submit(_prompt(1), max_new_tokens=4)
+        eng.submit(_prompt(2), max_new_tokens=4)
+        eng.drain()
+        assert eng.in_flight == 2 and not eng.drained
+        with pytest.raises(ValueError, match="draining"):
+            eng.submit(_prompt(3), max_new_tokens=4)
+        fin = eng.run()
+        assert len(fin) == 2
+        assert eng.in_flight == 0 and eng.drained
+        eng.undrain()
+        assert eng.submit(_prompt(3), max_new_tokens=4) >= 0
+
+    def test_prefill_role(self, model):
+        reg = SharedPrefixRegistry(max_entries=256)
+        eng = _engine(model, role="prefill", reg=reg)
+        eng.submit(_prompt(4, n=20), max_new_tokens=8)
+        eng.drain()
+        assert eng.in_flight == 1
+        fin = eng.run()
+        # prefill retires at first token — still counts as retired work
+        assert len(fin) == 1 and fin[0].prefilled
+        assert eng.drained
+
+    def test_drain_is_idempotent(self, model):
+        eng = _engine(model)
+        eng.drain()
+        eng.drain()
+        assert eng.drained  # empty + draining
+
+
+class TestRouterDrain:
+    def test_drain_stops_routing_and_remove_after_empty(self, model):
+        e0, e1 = _engine(model), _engine(model)
+        router = ServingRouter({"d0": e0, "d1": e1})
+        for i in range(4):
+            # 64-token budgets: several horizons of work, so the drain
+            # observably overlaps live decoding
+            router.submit(_prompt(10 + i), max_new_tokens=64)
+        router.step()  # admissions land on both (least-loaded)
+        assert e1.in_flight > 0
+        status = router.drain("d1")
+        assert status.draining and status.in_flight >= 1 and not status.empty
+        # remove while live work exists must refuse
+        with pytest.raises(ValueError, match="in flight"):
+            router.remove_engine("d1")
+        for i in range(4):
+            router.submit(_prompt(20 + i), max_new_tokens=8)
+        router.run()
+        assert len(router.finished) == 8
+        # every new admission avoided the draining engine
+        assert e1.in_flight == 0
+        assert router.drain_status("d1").empty
+        removed = router.remove_engine("d1")
+        assert removed is e1
+        assert "d1" not in router.engines
+        # the survivor keeps serving
+        router.submit(_prompt(30), max_new_tokens=4)
+        router.run()
+        assert router.drain_status("d1") is None
+
+    def test_undrain_restores_routing(self, model):
+        e0, e1 = _engine(model), _engine(model)
+        router = ServingRouter({"d0": e0, "d1": e1})
+        router.drain("d1")
+        router.undrain("d1")
+        for i in range(6):
+            router.submit(_prompt(40 + i), max_new_tokens=64)
+        router.step()
+        assert e1.in_flight > 0  # least-loaded uses it again
+        router.run()
+
+    def test_all_draining_queues_hold(self, model):
+        e0 = _engine(model)
+        router = ServingRouter({"d0": e0})
+        router.drain("d0")
+        rid = router.submit(_prompt(50), max_new_tokens=4)
+        for _ in range(5):
+            router.step()
+        # nothing admitted anywhere, nothing lost
+        assert router.queue_depths()["decode"] == 1
+        router.undrain("d0")
+        router.run()
+        assert any(r.rid == rid for r in router.finished)
+
+    def test_add_engine_scales_service(self, model):
+        e0 = _engine(model)
+        router = ServingRouter({"d0": e0})
+        e1 = _engine(model)
+        router.add_engine("d1", e1)
+        with pytest.raises(ValueError, match="already registered"):
+            router.add_engine("d1", e1)
+        for i in range(6):
+            router.submit(_prompt(60 + i), max_new_tokens=64)
+        router.step()
+        assert e1.in_flight > 0
+        router.run()
+        assert len(router.finished) == 6
+
+    def test_live_role_demotion_via_drain(self, model):
+        """router.set_role: the flip waits for the engine to empty
+        under its OLD role — in-flight work is never truncated."""
+        reg = SharedPrefixRegistry(max_entries=256)
+        # one slot: direct submissions below stay observably in flight
+        pf = _engine(model, role="prefill", reg=reg, max_slots=1)
+        dec = _engine(model, role="decode", reg=reg)
+        router = ServingRouter({"pf": pf, "dec": dec},
+                               registry=reg, prefill_threshold=16)
+        for i in range(3):  # direct prefill-pool traffic keeps pf busy
+            pf.submit(_prompt(70 + i, n=24), max_new_tokens=6)
+        router.set_role("pf", "decode")
+        assert pf.role == "prefill"  # still busy: flip deferred
+        assert router.drain_status("pf").in_flight == 3
+        # routed work during the demotion must avoid pf entirely
+        routed = [router.submit(_prompt(75 + i, n=24), max_new_tokens=6)
+                  for i in range(2)]
+        router.run()
+        assert pf.role == "decode"  # applied once empty
+        assert router.drain_status("pf").draining is False
+        # pf's direct work retired under the OLD role (prefilled flag),
+        # the routed requests completed with full budgets elsewhere
+        assert all(r.prefilled for r in pf.finished[:3])
+        done = {r.rid: r for r in router.finished}
+        assert sorted(done) == sorted(routed)
+        assert all(len(done[r].output) == 6 for r in routed)
+
+
+class TestEvictEngine:
+    def test_mid_decode_eviction_is_byte_identical(self, model):
+        """Preempting a replica mid-decode requeues its work; outputs
+        (greedy AND sampled) match an undisturbed run exactly, and
+        every rid retires exactly once."""
+        def build():
+            e0, e1 = _engine(model), _engine(model)
+            return ServingRouter({"d0": e0, "d1": e1})
+
+        def submit_all(router):
+            rids = []
+            for i in range(8):
+                rids.append(router.submit(
+                    _prompt(80 + i, n=10 + i % 3), max_new_tokens=40,
+                    temperature=0.8 if i % 2 else 0.0))
+            return rids
+
+        ref = build()
+        ref_rids = submit_all(ref)
+        ref_out = {r.rid: list(r.output) for r in ref.run()}
+
+        router = build()
+        rids = submit_all(router)
+        assert rids == ref_rids
+        for _ in range(3):
+            router.step()  # some requests mid-decode on both engines
+        victim = router.engines["d1"]
+        assert victim.in_flight > 0  # the eviction interrupts real work
+        requeued = router.evict_engine("d1")
+        assert requeued > 0
+        assert "d1" not in router.engines
+        fin = router.run()
+        assert sorted(r.rid for r in fin) == sorted(ref_rids)  # exactly once
+        assert {r.rid: list(r.output) for r in fin} == ref_out
+
+    def test_evict_unknown_engine(self, model):
+        router = ServingRouter({"d0": _engine(model)})
+        with pytest.raises(ValueError, match="unknown engine"):
+            router.evict_engine("ghost")
+
+
+# ---------------------------------------------------------------------------
+# fairness acceptance: 10x burst cannot starve the victim (both ways)
+# ---------------------------------------------------------------------------
+
+
+VICTIM = "victim"
+AGGRESSOR = "agg"
+#: the pinned bound: with fair admission ON the victim's p95 TTFT under
+#: a 10x flood stays within this factor of its solo baseline; with
+#: fairness OFF the same scenario must exceed it (measured ~2x fair vs
+#: ~15-40x FIFO on this image — the bound sits between with margin)
+BOUND_FACTOR = 6.0
+
+
+def _fairness_run(model, weights, seed=11):
+    eng = _engine(model, max_slots=2)
+    # warm every compiled shape OUTSIDE the measured runs (first-touch
+    # compile landing in one tenant's TTFT would swamp the queueing
+    # signal this test measures)
+    eng.submit(_prompt(998, n=14), max_new_tokens=8)
+    eng.submit(_prompt(999, n=56), max_new_tokens=12)
+    eng.run()
+    if weights is not None:
+        eng.set_tenant_weights(weights)
+    profiles = [
+        TenantProfile(VICTIM, users=1, prompt_len=(12, 16),
+                      new_tokens=(6, 8), max_requests=16),
+        TenantProfile(AGGRESSOR, users=16, prompt_len=(48, 64),
+                      new_tokens=(10, 14), max_requests=96),
+    ]
+    rep = ClosedLoopLoadGen(eng, profiles, seed=seed).run(
+        max_duration_s=60.0)
+    assert rep.lost == 0
+    assert rep.tenant(VICTIM)["completed"] == 16
+    return rep.tenant(VICTIM)["ttft_p95_s"]
+
+
+def _solo_baseline(model, seed=11):
+    eng = _engine(model, max_slots=2)
+    eng.submit(_prompt(997, n=14), max_new_tokens=8)
+    eng.run()
+    rep = ClosedLoopLoadGen(
+        eng,
+        [TenantProfile(VICTIM, users=1, prompt_len=(12, 16),
+                       new_tokens=(6, 8), max_requests=16)],
+        seed=seed,
+    ).run(max_duration_s=30.0)
+    assert rep.tenant(VICTIM)["completed"] == 16
+    return rep.tenant(VICTIM)["ttft_p95_s"]
+
+
+class TestFairnessAcceptance:
+    def test_fair_admission_bounds_victim_ttft_and_fifo_violates(
+        self, model
+    ):
+        solo = _solo_baseline(model)
+        assert solo is not None and solo > 0
+        fair = _fairness_run(model, {VICTIM: 1.0, AGGRESSOR: 1.0})
+        fifo = _fairness_run(model, None)
+        # direction 1: weighted-fair ON -> bounded by construction
+        assert fair <= BOUND_FACTOR * solo, (
+            f"fair p95 {fair * 1000:.1f}ms vs solo {solo * 1000:.1f}ms "
+            f"exceeds {BOUND_FACTOR}x"
+        )
+        # direction 2: FIFO demonstrably violates the same bound (if it
+        # did not, the fairness machinery would be unfalsifiable here)
+        assert fifo > BOUND_FACTOR * solo, (
+            f"fifo p95 {fifo * 1000:.1f}ms vs solo {solo * 1000:.1f}ms "
+            f"unexpectedly within {BOUND_FACTOR}x — the aggressor load "
+            f"no longer stresses the queue"
+        )
+        # and the ordering that makes the story coherent
+        assert fair < fifo
+
+
+# ---------------------------------------------------------------------------
+# autoscaler e2e: up via placement, down via drain, exactly-once rids
+# ---------------------------------------------------------------------------
+
+
+class TestAutoscalerE2E:
+    def test_burst_scales_up_idle_scales_down_zero_lost(self, model):
+        set_slo_thresholds(2.0, 0.000001)  # every tpot breaches: the
+        # burn signal saturates under load, proving the metric plumbing
+        try:
+            self._run(model)
+        finally:
+            set_slo_thresholds(2.0, 0.1)
+            assert SLO_THRESHOLDS["tpot"] == 0.1
+
+    def _run(self, model):
+        placer = SlicePlacer([SlicePool("serve", "4x4", chips_per_host=4)])
+        pool = placer.pool("serve")
+        assert pool is not None
+        e0 = _engine(model)
+        router = ServingRouter({"d0": e0})
+
+        def factory():
+            return _engine(model)
+
+        rs = EngineReplicaSet(
+            "decode", router, factory, placer=placer, queue="serve",
+            tpu=TPUPolicy(topology="2x2"),
+        )
+        scaler = Autoscaler(
+            {"decode": rs},
+            AutoscalePolicy(
+                min_replicas=1, max_replicas=3,
+                scale_up_burn=0.5, scale_down_burn=0.05,
+                queue_depth_per_replica=2,
+                scale_up_cooldown_s=0.0, scale_down_cooldown_s=0.05,
+            ),
+            interval_s=0.0,
+        )
+        free0 = pool.free_chips()
+        assert free0 == 16
+
+        # burst phase: 14 queued requests >> 2/replica threshold
+        submitted = [
+            router.submit(_prompt(200 + i, n=10 + i % 4), max_new_tokens=6)
+            for i in range(14)
+        ]
+        saw_replicas = 1
+        for _ in range(600):
+            router.step()
+            scaler.tick()
+            saw_replicas = max(saw_replicas, rs.actual())
+            if len(router.finished) == len(submitted):
+                break
+        assert len(router.finished) == len(submitted)
+        # exactly-once retirement, no mis-routing
+        assert sorted(r.rid for r in router.finished) == sorted(submitted)
+        assert saw_replicas >= 2, "burst never scaled up"
+        # scale-up went through the placement fast path
+        assert any(g is not None for g in rs.grants.values())
+        assert pool.free_chips() < free0
+
+        # idle phase: calm signals drain the added replicas back down.
+        # The settle/cooldown windows are wall-clock; an idle tick is
+        # microseconds, so pace the loop with a real sleep
+        import time as _t
+
+        for _ in range(400):
+            router.step()
+            scaler.tick()
+            _t.sleep(0.001)
+            if rs.actual() == 1 and rs.draining() == 0:
+                break
+        assert rs.actual() == 1 and rs.draining() == 0
+        assert pool.free_chips() == free0, "scale-down leaked a grant"
+        assert list(router.engines) == ["d0"], "seed replica was retired"
+
+        # decisions visible: metrics...
+        ups = sum(
+            metrics.traffic_autoscale.value("decode", "up", reason)
+            for reason in ("tpot-burn", "queue-depth")
+        )
+        downs = metrics.traffic_autoscale.value("decode", "down", "calm")
+        assert ups >= 1 and downs >= 1
+        assert metrics.traffic_replicas.value("decode", "actual") == 1.0
+        # ...flight records...
+        kinds = [
+            r for r in FLIGHT.timeline("bobrapet-system",
+                                       "traffic-autoscaler")
+            if r.get("kind") == "autoscale"
+        ]
+        assert any(r.get("direction") == "up" for r in kinds)
+        assert any(r.get("outcome") == "down" for r in kinds)
+        # ...and the /debug/traffic payload
+        payload = traffic_debug_payload()
+        ours = [
+            s for s in payload["autoscalers"]
+            if "decode" in s["pools"] and s["pools"]["decode"]["actual"] == 1
+        ]
+        assert ours and any(d["direction"] == "up"
+                            for s in ours for d in s["decisions"])
+
+    def test_scale_up_respects_placement_no_capacity(self, model):
+        """A pool too full to place simply holds — the autoscaler must
+        not crash, leak, or count a phantom replica."""
+        placer = SlicePlacer([SlicePool("tiny", "2x2", chips_per_host=4)])
+        tiny = placer.pool("tiny")
+        blocker = tiny.allocate(want_topology="2x2")  # pool now full
+        router = ServingRouter({"d0": _engine(model)})
+        rs = EngineReplicaSet(
+            "decode", router, lambda: _engine(model), placer=placer,
+            queue="tiny", tpu=TPUPolicy(topology="2x2"),
+        )
+        scaler = Autoscaler(
+            {"decode": rs},
+            AutoscalePolicy(max_replicas=3, queue_depth_per_replica=1,
+                            scale_up_cooldown_s=0.0),
+            interval_s=0.0,
+        )
+        for i in range(6):
+            router.submit(_prompt(300 + i), max_new_tokens=4)
+        scaler.tick()
+        assert rs.actual() == 1 and rs.grants == {}
+        assert len(router.engines) == 1
+        tiny.release(blocker.slice_id)
+        scaler.tick()
+        assert rs.actual() == 2  # capacity freed -> next window scales
+        router.run()
+
+
+class TestTenantWeightsLiveReload:
+    def test_serving_reload_swaps_queues_without_losing_work(self, model):
+        """`serving.tenant-weights` live path: engram.apply_tuning
+        reaches engines AND routers; queued work survives the queue
+        swap in arrival order; clearing the key restores FIFO."""
+        from bobrapet_tpu.config.operator import ServingConfig
+        from bobrapet_tpu.serving import engram as engram_mod
+        from bobrapet_tpu.traffic.fairness import WeightedFairQueue
+
+        eng = _engine(model, max_slots=1)
+        router = ServingRouter({"d0": _engine(model, max_slots=1)})
+        engram_mod._LIVE_ENGINES.add(eng)
+        try:
+            # queue work BEFORE the reload: the swap must not lose it
+            blocker = eng.submit(_prompt(400, n=8), max_new_tokens=48)
+            queued = [eng.submit(_prompt(401 + i), max_new_tokens=4)
+                      for i in range(3)]
+            routed = [router.submit(_prompt(410 + i), max_new_tokens=4,
+                                    tenant="gold")
+                      for i in range(2)]
+            scfg = ServingConfig(tenant_weights="gold:4,free:1")
+            engram_mod.apply_tuning(scfg)
+            assert isinstance(eng.pending, WeightedFairQueue)
+            assert [r.rid for r in eng.pending] == [blocker] + queued
+            assert isinstance(router._queues["decode"], WeightedFairQueue)
+            fin = eng.run()
+            assert sorted(r.rid for r in fin) == sorted([blocker] + queued)
+            router.run()
+            assert sorted(r.rid for r in router.finished) == sorted(routed)
+            # clearing the key restores plain FIFO deques
+            engram_mod.apply_tuning(ServingConfig(tenant_weights=""))
+            from collections import deque as _deque
+
+            assert isinstance(eng.pending, _deque)
+            assert isinstance(router._queues["decode"], _deque)
+        finally:
+            engram_mod._LIVE_ENGINES.discard(eng)
+
+    def test_step_pinned_weights_survive_reload(self, model):
+        from bobrapet_tpu.config.operator import ServingConfig
+        from bobrapet_tpu.serving import engram as engram_mod
+
+        eng = _engine(model)
+        eng.set_tenant_weights({"pinned": 2.0})
+        eng._engram_pinned = frozenset(["tenant_weights"])
+        engram_mod._LIVE_ENGINES.add(eng)
+        try:
+            engram_mod.apply_tuning(ServingConfig(tenant_weights="other:9"))
+            assert eng._tenant_weights == {"pinned": 2.0}
+        finally:
+            engram_mod._LIVE_ENGINES.discard(eng)
