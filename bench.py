@@ -25,6 +25,10 @@ BENCH_NEW_TOKENS, BENCH_REPS, BENCH_FORCE_CPU=1, BENCH_PROBE_TIMEOUT (s),
 BENCH_DEADLINE (s), BENCH_BASELINE (tok/s/chip), BENCH_QUANT=int8,
 BENCH_SKIP_SWEEP=1 (decode only), BENCH_CHILD (internal),
 BENCH_SHARDED_{SHARDS,CAP,SLEEP_S,MEASURE_S} (sharded soak),
+BENCH_PIN_CPUS=0-3 (pinned-environment mode: fix CPU affinity for the
+run and record it on the comparison lines), BENCH_AB_TREE=/path (A/B
+microbench mode: interleave serving legs between this tree and a
+pre-change checkout, emit serving_ab_tree_speedup, skip the sweep),
 BENCH_GATE_TOLERANCE (fraction, default 0.10) and
 BENCH_ALLOW_REGRESSION=1 for the end-of-run regression gate (every
 metric vs its best prior BENCH_r*.json value, same-backend only; an
@@ -61,6 +65,37 @@ def _emit(obj: dict) -> None:
     _EMITTED.append(obj)
     print(json.dumps(obj))
     sys.stdout.flush()
+
+
+#: pinned-environment record (see _maybe_pin_cpus) — folded into the
+#: lines minted by the measurement modes that honor the pin
+_PIN_INFO: dict = {}
+
+
+def _maybe_pin_cpus() -> dict:
+    """Opt-in pinned-environment microbench mode: ``BENCH_PIN_CPUS``
+    (e.g. ``0-3`` or ``0,2,4``) pins this process — and every child it
+    spawns, affinity is inherited — to a fixed CPU set, so an A/B
+    comparison isn't judging scheduler migrations. The pin is recorded
+    in ``_PIN_INFO`` and stamped onto the comparison lines; a pin the
+    OS rejects is recorded as an error rather than silently dropped."""
+    spec = (os.environ.get("BENCH_PIN_CPUS") or "").strip()
+    if not spec or _PIN_INFO:
+        return _PIN_INFO
+    cpus: set[int] = set()
+    try:
+        for part in spec.split(","):
+            part = part.strip()
+            if "-" in part:
+                lo, hi = part.split("-", 1)
+                cpus.update(range(int(lo), int(hi) + 1))
+            elif part:
+                cpus.add(int(part))
+        os.sched_setaffinity(0, cpus)
+        _PIN_INFO["pinned_cpus"] = sorted(cpus)
+    except (ValueError, OSError, AttributeError) as e:
+        _PIN_INFO["pinned_cpus_error"] = f"{spec!r}: {e}"
+    return _PIN_INFO
 
 
 def _fail(msg: str, **extras) -> None:
@@ -512,20 +547,43 @@ def _slo_lines(reqs, config_name: str, new_tokens: int, **key_fields) -> list:
 def _phase_fields(engine) -> dict:
     """Flatten the engine's per-phase wall-clock counters into the
     metric line (`prefill_s`/`decode_device_s`/`host_sync_s`/`draft_s`
-    /`verify_s` + sync/horizon counts) — the ISSUE-7 instrumentation
-    that shows WHERE decode wall-clock goes. Call reset_phase_stats()
-    after warm so compile time never pollutes the breakdown."""
+    /`verify_s`/`host_gap_s`/`host_overlap_s` + sync/horizon counts) —
+    the ISSUE-7 instrumentation that shows WHERE decode wall-clock
+    goes, extended with the pipelining split: host_gap_s is wall the
+    DEVICE sat idle waiting on the host between horizons (the number
+    dispatch-depth > 1 exists to shrink), host_overlap_s is host-side
+    scheduler/commit work hidden behind an in-flight horizon. Call
+    reset_phase_stats() after warm so compile time never pollutes the
+    breakdown."""
     p = engine.phase_seconds
     return {
         "prefill_s": round(p["prefill"], 4),
         "decode_device_s": round(p["decode_device"], 4),
         "host_sync_s": round(p["host_sync"], 4),
+        "host_gap_s": round(p.get("host_gap", 0.0), 4),
+        "host_overlap_s": round(p.get("host_overlap", 0.0), 4),
         "draft_s": round(p["draft"], 4),
         "verify_s": round(p["verify"], 4),
         "host_syncs": engine.phase_counts["host_syncs"],
         "horizons": engine.phase_counts["horizons"],
         "decode_horizon": engine.decode_horizon,
+        "dispatch_depth": getattr(engine, "dispatch_depth", 1),
     }
+
+
+def _host_stall_share(fields: dict) -> float | None:
+    """Share of the decode-side wall the HOST was the pacer:
+    (host_sync + host_gap) over the sum of every decode-side phase.
+    host_overlap counts toward the denominator — it is host work the
+    device is concurrently executing behind, i.e. decode wall where
+    the device is NOT idle (at depth > 1 nearly all device time hides
+    under it, so omitting it would collapse the denominator). At depth
+    1 the gap is the full commit+schedule round-trip between horizons;
+    a working pipeline collapses it toward zero."""
+    stall = fields["host_sync_s"] + fields["host_gap_s"]
+    total = (stall + fields["decode_device_s"] + fields["draft_s"]
+             + fields["verify_s"] + fields["host_overlap_s"])
+    return round(stall / total, 4) if total > 0 else None
 
 
 def config6_serving() -> dict:
@@ -535,7 +593,26 @@ def config6_serving() -> dict:
     (a shape-identical different-bytes pass compiles every graph the
     drain touches first — the seed measurement was ~90% jit compile
     time, which buried the engine's actual speed). CPU tiny-model
-    numbers gauge engine overhead, not chip speed."""
+    numbers gauge engine overhead, not chip speed.
+
+    Runs as an INTERLEAVED depth A/B: the pipelined engine
+    (dispatch-depth 2, the default) against the single-buffered
+    depth-1 reference, alternating best-of-2 drains so box-load drift
+    taxes both legs evenly. Three lines: depth-2 tok/s (headline of
+    this config), depth-1 tok/s (its own gate lineage — dispatch_depth
+    is in the gate key), and the speedup ratio with the host-stall
+    share of both legs.
+
+    The workload STAGGERS per-request budgets (32..64 tokens) so
+    retirement/admission rolls through the drain instead of arriving
+    in synchronized waves — the continuous-admission steady state the
+    pipeline targets, where depth 2 keeps the device queue fed across
+    lane turnover. The pipeline's gated claim is the host-stall share
+    COLLAPSING (device never idles waiting on the host), not the raw
+    tok/s ratio: on a single-core host the scheduler work depth 2
+    hides still contends for the same core the XLA threads run on, so
+    wall-clock speedup is bounded by the dispatch/wakeup bubbles it
+    removes (measure on a multi-core host for the real overlap win)."""
     import numpy as np
 
     from bobrapet_tpu.models import llama
@@ -543,34 +620,83 @@ def config6_serving() -> dict:
 
     cfg = llama.llama_tiny()
     params = llama.init_params(__import__("jax").random.PRNGKey(0), cfg)
-    eng = ServingEngine(params, cfg, PagedConfig(
-        max_slots=4, block_size=16, num_blocks=128, max_blocks_per_seq=8))
+
+    def build(depth):
+        return ServingEngine(params, cfg, PagedConfig(
+            max_slots=8, block_size=16, num_blocks=256,
+            max_blocks_per_seq=8), dispatch_depth=depth)
+
+    eng = build(2)
+    ref = build(1)
     rng = np.random.default_rng(0)
-    # 48-token budgets: the seed's 16-token drain finished in <100ms on
-    # the horizon engine — pure scheduler-noise territory for the
-    # regression gate. new_tokens is recorded on the line, so this is
-    # a FRESH gate lineage (the old shapeless prior keys as None).
-    n_requests, new_tokens = 12, 48
+    # 16 requests over 8 slots with staggered 32..64-token budgets:
+    # two rolling admission generations, no synchronized retirement
+    # wave. new_tokens/budget fields are recorded on the line, so this
+    # is a FRESH gate lineage (the old shapeless prior keys as None).
+    n_requests = 16
+    budgets = [32 + (i * 13) % 33 for i in range(n_requests)]
+    total_tokens = sum(budgets)
+    new_tokens = total_tokens // n_requests  # mean, for the line key
     prompts = [rng.integers(0, cfg.vocab_size, 8 + (i % 5) * 7).tolist()
                for i in range(n_requests)]
 
-    def one_drain(seed=None):
+    def one_drain(engine, seed=None):
         r2 = np.random.default_rng(seed) if seed is not None else None
-        for pr in prompts:
+        for pr, budget in zip(prompts, budgets):
             toks = (r2.integers(0, cfg.vocab_size, len(pr)).tolist()
                     if r2 is not None else list(pr))
-            eng.submit(toks, max_new_tokens=new_tokens)
+            engine.submit(toks, max_new_tokens=budget)
         t0 = time.perf_counter()
-        eng.run()
-        return (n_requests * new_tokens) / (time.perf_counter() - t0)
+        engine.run()
+        return total_tokens / (time.perf_counter() - t0)
 
-    one_drain(seed=99)  # compile every graph the drain touches
+    one_drain(eng, seed=99)  # compile every graph the drain touches
+    one_drain(ref, seed=99)
     eng.reset_phase_stats()
+    ref.reset_phase_stats()
     measured_from = len(eng.finished)  # warm drain's TTFT is compile-polluted
-    best = max(one_drain(), one_drain(seed=98))
+    rates = {id(eng): [], id(ref): []}
+    for leg_seed, target in ((None, eng), (None, ref),
+                             (98, eng), (98, ref)):
+        rates[id(target)].append(one_drain(target, seed=leg_seed))
+    best = max(rates[id(eng)])
+    ref_best = max(rates[id(ref)])
     for line in _slo_lines(eng.finished[measured_from:], "serving",
                            new_tokens, requests=n_requests):
         _emit(line)
+    pipe_fields = _phase_fields(eng)
+    ref_fields = _phase_fields(ref)
+    _emit({
+        "metric": "serving_decode_tokens_per_sec",
+        "value": round(ref_best, 1),
+        "unit": "tok/s",
+        "vs_baseline": 1.0,
+        "config": "serving",
+        "requests": n_requests,
+        "new_tokens": new_tokens,
+        "slots": 8,
+        "tokens": total_tokens,
+        "host_stall_share": _host_stall_share(ref_fields),
+        **ref_fields,
+    })
+    share1 = _host_stall_share(ref_fields)
+    share2 = _host_stall_share(pipe_fields)
+    _emit({
+        "metric": "serving_pipeline_speedup_vs_depth1",
+        "value": round(best / ref_best, 3) if ref_best else 0.0,
+        "unit": "x",
+        "vs_baseline": 1.0,
+        "config": "serving",
+        "new_tokens": new_tokens,
+        "depth1_tok_s": round(ref_best, 1),
+        "depth2_tok_s": round(best, 1),
+        "host_stall_share_depth1": share1,
+        "host_stall_share_depth2": share2,
+        # the pipeline's gated invariant: stall share collapses ≥2x
+        "host_stall_reduction": (round(share1 / share2, 2)
+                                 if share1 and share2 else None),
+        **_PIN_INFO,
+    })
     return {
         "metric": "serving_decode_tokens_per_sec",
         "value": round(best, 1),
@@ -579,9 +705,10 @@ def config6_serving() -> dict:
         "config": "serving",
         "requests": n_requests,
         "new_tokens": new_tokens,
-        "slots": 4,
-        "tokens": n_requests * new_tokens,
-        **_phase_fields(eng),
+        "slots": 8,
+        "tokens": total_tokens,
+        "host_stall_share": _host_stall_share(pipe_fields),
+        **pipe_fields,
     }
 
 
@@ -2153,6 +2280,66 @@ def run_serving_child() -> None:
     })
 
 
+def _run_ab_tree() -> None:
+    """Pinned-environment A/B microbench: interleave serving-child
+    legs between THIS tree and a pre-change tree (``BENCH_AB_TREE=
+    /path/to/old/checkout``), alternating so box-load drift taxes both
+    sides evenly — the honest way to claim a host-path change moved
+    the serving number, instead of comparing against a prior run on a
+    different box hour. Legs run on cpu (deterministic backend) with
+    the affinity pin (``BENCH_PIN_CPUS``) inherited; the comparison
+    line records the tree and the pin so the gate entry carries the
+    measurement conditions."""
+    tree = os.path.abspath(os.environ["BENCH_AB_TREE"])
+    here = os.path.dirname(os.path.abspath(__file__))
+    rates: dict[str, list[float]] = {"current": [], "prechange": []}
+    budget = max(120.0, (_remaining() - 60.0) / 4)
+    for tag, root in (("prechange", tree), ("current", here),
+                      ("prechange", tree), ("current", here)):
+        env = dict(os.environ)
+        env.pop("BENCH_AB_TREE", None)
+        env["BENCH_CHILD"] = "serving"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_CHILD_CPU"] = "1"
+        env["PYTHONPATH"] = root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        bench_py = os.path.join(root, "bench.py")
+        if not os.path.exists(bench_py):
+            bench_py = os.path.abspath(__file__)
+        try:
+            proc = subprocess.run(
+                [sys.executable, bench_py], capture_output=True,
+                text=True, timeout=budget, env=env)
+        except subprocess.TimeoutExpired:
+            continue
+        for ln in (proc.stdout or "").strip().splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                d = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if (d.get("metric") == "serving_decode_tokens_per_sec"
+                    and isinstance(d.get("value"), (int, float))
+                    and not d.get("error")):
+                rates[tag].append(float(d["value"]))
+    a = max(rates["current"], default=0.0)
+    b = max(rates["prechange"], default=0.0)
+    _emit({
+        "metric": "serving_ab_tree_speedup",
+        "value": round(a / b, 3) if b else 0.0,
+        "unit": "x",
+        "vs_baseline": 1.0,
+        "config": "serving-ab",
+        "current_tok_s": round(a, 1),
+        "prechange_tok_s": round(b, 1),
+        "ab_tree": tree,
+        "legs": {k: [round(v, 1) for v in vs] for k, vs in rates.items()},
+        **_PIN_INFO,
+    })
+
+
 def _spawn_decode(cpu: bool, model: str | None, quant: str | None,
                   timeout: float, extra: dict | None = None,
                   child: str = "decode") -> dict | None:
@@ -2280,7 +2467,12 @@ def _gate_key(d: dict) -> tuple:
             # multi-slice lineage: the two-level mesh shape is part of
             # the identity (a dcn2 leg vs a future dcn4 prior would be
             # a shape change, not a regression)
-            d.get("mesh"))
+            d.get("mesh"),
+            # pipelined-dispatch lineage: depth-1 reference and depth-2
+            # pipelined legs are different machines; shapeless priors
+            # from before the knob existed key as None and never judge
+            # either leg
+            d.get("dispatch_depth"))
 
 
 def _best_prior() -> dict:
@@ -2352,6 +2544,14 @@ def _regression_gate() -> list[dict]:
 
 
 def main() -> None:
+    _maybe_pin_cpus()
+    if os.environ.get("BENCH_AB_TREE") and not os.environ.get("BENCH_CHILD"):
+        # pinned-environment A/B microbench mode: interleaved serving
+        # legs against the pre-change tree, nothing else — the mode
+        # exists to answer ONE question (did this change move the
+        # serving number on this box, under this pin) quickly
+        _run_ab_tree()
+        return
     if os.environ.get("BENCH_CHILD") == "decode":
         run_decode_child()
         return

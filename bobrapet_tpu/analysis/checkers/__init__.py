@@ -5,6 +5,7 @@ from .cow_discipline import CowDisciplineChecker
 from .enum_literal_drift import EnumLiteralDriftChecker
 from .lock_blocking_io import LockBlockingIOChecker
 from .metrics_drift import MetricsDriftChecker
+from .serving_sync_points import ServingSyncPointsChecker
 
 ALL_CHECKERS = (
     LockBlockingIOChecker(),
@@ -12,6 +13,7 @@ ALL_CHECKERS = (
     ConfigKeyDriftChecker(),
     MetricsDriftChecker(),
     EnumLiteralDriftChecker(),
+    ServingSyncPointsChecker(),
 )
 
 __all__ = ["ALL_CHECKERS"]

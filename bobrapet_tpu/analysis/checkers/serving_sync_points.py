@@ -1,0 +1,155 @@
+"""serving-sync-points: no unannotated host syncs in serving hot paths.
+
+The pipelined serving engine (PR 16) keeps the device fed by
+dispatching horizons ahead of the host; its entire win evaporates if
+any code on the dispatch/commit path forces an early device
+round-trip. The three spellings that do:
+
+- ``jax.device_get(...)`` — blocks until the value is resident on
+  host;
+- ``.block_until_ready()`` / ``jax.block_until_ready(...)`` — blocks
+  until the computation completes;
+- ``np.asarray(x)`` (any numpy alias) — silently performs a
+  device->host transfer when ``x`` is a jax array, indistinguishable
+  at the call site from a free host-side view.
+
+Inside ``bobrapet_tpu/serving/`` every such call must either carry a
+trailing ``# sync-point: <why>`` annotation on the call line (the
+reviewed allowlist — the justification is part of the source, next to
+the sync it excuses) or be suppressed in ``bobralint-baseline.json``
+(the per-horizon commit syncs, which are the engine's ONE intended
+round-trip per horizon). An annotation with an empty justification is
+still flagged: "# sync-point:" with no reason is a TODO, not a
+review.
+
+``jnp.asarray`` is deliberately NOT matched — it produces a device
+array (an upload, not a sync) and is the engine's standard patch
+idiom. The checker is lexical about numpy aliases (``np``, ``_np``,
+``numpy``): serving code imports numpy under those names only.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Sequence
+
+from ..core import AnalysisContext, Finding, ProjectFile, attr_chain
+
+#: rel-path prefixes the invariant binds to: the serving package, plus
+#: the pseudo-path test_analysis.py feeds corpus fixtures under
+_DOMAIN_PREFIXES = (
+    "bobrapet_tpu/serving/",
+    "bobrapet_tpu/_corpus/serving_sync_points",
+)
+
+#: numpy module aliases (lowercased, underscores stripped)
+_NUMPY_ALIASES = {"np", "numpy"}
+
+_ANNOTATION = "# sync-point:"
+
+
+def _classify(call: ast.Call) -> Optional[str]:
+    """-> stable kernel for a host-sync call, or None."""
+    chain = attr_chain(call.func)
+    if chain is None:
+        return None
+    last = chain[-1]
+    if last == "device_get":
+        return "host sync jax.device_get"
+    if last == "block_until_ready":
+        return "host sync block_until_ready"
+    if (
+        last == "asarray"
+        and len(chain) >= 2
+        and chain[-2].lower().strip("_") in _NUMPY_ALIASES
+    ):
+        return "device->host copy np.asarray"
+    return None
+
+
+def _annotation_state(source_lines: list[str], lineno: int) -> Optional[bool]:
+    """None = no annotation; True = justified; False = empty reason."""
+    if not 1 <= lineno <= len(source_lines):
+        return None
+    line = source_lines[lineno - 1]
+    idx = line.find(_ANNOTATION)
+    if idx < 0:
+        return None
+    # the reason runs to the next comment marker (tooling tags like
+    # the corpus' "# BAD" may trail the annotation) or end of line
+    reason = line[idx + len(_ANNOTATION):]
+    reason = reason.split("#", 1)[0]
+    return bool(reason.strip())
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, pf: ProjectFile):
+        self.pf = pf
+        self.lines = pf.source.splitlines()
+        self.findings: list[Finding] = []
+        self._scope: list[str] = []
+
+    def _in_scope(self, name: str, node: ast.AST) -> None:
+        self._scope.append(name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._in_scope(node.name, node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._in_scope(node.name, node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._in_scope(node.name, node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        kernel = _classify(node)
+        if kernel is not None:
+            ann = _annotation_state(self.lines, node.lineno)
+            if ann is True:
+                pass  # reviewed allowlist entry
+            elif ann is False:
+                self._flag(node, f"{kernel} (empty sync-point reason)",
+                           "empty '# sync-point:' annotation — state WHY "
+                           "this sync is acceptable on the hot path")
+            else:
+                self._flag(node, kernel,
+                           "forces a device round-trip on a serving hot "
+                           "path — move it to the commit boundary, or "
+                           "annotate the line with '# sync-point: <why>' "
+                           "if the sync is intended")
+        self.generic_visit(node)
+
+    def _flag(self, node: ast.Call, kernel: str, advice: str) -> None:
+        self.findings.append(
+            Finding(
+                checker="serving-sync-points",
+                path=self.pf.rel,
+                line=node.lineno,
+                col=node.col_offset,
+                scope=".".join(self._scope),
+                message=f"{kernel}: {advice}",
+                kernel=kernel,
+            )
+        )
+
+
+class ServingSyncPointsChecker:
+    name = "serving-sync-points"
+    description = (
+        "unannotated host sync (device_get/block_until_ready/np.asarray) "
+        "in the serving package"
+    )
+
+    def run(
+        self, files: Sequence[ProjectFile], ctx: AnalysisContext
+    ) -> Iterable[Finding]:
+        out: list[Finding] = []
+        for pf in files:
+            if not pf.rel.startswith(_DOMAIN_PREFIXES):
+                continue
+            v = _Visitor(pf)
+            v.visit(pf.tree)
+            out.extend(v.findings)
+        return out

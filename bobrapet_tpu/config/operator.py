@@ -174,6 +174,12 @@ class ServingConfig:
     #: horizon; 1 = the classic single-step reference engine)
     #: (dotted: serving.decode-horizon)
     decode_horizon: int = 8
+    #: decode horizons kept in flight on the device queue (double
+    #: buffering); the host commits horizon N-1 and runs admission
+    #: while N executes. 1 = the single-buffered reference path (each
+    #: horizon fully committed before the next dispatch)
+    #: (dotted: serving.dispatch-depth)
+    dispatch_depth: int = 2
     #: draft proposals per speculative round on draft-capable engines
     #: (dotted: serving.spec-k)
     spec_k: int = 4
@@ -417,6 +423,8 @@ class OperatorConfig:
             errs.append("fleet.redrive-delay must be >= 0")
         if self.serving.decode_horizon < 1:
             errs.append("serving.decode-horizon must be >= 1")
+        if self.serving.dispatch_depth < 1:
+            errs.append("serving.dispatch-depth must be >= 1")
         if self.serving.spec_k < 1:
             errs.append("serving.spec-k must be >= 1")
         if self.serving.role not in ("unified", "prefill", "decode"):
@@ -519,6 +527,7 @@ def _apply_dotted(cfg: OperatorConfig, key: str, value: str) -> bool:
         "fleet.gke-spot": lambda: fset(cfg.fleet, "gke_spot", as_bool),
         "fleet.termination-grace": lambda: fset(cfg.fleet, "termination_grace_seconds", as_dur),
         "serving.decode-horizon": lambda: fset(cfg.serving, "decode_horizon", int),
+        "serving.dispatch-depth": lambda: fset(cfg.serving, "dispatch_depth", int),
         "serving.spec-k": lambda: fset(cfg.serving, "spec_k", int),
         "serving.prefix-cache-shared": lambda: fset(cfg.serving, "prefix_cache_shared", as_bool),
         "serving.role": lambda: fset(cfg.serving, "role", str),

@@ -577,6 +577,28 @@ class _ControlPlaneMetrics:
             buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
                      0.5, 1.0, 5.0),
         )
+        self.serving_host_gap = h(
+            "bobrapet_serving_host_gap_seconds",
+            "Device-idle gap between consecutive decode-horizon "
+            "dispatches: wall time from the moment no horizon was in "
+            "flight (results committed) to the next horizon enqueue. "
+            "At dispatch-depth 1 this is the full host round-trip the "
+            "pipeline exists to hide; at depth >= 2 it should collapse "
+            "toward zero", [],
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                     0.5, 1.0, 5.0),
+        )
+        self.serving_dispatch_depth = g(
+            "bobrapet_serving_dispatch_depth",
+            "Configured decode-dispatch pipeline depth (horizons the "
+            "engine keeps in flight; 1 = single-buffered reference "
+            "path)", []
+        )
+        self.serving_inflight = g(
+            "bobrapet_serving_inflight_horizons",
+            "Decode horizons currently enqueued on the device and not "
+            "yet committed by the host", []
+        )
         self.serving_spec_rounds = c(
             "bobrapet_serving_spec_rounds_total",
             "Fused draft+verify+accept rounds dispatched inside "

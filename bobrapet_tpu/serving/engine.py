@@ -187,10 +187,13 @@ class ServingEngine:
                  spec_guard_margin: float = 0.05,
                  pipeline_decode: bool = True,
                  decode_horizon: int = 8,
+                 dispatch_depth: int = 2,
                  prefix_shared: Any = False,
                  role: str = "unified"):
         if decode_horizon < 1:
             raise ValueError("decode_horizon must be >= 1")
+        if dispatch_depth < 1:
+            raise ValueError("dispatch_depth must be >= 1")
         if role not in self.ROLES:
             raise ValueError(
                 f"role must be one of {sorted(self.ROLES)}, got {role!r}"
@@ -268,6 +271,38 @@ class ServingEngine:
         #: fused multi-step decode (device-resident horizon); 1 = the
         #: retained classic single-step engine (the parity reference)
         self.decode_horizon = decode_horizon
+        #: decode horizons kept in flight on the device queue
+        #: (serving.dispatch-depth): while horizon N executes, the host
+        #: commits N-1's results, runs admission/scheduling, and
+        #: enqueues N+1 — jax's async dispatch keeps the device busy
+        #: through the host round-trip. 1 = the single-buffered
+        #: reference path (dispatch -> commit, nothing overlapped).
+        self.dispatch_depth = int(dispatch_depth)
+        # gauge reflects the configured depth from construction — a
+        # depth-1 engine never reaches the pipelined dispatch sites
+        # that would otherwise first set it
+        metrics.serving_dispatch_depth.set(float(self.dispatch_depth))
+        #: FIFO of dispatched-but-uncommitted horizon records: the
+        #: device output arrays plus the host bookkeeping needed to
+        #: commit them later. Commit order IS dispatch order.
+        self._inflight: deque = deque()
+        #: per-lane patch generation: a pipelined commit folds a
+        #: record's device lane values into the mirror only when the
+        #: lane was NOT re-patched after that record was dispatched
+        #: (a readmitted lane's mirror must not be clobbered by a stale
+        #: horizon's fixed-point outputs)
+        self._patch_epoch = [0] * self.pcfg.max_slots
+        #: perf_counter stamp of the moment the decode pipeline went
+        #: empty (results committed, nothing in flight); the next
+        #: horizon dispatch observes the difference as the device-idle
+        #: host gap (bobrapet_serving_host_gap_seconds)
+        self._dev_idle_at: Optional[float] = None
+        #: wall stamp of the previous pipelined spec commit (watchdog
+        #: windows account commit-to-commit; see _watch_spec_commit)
+        self._watch_commit_t: Optional[float] = None
+        #: one-shot KV view-chain sharding audit latch (see
+        #: _maybe_check_view_chain / serving/sharding_check.py)
+        self._view_chain_checked = False
         if role == "prefill" and not self.pcfg.prefix_caching:
             raise ValueError(
                 "prefill role requires prefix_caching=True — the KV "
@@ -323,7 +358,8 @@ class ServingEngine:
         #: per-phase wall-clock breakdown of where engine time goes
         #: (bench surfaces these; reset_phase_stats() zeroes after warm)
         self.phase_seconds = {"prefill": 0.0, "decode_device": 0.0,
-                              "host_sync": 0.0, "draft": 0.0, "verify": 0.0}
+                              "host_sync": 0.0, "draft": 0.0, "verify": 0.0,
+                              "host_gap": 0.0, "host_overlap": 0.0}
         self.phase_counts = {"host_syncs": 0, "horizons": 0,
                              "device_steps": 0, "spec_rounds": 0}
         self._decode_fn = jax.jit(
@@ -506,9 +542,11 @@ class ServingEngine:
         while (self.pending or any(self.slots)) and steps < max_steps:
             self.step()
             steps += 1
-        # a pipelined tick may still be in flight at loop exit
+        # a pipelined tick / in-flight horizons may still be pending at
+        # loop exit
         self._commit_tick(self._pending_tick)
         self._pending_tick = None
+        self._drain_inflight()
         return self.finished
 
     @property
@@ -554,6 +592,18 @@ class ServingEngine:
             fresh = deque()
         fresh.extend(self.pending)
         self.pending = fresh
+
+    def set_dispatch_depth(self, depth: int) -> None:
+        """Live-reloadable (`serving.dispatch-depth`): shrinking takes
+        effect at the next step (which commits the pipeline down to the
+        new depth), growing fills on the next dispatch. Safe
+        mid-stream — commits are strictly FIFO and every in-flight
+        record carries its own bookkeeping, so token streams are
+        byte-identical at every depth."""
+        if depth < 1:
+            raise ValueError("dispatch_depth must be >= 1")
+        self.dispatch_depth = int(depth)
+        metrics.serving_dispatch_depth.set(float(self.dispatch_depth))
 
     def set_decode_horizon(self, horizon: int) -> None:
         """Live-reloadable (`serving.decode-horizon`): takes effect at
@@ -630,6 +680,7 @@ class ServingEngine:
         self.spec_guard_decision = None
         self._guard_samples = {"spec": [], "plain": []}
         self._spec_watch = [0, 0.0]
+        self._watch_commit_t = None
         self.spec_active = True
         if self.blocks._shared is not None:
             self._sharing_scope_cache = None
@@ -662,6 +713,9 @@ class ServingEngine:
             self.phase_seconds[k] = 0.0
         for k in self.phase_counts:
             self.phase_counts[k] = 0
+        # a stale idle stamp would book the whole warm->timed window
+        # into the first timed dispatch's host_gap
+        self._dev_idle_at = None
 
     def _sharing_scope(self) -> str:
         """Content fingerprint isolating shared-prefix namespaces:
@@ -692,10 +746,10 @@ class ServingEngine:
                     # fingerprinted identically and cross-hit)
                     flat = jnp.ravel(leaf)
                     stride = max(1, flat.shape[0] // 16)
-                    sample = _np.asarray(jax.device_get(
+                    sample = _np.asarray(jax.device_get(  # sync-point: once-per-engine fingerprint, not per-horizon
                         flat[::stride][:16].astype(jnp.float32)))
                     h.update(sample.tobytes())
-                    total = _np.asarray(jax.device_get(
+                    total = _np.asarray(jax.device_get(  # sync-point: once-per-engine fingerprint, not per-horizon
                         jnp.sum(flat.astype(jnp.float32))))
                     h.update(total.tobytes())
 
@@ -784,6 +838,12 @@ class ServingEngine:
         run the classic settled sequence (admit -> ingest one chunk
         per prefilling slot -> retire-finished -> grow/preempt ->
         fused decode -> retire). Returns rids that finished."""
+        if self._pipeline_ready():
+            return self._pipelined_step()
+        # mode transition (live depth/horizon reload, spec guard
+        # re-arm): commit whatever the pipelined path left in flight so
+        # mirror and host state are exact before diff-based syncing
+        pre = self._drain_inflight() if self._inflight else []
         if (
             # the device-resident horizon subsumes single-step
             # pipelining: with decode_horizon > 1 every steady tick goes
@@ -802,10 +862,10 @@ class ServingEngine:
             prev = self._pending_tick
             self._pending_tick = None
             new_tick = self._dispatch_plain(prev)
-            done = self._commit_tick(prev)
+            done = pre + self._commit_tick(prev)
             self._pending_tick = new_tick
             return done
-        done = self._commit_tick(self._pending_tick)
+        done = pre + self._commit_tick(self._pending_tick)
         self._pending_tick = None
         done.extend(self._settled_step())
         return done
@@ -1485,7 +1545,9 @@ class ServingEngine:
                 donate_argnums=(1,),
             )
             self._hz_fns[H_eff] = fn
+        self._maybe_check_view_chain(spec=False)
         d = self._dev
+        self._note_dispatch_gap()
         t0 = _time.perf_counter()
         pools, (last, seq, act, emitted), toks = fn(
             self.params, self.pools, d["last"], d["seq"], d["act"],
@@ -1534,6 +1596,7 @@ class ServingEngine:
                 done.append(req.rid)
                 self._retire(i)
         self._mirror_from_device(last_h, seq_h, act_h, em_h)
+        self._stamp_dev_idle()
         return done
 
     def _hz_draft_sync_fn(self, H_eff: int):
@@ -1593,7 +1656,9 @@ class ServingEngine:
                 return None
             cov[i] = spec_capable
         self._sync_device_state()
+        self._maybe_check_view_chain(spec=True)
         d = self._dev
+        self._note_dispatch_gap()
         vk, vv = gather_fn(self.pools, d["tables"])
         dvk, dvv = gather_fn(self.dpools, d["tables"])
         cov_dev = jnp.asarray(cov, jnp.bool_)
@@ -1672,6 +1737,7 @@ class ServingEngine:
             metrics.serving_spec_tokens.inc("proposed", by=drafted)
             metrics.serving_spec_tokens.inc("accepted", by=accepted)
         self._mirror_from_device(last_h, seq_h, act_h, em_h)
+        self._stamp_dev_idle()
         return done
 
     def _spec_horizon_fns(self):
@@ -1701,6 +1767,534 @@ class ServingEngine:
             )
             self._hz_scatter_fns[width] = fn
         return fn
+
+    # -- pipelined dispatch (serving.dispatch-depth > 1) -------------------
+
+    def _pipeline_ready(self) -> bool:
+        """True when this tick may run the depth-pipelined horizon
+        loop: multi-step horizons, depth > 1, and — on draft-capable
+        engines — a settled payoff-guard verdict (the guard's A/B
+        samples time dispatch+commit as one unit, which pipelining
+        would smear into the neighboring horizons)."""
+        if self.decode_horizon <= 1 or self.dispatch_depth <= 1:
+            return False
+        if (self.draft_params is not None and self.spec_active
+                and self.spec_guard and self.spec_guard_decision is None):
+            return False
+        return True
+
+    def _pipelined_step(self) -> list[int]:
+        """One tick of the depth-N dispatch pipeline: commit the
+        oldest horizon(s) down to depth-1 in flight, run the host
+        scheduler work (admission / chunked ingest / retirement) while
+        the remaining horizons execute on device, then top the
+        pipeline back up. Newly admitted or retired lanes fold into
+        the NEXT enqueued horizon via _patch_pipeline_lanes — no drain.
+        The pipeline only drains when block coverage cannot be funded
+        without preemption: eviction decisions stay exclusive to the
+        settled classic tick, which needs exact host state."""
+        import time as _time
+
+        done: list[int] = []
+        while len(self._inflight) >= self.dispatch_depth:
+            done.extend(self._commit_horizon(self._inflight.popleft()))
+        # everything below overlaps the horizons still in flight
+        overlap = bool(self._inflight)
+        t_host = _time.perf_counter()
+        self._admit()
+        for i, slot in enumerate(self.slots):
+            if slot is not None and slot.ingest_pos is not None:
+                self._ingest_chunk(i)
+        # a request can finish ON its prefill token (max_new_tokens=1,
+        # eos as the first sample, or the prefill role)
+        for i, slot in enumerate(self.slots):
+            if slot is not None and slot.request.done:
+                done.append(slot.request.rid)
+                self._retire(i)
+        dispatched = False
+        unfundable = False
+        while len(self._inflight) < self.dispatch_depth:
+            rec = self._dispatch_horizon()
+            if rec is None:
+                break
+            if rec is _UNFUNDABLE:
+                unfundable = True
+                break
+            self._inflight.append(rec)
+            dispatched = True
+        if overlap:
+            self.phase_seconds["host_overlap"] += (
+                _time.perf_counter() - t_host)
+        metrics.serving_inflight.set(float(len(self._inflight)))
+        if unfundable:
+            # coverage needs preemption: drain so the classic tick's
+            # eviction logic sees exact host/device-committed state
+            done.extend(self._drain_inflight())
+            if any(s is not None and s.ingest_pos is None
+                   for s in self.slots):
+                self._ensure_growth()
+                if any(s is not None and s.ingest_pos is None
+                       for s in self.slots):
+                    done.extend(self._decode_once())
+            return done
+        if not dispatched and self._inflight:
+            # nothing new could enter (every remaining budget token is
+            # already covered in flight) — commit the oldest so the
+            # loop always makes progress toward retirement
+            done.extend(self._commit_horizon(self._inflight.popleft()))
+            metrics.serving_inflight.set(float(len(self._inflight)))
+        return done
+
+    def _drain_inflight(self) -> list[int]:
+        """Commit every in-flight horizon in dispatch order (mode
+        transitions, live knob reloads, unfundable coverage, run()
+        exit). After a drain the mirror equals the host's committed
+        view, so diff-based _sync_device_state is exact again."""
+        done: list[int] = []
+        while self._inflight:
+            done.extend(self._commit_horizon(self._inflight.popleft()))
+        return done
+
+    def _inflight_ahead(self, i: int, rid: int) -> int:
+        """Upper bound on tokens dispatched-but-uncommitted for slot
+        ``i`` as request ``rid`` (records of a replaced rid don't
+        count — their commits will be discarded)."""
+        return sum(rec["ahead"].get(i, 0) for rec in self._inflight
+                   if rec["rids"].get(i) == rid)
+
+    def _dispatch_horizon(self):
+        """Enqueue one horizon WITHOUT waiting on it. Returns the
+        in-flight record, None when there is nothing to dispatch
+        (no decoding lanes, or every remaining token already in
+        flight), or ``_UNFUNDABLE`` when per-slot block coverage needs
+        preemption."""
+        if not self._decoding_slots():
+            return None
+        if self.draft_params is not None and self.spec_active:
+            return self._dispatch_spec_horizon(self._spec_rounds())
+        return self._dispatch_plain_horizon(self.decode_horizon)
+
+    def _dispatch_plain_horizon(self, horizon: int):
+        """The dispatch half of :meth:`_plain_horizon_decode`: fund
+        coverage (committed + in-flight + this horizon), patch changed
+        lanes, enqueue the fused H-step scan, and return the record —
+        no block, no device_get. The commit's block_until_ready owns
+        the real device wall for this record."""
+        acts = self._decoding_slots()
+        H_eff = horizon
+        ahead: dict[int, int] = {}
+        for i, s in acts:
+            req = s.request
+            pend = self._inflight_ahead(i, req.rid)
+            ahead[i] = max(0, min(
+                H_eff, req.max_new_tokens - len(req.output) - pend))
+        if all(a == 0 for a in ahead.values()):
+            return None
+        for i, s in acts:
+            if not self._fund_lookahead(
+                    s, self._inflight_ahead(i, s.request.rid) + ahead[i]):
+                return _UNFUNDABLE
+        self._patch_pipeline_lanes()
+        fn = self._hz_fns.get(H_eff)
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(_horizon_plain, cfg=self.cfg,
+                                  pcfg=self.pcfg, H=H_eff,
+                                  lora_scale=self.lora_scale,
+                                  is_moe=self.is_moe),
+                donate_argnums=(1,),
+            )
+            self._hz_fns[H_eff] = fn
+        self._maybe_check_view_chain(spec=False)
+        d = self._dev
+        self._note_dispatch_gap()
+        pools, (last, seq, act, emitted), toks = fn(
+            self.params, self.pools, d["last"], d["seq"], d["act"],
+            d["emitted"], d["budget"], d["eos"], d["temps"], d["adapters"],
+            d["rids"], d["tables"], self._base_key, self.loras)
+        self.phase_counts["horizons"] += 1
+        self.phase_counts["device_steps"] += H_eff
+        metrics.serving_horizon.set(float(H_eff))
+        metrics.serving_dispatch_depth.set(float(self.dispatch_depth))
+        self.pools = pools
+        self._dev = {**d, "last": last, "seq": seq, "act": act,
+                     "emitted": emitted}
+        self._steps += H_eff
+        return {
+            "kind": "plain",
+            "toks": toks, "last": last, "seq": seq, "act": act,
+            "emitted": emitted,
+            "snapshot": [(i, s.request.rid) for i, s in acts],
+            "rids": {i: s.request.rid for i, s in acts},
+            "ahead": ahead,
+            "epochs": list(self._patch_epoch),
+        }
+
+    def _dispatch_spec_horizon(self, rounds: int):
+        """The dispatch half of :meth:`_spec_horizon_decode`: R chained
+        draft+verify rounds plus the windowed scatter ride the pipeline
+        exactly like a plain horizon — the host enqueues and moves on;
+        accept counts and spec stats are read at commit."""
+        import time as _time
+
+        acts = self._decoding_slots()
+        k, (gather_fn, draft_fn, verify_fn) = self._spec_horizon_fns()
+        rems: dict[int, int] = {}
+        for i, s in acts:
+            req = s.request
+            pend = self._inflight_ahead(i, req.rid)
+            rems[i] = max(0, req.max_new_tokens - len(req.output) - pend)
+        if all(r == 0 for r in rems.values()):
+            return None
+        ahead: dict[int, int] = {}
+        cov = [False] * self.pcfg.max_slots
+        for i, s in acts:
+            spec_capable = (s.request.temperature == 0 and rems[i] >= 2)
+            want = (min(rounds * (k + 1), rems[i]) if spec_capable
+                    else min(rounds, rems[i]))
+            pend = self._inflight_ahead(i, s.request.rid)
+            ok = self._fund_lookahead(s, pend + want)
+            if not ok and spec_capable:
+                # degrade THIS lane to plain commits rather than give
+                # up the horizon (mirrors _spec_horizon_decode)
+                spec_capable = False
+                want = min(rounds, rems[i])
+                ok = self._fund_lookahead(s, pend + want)
+            if not ok:
+                return _UNFUNDABLE
+            cov[i] = spec_capable
+            ahead[i] = want
+        self._patch_pipeline_lanes()
+        self._maybe_check_view_chain(spec=True)
+        d = self._dev
+        self._note_dispatch_gap()
+        vk, vv = gather_fn(self.pools, d["tables"])
+        dvk, dvv = gather_fn(self.dpools, d["tables"])
+        cov_dev = jnp.asarray(cov, jnp.bool_)
+        last, seq, act, emitted = d["last"], d["seq"], d["act"], d["emitted"]
+        outs = []
+        for _r in range(rounds):
+            # phase seconds here attribute ENQUEUE wall (no sync
+            # between rounds), exactly like the settled spec horizon
+            t0 = _time.perf_counter()
+            dvk, dvv, props, spec_ok = draft_fn(
+                self.draft_params, dvk, dvv, last, seq, act, emitted,
+                d["budget"], d["temps"], cov_dev)
+            dt = _time.perf_counter() - t0
+            self.phase_seconds["draft"] += dt
+            metrics.serving_device_step.observe(dt, "draft")
+            t0 = _time.perf_counter()
+            (vk, vv, last, seq, act, emitted, c_out, ncommit,
+             stats) = verify_fn(
+                self.params, vk, vv, props, spec_ok, last, seq, act,
+                emitted, d["budget"], d["eos"], d["temps"], d["adapters"],
+                d["rids"], self._base_key, self.loras)
+            dt = _time.perf_counter() - t0
+            self.phase_seconds["verify"] += dt
+            metrics.serving_device_step.observe(dt, "verify")
+            outs.append((c_out, ncommit, stats))
+        self.phase_counts["spec_rounds"] += rounds
+        metrics.serving_spec_rounds.inc(by=rounds)
+        width = rounds * (k + 1)
+        scatter_fn = self._scatter_fn(width)
+        self.pools = scatter_fn(self.pools, vk, vv, d["tables"],
+                                d["seq"] - 1, d["act"])
+        self.dpools = scatter_fn(self.dpools, dvk, dvv, d["tables"],
+                                 d["seq"] - 1, d["act"])
+        self._dev = {**d, "last": last, "seq": seq, "act": act,
+                     "emitted": emitted}
+        self._steps += rounds
+        self.phase_counts["horizons"] += 1
+        metrics.serving_dispatch_depth.set(float(self.dispatch_depth))
+        return {
+            "kind": "spec",
+            "outs": outs, "last": last, "seq": seq, "act": act,
+            "emitted": emitted,
+            "snapshot": [(i, s.request.rid) for i, s in acts],
+            "rids": {i: s.request.rid for i, s in acts},
+            "ahead": ahead,
+            "epochs": list(self._patch_epoch),
+        }
+
+    def _commit_horizon(self, rec: dict) -> list[int]:
+        """Wait for one in-flight horizon and commit its tokens. FIFO
+        order is load-bearing: the commit math assumes every earlier
+        record of the same request already landed in ``req.output``.
+        Lanes whose slot churned since dispatch (retired / replaced /
+        evicted) are discarded — their tokens recompute byte-
+        identically elsewhere because sampled streams key off
+        (seed, rid, position), never engine schedule.
+
+        Phase split mirrors the settled path: block_until_ready is the
+        residual DEVICE wall not hidden by overlapped host work
+        (decode_device); the device_get that follows moves ready
+        buffers (host_sync)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        jax.block_until_ready(rec["last"])
+        self.phase_seconds["decode_device"] += _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        payload = rec["toks"] if rec["kind"] == "plain" else rec["outs"]
+        res_h, last_h, seq_h, act_h, em_h = jax.device_get(
+            (payload, rec["last"], rec["seq"], rec["act"],
+             rec["emitted"]))
+        self.phase_seconds["host_sync"] += _time.perf_counter() - t0
+        self.phase_counts["host_syncs"] += 1
+        metrics.serving_host_syncs.inc(
+            "decode" if rec["kind"] == "plain" else "spec")
+        done: list[int] = []
+        tokens_before = self._tokens_emitted
+        if rec["kind"] == "plain":
+            for i, rid in rec["snapshot"]:
+                s = self.slots[i]
+                if s is None or s.request.rid != rid:
+                    continue
+                req = s.request
+                # device `emitted` counts the request's total committed
+                # tokens; every earlier record already landed (FIFO),
+                # so the difference is exactly this record's share
+                e = int(em_h[i]) - len(req.output)
+                for t in range(e):
+                    s.seq_len += 1
+                    self._record(i, req, int(res_h[t][i]))
+                    if req.done:
+                        # a live promotion to the prefill role retires
+                        # the request HOST-side mid-commit — the rest
+                        # of this record's tokens must not leak into a
+                        # request the router is about to hand off
+                        break
+                if req.done:
+                    done.append(req.rid)
+                    self._retire(i)
+        else:
+            drafted = accepted = 0
+            for c_out, ncommit, stats in res_h:
+                drafted += int(stats[0])
+                accepted += int(stats[1])
+                for i, rid in rec["snapshot"]:
+                    s = self.slots[i]
+                    if s is None or s.request.rid != rid:
+                        continue
+                    req = s.request
+                    if req.done:
+                        continue
+                    for t in range(int(ncommit[i])):
+                        s.seq_len += 1
+                        self._record(i, req, int(c_out[i][t]))
+                        if req.done:
+                            # same prefill-role promotion guard as the
+                            # plain commit loop above
+                            break
+            for i, rid in rec["snapshot"]:
+                s = self.slots[i]
+                if s is not None and s.request.rid == rid and s.request.done:
+                    done.append(s.request.rid)
+                    self._retire(i)
+            if drafted:
+                self.spec_drafted += drafted
+                self.spec_accepted += accepted
+                metrics.serving_spec_tokens.inc("proposed", by=drafted)
+                metrics.serving_spec_tokens.inc("accepted", by=accepted)
+            self._watch_spec_commit(self._tokens_emitted - tokens_before)
+        for i in range(self.pcfg.max_slots):
+            if rec["epochs"][i] != self._patch_epoch[i]:
+                continue  # lane re-patched after this dispatch
+            m = self._dev_mirror[i]
+            m["last"] = int(last_h[i])
+            m["seq"] = int(seq_h[i])
+            m["act"] = bool(act_h[i])
+            m["emitted"] = int(em_h[i])
+        if not self._inflight:
+            self._stamp_dev_idle()
+        return done
+
+    def _watch_spec_commit(self, tokens: int) -> None:
+        """Pipelined-path spec watchdog: same one-way demotion as
+        :meth:`_watched_spec_horizon`, windowed over commit-to-commit
+        wall instead of per-horizon wall (a record's dispatch and
+        commit overlap OTHER records; per-record timing would double-
+        count the pipeline). Gaps over a second are discarded as idle,
+        not cadence — a between-workload pause must not tank the
+        realized rate and demote a healthy draft."""
+        if not (self.spec_guard and self.spec_guard_decision is not None
+                and self.spec_active):
+            self._watch_commit_t = None
+            return
+        import time as _time
+
+        now = _time.perf_counter()
+        t_prev, self._watch_commit_t = self._watch_commit_t, now
+        if t_prev is None or now - t_prev > 1.0:
+            return
+        w = self._spec_watch
+        w[0] += tokens
+        w[1] += now - t_prev
+        if w[0] >= 512 and w[1] > 0:
+            realized = w[0] / w[1]
+            floor = float(self.spec_guard_decision.get("plain_tok_s", 0.0))
+            if realized < floor:
+                self.spec_active = False
+                self._retire_draft_scope()
+                self.spec_guard_decision["demoted"] = {
+                    "realized_spec_tok_s": round(realized, 1),
+                    "plain_floor_tok_s": round(floor, 1),
+                    "window_tokens": int(w[0]),
+                }
+                metrics.serving_spec_active.set(0.0)
+            self._spec_watch = [0, 0.0]
+
+    def _patch_pipeline_lanes(self) -> None:
+        """Pipelined replacement for :meth:`_sync_device_state`: fold
+        host-side lane changes (admission, retirement, eviction,
+        growth) into the NEXT dispatch's inputs while earlier horizons
+        are still in flight. Three disjoint cases per lane:
+
+        * host freed / ingesting but the device lane may still be
+          live -> act-only patch (a dead lane is a scan fixed point;
+          without it a host-retired lane would keep decoding into
+          blocks the allocator already reclaimed);
+        * active slot whose identity/values differ from the committed
+          mirror -> FULL lane write (admission or readmission; safe
+          because the device lane is either an inactive fixed point or
+          an old rid whose in-flight commits the snapshot discards);
+        * only the block table grew (lookahead funding for an
+          in-flight-advanced lane) -> table-only patch, because a full
+          write would REWIND last/seq/emitted values that are device-
+          ahead of the host's committed view. Table changes are the
+          COMMON case (funding grows a table nearly every horizon), so
+          they batch into ONE host-built [S, MB] transfer instead of a
+          jitted per-lane .at[].set dispatch — on a busy device queue
+          each extra dispatch costs more than the whole transfer.
+
+        Act-only and full patches bump the lane's epoch so in-flight
+        commits don't fold stale device values over the new lane's
+        mirror. A table-only patch deliberately does NOT: it leaves
+        the lane's scalar state untouched, and the in-flight horizons'
+        outputs remain the authoritative mirror chain — bumping here
+        would orphan their folds, leave the mirror stale, and make the
+        next pass "repair" a healthy device-ahead lane with a full
+        rewind (observed as duplicated emissions)."""
+        if self._dev is None or not self._inflight:
+            # empty pipeline: commits made the mirror exact, the
+            # classic full diff is both correct and cheapest
+            self._sync_device_state()
+            return
+        import numpy as np
+
+        MB = self.pcfg.max_blocks_per_seq
+        tables_dirty = False
+        for i, s in enumerate(self.slots):
+            m = self._dev_mirror[i]
+            if s is None or s.ingest_pos is not None:
+                if m is not None and m["act"]:
+                    self._dev = _patch_lane_act(self._dev, i, False)
+                    m["act"] = False
+                    self._patch_epoch[i] += 1
+                continue
+            req = s.request
+            want = {
+                "last": int(self._last_tokens[i]),
+                "seq": int(s.seq_len), "act": True,
+                "emitted": len(req.output),
+                "budget": int(req.max_new_tokens),
+                "eos": -1 if req.eos_token is None else int(req.eos_token),
+                "temp": float(req.temperature),
+                "adapter": int(req.adapter), "rid": int(req.rid),
+                "table": tuple(s.blocks),
+            }
+            if m == want:
+                continue
+            if (m is not None
+                    and all(m[f] == want[f] for f in m if f != "table")):
+                m["table"] = want["table"]
+                tables_dirty = True
+            else:
+                trow = np.full((MB,), SCRATCH_BLOCK, np.int32)
+                trow[:len(want["table"])] = want["table"]
+                self._dev = _patch_lane(
+                    self._dev, i, want["last"], want["seq"], want["act"],
+                    want["emitted"], want["budget"], want["eos"],
+                    want["temp"], want["adapter"], want["rid"],
+                    jnp.asarray(trow))
+                self._dev_mirror[i] = want
+                self._patch_epoch[i] += 1
+        if tables_dirty:
+            # one transfer covers every grown table this pass. Rebuilt
+            # wholesale from the mirrors (the device never writes
+            # tables, so the mirror rows ARE the device rows plus this
+            # pass's growth); rows of dead/ingesting lanes read as
+            # scratch, which is where act=False lanes scatter anyway.
+            # In-flight horizons are untouched — they hold the tables
+            # ARRAY they were dispatched with.
+            tab = np.full((self.pcfg.max_slots, MB), SCRATCH_BLOCK,
+                          np.int32)
+            for i, s in enumerate(self.slots):
+                if s is not None and s.ingest_pos is None:
+                    row = self._dev_mirror[i]["table"]
+                    tab[i, :len(row)] = row
+            self._dev = {**self._dev, "tables": jnp.asarray(tab)}
+
+    def _stamp_dev_idle(self) -> None:
+        """Mark the decode pipeline empty — but only while decode work
+        remains (queued or slotted requests). A fully idle engine is
+        not a host gap: counting the wait for the NEXT workload would
+        book arbitrary idle wall (the whole window between bench
+        drains, a lull in live traffic) into the first dispatch that
+        follows it."""
+        import time as _time
+
+        if self.pending or any(s is not None for s in self.slots):
+            self._dev_idle_at = _time.perf_counter()
+        else:
+            self._dev_idle_at = None
+
+    def _note_dispatch_gap(self) -> None:
+        """Observe the device-idle gap: wall time since the decode
+        pipeline last went empty. At depth 1 this is the full host
+        round-trip between horizons — the number the pipeline exists
+        to shrink. (Prefill dispatches inside the gap still count as
+        gap: the decode pipeline sat empty through them.)"""
+        if self._dev_idle_at is None:
+            return
+        import time as _time
+
+        gap = _time.perf_counter() - self._dev_idle_at
+        self._dev_idle_at = None
+        self.phase_seconds["host_gap"] += gap
+        metrics.serving_host_gap.observe(gap)
+
+    def _maybe_check_view_chain(self, spec: bool) -> None:
+        """One-shot KV view-chain sharding audit, armed by
+        ``BOBRA_SERVING_SHARDING_CHECK=1``: fail loudly at the first
+        horizon if chained jitted calls would repartition views, pools,
+        or lane arrays between dispatches (see SNIPPETS' pjit
+        out/in_axis_resources contract and serving/sharding_check.py)."""
+        if self._view_chain_checked:
+            return
+        import os as _os
+
+        self._view_chain_checked = True
+        if _os.environ.get("BOBRA_SERVING_SHARDING_CHECK", "") != "1":
+            return
+        bad = self.check_view_chain(include_spec=spec)
+        if bad:
+            raise RuntimeError(
+                "KV view chain repartitions between chained jitted "
+                "calls:\n  " + "\n  ".join(bad))
+
+    def check_view_chain(self, include_spec: Optional[bool] = None
+                         ) -> list[str]:
+        """Audit the gather_views -> attention -> scatter_window chain
+        (plain and, when available, spec) for hidden resharding between
+        chained jitted calls; returns human-readable mismatches (empty
+        = sharding-stable end to end)."""
+        from .sharding_check import audit_view_chain
+
+        if include_spec is None:
+            include_spec = (self.draft_params is not None
+                            and self.spec_active)
+        return audit_view_chain(self, include_spec=include_spec)
 
     def _guarded_horizon(self) -> Optional[list[int]]:
         """The payoff guard at horizon granularity: alternate one spec
@@ -2171,6 +2765,21 @@ def _fold_keys(base_key, rids, emitted):
         return jax.random.fold_in(jax.random.fold_in(base_key, r), e)
 
     return jax.vmap(one)(rids, emitted)
+
+
+#: sentinel: a pipelined dispatch found per-slot block coverage
+#: unfundable without preemption — the pipeline drains and the settled
+#: classic tick (the one place eviction decisions live) takes over
+_UNFUNDABLE = object()
+
+
+@jax.jit
+def _patch_lane_act(dev, i, act):
+    """Flip ONE lane's active flag without touching its other fields —
+    the pipelined retirement/eviction patch. last/seq/emitted of an
+    in-flight-advanced lane are device-authoritative; writing host
+    values would rewind a live lane and re-emit tokens."""
+    return {**dev, "act": dev["act"].at[i].set(act)}
 
 
 @jax.jit

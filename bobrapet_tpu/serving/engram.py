@@ -25,13 +25,15 @@ steps:
                maxBlocksPerSeq: 64, prefillChunk: 256}
       draft: {selfInt8: true, specK: 4}   # optional speculative decoding
       decodeHorizon: 8                    # fused steps per host sync
+      dispatchDepth: 2                    # horizons kept in flight
       prefixShared: true                  # cross-engine prefix sharing
       role: prefill                       # disaggregated pool role
       hub: bobravoz-hub.bobrapet-system.svc:50052
 ```
 
-``decodeHorizon``/``prefixShared`` default to the operator's live
-`serving.decode-horizon` / `serving.prefix-cache-shared` knobs (see
+``decodeHorizon``/``dispatchDepth``/``prefixShared`` default to the
+operator's live `serving.decode-horizon` / `serving.dispatch-depth` /
+`serving.prefix-cache-shared` knobs (see
 :func:`apply_tuning`); pinning them in the step config opts the engine
 out of live reloads of that knob's build-time default (reloads still
 retune running engines).
@@ -119,6 +121,9 @@ def apply_tuning(scfg: Any) -> None:
         try:
             if "decode_horizon" not in pinned:
                 eng.set_decode_horizon(scfg.decode_horizon)
+            if "dispatch_depth" not in pinned:
+                eng.set_dispatch_depth(
+                    getattr(scfg, "dispatch_depth", 2))
             if "spec_k" not in pinned:
                 eng.set_spec_k(scfg.spec_k)
             if "role" not in pinned:
@@ -269,6 +274,9 @@ def build_engine(ctx) -> ServingEngine:
     tuning = _tuning()
     horizon = int(config.get(
         "decodeHorizon", tuning.decode_horizon if tuning else 8))
+    depth = int(config.get(
+        "dispatchDepth",
+        getattr(tuning, "dispatch_depth", 2) if tuning else 2))
     shared = bool(config.get(
         "prefixShared", tuning.prefix_cache_shared if tuning else False))
     if (draft_params is not None and tuning is not None
@@ -315,8 +323,8 @@ def build_engine(ctx) -> ServingEngine:
                            loras=loras, lora_scale=lora_scale,
                            draft_params=draft_params, draft_cfg=draft_cfg,
                            spec_k=spec_k, spec_guard=spec_guard,
-                           decode_horizon=horizon, prefix_shared=shared,
-                           role=role)
+                           decode_horizon=horizon, dispatch_depth=depth,
+                           prefix_shared=shared, role=role)
     # weighted-fair tenant admission: the step's own tenantWeights
     # mapping pins it; otherwise the live serving.tenant-weights knob
     # is the build-time default (same contract as the other knobs)
@@ -337,6 +345,7 @@ def build_engine(ctx) -> ServingEngine:
     # knobs the STEP pinned survive serving.* reloads (apply_tuning)
     engine._engram_pinned = frozenset(
         name for key, name in (("decodeHorizon", "decode_horizon"),
+                               ("dispatchDepth", "dispatch_depth"),
                                ("prefixShared", "prefix_shared"),
                                ("role", "role"),
                                ("tenantWeights", "tenant_weights"))

@@ -172,6 +172,59 @@ def gather_views(
             jnp.pad(v.reshape(L, s, mb * b, h, d), pad))
 
 
+def view_sharding(pools: dict[str, jax.Array]):
+    """Derive the axis_resources a gathered view must carry from the
+    pool's own sharding, or None when the pools are not
+    NamedSharding-placed (single device, CPU tests).
+
+    Pool ``[L, N, B, H, D]`` -> view ``[L, S, cap+1, H, D]``: the
+    layer and head/dim partitioning carries over one-to-one; the block
+    axes become the slot/position axes, which the gather fully
+    rematerializes per slot, so they must be unsharded in the view.
+    Pinning this on the gather's outputs anchors the whole
+    gather -> draft/verify -> scatter chain: chained jitted calls then
+    consume the views at exactly the layout they were produced
+    (SNIPPETS' pjit out/in_axis_resources contract) instead of leaving
+    XLA free to silently repartition per call."""
+    s = getattr(pools["k"], "sharding", None)
+    if not isinstance(s, jax.sharding.NamedSharding):
+        return None
+    spec = tuple(s.spec) + (None,) * (5 - len(tuple(s.spec)))
+    return jax.sharding.NamedSharding(
+        s.mesh,
+        jax.sharding.PartitionSpec(spec[0], None, None, spec[3], spec[4]),
+    )
+
+
+#: compiled gather_views wrappers keyed by pinned view sharding (None =
+#: unpinned single-device). Module-level ON PURPOSE: a fresh
+#: ``jax.jit(gather_views)`` per engine/per make_spec_horizon_fns call
+#: minted a new wrapper object with its own cache — every spec-k reload
+#: and every engine paid a fresh trace for the identical graph.
+_GATHER_VIEWS_JITS: dict = {}
+
+
+def gather_views_jit(vs=None):
+    """The shared compiled ``gather_views`` entry for a given pinned
+    view sharding (``view_sharding(pools)``); cached process-wide."""
+    fn = _GATHER_VIEWS_JITS.get(vs)
+    if fn is None:
+        fn = (jax.jit(gather_views) if vs is None
+              else jax.jit(gather_views, out_shardings=(vs, vs)))
+        _GATHER_VIEWS_JITS[vs] = fn
+    return fn
+
+
+def gather_views_pinned(
+    pools: dict[str, jax.Array],
+    block_tables: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """:func:`gather_views` through the process-wide compiled wrapper,
+    with the view sharding pinned to match the pools (see
+    :func:`view_sharding`)."""
+    return gather_views_jit(view_sharding(pools))(pools, block_tables)
+
+
 def scatter_window(
     pools: dict[str, jax.Array],
     view_k: jax.Array,  # [L, S, capacity + 1, Hkv, Dh] (scratch-padded)

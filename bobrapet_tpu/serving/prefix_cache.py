@@ -87,7 +87,8 @@ def _encode_kv_payload(payload: dict) -> bytes:
     import numpy as np
 
     buf = io.BytesIO()
-    np.savez(buf, **{k: np.asarray(v) for k, v in payload.items()})
+    np.savez(buf, **{k: np.asarray(v)  # sync-point: disk-tier export, runs outside the lock off the decode path
+                     for k, v in payload.items()})
     return buf.getvalue()
 
 

@@ -1,0 +1,227 @@
+"""Sharding-stability audit of the serving KV view chain.
+
+The horizon engine chains jitted calls whose outputs feed the next
+dispatch's inputs without a host sync: the plain horizon's donated
+pools and lane arrays feed the next horizon; the spec path chains
+``gather_views -> draft -> verify (xR) -> scatter_window`` as four
+separate dispatches per horizon. pjit's documented contract (see
+SNIPPETS [1]) is that the producer's ``out_axis_resources`` must match
+the consumer's ``in_axis_resources`` — otherwise XLA silently inserts
+a repartition on EVERY horizon, a steady-state tax that profiles as
+"the kernel got slower" rather than as a visible collective.
+
+:func:`audit_view_chain` lowers and compiles the actual chain
+functions with the engine's live array layouts, then compares the
+producer-side output shardings against the consumer-side input
+shardings at every chain boundary. Empty result = sharding-stable end
+to end. The engine runs this once at the first horizon when
+``BOBRA_SERVING_SHARDING_CHECK=1`` and fails loudly on a mismatch;
+tests call :meth:`ServingEngine.check_view_chain` directly.
+
+On a single device every sharding is the (one) SingleDeviceSharding,
+so the audit is trivially clean — the value is on meshes, where the
+pinned gather (:func:`~.paged_cache.view_sharding`) anchors the chain
+and this check proves nothing downstream un-anchors it. Introspection
+APIs vary across jax versions; boundaries whose shardings cannot be
+read are skipped rather than reported (the audit must never fail a
+deployment over an API rename — only over a real repartition).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _equiv(a: Any, b: Any, ndim: Optional[int] = None) -> bool:
+    if a == b:
+        return True
+    try:
+        return a.is_equivalent_to(b, ndim if ndim is not None else 5)
+    except Exception:
+        return False
+
+
+def _compiled(fn: Any, *args: Any) -> Optional[Any]:
+    try:
+        return fn.lower(*args).compile()
+    except Exception:
+        return None
+
+
+def _in_shardings(compiled: Any) -> Optional[tuple]:
+    try:
+        return compiled.input_shardings[0]
+    except Exception:
+        return None
+
+
+def _out_shardings(compiled: Any) -> Optional[Any]:
+    try:
+        return compiled.output_shardings
+    except Exception:
+        return None
+
+
+def _compare(name: str, out_tree: Any, in_tree: Any,
+             msgs: list[str]) -> None:
+    """Append one message per leaf whose producer-side sharding does
+    not match the consumer-side one."""
+    if out_tree is None or in_tree is None:
+        return
+    o = jax.tree_util.tree_leaves(out_tree)
+    i = jax.tree_util.tree_leaves(in_tree)
+    if len(o) != len(i):
+        msgs.append(f"{name}: leaf arity {len(o)} vs {len(i)}")
+        return
+    for idx, (a, b) in enumerate(zip(o, i)):
+        if not _equiv(a, b):
+            msgs.append(f"{name}[leaf {idx}]: produced {a} but consumed "
+                        f"as {b}")
+
+
+def _sharded_aval(ref: Any, sharding: Any) -> Any:
+    """ShapeDtypeStruct carrying the producer's sharding so consumer
+    lowering sees the arrays exactly as the chain delivers them."""
+    try:
+        if sharding is not None:
+            return jax.ShapeDtypeStruct(ref.shape, ref.dtype,
+                                        sharding=sharding)
+    except Exception:
+        pass
+    return jax.ShapeDtypeStruct(ref.shape, ref.dtype)
+
+
+def _plain_chain(engine: Any, msgs: list[str]) -> None:
+    """The plain horizon is ONE jitted scan, so the only chain
+    boundary is the self-chain: this dispatch's donated pools and lane
+    arrays are the next dispatch's inputs."""
+    import functools
+
+    from .engine import _horizon_plain
+
+    H = engine.decode_horizon
+    fn = engine._hz_fns.get(H)
+    if fn is None:
+        fn = jax.jit(
+            functools.partial(_horizon_plain, cfg=engine.cfg,
+                              pcfg=engine.pcfg, H=H,
+                              lora_scale=engine.lora_scale,
+                              is_moe=engine.is_moe),
+            donate_argnums=(1,),
+        )
+        engine._hz_fns[H] = fn
+    d = engine._dev
+    c = _compiled(fn, engine.params, engine.pools, d["last"], d["seq"],
+                  d["act"], d["emitted"], d["budget"], d["eos"],
+                  d["temps"], d["adapters"], d["rids"], d["tables"],
+                  engine._base_key, engine.loras)
+    if c is None:
+        return
+    ins, outs = _in_shardings(c), _out_shardings(c)
+    if ins is None or outs is None:
+        return
+    _compare("plain horizon pools (out -> next in)", outs[0], ins[1], msgs)
+    # lane arrays: outputs (last, seq, act, emitted) chain into args
+    # 2..5 of the next dispatch
+    _compare("plain horizon lanes (out -> next in)", outs[1],
+             tuple(ins[2:6]), msgs)
+
+
+def _spec_chain(engine: Any, msgs: list[str]) -> None:
+    """The spec horizon chains four separate dispatches; every arrow
+    below is a boundary where a mismatched layout would repartition:
+
+        scatter.pools -> gather.pools
+        gather.(vk,vv) -> verify.(vk,vv) -> verify.(vk,vv) [rounds]
+        gather.(dvk,dvv) -> draft.(dvk,dvv) -> draft.(dvk,dvv)
+        verify.(vk,vv) -> scatter.(vk,vv)
+        verify.lanes -> draft.lanes [next round]
+    """
+    from .paged_cache import gather_views, gather_views_jit, view_sharding
+
+    d = engine._dev
+    k, (_, draft_fn, verify_fn) = engine._spec_horizon_fns()
+    S = engine.pcfg.max_slots
+
+    def gather_side(pools):
+        g = gather_views_jit(view_sharding(pools))
+        c = _compiled(g, pools, d["tables"])
+        avals = jax.eval_shape(gather_views, pools, d["tables"])
+        outs = _out_shardings(c) if c is not None else None
+        vs = (jax.tree_util.tree_leaves(outs)
+              if outs is not None else [None, None])
+        vk = _sharded_aval(avals[0], vs[0] if len(vs) == 2 else None)
+        vv = _sharded_aval(avals[1], vs[1] if len(vs) == 2 else None)
+        return c, (vk, vv)
+
+    gc, (vk_a, vv_a) = gather_side(engine.pools)
+    dgc, (dvk_a, dvv_a) = gather_side(engine.dpools)
+
+    dc = _compiled(draft_fn, engine.draft_params, dvk_a, dvv_a,
+                   d["last"], d["seq"], d["act"], d["emitted"],
+                   d["budget"], d["temps"], d["act"])
+    props_a = jax.ShapeDtypeStruct((S, k), jnp.int32)
+    ok_a = jax.ShapeDtypeStruct((S,), jnp.bool_)
+    vc = _compiled(verify_fn, engine.params, vk_a, vv_a, props_a, ok_a,
+                   d["last"], d["seq"], d["act"], d["emitted"],
+                   d["budget"], d["eos"], d["temps"], d["adapters"],
+                   d["rids"], engine._base_key, engine.loras)
+    rounds = engine._spec_rounds()
+    sc = _compiled(engine._scatter_fn(rounds * (k + 1)), engine.pools,
+                   vk_a, vv_a, d["tables"], d["seq"], d["act"])
+
+    g_out = _out_shardings(gc) if gc is not None else None
+    dg_out = _out_shardings(dgc) if dgc is not None else None
+    d_in = _in_shardings(dc) if dc is not None else None
+    d_out = _out_shardings(dc) if dc is not None else None
+    v_in = _in_shardings(vc) if vc is not None else None
+    v_out = _out_shardings(vc) if vc is not None else None
+    s_in = _in_shardings(sc) if sc is not None else None
+    s_out = _out_shardings(sc) if sc is not None else None
+    g_in = _in_shardings(gc) if gc is not None else None
+
+    if g_out is not None and v_in is not None:
+        _compare("spec gather -> verify views", g_out, tuple(v_in[1:3]),
+                 msgs)
+    if dg_out is not None and d_in is not None:
+        _compare("spec gather -> draft views", dg_out, tuple(d_in[1:3]),
+                 msgs)
+    if d_out is not None and d_in is not None:
+        # draft returns (dvk, dvv, props, spec_ok); views self-chain
+        _compare("spec draft views (out -> next round in)",
+                 tuple(jax.tree_util.tree_leaves(d_out)[:2]),
+                 tuple(d_in[1:3]), msgs)
+    if v_out is not None:
+        v_out_l = jax.tree_util.tree_leaves(v_out)
+        if v_in is not None:
+            _compare("spec verify views (out -> next round in)",
+                     tuple(v_out_l[:2]), tuple(v_in[1:3]), msgs)
+            _compare("spec verify lanes (out -> next round in)",
+                     tuple(v_out_l[2:6]), tuple(v_in[5:9]), msgs)
+        if d_in is not None:
+            _compare("spec verify lanes -> draft lanes",
+                     tuple(v_out_l[2:6]), tuple(d_in[3:7]), msgs)
+        if s_in is not None:
+            _compare("spec verify views -> scatter views",
+                     tuple(v_out_l[:2]), tuple(s_in[1:3]), msgs)
+    if s_out is not None and g_in is not None:
+        _compare("spec scatter pools -> gather pools", s_out, g_in[0],
+                 msgs)
+
+
+def audit_view_chain(engine: Any, include_spec: bool = False) -> list[str]:
+    """Compare producer output shardings against consumer input
+    shardings at every boundary of the plain (and optionally spec) KV
+    view chain; returns human-readable mismatches, empty when the
+    chain is sharding-stable."""
+    msgs: list[str] = []
+    if engine._dev is None:
+        # all-inactive lane arrays have the production shapes/layouts
+        engine._sync_device_state()
+    _plain_chain(engine, msgs)
+    if include_spec and engine.draft_params is not None:
+        _spec_chain(engine, msgs)
+    return msgs

@@ -290,9 +290,12 @@ def make_spec_horizon_fns(cfg: LlamaConfig, dcfg: LlamaConfig,
     profitability instrumentation).
     """
     from .engine import _fold_keys, _forward_views
-    from .paged_cache import gather_views
+    from .paged_cache import gather_views_pinned
 
-    gather_fn = jax.jit(gather_views)
+    # process-wide cached compiled gather (a per-call jax.jit minted a
+    # fresh wrapper + trace per spec-k reload); sharding-pinned so the
+    # gather -> draft/verify -> scatter chain can't repartition
+    gather_fn = gather_views_pinned
 
     def _draft(draft_params, dvk, dvv, last, seq, act, emitted, budget,
                temps, cov):

@@ -1,0 +1,22 @@
+"""serving-sync-points bad fixture: every tagged line must flag."""
+
+import jax
+import numpy as np
+
+
+def commit_horizon(rec):
+    jax.block_until_ready(rec["last"])  # BAD
+    payload = jax.device_get(rec["outs"])  # BAD
+    return payload
+
+
+def sample_metrics(arr):
+    host = np.asarray(arr)  # BAD
+    return host.mean()
+
+
+class Engine:
+    def drain(self, toks):
+        toks.block_until_ready()  # BAD
+        # annotation present but no reason given — still a finding
+        return jax.device_get(toks)  # sync-point:   # BAD

@@ -1,0 +1,356 @@
+"""Pipelined serving dispatch (PR 16): double-buffered horizons.
+
+`dispatch_depth > 1` keeps multiple decode horizons enqueued on the
+device while the host commits the oldest and schedules the next —
+jax's async dispatch is the buffer. The contract that makes the
+pipeline deployable is the same one the horizon engine set: every
+output stream is BYTE-IDENTICAL to the single-buffered
+`dispatch_depth=1` reference for every scheduling shape — greedy,
+sampled, mixed temperatures, speculation on/off, EOS inside a
+horizon, preemption mid-flight, a live depth reload mid-stream, and
+requests admitted while horizons are in flight.
+
+Parity is not luck here either: sampled streams are a pure function
+of (engine seed, rid, token index), and the commit path tolerates the
+one-horizon lag by folding device-authoritative lane state back into
+the host mirror under patch epochs.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from bobrapet_tpu.models import llama, quant
+from bobrapet_tpu.serving import PagedConfig, ServingEngine
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def draft(model):
+    _cfg, params = model
+    return quant.quantize_params(params)
+
+
+def _pcfg(**over):
+    kw = dict(max_slots=4, block_size=16, num_blocks=128,
+              max_blocks_per_seq=8)
+    kw.update(over)
+    return PagedConfig(**kw)
+
+
+def _prompts(cfg, n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, cfg.vocab_size, 8 + (i % 5) * 7).tolist()
+            for i in range(n)]
+
+
+def _drain(engine, prompts, *, max_new=12, temps=None, eos=None):
+    for i, p in enumerate(prompts):
+        engine.submit(list(p), max_new_tokens=max_new,
+                      temperature=(temps[i] if temps else 0.0),
+                      eos_token=eos)
+    done = engine.run()
+    return {r.rid: r.output for r in done}
+
+
+class TestPipelineParity:
+    """Every case: pipelined engine vs the dispatch_depth=1 reference
+    (both on the SAME decode horizon, so only the pipelining moves)."""
+
+    def _pair(self, model, depth=2, pc=None, **kw):
+        cfg, params = model
+        ref = ServingEngine(params, cfg, pc or _pcfg(), decode_horizon=8,
+                            dispatch_depth=1, **kw)
+        pipe = ServingEngine(params, cfg, pc or _pcfg(), decode_horizon=8,
+                             dispatch_depth=depth, **kw)
+        return ref, pipe
+
+    def test_greedy_byte_identical(self, model):
+        cfg, _ = model
+        prompts = _prompts(cfg)
+        ref, pipe = self._pair(model)
+        assert _drain(ref, prompts) == _drain(pipe, prompts)
+        assert pipe.phase_counts["horizons"] > 0
+        # the pipeline drained fully: nothing left enqueued
+        assert not pipe._inflight
+        # host work actually overlapped an in-flight horizon
+        assert pipe.phase_seconds["host_overlap"] > 0
+
+    def test_depth3_greedy_byte_identical(self, model):
+        cfg, _ = model
+        prompts = _prompts(cfg, seed=2)
+        ref, pipe = self._pair(model, depth=3)
+        assert _drain(ref, prompts) == _drain(pipe, prompts)
+
+    def test_sampled_fixed_seed_byte_identical(self, model):
+        cfg, _ = model
+        prompts = _prompts(cfg, seed=3)
+        temps = [0.7, 1.1, 0.9, 1.3, 0.8, 1.0, 0.6, 1.2]
+        ref, pipe = self._pair(model)
+        assert _drain(ref, prompts, temps=temps) == _drain(
+            pipe, prompts, temps=temps)
+
+    def test_mixed_temperature_batch_byte_identical(self, model):
+        cfg, _ = model
+        prompts = _prompts(cfg, seed=4)
+        temps = [0.0, 0.8, 0.0, 1.2, 0.0, 0.0, 0.9, 0.0]
+        ref, pipe = self._pair(model)
+        assert _drain(ref, prompts, temps=temps) == _drain(
+            pipe, prompts, temps=temps)
+
+    def test_eos_fires_inside_horizon(self, model):
+        """EOS lands mid-horizon while a LATER horizon is already
+        enqueued: retirement must tolerate the one-horizon commit lag
+        and still cut the stream at the reference position."""
+        cfg, _ = model
+        prompts = _prompts(cfg, seed=5)
+        ref, pipe = self._pair(model)
+        base = _drain(ref, prompts, max_new=16)
+        eos = next(t for out in base.values() for t in out[3:10])
+        ref2, pipe2 = self._pair(model)
+        a = _drain(ref2, prompts, max_new=16, eos=eos)
+        b = _drain(pipe2, prompts, max_new=16, eos=eos)
+        assert a == b
+        assert any(len(v) < 16 for v in a.values())
+
+    def test_spec_on_off_byte_identical(self, model, draft):
+        cfg, _ = model
+        prompts = _prompts(cfg, seed=6)
+        ref, _unused = self._pair(model)
+        base = _drain(ref, prompts, max_new=14)
+        for depth in (1, 2):
+            spec = ServingEngine(
+                model[1], cfg, _pcfg(), decode_horizon=8,
+                dispatch_depth=depth, draft_params=draft, draft_cfg=cfg,
+                spec_k=4, spec_guard=False)
+            assert _drain(spec, prompts, max_new=14) == base
+            assert spec.spec_drafted > 0
+
+    def test_spec_mixed_temps_byte_identical(self, model, draft):
+        cfg, _ = model
+        prompts = _prompts(cfg, seed=7)
+        temps = [0.0, 0.9, 0.0, 1.1, 0.0, 0.7, 0.0, 0.0]
+        ref, _unused = self._pair(model)
+        base = _drain(ref, prompts, temps=temps)
+        spec = ServingEngine(model[1], cfg, _pcfg(), decode_horizon=8,
+                             dispatch_depth=2, draft_params=draft,
+                             draft_cfg=cfg, spec_k=4, spec_guard=False)
+        assert _drain(spec, prompts, temps=temps) == base
+
+    def test_preemption_mid_flight_byte_identical(self, model, draft):
+        """Tight block pool: growth becomes unfundable while horizons
+        are in flight — the pipeline drains to the settled eviction
+        tick and resumes, with recompute keeping streams identical."""
+        cfg, params = model
+        rng = np.random.default_rng(8)
+        prompts = [rng.integers(0, cfg.vocab_size, 10 + (i % 3) * 9).tolist()
+                   for i in range(6)]
+        pc = dict(max_slots=4, block_size=8, num_blocks=18,
+                  max_blocks_per_seq=8, prefix_caching=False)
+
+        def run(depth, spec=False):
+            kw = dict(draft_params=draft, draft_cfg=cfg, spec_k=4,
+                      spec_guard=False) if spec else {}
+            eng = ServingEngine(params, cfg, PagedConfig(**pc),
+                                decode_horizon=8, dispatch_depth=depth,
+                                **kw)
+            for p in prompts:
+                eng.submit(list(p), max_new_tokens=24)
+            done = eng.run()
+            return ({r.rid: r.output for r in done},
+                    sum(r.preemptions for r in done))
+
+        base, pre_ref = run(1)
+        pipe, pre_pipe = run(2)
+        spec_pipe, _ = run(2, spec=True)
+        assert pre_ref > 0 and pre_pipe > 0
+        assert base == pipe == spec_pipe
+
+    def test_depth_live_reload_mid_stream(self, model):
+        """set_dispatch_depth between ticks (the serving.dispatch-depth
+        reload path) must not change a single output byte — including
+        the drop to 1, which forces the pipeline to drain."""
+        cfg, params = model
+        prompts = _prompts(cfg, seed=9)
+        ref, _unused = self._pair(model)
+        base = _drain(ref, prompts, max_new=16)
+        eng = ServingEngine(params, cfg, _pcfg(), decode_horizon=8,
+                            dispatch_depth=2)
+        for p in prompts:
+            eng.submit(list(p), max_new_tokens=16)
+        for depth in (2, 1, 3, 2):
+            eng.set_dispatch_depth(depth)
+            eng.step()
+        done = eng.run()
+        assert {r.rid: r.output for r in done} == base
+
+    def test_mid_flight_admission_byte_identical(self, model):
+        """Requests submitted while horizons are in flight fold into
+        the next enqueued horizon without a drain, and the streams
+        match a quiesced submit-everything-upfront drain."""
+        cfg, params = model
+        prompts = _prompts(cfg, n=8, seed=12)
+        ref = ServingEngine(params, cfg, _pcfg(), decode_horizon=8,
+                            dispatch_depth=1)
+        base = _drain(ref, prompts, max_new=16)
+        eng = ServingEngine(params, cfg, _pcfg(), decode_horizon=8,
+                            dispatch_depth=2)
+        for p in prompts[:4]:
+            eng.submit(list(p), max_new_tokens=16)
+        eng.step()
+        assert eng._inflight  # horizons genuinely in flight
+        for p in prompts[4:]:
+            eng.submit(list(p), max_new_tokens=16)
+        done = eng.run()
+        assert {r.rid: r.output for r in done} == base
+
+    def test_invalid_depth_rejected(self, model):
+        cfg, params = model
+        with pytest.raises(ValueError):
+            ServingEngine(params, cfg, _pcfg(), dispatch_depth=0)
+        eng = ServingEngine(params, cfg, _pcfg())
+        with pytest.raises(ValueError):
+            eng.set_dispatch_depth(0)
+
+
+class TestShardingCheck:
+    """KV view-chain sharding audit: gather_views -> attention ->
+    scatter_window must chain with zero hidden repartitions."""
+
+    def test_plain_chain_stable(self, model):
+        cfg, params = model
+        eng = ServingEngine(params, cfg, _pcfg(), decode_horizon=8)
+        assert eng.check_view_chain(include_spec=False) == []
+
+    def test_spec_chain_stable(self, model, draft):
+        cfg, params = model
+        eng = ServingEngine(params, cfg, _pcfg(), decode_horizon=8,
+                            draft_params=draft, draft_cfg=cfg, spec_k=4,
+                            spec_guard=False)
+        assert eng.check_view_chain(include_spec=True) == []
+
+    def test_env_armed_startup_check(self, model, monkeypatch):
+        """BOBRA_SERVING_SHARDING_CHECK=1 runs the audit once at the
+        first horizon and passes on a sharding-stable chain."""
+        cfg, params = model
+        monkeypatch.setenv("BOBRA_SERVING_SHARDING_CHECK", "1")
+        eng = ServingEngine(params, cfg, _pcfg(), decode_horizon=8,
+                            dispatch_depth=2)
+        for p in _prompts(cfg, n=4, seed=13):
+            eng.submit(list(p), max_new_tokens=8)
+        eng.run()  # would raise on a repartition
+        assert eng._view_chain_checked
+
+    def test_check_runs_once(self, model, monkeypatch):
+        cfg, params = model
+        monkeypatch.setenv("BOBRA_SERVING_SHARDING_CHECK", "1")
+        eng = ServingEngine(params, cfg, _pcfg(), decode_horizon=8)
+        calls = []
+        orig = eng.check_view_chain
+
+        def counting(**kw):
+            calls.append(kw)
+            return orig(**kw)
+
+        monkeypatch.setattr(eng, "check_view_chain", counting)
+        for p in _prompts(cfg, n=4, seed=14):
+            eng.submit(list(p), max_new_tokens=8)
+        eng.run()
+        assert len(calls) == 1
+
+
+class TestPipelineObservability:
+    def test_phase_keys_and_reset(self, model):
+        cfg, params = model
+        eng = ServingEngine(params, cfg, _pcfg(), decode_horizon=8,
+                            dispatch_depth=2)
+        assert "host_gap" in eng.phase_seconds
+        assert "host_overlap" in eng.phase_seconds
+        for p in _prompts(cfg, n=4, seed=15):
+            eng.submit(list(p), max_new_tokens=10)
+        eng.run()
+        eng.reset_phase_stats()
+        assert eng.phase_seconds["host_gap"] == 0.0
+        assert eng.phase_seconds["host_overlap"] == 0.0
+        # a stale idle stamp must not leak the reset boundary into the
+        # next measured window's first dispatch gap
+        assert eng._dev_idle_at is None
+
+    def test_pipeline_series_emitted(self, model):
+        from bobrapet_tpu.observability.metrics import metrics
+
+        cfg, params = model
+        # depth 1: every horizon-to-horizon round-trip is a device-idle
+        # gap, so the histogram must accumulate observations
+        gaps_before = metrics.serving_host_gap.count()
+        ref = ServingEngine(params, cfg, _pcfg(), decode_horizon=8,
+                            dispatch_depth=1)
+        for p in _prompts(cfg, n=8, seed=16):
+            ref.submit(list(p), max_new_tokens=10)
+        ref.run()
+        assert metrics.serving_host_gap.count() > gaps_before
+        assert metrics.serving_dispatch_depth.value() == 1.0
+        # depth 2 on a single-wave drain: the pipeline never goes empty
+        # mid-drain, so the ENGINE's own gap stays (near) zero while
+        # the gauge reports the configured depth
+        eng = ServingEngine(params, cfg, _pcfg(), decode_horizon=8,
+                            dispatch_depth=2)
+        for p in _prompts(cfg, n=4, seed=16):
+            eng.submit(list(p), max_new_tokens=10)
+        eng.run()
+        assert metrics.serving_dispatch_depth.value() == 2.0
+        # drained: nothing in flight is the resting state of the gauge
+        assert metrics.serving_inflight.value() == 0.0
+
+
+class TestDispatchDepthKnob:
+    """`serving.dispatch-depth`: registration, validation, and the
+    live-reload path through serving/engram.apply_tuning."""
+
+    def test_key_parses_and_validates(self):
+        from bobrapet_tpu.config.operator import parse_config
+
+        cfg = parse_config({"serving.dispatch-depth": "3"})
+        assert cfg.serving.dispatch_depth == 3
+        assert cfg.validate() == []
+        cfg.serving.dispatch_depth = 0
+        assert any("serving.dispatch-depth" in e for e in cfg.validate())
+
+    def test_apply_tuning_retunes_live_engine(self, model):
+        from bobrapet_tpu.config.operator import ServingConfig
+        from bobrapet_tpu.serving import engram
+
+        cfg, params = model
+        eng = ServingEngine(params, cfg, _pcfg(), dispatch_depth=2)
+        engram._LIVE_ENGINES.add(eng)
+        try:
+            engram.apply_tuning(ServingConfig(dispatch_depth=1))
+            assert eng.dispatch_depth == 1
+            engram.apply_tuning(ServingConfig(dispatch_depth=3))
+            assert eng.dispatch_depth == 3
+        finally:
+            engram._LIVE_ENGINES.discard(eng)
+            engram._TUNING = None
+
+    def test_apply_tuning_respects_pinned_depth(self, model):
+        """An EngramSpec that pins dispatchDepth keeps its value across
+        operator reloads of unrelated serving keys."""
+        from bobrapet_tpu.config.operator import ServingConfig
+        from bobrapet_tpu.serving import engram
+
+        cfg, params = model
+        eng = ServingEngine(params, cfg, _pcfg(), dispatch_depth=1)
+        eng._engram_pinned = frozenset({"dispatch_depth"})
+        engram._LIVE_ENGINES.add(eng)
+        try:
+            engram.apply_tuning(ServingConfig(dispatch_depth=4))
+            assert eng.dispatch_depth == 1  # pinned single-buffered
+        finally:
+            engram._LIVE_ENGINES.discard(eng)
+            engram._TUNING = None
