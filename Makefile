@@ -2,7 +2,7 @@
 
 NATIVE_SO  := native/libblobcache.so native/libstreamhub.so
 
-.PHONY: all native test test-e2e test-e2e-apiserver test-e2e-kind lint analyze bench clean crds chart image
+.PHONY: all native test test-e2e test-e2e-apiserver test-e2e-kind lint analyze race bench clean crds chart image
 
 all: native
 
@@ -29,10 +29,10 @@ test-fast: native
 # the target stays runnable in minimal environments
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
-		ruff check bobrapet_tpu tests bench.py __graft_entry__.py; \
+		ruff check bobrapet_tpu tests bench.py bench_race_overhead.py __graft_entry__.py; \
 	else \
 		echo "ruff not found; running compileall sweep"; \
-		python -m compileall -q bobrapet_tpu tests bench.py __graft_entry__.py; \
+		python -m compileall -q bobrapet_tpu tests bench.py bench_race_overhead.py __graft_entry__.py; \
 	fi
 
 # bobralint: repo-native invariant analyzer (docs/ANALYSIS.md). Fails
@@ -40,6 +40,18 @@ lint:
 # bobralint-baseline.json. Stdlib-only — runs in the lint CI job.
 analyze:
 	python -m bobrapet_tpu.analysis
+
+# bobrarace: lockset/happens-before data-race sanitizer over the
+# concurrency + chaos suites (docs/ANALYSIS.md "bobrarace"). The
+# sanitizer arms itself via autouse fixtures in these modules; any
+# race not suppressed (with justification) in bobrarace-baseline.json
+# fails the run, and STRICT_STALE makes dead suppressions fatal too.
+# Replay a failure deterministically with BOBRA_RACE_SEED=<seed>.
+race:
+	BOBRA_RACE_STRICT_STALE=1 python -m pytest \
+		tests/test_concurrency.py tests/test_dispatcher_concurrency.py \
+		tests/test_shard_e2e.py tests/test_fleet_chaos.py \
+		tests/test_traffic_chaos.py tests/test_racedetect.py -q
 
 bench: native
 	python bench.py

@@ -1,0 +1,59 @@
+"""Interleaved best-of-2 sanitizer-on vs off over the 1k-run soak shape.
+
+The bobrarace overhead measurement recorded in
+bobrapet_tpu/analysis/racedetect.py's module docstring — rerun after
+any change to the tracked-wrapper hot path and update those numbers.
+
+Run: JAX_PLATFORMS=cpu python bench_race_overhead.py
+"""
+import gc
+import os
+import sys
+import time
+
+os.environ.setdefault("BOBRA_SOAK", "1")
+# match the soak suite's _gc_posture fixture (manager GC posture) —
+# default thresholds thrash on the soak's live-object population
+gc.set_threshold(100_000, 50, 50)
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tests"))
+
+import test_scale_soak as soak  # noqa: E402
+
+from bobrapet_tpu.analysis.racedetect import sanitize_races  # noqa: E402
+
+N = soak.N_RUNS
+STEPS = soak.STEPS_PER_RUN
+
+
+def one_trial() -> float:
+    rt = soak._soak_rt()
+    t0 = time.perf_counter()
+    runs = [rt.run_story("soak", inputs={"i": i}) for i in range(N)]
+    soak.drain(rt)
+    wall = time.perf_counter() - t0
+    ok = sum(1 for r in runs if rt.run_phase(r) == "Succeeded")
+    assert ok == N, f"{ok}/{N} succeeded"
+    return N * STEPS / wall
+
+
+def main() -> None:
+    results = {"off": [], "on": []}
+    # interleave so box drift hits both arms equally; best-of-2 per arm
+    for trial in ("off", "on", "off", "on"):
+        if trial == "on":
+            with sanitize_races() as det:
+                sps = one_trial()
+            det.assert_clean()
+        else:
+            sps = one_trial()
+        results[trial].append(sps)
+        print(f"{trial}: {sps:.1f} steps/s", flush=True)
+    best_off = max(results["off"])
+    best_on = max(results["on"])
+    print(f"\nbest off: {best_off:.1f} steps/s")
+    print(f"best on:  {best_on:.1f} steps/s")
+    print(f"ratio on/off: {best_on / best_off:.3f}")
+
+
+if __name__ == "__main__":
+    main()

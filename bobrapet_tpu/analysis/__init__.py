@@ -20,18 +20,29 @@ THIS codebase already relies on (rather than generic style rules):
   ``observability/metrics.py`` and carry the ``bobrapet_*`` /
   ``bobravoz_*`` prefix;
 - **enum-literal-drift** — bare string literals that shadow
-  phase/exit-class/decision vocabulary must come from ``api/enums.py``.
+  phase/exit-class/decision vocabulary must come from ``api/enums.py``;
+- **shared-state-discipline** — container fields declared by
+  ``@guarded_state`` are only mutated under ``with self._lock:`` (or
+  from methods proven lock-context-only by a least fixed point over
+  the in-class call graph), and the declarations match the mutated
+  fields both ways. Its discovery pass IS the runtime race
+  sanitizer's instrumentation registry.
 
 Static findings are gated by a checked-in baseline
 (``bobralint-baseline.json``) whose every entry carries a mandatory
 justification — CI fails on any NEW violation, never on the audited
 backlog. Run ``python -m bobrapet_tpu.analysis`` or ``make analyze``.
 
-The runtime prong (:mod:`.lockorder`) instruments ``threading.Lock`` /
-``RLock`` during the concurrency/chaos suites, records the
-lock-acquisition-order graph, and fails the suite on acquisition-order
-cycles (potential deadlocks) — ThreadSanitizer's lock-order checking,
-scoped to this process model.
+The runtime prong has two sanitizers armed during the
+concurrency/chaos suites: :mod:`.lockorder` instruments
+``threading.Lock`` / ``RLock``, records the lock-acquisition-order
+graph and fails on acquisition-order cycles (potential deadlocks);
+:mod:`.racedetect` ("bobrarace") swaps the ``@guarded_state`` container
+fields for tracked wrappers and fails on conflicting access pairs
+unordered by happens-before with no common lock — hybrid
+lockset/vector-clock detection with seeded deterministic replay
+(:mod:`.schedules`), gated by ``bobrarace-baseline.json``. Run
+``make race``.
 
 Everything here is stdlib-only so the analyzer runs in the lint CI job
 without the compute-plane dependencies installed.

@@ -6,6 +6,7 @@ from .enum_literal_drift import EnumLiteralDriftChecker
 from .lock_blocking_io import LockBlockingIOChecker
 from .metrics_drift import MetricsDriftChecker
 from .serving_sync_points import ServingSyncPointsChecker
+from .shared_state_discipline import SharedStateDisciplineChecker
 
 ALL_CHECKERS = (
     LockBlockingIOChecker(),
@@ -14,6 +15,7 @@ ALL_CHECKERS = (
     MetricsDriftChecker(),
     EnumLiteralDriftChecker(),
     ServingSyncPointsChecker(),
+    SharedStateDisciplineChecker(),
 )
 
 __all__ = ["ALL_CHECKERS"]

@@ -1,0 +1,370 @@
+"""shared-state-discipline: lock-owning classes mutate shared
+containers only under their lock; @guarded_state declarations match.
+
+The static half of the bobrarace data-race sanitizer
+(:mod:`..racedetect`). Two coupled invariants:
+
+1. **lock discipline** — in any class that stores a
+   ``threading.Lock``/``RLock``/``Condition`` on ``self``, every
+   mutation of a container attribute initialized in ``__init__``
+   (``self.x[...] = / del / .append / .add / .update / += ...``) must
+   be lexically inside a ``with self.<lock_attr>:`` block. ``__init__``
+   itself is exempt (pre-publication, no concurrent reader exists yet).
+   PR-4-style same-file interprocedural reasoning applies, as a fixed
+   point over the class (the lock-blocking-io precedent): an unlocked
+   mutating helper is fine if EVERY ``self.helper(...)`` call site in
+   the class is lock-held or inside a method already proven
+   locked-only (the ``_index_add_locked`` convention, transitively —
+   ``_acquire_gang_locked`` -> ``_acquire_block_locked`` ->
+   ``_commit_block_locked`` chains resolve). A helper's recursive call
+   to itself inherits its own precondition, and a call site inside
+   ``__init__`` counts as protected (pre-publication). A helper with
+   no in-class call sites stays flagged, because nothing proves its
+   callers lock.
+2. **instrumentation drift** — a class decorated ``@guarded_state``
+   must declare exactly the container attributes this checker
+   discovers: a missing field means the runtime sanitizer silently
+   skips shared state; an unknown field means the declaration rotted.
+   ``discover_guarded`` is exported so tests/test_racedetect.py can
+   assert the runtime registry equals this discovery on the real tree
+   — the static view and the instrumentation cannot drift apart.
+
+Known static limits (the RUNTIME sanitizer covers these): cross-object
+mutations (``self.router.parked.add(...)`` from another class), calls
+that mutate through an argument (``heapq.heappush(self._timers, ...)``),
+and aliasing through locals. Subscripts are transparent in receiver
+chains, so ``self._buckets[k].discard(...)`` counts as a mutation of
+``_buckets`` — inner containers inherit the outer discipline.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, Optional, Sequence
+
+from ..core import AnalysisContext, Finding, ProjectFile, attr_chain, terminal_name
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_CONTAINER_FACTORIES = {
+    "dict", "list", "set", "frozenset", "deque", "defaultdict",
+    "OrderedDict", "Counter",
+}
+_CONTAINER_NODES = (
+    ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp, ast.SetComp,
+)
+_MUTATORS = {
+    # dict
+    "pop", "popitem", "clear", "update", "setdefault",
+    # list / deque
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "sort", "reverse", "rotate", "popleft",
+    # set
+    "add", "discard", "difference_update", "intersection_update",
+    "symmetric_difference_update",
+}
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    line: int
+    lock_attrs: set  #: attr names holding Lock/RLock/Condition
+    containers: dict  #: attr name -> __init__ assignment line
+    declared: Optional[tuple]  #: @guarded_state fields, None if undecorated
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.x`` (subscripts transparent) -> "x", else None."""
+    chain = attr_chain(node)
+    if chain and len(chain) == 2 and chain[0] == "self":
+        return chain[1]
+    return None
+
+
+def _guarded_decorator_fields(cls: ast.ClassDef) -> Optional[tuple]:
+    for deco in cls.decorator_list:
+        if isinstance(deco, ast.Call) and \
+                terminal_name(deco.func) == "guarded_state":
+            fields = []
+            for arg in deco.args:
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    fields.append(arg.value)
+            return tuple(fields)
+    return None
+
+
+def class_info(cls: ast.ClassDef) -> ClassInfo:
+    info = ClassInfo(name=cls.name, line=cls.lineno, lock_attrs=set(),
+                     containers={}, declared=_guarded_decorator_fields(cls))
+    init = next(
+        (n for n in cls.body
+         if isinstance(n, ast.FunctionDef) and n.name == "__init__"),
+        None,
+    )
+    if init is None:
+        return info
+    for node in ast.walk(init):
+        if isinstance(node, ast.AnnAssign):
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        else:
+            continue
+        if value is None:
+            continue
+        for target in targets:
+            if not isinstance(target, ast.Attribute):
+                continue
+            attr = _self_attr(target)
+            if attr is None:
+                continue
+            if isinstance(value, ast.Call) and \
+                    terminal_name(value.func) in _LOCK_FACTORIES:
+                info.lock_attrs.add(attr)
+            elif isinstance(value, _CONTAINER_NODES) or (
+                isinstance(value, ast.Call)
+                and terminal_name(value.func) in _CONTAINER_FACTORIES
+            ):
+                info.containers[attr] = node.lineno
+    return info
+
+
+def discover_guarded(files: Sequence[ProjectFile]) -> dict:
+    """(rel_path, class name) -> ClassInfo for every @guarded_state
+    class — the registry the runtime sanitizer must match."""
+    out = {}
+    for pf in files:
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.ClassDef):
+                info = class_info(node)
+                if info.declared is not None:
+                    out[(pf.rel, node.name)] = info
+    return out
+
+
+@dataclasses.dataclass
+class _Mutation:
+    attr: str
+    line: int
+    col: int
+    method: str
+    locked: bool
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Collect container mutations + self-method call sites within one
+    method body, tracking lexical ``with self.<lock>`` nesting. Nested
+    function definitions reset the locked flag: a closure built under a
+    lock may run long after the lock is gone."""
+
+    def __init__(self, info: ClassInfo, method: str):
+        self.info = info
+        self.method = method
+        self.locked = 0
+        self.mutations: list[_Mutation] = []
+        #: called method name -> [locked?] per call site
+        self.calls: dict[str, list[bool]] = {}
+
+    def _note(self, node: ast.AST, attr: Optional[str]) -> None:
+        if attr is not None and attr in self.info.containers:
+            self.mutations.append(_Mutation(
+                attr=attr, line=node.lineno, col=node.col_offset,
+                method=self.method, locked=self.locked > 0,
+            ))
+
+    def visit_With(self, node: ast.With) -> None:
+        guards = 0
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr is not None and attr in self.info.lock_attrs:
+                guards += 1
+        self.locked += guards
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.locked -= guards
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            if isinstance(target, ast.Subscript):
+                self._note(node, _self_attr(target.value))
+            elif isinstance(target, ast.Attribute):
+                self._note(node, _self_attr(target))
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            if isinstance(node.target, ast.Subscript):
+                self._note(node, _self_attr(node.target.value))
+            elif isinstance(node.target, ast.Attribute):
+                self._note(node, _self_attr(node.target))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if isinstance(node.target, ast.Subscript):
+            self._note(node, _self_attr(node.target.value))
+        elif isinstance(node.target, ast.Attribute):
+            self._note(node, _self_attr(node.target))
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            if isinstance(target, ast.Subscript):
+                self._note(node, _self_attr(target.value))
+            elif isinstance(target, ast.Attribute):
+                self._note(node, _self_attr(target))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            chain = attr_chain(node.func)
+            if chain and chain[0] == "self":
+                if len(chain) == 2:
+                    # self.helper(...) — interprocedural call site
+                    self.calls.setdefault(chain[1], []).append(
+                        self.locked > 0
+                    )
+                if len(chain) >= 3 and node.func.attr in _MUTATORS:
+                    # self.x.append(...) / self.x[k].discard(...)
+                    self._note(node, chain[1] if chain[1] in
+                               self.info.containers else None)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        saved, self.locked = self.locked, 0
+        self.generic_visit(node)
+        self.locked = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved, self.locked = self.locked, 0
+        self.generic_visit(node)
+        self.locked = saved
+
+
+class SharedStateDisciplineChecker:
+    name = "shared-state-discipline"
+    description = (
+        "lock-owning classes must mutate shared containers under their "
+        "lock; @guarded_state declarations must match discovered state"
+    )
+
+    def run(
+        self, files: Sequence[ProjectFile], ctx: AnalysisContext
+    ) -> Iterable[Finding]:
+        for pf in files:
+            for node in ast.walk(pf.tree):
+                if isinstance(node, ast.ClassDef):
+                    yield from self._check_class(pf, node)
+
+    def _check_class(
+        self, pf: ProjectFile, cls: ast.ClassDef
+    ) -> Iterable[Finding]:
+        info = class_info(cls)
+        if info.declared is not None:
+            yield from self._check_drift(pf, cls, info)
+        if not info.lock_attrs or not info.containers:
+            return
+
+        scans: list[_MethodScan] = []
+        #: callee -> [(caller method, call site lexically locked?)]
+        calls: dict[str, list[tuple[str, bool]]] = {}
+        for item in cls.body:
+            if not isinstance(item, ast.FunctionDef):
+                continue
+            scan = _MethodScan(info, item.name)
+            for stmt in item.body:
+                scan.visit(stmt)
+            if item.name != "__init__":
+                # __init__ mutations are exempt (pre-publication), but its
+                # call sites still feed the proof below — also as
+                # protected, for the same reason.
+                scans.append(scan)
+            for callee, sites in scan.calls.items():
+                for locked in sites:
+                    calls.setdefault(callee, []).append(
+                        (item.name, locked or item.name == "__init__")
+                    )
+
+        # Least fixed point of "locked-only" methods: M qualifies iff it
+        # has in-class call sites and every one is lexically lock-held,
+        # inside an already locked-only method, or a self-recursive call
+        # (which inherits M's own precondition). Starting empty and only
+        # adding is what makes a mutually-recursive cycle with no locked
+        # entry point stay flagged.
+        locked_only: set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for scan in scans:
+                m = scan.method
+                if m in locked_only:
+                    continue
+                sites = calls.get(m)
+                if sites and all(
+                    locked or caller in locked_only or caller == m
+                    for caller, locked in sites
+                ):
+                    locked_only.add(m)
+                    changed = True
+
+        locks = ", ".join(sorted(info.lock_attrs))
+        for scan in scans:
+            unprotected = [m for m in scan.mutations if not m.locked]
+            if not unprotected:
+                continue
+            if scan.method in locked_only:
+                # every in-class call chain reaching this helper holds the
+                # lock: a *_locked-style extraction, not an escape
+                continue
+            for m in unprotected:
+                yield Finding(
+                    checker=self.name,
+                    path=pf.rel,
+                    line=m.line,
+                    col=m.col,
+                    scope=f"{cls.name}.{m.method}",
+                    message=(
+                        f"mutation of shared container self.{m.attr} "
+                        f"outside any 'with self.<lock>' block (class "
+                        f"owns {locks}); runtime-verify with bobrarace "
+                        f"or move under the lock"
+                    ),
+                    kernel=f"{m.attr} mutated unlocked",
+                )
+
+    def _check_drift(
+        self, pf: ProjectFile, cls: ast.ClassDef, info: ClassInfo
+    ) -> Iterable[Finding]:
+        declared = set(info.declared or ())
+        discovered = set(info.containers)
+        for attr in sorted(discovered - declared):
+            yield Finding(
+                checker=self.name,
+                path=pf.rel,
+                line=info.containers[attr],
+                col=0,
+                scope=cls.name,
+                message=(
+                    f"@guarded_state on {cls.name} omits container "
+                    f"attribute self.{attr} — the race sanitizer will "
+                    f"not track it; declare it or it drifts"
+                ),
+                kernel=f"{attr} undeclared in guarded_state",
+            )
+        for attr in sorted(declared - discovered):
+            yield Finding(
+                checker=self.name,
+                path=pf.rel,
+                line=cls.lineno,
+                col=0,
+                scope=cls.name,
+                message=(
+                    f"@guarded_state on {cls.name} declares {attr!r} "
+                    f"but __init__ assigns no such container attribute"
+                ),
+                kernel=f"{attr} unknown in guarded_state",
+            )

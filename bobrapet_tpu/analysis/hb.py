@@ -1,0 +1,162 @@
+"""Happens-before machinery for the data-race sanitizer (racedetect).
+
+Pure data structures, no threads and no patching — so the race logic is
+unit-testable without spawning a single thread (tests/test_racedetect.py
+drives it with hand-built clocks):
+
+- :class:`VectorClock` — tid -> counter maps with ``join`` / ``advance``
+  (Lamport/Mattern vector time over the sanitizer's synthetic thread
+  ids, NOT OS idents — idents are recycled by the OS, synthetic tids
+  never are, so a dead thread's epochs cannot be confused with a new
+  thread's).
+- epochs — FastTrack's ``(tid, clock)`` pairs (Flanagan & Freund,
+  "FastTrack: Efficient and Precise Dynamic Race Detection"): the last
+  write to a variable is one epoch, not a whole vector, because a
+  race-free history needs only the MOST RECENT write ordered before the
+  current access.
+- :class:`VarState` — the per-variable detector state machine: a write
+  epoch, a read vector (FastTrack's promoted read state, kept simple as
+  a per-tid dict), and an Eraser-style candidate lockset (Savage et
+  al., "Eraser: A Dynamic Data Race Detector for Multithreaded
+  Programs"). An access pair is a race iff it is conflicting (at least
+  one write), unordered by the pure-sync happens-before clocks, AND the
+  two accesses share no common lock.
+
+The hybrid detection rule (lockset AND clocks, like ThreadSanitizer
+v1's hybrid mode) is deliberate: building HB edges out of every mutex
+release->acquire (pure FastTrack) makes detection timing-dependent —
+ambient lock traffic between two racy accesses accidentally orders
+them and the race is only caught 1-run-in-N. With the lockset clause
+carrying mutex reasoning, a consistently-locked variable never reports
+regardless of timing, and an unlocked access pair reports whenever the
+two threads both touch it, ordered or not — unless a real fork/join /
+Future / Condition / queue handoff ordered them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+#: a FastTrack epoch: (synthetic tid, that thread's clock at the access)
+Epoch = tuple[int, int]
+
+
+class VectorClock(dict):
+    """tid -> counter. A plain dict subclass: missing tids read as 0."""
+
+    __slots__ = ()
+
+    def time_of(self, tid: int) -> int:
+        return self.get(tid, 0)
+
+    def advance(self, tid: int) -> None:
+        self[tid] = self.get(tid, 0) + 1
+
+    def join(self, other: Optional[dict]) -> None:
+        """Pointwise max, in place. ``None`` joins as the zero clock."""
+        if not other:
+            return
+        for tid, c in other.items():
+            if c > self.get(tid, 0):
+                self[tid] = c
+
+    def snapshot(self) -> dict:
+        """Immutable-by-convention copy for publishing into shared maps
+        (lock-release clocks, condition clocks, queue clocks). Publishers
+        never mutate a snapshot after handing it out."""
+        return dict(self)
+
+    def leq(self, other: dict) -> bool:
+        return all(c <= other.get(tid, 0) for tid, c in self.items())
+
+
+def epoch_leq(epoch: Optional[Epoch], vc: dict) -> bool:
+    """``e ⊑ VC`` — the access the epoch stamps happened-before a thread
+    whose clock is ``vc``. A missing epoch (no prior access) is ⊑ all."""
+    if epoch is None:
+        return True
+    tid, c = epoch
+    return c <= vc.get(tid, 0)
+
+
+@dataclasses.dataclass
+class AccessCheck:
+    """Outcome of one :meth:`VarState.on_access`.
+
+    ``conflicts`` holds the caller-supplied tokens (access records) of
+    every prior conflicting access NOT ordered before the current one by
+    the sync-only happens-before relation. ``common_locks`` is the
+    non-empty lock intersection that excused those conflicts, if any —
+    so ``conflicts and not common_locks`` is the race condition, and a
+    suppressed pair still surfaces in the variable's lockset history.
+    """
+
+    conflicts: list
+    common_locks: frozenset
+
+    @property
+    def is_race(self) -> bool:
+        return bool(self.conflicts) and not self.common_locks
+
+
+class VarState:
+    """FastTrack-style last-access state + Eraser candidate lockset for
+    ONE shared variable. Callers pass an opaque ``token`` per access
+    (racedetect passes a stack/thread/lockset record) that comes back in
+    :class:`AccessCheck.conflicts` for reporting."""
+
+    __slots__ = ("write_epoch", "write_token", "read_epochs", "read_tokens",
+                 "lockset")
+
+    def __init__(self) -> None:
+        self.write_epoch: Optional[Epoch] = None
+        self.write_token: Any = None
+        #: tid -> clock of that thread's last read since the last write
+        self.read_epochs: dict[int, int] = {}
+        self.read_tokens: dict[int, Any] = {}
+        #: Eraser candidate lockset: locks held on EVERY access of the
+        #: current concurrent phase; None = virgin (no access yet)
+        self.lockset: Optional[frozenset] = None
+
+    def on_access(
+        self,
+        tid: int,
+        vc: dict,
+        lockset: frozenset,
+        is_write: bool,
+        token: Any = None,
+    ) -> AccessCheck:
+        conflicts: list = []
+        if not epoch_leq(self.write_epoch, vc):
+            conflicts.append(self.write_token)
+        if is_write:
+            for rt, rc in self.read_epochs.items():
+                if rt != tid and rc > vc.get(rt, 0):
+                    conflicts.append(self.read_tokens[rt])
+
+        common: frozenset = frozenset()
+        if conflicts:
+            # unordered conflicting accesses: Eraser refinement decides
+            refined = (self.lockset if self.lockset is not None
+                       else lockset) & lockset
+            self.lockset = refined
+            common = refined
+        else:
+            # every prior conflicting access happens-before this one (or
+            # there was none): a new exclusive phase begins — re-arm the
+            # candidate lockset so a clean handoff chain (fork/join,
+            # future, queue) doesn't inherit a drained lockset from the
+            # previous owner's unlocked accesses.
+            self.lockset = frozenset(lockset)
+
+        # FastTrack state update
+        if is_write:
+            self.write_epoch = (tid, vc.get(tid, 0))
+            self.write_token = token
+            self.read_epochs.clear()
+            self.read_tokens.clear()
+        else:
+            self.read_epochs[tid] = vc.get(tid, 0)
+            self.read_tokens[tid] = token
+        return AccessCheck(conflicts=conflicts, common_locks=common)

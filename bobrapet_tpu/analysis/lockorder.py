@@ -75,6 +75,23 @@ class LockMonitor:
         self.max_hold: dict[str, float] = {}
         #: (label, seconds) for holds beyond budget
         self.hold_violations: list[tuple[str, float]] = []
+        #: downstream consumers of lock events (racedetect attaches
+        #: here to build happens-before edges + per-thread locksets
+        #: without double-patching threading.Lock). A listener sees
+        #: ``lock_acquired(lock, label)`` after a real (non-reentrant)
+        #: acquisition and ``lock_released(lock, label)`` just BEFORE
+        #: the real release — so a release-clock snapshot is taken
+        #: while the lock is still held (correct release->acquire HB
+        #: ordering).
+        self.listeners: list = []
+
+    def add_listener(self, listener) -> None:
+        if listener not in self.listeners:
+            self.listeners.append(listener)
+
+    def remove_listener(self, listener) -> None:
+        if listener in self.listeners:
+            self.listeners.remove(listener)
 
     # -- per-thread stack --------------------------------------------------
 
@@ -83,6 +100,11 @@ class LockMonitor:
         if st is None:
             st = self._tls.stack = []
         return st
+
+    def held(self) -> list[tuple[object, str]]:
+        """(lock, label) pairs the CURRENT thread holds, bottom-up.
+        Reads only this thread's stack — safe without a lock."""
+        return [(entry[0], entry[1]) for entry in self._stack()]
 
     def on_acquired(self, lock: "_SanitizedLockBase", count: int = 1) -> None:
         if not self.enabled:
@@ -98,6 +120,8 @@ class LockMonitor:
                 key = (top[1], lock.label)
                 self.edges[key] = self.edges.get(key, 0) + 1
         stack.append([lock, lock.label, time.monotonic(), count])
+        for listener in self.listeners:
+            listener.lock_acquired(lock, lock.label)
 
     def on_released(self, lock: "_SanitizedLockBase") -> None:
         if not self.enabled:
@@ -112,6 +136,8 @@ class LockMonitor:
                 held = time.monotonic() - stack[i][2]
                 del stack[i]
                 self._note_hold(lock.label, held)
+                for listener in self.listeners:
+                    listener.lock_released(lock, lock.label)
                 return
 
     def on_wait_release(self, lock: "_SanitizedLockBase") -> None:
@@ -124,6 +150,8 @@ class LockMonitor:
                 held = time.monotonic() - stack[i][2]
                 del stack[i]
                 self._note_hold(lock.label, held)
+                for listener in self.listeners:
+                    listener.lock_released(lock, lock.label)
                 return
 
     def _note_hold(self, label: str, held: float) -> None:
@@ -306,6 +334,16 @@ def _creation_label() -> Optional[str]:
     return None
 
 
+#: the monitor of the innermost active :func:`sanitize_locks` session,
+#: so cooperating instrumentation (racedetect) can attach listeners to
+#: an already-armed session instead of re-patching threading.Lock.
+_CURRENT_MONITOR: Optional[LockMonitor] = None
+
+
+def current_monitor() -> Optional[LockMonitor]:
+    return _CURRENT_MONITOR
+
+
 @contextlib.contextmanager
 def sanitize_locks(
     hold_budget: Optional[float] = None,
@@ -313,6 +351,7 @@ def sanitize_locks(
     """Patch ``threading.Lock``/``RLock`` for the duration; locks repo
     code creates inside the session are instrumented and keep working
     (recording stops) after the session ends."""
+    global _CURRENT_MONITOR
     mon = LockMonitor(hold_budget=hold_budget)
     real_lock = threading.Lock
     real_rlock = threading.RLock
@@ -329,9 +368,12 @@ def sanitize_locks(
 
     threading.Lock = make_lock  # type: ignore[assignment]
     threading.RLock = make_rlock  # type: ignore[assignment]
+    prev_monitor = _CURRENT_MONITOR
+    _CURRENT_MONITOR = mon
     try:
         yield mon
     finally:
+        _CURRENT_MONITOR = prev_monitor
         threading.Lock = real_lock  # type: ignore[assignment]
         threading.RLock = real_rlock  # type: ignore[assignment]
         mon.enabled = False

@@ -1,0 +1,887 @@
+"""bobrarace: a test-time data-race sanitizer for the control plane.
+
+The repo's cross-shard correctness bugs (the PR-6 starved-heartbeat
+double-reconcile, the stale-scope lost-work race fixed in PR 12, the
+PR-11 churn flake) were all found by *probabilistic* churn soaks. The
+reference operator leans on Go's ``-race`` detector for this class;
+this module is the Python-process-model equivalent, layered on the
+PR-4 lock-order sanitizer:
+
+- **what is watched** — the hot shared containers declared via the
+  :func:`guarded_state` class decorator (store indexes and watch
+  registries, dispatcher active/dirty sets and worker deques, shard
+  membership/parked roots, serving and traffic queues) are swapped for
+  ``TrackedDict``/``TrackedList``/``TrackedSet``/``TrackedDeque``
+  wrappers **at test time only**: the decorator records the field list
+  in :data:`GUARDED_REGISTRY` and returns the class untouched, so
+  production builds carry zero overhead; :func:`sanitize_races`
+  patches the registered ``__init__``\\ s for the session the same way
+  lockorder patches ``threading.Lock``. Ad-hoc containers born inside
+  methods opt in with :func:`track`.
+- **how a race is decided** — each access grabs the thread's lockset
+  from the lockorder monitor (allocation-site lock classes + instance
+  ids) and its vector clock (:mod:`.hb`); clocks gain edges from
+  ``Thread.start``/``join``, ``Future.set_result``→``result``,
+  ``Condition.notify``→``wait``, ``Event.set``→``wait``/``is_set``,
+  ``queue.Queue.put``→``get``, ``ThreadPoolExecutor.submit``→run, and
+  (in ``mode="hb"``) lock release→acquire. The default ``"hybrid"``
+  mode keeps mutex reasoning in the Eraser lockset clause instead —
+  see :mod:`.hb` for why that makes detection far less
+  timing-dependent than pure FastTrack.
+- **how a race is reported** — both access stacks, both locksets, and
+  the variable's lockset history, with a line-number-free fingerprint
+  (variable + the two access sites' file:function + op pair) gated by
+  ``bobrarace-baseline.json`` at the repo root: same contract as
+  bobralint (mandatory justifications, stale-entry reporting).
+- **replay** — a seeded schedule from :mod:`.schedules` can be armed
+  per detector (:meth:`RaceDetector.scoped_schedule`) to inject
+  deterministic yield points at every instrumented access, so a churn
+  flake reproduces from its seed.
+
+Overhead (measured on tests/test_scale_soak.py's 1k-run soak shape,
+BOBRA_SOAK=1, interleaved best-of-2 per PR-13 profiler style, soak GC
+posture): sanitizer-on runs at **0.092x** the sanitizer-off steps/s
+(28.8 vs 314.4 steps/s on the measurement box, ~10.9x slowdown; the
+second trial pair repeated within 3%). Every store access crosses a
+tracked wrapper on that soak, so this is the worst case — the armed
+concurrency/chaos suites are wait-dominated and absorb it (fleet
+chaos: 87s armed), which is exactly why the autouse fixtures scope
+arming to those five modules and tier-1 at large runs untracked.
+Rerun with ``python bench_race_overhead.py`` after touching the
+wrapper hot path.
+
+Static companion: the ``shared-state-discipline`` bobralint checker
+walks lock-owning classes for container mutations outside ``with
+self._lock`` and cross-checks every ``@guarded_state`` field list
+against the containers it discovers, so the runtime instrumentation
+and the static view cannot drift (tests/test_racedetect.py asserts
+registry == discovery).
+"""
+
+from __future__ import annotations
+
+import _thread
+import contextlib
+import dataclasses
+import functools
+import hashlib
+import os
+import queue as queue_mod
+import sys
+import threading
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Iterator, Optional
+
+from . import lockorder
+from .baseline import Baseline
+from .hb import VarState, VectorClock
+
+RACE_BASELINE_NAME = "bobrarace-baseline.json"
+
+_THIS_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(os.path.dirname(_THIS_DIR))
+_TEST_PART = f"{os.sep}tests{os.sep}"
+_REPO_PARTS = (f"{os.sep}bobrapet_tpu{os.sep}", _TEST_PART)
+
+#: the active detector, or None — product-code helpers (:func:`track`)
+#: and the patched ``__init__``\\ s read this; a single global load when
+#: the sanitizer is off.
+_ACTIVE: Optional["RaceDetector"] = None
+
+#: classes declared with :func:`guarded_state`: class -> field tuple.
+#: Populated at import time (decoration), consumed at session arm time.
+GUARDED_REGISTRY: dict[type, tuple[str, ...]] = {}
+
+
+class RaceViolation(AssertionError):
+    """An unsuppressed data race was observed (or a baseline went stale
+    in strict mode)."""
+
+
+def default_baseline_path() -> str:
+    return os.path.join(_REPO_ROOT, RACE_BASELINE_NAME)
+
+
+# ---------------------------------------------------------------------------
+# declaration API (importable from product code, zero overhead when off)
+# ---------------------------------------------------------------------------
+
+
+def guarded_state(*fields: str):
+    """Class decorator declaring which container attributes carry the
+    class's cross-thread shared state. Purely declarative in
+    production: it records ``(cls, fields)`` in :data:`GUARDED_REGISTRY`
+    and returns the class unchanged. Inside a :func:`sanitize_races`
+    session the declared fields are wrapped in tracked containers right
+    after ``__init__`` returns.
+
+    The field list is NOT free-form: the ``shared-state-discipline``
+    checker recomputes the class's container attributes statically and
+    flags any drift between that discovery and this declaration."""
+
+    def deco(cls: type) -> type:
+        GUARDED_REGISTRY[cls] = tuple(fields)
+        cls.__guarded_fields__ = tuple(fields)
+        return cls
+
+    return deco
+
+
+def track(label: str, container):
+    """Opt a method-local / lazily-created container into tracking
+    (e.g. the store's scheduling-gate reservation map, which is born
+    outside ``__init__``). Returns the container unchanged when no
+    sanitizer session is active."""
+    det = _ACTIVE
+    if det is None or not det.enabled:
+        return container
+    return det.wrap(label, container)
+
+
+# ---------------------------------------------------------------------------
+# access records + reports
+# ---------------------------------------------------------------------------
+
+
+def _capture_site(limit: int = 5) -> tuple:
+    """Innermost repo frames (file, line, function) of the current
+    access, skipping the sanitizer's own machinery."""
+    frames = []
+    f = sys._getframe(2)
+    while f is not None and len(frames) < limit:
+        fn = f.f_code.co_filename
+        if any(p in fn for p in _REPO_PARTS) and not fn.startswith(_THIS_DIR):
+            try:
+                rel = os.path.relpath(fn, _REPO_ROOT).replace(os.sep, "/")
+            except ValueError:  # pragma: no cover - other-drive paths
+                rel = fn
+            frames.append((rel, f.f_lineno, f.f_code.co_name))
+        f = f.f_back
+    return tuple(frames)
+
+
+@dataclasses.dataclass(frozen=True)
+class AccessRecord:
+    op: str  #: "read" | "write"
+    thread: str
+    site: tuple  #: ((rel_path, line, function), ...) innermost first
+    lockset: frozenset
+
+    @property
+    def in_tests(self) -> bool:
+        return bool(self.site) and self.site[0][0].startswith("tests/")
+
+    def site_key(self) -> str:
+        """Line-number-free identity of this access for fingerprints."""
+        if not self.site:
+            return f"{self.op}@?"
+        path, _line, func = self.site[0]
+        return f"{self.op}@{path}:{func}"
+
+    def render(self) -> str:
+        locks = ", ".join(sorted(self.lockset)) or "NO LOCKS"
+        head = f"{self.op} by thread {self.thread!r} holding [{locks}]"
+        body = "".join(
+            f"\n      at {path}:{line} in {func}"
+            for path, line, func in self.site
+        ) or "\n      at <no repo frames>"
+        return head + body
+
+
+@dataclasses.dataclass
+class RaceReport:
+    """One unordered, unlocked conflicting access pair. Duck-compatible
+    with bobralint's Finding where the Baseline machinery needs it
+    (``fingerprint``/``checker``/``path``/``scope``/``message``)."""
+
+    var: str
+    a: AccessRecord  #: the earlier access
+    b: AccessRecord  #: the access that exposed the race
+    lockset_history: tuple
+    count: int = 1
+
+    checker: str = "bobrarace"
+
+    @property
+    def path(self) -> str:
+        return self.b.site[0][0] if self.b.site else "?"
+
+    @property
+    def scope(self) -> str:
+        return self.var
+
+    @property
+    def message(self) -> str:
+        return (f"data race on {self.var}: {self.a.site_key()} vs "
+                f"{self.b.site_key()}")
+
+    @property
+    def fingerprint(self) -> str:
+        ka, kb = sorted((self.a.site_key(), self.b.site_key()))
+        raw = f"bobrarace|{self.var}|{ka}|{kb}"
+        return hashlib.sha256(raw.encode()).hexdigest()[:12]
+
+    def render(self) -> str:
+        lines = [
+            f"DATA RACE on {self.var} ({self.fingerprint}, "
+            f"seen {self.count}x):",
+            f"  prior {self.a.render()}",
+            f"  now   {self.b.render()}",
+        ]
+        if self.lockset_history:
+            lines.append("  lockset history (most recent last):")
+            lines.extend(f"    {h}" for h in self.lockset_history)
+        return "\n".join(lines)
+
+
+class _VarMeta:
+    """Per-tracked-container detector state."""
+
+    __slots__ = ("label", "state", "history", "prev_locks", "det")
+
+    def __init__(self, label: str, det: "RaceDetector"):
+        self.label = label
+        self.state = VarState()
+        self.history: deque = deque(maxlen=8)
+        self.prev_locks: Optional[frozenset] = None
+        self.det = det
+
+
+# ---------------------------------------------------------------------------
+# tracked containers
+# ---------------------------------------------------------------------------
+
+
+def _hooked(base: type, name: str, is_write: bool):
+    orig = getattr(base, name)
+
+    def method(self, *args, **kwargs):
+        meta = self._rd_meta
+        if meta is not None:
+            meta.det.on_access(meta, is_write)
+        return orig(self, *args, **kwargs)
+
+    method.__name__ = name
+    method.__qualname__ = name
+    return method
+
+
+def _make_tracked(base: type, name: str, writes: tuple, reads: tuple) -> type:
+    ns: dict[str, Any] = {"_rd_meta": None}
+    for m in writes:
+        ns[m] = _hooked(base, m, True)
+    for m in reads:
+        ns[m] = _hooked(base, m, False)
+    return type(name, (base,), ns)
+
+
+TrackedDict = _make_tracked(
+    dict, "TrackedDict",
+    writes=("__setitem__", "__delitem__", "pop", "popitem", "clear",
+            "update", "setdefault"),
+    reads=("__getitem__", "__contains__", "__iter__", "__len__", "get",
+           "keys", "values", "items", "copy"),
+)
+
+TrackedList = _make_tracked(
+    list, "TrackedList",
+    writes=("__setitem__", "__delitem__", "__iadd__", "append", "extend",
+            "insert", "pop", "remove", "clear", "sort", "reverse"),
+    reads=("__getitem__", "__contains__", "__iter__", "__len__", "index",
+           "count", "copy"),
+)
+
+TrackedSet = _make_tracked(
+    set, "TrackedSet",
+    writes=("add", "discard", "remove", "pop", "clear", "update",
+            "difference_update", "intersection_update",
+            "symmetric_difference_update"),
+    reads=("__contains__", "__iter__", "__len__", "copy", "issubset",
+           "issuperset", "union", "intersection", "difference"),
+)
+
+TrackedDeque = _make_tracked(
+    deque, "TrackedDeque",
+    writes=("__setitem__", "__delitem__", "append", "appendleft", "extend",
+            "extendleft", "insert", "pop", "popleft", "remove", "clear",
+            "rotate"),
+    reads=("__getitem__", "__contains__", "__iter__", "__len__", "count",
+           "index", "copy"),
+)
+
+_TRACKED_TYPES = (TrackedDict, TrackedList, TrackedSet, TrackedDeque)
+
+
+# ---------------------------------------------------------------------------
+# the detector
+# ---------------------------------------------------------------------------
+
+
+class _ThreadState:
+    __slots__ = ("tid", "vc")
+
+    def __init__(self, tid: int):
+        self.tid = tid
+        self.vc = VectorClock()
+        self.vc[tid] = 1
+
+
+class RaceDetector:
+    """One sanitizer session's state: per-thread clocks, per-variable
+    FastTrack/Eraser states, the race report ledger, and the patch
+    bookkeeping. Internal synchronization uses ``_thread.allocate_lock``
+    directly so the detector's own lock is invisible to the lockorder
+    patches and to itself."""
+
+    def __init__(
+        self,
+        monitor: Optional[lockorder.LockMonitor] = None,
+        mode: Optional[str] = None,
+        schedule=None,
+        include_tests: bool = False,
+    ):
+        if mode is None:
+            mode = os.environ.get("BOBRA_RACE_MODE", "hybrid")
+        if mode not in ("hybrid", "hb"):
+            raise ValueError(f"unknown race mode {mode!r}")
+        self.mode = mode
+        self.enabled = True
+        self.monitor = monitor
+        self.schedule = schedule
+        #: report races with a tests/-frame side? Default no: tests
+        #: poll product state unlocked by design (wait_for loops); the
+        #: clocks still advance through those accesses, but only
+        #: product<->product unordered pairs gate. The known-bad corpus
+        #: (whose racy bodies live in test files) flips this on.
+        self.include_tests = include_tests
+        self._lock = _thread.allocate_lock()
+        self._tls = threading.local()
+        self._next_tid = 0
+        self.access_count = 0
+        #: fingerprint -> RaceReport (deduped)
+        self._reports: dict[str, RaceReport] = {}
+        #: product-suppressed observations (a tests/-frame side), kept
+        #: for debugging/triage visibility
+        self.observer_races: list[RaceReport] = []
+        #: id(lock) -> stable per-instance index for lockset identity
+        self._lock_seq: dict[int, int] = {}
+        #: id(lock) -> release-clock snapshot (mode="hb" only)
+        self._release_clocks: dict[int, dict] = {}
+        self._patches: list[tuple[Any, str, Any]] = []
+        self._patched_inits: list[tuple[type, Any]] = []
+        self.tracked_labels: list[str] = []
+
+    # -- thread clocks -----------------------------------------------------
+
+    def _thread_state(self) -> _ThreadState:
+        st = getattr(self._tls, "st", None)
+        if st is None:
+            with self._lock:
+                self._next_tid += 1
+                tid = self._next_tid
+            st = self._tls.st = _ThreadState(tid)
+            birth = getattr(threading.current_thread(), "_rd_birth", None)
+            if birth:
+                st.vc.join(birth)
+        return st
+
+    def _publish(self) -> dict:
+        """Snapshot the current thread's clock and advance it: the
+        sender half of every HB edge."""
+        st = self._thread_state()
+        snap = st.vc.snapshot()
+        st.vc.advance(st.tid)
+        return snap
+
+    def _join(self, snap: Optional[dict]) -> None:
+        if snap:
+            self._thread_state().vc.join(snap)
+
+    def _merge_shared(self, obj, attr: str = "_rd_clock") -> None:
+        """Publish into a clock slot on a shared object (condition,
+        event, future), joining with whatever is already there."""
+        snap = self._publish()
+        with self._lock:
+            cur = getattr(obj, attr, None)
+            if cur is None:
+                try:
+                    setattr(obj, attr, dict(snap))
+                except AttributeError:  # pragma: no cover - slotted obj
+                    pass
+            else:
+                for t, c in snap.items():
+                    if c > cur.get(t, 0):
+                        cur[t] = c
+
+    def _join_shared(self, obj, attr: str = "_rd_clock") -> None:
+        with self._lock:
+            cur = getattr(obj, attr, None)
+            snap = dict(cur) if cur else None
+        self._join(snap)
+
+    # -- lockset -----------------------------------------------------------
+
+    def _lockset(self) -> frozenset:
+        mon = self.monitor
+        if mon is None:
+            return frozenset()
+        out = []
+        for lock, label in mon.held():
+            key = id(lock)
+            with self._lock:
+                idx = self._lock_seq.get(key)
+                if idx is None:
+                    idx = self._lock_seq[key] = len(self._lock_seq) + 1
+            out.append(f"{label}#{idx}")
+        return frozenset(out)
+
+    # -- lockorder listener hooks (mode="hb" lock HB edges) ----------------
+
+    def lock_acquired(self, lock, label: str) -> None:
+        if self.mode != "hb" or not self.enabled:
+            return
+        with self._lock:
+            snap = self._release_clocks.get(id(lock))
+            snap = dict(snap) if snap else None
+        self._join(snap)
+
+    def lock_released(self, lock, label: str) -> None:
+        if self.mode != "hb" or not self.enabled:
+            return
+        snap = self._publish()
+        with self._lock:
+            cur = self._release_clocks.get(id(lock))
+            if cur is None:
+                self._release_clocks[id(lock)] = dict(snap)
+            else:
+                for t, c in snap.items():
+                    if c > cur.get(t, 0):
+                        cur[t] = c
+
+    # -- instrumentation ---------------------------------------------------
+
+    def wrap(self, label: str, container):
+        if isinstance(container, _TRACKED_TYPES):
+            return container
+        t = type(container)
+        if t is dict:
+            wrapped = TrackedDict(container)
+        elif t is list:
+            wrapped = TrackedList(container)
+        elif t is set:
+            wrapped = TrackedSet(container)
+        elif t is deque:
+            wrapped = TrackedDeque(container, container.maxlen)
+        else:
+            return container
+        wrapped._rd_meta = _VarMeta(label, self)
+        with self._lock:
+            self.tracked_labels.append(label)
+        return wrapped
+
+    def instrument(self, obj, cls: type, fields: tuple) -> None:
+        if getattr(obj, "_rd_instrumented", False):
+            return
+        try:
+            obj._rd_instrumented = True
+        except AttributeError:  # pragma: no cover - __slots__ class
+            return
+        for field in fields:
+            val = getattr(obj, field, None)
+            wrapped = self.wrap(f"{cls.__name__}.{field}", val)
+            if wrapped is not val:
+                setattr(obj, field, wrapped)
+
+    # -- the access check --------------------------------------------------
+
+    def on_access(self, meta: _VarMeta, is_write: bool) -> None:
+        if not self.enabled:
+            return
+        tls = self._tls
+        if getattr(tls, "busy", False):
+            return
+        sched = self.schedule
+        tls.busy = True
+        try:
+            st = self._thread_state()
+            ls = self._lockset()
+            rec = AccessRecord(
+                op="write" if is_write else "read",
+                thread=threading.current_thread().name,
+                site=_capture_site(),
+                lockset=ls,
+            )
+            with self._lock:
+                self.access_count += 1
+                if ls != meta.prev_locks:
+                    meta.prev_locks = ls
+                    meta.history.append(
+                        f"{rec.op} by {rec.thread} holding "
+                        f"[{', '.join(sorted(ls)) or 'nothing'}]"
+                    )
+                chk = meta.state.on_access(st.tid, st.vc, ls, is_write, rec)
+                if chk.conflicts and chk.common_locks:
+                    meta.history.append(
+                        f"unordered {rec.op} by {rec.thread} excused by "
+                        f"common [{', '.join(sorted(chk.common_locks))}]"
+                    )
+                elif chk.conflicts:
+                    for prior in chk.conflicts:
+                        if prior is not None:
+                            self._record_race_locked(meta, prior, rec)
+        finally:
+            tls.busy = False
+        if sched is not None:
+            sched.on_access(meta.label)
+
+    def _record_race_locked(self, meta: _VarMeta, prior: AccessRecord,
+                            rec: AccessRecord) -> None:
+        report = RaceReport(
+            var=meta.label, a=prior, b=rec,
+            lockset_history=tuple(meta.history),
+        )
+        if not self.include_tests and (prior.in_tests or rec.in_tests):
+            if len(self.observer_races) < 100:
+                self.observer_races.append(report)
+            return
+        existing = self._reports.get(report.fingerprint)
+        if existing is not None:
+            existing.count += 1
+        else:
+            self._reports[report.fingerprint] = report
+
+    # -- results -----------------------------------------------------------
+
+    @property
+    def reports(self) -> list[RaceReport]:
+        return sorted(self._reports.values(),
+                      key=lambda r: (r.var, r.fingerprint))
+
+    def report_text(self) -> str:
+        parts = [r.render() for r in self.reports]
+        parts.append(
+            f"bobrarace: {len(self._reports)} distinct race(s) over "
+            f"{self.access_count} tracked accesses, "
+            f"{len(self.tracked_labels)} tracked containers"
+        )
+        return "\n".join(parts)
+
+    def assert_clean(
+        self,
+        baseline_path: Optional[str] = None,
+        strict_stale: Optional[bool] = None,
+    ) -> None:
+        """Gate against ``bobrarace-baseline.json``: raise on any race
+        whose fingerprint is not suppressed there; report (or, strict,
+        raise on) suppressions no longer observed this session is NOT
+        stale — stale means the fingerprint never fires across the armed
+        suites, which ``make race`` checks in aggregate via
+        BOBRA_RACE_STRICT_STALE."""
+        if strict_stale is None:
+            strict_stale = os.environ.get(
+                "BOBRA_RACE_STRICT_STALE", ""
+            ) not in ("", "0", "false")
+        baseline = Baseline.load(baseline_path or default_baseline_path())
+        new, suppressed, stale = baseline.partition(self.reports)
+        if stale and strict_stale:
+            lines = [
+                f"stale: {s.fingerprint} ({s.scope}): {s.message}"
+                for s in stale
+            ]
+            raise RaceViolation(
+                "bobrarace baseline has stale suppressions (fixed races "
+                "whose entries must be deleted):\n" + "\n".join(lines)
+            )
+        if new:
+            raise RaceViolation(
+                "\n".join(r.render() for r in new)
+                + f"\n{len(new)} unsuppressed data race(s); "
+                f"{len(suppressed)} baseline-suppressed. Fix the race or "
+                f"justify it in {RACE_BASELINE_NAME}."
+            )
+
+    @contextlib.contextmanager
+    def scoped_schedule(self, sched) -> Iterator:
+        """Arm a replay schedule for a code region (e.g. one churn
+        soak): every tracked access becomes a seeded yield point."""
+        prev = self.schedule
+        self.schedule = sched
+        try:
+            yield sched
+        finally:
+            self.schedule = prev
+
+    # -- patching ----------------------------------------------------------
+
+    def _patch(self, obj, name: str, wrapper_factory: Callable) -> None:
+        orig = getattr(obj, name)
+        setattr(obj, name, wrapper_factory(orig))
+        self._patches.append((obj, name, orig))
+
+    def _arm_patches(self) -> None:
+        det = self
+
+        def wrap_start(orig):
+            def start(thr):
+                if det.enabled:
+                    thr._rd_birth = det._publish()
+                    det._wrap_run(thr)
+                return orig(thr)
+            return start
+
+        def wrap_join(orig):
+            def join(thr, timeout=None):
+                r = orig(thr, timeout)
+                if det.enabled and not thr.is_alive():
+                    det._join(getattr(thr, "_rd_final", None))
+                return r
+            return join
+
+        def wrap_is_alive(orig):
+            def is_alive(thr):
+                r = orig(thr)
+                if det.enabled and not r:
+                    det._join(getattr(thr, "_rd_final", None))
+                return r
+            return is_alive
+
+        self._patch(threading.Thread, "start", wrap_start)
+        self._patch(threading.Thread, "join", wrap_join)
+        self._patch(threading.Thread, "is_alive", wrap_is_alive)
+
+        def wrap_notify(orig):
+            def notify(cond, n=1):
+                if det.enabled:
+                    det._merge_shared(cond)
+                return orig(cond, n)
+            return notify
+
+        def wrap_notify_all(orig):
+            def notify_all(cond):
+                if det.enabled:
+                    det._merge_shared(cond)
+                return orig(cond)
+            return notify_all
+
+        def wrap_wait(orig):
+            def wait(cond, timeout=None):
+                r = orig(cond, timeout)
+                if det.enabled:
+                    det._join_shared(cond)
+                return r
+            return wait
+
+        self._patch(threading.Condition, "notify", wrap_notify)
+        self._patch(threading.Condition, "notify_all", wrap_notify_all)
+        self._patch(threading.Condition, "wait", wrap_wait)
+
+        def wrap_event_set(orig):
+            def set_(ev):
+                if det.enabled:
+                    det._merge_shared(ev)
+                return orig(ev)
+            return set_
+
+        def wrap_event_wait(orig):
+            def wait(ev, timeout=None):
+                r = orig(ev, timeout)
+                if det.enabled and r:
+                    det._join_shared(ev)
+                return r
+            return wait
+
+        def wrap_event_is_set(orig):
+            def is_set(ev):
+                r = orig(ev)
+                if det.enabled and r:
+                    det._join_shared(ev)
+                return r
+            return is_set
+
+        self._patch(threading.Event, "set", wrap_event_set)
+        self._patch(threading.Event, "wait", wrap_event_wait)
+        self._patch(threading.Event, "is_set", wrap_event_is_set)
+
+        def wrap_put(orig):
+            def put(q, item, block=True, timeout=None):
+                r = orig(q, item, block, timeout)
+                if det.enabled:
+                    snap = det._publish()
+                    with det._lock:
+                        clocks = getattr(q, "_rd_clock_q", None)
+                        if clocks is None:
+                            try:
+                                q._rd_clock_q = clocks = deque()
+                            except AttributeError:  # pragma: no cover
+                                return r
+                        clocks.append(snap)
+                return r
+            return put
+
+        def wrap_get(orig):
+            def get(q, block=True, timeout=None):
+                item = orig(q, block, timeout)
+                if det.enabled:
+                    with det._lock:
+                        clocks = getattr(q, "_rd_clock_q", None)
+                        snap = clocks.popleft() if clocks else None
+                    det._join(snap)
+                return item
+            return get
+
+        self._patch(queue_mod.Queue, "put", wrap_put)
+        self._patch(queue_mod.Queue, "get", wrap_get)
+
+        def wrap_set_result(orig):
+            def set_result(fut, result):
+                if det.enabled:
+                    det._merge_shared(fut)
+                return orig(fut, result)
+            return set_result
+
+        def wrap_set_exception(orig):
+            def set_exception(fut, exception):
+                if det.enabled:
+                    det._merge_shared(fut)
+                return orig(fut, exception)
+            return set_exception
+
+        def wrap_result(orig):
+            def result(fut, timeout=None):
+                try:
+                    return orig(fut, timeout)
+                finally:
+                    if det.enabled and fut.done():
+                        det._join_shared(fut)
+            return result
+
+        def wrap_exception(orig):
+            def exception(fut, timeout=None):
+                try:
+                    return orig(fut, timeout)
+                finally:
+                    if det.enabled and fut.done():
+                        det._join_shared(fut)
+            return exception
+
+        self._patch(Future, "set_result", wrap_set_result)
+        self._patch(Future, "set_exception", wrap_set_exception)
+        self._patch(Future, "result", wrap_result)
+        self._patch(Future, "exception", wrap_exception)
+
+        def wrap_submit(orig):
+            def submit(ex, fn, *args, **kwargs):
+                if not det.enabled:
+                    return orig(ex, fn, *args, **kwargs)
+                birth = det._publish()
+
+                @functools.wraps(fn)
+                def handoff(*a, **kw):
+                    det._join(birth)
+                    return fn(*a, **kw)
+
+                return orig(ex, handoff, *args, **kwargs)
+            return submit
+
+        self._patch(ThreadPoolExecutor, "submit", wrap_submit)
+
+    def _wrap_run(self, thr: threading.Thread) -> None:
+        det = self
+        orig_run = thr.run
+
+        def run():
+            det._join(getattr(thr, "_rd_birth", None))
+            try:
+                orig_run()
+            finally:
+                if det.enabled:
+                    st = det._thread_state()
+                    thr._rd_final = st.vc.snapshot()
+
+        thr.run = run
+
+    def _arm_guarded_classes(self) -> None:
+        for cls, fields in list(GUARDED_REGISTRY.items()):
+            orig = cls.__dict__.get("__init__")
+            if orig is None or getattr(orig, "_rd_wrapped", False):
+                continue
+            cls.__init__ = _make_guarded_init(orig, cls, fields)
+            self._patched_inits.append((cls, orig))
+
+    def _disarm(self) -> None:
+        self.enabled = False
+        for obj, name, orig in reversed(self._patches):
+            setattr(obj, name, orig)
+        self._patches.clear()
+        for cls, orig in reversed(self._patched_inits):
+            cls.__init__ = orig
+        self._patched_inits.clear()
+
+
+def _make_guarded_init(orig, cls: type, fields: tuple):
+    @functools.wraps(orig)
+    def __init__(self, *args, **kwargs):
+        orig(self, *args, **kwargs)
+        det = _ACTIVE
+        if det is not None and det.enabled:
+            det.instrument(self, cls, fields)
+
+    __init__._rd_wrapped = True
+    return __init__
+
+
+# ---------------------------------------------------------------------------
+# session entry point
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def sanitize_races(
+    monitor: Optional[lockorder.LockMonitor] = None,
+    mode: Optional[str] = None,
+    schedule=None,
+    include_tests: bool = False,
+) -> Iterator[RaceDetector]:
+    """Arm the data-race sanitizer for a region. Composes with an
+    already-armed lockorder session (pass its monitor, or let it find
+    :func:`lockorder.current_monitor`); opens a private one otherwise —
+    the lockset clause needs instrumented locks to see anything.
+
+    Typical suite wiring (module-scoped autouse, after the lockorder
+    fixture so lock patching is already live)::
+
+        with sanitize_races(monitor=lock_monitor) as det:
+            ... threaded workload ...
+        det.assert_clean()
+    """
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError("sanitize_races sessions do not nest")
+    own_locks = None
+    if monitor is None:
+        monitor = lockorder.current_monitor()
+    if monitor is None:
+        own_locks = lockorder.sanitize_locks()
+        monitor = own_locks.__enter__()
+    det = RaceDetector(monitor=monitor, mode=mode, schedule=schedule,
+                       include_tests=include_tests)
+    monitor.add_listener(det)
+    det._arm_patches()
+    det._arm_guarded_classes()
+    _ACTIVE = det
+    try:
+        yield det
+    finally:
+        _ACTIVE = None
+        det._disarm()
+        monitor.remove_listener(det)
+        if own_locks is not None:
+            own_locks.__exit__(None, None, None)
+
+
+def render_race_baseline(reports, justification: str = "todo") -> str:
+    """Serialize observed races as a ``bobrarace-baseline.json``
+    document (the loader rejects the placeholder justification — each
+    entry must be hand-audited, same contract as bobralint)."""
+    return Baseline.render(reports, justification)

@@ -1,0 +1,193 @@
+"""Seeded replay schedules for the data-race sanitizer.
+
+A race the churn soak catches 1-run-in-10 is useless for debugging
+until it reproduces on demand. These schedules turn every tracked
+access (racedetect's instrumentation sites) into a *seeded* decision
+point, at two strength levels:
+
+- :class:`JitterSchedule` — seeded perturbation: at each site, a
+  shared seeded RNG decides pass / GIL-yield / microsleep. Safe under
+  arbitrary blocking (threads never wait on the schedule), so it wraps
+  real workloads — the shard churn soak arms it and prints the seed on
+  failure. The DECISION SEQUENCE is exactly reproducible from the
+  seed; which thread consumes which decision still depends on arrival
+  order, so this is statistical reproducibility: same seed, same
+  perturbation shape, dramatically better repro odds than bare timing.
+- :class:`SerialSchedule` — strict cooperative serialization for
+  self-contained repro cases (the known-bad corpus in
+  tests/test_racedetect.py): participant threads are registered up
+  front, every participant blocks at each instrumented access until
+  ALL live participants are blocked, then the seeded RNG picks who
+  runs one step. The resulting ``trace`` (thread, site) sequence is
+  bit-identical across runs with the same seed, independent of OS
+  scheduling — deterministic replay, with the caveat that participant
+  bodies must not block on each other outside instrumented state (a
+  token holder stuck on an application lock would stall the round;
+  stalls time out, are counted in ``stalls``, and degrade to free
+  running rather than deadlocking).
+
+Both schedules synchronize internally with raw ``_thread.allocate_lock``
+primitives and busy gates: their own machinery must be invisible to the
+detector (no patched-lock lockset noise) and, critically, must create
+NO happens-before edges between the threads being scheduled — a
+serializer built on ``threading.Condition`` would order every access
+pair it interleaves and the sanitizer would see nothing but clean
+handoffs.
+"""
+
+from __future__ import annotations
+
+import _thread
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+
+class JitterSchedule:
+    """Seeded perturbation at instrumentation sites.
+
+    ``p_sleep``/``p_yield`` partition the unit interval: a draw below
+    ``p_sleep`` sleeps ``sleep_s`` (forces a real reschedule), below
+    ``p_sleep + p_yield`` sleeps 0 (drops the GIL), else passes
+    through. Defaults keep the soak within ~1.3x wall-clock."""
+
+    def __init__(self, seed: int, *, p_sleep: float = 0.02,
+                 p_yield: float = 0.08, sleep_s: float = 0.0005):
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._mu = _thread.allocate_lock()
+        self.p_sleep = p_sleep
+        self.p_yield = p_yield
+        self.sleep_s = sleep_s
+        self.decisions = 0
+
+    def on_access(self, site: str) -> None:
+        with self._mu:
+            draw = self._rng.random()
+            self.decisions += 1
+        if draw < self.p_sleep:
+            time.sleep(self.sleep_s)
+        elif draw < self.p_sleep + self.p_yield:
+            time.sleep(0)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"JitterSchedule(seed={self.seed}, decisions={self.decisions})"
+
+
+class SerialSchedule:
+    """Deterministic round-based cooperative scheduler.
+
+    Usage::
+
+        sched = SerialSchedule(seed=7)
+        t1 = sched.spawn(writer, name="w")
+        t2 = sched.spawn(reader, name="r")
+        with sanitize_races(schedule=sched, include_tests=True) as det:
+            t1.start(); t2.start(); sched.run()
+        assert sched.trace == <same-seed trace>
+
+    ``spawn`` registers the participant BEFORE its thread starts, so no
+    participant can slip past the first barrier while another is still
+    being scheduled by the OS; ``run`` releases the first step and joins
+    all participants."""
+
+    def __init__(self, seed: int, *, step_timeout: float = 5.0,
+                 max_steps: int = 100_000):
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._mu = _thread.allocate_lock()
+        self.step_timeout = float(step_timeout)
+        self.max_steps = int(max_steps)
+        #: participant name -> pre-acquired gate the dispatcher releases
+        self._gates: dict[str, object] = {}
+        self._live: set[str] = set()
+        self._arrived: dict[str, str] = {}  # name -> site waiting at
+        self._idents: dict[int, str] = {}
+        self._threads: list[threading.Thread] = []
+        self._released = False
+        #: (participant, site) per granted step — the replay artifact:
+        #: identical across runs with the same seed
+        self.trace: list[tuple[str, str]] = []
+        self.stalls = 0
+
+    # -- participant management -------------------------------------------
+
+    def spawn(self, fn: Callable[[], None], name: str) -> threading.Thread:
+        if name in self._gates:
+            raise ValueError(f"duplicate participant {name!r}")
+        gate = _thread.allocate_lock()
+        gate.acquire()
+        with self._mu:
+            self._gates[name] = gate
+            self._live.add(name)
+
+        def body():
+            ident = threading.get_ident()
+            with self._mu:
+                self._idents[ident] = name
+            self._checkpoint(name, "start")
+            try:
+                fn()
+            finally:
+                with self._mu:
+                    self._live.discard(name)
+                    self._arrived.pop(name, None)
+                    self._idents.pop(ident, None)
+                    self._dispatch_locked()
+
+        t = threading.Thread(target=body, name=f"serial-{name}", daemon=True)
+        self._threads.append(t)
+        return t
+
+    def run(self, timeout: Optional[float] = None) -> None:
+        """Open the schedule (threads must already be started) and join
+        every participant."""
+        with self._mu:
+            self._released = True
+            self._dispatch_locked()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for t in self._threads:
+            t.join(None if deadline is None
+                   else max(0.0, deadline - time.monotonic()))
+
+    # -- detector hook -----------------------------------------------------
+
+    def on_access(self, site: str) -> None:
+        with self._mu:
+            name = self._idents.get(threading.get_ident())
+        if name is not None:
+            self._checkpoint(name, site)
+
+    # -- internals ---------------------------------------------------------
+
+    def _checkpoint(self, name: str, site: str) -> None:
+        with self._mu:
+            if len(self.trace) >= self.max_steps:
+                return  # runaway guard: degrade to free running
+            self._arrived[name] = site
+            gate = self._gates[name]
+            self._dispatch_locked()
+        if not gate.acquire(timeout=self.step_timeout):
+            # a participant is blocked outside the schedule (application
+            # lock, IO): don't deadlock the repro — run free and record
+            # the stall so the test can notice determinism was lost
+            with self._mu:
+                self._arrived.pop(name, None)
+                self.stalls += 1
+
+    def _dispatch_locked(self) -> None:
+        """Grant one step when every live participant is parked at a
+        checkpoint. Called with ``_mu`` held."""
+        if not self._released or not self._arrived:
+            return
+        if set(self._arrived) != self._live or not self._live:
+            return
+        name = self._rng.choice(sorted(self._arrived))
+        site = self._arrived.pop(name)
+        self.trace.append((name, site))
+        self._gates[name].release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"SerialSchedule(seed={self.seed}, steps={len(self.trace)}, "
+                f"stalls={self.stalls})")

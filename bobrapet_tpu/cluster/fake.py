@@ -371,10 +371,11 @@ class FakeCluster:
             return True
         meta = obj["metadata"]
         key = (meta["namespace"], meta["name"], generation)
-        warm_at = self._warm_at.setdefault(key, self.clock.now() + self.warmup_seconds)
-        if self.clock.now() >= warm_at:
-            self._warm_at.pop(key, None)
-            return True
+        with self._lock:  # RLock: callers may already hold it
+            warm_at = self._warm_at.setdefault(key, self.clock.now() + self.warmup_seconds)
+            if self.clock.now() >= warm_at:
+                self._warm_at.pop(key, None)
+                return True
         return False
 
     def resync_workload(self, namespace: str, name: str) -> None:

@@ -43,6 +43,7 @@ import time
 from collections import deque
 from typing import Callable, Iterable, Optional
 
+from ..analysis.racedetect import guarded_state
 from ..core.store import ResourceStore, WatchEvent
 from ..observability.metrics import metrics
 
@@ -107,6 +108,7 @@ class _Timer:
     key: tuple[str, str, str] = dataclasses.field(compare=False)  # (controller, ns, name)
 
 
+@guarded_state("queue", "queued")
 class _Pool:
     """One controller's work queue + worker bookkeeping. All fields are
     guarded by the manager's shared lock; ``cond`` shares that lock so
@@ -127,6 +129,8 @@ class _Pool:
         self.busy = 0  # reconciles in flight
 
 
+@guarded_state("_active", "_controllers", "_dirty", "_failures",
+               "_per_controller_max", "_pools", "_registered_max", "_timers")
 class ControllerManager:
     """Per-controller-pool reconcile engine (see module docstring).
 
@@ -200,10 +204,10 @@ class ControllerManager:
         ``max_concurrent`` pins this controller's pool width; without it
         the config default / per-controller override applies.
         """
-        self._controllers[name] = reconcile
-        if max_concurrent is not None:
-            self._registered_max[name] = max(1, int(max_concurrent))
         with self._lock:
+            self._controllers[name] = reconcile
+            if max_concurrent is not None:
+                self._registered_max[name] = max(1, int(max_concurrent))
             if name not in self._pools:
                 self._pools[name] = _Pool(
                     name, self._lock, self._target_width(name)
@@ -330,7 +334,11 @@ class ControllerManager:
 
     def _process(self, key: tuple[str, str, str]) -> None:
         controller, ns, name = key
-        fn = self._controllers.get(controller)
+        # register() may run mid-flight (a joining shard's runtime wires
+        # controllers while earlier pools already dispatch): reads of
+        # the registry share its lock
+        with self._lock:
+            fn = self._controllers.get(controller)
         if fn is None:
             return
         gate = self.reconcile_gate
@@ -370,22 +378,26 @@ class ControllerManager:
 
     def _process_inner(self, key: tuple[str, str, str]) -> None:
         controller, ns, name = key
-        fn = self._controllers[controller]
+        with self._lock:
+            fn = self._controllers[controller]
         started = time.monotonic()
         try:
             requeue_after = fn(ns, name)
             metrics.reconcile_total.inc(controller, "success")
             self._observe_duration(controller, ns, name, started)
-            self._failures.pop(key, None)
+            with self._lock:
+                self._failures.pop(key, None)
             if requeue_after is not None and requeue_after >= 0:
                 self.enqueue(controller, ns, name, after=max(requeue_after, 1e-9))
         except Exception:  # noqa: BLE001 - reconcile errors retry with backoff
             metrics.reconcile_total.inc(controller, "error")
             self._observe_duration(controller, ns, name, started)
-            # per-key counters race-free: keyed serialization means no
-            # two workers ever touch the same key's entry concurrently
-            n = self._failures.get(key, 0) + 1
-            self._failures[key] = n
+            # keyed serialization keeps each key's COUNT consistent, but
+            # the dict itself is shared across every worker thread —
+            # entries for different keys land under the manager lock
+            with self._lock:
+                n = self._failures.get(key, 0) + 1
+                self._failures[key] = n
             delay = jittered_backoff(n, self._requeue_base, self._requeue_max)
             if n <= self._max_failures_logged:
                 _log.exception(

@@ -34,6 +34,7 @@ import urllib.parse
 from collections import deque
 from typing import Any, Callable, Iterable, Optional
 
+from ..analysis.racedetect import guarded_state
 from .object import Resource, _fast_copy, fresh_uid, now
 
 _log = logging.getLogger(__name__)
@@ -96,6 +97,9 @@ WatchHandler = Callable[[WatchEvent], None]
 WatchFilter = Callable[[Resource], bool]
 
 
+@guarded_state("_defaulters", "_index_buckets", "_indexes", "_objects",
+               "_pending_events", "_status_validators", "_validators",
+               "_watchers")
 class ResourceStore:
     """Thread-safe in-process resource store with watch semantics."""
 
@@ -127,16 +131,19 @@ class ResourceStore:
 
     # -- admission registration -------------------------------------------
     def register_defaulter(self, kind: str, fn: Defaulter) -> None:
-        self._defaulters.setdefault(kind, []).append(fn)
+        with self._lock:
+            self._defaulters.setdefault(kind, []).append(fn)
 
     def register_validator(self, kind: str, fn: Validator) -> None:
-        self._validators.setdefault(kind, []).append(fn)
+        with self._lock:
+            self._validators.setdefault(kind, []).append(fn)
 
     def register_status_validator(self, kind: str, fn: Validator) -> None:
         """Validators for the status subresource (the reference validates
         status writes too, e.g. observedGeneration monotonicity
         steprun_webhook.go:529)."""
-        self._status_validators.setdefault(kind, []).append(fn)
+        with self._lock:
+            self._status_validators.setdefault(kind, []).append(fn)
 
     def admission_chain(
         self, kind: str

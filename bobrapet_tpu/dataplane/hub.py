@@ -429,7 +429,8 @@ class StreamHub:
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="hub-accept")
         t.start()
-        self._threads.append(t)
+        with self._lock:
+            self._threads.append(t)
         return self.port
 
     def stop(self) -> None:

@@ -45,6 +45,7 @@ import threading
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
+from ..analysis.racedetect import guarded_state
 from ..observability.metrics import metrics
 from .paged_cache import BlockAllocator
 
@@ -101,6 +102,7 @@ def _decode_kv_payload(data: bytes) -> dict:
         return {k: z[k] for k in z.files}
 
 
+@guarded_state("_entries")
 class SharedPrefixRegistry:
     """Process-wide content-hash -> exported-block-payload map shared
     by engine instances (bounded LRU; thread-safe — engines may serve

@@ -59,6 +59,7 @@ import weakref
 from collections import deque
 from typing import Any, Optional
 
+from ..analysis.racedetect import guarded_state
 from ..observability.metrics import metrics
 from ..observability.timeline import FLIGHT
 from .engine import Request, ServingEngine
@@ -158,6 +159,8 @@ class _Queued:
         return self._hashes
 
 
+@guarded_state("_consumed", "_draining", "_handoff_clock", "_owned",
+               "_pending_roles", "_queues", "engines", "finished", "outcomes")
 class ServingRouter:
     """See module docstring.
 

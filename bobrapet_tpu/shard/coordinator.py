@@ -45,6 +45,7 @@ import logging
 import threading
 from typing import Optional
 
+from ..analysis.racedetect import guarded_state
 from ..api.enums import is_nonterminal_phase
 from ..api.runs import STEP_RUN_KIND, STORY_RUN_KIND
 from ..controllers.step_executor import parse_trace_annotation
@@ -79,6 +80,7 @@ _log = logging.getLogger(__name__)
 SHARD_CONTROLLER = "shard"
 
 
+@guarded_state("_parked_labels")
 class ShardCoordinator:
     """Runs inside one manager process; see module docstring."""
 
@@ -124,8 +126,10 @@ class ShardCoordinator:
         self._retired = False
         self._acked_epoch = 0
         self._tick = 0
-        #: gauge labels set by the last _update_parked_gauge pass
+        #: gauge labels set by the last _update_parked_gauge pass;
+        #: written from every dispatcher worker, hence its own lock
         self._parked_labels: set[str] = set()
+        self._gauge_lock = threading.Lock()
         #: last wall-clock write of the member/lease heartbeats. Event-
         #: triggered reconciles (map changes, member joins) run the
         #: read-only state machine at full cadence but must NOT write a
@@ -203,39 +207,38 @@ class ShardCoordinator:
             # already declared us dead and handed our families to
             # survivors — starting work now risks the double-reconcile
             # the barrier exists to prevent. Park until a renewal lands.
-            if key not in self.router.parked:
-                self.router.parked.add(key)
+            if self.router.park(key):
                 self._update_parked_gauge()
                 metrics.shard_self_fenced.inc(self.router.me)
             return self.park_delay
         if verdict == ADMIT_OWN:
-            if key in self.router.parked:
+            if self.router.unpark(key):
                 # released from a self-fence (barrier parks are cleared
                 # wholesale at promote) — drop the gauge entry
-                self.router.parked.discard(key)
                 self._update_parked_gauge()
             return None
         if verdict == ADMIT_PARK:
-            if key not in self.router.parked:
-                self.router.parked.add(key)
+            if self.router.park(key):
                 self._update_parked_gauge()
             return self.park_delay
-        if key in self.router.parked:
-            self.router.parked.discard(key)
+        if self.router.unpark(key):
             self._update_parked_gauge()
         return -1.0
 
     def _update_parked_gauge(self) -> None:
         counts: dict[str, int] = {}
-        for controller, _ns, _name in tuple(self.router.parked):
+        for controller, _ns, _name in self.router.parked_snapshot():
             counts[controller] = counts.get(controller, 0) + 1
-        # zero labels that emptied, or the gauge would read "parked"
-        # forever after the barrier clears
-        for stale in self._parked_labels - counts.keys():
-            metrics.shard_parked_keys.set(0, stale)
-        for controller, n in counts.items():
-            metrics.shard_parked_keys.set(n, controller)
-        self._parked_labels = set(counts)
+        # gate() runs on every dispatcher worker: _parked_labels and the
+        # zero-out pass must not interleave between workers
+        with self._gauge_lock:
+            # zero labels that emptied, or the gauge would read "parked"
+            # forever after the barrier clears
+            for stale in self._parked_labels - counts.keys():
+                metrics.shard_parked_keys.set(0, stale)
+            for controller, n in counts.items():
+                metrics.shard_parked_keys.set(n, controller)
+            self._parked_labels = set(counts)
 
     # -- cross-shard handoff accounting -----------------------------------
     def _on_storyrun_added(self, ev) -> None:

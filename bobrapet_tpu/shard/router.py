@@ -41,6 +41,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from ..analysis.racedetect import guarded_state
 from ..api.catalog import ENGRAM_TEMPLATE_KIND, IMPULSE_TEMPLATE_KIND
 from ..api.engram import KIND as ENGRAM_KIND
 from ..api.impulse import KIND as IMPULSE_KIND
@@ -88,6 +89,7 @@ _AUX_CONTROLLER_KIND = {
 DEFINITION_KINDS = frozenset(_DEF_CONTROLLER_KIND.values())
 
 
+@guarded_state("parked")
 class ShardRouter:
     """One per manager process; thread-safe (ring swaps under a lock,
     reads take an immutable snapshot)."""
@@ -184,6 +186,32 @@ class ShardRouter:
             self._rebalance_started = None
             self.parked.clear()
             return old_n, len(pending.members), started
+
+    # -- gate parking ------------------------------------------------------
+    def park(self, key: tuple[str, str, str]) -> bool:
+        """Record ``key`` as parked by the gate; True if newly parked.
+        Parks are cleared wholesale by :meth:`promote` at the barrier,
+        so membership changes and the clear serialize on one lock — the
+        dispatcher gate threads must NOT touch ``parked`` directly."""
+        with self._lock:
+            if key in self.parked:
+                return False
+            self.parked.add(key)
+            return True
+
+    def unpark(self, key: tuple[str, str, str]) -> bool:
+        """Drop a gate park; True if the key was actually parked."""
+        with self._lock:
+            if key not in self.parked:
+                return False
+            self.parked.discard(key)
+            return True
+
+    def parked_snapshot(self) -> tuple[tuple[str, str, str], ...]:
+        """Stable copy for the gauge/tests (iteration must not race the
+        gate threads' adds or promote()'s clear)."""
+        with self._lock:
+            return tuple(self.parked)
 
     # -- ownership ---------------------------------------------------------
     def owner_of(self, root: str) -> str:

@@ -49,6 +49,7 @@ import weakref
 from collections import deque
 from typing import Any, Callable, Optional
 
+from ..analysis.racedetect import guarded_state
 from ..observability.metrics import metrics
 from ..observability.timeline import FLIGHT
 
@@ -501,6 +502,7 @@ class EngineReplicaSet:
 # ---------------------------------------------------------------------------
 
 
+@guarded_state("_last_down", "_last_up", "decisions", "pools")
 class Autoscaler:
     """Tick-driven control loop over one router's replica sets.
 

@@ -43,6 +43,8 @@ import itertools
 from collections import deque
 from typing import Any, Callable, Iterable, Optional
 
+from ..analysis.racedetect import guarded_state
+
 
 def parse_tenant_weights(raw: str) -> dict[str, float]:
     """Parse the ``serving.tenant-weights`` operator value:
@@ -84,6 +86,7 @@ def _default_cost(item: Any) -> float:
     )
 
 
+@guarded_state("_queues", "_vfinish", "_weights")
 class WeightedFairQueue:
     """See module docstring. Single-threaded by the same contract as
     the engine/router that owns it."""

@@ -37,6 +37,7 @@ import random
 import time as _walltime
 from typing import Any, Callable, Optional, Sequence
 
+from ..analysis.racedetect import guarded_state
 from ..observability.metrics import metrics
 
 
@@ -126,6 +127,7 @@ class _User:
         self.submitted = 0
 
 
+@guarded_state("_inflight", "_users", "phases", "profiles", "tick_hooks")
 class ClosedLoopLoadGen:
     """See module docstring."""
 

@@ -1,0 +1,66 @@
+"""BAD corpus for shared-state-discipline: every tagged line must be
+flagged. Never imported — parsed by tests/test_analysis.py only."""
+
+import threading
+from collections import defaultdict, deque
+
+from bobrapet_tpu.analysis.racedetect import guarded_state
+
+
+class Registry:
+    """Owns a lock, mutates its containers without it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        self._order = []
+        self._seen = set()
+        self._recent = deque()
+        self._buckets = defaultdict(set)
+
+    def put(self, key, value):
+        self._items[key] = value  # BAD: subscript assign, no lock
+
+    def bump(self, key):
+        self._items[key] += 1  # BAD: augmented assign, no lock
+
+    def forget(self, key):
+        del self._items[key]  # BAD: delete, no lock
+
+    def push(self, item):
+        self._order.append(item)  # BAD: list mutator, no lock
+
+    def tag(self, key, label):
+        self._seen.add((key, label))  # BAD: set mutator, no lock
+
+    def note(self, item):
+        self._recent.appendleft(item)  # BAD: deque mutator, no lock
+
+    def retire(self, bucket, key):
+        # inner containers inherit the outer attribute's discipline
+        self._buckets[bucket].discard(key)  # BAD: through-subscript mutation
+
+    def deferred(self):
+        with self._lock:
+            def later():
+                self._order.append("late")  # BAD: closure outlives the lock
+            return later
+
+    def _sweep(self):
+        self._items.clear()  # BAD: helper with no in-class call sites
+
+    def _cycle_a(self):
+        self._seen.discard("a")  # BAD: mutual recursion, no locked entry
+        self._cycle_b()
+
+    def _cycle_b(self):
+        self._seen.discard("b")  # BAD: mutual recursion, no locked entry
+        self._cycle_a()
+
+
+@guarded_state("declared", "ghost")
+class Drifted:  # BAD: declares 'ghost' but __init__ assigns no such container
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.declared = {}
+        self.missing = []  # BAD: container undeclared in guarded_state

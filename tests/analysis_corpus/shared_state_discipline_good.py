@@ -1,0 +1,74 @@
+"""GOOD corpus for shared-state-discipline: nothing here may be
+flagged. Never imported — parsed by tests/test_analysis.py only."""
+
+import threading
+from collections import deque
+
+from bobrapet_tpu.analysis.racedetect import guarded_state
+
+
+@guarded_state("_items", "_order")
+class DisciplinedRegistry:
+    """Every mutation lock-held, lexically or through a *_locked chain;
+    the guarded_state declaration matches the discovered containers."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        self._order = deque()
+        self._items["boot"] = 1  # __init__ is pre-publication
+        self.capacity = 8  # scalar attrs are out of scope
+
+    def put(self, key, value):
+        with self._lock:
+            self._items[key] = value
+            self._order.append(key)
+
+    def evict(self):
+        with self._lock:
+            self._evict_locked()
+
+    def _evict_locked(self):
+        # excused transitively: its only call site holds the lock
+        while len(self._order) > self.capacity:
+            self._trim_one_locked()
+
+    def _trim_one_locked(self):
+        # two-level chain plus self-recursion: the fixed point proves
+        # every path here enters under the lock
+        key = self._order.popleft()
+        self._items.pop(key, None)
+        if key in self._items:
+            self._trim_one_locked()
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._items)
+
+
+class InitCallee:
+    """A mutating helper called only from __init__ is pre-publication."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+        self._seed()
+
+    def _seed(self):
+        self._state["ready"] = False
+
+    def ready(self):
+        with self._lock:
+            self._state["ready"] = True
+
+
+class NoLock:
+    """No lock attribute: the discipline does not apply (the class is
+    single-threaded by construction or externally synchronized — the
+    runtime sanitizer, not this checker, judges that claim)."""
+
+    def __init__(self):
+        self._cache = {}
+
+    def put(self, k, v):
+        self._cache[k] = v

@@ -46,6 +46,19 @@ def _lock_order_sanitizer():
     monitor.assert_clean()
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _race_sanitizer(_lock_order_sanitizer):
+    """bobrarace for the whole module: every @guarded_state container
+    created by these tests is swapped for a tracked wrapper; an
+    unordered, unlocked conflicting access pair fails the suite at
+    teardown unless justified in bobrarace-baseline.json."""
+    from bobrapet_tpu.analysis.racedetect import sanitize_races
+
+    with sanitize_races(monitor=_lock_order_sanitizer) as det:
+        yield det
+    det.assert_clean()
+
+
 @pytest.fixture
 def live_rt():
     """Runtime in live mode: real clock, dispatcher thread, threaded

@@ -46,6 +46,18 @@ def _lock_order_sanitizer():
     monitor.assert_clean()
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _race_sanitizer(_lock_order_sanitizer):
+    """bobrarace over the dispatcher suite: pools, dirty/active sets,
+    failure counters and timer heaps are all tracked (see
+    test_concurrency.py for the contract)."""
+    from bobrapet_tpu.analysis.racedetect import sanitize_races
+
+    with sanitize_races(monitor=_lock_order_sanitizer) as det:
+        yield det
+    det.assert_clean()
+
+
 def make_manager(**per_controller) -> ControllerManager:
     m = ControllerManager(ResourceStore(), clock=Clock())
     cfg = OperatorConfig()

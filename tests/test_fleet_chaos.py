@@ -42,6 +42,18 @@ def _lock_order_sanitizer():
     monitor.assert_clean()
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _race_sanitizer(_lock_order_sanitizer):
+    """bobrarace over the preemption storm (see test_concurrency.py
+    for the contract): chaos interleavings are exactly where an
+    unlocked shared-container access would finally collide."""
+    from bobrapet_tpu.analysis.racedetect import sanitize_races
+
+    with sanitize_races(monitor=_lock_order_sanitizer) as det:
+        yield det
+    det.assert_clean()
+
+
 class ScriptedInjector(PreemptionInjector):
     """Deterministic plan list instead of a seeded rate."""
 

@@ -1,0 +1,647 @@
+"""bobrarace: the lockset/happens-before data-race sanitizer.
+
+Four layers, mirroring the module split:
+
+1. pure happens-before machinery (analysis/hb.py) driven with
+   hand-built clocks — no threads;
+2. real-thread HB edges (fork/join, Future, Condition, Event, queue,
+   executor submit) and the hybrid lockset rule, via short
+   ``sanitize_races`` sessions;
+3. the known-bad proof corpus — the PR-6 stale-scope race shape and an
+   unlocked-deque mutation — detected AND deterministically replayed
+   from a seed (analysis/schedules.py);
+4. the contracts around the detector: baseline gating, static/runtime
+   registry drift (``discover_guarded`` == ``GUARDED_REGISTRY``), and
+   regression pins for the real races fixed alongside this sanitizer
+   (ShardRouter.parked vs promote, ControllerManager._failures,
+   ResourceStore admission registration).
+"""
+
+import json
+import os
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import pytest
+
+# decorated product modules must be imported so GUARDED_REGISTRY is
+# populated before the drift test compares it to static discovery
+import bobrapet_tpu.controllers.manager  # noqa: F401
+import bobrapet_tpu.core.store  # noqa: F401
+import bobrapet_tpu.serving.prefix_cache  # noqa: F401
+import bobrapet_tpu.serving.router  # noqa: F401
+import bobrapet_tpu.shard.coordinator  # noqa: F401
+import bobrapet_tpu.shard.router  # noqa: F401
+import bobrapet_tpu.traffic.autoscaler  # noqa: F401
+import bobrapet_tpu.traffic.fairness  # noqa: F401
+import bobrapet_tpu.traffic.loadgen  # noqa: F401
+from bobrapet_tpu.analysis.baseline import BaselineError
+from bobrapet_tpu.analysis.checkers.shared_state_discipline import (
+    discover_guarded,
+)
+from bobrapet_tpu.analysis.core import load_project
+from bobrapet_tpu.analysis.hb import (
+    AccessCheck,
+    VarState,
+    VectorClock,
+    epoch_leq,
+)
+from bobrapet_tpu.analysis.racedetect import (
+    GUARDED_REGISTRY,
+    RaceViolation,
+    render_race_baseline,
+    sanitize_races,
+    track,
+)
+from bobrapet_tpu.analysis.schedules import JitterSchedule, SerialSchedule
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# 1. clocks + VarState, no threads
+# ---------------------------------------------------------------------------
+
+
+class TestVectorClock:
+    def test_missing_tids_read_zero(self):
+        vc = VectorClock()
+        assert vc.time_of(7) == 0
+        vc.advance(7)
+        assert vc.time_of(7) == 1
+
+    def test_join_is_pointwise_max(self):
+        a = VectorClock({1: 3, 2: 1})
+        a.join({2: 5, 3: 2})
+        assert a == {1: 3, 2: 5, 3: 2}
+        a.join(None)  # zero clock joins as identity
+        assert a == {1: 3, 2: 5, 3: 2}
+
+    def test_leq(self):
+        assert VectorClock({1: 1}).leq({1: 2, 9: 9})
+        assert not VectorClock({1: 3}).leq({1: 2})
+
+    def test_epoch_leq(self):
+        assert epoch_leq(None, {})  # virgin epoch precedes everything
+        assert epoch_leq((1, 2), {1: 2})
+        assert not epoch_leq((1, 3), {1: 2})
+        assert not epoch_leq((1, 1), {2: 5})
+
+
+class TestVarState:
+    def test_unordered_unlocked_writes_race(self):
+        vs = VarState()
+        vs.on_access(1, {1: 1}, frozenset(), True, token="w1")
+        chk = vs.on_access(2, {2: 1}, frozenset(), True, token="w2")
+        assert chk.is_race and chk.conflicts == ["w1"]
+
+    def test_common_lock_excuses_unordered_writes(self):
+        vs = VarState()
+        vs.on_access(1, {1: 1}, frozenset({"L#1"}), True)
+        chk = vs.on_access(2, {2: 1}, frozenset({"L#1"}), True)
+        assert chk.conflicts and chk.common_locks == frozenset({"L#1"})
+        assert not chk.is_race
+
+    def test_lockset_refines_to_intersection(self):
+        vs = VarState()
+        vs.on_access(1, {1: 1}, frozenset({"A#1", "B#1"}), True)
+        chk = vs.on_access(2, {2: 1}, frozenset({"B#1", "C#1"}), True)
+        assert chk.common_locks == frozenset({"B#1"})
+        # third unordered access without B drains the set: race
+        chk = vs.on_access(3, {3: 1}, frozenset({"C#1"}), True)
+        assert chk.is_race
+
+    def test_ordered_access_is_clean_and_rearms_lockset(self):
+        vs = VarState()
+        vs.on_access(1, {1: 1}, frozenset(), True, token="w1")
+        # tid 2 saw tid 1's write (joined clock): clean handoff, and the
+        # drained lockset must NOT leak into the new exclusive phase
+        chk = vs.on_access(2, {1: 1, 2: 1}, frozenset({"L#1"}), True)
+        assert not chk.conflicts
+        chk = vs.on_access(3, {3: 1}, frozenset({"L#1"}), True)
+        assert chk.conflicts and not chk.is_race  # excused by L#1
+
+    def test_write_conflicts_with_unordered_read(self):
+        vs = VarState()
+        vs.on_access(1, {1: 1}, frozenset(), False, token="r1")
+        chk = vs.on_access(2, {2: 1}, frozenset(), True, token="w2")
+        assert chk.is_race and "r1" in chk.conflicts
+
+    def test_reads_never_conflict_with_reads(self):
+        vs = VarState()
+        vs.on_access(1, {1: 1}, frozenset(), False)
+        chk = vs.on_access(2, {2: 1}, frozenset(), False)
+        assert not chk.conflicts
+
+    def test_write_clears_read_state(self):
+        vs = VarState()
+        vs.on_access(1, {1: 1}, frozenset(), False, token="r1")
+        vs.on_access(1, {1: 2}, frozenset(), True)  # same-thread write
+        assert vs.read_epochs == {} and vs.read_tokens == {}
+
+    def test_access_check_shape(self):
+        chk = AccessCheck(conflicts=[], common_locks=frozenset())
+        assert not chk.is_race
+
+
+# ---------------------------------------------------------------------------
+# 2. real-thread HB edges + the hybrid rule
+# ---------------------------------------------------------------------------
+
+
+def _run_all(*fns):
+    ts = [threading.Thread(target=fn) for fn in fns]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+class TestThreadedEdges:
+    def test_unlocked_writer_pair_races(self):
+        with sanitize_races(include_tests=True) as det:
+            d = track("t.unlocked", {})
+
+            def w(n):
+                for i in range(100):
+                    d[i % 5] = n
+
+            _run_all(lambda: w(1), lambda: w(2))
+        assert det.reports, det.report_text()
+        rep = det.reports[0]
+        assert rep.var == "t.unlocked"
+        assert "NO LOCKS" in rep.render()
+        assert rep.fingerprint  # line-number-free identity
+
+    def test_common_lock_is_clean(self):
+        with sanitize_races(include_tests=True) as det:
+            d = track("t.locked", {})
+            mu = threading.Lock()
+
+            def w(n):
+                for i in range(100):
+                    with mu:
+                        d[i % 5] = n
+
+            _run_all(lambda: w(1), lambda: w(2))
+        assert not det.reports, det.report_text()
+
+    def test_two_different_locks_race(self):
+        with sanitize_races(include_tests=True) as det:
+            d = track("t.twolocks", {})
+            mu_a, mu_b = threading.Lock(), threading.Lock()
+
+            def w(mu, n):
+                for i in range(100):
+                    with mu:
+                        d[i % 5] = n
+
+            _run_all(lambda: w(mu_a, 1), lambda: w(mu_b, 2))
+        assert det.reports, "disjoint locksets must not excuse the pair"
+
+    def test_fork_join_orders_accesses(self):
+        with sanitize_races(include_tests=True) as det:
+            d = track("t.forkjoin", {})
+            d["x"] = 1
+            t = threading.Thread(target=lambda: d.update(x=2))
+            t.start()
+            t.join()
+            assert d["x"] == 2  # read after join: ordered
+        assert not det.reports, det.report_text()
+
+    def test_future_handoff_orders_accesses(self):
+        with sanitize_races(include_tests=True) as det:
+            d = track("t.future", {})
+            fut = Future()
+
+            def worker():
+                d["x"] = 41
+                fut.set_result(True)
+
+            t = threading.Thread(target=worker)
+            t.start()
+            assert fut.result(timeout=2.0)
+            d["x"] += 1  # ordered by set_result -> result, NOT by join
+            t.join()
+        assert not det.reports, det.report_text()
+
+    def test_executor_submit_and_result_order_accesses(self):
+        with sanitize_races(include_tests=True) as det:
+            d = track("t.executor", {})
+            d["x"] = 1  # visible to the task via the submit edge
+            with ThreadPoolExecutor(max_workers=1) as ex:
+                fut = ex.submit(lambda: d.update(x=2))
+                fut.result(timeout=2.0)
+                d["x"] += 1  # ordered by the future edge
+        assert not det.reports, det.report_text()
+
+    def test_condition_handoff_orders_accesses(self):
+        with sanitize_races(include_tests=True) as det:
+            d = track("t.cond", {})
+            cond = threading.Condition()
+            parked = threading.Event()
+            out = []
+
+            def consumer():
+                with cond:
+                    parked.set()
+                    ok = cond.wait(timeout=2.0)
+                assert ok
+                out.append(d["x"])  # read outside any lock
+
+            def producer():
+                parked.wait(timeout=2.0)
+                d["x"] = 42  # write outside any lock
+                with cond:  # consumer holds cond until its wait parks
+                    cond.notify()
+
+            _run_all(consumer, producer)
+            assert out == [42]
+        assert not det.reports, det.report_text()
+
+    def test_event_handoff_orders_accesses(self):
+        with sanitize_races(include_tests=True) as det:
+            d = track("t.event", {})
+            ev = threading.Event()
+
+            def producer():
+                d["x"] = 7
+                ev.set()
+
+            t = threading.Thread(target=producer)
+            t.start()
+            assert ev.wait(timeout=2.0)
+            assert d["x"] == 7  # ordered by set -> wait, not by join
+            t.join()
+        assert not det.reports, det.report_text()
+
+    def test_queue_handoff_orders_accesses(self):
+        with sanitize_races(include_tests=True) as det:
+            d = track("t.queue", {})
+            q = queue.Queue()
+
+            def producer():
+                d["x"] = 9
+                q.put("token")
+
+            t = threading.Thread(target=producer)
+            t.start()
+            assert q.get(timeout=2.0) == "token"
+            assert d["x"] == 9  # ordered by put -> get
+            t.join()
+        assert not det.reports, det.report_text()
+
+    def test_hybrid_vs_hb_mode_on_lock_release_ordering(self):
+        """A writes under L; B later takes-and-releases L, then writes
+        WITHOUT it. mode="hb" treats release->acquire as an HB edge
+        (pure FastTrack: clean); default hybrid mode deliberately does
+        not, so the unlocked second write is still reported."""
+
+        def scenario():
+            d = track("t.relacq", {})
+            mu = threading.Lock()
+            flag = [False]
+
+            def a():
+                with mu:
+                    d["x"] = 1
+                flag[0] = True
+
+            def b():
+                while not flag[0]:
+                    time.sleep(0.005)
+                with mu:
+                    pass
+                d["x"] = 2  # unlocked, but after b held-and-released L
+
+            _run_all(a, b)
+
+        with sanitize_races(include_tests=True, mode="hybrid") as det:
+            scenario()
+        assert det.reports, "hybrid mode must not order through mutexes"
+
+        with sanitize_races(include_tests=True, mode="hb") as det:
+            scenario()
+        assert not det.reports, det.report_text()
+
+    def test_test_frame_accesses_suppressed_by_default(self):
+        with sanitize_races() as det:  # include_tests=False
+            d = track("t.observer", {})
+
+            def w(n):
+                for i in range(50):
+                    d[i % 3] = n
+
+            _run_all(lambda: w(1), lambda: w(2))
+        assert not det.reports
+        assert det.observer_races, "suppressed races stay visible for triage"
+
+    def test_sessions_do_not_nest(self):
+        with sanitize_races():
+            with pytest.raises(RuntimeError):
+                with sanitize_races():
+                    pass
+
+    def test_report_fingerprint_ignores_line_numbers(self):
+        with sanitize_races(include_tests=True) as det:
+            d = track("t.fp", {})
+
+            def w(n):
+                for i in range(100):
+                    d[i % 5] = n
+
+            _run_all(lambda: w(1), lambda: w(2))
+        rep = det.reports[0]
+        assert ":w" in rep.a.site_key() or ":w" in rep.b.site_key()
+        assert not any(ch.isdigit() for ch in rep.a.site_key().split("@")[0])
+
+
+# ---------------------------------------------------------------------------
+# 3. known-bad corpus + deterministic replay
+# ---------------------------------------------------------------------------
+
+
+def _stale_scope_race(sched=None):
+    """The PR-6 stale-scope shape: one worker patches a sibling step's
+    outputs into the shared family view while another reads that view
+    to decide the next step — no lock, no handoff edge."""
+    with sanitize_races(include_tests=True, schedule=sched) as det:
+        view = track("corpus.family_status_view",
+                     {"phase": "Running", "outputs": None})
+        seen = []
+
+        def sibling_patch():
+            view["outputs"] = {"tokens": 128}
+            view["phase"] = "Succeeded"
+
+        def scope_reader():
+            seen.append(view["phase"])
+            seen.append(view["outputs"])
+
+        if isinstance(sched, SerialSchedule):
+            ts = [sched.spawn(sibling_patch, name="sibling"),
+                  sched.spawn(scope_reader, name="reader")]
+            for t in ts:
+                t.start()
+            sched.run(timeout=10.0)
+        else:
+            _run_all(sibling_patch, scope_reader)
+    return det
+
+
+class TestKnownBadCorpus:
+    def test_stale_scope_shape_detected(self):
+        det = _stale_scope_race()
+        assert det.reports, "stale-scope view race must be detected"
+        assert det.reports[0].var == "corpus.family_status_view"
+
+    def test_stale_scope_replays_deterministically(self):
+        runs = []
+        for _ in range(2):
+            sched = SerialSchedule(seed=1337)
+            det = _stale_scope_race(sched)
+            assert sched.stalls == 0, "determinism degraded (stalled step)"
+            assert det.reports, "seeded replay must still detect the race"
+            runs.append(tuple(sched.trace))
+        assert runs[0] == runs[1], "same seed must give identical traces"
+        assert len(runs[0]) >= 4  # both participants actually interleaved
+
+    def test_different_seeds_may_reorder_but_still_detect(self):
+        t1 = _stale_scope_race(SerialSchedule(seed=1))
+        t2 = _stale_scope_race(SerialSchedule(seed=2))
+        assert t1.reports and t2.reports
+
+    def test_unlocked_deque_mutation_detected(self):
+        with sanitize_races(include_tests=True) as det:
+            dq = track("corpus.worker_deque", deque())
+
+            def pusher():
+                for i in range(100):
+                    dq.append(i)
+
+            def drainer():
+                for _ in range(100):
+                    try:
+                        dq.popleft()
+                    except IndexError:
+                        pass
+
+            _run_all(pusher, drainer)
+        assert det.reports
+        assert det.reports[0].var == "corpus.worker_deque"
+
+    def test_jitter_schedule_decisions_are_seeded(self):
+        a, b = JitterSchedule(seed=7), JitterSchedule(seed=7)
+        draws_a = [a._rng.random() for _ in range(32)]
+        draws_b = [b._rng.random() for _ in range(32)]
+        assert draws_a == draws_b
+        det = _stale_scope_race(JitterSchedule(seed=7))
+        assert det.reports, "jitter must not mask the race"
+
+
+# ---------------------------------------------------------------------------
+# 4. contracts: baseline gating, drift, regression pins
+# ---------------------------------------------------------------------------
+
+
+class TestBaselineContract:
+    def _racy_detector(self):
+        det = _stale_scope_race()
+        assert det.reports
+        return det
+
+    def test_assert_clean_raises_on_unsuppressed_race(self, tmp_path):
+        det = self._racy_detector()
+        with pytest.raises(RaceViolation) as exc:
+            det.assert_clean(baseline_path=str(tmp_path / "none.json"))
+        assert "DATA RACE" in str(exc.value)
+
+    def test_render_baseline_placeholder_is_rejected(self, tmp_path):
+        det = self._racy_detector()
+        path = tmp_path / "bobrarace-baseline.json"
+        path.write_text(render_race_baseline(det.reports))
+        with pytest.raises(BaselineError):
+            det.assert_clean(baseline_path=str(path))
+
+    def test_justified_suppression_passes(self, tmp_path):
+        det = self._racy_detector()
+        doc = json.loads(render_race_baseline(det.reports))
+        for entry in doc["suppressions"]:
+            entry["justification"] = (
+                "known-bad corpus shape, intentionally racy by design"
+            )
+        path = tmp_path / "bobrarace-baseline.json"
+        path.write_text(json.dumps(doc))
+        det.assert_clean(baseline_path=str(path))
+
+    def test_stale_suppression_raises_in_strict_mode(self, tmp_path):
+        racy = self._racy_detector()
+        doc = json.loads(render_race_baseline(racy.reports))
+        for entry in doc["suppressions"]:
+            entry["justification"] = (
+                "entry for a race this clean session never observes"
+            )
+        path = tmp_path / "bobrarace-baseline.json"
+        path.write_text(json.dumps(doc))
+        with sanitize_races() as det:
+            pass  # clean session: the suppression goes stale
+        det.assert_clean(baseline_path=str(path), strict_stale=False)
+        with pytest.raises(RaceViolation) as exc:
+            det.assert_clean(baseline_path=str(path), strict_stale=True)
+        assert "stale" in str(exc.value)
+
+    def test_repo_baseline_loads_and_has_justifications(self):
+        from bobrapet_tpu.analysis.baseline import Baseline
+        from bobrapet_tpu.analysis.racedetect import default_baseline_path
+
+        Baseline.load(default_baseline_path())  # raises if malformed
+
+
+class TestRegistryDrift:
+    def test_runtime_registry_matches_static_discovery(self):
+        ctx, errors = load_project(REPO_ROOT)
+        assert not errors, errors
+        disc = discover_guarded(
+            [pf for pf in ctx.files if pf.rel.startswith("bobrapet_tpu/")]
+        )
+        assert disc, "no @guarded_state classes discovered statically"
+        reg = {
+            (cls.__module__.replace(".", "/") + ".py", cls.__name__): fields
+            for cls, fields in GUARDED_REGISTRY.items()
+        }
+        assert set(reg) == set(disc), (
+            "runtime registry and static discovery name different classes:\n"
+            f"runtime only: {sorted(set(reg) - set(disc))}\n"
+            f"static only: {sorted(set(disc) - set(reg))}"
+        )
+        for key, info in disc.items():
+            assert tuple(info.declared) == reg[key], key
+            assert set(info.declared) == set(info.containers), (
+                f"{key}: declaration drifted from __init__ containers"
+            )
+
+
+class TestRegressionPins:
+    """The real races fixed alongside this sanitizer stay fixed: each
+    pin drives the pre-fix interleaving under an armed detector."""
+
+    def test_router_gate_parking_vs_promote(self):
+        from bobrapet_tpu.core.store import ResourceStore
+        from bobrapet_tpu.shard.router import ShardRouter
+
+        with sanitize_races() as det:
+            router = ShardRouter(ResourceStore(), "0", shard_count=2)
+            stop = threading.Event()
+
+            def gate_worker(n):
+                i = 0
+                while not stop.is_set() and i < 400:
+                    key = ("storyrun", "default", f"r{n}-{i % 7}")
+                    router.park(key)
+                    router.unpark(key)
+                    i += 1
+
+            def promoter():
+                for epoch in range(1, 40):
+                    router.begin_rebalance(["0", "1"], epoch, 0.0)
+                    router.promote()
+                stop.set()
+
+            _run_all(lambda: gate_worker(1), lambda: gate_worker(2),
+                     promoter)
+        parked_races = [r for r in det.reports if "parked" in r.var]
+        assert not parked_races, det.report_text()
+
+    def test_manager_failure_counters_under_concurrent_reconciles(self):
+        from bobrapet_tpu.controllers.manager import ControllerManager
+        from bobrapet_tpu.core.store import ResourceStore
+
+        with sanitize_races() as det:
+            mgr = ControllerManager(
+                ResourceStore(), requeue_base_delay=0.005,
+                requeue_max_delay=0.02, default_max_concurrent=4,
+            )
+            attempts: dict[str, int] = {}
+            attempts_mu = threading.Lock()
+
+            def flaky(ns, name):
+                with attempts_mu:
+                    n = attempts[name] = attempts.get(name, 0) + 1
+                if n == 1:
+                    raise RuntimeError("first attempt fails")
+                return None
+
+            mgr.register("flaky", flaky, watches={}, max_concurrent=4)
+            mgr.start()
+            try:
+                for i in range(8):
+                    mgr.enqueue("flaky", "default", f"r{i}")
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    with attempts_mu:
+                        if len(attempts) == 8 and all(
+                            v >= 2 for v in attempts.values()
+                        ):
+                            break
+                    time.sleep(0.01)
+            finally:
+                mgr.stop()
+        failure_races = [
+            r for r in det.reports
+            if "_failures" in r.var or "_controllers" in r.var
+        ]
+        assert not failure_races, det.report_text()
+
+    def test_store_registration_from_concurrent_threads(self):
+        from bobrapet_tpu.core.store import ResourceStore
+
+        with sanitize_races() as det:
+            store = ResourceStore()
+
+            def reg(n):
+                for i in range(50):
+                    store.register_validator(f"Kind{n}", lambda o: None)
+                    store.register_defaulter(f"Kind{n}", lambda o: None)
+                    store.register_status_validator(
+                        f"Kind{n}", lambda o: None
+                    )
+
+            _run_all(lambda: reg(1), lambda: reg(2))
+        reg_races = [
+            r for r in det.reports
+            if "validators" in r.var or "_defaulters" in r.var
+        ]
+        assert not reg_races, det.report_text()
+
+
+class TestLockorderBridge:
+    def test_monitor_held_exposes_current_thread_locks(self):
+        from bobrapet_tpu.analysis.lockorder import sanitize_locks
+
+        with sanitize_locks() as mon:
+            mu = threading.Lock()
+            assert mon.held() == []
+            with mu:
+                held = mon.held()
+                assert len(held) == 1
+                assert held[0][0] is mu
+            assert mon.held() == []
+        mon.assert_clean()
+
+    def test_detector_locksets_name_allocation_sites(self):
+        with sanitize_races(include_tests=True) as det:
+            d = track("t.lockname", {})
+            mu = threading.Lock()
+
+            def w(n):
+                for i in range(40):
+                    with mu:
+                        d[i % 3] = n
+
+            _run_all(lambda: w(1), lambda: w(2))
+            # the same lock instance must map to one stable lockset name
+            assert len(det._lock_seq) >= 1
+        assert not det.reports

@@ -48,6 +48,19 @@ def _lock_order_sanitizer():
     monitor.assert_clean()
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _race_sanitizer(_lock_order_sanitizer):
+    """bobrarace over the sharded e2e suite: router parked sets, store
+    indexes and dispatcher pools across N shard managers are tracked
+    (see test_concurrency.py for the contract). The churn soak arms a
+    seeded JitterSchedule on top (BOBRA_RACE_SEED replays a failure)."""
+    from bobrapet_tpu.analysis.racedetect import sanitize_races
+
+    with sanitize_races(monitor=_lock_order_sanitizer) as det:
+        yield det
+    det.assert_clean()
+
+
 def _install_workload(cp: ShardedControlPlane, entry: str,
                       sleep_s: float = 0.0, steps: int = 1) -> None:
     """A ``steps``-deep chain story backed by a sleeping engram."""
@@ -158,7 +171,7 @@ class TestCrossShardHandoff:
 
 
 class TestRebalance:
-    def test_join_and_leave_churn_mid_soak(self):
+    def test_join_and_leave_churn_mid_soak(self, _race_sanitizer):
         """Shard join + graceful leave while runs are in flight: the
         drain/ack/promote barrier must hand families over with zero
         double-owned and zero orphaned runs.
@@ -172,9 +185,18 @@ class TestRebalance:
         The all-succeeded assert below stays armed as the detector —
         if it ever fires again, a NEW lost-work path exists; do not
         de-assert it."""
+        import os as _os
+
+        from bobrapet_tpu.analysis.schedules import JitterSchedule
+
+        # seeded perturbation at every tracked shared-state access: a
+        # race this soak exposes replays from the printed seed via
+        # BOBRA_RACE_SEED=<seed> (see docs/ANALYSIS.md, bobrarace)
+        seed = int(_os.environ.get("BOBRA_RACE_SEED", "1337"))
+        print(f"bobrarace churn soak: JitterSchedule seed={seed}")
         cp = ShardedControlPlane(shards=2, heartbeat_interval=0.25,
                                  member_ttl=3.0, lease_duration=4.0)
-        with cp:
+        with _race_sanitizer.scoped_schedule(JitterSchedule(seed)), cp:
             cp.wait_members({"0", "1"})
             _install_workload(cp, "shard-churn", sleep_s=0.05, steps=2)
             runs = []
@@ -431,18 +453,24 @@ class TestShardedSoak:
         assert min(epochs) >= 2, f"join never promoted: {epochs}"
 
     @pytest.mark.slow
-    def test_long_churn_soak(self):
+    def test_long_churn_soak(self, _race_sanitizer):
         """The long leg: repeated join/leave cycles under sustained
         load — minutes of wall clock, excluded from tier-1."""
+        import os as _os
+
+        from bobrapet_tpu.analysis.schedules import JitterSchedule
+
         def configure(cfg):
             cfg.scheduling.global_max_concurrent_steps = self.CAP_PER_SHARD
             cfg.scheduling.queue_probe_interval = 0.05
 
+        seed = int(_os.environ.get("BOBRA_RACE_SEED", "20260807"))
+        print(f"bobrarace long churn soak: JitterSchedule seed={seed}")
         cp = ShardedControlPlane(
             shards=2, heartbeat_interval=0.25, member_ttl=3.0,
             lease_duration=4.0, configure=configure,
         )
-        with cp:
+        with _race_sanitizer.scoped_schedule(JitterSchedule(seed)), cp:
             cp.wait_members({"0", "1"})
             _install_workload(cp, "shard-churn-long", sleep_s=0.05,
                               steps=2)

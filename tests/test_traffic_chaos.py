@@ -35,6 +35,28 @@ from bobrapet_tpu.traffic import (
 pytestmark = [pytest.mark.slow, pytest.mark.chaos]
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _lock_order_sanitizer():
+    """Lockdep for the traffic chaos soak (see test_concurrency.py)."""
+    from bobrapet_tpu.analysis.lockorder import sanitize_locks
+
+    with sanitize_locks() as monitor:
+        yield monitor
+    monitor.assert_clean()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _race_sanitizer(_lock_order_sanitizer):
+    """bobrarace over the traffic harness: loadgen user tables, fair
+    queues, autoscaler pools and serving router queues are tracked
+    (see test_concurrency.py for the contract)."""
+    from bobrapet_tpu.analysis.racedetect import sanitize_races
+
+    with sanitize_races(monitor=_lock_order_sanitizer) as det:
+        yield det
+    det.assert_clean()
+
+
 @pytest.fixture(scope="module")
 def model():
     cfg = llama.llama_tiny()
