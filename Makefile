@@ -2,7 +2,7 @@
 
 NATIVE_SO  := native/libblobcache.so native/libstreamhub.so
 
-.PHONY: all native test test-e2e test-e2e-apiserver test-e2e-kind lint analyze race bench clean crds chart image
+.PHONY: all native test test-e2e test-e2e-apiserver test-e2e-kind lint analyze race soak-procs bench clean crds chart image
 
 all: native
 
@@ -52,6 +52,16 @@ race:
 		tests/test_concurrency.py tests/test_dispatcher_concurrency.py \
 		tests/test_shard_e2e.py tests/test_fleet_chaos.py \
 		tests/test_traffic_chaos.py tests/test_racedetect.py -q
+
+# Process-mode soak: real shard manager PROCESSES (kill -9 + store
+# service crash chaos) over the durable store service, including the
+# slow acceptance leg, with bobrarace armed on the parent-side shims.
+# timeout(1)-guarded because orphaned grandchildren are the failure
+# mode here — the suites' plane fixtures reap on any exit, and the
+# hard deadline bounds a wedged parent too.
+soak-procs:
+	BOBRA_RACE_STRICT_STALE=1 timeout -k 15 900 python -m pytest \
+		tests/test_proc_soak.py tests/test_store_service.py -q -rs
 
 bench: native
 	python bench.py

@@ -115,6 +115,24 @@ def _classify_call(call: ast.Call) -> Optional[str]:
     return None
 
 
+def _same_file_callee(func: ast.AST) -> Optional[str]:
+    """Name a call target that can plausibly resolve to a function
+    defined in this file: a bare name (``helper()``) or a self/cls
+    method (``self._persist()``). Attribute calls through any OTHER
+    receiver are rejected — ``self._defaulters.get(...)`` is a dict
+    read, and bare-name matching used to make it inherit whatever a
+    same-file method named ``get`` does."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if (
+        isinstance(func, ast.Attribute)
+        and isinstance(func.value, ast.Name)
+        and func.value.id in ("self", "cls")
+    ):
+        return func.attr
+    return None
+
+
 def _blocking_functions(tree: ast.Module) -> dict[str, str]:
     """Map bare function/method name -> kernel of a blocking call it
     performs, propagated through same-file call edges to a fixed point
@@ -133,7 +151,7 @@ def _blocking_functions(tree: ast.Module) -> dict[str, str]:
             if kernel is not None:
                 direct.setdefault(node.name, kernel)
             else:
-                t = terminal_name(call.func)
+                t = _same_file_callee(call.func)
                 if t is not None:
                     callees.add(t)
         edges[node.name] = callees
@@ -194,7 +212,7 @@ class _Visitor(ast.NodeVisitor):
             if isinstance(child, ast.Call):
                 kernel = _classify_call(child)
                 if kernel is None:
-                    t = terminal_name(child.func)
+                    t = _same_file_callee(child.func)
                     if t in self.blocking_fns:
                         kernel = f"{t}(): {self.blocking_fns[t]}"
                 if kernel is not None:

@@ -349,6 +349,22 @@ class TimeoutDefaults:
 
 
 @dataclasses.dataclass
+class StoreServiceConfig:
+    """Store-service durability knobs (``store.*``; consumed live by the
+    store-service process — a reload retunes the running journal's
+    group-commit cap via :meth:`~bobrapet_tpu.store_service.journal.
+    Journal.set_fsync_batch` without restarting the service)."""
+
+    #: records that may share one group-committed fsync; 1 = per-record
+    #: fsync, the durability-latency baseline the bench compares against
+    #: (dotted: store.journal-fsync-batch)
+    journal_fsync_batch: int = 64
+    #: journal records between snapshot+truncate compactions — bounds
+    #: crash-recovery replay length (dotted: store.snapshot-every-records)
+    snapshot_every_records: int = 4096
+
+
+@dataclasses.dataclass
 class OperatorConfig:
     """The full operator config tree
     (reference: ControllerConfig controller_config.go:55-168)."""
@@ -365,6 +381,7 @@ class OperatorConfig:
     engram: EngramDefaults = dataclasses.field(default_factory=EngramDefaults)
     retention: RetentionDefaults = dataclasses.field(default_factory=RetentionDefaults)
     timeouts: TimeoutDefaults = dataclasses.field(default_factory=TimeoutDefaults)
+    store: StoreServiceConfig = dataclasses.field(default_factory=StoreServiceConfig)
     reference_cross_namespace_policy: str = "deny"  # deny | grant | allow
     max_story_with_block_size_bytes: int = 256 * 1024
     default_retry_max: int = 3
@@ -473,6 +490,12 @@ class OperatorConfig:
             errs.append("telemetry.profiler-depth must be >= 1")
         if self.engram.max_inline_size < 0:
             errs.append("engram.maxInlineSize must be >= 0")
+        if self.store.journal_fsync_batch < 1:
+            # 0 would mean "never fsync" — a durability knob must not be
+            # able to disable durability by typo
+            errs.append("store.journal-fsync-batch must be >= 1")
+        if self.store.snapshot_every_records < 1:
+            errs.append("store.snapshot-every-records must be >= 1")
         for qname, q in self.scheduling.queues.items():
             if q.max_concurrent < 0:
                 errs.append(f"queue {qname}: maxConcurrent must be >= 0")
@@ -545,6 +568,8 @@ def _apply_dotted(cfg: OperatorConfig, key: str, value: str) -> bool:
         "traffic.queue-depth-per-replica": lambda: fset(cfg.traffic, "queue_depth_per_replica", int),
         "traffic.scale-up-cooldown": lambda: fset(cfg.traffic, "scale_up_cooldown_seconds", as_dur),
         "traffic.scale-down-cooldown": lambda: fset(cfg.traffic, "scale_down_cooldown_seconds", as_dur),
+        "store.journal-fsync-batch": lambda: fset(cfg.store, "journal_fsync_batch", int),
+        "store.snapshot-every-records": lambda: fset(cfg.store, "snapshot_every_records", int),
         "storage.disk-cache-enabled": lambda: fset(cfg.storage, "disk_cache_enabled", as_bool),
         "storage.disk-cache-dir": lambda: fset(cfg.storage, "disk_cache_dir", str),
         "storage.disk-cache-bytes": lambda: fset(cfg.storage, "disk_cache_bytes", int),

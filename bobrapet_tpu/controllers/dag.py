@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 import threading
 from typing import Any, Optional
 
@@ -233,7 +234,9 @@ class DAGEngine:
         #: is shard-local dispatch capacity (see owned_filter above),
         #: so one shard's in-flight reservations must not shrink
         #: another's budget. Named queues share their string keys.
-        self._global_bucket = ("global", id(self))
+        #: pid + id: in process mode the gate map is served centrally,
+        #: and id(self) alone collides across interpreters
+        self._global_bucket = ("global", os.getpid(), id(self))
         #: runs parked behind a capacity gate (queueWaiting /
         #: placementWaiting) as of their last reconcile. A terminal
         #: StepRun frees capacity, so the runtime wakes entries from

@@ -940,6 +940,32 @@ class _ControlPlaneMetrics:
             ["controller"],
             buckets=(0.0001, 0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
         )
+        # Store-service journal (group-committed fsync write path; the
+        # durability cost every process-mode commit pays before its watch
+        # event becomes visible)
+        self.store_journal_append_latency = h(
+            "bobrapet_store_journal_append_latency_seconds",
+            "Commit-to-durable wait per journaled write (group commit)",
+            [],
+            buckets=(0.00005, 0.0002, 0.001, 0.005, 0.02, 0.1, 0.5, 2.0),
+        )
+        self.store_journal_fsync_batch = h(
+            "bobrapet_store_journal_fsync_batch_records",
+            "Records made durable per fsync (group-commit batch size)",
+            [],
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+        )
+        self.store_journal_snapshot_duration = h(
+            "bobrapet_store_journal_snapshot_duration_seconds",
+            "Snapshot+truncate pause per journal compaction",
+            [],
+            buckets=(0.001, 0.01, 0.05, 0.1, 0.5, 2.0, 10.0),
+        )
+        self.store_journal_replay_rate = g(
+            "bobrapet_store_journal_replay_records_per_second",
+            "Journal replay throughput measured at the last recovery",
+            [],
+        )
 
 
 metrics = _ControlPlaneMetrics(REGISTRY)

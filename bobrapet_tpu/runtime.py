@@ -1053,3 +1053,19 @@ class Runtime:
         for sts in self.store.list(STATEFULSET_KIND, namespace):
             manifests.extend(m.materialize_deployment(sts, kind="StatefulSet"))
         return manifests
+
+
+def register_core_indexes(store) -> None:
+    """Register the full core field-index inventory on a bare store.
+
+    The store-service process calls this at boot so list/count stay
+    O(bucket) SERVER-side for every shard process sharing the bus — the
+    same inventory a Runtime registers, without constructing one (index
+    functions cannot cross the wire, so they must live where the
+    objects do). ``_register_indexes`` only reads ``self.store``, so a
+    one-field shim reuses it verbatim and the two inventories cannot
+    drift.
+    """
+    from types import SimpleNamespace
+
+    Runtime._register_indexes(SimpleNamespace(store=store))

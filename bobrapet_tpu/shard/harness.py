@@ -26,6 +26,19 @@ from .ring import DEFAULT_VNODES
 
 
 class ShardedControlPlane:
+    def __new__(cls, *args, processes: bool = False, **kwargs):
+        """``processes=True`` returns the process-mode harness instead:
+        one OS process per shard plus a durable store-service process
+        (``procharness.ProcessShardedControlPlane``, which takes
+        ``config_data``/``workload`` in place of ``configure``). The
+        returned object is not a ShardedControlPlane, so ``__init__``
+        below never runs on it — kwargs pass through untouched."""
+        if processes and cls is ShardedControlPlane:
+            from .procharness import ProcessShardedControlPlane
+
+            return ProcessShardedControlPlane(*args, **kwargs)
+        return super().__new__(cls)
+
     def __init__(
         self,
         shards: int = 2,
@@ -35,6 +48,7 @@ class ShardedControlPlane:
         lease_duration: float = 4.0,
         vnodes: int = DEFAULT_VNODES,
         configure: Optional[Callable] = None,
+        processes: bool = False,
     ):
         from ..runtime import Runtime  # late: runtime imports this package
 

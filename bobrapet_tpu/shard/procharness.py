@@ -1,0 +1,664 @@
+"""N real OS processes over one durable store service, for tests + bench.
+
+``ProcessShardedControlPlane`` is the process-mode sibling of
+:class:`.harness.ShardedControlPlane` (construct the latter with
+``processes=True`` to get one of these): it spawns the store service as
+its own process (``python -m bobrapet_tpu.store_service`` over a Unix
+socket, journal + snapshots in a scratch data dir) and one shard
+manager **process** per shard (``python -m
+bobrapet_tpu.shard.procharness --child``). Each child builds a full
+Runtime against a :class:`..store_service.client.StoreClient`, so the
+whole PR-6 contract — fenced map publish, member TTL expiry,
+drain/ack/promote barriers — runs across real process boundaries, and
+``kill_shard`` is a real ``SIGKILL``: no crash() courtesy call, no
+in-process cleanup, exactly the death the lease-TTL takeover paths
+exist for. ``kill_store_service`` / ``restart_store_service`` extend
+the same honesty to the bus itself (clients reconnect + resync;
+recovery replays the journal).
+
+What in-process shards could never show — CPU parallelism past one
+GIL — is what this harness exists to measure; what it cannot use are
+in-process conveniences: no shared detector, recorder or configure
+callback. Control flows through bus resources instead: the parent
+writes a ``ShardControl`` command to stop/leave a child, and a child
+exiting gracefully publishes a ``ShardReport`` (reconcile counts,
+per-process double-reconcile violations, ChipLedger imbalance) the
+parent collects in :attr:`reports`. Cross-process exactly-once
+retirement is asserted parent-side: a watch on StoryRuns counts
+transitions into a terminal phase, which must be exactly one per run.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Optional
+
+from ..analysis.racedetect import guarded_state
+from ..api.enums import Phase
+from ..api.runs import STORY_RUN_KIND, make_storyrun
+from ..core.object import new_resource
+from ..core.store import Conflict, NotFound
+from ..utils.naming import compose_unique
+from .map import SHARD_MAP_KIND, SHARD_MAP_NAME, SHARD_NAMESPACE
+from .ring import DEFAULT_VNODES
+
+SHARD_CONTROL_KIND = "ShardControl"
+SHARD_REPORT_KIND = "ShardReport"
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_TERMINAL = (Phase.SUCCEEDED, Phase.FAILED)
+
+
+def _child_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    path = env.get("PYTHONPATH")
+    env["PYTHONPATH"] = _REPO_ROOT + (os.pathsep + path if path else "")
+    return env
+
+
+@guarded_state("_children", "_logs", "_run_phase_seen", "_shard_options",
+               "_terminal_counts", "config_data", "reports")
+class ProcessShardedControlPlane:
+    """Mirror of ``ShardedControlPlane``'s surface over real processes.
+
+    Differences forced by the process boundary:
+
+    - ``configure`` (a callable) cannot cross the wire — pass
+      ``config_data`` (dotted operator-config keys, e.g.
+      ``{"scheduling.global-max-concurrent-steps": "2"}``) and the
+      parent publishes the ConfigMap before any child boots;
+    - ``workload`` is a ``"module:function"`` spec imported INSIDE each
+      child to register engram entrypoints there (callables cannot be
+      applied through the store; resources still apply from the parent);
+    - there is no shared ``detector`` — each child runs its own and
+      publishes the verdict in its ShardReport on graceful exit.
+    """
+
+    def __init__(
+        self,
+        shards: int = 2,
+        executor_mode: str = "threaded",
+        heartbeat_interval: float = 0.25,
+        member_ttl: float = 3.0,
+        lease_duration: float = 4.0,
+        vnodes: int = DEFAULT_VNODES,
+        workload: str = "tests.proc_workload:install",
+        config_data: Optional[dict] = None,
+        base_dir: Optional[str] = None,
+        fsync_batch: Optional[int] = None,
+        snapshot_every: Optional[int] = None,
+    ):
+        self._lock = threading.Lock()
+        self.executor_mode = executor_mode
+        self.workload = workload
+        self.config_data = dict(config_data or {})
+        self._bootstrap_count = max(1, int(shards))
+        self._shard_options = {
+            "heartbeat_interval": heartbeat_interval,
+            "member_ttl": member_ttl,
+            "lease_duration": lease_duration,
+            "vnodes": vnodes,
+        }
+        self._fsync_batch = fsync_batch
+        self._snapshot_every = snapshot_every
+        self._dir = base_dir or tempfile.mkdtemp(prefix="bobra-proc-")
+        #: socket paths cap at ~107 bytes; a mkdtemp under /tmp fits
+        self.socket_path = os.path.join(self._dir, "store.sock")
+        self.data_dir = os.path.join(self._dir, "store")
+        self._service: Optional[subprocess.Popen] = None
+        self.store = None  # parent StoreClient, built in start()
+        self._children: dict[str, subprocess.Popen] = {}
+        self._logs: dict[str, object] = {}
+        #: sid -> ShardReport spec, collected at graceful child exit
+        self.reports: dict[str, dict] = {}
+        #: run name -> count of transitions INTO a terminal phase
+        #: (exactly-once retirement, observed from outside every shard)
+        self._terminal_counts: dict[str, int] = {}
+        self._run_phase_seen: dict[str, Optional[str]] = {}
+        self._next_id = 0
+        self._started = False
+
+    # -- store service -----------------------------------------------------
+    def _spawn_service(self) -> None:
+        cmd = [
+            sys.executable, "-m", "bobrapet_tpu.store_service",
+            "--socket", self.socket_path, "--data-dir", self.data_dir,
+        ]
+        if self._fsync_batch is not None:
+            cmd += ["--fsync-batch", str(self._fsync_batch)]
+        if self._snapshot_every is not None:
+            cmd += ["--snapshot-every", str(self._snapshot_every)]
+        log = self._open_log("store-service")
+        proc = subprocess.Popen(
+            cmd, env=_child_env(), stdout=log, stderr=subprocess.STDOUT,
+        )
+        with self._lock:
+            self._service = proc
+        deadline = time.monotonic() + 30.0
+        while not os.path.exists(self.socket_path):
+            if self._service.poll() is not None:
+                raise RuntimeError(
+                    f"store service died at startup (rc={self._service.returncode}); "
+                    f"see {self._log_path('store-service')}"
+                )
+            if time.monotonic() > deadline:
+                raise AssertionError("store service never bound its socket")
+            time.sleep(0.02)
+
+    def kill_store_service(self) -> None:
+        """SIGKILL the bus itself: clients must survive by reconnecting
+        after :meth:`restart_store_service` replays the journal."""
+        svc = self._service
+        assert svc is not None and svc.poll() is None, "service not running"
+        svc.kill()
+        svc.wait(timeout=10.0)
+
+    def restart_store_service(self) -> None:
+        """Respawn over the SAME data dir — recovery is journal replay,
+        not amnesia. Parent + child clients redial and resync."""
+        self._spawn_service()
+
+    def dump_store(self) -> bytes:
+        """Canonical state bytes via the live service (see
+        ``DurableResourceStore.dump`` / ``dump_recovered`` — the
+        byte-identity pair for crash-recovery asserts)."""
+        return self.store.dump_remote()
+
+    # -- membership --------------------------------------------------------
+    def add_shard(self) -> str:
+        sid = str(self._next_id)
+        self._next_id += 1
+        cmd = [
+            sys.executable, "-m", "bobrapet_tpu.shard.procharness", "--child",
+            "--socket", self.socket_path,
+            "--shard-id", sid,
+            "--bootstrap", str(self._bootstrap_count),
+            "--executor-mode", self.executor_mode,
+            "--heartbeat-interval", str(self._shard_options["heartbeat_interval"]),
+            "--member-ttl", str(self._shard_options["member_ttl"]),
+            "--lease-duration", str(self._shard_options["lease_duration"]),
+            "--vnodes", str(self._shard_options["vnodes"]),
+            "--workload", self.workload,
+        ]
+        log = self._open_log(f"shard-{sid}")
+        proc = subprocess.Popen(
+            cmd, env=_child_env(), stdout=log, stderr=subprocess.STDOUT,
+        )
+        with self._lock:
+            self._children[sid] = proc
+        return sid
+
+    def leave_shard(self, sid: str, timeout: float = 60.0) -> None:
+        """Graceful leave via the bus: the child drains, acks the
+        removal barrier, retires, publishes its report and exits 0."""
+        self._command(sid, "leave")
+        self._await_child_exit(sid, timeout, expect_clean=True)
+
+    def stop_shard(self, sid: str, timeout: float = 60.0) -> None:
+        """Stop without leaving the ring (process shutdown, member TTL
+        left to expire) — the restart-shaped exit."""
+        self._command(sid, "stop")
+        self._await_child_exit(sid, timeout, expect_clean=True)
+
+    def kill_shard(self, sid: str) -> None:
+        """A real ``kill -9``. Nothing in the child runs again — no
+        crash() flag, no lease release, no report. The survivors must
+        detect the stale heartbeat / outlive the lease TTL exactly as
+        they would for a production manager OOM-kill."""
+        with self._lock:
+            proc = self._children.pop(sid)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10.0)
+
+    def _command(self, sid: str, command: str) -> None:
+        name = f"shard-{sid}"
+        try:
+            self.store.create(new_resource(
+                SHARD_CONTROL_KIND, name, SHARD_NAMESPACE, {"command": command}
+            ))
+        except Exception:  # noqa: BLE001 - exists (or raced): mutate it
+            self.store.mutate(
+                SHARD_CONTROL_KIND, SHARD_NAMESPACE, name,
+                lambda r: r.spec.__setitem__("command", command),
+            )
+
+    def _await_child_exit(self, sid: str, timeout: float,
+                          expect_clean: bool) -> None:
+        with self._lock:
+            proc = self._children.pop(sid)
+        try:
+            rc = proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise AssertionError(
+                f"shard {sid} ignored its control command for {timeout}s; "
+                f"see {self._log_path(f'shard-{sid}')}"
+            ) from None
+        if expect_clean and rc != 0:
+            raise AssertionError(
+                f"shard {sid} exited rc={rc}; see {self._log_path(f'shard-{sid}')}"
+            )
+        self._collect_report(sid)
+
+    def _collect_report(self, sid: str) -> None:
+        rep = self.store.try_get(SHARD_REPORT_KIND, SHARD_NAMESPACE, str(sid))
+        if rep is not None:
+            with self._lock:
+                self.reports[sid] = dict(rep.spec)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ProcessShardedControlPlane":
+        from ..config import OperatorConfigManager
+        from ..config.operator import CONFIG_MAP_KIND
+        from ..store_service.client import StoreClient
+        from ..templating.engine import Evaluator, TemplateConfig
+        from ..webhooks import register_webhooks
+
+        self._spawn_service()
+        self.store = StoreClient(self.socket_path)
+        # the parent is an API client like any other: its creates must
+        # pass the same defaulting/validation chain the shards run
+        cfgman = OperatorConfigManager(self.store)
+        register_webhooks(
+            self.store, Evaluator(TemplateConfig()), cfgman, enabled=True
+        )
+        if self.config_data:
+            # publish BEFORE any child boots: children read the
+            # ConfigMap at Runtime construction, not only on reloads
+            self.store.create(new_resource(
+                CONFIG_MAP_KIND, "operator-config", SHARD_NAMESPACE,
+                {"data": {k: str(v) for k, v in self.config_data.items()}},
+            ))
+        self.store.watch(self._on_run_event, kinds=[STORY_RUN_KIND])
+        self._started = True
+        for _ in range(self._bootstrap_count):
+            self.add_shard()
+        return self
+
+    def stop(self, timeout: float = 60.0) -> None:
+        """Graceful teardown: stop children (collecting reports), then
+        the service. Always followed by :meth:`reap` in fixtures."""
+        self._started = False
+        with self._lock:
+            sids = list(self._children)
+        for sid in sids:
+            try:
+                self.stop_shard(sid, timeout=timeout)
+            except Exception:
+                if self._children_alive() or self._service_alive():
+                    raise
+        svc = self._service
+        if svc is not None and svc.poll() is None:
+            svc.terminate()
+            try:
+                svc.wait(timeout=15.0)
+            except subprocess.TimeoutExpired:
+                svc.kill()
+                svc.wait(timeout=10.0)
+
+    def reap(self) -> None:
+        """Finalizer: SIGKILL anything still alive, close the client
+        and every log handle. Idempotent; never raises."""
+        with self._lock:
+            procs = list(self._children.values())
+            self._children = {}
+        svc = self._service
+        if svc is not None:
+            procs.append(svc)
+        for proc in procs:
+            try:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=10.0)
+            except Exception:  # noqa: BLE001 - reaping is best-effort
+                pass
+        if self.store is not None:
+            try:
+                self.store.close()
+            except Exception:  # noqa: BLE001
+                pass
+        with self._lock:
+            logs, self._logs = dict(self._logs), {}
+        for handle in logs.values():
+            try:
+                handle.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def __enter__(self) -> "ProcessShardedControlPlane":
+        if not self._started:
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _children_alive(self) -> bool:
+        with self._lock:
+            return any(p.poll() is None for p in self._children.values())
+
+    def _service_alive(self) -> bool:
+        return self._service is not None and self._service.poll() is None
+
+    def logs(self, name: str) -> str:
+        """Tail of one process log (``store-service`` / ``shard-<sid>``)
+        for assertion forensics."""
+        try:
+            with open(self._log_path(name), "r", encoding="utf-8",
+                      errors="replace") as fh:
+                return fh.read()[-8000:]
+        except OSError:
+            return ""
+
+    def _log_path(self, name: str) -> str:
+        return os.path.join(self._dir, f"{name}.log")
+
+    def _open_log(self, name: str):
+        handle = open(self._log_path(name), "ab")
+        with self._lock:
+            self._logs[name] = handle
+        return handle
+
+    # -- exactly-once retirement (parent-side observer) --------------------
+    def _on_run_event(self, ev) -> None:
+        res = ev.resource
+        name = f"{res.meta.namespace}/{res.meta.name}"
+        phase = (res.status or {}).get("phase")
+        with self._lock:
+            prev = self._run_phase_seen.get(name)
+            self._run_phase_seen[name] = phase
+            if phase in _TERMINAL and prev not in _TERMINAL:
+                self._terminal_counts[name] = self._terminal_counts.get(name, 0) + 1
+
+    def terminal_transitions(self, run: str, namespace: str = "default") -> int:
+        with self._lock:
+            return self._terminal_counts.get(f"{namespace}/{run}", 0)
+
+    def assert_exactly_once(self, runs, namespace: str = "default") -> None:
+        """Every run retired exactly once, as observed from the bus.
+        Two shards finishing one family would each drive a terminal
+        transition; zero means the run was lost."""
+        bad = {r: self.terminal_transitions(r, namespace)
+               for r in runs if self.terminal_transitions(r, namespace) != 1}
+        assert not bad, f"runs not retired exactly once: {bad}"
+
+    def terminal_count_violations(self) -> dict:
+        """Runs observed retiring MORE than once, over every run this
+        plane ever watched (entries exist only once a run turns
+        terminal, so in-flight runs are not false positives). The bench
+        gates on this when it never learned individual run names."""
+        with self._lock:
+            return {r: c for r, c in self._terminal_counts.items() if c != 1}
+
+    # -- convenience (mirrors ShardedControlPlane) -------------------------
+    def apply(self, resource):
+        existing = self.store.try_get(
+            resource.kind, resource.meta.namespace, resource.meta.name
+        )
+        if existing is None:
+            return self.store.create(resource)
+
+        def sync(r) -> None:
+            r.spec = dict(resource.spec)
+            r.meta.labels.update(resource.meta.labels)
+            r.meta.annotations.update(resource.meta.annotations)
+
+        return self.store.mutate(
+            resource.kind, resource.meta.namespace, resource.meta.name, sync
+        )
+
+    def run_story(self, story: str, inputs=None, name=None,
+                  namespace: str = "default") -> str:
+        run_name = name or compose_unique(
+            story, "run", str(self.store._rv_counter))
+        self.store.create(make_storyrun(run_name, story, inputs, namespace))
+        return run_name
+
+    def run_phase(self, run_name: str, namespace: str = "default"):
+        run = self.store.try_get(STORY_RUN_KIND, namespace, run_name)
+        return run.status.get("phase") if run is not None else None
+
+    def members_settled(self, expected: set[str]) -> bool:
+        """The published map lists exactly ``expected`` AND every member
+        has acked the map's epoch (the barrier cleared) — the
+        outside-observer form of the in-process router check."""
+        m = self.store.try_get(SHARD_MAP_KIND, SHARD_NAMESPACE, SHARD_MAP_NAME)
+        if m is None:
+            return False
+        members = {str(x) for x in (m.spec.get("members") or [])}
+        if members != set(expected):
+            return False
+        epoch = int(m.spec.get("epoch") or 0)
+        acks = (m.status or {}).get("acks") or {}
+        return all(int(acks.get(s, 0)) >= epoch for s in members)
+
+    def wait_members(self, expected: set[str], timeout: float = 60.0) -> None:
+        def detail() -> str:
+            m = self.store.try_get(
+                SHARD_MAP_KIND, SHARD_NAMESPACE, SHARD_MAP_NAME)
+            return (
+                f"map never settled on {sorted(expected)}: "
+                f"spec={m and m.spec} status={m and m.status}"
+            )
+
+        self.wait_until(lambda: self.members_settled(expected), timeout, detail)
+
+    def steady_state_steps_per_sec(
+        self,
+        story: str,
+        window: int,
+        measure_s: float = 6.0,
+        warmup_s: float = 2.5,
+        namespace: str = "default",
+        drain_timeout: float = 60.0,
+    ) -> float:
+        """Same closed-loop measurement as the in-process harness (keep
+        ``window`` outstanding, count completions inside the timed
+        window only) — over RPCs, so the parent's polling cost is part
+        of the measured client-side reality."""
+        outstanding: list[str] = []
+        submitted = done_meas = 0
+        warm_end = time.perf_counter() + warmup_s
+        t_meas0 = None
+        while True:
+            now = time.perf_counter()
+            if t_meas0 is None and now >= warm_end:
+                t_meas0 = now
+            if t_meas0 is not None and now - t_meas0 >= measure_s:
+                break
+            while len(outstanding) < window:
+                outstanding.append(self.run_story(
+                    story, inputs={"i": submitted}, namespace=namespace))
+                submitted += 1
+            still = []
+            for r in outstanding:
+                if self.run_phase(r, namespace) in _TERMINAL:
+                    done_meas += t_meas0 is not None
+                else:
+                    still.append(r)
+            outstanding = still
+            time.sleep(0.02)
+        wall = time.perf_counter() - t_meas0
+        self.wait_runs(outstanding, timeout=drain_timeout, namespace=namespace)
+        return done_meas / wall
+
+    def wait_runs(self, runs, timeout: float = 60.0,
+                  namespace: str = "default") -> None:
+        remaining = set(runs)
+        deadline = time.monotonic() + timeout
+        while remaining:
+            for r in list(remaining):
+                if self.run_phase(r, namespace) in _TERMINAL:
+                    remaining.discard(r)
+            if not remaining:
+                return
+            if time.monotonic() > deadline:
+                sample = [(r, self.run_phase(r, namespace))
+                          for r in list(remaining)[:5]]
+                raise AssertionError(
+                    f"{len(remaining)} runs not terminal after {timeout}s; "
+                    f"sample: {sample}"
+                )
+            time.sleep(0.1)
+
+    @staticmethod
+    def wait_until(cond, timeout: float, message="condition not met",
+                   interval: float = 0.02) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return
+            time.sleep(interval)
+        raise AssertionError(message() if callable(message) else message)
+
+
+# ---------------------------------------------------------------------------
+# child entrypoint: one shard manager process
+# ---------------------------------------------------------------------------
+
+def _load_workload(spec: str) -> None:
+    """Import ``module:function`` and call it — engram entrypoints must
+    register in THIS interpreter; the executor runs here."""
+    import importlib
+
+    mod_name, _, fn_name = spec.partition(":")
+    fn = getattr(importlib.import_module(mod_name), fn_name)
+    fn()
+
+
+def _publish_report(store, sid: str, detector, reason: str) -> None:
+    from ..observability.analytics import LEDGER
+
+    spec = {
+        "shard": sid,
+        "exit": reason,
+        "processed": int(detector.processed.get(sid, 0)),
+        "violations": [
+            f"{v.root} on {list(v.shards)} ({v.controller} {v.key})"
+            for v in detector.violations
+        ],
+        "ledgerUnbalanced": list(LEDGER.unbalanced()),
+    }
+    try:
+        store.create(new_resource(SHARD_REPORT_KIND, sid, SHARD_NAMESPACE, spec))
+    except Exception:  # noqa: BLE001 - restarted shard: replace the old report
+        try:
+            def mut(r):
+                r.spec = spec
+
+            store.mutate(SHARD_REPORT_KIND, SHARD_NAMESPACE, sid, mut)
+        except (Conflict, NotFound):
+            pass
+
+
+def child_main(argv=None) -> int:
+    import argparse
+    import logging
+
+    parser = argparse.ArgumentParser(
+        prog="python -m bobrapet_tpu.shard.procharness")
+    parser.add_argument("--child", action="store_true", required=True)
+    parser.add_argument("--socket", required=True)
+    parser.add_argument("--shard-id", required=True)
+    parser.add_argument("--bootstrap", type=int, required=True)
+    parser.add_argument("--executor-mode", default="threaded")
+    parser.add_argument("--heartbeat-interval", type=float, default=0.25)
+    parser.add_argument("--member-ttl", type=float, default=3.0)
+    parser.add_argument("--lease-duration", type=float, default=4.0)
+    parser.add_argument("--vnodes", type=int, default=DEFAULT_VNODES)
+    parser.add_argument("--workload", default="")
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format=(f"%(asctime)s shard-{args.shard_id} "
+                "%(levelname)s %(name)s: %(message)s"),
+    )
+
+    from ..controllers.manager import Clock
+    from ..core.events import EventRecorder
+    from ..runtime import Runtime
+    from ..store_service.client import StoreClient
+    from .detector import DoubleReconcileDetector
+
+    if args.workload:
+        _load_workload(args.workload)
+
+    sid = str(args.shard_id)
+    store = StoreClient(args.socket)
+    detector = DoubleReconcileDetector()
+    rt = Runtime(
+        store=store,
+        clock=Clock(),
+        shard_id=sid,
+        shard_count=args.bootstrap,
+        recorder=EventRecorder(),
+        executor_mode=args.executor_mode,
+        # chains are per-process in service mode: every client runs its
+        # own admission (the in-process harness's first-runtime-only
+        # rule is a shared-store artifact)
+        enable_webhooks=True,
+        shard_options={
+            "heartbeat_interval": args.heartbeat_interval,
+            "member_ttl": args.member_ttl,
+            "lease_duration": args.lease_duration,
+            "vnodes": args.vnodes,
+        },
+    )
+    detector.install(rt)
+    rt.start()
+
+    command_box: list[str] = []
+    got_command = threading.Event()
+    control_name = f"shard-{sid}"
+
+    def on_control(ev) -> None:
+        if ev.resource.meta.name != control_name:
+            return
+        cmd = (ev.resource.spec or {}).get("command")
+        if cmd in ("stop", "leave") and not command_box:
+            command_box.append(cmd)
+            got_command.set()
+
+    store.watch(on_control, kinds=[SHARD_CONTROL_KIND])
+    # a command written before the watch registered must still land
+    pre = store.try_get(SHARD_CONTROL_KIND, SHARD_NAMESPACE, control_name)
+    if pre is not None:
+        cmd = (pre.spec or {}).get("command")
+        if cmd in ("stop", "leave") and not command_box:
+            command_box.append(cmd)
+            got_command.set()
+
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(signum, lambda *_: (
+            command_box.append("stop") if not command_box else None,
+            got_command.set(),
+        ))
+
+    got_command.wait()
+    command = command_box[0]
+    if command == "leave":
+        rt.shard_coordinator.request_leave()
+        deadline = time.monotonic() + 60.0
+        while not rt.shard_coordinator.retired:
+            if time.monotonic() > deadline:
+                _publish_report(store, sid, detector, "leave-timeout")
+                rt.stop()
+                return 3
+            time.sleep(0.05)
+    _publish_report(store, sid, detector, command)
+    rt.stop()
+    store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(child_main())

@@ -125,6 +125,12 @@ class ShardRouter:
         self._rebalance_started: Optional[float] = None
         #: keys currently parked by the gate, for the gauge + tests
         self.parked: set[tuple[str, str, str]] = set()
+        #: fired (outside the lock) after any ring mutation — the
+        #: store-service client pushes the refreshed :meth:`filter_spec`
+        #: to the server so the SERVER-side delivery filter tracks ring
+        #: changes with the same immediacy the in-process drain-time
+        #: evaluation gives already-bound subscriptions
+        self.on_rings_changed: Optional[callable] = None
 
     # -- ring state --------------------------------------------------------
     @property
@@ -158,7 +164,8 @@ class ShardRouter:
             if list(active.members) == members:
                 return False
             self._rings = (HashRing(members, vnodes=self.vnodes), None)
-            return True
+        self._rings_changed()
+        return True
 
     def begin_rebalance(self, members, epoch: int, started_at: float,
                         vnodes: Optional[int] = None) -> None:
@@ -172,6 +179,7 @@ class ShardRouter:
             self._pending_epoch = int(epoch)
             if self._rebalance_started is None:
                 self._rebalance_started = float(started_at)
+        self._rings_changed()
 
     def promote(self) -> tuple[int, int, Optional[float]]:
         """Swap pending -> active at the barrier; returns
@@ -185,7 +193,40 @@ class ShardRouter:
             started = self._rebalance_started
             self._rebalance_started = None
             self.parked.clear()
-            return old_n, len(pending.members), started
+        self._rings_changed()
+        return old_n, len(pending.members), started
+
+    def _rings_changed(self) -> None:
+        """Notify the (optional) filter-push hook OUTSIDE the ring lock
+        — the hook does socket I/O and must not nest under it."""
+        hook = self.on_rings_changed
+        if hook is not None:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 - delivery heals at resync
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "shard %s filter push failed", self.me
+                )
+
+    def filter_spec(self) -> dict:
+        """The declarative, wire-serializable form of :meth:`wants`:
+        rings are deterministic from (members, vnodes) so the store
+        service rebuilds the SAME predicate against its own store with
+        :func:`router_from_spec` and evaluates it server-side — each
+        shard process only ever receives events for families it has an
+        ownership interest in."""
+        active, pending = self._rings  # one atomic load (see __init__)
+        spec = {
+            "me": self.me,
+            "active": {"members": list(active.members),
+                       "vnodes": active.vnodes},
+        }
+        if pending is not None:
+            spec["pending"] = {"members": list(pending.members),
+                               "vnodes": pending.vnodes}
+        return spec
 
     # -- gate parking ------------------------------------------------------
     def park(self, key: tuple[str, str, str]) -> bool:
@@ -340,3 +381,21 @@ class ShardRouter:
         if kind is not None:
             return f"{kind}:{ns}/{name}"
         return None
+
+
+def router_from_spec(store, spec: dict) -> ShardRouter:
+    """Rebuild a shard's delivery predicate from its
+    :meth:`ShardRouter.filter_spec` against ``store`` (the store
+    SERVICE's authoritative store — ``_steprun_root`` needs local
+    lookups, which is exactly why the filter must be reconstructed
+    server-side rather than shipped as a callable)."""
+    active = spec["active"]
+    r = ShardRouter(store, spec["me"], shard_count=1,
+                    vnodes=int(active["vnodes"]))
+    pending = spec.get("pending")
+    r._rings = (  # noqa: SLF001 - deterministic reconstruction
+        HashRing(active["members"], vnodes=int(active["vnodes"])),
+        HashRing(pending["members"], vnodes=int(pending["vnodes"]))
+        if pending else None,
+    )
+    return r

@@ -1,0 +1,34 @@
+"""The store service: the coordination bus as a real OS process.
+
+The reference architecture is controller-runtime over etcd — N manager
+*processes* reconciling against one durable, watch-filtered API server.
+This package is that split for the in-repo bus (``core/store.py``):
+
+- :mod:`wire` — a thin length-prefixed JSON codec over a Unix domain
+  socket (one frame = one request / response / watch event).
+- :mod:`journal` — append-only journal with group-committed fsync
+  batching, periodic snapshot+truncate under the store's commit lock
+  discipline, and crash-recovery replay (``DurableResourceStore``).
+- :mod:`service` — the store-service process: owns the authoritative
+  ``ResourceStore``, serves get/list/commit/watch per session, and
+  evaluates the PR-6 per-watcher watch filters SERVER-side
+  (``shard.router.router_from_spec``) so each shard process only
+  receives events for run families it owns. The bus-wide scheduling
+  gate (named-queue caps) is served here too, so check-then-reserve
+  still serializes across ALL shard processes.
+- :mod:`client` — ``StoreClient``, a shim implementing the existing
+  store surface so Runtime/manager/dag code runs unmodified over the
+  wire; admission (defaulters/validators) runs client-side where the
+  registered callables live.
+- :mod:`backend` — the ``StoreBackend`` seam selecting in-process
+  (default, unit tests) vs service-backed stores.
+
+``python -m bobrapet_tpu.store_service --socket S --data-dir D`` runs
+the service (``__main__``); ``shard/procharness.py`` spawns it plus one
+OS process per shard for the process-mode harness.
+"""
+
+from .backend import StoreBackend, make_store  # noqa: F401
+from .client import StoreClient  # noqa: F401
+from .journal import DurableResourceStore, Journal  # noqa: F401
+from .service import StoreService  # noqa: F401

@@ -1,0 +1,80 @@
+"""Store-service process entrypoint.
+
+    python -m bobrapet_tpu.store_service --socket /run/bobra.sock \
+        --data-dir /var/lib/bobra [--fsync-batch N] [--snapshot-every N]
+
+Owns the durable store, serves every shard manager, and runs an
+OperatorConfigManager over its OWN store so ``store.journal-fsync-batch``
+/ ``store.snapshot-every-records`` live-reload from the same ConfigMap
+resource the shard processes read — one config plane, no side channel.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from ..config.operator import CONFIG_MAP_KIND, OperatorConfigManager
+from .journal import (
+    DEFAULT_FSYNC_BATCH,
+    DEFAULT_SNAPSHOT_EVERY,
+    DurableResourceStore,
+)
+from .service import StoreService
+
+_log = logging.getLogger("bobrapet_tpu.store_service")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m bobrapet_tpu.store_service")
+    parser.add_argument("--socket", required=True, help="Unix socket path to serve on")
+    parser.add_argument("--data-dir", required=True, help="journal + snapshot directory")
+    parser.add_argument("--fsync-batch", type=int, default=DEFAULT_FSYNC_BATCH)
+    parser.add_argument("--snapshot-every", type=int, default=DEFAULT_SNAPSHOT_EVERY)
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s store-service %(levelname)s %(name)s: %(message)s",
+    )
+
+    store = DurableResourceStore(
+        args.data_dir,
+        fsync_batch=args.fsync_batch,
+        snapshot_every=args.snapshot_every,
+    )
+    if store.replayed_records:
+        _log.info(
+            "recovered %d journal records in %.3fs (rv=%d, %d objects)",
+            store.replayed_records, store.replay_duration,
+            store._rv_counter, len(store),
+        )
+    service = StoreService(store, args.socket).start()
+
+    manager = OperatorConfigManager(store)
+
+    def apply_store_config(cfg) -> None:
+        store._journal.set_fsync_batch(cfg.store.journal_fsync_batch)
+        store._snapshot_every = max(1, cfg.store.snapshot_every_records)
+
+    manager.subscribe(apply_store_config)
+    # A ConfigMap recovered from the journal was swapped in before the
+    # subscription existed — apply it once, explicitly. CLI flags stand
+    # only while no operator-config resource does.
+    if store.try_get_view(CONFIG_MAP_KIND, "bobrapet-system", "operator-config"):
+        apply_store_config(manager.config)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    _log.info("serving on %s (data in %s)", args.socket, args.data_dir)
+    stop.wait()
+    service.close()
+    store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

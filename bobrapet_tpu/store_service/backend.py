@@ -1,0 +1,52 @@
+"""StoreBackend: the seam selecting in-process vs service-backed stores.
+
+Unit tests (and every existing call site) keep the zero-setup
+in-process :class:`~..core.store.ResourceStore`; the process harness
+sets ``BOBRA_STORE_BACKEND=service`` (+ ``BOBRA_STORE_SOCKET``) in
+child processes so the same construction path yields a
+:class:`.client.StoreClient` against the shared store service.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from typing import Optional
+
+from ..core.store import ResourceStore, StoreError
+
+ENV_BACKEND = "BOBRA_STORE_BACKEND"
+ENV_SOCKET = "BOBRA_STORE_SOCKET"
+
+
+class StoreBackend(str, enum.Enum):
+    INPROC = "inproc"
+    SERVICE = "service"
+
+
+def make_store(
+    backend: Optional[str] = None,
+    socket_path: Optional[str] = None,
+    **kwargs,
+):
+    """Build the store the current process should coordinate through.
+
+    ``backend`` defaults to ``$BOBRA_STORE_BACKEND`` then "inproc";
+    "service" requires a socket path (argument or
+    ``$BOBRA_STORE_SOCKET``). Extra kwargs pass through to the chosen
+    constructor.
+    """
+    chosen = backend or os.environ.get(ENV_BACKEND) or StoreBackend.INPROC.value
+    if chosen == StoreBackend.INPROC.value:
+        return ResourceStore(**kwargs)
+    if chosen == StoreBackend.SERVICE.value:
+        path = socket_path or os.environ.get(ENV_SOCKET)
+        if not path:
+            raise StoreError(
+                "service store backend needs a socket path "
+                f"(argument or ${ENV_SOCKET})"
+            )
+        from .client import StoreClient
+
+        return StoreClient(path, **kwargs)
+    raise StoreError(f"unknown store backend {chosen!r}")

@@ -1,0 +1,653 @@
+"""StoreClient: the existing store surface, served over the socket.
+
+Runtime/manager/dag code runs unmodified against this shim — same
+methods, same exceptions, same watch/scheduling-gate/view semantics as
+:class:`~..core.store.ResourceStore` — while the authoritative state
+lives in the store-service process. What moves where:
+
+- **admission runs client-side**: defaulters/validators are Python
+  callables registered by whatever process constructs the Runtime, so
+  they cannot cross the wire. create/update fetch the current object,
+  merge exactly as ``ResourceStore._update`` does, run the local
+  chains, and ship the result with the rv they read — the server
+  re-checks the rv atomically at commit, so optimistic concurrency is
+  still decided in exactly one place. The server runs its OWN chain
+  (shard-map fence admission), which is the one that must be atomic
+  with the commit.
+- **watch filters run server-side**: ``set_watch_filter`` with a shard
+  router's ``wants`` pushes the ring spec to the session (and re-pushes
+  on every ring change via ``router.on_rings_changed``), so this
+  process only receives events for families it owns. Local watchers
+  still apply their own kinds/filter on dispatch, same as in-process.
+- **the scheduling gate is remote**: ``scheduling_gate()`` returns
+  (lock proxy, reservations proxy) whose operations are RPCs against
+  the service's single bus-wide gate — named-queue caps never
+  over-admit across shard processes, and the service rolls back a dead
+  session's net reservations so a ``kill -9`` cannot wedge a cap shut.
+- **crash windows are explicit**: on disconnect, idempotent reads
+  retry transparently through reconnect; in-flight mutations raise
+  ``StoreError`` (the caller cannot know whether they committed — the
+  level-triggered reconcile retries); after reconnect the client
+  re-pushes its filter spec and requests a resync (synthetic MODIFIED
+  for all owned state), healing any events lost during the outage.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import socket
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Iterable, Optional
+
+from ..analysis.racedetect import guarded_state
+from ..core.object import Resource
+from ..core.store import (
+    Conflict,
+    NotFound,
+    StoreError,
+    WatchEvent,
+    WatchFilter,
+    WatchHandler,
+)
+from .service import decode_error, encode_key
+from .wire import FrameConn
+
+_log = logging.getLogger(__name__)
+
+
+class _Call:
+    __slots__ = ("event", "result", "error", "retry")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.result: Any = None
+        self.error: Optional[Exception] = None
+        self.retry = False
+
+
+class _GateLock:
+    """Context-manager proxy for the service-side scheduling-gate lock
+    (session-scoped: a dead holder's lock is auto-released)."""
+
+    def __init__(self, client: "StoreClient"):
+        self._client = client
+
+    def acquire(self) -> bool:
+        self._client._call("gate_acquire", _idempotent=True)
+        return True
+
+    def release(self) -> None:
+        try:
+            self._client._call("gate_release", _idempotent=True)
+        except StoreError:
+            pass  # session died while holding: server already released
+
+    def __enter__(self) -> "_GateLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        self.release()
+        return False
+
+
+class _GateMap:
+    """dict-shaped proxy for the bus-wide reservations table (the ops
+    the DAG engines use: get / __setitem__ / pop)."""
+
+    def __init__(self, client: "StoreClient"):
+        self._client = client
+
+    def get(self, key: Any, default: Any = None) -> Any:
+        return self._client._call(
+            "gate_get", _idempotent=True, key=encode_key(key), default=default
+        )
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        self._client._call(
+            "gate_set", _idempotent=True, key=encode_key(key), value=value
+        )
+
+    def pop(self, key: Any, default: Any = None) -> Any:
+        return self._client._call(
+            "gate_pop", _idempotent=True, key=encode_key(key), default=default
+        )
+
+    def __contains__(self, key: Any) -> bool:
+        return self.get(key, None) is not None
+
+
+@guarded_state("_defaulters", "_events", "_indexes", "_pending",
+               "_server_indexes", "_status_validators", "_validators",
+               "_watchers")
+class StoreClient:
+    """Store-surface shim over one store-service session."""
+
+    def __init__(
+        self,
+        socket_path: str,
+        connect_timeout: float = 30.0,
+        reconnect_deadline: float = 15.0,
+    ):
+        self.socket_path = socket_path
+        self._reconnect_deadline = reconnect_deadline
+        self._lock = threading.RLock()
+        # explicit lock under the Condition: a bare Condition() allocates
+        # its RLock inside stdlib threading, where the lock-order
+        # sanitizer deliberately does not look — the event queue would
+        # run untracked in the armed suites
+        self._ev_lock = threading.Lock()
+        self._ev_cond = threading.Condition(self._ev_lock)
+        self._pending: dict[int, _Call] = {}
+        self._events: deque = deque()  # raw event frames awaiting dispatch
+        self._watchers: list = []
+        self._indexes: dict[tuple[str, str], Callable] = {}
+        self._defaulters: dict[str, list] = {}
+        self._validators: dict[str, list] = {}
+        self._status_validators: dict[str, list] = {}
+        self._server_indexes: frozenset = frozenset()
+        self._default_watch_filter: Optional[WatchFilter] = None
+        self._router = None  # shard router whose spec is pushed server-side
+        self._call_id = 0
+        self._conn: Optional[FrameConn] = None
+        self._connected = threading.Event()
+        self._closing = False
+        self._dead = False
+        self._gate = (_GateLock(self), _GateMap(self))
+        deadline = time.monotonic() + connect_timeout
+        while True:
+            try:
+                self._connect(resync=False)
+                break
+            except OSError as e:
+                if time.monotonic() >= deadline:
+                    raise StoreError(
+                        f"store service at {socket_path} unreachable: {e}"
+                    ) from e
+                time.sleep(0.05)
+        self._connected.set()
+        self._reader = threading.Thread(
+            target=self._reader_loop, name="store-client-reader", daemon=True
+        )
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="store-client-dispatch", daemon=True
+        )
+        self._reader.start()
+        self._dispatcher.start()
+
+    # -- connection management ---------------------------------------------
+    def _connect(self, resync: bool) -> None:
+        """Dial + handshake. Runs with the reader NOT consuming this
+        conn (initial connect, or from the reader thread itself), so
+        responses are received inline; event frames that race the
+        handshake are buffered for the dispatcher."""
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(5.0)
+        sock.connect(self.socket_path)
+        sock.settimeout(None)
+        conn = FrameConn(sock)
+        hello = self._rpc_inline(conn, "hello")
+        with self._lock:
+            self._server_indexes = frozenset(
+                tuple(pair) for pair in hello["indexes"]
+            )
+        router = self._router
+        if router is not None:
+            self._rpc_inline(conn, "set_filter", spec=router.filter_spec())
+        if resync:
+            self._rpc_inline(conn, "resync")
+        self._conn = conn
+
+    def _rpc_inline(self, conn: FrameConn, op: str, **params: Any) -> Any:
+        with self._lock:
+            self._call_id += 1
+            cid = self._call_id
+        conn.send({"id": cid, "op": op, **params})
+        while True:
+            frame = conn.recv()
+            if frame is None:
+                raise OSError(f"connection closed during {op} handshake")
+            if "event" in frame:
+                with self._ev_cond:
+                    self._events.append(frame)
+                    self._ev_cond.notify_all()
+                continue
+            if not frame.get("ok", False):
+                raise decode_error(frame["error"])
+            return frame["result"]
+
+    def _reader_loop(self) -> None:
+        while True:
+            conn = self._conn
+            if conn is None or self._closing:
+                return
+            try:
+                frame = conn.recv()
+            except (OSError, ValueError, ConnectionError):
+                frame = None
+            if frame is None:
+                if self._closing:
+                    return
+                if not self._reconnect():
+                    return
+                continue
+            if "event" in frame:
+                with self._ev_cond:
+                    self._events.append(frame)
+                    self._ev_cond.notify_all()
+            else:
+                with self._lock:
+                    call = self._pending.pop(frame.get("id"), None)
+                if call is not None:
+                    if frame.get("ok", False):
+                        call.result = frame.get("result")
+                    else:
+                        call.error = decode_error(frame["error"])
+                    call.event.set()
+
+    def _reconnect(self) -> bool:
+        """Reader-thread path after EOF: fail in-flight calls (their
+        outcome is unknowable), redial until the deadline, re-push the
+        filter spec, request a resync."""
+        self._connected.clear()
+        with self._lock:
+            stranded = list(self._pending.values())
+            self._pending.clear()
+        for call in stranded:
+            call.retry = True
+            call.event.set()
+        deadline = time.monotonic() + self._reconnect_deadline
+        while not self._closing and time.monotonic() < deadline:
+            try:
+                self._connect(resync=True)
+            except (OSError, StoreError):
+                time.sleep(0.1)
+                continue
+            self._connected.set()
+            _log.info("store client reconnected to %s", self.socket_path)
+            return True
+        self._dead = True
+        self._connected.set()  # wake blockers into the dead check
+        with self._ev_cond:
+            self._ev_cond.notify_all()
+        return False
+
+    def _call(self, op: str, _idempotent: bool = False, **params: Any) -> Any:
+        deadline = time.monotonic() + self._reconnect_deadline + 5.0
+        while True:
+            if self._dead or self._closing:
+                raise StoreError(f"store service connection closed ({op})")
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise StoreError(f"store service unreachable ({op})")
+            if not self._connected.wait(timeout=remaining):
+                continue
+            if self._dead or self._closing:
+                raise StoreError(f"store service connection closed ({op})")
+            call = _Call()
+            with self._lock:
+                self._call_id += 1
+                cid = self._call_id
+                self._pending[cid] = call
+                conn = self._conn
+            try:
+                conn.send({"id": cid, "op": op, **params})
+            except (OSError, ValueError):
+                with self._lock:
+                    self._pending.pop(cid, None)
+                time.sleep(0.05)  # reader notices EOF and reconnects
+                continue
+            call.event.wait()
+            if call.retry:
+                if _idempotent:
+                    continue
+                raise StoreError(
+                    f"store connection lost during {op}; outcome unknown"
+                )
+            if call.error is not None:
+                raise call.error
+            return call.result
+
+    def close(self) -> None:
+        self._closing = True
+        self._dead = True
+        conn = self._conn
+        if conn is not None:
+            conn.close()
+        with self._lock:
+            stranded = list(self._pending.values())
+            self._pending.clear()
+        for call in stranded:
+            call.retry = True
+            call.event.set()
+        self._connected.set()
+        with self._ev_cond:
+            self._ev_cond.notify_all()
+
+    # -- event dispatch ----------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._ev_cond:
+                while not self._events and not self._closing and not self._dead:
+                    self._ev_cond.wait()
+                if not self._events:
+                    return  # closing/dead and drained
+                frame = self._events.popleft()
+            try:
+                resource = Resource.from_dict(frame["obj"])
+            except Exception:  # noqa: BLE001 - one bad frame must not kill dispatch
+                _log.exception("undecodable watch frame")
+                continue
+            ev = WatchEvent(frame["event"], resource)
+            with self._lock:
+                watchers = list(self._watchers)
+            for kinds, flt, handler in watchers:
+                if kinds is not None and resource.kind not in kinds:
+                    continue
+                try:
+                    if flt is not None and not flt(resource):
+                        continue
+                    handler(ev)
+                except Exception:  # noqa: BLE001 - same isolation as ResourceStore._drain
+                    _log.exception(
+                        "watch handler failed for %s %s/%s",
+                        resource.kind, resource.meta.namespace, resource.meta.name,
+                    )
+
+    # -- admission registration (local: callables cannot cross the wire) --
+    def register_defaulter(self, kind: str, fn: Callable) -> None:
+        with self._lock:
+            self._defaulters.setdefault(kind, []).append(fn)
+
+    def register_validator(self, kind: str, fn: Callable) -> None:
+        with self._lock:
+            self._validators.setdefault(kind, []).append(fn)
+
+    def register_status_validator(self, kind: str, fn: Callable) -> None:
+        with self._lock:
+            self._status_validators.setdefault(kind, []).append(fn)
+
+    def admission_chain(self, kind: str) -> tuple[list, list, list]:
+        with self._lock:
+            return (
+                list(self._defaulters.get(kind, [])),
+                list(self._validators.get(kind, [])),
+                list(self._status_validators.get(kind, [])),
+            )
+
+    # -- indexes -----------------------------------------------------------
+    def add_index(self, kind: str, index_name: str, fn: Callable) -> None:
+        """Remembered locally; queries pass through when the service
+        registered the same name at boot (the core inventory), else
+        fall back to a client-side scan with the local function."""
+        with self._lock:
+            if (kind, index_name) not in self._indexes:
+                self._indexes[(kind, index_name)] = fn
+
+    def _wire_index(self, kind: str, index: Optional[tuple]) -> Optional[list]:
+        if index is None:
+            return None
+        if (kind, index[0]) in self._server_indexes:
+            return [index[0], index[1]]
+        return None  # unknown server-side: caller falls back locally
+
+    def _local_index_filter(
+        self, kind: str, index: tuple, objs: list[Resource]
+    ) -> list[Resource]:
+        with self._lock:
+            fn = self._indexes.get((kind, index[0]))
+        if fn is None:
+            raise StoreError(f"unknown index {index[0]!r} for kind {kind}")
+        return [o for o in objs if index[1] in fn(o)]
+
+    # -- watch / filters / gate --------------------------------------------
+    def watch(
+        self,
+        handler: WatchHandler,
+        kinds: Optional[Iterable[str]] = None,
+        filter: Optional[WatchFilter] = None,
+    ) -> Callable[[], None]:
+        if filter is None:
+            filter = self._default_watch_filter
+        entry = (frozenset(kinds) if kinds is not None else None, filter, handler)
+        with self._lock:
+            self._watchers.append(entry)
+
+        def cancel() -> None:
+            with self._lock:
+                if entry in self._watchers:
+                    self._watchers.remove(entry)
+
+        return cancel
+
+    def set_watch_filter(self, filter: Optional[WatchFilter]) -> None:
+        """Same registration-time default binding as the in-process
+        store — PLUS, when the predicate is a shard router's ``wants``,
+        the ring spec is pushed so the SERVICE evaluates it per event
+        and this process stops receiving other shards' run churn at
+        all. Clearing the default (None) does not clear the session
+        filter: that is the process's delivery partition, and ring
+        changes keep flowing through ``router.on_rings_changed``."""
+        self._default_watch_filter = filter
+        router = getattr(filter, "__self__", None)
+        if (
+            filter is not None
+            and getattr(filter, "__name__", "") == "wants"
+            and router is not None
+            and hasattr(router, "filter_spec")
+        ):
+            self._router = router
+            router.on_rings_changed = self._push_filter
+            self._push_filter()
+
+    def _push_filter(self) -> None:
+        router = self._router
+        if router is None:
+            return
+        try:
+            self._call("set_filter", _idempotent=True, spec=router.filter_spec())
+        except StoreError:
+            _log.warning("filter push failed; reconnect will re-push")
+
+    def scheduling_gate(self) -> tuple[_GateLock, _GateMap]:
+        return self._gate
+
+    def resync(self) -> None:
+        """Request synthetic MODIFIED for all (filtered) state."""
+        self._call("resync", _idempotent=True)
+
+    # -- reads -------------------------------------------------------------
+    def get_view(self, kind: str, namespace: str, name: str) -> Resource:
+        d = self._call(
+            "get_view", _idempotent=True, kind=kind, namespace=namespace, name=name
+        )
+        return Resource.from_dict(d)
+
+    def try_get_view(self, kind: str, namespace: str, name: str) -> Optional[Resource]:
+        d = self._call(
+            "try_get_view", _idempotent=True, kind=kind, namespace=namespace, name=name
+        )
+        return None if d is None else Resource.from_dict(d)
+
+    # Wire objects are already private copies, so get == get_view here.
+    get = get_view
+    try_get = try_get_view
+
+    def list_views(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        labels: Optional[dict[str, str]] = None,
+        index: Optional[tuple[str, str]] = None,
+    ) -> list[Resource]:
+        wire_index = self._wire_index(kind, index)
+        if index is not None and wire_index is None:
+            objs = self.list_views(kind, namespace, labels, None)
+            return self._local_index_filter(kind, index, objs)
+        ds = self._call(
+            "list_views", _idempotent=True, kind=kind, namespace=namespace,
+            labels=labels, index=wire_index,
+        )
+        return [Resource.from_dict(d) for d in ds]
+
+    list = list_views  # wire objects are private copies already
+
+    def count(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        index: Optional[tuple[str, str]] = None,
+    ) -> int:
+        wire_index = self._wire_index(kind, index)
+        if index is not None and wire_index is None:
+            return len(self._local_index_filter(
+                kind, index, self.list_views(kind, namespace)))
+        return self._call(
+            "count", _idempotent=True, kind=kind, namespace=namespace,
+            index=wire_index,
+        )
+
+    def list_keys(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        index: Optional[tuple[str, str]] = None,
+    ) -> list[tuple[str, str]]:
+        wire_index = self._wire_index(kind, index)
+        if index is not None and wire_index is None:
+            picked = self._local_index_filter(
+                kind, index, self.list_views(kind, namespace))
+            return sorted((o.meta.namespace, o.meta.name) for o in picked)
+        pairs = self._call(
+            "list_keys", _idempotent=True, kind=kind, namespace=namespace,
+            index=wire_index,
+        )
+        return [tuple(p) for p in pairs]
+
+    # -- writes ------------------------------------------------------------
+    def create(self, obj: Resource) -> Resource:
+        new = obj.deepcopy()
+        with self._lock:
+            dfs = list(self._defaulters.get(new.kind, []))
+            vds = list(self._validators.get(new.kind, []))
+            svs = list(self._status_validators.get(new.kind, []))
+        for fn in dfs:
+            fn(new)
+        for fn in vds:
+            fn(new, None)
+        if new.status:
+            for fn in svs:
+                fn(new, None)
+        d = self._call("create", obj=new.to_dict())
+        return Resource.from_dict(d)
+
+    def update(self, obj: Resource) -> Resource:
+        return self._update(obj, status_only=False)
+
+    def update_status(self, obj: Resource) -> Resource:
+        return self._update(obj, status_only=True)
+
+    def _update(self, obj: Resource, status_only: bool) -> Resource:
+        """Local admission needs the current object for fn(new, cur);
+        the merge mirrors ``ResourceStore._update`` so validators see
+        exactly what the server will commit. Exactness argument: the
+        chains run only when the fetched cur carries the rv the caller
+        read; the server re-checks that rv at commit, so a write that
+        lands validated against the true predecessor, and a racing
+        change turns into the same Conflict the in-process store would
+        raise. Kinds with no local chains skip the extra round-trip."""
+        kind = obj.kind
+        op = "update_status" if status_only else "update"
+        with self._lock:
+            dfs = list(self._defaulters.get(kind, []))
+            vds = list(self._validators.get(kind, []))
+            svs = list(self._status_validators.get(kind, []))
+        needs_local = bool(svs) if status_only else bool(dfs or vds or svs)
+        if not needs_local:
+            return Resource.from_dict(self._call(op, obj=obj.to_dict()))
+        cur = self.try_get_view(kind, obj.meta.namespace, obj.meta.name)
+        if cur is None:
+            raise NotFound(kind, obj.meta.namespace, obj.meta.name)
+        if obj.meta.resource_version != cur.meta.resource_version:
+            raise Conflict(
+                kind, obj.meta.namespace, obj.meta.name,
+                obj.meta.resource_version, cur.meta.resource_version,
+            )
+        new = cur.deepcopy()
+        if status_only:
+            new.status = copy.deepcopy(obj.status)
+            for fn in svs:
+                fn(new, cur)
+        else:
+            new.spec = copy.deepcopy(obj.spec)
+            new.status = copy.deepcopy(obj.status)
+            new.meta.labels = dict(obj.meta.labels)
+            new.meta.annotations = dict(obj.meta.annotations)
+            new.meta.finalizers = list(obj.meta.finalizers)
+            new.meta.owner_references = list(obj.meta.owner_references)
+            for fn in dfs:
+                fn(new)
+            for fn in vds:
+                fn(new, cur)
+            if new.status != cur.status:
+                for fn in svs:
+                    fn(new, cur)
+        return Resource.from_dict(self._call(op, obj=new.to_dict()))
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._call("delete", kind=kind, namespace=namespace, name=name)
+
+    def mutate(
+        self,
+        kind: str,
+        namespace: str,
+        name: str,
+        fn: Callable[[Resource], None],
+        status_only: bool = False,
+        max_attempts: int = 10,
+    ) -> Resource:
+        last: Optional[Conflict] = None
+        for _ in range(max_attempts):
+            committed = self.get_view(kind, namespace, name)
+            cur = committed.deepcopy()
+            fn(cur)
+            if cur == committed:
+                return cur
+            try:
+                if status_only:
+                    return self.update_status(cur)
+                return self.update(cur)
+            except Conflict as e:
+                last = e
+        raise last  # type: ignore[misc]
+
+    def patch_status(
+        self, kind: str, namespace: str, name: str, fn: Callable[[dict], None]
+    ) -> Resource:
+        return self.mutate(kind, namespace, name, lambda r: fn(r.status), status_only=True)
+
+    # -- introspection -----------------------------------------------------
+    def __len__(self) -> int:
+        return self._call("len", _idempotent=True)
+
+    def kinds(self) -> set[str]:
+        return set(self._call("kinds", _idempotent=True))
+
+    @property
+    def _rv_counter(self) -> int:
+        """The service's committed-version counter (harness helpers use
+        it for unique run names)."""
+        return self._call("rv", _idempotent=True)
+
+    def dump_remote(self) -> bytes:
+        """Canonical state bytes from the service (crash-soak probe)."""
+        import base64
+
+        b64 = self._call("dump", _idempotent=True)
+        return b"" if b64 is None else base64.b64decode(b64)
+
+    def snapshot_remote(self) -> None:
+        self._call("snapshot", _idempotent=True)

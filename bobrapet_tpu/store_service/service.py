@@ -1,0 +1,454 @@
+"""The store-service process: the authoritative store behind a socket.
+
+One process owns the :class:`~..core.store.ResourceStore` (durable via
+:class:`.journal.DurableResourceStore`) and serves every shard manager
+over a Unix domain socket. Three things deliberately live HERE rather
+than in the client shim, because they cannot or must not cross the
+wire:
+
+- **watch filters** — each session may push its shard router's ring
+  spec (``set_filter``); the service rebuilds the router
+  (:func:`~..shard.router.router_from_spec`) and evaluates
+  ``router.wants`` inside the store's own per-watcher fan-out, so a
+  shard process only ever RECEIVES events for run families it owns —
+  the PR-6 delivery partition, now saving socket bytes instead of just
+  dispatcher wakeups.
+- **the scheduling gate** — named-queue caps are bus-wide admission
+  invariants, so the check-then-reserve window must serialize across
+  ALL shard processes. :class:`_RemoteGate` serves the PR-1
+  (lock, reservations) pair with per-session delta tracking: a shard
+  killed between reserve and launch has its net reservations rolled
+  back, so caps neither over-admit nor leak shut.
+- **field indexes + shard admission** — index functions and the
+  ShardMap fence validator run where the objects live
+  (``register_core_indexes`` / ``register_shard_admission`` at boot),
+  keeping list/count O(bucket) and fence checks atomic with the
+  commit.
+
+Per session: a reader thread dispatches requests serially (matching
+the in-process one-caller-at-a-time feel), EXCEPT ``gate_acquire``
+which blocks arbitrarily long and gets a one-off thread; a writer
+thread drains the watch-event queue, serializing resources off the
+store drainer's critical path.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+import os
+import socket
+import threading
+from collections import deque
+from typing import Any, Optional
+
+from ..analysis.racedetect import guarded_state
+from ..core.object import Resource
+from ..core.store import (
+    MODIFIED,
+    AdmissionDenied,
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ResourceStore,
+    StoreError,
+    WatchEvent,
+)
+from ..shard.router import router_from_spec
+from .wire import FrameConn
+
+_log = logging.getLogger(__name__)
+
+
+def encode_key(k: Any) -> Any:
+    """Scheduling-gate keys are strs or (nested) tuples; JSON has no
+    tuples, so tag them: ``{"t": [...]}`` vs ``{"v": scalar}``."""
+    if isinstance(k, tuple):
+        return {"t": [encode_key(x) for x in k]}
+    return {"v": k}
+
+
+def decode_key(d: Any) -> Any:
+    if isinstance(d, dict) and "t" in d:
+        return tuple(decode_key(x) for x in d["t"])
+    return d["v"]
+
+
+def encode_error(exc: Exception) -> dict[str, Any]:
+    if isinstance(exc, NotFound):
+        args = [exc.kind, exc.namespace, exc.name]
+    elif isinstance(exc, AlreadyExists):
+        args = [exc.kind, exc.namespace, exc.name]
+    elif isinstance(exc, Conflict):
+        args = [exc.kind, exc.namespace, exc.name, exc.expected, exc.actual]
+    else:
+        args = [str(exc)]
+    return {"type": type(exc).__name__, "args": args}
+
+
+def decode_error(err: dict[str, Any]) -> Exception:
+    typ, args = err.get("type"), err.get("args", [])
+    if typ == "NotFound":
+        return NotFound(*args)
+    if typ == "AlreadyExists":
+        return AlreadyExists(*args)
+    if typ == "Conflict":
+        return Conflict(*args)
+    if typ == "AdmissionDenied":
+        return AdmissionDenied(*args)
+    return StoreError(*args)
+
+
+@guarded_state("_deltas", "_reservations")
+class _RemoteGate:
+    """The bus-wide scheduling gate, served over the wire.
+
+    Preserves the PR-1 shape — one lock, one reservations dict, shared
+    by every DAG engine on the bus — across process boundaries, plus
+    what processes add: per-session NET deltas, so ``kill -9`` of a
+    shard between its reserve and its unreserve rolls back exactly its
+    outstanding contribution (a leaked reservation would wedge a
+    named-queue cap shut forever; a lost rollback would over-admit)."""
+
+    def __init__(self) -> None:
+        # explicit lock under the Condition: sanitizer-tracked (a bare
+        # Condition()'s internal RLock allocates in stdlib threading,
+        # outside the monitors' tracked source prefixes)
+        self._gate_lock = threading.Lock()
+        self._cond = threading.Condition(self._gate_lock)
+        self._owner: Optional[int] = None
+        self._reservations: dict[Any, Any] = {}
+        self._deltas: dict[int, dict[Any, float]] = {}
+
+    def acquire(self, sid: int) -> None:
+        with self._cond:
+            while self._owner is not None:
+                self._cond.wait()
+            self._owner = sid
+
+    def release(self, sid: int) -> None:
+        with self._cond:
+            # A reconnected client releasing a lock its DEAD session held
+            # is a no-op: session_died already released it.
+            if self._owner == sid:
+                self._owner = None
+                self._cond.notify_all()
+
+    def get(self, key: Any, default: Any = None) -> Any:
+        with self._cond:
+            return self._reservations.get(key, default)
+
+    def set(self, sid: int, key: Any, value: Any) -> None:
+        with self._cond:
+            old = self._reservations.get(key, 0)
+            self._reservations[key] = value
+            sd = self._deltas.setdefault(sid, {})
+            sd[key] = sd.get(key, 0) + (value - old)
+
+    def pop(self, sid: int, key: Any, default: Any = None) -> Any:
+        with self._cond:
+            if key not in self._reservations:
+                return default
+            old = self._reservations.pop(key)
+            sd = self._deltas.setdefault(sid, {})
+            sd[key] = sd.get(key, 0) - old
+            return old
+
+    def session_died(self, sid: int) -> None:
+        with self._cond:
+            if self._owner == sid:
+                self._owner = None
+            for key, delta in self._deltas.pop(sid, {}).items():
+                if not delta:
+                    continue
+                remaining = self._reservations.get(key, 0) - delta
+                if remaining > 0:
+                    self._reservations[key] = remaining
+                else:
+                    self._reservations.pop(key, None)
+            self._cond.notify_all()
+
+    def reservations(self) -> dict[Any, Any]:
+        with self._cond:
+            return dict(self._reservations)
+
+
+@guarded_state("_outq")
+class _Session:
+    """One connected client: reader (request dispatch), writer (watch
+    event fan-out), one store watcher filtered by the session's pushed
+    ring spec."""
+
+    def __init__(self, service: "StoreService", sid: int, conn: FrameConn):
+        self.service = service
+        self.sid = sid
+        self.conn = conn
+        # explicit tracked lock under the Condition (see _RemoteGate)
+        self._outq_lock = threading.Lock()
+        self._cond = threading.Condition(self._outq_lock)
+        self._outq: deque = deque()
+        self._closed = False
+        #: shard router rebuilt from the client's ``set_filter`` pushes;
+        #: swapped atomically, read per event by ``_wants``
+        self._router = None
+        self._cancel_watch = service.store.watch(self._on_event, filter=self._wants)
+        self._reader = threading.Thread(
+            target=self._serve, name=f"store-sess-{sid}-reader", daemon=True
+        )
+        self._writer = threading.Thread(
+            target=self._write_loop, name=f"store-sess-{sid}-writer", daemon=True
+        )
+
+    def start(self) -> None:
+        self._reader.start()
+        self._writer.start()
+
+    # -- delivery (store drainer -> writer thread) -------------------------
+    def _wants(self, obj: Resource) -> bool:
+        router = self._router
+        if router is None:
+            return True
+        try:
+            return router.wants(obj)
+        except Exception:  # noqa: BLE001 - a broken spec must not poison the bus
+            _log.exception("session %d filter failed", self.sid)
+            return True
+
+    def _on_event(self, ev: WatchEvent) -> None:
+        # Store drainer thread: enqueue only — to_dict runs on the
+        # writer so serialization stays off the bus-wide delivery path.
+        with self._cond:
+            if self._closed:
+                return
+            self._outq.append((ev.type, ev.resource))
+            self._cond.notify_all()
+
+    def _write_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._outq and not self._closed:
+                    self._cond.wait()
+                if not self._outq:
+                    return  # closed and drained
+                ev_type, resource = self._outq.popleft()
+            try:
+                self.conn.send({"event": ev_type, "obj": resource.to_dict()})
+            except (OSError, ValueError):
+                self.close()
+                return
+
+    # -- request dispatch (reader thread) ----------------------------------
+    def _serve(self) -> None:
+        while True:
+            try:
+                req = self.conn.recv()
+            except (OSError, ValueError, ConnectionError):
+                break
+            if req is None:
+                break
+            if not isinstance(req, dict) or "op" not in req:
+                break
+            if req["op"] == "gate_acquire":
+                # blocks until the gate frees — must not stall this
+                # session's other traffic
+                threading.Thread(
+                    target=self._respond, args=(req,), daemon=True,
+                    name=f"store-sess-{self.sid}-gate",
+                ).start()
+                continue
+            self._respond(req)
+        self.close()
+
+    def _respond(self, req: dict[str, Any]) -> None:
+        rid = req.get("id")
+        try:
+            result = self._dispatch(req)
+            frame = {"id": rid, "ok": True, "result": result}
+        except (NotFound, AlreadyExists, Conflict, AdmissionDenied, StoreError) as e:
+            frame = {"id": rid, "ok": False, "error": encode_error(e)}
+        except Exception as e:  # noqa: BLE001 - op bugs must not kill the session
+            _log.exception("session %d op %s failed", self.sid, req.get("op"))
+            frame = {"id": rid, "ok": False,
+                     "error": {"type": "StoreError", "args": [repr(e)]}}
+        try:
+            self.conn.send(frame)
+        except (OSError, ValueError):
+            self.close()
+
+    def _dispatch(self, req: dict[str, Any]) -> Any:
+        op = req["op"]
+        store = self.service.store
+        gate = self.service.gate
+        if op == "ping":
+            return "pong"
+        if op == "hello":
+            with store._lock:
+                return {
+                    "indexes": [list(k) for k in sorted(store._indexes.keys())],
+                    "rv": store._rv_counter,
+                }
+        if op == "get_view":
+            return store.get_view(req["kind"], req["namespace"], req["name"]).to_dict()
+        if op == "try_get_view":
+            obj = store.try_get_view(req["kind"], req["namespace"], req["name"])
+            return None if obj is None else obj.to_dict()
+        if op == "list_views":
+            index = tuple(req["index"]) if req.get("index") else None
+            return [
+                o.to_dict()
+                for o in store.list_views(
+                    req["kind"], req.get("namespace"), req.get("labels"), index
+                )
+            ]
+        if op == "count":
+            index = tuple(req["index"]) if req.get("index") else None
+            return store.count(req["kind"], req.get("namespace"), index)
+        if op == "list_keys":
+            index = tuple(req["index"]) if req.get("index") else None
+            return [
+                list(t)
+                for t in store.list_keys(req["kind"], req.get("namespace"), index)
+            ]
+        if op == "create":
+            return store.create(Resource.from_dict(req["obj"])).to_dict()
+        if op == "update":
+            return store.update(Resource.from_dict(req["obj"])).to_dict()
+        if op == "update_status":
+            return store.update_status(Resource.from_dict(req["obj"])).to_dict()
+        if op == "delete":
+            store.delete(req["kind"], req["namespace"], req["name"])
+            return None
+        if op == "rv":
+            with store._lock:
+                return store._rv_counter
+        if op == "len":
+            return len(store)
+        if op == "kinds":
+            return sorted(store.kinds())
+        if op == "set_filter":
+            self._router = router_from_spec(store, req["spec"])
+            return None
+        if op == "resync":
+            self._resync()
+            return None
+        if op == "gate_acquire":
+            gate.acquire(self.sid)
+            return None
+        if op == "gate_release":
+            gate.release(self.sid)
+            return None
+        if op == "gate_get":
+            return gate.get(decode_key(req["key"]), req.get("default"))
+        if op == "gate_set":
+            gate.set(self.sid, decode_key(req["key"]), req["value"])
+            return None
+        if op == "gate_pop":
+            return gate.pop(self.sid, decode_key(req["key"]), req.get("default"))
+        if op == "dump":
+            dump = getattr(store, "dump", None)
+            return base64.b64encode(dump()).decode("ascii") if dump else None
+        if op == "snapshot":
+            snap = getattr(store, "snapshot", None)
+            if snap:
+                snap()
+            return None
+        raise StoreError(f"unknown op {op!r}")
+
+    def _resync(self) -> None:
+        """Synthetic MODIFIED for every object passing the session
+        filter — the level-triggered heal a client requests after
+        reconnecting (events during the outage are gone; state is
+        not)."""
+        store = self.service.store
+        objs = []
+        for kind in sorted(store.kinds()):
+            objs.extend(o for o in store.list_views(kind) if self._wants(o))
+        with self._cond:
+            if self._closed:
+                return
+            for obj in objs:
+                self._outq.append((MODIFIED, obj))
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._cancel_watch()
+        self.service.gate.session_died(self.sid)
+        self.conn.close()
+        self.service._forget(self.sid)
+
+
+@guarded_state("_sessions")
+class StoreService:
+    """The store service: accept loop + session registry around one
+    authoritative store (plain for tests, durable in production)."""
+
+    def __init__(self, store: ResourceStore, socket_path: str):
+        self.store = store
+        self.socket_path = socket_path
+        self.gate = _RemoteGate()
+        self._lock = threading.Lock()
+        self._sessions: dict[int, _Session] = {}
+        self._sid_counter = 0
+        self._closed = False
+        # Index functions and the ShardMap fence validator cannot cross
+        # the wire: they live where the objects live. runtime is a heavy
+        # import (jax) — only the service process pays it, never clients.
+        from ..runtime import register_core_indexes
+        from ..shard.map import register_shard_admission
+
+        register_core_indexes(store)
+        register_shard_admission(store)
+        try:
+            os.unlink(socket_path)
+        except (FileNotFoundError, OSError):
+            pass
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(socket_path)
+        self._listener.listen(128)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="store-accept", daemon=True
+        )
+
+    def start(self) -> "StoreService":
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._lock:
+                if self._closed:
+                    sock.close()
+                    return
+                self._sid_counter += 1
+                sid = self._sid_counter
+                session = _Session(self, sid, FrameConn(sock))
+                self._sessions[sid] = session
+            session.start()
+
+    def _forget(self, sid: int) -> None:
+        with self._lock:
+            self._sessions.pop(sid, None)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            sessions = list(self._sessions.values())
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for session in sessions:
+            session.close()
+        try:
+            os.unlink(self.socket_path)
+        except (FileNotFoundError, OSError):
+            pass

@@ -1,0 +1,85 @@
+"""Length-prefixed JSON framing for the store-service socket protocol.
+
+One frame is a 4-byte big-endian length followed by a UTF-8 JSON body.
+That is the entire codec: requests, responses, and watch events are all
+single frames, and the only concurrency rule is that writers serialize
+per connection (``FrameConn`` holds a send lock so the service's writer
+thread and one-off responders never interleave partial frames).
+
+The cap (``MAX_FRAME``) bounds a single resource plus envelope; it is a
+corruption tripwire, not a quota — a length word above it means the
+stream is desynchronised and the connection must die.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Any, Optional
+
+#: Corruption tripwire for the 4-byte length word (64 MiB).
+MAX_FRAME = 64 * 1024 * 1024
+
+_LEN = struct.Struct(">I")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes, or ``None`` on clean EOF at a boundary."""
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except (OSError, ValueError):
+            return None
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, payload: Any) -> None:
+    body = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+    if len(body) > MAX_FRAME:
+        raise ValueError(f"frame of {len(body)} bytes exceeds MAX_FRAME")
+    sock.sendall(_LEN.pack(len(body)) + body)
+
+
+def recv_frame(sock: socket.socket) -> Optional[Any]:
+    """Receive one frame; ``None`` means the peer closed the stream."""
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise ConnectionError(f"frame length {n} exceeds MAX_FRAME; stream desynchronised")
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return json.loads(body.decode("utf-8"))
+
+
+class FrameConn:
+    """A socket plus a send lock: many threads may send, one may receive."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._send_lock = threading.Lock()
+
+    def send(self, payload: Any) -> None:
+        with self._send_lock:
+            send_frame(self.sock, payload)
+
+    def recv(self) -> Optional[Any]:
+        return recv_frame(self.sock)
+
+    def close(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass

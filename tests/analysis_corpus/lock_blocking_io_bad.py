@@ -44,3 +44,21 @@ class Recorder:
 def module_level(payload):
     with _lock:
         os.replace("/tmp/a", "/tmp/b")  # BAD: filesystem under module lock
+
+
+class RpcClient:
+    """Self-receiver interprocedural resolution: a blocking method of
+    THIS class called as ``self.get(...)`` is followed (the good
+    corpus pins that ``other.get(...)`` is not)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+
+    def get(self, key):
+        self._done.wait()
+        return key
+
+    def blocking_under_lock(self):
+        with self._lock:
+            return self.get("k")  # BAD: self.get blocks via Event.wait

@@ -31,3 +31,23 @@ class Recorder:
                 time.sleep(1.0)  # OK: defined under lock, not run
 
             self._pending.append(flush)
+
+
+class RpcClient:
+    """A class whose OWN ``get`` blocks must not poison unrelated
+    ``dict.get`` calls under a lock: interprocedural resolution only
+    follows bare names and self/cls methods, never other receivers."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handlers = {}
+        self._done = threading.Event()
+
+    def get(self, key):
+        self._done.wait()  # genuinely blocking RPC-style method
+        return key
+
+    def handlers_for(self, kind):
+        with self._lock:
+            # OK: dict.get on a non-self receiver, not RpcClient.get
+            return list(self._handlers.get(kind, []))

@@ -1,0 +1,50 @@
+"""Workload installed INSIDE each shard manager process.
+
+Engram entrypoints are process-local callables — they cannot travel
+through the store — so the process harness imports this module in every
+child (``--workload tests.proc_workload:install``) while the parent
+applies the matching templates/engrams/stories through the bus
+(:func:`apply_resources`). Keep the two halves in one file so the
+entrypoint names cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import time
+
+ENTRIES = {
+    "proc-fast": 0.0,  # latency-free: tier-1 smoke + correctness legs
+    "proc-soak": 0.05,  # latency-bound: churn soak + bench scaling legs
+}
+
+
+def install() -> None:
+    from bobrapet_tpu.sdk import register_engram
+
+    for entry, sleep_s in ENTRIES.items():
+        def impl(ctx, _sleep=sleep_s):
+            if _sleep:
+                time.sleep(_sleep)
+            return {"i": ctx.inputs.get("i", 0)}
+
+        register_engram(entry)(impl)
+
+
+def apply_resources(cp, entry: str, steps: int = 1) -> str:
+    """Parent-side half: template + engram + a ``steps``-deep chain
+    story for ``entry``. Returns the story name."""
+    from bobrapet_tpu.api.catalog import make_engram_template
+    from bobrapet_tpu.api.engram import make_engram
+    from bobrapet_tpu.api.story import make_story
+
+    assert entry in ENTRIES, f"unknown workload entry {entry!r}"
+    cp.apply(make_engram_template(f"{entry}-tpl", entrypoint=entry))
+    cp.apply(make_engram(f"{entry}-worker", f"{entry}-tpl"))
+    defs = [{"name": "s0", "ref": {"name": f"{entry}-worker"},
+             "with": {"i": "{{ inputs.i }}"}}]
+    for i in range(1, steps):
+        defs.append({"name": f"s{i}", "ref": {"name": f"{entry}-worker"},
+                     "needs": [f"s{i-1}"],
+                     "with": {"i": "{{ steps.s%d.output.i }}" % (i - 1)}})
+    cp.apply(make_story(f"{entry}-story", steps=defs))
+    return f"{entry}-story"

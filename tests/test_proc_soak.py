@@ -1,0 +1,241 @@
+"""Process-mode sharded control plane: real OS processes, real kills.
+
+Every test here spawns the store service plus N shard manager
+*processes* (``ShardedControlPlane(..., processes=True)``), so the
+PR-6 rebalance/lease contract and the PR-16 serving-era invariants are
+exercised where the in-process harness cannot honestly reach: across a
+real ``kill -9`` (no interpreter survives to run courtesy cleanup) and
+across a crash of the bus itself (SIGKILL the store service; clients
+reconnect, the journal replays).
+
+Parent-side shims (StoreClient, the harness) run with bobrarace armed;
+child processes arm nothing — their verdicts travel back as
+ShardReport resources (per-process double-reconcile violations,
+ChipLedger imbalance, reconcile counts) and the cross-process
+exactly-once-retirement assert is computed from the parent's own watch
+stream. The slow leg drives load through the PR-14 closed-loop
+generator via a StoryRun-submitting target adapter.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from bobrapet_tpu.api.enums import Phase
+
+from tests.proc_workload import apply_resources
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _lock_order_sanitizer():
+    from bobrapet_tpu.analysis.lockorder import sanitize_locks
+
+    with sanitize_locks() as monitor:
+        yield monitor
+    monitor.assert_clean()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _race_sanitizer(_lock_order_sanitizer):
+    """bobrarace over the parent-side process shims: StoreClient's
+    pending-call/event/watcher tables and the harness's child/report
+    registries are @guarded_state — every cross-process test in this
+    module runs them tracked."""
+    from bobrapet_tpu.analysis.racedetect import sanitize_races
+
+    with sanitize_races(monitor=_lock_order_sanitizer) as det:
+        yield det
+    det.assert_clean()
+
+
+@pytest.fixture()
+def plane_factory(request):
+    """Build process planes with an ALWAYS-run reaper: a failing assert
+    must not strand shard processes (or the store service) on the box —
+    the finalizer SIGKILLs whatever graceful teardown missed."""
+    from bobrapet_tpu.shard import ShardedControlPlane
+
+    planes = []
+
+    def build(**kwargs):
+        cp = ShardedControlPlane(processes=True, **kwargs)
+        planes.append(cp)
+        request.addfinalizer(cp.reap)
+        return cp
+
+    return build
+
+
+def _assert_reports_clean(cp, sids) -> None:
+    for sid in sids:
+        rep = cp.reports.get(sid)
+        assert rep is not None, f"shard {sid} never published its report"
+        assert rep["violations"] == [], f"shard {sid}: {rep['violations']}"
+        assert rep["ledgerUnbalanced"] == [], (
+            f"shard {sid} ledger: {rep['ledgerUnbalanced']}")
+        assert rep["processed"] > 0, f"shard {sid} processed no reconciles"
+
+
+def _assert_byte_identical_recovery(cp) -> None:
+    """Quiesce writers (children already stopped), dump through the
+    live service, SIGKILL it, and replay journal+snapshot offline: the
+    recovered bytes must equal the dump exactly."""
+    from bobrapet_tpu.store_service.journal import dump_recovered
+
+    d0 = cp.dump_store()
+    cp.kill_store_service()
+    d1 = dump_recovered(cp.data_dir)
+    assert d0 == d1, (
+        f"journal replay diverged: {len(d0)} vs {len(d1)} bytes")
+
+
+class TestProcessSmoke:
+    def test_two_processes_survive_kill_nine(self, plane_factory):
+        """Tier-1 leg: 2 shard processes + the service; runs complete
+        across a real SIGKILL of one shard, nothing lost, every run
+        retired exactly once, recovery is byte-identical."""
+        cp = plane_factory(shards=2)
+        with cp:
+            cp.wait_members({"0", "1"}, timeout=90.0)
+            story = apply_resources(cp, "proc-fast")
+            runs = [cp.run_story(story, inputs={"i": i}) for i in range(6)]
+            cp.wait_runs(runs, timeout=90.0)
+            # kill -9 mid-flight: submit first, then kill, then wait
+            runs2 = [cp.run_story(story, inputs={"i": 10 + i})
+                     for i in range(6)]
+            cp.kill_shard("1")
+            cp.wait_members({"0"}, timeout=90.0)
+            cp.wait_runs(runs2, timeout=120.0)
+            for r in runs + runs2:
+                assert cp.run_phase(r) == Phase.SUCCEEDED, (
+                    r, cp.run_phase(r), cp.logs("shard-0")[-2000:])
+            cp.assert_exactly_once(runs + runs2)
+            # graceful stop of the survivor so its report publishes,
+            # then the byte-identity check (service still up)
+            cp.stop_shard("0", timeout=90.0)
+            _assert_reports_clean(cp, ["0"])
+            _assert_byte_identical_recovery(cp)
+
+
+class _StoryRunTarget:
+    """PR-14 loadgen target adapter: ``submit`` creates a StoryRun,
+    ``step`` polls outstanding phases, ``finished`` grows as runs turn
+    terminal. Token/latency fields exist so TrafficReport stats
+    compute; the soak gates on ``lost == 0``, not on them."""
+
+    class _Req:
+        __slots__ = ("rid", "run", "t0", "ttft_seconds", "tpot_seconds",
+                     "output", "preemptions")
+
+        def __init__(self, rid, run, t0):
+            self.rid = rid
+            self.run = run
+            self.t0 = t0
+            self.ttft_seconds = None
+            self.tpot_seconds = None
+            self.output = []
+            self.preemptions = 0
+
+    def __init__(self, cp, story: str):
+        self.cp = cp
+        self.story = story
+        self.finished: list = []
+        self._outstanding: dict[int, _StoryRunTarget._Req] = {}
+        self._next = 0
+        self.runs: list[str] = []
+
+    def submit(self, prompt, max_new_tokens=0, temperature=0.0,
+               tenant=None) -> int:
+        rid = self._next
+        self._next += 1
+        run = self.cp.run_story(self.story, inputs={"i": rid})
+        self.runs.append(run)
+        self._outstanding[rid] = self._Req(rid, run, time.perf_counter())
+        return rid
+
+    def step(self) -> None:
+        now = time.perf_counter()
+        for rid, req in list(self._outstanding.items()):
+            phase = self.cp.run_phase(req.run)
+            if phase in (Phase.SUCCEEDED, Phase.FAILED):
+                req.ttft_seconds = now - req.t0
+                self.finished.append(req)
+                del self._outstanding[rid]
+        time.sleep(0.02)  # closed loop over RPCs: don't spin the socket
+
+
+@pytest.mark.slow
+class TestProcessSoak:
+    def test_four_processes_churn_and_store_crash(self, plane_factory):
+        """The acceptance soak: 4 shard processes under closed-loop
+        load, one shard SIGKILLed and one joined mid-soak, THEN the
+        store service itself SIGKILLed and restarted mid-soak. Gates:
+        zero lost runs, every run retired exactly once, per-process
+        detectors and ChipLedgers clean, byte-identical replay."""
+        from bobrapet_tpu.traffic.loadgen import ClosedLoopLoadGen, TenantProfile
+
+        cp = plane_factory(
+            shards=4,
+            config_data={"scheduling.global-max-concurrent-steps": "4"},
+            fsync_batch=8,
+        )
+        with cp:
+            cp.wait_members({"0", "1", "2", "3"}, timeout=120.0)
+            story = apply_resources(cp, "proc-soak")
+            target = _StoryRunTarget(cp, story)
+
+            chaos_state = {"at": None}
+
+            def chaos(now: float) -> None:
+                """Mid-soak fault schedule, driven off loadgen ticks:
+                ~3s in, SIGKILL shard 3 and join a replacement; ~8s in,
+                SIGKILL the store service and restart it."""
+                if chaos_state["at"] is None:
+                    chaos_state["at"] = now
+                    return
+                elapsed = now - chaos_state["at"]
+                if elapsed > 3.0 and "killed" not in chaos_state:
+                    chaos_state["killed"] = True
+                    cp.kill_shard("3")
+                    chaos_state["joined"] = cp.add_shard()
+                if elapsed > 8.0 and "crashed" not in chaos_state:
+                    chaos_state["crashed"] = True
+                    cp.kill_store_service()
+                    cp.restart_store_service()
+
+            gen = ClosedLoopLoadGen(
+                target,
+                profiles=[
+                    TenantProfile(tenant="batch", users=6,
+                                  think_time_s=0.05, max_requests=60),
+                    TenantProfile(tenant="interactive", users=2,
+                                  think_time_s=0.2, max_requests=20),
+                ],
+                seed=20260807,
+                tick_hooks=[chaos],
+            )
+            report = gen.run(max_duration_s=240.0)
+            assert "crashed" in chaos_state, (
+                "soak finished before the store-service crash fired — "
+                f"wall {report.wall_s:.1f}s; raise the load budget")
+            # the loadgen's own ledger: everything submitted retired
+            assert report.lost == 0, (
+                f"{report.lost} runs lost; phases: "
+                f"{[(r, cp.run_phase(r)) for r in target.runs[-8:]]}")
+            assert report.completed == report.submitted >= 70
+            cp.wait_runs(target.runs, timeout=120.0)
+            for r in target.runs:
+                assert cp.run_phase(r) == Phase.SUCCEEDED, (r, cp.run_phase(r))
+            cp.assert_exactly_once(target.runs)
+
+            joined = chaos_state["joined"]
+            survivors = ["0", "1", "2", joined]
+            cp.wait_members(set(survivors), timeout=120.0)
+            for sid in survivors:
+                cp.stop_shard(sid, timeout=120.0)
+            _assert_reports_clean(cp, survivors)
+            # work actually spread across processes, including the joiner
+            assert sum(cp.reports[s]["processed"] for s in survivors) > 0
+            _assert_byte_identical_recovery(cp)

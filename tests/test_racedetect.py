@@ -34,7 +34,11 @@ import bobrapet_tpu.core.store  # noqa: F401
 import bobrapet_tpu.serving.prefix_cache  # noqa: F401
 import bobrapet_tpu.serving.router  # noqa: F401
 import bobrapet_tpu.shard.coordinator  # noqa: F401
+import bobrapet_tpu.shard.procharness  # noqa: F401
 import bobrapet_tpu.shard.router  # noqa: F401
+import bobrapet_tpu.store_service.client  # noqa: F401
+import bobrapet_tpu.store_service.journal  # noqa: F401
+import bobrapet_tpu.store_service.service  # noqa: F401
 import bobrapet_tpu.traffic.autoscaler  # noqa: F401
 import bobrapet_tpu.traffic.fairness  # noqa: F401
 import bobrapet_tpu.traffic.loadgen  # noqa: F401

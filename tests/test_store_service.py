@@ -1,0 +1,430 @@
+"""Store service units: wire codec, group-committed journal, durable
+store recovery, and the socket service + client shim — all in-process
+(threads over a tmp Unix socket), so tier-1 covers the full RPC surface
+without subprocess spawn cost. The real multi-process contract lives in
+tests/test_proc_soak.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import tempfile
+import threading
+import time
+
+import pytest
+
+from bobrapet_tpu.core.object import ObjectMeta, Resource, new_resource
+from bobrapet_tpu.core.store import AdmissionDenied, Conflict, NotFound, ResourceStore
+from bobrapet_tpu.observability.metrics import metrics
+from bobrapet_tpu.store_service import (
+    DurableResourceStore,
+    Journal,
+    StoreClient,
+    StoreService,
+    make_store,
+)
+from bobrapet_tpu.store_service.backend import ENV_BACKEND, ENV_SOCKET
+from bobrapet_tpu.store_service.journal import dump_recovered, load_state
+from bobrapet_tpu.store_service.wire import FrameConn, recv_frame, send_frame
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _lock_order_sanitizer():
+    from bobrapet_tpu.analysis.lockorder import sanitize_locks
+
+    with sanitize_locks() as monitor:
+        yield monitor
+    monitor.assert_clean()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _race_sanitizer(_lock_order_sanitizer):
+    """bobrarace over the new process-boundary shims: the service's
+    session/gate registries and the client's pending-call tables are
+    @guarded_state — this suite runs them with the sanitizer armed."""
+    from bobrapet_tpu.analysis.racedetect import sanitize_races
+
+    with sanitize_races(monitor=_lock_order_sanitizer) as det:
+        yield det
+    det.assert_clean()
+
+
+def _res(name: str, kind: str = "Story", ns: str = "default", **spec) -> Resource:
+    return Resource(kind=kind, meta=ObjectMeta(namespace=ns, name=name),
+                    spec=spec or {"v": 1})
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+class TestWire:
+    def test_roundtrip_and_clean_eof(self):
+        a, b = socket.socketpair()
+        try:
+            send_frame(a, {"op": "ping", "k": [1, 2, {"x": "y"}]})
+            assert recv_frame(b) == {"op": "ping", "k": [1, 2, {"x": "y"}]}
+            a.close()
+            assert recv_frame(b) is None  # clean EOF, not an exception
+        finally:
+            b.close()
+
+    def test_oversized_frame_rejected_by_sender(self):
+        a, b = socket.socketpair()
+        try:
+            with pytest.raises(ValueError):
+                send_frame(a, {"blob": "x" * (64 * 1024 * 1024)})
+        finally:
+            a.close()
+            b.close()
+
+    def test_frameconn_serializes_concurrent_senders(self):
+        a, b = socket.socketpair()
+        conn = FrameConn(a)
+        try:
+            threads = [
+                threading.Thread(
+                    target=lambda i=i: [conn.send({"i": i, "pad": "p" * 512})
+                                        for _ in range(50)]
+                )
+                for i in range(4)
+            ]
+            got = []
+
+            def reader():
+                while len(got) < 200:
+                    frame = recv_frame(b)
+                    assert frame is not None
+                    got.append(frame)
+
+            rt = threading.Thread(target=reader)
+            rt.start()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            rt.join(timeout=10.0)
+            # interleaved senders never torn: every frame parsed whole
+            assert len(got) == 200
+        finally:
+            conn.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# journal: group commit + durability
+# ---------------------------------------------------------------------------
+
+class TestJournal:
+    def test_append_then_wait_durable(self, tmp_path):
+        j = Journal(str(tmp_path / "j.jsonl"), fsync_batch=8)
+        try:
+            seqs = [j.append({"n": i}) for i in range(20)]
+            j.wait_durable(seqs[-1], timeout=10.0)
+            assert j.durable_seq >= seqs[-1]
+        finally:
+            j.close()
+        lines = (tmp_path / "j.jsonl").read_bytes().splitlines()
+        assert [json.loads(ln)["n"] for ln in lines] == list(range(20))
+
+    def test_batch_of_one_is_per_record_fsync(self, tmp_path):
+        j = Journal(str(tmp_path / "j.jsonl"), fsync_batch=1)
+        try:
+            for i in range(5):
+                j.wait_durable(j.append({"n": i}), timeout=10.0)
+        finally:
+            j.close()
+
+    def test_live_retune_and_close_drains(self, tmp_path):
+        j = Journal(str(tmp_path / "j.jsonl"), fsync_batch=64)
+        j.set_fsync_batch(2)
+        assert j.fsync_batch == 2
+        last = 0
+        for i in range(10):
+            last = j.append({"n": i})
+        j.close()  # must drain pending before the worker exits
+        assert j.durable_seq >= last
+        assert len((tmp_path / "j.jsonl").read_bytes().splitlines()) == 10
+
+
+class TestDurableStore:
+    def _store(self, d, **kw) -> DurableResourceStore:
+        kw.setdefault("fsync_batch", 4)
+        return DurableResourceStore(str(d), **kw)
+
+    def test_recovery_replays_objects_and_exact_rv(self, tmp_path):
+        s = self._store(tmp_path)
+        s.create(_res("a", v=1))
+        s.create(_res("b"))
+        s.mutate("Story", "default", "a", lambda r: r.spec.__setitem__("v", 2))
+        s.delete("Story", "default", "b")
+        rv = s._rv_counter
+        s.close()
+
+        s2 = self._store(tmp_path)
+        try:
+            assert s2._rv_counter == rv  # exact, incl. the delete bump
+            assert s2.get("Story", "default", "a").spec["v"] == 2
+            assert s2.try_get("Story", "default", "b") is None
+            # recovered store keeps journaling: new commits survive too
+            s2.create(_res("c"))
+        finally:
+            s2.close()
+        objs, rv3, replayed, _ = load_state(str(tmp_path))
+        assert ("Story", "default", "c") in objs
+        assert rv3 == rv + 1
+        assert replayed >= 1
+
+    def test_dump_matches_offline_recovery_bytes(self, tmp_path):
+        s = self._store(tmp_path)
+        try:
+            for i in range(25):
+                s.create(_res(f"r{i}", v=i))
+            s.mutate("Story", "default", "r3",
+                     lambda r: r.spec.__setitem__("v", 99))
+            s.delete("Story", "default", "r7")
+            d0 = s.dump()
+        finally:
+            s.close()
+        assert d0 == dump_recovered(str(tmp_path))
+
+    def test_snapshot_truncates_journal_and_preserves_bytes(self, tmp_path):
+        s = self._store(tmp_path, snapshot_every=10)
+        try:
+            for i in range(25):  # crosses the snapshot threshold twice
+                s.create(_res(f"s{i}", v=i))
+            d0 = s.dump()
+        finally:
+            s.close()
+        # compaction actually happened: journal holds the tail, not all 25
+        journal_lines = (tmp_path / "journal.jsonl").read_bytes().splitlines()
+        assert 0 < len(journal_lines) < 25
+        assert (tmp_path / "snapshot.json").exists()
+        assert dump_recovered(str(tmp_path)) == d0
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        s = self._store(tmp_path)
+        s.create(_res("whole"))
+        d0 = s.dump()
+        s.close()
+        with open(tmp_path / "journal.jsonl", "ab") as fh:
+            fh.write(b'{"op": "put", "key": ["Sto')  # crash mid-write
+        assert dump_recovered(str(tmp_path)) == d0
+
+    def test_journal_metrics_registered(self):
+        assert metrics.store_journal_append_latency is not None
+        assert metrics.store_journal_fsync_batch is not None
+        assert metrics.store_journal_snapshot_duration is not None
+        assert metrics.store_journal_replay_rate is not None
+
+
+# ---------------------------------------------------------------------------
+# service + client over a real socket (in-process threads)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def served():
+    d = tempfile.mkdtemp(prefix="bobra-svc-")
+    sock = os.path.join(d, "s.sock")
+    store = ResourceStore()
+    service = StoreService(store, sock).start()
+    clients = []
+
+    def connect() -> StoreClient:
+        c = StoreClient(sock)
+        clients.append(c)
+        return c
+
+    yield store, connect
+    for c in clients:
+        c.close()
+    service.close()
+
+
+class TestServiceClient:
+    def test_crud_conflict_notfound(self, served):
+        _, connect = served
+        c = connect()
+        created = c.create(_res("a", v=1))
+        assert created.meta.resource_version == 1
+        stale = created
+        c.mutate("Story", "default", "a", lambda r: r.spec.__setitem__("v", 2))
+        stale.spec["v"] = 7
+        with pytest.raises(Conflict):
+            c.update(stale)
+        with pytest.raises(NotFound):
+            c.get("Story", "default", "missing")
+        with pytest.raises(NotFound):
+            c.delete("Story", "default", "missing")
+        c.delete("Story", "default", "a")
+        assert len(c) == 0
+
+    def test_watch_events_and_resync(self, served):
+        _, connect = served
+        c = connect()
+        events = []
+        cond = threading.Condition()
+
+        def on_ev(ev):
+            with cond:
+                events.append((ev.type, ev.resource.meta.name))
+                cond.notify_all()
+
+        c.watch(on_ev, kinds=["Story"])
+        c.create(_res("w1"))
+        with cond:
+            cond.wait_for(lambda: ("ADDED", "w1") in events, timeout=10.0)
+        c.resync()
+        with cond:
+            cond.wait_for(lambda: ("MODIFIED", "w1") in events, timeout=10.0)
+        assert ("ADDED", "w1") in events and ("MODIFIED", "w1") in events
+
+    def test_client_side_admission_chain(self, served):
+        _, connect = served
+        c = connect()
+
+        def default_v(r):
+            r.spec.setdefault("v", 42)
+
+        def deny_neg(new, old):
+            if new.spec.get("v", 0) < 0:
+                raise AdmissionDenied("v must be >= 0")
+
+        c.register_defaulter("Story", default_v)
+        c.register_validator("Story", deny_neg)
+        got = c.create(Resource(kind="Story",
+                                meta=ObjectMeta(namespace="default", name="adm"),
+                                spec={}))
+        assert got.spec["v"] == 42  # defaulted client-side, then shipped
+        with pytest.raises(AdmissionDenied):
+            c.create(_res("bad", v=-1))
+
+    def test_cross_client_gate_and_session_death_rollback(self, served):
+        _, connect = served
+        c1, c2 = connect(), connect()
+        lock1, res1 = c1.scheduling_gate()
+        lock2, res2 = c2.scheduling_gate()
+        with lock1:
+            res1[("q", "default")] = 2
+        with lock2:
+            assert res2.get(("q", "default"), 0) == 2  # one gate, all shards
+            res2[("q", "default")] = 5  # net +3 owned by c2's session
+        c2.close()  # kill -9 analog: session dies holding reservations
+
+        def rolled_back() -> bool:
+            with lock1:
+                return res1.get(("q", "default"), 0) == 2
+
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not rolled_back():
+            time.sleep(0.02)
+        assert rolled_back(), "dead session's net delta was not rolled back"
+
+    def test_list_count_kinds_rv(self, served):
+        _, connect = served
+        c = connect()
+        for i in range(4):
+            c.create(_res(f"l{i}", kind="Engram"))
+        assert {r.meta.name for r in c.list("Engram", "default")} == {
+            "l0", "l1", "l2", "l3"}
+        assert c.count("Engram", "default") == 4
+        assert c.list_keys("Engram", "default") == [
+            ("default", f"l{i}") for i in range(4)]
+        assert "Engram" in c.kinds()
+        assert c._rv_counter == 4
+
+    def test_local_index_fallback(self, served):
+        _, connect = served
+        c = connect()
+        c.add_index("Engram", "byTpl",
+                    lambda r: [r.spec.get("tpl")] if r.spec.get("tpl") else [])
+        c.create(_res("i1", kind="Engram", tpl="t-a"))
+        c.create(_res("i2", kind="Engram", tpl="t-b"))
+        c.create(_res("i3", kind="Engram", tpl="t-a"))
+        got = {r.meta.name for r in c.list("Engram", "default",
+                                           index=("byTpl", "t-a"))}
+        assert got == {"i1", "i3"}
+
+    def test_durable_service_dump_remote(self):
+        d = tempfile.mkdtemp(prefix="bobra-svc-dur-")
+        sock = os.path.join(d, "s.sock")
+        store = DurableResourceStore(os.path.join(d, "data"), fsync_batch=2)
+        service = StoreService(store, sock).start()
+        c = StoreClient(sock)
+        try:
+            c.create(_res("dur1", v=1))
+            c.create(_res("dur2", v=2))
+            c.snapshot_remote()
+            c.create(_res("dur3", v=3))
+            d0 = c.dump_remote()
+        finally:
+            c.close()
+            service.close()
+            store.close()
+        assert d0 == dump_recovered(os.path.join(d, "data"))
+
+
+# ---------------------------------------------------------------------------
+# backend seam + config knobs
+# ---------------------------------------------------------------------------
+
+class TestBackendSeam:
+    def test_inproc_is_default(self, monkeypatch):
+        monkeypatch.delenv(ENV_BACKEND, raising=False)
+        s = make_store()
+        assert isinstance(s, ResourceStore)
+        assert not isinstance(s, DurableResourceStore)
+
+    def test_service_requires_socket(self, monkeypatch):
+        from bobrapet_tpu.core.store import StoreError
+
+        monkeypatch.delenv(ENV_SOCKET, raising=False)
+        with pytest.raises(StoreError):
+            make_store("service")
+
+    def test_env_selects_service(self, served, monkeypatch):
+        _, connect = served
+        ref = connect()  # keeps the fixture socket path
+        monkeypatch.setenv(ENV_BACKEND, "service")
+        monkeypatch.setenv(ENV_SOCKET, ref.socket_path)
+        c = make_store()
+        try:
+            assert isinstance(c, StoreClient)
+        finally:
+            c.close()
+
+
+class TestConfigKnobs:
+    def test_validation_rejects_bad_values(self):
+        from bobrapet_tpu.config.operator import OperatorConfig
+
+        cfg = OperatorConfig()
+        cfg.store.journal_fsync_batch = 0
+        errs = cfg.validate()
+        assert any("store.journal-fsync-batch" in e for e in errs)
+        cfg = OperatorConfig()
+        cfg.store.snapshot_every_records = 0
+        assert any("store.snapshot-every-records" in e for e in cfg.validate())
+
+    def test_dotted_keys_apply(self):
+        from bobrapet_tpu.config.operator import OperatorConfig, parse_config
+
+        cfg = parse_config({
+            "store.journal-fsync-batch": "16",
+            "store.snapshot-every-records": "500",
+        })
+        assert isinstance(cfg, OperatorConfig)
+        assert cfg.store.journal_fsync_batch == 16
+        assert cfg.store.snapshot_every_records == 500
+
+    def test_live_reload_retunes_journal(self, tmp_path):
+        s = DurableResourceStore(str(tmp_path), fsync_batch=64)
+        try:
+            s._journal.set_fsync_batch(4)
+            assert s._journal.fsync_batch == 4
+            s.create(_res("tuned"))
+        finally:
+            s.close()
