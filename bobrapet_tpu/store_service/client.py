@@ -27,9 +27,12 @@ lives in the store-service process. What moves where:
 - **crash windows are explicit**: on disconnect, idempotent reads
   retry transparently through reconnect; in-flight mutations raise
   ``StoreError`` (the caller cannot know whether they committed — the
-  level-triggered reconcile retries); after reconnect the client
-  re-pushes its filter spec and requests a resync (synthetic MODIFIED
-  for all owned state), healing any events lost during the outage.
+  level-triggered reconcile retries); calls issued during an outage
+  fail after ``reconnect_deadline``, but the client itself redials
+  with backoff until the service returns, so an outage of ANY length
+  heals; after reconnect the client re-pushes its filter spec and
+  requests a resync (synthetic MODIFIED for all owned state), healing
+  any events lost during the outage.
 """
 
 from __future__ import annotations
@@ -185,21 +188,30 @@ class StoreClient:
         responses are received inline; event frames that race the
         handshake are buffered for the dispatcher."""
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.settimeout(5.0)
-        sock.connect(self.socket_path)
-        sock.settimeout(None)
-        conn = FrameConn(sock)
-        hello = self._rpc_inline(conn, "hello")
-        with self._lock:
-            self._server_indexes = frozenset(
-                tuple(pair) for pair in hello["indexes"]
-            )
-        router = self._router
-        if router is not None:
-            self._rpc_inline(conn, "set_filter", spec=router.filter_spec())
-        if resync:
-            self._rpc_inline(conn, "resync")
-        self._conn = conn
+        try:
+            sock.settimeout(5.0)
+            sock.connect(self.socket_path)
+            sock.settimeout(None)
+            conn = FrameConn(sock)
+            hello = self._rpc_inline(conn, "hello")
+            with self._lock:
+                self._server_indexes = frozenset(
+                    tuple(pair) for pair in hello["indexes"]
+                )
+            router = self._router
+            if router is not None:
+                self._rpc_inline(conn, "set_filter", spec=router.filter_spec())
+            if resync:
+                self._rpc_inline(conn, "resync")
+        except BaseException:
+            # half-constructed dial: don't leave the socket to GC
+            sock.close()
+            raise
+        old, self._conn = self._conn, conn
+        if old is not None:
+            # the previous conn already EOF'd, but its fd is still open —
+            # without this every reconnect leaks one socket
+            old.close()
 
     def _rpc_inline(self, conn: FrameConn, op: str, **params: Any) -> Any:
         with self._lock:
@@ -250,8 +262,13 @@ class StoreClient:
 
     def _reconnect(self) -> bool:
         """Reader-thread path after EOF: fail in-flight calls (their
-        outcome is unknowable), redial until the deadline, re-push the
-        filter spec, request a resync."""
+        outcome is unknowable), then redial with backoff until the
+        service returns or the client is closed — NEVER give up for
+        good. Individual calls still fail after ``reconnect_deadline``
+        (see ``_call``), but the client itself stays recoverable, so a
+        store-service restart slower than the deadline heals instead of
+        bricking every shard until process restart. On success the
+        filter spec is re-pushed and a resync requested."""
         self._connected.clear()
         with self._lock:
             stranded = list(self._pending.values())
@@ -259,20 +276,17 @@ class StoreClient:
         for call in stranded:
             call.retry = True
             call.event.set()
-        deadline = time.monotonic() + self._reconnect_deadline
-        while not self._closing and time.monotonic() < deadline:
+        delay = 0.05
+        while not self._closing:
             try:
                 self._connect(resync=True)
             except (OSError, StoreError):
-                time.sleep(0.1)
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
                 continue
             self._connected.set()
             _log.info("store client reconnected to %s", self.socket_path)
             return True
-        self._dead = True
-        self._connected.set()  # wake blockers into the dead check
-        with self._ev_cond:
-            self._ev_cond.notify_all()
         return False
 
     def _call(self, op: str, _idempotent: bool = False, **params: Any) -> Any:

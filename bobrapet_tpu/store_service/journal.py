@@ -28,6 +28,7 @@ The write path mirrors etcd's WAL discipline scaled to one box:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
@@ -37,6 +38,8 @@ from typing import Any, Optional
 from ..analysis.racedetect import guarded_state
 from ..core.object import Resource
 from ..core.store import ResourceStore
+
+_log = logging.getLogger(__name__)
 
 JOURNAL_FILE = "journal.jsonl"
 SNAPSHOT_FILE = "snapshot.json"
@@ -70,6 +73,9 @@ class Journal:
         self._durable = 0    # last sequence known fsynced
         self._batch = max(1, int(fsync_batch))
         self._closed = False
+        #: first live-file write/fsync failure; once set, the journal can
+        #: no longer promise durability and every append/wait fails loud
+        self._error: Optional[Exception] = None
         self._file = open(path, "ab")
         self._worker = threading.Thread(
             target=self._fsync_loop, name="journal-fsync", daemon=True
@@ -83,6 +89,10 @@ class Journal:
         with self._cond:
             if self._closed:
                 raise RuntimeError("journal is closed")
+            if self._error is not None:
+                raise RuntimeError(
+                    f"journal write failed: {self._error}"
+                ) from self._error
             self._seq += 1
             self._pending.append(line)
             self._cond.notify_all()
@@ -92,6 +102,15 @@ class Journal:
         with self._cond:
             deadline = None if timeout is None else time.monotonic() + timeout
             while self._durable < seq:
+                if self._error is not None:
+                    # The fsync worker hit a genuine I/O failure on the
+                    # live file: this record may never have reached disk.
+                    # Failing here keeps "durability precedes visibility"
+                    # honest — the commit is reported as an error, never
+                    # acked as durable.
+                    raise RuntimeError(
+                        f"journal write failed: {self._error}"
+                    ) from self._error
                 if self._closed:
                     # reset()/close() account for every outstanding seq
                     # before flipping state, so this is unreachable in
@@ -145,7 +164,12 @@ class Journal:
                 os.fsync(self._file.fileno())
             except (OSError, ValueError):
                 pass
-            self._file.close()
+            try:
+                self._file.close()
+            except OSError:
+                # close() flushes too; a file that already failed its
+                # fsync may refuse even that
+                pass
 
     # -- fsync worker ------------------------------------------------------
     def _fsync_loop(self) -> None:
@@ -159,17 +183,33 @@ class Journal:
                 while self._pending and len(batch) < self._batch:
                     batch.append(self._pending.popleft())
                 file = self._file
+            failure: Optional[Exception] = None
             try:
                 file.write(b"".join(batch))
                 file.flush()
                 os.fsync(file.fileno())
-            except (OSError, ValueError):
-                # reset() swapped the file under us; the snapshot owns
-                # these records' durability now.
-                pass
+            except (OSError, ValueError) as e:
+                failure = e
             with self._cond:
                 if file is self._file:
+                    if failure is not None:
+                        # Genuine live-file write/fsync failure (ENOSPC,
+                        # EIO, …): this batch never reached disk. Marking
+                        # it durable would ack committed-and-lost records,
+                        # so fail the journal loudly instead — appenders
+                        # and durability waiters all raise from here on.
+                        self._error = failure
+                        self._cond.notify_all()
+                        _log.critical(
+                            "journal %s write/fsync failed; failing all "
+                            "durability waiters: %s", self.path, failure,
+                        )
+                        return
                     self._durable += len(batch)
+                # else: reset() swapped the file mid-batch — a failure on
+                # the retired fd is benign, and either way the snapshot
+                # that triggered the reset owns these records' durability
+                # (reset already advanced _durable past them).
                 self._cond.notify_all()
             try:
                 from ..observability.metrics import metrics

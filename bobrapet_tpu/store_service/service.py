@@ -99,7 +99,7 @@ def decode_error(err: dict[str, Any]) -> Exception:
     return StoreError(*args)
 
 
-@guarded_state("_deltas", "_reservations")
+@guarded_state("_dead", "_deltas", "_reservations", "_waiting")
 class _RemoteGate:
     """The bus-wide scheduling gate, served over the wire.
 
@@ -119,12 +119,36 @@ class _RemoteGate:
         self._owner: Optional[int] = None
         self._reservations: dict[Any, Any] = {}
         self._deltas: dict[int, dict[Any, float]] = {}
+        #: sid -> threads currently blocked in acquire()
+        self._waiting: dict[int, int] = {}
+        #: sids whose session died while they still had blocked acquires;
+        #: entries are pruned when the last waiter for that sid leaves
+        self._dead: set[int] = set()
 
     def acquire(self, sid: int) -> None:
+        """Block until the gate is free, then take it — UNLESS this
+        session dies while we wait. A client killed mid-``gate_acquire``
+        must not take ownership after its close ran (close's
+        ``session_died`` would never re-run, wedging the gate bus-wide
+        forever), so ``session_died`` marks waiting sids dead and wakes
+        them to abort here instead."""
         with self._cond:
-            while self._owner is not None:
-                self._cond.wait()
-            self._owner = sid
+            self._waiting[sid] = self._waiting.get(sid, 0) + 1
+            try:
+                while self._owner is not None and sid not in self._dead:
+                    self._cond.wait()
+                if sid in self._dead:
+                    raise StoreError(
+                        "session died while waiting for scheduling gate"
+                    )
+                self._owner = sid
+            finally:
+                left = self._waiting.get(sid, 1) - 1
+                if left > 0:
+                    self._waiting[sid] = left
+                else:
+                    self._waiting.pop(sid, None)
+                    self._dead.discard(sid)
 
     def release(self, sid: int) -> None:
         with self._cond:
@@ -158,6 +182,8 @@ class _RemoteGate:
         with self._cond:
             if self._owner == sid:
                 self._owner = None
+            if self._waiting.get(sid):
+                self._dead.add(sid)
             for key, delta in self._deltas.pop(sid, {}).items():
                 if not delta:
                     continue
@@ -272,8 +298,34 @@ class _Session:
                      "error": {"type": "StoreError", "args": [repr(e)]}}
         try:
             self.conn.send(frame)
-        except (OSError, ValueError):
-            self.close()
+        except ValueError:
+            # Oversized response (e.g. list_views over a huge store):
+            # the stream is still framed and healthy — fail just this
+            # call instead of tearing down the watch stream and every
+            # in-flight request with it.
+            err = {
+                "id": rid, "ok": False,
+                "error": {"type": "StoreError", "args": [
+                    f"response to {req.get('op')!r} exceeds the frame cap"
+                ]},
+            }
+            try:
+                self.conn.send(err)
+            except (OSError, ValueError):
+                self._send_failed()
+        except OSError:
+            self._send_failed()
+
+    def _send_failed(self) -> None:
+        """A response could not be delivered: the connection is dead.
+        ``close()`` early-returns if the reader's EOF path already closed
+        this session — but a gate acquisition that completed AFTER that
+        close (a stranded ``gate_acquire`` thread taking ownership for a
+        dead sid) would then never be rolled back, deadlocking the gate
+        bus-wide. ``session_died`` is idempotent, so re-run it
+        unconditionally here."""
+        self.close()
+        self.service.gate.session_died(self.sid)
 
     def _dispatch(self, req: dict[str, Any]) -> Any:
         op = req["op"]

@@ -17,7 +17,13 @@ import time
 import pytest
 
 from bobrapet_tpu.core.object import ObjectMeta, Resource, new_resource
-from bobrapet_tpu.core.store import AdmissionDenied, Conflict, NotFound, ResourceStore
+from bobrapet_tpu.core.store import (
+    AdmissionDenied,
+    Conflict,
+    NotFound,
+    ResourceStore,
+    StoreError,
+)
 from bobrapet_tpu.observability.metrics import metrics
 from bobrapet_tpu.store_service import (
     DurableResourceStore,
@@ -148,6 +154,33 @@ class TestJournal:
         j.close()  # must drain pending before the worker exits
         assert j.durable_seq >= last
         assert len((tmp_path / "j.jsonl").read_bytes().splitlines()) == 10
+
+    def test_live_fsync_failure_fails_loud_not_silently_durable(
+        self, tmp_path, monkeypatch
+    ):
+        """A genuine I/O failure (ENOSPC/EIO analog) on the LIVE file
+        must never advance _durable: waiters and appenders get errors,
+        never an ack for a record the journal lost."""
+        import bobrapet_tpu.store_service.journal as journal_mod
+
+        j = Journal(str(tmp_path / "j.jsonl"), fsync_batch=8)
+        try:
+            seq0 = j.append({"n": 0})
+            j.wait_durable(seq0, timeout=10.0)
+
+            def broken_fsync(fd):
+                raise OSError(28, "No space left on device")
+
+            monkeypatch.setattr(journal_mod.os, "fsync", broken_fsync)
+            seq1 = j.append({"n": 1})
+            with pytest.raises(RuntimeError, match="journal write failed"):
+                j.wait_durable(seq1, timeout=10.0)
+            assert j.durable_seq < seq1  # the lost batch was NOT acked
+            with pytest.raises(RuntimeError, match="journal write failed"):
+                j.append({"n": 2})
+        finally:
+            monkeypatch.undo()
+            j.close()
 
 
 class TestDurableStore:
@@ -322,6 +355,97 @@ class TestServiceClient:
         while time.monotonic() < deadline and not rolled_back():
             time.sleep(0.02)
         assert rolled_back(), "dead session's net delta was not rolled back"
+
+    def test_gate_survives_client_killed_while_waiting(self, served):
+        """kill -9 analog for a client whose gate_acquire is BLOCKED:
+        its stranded server-side acquire thread must never take (and
+        keep) ownership for the dead sid — the gate has to stay
+        acquirable bus-wide afterwards."""
+        _, connect = served
+        c1, c2, c3 = connect(), connect(), connect()
+        lock1, _ = c1.scheduling_gate()
+        lock2, _ = c2.scheduling_gate()
+        lock3, _ = c3.scheduling_gate()
+        lock1.acquire()
+        try:
+            waiter_done = threading.Event()
+
+            def blocked_acquire():
+                try:
+                    lock2.acquire()
+                except StoreError:
+                    pass  # expected: session died mid-acquire
+                waiter_done.set()
+
+            t = threading.Thread(target=blocked_acquire, daemon=True)
+            t.start()
+            time.sleep(0.3)  # let gate_acquire reach the service and block
+            c2.close()  # die while waiting for the gate
+            time.sleep(0.2)  # let the service tear the session down
+        finally:
+            lock1.release()
+
+        acquired = threading.Event()
+
+        def third():
+            lock3.acquire()
+            acquired.set()
+            lock3.release()
+
+        t3 = threading.Thread(target=third, daemon=True)
+        t3.start()
+        assert acquired.wait(10.0), "gate wedged by client killed mid-acquire"
+        assert waiter_done.wait(10.0)
+        t3.join(timeout=5.0)
+
+    def test_client_survives_outage_longer_than_deadline(self):
+        """A store-service restart SLOWER than reconnect_deadline must
+        not brick the client: calls during the outage fail, but the
+        client keeps redialing and heals once the service returns."""
+        d = tempfile.mkdtemp(prefix="bobra-svc-outage-")
+        sock = os.path.join(d, "s.sock")
+        service = StoreService(ResourceStore(), sock).start()
+        c = StoreClient(sock, reconnect_deadline=0.2)
+        try:
+            c.create(_res("pre"))
+            service.close()
+            time.sleep(0.6)  # outage 3x the reconnect deadline
+            service2 = StoreService(ResourceStore(), sock).start()
+            try:
+                deadline = time.monotonic() + 10.0
+                while True:
+                    try:
+                        c.create(_res("post"))
+                        break
+                    except StoreError:
+                        assert time.monotonic() < deadline, (
+                            "client never recovered after slow restart"
+                        )
+                        time.sleep(0.05)
+                assert c.get("Story", "default", "post").meta.name == "post"
+            finally:
+                service2.close()
+        finally:
+            c.close()
+
+    def test_oversized_response_fails_call_not_session(
+        self, served, monkeypatch
+    ):
+        """A response above the frame cap must fail just that call with
+        a StoreError — not tear down the session (watch stream and all
+        in-flight requests) the way a real socket death does."""
+        from bobrapet_tpu.store_service import wire
+
+        _, connect = served
+        c = connect()
+        for i in range(50):
+            c.create(_res(f"wide{i}", v=i))
+        time.sleep(0.2)  # drain small watch frames before lowering the cap
+        monkeypatch.setattr(wire, "MAX_FRAME", 4096)
+        with pytest.raises(StoreError, match="frame cap"):
+            c.list("Story", "default")
+        # session survived: single-object traffic still flows
+        assert c.get("Story", "default", "wide7").spec["v"] == 7
 
     def test_list_count_kinds_rv(self, served):
         _, connect = served
